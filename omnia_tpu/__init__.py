@@ -1,0 +1,18 @@
+"""omnia_tpu — TPU-native agent-serving platform.
+
+Two planes, meeting at the runtime gRPC contract:
+
+- **Compute plane** (`models/`, `ops/`, `parallel/`, `engine/`): a JAX/XLA
+  continuous-batching inference engine (Llama / Mixtral family) sharded with
+  ``jax.sharding`` over a device mesh. This replaces the reference platform's
+  remote HTTPS provider clients (AltairaLabs/Omnia consumes LLMs via
+  PromptKit provider SDKs; see reference internal/runtime/provider.go:93-135)
+  with on-device inference.
+
+- **Platform plane** (`runtime/`, `facade/`, `operator/`, `session/`,
+  `memory/`, `tools/`, `evals/`): the agent-serving control/data plane with
+  the same capabilities as the reference (operator, CRD-style resources,
+  WebSocket facade, session/memory APIs, tool execution, eval workers).
+"""
+
+__version__ = "0.1.0"

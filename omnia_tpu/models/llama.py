@@ -1,0 +1,252 @@
+"""Llama-family transformer (dense MLP or Mixtral-style MoE), functional JAX.
+
+Design notes (TPU-first, not a port — the reference platform executes no
+models; see SURVEY.md §0):
+
+- **Params are a plain pytree** with all layers stacked on a leading [L] axis
+  and the forward pass runs ``lax.scan`` over layers. One traced layer body
+  instead of L inlined copies → ~L× faster XLA compiles and an HLO whose
+  while-loop body XLA tiles once for the MXU.
+- **One forward for prefill AND decode.** The KV cache is slot-contiguous
+  (row s = absolute position s), writes land via per-batch
+  ``dynamic_update_slice`` at ``write_start``, and causality is just
+  ``key_index <= query_position`` (ops/attention.py). Multi-turn incremental
+  prefill falls out for free: pass write_start = current length.
+- **Sharding by annotation**: ``param_specs`` returns a PartitionSpec pytree
+  (megatron-style tensor parallel over the "tp" mesh axis: attention heads,
+  FFN hidden dim, expert dim, vocab). Activations shard batch over "dp". XLA
+  GSPMD inserts the collectives; there are no explicit psums here.
+- Compute dtype bf16 (MXU native), logits and softmax statistics f32.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from omnia_tpu.models.config import ModelConfig
+from omnia_tpu.ops.attention import gqa_attention
+from omnia_tpu.ops.norms import rms_norm
+from omnia_tpu.ops.rope import apply_rope, rope_cos_sin
+
+
+# ---------------------------------------------------------------------------
+# Parameters
+# ---------------------------------------------------------------------------
+
+
+def init_params(cfg: ModelConfig, key: jax.Array, dtype=jnp.bfloat16):
+    """Random-initialized parameter pytree (layers stacked on axis 0)."""
+    L, D, F, V = cfg.num_layers, cfg.hidden_size, cfg.ffn_hidden_size, cfg.vocab_size
+    keys = iter(jax.random.split(key, 16))
+
+    def normal(key, shape, std=0.02):
+        return (jax.random.normal(key, shape, dtype=jnp.float32) * std).astype(dtype)
+
+    attn = {
+        "wq": normal(next(keys), (L, D, cfg.q_dim)),
+        "wk": normal(next(keys), (L, D, cfg.kv_dim)),
+        "wv": normal(next(keys), (L, D, cfg.kv_dim)),
+        "wo": normal(next(keys), (L, cfg.q_dim, D), std=0.02 / (2 * L) ** 0.5),
+    }
+    if cfg.is_moe:
+        E = cfg.num_experts
+        mlp = {
+            "router": normal(next(keys), (L, D, E)),
+            "wg": normal(next(keys), (L, E, D, F)),
+            "wu": normal(next(keys), (L, E, D, F)),
+            "wd": normal(next(keys), (L, E, F, D), std=0.02 / (2 * L) ** 0.5),
+        }
+    else:
+        mlp = {
+            "wg": normal(next(keys), (L, D, F)),
+            "wu": normal(next(keys), (L, D, F)),
+            "wd": normal(next(keys), (L, F, D), std=0.02 / (2 * L) ** 0.5),
+        }
+    params = {
+        "embed": normal(next(keys), (V, D)),
+        "layers": {
+            "ln1": jnp.ones((L, D), dtype=dtype),
+            "ln2": jnp.ones((L, D), dtype=dtype),
+            "attn": attn,
+            "mlp": mlp,
+        },
+        "final_norm": jnp.ones((D,), dtype=dtype),
+    }
+    if not cfg.tie_embeddings:
+        params["lm_head"] = normal(next(keys), (D, V))
+    return params
+
+
+def param_specs(cfg: ModelConfig):
+    """PartitionSpec pytree matching init_params (tensor parallel on "tp")."""
+    attn = {
+        "wq": P(None, None, "tp"),
+        "wk": P(None, None, "tp"),
+        "wv": P(None, None, "tp"),
+        "wo": P(None, "tp", None),
+    }
+    if cfg.is_moe:
+        # Expert parallelism: experts sharded over the same ICI axis.
+        mlp = {
+            "router": P(None, None, None),
+            "wg": P(None, "tp", None, None),
+            "wu": P(None, "tp", None, None),
+            "wd": P(None, "tp", None, None),
+        }
+    else:
+        mlp = {
+            "wg": P(None, None, "tp"),
+            "wu": P(None, None, "tp"),
+            "wd": P(None, "tp", None),
+        }
+    specs = {
+        "embed": P("tp", None),
+        "layers": {
+            "ln1": P(None, None),
+            "ln2": P(None, None),
+            "attn": attn,
+            "mlp": mlp,
+        },
+        "final_norm": P(None),
+    }
+    if not cfg.tie_embeddings:
+        specs["lm_head"] = P(None, "tp")
+    return specs
+
+
+def kv_cache_specs() -> tuple:
+    """(k, v) PartitionSpecs for [L, B, S, Hkv, D] caches: batch over "dp",
+    KV heads over "tp"."""
+    spec = P(None, "dp", None, "tp", None)
+    return spec, spec
+
+
+def init_kv_cache(cfg: ModelConfig, batch: int, seq: int, dtype=jnp.bfloat16):
+    shape = (cfg.num_layers, batch, seq, cfg.num_kv_heads, cfg.head_dim)
+    return jnp.zeros(shape, dtype=dtype), jnp.zeros(shape, dtype=dtype)
+
+
+# ---------------------------------------------------------------------------
+# Layer body
+# ---------------------------------------------------------------------------
+
+
+def _dense_mlp(h, p):
+    gate = jnp.dot(h, p["wg"])
+    up = jnp.dot(h, p["wu"])
+    return jnp.dot(jax.nn.silu(gate) * up, p["wd"])
+
+
+def _moe_mlp(h, p, cfg: ModelConfig):
+    """Mixtral MoE. v1 computes every expert and combines with router weights
+    masked to the top-k (exact; ~E/k extra FLOPs). Capacity-based sorted
+    dispatch is the planned optimization once the serving path is profiled.
+    """
+    E, K = cfg.num_experts, cfg.num_experts_per_tok
+    router_logits = jnp.dot(h, p["router"]).astype(jnp.float32)  # [B,T,E]
+    probs = jax.nn.softmax(router_logits, axis=-1)
+    top_w, top_i = jax.lax.top_k(probs, K)  # [B,T,K]
+    top_w = top_w / top_w.sum(axis=-1, keepdims=True)
+    combine = jnp.zeros_like(probs)  # [B,T,E]
+    combine = jnp.sum(jax.nn.one_hot(top_i, E, dtype=probs.dtype) * top_w[..., None], axis=-2)
+
+    # All-expert compute, expert dim sharded over "tp" (expert parallelism):
+    # each device computes its experts for all tokens; the combine einsum
+    # reduces over E, which GSPMD turns into a psum over the tp axis.
+    gate = jnp.einsum("btd,edf->betf", h, p["wg"])
+    up = jnp.einsum("btd,edf->betf", h, p["wu"])
+    expert_out = jnp.einsum("betf,efd->betd", jax.nn.silu(gate) * up, p["wd"])
+    return jnp.einsum("bte,betd->btd", combine.astype(h.dtype), expert_out)
+
+
+def _write_kv(cache, new, start):
+    """cache [B,S,Hkv,D] ← new [B,T,Hkv,D] at per-batch row offsets start [B]."""
+
+    def one(c, n, s):
+        return jax.lax.dynamic_update_slice(c, n, (s, 0, 0))
+
+    return jax.vmap(one)(cache, new.astype(cache.dtype), start)
+
+
+def _layer(x, p, cfg: ModelConfig, cos, sin, q_positions, ck, cv, write_start):
+    B, T, D = x.shape
+    h = rms_norm(x, p["ln1"], cfg.rms_norm_eps)
+    q = jnp.dot(h, p["attn"]["wq"]).reshape(B, T, cfg.num_heads, cfg.head_dim)
+    k = jnp.dot(h, p["attn"]["wk"]).reshape(B, T, cfg.num_kv_heads, cfg.head_dim)
+    v = jnp.dot(h, p["attn"]["wv"]).reshape(B, T, cfg.num_kv_heads, cfg.head_dim)
+    q = apply_rope(q, cos, sin)
+    k = apply_rope(k, cos, sin)
+
+    if ck is None:
+        # Training / no-cache path: attend over this chunk's own keys.
+        ck_eff, cv_eff = k, v
+    else:
+        ck = _write_kv(ck, k, write_start)
+        cv = _write_kv(cv, v, write_start)
+        ck_eff, cv_eff = ck, cv
+
+    attn = gqa_attention(q, ck_eff, cv_eff, q_positions)
+    x = x + jnp.dot(attn.reshape(B, T, -1), p["attn"]["wo"])
+
+    h2 = rms_norm(x, p["ln2"], cfg.rms_norm_eps)
+    if cfg.is_moe:
+        x = x + _moe_mlp(h2, p["mlp"], cfg)
+    else:
+        x = x + _dense_mlp(h2, p["mlp"])
+    return x, ck, cv
+
+
+# ---------------------------------------------------------------------------
+# Forward passes
+# ---------------------------------------------------------------------------
+
+
+def _logits(params, cfg: ModelConfig, x):
+    x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
+    if cfg.tie_embeddings:
+        return jnp.dot(x, params["embed"].T).astype(jnp.float32)
+    return jnp.dot(x, params["lm_head"]).astype(jnp.float32)
+
+
+def forward(params, cfg: ModelConfig, tokens, q_positions, cache_k, cache_v, write_start):
+    """Serving forward (prefill or decode — same code, different T).
+
+    tokens, q_positions: int32 [B, T]; cache_k/v: [L, B, S, Hkv, D];
+    write_start: int32 [B] row offset where this chunk's KV lands.
+    Returns (logits [B, T, V] f32, new_cache_k, new_cache_v).
+    """
+    x = params["embed"][tokens]  # [B,T,D]
+    cos, sin = rope_cos_sin(q_positions, cfg.head_dim, cfg.rope_theta)
+
+    def body(carry, scanned):
+        x = carry
+        p, ck, cv = scanned
+        x, ck, cv = _layer(x, p, cfg, cos, sin, q_positions, ck, cv, write_start)
+        return x, (ck, cv)
+
+    x, (new_k, new_v) = jax.lax.scan(
+        body, x, (params["layers"], cache_k, cache_v)
+    )
+    return _logits(params, cfg, x), new_k, new_v
+
+
+def forward_train(params, cfg: ModelConfig, tokens):
+    """Full causal forward with no cache (training / scoring).
+
+    tokens: int32 [B, T] → logits [B, T, V] f32.
+    """
+    B, T = tokens.shape
+    x = params["embed"][tokens]
+    q_positions = jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32)[None, :], (B, T))
+    cos, sin = rope_cos_sin(q_positions, cfg.head_dim, cfg.rope_theta)
+
+    def body(x, p):
+        x, _, _ = _layer(x, p, cfg, cos, sin, q_positions, None, None, None)
+        return x, None
+
+    x, _ = jax.lax.scan(body, x, params["layers"])
+    return _logits(params, cfg, x)

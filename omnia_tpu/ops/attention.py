@@ -1,0 +1,62 @@
+"""Grouped-query attention over a slot-contiguous KV cache.
+
+Design notes (TPU-first):
+
+- One attention routine serves both prefill and decode. The KV cache is laid
+  out slot-contiguously: cache row ``s`` holds the key/value for absolute
+  position ``s`` of that sequence, so the causal mask is simply
+  ``key_index <= query_position``. Unified masking means one compiled kernel
+  shape per (batch, q_len) bucket instead of separate mask plumbing.
+- Softmax and the score matmul accumulate in float32; inputs stay bf16 so both
+  matmuls hit the MXU.
+- GQA is expressed by reshaping Q to [B, T, Hkv, G, D] and batching the
+  einsums over the KV-head axis — no materialized KV repeat (which would
+  multiply HBM traffic by the group size).
+- Head axes are sharded over the "tp" mesh axis by the caller (weights carry
+  the sharding; XLA propagates it here with no collectives inside attention).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+_NEG_INF = -1e30
+
+
+def gqa_attention(
+    q: jnp.ndarray,
+    k_cache: jnp.ndarray,
+    v_cache: jnp.ndarray,
+    q_positions: jnp.ndarray,
+) -> jnp.ndarray:
+    """Attention of queries against a slot-contiguous KV cache.
+
+    q: [B, T, H, D] (already rotary-embedded)
+    k_cache, v_cache: [B, S, Hkv, D] (position s stored at row s)
+    q_positions: int [B, T] absolute position of each query token.
+    Returns [B, T, H, D].
+    """
+    B, T, H, D = q.shape
+    S = k_cache.shape[1]
+    Hkv = k_cache.shape[2]
+    G = H // Hkv
+
+    qg = q.reshape(B, T, Hkv, G, D)
+    # scores [B, Hkv, G, T, S]
+    scores = jnp.einsum(
+        "bthgd,bshd->bhgts", qg, k_cache, preferred_element_type=jnp.float32
+    )
+    scores = scores * (D**-0.5)
+
+    key_idx = jnp.arange(S, dtype=jnp.int32)
+    # valid iff key position <= query position (causal; rows past the written
+    # prefix have key_idx > q_pos so they are masked automatically)
+    mask = key_idx[None, None, :] <= q_positions[:, :, None]  # [B, T, S]
+    scores = jnp.where(mask[:, None, None, :, :], scores, _NEG_INF)
+
+    probs = jnp.exp(scores - scores.max(axis=-1, keepdims=True))
+    probs = probs / probs.sum(axis=-1, keepdims=True)
+    probs = probs.astype(v_cache.dtype)
+
+    out = jnp.einsum("bhgts,bshd->bthgd", probs, v_cache)
+    return out.reshape(B, T, H, D)

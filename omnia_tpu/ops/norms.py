@@ -1,0 +1,20 @@
+"""Normalization ops.
+
+Computed in float32 regardless of input dtype (bf16 accumulation of squares
+loses too much precision), cast back to the input dtype so surrounding matmuls
+stay on the MXU in bf16.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def rms_norm(x: jnp.ndarray, weight: jnp.ndarray, eps: float = 1e-5) -> jnp.ndarray:
+    """RMSNorm: x / rms(x) * weight, reduction over the last axis."""
+    dtype = x.dtype
+    xf = x.astype(jnp.float32)
+    var = jnp.mean(xf * xf, axis=-1, keepdims=True)
+    normed = xf * jax.lax.rsqrt(var + eps)
+    return (normed * weight.astype(jnp.float32)).astype(dtype)

@@ -1,0 +1,36 @@
+"""Rotary position embeddings (half-split / "rotate-half" convention).
+
+Angles are computed in float32 from integer positions (not accumulated), so
+decode steps at large positions stay exact. Cos/sin are computed on the fly —
+they are cheap VPU work that XLA fuses into the surrounding ops, which beats
+materializing a [max_seq, head_dim] table in HBM.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def rope_cos_sin(positions: jnp.ndarray, head_dim: int, theta: float):
+    """Cos/sin for rotary embedding.
+
+    positions: int array [...]. Returns (cos, sin) of shape [..., head_dim//2]
+    in float32.
+    """
+    inv_freq = 1.0 / (
+        theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim)
+    )
+    angles = positions[..., None].astype(jnp.float32) * inv_freq
+    return jnp.cos(angles), jnp.sin(angles)
+
+
+def apply_rope(x: jnp.ndarray, cos: jnp.ndarray, sin: jnp.ndarray) -> jnp.ndarray:
+    """Apply rotary embedding.
+
+    x: [..., H, head_dim]; cos/sin: [..., head_dim//2] (broadcast over H).
+    """
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    cos = cos[..., None, :]
+    sin = sin[..., None, :]
+    out = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
+    return out.astype(x.dtype)

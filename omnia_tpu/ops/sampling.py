@@ -1,0 +1,65 @@
+"""Vectorized token sampling: temperature / top-k / top-p / greedy.
+
+All paths are jit-compatible with per-slot (batched) dynamic temperature and
+top-p, so one compiled decode step serves heterogeneous requests in the same
+continuous batch — the whole point of slot-based serving. top_k is static
+(changes the top_k kernel shape); the engine buckets it.
+
+Greedy is expressed as temperature <= 0 and resolved with jnp.where, not
+Python branching, to keep the step traceable.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+_NEG_INF = -1e30
+
+
+def _apply_top_k(logits: jnp.ndarray, k: int) -> jnp.ndarray:
+    vals, _ = jax.lax.top_k(logits, k)
+    kth = vals[..., -1:]
+    return jnp.where(logits < kth, _NEG_INF, logits)
+
+
+def _apply_top_p(logits: jnp.ndarray, top_p: jnp.ndarray) -> jnp.ndarray:
+    """top_p: [B, 1] in (0, 1]. Keeps the smallest set of tokens whose
+    cumulative probability exceeds top_p."""
+    sorted_desc = jnp.sort(logits, axis=-1)[..., ::-1]
+    probs = jax.nn.softmax(sorted_desc, axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    # A sorted token is kept if the mass strictly before it is < top_p.
+    keep = (cum - probs) < top_p
+    # Smallest kept logit is the admission threshold in original order.
+    threshold = jnp.min(
+        jnp.where(keep, sorted_desc, jnp.inf), axis=-1, keepdims=True
+    )
+    return jnp.where(logits < threshold, _NEG_INF, logits)
+
+
+def sample_tokens(
+    logits: jnp.ndarray,
+    key: jax.Array,
+    temperature: jnp.ndarray,
+    top_p: jnp.ndarray,
+    top_k: int = 0,
+) -> jnp.ndarray:
+    """Sample one token per row.
+
+    logits: [B, V] float; temperature: [B] (<=0 means greedy); top_p: [B]
+    (>=1 disables); top_k: static int (0 disables). Returns int32 [B].
+    """
+    logits = logits.astype(jnp.float32)
+    greedy_tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+    temp = jnp.maximum(temperature, 1e-6)[:, None]
+    scaled = logits / temp
+    if top_k > 0:
+        scaled = _apply_top_k(scaled, top_k)
+    scaled = _apply_top_p(scaled, top_p[:, None])
+
+    gumbel = jax.random.gumbel(key, scaled.shape, dtype=jnp.float32)
+    sampled_tok = jnp.argmax(scaled + gumbel, axis=-1).astype(jnp.int32)
+
+    return jnp.where(temperature <= 0.0, greedy_tok, sampled_tok)

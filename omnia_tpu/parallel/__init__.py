@@ -1,0 +1,4 @@
+from omnia_tpu.parallel.mesh import make_mesh
+from omnia_tpu.parallel.sharding import shard_pytree, named_sharding_tree
+
+__all__ = ["make_mesh", "shard_pytree", "named_sharding_tree"]

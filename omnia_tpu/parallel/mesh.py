@@ -1,0 +1,41 @@
+"""Device-mesh construction.
+
+Axis conventions used across omnia_tpu:
+
+- "dp": data parallel — request batch slots in serving, global batch in
+  training/eval. Maps across slices/hosts (DCN-tolerant: only batch-sharded
+  activations cross it).
+- "tp": tensor parallel — attention heads, FFN hidden, expert dim, vocab.
+  Must stay inside a slice so its all-reduces ride ICI.
+
+The reference platform has no device meshes at all (its parallelism is K8s
+replica scaling — reference internal/controller/autoscaling.go:74); the mesh
+is the new TPU-native scaling substrate underneath that same autoscaling
+surface.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+
+def make_mesh(dp: int = 1, tp: int = 1, devices: Optional[Sequence] = None) -> Mesh:
+    devices = list(devices if devices is not None else jax.devices())
+    n = dp * tp
+    if len(devices) < n:
+        raise ValueError(f"mesh {dp}x{tp} needs {n} devices, have {len(devices)}")
+    try:
+        from jax.experimental import mesh_utils
+
+        dev_array = mesh_utils.create_device_mesh((dp, tp), devices=devices[:n])
+    except Exception:
+        dev_array = np.array(devices[:n]).reshape(dp, tp)
+    return Mesh(dev_array, ("dp", "tp"))
+
+
+def single_device_mesh() -> Mesh:
+    return make_mesh(1, 1)

@@ -1,0 +1,27 @@
+"""Sharding helpers: map PartitionSpec pytrees onto a mesh."""
+
+from __future__ import annotations
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+
+def _is_spec(x) -> bool:
+    return isinstance(x, PartitionSpec)
+
+
+def named_sharding_tree(specs, mesh: Mesh):
+    """PartitionSpec pytree → NamedSharding pytree."""
+    return jax.tree.map(
+        lambda s: NamedSharding(mesh, s), specs, is_leaf=_is_spec
+    )
+
+
+def shard_pytree(tree, specs, mesh: Mesh):
+    """device_put every leaf of `tree` with the matching spec in `specs`."""
+    return jax.tree.map(
+        lambda s, x: jax.device_put(x, NamedSharding(mesh, s)),
+        specs,
+        tree,
+        is_leaf=_is_spec,
+    )

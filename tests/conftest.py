@@ -1,0 +1,44 @@
+"""Test bootstrap: force an 8-device virtual CPU platform.
+
+Two subtleties of this environment:
+
+- The axon TPU plugin registers itself from sitecustomize at interpreter
+  start, so jax may already be imported before this file runs. Backend
+  *creation* is lazy though, so ``jax.config.update("jax_platforms", ...)``
+  still wins as long as no backend has been touched yet — env vars alone
+  are NOT sufficient here.
+- ``xla_force_host_platform_device_count`` is read from XLA_FLAGS when the
+  CPU client is created, which is also lazy — setting it here works.
+
+Mirrors the reference's clusterless testing stance (SURVEY.md §4: the
+reference tests distributed topology without a cluster via a file-backed
+fake); multi-chip sharding is tested without TPUs via virtual host devices.
+"""
+
+import os
+
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session", autouse=True)
+def _assert_cpu_platform():
+    devs = jax.devices()
+    assert devs[0].platform == "cpu", f"tests must run on CPU, got {devs[0]}"
+    yield
+
+
+@pytest.fixture(scope="session")
+def devices8():
+    devs = jax.devices()
+    assert len(devs) >= 8, f"expected 8 virtual devices, got {len(devs)}"
+    return devs

@@ -1,0 +1,154 @@
+"""Numerics tests for core ops against straightforward NumPy references."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from omnia_tpu.ops.attention import gqa_attention
+from omnia_tpu.ops.norms import rms_norm
+from omnia_tpu.ops.rope import apply_rope, rope_cos_sin
+from omnia_tpu.ops.sampling import sample_tokens
+
+
+def test_rms_norm_matches_reference():
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((2, 5, 16)).astype(np.float32)
+    w = rng.standard_normal(16).astype(np.float32)
+    eps = 1e-5
+    expected = x / np.sqrt((x**2).mean(-1, keepdims=True) + eps) * w
+    got = rms_norm(jnp.asarray(x), jnp.asarray(w), eps)
+    np.testing.assert_allclose(np.asarray(got), expected, rtol=1e-5, atol=1e-5)
+
+
+def test_rms_norm_preserves_dtype():
+    x = jnp.ones((2, 8), dtype=jnp.bfloat16)
+    w = jnp.ones(8, dtype=jnp.bfloat16)
+    assert rms_norm(x, w).dtype == jnp.bfloat16
+
+
+def test_rope_preserves_norm():
+    rng = np.random.default_rng(1)
+    x = jnp.asarray(rng.standard_normal((1, 6, 4, 32)).astype(np.float32))
+    pos = jnp.arange(6, dtype=jnp.int32)[None, :]
+    cos, sin = rope_cos_sin(pos, 32, 10000.0)
+    out = apply_rope(x, cos, sin)
+    np.testing.assert_allclose(
+        np.linalg.norm(np.asarray(out), axis=-1),
+        np.linalg.norm(np.asarray(x), axis=-1),
+        rtol=1e-4,
+    )
+
+
+def test_rope_relative_property():
+    """<rope(q,m), rope(k,n)> depends only on m-n."""
+    rng = np.random.default_rng(2)
+    q = jnp.asarray(rng.standard_normal((1, 1, 1, 16)).astype(np.float32))
+    k = jnp.asarray(rng.standard_normal((1, 1, 1, 16)).astype(np.float32))
+
+    def dot_at(m, n):
+        pos_q = jnp.full((1, 1), m, dtype=jnp.int32)
+        pos_k = jnp.full((1, 1), n, dtype=jnp.int32)
+        cq, sq = rope_cos_sin(pos_q, 16, 10000.0)
+        ck, sk = rope_cos_sin(pos_k, 16, 10000.0)
+        return float(jnp.sum(apply_rope(q, cq, sq) * apply_rope(k, ck, sk)))
+
+    assert abs(dot_at(5, 3) - dot_at(12, 10)) < 1e-4
+    assert abs(dot_at(0, 0) - dot_at(7, 7)) < 1e-4
+
+
+def _naive_attention(q, k, v, q_pos):
+    """NumPy GQA reference. q [B,T,H,D]; k,v [B,S,Hkv,D]; q_pos [B,T]."""
+    B, T, H, D = q.shape
+    S, Hkv = k.shape[1], k.shape[2]
+    G = H // Hkv
+    out = np.zeros_like(q, dtype=np.float32)
+    for b in range(B):
+        for h in range(H):
+            kv_h = h // G
+            scores = q[b, :, h] @ k[b, :, kv_h].T / np.sqrt(D)  # [T,S]
+            mask = np.arange(S)[None, :] <= q_pos[b][:, None]
+            scores = np.where(mask, scores, -1e30)
+            e = np.exp(scores - scores.max(-1, keepdims=True))
+            p = e / e.sum(-1, keepdims=True)
+            out[b, :, h] = p @ v[b, :, kv_h]
+    return out
+
+
+def test_gqa_attention_matches_naive():
+    rng = np.random.default_rng(3)
+    B, T, S, H, Hkv, D = 2, 4, 8, 4, 2, 16
+    q = rng.standard_normal((B, T, H, D)).astype(np.float32)
+    k = rng.standard_normal((B, S, Hkv, D)).astype(np.float32)
+    v = rng.standard_normal((B, S, Hkv, D)).astype(np.float32)
+    q_pos = np.array([[0, 1, 2, 3], [2, 3, 4, 5]], dtype=np.int32)
+    got = gqa_attention(jnp.asarray(q), jnp.asarray(k), jnp.asarray(v), jnp.asarray(q_pos))
+    expected = _naive_attention(q, k, v, q_pos)
+    np.testing.assert_allclose(np.asarray(got), expected, rtol=1e-4, atol=1e-4)
+
+
+def test_gqa_attention_mha_case():
+    """H == Hkv (no grouping) still works."""
+    rng = np.random.default_rng(4)
+    B, T, S, H, D = 1, 2, 4, 2, 8
+    q = rng.standard_normal((B, T, H, D)).astype(np.float32)
+    k = rng.standard_normal((B, S, H, D)).astype(np.float32)
+    v = rng.standard_normal((B, S, H, D)).astype(np.float32)
+    q_pos = np.array([[1, 2]], dtype=np.int32)
+    got = gqa_attention(jnp.asarray(q), jnp.asarray(k), jnp.asarray(v), jnp.asarray(q_pos))
+    expected = _naive_attention(q, k, v, q_pos)
+    np.testing.assert_allclose(np.asarray(got), expected, rtol=1e-4, atol=1e-4)
+
+
+class TestSampling:
+    def test_greedy_when_temperature_zero(self):
+        logits = jnp.asarray([[0.1, 5.0, 0.2], [3.0, 0.0, -1.0]])
+        toks = sample_tokens(
+            logits,
+            jax.random.key(0),
+            temperature=jnp.zeros(2),
+            top_p=jnp.ones(2),
+        )
+        assert toks.tolist() == [1, 0]
+
+    def test_top_k_restricts_support(self):
+        logits = jnp.asarray([[0.0, 1.0, 2.0, 3.0]] * 64, dtype=jnp.float32)
+        toks = sample_tokens(
+            logits,
+            jax.random.key(1),
+            temperature=jnp.full(64, 10.0),  # near-uniform over survivors
+            top_p=jnp.ones(64),
+            top_k=2,
+        )
+        assert set(np.asarray(toks).tolist()) <= {2, 3}
+
+    def test_top_p_restricts_support(self):
+        # softmax([0,0,10,10]) ≈ [~0, ~0, .5, .5]; top_p=0.9 keeps {2,3}.
+        logits = jnp.asarray([[0.0, 0.0, 10.0, 10.0]] * 64, dtype=jnp.float32)
+        toks = sample_tokens(
+            logits,
+            jax.random.key(2),
+            temperature=jnp.ones(64),
+            top_p=jnp.full(64, 0.9),
+        )
+        assert set(np.asarray(toks).tolist()) <= {2, 3}
+
+    def test_mixed_batch_greedy_and_sampled(self):
+        logits = jnp.asarray([[0.0, 4.0], [4.0, 0.0]])
+        toks = sample_tokens(
+            logits,
+            jax.random.key(3),
+            temperature=jnp.asarray([0.0, 1.0]),
+            top_p=jnp.ones(2),
+        )
+        assert int(toks[0]) == 1
+
+    def test_jittable(self):
+        f = jax.jit(lambda l, k, t, p: sample_tokens(l, k, t, p, top_k=4))
+        out = f(
+            jnp.zeros((2, 16)),
+            jax.random.key(0),
+            jnp.ones(2),
+            jnp.full(2, 0.9),
+        )
+        assert out.shape == (2,)
