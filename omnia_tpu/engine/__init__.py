@@ -1,0 +1,21 @@
+from omnia_tpu.engine.types import (
+    EngineConfig,
+    FinishReason,
+    Request,
+    RequestHandle,
+    SamplingParams,
+    StreamEvent,
+)
+from omnia_tpu.engine.engine import InferenceEngine
+from omnia_tpu.engine.mock import MockEngine
+
+__all__ = [
+    "EngineConfig",
+    "FinishReason",
+    "InferenceEngine",
+    "MockEngine",
+    "Request",
+    "RequestHandle",
+    "SamplingParams",
+    "StreamEvent",
+]

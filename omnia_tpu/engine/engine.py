@@ -1,0 +1,527 @@
+"""Continuous-batching inference engine.
+
+This is the component the reference platform does not have: it serves LLM
+turns from the attached accelerator instead of relaying HTTPS SSE streams
+(the reference's provider clients, SURVEY.md §0.2 / reference
+internal/runtime/provider.go). The runtime gRPC layer streams tokens from
+here (replacing reference internal/runtime/message.go:169 `conv.Stream`).
+
+Architecture (TPU-first):
+
+- **Slot batching.** The decode step is one compiled XLA program over a
+  fixed batch of `num_slots` sequences; requests claim/free slots as they
+  arrive/finish (continuous batching). Inactive slots still compute — a
+  static shape beats a recompile, and idle-slot FLOPs are reclaimed by
+  admission, not by shape changes.
+- **Prefill/decode disaggregation.** Prefill runs as its own self-contained
+  program per bucketed prompt length (no cache reads), producing a KV chunk
+  that a tiny donated-insert program places into the slot's rows. Decode
+  never sees prompt-length shapes, so its compiled step is stable.
+- **Everything stays on device.** Sampled tokens feed the next decode step
+  as device arrays; only the int32[num_slots] token vector crosses to host
+  per step for streaming/stop logic.
+- **Donation.** KV caches are donated through insert and decode steps, so
+  XLA updates them in place — no per-step HBM copy of the cache.
+- **Per-slot PRNG streams** make a request's sampling reproducible (seed)
+  regardless of which other requests share the batch.
+- **warmup()** AOT-compiles every (bucket) shape before the engine reports
+  ready — the serving analog of the reference's capability gate (its
+  operator scales a pod to zero until the runtime advertises capabilities;
+  here readiness additionally implies "no compile on the request path").
+
+Scheduling policy: prefill-first (favors TTFT over decode throughput;
+BASELINE.json north star is p50 TTFT < 400 ms), one prefill per step,
+then a decode step for all active slots.
+"""
+
+from __future__ import annotations
+
+import itertools
+import logging
+import threading
+import time
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from omnia_tpu.engine.types import (
+    EngineConfig,
+    FinishReason,
+    Request,
+    RequestHandle,
+    SamplingParams,
+    StreamEvent,
+)
+from omnia_tpu.models import ModelConfig
+from omnia_tpu.models import llama
+from omnia_tpu.ops.sampling import (
+    make_slot_key_data,
+    sample_tokens_per_slot,
+)
+from omnia_tpu.parallel import make_mesh, shard_pytree
+from omnia_tpu.parallel.sharding import named_sharding_tree
+
+logger = logging.getLogger(__name__)
+
+
+class _Slot:
+    __slots__ = (
+        "request",
+        "handle",
+        "length",
+        "generated",
+        "max_total",
+        "stop_ids",
+    )
+
+    def __init__(self):
+        self.request: Optional[Request] = None
+        self.handle: Optional[RequestHandle] = None
+        self.length = 0          # tokens currently in the slot's KV rows
+        self.generated = 0
+        self.max_total = 0       # generation cap (request max_tokens)
+        self.stop_ids: frozenset[int] = frozenset()
+
+    @property
+    def active(self) -> bool:
+        return self.request is not None
+
+    def clear(self):
+        self.request = None
+        self.handle = None
+        self.length = 0
+        self.generated = 0
+
+
+class InferenceEngine:
+    """Slot-based continuous-batching engine over one model."""
+
+    def __init__(
+        self,
+        model_cfg: ModelConfig,
+        engine_cfg: EngineConfig = EngineConfig(),
+        params=None,
+        seed: int = 0,
+        devices=None,
+    ):
+        self.model_cfg = model_cfg
+        self.cfg = engine_cfg
+        if engine_cfg.max_seq > model_cfg.max_seq_len:
+            raise ValueError("engine max_seq exceeds model max_seq_len")
+        if engine_cfg.num_slots % max(engine_cfg.dp, 1) != 0:
+            raise ValueError("num_slots must be divisible by dp")
+
+        self._dtype = jnp.bfloat16 if engine_cfg.dtype == "bfloat16" else jnp.float32
+        self._mesh = None
+        use_mesh = engine_cfg.dp * engine_cfg.tp > 1
+        if use_mesh:
+            self._mesh = make_mesh(engine_cfg.dp, engine_cfg.tp, devices=devices)
+
+        if params is None:
+            params = llama.init_params(model_cfg, jax.random.key(seed), dtype=self._dtype)
+        if self._mesh is not None:
+            params = shard_pytree(params, llama.param_specs(model_cfg), self._mesh)
+        self.params = params
+
+        self._seed = seed
+        self._init_device_state()
+
+        B = engine_cfg.num_slots
+        self._slots = [_Slot() for _ in range(B)]
+        self._waiting: list[tuple[Request, RequestHandle]] = []
+        self._lock = threading.Lock()
+        self._req_counter = itertools.count()
+
+        self._thread: Optional[threading.Thread] = None
+        self._stop_event = threading.Event()
+        self._healthy = True
+
+        # Metrics (engine-level; exported via utils.metrics by the runtime).
+        self.metrics = {
+            "requests_submitted": 0,
+            "requests_finished": 0,
+            "tokens_generated": 0,
+            "prefill_steps": 0,
+            "decode_steps": 0,
+        }
+
+        self._build_programs()
+
+    def _init_device_state(self):
+        """(Re)allocate KV caches and per-slot device state. Called at
+        construction and from crash recovery — after an exception inside a
+        donated-buffer step, self._ck/_cv may point at deleted arrays, so
+        the only way back to a healthy engine is a fresh allocation."""
+        B, S = self.cfg.num_slots, self.cfg.max_seq
+        ck, cv = llama.init_kv_cache(self.model_cfg, B, S, dtype=self._dtype)
+        if self._mesh is not None:
+            kspec, vspec = llama.kv_cache_specs()
+            tree = named_sharding_tree((kspec, vspec), self._mesh)
+            ck = jax.device_put(ck, tree[0])
+            cv = jax.device_put(cv, tree[1])
+        self._ck, self._cv = ck, cv
+
+        self._tokens = jnp.zeros((B,), jnp.int32)       # last sampled token
+        self._positions = jnp.zeros((B,), jnp.int32)    # next write row
+        self._temp = jnp.zeros((B,), jnp.float32)
+        self._top_p = jnp.ones((B,), jnp.float32)
+        self._top_k = jnp.zeros((B,), jnp.int32)
+        self._key_data = jnp.stack(
+            [make_slot_key_data(self._seed + 1 + i) for i in range(B)]
+        )
+
+    # ------------------------------------------------------------------
+    # Compiled programs
+    # ------------------------------------------------------------------
+
+    def _build_programs(self):
+        cfg = self.model_cfg
+
+        def prefill(params, tokens, positions):
+            return llama.forward_prefill(params, cfg, tokens, positions)
+
+        # One compiled prefill per bucket length (lazily compiled; warmup()
+        # forces all). Shapes: tokens [1, T].
+        self._prefill_fn = jax.jit(prefill)
+
+        def insert(ck, cv, k_chunk, v_chunk, slot, last_logits, key_data, temp, top_p, top_k):
+            # Place the prefill chunk into the slot's rows [slot, 0:T].
+            def put(c, chunk):
+                # c: [L,B,S,H,D]; chunk: [L,1,T,H,D]
+                return jax.lax.dynamic_update_slice(
+                    c, chunk.astype(c.dtype), (0, slot, 0, 0, 0)
+                )
+
+            ck = put(ck, k_chunk)
+            cv = put(cv, v_chunk)
+            tok, new_kd = sample_tokens_per_slot(
+                last_logits, key_data[None], temp[None], top_p[None], top_k[None]
+            )
+            return ck, cv, tok[0], new_kd[0]
+
+        self._insert_fn = jax.jit(insert, donate_argnums=(0, 1))
+
+        def decode(params, ck, cv, tokens, positions, key_data, temp, top_p, top_k):
+            logits, ck, cv = llama.forward(
+                params,
+                cfg,
+                tokens[:, None],
+                positions[:, None],
+                ck,
+                cv,
+                positions,
+            )
+            tok, new_kd = sample_tokens_per_slot(
+                logits[:, 0], key_data, temp, top_p, top_k
+            )
+            return ck, cv, tok, new_kd
+
+        self._decode_fn = jax.jit(decode, donate_argnums=(1, 2))
+
+    def warmup(self):
+        """AOT-compile decode + all usable prefill buckets (called before
+        ready — the request path must never hit a compile). Behavior-neutral:
+        all device state and metrics it touched are restored afterwards."""
+        t0 = time.monotonic()
+        metrics_before = dict(self.metrics)
+        self._run_decode_step()
+        for b in self.cfg.usable_buckets():
+            toks = jnp.zeros((1, b), jnp.int32)
+            pos = jnp.arange(b, dtype=jnp.int32)[None, :]
+            logits, k_chunk, v_chunk = self._prefill_fn(self.params, toks, pos)
+            self._ck, self._cv, _, self._key_data = self._run_insert(
+                k_chunk, v_chunk, 0, logits[:, -1]
+            )
+        # Restore everything warmup wrote (cache contents, PRNG streams,
+        # positions, metrics) so warmup cannot perturb request sampling.
+        self._init_device_state()
+        self.metrics.update(metrics_before)
+        logger.info("engine warmup done in %.1fs", time.monotonic() - t0)
+
+    # ------------------------------------------------------------------
+    # Submission API
+    # ------------------------------------------------------------------
+
+    def submit(
+        self, prompt_tokens: list[int], params: SamplingParams = SamplingParams()
+    ) -> RequestHandle:
+        rid = f"req-{next(self._req_counter)}"
+        handle = RequestHandle(rid)
+        request = Request(rid, list(prompt_tokens), params)
+        if not prompt_tokens:
+            handle._push(
+                StreamEvent(rid, finish_reason=FinishReason.ERROR, error="empty prompt")
+            )
+            return handle
+        if params.max_tokens < 1:
+            handle._push(
+                StreamEvent(
+                    rid,
+                    finish_reason=FinishReason.ERROR,
+                    error=f"max_tokens must be >= 1, got {params.max_tokens}",
+                )
+            )
+            return handle
+        try:
+            self.cfg.bucket_for(len(prompt_tokens))
+        except ValueError as e:
+            handle._push(
+                StreamEvent(rid, finish_reason=FinishReason.ERROR, error=str(e))
+            )
+            return handle
+        if len(prompt_tokens) >= self.cfg.max_seq:
+            handle._push(
+                StreamEvent(
+                    rid,
+                    finish_reason=FinishReason.ERROR,
+                    error=f"prompt of {len(prompt_tokens)} tokens >= max_seq {self.cfg.max_seq}",
+                )
+            )
+            return handle
+        with self._lock:
+            self._waiting.append((request, handle))
+            self.metrics["requests_submitted"] += 1
+        return handle
+
+    def queue_depth(self) -> int:
+        """Waiting requests — the autoscaling signal (north star replaces the
+        reference's active-connections KEDA trigger with queue depth)."""
+        with self._lock:
+            return len(self._waiting)
+
+    def active_slots(self) -> int:
+        return sum(1 for s in self._slots if s.active)
+
+    # ------------------------------------------------------------------
+    # Engine loop
+    # ------------------------------------------------------------------
+
+    def step(self) -> bool:
+        """One scheduling step. Returns True if any work was done."""
+        self._reap_cancelled()
+        did = False
+        free = [i for i, s in enumerate(self._slots) if not s.active]
+        with self._lock:
+            pending = self._waiting.pop(0) if (self._waiting and free) else None
+        if pending is not None:
+            self._do_prefill(free[0], *pending)
+            did = True
+        if any(s.active for s in self._slots):
+            self._do_decode()
+            did = True
+        return did
+
+    def _reap_cancelled(self):
+        for i, slot in enumerate(self._slots):
+            if slot.active and slot.handle.cancelled:
+                self._finish_slot(i, FinishReason.CANCELLED)
+        with self._lock:
+            still = []
+            for req, handle in self._waiting:
+                if handle.cancelled:
+                    handle._push(
+                        StreamEvent(req.request_id, finish_reason=FinishReason.CANCELLED)
+                    )
+                else:
+                    still.append((req, handle))
+            self._waiting = still
+
+    def _run_insert(self, k_chunk, v_chunk, slot_idx, last_logits):
+        slot = self._slots[slot_idx] if self._slots[slot_idx].active else None
+        sp = slot.request.params if slot else SamplingParams()
+        kd = (
+            jnp.asarray(make_slot_key_data(sp.seed))
+            if sp.seed is not None
+            else self._key_data[slot_idx]
+        )
+        ck, cv, tok, new_kd = self._insert_fn(
+            self._ck,
+            self._cv,
+            k_chunk,
+            v_chunk,
+            slot_idx,
+            last_logits,
+            kd,
+            jnp.float32(sp.temperature),
+            jnp.float32(sp.top_p),
+            jnp.int32(sp.top_k),
+        )
+        key_data = self._key_data.at[slot_idx].set(new_kd)
+        return ck, cv, tok, key_data
+
+    def _do_prefill(self, slot_idx: int, request: Request, handle: RequestHandle):
+        n = len(request.prompt_tokens)
+        bucket = self.cfg.bucket_for(n)
+        toks = np.zeros((1, bucket), np.int32)
+        toks[0, :n] = request.prompt_tokens
+        # Pad rows sit at positions n..bucket-1, i.e. strictly after every
+        # real query position, so the causal mask (key_idx <= q_pos) already
+        # excludes them — and decode overwrites each pad row before it first
+        # becomes attendable.
+        pos = np.arange(bucket, dtype=np.int32)[None, :]
+
+        logits, k_chunk, v_chunk = self._prefill_fn(
+            self.params, jnp.asarray(toks), jnp.asarray(pos)
+        )
+        slot = self._slots[slot_idx]
+        slot.request = request
+        slot.handle = handle
+        slot.length = n
+        slot.generated = 0
+        slot.max_total = request.params.max_tokens
+        slot.stop_ids = frozenset(request.params.stop_token_ids)
+
+        self._ck, self._cv, first_tok, self._key_data = self._run_insert(
+            k_chunk, v_chunk, slot_idx, logits[:, n - 1]
+        )
+        sp = request.params
+        self._tokens = self._tokens.at[slot_idx].set(first_tok)
+        self._positions = self._positions.at[slot_idx].set(n)
+        self._temp = self._temp.at[slot_idx].set(sp.temperature)
+        self._top_p = self._top_p.at[slot_idx].set(sp.top_p)
+        self._top_k = self._top_k.at[slot_idx].set(sp.top_k)
+        self.metrics["prefill_steps"] += 1
+
+        self._emit_token(slot_idx, int(first_tok))
+
+    def _run_decode_step(self):
+        self._ck, self._cv, self._tokens, self._key_data = self._decode_fn(
+            self.params,
+            self._ck,
+            self._cv,
+            self._tokens,
+            self._positions,
+            self._key_data,
+            self._temp,
+            self._top_p,
+            self._top_k,
+        )
+        self._positions = jnp.minimum(self._positions + 1, self.cfg.max_seq - 1)
+        self.metrics["decode_steps"] += 1
+
+    def _do_decode(self):
+        active = [i for i, s in enumerate(self._slots) if s.active]
+        self._run_decode_step()
+        host_tokens = np.asarray(self._tokens)
+        for i in active:
+            slot = self._slots[i]
+            slot.length += 1
+            self._emit_token(i, int(host_tokens[i]))
+
+    def _emit_token(self, slot_idx: int, token: int):
+        slot = self._slots[slot_idx]
+        if not slot.active:
+            return
+        rid = slot.request.request_id
+        if token in slot.stop_ids:
+            self._finish_slot(slot_idx, FinishReason.STOP)
+            return
+        slot.generated += 1
+        slot.handle._push(StreamEvent(rid, token_id=token))
+        self.metrics["tokens_generated"] += 1
+        # max_total caps generated tokens; the cache bound stops a step early
+        # so the next decode write can never clamp/corrupt (row max_seq-1 is
+        # the last legal write).
+        if slot.generated >= slot.max_total or slot.length >= self.cfg.max_seq - 2:
+            self._finish_slot(slot_idx, FinishReason.LENGTH)
+
+    def _finish_slot(self, slot_idx: int, reason: FinishReason):
+        slot = self._slots[slot_idx]
+        rid = slot.request.request_id
+        slot.handle._push(
+            StreamEvent(
+                rid,
+                finish_reason=reason,
+                num_prompt_tokens=len(slot.request.prompt_tokens),
+                num_generated_tokens=slot.generated,
+            )
+        )
+        self.metrics["requests_finished"] += 1
+        slot.clear()
+        # Quiesce the slot: decode keeps running over it with static shape;
+        # park its writes on its own row 0 (overwritten by the next prefill)
+        # and zero its sampling knobs.
+        self._positions = self._positions.at[slot_idx].set(0)
+        self._tokens = self._tokens.at[slot_idx].set(0)
+        self._temp = self._temp.at[slot_idx].set(0.0)
+
+    # ------------------------------------------------------------------
+    # Thread loop / sync helpers
+    # ------------------------------------------------------------------
+
+    def start(self):
+        if self._thread is not None:
+            return
+        self._stop_event.clear()
+        self._thread = threading.Thread(target=self._loop, name="omnia-engine", daemon=True)
+        self._thread.start()
+
+    def stop(self):
+        if self._thread is None:
+            return
+        self._stop_event.set()
+        self._thread.join(timeout=30)
+        self._thread = None
+
+    def _loop(self):
+        while not self._stop_event.is_set():
+            try:
+                if not self.step():
+                    time.sleep(0.001)
+            except Exception:  # pragma: no cover - engine must not die silently
+                logger.exception("engine step failed")
+                self._recover("engine step failed")
+                time.sleep(0.1)
+
+    def _recover(self, msg: str):
+        """Fail in-flight requests and rebuild device state. A raise after
+        cache donation leaves self._ck/_cv pointing at deleted arrays, so
+        without reallocation every subsequent step would also fail and the
+        engine would be permanently dead while looking alive."""
+        self._fail_all(msg)
+        try:
+            self._init_device_state()
+            self.metrics["recoveries"] = self.metrics.get("recoveries", 0) + 1
+        except Exception:
+            logger.exception("engine recovery failed; marking unhealthy")
+            self._healthy = False
+
+    def healthy(self) -> bool:
+        """False once recovery itself failed — the readiness signal
+        (platform analog of the reference runtime's Health capabilities)."""
+        return self._healthy
+
+    def _fail_all(self, msg: str):
+        for i, slot in enumerate(self._slots):
+            if slot.active:
+                slot.handle._push(
+                    StreamEvent(
+                        slot.request.request_id,
+                        finish_reason=FinishReason.ERROR,
+                        error=msg,
+                    )
+                )
+                slot.clear()
+
+    def generate(
+        self, prompt_tokens: list[int], params: SamplingParams = SamplingParams()
+    ) -> tuple[list[int], StreamEvent]:
+        """Synchronous helper: submit and drive steps inline (single-threaded
+        use in tests/bench; with the engine thread running, just blocks)."""
+        handle = self.submit(prompt_tokens, params)
+        if self._thread is None:
+            toks: list[int] = []
+            while True:
+                self.step()
+                while True:
+                    try:
+                        ev = handle._queue.get_nowait()
+                    except Exception:
+                        break
+                    if ev.token_id is not None:
+                        toks.append(ev.token_id)
+                    if ev.is_final:
+                        return toks, ev
+        return handle.collect_tokens(timeout=120)

@@ -1,0 +1,145 @@
+"""Mock engine: scripted scenario playback with the real Engine interface.
+
+The platform analog of the reference's mock LLM provider (reference
+internal/runtime/provider.go:50-91 wires a scenario-playback provider so
+every platform test runs with zero real LLM calls). Here the mock
+implements the same submit/step/handle surface as InferenceEngine so the
+runtime, facade, and e2e tests exercise the identical streaming path with
+no device.
+
+Scenarios map a matcher against the decoded prompt to a scripted reply;
+special directives simulate failures and tool calls.
+"""
+
+from __future__ import annotations
+
+import itertools
+import re
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Optional, Sequence
+
+from omnia_tpu.engine.tokenizer import ByteTokenizer
+from omnia_tpu.engine.types import (
+    FinishReason,
+    Request,
+    RequestHandle,
+    SamplingParams,
+    StreamEvent,
+)
+
+
+@dataclass
+class Scenario:
+    """One scripted behavior: if `pattern` matches the prompt, stream `reply`."""
+
+    pattern: str
+    reply: str = ""
+    error: Optional[str] = None          # stream an ERROR final instead
+    delay_per_token_s: float = 0.0       # simulated decode latency
+    ttft_s: float = 0.0                  # simulated prefill latency
+
+    def matches(self, prompt: str) -> bool:
+        return re.search(self.pattern, prompt, re.DOTALL) is not None
+
+
+DEFAULT_REPLY = "mock-reply"
+
+
+class MockEngine:
+    """Drop-in scripted engine (no device, no model)."""
+
+    def __init__(self, scenarios: Sequence[Scenario] = (), tokenizer=None):
+        self.scenarios = list(scenarios)
+        self.tokenizer = tokenizer or ByteTokenizer()
+        self._req_counter = itertools.count()
+        self._lock = threading.Lock()
+        self.metrics = {
+            "requests_submitted": 0,
+            "requests_finished": 0,
+            "tokens_generated": 0,
+        }
+
+    def warmup(self):
+        pass
+
+    def queue_depth(self) -> int:
+        return 0
+
+    def active_slots(self) -> int:
+        return 0
+
+    def submit(
+        self, prompt_tokens: list[int], params: SamplingParams = SamplingParams()
+    ) -> RequestHandle:
+        rid = f"mock-{next(self._req_counter)}"
+        handle = RequestHandle(rid)
+        with self._lock:
+            self.metrics["requests_submitted"] += 1
+        thread = threading.Thread(
+            target=self._play, args=(rid, list(prompt_tokens), params, handle), daemon=True
+        )
+        thread.start()
+        return handle
+
+    def generate(self, prompt_tokens, params=SamplingParams()):
+        return self.submit(prompt_tokens, params).collect_tokens(timeout=30)
+
+    def start(self):
+        pass
+
+    def stop(self):
+        pass
+
+    def _scenario_for(self, prompt: str) -> Scenario:
+        for s in self.scenarios:
+            if s.matches(prompt):
+                return s
+        return Scenario(pattern=".*", reply=DEFAULT_REPLY)
+
+    def _play(self, rid, prompt_tokens, params, handle: RequestHandle):
+        prompt = self.tokenizer.decode(prompt_tokens)
+        scenario = self._scenario_for(prompt)
+        if scenario.ttft_s:
+            time.sleep(scenario.ttft_s)
+        if scenario.error is not None:
+            handle._push(
+                StreamEvent(rid, finish_reason=FinishReason.ERROR, error=scenario.error)
+            )
+            return
+        reply_ids = self.tokenizer.encode(scenario.reply, add_bos=False)
+        reply_ids = reply_ids[: params.max_tokens]
+        generated = 0
+        for tok in reply_ids:
+            if handle.cancelled:
+                handle._push(
+                    StreamEvent(
+                        rid,
+                        finish_reason=FinishReason.CANCELLED,
+                        num_prompt_tokens=len(prompt_tokens),
+                        num_generated_tokens=generated,
+                    )
+                )
+                return
+            if scenario.delay_per_token_s:
+                time.sleep(scenario.delay_per_token_s)
+            handle._push(StreamEvent(rid, token_id=tok))
+            generated += 1
+            with self._lock:
+                self.metrics["tokens_generated"] += 1
+        reason = (
+            FinishReason.LENGTH
+            if len(reply_ids) >= params.max_tokens
+            else FinishReason.STOP
+        )
+        handle._push(
+            StreamEvent(
+                rid,
+                finish_reason=reason,
+                num_prompt_tokens=len(prompt_tokens),
+                num_generated_tokens=generated,
+            )
+        )
+        with self._lock:
+            self.metrics["requests_finished"] += 1

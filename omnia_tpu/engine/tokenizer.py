@@ -1,0 +1,82 @@
+"""Tokenizers for the serving engine.
+
+ByteTokenizer is the hermetic default (tests, bench, mock scenarios): UTF-8
+bytes + special tokens, zero external files — the analog of the reference's
+no-real-LLM-needed test stance (SURVEY.md §4). HFTokenizer wraps a local
+HuggingFace tokenizer directory when real model vocabularies are available
+(this environment has no network egress, so it is strictly opt-in).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Protocol, Sequence
+
+
+class Tokenizer(Protocol):
+    vocab_size: int
+    bos_id: int
+    eos_id: int
+
+    def encode(self, text: str) -> list[int]: ...
+    def decode(self, ids: Sequence[int]) -> str: ...
+
+
+class ByteTokenizer:
+    """UTF-8 byte tokenizer: ids 0..255 are bytes, then BOS/EOS/PAD."""
+
+    def __init__(self):
+        self.bos_id = 256
+        self.eos_id = 257
+        self.pad_id = 258
+        self.vocab_size = 259
+
+    def encode(self, text: str, add_bos: bool = True) -> list[int]:
+        ids = list(text.encode("utf-8"))
+        return [self.bos_id] + ids if add_bos else ids
+
+    def decode(self, ids: Sequence[int]) -> str:
+        data = bytes(i for i in ids if 0 <= i < 256)
+        return data.decode("utf-8", errors="replace")
+
+
+class HFTokenizer:
+    """Wrapper over a local transformers tokenizer directory."""
+
+    def __init__(self, path: str):
+        from transformers import AutoTokenizer  # local import: heavy
+
+        self._tok = AutoTokenizer.from_pretrained(path, local_files_only=True)
+        self.vocab_size = len(self._tok)
+        self.bos_id = self._tok.bos_token_id or 0
+        self.eos_id = self._tok.eos_token_id or 0
+
+    def encode(self, text: str, add_bos: bool = True) -> list[int]:
+        return self._tok.encode(text, add_special_tokens=add_bos)
+
+    def decode(self, ids: Sequence[int]) -> str:
+        return self._tok.decode(ids, skip_special_tokens=True)
+
+
+class IncrementalDetokenizer:
+    """Streams text from a token stream without re-decoding the full prefix.
+
+    Holds back bytes that may be a UTF-8 continuation so chunk boundaries
+    never emit replacement characters mid-rune.
+    """
+
+    def __init__(self, tokenizer: Tokenizer):
+        self._tok = tokenizer
+        self._pending: list[int] = []
+
+    def push(self, token_id: int) -> str:
+        self._pending.append(token_id)
+        text = self._tok.decode(self._pending)
+        if text and not text.endswith("�"):
+            self._pending.clear()
+            return text
+        return ""
+
+    def flush(self) -> str:
+        text = self._tok.decode(self._pending)
+        self._pending.clear()
+        return text

@@ -1,0 +1,138 @@
+"""Engine request/response types.
+
+The serving counterpart of the reference's provider-call surface: where the
+reference submits an HTTPS SSE request per turn and relays chunks (reference
+internal/runtime/message.go:148-238 via PromptKit), omnia_tpu submits a
+token-level Request to the in-process engine and streams StreamEvents off
+the device.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+import queue
+import threading
+import time
+from typing import Iterator, Optional, Sequence
+
+
+@dataclasses.dataclass(frozen=True)
+class SamplingParams:
+    temperature: float = 0.7
+    top_p: float = 1.0
+    top_k: int = 0
+    max_tokens: int = 256
+    stop_token_ids: tuple[int, ...] = ()
+    seed: Optional[int] = None
+
+
+class FinishReason(enum.Enum):
+    STOP = "stop"          # hit a stop/EOS token
+    LENGTH = "length"      # hit max_tokens or context limit
+    CANCELLED = "cancelled"
+    ERROR = "error"
+
+
+@dataclasses.dataclass
+class Request:
+    request_id: str
+    prompt_tokens: list[int]
+    params: SamplingParams
+    submitted_at: float = dataclasses.field(default_factory=time.monotonic)
+
+
+@dataclasses.dataclass(frozen=True)
+class StreamEvent:
+    """One engine output event: a generated token, or end-of-stream."""
+
+    request_id: str
+    token_id: Optional[int] = None
+    finish_reason: Optional[FinishReason] = None
+    # Filled on the final event.
+    num_prompt_tokens: int = 0
+    num_generated_tokens: int = 0
+    error: Optional[str] = None
+
+    @property
+    def is_final(self) -> bool:
+        return self.finish_reason is not None
+
+
+class RequestHandle:
+    """Consumer side of a submitted request: iterate StreamEvents."""
+
+    def __init__(self, request_id: str):
+        self.request_id = request_id
+        self._queue: "queue.Queue[StreamEvent]" = queue.Queue()
+        self._cancelled = threading.Event()
+        self.first_token_at: Optional[float] = None
+
+    # engine side -----------------------------------------------------------
+    def _push(self, event: StreamEvent) -> None:
+        if event.token_id is not None and self.first_token_at is None:
+            self.first_token_at = time.monotonic()
+        self._queue.put(event)
+
+    @property
+    def cancelled(self) -> bool:
+        return self._cancelled.is_set()
+
+    # consumer side ---------------------------------------------------------
+    def cancel(self) -> None:
+        self._cancelled.set()
+
+    def events(self, timeout: Optional[float] = None) -> Iterator[StreamEvent]:
+        """Blocking iterator over events until the final one."""
+        while True:
+            event = self._queue.get(timeout=timeout)
+            yield event
+            if event.is_final:
+                return
+
+    def get_event(self, timeout: Optional[float] = None) -> StreamEvent:
+        return self._queue.get(timeout=timeout)
+
+    def collect_tokens(self, timeout: Optional[float] = None) -> tuple[list[int], StreamEvent]:
+        """Drain the stream; returns (token_ids, final_event)."""
+        toks: list[int] = []
+        for ev in self.events(timeout=timeout):
+            if ev.token_id is not None:
+                toks.append(ev.token_id)
+            if ev.is_final:
+                return toks, ev
+        raise AssertionError("stream ended without final event")
+
+
+@dataclasses.dataclass(frozen=True)
+class EngineConfig:
+    """Serving-engine shape/placement configuration.
+
+    Static shapes are the XLA contract: num_slots fixes the decode batch,
+    prefill_buckets fixes the set of compiled prefill lengths, max_seq fixes
+    the KV cache. warmup() compiles all of them ahead of readiness (the
+    TTFT discipline SURVEY.md §7 calls out).
+    """
+
+    num_slots: int = 8
+    max_seq: int = 1024
+    prefill_buckets: tuple[int, ...] = (32, 64, 128, 256, 512, 1024)
+    dtype: str = "bfloat16"
+    # Mesh shape; dp divides num_slots, tp divides num_kv_heads.
+    dp: int = 1
+    tp: int = 1
+
+    def usable_buckets(self) -> tuple[int, ...]:
+        """Prefill buckets that fit the KV cache (a bucket's chunk is
+        written whole, so it must not exceed max_seq)."""
+        return tuple(b for b in self.prefill_buckets if b <= self.max_seq)
+
+    def bucket_for(self, n: int) -> int:
+        buckets = self.usable_buckets()
+        for b in buckets:
+            if n <= b:
+                return b
+        limit = buckets[-1] if buckets else 0
+        raise ValueError(
+            f"prompt of {n} tokens exceeds largest usable prefill bucket {limit}"
+        )

@@ -182,12 +182,16 @@ def _layer(x, p, cfg: ModelConfig, cos, sin, q_positions, ck, cv, write_start):
     k = apply_rope(k, cos, sin)
 
     if ck is None:
-        # Training / no-cache path: attend over this chunk's own keys.
+        # Self-contained path (training, or fresh prefill): attend over this
+        # chunk's own keys; the caller receives the k/v chunk to place into
+        # a cache slot if it wants one.
         ck_eff, cv_eff = k, v
+        out_pair = (k, v)
     else:
         ck = _write_kv(ck, k, write_start)
         cv = _write_kv(cv, v, write_start)
         ck_eff, cv_eff = ck, cv
+        out_pair = (ck, cv)
 
     attn = gqa_attention(q, ck_eff, cv_eff, q_positions)
     x = x + jnp.dot(attn.reshape(B, T, -1), p["attn"]["wo"])
@@ -197,7 +201,26 @@ def _layer(x, p, cfg: ModelConfig, cos, sin, q_positions, ck, cv, write_start):
         x = x + _moe_mlp(h2, p["mlp"], cfg)
     else:
         x = x + _dense_mlp(h2, p["mlp"])
-    return x, ck, cv
+    return x, out_pair[0], out_pair[1]
+
+
+def forward_prefill(params, cfg: ModelConfig, tokens, q_positions):
+    """Fresh-sequence prefill: self-contained attention over the chunk,
+    returning the per-layer KV chunk for the engine to place into a cache
+    slot (so prefill never reads or writes other slots' cache).
+
+    tokens, q_positions: int32 [B, T]
+    Returns (logits [B, T, V] f32, k_chunk, v_chunk [L, B, T, Hkv, D]).
+    """
+    x = params["embed"][tokens]
+    cos, sin = rope_cos_sin(q_positions, cfg.head_dim, cfg.rope_theta)
+
+    def body(x, p):
+        x, k, v = _layer(x, p, cfg, cos, sin, q_positions, None, None, None)
+        return x, (k, v)
+
+    x, (k_chunk, v_chunk) = jax.lax.scan(body, x, params["layers"])
+    return _logits(params, cfg, x), k_chunk, v_chunk
 
 
 # ---------------------------------------------------------------------------

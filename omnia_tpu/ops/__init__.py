@@ -1,6 +1,13 @@
 from omnia_tpu.ops.norms import rms_norm
 from omnia_tpu.ops.rope import rope_cos_sin, apply_rope
 from omnia_tpu.ops.attention import gqa_attention
-from omnia_tpu.ops.sampling import sample_tokens
+from omnia_tpu.ops.sampling import sample_tokens, sample_tokens_per_slot
 
-__all__ = ["rms_norm", "rope_cos_sin", "apply_rope", "gqa_attention", "sample_tokens"]
+__all__ = [
+    "rms_norm",
+    "rope_cos_sin",
+    "apply_rope",
+    "gqa_attention",
+    "sample_tokens",
+    "sample_tokens_per_slot",
+]

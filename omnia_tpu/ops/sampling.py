@@ -1,9 +1,11 @@
 """Vectorized token sampling: temperature / top-k / top-p / greedy.
 
-All paths are jit-compatible with per-slot (batched) dynamic temperature and
-top-p, so one compiled decode step serves heterogeneous requests in the same
-continuous batch — the whole point of slot-based serving. top_k is static
-(changes the top_k kernel shape); the engine buckets it.
+All knobs — temperature, top_p, AND top_k — are per-row *dynamic* values, so
+one compiled decode step serves heterogeneous requests in the same
+continuous batch (the point of slot-based serving: no per-request shape
+specialization). top_k is implemented as a threshold gathered from the
+descending sort that top_p already pays for, which keeps it dynamic without
+a second sort or a static lax.top_k shape.
 
 Greedy is expressed as temperature <= 0 and resolved with jnp.where, not
 Python branching, to keep the step traceable.
@@ -11,31 +13,58 @@ Python branching, to keep the step traceable.
 
 from __future__ import annotations
 
+from typing import Union
+
 import jax
 import jax.numpy as jnp
 
 _NEG_INF = -1e30
 
 
-def _apply_top_k(logits: jnp.ndarray, k: int) -> jnp.ndarray:
-    vals, _ = jax.lax.top_k(logits, k)
-    kth = vals[..., -1:]
-    return jnp.where(logits < kth, _NEG_INF, logits)
+def _filter_thresholds(scaled: jnp.ndarray, top_p: jnp.ndarray, top_k: jnp.ndarray):
+    """Per-row admission threshold combining top-k and top-p (nucleus).
 
+    Sequential-filter semantics (the HF/vLLM convention): top-k first, then
+    the nucleus is computed over the *renormalized top-k survivors* — so
+    top_p admits the smallest prefix of the top-k set whose renormalized
+    mass reaches top_p.
 
-def _apply_top_p(logits: jnp.ndarray, top_p: jnp.ndarray) -> jnp.ndarray:
-    """top_p: [B, 1] in (0, 1]. Keeps the smallest set of tokens whose
-    cumulative probability exceeds top_p."""
-    sorted_desc = jnp.sort(logits, axis=-1)[..., ::-1]
-    probs = jax.nn.softmax(sorted_desc, axis=-1)
-    cum = jnp.cumsum(probs, axis=-1)
-    # A sorted token is kept if the mass strictly before it is < top_p.
-    keep = (cum - probs) < top_p
-    # Smallest kept logit is the admission threshold in original order.
-    threshold = jnp.min(
-        jnp.where(keep, sorted_desc, jnp.inf), axis=-1, keepdims=True
+    scaled: [B, V] temperature-scaled logits; top_p: [B] (>= 1 disables);
+    top_k: [B] int32 (<= 0 disables). Returns [B, 1] threshold.
+    """
+    V = scaled.shape[-1]
+    sorted_desc = jnp.sort(scaled, axis=-1)[..., ::-1]
+
+    # top-k: the k-th largest scaled logit.
+    k = jnp.clip(top_k, 0, V)
+    kth = jnp.take_along_axis(
+        sorted_desc, jnp.clip(k - 1, 0, V - 1)[:, None], axis=-1
     )
-    return jnp.where(logits < threshold, _NEG_INF, logits)
+    k_thresh = jnp.where((k > 0)[:, None], kth, _NEG_INF)
+
+    # top-p over the top-k survivors: mask the sorted tail beyond k, then
+    # softmax renormalizes over what's left (sorted order makes the
+    # survivor set a prefix).
+    in_topk = jnp.arange(V)[None, :] < jnp.where(k > 0, k, V)[:, None]
+    survivors = jnp.where(in_topk, sorted_desc, _NEG_INF)
+    probs = jax.nn.softmax(survivors, axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    keep = in_topk & ((cum - probs) < top_p[:, None])  # mass strictly before < top_p
+    p_thresh = jnp.min(jnp.where(keep, sorted_desc, jnp.inf), axis=-1, keepdims=True)
+
+    return jnp.maximum(k_thresh, p_thresh)
+
+
+def _prepare(logits, temperature, top_p, top_k):
+    B, V = logits.shape
+    logits = logits.astype(jnp.float32)
+    if isinstance(top_k, int):
+        top_k = jnp.full((B,), top_k, dtype=jnp.int32)
+    greedy_tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    scaled = logits / jnp.maximum(temperature, 1e-6)[:, None]
+    thresh = _filter_thresholds(scaled, top_p, jnp.asarray(top_k, jnp.int32))
+    filtered = jnp.where(scaled < thresh, _NEG_INF, scaled)
+    return filtered, greedy_tok
 
 
 def sample_tokens(
@@ -43,23 +72,46 @@ def sample_tokens(
     key: jax.Array,
     temperature: jnp.ndarray,
     top_p: jnp.ndarray,
-    top_k: int = 0,
+    top_k: Union[int, jnp.ndarray] = 0,
 ) -> jnp.ndarray:
-    """Sample one token per row.
+    """Sample one token per row with a single PRNG key for the whole batch.
 
-    logits: [B, V] float; temperature: [B] (<=0 means greedy); top_p: [B]
-    (>=1 disables); top_k: static int (0 disables). Returns int32 [B].
+    logits: [B, V]; temperature: [B] (<= 0 → greedy); top_p: [B];
+    top_k: int or [B] int32. Returns int32 [B].
     """
-    logits = logits.astype(jnp.float32)
-    greedy_tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-
-    temp = jnp.maximum(temperature, 1e-6)[:, None]
-    scaled = logits / temp
-    if top_k > 0:
-        scaled = _apply_top_k(scaled, top_k)
-    scaled = _apply_top_p(scaled, top_p[:, None])
-
-    gumbel = jax.random.gumbel(key, scaled.shape, dtype=jnp.float32)
-    sampled_tok = jnp.argmax(scaled + gumbel, axis=-1).astype(jnp.int32)
-
+    filtered, greedy_tok = _prepare(logits, temperature, top_p, top_k)
+    gumbel = jax.random.gumbel(key, filtered.shape, dtype=jnp.float32)
+    sampled_tok = jnp.argmax(filtered + gumbel, axis=-1).astype(jnp.int32)
     return jnp.where(temperature <= 0.0, greedy_tok, sampled_tok)
+
+
+def sample_tokens_per_slot(
+    logits: jnp.ndarray,
+    key_data: jnp.ndarray,
+    temperature: jnp.ndarray,
+    top_p: jnp.ndarray,
+    top_k: Union[int, jnp.ndarray] = 0,
+):
+    """Per-slot PRNG streams: each continuous-batching slot owns a key so a
+    request's sample sequence is reproducible regardless of which other
+    requests share the batch.
+
+    key_data: uint32 [B, 2] raw key data (jax.random.key_data of threefry
+    keys). Returns (tokens int32 [B], new_key_data [B, 2]).
+    """
+    filtered, greedy_tok = _prepare(logits, temperature, top_p, top_k)
+
+    def one(row, kd):
+        k = jax.random.wrap_key_data(kd)
+        k, sub = jax.random.split(k)
+        g = jax.random.gumbel(sub, row.shape, dtype=jnp.float32)
+        return jnp.argmax(row + g).astype(jnp.int32), jax.random.key_data(k)
+
+    sampled_tok, new_key_data = jax.vmap(one)(filtered, key_data)
+    tok = jnp.where(temperature <= 0.0, greedy_tok, sampled_tok)
+    return tok, new_key_data
+
+
+def make_slot_key_data(seed: int) -> jnp.ndarray:
+    """uint32 [2] key data for one slot from an integer seed."""
+    return jax.random.key_data(jax.random.key(seed))

@@ -1,0 +1,244 @@
+"""Continuous-batching engine tests (tiny model, CPU)."""
+
+import numpy as np
+import pytest
+
+from omnia_tpu.engine import (
+    EngineConfig,
+    FinishReason,
+    InferenceEngine,
+    MockEngine,
+    SamplingParams,
+)
+from omnia_tpu.engine.mock import Scenario
+from omnia_tpu.engine.tokenizer import ByteTokenizer, IncrementalDetokenizer
+from omnia_tpu.models import get_config
+
+
+@pytest.fixture(scope="module")
+def engine():
+    cfg = get_config("test-tiny")
+    ecfg = EngineConfig(
+        num_slots=4, max_seq=64, prefill_buckets=(8, 16, 32), dtype="float32"
+    )
+    return InferenceEngine(cfg, ecfg, seed=0)
+
+
+def test_generate_greedy_deterministic(engine):
+    sp = SamplingParams(temperature=0.0, max_tokens=8)
+    toks1, fin1 = engine.generate([1, 2, 3, 4], sp)
+    toks2, fin2 = engine.generate([1, 2, 3, 4], sp)
+    assert toks1 == toks2
+    assert len(toks1) == 8
+    assert fin1.finish_reason == FinishReason.LENGTH
+    assert fin1.num_prompt_tokens == 4
+    assert fin1.num_generated_tokens == 8
+    assert all(0 <= t < engine.model_cfg.vocab_size for t in toks1)
+
+
+def test_seeded_sampling_reproducible(engine):
+    sp = SamplingParams(temperature=1.0, top_p=0.9, top_k=40, max_tokens=6, seed=1234)
+    toks1, _ = engine.generate([5, 6, 7], sp)
+    toks2, _ = engine.generate([5, 6, 7], sp)
+    assert toks1 == toks2
+    assert len(toks1) == 6
+
+
+def test_generation_independent_of_batch_mates(engine):
+    """A seeded request must produce identical tokens whether it runs alone
+    or concurrently with other requests — the continuous-batching isolation
+    invariant."""
+    sp = SamplingParams(temperature=0.0, max_tokens=6)
+    alone, _ = engine.generate([9, 8, 7], sp)
+
+    handles = [
+        engine.submit([9, 8, 7], sp),
+        engine.submit([1, 1, 2, 2, 3, 3], SamplingParams(temperature=0.7, max_tokens=10, seed=7)),
+        engine.submit([4, 4, 4], SamplingParams(temperature=0.0, max_tokens=4)),
+    ]
+    while engine.step():
+        pass
+    together, fin = handles[0].collect_tokens(timeout=5)
+    assert fin.finish_reason == FinishReason.LENGTH
+    assert together == alone
+
+
+def test_stop_token(engine):
+    sp0 = SamplingParams(temperature=0.0, max_tokens=5)
+    free_run, _ = engine.generate([3, 1, 4, 1, 5], sp0)
+    stop_tok = free_run[2]
+    sp = SamplingParams(temperature=0.0, max_tokens=5, stop_token_ids=(stop_tok,))
+    toks, fin = engine.generate([3, 1, 4, 1, 5], sp)
+    assert fin.finish_reason == FinishReason.STOP
+    assert toks == free_run[:2]
+    assert stop_tok not in toks
+
+
+def test_more_requests_than_slots(engine):
+    sp = SamplingParams(temperature=0.0, max_tokens=3)
+    handles = [engine.submit([i + 1, i + 2], sp) for i in range(9)]
+    while engine.step():
+        pass
+    for h in handles:
+        toks, fin = h.collect_tokens(timeout=5)
+        assert len(toks) == 3
+        assert fin.finish_reason == FinishReason.LENGTH
+
+
+def test_prompt_too_long_rejected(engine):
+    sp = SamplingParams(max_tokens=2)
+    handle = engine.submit(list(range(200)), sp)
+    ev = handle.get_event(timeout=5)
+    assert ev.finish_reason == FinishReason.ERROR
+    assert "exceeds" in ev.error
+
+
+def test_empty_prompt_rejected(engine):
+    ev = engine.submit([], SamplingParams()).get_event(timeout=5)
+    assert ev.finish_reason == FinishReason.ERROR
+
+
+def test_cancellation(engine):
+    sp = SamplingParams(temperature=0.0, max_tokens=50)
+    handle = engine.submit([2, 4, 6], sp)
+    engine.step()  # prefill + first token
+    handle.cancel()
+    while engine.step():
+        pass
+    events = []
+    while True:
+        ev = handle.get_event(timeout=5)
+        events.append(ev)
+        if ev.is_final:
+            break
+    assert events[-1].finish_reason == FinishReason.CANCELLED
+
+
+def test_queue_depth_signal(engine):
+    sp = SamplingParams(temperature=0.0, max_tokens=2)
+    handles = [engine.submit([1, 2], sp) for _ in range(6)]
+    assert engine.queue_depth() == 6
+    while engine.step():
+        pass
+    assert engine.queue_depth() == 0
+    for h in handles:
+        h.collect_tokens(timeout=5)
+
+
+def test_engine_thread_mode(engine):
+    engine.start()
+    try:
+        sp = SamplingParams(temperature=0.0, max_tokens=4)
+        toks, fin = engine.submit([1, 2, 3], sp).collect_tokens(timeout=60)
+        assert len(toks) == 4
+    finally:
+        engine.stop()
+
+
+def test_warmup_compiles_without_error(engine):
+    engine.warmup()
+    sp = SamplingParams(temperature=0.0, max_tokens=2)
+    toks, _ = engine.generate([1, 2], sp)
+    assert len(toks) == 2
+
+
+class TestMockEngine:
+    def test_scenario_playback(self):
+        tok = ByteTokenizer()
+        eng = MockEngine([Scenario(pattern="weather", reply="it is sunny")])
+        toks, fin = eng.generate(tok.encode("what is the weather?"), SamplingParams(max_tokens=64))
+        assert tok.decode(toks) == "it is sunny"
+        assert fin.finish_reason == FinishReason.STOP
+
+    def test_default_reply(self):
+        tok = ByteTokenizer()
+        eng = MockEngine()
+        toks, _ = eng.generate(tok.encode("anything"), SamplingParams(max_tokens=64))
+        assert tok.decode(toks) == "mock-reply"
+
+    def test_error_scenario(self):
+        tok = ByteTokenizer()
+        eng = MockEngine([Scenario(pattern="boom", error="simulated failure")])
+        handle = eng.submit(tok.encode("boom now"), SamplingParams())
+        ev = handle.get_event(timeout=5)
+        assert ev.finish_reason == FinishReason.ERROR
+        assert ev.error == "simulated failure"
+
+    def test_max_tokens_truncates(self):
+        tok = ByteTokenizer()
+        eng = MockEngine([Scenario(pattern=".", reply="0123456789")])
+        toks, fin = eng.generate(tok.encode("x"), SamplingParams(max_tokens=4))
+        assert tok.decode(toks) == "0123"
+        assert fin.finish_reason == FinishReason.LENGTH
+
+
+class TestTokenizer:
+    def test_byte_roundtrip(self):
+        tok = ByteTokenizer()
+        ids = tok.encode("héllo ⚡", add_bos=True)
+        assert ids[0] == tok.bos_id
+        assert tok.decode(ids) == "héllo ⚡"
+
+    def test_incremental_detokenizer_utf8_boundary(self):
+        tok = ByteTokenizer()
+        det = IncrementalDetokenizer(tok)
+        ids = tok.encode("a⚡b", add_bos=False)  # ⚡ is 3 bytes
+        out = "".join(det.push(i) for i in ids) + det.flush()
+        assert out == "a⚡b"
+        # no replacement chars were ever emitted mid-rune
+        assert "�" not in out
+
+
+def test_max_tokens_zero_rejected(engine):
+    ev = engine.submit([1, 2], SamplingParams(max_tokens=0)).get_event(timeout=5)
+    assert ev.finish_reason == FinishReason.ERROR
+    assert "max_tokens" in ev.error
+
+
+def test_bucket_larger_than_cache_rejected():
+    """A prompt whose bucket exceeds max_seq must be rejected at submit, not
+    crash the insert step for everyone (buckets > max_seq are unusable)."""
+    cfg = get_config("test-tiny")
+    eng = InferenceEngine(
+        cfg,
+        EngineConfig(num_slots=2, max_seq=20, prefill_buckets=(8, 16, 128), dtype="float32"),
+        seed=0,
+    )
+    ev = eng.submit(list(range(1, 18)), SamplingParams(max_tokens=1)).get_event(timeout=5)
+    assert ev.finish_reason == FinishReason.ERROR
+    assert "bucket" in ev.error
+    toks, fin = eng.generate([1, 2, 3], SamplingParams(temperature=0.0, max_tokens=2))
+    assert len(toks) == 2 and fin.finish_reason == FinishReason.LENGTH
+
+
+def test_recovery_reallocates_device_state(engine):
+    """After a step failure (donated caches deleted), _recover must rebuild
+    device state so the engine keeps serving."""
+    sp = SamplingParams(temperature=0.0, max_tokens=4)
+    before, _ = engine.generate([6, 5, 4], sp)
+    h = engine.submit([6, 5, 4], sp)
+    engine.step()  # slot active mid-request
+    engine._recover("injected failure")
+    ev = h.get_event(timeout=5)
+    # drain to the final event (first token may already be queued)
+    while not ev.is_final:
+        ev = h.get_event(timeout=5)
+    assert ev.finish_reason == FinishReason.ERROR
+    assert engine.healthy()
+    assert engine.metrics["recoveries"] >= 1
+    after, fin = engine.generate([6, 5, 4], sp)
+    assert fin.finish_reason == FinishReason.LENGTH
+    assert after == before  # greedy generation identical post-recovery
+
+
+def test_warmup_is_behavior_neutral():
+    """Unseeded sampled generation must not depend on whether warmup ran."""
+    cfg = get_config("test-tiny")
+    ecfg = EngineConfig(num_slots=2, max_seq=64, prefill_buckets=(8, 16), dtype="float32")
+    sp = SamplingParams(temperature=1.0, max_tokens=5)  # no seed: slot stream
+    e1 = InferenceEngine(cfg, ecfg, seed=3)
+    t1, _ = e1.generate([1, 2, 3], sp)
+    e2 = InferenceEngine(cfg, ecfg, seed=3)
+    e2.warmup()
+    t2, _ = e2.generate([1, 2, 3], sp)
+    assert t1 == t2

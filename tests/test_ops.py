@@ -152,3 +152,21 @@ class TestSampling:
             jnp.full(2, 0.9),
         )
         assert out.shape == (2,)
+
+
+def test_top_k_top_p_sequential_semantics():
+    """top_p nucleus must be computed over the RENORMALIZED top-k survivors
+    (HF/vLLM sequential filtering), not the full distribution."""
+    # probs: [0.3, 0.2, 0.05 x 10] -> top_k=2 survivors renormalize to
+    # [0.6, 0.4]; top_p=0.5 then admits only token 0.
+    probs = np.array([[0.3, 0.2] + [0.05] * 10], dtype=np.float32)
+    logits = jnp.asarray(np.log(probs))
+    logits64 = jnp.tile(logits, (64, 1))
+    toks = sample_tokens(
+        logits64,
+        jax.random.key(7),
+        temperature=jnp.ones(64),
+        top_p=jnp.full(64, 0.5),
+        top_k=jnp.full(64, 2, dtype=jnp.int32),
+    )
+    assert set(np.asarray(toks).tolist()) == {0}
