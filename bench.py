@@ -1,0 +1,128 @@
+"""Serving benchmark: p50 TTFT + decode tokens/sec/chip on the largest
+flagship-family model that fits the attached chip.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": <p50 TTFT ms>, "unit": "ms", "vs_baseline": ...}
+
+vs_baseline is measured against the north-star target (p50 TTFT < 400 ms,
+BASELINE.md — the reference publishes no numbers of its own), so > 1.0
+means faster than target. Aux metrics (decode throughput per chip, prefill
+rate) ride in "aux".
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import statistics
+import subprocess
+import sys
+import time
+
+TTFT_TARGET_MS = 400.0
+
+
+def _tpu_reachable(timeout_s: float = 180.0) -> bool:
+    """Probe accelerator init in a subprocess: the axon tunnel client can
+    block indefinitely inside backend creation (uninterruptible C call) if a
+    previous holder died without releasing its claim, so the probe must be a
+    killable child, not an in-process attempt."""
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", "import jax; jax.devices()"],
+            timeout=timeout_s,
+            capture_output=True,
+        )
+        return proc.returncode == 0
+    except subprocess.TimeoutExpired:
+        return False
+
+
+def main() -> None:
+    if os.environ.get("OMNIA_BENCH_PROBED") != "1" and not _tpu_reachable():
+        print(
+            "accelerator unreachable; falling back to CPU bench",
+            file=sys.stderr,
+        )
+        env = dict(os.environ)
+        env.pop("PALLAS_AXON_POOL_IPS", None)
+        env["JAX_PLATFORMS"] = "cpu"
+        env["OMNIA_BENCH_PROBED"] = "1"
+        os.execve(sys.executable, [sys.executable, os.path.abspath(__file__)], env)
+    import jax
+
+    platform = jax.devices()[0].platform
+    on_accel = platform not in ("cpu",)
+
+    from omnia_tpu.engine import EngineConfig, InferenceEngine, SamplingParams
+    from omnia_tpu.models import get_config
+
+    if on_accel:
+        model_name = "llama3-1b"
+        ecfg = EngineConfig(
+            num_slots=8,
+            max_seq=1024,
+            prefill_buckets=(64, 128, 256, 512),
+            dtype="bfloat16",
+        )
+        ttft_iters, decode_tokens = 20, 128
+    else:
+        model_name = "test-tiny"
+        ecfg = EngineConfig(
+            num_slots=4, max_seq=128, prefill_buckets=(64,), dtype="float32"
+        )
+        ttft_iters, decode_tokens = 5, 32
+
+    cfg = get_config(model_name)
+    engine = InferenceEngine(cfg, ecfg, seed=0)
+    t0 = time.monotonic()
+    engine.warmup()
+    warmup_s = time.monotonic() - t0
+    engine.start()
+
+    prompt = list(range(1, 49))  # 48-token prompt -> 64 bucket
+    sp_short = SamplingParams(temperature=0.0, max_tokens=4)
+
+    # --- TTFT: sequential single requests against a warm engine ---
+    ttfts = []
+    for _ in range(ttft_iters):
+        t_submit = time.monotonic()
+        handle = engine.submit(prompt, sp_short)
+        handle.collect_tokens(timeout=300)
+        ttfts.append((handle.first_token_at - t_submit) * 1000.0)
+    p50_ttft = statistics.median(ttfts)
+
+    # --- decode throughput: saturate all slots ---
+    sp_long = SamplingParams(temperature=0.7, top_p=0.9, max_tokens=decode_tokens, seed=1)
+    t_start = time.monotonic()
+    handles = [engine.submit(prompt, sp_long) for _ in range(ecfg.num_slots)]
+    total_tokens = 0
+    for h in handles:
+        toks, _ = h.collect_tokens(timeout=600)
+        total_tokens += len(toks)
+    wall = time.monotonic() - t_start
+    engine.stop()
+
+    n_chips = 1  # single-chip bench (multi-chip sharding validated via dryrun)
+    tok_s_chip = total_tokens / wall / n_chips
+
+    result = {
+        "metric": f"p50 TTFT, {model_name} {ecfg.dtype}, {platform} x{n_chips}, "
+        f"{ecfg.num_slots} slots continuous batching",
+        "value": round(p50_ttft, 2),
+        "unit": "ms",
+        "vs_baseline": round(TTFT_TARGET_MS / p50_ttft, 3),
+        "aux": {
+            "decode_tok_s_per_chip": round(tok_s_chip, 1),
+            "batch_tokens": total_tokens,
+            "batch_wall_s": round(wall, 2),
+            "warmup_s": round(warmup_s, 1),
+            "ttft_p90_ms": round(sorted(ttfts)[int(len(ttfts) * 0.9)], 2),
+            "platform": platform,
+        },
+    }
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -38,6 +38,7 @@ from __future__ import annotations
 
 import itertools
 import logging
+import queue
 import threading
 import time
 from typing import Optional
@@ -168,6 +169,7 @@ class InferenceEngine:
         self._temp = jnp.zeros((B,), jnp.float32)
         self._top_p = jnp.ones((B,), jnp.float32)
         self._top_k = jnp.zeros((B,), jnp.int32)
+        self._active = jnp.zeros((B,), jnp.bool_)
         self._key_data = jnp.stack(
             [make_slot_key_data(self._seed + 1 + i) for i in range(B)]
         )
@@ -306,7 +308,22 @@ class InferenceEngine:
         with self._lock:
             pending = self._waiting.pop(0) if (self._waiting and free) else None
         if pending is not None:
-            self._do_prefill(free[0], *pending)
+            try:
+                self._do_prefill(free[0], *pending)
+            except Exception:
+                # The request may not be attached to a slot yet, so recovery's
+                # _fail_all would never reach its handle — fail it here, then
+                # let the loop's recovery rebuild device state.
+                request, handle = pending
+                handle._push(
+                    StreamEvent(
+                        request.request_id,
+                        finish_reason=FinishReason.ERROR,
+                        error="prefill failed",
+                    )
+                )
+                self._slots[free[0]].clear()
+                raise
             did = True
         if any(s.active for s in self._slots):
             self._do_decode()
@@ -379,6 +396,7 @@ class InferenceEngine:
         sp = request.params
         self._tokens = self._tokens.at[slot_idx].set(first_tok)
         self._positions = self._positions.at[slot_idx].set(n)
+        self._active = self._active.at[slot_idx].set(True)
         self._temp = self._temp.at[slot_idx].set(sp.temperature)
         self._top_p = self._top_p.at[slot_idx].set(sp.top_p)
         self._top_k = self._top_k.at[slot_idx].set(sp.top_k)
@@ -398,7 +416,14 @@ class InferenceEngine:
             self._top_p,
             self._top_k,
         )
-        self._positions = jnp.minimum(self._positions + 1, self.cfg.max_seq - 1)
+        # Only active slots advance; a finished slot stays parked writing
+        # row 0 until the next prefill claims it (so idle slots can never
+        # scribble garbage into rows a future request won't overwrite).
+        self._positions = jnp.where(
+            self._active,
+            jnp.minimum(self._positions + 1, self.cfg.max_seq - 1),
+            self._positions,
+        )
         self.metrics["decode_steps"] += 1
 
     def _do_decode(self):
@@ -440,12 +465,13 @@ class InferenceEngine:
         )
         self.metrics["requests_finished"] += 1
         slot.clear()
-        # Quiesce the slot: decode keeps running over it with static shape;
-        # park its writes on its own row 0 (overwritten by the next prefill)
-        # and zero its sampling knobs.
+        # Quiesce the slot: decode keeps running over it (static shape), but
+        # with active=False its position is frozen at row 0, so it only ever
+        # rewrites row 0 — which the next prefill's insert overwrites.
         self._positions = self._positions.at[slot_idx].set(0)
         self._tokens = self._tokens.at[slot_idx].set(0)
         self._temp = self._temp.at[slot_idx].set(0.0)
+        self._active = self._active.at[slot_idx].set(False)
 
     # ------------------------------------------------------------------
     # Thread loop / sync helpers
@@ -463,6 +489,12 @@ class InferenceEngine:
             return
         self._stop_event.set()
         self._thread.join(timeout=30)
+        if self._thread.is_alive():
+            # A wedged device step: keep the handle so a retried start()
+            # cannot spawn a second loop over the same donated buffers.
+            logger.error("engine loop did not stop within 30s; still alive")
+            self._healthy = False
+            return
         self._thread = None
 
     def _loop(self):
@@ -515,13 +547,13 @@ class InferenceEngine:
             toks: list[int] = []
             while True:
                 self.step()
-                while True:
-                    try:
+                try:
+                    while True:
                         ev = handle._queue.get_nowait()
-                    except Exception:
-                        break
-                    if ev.token_id is not None:
-                        toks.append(ev.token_id)
-                    if ev.is_final:
-                        return toks, ev
+                        if ev.token_id is not None:
+                            toks.append(ev.token_id)
+                        if ev.is_final:
+                            return toks, ev
+                except queue.Empty:
+                    pass
         return handle.collect_tokens(timeout=120)

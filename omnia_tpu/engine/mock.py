@@ -77,6 +77,18 @@ class MockEngine:
         handle = RequestHandle(rid)
         with self._lock:
             self.metrics["requests_submitted"] += 1
+        # Mirror InferenceEngine.submit's validation so code tested against
+        # the mock sees the same rejection events as production.
+        error = None
+        if not prompt_tokens:
+            error = "empty prompt"
+        elif params.max_tokens < 1:
+            error = f"max_tokens must be >= 1, got {params.max_tokens}"
+        if error is not None:
+            handle._push(
+                StreamEvent(rid, finish_reason=FinishReason.ERROR, error=error)
+            )
+            return handle
         thread = threading.Thread(
             target=self._play, args=(rid, list(prompt_tokens), params, handle), daemon=True
         )

@@ -58,25 +58,37 @@ class HFTokenizer:
 
 
 class IncrementalDetokenizer:
-    """Streams text from a token stream without re-decoding the full prefix.
+    """Streams text deltas from a token stream.
 
-    Holds back bytes that may be a UTF-8 continuation so chunk boundaries
-    never emit replacement characters mid-rune.
+    Decodes the full id sequence and emits the delta against the previous
+    decode, so tokenizers whose per-token decode differs from in-context
+    decode (sentencepiece leading-space markers, merge rules) stream
+    exactly the text that decode(all_ids) would produce. A trailing
+    replacement character is held back — it may be a UTF-8 rune split
+    across token boundaries.
+
+    Decoding from the turn start keeps correctness simple; generations are
+    bounded by max_tokens, and a windowed delta decode is the optimization
+    once profiles say this matters.
     """
 
     def __init__(self, tokenizer: Tokenizer):
         self._tok = tokenizer
-        self._pending: list[int] = []
+        self._ids: list[int] = []
+        self._emitted = 0  # chars of the current decode already streamed
 
     def push(self, token_id: int) -> str:
-        self._pending.append(token_id)
-        text = self._tok.decode(self._pending)
-        if text and not text.endswith("�"):
-            self._pending.clear()
-            return text
-        return ""
+        self._ids.append(token_id)
+        text = self._tok.decode(self._ids)
+        if text.endswith("�"):
+            return ""
+        delta = text[self._emitted:]
+        self._emitted = len(text)
+        return delta
 
     def flush(self) -> str:
-        text = self._tok.decode(self._pending)
-        self._pending.clear()
-        return text
+        text = self._tok.decode(self._ids)
+        delta = text[self._emitted:]
+        self._ids.clear()
+        self._emitted = 0
+        return delta

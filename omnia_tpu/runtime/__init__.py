@@ -1,0 +1,3 @@
+from omnia_tpu.runtime.contract import CONTRACT_VERSION, Capability
+
+__all__ = ["CONTRACT_VERSION", "Capability"]

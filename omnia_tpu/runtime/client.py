@@ -1,0 +1,118 @@
+"""Runtime gRPC client: used by the facade and by tests.
+
+Counterpart of the reference facade's runtime client (reference
+internal/facade/runtime_client.go bridging WS ⇄ Converse).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Iterator, Optional
+
+import grpc
+
+from omnia_tpu.runtime import contract as c
+
+
+class RuntimeClient:
+    def __init__(self, target: str):
+        self.channel = grpc.insecure_channel(target)
+        self._converse = self.channel.stream_stream(
+            c.method_path("Converse"),
+            request_serializer=c.ClientMessage.to_bytes,
+            response_deserializer=c.ServerMessage.from_bytes,
+        )
+        self._invoke = self.channel.unary_unary(
+            c.method_path("Invoke"),
+            request_serializer=c.InvokeRequest.to_bytes,
+            response_deserializer=c.InvokeResponse.from_bytes,
+        )
+        self._health = self.channel.unary_unary(
+            c.method_path("Health"),
+            request_serializer=lambda x: x,
+            response_deserializer=c.HealthResponse.from_bytes,
+        )
+        self._has_conversation = self.channel.unary_unary(
+            c.method_path("HasConversation"),
+            request_serializer=c.HasConversationRequest.to_bytes,
+            response_deserializer=c.HasConversationResponse.from_bytes,
+        )
+
+    def close(self):
+        self.channel.close()
+
+    # ------------------------------------------------------------------
+
+    def health(self, timeout: float = 10.0) -> c.HealthResponse:
+        return self._health(b"{}", timeout=timeout)
+
+    def has_conversation(self, session_id: str, timeout: float = 10.0) -> c.ResumeState:
+        resp = self._has_conversation(
+            c.HasConversationRequest(session_id=session_id), timeout=timeout
+        )
+        return c.ResumeState(resp.state)
+
+    def invoke(
+        self, name: str, input, metadata: Optional[dict] = None, timeout: float = 120.0
+    ) -> c.InvokeResponse:
+        return self._invoke(
+            c.InvokeRequest(name=name, input=input, metadata=metadata or {}),
+            timeout=timeout,
+        )
+
+    def open_stream(
+        self,
+        session_id: str,
+        user_id: str = "",
+        agent: str = "",
+        timeout: float = 300.0,
+    ) -> "ConverseStream":
+        md = [(c.MD_SESSION_ID, session_id)]
+        if user_id:
+            md.append((c.MD_USER_ID, user_id))
+        if agent:
+            md.append((c.MD_AGENT, agent))
+        return ConverseStream(self._converse, md, timeout)
+
+
+class ConverseStream:
+    """One bidirectional Converse stream: send ClientMessages, iterate
+    ServerMessages."""
+
+    def __init__(self, stub, metadata, timeout: float):
+        self._outbox: "queue.Queue[Optional[c.ClientMessage]]" = queue.Queue()
+        self._responses = stub(
+            iter(self._outbox.get, None), metadata=metadata, timeout=timeout
+        )
+        self.hello: Optional[c.ServerMessage] = None
+
+    def send(self, msg: c.ClientMessage) -> None:
+        self._outbox.put(msg)
+
+    def send_text(self, content: str) -> None:
+        self.send(c.ClientMessage(type="message", content=content))
+
+    def send_tool_results(self, results: list[c.ToolResult]) -> None:
+        self.send(c.ClientMessage(type="tool_results", tool_results=results))
+
+    def close(self) -> None:
+        self._outbox.put(None)
+
+    def cancel(self) -> None:
+        self._responses.cancel()
+
+    def __iter__(self) -> Iterator[c.ServerMessage]:
+        for msg in self._responses:
+            if msg.type == "hello" and self.hello is None:
+                self.hello = msg
+                continue
+            yield msg
+
+    def turn(self, content: str) -> Iterator[c.ServerMessage]:
+        """Send one user message and yield until done/error of that turn."""
+        self.send_text(content)
+        for msg in self:
+            yield msg
+            if msg.type in ("done", "error"):
+                return

@@ -1,0 +1,177 @@
+"""Conversation context store: the runtime's working-memory tier.
+
+Same separation as the reference (SURVEY.md §5.4): the context store is the
+ONLY resumability authority (the session archive records but never decides
+resume). Backends are pluggable — in-memory with TTL for single-pod, and a
+file-backed store for multi-process dev topologies; the interface is
+deliberately tiny so a Redis backend drops in unchanged.
+
+The tri-state probe contract: `exists` returns ACTIVE / NOT_FOUND, and
+raises StoreUnavailable on backend outage — the runtime maps that to
+ResumeState.UNAVAILABLE so clients can distinguish "session expired" from
+"store down" (reference runtime.proto:363-384 semantics).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import threading
+import time
+from typing import Optional, Protocol
+
+
+class StoreUnavailable(RuntimeError):
+    pass
+
+
+@dataclasses.dataclass
+class Turn:
+    role: str       # user | assistant | tool
+    content: str
+    tool_call_id: str = ""
+
+
+@dataclasses.dataclass
+class ConversationState:
+    session_id: str
+    turns: list[Turn] = dataclasses.field(default_factory=list)
+    created_at: float = dataclasses.field(default_factory=time.time)
+    updated_at: float = dataclasses.field(default_factory=time.time)
+
+    def to_json(self) -> str:
+        return json.dumps(
+            {
+                "session_id": self.session_id,
+                "turns": [dataclasses.asdict(t) for t in self.turns],
+                "created_at": self.created_at,
+                "updated_at": self.updated_at,
+            }
+        )
+
+    @classmethod
+    def from_json(cls, raw: str) -> "ConversationState":
+        d = json.loads(raw)
+        return cls(
+            session_id=d["session_id"],
+            turns=[Turn(**t) for t in d["turns"]],
+            created_at=d["created_at"],
+            updated_at=d["updated_at"],
+        )
+
+
+class ContextStore(Protocol):
+    def get(self, session_id: str) -> Optional[ConversationState]: ...
+    def put(self, state: ConversationState) -> None: ...
+    def delete(self, session_id: str) -> None: ...
+    def exists(self, session_id: str) -> bool: ...
+
+
+class InMemoryContextStore:
+    """Dict store with TTL eviction (single-pod default)."""
+
+    def __init__(self, ttl_s: float = 3600.0):
+        self.ttl_s = ttl_s
+        self._data: dict[str, tuple[float, str]] = {}
+        self._lock = threading.Lock()
+
+    def _evict(self):
+        now = time.time()
+        dead = [k for k, (ts, _) in self._data.items() if now - ts > self.ttl_s]
+        for k in dead:
+            del self._data[k]
+
+    def get(self, session_id: str) -> Optional[ConversationState]:
+        with self._lock:
+            self._evict()
+            hit = self._data.get(session_id)
+            return ConversationState.from_json(hit[1]) if hit else None
+
+    def put(self, state: ConversationState) -> None:
+        state.updated_at = time.time()
+        with self._lock:
+            self._data[state.session_id] = (time.time(), state.to_json())
+
+    def delete(self, session_id: str) -> None:
+        with self._lock:
+            self._data.pop(session_id, None)
+
+    def exists(self, session_id: str) -> bool:
+        with self._lock:
+            self._evict()
+            return session_id in self._data
+
+
+class FileContextStore:
+    """File-per-session store for clusterless multi-process topologies (the
+    reference's devroot pattern: any binary against a YAML/file root)."""
+
+    def __init__(self, root: str, ttl_s: float = 3600.0):
+        self.root = root
+        self.ttl_s = ttl_s
+        os.makedirs(root, exist_ok=True)
+
+    def _path(self, session_id: str) -> str:
+        safe = session_id.replace("/", "_")
+        return os.path.join(self.root, f"{safe}.json")
+
+    def get(self, session_id: str) -> Optional[ConversationState]:
+        path = self._path(session_id)
+        try:
+            if not os.path.exists(path):
+                return None
+            if time.time() - os.path.getmtime(path) > self.ttl_s:
+                os.unlink(path)
+                return None
+            with open(path) as f:
+                return ConversationState.from_json(f.read())
+        except OSError as e:
+            raise StoreUnavailable(str(e)) from e
+
+    def put(self, state: ConversationState) -> None:
+        state.updated_at = time.time()
+        path = self._path(state.session_id)
+        try:
+            tmp = path + ".tmp"
+            with open(tmp, "w") as f:
+                f.write(state.to_json())
+            os.replace(tmp, path)
+        except OSError as e:
+            raise StoreUnavailable(str(e)) from e
+
+    def delete(self, session_id: str) -> None:
+        try:
+            os.unlink(self._path(session_id))
+        except FileNotFoundError:
+            pass
+        except OSError as e:
+            raise StoreUnavailable(str(e)) from e
+
+    def exists(self, session_id: str) -> bool:
+        try:
+            path = self._path(session_id)
+            if not os.path.exists(path):
+                return False
+            if time.time() - os.path.getmtime(path) > self.ttl_s:
+                return False
+            return True
+        except OSError as e:
+            raise StoreUnavailable(str(e)) from e
+
+
+class BrokenContextStore:
+    """Test double: every operation raises StoreUnavailable (outage drills —
+    the tri-state resume probe must report UNAVAILABLE, not NOT_FOUND)."""
+
+    def get(self, session_id):
+        raise StoreUnavailable("injected outage")
+
+    def put(self, state):
+        raise StoreUnavailable("injected outage")
+
+    def delete(self, session_id):
+        raise StoreUnavailable("injected outage")
+
+    def exists(self, session_id):
+        raise StoreUnavailable("injected outage")

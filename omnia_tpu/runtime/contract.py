@@ -1,0 +1,210 @@
+"""Runtime wire contract: the facade⇄runtime seam.
+
+Semantics mirror the reference's `omnia.runtime.v1` gRPC contract
+(reference api/proto/runtime/v1/runtime.proto: bidirectional Converse :38,
+one-shot Invoke :49, Health with capabilities :52/:350-354, tri-state
+HasConversation :62/:370-384; identity as x-omnia-* metadata :30-33) — but
+the encoding is fresh: length-delimited JSON messages over gRPC bytes
+(no protoc codegen dependency), versioned and capability-gated the same
+way. The runtime side streams tokens straight from the in-process TPU
+engine instead of an external SDK pipeline.
+
+Anything a runtime cannot do yet is declared by OMITTING the capability —
+the operator's capability gate (operator plane) scales agents to zero until
+a running runtime advertises what their spec requires, exactly the
+reference's honesty mechanism.
+"""
+
+from __future__ import annotations
+
+import enum
+import json
+from dataclasses import asdict, dataclass, field
+from typing import Any, Optional
+
+CONTRACT_VERSION = "1.0.0"
+
+# gRPC metadata keys carrying identity (never message fields).
+MD_SESSION_ID = "x-omnia-session-id"
+MD_USER_ID = "x-omnia-user-id"
+MD_AGENT = "x-omnia-agent"
+MD_TURN_ID = "x-omnia-turn-id"
+
+
+class Capability(str, enum.Enum):
+    TEXT = "text"                  # plain text turns
+    STREAMING = "streaming"        # token streaming
+    TOOLS = "tools"                # server-side tool execution
+    CLIENT_TOOLS = "client_tools"  # tool round-trips through the facade
+    FUNCTIONS = "functions"        # one-shot Invoke (function mode)
+    RESUME = "resume"              # HasConversation + context-store resume
+    MEMORY = "memory"              # memory retrieval/injection
+    RESPONSE_FORMAT = "response_format"  # json / json_schema constrained output
+    DUPLEX_AUDIO = "duplex_audio"  # bidirectional voice (not yet served)
+
+
+class ResumeState(str, enum.Enum):
+    """Tri-state resume probe result: distinguishes 'expired' from 'store
+    outage' so the facade can tell clients the truth."""
+
+    ACTIVE = "active"
+    NOT_FOUND = "not_found"
+    UNAVAILABLE = "unavailable"
+
+
+# ---------------------------------------------------------------------------
+# Messages (JSON-encoded on the wire)
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class ToolResult:
+    tool_call_id: str
+    content: str
+    is_error: bool = False
+
+
+@dataclass
+class ClientMessage:
+    """Client→runtime turn input."""
+
+    type: str = "message"          # message | tool_results | cancel
+    content: str = ""
+    tool_results: list[ToolResult] = field(default_factory=list)
+    response_format: Optional[dict] = None   # {"type": "json"|"json_schema", "schema": {...}}
+    metadata: dict = field(default_factory=dict)
+
+    def to_bytes(self) -> bytes:
+        return json.dumps(asdict(self)).encode()
+
+    @classmethod
+    def from_bytes(cls, raw: bytes) -> "ClientMessage":
+        d = json.loads(raw)
+        d["tool_results"] = [ToolResult(**t) for t in d.get("tool_results", [])]
+        return cls(**d)
+
+
+@dataclass
+class Usage:
+    prompt_tokens: int = 0
+    completion_tokens: int = 0
+    cost_usd: float = 0.0
+
+
+@dataclass
+class ToolCall:
+    tool_call_id: str
+    name: str
+    arguments: dict
+    client_side: bool = False
+
+
+@dataclass
+class ServerMessage:
+    """Runtime→client stream element (oneof via `type`)."""
+
+    type: str                       # hello | chunk | tool_call | done | error
+    text: str = ""                  # chunk
+    tool_call: Optional[ToolCall] = None
+    usage: Optional[Usage] = None   # done
+    finish_reason: str = ""         # done
+    error_code: str = ""            # error
+    error_message: str = ""         # error
+    contract_version: str = ""      # hello
+    capabilities: list[str] = field(default_factory=list)  # hello
+
+    def to_bytes(self) -> bytes:
+        d = asdict(self)
+        return json.dumps({k: v for k, v in d.items() if v not in (None, "", [], {})} | {"type": self.type}).encode()
+
+    @classmethod
+    def from_bytes(cls, raw: bytes) -> "ServerMessage":
+        d = json.loads(raw)
+        if d.get("tool_call"):
+            d["tool_call"] = ToolCall(**d["tool_call"])
+        if d.get("usage"):
+            d["usage"] = Usage(**d["usage"])
+        return cls(**d)
+
+
+@dataclass
+class InvokeRequest:
+    """Function-mode one-shot invocation."""
+
+    name: str
+    input: Any
+    metadata: dict = field(default_factory=dict)
+
+    def to_bytes(self) -> bytes:
+        return json.dumps(asdict(self)).encode()
+
+    @classmethod
+    def from_bytes(cls, raw: bytes) -> "InvokeRequest":
+        return cls(**json.loads(raw))
+
+
+@dataclass
+class InvokeResponse:
+    output: Any = None
+    usage: Optional[Usage] = None
+    error_code: str = ""
+    error_message: str = ""
+
+    def to_bytes(self) -> bytes:
+        d = asdict(self)
+        return json.dumps(d).encode()
+
+    @classmethod
+    def from_bytes(cls, raw: bytes) -> "InvokeResponse":
+        d = json.loads(raw)
+        if d.get("usage"):
+            d["usage"] = Usage(**d["usage"])
+        return cls(**d)
+
+
+@dataclass
+class HealthResponse:
+    status: str = "ok"
+    contract_version: str = CONTRACT_VERSION
+    capabilities: list[str] = field(default_factory=list)
+    model: str = ""
+    queue_depth: int = 0
+    active_slots: int = 0
+
+    def to_bytes(self) -> bytes:
+        return json.dumps(asdict(self)).encode()
+
+    @classmethod
+    def from_bytes(cls, raw: bytes) -> "HealthResponse":
+        return cls(**json.loads(raw))
+
+
+@dataclass
+class HasConversationRequest:
+    session_id: str
+
+    def to_bytes(self) -> bytes:
+        return json.dumps(asdict(self)).encode()
+
+    @classmethod
+    def from_bytes(cls, raw: bytes) -> "HasConversationRequest":
+        return cls(**json.loads(raw))
+
+
+@dataclass
+class HasConversationResponse:
+    state: str = ResumeState.NOT_FOUND.value
+
+    def to_bytes(self) -> bytes:
+        return json.dumps(asdict(self)).encode()
+
+    @classmethod
+    def from_bytes(cls, raw: bytes) -> "HasConversationResponse":
+        return cls(**json.loads(raw))
+
+
+SERVICE_NAME = "omnia.runtime.v1.RuntimeService"
+
+
+def method_path(method: str) -> str:
+    return f"/{SERVICE_NAME}/{method}"

@@ -1,0 +1,369 @@
+"""Conversation orchestration: the turn hot path.
+
+The in-tree replacement for the reference's external conversation pipeline
+(reference internal/runtime/message.go:40 processMessage → conversation.go
+buildConversationOptions → PromptKit conv.Stream → consumeStream; SURVEY.md
+§3.2). Here the provider hop is a submit to the in-process TPU engine and
+chunks come straight off the device stream.
+
+Turn flow:
+  user message → history from context store → prompt render → engine
+  submit → stream chunks (tool-call markers parsed inline) → server tools
+  dispatched via ToolExecutor / client tools suspended to the caller →
+  results appended → re-submit → ... → done with Usage (tokens + cost).
+
+Tool-call convention: the model emits `<tool_call>{json}</tool_call>`;
+the parser holds back any potential marker prefix so marker fragments are
+never streamed as text.
+"""
+
+from __future__ import annotations
+
+import json
+import queue
+import threading
+import time
+import uuid
+from typing import Callable, Iterator, Optional
+
+import jsonschema
+
+from omnia_tpu.engine.tokenizer import IncrementalDetokenizer
+from omnia_tpu.engine.types import FinishReason, SamplingParams
+from omnia_tpu.runtime.context_store import (
+    ContextStore,
+    ConversationState,
+    StoreUnavailable,
+    Turn,
+)
+from omnia_tpu.runtime.contract import (
+    ClientMessage,
+    ServerMessage,
+    ToolCall,
+    ToolResult,
+    Usage,
+)
+from omnia_tpu.runtime.packs import PromptPack
+from omnia_tpu.runtime.providers import ProviderSpec
+from omnia_tpu.tools import ToolExecutor
+
+TOOL_OPEN = "<tool_call>"
+TOOL_CLOSE = "</tool_call>"
+MAX_TOOL_ROUNDS = 4
+TURN_TIMEOUT_S = 120.0          # reference tool-loop envelope
+CLIENT_TOOL_TIMEOUT_S = 60.0    # reference client-tool wait
+
+
+class ToolCallStreamParser:
+    """Splits a streamed text into text segments and tool calls, holding
+    back any suffix that could be a partial marker."""
+
+    def __init__(self):
+        self._buf = ""
+        self._in_tool = False
+
+    def feed(self, text: str) -> list[tuple[str, str]]:
+        """Returns [("text", s) | ("tool", payload_json)] events."""
+        self._buf += text
+        out: list[tuple[str, str]] = []
+        while True:
+            if self._in_tool:
+                end = self._buf.find(TOOL_CLOSE)
+                if end < 0:
+                    return out
+                out.append(("tool", self._buf[:end]))
+                self._buf = self._buf[end + len(TOOL_CLOSE):]
+                self._in_tool = False
+                continue
+            start = self._buf.find(TOOL_OPEN)
+            if start >= 0:
+                if start:
+                    out.append(("text", self._buf[:start]))
+                self._buf = self._buf[start + len(TOOL_OPEN):]
+                self._in_tool = True
+                continue
+            # Emit all text except a suffix that could begin TOOL_OPEN.
+            keep = 0
+            for k in range(min(len(TOOL_OPEN) - 1, len(self._buf)), 0, -1):
+                if TOOL_OPEN.startswith(self._buf[-k:]):
+                    keep = k
+                    break
+            emit = self._buf[: len(self._buf) - keep]
+            if emit:
+                out.append(("text", emit))
+            self._buf = self._buf[len(self._buf) - keep:]
+            return out
+
+    def flush(self) -> str:
+        """Remaining held-back text (end of stream)."""
+        rest = self._buf
+        self._buf = ""
+        self._in_tool = False
+        return rest
+
+
+def render_prompt(pack: PromptPack, state: ConversationState, params: Optional[dict] = None) -> str:
+    """Chat-format the conversation for the model. Tool declarations ride in
+    the system block so the model knows the call convention."""
+    parts = [f"[SYS]{pack.render_system(params)}"]
+    if pack.tools:
+        tool_desc = json.dumps(
+            [
+                {"name": t["name"], "description": t.get("description", "")}
+                for t in pack.tools
+            ]
+        )
+        parts.append(f"\n[TOOLS]{tool_desc}[/TOOLS]")
+    parts.append("[/SYS]\n")
+    for turn in state.turns:
+        if turn.role == "user":
+            parts.append(f"[USER]{turn.content}[/USER]\n")
+        elif turn.role == "assistant":
+            parts.append(f"[ASSIST]{turn.content}[/ASSIST]\n")
+        elif turn.role == "tool":
+            parts.append(f"[TOOL]{turn.content}[/TOOL]\n")
+    parts.append("[ASSIST]")
+    return "".join(parts)
+
+
+class Conversation:
+    """One session's turn processor (thread-safe for one turn at a time)."""
+
+    def __init__(
+        self,
+        session_id: str,
+        pack: PromptPack,
+        engine,
+        tokenizer,
+        store: ContextStore,
+        provider_spec: Optional[ProviderSpec] = None,
+        tool_executor: Optional[ToolExecutor] = None,
+        pack_params: Optional[dict] = None,
+        on_event: Optional[Callable[[str, dict], None]] = None,
+    ):
+        self.session_id = session_id
+        self.pack = pack
+        self.engine = engine
+        self.tokenizer = tokenizer
+        self.store = store
+        self.provider_spec = provider_spec
+        self.tools = tool_executor or ToolExecutor()
+        self.pack_params = pack_params or {}
+        self.on_event = on_event or (lambda kind, data: None)
+        self._client_results: "queue.Queue[list[ToolResult]]" = queue.Queue()
+        self._turn_lock = threading.Lock()
+
+    # ------------------------------------------------------------------
+
+    def provide_tool_results(self, results: list[ToolResult]) -> None:
+        self._client_results.put(results)
+
+    def _sampling(self, msg: ClientMessage) -> SamplingParams:
+        s = dict(self.pack.sampling)
+        return SamplingParams(
+            temperature=float(s.get("temperature", 0.7)),
+            top_p=float(s.get("top_p", 1.0)),
+            top_k=int(s.get("top_k", 0)),
+            max_tokens=int(s.get("max_tokens", 256)),
+            stop_token_ids=(self.tokenizer.eos_id,),
+        )
+
+    def _load_state(self) -> ConversationState:
+        state = self.store.get(self.session_id)
+        return state or ConversationState(session_id=self.session_id)
+
+    # ------------------------------------------------------------------
+
+    def stream(self, msg: ClientMessage) -> Iterator[ServerMessage]:
+        """Process one turn; yields chunk/tool_call/done/error messages."""
+        with self._turn_lock:
+            yield from self._stream_locked(msg)
+
+    def _stream_locked(self, msg: ClientMessage) -> Iterator[ServerMessage]:
+        deadline = time.monotonic() + TURN_TIMEOUT_S
+        try:
+            state = self._load_state()
+        except StoreUnavailable as e:
+            yield ServerMessage(type="error", error_code="store_unavailable", error_message=str(e))
+            return
+
+        state.turns.append(Turn(role="user", content=msg.content))
+        self.on_event("user_message", {"content": msg.content})
+        usage = Usage()
+        sp = self._sampling(msg)
+
+        for _ in range(MAX_TOOL_ROUNDS + 1):
+            prompt = render_prompt(self.pack, state, self.pack_params)
+            prompt_ids = self.tokenizer.encode(prompt)
+            usage.prompt_tokens += len(prompt_ids)
+
+            handle = self.engine.submit(prompt_ids, sp)
+            parser = ToolCallStreamParser()
+            detok = IncrementalDetokenizer(self.tokenizer)
+            assistant_text = ""
+            tool_payload: Optional[str] = None
+            error: Optional[StreamError] = None
+
+            for ev in handle.events(timeout=max(1.0, deadline - time.monotonic())):
+                if ev.token_id is not None:
+                    usage.completion_tokens += 1
+                    piece = detok.push(ev.token_id)
+                    if piece:
+                        for kind, payload in parser.feed(piece):
+                            if kind == "text":
+                                assistant_text += payload
+                                yield ServerMessage(type="chunk", text=payload)
+                            else:
+                                tool_payload = payload
+                    if tool_payload is not None:
+                        handle.cancel()
+                if ev.is_final:
+                    if ev.finish_reason == FinishReason.ERROR:
+                        error = StreamError("engine_error", ev.error or "engine error")
+                    break
+                if time.monotonic() > deadline:
+                    handle.cancel()
+                    error = StreamError("timeout", "turn exceeded execution timeout")
+                    break
+
+            if error is not None:
+                yield ServerMessage(type="error", error_code=error.code, error_message=error.message)
+                return
+
+            tail = detok.flush()
+            if tail:
+                for kind, payload in parser.feed(tail):
+                    if kind == "text":
+                        assistant_text += payload
+                        yield ServerMessage(type="chunk", text=payload)
+                    elif tool_payload is None:
+                        tool_payload = payload
+            tail2 = parser.flush()
+            if tail2:
+                assistant_text += tail2
+                yield ServerMessage(type="chunk", text=tail2)
+
+            if tool_payload is None:
+                # Terminal round: validate response format, persist, done.
+                if msg.response_format:
+                    err = self._check_response_format(assistant_text, msg.response_format)
+                    if err:
+                        yield ServerMessage(
+                            type="error", error_code="bad_response_format", error_message=err
+                        )
+                        return
+                state.turns.append(Turn(role="assistant", content=assistant_text))
+                try:
+                    self.store.put(state)
+                except StoreUnavailable:
+                    pass  # archive-grade durability is session-api's job
+                usage.cost_usd = self._cost(usage)
+                self.on_event(
+                    "assistant_message",
+                    {"content": assistant_text, "usage": usage.__dict__},
+                )
+                yield ServerMessage(type="done", usage=usage, finish_reason="stop")
+                return
+
+            # --- tool round ---
+            outcome_turns, reply, err_msg = self._handle_tool_call(
+                tool_payload, assistant_text, deadline
+            )
+            if err_msg is not None:
+                yield ServerMessage(type="error", error_code="tool_error", error_message=err_msg)
+                return
+            if reply is not None:
+                yield reply  # client-side tool_call announcement
+                results = self._await_client_results(deadline)
+                if results is None:
+                    yield ServerMessage(
+                        type="error",
+                        error_code="client_tool_timeout",
+                        error_message="no tool results before timeout",
+                    )
+                    return
+                for r in results:
+                    outcome_turns.append(
+                        Turn(role="tool", content=r.content, tool_call_id=r.tool_call_id)
+                    )
+            state.turns.extend(outcome_turns)
+
+        yield ServerMessage(
+            type="error",
+            error_code="tool_loop_limit",
+            error_message=f"exceeded {MAX_TOOL_ROUNDS} tool rounds",
+        )
+
+    # ------------------------------------------------------------------
+
+    def _handle_tool_call(self, payload: str, assistant_text: str, deadline: float):
+        """Returns (turns_to_append, client_tool_call_msg_or_None, error)."""
+        try:
+            call = json.loads(payload)
+            name = call["name"]
+            arguments = call.get("arguments", {})
+        except (json.JSONDecodeError, KeyError) as e:
+            return [], None, f"malformed tool call: {e}"
+
+        call_id = f"call-{uuid.uuid4().hex[:8]}"
+        turns = [
+            Turn(
+                role="assistant",
+                content=assistant_text + f"{TOOL_OPEN}{payload}{TOOL_CLOSE}",
+            )
+        ]
+        self.on_event("tool_call", {"name": name, "arguments": arguments, "id": call_id})
+
+        if self.tools.is_client_side(name):
+            msg = ServerMessage(
+                type="tool_call",
+                tool_call=ToolCall(
+                    tool_call_id=call_id, name=name, arguments=arguments, client_side=True
+                ),
+            )
+            return turns, msg, None
+
+        outcome = self.tools.execute(name, arguments, {"session_id": self.session_id})
+        self.on_event(
+            "tool_result",
+            {"id": call_id, "is_error": outcome.is_error, "content": outcome.content},
+        )
+        turns.append(Turn(role="tool", content=outcome.content, tool_call_id=call_id))
+        return turns, None, None
+
+    def _await_client_results(self, deadline: float) -> Optional[list[ToolResult]]:
+        timeout = min(CLIENT_TOOL_TIMEOUT_S, max(0.1, deadline - time.monotonic()))
+        try:
+            return self._client_results.get(timeout=timeout)
+        except queue.Empty:
+            return None
+
+    def _check_response_format(self, text: str, response_format: dict) -> Optional[str]:
+        kind = response_format.get("type")
+        if kind not in ("json", "json_schema"):
+            return None
+        try:
+            doc = json.loads(text)
+        except json.JSONDecodeError as e:
+            return f"output is not valid JSON: {e}"
+        if kind == "json_schema" and response_format.get("schema"):
+            try:
+                jsonschema.validate(doc, response_format["schema"])
+            except jsonschema.ValidationError as e:
+                return f"output violates schema: {e.message}"
+        return None
+
+    def _cost(self, usage: Usage) -> float:
+        if self.provider_spec is None:
+            return 0.0
+        return round(
+            usage.prompt_tokens * self.provider_spec.input_cost_per_mtok / 1e6
+            + usage.completion_tokens * self.provider_spec.output_cost_per_mtok / 1e6,
+            8,
+        )
+
+
+class StreamError:
+    def __init__(self, code: str, message: str):
+        self.code = code
+        self.message = message

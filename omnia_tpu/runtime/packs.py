@@ -1,0 +1,184 @@
+"""PromptPack: the compiled agent-definition artifact.
+
+Same role as the reference's PromptPack CRD + compiled-JSON schema
+(reference api/v1alpha1/promptpack_types.go, internal/schema/
+promptpack.schema.json, shape shown in README.md:57-80): a versioned JSON
+document carrying the system prompt, template params, tool declarations and
+default sampling. Validated against a JSON-Schema here too (jsonschema lib),
+so malformed packs fail at admission, not at turn time.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import re
+from typing import Any, Optional
+
+import jsonschema
+
+PACK_SCHEMA: dict = {
+    "$schema": "https://json-schema.org/draft/2020-12/schema",
+    "type": "object",
+    "required": ["name", "version", "prompts"],
+    "additionalProperties": False,
+    "properties": {
+        "name": {"type": "string", "minLength": 1},
+        "version": {
+            "type": "string",
+            "pattern": r"^\d+\.\d+\.\d+$",
+        },
+        "description": {"type": "string"},
+        "prompts": {
+            "type": "object",
+            "required": ["system"],
+            "additionalProperties": False,
+            "properties": {
+                "system": {"type": "string"},
+                "greeting": {"type": "string"},
+            },
+        },
+        "params": {
+            "type": "object",
+            "additionalProperties": {
+                "type": "object",
+                "properties": {
+                    "type": {"enum": ["string", "number", "boolean"]},
+                    "default": {},
+                    "required": {"type": "boolean"},
+                },
+            },
+        },
+        "tools": {
+            "type": "array",
+            "items": {
+                "type": "object",
+                "required": ["name"],
+                "properties": {
+                    "name": {"type": "string"},
+                    "description": {"type": "string"},
+                    "input_schema": {"type": "object"},
+                    "client_side": {"type": "boolean"},
+                },
+            },
+        },
+        "sampling": {
+            "type": "object",
+            "additionalProperties": False,
+            "properties": {
+                "temperature": {"type": "number", "minimum": 0},
+                "top_p": {"type": "number", "exclusiveMinimum": 0, "maximum": 1},
+                "top_k": {"type": "integer", "minimum": 0},
+                "max_tokens": {"type": "integer", "minimum": 1},
+            },
+        },
+        "functions": {
+            "type": "array",
+            "items": {
+                "type": "object",
+                "required": ["name"],
+                "properties": {
+                    "name": {"type": "string"},
+                    "description": {"type": "string"},
+                    "input_schema": {"type": "object"},
+                    "output_schema": {"type": "object"},
+                    "prompt": {"type": "string"},
+                },
+            },
+        },
+    },
+}
+
+_VAR_RE = re.compile(r"\{\{\s*(\w+)\s*\}\}")
+
+
+class PackValidationError(ValueError):
+    pass
+
+
+@dataclasses.dataclass(frozen=True)
+class PromptPack:
+    name: str
+    version: str
+    raw: dict
+
+    @property
+    def system_template(self) -> str:
+        return self.raw["prompts"]["system"]
+
+    @property
+    def greeting(self) -> Optional[str]:
+        return self.raw["prompts"].get("greeting")
+
+    @property
+    def tools(self) -> list[dict]:
+        return self.raw.get("tools", [])
+
+    @property
+    def functions(self) -> list[dict]:
+        return self.raw.get("functions", [])
+
+    def function(self, name: str) -> Optional[dict]:
+        for f in self.functions:
+            if f["name"] == name:
+                return f
+        return None
+
+    @property
+    def sampling(self) -> dict:
+        return self.raw.get("sampling", {})
+
+    def render_system(self, params: Optional[dict[str, Any]] = None) -> str:
+        """Render the system template with declared params (defaults applied,
+        required enforced, undeclared references rejected)."""
+        declared = self.raw.get("params", {})
+        values: dict[str, Any] = {
+            k: spec.get("default") for k, spec in declared.items() if "default" in spec
+        }
+        values.update(params or {})
+        missing = [
+            k
+            for k, spec in declared.items()
+            if spec.get("required") and k not in values
+        ]
+        if missing:
+            raise PackValidationError(f"missing required params: {missing}")
+
+        def sub(m: re.Match) -> str:
+            key = m.group(1)
+            if key not in declared:
+                raise PackValidationError(f"template references undeclared param {key!r}")
+            if key not in values:
+                raise PackValidationError(f"no value for param {key!r}")
+            return str(values[key])
+
+        return _VAR_RE.sub(sub, self.system_template)
+
+
+def validate_pack(doc: dict) -> list[str]:
+    """Returns a list of human-readable validation errors (empty = valid)."""
+    validator = jsonschema.Draft202012Validator(PACK_SCHEMA)
+    errors = [
+        f"{'/'.join(str(p) for p in e.absolute_path) or '<root>'}: {e.message}"
+        for e in validator.iter_errors(doc)
+    ]
+    if errors:
+        return errors
+    # Template/param cross-checks beyond JSON-Schema.
+    declared = set(doc.get("params", {}))
+    for key in ("system", "greeting"):
+        tmpl = doc.get("prompts", {}).get(key)
+        if tmpl:
+            for ref in _VAR_RE.findall(tmpl):
+                if ref not in declared:
+                    errors.append(f"prompts/{key}: undeclared param {ref!r}")
+    return errors
+
+
+def load_pack(doc: dict | str | bytes) -> PromptPack:
+    if isinstance(doc, (str, bytes)):
+        doc = json.loads(doc)
+    errors = validate_pack(doc)
+    if errors:
+        raise PackValidationError("; ".join(errors))
+    return PromptPack(name=doc["name"], version=doc["version"], raw=doc)

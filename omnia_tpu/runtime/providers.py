@@ -1,0 +1,110 @@
+"""Provider registry: declarative model-backend specs → engines.
+
+The reference's Provider CR maps a name to an external LLM API client
+(type claude/openai/gemini/ollama/vllm/mock..., reference
+api/v1alpha1/agentruntime_types.go:382-414 + internal/runtime/
+provider.go:93-135). Here the first-class citizens are:
+
+- type "tpu": the in-tree JAX continuous-batching engine on the attached
+  slice (the north-star addition — zero external LLM calls),
+- type "mock": scripted scenario playback (reference mock-provider analog),
+
+with the same named-provider indirection so AgentRuntime specs bind by
+name. Roles (llm | embedding) mirror the reference's provider roles.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+from omnia_tpu.engine import EngineConfig, InferenceEngine, MockEngine
+from omnia_tpu.engine.mock import Scenario
+from omnia_tpu.engine.tokenizer import ByteTokenizer
+
+
+class ProviderError(ValueError):
+    pass
+
+
+@dataclasses.dataclass(frozen=True)
+class ProviderSpec:
+    name: str
+    type: str = "tpu"              # tpu | mock
+    role: str = "llm"              # llm | embedding
+    model: str = "llama3-8b"       # ModelConfig preset name
+    # Engine placement/shape options (forwarded to EngineConfig).
+    options: dict = dataclasses.field(default_factory=dict)
+    # Pricing for cost accounting on Usage (per 1M tokens), like the
+    # reference's provider pricing config.
+    input_cost_per_mtok: float = 0.0
+    output_cost_per_mtok: float = 0.0
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "ProviderSpec":
+        known = {f.name for f in dataclasses.fields(cls)}
+        unknown = set(d) - known
+        if unknown:
+            raise ProviderError(f"unknown provider fields: {sorted(unknown)}")
+        return cls(**d)
+
+
+def build_engine(spec: ProviderSpec, *, warmup: bool = False):
+    """Instantiate the engine for a provider spec."""
+    if spec.type == "mock":
+        scenarios = [Scenario(**s) for s in spec.options.get("scenarios", [])]
+        return MockEngine(scenarios)
+    if spec.type == "tpu":
+        from omnia_tpu.models import PRESETS, get_config
+
+        if spec.model not in PRESETS:
+            raise ProviderError(
+                f"unknown model preset {spec.model!r}; have {sorted(PRESETS)}"
+            )
+        cfg = get_config(spec.model)
+        eng_kwargs = {
+            k: v
+            for k, v in spec.options.items()
+            if k in {"num_slots", "max_seq", "prefill_buckets", "dtype", "dp", "tp"}
+        }
+        if "prefill_buckets" in eng_kwargs:
+            eng_kwargs["prefill_buckets"] = tuple(eng_kwargs["prefill_buckets"])
+        engine = InferenceEngine(cfg, EngineConfig(**eng_kwargs), seed=spec.options.get("seed", 0))
+        if warmup:
+            engine.warmup()
+        return engine
+    raise ProviderError(f"unknown provider type {spec.type!r}")
+
+
+def build_tokenizer(spec: ProviderSpec):
+    path = spec.options.get("tokenizer_path")
+    if path:
+        from omnia_tpu.engine.tokenizer import HFTokenizer
+
+        return HFTokenizer(path)
+    return ByteTokenizer()
+
+
+class ProviderRegistry:
+    """Named providers for one runtime (AgentRuntime.providers[] analog)."""
+
+    def __init__(self):
+        self._specs: dict[str, ProviderSpec] = {}
+        self._engines: dict[str, Any] = {}
+
+    def register(self, spec: ProviderSpec) -> None:
+        self._specs[spec.name] = spec
+
+    def spec(self, name: str) -> ProviderSpec:
+        if name not in self._specs:
+            raise ProviderError(f"no provider named {name!r}")
+        return self._specs[name]
+
+    def engine(self, name: str):
+        """Lazily build (and cache) the engine for a named provider."""
+        if name not in self._engines:
+            self._engines[name] = build_engine(self.spec(name))
+        return self._engines[name]
+
+    def names(self) -> list[str]:
+        return sorted(self._specs)

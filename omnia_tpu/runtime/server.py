@@ -1,0 +1,272 @@
+"""Runtime gRPC server: serves the omnia.runtime.v1 contract.
+
+The right-hand container of the agent pod (reference cmd/runtime +
+pkg/runtime/service.go adapter + internal/runtime/server.go state), rebuilt
+around the in-process TPU engine. Four RPCs, same shape as the reference
+contract: bidirectional Converse, one-shot Invoke (function mode), Health
+(capabilities + queue depth), HasConversation (tri-state resume probe).
+
+gRPC plumbing uses generic method handlers with the JSON contract
+serializers (no protoc codegen in this environment); the wire remains a
+normal gRPC HTTP/2 stream.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import queue
+import threading
+import uuid
+from concurrent import futures
+from typing import Optional
+
+import grpc
+import jsonschema
+
+from omnia_tpu.engine.types import FinishReason, SamplingParams
+from omnia_tpu.runtime import contract as c
+from omnia_tpu.runtime.context_store import (
+    ContextStore,
+    InMemoryContextStore,
+    StoreUnavailable,
+)
+from omnia_tpu.runtime.conversation import Conversation
+from omnia_tpu.runtime.packs import PromptPack
+from omnia_tpu.runtime.providers import ProviderRegistry, build_tokenizer
+from omnia_tpu.tools import ToolExecutor
+
+logger = logging.getLogger(__name__)
+
+DEFAULT_CAPABILITIES = [
+    c.Capability.TEXT.value,
+    c.Capability.STREAMING.value,
+    c.Capability.TOOLS.value,
+    c.Capability.CLIENT_TOOLS.value,
+    c.Capability.FUNCTIONS.value,
+    c.Capability.RESUME.value,
+    c.Capability.RESPONSE_FORMAT.value,
+]
+
+
+class RuntimeServer:
+    """Assembles pack + provider engine + stores into a gRPC service."""
+
+    def __init__(
+        self,
+        pack: PromptPack,
+        providers: ProviderRegistry,
+        provider_name: str,
+        context_store: Optional[ContextStore] = None,
+        tool_executor: Optional[ToolExecutor] = None,
+        capabilities: Optional[list[str]] = None,
+        pack_params: Optional[dict] = None,
+        on_event=None,
+    ):
+        self.pack = pack
+        self.providers = providers
+        self.provider_name = provider_name
+        self.store = context_store or InMemoryContextStore()
+        self.tools = tool_executor or ToolExecutor()
+        self.capabilities = capabilities or list(DEFAULT_CAPABILITIES)
+        self.pack_params = pack_params or {}
+        self.on_event = on_event
+        self._conversations: dict[str, Conversation] = {}
+        self._conv_lock = threading.Lock()
+        self._grpc_server: Optional[grpc.Server] = None
+        self.port: Optional[int] = None
+
+    # ------------------------------------------------------------------
+
+    @property
+    def engine(self):
+        return self.providers.engine(self.provider_name)
+
+    @property
+    def spec(self):
+        return self.providers.spec(self.provider_name)
+
+    def _get_or_create(self, session_id: str) -> Conversation:
+        conv = self._conversations.get(session_id)
+        if conv is None:
+            with self._conv_lock:
+                conv = self._conversations.get(session_id)
+                if conv is None:
+                    conv = Conversation(
+                        session_id=session_id,
+                        pack=self.pack,
+                        engine=self.engine,
+                        tokenizer=build_tokenizer(self.spec),
+                        store=self.store,
+                        provider_spec=self.spec,
+                        tool_executor=self.tools,
+                        pack_params=self.pack_params,
+                        on_event=(
+                            (lambda kind, data, sid=session_id: self.on_event(sid, kind, data))
+                            if self.on_event
+                            else None
+                        ),
+                    )
+                    self._conversations[session_id] = conv
+        return conv
+
+    # ------------------------------------------------------------------
+    # RPC implementations
+    # ------------------------------------------------------------------
+
+    def converse(self, request_iterator, context):
+        md = dict(context.invocation_metadata())
+        session_id = md.get(c.MD_SESSION_ID) or f"sess-{uuid.uuid4().hex[:12]}"
+        conv = self._get_or_create(session_id)
+
+        yield c.ServerMessage(
+            type="hello",
+            contract_version=c.CONTRACT_VERSION,
+            capabilities=self.capabilities,
+        )
+
+        inbox: "queue.Queue[Optional[c.ClientMessage]]" = queue.Queue()
+
+        def reader():
+            try:
+                for m in request_iterator:
+                    if m.type == "tool_results":
+                        conv.provide_tool_results(m.tool_results)
+                    else:
+                        inbox.put(m)
+            except Exception:  # stream broken: unblock the writer
+                pass
+            finally:
+                inbox.put(None)
+
+        threading.Thread(target=reader, daemon=True).start()
+
+        while True:
+            m = inbox.get()
+            if m is None:
+                return
+            if m.type == "cancel":
+                continue
+            try:
+                yield from conv.stream(m)
+            except Exception as e:  # turn must not kill the stream silently
+                logger.exception("turn failed")
+                yield c.ServerMessage(
+                    type="error", error_code="internal", error_message=str(e)
+                )
+
+    def invoke(self, request: c.InvokeRequest, context):
+        fn = self.pack.function(request.name)
+        if fn is None:
+            return c.InvokeResponse(
+                error_code="not_found", error_message=f"no function {request.name!r}"
+            )
+        if fn.get("input_schema"):
+            try:
+                jsonschema.validate(request.input, fn["input_schema"])
+            except jsonschema.ValidationError as e:
+                return c.InvokeResponse(
+                    error_code="bad_input", error_message=e.message
+                )
+
+        tokenizer = build_tokenizer(self.spec)
+        prompt_tmpl = fn.get("prompt") or self.pack.system_template
+        prompt = prompt_tmpl.replace("{{input}}", json.dumps(request.input))
+        s = self.pack.sampling
+        sp = SamplingParams(
+            temperature=float(s.get("temperature", 0.0)),
+            top_p=float(s.get("top_p", 1.0)),
+            max_tokens=int(s.get("max_tokens", 256)),
+            stop_token_ids=(tokenizer.eos_id,),
+        )
+        toks, fin = self.engine.generate(tokenizer.encode(prompt), sp)
+        if fin.finish_reason == FinishReason.ERROR:
+            return c.InvokeResponse(error_code="engine_error", error_message=fin.error or "")
+        text = tokenizer.decode(toks)
+        usage = c.Usage(
+            prompt_tokens=fin.num_prompt_tokens, completion_tokens=fin.num_generated_tokens
+        )
+        if fn.get("output_schema"):
+            # Bad model output is the runtime's fault, not the caller's —
+            # surfaced as bad_output (the reference facade maps this to 502).
+            try:
+                doc = json.loads(text)
+                jsonschema.validate(doc, fn["output_schema"])
+            except (json.JSONDecodeError, jsonschema.ValidationError) as e:
+                return c.InvokeResponse(
+                    error_code="bad_output",
+                    error_message=f"function output failed validation: {e}",
+                )
+            return c.InvokeResponse(output=doc, usage=usage)
+        return c.InvokeResponse(output=text, usage=usage)
+
+    def health(self, request, context):
+        engine = self.engine
+        healthy = getattr(engine, "healthy", lambda: True)()
+        return c.HealthResponse(
+            status="ok" if healthy else "unhealthy",
+            contract_version=c.CONTRACT_VERSION,
+            capabilities=self.capabilities,
+            model=self.spec.model,
+            queue_depth=engine.queue_depth(),
+            active_slots=engine.active_slots(),
+        )
+
+    def has_conversation(self, request: c.HasConversationRequest, context):
+        try:
+            exists = self.store.exists(request.session_id)
+        except StoreUnavailable:
+            return c.HasConversationResponse(state=c.ResumeState.UNAVAILABLE.value)
+        return c.HasConversationResponse(
+            state=(c.ResumeState.ACTIVE if exists else c.ResumeState.NOT_FOUND).value
+        )
+
+    # ------------------------------------------------------------------
+    # gRPC wiring
+    # ------------------------------------------------------------------
+
+    def _generic_handler(self):
+        def _raw(x: bytes) -> bytes:
+            return x
+
+        handlers = {
+            "Converse": grpc.stream_stream_rpc_method_handler(
+                self.converse,
+                request_deserializer=c.ClientMessage.from_bytes,
+                response_serializer=c.ServerMessage.to_bytes,
+            ),
+            "Invoke": grpc.unary_unary_rpc_method_handler(
+                self.invoke,
+                request_deserializer=c.InvokeRequest.from_bytes,
+                response_serializer=c.InvokeResponse.to_bytes,
+            ),
+            "Health": grpc.unary_unary_rpc_method_handler(
+                self.health,
+                request_deserializer=_raw,
+                response_serializer=c.HealthResponse.to_bytes,
+            ),
+            "HasConversation": grpc.unary_unary_rpc_method_handler(
+                self.has_conversation,
+                request_deserializer=c.HasConversationRequest.from_bytes,
+                response_serializer=c.HasConversationResponse.to_bytes,
+            ),
+        }
+        return grpc.method_handlers_generic_handler(c.SERVICE_NAME, handlers)
+
+    def serve(self, address: str = "localhost:0", max_workers: int = 32) -> int:
+        """Start the server; returns the bound port."""
+        server = grpc.server(futures.ThreadPoolExecutor(max_workers=max_workers))
+        server.add_generic_rpc_handlers((self._generic_handler(),))
+        self.port = server.add_insecure_port(address)
+        server.start()
+        self._grpc_server = server
+        logger.info("runtime serving on port %d", self.port)
+        return self.port
+
+    def shutdown(self, grace: float = 5.0):
+        if self._grpc_server is not None:
+            self._grpc_server.stop(grace).wait()
+            self._grpc_server = None
+        engine = self.providers._engines.get(self.provider_name)
+        if engine is not None:
+            engine.stop()

@@ -1,0 +1,15 @@
+from omnia_tpu.tools.executor import (
+    CircuitBreaker,
+    CircuitOpen,
+    ToolExecutor,
+    ToolHandler,
+    ToolOutcome,
+)
+
+__all__ = [
+    "CircuitBreaker",
+    "CircuitOpen",
+    "ToolExecutor",
+    "ToolHandler",
+    "ToolOutcome",
+]

@@ -1,0 +1,210 @@
+"""Server-side tool execution: handler registry, dispatch, resilience.
+
+Counterpart of the reference's tool executor (reference internal/runtime/
+tools/omnia_executor.go:56/:177/:403 routes tool calls to http/grpc/mcp/
+openapi backends with a circuit breaker + classified retries per handler;
+client-side tools are suspended up to the facade). Here:
+
+- handler types: python (in-process callable), http (JSON POST),
+  openapi (operation mapped to http), client (suspension marker);
+  mcp/grpc handlers arrive with the transport work.
+- resilience: per-handler circuit breaker + classified retries
+  (retry on transport/5xx, never on 4xx), wall-clock execution timeout.
+- policy hook: an optional decision callback runs before every dispatch
+  (the EE policy-broker seam, fail-closed).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+from typing import Any, Callable, Optional
+
+DEFAULT_TIMEOUT_S = 30.0
+MAX_RETRIES = 2
+
+
+class CircuitOpen(RuntimeError):
+    pass
+
+
+class PolicyDenied(RuntimeError):
+    pass
+
+
+@dataclasses.dataclass
+class ToolOutcome:
+    content: str
+    is_error: bool = False
+
+
+@dataclasses.dataclass
+class ToolHandler:
+    name: str
+    type: str = "python"              # python | http | openapi | client
+    description: str = ""
+    input_schema: Optional[dict] = None
+    # python
+    fn: Optional[Callable[[dict], Any]] = None
+    # http / openapi
+    url: str = ""
+    method: str = "POST"
+    headers: dict = dataclasses.field(default_factory=dict)
+    timeout_s: float = DEFAULT_TIMEOUT_S
+
+    @property
+    def client_side(self) -> bool:
+        return self.type == "client"
+
+
+class CircuitBreaker:
+    """Count-based breaker: opens after `threshold` consecutive failures,
+    half-opens after `cooldown_s` (one trial request)."""
+
+    def __init__(self, threshold: int = 5, cooldown_s: float = 30.0):
+        self.threshold = threshold
+        self.cooldown_s = cooldown_s
+        self._failures = 0
+        self._opened_at: Optional[float] = None
+        self._lock = threading.Lock()
+
+    def allow(self) -> bool:
+        with self._lock:
+            if self._opened_at is None:
+                return True
+            if time.monotonic() - self._opened_at >= self.cooldown_s:
+                return True  # half-open trial
+            return False
+
+    def record(self, ok: bool) -> None:
+        with self._lock:
+            if ok:
+                self._failures = 0
+                self._opened_at = None
+            else:
+                self._failures += 1
+                if self._failures >= self.threshold:
+                    self._opened_at = time.monotonic()
+
+    @property
+    def open(self) -> bool:
+        return not self.allow()
+
+
+class _RetryableError(RuntimeError):
+    pass
+
+
+class _FatalError(RuntimeError):
+    pass
+
+
+class ToolExecutor:
+    def __init__(
+        self,
+        handlers: Optional[list[ToolHandler]] = None,
+        policy_check: Optional[Callable[[str, dict, dict], bool]] = None,
+        max_retries: int = MAX_RETRIES,
+    ):
+        self._handlers: dict[str, ToolHandler] = {}
+        self._breakers: dict[str, CircuitBreaker] = {}
+        self._policy_check = policy_check
+        self._max_retries = max_retries
+        for h in handlers or []:
+            self.register(h)
+
+    def register(self, handler: ToolHandler) -> None:
+        self._handlers[handler.name] = handler
+        self._breakers[handler.name] = CircuitBreaker()
+
+    def handler(self, name: str) -> Optional[ToolHandler]:
+        return self._handlers.get(name)
+
+    def is_client_side(self, name: str) -> bool:
+        h = self._handlers.get(name)
+        return h is not None and h.client_side
+
+    def names(self) -> list[str]:
+        return sorted(self._handlers)
+
+    # ------------------------------------------------------------------
+
+    def execute(self, name: str, arguments: dict, context: Optional[dict] = None) -> ToolOutcome:
+        """Dispatch with policy gate, breaker, and classified retries.
+        Returns an error ToolOutcome rather than raising (errors flow back
+        into the conversation as tool results, as the model should see them)."""
+        handler = self._handlers.get(name)
+        if handler is None:
+            return ToolOutcome(f"unknown tool: {name}", is_error=True)
+        if handler.client_side:
+            return ToolOutcome(
+                f"tool {name} is client-side; cannot execute server-side",
+                is_error=True,
+            )
+        if self._policy_check is not None:
+            # Fail-closed: a policy evaluation error is a deny.
+            try:
+                allowed = self._policy_check(name, arguments, context or {})
+            except Exception as e:
+                return ToolOutcome(f"policy check failed (deny): {e}", is_error=True)
+            if not allowed:
+                return ToolOutcome(f"tool {name} denied by policy", is_error=True)
+
+        breaker = self._breakers[name]
+        if not breaker.allow():
+            return ToolOutcome(f"tool {name} circuit open", is_error=True)
+
+        attempt = 0
+        while True:
+            try:
+                result = self._dispatch(handler, arguments, context or {})
+                breaker.record(True)
+                return result
+            except _FatalError as e:
+                breaker.record(False)
+                return ToolOutcome(str(e), is_error=True)
+            except (_RetryableError, Exception) as e:  # noqa: BLE001
+                breaker.record(False)
+                attempt += 1
+                if attempt > self._max_retries:
+                    return ToolOutcome(
+                        f"tool {name} failed after {attempt} attempts: {e}",
+                        is_error=True,
+                    )
+                time.sleep(min(0.1 * 2**attempt, 2.0))
+
+    # ------------------------------------------------------------------
+
+    def _dispatch(self, handler: ToolHandler, arguments: dict, context: dict) -> ToolOutcome:
+        if handler.type == "python":
+            if handler.fn is None:
+                raise _FatalError(f"python tool {handler.name} has no fn")
+            out = handler.fn(arguments)
+            return ToolOutcome(out if isinstance(out, str) else json.dumps(out))
+        if handler.type in ("http", "openapi"):
+            return self._dispatch_http(handler, arguments, context)
+        raise _FatalError(f"unsupported handler type {handler.type}")
+
+    def _dispatch_http(self, handler: ToolHandler, arguments: dict, context: dict) -> ToolOutcome:
+        body = json.dumps(arguments).encode()
+        req = urllib.request.Request(
+            handler.url,
+            data=body if handler.method in ("POST", "PUT", "PATCH") else None,
+            method=handler.method,
+            headers={"Content-Type": "application/json", **handler.headers},
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=handler.timeout_s) as resp:
+                return ToolOutcome(resp.read().decode("utf-8", errors="replace"))
+        except urllib.error.HTTPError as e:
+            # 5xx: transient backend trouble -> retry; 4xx: our request is
+            # wrong, retrying cannot help -> fatal.
+            if e.code >= 500:
+                raise _RetryableError(f"HTTP {e.code} from {handler.name}") from e
+            raise _FatalError(f"HTTP {e.code} from {handler.name}: {e.reason}") from e
+        except urllib.error.URLError as e:
+            raise _RetryableError(f"transport error calling {handler.name}: {e.reason}") from e

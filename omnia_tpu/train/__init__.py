@@ -1,0 +1,3 @@
+from omnia_tpu.train.trainer import make_train_step, TrainState
+
+__all__ = ["make_train_step", "TrainState"]

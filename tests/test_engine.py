@@ -242,3 +242,31 @@ def test_warmup_is_behavior_neutral():
     e2.warmup()
     t2, _ = e2.generate([1, 2, 3], sp)
     assert t1 == t2
+
+
+def test_mock_rejects_like_real_engine():
+    eng = MockEngine()
+    ev = eng.submit([], SamplingParams()).get_event(timeout=5)
+    assert ev.finish_reason == FinishReason.ERROR
+    ev = eng.submit([1], SamplingParams(max_tokens=0)).get_event(timeout=5)
+    assert ev.finish_reason == FinishReason.ERROR
+
+
+def test_prefill_failure_reaches_handle(engine):
+    """A prefill exception must deliver an ERROR final to that request's
+    handle (it has no slot yet, so recovery's fail_all can't see it)."""
+    sp = SamplingParams(temperature=0.0, max_tokens=2)
+    orig = engine._prefill_fn
+    engine._prefill_fn = lambda *a, **k: (_ for _ in ()).throw(RuntimeError("boom"))
+    try:
+        h = engine.submit([1, 2], sp)
+        with pytest.raises(RuntimeError):
+            engine.step()
+        ev = h.get_event(timeout=5)
+        assert ev.finish_reason == FinishReason.ERROR
+        assert "prefill" in ev.error
+    finally:
+        engine._prefill_fn = orig
+        engine._recover("test cleanup")
+    toks, fin = engine.generate([1, 2], sp)
+    assert len(toks) == 2

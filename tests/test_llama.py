@@ -170,3 +170,23 @@ def test_sharded_moe_matches_single_device(devices8):
     sh_params = shard_pytree(params, llama.param_specs(cfg), mesh)
     got = jax.jit(lambda p, t: llama.forward_train(p, cfg, t))(sh_params, tokens)
     np.testing.assert_allclose(np.asarray(got), np.asarray(ref), rtol=1e-3, atol=1e-3)
+
+
+def test_train_step_runs_and_loss_decreases(devices8):
+    import optax
+    from omnia_tpu.parallel import make_mesh
+    from omnia_tpu.train import make_train_step
+
+    cfg = get_config("test-tiny")
+    mesh = make_mesh(dp=2, tp=2, devices=devices8)
+    init_fn, train_step = make_train_step(cfg, optax.adamw(1e-2), mesh=mesh)
+    state = init_fn(jax.random.key(0))
+    tokens = jnp.asarray(
+        np.random.default_rng(0).integers(1, cfg.vocab_size, size=(4, 12)),
+        dtype=jnp.int32,
+    )
+    state, loss0 = train_step(state, tokens)
+    for _ in range(5):
+        state, loss = train_step(state, tokens)
+    assert float(loss) < float(loss0)
+    assert int(state.step) == 6

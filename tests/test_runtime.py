@@ -1,0 +1,403 @@
+"""Runtime-plane tests: packs, context store, conversation loop, and the
+facade-less gRPC integration (both ends in one process over localhost, the
+reference's integration-test pattern)."""
+
+import json
+import threading
+import time
+
+import pytest
+
+from omnia_tpu.engine import MockEngine, SamplingParams
+from omnia_tpu.engine.mock import Scenario
+from omnia_tpu.engine.tokenizer import ByteTokenizer
+from omnia_tpu.runtime import contract as c
+from omnia_tpu.runtime.client import RuntimeClient
+from omnia_tpu.runtime.context_store import (
+    BrokenContextStore,
+    ConversationState,
+    FileContextStore,
+    InMemoryContextStore,
+    Turn,
+)
+from omnia_tpu.runtime.conversation import Conversation, ToolCallStreamParser
+from omnia_tpu.runtime.packs import PackValidationError, load_pack, validate_pack
+from omnia_tpu.runtime.providers import ProviderRegistry, ProviderSpec
+from omnia_tpu.runtime.server import RuntimeServer
+from omnia_tpu.tools import ToolExecutor, ToolHandler
+
+PACK = {
+    "name": "test-agent",
+    "version": "1.0.0",
+    "prompts": {"system": "You are {{persona}}.", "greeting": "hello!"},
+    "params": {"persona": {"type": "string", "default": "helpful"}},
+    "tools": [
+        {"name": "echo", "description": "echo back"},
+        {"name": "browser", "description": "client side", "client_side": True},
+    ],
+    "sampling": {"temperature": 0.0, "max_tokens": 128},
+    "functions": [
+        {
+            "name": "classify",
+            "input_schema": {"type": "object", "required": ["text"]},
+            "output_schema": {"type": "object", "required": ["label"]},
+            "prompt": "Classify: {{input}}",
+        }
+    ],
+}
+
+
+class TestPacks:
+    def test_valid_pack_loads(self):
+        pack = load_pack(PACK)
+        assert pack.name == "test-agent"
+        assert pack.render_system() == "You are helpful."
+        assert pack.render_system({"persona": "terse"}) == "You are terse."
+
+    def test_missing_system_rejected(self):
+        doc = {"name": "x", "version": "1.0.0", "prompts": {}}
+        errs = validate_pack(doc)
+        assert any("system" in e for e in errs)
+
+    def test_bad_version_rejected(self):
+        doc = {"name": "x", "version": "not-semver", "prompts": {"system": "s"}}
+        assert validate_pack(doc)
+
+    def test_undeclared_template_param_rejected(self):
+        doc = {
+            "name": "x",
+            "version": "1.0.0",
+            "prompts": {"system": "hello {{nope}}"},
+        }
+        errs = validate_pack(doc)
+        assert any("undeclared" in e for e in errs)
+
+    def test_unknown_top_level_key_rejected(self):
+        doc = dict(PACK, extra_field=1)
+        assert validate_pack(doc)
+
+    def test_required_param_enforced_at_render(self):
+        doc = {
+            "name": "x",
+            "version": "1.0.0",
+            "prompts": {"system": "agent {{who}}"},
+            "params": {"who": {"type": "string", "required": True}},
+        }
+        pack = load_pack(doc)
+        with pytest.raises(PackValidationError, match="missing required"):
+            pack.render_system()
+
+
+class TestContextStore:
+    def test_in_memory_roundtrip(self):
+        store = InMemoryContextStore()
+        st = ConversationState(session_id="s1", turns=[Turn("user", "hi")])
+        store.put(st)
+        got = store.get("s1")
+        assert got.turns[0].content == "hi"
+        assert store.exists("s1")
+        store.delete("s1")
+        assert not store.exists("s1")
+
+    def test_ttl_eviction(self):
+        store = InMemoryContextStore(ttl_s=0.05)
+        store.put(ConversationState(session_id="s1"))
+        time.sleep(0.1)
+        assert store.get("s1") is None
+
+    def test_file_store_roundtrip(self, tmp_path):
+        store = FileContextStore(str(tmp_path))
+        st = ConversationState(session_id="a/b", turns=[Turn("user", "x")])
+        store.put(st)
+        assert store.exists("a/b")
+        assert store.get("a/b").turns[0].content == "x"
+        # second store instance sees it (multi-process topology)
+        store2 = FileContextStore(str(tmp_path))
+        assert store2.exists("a/b")
+
+
+class TestToolCallStreamParser:
+    def test_plain_text_passthrough(self):
+        p = ToolCallStreamParser()
+        out = p.feed("hello world")
+        assert out == [("text", "hello world")]
+
+    def test_tool_call_split_across_chunks(self):
+        p = ToolCallStreamParser()
+        events = []
+        for chunk in ["before <tool", '_call>{"name":', '"echo"}</tool_call> after']:
+            events.extend(p.feed(chunk))
+        kinds = [k for k, _ in events]
+        assert ("tool", '{"name":"echo"}') in events
+        assert "".join(v for k, v in events if k == "text") == "before  after"
+        assert kinds.index("tool") > 0
+
+    def test_partial_marker_held_back(self):
+        p = ToolCallStreamParser()
+        out = p.feed("text <tool")
+        assert out == [("text", "text ")]
+        assert p.flush() == "<tool"
+
+
+def _make_conversation(scenarios, store=None, handlers=None, session="s1"):
+    tok = ByteTokenizer()
+    engine = MockEngine(scenarios, tokenizer=tok)
+    executor = ToolExecutor(
+        handlers
+        or [
+            ToolHandler(name="echo", type="python", fn=lambda args: f"echo:{args.get('text', '')}"),
+            ToolHandler(name="browser", type="client"),
+        ]
+    )
+    return Conversation(
+        session_id=session,
+        pack=load_pack(PACK),
+        engine=engine,
+        tokenizer=tok,
+        store=store if store is not None else InMemoryContextStore(),
+        provider_spec=ProviderSpec(
+            name="mock", type="mock", input_cost_per_mtok=1.0, output_cost_per_mtok=2.0
+        ),
+        tool_executor=executor,
+    )
+
+
+class TestConversation:
+    def test_simple_turn_streams_and_persists(self):
+        store = InMemoryContextStore()
+        conv = _make_conversation(
+            [Scenario(pattern="weather", reply="it is sunny")], store=store
+        )
+        msgs = list(conv.stream(c.ClientMessage(content="weather?")))
+        text = "".join(m.text for m in msgs if m.type == "chunk")
+        assert text == "it is sunny"
+        done = msgs[-1]
+        assert done.type == "done"
+        assert done.usage.completion_tokens > 0
+        assert done.usage.cost_usd > 0
+        state = store.get("s1")
+        assert [t.role for t in state.turns] == ["user", "assistant"]
+
+    def test_multi_turn_history_in_prompt(self):
+        # Second turn's prompt must contain the first exchange.
+        seen_prompts = []
+
+        class SpyEngine(MockEngine):
+            def submit(self, prompt_tokens, params=SamplingParams()):
+                seen_prompts.append(ByteTokenizer().decode(prompt_tokens))
+                return super().submit(prompt_tokens, params)
+
+        tok = ByteTokenizer()
+        conv = _make_conversation([Scenario(pattern=".", reply="ok")])
+        conv.engine = SpyEngine([Scenario(pattern=".", reply="ok")], tokenizer=tok)
+        list(conv.stream(c.ClientMessage(content="first question")))
+        list(conv.stream(c.ClientMessage(content="second question")))
+        assert "first question" in seen_prompts[1]
+        assert "[ASSIST]ok[/ASSIST]" in seen_prompts[1]
+
+    def test_server_side_tool_round(self):
+        scenarios = [
+            Scenario(pattern=r"\[TOOL\]echo:ping", reply="tool said ping"),
+            Scenario(
+                pattern="use the tool",
+                reply='<tool_call>{"name": "echo", "arguments": {"text": "ping"}}</tool_call>',
+            ),
+        ]
+        conv = _make_conversation(scenarios)
+        msgs = list(conv.stream(c.ClientMessage(content="use the tool")))
+        text = "".join(m.text for m in msgs if m.type == "chunk")
+        assert text == "tool said ping"
+        assert msgs[-1].type == "done"
+
+    def test_client_side_tool_suspends_and_resumes(self):
+        scenarios = [
+            Scenario(pattern=r"\[TOOL\]page content", reply="summarized"),
+            Scenario(
+                pattern="summarize",
+                reply='<tool_call>{"name": "browser", "arguments": {"url": "x"}}</tool_call>',
+            ),
+        ]
+        conv = _make_conversation(scenarios)
+        out = []
+
+        def run():
+            out.extend(conv.stream(c.ClientMessage(content="summarize this")))
+
+        t = threading.Thread(target=run)
+        t.start()
+        # wait for the tool_call announcement
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            if any(m.type == "tool_call" for m in out):
+                break
+            time.sleep(0.01)
+        tc = next(m for m in out if m.type == "tool_call")
+        assert tc.tool_call.client_side
+        assert tc.tool_call.name == "browser"
+        conv.provide_tool_results(
+            [c.ToolResult(tool_call_id=tc.tool_call.tool_call_id, content="page content")]
+        )
+        t.join(timeout=10)
+        text = "".join(m.text for m in out if m.type == "chunk")
+        assert text == "summarized"
+
+    def test_tool_loop_limit(self):
+        scenarios = [
+            Scenario(
+                pattern=".",
+                reply='<tool_call>{"name": "echo", "arguments": {}}</tool_call>',
+            )
+        ]
+        conv = _make_conversation(scenarios)
+        msgs = list(conv.stream(c.ClientMessage(content="loop forever")))
+        assert msgs[-1].type == "error"
+        assert msgs[-1].error_code == "tool_loop_limit"
+
+    def test_store_outage_reported(self):
+        conv = _make_conversation(
+            [Scenario(pattern=".", reply="x")], store=BrokenContextStore()
+        )
+        msgs = list(conv.stream(c.ClientMessage(content="hi")))
+        assert msgs[-1].type == "error"
+        assert msgs[-1].error_code == "store_unavailable"
+
+    def test_malformed_tool_call_is_error(self):
+        scenarios = [
+            Scenario(pattern=".", reply="<tool_call>not json</tool_call>")
+        ]
+        conv = _make_conversation(scenarios)
+        msgs = list(conv.stream(c.ClientMessage(content="x")))
+        assert msgs[-1].type == "error"
+        assert msgs[-1].error_code == "tool_error"
+
+    def test_response_format_json_enforced(self):
+        conv = _make_conversation([Scenario(pattern=".", reply="not json at all")])
+        msgs = list(
+            conv.stream(
+                c.ClientMessage(content="x", response_format={"type": "json"})
+            )
+        )
+        assert msgs[-1].type == "error"
+        assert msgs[-1].error_code == "bad_response_format"
+
+
+@pytest.fixture(scope="module")
+def grpc_pair():
+    """Runtime server + client over real localhost gRPC, mock engine."""
+    registry = ProviderRegistry()
+    registry.register(
+        ProviderSpec(
+            name="main",
+            type="mock",
+            options={
+                "scenarios": [
+                    {"pattern": r"\[TOOL\]echo:hi", "reply": "tool done"},
+                    {
+                        "pattern": "tooltime",
+                        "reply": '<tool_call>{"name": "echo", "arguments": {"text": "hi"}}</tool_call>',
+                    },
+                    {"pattern": "hello", "reply": "world"},
+                    {"pattern": "Classify", "reply": '{"label": "positive"}'},
+                    {"pattern": "badout", "reply": "oops not json"},
+                ]
+            },
+        )
+    )
+    executor = ToolExecutor(
+        [ToolHandler(name="echo", type="python", fn=lambda a: f"echo:{a.get('text','')}")]
+    )
+    pack = dict(PACK)
+    pack["functions"] = PACK["functions"] + [
+        {
+            "name": "badfn",
+            "output_schema": {"type": "object"},
+            "prompt": "badout {{input}}",
+        }
+    ]
+    server = RuntimeServer(
+        pack=load_pack(pack),
+        providers=registry,
+        provider_name="main",
+        tool_executor=executor,
+    )
+    port = server.serve("localhost:0")
+    client = RuntimeClient(f"localhost:{port}")
+    yield server, client
+    client.close()
+    server.shutdown()
+
+
+class TestGrpcIntegration:
+    def test_hello_and_turn(self, grpc_pair):
+        _, client = grpc_pair
+        stream = client.open_stream("sess-int-1", user_id="u1")
+        msgs = list(stream.turn("hello there"))
+        assert stream.hello is not None
+        assert stream.hello.contract_version == c.CONTRACT_VERSION
+        assert c.Capability.STREAMING.value in stream.hello.capabilities
+        text = "".join(m.text for m in msgs if m.type == "chunk")
+        assert text == "world"
+        assert msgs[-1].type == "done"
+        stream.close()
+
+    def test_tool_round_over_grpc(self, grpc_pair):
+        _, client = grpc_pair
+        stream = client.open_stream("sess-int-2")
+        msgs = list(stream.turn("tooltime please"))
+        text = "".join(m.text for m in msgs if m.type == "chunk")
+        assert text == "tool done"
+        stream.close()
+
+    def test_health_capabilities(self, grpc_pair):
+        _, client = grpc_pair
+        h = client.health()
+        assert h.status == "ok"
+        assert h.model == "llama3-8b"
+        assert c.Capability.TOOLS.value in h.capabilities
+
+    def test_has_conversation_tristate(self, grpc_pair):
+        server, client = grpc_pair
+        assert client.has_conversation("nope") == c.ResumeState.NOT_FOUND
+        stream = client.open_stream("sess-int-3")
+        list(stream.turn("hello"))
+        stream.close()
+        assert client.has_conversation("sess-int-3") == c.ResumeState.ACTIVE
+        old_store = server.store
+        server.store = BrokenContextStore()
+        try:
+            assert client.has_conversation("sess-int-3") == c.ResumeState.UNAVAILABLE
+        finally:
+            server.store = old_store
+
+    def test_invoke_function_mode(self, grpc_pair):
+        _, client = grpc_pair
+        resp = client.invoke("classify", {"text": "great stuff"})
+        assert resp.error_code == ""
+        assert resp.output == {"label": "positive"}
+        assert resp.usage.completion_tokens > 0
+
+    def test_invoke_bad_input_schema(self, grpc_pair):
+        _, client = grpc_pair
+        resp = client.invoke("classify", {"wrong": 1})
+        assert resp.error_code == "bad_input"
+
+    def test_invoke_unknown_function(self, grpc_pair):
+        _, client = grpc_pair
+        resp = client.invoke("nope", {})
+        assert resp.error_code == "not_found"
+
+    def test_invoke_bad_output_is_runtime_fault(self, grpc_pair):
+        _, client = grpc_pair
+        resp = client.invoke("badfn", {"x": 1})
+        assert resp.error_code == "bad_output"
+
+    def test_resume_same_session_has_history(self, grpc_pair):
+        _, client = grpc_pair
+        s1 = client.open_stream("sess-resume")
+        list(s1.turn("hello"))
+        s1.close()
+        # new stream, same session id: history must persist via context store
+        s2 = client.open_stream("sess-resume")
+        msgs = list(s2.turn("hello again"))
+        assert msgs[-1].type == "done"
+        s2.close()

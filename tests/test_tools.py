@@ -1,0 +1,150 @@
+"""Tool executor tests: dispatch, retry classification, circuit breaker,
+policy gate — against a real local HTTP server."""
+
+import http.server
+import json
+import threading
+
+import pytest
+
+from omnia_tpu.tools import CircuitBreaker, ToolExecutor, ToolHandler
+
+
+@pytest.fixture(scope="module")
+def http_backend():
+    """Local HTTP tool backend with scriptable failure modes."""
+    state = {"fail_next": 0, "calls": 0}
+
+    class Handler(http.server.BaseHTTPRequestHandler):
+        def do_POST(self):
+            state["calls"] += 1
+            body = self.rfile.read(int(self.headers.get("Content-Length", 0)))
+            if self.path == "/flaky" and state["fail_next"] > 0:
+                state["fail_next"] -= 1
+                self.send_response(503)
+                self.end_headers()
+                return
+            if self.path == "/badreq":
+                self.send_response(400, "nope")
+                self.end_headers()
+                return
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.end_headers()
+            self.wfile.write(json.dumps({"echo": json.loads(body or b"{}")}).encode())
+
+        def log_message(self, *args):
+            pass
+
+    server = http.server.ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    yield server.server_address[1], state
+    server.shutdown()
+
+
+def test_python_tool():
+    ex = ToolExecutor([ToolHandler(name="add", fn=lambda a: a["x"] + a["y"])])
+    out = ex.execute("add", {"x": 2, "y": 3})
+    assert not out.is_error
+    assert out.content == "5"
+
+
+def test_unknown_tool_is_error():
+    ex = ToolExecutor()
+    out = ex.execute("nope", {})
+    assert out.is_error
+    assert "unknown tool" in out.content
+
+
+def test_http_tool_roundtrip(http_backend):
+    port, _ = http_backend
+    ex = ToolExecutor(
+        [ToolHandler(name="web", type="http", url=f"http://127.0.0.1:{port}/ok")]
+    )
+    out = ex.execute("web", {"q": "hi"})
+    assert not out.is_error
+    assert json.loads(out.content) == {"echo": {"q": "hi"}}
+
+
+def test_http_5xx_retried_then_succeeds(http_backend):
+    port, state = http_backend
+    state["fail_next"] = 2
+    ex = ToolExecutor(
+        [ToolHandler(name="flaky", type="http", url=f"http://127.0.0.1:{port}/flaky")]
+    )
+    out = ex.execute("flaky", {})
+    assert not out.is_error  # 2 failures < default 2 retries + first attempt
+
+
+def test_http_4xx_not_retried(http_backend):
+    port, state = http_backend
+    before = state["calls"]
+    ex = ToolExecutor(
+        [ToolHandler(name="bad", type="http", url=f"http://127.0.0.1:{port}/badreq")]
+    )
+    out = ex.execute("bad", {})
+    assert out.is_error
+    assert "400" in out.content
+    assert state["calls"] == before + 1  # exactly one attempt
+
+
+def test_transport_error_exhausts_retries():
+    ex = ToolExecutor(
+        [ToolHandler(name="gone", type="http", url="http://127.0.0.1:1/none", timeout_s=0.2)],
+        max_retries=1,
+    )
+    out = ex.execute("gone", {})
+    assert out.is_error
+    assert "after 2 attempts" in out.content
+
+
+def test_circuit_breaker_opens_and_half_opens():
+    cb = CircuitBreaker(threshold=2, cooldown_s=0.05)
+    assert cb.allow()
+    cb.record(False)
+    cb.record(False)
+    assert not cb.allow()
+    import time
+
+    time.sleep(0.08)
+    assert cb.allow()  # half-open trial
+    cb.record(True)
+    assert cb.allow()
+
+
+def test_breaker_blocks_dispatch():
+    calls = []
+
+    def boom(a):
+        calls.append(1)
+        raise RuntimeError("down")
+
+    ex = ToolExecutor([ToolHandler(name="b", fn=boom)], max_retries=0)
+    for _ in range(5):
+        ex.execute("b", {})
+    out = ex.execute("b", {})
+    assert out.is_error
+    assert "circuit open" in out.content
+
+
+def test_policy_gate_fail_closed():
+    ex = ToolExecutor(
+        [ToolHandler(name="t", fn=lambda a: "ok")],
+        policy_check=lambda name, args, ctx: False,
+    )
+    out = ex.execute("t", {})
+    assert out.is_error and "denied" in out.content
+
+    def broken_policy(name, args, ctx):
+        raise RuntimeError("policy svc down")
+
+    ex2 = ToolExecutor([ToolHandler(name="t", fn=lambda a: "ok")], policy_check=broken_policy)
+    out2 = ex2.execute("t", {})
+    assert out2.is_error and "deny" in out2.content
+
+
+def test_client_side_marker():
+    ex = ToolExecutor([ToolHandler(name="ui", type="client")])
+    assert ex.is_client_side("ui")
+    out = ex.execute("ui", {})
+    assert out.is_error
