@@ -75,10 +75,8 @@ class MockEngine:
     ) -> RequestHandle:
         rid = f"mock-{next(self._req_counter)}"
         handle = RequestHandle(rid)
-        with self._lock:
-            self.metrics["requests_submitted"] += 1
-        # Mirror InferenceEngine.submit's validation so code tested against
-        # the mock sees the same rejection events as production.
+        # Mirror InferenceEngine.submit's validation (and its metric
+        # ordering: rejected requests are NOT counted as submitted).
         error = None
         if not prompt_tokens:
             error = "empty prompt"
@@ -89,6 +87,8 @@ class MockEngine:
                 StreamEvent(rid, finish_reason=FinishReason.ERROR, error=error)
             )
             return handle
+        with self._lock:
+            self.metrics["requests_submitted"] += 1
         thread = threading.Thread(
             target=self._play, args=(rid, list(prompt_tokens), params, handle), daemon=True
         )

@@ -1,0 +1,3 @@
+from omnia_tpu.facade.server import FacadeServer
+
+__all__ = ["FacadeServer"]

@@ -1,0 +1,128 @@
+"""Facade auth chain: pluggable validators tried in order.
+
+Same architecture as the reference's facade auth (reference pkg/facade/auth:
+chain of client-key / OIDC / edge-trust / shared-token validators, with the
+management plane on an isolated twin listener). Validators here:
+
+- ClientKeyValidator: static API keys (hashed at rest).
+- SharedTokenValidator: one bearer token for service-to-service paths.
+- HmacValidator: HS256-signed JWT-shaped tokens for the management plane
+  (dashboard-minted tokens in the reference; stdlib hmac, no deps).
+- AllowAll: explicit opt-out for dev.
+
+A chain authenticates if ANY validator accepts; an empty chain denies
+(fail closed).
+"""
+
+from __future__ import annotations
+
+import base64
+import hashlib
+import hmac
+import json
+import time
+from dataclasses import dataclass
+from typing import Optional, Protocol, Sequence
+
+
+@dataclass(frozen=True)
+class Principal:
+    subject: str
+    method: str            # client_key | shared_token | hmac_jwt | anonymous
+    claims: dict = None
+
+
+class Validator(Protocol):
+    def validate(self, token: str) -> Optional[Principal]: ...
+
+
+class ClientKeyValidator:
+    """Static client keys; stores SHA-256 digests, compares in constant time."""
+
+    def __init__(self, keys: dict[str, str]):
+        """keys: {key_id: secret}."""
+        self._digests = {
+            kid: hashlib.sha256(secret.encode()).digest() for kid, secret in keys.items()
+        }
+
+    def validate(self, token: str) -> Optional[Principal]:
+        digest = hashlib.sha256(token.encode()).digest()
+        for kid, expected in self._digests.items():
+            if hmac.compare_digest(digest, expected):
+                return Principal(subject=kid, method="client_key", claims={})
+        return None
+
+
+class SharedTokenValidator:
+    def __init__(self, token: str, subject: str = "service"):
+        self._digest = hashlib.sha256(token.encode()).digest()
+        self._subject = subject
+
+    def validate(self, token: str) -> Optional[Principal]:
+        if hmac.compare_digest(hashlib.sha256(token.encode()).digest(), self._digest):
+            return Principal(subject=self._subject, method="shared_token", claims={})
+        return None
+
+
+def _b64url_decode(s: str) -> bytes:
+    return base64.urlsafe_b64decode(s + "=" * (-len(s) % 4))
+
+
+def _b64url_encode(b: bytes) -> str:
+    return base64.urlsafe_b64encode(b).decode().rstrip("=")
+
+
+class HmacValidator:
+    """HS256 JWT validation for management-plane tokens."""
+
+    def __init__(self, secret: bytes, audience: str = ""):
+        self._secret = secret
+        self._audience = audience
+
+    def validate(self, token: str) -> Optional[Principal]:
+        try:
+            header_b64, payload_b64, sig_b64 = token.split(".")
+            signing_input = f"{header_b64}.{payload_b64}".encode()
+            expected = hmac.new(self._secret, signing_input, hashlib.sha256).digest()
+            if not hmac.compare_digest(expected, _b64url_decode(sig_b64)):
+                return None
+            header = json.loads(_b64url_decode(header_b64))
+            if header.get("alg") != "HS256":
+                return None
+            claims = json.loads(_b64url_decode(payload_b64))
+            if claims.get("exp") is not None and time.time() > claims["exp"]:
+                return None
+            if self._audience and claims.get("aud") != self._audience:
+                return None
+            return Principal(
+                subject=str(claims.get("sub", "")), method="hmac_jwt", claims=claims
+            )
+        except Exception:
+            return None
+
+    @staticmethod
+    def mint(secret: bytes, subject: str, audience: str = "", ttl_s: float = 300.0) -> str:
+        header = _b64url_encode(json.dumps({"alg": "HS256", "typ": "JWT"}).encode())
+        claims = {"sub": subject, "iat": int(time.time()), "exp": int(time.time() + ttl_s)}
+        if audience:
+            claims["aud"] = audience
+        payload = _b64url_encode(json.dumps(claims).encode())
+        sig = hmac.new(secret, f"{header}.{payload}".encode(), hashlib.sha256).digest()
+        return f"{header}.{payload}.{_b64url_encode(sig)}"
+
+
+class AllowAll:
+    def validate(self, token: str) -> Optional[Principal]:
+        return Principal(subject="anonymous", method="anonymous", claims={})
+
+
+class AuthChain:
+    def __init__(self, validators: Sequence[Validator]):
+        self.validators = list(validators)
+
+    def authenticate(self, token: str) -> Optional[Principal]:
+        for v in self.validators:
+            p = v.validate(token or "")
+            if p is not None:
+                return p
+        return None

@@ -1,0 +1,121 @@
+"""Session recording interceptor: protocol-agnostic capture to session-api.
+
+Same posture as the reference's recording interceptor (reference
+internal/facade/recording_interceptor.go + recording_pool.go): capture
+user/assistant messages off the message bus, ship them to the session
+service on a background worker pool, and FAIL OPEN — recording problems
+never block or break the conversation path.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import queue
+import threading
+import time
+import urllib.request
+from typing import Optional
+
+logger = logging.getLogger(__name__)
+
+
+class RecordingInterceptor:
+    def __init__(
+        self,
+        session_api_url: Optional[str],
+        workers: int = 2,
+        queue_limit: int = 1000,
+        timeout_s: float = 5.0,
+    ):
+        self.url = session_api_url.rstrip("/") if session_api_url else None
+        self.timeout_s = timeout_s
+        self._queue: "queue.Queue[dict]" = queue.Queue(maxsize=queue_limit)
+        self._dropped = 0
+        self._stop = threading.Event()
+        self._threads = []
+        if self.url:
+            for i in range(workers):
+                t = threading.Thread(
+                    target=self._worker, name=f"recording-{i}", daemon=True
+                )
+                t.start()
+                self._threads.append(t)
+
+    # ------------------------------------------------------------------
+
+    def record_user(self, session_id: str, user_id: str, content: str) -> None:
+        self._enqueue(
+            {
+                "kind": "message",
+                "session_id": session_id,
+                "user_id": user_id,
+                "role": "user",
+                "content": content,
+                "ts": time.time(),
+            }
+        )
+
+    def record_assistant(
+        self, session_id: str, user_id: str, content: str, usage: Optional[dict] = None
+    ) -> None:
+        self._enqueue(
+            {
+                "kind": "message",
+                "session_id": session_id,
+                "user_id": user_id,
+                "role": "assistant",
+                "content": content,
+                "usage": usage or {},
+                "ts": time.time(),
+            }
+        )
+
+    def record_event(self, session_id: str, event_type: str, data: dict) -> None:
+        self._enqueue(
+            {
+                "kind": "event",
+                "session_id": session_id,
+                "event_type": event_type,
+                "data": data,
+                "ts": time.time(),
+            }
+        )
+
+    # ------------------------------------------------------------------
+
+    def _enqueue(self, record: dict) -> None:
+        if self.url is None:
+            return
+        try:
+            self._queue.put_nowait(record)
+        except queue.Full:
+            # Fail open: drop and count, never block the message path.
+            self._dropped += 1
+
+    def _worker(self) -> None:
+        while not self._stop.is_set():
+            try:
+                record = self._queue.get(timeout=0.25)
+            except queue.Empty:
+                continue
+            try:
+                path = "/api/v1/messages" if record["kind"] == "message" else "/api/v1/events"
+                req = urllib.request.Request(
+                    self.url + path,
+                    data=json.dumps(record).encode(),
+                    headers={"Content-Type": "application/json"},
+                    method="POST",
+                )
+                urllib.request.urlopen(req, timeout=self.timeout_s).read()
+            except Exception as e:  # fail open
+                logger.debug("recording failed (open): %s", e)
+
+    def close(self) -> None:
+        self._stop.set()
+        for t in self._threads:
+            t.join(timeout=2)
+
+    @property
+    def dropped(self) -> int:
+        return self._dropped

@@ -1,0 +1,348 @@
+"""Facade: the agent's client-facing surface (WebSocket protocol v1).
+
+Left-hand container of the agent pod (reference cmd/agent +
+internal/facade: WS server, runtime gRPC bridge, recording interceptor,
+auth chain, drain; protocol per api/websocket/asyncapi.yaml). Wire protocol:
+
+  client → {"type": "message", "content": ...}
+           {"type": "tool_result", "tool_call_id": ..., "content": ..., "is_error"?}
+           {"type": "hangup"}
+  server → {"type": "connected", "session_id", "agent", "capabilities", "resumed"}
+           {"type": "chunk", "text"} | {"type": "tool_call", ...}
+           {"type": "done", "usage", "finish_reason"} | {"type": "error", "code", "message"}
+
+Close codes: 4401 unauthorized, 4408 client-tool timeout, 4429 rate
+limited, 1013 draining.
+
+Threaded end to end (websockets.sync): one OS thread per connection,
+matching the runtime's thread-per-stream gRPC server — no asyncio/thread
+seam on the token hot path.
+"""
+
+from __future__ import annotations
+
+import http.server
+import json
+import logging
+import threading
+import urllib.parse
+import uuid
+from typing import Optional
+
+from websockets.exceptions import ConnectionClosed
+from websockets.sync.server import ServerConnection, serve
+
+from omnia_tpu.facade.auth import AuthChain, Principal
+from omnia_tpu.facade.recording import RecordingInterceptor
+from omnia_tpu.runtime import contract as c
+from omnia_tpu.runtime.client import RuntimeClient
+from omnia_tpu.utils.metrics import Registry
+from omnia_tpu.utils.ratelimit import KeyedLimiter
+
+logger = logging.getLogger(__name__)
+
+CLIENT_TOOL_TIMEOUT_S = 60.0
+RECV_IDLE_TIMEOUT_S = 600.0
+
+
+class FacadeServer:
+    def __init__(
+        self,
+        runtime_target: str,
+        agent_name: str = "agent",
+        auth_chain: Optional[AuthChain] = None,
+        recording: Optional[RecordingInterceptor] = None,
+        messages_per_minute: float = 120.0,
+        drain_timeout_s: float = 30.0,
+    ):
+        self.runtime = RuntimeClient(runtime_target)
+        self.agent_name = agent_name
+        self.auth = auth_chain
+        self.recording = recording or RecordingInterceptor(None)
+        self.drain_timeout_s = drain_timeout_s
+        self.metrics = Registry(prefix="omnia_facade")
+        self._connections_active = self.metrics.gauge(
+            "connections_active", "live websocket connections"
+        )
+        self._messages_total = self.metrics.counter("messages_total")
+        self._turn_latency = self.metrics.histogram(
+            "turn_seconds", buckets=(0.05, 0.1, 0.25, 0.5, 1, 2.5, 5, 10, 30, 60, 120)
+        )
+        self._limiter = KeyedLimiter(rate=messages_per_minute / 60.0, burst=10)
+        self._draining = threading.Event()
+        self._live = set()
+        self._live_lock = threading.Lock()
+        self._ws_server = None
+        self._health_server = None
+        self.port: Optional[int] = None
+        self.health_port: Optional[int] = None
+
+    # ------------------------------------------------------------------
+    # lifecycle
+    # ------------------------------------------------------------------
+
+    def serve(self, host: str = "localhost", port: int = 0, health_port: int = 0) -> int:
+        self._ws_server = serve(self._handle, host, port)
+        self.port = self._ws_server.socket.getsockname()[1]
+        threading.Thread(target=self._ws_server.serve_forever, daemon=True).start()
+        self._start_health(host, health_port)
+        logger.info("facade serving ws on %s:%d", host, self.port)
+        return self.port
+
+    def shutdown(self):
+        if self._ws_server is not None:
+            self._ws_server.shutdown()
+        if self._health_server is not None:
+            self._health_server.shutdown()
+        self.recording.close()
+        self.runtime.close()
+
+    def drain(self):
+        """SIGTERM path: stop accepting new upgrades (readyz 503), give live
+        sessions the drain window, then close them."""
+        self._draining.set()
+        deadline = threading.Event()
+        threading.Timer(self.drain_timeout_s, deadline.set).start()
+        while not deadline.is_set():
+            with self._live_lock:
+                if not self._live:
+                    return
+            deadline.wait(0.2)
+        with self._live_lock:
+            for ws in list(self._live):
+                try:
+                    ws.close(1013, "draining")
+                except Exception:
+                    pass
+
+    @property
+    def draining(self) -> bool:
+        return self._draining.is_set()
+
+    # ------------------------------------------------------------------
+    # connection handling
+    # ------------------------------------------------------------------
+
+    def _handle(self, ws: ServerConnection) -> None:
+        if self._draining.is_set():
+            ws.close(1013, "draining")
+            return
+
+        query = urllib.parse.parse_qs(urllib.parse.urlsplit(ws.request.path).query)
+        token = (query.get("token") or [""])[0]
+        auth_header = ws.request.headers.get("Authorization", "")
+        if auth_header.startswith("Bearer "):
+            token = auth_header[len("Bearer "):]
+
+        principal: Optional[Principal] = None
+        if self.auth is not None:
+            principal = self.auth.authenticate(token)
+            if principal is None:
+                ws.close(4401, "unauthorized")
+                return
+        user_id = (query.get("user") or [principal.subject if principal else "anon"])[0]
+
+        requested_session = (query.get("session") or [""])[0]
+        resumed = False
+        session_id = requested_session or f"sess-{uuid.uuid4().hex[:12]}"
+        if requested_session:
+            try:
+                state = self.runtime.has_conversation(requested_session)
+            except Exception:
+                state = c.ResumeState.UNAVAILABLE
+            if state == c.ResumeState.ACTIVE:
+                resumed = True
+            elif state == c.ResumeState.UNAVAILABLE:
+                self._send(ws, {
+                    "type": "error",
+                    "code": "resume_unavailable",
+                    "message": "context store unavailable; cannot resume",
+                })
+                ws.close(1011, "resume unavailable")
+                return
+            # NOT_FOUND: keep the requested id, start fresh (client keeps
+            # its handle; history is simply gone — the honest outcome).
+
+        with self._live_lock:
+            self._live.add(ws)
+        self._connections_active.add(1)
+        stream = self.runtime.open_stream(session_id, user_id=user_id, agent=self.agent_name)
+        try:
+            health = self.runtime.health()
+            self._send(ws, {
+                "type": "connected",
+                "session_id": session_id,
+                "agent": self.agent_name,
+                "capabilities": health.capabilities,
+                "resumed": resumed,
+            })
+            self._connection_loop(ws, stream, session_id, user_id)
+        except ConnectionClosed:
+            pass
+        except Exception as e:
+            logger.exception("connection failed")
+            self._try_send(ws, {"type": "error", "code": "internal", "message": str(e)})
+        finally:
+            stream.close()
+            with self._live_lock:
+                self._live.discard(ws)
+            self._connections_active.add(-1)
+            self._limiter.forget(session_id)
+
+    def _connection_loop(self, ws, stream, session_id: str, user_id: str) -> None:
+        import time as _time
+
+        while True:
+            raw = ws.recv(timeout=RECV_IDLE_TIMEOUT_S)
+            msg = self._parse(ws, raw)
+            if msg is None:
+                continue
+            mtype = msg.get("type")
+            if mtype == "hangup":
+                ws.close(1000, "bye")
+                return
+            if mtype == "tool_result":
+                # tool_result outside a turn: protocol error, ignore.
+                self._try_send(ws, {
+                    "type": "error", "code": "unexpected_tool_result",
+                    "message": "no tool call in flight",
+                })
+                continue
+            if mtype != "message":
+                self._try_send(ws, {
+                    "type": "error", "code": "bad_message",
+                    "message": f"unknown type {mtype!r}",
+                })
+                continue
+            if not self._limiter.allow(session_id):
+                ws.close(4429, "rate limited")
+                return
+
+            self._messages_total.inc()
+            content = msg.get("content", "")
+            self.recording.record_user(session_id, user_id, content)
+            t0 = _time.monotonic()
+            stream.send_text(content)
+            assistant_text = self._pump_turn(ws, stream, session_id, user_id)
+            self._turn_latency.observe(_time.monotonic() - t0)
+            if assistant_text is None:
+                return  # turn ended the connection
+
+    def _pump_turn(self, ws, stream, session_id: str, user_id: str) -> Optional[str]:
+        """Forward runtime messages for one turn; handles client-tool
+        round-trips. Returns assistant text, or None if the connection
+        should close."""
+        assistant_text = ""
+        for rmsg in stream:
+            if rmsg.type == "chunk":
+                assistant_text += rmsg.text
+                self._send(ws, {"type": "chunk", "text": rmsg.text})
+            elif rmsg.type == "tool_call":
+                tc = rmsg.tool_call
+                self._send(ws, {
+                    "type": "tool_call",
+                    "id": tc.tool_call_id,
+                    "name": tc.name,
+                    "arguments": tc.arguments,
+                })
+                results = self._await_tool_result(ws, tc.tool_call_id)
+                if results is None:
+                    ws.close(4408, "client tool timeout")
+                    return None
+                stream.send_tool_results(results)
+            elif rmsg.type == "done":
+                usage = rmsg.usage.__dict__ if rmsg.usage else {}
+                self.recording.record_assistant(session_id, user_id, assistant_text, usage)
+                self._send(ws, {
+                    "type": "done",
+                    "usage": usage,
+                    "finish_reason": rmsg.finish_reason,
+                })
+                return assistant_text
+            elif rmsg.type == "error":
+                self._send(ws, {
+                    "type": "error",
+                    "code": rmsg.error_code,
+                    "message": rmsg.error_message,
+                })
+                return assistant_text
+        return None
+
+    def _await_tool_result(self, ws, tool_call_id: str) -> Optional[list[c.ToolResult]]:
+        try:
+            raw = ws.recv(timeout=CLIENT_TOOL_TIMEOUT_S)
+        except TimeoutError:
+            return None
+        except ConnectionClosed:
+            return None
+        msg = self._parse(ws, raw)
+        if msg is None or msg.get("type") != "tool_result":
+            return None
+        return [
+            c.ToolResult(
+                tool_call_id=msg.get("tool_call_id", tool_call_id),
+                content=msg.get("content", ""),
+                is_error=bool(msg.get("is_error", False)),
+            )
+        ]
+
+    # ------------------------------------------------------------------
+
+    def _parse(self, ws, raw) -> Optional[dict]:
+        try:
+            if isinstance(raw, bytes):
+                raw = raw.decode("utf-8")
+            doc = json.loads(raw)
+            if not isinstance(doc, dict):
+                raise ValueError("not an object")
+            return doc
+        except (ValueError, UnicodeDecodeError) as e:
+            self._try_send(ws, {
+                "type": "error", "code": "bad_json", "message": str(e)
+            })
+            return None
+
+    def _send(self, ws, doc: dict) -> None:
+        ws.send(json.dumps(doc))
+
+    def _try_send(self, ws, doc: dict) -> None:
+        try:
+            self._send(ws, doc)
+        except Exception:
+            pass
+
+    # ------------------------------------------------------------------
+    # health / metrics endpoint
+    # ------------------------------------------------------------------
+
+    def _start_health(self, host: str, port: int) -> None:
+        facade = self
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            def do_GET(self):
+                if self.path == "/healthz":
+                    self._reply(200, "ok")
+                elif self.path == "/readyz":
+                    if facade.draining:
+                        self._reply(503, "draining")
+                    else:
+                        self._reply(200, "ready")
+                elif self.path == "/metrics":
+                    self._reply(200, facade.metrics.expose())
+                else:
+                    self._reply(404, "not found")
+
+            def _reply(self, code: int, body: str):
+                data = body.encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "text/plain; version=0.0.4")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def log_message(self, *args):
+                pass
+
+        self._health_server = http.server.ThreadingHTTPServer((host, port), Handler)
+        self.health_port = self._health_server.server_address[1]
+        threading.Thread(target=self._health_server.serve_forever, daemon=True).start()

@@ -17,10 +17,19 @@ reference's honesty mechanism.
 
 from __future__ import annotations
 
+import dataclasses
 import enum
 import json
 from dataclasses import asdict, dataclass, field
 from typing import Any, Optional
+
+
+def _known_fields(cls, d: dict) -> dict:
+    """Drop unknown keys before constructing a message dataclass: a newer
+    peer adding an optional field must not crash an older decoder (unknown
+    fields are ignored, the standard versioned-wire-contract rule)."""
+    known = {f.name for f in dataclasses.fields(cls)}
+    return {k: v for k, v in d.items() if k in known}
 
 CONTRACT_VERSION = "1.0.0"
 
@@ -79,8 +88,10 @@ class ClientMessage:
 
     @classmethod
     def from_bytes(cls, raw: bytes) -> "ClientMessage":
-        d = json.loads(raw)
-        d["tool_results"] = [ToolResult(**t) for t in d.get("tool_results", [])]
+        d = _known_fields(cls, json.loads(raw))
+        d["tool_results"] = [
+            ToolResult(**_known_fields(ToolResult, t)) for t in d.get("tool_results", [])
+        ]
         return cls(**d)
 
 
@@ -119,11 +130,11 @@ class ServerMessage:
 
     @classmethod
     def from_bytes(cls, raw: bytes) -> "ServerMessage":
-        d = json.loads(raw)
+        d = _known_fields(cls, json.loads(raw))
         if d.get("tool_call"):
-            d["tool_call"] = ToolCall(**d["tool_call"])
+            d["tool_call"] = ToolCall(**_known_fields(ToolCall, d["tool_call"]))
         if d.get("usage"):
-            d["usage"] = Usage(**d["usage"])
+            d["usage"] = Usage(**_known_fields(Usage, d["usage"]))
         return cls(**d)
 
 
@@ -140,7 +151,7 @@ class InvokeRequest:
 
     @classmethod
     def from_bytes(cls, raw: bytes) -> "InvokeRequest":
-        return cls(**json.loads(raw))
+        return cls(**_known_fields(cls, json.loads(raw)))
 
 
 @dataclass
@@ -156,9 +167,9 @@ class InvokeResponse:
 
     @classmethod
     def from_bytes(cls, raw: bytes) -> "InvokeResponse":
-        d = json.loads(raw)
+        d = _known_fields(cls, json.loads(raw))
         if d.get("usage"):
-            d["usage"] = Usage(**d["usage"])
+            d["usage"] = Usage(**_known_fields(Usage, d["usage"]))
         return cls(**d)
 
 
@@ -176,7 +187,7 @@ class HealthResponse:
 
     @classmethod
     def from_bytes(cls, raw: bytes) -> "HealthResponse":
-        return cls(**json.loads(raw))
+        return cls(**_known_fields(cls, json.loads(raw)))
 
 
 @dataclass
@@ -188,7 +199,7 @@ class HasConversationRequest:
 
     @classmethod
     def from_bytes(cls, raw: bytes) -> "HasConversationRequest":
-        return cls(**json.loads(raw))
+        return cls(**_known_fields(cls, json.loads(raw)))
 
 
 @dataclass
@@ -200,7 +211,7 @@ class HasConversationResponse:
 
     @classmethod
     def from_bytes(cls, raw: bytes) -> "HasConversationResponse":
-        return cls(**json.loads(raw))
+        return cls(**_known_fields(cls, json.loads(raw)))
 
 
 SERVICE_NAME = "omnia.runtime.v1.RuntimeService"

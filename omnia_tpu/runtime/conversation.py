@@ -94,8 +94,15 @@ class ToolCallStreamParser:
             self._buf = self._buf[len(self._buf) - keep:]
             return out
 
+    @property
+    def in_tool(self) -> bool:
+        """True when the stream ended inside an unterminated tool call —
+        the buffer holds internal JSON, not user-visible text."""
+        return self._in_tool
+
     def flush(self) -> str:
-        """Remaining held-back text (end of stream)."""
+        """Remaining held-back text (end of stream). Check `in_tool` first:
+        a mid-tool buffer must not be streamed as text."""
         rest = self._buf
         self._buf = ""
         self._in_tool = False
@@ -152,11 +159,22 @@ class Conversation:
         self.on_event = on_event or (lambda kind, data: None)
         self._client_results: "queue.Queue[list[ToolResult]]" = queue.Queue()
         self._turn_lock = threading.Lock()
+        self._active_handle = None
+        self._cancel_requested = threading.Event()
 
     # ------------------------------------------------------------------
 
     def provide_tool_results(self, results: list[ToolResult]) -> None:
         self._client_results.put(results)
+
+    def cancel_turn(self) -> None:
+        """Interrupt the in-flight turn (client `cancel` message). The
+        engine request is cancelled so the slot frees immediately instead of
+        decoding to max_tokens."""
+        self._cancel_requested.set()
+        handle = self._active_handle
+        if handle is not None:
+            handle.cancel()
 
     def _sampling(self, msg: ClientMessage) -> SamplingParams:
         s = dict(self.pack.sampling)
@@ -181,6 +199,14 @@ class Conversation:
 
     def _stream_locked(self, msg: ClientMessage) -> Iterator[ServerMessage]:
         deadline = time.monotonic() + TURN_TIMEOUT_S
+        self._cancel_requested.clear()
+        # Drain tool results left over from a previous (timed-out) turn so a
+        # stale answer can never satisfy this turn's tool call.
+        while not self._client_results.empty():
+            try:
+                self._client_results.get_nowait()
+            except queue.Empty:
+                break
         try:
             state = self._load_state()
         except StoreUnavailable as e:
@@ -198,13 +224,21 @@ class Conversation:
             usage.prompt_tokens += len(prompt_ids)
 
             handle = self.engine.submit(prompt_ids, sp)
+            self._active_handle = handle
             parser = ToolCallStreamParser()
             detok = IncrementalDetokenizer(self.tokenizer)
             assistant_text = ""
             tool_payload: Optional[str] = None
             error: Optional[StreamError] = None
+            cancelled = False
 
-            for ev in handle.events(timeout=max(1.0, deadline - time.monotonic())):
+            while True:
+                try:
+                    ev = handle.get_event(timeout=max(0.1, deadline - time.monotonic()))
+                except queue.Empty:
+                    handle.cancel()
+                    error = StreamError("timeout", "turn exceeded execution timeout")
+                    break
                 if ev.token_id is not None:
                     usage.completion_tokens += 1
                     piece = detok.push(ev.token_id)
@@ -220,14 +254,32 @@ class Conversation:
                 if ev.is_final:
                     if ev.finish_reason == FinishReason.ERROR:
                         error = StreamError("engine_error", ev.error or "engine error")
+                    elif (
+                        ev.finish_reason == FinishReason.CANCELLED
+                        and self._cancel_requested.is_set()
+                    ):
+                        cancelled = True
                     break
                 if time.monotonic() > deadline:
                     handle.cancel()
                     error = StreamError("timeout", "turn exceeded execution timeout")
                     break
+            self._active_handle = None
 
             if error is not None:
                 yield ServerMessage(type="error", error_code=error.code, error_message=error.message)
+                return
+
+            if cancelled:
+                # Client asked to stop: persist what was produced, finish
+                # honestly with finish_reason=cancelled.
+                state.turns.append(Turn(role="assistant", content=assistant_text))
+                try:
+                    self.store.put(state)
+                except StoreUnavailable:
+                    pass
+                usage.cost_usd = self._cost(usage)
+                yield ServerMessage(type="done", usage=usage, finish_reason="cancelled")
                 return
 
             tail = detok.flush()
@@ -238,6 +290,15 @@ class Conversation:
                         yield ServerMessage(type="chunk", text=payload)
                     elif tool_payload is None:
                         tool_payload = payload
+            if parser.in_tool:
+                # Generation truncated mid-tool-call: the held-back fragment
+                # is internal JSON, never user text.
+                yield ServerMessage(
+                    type="error",
+                    error_code="truncated_tool_call",
+                    error_message="generation ended inside a tool call",
+                )
+                return
             tail2 = parser.flush()
             if tail2:
                 assistant_text += tail2
@@ -274,7 +335,9 @@ class Conversation:
                 return
             if reply is not None:
                 yield reply  # client-side tool_call announcement
-                results = self._await_client_results(deadline)
+                results = self._await_client_results(
+                    deadline, expected_id=reply.tool_call.tool_call_id
+                )
                 if results is None:
                     yield ServerMessage(
                         type="error",
@@ -331,12 +394,24 @@ class Conversation:
         turns.append(Turn(role="tool", content=outcome.content, tool_call_id=call_id))
         return turns, None, None
 
-    def _await_client_results(self, deadline: float) -> Optional[list[ToolResult]]:
-        timeout = min(CLIENT_TOOL_TIMEOUT_S, max(0.1, deadline - time.monotonic()))
-        try:
-            return self._client_results.get(timeout=timeout)
-        except queue.Empty:
-            return None
+    def _await_client_results(
+        self, deadline: float, expected_id: str = ""
+    ) -> Optional[list[ToolResult]]:
+        """Wait for results for THIS call; stale batches (wrong or missing
+        tool_call_id from an earlier timed-out call) are discarded and the
+        wait continues with the remaining budget."""
+        stop_at = min(time.monotonic() + CLIENT_TOOL_TIMEOUT_S, deadline)
+        while True:
+            timeout = stop_at - time.monotonic()
+            if timeout <= 0:
+                return None
+            try:
+                results = self._client_results.get(timeout=timeout)
+            except queue.Empty:
+                return None
+            if not expected_id or any(r.tool_call_id == expected_id for r in results):
+                return results
+            # stale batch: drop and keep waiting
 
     def _check_response_format(self, text: str, response_format: dict) -> Optional[str]:
         kind = response_format.get("type")

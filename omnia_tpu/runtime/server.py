@@ -75,6 +75,7 @@ class RuntimeServer:
         self._conv_lock = threading.Lock()
         self._grpc_server: Optional[grpc.Server] = None
         self.port: Optional[int] = None
+        self._ready = threading.Event()
 
     # ------------------------------------------------------------------
 
@@ -132,6 +133,8 @@ class RuntimeServer:
                 for m in request_iterator:
                     if m.type == "tool_results":
                         conv.provide_tool_results(m.tool_results)
+                    elif m.type == "cancel":
+                        conv.cancel_turn()  # interrupt the in-flight turn
                     else:
                         inbox.put(m)
             except Exception:  # stream broken: unblock the writer
@@ -145,8 +148,6 @@ class RuntimeServer:
             m = inbox.get()
             if m is None:
                 return
-            if m.type == "cancel":
-                continue
             try:
                 yield from conv.stream(m)
             except Exception as e:  # turn must not kill the stream silently
@@ -202,9 +203,17 @@ class RuntimeServer:
 
     def health(self, request, context):
         engine = self.engine
-        healthy = getattr(engine, "healthy", lambda: True)()
+        # Capability-gate honesty: not ready until every serving shape is
+        # compiled and the engine loop is running (no compile, no stall on
+        # the request path).
+        if not self._ready.is_set():
+            status = "initializing"
+        elif getattr(engine, "healthy", lambda: True)():
+            status = "ok"
+        else:
+            status = "unhealthy"
         return c.HealthResponse(
-            status="ok" if healthy else "unhealthy",
+            status=status,
             contract_version=c.CONTRACT_VERSION,
             capabilities=self.capabilities,
             model=self.spec.model,
@@ -253,15 +262,38 @@ class RuntimeServer:
         }
         return grpc.method_handlers_generic_handler(c.SERVICE_NAME, handlers)
 
-    def serve(self, address: str = "localhost:0", max_workers: int = 32) -> int:
-        """Start the server; returns the bound port."""
+    def serve(
+        self, address: str = "localhost:0", max_workers: int = 32, wait_ready: bool = True
+    ) -> int:
+        """Start the server; returns the bound port.
+
+        Engine bring-up (warmup compiles + loop thread) happens before the
+        ready flag flips — Health reports "initializing" until then. With
+        wait_ready=False bring-up runs in the background (operator-style
+        capability gating decides when to route traffic)."""
         server = grpc.server(futures.ThreadPoolExecutor(max_workers=max_workers))
         server.add_generic_rpc_handlers((self._generic_handler(),))
         self.port = server.add_insecure_port(address)
         server.start()
         self._grpc_server = server
+
+        def bring_up():
+            engine = self.engine  # builds (and shards) the model
+            try:
+                engine.warmup()
+            finally:
+                engine.start()
+                self._ready.set()
+
+        if wait_ready:
+            bring_up()
+        else:
+            threading.Thread(target=bring_up, daemon=True).start()
         logger.info("runtime serving on port %d", self.port)
         return self.port
+
+    def wait_ready(self, timeout: float = 600.0) -> bool:
+        return self._ready.wait(timeout)
 
     def shutdown(self, grace: float = 5.0):
         if self._grpc_server is not None:

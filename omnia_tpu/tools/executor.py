@@ -164,10 +164,8 @@ class ToolExecutor:
                 result = self._dispatch(handler, arguments, context or {})
                 breaker.record(True)
                 return result
-            except _FatalError as e:
-                breaker.record(False)
-                return ToolOutcome(str(e), is_error=True)
-            except (_RetryableError, Exception) as e:  # noqa: BLE001
+            except _RetryableError as e:
+                # Only classified-transient failures retry (transport, 5xx).
                 breaker.record(False)
                 attempt += 1
                 if attempt > self._max_retries:
@@ -176,6 +174,10 @@ class ToolExecutor:
                         is_error=True,
                     )
                 time.sleep(min(0.1 * 2**attempt, 2.0))
+            except Exception as e:  # deterministic failure: never re-run
+                # side effects for an error a retry cannot fix
+                breaker.record(False)
+                return ToolOutcome(f"tool {name} failed: {e}", is_error=True)
 
     # ------------------------------------------------------------------
 

@@ -1,0 +1,145 @@
+"""Prometheus-style metrics registry with text exposition.
+
+Naming convention matches the reference platform: `omnia_<service>_*`
+(reference pkg/metrics + per-service metrics files; discovery by a port
+named "metrics"). Implemented fresh and dependency-free: counters, gauges,
+histograms with the classic exposition format served from each service's
+health endpoint.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Optional, Sequence
+
+_DEFAULT_BUCKETS = (0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0)
+
+
+def _fmt_labels(labels: dict[str, str]) -> str:
+    if not labels:
+        return ""
+    inner = ",".join(f'{k}="{v}"' for k, v in sorted(labels.items()))
+    return "{" + inner + "}"
+
+
+class Counter:
+    def __init__(self, name: str, help_: str = ""):
+        self.name = name
+        self.help = help_
+        self._values: dict[tuple, float] = {}
+        self._lock = threading.Lock()
+
+    def inc(self, amount: float = 1.0, **labels: str) -> None:
+        key = tuple(sorted(labels.items()))
+        with self._lock:
+            self._values[key] = self._values.get(key, 0.0) + amount
+
+    def value(self, **labels: str) -> float:
+        return self._values.get(tuple(sorted(labels.items())), 0.0)
+
+    def expose(self) -> list[str]:
+        lines = [f"# TYPE {self.name} counter"]
+        for key, v in sorted(self._values.items()):
+            lines.append(f"{self.name}{_fmt_labels(dict(key))} {v}")
+        return lines
+
+
+class Gauge:
+    def __init__(self, name: str, help_: str = "", fn: Optional[Callable[[], float]] = None):
+        self.name = name
+        self.help = help_
+        self._fn = fn
+        self._value = 0.0
+        self._lock = threading.Lock()
+
+    def set(self, v: float) -> None:
+        with self._lock:
+            self._value = v
+
+    def add(self, v: float) -> None:
+        with self._lock:
+            self._value += v
+
+    def value(self) -> float:
+        return self._fn() if self._fn is not None else self._value
+
+    def expose(self) -> list[str]:
+        return [f"# TYPE {self.name} gauge", f"{self.name} {self.value()}"]
+
+
+class Histogram:
+    def __init__(self, name: str, help_: str = "", buckets: Sequence[float] = _DEFAULT_BUCKETS):
+        self.name = name
+        self.help = help_
+        self.buckets = tuple(sorted(buckets))
+        self._counts = [0] * (len(self.buckets) + 1)
+        self._sum = 0.0
+        self._lock = threading.Lock()
+
+    def observe(self, v: float) -> None:
+        with self._lock:
+            self._sum += v
+            for i, b in enumerate(self.buckets):
+                if v <= b:
+                    self._counts[i] += 1
+                    return
+            self._counts[-1] += 1
+
+    @property
+    def count(self) -> int:
+        return sum(self._counts)
+
+    def quantile(self, q: float) -> float:
+        """Approximate quantile from bucket counts (upper bound)."""
+        total = self.count
+        if total == 0:
+            return 0.0
+        target = q * total
+        cum = 0
+        for i, b in enumerate(self.buckets):
+            cum += self._counts[i]
+            if cum >= target:
+                return b
+        return float("inf")
+
+    def expose(self) -> list[str]:
+        lines = [f"# TYPE {self.name} histogram"]
+        cum = 0
+        for i, b in enumerate(self.buckets):
+            cum += self._counts[i]
+            lines.append(f'{self.name}_bucket{{le="{b}"}} {cum}')
+        cum += self._counts[-1]
+        lines.append(f'{self.name}_bucket{{le="+Inf"}} {cum}')
+        lines.append(f"{self.name}_sum {self._sum}")
+        lines.append(f"{self.name}_count {cum}")
+        return lines
+
+
+class Registry:
+    def __init__(self, prefix: str = "omnia"):
+        self.prefix = prefix
+        self._metrics: dict[str, object] = {}
+        self._lock = threading.Lock()
+
+    def counter(self, name: str, help_: str = "") -> Counter:
+        return self._get_or_make(name, lambda n: Counter(n, help_))
+
+    def gauge(self, name: str, help_: str = "", fn=None) -> Gauge:
+        return self._get_or_make(name, lambda n: Gauge(n, help_, fn))
+
+    def histogram(self, name: str, help_: str = "", buckets=_DEFAULT_BUCKETS) -> Histogram:
+        return self._get_or_make(name, lambda n: Histogram(n, help_, buckets))
+
+    def _get_or_make(self, name: str, make):
+        full = f"{self.prefix}_{name}"
+        with self._lock:
+            m = self._metrics.get(full)
+            if m is None:
+                m = self._metrics[full] = make(full)
+            return m
+
+    def expose(self) -> str:
+        lines: list[str] = []
+        for m in self._metrics.values():
+            lines.extend(m.expose())
+        return "\n".join(lines) + "\n"

@@ -1,0 +1,62 @@
+"""Keyed token-bucket rate limiting (facade connections, API clients).
+
+Same role as the reference's pkg/ratelimit KeyedLimiter: per-key buckets
+with lazy refill, O(1) per check, periodic garbage collection of idle keys.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+
+class TokenBucket:
+    __slots__ = ("rate", "burst", "tokens", "last")
+
+    def __init__(self, rate: float, burst: float):
+        self.rate = rate
+        self.burst = burst
+        self.tokens = burst
+        self.last = time.monotonic()
+
+    def allow(self, cost: float = 1.0) -> bool:
+        now = time.monotonic()
+        self.tokens = min(self.burst, self.tokens + (now - self.last) * self.rate)
+        self.last = now
+        if self.tokens >= cost:
+            self.tokens -= cost
+            return True
+        return False
+
+
+class KeyedLimiter:
+    """Per-key token buckets (key = connection id, client IP, ...)."""
+
+    def __init__(self, rate: float, burst: float, gc_after_s: float = 300.0):
+        self.rate = rate
+        self.burst = burst
+        self.gc_after_s = gc_after_s
+        self._buckets: dict[str, TokenBucket] = {}
+        self._lock = threading.Lock()
+        self._last_gc = time.monotonic()
+
+    def allow(self, key: str, cost: float = 1.0) -> bool:
+        with self._lock:
+            self._maybe_gc()
+            bucket = self._buckets.get(key)
+            if bucket is None:
+                bucket = self._buckets[key] = TokenBucket(self.rate, self.burst)
+            return bucket.allow(cost)
+
+    def forget(self, key: str) -> None:
+        with self._lock:
+            self._buckets.pop(key, None)
+
+    def _maybe_gc(self) -> None:
+        now = time.monotonic()
+        if now - self._last_gc < self.gc_after_s:
+            return
+        dead = [k for k, b in self._buckets.items() if now - b.last > self.gc_after_s]
+        for k in dead:
+            del self._buckets[k]
+        self._last_gc = now

@@ -1,0 +1,277 @@
+"""Facade e2e: real WebSocket client → FacadeServer → runtime gRPC →
+mock engine, all in one process over localhost (reference integration
+pattern)."""
+
+import http.server
+import json
+import threading
+import time
+
+import pytest
+from websockets.exceptions import ConnectionClosed
+from websockets.sync.client import connect
+
+from omnia_tpu.facade.auth import AuthChain, ClientKeyValidator, HmacValidator
+from omnia_tpu.facade.recording import RecordingInterceptor
+from omnia_tpu.facade.server import FacadeServer
+from omnia_tpu.runtime.packs import load_pack
+from omnia_tpu.runtime.providers import ProviderRegistry, ProviderSpec
+from omnia_tpu.runtime.server import RuntimeServer
+from omnia_tpu.tools import ToolExecutor, ToolHandler
+
+PACK = {
+    "name": "ws-agent",
+    "version": "1.0.0",
+    "prompts": {"system": "You are an assistant."},
+    "tools": [
+        {"name": "echo"},
+        {"name": "lookup", "client_side": True},
+    ],
+    "sampling": {"temperature": 0.0, "max_tokens": 256},
+}
+
+SCENARIOS = [
+    {"pattern": r"\[TOOL\]client data", "reply": "got your data"},
+    {
+        "pattern": "clienttool",
+        "reply": '<tool_call>{"name": "lookup", "arguments": {"k": "v"}}</tool_call>',
+    },
+    {"pattern": "hello", "reply": "hi there"},
+    {"pattern": "slow", "reply": "s l o w", "delay_per_token_s": 0.02},
+]
+
+
+@pytest.fixture(scope="module")
+def record_sink():
+    records = []
+
+    class Handler(http.server.BaseHTTPRequestHandler):
+        def do_POST(self):
+            body = self.rfile.read(int(self.headers.get("Content-Length", 0)))
+            records.append((self.path, json.loads(body)))
+            self.send_response(204)
+            self.end_headers()
+
+        def log_message(self, *args):
+            pass
+
+    server = http.server.ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    yield server.server_address[1], records
+    server.shutdown()
+
+
+@pytest.fixture(scope="module")
+def stack(record_sink):
+    sink_port, _ = record_sink
+    registry = ProviderRegistry()
+    registry.register(ProviderSpec(name="main", type="mock", options={"scenarios": SCENARIOS}))
+    runtime = RuntimeServer(
+        pack=load_pack(PACK),
+        providers=registry,
+        provider_name="main",
+        tool_executor=ToolExecutor(
+            [
+                ToolHandler(name="echo", fn=lambda a: "echoed"),
+                ToolHandler(name="lookup", type="client"),
+            ]
+        ),
+    )
+    rport = runtime.serve("localhost:0")
+    facade = FacadeServer(
+        runtime_target=f"localhost:{rport}",
+        agent_name="ws-agent",
+        auth_chain=AuthChain(
+            [ClientKeyValidator({"key1": "secret-abc"}), HmacValidator(b"mgmt-secret")]
+        ),
+        recording=RecordingInterceptor(f"http://127.0.0.1:{sink_port}"),
+        messages_per_minute=600,
+    )
+    fport = facade.serve()
+    yield facade, fport
+    facade.shutdown()
+    runtime.shutdown()
+
+
+def _url(port, **params):
+    q = "&".join(f"{k}={v}" for k, v in params.items())
+    return f"ws://localhost:{port}/ws" + (f"?{q}" if q else "")
+
+
+def _recv_until(ws, types, timeout=15):
+    got = []
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        msg = json.loads(ws.recv(timeout=deadline - time.monotonic()))
+        got.append(msg)
+        if msg["type"] in types:
+            return got
+    raise TimeoutError(f"never saw {types}, got {got}")
+
+
+class TestFacade:
+    def test_unauthorized_rejected(self, stack):
+        _, port = stack
+        with pytest.raises(ConnectionClosed) as exc:
+            ws = connect(_url(port, token="wrong"))
+            ws.recv(timeout=5)
+        assert exc.value.rcvd.code == 4401
+
+    def test_turn_streams(self, stack):
+        _, port = stack
+        with connect(_url(port, token="secret-abc")) as ws:
+            connected = json.loads(ws.recv(timeout=10))
+            assert connected["type"] == "connected"
+            assert connected["agent"] == "ws-agent"
+            assert not connected["resumed"]
+            assert "streaming" in connected["capabilities"]
+
+            ws.send(json.dumps({"type": "message", "content": "hello facade"}))
+            msgs = _recv_until(ws, {"done", "error"})
+            text = "".join(m["text"] for m in msgs if m["type"] == "chunk")
+            assert text == "hi there"
+            assert msgs[-1]["type"] == "done"
+            assert msgs[-1]["usage"]["completion_tokens"] > 0
+
+    def test_mgmt_jwt_auth(self, stack):
+        _, port = stack
+        token = HmacValidator.mint(b"mgmt-secret", subject="dashboard")
+        with connect(_url(port, token=token)) as ws:
+            assert json.loads(ws.recv(timeout=10))["type"] == "connected"
+
+    def test_resume_same_session(self, stack):
+        _, port = stack
+        with connect(_url(port, token="secret-abc", session="ws-resume-1")) as ws:
+            assert not json.loads(ws.recv(timeout=10))["resumed"]
+            ws.send(json.dumps({"type": "message", "content": "hello"}))
+            _recv_until(ws, {"done", "error"})
+            ws.send(json.dumps({"type": "hangup"}))
+        with connect(_url(port, token="secret-abc", session="ws-resume-1")) as ws:
+            connected = json.loads(ws.recv(timeout=10))
+            assert connected["resumed"]
+            assert connected["session_id"] == "ws-resume-1"
+
+    def test_client_tool_roundtrip(self, stack):
+        _, port = stack
+        with connect(_url(port, token="secret-abc")) as ws:
+            ws.recv(timeout=10)
+            ws.send(json.dumps({"type": "message", "content": "clienttool now"}))
+            msgs = _recv_until(ws, {"tool_call"})
+            tc = msgs[-1]
+            assert tc["name"] == "lookup"
+            ws.send(
+                json.dumps(
+                    {
+                        "type": "tool_result",
+                        "tool_call_id": tc["id"],
+                        "content": "client data",
+                    }
+                )
+            )
+            msgs = _recv_until(ws, {"done", "error"})
+            text = "".join(m["text"] for m in msgs if m["type"] == "chunk")
+            assert text == "got your data"
+
+    def test_bad_json_reported(self, stack):
+        _, port = stack
+        with connect(_url(port, token="secret-abc")) as ws:
+            ws.recv(timeout=10)
+            ws.send("{{{nope")
+            msg = json.loads(ws.recv(timeout=10))
+            assert msg["type"] == "error"
+            assert msg["code"] == "bad_json"
+
+    def test_unexpected_tool_result(self, stack):
+        _, port = stack
+        with connect(_url(port, token="secret-abc")) as ws:
+            ws.recv(timeout=10)
+            ws.send(json.dumps({"type": "tool_result", "tool_call_id": "x", "content": "y"}))
+            msg = json.loads(ws.recv(timeout=10))
+            assert msg["code"] == "unexpected_tool_result"
+
+    def test_recording_captures_both_sides(self, stack, record_sink):
+        _, port = stack
+        _, records = record_sink
+        before = len(records)
+        with connect(_url(port, token="secret-abc", user="u-rec")) as ws:
+            ws.recv(timeout=10)
+            ws.send(json.dumps({"type": "message", "content": "hello recorder"}))
+            _recv_until(ws, {"done", "error"})
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline and len(records) < before + 2:
+            time.sleep(0.05)
+        new = [r for _, r in records[before:]]
+        roles = [r["role"] for r in new if r.get("kind") == "message"]
+        assert "user" in roles and "assistant" in roles
+        assistant = next(r for r in new if r.get("role") == "assistant")
+        assert assistant["usage"]["completion_tokens"] > 0
+
+    def test_health_and_metrics_endpoints(self, stack):
+        facade, _ = stack
+        import urllib.request
+
+        base = f"http://localhost:{facade.health_port}"
+        assert urllib.request.urlopen(base + "/healthz").status == 200
+        assert urllib.request.urlopen(base + "/readyz").status == 200
+        body = urllib.request.urlopen(base + "/metrics").read().decode()
+        assert "omnia_facade_connections_active" in body
+        assert "omnia_facade_turn_seconds_bucket" in body
+
+    def test_rate_limit_closes(self, record_sink):
+        sink_port, _ = record_sink
+        registry = ProviderRegistry()
+        registry.register(
+            ProviderSpec(name="main", type="mock", options={"scenarios": SCENARIOS})
+        )
+        runtime = RuntimeServer(
+            pack=load_pack(PACK), providers=registry, provider_name="main"
+        )
+        rport = runtime.serve("localhost:0")
+        facade = FacadeServer(
+            runtime_target=f"localhost:{rport}", messages_per_minute=0.0001
+        )
+        port = facade.serve()
+        try:
+            with pytest.raises(ConnectionClosed) as exc:
+                ws = connect(_url(port))
+                ws.recv(timeout=10)
+                for i in range(15):  # burst allows 10
+                    ws.send(json.dumps({"type": "message", "content": "hello"}))
+                    while True:
+                        m = json.loads(ws.recv(timeout=10))
+                        if m["type"] in ("done", "error"):
+                            break
+            assert exc.value.rcvd.code == 4429
+        finally:
+            facade.shutdown()
+            runtime.shutdown()
+
+    def test_drain_rejects_new_and_reports_unready(self, record_sink):
+        registry = ProviderRegistry()
+        registry.register(
+            ProviderSpec(name="main", type="mock", options={"scenarios": SCENARIOS})
+        )
+        runtime = RuntimeServer(
+            pack=load_pack(PACK), providers=registry, provider_name="main"
+        )
+        rport = runtime.serve("localhost:0")
+        facade = FacadeServer(runtime_target=f"localhost:{rport}", drain_timeout_s=0.5)
+        port = facade.serve()
+        try:
+            import urllib.request
+
+            threading.Thread(target=facade.drain, daemon=True).start()
+            time.sleep(0.1)
+            resp = urllib.request.urlopen(
+                f"http://localhost:{facade.health_port}/readyz"
+            )
+        except urllib.error.HTTPError as e:
+            assert e.code == 503
+        else:
+            pytest.fail(f"readyz should 503 while draining, got {resp.status}")
+        finally:
+            with pytest.raises(ConnectionClosed):
+                ws = connect(_url(port))
+                ws.recv(timeout=5)
+            facade.shutdown()
+            runtime.shutdown()

@@ -1,0 +1,100 @@
+"""Unit tests: auth chain, rate limiter, metrics registry."""
+
+import time
+
+from omnia_tpu.facade.auth import (
+    AllowAll,
+    AuthChain,
+    ClientKeyValidator,
+    HmacValidator,
+    SharedTokenValidator,
+)
+from omnia_tpu.utils.metrics import Registry
+from omnia_tpu.utils.ratelimit import KeyedLimiter
+
+
+class TestAuth:
+    def test_client_key(self):
+        v = ClientKeyValidator({"web": "s3cret"})
+        assert v.validate("s3cret").subject == "web"
+        assert v.validate("wrong") is None
+        assert v.validate("") is None
+
+    def test_shared_token(self):
+        v = SharedTokenValidator("tok", subject="doctor")
+        assert v.validate("tok").subject == "doctor"
+        assert v.validate("nope") is None
+
+    def test_hmac_jwt_roundtrip(self):
+        secret = b"k"
+        tok = HmacValidator.mint(secret, "dash", audience="mgmt", ttl_s=60)
+        v = HmacValidator(secret, audience="mgmt")
+        p = v.validate(tok)
+        assert p.subject == "dash" and p.method == "hmac_jwt"
+
+    def test_hmac_jwt_wrong_audience(self):
+        tok = HmacValidator.mint(b"k", "dash", audience="other")
+        assert HmacValidator(b"k", audience="mgmt").validate(tok) is None
+
+    def test_hmac_jwt_expired(self):
+        tok = HmacValidator.mint(b"k", "dash", ttl_s=-10)
+        assert HmacValidator(b"k").validate(tok) is None
+
+    def test_hmac_jwt_tampered(self):
+        tok = HmacValidator.mint(b"k", "dash")
+        head, payload, sig = tok.split(".")
+        assert HmacValidator(b"k").validate(f"{head}.{payload}x.{sig}") is None
+        assert HmacValidator(b"other").validate(tok) is None
+
+    def test_chain_order_and_fail_closed(self):
+        chain = AuthChain([ClientKeyValidator({"a": "ka"})])
+        assert chain.authenticate("ka").method == "client_key"
+        assert chain.authenticate("nope") is None
+        assert AuthChain([]).authenticate("anything") is None
+        assert AuthChain([AllowAll()]).authenticate("").method == "anonymous"
+
+
+class TestRateLimit:
+    def test_burst_then_block(self):
+        lim = KeyedLimiter(rate=0.0001, burst=3)
+        assert all(lim.allow("k") for _ in range(3))
+        assert not lim.allow("k")
+        assert lim.allow("other")  # independent key
+
+    def test_refill(self):
+        lim = KeyedLimiter(rate=50, burst=1)
+        assert lim.allow("k")
+        assert not lim.allow("k")
+        time.sleep(0.05)
+        assert lim.allow("k")
+
+
+class TestMetrics:
+    def test_counter_labels(self):
+        r = Registry("t")
+        c = r.counter("reqs")
+        c.inc()
+        c.inc(2, code="500")
+        out = r.expose()
+        assert "t_reqs 1.0" in out
+        assert 't_reqs{code="500"} 2.0' in out
+
+    def test_gauge_fn(self):
+        r = Registry("t")
+        r.gauge("depth", fn=lambda: 7)
+        assert "t_depth 7" in r.expose()
+
+    def test_histogram_buckets_and_quantile(self):
+        r = Registry("t")
+        h = r.histogram("lat", buckets=(0.1, 1.0, 10.0))
+        for v in (0.05, 0.05, 0.5, 2.0):
+            h.observe(v)
+        assert h.count == 4
+        assert h.quantile(0.5) == 0.1
+        out = r.expose()
+        assert 't_lat_bucket{le="+Inf"} 4' in out
+        assert "t_lat_count 4" in out
+
+    def test_same_metric_returned(self):
+        r = Registry("t")
+        assert r.counter("x") is r.counter("x")
