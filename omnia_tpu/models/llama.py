@@ -29,6 +29,7 @@ from jax.sharding import PartitionSpec as P
 
 from omnia_tpu.models.config import ModelConfig
 from omnia_tpu.ops.attention import gqa_attention
+from omnia_tpu.ops.moe import moe_mlp
 from omnia_tpu.ops.norms import rms_norm
 from omnia_tpu.ops.rope import apply_rope, rope_cos_sin
 
@@ -142,25 +143,10 @@ def _dense_mlp(h, p):
 
 
 def _moe_mlp(h, p, cfg: ModelConfig):
-    """Mixtral MoE. v1 computes every expert and combines with router weights
-    masked to the top-k (exact; ~E/k extra FLOPs). Capacity-based sorted
-    dispatch is the planned optimization once the serving path is profiled.
-    """
-    E, K = cfg.num_experts, cfg.num_experts_per_tok
-    router_logits = jnp.dot(h, p["router"]).astype(jnp.float32)  # [B,T,E]
-    probs = jax.nn.softmax(router_logits, axis=-1)
-    top_w, top_i = jax.lax.top_k(probs, K)  # [B,T,K]
-    top_w = top_w / top_w.sum(axis=-1, keepdims=True)
-    combine = jnp.zeros_like(probs)  # [B,T,E]
-    combine = jnp.sum(jax.nn.one_hot(top_i, E, dtype=probs.dtype) * top_w[..., None], axis=-2)
-
-    # All-expert compute, expert dim sharded over "tp" (expert parallelism):
-    # each device computes its experts for all tokens; the combine einsum
-    # reduces over E, which GSPMD turns into a psum over the tp axis.
-    gate = jnp.einsum("btd,edf->betf", h, p["wg"])
-    up = jnp.einsum("btd,edf->betf", h, p["wu"])
-    expert_out = jnp.einsum("betf,efd->betd", jax.nn.silu(gate) * up, p["wd"])
-    return jnp.einsum("bte,betd->btd", combine.astype(h.dtype), expert_out)
+    """Mixtral MoE — routing + dispatch live in ops/moe.py. Decode-sized
+    token counts take the exact all-expert path; prefill/train token counts
+    take GShard-style capacity dispatch (experts sharded over "tp")."""
+    return moe_mlp(h, p, cfg.num_experts_per_tok)
 
 
 def _write_kv(cache, new, start):

@@ -1,6 +1,7 @@
 from omnia_tpu.ops.norms import rms_norm
 from omnia_tpu.ops.rope import rope_cos_sin, apply_rope
 from omnia_tpu.ops.attention import gqa_attention
+from omnia_tpu.ops.moe import moe_dense, moe_dispatch, moe_mlp, route_topk
 from omnia_tpu.ops.sampling import sample_tokens, sample_tokens_per_slot
 
 __all__ = [
@@ -8,6 +9,10 @@ __all__ = [
     "rope_cos_sin",
     "apply_rope",
     "gqa_attention",
+    "moe_dense",
+    "moe_dispatch",
+    "moe_mlp",
+    "route_topk",
     "sample_tokens",
     "sample_tokens_per_slot",
 ]

@@ -1,0 +1,108 @@
+"""Mixture-of-experts routing and capacity-based dispatch.
+
+The reference has no on-device models at all (its "Mixtral" is a string on a
+Provider CR routed to a SaaS API — reference api/v1alpha1/provider_types.go,
+agentruntime_types.go:382-414). Here MoE executes on the chip, so dispatch
+efficiency is ours to win. Two interchangeable implementations, both exact
+on the tokens they serve:
+
+- ``moe_dense``: compute every expert, combine with top-k-masked router
+  weights. No token ever drops; ~E/k redundant FLOPs. Right choice for tiny
+  token counts (serving decode: a handful of slots) where the dispatch
+  bookkeeping would dominate and dropped tokens are unacceptable.
+- ``moe_dispatch``: GShard-style capacity dispatch. One-hot dispatch/combine
+  tensors are built with cumsum position bookkeeping; the gather, expert
+  FFN, and scatter are all einsums, so the whole path is static-shaped and
+  MXU-eligible. Tokens past an expert's capacity contribute zero (standard
+  capacity-drop semantics); use capacity_factor ≥ ~2 at small batch.
+
+Sharding: expert-leading weights [E, d, f] shard E over the "tp" axis
+(expert parallelism). In ``moe_dispatch`` the dispatch einsum produces
+[E, C, d] sharded over E; each device runs only its experts' FFNs; the
+combine einsum reduces over E and GSPMD inserts the psum. This is
+all-to-all-free EP (activations are replicated over tp, which is the right
+trade at serving batch sizes; token-sharded a2a dispatch is the large-batch
+training variant).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def route_topk(h, router_w, num_experts_per_tok: int):
+    """Router: h [..., d] × router_w [d, E] → combine weights [..., E].
+
+    Top-k probabilities renormalized to sum 1, zero elsewhere (Mixtral
+    semantics: softmax over all experts, then keep-and-renormalize top-k).
+    """
+    E = router_w.shape[-1]
+    logits = jnp.dot(h, router_w).astype(jnp.float32)
+    probs = jax.nn.softmax(logits, axis=-1)
+    top_w, top_i = jax.lax.top_k(probs, num_experts_per_tok)
+    top_w = top_w / top_w.sum(axis=-1, keepdims=True)
+    combine = jnp.sum(
+        jax.nn.one_hot(top_i, E, dtype=probs.dtype) * top_w[..., None], axis=-2
+    )
+    return combine  # [..., E]
+
+
+def moe_dense(h, p, num_experts_per_tok: int):
+    """All-expert MoE: exact, no drops, ~E/k extra FLOPs. h: [B, T, d]."""
+    combine = route_topk(h, p["router"], num_experts_per_tok)  # [B,T,E]
+    gate = jnp.einsum("btd,edf->betf", h, p["wg"])
+    up = jnp.einsum("btd,edf->betf", h, p["wu"])
+    expert_out = jnp.einsum("betf,efd->betd", jax.nn.silu(gate) * up, p["wd"])
+    return jnp.einsum("bte,betd->btd", combine.astype(h.dtype), expert_out)
+
+
+def moe_dispatch(h, p, num_experts_per_tok: int, capacity_factor: float = 2.0):
+    """Capacity-based dispatched MoE. h: [B, T, d] → [B, T, d].
+
+    FLOPs scale with k/E of the dense path plus dispatch einsums. Tokens
+    beyond an expert's capacity C = ceil(N·k/E · capacity_factor) are
+    dropped (their combine weight contributes nothing), matching GShard.
+    """
+    B, T, d = h.shape
+    E = p["router"].shape[-1]
+    K = num_experts_per_tok
+    N = B * T
+    capacity = max(1, int(-(-N * K * capacity_factor // E)))  # ceil
+
+    flat = h.reshape(N, d)
+    combine_e = route_topk(flat, p["router"], K)  # [N, E] renormalized top-k
+    chosen = (combine_e > 0).astype(jnp.float32)  # [N, E]
+
+    # Position of each token within its expert's buffer (tokens in index
+    # order; cumsum is cheap and static-shaped).
+    pos_in_expert = jnp.cumsum(chosen, axis=0) * chosen - 1.0  # [N, E], -1 if unchosen
+    within = (pos_in_expert >= 0) & (pos_in_expert < capacity)
+    pos_clipped = jnp.clip(pos_in_expert, 0, capacity - 1).astype(jnp.int32)
+
+    # dispatch[n, e, c] = 1 iff token n sits in slot c of expert e
+    pos_onehot = jax.nn.one_hot(pos_clipped, capacity, dtype=flat.dtype)  # [N,E,C]
+    dispatch = pos_onehot * within.astype(flat.dtype)[..., None]
+    combine = dispatch * combine_e.astype(flat.dtype)[..., None]  # [N,E,C]
+
+    xs = jnp.einsum("nec,nd->ecd", dispatch, flat)  # [E, C, d] gather
+    gate = jnp.einsum("ecd,edf->ecf", xs, p["wg"])
+    up = jnp.einsum("ecd,edf->ecf", xs, p["wu"])
+    ys = jnp.einsum("ecf,efd->ecd", jax.nn.silu(gate) * up, p["wd"])
+    out = jnp.einsum("nec,ecd->nd", combine, ys)  # scatter+weight (psum over E)
+    return out.reshape(B, T, d)
+
+
+# Below this many tokens the dense path is both faster (no dispatch
+# bookkeeping) and safer (zero drops); above it, dispatched FLOPs win.
+DISPATCH_MIN_TOKENS = 64
+
+
+def moe_mlp(h, p, num_experts_per_tok: int, capacity_factor: float = 2.0):
+    """Shape-static auto-selection: decode-sized inputs go dense, prefill/train
+    inputs go dispatched. The branch is on the *traced shape*, so each
+    compiled program contains exactly one implementation."""
+    B, T, _ = h.shape
+    if B * T < DISPATCH_MIN_TOKENS:
+        return moe_dense(h, p, num_experts_per_tok)
+    return moe_dispatch(h, p, num_experts_per_tok, capacity_factor)

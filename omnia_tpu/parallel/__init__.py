@@ -1,4 +1,11 @@
-from omnia_tpu.parallel.mesh import make_mesh
+from omnia_tpu.parallel.mesh import make_mesh, single_device_mesh
 from omnia_tpu.parallel.sharding import shard_pytree, named_sharding_tree
+from omnia_tpu.parallel.ring_attention import ring_attention
 
-__all__ = ["make_mesh", "shard_pytree", "named_sharding_tree"]
+__all__ = [
+    "make_mesh",
+    "single_device_mesh",
+    "shard_pytree",
+    "named_sharding_tree",
+    "ring_attention",
+]

@@ -23,18 +23,31 @@ import numpy as np
 from jax.sharding import Mesh
 
 
-def make_mesh(dp: int = 1, tp: int = 1, devices: Optional[Sequence] = None) -> Mesh:
+def make_mesh(
+    dp: int = 1,
+    tp: int = 1,
+    sp: int = 1,
+    devices: Optional[Sequence] = None,
+) -> Mesh:
+    """Build a ("dp", "tp") mesh, or ("dp", "sp", "tp") when sp > 1.
+
+    "sp" (sequence/context parallel — ring attention) sits between dp and
+    tp so that the ring ppermute hops between ICI neighbors: consecutive
+    devices differ in the sp coordinate while sharing the dp coordinate.
+    """
     devices = list(devices if devices is not None else jax.devices())
-    n = dp * tp
+    n = dp * tp * sp
     if len(devices) < n:
-        raise ValueError(f"mesh {dp}x{tp} needs {n} devices, have {len(devices)}")
+        raise ValueError(f"mesh {dp}x{sp}x{tp} needs {n} devices, have {len(devices)}")
+    shape = (dp, sp, tp) if sp > 1 else (dp, tp)
+    names = ("dp", "sp", "tp") if sp > 1 else ("dp", "tp")
     try:
         from jax.experimental import mesh_utils
 
-        dev_array = mesh_utils.create_device_mesh((dp, tp), devices=devices[:n])
+        dev_array = mesh_utils.create_device_mesh(shape, devices=devices[:n])
     except Exception:
-        dev_array = np.array(devices[:n]).reshape(dp, tp)
-    return Mesh(dev_array, ("dp", "tp"))
+        dev_array = np.array(devices[:n]).reshape(shape)
+    return Mesh(dev_array, names)
 
 
 def single_device_mesh() -> Mesh:

@@ -1,0 +1,129 @@
+"""Ring attention: causal sequence/context parallelism over an "sp" mesh axis.
+
+The reference platform has no sequence dimension at all — long conversations
+are handled by context-store truncation and session compaction (reference
+cmd/runtime/SERVICE.md context table, internal/compaction/engine.go). On TPU
+the long-context path is first-class: queries, keys and values are sharded
+along the sequence axis across the "sp" mesh axis, and key/value blocks
+rotate around the ring via `ppermute` while each device folds every block
+into a numerically-stable online softmax (flash-attention style running
+max / sum / output accumulators, float32).
+
+TPU-first properties:
+
+- One `shard_map` region; the only collectives are the ring `ppermute`s, so
+  communication rides ICI neighbor links and overlaps with the block matmuls
+  (XLA schedules the permute of step j+1 against the compute of step j).
+- Block matmuls keep the [T_local, T_local] score tile large and bf16 on
+  both operands → MXU. Accumulators are f32.
+- GQA is computed without materializing the KV repeat, same as
+  `omnia_tpu.ops.attention.gqa_attention`.
+- Causality across blocks is decided by *global* positions derived from the
+  ring step, so fully-masked future blocks still cost one (cheap, fully
+  masked) block — keeping the loop shape static for XLA. Skipping them is a
+  load-balance optimization (striped layout), not a correctness need.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+_NEG_INF = -1e30
+
+
+def _block_update(q, k, v, q_pos, k_pos, m, l, o):
+    """Fold one K/V block into the running (m, l, o) accumulators.
+
+    q: [B, Tq, Hkv, G, D] bf16 (grouped queries)
+    k, v: [B, Tk, Hkv, D]
+    q_pos, k_pos: int32 [Tq], [Tk] global positions
+    m, l: [B, Hkv, G, Tq] f32 running max / normalizer
+    o: [B, Tq, Hkv, G, D] f32 running (unnormalized) output
+    """
+    D = q.shape[-1]
+    scores = jnp.einsum(
+        "bthgd,bshd->bhgts", q, k, preferred_element_type=jnp.float32
+    ) * (D**-0.5)
+    mask = k_pos[None, :] <= q_pos[:, None]  # [Tq, Tk] causal
+    scores = jnp.where(mask[None, None, None, :, :], scores, _NEG_INF)
+
+    block_m = scores.max(axis=-1)  # [B,Hkv,G,Tq]
+    new_m = jnp.maximum(m, block_m)
+    alpha = jnp.exp(m - new_m)  # rescale old accumulators
+    p = jnp.exp(scores - new_m[..., None])  # [B,Hkv,G,Tq,Tk]
+    new_l = l * alpha + p.sum(axis=-1)
+    pv = jnp.einsum("bhgts,bshd->bthgd", p.astype(v.dtype), v).astype(jnp.float32)
+    new_o = o * jnp.moveaxis(alpha, -1, 1)[..., None] + pv
+    return new_m, new_l, new_o
+
+
+def _ring_attn_local(q, k, v, axis_name: str):
+    """Per-device body. q: [B, Tl, H, D]; k, v: [B, Tl, Hkv, D] (local shards)."""
+    B, Tl, H, D = q.shape
+    Hkv = k.shape[2]
+    G = H // Hkv
+    n = lax.psum(1, axis_name)
+    i = lax.axis_index(axis_name)
+
+    qg = q.reshape(B, Tl, Hkv, G, D)
+    offs = jnp.arange(Tl, dtype=jnp.int32)
+    q_pos = i * Tl + offs
+
+    m0 = jnp.full((B, Hkv, G, Tl), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((B, Hkv, G, Tl), jnp.float32)
+    o0 = jnp.zeros((B, Tl, Hkv, G, D), jnp.float32)
+
+    perm = [(s, (s + 1) % n) for s in range(n)]
+
+    def step(j, carry):
+        m, l, o, kj, vj = carry
+        src = (i - j) % n  # which shard's K/V this device holds at step j
+        k_pos = src * Tl + offs
+        m, l, o = _block_update(qg, kj, vj, q_pos, k_pos, m, l, o)
+        kj = lax.ppermute(kj, axis_name, perm)
+        vj = lax.ppermute(vj, axis_name, perm)
+        return m, l, o, kj, vj
+
+    m, l, o, _, _ = lax.fori_loop(0, n, step, (m0, l0, o0, k, v))
+    # The diagonal block guarantees l > 0 for every causal query.
+    out = o / jnp.moveaxis(l, -1, 1)[..., None]
+    return out.reshape(B, Tl, H, D).astype(q.dtype)
+
+
+def ring_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    mesh: Mesh,
+    seq_axis: str = "sp",
+) -> jnp.ndarray:
+    """Causal ring attention with q/k/v sequence-sharded over `seq_axis`.
+
+    q: [B, T, H, D]; k, v: [B, T, Hkv, D]; T must divide evenly by the
+    `seq_axis` mesh size. Batch rides "dp" and heads ride "tp" when those
+    axes exist in the mesh (pure data parallelism from this op's view).
+    Returns [B, T, H, D] with the same sharding as q.
+    """
+    axes = mesh.axis_names
+    b_ax = "dp" if "dp" in axes else None
+    h_ax = "tp" if "tp" in axes else None
+    qspec = P(b_ax, seq_axis, h_ax, None)
+    kvspec = P(b_ax, seq_axis, h_ax if k.shape[2] > 1 else None, None)
+
+    n = mesh.shape[seq_axis]
+    if q.shape[1] % n:
+        raise ValueError(f"seq len {q.shape[1]} not divisible by {seq_axis}={n}")
+
+    fn = jax.shard_map(
+        functools.partial(_ring_attn_local, axis_name=seq_axis),
+        mesh=mesh,
+        in_specs=(qspec, kvspec, kvspec),
+        out_specs=qspec,
+        check_vma=False,
+    )
+    return fn(q, k, v)
