@@ -11,8 +11,13 @@ auth chain, drain; protocol per api/websocket/asyncapi.yaml). Wire protocol:
            {"type": "chunk", "text"} | {"type": "tool_call", ...}
            {"type": "done", "usage", "finish_reason"} | {"type": "error", "code", "message"}
 
-Close codes: 4401 unauthorized, 4408 client-tool timeout, 4429 rate
-limited, 1013 draining.
+Close codes: 4401 unauthorized, 4403 foreign session, 4408 client-tool
+timeout, 4429 rate limited, 1013 draining, 1000 idle timeout.
+
+Identity: when an auth chain is configured the authenticated principal's
+subject IS the user id (the ?user= hint is only honored in chainless dev
+mode), and session ids are namespaced per user (`u-<subject>-…`) so one
+user can never resume or record into another's session.
 
 Threaded end to end (websockets.sync): one OS thread per connection,
 matching the runtime's thread-per-stream gRPC server — no asyncio/thread
@@ -21,9 +26,11 @@ seam on the token hot path.
 
 from __future__ import annotations
 
+import hashlib
 import http.server
 import json
 import logging
+import re
 import threading
 import urllib.parse
 import uuid
@@ -43,6 +50,9 @@ logger = logging.getLogger(__name__)
 
 CLIENT_TOOL_TIMEOUT_S = 60.0
 RECV_IDLE_TIMEOUT_S = 600.0
+
+# Server-minted session ids: u-<16 hex digest of the owner's subject>-…
+_RESERVED_SESSION_RE = re.compile(r"^u-[0-9a-f]{16}-")
 
 
 class FacadeServer:
@@ -140,11 +150,33 @@ class FacadeServer:
             if principal is None:
                 ws.close(4401, "unauthorized")
                 return
-        user_id = (query.get("user") or [principal.subject if principal else "anon"])[0]
+            # The authenticated subject is authoritative — a client-supplied
+            # ?user= must never override the principal (impersonation).
+            user_id = principal.subject
+        else:
+            user_id = (query.get("user") or ["anon"])[0]
 
         requested_session = (query.get("session") or [""])[0]
+        if self.auth is not None:
+            # Fixed-width digest, not the raw subject: subjects are arbitrary
+            # strings, and a raw-prefix scheme would let subject "a" claim
+            # sessions of subject "a-b" (prefix collision).
+            digest = hashlib.sha256(user_id.encode()).hexdigest()[:16]
+            scope = f"u-{digest}-"
+            if requested_session:
+                # Only SERVER-MINTED ids (u-<16 hex>-…) are ownership-checked;
+                # a client-chosen name that merely starts with "u-" is scoped
+                # like any other handle, not rejected.
+                reserved = _RESERVED_SESSION_RE.match(requested_session)
+                if reserved and not requested_session.startswith(scope):
+                    ws.close(4403, "session belongs to another user")
+                    return
+                if not requested_session.startswith(scope):
+                    requested_session = scope + requested_session
+            session_id = requested_session or f"{scope}sess-{uuid.uuid4().hex[:12]}"
+        else:
+            session_id = requested_session or f"sess-{uuid.uuid4().hex[:12]}"
         resumed = False
-        session_id = requested_session or f"sess-{uuid.uuid4().hex[:12]}"
         if requested_session:
             try:
                 state = self.runtime.has_conversation(requested_session)
@@ -163,11 +195,24 @@ class FacadeServer:
             # NOT_FOUND: keep the requested id, start fresh (client keeps
             # its handle; history is simply gone — the honest outcome).
 
+        # Rate-limit by a key the client cannot rotate: authenticated
+        # principal, else the peer address (session ids are client-chosen).
+        if self.auth is not None:
+            limiter_key = f"user:{user_id}"
+        else:
+            try:
+                limiter_key = f"addr:{ws.remote_address[0]}"
+            except Exception:
+                # Never fall back to a client-chosen value (?user= would let
+                # a client mint fresh buckets); share one anonymous bucket.
+                limiter_key = "addr:unknown"
+
         with self._live_lock:
             self._live.add(ws)
         self._connections_active.add(1)
-        stream = self.runtime.open_stream(session_id, user_id=user_id, agent=self.agent_name)
+        stream = None
         try:
+            stream = self.runtime.open_stream(session_id, user_id=user_id, agent=self.agent_name)
             health = self.runtime.health()
             self._send(ws, {
                 "type": "connected",
@@ -176,24 +221,34 @@ class FacadeServer:
                 "capabilities": health.capabilities,
                 "resumed": resumed,
             })
-            self._connection_loop(ws, stream, session_id, user_id)
+            self._connection_loop(ws, stream, session_id, user_id, limiter_key)
         except ConnectionClosed:
             pass
         except Exception as e:
             logger.exception("connection failed")
             self._try_send(ws, {"type": "error", "code": "internal", "message": str(e)})
         finally:
-            stream.close()
+            if stream is not None:
+                stream.close()
             with self._live_lock:
                 self._live.discard(ws)
             self._connections_active.add(-1)
-            self._limiter.forget(session_id)
+            # limiter buckets are NOT forgotten here: dropping the bucket on
+            # disconnect would let a client reset its budget by reconnecting.
+            # Idle buckets are garbage-collected by the limiter itself.
 
-    def _connection_loop(self, ws, stream, session_id: str, user_id: str) -> None:
+    def _connection_loop(
+        self, ws, stream, session_id: str, user_id: str, limiter_key: str
+    ) -> None:
         import time as _time
 
         while True:
-            raw = ws.recv(timeout=RECV_IDLE_TIMEOUT_S)
+            try:
+                raw = ws.recv(timeout=RECV_IDLE_TIMEOUT_S)
+            except TimeoutError:
+                # Normal idle expiry — clean close, not an internal error.
+                ws.close(1000, "idle timeout")
+                return
             msg = self._parse(ws, raw)
             if msg is None:
                 continue
@@ -214,7 +269,7 @@ class FacadeServer:
                     "message": f"unknown type {mtype!r}",
                 })
                 continue
-            if not self._limiter.allow(session_id):
+            if not self._limiter.allow(limiter_key):
                 ws.close(4429, "rate limited")
                 return
 

@@ -10,19 +10,20 @@ on the tokens they serve:
   weights. No token ever drops; ~E/k redundant FLOPs. Right choice for tiny
   token counts (serving decode: a handful of slots) where the dispatch
   bookkeeping would dominate and dropped tokens are unacceptable.
-- ``moe_dispatch``: GShard-style capacity dispatch. One-hot dispatch/combine
-  tensors are built with cumsum position bookkeeping; the gather, expert
-  FFN, and scatter are all einsums, so the whole path is static-shaped and
-  MXU-eligible. Tokens past an expert's capacity contribute zero (standard
-  capacity-drop semantics); use capacity_factor ≥ ~2 at small batch.
+- ``moe_dispatch``: GShard-style capacity dispatch, sort-based
+  (MegaBlocks-style): assignments are sorted by expert, tokens are
+  gathered into a static [E, C, d] buffer, expert FFNs run as batched
+  einsums on the MXU, and results scatter-add back per token. O(N·K·d)
+  memory — no O(N²) one-hot tensors — so long-context prefill fits HBM.
+  Tokens past an expert's capacity contribute zero (standard capacity-drop
+  semantics); use capacity_factor ≥ ~2 at small batch.
 
 Sharding: expert-leading weights [E, d, f] shard E over the "tp" axis
-(expert parallelism). In ``moe_dispatch`` the dispatch einsum produces
-[E, C, d] sharded over E; each device runs only its experts' FFNs; the
-combine einsum reduces over E and GSPMD inserts the psum. This is
-all-to-all-free EP (activations are replicated over tp, which is the right
-trade at serving batch sizes; token-sharded a2a dispatch is the large-batch
-training variant).
+(expert parallelism). The [E, C, d] buffer shards over E, each device runs
+its experts' FFNs, and the scatter-add back to tokens reduces over E with
+a GSPMD-inserted psum. Activations are replicated over tp — the right
+trade at serving batch sizes; token-sharded all-to-all dispatch is the
+large-batch training variant.
 """
 
 from __future__ import annotations
@@ -31,21 +32,27 @@ import jax
 import jax.numpy as jnp
 
 
-def route_topk(h, router_w, num_experts_per_tok: int):
-    """Router: h [..., d] × router_w [d, E] → combine weights [..., E].
+def route_sparse(h, router_w, num_experts_per_tok: int):
+    """Router: h [..., d] × router_w [d, E] → (top_w, top_i), each [..., K].
 
-    Top-k probabilities renormalized to sum 1, zero elsewhere (Mixtral
-    semantics: softmax over all experts, then keep-and-renormalize top-k).
+    Mixtral semantics: float32 softmax over all experts, keep the top-k,
+    renormalize kept weights to sum 1. The single source of routing truth —
+    both MoE implementations derive from it so they can never diverge.
     """
-    E = router_w.shape[-1]
     logits = jnp.dot(h, router_w).astype(jnp.float32)
     probs = jax.nn.softmax(logits, axis=-1)
     top_w, top_i = jax.lax.top_k(probs, num_experts_per_tok)
     top_w = top_w / top_w.sum(axis=-1, keepdims=True)
-    combine = jnp.sum(
-        jax.nn.one_hot(top_i, E, dtype=probs.dtype) * top_w[..., None], axis=-2
+    return top_w, top_i
+
+
+def route_topk(h, router_w, num_experts_per_tok: int):
+    """Dense combine weights [..., E]: top-k renormalized, zero elsewhere."""
+    E = router_w.shape[-1]
+    top_w, top_i = route_sparse(h, router_w, num_experts_per_tok)
+    return jnp.sum(
+        jax.nn.one_hot(top_i, E, dtype=top_w.dtype) * top_w[..., None], axis=-2
     )
-    return combine  # [..., E]
 
 
 def moe_dense(h, p, num_experts_per_tok: int):
@@ -60,36 +67,46 @@ def moe_dense(h, p, num_experts_per_tok: int):
 def moe_dispatch(h, p, num_experts_per_tok: int, capacity_factor: float = 2.0):
     """Capacity-based dispatched MoE. h: [B, T, d] → [B, T, d].
 
-    FLOPs scale with k/E of the dense path plus dispatch einsums. Tokens
-    beyond an expert's capacity C = ceil(N·k/E · capacity_factor) are
-    dropped (their combine weight contributes nothing), matching GShard.
+    Sort-based (MegaBlocks-style) routing: the N·K (token, expert)
+    assignments are sorted by expert, positions within each expert come
+    from bincount offsets, and tokens move through a [E·C, d] buffer via
+    gather/scatter — O(N·K·d) memory, never an O(N²) one-hot tensor, so
+    long-context prefill stays HBM-feasible. Tokens beyond an expert's
+    capacity C = ceil(N·k/E · capacity_factor) are dropped (contribute
+    zero), matching GShard semantics. All shapes static.
     """
     B, T, d = h.shape
     E = p["router"].shape[-1]
     K = num_experts_per_tok
     N = B * T
     capacity = max(1, int(-(-N * K * capacity_factor // E)))  # ceil
+    NK = N * K
 
     flat = h.reshape(N, d)
-    combine_e = route_topk(flat, p["router"], K)  # [N, E] renormalized top-k
-    chosen = (combine_e > 0).astype(jnp.float32)  # [N, E]
+    top_w, top_i = route_sparse(flat, p["router"], K)  # [N, K]
 
-    # Position of each token within its expert's buffer (tokens in index
-    # order; cumsum is cheap and static-shaped).
-    pos_in_expert = jnp.cumsum(chosen, axis=0) * chosen - 1.0  # [N, E], -1 if unchosen
-    within = (pos_in_expert >= 0) & (pos_in_expert < capacity)
-    pos_clipped = jnp.clip(pos_in_expert, 0, capacity - 1).astype(jnp.int32)
+    e_flat = top_i.reshape(NK)  # token-major assignment list
+    w_flat = top_w.reshape(NK)
+    tok_of = jnp.repeat(jnp.arange(N, dtype=jnp.int32), K)
 
-    # dispatch[n, e, c] = 1 iff token n sits in slot c of expert e
-    pos_onehot = jax.nn.one_hot(pos_clipped, capacity, dtype=flat.dtype)  # [N,E,C]
-    dispatch = pos_onehot * within.astype(flat.dtype)[..., None]
-    combine = dispatch * combine_e.astype(flat.dtype)[..., None]  # [N,E,C]
+    order = jnp.argsort(e_flat)  # stable → within an expert, token order kept
+    e_s, w_s, t_s = e_flat[order], w_flat[order], tok_of[order]
+    counts = jnp.bincount(e_flat, length=E)
+    starts = jnp.cumsum(counts) - counts  # first row of each expert's run
+    pos = jnp.arange(NK, dtype=jnp.int32) - starts[e_s]
+    keep = pos < capacity
+    # Overflow assignments land in a trash row past the buffer.
+    dest = jnp.where(keep, e_s * capacity + pos, E * capacity)
 
-    xs = jnp.einsum("nec,nd->ecd", dispatch, flat)  # [E, C, d] gather
+    xs = jnp.zeros((E * capacity + 1, d), flat.dtype).at[dest].set(flat[t_s])
+    xs = xs[: E * capacity].reshape(E, capacity, d)
     gate = jnp.einsum("ecd,edf->ecf", xs, p["wg"])
     up = jnp.einsum("ecd,edf->ecf", xs, p["wu"])
     ys = jnp.einsum("ecf,efd->ecd", jax.nn.silu(gate) * up, p["wd"])
-    out = jnp.einsum("nec,ecd->nd", combine, ys)  # scatter+weight (psum over E)
+
+    contrib = ys.reshape(E * capacity, d)[jnp.clip(dest, 0, E * capacity - 1)]
+    contrib = contrib * (w_s * keep).astype(flat.dtype)[:, None]
+    out = jnp.zeros((N, d), flat.dtype).at[t_s].add(contrib)
     return out.reshape(B, T, d)
 
 

@@ -219,12 +219,27 @@ class Conversation:
         sp = self._sampling(msg)
 
         for _ in range(MAX_TOOL_ROUNDS + 1):
+            # A cancel that landed between rounds (no engine request in
+            # flight) must stop the turn, not be silently ignored.
+            if self._cancel_requested.is_set():
+                try:
+                    self.store.put(state)
+                except StoreUnavailable:
+                    pass
+                usage.cost_usd = self._cost(usage)
+                yield ServerMessage(type="done", usage=usage, finish_reason="cancelled")
+                return
+
             prompt = render_prompt(self.pack, state, self.pack_params)
             prompt_ids = self.tokenizer.encode(prompt)
             usage.prompt_tokens += len(prompt_ids)
 
             handle = self.engine.submit(prompt_ids, sp)
             self._active_handle = handle
+            # Close the submit→publish window: a cancel_turn racing here saw
+            # _active_handle=None and only set the flag.
+            if self._cancel_requested.is_set():
+                handle.cancel()
             parser = ToolCallStreamParser()
             detok = IncrementalDetokenizer(self.tokenizer)
             assistant_text = ""
@@ -338,6 +353,16 @@ class Conversation:
                 results = self._await_client_results(
                     deadline, expected_id=reply.tool_call.tool_call_id
                 )
+                if results is self._CANCELLED:
+                    try:
+                        self.store.put(state)
+                    except StoreUnavailable:
+                        pass
+                    usage.cost_usd = self._cost(usage)
+                    yield ServerMessage(
+                        type="done", usage=usage, finish_reason="cancelled"
+                    )
+                    return
                 if results is None:
                     yield ServerMessage(
                         type="error",
@@ -394,21 +419,26 @@ class Conversation:
         turns.append(Turn(role="tool", content=outcome.content, tool_call_id=call_id))
         return turns, None, None
 
-    def _await_client_results(
-        self, deadline: float, expected_id: str = ""
-    ) -> Optional[list[ToolResult]]:
+    _CANCELLED = object()  # sentinel: wait ended by cancel_turn, not timeout
+
+    def _await_client_results(self, deadline: float, expected_id: str = ""):
         """Wait for results for THIS call; stale batches (wrong or missing
         tool_call_id from an earlier timed-out call) are discarded and the
-        wait continues with the remaining budget."""
+        wait continues with the remaining budget. Polls in short slices so a
+        cancel_turn during the (up to 60s) client-tool wait ends the turn
+        promptly instead of holding the turn lock to the full timeout.
+        Returns the results, None on timeout, or _CANCELLED."""
         stop_at = min(time.monotonic() + CLIENT_TOOL_TIMEOUT_S, deadline)
         while True:
+            if self._cancel_requested.is_set():
+                return self._CANCELLED
             timeout = stop_at - time.monotonic()
             if timeout <= 0:
                 return None
             try:
-                results = self._client_results.get(timeout=timeout)
+                results = self._client_results.get(timeout=min(timeout, 0.25))
             except queue.Empty:
-                return None
+                continue
             if not expected_id or any(r.tool_call_id == expected_id for r in results):
                 return results
             # stale batch: drop and keep waiting

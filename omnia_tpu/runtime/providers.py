@@ -16,6 +16,7 @@ name. Roles (llm | embedding) mirror the reference's provider roles.
 from __future__ import annotations
 
 import dataclasses
+import threading
 from typing import Any, Optional
 
 from omnia_tpu.engine import EngineConfig, InferenceEngine, MockEngine
@@ -91,6 +92,8 @@ class ProviderRegistry:
     def __init__(self):
         self._specs: dict[str, ProviderSpec] = {}
         self._engines: dict[str, Any] = {}
+        self._registry_lock = threading.Lock()
+        self._build_locks: dict[str, threading.Lock] = {}
 
     def register(self, spec: ProviderSpec) -> None:
         self._specs[spec.name] = spec
@@ -101,10 +104,25 @@ class ProviderRegistry:
         return self._specs[name]
 
     def engine(self, name: str):
-        """Lazily build (and cache) the engine for a named provider."""
-        if name not in self._engines:
-            self._engines[name] = build_engine(self.spec(name))
-        return self._engines[name]
+        """Lazily build (and cache) the engine for a named provider.
+
+        Builds are serialized PER NAME: a model build takes minutes, and two
+        threads racing here (server bring-up vs an early RPC) must get the
+        SAME engine — the loser of an unsynchronized race would submit to a
+        never-started one. Already-built engines return without locking, and
+        one provider's build never stalls another provider (llm vs
+        embedding) or post-ready health probes.
+        """
+        eng = self._engines.get(name)
+        if eng is not None:
+            return eng
+        with self._registry_lock:
+            lock = self._build_locks.setdefault(name, threading.Lock())
+        with lock:
+            eng = self._engines.get(name)
+            if eng is None:
+                eng = self._engines[name] = build_engine(self.spec(name))
+            return eng
 
     def names(self) -> list[str]:
         return sorted(self._specs)

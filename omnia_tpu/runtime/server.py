@@ -202,16 +202,21 @@ class RuntimeServer:
         return c.InvokeResponse(output=text, usage=usage)
 
     def health(self, request, context):
-        engine = self.engine
         # Capability-gate honesty: not ready until every serving shape is
         # compiled and the engine loop is running (no compile, no stall on
-        # the request path).
+        # the request path). Before ready, do NOT touch self.engine — the
+        # probe must never trigger (or block on) the minutes-long build.
         if not self._ready.is_set():
-            status = "initializing"
-        elif getattr(engine, "healthy", lambda: True)():
-            status = "ok"
-        else:
-            status = "unhealthy"
+            return c.HealthResponse(
+                status="initializing",
+                contract_version=c.CONTRACT_VERSION,
+                capabilities=self.capabilities,
+                model=self.spec.model,
+                queue_depth=0,
+                active_slots=0,
+            )
+        engine = self.engine
+        status = "ok" if getattr(engine, "healthy", lambda: True)() else "unhealthy"
         return c.HealthResponse(
             status=status,
             contract_version=c.CONTRACT_VERSION,

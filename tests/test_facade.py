@@ -142,14 +142,49 @@ class TestFacade:
     def test_resume_same_session(self, stack):
         _, port = stack
         with connect(_url(port, token="secret-abc", session="ws-resume-1")) as ws:
-            assert not json.loads(ws.recv(timeout=10))["resumed"]
+            connected = json.loads(ws.recv(timeout=10))
+            assert not connected["resumed"]
+            # Authenticated sessions are namespaced per user; the server
+            # returns the canonical id and resumes by it or by the raw id.
+            canonical = connected["session_id"]
+            assert canonical.endswith("ws-resume-1")
             ws.send(json.dumps({"type": "message", "content": "hello"}))
             _recv_until(ws, {"done", "error"})
             ws.send(json.dumps({"type": "hangup"}))
-        with connect(_url(port, token="secret-abc", session="ws-resume-1")) as ws:
-            connected = json.loads(ws.recv(timeout=10))
-            assert connected["resumed"]
-            assert connected["session_id"] == "ws-resume-1"
+        for handle in ("ws-resume-1", canonical):
+            with connect(_url(port, token="secret-abc", session=handle)) as ws:
+                connected = json.loads(ws.recv(timeout=10))
+                assert connected["resumed"]
+                assert connected["session_id"] == canonical
+
+    def test_foreign_session_rejected(self, stack):
+        """One principal must not resume (or hijack) another's session."""
+        _, port = stack
+        with connect(_url(port, token="secret-abc", session="private-1")) as ws:
+            canonical = json.loads(ws.recv(timeout=10))["session_id"]
+            ws.send(json.dumps({"type": "hangup"}))
+        other = HmacValidator.mint(b"mgmt-secret", subject="dashboard")
+        with pytest.raises(ConnectionClosed) as exc:
+            with connect(_url(port, token=other, session=canonical)) as ws:
+                ws.recv(timeout=10)
+        assert exc.value.rcvd.code == 4403
+
+    def test_user_param_cannot_override_principal(self, stack, record_sink):
+        """?user= is an impersonation vector when auth is on — must be ignored."""
+        _, port = stack
+        _, records = record_sink
+        before = len(records)
+        with connect(_url(port, token="secret-abc", user="victim")) as ws:
+            ws.recv(timeout=10)
+            ws.send(json.dumps({"type": "message", "content": "hi"}))
+            _recv_until(ws, {"done", "error"})
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline and len(records) < before + 2:
+            time.sleep(0.05)
+        new = [r for _, r in records[before:]]
+        ids = [r["user_id"] for r in new if "user_id" in r]
+        assert ids, f"no recorded identity at all: {new}"
+        assert all(i == "key1" for i in ids)
 
     def test_client_tool_roundtrip(self, stack):
         _, port = stack
