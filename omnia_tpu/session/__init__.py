@@ -1,0 +1,36 @@
+from omnia_tpu.session.records import (
+    EvalResultRecord,
+    MessageRecord,
+    ProviderCallRecord,
+    RuntimeEventRecord,
+    SessionRecord,
+    ToolCallRecord,
+)
+from omnia_tpu.session.store import SessionStore
+from omnia_tpu.session.hot import HotStore
+from omnia_tpu.session.warm import WarmStore
+from omnia_tpu.session.cold import ColdArchive, LocalBlobStore, MemoryBlobStore
+from omnia_tpu.session.tiers import TieredStore
+from omnia_tpu.session.retention import RetentionPolicy
+from omnia_tpu.session.compaction import CompactionEngine
+from omnia_tpu.session.api import SESSION_EVENTS_STREAM, SessionAPI
+
+__all__ = [
+    "SESSION_EVENTS_STREAM",
+    "SessionAPI",
+    "ColdArchive",
+    "CompactionEngine",
+    "EvalResultRecord",
+    "HotStore",
+    "LocalBlobStore",
+    "MemoryBlobStore",
+    "MessageRecord",
+    "ProviderCallRecord",
+    "RetentionPolicy",
+    "RuntimeEventRecord",
+    "SessionRecord",
+    "SessionStore",
+    "TieredStore",
+    "ToolCallRecord",
+    "WarmStore",
+]

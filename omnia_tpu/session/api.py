@@ -1,0 +1,241 @@
+"""session-api: the HTTP surface over the tiered session store.
+
+Endpoint families mirror the reference session-api (reference
+cmd/session-api/SERVICE.md:27-50, internal/session/api/handler*.go):
+session CRUD, record appends (messages / events / tool-calls /
+provider-calls / eval-results), per-session reads, usage aggregates.
+Every write publishes a session event to the stream fabric so eval
+workers can consume them (reference internal/session/api/
+event_publisher.go → Redis Streams). Per-client rate limiting and
+Prometheus-style metrics ride on the same server, as in the reference.
+
+The facade's recording interceptor posts to /api/v1/messages and
+/api/v1/events — fail-open on its side, best-effort ack on ours."""
+
+from __future__ import annotations
+
+import json
+import logging
+import re
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from omnia_tpu.session.records import (
+    EvalResultRecord,
+    MessageRecord,
+    ProviderCallRecord,
+    RuntimeEventRecord,
+    SessionRecord,
+    ToolCallRecord,
+    to_dict,
+)
+from omnia_tpu.session.tiers import TieredStore
+from omnia_tpu.streams import Stream
+from omnia_tpu.utils.metrics import Registry
+from omnia_tpu.utils.ratelimit import KeyedLimiter
+
+logger = logging.getLogger(__name__)
+
+SESSION_EVENTS_STREAM = "omnia:session-events"
+
+_SESSION_PATH = re.compile(
+    r"^/api/v1/sessions/(?P<sid>[^/]+)"
+    r"(?:/(?P<sub>messages|events|tool-calls|provider-calls|eval-results))?$"
+)
+
+_APPEND_ROUTES = {
+    "/api/v1/messages": ("message", MessageRecord, "append_message"),
+    "/api/v1/events": ("event", RuntimeEventRecord, "append_event"),
+    "/api/v1/tool-calls": ("tool_call", ToolCallRecord, "append_tool_call"),
+    "/api/v1/provider-calls": (
+        "provider_call",
+        ProviderCallRecord,
+        "append_provider_call",
+    ),
+    "/api/v1/eval-results": ("eval_result", EvalResultRecord, "append_eval_result"),
+}
+
+_SUB_READS = {
+    "messages": "messages",
+    "events": "events",
+    "tool-calls": "tool_calls",
+    "provider-calls": "provider_calls",
+    "eval-results": "eval_results",
+}
+
+
+class SessionAPI:
+    def __init__(
+        self,
+        store: Optional[TieredStore] = None,
+        events: Optional[Stream] = None,
+        rate_limit_rps: float = 200.0,
+    ) -> None:
+        self.store = store or TieredStore()
+        self.events = events or Stream()
+        self.metrics = Registry("omnia_session")
+        self._requests = self.metrics.counter("requests_total", "HTTP requests")
+        self._writes = self.metrics.counter("records_written_total", "records written")
+        self._limiter = KeyedLimiter(rate=rate_limit_rps, burst=int(rate_limit_rps * 2))
+        self._httpd: Optional[ThreadingHTTPServer] = None
+
+    # ------------------------------------------------------------------
+    # Request handling (framework-free so tests can call it directly).
+    # ------------------------------------------------------------------
+
+    def handle(self, method: str, path: str, body: Optional[dict], client: str = "local"):
+        """Returns (status_code, response_dict)."""
+        self._requests.inc(method=method)
+        if not self._limiter.allow(client):
+            return 429, {"error": "rate limited"}
+        try:
+            return self._route(method, path, body)
+        except (KeyError, TypeError, ValueError) as e:
+            return 400, {"error": str(e)}
+        except Exception as e:  # pragma: no cover - defensive
+            logger.exception("session-api internal error")
+            return 500, {"error": str(e)}
+
+    def _route(self, method: str, path: str, body: Optional[dict]):
+        if method == "POST" and path in _APPEND_ROUTES:
+            return self._append(path, body or {})
+        if method == "POST" and path == "/api/v1/sessions":
+            return self._ensure_session(body or {})
+        if path == "/api/v1/usage" and method == "GET":
+            # workspace filter arrives as ?workspace= pre-parsed into body
+            ws = (body or {}).get("workspace")
+            return 200, self.store.usage(ws)
+        if path == "/api/v1/sessions" and method == "GET":
+            ws = (body or {}).get("workspace")
+            limit = int((body or {}).get("limit", 100))
+            return 200, {
+                "sessions": [to_dict(s) for s in self.store.list_sessions(ws, limit)]
+            }
+        m = _SESSION_PATH.match(path)
+        if m:
+            sid, sub = m.group("sid"), m.group("sub")
+            if sub is None:
+                if method == "GET":
+                    s = self.store.get_session(sid)
+                    if s is None:
+                        return 404, {"error": "not found"}
+                    return 200, to_dict(s)
+                if method == "DELETE":
+                    if self.store.delete_session(sid):
+                        self._publish("session_deleted", sid, {})
+                        return 200, {"deleted": True}
+                    return 404, {"error": "not found"}
+            elif method == "GET":
+                recs = getattr(self.store, _SUB_READS[sub])(sid)
+                return 200, {sub.replace("-", "_"): [to_dict(r) for r in recs]}
+        return 404, {"error": f"no route {method} {path}"}
+
+    def _ensure_session(self, body: dict):
+        if "session_id" not in body:
+            return 400, {"error": "session_id required"}
+        known = {"session_id", "workspace", "agent", "user_id", "attrs"}
+        rec = SessionRecord(**{k: v for k, v in body.items() if k in known})
+        out = self.store.ensure_session(rec)
+        self._publish("session_ensured", out.session_id, {"workspace": out.workspace})
+        return 200, to_dict(out)
+
+    def _append(self, path: str, body: dict):
+        kind, cls, method_name = _APPEND_ROUTES[path]
+        body = dict(body)
+        body.pop("kind", None)  # recording interceptor envelope field
+        # The recording pool delivers out of order; honor the client-side
+        # capture timestamp so reads sort by when things actually happened.
+        if "ts" in body and "created_at" not in body:
+            body["created_at"] = float(body.pop("ts"))
+        import dataclasses as _dc
+
+        known = {f.name for f in _dc.fields(cls)}
+        rec = cls(**{k: v for k, v in body.items() if k in known})
+        if not rec.session_id:
+            return 400, {"error": "session_id required"}
+        # Auto-ensure the session so appends never race session creation.
+        self.store.ensure_session(SessionRecord(session_id=rec.session_id))
+        getattr(self.store, method_name)(rec)
+        self._writes.inc(kind=kind)
+        self._publish(kind, rec.session_id, to_dict(rec))
+        return 200, {"ok": True, "record_id": getattr(rec, "record_id", "")}
+
+    def _publish(self, event_type: str, session_id: str, payload: dict) -> None:
+        try:
+            self.events.add(
+                {"type": event_type, "session_id": session_id, "payload": payload}
+            )
+        except Exception:  # never let the event bus break the write path
+            logger.exception("session event publish failed")
+
+    # ------------------------------------------------------------------
+    # HTTP server
+    # ------------------------------------------------------------------
+
+    def serve(self, host: str = "localhost", port: int = 0) -> int:
+        api = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def _body(self) -> Optional[dict]:
+                n = int(self.headers.get("Content-Length") or 0)
+                if n == 0:
+                    return None
+                try:
+                    return json.loads(self.rfile.read(n))
+                except json.JSONDecodeError:
+                    return None
+
+            def _dispatch(self, method: str):
+                from urllib.parse import parse_qsl, urlsplit
+
+                parts = urlsplit(self.path)
+                path = parts.path
+                if path == "/healthz" or path == "/readyz":
+                    self._reply(200, {"status": "ok"})
+                    return
+                if path == "/metrics":
+                    text = api.metrics.expose()
+                    data = text.encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type", "text/plain; version=0.0.4")
+                    self.send_header("Content-Length", str(len(data)))
+                    self.end_headers()
+                    self.wfile.write(data)
+                    return
+                body = self._body() or {}
+                body.update(dict(parse_qsl(parts.query)))
+                code, resp = api.handle(
+                    method, path, body, client=self.client_address[0]
+                )
+                self._reply(code, resp)
+
+            def do_GET(self):
+                self._dispatch("GET")
+
+            def do_POST(self):
+                self._dispatch("POST")
+
+            def do_DELETE(self):
+                self._dispatch("DELETE")
+
+            def _reply(self, code: int, doc: dict):
+                data = json.dumps(doc).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def log_message(self, *args):
+                pass
+
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        t = threading.Thread(target=self._httpd.serve_forever, daemon=True)
+        t.start()
+        return self._httpd.server_address[1]
+
+    def shutdown(self) -> None:
+        if self._httpd:
+            self._httpd.shutdown()
+            self._httpd = None

@@ -1,0 +1,254 @@
+"""Cold tier: Parquet archives on an object store.
+
+Reference shape: Parquet session archives in S3/GCS/Azure with a JSON
+manifest (reference internal/session/providers/cold/{parquet.go,
+manifest.go, blobstore_*.go}). Here: pyarrow Parquet over a blobstore
+abstraction with in-memory and local-filesystem backends (cloud backends
+are a put/get/list/delete swap behind the same four calls).
+
+Each archived session becomes one Parquet object holding every record
+kind (a `kind` column discriminates), plus a manifest entry so lookups
+never scan the bucket."""
+
+from __future__ import annotations
+
+import io
+import json
+import os
+import threading
+import time
+from typing import Optional
+
+import pyarrow as pa
+import pyarrow.parquet as pq
+
+from omnia_tpu.session.records import SessionRecord, from_dict
+
+
+class MemoryBlobStore:
+    def __init__(self) -> None:
+        self._blobs: dict[str, bytes] = {}
+        self._lock = threading.Lock()
+
+    def put(self, key: str, data: bytes) -> None:
+        with self._lock:
+            self._blobs[key] = data
+
+    def get(self, key: str) -> Optional[bytes]:
+        with self._lock:
+            return self._blobs.get(key)
+
+    def list(self, prefix: str = "") -> list[str]:
+        with self._lock:
+            return sorted(k for k in self._blobs if k.startswith(prefix))
+
+    def delete(self, key: str) -> bool:
+        with self._lock:
+            return self._blobs.pop(key, None) is not None
+
+
+class LocalBlobStore:
+    def __init__(self, root: str) -> None:
+        self.root = root
+        os.makedirs(root, exist_ok=True)
+
+    def _path(self, key: str) -> str:
+        p = os.path.normpath(os.path.join(self.root, key))
+        if not p.startswith(os.path.abspath(self.root) + os.sep) and p != self.root:
+            p = os.path.join(self.root, key.replace("/", "_"))
+        return p
+
+    def put(self, key: str, data: bytes) -> None:
+        path = self._path(key)
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(data)
+        os.replace(tmp, path)
+
+    def get(self, key: str) -> Optional[bytes]:
+        try:
+            with open(self._path(key), "rb") as f:
+                return f.read()
+        except FileNotFoundError:
+            return None
+
+    def list(self, prefix: str = "") -> list[str]:
+        out = []
+        for dirpath, _, files in os.walk(self.root):
+            for fn in files:
+                if fn.endswith(".tmp"):
+                    continue
+                rel = os.path.relpath(os.path.join(dirpath, fn), self.root)
+                key = rel.replace(os.sep, "/")
+                if key.startswith(prefix):
+                    out.append(key)
+        return sorted(out)
+
+    def delete(self, key: str) -> bool:
+        try:
+            os.remove(self._path(key))
+            return True
+        except FileNotFoundError:
+            return False
+
+
+_SCHEMA = pa.schema(
+    [
+        ("kind", pa.string()),
+        ("record_id", pa.string()),
+        ("session_id", pa.string()),
+        ("created_at", pa.float64()),
+        ("body", pa.string()),  # full record JSON — lossless round-trip
+    ]
+)
+
+_MANIFEST_KEY = "manifest.json"
+
+
+class ColdArchive:
+    def __init__(self, blobstore=None) -> None:
+        self.blobs = blobstore or MemoryBlobStore()
+        self._lock = threading.Lock()
+
+    # -- manifest ------------------------------------------------------
+
+    def _load_manifest(self) -> dict:
+        raw = self.blobs.get(_MANIFEST_KEY)
+        return json.loads(raw) if raw else {"sessions": {}}
+
+    def _save_manifest(self, m: dict) -> None:
+        self.blobs.put(_MANIFEST_KEY, json.dumps(m).encode())
+
+    # -- archive -------------------------------------------------------
+
+    def archive_session(
+        self, session: SessionRecord, records: dict[str, list[dict]]
+    ) -> str:
+        """Write one Parquet object for the session + manifest entry.
+        Returns the blob key."""
+        rows = {"kind": [], "record_id": [], "session_id": [], "created_at": [], "body": []}
+        for kind, recs in records.items():
+            for r in recs:
+                rows["kind"].append(kind)
+                rows["record_id"].append(str(r.get("record_id", "")))
+                rows["session_id"].append(session.session_id)
+                rows["created_at"].append(float(r.get("created_at", 0.0)))
+                rows["body"].append(json.dumps(r))
+        table = pa.Table.from_pydict(rows, schema=_SCHEMA)
+        buf = io.BytesIO()
+        pq.write_table(table, buf, compression="zstd")
+        day = time.strftime("%Y-%m-%d", time.gmtime(session.updated_at))
+        key = f"archive/{day}/{session.session_id}.parquet"
+        with self._lock:
+            self.blobs.put(key, buf.getvalue())
+            m = self._load_manifest()
+            m["sessions"][session.session_id] = {
+                "key": key,
+                "workspace": session.workspace,
+                "agent": session.agent,
+                "user_id": session.user_id,
+                "created_at": session.created_at,
+                "updated_at": session.updated_at,
+                "records": table.num_rows,
+            }
+            self._save_manifest(m)
+        return key
+
+    # -- reads ---------------------------------------------------------
+
+    def get_session(self, session_id: str) -> Optional[SessionRecord]:
+        entry = self._load_manifest()["sessions"].get(session_id)
+        if entry is None:
+            return None
+        return SessionRecord(
+            session_id=session_id,
+            workspace=entry["workspace"],
+            agent=entry["agent"],
+            user_id=entry["user_id"],
+            created_at=entry["created_at"],
+            updated_at=entry["updated_at"],
+            archived=True,
+            tier="cold",
+        )
+
+    def list_sessions(
+        self, workspace: Optional[str] = None, limit: int = 100
+    ) -> list[SessionRecord]:
+        m = self._load_manifest()
+        out = []
+        for sid, entry in m["sessions"].items():
+            if workspace is not None and entry["workspace"] != workspace:
+                continue
+            out.append(
+                SessionRecord(
+                    session_id=sid,
+                    workspace=entry["workspace"],
+                    agent=entry["agent"],
+                    user_id=entry["user_id"],
+                    created_at=entry["created_at"],
+                    updated_at=entry["updated_at"],
+                    archived=True,
+                    tier="cold",
+                )
+            )
+        out.sort(key=lambda s: -s.updated_at)
+        return out[:limit]
+
+    def session_ids(self, workspace: Optional[str] = None) -> set[str]:
+        m = self._load_manifest()
+        return {
+            sid
+            for sid, e in m["sessions"].items()
+            if workspace is None or e["workspace"] == workspace
+        }
+
+    def records(self, session_id: str, kind: Optional[str] = None) -> list:
+        """Read back typed records from the session's Parquet object."""
+        entry = self._load_manifest()["sessions"].get(session_id)
+        if entry is None:
+            return []
+        raw = self.blobs.get(entry["key"])
+        if raw is None:
+            return []
+        table = pq.read_table(io.BytesIO(raw))
+        out = []
+        for batch in table.to_batches():
+            kinds = batch.column("kind").to_pylist()
+            bodies = batch.column("body").to_pylist()
+            for k, body in zip(kinds, bodies):
+                if kind is not None and k != kind:
+                    continue
+                out.append(from_dict(k, json.loads(body)))
+        out.sort(key=lambda r: r.created_at)
+        return out
+
+    def delete_session(self, session_id: str) -> bool:
+        with self._lock:
+            m = self._load_manifest()
+            entry = m["sessions"].pop(session_id, None)
+            if entry is None:
+                return False
+            self.blobs.delete(entry["key"])
+            self._save_manifest(m)
+            return True
+
+    def purge_older_than(self, cutoff_ts: float) -> int:
+        """Delete archives past retention (reference compaction
+        engine.go:299 purge-cold pass)."""
+        with self._lock:
+            m = self._load_manifest()
+            doomed = [
+                sid
+                for sid, e in m["sessions"].items()
+                if e["updated_at"] < cutoff_ts
+            ]
+            for sid in doomed:
+                self.blobs.delete(m["sessions"][sid]["key"])
+                del m["sessions"][sid]
+            if doomed:
+                self._save_manifest(m)
+            return len(doomed)
+
+    def __len__(self) -> int:
+        return len(self._load_manifest()["sessions"])

@@ -1,0 +1,76 @@
+"""Compaction engine: hot → warm → cold lifecycle.
+
+Reference shape: internal/compaction/engine.go:85 Run → :99 warm→cold
+batches → :299 purge-cold, driven as a CronJob on the retention policy.
+Here a single `run_once` does the three passes; the control plane runs
+it on a schedule (or tests call it directly)."""
+
+from __future__ import annotations
+
+import logging
+import time
+from dataclasses import dataclass, field
+
+from omnia_tpu.session.retention import RetentionPolicy
+from omnia_tpu.session.tiers import TieredStore
+
+logger = logging.getLogger(__name__)
+
+
+@dataclass
+class CompactionReport:
+    demoted_hot_to_warm: int = 0
+    demoted_warm_to_cold: int = 0
+    purged_cold: int = 0
+    errors: list[str] = field(default_factory=list)
+
+
+class CompactionEngine:
+    def __init__(self, store: TieredStore, policy: RetentionPolicy | None = None):
+        self.store = store
+        self.policy = policy or RetentionPolicy()
+        self.policy.validate()
+
+    def run_once(self, now: float | None = None) -> CompactionReport:
+        now = time.time() if now is None else now
+        report = CompactionReport()
+        self._hot_to_warm(report)
+        self._warm_to_cold(report, now)
+        report.purged_cold = self.store.cold.purge_older_than(
+            now - self.policy.cold_window_s
+        )
+        return report
+
+    def _hot_to_warm(self, report: CompactionReport) -> None:
+        from omnia_tpu.session.tiers import demote_bundle
+
+        bundles = self.store.hot.pop_idle(
+            self.policy.hot_idle_s, limit=self.policy.batch_size
+        )
+        for b in bundles:
+            try:
+                demote_bundle(self.store.warm, b)
+                report.demoted_hot_to_warm += 1
+            except Exception as e:  # keep compacting the rest of the batch
+                # The bundle was already popped from hot — put it back so
+                # the records survive a warm-store outage and the next
+                # pass retries (duplicate appends are idempotent by
+                # record_id upsert).
+                self.store.hot.restore(b)
+                logger.exception("hot→warm demotion failed for %s", b.session.session_id)
+                report.errors.append(f"hot→warm {b.session.session_id}: {e}")
+
+    def _warm_to_cold(self, report: CompactionReport, now: float) -> None:
+        cutoff = now - self.policy.warm_window_s
+        doomed = self.store.warm.sessions_older_than(
+            cutoff, limit=self.policy.batch_size
+        )
+        for sess in doomed:
+            try:
+                records = self.store.warm.all_records(sess.session_id)
+                self.store.cold.archive_session(sess, records)
+                self.store.warm.delete_session(sess.session_id)
+                report.demoted_warm_to_cold += 1
+            except Exception as e:
+                logger.exception("warm→cold archive failed for %s", sess.session_id)
+                report.errors.append(f"warm→cold {sess.session_id}: {e}")

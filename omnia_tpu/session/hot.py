@@ -1,0 +1,225 @@
+"""Hot tier: in-memory live-session store with TTL.
+
+The Redis-equivalent tier (reference
+internal/session/providers/redis/provider.go): fast, bounded, recent.
+Thread-safe; expired sessions are swept lazily on access and by the
+compaction engine. `pop_idle` hands whole sessions to compaction for
+demotion to the warm tier."""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Optional
+
+from omnia_tpu.session.records import (
+    EvalResultRecord,
+    MessageRecord,
+    ProviderCallRecord,
+    RuntimeEventRecord,
+    SessionRecord,
+    ToolCallRecord,
+)
+
+
+class _SessionBundle:
+    __slots__ = (
+        "session",
+        "messages",
+        "tool_calls",
+        "provider_calls",
+        "eval_results",
+        "events",
+    )
+
+    def __init__(self, session: SessionRecord) -> None:
+        self.session = session
+        self.messages: list[MessageRecord] = []
+        self.tool_calls: list[ToolCallRecord] = []
+        self.provider_calls: list[ProviderCallRecord] = []
+        self.eval_results: list[EvalResultRecord] = []
+        self.events: list[RuntimeEventRecord] = []
+
+
+class HotStore:
+    def __init__(
+        self,
+        ttl_s: float = 3600.0,
+        max_sessions: int = 10000,
+        evict_sink=None,
+    ) -> None:
+        self.ttl_s = ttl_s
+        self.max_sessions = max_sessions
+        # Capacity evictions hand the whole bundle here (the tiered store
+        # wires this to warm-tier demotion) so live records are never
+        # silently discarded.
+        self.evict_sink = evict_sink
+        self._bundles: dict[str, _SessionBundle] = {}
+        self._lock = threading.Lock()
+
+    # -- sessions ------------------------------------------------------
+
+    def ensure_session(self, rec: SessionRecord) -> SessionRecord:
+        evicted = None
+        with self._lock:
+            b = self._bundles.get(rec.session_id)
+            if b is None:
+                if len(self._bundles) >= self.max_sessions:
+                    evicted = self._pop_oldest_locked()
+                rec.tier = "hot"
+                b = _SessionBundle(rec)
+                self._bundles[rec.session_id] = b
+            else:
+                # An auto-ensure from a racing append creates the session
+                # with defaults; a later explicit ensure must win for
+                # identity/placement fields or usage lands in the wrong
+                # workspace forever.
+                s = b.session
+                if rec.workspace != "default":
+                    s.workspace = rec.workspace
+                if rec.agent:
+                    s.agent = rec.agent
+                if rec.user_id:
+                    s.user_id = rec.user_id
+                if rec.attrs:
+                    s.attrs.update(rec.attrs)
+            b.session.updated_at = time.time()
+            out = b.session
+        if evicted is not None and self.evict_sink is not None:
+            self.evict_sink(evicted)
+        return out
+
+    def get_session(self, session_id: str) -> Optional[SessionRecord]:
+        with self._lock:
+            b = self._bundles.get(session_id)
+            if b is None or self._expired(b):
+                return None
+            return b.session
+
+    def list_sessions(
+        self, workspace: Optional[str] = None, limit: int = 100
+    ) -> list[SessionRecord]:
+        with self._lock:
+            out = [
+                b.session
+                for b in self._bundles.values()
+                if not self._expired(b)
+                and (workspace is None or b.session.workspace == workspace)
+            ]
+        out.sort(key=lambda s: -s.updated_at)
+        return out[:limit]
+
+    def delete_session(self, session_id: str) -> bool:
+        with self._lock:
+            return self._bundles.pop(session_id, None) is not None
+
+    # -- appends -------------------------------------------------------
+
+    def _bundle(self, session_id: str) -> _SessionBundle:
+        with self._lock:
+            b = self._bundles.get(session_id)
+            if b is None:
+                b = _SessionBundle(SessionRecord(session_id=session_id))
+                self._bundles[session_id] = b
+            b.session.updated_at = time.time()
+            return b
+
+    def append_message(self, rec: MessageRecord) -> None:
+        self._bundle(rec.session_id).messages.append(rec)
+
+    def append_tool_call(self, rec: ToolCallRecord) -> None:
+        self._bundle(rec.session_id).tool_calls.append(rec)
+
+    def append_provider_call(self, rec: ProviderCallRecord) -> None:
+        self._bundle(rec.session_id).provider_calls.append(rec)
+
+    def append_eval_result(self, rec: EvalResultRecord) -> None:
+        self._bundle(rec.session_id).eval_results.append(rec)
+
+    def append_event(self, rec: RuntimeEventRecord) -> None:
+        self._bundle(rec.session_id).events.append(rec)
+
+    # -- reads ---------------------------------------------------------
+
+    def messages(self, session_id: str) -> list[MessageRecord]:
+        return self._read(session_id, "messages")
+
+    def tool_calls(self, session_id: str) -> list[ToolCallRecord]:
+        return self._read(session_id, "tool_calls")
+
+    def provider_calls(self, session_id: str) -> list[ProviderCallRecord]:
+        return self._read(session_id, "provider_calls")
+
+    def eval_results(self, session_id: str) -> list[EvalResultRecord]:
+        return self._read(session_id, "eval_results")
+
+    def events(self, session_id: str) -> list[RuntimeEventRecord]:
+        return self._read(session_id, "events")
+
+    def _read(self, session_id: str, attr: str):
+        with self._lock:
+            b = self._bundles.get(session_id)
+            return list(getattr(b, attr)) if b else []
+
+    # -- usage ---------------------------------------------------------
+
+    def usage(self, workspace: Optional[str] = None) -> dict:
+        with self._lock:
+            bundles = [
+                b
+                for b in self._bundles.values()
+                if workspace is None or b.session.workspace == workspace
+            ]
+        input_t = output_t = 0
+        cost = 0.0
+        for b in bundles:
+            for pc in b.provider_calls:
+                input_t += pc.input_tokens
+                output_t += pc.output_tokens
+                cost += pc.cost_usd
+        return {
+            "sessions": len(bundles),
+            "input_tokens": input_t,
+            "output_tokens": output_t,
+            "cost_usd": round(cost, 6),
+        }
+
+    # -- compaction hooks ---------------------------------------------
+
+    def pop_idle(self, idle_s: float, limit: int = 100) -> list[_SessionBundle]:
+        """Remove and return bundles idle longer than idle_s (oldest
+        first) for demotion to the warm tier."""
+        now = time.time()
+        with self._lock:
+            idle = sorted(
+                (
+                    b
+                    for b in self._bundles.values()
+                    if now - b.session.updated_at >= idle_s
+                ),
+                key=lambda b: b.session.updated_at,
+            )[:limit]
+            for b in idle:
+                del self._bundles[b.session.session_id]
+            return idle
+
+    def restore(self, bundle: _SessionBundle) -> None:
+        """Re-insert a bundle popped by pop_idle (compaction failure
+        recovery — the records must not be lost)."""
+        with self._lock:
+            self._bundles[bundle.session.session_id] = bundle
+
+    def session_ids(self) -> set[str]:
+        with self._lock:
+            return set(self._bundles)
+
+    def _expired(self, b: _SessionBundle) -> bool:
+        return time.time() - b.session.updated_at > self.ttl_s
+
+    def _pop_oldest_locked(self) -> _SessionBundle:
+        oldest = min(self._bundles.values(), key=lambda b: b.session.updated_at)
+        return self._bundles.pop(oldest.session.session_id)
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._bundles)

@@ -1,0 +1,119 @@
+"""Session archive record types.
+
+Mirrors the reference session-api's record families (reference
+internal/session/store.go:425 — sessions, messages, tool calls, provider
+calls, eval results, runtime events, usage) with the same archive
+posture: these records DESCRIBE what happened; they never decide
+resumability (reference internal/session/store.go:430-437 — the runtime
+context store is the only resume authority)."""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+import uuid
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+
+def _now() -> float:
+    return time.time()
+
+
+def _rid() -> str:
+    return uuid.uuid4().hex
+
+
+@dataclass
+class SessionRecord:
+    session_id: str
+    workspace: str = "default"
+    agent: str = ""
+    user_id: str = ""
+    created_at: float = field(default_factory=_now)
+    updated_at: float = field(default_factory=_now)
+    archived: bool = False
+    tier: str = "hot"  # hot | warm | cold — where the authoritative copy lives
+    attrs: dict = field(default_factory=dict)
+
+
+@dataclass
+class MessageRecord:
+    session_id: str
+    role: str  # user | assistant | tool
+    content: str
+    record_id: str = field(default_factory=_rid)
+    user_id: str = ""
+    turn_id: str = ""
+    created_at: float = field(default_factory=_now)
+    attrs: dict = field(default_factory=dict)
+
+
+@dataclass
+class ToolCallRecord:
+    session_id: str
+    tool: str
+    arguments: str
+    result: str = ""
+    status: str = "ok"  # ok | error | denied
+    record_id: str = field(default_factory=_rid)
+    turn_id: str = ""
+    duration_ms: float = 0.0
+    created_at: float = field(default_factory=_now)
+
+
+@dataclass
+class ProviderCallRecord:
+    session_id: str
+    provider: str
+    model: str
+    input_tokens: int = 0
+    output_tokens: int = 0
+    cost_usd: float = 0.0
+    latency_ms: float = 0.0
+    ttft_ms: float = 0.0
+    record_id: str = field(default_factory=_rid)
+    turn_id: str = ""
+    created_at: float = field(default_factory=_now)
+
+
+@dataclass
+class EvalResultRecord:
+    session_id: str
+    eval_name: str
+    score: float
+    passed: bool
+    source: str = "runtime-inline"  # runtime-inline | eval-worker | arena
+    record_id: str = field(default_factory=_rid)
+    turn_id: str = ""
+    details: dict = field(default_factory=dict)
+    created_at: float = field(default_factory=_now)
+
+
+@dataclass
+class RuntimeEventRecord:
+    session_id: str
+    event_type: str
+    data: dict = field(default_factory=dict)
+    record_id: str = field(default_factory=_rid)
+    created_at: float = field(default_factory=_now)
+
+
+RECORD_KINDS = {
+    "session": SessionRecord,
+    "message": MessageRecord,
+    "tool_call": ToolCallRecord,
+    "provider_call": ProviderCallRecord,
+    "eval_result": EvalResultRecord,
+    "event": RuntimeEventRecord,
+}
+
+
+def to_dict(rec: Any) -> dict:
+    return dataclasses.asdict(rec)
+
+
+def from_dict(kind: str, d: dict) -> Any:
+    cls = RECORD_KINDS[kind]
+    known = {f.name for f in dataclasses.fields(cls)}
+    return cls(**{k: v for k, v in d.items() if k in known})

@@ -1,0 +1,26 @@
+"""Session retention policy: the windows driving compaction.
+
+Mirrors the reference SessionRetentionPolicy CRD (reference
+api/v1alpha1/sessionretentionpolicy_types.go — hot/warm/cold retention
+windows consumed by the compaction CronJob)."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+
+@dataclass(frozen=True)
+class RetentionPolicy:
+    hot_idle_s: float = 3600.0        # hot → warm after idle this long
+    warm_window_s: float = 7 * 86400  # warm → cold past this age
+    cold_window_s: float = 90 * 86400  # cold purged past this age
+    batch_size: int = 100             # sessions demoted per compaction pass
+
+    def validate(self) -> None:
+        if not (0 < self.hot_idle_s <= self.warm_window_s <= self.cold_window_s):
+            raise ValueError(
+                "retention windows must satisfy 0 < hot <= warm <= cold; got "
+                f"hot={self.hot_idle_s} warm={self.warm_window_s} cold={self.cold_window_s}"
+            )
+        if self.batch_size <= 0:
+            raise ValueError("batch_size must be positive")

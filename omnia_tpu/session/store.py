@@ -1,0 +1,56 @@
+"""Session store interface shared by the hot/warm/cold tiers.
+
+One protocol, three implementations (reference
+internal/session/providers/{redis,postgres,cold}); the tiered registry
+composes them read-through (reference providers.go:159)."""
+
+from __future__ import annotations
+
+from typing import Optional, Protocol
+
+from omnia_tpu.session.records import (
+    EvalResultRecord,
+    MessageRecord,
+    ProviderCallRecord,
+    RuntimeEventRecord,
+    SessionRecord,
+    ToolCallRecord,
+)
+
+
+class SessionStore(Protocol):
+    # -- sessions ------------------------------------------------------
+    def ensure_session(self, rec: SessionRecord) -> SessionRecord: ...
+
+    def get_session(self, session_id: str) -> Optional[SessionRecord]: ...
+
+    def list_sessions(
+        self, workspace: Optional[str] = None, limit: int = 100
+    ) -> list[SessionRecord]: ...
+
+    def delete_session(self, session_id: str) -> bool: ...
+
+    # -- appends -------------------------------------------------------
+    def append_message(self, rec: MessageRecord) -> None: ...
+
+    def append_tool_call(self, rec: ToolCallRecord) -> None: ...
+
+    def append_provider_call(self, rec: ProviderCallRecord) -> None: ...
+
+    def append_eval_result(self, rec: EvalResultRecord) -> None: ...
+
+    def append_event(self, rec: RuntimeEventRecord) -> None: ...
+
+    # -- reads ---------------------------------------------------------
+    def messages(self, session_id: str) -> list[MessageRecord]: ...
+
+    def tool_calls(self, session_id: str) -> list[ToolCallRecord]: ...
+
+    def provider_calls(self, session_id: str) -> list[ProviderCallRecord]: ...
+
+    def eval_results(self, session_id: str) -> list[EvalResultRecord]: ...
+
+    def events(self, session_id: str) -> list[RuntimeEventRecord]: ...
+
+    # -- usage aggregation --------------------------------------------
+    def usage(self, workspace: Optional[str] = None) -> dict: ...

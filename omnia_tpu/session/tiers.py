@@ -1,0 +1,157 @@
+"""Tiered read-through composition: hot → warm → cold.
+
+Reference shape: providers.go:159 NewRegistry + hot_cache.go /
+warm_store.go / cold_archive.go. Writes land in the hot tier; reads fall
+through hot → warm → cold; the compaction engine (compaction.py) demotes
+between tiers on the retention schedule."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from omnia_tpu.session.cold import ColdArchive
+from omnia_tpu.session.hot import HotStore
+from omnia_tpu.session.records import (
+    EvalResultRecord,
+    MessageRecord,
+    ProviderCallRecord,
+    RuntimeEventRecord,
+    SessionRecord,
+    ToolCallRecord,
+)
+from omnia_tpu.session.warm import WarmStore
+
+_KIND_ATTR = {
+    "message": "messages",
+    "tool_call": "tool_calls",
+    "provider_call": "provider_calls",
+    "eval_result": "eval_results",
+    "event": "events",
+}
+
+
+def demote_bundle(warm: WarmStore, bundle) -> None:
+    """Write one hot-tier bundle into the warm store (used by compaction
+    and by hot-capacity eviction so records always survive demotion)."""
+    warm.ensure_session(bundle.session)
+    for m in bundle.messages:
+        warm.append_message(m)
+    for t in bundle.tool_calls:
+        warm.append_tool_call(t)
+    for p in bundle.provider_calls:
+        warm.append_provider_call(p)
+    for e in bundle.eval_results:
+        warm.append_eval_result(e)
+    for ev in bundle.events:
+        warm.append_event(ev)
+
+
+class TieredStore:
+    def __init__(
+        self,
+        hot: Optional[HotStore] = None,
+        warm: Optional[WarmStore] = None,
+        cold: Optional[ColdArchive] = None,
+    ) -> None:
+        # `is None`, not truthiness: empty Hot/Cold stores are falsy
+        # (they define __len__) and must not be replaced.
+        self.hot = hot if hot is not None else HotStore()
+        self.warm = warm if warm is not None else WarmStore()
+        self.cold = cold if cold is not None else ColdArchive()
+        if self.hot.evict_sink is None:
+            self.hot.evict_sink = lambda bundle: demote_bundle(self.warm, bundle)
+
+    # -- sessions ------------------------------------------------------
+
+    def ensure_session(self, rec: SessionRecord) -> SessionRecord:
+        return self.hot.ensure_session(rec)
+
+    def get_session(self, session_id: str) -> Optional[SessionRecord]:
+        return (
+            self.hot.get_session(session_id)
+            or self.warm.get_session(session_id)
+            or self.cold.get_session(session_id)
+        )
+
+    def list_sessions(
+        self, workspace: Optional[str] = None, limit: int = 100
+    ) -> list[SessionRecord]:
+        seen: dict[str, SessionRecord] = {}
+        for tier in (self.hot, self.warm, self.cold):
+            for s in tier.list_sessions(workspace, limit):
+                seen.setdefault(s.session_id, s)
+        out = sorted(seen.values(), key=lambda s: -s.updated_at)
+        return out[:limit]
+
+    def delete_session(self, session_id: str) -> bool:
+        hit = False
+        for tier in (self.hot, self.warm, self.cold):
+            hit = tier.delete_session(session_id) or hit
+        return hit
+
+    # -- appends (hot tier) -------------------------------------------
+
+    def append_message(self, rec: MessageRecord) -> None:
+        self.hot.append_message(rec)
+
+    def append_tool_call(self, rec: ToolCallRecord) -> None:
+        self.hot.append_tool_call(rec)
+
+    def append_provider_call(self, rec: ProviderCallRecord) -> None:
+        self.hot.append_provider_call(rec)
+
+    def append_eval_result(self, rec: EvalResultRecord) -> None:
+        self.hot.append_eval_result(rec)
+
+    def append_event(self, rec: RuntimeEventRecord) -> None:
+        self.hot.append_event(rec)
+
+    # -- reads (read-through) -----------------------------------------
+
+    def _read(self, kind: str, session_id: str) -> list:
+        """Merge records across ALL tiers: a session resumed after
+        demotion has new records in hot and its prior history in
+        warm/cold — returning only the top non-empty tier would hide the
+        older turns. Dedup by record_id, ordered by capture time."""
+        attr = _KIND_ATTR[kind]
+        seen: dict[str, object] = {}
+        for recs in (
+            self.cold.records(session_id, kind),
+            getattr(self.warm, attr)(session_id),
+            getattr(self.hot, attr)(session_id),
+        ):
+            for r in recs:
+                seen[r.record_id] = r
+        return sorted(seen.values(), key=lambda r: r.created_at)
+
+    def messages(self, session_id: str) -> list[MessageRecord]:
+        return self._read("message", session_id)
+
+    def tool_calls(self, session_id: str) -> list[ToolCallRecord]:
+        return self._read("tool_call", session_id)
+
+    def provider_calls(self, session_id: str) -> list[ProviderCallRecord]:
+        return self._read("provider_call", session_id)
+
+    def eval_results(self, session_id: str) -> list[EvalResultRecord]:
+        return self._read("eval_result", session_id)
+
+    def events(self, session_id: str) -> list[RuntimeEventRecord]:
+        return self._read("event", session_id)
+
+    # -- usage ---------------------------------------------------------
+
+    def usage(self, workspace: Optional[str] = None) -> dict:
+        h = self.hot.usage(workspace)
+        w = self.warm.usage(workspace)
+        # Distinct session ids across tiers: a demoted-then-resumed
+        # session exists in hot AND warm (and may linger in cold).
+        ids = {s.session_id for s in self.hot.list_sessions(workspace, 10**9)}
+        ids |= {s.session_id for s in self.warm.list_sessions(workspace, 10**9)}
+        ids |= self.cold.session_ids(workspace)
+        return {
+            "sessions": len(ids),
+            "input_tokens": h["input_tokens"] + w["input_tokens"],
+            "output_tokens": h["output_tokens"] + w["output_tokens"],
+            "cost_usd": round(h["cost_usd"] + w["cost_usd"], 6),
+        }

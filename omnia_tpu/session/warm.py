@@ -1,0 +1,296 @@
+"""Warm tier: SQLite-backed durable session archive.
+
+The Postgres-equivalent tier (reference
+internal/session/providers/postgres/ — partitioned tables, eval /
+provider-call / usage stores). SQLite keeps the framework dependency-free
+on a dev box; the schema and store surface are shaped so a Postgres
+backend is a connection-string swap. Time-partitioning is modelled with
+a `day` column + index (the reference partitions by time range,
+provider_partition.go); usage aggregation is SQL-side like the
+reference's aggregate endpoints."""
+
+from __future__ import annotations
+
+import json
+import sqlite3
+import threading
+import time
+from typing import Optional
+
+from omnia_tpu.session.records import (
+    EvalResultRecord,
+    MessageRecord,
+    ProviderCallRecord,
+    RuntimeEventRecord,
+    SessionRecord,
+    ToolCallRecord,
+)
+
+_SCHEMA = """
+CREATE TABLE IF NOT EXISTS sessions (
+  session_id TEXT PRIMARY KEY,
+  workspace TEXT NOT NULL DEFAULT 'default',
+  agent TEXT NOT NULL DEFAULT '',
+  user_id TEXT NOT NULL DEFAULT '',
+  created_at REAL NOT NULL,
+  updated_at REAL NOT NULL,
+  archived INTEGER NOT NULL DEFAULT 0,
+  tier TEXT NOT NULL DEFAULT 'warm',
+  attrs TEXT NOT NULL DEFAULT '{}'
+);
+CREATE INDEX IF NOT EXISTS idx_sessions_ws ON sessions(workspace, updated_at);
+
+CREATE TABLE IF NOT EXISTS records (
+  record_id TEXT PRIMARY KEY,
+  kind TEXT NOT NULL,
+  session_id TEXT NOT NULL,
+  day TEXT NOT NULL,
+  created_at REAL NOT NULL,
+  body TEXT NOT NULL
+);
+CREATE INDEX IF NOT EXISTS idx_records_session ON records(session_id, kind, created_at);
+CREATE INDEX IF NOT EXISTS idx_records_day ON records(day, kind);
+
+CREATE TABLE IF NOT EXISTS provider_usage (
+  workspace TEXT NOT NULL,
+  day TEXT NOT NULL,
+  provider TEXT NOT NULL,
+  model TEXT NOT NULL,
+  input_tokens INTEGER NOT NULL DEFAULT 0,
+  output_tokens INTEGER NOT NULL DEFAULT 0,
+  cost_usd REAL NOT NULL DEFAULT 0,
+  calls INTEGER NOT NULL DEFAULT 0,
+  PRIMARY KEY (workspace, day, provider, model)
+);
+"""
+
+
+def _day(ts: float) -> str:
+    return time.strftime("%Y-%m-%d", time.gmtime(ts))
+
+
+class WarmStore:
+    def __init__(self, path: str = ":memory:") -> None:
+        # One shared connection guarded by a lock: SQLite serializes writes
+        # anyway and this keeps :memory: stores coherent across threads.
+        self._db = sqlite3.connect(path, check_same_thread=False)
+        self._db.execute("PRAGMA journal_mode=WAL")
+        self._lock = threading.Lock()
+        with self._lock:
+            self._db.executescript(_SCHEMA)
+            self._db.commit()
+
+    # -- sessions ------------------------------------------------------
+
+    def ensure_session(self, rec: SessionRecord) -> SessionRecord:
+        with self._lock:
+            self._db.execute(
+                """INSERT INTO sessions
+                   (session_id, workspace, agent, user_id, created_at,
+                    updated_at, archived, tier, attrs)
+                   VALUES (?,?,?,?,?,?,?,?,?)
+                   ON CONFLICT(session_id) DO UPDATE SET updated_at=excluded.updated_at""",
+                (
+                    rec.session_id,
+                    rec.workspace,
+                    rec.agent,
+                    rec.user_id,
+                    rec.created_at,
+                    rec.updated_at,
+                    int(rec.archived),
+                    "warm",
+                    json.dumps(rec.attrs),
+                ),
+            )
+            self._db.commit()
+        rec.tier = "warm"
+        return rec
+
+    def get_session(self, session_id: str) -> Optional[SessionRecord]:
+        with self._lock:
+            row = self._db.execute(
+                "SELECT session_id, workspace, agent, user_id, created_at,"
+                " updated_at, archived, tier, attrs FROM sessions WHERE session_id=?",
+                (session_id,),
+            ).fetchone()
+        return self._row_to_session(row) if row else None
+
+    def list_sessions(
+        self, workspace: Optional[str] = None, limit: int = 100
+    ) -> list[SessionRecord]:
+        q = (
+            "SELECT session_id, workspace, agent, user_id, created_at,"
+            " updated_at, archived, tier, attrs FROM sessions"
+        )
+        params: tuple = ()
+        if workspace is not None:
+            q += " WHERE workspace=?"
+            params = (workspace,)
+        q += " ORDER BY updated_at DESC LIMIT ?"
+        with self._lock:
+            rows = self._db.execute(q, params + (limit,)).fetchall()
+        return [self._row_to_session(r) for r in rows]
+
+    def delete_session(self, session_id: str) -> bool:
+        with self._lock:
+            cur = self._db.execute(
+                "DELETE FROM sessions WHERE session_id=?", (session_id,)
+            )
+            self._db.execute("DELETE FROM records WHERE session_id=?", (session_id,))
+            self._db.commit()
+            return cur.rowcount > 0
+
+    @staticmethod
+    def _row_to_session(row) -> SessionRecord:
+        return SessionRecord(
+            session_id=row[0],
+            workspace=row[1],
+            agent=row[2],
+            user_id=row[3],
+            created_at=row[4],
+            updated_at=row[5],
+            archived=bool(row[6]),
+            tier=row[7],
+            attrs=json.loads(row[8]),
+        )
+
+    # -- appends -------------------------------------------------------
+
+    def _append(self, kind: str, session_id: str, created_at: float, body: dict):
+        with self._lock:
+            self._db.execute(
+                "INSERT OR REPLACE INTO records"
+                " (record_id, kind, session_id, day, created_at, body)"
+                " VALUES (?,?,?,?,?,?)",
+                (
+                    body.get("record_id"),
+                    kind,
+                    session_id,
+                    _day(created_at),
+                    created_at,
+                    json.dumps(body),
+                ),
+            )
+            self._db.commit()
+
+    def append_message(self, rec: MessageRecord) -> None:
+        self._append("message", rec.session_id, rec.created_at, rec.__dict__)
+
+    def append_tool_call(self, rec: ToolCallRecord) -> None:
+        self._append("tool_call", rec.session_id, rec.created_at, rec.__dict__)
+
+    def append_provider_call(self, rec: ProviderCallRecord) -> None:
+        # Usage increments are not idempotent, so skip them when this
+        # record_id was already written (a retried demotion re-appends).
+        with self._lock:
+            dup = self._db.execute(
+                "SELECT 1 FROM records WHERE record_id=?", (rec.record_id,)
+            ).fetchone()
+        self._append("provider_call", rec.session_id, rec.created_at, rec.__dict__)
+        if dup:
+            return
+        sess = self.get_session(rec.session_id)
+        ws = sess.workspace if sess else "default"
+        with self._lock:
+            self._db.execute(
+                """INSERT INTO provider_usage
+                   (workspace, day, provider, model, input_tokens, output_tokens, cost_usd, calls)
+                   VALUES (?,?,?,?,?,?,?,1)
+                   ON CONFLICT(workspace, day, provider, model) DO UPDATE SET
+                     input_tokens = input_tokens + excluded.input_tokens,
+                     output_tokens = output_tokens + excluded.output_tokens,
+                     cost_usd = cost_usd + excluded.cost_usd,
+                     calls = calls + 1""",
+                (
+                    ws,
+                    _day(rec.created_at),
+                    rec.provider,
+                    rec.model,
+                    rec.input_tokens,
+                    rec.output_tokens,
+                    rec.cost_usd,
+                ),
+            )
+            self._db.commit()
+
+    def append_eval_result(self, rec: EvalResultRecord) -> None:
+        self._append("eval_result", rec.session_id, rec.created_at, rec.__dict__)
+
+    def append_event(self, rec: RuntimeEventRecord) -> None:
+        self._append("event", rec.session_id, rec.created_at, rec.__dict__)
+
+    # -- reads ---------------------------------------------------------
+
+    def _read(self, kind: str, session_id: str) -> list[dict]:
+        with self._lock:
+            rows = self._db.execute(
+                "SELECT body FROM records WHERE session_id=? AND kind=?"
+                " ORDER BY created_at",
+                (session_id, kind),
+            ).fetchall()
+        return [json.loads(r[0]) for r in rows]
+
+    def messages(self, session_id: str) -> list[MessageRecord]:
+        return [MessageRecord(**d) for d in self._read("message", session_id)]
+
+    def tool_calls(self, session_id: str) -> list[ToolCallRecord]:
+        return [ToolCallRecord(**d) for d in self._read("tool_call", session_id)]
+
+    def provider_calls(self, session_id: str) -> list[ProviderCallRecord]:
+        return [
+            ProviderCallRecord(**d) for d in self._read("provider_call", session_id)
+        ]
+
+    def eval_results(self, session_id: str) -> list[EvalResultRecord]:
+        return [EvalResultRecord(**d) for d in self._read("eval_result", session_id)]
+
+    def events(self, session_id: str) -> list[RuntimeEventRecord]:
+        return [RuntimeEventRecord(**d) for d in self._read("event", session_id)]
+
+    # -- usage ---------------------------------------------------------
+
+    def usage(self, workspace: Optional[str] = None) -> dict:
+        q = (
+            "SELECT COALESCE(SUM(input_tokens),0), COALESCE(SUM(output_tokens),0),"
+            " COALESCE(SUM(cost_usd),0), COALESCE(SUM(calls),0) FROM provider_usage"
+        )
+        params: tuple = ()
+        if workspace is not None:
+            q += " WHERE workspace=?"
+            params = (workspace,)
+        with self._lock:
+            row = self._db.execute(q, params).fetchone()
+            n_sessions = self._db.execute(
+                "SELECT COUNT(*) FROM sessions"
+                + (" WHERE workspace=?" if workspace is not None else ""),
+                params,
+            ).fetchone()[0]
+        return {
+            "sessions": n_sessions,
+            "input_tokens": int(row[0]),
+            "output_tokens": int(row[1]),
+            "cost_usd": round(row[2], 6),
+            "calls": int(row[3]),
+        }
+
+    # -- compaction hooks ---------------------------------------------
+
+    def sessions_older_than(self, cutoff_ts: float, limit: int = 100) -> list[SessionRecord]:
+        with self._lock:
+            rows = self._db.execute(
+                "SELECT session_id, workspace, agent, user_id, created_at,"
+                " updated_at, archived, tier, attrs FROM sessions"
+                " WHERE updated_at < ? ORDER BY updated_at LIMIT ?",
+                (cutoff_ts, limit),
+            ).fetchall()
+        return [self._row_to_session(r) for r in rows]
+
+    def all_records(self, session_id: str) -> dict[str, list[dict]]:
+        out: dict[str, list[dict]] = {}
+        for kind in ("message", "tool_call", "provider_call", "eval_result", "event"):
+            out[kind] = self._read(kind, session_id)
+        return out
+
+    def close(self) -> None:
+        with self._lock:
+            self._db.close()

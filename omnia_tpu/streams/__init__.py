@@ -1,0 +1,17 @@
+from omnia_tpu.streams.streams import (
+    Entry,
+    FileStreamBackend,
+    MemoryStreamBackend,
+    PendingEntry,
+    Stream,
+    StreamBackend,
+)
+
+__all__ = [
+    "Entry",
+    "FileStreamBackend",
+    "MemoryStreamBackend",
+    "PendingEntry",
+    "Stream",
+    "StreamBackend",
+]

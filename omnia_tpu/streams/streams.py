@@ -1,0 +1,296 @@
+"""Durable work/event streams: the platform's queue fabric.
+
+The reference runs its whole eval/event plane on Redis Streams — consumer
+groups with explicit ack and pending-message reclaim for crashed peers
+(reference ee/pkg/arena/queue/redis.go, redis_reclaim.go;
+internal/session/api/event_publisher.go; ee/pkg/evals/worker_consume.go:84
+XReadGroup loop). This module is the in-tree equivalent: an append-only
+log with consumer groups, ack, and claim-idle semantics, over pluggable
+backends (in-memory for single-process, file-backed jsonl for
+multi-process dev topologies; a Redis backend drops in behind the same
+interface for cluster deployments).
+
+Semantics preserved from the reference:
+- at-least-once delivery: an entry stays "pending" for its consumer until
+  acked; a reclaim pass re-delivers entries idle past a deadline to a new
+  consumer (crashed-peer recovery).
+- per-group cursors: independent consumer groups each see every entry.
+- monotonic ids `<millis>-<seq>` ordered and resumable.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import threading
+import time
+from typing import Iterator, Optional
+
+
+@dataclasses.dataclass(frozen=True)
+class Entry:
+    id: str
+    data: dict
+
+    def seq_key(self) -> tuple[int, int]:
+        ms, seq = self.id.split("-")
+        return (int(ms), int(seq))
+
+
+@dataclasses.dataclass
+class PendingEntry:
+    entry: Entry
+    consumer: str
+    delivered_at: float
+    delivery_count: int = 1
+
+
+class StreamBackend:
+    """Storage for one named stream. Subclasses provide append/scan/ack
+    persistence; group bookkeeping lives in Stream."""
+
+    def append(self, data: dict) -> str:
+        raise NotImplementedError
+
+    def scan(self, after_id: Optional[str]) -> Iterator[Entry]:
+        raise NotImplementedError
+
+    def length(self) -> int:
+        raise NotImplementedError
+
+
+class MemoryStreamBackend(StreamBackend):
+    def __init__(self) -> None:
+        self._entries: list[Entry] = []
+        self._lock = threading.Lock()
+        self._seq = 0
+        self._last_ms = 0
+
+    def append(self, data: dict) -> str:
+        with self._lock:
+            ms = int(time.time() * 1000)
+            if ms <= self._last_ms:
+                ms = self._last_ms
+                self._seq += 1
+            else:
+                self._last_ms = ms
+                self._seq = 0
+            eid = f"{ms}-{self._seq}"
+            self._entries.append(Entry(eid, data))
+            return eid
+
+    def scan(self, after_id: Optional[str]) -> Iterator[Entry]:
+        with self._lock:
+            snapshot = list(self._entries)
+        yield from _after_in_log_order(snapshot, after_id)
+
+    def length(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+
+class FileStreamBackend(StreamBackend):
+    """Append-only jsonl file; safe for multiple processes appending via
+    O_APPEND single-write records (each line < PIPE_BUF stays atomic on
+    POSIX for practical record sizes)."""
+
+    def __init__(self, path: str) -> None:
+        self.path = path
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        self._lock = threading.Lock()
+        self._seq = 0
+        self._last_ms = 0
+
+    def append(self, data: dict) -> str:
+        with self._lock:
+            ms = int(time.time() * 1000)
+            if ms <= self._last_ms:
+                ms = self._last_ms
+                self._seq += 1
+            else:
+                self._last_ms = ms
+                self._seq = 0
+            # Disambiguate concurrent appenders by pid in the seq slot.
+            eid = f"{ms}-{self._seq * 100000 + (os.getpid() % 100000)}"
+            line = json.dumps({"id": eid, "data": data}) + "\n"
+            with open(self.path, "a", encoding="utf-8") as f:
+                f.write(line)
+            return eid
+
+    def scan(self, after_id: Optional[str]) -> Iterator[Entry]:
+        if not os.path.exists(self.path):
+            return
+        entries: list[Entry] = []
+        with open(self.path, encoding="utf-8") as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    d = json.loads(line)
+                except json.JSONDecodeError:
+                    continue  # torn tail write from a live appender
+                entries.append(Entry(d["id"], d["data"]))
+        yield from _after_in_log_order(entries, after_id)
+
+    def length(self) -> int:
+        return sum(1 for _ in self.scan(None))
+
+
+def _parse_id(eid: str) -> tuple[int, int]:
+    ms, seq = eid.split("-")
+    return (int(ms), int(seq))
+
+
+def _after_in_log_order(entries: list[Entry], after_id: Optional[str]) -> Iterator[Entry]:
+    """Entries strictly after `after_id` in LOG order, not id order.
+
+    Concurrent multi-process appenders can mint ids whose numeric order
+    disagrees with file order within the same millisecond; a positional
+    cursor (find the id, yield what follows) neither skips nor redelivers
+    in that case. Falls back to id comparison only if the cursor id has
+    vanished (e.g. truncated log)."""
+    if after_id is None:
+        yield from entries
+        return
+    for i, e in enumerate(entries):
+        if e.id == after_id:
+            yield from entries[i + 1 :]
+            return
+    after = _parse_id(after_id)
+    for e in entries:
+        if e.seq_key() > after:
+            yield e
+
+
+class _Group:
+    def __init__(self) -> None:
+        self.cursor: Optional[str] = None  # last id handed out
+        self.pending: dict[str, PendingEntry] = {}
+        self.acked: int = 0
+
+
+class Stream:
+    """One named stream with consumer-group read/ack/reclaim semantics."""
+
+    def __init__(self, backend: Optional[StreamBackend] = None) -> None:
+        self.backend = backend or MemoryStreamBackend()
+        self._groups: dict[str, _Group] = {}
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+
+    # -- producer ------------------------------------------------------
+
+    def add(self, data: dict) -> str:
+        eid = self.backend.append(data)
+        with self._cond:
+            self._cond.notify_all()
+        return eid
+
+    # -- consumer groups ----------------------------------------------
+
+    def ensure_group(self, group: str, from_start: bool = True) -> None:
+        with self._lock:
+            if group not in self._groups:
+                g = _Group()
+                if not from_start:
+                    last = None
+                    for e in self.backend.scan(None):
+                        last = e.id
+                    g.cursor = last
+                self._groups[group] = g
+
+    def read_group(
+        self,
+        group: str,
+        consumer: str,
+        count: int = 10,
+        block_s: float = 0.0,
+    ) -> list[Entry]:
+        """XREADGROUP: hand out new entries past the group cursor, marking
+        them pending for `consumer`. Blocks up to block_s when empty."""
+        self.ensure_group(group)
+        deadline = time.monotonic() + block_s
+        while True:
+            with self._cond:
+                g = self._groups[group]
+                out: list[Entry] = []
+                for e in self.backend.scan(g.cursor):
+                    g.cursor = e.id
+                    g.pending[e.id] = PendingEntry(e, consumer, time.time())
+                    out.append(e)
+                    if len(out) >= count:
+                        break
+                if out:
+                    return out
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return []
+                self._cond.wait(timeout=min(remaining, 0.25))
+
+    def ack(self, group: str, *ids: str) -> int:
+        with self._lock:
+            g = self._groups.get(group)
+            if g is None:
+                return 0
+            n = 0
+            for eid in ids:
+                if g.pending.pop(eid, None) is not None:
+                    n += 1
+            g.acked += n
+            return n
+
+    def pending(self, group: str) -> list[PendingEntry]:
+        with self._lock:
+            g = self._groups.get(group)
+            return sorted(
+                (g.pending.values() if g else []),
+                key=lambda p: p.entry.seq_key(),
+            )
+
+    def claim_idle(
+        self,
+        group: str,
+        consumer: str,
+        min_idle_s: float,
+        count: int = 10,
+    ) -> list[Entry]:
+        """XAUTOCLAIM: take over entries pending longer than min_idle_s
+        (their consumer is presumed crashed); bumps delivery_count so
+        callers can dead-letter poison entries."""
+        now = time.time()
+        with self._lock:
+            g = self._groups.get(group)
+            if g is None:
+                return []
+            claimed: list[Entry] = []
+            for p in sorted(g.pending.values(), key=lambda p: p.delivered_at):
+                if now - p.delivered_at >= min_idle_s:
+                    p.consumer = consumer
+                    p.delivered_at = now
+                    p.delivery_count += 1
+                    claimed.append(p.entry)
+                    if len(claimed) >= count:
+                        break
+            return claimed
+
+    def delivery_count(self, group: str, eid: str) -> int:
+        with self._lock:
+            g = self._groups.get(group)
+            p = g.pending.get(eid) if g else None
+            return p.delivery_count if p else 0
+
+    def stats(self, group: Optional[str] = None) -> dict:
+        with self._lock:
+            d: dict = {"length": self.backend.length()}
+            groups = (
+                {group: self._groups[group]}
+                if group and group in self._groups
+                else self._groups
+            )
+            d["groups"] = {
+                name: {"pending": len(g.pending), "acked": g.acked}
+                for name, g in groups.items()
+            }
+            return d
