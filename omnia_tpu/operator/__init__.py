@@ -1,0 +1,29 @@
+from omnia_tpu.operator.resources import Resource, ResourceKind
+from omnia_tpu.operator.store import FileResourceStore, MemoryResourceStore, ResourceStore
+from omnia_tpu.operator.validation import ValidationError, validate
+from omnia_tpu.operator.deployment import (
+    AgentDeployment,
+    InProcessPodBackend,
+    K8sManifestBackend,
+)
+from omnia_tpu.operator.autoscaling import Autoscaler, AutoscalingPolicy
+from omnia_tpu.operator.rollout import RolloutEngine, RolloutState
+from omnia_tpu.operator.controller import ControllerManager
+
+__all__ = [
+    "AgentDeployment",
+    "Autoscaler",
+    "AutoscalingPolicy",
+    "ControllerManager",
+    "FileResourceStore",
+    "InProcessPodBackend",
+    "K8sManifestBackend",
+    "MemoryResourceStore",
+    "Resource",
+    "ResourceKind",
+    "ResourceStore",
+    "RolloutEngine",
+    "RolloutState",
+    "ValidationError",
+    "validate",
+]

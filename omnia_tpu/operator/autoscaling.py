@@ -1,0 +1,90 @@
+"""Autoscaling: inference-queue-depth driven replica control.
+
+The reference scales agents with HPA or KEDA on the Prometheus metric
+`omnia_agent_connections_active`, including scale-to-zero (reference
+internal/controller/autoscaling.go:74/:204/:306-319). The TPU build's
+north star rewires the trigger to **inference queue depth** — the
+engine's continuous-batching backlog is the true load signal on a TPU
+slice (SURVEY.md §2.4). This scaler consumes per-pod queue depth +
+active connections and returns a desired replica count; the controller
+applies it through the pod backend.
+"""
+
+from __future__ import annotations
+
+import math
+import time
+from dataclasses import dataclass
+from typing import Optional
+
+
+@dataclass(frozen=True)
+class AutoscalingPolicy:
+    min_replicas: int = 0               # 0 => scale-to-zero allowed
+    max_replicas: int = 4
+    target_queue_depth: float = 8.0     # per-replica backlog target
+    scale_to_zero_after_idle_s: float = 300.0
+    stabilization_s: float = 30.0       # min seconds between scale-downs
+
+    @classmethod
+    def from_spec(
+        cls, spec: Optional[dict], fallback_replicas: int = 1
+    ) -> "AutoscalingPolicy":
+        if not spec:
+            # No autoscaling block: pin to spec.replicas.
+            return cls(min_replicas=fallback_replicas, max_replicas=fallback_replicas)
+        return cls(
+            min_replicas=spec.get("minReplicas", 0),
+            max_replicas=spec.get("maxReplicas", 4),
+            target_queue_depth=spec.get("targetQueueDepth", 8.0),
+            scale_to_zero_after_idle_s=spec.get("scaleToZeroAfterIdleSeconds", 300.0),
+            stabilization_s=spec.get("stabilizationSeconds", 30.0),
+        )
+
+
+class Autoscaler:
+    def __init__(self, policy: AutoscalingPolicy):
+        self.policy = policy
+        self._last_active_at = time.monotonic()
+        self._last_change = 0.0
+
+    def desired_replicas(
+        self,
+        current: int,
+        total_queue_depth: float,
+        active_connections: int,
+        now: Optional[float] = None,
+    ) -> int:
+        """KEDA/HPA-style: ceil(load / per-replica target), clamped, with
+        scale-to-zero only after a sustained idle window and scale-down
+        stabilization to avoid flapping."""
+        p = self.policy
+        now = time.monotonic() if now is None else now
+        busy = total_queue_depth > 0 or active_connections > 0
+        if busy:
+            self._last_active_at = now
+
+        if total_queue_depth > 0:
+            want = math.ceil(total_queue_depth / p.target_queue_depth)
+        elif active_connections > 0:
+            want = max(1, current)
+        else:
+            want = 0 if self._idle_long_enough(now) else max(1, min(current, p.max_replicas))
+
+        want = max(p.min_replicas, min(p.max_replicas, want))
+        # Cold-start from zero on any load (KEDA activation semantics).
+        if current == 0 and busy:
+            want = max(want, 1)
+        # Scale-downs hold for stabilization_s after the last replica
+        # change (HPA stabilization: don't thrash on a transient dip).
+        if want < current and now - self._last_change < p.stabilization_s:
+            return current
+        if want != current:
+            self._last_change = now
+        return want
+
+    def _idle_long_enough(self, now: float) -> bool:
+        return (
+            self.policy.min_replicas == 0
+            and now - self._last_active_at >= self.policy.scale_to_zero_after_idle_s
+        )

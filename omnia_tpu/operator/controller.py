@@ -1,0 +1,384 @@
+"""Controller manager: reconcilers over the resource store.
+
+The reconcile flow mirrors the reference's AgentRuntimeReconciler
+(reference internal/controller/agentruntime_controller.go:479 →
+:523 reconcileReferences → :539 reconcileResources → :548
+enforceCapabilities → :551 reconcileRollout → :566 reconcileAutoscaling
+→ :630 status update), plus Provider and PromptPack reconcilers. Watch
+events enqueue keys into a work queue drained by `reconcile_once` /
+`run` — level-triggered like controller-runtime: each pass recomputes
+from current state."""
+
+from __future__ import annotations
+
+import logging
+import queue
+import threading
+import time
+from typing import Optional
+
+from omnia_tpu.operator.autoscaling import Autoscaler, AutoscalingPolicy
+from omnia_tpu.operator.deployment import AgentDeployment, InProcessPodBackend
+from omnia_tpu.operator.resources import Resource, ResourceKind, resolve_ref
+from omnia_tpu.operator.rollout import RolloutEngine
+from omnia_tpu.operator.store import ResourceStore
+
+logger = logging.getLogger(__name__)
+
+
+class ControllerManager:
+    def __init__(
+        self,
+        store: ResourceStore,
+        backend: Optional[InProcessPodBackend] = None,
+        session_api_url: Optional[str] = None,
+        capability_probe_timeout_s: float = 600.0,
+        wait_ready: bool = True,
+    ) -> None:
+        self.store = store
+        self.backend = backend or InProcessPodBackend()
+        self.session_api_url = session_api_url
+        self.capability_probe_timeout_s = capability_probe_timeout_s
+        self.wait_ready = wait_ready
+        self.rollouts = RolloutEngine(self.backend)
+        self.deployments: dict[str, AgentDeployment] = {}
+        self._autoscalers: dict[str, Autoscaler] = {}
+        self._queue: "queue.Queue[tuple[str, str, str]]" = queue.Queue()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        store.watch(self._on_event)
+
+    # -- watch fan-in ---------------------------------------------------
+
+    def _on_event(self, event: str, res: Resource) -> None:
+        if res.kind == ResourceKind.AGENT_RUNTIME.value:
+            self._queue.put((res.namespace, res.kind, res.name))
+        elif res.kind in (
+            ResourceKind.PROVIDER.value,
+            ResourceKind.PROMPT_PACK.value,
+            ResourceKind.TOOL_REGISTRY.value,
+        ):
+            # Cross-resource fan-in: requeue every AgentRuntime that might
+            # reference this (reference agentruntime_watches.go).
+            self._queue.put((res.namespace, res.kind, res.name))
+            for ar in self.store.list(ResourceKind.AGENT_RUNTIME.value, res.namespace):
+                self._queue.put((ar.namespace, ar.kind, ar.name))
+
+    # -- run loop -------------------------------------------------------
+
+    def run(self, resync_s: float = 5.0) -> None:
+        self._thread = threading.Thread(
+            target=self._loop, args=(resync_s,), daemon=True
+        )
+        self._thread.start()
+
+    def _loop(self, resync_s: float) -> None:
+        last_resync = 0.0
+        while not self._stop.is_set():
+            try:
+                key = self._queue.get(timeout=0.25)
+                self.reconcile_key(*key)
+            except queue.Empty:
+                pass
+            if time.monotonic() - last_resync >= resync_s:
+                last_resync = time.monotonic()
+                self.resync()
+
+    def shutdown(self) -> None:
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=5)
+        for dep in self.deployments.values():
+            for p in dep.pods + dep.candidate_pods:
+                try:
+                    p.stop()
+                except Exception:
+                    pass
+        self.deployments.clear()
+
+    def drain_queue(self) -> None:
+        """Process every queued key (tests / single-step operation)."""
+        while True:
+            try:
+                key = self._queue.get_nowait()
+            except queue.Empty:
+                return
+            self.reconcile_key(*key)
+
+    def resync(self) -> None:
+        """Periodic level-trigger: autoscale + rollout ticks + status."""
+        for ar in self.store.list(ResourceKind.AGENT_RUNTIME.value):
+            self.reconcile_agent_runtime(ar)
+
+    # -- reconcilers ----------------------------------------------------
+
+    def reconcile_key(self, namespace: str, kind: str, name: str) -> None:
+        res = self.store.get(namespace, kind, name)
+        if res is None:
+            if kind == ResourceKind.AGENT_RUNTIME.value:
+                self._teardown(f"{namespace}/{kind}/{name}")
+            return
+        if kind == ResourceKind.AGENT_RUNTIME.value:
+            self.reconcile_agent_runtime(res)
+        elif kind == ResourceKind.PROVIDER.value:
+            self.reconcile_provider(res)
+        elif kind == ResourceKind.PROMPT_PACK.value:
+            self.reconcile_prompt_pack(res)
+
+    def reconcile_provider(self, res: Resource) -> None:
+        """Credential/model validation → phase (reference
+        provider_controller.go → phase Ready/Error)."""
+        spec = res.spec
+        phase, msg = "Ready", ""
+        if spec.get("type") == "tpu":
+            from omnia_tpu.models import PRESETS
+
+            if spec.get("model") not in PRESETS:
+                phase, msg = "Error", f"unknown model preset {spec.get('model')!r}"
+        self.store.update_status(res, {"phase": phase, "message": msg})
+
+    def reconcile_prompt_pack(self, res: Resource) -> None:
+        from omnia_tpu.runtime.packs import validate_pack
+
+        errs = validate_pack(res.spec.get("content") or {})
+        self.store.update_status(
+            res,
+            {
+                "phase": "Error" if errs else "Ready",
+                "message": "; ".join(errs),
+                "version": (res.spec.get("content") or {}).get("version", ""),
+            },
+        )
+
+    def reconcile_agent_runtime(self, res: Resource) -> None:
+        key = res.key
+        refs = self._resolve_refs(res)
+        if refs is None:
+            return  # status already written by _resolve_refs
+        pack_doc, provider_specs, default_provider, tool_configs = refs
+
+        dep = self.deployments.get(key)
+        if dep is None:
+            dep = AgentDeployment(
+                resource=res,
+                pack_doc=pack_doc,
+                provider_specs=provider_specs,
+                default_provider=default_provider,
+                tool_configs=tool_configs,
+                session_api_url=self.session_api_url,
+                required_capabilities=self._required_capabilities(res, tool_configs),
+                replicas=res.spec.get("replicas", 1),
+            )
+            dep.stable_hash = dep.config_hash()
+            self.deployments[key] = dep
+            self.backend.scale(dep, dep.replicas, wait_ready=self.wait_ready)
+        else:
+            dep.resource = res
+            dep.pack_doc = pack_doc
+            dep.provider_specs = provider_specs
+            dep.default_provider = default_provider
+            dep.tool_configs = tool_configs
+            dep.required_capabilities = self._required_capabilities(res, tool_configs)
+            dep.replicas = res.spec.get("replicas", 1)
+
+        # Capability gate (reference capability_gate.go:125): scale to 0
+        # until a running runtime advertises what the spec requires. The
+        # gate LATCHES on the probed config hash — otherwise the next
+        # resync would see zero pods, un-gate, scale up, and flap.
+        gate_key = dep.config_hash() + "|" + ",".join(sorted(dep.required_capabilities))
+        if dep.gate_blocked_hash == gate_key:
+            self._write_blocked(res, dep, "latched: config unchanged since probe")
+            return
+        if dep.gate_blocked_hash:
+            dep.gate_blocked_hash = ""  # config changed: re-admit and re-probe
+            if not dep.pods and not dep.candidate_pods:
+                self.backend.scale(dep, max(1, dep.replicas), wait_ready=self.wait_ready)
+        gated, missing = self._capability_gate(dep)
+        if gated:
+            dep.gate_blocked_hash = gate_key
+            self.backend.scale(dep, 0)
+            self._write_blocked(
+                res, dep, f"runtime missing capabilities: {missing}"
+            )
+            return
+
+        # Rollout on config change.
+        self.rollouts.tick(dep)
+
+        # Autoscaling on queue depth + connections.
+        self._autoscale(key, dep)
+
+        self._write_status(
+            res,
+            dep,
+            phase="Running" if dep.pods or dep.candidate_pods else "Idle",
+            conditions=[
+                {"type": "CapabilitiesSatisfied", "status": "True", "message": ""}
+            ],
+        )
+
+    # -- pieces ---------------------------------------------------------
+
+    def _resolve_refs(self, res: Resource):
+        ns = res.namespace
+        pack = resolve_ref(self.store, ns, ResourceKind.PROMPT_PACK, res.spec.get("promptPackRef"))
+        if pack is None:
+            self._write_ref_error(res, "promptPackRef not found")
+            return None
+        provider_specs: list[dict] = []
+        default_provider = ""
+        for entry in res.spec.get("providers", []):
+            pres = resolve_ref(self.store, ns, ResourceKind.PROVIDER, entry.get("providerRef"))
+            if pres is None:
+                self._write_ref_error(
+                    res, f"providerRef {entry.get('providerRef')} not found"
+                )
+                return None
+            spec = {
+                "name": entry["name"],
+                "type": pres.spec.get("type", "tpu"),
+                "role": pres.spec.get("role", "llm"),
+                "model": pres.spec.get("model", ""),
+                "options": pres.spec.get("options", {}),
+                "input_cost_per_mtok": pres.spec.get("pricing", {}).get("inputPerMTok", 0.0),
+                "output_cost_per_mtok": pres.spec.get("pricing", {}).get("outputPerMTok", 0.0),
+            }
+            if not spec["model"]:
+                spec.pop("model")
+            provider_specs.append(spec)
+            if entry.get("default") or not default_provider:
+                default_provider = entry["name"]
+        tool_configs: list[dict] = []
+        treg = resolve_ref(self.store, ns, ResourceKind.TOOL_REGISTRY, res.spec.get("toolRegistryRef"))
+        if res.spec.get("toolRegistryRef") and treg is None:
+            self._write_ref_error(res, "toolRegistryRef not found")
+            return None
+        if treg is not None:
+            tool_configs = treg.spec.get("tools", [])
+        return pack.spec["content"], provider_specs, default_provider, tool_configs
+
+    def _required_capabilities(self, res: Resource, tool_configs: list[dict]) -> list[str]:
+        from omnia_tpu.runtime.contract import Capability as C
+
+        req = [C.TEXT.value, C.STREAMING.value, C.RESUME.value]
+        if res.spec.get("mode", "agent") == "function":
+            req.append(C.FUNCTIONS.value)
+        if tool_configs:
+            req.append(C.TOOLS.value)
+            if any(t.get("handler", {}).get("type") == "client" for t in tool_configs):
+                req.append(C.CLIENT_TOOLS.value)
+        return req
+
+    def _capability_gate(self, dep: AgentDeployment):
+        """Probe the first live runtime's Health; gate if its advertised
+        capabilities miss anything required. No pods yet → not gated
+        (nothing to probe; scale-up proceeds and the next resync probes)."""
+        pods = dep.pods + dep.candidate_pods
+        if not pods:
+            return False, []
+        from omnia_tpu.runtime.client import RuntimeClient
+
+        try:
+            client = RuntimeClient(f"localhost:{pods[0].runtime_port}")
+            try:
+                h = client.health(timeout=self.capability_probe_timeout_s)
+            finally:
+                client.close()
+        except Exception as e:
+            logger.warning("capability probe failed for %s: %s", dep.name, e)
+            return False, []  # unreachable ≠ missing; retry next resync
+        missing = sorted(set(dep.required_capabilities) - set(h.capabilities))
+        return (True, missing) if missing else (False, [])
+
+    def _autoscale(self, key: str, dep: AgentDeployment) -> None:
+        policy = AutoscalingPolicy.from_spec(
+            dep.resource.spec.get("autoscaling"),
+            fallback_replicas=dep.resource.spec.get("replicas", 1),
+        )
+        scaler = self._autoscalers.get(key)
+        if scaler is None or scaler.policy != policy:
+            scaler = Autoscaler(policy)
+            self._autoscalers[key] = scaler
+        depth, conns = self._load_signals(dep)
+        want = scaler.desired_replicas(len(dep.pods), depth, conns)
+        if want != len(dep.pods):
+            logger.info(
+                "autoscale %s: %d -> %d (queue=%s conns=%s)",
+                dep.name, len(dep.pods), want, depth, conns,
+            )
+            self.backend.scale(dep, want, wait_ready=self.wait_ready)
+
+    def _load_signals(self, dep: AgentDeployment) -> tuple[float, int]:
+        from omnia_tpu.runtime.client import RuntimeClient
+
+        depth = 0.0
+        conns = 0
+        for pod in dep.pods + dep.candidate_pods:
+            try:
+                client = RuntimeClient(f"localhost:{pod.runtime_port}")
+                try:
+                    h = client.health()
+                    depth += h.queue_depth
+                finally:
+                    client.close()
+            except Exception:
+                pass
+            try:
+                conns += int(pod.facade.metrics.gauge("connections_active").value())
+            except Exception:
+                pass
+        return depth, conns
+
+    def _write_blocked(self, res: Resource, dep, msg: str) -> None:
+        self._write_status(
+            res,
+            dep,
+            phase="Blocked",
+            conditions=[
+                {
+                    "type": "CapabilitiesSatisfied",
+                    "status": "False",
+                    "message": msg,
+                }
+            ],
+        )
+
+    def _write_ref_error(self, res: Resource, msg: str) -> None:
+        self.store.update_status(
+            res,
+            {
+                "phase": "Pending",
+                "conditions": [
+                    {"type": "ReferencesResolved", "status": "False", "message": msg}
+                ],
+            },
+        )
+
+    def _write_status(self, res, dep, phase: str, conditions: list[dict]) -> None:
+        st = {
+            "phase": phase,
+            "replicas": len(dep.pods),
+            "candidateReplicas": len(dep.candidate_pods),
+            "endpoints": [
+                {"url": url, "weight": w} for url, w in dep.endpoints()
+            ],
+            "configHash": dep.stable_hash,
+            "conditions": conditions,
+            "rollout": self.rollouts.state(dep).to_status(),
+        }
+        try:
+            self.store.update_status(res, st)
+        except KeyError:
+            pass  # deleted mid-reconcile
+
+    def _teardown(self, key: str) -> None:
+        dep = self.deployments.pop(key, None)
+        if dep is None:
+            return
+        for p in dep.pods + dep.candidate_pods:
+            try:
+                p.stop()
+            except Exception:
+                logger.exception("pod stop failed during teardown")
+        self._autoscalers.pop(key, None)
+        logger.info("deployment %s torn down", key)

@@ -1,0 +1,290 @@
+"""Deployment builder: AgentRuntime resource → running agent "pods".
+
+The reference operator builds a two-container pod (facade + runtime) per
+agent (reference internal/controller/deployment_builder.go:124,
+deployment_builder_containers.go:27/:187) and applies podOverrides for
+node placement — the hook the TPU build uses for
+`cloud.google.com/gke-tpu-accelerator` node pools (reference
+internal/podoverrides/podoverrides.go:44).
+
+Two backends over one Deployment abstraction:
+- InProcessPodBackend — actually runs the pair (RuntimeServer gRPC +
+  FacadeServer WebSocket) on localhost ports: the framework's
+  single-node/dev data plane, and what integration tests drive.
+- K8sManifestBackend — renders Deployment/Service manifests (two
+  containers, config projection, TPU nodeSelector/tolerations from
+  podOverrides) for a cluster to run; rendering is pure so it needs no
+  cluster to test.
+"""
+
+from __future__ import annotations
+
+import copy
+import logging
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Optional
+
+from omnia_tpu.operator.resources import Resource
+
+logger = logging.getLogger(__name__)
+
+
+@dataclass
+class PodHandle:
+    name: str
+    runtime: object  # RuntimeServer
+    facade: object   # FacadeServer
+    runtime_port: int
+    facade_port: int
+    started_at: float = field(default_factory=time.time)
+    version: str = ""  # config hash / rollout track
+
+    @property
+    def endpoint(self) -> str:
+        return f"ws://localhost:{self.facade_port}"
+
+    def stop(self) -> None:
+        try:
+            self.facade.shutdown()
+        finally:
+            self.runtime.shutdown()
+
+
+@dataclass
+class AgentDeployment:
+    """Desired state resolved from an AgentRuntime + its refs."""
+
+    resource: Resource
+    pack_doc: dict
+    provider_specs: list[dict]
+    default_provider: str
+    tool_configs: list[dict] = field(default_factory=list)
+    session_api_url: Optional[str] = None
+    required_capabilities: list[str] = field(default_factory=list)
+    replicas: int = 1
+    pods: list[PodHandle] = field(default_factory=list)
+    # Rollout bookkeeping: stable config hash + candidate pods.
+    stable_hash: str = ""
+    candidate_pods: list[PodHandle] = field(default_factory=list)
+    candidate_weight: float = 0.0
+    # Capability-gate latch: config hash that was probed and found
+    # missing capabilities; stays scaled-to-zero until the config (or
+    # required capability set) changes.
+    gate_blocked_hash: str = ""
+
+    @property
+    def name(self) -> str:
+        return self.resource.name
+
+    @property
+    def namespace(self) -> str:
+        return self.resource.namespace
+
+    def config_hash(self) -> str:
+        """Hash of everything that requires a pod restart when changed
+        (the reference's config-hash restart trigger,
+        deployment_builder_confighash.go). Scaling and delivery policy
+        (replicas / autoscaling / rollout) are deliberately EXCLUDED — a
+        replica-count edit must not restart pods or trigger a canary."""
+        import hashlib
+        import json
+
+        restart_spec = {
+            k: v
+            for k, v in self.resource.spec.items()
+            if k not in ("replicas", "autoscaling", "rollout")
+        }
+        doc = {
+            "spec": restart_spec,
+            "pack": self.pack_doc,
+            "providers": self.provider_specs,
+            "tools": self.tool_configs,
+        }
+        return hashlib.sha256(
+            json.dumps(doc, sort_keys=True, default=str).encode()
+        ).hexdigest()[:16]
+
+    def endpoints(self) -> list[tuple[str, float]]:
+        """(endpoint, weight) pairs for traffic routing. Stable pods share
+        (100 - candidate_weight); candidates share candidate_weight."""
+        out: list[tuple[str, float]] = []
+        stable_w = 100.0 - self.candidate_weight
+        if self.pods:
+            w = stable_w / len(self.pods)
+            out.extend((p.endpoint, w) for p in self.pods)
+        if self.candidate_pods and self.candidate_weight > 0:
+            w = self.candidate_weight / len(self.candidate_pods)
+            out.extend((p.endpoint, w) for p in self.candidate_pods)
+        return out
+
+
+def _build_tool_handlers(tool_configs: list[dict]):
+    from omnia_tpu.tools.executor import ToolHandler
+
+    handlers = []
+    for t in tool_configs:
+        h = t.get("handler", {})
+        handlers.append(
+            ToolHandler(
+                name=t["name"],
+                type={"http": "http", "openapi": "openapi", "client": "client"}.get(
+                    h.get("type", "http"), "http"
+                ),
+                description=t.get("description", ""),
+                input_schema=t.get("inputSchema"),
+                url=h.get("url", ""),
+                method=h.get("method", "POST"),
+                headers=h.get("headers", {}),
+                timeout_s=h.get("timeoutSeconds", 30.0),
+            )
+        )
+    return handlers
+
+
+class InProcessPodBackend:
+    """Runs facade+runtime pairs in this process (threads + localhost
+    ports) — the reference's integration-test topology (test/integration/
+    facade_runtime_test.go:190-202) promoted to a first-class dev
+    backend."""
+
+    def __init__(self) -> None:
+        self._counter = 0
+        self._lock = threading.Lock()
+
+    def start_pod(
+        self, dep: AgentDeployment, *, version: str = "", wait_ready: bool = True
+    ) -> PodHandle:
+        from omnia_tpu.facade.recording import RecordingInterceptor
+        from omnia_tpu.facade.server import FacadeServer
+        from omnia_tpu.runtime.packs import load_pack
+        from omnia_tpu.runtime.providers import ProviderRegistry, ProviderSpec
+        from omnia_tpu.runtime.server import RuntimeServer
+        from omnia_tpu.tools.executor import ToolExecutor
+
+        with self._lock:
+            self._counter += 1
+            pod_name = f"{dep.name}-{self._counter}"
+
+        registry = ProviderRegistry()
+        for ps in dep.provider_specs:
+            registry.register(ProviderSpec.from_dict(ps))
+        runtime = RuntimeServer(
+            pack=load_pack(copy.deepcopy(dep.pack_doc)),
+            providers=registry,
+            provider_name=dep.default_provider,
+            tool_executor=ToolExecutor(handlers=_build_tool_handlers(dep.tool_configs)),
+        )
+        runtime_port = runtime.serve(wait_ready=wait_ready)
+        facade = FacadeServer(
+            runtime_target=f"localhost:{runtime_port}",
+            agent_name=dep.name,
+            recording=RecordingInterceptor(dep.session_api_url),
+        )
+        facade_port = facade.serve()
+        handle = PodHandle(
+            name=pod_name,
+            runtime=runtime,
+            facade=facade,
+            runtime_port=runtime_port,
+            facade_port=facade_port,
+            version=version or dep.config_hash(),
+        )
+        logger.info("pod %s up: facade :%d runtime :%d", pod_name, facade_port, runtime_port)
+        return handle
+
+    def stop_pod(self, handle: PodHandle) -> None:
+        logger.info("pod %s stopping", handle.name)
+        handle.stop()
+
+    def scale(self, dep: AgentDeployment, replicas: int, *, wait_ready: bool = True) -> None:
+        """Reconcile the stable pod set to `replicas`."""
+        while len(dep.pods) > replicas:
+            self.stop_pod(dep.pods.pop())
+        while len(dep.pods) < replicas:
+            dep.pods.append(
+                self.start_pod(dep, version=dep.stable_hash, wait_ready=wait_ready)
+            )
+
+
+class K8sManifestBackend:
+    """Pure manifest rendering for cluster deployment; mirrors the
+    reference's Deployment shape (two containers, env projection,
+    config-hash annotation, podOverrides merge for TPU placement)."""
+
+    def render(self, dep: AgentDeployment) -> dict:
+        spec = dep.resource.spec
+        overrides = spec.get("podOverrides", {})
+        cfg_hash = dep.config_hash()
+        env = [
+            {"name": "OMNIA_AGENT", "value": dep.name},
+            {"name": "OMNIA_PROVIDER", "value": dep.default_provider},
+            {"name": "OMNIA_SESSION_API_URL", "value": dep.session_api_url or ""},
+        ]
+        pod_spec = {
+            "nodeSelector": overrides.get("nodeSelector", {}),
+            "tolerations": overrides.get("tolerations", []),
+            "serviceAccountName": overrides.get("serviceAccountName", "default"),
+            "volumes": overrides.get("volumes", []),
+            "containers": [
+                {
+                    "name": "facade",
+                    "image": spec.get("facadeImage", "omnia-tpu/facade:latest"),
+                    "ports": [
+                        {"name": "ws", "containerPort": 8080},
+                        {"name": "metrics", "containerPort": 8081},
+                    ],
+                    "env": env,
+                },
+                {
+                    "name": "runtime",
+                    "image": spec.get("runtimeImage", "omnia-tpu/runtime:latest"),
+                    # Port names must be unique pod-wide in K8s; the
+                    # facade owns the plain "metrics" name.
+                    "ports": [
+                        {"name": "grpc", "containerPort": 9000},
+                        {"name": "metrics-rt", "containerPort": 9001},
+                    ],
+                    "env": env,
+                    "resources": overrides.get(
+                        "runtimeResources",
+                        {"limits": {"google.com/tpu": spec.get("tpuChips", 8)}},
+                    ),
+                },
+            ],
+        }
+        deployment = {
+            "apiVersion": "apps/v1",
+            "kind": "Deployment",
+            "metadata": {
+                "name": f"agent-{dep.name}",
+                "namespace": dep.namespace,
+                "labels": {"omnia/agent": dep.name},
+                "annotations": {"omnia/config-hash": cfg_hash},
+            },
+            "spec": {
+                "replicas": dep.replicas,
+                "selector": {"matchLabels": {"omnia/agent": dep.name}},
+                "template": {
+                    "metadata": {
+                        "labels": {"omnia/agent": dep.name},
+                        "annotations": {"omnia/config-hash": cfg_hash},
+                    },
+                    "spec": pod_spec,
+                },
+            },
+        }
+        service = {
+            "apiVersion": "v1",
+            "kind": "Service",
+            "metadata": {"name": f"agent-{dep.name}", "namespace": dep.namespace},
+            "spec": {
+                "selector": {"omnia/agent": dep.name},
+                "ports": [
+                    {"name": "ws", "port": 80, "targetPort": "ws"},
+                    {"name": "metrics", "port": 8081, "targetPort": "metrics"},
+                ],
+            },
+        }
+        return {"deployment": deployment, "service": service}

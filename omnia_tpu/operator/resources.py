@@ -1,0 +1,105 @@
+"""Declarative resource model: the framework's CRD-equivalent surface.
+
+Kinds mirror the reference's core CRDs (reference api/v1alpha1/ — see
+SURVEY.md §2.1): AgentRuntime (agentruntime_types.go:1355-1504),
+Provider (provider_types.go:322-412, plus the NEW `type: tpu`),
+PromptPack, ToolRegistry, Workspace, AgentPolicy, MemoryPolicy,
+SessionRetentionPolicy, SkillSource. The envelope is K8s-shaped
+(apiVersion/kind/metadata/spec/status) so manifests translate 1:1, but
+resources here are plain dicts validated by validation.py — the control
+plane is cluster-optional (file-backed store = the reference's
+OMNIA_CONFIG_DIR clusterless mode, pkg/k8s/filebacked.go:36-42)."""
+
+from __future__ import annotations
+
+import copy
+import enum
+import time
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+API_VERSION = "omnia.tpu/v1alpha1"
+
+
+class ResourceKind(str, enum.Enum):
+    AGENT_RUNTIME = "AgentRuntime"
+    PROVIDER = "Provider"
+    PROMPT_PACK = "PromptPack"
+    TOOL_REGISTRY = "ToolRegistry"
+    WORKSPACE = "Workspace"
+    AGENT_POLICY = "AgentPolicy"
+    MEMORY_POLICY = "MemoryPolicy"
+    SESSION_RETENTION_POLICY = "SessionRetentionPolicy"
+    SKILL_SOURCE = "SkillSource"
+
+
+# Enum vocabularies shared with validation (reference anchors cited).
+FACADE_TYPES = ("websocket", "a2a", "rest", "mcp")  # agentruntime_types.go:1408-1417
+AGENT_MODES = ("agent", "function")  # agentruntime_types.go:1356-1394
+PROVIDER_TYPES = ("tpu", "mock")  # reference enum :382-414 + the new tpu type
+PROVIDER_ROLES = ("llm", "embedding")  # provider_types.go:40-63 (serving subset)
+TOOL_HANDLER_TYPES = ("http", "openapi", "grpc", "mcp", "client")  # toolregistry :26-51
+
+
+@dataclass
+class Resource:
+    kind: str
+    name: str
+    namespace: str = "default"
+    labels: dict = field(default_factory=dict)
+    spec: dict = field(default_factory=dict)
+    status: dict = field(default_factory=dict)
+    generation: int = 1
+    created_at: float = field(default_factory=time.time)
+
+    @property
+    def key(self) -> str:
+        return f"{self.namespace}/{self.kind}/{self.name}"
+
+    def to_manifest(self) -> dict:
+        return {
+            "apiVersion": API_VERSION,
+            "kind": self.kind,
+            "metadata": {
+                "name": self.name,
+                "namespace": self.namespace,
+                "labels": dict(self.labels),
+                "generation": self.generation,
+            },
+            "spec": copy.deepcopy(self.spec),
+            "status": copy.deepcopy(self.status),
+        }
+
+    @classmethod
+    def from_manifest(cls, doc: dict) -> "Resource":
+        if "kind" not in doc:
+            raise ValueError("manifest missing kind")
+        md = doc.get("metadata") or {}
+        if not md.get("name"):
+            raise ValueError("manifest missing metadata.name")
+        return cls(
+            kind=doc["kind"],
+            name=md["name"],
+            namespace=md.get("namespace", "default"),
+            labels=md.get("labels") or {},
+            spec=copy.deepcopy(doc.get("spec") or {}),
+            status=copy.deepcopy(doc.get("status") or {}),
+            generation=md.get("generation", 1),
+        )
+
+
+def ref_key(namespace: str, kind: str, name: str) -> str:
+    return f"{namespace}/{kind}/{name}"
+
+
+def resolve_ref(
+    store, namespace: str, kind: ResourceKind, ref: Any
+) -> Optional[Resource]:
+    """Resolve a spec reference ({'name': ...} or plain string) within the
+    same namespace, the reference's ref convention."""
+    if ref is None:
+        return None
+    name = ref.get("name") if isinstance(ref, dict) else str(ref)
+    if not name:
+        return None
+    return store.get(namespace, kind.value, name)

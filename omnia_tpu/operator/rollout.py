@@ -1,0 +1,196 @@
+"""Progressive delivery: candidate pods, stepped traffic, analysis,
+auto-rollback.
+
+Reference shape: internal/controller/rollout*.go — a config change spawns
+a candidate Deployment; traffic shifts through spec.rollout.steps[]
+weights; each step runs metric analysis; failure rolls back, completion
+promotes the candidate to stable. Version-triggered rollouts fire when
+the PromptPack resolves to a new version (rollout_version_trigger.go).
+
+Here the state machine is explicit and tick-driven so it is testable
+without a cluster: the controller calls `tick()` on its resync loop, the
+analyzer is injectable (default: facade error-rate + eval pass-rate from
+the session store)."""
+
+from __future__ import annotations
+
+import enum
+import logging
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Optional
+
+from omnia_tpu.operator.deployment import AgentDeployment
+
+logger = logging.getLogger(__name__)
+
+
+class RolloutPhase(str, enum.Enum):
+    IDLE = "Idle"
+    PROGRESSING = "Progressing"
+    PROMOTED = "Promoted"
+    ROLLED_BACK = "RolledBack"
+
+
+@dataclass
+class RolloutStep:
+    weight: float
+    hold_s: float = 0.0  # dwell before analysis+advance
+
+    @classmethod
+    def from_spec(cls, d: dict) -> "RolloutStep":
+        return cls(weight=float(d["weight"]), hold_s=float(d.get("holdSeconds", 0.0)))
+
+
+@dataclass
+class RolloutState:
+    phase: RolloutPhase = RolloutPhase.IDLE
+    candidate_hash: str = ""
+    step_index: int = -1
+    step_entered_at: float = 0.0
+    message: str = ""
+
+    def to_status(self) -> dict:
+        return {
+            "phase": self.phase.value,
+            "candidateHash": self.candidate_hash,
+            "stepIndex": self.step_index,
+            "message": self.message,
+        }
+
+
+# Analyzer returns True (healthy), False (unhealthy → rollback).
+Analyzer = Callable[[AgentDeployment], bool]
+
+
+def _default_analyzer(dep: AgentDeployment) -> bool:
+    """Healthy iff every candidate pod's runtime still answers Health
+    ready. Metric-based analysis (error rate, eval pass-rate) plugs in
+    here via the controller."""
+    from omnia_tpu.runtime.client import RuntimeClient
+
+    for pod in dep.candidate_pods:
+        try:
+            client = RuntimeClient(f"localhost:{pod.runtime_port}")
+            try:
+                h = client.health()
+                if h.status != "ok":
+                    return False
+            finally:
+                client.close()
+        except Exception:
+            return False
+    return True
+
+
+class RolloutEngine:
+    def __init__(self, backend, analyzer: Optional[Analyzer] = None):
+        self.backend = backend
+        self.analyzer = analyzer or _default_analyzer
+        self._states: dict[str, RolloutState] = {}
+
+    def state(self, dep: AgentDeployment) -> RolloutState:
+        return self._states.setdefault(dep.resource.key, RolloutState())
+
+    def tick(self, dep: AgentDeployment, now: Optional[float] = None) -> RolloutState:
+        """Advance the rollout machine one step. No-op (direct replace)
+        when the spec has no rollout steps."""
+        now = time.time() if now is None else now
+        st = self.state(dep)
+        steps = [
+            RolloutStep.from_spec(s)
+            for s in (dep.resource.spec.get("rollout") or {}).get("steps", [])
+        ]
+        new_hash = dep.config_hash()
+
+        if st.phase in (RolloutPhase.IDLE, RolloutPhase.PROMOTED, RolloutPhase.ROLLED_BACK):
+            if new_hash != dep.stable_hash:
+                if not steps:
+                    self._direct_replace(dep, new_hash)
+                    st.phase = RolloutPhase.PROMOTED
+                    st.candidate_hash = new_hash
+                    st.message = "replaced without steps"
+                else:
+                    self._start_candidate(dep, new_hash, steps[0], st, now)
+            return st
+
+        # PROGRESSING -------------------------------------------------
+        if new_hash != st.candidate_hash:
+            # Spec changed mid-rollout: abort current candidate, restart.
+            self._teardown_candidate(dep)
+            st.phase = RolloutPhase.IDLE
+            st.message = "superseded by newer config"
+            return self.tick(dep, now)
+
+        step = steps[st.step_index] if st.step_index < len(steps) else None
+        if step is not None and now - st.step_entered_at < step.hold_s:
+            return st  # dwell
+
+        if not self.analyzer(dep):
+            self._teardown_candidate(dep)
+            st.phase = RolloutPhase.ROLLED_BACK
+            st.message = f"analysis failed at step {st.step_index}"
+            logger.warning("rollout %s rolled back: %s", dep.name, st.message)
+            return st
+
+        next_index = st.step_index + 1
+        if next_index < len(steps):
+            st.step_index = next_index
+            st.step_entered_at = now
+            dep.candidate_weight = steps[next_index].weight
+            st.message = f"step {next_index}: weight {dep.candidate_weight}"
+        else:
+            self._promote(dep, st)
+        return st
+
+    # -- transitions ----------------------------------------------------
+
+    def _start_candidate(self, dep, new_hash, first_step, st, now) -> None:
+        n = max(1, len(dep.pods))
+        for _ in range(n):
+            dep.candidate_pods.append(
+                self.backend.start_pod(dep, version=new_hash)
+            )
+        dep.candidate_weight = first_step.weight
+        st.phase = RolloutPhase.PROGRESSING
+        st.candidate_hash = new_hash
+        st.step_index = 0
+        st.step_entered_at = now
+        st.message = f"step 0: weight {first_step.weight}"
+        logger.info("rollout %s started: candidate %s", dep.name, new_hash)
+
+    def _teardown_candidate(self, dep: AgentDeployment) -> None:
+        for p in dep.candidate_pods:
+            try:
+                self.backend.stop_pod(p)
+            except Exception:
+                logger.exception("candidate pod stop failed")
+        dep.candidate_pods = []
+        dep.candidate_weight = 0.0
+
+    def _promote(self, dep: AgentDeployment, st: RolloutState) -> None:
+        old = dep.pods
+        dep.pods = dep.candidate_pods
+        dep.candidate_pods = []
+        dep.candidate_weight = 0.0
+        dep.stable_hash = st.candidate_hash
+        for p in old:
+            try:
+                self.backend.stop_pod(p)
+            except Exception:
+                logger.exception("old stable pod stop failed")
+        st.phase = RolloutPhase.PROMOTED
+        st.message = "promoted"
+        logger.info("rollout %s promoted %s", dep.name, st.candidate_hash)
+
+    def _direct_replace(self, dep: AgentDeployment, new_hash: str) -> None:
+        old = dep.pods
+        dep.pods = [
+            self.backend.start_pod(dep, version=new_hash) for _ in range(max(1, len(old)))
+        ]
+        dep.stable_hash = new_hash
+        for p in old:
+            try:
+                self.backend.stop_pod(p)
+            except Exception:
+                logger.exception("old pod stop failed")

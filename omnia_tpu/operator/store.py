@@ -1,0 +1,196 @@
+"""Resource store: the control plane's K8s-API equivalent.
+
+Two backends, same watchable interface:
+- MemoryResourceStore — in-process (tests, embedded control plane).
+- FileResourceStore — a directory of YAML/JSON manifests, the
+  reference's clusterless devroot mode (reference
+  pkg/k8s/filebacked.go:36-42, examples/custom-runtime: any binary runs
+  against a YAML devroot). `sync()` re-reads the tree so external edits
+  (kubectl-apply-equivalent) are picked up.
+
+Apply runs admission validation (validation.py) before committing —
+fail-closed, like the reference's webhook chain. Watchers receive
+(event, resource) callbacks: ADDED | MODIFIED | DELETED."""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+from typing import Callable, Iterable, Optional
+
+from omnia_tpu.operator.resources import Resource
+from omnia_tpu.operator.validation import validate
+
+Watcher = Callable[[str, Resource], None]
+
+
+class ResourceStore:
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._watchers: list[Watcher] = []
+
+    # -- watch ---------------------------------------------------------
+
+    def watch(self, fn: Watcher) -> None:
+        with self._lock:
+            self._watchers.append(fn)
+
+    def _notify(self, event: str, res: Resource) -> None:
+        with self._lock:
+            watchers = list(self._watchers)
+        for w in watchers:
+            try:
+                w(event, res)
+            except Exception:  # watcher bugs must not break the store
+                import logging
+
+                logging.getLogger(__name__).exception("watcher failed")
+
+    # -- CRUD (subclass provides storage) ------------------------------
+
+    def apply(self, res: Resource) -> Resource:
+        validate(res)
+        prev = self.get(res.namespace, res.kind, res.name)
+        if prev is not None:
+            res.generation = prev.generation + 1
+            res.created_at = prev.created_at
+        self._put(res)
+        self._notify("MODIFIED" if prev is not None else "ADDED", res)
+        return res
+
+    def update_status(self, res: Resource, status: dict) -> Resource:
+        """Status-subresource write: no generation bump, no admission."""
+        cur = self.get(res.namespace, res.kind, res.name)
+        if cur is None:
+            raise KeyError(res.key)
+        cur.status = dict(status)
+        self._put(cur)
+        return cur
+
+    def delete(self, namespace: str, kind: str, name: str) -> bool:
+        res = self.get(namespace, kind, name)
+        if res is None:
+            return False
+        self._remove(res)
+        self._notify("DELETED", res)
+        return True
+
+    # storage primitives -------------------------------------------------
+
+    def _put(self, res: Resource) -> None:
+        raise NotImplementedError
+
+    def _remove(self, res: Resource) -> None:
+        raise NotImplementedError
+
+    def get(self, namespace: str, kind: str, name: str) -> Optional[Resource]:
+        raise NotImplementedError
+
+    def list(
+        self, kind: Optional[str] = None, namespace: Optional[str] = None
+    ) -> list[Resource]:
+        raise NotImplementedError
+
+
+class MemoryResourceStore(ResourceStore):
+    def __init__(self) -> None:
+        super().__init__()
+        self._items: dict[str, Resource] = {}
+
+    def _put(self, res: Resource) -> None:
+        with self._lock:
+            self._items[res.key] = res
+
+    def _remove(self, res: Resource) -> None:
+        with self._lock:
+            self._items.pop(res.key, None)
+
+    def get(self, namespace: str, kind: str, name: str) -> Optional[Resource]:
+        with self._lock:
+            return self._items.get(f"{namespace}/{kind}/{name}")
+
+    def list(
+        self, kind: Optional[str] = None, namespace: Optional[str] = None
+    ) -> list[Resource]:
+        with self._lock:
+            out = [
+                r
+                for r in self._items.values()
+                if (kind is None or r.kind == kind)
+                and (namespace is None or r.namespace == namespace)
+            ]
+        return sorted(out, key=lambda r: r.key)
+
+
+def _load_manifest_file(path: str) -> Iterable[dict]:
+    with open(path, encoding="utf-8") as f:
+        raw = f.read()
+    if path.endswith((".yaml", ".yml")):
+        import yaml
+
+        for doc in yaml.safe_load_all(raw):
+            if doc:
+                yield doc
+    else:
+        doc = json.loads(raw)
+        yield from doc if isinstance(doc, list) else [doc]
+
+
+class FileResourceStore(MemoryResourceStore):
+    """Manifests under root/<namespace>/<Kind>/<name>.json (writes) plus
+    any *.yaml|*.json dropped in the tree (reads via sync)."""
+
+    def __init__(self, root: str) -> None:
+        super().__init__()
+        self.root = root
+        os.makedirs(root, exist_ok=True)
+        self.sync()
+
+    def sync(self) -> int:
+        """Re-read the manifest tree; returns how many resources loaded.
+        External edits surface as ADDED/MODIFIED events."""
+        n = 0
+        for dirpath, _, files in os.walk(self.root):
+            for fn in sorted(files):
+                if not fn.endswith((".yaml", ".yml", ".json")):
+                    continue
+                path = os.path.join(dirpath, fn)
+                try:
+                    for doc in _load_manifest_file(path):
+                        res = Resource.from_manifest(doc)
+                        cur = self.get(res.namespace, res.kind, res.name)
+                        if cur is None or cur.spec != res.spec:
+                            # Route through admission + watch like apply,
+                            # but keep file writes out (we just read it).
+                            validate(res)
+                            if cur is not None:
+                                res.generation = cur.generation + 1
+                                res.status = cur.status
+                            MemoryResourceStore._put(self, res)
+                            self._notify("MODIFIED" if cur else "ADDED", res)
+                        n += 1
+                except Exception:
+                    import logging
+
+                    logging.getLogger(__name__).exception("bad manifest %s", path)
+        return n
+
+    def _path(self, res: Resource) -> str:
+        return os.path.join(self.root, res.namespace, res.kind, res.name + ".json")
+
+    def _put(self, res: Resource) -> None:
+        super()._put(res)
+        path = self._path(res)
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        tmp = path + ".tmp"
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump(res.to_manifest(), f, indent=2)
+        os.replace(tmp, path)
+
+    def _remove(self, res: Resource) -> None:
+        super()._remove(res)
+        try:
+            os.remove(self._path(res))
+        except FileNotFoundError:
+            pass

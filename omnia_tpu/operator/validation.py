@@ -1,0 +1,183 @@
+"""Admission validation: the CEL-rules + webhook equivalent.
+
+The reference validates CRDs with CEL expressions compiled into the CRD
+schema plus validating webhooks (reference internal/webhook/*_webhook.go,
+api/v1alpha1/agentruntime_facades_cel_envtest_test.go). Here each kind
+gets a Python validator invoked by the store on every apply — same
+fail-closed admission posture, no cluster required."""
+
+from __future__ import annotations
+
+from typing import Callable
+
+from omnia_tpu.operator.resources import (
+    AGENT_MODES,
+    FACADE_TYPES,
+    PROVIDER_ROLES,
+    PROVIDER_TYPES,
+    TOOL_HANDLER_TYPES,
+    Resource,
+    ResourceKind,
+)
+
+
+class ValidationError(ValueError):
+    def __init__(self, resource: Resource, errors: list[str]):
+        self.errors = errors
+        super().__init__(f"{resource.key}: " + "; ".join(errors))
+
+
+def _validate_agent_runtime(spec: dict, errs: list[str]) -> None:
+    mode = spec.get("mode", "agent")
+    if mode not in AGENT_MODES:
+        errs.append(f"mode must be one of {AGENT_MODES}, got {mode!r}")
+    facades = spec.get("facades", [{"type": "websocket"}])
+    if not isinstance(facades, list) or not facades:
+        errs.append("facades must be a non-empty list")
+        facades = []
+    for f in facades:
+        t = f.get("type") if isinstance(f, dict) else None
+        if t not in FACADE_TYPES:
+            errs.append(f"facade type must be one of {FACADE_TYPES}, got {t!r}")
+    # mcp facade requires function mode (reference CEL rule on facades).
+    if mode != "function" and any(
+        isinstance(f, dict) and f.get("type") == "mcp" for f in facades
+    ):
+        errs.append("mcp facade requires mode: function")
+    if not spec.get("promptPackRef"):
+        errs.append("promptPackRef is required")
+    providers = spec.get("providers", [])
+    if not providers:
+        errs.append("at least one providers[] entry is required")
+    names = [p.get("name") for p in providers if isinstance(p, dict)]
+    if len(names) != len(set(names)):
+        errs.append("providers[].name must be unique")
+    for p in providers:
+        if not isinstance(p, dict) or not p.get("name") or not p.get("providerRef"):
+            errs.append("each providers[] entry needs name and providerRef")
+    replicas = spec.get("replicas", 1)
+    if not isinstance(replicas, int) or replicas < 0:
+        errs.append("replicas must be a non-negative integer")
+    auto = spec.get("autoscaling")
+    if auto:
+        # Defaults must match AutoscalingPolicy.from_spec (min 0, max 4)
+        # or a spec the scaler accepts gets rejected at admission.
+        lo, hi = auto.get("minReplicas", 0), auto.get("maxReplicas", 4)
+        if lo > hi:
+            errs.append("autoscaling.minReplicas must be <= maxReplicas")
+    rollout = spec.get("rollout")
+    if rollout:
+        steps = rollout.get("steps", [])
+        if not steps:
+            errs.append("rollout.steps must be non-empty when rollout is set")
+        for s in steps:
+            w = s.get("weight") if isinstance(s, dict) else None
+            if not isinstance(w, (int, float)) or not (0 <= w <= 100):
+                errs.append("rollout step weight must be in [0, 100]")
+
+
+def _validate_provider(spec: dict, errs: list[str]) -> None:
+    t = spec.get("type")
+    if t not in PROVIDER_TYPES:
+        errs.append(f"type must be one of {PROVIDER_TYPES}, got {t!r}")
+    role = spec.get("role", "llm")
+    if role not in PROVIDER_ROLES:
+        errs.append(f"role must be one of {PROVIDER_ROLES}, got {role!r}")
+    if t == "tpu" and not spec.get("model"):
+        errs.append("tpu provider requires spec.model (a model preset name)")
+    pricing = spec.get("pricing", {})
+    for k in ("inputPerMTok", "outputPerMTok"):
+        v = pricing.get(k, 0)
+        if not isinstance(v, (int, float)) or v < 0:
+            errs.append(f"pricing.{k} must be a non-negative number")
+
+
+def _validate_prompt_pack(spec: dict, errs: list[str]) -> None:
+    content = spec.get("content")
+    if content is None:
+        errs.append("spec.content (compiled pack JSON) is required")
+        return
+    from omnia_tpu.runtime.packs import validate_pack
+
+    errs.extend(validate_pack(content))
+
+
+def _validate_tool_registry(spec: dict, errs: list[str]) -> None:
+    tools = spec.get("tools", [])
+    seen = set()
+    for t in tools:
+        if not isinstance(t, dict) or not t.get("name"):
+            errs.append("each tools[] entry needs a name")
+            continue
+        if t["name"] in seen:
+            errs.append(f"duplicate tool name {t['name']!r}")
+        seen.add(t["name"])
+        ht = t.get("handler", {}).get("type")
+        if ht not in TOOL_HANDLER_TYPES:
+            errs.append(
+                f"tool {t['name']}: handler.type must be one of {TOOL_HANDLER_TYPES}"
+            )
+
+
+def _validate_workspace(spec: dict, errs: list[str]) -> None:
+    if not spec.get("environment"):
+        errs.append("spec.environment is required (e.g. dev|staging|prod)")
+    for g in spec.get("services", []):
+        if not isinstance(g, dict) or not g.get("name"):
+            errs.append("each services[] group needs a name")
+
+
+def _validate_retention(spec: dict, errs: list[str]) -> None:
+    hot = spec.get("hotIdleSeconds", 3600)
+    warm = spec.get("warmWindowSeconds", 7 * 86400)
+    cold = spec.get("coldWindowSeconds", 90 * 86400)
+    if not (0 < hot <= warm <= cold):
+        errs.append("windows must satisfy 0 < hot <= warm <= cold")
+
+
+def _validate_memory_policy(spec: dict, errs: list[str]) -> None:
+    for tier in spec.get("tiers", []):
+        if tier.get("ttlSeconds", 1) <= 0:
+            errs.append("tier ttlSeconds must be positive")
+        hl = tier.get("halfLifeSeconds")
+        if hl is not None and hl <= 0:
+            errs.append("tier halfLifeSeconds must be positive")
+
+
+def _validate_agent_policy(spec: dict, errs: list[str]) -> None:
+    allow, deny = spec.get("allowTools"), spec.get("denyTools")
+    if allow is not None and deny is not None:
+        overlap = set(allow) & set(deny)
+        if overlap:
+            errs.append(f"tools both allowed and denied: {sorted(overlap)}")
+
+
+def _validate_skill_source(spec: dict, errs: list[str]) -> None:
+    src = spec.get("source", {})
+    if src.get("type") not in ("git", "oci", "configmap", "local"):
+        errs.append("source.type must be git|oci|configmap|local")
+
+
+_VALIDATORS: dict[str, Callable[[dict, list[str]], None]] = {
+    ResourceKind.AGENT_RUNTIME.value: _validate_agent_runtime,
+    ResourceKind.PROVIDER.value: _validate_provider,
+    ResourceKind.PROMPT_PACK.value: _validate_prompt_pack,
+    ResourceKind.TOOL_REGISTRY.value: _validate_tool_registry,
+    ResourceKind.WORKSPACE.value: _validate_workspace,
+    ResourceKind.SESSION_RETENTION_POLICY.value: _validate_retention,
+    ResourceKind.MEMORY_POLICY.value: _validate_memory_policy,
+    ResourceKind.AGENT_POLICY.value: _validate_agent_policy,
+    ResourceKind.SKILL_SOURCE.value: _validate_skill_source,
+}
+
+
+def validate(resource: Resource) -> None:
+    """Raise ValidationError when the resource fails admission. Unknown
+    kinds are rejected (fail closed, like an unregistered CRD)."""
+    v = _VALIDATORS.get(resource.kind)
+    if v is None:
+        raise ValidationError(resource, [f"unknown kind {resource.kind!r}"])
+    errs: list[str] = []
+    v(resource.spec, errs)
+    if errs:
+        raise ValidationError(resource, errs)

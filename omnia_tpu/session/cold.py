@@ -126,23 +126,47 @@ class ColdArchive:
         self, session: SessionRecord, records: dict[str, list[dict]]
     ) -> str:
         """Write one Parquet object for the session + manifest entry.
-        Returns the blob key."""
-        rows = {"kind": [], "record_id": [], "session_id": [], "created_at": [], "body": []}
-        for kind, recs in records.items():
-            for r in recs:
-                rows["kind"].append(kind)
-                rows["record_id"].append(str(r.get("record_id", "")))
-                rows["session_id"].append(session.session_id)
-                rows["created_at"].append(float(r.get("created_at", 0.0)))
-                rows["body"].append(json.dumps(r))
-        table = pa.Table.from_pydict(rows, schema=_SCHEMA)
-        buf = io.BytesIO()
-        pq.write_table(table, buf, compression="zstd")
-        day = time.strftime("%Y-%m-%d", time.gmtime(session.updated_at))
-        key = f"archive/{day}/{session.session_id}.parquet"
+        Returns the blob key.
+
+        Re-archiving a previously archived session (resumed → demoted
+        again) MERGES with the existing archive — the new object holds
+        old ∪ new records (dedup by record_id) and the superseded blob is
+        deleted, so history is never lost or leaked."""
         with self._lock:
-            self.blobs.put(key, buf.getvalue())
             m = self._load_manifest()
+            prior = m["sessions"].get(session.session_id)
+            merged: dict[str, dict] = {}
+            if prior is not None:
+                raw = self.blobs.get(prior["key"])
+                if raw is not None:
+                    old_table = pq.read_table(io.BytesIO(raw))
+                    for kind, rid, body in zip(
+                        old_table.column("kind").to_pylist(),
+                        old_table.column("record_id").to_pylist(),
+                        old_table.column("body").to_pylist(),
+                    ):
+                        merged[rid or body] = {"kind": kind, "body": body}
+            for kind, recs in records.items():
+                for r in recs:
+                    rid = str(r.get("record_id", ""))
+                    body = json.dumps(r)
+                    merged[rid or body] = {"kind": kind, "body": body}
+            rows = {"kind": [], "record_id": [], "session_id": [], "created_at": [], "body": []}
+            for rid, item in merged.items():
+                d = json.loads(item["body"])
+                rows["kind"].append(item["kind"])
+                rows["record_id"].append(str(d.get("record_id", "")))
+                rows["session_id"].append(session.session_id)
+                rows["created_at"].append(float(d.get("created_at", 0.0)))
+                rows["body"].append(item["body"])
+            table = pa.Table.from_pydict(rows, schema=_SCHEMA)
+            buf = io.BytesIO()
+            pq.write_table(table, buf, compression="zstd")
+            day = time.strftime("%Y-%m-%d", time.gmtime(session.updated_at))
+            key = f"archive/{day}/{session.session_id}.parquet"
+            self.blobs.put(key, buf.getvalue())
+            if prior is not None and prior["key"] != key:
+                self.blobs.delete(prior["key"])
             m["sessions"][session.session_id] = {
                 "key": key,
                 "workspace": session.workspace,

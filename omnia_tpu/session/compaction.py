@@ -34,18 +34,18 @@ class CompactionEngine:
     def run_once(self, now: float | None = None) -> CompactionReport:
         now = time.time() if now is None else now
         report = CompactionReport()
-        self._hot_to_warm(report)
+        self._hot_to_warm(report, now)
         self._warm_to_cold(report, now)
         report.purged_cold = self.store.cold.purge_older_than(
             now - self.policy.cold_window_s
         )
         return report
 
-    def _hot_to_warm(self, report: CompactionReport) -> None:
+    def _hot_to_warm(self, report: CompactionReport, now: float) -> None:
         from omnia_tpu.session.tiers import demote_bundle
 
         bundles = self.store.hot.pop_idle(
-            self.policy.hot_idle_s, limit=self.policy.batch_size
+            self.policy.hot_idle_s, limit=self.policy.batch_size, now=now
         )
         for b in bundles:
             try:

@@ -186,10 +186,13 @@ class HotStore:
 
     # -- compaction hooks ---------------------------------------------
 
-    def pop_idle(self, idle_s: float, limit: int = 100) -> list[_SessionBundle]:
+    def pop_idle(
+        self, idle_s: float, limit: int = 100, now: Optional[float] = None
+    ) -> list[_SessionBundle]:
         """Remove and return bundles idle longer than idle_s (oldest
-        first) for demotion to the warm tier."""
-        now = time.time()
+        first) for demotion to the warm tier. `now` lets the compaction
+        engine age all three tiers on one clock."""
+        now = time.time() if now is None else now
         with self._lock:
             idle = sorted(
                 (

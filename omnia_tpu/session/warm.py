@@ -180,18 +180,35 @@ class WarmStore:
         self._append("tool_call", rec.session_id, rec.created_at, rec.__dict__)
 
     def append_provider_call(self, rec: ProviderCallRecord) -> None:
-        # Usage increments are not idempotent, so skip them when this
-        # record_id was already written (a retried demotion re-appends).
+        # Dup-check + record insert + usage upsert under ONE lock:
+        # usage increments are not idempotent, and a concurrent retry of
+        # the same record_id must not double-count tokens/cost.
+        body = json.dumps(rec.__dict__)
         with self._lock:
             dup = self._db.execute(
                 "SELECT 1 FROM records WHERE record_id=?", (rec.record_id,)
             ).fetchone()
-        self._append("provider_call", rec.session_id, rec.created_at, rec.__dict__)
-        if dup:
-            return
-        sess = self.get_session(rec.session_id)
-        ws = sess.workspace if sess else "default"
-        with self._lock:
+            self._db.execute(
+                "INSERT OR REPLACE INTO records"
+                " (record_id, kind, session_id, day, created_at, body)"
+                " VALUES (?,?,?,?,?,?)",
+                (
+                    rec.record_id,
+                    "provider_call",
+                    rec.session_id,
+                    _day(rec.created_at),
+                    rec.created_at,
+                    body,
+                ),
+            )
+            if dup:
+                self._db.commit()
+                return
+            row = self._db.execute(
+                "SELECT workspace FROM sessions WHERE session_id=?",
+                (rec.session_id,),
+            ).fetchone()
+            ws = row[0] if row else "default"
             self._db.execute(
                 """INSERT INTO provider_usage
                    (workspace, day, provider, model, input_tokens, output_tokens, cost_usd, calls)
