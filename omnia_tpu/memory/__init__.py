@@ -1,0 +1,40 @@
+"""Agentic memory plane: entity store, hybrid retrieval, consolidation,
+ingestion, retention, projection, and the memory-api HTTP surface.
+
+The TPU-native counterpart of the reference memory service (reference
+internal/memory + cmd/memory-api): same tiers (institutional / agent /
+user / user-for-agent), same hybrid ranking (RRF k=60 FTS ⊕ cosine with
+tier bias and recency half-life), with the embedding role served
+on-device (models/llama.py forward_embed) instead of a remote API."""
+
+from omnia_tpu.memory.api import MemoryAPI
+from omnia_tpu.memory.client import InProcessMemory, MemoryClient
+from omnia_tpu.memory.consolidation import Consolidator
+from omnia_tpu.memory.embedding import HashingEmbedder, ReembedWorker, TpuEmbedder
+from omnia_tpu.memory.ingestion import ChunkStrategy, Ingestor, IngestRequest
+from omnia_tpu.memory.retention import ConsentEvent, ConsentLog, RetentionWorker
+from omnia_tpu.memory.retrieve import RecallPolicy, Retriever
+from omnia_tpu.memory.store import MemoryStore
+from omnia_tpu.memory.types import MemoryEntry, Observation, Relation
+
+__all__ = [
+    "MemoryAPI",
+    "MemoryClient",
+    "InProcessMemory",
+    "Consolidator",
+    "HashingEmbedder",
+    "TpuEmbedder",
+    "ReembedWorker",
+    "ChunkStrategy",
+    "Ingestor",
+    "IngestRequest",
+    "ConsentEvent",
+    "ConsentLog",
+    "RetentionWorker",
+    "RecallPolicy",
+    "Retriever",
+    "MemoryStore",
+    "MemoryEntry",
+    "Observation",
+    "Relation",
+]

@@ -1,0 +1,407 @@
+"""memory-api: the HTTP surface over the memory store.
+
+Endpoint families mirror the reference memory-api (reference
+cmd/memory-api/SERVICE.md, internal/memory/api/):
+
+  POST /api/v1/memories                  save (remember)
+  GET  /api/v1/memories                  list (tier field on every row)
+  GET|DELETE /api/v1/memories/{id}
+  POST /api/v1/memories/search           FTS list search
+  POST /api/v1/memories/retrieve         ranked multi-tier hybrid (RRF)
+  POST /api/v1/memories/retrieve/semantic  workspace-scoped + deny filter
+  GET  /api/v1/memories/aggregate        groupBy=category|agent|day|tier
+  GET  /api/v1/memories/export
+  POST /api/v1/institutional/ingest      → 202, async embed backfill
+  GET  /api/v1/institutional/memories
+  POST /api/v1/consent                   grant/revoke consent category
+  GET  /api/v1/privacy/consent/stats
+  POST /api/v1/relations                 relate two entities
+  POST /api/v1/memories/{id}/observations
+  POST /api/v1/graph/traverse
+  POST /api/v1/consolidation/run
+  POST /admin/embedding-dimension-change one-shot dim-change consent
+
+Status-code contract preserved from the reference: 400 on missing
+workspace_id (retrieve/ingest), 202 + empty-ish body on ingest accept,
+500 fail-closed on malformed deny expressions."""
+
+from __future__ import annotations
+
+import json
+import logging
+import re
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from omnia_tpu.memory.consolidation import Consolidator
+from omnia_tpu.memory.embedding import Embedder, ReembedWorker
+from omnia_tpu.memory.graph import structured_lookup, traverse
+from omnia_tpu.memory.ingestion import Ingestor, IngestRequest
+from omnia_tpu.memory.retention import ConsentEvent, ConsentLog, RetentionWorker
+from omnia_tpu.memory.retrieve import DenyExprError, RecallPolicy, Retriever
+from omnia_tpu.memory.store import MemoryStore
+from omnia_tpu.memory.types import MemoryEntry, Observation, Relation
+from omnia_tpu.utils.metrics import Registry
+
+logger = logging.getLogger(__name__)
+
+_MEMORY_PATH = re.compile(r"^/api/v1/memories/(?P<id>[0-9a-f-]+)(?:/(?P<sub>observations))?$")
+
+
+class MemoryAPI:
+    def __init__(
+        self,
+        store: Optional[MemoryStore] = None,
+        embedder: Optional[Embedder] = None,
+        policy: Optional[RecallPolicy] = None,
+        default_ttl_s: Optional[float] = None,
+    ):
+        self.store = store or MemoryStore()
+        self.embedder = embedder
+        if embedder is not None and self.store.embedding_dim is None:
+            self.store.ensure_embedding_dim(embedder.dim)
+        self.retriever = Retriever(self.store, embedder, policy)
+        self.consent = ConsentLog()
+        self.retention = RetentionWorker(self.store, self.consent, default_ttl_s)
+        self.consolidator = Consolidator(self.store)
+        self.ingestor = Ingestor(self.store)
+        self.reembed = ReembedWorker(self.store, embedder) if embedder else None
+        self.metrics = Registry("omnia_memory")
+        self._requests = self.metrics.counter("requests_total", "HTTP requests")
+        self._writes = self.metrics.counter("writes_total", "memory writes")
+        self._retrievals = self.metrics.counter("retrievals_total", "retrieval calls")
+        self._httpd: Optional[ThreadingHTTPServer] = None
+
+    # ------------------------------------------------------------------
+    # Request handling (framework-free so tests can call it directly).
+    # ------------------------------------------------------------------
+
+    def handle(self, method: str, path: str, body: Optional[dict], client: str = "local"):
+        self._requests.inc(method=method)
+        try:
+            return self._route(method, path, body or {})
+        except DenyExprError as e:
+            return 500, {"error": f"deny filter: {e}"}  # fail closed
+        except (KeyError, TypeError, ValueError) as e:
+            return 400, {"error": str(e)}
+        except Exception as e:  # pragma: no cover - defensive
+            logger.exception("memory-api internal error")
+            return 500, {"error": str(e)}
+
+    def _route(self, method: str, path: str, body: dict):
+        if method == "POST":
+            if path == "/api/v1/memories":
+                return self._save(body)
+            if path == "/api/v1/memories/search":
+                return self._search(body)
+            if path == "/api/v1/memories/retrieve":
+                return self._retrieve(body)
+            if path == "/api/v1/memories/retrieve/semantic":
+                return self._retrieve_semantic(body)
+            if path == "/api/v1/institutional/ingest":
+                return self._ingest(body)
+            if path == "/api/v1/consent":
+                return self._consent(body)
+            if path == "/api/v1/relations":
+                return self._relate(body)
+            if path == "/api/v1/graph/traverse":
+                return self._traverse(body)
+            if path == "/api/v1/consolidation/run":
+                ws = body.get("workspace_id")
+                if not ws:
+                    return 400, {"error": "workspace_id required"}
+                return 200, self.consolidator.run_once(ws)
+            if path == "/api/v1/retention/sweep":
+                return 200, self.retention.sweep()
+            if path == "/admin/embedding-dimension-change":
+                dim = int(body.get("target_dim", 0))
+                self.store.record_dimension_change_consent(dim)
+                return 200, {"recorded": dim}
+        if method == "GET":
+            if path == "/api/v1/memories":
+                return self._list(body)
+            if path == "/api/v1/memories/aggregate":
+                return self._aggregate(body)
+            if path == "/api/v1/memories/export":
+                return self._export(body)
+            if path == "/api/v1/institutional/memories":
+                body = dict(body, tier="institutional")
+                return self._list(body)
+            if path == "/api/v1/privacy/consent/stats":
+                ws = body.get("workspace_id")
+                if not ws:
+                    return 400, {"error": "workspace_id required"}
+                return 200, self.consent.stats(ws)
+            if path == "/api/v1/stats":
+                return 200, self.store.stats()
+        m = _MEMORY_PATH.match(path)
+        if m:
+            mid, sub = m.group("id"), m.group("sub")
+            # id-addressed ops are workspace-authorized: the caller must
+            # name the workspace and it must own the entry (the reference
+            # deploys memory-api per workspace; in-process we enforce it).
+            ws = body.get("workspace_id")
+            if not ws:
+                return 400, {"error": "workspace_id required"}
+            e = self.store.get(mid)
+            if e is None or e.workspace_id != ws:
+                return 404, {"error": "not found"}
+            if sub == "observations" and method == "POST":
+                self.store.observe(mid, Observation(content=body["content"], source=body.get("source", "")))
+                self._writes.inc(kind="observation")
+                return 200, {"ok": True}
+            if sub is None and method == "GET":
+                return 200, e.to_dict()
+            if sub is None and method == "DELETE":
+                if self.store.tombstone(mid):
+                    self._writes.inc(kind="tombstone")
+                    return 200, {"deleted": True}
+                return 404, {"error": "not found"}
+        return 404, {"error": f"no route {method} {path}"}
+
+    # -- handlers ---------------------------------------------------------
+
+    def _save(self, body: dict):
+        if not body.get("workspace_id"):
+            return 400, {"error": "workspace_id required"}
+        if not body.get("content"):
+            return 400, {"error": "content required"}
+        import dataclasses as _dc
+
+        known = {f.name for f in _dc.fields(MemoryEntry)} - {"embedding", "observations"}
+        entry = MemoryEntry(**{k: v for k, v in body.items() if k in known})
+        saved = self.store.save(entry)
+        self._writes.inc(kind="memory")
+        if self.reembed:
+            self.reembed.start()  # async backfill — writes never block on device
+        return 200, saved.to_dict()
+
+    def _list(self, body: dict):
+        ws = body.get("workspace_id")
+        if not ws:
+            return 400, {"error": "workspace_id required"}
+        entries = self.store.scan(
+            ws,
+            tier=body.get("tier"),
+            agent_id=body.get("agent_id") or None,
+            virtual_user_id=body.get("virtual_user_id") or None,
+            categories=[body["category"]] if body.get("category") else None,
+        )
+        limit = int(body.get("limit", 100))
+        return 200, {
+            "memories": [e.to_dict() for e in entries[:limit]],
+            "total": len(entries),
+        }
+
+    def _search(self, body: dict):
+        ws = body.get("workspace_id")
+        if not ws:
+            return 400, {"error": "workspace_id required"}
+        query = body.get("query", "")
+        cands = self.store.scan(ws)
+        ranked = self.store.fts_rank(query, {e.id for e in cands})
+        limit = int(body.get("limit", 20))
+        out = []
+        for doc_id, score in ranked[:limit]:
+            e = self.store.get(doc_id)
+            if e:
+                d = e.to_dict()
+                d["score"] = score
+                out.append(d)
+        return 200, {"memories": out, "total": len(ranked)}
+
+    def _retrieve(self, body: dict):
+        if not body.get("workspace_id"):
+            return 400, {"error": "workspace_id required"}
+        self._retrievals.inc(kind="multi_tier")
+        results = self.retriever.retrieve(
+            workspace_id=body["workspace_id"],
+            query=body.get("query", ""),
+            virtual_user_id=body.get("user_id") or body.get("virtual_user_id") or "",
+            agent_id=body.get("agent_id") or "",
+            categories=body.get("types") or body.get("categories"),
+            purposes=body.get("purposes"),
+            min_confidence=float(body.get("min_confidence", 0.0)),
+            limit=int(body.get("limit", 8)),
+        )
+        return 200, {"memories": [r.to_dict() for r in results], "total": len(results)}
+
+    def _retrieve_semantic(self, body: dict):
+        if not body.get("workspace_id"):
+            return 400, {"error": "workspace_id required"}
+        self._retrievals.inc(kind="semantic")
+        results = self.retriever.retrieve_semantic(
+            workspace_id=body["workspace_id"],
+            query=body.get("query", ""),
+            deny_expr=body.get("deny_cel", "") or body.get("deny_expr", ""),
+            limit=int(body.get("limit", 8)),
+        )
+        return 200, {"memories": [r.to_dict() for r in results], "total": len(results)}
+
+    def _aggregate(self, body: dict):
+        ws = body.get("workspace_id")
+        if not ws:
+            return 400, {"error": "workspace_id required"}
+        group_by = body.get("groupBy", "category")
+        entries = self.store.scan(ws)
+        counts: dict[str, int] = {}
+        for e in entries:
+            if group_by == "category":
+                key = e.category
+            elif group_by == "agent":
+                key = e.agent_id or "(none)"
+            elif group_by == "tier":
+                key = "user" if e.tier in ("user", "user_for_agent") else e.tier
+            elif group_by == "day":
+                key = time.strftime("%Y-%m-%d", time.gmtime(e.created_at))
+            else:
+                return 400, {"error": f"bad groupBy {group_by!r}"}
+            counts[key] = counts.get(key, 0) + 1
+        return 200, {"groupBy": group_by, "counts": counts, "total": len(entries)}
+
+    def _export(self, body: dict):
+        ws = body.get("workspace_id")
+        if not ws:
+            return 400, {"error": "workspace_id required"}
+        entries = self.store.scan(
+            ws, virtual_user_id=body.get("virtual_user_id") or None, include_dead=False
+        )
+        return 200, {"memories": [e.to_dict() for e in entries], "total": len(entries)}
+
+    def _ingest(self, body: dict):
+        if not body.get("workspace_id"):
+            return 400, {"error": "workspace_id required"}
+        req = IngestRequest(
+            workspace_id=body["workspace_id"],
+            text=body.get("text", ""),
+            title=body.get("title", ""),
+            url=body.get("url", ""),
+            site=body.get("site", ""),
+        )
+        entries = self.ingestor.ingest(req)
+        self._writes.inc(kind="ingest")
+        if self.reembed:
+            self.reembed.start()  # async backfill, 202 semantics
+        return 202, {"chunks": len(entries)}
+
+    def _consent(self, body: dict):
+        for field in ("workspace_id", "virtual_user_id", "category"):
+            if not body.get(field):
+                return 400, {"error": f"{field} required"}
+        ev = ConsentEvent(
+            workspace_id=body["workspace_id"],
+            virtual_user_id=body["virtual_user_id"],
+            category=body["category"],
+            granted=bool(body.get("granted", True)),
+        )
+        self.consent.record(ev)
+        return 200, {"ok": True}
+
+    def _relate(self, body: dict):
+        for field in ("src_id", "relation", "dst_id"):
+            if not body.get(field):
+                return 400, {"error": f"{field} required"}
+        self.store.relate(
+            Relation(
+                src_id=body["src_id"],
+                relation=body["relation"],
+                dst_id=body["dst_id"],
+                weight=float(body.get("weight", 1.0)),
+            )
+        )
+        return 200, {"ok": True}
+
+    def _traverse(self, body: dict):
+        seeds = body.get("seed_ids") or []
+        if not seeds and body.get("about_key"):
+            ws = body.get("workspace_id")
+            if not ws:
+                return 400, {"error": "workspace_id required"}
+            seeds = [e.id for e in structured_lookup(self.store, ws, about_key=body["about_key"])]
+        nodes = traverse(
+            self.store,
+            seeds,
+            max_depth=int(body.get("max_depth", 2)),
+            max_nodes=int(body.get("max_nodes", 50)),
+            relation_types=body.get("relation_types"),
+        )
+        return 200, {
+            "nodes": [
+                {"memory": n["entry"].to_dict(), "depth": n["depth"], "via": n["via"]}
+                for n in nodes
+            ]
+        }
+
+    # ------------------------------------------------------------------
+    # HTTP server (same plumbing as session-api)
+    # ------------------------------------------------------------------
+
+    def serve(self, host: str = "localhost", port: int = 0) -> int:
+        api = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def _body(self) -> Optional[dict]:
+                n = int(self.headers.get("Content-Length") or 0)
+                if n == 0:
+                    return None
+                try:
+                    return json.loads(self.rfile.read(n))
+                except json.JSONDecodeError:
+                    return None
+
+            def _dispatch(self, method: str):
+                from urllib.parse import parse_qsl, urlsplit
+
+                parts = urlsplit(self.path)
+                path = parts.path
+                if path in ("/healthz", "/readyz"):
+                    self._reply(200, {"status": "ok"})
+                    return
+                if path == "/metrics":
+                    data = api.metrics.expose().encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type", "text/plain")
+                    self.send_header("Content-Length", str(len(data)))
+                    self.end_headers()
+                    self.wfile.write(data)
+                    return
+                body = self._body() or {}
+                body.update(dict(parse_qsl(parts.query)))
+                status, resp = api.handle(
+                    method, path, body, client=self.client_address[0]
+                )
+                self._reply(status, resp)
+
+            def _reply(self, status: int, resp: dict):
+                data = json.dumps(resp).encode()
+                self.send_response(status)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def do_GET(self):
+                self._dispatch("GET")
+
+            def do_POST(self):
+                self._dispatch("POST")
+
+            def do_DELETE(self):
+                self._dispatch("DELETE")
+
+            def log_message(self, *args):  # quiet
+                pass
+
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        import threading
+
+        threading.Thread(target=self._httpd.serve_forever, daemon=True).start()
+        return self._httpd.server_address[1]
+
+    def close(self) -> None:
+        if self.reembed:
+            self.reembed.stop()
+        if self._httpd:
+            self._httpd.shutdown()
+            self._httpd = None

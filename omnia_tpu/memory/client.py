@@ -1,0 +1,126 @@
+"""Memory clients: HTTP (runtime → memory-api) and in-process.
+
+Reference internal/memory/httpclient — the runtime's memory capability
+talks HTTP to the workspace's memory-api. Both clients expose the same
+three calls the conversation layer needs (remember / recall / retrieve
+ambient), so the runtime wires either without caring which."""
+
+from __future__ import annotations
+
+import json
+import logging
+import urllib.error
+import urllib.request
+from typing import Optional
+
+from omnia_tpu.memory.api import MemoryAPI
+
+logger = logging.getLogger(__name__)
+
+
+class MemoryClient:
+    """HTTP client for a remote memory-api."""
+
+    def __init__(self, base_url: str, timeout_s: float = 10.0, token: str = ""):
+        self.base_url = base_url.rstrip("/")
+        self.timeout_s = timeout_s
+        self.token = token
+
+    def _post(self, path: str, body: dict) -> dict:
+        req = urllib.request.Request(
+            self.base_url + path,
+            data=json.dumps(body).encode(),
+            headers={
+                "Content-Type": "application/json",
+                **({"Authorization": f"Bearer {self.token}"} if self.token else {}),
+            },
+            method="POST",
+        )
+        with urllib.request.urlopen(req, timeout=self.timeout_s) as resp:
+            return json.loads(resp.read() or b"{}")
+
+    def remember(
+        self,
+        workspace_id: str,
+        content: str,
+        virtual_user_id: str = "",
+        agent_id: str = "",
+        category: str = "general",
+        confidence: float = 0.8,
+        purposes: Optional[list] = None,
+    ) -> dict:
+        return self._post(
+            "/api/v1/memories",
+            {
+                "workspace_id": workspace_id,
+                "content": content,
+                "virtual_user_id": virtual_user_id,
+                "agent_id": agent_id,
+                "category": category,
+                "confidence": confidence,
+                "purposes": purposes or [],
+            },
+        )
+
+    def recall(
+        self,
+        workspace_id: str,
+        query: str,
+        virtual_user_id: str = "",
+        agent_id: str = "",
+        limit: int = 8,
+    ) -> list[dict]:
+        out = self._post(
+            "/api/v1/memories/retrieve",
+            {
+                "workspace_id": workspace_id,
+                "query": query,
+                "user_id": virtual_user_id,
+                "agent_id": agent_id,
+                "limit": limit,
+            },
+        )
+        return out.get("memories", [])
+
+
+class InProcessMemory:
+    """Same surface over an in-process MemoryAPI (clusterless dev, tests,
+    and the single-pod topology where runtime and memory share a process)."""
+
+    def __init__(self, api: Optional[MemoryAPI] = None):
+        self.api = api or MemoryAPI()
+
+    def remember(self, workspace_id, content, virtual_user_id="", agent_id="",
+                 category="general", confidence=0.8, purposes=None) -> dict:
+        status, resp = self.api.handle(
+            "POST",
+            "/api/v1/memories",
+            {
+                "workspace_id": workspace_id,
+                "content": content,
+                "virtual_user_id": virtual_user_id,
+                "agent_id": agent_id,
+                "category": category,
+                "confidence": confidence,
+                "purposes": purposes or [],
+            },
+        )
+        if status != 200:
+            raise RuntimeError(resp.get("error", "remember failed"))
+        return resp
+
+    def recall(self, workspace_id, query, virtual_user_id="", agent_id="", limit=8) -> list[dict]:
+        status, resp = self.api.handle(
+            "POST",
+            "/api/v1/memories/retrieve",
+            {
+                "workspace_id": workspace_id,
+                "query": query,
+                "user_id": virtual_user_id,
+                "agent_id": agent_id,
+                "limit": limit,
+            },
+        )
+        if status != 200:
+            raise RuntimeError(resp.get("error", "recall failed"))
+        return resp.get("memories", [])

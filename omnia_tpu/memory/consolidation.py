@@ -1,0 +1,154 @@
+"""Memory consolidation: dedup, merge, supersession, conflict detection.
+
+Reference internal/memory/consolidation/ + compaction.go, conflicts.go,
+supersession_store.go: periodic workers find near-duplicate memories
+(embedding cosine within a tier/scope), merge them into a survivor (the
+duplicate is superseded, not deleted — the supersession record keeps the
+audit trail), and surface contradictions on the same about-key for
+review. The reference serializes workers with Postgres advisory locks;
+here a process-local lock keeps one consolidation pass at a time (the
+store itself is the single-writer)."""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from typing import Optional
+
+from omnia_tpu.memory.store import MemoryStore
+from omnia_tpu.memory.types import MemoryEntry, Observation
+
+DUP_COSINE_THRESHOLD = 0.92
+
+
+@dataclasses.dataclass
+class SupersessionRecord:
+    old_id: str
+    new_id: str
+    reason: str
+    at: float = dataclasses.field(default_factory=time.time)
+
+
+@dataclasses.dataclass
+class ConflictRecord:
+    about_key: str
+    entry_ids: list
+    detected_at: float = dataclasses.field(default_factory=time.time)
+
+
+class Consolidator:
+    def __init__(self, store: MemoryStore, dup_threshold: float = DUP_COSINE_THRESHOLD):
+        self.store = store
+        self.dup_threshold = dup_threshold
+        self.supersessions: list[SupersessionRecord] = []
+        self.conflicts: list[ConflictRecord] = []
+        self._lock = threading.Lock()
+
+    # -- duplicate detection ---------------------------------------------
+
+    def find_duplicates(self, workspace_id: str) -> list[tuple[MemoryEntry, MemoryEntry, float]]:
+        """(survivor, duplicate, cosine) pairs — same workspace, same tier
+        and scope, cosine ≥ threshold. Survivor = higher confidence, then
+        older (the established memory wins)."""
+        import numpy as np
+
+        entries = [
+            e
+            for e in self.store.scan(workspace_id)
+            if e.embedding is not None
+        ]
+        pairs = []
+        by_scope: dict[tuple, list[MemoryEntry]] = {}
+        for e in entries:
+            by_scope.setdefault((e.tier, e.agent_id, e.virtual_user_id), []).append(e)
+        for group in by_scope.values():
+            if len(group) < 2:
+                continue
+            mat = np.stack([e.embedding for e in group])
+            sims = mat @ mat.T
+            for i in range(len(group)):
+                for j in range(i + 1, len(group)):
+                    sim = float(sims[i, j])
+                    if sim < self.dup_threshold:
+                        continue
+                    a, b = group[i], group[j]
+                    survivor, dup = (
+                        (a, b)
+                        if (a.confidence, -a.created_at) >= (b.confidence, -b.created_at)
+                        else (b, a)
+                    )
+                    pairs.append((survivor, dup, sim))
+        return pairs
+
+    def merge(self, survivor: MemoryEntry, dup: MemoryEntry, reason: str = "duplicate") -> None:
+        """Fold dup into survivor: union purposes/metadata, carry the
+        duplicate's content as an observation, supersede the duplicate."""
+        survivor.purposes = sorted(set(survivor.purposes) | set(dup.purposes))
+        for k, v in dup.metadata.items():
+            survivor.metadata.setdefault(k, v)
+        # Carry the duplicate's own observations too: merges can chain
+        # (c→b, then b→a), and anything left on a superseded entry is
+        # unreachable by retrieval.
+        for obs in dup.observations:
+            self.store.observe(survivor.id, obs)
+        if dup.content.strip() and dup.content.strip() != survivor.content.strip():
+            self.store.observe(
+                survivor.id, Observation(content=dup.content, source=f"merged:{dup.id}")
+            )
+        survivor.confidence = max(survivor.confidence, dup.confidence)
+        self.store.supersede(dup.id, survivor.id)
+        self.supersessions.append(SupersessionRecord(dup.id, survivor.id, reason))
+
+    def resolve(self, entry_id: str) -> Optional[MemoryEntry]:
+        """Follow the supersession chain to the live survivor."""
+        seen = set()
+        e = self.store.get(entry_id)
+        while e is not None and e.superseded_by and e.id not in seen:
+            seen.add(e.id)
+            e = self.store.get(e.superseded_by)
+        return e
+
+    # -- conflicts --------------------------------------------------------
+
+    def detect_conflicts(self, workspace_id: str) -> list[ConflictRecord]:
+        """Live entries sharing an about.key with differing content —
+        surfaced for review, never auto-resolved."""
+        by_key: dict[str, list[MemoryEntry]] = {}
+        for e in self.store.scan(workspace_id):
+            if e.about and e.about.get("key"):
+                by_key.setdefault(e.about["key"], []).append(e)
+        found = []
+        for key, group in by_key.items():
+            contents = {e.content.strip() for e in group}
+            if len(group) > 1 and len(contents) > 1:
+                found.append(ConflictRecord(key, [e.id for e in group]))
+        self.conflicts = found
+        return found
+
+    # -- pass -------------------------------------------------------------
+
+    def run_once(self, workspace_id: str) -> dict:
+        """One consolidation pass (single-flight)."""
+        if not self._lock.acquire(blocking=False):
+            return {"skipped": True}
+        try:
+            merged = 0
+            for survivor, dup, _sim in self.find_duplicates(workspace_id):
+                # Both sides must still be live at merge time: an earlier
+                # pair may have superseded either one, and folding content
+                # into an already-superseded survivor would strand it
+                # (scan filters superseded entries).
+                s_now, d_now = self.store.get(survivor.id), self.store.get(dup.id)
+                if (
+                    s_now is not None
+                    and d_now is not None
+                    and s_now.superseded_by is None
+                    and d_now.superseded_by is None
+                ):
+                    self.merge(s_now, d_now)
+                    merged += 1
+            conflicts = self.detect_conflicts(workspace_id)
+            return {"skipped": False, "merged": merged, "conflicts": len(conflicts)}
+        finally:
+            self._lock.release()

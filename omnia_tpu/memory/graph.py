@@ -1,0 +1,68 @@
+"""Relation graph traversal + structured lookup over memory entities.
+
+Reference internal/memory/graph_traversal.go + structured_lookup.go:
+bounded BFS from seed entities along typed relations, and exact-match
+lookup on about/metadata keys (no ranking — the structured complement to
+hybrid retrieval)."""
+
+from __future__ import annotations
+
+from collections import deque
+from typing import Optional
+
+from omnia_tpu.memory.store import MemoryStore
+from omnia_tpu.memory.types import MemoryEntry
+
+
+def traverse(
+    store: MemoryStore,
+    seed_ids: list[str],
+    max_depth: int = 2,
+    max_nodes: int = 50,
+    relation_types: Optional[list] = None,
+) -> list[dict]:
+    """Bounded BFS; returns [{entry, depth, via}] excluding dead nodes.
+    Follows edges in both directions (relations are directed but
+    traversal is not — matching the reference's neighbor expansion)."""
+    want = set(relation_types) if relation_types else None
+    seen = set(seed_ids)
+    out: list[dict] = []
+    q: deque[tuple[str, int]] = deque((sid, 0) for sid in seed_ids)
+    while q and len(out) < max_nodes:
+        node_id, depth = q.popleft()
+        if depth >= max_depth:
+            continue
+        edges = [(r.dst_id, r.relation) for r in store.relations_from(node_id)]
+        edges += [(r.src_id, r.relation) for r in store.relations_to(node_id)]
+        for nbr_id, rel in edges:
+            if nbr_id in seen or (want and rel not in want):
+                continue
+            seen.add(nbr_id)
+            e = store.get(nbr_id)
+            if e is None or not e.live():
+                continue
+            out.append({"entry": e, "depth": depth + 1, "via": rel})
+            q.append((nbr_id, depth + 1))
+            if len(out) >= max_nodes:
+                break
+    return out
+
+
+def structured_lookup(
+    store: MemoryStore,
+    workspace_id: str,
+    about_kind: Optional[str] = None,
+    about_key: Optional[str] = None,
+    metadata: Optional[dict] = None,
+) -> list[MemoryEntry]:
+    """Exact-match lookup on about {kind,key} and/or metadata key=value."""
+    out = []
+    for e in store.scan(workspace_id):
+        if about_kind and (not e.about or e.about.get("kind") != about_kind):
+            continue
+        if about_key and (not e.about or e.about.get("key") != about_key):
+            continue
+        if metadata and any(e.metadata.get(k) != v for k, v in metadata.items()):
+            continue
+        out.append(e)
+    return out

@@ -1,0 +1,128 @@
+"""Institutional document ingestion strategies.
+
+Reference internal/memory/ingestion/ (chunk / extractive / summary
+strategies + queue; default ChunkStrategy with 200-word chunks and
+40-word overlap per cmd/memory-api/SERVICE.md flags). Each produced
+chunk persists as an institutional memory keyed by
+about={kind, key: "<url>#<index>"} so re-ingesting the same document
+upserts instead of duplicating; embeddings backfill async via
+ReembedWorker."""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+from collections import Counter
+from typing import Callable, Optional
+
+from omnia_tpu.memory.store import MemoryStore, tokenize
+from omnia_tpu.memory.types import MemoryEntry
+
+DEFAULT_CHUNK_WORDS = 200
+DEFAULT_CHUNK_OVERLAP = 40
+
+_SENT = re.compile(r"(?<=[.!?])\s+")
+
+
+@dataclasses.dataclass
+class IngestRequest:
+    workspace_id: str
+    text: str
+    title: str = ""
+    url: str = ""
+    site: str = ""
+    kind: str = "doc"
+
+
+class ChunkStrategy:
+    """Word-window chunks with overlap (the default strategy)."""
+
+    def __init__(self, chunk_words: int = DEFAULT_CHUNK_WORDS, overlap: int = DEFAULT_CHUNK_OVERLAP):
+        if overlap >= chunk_words:
+            raise ValueError("overlap must be < chunk size")
+        self.chunk_words = chunk_words
+        self.overlap = overlap
+
+    def chunks(self, text: str) -> list[str]:
+        words = text.split()
+        if not words:
+            return []
+        out, start = [], 0
+        step = self.chunk_words - self.overlap
+        while start < len(words):
+            out.append(" ".join(words[start : start + self.chunk_words]))
+            if start + self.chunk_words >= len(words):
+                break
+            start += step
+        return out
+
+
+class ExtractiveStrategy:
+    """Top-K sentences by word-frequency salience, in document order."""
+
+    def __init__(self, max_sentences: int = 6):
+        self.max_sentences = max_sentences
+
+    def chunks(self, text: str) -> list[str]:
+        sents = [s.strip() for s in _SENT.split(text) if s.strip()]
+        if len(sents) <= self.max_sentences:
+            return sents
+        freq = Counter(tokenize(text))
+        scored = sorted(
+            range(len(sents)),
+            key=lambda i: -sum(freq[w] for w in tokenize(sents[i])) / (len(tokenize(sents[i])) or 1),
+        )
+        keep = sorted(scored[: self.max_sentences])
+        return [sents[i] for i in keep]
+
+
+class SummaryStrategy:
+    """LLM-assisted summary chunks: `summarize` is any text→text callable
+    (in this framework, an engine-backed completion); falls back to the
+    leading window when no summarizer is wired."""
+
+    def __init__(self, summarize: Optional[Callable[[str], str]] = None, fallback_words: int = 120):
+        self.summarize = summarize
+        self.fallback_words = fallback_words
+
+    def chunks(self, text: str) -> list[str]:
+        if self.summarize is not None:
+            summary = self.summarize(text).strip()
+            if summary:
+                return [summary]
+        return [" ".join(text.split()[: self.fallback_words])] if text.strip() else []
+
+
+class Ingestor:
+    def __init__(self, store: MemoryStore, strategy=None):
+        self.store = store
+        self.strategy = strategy or ChunkStrategy()
+
+    def ingest(self, req: IngestRequest) -> list[MemoryEntry]:
+        """Persist each chunk idempotently; returns the saved entries
+        (embeddings pending — the worker backfills). Chunks beyond the new
+        version's count are tombstoned so a shortened document doesn't
+        leave stale trailing chunks live."""
+        doc_key = req.url or req.title or "doc"
+        chunks = self.strategy.chunks(req.text)
+        entries = []
+        for i, chunk in enumerate(chunks):
+            entry = MemoryEntry(
+                workspace_id=req.workspace_id,
+                content=chunk,
+                category="institutional",
+                about={"kind": req.kind, "key": f"{doc_key}#{i}"},
+                metadata={"title": req.title, "url": req.url, "site": req.site},
+                source="ingest",
+            )
+            entries.append(self.store.save(entry))
+        prefix = f"{doc_key}#"
+        for e in self.store.scan(req.workspace_id, tier="institutional"):
+            if e.about and e.about.get("key", "").startswith(prefix):
+                try:
+                    idx = int(e.about["key"][len(prefix):])
+                except ValueError:
+                    continue
+                if idx >= len(chunks):
+                    self.store.tombstone(e.id)
+        return entries

@@ -1,0 +1,63 @@
+"""User-profile projection: the ambient-retrieval digest.
+
+Reference internal/memory/projection/ + projection_render.go +
+projection_store.go: a compact per-(workspace, user[, agent]) text
+rendering of the highest-value memories, grouped by category, for
+injection into the system context without a per-turn search. Projections
+are cached with a version stamp and invalidated by writes."""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Optional
+
+from omnia_tpu.memory.retrieve import RecallPolicy, Retriever
+from omnia_tpu.memory.store import MemoryStore
+
+
+class ProjectionStore:
+    def __init__(self, store: MemoryStore, max_items: int = 12, ttl_s: float = 60.0):
+        self.store = store
+        self.max_items = max_items
+        self.ttl_s = ttl_s
+        self._cache: dict[tuple, tuple[float, str]] = {}
+        self._lock = threading.Lock()
+
+    def invalidate(self, workspace_id: str, virtual_user_id: str = "") -> None:
+        with self._lock:
+            for key in list(self._cache):
+                if key[0] == workspace_id and (not virtual_user_id or key[1] == virtual_user_id):
+                    del self._cache[key]
+
+    def render(self, workspace_id: str, virtual_user_id: str, agent_id: str = "") -> str:
+        key = (workspace_id, virtual_user_id, agent_id)
+        now = time.time()
+        with self._lock:
+            hit = self._cache.get(key)
+            if hit and now - hit[0] < self.ttl_s:
+                return hit[1]
+        text = self._render(workspace_id, virtual_user_id, agent_id)
+        with self._lock:
+            self._cache[key] = (now, text)
+        return text
+
+    def _render(self, workspace_id: str, virtual_user_id: str, agent_id: str) -> str:
+        retr = Retriever(self.store, embedder=None, policy=RecallPolicy())
+        items = retr.retrieve(
+            workspace_id,
+            query="",
+            virtual_user_id=virtual_user_id,
+            agent_id=agent_id,
+            limit=self.max_items,
+        )
+        if not items:
+            return ""
+        by_cat: dict[str, list[str]] = {}
+        for r in sorted(items, key=lambda r: (-r.entry.confidence, -r.score)):
+            by_cat.setdefault(r.entry.category, []).append(r.entry.content)
+        lines = ["Known context about this user:"]
+        for cat in sorted(by_cat):
+            for content in by_cat[cat][:4]:
+                lines.append(f"- ({cat}) {content}")
+        return "\n".join(lines)

@@ -1,0 +1,103 @@
+"""Memory retention: TTL sweeps, tombstone purge, consent-driven pruning.
+
+Reference internal/memory/retention*.go + tombstone*.go +
+consent_event_store.go / consent_revocation_*: a periodic worker
+tombstones expired memories (TTL from MemoryPolicy or per-entry),
+hard-purges tombstones after a grace window, and deletes memories whose
+purposes fall under a revoked consent category for that user. Consent
+grants/revocations are an append-only event log (audit-friendly) with a
+current-state projection."""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Optional
+
+from omnia_tpu.memory.store import MemoryStore
+
+DEFAULT_TOMBSTONE_GRACE_S = 7 * 86400.0
+
+
+@dataclasses.dataclass
+class ConsentEvent:
+    workspace_id: str
+    virtual_user_id: str
+    category: str
+    granted: bool
+    at: float = dataclasses.field(default_factory=time.time)
+
+
+class ConsentLog:
+    """Append-only consent events; latest event per (user, category) wins."""
+
+    def __init__(self) -> None:
+        self.events: list[ConsentEvent] = []
+
+    def record(self, ev: ConsentEvent) -> None:
+        self.events.append(ev)
+
+    def granted(self, workspace_id: str, virtual_user_id: str, category: str) -> bool:
+        state = True  # default-granted until an explicit revocation
+        for ev in self.events:
+            if (
+                ev.workspace_id == workspace_id
+                and ev.virtual_user_id == virtual_user_id
+                and ev.category == category
+            ):
+                state = ev.granted
+        return state
+
+    def revoked_categories(self, workspace_id: str, virtual_user_id: str) -> set:
+        state: dict[str, bool] = {}
+        for ev in self.events:
+            if ev.workspace_id == workspace_id and ev.virtual_user_id == virtual_user_id:
+                state[ev.category] = ev.granted
+        return {cat for cat, ok in state.items() if not ok}
+
+    def stats(self, workspace_id: str) -> dict:
+        users = set()
+        revoked = 0
+        state: dict[tuple, bool] = {}
+        for ev in self.events:
+            if ev.workspace_id != workspace_id:
+                continue
+            users.add(ev.virtual_user_id)
+            state[(ev.virtual_user_id, ev.category)] = ev.granted
+        revoked = sum(1 for ok in state.values() if not ok)
+        return {"users": len(users), "grants": len(state), "revoked": revoked}
+
+
+class RetentionWorker:
+    def __init__(
+        self,
+        store: MemoryStore,
+        consent: Optional[ConsentLog] = None,
+        default_ttl_s: Optional[float] = None,
+        tombstone_grace_s: float = DEFAULT_TOMBSTONE_GRACE_S,
+    ):
+        self.store = store
+        self.consent = consent or ConsentLog()
+        self.default_ttl_s = default_ttl_s
+        self.tombstone_grace_s = tombstone_grace_s
+
+    def sweep(self, now: Optional[float] = None) -> dict:
+        now = now or time.time()
+        expired = purged = consent_pruned = 0
+        for e in self.store.all_entries():
+            if e.tombstoned:
+                if now - e.tombstoned_at >= self.tombstone_grace_s:
+                    self.store.purge(e.id)
+                    purged += 1
+                continue
+            ttl = e.ttl_s if e.ttl_s is not None else self.default_ttl_s
+            if ttl is not None and now >= e.created_at + ttl:
+                self.store.tombstone(e.id)
+                expired += 1
+                continue
+            if e.virtual_user_id and e.purposes:
+                revoked = self.consent.revoked_categories(e.workspace_id, e.virtual_user_id)
+                if revoked and set(e.purposes) <= revoked:
+                    self.store.tombstone(e.id)
+                    consent_pruned += 1
+        return {"expired": expired, "purged": purged, "consent_pruned": consent_pruned}

@@ -1,0 +1,348 @@
+"""Ranked multi-tier hybrid retrieval.
+
+The reference's retrieval pipeline (reference internal/memory/
+retrieve_multi_tier.go + retrieve_multi_tier_hybrid.go:39-41 +
+tier_ranking.go): candidates are gathered per tier (institutional /
+agent / user / user-for-agent), FTS rank and vector cosine rank are
+fused via Reciprocal Rank Fusion with k=60 so semantic-only matches
+still surface, then a per-tier MemoryPolicy bias and per-tier recency
+half-life decay (default 30d) shape the final score. Without an
+embedder (or on embed failure, or empty query) it degrades to FTS-only —
+same fallback contract as the reference.
+
+The deny-filter for workspace-scoped semantic retrieval evaluates a
+restricted boolean expression over each result (the reference uses CEL;
+malformed expressions fail closed)."""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import time
+from typing import Optional
+
+from omnia_tpu.memory.embedding import Embedder
+from omnia_tpu.memory.store import MemoryStore
+from omnia_tpu.memory.types import (
+    DEFAULT_HALF_LIFE_DAYS,
+    RRF_K,
+    TIER_AGENT,
+    TIER_INSTITUTIONAL,
+    TIER_USER,
+    TIER_USER_FOR_AGENT,
+    MemoryEntry,
+)
+
+logger = logging.getLogger(__name__)
+
+_DAY_S = 86400.0
+
+
+@dataclasses.dataclass
+class RecallPolicy:
+    """Per-tier ranking knobs (MemoryPolicy spec.recall in the reference:
+    tier bias via TierRanker, halfLife.{user,agent,institutional})."""
+
+    tier_bias: dict = dataclasses.field(
+        default_factory=lambda: {
+            TIER_INSTITUTIONAL: 1.0,
+            TIER_AGENT: 1.0,
+            TIER_USER: 1.1,
+            TIER_USER_FOR_AGENT: 1.2,
+        }
+    )
+    half_life_days: dict = dataclasses.field(
+        default_factory=lambda: {
+            TIER_INSTITUTIONAL: DEFAULT_HALF_LIFE_DAYS,
+            TIER_AGENT: DEFAULT_HALF_LIFE_DAYS,
+            TIER_USER: DEFAULT_HALF_LIFE_DAYS,
+            TIER_USER_FOR_AGENT: DEFAULT_HALF_LIFE_DAYS,
+        }
+    )
+
+
+@dataclasses.dataclass
+class RetrievedMemory:
+    entry: MemoryEntry
+    score: float
+    fts_rank: Optional[int] = None
+    vec_rank: Optional[int] = None
+
+    def to_dict(self) -> dict:
+        d = self.entry.to_dict()
+        d["score"] = self.score
+        return d
+
+
+class Retriever:
+    def __init__(
+        self,
+        store: MemoryStore,
+        embedder: Optional[Embedder] = None,
+        policy: Optional[RecallPolicy] = None,
+    ):
+        self.store = store
+        self.embedder = embedder
+        self.policy = policy or RecallPolicy()
+
+    # -- candidate gathering ---------------------------------------------
+
+    def _candidates(
+        self,
+        workspace_id: str,
+        virtual_user_id: str = "",
+        agent_id: str = "",
+        categories: Optional[list] = None,
+        purposes: Optional[list] = None,
+        min_confidence: float = 0.0,
+    ) -> list[MemoryEntry]:
+        """Institutional + (agent) + (user) + (user-for-agent) tiers, as
+        scoped by the caller's ids — a user-for-agent memory is visible
+        only to retrievals carrying BOTH matching ids."""
+        now = time.time()
+        out = list(
+            self.store.scan(workspace_id, tier=TIER_INSTITUTIONAL, categories=categories, now=now)
+        )
+        if agent_id:
+            out += self.store.scan(
+                workspace_id, tier=TIER_AGENT, agent_id=agent_id, categories=categories, now=now
+            )
+        if virtual_user_id:
+            out += self.store.scan(
+                workspace_id,
+                tier=TIER_USER,
+                virtual_user_id=virtual_user_id,
+                categories=categories,
+                now=now,
+            )
+        if virtual_user_id and agent_id:
+            out += self.store.scan(
+                workspace_id,
+                tier=TIER_USER_FOR_AGENT,
+                virtual_user_id=virtual_user_id,
+                agent_id=agent_id,
+                categories=categories,
+                now=now,
+            )
+        if min_confidence > 0.0:
+            out = [e for e in out if e.confidence >= min_confidence]
+        if purposes:
+            want = set(purposes)
+            out = [e for e in out if not e.purposes or want & set(e.purposes)]
+        return out
+
+    # -- fusion -----------------------------------------------------------
+
+    def _fuse(self, query: str, candidates: list[MemoryEntry], limit: int) -> list[RetrievedMemory]:
+        ids = {e.id for e in candidates}
+        by_id = {e.id: e for e in candidates}
+        fts = self.store.fts_rank(query, ids) if query else []
+        fts_rank = {doc_id: i for i, (doc_id, _) in enumerate(fts)}
+
+        vec_rank: dict[str, int] = {}
+        if self.embedder is not None and query:
+            try:
+                qvec = self.embedder.embed([query])[0]
+                ranked = self.store.cosine_rank(qvec, candidates)
+                vec_rank = {doc_id: i for i, (doc_id, _) in enumerate(ranked)}
+            except Exception:  # noqa: BLE001 — embed failure degrades to FTS-only
+                logger.exception("query embed failed; FTS-only retrieval")
+                vec_rank = {}
+
+        now = time.time()
+        fused: list[RetrievedMemory] = []
+        for doc_id in set(fts_rank) | set(vec_rank):
+            e = by_id[doc_id]
+            score = 0.0
+            if doc_id in fts_rank:
+                score += 1.0 / (RRF_K + fts_rank[doc_id] + 1)
+            if doc_id in vec_rank:
+                score += 1.0 / (RRF_K + vec_rank[doc_id] + 1)
+            score *= self.policy.tier_bias.get(e.tier, 1.0)
+            hl = self.policy.half_life_days.get(e.tier, DEFAULT_HALF_LIFE_DAYS)
+            age_days = max(now - e.created_at, 0.0) / _DAY_S
+            score *= 0.5 ** (age_days / hl) if hl > 0 else 1.0
+            fused.append(
+                RetrievedMemory(e, score, fts_rank.get(doc_id), vec_rank.get(doc_id))
+            )
+        fused.sort(key=lambda r: (-r.score, r.entry.id))
+        top = fused[:limit]
+        for r in top:
+            self.store.get(r.entry.id, touch=True)  # access tracking
+        return top
+
+    # -- public API -------------------------------------------------------
+
+    def retrieve(
+        self,
+        workspace_id: str,
+        query: str,
+        virtual_user_id: str = "",
+        agent_id: str = "",
+        categories: Optional[list] = None,
+        purposes: Optional[list] = None,
+        min_confidence: float = 0.0,
+        limit: int = 8,
+    ) -> list[RetrievedMemory]:
+        cands = self._candidates(
+            workspace_id, virtual_user_id, agent_id, categories, purposes, min_confidence
+        )
+        if not query:
+            # No query → recency-ordered (the reference's FTS-only
+            # multi-tier fallback reduces to a scan here).
+            now = time.time()
+            out = []
+            for e in sorted(cands, key=lambda e: -e.created_at)[:limit]:
+                hl = self.policy.half_life_days.get(e.tier, DEFAULT_HALF_LIFE_DAYS)
+                age_days = max(now - e.created_at, 0.0) / _DAY_S
+                out.append(RetrievedMemory(e, 0.5 ** (age_days / hl)))
+            return out
+        return self._fuse(query, cands, limit)
+
+    def retrieve_semantic(
+        self,
+        workspace_id: str,
+        query: str,
+        deny_expr: str = "",
+        limit: int = 8,
+    ) -> list[RetrievedMemory]:
+        """Workspace-wide hybrid retrieval + deny-filter. A malformed
+        deny expression raises (the caller maps it to 500 — fail closed,
+        matching the reference's CEL handling)."""
+        pred = compile_deny(deny_expr) if deny_expr else None
+        cands = [
+            e
+            for e in self.store.scan(workspace_id)
+        ]
+        out = self._fuse(query, cands, limit * 3 if pred else limit)
+        if pred is not None:
+            out = [r for r in out if not pred(r.entry.to_dict())]
+        return out[:limit]
+
+
+# ---------------------------------------------------------------------------
+# Deny-filter expression language (restricted; fail-closed on parse error)
+# ---------------------------------------------------------------------------
+#
+# Grammar: expr := or ; or := and ("||" and)* ; and := unary ("&&" unary)* ;
+# unary := "!" unary | "(" expr ")" | cmp ;
+# cmp := path (("=="|"!="|"in"|"contains") literal)?
+# path := ident ("." ident)* — resolved against the memory's dict form.
+
+import re as _re  # noqa: E402
+
+_TOKEN = _re.compile(
+    r"\s*(?:(?P<op>\(|\)|==|!=|&&|\|\||!)|(?P<kw>in|contains)\b"
+    r"|(?P<str>\"[^\"]*\"|'[^']*')|(?P<num>-?\d+(?:\.\d+)?)"
+    r"|(?P<path>[A-Za-z_][A-Za-z0-9_]*(?:\.[A-Za-z_][A-Za-z0-9_]*)*))"
+)
+
+
+class DenyExprError(ValueError):
+    pass
+
+
+def _lex(expr: str) -> list[tuple[str, str]]:
+    out, pos = [], 0
+    while pos < len(expr):
+        m = _TOKEN.match(expr, pos)
+        if not m or m.end() == pos:
+            raise DenyExprError(f"bad token at {pos!r} in {expr!r}")
+        pos = m.end()
+        for kind in ("op", "kw", "str", "num", "path"):
+            if m.group(kind) is not None:
+                out.append((kind, m.group(kind)))
+                break
+    return out
+
+
+def compile_deny(expr: str):
+    """→ predicate(memory_dict) -> bool. Raises DenyExprError on any
+    malformed input (callers fail closed)."""
+    toks = _lex(expr)
+    pos = 0
+
+    def peek():
+        return toks[pos] if pos < len(toks) else (None, None)
+
+    def eat(kind=None, val=None):
+        nonlocal pos
+        k, v = peek()
+        if k is None or (kind and k != kind) or (val and v != val):
+            raise DenyExprError(f"unexpected {v!r} at token {pos} in {expr!r}")
+        pos += 1
+        return v
+
+    def resolve(d: dict, path: str):
+        cur = d
+        for part in path.split("."):
+            if not isinstance(cur, dict) or part not in cur:
+                return None
+            cur = cur[part]
+        return cur
+
+    def literal():
+        k, v = peek()
+        if k == "str":
+            eat()
+            return lambda d: v[1:-1]
+        if k == "num":
+            eat()
+            return lambda d: float(v)
+        if k == "path":
+            eat()
+            return lambda d, p=v: resolve(d, p)
+        raise DenyExprError(f"expected literal, got {v!r}")
+
+    def cmp_expr():
+        k, v = peek()
+        if k == "op" and v == "(":
+            eat()
+            inner = or_expr()
+            eat("op", ")")
+            return inner
+        if k == "op" and v == "!":
+            eat()
+            inner = cmp_expr()
+            return lambda d: not inner(d)
+        path = eat("path")
+        k2, v2 = peek()
+        if k2 == "op" and v2 in ("==", "!="):
+            eat()
+            rhs = literal()
+            if v2 == "==":
+                return lambda d: resolve(d, path) == rhs(d)
+            return lambda d: resolve(d, path) != rhs(d)
+        if k2 == "kw" and v2 == "in":
+            eat()
+            rhs = literal()
+            return lambda d: (lambda c: c is not None and resolve(d, path) in c)(rhs(d))
+        if k2 == "kw" and v2 == "contains":
+            eat()
+            rhs = literal()
+
+            def contains(d):
+                c = resolve(d, path)
+                return c is not None and rhs(d) in c
+
+            return contains
+        return lambda d: bool(resolve(d, path))
+
+    def and_expr():
+        terms = [cmp_expr()]
+        while peek() == ("op", "&&"):
+            eat()
+            terms.append(cmp_expr())
+        return lambda d: all(t(d) for t in terms)
+
+    def or_expr():
+        terms = [and_expr()]
+        while peek() == ("op", "||"):
+            eat()
+            terms.append(and_expr())
+        return lambda d: any(t(d) for t in terms)
+
+    result = or_expr()
+    if pos != len(toks):
+        raise DenyExprError(f"trailing tokens in {expr!r}")
+    return result

@@ -1,0 +1,344 @@
+"""Memory store: entities, observations, relations, FTS + vector indexes.
+
+The in-tree equivalent of the reference's Postgres+pgvector memory store
+(reference internal/memory/store.go + store_{read,write,query,scan,
+delete,meta}.go, postgres/embedding_schema.go). Backed here by an
+in-process engine with a BM25 inverted index (the FTS rank source) and a
+numpy matrix of unit vectors (the cosine rank source), behind one
+interface so a Postgres/pgvector provider drops in for cluster
+deployments. Thread-safe; persistence via jsonl snapshot+append wal.
+
+Embedding-dimension policy follows the reference's reconciler semantics
+(embedding_schema.go / "#1309"): the store's vector column dimension is
+set once from the configured embedder; changing it on a store that holds
+vectors requires a recorded one-shot consent marker and discards all
+embeddings for async re-embed.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+import re
+import threading
+import time
+from collections import Counter, defaultdict
+from typing import Iterable, Optional
+
+import numpy as np
+
+from omnia_tpu.memory.types import MemoryEntry, Observation, Relation
+
+_WORD = re.compile(r"[a-z0-9]+")
+
+
+def tokenize(text: str) -> list[str]:
+    return _WORD.findall(text.lower())
+
+
+class DimensionChangeNeedsConsent(RuntimeError):
+    """Raised when re-dimensioning a store that already holds embeddings
+    without a recorded consent marker for that exact target dimension."""
+
+
+class Bm25Index:
+    """Inverted index with BM25 scoring (k1=1.2, b=0.75) over entry
+    content + observations. Pure python; rebuilt incrementally."""
+
+    K1 = 1.2
+    B = 0.75
+
+    def __init__(self) -> None:
+        self._postings: dict[str, dict[str, int]] = defaultdict(dict)
+        self._doc_len: dict[str, int] = {}
+
+    def index(self, doc_id: str, text: str) -> None:
+        self.remove(doc_id)
+        terms = tokenize(text)
+        self._doc_len[doc_id] = len(terms)
+        for term, tf in Counter(terms).items():
+            self._postings[term][doc_id] = tf
+
+    def remove(self, doc_id: str) -> None:
+        if doc_id not in self._doc_len:
+            return
+        del self._doc_len[doc_id]
+        for term in list(self._postings):
+            self._postings[term].pop(doc_id, None)
+            if not self._postings[term]:
+                del self._postings[term]
+
+    def search(self, query: str, candidates: Optional[set] = None) -> list[tuple[str, float]]:
+        n_docs = len(self._doc_len)
+        if n_docs == 0:
+            return []
+        avg_len = sum(self._doc_len.values()) / n_docs
+        scores: dict[str, float] = defaultdict(float)
+        for term in set(tokenize(query)):
+            posting = self._postings.get(term)
+            if not posting:
+                continue
+            idf = math.log(1 + (n_docs - len(posting) + 0.5) / (len(posting) + 0.5))
+            for doc_id, tf in posting.items():
+                if candidates is not None and doc_id not in candidates:
+                    continue
+                dl = self._doc_len[doc_id] or 1
+                denom = tf + self.K1 * (1 - self.B + self.B * dl / avg_len)
+                scores[doc_id] += idf * tf * (self.K1 + 1) / denom
+        return sorted(scores.items(), key=lambda kv: (-kv[1], kv[0]))
+
+
+class MemoryStore:
+    def __init__(self, path: Optional[str] = None, embedding_dim: Optional[int] = None):
+        self._entries: dict[str, MemoryEntry] = {}
+        self._relations: list[Relation] = []
+        # Idempotency index scoped by (workspace, agent, user, about.key):
+        # an about-key collision can only upsert within the SAME tier and
+        # scope — a user-scoped write can never overwrite an institutional
+        # entry that happens to share its key.
+        self._by_about: dict[tuple, str] = {}
+        self._fts = Bm25Index()
+        self._lock = threading.RLock()
+        self._path = path
+        self.embedding_dim = embedding_dim
+        self._dim_change_consent: Optional[int] = None
+        if path and os.path.exists(path):
+            self._load(path)
+
+    # -- persistence ------------------------------------------------------
+
+    def _load(self, path: str) -> None:
+        with open(path) as f:
+            for line in f:
+                rec = json.loads(line)
+                if rec.get("_kind") == "relation":
+                    rec.pop("_kind")
+                    self._relations.append(Relation(**rec))
+                else:
+                    rec.pop("_kind", None)
+                    e = MemoryEntry.from_dict(rec)
+                    self._entries[e.id] = e
+                    self._index(e)
+
+    def snapshot(self, path: Optional[str] = None) -> None:
+        path = path or self._path
+        if not path:
+            return
+        with self._lock, open(path + ".tmp", "w") as f:
+            for e in self._entries.values():
+                f.write(json.dumps({"_kind": "entry", **e.to_dict(include_embedding=True)}) + "\n")
+            for r in self._relations:
+                f.write(json.dumps({"_kind": "relation", **r.__dict__}) + "\n")
+        os.replace(path + ".tmp", path)
+
+    # -- writes -----------------------------------------------------------
+
+    def _index(self, e: MemoryEntry) -> None:
+        text = " ".join([e.content] + [o.content for o in e.observations])
+        self._fts.index(e.id, text)
+        if e.about and e.about.get("key"):
+            self._by_about[self._about_key(e)] = e.id
+
+    @staticmethod
+    def _about_key(e: MemoryEntry) -> tuple:
+        return (e.workspace_id, e.agent_id, e.virtual_user_id, e.about["key"])
+
+    def save(self, entry: MemoryEntry) -> MemoryEntry:
+        """Insert, or idempotent upsert when about.key matches an existing
+        entry in the same workspace (the ingest re-seed path)."""
+        with self._lock:
+            prior = self._entries.get(entry.id)
+            if prior is not None and prior.workspace_id != entry.workspace_id:
+                raise ValueError("id belongs to another workspace")
+            if entry.about and entry.about.get("key"):
+                existing_id = self._by_about.get(self._about_key(entry))
+                if existing_id and existing_id in self._entries:
+                    old = self._entries[existing_id]
+                    old.content = entry.content
+                    old.category = entry.category
+                    old.confidence = entry.confidence
+                    old.metadata.update(entry.metadata)
+                    old.updated_at = time.time()
+                    old.embedding = None  # content changed → re-embed
+                    old.tombstoned_at = None
+                    self._index(old)
+                    return old
+            if self.embedding_dim is not None and entry.embedding is not None:
+                if entry.embedding.shape[-1] != self.embedding_dim:
+                    entry.embedding = None
+            self._entries[entry.id] = entry
+            self._index(entry)
+            return entry
+
+    def observe(self, entry_id: str, obs: Observation) -> None:
+        with self._lock:
+            e = self._require(entry_id)
+            e.observations.append(obs)
+            e.updated_at = time.time()
+            e.embedding = None
+            self._index(e)
+
+    def relate(self, rel: Relation) -> None:
+        with self._lock:
+            self._require(rel.src_id)
+            self._require(rel.dst_id)
+            self._relations.append(rel)
+
+    def set_embedding(self, entry_id: str, vec: np.ndarray) -> None:
+        with self._lock:
+            e = self._entries.get(entry_id)
+            if e is None:
+                return
+            if self.embedding_dim is not None and vec.shape[-1] != self.embedding_dim:
+                return
+            e.embedding = np.asarray(vec, dtype=np.float32)
+
+    def supersede(self, old_id: str, new_id: str) -> None:
+        with self._lock:
+            self._require(old_id).superseded_by = new_id
+
+    def tombstone(self, entry_id: str) -> bool:
+        with self._lock:
+            e = self._entries.get(entry_id)
+            if e is None or e.tombstoned:
+                return False
+            e.tombstoned_at = time.time()
+            self._fts.remove(e.id)
+            return True
+
+    def purge(self, entry_id: str) -> bool:
+        with self._lock:
+            e = self._entries.pop(entry_id, None)
+            if e is None:
+                return False
+            self._fts.remove(entry_id)
+            if e.about and e.about.get("key"):
+                self._by_about.pop(self._about_key(e), None)
+            self._relations = [
+                r for r in self._relations if entry_id not in (r.src_id, r.dst_id)
+            ]
+            return True
+
+    # -- embedding dimension policy --------------------------------------
+
+    def record_dimension_change_consent(self, target_dim: int) -> None:
+        if not (1 <= target_dim <= 2000):
+            raise ValueError("target_dim out of range (1..2000)")
+        with self._lock:
+            self._dim_change_consent = target_dim
+
+    def ensure_embedding_dim(self, dim: int) -> None:
+        """Reconcile the vector dimension to the configured embedder's.
+        Fresh/empty vector sets reshape freely; a populated set requires
+        the one-shot consent marker naming this exact dimension, and the
+        reshape discards every embedding (async re-embed follows)."""
+        with self._lock:
+            if self.embedding_dim == dim:
+                return
+            has_vectors = any(e.embedding is not None for e in self._entries.values())
+            if has_vectors:
+                if self._dim_change_consent != dim:
+                    raise DimensionChangeNeedsConsent(
+                        f"store holds embeddings; record consent for dim={dim} first"
+                    )
+                self._dim_change_consent = None  # consumed atomically
+                for e in self._entries.values():
+                    e.embedding = None
+            self.embedding_dim = dim
+
+    # -- reads ------------------------------------------------------------
+
+    def _require(self, entry_id: str) -> MemoryEntry:
+        e = self._entries.get(entry_id)
+        if e is None:
+            raise KeyError(entry_id)
+        return e
+
+    def get(self, entry_id: str, touch: bool = False) -> Optional[MemoryEntry]:
+        with self._lock:
+            e = self._entries.get(entry_id)
+            if e is not None and touch:
+                e.last_accessed_at = time.time()
+                e.access_count += 1
+            return e
+
+    def scan(
+        self,
+        workspace_id: str,
+        tier: Optional[str] = None,
+        agent_id: Optional[str] = None,
+        virtual_user_id: Optional[str] = None,
+        categories: Optional[Iterable[str]] = None,
+        include_dead: bool = False,
+        now: Optional[float] = None,
+    ) -> list[MemoryEntry]:
+        cats = set(categories) if categories else None
+        with self._lock:
+            out = []
+            for e in self._entries.values():
+                if e.workspace_id != workspace_id:
+                    continue
+                if not include_dead and not e.live(now):
+                    continue
+                if tier is not None and e.tier != tier:
+                    continue
+                if agent_id is not None and e.agent_id != agent_id:
+                    continue
+                if virtual_user_id is not None and e.virtual_user_id != virtual_user_id:
+                    continue
+                if cats and e.category not in cats:
+                    continue
+                out.append(e)
+            return sorted(out, key=lambda e: -e.created_at)
+
+    def all_entries(self) -> list[MemoryEntry]:
+        with self._lock:
+            return list(self._entries.values())
+
+    def fts_rank(self, query: str, candidates: set) -> list[tuple[str, float]]:
+        with self._lock:
+            return self._fts.search(query, candidates)
+
+    def cosine_rank(self, query_vec: np.ndarray, candidates: list) -> list[tuple[str, float]]:
+        """candidates: MemoryEntry list with embeddings; returns ranked
+        (id, cosine) — one matmul over the stacked unit vectors."""
+        with self._lock:
+            have = [e for e in candidates if e.embedding is not None]
+            if not have:
+                return []
+            mat = np.stack([e.embedding for e in have])  # [N, D] unit rows
+            q = np.asarray(query_vec, dtype=np.float32)
+            q = q / max(float(np.linalg.norm(q)), 1e-9)
+            sims = mat @ q
+            order = np.argsort(-sims)
+            return [(have[i].id, float(sims[i])) for i in order]
+
+    def pending_embeddings(self, limit: int = 64) -> list[MemoryEntry]:
+        with self._lock:
+            out = [
+                e
+                for e in self._entries.values()
+                if e.embedding is None and e.live()
+            ]
+            out.sort(key=lambda e: e.updated_at)
+            return out[:limit]
+
+    def relations_from(self, entry_id: str) -> list[Relation]:
+        with self._lock:
+            return [r for r in self._relations if r.src_id == entry_id]
+
+    def relations_to(self, entry_id: str) -> list[Relation]:
+        with self._lock:
+            return [r for r in self._relations if r.dst_id == entry_id]
+
+    def stats(self) -> dict:
+        with self._lock:
+            live = [e for e in self._entries.values() if e.live()]
+            return {
+                "entries": len(self._entries),
+                "live": len(live),
+                "embedded": sum(1 for e in live if e.embedding is not None),
+                "relations": len(self._relations),
+                "embedding_dim": self.embedding_dim,
+            }

@@ -1,0 +1,164 @@
+"""Memory data model: entity–relation–observation records with tier scoping.
+
+Mirrors the reference memory store's shape (reference internal/memory/
+types.go, store.go — Postgres+pgvector there) as plain dataclasses over a
+pluggable store. Tier is derived from scoping columns exactly as the
+reference derives it for list responses (internal/memory/ — the derived
+`tier` field on every row, reference cmd/memory-api/SERVICE.md "#1017"):
+
+  institutional : no agent_id, no virtual_user_id
+  agent         : agent_id only
+  user          : virtual_user_id only
+  user_for_agent: both
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+import uuid
+from typing import Optional
+
+import numpy as np
+
+TIER_INSTITUTIONAL = "institutional"
+TIER_AGENT = "agent"
+TIER_USER = "user"
+TIER_USER_FOR_AGENT = "user_for_agent"
+
+# Retrieval fusion / ranking defaults (reference
+# internal/memory/retrieve_multi_tier_hybrid.go:39-41 — RRF k=60;
+# MemoryPolicy spec.recall.halfLife default 30d per tier).
+RRF_K = 60
+DEFAULT_HALF_LIFE_DAYS = 30.0
+
+
+def derive_tier(agent_id: str, virtual_user_id: str) -> str:
+    if virtual_user_id and agent_id:
+        return TIER_USER_FOR_AGENT
+    if virtual_user_id:
+        return TIER_USER
+    if agent_id:
+        return TIER_AGENT
+    return TIER_INSTITUTIONAL
+
+
+@dataclasses.dataclass
+class Observation:
+    """An append-only fact attached to a memory entity."""
+
+    content: str
+    created_at: float = dataclasses.field(default_factory=time.time)
+    source: str = ""
+
+
+@dataclasses.dataclass
+class Relation:
+    """Directed edge between two memory entities (graph traversal)."""
+
+    src_id: str
+    relation: str
+    dst_id: str
+    weight: float = 1.0
+    created_at: float = dataclasses.field(default_factory=time.time)
+
+
+@dataclasses.dataclass
+class MemoryEntry:
+    workspace_id: str
+    content: str
+    id: str = dataclasses.field(default_factory=lambda: uuid.uuid4().hex)
+    agent_id: str = ""
+    virtual_user_id: str = ""
+    category: str = "general"
+    # Idempotency key: {kind, key} — re-writes with the same about.key
+    # upsert instead of duplicating (reference institutional ingest,
+    # cmd/memory-api/SERVICE.md `about={kind,key}` idempotent re-seed).
+    about: Optional[dict] = None
+    confidence: float = 0.8
+    purposes: list = dataclasses.field(default_factory=list)
+    metadata: dict = dataclasses.field(default_factory=dict)
+    observations: list = dataclasses.field(default_factory=list)
+    embedding: Optional[np.ndarray] = None
+    created_at: float = dataclasses.field(default_factory=time.time)
+    updated_at: float = dataclasses.field(default_factory=time.time)
+    last_accessed_at: float = 0.0
+    access_count: int = 0
+    ttl_s: Optional[float] = None
+    tombstoned_at: Optional[float] = None
+    superseded_by: Optional[str] = None
+    source: str = ""
+
+    @property
+    def tier(self) -> str:
+        return derive_tier(self.agent_id, self.virtual_user_id)
+
+    @property
+    def tombstoned(self) -> bool:
+        return self.tombstoned_at is not None
+
+    def expired(self, now: Optional[float] = None) -> bool:
+        if self.ttl_s is None:
+            return False
+        return (now or time.time()) >= self.created_at + self.ttl_s
+
+    def live(self, now: Optional[float] = None) -> bool:
+        return (
+            not self.tombstoned
+            and self.superseded_by is None
+            and not self.expired(now)
+        )
+
+    def to_dict(self, include_embedding: bool = False) -> dict:
+        d = {
+            "id": self.id,
+            "workspace_id": self.workspace_id,
+            "agent_id": self.agent_id,
+            "virtual_user_id": self.virtual_user_id,
+            "tier": self.tier,
+            "category": self.category,
+            "content": self.content,
+            "about": self.about,
+            "confidence": self.confidence,
+            "purposes": list(self.purposes),
+            "metadata": dict(self.metadata),
+            "observations": [dataclasses.asdict(o) for o in self.observations],
+            "created_at": self.created_at,
+            "updated_at": self.updated_at,
+            "last_accessed_at": self.last_accessed_at,
+            "access_count": self.access_count,
+            "ttl_s": self.ttl_s,
+            "tombstoned_at": self.tombstoned_at,
+            "superseded_by": self.superseded_by,
+            "source": self.source,
+        }
+        if include_embedding and self.embedding is not None:
+            d["embedding"] = [float(x) for x in self.embedding]
+        return d
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "MemoryEntry":
+        obs = [Observation(**o) for o in d.get("observations", [])]
+        emb = d.get("embedding")
+        return cls(
+            workspace_id=d["workspace_id"],
+            content=d.get("content", ""),
+            id=d.get("id", uuid.uuid4().hex),
+            agent_id=d.get("agent_id", ""),
+            virtual_user_id=d.get("virtual_user_id", ""),
+            category=d.get("category", "general"),
+            about=d.get("about"),
+            confidence=float(d.get("confidence", 0.8)),
+            purposes=list(d.get("purposes", [])),
+            metadata=dict(d.get("metadata", {})),
+            observations=obs,
+            embedding=np.asarray(emb, dtype=np.float32) if emb is not None else None,
+            created_at=float(d.get("created_at", time.time())),
+            updated_at=float(d.get("updated_at", time.time())),
+            last_accessed_at=float(d.get("last_accessed_at", 0.0)),
+            access_count=int(d.get("access_count", 0)),
+            ttl_s=d.get("ttl_s"),
+            tombstoned_at=d.get("tombstoned_at"),
+            superseded_by=d.get("superseded_by"),
+            source=d.get("source", ""),
+        )

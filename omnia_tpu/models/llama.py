@@ -243,6 +243,29 @@ def forward(params, cfg: ModelConfig, tokens, q_positions, cache_k, cache_v, wri
     return _logits(params, cfg, x), new_k, new_v
 
 
+def forward_embed(params, cfg: ModelConfig, tokens, mask):
+    """Embedding-role forward (reference Provider role `embedding`,
+    provider_types.go:40-63 — served remotely there, on-device here):
+    masked mean-pool of the final hidden states, L2-normalized f32 [B, D].
+
+    tokens: int32 [B, T]; mask: [B, T] (1 = real token, 0 = pad).
+    """
+    B, T = tokens.shape
+    x = params["embed"][tokens]
+    q_positions = jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32)[None, :], (B, T))
+    cos, sin = rope_cos_sin(q_positions, cfg.head_dim, cfg.rope_theta)
+
+    def body(x, p):
+        x, _, _ = _layer(x, p, cfg, cos, sin, q_positions, None, None, None)
+        return x, None
+
+    x, _ = jax.lax.scan(body, x, params["layers"])
+    x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps).astype(jnp.float32)
+    m = mask.astype(jnp.float32)[:, :, None]
+    pooled = (x * m).sum(axis=1) / jnp.maximum(m.sum(axis=1), 1.0)
+    return pooled / jnp.maximum(jnp.linalg.norm(pooled, axis=-1, keepdims=True), 1e-9)
+
+
 def forward_train(params, cfg: ModelConfig, tokens):
     """Full causal forward with no cache (training / scoring).
 

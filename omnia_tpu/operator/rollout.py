@@ -49,6 +49,11 @@ class RolloutState:
     step_index: int = -1
     step_entered_at: float = 0.0
     message: str = ""
+    # Rollback latch: the config hash that failed analysis. A rolled-back
+    # hash is never auto-retried — only a *new* config restarts a rollout
+    # (otherwise a persistently unhealthy candidate would be spawned and
+    # killed on every controller resync).
+    failed_hash: str = ""
 
     def to_status(self) -> dict:
         return {
@@ -104,7 +109,7 @@ class RolloutEngine:
         new_hash = dep.config_hash()
 
         if st.phase in (RolloutPhase.IDLE, RolloutPhase.PROMOTED, RolloutPhase.ROLLED_BACK):
-            if new_hash != dep.stable_hash:
+            if new_hash != dep.stable_hash and new_hash != st.failed_hash:
                 if not steps:
                     self._direct_replace(dep, new_hash)
                     st.phase = RolloutPhase.PROMOTED
@@ -129,6 +134,7 @@ class RolloutEngine:
         if not self.analyzer(dep):
             self._teardown_candidate(dep)
             st.phase = RolloutPhase.ROLLED_BACK
+            st.failed_hash = st.candidate_hash
             st.message = f"analysis failed at step {st.step_index}"
             logger.warning("rollout %s rolled back: %s", dep.name, st.message)
             return st

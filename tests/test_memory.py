@@ -1,0 +1,534 @@
+"""Memory plane tests: store, hybrid retrieval, tiers, consolidation,
+ingestion, retention/consent, graph, projection, API surface, and the
+on-device embedding forward."""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+import pytest
+
+from omnia_tpu.memory import (
+    ChunkStrategy,
+    ConsentEvent,
+    Consolidator,
+    HashingEmbedder,
+    InProcessMemory,
+    IngestRequest,
+    Ingestor,
+    MemoryAPI,
+    MemoryEntry,
+    MemoryStore,
+    Observation,
+    ReembedWorker,
+    Relation,
+    RetentionWorker,
+    Retriever,
+)
+from omnia_tpu.memory.retrieve import DenyExprError, compile_deny
+from omnia_tpu.memory.store import DimensionChangeNeedsConsent
+
+WS = "ws1"
+
+
+def make_api() -> MemoryAPI:
+    return MemoryAPI(embedder=HashingEmbedder(dim=64))
+
+
+def seed(api: MemoryAPI):
+    mems = [
+        dict(content="The user prefers dark roast coffee", virtual_user_id="u1", category="preference"),
+        dict(content="The user's deploy target is us-east1", virtual_user_id="u1", agent_id="a1", category="ops"),
+        dict(content="Agent escalation contact is the SRE oncall", agent_id="a1", category="ops"),
+        dict(content="Company holiday calendar is published every January", category="policy"),
+        dict(content="Another user's secret fact", virtual_user_id="u2", category="preference"),
+    ]
+    for m in mems:
+        status, resp = api.handle("POST", "/api/v1/memories", {"workspace_id": WS, **m})
+        assert status == 200, resp
+    if api.reembed:
+        api.reembed.drain()
+
+
+# ---------------------------------------------------------------------------
+# Store
+# ---------------------------------------------------------------------------
+
+
+class TestStore:
+    def test_save_and_tiers(self):
+        s = MemoryStore()
+        e1 = s.save(MemoryEntry(workspace_id=WS, content="inst fact"))
+        e2 = s.save(MemoryEntry(workspace_id=WS, content="agent fact", agent_id="a"))
+        e3 = s.save(MemoryEntry(workspace_id=WS, content="user fact", virtual_user_id="u"))
+        e4 = s.save(MemoryEntry(workspace_id=WS, content="ufa", virtual_user_id="u", agent_id="a"))
+        assert [e.tier for e in (e1, e2, e3, e4)] == [
+            "institutional",
+            "agent",
+            "user",
+            "user_for_agent",
+        ]
+
+    def test_about_key_upsert_is_idempotent(self):
+        s = MemoryStore()
+        a = s.save(MemoryEntry(workspace_id=WS, content="v1", about={"kind": "doc", "key": "k"}))
+        b = s.save(MemoryEntry(workspace_id=WS, content="v2", about={"kind": "doc", "key": "k"}))
+        assert a.id == b.id
+        assert s.get(a.id).content == "v2"
+        assert len(s.scan(WS)) == 1
+
+    def test_tombstone_hides_from_scan_and_fts(self):
+        s = MemoryStore()
+        e = s.save(MemoryEntry(workspace_id=WS, content="findable zebra"))
+        assert s.fts_rank("zebra", {e.id})
+        assert s.tombstone(e.id)
+        assert s.scan(WS) == []
+        assert not s.fts_rank("zebra", {e.id})
+
+    def test_embedding_dim_change_requires_consent(self):
+        s = MemoryStore(embedding_dim=8)
+        e = s.save(MemoryEntry(workspace_id=WS, content="x"))
+        s.set_embedding(e.id, np.ones(8, dtype=np.float32))
+        with pytest.raises(DimensionChangeNeedsConsent):
+            s.ensure_embedding_dim(16)
+        s.record_dimension_change_consent(16)
+        s.ensure_embedding_dim(16)
+        assert s.embedding_dim == 16
+        assert s.get(e.id).embedding is None  # discarded for re-embed
+        # consent is single-use
+        with pytest.raises(DimensionChangeNeedsConsent):
+            s.set_embedding(e.id, np.ones(16, dtype=np.float32))
+            s.ensure_embedding_dim(32)
+
+    def test_persistence_roundtrip(self, tmp_path):
+        p = str(tmp_path / "mem.jsonl")
+        s = MemoryStore(path=p)
+        a = s.save(MemoryEntry(workspace_id=WS, content="alpha"))
+        b = s.save(MemoryEntry(workspace_id=WS, content="beta"))
+        s.relate(Relation(src_id=a.id, relation="refines", dst_id=b.id))
+        s.set_embedding(a.id, np.ones(4, dtype=np.float32))
+        s.snapshot()
+        s2 = MemoryStore(path=p)
+        assert {e.content for e in s2.scan(WS)} == {"alpha", "beta"}
+        assert s2.relations_from(a.id)[0].dst_id == b.id
+        assert s2.get(a.id).embedding is not None
+        assert s2.fts_rank("alpha", {a.id, b.id})  # FTS index rebuilt
+
+
+# ---------------------------------------------------------------------------
+# Retrieval
+# ---------------------------------------------------------------------------
+
+
+class TestRetrieval:
+    def test_multi_tier_scoping(self):
+        api = make_api()
+        seed(api)
+        status, resp = api.handle(
+            "POST",
+            "/api/v1/memories/retrieve",
+            {"workspace_id": WS, "query": "user preference", "user_id": "u1", "limit": 10},
+        )
+        assert status == 200
+        contents = [m["content"] for m in resp["memories"]]
+        assert any("dark roast" in c for c in contents)
+        # u2's memory must never surface for u1
+        assert not any("secret" in c for c in contents)
+
+    def test_user_for_agent_needs_both_ids(self):
+        api = make_api()
+        seed(api)
+        _, without_agent = api.handle(
+            "POST",
+            "/api/v1/memories/retrieve",
+            {"workspace_id": WS, "query": "deploy target region", "user_id": "u1"},
+        )
+        assert not any("us-east1" in m["content"] for m in without_agent["memories"])
+        _, with_agent = api.handle(
+            "POST",
+            "/api/v1/memories/retrieve",
+            {"workspace_id": WS, "query": "deploy target region", "user_id": "u1", "agent_id": "a1"},
+        )
+        assert any("us-east1" in m["content"] for m in with_agent["memories"])
+
+    def test_semantic_surfaces_without_lexical_overlap(self):
+        """RRF fuses the vector rank in: a query with related wording but
+        few shared tokens still finds the memory via cosine."""
+        api = make_api()
+        api.handle(
+            "POST",
+            "/api/v1/memories",
+            {"workspace_id": WS, "content": "espresso brewing preferences coffee"},
+        )
+        api.reembed.drain()
+        _, resp = api.handle(
+            "POST",
+            "/api/v1/memories/retrieve",
+            {"workspace_id": WS, "query": "espresso brewing"},
+        )
+        assert resp["memories"]
+
+    def test_missing_workspace_is_400(self):
+        api = make_api()
+        status, _ = api.handle("POST", "/api/v1/memories/retrieve", {"query": "x"})
+        assert status == 400
+
+    def test_retrieve_without_embedder_falls_back_to_fts(self):
+        api = MemoryAPI()  # no embedder
+        api.handle("POST", "/api/v1/memories", {"workspace_id": WS, "content": "zebra stripes"})
+        _, resp = api.handle(
+            "POST", "/api/v1/memories/retrieve", {"workspace_id": WS, "query": "zebra"}
+        )
+        assert len(resp["memories"]) == 1
+
+    def test_min_confidence_and_purposes_filter(self):
+        api = make_api()
+        api.handle(
+            "POST",
+            "/api/v1/memories",
+            {"workspace_id": WS, "content": "low conf zebra", "confidence": 0.2},
+        )
+        api.handle(
+            "POST",
+            "/api/v1/memories",
+            {"workspace_id": WS, "content": "high conf zebra", "confidence": 0.9,
+             "purposes": ["support"]},
+        )
+        api.reembed.drain()
+        _, resp = api.handle(
+            "POST",
+            "/api/v1/memories/retrieve",
+            {"workspace_id": WS, "query": "zebra", "min_confidence": 0.5,
+             "purposes": ["support"]},
+        )
+        assert [m["content"] for m in resp["memories"]] == ["high conf zebra"]
+
+    def test_recency_half_life_decay(self):
+        api = make_api()
+        old = MemoryEntry(workspace_id=WS, content="zebra old", created_at=time.time() - 90 * 86400)
+        api.store.save(old)
+        api.handle("POST", "/api/v1/memories", {"workspace_id": WS, "content": "zebra new"})
+        api.reembed.drain()
+        _, resp = api.handle(
+            "POST", "/api/v1/memories/retrieve", {"workspace_id": WS, "query": "zebra"}
+        )
+        assert resp["memories"][0]["content"] == "zebra new"
+
+
+class TestDenyFilter:
+    def test_deny_expr(self):
+        pred = compile_deny('category == "secret" || metadata.site contains "internal"')
+        assert pred({"category": "secret", "metadata": {}})
+        assert pred({"category": "x", "metadata": {"site": "internal-wiki"}})
+        assert not pred({"category": "x", "metadata": {"site": "public"}})
+
+    def test_malformed_fails_closed_500(self):
+        api = make_api()
+        seed(api)
+        status, _ = api.handle(
+            "POST",
+            "/api/v1/memories/retrieve/semantic",
+            {"workspace_id": WS, "query": "coffee", "deny_cel": "category =="},
+        )
+        assert status == 500
+        with pytest.raises(DenyExprError):
+            compile_deny("&& bogus ((")
+
+    def test_semantic_deny_filters_results(self):
+        api = make_api()
+        seed(api)
+        _, allowed = api.handle(
+            "POST",
+            "/api/v1/memories/retrieve/semantic",
+            {"workspace_id": WS, "query": "coffee preference",
+             "deny_cel": 'category == "preference"'},
+        )
+        assert not any(m["category"] == "preference" for m in allowed["memories"])
+
+
+# ---------------------------------------------------------------------------
+# Embeddings
+# ---------------------------------------------------------------------------
+
+
+class TestEmbedding:
+    def test_hashing_embedder_deterministic_unit(self):
+        e = HashingEmbedder(dim=64)
+        v1 = e.embed(["hello world"])
+        v2 = e.embed(["hello world"])
+        np.testing.assert_allclose(v1, v2)
+        assert abs(float(np.linalg.norm(v1[0])) - 1.0) < 1e-5
+        sim_close = float(v1[0] @ e.embed(["hello worlds"])[0])
+        sim_far = float(v1[0] @ e.embed(["quantum flux capacitor"])[0])
+        assert sim_close > sim_far
+
+    def test_reembed_worker_backfills(self):
+        store = MemoryStore()
+        store.save(MemoryEntry(workspace_id=WS, content="a"))
+        store.save(MemoryEntry(workspace_id=WS, content="b"))
+        w = ReembedWorker(store, HashingEmbedder(dim=32), batch=1)
+        assert w.drain() == 2
+        assert all(e.embedding is not None for e in store.scan(WS))
+
+    def test_tpu_embedder_on_tiny_model(self):
+        from omnia_tpu.engine.tokenizer import ByteTokenizer
+        from omnia_tpu.memory import TpuEmbedder
+        from omnia_tpu.models import get_config, llama
+        import jax
+
+        cfg = get_config("test-tiny")
+        params = llama.init_params(cfg, jax.random.key(0), dtype="float32")
+        emb = TpuEmbedder(params, cfg, ByteTokenizer())
+        vecs = emb.embed(["hello", "a much longer piece of text to embed"])
+        assert vecs.shape == (2, cfg.hidden_size)
+        norms = np.linalg.norm(vecs, axis=-1)
+        np.testing.assert_allclose(norms, 1.0, atol=1e-4)
+        # padding rows must not leak into real outputs
+        again = emb.embed(["hello"])
+        np.testing.assert_allclose(again[0], vecs[0], atol=1e-4)
+        # oversize inputs split into device-batch chunks instead of crashing
+        many = emb.embed([f"text {i}" for i in range(TpuEmbedder.BATCH_BUCKETS[-1] + 1)])
+        assert many.shape[0] == TpuEmbedder.BATCH_BUCKETS[-1] + 1
+
+
+# ---------------------------------------------------------------------------
+# Consolidation / ingestion / retention / graph / projection
+# ---------------------------------------------------------------------------
+
+
+class TestConsolidation:
+    def test_merge_supersedes_duplicate(self):
+        store = MemoryStore()
+        w = ReembedWorker(store, HashingEmbedder(dim=64))
+        a = store.save(MemoryEntry(workspace_id=WS, content="user loves dark roast coffee",
+                                   confidence=0.9, purposes=["personalization"]))
+        b = store.save(MemoryEntry(workspace_id=WS, content="user loves dark roast coffee beans",
+                                   confidence=0.6, purposes=["support"]))
+        store.save(MemoryEntry(workspace_id=WS, content="completely unrelated quantum physics"))
+        w.drain()
+        cons = Consolidator(store, dup_threshold=0.8)
+        out = cons.run_once(WS)
+        assert out["merged"] == 1
+        assert store.get(b.id).superseded_by == a.id
+        survivor = store.get(a.id)
+        assert set(survivor.purposes) == {"personalization", "support"}
+        assert survivor.live() and not store.get(b.id).live()
+        assert cons.supersessions[0].old_id == b.id
+
+    def test_chain_merge_never_folds_into_superseded_survivor(self):
+        """A~B and B~C (but A!~C): after B merges into A, the (B,C) pair
+        must not fold C into the now-dead B — C stays live instead."""
+        store = MemoryStore(embedding_dim=2)
+        a = store.save(MemoryEntry(workspace_id=WS, content="a", confidence=0.9))
+        b = store.save(MemoryEntry(workspace_id=WS, content="b", confidence=0.8))
+        c = store.save(MemoryEntry(workspace_id=WS, content="c", confidence=0.7))
+        store.set_embedding(a.id, np.array([1.0, 0.0], dtype=np.float32))
+        store.set_embedding(b.id, np.array([0.96, 0.28], dtype=np.float32))
+        store.set_embedding(c.id, np.array([0.85, 0.53], dtype=np.float32))
+        cons = Consolidator(store, dup_threshold=0.95)  # a~b, b~c, NOT a~c
+        cons.run_once(WS)
+        # Whatever the merge order, exactly one live survivor remains and
+        # every chained entry's content is reachable on it.
+        live = [e for e in store.scan(WS)]
+        assert len(live) == 1
+        survivor = live[0]
+        reachable = {survivor.content} | {o.content for o in survivor.observations}
+        assert {"a", "b", "c"} <= reachable
+        # the supersession chain resolves every entry to the live survivor
+        for eid in (a.id, b.id, c.id):
+            assert cons.resolve(eid).id == survivor.id
+
+    def test_conflict_detection_on_about_key(self):
+        store = MemoryStore()
+        a = store.save(MemoryEntry(workspace_id=WS, content="value is A", about={"kind": "fact", "key": "k1"}))
+        b = MemoryEntry(workspace_id=WS, content="value is B", about={"kind": "fact", "key": "k1"})
+        # bypass upsert to simulate two sources writing the same key
+        store._entries[b.id] = b
+        store._fts.index(b.id, b.content)
+        conflicts = Consolidator(store).detect_conflicts(WS)
+        assert len(conflicts) == 1
+        assert set(conflicts[0].entry_ids) == {a.id, b.id}
+
+
+class TestIngestion:
+    def test_chunking_with_overlap(self):
+        text = " ".join(f"w{i}" for i in range(500))
+        chunks = ChunkStrategy(chunk_words=200, overlap=40).chunks(text)
+        assert len(chunks) == 3
+        assert chunks[0].split()[-40:] == chunks[1].split()[:40]
+
+    def test_reingest_shorter_doc_tombstones_stale_chunks(self):
+        api = make_api()
+        long_doc = {"workspace_id": WS, "url": "https://x/d",
+                    "text": " ".join(f"w{i}" for i in range(500))}
+        api.handle("POST", "/api/v1/institutional/ingest", long_doc)
+        short_doc = dict(long_doc, text=" ".join(f"w{i}" for i in range(100)))
+        api.handle("POST", "/api/v1/institutional/ingest", short_doc)
+        _, listing = api.handle("GET", "/api/v1/institutional/memories", {"workspace_id": WS})
+        assert listing["total"] == 1  # stale trailing chunks tombstoned
+        api.close()
+
+    def test_ingest_idempotent_reseed(self):
+        api = make_api()
+        doc = {"workspace_id": WS, "title": "T", "url": "https://x/doc",
+               "text": " ".join(f"word{i}" for i in range(300))}
+        status, resp = api.handle("POST", "/api/v1/institutional/ingest", doc)
+        assert status == 202 and resp["chunks"] == 2
+        api.handle("POST", "/api/v1/institutional/ingest", doc)
+        _, listing = api.handle("GET", "/api/v1/institutional/memories", {"workspace_id": WS})
+        assert listing["total"] == 2  # re-seed upserted, not duplicated
+        assert all(m["tier"] == "institutional" for m in listing["memories"])
+        api.close()
+
+
+class TestRetention:
+    def test_ttl_tombstone_and_purge(self):
+        store = MemoryStore()
+        e = store.save(MemoryEntry(workspace_id=WS, content="ephemeral", ttl_s=10))
+        keeper = store.save(MemoryEntry(workspace_id=WS, content="keeper"))
+        w = RetentionWorker(store, tombstone_grace_s=100)
+        now = e.created_at + 11
+        out = w.sweep(now=now)
+        assert out["expired"] == 1
+        assert not store.get(e.id).live() and store.get(keeper.id).live()
+        out2 = w.sweep(now=now + 101)
+        assert out2["purged"] == 1
+        assert store.get(e.id) is None
+
+    def test_consent_revocation_prunes(self):
+        store = MemoryStore()
+        w = RetentionWorker(store)
+        store.save(MemoryEntry(workspace_id=WS, content="ad prefs", virtual_user_id="u1",
+                               purposes=["ads"]))
+        keep = store.save(MemoryEntry(workspace_id=WS, content="multi", virtual_user_id="u1",
+                                      purposes=["ads", "support"]))
+        w.consent.record(ConsentEvent(WS, "u1", "ads", granted=False))
+        out = w.sweep()
+        assert out["consent_pruned"] == 1
+        assert store.get(keep.id).live()  # not fully covered by revocation
+        assert not w.consent.granted(WS, "u1", "ads")
+        assert w.consent.granted(WS, "u1", "support")
+
+
+class TestGraphAndProjection:
+    def test_traversal_bounded(self):
+        store = MemoryStore()
+        ids = [store.save(MemoryEntry(workspace_id=WS, content=f"n{i}")).id for i in range(4)]
+        store.relate(Relation(src_id=ids[0], relation="refines", dst_id=ids[1]))
+        store.relate(Relation(src_id=ids[1], relation="refines", dst_id=ids[2]))
+        store.relate(Relation(src_id=ids[2], relation="refines", dst_id=ids[3]))
+        from omnia_tpu.memory.graph import traverse
+
+        nodes = traverse(store, [ids[0]], max_depth=2)
+        assert {n["entry"].id for n in nodes} == {ids[1], ids[2]}
+
+    def test_projection_renders_and_caches(self):
+        api = make_api()
+        seed(api)
+        from omnia_tpu.memory.projection import ProjectionStore
+
+        proj = ProjectionStore(api.store)
+        text = proj.render(WS, "u1", "a1")
+        assert "dark roast" in text
+        assert "secret" not in text
+        assert proj.render(WS, "u1", "a1") == text  # cached
+
+
+# ---------------------------------------------------------------------------
+# API surface
+# ---------------------------------------------------------------------------
+
+
+class TestAPI:
+    def test_aggregate_group_by(self):
+        api = make_api()
+        seed(api)
+        for group_by, expect_key in (("category", "preference"), ("tier", "user"), ("agent", "a1")):
+            status, resp = api.handle(
+                "GET", "/api/v1/memories/aggregate",
+                {"workspace_id": WS, "groupBy": group_by},
+            )
+            assert status == 200
+            assert expect_key in resp["counts"], (group_by, resp)
+
+    def test_crud_and_observations(self):
+        api = make_api()
+        _, saved = api.handle("POST", "/api/v1/memories", {"workspace_id": WS, "content": "crud"})
+        mid = saved["id"]
+        status, got = api.handle("GET", f"/api/v1/memories/{mid}", {"workspace_id": WS})
+        assert status == 200 and got["content"] == "crud"
+        api.handle("POST", f"/api/v1/memories/{mid}/observations",
+                   {"workspace_id": WS, "content": "obs one"})
+        _, got2 = api.handle("GET", f"/api/v1/memories/{mid}", {"workspace_id": WS})
+        assert got2["observations"][0]["content"] == "obs one"
+        status, _ = api.handle("DELETE", f"/api/v1/memories/{mid}", {"workspace_id": WS})
+        assert status == 200
+        status, _ = api.handle("DELETE", f"/api/v1/memories/{mid}", {"workspace_id": WS})
+        assert status == 404
+
+    def test_id_routes_are_workspace_authorized(self):
+        api = make_api()
+        _, saved = api.handle("POST", "/api/v1/memories", {"workspace_id": WS, "content": "mine"})
+        mid = saved["id"]
+        # no workspace → 400; wrong workspace → 404 (no cross-tenant reads)
+        assert api.handle("GET", f"/api/v1/memories/{mid}", None)[0] == 400
+        assert api.handle("GET", f"/api/v1/memories/{mid}", {"workspace_id": "other"})[0] == 404
+        assert api.handle("DELETE", f"/api/v1/memories/{mid}", {"workspace_id": "other"})[0] == 404
+        # a save naming another workspace's id must not overwrite it
+        status, _ = api.handle(
+            "POST", "/api/v1/memories",
+            {"workspace_id": "other", "id": mid, "content": "stolen"},
+        )
+        assert status == 400
+        assert api.store.get(mid).content == "mine"
+
+    def test_about_key_upsert_is_scope_local(self):
+        api = make_api()
+        inst = api.store.save(MemoryEntry(
+            workspace_id=WS, content="institutional truth",
+            about={"kind": "doc", "key": "https://d#0"}))
+        status, resp = api.handle(
+            "POST", "/api/v1/memories",
+            {"workspace_id": WS, "virtual_user_id": "mallory", "content": "poison",
+             "about": {"kind": "doc", "key": "https://d#0"}},
+        )
+        assert status == 200
+        assert api.store.get(inst.id).content == "institutional truth"
+        assert resp["id"] != inst.id  # landed as a separate user-tier entry
+
+    def test_consent_stats(self):
+        api = make_api()
+        api.handle("POST", "/api/v1/consent",
+                   {"workspace_id": WS, "virtual_user_id": "u1", "category": "ads", "granted": False})
+        _, stats = api.handle("GET", "/api/v1/privacy/consent/stats", {"workspace_id": WS})
+        assert stats == {"users": 1, "grants": 1, "revoked": 1}
+
+    def test_http_server_end_to_end(self):
+        import urllib.request
+
+        api = make_api()
+        port = api.serve()
+        base = f"http://localhost:{port}"
+        req = urllib.request.Request(
+            base + "/api/v1/memories",
+            data=b'{"workspace_id": "ws1", "content": "over http"}',
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req) as resp:
+            assert resp.status == 200
+        from omnia_tpu.memory import MemoryClient
+
+        client = MemoryClient(base)
+        mems = client.recall(WS, "http")
+        assert any("over http" in m["content"] for m in mems)
+        with urllib.request.urlopen(base + "/metrics") as resp:
+            assert b"omnia_memory_requests_total" in resp.read()
+        api.close()
+
+    def test_in_process_client(self):
+        mem = InProcessMemory(make_api())
+        mem.remember(WS, "in process fact", virtual_user_id="u9")
+        mem.api.reembed.drain()
+        out = mem.recall(WS, "in process fact", virtual_user_id="u9")
+        assert out and out[0]["content"] == "in process fact"

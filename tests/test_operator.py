@@ -384,6 +384,25 @@ def test_rollout_rollback_on_failed_analysis(manager):
     assert dep.stable_hash == stable_before
     assert not dep.candidate_pods and dep.candidate_weight == 0
 
+    # The failed hash is latched: further resyncs must NOT respawn a
+    # candidate for the same (still-failing) config ...
+    cm.rollouts.tick(dep)
+    cm.rollouts.tick(dep)
+    assert cm.rollouts.state(dep).phase == RolloutPhase.ROLLED_BACK
+    assert not dep.candidate_pods
+
+    # ... but a NEW config does restart a rollout.
+    cm.rollouts.analyzer = lambda d: True
+    store.apply(
+        Resource(
+            kind="PromptPack",
+            name="op-pack",
+            spec={"content": {**PACK_CONTENT, "version": "3.0.0"}},
+        )
+    )
+    cm.drain_queue()
+    assert cm.rollouts.state(dep).phase == RolloutPhase.PROGRESSING
+
 
 def test_capability_gate_latches_without_flapping(manager, monkeypatch):
     """Once gated, resyncs must NOT restart pods until the config changes."""
