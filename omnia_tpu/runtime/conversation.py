@@ -109,15 +109,25 @@ class ToolCallStreamParser:
         return rest
 
 
-def render_prompt(pack: PromptPack, state: ConversationState, params: Optional[dict] = None) -> str:
+def render_prompt(
+    pack: PromptPack,
+    state: ConversationState,
+    params: Optional[dict] = None,
+    memory_block: str = "",
+    extra_tools: Optional[list] = None,
+) -> str:
     """Chat-format the conversation for the model. Tool declarations ride in
-    the system block so the model knows the call convention."""
+    the system block so the model knows the call convention; ambient
+    memories (when a memory capability is wired) land there too."""
     parts = [f"[SYS]{pack.render_system(params)}"]
-    if pack.tools:
+    if memory_block:
+        parts.append(f"\n{memory_block}")
+    all_tools = list(pack.tools) + list(extra_tools or [])
+    if all_tools:
         tool_desc = json.dumps(
             [
                 {"name": t["name"], "description": t.get("description", "")}
-                for t in pack.tools
+                for t in all_tools
             ]
         )
         parts.append(f"\n[TOOLS]{tool_desc}[/TOOLS]")
@@ -147,6 +157,8 @@ class Conversation:
         tool_executor: Optional[ToolExecutor] = None,
         pack_params: Optional[dict] = None,
         on_event: Optional[Callable[[str, dict], None]] = None,
+        memory=None,
+        user_id: str = "",
     ):
         self.session_id = session_id
         self.pack = pack
@@ -155,6 +167,8 @@ class Conversation:
         self.store = store
         self.provider_spec = provider_spec
         self.tools = tool_executor or ToolExecutor()
+        self.memory = memory  # MemoryCapability (reference sdk.WithMemory)
+        self.user_id = user_id  # authenticated identity, set by the server
         self.pack_params = pack_params or {}
         self.on_event = on_event or (lambda kind, data: None)
         self._client_results: "queue.Queue[list[ToolResult]]" = queue.Queue()
@@ -218,6 +232,15 @@ class Conversation:
         usage = Usage()
         sp = self._sampling(msg)
 
+        # Ambient memory retrieval: once per turn, against the user's
+        # message (reference CompositeRetriever — best-effort, the block
+        # is "" on any failure).
+        memory_block = ""
+        extra_tools: list = []
+        if self.memory is not None:
+            memory_block = self.memory.ambient_block(msg.content, self.user_id)
+            extra_tools = self.memory.tool_defs()
+
         for _ in range(MAX_TOOL_ROUNDS + 1):
             # A cancel that landed between rounds (no engine request in
             # flight) must stop the turn, not be silently ignored.
@@ -230,7 +253,10 @@ class Conversation:
                 yield ServerMessage(type="done", usage=usage, finish_reason="cancelled")
                 return
 
-            prompt = render_prompt(self.pack, state, self.pack_params)
+            prompt = render_prompt(
+                self.pack, state, self.pack_params,
+                memory_block=memory_block, extra_tools=extra_tools,
+            )
             prompt_ids = self.tokenizer.encode(prompt)
             usage.prompt_tokens += len(prompt_ids)
 
@@ -401,6 +427,17 @@ class Conversation:
             )
         ]
         self.on_event("tool_call", {"name": name, "arguments": arguments, "id": call_id})
+
+        if self.memory is not None and self.memory.handles(name):
+            # Memory tool override (reference memory_tool_overrides.go):
+            # dispatched against the capability, scoped by authenticated
+            # identity — never through the generic executor.
+            content, is_error = self.memory.execute(name, arguments, self.user_id)
+            self.on_event(
+                "tool_result", {"id": call_id, "is_error": is_error, "content": content}
+            )
+            turns.append(Turn(role="tool", content=content, tool_call_id=call_id))
+            return turns, None, None
 
         if self.tools.is_client_side(name):
             msg = ServerMessage(

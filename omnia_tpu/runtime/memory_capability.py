@@ -1,0 +1,135 @@
+"""Runtime memory capability: ambient retrieval + memory tools.
+
+The reference wires memory into the conversation two ways (reference
+internal/runtime/conversation.go:183-241 + memory_retriever.go +
+memory_tool_overrides.go): a CompositeRetriever injects relevant
+memories into the system context each turn (ambient RAG), and the
+`memory__remember` / `memory__recall` tools let the model read/write
+memory explicitly. Scope is {workspace, virtual_user, agent} — the user
+id comes from the authenticated identity metadata, never from the model.
+
+Works over either memory client (HTTP MemoryClient or InProcessMemory)
+since both expose remember/recall. Retrieval failures degrade to
+no-injection (ambient memory is best-effort; the turn must not die
+because memory-api is down) — but explicit tool calls report errors
+honestly so the model knows a remember didn't land.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+from typing import Optional
+
+logger = logging.getLogger(__name__)
+
+TOOL_REMEMBER = "memory__remember"
+TOOL_RECALL = "memory__recall"
+
+MEMORY_TOOL_DEFS = [
+    {
+        "name": TOOL_REMEMBER,
+        "description": (
+            "Save a durable fact about the user or task for future "
+            "conversations. Arguments: content (string, required), "
+            "category (string, optional)."
+        ),
+    },
+    {
+        "name": TOOL_RECALL,
+        "description": (
+            "Search long-term memory. Arguments: query (string, required), "
+            "limit (int, optional)."
+        ),
+    },
+]
+
+
+class MemoryCapability:
+    def __init__(
+        self,
+        client,
+        workspace_id: str,
+        agent_id: str = "",
+        ambient_limit: int = 4,
+        expose_tools: bool = True,
+    ):
+        self.client = client
+        self.workspace_id = workspace_id
+        self.agent_id = agent_id
+        self.ambient_limit = ambient_limit
+        self.expose_tools = expose_tools
+
+    # -- ambient retrieval (system-context injection) ---------------------
+
+    def ambient_block(self, query: str, user_id: str) -> str:
+        """Relevant-memory block for the system prompt, or "" (failures
+        included — ambient memory never kills a turn)."""
+        try:
+            mems = self.client.recall(
+                self.workspace_id,
+                query,
+                virtual_user_id=user_id,
+                agent_id=self.agent_id,
+                limit=self.ambient_limit,
+            )
+        except Exception:  # noqa: BLE001
+            logger.exception("ambient memory retrieval failed; continuing without")
+            return ""
+        if not mems:
+            return ""
+        lines = [f"- ({m.get('category', 'general')}) {m.get('content', '')}" for m in mems]
+        return "[MEMORY]\n" + "\n".join(lines) + "\n[/MEMORY]"
+
+    # -- explicit tools ---------------------------------------------------
+
+    def tool_defs(self) -> list[dict]:
+        return list(MEMORY_TOOL_DEFS) if self.expose_tools else []
+
+    def handles(self, name: str) -> bool:
+        return self.expose_tools and name in (TOOL_REMEMBER, TOOL_RECALL)
+
+    def execute(self, name: str, arguments: dict, user_id: str):
+        """→ (content, is_error). The scope ids come from the capability
+        and the authenticated identity — model-supplied scope is ignored."""
+        try:
+            if name == TOOL_REMEMBER:
+                content = str(arguments.get("content", "")).strip()
+                if not content:
+                    return "remember requires non-empty content", True
+                if not user_id:
+                    # An anonymous session's write would land agent- or
+                    # institutional-tier (derive_tier on empty ids) and
+                    # surface in EVERY user's ambient recall — refuse
+                    # instead of silently escalating scope.
+                    return (
+                        "cannot remember without an authenticated user identity",
+                        True,
+                    )
+                self.client.remember(
+                    self.workspace_id,
+                    content,
+                    virtual_user_id=user_id,
+                    agent_id=self.agent_id,
+                    category=str(arguments.get("category", "general")),
+                )
+                return "remembered", False
+            if name == TOOL_RECALL:
+                query = str(arguments.get("query", ""))
+                limit = int(arguments.get("limit", 5))
+                mems = self.client.recall(
+                    self.workspace_id,
+                    query,
+                    virtual_user_id=user_id,
+                    agent_id=self.agent_id,
+                    limit=max(1, min(limit, 20)),
+                )
+                out = [
+                    {"content": m.get("content", ""), "category": m.get("category", "")}
+                    for m in mems
+                ]
+                return json.dumps({"memories": out}), False
+            return f"unknown memory tool {name}", True
+        except Exception as e:  # noqa: BLE001 — report, don't crash the turn
+            logger.exception("memory tool %s failed", name)
+            return f"memory operation failed: {e}", True

@@ -62,13 +62,21 @@ class RuntimeServer:
         capabilities: Optional[list[str]] = None,
         pack_params: Optional[dict] = None,
         on_event=None,
+        memory=None,
     ):
         self.pack = pack
         self.providers = providers
         self.provider_name = provider_name
         self.store = context_store or InMemoryContextStore()
         self.tools = tool_executor or ToolExecutor()
-        self.capabilities = capabilities or list(DEFAULT_CAPABILITIES)
+        self.memory = memory  # MemoryCapability shared by conversations
+        # Copy: appending 'memory' below must never mutate a caller list
+        # shared with another server.
+        self.capabilities = list(capabilities) if capabilities else list(DEFAULT_CAPABILITIES)
+        if memory is not None and c.Capability.MEMORY.value not in self.capabilities:
+            # Honest capability advertisement (reference runtime.proto
+            # :350-354): only claim memory when a capability is wired.
+            self.capabilities.append(c.Capability.MEMORY.value)
         self.pack_params = pack_params or {}
         self.on_event = on_event
         self._conversations: dict[str, Conversation] = {}
@@ -87,7 +95,7 @@ class RuntimeServer:
     def spec(self):
         return self.providers.spec(self.provider_name)
 
-    def _get_or_create(self, session_id: str) -> Conversation:
+    def _get_or_create(self, session_id: str, user_id: str = "") -> Conversation:
         conv = self._conversations.get(session_id)
         if conv is None:
             with self._conv_lock:
@@ -95,6 +103,8 @@ class RuntimeServer:
                 if conv is None:
                     conv = Conversation(
                         session_id=session_id,
+                        memory=self.memory,
+                        user_id=user_id,
                         pack=self.pack,
                         engine=self.engine,
                         tokenizer=build_tokenizer(self.spec),
@@ -118,7 +128,18 @@ class RuntimeServer:
     def converse(self, request_iterator, context):
         md = dict(context.invocation_metadata())
         session_id = md.get(c.MD_SESSION_ID) or f"sess-{uuid.uuid4().hex[:12]}"
-        conv = self._get_or_create(session_id)
+        user_id = md.get(c.MD_USER_ID, "")
+        conv = self._get_or_create(session_id, user_id=user_id)
+        if conv.user_id != user_id:
+            # A session is pinned to the identity that created it: a
+            # reconnect presenting a different (or missing) x-omnia-user-id
+            # must not inherit the cached identity's memory scope.
+            yield c.ServerMessage(
+                type="error",
+                error_code="session_identity_mismatch",
+                error_message="session belongs to a different identity",
+            )
+            return
 
         yield c.ServerMessage(
             type="hello",

@@ -521,3 +521,177 @@ class TestReviewRegressions:
             client.close()
         finally:
             server.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# Memory capability in the turn loop
+# ---------------------------------------------------------------------------
+
+
+class TestMemoryCapability:
+    def _memory(self, ambient_limit=4):
+        from omnia_tpu.memory import HashingEmbedder, InProcessMemory, MemoryAPI
+        from omnia_tpu.runtime.memory_capability import MemoryCapability
+
+        mem = InProcessMemory(MemoryAPI(embedder=HashingEmbedder(dim=64)))
+        return mem, MemoryCapability(mem, workspace_id="ws", agent_id="agent1")
+
+    def _conv_with_memory(self, scenarios, capability, user_id="u1"):
+        conv = _make_conversation(scenarios)
+        conv.memory = capability
+        conv.user_id = user_id
+        return conv
+
+    def test_ambient_memory_injected_into_prompt(self):
+        mem, cap = self._memory()
+        mem.remember("ws", "the user is allergic to peanuts",
+                     virtual_user_id="u1", agent_id="agent1")
+        mem.api.reembed.drain()
+        seen_prompts = []
+
+        class SpyEngine(MockEngine):
+            def submit(self, prompt_tokens, params=SamplingParams()):
+                seen_prompts.append(ByteTokenizer().decode(prompt_tokens))
+                return super().submit(prompt_tokens, params)
+
+        conv = self._conv_with_memory([Scenario(pattern=".", reply="ok")], cap)
+        conv.engine = SpyEngine([Scenario(pattern=".", reply="ok")], tokenizer=ByteTokenizer())
+        list(conv.stream(c.ClientMessage(content="what snacks are safe? peanuts allergic?")))
+        assert "[MEMORY]" in seen_prompts[0]
+        assert "allergic to peanuts" in seen_prompts[0]
+        # memory tools advertised in the system block
+        assert "memory__remember" in seen_prompts[0]
+
+    def test_memory_remember_tool_scoped_to_identity(self):
+        mem, cap = self._memory()
+        scenarios = [
+            Scenario(pattern=r"\[TOOL\]remembered", reply="noted!"),
+            Scenario(
+                pattern=r"likes tabs",
+                reply='<tool_call>{"name": "memory__remember", "arguments": {"content": "user likes tabs", "category": "preference"}}</tool_call>',
+            ),
+        ]
+        conv = self._conv_with_memory(scenarios, cap, user_id="u7")
+        msgs = list(conv.stream(c.ClientMessage(content="I want you to know I likes tabs")))
+        assert msgs[-1].type == "done"
+        mem.api.reembed.drain()
+        saved = mem.api.store.scan("ws")
+        assert len(saved) == 1
+        # scope comes from authenticated identity, not the model
+        assert saved[0].virtual_user_id == "u7"
+        assert saved[0].agent_id == "agent1"
+        assert saved[0].tier == "user_for_agent"
+
+    def test_memory_recall_tool_round(self):
+        mem, cap = self._memory()
+        mem.remember("ws", "deploy window is friday", virtual_user_id="u1",
+                     agent_id="agent1", category="ops")
+        mem.api.reembed.drain()
+        scenarios = [
+            Scenario(pattern=r"\[TOOL\].*deploy window is friday", reply="it is friday"),
+            Scenario(
+                pattern=r"when can we deploy",
+                reply='<tool_call>{"name": "memory__recall", "arguments": {"query": "deploy window"}}</tool_call>',
+            ),
+        ]
+        conv = self._conv_with_memory(scenarios, cap)
+        msgs = list(conv.stream(c.ClientMessage(content="when can we deploy?")))
+        text = "".join(m.text for m in msgs if m.type == "chunk")
+        assert "it is friday" in text
+
+    def test_memory_failure_degrades_not_dies(self):
+        from omnia_tpu.runtime.memory_capability import MemoryCapability
+
+        class BrokenClient:
+            def recall(self, *a, **k):
+                raise RuntimeError("memory-api down")
+
+            def remember(self, *a, **k):
+                raise RuntimeError("memory-api down")
+
+        cap = MemoryCapability(BrokenClient(), workspace_id="ws")
+        conv = self._conv_with_memory([Scenario(pattern=".", reply="fine")], cap)
+        msgs = list(conv.stream(c.ClientMessage(content="hello")))
+        assert msgs[-1].type == "done"  # ambient failure → turn continues
+        # explicit tool failure is reported as a tool error, not a crash
+        content, is_error = cap.execute("memory__remember", {"content": "x"}, "u1")
+        assert is_error and "failed" in content
+
+    def test_server_advertises_memory_capability(self):
+        from omnia_tpu.memory import HashingEmbedder, InProcessMemory, MemoryAPI
+        from omnia_tpu.runtime.memory_capability import MemoryCapability
+
+        mem = InProcessMemory(MemoryAPI(embedder=HashingEmbedder(dim=32)))
+        cap = MemoryCapability(mem, workspace_id="ws")
+        registry = ProviderRegistry()
+        registry.register(
+            ProviderSpec(name="mock", type="mock",
+                         options={"scenarios": [{"pattern": ".", "reply": "ok"}]})
+        )
+        server = RuntimeServer(
+            pack=load_pack(PACK), providers=registry, provider_name="mock", memory=cap
+        )
+        assert c.Capability.MEMORY.value in server.capabilities
+        plain = RuntimeServer(pack=load_pack(PACK), providers=registry, provider_name="mock")
+        assert c.Capability.MEMORY.value not in plain.capabilities
+
+    def test_session_identity_pinned_across_streams(self):
+        from omnia_tpu.memory import HashingEmbedder, InProcessMemory, MemoryAPI
+        from omnia_tpu.runtime.memory_capability import MemoryCapability
+
+        mem = InProcessMemory(MemoryAPI(embedder=HashingEmbedder(dim=32)))
+        registry = ProviderRegistry()
+        registry.register(
+            ProviderSpec(name="mock", type="mock",
+                         options={"scenarios": [{"pattern": ".", "reply": "ok"}]})
+        )
+        server = RuntimeServer(
+            pack=load_pack(PACK), providers=registry, provider_name="mock",
+            memory=MemoryCapability(mem, workspace_id="ws"),
+        )
+        port = server.serve("localhost:0")
+        try:
+            client = RuntimeClient(f"localhost:{port}")
+            s1 = client.open_stream("pinned-sess", user_id="alice")
+            assert list(s1.turn("hi"))[-1].type == "done"
+            s1.close()
+            # same session, different identity → rejected, not inherited
+            s2 = client.open_stream("pinned-sess", user_id="mallory")
+            msgs = list(s2.turn("hi"))
+            assert msgs[-1].type == "error"
+            assert msgs[-1].error_code == "session_identity_mismatch"
+            s2.close()
+            # missing identity is a mismatch too
+            s3 = client.open_stream("pinned-sess")
+            msgs = list(s3.turn("hi"))
+            assert msgs[-1].error_code == "session_identity_mismatch"
+            s3.close()
+            client.close()
+        finally:
+            server.shutdown()
+
+    def test_anonymous_remember_refused_not_escalated(self):
+        mem, cap = self._memory()
+        content, is_error = cap.execute(
+            "memory__remember", {"content": "private fact"}, user_id=""
+        )
+        assert is_error and "identity" in content
+        assert mem.api.store.scan("ws") == []  # nothing written at any tier
+
+    def test_shared_capabilities_list_not_mutated(self):
+        from omnia_tpu.memory import HashingEmbedder, InProcessMemory, MemoryAPI
+        from omnia_tpu.runtime.memory_capability import MemoryCapability
+        from omnia_tpu.runtime.server import DEFAULT_CAPABILITIES
+
+        shared = ["text", "streaming"]
+        mem = InProcessMemory(MemoryAPI(embedder=HashingEmbedder(dim=32)))
+        registry = ProviderRegistry()
+        registry.register(
+            ProviderSpec(name="mock", type="mock",
+                         options={"scenarios": [{"pattern": ".", "reply": "ok"}]})
+        )
+        RuntimeServer(pack=load_pack(PACK), providers=registry, provider_name="mock",
+                      memory=MemoryCapability(mem, workspace_id="ws"),
+                      capabilities=shared)
+        assert shared == ["text", "streaming"]
+        assert "memory" not in DEFAULT_CAPABILITIES
