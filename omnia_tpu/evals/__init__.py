@@ -1,0 +1,62 @@
+"""Eval plane: arena batch evals + realtime LLM-judge evals.
+
+TPU-native counterpart of the reference eval stack (reference ee/pkg/
+arena, ee/pkg/evals, ee/cmd/arena-worker, ee/cmd/arena-eval-worker):
+scenario × provider matrices partitioned onto a durable work queue,
+drained by direct (in-process engine) or fleet (WebSocket virtual-user)
+workers, results aggregated against thresholds; plus a realtime worker
+judging sampled session events. The judge runs on the serving engine's
+spare batch slots — no external LLM APIs anywhere."""
+
+from omnia_tpu.evals.aggregator import Aggregator, CellStats
+from omnia_tpu.evals.arena import ArenaJobController, JobPhase, JobStatus
+from omnia_tpu.evals.defs import (
+    ArenaJobSpec,
+    Check,
+    CheckResult,
+    EvalScenario,
+    ScenarioTurn,
+    Threshold,
+    WorkItem,
+    WorkResult,
+)
+from omnia_tpu.evals.judge import (
+    BudgetExceeded,
+    BudgetTracker,
+    CostCalculator,
+    Judge,
+    JudgeVerdict,
+    Sampler,
+)
+from omnia_tpu.evals.partitioner import partition
+from omnia_tpu.evals.queue import ArenaQueue
+from omnia_tpu.evals.realtime import RealtimeEvalWorker
+from omnia_tpu.evals.worker import ArenaWorker, DirectRunner, FleetRunner
+
+__all__ = [
+    "Aggregator",
+    "CellStats",
+    "ArenaJobController",
+    "JobPhase",
+    "JobStatus",
+    "ArenaJobSpec",
+    "Check",
+    "CheckResult",
+    "EvalScenario",
+    "ScenarioTurn",
+    "Threshold",
+    "WorkItem",
+    "WorkResult",
+    "BudgetExceeded",
+    "BudgetTracker",
+    "CostCalculator",
+    "Judge",
+    "JudgeVerdict",
+    "Sampler",
+    "partition",
+    "ArenaQueue",
+    "RealtimeEvalWorker",
+    "ArenaWorker",
+    "DirectRunner",
+    "FleetRunner",
+]

@@ -1,0 +1,104 @@
+"""Result aggregation + threshold gating.
+
+Reference ee/pkg/arena/{aggregator,threshold}: per scenario×provider
+cell — pass rate, error rate, latency percentiles, cost — then the job
+threshold decides pass/fail for the whole run."""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+from omnia_tpu.evals.defs import Threshold, WorkResult
+
+
+def _percentile(values: list[float], p: float) -> float:
+    if not values:
+        return 0.0
+    s = sorted(values)
+    idx = min(len(s) - 1, max(0, int(round(p / 100.0 * (len(s) - 1)))))
+    return s[idx]
+
+
+@dataclasses.dataclass
+class CellStats:
+    scenario: str
+    provider: str
+    runs: int = 0
+    passed: int = 0
+    errors: int = 0
+    latencies: list = dataclasses.field(default_factory=list)
+    cost_usd: float = 0.0
+    tokens: int = 0
+
+    @property
+    def pass_rate(self) -> float:
+        return self.passed / self.runs if self.runs else 0.0
+
+    @property
+    def error_rate(self) -> float:
+        return self.errors / self.runs if self.runs else 0.0
+
+    def to_dict(self) -> dict:
+        return {
+            "scenario": self.scenario,
+            "provider": self.provider,
+            "runs": self.runs,
+            "passed": self.passed,
+            "errors": self.errors,
+            "pass_rate": self.pass_rate,
+            "error_rate": self.error_rate,
+            "p50_latency_s": _percentile(self.latencies, 50),
+            "p95_latency_s": _percentile(self.latencies, 95),
+            "cost_usd": self.cost_usd,
+            "tokens": self.tokens,
+        }
+
+
+class Aggregator:
+    def __init__(self) -> None:
+        self._cells: dict[tuple, CellStats] = {}
+
+    def add(self, r: WorkResult) -> None:
+        key = (r.scenario, r.provider)
+        cell = self._cells.get(key)
+        if cell is None:
+            cell = self._cells[key] = CellStats(r.scenario, r.provider)
+        cell.runs += 1
+        if r.error:
+            cell.errors += 1
+        elif r.passed:
+            cell.passed += 1
+        cell.latencies.append(r.latency_s)
+        cell.cost_usd += r.cost_usd
+        cell.tokens += r.tokens
+
+    def cells(self) -> list[CellStats]:
+        return [self._cells[k] for k in sorted(self._cells)]
+
+    def evaluate(self, threshold: Threshold) -> dict:
+        """Job verdict: every cell must clear the threshold."""
+        failures = []
+        for cell in self.cells():
+            if cell.pass_rate < threshold.min_pass_rate:
+                failures.append(
+                    f"{cell.scenario}/{cell.provider}: pass_rate "
+                    f"{cell.pass_rate:.2f} < {threshold.min_pass_rate:.2f}"
+                )
+            if cell.error_rate > threshold.max_error_rate:
+                failures.append(
+                    f"{cell.scenario}/{cell.provider}: error_rate "
+                    f"{cell.error_rate:.2f} > {threshold.max_error_rate:.2f}"
+                )
+            if threshold.max_p95_latency_s is not None:
+                p95 = _percentile(cell.latencies, 95)
+                if p95 > threshold.max_p95_latency_s:
+                    failures.append(
+                        f"{cell.scenario}/{cell.provider}: p95 {p95:.2f}s "
+                        f"> {threshold.max_p95_latency_s:.2f}s"
+                    )
+        return {
+            "passed": not failures,
+            "failures": failures,
+            "cells": [c.to_dict() for c in self.cells()],
+        }

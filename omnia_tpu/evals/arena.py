@@ -1,0 +1,81 @@
+"""ArenaJob lifecycle: partition → enqueue → drain → aggregate → verdict.
+
+Reference ee/internal/controller/arenajob_controller.go:199 — the
+controller partitions the matrix, enqueues work, manages worker pods,
+and folds results into job status. Here the controller is a plain
+object the operator plane drives; workers scale as threads in-process
+or as separate processes sharing a file-backed stream."""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+import time
+from typing import Optional
+
+from omnia_tpu.evals.aggregator import Aggregator
+from omnia_tpu.evals.defs import ArenaJobSpec
+from omnia_tpu.evals.partitioner import partition
+from omnia_tpu.evals.queue import ArenaQueue
+
+
+class JobPhase(str, enum.Enum):
+    PENDING = "Pending"
+    RUNNING = "Running"
+    SUCCEEDED = "Succeeded"
+    FAILED = "Failed"
+
+
+@dataclasses.dataclass
+class JobStatus:
+    phase: JobPhase = JobPhase.PENDING
+    total: int = 0
+    completed: int = 0
+    verdict: Optional[dict] = None
+    started_at: float = 0.0
+    finished_at: float = 0.0
+
+    def to_dict(self) -> dict:
+        return {
+            "phase": self.phase.value,
+            "total": self.total,
+            "completed": self.completed,
+            "verdict": self.verdict,
+        }
+
+
+class ArenaJobController:
+    def __init__(self, queue: Optional[ArenaQueue] = None):
+        self.queue = queue or ArenaQueue()
+        self._jobs: dict[str, tuple[ArenaJobSpec, JobStatus, Aggregator]] = {}
+
+    def submit(self, spec: ArenaJobSpec) -> JobStatus:
+        items = partition(spec)
+        status = JobStatus(
+            phase=JobPhase.RUNNING, total=len(items), started_at=time.time()
+        )
+        self._jobs[spec.name] = (spec, status, Aggregator())
+        self.queue.enqueue(items)
+        return status
+
+    def reconcile(self, job: str) -> JobStatus:
+        """Fold any new results into the job; finalize when all arrived."""
+        spec, status, agg = self._jobs[job]
+        if status.phase not in (JobPhase.RUNNING,):
+            return status
+        for result in self.queue.consume_results():
+            owner = self._jobs.get(result.job)
+            if owner is None:
+                continue
+            o_spec, o_status, o_agg = owner
+            o_agg.add(result)
+            o_status.completed += 1
+        if status.completed >= status.total:
+            verdict = agg.evaluate(spec.threshold)
+            status.verdict = verdict
+            status.phase = JobPhase.SUCCEEDED if verdict["passed"] else JobPhase.FAILED
+            status.finished_at = time.time()
+        return status
+
+    def status(self, job: str) -> JobStatus:
+        return self._jobs[job][1]

@@ -1,0 +1,132 @@
+"""LLM-judge, sampling, budgets, cost accounting.
+
+Reference ee/pkg/evals: sdk_runner.go (judge prompt → provider → score),
+sampling.go (probabilistic + per-session caps), budget_tracker.go (spend
+ceilings), cost_calculator.go (token pricing). Here the judge runs on
+the SAME TPU engine that serves traffic (an engine is just a
+`complete(prompt) -> text` here) — judging rides spare slot capacity in
+the continuous batcher instead of calling an external API."""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import logging
+import random
+import re
+import threading
+from typing import Callable, Optional
+
+logger = logging.getLogger(__name__)
+
+JUDGE_TEMPLATE = (
+    "[SYS]You are an impartial evaluation judge. Score the assistant reply "
+    "against the rubric. Respond with ONLY a JSON object: "
+    '{{"score": <0.0-1.0>, "reason": "<short>"}}[/SYS]\n'
+    "[RUBRIC]{rubric}[/RUBRIC]\n"
+    "[USER]{user}[/USER]\n"
+    "[REPLY]{reply}[/REPLY]\n"
+    "[ASSIST]"
+)
+
+_SCORE_RE = re.compile(r'"score"\s*:\s*([0-9.]+)')
+
+
+@dataclasses.dataclass
+class JudgeVerdict:
+    score: float
+    reason: str = ""
+    raw: str = ""
+
+
+class Judge:
+    """Scores (user, reply) pairs against a rubric via a completion fn."""
+
+    def __init__(self, complete: Callable[[str], str]):
+        self.complete = complete
+
+    def score(self, rubric: str, user: str, reply: str) -> JudgeVerdict:
+        prompt = JUDGE_TEMPLATE.format(rubric=rubric, user=user, reply=reply)
+        raw = self.complete(prompt)
+        try:
+            d = json.loads(raw[raw.index("{") : raw.rindex("}") + 1])
+            return JudgeVerdict(
+                score=max(0.0, min(1.0, float(d["score"]))),
+                reason=str(d.get("reason", "")),
+                raw=raw,
+            )
+        except (ValueError, KeyError, TypeError):
+            m = _SCORE_RE.search(raw)
+            if m:
+                return JudgeVerdict(score=max(0.0, min(1.0, float(m.group(1)))), raw=raw)
+            # Unparseable judge output scores 0 (fail-safe: never a free pass).
+            return JudgeVerdict(score=0.0, reason="unparseable judge output", raw=raw)
+
+
+class Sampler:
+    """Probabilistic sampling with a per-session cap (reference
+    sampling.go): realtime evals judge a fraction of turns, never more
+    than `per_session_cap` per session."""
+
+    def __init__(self, rate: float = 1.0, per_session_cap: int = 10, seed: Optional[int] = None):
+        self.rate = rate
+        self.per_session_cap = per_session_cap
+        self._per_session: dict[str, int] = {}
+        self._rng = random.Random(seed)
+        self._lock = threading.Lock()
+
+    def should_sample(self, session_id: str) -> bool:
+        with self._lock:
+            if self._per_session.get(session_id, 0) >= self.per_session_cap:
+                return False
+            if self._rng.random() >= self.rate:
+                return False
+            self._per_session[session_id] = self._per_session.get(session_id, 0) + 1
+            return True
+
+
+class BudgetExceeded(RuntimeError):
+    pass
+
+
+class BudgetTracker:
+    """Hard spend ceiling (USD and/or tokens); charge() raises once
+    exhausted so workers stop cleanly (reference budget_tracker.go)."""
+
+    def __init__(self, max_cost_usd: Optional[float] = None, max_tokens: Optional[int] = None):
+        self.max_cost_usd = max_cost_usd
+        self.max_tokens = max_tokens
+        self.spent_usd = 0.0
+        self.spent_tokens = 0
+        self._lock = threading.Lock()
+
+    def charge(self, cost_usd: float = 0.0, tokens: int = 0) -> None:
+        with self._lock:
+            if self.max_cost_usd is not None and self.spent_usd + cost_usd > self.max_cost_usd:
+                raise BudgetExceeded(f"cost budget exhausted (${self.max_cost_usd})")
+            if self.max_tokens is not None and self.spent_tokens + tokens > self.max_tokens:
+                raise BudgetExceeded(f"token budget exhausted ({self.max_tokens})")
+            self.spent_usd += cost_usd
+            self.spent_tokens += tokens
+
+    @property
+    def exhausted(self) -> bool:
+        with self._lock:
+            over_cost = self.max_cost_usd is not None and self.spent_usd >= self.max_cost_usd
+            over_tok = self.max_tokens is not None and self.spent_tokens >= self.max_tokens
+            return over_cost or over_tok
+
+
+class CostCalculator:
+    """Token pricing from provider spec (reference cost_calculator.go;
+    pricing fields per provider_types.go:404-407)."""
+
+    def __init__(self, input_cost_per_mtok: float = 0.0, output_cost_per_mtok: float = 0.0):
+        self.input_cost_per_mtok = input_cost_per_mtok
+        self.output_cost_per_mtok = output_cost_per_mtok
+
+    def cost(self, prompt_tokens: int, completion_tokens: int) -> float:
+        return (
+            prompt_tokens * self.input_cost_per_mtok
+            + completion_tokens * self.output_cost_per_mtok
+        ) / 1e6

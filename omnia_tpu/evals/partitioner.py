@@ -1,0 +1,30 @@
+"""Arena job partitioning: scenario × provider × repeat → work items.
+
+Reference ee/pkg/arena/partitioner: the controller expands the job
+matrix into queue items so any number of workers can drain it. Items
+are interleaved provider-first so early results cover every provider
+(fast feedback on a broken provider instead of finishing provider A
+entirely before touching B)."""
+
+from __future__ import annotations
+
+import dataclasses
+
+from omnia_tpu.evals.defs import ArenaJobSpec, WorkItem
+
+
+def partition(spec: ArenaJobSpec) -> list[WorkItem]:
+    items: list[WorkItem] = []
+    for repeat in range(spec.repeats):
+        for scenario in spec.scenarios:
+            for provider in spec.providers:
+                items.append(
+                    WorkItem(
+                        job=spec.name,
+                        scenario=dataclasses.asdict(scenario),
+                        provider=provider,
+                        repeat=repeat,
+                        mode=spec.mode,
+                    )
+                )
+    return items

@@ -1,0 +1,103 @@
+"""Arena work queue over the stream fabric.
+
+Reference ee/pkg/arena/queue: Redis Streams with consumer groups,
+explicit ack, and pending-reclaim so a crashed worker's items get
+re-delivered. Here the fabric is omnia_tpu.streams (same semantics,
+pluggable backend); poison items that keep failing dead-letter after
+`max_deliveries` instead of cycling forever."""
+
+from __future__ import annotations
+
+import logging
+from typing import Optional
+
+from omnia_tpu.evals.defs import WorkItem, WorkResult
+from omnia_tpu.streams import Stream
+
+logger = logging.getLogger(__name__)
+
+WORK_GROUP = "arena-workers"
+RESULT_GROUP = "arena-aggregator"
+DEFAULT_MAX_DELIVERIES = 5
+
+
+class ArenaQueue:
+    def __init__(
+        self,
+        work: Optional[Stream] = None,
+        results: Optional[Stream] = None,
+        max_deliveries: int = DEFAULT_MAX_DELIVERIES,
+    ):
+        self.work = work or Stream()
+        self.results = results or Stream()
+        self.max_deliveries = max_deliveries
+        self.work.ensure_group(WORK_GROUP)
+        self.results.ensure_group(RESULT_GROUP)
+        self.dead_letters: list[dict] = []
+
+    # -- producer ---------------------------------------------------------
+
+    def enqueue(self, items: list[WorkItem]) -> int:
+        for item in items:
+            self.work.add(item.to_dict())
+        return len(items)
+
+    # -- consumer ---------------------------------------------------------
+
+    def next(self, consumer: str, block_s: float = 0.0) -> Optional[tuple[str, WorkItem]]:
+        got = self.work.read_group(WORK_GROUP, consumer, count=1, block_s=block_s)
+        if not got:
+            return None
+        entry = got[0]
+        return entry.id, WorkItem.from_dict(entry.data)
+
+    def ack(self, entry_id: str) -> None:
+        self.work.ack(WORK_GROUP, entry_id)
+
+    def reclaim(self, consumer: str, idle_s: float) -> list[tuple[str, WorkItem]]:
+        """Re-deliver items a crashed peer left pending; items past
+        max_deliveries dead-letter (acked + recorded) instead of looping.
+        A dead-lettered item still publishes an error WorkResult — the
+        job's completed count must reach total or it would poll Running
+        forever."""
+        out = []
+        for entry in self.work.claim_idle(WORK_GROUP, consumer, idle_s):
+            if self.work.delivery_count(WORK_GROUP, entry.id) > self.max_deliveries:
+                self.work.ack(WORK_GROUP, entry.id)
+                self.dead_letters.append(entry.data)
+                item = WorkItem.from_dict(entry.data)
+                self.publish_result(
+                    WorkResult(
+                        work_id=item.id,
+                        job=item.job,
+                        scenario=(item.scenario or {}).get("name", ""),
+                        provider=item.provider,
+                        repeat=item.repeat,
+                        error=f"dead-lettered after {self.max_deliveries} deliveries",
+                        worker=consumer,
+                    )
+                )
+                logger.warning("dead-lettered work item %s", entry.data.get("id"))
+                continue
+            out.append((entry.id, WorkItem.from_dict(entry.data)))
+        return out
+
+    # -- results ----------------------------------------------------------
+
+    def publish_result(self, result: WorkResult) -> None:
+        self.results.add(result.to_dict())
+
+    def consume_results(self, consumer: str = "agg", count: int = 100) -> list[WorkResult]:
+        entries = self.results.read_group(RESULT_GROUP, consumer, count=count)
+        out = [WorkResult.from_dict(e.data) for e in entries]
+        if entries:
+            self.results.ack(RESULT_GROUP, *[e.id for e in entries])
+        return out
+
+    def depth(self) -> int:
+        """Backlog (undelivered + pending-unacked) — the queue-depth
+        autoscale signal for eval workers (the north star swaps KEDA's
+        active-connections trigger for this)."""
+        s = self.work.stats(WORK_GROUP)
+        g = s["groups"].get(WORK_GROUP, {"pending": 0, "acked": 0})
+        return s["length"] - g["acked"]

@@ -1,0 +1,119 @@
+"""Realtime eval worker: session events → sampled LLM-judge → results.
+
+Reference ee/pkg/evals/worker_consume.go:84 — an XReadGroup loop over
+the session-event stream; assistant messages are sampled, judged, and
+the results POSTed back to session-api as eval-result records
+(source="realtime"). Sampling + budget keep judge spend bounded; the
+consumer group gives crash recovery for free (pending reclaim)."""
+
+from __future__ import annotations
+
+import logging
+import threading
+import uuid
+from typing import Callable, Optional
+
+from omnia_tpu.evals.judge import BudgetExceeded, BudgetTracker, Judge, Sampler
+from omnia_tpu.streams import Stream
+
+logger = logging.getLogger(__name__)
+
+EVAL_GROUP = "eval-workers"
+
+
+class RealtimeEvalWorker:
+    def __init__(
+        self,
+        events: Stream,
+        judge: Judge,
+        rubrics: list[dict],  # [{"name", "rubric", "min_score"}]
+        publish: Callable[[dict], None],  # eval-result record sink (session-api)
+        sampler: Optional[Sampler] = None,
+        budget: Optional[BudgetTracker] = None,
+        name: Optional[str] = None,
+    ):
+        self.events = events
+        self.judge = judge
+        self.rubrics = rubrics
+        self.publish = publish
+        self.sampler = sampler or Sampler()
+        self.budget = budget
+        self.name = name or f"eval-{uuid.uuid4().hex[:6]}"
+        self.events.ensure_group(EVAL_GROUP)
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.judged_total = 0
+        # Last user message per session: the event stream delivers user and
+        # assistant messages as separate records (session-api MessageRecord
+        # has no in_reply_to field), so the judge pairs them here.
+        self._last_user: dict[str, str] = {}
+        self._last_user_cap = 10_000
+
+    def _handle(self, data: dict) -> None:
+        if data.get("type") != "message":
+            return
+        payload = data.get("payload") or {}
+        session_id = data.get("session_id", "")
+        if payload.get("role") == "user":
+            if len(self._last_user) >= self._last_user_cap:
+                self._last_user.pop(next(iter(self._last_user)))
+            self._last_user[session_id] = payload.get("content", "")
+            return
+        if payload.get("role") != "assistant":
+            return
+        if not self.sampler.should_sample(session_id):
+            return
+        reply = payload.get("content", "")
+        user = self._last_user.get(session_id, "")
+        for rubric in self.rubrics:
+            if self.budget is not None:
+                self.budget.charge(tokens=len(reply) // 4 + 64)  # judge estimate
+            verdict = self.judge.score(rubric["rubric"], user, reply)
+            self.publish(
+                {
+                    "session_id": session_id,
+                    "name": rubric["name"],
+                    "score": verdict.score,
+                    "passed": verdict.score >= float(rubric.get("min_score", 0.7)),
+                    "reason": verdict.reason,
+                    "source": "realtime",
+                }
+            )
+            self.judged_total += 1
+
+    def run_once(self, block_s: float = 0.0) -> int:
+        # Reclaim first (crashed peers), then read new.
+        entries = list(self.events.claim_idle(EVAL_GROUP, self.name, min_idle_s=60.0))
+        entries += self.events.read_group(EVAL_GROUP, self.name, count=20, block_s=block_s)
+        n = 0
+        for e in entries:
+            try:
+                self._handle(e.data)
+            except BudgetExceeded:
+                logger.warning("%s: judge budget exhausted", self.name)
+                self._stop.set()
+                self.events.ack(EVAL_GROUP, e.id)
+                return n
+            except Exception:  # noqa: BLE001 — one bad event never wedges the loop
+                logger.exception("eval event handling failed")
+            self.events.ack(EVAL_GROUP, e.id)
+            n += 1
+        return n
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._stop.clear()
+
+        def loop():
+            while not self._stop.is_set():
+                self.run_once(block_s=0.25)
+
+        self._thread = threading.Thread(target=loop, name=self.name, daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None

@@ -1,0 +1,272 @@
+"""Arena workers: direct-mode and fleet-mode scenario execution.
+
+Reference ee/cmd/arena-worker (worker.go, worker_fleet.go, vu_pool.go):
+workers drain the queue; **direct mode** drives the conversation engine
+in-process (reference: PromptKit → LLM APIs; here: a Conversation over
+the TPU engine — batch eval throughput comes from submitting many
+work items concurrently into the continuous batcher, not from pmap'ing
+a separate program); **fleet mode** connects as a virtual user over
+WebSocket to a live agent facade (load/e2e realism).
+
+Resilience mirrors the reference queue contract: ack only after the
+result is published; a crashed worker's pending items are reclaimed by
+peers (queue.reclaim), poison items dead-letter."""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+import time
+import uuid
+from typing import Callable, Optional
+
+from omnia_tpu.evals.defs import Check, CheckResult, EvalScenario, WorkItem, WorkResult
+from omnia_tpu.evals.judge import BudgetExceeded, BudgetTracker, Judge
+from omnia_tpu.evals.queue import ArenaQueue
+
+logger = logging.getLogger(__name__)
+
+
+class DirectRunner:
+    """Runs a scenario in-process against a named provider's engine."""
+
+    def __init__(self, pack, providers, tool_executor=None):
+        from omnia_tpu.runtime.context_store import InMemoryContextStore
+
+        self.pack = pack
+        self.providers = providers
+        self.tool_executor = tool_executor
+        self._store = InMemoryContextStore()
+        self._conversations: dict[str, object] = {}
+        self._lock = threading.Lock()
+
+    def run_turn(self, provider: str, session_id: str, content: str) -> tuple[str, float, int, float]:
+        """→ (reply_text, latency_s, completion_tokens, cost_usd). One
+        Conversation per session id, reused across turns of the same
+        scenario run; the caller MUST end_session() when the scenario
+        completes (a long-running worker would otherwise accumulate every
+        arena session's history)."""
+        from omnia_tpu.runtime import contract as c
+        from omnia_tpu.runtime.conversation import Conversation
+        from omnia_tpu.runtime.providers import build_tokenizer
+
+        with self._lock:
+            conv = self._conversations.get(session_id)
+            if conv is None:
+                conv = Conversation(
+                    session_id=session_id,
+                    pack=self.pack,
+                    engine=self.providers.engine(provider),
+                    tokenizer=build_tokenizer(self.providers.spec(provider)),
+                    store=self._store,
+                    provider_spec=self.providers.spec(provider),
+                    tool_executor=self.tool_executor,
+                )
+                self._conversations[session_id] = conv
+        t0 = time.monotonic()
+        reply, tokens, cost = [], 0, 0.0
+        for m in conv.stream(c.ClientMessage(content=content)):
+            if m.type == "chunk":
+                reply.append(m.text)
+            elif m.type == "error":
+                raise RuntimeError(f"{m.error_code}: {m.error_message}")
+            elif m.type == "done":
+                if m.usage:
+                    tokens = m.usage.completion_tokens
+                    # Exact cost from the conversation (prompt+completion
+                    # priced per provider spec) — never recomputed here.
+                    cost = m.usage.cost_usd
+        return "".join(reply), time.monotonic() - t0, tokens, cost
+
+    def end_session(self, session_id: str) -> None:
+        with self._lock:
+            self._conversations.pop(session_id, None)
+        try:
+            self._store.delete(session_id)
+        except Exception:  # noqa: BLE001 — eviction is best-effort
+            pass
+
+
+class FleetRunner:
+    """Virtual-user WebSocket runner against a live facade."""
+
+    def __init__(self, url_for: Callable[[str], str], recv_timeout_s: float = 60.0):
+        self.url_for = url_for  # provider/agent name → ws url
+        self.recv_timeout_s = recv_timeout_s
+
+    def run_turn(self, provider: str, session_id: str, content: str) -> tuple[str, float, int]:
+        from websockets.sync.client import connect
+
+        url = self.url_for(provider)
+        sep = "&" if "?" in url else "?"
+        t0 = time.monotonic()
+        with connect(f"{url}{sep}session={session_id}") as ws:
+            hello = json.loads(ws.recv(timeout=self.recv_timeout_s))
+            if hello.get("type") != "connected":
+                raise RuntimeError(f"no connected frame: {hello}")
+            ws.send(json.dumps({"type": "message", "content": content}))
+            reply, tokens, cost = [], 0, 0.0
+            deadline = time.monotonic() + self.recv_timeout_s
+            while True:
+                msg = json.loads(ws.recv(timeout=max(0.1, deadline - time.monotonic())))
+                if msg["type"] == "chunk":
+                    reply.append(msg["text"])
+                elif msg["type"] == "error":
+                    raise RuntimeError(f"{msg.get('code')}: {msg.get('message')}")
+                elif msg["type"] == "done":
+                    usage = msg.get("usage") or {}
+                    tokens = usage.get("completion_tokens", 0)
+                    cost = usage.get("cost_usd", 0.0)
+                    break
+            return "".join(reply), time.monotonic() - t0, tokens, cost
+
+    def end_session(self, session_id: str) -> None:
+        pass  # fleet sessions live server-side; nothing to evict here
+
+
+class ArenaWorker:
+    """Queue consumer: claims items, runs scenarios, publishes results.
+
+    `concurrency` threads submit independent work items simultaneously —
+    on the TPU engine this is what fills the decode batch (continuous
+    batching turns concurrent sessions into one large MXU-friendly step).
+    """
+
+    def __init__(
+        self,
+        queue: ArenaQueue,
+        runner,
+        judge: Optional[Judge] = None,
+        cost_calculator=None,
+        budget: Optional[BudgetTracker] = None,
+        name: Optional[str] = None,
+        concurrency: int = 4,
+        reclaim_idle_s: float = 60.0,
+    ):
+        self.queue = queue
+        self.runner = runner
+        self.judge = judge
+        self.cost_calculator = cost_calculator
+        self.budget = budget
+        self.name = name or f"worker-{uuid.uuid4().hex[:6]}"
+        self.concurrency = concurrency
+        self.reclaim_idle_s = reclaim_idle_s
+        self._stop = threading.Event()
+        self._threads: list[threading.Thread] = []
+
+    # -- one item ---------------------------------------------------------
+
+    def process(self, item: WorkItem) -> WorkResult:
+        scenario = EvalScenario.from_dict(item.scenario)
+        result = WorkResult(
+            work_id=item.id,
+            job=item.job,
+            scenario=scenario.name,
+            provider=item.provider,
+            repeat=item.repeat,
+            worker=self.name,
+        )
+        session_id = f"arena-{item.id[:12]}"
+        t0 = time.monotonic()
+        try:
+            for turn in scenario.turns:
+                reply, latency, tokens, turn_cost = self.runner.run_turn(
+                    item.provider, session_id, turn.user
+                )
+                result.tokens += tokens
+                if turn_cost <= 0.0 and self.cost_calculator is not None:
+                    # Fallback pricing when the runner reports no cost
+                    # (e.g. a facade that omits usage.cost_usd).
+                    turn_cost = self.cost_calculator.cost(0, tokens)
+                result.cost_usd += turn_cost
+                if self.budget is not None:
+                    # Charge the per-turn delta — charging the running
+                    # total would re-bill earlier turns every turn.
+                    self.budget.charge(cost_usd=turn_cost, tokens=tokens)
+                for chk in turn.checks:
+                    check = chk if isinstance(chk, Check) else Check.from_dict(chk)
+                    verdict = check.evaluate_sync(reply, latency)
+                    if verdict is None:  # judge check
+                        if self.judge is None:
+                            result.checks.append(
+                                CheckResult(check.name, False, detail="no judge wired")
+                            )
+                            continue
+                        jv = self.judge.score(check.rubric, turn.user, reply)
+                        result.checks.append(
+                            CheckResult(
+                                check.name,
+                                jv.score >= check.min_score,
+                                score=jv.score,
+                                detail=jv.reason,
+                            )
+                        )
+                    else:
+                        result.checks.append(CheckResult(check.name, verdict))
+        except BudgetExceeded:
+            raise  # stop the worker loop; do NOT record as a scenario error
+        except Exception as e:  # noqa: BLE001 — scenario failure is a result
+            result.error = str(e)
+        finally:
+            ender = getattr(self.runner, "end_session", None)
+            if ender is not None:
+                ender(session_id)
+        result.latency_s = time.monotonic() - t0
+        return result
+
+    # -- loop -------------------------------------------------------------
+
+    def run_until_empty(self) -> int:
+        """Drain the queue (used by tests and one-shot jobs). Returns the
+        number of items processed by THIS worker."""
+        done = 0
+        while not self._stop.is_set():
+            if self.budget is not None and self.budget.exhausted:
+                break
+            claimed = self.queue.reclaim(self.name, self.reclaim_idle_s)
+            if not claimed:
+                got = self.queue.next(self.name)
+                if got is None:
+                    break
+                claimed = [got]
+            for entry_id, item in claimed:
+                try:
+                    result = self.process(item)
+                except BudgetExceeded:
+                    logger.warning("%s: budget exhausted, stopping", self.name)
+                    return done
+                self.queue.publish_result(result)
+                self.queue.ack(entry_id)  # ack only after result published
+                done += 1
+        return done
+
+    def start(self) -> None:
+        self._stop.clear()
+        for i in range(self.concurrency):
+            t = threading.Thread(
+                target=self._loop, name=f"{self.name}-{i}", daemon=True
+            )
+            t.start()
+            self._threads.append(t)
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            n = self.run_until_empty()
+            if n == 0:
+                got = self.queue.next(self.name, block_s=0.5)
+                if got is None:
+                    continue
+                entry_id, item = got
+                try:
+                    result = self.process(item)
+                except BudgetExceeded:
+                    return
+                self.queue.publish_result(result)
+                self.queue.ack(entry_id)
+
+    def stop(self) -> None:
+        self._stop.set()
+        for t in self._threads:
+            t.join(timeout=5)
+        self._threads = []
