@@ -58,8 +58,16 @@ class CellStats:
 class Aggregator:
     def __init__(self) -> None:
         self._cells: dict[tuple, CellStats] = {}
+        self._seen: set[str] = set()
 
-    def add(self, r: WorkResult) -> None:
+    def add(self, r: WorkResult) -> bool:
+        """Fold one result; returns False for a duplicate work_id (the
+        queue is at-least-once — a worker that crashed between publish
+        and ack, or a reclaimed slow item, delivers twice)."""
+        if r.work_id:
+            if r.work_id in self._seen:
+                return False
+            self._seen.add(r.work_id)
         key = (r.scenario, r.provider)
         cell = self._cells.get(key)
         if cell is None:
@@ -72,6 +80,7 @@ class Aggregator:
         cell.latencies.append(r.latency_s)
         cell.cost_usd += r.cost_usd
         cell.tokens += r.tokens
+        return True
 
     def cells(self) -> list[CellStats]:
         return [self._cells[k] for k in sorted(self._cells)]

@@ -68,8 +68,10 @@ class ArenaJobController:
             if owner is None:
                 continue
             o_spec, o_status, o_agg = owner
-            o_agg.add(result)
-            o_status.completed += 1
+            # add() dedupes on work_id (at-least-once queue): a duplicate
+            # must not bump completed past total or skew the verdict.
+            if o_agg.add(result):
+                o_status.completed += 1
         if status.completed >= status.total:
             verdict = agg.evaluate(spec.threshold)
             status.verdict = verdict

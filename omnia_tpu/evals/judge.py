@@ -68,6 +68,8 @@ class Sampler:
     sampling.go): realtime evals judge a fraction of turns, never more
     than `per_session_cap` per session."""
 
+    MAX_TRACKED_SESSIONS = 10_000
+
     def __init__(self, rate: float = 1.0, per_session_cap: int = 10, seed: Optional[int] = None):
         self.rate = rate
         self.per_session_cap = per_session_cap
@@ -81,6 +83,14 @@ class Sampler:
                 return False
             if self._rng.random() >= self.rate:
                 return False
+            if (
+                session_id not in self._per_session
+                and len(self._per_session) >= self.MAX_TRACKED_SESSIONS
+            ):
+                # FIFO eviction: a long-lived worker sees unbounded distinct
+                # sessions; dropping the oldest counter only risks slightly
+                # over-sampling a very old session that comes back.
+                self._per_session.pop(next(iter(self._per_session)))
             self._per_session[session_id] = self._per_session.get(session_id, 0) + 1
             return True
 

@@ -45,20 +45,35 @@ class RealtimeEvalWorker:
         self.judged_total = 0
         # Last user message per session: the event stream delivers user and
         # assistant messages as separate records (session-api MessageRecord
-        # has no in_reply_to field), so the judge pairs them here.
+        # has no in_reply_to field), so the judge pairs them here. Pairing
+        # reads through a PER-WORKER broadcast group — with several workers
+        # sharing EVAL_GROUP, the shared group would split a user record to
+        # worker A and its assistant record to worker B, leaving B to judge
+        # with an empty [USER] block.
+        self._pair_group = f"{EVAL_GROUP}-pair-{self.name}"
+        self.events.ensure_group(self._pair_group)
         self._last_user: dict[str, str] = {}
         self._last_user_cap = 10_000
+
+    def _sync_pairing(self) -> None:
+        while True:
+            entries = self.events.read_group(self._pair_group, self.name, count=100)
+            if not entries:
+                return
+            for e in entries:
+                data = e.data
+                payload = data.get("payload") or {}
+                if data.get("type") == "message" and payload.get("role") == "user":
+                    if len(self._last_user) >= self._last_user_cap:
+                        self._last_user.pop(next(iter(self._last_user)))
+                    self._last_user[data.get("session_id", "")] = payload.get("content", "")
+            self.events.ack(self._pair_group, *[e.id for e in entries])
 
     def _handle(self, data: dict) -> None:
         if data.get("type") != "message":
             return
         payload = data.get("payload") or {}
         session_id = data.get("session_id", "")
-        if payload.get("role") == "user":
-            if len(self._last_user) >= self._last_user_cap:
-                self._last_user.pop(next(iter(self._last_user)))
-            self._last_user[session_id] = payload.get("content", "")
-            return
         if payload.get("role") != "assistant":
             return
         if not self.sampler.should_sample(session_id):
@@ -85,6 +100,11 @@ class RealtimeEvalWorker:
         # Reclaim first (crashed peers), then read new.
         entries = list(self.events.claim_idle(EVAL_GROUP, self.name, min_idle_s=60.0))
         entries += self.events.read_group(EVAL_GROUP, self.name, count=20, block_s=block_s)
+        # Pairing AFTER the judging read: a user record always precedes its
+        # assistant record in the log, so once the batch is fixed, draining
+        # the broadcast pairing group is guaranteed to have seen the user
+        # message for every assistant in `entries`.
+        self._sync_pairing()
         n = 0
         for e in entries:
             try:

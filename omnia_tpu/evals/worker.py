@@ -217,16 +217,17 @@ class ArenaWorker:
 
     # -- loop -------------------------------------------------------------
 
-    def run_until_empty(self) -> int:
+    def run_until_empty(self, consumer: Optional[str] = None, do_reclaim: bool = True) -> int:
         """Drain the queue (used by tests and one-shot jobs). Returns the
-        number of items processed by THIS worker."""
+        number of items processed by THIS consumer."""
+        consumer = consumer or self.name
         done = 0
         while not self._stop.is_set():
             if self.budget is not None and self.budget.exhausted:
                 break
-            claimed = self.queue.reclaim(self.name, self.reclaim_idle_s)
+            claimed = self.queue.reclaim(consumer, self.reclaim_idle_s) if do_reclaim else []
             if not claimed:
-                got = self.queue.next(self.name)
+                got = self.queue.next(consumer)
                 if got is None:
                     break
                 claimed = [got]
@@ -245,16 +246,21 @@ class ArenaWorker:
         self._stop.clear()
         for i in range(self.concurrency):
             t = threading.Thread(
-                target=self._loop, name=f"{self.name}-{i}", daemon=True
+                target=self._loop, args=(i,), name=f"{self.name}-{i}", daemon=True
             )
             t.start()
             self._threads.append(t)
 
-    def _loop(self) -> None:
+    def _loop(self, index: int) -> None:
+        # Each thread is its OWN queue consumer — threads sharing one
+        # consumer name would let reclaim() steal a sibling's in-flight
+        # item (claim_idle can't tell them apart). Only thread 0 reclaims,
+        # so a slow multi-turn scenario on thread 2 isn't re-run by 3.
+        consumer = f"{self.name}-{index}"
         while not self._stop.is_set():
-            n = self.run_until_empty()
+            n = self.run_until_empty(consumer=consumer, do_reclaim=index == 0)
             if n == 0:
-                got = self.queue.next(self.name, block_s=0.5)
+                got = self.queue.next(consumer, block_s=0.5)
                 if got is None:
                     continue
                 entry_id, item = got

@@ -221,128 +221,11 @@ class Retriever:
 
 
 # ---------------------------------------------------------------------------
-# Deny-filter expression language (restricted; fail-closed on parse error)
+# Deny-filter expression language: the shared restricted-expression
+# evaluator (utils/expr.py — the framework's CEL stand-in). Kept as
+# aliases here because the deny-filter API surface is part of the memory
+# plane's contract (malformed expressions fail closed at the API layer).
 # ---------------------------------------------------------------------------
-#
-# Grammar: expr := or ; or := and ("||" and)* ; and := unary ("&&" unary)* ;
-# unary := "!" unary | "(" expr ")" | cmp ;
-# cmp := path (("=="|"!="|"in"|"contains") literal)?
-# path := ident ("." ident)* — resolved against the memory's dict form.
 
-import re as _re  # noqa: E402
-
-_TOKEN = _re.compile(
-    r"\s*(?:(?P<op>\(|\)|==|!=|&&|\|\||!)|(?P<kw>in|contains)\b"
-    r"|(?P<str>\"[^\"]*\"|'[^']*')|(?P<num>-?\d+(?:\.\d+)?)"
-    r"|(?P<path>[A-Za-z_][A-Za-z0-9_]*(?:\.[A-Za-z_][A-Za-z0-9_]*)*))"
-)
-
-
-class DenyExprError(ValueError):
-    pass
-
-
-def _lex(expr: str) -> list[tuple[str, str]]:
-    out, pos = [], 0
-    while pos < len(expr):
-        m = _TOKEN.match(expr, pos)
-        if not m or m.end() == pos:
-            raise DenyExprError(f"bad token at {pos!r} in {expr!r}")
-        pos = m.end()
-        for kind in ("op", "kw", "str", "num", "path"):
-            if m.group(kind) is not None:
-                out.append((kind, m.group(kind)))
-                break
-    return out
-
-
-def compile_deny(expr: str):
-    """→ predicate(memory_dict) -> bool. Raises DenyExprError on any
-    malformed input (callers fail closed)."""
-    toks = _lex(expr)
-    pos = 0
-
-    def peek():
-        return toks[pos] if pos < len(toks) else (None, None)
-
-    def eat(kind=None, val=None):
-        nonlocal pos
-        k, v = peek()
-        if k is None or (kind and k != kind) or (val and v != val):
-            raise DenyExprError(f"unexpected {v!r} at token {pos} in {expr!r}")
-        pos += 1
-        return v
-
-    def resolve(d: dict, path: str):
-        cur = d
-        for part in path.split("."):
-            if not isinstance(cur, dict) or part not in cur:
-                return None
-            cur = cur[part]
-        return cur
-
-    def literal():
-        k, v = peek()
-        if k == "str":
-            eat()
-            return lambda d: v[1:-1]
-        if k == "num":
-            eat()
-            return lambda d: float(v)
-        if k == "path":
-            eat()
-            return lambda d, p=v: resolve(d, p)
-        raise DenyExprError(f"expected literal, got {v!r}")
-
-    def cmp_expr():
-        k, v = peek()
-        if k == "op" and v == "(":
-            eat()
-            inner = or_expr()
-            eat("op", ")")
-            return inner
-        if k == "op" and v == "!":
-            eat()
-            inner = cmp_expr()
-            return lambda d: not inner(d)
-        path = eat("path")
-        k2, v2 = peek()
-        if k2 == "op" and v2 in ("==", "!="):
-            eat()
-            rhs = literal()
-            if v2 == "==":
-                return lambda d: resolve(d, path) == rhs(d)
-            return lambda d: resolve(d, path) != rhs(d)
-        if k2 == "kw" and v2 == "in":
-            eat()
-            rhs = literal()
-            return lambda d: (lambda c: c is not None and resolve(d, path) in c)(rhs(d))
-        if k2 == "kw" and v2 == "contains":
-            eat()
-            rhs = literal()
-
-            def contains(d):
-                c = resolve(d, path)
-                return c is not None and rhs(d) in c
-
-            return contains
-        return lambda d: bool(resolve(d, path))
-
-    def and_expr():
-        terms = [cmp_expr()]
-        while peek() == ("op", "&&"):
-            eat()
-            terms.append(cmp_expr())
-        return lambda d: all(t(d) for t in terms)
-
-    def or_expr():
-        terms = [and_expr()]
-        while peek() == ("op", "||"):
-            eat()
-            terms.append(and_expr())
-        return lambda d: any(t(d) for t in terms)
-
-    result = or_expr()
-    if pos != len(toks):
-        raise DenyExprError(f"trailing tokens in {expr!r}")
-    return result
+from omnia_tpu.utils.expr import ExprError as DenyExprError  # noqa: E402
+from omnia_tpu.utils.expr import compile_expr as compile_deny  # noqa: E402

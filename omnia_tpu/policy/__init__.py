@@ -1,0 +1,21 @@
+"""Policy plane: declarative tool allow/deny with expression rules,
+served in-process or as a fail-closed HTTP sidecar (reference
+ee/pkg/policy + ee/cmd/policy-broker)."""
+
+from omnia_tpu.policy.broker import (
+    Decision,
+    PolicyBroker,
+    PolicyEvaluator,
+    PolicyRule,
+    RemotePolicyClient,
+    ToolPolicy,
+)
+
+__all__ = [
+    "Decision",
+    "PolicyBroker",
+    "PolicyEvaluator",
+    "PolicyRule",
+    "RemotePolicyClient",
+    "ToolPolicy",
+]

@@ -1,0 +1,303 @@
+"""Tool policy broker: declarative allow/deny with expression rules.
+
+Reference ee/pkg/policy: ToolPolicy CRs carry CEL rules; a broker
+sidecar answers POST /v1/decision per tool dispatch; the runtime's tool
+executor calls it fail-closed (ee/pkg/policy/broker.go:38-49,
+evaluator.go, watcher.go:26-108). Here the rule language is the shared
+restricted-expression evaluator (utils/expr.py), policies come from the
+operator's resource store (poll-watched, like the reference's
+list-and-poll watcher), and the broker runs in-process or as an HTTP
+sidecar — the executor's `policy_check` hook treats any error as deny.
+
+Decision context offered to rules:
+  {tool, arguments.<k>, agent, workspace, user, session}
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import fnmatch
+import json
+import logging
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from omnia_tpu.utils.expr import ExprError, compile_expr, lint
+
+logger = logging.getLogger(__name__)
+
+
+@dataclasses.dataclass
+class PolicyRule:
+    action: str  # allow | deny
+    when: str = ""  # expression; empty = always matches
+    reason: str = ""
+
+    def __post_init__(self):
+        if self.action not in ("allow", "deny"):
+            raise ValueError(f"rule action must be allow|deny, got {self.action!r}")
+        # Compile eagerly: a malformed rule fails at load, not at decision
+        # time (where it would have to be treated as deny anyway).
+        self._pred = compile_expr(self.when) if self.when else (lambda d: True)
+
+    def matches(self, ctx: dict) -> bool:
+        return self._pred(ctx)
+
+
+@dataclasses.dataclass
+class ToolPolicy:
+    name: str
+    tools: list = dataclasses.field(default_factory=lambda: ["*"])  # glob match
+    agents: list = dataclasses.field(default_factory=lambda: ["*"])
+    rules: list = dataclasses.field(default_factory=list)  # [PolicyRule]
+    default_action: str = "deny"  # when a policy matches but no rule does
+    priority: int = 0  # higher evaluated first
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "ToolPolicy":
+        return cls(
+            name=d["name"],
+            tools=list(d.get("tools", ["*"])),
+            agents=list(d.get("agents", ["*"])),
+            rules=[
+                PolicyRule(
+                    action=r["action"],
+                    when=r.get("when", ""),
+                    reason=r.get("reason", ""),
+                )
+                for r in d.get("rules", [])
+            ],
+            default_action=d.get("default_action", "deny"),
+            priority=int(d.get("priority", 0)),
+        )
+
+    def applies(self, tool: str, agent: str) -> bool:
+        return any(fnmatch.fnmatch(tool, p) for p in self.tools) and any(
+            fnmatch.fnmatch(agent, p) for p in self.agents
+        )
+
+
+@dataclasses.dataclass
+class Decision:
+    allow: bool
+    policy: str = ""
+    rule_index: int = -1
+    reason: str = ""
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+class PolicyEvaluator:
+    """Pure decision function over a policy set. No applicable policy →
+    allow (an agent without policies is unrestricted, matching the
+    reference's sidecar-only-injected-when-policies-match shape); an
+    applicable policy decides via first matching rule, else its default."""
+
+    def __init__(self, policies: Optional[list[ToolPolicy]] = None):
+        self.policies = sorted(policies or [], key=lambda p: -p.priority)
+
+    def decide(self, ctx: dict) -> Decision:
+        tool = str(ctx.get("tool", ""))
+        agent = str(ctx.get("agent", ""))
+        for pol in self.policies:
+            if not pol.applies(tool, agent):
+                continue
+            for i, rule in enumerate(pol.rules):
+                if rule.matches(ctx):
+                    return Decision(
+                        allow=rule.action == "allow",
+                        policy=pol.name,
+                        rule_index=i,
+                        reason=rule.reason or f"rule {i} ({rule.action})",
+                    )
+            return Decision(
+                allow=pol.default_action == "allow",
+                policy=pol.name,
+                reason=f"default ({pol.default_action})",
+            )
+        return Decision(allow=True, reason="no applicable policy")
+
+
+class PolicyBroker:
+    """Holds the live policy set, answers decisions, records audit rows.
+    `watch()` polls a resource store for AgentPolicy resources whose spec
+    carries the ToolPolicy shape (the reference's list-and-poll watcher)."""
+
+    AUDIT_RING_SIZE = 1000
+
+    def __init__(self, policies: Optional[list[ToolPolicy]] = None, audit_sink=None):
+        from collections import deque
+
+        self._evaluator = PolicyEvaluator(policies)
+        self._lock = threading.Lock()
+        # Bounded ring of recent decisions for introspection; the durable
+        # trail goes through audit_sink (an AuditOutbox.record) — an
+        # unbounded list would grow one row per tool dispatch forever.
+        self.audit: "deque[dict]" = deque(maxlen=self.AUDIT_RING_SIZE)
+        self.audit_sink = audit_sink  # optional callable(dict) (privacy audit hub)
+        self._stop = threading.Event()
+        self._watch_thread: Optional[threading.Thread] = None
+        self._httpd: Optional[ThreadingHTTPServer] = None
+
+    def set_policies(self, policies: list[ToolPolicy]) -> None:
+        with self._lock:
+            self._evaluator = PolicyEvaluator(policies)
+
+    def decide(self, ctx: dict) -> Decision:
+        with self._lock:
+            evaluator = self._evaluator
+        d = evaluator.decide(ctx)
+        row = {
+            "ts": time.time(),
+            "tool": ctx.get("tool"),
+            "agent": ctx.get("agent"),
+            "user": ctx.get("user"),
+            "allow": d.allow,
+            "policy": d.policy,
+            "reason": d.reason,
+        }
+        self.audit.append(row)
+        if self.audit_sink is not None:
+            try:
+                self.audit_sink(row)
+            except Exception:  # noqa: BLE001 — audit forwarding is async-drained
+                logger.exception("audit sink failed")
+        return d
+
+    # -- ToolExecutor hook -------------------------------------------------
+
+    def policy_check(self, name: str, arguments: dict, context: dict) -> bool:
+        """Signature matches ToolExecutor(policy_check=...); the executor
+        already treats exceptions as deny (fail-closed)."""
+        d = self.decide(
+            {
+                "tool": name,
+                "arguments": arguments,
+                "agent": context.get("agent", ""),
+                "workspace": context.get("workspace", ""),
+                "user": context.get("user", ""),
+                "session": context.get("session_id", ""),
+            }
+        )
+        return d.allow
+
+    # -- store watcher -----------------------------------------------------
+
+    def load_from_store(self, store, namespace: Optional[str] = None) -> int:
+        """One sync from the operator resource store (AgentPolicy kind)."""
+        policies = []
+        for res in store.list(kind="AgentPolicy", namespace=namespace):
+            try:
+                policies.append(ToolPolicy.from_dict({"name": res.name, **res.spec}))
+            except (ExprError, ValueError, KeyError):
+                # A malformed policy must not silently vanish — it becomes
+                # deny-all for its match set (fail closed).
+                logger.exception("malformed policy %s; treating as deny-all", res.name)
+                policies.append(
+                    ToolPolicy(
+                        name=res.name,
+                        tools=list(res.spec.get("tools", ["*"])),
+                        agents=list(res.spec.get("agents", ["*"])),
+                        rules=[],
+                        default_action="deny",
+                        priority=int(res.spec.get("priority", 0)),
+                    )
+                )
+        self.set_policies(policies)
+        return len(policies)
+
+    def watch(self, store, interval_s: float = 2.0, namespace: Optional[str] = None) -> None:
+        self.load_from_store(store, namespace)
+
+        def loop():
+            while not self._stop.wait(interval_s):
+                try:
+                    self.load_from_store(store, namespace)
+                except Exception:  # noqa: BLE001
+                    logger.exception("policy watch sync failed")
+
+        self._watch_thread = threading.Thread(target=loop, name="policy-watch", daemon=True)
+        self._watch_thread.start()
+
+    # -- HTTP sidecar ------------------------------------------------------
+
+    def serve(self, host: str = "localhost", port: int = 0) -> int:
+        broker = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_POST(self):
+                if self.path != "/v1/decision":
+                    self.send_response(404)
+                    self.end_headers()
+                    return
+                try:
+                    n = int(self.headers.get("Content-Length") or 0)
+                    ctx = json.loads(self.rfile.read(n)) if n else {}
+                    out = broker.decide(ctx).to_dict()
+                    data = json.dumps(out).encode()
+                    self.send_response(200)
+                except Exception:  # noqa: BLE001 — a broken broker must read as deny
+                    data = json.dumps({"allow": False, "reason": "broker error"}).encode()
+                    self.send_response(500)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def do_GET(self):
+                if self.path == "/healthz":
+                    self.send_response(200)
+                    self.end_headers()
+                    return
+                self.send_response(404)
+                self.end_headers()
+
+            def log_message(self, *args):
+                pass
+
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        threading.Thread(target=self._httpd.serve_forever, daemon=True).start()
+        return self._httpd.server_address[1]
+
+    def close(self) -> None:
+        self._stop.set()
+        if self._watch_thread is not None:
+            self._watch_thread.join(timeout=5)
+            self._watch_thread = None
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd = None
+
+
+class RemotePolicyClient:
+    """HTTP client for a broker sidecar; usable as ToolExecutor
+    policy_check. Any transport/HTTP error raises — the executor's
+    fail-closed contract turns that into a deny."""
+
+    def __init__(self, base_url: str, timeout_s: float = 5.0):
+        self.base_url = base_url.rstrip("/")
+        self.timeout_s = timeout_s
+
+    def policy_check(self, name: str, arguments: dict, context: dict) -> bool:
+        import urllib.request
+
+        body = json.dumps(
+            {
+                "tool": name,
+                "arguments": arguments,
+                "agent": context.get("agent", ""),
+                "workspace": context.get("workspace", ""),
+                "user": context.get("user", ""),
+                "session": context.get("session_id", ""),
+            }
+        ).encode()
+        req = urllib.request.Request(
+            self.base_url + "/v1/decision",
+            data=body,
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=self.timeout_s) as resp:
+            return bool(json.loads(resp.read()).get("allow", False))

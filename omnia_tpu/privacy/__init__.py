@@ -1,0 +1,24 @@
+"""Privacy plane: envelope encryption + KMS, PII redaction, consent,
+DSAR deletion fan-out, at-least-once audit (reference ee/pkg/privacy,
+ee/pkg/encryption, ee/pkg/redaction, ee/pkg/audit, ee/cmd/privacy-api)."""
+
+from omnia_tpu.privacy.audit import AuditHub, AuditOutbox
+from omnia_tpu.privacy.api import PrivacyAPI
+from omnia_tpu.privacy.deletion import DeletionRequest, FanoutEraser, TargetState
+from omnia_tpu.privacy.encryption import Envelope, EnvelopeCipher, Kms, KmsError, LocalKms
+from omnia_tpu.privacy.redaction import Redactor
+
+__all__ = [
+    "AuditHub",
+    "AuditOutbox",
+    "PrivacyAPI",
+    "DeletionRequest",
+    "FanoutEraser",
+    "TargetState",
+    "Envelope",
+    "EnvelopeCipher",
+    "Kms",
+    "KmsError",
+    "LocalKms",
+    "Redactor",
+]

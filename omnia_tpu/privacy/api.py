@@ -1,0 +1,152 @@
+"""privacy-api: central consent, DSAR lifecycle, audit ingest hub.
+
+Reference ee/cmd/privacy-api + ee/pkg/privacy: consent grant/opt-out
+endpoints, deletion (DSAR) submit/status, and the audit ingest endpoint
+that enforcement-point outboxes drain into (at-least-once; dedupe by
+row id)."""
+
+from __future__ import annotations
+
+import json
+import logging
+import re
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from omnia_tpu.memory.retention import ConsentEvent, ConsentLog
+from omnia_tpu.privacy.audit import AuditHub
+from omnia_tpu.privacy.deletion import FanoutEraser
+from omnia_tpu.utils.metrics import Registry
+
+logger = logging.getLogger(__name__)
+
+_DSAR_PATH = re.compile(r"^/api/v1/dsar/(?P<id>[0-9a-f]+)$")
+
+
+class PrivacyAPI:
+    def __init__(self, eraser: Optional[FanoutEraser] = None, consent: Optional[ConsentLog] = None):
+        self.consent = consent or ConsentLog()
+        self.eraser = eraser or FanoutEraser()
+        self.hub = AuditHub()
+        self.metrics = Registry("omnia_privacy")
+        self._requests = self.metrics.counter("requests_total", "HTTP requests")
+        self._httpd: Optional[ThreadingHTTPServer] = None
+
+    def handle(self, method: str, path: str, body: Optional[dict]):
+        self._requests.inc(method=method)
+        body = body or {}
+        try:
+            return self._route(method, path, body)
+        except (KeyError, TypeError, ValueError) as e:
+            return 400, {"error": str(e)}
+        except Exception as e:  # pragma: no cover
+            logger.exception("privacy-api internal error")
+            return 500, {"error": str(e)}
+
+    def _route(self, method: str, path: str, body: dict):
+        if method == "POST" and path == "/api/v1/consent":
+            for f in ("workspace_id", "virtual_user_id", "category"):
+                if not body.get(f):
+                    return 400, {"error": f"{f} required"}
+            self.consent.record(
+                ConsentEvent(
+                    workspace_id=body["workspace_id"],
+                    virtual_user_id=body["virtual_user_id"],
+                    category=body["category"],
+                    granted=bool(body.get("granted", True)),
+                )
+            )
+            return 200, {"ok": True}
+        if method == "GET" and path == "/api/v1/consent/stats":
+            ws = body.get("workspace_id")
+            if not ws:
+                return 400, {"error": "workspace_id required"}
+            return 200, self.consent.stats(ws)
+        if method == "GET" and path == "/api/v1/consent/check":
+            for f in ("workspace_id", "virtual_user_id", "category"):
+                if not body.get(f):
+                    return 400, {"error": f"{f} required"}
+            return 200, {
+                "granted": self.consent.granted(
+                    body["workspace_id"], body["virtual_user_id"], body["category"]
+                )
+            }
+        if method == "POST" and path == "/api/v1/dsar":
+            for f in ("workspace_id", "virtual_user_id"):
+                if not body.get(f):
+                    return 400, {"error": f"{f} required"}
+            req = self.eraser.submit(body["workspace_id"], body["virtual_user_id"])
+            return 202, req.to_dict()
+        m = _DSAR_PATH.match(path)
+        if m and method == "GET":
+            req = self.eraser.status(m.group("id"))
+            if req is None:
+                return 404, {"error": "not found"}
+            return 200, req.to_dict()
+        if method == "POST" and path == "/api/v1/dsar/retry":
+            return 200, {"retried": self.eraser.retry_failed()}
+        if method == "POST" and path == "/api/v1/audit/ingest":
+            rows = body.get("rows") or []
+            ingested = sum(1 for r in rows if self.hub.ingest(r))
+            return 200, {"ingested": ingested, "duplicates": len(rows) - ingested}
+        if method == "GET" and path == "/api/v1/audit":
+            filters = {k: v for k, v in body.items() if k in ("kind", "workspace", "user")}
+            return 200, {"rows": self.hub.query(**filters)}
+        return 404, {"error": f"no route {method} {path}"}
+
+    # -- HTTP --------------------------------------------------------------
+
+    def serve(self, host: str = "localhost", port: int = 0) -> int:
+        api = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def _dispatch(self, method):
+                from urllib.parse import parse_qsl, urlsplit
+
+                parts = urlsplit(self.path)
+                if parts.path in ("/healthz", "/readyz"):
+                    self._reply(200, {"status": "ok"})
+                    return
+                if parts.path == "/metrics":
+                    data = api.metrics.expose().encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type", "text/plain")
+                    self.send_header("Content-Length", str(len(data)))
+                    self.end_headers()
+                    self.wfile.write(data)
+                    return
+                n = int(self.headers.get("Content-Length") or 0)
+                try:
+                    body = json.loads(self.rfile.read(n)) if n else {}
+                except json.JSONDecodeError:
+                    body = {}
+                body.update(dict(parse_qsl(parts.query)))
+                status, resp = api.handle(method, parts.path, body)
+                self._reply(status, resp)
+
+            def _reply(self, status, resp):
+                data = json.dumps(resp).encode()
+                self.send_response(status)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def do_GET(self):
+                self._dispatch("GET")
+
+            def do_POST(self):
+                self._dispatch("POST")
+
+            def log_message(self, *args):
+                pass
+
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        threading.Thread(target=self._httpd.serve_forever, daemon=True).start()
+        return self._httpd.server_address[1]
+
+    def close(self) -> None:
+        if self._httpd:
+            self._httpd.shutdown()
+            self._httpd = None

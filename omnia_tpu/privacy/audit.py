@@ -1,0 +1,105 @@
+"""At-least-once audit trail with outbox drain.
+
+Reference ee/pkg/audit + ee/pkg/privacy/outbox_store.go: enforcement
+points append audit rows locally; an outbox drainer forwards them to the
+central privacy hub with retries, marking rows forwarded only after an
+acknowledged delivery — rows survive crashes (jsonl-backed) and are
+never lost, at the price of possible duplicates (receivers dedupe on
+row id)."""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import threading
+import time
+import uuid
+from typing import Callable, Optional
+
+logger = logging.getLogger(__name__)
+
+
+class AuditOutbox:
+    def __init__(self, path: Optional[str] = None):
+        self._path = path
+        self._rows: dict[str, dict] = {}  # id → row (pending only)
+        self._forwarded: set[str] = set()
+        self._lock = threading.Lock()
+        if path and os.path.exists(path):
+            self._load(path)
+
+    def _load(self, path: str) -> None:
+        with open(path) as f:
+            for line in f:
+                rec = json.loads(line)
+                if rec.get("_forwarded"):
+                    self._forwarded.add(rec["id"])
+                    self._rows.pop(rec["id"], None)
+                else:
+                    self._rows[rec["id"]] = rec
+
+    def _append_wal(self, rec: dict) -> None:
+        if not self._path:
+            return
+        with open(self._path, "a") as f:
+            f.write(json.dumps(rec) + "\n")
+
+    def record(self, row: dict) -> str:
+        rid = row.get("id") or uuid.uuid4().hex
+        rec = {**row, "id": rid, "ts": row.get("ts", time.time())}
+        with self._lock:
+            if rid in self._forwarded or rid in self._rows:
+                return rid  # idempotent re-record
+            self._rows[rid] = rec
+            self._append_wal(rec)
+        return rid
+
+    def pending(self) -> list[dict]:
+        with self._lock:
+            return sorted(self._rows.values(), key=lambda r: r["ts"])
+
+    def drain(self, forward: Callable[[dict], None], max_rows: int = 1000) -> int:
+        """Forward pending rows; a row is marked forwarded ONLY after the
+        sink returns. A sink failure stops the drain (retried next pass) —
+        at-least-once, ordered."""
+        sent = 0
+        for row in self.pending()[:max_rows]:
+            try:
+                forward(row)
+            except Exception:  # noqa: BLE001
+                logger.exception("audit forward failed; will retry")
+                break
+            with self._lock:
+                self._rows.pop(row["id"], None)
+                self._forwarded.add(row["id"])
+                self._append_wal({"id": row["id"], "_forwarded": True, "ts": time.time()})
+            sent += 1
+        return sent
+
+
+class AuditHub:
+    """Central ingest (the privacy-api side): dedupes on row id."""
+
+    def __init__(self) -> None:
+        self.rows: dict[str, dict] = {}
+        self._lock = threading.Lock()
+
+    def ingest(self, row: dict) -> bool:
+        rid = row.get("id")
+        if not rid:
+            raise ValueError("audit row requires id")
+        with self._lock:
+            if rid in self.rows:
+                return False  # duplicate delivery (at-least-once)
+            self.rows[rid] = row
+            return True
+
+    def query(self, **filters) -> list[dict]:
+        with self._lock:
+            out = [
+                r
+                for r in self.rows.values()
+                if all(r.get(k) == v for k, v in filters.items())
+            ]
+        return sorted(out, key=lambda r: r.get("ts", 0))

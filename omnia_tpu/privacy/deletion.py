@@ -1,0 +1,118 @@
+"""DSAR deletion fan-out.
+
+Reference ee/pkg/privacy/deletion*.go + fanout_eraser.go: a deletion
+request for a (workspace, user) fans out to every registered data plane
+(session archive, memory store, media, context store), tracking
+per-target status; reruns are idempotent, partial failures retry only
+the failed targets, and every erasure lands an audit row."""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+import logging
+import threading
+import time
+import uuid
+from typing import Callable, Optional
+
+logger = logging.getLogger(__name__)
+
+
+class TargetState(str, enum.Enum):
+    PENDING = "Pending"
+    DONE = "Done"
+    FAILED = "Failed"
+
+
+@dataclasses.dataclass
+class DeletionRequest:
+    workspace_id: str
+    virtual_user_id: str
+    id: str = dataclasses.field(default_factory=lambda: uuid.uuid4().hex)
+    created_at: float = dataclasses.field(default_factory=time.time)
+    targets: dict = dataclasses.field(default_factory=dict)  # name → {state, error, deleted}
+
+    @property
+    def done(self) -> bool:
+        return all(t["state"] == TargetState.DONE.value for t in self.targets.values())
+
+    def to_dict(self) -> dict:
+        return {
+            "id": self.id,
+            "workspace_id": self.workspace_id,
+            "virtual_user_id": self.virtual_user_id,
+            "created_at": self.created_at,
+            "targets": self.targets,
+            "done": self.done,
+        }
+
+
+# An eraser: (workspace_id, virtual_user_id) -> int (records deleted).
+Eraser = Callable[[str, str], int]
+
+
+class FanoutEraser:
+    def __init__(self, audit=None):
+        self._erasers: dict[str, Eraser] = {}
+        self._requests: dict[str, DeletionRequest] = {}
+        self._lock = threading.Lock()
+        self.audit = audit  # AuditOutbox-compatible (record(dict))
+
+    def register(self, name: str, eraser: Eraser) -> None:
+        self._erasers[name] = eraser
+
+    def submit(self, workspace_id: str, virtual_user_id: str) -> DeletionRequest:
+        req = DeletionRequest(workspace_id=workspace_id, virtual_user_id=virtual_user_id)
+        req.targets = {
+            name: {"state": TargetState.PENDING.value, "error": "", "deleted": 0}
+            for name in self._erasers
+        }
+        with self._lock:
+            self._requests[req.id] = req
+        self.process(req.id)
+        return req
+
+    def process(self, request_id: str) -> DeletionRequest:
+        """Run (or re-run) the fan-out; only non-Done targets execute.
+        Erasers registered AFTER the request was submitted are added as
+        fresh targets (a late-wired data plane still gets erased; a
+        missing key must never break retry)."""
+        with self._lock:
+            req = self._requests[request_id]
+        for name, eraser in self._erasers.items():
+            target = req.targets.setdefault(
+                name, {"state": TargetState.PENDING.value, "error": "", "deleted": 0}
+            )
+            if target["state"] == TargetState.DONE.value:
+                continue
+            try:
+                deleted = eraser(req.workspace_id, req.virtual_user_id)
+                target.update(state=TargetState.DONE.value, error="", deleted=deleted)
+                if self.audit is not None:
+                    self.audit.record(
+                        {
+                            "kind": "dsar_erasure",
+                            "request_id": req.id,
+                            "target": name,
+                            "workspace": req.workspace_id,
+                            "user": req.virtual_user_id,
+                            "deleted": deleted,
+                        }
+                    )
+            except Exception as e:  # noqa: BLE001 — partial failure retries later
+                logger.exception("erasure target %s failed", name)
+                target.update(state=TargetState.FAILED.value, error=str(e))
+        return req
+
+    def status(self, request_id: str) -> Optional[DeletionRequest]:
+        with self._lock:
+            return self._requests.get(request_id)
+
+    def retry_failed(self) -> int:
+        """Re-run every request with failed targets; → requests touched."""
+        with self._lock:
+            ids = [r.id for r in self._requests.values() if not r.done]
+        for rid in ids:
+            self.process(rid)
+        return len(ids)

@@ -1,0 +1,136 @@
+"""Envelope encryption + KMS abstraction + key rotation.
+
+Reference ee/pkg/encryption: AES-256-GCM envelope scheme — each payload
+is encrypted with a fresh data key (DEK), the DEK is wrapped by a master
+key (KEK) held in a KMS, and the ciphertext carries {key_id, wrapped_dek,
+nonce, ct}. Rotation re-wraps DEKs under a new KEK without touching
+payload bytes (keyrotation_controller.go). LocalKms is the in-tree
+provider (the reference also ships AWS/GCP/Azure providers behind the
+same interface)."""
+
+from __future__ import annotations
+
+import base64
+import dataclasses
+import json
+import os
+import threading
+from typing import Optional
+
+from cryptography.hazmat.primitives.ciphers.aead import AESGCM
+
+
+class KmsError(RuntimeError):
+    pass
+
+
+class Kms:
+    """Wrap/unwrap data keys under named master keys."""
+
+    def wrap(self, key_id: str, dek: bytes) -> bytes:
+        raise NotImplementedError
+
+    def unwrap(self, key_id: str, wrapped: bytes) -> bytes:
+        raise NotImplementedError
+
+    def current_key_id(self) -> str:
+        raise NotImplementedError
+
+
+class LocalKms(Kms):
+    """In-process KMS: master keys in memory (or a key file), wrap =
+    AES-GCM under the master key. Generations rotate via add_key()."""
+
+    def __init__(self, keys: Optional[dict[str, bytes]] = None, current: Optional[str] = None):
+        self._keys = dict(keys or {})
+        if not self._keys:
+            self._keys["k1"] = AESGCM.generate_key(bit_length=256)
+        self._current = current or sorted(self._keys)[-1]
+        self._lock = threading.Lock()
+
+    def add_key(self, key_id: str, key: Optional[bytes] = None, make_current: bool = True) -> None:
+        with self._lock:
+            if key_id in self._keys:
+                raise KmsError(f"key {key_id!r} already exists")
+            self._keys[key_id] = key or AESGCM.generate_key(bit_length=256)
+            if make_current:
+                self._current = key_id
+
+    def current_key_id(self) -> str:
+        with self._lock:
+            return self._current
+
+    def wrap(self, key_id: str, dek: bytes) -> bytes:
+        with self._lock:
+            kek = self._keys.get(key_id)
+        if kek is None:
+            raise KmsError(f"unknown key {key_id!r}")
+        nonce = os.urandom(12)
+        return nonce + AESGCM(kek).encrypt(nonce, dek, b"dek")
+
+    def unwrap(self, key_id: str, wrapped: bytes) -> bytes:
+        with self._lock:
+            kek = self._keys.get(key_id)
+        if kek is None:
+            raise KmsError(f"unknown key {key_id!r}")
+        return AESGCM(kek).decrypt(wrapped[:12], wrapped[12:], b"dek")
+
+
+@dataclasses.dataclass
+class Envelope:
+    key_id: str
+    wrapped_dek: bytes
+    nonce: bytes
+    ciphertext: bytes
+
+    def to_json(self) -> str:
+        return json.dumps(
+            {
+                "v": 1,
+                "key_id": self.key_id,
+                "dek": base64.b64encode(self.wrapped_dek).decode(),
+                "nonce": base64.b64encode(self.nonce).decode(),
+                "ct": base64.b64encode(self.ciphertext).decode(),
+            }
+        )
+
+    @classmethod
+    def from_json(cls, raw: str) -> "Envelope":
+        d = json.loads(raw)
+        return cls(
+            key_id=d["key_id"],
+            wrapped_dek=base64.b64decode(d["dek"]),
+            nonce=base64.b64decode(d["nonce"]),
+            ciphertext=base64.b64decode(d["ct"]),
+        )
+
+
+class EnvelopeCipher:
+    def __init__(self, kms: Kms):
+        self.kms = kms
+
+    def encrypt(self, plaintext: bytes, aad: bytes = b"") -> Envelope:
+        dek = AESGCM.generate_key(bit_length=256)
+        key_id = self.kms.current_key_id()
+        nonce = os.urandom(12)
+        ct = AESGCM(dek).encrypt(nonce, plaintext, aad)
+        return Envelope(
+            key_id=key_id,
+            wrapped_dek=self.kms.wrap(key_id, dek),
+            nonce=nonce,
+            ciphertext=ct,
+        )
+
+    def decrypt(self, env: Envelope, aad: bytes = b"") -> bytes:
+        dek = self.kms.unwrap(env.key_id, env.wrapped_dek)
+        return AESGCM(dek).decrypt(env.nonce, env.ciphertext, aad)
+
+    def rotate(self, env: Envelope) -> Envelope:
+        """Re-wrap the DEK under the current KEK; payload untouched."""
+        current = self.kms.current_key_id()
+        if env.key_id == current:
+            return env
+        dek = self.kms.unwrap(env.key_id, env.wrapped_dek)
+        return dataclasses.replace(
+            env, key_id=current, wrapped_dek=self.kms.wrap(current, dek)
+        )

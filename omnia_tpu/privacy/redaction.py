@@ -1,0 +1,61 @@
+"""PII redaction middleware.
+
+Reference ee/pkg/redaction: pattern-based redaction applied to session
+records before persistence (session-api writes) and available to any
+text sink. Redactions are labeled (`[REDACTED:email]`) so downstream
+analytics can count categories without seeing values."""
+
+from __future__ import annotations
+
+import re
+from typing import Optional
+
+_PATTERNS: list[tuple[str, re.Pattern]] = [
+    ("email", re.compile(r"[A-Za-z0-9._%+-]+@[A-Za-z0-9.-]+\.[A-Za-z]{2,}")),
+    ("ssn", re.compile(r"\b\d{3}-\d{2}-\d{4}\b")),
+    # 13-19 digit runs with optional separators, Luhn-checked below.
+    ("credit_card", re.compile(r"\b(?:\d[ -]?){13,19}\b")),
+    ("phone", re.compile(r"(?<!\d)(?:\+?\d{1,2}[ .-]?)?(?:\(\d{3}\)|\d{3})[ .-]?\d{3}[ .-]?\d{4}(?!\d)")),
+    ("ipv4", re.compile(r"\b(?:\d{1,3}\.){3}\d{1,3}\b")),
+]
+
+
+def _luhn_ok(digits: str) -> bool:
+    total, alt = 0, False
+    for ch in reversed(digits):
+        d = ord(ch) - 48
+        if alt:
+            d *= 2
+            if d > 9:
+                d -= 9
+        total += d
+        alt = not alt
+    return total % 10 == 0
+
+
+class Redactor:
+    def __init__(self, categories: Optional[list[str]] = None):
+        wanted = set(categories) if categories else None
+        self.patterns = [
+            (name, pat) for name, pat in _PATTERNS if wanted is None or name in wanted
+        ]
+        self.counts: dict[str, int] = {}
+
+    def redact(self, text: str) -> str:
+        for name, pat in self.patterns:
+            def sub(m, name=name):
+                if name == "credit_card" and not _luhn_ok(re.sub(r"\D", "", m.group())):
+                    return m.group()  # digit run but not a card number
+                self.counts[name] = self.counts.get(name, 0) + 1
+                return f"[REDACTED:{name}]"
+
+            text = pat.sub(sub, text)
+        return text
+
+    def redact_record(self, record: dict, fields: tuple = ("content",)) -> dict:
+        """Shallow-copy a record dict with named text fields redacted."""
+        out = dict(record)
+        for f in fields:
+            if isinstance(out.get(f), str):
+                out[f] = self.redact(out[f])
+        return out

@@ -1,0 +1,167 @@
+"""Restricted boolean expression language over dict contexts.
+
+The framework's CEL stand-in (the reference evaluates CEL in its policy
+broker, ee/pkg/policy/evaluator.go, and in memory deny-filters): a tiny
+total language — no calls, no loops, no attribute access beyond dotted
+dict paths — so policy evaluation is safe on untrusted input and always
+terminates. Parse errors raise ExprError; callers fail closed.
+
+Grammar:
+  expr     := or
+  or       := and ("||" and)*
+  and      := unary ("&&" unary)*
+  unary    := "!" unary | "(" expr ")" | cmp
+  cmp      := operand (op operand)?        op ∈ == != < <= > >= in contains
+  operand  := string | number | true|false | path
+  path     := ident ("." ident)*           resolved against the context dict
+"""
+
+from __future__ import annotations
+
+import re
+
+_TOKEN = re.compile(
+    r"\s*(?:(?P<op>\(|\)|==|!=|<=|>=|<|>|&&|\|\||!)|(?P<kw>in|contains|true|false)\b"
+    r"|(?P<str>\"[^\"]*\"|'[^']*')|(?P<num>-?\d+(?:\.\d+)?)"
+    r"|(?P<path>[A-Za-z_][A-Za-z0-9_]*(?:\.[A-Za-z_][A-Za-z0-9_]*)*))"
+)
+
+
+class ExprError(ValueError):
+    pass
+
+
+def _lex(expr: str) -> list[tuple[str, str]]:
+    out, pos = [], 0
+    while pos < len(expr):
+        m = _TOKEN.match(expr, pos)
+        if not m or m.end() == pos:
+            raise ExprError(f"bad token at {pos!r} in {expr!r}")
+        pos = m.end()
+        for kind in ("op", "kw", "str", "num", "path"):
+            if m.group(kind) is not None:
+                out.append((kind, m.group(kind)))
+                break
+    return out
+
+
+def _resolve(d: dict, path: str):
+    cur = d
+    for part in path.split("."):
+        if not isinstance(cur, dict) or part not in cur:
+            return None
+        cur = cur[part]
+    return cur
+
+
+def compile_expr(expr: str):
+    """→ predicate(context_dict) -> bool. Raises ExprError on malformed
+    input; comparisons against missing paths / mismatched types are False
+    (never an exception at evaluation time)."""
+    toks = _lex(expr)
+    pos = 0
+
+    def peek():
+        return toks[pos] if pos < len(toks) else (None, None)
+
+    def eat(kind=None, val=None):
+        nonlocal pos
+        k, v = peek()
+        if k is None or (kind and k != kind) or (val and v != val):
+            raise ExprError(f"unexpected {v!r} at token {pos} in {expr!r}")
+        pos += 1
+        return v
+
+    def operand():
+        k, v = peek()
+        if k == "str":
+            eat()
+            return lambda d, s=v[1:-1]: s
+        if k == "num":
+            eat()
+            return lambda d, n=float(v): n
+        if k == "kw" and v in ("true", "false"):
+            eat()
+            return lambda d, b=(v == "true"): b
+        if k == "path":
+            eat()
+            return lambda d, p=v: _resolve(d, p)
+        raise ExprError(f"expected operand, got {v!r}")
+
+    def _cmp_vals(a, b, op):
+        if op == "==":
+            return a == b
+        if op == "!=":
+            return a != b
+        if op == "in":
+            try:
+                return b is not None and a in b
+            except TypeError:
+                return False
+        if op == "contains":
+            try:
+                return a is not None and b in a
+            except TypeError:
+                return False
+        # Numeric-ish ordering: both sides must be comparable.
+        try:
+            if op == "<":
+                return a < b
+            if op == "<=":
+                return a <= b
+            if op == ">":
+                return a > b
+            if op == ">=":
+                return a >= b
+        except TypeError:
+            return False
+        raise ExprError(f"unknown operator {op!r}")
+
+    def cmp_expr():
+        k, v = peek()
+        if k == "op" and v == "(":
+            eat()
+            inner = or_expr()
+            eat("op", ")")
+            return inner
+        if k == "op" and v == "!":
+            eat()
+            inner = cmp_expr()
+            return lambda d: not inner(d)
+        lhs = operand()
+        k2, v2 = peek()
+        if (k2 == "op" and v2 in ("==", "!=", "<", "<=", ">", ">=")) or (
+            k2 == "kw" and v2 in ("in", "contains")
+        ):
+            eat()
+            rhs = operand()
+            return lambda d, op=v2: _cmp_vals(lhs(d), rhs(d), op)
+        return lambda d: bool(lhs(d))
+
+    def and_expr():
+        terms = [cmp_expr()]
+        while peek() == ("op", "&&"):
+            eat()
+            terms.append(cmp_expr())
+        return lambda d: all(t(d) for t in terms)
+
+    def or_expr():
+        terms = [and_expr()]
+        while peek() == ("op", "||"):
+            eat()
+            terms.append(and_expr())
+        return lambda d: any(t(d) for t in terms)
+
+    result = or_expr()
+    if pos != len(toks):
+        raise ExprError(f"trailing tokens in {expr!r}")
+    return result
+
+
+def lint(expr: str) -> list[str]:
+    """Parse-only check (the reference's cel_lint analog): [] when valid."""
+    try:
+        compile_expr(expr)
+        return []
+    except ExprError as e:
+        return [str(e)]

@@ -238,8 +238,8 @@ class TestJudge:
 class TestAggregator:
     def test_threshold_latency_gate(self):
         agg = Aggregator()
-        for lat in (0.1, 0.2, 5.0):
-            agg.add(WorkResult(work_id="w", job="j", scenario="s", provider="p",
+        for i, lat in enumerate((0.1, 0.2, 5.0)):
+            agg.add(WorkResult(work_id=f"w{i}", job="j", scenario="s", provider="p",
                                repeat=0, latency_s=lat))
         out = agg.evaluate(Threshold(min_pass_rate=1.0, max_p95_latency_s=1.0))
         assert not out["passed"]
@@ -353,3 +353,46 @@ class TestFleetMode:
         finally:
             facade.shutdown()
             runtime.shutdown()
+
+
+class TestAtLeastOnceDedup:
+    def test_duplicate_results_do_not_skew_job(self):
+        ctrl = ArenaJobController()
+        ctrl.submit(_spec(providers=("good",)))
+        worker = ArenaWorker(ctrl.queue, DirectRunner(load_pack(PACK), _registry()))
+        worker.run_until_empty()
+        # simulate at-least-once double delivery of the same result
+        results = ctrl.queue.consume_results()
+        for r in results:
+            ctrl.queue.publish_result(r)
+            ctrl.queue.publish_result(r)
+        status = ctrl.reconcile("job1")
+        assert status.completed == 1  # deduped on work_id
+        assert status.phase == JobPhase.SUCCEEDED
+        assert status.verdict["cells"][0]["runs"] == 1
+
+    def test_two_realtime_workers_still_pair_user_messages(self):
+        events = Stream()
+        prompts = []
+
+        def complete(p):
+            prompts.append(p)
+            return '{"score": 1.0}'
+
+        published = []
+        w1 = RealtimeEvalWorker(events, judge=Judge(complete),
+                                rubrics=[{"name": "r", "rubric": "x"}],
+                                publish=published.append, name="w1")
+        w2 = RealtimeEvalWorker(events, judge=Judge(complete),
+                                rubrics=[{"name": "r", "rubric": "x"}],
+                                publish=published.append, name="w2")
+        # w1 consumes the user record from the shared group; the assistant
+        # record lands on w2 — pairing must still work via broadcast groups
+        events.add({"type": "message", "session_id": "s1",
+                    "payload": {"role": "user", "content": "the question"}})
+        w1.run_once()
+        events.add({"type": "message", "session_id": "s1",
+                    "payload": {"role": "assistant", "content": "the answer"}})
+        w2.run_once()
+        assert len(published) == 1
+        assert any("the question" in p and "the answer" in p for p in prompts), prompts

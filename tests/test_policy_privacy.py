@@ -1,0 +1,293 @@
+"""Policy broker + privacy plane tests: expression language, policy
+decisions + fail-closed wiring, envelope encryption + rotation, PII
+redaction, audit outbox at-least-once, DSAR fan-out, privacy API."""
+
+from __future__ import annotations
+
+import json
+import threading
+import urllib.request
+
+import pytest
+
+from omnia_tpu.policy import (
+    PolicyBroker,
+    PolicyEvaluator,
+    PolicyRule,
+    RemotePolicyClient,
+    ToolPolicy,
+)
+from omnia_tpu.privacy import (
+    AuditHub,
+    AuditOutbox,
+    EnvelopeCipher,
+    FanoutEraser,
+    KmsError,
+    LocalKms,
+    PrivacyAPI,
+    Redactor,
+)
+from omnia_tpu.tools import ToolExecutor, ToolHandler
+from omnia_tpu.utils.expr import ExprError, compile_expr, lint
+
+
+class TestExpr:
+    def test_operators(self):
+        ctx = {"tool": "sql", "arguments": {"query": "drop table users"},
+               "user": "u1", "n": 5}
+        assert compile_expr('tool == "sql"')(ctx)
+        assert compile_expr('arguments.query contains "drop"')(ctx)
+        assert compile_expr("n > 3 && n <= 5")(ctx)
+        assert compile_expr('user in "u1,u2"')(ctx)
+        assert compile_expr('!(tool == "http")')(ctx)
+        assert compile_expr('tool == "sql" || tool == "http"')(ctx)
+        assert not compile_expr("missing.path")(ctx)
+        assert not compile_expr('n < "abc"')(ctx)  # type mismatch → False, no raise
+
+    def test_malformed_raises(self):
+        with pytest.raises(ExprError):
+            compile_expr("tool ==")
+        assert lint("a == ") != []
+        assert lint('a == "b"') == []
+
+
+class TestPolicyEvaluator:
+    def _policies(self):
+        return [
+            ToolPolicy(
+                name="sql-guard",
+                tools=["sql*"],
+                rules=[
+                    PolicyRule(action="deny", when='arguments.query contains "drop"',
+                               reason="destructive sql"),
+                    PolicyRule(action="allow"),
+                ],
+            ),
+            ToolPolicy(name="lockdown", tools=["admin__*"], default_action="deny"),
+        ]
+
+    def test_first_matching_rule_wins(self):
+        ev = PolicyEvaluator(self._policies())
+        deny = ev.decide({"tool": "sql", "arguments": {"query": "drop table x"}, "agent": "a"})
+        assert not deny.allow and deny.reason == "destructive sql"
+        allow = ev.decide({"tool": "sql", "arguments": {"query": "select 1"}, "agent": "a"})
+        assert allow.allow
+
+    def test_no_applicable_policy_allows(self):
+        ev = PolicyEvaluator(self._policies())
+        assert ev.decide({"tool": "weather", "agent": "a"}).allow
+
+    def test_matching_policy_without_rule_uses_default(self):
+        ev = PolicyEvaluator(self._policies())
+        d = ev.decide({"tool": "admin__reboot", "agent": "a"})
+        assert not d.allow and d.policy == "lockdown"
+
+    def test_priority_ordering(self):
+        ev = PolicyEvaluator([
+            ToolPolicy(name="low", tools=["x"], priority=0,
+                       rules=[PolicyRule(action="allow")]),
+            ToolPolicy(name="high", tools=["x"], priority=10,
+                       rules=[PolicyRule(action="deny", reason="high wins")]),
+        ])
+        d = ev.decide({"tool": "x", "agent": "a"})
+        assert not d.allow and d.policy == "high"
+
+    def test_malformed_rule_fails_at_load(self):
+        with pytest.raises(ExprError):
+            PolicyRule(action="deny", when="tool ==")
+
+
+class TestBrokerIntegration:
+    def test_executor_denied_by_broker(self):
+        broker = PolicyBroker([
+            ToolPolicy(name="p", tools=["danger"],
+                       rules=[PolicyRule(action="deny", reason="nope")]),
+        ])
+        executor = ToolExecutor(
+            [ToolHandler(name="danger", fn=lambda a: "boom"),
+             ToolHandler(name="safe", fn=lambda a: "fine")],
+            policy_check=broker.policy_check,
+        )
+        out = executor.execute("danger", {}, {"agent": "a1"})
+        assert out.is_error and "denied" in out.content
+        assert executor.execute("safe", {}, {"agent": "a1"}).content == "fine"
+        assert broker.audit[0]["allow"] is False
+
+    def test_http_sidecar_and_fail_closed_client(self):
+        broker = PolicyBroker([
+            ToolPolicy(name="p", tools=["x"], rules=[PolicyRule(action="deny")]),
+        ])
+        port = broker.serve()
+        client = RemotePolicyClient(f"http://localhost:{port}")
+        assert client.policy_check("x", {}, {}) is False
+        assert client.policy_check("other", {}, {}) is True
+        broker.close()
+        # broker down → transport error → executor treats as deny
+        executor = ToolExecutor(
+            [ToolHandler(name="x", fn=lambda a: "v")], policy_check=client.policy_check
+        )
+        out = executor.execute("x", {}, {})
+        assert out.is_error and "deny" in out.content
+
+    def test_store_watch_and_malformed_policy_fails_closed(self):
+        from omnia_tpu.operator.resources import Resource
+        from omnia_tpu.operator.store import MemoryResourceStore
+
+        store = MemoryResourceStore()
+        store.apply(Resource(kind="AgentPolicy", name="ok", spec={
+            "tools": ["t1"], "rules": [{"action": "deny", "reason": "r"}]}))
+        store.apply(Resource(kind="AgentPolicy", name="broken", spec={
+            "tools": ["t2"], "rules": [{"action": "deny", "when": "bad =="}]}))
+        broker = PolicyBroker()
+        n = broker.load_from_store(store)
+        assert n == 2
+        assert not broker.decide({"tool": "t1", "agent": "a"}).allow
+        # malformed policy → deny-all for its match set, not silently dropped
+        assert not broker.decide({"tool": "t2", "agent": "a"}).allow
+        assert broker.decide({"tool": "t3", "agent": "a"}).allow
+
+
+class TestEncryption:
+    def test_roundtrip_and_aad(self):
+        cipher = EnvelopeCipher(LocalKms())
+        env = cipher.encrypt(b"secret payload", aad=b"session-1")
+        assert cipher.decrypt(env, aad=b"session-1") == b"secret payload"
+        with pytest.raises(Exception):
+            cipher.decrypt(env, aad=b"session-2")  # AAD mismatch
+
+    def test_serialization_roundtrip(self):
+        from omnia_tpu.privacy import Envelope
+
+        cipher = EnvelopeCipher(LocalKms())
+        env = cipher.encrypt(b"data")
+        env2 = Envelope.from_json(env.to_json())
+        assert cipher.decrypt(env2) == b"data"
+
+    def test_key_rotation_rewraps_without_touching_payload(self):
+        kms = LocalKms()
+        cipher = EnvelopeCipher(kms)
+        env = cipher.encrypt(b"long-lived record")
+        old_ct = env.ciphertext
+        kms.add_key("k2")
+        rotated = cipher.rotate(env)
+        assert rotated.key_id == "k2"
+        assert rotated.ciphertext is old_ct  # payload untouched
+        assert cipher.decrypt(rotated) == b"long-lived record"
+        # old envelope still decrypts (old KEK retained until retired)
+        assert cipher.decrypt(env) == b"long-lived record"
+
+    def test_unknown_key_errors(self):
+        kms = LocalKms()
+        with pytest.raises(KmsError):
+            kms.unwrap("ghost", b"x" * 40)
+
+
+class TestRedaction:
+    def test_categories(self):
+        r = Redactor()
+        text = ("mail a@b.com, card 4111 1111 1111 1111, ssn 123-45-6789, "
+                "call (415) 555-2671, host 10.0.0.1, order 12345678901234")
+        out = r.redact(text)
+        assert "[REDACTED:email]" in out
+        assert "[REDACTED:credit_card]" in out
+        assert "[REDACTED:ssn]" in out
+        assert "[REDACTED:phone]" in out
+        assert "[REDACTED:ipv4]" in out
+        assert "12345678901234" in out  # digit run failing Luhn is kept
+        assert "a@b.com" not in out
+
+    def test_record_middleware(self):
+        r = Redactor(categories=["email"])
+        rec = {"session_id": "s", "content": "write to x@y.io now"}
+        out = r.redact_record(rec)
+        assert out["content"] == "write to [REDACTED:email] now"
+        assert rec["content"].count("x@y.io") == 1  # original untouched
+
+
+class TestAudit:
+    def test_outbox_at_least_once(self, tmp_path):
+        path = str(tmp_path / "outbox.jsonl")
+        ob = AuditOutbox(path)
+        ob.record({"kind": "k", "id": "r1"})
+        ob.record({"kind": "k", "id": "r2"})
+        hub = AuditHub()
+        failures = {"n": 0}
+
+        def flaky(row):
+            if row["id"] == "r2" and failures["n"] == 0:
+                failures["n"] += 1
+                raise RuntimeError("hub down")
+            hub.ingest(row)
+
+        assert ob.drain(flaky) == 1  # r1 sent, r2 failed → stop
+        assert len(ob.pending()) == 1
+        assert ob.drain(flaky) == 1  # retry succeeds
+        assert set(hub.rows) == {"r1", "r2"}
+        # crash-restart: forwarded rows stay forwarded, none resent
+        ob2 = AuditOutbox(path)
+        assert ob2.pending() == []
+        # duplicate delivery dedupes at the hub
+        assert hub.ingest({"id": "r1"}) is False
+
+
+class TestDeletion:
+    def test_fanout_partial_failure_and_retry(self):
+        outbox = AuditOutbox()
+        eraser = FanoutEraser(audit=outbox)
+        state = {"memory_up": False}
+        eraser.register("session", lambda ws, u: 3)
+
+        def memory_eraser(ws, u):
+            if not state["memory_up"]:
+                raise RuntimeError("memory-api down")
+            return 2
+
+        eraser.register("memory", memory_eraser)
+        req = eraser.submit("ws", "u1")
+        assert req.targets["session"]["state"] == "Done"
+        assert req.targets["memory"]["state"] == "Failed"
+        assert not req.done
+        state["memory_up"] = True
+        eraser.retry_failed()
+        req = eraser.status(req.id)
+        assert req.done and req.targets["memory"]["deleted"] == 2
+        kinds = [r["kind"] for r in outbox.pending()]
+        assert kinds.count("dsar_erasure") == 2
+
+    def test_rerun_is_idempotent(self):
+        calls = []
+        eraser = FanoutEraser()
+        eraser.register("session", lambda ws, u: calls.append(1) or 1)
+        req = eraser.submit("ws", "u")
+        eraser.process(req.id)  # re-run must not re-delete Done targets
+        assert len(calls) == 1
+
+
+class TestPrivacyAPI:
+    def test_end_to_end_over_http(self):
+        eraser = FanoutEraser()
+        eraser.register("session", lambda ws, u: 1)
+        api = PrivacyAPI(eraser=eraser)
+        port = api.serve()
+        base = f"http://localhost:{port}"
+
+        def post(path, body):
+            req = urllib.request.Request(base + path, data=json.dumps(body).encode(),
+                                         headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(req) as resp:
+                return resp.status, json.loads(resp.read())
+
+        status, _ = post("/api/v1/consent", {"workspace_id": "ws", "virtual_user_id": "u",
+                                             "category": "ads", "granted": False})
+        assert status == 200
+        with urllib.request.urlopen(
+            base + "/api/v1/consent/check?workspace_id=ws&virtual_user_id=u&category=ads"
+        ) as resp:
+            assert json.loads(resp.read()) == {"granted": False}
+        status, dsar = post("/api/v1/dsar", {"workspace_id": "ws", "virtual_user_id": "u"})
+        assert status == 202 and dsar["done"]
+        status, out = post("/api/v1/audit/ingest", {"rows": [{"id": "a1", "kind": "k"}]})
+        assert out == {"ingested": 1, "duplicates": 0}
+        status, out = post("/api/v1/audit/ingest", {"rows": [{"id": "a1", "kind": "k"}]})
+        assert out["duplicates"] == 1
+        api.close()
