@@ -1,3 +1,18 @@
+"""Facade plane: the agent's client-facing surfaces (reference
+cmd/agent + internal/facade) — WebSocket chat, REST/function-mode,
+MCP tools, and the A2A agent-to-agent protocol, sharing one auth chain
+and one runtime gRPC backend."""
+
+from omnia_tpu.facade.a2a import A2aFacade, TaskStore
+from omnia_tpu.facade.mcp import McpFacade
+from omnia_tpu.facade.rest import JsonHttpFacade, RestFacade
 from omnia_tpu.facade.server import FacadeServer
 
-__all__ = ["FacadeServer"]
+__all__ = [
+    "A2aFacade",
+    "TaskStore",
+    "McpFacade",
+    "JsonHttpFacade",
+    "RestFacade",
+    "FacadeServer",
+]

@@ -1,0 +1,206 @@
+"""A2A facade: agent-to-agent protocol surface.
+
+Reference internal/facade/a2a/ (server, card_provider, authenticator,
+redis_task_store): agents expose an Agent Card at
+/.well-known/agent.json and serve the A2A JSON-RPC methods —
+message/send (run a turn, returns a completed task with the reply
+artifact), tasks/get (poll), tasks/cancel. Tasks persist in a store
+(in-memory here; the stream/Redis-backed store drops in) keyed by task
+id, with contextId carrying the conversation session so multi-message
+exchanges resume the same runtime conversation."""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+import uuid
+from typing import Optional
+
+from omnia_tpu.facade.auth import Principal
+from omnia_tpu.facade.rest import JsonHttpFacade
+from omnia_tpu.facade.mcp import (
+    JSONRPC_INTERNAL,
+    JSONRPC_INVALID_PARAMS,
+    JSONRPC_METHOD_NOT_FOUND,
+    JSONRPC_PARSE_ERROR,
+)
+
+logger = logging.getLogger(__name__)
+
+
+class TaskStore:
+    """In-memory task store with TTL eviction (reference
+    redis_task_store.go keeps tasks in Redis with a TTL)."""
+
+    def __init__(self, ttl_s: float = 3600.0, max_tasks: int = 10_000):
+        self._tasks: dict[str, dict] = {}
+        self._lock = threading.Lock()
+        self.ttl_s = ttl_s
+        self.max_tasks = max_tasks
+
+    def put(self, task: dict) -> None:
+        with self._lock:
+            now = time.time()
+            if len(self._tasks) >= self.max_tasks:
+                self._evict(now)
+            task["_touched"] = now
+            self._tasks[task["id"]] = task
+
+    def get(self, task_id: str) -> Optional[dict]:
+        with self._lock:
+            t = self._tasks.get(task_id)
+            if t is None:
+                return None
+            if time.time() - t["_touched"] > self.ttl_s:
+                del self._tasks[task_id]
+                return None
+            return t
+
+    def _evict(self, now: float) -> None:
+        expired = [tid for tid, t in self._tasks.items() if now - t["_touched"] > self.ttl_s]
+        for tid in expired:
+            del self._tasks[tid]
+        while len(self._tasks) >= self.max_tasks:
+            oldest = min(self._tasks, key=lambda tid: self._tasks[tid]["_touched"])
+            del self._tasks[oldest]
+
+
+class A2aFacade(JsonHttpFacade):
+    def __init__(self, *args, description: str = "", skills: Optional[list] = None,
+                 task_store: Optional[TaskStore] = None, **kwargs):
+        super().__init__(*args, metrics_prefix="omnia_facade_a2a", **kwargs)
+        self.description = description
+        self.skills = skills or []
+        self.tasks = task_store or TaskStore()
+        self.base_url = ""  # set at serve() time for the card
+
+    def serve(self, host: str = "localhost", port: int = 0) -> int:
+        bound = super().serve(host, port)
+        self.base_url = f"http://{host}:{bound}"
+        return bound
+
+    # -- routing -----------------------------------------------------------
+
+    def handle(self, method: str, path: str, body, principal: Principal):
+        if path == "/.well-known/agent.json" and method == "GET":
+            return 200, self._card()
+        if path == "/" and method == "POST":
+            return self._jsonrpc(body, principal)
+        return 404, {"error": f"no route {method} {path}"}
+
+    def _card(self) -> dict:
+        return {
+            "name": self.agent_name,
+            "description": self.description,
+            "url": self.base_url + "/",
+            "version": "1.0.0",
+            "protocolVersion": "0.2.5",
+            "capabilities": {"streaming": False, "pushNotifications": False},
+            "defaultInputModes": ["text/plain"],
+            "defaultOutputModes": ["text/plain"],
+            "skills": self.skills,
+        }
+
+    def _jsonrpc(self, body, principal: Principal):
+        if not isinstance(body, dict) or body.get("jsonrpc") != "2.0":
+            return 200, _err(None, JSONRPC_PARSE_ERROR, "expected JSON-RPC 2.0 object")
+        rpc_id = body.get("id")
+        method = body.get("method", "")
+        params = body.get("params") or {}
+        try:
+            if method == "message/send":
+                result = self._message_send(params, principal)
+            elif method == "tasks/get":
+                result = self._tasks_get(params)
+            elif method == "tasks/cancel":
+                result = self._tasks_cancel(params)
+            else:
+                return 200, _err(rpc_id, JSONRPC_METHOD_NOT_FOUND, f"unknown method {method!r}")
+        except _ParamsError as e:
+            return 200, _err(rpc_id, JSONRPC_INVALID_PARAMS, str(e))
+        except Exception as e:  # noqa: BLE001
+            logger.exception("a2a dispatch failed")
+            return 200, _err(rpc_id, JSONRPC_INTERNAL, str(e))
+        return 200, {"jsonrpc": "2.0", "id": rpc_id, "result": result}
+
+    # -- methods -----------------------------------------------------------
+
+    def _message_send(self, params: dict, principal: Principal) -> dict:
+        msg = params.get("message") or {}
+        parts = msg.get("parts") or []
+        text = " ".join(p.get("text", "") for p in parts if p.get("kind") == "text").strip()
+        if not text:
+            raise _ParamsError("message.parts must contain text")
+        # contextId carries the conversation: same context → same session.
+        context_id = msg.get("contextId") or f"ctx-{uuid.uuid4().hex[:12]}"
+        task_id = msg.get("taskId") or f"task-{uuid.uuid4().hex[:12]}"
+        session_id = f"a2a-{principal.subject}-{context_id}"
+
+        task = {
+            "id": task_id,
+            "contextId": context_id,
+            "status": {"state": "working"},
+            "artifacts": [],
+            "kind": "task",
+        }
+        self.tasks.put(task)
+        stream = self.runtime.open_stream(
+            session_id, user_id=principal.subject, agent=self.agent_name
+        )
+        try:
+            reply, failed = [], None
+            for m in stream.turn(text):
+                if m.type == "chunk":
+                    reply.append(m.text)
+                elif m.type == "error":
+                    failed = f"{m.error_code}: {m.error_message}"
+                elif m.type == "tool_call":
+                    failed = "client tools unsupported over A2A"
+            if failed:
+                task["status"] = {"state": "failed", "message": _text_msg(failed)}
+            else:
+                task["status"] = {"state": "completed"}
+                task["artifacts"] = [
+                    {
+                        "artifactId": f"artifact-{uuid.uuid4().hex[:8]}",
+                        "parts": [{"kind": "text", "text": "".join(reply)}],
+                    }
+                ]
+            self.tasks.put(task)
+            return task
+        finally:
+            stream.close()
+
+    def _tasks_get(self, params: dict) -> dict:
+        task = self.tasks.get(params.get("id", ""))
+        if task is None:
+            raise _ParamsError(f"unknown task {params.get('id')!r}")
+        return task
+
+    def _tasks_cancel(self, params: dict) -> dict:
+        task = self.tasks.get(params.get("id", ""))
+        if task is None:
+            raise _ParamsError(f"unknown task {params.get('id')!r}")
+        if task["status"]["state"] in ("completed", "failed"):
+            return task  # terminal states are not cancellable; idempotent
+        task["status"] = {"state": "canceled"}
+        self.tasks.put(task)
+        return task
+
+
+def _text_msg(text: str) -> dict:
+    return {
+        "role": "agent",
+        "parts": [{"kind": "text", "text": text}],
+        "messageId": f"msg-{uuid.uuid4().hex[:8]}",
+        "kind": "message",
+    }
+
+
+def _err(rpc_id, code: int, message: str) -> dict:
+    return {"jsonrpc": "2.0", "id": rpc_id, "error": {"code": code, "message": message}}
+
+
+class _ParamsError(ValueError):
+    pass

@@ -1,0 +1,230 @@
+"""REST facade: function-mode invoke + single-turn chat over HTTP.
+
+Reference: function mode exposes `POST /functions/{name}` on the facade
+(internal/facade/functions_handler.go, cmd/agent/functions.go) with
+input/output JSON-Schema validation done runtime-side; invalid model
+output maps to 502 (the runtime's fault), invalid caller input to 400.
+The REST chat surface (`facades[] type: rest`) serves one-shot turns for
+clients that can't hold a WebSocket.
+
+Shared `JsonHttpFacade` base: bearer/`?token=` auth via the facade auth
+chain, JSON plumbing, drain-aware readiness — reused by the MCP and A2A
+surfaces."""
+
+from __future__ import annotations
+
+import json
+import logging
+import re
+import threading
+import urllib.parse
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from omnia_tpu.facade.auth import AuthChain, Principal
+from omnia_tpu.runtime.client import RuntimeClient
+from omnia_tpu.utils.metrics import Registry
+
+logger = logging.getLogger(__name__)
+
+_FUNCTION_PATH = re.compile(r"^/functions/(?P<name>[A-Za-z0-9_.-]+)$")
+
+# runtime error_code → HTTP status (reference runtime.proto:317-321
+# semantics: bad_input is the caller's 400, bad_output the runtime's 502).
+_INVOKE_STATUS = {
+    "not_found": 404,
+    "bad_input": 400,
+    "bad_output": 502,
+    "engine_error": 502,
+    "unavailable": 503,
+}
+
+
+class JsonHttpFacade:
+    """Base for facade HTTP surfaces: auth chain + JSON + lifecycle."""
+
+    def __init__(
+        self,
+        runtime_target: str,
+        agent_name: str = "agent",
+        auth_chain: Optional[AuthChain] = None,
+        metrics_prefix: str = "omnia_facade_http",
+    ):
+        self.runtime_target = runtime_target
+        self.agent_name = agent_name
+        self.auth_chain = auth_chain
+        self.metrics = Registry(metrics_prefix)
+        self._requests = self.metrics.counter("requests_total", "HTTP requests")
+        self._client: Optional[RuntimeClient] = None
+        self._client_lock = threading.Lock()
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._draining = threading.Event()
+
+    # -- runtime client (shared channel) ----------------------------------
+
+    @property
+    def runtime(self) -> RuntimeClient:
+        if self._client is None:
+            with self._client_lock:
+                if self._client is None:
+                    self._client = RuntimeClient(self.runtime_target)
+        return self._client
+
+    # -- auth --------------------------------------------------------------
+
+    def authenticate(self, headers, query: dict) -> Optional[Principal]:
+        """None = unauthorized. Chainless facades run in dev mode
+        (anonymous principal), matching the WS facade's contract."""
+        if self.auth_chain is None:
+            return Principal(subject=query.get("user", [""])[0] or "anonymous",
+                             method="anonymous", claims={})
+        auth = headers.get("Authorization", "")
+        token = auth[7:] if auth.startswith("Bearer ") else query.get("token", [""])[0]
+        return self.auth_chain.authenticate(token)
+
+    # -- request handling (override in subclasses) -------------------------
+
+    def handle(self, method: str, path: str, body, principal: Principal):
+        raise NotImplementedError
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def drain(self) -> None:
+        self._draining.set()
+
+    def serve(self, host: str = "localhost", port: int = 0) -> int:
+        facade = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def _dispatch(self, method: str):
+                parts = urllib.parse.urlsplit(self.path)
+                query = urllib.parse.parse_qs(parts.query)
+                if parts.path == "/healthz":
+                    self._reply(200, {"status": "ok"})
+                    return
+                if parts.path == "/readyz":
+                    if facade._draining.is_set():
+                        self._reply(503, {"status": "draining"})
+                    else:
+                        self._reply(200, {"status": "ready"})
+                    return
+                if parts.path == "/metrics":
+                    data = facade.metrics.expose().encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type", "text/plain")
+                    self.send_header("Content-Length", str(len(data)))
+                    self.end_headers()
+                    self.wfile.write(data)
+                    return
+                if facade._draining.is_set():
+                    self._reply(503, {"error": "draining"})
+                    return
+                principal = facade.authenticate(self.headers, query)
+                if principal is None:
+                    self._reply(401, {"error": "unauthorized"})
+                    return
+                n = int(self.headers.get("Content-Length") or 0)
+                raw = self.rfile.read(n) if n else b""
+                try:
+                    body = json.loads(raw) if raw else None
+                except json.JSONDecodeError:
+                    self._reply(400, {"error": "invalid JSON body"})
+                    return
+                facade._requests.inc(method=method)
+                try:
+                    status, resp = facade.handle(method, parts.path, body, principal)
+                except Exception as e:  # noqa: BLE001
+                    logger.exception("facade http handler failed")
+                    status, resp = 500, {"error": str(e)}
+                self._reply(status, resp)
+
+            def _reply(self, status: int, resp: dict):
+                data = json.dumps(resp).encode()
+                self.send_response(status)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def do_GET(self):
+                self._dispatch("GET")
+
+            def do_POST(self):
+                self._dispatch("POST")
+
+            def log_message(self, *args):
+                pass
+
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        threading.Thread(target=self._httpd.serve_forever, daemon=True).start()
+        return self._httpd.server_address[1]
+
+    def shutdown(self) -> None:
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd = None
+        if self._client is not None:
+            self._client.close()
+            self._client = None
+
+
+class RestFacade(JsonHttpFacade):
+    """`POST /functions/{name}` (function mode) + `POST /v1/chat`."""
+
+    def handle(self, method: str, path: str, body, principal: Principal):
+        m = _FUNCTION_PATH.match(path)
+        if m and method == "POST":
+            return self._invoke(m.group("name"), body)
+        if path == "/v1/chat" and method == "POST":
+            return self._chat(body or {}, principal)
+        if path == "/v1/functions" and method == "GET":
+            return 200, {"functions": self.runtime.health().functions}
+        return 404, {"error": f"no route {method} {path}"}
+
+    def _invoke(self, name: str, body):
+        resp = self.runtime.invoke(name, body)
+        if resp.error_code:
+            status = _INVOKE_STATUS.get(resp.error_code, 500)
+            return status, {"error": resp.error_code, "message": resp.error_message}
+        out = {"output": resp.output}
+        if resp.usage:
+            out["usage"] = {
+                "prompt_tokens": resp.usage.prompt_tokens,
+                "completion_tokens": resp.usage.completion_tokens,
+                "cost_usd": resp.usage.cost_usd,
+            }
+        return 200, out
+
+    def _chat(self, body: dict, principal: Principal):
+        content = body.get("content") or body.get("message")
+        if not content:
+            return 400, {"error": "content required"}
+        session_id = body.get("session_id") or f"rest-{principal.subject}"
+        stream = self.runtime.open_stream(
+            session_id, user_id=principal.subject, agent=self.agent_name
+        )
+        try:
+            text, usage, finish = [], None, ""
+            for msg in stream.turn(content):
+                if msg.type == "chunk":
+                    text.append(msg.text)
+                elif msg.type == "tool_call":
+                    return 501, {"error": "client tools unsupported over REST"}
+                elif msg.type == "error":
+                    return 502, {"error": msg.error_code, "message": msg.error_message}
+                elif msg.type == "done":
+                    finish = msg.finish_reason
+                    usage = msg.usage
+            out = {"session_id": session_id, "content": "".join(text),
+                   "finish_reason": finish}
+            if usage:
+                out["usage"] = {
+                    "prompt_tokens": usage.prompt_tokens,
+                    "completion_tokens": usage.completion_tokens,
+                    "cost_usd": usage.cost_usd,
+                }
+            return 200, out
+        finally:
+            stream.close()

@@ -181,6 +181,10 @@ class HealthResponse:
     model: str = ""
     queue_depth: int = 0
     active_slots: int = 0
+    # Function-mode metadata ({name, description, input_schema} per entry)
+    # so HTTP facades (REST, MCP tools/list) can enumerate callable
+    # functions without a pack copy of their own.
+    functions: list[dict] = field(default_factory=list)
 
     def to_bytes(self) -> bytes:
         return json.dumps(asdict(self)).encode()

@@ -79,6 +79,17 @@ class RuntimeServer:
             self.capabilities.append(c.Capability.MEMORY.value)
         self.pack_params = pack_params or {}
         self.on_event = on_event
+        # Pack is immutable for the server's lifetime: precompute the
+        # function metadata once instead of per health probe (the operator
+        # polls Health on its reconcile loop).
+        self._function_meta_cache = [
+            {
+                "name": f["name"],
+                "description": f.get("description", ""),
+                "input_schema": f.get("input_schema"),
+            }
+            for f in pack.functions
+        ]
         self._conversations: dict[str, Conversation] = {}
         self._conv_lock = threading.Lock()
         self._grpc_server: Optional[grpc.Server] = None
@@ -222,6 +233,9 @@ class RuntimeServer:
             return c.InvokeResponse(output=doc, usage=usage)
         return c.InvokeResponse(output=text, usage=usage)
 
+    def _function_meta(self) -> list[dict]:
+        return self._function_meta_cache
+
     def health(self, request, context):
         # Capability-gate honesty: not ready until every serving shape is
         # compiled and the engine loop is running (no compile, no stall on
@@ -235,6 +249,7 @@ class RuntimeServer:
                 model=self.spec.model,
                 queue_depth=0,
                 active_slots=0,
+                functions=self._function_meta(),
             )
         engine = self.engine
         status = "ok" if getattr(engine, "healthy", lambda: True)() else "unhealthy"
@@ -245,6 +260,7 @@ class RuntimeServer:
             model=self.spec.model,
             queue_depth=engine.queue_depth(),
             active_slots=engine.active_slots(),
+            functions=self._function_meta(),
         )
 
     def has_conversation(self, request: c.HasConversationRequest, context):

@@ -1,0 +1,225 @@
+"""REST / MCP / A2A facade surface tests against a live runtime."""
+
+from __future__ import annotations
+
+import json
+import urllib.error
+import urllib.request
+
+import pytest
+
+from omnia_tpu.facade import A2aFacade, McpFacade, RestFacade
+from omnia_tpu.facade.auth import AuthChain, ClientKeyValidator
+from omnia_tpu.runtime.packs import load_pack
+from omnia_tpu.runtime.providers import ProviderRegistry, ProviderSpec
+from omnia_tpu.runtime.server import RuntimeServer
+
+PACK = {
+    "name": "fn-agent",
+    "version": "1.0.0",
+    "prompts": {"system": "You classify text."},
+    "sampling": {"temperature": 0.0, "max_tokens": 256},
+    "functions": [
+        {
+            "name": "classify",
+            "description": "Classify sentiment",
+            "input_schema": {"type": "object", "required": ["text"]},
+            "output_schema": {"type": "object", "required": ["label"]},
+            "prompt": "Classify: {{input}}",
+        }
+    ],
+}
+
+SCENARIOS = [
+    {"pattern": "Classify.*terrible", "reply": "not json at all"},
+    {"pattern": "Classify", "reply": '{"label": "positive"}'},
+    {"pattern": "hello", "reply": "hi from rest"},
+]
+
+
+@pytest.fixture(scope="module")
+def runtime():
+    reg = ProviderRegistry()
+    reg.register(ProviderSpec(name="m", type="mock", options={"scenarios": SCENARIOS}))
+    rt = RuntimeServer(pack=load_pack(PACK), providers=reg, provider_name="m")
+    port = rt.serve("localhost:0")
+    yield f"localhost:{port}"
+    rt.shutdown()
+
+
+def _post(url, body, token=None, expect_error=False):
+    headers = {"Content-Type": "application/json"}
+    if token:
+        headers["Authorization"] = f"Bearer {token}"
+    req = urllib.request.Request(url, data=json.dumps(body).encode(), headers=headers)
+    try:
+        with urllib.request.urlopen(req) as resp:
+            return resp.status, json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        if not expect_error:
+            raise
+        return e.code, json.loads(e.read() or b"{}")
+
+
+class TestRestFacade:
+    def test_function_invoke_and_status_mapping(self, runtime):
+        facade = RestFacade(runtime_target=runtime, agent_name="fn-agent")
+        port = facade.serve()
+        base = f"http://localhost:{port}"
+        try:
+            status, out = _post(base + "/functions/classify", {"text": "great stuff"})
+            assert status == 200 and out["output"] == {"label": "positive"}
+            assert out["usage"]["completion_tokens"] > 0
+            # caller's bad input → 400
+            status, out = _post(base + "/functions/classify", {"nope": 1}, expect_error=True)
+            assert status == 400 and out["error"] == "bad_input"
+            # model's bad output → 502 (runtime's fault)
+            status, out = _post(base + "/functions/classify", {"text": "terrible"},
+                                expect_error=True)
+            assert status == 502 and out["error"] == "bad_output"
+            # unknown function → 404
+            status, _ = _post(base + "/functions/ghost", {}, expect_error=True)
+            assert status == 404
+            # function listing
+            with urllib.request.urlopen(base + "/v1/functions") as resp:
+                fns = json.loads(resp.read())["functions"]
+            assert fns[0]["name"] == "classify"
+        finally:
+            facade.shutdown()
+
+    def test_rest_chat_and_auth(self, runtime):
+        facade = RestFacade(
+            runtime_target=runtime, agent_name="fn-agent",
+            auth_chain=AuthChain([ClientKeyValidator({"kid": "sekret"})]),
+        )
+        port = facade.serve()
+        base = f"http://localhost:{port}"
+        try:
+            status, _ = _post(base + "/v1/chat", {"content": "hello"}, expect_error=True)
+            assert status == 401
+            status, out = _post(base + "/v1/chat", {"content": "hello"}, token="sekret")
+            assert status == 200 and out["content"] == "hi from rest"
+            assert out["finish_reason"] == "stop"
+        finally:
+            facade.shutdown()
+
+    def test_drain_rejects_new_work(self, runtime):
+        facade = RestFacade(runtime_target=runtime)
+        port = facade.serve()
+        base = f"http://localhost:{port}"
+        try:
+            facade.drain()
+            status, _ = _post(base + "/v1/chat", {"content": "hello"}, expect_error=True)
+            assert status == 503
+            with urllib.request.urlopen(base + "/healthz") as resp:
+                assert resp.status == 200  # liveness unaffected
+        finally:
+            facade.shutdown()
+
+
+class TestMcpFacade:
+    @pytest.fixture()
+    def mcp(self, runtime):
+        facade = McpFacade(runtime_target=runtime, agent_name="fn-agent")
+        port = facade.serve()
+        yield f"http://localhost:{port}/mcp"
+        facade.shutdown()
+
+    def _rpc(self, url, method, params=None, rpc_id=1):
+        body = {"jsonrpc": "2.0", "id": rpc_id, "method": method}
+        if params is not None:
+            body["params"] = params
+        return _post(url, body)
+
+    def test_initialize_and_list(self, mcp):
+        status, out = self._rpc(mcp, "initialize", {})
+        assert status == 200
+        assert out["result"]["serverInfo"]["name"] == "fn-agent"
+        _, out = self._rpc(mcp, "tools/list")
+        tools = out["result"]["tools"]
+        assert tools[0]["name"] == "classify"
+        assert tools[0]["inputSchema"]["type"] == "object"
+
+    def test_tools_call_success_and_error(self, mcp):
+        _, out = self._rpc(mcp, "tools/call",
+                           {"name": "classify", "arguments": {"text": "nice"}})
+        content = out["result"]["content"][0]["text"]
+        assert json.loads(content) == {"label": "positive"}
+        assert out["result"]["isError"] is False
+        # execution error → isError result, not protocol error
+        _, out = self._rpc(mcp, "tools/call",
+                           {"name": "classify", "arguments": {"text": "terrible"}})
+        assert out["result"]["isError"] is True
+        # unknown tool → invalid params protocol error
+        _, out = self._rpc(mcp, "tools/call", {"name": "ghost", "arguments": {}})
+        assert out["error"]["code"] == -32602
+
+    def test_unknown_method_and_notification(self, mcp):
+        _, out = self._rpc(mcp, "resources/list")
+        assert out["error"]["code"] == -32601
+        status, _ = _post(mcp, {"jsonrpc": "2.0", "method": "notifications/initialized"})
+        assert status == 202
+
+
+class TestA2aFacade:
+    @pytest.fixture()
+    def a2a(self, runtime):
+        facade = A2aFacade(runtime_target=runtime, agent_name="fn-agent",
+                           description="classifies text")
+        port = facade.serve()
+        yield facade, f"http://localhost:{port}"
+        facade.shutdown()
+
+    def test_agent_card(self, a2a):
+        _, base = a2a
+        with urllib.request.urlopen(base + "/.well-known/agent.json") as resp:
+            card = json.loads(resp.read())
+        assert card["name"] == "fn-agent"
+        assert card["protocolVersion"]
+        assert card["url"].startswith("http://")
+
+    def test_message_send_and_task_roundtrip(self, a2a):
+        _, base = a2a
+        _, out = _post(base + "/", {
+            "jsonrpc": "2.0", "id": 1, "method": "message/send",
+            "params": {"message": {
+                "role": "user", "kind": "message", "messageId": "m1",
+                "parts": [{"kind": "text", "text": "hello"}]}},
+        })
+        task = out["result"]
+        assert task["status"]["state"] == "completed"
+        reply = task["artifacts"][0]["parts"][0]["text"]
+        assert reply == "hi from rest"
+        # tasks/get returns the stored task
+        _, out2 = _post(base + "/", {"jsonrpc": "2.0", "id": 2, "method": "tasks/get",
+                                     "params": {"id": task["id"]}})
+        assert out2["result"]["id"] == task["id"]
+        # cancel on a terminal task is idempotent
+        _, out3 = _post(base + "/", {"jsonrpc": "2.0", "id": 3, "method": "tasks/cancel",
+                                     "params": {"id": task["id"]}})
+        assert out3["result"]["status"]["state"] == "completed"
+
+    def test_same_context_resumes_conversation(self, a2a, runtime):
+        facade, base = a2a
+
+        def send(text, ctx=None):
+            msg = {"role": "user", "kind": "message", "messageId": "m",
+                   "parts": [{"kind": "text", "text": text}]}
+            if ctx:
+                msg["contextId"] = ctx
+            _, out = _post(base + "/", {"jsonrpc": "2.0", "id": 1,
+                                        "method": "message/send",
+                                        "params": {"message": msg}})
+            return out["result"]
+
+        t1 = send("hello")
+        ctx = t1["contextId"]
+        t2 = send("hello", ctx=ctx)
+        assert t2["contextId"] == ctx
+        assert t2["id"] != t1["id"]  # new task, same conversation
+
+    def test_bad_params_is_invalid_params(self, a2a):
+        _, base = a2a
+        _, out = _post(base + "/", {"jsonrpc": "2.0", "id": 1, "method": "message/send",
+                                    "params": {"message": {"parts": []}}})
+        assert out["error"]["code"] == -32602
