@@ -5,9 +5,11 @@ redis_task_store): agents expose an Agent Card at
 /.well-known/agent.json and serve the A2A JSON-RPC methods —
 message/send (run a turn, returns a completed task with the reply
 artifact), tasks/get (poll), tasks/cancel. Tasks persist in a store
-(in-memory here; the stream/Redis-backed store drops in) keyed by task
-id, with contextId carrying the conversation session so multi-message
-exchanges resume the same runtime conversation."""
+(in-memory here; a stream/Redis-backed store drops in) keyed by task id
+and OWNED by the authenticated principal — a caller can never read,
+overwrite, or cancel another principal's task. contextId carries the
+conversation session so multi-message exchanges resume the same runtime
+conversation."""
 
 from __future__ import annotations
 
@@ -17,14 +19,9 @@ import time
 import uuid
 from typing import Optional
 
+from omnia_tpu.facade import jsonrpc
 from omnia_tpu.facade.auth import Principal
 from omnia_tpu.facade.rest import JsonHttpFacade
-from omnia_tpu.facade.mcp import (
-    JSONRPC_INTERNAL,
-    JSONRPC_INVALID_PARAMS,
-    JSONRPC_METHOD_NOT_FOUND,
-    JSONRPC_PARSE_ERROR,
-)
 
 logger = logging.getLogger(__name__)
 
@@ -74,6 +71,10 @@ class A2aFacade(JsonHttpFacade):
         self.skills = skills or []
         self.tasks = task_store or TaskStore()
         self.base_url = ""  # set at serve() time for the card
+        # In-flight turn streams by task id, so tasks/cancel can actually
+        # interrupt the runtime turn (not just flip a status field).
+        self._active: dict[str, object] = {}
+        self._active_lock = threading.Lock()
 
     def serve(self, host: str = "localhost", port: int = 0) -> int:
         bound = super().serve(host, port)
@@ -86,8 +87,19 @@ class A2aFacade(JsonHttpFacade):
         if path == "/.well-known/agent.json" and method == "GET":
             return 200, self._card()
         if path == "/" and method == "POST":
-            return self._jsonrpc(body, principal)
+            return jsonrpc.handle_envelope(
+                body, lambda m, p: self._dispatch(m, p, principal)
+            )
         return 404, {"error": f"no route {method} {path}"}
+
+    def _dispatch(self, method: str, params: dict, principal: Principal) -> dict:
+        if method == "message/send":
+            return _public(self._message_send(params, principal))
+        if method == "tasks/get":
+            return _public(self._owned_task(params, principal))
+        if method == "tasks/cancel":
+            return _public(self._tasks_cancel(params, principal))
+        raise jsonrpc.RpcError(jsonrpc.METHOD_NOT_FOUND, f"unknown method {method!r}")
 
     def _card(self) -> dict:
         return {
@@ -102,39 +114,32 @@ class A2aFacade(JsonHttpFacade):
             "skills": self.skills,
         }
 
-    def _jsonrpc(self, body, principal: Principal):
-        if not isinstance(body, dict) or body.get("jsonrpc") != "2.0":
-            return 200, _err(None, JSONRPC_PARSE_ERROR, "expected JSON-RPC 2.0 object")
-        rpc_id = body.get("id")
-        method = body.get("method", "")
-        params = body.get("params") or {}
-        try:
-            if method == "message/send":
-                result = self._message_send(params, principal)
-            elif method == "tasks/get":
-                result = self._tasks_get(params)
-            elif method == "tasks/cancel":
-                result = self._tasks_cancel(params)
-            else:
-                return 200, _err(rpc_id, JSONRPC_METHOD_NOT_FOUND, f"unknown method {method!r}")
-        except _ParamsError as e:
-            return 200, _err(rpc_id, JSONRPC_INVALID_PARAMS, str(e))
-        except Exception as e:  # noqa: BLE001
-            logger.exception("a2a dispatch failed")
-            return 200, _err(rpc_id, JSONRPC_INTERNAL, str(e))
-        return 200, {"jsonrpc": "2.0", "id": rpc_id, "result": result}
-
     # -- methods -----------------------------------------------------------
+
+    def _owned_task(self, params: dict, principal: Principal) -> dict:
+        """Fetch a task the caller owns; a foreign or unknown id reads the
+        same ('unknown task') so ids can't be probed."""
+        task = self.tasks.get(params.get("id", ""))
+        if task is None or task.get("_owner") != principal.subject:
+            raise jsonrpc.RpcError(
+                jsonrpc.INVALID_PARAMS, f"unknown task {params.get('id')!r}"
+            )
+        return task
 
     def _message_send(self, params: dict, principal: Principal) -> dict:
         msg = params.get("message") or {}
         parts = msg.get("parts") or []
         text = " ".join(p.get("text", "") for p in parts if p.get("kind") == "text").strip()
         if not text:
-            raise _ParamsError("message.parts must contain text")
+            raise jsonrpc.RpcError(jsonrpc.INVALID_PARAMS, "message.parts must contain text")
         # contextId carries the conversation: same context → same session.
         context_id = msg.get("contextId") or f"ctx-{uuid.uuid4().hex[:12]}"
         task_id = msg.get("taskId") or f"task-{uuid.uuid4().hex[:12]}"
+        existing = self.tasks.get(task_id)
+        if existing is not None and existing.get("_owner") != principal.subject:
+            # A client-supplied taskId must never collide into another
+            # principal's task.
+            raise jsonrpc.RpcError(jsonrpc.INVALID_PARAMS, f"unknown task {task_id!r}")
         session_id = f"a2a-{principal.subject}-{context_id}"
 
         task = {
@@ -143,11 +148,14 @@ class A2aFacade(JsonHttpFacade):
             "status": {"state": "working"},
             "artifacts": [],
             "kind": "task",
+            "_owner": principal.subject,
         }
         self.tasks.put(task)
         stream = self.runtime.open_stream(
             session_id, user_id=principal.subject, agent=self.agent_name
         )
+        with self._active_lock:
+            self._active[task_id] = stream
         try:
             reply, failed = [], None
             for m in stream.turn(text):
@@ -156,7 +164,15 @@ class A2aFacade(JsonHttpFacade):
                 elif m.type == "error":
                     failed = f"{m.error_code}: {m.error_message}"
                 elif m.type == "tool_call":
+                    # Client tools can't round-trip over A2A: cancel the
+                    # turn NOW instead of letting the runtime wait out its
+                    # client-tool timeout with the session lock held.
                     failed = "client tools unsupported over A2A"
+                    stream.cancel()
+                    break
+            current = self.tasks.get(task_id) or task
+            if current["status"]["state"] == "canceled":
+                return current  # a concurrent tasks/cancel won; keep it
             if failed:
                 task["status"] = {"state": "failed", "message": _text_msg(failed)}
             else:
@@ -170,23 +186,29 @@ class A2aFacade(JsonHttpFacade):
             self.tasks.put(task)
             return task
         finally:
+            with self._active_lock:
+                self._active.pop(task_id, None)
             stream.close()
 
-    def _tasks_get(self, params: dict) -> dict:
-        task = self.tasks.get(params.get("id", ""))
-        if task is None:
-            raise _ParamsError(f"unknown task {params.get('id')!r}")
-        return task
-
-    def _tasks_cancel(self, params: dict) -> dict:
-        task = self.tasks.get(params.get("id", ""))
-        if task is None:
-            raise _ParamsError(f"unknown task {params.get('id')!r}")
-        if task["status"]["state"] in ("completed", "failed"):
+    def _tasks_cancel(self, params: dict, principal: Principal) -> dict:
+        task = self._owned_task(params, principal)
+        if task["status"]["state"] in ("completed", "failed", "canceled"):
             return task  # terminal states are not cancellable; idempotent
         task["status"] = {"state": "canceled"}
         self.tasks.put(task)
+        with self._active_lock:
+            stream = self._active.get(task["id"])
+        if stream is not None:
+            try:
+                stream.cancel()  # interrupt the in-flight runtime turn
+            except Exception:  # noqa: BLE001
+                logger.exception("turn cancel failed")
         return task
+
+
+def _public(task: dict) -> dict:
+    """Wire view of a task: internal fields (_owner, _touched) stripped."""
+    return {k: v for k, v in task.items() if not k.startswith("_")}
 
 
 def _text_msg(text: str) -> dict:
@@ -196,11 +218,3 @@ def _text_msg(text: str) -> dict:
         "messageId": f"msg-{uuid.uuid4().hex[:8]}",
         "kind": "message",
     }
-
-
-def _err(rpc_id, code: int, message: str) -> dict:
-    return {"jsonrpc": "2.0", "id": rpc_id, "error": {"code": code, "message": message}}
-
-
-class _ParamsError(ValueError):
-    pass

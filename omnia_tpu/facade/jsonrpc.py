@@ -1,0 +1,49 @@
+"""Shared JSON-RPC 2.0 envelope plumbing for the MCP and A2A surfaces.
+
+One place for the envelope check, error-response shape, and the
+dispatch→error-code mapping so a protocol fix lands once."""
+
+from __future__ import annotations
+
+import logging
+from typing import Callable
+
+logger = logging.getLogger(__name__)
+
+PARSE_ERROR = -32700
+METHOD_NOT_FOUND = -32601
+INVALID_PARAMS = -32602
+INTERNAL = -32603
+
+
+class RpcError(Exception):
+    def __init__(self, code: int, message: str):
+        super().__init__(message)
+        self.code = code
+        self.message = message
+
+
+def error_response(rpc_id, code: int, message: str) -> dict:
+    return {"jsonrpc": "2.0", "id": rpc_id, "error": {"code": code, "message": message}}
+
+
+def handle_envelope(body, dispatch: Callable[[str, dict], dict]):
+    """Validate a JSON-RPC request and run `dispatch(method, params)`.
+    Returns (http_status, response_dict). Notifications (no id,
+    `notifications/` prefix) get 202 with no body; RpcError maps to the
+    protocol error shape; anything else to INTERNAL."""
+    if not isinstance(body, dict) or body.get("jsonrpc") != "2.0":
+        return 200, error_response(None, PARSE_ERROR, "expected JSON-RPC 2.0 object")
+    rpc_id = body.get("id")
+    method = body.get("method", "")
+    params = body.get("params") or {}
+    if rpc_id is None and method.startswith("notifications/"):
+        return 202, {}
+    try:
+        result = dispatch(method, params)
+    except RpcError as e:
+        return 200, error_response(rpc_id, e.code, e.message)
+    except Exception as e:  # noqa: BLE001
+        logger.exception("json-rpc dispatch failed")
+        return 200, error_response(rpc_id, INTERNAL, str(e))
+    return 200, {"jsonrpc": "2.0", "id": rpc_id, "result": result}

@@ -14,6 +14,7 @@ import json
 import logging
 from typing import Optional
 
+from omnia_tpu.facade import jsonrpc
 from omnia_tpu.facade.auth import Principal
 from omnia_tpu.facade.rest import JsonHttpFacade
 
@@ -21,40 +22,18 @@ logger = logging.getLogger(__name__)
 
 PROTOCOL_VERSION = "2025-03-26"
 
-JSONRPC_PARSE_ERROR = -32700
-JSONRPC_METHOD_NOT_FOUND = -32601
-JSONRPC_INVALID_PARAMS = -32602
-JSONRPC_INTERNAL = -32603
-
 
 class McpFacade(JsonHttpFacade):
     def __init__(self, *args, server_name: Optional[str] = None, **kwargs):
         super().__init__(*args, metrics_prefix="omnia_facade_mcp", **kwargs)
         self.server_name = server_name or self.agent_name
 
-    # -- JSON-RPC plumbing -------------------------------------------------
-
     def handle(self, method: str, path: str, body, principal: Principal):
         if path != "/mcp" or method != "POST":
             return 404, {"error": f"no route {method} {path}"}
-        if not isinstance(body, dict) or body.get("jsonrpc") != "2.0":
-            return 200, self._err(None, JSONRPC_PARSE_ERROR, "expected JSON-RPC 2.0 object")
-        rpc_id = body.get("id")
-        rpc_method = body.get("method", "")
-        params = body.get("params") or {}
-        if rpc_id is None and rpc_method.startswith("notifications/"):
-            return 202, {}  # notifications need no response
-        try:
-            result = self._dispatch(rpc_method, params, principal)
-        except _RpcError as e:
-            return 200, self._err(rpc_id, e.code, e.message)
-        except Exception as e:  # noqa: BLE001
-            logger.exception("mcp dispatch failed")
-            return 200, self._err(rpc_id, JSONRPC_INTERNAL, str(e))
-        return 200, {"jsonrpc": "2.0", "id": rpc_id, "result": result}
-
-    def _err(self, rpc_id, code: int, message: str) -> dict:
-        return {"jsonrpc": "2.0", "id": rpc_id, "error": {"code": code, "message": message}}
+        return jsonrpc.handle_envelope(
+            body, lambda m, p: self._dispatch(m, p, principal)
+        )
 
     # -- methods -----------------------------------------------------------
 
@@ -71,7 +50,7 @@ class McpFacade(JsonHttpFacade):
             return {"tools": self._tools()}
         if method == "tools/call":
             return self._call(params, principal)
-        raise _RpcError(JSONRPC_METHOD_NOT_FOUND, f"unknown method {method!r}")
+        raise jsonrpc.RpcError(jsonrpc.METHOD_NOT_FOUND, f"unknown method {method!r}")
 
     def _tools(self) -> list[dict]:
         tools = []
@@ -89,11 +68,11 @@ class McpFacade(JsonHttpFacade):
     def _call(self, params: dict, principal: Principal) -> dict:
         name = params.get("name")
         if not name:
-            raise _RpcError(JSONRPC_INVALID_PARAMS, "params.name required")
+            raise jsonrpc.RpcError(jsonrpc.INVALID_PARAMS, "params.name required")
         args = params.get("arguments") or {}
         resp = self.runtime.invoke(name, args, metadata={"user": principal.subject})
         if resp.error_code == "not_found":
-            raise _RpcError(JSONRPC_INVALID_PARAMS, resp.error_message)
+            raise jsonrpc.RpcError(jsonrpc.INVALID_PARAMS, resp.error_message)
         if resp.error_code:
             # Execution errors are MCP tool results with isError, not
             # protocol errors — the model-side client should see them.
@@ -104,10 +83,3 @@ class McpFacade(JsonHttpFacade):
         output = resp.output
         text = output if isinstance(output, str) else json.dumps(output)
         return {"content": [{"type": "text", "text": text}], "isError": False}
-
-
-class _RpcError(Exception):
-    def __init__(self, code: int, message: str):
-        super().__init__(message)
-        self.code = code
-        self.message = message

@@ -176,15 +176,15 @@ class RestFacade(JsonHttpFacade):
     def handle(self, method: str, path: str, body, principal: Principal):
         m = _FUNCTION_PATH.match(path)
         if m and method == "POST":
-            return self._invoke(m.group("name"), body)
+            return self._invoke(m.group("name"), body, principal)
         if path == "/v1/chat" and method == "POST":
             return self._chat(body or {}, principal)
         if path == "/v1/functions" and method == "GET":
             return 200, {"functions": self.runtime.health().functions}
         return 404, {"error": f"no route {method} {path}"}
 
-    def _invoke(self, name: str, body):
-        resp = self.runtime.invoke(name, body)
+    def _invoke(self, name: str, body, principal: Principal):
+        resp = self.runtime.invoke(name, body, metadata={"user": principal.subject})
         if resp.error_code:
             status = _INVOKE_STATUS.get(resp.error_code, 500)
             return status, {"error": resp.error_code, "message": resp.error_message}
@@ -211,6 +211,10 @@ class RestFacade(JsonHttpFacade):
                 if msg.type == "chunk":
                     text.append(msg.text)
                 elif msg.type == "tool_call":
+                    # Cancel the turn NOW — returning without cancelling
+                    # would leave the runtime waiting out its client-tool
+                    # timeout with this session's turn lock held.
+                    stream.cancel()
                     return 501, {"error": "client tools unsupported over REST"}
                 elif msg.type == "error":
                     return 502, {"error": msg.error_code, "message": msg.error_message}

@@ -67,12 +67,15 @@ class RuntimeClient:
         user_id: str = "",
         agent: str = "",
         timeout: float = 300.0,
+        traceparent: str = "",
     ) -> "ConverseStream":
         md = [(c.MD_SESSION_ID, session_id)]
         if user_id:
             md.append((c.MD_USER_ID, user_id))
         if agent:
             md.append((c.MD_AGENT, agent))
+        if traceparent:
+            md.append(("traceparent", traceparent))
         return ConverseStream(self._converse, md, timeout)
 
 

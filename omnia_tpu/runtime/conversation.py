@@ -159,6 +159,7 @@ class Conversation:
         on_event: Optional[Callable[[str, dict], None]] = None,
         memory=None,
         user_id: str = "",
+        tracer=None,
     ):
         self.session_id = session_id
         self.pack = pack
@@ -169,6 +170,9 @@ class Conversation:
         self.tools = tool_executor or ToolExecutor()
         self.memory = memory  # MemoryCapability (reference sdk.WithMemory)
         self.user_id = user_id  # authenticated identity, set by the server
+        self.tracer = tracer  # utils.tracing.Tracer (None = no tracing)
+        self.traceparent: Optional[str] = None  # set per-stream by the server
+        self._turn_index = 0
         self.pack_params = pack_params or {}
         self.on_event = on_event or (lambda kind, data: None)
         self._client_results: "queue.Queue[list[ToolResult]]" = queue.Queue()
@@ -209,7 +213,32 @@ class Conversation:
     def stream(self, msg: ClientMessage) -> Iterator[ServerMessage]:
         """Process one turn; yields chunk/tool_call/done/error messages."""
         with self._turn_lock:
-            yield from self._stream_locked(msg)
+            if self.tracer is None:
+                yield from self._stream_locked(msg)
+                return
+            # Turn-indexed conversation span (reference tracing.go:214);
+            # remote parent arrives as a traceparent from the facade.
+            self._turn_index += 1
+            from omnia_tpu.utils import tracing as tr
+
+            with self.tracer.start_span(
+                tr.SPAN_CONVERSATION,
+                traceparent=self.traceparent,
+                attrs={"session.id": self.session_id, "turn.index": self._turn_index},
+            ) as span:
+                for m in self._stream_locked(msg):
+                    if m.type == "error":
+                        span.status = "error"
+                        span.set_attr("error.code", m.error_code)
+                    elif m.type == "done":
+                        span.add_finish_reason(m.finish_reason)
+                        if m.usage:
+                            span.add_llm_metrics(
+                                m.usage.prompt_tokens,
+                                m.usage.completion_tokens,
+                                cost_usd=m.usage.cost_usd,
+                            )
+                    yield m
 
     def _stream_locked(self, msg: ClientMessage) -> Iterator[ServerMessage]:
         deadline = time.monotonic() + TURN_TIMEOUT_S
@@ -260,6 +289,15 @@ class Conversation:
             prompt_ids = self.tokenizer.encode(prompt)
             usage.prompt_tokens += len(prompt_ids)
 
+            submit_t = time.monotonic()
+            first_token_t: Optional[float] = None
+            llm_span = None
+            if self.tracer is not None:
+                from omnia_tpu.utils import tracing as tr
+
+                llm_span = self.tracer.start_span(
+                    tr.SPAN_LLM, attrs={"llm.prompt_tokens": len(prompt_ids)}
+                )
             handle = self.engine.submit(prompt_ids, sp)
             self._active_handle = handle
             # Close the submit→publish window: a cancel_turn racing here saw
@@ -281,6 +319,8 @@ class Conversation:
                     error = StreamError("timeout", "turn exceeded execution timeout")
                     break
                 if ev.token_id is not None:
+                    if first_token_t is None:
+                        first_token_t = time.monotonic()
                     usage.completion_tokens += 1
                     piece = detok.push(ev.token_id)
                     if piece:
@@ -306,6 +346,16 @@ class Conversation:
                     error = StreamError("timeout", "turn exceeded execution timeout")
                     break
             self._active_handle = None
+            if llm_span is not None:
+                llm_span.add_llm_metrics(
+                    len(prompt_ids),
+                    usage.completion_tokens,
+                    ttft_s=(first_token_t - submit_t) if first_token_t else None,
+                )
+                if error is not None:
+                    llm_span.status = "error"
+                    llm_span.set_attr("error.code", error.code)
+                llm_span.end()
 
             if error is not None:
                 yield ServerMessage(type="error", error_code=error.code, error_message=error.message)
@@ -448,7 +498,16 @@ class Conversation:
             )
             return turns, msg, None
 
-        outcome = self.tools.execute(name, arguments, {"session_id": self.session_id})
+        if self.tracer is not None:
+            from omnia_tpu.utils import tracing as tr
+
+            with self.tracer.start_span(tr.SPAN_TOOL, attrs={"tool.name": name}) as tspan:
+                outcome = self.tools.execute(
+                    name, arguments, {"session_id": self.session_id}
+                )
+                tspan.add_tool_result(name, outcome.is_error)
+        else:
+            outcome = self.tools.execute(name, arguments, {"session_id": self.session_id})
         self.on_event(
             "tool_result",
             {"id": call_id, "is_error": outcome.is_error, "content": outcome.content},

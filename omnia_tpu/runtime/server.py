@@ -63,6 +63,7 @@ class RuntimeServer:
         pack_params: Optional[dict] = None,
         on_event=None,
         memory=None,
+        tracer=None,
     ):
         self.pack = pack
         self.providers = providers
@@ -70,6 +71,7 @@ class RuntimeServer:
         self.store = context_store or InMemoryContextStore()
         self.tools = tool_executor or ToolExecutor()
         self.memory = memory  # MemoryCapability shared by conversations
+        self.tracer = tracer  # utils.tracing.Tracer (None = tracing off)
         # Copy: appending 'memory' below must never mutate a caller list
         # shared with another server.
         self.capabilities = list(capabilities) if capabilities else list(DEFAULT_CAPABILITIES)
@@ -116,6 +118,7 @@ class RuntimeServer:
                         session_id=session_id,
                         memory=self.memory,
                         user_id=user_id,
+                        tracer=self.tracer,
                         pack=self.pack,
                         engine=self.engine,
                         tokenizer=build_tokenizer(self.spec),
@@ -151,6 +154,10 @@ class RuntimeServer:
                 error_message="session belongs to a different identity",
             )
             return
+
+        # Remote trace context (facade's otel-style interceptor analog):
+        # the whole stream's turns parent under the caller's trace.
+        conv.traceparent = md.get("traceparent")
 
         yield c.ServerMessage(
             type="hello",

@@ -1,0 +1,223 @@
+"""Tracing: OTel-shaped spans, propagation, exporters.
+
+Reference internal/tracing/tracing.go: an OTLP tracer provider with a
+fixed span vocabulary — conversation (turn-indexed), invocation, llm,
+tool — plus helpers stamping LLM metrics (token counts, TTFT, finish
+reason) onto spans, gRPC metadata propagation between facade and
+runtime, and trace ids enriched into logs (pkg/logctx). Here the tracer
+is dependency-free: spans collect into an in-memory ring and/or a jsonl
+exporter (OTLP-compatible field names, so an adapter can forward to a
+real collector); propagation uses the same W3C-style traceparent string
+the reference's otelgrpc interceptors produce."""
+
+from __future__ import annotations
+
+import contextvars
+import json
+import logging
+import os
+import random
+import threading
+import time
+from collections import deque
+from typing import Optional
+
+# Span kinds (the reference's vocabulary, internal/tracing/tracing.go
+# :214/:244/:270/:296).
+SPAN_CONVERSATION = "omnia.conversation"
+SPAN_INVOCATION = "omnia.invocation"
+SPAN_LLM = "omnia.llm"
+SPAN_TOOL = "omnia.tool"
+
+MD_TRACEPARENT = "traceparent"
+
+_current_span: "contextvars.ContextVar[Optional[Span]]" = contextvars.ContextVar(
+    "omnia_current_span", default=None
+)
+
+
+def _rand_hex(nbytes: int) -> str:
+    return "".join(f"{random.getrandbits(8):02x}" for _ in range(nbytes))
+
+
+class Span:
+    def __init__(self, tracer: "Tracer", name: str, trace_id: str, span_id: str,
+                 parent_id: str = "", attrs: Optional[dict] = None):
+        self.tracer = tracer
+        self.name = name
+        self.trace_id = trace_id
+        self.span_id = span_id
+        self.parent_id = parent_id
+        self.attrs = dict(attrs or {})
+        self.events: list[dict] = []
+        self.status = "ok"
+        self.start_ns = time.time_ns()
+        self.end_ns: Optional[int] = None
+        self._token = None
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def __enter__(self) -> "Span":
+        self._token = _current_span.set(self)
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        if exc is not None:
+            self.record_error(exc)
+        self.end()
+
+    def end(self) -> None:
+        if self.end_ns is not None:
+            return
+        self.end_ns = time.time_ns()
+        if self._token is not None:
+            _current_span.reset(self._token)
+            self._token = None
+        self.tracer._export(self)
+
+    # -- data --------------------------------------------------------------
+
+    def set_attr(self, key: str, value) -> None:
+        self.attrs[key] = value
+
+    def add_event(self, name: str, attrs: Optional[dict] = None) -> None:
+        self.events.append({"name": name, "ts_ns": time.time_ns(), "attrs": attrs or {}})
+
+    def record_error(self, exc: BaseException) -> None:
+        self.status = "error"
+        self.attrs["error.type"] = type(exc).__name__
+        self.attrs["error.message"] = str(exc)
+
+    # -- LLM helpers (reference AddLLMMetrics/AddFinishReason/AddToolResult)
+
+    def add_llm_metrics(self, prompt_tokens: int, completion_tokens: int,
+                        ttft_s: Optional[float] = None, cost_usd: float = 0.0) -> None:
+        self.attrs["llm.prompt_tokens"] = prompt_tokens
+        self.attrs["llm.completion_tokens"] = completion_tokens
+        if ttft_s is not None:
+            self.attrs["llm.ttft_s"] = round(ttft_s, 6)
+        self.attrs["llm.cost_usd"] = cost_usd
+
+    def add_finish_reason(self, reason: str) -> None:
+        self.attrs["llm.finish_reason"] = reason
+
+    def add_tool_result(self, tool: str, is_error: bool) -> None:
+        self.attrs["tool.name"] = tool
+        self.attrs["tool.is_error"] = is_error
+
+    # -- propagation -------------------------------------------------------
+
+    def traceparent(self) -> str:
+        """W3C traceparent for cross-process propagation (gRPC metadata /
+        HTTP header)."""
+        return f"00-{self.trace_id}-{self.span_id}-01"
+
+    def to_dict(self) -> dict:
+        return {
+            "name": self.name,
+            "trace_id": self.trace_id,
+            "span_id": self.span_id,
+            "parent_span_id": self.parent_id,
+            "start_ns": self.start_ns,
+            "end_ns": self.end_ns,
+            "status": self.status,
+            "attributes": self.attrs,
+            "events": self.events,
+        }
+
+
+def parse_traceparent(header: str) -> Optional[tuple[str, str]]:
+    """→ (trace_id, parent_span_id) or None."""
+    try:
+        version, trace_id, span_id, _flags = header.split("-")
+        if len(trace_id) == 32 and len(span_id) == 16 and version == "00":
+            return trace_id, span_id
+    except ValueError:
+        pass
+    return None
+
+
+class Tracer:
+    """Process tracer: sampling + ring buffer + optional jsonl export."""
+
+    def __init__(self, service: str, sample_rate: float = 1.0,
+                 export_path: Optional[str] = None, ring_size: int = 2048,
+                 seed: Optional[int] = None):
+        self.service = service
+        self.sample_rate = sample_rate
+        self.export_path = export_path
+        self.finished: "deque[Span]" = deque(maxlen=ring_size)
+        self._rng = random.Random(seed)
+        self._lock = threading.Lock()
+
+    def start_span(self, name: str, parent: Optional[Span] = None,
+                   traceparent: Optional[str] = None,
+                   attrs: Optional[dict] = None) -> Span:
+        """Parent precedence: explicit parent > traceparent header >
+        current-context span > new root. Sampling decides at the root;
+        children always follow their root's decision (parent-based)."""
+        parent = parent or _current_span.get()
+        if isinstance(parent, _NoopSpan):
+            # Parent-based sampling: children of an unsampled root must be
+            # dropped too, not exported as orphans under the zero trace id.
+            return _NoopSpan(self)
+        trace_id = parent_id = None
+        if parent is not None:
+            trace_id, parent_id = parent.trace_id, parent.span_id
+        elif traceparent:
+            parsed = parse_traceparent(traceparent)
+            if parsed:
+                trace_id, parent_id = parsed
+        if trace_id is None:
+            if self._rng.random() >= self.sample_rate:
+                return _NoopSpan(self)
+            trace_id, parent_id = _rand_hex(16), ""
+        span = Span(self, name, trace_id, _rand_hex(8), parent_id, attrs)
+        span.attrs.setdefault("service.name", self.service)
+        return span
+
+    def _export(self, span: Span) -> None:
+        with self._lock:
+            self.finished.append(span)
+            if self.export_path:
+                try:
+                    with open(self.export_path, "a") as f:
+                        f.write(json.dumps(span.to_dict()) + "\n")
+                except OSError:  # pragma: no cover — tracing never breaks serving
+                    pass
+
+    def spans(self, name: Optional[str] = None) -> list[Span]:
+        with self._lock:
+            return [s for s in self.finished if name is None or s.name == name]
+
+
+class _NoopSpan(Span):
+    """Unsampled span: context-manager compatible, exports nothing."""
+
+    def __init__(self, tracer: Tracer):
+        super().__init__(tracer, "noop", "0" * 32, "0" * 16)
+
+    def end(self) -> None:
+        if self._token is not None:
+            _current_span.reset(self._token)
+            self._token = None
+        self.end_ns = time.time_ns()  # no export
+
+
+def current_span() -> Optional[Span]:
+    return _current_span.get()
+
+
+class TraceContextFilter(logging.Filter):
+    """logctx analog: stamps trace_id/span_id onto every log record so
+    logs correlate with traces (blank when no span is active)."""
+
+    def filter(self, record: logging.LogRecord) -> bool:
+        span = _current_span.get()
+        record.trace_id = span.trace_id if span else ""
+        record.span_id = span.span_id if span else ""
+        return True
+
+
+def noop_tracer() -> Tracer:
+    return Tracer("noop", sample_rate=0.0)

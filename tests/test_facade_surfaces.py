@@ -223,3 +223,112 @@ class TestA2aFacade:
         _, out = _post(base + "/", {"jsonrpc": "2.0", "id": 1, "method": "message/send",
                                     "params": {"message": {"parts": []}}})
         assert out["error"]["code"] == -32602
+
+
+CLIENT_TOOL_PACK = {
+    "name": "ct-agent",
+    "version": "1.0.0",
+    "prompts": {"system": "s"},
+    "tools": [{"name": "lookup", "client_side": True}],
+    "sampling": {"temperature": 0.0, "max_tokens": 256},
+}
+
+CLIENT_TOOL_SCENARIOS = [
+    {"pattern": "needs the client",
+     "reply": '<tool_call>{"name": "lookup", "arguments": {}}</tool_call>'},
+    {"pattern": ".", "reply": "plain"},
+]
+
+
+@pytest.fixture(scope="module")
+def ct_runtime():
+    from omnia_tpu.tools import ToolExecutor, ToolHandler
+
+    reg = ProviderRegistry()
+    reg.register(ProviderSpec(name="m", type="mock",
+                              options={"scenarios": CLIENT_TOOL_SCENARIOS}))
+    rt = RuntimeServer(pack=load_pack(CLIENT_TOOL_PACK), providers=reg,
+                       provider_name="m",
+                       tool_executor=ToolExecutor([ToolHandler(name="lookup", type="client")]))
+    port = rt.serve("localhost:0")
+    yield f"localhost:{port}"
+    rt.shutdown()
+
+
+class TestClientToolCancellation:
+    def test_rest_chat_cancels_turn_not_blocks_session(self, ct_runtime):
+        import time
+
+        facade = RestFacade(runtime_target=ct_runtime, agent_name="ct-agent")
+        port = facade.serve()
+        base = f"http://localhost:{port}"
+        try:
+            t0 = time.monotonic()
+            status, _ = _post(base + "/v1/chat", {"content": "this needs the client tool"},
+                              expect_error=True)
+            assert status == 501
+            assert time.monotonic() - t0 < 10  # no 60s client-tool wait
+            # same session must NOT be blocked behind a held turn lock
+            t0 = time.monotonic()
+            status, out = _post(base + "/v1/chat", {"content": "say something plain"})
+            assert status == 200 and out["content"] == "plain"
+            assert time.monotonic() - t0 < 10
+        finally:
+            facade.shutdown()
+
+    def test_a2a_client_tool_fails_fast(self, ct_runtime):
+        import time
+
+        facade = A2aFacade(runtime_target=ct_runtime, agent_name="ct-agent")
+        port = facade.serve()
+        try:
+            t0 = time.monotonic()
+            _, out = _post(f"http://localhost:{port}/", {
+                "jsonrpc": "2.0", "id": 1, "method": "message/send",
+                "params": {"message": {"role": "user", "kind": "message", "messageId": "m",
+                                       "parts": [{"kind": "text", "text": "this needs the client tool"}]}},
+            })
+            task = out["result"]
+            assert task["status"]["state"] == "failed"
+            assert "client tools" in task["status"]["message"]["parts"][0]["text"]
+            assert time.monotonic() - t0 < 10
+        finally:
+            facade.shutdown()
+
+
+class TestA2aIsolation:
+    def test_tasks_scoped_to_principal(self, runtime):
+        from omnia_tpu.facade.auth import AuthChain, ClientKeyValidator
+
+        facade = A2aFacade(
+            runtime_target=runtime, agent_name="fn-agent",
+            auth_chain=AuthChain([ClientKeyValidator({"alice": "key-a", "bob": "key-b"})]),
+        )
+        port = facade.serve()
+        base = f"http://localhost:{port}"
+        try:
+            _, out = _post(base + "/", {
+                "jsonrpc": "2.0", "id": 1, "method": "message/send",
+                "params": {"message": {"role": "user", "kind": "message", "messageId": "m",
+                                       "parts": [{"kind": "text", "text": "hello"}]}},
+            }, token="key-a")
+            task = out["result"]
+            assert "_owner" not in task  # internals never on the wire
+            # bob cannot read alice's task...
+            _, out = _post(base + "/", {"jsonrpc": "2.0", "id": 2, "method": "tasks/get",
+                                        "params": {"id": task["id"]}}, token="key-b")
+            assert out["error"]["code"] == -32602
+            # ...nor hijack its id via message/send
+            _, out = _post(base + "/", {
+                "jsonrpc": "2.0", "id": 3, "method": "message/send",
+                "params": {"message": {"role": "user", "kind": "message", "messageId": "m",
+                                       "taskId": task["id"],
+                                       "parts": [{"kind": "text", "text": "steal"}]}},
+            }, token="key-b")
+            assert out["error"]["code"] == -32602
+            # alice still sees her own
+            _, out = _post(base + "/", {"jsonrpc": "2.0", "id": 4, "method": "tasks/get",
+                                        "params": {"id": task["id"]}}, token="key-a")
+            assert out["result"]["status"]["state"] == "completed"
+        finally:
+            facade.shutdown()

@@ -1,0 +1,146 @@
+"""Tracing tests: span lifecycle, propagation, sampling, log correlation,
+and the conversation/llm/tool span vocabulary on a real turn."""
+
+from __future__ import annotations
+
+import json
+import logging
+
+from omnia_tpu.utils import tracing as tr
+
+
+class TestTracer:
+    def test_span_nesting_and_context(self):
+        t = tr.Tracer("svc")
+        with t.start_span("parent") as parent:
+            assert tr.current_span() is parent
+            with t.start_span("child") as child:
+                assert child.trace_id == parent.trace_id
+                assert child.parent_id == parent.span_id
+        assert tr.current_span() is None
+        assert [s.name for s in t.spans()] == ["child", "parent"]
+
+    def test_traceparent_roundtrip(self):
+        t = tr.Tracer("a")
+        span = t.start_span("root")
+        header = span.traceparent()
+        parsed = tr.parse_traceparent(header)
+        assert parsed == (span.trace_id, span.span_id)
+        t2 = tr.Tracer("b")
+        remote = t2.start_span("remote-child", traceparent=header)
+        assert remote.trace_id == span.trace_id
+        assert remote.parent_id == span.span_id
+        assert tr.parse_traceparent("garbage") is None
+
+    def test_sampling_zero_exports_nothing(self):
+        t = tr.Tracer("svc", sample_rate=0.0)
+        with t.start_span("root"):
+            pass
+        assert t.spans() == []
+
+    def test_children_follow_root_decision(self):
+        t = tr.Tracer("svc", sample_rate=1.0)
+        with t.start_span("root") as root:
+            t.sample_rate = 0.0  # must not affect children of a sampled root
+            with t.start_span("child") as child:
+                assert child.trace_id == root.trace_id
+        assert len(t.spans()) == 2
+
+    def test_error_recording(self):
+        t = tr.Tracer("svc")
+        try:
+            with t.start_span("boom"):
+                raise ValueError("bad")
+        except ValueError:
+            pass
+        s = t.spans("boom")[0]
+        assert s.status == "error"
+        assert s.attrs["error.message"] == "bad"
+
+    def test_jsonl_export(self, tmp_path):
+        path = str(tmp_path / "spans.jsonl")
+        t = tr.Tracer("svc", export_path=path)
+        with t.start_span("exported", attrs={"k": "v"}):
+            pass
+        rows = [json.loads(l) for l in open(path)]
+        assert rows[0]["name"] == "exported"
+        assert rows[0]["attributes"]["k"] == "v"
+        assert rows[0]["end_ns"] >= rows[0]["start_ns"]
+
+    def test_log_correlation_filter(self, caplog):
+        t = tr.Tracer("svc")
+        logger = logging.getLogger("corr-test")
+        logger.addFilter(tr.TraceContextFilter())
+        try:
+            with t.start_span("op") as span:
+                with caplog.at_level(logging.INFO, logger="corr-test"):
+                    logger.info("inside")
+            assert caplog.records[0].trace_id == span.trace_id
+            assert caplog.records[0].span_id == span.span_id
+        finally:
+            logger.filters.clear()
+
+
+class TestTurnSpans:
+    def test_conversation_llm_tool_spans_on_turn(self):
+        from omnia_tpu.engine import MockEngine
+        from omnia_tpu.engine.mock import Scenario
+        from omnia_tpu.engine.tokenizer import ByteTokenizer
+        from omnia_tpu.runtime import contract as c
+        from omnia_tpu.runtime.context_store import InMemoryContextStore
+        from omnia_tpu.runtime.conversation import Conversation
+        from omnia_tpu.runtime.packs import load_pack
+        from omnia_tpu.tools import ToolExecutor, ToolHandler
+
+        tracer = tr.Tracer("runtime-test")
+        tok = ByteTokenizer()
+        scenarios = [
+            Scenario(pattern=r"\[TOOL\]echoed", reply="tool done"),
+            Scenario(pattern="use the tool",
+                     reply='<tool_call>{"name": "echo", "arguments": {}}</tool_call>'),
+        ]
+        conv = Conversation(
+            session_id="traced",
+            pack=load_pack({"name": "t", "version": "1.0.0",
+                            "prompts": {"system": "s"},
+                            "tools": [{"name": "echo"}],
+                            "sampling": {"max_tokens": 256}}),
+            engine=MockEngine(scenarios, tokenizer=tok),
+            tokenizer=tok,
+            store=InMemoryContextStore(),
+            tool_executor=ToolExecutor([ToolHandler(name="echo", fn=lambda a: "echoed")]),
+            tracer=tracer,
+        )
+        # remote parent from the facade
+        root = tr.Tracer("facade").start_span("ws-turn")
+        conv.traceparent = root.traceparent()
+        msgs = list(conv.stream(c.ClientMessage(content="use the tool please")))
+        assert msgs[-1].type == "done"
+
+        conv_spans = tracer.spans(tr.SPAN_CONVERSATION)
+        llm_spans = tracer.spans(tr.SPAN_LLM)
+        tool_spans = tracer.spans(tr.SPAN_TOOL)
+        assert len(conv_spans) == 1
+        assert len(llm_spans) == 2  # tool round + final round
+        assert len(tool_spans) == 1
+        # whole turn parents under the facade's trace
+        assert conv_spans[0].trace_id == root.trace_id
+        assert all(s.trace_id == root.trace_id for s in llm_spans + tool_spans)
+        # llm spans carry TTFT + token metrics; tool span carries outcome
+        assert llm_spans[0].attrs["llm.ttft_s"] >= 0
+        assert llm_spans[0].attrs["llm.completion_tokens"] > 0
+        assert tool_spans[0].attrs == {
+            **tool_spans[0].attrs, "tool.name": "echo", "tool.is_error": False}
+        # turn-level rollup on the conversation span
+        assert conv_spans[0].attrs["llm.finish_reason"] == "stop"
+        assert conv_spans[0].attrs["turn.index"] == 1
+
+
+class TestSamplingPropagation:
+    def test_children_of_unsampled_root_are_dropped(self):
+        t = tr.Tracer("svc", sample_rate=0.0)
+        with t.start_span("root"):
+            with t.start_span("child"):
+                with t.start_span("grandchild"):
+                    pass
+        assert t.spans() == []  # nothing leaks under the zero trace id
