@@ -1,0 +1,158 @@
+"""Doctor: platform diagnostics runner.
+
+Reference internal/doctor (runner.go, checks/{agent,crds,infrastructure,
+memory,observability,sessions,workspace}.go): a battery of probes across
+every service, each returning pass/warn/fail with a remedy hint; the
+runner aggregates into a report for the CLI/dashboard. Checks here probe
+the same planes: resource store + CRD presence, runtime gRPC health
+(incl. capability honesty), facade surfaces (WS round-trip like the
+reference's mgmt-twin probe), session/memory/privacy HTTP APIs, and the
+stream fabric."""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import logging
+import time
+import urllib.error
+import urllib.request
+from typing import Callable, Optional
+
+logger = logging.getLogger(__name__)
+
+PASS, WARN, FAIL = "pass", "warn", "fail"
+
+
+@dataclasses.dataclass
+class CheckResult:
+    name: str
+    status: str
+    detail: str = ""
+    remedy: str = ""
+    duration_s: float = 0.0
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+class Doctor:
+    def __init__(self) -> None:
+        self._checks: list[tuple[str, Callable[[], CheckResult]]] = []
+
+    def register(self, name: str, fn: Callable[[], CheckResult]) -> None:
+        self._checks.append((name, fn))
+
+    def run(self) -> dict:
+        results = []
+        for name, fn in self._checks:
+            t0 = time.monotonic()
+            try:
+                r = fn()
+            except Exception as e:  # noqa: BLE001 — a crashing check is a FAIL
+                r = CheckResult(name, FAIL, detail=str(e),
+                                remedy="check service logs")
+            r.name = r.name or name
+            r.duration_s = round(time.monotonic() - t0, 4)
+            results.append(r)
+        worst = FAIL if any(r.status == FAIL for r in results) else (
+            WARN if any(r.status == WARN for r in results) else PASS
+        )
+        return {
+            "status": worst,
+            "checks": [r.to_dict() for r in results],
+            "ran_at": time.time(),
+        }
+
+    # -- stock checks ------------------------------------------------------
+
+    def add_store_check(self, store, expect_kinds: tuple = ("AgentRuntime", "Provider", "PromptPack")) -> None:
+        def check() -> CheckResult:
+            missing = [k for k in expect_kinds if not store.list(kind=k)]
+            if missing:
+                return CheckResult(
+                    "resources", WARN,
+                    detail=f"no resources of kind: {', '.join(missing)}",
+                    remedy="apply your agent manifests",
+                )
+            return CheckResult("resources", PASS,
+                               detail=f"{len(store.list())} resources")
+        self.register("resources", check)
+
+    def add_runtime_check(self, target: str) -> None:
+        def check() -> CheckResult:
+            from omnia_tpu.runtime.client import RuntimeClient
+
+            client = RuntimeClient(target)
+            try:
+                h = client.health(timeout=5.0)
+            finally:
+                client.close()
+            if h.status == "initializing":
+                return CheckResult("runtime", WARN, detail="engine still compiling",
+                                   remedy="wait for warmup; check pod resources")
+            if h.status != "ok":
+                return CheckResult("runtime", FAIL, detail=f"health={h.status}",
+                                   remedy="inspect runtime logs")
+            return CheckResult(
+                "runtime", PASS,
+                detail=f"model={h.model} caps={len(h.capabilities)} "
+                       f"queue={h.queue_depth}",
+            )
+        self.register("runtime", check)
+
+    def add_http_check(self, name: str, url: str, expect_status: int = 200) -> None:
+        def check() -> CheckResult:
+            try:
+                with urllib.request.urlopen(url, timeout=5.0) as resp:
+                    ok = resp.status == expect_status
+                    return CheckResult(
+                        name, PASS if ok else FAIL,
+                        detail=f"HTTP {resp.status}",
+                        remedy="" if ok else f"expected {expect_status}",
+                    )
+            except (urllib.error.URLError, OSError) as e:
+                return CheckResult(name, FAIL, detail=str(e),
+                                   remedy=f"is {name} running at {url}?")
+        self.register(name, check)
+
+    def add_facade_ws_check(self, ws_url: str, timeout_s: float = 15.0) -> None:
+        """Full WS round-trip (the reference doctor's mgmt-twin probe):
+        connect, send a message, require a done/error frame back."""
+        def check() -> CheckResult:
+            from websockets.sync.client import connect
+
+            with connect(ws_url) as ws:
+                hello = json.loads(ws.recv(timeout=timeout_s))
+                if hello.get("type") != "connected":
+                    return CheckResult("facade-ws", FAIL,
+                                       detail=f"expected connected, got {hello.get('type')}",
+                                       remedy="check facade auth config")
+                ws.send(json.dumps({"type": "message", "content": "doctor probe"}))
+                deadline = time.monotonic() + timeout_s
+                while time.monotonic() < deadline:
+                    msg = json.loads(ws.recv(timeout=deadline - time.monotonic()))
+                    if msg["type"] == "done":
+                        return CheckResult("facade-ws", PASS, detail="turn round-trip ok")
+                    if msg["type"] == "error":
+                        return CheckResult("facade-ws", FAIL,
+                                           detail=msg.get("message", "turn error"),
+                                           remedy="inspect runtime logs")
+                return CheckResult("facade-ws", FAIL, detail="no done frame",
+                                   remedy="runtime may be stalled")
+        self.register("facade-ws", check)
+
+    def add_streams_check(self, stream) -> None:
+        def check() -> CheckResult:
+            probe_group = "doctor-probe"
+            stream.ensure_group(probe_group, from_start=False)
+            stream.add({"type": "doctor_probe"})
+            got = stream.read_group(probe_group, "doctor", count=10, block_s=2.0)
+            probe = [e for e in got if e.data.get("type") == "doctor_probe"]
+            if got:
+                stream.ack(probe_group, *[e.id for e in got])
+            if not probe:
+                return CheckResult("streams", FAIL, detail="probe event not delivered",
+                                   remedy="check stream backend")
+            return CheckResult("streams", PASS, detail="append+consume ok")
+        self.register("streams", check)

@@ -54,6 +54,26 @@ class TaskStore:
                 return None
             return t
 
+    def transition(self, task_id: str, status: dict,
+                   artifacts: Optional[list] = None,
+                   unless_state: tuple = ()) -> Optional[dict]:
+        """Atomic status transition: under the store lock, set the task's
+        status (and artifacts) UNLESS its current state is in
+        `unless_state` — the compare-and-set that keeps a concurrent
+        tasks/cancel from being silently overwritten. Returns the task as
+        stored after the call (unchanged if the guard held)."""
+        with self._lock:
+            t = self._tasks.get(task_id)
+            if t is None:
+                return None
+            if t["status"]["state"] in unless_state:
+                return t
+            t["status"] = status
+            if artifacts is not None:
+                t["artifacts"] = artifacts
+            t["_touched"] = time.time()
+            return t
+
     def _evict(self, now: float) -> None:
         expired = [tid for tid, t in self._tasks.items() if now - t["_touched"] > self.ttl_s]
         for tid in expired:
@@ -168,23 +188,24 @@ class A2aFacade(JsonHttpFacade):
                     # turn NOW instead of letting the runtime wait out its
                     # client-tool timeout with the session lock held.
                     failed = "client tools unsupported over A2A"
-                    stream.cancel()
+                    stream.send_cancel()
                     break
-            current = self.tasks.get(task_id) or task
-            if current["status"]["state"] == "canceled":
-                return current  # a concurrent tasks/cancel won; keep it
             if failed:
-                task["status"] = {"state": "failed", "message": _text_msg(failed)}
+                status, artifacts = {"state": "failed", "message": _text_msg(failed)}, None
             else:
-                task["status"] = {"state": "completed"}
-                task["artifacts"] = [
+                status = {"state": "completed"}
+                artifacts = [
                     {
                         "artifactId": f"artifact-{uuid.uuid4().hex[:8]}",
                         "parts": [{"kind": "text", "text": "".join(reply)}],
                     }
                 ]
-            self.tasks.put(task)
-            return task
+            # CAS under the store lock: a concurrent tasks/cancel that
+            # already flipped the task to canceled must win.
+            final = self.tasks.transition(
+                task_id, status, artifacts, unless_state=("canceled",)
+            )
+            return final or task
         finally:
             with self._active_lock:
                 self._active.pop(task_id, None)
@@ -192,15 +213,15 @@ class A2aFacade(JsonHttpFacade):
 
     def _tasks_cancel(self, params: dict, principal: Principal) -> dict:
         task = self._owned_task(params, principal)
-        if task["status"]["state"] in ("completed", "failed", "canceled"):
-            return task  # terminal states are not cancellable; idempotent
-        task["status"] = {"state": "canceled"}
-        self.tasks.put(task)
+        task = self.tasks.transition(
+            task["id"], {"state": "canceled"},
+            unless_state=("completed", "failed", "canceled"),  # terminal: idempotent
+        ) or task
         with self._active_lock:
             stream = self._active.get(task["id"])
         if stream is not None:
             try:
-                stream.cancel()  # interrupt the in-flight runtime turn
+                stream.send_cancel()  # interrupt the in-flight runtime turn
             except Exception:  # noqa: BLE001
                 logger.exception("turn cancel failed")
         return task

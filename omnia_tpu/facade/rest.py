@@ -214,7 +214,7 @@ class RestFacade(JsonHttpFacade):
                     # Cancel the turn NOW — returning without cancelling
                     # would leave the runtime waiting out its client-tool
                     # timeout with this session's turn lock held.
-                    stream.cancel()
+                    stream.send_cancel()
                     return 501, {"error": "client tools unsupported over REST"}
                 elif msg.type == "error":
                     return 502, {"error": msg.error_code, "message": msg.error_message}

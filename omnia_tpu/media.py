@@ -1,0 +1,114 @@
+"""Media storage: upload negotiation + pluggable blob backends.
+
+Reference internal/media (builder.go, handler.go, s3/gcs/azure/local
+backends): clients negotiate an upload (get a storage_ref + a signed
+upload URL), PUT bytes, and the runtime resolves storage_refs to bytes
+at provider-call time (internal/runtime/media_storage_adapter.go).
+Backends here: LocalMediaStore (filesystem, the dev/test backend; the
+cloud backends drop in behind the same interface). Upload tokens are
+HMAC-signed and expire, which is the signed-URL analog."""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import hmac
+import os
+import re
+import threading
+import time
+import uuid
+from typing import Optional
+
+MAX_UPLOAD_BYTES = 32 * 1024 * 1024
+_REF = re.compile(r"^media://(?P<workspace>[A-Za-z0-9_.-]+)/(?P<id>[0-9a-f]{32})$")
+
+
+class MediaError(RuntimeError):
+    pass
+
+
+@dataclasses.dataclass
+class UploadGrant:
+    storage_ref: str
+    token: str
+    expires_at: float
+    max_bytes: int = MAX_UPLOAD_BYTES
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+class LocalMediaStore:
+    def __init__(self, root: str, secret: Optional[bytes] = None,
+                 grant_ttl_s: float = 600.0):
+        self.root = root
+        self.secret = secret or os.urandom(32)
+        self.grant_ttl_s = grant_ttl_s
+        self._lock = threading.Lock()
+        os.makedirs(root, exist_ok=True)
+
+    # -- negotiation -------------------------------------------------------
+
+    def negotiate_upload(self, workspace: str, content_type: str = "") -> UploadGrant:
+        media_id = uuid.uuid4().hex
+        ref = f"media://{workspace}/{media_id}"
+        expires = time.time() + self.grant_ttl_s
+        token = self._sign(ref, expires)
+        return UploadGrant(storage_ref=ref, token=token, expires_at=expires)
+
+    def _sign(self, ref: str, expires: float) -> str:
+        msg = f"{ref}:{int(expires)}".encode()
+        return f"{int(expires)}.{hmac.new(self.secret, msg, hashlib.sha256).hexdigest()}"
+
+    def _verify(self, ref: str, token: str) -> None:
+        try:
+            exp_s, _sig = token.split(".", 1)
+            expires = int(exp_s)
+        except ValueError as e:
+            raise MediaError("malformed upload token") from e
+        if time.time() > expires:
+            raise MediaError("upload grant expired")
+        if not hmac.compare_digest(self._sign(ref, expires), token):
+            raise MediaError("invalid upload token")
+
+    # -- data path ---------------------------------------------------------
+
+    def _path(self, ref: str) -> tuple[str, str]:
+        m = _REF.match(ref)
+        if not m:
+            raise MediaError(f"bad storage ref {ref!r}")
+        d = os.path.join(self.root, m.group("workspace"))
+        return d, os.path.join(d, m.group("id"))
+
+    def put(self, ref: str, token: str, data: bytes) -> None:
+        self._verify(ref, token)
+        if len(data) > MAX_UPLOAD_BYTES:
+            raise MediaError(f"upload exceeds {MAX_UPLOAD_BYTES} bytes")
+        d, path = self._path(ref)
+        os.makedirs(d, exist_ok=True)
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(data)
+        os.replace(tmp, path)
+
+    def resolve(self, ref: str) -> bytes:
+        """storage_ref → bytes (the runtime's provider-call-time hop)."""
+        _d, path = self._path(ref)
+        if not os.path.exists(path):
+            raise MediaError(f"no media at {ref!r}")
+        with open(path, "rb") as f:
+            return f.read()
+
+    def delete_workspace_user_media(self, workspace: str, refs: list[str]) -> int:
+        """DSAR hook: delete the given refs (caller scopes them by user)."""
+        n = 0
+        for ref in refs:
+            try:
+                _d, path = self._path(ref)
+            except MediaError:
+                continue
+            if os.path.exists(path):
+                os.remove(path)
+                n += 1
+        return n

@@ -1,0 +1,121 @@
+"""Deploy API: versioned DeployIntent → applied resource set.
+
+Reference internal/api/deploy/translate.go (cmd/SERVICE.md:17-21): a
+single DeployIntent document (the dashboard's "deploy this agent"
+payload) translates into PromptPack + ToolRegistry + AgentPolicy +
+AgentRuntime resources applied atomically to the resource store. The
+translation is versioned so older dashboards keep working."""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+from typing import Optional
+
+from omnia_tpu.operator.resources import Resource
+from omnia_tpu.operator.validation import ValidationError, validate
+
+logger = logging.getLogger(__name__)
+
+SUPPORTED_VERSIONS = ("v1",)
+
+
+class DeployIntentError(ValueError):
+    pass
+
+
+@dataclasses.dataclass
+class DeployResult:
+    applied: list  # [Resource]
+    agent: str
+    namespace: str
+
+    def to_dict(self) -> dict:
+        return {
+            "agent": self.agent,
+            "namespace": self.namespace,
+            "applied": [f"{r.kind}/{r.name}" for r in self.applied],
+        }
+
+
+def translate(intent: dict) -> list[Resource]:
+    """DeployIntent → resources (not yet applied). Raises
+    DeployIntentError on malformed intents."""
+    version = intent.get("version", "v1")
+    if version not in SUPPORTED_VERSIONS:
+        raise DeployIntentError(f"unsupported intent version {version!r}")
+    name = intent.get("name")
+    if not name:
+        raise DeployIntentError("intent.name required")
+    namespace = intent.get("namespace", "default")
+    pack_content = intent.get("pack")
+    if not pack_content:
+        raise DeployIntentError("intent.pack required")
+
+    out: list[Resource] = []
+    pack_name = f"{name}-pack"
+    out.append(
+        Resource(kind="PromptPack", name=pack_name, namespace=namespace,
+                 spec={"content": pack_content})
+    )
+
+    registry_ref = None
+    if intent.get("tools"):
+        registry_ref = f"{name}-tools"
+        out.append(
+            Resource(kind="ToolRegistry", name=registry_ref, namespace=namespace,
+                     spec={"tools": [_normalize_tool(t) for t in intent["tools"]]})
+        )
+
+    if intent.get("policy"):
+        out.append(
+            Resource(kind="AgentPolicy", name=f"{name}-policy", namespace=namespace,
+                     spec=dict(intent["policy"]))
+        )
+
+    providers = intent.get("providers")
+    if not providers:
+        if not intent.get("provider"):
+            raise DeployIntentError("intent.provider (or providers[]) required")
+        providers = [{"name": "main", "providerRef": intent["provider"]}]
+    agent_spec = {
+        "mode": intent.get("mode", "agent"),
+        "promptPackRef": pack_name,
+        "providers": providers,
+        "facades": intent.get("facades", [{"type": "websocket"}]),
+    }
+    if registry_ref:
+        agent_spec["toolRegistryRef"] = registry_ref
+    for key in ("replicas", "autoscaling", "rollout", "memory", "podOverrides", "context"):
+        if key in intent:
+            agent_spec[key] = intent[key]
+    out.append(
+        Resource(kind="AgentRuntime", name=name, namespace=namespace, spec=agent_spec)
+    )
+    return out
+
+
+def _normalize_tool(t: dict) -> dict:
+    """Accept both the canonical shape ({name, handler: {type, ...}}) and
+    the dashboard's flat shape ({name, type, url, ...})."""
+    if "handler" in t:
+        return dict(t)
+    out = {"name": t.get("name"), "description": t.get("description", "")}
+    handler = {k: v for k, v in t.items() if k not in ("name", "description")}
+    out["handler"] = handler
+    return out
+
+
+def deploy(store, intent: dict) -> DeployResult:
+    """Translate + validate ALL resources, then apply (all-or-nothing on
+    validation — the store apply itself is last so a bad intent never
+    half-lands)."""
+    resources = translate(intent)
+    for res in resources:
+        try:
+            validate(res)
+        except ValidationError as e:
+            raise DeployIntentError(f"{res.kind}/{res.name}: {e}") from e
+    applied = [store.apply(res) for res in resources]
+    agent = next(r for r in applied if r.kind == "AgentRuntime")
+    return DeployResult(applied=applied, agent=agent.name, namespace=agent.namespace)

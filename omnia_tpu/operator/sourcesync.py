@@ -1,0 +1,178 @@
+"""Source sync: pack/arena content → versioned filesystem layout.
+
+Reference internal/sourcesync (git.go, oci.go, configmap.go,
+syncer.go:92 SyncToFilesystem): content from a git repo, a configmap
+payload, or a local directory lands in a versioned directory tree
+
+    <root>/<source>/<version>/...files...
+    <root>/<source>/HEAD            ← current version name
+
+with atomic HEAD flips and garbage collection of old versions
+(syncer.go:216-236 keeps the most recent N). Consumers (pack resolve,
+arena workers) always read through HEAD, so a half-synced version is
+never visible."""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import logging
+import os
+import shutil
+import subprocess
+from typing import Optional
+
+logger = logging.getLogger(__name__)
+
+DEFAULT_KEEP_VERSIONS = 3
+
+
+class SyncError(RuntimeError):
+    pass
+
+
+class Syncer:
+    def __init__(self, root: str, keep_versions: int = DEFAULT_KEEP_VERSIONS):
+        self.root = root
+        self.keep_versions = keep_versions
+        os.makedirs(root, exist_ok=True)
+
+    # -- public ------------------------------------------------------------
+
+    def sync(self, name: str, source: dict) -> str:
+        """Sync one source spec (SkillSource/PromptPackSource shape:
+        {type: git|configmap|local, ...}) → version id now at HEAD."""
+        stype = source.get("type")
+        if stype == "git":
+            return self._sync_git(name, source)
+        if stype == "configmap":
+            return self._sync_payload(name, source.get("data") or {})
+        if stype == "local":
+            return self._sync_local(name, source["path"])
+        raise SyncError(f"unsupported source type {stype!r}")
+
+    def head(self, name: str) -> Optional[str]:
+        path = os.path.join(self.root, name, "HEAD")
+        if not os.path.exists(path):
+            return None
+        with open(path) as f:
+            return f.read().strip()
+
+    def head_dir(self, name: str) -> Optional[str]:
+        version = self.head(name)
+        if version is None:
+            return None
+        return os.path.join(self.root, name, version)
+
+    def read(self, name: str, rel_path: str) -> bytes:
+        d = self.head_dir(name)
+        if d is None:
+            raise SyncError(f"source {name!r} never synced")
+        full = os.path.realpath(os.path.join(d, rel_path))
+        if not full.startswith(os.path.realpath(d) + os.sep):
+            raise SyncError("path escapes source root")
+        with open(full, "rb") as f:
+            return f.read()
+
+    def versions(self, name: str) -> list[str]:
+        base = os.path.join(self.root, name)
+        if not os.path.isdir(base):
+            return []
+        return sorted(
+            v for v in os.listdir(base)
+            if v != "HEAD" and os.path.isdir(os.path.join(base, v))
+        )
+
+    # -- backends ----------------------------------------------------------
+
+    def _sync_git(self, name: str, source: dict) -> str:
+        url = source.get("repo") or source.get("url")
+        if not url:
+            raise SyncError("git source requires repo url")
+        ref = source.get("ref", "HEAD")
+        tmp = os.path.join(self.root, name, ".clone.tmp")
+        shutil.rmtree(tmp, ignore_errors=True)
+        try:
+            subprocess.run(
+                ["git", "clone", "--quiet", "--depth", "1",
+                 *(["--branch", ref] if ref != "HEAD" else []), url, tmp],
+                check=True, capture_output=True, timeout=120,
+            )
+            rev = subprocess.run(
+                ["git", "-C", tmp, "rev-parse", "--short=12", "HEAD"],
+                check=True, capture_output=True, text=True, timeout=30,
+            ).stdout.strip()
+            shutil.rmtree(os.path.join(tmp, ".git"), ignore_errors=True)
+            subdir = source.get("path", "")
+            src_dir = os.path.join(tmp, subdir) if subdir else tmp
+            if not os.path.isdir(src_dir):
+                raise SyncError(f"git source path {subdir!r} not found")
+            return self._install(name, f"git-{rev}", src_dir)
+        except subprocess.CalledProcessError as e:
+            raise SyncError(f"git sync failed: {e.stderr}") from e
+        finally:
+            shutil.rmtree(tmp, ignore_errors=True)
+
+    def _sync_payload(self, name: str, data: dict) -> str:
+        """Configmap-style payload: {filename: text-or-json}."""
+        digest = hashlib.sha256(
+            json.dumps(data, sort_keys=True).encode()
+        ).hexdigest()[:12]
+        version = f"cm-{digest}"
+        if self.head(name) == version:
+            return version  # idempotent re-sync
+        staging = os.path.join(self.root, name, f".{version}.tmp")
+        shutil.rmtree(staging, ignore_errors=True)
+        os.makedirs(staging)
+        for fname, content in data.items():
+            if "/" in fname or fname.startswith("."):
+                raise SyncError(f"bad payload filename {fname!r}")
+            text = content if isinstance(content, str) else json.dumps(content)
+            with open(os.path.join(staging, fname), "w") as f:
+                f.write(text)
+        return self._install(name, version, staging, move=True)
+
+    def _sync_local(self, name: str, path: str) -> str:
+        if not os.path.isdir(path):
+            raise SyncError(f"local source {path!r} not a directory")
+        h = hashlib.sha256()
+        for dirpath, _dirs, files in sorted(os.walk(path)):
+            for fname in sorted(files):
+                fp = os.path.join(dirpath, fname)
+                h.update(fname.encode())
+                with open(fp, "rb") as f:
+                    h.update(f.read())
+        return self._install(name, f"local-{h.hexdigest()[:12]}", path)
+
+    # -- install / GC ------------------------------------------------------
+
+    def _install(self, name: str, version: str, src_dir: str, move: bool = False) -> str:
+        base = os.path.join(self.root, name)
+        os.makedirs(base, exist_ok=True)
+        dest = os.path.join(base, version)
+        if not os.path.isdir(dest):
+            staging = dest + ".installing"
+            shutil.rmtree(staging, ignore_errors=True)
+            if move:
+                os.rename(src_dir, staging)
+            else:
+                shutil.copytree(src_dir, staging)
+            os.rename(staging, dest)  # version dirs appear atomically
+        head_tmp = os.path.join(base, "HEAD.tmp")
+        with open(head_tmp, "w") as f:
+            f.write(version)
+        os.replace(head_tmp, os.path.join(base, "HEAD"))  # atomic flip
+        self._gc(name, keep=version)
+        logger.info("synced %s → %s", name, version)
+        return version
+
+    def _gc(self, name: str, keep: str) -> None:
+        base = os.path.join(self.root, name)
+        versions = [
+            (os.path.getmtime(os.path.join(base, v)), v)
+            for v in self.versions(name)
+            if v != keep
+        ]
+        versions.sort(reverse=True)
+        for _mtime, v in versions[self.keep_versions - 1:]:
+            shutil.rmtree(os.path.join(base, v), ignore_errors=True)

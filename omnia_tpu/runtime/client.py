@@ -99,6 +99,14 @@ class ConverseStream:
     def send_tool_results(self, results: list[c.ToolResult]) -> None:
         self.send(c.ClientMessage(type="tool_results", tool_results=results))
 
+    def send_cancel(self) -> None:
+        """Protocol-level turn cancel: the runtime's stream reader routes
+        this to conv.cancel_turn(), which interrupts an in-flight decode
+        AND unblocks a client-tool wait — unlike cancel(), which only
+        tears down the RPC client-side and leaves the server handler
+        blocked until its own timeout."""
+        self.send(c.ClientMessage(type="cancel"))
+
     def close(self) -> None:
         self._outbox.put(None)
 

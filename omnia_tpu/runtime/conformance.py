@@ -1,0 +1,199 @@
+"""Runtime conformance suite: contract checks for ANY runtime target.
+
+Reference pkg/runtime/conformance/checks.go + cmd/runtime-conformance:
+a third-party runtime is valid if it passes these black-box checks over
+the omnia.runtime.v1 contract. Checks: hello frame (contract version +
+capabilities), turn streaming (chunks then done with usage), resume
+probe tri-state, session history across streams, function invoke
+validation codes, and identity pinning. Run against any host:port —
+in-tree or third-party."""
+
+from __future__ import annotations
+
+import dataclasses
+import uuid
+from typing import Callable, Optional
+
+from omnia_tpu.runtime import contract as c
+from omnia_tpu.runtime.client import RuntimeClient
+
+
+@dataclasses.dataclass
+class ConformanceResult:
+    name: str
+    passed: bool
+    detail: str = ""
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+class ConformanceSuite:
+    """`probe_text` must be a prompt the runtime will answer with at
+    least one chunk (for mock-backed runtimes, any scenario hit)."""
+
+    def __init__(self, target: str, probe_text: str = "hello"):
+        self.target = target
+        self.probe_text = probe_text
+
+    def run(self, checks: Optional[list[str]] = None) -> list[ConformanceResult]:
+        all_checks: list[tuple[str, Callable[[], Optional[str]]]] = [
+            ("health_contract", self.check_health_contract),
+            ("hello_frame", self.check_hello_frame),
+            ("turn_streaming", self.check_turn_streaming),
+            ("resume_tristate", self.check_resume_tristate),
+            ("history_resume", self.check_history_resume),
+            ("invoke_validation", self.check_invoke_validation),
+            ("identity_pinning", self.check_identity_pinning),
+        ]
+        results = []
+        for name, fn in all_checks:
+            if checks and name not in checks:
+                continue
+            try:
+                err = fn()
+            except Exception as e:  # noqa: BLE001
+                err = f"raised {type(e).__name__}: {e}"
+            results.append(ConformanceResult(name, err is None, err or ""))
+        return results
+
+    # -- checks ------------------------------------------------------------
+
+    def _client(self) -> RuntimeClient:
+        return RuntimeClient(self.target)
+
+    def check_health_contract(self) -> Optional[str]:
+        client = self._client()
+        try:
+            h = client.health()
+            if not h.contract_version:
+                return "health carries no contract_version"
+            if h.contract_version.split(".")[0] != c.CONTRACT_VERSION.split(".")[0]:
+                return (f"major contract mismatch: {h.contract_version} "
+                        f"vs {c.CONTRACT_VERSION}")
+            if not h.capabilities:
+                return "no capabilities advertised"
+            return None
+        finally:
+            client.close()
+
+    def check_hello_frame(self) -> Optional[str]:
+        client = self._client()
+        try:
+            stream = client.open_stream(f"conf-{uuid.uuid4().hex[:8]}")
+            list(stream.turn(self.probe_text))
+            hello = stream.hello  # the client captures the leading frame
+            stream.close()
+            if hello is None:
+                return "stream opened without a hello frame"
+            if not hello.contract_version:
+                return "hello carries no contract_version"
+            if not hello.capabilities:
+                return "hello carries no capabilities"
+            return None
+        finally:
+            client.close()
+
+    def check_turn_streaming(self) -> Optional[str]:
+        client = self._client()
+        try:
+            stream = client.open_stream(f"conf-{uuid.uuid4().hex[:8]}")
+            saw_chunk = saw_done = False
+            for m in stream.turn(self.probe_text):
+                if m.type == "chunk":
+                    if saw_done:
+                        return "chunk after done"
+                    saw_chunk = True
+                elif m.type == "done":
+                    saw_done = True
+                    if m.usage is None or m.usage.completion_tokens <= 0:
+                        return "done missing usage.completion_tokens"
+                elif m.type == "error":
+                    return f"turn errored: {m.error_code}"
+            stream.close()
+            if not saw_chunk:
+                return "no chunks streamed"
+            if not saw_done:
+                return "no done frame"
+            return None
+        finally:
+            client.close()
+
+    def check_resume_tristate(self) -> Optional[str]:
+        client = self._client()
+        try:
+            state = client.has_conversation(f"never-{uuid.uuid4().hex}")
+            if state != c.ResumeState.NOT_FOUND:
+                return f"unknown session must be not_found, got {state}"
+            sid = f"conf-{uuid.uuid4().hex[:8]}"
+            stream = client.open_stream(sid)
+            list(stream.turn(self.probe_text))
+            stream.close()
+            state = client.has_conversation(sid)
+            if state != c.ResumeState.ACTIVE:
+                return f"live session must be active, got {state}"
+            return None
+        finally:
+            client.close()
+
+    def check_history_resume(self) -> Optional[str]:
+        client = self._client()
+        try:
+            sid = f"conf-{uuid.uuid4().hex[:8]}"
+            s1 = client.open_stream(sid)
+            first = "".join(m.text for m in s1.turn(self.probe_text) if m.type == "chunk")
+            s1.close()
+            s2 = client.open_stream(sid)
+            msgs = list(s2.turn(self.probe_text))
+            s2.close()
+            if msgs[-1].type != "done":
+                return "resumed session turn did not complete"
+            return None if first is not None else "no first reply"
+        finally:
+            client.close()
+
+    def check_invoke_validation(self) -> Optional[str]:
+        client = self._client()
+        try:
+            resp = client.invoke(f"ghost-{uuid.uuid4().hex[:6]}", {})
+            if resp.error_code != "not_found":
+                return f"unknown function must be not_found, got {resp.error_code!r}"
+            return None
+        finally:
+            client.close()
+
+    def check_identity_pinning(self) -> Optional[str]:
+        client = self._client()
+        try:
+            sid = f"conf-{uuid.uuid4().hex[:8]}"
+            s1 = client.open_stream(sid, user_id="conf-alice")
+            list(s1.turn(self.probe_text))
+            s1.close()
+            s2 = client.open_stream(sid, user_id="conf-mallory")
+            msgs = list(s2.turn(self.probe_text))
+            s2.close()
+            if msgs and msgs[-1].type == "error":
+                return None  # rejected foreign identity — conformant
+            return "session accepted a different identity (no pinning)"
+        finally:
+            client.close()
+
+
+def main(argv: Optional[list[str]] = None) -> int:
+    """CLI: python -m omnia_tpu.runtime.conformance host:port [probe]"""
+    import json
+    import sys
+
+    args = argv if argv is not None else sys.argv[1:]
+    if not args:
+        print("usage: conformance <host:port> [probe-text]", file=sys.stderr)
+        return 2
+    suite = ConformanceSuite(args[0], probe_text=args[1] if len(args) > 1 else "hello")
+    results = suite.run()
+    for r in results:
+        print(json.dumps(r.to_dict()))
+    return 0 if all(r.passed for r in results) else 1
+
+
+if __name__ == "__main__":  # pragma: no cover
+    raise SystemExit(main())

@@ -210,8 +210,13 @@ class Conversation:
 
     # ------------------------------------------------------------------
 
-    def stream(self, msg: ClientMessage) -> Iterator[ServerMessage]:
-        """Process one turn; yields chunk/tool_call/done/error messages."""
+    def stream(
+        self, msg: ClientMessage, traceparent: Optional[str] = None
+    ) -> Iterator[ServerMessage]:
+        """Process one turn; yields chunk/tool_call/done/error messages.
+        `traceparent` is per-call (each stream carries its own remote
+        context; a shared per-conversation field would be clobbered by
+        concurrent streams on the same session)."""
         with self._turn_lock:
             if self.tracer is None:
                 yield from self._stream_locked(msg)
@@ -223,7 +228,7 @@ class Conversation:
 
             with self.tracer.start_span(
                 tr.SPAN_CONVERSATION,
-                traceparent=self.traceparent,
+                traceparent=traceparent or self.traceparent,
                 attrs={"session.id": self.session_id, "turn.index": self._turn_index},
             ) as span:
                 for m in self._stream_locked(msg):
@@ -291,6 +296,7 @@ class Conversation:
 
             submit_t = time.monotonic()
             first_token_t: Optional[float] = None
+            round_base_tokens = usage.completion_tokens
             llm_span = None
             if self.tracer is not None:
                 from omnia_tpu.utils import tracing as tr
@@ -298,7 +304,13 @@ class Conversation:
                 llm_span = self.tracer.start_span(
                     tr.SPAN_LLM, attrs={"llm.prompt_tokens": len(prompt_ids)}
                 )
-            handle = self.engine.submit(prompt_ids, sp)
+            try:
+                handle = self.engine.submit(prompt_ids, sp)
+            except Exception:
+                if llm_span is not None:
+                    llm_span.status = "error"
+                    llm_span.end()
+                raise
             self._active_handle = handle
             # Close the submit→publish window: a cancel_turn racing here saw
             # _active_handle=None and only set the flag.
@@ -311,7 +323,8 @@ class Conversation:
             error: Optional[StreamError] = None
             cancelled = False
 
-            while True:
+            try:
+              while True:
                 try:
                     ev = handle.get_event(timeout=max(0.1, deadline - time.monotonic()))
                 except queue.Empty:
@@ -345,17 +358,20 @@ class Conversation:
                     handle.cancel()
                     error = StreamError("timeout", "turn exceeded execution timeout")
                     break
-            self._active_handle = None
-            if llm_span is not None:
-                llm_span.add_llm_metrics(
-                    len(prompt_ids),
-                    usage.completion_tokens,
-                    ttft_s=(first_token_t - submit_t) if first_token_t else None,
-                )
-                if error is not None:
-                    llm_span.status = "error"
-                    llm_span.set_attr("error.code", error.code)
-                llm_span.end()
+            finally:
+                self._active_handle = None
+                if llm_span is not None:
+                    # Per-ROUND token count: usage.completion_tokens is the
+                    # turn-cumulative accumulator.
+                    llm_span.add_llm_metrics(
+                        len(prompt_ids),
+                        usage.completion_tokens - round_base_tokens,
+                        ttft_s=(first_token_t - submit_t) if first_token_t else None,
+                    )
+                    if error is not None:
+                        llm_span.status = "error"
+                        llm_span.set_attr("error.code", error.code)
+                    llm_span.end()
 
             if error is not None:
                 yield ServerMessage(type="error", error_code=error.code, error_message=error.message)

@@ -156,8 +156,9 @@ class RuntimeServer:
             return
 
         # Remote trace context (facade's otel-style interceptor analog):
-        # the whole stream's turns parent under the caller's trace.
-        conv.traceparent = md.get("traceparent")
+        # per-stream, passed per-turn — never stored on the shared
+        # Conversation where a concurrent stream would clobber it.
+        traceparent = md.get("traceparent")
 
         yield c.ServerMessage(
             type="hello",
@@ -188,7 +189,7 @@ class RuntimeServer:
             if m is None:
                 return
             try:
-                yield from conv.stream(m)
+                yield from conv.stream(m, traceparent=traceparent)
             except Exception as e:  # turn must not kill the stream silently
                 logger.exception("turn failed")
                 yield c.ServerMessage(

@@ -1,0 +1,56 @@
+"""Service discovery: resolve workspace data-plane endpoints.
+
+Reference pkg/servicediscovery: the facade/runtime resolve their
+session-api and memory-api endpoints from the Workspace resource's
+service groups (workspace_types.go services[]), falling back to
+install-wide defaults. An agent names its group via
+spec.serviceGroup."""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+
+@dataclasses.dataclass(frozen=True)
+class Endpoints:
+    session_api: str = ""
+    memory_api: str = ""
+    privacy_api: str = ""
+
+    def merged_over(self, base: "Endpoints") -> "Endpoints":
+        return Endpoints(
+            session_api=self.session_api or base.session_api,
+            memory_api=self.memory_api or base.memory_api,
+            privacy_api=self.privacy_api or base.privacy_api,
+        )
+
+
+class ServiceDiscovery:
+    def __init__(self, store, defaults: Optional[Endpoints] = None):
+        self.store = store
+        self.defaults = defaults or Endpoints()
+
+    def resolve(self, namespace: str, workspace: str,
+                service_group: str = "") -> Endpoints:
+        """Workspace service-group endpoints merged over defaults. An
+        unknown workspace or group resolves to the defaults (an agent
+        without data services still runs; recording just no-ops)."""
+        res = self.store.get(namespace, "Workspace", workspace)
+        if res is None:
+            return self.defaults
+        groups = res.spec.get("services") or []
+        chosen = None
+        for g in groups:
+            if g.get("name") == service_group:
+                chosen = g
+                break
+        if chosen is None and groups and not service_group:
+            chosen = groups[0]  # unnamed → the workspace's default group
+        if chosen is None:
+            return self.defaults
+        return Endpoints(
+            session_api=chosen.get("sessionApi", ""),
+            memory_api=chosen.get("memoryApi", ""),
+            privacy_api=chosen.get("privacyApi", ""),
+        ).merged_over(self.defaults)

@@ -126,12 +126,13 @@ class Span:
         }
 
 
-def parse_traceparent(header: str) -> Optional[tuple[str, str]]:
-    """→ (trace_id, parent_span_id) or None."""
+def parse_traceparent(header: str) -> Optional[tuple[str, str, bool]]:
+    """→ (trace_id, parent_span_id, sampled) or None."""
     try:
-        version, trace_id, span_id, _flags = header.split("-")
+        version, trace_id, span_id, flags = header.split("-")
         if len(trace_id) == 32 and len(span_id) == 16 and version == "00":
-            return trace_id, span_id
+            sampled = bool(int(flags, 16) & 0x01)
+            return trace_id, span_id, sampled
     except ValueError:
         pass
     return None
@@ -149,6 +150,10 @@ class Tracer:
         self.finished: "deque[Span]" = deque(maxlen=ring_size)
         self._rng = random.Random(seed)
         self._lock = threading.Lock()
+        # Separate I/O lock + persistent handle: span-ending threads must
+        # never serialize on per-span open/write/close of the export file.
+        self._io_lock = threading.Lock()
+        self._export_file = None
 
     def start_span(self, name: str, parent: Optional[Span] = None,
                    traceparent: Optional[str] = None,
@@ -167,7 +172,12 @@ class Tracer:
         elif traceparent:
             parsed = parse_traceparent(traceparent)
             if parsed:
-                trace_id, parent_id = parsed
+                trace_id, parent_id, sampled = parsed
+                if not sampled:
+                    # Parent-based sampling: honor the remote decision —
+                    # an explicitly-unsampled parent (flags 00) must not
+                    # be resurrected here.
+                    return _NoopSpan(self)
         if trace_id is None:
             if self._rng.random() >= self.sample_rate:
                 return _NoopSpan(self)
@@ -179,12 +189,16 @@ class Tracer:
     def _export(self, span: Span) -> None:
         with self._lock:
             self.finished.append(span)
-            if self.export_path:
-                try:
-                    with open(self.export_path, "a") as f:
-                        f.write(json.dumps(span.to_dict()) + "\n")
-                except OSError:  # pragma: no cover — tracing never breaks serving
-                    pass
+        if self.export_path:
+            line = json.dumps(span.to_dict()) + "\n"
+            try:
+                with self._io_lock:
+                    if self._export_file is None:
+                        self._export_file = open(self.export_path, "a")
+                    self._export_file.write(line)
+                    self._export_file.flush()
+            except OSError:  # pragma: no cover — tracing never breaks serving
+                pass
 
     def spans(self, name: Optional[str] = None) -> list[Span]:
         with self._lock:

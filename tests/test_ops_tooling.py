@@ -1,0 +1,245 @@
+"""Ops tooling tests: deploy-intent translate, sourcesync, doctor,
+media storage, conformance suite, service discovery."""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+import pytest
+
+from omnia_tpu.operator.deploy import DeployIntentError, deploy, translate
+from omnia_tpu.operator.resources import Resource
+from omnia_tpu.operator.sourcesync import SyncError, Syncer
+from omnia_tpu.operator.store import MemoryResourceStore
+
+
+INTENT = {
+    "version": "v1",
+    "name": "support-bot",
+    "namespace": "team-a",
+    "mode": "agent",
+    "provider": "main",
+    "pack": {"name": "support", "version": "1.0.0",
+             "prompts": {"system": "You help."}},
+    "tools": [{"name": "kb_search", "type": "http", "url": "http://kb/search"}],
+    "policy": {"tools": ["kb_search"], "rules": [{"action": "allow"}]},
+    "facades": [{"type": "websocket"}, {"type": "rest"}],
+}
+
+
+class TestDeployIntent:
+    def test_translate_produces_resource_set(self):
+        resources = translate(INTENT)
+        kinds = [r.kind for r in resources]
+        assert kinds == ["PromptPack", "ToolRegistry", "AgentPolicy", "AgentRuntime"]
+        agent = resources[-1]
+        assert agent.spec["promptPackRef"] == "support-bot-pack"
+        assert agent.spec["toolRegistryRef"] == "support-bot-tools"
+        assert all(r.namespace == "team-a" for r in resources)
+
+    def test_deploy_applies_all(self):
+        store = MemoryResourceStore()
+        result = deploy(store, INTENT)
+        assert result.agent == "support-bot"
+        assert len(store.list(namespace="team-a")) == 4
+        assert "AgentRuntime/support-bot" in result.to_dict()["applied"]
+
+    def test_invalid_intent_applies_nothing(self):
+        store = MemoryResourceStore()
+        bad = dict(INTENT, facades=[{"type": "carrier-pigeon"}])
+        with pytest.raises(DeployIntentError):
+            deploy(store, bad)
+        assert store.list() == []  # nothing half-landed
+
+    def test_unsupported_version_rejected(self):
+        with pytest.raises(DeployIntentError, match="version"):
+            translate(dict(INTENT, version="v99"))
+
+
+class TestSourceSync:
+    def test_configmap_payload_sync_and_idempotency(self, tmp_path):
+        s = Syncer(str(tmp_path))
+        v1 = s.sync("packs", {"type": "configmap",
+                              "data": {"pack.json": {"name": "a", "version": "1.0.0"}}})
+        assert s.head("packs") == v1
+        assert json.loads(s.read("packs", "pack.json"))["name"] == "a"
+        # same payload → same version, no new dir
+        assert s.sync("packs", {"type": "configmap",
+                                "data": {"pack.json": {"name": "a", "version": "1.0.0"}}}) == v1
+        assert len(s.versions("packs")) == 1
+        # changed payload → new version, HEAD flips
+        v2 = s.sync("packs", {"type": "configmap",
+                              "data": {"pack.json": {"name": "a", "version": "2.0.0"}}})
+        assert v2 != v1 and s.head("packs") == v2
+        assert json.loads(s.read("packs", "pack.json"))["version"] == "2.0.0"
+
+    def test_gc_keeps_recent_versions(self, tmp_path):
+        s = Syncer(str(tmp_path), keep_versions=2)
+        for i in range(5):
+            s.sync("src", {"type": "configmap", "data": {"f": f"v{i}"}})
+            time.sleep(0.01)
+        assert len(s.versions("src")) <= 2
+        assert s.read("src", "f") == b"v4"  # HEAD is newest
+
+    def test_local_dir_sync(self, tmp_path):
+        src = tmp_path / "content"
+        src.mkdir()
+        (src / "skill.md").write_text("do the thing")
+        s = Syncer(str(tmp_path / "root"))
+        v = s.sync("skills", {"type": "local", "path": str(src)})
+        assert v.startswith("local-")
+        assert s.read("skills", "skill.md") == b"do the thing"
+
+    def test_path_escape_blocked(self, tmp_path):
+        s = Syncer(str(tmp_path))
+        s.sync("x", {"type": "configmap", "data": {"f": "v"}})
+        with pytest.raises(SyncError, match="escapes"):
+            s.read("x", "../../etc/passwd")
+
+    def test_bad_source_type(self, tmp_path):
+        with pytest.raises(SyncError):
+            Syncer(str(tmp_path)).sync("x", {"type": "carrier-pigeon"})
+
+
+class TestMedia:
+    def test_negotiate_upload_resolve(self, tmp_path):
+        from omnia_tpu.media import LocalMediaStore, MediaError
+
+        store = LocalMediaStore(str(tmp_path))
+        grant = store.negotiate_upload("ws1")
+        assert grant.storage_ref.startswith("media://ws1/")
+        store.put(grant.storage_ref, grant.token, b"image-bytes")
+        assert store.resolve(grant.storage_ref) == b"image-bytes"
+        # wrong token rejected
+        with pytest.raises(MediaError, match="invalid"):
+            store.put(grant.storage_ref, "9999999999.deadbeef", b"x")
+        # expired grant rejected
+        store.grant_ttl_s = -1
+        expired = store.negotiate_upload("ws1")
+        with pytest.raises(MediaError, match="expired"):
+            store.put(expired.storage_ref, expired.token, b"x")
+
+    def test_dsar_media_deletion(self, tmp_path):
+        from omnia_tpu.media import LocalMediaStore
+
+        store = LocalMediaStore(str(tmp_path))
+        g = store.negotiate_upload("ws1")
+        store.put(g.storage_ref, g.token, b"pic")
+        assert store.delete_workspace_user_media("ws1", [g.storage_ref]) == 1
+        assert store.delete_workspace_user_media("ws1", [g.storage_ref]) == 0
+
+
+class TestDiscovery:
+    def test_workspace_group_resolution(self):
+        from omnia_tpu.utils.discovery import Endpoints, ServiceDiscovery
+
+        store = MemoryResourceStore()
+        store.apply(Resource(kind="Workspace", name="team-a", namespace="default", spec={
+            "environment": "dev",
+            "services": [
+                {"name": "default", "sessionApi": "http://sess-a:8080"},
+                {"name": "heavy", "sessionApi": "http://sess-heavy:8080",
+                 "memoryApi": "http://mem-heavy:8080"},
+            ]}))
+        disco = ServiceDiscovery(store, defaults=Endpoints(
+            session_api="http://sess-default", memory_api="http://mem-default"))
+        e = disco.resolve("default", "team-a", "heavy")
+        assert e.session_api == "http://sess-heavy:8080"
+        assert e.memory_api == "http://mem-heavy:8080"
+        # group without memoryApi merges over defaults
+        e = disco.resolve("default", "team-a", "default")
+        assert e.session_api == "http://sess-a:8080"
+        assert e.memory_api == "http://mem-default"
+        # unknown workspace → defaults
+        assert disco.resolve("default", "ghost").session_api == "http://sess-default"
+
+
+@pytest.fixture(scope="module")
+def live_runtime():
+    from omnia_tpu.runtime.packs import load_pack
+    from omnia_tpu.runtime.providers import ProviderRegistry, ProviderSpec
+    from omnia_tpu.runtime.server import RuntimeServer
+
+    reg = ProviderRegistry()
+    reg.register(ProviderSpec(name="m", type="mock",
+                              options={"scenarios": [{"pattern": ".", "reply": "pong"}]}))
+    rt = RuntimeServer(
+        pack=load_pack({"name": "t", "version": "1.0.0", "prompts": {"system": "s"},
+                        "sampling": {"max_tokens": 64}}),
+        providers=reg, provider_name="m",
+    )
+    port = rt.serve("localhost:0")
+    yield rt, f"localhost:{port}"
+    rt.shutdown()
+
+
+class TestConformance:
+    def test_in_tree_runtime_is_conformant(self, live_runtime):
+        from omnia_tpu.runtime.conformance import ConformanceSuite
+
+        _rt, target = live_runtime
+        results = ConformanceSuite(target, probe_text="ping").run()
+        failed = [r.to_dict() for r in results if not r.passed]
+        assert not failed, failed
+        assert len(results) == 7
+
+    def test_cli_entrypoint(self, live_runtime, capsys):
+        from omnia_tpu.runtime.conformance import main
+
+        _rt, target = live_runtime
+        rc = main([target, "ping"])
+        assert rc == 0
+        out = capsys.readouterr().out.strip().splitlines()
+        assert len(out) == 7
+        assert all(json.loads(l)["passed"] for l in out)
+
+
+class TestDoctor:
+    def test_report_aggregation(self, live_runtime):
+        from omnia_tpu.doctor import Doctor
+        from omnia_tpu.streams import Stream
+
+        _rt, target = live_runtime
+        store = MemoryResourceStore()
+        deploy(store, INTENT)  # valid AgentRuntime + PromptPack + friends
+        store.apply(Resource(kind="Provider", name="p", namespace="team-a",
+                             spec={"type": "mock"}))
+        doc = Doctor()
+        doc.add_store_check(store)
+        doc.add_runtime_check(target)
+        doc.add_streams_check(Stream())
+        doc.add_http_check("session-api", "http://localhost:1/healthz")  # down
+        report = doc.run()
+        by_name = {c["name"]: c for c in report["checks"]}
+        assert by_name["resources"]["status"] == "pass"
+        assert by_name["runtime"]["status"] == "pass"
+        assert by_name["streams"]["status"] == "pass"
+        assert by_name["session-api"]["status"] == "fail"
+        assert "running" in by_name["session-api"]["remedy"]
+        assert report["status"] == "fail"  # worst wins
+
+    def test_facade_ws_probe(self, live_runtime):
+        from omnia_tpu.doctor import Doctor
+        from omnia_tpu.facade.server import FacadeServer
+
+        _rt, target = live_runtime
+        facade = FacadeServer(runtime_target=target, agent_name="doc-agent")
+        fport = facade.serve()
+        try:
+            doc = Doctor()
+            doc.add_facade_ws_check(f"ws://localhost:{fport}/ws")
+            report = doc.run()
+            assert report["checks"][0]["status"] == "pass", report
+        finally:
+            facade.shutdown()
+
+    def test_crashing_check_is_fail_not_crash(self):
+        from omnia_tpu.doctor import Doctor
+
+        doc = Doctor()
+        doc.register("boom", lambda: 1 / 0)
+        report = doc.run()
+        assert report["checks"][0]["status"] == "fail"
+        assert "division" in report["checks"][0]["detail"]

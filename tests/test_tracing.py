@@ -25,7 +25,7 @@ class TestTracer:
         span = t.start_span("root")
         header = span.traceparent()
         parsed = tr.parse_traceparent(header)
-        assert parsed == (span.trace_id, span.span_id)
+        assert parsed == (span.trace_id, span.span_id, True)
         t2 = tr.Tracer("b")
         remote = t2.start_span("remote-child", traceparent=header)
         assert remote.trace_id == span.trace_id
@@ -144,3 +144,12 @@ class TestSamplingPropagation:
                 with t.start_span("grandchild"):
                     pass
         assert t.spans() == []  # nothing leaks under the zero trace id
+
+    def test_unsampled_remote_parent_honored(self):
+        t = tr.Tracer("svc", sample_rate=1.0)
+        root = t.start_span("root")
+        unsampled = root.traceparent()[:-2] + "00"  # flags 00
+        with t.start_span("remote-child", traceparent=unsampled):
+            pass
+        assert not t.spans("remote-child")
+        root.end()
