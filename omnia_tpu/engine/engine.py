@@ -205,22 +205,42 @@ class InferenceEngine:
 
         self._insert_fn = jax.jit(insert, donate_argnums=(0, 1))
 
-        def decode(params, ck, cv, tokens, positions, key_data, temp, top_p, top_k):
-            logits, ck, cv = llama.forward(
-                params,
-                cfg,
-                tokens[:, None],
-                positions[:, None],
-                ck,
-                cv,
-                positions,
-            )
-            tok, new_kd = sample_tokens_per_slot(
-                logits[:, 0], key_data, temp, top_p, top_k
-            )
-            return ck, cv, tok, new_kd
+        max_seq = self.cfg.max_seq
 
-        self._decode_fn = jax.jit(decode, donate_argnums=(1, 2))
+        def make_decode(chunk: int):
+            def decode_chunk(params, ck, cv, tokens, positions, active, key_data, temp, top_p, top_k):
+                """`chunk` decode steps in ONE compiled program (lax.scan):
+                one host↔device round trip per K tokens instead of per
+                token. Inactive slots' positions stay frozen (they re-write
+                row 0, which the next prefill's insert overwrites)."""
+
+                def body(carry, _):
+                    ck, cv, tokens, positions, key_data = carry
+                    logits, ck, cv = llama.forward(
+                        params, cfg, tokens[:, None], positions[:, None], ck, cv, positions
+                    )
+                    tok, key_data = sample_tokens_per_slot(
+                        logits[:, 0], key_data, temp, top_p, top_k
+                    )
+                    positions = jnp.where(
+                        active, jnp.minimum(positions + 1, max_seq - 1), positions
+                    )
+                    return (ck, cv, tok, positions, key_data), tok
+
+                (ck, cv, tokens, positions, key_data), toks = jax.lax.scan(
+                    body, (ck, cv, tokens, positions, key_data), None, length=chunk
+                )
+                return ck, cv, tokens, positions, key_data, toks  # toks [K, B]
+
+            return jax.jit(decode_chunk, donate_argnums=(1, 2))
+
+        # Two compiled variants: the big chunk for steady-state throughput,
+        # a single step while requests are queued so a waiting prefill never
+        # sits out a long chunk (TTFT discipline).
+        self._decode_fn = make_decode(max(1, self.cfg.decode_chunk))
+        self._decode_fn_single = (
+            make_decode(1) if self.cfg.decode_chunk > 1 else self._decode_fn
+        )
 
     def warmup(self):
         """AOT-compile decode + all usable prefill buckets (called before
@@ -229,6 +249,8 @@ class InferenceEngine:
         t0 = time.monotonic()
         metrics_before = dict(self.metrics)
         self._run_decode_step()
+        if self._decode_fn_single is not self._decode_fn:
+            self._run_decode_step(single=True)
         for b in self.cfg.usable_buckets():
             toks = jnp.zeros((1, b), jnp.int32)
             pos = jnp.arange(b, dtype=jnp.int32)[None, :]
@@ -404,36 +426,47 @@ class InferenceEngine:
 
         self._emit_token(slot_idx, int(first_tok))
 
-    def _run_decode_step(self):
-        self._ck, self._cv, self._tokens, self._key_data = self._decode_fn(
-            self.params,
+    def _run_decode_step(self, single: bool = False):
+        """One chunked decode dispatch → host tokens [K, B]. Position
+        advancement happens on-device inside the scan (active slots only).
+        `single` picks the 1-step variant (used while work is queued so a
+        waiting prefill doesn't sit out a full chunk)."""
+        fn = self._decode_fn_single if single else self._decode_fn
+        (
             self._ck,
             self._cv,
             self._tokens,
             self._positions,
             self._key_data,
+            toks,
+        ) = fn(
+            self.params,
+            self._ck,
+            self._cv,
+            self._tokens,
+            self._positions,
+            self._active,
+            self._key_data,
             self._temp,
             self._top_p,
             self._top_k,
         )
-        # Only active slots advance; a finished slot stays parked writing
-        # row 0 until the next prefill claims it (so idle slots can never
-        # scribble garbage into rows a future request won't overwrite).
-        self._positions = jnp.where(
-            self._active,
-            jnp.minimum(self._positions + 1, self.cfg.max_seq - 1),
-            self._positions,
-        )
-        self.metrics["decode_steps"] += 1
+        self.metrics["decode_steps"] += int(toks.shape[0])
+        return toks
 
     def _do_decode(self):
         active = [i for i, s in enumerate(self._slots) if s.active]
-        self._run_decode_step()
-        host_tokens = np.asarray(self._tokens)
-        for i in active:
-            slot = self._slots[i]
-            slot.length += 1
-            self._emit_token(i, int(host_tokens[i]))
+        with self._lock:
+            queued = bool(self._waiting)
+        toks = self._run_decode_step(single=queued)
+        host_tokens = np.asarray(toks)  # [K, B] — ONE sync per chunk
+        for k in range(host_tokens.shape[0]):
+            for i in active:
+                slot = self._slots[i]
+                if not slot.active:
+                    continue  # finished earlier in this chunk; rest is garbage
+                slot.length += 1
+                self._emit_token(i, int(host_tokens[k, i]))
 
     def _emit_token(self, slot_idx: int, token: int):
         slot = self._slots[slot_idx]

@@ -121,6 +121,13 @@ class EngineConfig:
     # Mesh shape; dp divides num_slots, tp divides num_kv_heads.
     dp: int = 1
     tp: int = 1
+    # Decode steps per device dispatch (lax.scan inside one compiled
+    # program). Each dispatch costs a host↔device round trip — ruinous
+    # through a tunnel/remote device — so K tokens per sync amortizes it.
+    # Trade-offs: streaming granularity becomes K tokens, a queued prefill
+    # waits up to one chunk, and a slot finishing mid-chunk wastes ≤K-1
+    # slot-steps. 1 = per-token sync.
+    decode_chunk: int = 8
 
     def usable_buckets(self) -> tuple[int, ...]:
         """Prefill buckets that fit the KV cache (a bucket's chunk is

@@ -249,12 +249,26 @@ class FacadeServer:
                 # Normal idle expiry — clean close, not an internal error.
                 ws.close(1000, "idle timeout")
                 return
+            if isinstance(raw, bytes):
+                # Binary frames are duplex audio; a voice call must be
+                # negotiated first (duplex_start).
+                self._try_send(ws, {
+                    "type": "error", "code": "duplex_not_started",
+                    "message": "send duplex_start before binary audio",
+                })
+                continue
             msg = self._parse(ws, raw)
             if msg is None:
                 continue
             mtype = msg.get("type")
             if mtype == "hangup":
                 ws.close(1000, "bye")
+                return
+            if mtype == "duplex_start":
+                # Switch the connection into voice mode: two pumps
+                # (ws→stream audio input, stream→ws audio output) until
+                # hangup/close — the reference's duplex session shape.
+                self._duplex_loop(ws, stream, session_id, user_id, msg)
                 return
             if mtype == "tool_result":
                 # tool_result outside a turn: protocol error, ignore.
@@ -322,6 +336,76 @@ class FacadeServer:
                 })
                 return assistant_text
         return None
+
+    def _duplex_loop(self, ws, stream, session_id: str, user_id: str, start_msg: dict) -> None:
+        """Voice-call mode (reference internal/runtime/duplex.go shape at
+        the facade: binary WS frames ⇄ audio chunks). Client binary frame
+        = audio; EMPTY binary frame = end of utterance; JSON hangup ends
+        the call. Server media_chunk → binary frame; transcripts,
+        interruptions, done and errors stay JSON."""
+        import base64
+
+        stream.send(c.ClientMessage(
+            type="duplex_start", audio_format=start_msg.get("format") or {}
+        ))
+        stop = threading.Event()
+
+        def input_pump():
+            try:
+                while not stop.is_set():
+                    try:
+                        raw = ws.recv(timeout=RECV_IDLE_TIMEOUT_S)
+                    except TimeoutError:
+                        ws.close(1000, "idle timeout")
+                        return
+                    if isinstance(raw, bytes):
+                        stream.send(c.ClientMessage(
+                            type="audio_input",
+                            audio_b64=base64.b64encode(raw).decode() if raw else "",
+                            final=len(raw) == 0,
+                        ))
+                        continue
+                    msg = self._parse(ws, raw)
+                    if msg and msg.get("type") == "hangup":
+                        ws.close(1000, "bye")
+                        return
+            except ConnectionClosed:
+                pass
+            finally:
+                stop.set()
+                stream.close()  # unblock the output pump
+
+        pump = threading.Thread(target=input_pump, daemon=True)
+        pump.start()
+        try:
+            for rmsg in stream:
+                if rmsg.type == "media_chunk":
+                    ws.send(base64.b64decode(rmsg.audio_b64))
+                elif rmsg.type == "duplex_ready":
+                    self._send(ws, {"type": "duplex_ready", "format": rmsg.audio_format})
+                elif rmsg.type == "transcript":
+                    if rmsg.role == "user":
+                        self.recording.record_user(session_id, user_id, rmsg.text)
+                    else:
+                        self.recording.record_assistant(session_id, user_id, rmsg.text, {})
+                    self._send(ws, {"type": "transcript", "role": rmsg.role, "text": rmsg.text})
+                elif rmsg.type == "interruption":
+                    self._send(ws, {"type": "interrupt", "reason": rmsg.text})
+                elif rmsg.type == "done":
+                    self._send(ws, {
+                        "type": "done",
+                        "usage": rmsg.usage.__dict__ if rmsg.usage else {},
+                        "finish_reason": rmsg.finish_reason,
+                    })
+                elif rmsg.type == "error":
+                    self._try_send(ws, {
+                        "type": "error", "code": rmsg.error_code,
+                        "message": rmsg.error_message,
+                    })
+        except ConnectionClosed:
+            pass
+        finally:
+            stop.set()
 
     def _await_tool_result(self, ws, tool_call_id: str) -> Optional[list[c.ToolResult]]:
         try:

@@ -18,9 +18,52 @@ Design notes (TPU-first):
 
 from __future__ import annotations
 
+import functools
+import os
+
+import jax
 import jax.numpy as jnp
 
 _NEG_INF = -1e30
+
+# Decode (T==1) steps can route to the length-aware Pallas kernel
+# (ops/decode_attention.py) whose HBM traffic is proportional to actual
+# context length instead of cache capacity. OMNIA_PALLAS_DECODE:
+#   auto (default) = on when running on TPU; 1 = force; 0 = off;
+#   interpret = Pallas interpreter (tests on CPU).
+_DECODE_BLOCK_S = 256
+
+
+@functools.lru_cache(maxsize=1)
+def _pallas_decode_mode() -> str:
+    mode = os.environ.get("OMNIA_PALLAS_DECODE", "auto").lower()
+    if mode == "auto":
+        # TPU shows up as backend "tpu" locally and "axon" through the
+        # remote-device tunnel; both run real Mosaic kernels.
+        return "1" if jax.default_backend() in ("tpu", "axon") else "0"
+    return mode
+
+
+def _decode_path(q, k_cache, v_cache, q_positions):
+    """Try the Pallas decode kernel; None → caller falls back to XLA."""
+    mode = _pallas_decode_mode()
+    if mode not in ("1", "interpret"):
+        return None
+    S = k_cache.shape[1]
+    block = min(_DECODE_BLOCK_S, S)
+    if S % block != 0:
+        return None
+    from omnia_tpu.ops.decode_attention import decode_gqa_attention
+
+    out = decode_gqa_attention(
+        q[:, 0],
+        k_cache,
+        v_cache,
+        q_positions[:, 0],
+        block_s=block,
+        interpret=mode == "interpret",
+    )
+    return out[:, None]
 
 
 def gqa_attention(
@@ -40,6 +83,11 @@ def gqa_attention(
     S = k_cache.shape[1]
     Hkv = k_cache.shape[2]
     G = H // Hkv
+
+    if T == 1:
+        fused = _decode_path(q, k_cache, v_cache, q_positions)
+        if fused is not None:
+            return fused
 
     qg = q.reshape(B, T, Hkv, G, D)
     # scores [B, Hkv, G, T, S]

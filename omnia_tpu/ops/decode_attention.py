@@ -1,0 +1,160 @@
+"""Length-aware Pallas decode attention over a slot-contiguous KV cache.
+
+Decode attention is HBM-bandwidth-bound: each step streams the KV cache.
+The XLA path (ops/attention.py gqa_attention) always reads all S rows —
+a slot at position 500 in an 8192-row cache pays 16× the necessary HBM
+traffic. This kernel makes traffic proportional to the ACTUAL context:
+
+- grid = (B, S // BLOCK_S); the kv BlockSpec index_map CLAMPS the block
+  index to the slot's last needed block (scalar-prefetched positions).
+  Pallas skips the DMA when consecutive grid steps map to the same
+  block, so rows past the position are never fetched from HBM.
+- blocks past the position also skip all compute (`pl.when`).
+- within-block causality is an iota mask; the running (m, l, acc)
+  flash-attention state lives in VMEM scratch across the S-block loop
+  (TPU grids iterate the last axis innermost, sequentially).
+- GQA without KV repeat: q reshapes to [Hkv, G, D] and both matmuls
+  batch over the KV-head axis (MXU), accumulating in f32.
+
+Used for T==1 (decode) steps on TPU; prefill keeps the XLA path (it is
+compute-bound and XLA fuses it well)."""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+DEFAULT_BLOCK_S = 256
+_NEG_INF = -1e30
+
+
+def _decode_kernel(
+    positions_ref,  # SMEM [B] (scalar prefetch)
+    q_ref,          # VMEM [1, Hkv, G, D]
+    k_ref,          # VMEM [1, BLOCK_S, Hkv, D]
+    v_ref,          # VMEM [1, BLOCK_S, Hkv, D]
+    out_ref,        # VMEM [1, Hkv, G, D]
+    m_ref,          # VMEM [Hkv, G] f32 scratch
+    l_ref,          # VMEM [Hkv, G] f32 scratch
+    acc_ref,        # VMEM [Hkv, G, D] f32 scratch
+    *,
+    block_s: int,
+    scale: float,
+):
+    b = pl.program_id(0)
+    s = pl.program_id(1)
+    num_s = pl.num_programs(1)
+    pos = positions_ref[b]
+    last_needed = pos // block_s
+
+    @pl.when(s == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    @pl.when(s <= last_needed)
+    def _block():
+        q = q_ref[0].astype(jnp.float32)           # [Hkv, G, D]
+        k = k_ref[0]                               # [BLOCK_S, Hkv, D]
+        v = v_ref[0]
+        # scores [Hkv, G, BLOCK_S] — batch over the KV-head axis.
+        scores = jax.lax.dot_general(
+            q,
+            jnp.swapaxes(k, 0, 1).astype(jnp.float32),  # [Hkv, BLOCK_S, D]
+            dimension_numbers=(((2,), (2,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32,
+        ) * scale
+
+        key_idx = s * block_s + jax.lax.broadcasted_iota(
+            jnp.int32, scores.shape, dimension=2
+        )
+        scores = jnp.where(key_idx <= pos, scores, _NEG_INF)
+
+        m_prev, l_prev = m_ref[:], l_ref[:]
+        m_new = jnp.maximum(m_prev, scores.max(axis=-1))
+        alpha = jnp.exp(m_prev - m_new)             # [Hkv, G]
+        p = jnp.exp(scores - m_new[:, :, None])     # [Hkv, G, BLOCK_S]
+        # pv [Hkv, G, D]
+        pv = jax.lax.dot_general(
+            p,
+            jnp.swapaxes(v, 0, 1).astype(jnp.float32),  # [Hkv, BLOCK_S, D]
+            dimension_numbers=(((2,), (1,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32,
+        )
+        acc_ref[:] = acc_ref[:] * alpha[:, :, None] + pv
+        l_ref[:] = l_prev * alpha + p.sum(axis=-1)
+        m_ref[:] = m_new
+
+    @pl.when(s == num_s - 1)
+    def _finish():
+        out_ref[0] = (
+            acc_ref[:] / jnp.maximum(l_ref[:], 1e-30)[:, :, None]
+        ).astype(out_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("block_s", "interpret"))
+def decode_gqa_attention(
+    q: jnp.ndarray,          # [B, H, D] (rotary already applied)
+    k_cache: jnp.ndarray,    # [B, S, Hkv, D]
+    v_cache: jnp.ndarray,    # [B, S, Hkv, D]
+    positions: jnp.ndarray,  # int32 [B] — current decode position per slot
+    block_s: int = DEFAULT_BLOCK_S,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """→ [B, H, D]. Requires S % block_s == 0 (engine sizes caches so)."""
+    B, H, D = q.shape
+    S, Hkv = k_cache.shape[1], k_cache.shape[2]
+    G = H // Hkv
+    if S % block_s != 0:
+        raise ValueError(f"cache length {S} not divisible by block {block_s}")
+    num_s = S // block_s
+    positions = positions.astype(jnp.int32)
+
+    def kv_index(b, s, pos_ref):
+        # Clamp to the last needed block: steps past the position re-map
+        # to the same block, which Pallas recognizes as "already resident"
+        # and skips the HBM→VMEM DMA.
+        return (b, jnp.minimum(s, pos_ref[b] // block_s), 0, 0)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(B, num_s),
+        in_specs=[
+            pl.BlockSpec(
+                (1, Hkv, G, D), lambda b, s, pos_ref: (b, 0, 0, 0),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(
+                (1, block_s, Hkv, D),
+                lambda b, s, pos_ref: kv_index(b, s, pos_ref),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(
+                (1, block_s, Hkv, D),
+                lambda b, s, pos_ref: kv_index(b, s, pos_ref),
+                memory_space=pltpu.VMEM,
+            ),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, Hkv, G, D), lambda b, s, pos_ref: (b, 0, 0, 0),
+            memory_space=pltpu.VMEM,
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((Hkv, G), jnp.float32),
+            pltpu.VMEM((Hkv, G), jnp.float32),
+            pltpu.VMEM((Hkv, G, D), jnp.float32),
+        ],
+    )
+
+    out = pl.pallas_call(
+        functools.partial(_decode_kernel, block_s=block_s, scale=D**-0.5),
+        out_shape=jax.ShapeDtypeStruct((B, Hkv, G, D), q.dtype),
+        grid_spec=grid_spec,
+        interpret=interpret,
+    )(positions, q.reshape(B, Hkv, G, D), k_cache, v_cache)
+    return out.reshape(B, H, D)

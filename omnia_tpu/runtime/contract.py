@@ -77,11 +77,16 @@ class ToolResult:
 class ClientMessage:
     """Client→runtime turn input."""
 
-    type: str = "message"          # message | tool_results | cancel
+    # message | tool_results | cancel | duplex_start | audio_input
+    type: str = "message"
     content: str = ""
     tool_results: list[ToolResult] = field(default_factory=list)
     response_format: Optional[dict] = None   # {"type": "json"|"json_schema", "schema": {...}}
     metadata: dict = field(default_factory=dict)
+    # Duplex voice (reference runtime.proto DuplexStart/AudioInputChunk):
+    audio_b64: str = ""                      # audio_input payload
+    final: bool = False                      # audio_input end-of-utterance
+    audio_format: Optional[dict] = None      # duplex_start negotiation
 
     def to_bytes(self) -> bytes:
         return json.dumps(asdict(self)).encode()
@@ -114,8 +119,10 @@ class ToolCall:
 class ServerMessage:
     """Runtime→client stream element (oneof via `type`)."""
 
-    type: str                       # hello | chunk | tool_call | done | error
-    text: str = ""                  # chunk
+    # hello | chunk | tool_call | done | error
+    # | duplex_ready | media_chunk | transcript | interruption
+    type: str
+    text: str = ""                  # chunk / transcript
     tool_call: Optional[ToolCall] = None
     usage: Optional[Usage] = None   # done
     finish_reason: str = ""         # done
@@ -123,6 +130,11 @@ class ServerMessage:
     error_message: str = ""         # error
     contract_version: str = ""      # hello
     capabilities: list[str] = field(default_factory=list)  # hello
+    # Duplex voice (reference runtime.proto MediaChunk/Interruption):
+    audio_b64: str = ""             # media_chunk payload
+    seq: int = 0                    # media_chunk ordering
+    role: str = ""                  # transcript: user | assistant
+    audio_format: Optional[dict] = None  # duplex_ready (negotiated)
 
     def to_bytes(self) -> bytes:
         d = asdict(self)

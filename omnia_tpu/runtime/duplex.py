@@ -1,0 +1,175 @@
+"""Duplex voice sessions: audio in → STT → turn → TTS → audio out.
+
+Reference internal/runtime/duplex.go (handleDuplexSession :210,
+pumpDuplexInput :307, negotiation :120-208) + duplexmock/: a duplex
+session negotiates an audio format, transcribes caller audio, runs the
+normal conversation turn, and streams synthesized audio back — with
+barge-in: caller audio arriving while the agent is speaking interrupts
+playback (Interruption) and cancels the in-flight turn.
+
+Speech providers are pluggable (Provider CRD roles tts/stt in the
+reference; on-TPU speech models plug in here the same way the LLM
+does). MockStt/MockTts mirror the reference's duplexmock: the "audio"
+payload is UTF-8 text, synthesis is the reply bytes chunked — enough to
+exercise every protocol path without a speech model."""
+
+from __future__ import annotations
+
+import base64
+import dataclasses
+import logging
+import threading
+from typing import Iterator, Optional
+
+from omnia_tpu.runtime.contract import ClientMessage, ServerMessage
+
+logger = logging.getLogger(__name__)
+
+DEFAULT_FORMAT = {"encoding": "pcm16", "sample_rate_hz": 16000, "channels": 1}
+SUPPORTED_ENCODINGS = ("pcm16", "mock-text")
+
+
+class SttProvider:
+    def transcribe(self, audio: bytes, fmt: dict) -> str:
+        raise NotImplementedError
+
+
+class TtsProvider:
+    def synthesize(self, text: str, fmt: dict) -> Iterator[bytes]:
+        raise NotImplementedError
+
+
+class MockStt(SttProvider):
+    """Test stand-in: the audio payload IS the utterance text."""
+
+    def transcribe(self, audio: bytes, fmt: dict) -> str:
+        return audio.decode("utf-8", errors="replace").strip()
+
+
+class MockTts(TtsProvider):
+    def __init__(self, chunk_bytes: int = 32):
+        self.chunk_bytes = chunk_bytes
+
+    def synthesize(self, text: str, fmt: dict) -> Iterator[bytes]:
+        data = text.encode()
+        for i in range(0, len(data), self.chunk_bytes):
+            yield data[i : i + self.chunk_bytes]
+
+
+@dataclasses.dataclass
+class SpeechSupport:
+    stt: SttProvider
+    tts: TtsProvider
+
+
+class DuplexSession:
+    """Per-stream duplex state machine. Driven by the runtime server's
+    Converse handler: `handle(msg)` yields ServerMessages for duplex
+    client messages; `barge_in()` is called from the stream reader thread
+    when audio arrives while the agent is speaking."""
+
+    def __init__(self, conversation, speech: SpeechSupport):
+        self.conv = conversation
+        self.speech = speech
+        self.format = dict(DEFAULT_FORMAT)
+        self.negotiated = False
+        self._buffer = bytearray()
+        self._speaking = threading.Event()
+        self._interrupted = threading.Event()
+        self._seq = 0
+
+    # -- negotiation -------------------------------------------------------
+
+    def handle_start(self, msg: ClientMessage) -> Iterator[ServerMessage]:
+        want = msg.audio_format or {}
+        encoding = want.get("encoding", DEFAULT_FORMAT["encoding"])
+        if encoding not in SUPPORTED_ENCODINGS:
+            yield ServerMessage(
+                type="error",
+                error_code="unsupported_audio_format",
+                error_message=f"encoding {encoding!r}; supported: {SUPPORTED_ENCODINGS}",
+            )
+            return
+        self.format = {
+            "encoding": encoding,
+            "sample_rate_hz": int(want.get("sample_rate_hz", DEFAULT_FORMAT["sample_rate_hz"])),
+            "channels": 1,
+        }
+        self.negotiated = True
+        yield ServerMessage(type="duplex_ready", audio_format=self.format)
+
+    # -- audio input -------------------------------------------------------
+
+    def handle_audio(self, msg: ClientMessage) -> Iterator[ServerMessage]:
+        if not self.negotiated:
+            yield ServerMessage(
+                type="error",
+                error_code="duplex_not_started",
+                error_message="send duplex_start before audio_input",
+            )
+            return
+        if msg.audio_b64:
+            self._buffer.extend(base64.b64decode(msg.audio_b64))
+        if not msg.final:
+            return
+        audio = bytes(self._buffer)
+        self._buffer.clear()
+        if not audio:
+            return
+        try:
+            utterance = self.speech.stt.transcribe(audio, self.format)
+        except Exception as e:  # noqa: BLE001 — a bad utterance isn't fatal
+            logger.exception("stt failed")
+            yield ServerMessage(type="error", error_code="stt_error", error_message=str(e))
+            return
+        if not utterance:
+            return
+        yield ServerMessage(type="transcript", role="user", text=utterance)
+        yield from self._speak_turn(utterance)
+
+    def _speak_turn(self, utterance: str) -> Iterator[ServerMessage]:
+        """Run the normal conversation turn, synthesizing audio from the
+        text stream. Barge-in (audio during speech) cancels the turn and
+        emits an Interruption instead of the remaining audio."""
+        self._interrupted.clear()
+        self._speaking.set()
+        assistant_text = []
+        try:
+            for m in self.conv.stream(ClientMessage(content=utterance)):
+                if self._interrupted.is_set():
+                    yield ServerMessage(type="interruption", text="barge-in")
+                    return
+                if m.type == "chunk":
+                    assistant_text.append(m.text)
+                    for piece in self.speech.tts.synthesize(m.text, self.format):
+                        if self._interrupted.is_set():
+                            yield ServerMessage(type="interruption", text="barge-in")
+                            return
+                        self._seq += 1
+                        yield ServerMessage(
+                            type="media_chunk",
+                            audio_b64=base64.b64encode(piece).decode(),
+                            seq=self._seq,
+                        )
+                elif m.type == "done":
+                    if m.finish_reason == "cancelled" and self._interrupted.is_set():
+                        yield ServerMessage(type="interruption", text="barge-in")
+                        return
+                    yield ServerMessage(
+                        type="transcript", role="assistant", text="".join(assistant_text)
+                    )
+                    yield m
+                else:
+                    yield m  # error / tool_call pass through unchanged
+        finally:
+            self._speaking.clear()
+
+    # -- barge-in (called from the stream reader thread) -------------------
+
+    @property
+    def speaking(self) -> bool:
+        return self._speaking.is_set()
+
+    def barge_in(self) -> None:
+        self._interrupted.set()
+        self.conv.cancel_turn()

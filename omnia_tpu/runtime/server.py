@@ -64,6 +64,7 @@ class RuntimeServer:
         on_event=None,
         memory=None,
         tracer=None,
+        speech=None,
     ):
         self.pack = pack
         self.providers = providers
@@ -79,6 +80,9 @@ class RuntimeServer:
             # Honest capability advertisement (reference runtime.proto
             # :350-354): only claim memory when a capability is wired.
             self.capabilities.append(c.Capability.MEMORY.value)
+        self.speech = speech  # duplex.SpeechSupport (None = no voice)
+        if speech is not None and c.Capability.DUPLEX_AUDIO.value not in self.capabilities:
+            self.capabilities.append(c.Capability.DUPLEX_AUDIO.value)
         self.pack_params = pack_params or {}
         self.on_event = on_event
         # Pack is immutable for the server's lifetime: precompute the
@@ -167,6 +171,8 @@ class RuntimeServer:
         )
 
         inbox: "queue.Queue[Optional[c.ClientMessage]]" = queue.Queue()
+        duplex: Optional[object] = None
+        duplex_lock = threading.Lock()
 
         def reader():
             try:
@@ -175,6 +181,15 @@ class RuntimeServer:
                         conv.provide_tool_results(m.tool_results)
                     elif m.type == "cancel":
                         conv.cancel_turn()  # interrupt the in-flight turn
+                    elif m.type == "audio_input":
+                        # Barge-in: audio landing while the agent is
+                        # speaking interrupts playback; the audio itself
+                        # still queues as the next utterance.
+                        with duplex_lock:
+                            d = duplex
+                        if d is not None and d.speaking:
+                            d.barge_in()
+                        inbox.put(m)
                     else:
                         inbox.put(m)
             except Exception:  # stream broken: unblock the writer
@@ -189,7 +204,33 @@ class RuntimeServer:
             if m is None:
                 return
             try:
-                yield from conv.stream(m, traceparent=traceparent)
+                if m.type == "duplex_start":
+                    if self.speech is None:
+                        yield c.ServerMessage(
+                            type="error",
+                            error_code="capability_unsupported",
+                            error_message="runtime has no duplex_audio capability",
+                        )
+                        continue
+                    from omnia_tpu.runtime.duplex import DuplexSession
+
+                    with duplex_lock:
+                        duplex = DuplexSession(conv, self.speech)
+                        d = duplex
+                    yield from d.handle_start(m)
+                elif m.type == "audio_input":
+                    with duplex_lock:
+                        d = duplex
+                    if d is None:
+                        yield c.ServerMessage(
+                            type="error",
+                            error_code="duplex_not_started",
+                            error_message="send duplex_start before audio_input",
+                        )
+                        continue
+                    yield from d.handle_audio(m)
+                else:
+                    yield from conv.stream(m, traceparent=traceparent)
             except Exception as e:  # turn must not kill the stream silently
                 logger.exception("turn failed")
                 yield c.ServerMessage(

@@ -1,0 +1,82 @@
+"""Pallas decode-attention kernel numerics vs the XLA reference path
+(interpret mode on CPU; the real TPU path compiles the same kernel)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from omnia_tpu.ops.attention import gqa_attention
+from omnia_tpu.ops.decode_attention import decode_gqa_attention
+
+
+def _setup(B=4, S=512, H=8, Hkv=2, D=128, seed=0, dtype=jnp.float32):
+    ks = jax.random.split(jax.random.key(seed), 3)
+    q = jax.random.normal(ks[0], (B, 1, H, D), dtype=dtype)
+    k = jax.random.normal(ks[1], (B, S, Hkv, D), dtype=dtype)
+    v = jax.random.normal(ks[2], (B, S, Hkv, D), dtype=dtype)
+    return q, k, v
+
+
+class TestDecodeAttention:
+    @pytest.mark.parametrize("positions", [[0, 5, 255, 511], [37, 499, 256, 128]])
+    def test_matches_xla_reference(self, positions):
+        q, k, v = _setup()
+        pos = jnp.asarray(positions, dtype=jnp.int32)
+        ref = gqa_attention(q, k, v, pos[:, None])[:, 0]
+        out = decode_gqa_attention(q[:, 0], k, v, pos, block_s=128, interpret=True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5)
+
+    def test_rows_past_position_do_not_influence(self):
+        """Poison cache rows beyond each position with huge values — the
+        kernel must produce identical output (those blocks are skipped)."""
+        q, k, v = _setup(B=2, S=256, H=4, Hkv=2, D=128)
+        pos = jnp.asarray([63, 190], dtype=jnp.int32)
+        out_clean = decode_gqa_attention(q[:, 0], k, v, pos, block_s=64, interpret=True)
+        k_poison, v_poison = np.asarray(k).copy(), np.asarray(v).copy()
+        for b, p in enumerate([63, 190]):
+            k_poison[b, p + 1:] = 1e9
+            v_poison[b, p + 1:] = -1e9
+        out_poison = decode_gqa_attention(
+            q[:, 0], jnp.asarray(k_poison), jnp.asarray(v_poison), pos,
+            block_s=64, interpret=True,
+        )
+        np.testing.assert_allclose(np.asarray(out_clean), np.asarray(out_poison))
+
+    def test_bf16_inputs(self):
+        q, k, v = _setup(B=2, S=256, H=8, Hkv=4, D=128, dtype=jnp.bfloat16)
+        pos = jnp.asarray([100, 200], dtype=jnp.int32)
+        ref = gqa_attention(q, k, v, pos[:, None])[:, 0]
+        out = decode_gqa_attention(q[:, 0], k, v, pos, block_s=128, interpret=True)
+        np.testing.assert_allclose(
+            np.asarray(out, dtype=np.float32), np.asarray(ref, dtype=np.float32),
+            atol=3e-2, rtol=3e-2,
+        )
+
+    def test_indivisible_cache_rejected(self):
+        q, k, v = _setup(B=1, S=100, H=2, Hkv=1, D=128)
+        with pytest.raises(ValueError, match="divisible"):
+            decode_gqa_attention(q[:, 0], k, v, jnp.zeros((1,), jnp.int32),
+                                 block_s=64, interpret=True)
+
+    def test_dispatch_from_gqa_attention(self, monkeypatch):
+        """gqa_attention routes T==1 to the kernel when enabled."""
+        import omnia_tpu.ops.attention as attn
+
+        q, k, v = _setup(B=2, S=256, H=4, Hkv=2, D=128)
+        pos = jnp.asarray([10, 200], dtype=jnp.int32)
+        monkeypatch.setenv("OMNIA_PALLAS_DECODE", "interpret")
+        attn._pallas_decode_mode.cache_clear()
+        try:
+            out = attn.gqa_attention(q, k, v, pos[:, None])
+            ref_disabled_env = attn.gqa_attention  # same fn, reference below
+            monkeypatch.setenv("OMNIA_PALLAS_DECODE", "0")
+            attn._pallas_decode_mode.cache_clear()
+            ref = attn.gqa_attention(q, k, v, pos[:, None])
+            np.testing.assert_allclose(
+                np.asarray(out[:, 0]), np.asarray(ref[:, 0]), atol=2e-5, rtol=2e-5
+            )
+        finally:
+            attn._pallas_decode_mode.cache_clear()

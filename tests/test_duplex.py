@@ -1,0 +1,221 @@
+"""Duplex voice tests: negotiation, STT→turn→TTS flow, barge-in, and the
+facade's binary-frame path end-to-end (mock speech providers)."""
+
+from __future__ import annotations
+
+import base64
+import json
+import time
+
+import pytest
+
+from omnia_tpu.runtime import contract as c
+from omnia_tpu.runtime.client import RuntimeClient
+from omnia_tpu.runtime.duplex import MockStt, MockTts, SpeechSupport
+from omnia_tpu.runtime.packs import load_pack
+from omnia_tpu.runtime.providers import ProviderRegistry, ProviderSpec
+from omnia_tpu.runtime.server import RuntimeServer
+
+PACK = {"name": "voice-agent", "version": "1.0.0",
+        "prompts": {"system": "You speak."}, "sampling": {"max_tokens": 256}}
+
+SCENARIOS = [
+    {"pattern": "how do refunds work", "reply": "refunds take thirty days to process"},
+    {"pattern": "slow story", "reply": "o n c e  u p o n  a  t i m e " * 20,
+     "delay_per_token_s": 0.01},
+    {"pattern": ".", "reply": "I heard you"},
+]
+
+
+def _server(speech=True):
+    reg = ProviderRegistry()
+    reg.register(ProviderSpec(name="m", type="mock", options={"scenarios": SCENARIOS}))
+    return RuntimeServer(
+        pack=load_pack(PACK), providers=reg, provider_name="m",
+        speech=SpeechSupport(MockStt(), MockTts()) if speech else None,
+    )
+
+
+def _audio_msg(text: str, final: bool = True) -> c.ClientMessage:
+    return c.ClientMessage(
+        type="audio_input",
+        audio_b64=base64.b64encode(text.encode()).decode(),
+        final=final,
+    )
+
+
+class TestDuplexRuntime:
+    def test_capability_gated(self):
+        rt = _server(speech=False)
+        port = rt.serve("localhost:0")
+        try:
+            client = RuntimeClient(f"localhost:{port}")
+            assert "duplex_audio" not in client.health().capabilities
+            stream = client.open_stream("s-nocap")
+            stream.send(c.ClientMessage(type="duplex_start"))
+            msgs = [next(iter(stream))]
+            assert msgs[0].type == "error"
+            assert msgs[0].error_code == "capability_unsupported"
+            stream.close()
+            client.close()
+        finally:
+            rt.shutdown()
+
+    def test_voice_turn_flow(self):
+        rt = _server()
+        port = rt.serve("localhost:0")
+        try:
+            client = RuntimeClient(f"localhost:{port}")
+            assert "duplex_audio" in client.health().capabilities
+            stream = client.open_stream("s-voice")
+            stream.send(c.ClientMessage(type="duplex_start",
+                                        audio_format={"encoding": "pcm16"}))
+            it = iter(stream)
+            ready = next(it)
+            assert ready.type == "duplex_ready"
+            assert ready.audio_format["encoding"] == "pcm16"
+            # two partial chunks then final
+            stream.send(_audio_msg("how do refunds ", final=False))
+            stream.send(_audio_msg("work", final=True))
+            transcript_user = audio = transcript_assistant = done = None
+            chunks = []
+            while done is None:
+                m = next(it)
+                if m.type == "transcript" and m.role == "user":
+                    transcript_user = m.text
+                elif m.type == "media_chunk":
+                    chunks.append((m.seq, base64.b64decode(m.audio_b64)))
+                elif m.type == "transcript" and m.role == "assistant":
+                    transcript_assistant = m.text
+                elif m.type == "done":
+                    done = m
+            assert transcript_user == "how do refunds work"
+            spoken = b"".join(audio for _seq, audio in sorted(chunks))
+            assert spoken.decode() == "refunds take thirty days to process"
+            assert [s for s, _ in chunks] == sorted(s for s, _ in chunks)
+            assert transcript_assistant == "refunds take thirty days to process"
+            assert done.usage.completion_tokens > 0
+            stream.close()
+            client.close()
+        finally:
+            rt.shutdown()
+
+    def test_audio_before_start_rejected(self):
+        rt = _server()
+        port = rt.serve("localhost:0")
+        try:
+            client = RuntimeClient(f"localhost:{port}")
+            stream = client.open_stream("s-early")
+            stream.send(_audio_msg("hello"))
+            m = next(iter(stream))
+            assert m.type == "error" and m.error_code == "duplex_not_started"
+            stream.close()
+            client.close()
+        finally:
+            rt.shutdown()
+
+    def test_unsupported_encoding_rejected(self):
+        rt = _server()
+        port = rt.serve("localhost:0")
+        try:
+            client = RuntimeClient(f"localhost:{port}")
+            stream = client.open_stream("s-enc")
+            stream.send(c.ClientMessage(type="duplex_start",
+                                        audio_format={"encoding": "opus-48k"}))
+            m = next(iter(stream))
+            assert m.type == "error" and m.error_code == "unsupported_audio_format"
+            stream.close()
+            client.close()
+        finally:
+            rt.shutdown()
+
+    def test_barge_in_interrupts_playback(self):
+        rt = _server()
+        port = rt.serve("localhost:0")
+        try:
+            client = RuntimeClient(f"localhost:{port}")
+            stream = client.open_stream("s-barge")
+            stream.send(c.ClientMessage(type="duplex_start"))
+            it = iter(stream)
+            assert next(it).type == "duplex_ready"
+            stream.send(_audio_msg("tell me a slow story"))
+            saw_interrupt = False
+            deadline = time.monotonic() + 30
+            sent_barge = False
+            while time.monotonic() < deadline:
+                m = next(it)
+                if m.type == "media_chunk" and not sent_barge:
+                    # caller starts talking while the agent is speaking
+                    stream.send(_audio_msg("wait stop", final=False))
+                    sent_barge = True
+                elif m.type == "interruption":
+                    saw_interrupt = True
+                    break
+                elif m.type == "done":
+                    break
+            assert saw_interrupt, "barge-in never interrupted playback"
+            stream.close()
+            client.close()
+        finally:
+            rt.shutdown()
+
+
+class TestDuplexFacade:
+    def test_binary_frames_end_to_end(self):
+        from websockets.sync.client import connect
+
+        from omnia_tpu.facade.server import FacadeServer
+
+        rt = _server()
+        rport = rt.serve("localhost:0")
+        facade = FacadeServer(runtime_target=f"localhost:{rport}", agent_name="voice-agent")
+        fport = facade.serve()
+        try:
+            with connect(f"ws://localhost:{fport}/ws") as ws:
+                connected = json.loads(ws.recv(timeout=10))
+                assert "duplex_audio" in connected["capabilities"]
+                ws.send(json.dumps({"type": "duplex_start",
+                                    "format": {"encoding": "pcm16"}}))
+                ready = json.loads(ws.recv(timeout=10))
+                assert ready["type"] == "duplex_ready"
+                ws.send(b"how do refunds work")  # binary audio
+                ws.send(b"")  # empty frame = end of utterance
+                audio = bytearray()
+                transcripts = []
+                deadline = time.monotonic() + 30
+                while time.monotonic() < deadline:
+                    frame = ws.recv(timeout=deadline - time.monotonic())
+                    if isinstance(frame, bytes):
+                        audio.extend(frame)
+                        continue
+                    doc = json.loads(frame)
+                    if doc["type"] == "transcript":
+                        transcripts.append((doc["role"], doc["text"]))
+                    elif doc["type"] == "done":
+                        break
+                assert audio.decode() == "refunds take thirty days to process"
+                assert ("user", "how do refunds work") in transcripts
+                ws.send(json.dumps({"type": "hangup"}))
+        finally:
+            facade.shutdown()
+            rt.shutdown()
+
+    def test_binary_frame_without_duplex_rejected(self):
+        from websockets.sync.client import connect
+
+        from omnia_tpu.facade.server import FacadeServer
+
+        rt = _server()
+        rport = rt.serve("localhost:0")
+        facade = FacadeServer(runtime_target=f"localhost:{rport}", agent_name="voice-agent")
+        fport = facade.serve()
+        try:
+            with connect(f"ws://localhost:{fport}/ws") as ws:
+                json.loads(ws.recv(timeout=10))  # connected
+                ws.send(b"raw audio out of nowhere")
+                err = json.loads(ws.recv(timeout=10))
+                assert err["type"] == "error"
+                assert err["code"] == "duplex_not_started"
+        finally:
+            facade.shutdown()
+            rt.shutdown()

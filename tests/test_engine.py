@@ -216,7 +216,9 @@ def test_recovery_reallocates_device_state(engine):
     device state so the engine keeps serving."""
     sp = SamplingParams(temperature=0.0, max_tokens=4)
     before, _ = engine.generate([6, 5, 4], sp)
-    h = engine.submit([6, 5, 4], sp)
+    # Needs more tokens than one decode chunk so the slot is still active
+    # after a step (chunked decode can finish a short request in one step).
+    h = engine.submit([6, 5, 4], SamplingParams(temperature=0.0, max_tokens=40))
     engine.step()  # slot active mid-request
     engine._recover("injected failure")
     ev = h.get_event(timeout=5)
@@ -270,3 +272,29 @@ def test_prefill_failure_reaches_handle(engine):
         engine._recover("test cleanup")
     toks, fin = engine.generate([1, 2], sp)
     assert len(toks) == 2
+
+
+def test_chunked_decode_matches_per_token(engine):
+    """decode_chunk must be behavior-invisible: greedy output identical
+    between K=1 and K=8 engines."""
+    cfg = get_config("test-tiny")
+    sp = SamplingParams(temperature=0.0, max_tokens=11)
+    e1 = InferenceEngine(
+        cfg,
+        EngineConfig(num_slots=2, max_seq=64, prefill_buckets=(8,),
+                     dtype="float32", decode_chunk=1),
+        seed=7,
+    )
+    e8 = InferenceEngine(
+        cfg,
+        EngineConfig(num_slots=2, max_seq=64, prefill_buckets=(8,),
+                     dtype="float32", decode_chunk=8),
+        seed=7,
+    )
+    t1, f1 = e1.generate([3, 1, 4], sp)
+    t8, f8 = e8.generate([3, 1, 4], sp)
+    assert t1 == t8
+    assert f1.finish_reason == f8.finish_reason
+    # seeded sampling too (per-slot PRNG must advance identically)
+    sp2 = SamplingParams(temperature=1.0, max_tokens=9, seed=42)
+    assert e1.generate([2, 7], sp2)[0] == e8.generate([2, 7], sp2)[0]
