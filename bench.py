@@ -44,9 +44,9 @@ def main() -> None:
             "accelerator unreachable; falling back to CPU bench",
             file=sys.stderr,
         )
-        env = dict(os.environ)
-        env.pop("PALLAS_AXON_POOL_IPS", None)
-        env["JAX_PLATFORMS"] = "cpu"
+        from __graft_entry__ import cpu_mesh_env
+
+        env = cpu_mesh_env()
         env["OMNIA_BENCH_PROBED"] = "1"
         os.execve(sys.executable, [sys.executable, os.path.abspath(__file__)], env)
     import jax
