@@ -32,16 +32,41 @@ from omnia_tpu.engine.types import (
 
 @dataclass
 class Scenario:
-    """One scripted behavior: if `pattern` matches the prompt, stream `reply`."""
+    """One scripted behavior: if `pattern` matches the prompt, stream `reply`.
+
+    By default the pattern is matched against the system block plus the
+    CURRENT turn only (`match="turn"`) — a real model answers the latest
+    user message, and matching the whole prompt would let a scenario keyed
+    on an old user turn re-fire forever once that turn is in persisted
+    history. Scenarios that deliberately assert history retention set
+    `match="prompt"`.
+    """
 
     pattern: str
     reply: str = ""
     error: Optional[str] = None          # stream an ERROR final instead
     delay_per_token_s: float = 0.0       # simulated decode latency
     ttft_s: float = 0.0                  # simulated prefill latency
+    match: str = "turn"                  # "turn" | "prompt"
+
+    def __post_init__(self):
+        if self.match not in ("turn", "prompt"):
+            raise ValueError(f"Scenario.match must be 'turn' or 'prompt', got {self.match!r}")
 
     def matches(self, prompt: str) -> bool:
         return re.search(self.pattern, prompt, re.DOTALL) is not None
+
+
+def _current_turn_view(prompt: str) -> str:
+    """System block + last user turn (incl. this turn's tool rounds):
+    previous conversation turns are cut out. The marker is anchored at a
+    line start so message *content* containing the literal '[USER]' can't
+    hijack the split."""
+    sys_end = prompt.find("[/SYS]")
+    last_user = prompt.rfind("\n[USER]")
+    if sys_end < 0 or last_user < 0 or last_user < sys_end:
+        return prompt
+    return prompt[: sys_end + len("[/SYS]")] + prompt[last_user + 1:]
 
 
 DEFAULT_REPLY = "mock-reply"
@@ -105,8 +130,9 @@ class MockEngine:
         pass
 
     def _scenario_for(self, prompt: str) -> Scenario:
+        turn_view = _current_turn_view(prompt)
         for s in self.scenarios:
-            if s.matches(prompt):
+            if s.matches(prompt if s.match == "prompt" else turn_view):
                 return s
         return Scenario(pattern=".*", reply=DEFAULT_REPLY)
 

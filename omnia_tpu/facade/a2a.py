@@ -178,7 +178,8 @@ class A2aFacade(JsonHttpFacade):
             self._active[task_id] = stream
         try:
             reply, failed = [], None
-            for m in stream.turn(text):
+            turn_iter = stream.turn(text)
+            for m in turn_iter:
                 if m.type == "chunk":
                     reply.append(m.text)
                 elif m.type == "error":
@@ -186,9 +187,14 @@ class A2aFacade(JsonHttpFacade):
                 elif m.type == "tool_call":
                     # Client tools can't round-trip over A2A: cancel the
                     # turn NOW instead of letting the runtime wait out its
-                    # client-tool timeout with the session lock held.
+                    # client-tool timeout with the session lock held, then
+                    # drain to done so the lock is provably released (the
+                    # cancel frame can be lost if the stream is torn down
+                    # while it is still queued).
                     failed = "client tools unsupported over A2A"
                     stream.send_cancel()
+                    for _ in turn_iter:
+                        pass
                     break
             if failed:
                 status, artifacts = {"state": "failed", "message": _text_msg(failed)}, None

@@ -207,14 +207,20 @@ class RestFacade(JsonHttpFacade):
         )
         try:
             text, usage, finish = [], None, ""
-            for msg in stream.turn(content):
+            turn_iter = stream.turn(content)
+            for msg in turn_iter:
                 if msg.type == "chunk":
                     text.append(msg.text)
                 elif msg.type == "tool_call":
                     # Cancel the turn NOW — returning without cancelling
                     # would leave the runtime waiting out its client-tool
-                    # timeout with this session's turn lock held.
+                    # timeout with this session's turn lock held. Then
+                    # drain to done/error so the session's turn lock is
+                    # released before we answer: tearing the stream down
+                    # with the cancel frame still queued can lose it.
                     stream.send_cancel()
+                    for _ in turn_iter:
+                        pass
                     return 501, {"error": "client tools unsupported over REST"}
                 elif msg.type == "error":
                     return 502, {"error": msg.error_code, "message": msg.error_message}

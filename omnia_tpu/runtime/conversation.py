@@ -211,15 +211,22 @@ class Conversation:
     # ------------------------------------------------------------------
 
     def stream(
-        self, msg: ClientMessage, traceparent: Optional[str] = None
+        self,
+        msg: ClientMessage,
+        traceparent: Optional[str] = None,
+        input_closed: Optional[threading.Event] = None,
     ) -> Iterator[ServerMessage]:
         """Process one turn; yields chunk/tool_call/done/error messages.
         `traceparent` is per-call (each stream carries its own remote
         context; a shared per-conversation field would be clobbered by
-        concurrent streams on the same session)."""
+        concurrent streams on the same session). `input_closed` is set by
+        the transport when the client stream can produce no further input —
+        a client-tool wait then ends immediately (no results can ever
+        arrive on that stream) instead of holding the turn lock to the full
+        client-tool timeout."""
         with self._turn_lock:
             if self.tracer is None:
-                yield from self._stream_locked(msg)
+                yield from self._stream_locked(msg, input_closed)
                 return
             # Turn-indexed conversation span (reference tracing.go:214);
             # remote parent arrives as a traceparent from the facade.
@@ -231,7 +238,7 @@ class Conversation:
                 traceparent=traceparent or self.traceparent,
                 attrs={"session.id": self.session_id, "turn.index": self._turn_index},
             ) as span:
-                for m in self._stream_locked(msg):
+                for m in self._stream_locked(msg, input_closed):
                     if m.type == "error":
                         span.status = "error"
                         span.set_attr("error.code", m.error_code)
@@ -245,7 +252,11 @@ class Conversation:
                             )
                     yield m
 
-    def _stream_locked(self, msg: ClientMessage) -> Iterator[ServerMessage]:
+    def _stream_locked(
+        self,
+        msg: ClientMessage,
+        input_closed: Optional[threading.Event] = None,
+    ) -> Iterator[ServerMessage]:
         deadline = time.monotonic() + TURN_TIMEOUT_S
         self._cancel_requested.clear()
         # Drain tool results left over from a previous (timed-out) turn so a
@@ -358,6 +369,12 @@ class Conversation:
                     handle.cancel()
                     error = StreamError("timeout", "turn exceeded execution timeout")
                     break
+            except GeneratorExit:
+                # Consumer abandoned the turn mid-decode (stream torn
+                # down): free the engine slot instead of decoding the rest
+                # of max_tokens into the void.
+                handle.cancel()
+                raise
             finally:
                 self._active_handle = None
                 if llm_span is not None:
@@ -443,7 +460,9 @@ class Conversation:
             if reply is not None:
                 yield reply  # client-side tool_call announcement
                 results = self._await_client_results(
-                    deadline, expected_id=reply.tool_call.tool_call_id
+                    deadline,
+                    expected_id=reply.tool_call.tool_call_id,
+                    input_closed=input_closed,
                 )
                 if results is self._CANCELLED:
                     try:
@@ -533,23 +552,42 @@ class Conversation:
 
     _CANCELLED = object()  # sentinel: wait ended by cancel_turn, not timeout
 
-    def _await_client_results(self, deadline: float, expected_id: str = ""):
+    def _await_client_results(
+        self,
+        deadline: float,
+        expected_id: str = "",
+        input_closed: Optional[threading.Event] = None,
+    ):
         """Wait for results for THIS call; stale batches (wrong or missing
         tool_call_id from an earlier timed-out call) are discarded and the
         wait continues with the remaining budget. Polls in short slices so a
         cancel_turn during the (up to 60s) client-tool wait ends the turn
         promptly instead of holding the turn lock to the full timeout.
+        A set input_closed (client stream gone — results can never arrive)
+        ends the wait the same way: the cancel *frame* can be lost when the
+        client tears the RPC down right after sending it, so stream
+        teardown itself must also unblock this wait.
         Returns the results, None on timeout, or _CANCELLED."""
         stop_at = min(time.monotonic() + CLIENT_TOOL_TIMEOUT_S, deadline)
         while True:
             if self._cancel_requested.is_set():
                 return self._CANCELLED
+            # Drain-before-close: results the reader queued just before the
+            # stream half-closed are legitimate (send-then-half-close is
+            # legal gRPC), so the queue is always checked before a set
+            # input_closed ends the wait.
+            closed = input_closed is not None and input_closed.is_set()
             timeout = stop_at - time.monotonic()
             if timeout <= 0:
                 return None
             try:
-                results = self._client_results.get(timeout=min(timeout, 0.25))
+                if closed:
+                    results = self._client_results.get_nowait()
+                else:
+                    results = self._client_results.get(timeout=min(timeout, 0.25))
             except queue.Empty:
+                if closed:
+                    return self._CANCELLED
                 continue
             if not expected_id or any(r.tool_call_id == expected_id for r in results):
                 return results

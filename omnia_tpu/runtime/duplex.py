@@ -68,9 +68,13 @@ class DuplexSession:
     client messages; `barge_in()` is called from the stream reader thread
     when audio arrives while the agent is speaking."""
 
-    def __init__(self, conversation, speech: SpeechSupport):
+    def __init__(self, conversation, speech: SpeechSupport, input_closed=None):
         self.conv = conversation
         self.speech = speech
+        # Transport teardown signal, threaded into turns so a client-tool
+        # wait inside a duplex utterance ends when the stream dies (same
+        # contract as text turns — see Conversation.stream).
+        self.input_closed = input_closed
         self.format = dict(DEFAULT_FORMAT)
         self.negotiated = False
         self._buffer = bytearray()
@@ -135,7 +139,9 @@ class DuplexSession:
         self._speaking.set()
         assistant_text = []
         try:
-            for m in self.conv.stream(ClientMessage(content=utterance)):
+            for m in self.conv.stream(
+                ClientMessage(content=utterance), input_closed=self.input_closed
+            ):
                 if self._interrupted.is_set():
                     yield ServerMessage(type="interruption", text="barge-in")
                     return

@@ -173,6 +173,10 @@ class RuntimeServer:
         inbox: "queue.Queue[Optional[c.ClientMessage]]" = queue.Queue()
         duplex: Optional[object] = None
         duplex_lock = threading.Lock()
+        # Set when this stream can produce no further client input (half-
+        # close or break) — lets a client-tool wait end immediately even if
+        # the protocol-level cancel frame was lost in stream teardown.
+        input_closed = threading.Event()
 
         def reader():
             try:
@@ -195,6 +199,7 @@ class RuntimeServer:
             except Exception:  # stream broken: unblock the writer
                 pass
             finally:
+                input_closed.set()
                 inbox.put(None)
 
         threading.Thread(target=reader, daemon=True).start()
@@ -215,7 +220,9 @@ class RuntimeServer:
                     from omnia_tpu.runtime.duplex import DuplexSession
 
                     with duplex_lock:
-                        duplex = DuplexSession(conv, self.speech)
+                        duplex = DuplexSession(
+                            conv, self.speech, input_closed=input_closed
+                        )
                         d = duplex
                     yield from d.handle_start(m)
                 elif m.type == "audio_input":
@@ -230,7 +237,9 @@ class RuntimeServer:
                         continue
                     yield from d.handle_audio(m)
                 else:
-                    yield from conv.stream(m, traceparent=traceparent)
+                    yield from conv.stream(
+                        m, traceparent=traceparent, input_closed=input_closed
+                    )
             except Exception as e:  # turn must not kill the stream silently
                 logger.exception("turn failed")
                 yield c.ServerMessage(

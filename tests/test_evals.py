@@ -165,7 +165,8 @@ class TestDirectWorker:
         )
         reg = ProviderRegistry()
         reg.register(ProviderSpec(name="echoer", type="mock", options={"scenarios": [
-            {"pattern": r"otter.*what is the code word", "reply": "the code word is otter"},
+            {"pattern": r"otter.*what is the code word", "reply": "the code word is otter",
+             "match": "prompt"},  # deliberately asserts history retention
             {"pattern": ".", "reply": "ok"}]}))
         q = ArenaQueue()
         q.enqueue(partition(spec))

@@ -241,6 +241,76 @@ class TestConversation:
         text = "".join(m.text for m in out if m.type == "chunk")
         assert text == "summarized"
 
+    def test_input_closed_ends_client_tool_wait(self):
+        """Stream teardown (input_closed) must end a client-tool wait
+        promptly — the protocol cancel frame can be lost in teardown."""
+        scenarios = [
+            Scenario(
+                pattern="summarize",
+                reply='<tool_call>{"name": "browser", "arguments": {}}</tool_call>',
+            ),
+        ]
+        conv = _make_conversation(scenarios)
+        closed = threading.Event()
+        out = []
+        t0 = time.monotonic()
+
+        def run():
+            out.extend(
+                conv.stream(c.ClientMessage(content="summarize this"), input_closed=closed)
+            )
+
+        t = threading.Thread(target=run)
+        t.start()
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            if any(m.type == "tool_call" for m in out):
+                break
+            time.sleep(0.01)
+        closed.set()  # client went away without results
+        t.join(timeout=10)
+        assert not t.is_alive()
+        assert time.monotonic() - t0 < 10  # not the 60s client-tool timeout
+        assert out[-1].type == "done" and out[-1].finish_reason == "cancelled"
+
+    def test_results_queued_before_close_still_consumed(self):
+        """Send-then-half-close is legal: results queued before input_closed
+        fires must be consumed, not discarded as a cancel."""
+        scenarios = [
+            # tool-result scenario first: list order decides when both match
+            Scenario(pattern=r"\[TOOL\]page content", reply="summarized"),
+            Scenario(
+                pattern="summarize",
+                reply='<tool_call>{"name": "browser", "arguments": {}}</tool_call>',
+            ),
+        ]
+        conv = _make_conversation(scenarios)
+        closed = threading.Event()
+        out = []
+
+        def run():
+            out.extend(
+                conv.stream(c.ClientMessage(content="summarize this"), input_closed=closed)
+            )
+
+        t = threading.Thread(target=run)
+        t.start()
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            if any(m.type == "tool_call" for m in out):
+                break
+            time.sleep(0.01)
+        tc = next(m for m in out if m.type == "tool_call")
+        # reader delivers results, THEN the stream half-closes
+        conv.provide_tool_results(
+            [c.ToolResult(tool_call_id=tc.tool_call.tool_call_id, content="page content")]
+        )
+        closed.set()
+        t.join(timeout=10)
+        text = "".join(m.text for m in out if m.type == "chunk")
+        assert text == "summarized"
+        assert out[-1].type == "done" and out[-1].finish_reason == "stop"
+
     def test_tool_loop_limit(self):
         scenarios = [
             Scenario(
