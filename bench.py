@@ -78,7 +78,21 @@ def main() -> None:
         ttft_iters, decode_tokens = 5, 32
 
     cfg = get_config(model_name)
-    engine = InferenceEngine(cfg, ecfg, seed=0)
+    params = None
+    ckpt = os.environ.get("OMNIA_CHECKPOINT")
+    if ckpt:
+        # Serve real weights: the checkpoint's config.json overrides the
+        # preset (same authority rule as the tpu Provider path).
+        from omnia_tpu.engine.types import resolve_dtype
+        from omnia_tpu.models import checkpoint as ckpt_io
+
+        cfg = ckpt_io.read_config(ckpt)
+        model_name = cfg.name
+        params = ckpt_io.load_params(
+            ckpt, cfg,
+            dtype=resolve_dtype(ecfg.dtype),
+        )
+    engine = InferenceEngine(cfg, ecfg, params=params, seed=0)
     t0 = time.monotonic()
     engine.warmup()
     warmup_s = time.monotonic() - t0

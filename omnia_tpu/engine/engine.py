@@ -54,6 +54,7 @@ from omnia_tpu.engine.types import (
     RequestHandle,
     SamplingParams,
     StreamEvent,
+    resolve_dtype,
 )
 from omnia_tpu.models import ModelConfig
 from omnia_tpu.models import llama
@@ -114,7 +115,7 @@ class InferenceEngine:
         if engine_cfg.num_slots % max(engine_cfg.dp, 1) != 0:
             raise ValueError("num_slots must be divisible by dp")
 
-        self._dtype = jnp.bfloat16 if engine_cfg.dtype == "bfloat16" else jnp.float32
+        self._dtype = resolve_dtype(engine_cfg.dtype)
         self._mesh = None
         use_mesh = engine_cfg.dp * engine_cfg.tp > 1
         if use_mesh:

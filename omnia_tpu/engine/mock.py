@@ -60,8 +60,11 @@ class Scenario:
 def _current_turn_view(prompt: str) -> str:
     """System block + last user turn (incl. this turn's tool rounds):
     previous conversation turns are cut out. The marker is anchored at a
-    line start so message *content* containing the literal '[USER]' can't
-    hijack the split."""
+    line start, which keeps ordinary content containing the literal
+    '[USER]' from hijacking the split; content that embeds a full
+    newline-prefixed marker (a pasted transcript) can still confuse it —
+    acceptable for a test mock, don't put raw transcripts in scenario
+    content."""
     sys_end = prompt.find("[/SYS]")
     last_user = prompt.rfind("\n[USER]")
     if sys_end < 0 or last_user < 0 or last_user < sys_end:

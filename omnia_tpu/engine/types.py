@@ -104,6 +104,22 @@ class RequestHandle:
         raise AssertionError("stream ended without final event")
 
 
+def resolve_dtype(name: str):
+    """EngineConfig.dtype string → jnp dtype. The single mapping shared by
+    the engine, the provider layer, and bench — adding a dtype means
+    touching exactly this table."""
+    import jax.numpy as jnp
+
+    table = {
+        "bfloat16": jnp.bfloat16,
+        "float32": jnp.float32,
+        "float16": jnp.float16,
+    }
+    if name not in table:
+        raise ValueError(f"unknown engine dtype {name!r}; have {sorted(table)}")
+    return table[name]
+
+
 @dataclasses.dataclass(frozen=True)
 class EngineConfig:
     """Serving-engine shape/placement configuration.

@@ -1,0 +1,441 @@
+"""HF-layout checkpoint I/O: safetensors ⇄ the stacked serving pytree.
+
+The reference platform points a Provider CR at a model *name* and lets a
+SaaS API own the weights (reference api/v1alpha1/provider_types.go:322-412).
+The TPU-native equivalent of "point the provider at a model" is loading the
+actual weights into the engine's sharded param pytree. This module reads
+HuggingFace-layout llama/mixtral checkpoints (config.json +
+*.safetensors [+ model.safetensors.index.json]) into the stacked [L, ...]
+pytree that models/llama.py consumes:
+
+- **Streaming**: tensors are read one at a time and written into a
+  preallocated host buffer per stacked parameter, so peak host memory is
+  ~one stacked parameter above the weight bytes themselves — never 2× the
+  checkpoint.
+- **Sharded placement**: with a mesh, every leaf is device_put with its
+  NamedSharding from ``llama.param_specs`` as soon as it is assembled, so
+  per-device HBM only ever holds that device's shard.
+- **Convention match**: PyTorch ``nn.Linear`` stores [out, in]; this
+  pytree right-multiplies activations, so projection matrices transpose on
+  load. RoPE here is the same rotate-half convention transformers uses for
+  llama — weights load with no head permutation.
+
+``save_params`` writes the same HF layout back (sharded, with index),
+which is both the round-trip test harness and the export path.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import ml_dtypes
+import numpy as np
+
+from omnia_tpu.models.config import ModelConfig
+from omnia_tpu.models.llama import param_specs
+
+
+class CheckpointError(ValueError):
+    pass
+
+
+_JNP_TO_NP = {
+    jnp.bfloat16: ml_dtypes.bfloat16,
+    jnp.float32: np.float32,
+    jnp.float16: np.float16,
+}
+
+
+def _np_dtype(dtype):
+    for j, n in _JNP_TO_NP.items():
+        if dtype == j:
+            return n
+    return np.dtype(dtype)
+
+
+# ---------------------------------------------------------------------------
+# config.json ⇄ ModelConfig
+# ---------------------------------------------------------------------------
+
+
+_SUPPORTED_MODEL_TYPES = {"llama", "mixtral"}
+
+
+def _parse_rope_scaling(d: dict):
+    """HF rope_scaling → the hashable tuple ModelConfig carries. Silently
+    dropping an unsupported scheme would serve garbled long-context
+    generations with no error, so anything unrecognized raises."""
+    rs = d.get("rope_scaling")
+    if rs is None:
+        return None
+    rope_type = rs.get("rope_type") or rs.get("type")
+    if rope_type == "default":
+        return None
+    if rope_type != "llama3":
+        raise CheckpointError(
+            f"unsupported rope_scaling type {rope_type!r} (supported: llama3)"
+        )
+    try:
+        return (
+            float(rs["factor"]),
+            float(rs["low_freq_factor"]),
+            float(rs["high_freq_factor"]),
+            float(rs["original_max_position_embeddings"]),
+        )
+    except KeyError as e:
+        raise CheckpointError(f"rope_scaling missing field {e}") from e
+
+
+def hf_config_to_model(d: dict, name: str = "checkpoint") -> ModelConfig:
+    """Map a HuggingFace llama/mixtral config.json dict to a ModelConfig."""
+    model_type = d.get("model_type")
+    if model_type is not None and model_type not in _SUPPORTED_MODEL_TYPES:
+        raise CheckpointError(
+            f"unsupported model_type {model_type!r} "
+            f"(supported: {sorted(_SUPPORTED_MODEL_TYPES)})"
+        )
+    try:
+        n_heads = int(d["num_attention_heads"])
+        hidden = int(d["hidden_size"])
+        cfg = ModelConfig(
+            name=name,
+            vocab_size=int(d["vocab_size"]),
+            hidden_size=hidden,
+            num_layers=int(d["num_hidden_layers"]),
+            num_heads=n_heads,
+            num_kv_heads=int(d.get("num_key_value_heads") or n_heads),
+            head_dim=int(d.get("head_dim") or hidden // n_heads),
+            ffn_hidden_size=int(d["intermediate_size"]),
+            rope_theta=float(d.get("rope_theta", 10000.0)),
+            rope_scaling=_parse_rope_scaling(d),
+            rms_norm_eps=float(d.get("rms_norm_eps", 1e-5)),
+            tie_embeddings=bool(d.get("tie_word_embeddings", False)),
+            num_experts=int(d.get("num_local_experts") or 0),
+            num_experts_per_tok=int(d.get("num_experts_per_tok") or 2),
+            max_seq_len=int(d.get("max_position_embeddings", 8192)),
+        )
+    except KeyError as e:
+        raise CheckpointError(f"config.json missing required field {e}") from e
+    return cfg
+
+
+def model_to_hf_config(cfg: ModelConfig) -> dict:
+    arch = "MixtralForCausalLM" if cfg.is_moe else "LlamaForCausalLM"
+    d = {
+        "architectures": [arch],
+        "model_type": "mixtral" if cfg.is_moe else "llama",
+        "vocab_size": cfg.vocab_size,
+        "hidden_size": cfg.hidden_size,
+        "num_hidden_layers": cfg.num_layers,
+        "num_attention_heads": cfg.num_heads,
+        "num_key_value_heads": cfg.num_kv_heads,
+        "head_dim": cfg.head_dim,
+        "intermediate_size": cfg.ffn_hidden_size,
+        "rope_theta": cfg.rope_theta,
+        "rms_norm_eps": cfg.rms_norm_eps,
+        "tie_word_embeddings": cfg.tie_embeddings,
+        "max_position_embeddings": cfg.max_seq_len,
+    }
+    if cfg.is_moe:
+        d["num_local_experts"] = cfg.num_experts
+        d["num_experts_per_tok"] = cfg.num_experts_per_tok
+    if cfg.rope_scaling is not None:
+        factor, low, high, orig = cfg.rope_scaling
+        d["rope_scaling"] = {
+            "rope_type": "llama3",
+            "factor": factor,
+            "low_freq_factor": low,
+            "high_freq_factor": high,
+            "original_max_position_embeddings": orig,
+        }
+    return d
+
+
+def read_config(path: str, name: Optional[str] = None) -> ModelConfig:
+    cfg_path = os.path.join(path, "config.json")
+    if not os.path.exists(cfg_path):
+        raise CheckpointError(f"no config.json under {path}")
+    with open(cfg_path) as f:
+        d = json.load(f)
+    return hf_config_to_model(d, name=name or os.path.basename(path.rstrip("/")))
+
+
+# ---------------------------------------------------------------------------
+# Shard reading
+# ---------------------------------------------------------------------------
+
+
+class _ShardReader:
+    """name → tensor across a (possibly sharded) safetensors checkpoint,
+    keeping shard files open lazily so reads stream without re-scanning."""
+
+    def __init__(self, path: str):
+        from safetensors import safe_open
+
+        self._safe_open = safe_open
+        self.path = path
+        self._handles: dict = {}
+        index = os.path.join(path, "model.safetensors.index.json")
+        if os.path.exists(index):
+            with open(index) as f:
+                self._map = dict(json.load(f)["weight_map"])
+        else:
+            files = sorted(glob.glob(os.path.join(path, "*.safetensors")))
+            if not files:
+                raise CheckpointError(f"no *.safetensors under {path}")
+            self._map = {}
+            for fp in files:
+                with safe_open(fp, framework="np") as f:
+                    for k in f.keys():
+                        self._map[k] = os.path.basename(fp)
+
+    def names(self) -> set:
+        return set(self._map)
+
+    def has(self, name: str) -> bool:
+        return name in self._map
+
+    def get(self, name: str) -> np.ndarray:
+        if name not in self._map:
+            raise CheckpointError(f"tensor {name!r} not in checkpoint")
+        fname = self._map[name]
+        h = self._handles.get(fname)
+        if h is None:
+            h = self._handles[fname] = self._safe_open(
+                os.path.join(self.path, fname), framework="np"
+            )
+        return h.get_tensor(name)
+
+
+# ---------------------------------------------------------------------------
+# Tensor name mapping (HF llama / mixtral layout)
+# ---------------------------------------------------------------------------
+
+_ATTN = {
+    "wq": "model.layers.{i}.self_attn.q_proj.weight",
+    "wk": "model.layers.{i}.self_attn.k_proj.weight",
+    "wv": "model.layers.{i}.self_attn.v_proj.weight",
+    "wo": "model.layers.{i}.self_attn.o_proj.weight",
+}
+_DENSE_MLP = {
+    "wg": "model.layers.{i}.mlp.gate_proj.weight",
+    "wu": "model.layers.{i}.mlp.up_proj.weight",
+    "wd": "model.layers.{i}.mlp.down_proj.weight",
+}
+_MOE = {
+    "router": "model.layers.{i}.block_sparse_moe.gate.weight",
+    "wg": "model.layers.{i}.block_sparse_moe.experts.{e}.w1.weight",
+    "wu": "model.layers.{i}.block_sparse_moe.experts.{e}.w3.weight",
+    "wd": "model.layers.{i}.block_sparse_moe.experts.{e}.w2.weight",
+}
+
+
+# ---------------------------------------------------------------------------
+# Loading
+# ---------------------------------------------------------------------------
+
+
+def load_params(
+    path: str,
+    cfg: Optional[ModelConfig] = None,
+    dtype=jnp.bfloat16,
+    mesh=None,
+):
+    """Load an HF-layout llama/mixtral checkpoint into the stacked pytree.
+
+    With ``mesh``, each leaf is placed with its ``param_specs`` sharding as
+    it is assembled (per-device HBM holds only that device's shard);
+    without, leaves are committed to the default device.
+    """
+    cfg = cfg or read_config(path)
+    np_dt = _np_dtype(dtype)
+    reader = _ShardReader(path)
+    specs = param_specs(cfg)
+    L, D, F, V = cfg.num_layers, cfg.hidden_size, cfg.ffn_hidden_size, cfg.vocab_size
+
+    if mesh is not None:
+        from jax.sharding import NamedSharding
+
+        def put(arr, spec):
+            return jax.device_put(arr, NamedSharding(mesh, spec))
+    else:
+        def put(arr, spec):
+            return jnp.asarray(arr)
+
+    def fetch(name: str, want_shape: tuple, transpose: bool) -> np.ndarray:
+        t = reader.get(name)
+        if transpose:
+            t = t.T  # torch Linear [out,in] → right-multiply [in,out]
+        if tuple(t.shape) != want_shape:
+            raise CheckpointError(
+                f"{name}: shape {tuple(t.shape)} != expected {want_shape}"
+                f"{' (after transpose)' if transpose else ''}"
+            )
+        return t
+
+    def single(name: str, shape: tuple, spec, transpose: bool = False):
+        return put(np.asarray(fetch(name, shape, transpose), dtype=np_dt), spec)
+
+    def stacked(tmpl: str, shape: tuple, spec, transpose: bool = True):
+        out = np.empty((L, *shape), dtype=np_dt)
+        for i in range(L):
+            out[i] = fetch(tmpl.format(i=i), shape, transpose)
+        return put(out, spec)
+
+    def stacked_experts(tmpl: str, shape: tuple, spec):
+        E = cfg.num_experts
+        out = np.empty((L, E, *shape), dtype=np_dt)
+        for i in range(L):
+            for e in range(E):
+                out[i, e] = fetch(tmpl.format(i=i, e=e), shape, True)
+        return put(out, spec)
+
+    attn_specs = specs["layers"]["attn"]
+    attn = {
+        "wq": stacked(_ATTN["wq"], (D, cfg.q_dim), attn_specs["wq"]),
+        "wk": stacked(_ATTN["wk"], (D, cfg.kv_dim), attn_specs["wk"]),
+        "wv": stacked(_ATTN["wv"], (D, cfg.kv_dim), attn_specs["wv"]),
+        "wo": stacked(_ATTN["wo"], (cfg.q_dim, D), attn_specs["wo"]),
+    }
+    mlp_specs = specs["layers"]["mlp"]
+    if cfg.is_moe:
+        mlp = {
+            "router": stacked(_MOE["router"], (D, cfg.num_experts), mlp_specs["router"]),
+            "wg": stacked_experts(_MOE["wg"], (D, F), mlp_specs["wg"]),
+            "wu": stacked_experts(_MOE["wu"], (D, F), mlp_specs["wu"]),
+            "wd": stacked_experts(_MOE["wd"], (F, D), mlp_specs["wd"]),
+        }
+    else:
+        mlp = {
+            "wg": stacked(_DENSE_MLP["wg"], (D, F), mlp_specs["wg"]),
+            "wu": stacked(_DENSE_MLP["wu"], (D, F), mlp_specs["wu"]),
+            "wd": stacked(_DENSE_MLP["wd"], (F, D), mlp_specs["wd"]),
+        }
+    params = {
+        "embed": single("model.embed_tokens.weight", (V, D), specs["embed"]),
+        "layers": {
+            "ln1": stacked(
+                "model.layers.{i}.input_layernorm.weight",
+                (D,), specs["layers"]["ln1"], transpose=False,
+            ),
+            "ln2": stacked(
+                "model.layers.{i}.post_attention_layernorm.weight",
+                (D,), specs["layers"]["ln2"], transpose=False,
+            ),
+            "attn": attn,
+            "mlp": mlp,
+        },
+        "final_norm": single("model.norm.weight", (D,), specs["final_norm"]),
+    }
+    if not cfg.tie_embeddings:
+        if reader.has("lm_head.weight"):
+            params["lm_head"] = single(
+                "lm_head.weight", (D, V), specs["lm_head"], transpose=True
+            )
+        else:
+            # Some checkpoints omit lm_head and tie on load; honor that.
+            params["lm_head"] = put(
+                np.asarray(
+                    fetch("model.embed_tokens.weight", (V, D), False).T, dtype=np_dt
+                ),
+                specs["lm_head"],
+            )
+    return params
+
+
+# ---------------------------------------------------------------------------
+# Saving (HF layout back out; also the round-trip test harness)
+# ---------------------------------------------------------------------------
+
+
+def save_params(
+    params,
+    cfg: ModelConfig,
+    path: str,
+    max_shard_bytes: int = 2 * 1024**3,
+) -> None:
+    """Write the stacked pytree as an HF-layout safetensors checkpoint
+    (config.json + shard files + index when more than one shard)."""
+    from safetensors.numpy import save_file
+
+    os.makedirs(path, exist_ok=True)
+    with open(os.path.join(path, "config.json"), "w") as f:
+        json.dump(model_to_hf_config(cfg), f, indent=2)
+
+    def host(x) -> np.ndarray:
+        # Per-tensor device→host pull: for a stacked [L, ...] param only the
+        # indexed layer slice crosses, so peak host memory stays ~one shard —
+        # never a full second copy of the model.
+        return np.ascontiguousarray(np.asarray(jax.device_get(x)))
+
+    def tensors():
+        lay = params["layers"]
+        yield "model.embed_tokens.weight", host(params["embed"])
+        for i in range(cfg.num_layers):
+            yield f"model.layers.{i}.input_layernorm.weight", host(lay["ln1"][i])
+            yield f"model.layers.{i}.post_attention_layernorm.weight", host(lay["ln2"][i])
+            for key, tmpl in _ATTN.items():
+                yield tmpl.format(i=i), host(lay["attn"][key][i]).T
+            if cfg.is_moe:
+                yield _MOE["router"].format(i=i), host(lay["mlp"]["router"][i]).T
+                for e in range(cfg.num_experts):
+                    for key in ("wg", "wu", "wd"):
+                        yield (
+                            _MOE[key].format(i=i, e=e),
+                            host(lay["mlp"][key][i, e]).T,
+                        )
+            else:
+                for key, tmpl in _DENSE_MLP.items():
+                    yield tmpl.format(i=i), host(lay["mlp"][key][i]).T
+        yield "model.norm.weight", host(params["final_norm"])
+        if not cfg.tie_embeddings:
+            yield "lm_head.weight", host(params["lm_head"]).T
+
+    # Greedy size-based sharding, each shard written (and freed) as it
+    # fills. Files get temp names because the final HF-style names need the
+    # total shard count, unknown until the end; renames are cheap.
+    tmp_names: list[str] = []
+    shard_names: list[list[str]] = []
+    shard: dict = {}
+    size = 0
+    total = 0
+
+    def flush():
+        nonlocal shard, size
+        if not shard:
+            return
+        fname = f"model.tmp-{len(tmp_names)}.safetensors"
+        save_file(shard, os.path.join(path, fname))
+        tmp_names.append(fname)
+        shard_names.append(list(shard))
+        shard = {}
+        size = 0
+
+    for name, arr in tensors():
+        arr = np.ascontiguousarray(arr)
+        if size > 0 and size + arr.nbytes > max_shard_bytes:
+            flush()
+        shard[name] = arr
+        size += arr.nbytes
+        total += arr.nbytes
+    flush()
+
+    if len(tmp_names) == 1:
+        os.replace(
+            os.path.join(path, tmp_names[0]), os.path.join(path, "model.safetensors")
+        )
+        return
+    weight_map = {}
+    n = len(tmp_names)
+    for idx, (tmp, names) in enumerate(zip(tmp_names, shard_names), start=1):
+        fname = f"model-{idx:05d}-of-{n:05d}.safetensors"
+        os.replace(os.path.join(path, tmp), os.path.join(path, fname))
+        for name in names:
+            weight_map[name] = fname
+    with open(os.path.join(path, "model.safetensors.index.json"), "w") as f:
+        json.dump({"metadata": {"total_size": total}, "weight_map": weight_map}, f)

@@ -24,6 +24,10 @@ class ModelConfig:
     head_dim: int = 128
     ffn_hidden_size: int = 14336
     rope_theta: float = 500000.0
+    # Llama-3.1 'llama3' rope_type long-context frequency remap, as a
+    # hashable tuple (factor, low_freq_factor, high_freq_factor,
+    # original_max_position_embeddings); None = plain RoPE.
+    rope_scaling: Optional[tuple] = None
     rms_norm_eps: float = 1e-5
     tie_embeddings: bool = False
     # MoE (Mixtral-style). num_experts == 0 means dense MLP.

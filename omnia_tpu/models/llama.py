@@ -199,7 +199,7 @@ def forward_prefill(params, cfg: ModelConfig, tokens, q_positions):
     Returns (logits [B, T, V] f32, k_chunk, v_chunk [L, B, T, Hkv, D]).
     """
     x = params["embed"][tokens]
-    cos, sin = rope_cos_sin(q_positions, cfg.head_dim, cfg.rope_theta)
+    cos, sin = rope_cos_sin(q_positions, cfg.head_dim, cfg.rope_theta, cfg.rope_scaling)
 
     def body(x, p):
         x, k, v = _layer(x, p, cfg, cos, sin, q_positions, None, None, None)
@@ -229,7 +229,7 @@ def forward(params, cfg: ModelConfig, tokens, q_positions, cache_k, cache_v, wri
     Returns (logits [B, T, V] f32, new_cache_k, new_cache_v).
     """
     x = params["embed"][tokens]  # [B,T,D]
-    cos, sin = rope_cos_sin(q_positions, cfg.head_dim, cfg.rope_theta)
+    cos, sin = rope_cos_sin(q_positions, cfg.head_dim, cfg.rope_theta, cfg.rope_scaling)
 
     def body(carry, scanned):
         x = carry
@@ -253,7 +253,7 @@ def forward_embed(params, cfg: ModelConfig, tokens, mask):
     B, T = tokens.shape
     x = params["embed"][tokens]
     q_positions = jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32)[None, :], (B, T))
-    cos, sin = rope_cos_sin(q_positions, cfg.head_dim, cfg.rope_theta)
+    cos, sin = rope_cos_sin(q_positions, cfg.head_dim, cfg.rope_theta, cfg.rope_scaling)
 
     def body(x, p):
         x, _, _ = _layer(x, p, cfg, cos, sin, q_positions, None, None, None)
@@ -274,7 +274,7 @@ def forward_train(params, cfg: ModelConfig, tokens):
     B, T = tokens.shape
     x = params["embed"][tokens]
     q_positions = jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32)[None, :], (B, T))
-    cos, sin = rope_cos_sin(q_positions, cfg.head_dim, cfg.rope_theta)
+    cos, sin = rope_cos_sin(q_positions, cfg.head_dim, cfg.rope_theta, cfg.rope_scaling)
 
     def body(x, p):
         x, _, _ = _layer(x, p, cfg, cos, sin, q_positions, None, None, None)

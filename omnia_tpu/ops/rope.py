@@ -11,17 +11,53 @@ from __future__ import annotations
 import jax.numpy as jnp
 
 
-def rope_cos_sin(positions: jnp.ndarray, head_dim: int, theta: float):
+def rope_cos_sin(
+    positions: jnp.ndarray,
+    head_dim: int,
+    theta: float,
+    scaling: tuple | None = None,
+):
     """Cos/sin for rotary embedding.
 
     positions: int array [...]. Returns (cos, sin) of shape [..., head_dim//2]
-    in float32.
+    in float32. `scaling` is the llama3 long-context frequency remap as a
+    tuple (factor, low_freq_factor, high_freq_factor,
+    original_max_position_embeddings) — the convention Llama 3.1/3.2
+    checkpoints ship in config.json rope_scaling; None = plain RoPE.
     """
     inv_freq = 1.0 / (
         theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim)
     )
+    if scaling is not None:
+        inv_freq = _llama3_scaled_inv_freq(inv_freq, *scaling)
     angles = positions[..., None].astype(jnp.float32) * inv_freq
     return jnp.cos(angles), jnp.sin(angles)
+
+
+def _llama3_scaled_inv_freq(
+    inv_freq: jnp.ndarray,
+    factor: float,
+    low_freq_factor: float,
+    high_freq_factor: float,
+    original_max_position: float,
+):
+    """Llama-3.1 'llama3' rope_type: long wavelengths (relative to the
+    original training context) are slowed by `factor`, short ones kept, and
+    the band between low/high_freq_factor blends smoothly."""
+    wavelen = 2.0 * jnp.pi / inv_freq
+    low_wavelen = original_max_position / low_freq_factor
+    high_wavelen = original_max_position / high_freq_factor
+    # smooth ramp: 0 at low boundary → 1 at high boundary
+    smooth = (original_max_position / wavelen - low_freq_factor) / (
+        high_freq_factor - low_freq_factor
+    )
+    smooth = jnp.clip(smooth, 0.0, 1.0)
+    blended = (1.0 - smooth) * inv_freq / factor + smooth * inv_freq
+    return jnp.where(
+        wavelen > low_wavelen,
+        inv_freq / factor,
+        jnp.where(wavelen < high_wavelen, inv_freq, blended),
+    )
 
 
 def apply_rope(x: jnp.ndarray, cos: jnp.ndarray, sin: jnp.ndarray) -> jnp.ndarray:

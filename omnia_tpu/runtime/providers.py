@@ -58,11 +58,6 @@ def build_engine(spec: ProviderSpec, *, warmup: bool = False):
     if spec.type == "tpu":
         from omnia_tpu.models import PRESETS, get_config
 
-        if spec.model not in PRESETS:
-            raise ProviderError(
-                f"unknown model preset {spec.model!r}; have {sorted(PRESETS)}"
-            )
-        cfg = get_config(spec.model)
         eng_kwargs = {
             k: v
             for k, v in spec.options.items()
@@ -70,7 +65,39 @@ def build_engine(spec: ProviderSpec, *, warmup: bool = False):
         }
         if "prefill_buckets" in eng_kwargs:
             eng_kwargs["prefill_buckets"] = tuple(eng_kwargs["prefill_buckets"])
-        engine = InferenceEngine(cfg, EngineConfig(**eng_kwargs), seed=spec.options.get("seed", 0))
+        ecfg = EngineConfig(**eng_kwargs)
+
+        params = None
+        ckpt = spec.options.get("checkpoint_path")
+        if ckpt:
+            # Real weights: the checkpoint's config.json is the
+            # architecture authority (spec.model is just a label) — the
+            # TPU-native analog of the reference resolving a Provider's
+            # model string against a remote API
+            # (provider_types.go:322-412).
+            from omnia_tpu.engine.types import resolve_dtype
+            from omnia_tpu.models import checkpoint as ckpt_io
+
+            cfg = ckpt_io.read_config(ckpt, name=spec.model or None)
+            mesh = None
+            if ecfg.dp * ecfg.tp > 1:
+                from omnia_tpu.parallel import make_mesh
+
+                # Same mesh construction the engine performs, so leaves
+                # arrive pre-sharded and the engine's shard_pytree no-ops
+                # instead of bouncing the weights through one device.
+                mesh = make_mesh(ecfg.dp, ecfg.tp)
+            dtype = resolve_dtype(ecfg.dtype)
+            params = ckpt_io.load_params(ckpt, cfg, dtype=dtype, mesh=mesh)
+        else:
+            if spec.model not in PRESETS:
+                raise ProviderError(
+                    f"unknown model preset {spec.model!r}; have {sorted(PRESETS)}"
+                )
+            cfg = get_config(spec.model)
+        engine = InferenceEngine(
+            cfg, ecfg, params=params, seed=spec.options.get("seed", 0)
+        )
         if warmup:
             engine.warmup()
         return engine
@@ -78,7 +105,19 @@ def build_engine(spec: ProviderSpec, *, warmup: bool = False):
 
 
 def build_tokenizer(spec: ProviderSpec):
+    """Tokenizer for a provider: explicit tokenizer_path, else the
+    checkpoint directory when it carries tokenizer files (the usual HF
+    layout ships tokenizer.json next to the weights), else bytes."""
+    import os
+
     path = spec.options.get("tokenizer_path")
+    if not path:
+        ckpt = spec.options.get("checkpoint_path")
+        if ckpt and any(
+            os.path.exists(os.path.join(ckpt, f))
+            for f in ("tokenizer.json", "tokenizer.model", "tokenizer_config.json")
+        ):
+            path = ckpt
     if path:
         from omnia_tpu.engine.tokenizer import HFTokenizer
 
