@@ -76,6 +76,8 @@ class _Slot:
         "generated",
         "max_total",
         "stop_ids",
+        "session_id",
+        "emitted",
     )
 
     def __init__(self):
@@ -85,6 +87,8 @@ class _Slot:
         self.generated = 0
         self.max_total = 0       # generation cap (request max_tokens)
         self.stop_ids: frozenset[int] = frozenset()
+        self.session_id: Optional[str] = None  # pinned session (may be idle)
+        self.emitted: list[int] = []           # tokens emitted this request
 
     @property
     def active(self) -> bool:
@@ -95,6 +99,30 @@ class _Slot:
         self.handle = None
         self.length = 0
         self.generated = 0
+        self.emitted = []
+
+
+class _SessionKV:
+    """A logical session's KV residency record.
+
+    Exactly one of (slot is not None) / (host_k is not None) / neither
+    holds: resident in a device slot, paged out to host RAM, or empty.
+    token_ids are the tokens whose KV rows are KNOWN valid — on finish the
+    last emitted token is conservatively excluded (its row write is not
+    guaranteed when a slot finishes mid-decode-chunk), costing one
+    re-prefilled token per turn instead of a correctness proof over chunk
+    timing.
+    """
+
+    __slots__ = ("session_id", "token_ids", "slot", "host_k", "host_v", "last_used")
+
+    def __init__(self, session_id: str):
+        self.session_id = session_id
+        self.token_ids: list[int] = []
+        self.slot: Optional[int] = None
+        self.host_k: Optional[np.ndarray] = None  # [L, R, H, D] padded rows
+        self.host_v: Optional[np.ndarray] = None
+        self.last_used = time.monotonic()
 
 
 class InferenceEngine:
@@ -135,6 +163,11 @@ class InferenceEngine:
         self._waiting: list[tuple[Request, RequestHandle]] = []
         self._lock = threading.Lock()
         self._req_counter = itertools.count()
+        # Sessionful KV registry — engine-thread-owned: only step() and the
+        # helpers it calls touch it. Cross-thread requests (release_session)
+        # arrive via _pending_releases under _lock. LRU uses last_used.
+        self._sessions: dict[str, _SessionKV] = {}
+        self._pending_releases: list[str] = []
 
         self._thread: Optional[threading.Thread] = None
         self._stop_event = threading.Event()
@@ -147,6 +180,10 @@ class InferenceEngine:
             "tokens_generated": 0,
             "prefill_steps": 0,
             "decode_steps": 0,
+            "extend_steps": 0,
+            "prefix_reuse_tokens": 0,
+            "session_offloads": 0,
+            "session_restores": 0,
         }
 
         self._build_programs()
@@ -243,22 +280,107 @@ class InferenceEngine:
             make_decode(1) if self.cfg.decode_chunk > 1 else self._decode_fn
         )
 
+        # --- sessionful-KV programs -----------------------------------
+        # Incremental extend: run the suffix through `forward` against the
+        # slot's EXISTING rows (cross-attention to history) with
+        # write_start at the reuse frontier. Batch-1 on a sliced slot cache
+        # — one slot's cache moves, not B× suffix FLOPs. One program per
+        # suffix bucket; shapes all static.
+        def extend(params, ck, cv, tokens, positions, slot, write_start, last_idx,
+                   key_data, temp, top_p, top_k):
+            L, B, S, H, D = ck.shape
+            k_slot = jax.lax.dynamic_slice(ck, (0, slot, 0, 0, 0), (L, 1, S, H, D))
+            v_slot = jax.lax.dynamic_slice(cv, (0, slot, 0, 0, 0), (L, 1, S, H, D))
+            logits, k_slot, v_slot = llama.forward(
+                params, cfg, tokens, positions, k_slot, v_slot, write_start[None]
+            )
+            ck = jax.lax.dynamic_update_slice(
+                ck, k_slot.astype(ck.dtype), (0, slot, 0, 0, 0)
+            )
+            cv = jax.lax.dynamic_update_slice(
+                cv, v_slot.astype(cv.dtype), (0, slot, 0, 0, 0)
+            )
+            last = jax.lax.dynamic_slice(
+                logits, (0, last_idx, 0), (1, 1, logits.shape[-1])
+            )[:, 0]
+            tok, new_kd = sample_tokens_per_slot(
+                last, key_data[None], temp[None], top_p[None], top_k[None]
+            )
+            return ck, cv, tok[0], new_kd[0]
+
+        self._extend_fn = jax.jit(extend, donate_argnums=(1, 2))
+
+        # Mid-extend chunk: writes rows, no sampling (sampling happens only
+        # on the final chunk of a multi-chunk extend).
+        def extend_nosample(params, ck, cv, tokens, positions, slot, write_start):
+            L, B, S, H, D = ck.shape
+            k_slot = jax.lax.dynamic_slice(ck, (0, slot, 0, 0, 0), (L, 1, S, H, D))
+            v_slot = jax.lax.dynamic_slice(cv, (0, slot, 0, 0, 0), (L, 1, S, H, D))
+            _, k_slot, v_slot = llama.forward(
+                params, cfg, tokens, positions, k_slot, v_slot, write_start[None]
+            )
+            ck = jax.lax.dynamic_update_slice(
+                ck, k_slot.astype(ck.dtype), (0, slot, 0, 0, 0)
+            )
+            cv = jax.lax.dynamic_update_slice(
+                cv, v_slot.astype(cv.dtype), (0, slot, 0, 0, 0)
+            )
+            return ck, cv
+
+        self._extend_nosample_fn = jax.jit(extend_nosample, donate_argnums=(1, 2))
+
+        # Session paging: pull/push one slot's leading rows in fixed
+        # restore-bucket shapes (device↔host transfers stay compile-stable).
+        def offload(ck, cv, slot, rows: int):
+            L, B, S, H, D = ck.shape
+            k = jax.lax.dynamic_slice(ck, (0, slot, 0, 0, 0), (L, 1, rows, H, D))
+            v = jax.lax.dynamic_slice(cv, (0, slot, 0, 0, 0), (L, 1, rows, H, D))
+            return k[:, 0], v[:, 0]
+
+        self._offload_fn = jax.jit(offload, static_argnums=(3,))
+
+        def restore(ck, cv, k_rows, v_rows, slot):
+            ck = jax.lax.dynamic_update_slice(
+                ck, k_rows[:, None].astype(ck.dtype), (0, slot, 0, 0, 0)
+            )
+            cv = jax.lax.dynamic_update_slice(
+                cv, v_rows[:, None].astype(cv.dtype), (0, slot, 0, 0, 0)
+            )
+            return ck, cv
+
+        self._restore_fn = jax.jit(restore, donate_argnums=(0, 1))
+
     def warmup(self):
-        """AOT-compile decode + all usable prefill buckets (called before
-        ready — the request path must never hit a compile). Behavior-neutral:
-        all device state and metrics it touched are restored afterwards."""
+        """AOT-compile decode + all usable prefill buckets + the sessionful
+        extend/offload/restore programs (called before ready — the request
+        path must never hit a compile). Behavior-neutral: all device state
+        and metrics it touched are restored afterwards."""
         t0 = time.monotonic()
         metrics_before = dict(self.metrics)
         self._run_decode_step()
         if self._decode_fn_single is not self._decode_fn:
             self._run_decode_step(single=True)
-        for b in self.cfg.usable_buckets():
+        kd = self._key_data[0]
+        zero = jnp.int32(0)
+        sargs = (kd, jnp.float32(0.0), jnp.float32(1.0), jnp.int32(0))
+        extend_shapes = set(self.cfg.usable_buckets()) | {1}
+        for b in sorted(extend_shapes):
             toks = jnp.zeros((1, b), jnp.int32)
             pos = jnp.arange(b, dtype=jnp.int32)[None, :]
-            logits, k_chunk, v_chunk = self._prefill_fn(self.params, toks, pos)
-            self._ck, self._cv, _, self._key_data = self._run_insert(
-                k_chunk, v_chunk, 0, logits[:, -1]
+            if b in self.cfg.usable_buckets():
+                logits, k_chunk, v_chunk = self._prefill_fn(self.params, toks, pos)
+                self._ck, self._cv, _, self._key_data = self._run_insert(
+                    k_chunk, v_chunk, 0, logits[:, -1]
+                )
+            self._ck, self._cv = self._extend_nosample_fn(
+                self.params, self._ck, self._cv, toks, pos, zero, zero
             )
+            self._ck, self._cv, _, _ = self._extend_fn(
+                self.params, self._ck, self._cv, toks, pos, zero, zero, zero, *sargs
+            )
+        for r in self.cfg.restore_buckets():
+            k, v = self._offload_fn(self._ck, self._cv, zero, r)
+            self._ck, self._cv = self._restore_fn(self._ck, self._cv, k, v, zero)
         # Restore everything warmup wrote (cache contents, PRNG streams,
         # positions, metrics) so warmup cannot perturb request sampling.
         self._init_device_state()
@@ -270,11 +392,18 @@ class InferenceEngine:
     # ------------------------------------------------------------------
 
     def submit(
-        self, prompt_tokens: list[int], params: SamplingParams = SamplingParams()
+        self,
+        prompt_tokens: list[int],
+        params: SamplingParams = SamplingParams(),
+        session_id: Optional[str] = None,
     ) -> RequestHandle:
+        """Queue a generation request. With a session_id, the session's KV
+        rows persist across requests: the next request prefills only the
+        tokens past its longest common prefix with what is already cached
+        (multi-turn serving cost becomes O(new tokens), SURVEY §7)."""
         rid = f"req-{next(self._req_counter)}"
         handle = RequestHandle(rid)
-        request = Request(rid, list(prompt_tokens), params)
+        request = Request(rid, list(prompt_tokens), params, session_id=session_id)
         if not prompt_tokens:
             handle._push(
                 StreamEvent(rid, finish_reason=FinishReason.ERROR, error="empty prompt")
@@ -289,19 +418,25 @@ class InferenceEngine:
                 )
             )
             return handle
-        try:
-            self.cfg.bucket_for(len(prompt_tokens))
-        except ValueError as e:
-            handle._push(
-                StreamEvent(rid, finish_reason=FinishReason.ERROR, error=str(e))
-            )
-            return handle
-        if len(prompt_tokens) >= self.cfg.max_seq:
+        if not self.cfg.usable_buckets():
             handle._push(
                 StreamEvent(
                     rid,
                     finish_reason=FinishReason.ERROR,
-                    error=f"prompt of {len(prompt_tokens)} tokens >= max_seq {self.cfg.max_seq}",
+                    error="no usable prefill buckets (all exceed max_seq)",
+                )
+            )
+            return handle
+        # Prompts longer than the largest bucket prefill in chunks, so the
+        # only hard limit is the KV cache itself (≤ max_seq - 2 leaves the
+        # decode-step write rows legal).
+        if len(prompt_tokens) > self.cfg.max_seq - 2:
+            handle._push(
+                StreamEvent(
+                    rid,
+                    finish_reason=FinishReason.ERROR,
+                    error=f"prompt of {len(prompt_tokens)} tokens exceeds "
+                    f"KV capacity (max_seq {self.cfg.max_seq} - 2)",
                 )
             )
             return handle
@@ -325,18 +460,35 @@ class InferenceEngine:
 
     def step(self) -> bool:
         """One scheduling step. Returns True if any work was done."""
+        self._drain_releases()
         self._reap_cancelled()
         did = False
-        free = [i for i, s in enumerate(self._slots) if not s.active]
         with self._lock:
-            pending = self._waiting.pop(0) if (self._waiting and free) else None
+            waiting = list(self._waiting)
+        # First PLACEABLE request, not just the head: a request whose
+        # session is still mid-decode must not head-of-line-block other
+        # sessions' requests while slots sit free.
+        pending = None
+        slot_idx = None
+        for cand in waiting:
+            idx = self._slot_for(cand[0])
+            if idx is not None:
+                pending, slot_idx = cand, idx
+                break
+        if pending is not None:
+            with self._lock:
+                try:
+                    self._waiting.remove(pending)
+                except ValueError:
+                    pending = None  # reaped concurrently
         if pending is not None:
             try:
-                self._do_prefill(free[0], *pending)
+                self._place_request(slot_idx, *pending)
             except Exception:
-                # The request may not be attached to a slot yet, so recovery's
-                # _fail_all would never reach its handle — fail it here, then
-                # let the loop's recovery rebuild device state.
+                # The request may not be attached to a slot yet, so
+                # recovery's _fail_all would never reach its handle —
+                # fail it here, then let the loop's recovery rebuild
+                # device state.
                 request, handle = pending
                 handle._push(
                     StreamEvent(
@@ -345,13 +497,112 @@ class InferenceEngine:
                         error="prefill failed",
                     )
                 )
-                self._slots[free[0]].clear()
+                self._drop_session(request.session_id)
+                self._slots[slot_idx].session_id = None
+                self._slots[slot_idx].clear()
                 raise
             did = True
         if any(s.active for s in self._slots):
             self._do_decode()
             did = True
         return did
+
+    def _drain_releases(self) -> None:
+        with self._lock:
+            released, self._pending_releases = self._pending_releases, []
+        for sid in released:
+            self._drop_session(sid)
+
+    # -- slot & session scheduling -------------------------------------
+
+    def _slot_for(self, request: Request) -> Optional[int]:
+        """Pick the slot for a request, or None if it must wait.
+
+        Priority: the session's own resident slot (but never while a
+        previous request on the same session is still decoding there) →
+        a free unpinned slot → evict the least-recently-used idle session
+        to host and take its slot."""
+        sid = request.session_id if self.cfg.max_sessions > 0 else None
+        if sid is not None:
+            sess = self._sessions.get(sid)
+            if sess is not None and sess.slot is not None:
+                if self._slots[sess.slot].active:
+                    return None  # same-session turn still in flight
+                return sess.slot
+        for i, s in enumerate(self._slots):
+            if not s.active and s.session_id is None:
+                return i
+        idle_pinned = [
+            (self._sessions[s.session_id].last_used, i)
+            for i, s in enumerate(self._slots)
+            if not s.active and s.session_id is not None
+            and s.session_id in self._sessions
+        ]
+        if idle_pinned:
+            _, i = min(idle_pinned)
+            self._offload_session(self._sessions[self._slots[i].session_id])
+            return i
+        return None  # every slot is decoding
+
+    def _offload_session(self, sess: _SessionKV) -> None:
+        """Page an idle session's valid KV rows to host RAM and unpin its
+        slot. Rows move in a fixed restore-bucket shape so the transfer
+        program is compile-stable."""
+        slot_idx = sess.slot
+        valid = len(sess.token_ids)
+        if valid > 0:
+            rows = self.cfg.restore_bucket_for(valid)
+            k, v = self._offload_fn(self._ck, self._cv, slot_idx, rows)
+            sess.host_k = np.asarray(k)
+            sess.host_v = np.asarray(v)
+            self.metrics["session_offloads"] += 1
+        sess.slot = None
+        self._slots[slot_idx].session_id = None
+
+    def _restore_session(self, sess: _SessionKV, slot_idx: int) -> None:
+        """Swap a host-paged session's KV rows back into a device slot."""
+        self._ck, self._cv = self._restore_fn(
+            self._ck, self._cv, jnp.asarray(sess.host_k), jnp.asarray(sess.host_v),
+            slot_idx,
+        )
+        sess.host_k = sess.host_v = None
+        sess.slot = slot_idx
+        self._slots[slot_idx].session_id = sess.session_id
+        self.metrics["session_restores"] += 1
+
+    def _drop_session(self, sid: Optional[str]) -> None:
+        if not sid:
+            return
+        sess = self._sessions.pop(sid, None)
+        if sess is not None and sess.slot is not None:
+            self._slots[sess.slot].session_id = None
+
+    def release_session(self, session_id: str) -> None:
+        """Forget a session's cached KV (conversation ended / TTL expired).
+        Thread-safe: the registry is engine-thread-owned, so the release is
+        queued and applied at the next step. An in-flight request on the
+        session finishes normally."""
+        with self._lock:
+            self._pending_releases.append(session_id)
+        if self._thread is None:
+            self._drain_releases()  # synchronous single-threaded use
+
+    def _enforce_session_cap(self, protect: Optional[str] = None) -> None:
+        """Drop least-recently-used sessions above max_sessions. Sessions
+        with a decoding request — and the one currently being placed
+        (`protect`) — are never dropped: evicting the in-placement session
+        would leave its slot pinned to a ghost id."""
+        while len(self._sessions) > self.cfg.max_sessions:
+            victims = [
+                (s.last_used, s.session_id)
+                for s in self._sessions.values()
+                if s.session_id != protect
+                and not (s.slot is not None and self._slots[s.slot].active)
+            ]
+            if not victims:
+                return
+            _, sid = min(victims)
+            self._drop_session(sid)
 
     def _reap_cancelled(self):
         for i, slot in enumerate(self._slots):
@@ -368,14 +619,16 @@ class InferenceEngine:
                     still.append((req, handle))
             self._waiting = still
 
-    def _run_insert(self, k_chunk, v_chunk, slot_idx, last_logits):
-        slot = self._slots[slot_idx] if self._slots[slot_idx].active else None
-        sp = slot.request.params if slot else SamplingParams()
-        kd = (
+    def _sampling_key(self, slot_idx: int, sp: SamplingParams):
+        return (
             jnp.asarray(make_slot_key_data(sp.seed))
             if sp.seed is not None
             else self._key_data[slot_idx]
         )
+
+    def _run_insert(self, k_chunk, v_chunk, slot_idx, last_logits, sp=None):
+        sp = sp or SamplingParams()
+        kd = self._sampling_key(slot_idx, sp)
         ck, cv, tok, new_kd = self._insert_fn(
             self._ck,
             self._cv,
@@ -391,41 +644,134 @@ class InferenceEngine:
         key_data = self._key_data.at[slot_idx].set(new_kd)
         return ck, cv, tok, key_data
 
-    def _do_prefill(self, slot_idx: int, request: Request, handle: RequestHandle):
-        n = len(request.prompt_tokens)
-        bucket = self.cfg.bucket_for(n)
-        toks = np.zeros((1, bucket), np.int32)
-        toks[0, :n] = request.prompt_tokens
-        # Pad rows sit at positions n..bucket-1, i.e. strictly after every
-        # real query position, so the causal mask (key_idx <= q_pos) already
-        # excludes them — and decode overwrites each pad row before it first
-        # becomes attendable.
-        pos = np.arange(bucket, dtype=np.int32)[None, :]
+    def _place_request(self, slot_idx: int, request: Request, handle: RequestHandle):
+        """Prefill a request into a slot: fresh single-bucket prefill when
+        there is no reusable prefix and the prompt fits one bucket,
+        otherwise chunked incremental extend from the reuse frontier."""
+        prompt = request.prompt_tokens
+        n = len(prompt)
+        sess = None
+        reuse = 0
+        if self.cfg.max_sessions > 0 and request.session_id:
+            sess = self._sessions.get(request.session_id)
+            if sess is None:
+                sess = self._sessions[request.session_id] = _SessionKV(
+                    request.session_id
+                )
+                self._enforce_session_cap()
+            sess.last_used = time.monotonic()
+            # Longest common prefix with the cached rows, capped at n-1 so
+            # there is always ≥1 suffix token to produce the next logits.
+            limit = min(len(sess.token_ids), n - 1)
+            while reuse < limit and sess.token_ids[reuse] == prompt[reuse]:
+                reuse += 1
+            if sess.slot is None and sess.host_k is not None:
+                if reuse > 0:
+                    self._restore_session(sess, slot_idx)
+                else:
+                    sess.host_k = sess.host_v = None  # diverged: page is useless
+            if sess.slot is None:
+                sess.slot = slot_idx
+                self._slots[slot_idx].session_id = sess.session_id
+            slot_idx = sess.slot
+            if reuse == 0:
+                sess.token_ids = []
 
-        logits, k_chunk, v_chunk = self._prefill_fn(
-            self.params, jnp.asarray(toks), jnp.asarray(pos)
-        )
+        sp = request.params
+        usable = self.cfg.usable_buckets()
+        if reuse == 0 and n <= max(usable):
+            first_tok = self._fresh_prefill(slot_idx, prompt, sp)
+        else:
+            first_tok = self._chunked_extend(slot_idx, prompt, reuse, sp)
+        self.metrics["prefix_reuse_tokens"] += reuse
+        self.metrics["prefill_steps"] += 1
+
         slot = self._slots[slot_idx]
         slot.request = request
         slot.handle = handle
         slot.length = n
         slot.generated = 0
-        slot.max_total = request.params.max_tokens
-        slot.stop_ids = frozenset(request.params.stop_token_ids)
+        slot.emitted = []
+        slot.max_total = sp.max_tokens
+        slot.stop_ids = frozenset(sp.stop_token_ids)
+        if sess is not None:
+            sess.token_ids = list(prompt)
 
-        self._ck, self._cv, first_tok, self._key_data = self._run_insert(
-            k_chunk, v_chunk, slot_idx, logits[:, n - 1]
-        )
-        sp = request.params
         self._tokens = self._tokens.at[slot_idx].set(first_tok)
         self._positions = self._positions.at[slot_idx].set(n)
         self._active = self._active.at[slot_idx].set(True)
         self._temp = self._temp.at[slot_idx].set(sp.temperature)
         self._top_p = self._top_p.at[slot_idx].set(sp.top_p)
         self._top_k = self._top_k.at[slot_idx].set(sp.top_k)
-        self.metrics["prefill_steps"] += 1
-
         self._emit_token(slot_idx, int(first_tok))
+
+    def _fresh_prefill(self, slot_idx: int, prompt: list[int], sp: SamplingParams):
+        n = len(prompt)
+        bucket = self.cfg.bucket_for(n)
+        toks = np.zeros((1, bucket), np.int32)
+        toks[0, :n] = prompt
+        # Pad rows sit at positions n..bucket-1, i.e. strictly after every
+        # real query position, so the causal mask (key_idx <= q_pos) already
+        # excludes them — and decode overwrites each pad row before it first
+        # becomes attendable.
+        pos = np.arange(bucket, dtype=np.int32)[None, :]
+        logits, k_chunk, v_chunk = self._prefill_fn(
+            self.params, jnp.asarray(toks), jnp.asarray(pos)
+        )
+        self._ck, self._cv, first_tok, self._key_data = self._run_insert(
+            k_chunk, v_chunk, slot_idx, logits[:, n - 1], sp
+        )
+        return first_tok
+
+    def _extend_pieces(self, start: int, count: int) -> list[tuple[int, int, int]]:
+        """Plan (offset, real_len, bucket) chunks covering prompt[start:
+        start+count]. Bucket-padded writes must never cross max_seq (a
+        clamped dynamic_update_slice would corrupt earlier rows), so near
+        the cache end chunks degrade to single-token steps."""
+        buckets = sorted(self.cfg.usable_buckets())
+        S = self.cfg.max_seq
+        pieces = []
+        pos, left = start, count
+        while left > 0:
+            b = buckets[-1] if left >= buckets[-1] else self.cfg.bucket_for(left)
+            if pos + b > S:
+                b = 1
+            take = min(left, b)
+            pieces.append((pos, take, b))
+            pos += take
+            left -= take
+        return pieces
+
+    def _chunked_extend(
+        self, slot_idx: int, prompt: list[int], reuse: int, sp: SamplingParams
+    ):
+        """Incremental prefill of prompt[reuse:] against the slot's resident
+        rows; only the final chunk samples."""
+        pieces = self._extend_pieces(reuse, len(prompt) - reuse)
+        slot_arr = jnp.int32(slot_idx)
+
+        def chunk_arrays(off, take, b):
+            toks = np.zeros((1, b), np.int32)
+            toks[0, :take] = prompt[off:off + take]
+            pos = (off + np.arange(b, dtype=np.int32))[None, :]
+            return jnp.asarray(toks), jnp.asarray(pos)
+
+        for off, take, b in pieces[:-1]:
+            toks, pos = chunk_arrays(off, take, b)
+            self._ck, self._cv = self._extend_nosample_fn(
+                self.params, self._ck, self._cv, toks, pos, slot_arr, jnp.int32(off)
+            )
+        off, take, b = pieces[-1]
+        toks, pos = chunk_arrays(off, take, b)
+        kd = self._sampling_key(slot_idx, sp)
+        self._ck, self._cv, first_tok, new_kd = self._extend_fn(
+            self.params, self._ck, self._cv, toks, pos, slot_arr, jnp.int32(off),
+            jnp.int32(take - 1), kd,
+            jnp.float32(sp.temperature), jnp.float32(sp.top_p), jnp.int32(sp.top_k),
+        )
+        self._key_data = self._key_data.at[slot_idx].set(new_kd)
+        self.metrics["extend_steps"] += len(pieces)
+        return first_tok
 
     def _run_decode_step(self, single: bool = False):
         """One chunked decode dispatch → host tokens [K, B]. Position
@@ -478,6 +824,7 @@ class InferenceEngine:
             self._finish_slot(slot_idx, FinishReason.STOP)
             return
         slot.generated += 1
+        slot.emitted.append(token)
         slot.handle._push(StreamEvent(rid, token_id=token))
         self.metrics["tokens_generated"] += 1
         # max_total caps generated tokens; the cache bound stops a step early
@@ -498,11 +845,29 @@ class InferenceEngine:
             )
         )
         self.metrics["requests_finished"] += 1
+        # Sessionful: record which rows are valid for the next turn's
+        # prefix reuse. The last emitted token's row write is not
+        # guaranteed (a slot can finish mid-decode-chunk), so it is
+        # conservatively excluded — re-prefilling one token next turn is
+        # cheaper than reasoning about chunk timing.
+        quiesce_row = 0
+        sid = slot.session_id
+        sess = self._sessions.get(sid) if sid else None
+        if sess is not None and reason is not FinishReason.ERROR:
+            sess.token_ids = list(slot.request.prompt_tokens) + slot.emitted[:-1]
+            sess.last_used = time.monotonic()
+            # Idle-pinned slots keep decoding garbage at this frozen row —
+            # parking it at the valid-row frontier keeps the invariant that
+            # garbage only ever lives at rows ≥ the session's length.
+            quiesce_row = len(sess.token_ids)
+        elif sess is not None:
+            self._drop_session(sid)
         slot.clear()
         # Quiesce the slot: decode keeps running over it (static shape), but
-        # with active=False its position is frozen at row 0, so it only ever
-        # rewrites row 0 — which the next prefill's insert overwrites.
-        self._positions = self._positions.at[slot_idx].set(0)
+        # with active=False its position is frozen, so it only ever rewrites
+        # one row — row 0 for unpinned slots (the next prefill's insert
+        # overwrites it) or the session's length frontier for pinned ones.
+        self._positions = self._positions.at[slot_idx].set(quiesce_row)
         self._tokens = self._tokens.at[slot_idx].set(0)
         self._temp = self._temp.at[slot_idx].set(0.0)
         self._active = self._active.at[slot_idx].set(False)
@@ -547,6 +912,13 @@ class InferenceEngine:
         without reallocation every subsequent step would also fail and the
         engine would be permanently dead while looking alive."""
         self._fail_all(msg)
+        # Device-resident session rows died with the caches; host-paged
+        # sessions survive (their rows live in host RAM).
+        for sess in list(self._sessions.values()):
+            if sess.slot is not None:
+                self._slots[sess.slot].session_id = None
+                sess.slot = None
+                sess.token_ids = []
         try:
             self._init_device_state()
             self.metrics["recoveries"] = self.metrics.get("recoveries", 0) + 1

@@ -99,8 +99,13 @@ class MockEngine:
         return 0
 
     def submit(
-        self, prompt_tokens: list[int], params: SamplingParams = SamplingParams()
+        self,
+        prompt_tokens: list[int],
+        params: SamplingParams = SamplingParams(),
+        session_id: Optional[str] = None,
     ) -> RequestHandle:
+        # session_id accepted for interface parity with InferenceEngine;
+        # the mock replays scenarios statelessly, so it is ignored.
         rid = f"mock-{next(self._req_counter)}"
         handle = RequestHandle(rid)
         # Mirror InferenceEngine.submit's validation (and its metric

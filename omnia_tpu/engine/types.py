@@ -39,6 +39,10 @@ class Request:
     request_id: str
     prompt_tokens: list[int]
     params: SamplingParams
+    # Sessionful serving: requests carrying the same session_id reuse the
+    # session's resident KV rows across turns (prefix-matched), so turn
+    # N+1 prefills only its new tokens.
+    session_id: Optional[str] = None
     submitted_at: float = dataclasses.field(default_factory=time.monotonic)
 
 
@@ -144,6 +148,29 @@ class EngineConfig:
     # waits up to one chunk, and a slot finishing mid-chunk wastes ≤K-1
     # slot-steps. 1 = per-token sync.
     decode_chunk: int = 8
+    # Cross-turn KV reuse: sessions beyond num_slots page their KV rows to
+    # host RAM (LRU) and swap back on demand, so this many *logical*
+    # sessions share the fixed device cache. 0 disables sessionful serving.
+    max_sessions: int = 64
+
+    def restore_buckets(self) -> tuple[int, ...]:
+        """Row counts used when moving a session's KV rows device↔host:
+        fixed power-of-two sizes (plus max_seq) keep the transfer/restore
+        programs compile-stable regardless of actual session length."""
+        usable = self.usable_buckets()
+        b = min(usable) if usable else 64
+        out = []
+        while b < self.max_seq:
+            out.append(b)
+            b *= 2
+        out.append(self.max_seq)
+        return tuple(out)
+
+    def restore_bucket_for(self, n: int) -> int:
+        for b in self.restore_buckets():
+            if n <= b:
+                return b
+        raise ValueError(f"{n} rows exceed max_seq {self.max_seq}")
 
     def usable_buckets(self) -> tuple[int, ...]:
         """Prefill buckets that fit the KV cache (a bucket's chunk is

@@ -316,7 +316,10 @@ class Conversation:
                     tr.SPAN_LLM, attrs={"llm.prompt_tokens": len(prompt_ids)}
                 )
             try:
-                handle = self.engine.submit(prompt_ids, sp)
+                # session_id keys the engine's cross-turn KV reuse: the
+                # engine prefix-matches this prompt against the session's
+                # resident rows and prefills only the new tokens.
+                handle = self.engine.submit(prompt_ids, sp, session_id=self.session_id)
             except Exception:
                 if llm_span is not None:
                     llm_span.status = "error"

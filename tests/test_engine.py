@@ -195,18 +195,22 @@ def test_max_tokens_zero_rejected(engine):
     assert "max_tokens" in ev.error
 
 
-def test_bucket_larger_than_cache_rejected():
-    """A prompt whose bucket exceeds max_seq must be rejected at submit, not
-    crash the insert step for everyone (buckets > max_seq are unusable)."""
+def test_prompt_past_largest_bucket_served_chunked():
+    """Prompts longer than the largest prefill bucket are served via
+    chunked prefill (bucket pieces + single-token tail near the cache end)
+    instead of rejected; only KV capacity itself bounds prompt length."""
     cfg = get_config("test-tiny")
     eng = InferenceEngine(
         cfg,
         EngineConfig(num_slots=2, max_seq=20, prefill_buckets=(8, 16, 128), dtype="float32"),
         seed=0,
     )
-    ev = eng.submit(list(range(1, 18)), SamplingParams(max_tokens=1)).get_event(timeout=5)
+    toks, fin = eng.generate(list(range(1, 18)), SamplingParams(temperature=0.0, max_tokens=1))
+    assert len(toks) == 1 and fin.num_prompt_tokens == 17
+    # KV capacity is the hard limit.
+    ev = eng.submit(list(range(1, 20)), SamplingParams(max_tokens=1)).get_event(timeout=5)
     assert ev.finish_reason == FinishReason.ERROR
-    assert "bucket" in ev.error
+    assert "KV capacity" in ev.error
     toks, fin = eng.generate([1, 2, 3], SamplingParams(temperature=0.0, max_tokens=2))
     assert len(toks) == 2 and fin.finish_reason == FinishReason.LENGTH
 

@@ -183,7 +183,7 @@ class TestConversation:
         seen_prompts = []
 
         class SpyEngine(MockEngine):
-            def submit(self, prompt_tokens, params=SamplingParams()):
+            def submit(self, prompt_tokens, params=SamplingParams(), session_id=None):
                 seen_prompts.append(ByteTokenizer().decode(prompt_tokens))
                 return super().submit(prompt_tokens, params)
 
@@ -620,7 +620,7 @@ class TestMemoryCapability:
         seen_prompts = []
 
         class SpyEngine(MockEngine):
-            def submit(self, prompt_tokens, params=SamplingParams()):
+            def submit(self, prompt_tokens, params=SamplingParams(), session_id=None):
                 seen_prompts.append(ByteTokenizer().decode(prompt_tokens))
                 return super().submit(prompt_tokens, params)
 
