@@ -1,0 +1,56 @@
+import time
+import jax, jax.numpy as jnp, numpy as np
+from omnia_tpu.engine import EngineConfig, InferenceEngine, SamplingParams
+from omnia_tpu.models import get_config
+from omnia_tpu.ops.sampling import sample_tokens_per_slot, make_slot_key_data
+
+cfg = get_config("llama3-1b")
+ecfg = EngineConfig(num_slots=8, max_seq=1024, prefill_buckets=(64, 128, 256, 512),
+                    dtype="bfloat16", decode_chunk=16)
+t0=time.monotonic()
+eng = InferenceEngine(cfg, ecfg, seed=0)
+eng.warmup()
+print("warmup_s", round(time.monotonic()-t0,1))
+
+def timeit(label, fn, n=6):
+    fn()  # warm
+    t=time.monotonic()
+    for _ in range(n): fn()
+    print(label, round((time.monotonic()-t)/n*1000,1), "ms")
+
+# full chunk16 decode, sync
+def chunk():
+    toks = eng._run_decode_step()
+    np.asarray(toks)
+timeit("chunk16", chunk)
+
+def single():
+    toks = eng._run_decode_step(single=True)
+    np.asarray(toks)
+timeit("single", single)
+
+# dispatch overhead: trivial jit
+x = jnp.zeros((8,), jnp.int32)
+f = jax.jit(lambda x: x + 1)
+np.asarray(f(x))
+timeit("trivial-jit", lambda: np.asarray(f(x)))
+
+# sampling only
+logits = jnp.zeros((8, cfg.vocab_size), jnp.bfloat16)
+kd = jnp.stack([make_slot_key_data(i) for i in range(8)])
+temp = jnp.full((8,), 0.7, jnp.float32); tp = jnp.full((8,), 0.9, jnp.float32); tk=jnp.zeros((8,),jnp.int32)
+g = jax.jit(sample_tokens_per_slot)
+np.asarray(g(logits, kd, temp, tp, tk)[0])
+timeit("sampling", lambda: np.asarray(g(logits, kd, temp, tp, tk)[0]))
+
+# greedy sampling (temp 0)
+t0f = jnp.zeros((8,), jnp.float32)
+np.asarray(g(logits, kd, t0f, tp, tk)[0])
+timeit("sampling-greedy", lambda: np.asarray(g(logits, kd, t0f, tp, tk)[0]))
+
+# back-to-back chunks without sync (pipeline potential)
+def two_chunks_nosync():
+    t1 = eng._run_decode_step()
+    t2 = eng._run_decode_step()
+    np.asarray(t1); np.asarray(t2)
+timeit("2chunks-pipelined", two_chunks_nosync, n=3)

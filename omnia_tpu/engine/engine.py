@@ -36,6 +36,7 @@ then a decode step for all active slots.
 
 from __future__ import annotations
 
+import collections
 import itertools
 import logging
 import queue
@@ -64,6 +65,7 @@ from omnia_tpu.ops.sampling import (
 )
 from omnia_tpu.parallel import make_mesh, shard_pytree
 from omnia_tpu.parallel.sharding import named_sharding_tree
+from omnia_tpu.utils.compile_cache import enable_compilation_cache
 
 logger = logging.getLogger(__name__)
 
@@ -138,6 +140,10 @@ class InferenceEngine:
     ):
         self.model_cfg = model_cfg
         self.cfg = engine_cfg
+        # Every serving path compiles through the persistent cache: restart
+        # after the first start deserializes instead of recompiling (cold
+        # warmup ~100 s → seconds; the scale-to-zero enabler).
+        enable_compilation_cache()
         if engine_cfg.max_seq > model_cfg.max_seq_len:
             raise ValueError("engine max_seq exceeds model max_seq_len")
         if engine_cfg.num_slots % max(engine_cfg.dp, 1) != 0:
@@ -168,6 +174,9 @@ class InferenceEngine:
         # arrive via _pending_releases under _lock. LRU uses last_used.
         self._sessions: dict[str, _SessionKV] = {}
         self._pending_releases: list[str] = []
+        # Dispatched-but-unread decode chunks: (token futures, active
+        # snapshot). Engine-thread-owned.
+        self._inflight: collections.deque = collections.deque()
 
         self._thread: Optional[threading.Thread] = None
         self._stop_event = threading.Event()
@@ -464,6 +473,13 @@ class InferenceEngine:
         self._reap_cancelled()
         did = False
         with self._lock:
+            queued = bool(self._waiting)
+        if queued and self._inflight:
+            # Requests are waiting: surface any in-flight finishes now so
+            # their slots free up this step (TTFT over pipeline depth).
+            self._flush_pipeline()
+            did = True
+        with self._lock:
             waiting = list(self._waiting)
         # First PLACEABLE request, not just the head: a request whose
         # session is still mid-decode must not head-of-line-block other
@@ -482,6 +498,11 @@ class InferenceEngine:
                 except ValueError:
                     pending = None  # reaped concurrently
         if pending is not None:
+            # Prefill/extend programs consume self._ck/_cv, which may be
+            # futures from in-flight decode chunks — XLA sequences the
+            # dependency, but host slot state must be current before
+            # placement decisions stick, so the pipeline is already flushed
+            # (the queued branch above ran whenever _waiting was non-empty).
             try:
                 self._place_request(slot_idx, *pending)
             except Exception:
@@ -503,9 +524,51 @@ class InferenceEngine:
                 raise
             did = True
         if any(s.active for s in self._slots):
-            self._do_decode()
+            with self._lock:
+                queued = bool(self._waiting)
+            # Steady state keeps up to decode_pipeline chunks in flight:
+            # chunk N+1 is dispatched on chunk N's output *futures* before
+            # N's tokens are read, so the device never idles through the
+            # host's read-RTT + bookkeeping gap (the dominant per-chunk
+            # cost on a remote-dispatch link). While requests queue, the
+            # flush above degrades this to synchronous single steps. A
+            # dispatch-ahead that no slot can still need (everyone's token
+            # budget is covered by chunks already in flight) would be pure
+            # garbage whose sync delays the NEXT request's placement by a
+            # full chunk — drain instead.
+            if self._inflight and not self._dispatch_ahead_useful():
+                self._process_oldest_chunk()
+            else:
+                self._dispatch_decode(single=queued)
+                depth = 1 if queued else max(1, self.cfg.decode_pipeline)
+                while len(self._inflight) >= depth:
+                    self._process_oldest_chunk()
+            did = True
+        elif self._inflight:
+            self._process_oldest_chunk()
             did = True
         return did
+
+    def _dispatch_ahead_useful(self) -> bool:
+        """True if at least one active slot's generation budget extends past
+        the decode steps already in flight — i.e. one more chunk does real
+        work for someone. Stop-token finishes are unpredictable, so budgets
+        are optimistic (max_tokens); the cost of optimism is one garbage
+        chunk, the cost of pessimism would be no pipelining for any request
+        that carries an EOS id (all real chat traffic)."""
+        inflight_steps: dict[int, int] = {}
+        for toks, active in self._inflight:
+            k = int(toks.shape[0])
+            for i, _rid in active:
+                inflight_steps[i] = inflight_steps.get(i, 0) + k
+        for i, s in enumerate(self._slots):
+            if not s.active:
+                continue
+            pending = inflight_steps.get(i, 0)
+            if s.generated + pending < s.max_total and \
+                    s.length + pending < self.cfg.max_seq - 2:
+                return True
+        return False
 
     def _drain_releases(self) -> None:
         with self._lock:
@@ -801,19 +864,38 @@ class InferenceEngine:
         self.metrics["decode_steps"] += int(toks.shape[0])
         return toks
 
-    def _do_decode(self):
-        active = [i for i, s in enumerate(self._slots) if s.active]
-        with self._lock:
-            queued = bool(self._waiting)
-        toks = self._run_decode_step(single=queued)
+    def _dispatch_decode(self, single: bool = False):
+        """Dispatch one decode chunk asynchronously: device state advances
+        to output futures immediately; the token read is deferred to
+        _process_oldest_chunk. The active-slot list is snapshotted at
+        dispatch time — a slot that finishes while this chunk is in flight
+        produced garbage rows past its valid frontier, which the sessionful
+        bookkeeping already tolerates (garbage only at rows ≥ session
+        length)."""
+        active = [
+            (i, s.request.request_id) for i, s in enumerate(self._slots) if s.active
+        ]
+        toks = self._run_decode_step(single=single)
+        self._inflight.append((toks, active))
+
+    def _process_oldest_chunk(self):
+        toks, active = self._inflight.popleft()
         host_tokens = np.asarray(toks)  # [K, B] — ONE sync per chunk
         for k in range(host_tokens.shape[0]):
-            for i in active:
+            for i, rid in active:
                 slot = self._slots[i]
-                if not slot.active:
-                    continue  # finished earlier in this chunk; rest is garbage
+                if not slot.active or slot.request.request_id != rid:
+                    # Finished earlier in this chunk (rest is garbage) — or
+                    # cancelled and re-placed while the chunk was in
+                    # flight, in which case these tokens belong to the old
+                    # request, never the slot's new occupant.
+                    continue
                 slot.length += 1
                 self._emit_token(i, int(host_tokens[k, i]))
+
+    def _flush_pipeline(self):
+        while self._inflight:
+            self._process_oldest_chunk()
 
     def _emit_token(self, slot_idx: int, token: int):
         slot = self._slots[slot_idx]
@@ -912,6 +994,8 @@ class InferenceEngine:
         without reallocation every subsequent step would also fail and the
         engine would be permanently dead while looking alive."""
         self._fail_all(msg)
+        # In-flight chunk futures share lineage with the dead caches.
+        self._inflight.clear()
         # Device-resident session rows died with the caches; host-paged
         # sessions survive (their rows live in host RAM).
         for sess in list(self._sessions.values()):

@@ -148,6 +148,12 @@ class EngineConfig:
     # waits up to one chunk, and a slot finishing mid-chunk wastes ≤K-1
     # slot-steps. 1 = per-token sync.
     decode_chunk: int = 8
+    # Decode chunks kept in flight (dispatched on the previous chunk's
+    # output futures before its tokens are read). 2 hides the host's
+    # read-RTT + bookkeeping gap behind device compute — the device runs
+    # chunks back-to-back; 1 = synchronous dispatch-then-read. Streaming
+    # latency worst case becomes pipeline × chunk tokens.
+    decode_pipeline: int = 2
     # Cross-turn KV reuse: sessions beyond num_slots page their KV rows to
     # host RAM (LRU) and swap back on demand, so this many *logical*
     # sessions share the fixed device cache. 0 disables sessionful serving.

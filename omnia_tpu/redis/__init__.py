@@ -1,0 +1,21 @@
+"""Real Redis protocol (RESP2) support: client, and an in-tree server.
+
+The reference platform's entire hot/queue fabric is Redis — hot session
+tier (internal/session/providers/redis/provider.go), Redis Streams work
+queues (ee/pkg/arena/queue/redis.go), route table, context store. omnia_tpu
+ships the same capability as a real wire-protocol client
+(`omnia_tpu.redis.client.RedisClient`, pure stdlib sockets — no driver
+dependency) plus an in-tree RESP server (`omnia_tpu.redis.server`) that
+plays the role miniredis plays in the reference's test suite AND serves as
+a single-binary dev fabric (the reference's kind-cluster dev story needs a
+redis pod; clusterless dev here just starts the in-tree server thread).
+
+Against a production cluster the same client speaks to real Redis — the
+command surface used is standard (strings, hashes, zsets, streams with
+consumer groups).
+"""
+
+from omnia_tpu.redis.client import RedisClient, RedisError
+from omnia_tpu.redis.server import RedisServer
+
+__all__ = ["RedisClient", "RedisError", "RedisServer"]

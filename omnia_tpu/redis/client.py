@@ -1,0 +1,275 @@
+"""RESP2 Redis client over stdlib sockets.
+
+The production-path client (reference analog: go-redis across
+internal/session/providers/redis, ee/pkg/arena/queue/redis.go). No driver
+dependency: the image has no redis-py, and the command surface the
+platform needs is small enough that a direct protocol client is simpler
+than vendoring one. Works against real Redis and against the in-tree
+server identically.
+
+Thread-safe: one socket guarded by a lock, one request/reply round trip
+per command (the platform's redis calls are short; blocked stream reads
+use a dedicated client per consumer loop, same discipline the reference
+uses with go-redis pooled conns).
+"""
+
+from __future__ import annotations
+
+import socket
+import threading
+from typing import Optional, Union
+
+from omnia_tpu.redis.resp import Error, Reader, encode_command
+
+
+class RedisError(RuntimeError):
+    """Server-reported error reply."""
+
+
+class RedisUnavailable(RedisError):
+    """Transport-level failure (connect/reset/timeout) — callers map this
+    to their own outage type (e.g. context_store.StoreUnavailable)."""
+
+
+Arg = Union[bytes, str, int, float]
+
+
+class RedisClient:
+    def __init__(
+        self,
+        host: str = "127.0.0.1",
+        port: int = 6379,
+        password: Optional[str] = None,
+        timeout_s: float = 10.0,
+    ) -> None:
+        self.host, self.port = host, port
+        self._password = password
+        self._timeout = timeout_s
+        self._sock: Optional[socket.socket] = None
+        self._reader: Optional[Reader] = None
+        self._lock = threading.Lock()
+
+    # -- transport -----------------------------------------------------
+
+    def _connect_locked(self) -> None:
+        sock = socket.create_connection((self.host, self.port), timeout=self._timeout)
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self._sock = sock
+        self._reader = Reader(sock.makefile("rb"))
+        if self._password is not None:
+            reply = self._roundtrip_locked(encode_command("AUTH", self._password))
+            if isinstance(reply, Error):
+                raise RedisError(reply.message)
+
+    def _roundtrip_locked(self, payload: bytes, timeout_s: Optional[float] = None):
+        assert self._sock is not None
+        self._sock.settimeout(timeout_s if timeout_s is not None else self._timeout)
+        self._sock.sendall(payload)
+        return self._reader.read()
+
+    def execute(self, *args: Arg, timeout_s: Optional[float] = None):
+        """One command → decoded reply. Reconnects once on a dead socket;
+        raises RedisUnavailable when the server is unreachable and
+        RedisError on an error reply."""
+        payload = encode_command(*args)
+        with self._lock:
+            for attempt in (0, 1):
+                try:
+                    if self._sock is None:
+                        self._connect_locked()
+                    reply = self._roundtrip_locked(payload, timeout_s)
+                    break
+                except RedisError:
+                    self._drop_locked()
+                    raise
+                except Exception as e:
+                    # Transport failure (connect refused, reset, timeout,
+                    # torn reply): drop the socket, retry once on a fresh
+                    # connection, then surface as unavailable.
+                    self._drop_locked()
+                    if attempt:
+                        raise RedisUnavailable(
+                            f"redis at {self.host}:{self.port}: {e}"
+                        ) from e
+            else:  # pragma: no cover - loop always breaks or raises
+                raise RedisUnavailable("unreachable")
+        if isinstance(reply, Error):
+            raise RedisError(reply.message)
+        return reply
+
+    def _drop_locked(self) -> None:
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+        self._sock = None
+        self._reader = None
+
+    def close(self) -> None:
+        with self._lock:
+            self._drop_locked()
+
+    def clone(self) -> "RedisClient":
+        """A fresh connection to the same server. Blocking consumers hold
+        their connection for the whole BLOCK window, so they must never
+        share one with producers (a blocked read would serialize every
+        other caller behind it)."""
+        return RedisClient(
+            self.host, self.port, password=self._password, timeout_s=self._timeout
+        )
+
+    # -- convenience wrappers -----------------------------------------
+
+    def ping(self) -> bool:
+        return self.execute("PING") == "PONG"
+
+    def set(self, key: Arg, value: Arg, px_ms: Optional[int] = None,
+            nx: bool = False) -> bool:
+        cmd: list[Arg] = ["SET", key, value]
+        if px_ms is not None:
+            cmd += ["PX", px_ms]
+        if nx:
+            cmd.append("NX")
+        return self.execute(*cmd) == "OK"
+
+    def get(self, key: Arg) -> Optional[bytes]:
+        return self.execute("GET", key)
+
+    def delete(self, *keys: Arg) -> int:
+        return self.execute("DEL", *keys)
+
+    def exists(self, *keys: Arg) -> int:
+        return self.execute("EXISTS", *keys)
+
+    def expire(self, key: Arg, seconds: int) -> int:
+        return self.execute("EXPIRE", key, seconds)
+
+    def keys(self, pattern: str = "*") -> list[bytes]:
+        return self.execute("KEYS", pattern)
+
+    def flushdb(self) -> None:
+        self.execute("FLUSHDB")
+
+    def incr(self, key: Arg, by: int = 1) -> int:
+        return self.execute("INCRBY", key, by)
+
+    # hashes
+    def hset(self, key: Arg, *pairs: Arg) -> int:
+        return self.execute("HSET", key, *pairs)
+
+    def hget(self, key: Arg, field: Arg) -> Optional[bytes]:
+        return self.execute("HGET", key, field)
+
+    def hgetall(self, key: Arg) -> dict[bytes, bytes]:
+        flat = self.execute("HGETALL", key)
+        return {flat[i]: flat[i + 1] for i in range(0, len(flat), 2)}
+
+    def hdel(self, key: Arg, *fields: Arg) -> int:
+        return self.execute("HDEL", key, *fields)
+
+    # lists
+    def rpush(self, key: Arg, *values: Arg) -> int:
+        return self.execute("RPUSH", key, *values)
+
+    def lrange(self, key: Arg, start: int, stop: int) -> list[bytes]:
+        return self.execute("LRANGE", key, start, stop)
+
+    def llen(self, key: Arg) -> int:
+        return self.execute("LLEN", key)
+
+    # zsets
+    def zadd(self, key: Arg, score: float, member: Arg) -> int:
+        return self.execute("ZADD", key, score, member)
+
+    def zrem(self, key: Arg, *members: Arg) -> int:
+        return self.execute("ZREM", key, *members)
+
+    def zrangebyscore(
+        self, key: Arg, lo: Union[str, float], hi: Union[str, float],
+        offset: int = 0, count: Optional[int] = None,
+    ) -> list[bytes]:
+        cmd: list[Arg] = ["ZRANGEBYSCORE", key, str(lo), str(hi)]
+        if count is not None:
+            cmd += ["LIMIT", offset, count]
+        return self.execute(*cmd)
+
+    def zrange(self, key: Arg, start: int, stop: int,
+               withscores: bool = False) -> list[bytes]:
+        cmd: list[Arg] = ["ZRANGE", key, start, stop]
+        if withscores:
+            cmd.append("WITHSCORES")
+        return self.execute(*cmd)
+
+    def zcard(self, key: Arg) -> int:
+        return self.execute("ZCARD", key)
+
+    # streams
+    def xadd(self, key: Arg, fields: dict, entry_id: str = "*") -> bytes:
+        flat: list[Arg] = []
+        for k, v in fields.items():
+            flat += [k, v]
+        return self.execute("XADD", key, entry_id, *flat)
+
+    def xlen(self, key: Arg) -> int:
+        return self.execute("XLEN", key)
+
+    def xrange(self, key: Arg, lo: str = "-", hi: str = "+",
+               count: Optional[int] = None) -> list:
+        cmd: list[Arg] = ["XRANGE", key, lo, hi]
+        if count is not None:
+            cmd += ["COUNT", count]
+        return self.execute(*cmd)
+
+    def xgroup_create(self, key: Arg, group: Arg, start: str = "0",
+                      mkstream: bool = True) -> bool:
+        cmd: list[Arg] = ["XGROUP", "CREATE", key, group, start]
+        if mkstream:
+            cmd.append("MKSTREAM")
+        try:
+            return self.execute(*cmd) == "OK"
+        except RedisError as e:
+            if "BUSYGROUP" in str(e):
+                return False  # already exists — idempotent ensure
+            raise
+
+    def xreadgroup(
+        self, group: Arg, consumer: Arg, key: Arg, entry_id: str = ">",
+        count: int = 10, block_ms: Optional[int] = None,
+    ) -> list:
+        cmd: list[Arg] = ["XREADGROUP", "GROUP", group, consumer, "COUNT", count]
+        timeout = None
+        if block_ms is not None:
+            cmd += ["BLOCK", block_ms]
+            timeout = self._timeout + block_ms / 1000.0
+        cmd += ["STREAMS", key, entry_id]
+        reply = self.execute(*cmd, timeout_s=timeout)
+        return reply or []
+
+    def xack(self, key: Arg, group: Arg, *ids: Arg) -> int:
+        return self.execute("XACK", key, group, *ids)
+
+    def xpending_summary(self, key: Arg, group: Arg) -> tuple[int, list]:
+        reply = self.execute("XPENDING", key, group)
+        return int(reply[0]), reply[3] or []
+
+    def xpending(
+        self, key: Arg, group: Arg, lo: str = "-", hi: str = "+",
+        count: int = 100, min_idle_ms: int = 0,
+    ) -> list:
+        cmd: list[Arg] = ["XPENDING", key, group]
+        if min_idle_ms:
+            cmd += ["IDLE", min_idle_ms]
+        cmd += [lo, hi, count]
+        return self.execute(*cmd)
+
+    def xautoclaim(
+        self, key: Arg, group: Arg, consumer: Arg,
+        min_idle_ms: int, start: str = "0-0", count: int = 100,
+    ) -> list:
+        reply = self.execute(
+            "XAUTOCLAIM", key, group, consumer, min_idle_ms, start,
+            "COUNT", count,
+        )
+        # Redis 6.2 returns [cursor, entries]; 7.0 adds deleted-ids.
+        return reply[1]

@@ -1,0 +1,160 @@
+"""RESP2 wire codec (REdis Serialization Protocol).
+
+Shared by the client and the in-tree server. The protocol is the
+compatibility surface — same role the gRPC contract plays for the runtime:
+anything speaking RESP2 interoperates, so the client works against real
+Redis and real redis-cli works against the in-tree server.
+
+Types: simple string (+OK\r\n), error (-ERR ...\r\n), integer (:1\r\n),
+bulk string ($3\r\nfoo\r\n, $-1 = nil), array (*2\r\n... , *-1 = nil).
+"""
+
+from __future__ import annotations
+
+import io
+from typing import Optional, Union
+
+CRLF = b"\r\n"
+
+
+class ProtocolError(Exception):
+    pass
+
+
+class Error(Exception):
+    """A RESP error reply. An Exception so server handlers can raise it for
+    control flow, but usually returned as a value so pipelined replies can
+    carry per-command errors."""
+
+    def __init__(self, message: str) -> None:
+        super().__init__(message)
+        self.message = message
+
+    def __repr__(self) -> str:  # pragma: no cover - debug aid
+        return f"Error({self.message!r})"
+
+    def __eq__(self, other) -> bool:
+        return isinstance(other, Error) and other.message == self.message
+
+
+Reply = Union[bytes, int, None, Error, str, list]
+
+
+def encode_command(*args: Union[bytes, str, int, float]) -> bytes:
+    """Client→server commands are always arrays of bulk strings."""
+    out = [b"*%d\r\n" % len(args)]
+    for a in args:
+        if isinstance(a, bytes):
+            b = a
+        elif isinstance(a, str):
+            b = a.encode()
+        elif isinstance(a, bool):  # bool before int: True is an int
+            raise TypeError("bool is not a valid redis argument")
+        elif isinstance(a, int):
+            b = str(a).encode()
+        elif isinstance(a, float):
+            b = repr(a).encode()
+        else:
+            raise TypeError(f"unsupported arg type {type(a)!r}")
+        out.append(b"$%d\r\n%s\r\n" % (len(b), b))
+    return b"".join(out)
+
+
+def encode_reply(value: Reply) -> bytes:
+    """Server→client replies. str = simple string, bytes = bulk string,
+    None = nil bulk, Error = error line, int, list = array (recursive)."""
+    if isinstance(value, Error):
+        return b"-%s\r\n" % value.message.encode()
+    if isinstance(value, str):
+        return b"+%s\r\n" % value.encode()
+    if isinstance(value, bool):
+        raise TypeError("bool reply is ambiguous")
+    if isinstance(value, int):
+        return b":%d\r\n" % value
+    if value is None:
+        return b"$-1\r\n"
+    if isinstance(value, bytes):
+        return b"$%d\r\n%s\r\n" % (len(value), value)
+    if isinstance(value, (list, tuple)):
+        return b"*%d\r\n" % len(value) + b"".join(encode_reply(v) for v in value)
+    raise TypeError(f"unsupported reply type {type(value)!r}")
+
+
+NIL_ARRAY = b"*-1\r\n"
+
+
+class Reader:
+    """Incremental RESP parser over a readable binary stream (socket
+    makefile or BytesIO). Blocking reads; EOF raises ProtocolError."""
+
+    def __init__(self, stream: io.BufferedIOBase) -> None:
+        self._s = stream
+
+    def _line(self) -> bytes:
+        line = self._s.readline()
+        if not line:
+            raise ProtocolError("connection closed")
+        if not line.endswith(CRLF):
+            raise ProtocolError(f"malformed line {line!r}")
+        return line[:-2]
+
+    def _exactly(self, n: int) -> bytes:
+        buf = b""
+        while len(buf) < n:
+            chunk = self._s.read(n - len(buf))
+            if not chunk:
+                raise ProtocolError("connection closed mid-bulk")
+            buf += chunk
+        return buf
+
+    def read(self) -> Reply:
+        line = self._line()
+        kind, rest = line[:1], line[1:]
+        if kind == b"+":
+            return rest.decode()
+        if kind == b"-":
+            return Error(rest.decode())
+        if kind == b":":
+            return int(rest)
+        if kind == b"$":
+            n = int(rest)
+            if n == -1:
+                return None
+            data = self._exactly(n)
+            if self._exactly(2) != CRLF:
+                raise ProtocolError("bulk not CRLF-terminated")
+            return data
+        if kind == b"*":
+            n = int(rest)
+            if n == -1:
+                return None
+            return [self.read() for _ in range(n)]
+        raise ProtocolError(f"unknown reply type {line!r}")
+
+    def read_command(self) -> Optional[list[bytes]]:
+        """Server side: one client command (array of bulk strings), or an
+        inline command line (the protocol's telnet mode — redis-cli PING).
+        Returns None on clean EOF before any bytes."""
+        first = self._s.readline()
+        if not first:
+            return None
+        if not first.endswith(CRLF):
+            raise ProtocolError(f"malformed line {first!r}")
+        line = first[:-2]
+        if not line.startswith(b"*"):
+            return [p for p in line.split() if p]  # inline command
+        n = int(line[1:])
+        if n < 0:
+            raise ProtocolError("negative multibulk length")
+        args: list[bytes] = []
+        for _ in range(n):
+            hdr = self._line()
+            if not hdr.startswith(b"$"):
+                raise ProtocolError(f"expected bulk header, got {hdr!r}")
+            ln = int(hdr[1:])
+            if ln < 0:
+                raise ProtocolError("nil bulk in command")
+            args.append(self._exactly(ln))
+            if self._exactly(2) != CRLF:
+                raise ProtocolError("bulk not CRLF-terminated")
+        return args

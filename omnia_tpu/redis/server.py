@@ -1,0 +1,850 @@
+"""In-tree Redis-protocol server.
+
+Plays two roles (same as miniredis + the dev redis pod do for the
+reference): the test backend every redis-path conformance suite runs
+against, and a single-binary dev fabric for clusterless multi-process
+topologies. Implements the command subset the platform uses — strings
+with expiry, hashes, lists, sorted sets, and streams with consumer
+groups (XADD/XREADGROUP/XACK/XPENDING/XAUTOCLAIM — the at-least-once
+work-queue semantics of reference ee/pkg/arena/queue/redis.go).
+
+One global lock guards the keyspace: correctness over concurrency, which
+is the right trade for a dev/test fabric (real deployments point the same
+client at real Redis). Blocking XREADGROUP waits on a condition notified
+by every XADD.
+"""
+
+from __future__ import annotations
+
+import fnmatch
+import socket
+import socketserver
+import threading
+import time
+from typing import Optional
+
+from omnia_tpu.redis.resp import Error, Reader, encode_reply
+
+_WRONGTYPE = Error(
+    "WRONGTYPE Operation against a key holding the wrong kind of value"
+)
+
+
+class _Stream:
+    __slots__ = ("entries", "last_ms", "last_seq", "groups")
+
+    def __init__(self) -> None:
+        self.entries: list[tuple[int, int, dict[bytes, bytes]]] = []
+        self.last_ms = 0
+        self.last_seq = 0
+        self.groups: dict[bytes, _Group] = {}
+
+    def next_id(self) -> tuple[int, int]:
+        ms = int(time.time() * 1000)
+        if ms <= self.last_ms:
+            return self.last_ms, self.last_seq + 1
+        return ms, 0
+
+    def add(self, ms: int, seq: int, fields: dict[bytes, bytes]) -> None:
+        self.entries.append((ms, seq, fields))
+        self.last_ms, self.last_seq = ms, seq
+
+
+class _Group:
+    __slots__ = ("last_ms", "last_seq", "pending")
+
+    def __init__(self, last_ms: int, last_seq: int) -> None:
+        self.last_ms = last_ms
+        self.last_seq = last_seq
+        # id -> [consumer, delivered_at_ms, delivery_count]
+        self.pending: dict[tuple[int, int], list] = {}
+
+
+def _fmt_id(ms: int, seq: int) -> bytes:
+    return b"%d-%d" % (ms, seq)
+
+
+def _parse_id(raw: bytes, default_seq: int = 0) -> tuple[int, int]:
+    if b"-" in raw:
+        ms, seq = raw.split(b"-", 1)
+        return int(ms), int(seq)
+    return int(raw), default_seq
+
+
+class _DB:
+    def __init__(self) -> None:
+        # key -> (type, value); expiry in self.expires (ms epoch)
+        self.data: dict[bytes, tuple[str, object]] = {}
+        self.expires: dict[bytes, int] = {}
+
+
+class RedisServer:
+    """Threaded RESP2 server. start() binds and serves in background
+    threads; address is (host, port) after start."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 password: Optional[str] = None) -> None:
+        self._host, self._port = host, port
+        self._password = password
+        self._db = _DB()
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._server: Optional[socketserver.ThreadingTCPServer] = None
+        self._thread: Optional[threading.Thread] = None
+        self._conns: set[socket.socket] = set()
+        self._conns_lock = threading.Lock()
+
+    # -- lifecycle -----------------------------------------------------
+
+    def start(self) -> "RedisServer":
+        outer = self
+
+        class Handler(socketserver.StreamRequestHandler):
+            def handle(self) -> None:  # pragma: no branch
+                with outer._conns_lock:
+                    outer._conns.add(self.connection)
+                try:
+                    outer._serve_connection(self.rfile, self.wfile)
+                finally:
+                    with outer._conns_lock:
+                        outer._conns.discard(self.connection)
+
+        class Server(socketserver.ThreadingTCPServer):
+            allow_reuse_address = True
+            daemon_threads = True
+
+        self._server = Server((self._host, self._port), Handler)
+        self._port = self._server.server_address[1]
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, name="omnia-redisd", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        if self._server is not None:
+            self._server.shutdown()
+            self._server.server_close()
+            self._server = None
+        # Sever live connections too — a stopped server must look DOWN to
+        # connected clients (their next call fails → outage semantics),
+        # not like a server that just stopped accepting newcomers.
+        with self._conns_lock:
+            conns, self._conns = list(self._conns), set()
+        for s in conns:
+            try:
+                s.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+
+    @property
+    def address(self) -> tuple[str, int]:
+        return self._host, self._port
+
+    # -- connection loop ----------------------------------------------
+
+    def _serve_connection(self, rfile, wfile) -> None:
+        reader = Reader(rfile)
+        authed = self._password is None
+        while True:
+            try:
+                cmd = reader.read_command()
+            except Exception:
+                return
+            if cmd is None or not cmd:
+                return
+            name = cmd[0].upper().decode()
+            args = cmd[1:]
+            if name == "QUIT":
+                wfile.write(encode_reply("OK"))
+                return
+            if name == "AUTH":
+                pw = args[-1].decode() if args else ""
+                if self._password is not None and pw == self._password:
+                    authed = True
+                    reply = "OK"
+                else:
+                    reply = Error("WRONGPASS invalid username-password pair")
+                wfile.write(encode_reply(reply))
+                wfile.flush()
+                continue
+            if not authed:
+                wfile.write(encode_reply(Error("NOAUTH Authentication required.")))
+                wfile.flush()
+                continue
+            try:
+                reply = self._dispatch(name, args)
+            except Error as e:  # raised for control flow in handlers
+                reply = e
+            except (ValueError, IndexError):
+                reply = Error(f"ERR wrong number of arguments for '{name.lower()}'")
+            except Exception as e:  # pragma: no cover - defensive
+                reply = Error(f"ERR {e}")
+            try:
+                wfile.write(encode_reply(reply))
+                wfile.flush()
+            except OSError:
+                return
+
+    # -- dispatch ------------------------------------------------------
+
+    def _dispatch(self, name: str, a: list[bytes]):
+        h = getattr(self, "_cmd_" + name.lower(), None)
+        if h is None:
+            return Error(f"ERR unknown command '{name}'")
+        return h(a)
+
+    # -- expiry helpers (call with lock held) -------------------------
+
+    def _alive(self, key: bytes) -> bool:
+        exp = self._db.expires.get(key)
+        if exp is not None and exp <= int(time.time() * 1000):
+            self._db.data.pop(key, None)
+            self._db.expires.pop(key, None)
+            return False
+        return key in self._db.data
+
+    def _typed(self, key: bytes, want: str, create=None):
+        """Value of `key` checked against `want`; optionally create."""
+        if not self._alive(key):
+            if create is None:
+                return None
+            val = create()
+            self._db.data[key] = (want, val)
+            return val
+        typ, val = self._db.data[key]
+        if typ != want:
+            raise _WRONGTYPE
+        return val
+
+    # -- generic -------------------------------------------------------
+
+    def _cmd_ping(self, a):
+        return a[0] if a else "PONG"
+
+    def _cmd_echo(self, a):
+        return a[0]
+
+    def _cmd_select(self, a):
+        return "OK"
+
+    def _cmd_flushdb(self, a):
+        with self._lock:
+            self._db.data.clear()
+            self._db.expires.clear()
+        return "OK"
+
+    _cmd_flushall = _cmd_flushdb
+
+    def _cmd_del(self, a):
+        n = 0
+        with self._lock:
+            for k in a:
+                if self._alive(k):
+                    del self._db.data[k]
+                    self._db.expires.pop(k, None)
+                    n += 1
+        return n
+
+    def _cmd_exists(self, a):
+        with self._lock:
+            return sum(1 for k in a if self._alive(k))
+
+    def _cmd_type(self, a):
+        with self._lock:
+            if not self._alive(a[0]):
+                return "none"
+            return self._db.data[a[0]][0]
+
+    def _cmd_keys(self, a):
+        pat = a[0].decode()
+        with self._lock:
+            return sorted(
+                k for k in list(self._db.data) if self._alive(k)
+                and fnmatch.fnmatchcase(k.decode(), pat)
+            )
+
+    def _cmd_scan(self, a):
+        # Single-pass scan: cursor 0 returns everything + cursor 0 (legal
+        # for clients that loop until cursor == 0).
+        pat = b"*"
+        for i in range(1, len(a) - 1):
+            if a[i].upper() == b"MATCH":
+                pat = a[i + 1]
+        return [b"0", self._cmd_keys([pat])]
+
+    def _cmd_dbsize(self, a):
+        with self._lock:
+            return sum(1 for k in list(self._db.data) if self._alive(k))
+
+    def _cmd_expire(self, a):
+        return self._expire_ms(a[0], int(a[1]) * 1000)
+
+    def _cmd_pexpire(self, a):
+        return self._expire_ms(a[0], int(a[1]))
+
+    def _expire_ms(self, key: bytes, ms: int) -> int:
+        with self._lock:
+            if not self._alive(key):
+                return 0
+            self._db.expires[key] = int(time.time() * 1000) + ms
+            return 1
+
+    def _cmd_ttl(self, a):
+        ms = self._cmd_pttl(a)
+        return ms if ms < 0 else (ms + 999) // 1000
+
+    def _cmd_pttl(self, a):
+        with self._lock:
+            if not self._alive(a[0]):
+                return -2
+            exp = self._db.expires.get(a[0])
+            if exp is None:
+                return -1
+            return max(0, exp - int(time.time() * 1000))
+
+    # -- strings -------------------------------------------------------
+
+    def _cmd_set(self, a):
+        key, val = a[0], a[1]
+        px = nx = xx = None
+        keepttl = False
+        i = 2
+        while i < len(a):
+            opt = a[i].upper()
+            if opt == b"EX":
+                px = int(a[i + 1]) * 1000
+                i += 2
+            elif opt == b"PX":
+                px = int(a[i + 1])
+                i += 2
+            elif opt == b"NX":
+                nx = True
+                i += 1
+            elif opt == b"XX":
+                xx = True
+                i += 1
+            elif opt == b"KEEPTTL":
+                keepttl = True
+                i += 1
+            else:
+                return Error("ERR syntax error")
+        with self._lock:
+            exists = self._alive(key)
+            if (nx and exists) or (xx and not exists):
+                return None
+            self._db.data[key] = ("string", val)
+            if px is not None:
+                self._db.expires[key] = int(time.time() * 1000) + px
+            elif not keepttl:
+                self._db.expires.pop(key, None)
+        return "OK"
+
+    def _cmd_get(self, a):
+        with self._lock:
+            v = self._typed(a[0], "string")
+            return v
+
+    def _cmd_mget(self, a):
+        with self._lock:
+            out = []
+            for k in a:
+                try:
+                    out.append(self._typed(k, "string"))
+                except Error:
+                    out.append(None)
+            return out
+
+    def _cmd_incr(self, a):
+        return self._cmd_incrby([a[0], b"1"])
+
+    def _cmd_incrby(self, a):
+        with self._lock:
+            cur = self._typed(a[0], "string")
+            n = (int(cur) if cur is not None else 0) + int(a[1])
+            self._db.data[a[0]] = ("string", str(n).encode())
+            return n
+
+    # -- hashes --------------------------------------------------------
+
+    def _cmd_hset(self, a):
+        with self._lock:
+            h = self._typed(a[0], "hash", dict)
+            added = 0
+            for i in range(1, len(a) - 1, 2):
+                if a[i] not in h:
+                    added += 1
+                h[a[i]] = a[i + 1]
+            return added
+
+    def _cmd_hget(self, a):
+        with self._lock:
+            h = self._typed(a[0], "hash")
+            return None if h is None else h.get(a[1])
+
+    def _cmd_hgetall(self, a):
+        with self._lock:
+            h = self._typed(a[0], "hash")
+            out: list[bytes] = []
+            for k, v in (h or {}).items():
+                out += [k, v]
+            return out
+
+    def _cmd_hdel(self, a):
+        with self._lock:
+            h = self._typed(a[0], "hash")
+            if h is None:
+                return 0
+            n = sum(1 for f in a[1:] if h.pop(f, None) is not None)
+            if not h:
+                self._db.data.pop(a[0], None)
+            return n
+
+    def _cmd_hlen(self, a):
+        with self._lock:
+            h = self._typed(a[0], "hash")
+            return len(h or {})
+
+    def _cmd_hexists(self, a):
+        with self._lock:
+            h = self._typed(a[0], "hash")
+            return int(bool(h and a[1] in h))
+
+    # -- lists ---------------------------------------------------------
+
+    def _cmd_rpush(self, a):
+        with self._lock:
+            l = self._typed(a[0], "list", list)
+            l.extend(a[1:])
+            return len(l)
+
+    def _cmd_lpush(self, a):
+        with self._lock:
+            l = self._typed(a[0], "list", list)
+            for v in a[1:]:
+                l.insert(0, v)
+            return len(l)
+
+    def _cmd_llen(self, a):
+        with self._lock:
+            l = self._typed(a[0], "list")
+            return len(l or [])
+
+    def _cmd_lrange(self, a):
+        start, stop = int(a[1]), int(a[2])
+        with self._lock:
+            l = list(self._typed(a[0], "list") or [])
+        n = len(l)
+        if start < 0:
+            start += n
+        if stop < 0:
+            stop += n
+        return l[max(0, start): stop + 1]
+
+    def _cmd_lpop(self, a):
+        with self._lock:
+            l = self._typed(a[0], "list")
+            if not l:
+                return None
+            v = l.pop(0)
+            if not l:
+                self._db.data.pop(a[0], None)
+            return v
+
+    def _cmd_rpop(self, a):
+        with self._lock:
+            l = self._typed(a[0], "list")
+            if not l:
+                return None
+            v = l.pop()
+            if not l:
+                self._db.data.pop(a[0], None)
+            return v
+
+    # -- sorted sets ---------------------------------------------------
+
+    def _cmd_zadd(self, a):
+        with self._lock:
+            z = self._typed(a[0], "zset", dict)
+            added = 0
+            for i in range(1, len(a) - 1, 2):
+                member = a[i + 1]
+                if member not in z:
+                    added += 1
+                z[member] = float(a[i])
+            return added
+
+    def _cmd_zrem(self, a):
+        with self._lock:
+            z = self._typed(a[0], "zset")
+            if z is None:
+                return 0
+            n = sum(1 for m in a[1:] if z.pop(m, None) is not None)
+            if not z:
+                self._db.data.pop(a[0], None)
+            return n
+
+    def _cmd_zcard(self, a):
+        with self._lock:
+            z = self._typed(a[0], "zset")
+            return len(z or {})
+
+    def _cmd_zscore(self, a):
+        with self._lock:
+            z = self._typed(a[0], "zset")
+            if not z or a[1] not in z:
+                return None
+            return repr(z[a[1]]).encode()
+
+    def _sorted_members(self, key: bytes):
+        z = self._typed(key, "zset")
+        return sorted((z or {}).items(), key=lambda kv: (kv[1], kv[0]))
+
+    def _cmd_zrange(self, a):
+        start, stop = int(a[1]), int(a[2])
+        withscores = any(x.upper() == b"WITHSCORES" for x in a[3:])
+        with self._lock:
+            members = self._sorted_members(a[0])
+        n = len(members)
+        if start < 0:
+            start += n
+        if stop < 0:
+            stop += n
+        sel = members[max(0, start): stop + 1]
+        out: list[bytes] = []
+        for m, s in sel:
+            out.append(m)
+            if withscores:
+                out.append(repr(s).encode())
+        return out
+
+    @staticmethod
+    def _score_bound(raw: bytes) -> tuple[float, bool]:
+        excl = raw.startswith(b"(")
+        if excl:
+            raw = raw[1:]
+        if raw in (b"-inf", b"+inf", b"inf"):
+            v = float(raw.replace(b"+", b""))
+        else:
+            v = float(raw)
+        return v, excl
+
+    def _cmd_zrangebyscore(self, a):
+        lo, lo_x = self._score_bound(a[1])
+        hi, hi_x = self._score_bound(a[2])
+        offset, count = 0, None
+        withscores = False
+        i = 3
+        while i < len(a):
+            opt = a[i].upper()
+            if opt == b"WITHSCORES":
+                withscores = True
+                i += 1
+            elif opt == b"LIMIT":
+                offset, count = int(a[i + 1]), int(a[i + 2])
+                i += 3
+            else:
+                return Error("ERR syntax error")
+        with self._lock:
+            members = self._sorted_members(a[0])
+        sel = [
+            (m, s) for m, s in members
+            if (s > lo if lo_x else s >= lo) and (s < hi if hi_x else s <= hi)
+        ]
+        sel = sel[offset:] if count is None else sel[offset: offset + count]
+        out: list[bytes] = []
+        for m, s in sel:
+            out.append(m)
+            if withscores:
+                out.append(repr(s).encode())
+        return out
+
+    # -- streams -------------------------------------------------------
+
+    def _cmd_xadd(self, a):
+        key, idspec = a[0], a[1]
+        fields = {a[i]: a[i + 1] for i in range(2, len(a) - 1, 2)}
+        with self._cond:
+            st = self._typed(key, "stream", _Stream)
+            if idspec == b"*":
+                ms, seq = st.next_id()
+            else:
+                ms, seq = _parse_id(idspec)
+                if (ms, seq) <= (st.last_ms, st.last_seq) and st.entries:
+                    return Error(
+                        "ERR The ID specified in XADD is equal or smaller "
+                        "than the target stream top item"
+                    )
+            st.add(ms, seq, fields)
+            self._cond.notify_all()
+            return _fmt_id(ms, seq)
+
+    def _cmd_xlen(self, a):
+        with self._lock:
+            st = self._typed(a[0], "stream")
+            return len(st.entries) if st else 0
+
+    @staticmethod
+    def _entry_reply(e: tuple[int, int, dict[bytes, bytes]]):
+        ms, seq, fields = e
+        flat: list[bytes] = []
+        for k, v in fields.items():
+            flat += [k, v]
+        return [_fmt_id(ms, seq), flat]
+
+    def _cmd_xrange(self, a):
+        key, lo_raw, hi_raw = a[0], a[1], a[2]
+        count = None
+        if len(a) >= 5 and a[3].upper() == b"COUNT":
+            count = int(a[4])
+        lo = (0, 0) if lo_raw == b"-" else _parse_id(lo_raw, 0)
+        hi = (1 << 62, 1 << 62) if hi_raw == b"+" else _parse_id(hi_raw, 1 << 62)
+        with self._lock:
+            st = self._typed(a[0], "stream")
+            entries = list(st.entries) if st else []
+        out = [
+            self._entry_reply(e) for e in entries if lo <= (e[0], e[1]) <= hi
+        ]
+        return out[:count] if count is not None else out
+
+    def _cmd_xgroup(self, a):
+        sub = a[0].upper()
+        if sub != b"CREATE":
+            return Error("ERR unsupported XGROUP subcommand")
+        key, group, start = a[1], a[2], a[3]
+        mkstream = any(x.upper() == b"MKSTREAM" for x in a[4:])
+        with self._lock:
+            st = self._typed(key, "stream")
+            if st is None:
+                if not mkstream:
+                    return Error(
+                        "ERR The XGROUP subcommand requires the key to exist. "
+                        "Note that for CREATE you may want to use the MKSTREAM "
+                        "option to create an empty stream automatically."
+                    )
+                st = self._typed(key, "stream", _Stream)
+            if group in st.groups:
+                return Error("BUSYGROUP Consumer Group name already exists")
+            if start == b"$":
+                ms, seq = st.last_ms, st.last_seq
+            else:
+                ms, seq = _parse_id(start)
+            st.groups[group] = _Group(ms, seq)
+        return "OK"
+
+    def _cmd_xreadgroup(self, a):
+        group = consumer = None
+        count = 10**9
+        block_ms = None
+        i = 0
+        keys: list[bytes] = []
+        ids: list[bytes] = []
+        while i < len(a):
+            opt = a[i].upper()
+            if opt == b"GROUP":
+                group, consumer = a[i + 1], a[i + 2]
+                i += 3
+            elif opt == b"COUNT":
+                count = int(a[i + 1])
+                i += 2
+            elif opt == b"BLOCK":
+                block_ms = int(a[i + 1])
+                i += 2
+            elif opt == b"NOACK":
+                i += 1
+            elif opt == b"STREAMS":
+                rest = a[i + 1:]
+                half = len(rest) // 2
+                keys, ids = rest[:half], rest[half:]
+                break
+            else:
+                return Error("ERR syntax error")
+        if group is None or not keys:
+            return Error("ERR syntax error")
+        deadline = None if block_ms is None else time.monotonic() + block_ms / 1000.0
+        while True:
+            with self._cond:
+                result = []
+                for key, idspec in zip(keys, ids):
+                    st = self._typed(key, "stream")
+                    if st is None or group not in st.groups:
+                        return Error(
+                            "NOGROUP No such key '%s' or consumer group '%s'"
+                            % (key.decode(), group.decode())
+                        )
+                    g = st.groups[group]
+                    taken = []
+                    if idspec == b">":
+                        cur = (g.last_ms, g.last_seq)
+                        for e in st.entries:
+                            eid = (e[0], e[1])
+                            if eid > cur:
+                                taken.append(e)
+                                g.last_ms, g.last_seq = eid
+                                g.pending[eid] = [
+                                    consumer, int(time.time() * 1000), 1
+                                ]
+                                if len(taken) >= count:
+                                    break
+                    else:
+                        # Re-read this consumer's pending entries from id.
+                        lo = _parse_id(idspec, 0)
+                        for e in st.entries:
+                            eid = (e[0], e[1])
+                            p = g.pending.get(eid)
+                            if p and p[0] == consumer and eid >= lo:
+                                taken.append(e)
+                                if len(taken) >= count:
+                                    break
+                    if taken:
+                        result.append([key, [self._entry_reply(e) for e in taken]])
+                if result:
+                    return result
+                if deadline is None:
+                    return None
+                remaining = deadline - time.monotonic()
+                if block_ms != 0 and remaining <= 0:
+                    return None
+                self._cond.wait(
+                    timeout=0.25 if block_ms == 0 else min(remaining, 0.25)
+                )
+
+    def _cmd_xack(self, a):
+        key, group = a[0], a[1]
+        with self._lock:
+            st = self._typed(key, "stream")
+            if st is None or group not in st.groups:
+                return 0
+            g = st.groups[group]
+            return sum(
+                1 for raw in a[2:] if g.pending.pop(_parse_id(raw), None)
+            )
+
+    def _cmd_xpending(self, a):
+        key, group = a[0], a[1]
+        with self._lock:
+            st = self._typed(key, "stream")
+            if st is None or group not in st.groups:
+                return Error(
+                    "NOGROUP No such key '%s' or consumer group '%s'"
+                    % (key.decode(), group.decode())
+                )
+            g = st.groups[group]
+            pend = sorted(g.pending.items())
+            if len(a) == 2:  # summary form
+                if not pend:
+                    return [0, None, None, None]
+                consumers: dict[bytes, int] = {}
+                for _eid, (c, _t, _n) in pend:
+                    consumers[c] = consumers.get(c, 0) + 1
+                return [
+                    len(pend),
+                    _fmt_id(*pend[0][0]),
+                    _fmt_id(*pend[-1][0]),
+                    [[c, str(n).encode()] for c, n in sorted(consumers.items())],
+                ]
+            # extended: [IDLE ms] start end count [consumer]
+            i = 2
+            min_idle = 0
+            if a[i].upper() == b"IDLE":
+                min_idle = int(a[i + 1])
+                i += 2
+            lo = (0, 0) if a[i] == b"-" else _parse_id(a[i], 0)
+            hi = (1 << 62, 1 << 62) if a[i + 1] == b"+" else _parse_id(a[i + 1], 1 << 62)
+            count = int(a[i + 2])
+            want_consumer = a[i + 3] if len(a) > i + 3 else None
+            now = int(time.time() * 1000)
+            out = []
+            for eid, (c, delivered, n) in pend:
+                idle = now - delivered
+                if eid < lo or eid > hi or idle < min_idle:
+                    continue
+                if want_consumer is not None and c != want_consumer:
+                    continue
+                out.append([_fmt_id(*eid), c, idle, n])
+                if len(out) >= count:
+                    break
+            return out
+
+    def _cmd_xautoclaim(self, a):
+        key, group, consumer = a[0], a[1], a[2]
+        min_idle = int(a[3])
+        start = (0, 0) if a[4] in (b"0", b"0-0", b"-") else _parse_id(a[4], 0)
+        count = 100
+        for i in range(5, len(a) - 1):
+            if a[i].upper() == b"COUNT":
+                count = int(a[i + 1])
+        with self._lock:
+            st = self._typed(key, "stream")
+            if st is None or group not in st.groups:
+                return Error(
+                    "NOGROUP No such key '%s' or consumer group '%s'"
+                    % (key.decode(), group.decode())
+                )
+            g = st.groups[group]
+            now = int(time.time() * 1000)
+            by_id = {(e[0], e[1]): e for e in st.entries}
+            claimed = []
+            deleted = []
+            for eid in sorted(g.pending):
+                if eid < start:
+                    continue
+                p = g.pending[eid]
+                if now - p[1] < min_idle:
+                    continue
+                entry = by_id.get(eid)
+                if entry is None:  # trimmed entry: drop from PEL
+                    del g.pending[eid]
+                    deleted.append(_fmt_id(*eid))
+                    continue
+                p[0] = consumer
+                p[1] = now
+                p[2] += 1
+                claimed.append(self._entry_reply(entry))
+                if len(claimed) >= count:
+                    break
+            return [b"0-0", claimed, deleted]
+
+    def _cmd_xinfo(self, a):
+        sub = a[0].upper()
+        with self._lock:
+            st = self._typed(a[1], "stream")
+            if st is None:
+                return Error("ERR no such key")
+            if sub == b"STREAM":
+                return [
+                    b"length", len(st.entries),
+                    b"last-generated-id", _fmt_id(st.last_ms, st.last_seq),
+                    b"groups", len(st.groups),
+                ]
+            if sub == b"GROUPS":
+                return [
+                    [
+                        b"name", name,
+                        b"pending", len(g.pending),
+                        b"last-delivered-id", _fmt_id(g.last_ms, g.last_seq),
+                    ]
+                    for name, g in sorted(st.groups.items())
+                ]
+        return Error("ERR unsupported XINFO subcommand")
+
+
+def main() -> None:  # pragma: no cover - manual entry point
+    import argparse
+
+    ap = argparse.ArgumentParser(description="omnia in-tree redis server")
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, default=6379)
+    ap.add_argument("--password", default=None)
+    args = ap.parse_args()
+    srv = RedisServer(args.host, args.port, password=args.password).start()
+    print(f"omnia-redisd listening on {srv.address[0]}:{srv.address[1]}")
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        srv.stop()
+
+
+if __name__ == "__main__":  # pragma: no cover
+    main()

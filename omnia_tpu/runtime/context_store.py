@@ -160,6 +160,54 @@ class FileContextStore:
             raise StoreUnavailable(str(e)) from e
 
 
+class RedisContextStore:
+    """Redis-backed context store: the cluster-resume tier (reference
+    analog: PromptKit statestore.RedisStore, cmd/runtime/SERVICE.md
+    context-store table). TTL rides on the key itself (PX), so expiry is
+    server-authoritative and shared across every runtime pod — exactly the
+    property that lets any pod resume any session. Backend outage maps to
+    StoreUnavailable, preserving the tri-state resume probe."""
+
+    def __init__(self, client, ttl_s: float = 3600.0, prefix: str = "ctx:"):
+        self.client = client
+        self.ttl_s = ttl_s
+        self.prefix = prefix
+
+    def _key(self, session_id: str) -> str:
+        return self.prefix + session_id
+
+    def _call(self, fn, *args):
+        # Any RedisError — transport failure OR server error reply
+        # (-LOADING during restart, -READONLY/-MISCONF mid-failover) — is
+        # a backend outage from the resume probe's point of view.
+        from omnia_tpu.redis.client import RedisError
+
+        try:
+            return fn(*args)
+        except RedisError as e:
+            raise StoreUnavailable(str(e)) from e
+
+    def get(self, session_id: str) -> Optional[ConversationState]:
+        raw = self._call(self.client.get, self._key(session_id))
+        return ConversationState.from_json(raw.decode()) if raw else None
+
+    def put(self, state: ConversationState) -> None:
+        state.updated_at = time.time()
+        self._call(
+            lambda: self.client.set(
+                self._key(state.session_id),
+                state.to_json(),
+                px_ms=int(self.ttl_s * 1000),
+            )
+        )
+
+    def delete(self, session_id: str) -> None:
+        self._call(self.client.delete, self._key(session_id))
+
+    def exists(self, session_id: str) -> bool:
+        return bool(self._call(self.client.exists, self._key(session_id)))
+
+
 class BrokenContextStore:
     """Test double: every operation raises StoreUnavailable (outage drills —
     the tri-state resume probe must report UNAVAILABLE, not NOT_FOUND)."""

@@ -1,0 +1,283 @@
+"""Redis-backed hot tier.
+
+The cluster deployment of the live-session store (reference
+internal/session/providers/redis/provider.go): every session-api replica
+sees the same hot sessions. Same interface as `HotStore`; the redis/memory
+conformance suite in tests/test_redis.py runs identical assertions against
+both.
+
+Layout (all under one prefix so multiple tiers can share a server):
+  {p}idx           zset  session_id -> updated_at   (ordering/idleness)
+  {p}s:<sid>       string  JSON SessionRecord
+  {p}r:<sid>:<kind> list   JSON records (messages/tool_calls/...)
+
+updated_at ordering lives in the zset — list_sessions, capacity eviction
+(oldest first) and pop_idle are all ZRANGEBYSCORE reads, never full
+scans. TTL expiry is checked against the zset score (one clock for all
+replicas) rather than per-key TTLs, because an expired-but-present
+session must still be poppable whole by compaction.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from typing import Optional
+
+from omnia_tpu.redis.client import RedisClient
+from omnia_tpu.session.records import (
+    EvalResultRecord,
+    MessageRecord,
+    ProviderCallRecord,
+    RuntimeEventRecord,
+    SessionRecord,
+    ToolCallRecord,
+    from_dict,
+    to_dict,
+)
+
+_KINDS = ("messages", "tool_calls", "provider_calls", "eval_results", "events")
+_KIND_TYPES = {
+    "messages": "message",
+    "tool_calls": "tool_call",
+    "provider_calls": "provider_call",
+    "eval_results": "eval_result",
+    "events": "event",
+}
+
+
+class _Bundle:
+    """Shape-compatible with hot.HotStore's bundle (demote_bundle reads
+    these five attributes + .session)."""
+
+    __slots__ = ("session", "messages", "tool_calls", "provider_calls",
+                 "eval_results", "events")
+
+    def __init__(self, session: SessionRecord) -> None:
+        self.session = session
+        self.messages: list = []
+        self.tool_calls: list = []
+        self.provider_calls: list = []
+        self.eval_results: list = []
+        self.events: list = []
+
+
+class RedisHotStore:
+    def __init__(
+        self,
+        client: RedisClient,
+        ttl_s: float = 3600.0,
+        max_sessions: int = 10000,
+        evict_sink=None,
+        prefix: str = "hot:",
+    ) -> None:
+        self.client = client
+        self.ttl_s = ttl_s
+        self.max_sessions = max_sessions
+        self.evict_sink = evict_sink
+        self.p = prefix
+
+    # -- keys ----------------------------------------------------------
+
+    def _idx(self) -> str:
+        return self.p + "idx"
+
+    def _skey(self, sid: str) -> str:
+        return f"{self.p}s:{sid}"
+
+    def _rkey(self, sid: str, kind: str) -> str:
+        return f"{self.p}r:{sid}:{kind}"
+
+    # -- session record io --------------------------------------------
+
+    def _load(self, sid: str) -> Optional[SessionRecord]:
+        raw = self.client.get(self._skey(sid))
+        if raw is None:
+            return None
+        return from_dict("session", json.loads(raw))
+
+    def _store(self, rec: SessionRecord) -> None:
+        self.client.set(self._skey(rec.session_id), json.dumps(to_dict(rec)))
+        self.client.zadd(self._idx(), rec.updated_at, rec.session_id)
+
+    def _touch(self, rec: SessionRecord) -> None:
+        rec.updated_at = time.time()
+        self._store(rec)
+
+    def _expired(self, rec: SessionRecord) -> bool:
+        return time.time() - rec.updated_at > self.ttl_s
+
+    def _remove(self, sid: str) -> bool:
+        n = self.client.delete(
+            self._skey(sid), *[self._rkey(sid, k) for k in _KINDS]
+        )
+        self.client.zrem(self._idx(), sid)
+        return n > 0
+
+    # -- sessions ------------------------------------------------------
+
+    def ensure_session(self, rec: SessionRecord) -> SessionRecord:
+        existing = self._load(rec.session_id)
+        if existing is None:
+            while self.client.zcard(self._idx()) >= self.max_sessions:
+                oldest = self.client.zrange(self._idx(), 0, 0)
+                if not oldest:
+                    break
+                evicted = self._pop_bundle(oldest[0].decode())
+                if evicted is not None and self.evict_sink is not None:
+                    self.evict_sink(evicted)
+            rec.tier = "hot"
+            self._touch(rec)
+            return rec
+        # Explicit ensure after an auto-ensure must win identity fields
+        # (same merge rule as the in-memory tier).
+        if rec.workspace != "default":
+            existing.workspace = rec.workspace
+        if rec.agent:
+            existing.agent = rec.agent
+        if rec.user_id:
+            existing.user_id = rec.user_id
+        if rec.attrs:
+            existing.attrs.update(rec.attrs)
+        self._touch(existing)
+        return existing
+
+    def get_session(self, session_id: str) -> Optional[SessionRecord]:
+        rec = self._load(session_id)
+        if rec is None or self._expired(rec):
+            return None
+        return rec
+
+    def list_sessions(
+        self, workspace: Optional[str] = None, limit: int = 100
+    ) -> list[SessionRecord]:
+        out = []
+        for sid in reversed(self.client.zrange(self._idx(), 0, -1)):
+            rec = self._load(sid.decode())
+            if rec is None or self._expired(rec):
+                continue
+            if workspace is not None and rec.workspace != workspace:
+                continue
+            out.append(rec)
+            if len(out) >= limit:
+                break
+        return out
+
+    def delete_session(self, session_id: str) -> bool:
+        return self._remove(session_id)
+
+    # -- appends -------------------------------------------------------
+
+    def _append(self, kind: str, rec) -> None:
+        sid = rec.session_id
+        existing = self._load(sid)
+        if existing is None:
+            existing = SessionRecord(session_id=sid)
+        self._touch(existing)
+        self.client.rpush(self._rkey(sid, kind), json.dumps(to_dict(rec)))
+
+    def append_message(self, rec: MessageRecord) -> None:
+        self._append("messages", rec)
+
+    def append_tool_call(self, rec: ToolCallRecord) -> None:
+        self._append("tool_calls", rec)
+
+    def append_provider_call(self, rec: ProviderCallRecord) -> None:
+        self._append("provider_calls", rec)
+
+    def append_eval_result(self, rec: EvalResultRecord) -> None:
+        self._append("eval_results", rec)
+
+    def append_event(self, rec: RuntimeEventRecord) -> None:
+        self._append("events", rec)
+
+    # -- reads ---------------------------------------------------------
+
+    def _read(self, sid: str, kind: str) -> list:
+        t = _KIND_TYPES[kind]
+        return [
+            from_dict(t, json.loads(raw))
+            for raw in self.client.lrange(self._rkey(sid, kind), 0, -1)
+        ]
+
+    def messages(self, session_id: str) -> list[MessageRecord]:
+        return self._read(session_id, "messages")
+
+    def tool_calls(self, session_id: str) -> list[ToolCallRecord]:
+        return self._read(session_id, "tool_calls")
+
+    def provider_calls(self, session_id: str) -> list[ProviderCallRecord]:
+        return self._read(session_id, "provider_calls")
+
+    def eval_results(self, session_id: str) -> list[EvalResultRecord]:
+        return self._read(session_id, "eval_results")
+
+    def events(self, session_id: str) -> list[RuntimeEventRecord]:
+        return self._read(session_id, "events")
+
+    # -- usage ---------------------------------------------------------
+
+    def usage(self, workspace: Optional[str] = None) -> dict:
+        input_t = output_t = sessions = 0
+        cost = 0.0
+        for sid in self.client.zrange(self._idx(), 0, -1):
+            rec = self._load(sid.decode())
+            if rec is None:
+                continue
+            if workspace is not None and rec.workspace != workspace:
+                continue
+            sessions += 1
+            for pc in self.provider_calls(rec.session_id):
+                input_t += pc.input_tokens
+                output_t += pc.output_tokens
+                cost += pc.cost_usd
+        return {
+            "sessions": sessions,
+            "input_tokens": input_t,
+            "output_tokens": output_t,
+            "cost_usd": round(cost, 6),
+        }
+
+    # -- compaction hooks ---------------------------------------------
+
+    def _pop_bundle(self, sid: str) -> Optional[_Bundle]:
+        rec = self._load(sid)
+        if rec is None:
+            self.client.zrem(self._idx(), sid)
+            return None
+        b = _Bundle(rec)
+        for kind in _KINDS:
+            getattr(b, kind).extend(self._read(sid, kind))
+        self._remove(sid)
+        return b
+
+    def pop_idle(
+        self, idle_s: float, limit: int = 100, now: Optional[float] = None
+    ) -> list[_Bundle]:
+        now = time.time() if now is None else now
+        cutoff = now - idle_s
+        out = []
+        for sid in self.client.zrangebyscore(
+            self._idx(), "-inf", cutoff, count=limit
+        ):
+            b = self._pop_bundle(sid.decode())
+            if b is not None:
+                out.append(b)
+        return out
+
+    def restore(self, bundle) -> None:
+        self._store(bundle.session)
+        sid = bundle.session.session_id
+        for kind in _KINDS:
+            recs = getattr(bundle, kind)
+            if recs:
+                self.client.rpush(
+                    self._rkey(sid, kind),
+                    *[json.dumps(to_dict(r)) for r in recs],
+                )
+
+    def session_ids(self) -> set[str]:
+        return {s.decode() for s in self.client.zrange(self._idx(), 0, -1)}
+
+    def __len__(self) -> int:
+        return self.client.zcard(self._idx())

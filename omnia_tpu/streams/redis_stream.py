@@ -1,0 +1,134 @@
+"""Redis-backed stream: the cluster deployment of the queue fabric.
+
+Same public surface as `omnia_tpu.streams.Stream`, but group bookkeeping
+lives server-side in real Redis Streams (XADD / XREADGROUP / XACK /
+XPENDING / XAUTOCLAIM) — the exact primitives the reference queue uses
+(ee/pkg/arena/queue/redis.go, redis_reclaim.go). ArenaQueue and the
+session event bus take either implementation; the conformance tests in
+tests/test_redis.py run the same suite against both.
+
+Entry payloads ride as one `d` field holding JSON — the fabric's unit is
+a dict, not redis field-value pairs, and one field keeps XADD atomic and
+ordering-faithful for nested data.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from typing import Optional
+
+from omnia_tpu.redis.client import RedisClient
+from omnia_tpu.streams.streams import Entry, PendingEntry
+
+
+class RedisStream:
+    def __init__(self, client: RedisClient, key: str) -> None:
+        self.client = client
+        self.key = key
+        self._known_groups: set[str] = set()
+        # Blocking reads hold a connection for the whole BLOCK window —
+        # give each consumer thread its own so producers never queue
+        # behind a parked XREADGROUP.
+        self._blocking = threading.local()
+
+    def _blocking_client(self) -> RedisClient:
+        c = getattr(self._blocking, "client", None)
+        if c is None:
+            c = self._blocking.client = self.client.clone()
+        return c
+
+    # -- producer ------------------------------------------------------
+
+    def add(self, data: dict) -> str:
+        eid = self.client.xadd(self.key, {"d": json.dumps(data)})
+        return eid.decode()
+
+    # -- consumer groups ----------------------------------------------
+
+    def ensure_group(self, group: str, from_start: bool = True) -> None:
+        if group in self._known_groups:
+            return
+        self.client.xgroup_create(
+            self.key, group, "0" if from_start else "$", mkstream=True
+        )
+        self._known_groups.add(group)
+
+    @staticmethod
+    def _decode_entries(raw: list) -> list[Entry]:
+        out = []
+        for eid, flat in raw:
+            fields = {flat[i]: flat[i + 1] for i in range(0, len(flat), 2)}
+            out.append(Entry(eid.decode(), json.loads(fields[b"d"])))
+        return out
+
+    def read_group(
+        self, group: str, consumer: str, count: int = 10, block_s: float = 0.0
+    ) -> list[Entry]:
+        self.ensure_group(group)
+        block_ms = int(block_s * 1000) if block_s > 0 else None
+        client = self._blocking_client() if block_ms else self.client
+        reply = client.xreadgroup(
+            group, consumer, self.key, count=count, block_ms=block_ms
+        )
+        for key, raw in reply:
+            if key.decode() == self.key:
+                return self._decode_entries(raw)
+        return []
+
+    def ack(self, group: str, *ids: str) -> int:
+        return self.client.xack(self.key, group, *ids)
+
+    def pending(self, group: str) -> list[PendingEntry]:
+        self.ensure_group(group)
+        now = time.time()
+        out = []
+        for eid, consumer, idle_ms, n in self.client.xpending(self.key, group):
+            rows = self.client.xrange(self.key, eid.decode(), eid.decode())
+            if not rows:
+                continue  # trimmed
+            entry = self._decode_entries(rows)[0]
+            out.append(
+                PendingEntry(
+                    entry,
+                    consumer.decode(),
+                    delivered_at=now - int(idle_ms) / 1000.0,
+                    delivery_count=int(n),
+                )
+            )
+        out.sort(key=lambda p: p.entry.seq_key())
+        return out
+
+    def claim_idle(
+        self, group: str, consumer: str, min_idle_s: float, count: int = 10
+    ) -> list[Entry]:
+        self.ensure_group(group)
+        raw = self.client.xautoclaim(
+            self.key, group, consumer, int(min_idle_s * 1000), count=count
+        )
+        return self._decode_entries(raw)
+
+    def delivery_count(self, group: str, eid: str) -> int:
+        rows = self.client.xpending(self.key, group, lo=eid, hi=eid, count=1)
+        return int(rows[0][3]) if rows else 0
+
+    def stats(self, group: Optional[str] = None) -> dict:
+        d: dict = {"length": self.client.xlen(self.key), "groups": {}}
+        try:
+            ginfo = self.client.execute("XINFO", "GROUPS", self.key)
+        except Exception:
+            ginfo = []
+        for flat in ginfo or []:
+            info = {flat[i]: flat[i + 1] for i in range(0, len(flat), 2)}
+            name = info[b"name"].decode()
+            if group is not None and name != group:
+                continue
+            pending = int(info[b"pending"])
+            cursor = info[b"last-delivered-id"].decode()
+            # acked = delivered - pending; delivered = entries ≤ cursor.
+            delivered = (
+                0 if cursor == "0-0" else len(self.client.xrange(self.key, "-", cursor))
+            )
+            d["groups"][name] = {"pending": pending, "acked": delivered - pending}
+        return d

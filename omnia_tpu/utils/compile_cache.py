@@ -1,0 +1,68 @@
+"""Persistent XLA compilation cache.
+
+The engine AOT-compiles every serving shape before readiness (the TTFT
+discipline — no compile on the request path), which makes *cold start* pay
+the full compile bill. The reference's serving stack has no compile step at
+all (it relays HTTPS SSE), so its pods are warm in seconds; a TPU pod that
+recompiles ~100 s of XLA programs on every start would make the platform's
+scale-to-zero autoscaling (reference internal/controller/autoscaling.go:204)
+useless. Persisting compiled executables across process starts turns every
+restart after the first into a cache hit: warmup becomes deserialize +
+load, not compile.
+
+One call, idempotent, safe before or after backend init. Used by the
+engine itself (so every serving path benefits), bench, and the dryrun
+entry.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+
+logger = logging.getLogger(__name__)
+
+_enabled = False
+
+
+def default_cache_dir() -> str:
+    """OMNIA_JAX_CACHE_DIR wins; otherwise a dot-dir next to the package
+    (the repo root in dev, the install prefix in a pod image — both are
+    writable in their respective environments)."""
+    env = os.environ.get("OMNIA_JAX_CACHE_DIR")
+    if env:
+        return env
+    pkg_root = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    return os.path.join(pkg_root, ".jax_cache")
+
+
+def enable_compilation_cache(cache_dir: str | None = None) -> str | None:
+    """Point JAX's persistent compilation cache at `cache_dir` and drop the
+    entry-size/compile-time floors so *every* serving program is cached
+    (the defaults skip fast compiles — but through a remote-device tunnel
+    even a 1 s compile is worth skipping). Returns the dir, or None if the
+    cache could not be enabled (old jax) — serving still works, cold starts
+    just stay slow."""
+    global _enabled
+    if _enabled:
+        return default_cache_dir() if cache_dir is None else cache_dir
+    explicit = cache_dir is not None or "OMNIA_JAX_CACHE_DIR" in os.environ
+    cache_dir = cache_dir or default_cache_dir()
+    try:
+        import jax
+
+        if not explicit and jax.default_backend() == "cpu":
+            # CPU runs (tests, dev) don't pay a meaningful compile bill,
+            # and XLA:CPU AOT cache entries are machine-feature-pinned —
+            # reloading them across feature-detection differences risks
+            # SIGILL. Opt in explicitly to cache on CPU.
+            return None
+        os.makedirs(cache_dir, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+        _enabled = True
+        return cache_dir
+    except Exception:  # pragma: no cover - depends on jax version
+        logger.exception("persistent compilation cache unavailable")
+        return None

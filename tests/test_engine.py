@@ -302,3 +302,84 @@ def test_chunked_decode_matches_per_token(engine):
     # seeded sampling too (per-slot PRNG must advance identically)
     sp2 = SamplingParams(temperature=1.0, max_tokens=9, seed=42)
     assert e1.generate([2, 7], sp2)[0] == e8.generate([2, 7], sp2)[0]
+
+
+class TestDecodePipeline:
+    """decode_pipeline must be behavior-invisible: only dispatch timing
+    changes, never tokens."""
+
+    def _mk(self, pipeline, **kw):
+        cfg = get_config("test-tiny")
+        return InferenceEngine(
+            cfg,
+            EngineConfig(
+                num_slots=2, max_seq=64, prefill_buckets=(8,), dtype="float32",
+                decode_chunk=4, decode_pipeline=pipeline, **kw,
+            ),
+            seed=11,
+        )
+
+    def test_pipelined_matches_sync(self):
+        sp = SamplingParams(temperature=0.0, max_tokens=10)
+        sync = self._mk(1)
+        pipe = self._mk(2)
+        assert sync.generate([3, 1, 4], sp)[0] == pipe.generate([3, 1, 4], sp)[0]
+        sp2 = SamplingParams(temperature=1.0, max_tokens=9, seed=5)
+        assert sync.generate([2, 7], sp2)[0] == pipe.generate([2, 7], sp2)[0]
+
+    def test_pipelined_sessions_match_fresh(self):
+        """Cross-turn prefix reuse under a pipelined engine still equals a
+        fresh full-prompt generation."""
+        sp = SamplingParams(temperature=0.0, max_tokens=5)
+        pipe = self._mk(2)
+        t1, _ = pipe.generate([1, 2, 3, 4, 5], sp)
+
+        sess = self._mk(2)
+        a, _ = sess.generate([1, 2, 3], sp)  # unrelated warm traffic
+        h = sess.submit([1, 2, 3, 4, 5], sp, session_id="s1")
+        while sess.step():
+            pass
+        got, fin = h.collect_tokens(timeout=5)
+        assert got == t1
+        # turn 2 extends the resident rows
+        prompt2 = [1, 2, 3, 4, 5] + t1 + [9]
+        fresh = self._mk(1)
+        want, _ = fresh.generate(prompt2, sp)
+        h2 = sess.submit(prompt2, sp, session_id="s1")
+        while sess.step():
+            pass
+        got2, _ = h2.collect_tokens(timeout=5)
+        assert got2 == want
+        assert sess.metrics["prefix_reuse_tokens"] > 0
+
+    def test_cancel_and_reuse_slot_mid_flight(self):
+        """A slot freed by cancellation while a chunk is in flight must not
+        leak the old request's tokens into its new occupant."""
+        pipe = self._mk(2)
+        sp_long = SamplingParams(temperature=0.0, max_tokens=40)
+        h1 = pipe.submit([1, 2, 3], sp_long)
+        h2 = pipe.submit([4, 5, 6], sp_long)
+        for _ in range(3):
+            pipe.step()
+        h1.cancel()
+        h2.cancel()
+        sp = SamplingParams(temperature=0.0, max_tokens=6)
+        want, _ = self._mk(1).generate([7, 8, 9], sp)
+        h3 = pipe.submit([7, 8, 9], sp)
+        while pipe.step():
+            pass
+        got, fin = h3.collect_tokens(timeout=5)
+        assert fin.finish_reason == FinishReason.LENGTH
+        assert got == want
+
+    def test_more_requests_than_slots_pipelined(self):
+        pipe = self._mk(2)
+        sp = SamplingParams(temperature=0.0, max_tokens=3)
+        want = [self._mk(1).generate([i + 1, i + 2], sp)[0] for i in range(5)]
+        handles = [pipe.submit([i + 1, i + 2], sp) for i in range(5)]
+        while pipe.step():
+            pass
+        for h, w in zip(handles, want):
+            got, fin = h.collect_tokens(timeout=5)
+            assert fin.finish_reason == FinishReason.LENGTH
+            assert got == w
