@@ -120,9 +120,12 @@ class AuthChain:
     def __init__(self, validators: Sequence[Validator]):
         self.validators = list(validators)
 
-    def authenticate(self, token: str) -> Optional[Principal]:
+    def authenticate(self, token: str, headers=None) -> Optional[Principal]:
+        """Header-aware validators (edge trust) get the request headers via
+        validate_request; token validators see only the bearer token."""
         for v in self.validators:
-            p = v.validate(token or "")
+            vr = getattr(v, "validate_request", None)
+            p = vr(token or "", headers) if vr is not None else v.validate(token or "")
             if p is not None:
                 return p
         return None

@@ -1,0 +1,227 @@
+"""OIDC / JWKS / edge-trust validators for the facade auth chain.
+
+The external-auth tier of the reference facade (reference pkg/facade/auth/
+{oidc,jwks,edge_trust}.go; AgentRuntime spec.externalAuth,
+agentruntime_external_auth_types.go): end users authenticate against the
+workspace's identity provider; the facade validates RS256 ID/access
+tokens against the provider's published JWKS, discovered via
+`/.well-known/openid-configuration`. Edge trust covers the
+gateway-terminated variant: a fronting proxy (Istio/ALB) authenticates
+and forwards identity headers, which are trusted only when the request
+proves it came from the edge (shared header secret).
+
+Key handling rides on the `cryptography` package (already in the image);
+everything else is stdlib. JWKS sources cache keys and refetch once on an
+unknown kid — the standard rotation dance.
+"""
+
+from __future__ import annotations
+
+import base64
+import hashlib
+import hmac
+import json
+import threading
+import time
+import urllib.request
+from typing import Optional
+
+from omnia_tpu.facade.auth import Principal, _b64url_decode
+
+
+class _RSAKey:
+    def __init__(self, n: int, e: int) -> None:
+        from cryptography.hazmat.primitives.asymmetric import rsa
+
+        self._pub = rsa.RSAPublicNumbers(e, n).public_key()
+
+    def verify_pkcs1v15_sha256(self, sig: bytes, data: bytes) -> bool:
+        from cryptography.exceptions import InvalidSignature
+        from cryptography.hazmat.primitives import hashes
+        from cryptography.hazmat.primitives.asymmetric import padding
+
+        try:
+            self._pub.verify(sig, data, padding.PKCS1v15(), hashes.SHA256())
+            return True
+        except InvalidSignature:
+            return False
+
+
+def _parse_jwks(doc: dict) -> dict[str, _RSAKey]:
+    keys: dict[str, _RSAKey] = {}
+    for jwk in doc.get("keys", []):
+        if jwk.get("kty") != "RSA" or jwk.get("use", "sig") != "sig":
+            continue
+        try:
+            n = int.from_bytes(_b64url_decode(jwk["n"]), "big")
+            e = int.from_bytes(_b64url_decode(jwk["e"]), "big")
+            keys[jwk.get("kid", "")] = _RSAKey(n, e)
+        except Exception:
+            continue  # one malformed key must not poison the set
+    return keys
+
+
+class StaticJWKS:
+    """Fixed key set (tests, air-gapped deployments with pinned keys)."""
+
+    def __init__(self, doc: dict) -> None:
+        self._keys = _parse_jwks(doc)
+
+    def key(self, kid: str) -> Optional[_RSAKey]:
+        return self._keys.get(kid)
+
+
+class HTTPJWKS:
+    """JWKS fetched from a URL, cached, refetched at most every
+    `min_refresh_s` — and immediately (rate-limited) on an unknown kid,
+    which is how key rotation propagates."""
+
+    def __init__(self, url: str, min_refresh_s: float = 60.0,
+                 timeout_s: float = 10.0) -> None:
+        self.url = url
+        self.min_refresh_s = min_refresh_s
+        self.timeout_s = timeout_s
+        self._keys: dict[str, _RSAKey] = {}
+        self._fetched_at = 0.0
+        self._lock = threading.Lock()
+
+    def _fetch_locked(self) -> None:
+        with urllib.request.urlopen(self.url, timeout=self.timeout_s) as r:
+            doc = json.loads(r.read())
+        self._keys = _parse_jwks(doc)
+        self._fetched_at = time.monotonic()
+
+    def key(self, kid: str) -> Optional[_RSAKey]:
+        with self._lock:
+            if not self._keys and self._fetched_at == 0.0:
+                try:
+                    self._fetch_locked()
+                except Exception:
+                    return None
+            k = self._keys.get(kid)
+            if k is None and time.monotonic() - self._fetched_at >= self.min_refresh_s:
+                try:
+                    self._fetch_locked()
+                except Exception:
+                    return None
+                k = self._keys.get(kid)
+            return k
+
+
+def discover_jwks_uri(issuer: str, timeout_s: float = 10.0) -> str:
+    """OIDC discovery: issuer → jwks_uri."""
+    url = issuer.rstrip("/") + "/.well-known/openid-configuration"
+    with urllib.request.urlopen(url, timeout=timeout_s) as r:
+        cfg = json.loads(r.read())
+    return cfg["jwks_uri"]
+
+
+class OIDCValidator:
+    """RS256 JWT validation against a JWKS source, with issuer/audience/
+    time-window claim checks. Only asymmetric RS256 is accepted — an
+    attacker must never be able to downgrade to `none` or to HS256 signed
+    with a public key (the classic JWT confusion attacks)."""
+
+    def __init__(
+        self,
+        jwks,                       # StaticJWKS | HTTPJWKS (duck: .key(kid))
+        issuer: str = "",
+        audience: str = "",
+        leeway_s: float = 30.0,
+        subject_claim: str = "sub",
+    ) -> None:
+        self.jwks = jwks
+        self.issuer = issuer
+        self.audience = audience
+        self.leeway_s = leeway_s
+        self.subject_claim = subject_claim
+
+    @classmethod
+    def from_issuer(cls, issuer: str, audience: str = "", **kw) -> "OIDCValidator":
+        return cls(
+            HTTPJWKS(discover_jwks_uri(issuer)), issuer=issuer,
+            audience=audience, **kw,
+        )
+
+    def validate(self, token: str) -> Optional[Principal]:
+        try:
+            header_b64, payload_b64, sig_b64 = token.split(".")
+            header = json.loads(_b64url_decode(header_b64))
+            if header.get("alg") != "RS256":
+                return None
+            key = self.jwks.key(header.get("kid", ""))
+            if key is None:
+                return None
+            if not key.verify_pkcs1v15_sha256(
+                _b64url_decode(sig_b64), f"{header_b64}.{payload_b64}".encode()
+            ):
+                return None
+            claims = json.loads(_b64url_decode(payload_b64))
+        except Exception:
+            return None
+        now = time.time()
+        if self.issuer and claims.get("iss") != self.issuer:
+            return None
+        if self.audience:
+            aud = claims.get("aud")
+            auds = aud if isinstance(aud, list) else [aud]
+            if self.audience not in auds:
+                return None
+        exp = claims.get("exp")
+        if exp is not None and now > exp + self.leeway_s:
+            return None
+        nbf = claims.get("nbf")
+        if nbf is not None and now < nbf - self.leeway_s:
+            return None
+        subject = str(claims.get(self.subject_claim, ""))
+        if not subject:
+            return None
+        return Principal(subject=subject, method="oidc", claims=claims)
+
+
+class EdgeTrustValidator:
+    """Trust identity asserted by a fronting gateway — but only when the
+    request carries the edge secret, proving it traversed the proxy and
+    not a direct path around it (reference pkg/facade/auth/edge_trust.go:
+    spec.externalAuth.edgeTrust). Header-based, so it participates via
+    validate_request; bare-token validate always denies."""
+
+    def __init__(
+        self,
+        edge_secret: str,
+        identity_header: str = "x-forwarded-user",
+        secret_header: str = "x-edge-auth",
+    ) -> None:
+        self._digest = hashlib.sha256(edge_secret.encode()).digest()
+        self.identity_header = identity_header.lower()
+        self.secret_header = secret_header.lower()
+
+    def validate(self, token: str) -> Optional[Principal]:
+        return None
+
+    def validate_request(self, token: str, headers) -> Optional[Principal]:
+        if headers is None:
+            return None
+        # Iterate items() rather than dict()-ing: websockets' Headers is a
+        # multidict whose dict() conversion raises on duplicated header
+        # names (proxies routinely duplicate X-Forwarded-*). First value
+        # wins — the edge's own header precedes any client-smuggled copy.
+        lowered: dict[str, str] = {}
+        try:
+            pairs = headers.raw_items()
+        except AttributeError:
+            pairs = headers.items()
+        for k, v in pairs:
+            lowered.setdefault(str(k).lower(), str(v))
+        secret = lowered.get(self.secret_header, "")
+        if not secret or not hmac.compare_digest(
+            hashlib.sha256(secret.encode()).digest(), self._digest
+        ):
+            return None
+        subject = lowered.get(self.identity_header, "")
+        if not subject:
+            return None
+        return Principal(
+            subject=subject, method="edge_trust",
+            claims={"identity_header": self.identity_header},
+        )

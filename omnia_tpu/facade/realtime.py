@@ -1,0 +1,260 @@
+"""Realtime park/resume + route table: duplex sessions survive WS blips.
+
+Reference parity: internal/facade/realtime_registry.go:27-118 (parked
+live sessions with a grace TTL) and the Redis route table
+`rt:route:<sid>` → pod address (internal/agent/route_store_redis.go) that
+lets a reconnecting client — via the dashboard WS proxy's route hint —
+land on the pod still holding its live call.
+
+Architecture: a `DuplexSession` owns the runtime stream and ONE output
+thread for the stream's whole life. The thread writes to a swappable
+sink — the live WebSocket when attached, a bounded replay buffer while
+parked. A WS blip detaches (output starts buffering); reconnect attaches
+(buffer flushes to the new socket, then live forwarding continues). The
+runtime never notices: its Converse stream stays open across the blip,
+so the voice call's state (STT partials, pending TTS) is preserved
+end-to-end. Transcript recording happens at emit time, attached or not —
+the archive must not lose what was said during a blip.
+"""
+
+from __future__ import annotations
+
+import collections
+import logging
+import threading
+import time
+from typing import Callable, Optional, Protocol
+
+logger = logging.getLogger(__name__)
+
+
+# ---------------------------------------------------------------------------
+# route table
+# ---------------------------------------------------------------------------
+
+
+class RouteStore(Protocol):
+    def put(self, session_id: str, address: str, ttl_s: float = 300.0) -> None: ...
+    def get(self, session_id: str) -> Optional[str]: ...
+    def delete(self, session_id: str) -> None: ...
+
+
+class InMemoryRouteStore:
+    def __init__(self) -> None:
+        self._routes: dict[str, tuple[str, float]] = {}
+        self._lock = threading.Lock()
+
+    def put(self, session_id: str, address: str, ttl_s: float = 300.0) -> None:
+        with self._lock:
+            self._routes[session_id] = (address, time.time() + ttl_s)
+
+    def get(self, session_id: str) -> Optional[str]:
+        with self._lock:
+            hit = self._routes.get(session_id)
+            if hit is None:
+                return None
+            addr, exp = hit
+            if time.time() > exp:
+                del self._routes[session_id]
+                return None
+            return addr
+
+    def delete(self, session_id: str) -> None:
+        with self._lock:
+            self._routes.pop(session_id, None)
+
+
+class RedisRouteStore:
+    """`rt:route:<sid>` → address with server-side TTL — shared across
+    facade replicas so any proxy can look up where a call lives."""
+
+    def __init__(self, client, prefix: str = "rt:route:") -> None:
+        self.client = client
+        self.prefix = prefix
+
+    def put(self, session_id: str, address: str, ttl_s: float = 300.0) -> None:
+        self.client.set(self.prefix + session_id, address, px_ms=int(ttl_s * 1000))
+
+    def get(self, session_id: str) -> Optional[str]:
+        raw = self.client.get(self.prefix + session_id)
+        return raw.decode() if raw is not None else None
+
+    def delete(self, session_id: str) -> None:
+        self.client.delete(self.prefix + session_id)
+
+
+# ---------------------------------------------------------------------------
+# duplex session with swappable output sink
+# ---------------------------------------------------------------------------
+
+
+class DuplexSession:
+    """Owns a runtime Converse stream in duplex mode plus its single
+    output-forwarding thread. `forward(ws, rmsg)` is supplied by the
+    facade (it knows the WS encoding); `on_record(rmsg)` fires for every
+    server message regardless of attachment."""
+
+    def __init__(
+        self,
+        stream,
+        session_id: str,
+        user_id: str,
+        forward: Callable,
+        on_record: Optional[Callable] = None,
+        buffer_limit: int = 1024,
+    ) -> None:
+        self.stream = stream
+        self.session_id = session_id
+        self.user_id = user_id
+        self._forward = forward
+        self._on_record = on_record
+        self._ws = None
+        self._buffer: collections.deque = collections.deque(maxlen=buffer_limit)
+        self._dropped = 0
+        self._lock = threading.Lock()
+        self.ended = threading.Event()    # runtime stream finished
+        self._closed = False
+        self._thread = threading.Thread(
+            target=self._output_loop, name=f"duplex-out-{session_id}", daemon=True
+        )
+        self._thread.start()
+
+    # -- sink management ----------------------------------------------
+
+    def attach(self, ws) -> int:
+        """Point output at a (new) websocket, flushing anything buffered
+        while parked. Returns the number of replayed messages, or -1 if
+        the socket died mid-flush — the unflushed remainder is re-buffered
+        in order and the session stays detached (caller should re-park)."""
+        with self._lock:
+            replay = list(self._buffer)
+            self._buffer.clear()
+            for i, rmsg in enumerate(replay):
+                try:
+                    self._forward(ws, rmsg)
+                except Exception:
+                    for back in reversed(replay[i:]):
+                        self._buffer.appendleft(back)
+                    return -1
+            self._ws = ws
+            return len(replay)
+
+    def detach(self) -> None:
+        with self._lock:
+            self._ws = None
+
+    @property
+    def attached(self) -> bool:
+        with self._lock:
+            return self._ws is not None
+
+    # -- output thread -------------------------------------------------
+
+    def _output_loop(self) -> None:
+        try:
+            for rmsg in self.stream:
+                if self._on_record is not None:
+                    try:
+                        self._on_record(rmsg)
+                    except Exception:
+                        logger.exception("duplex recording failed (fail-open)")
+                with self._lock:
+                    ws = self._ws
+                    if ws is None:
+                        self._buffer.append(rmsg)
+                        if len(self._buffer) == self._buffer.maxlen:
+                            self._dropped += 1
+                        continue
+                try:
+                    self._forward(ws, rmsg)
+                except Exception:
+                    # WS died mid-forward: park the message and everything
+                    # after it until someone re-attaches. Only clear the
+                    # sink if it is still the socket that failed — attach()
+                    # may have installed a fresh one while we were blocked.
+                    with self._lock:
+                        if self._ws is ws:
+                            self._ws = None
+                        self._buffer.append(rmsg)
+        except Exception:
+            if not self._closed:
+                logger.exception("duplex output stream failed")
+        finally:
+            self.ended.set()
+
+    def close(self) -> None:
+        """End the call: close the runtime stream (the output thread then
+        drains and exits)."""
+        self._closed = True
+        try:
+            self.stream.close()
+        except Exception:
+            pass
+        self.ended.set()
+
+
+# ---------------------------------------------------------------------------
+# registry
+# ---------------------------------------------------------------------------
+
+
+class RealtimeRegistry:
+    """Parked DuplexSessions waiting out a WS blip. A session parks with a
+    grace TTL; `take` hands it to a reconnecting client; the reaper closes
+    calls nobody came back for (reference realtime_registry.go:60-95)."""
+
+    def __init__(self, park_ttl_s: float = 60.0) -> None:
+        self.park_ttl_s = park_ttl_s
+        self._parked: dict[str, tuple[DuplexSession, float]] = {}
+        self._lock = threading.Lock()
+        self._reaper = threading.Thread(
+            target=self._reap_loop, name="realtime-reaper", daemon=True
+        )
+        self._stop = threading.Event()
+        self._reaper.start()
+
+    def park(self, session: DuplexSession) -> None:
+        session.detach()
+        with self._lock:
+            self._parked[session.session_id] = (session, time.time() + self.park_ttl_s)
+
+    def take(self, session_id: str, user_id: str) -> Optional[DuplexSession]:
+        """Claim a parked session for resumption. Ownership-checked: only
+        the same authenticated user may pick up the call."""
+        with self._lock:
+            hit = self._parked.get(session_id)
+            if hit is None:
+                return None
+            session, exp = hit
+            if session.user_id != user_id:
+                return None
+            del self._parked[session_id]
+        if time.time() > exp or session.ended.is_set():
+            session.close()
+            return None
+        return session
+
+    def parked_count(self) -> int:
+        with self._lock:
+            return len(self._parked)
+
+    def _reap_loop(self) -> None:
+        while not self._stop.wait(1.0):
+            now = time.time()
+            with self._lock:
+                dead = [
+                    sid for sid, (s, exp) in self._parked.items()
+                    if now > exp or s.ended.is_set()
+                ]
+                victims = [self._parked.pop(sid)[0] for sid in dead]
+            for s in victims:
+                logger.info("reaping parked duplex session %s", s.session_id)
+                s.close()
+
+    def shutdown(self) -> None:
+        self._stop.set()
+        with self._lock:
+            victims = [s for s, _ in self._parked.values()]
+            self._parked.clear()
+        for s in victims:
+            s.close()

@@ -80,7 +80,9 @@ class JsonHttpFacade:
                              method="anonymous", claims={})
         auth = headers.get("Authorization", "")
         token = auth[7:] if auth.startswith("Bearer ") else query.get("token", [""])[0]
-        return self.auth_chain.authenticate(token)
+        # Headers flow through so edge-trust identities work on REST
+        # exactly as they do on the WS facade.
+        return self.auth_chain.authenticate(token, headers=headers)
 
     # -- request handling (override in subclasses) -------------------------
 

@@ -32,6 +32,7 @@ import json
 import logging
 import re
 import threading
+import time
 import urllib.parse
 import uuid
 from typing import Optional
@@ -64,11 +65,17 @@ class FacadeServer:
         recording: Optional[RecordingInterceptor] = None,
         messages_per_minute: float = 120.0,
         drain_timeout_s: float = 30.0,
+        realtime=None,          # realtime.RealtimeRegistry — park/resume
+        route_store=None,       # realtime.RouteStore — sid → pod address
+        advertise_address: str = "",
     ):
         self.runtime = RuntimeClient(runtime_target)
         self.agent_name = agent_name
         self.auth = auth_chain
         self.recording = recording or RecordingInterceptor(None)
+        self.realtime = realtime
+        self.route_store = route_store
+        self.advertise_address = advertise_address
         self.drain_timeout_s = drain_timeout_s
         self.metrics = Registry(prefix="omnia_facade")
         self._connections_active = self.metrics.gauge(
@@ -104,6 +111,10 @@ class FacadeServer:
             self._ws_server.shutdown()
         if self._health_server is not None:
             self._health_server.shutdown()
+        if self.realtime is not None:
+            # Parked calls hold live runtime streams; a facade going away
+            # must end them, not leak them.
+            self.realtime.shutdown()
         self.recording.close()
         self.runtime.close()
 
@@ -146,7 +157,7 @@ class FacadeServer:
 
         principal: Optional[Principal] = None
         if self.auth is not None:
-            principal = self.auth.authenticate(token)
+            principal = self.auth.authenticate(token, headers=ws.request.headers)
             if principal is None:
                 ws.close(4401, "unauthorized")
                 return
@@ -211,24 +222,59 @@ class FacadeServer:
             self._live.add(ws)
         self._connections_active.add(1)
         stream = None
+        parked_again = False
         try:
-            stream = self.runtime.open_stream(session_id, user_id=user_id, agent=self.agent_name)
-            health = self.runtime.health()
-            self._send(ws, {
-                "type": "connected",
-                "session_id": session_id,
-                "agent": self.agent_name,
-                "capabilities": health.capabilities,
-                "resumed": resumed,
-            })
-            self._connection_loop(ws, stream, session_id, user_id, limiter_key)
+            # A parked live duplex call for this session? Re-attach it to
+            # the new socket instead of opening a fresh runtime stream —
+            # the call never stopped runtime-side (realtime park/resume).
+            resumed_call = (
+                self.realtime.take(session_id, user_id)
+                if self.realtime is not None and requested_session
+                else None
+            )
+            if resumed_call is not None:
+                stream = resumed_call.stream
+                self._send(ws, {
+                    "type": "connected",
+                    "session_id": session_id,
+                    "agent": self.agent_name,
+                    "capabilities": [],
+                    "resumed": True,
+                    "mode": "duplex",
+                })
+                replayed = resumed_call.attach(ws)
+                if replayed < 0:
+                    # The new socket died during the replay flush — the
+                    # remainder is re-buffered; park again for the next try.
+                    self.realtime.park(resumed_call)
+                    parked_again = True
+                else:
+                    logger.info(
+                        "resumed parked duplex %s (%d replayed)", session_id, replayed
+                    )
+                    parked_again = self._duplex_input_loop(ws, resumed_call)
+            else:
+                stream = self.runtime.open_stream(
+                    session_id, user_id=user_id, agent=self.agent_name
+                )
+                health = self.runtime.health()
+                self._send(ws, {
+                    "type": "connected",
+                    "session_id": session_id,
+                    "agent": self.agent_name,
+                    "capabilities": health.capabilities,
+                    "resumed": resumed,
+                })
+                parked_again = self._connection_loop(
+                    ws, stream, session_id, user_id, limiter_key
+                )
         except ConnectionClosed:
             pass
         except Exception as e:
             logger.exception("connection failed")
             self._try_send(ws, {"type": "error", "code": "internal", "message": str(e)})
         finally:
-            if stream is not None:
+            if stream is not None and not parked_again:
                 stream.close()
             with self._live_lock:
                 self._live.discard(ws)
@@ -239,7 +285,9 @@ class FacadeServer:
 
     def _connection_loop(
         self, ws, stream, session_id: str, user_id: str, limiter_key: str
-    ) -> None:
+    ) -> bool:
+        """Text-mode message loop. Returns True iff the connection ended
+        with its runtime stream parked (live duplex call awaiting resume)."""
         import time as _time
 
         while True:
@@ -248,7 +296,7 @@ class FacadeServer:
             except TimeoutError:
                 # Normal idle expiry — clean close, not an internal error.
                 ws.close(1000, "idle timeout")
-                return
+                return False
             if isinstance(raw, bytes):
                 # Binary frames are duplex audio; a voice call must be
                 # negotiated first (duplex_start).
@@ -263,13 +311,13 @@ class FacadeServer:
             mtype = msg.get("type")
             if mtype == "hangup":
                 ws.close(1000, "bye")
-                return
+                return False
             if mtype == "duplex_start":
-                # Switch the connection into voice mode: two pumps
-                # (ws→stream audio input, stream→ws audio output) until
-                # hangup/close — the reference's duplex session shape.
-                self._duplex_loop(ws, stream, session_id, user_id, msg)
-                return
+                # Switch the connection into voice mode: one output thread
+                # owned by a DuplexSession (sink = this ws, or the park
+                # buffer during a blip) + an inline input loop — the
+                # reference's duplex session shape with park/resume.
+                return self._duplex_loop(ws, stream, session_id, user_id, msg)
             if mtype == "tool_result":
                 # tool_result outside a turn: protocol error, ignore.
                 self._try_send(ws, {
@@ -285,7 +333,7 @@ class FacadeServer:
                 continue
             if not self._limiter.allow(limiter_key):
                 ws.close(4429, "rate limited")
-                return
+                return False
 
             self._messages_total.inc()
             content = msg.get("content", "")
@@ -295,7 +343,7 @@ class FacadeServer:
             assistant_text = self._pump_turn(ws, stream, session_id, user_id)
             self._turn_latency.observe(_time.monotonic() - t0)
             if assistant_text is None:
-                return  # turn ended the connection
+                return False  # turn ended the connection
 
     def _pump_turn(self, ws, stream, session_id: str, user_id: str) -> Optional[str]:
         """Forward runtime messages for one turn; handles client-tool
@@ -337,75 +385,122 @@ class FacadeServer:
                 return assistant_text
         return None
 
-    def _duplex_loop(self, ws, stream, session_id: str, user_id: str, start_msg: dict) -> None:
+    def _duplex_loop(
+        self, ws, stream, session_id: str, user_id: str, start_msg: dict
+    ) -> bool:
         """Voice-call mode (reference internal/runtime/duplex.go shape at
-        the facade: binary WS frames ⇄ audio chunks). Client binary frame
-        = audio; EMPTY binary frame = end of utterance; JSON hangup ends
-        the call. Server media_chunk → binary frame; transcripts,
-        interruptions, done and errors stay JSON."""
-        import base64
+        the facade: binary WS frames ⇄ audio chunks). A DuplexSession owns
+        the runtime stream and its output thread for the call's whole
+        life, so a WS blip parks the live call instead of ending it.
+        Returns True iff the call is parked awaiting resume."""
+        from omnia_tpu.facade.realtime import DuplexSession
 
         stream.send(c.ClientMessage(
             type="duplex_start", audio_format=start_msg.get("format") or {}
         ))
-        stop = threading.Event()
+        session = DuplexSession(
+            stream, session_id, user_id,
+            forward=self._forward_duplex,
+            on_record=lambda rmsg: self._record_duplex(session_id, user_id, rmsg),
+        )
+        if self.route_store is not None and self.advertise_address:
+            self.route_store.put(session_id, self.advertise_address)
+        session.attach(ws)
+        return self._duplex_input_loop(ws, session)
 
-        def input_pump():
-            try:
-                while not stop.is_set():
-                    try:
-                        raw = ws.recv(timeout=RECV_IDLE_TIMEOUT_S)
-                    except TimeoutError:
-                        ws.close(1000, "idle timeout")
-                        return
-                    if isinstance(raw, bytes):
-                        stream.send(c.ClientMessage(
-                            type="audio_input",
-                            audio_b64=base64.b64encode(raw).decode() if raw else "",
-                            final=len(raw) == 0,
-                        ))
-                        continue
-                    msg = self._parse(ws, raw)
-                    if msg and msg.get("type") == "hangup":
-                        ws.close(1000, "bye")
-                        return
-            except ConnectionClosed:
-                pass
-            finally:
-                stop.set()
-                stream.close()  # unblock the output pump
+    def _forward_duplex(self, ws, rmsg) -> None:
+        """Runtime ServerMessage → WS frame (binary for audio, JSON rest)."""
+        import base64
 
-        pump = threading.Thread(target=input_pump, daemon=True)
-        pump.start()
+        if rmsg.type == "media_chunk":
+            ws.send(base64.b64decode(rmsg.audio_b64))
+        elif rmsg.type == "duplex_ready":
+            self._send(ws, {"type": "duplex_ready", "format": rmsg.audio_format})
+        elif rmsg.type == "transcript":
+            self._send(ws, {"type": "transcript", "role": rmsg.role, "text": rmsg.text})
+        elif rmsg.type == "interruption":
+            self._send(ws, {"type": "interrupt", "reason": rmsg.text})
+        elif rmsg.type == "done":
+            self._send(ws, {
+                "type": "done",
+                "usage": rmsg.usage.__dict__ if rmsg.usage else {},
+                "finish_reason": rmsg.finish_reason,
+            })
+        elif rmsg.type == "error":
+            self._send(ws, {
+                "type": "error", "code": rmsg.error_code,
+                "message": rmsg.error_message,
+            })
+
+    def _record_duplex(self, session_id: str, user_id: str, rmsg) -> None:
+        """Transcripts reach the session archive at emit time — attached
+        or parked; a blip must not lose what was said."""
+        if rmsg.type == "transcript":
+            if rmsg.role == "user":
+                self.recording.record_user(session_id, user_id, rmsg.text)
+            else:
+                self.recording.record_assistant(session_id, user_id, rmsg.text, {})
+
+    def _duplex_input_loop(self, ws, session) -> bool:
+        """ws → runtime audio input until hangup, blip, or call end.
+        Returns True iff the session was parked (ws died, call alive)."""
+        import base64
+
+        idle_deadline = time.monotonic() + RECV_IDLE_TIMEOUT_S
         try:
-            for rmsg in stream:
-                if rmsg.type == "media_chunk":
-                    ws.send(base64.b64decode(rmsg.audio_b64))
-                elif rmsg.type == "duplex_ready":
-                    self._send(ws, {"type": "duplex_ready", "format": rmsg.audio_format})
-                elif rmsg.type == "transcript":
-                    if rmsg.role == "user":
-                        self.recording.record_user(session_id, user_id, rmsg.text)
-                    else:
-                        self.recording.record_assistant(session_id, user_id, rmsg.text, {})
-                    self._send(ws, {"type": "transcript", "role": rmsg.role, "text": rmsg.text})
-                elif rmsg.type == "interruption":
-                    self._send(ws, {"type": "interrupt", "reason": rmsg.text})
-                elif rmsg.type == "done":
-                    self._send(ws, {
-                        "type": "done",
-                        "usage": rmsg.usage.__dict__ if rmsg.usage else {},
-                        "finish_reason": rmsg.finish_reason,
-                    })
-                elif rmsg.type == "error":
-                    self._try_send(ws, {
-                        "type": "error", "code": rmsg.error_code,
-                        "message": rmsg.error_message,
-                    })
+            while True:
+                if session.ended.is_set():
+                    # Call finished runtime-side; output thread already
+                    # forwarded the final messages.
+                    ws.close(1000, "call ended")
+                    return False
+                try:
+                    raw = ws.recv(timeout=1.0)
+                except TimeoutError:
+                    if time.monotonic() > idle_deadline:
+                        ws.close(1000, "idle timeout")
+                        session.close()
+                        self._drop_route(session.session_id)
+                        return False
+                    continue
+                idle_deadline = time.monotonic() + RECV_IDLE_TIMEOUT_S
+                if isinstance(raw, bytes):
+                    session.stream.send(c.ClientMessage(
+                        type="audio_input",
+                        audio_b64=base64.b64encode(raw).decode() if raw else "",
+                        final=len(raw) == 0,
+                    ))
+                    continue
+                msg = self._parse(ws, raw)
+                if msg and msg.get("type") == "hangup":
+                    ws.close(1000, "bye")
+                    session.close()
+                    self._drop_route(session.session_id)
+                    return False
         except ConnectionClosed:
-            pass
-        finally:
-            stop.set()
+            # WS blip mid-call: park the live session for the grace window
+            # (reference realtime_registry.go park-on-disconnect).
+            if self.realtime is not None and not session.ended.is_set() \
+                    and not self._draining.is_set():
+                session.detach()
+                self.realtime.park(session)
+                if self.route_store is not None and self.advertise_address:
+                    self.route_store.put(
+                        session.session_id, self.advertise_address,
+                        ttl_s=self.realtime.park_ttl_s,
+                    )
+                logger.info("parked duplex session %s on ws blip", session.session_id)
+                return True
+            session.close()
+            self._drop_route(session.session_id)
+            return False
+
+    def _drop_route(self, session_id: str) -> None:
+        if self.route_store is not None:
+            try:
+                self.route_store.delete(session_id)
+            except Exception:
+                logger.warning("route delete failed for %s", session_id)
 
     def _await_tool_result(self, ws, tool_call_id: str) -> Optional[list[c.ToolResult]]:
         try:

@@ -57,40 +57,61 @@ class RedisClient:
         self._sock = sock
         self._reader = Reader(sock.makefile("rb"))
         if self._password is not None:
-            reply = self._roundtrip_locked(encode_command("AUTH", self._password))
+            sock.sendall(encode_command("AUTH", self._password))
+            reply = self._reader.read()
             if isinstance(reply, Error):
                 raise RedisError(reply.message)
 
-    def _roundtrip_locked(self, payload: bytes, timeout_s: Optional[float] = None):
-        assert self._sock is not None
-        self._sock.settimeout(timeout_s if timeout_s is not None else self._timeout)
-        self._sock.sendall(payload)
-        return self._reader.read()
-
     def execute(self, *args: Arg, timeout_s: Optional[float] = None):
-        """One command → decoded reply. Reconnects once on a dead socket;
-        raises RedisUnavailable when the server is unreachable and
-        RedisError on an error reply."""
+        """One command → decoded reply. Retries once ONLY on failures the
+        server provably did not execute (connect failure, or sendall
+        raising mid-write — the server sees a torn multibulk and discards
+        it). A failure after the request was fully written is NOT retried:
+        the command may have executed, and replaying a non-idempotent one
+        (XADD, INCRBY) would duplicate it. Raises RedisUnavailable for
+        transport failures, RedisError for error replies."""
         payload = encode_command(*args)
         with self._lock:
             for attempt in (0, 1):
                 try:
                     if self._sock is None:
                         self._connect_locked()
-                    reply = self._roundtrip_locked(payload, timeout_s)
-                    break
+                    sock = self._sock
+                    sock.settimeout(
+                        timeout_s if timeout_s is not None else self._timeout
+                    )
                 except RedisError:
                     self._drop_locked()
                     raise
                 except Exception as e:
-                    # Transport failure (connect refused, reset, timeout,
-                    # torn reply): drop the socket, retry once on a fresh
-                    # connection, then surface as unavailable.
                     self._drop_locked()
                     if attempt:
                         raise RedisUnavailable(
                             f"redis at {self.host}:{self.port}: {e}"
                         ) from e
+                    continue
+                try:
+                    sock.sendall(payload)
+                except Exception as e:
+                    # Mid-write failure: the server cannot have executed a
+                    # torn command — safe to retry on a fresh connection.
+                    self._drop_locked()
+                    if attempt:
+                        raise RedisUnavailable(
+                            f"redis at {self.host}:{self.port}: {e}"
+                        ) from e
+                    continue
+                try:
+                    reply = self._reader.read()
+                    break
+                except Exception as e:
+                    # Post-write failure: command may have executed; do not
+                    # replay it.
+                    self._drop_locked()
+                    raise RedisUnavailable(
+                        f"redis at {self.host}:{self.port}: {e} "
+                        "(command may have executed)"
+                    ) from e
             else:  # pragma: no cover - loop always breaks or raises
                 raise RedisUnavailable("unreachable")
         if isinstance(reply, Error):

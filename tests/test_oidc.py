@@ -1,0 +1,345 @@
+"""OIDC / JWKS / edge-trust auth tests (reference pkg/facade/auth/
+{oidc,jwks,edge_trust}.go parity): RS256 validation against a local JWKS
+fixture, discovery, rotation-by-refetch, downgrade-attack rejection, and
+the validators working through the real facade WebSocket handshake."""
+
+import http.server
+import json
+import threading
+import time
+
+import pytest
+
+from omnia_tpu.facade.auth import AuthChain, Principal, _b64url_encode
+from omnia_tpu.facade.oidc import (
+    EdgeTrustValidator,
+    HTTPJWKS,
+    OIDCValidator,
+    StaticJWKS,
+    discover_jwks_uri,
+)
+
+
+# ---------------------------------------------------------------------------
+# RS256 fixture key + minting helpers
+# ---------------------------------------------------------------------------
+
+from cryptography.hazmat.primitives import hashes
+from cryptography.hazmat.primitives.asymmetric import padding, rsa
+
+
+@pytest.fixture(scope="module")
+def keypair():
+    priv = rsa.generate_private_key(public_exponent=65537, key_size=2048)
+    return priv
+
+
+def _jwk(priv, kid="k1"):
+    pub = priv.public_key().public_numbers()
+    return {
+        "kty": "RSA",
+        "kid": kid,
+        "use": "sig",
+        "alg": "RS256",
+        "n": _b64url_encode(pub.n.to_bytes((pub.n.bit_length() + 7) // 8, "big")),
+        "e": _b64url_encode(pub.e.to_bytes((pub.e.bit_length() + 7) // 8, "big")),
+    }
+
+
+def mint(priv, kid="k1", alg="RS256", **claims):
+    claims.setdefault("sub", "user-1")
+    claims.setdefault("iss", "https://idp.test")
+    claims.setdefault("aud", "omnia")
+    claims.setdefault("exp", int(time.time()) + 300)
+    header = _b64url_encode(json.dumps({"alg": alg, "kid": kid}).encode())
+    payload = _b64url_encode(json.dumps(claims).encode())
+    sig = priv.sign(
+        f"{header}.{payload}".encode(), padding.PKCS1v15(), hashes.SHA256()
+    )
+    return f"{header}.{payload}.{_b64url_encode(sig)}"
+
+
+@pytest.fixture(scope="module")
+def validator(keypair):
+    return OIDCValidator(
+        StaticJWKS({"keys": [_jwk(keypair)]}),
+        issuer="https://idp.test",
+        audience="omnia",
+    )
+
+
+class TestOIDCValidation:
+    def test_valid_token(self, keypair, validator):
+        p = validator.validate(mint(keypair))
+        assert p is not None and p.method == "oidc"
+        assert p.subject == "user-1"
+        assert p.claims["aud"] == "omnia"
+
+    def test_wrong_signature_rejected(self, validator):
+        other = rsa.generate_private_key(public_exponent=65537, key_size=2048)
+        assert validator.validate(mint(other)) is None
+
+    def test_expired_rejected(self, keypair, validator):
+        tok = mint(keypair, exp=int(time.time()) - 120)
+        assert validator.validate(tok) is None
+
+    def test_not_yet_valid_rejected(self, keypair, validator):
+        tok = mint(keypair, nbf=int(time.time()) + 300)
+        assert validator.validate(tok) is None
+
+    def test_wrong_issuer_rejected(self, keypair, validator):
+        assert validator.validate(mint(keypair, iss="https://evil.test")) is None
+
+    def test_wrong_audience_rejected(self, keypair, validator):
+        assert validator.validate(mint(keypair, aud="other")) is None
+
+    def test_audience_list_accepted(self, keypair, validator):
+        p = validator.validate(mint(keypair, aud=["other", "omnia"]))
+        assert p is not None
+
+    def test_unknown_kid_rejected(self, keypair, validator):
+        assert validator.validate(mint(keypair, kid="k-unknown")) is None
+
+    def test_alg_none_downgrade_rejected(self, keypair, validator):
+        header = _b64url_encode(json.dumps({"alg": "none", "kid": "k1"}).encode())
+        payload = _b64url_encode(
+            json.dumps({"sub": "evil", "iss": "https://idp.test",
+                        "aud": "omnia", "exp": int(time.time()) + 300}).encode()
+        )
+        assert validator.validate(f"{header}.{payload}.") is None
+
+    def test_hs256_confusion_rejected(self, keypair, validator):
+        # Token HMAC-signed with the PUBLIC key bytes, alg=HS256 — the
+        # classic key-confusion attack; must not validate.
+        import hashlib
+        import hmac as hmac_mod
+
+        header = _b64url_encode(json.dumps({"alg": "HS256", "kid": "k1"}).encode())
+        payload = _b64url_encode(
+            json.dumps({"sub": "evil", "iss": "https://idp.test",
+                        "aud": "omnia", "exp": int(time.time()) + 300}).encode()
+        )
+        fake_key = json.dumps(_jwk(keypair)).encode()
+        sig = hmac_mod.new(fake_key, f"{header}.{payload}".encode(), hashlib.sha256).digest()
+        assert validator.validate(f"{header}.{payload}.{_b64url_encode(sig)}") is None
+
+    def test_garbage_rejected(self, validator):
+        assert validator.validate("") is None
+        assert validator.validate("a.b") is None
+        assert validator.validate("not-a-jwt-at-all") is None
+
+    def test_missing_subject_rejected(self, keypair):
+        v = OIDCValidator(StaticJWKS({"keys": [_jwk(keypair)]}))
+        header = mint(keypair)
+        # mint always sets sub; craft one without it
+        tok = mint(keypair, sub="")
+        assert v.validate(tok) is None
+
+
+# ---------------------------------------------------------------------------
+# JWKS over HTTP: discovery, caching, rotation
+# ---------------------------------------------------------------------------
+
+
+class _IdpServer:
+    """Local IdP fixture: serves openid-configuration + a mutable JWKS."""
+
+    def __init__(self):
+        self.jwks = {"keys": []}
+        self.hits = {"jwks": 0, "discovery": 0}
+        idp = self
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            def do_GET(self):
+                if self.path == "/.well-known/openid-configuration":
+                    idp.hits["discovery"] += 1
+                    body = json.dumps(
+                        {"issuer": idp.issuer, "jwks_uri": idp.issuer + "/jwks"}
+                    ).encode()
+                elif self.path == "/jwks":
+                    idp.hits["jwks"] += 1
+                    body = json.dumps(idp.jwks).encode()
+                else:
+                    self.send_response(404)
+                    self.end_headers()
+                    return
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *a):
+                pass
+
+        self.httpd = http.server.ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self.issuer = f"http://127.0.0.1:{self.httpd.server_address[1]}"
+        threading.Thread(target=self.httpd.serve_forever, daemon=True).start()
+
+    def stop(self):
+        self.httpd.shutdown()
+        self.httpd.server_close()
+
+
+@pytest.fixture()
+def idp():
+    s = _IdpServer()
+    yield s
+    s.stop()
+
+
+class TestJWKSOverHTTP:
+    def test_discovery_and_validation(self, keypair, idp):
+        idp.jwks = {"keys": [_jwk(keypair)]}
+        uri = discover_jwks_uri(idp.issuer)
+        v = OIDCValidator(HTTPJWKS(uri), issuer="https://idp.test", audience="omnia")
+        assert v.validate(mint(keypair)) is not None
+        assert idp.hits["discovery"] == 1
+
+    def test_cache_avoids_refetch(self, keypair, idp):
+        idp.jwks = {"keys": [_jwk(keypair)]}
+        v = OIDCValidator(HTTPJWKS(idp.issuer + "/jwks"), issuer="https://idp.test",
+                          audience="omnia")
+        for _ in range(5):
+            assert v.validate(mint(keypair)) is not None
+        assert idp.hits["jwks"] == 1
+
+    def test_rotation_refetches_on_unknown_kid(self, keypair, idp):
+        idp.jwks = {"keys": [_jwk(keypair, kid="old")]}
+        jwks = HTTPJWKS(idp.issuer + "/jwks", min_refresh_s=0.0)
+        v = OIDCValidator(jwks, issuer="https://idp.test", audience="omnia")
+        assert v.validate(mint(keypair, kid="old")) is not None
+        # IdP rotates: new kid published
+        new_priv = rsa.generate_private_key(public_exponent=65537, key_size=2048)
+        idp.jwks = {"keys": [_jwk(new_priv, kid="new")]}
+        assert v.validate(mint(new_priv, kid="new")) is not None
+        assert idp.hits["jwks"] == 2
+
+    def test_idp_down_denies_not_crashes(self, keypair, idp):
+        url = idp.issuer + "/jwks"
+        idp.stop()
+        v = OIDCValidator(HTTPJWKS(url), issuer="https://idp.test", audience="omnia")
+        assert v.validate(mint(keypair)) is None
+
+
+# ---------------------------------------------------------------------------
+# edge trust
+# ---------------------------------------------------------------------------
+
+
+class TestEdgeTrust:
+    def test_trusts_identity_only_with_edge_secret(self):
+        v = EdgeTrustValidator("edge-s3cret")
+        headers = {"X-Forwarded-User": "alice", "X-Edge-Auth": "edge-s3cret"}
+        p = v.validate_request("", headers)
+        assert p is not None and p.subject == "alice" and p.method == "edge_trust"
+
+    def test_no_secret_no_trust(self):
+        v = EdgeTrustValidator("edge-s3cret")
+        assert v.validate_request("", {"X-Forwarded-User": "mallory"}) is None
+        assert v.validate_request("", {"X-Forwarded-User": "m",
+                                       "X-Edge-Auth": "wrong"}) is None
+        assert v.validate_request("", None) is None
+        assert v.validate("") is None
+
+    def test_secret_without_identity_denied(self):
+        v = EdgeTrustValidator("edge-s3cret")
+        assert v.validate_request("", {"X-Edge-Auth": "edge-s3cret"}) is None
+
+    def test_chain_integration(self, keypair):
+        chain = AuthChain([
+            OIDCValidator(StaticJWKS({"keys": [_jwk(keypair)]}),
+                          issuer="https://idp.test", audience="omnia"),
+            EdgeTrustValidator("edge-s3cret"),
+        ])
+        # OIDC path
+        p = chain.authenticate(mint(keypair), headers={})
+        assert p is not None and p.method == "oidc"
+        # edge path
+        p = chain.authenticate(
+            "", headers={"x-forwarded-user": "bob", "x-edge-auth": "edge-s3cret"}
+        )
+        assert p is not None and p.subject == "bob"
+        # neither
+        assert chain.authenticate("", headers={}) is None
+
+
+# ---------------------------------------------------------------------------
+# through the real facade WS handshake
+# ---------------------------------------------------------------------------
+
+
+class TestFacadeIntegration:
+    @pytest.fixture()
+    def facade(self, keypair):
+        from websockets.sync.client import connect  # noqa: F401 (env check)
+
+        from omnia_tpu.engine.mock import MockEngine, Scenario
+        from omnia_tpu.facade.server import FacadeServer
+        from omnia_tpu.runtime.packs import load_pack
+        from omnia_tpu.runtime.providers import ProviderRegistry, ProviderSpec
+        from omnia_tpu.runtime.server import RuntimeServer
+
+        pack = {
+            "name": "oidc-agent", "version": "1.0.0",
+            "prompts": {"system": "sys", "greeting": "hi"},
+            "sampling": {"temperature": 0.0, "max_tokens": 32},
+        }
+        reg = ProviderRegistry()
+        reg.register(ProviderSpec(name="m", type="mock", options={
+            "scenarios": [{"pattern": ".", "reply": "ok"}]}))
+        rt = RuntimeServer(pack=load_pack(pack), providers=reg, provider_name="m")
+        rt_port = rt.serve("localhost:0")
+        chain = AuthChain([
+            OIDCValidator(StaticJWKS({"keys": [_jwk(keypair)]}),
+                          issuer="https://idp.test", audience="omnia"),
+            EdgeTrustValidator("edge-s3cret"),
+        ])
+        f = FacadeServer(
+            runtime_target=f"localhost:{rt_port}", agent_name="oidc-agent",
+            auth_chain=chain,
+        )
+        port = f.serve()
+        yield port
+        f.shutdown()
+        rt.shutdown()
+
+    def test_oidc_bearer_ws_handshake(self, keypair, facade):
+        import json as j
+
+        from websockets.sync.client import connect
+
+        tok = mint(keypair, sub="ws-user")
+        with connect(
+            f"ws://localhost:{facade}/ws",
+            additional_headers={"Authorization": f"Bearer {tok}"},
+        ) as ws:
+            hello = j.loads(ws.recv(timeout=10))
+            assert hello["type"] == "connected"
+
+    def test_bad_token_closes_4401(self, facade):
+        from websockets.sync.client import connect
+        from websockets.exceptions import ConnectionClosed
+
+        with pytest.raises(Exception) as ei:
+            with connect(
+                f"ws://localhost:{facade}/ws",
+                additional_headers={"Authorization": "Bearer nope"},
+            ) as ws:
+                ws.recv(timeout=10)
+        assert "4401" in str(ei.value) or isinstance(ei.value, ConnectionClosed)
+
+    def test_edge_headers_ws_handshake(self, facade):
+        import json as j
+
+        from websockets.sync.client import connect
+
+        with connect(
+            f"ws://localhost:{facade}/ws",
+            additional_headers={
+                "X-Forwarded-User": "edge-user",
+                "X-Edge-Auth": "edge-s3cret",
+            },
+        ) as ws:
+            hello = j.loads(ws.recv(timeout=10))
+            assert hello["type"] == "connected"

@@ -1,0 +1,248 @@
+"""Realtime park/resume + route table tests (reference
+internal/facade/realtime_registry.go:27-118, route_store.go /
+route_store_redis.go parity): a WS blip mid-duplex parks the live call;
+reconnecting with the same session resumes it with nothing lost."""
+
+import json
+import threading
+import time
+
+import pytest
+from websockets.sync.client import connect
+
+from omnia_tpu.facade.realtime import (
+    InMemoryRouteStore,
+    RealtimeRegistry,
+    RedisRouteStore,
+)
+from omnia_tpu.facade.server import FacadeServer
+from omnia_tpu.redis import RedisClient, RedisServer
+from omnia_tpu.runtime.duplex import MockStt, MockTts, SpeechSupport
+from omnia_tpu.runtime.packs import load_pack
+from omnia_tpu.runtime.providers import ProviderRegistry, ProviderSpec
+from omnia_tpu.runtime.server import RuntimeServer
+
+PACK = {
+    "name": "voice", "version": "1.0.0",
+    "prompts": {"system": "You speak."}, "sampling": {"max_tokens": 256},
+}
+SCENARIOS = [
+    {"pattern": "how do refunds work", "reply": "refunds take thirty days"},
+    {"pattern": "story", "reply": "o n c e  u p o n  a  t i m e " * 4,
+     "delay_per_token_s": 0.01},
+    {"pattern": ".", "reply": "I heard you"},
+]
+
+
+@pytest.fixture()
+def stack():
+    reg = ProviderRegistry()
+    reg.register(ProviderSpec(name="m", type="mock", options={"scenarios": SCENARIOS}))
+    rt = RuntimeServer(
+        pack=load_pack(PACK), providers=reg, provider_name="m",
+        speech=SpeechSupport(MockStt(), MockTts()),
+    )
+    rport = rt.serve("localhost:0")
+    registry = RealtimeRegistry(park_ttl_s=10.0)
+    routes = InMemoryRouteStore()
+    facade = FacadeServer(
+        runtime_target=f"localhost:{rport}", agent_name="voice-agent",
+        realtime=registry, route_store=routes, advertise_address="pod-1:443",
+    )
+    fport = facade.serve()
+    yield facade, fport, registry, routes
+    registry.shutdown()
+    facade.shutdown()
+    rt.shutdown()
+
+
+def _drain_call(ws, want_text: str, deadline_s: float = 30.0):
+    """Collect binary audio + transcripts until `done`."""
+    audio = bytearray()
+    transcripts = []
+    deadline = time.monotonic() + deadline_s
+    while time.monotonic() < deadline:
+        frame = ws.recv(timeout=deadline - time.monotonic())
+        if isinstance(frame, bytes):
+            audio.extend(frame)
+            continue
+        doc = json.loads(frame)
+        if doc["type"] == "transcript":
+            transcripts.append((doc["role"], doc["text"]))
+        elif doc["type"] == "done":
+            break
+    return bytes(audio), transcripts
+
+
+class TestParkResume:
+    def test_ws_blip_parks_then_resume_preserves_call(self, stack):
+        facade, fport, registry, routes = stack
+        url = f"ws://localhost:{fport}/ws?session=call-1&user=alice"
+
+        # Start the call, provoke a long reply, kill the socket mid-stream.
+        ws = connect(url)
+        connected = json.loads(ws.recv(timeout=10))
+        session_id = connected["session_id"]
+        ws.send(json.dumps({"type": "duplex_start", "format": {"encoding": "pcm16"}}))
+        assert json.loads(ws.recv(timeout=10))["type"] == "duplex_ready"
+        ws.send(b"story")
+        ws.send(b"")
+        # Read a couple of frames to know the reply is flowing, then blip.
+        got_first = ws.recv(timeout=15)
+        ws.socket.close()  # abrupt — no close handshake, no hangup
+
+        deadline = time.monotonic() + 5
+        while registry.parked_count() == 0 and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert registry.parked_count() == 1
+        assert routes.get(session_id) == "pod-1:443"
+
+        # Reconnect with the same session: the call resumes; buffered
+        # audio generated during the blip is replayed.
+        time.sleep(0.3)  # let some output accumulate while parked
+        ws2 = connect(url)
+        connected2 = json.loads(ws2.recv(timeout=10))
+        assert connected2["resumed"] is True
+        assert connected2.get("mode") == "duplex"
+        audio, transcripts = _drain_call(ws2, "once")
+        full = (got_first if isinstance(got_first, bytes) else b"") + audio
+        assert b"o n c e" in full or b"u p o n" in full
+        assert registry.parked_count() == 0
+        # Second utterance on the resumed call proves the stream is live.
+        ws2.send(b"how do refunds work")
+        ws2.send(b"")
+        audio2, tr2 = _drain_call(ws2, "refunds")
+        assert b"refunds take thirty days" in audio2
+        ws2.send(json.dumps({"type": "hangup"}))
+        ws2.close()
+
+    def test_transcripts_recorded_through_blip(self, stack):
+        facade, fport, registry, routes = stack
+        url = f"ws://localhost:{fport}/ws?session=call-rec&user=alice"
+        ws = connect(url)
+        sid = json.loads(ws.recv(timeout=10))["session_id"]
+        ws.send(json.dumps({"type": "duplex_start", "format": {}}))
+        assert json.loads(ws.recv(timeout=10))["type"] == "duplex_ready"
+        ws.send(b"story")
+        ws.send(b"")
+        ws.recv(timeout=15)  # first frame flowing
+        ws.socket.close()
+        deadline = time.monotonic() + 5
+        while registry.parked_count() == 0 and time.monotonic() < deadline:
+            time.sleep(0.05)
+        # The turn completes while nobody is attached; its frames buffer
+        # and its transcripts are recorded at emit time. Attach a fake
+        # sink and the whole parked backlog (incl. done) replays.
+        parked = registry.take(sid, "alice")
+        assert parked is not None
+        deadline = time.monotonic() + 15
+        while time.monotonic() < deadline and not any(
+            m.type == "done" for m in list(parked._buffer)
+        ):
+            time.sleep(0.1)
+
+        class FakeWS:
+            frames = []
+
+            def send(self, data):
+                FakeWS.frames.append(data)
+
+        replayed = parked.attach(FakeWS())
+        assert replayed > 0
+        jsons = [json.loads(f) for f in FakeWS.frames if isinstance(f, str)]
+        assert any(d["type"] == "done" for d in jsons)
+        assert any(
+            d["type"] == "transcript" and d["role"] == "assistant" for d in jsons
+        )
+        parked.close()
+
+    def test_hangup_is_not_parked(self, stack):
+        facade, fport, registry, routes = stack
+        url = f"ws://localhost:{fport}/ws?session=call-2&user=bob"
+        with connect(url) as ws:
+            sid = json.loads(ws.recv(timeout=10))["session_id"]
+            ws.send(json.dumps({"type": "duplex_start", "format": {}}))
+            assert json.loads(ws.recv(timeout=10))["type"] == "duplex_ready"
+            ws.send(b"how do refunds work")
+            ws.send(b"")
+            _drain_call(ws, "refunds")
+            ws.send(json.dumps({"type": "hangup"}))
+        time.sleep(0.3)
+        assert registry.parked_count() == 0
+        assert routes.get(sid) is None
+
+    def test_other_user_cannot_take_parked_call(self, stack):
+        facade, fport, registry, routes = stack
+        ws = connect(f"ws://localhost:{fport}/ws?session=call-3&user=alice")
+        sid = json.loads(ws.recv(timeout=10))["session_id"]
+        ws.send(json.dumps({"type": "duplex_start", "format": {}}))
+        assert json.loads(ws.recv(timeout=10))["type"] == "duplex_ready"
+        ws.send(b"story")
+        ws.send(b"")
+        ws.recv(timeout=15)
+        ws.socket.close()
+        deadline = time.monotonic() + 5
+        while registry.parked_count() == 0 and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert registry.take(sid, "mallory") is None
+        assert registry.parked_count() == 1  # still parked for alice
+        took = registry.take(sid, "alice")
+        assert took is not None
+        took.close()
+
+
+class TestRegistry:
+    def test_reaper_expires_unclaimed(self):
+        registry = RealtimeRegistry(park_ttl_s=0.2)
+
+        class FakeStream:
+            closed = False
+
+            def __iter__(self):
+                return iter(())
+
+            def close(self):
+                FakeStream.closed = True
+
+        from omnia_tpu.facade.realtime import DuplexSession
+
+        s = DuplexSession(FakeStream(), "sid-x", "u", forward=lambda ws, m: None)
+        registry.park(s)
+        deadline = time.monotonic() + 5
+        while registry.parked_count() and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert registry.parked_count() == 0
+        assert FakeStream.closed
+        registry.shutdown()
+
+
+@pytest.fixture(params=["memory", "redis"])
+def route_store(request):
+    if request.param == "memory":
+        yield InMemoryRouteStore()
+    else:
+        srv = RedisServer().start()
+        c = RedisClient(*srv.address)
+        yield RedisRouteStore(c)
+        c.close()
+        srv.stop()
+
+
+class TestRouteStoreConformance:
+    def test_put_get_delete(self, route_store):
+        route_store.put("s1", "10.0.0.5:8443")
+        assert route_store.get("s1") == "10.0.0.5:8443"
+        route_store.put("s1", "10.0.0.6:8443")  # move
+        assert route_store.get("s1") == "10.0.0.6:8443"
+        route_store.delete("s1")
+        assert route_store.get("s1") is None
+
+    def test_ttl_expires(self, route_store):
+        route_store.put("s2", "pod:1", ttl_s=0.05)
+        assert route_store.get("s2") == "pod:1"
+        time.sleep(0.12)
+        assert route_store.get("s2") is None
+
+    def test_missing_is_none(self, route_store):
+        assert route_store.get("never") is None
+        route_store.delete("never")  # no raise
