@@ -1,0 +1,370 @@
+"""Entry-point binaries + the env-var configuration tier.
+
+Reference parity: the cmd/ binaries (operator, agent/facade, runtime,
+session-api, memory-api, compaction, doctor, runtime-conformance —
+SURVEY.md §2.3) and the `OMNIA_*` env projection stamped onto pods by
+the deployment builder (reference internal/runtime/config.go:185-208).
+Each main assembles its service purely from env + mounted files, which
+is exactly what the Dockerfiles' ENTRYPOINTs and the operator's env
+injection rely on.
+
+Config tiers (reference §5.6): CRDs (user intent) → install values
+(chart) → THESE env vars (pod projection) → mounted files (pack JSON,
+tool configs).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import signal
+import sys
+import threading
+from typing import Optional
+
+logging.basicConfig(
+    level=os.environ.get("OMNIA_LOG_LEVEL", "INFO"),
+    format="%(asctime)s %(levelname)s %(name)s %(message)s",
+)
+logger = logging.getLogger("omnia.cli")
+
+
+def _env(name: str, default: Optional[str] = None) -> Optional[str]:
+    return os.environ.get(name, default)
+
+
+def _require(name: str) -> str:
+    v = os.environ.get(name)
+    if not v:
+        print(f"missing required env {name}", file=sys.stderr)
+        raise SystemExit(2)
+    return v
+
+
+def _redis_client():
+    addr = _env("OMNIA_REDIS_ADDR")
+    if not addr:
+        return None
+    from omnia_tpu.redis import RedisClient
+
+    host, _, port = addr.rpartition(":")
+    return RedisClient(host or "127.0.0.1", int(port),
+                       password=_env("OMNIA_REDIS_PASSWORD"))
+
+
+def _wait_forever() -> None:
+    stop = threading.Event()
+
+    def _sig(*_a):
+        stop.set()
+
+    signal.signal(signal.SIGTERM, _sig)
+    signal.signal(signal.SIGINT, _sig)
+    stop.wait()
+
+
+# ---------------------------------------------------------------------------
+# runtime
+# ---------------------------------------------------------------------------
+
+
+def runtime_main() -> int:
+    """OMNIA_PACK_PATH (compiled pack JSON, mounted), OMNIA_PROVIDERS_PATH
+    (provider spec list JSON), OMNIA_PROVIDER (default provider name),
+    OMNIA_TOOLS_PATH (optional tool handlers), OMNIA_GRPC_PORT,
+    OMNIA_REDIS_ADDR (context store; in-memory without it)."""
+    from omnia_tpu.runtime.packs import load_pack
+    from omnia_tpu.runtime.providers import ProviderRegistry, ProviderSpec
+    from omnia_tpu.runtime.server import RuntimeServer
+    from omnia_tpu.tools.executor import ToolExecutor, ToolHandler
+
+    with open(_require("OMNIA_PACK_PATH")) as f:
+        pack = load_pack(json.load(f))
+    registry = ProviderRegistry()
+    with open(_require("OMNIA_PROVIDERS_PATH")) as f:
+        specs = json.load(f)
+    for spec in specs:
+        registry.register(ProviderSpec(**spec))
+    provider_name = _env("OMNIA_PROVIDER") or specs[0]["name"]
+
+    store = None
+    rc = _redis_client()
+    if rc is not None:
+        from omnia_tpu.runtime.context_store import RedisContextStore
+
+        store = RedisContextStore(
+            rc, ttl_s=float(_env("OMNIA_CONTEXT_TTL_S", "3600")))
+
+    executor = None
+    tools_path = _env("OMNIA_TOOLS_PATH")
+    if tools_path:
+        with open(tools_path) as f:
+            executor = ToolExecutor(
+                [ToolHandler(**h) for h in json.load(f)]
+            )
+
+    server = RuntimeServer(
+        pack=pack, providers=registry, provider_name=provider_name,
+        context_store=store, tool_executor=executor,
+    )
+    port = server.serve(f"0.0.0.0:{_env('OMNIA_GRPC_PORT', '9000')}")
+    logger.info("runtime serving gRPC on :%d", port)
+    _wait_forever()
+    server.shutdown()
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# facade
+# ---------------------------------------------------------------------------
+
+
+def _auth_chain_from_env():
+    from omnia_tpu.facade.auth import (
+        AuthChain,
+        ClientKeyValidator,
+        HmacValidator,
+        SharedTokenValidator,
+    )
+
+    validators = []
+    keys_path = _env("OMNIA_CLIENT_KEYS_PATH")
+    if keys_path:
+        with open(keys_path) as f:
+            validators.append(ClientKeyValidator(json.load(f)))
+    shared = _env("OMNIA_SHARED_TOKEN")
+    if shared:
+        validators.append(SharedTokenValidator(shared))
+    mgmt = _env("OMNIA_MGMT_SECRET")
+    if mgmt:
+        validators.append(HmacValidator(mgmt.encode()))
+    issuer = _env("OMNIA_OIDC_ISSUER")
+    if issuer:
+        from omnia_tpu.facade.oidc import OIDCValidator
+
+        validators.append(OIDCValidator.from_issuer(
+            issuer, audience=_env("OMNIA_OIDC_AUDIENCE", "")))
+    edge = _env("OMNIA_EDGE_SECRET")
+    if edge:
+        from omnia_tpu.facade.oidc import EdgeTrustValidator
+
+        validators.append(EdgeTrustValidator(edge))
+    return AuthChain(validators) if validators else None
+
+
+def facade_main() -> int:
+    """OMNIA_RUNTIME_TARGET (host:port), OMNIA_WS_PORT, OMNIA_HEALTH_PORT,
+    OMNIA_SESSION_API_URL (recording sink), auth env (see
+    _auth_chain_from_env), OMNIA_REDIS_ADDR (route table),
+    OMNIA_ADVERTISE (this pod's address for the route table)."""
+    from omnia_tpu.facade.realtime import RealtimeRegistry, RedisRouteStore
+    from omnia_tpu.facade.recording import RecordingInterceptor
+    from omnia_tpu.facade.server import FacadeServer
+
+    rc = _redis_client()
+    server = FacadeServer(
+        runtime_target=_require("OMNIA_RUNTIME_TARGET"),
+        agent_name=_env("OMNIA_AGENT", "agent"),
+        auth_chain=_auth_chain_from_env(),
+        recording=RecordingInterceptor(_env("OMNIA_SESSION_API_URL")),
+        realtime=RealtimeRegistry(
+            park_ttl_s=float(_env("OMNIA_PARK_TTL_S", "60"))),
+        route_store=RedisRouteStore(rc) if rc is not None else None,
+        advertise_address=_env("OMNIA_ADVERTISE", ""),
+    )
+    port = server.serve(
+        host="0.0.0.0",
+        port=int(_env("OMNIA_WS_PORT", "8080")),
+        health_port=int(_env("OMNIA_HEALTH_PORT", "8081")),
+    )
+    logger.info("facade serving ws on :%d", port)
+
+    def _drain(*_a):
+        server.drain()
+        server.shutdown()
+        sys.exit(0)
+
+    signal.signal(signal.SIGTERM, _drain)
+    _wait_forever()
+    server.shutdown()
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# session-api / memory-api
+# ---------------------------------------------------------------------------
+
+
+def session_api_main() -> int:
+    """OMNIA_HTTP_PORT, OMNIA_REDIS_ADDR (hot tier + event stream),
+    OMNIA_WARM_DB (sqlite path), OMNIA_COLD_DIR (parquet archive)."""
+    from omnia_tpu.session.api import SessionAPI
+    from omnia_tpu.session.tiers import TieredStore
+    from omnia_tpu.streams import Stream
+
+    rc = _redis_client()
+    hot = None
+    events = None
+    if rc is not None:
+        from omnia_tpu.session.redis_hot import RedisHotStore
+        from omnia_tpu.streams.redis_stream import RedisStream
+
+        hot = RedisHotStore(rc, ttl_s=float(_env("OMNIA_HOT_TTL_S", "3600")))
+        events = RedisStream(rc.clone(), "session-events")
+    kw = {}
+    if _env("OMNIA_WARM_DB"):
+        from omnia_tpu.session.warm import WarmStore
+
+        kw["warm"] = WarmStore(_env("OMNIA_WARM_DB"))
+    if _env("OMNIA_COLD_DIR"):
+        from omnia_tpu.session.cold import ColdArchive, LocalBlobStore
+
+        kw["cold"] = ColdArchive(LocalBlobStore(_env("OMNIA_COLD_DIR")))
+    store = TieredStore(hot=hot, **kw) if (hot or kw) else TieredStore()
+    api = SessionAPI(store=store, events=events or Stream())
+    port = api.serve(host="0.0.0.0", port=int(_env("OMNIA_HTTP_PORT", "8300")))
+    logger.info("session-api on :%d", port)
+    _wait_forever()
+    api.shutdown()
+    return 0
+
+
+def memory_api_main() -> int:
+    """OMNIA_HTTP_PORT, OMNIA_MEMORY_DB (sqlite path), OMNIA_EMBED_TARGET
+    (runtime gRPC with an embedding-role provider)."""
+    from omnia_tpu.memory.api import MemoryAPI
+    from omnia_tpu.memory.store import MemoryStore
+
+    store = (
+        MemoryStore(_env("OMNIA_MEMORY_DB"))
+        if _env("OMNIA_MEMORY_DB")
+        else MemoryStore()
+    )
+    embedder = None
+    if _env("OMNIA_EMBED_DIM"):
+        from omnia_tpu.memory.embedding import HashingEmbedder
+
+        embedder = HashingEmbedder(dim=int(_env("OMNIA_EMBED_DIM")))
+    api = MemoryAPI(store=store, embedder=embedder)
+    port = api.serve(host="0.0.0.0", port=int(_env("OMNIA_HTTP_PORT", "8400")))
+    logger.info("memory-api on :%d", port)
+    _wait_forever()
+    api.shutdown()
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# operator / compaction / doctor / conformance
+# ---------------------------------------------------------------------------
+
+
+def operator_main() -> int:
+    """OMNIA_CONFIG_DIR (manifest devroot, watched — the reference's
+    file-backed clusterless mode), OMNIA_HTTP_PORT (operator REST +
+    dashboard), OMNIA_SESSION_API_URL."""
+    from omnia_tpu.operator.controller import ControllerManager as Controller
+    from omnia_tpu.operator.store import ResourceStore
+
+    store = ResourceStore()
+    config_dir = _env("OMNIA_CONFIG_DIR")
+    if config_dir:
+        _load_config_dir(store, config_dir)
+    controller = Controller(store, session_api_url=_env("OMNIA_SESSION_API_URL"))
+    t = threading.Thread(
+        target=controller.run,
+        kwargs={"resync_s": float(_env("OMNIA_RESYNC_S", "5"))},
+        daemon=True,
+    )
+    t.start()
+    dash = None
+    if _env("OMNIA_DASHBOARD", "1") == "1":
+        from omnia_tpu.dashboard import DashboardServer
+
+        dash = DashboardServer(
+            store, session_api_url=_env("OMNIA_SESSION_API_URL"))
+        dash.serve(host="0.0.0.0", port=int(_env("OMNIA_HTTP_PORT", "8090")))
+    logger.info("operator reconciling (%d resources)", len(store.list()))
+    _wait_forever()
+    if dash is not None:
+        dash.shutdown()
+    return 0
+
+
+def _load_config_dir(store, config_dir: str) -> None:
+    import yaml
+
+    from omnia_tpu.operator.resources import Resource
+
+    for root, _dirs, files in os.walk(config_dir):
+        for fn in sorted(files):
+            if not fn.endswith((".yaml", ".yml", ".json")):
+                continue
+            path = os.path.join(root, fn)
+            with open(path) as f:
+                docs = (
+                    [json.load(f)] if fn.endswith(".json")
+                    else list(yaml.safe_load_all(f))
+                )
+            for doc in docs:
+                if doc:
+                    store.apply(Resource.from_manifest(doc))
+
+
+def compaction_main() -> int:
+    """One compaction pass (CronJob binary): OMNIA_REDIS_ADDR +
+    OMNIA_WARM_DB + OMNIA_COLD_DIR select the tiers."""
+    from omnia_tpu.session.compaction import CompactionEngine
+    from omnia_tpu.session.tiers import TieredStore
+
+    rc = _redis_client()
+    kw = {}
+    if rc is not None:
+        from omnia_tpu.session.redis_hot import RedisHotStore
+
+        kw["hot"] = RedisHotStore(rc)
+    if _env("OMNIA_WARM_DB"):
+        from omnia_tpu.session.warm import WarmStore
+
+        kw["warm"] = WarmStore(_env("OMNIA_WARM_DB"))
+    if _env("OMNIA_COLD_DIR"):
+        from omnia_tpu.session.cold import ColdArchive, LocalBlobStore
+
+        kw["cold"] = ColdArchive(LocalBlobStore(_env("OMNIA_COLD_DIR")))
+    store = TieredStore(**kw)
+    engine = CompactionEngine(store)
+    report = engine.run_once()
+    print(json.dumps(report.__dict__))
+    return 0
+
+
+def doctor_main() -> int:
+    from omnia_tpu.doctor import Doctor
+
+    doc = Doctor()
+    if _env("OMNIA_RUNTIME_TARGET"):
+        doc.add_runtime_check(_env("OMNIA_RUNTIME_TARGET"))
+    if _env("OMNIA_SESSION_API_URL"):
+        doc.add_http_check(
+            "session-api", _env("OMNIA_SESSION_API_URL") + "/healthz")
+    if _env("OMNIA_MEMORY_API_URL"):
+        doc.add_http_check(
+            "memory-api", _env("OMNIA_MEMORY_API_URL") + "/healthz")
+    if _env("OMNIA_FACADE_WS_URL"):
+        doc.add_facade_ws_check(_env("OMNIA_FACADE_WS_URL"))
+    report = doc.run()
+    print(json.dumps(report, indent=2))
+    return 0 if report.get("status") == "pass" else 1
+
+
+def conformance_main() -> int:
+    from omnia_tpu.runtime.conformance import main as conf_main
+
+    return conf_main()
+
+
+def redisd_main() -> int:
+    from omnia_tpu.redis.server import main as redis_main
+
+    redis_main()
+    return 0

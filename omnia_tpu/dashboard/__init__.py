@@ -1,0 +1,3 @@
+from omnia_tpu.dashboard.server import DashboardServer
+
+__all__ = ["DashboardServer"]

@@ -1,0 +1,161 @@
+"""Operator-hosted web console.
+
+Reference parity target: dashboard/ (~239k LoC Next.js) + its WS proxy
+(dashboard/server.js). V1 scope per the platform's actual operator
+surface: agent list with live status, a chat console speaking the real
+WS protocol straight to an agent facade, a session browser over
+session-api, and eval results — one static page served by the operator
+process (no node toolchain in a TPU serving image; the reference runs a
+separate Next server, here the console IS an operator endpoint).
+
+APIs (JSON): /api/agents (resource store + reconciler status),
+/api/resources?kind= (topology), /api/sessions[?workspace=],
+/api/sessions/<id>/messages|tool-calls|eval-results (session-api
+proxy — the browser never needs CORS to session-api), /api/usage.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import threading
+import urllib.parse
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+logger = logging.getLogger(__name__)
+
+_STATIC_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)), "static")
+
+
+class DashboardServer:
+    def __init__(
+        self,
+        store,
+        session_api_url: Optional[str] = None,
+    ) -> None:
+        self.store = store
+        self.session_api_url = (session_api_url or "").rstrip("/")
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self.port: Optional[int] = None
+
+    # -- data assembly -------------------------------------------------
+
+    def agents(self) -> list[dict]:
+        out = []
+        for res in self.store.list(kind="AgentRuntime"):
+            spec = res.spec
+            out.append({
+                "name": res.name,
+                "namespace": res.namespace,
+                "mode": spec.get("mode", "agent"),
+                "providers": [
+                    (p.get("providerRef") or {}).get("name", "")
+                    if isinstance(p.get("providerRef"), dict)
+                    else str(p.get("providerRef", ""))
+                    for p in spec.get("providers", [])
+                ],
+                "phase": res.status.get("phase", "Unknown"),
+                "replicas": res.status.get("replicas", 0),
+                "endpoints": res.status.get("endpoints", []),
+                "configHash": res.status.get("configHash", ""),
+            })
+        return out
+
+    def resources(self, kind: Optional[str] = None) -> list[dict]:
+        return [r.to_manifest() for r in self.store.list(kind=kind)]
+
+    def _proxy_session_api(self, path: str, query: str):
+        if not self.session_api_url:
+            return 503, {"error": "session-api not configured"}
+        url = f"{self.session_api_url}{path}"
+        if query:
+            url += f"?{query}"
+        try:
+            with urllib.request.urlopen(url, timeout=10) as resp:
+                return resp.status, json.loads(resp.read())
+        except urllib.error.HTTPError as e:
+            try:
+                return e.code, json.loads(e.read())
+            except Exception:
+                return e.code, {"error": str(e)}
+        except (urllib.error.URLError, OSError) as e:
+            return 502, {"error": f"session-api unreachable: {e}"}
+
+    # -- request handling ---------------------------------------------
+
+    def handle(self, method: str, path: str, query: str = ""):
+        """Returns (status, content_type, body_bytes)."""
+        if method != "GET":
+            return 405, "application/json", b'{"error": "GET only"}'
+        if path in ("/", "/index.html"):
+            try:
+                with open(os.path.join(_STATIC_DIR, "index.html"), "rb") as f:
+                    return 200, "text/html; charset=utf-8", f.read()
+            except OSError:
+                return 500, "application/json", b'{"error": "asset missing"}'
+        if path == "/healthz":
+            return 200, "application/json", b'{"status": "ok"}'
+        if path == "/api/agents":
+            return self._json(200, {"agents": self.agents()})
+        if path == "/api/resources":
+            q = urllib.parse.parse_qs(query)
+            kind = (q.get("kind") or [None])[0]
+            return self._json(200, {"resources": self.resources(kind)})
+        if path == "/api/usage":
+            status, doc = self._proxy_session_api("/api/v1/usage", query)
+            return self._json(status, doc)
+        if path == "/api/sessions":
+            status, doc = self._proxy_session_api("/api/v1/sessions", query)
+            return self._json(status, doc)
+        if path.startswith("/api/sessions/"):
+            rest = path[len("/api/sessions/"):]
+            parts = rest.split("/", 1)
+            sid = urllib.parse.quote(parts[0], safe="")
+            sub = f"/{parts[1]}" if len(parts) > 1 else ""
+            status, doc = self._proxy_session_api(
+                f"/api/v1/sessions/{sid}{sub}", query
+            )
+            return self._json(status, doc)
+        return 404, "application/json", b'{"error": "not found"}'
+
+    @staticmethod
+    def _json(status: int, doc: dict):
+        return status, "application/json", json.dumps(doc).encode()
+
+    # -- lifecycle -----------------------------------------------------
+
+    def serve(self, host: str = "localhost", port: int = 0) -> int:
+        dash = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_GET(self):
+                split = urllib.parse.urlsplit(self.path)
+                status, ctype, body = dash.handle("GET", split.path, split.query)
+                self.send_response(status)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                # The chat console opens WS connections to agent facades
+                # on other ports.
+                self.send_header("Access-Control-Allow-Origin", "*")
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *a):  # pragma: no cover - quiet
+                pass
+
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self.port = self._httpd.server_address[1]
+        threading.Thread(
+            target=self._httpd.serve_forever, name="omnia-dashboard", daemon=True
+        ).start()
+        logger.info("dashboard on %s:%d", host, self.port)
+        return self.port
+
+    def shutdown(self) -> None:
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None

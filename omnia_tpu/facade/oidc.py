@@ -86,20 +86,23 @@ class HTTPJWKS:
         self._lock = threading.Lock()
 
     def _fetch_locked(self) -> None:
+        # Attempt time is stamped FIRST: a failing IdP (or a stream of
+        # unknown-kid tokens) must not defeat the min_refresh_s rate limit
+        # — otherwise every validate() serializes behind a blocking
+        # network call and hammers the IdP.
+        self._fetched_at = time.monotonic()
         with urllib.request.urlopen(self.url, timeout=self.timeout_s) as r:
             doc = json.loads(r.read())
         self._keys = _parse_jwks(doc)
-        self._fetched_at = time.monotonic()
 
     def key(self, kid: str) -> Optional[_RSAKey]:
         with self._lock:
-            if not self._keys and self._fetched_at == 0.0:
-                try:
-                    self._fetch_locked()
-                except Exception:
-                    return None
+            never_fetched = self._fetched_at == 0.0
             k = self._keys.get(kid)
-            if k is None and time.monotonic() - self._fetched_at >= self.min_refresh_s:
+            if k is None and (
+                never_fetched
+                or time.monotonic() - self._fetched_at >= self.min_refresh_s
+            ):
                 try:
                     self._fetch_locked()
                 except Exception:
@@ -168,7 +171,11 @@ class OIDCValidator:
             if self.audience not in auds:
                 return None
         exp = claims.get("exp")
-        if exp is not None and now > exp + self.leeway_s:
+        if exp is None:
+            # OIDC requires exp; a token without one would be valid
+            # forever — fail closed.
+            return None
+        if now > exp + self.leeway_s:
             return None
         nbf = claims.get("nbf")
         if nbf is not None and now < nbf - self.leeway_s:
@@ -204,15 +211,23 @@ class EdgeTrustValidator:
             return None
         # Iterate items() rather than dict()-ing: websockets' Headers is a
         # multidict whose dict() conversion raises on duplicated header
-        # names (proxies routinely duplicate X-Forwarded-*). First value
-        # wins — the edge's own header precedes any client-smuggled copy.
+        # names. A DUPLICATED identity or secret header is rejected
+        # outright: header-ordering guarantees vary by proxy, so neither
+        # first- nor last-wins is safe against a client smuggling its own
+        # copy — ambiguity fails closed.
+        counts: dict[str, int] = {}
         lowered: dict[str, str] = {}
         try:
             pairs = headers.raw_items()
         except AttributeError:
             pairs = headers.items()
         for k, v in pairs:
-            lowered.setdefault(str(k).lower(), str(v))
+            lk = str(k).lower()
+            counts[lk] = counts.get(lk, 0) + 1
+            lowered.setdefault(lk, str(v))
+        if counts.get(self.identity_header, 0) > 1 or \
+                counts.get(self.secret_header, 0) > 1:
+            return None
         secret = lowered.get(self.secret_header, "")
         if not secret or not hmac.compare_digest(
             hashlib.sha256(secret.encode()).digest(), self._digest

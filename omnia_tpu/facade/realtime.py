@@ -121,12 +121,24 @@ class DuplexSession:
 
     # -- sink management ----------------------------------------------
 
+    def _park_msg_locked(self, rmsg) -> None:
+        if len(self._buffer) == self._buffer.maxlen:
+            self._dropped += 1  # the append below evicts the oldest
+        self._buffer.append(rmsg)
+
     def attach(self, ws) -> int:
         """Point output at a (new) websocket, flushing anything buffered
         while parked. Returns the number of replayed messages, or -1 if
         the socket died mid-flush — the unflushed remainder is re-buffered
         in order and the session stays detached (caller should re-park)."""
         with self._lock:
+            if self._dropped:
+                logger.warning(
+                    "duplex %s: %d message(s) dropped while parked "
+                    "(buffer overflow) — replay has a gap",
+                    self.session_id, self._dropped,
+                )
+                self._dropped = 0
             replay = list(self._buffer)
             self._buffer.clear()
             for i, rmsg in enumerate(replay):
@@ -161,21 +173,30 @@ class DuplexSession:
                 with self._lock:
                     ws = self._ws
                     if ws is None:
-                        self._buffer.append(rmsg)
-                        if len(self._buffer) == self._buffer.maxlen:
-                            self._dropped += 1
+                        self._park_msg_locked(rmsg)
                         continue
                 try:
                     self._forward(ws, rmsg)
                 except Exception:
-                    # WS died mid-forward: park the message and everything
-                    # after it until someone re-attaches. Only clear the
-                    # sink if it is still the socket that failed — attach()
-                    # may have installed a fresh one while we were blocked.
+                    # WS died mid-forward. attach() may have installed a
+                    # FRESH socket while we were blocked in the failed
+                    # send — re-read the sink under the lock and deliver
+                    # there, else the message would sit stranded in the
+                    # buffer of an attached (never-flushing) session.
                     with self._lock:
                         if self._ws is ws:
                             self._ws = None
-                        self._buffer.append(rmsg)
+                        current = self._ws
+                    if current is not None:
+                        try:
+                            self._forward(current, rmsg)
+                            continue
+                        except Exception:
+                            with self._lock:
+                                if self._ws is current:
+                                    self._ws = None
+                    with self._lock:
+                        self._park_msg_locked(rmsg)
         except Exception:
             if not self._closed:
                 logger.exception("duplex output stream failed")

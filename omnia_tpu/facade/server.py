@@ -234,11 +234,15 @@ class FacadeServer:
             )
             if resumed_call is not None:
                 stream = resumed_call.stream
+                try:
+                    caps = self.runtime.health().capabilities
+                except Exception:
+                    caps = []  # resume must not die on a health blip
                 self._send(ws, {
                     "type": "connected",
                     "session_id": session_id,
                     "agent": self.agent_name,
-                    "capabilities": [],
+                    "capabilities": caps,
                     "resumed": True,
                     "mode": "duplex",
                 })

@@ -1,0 +1,269 @@
+"""CustomResourceDefinition manifests for the 9 declarative kinds.
+
+The cluster-facing twin of `operator/resources.py` (reference
+config/crd/bases/*.yaml, generated there by controller-gen from
+api/v1alpha1 types). Here the CRDs are generated from the same enum
+vocabularies the in-process admission validation uses
+(`operator/validation.py`), so the schema the cluster enforces and the
+schema the operator enforces cannot drift apart. `deploy/crds/*.yaml` is
+the committed output; tests assert the files match this generator
+(the controller-gen make-manifests discipline).
+
+Structural-schema rules honored: every object schema either types its
+properties or carries x-kubernetes-preserve-unknown-fields for
+deliberately open maps (pack params, tool args, annotations).
+"""
+
+from __future__ import annotations
+
+from omnia_tpu.operator.resources import (
+    AGENT_MODES,
+    API_VERSION,
+    FACADE_TYPES,
+    PROVIDER_ROLES,
+    PROVIDER_TYPES,
+    TOOL_HANDLER_TYPES,
+)
+
+GROUP = API_VERSION.split("/")[0]
+VERSION = API_VERSION.split("/")[1]
+
+
+def _str(enum=None, **kw):
+    s = {"type": "string", **kw}
+    if enum:
+        s["enum"] = list(enum)
+    return s
+
+
+def _obj(props=None, required=None, open_=False, desc=None):
+    s: dict = {"type": "object"}
+    if props:
+        s["properties"] = props
+    if required:
+        s["required"] = list(required)
+    if open_:
+        s["x-kubernetes-preserve-unknown-fields"] = True
+    if desc:
+        s["description"] = desc
+    return s
+
+
+def _arr(items):
+    return {"type": "array", "items": items}
+
+
+_INT = {"type": "integer"}
+_NUM = {"type": "number"}
+_BOOL = {"type": "boolean"}
+_REF = _obj({"name": _str()}, required=["name"])
+
+
+def _agent_runtime_schema() -> dict:
+    facade = _obj(
+        {
+            "type": _str(enum=FACADE_TYPES),
+            "path": _str(),
+            "auth": _obj(open_=True),
+        },
+        required=["type"],
+    )
+    autoscaling = _obj({
+        "minReplicas": _INT,
+        "maxReplicas": _INT,
+        "scaleToZero": _BOOL,
+        "queueDepthTarget": _INT,
+    })
+    rollout = _obj({
+        "steps": _arr(_obj({"weight": _INT, "pause_s": _NUM})),
+        "analysis": _obj(open_=True),
+        "autoPromote": _BOOL,
+    })
+    return _obj(
+        {
+            "mode": _str(enum=AGENT_MODES),
+            "promptPackRef": _REF,
+            "toolRegistryRef": _REF,
+            "providers": _arr(_obj({
+                "name": _str(),
+                "providerRef": _REF,
+                "role": _str(enum=PROVIDER_ROLES),
+            }, required=["providerRef"])),
+            "facades": _arr(facade),
+            "context": _obj({"ttl_s": _NUM, "store": _str()}),
+            "memoryRef": _REF,
+            "privacyPolicyRef": _REF,
+            "replicas": _INT,
+            "autoscaling": autoscaling,
+            "rollout": rollout,
+            "duplex": _obj({"enabled": _BOOL, "format": _obj(open_=True)}),
+            "evals": _arr(_obj(open_=True)),
+            "externalAuth": _obj(open_=True),
+            "serviceGroup": _str(),
+            "facadeImage": _str(),
+            "runtimeImage": _str(),
+            "tpuChips": _INT,
+            "podOverrides": _obj(open_=True),
+        },
+        required=["promptPackRef", "providers"],
+    )
+
+
+def _provider_schema() -> dict:
+    return _obj(
+        {
+            "type": _str(enum=PROVIDER_TYPES),
+            "role": _str(enum=PROVIDER_ROLES),
+            "model": _str(),
+            "options": _obj(open_=True),
+            "pricing": _obj({
+                "inputPerMTokUSD": _NUM,
+                "outputPerMTokUSD": _NUM,
+            }),
+            "engine": _obj({
+                "numSlots": _INT,
+                "maxSeq": _INT,
+                "dtype": _str(),
+                "dp": _INT,
+                "tp": _INT,
+                "decodeChunk": _INT,
+                "maxSessions": _INT,
+            }),
+        },
+        required=["type"],
+    )
+
+
+def _prompt_pack_schema() -> dict:
+    return _obj(
+        {
+            "content": _obj(open_=True, desc="compiled pack JSON"),
+            "sourceRef": _REF,
+            "version": _str(),
+        },
+        required=["content"],
+    )
+
+
+def _tool_registry_schema() -> dict:
+    return _obj({
+        "tools": _arr(_obj({
+            "name": _str(),
+            "description": _str(),
+            "type": _str(enum=TOOL_HANDLER_TYPES),
+            "endpoint": _str(),
+            "input_schema": _obj(open_=True),
+            "auth": _obj(open_=True),
+            "timeout_s": _NUM,
+        }, required=["name"])),
+    }, required=["tools"])
+
+
+def _workspace_schema() -> dict:
+    return _obj({
+        "environment": _str(),
+        "services": _arr(_obj({
+            "name": _str(),
+            "sessionApi": _BOOL,
+            "memoryApi": _BOOL,
+        }, required=["name"])),
+        "roleBindings": _arr(_obj(open_=True)),
+        "storage": _obj(open_=True),
+    }, required=["environment"])
+
+
+def _agent_policy_schema() -> dict:
+    return _obj({
+        "rules": _arr(_obj({
+            "tools": _arr(_str()),
+            "effect": _str(enum=("allow", "deny")),
+            "when": _str(),
+        }, required=["effect"])),
+    }, required=["rules"])
+
+
+def _memory_policy_schema() -> dict:
+    return _obj({
+        "tiers": _arr(_str()),
+        "ttl_s": _NUM,
+        "halfLife_s": _NUM,
+        "consentCategories": _arr(_str()),
+        "ingestion": _obj(open_=True),
+    })
+
+
+def _session_retention_schema() -> dict:
+    return _obj({
+        "hot_ttl_s": _NUM,
+        "warm_ttl_s": _NUM,
+        "cold_ttl_s": _NUM,
+        "purgeDeleted": _BOOL,
+    })
+
+
+def _skill_source_schema() -> dict:
+    return _obj({
+        "source": _obj({
+            "type": _str(enum=("dir", "configmap", "git", "oci")),
+            "path": _str(),
+            "ref": _str(),
+        }, required=["type"]),
+        "interval_s": _NUM,
+    }, required=["source"])
+
+
+# kind → (plural, schema builder, short names)
+KINDS: dict[str, tuple[str, object, list[str]]] = {
+    "AgentRuntime": ("agentruntimes", _agent_runtime_schema, ["ar"]),
+    "Provider": ("providers", _provider_schema, ["prov"]),
+    "PromptPack": ("promptpacks", _prompt_pack_schema, ["pack"]),
+    "ToolRegistry": ("toolregistries", _tool_registry_schema, ["tools"]),
+    "Workspace": ("workspaces", _workspace_schema, ["ws"]),
+    "AgentPolicy": ("agentpolicies", _agent_policy_schema, []),
+    "MemoryPolicy": ("memorypolicies", _memory_policy_schema, []),
+    "SessionRetentionPolicy": (
+        "sessionretentionpolicies", _session_retention_schema, ["srp"],
+    ),
+    "SkillSource": ("skillsources", _skill_source_schema, []),
+}
+
+
+def render_crd(kind: str) -> dict:
+    plural, schema_fn, short = KINDS[kind]
+    return {
+        "apiVersion": "apiextensions.k8s.io/v1",
+        "kind": "CustomResourceDefinition",
+        "metadata": {"name": f"{plural}.{GROUP}"},
+        "spec": {
+            "group": GROUP,
+            "names": {
+                "kind": kind,
+                "listKind": f"{kind}List",
+                "plural": plural,
+                "singular": kind.lower(),
+                **({"shortNames": short} if short else {}),
+            },
+            "scope": "Namespaced",
+            "versions": [
+                {
+                    "name": VERSION,
+                    "served": True,
+                    "storage": True,
+                    "subresources": {"status": {}},
+                    "schema": {
+                        "openAPIV3Schema": _obj({
+                            "apiVersion": _str(),
+                            "kind": _str(),
+                            "metadata": {"type": "object"},
+                            "spec": schema_fn(),
+                            "status": _obj(open_=True),
+                        }),
+                    },
+                }
+            ],
+        },
+    }
+
+
+def render_crds() -> list[dict]:
+    return [render_crd(kind) for kind in KINDS]

@@ -1,0 +1,218 @@
+"""Installable deployment bundle — the chart.
+
+Reference parity target: charts/omnia (operator + dashboard + redis +
+agents RBAC + observability). Rendered in Python instead of Go
+templates: `render_install(values)` returns the full manifest list and
+`python -m omnia_tpu.operator.install [values.yaml] > install.yaml`
+emits it as multi-doc YAML for `kubectl apply -f -`. Everything rendered
+here must pass `manifest_lint.lint` — the repo's dry-run gate (tests
+enforce it), and deploy/values.yaml documents every knob.
+
+The agent pods themselves are NOT rendered here — the operator builds
+those at runtime from AgentRuntime resources (deployment.K8sManifestBackend),
+exactly like the reference's deployment builder.
+"""
+
+from __future__ import annotations
+
+import sys
+from typing import Optional
+
+from omnia_tpu.operator.crds import GROUP, render_crds
+
+DEFAULT_VALUES: dict = {
+    "namespace": "omnia-system",
+    "images": {
+        "operator": "omnia-tpu/operator:latest",
+        "sessionApi": "omnia-tpu/session-api:latest",
+        "memoryApi": "omnia-tpu/memory-api:latest",
+        "redis": "omnia-tpu/redisd:latest",
+    },
+    "operator": {"replicas": 1, "dashboard": True},
+    "sessionApi": {"replicas": 1},
+    "memoryApi": {"replicas": 1},
+    "redis": {"enabled": True},
+    "serviceAccount": "omnia-operator",
+}
+
+
+def _merge(base: dict, over: Optional[dict]) -> dict:
+    out = dict(base)
+    for k, v in (over or {}).items():
+        if isinstance(v, dict) and isinstance(out.get(k), dict):
+            out[k] = _merge(out[k], v)
+        else:
+            out[k] = v
+    return out
+
+
+def _labels(comp: str) -> dict:
+    return {"app.kubernetes.io/name": "omnia", "app.kubernetes.io/component": comp}
+
+
+def _deployment(ns: str, name: str, comp: str, image: str, replicas: int,
+                ports: list[dict], env: list[dict]) -> dict:
+    labels = _labels(comp)
+    return {
+        "apiVersion": "apps/v1",
+        "kind": "Deployment",
+        "metadata": {"name": name, "namespace": ns, "labels": labels},
+        "spec": {
+            "replicas": replicas,
+            "selector": {"matchLabels": labels},
+            "template": {
+                "metadata": {"labels": labels},
+                "spec": {
+                    "containers": [{
+                        "name": comp,
+                        "image": image,
+                        "ports": ports,
+                        "env": env,
+                    }],
+                },
+            },
+        },
+    }
+
+
+def _service(ns: str, name: str, comp: str, ports: list[dict]) -> dict:
+    return {
+        "apiVersion": "v1",
+        "kind": "Service",
+        "metadata": {"name": name, "namespace": ns, "labels": _labels(comp)},
+        "spec": {"selector": _labels(comp), "ports": ports},
+    }
+
+
+def render_install(values: Optional[dict] = None) -> list[dict]:
+    v = _merge(DEFAULT_VALUES, values)
+    ns = v["namespace"]
+    sa = v["serviceAccount"]
+    out: list[dict] = [
+        {"apiVersion": "v1", "kind": "Namespace", "metadata": {"name": ns}},
+    ]
+    out += render_crds()
+    # RBAC: the operator watches its CRDs cluster-wide and manages agent
+    # Deployments/Services/ConfigMaps in workspace namespaces.
+    out += [
+        {
+            "apiVersion": "v1",
+            "kind": "ServiceAccount",
+            "metadata": {"name": sa, "namespace": ns},
+        },
+        {
+            "apiVersion": "rbac.authorization.k8s.io/v1",
+            "kind": "ClusterRole",
+            "metadata": {"name": "omnia-operator"},
+            "rules": [
+                {"apiGroups": [GROUP],
+                 "resources": ["*"],
+                 "verbs": ["get", "list", "watch", "update", "patch"]},
+                {"apiGroups": [GROUP],
+                 "resources": ["*/status"],
+                 "verbs": ["get", "update", "patch"]},
+                {"apiGroups": ["apps"],
+                 "resources": ["deployments"],
+                 "verbs": ["get", "list", "watch", "create", "update", "patch", "delete"]},
+                {"apiGroups": [""],
+                 "resources": ["services", "configmaps", "secrets"],
+                 "verbs": ["get", "list", "watch", "create", "update", "patch", "delete"]},
+                {"apiGroups": ["autoscaling"],
+                 "resources": ["horizontalpodautoscalers"],
+                 "verbs": ["get", "list", "watch", "create", "update", "patch", "delete"]},
+            ],
+        },
+        {
+            "apiVersion": "rbac.authorization.k8s.io/v1",
+            "kind": "ClusterRoleBinding",
+            "metadata": {"name": "omnia-operator"},
+            "roleRef": {
+                "apiGroup": "rbac.authorization.k8s.io",
+                "kind": "ClusterRole",
+                "name": "omnia-operator",
+            },
+            "subjects": [{"kind": "ServiceAccount", "name": sa, "namespace": ns}],
+        },
+    ]
+    redis_env = []
+    if v["redis"]["enabled"]:
+        out += [
+            _deployment(ns, "omnia-redis", "redis", v["images"]["redis"], 1,
+                        [{"name": "redis", "containerPort": 6379}], []),
+            _service(ns, "omnia-redis", "redis",
+                     [{"name": "redis", "port": 6379}]),
+        ]
+        redis_env = [{"name": "OMNIA_REDIS_ADDR",
+                      "value": f"omnia-redis.{ns}.svc:6379"}]
+    common_env = redis_env + [
+        {"name": "OMNIA_NAMESPACE", "value": ns},
+    ]
+    out += [
+        _deployment(
+            ns, "omnia-operator", "operator", v["images"]["operator"],
+            v["operator"]["replicas"],
+            [{"name": "http", "containerPort": 8090},
+             {"name": "metrics", "containerPort": 8091}],
+            common_env + [
+                {"name": "OMNIA_DASHBOARD",
+                 "value": "1" if v["operator"]["dashboard"] else "0"},
+            ],
+        ),
+        _service(ns, "omnia-operator", "operator",
+                 [{"name": "http", "port": 8090}]),
+        _deployment(
+            ns, "omnia-session-api", "session-api", v["images"]["sessionApi"],
+            v["sessionApi"]["replicas"],
+            [{"name": "http", "containerPort": 8300},
+             {"name": "metrics", "containerPort": 8301}],
+            common_env,
+        ),
+        _service(ns, "omnia-session-api", "session-api",
+                 [{"name": "http", "port": 8300}]),
+        _deployment(
+            ns, "omnia-memory-api", "memory-api", v["images"]["memoryApi"],
+            v["memoryApi"]["replicas"],
+            [{"name": "http", "containerPort": 8400},
+             {"name": "metrics", "containerPort": 8401}],
+            common_env + [
+                {"name": "OMNIA_SESSION_API_URL",
+                 "value": f"http://omnia-session-api.{ns}.svc:8300"},
+            ],
+        ),
+        _service(ns, "omnia-memory-api", "memory-api",
+                 [{"name": "http", "port": 8400}]),
+    ]
+    return out
+
+
+def to_yaml(manifests: list[dict]) -> str:
+    import yaml
+
+    return "---\n".join(
+        yaml.safe_dump(m, sort_keys=False, default_flow_style=False)
+        for m in manifests
+    )
+
+
+def main(argv: Optional[list[str]] = None) -> int:
+    import yaml
+
+    argv = sys.argv[1:] if argv is None else argv
+    values = None
+    if argv:
+        with open(argv[0]) as f:
+            values = yaml.safe_load(f) or {}
+    manifests = render_install(values)
+    from omnia_tpu.operator.manifest_lint import lint
+
+    errs = lint(manifests)
+    if errs:
+        for e in errs:
+            print(f"lint: {e}", file=sys.stderr)
+        return 1
+    print(to_yaml(manifests))
+    return 0
+
+
+if __name__ == "__main__":  # pragma: no cover
+    sys.exit(main())

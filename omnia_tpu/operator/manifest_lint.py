@@ -1,0 +1,196 @@
+"""Structural manifest validation — the in-tree stand-in for
+`kubectl apply --dry-run=client` / kubeconform.
+
+The reference validates its charts against a live envtest apiserver; this
+environment has no cluster, so the deploy artifacts are gated by this
+linter instead: every rendered manifest must pass before it lands in
+deploy/. Checks the invariants that actually break installs — identity
+fields, DNS-1123 names, unique resource identities, Deployment
+selector⇄template-label agreement, container port-name uniqueness and
+length, env var names, CRD structural-schema rules, and RBAC shape.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Iterable
+
+_DNS1123 = re.compile(r"^[a-z0-9]([-a-z0-9]*[a-z0-9])?$")
+_ENV_NAME = re.compile(r"^[A-Za-z_][A-Za-z0-9_]*$")
+_PORT_NAME = re.compile(r"^[a-z0-9]([-a-z0-9]*[a-z0-9])?$")
+
+# Kinds that are cluster-scoped (no namespace expected).
+_CLUSTER_SCOPED = {
+    "CustomResourceDefinition", "ClusterRole", "ClusterRoleBinding",
+    "Namespace", "PriorityClass",
+}
+
+
+def lint(manifests: Iterable[dict]) -> list[str]:
+    errs: list[str] = []
+    seen: set[tuple] = set()
+    for i, m in enumerate(manifests):
+        where = f"manifest[{i}]"
+        if not isinstance(m, dict):
+            errs.append(f"{where}: not a mapping")
+            continue
+        kind = m.get("kind")
+        api = m.get("apiVersion")
+        md = m.get("metadata") or {}
+        name = md.get("name", "")
+        where = f"{kind or '?'}/{name or '?'}"
+        if not api:
+            errs.append(f"{where}: missing apiVersion")
+        if not kind:
+            errs.append(f"{where}: missing kind")
+        if not name:
+            errs.append(f"{where}: missing metadata.name")
+        elif kind != "CustomResourceDefinition" and not _DNS1123.match(name):
+            errs.append(f"{where}: name {name!r} is not DNS-1123")
+        elif len(name) > 253:
+            errs.append(f"{where}: name too long")
+        ns = md.get("namespace")
+        if kind in _CLUSTER_SCOPED and ns:
+            errs.append(f"{where}: cluster-scoped kind must not set namespace")
+        ident = (api, kind, ns or "", name)
+        if ident in seen:
+            errs.append(f"{where}: duplicate resource identity")
+        seen.add(ident)
+
+        if kind == "Deployment":
+            errs += _lint_deployment(where, m)
+        elif kind == "CustomResourceDefinition":
+            errs += _lint_crd(where, m)
+        elif kind == "Service":
+            errs += _lint_service(where, m)
+        elif kind in ("ClusterRole", "Role"):
+            for r, rule in enumerate(m.get("rules") or []):
+                if not rule.get("verbs"):
+                    errs.append(f"{where}: rules[{r}] missing verbs")
+        elif kind in ("ClusterRoleBinding", "RoleBinding"):
+            if not m.get("roleRef", {}).get("name"):
+                errs.append(f"{where}: roleRef.name missing")
+            if not m.get("subjects"):
+                errs.append(f"{where}: subjects missing")
+    return errs
+
+
+def _lint_deployment(where: str, m: dict) -> list[str]:
+    errs = []
+    spec = m.get("spec") or {}
+    sel = (spec.get("selector") or {}).get("matchLabels") or {}
+    tmpl = spec.get("template") or {}
+    labels = (tmpl.get("metadata") or {}).get("labels") or {}
+    if not sel:
+        errs.append(f"{where}: selector.matchLabels empty")
+    for k, v in sel.items():
+        if labels.get(k) != v:
+            errs.append(
+                f"{where}: selector {k}={v} not matched by template labels"
+            )
+    pod = tmpl.get("spec") or {}
+    containers = pod.get("containers") or []
+    if not containers:
+        errs.append(f"{where}: no containers")
+    port_names: set[str] = set()
+    cnames: set[str] = set()
+    for c in containers:
+        cn = c.get("name", "")
+        if not _DNS1123.match(cn):
+            errs.append(f"{where}: container name {cn!r} invalid")
+        if cn in cnames:
+            errs.append(f"{where}: duplicate container name {cn!r}")
+        cnames.add(cn)
+        if not c.get("image"):
+            errs.append(f"{where}/{cn}: missing image")
+        for p in c.get("ports") or []:
+            pn = p.get("name")
+            if pn:
+                if len(pn) > 15 or not _PORT_NAME.match(pn):
+                    errs.append(f"{where}/{cn}: bad port name {pn!r}")
+                if pn in port_names:
+                    errs.append(f"{where}/{cn}: duplicate port name {pn!r} in pod")
+                port_names.add(pn)
+            cp = p.get("containerPort")
+            if not isinstance(cp, int) or not (0 < cp < 65536):
+                errs.append(f"{where}/{cn}: bad containerPort {cp!r}")
+        for e in c.get("env") or []:
+            if not _ENV_NAME.match(e.get("name", "")):
+                errs.append(f"{where}/{cn}: bad env name {e.get('name')!r}")
+            if "value" in e and not isinstance(e["value"], str):
+                errs.append(
+                    f"{where}/{cn}: env {e['name']} value must be a string"
+                )
+    return errs
+
+
+def _lint_service(where: str, m: dict) -> list[str]:
+    errs = []
+    spec = m.get("spec") or {}
+    if not spec.get("selector"):
+        errs.append(f"{where}: service selector empty")
+    for p in spec.get("ports") or []:
+        if not isinstance(p.get("port"), int):
+            errs.append(f"{where}: service port missing/bad")
+    return errs
+
+
+def _lint_crd(where: str, m: dict) -> list[str]:
+    errs = []
+    spec = m.get("spec") or {}
+    names = spec.get("names") or {}
+    group = spec.get("group", "")
+    if m.get("metadata", {}).get("name") != f"{names.get('plural')}.{group}":
+        errs.append(f"{where}: CRD name must be <plural>.<group>")
+    for field in ("kind", "plural", "singular"):
+        if not names.get(field):
+            errs.append(f"{where}: names.{field} missing")
+    versions = spec.get("versions") or []
+    if not versions:
+        errs.append(f"{where}: no versions")
+    if sum(1 for v in versions if v.get("storage")) != 1:
+        errs.append(f"{where}: exactly one storage version required")
+    for v in versions:
+        schema = (v.get("schema") or {}).get("openAPIV3Schema")
+        if not schema:
+            errs.append(f"{where}: version {v.get('name')} missing schema")
+            continue
+        errs += _lint_schema(f"{where}@{v.get('name')}", schema, "")
+    return errs
+
+
+def _lint_schema(where: str, s: dict, path: str) -> list[str]:
+    """Structural-schema subset: every object either types its properties
+    or preserves unknown fields; arrays carry items; enums are lists."""
+    errs = []
+    t = s.get("type")
+    if t == "object":
+        if path == ".metadata":
+            # Structural-schema special case: root metadata MUST be a bare
+            # `type: object` — the apiserver owns its schema.
+            return errs
+        if "properties" not in s and not s.get("x-kubernetes-preserve-unknown-fields"):
+            errs.append(
+                f"{where}: object at {path or '/'} has neither properties "
+                "nor preserve-unknown-fields"
+            )
+        for k, sub in (s.get("properties") or {}).items():
+            errs += _lint_schema(where, sub, f"{path}.{k}")
+        for req in s.get("required") or []:
+            if req not in (s.get("properties") or {}):
+                errs.append(f"{where}: required {path}.{req} not in properties")
+    elif t == "array":
+        items = s.get("items")
+        if not items:
+            errs.append(f"{where}: array at {path} missing items")
+        else:
+            errs += _lint_schema(where, items, path + "[]")
+    elif t in ("string", "integer", "number", "boolean"):
+        enum = s.get("enum")
+        if enum is not None and not isinstance(enum, list):
+            errs.append(f"{where}: enum at {path} not a list")
+    elif t is None and s.get("x-kubernetes-preserve-unknown-fields"):
+        pass
+    elif t is None:
+        errs.append(f"{where}: schema at {path or '/'} missing type")
+    return errs

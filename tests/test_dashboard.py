@@ -1,0 +1,159 @@
+"""Dashboard v1 tests (reference dashboard/ parity, v1 scope): agent
+list/status from the resource store, chat console against a real live
+agent facade (the same WS protocol the page's JS speaks), session
+browser + eval results proxied from session-api, topology listing."""
+
+import json
+import time
+import urllib.request
+
+import pytest
+from websockets.sync.client import connect
+
+from omnia_tpu.dashboard import DashboardServer
+from omnia_tpu.operator.controller import ControllerManager as Controller
+from omnia_tpu.operator.store import MemoryResourceStore
+from omnia_tpu.operator.resources import Resource
+from omnia_tpu.session.api import SessionAPI
+from omnia_tpu.session.records import EvalResultRecord, MessageRecord, SessionRecord
+
+PACK = {
+    "name": "dash-agent",
+    "version": "1.0.0",
+    "prompts": {"system": "You are terse."},
+    "sampling": {"temperature": 0.0, "max_tokens": 64},
+}
+
+
+def _get(port, path):
+    with urllib.request.urlopen(f"http://127.0.0.1:{port}{path}", timeout=10) as r:
+        return r.status, json.loads(r.read())
+
+
+@pytest.fixture(scope="module")
+def stack():
+    """Controller + live in-process agent pod + session-api + dashboard."""
+    session_api = SessionAPI()
+    sess_port = session_api.serve(host="127.0.0.1", port=0)
+
+    store = MemoryResourceStore()
+    store.apply(Resource(
+        kind="Provider", name="mock-llm",
+        spec={"type": "mock", "role": "llm", "options": {
+            "scenarios": [{"pattern": "ping", "reply": "pong from dash"},
+                          {"pattern": ".", "reply": "ok"}]}},
+    ))
+    store.apply(Resource(
+        kind="PromptPack", name="dash-pack", spec={"content": PACK}))
+    store.apply(Resource(
+        kind="AgentRuntime", name="dash-agent",
+        spec={
+            "mode": "agent",
+            "promptPackRef": {"name": "dash-pack"},
+            "providers": [{"name": "main", "providerRef": {"name": "mock-llm"}}],
+            "facades": [{"type": "websocket"}],
+            "replicas": 1,
+        },
+    ))
+    controller = Controller(store, session_api_url=f"http://127.0.0.1:{sess_port}")
+    controller.resync()
+    controller.drain_queue()
+
+    dash = DashboardServer(store, session_api_url=f"http://127.0.0.1:{sess_port}")
+    dport = dash.serve(host="127.0.0.1", port=0)
+    yield dash, dport, session_api, sess_port
+    dash.shutdown()
+    controller.shutdown()
+    session_api.shutdown()
+
+
+class TestDashboard:
+    def test_serves_spa(self, stack):
+        _dash, dport, *_ = stack
+        with urllib.request.urlopen(f"http://127.0.0.1:{dport}/", timeout=10) as r:
+            html = r.read().decode()
+        assert r.status == 200
+        assert "Omnia TPU Console" in html
+        assert "/api/agents" in html  # the page actually drives the APIs
+
+    def test_agent_list_shows_live_status_and_endpoint(self, stack):
+        _dash, dport, *_ = stack
+        _status, doc = _get(dport, "/api/agents")
+        agents = doc["agents"]
+        assert [a["name"] for a in agents] == ["dash-agent"]
+        a = agents[0]
+        assert a["phase"] == "Running"
+        assert a["replicas"] == 1
+        assert a["providers"] == ["mock-llm"]
+        assert a["endpoints"] and a["endpoints"][0]["url"].startswith("ws://")
+
+    def test_chat_console_roundtrip_via_listed_endpoint(self, stack):
+        """Exactly what the console JS does: open the agent's WS endpoint,
+        send a message, stream chunks to done."""
+        _dash, dport, *_ = stack
+        _s, doc = _get(dport, "/api/agents")
+        url = doc["agents"][0]["endpoints"][0]["url"]
+        with connect(url) as ws:
+            hello = json.loads(ws.recv(timeout=10))
+            assert hello["type"] == "connected"
+            ws.send(json.dumps({"type": "message", "content": "ping"}))
+            text = ""
+            deadline = time.monotonic() + 30
+            while time.monotonic() < deadline:
+                m = json.loads(ws.recv(timeout=30))
+                if m["type"] == "chunk":
+                    text += m["text"]
+                elif m["type"] == "done":
+                    break
+            assert text == "pong from dash"
+
+    def test_session_browser_proxies_session_api(self, stack):
+        _dash, dport, session_api, _sp = stack
+        session_api.store.ensure_session(
+            SessionRecord(session_id="dash-sess", workspace="w1", agent="dash-agent"))
+        session_api.store.append_message(
+            MessageRecord(session_id="dash-sess", role="user", content="hello dash"))
+        session_api.store.append_eval_result(EvalResultRecord(
+            session_id="dash-sess", eval_name="helpfulness", score=0.9,
+            passed=True))
+
+        _s, doc = _get(dport, "/api/sessions?workspace=w1")
+        assert any(s["session_id"] == "dash-sess" for s in doc["sessions"])
+        _s, doc = _get(dport, "/api/sessions/dash-sess/messages")
+        assert [m["content"] for m in doc["messages"]] == ["hello dash"]
+        _s, doc = _get(dport, "/api/sessions/dash-sess/eval-results")
+        assert doc["eval_results"][0]["score"] == 0.9
+
+    def test_topology_lists_all_kinds(self, stack):
+        _dash, dport, *_ = stack
+        _s, doc = _get(dport, "/api/resources")
+        kinds = {r["kind"] for r in doc["resources"]}
+        assert {"AgentRuntime", "Provider", "PromptPack"} <= kinds
+        _s, doc = _get(dport, "/api/resources?kind=Provider")
+        assert all(r["kind"] == "Provider" for r in doc["resources"])
+
+    def test_no_session_api_is_503_not_crash(self, stack):
+        dash2 = DashboardServer(stack[0].store, session_api_url=None)
+        port2 = dash2.serve(host="127.0.0.1", port=0)
+        try:
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                _get(port2, "/api/sessions")
+            assert ei.value.code == 503
+        finally:
+            dash2.shutdown()
+
+    def test_chat_usage_surfaces_cost(self, stack):
+        """The console footer shows usage from done — make sure the wire
+        carries it."""
+        _dash, dport, *_ = stack
+        _s, doc = _get(dport, "/api/agents")
+        url = doc["agents"][0]["endpoints"][0]["url"]
+        with connect(url) as ws:
+            json.loads(ws.recv(timeout=10))
+            ws.send(json.dumps({"type": "message", "content": "anything"}))
+            deadline = time.monotonic() + 30
+            while time.monotonic() < deadline:
+                m = json.loads(ws.recv(timeout=30))
+                if m["type"] == "done":
+                    assert "completion_tokens" in m["usage"]
+                    break
