@@ -264,13 +264,29 @@ def operator_main() -> int:
     file-backed clusterless mode), OMNIA_HTTP_PORT (operator REST +
     dashboard), OMNIA_SESSION_API_URL."""
     from omnia_tpu.operator.controller import ControllerManager as Controller
-    from omnia_tpu.operator.store import ResourceStore
+    from omnia_tpu.operator.store import FileResourceStore, MemoryResourceStore
 
-    store = ResourceStore()
     config_dir = _env("OMNIA_CONFIG_DIR")
-    if config_dir:
-        _load_config_dir(store, config_dir)
-    controller = Controller(store, session_api_url=_env("OMNIA_SESSION_API_URL"))
+    # Devroot mode (reference pkg/k8s/filebacked.go): a manifest tree IS
+    # the cluster; the controller's resync loop re-syncs it so external
+    # edits are the kubectl-apply equivalent.
+    store = FileResourceStore(config_dir) if config_dir else MemoryResourceStore()
+    license_manager = None
+    pubkey_path = _env("OMNIA_LICENSE_PUBKEY_PATH")
+    if pubkey_path:
+        from omnia_tpu.license import LicenseManager
+
+        with open(pubkey_path, "rb") as f:
+            license_manager = LicenseManager(f.read())
+        key_path = _env("OMNIA_LICENSE_KEY_PATH")
+        if key_path:
+            with open(key_path) as f:
+                license_manager.activate(f.read())
+    controller = Controller(
+        store,
+        session_api_url=_env("OMNIA_SESSION_API_URL"),
+        license_manager=license_manager,
+    )
     t = threading.Thread(
         target=controller.run,
         kwargs={"resync_s": float(_env("OMNIA_RESYNC_S", "5"))},
@@ -284,31 +300,22 @@ def operator_main() -> int:
         dash = DashboardServer(
             store, session_api_url=_env("OMNIA_SESSION_API_URL"))
         dash.serve(host="0.0.0.0", port=int(_env("OMNIA_HTTP_PORT", "8090")))
+    from omnia_tpu.operator.api import OperatorAPI
+
+    mgmt = _env("OMNIA_MGMT_SECRET")
+    api = OperatorAPI(
+        store,
+        mgmt_secret=mgmt.encode() if mgmt else None,
+        license_manager=license_manager,
+        service_token=_env("OMNIA_SERVICE_TOKEN"),
+    )
+    api.serve(host="0.0.0.0", port=int(_env("OMNIA_API_PORT", "8092")))
     logger.info("operator reconciling (%d resources)", len(store.list()))
     _wait_forever()
+    api.shutdown()
     if dash is not None:
         dash.shutdown()
     return 0
-
-
-def _load_config_dir(store, config_dir: str) -> None:
-    import yaml
-
-    from omnia_tpu.operator.resources import Resource
-
-    for root, _dirs, files in os.walk(config_dir):
-        for fn in sorted(files):
-            if not fn.endswith((".yaml", ".yml", ".json")):
-                continue
-            path = os.path.join(root, fn)
-            with open(path) as f:
-                docs = (
-                    [json.load(f)] if fn.endswith(".json")
-                    else list(yaml.safe_load_all(f))
-                )
-            for doc in docs:
-                if doc:
-                    store.apply(Resource.from_manifest(doc))
 
 
 def compaction_main() -> int:

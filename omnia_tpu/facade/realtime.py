@@ -126,6 +126,30 @@ class DuplexSession:
             self._dropped += 1  # the append below evicts the oldest
         self._buffer.append(rmsg)
 
+    def _deliver_or_park(self, rmsg, failed) -> None:
+        """After a forward failure: deliver to whatever sink is CURRENT,
+        parking only while no sink exists — checked under the lock in the
+        same critical section as the park, so attach() can never slip a
+        fresh socket in between the check and a wrong park (which would
+        strand the message in an attached session's buffer)."""
+        for _ in range(3):  # bounded: each retry means another sink died
+            with self._lock:
+                if self._ws is failed:
+                    self._ws = None
+                ws = self._ws
+                if ws is None:
+                    self._park_msg_locked(rmsg)
+                    return
+            try:
+                self._forward(ws, rmsg)
+                return
+            except Exception:
+                failed = ws
+        with self._lock:
+            if self._ws is failed:
+                self._ws = None
+            self._park_msg_locked(rmsg)
+
     def attach(self, ws) -> int:
         """Point output at a (new) websocket, flushing anything buffered
         while parked. Returns the number of replayed messages, or -1 if
@@ -178,25 +202,7 @@ class DuplexSession:
                 try:
                     self._forward(ws, rmsg)
                 except Exception:
-                    # WS died mid-forward. attach() may have installed a
-                    # FRESH socket while we were blocked in the failed
-                    # send — re-read the sink under the lock and deliver
-                    # there, else the message would sit stranded in the
-                    # buffer of an attached (never-flushing) session.
-                    with self._lock:
-                        if self._ws is ws:
-                            self._ws = None
-                        current = self._ws
-                    if current is not None:
-                        try:
-                            self._forward(current, rmsg)
-                            continue
-                        except Exception:
-                            with self._lock:
-                                if self._ws is current:
-                                    self._ws = None
-                    with self._lock:
-                        self._park_msg_locked(rmsg)
+                    self._deliver_or_park(rmsg, failed=ws)
         except Exception:
             if not self._closed:
                 logger.exception("duplex output stream failed")

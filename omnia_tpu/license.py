@@ -1,0 +1,184 @@
+"""License activation + feature gating for enterprise components.
+
+Reference ee/pkg/license (4.1k LoC) + license_activation_controller.go:
+a signed license key unlocks EE features (arena, policy broker, privacy
+API, envelope encryption, SSO); activation is recorded and heartbeats
+expose days-remaining; expiry enters a grace window before gating.
+
+Keys are RS256-signed JSON (`base64url(payload).base64url(sig)`): the
+vendor signs with a private key, deployments embed only the public key —
+a forged key fails signature verification, and clock-rollback cannot
+resurrect an expired one beyond the grace window.
+"""
+
+from __future__ import annotations
+
+import base64
+import dataclasses
+import json
+import threading
+import time
+from typing import Optional
+
+EE_FEATURES = frozenset({
+    "arena",          # batch eval jobs (ArenaJob)
+    "policy-broker",  # tool-policy decision sidecar
+    "privacy-api",    # consent/DSAR/audit plane
+    "encryption",     # envelope encryption + key rotation
+    "sso",            # OIDC/edge-trust external auth
+})
+
+
+class LicenseError(RuntimeError):
+    """Raised by require(): the operation needs an unlicensed feature."""
+
+
+def _b64url(b: bytes) -> str:
+    return base64.urlsafe_b64encode(b).decode().rstrip("=")
+
+
+def _unb64url(s: str) -> bytes:
+    return base64.urlsafe_b64decode(s + "=" * (-len(s) % 4))
+
+
+@dataclasses.dataclass(frozen=True)
+class License:
+    license_id: str
+    customer: str
+    plan: str                       # community | enterprise
+    features: tuple[str, ...]
+    issued_at: float
+    expires_at: float               # 0 = perpetual
+
+    def to_payload(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+def sign_license(private_key, **fields) -> str:
+    """Vendor-side minting (tests use it with a generated keypair)."""
+    from cryptography.hazmat.primitives import hashes
+    from cryptography.hazmat.primitives.asymmetric import padding
+
+    lic = License(
+        license_id=fields.get("license_id", "lic-1"),
+        customer=fields.get("customer", ""),
+        plan=fields.get("plan", "enterprise"),
+        features=tuple(fields.get("features", sorted(EE_FEATURES))),
+        issued_at=fields.get("issued_at", time.time()),
+        expires_at=fields.get("expires_at", 0.0),
+    )
+    payload = _b64url(json.dumps(lic.to_payload(), sort_keys=True).encode())
+    sig = private_key.sign(
+        payload.encode(), padding.PKCS1v15(), hashes.SHA256()
+    )
+    return f"{payload}.{_b64url(sig)}"
+
+
+class LicenseManager:
+    """Holds the activated license; every EE entry point calls
+    `require(feature)`. Unactivated = community: EE features gate closed
+    (the reference's --enterprise + activation posture)."""
+
+    def __init__(self, public_key_pem: Optional[bytes] = None,
+                 grace_s: float = 14 * 86400.0):
+        self._public_key = None
+        if public_key_pem:
+            from cryptography.hazmat.primitives.serialization import (
+                load_pem_public_key,
+            )
+
+            self._public_key = load_pem_public_key(public_key_pem)
+        self.grace_s = grace_s
+        self._lock = threading.Lock()
+        self._license: Optional[License] = None
+        self._activated_at: Optional[float] = None
+
+    # -- activation ----------------------------------------------------
+
+    def activate(self, key: str) -> License:
+        if self._public_key is None:
+            raise LicenseError("no license public key configured")
+        try:
+            payload_b64, sig_b64 = key.strip().split(".")
+        except ValueError:
+            raise LicenseError("malformed license key")
+        from cryptography.exceptions import InvalidSignature
+        from cryptography.hazmat.primitives import hashes
+        from cryptography.hazmat.primitives.asymmetric import padding
+
+        try:
+            self._public_key.verify(
+                _unb64url(sig_b64), payload_b64.encode(),
+                padding.PKCS1v15(), hashes.SHA256(),
+            )
+        except InvalidSignature:
+            raise LicenseError("license signature invalid")
+        doc = json.loads(_unb64url(payload_b64))
+        lic = License(
+            license_id=doc["license_id"], customer=doc.get("customer", ""),
+            plan=doc.get("plan", "enterprise"),
+            features=tuple(doc.get("features", [])),
+            issued_at=doc.get("issued_at", 0.0),
+            expires_at=doc.get("expires_at", 0.0),
+        )
+        if lic.expires_at and time.time() > lic.expires_at + self.grace_s:
+            raise LicenseError("license expired beyond grace window")
+        with self._lock:
+            self._license = lic
+            self._activated_at = time.time()
+        return lic
+
+    # -- gating --------------------------------------------------------
+
+    def licensed(self, feature: str) -> bool:
+        with self._lock:
+            lic = self._license
+        if lic is None:
+            return False
+        if lic.expires_at and time.time() > lic.expires_at + self.grace_s:
+            return False
+        return feature in lic.features
+
+    def require(self, feature: str) -> None:
+        if not self.licensed(feature):
+            raise LicenseError(
+                f"feature {feature!r} requires an active enterprise license"
+            )
+
+    # -- status/heartbeat ---------------------------------------------
+
+    def heartbeat(self) -> dict:
+        with self._lock:
+            lic = self._license
+        if lic is None:
+            return {"plan": "community", "active": False, "features": []}
+        now = time.time()
+        expired = bool(lic.expires_at) and now > lic.expires_at
+        in_grace = expired and now <= lic.expires_at + self.grace_s
+        return {
+            "plan": lic.plan,
+            "active": not expired or in_grace,
+            "license_id": lic.license_id,
+            "customer": lic.customer,
+            "features": sorted(lic.features),
+            "expires_at": lic.expires_at,
+            "in_grace": in_grace,
+            "days_left": (
+                None if not lic.expires_at
+                else round((lic.expires_at - now) / 86400.0, 1)
+            ),
+        }
+
+
+class CommunityLicenseManager(LicenseManager):
+    """Dev/test convenience: everything licensed (the in-process platform
+    default — a cluster install configures a real key)."""
+
+    def __init__(self):
+        super().__init__()
+
+    def licensed(self, feature: str) -> bool:
+        return True
+
+    def heartbeat(self) -> dict:
+        return {"plan": "dev", "active": True, "features": sorted(EE_FEATURES)}

@@ -1,0 +1,317 @@
+"""Operator REST APIs: content, authz, tool-test, mgmt-plane tokens,
+deploy translate, license.
+
+Reference parity: internal/api/content (workspace content CRUD),
+internal/api/authz (workspace role checks), internal/tooltest/server.go
+(dashboard "test this tool" backend), internal/mgmtplane/fetcher.go
+(dashboard-minted mgmt JWTs for in-cluster callers), internal/api/deploy
+(DeployIntent), ee license activation. One framework-free handler so the
+operator process mounts it next to the dashboard.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+import time
+import urllib.parse
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from omnia_tpu.facade.auth import HmacValidator
+from omnia_tpu.license import CommunityLicenseManager, LicenseError
+from omnia_tpu.operator.deploy import DeployIntentError, deploy as apply_intent
+from omnia_tpu.operator.resources import Resource
+from omnia_tpu.operator.validation import ValidationError
+
+logger = logging.getLogger(__name__)
+
+# Workspace roles → allowed verbs (reference internal/api/authz).
+ROLE_VERBS = {
+    "viewer": {"get", "list"},
+    "editor": {"get", "list", "create", "update"},
+    "admin": {"get", "list", "create", "update", "delete", "grant"},
+}
+
+
+class ContentStore:
+    """Versioned workspace content (reference internal/api/content →
+    workspace PVC): path → ordered versions, latest wins."""
+
+    def __init__(self) -> None:
+        self._items: dict[tuple[str, str], list[dict]] = {}
+        self._lock = threading.Lock()
+
+    def put(self, workspace: str, path: str, content: str, author: str = "") -> dict:
+        with self._lock:
+            versions = self._items.setdefault((workspace, path), [])
+            doc = {
+                "workspace": workspace, "path": path, "content": content,
+                "version": len(versions) + 1, "author": author,
+                "updated_at": time.time(),
+            }
+            versions.append(doc)
+            return dict(doc)
+
+    def get(self, workspace: str, path: str, version: Optional[int] = None) -> Optional[dict]:
+        with self._lock:
+            versions = self._items.get((workspace, path))
+            if not versions:
+                return None
+            if version is None:
+                return dict(versions[-1])
+            if 1 <= version <= len(versions):
+                return dict(versions[version - 1])
+            return None
+
+    def list(self, workspace: str) -> list[dict]:
+        with self._lock:
+            return [
+                {"path": p, "version": len(v), "updated_at": v[-1]["updated_at"]}
+                for (ws, p), v in sorted(self._items.items())
+                if ws == workspace
+            ]
+
+    def delete(self, workspace: str, path: str) -> bool:
+        with self._lock:
+            return self._items.pop((workspace, path), None) is not None
+
+
+class OperatorAPI:
+    # Routes that change state or mint credentials; read-only routes stay
+    # open for the dashboard (which fronts its own auth).
+    _PROTECTED = ("/api/v1/mgmt-token", "/api/v1/deploy",
+                  "/api/v1/license/activate", "/api/v1/content/")
+
+    def __init__(
+        self,
+        store,                       # operator resource store
+        mgmt_secret: Optional[bytes] = None,
+        license_manager=None,
+        tool_executor=None,          # retained for wiring symmetry; tool
+        # tests always run on an ephemeral executor
+        content: Optional[ContentStore] = None,
+        service_token: Optional[str] = None,
+    ) -> None:
+        self.store = store
+        self.mgmt_secret = mgmt_secret
+        self.license = license_manager or CommunityLicenseManager()
+        self.content = content or ContentStore()
+        self.tool_executor = tool_executor
+        # Service-to-service auth (reference internal/serviceauth): when a
+        # token is configured, privileged routes require it. Minting mgmt
+        # tokens is privileged ALWAYS — an open minting endpoint would let
+        # any caller escalate to an authenticated principal, so with no
+        # service token configured it is disabled rather than open.
+        self.service_token = service_token
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self.port: Optional[int] = None
+
+    # -- authz ---------------------------------------------------------
+
+    def _workspace_roles(self, workspace: str) -> list[dict]:
+        res = self.store.get("default", "Workspace", workspace)
+        if res is None:
+            return []
+        return res.spec.get("roleBindings", [])
+
+    def check_access(self, workspace: str, user: str, verb: str) -> dict:
+        for binding in self._workspace_roles(workspace):
+            if user in binding.get("users", []):
+                role = binding.get("role", "viewer")
+                if verb in ROLE_VERBS.get(role, set()):
+                    return {"allowed": True, "role": role}
+        return {"allowed": False, "role": None}
+
+    # -- tool-test -----------------------------------------------------
+
+    def tool_test(self, body: dict) -> tuple[int, dict]:
+        """Execute one tool handler config against its backend and report
+        the outcome (reference internal/tooltest/server.go:33)."""
+        from omnia_tpu.tools.executor import ToolExecutor, ToolHandler
+
+        handler_doc = body.get("handler")
+        if not handler_doc or "name" not in handler_doc:
+            return 400, {"error": "handler with name required"}
+        if handler_doc.get("type") == "client":
+            return 400, {"error": "client tools execute in the browser"}
+        known = {
+            "name", "type", "description", "input_schema", "url", "method",
+            "headers", "timeout_s",
+        }
+        try:
+            handler = ToolHandler(
+                **{k: v for k, v in handler_doc.items() if k in known}
+            )
+        except TypeError as e:
+            return 400, {"error": str(e)}
+        # ALWAYS an ephemeral executor: registering the probe handler into
+        # the production executor would overwrite the real tool of the
+        # same name (and reset its circuit breaker) for live traffic.
+        executor = ToolExecutor([handler])
+        t0 = time.monotonic()
+        outcome = executor.execute(handler.name, body.get("arguments", {}))
+        return 200, {
+            "ok": not outcome.is_error,
+            "result": outcome.content,
+            "latency_ms": round((time.monotonic() - t0) * 1000, 2),
+        }
+
+    # -- mgmt tokens ---------------------------------------------------
+
+    def mint_mgmt_token(self, subject: str, ttl_s: float = 300.0) -> tuple[int, dict]:
+        """Short-lived HS256 mgmt-plane token (reference
+        internal/mgmtplane/fetcher.go consumes the dashboard's equivalent;
+        here the operator mints for in-cluster callers like doctor)."""
+        if not self.mgmt_secret:
+            return 503, {"error": "management plane secret not configured"}
+        token = HmacValidator.mint(
+            self.mgmt_secret, subject=subject, audience="mgmt", ttl_s=ttl_s
+        )
+        return 200, {"token": token, "expires_in_s": ttl_s}
+
+    # -- routing -------------------------------------------------------
+
+    def _authorized(self, path: str, headers: Optional[dict]) -> bool:
+        if not any(path.startswith(p) for p in self._PROTECTED):
+            return True
+        if path == "/api/v1/mgmt-token" and self.service_token is None:
+            return False  # never open: minting escalates privileges
+        if self.service_token is None:
+            return True
+        auth = (headers or {}).get("Authorization", "")
+        token = auth[7:] if auth.startswith("Bearer ") else ""
+        import hashlib
+        import hmac as hmac_mod
+
+        return hmac_mod.compare_digest(
+            hashlib.sha256(token.encode()).digest(),
+            hashlib.sha256(self.service_token.encode()).digest(),
+        )
+
+    def handle(self, method: str, path: str, body: Optional[dict],
+               query: Optional[dict] = None,
+               headers: Optional[dict] = None) -> tuple[int, dict]:
+        query = query or {}
+        if not self._authorized(path, headers):
+            return 401, {"error": "service token required"}
+        try:
+            return self._route(method, path, body or {}, query)
+        except (ValidationError, DeployIntentError) as e:
+            return 400, {"error": str(e)}
+        except LicenseError as e:
+            return 402, {"error": str(e)}
+        except (KeyError, TypeError, ValueError) as e:
+            return 400, {"error": str(e)}
+        except Exception as e:  # pragma: no cover - defensive
+            logger.exception("operator api error")
+            return 500, {"error": str(e)}
+
+    def _route(self, method, path, body, query):
+        if path == "/api/v1/deploy" and method == "POST":
+            result = apply_intent(self.store, body)
+            return 200, result.to_dict()
+        if path == "/api/v1/tooltest" and method == "POST":
+            return self.tool_test(body)
+        if path == "/api/v1/mgmt-token" and method == "POST":
+            subject = body.get("subject", "")
+            if not subject:
+                return 400, {"error": "subject required"}
+            return self.mint_mgmt_token(subject, float(body.get("ttl_s", 300)))
+        if path == "/api/v1/authz/check" and method == "POST":
+            for field in ("workspace", "user", "verb"):
+                if not body.get(field):
+                    return 400, {"error": f"{field} required"}
+            return 200, self.check_access(
+                body["workspace"], body["user"], body["verb"])
+        if path == "/api/v1/license" and method == "GET":
+            return 200, self.license.heartbeat()
+        if path == "/api/v1/license/activate" and method == "POST":
+            lic = self.license.activate(body.get("key", ""))
+            return 200, {"activated": True, "license_id": lic.license_id,
+                         "features": sorted(lic.features)}
+        # content CRUD
+        if path.startswith("/api/v1/content/"):
+            rest = path[len("/api/v1/content/"):]
+            ws, _, cpath = rest.partition("/")
+            if not ws:
+                return 400, {"error": "workspace required"}
+            if method == "GET" and not cpath:
+                return 200, {"items": self.content.list(ws)}
+            if not cpath:
+                return 400, {"error": "content path required"}
+            if method == "GET":
+                version = query.get("version")
+                doc = self.content.get(
+                    ws, cpath, int(version[0]) if version else None)
+                return (200, doc) if doc else (404, {"error": "not found"})
+            if method in ("PUT", "POST"):
+                if "content" not in body:
+                    return 400, {"error": "content required"}
+                return 200, self.content.put(
+                    ws, cpath, body["content"], body.get("author", ""))
+            if method == "DELETE":
+                return (200, {"deleted": True}) if self.content.delete(ws, cpath) \
+                    else (404, {"error": "not found"})
+        return 404, {"error": f"no route {method} {path}"}
+
+    # -- http ----------------------------------------------------------
+
+    def serve(self, host: str = "localhost", port: int = 0) -> int:
+        api = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def _dispatch(self, method):
+                split = urllib.parse.urlsplit(self.path)
+                body = None
+                length = int(self.headers.get("Content-Length") or 0)
+                if length:
+                    try:
+                        body = json.loads(self.rfile.read(length))
+                    except json.JSONDecodeError:
+                        self._reply(400, {"error": "bad json"})
+                        return
+                status, doc = api.handle(
+                    method, split.path, body,
+                    urllib.parse.parse_qs(split.query),
+                    headers=dict(self.headers),
+                )
+                self._reply(status, doc)
+
+            def _reply(self, status, doc):
+                payload = json.dumps(doc).encode()
+                self.send_response(status)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(payload)))
+                self.end_headers()
+                self.wfile.write(payload)
+
+            def do_GET(self):
+                self._dispatch("GET")
+
+            def do_POST(self):
+                self._dispatch("POST")
+
+            def do_PUT(self):
+                self._dispatch("PUT")
+
+            def do_DELETE(self):
+                self._dispatch("DELETE")
+
+            def log_message(self, *a):  # pragma: no cover
+                pass
+
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self.port = self._httpd.server_address[1]
+        threading.Thread(
+            target=self._httpd.serve_forever, name="omnia-operator-api",
+            daemon=True,
+        ).start()
+        return self.port
+
+    def shutdown(self) -> None:
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None

@@ -19,7 +19,7 @@ from typing import Optional
 
 from omnia_tpu.operator.autoscaling import Autoscaler, AutoscalingPolicy
 from omnia_tpu.operator.deployment import AgentDeployment, InProcessPodBackend
-from omnia_tpu.operator.resources import Resource, ResourceKind, resolve_ref
+from omnia_tpu.operator.resources import EE_KINDS, Resource, ResourceKind, resolve_ref
 from omnia_tpu.operator.rollout import RolloutEngine
 from omnia_tpu.operator.store import ResourceStore
 
@@ -34,7 +34,11 @@ class ControllerManager:
         session_api_url: Optional[str] = None,
         capability_probe_timeout_s: float = 600.0,
         wait_ready: bool = True,
+        license_manager=None,
+        arena: Optional["object"] = None,
     ) -> None:
+        from omnia_tpu.license import CommunityLicenseManager
+
         self.store = store
         self.backend = backend or InProcessPodBackend()
         self.session_api_url = session_api_url
@@ -43,6 +47,13 @@ class ControllerManager:
         self.rollouts = RolloutEngine(self.backend)
         self.deployments: dict[str, AgentDeployment] = {}
         self._autoscalers: dict[str, Autoscaler] = {}
+        # EE plane: license gates reconciliation of enterprise kinds
+        # (reference ee/pkg/setup registration behind --enterprise +
+        # license activation); the shared policy evaluator is rebuilt from
+        # ToolPolicy resources and consumed by policy brokers.
+        self.license = license_manager or CommunityLicenseManager()
+        self.arena = arena  # evals.arena.ArenaJobController (lazy default)
+        self.policy_evaluator = None  # policy.broker.PolicyEvaluator
         self._queue: "queue.Queue[tuple[str, str, str]]" = queue.Queue()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -63,6 +74,8 @@ class ControllerManager:
             self._queue.put((res.namespace, res.kind, res.name))
             for ar in self.store.list(ResourceKind.AGENT_RUNTIME.value, res.namespace):
                 self._queue.put((ar.namespace, ar.kind, ar.name))
+        elif res.kind in EE_KINDS:
+            self._queue.put((res.namespace, res.kind, res.name))
 
     # -- run loop -------------------------------------------------------
 
@@ -107,8 +120,21 @@ class ControllerManager:
 
     def resync(self) -> None:
         """Periodic level-trigger: autoscale + rollout ticks + status."""
+        # Devroot mode: re-read the manifest tree so external edits are
+        # the kubectl-apply equivalent (FileResourceStore.sync fires
+        # ADDED/MODIFIED events into the work queue).
+        sync = getattr(self.store, "sync", None)
+        if callable(sync):
+            try:
+                sync()
+            except Exception:
+                logger.exception("store sync failed")
         for ar in self.store.list(ResourceKind.AGENT_RUNTIME.value):
             self.reconcile_agent_runtime(ar)
+        # Running arena jobs fold queue results on the same tick.
+        for aj in self.store.list(ResourceKind.ARENA_JOB.value):
+            if aj.status.get("phase") in ("", "Pending", "Running", None):
+                self.reconcile_arena_job(aj)
 
     # -- reconcilers ----------------------------------------------------
 
@@ -117,6 +143,11 @@ class ControllerManager:
         if res is None:
             if kind == ResourceKind.AGENT_RUNTIME.value:
                 self._teardown(f"{namespace}/{kind}/{name}")
+            elif kind == ResourceKind.TOOL_POLICY.value:
+                # A deleted policy's rules must stop being enforced NOW —
+                # a stale allow-override lingering in the evaluator is a
+                # security hole.
+                self._rebuild_policy_evaluator()
             return
         if kind == ResourceKind.AGENT_RUNTIME.value:
             self.reconcile_agent_runtime(res)
@@ -124,6 +155,15 @@ class ControllerManager:
             self.reconcile_provider(res)
         elif kind == ResourceKind.PROMPT_PACK.value:
             self.reconcile_prompt_pack(res)
+        elif kind == ResourceKind.ARENA_JOB.value:
+            self.reconcile_arena_job(res)
+        elif kind == ResourceKind.TOOL_POLICY.value:
+            self.reconcile_tool_policies(res)
+        elif kind in (
+            ResourceKind.SESSION_PRIVACY_POLICY.value,
+            ResourceKind.ROLLOUT_ANALYSIS.value,
+        ):
+            self.reconcile_ee_passive(res)
 
     def reconcile_provider(self, res: Resource) -> None:
         """Credential/model validation → phase (reference
@@ -149,6 +189,80 @@ class ControllerManager:
                 "version": (res.spec.get("content") or {}).get("version", ""),
             },
         )
+
+    # -- EE reconcilers -------------------------------------------------
+
+    def _license_gate(self, res: Resource, feature: str) -> bool:
+        if self.license.licensed(feature):
+            return True
+        self.store.update_status(res, {
+            "phase": "Blocked",
+            "message": f"feature {feature!r} requires an enterprise license",
+        })
+        return False
+
+    def reconcile_arena_job(self, res: Resource) -> None:
+        """ArenaJob → partition matrix → work queue → poll results
+        (reference ee/internal/controller/arenajob_controller.go:199)."""
+        if not self._license_gate(res, "arena"):
+            return
+        from omnia_tpu.evals.arena import ArenaJobController
+        from omnia_tpu.evals.defs import ArenaJobSpec
+
+        if self.arena is None:
+            self.arena = ArenaJobController()
+        name = f"{res.namespace}/{res.name}"
+        try:
+            if name not in self.arena._jobs:
+                spec_doc = dict(res.spec)
+                spec_doc["name"] = name
+                self.arena.submit(ArenaJobSpec.from_dict(spec_doc))
+            status = self.arena.reconcile(name)
+        except Exception as e:
+            self.store.update_status(res, {"phase": "Error", "message": str(e)})
+            return
+        self.store.update_status(res, status.to_dict())
+
+    def _rebuild_policy_evaluator(self) -> list[str]:
+        from omnia_tpu.policy.broker import PolicyEvaluator, ToolPolicy
+
+        policies = []
+        errs = []
+        for tp in self.store.list(kind=ResourceKind.TOOL_POLICY.value):
+            try:
+                policies.append(ToolPolicy.from_dict(
+                    {"name": tp.name, **tp.spec}))
+            except Exception as e:
+                errs.append(f"{tp.name}: {e}")
+        self.policy_evaluator = PolicyEvaluator(policies)
+        return errs
+
+    def reconcile_tool_policies(self, res: Resource) -> None:
+        """Rebuild the shared evaluator from ALL ToolPolicy resources (the
+        reference policy broker's list-and-poll watcher,
+        ee/pkg/policy/watcher.go:26-108)."""
+        if not self._license_gate(res, "policy-broker"):
+            return
+        errs = self._rebuild_policy_evaluator()
+        self.store.update_status(res, {
+            "phase": "Error" if errs else "Ready",
+            "message": "; ".join(errs),
+            "policiesLoaded": len(self.policy_evaluator.policies),
+        })
+
+    def reconcile_ee_passive(self, res: Resource) -> None:
+        """SessionPrivacyPolicy / RolloutAnalysis: admission already
+        validated the spec; consumers resolve them by ref (recording
+        interceptor, rollout analysis runs) — reconcile just marks Ready
+        under license."""
+        feature = (
+            "privacy-api"
+            if res.kind == ResourceKind.SESSION_PRIVACY_POLICY.value
+            else "arena"
+        )
+        if not self._license_gate(res, feature):
+            return
+        self.store.update_status(res, {"phase": "Ready", "message": ""})
 
     def reconcile_agent_runtime(self, res: Resource) -> None:
         key = res.key

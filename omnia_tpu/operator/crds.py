@@ -201,6 +201,59 @@ def _session_retention_schema() -> dict:
     })
 
 
+def _arena_job_schema() -> dict:
+    return _obj({
+        "scenarios": _arr(_obj({
+            "name": _str(),
+            "turns": _arr(_obj(open_=True)),
+            "checks": _arr(_obj(open_=True)),
+        }, required=["name"], open_=True)),
+        "providers": _arr(_str()),
+        "repeats": _INT,
+        "mode": _str(enum=("direct", "fleet")),
+        "threshold": _obj({
+            "min_pass_rate": _NUM,
+            "max_error_rate": _NUM,
+            "max_p95_latency_s": _NUM,
+        }),
+    }, required=["scenarios", "providers"])
+
+
+def _tool_policy_schema() -> dict:
+    return _obj({
+        "tools": _arr(_str()),
+        "agents": _arr(_str()),
+        "rules": _arr(_obj({
+            "action": _str(enum=("allow", "deny")),
+            "when": _str(),
+            "reason": _str(),
+        }, required=["action"])),
+        "default_action": _str(enum=("allow", "deny")),
+        "priority": _INT,
+    }, required=["rules"])
+
+
+def _session_privacy_policy_schema() -> dict:
+    return _obj({
+        "recording": _BOOL,
+        "redactFields": _arr(_str()),
+        "consentCategories": _arr(_str()),
+        "retention": _obj(open_=True),
+    })
+
+
+def _rollout_analysis_schema() -> dict:
+    return _obj({
+        "metrics": _arr(_obj({
+            "name": _str(),
+            "threshold": _NUM,
+            "maxErrorRate": _NUM,
+            "maxP95LatencyS": _NUM,
+        }, required=["name"])),
+        "interval_s": _NUM,
+    }, required=["metrics"])
+
+
 def _skill_source_schema() -> dict:
     return _obj({
         "source": _obj({
@@ -225,6 +278,13 @@ KINDS: dict[str, tuple[str, object, list[str]]] = {
         "sessionretentionpolicies", _session_retention_schema, ["srp"],
     ),
     "SkillSource": ("skillsources", _skill_source_schema, []),
+    # EE kinds (reference ee/api/v1alpha1).
+    "ArenaJob": ("arenajobs", _arena_job_schema, ["aj"]),
+    "ToolPolicy": ("toolpolicies", _tool_policy_schema, []),
+    "SessionPrivacyPolicy": (
+        "sessionprivacypolicies", _session_privacy_policy_schema, ["spp"],
+    ),
+    "RolloutAnalysis": ("rolloutanalyses", _rollout_analysis_schema, []),
 }
 
 

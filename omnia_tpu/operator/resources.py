@@ -31,6 +31,20 @@ class ResourceKind(str, enum.Enum):
     MEMORY_POLICY = "MemoryPolicy"
     SESSION_RETENTION_POLICY = "SessionRetentionPolicy"
     SKILL_SOURCE = "SkillSource"
+    # Enterprise kinds (reference ee/api/v1alpha1): store-resident like
+    # everything else, reconciled only when the feature is licensed.
+    ARENA_JOB = "ArenaJob"
+    TOOL_POLICY = "ToolPolicy"
+    SESSION_PRIVACY_POLICY = "SessionPrivacyPolicy"
+    ROLLOUT_ANALYSIS = "RolloutAnalysis"
+
+
+EE_KINDS = frozenset({
+    ResourceKind.ARENA_JOB.value,
+    ResourceKind.TOOL_POLICY.value,
+    ResourceKind.SESSION_PRIVACY_POLICY.value,
+    ResourceKind.ROLLOUT_ANALYSIS.value,
+})
 
 
 # Enum vocabularies shared with validation (reference anchors cited).

@@ -158,7 +158,67 @@ def _validate_skill_source(spec: dict, errs: list[str]) -> None:
         errs.append("source.type must be git|oci|configmap|local")
 
 
+def _validate_arena_job(spec: dict, errs: list[str]) -> None:
+    if not spec.get("scenarios"):
+        errs.append("scenarios[] is required")
+    if not spec.get("providers"):
+        errs.append("providers[] is required")
+    mode = spec.get("mode", "direct")
+    if mode not in ("direct", "fleet"):
+        errs.append(f"mode must be direct|fleet, got {mode!r}")
+    repeats = spec.get("repeats", 1)
+    if not isinstance(repeats, int) or isinstance(repeats, bool) or repeats < 1:
+        errs.append(f"repeats must be an integer >= 1, got {repeats!r}")
+    for i, s in enumerate(spec.get("scenarios") or []):
+        if not isinstance(s, dict) or not s.get("name"):
+            errs.append(f"scenarios[{i}].name is required")
+
+
+def _validate_tool_policy(spec: dict, errs: list[str]) -> None:
+    rules = spec.get("rules")
+    if not isinstance(rules, list) or not rules:
+        errs.append("rules[] is required")
+        return
+    for i, r in enumerate(rules):
+        if not isinstance(r, dict):
+            errs.append(f"rules[{i}] must be an object")
+            continue
+        # Same vocabulary the policy broker enforces (PolicyRule.action).
+        if r.get("action") not in ("allow", "deny"):
+            errs.append(f"rules[{i}].action must be allow|deny")
+    if spec.get("default_action", "deny") not in ("allow", "deny"):
+        errs.append("default_action must be allow|deny")
+
+
+def _validate_session_privacy_policy(spec: dict, errs: list[str]) -> None:
+    if "recording" in spec and not isinstance(spec["recording"], bool):
+        errs.append("recording must be a bool")
+    for field in ("redactFields", "consentCategories"):
+        v = spec.get(field)
+        if v is not None and (
+            not isinstance(v, list) or not all(isinstance(x, str) for x in v)
+        ):
+            errs.append(f"{field} must be a list of strings")
+
+
+def _validate_rollout_analysis(spec: dict, errs: list[str]) -> None:
+    metrics = spec.get("metrics")
+    if not isinstance(metrics, list) or not metrics:
+        errs.append("metrics[] is required")
+        return
+    for i, m in enumerate(metrics):
+        if not isinstance(m, dict) or not m.get("name"):
+            errs.append(f"metrics[{i}].name is required")
+        elif "maxErrorRate" not in m and "maxP95LatencyS" not in m \
+                and "threshold" not in m:
+            errs.append(f"metrics[{i}] needs a threshold field")
+
+
 _VALIDATORS: dict[str, Callable[[dict, list[str]], None]] = {
+    ResourceKind.ARENA_JOB.value: _validate_arena_job,
+    ResourceKind.TOOL_POLICY.value: _validate_tool_policy,
+    ResourceKind.SESSION_PRIVACY_POLICY.value: _validate_session_privacy_policy,
+    ResourceKind.ROLLOUT_ANALYSIS.value: _validate_rollout_analysis,
     ResourceKind.AGENT_RUNTIME.value: _validate_agent_runtime,
     ResourceKind.PROVIDER.value: _validate_provider,
     ResourceKind.PROMPT_PACK.value: _validate_prompt_pack,

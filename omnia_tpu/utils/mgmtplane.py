@@ -1,0 +1,54 @@
+"""Mgmt-plane token fetcher.
+
+Reference internal/mgmtplane/fetcher.go: in-cluster callers (doctor,
+conformance probes) fetch short-lived management-plane JWTs from the
+token-minting endpoint instead of holding long-lived secrets. Tokens are
+cached and refreshed shortly before expiry.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+import urllib.request
+from typing import Optional
+
+
+class MgmtTokenFetcher:
+    def __init__(self, operator_url: str, subject: str,
+                 service_token: Optional[str] = None,
+                 refresh_margin_s: float = 30.0, timeout_s: float = 10.0):
+        self.url = operator_url.rstrip("/") + "/api/v1/mgmt-token"
+        self.subject = subject
+        # The minting endpoint requires service-to-service auth; the
+        # service token is the pod-mounted credential that proves this
+        # caller may obtain mgmt principals.
+        self.service_token = service_token
+        self.refresh_margin_s = refresh_margin_s
+        self.timeout_s = timeout_s
+        self._token: Optional[str] = None
+        self._expires_at = 0.0
+        self._lock = threading.Lock()
+
+    def token(self) -> str:
+        """Cached token, refreshed when within the margin of expiry."""
+        with self._lock:
+            if self._token and time.time() < self._expires_at - self.refresh_margin_s:
+                return self._token
+            headers = {"Content-Type": "application/json"}
+            if self.service_token:
+                headers["Authorization"] = f"Bearer {self.service_token}"
+            req = urllib.request.Request(
+                self.url,
+                data=json.dumps({"subject": self.subject}).encode(),
+                headers=headers,
+            )
+            with urllib.request.urlopen(req, timeout=self.timeout_s) as r:
+                doc = json.loads(r.read())
+            self._token = doc["token"]
+            self._expires_at = time.time() + float(doc.get("expires_in_s", 300))
+            return self._token
+
+    def auth_header(self) -> dict:
+        return {"Authorization": f"Bearer {self.token()}"}

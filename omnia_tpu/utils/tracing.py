@@ -138,15 +138,137 @@ def parse_traceparent(header: str) -> Optional[tuple[str, str, bool]]:
     return None
 
 
+class OTLPExporter:
+    """OTLP/HTTP trace exporter (reference internal/tracing/tracing.go:102
+    NewProvider → OTLP → Tempo). Spans batch in a bounded queue drained by
+    one background thread POSTing ExportTraceServiceRequest JSON to
+    `{endpoint}/v1/traces`; a dead collector drops batches (fail-open,
+    counted) — tracing must never stall serving."""
+
+    def __init__(self, endpoint: str, flush_interval_s: float = 2.0,
+                 max_batch: int = 512, timeout_s: float = 10.0):
+        self.endpoint = endpoint.rstrip("/")
+        self.flush_interval_s = flush_interval_s
+        self.max_batch = max_batch
+        self.timeout_s = timeout_s
+        self.dropped = 0
+        self.exported = 0
+        self._queue: "deque[tuple[str, dict]]" = deque(maxlen=8192)
+        self._lock = threading.Lock()
+        self._wake = threading.Event()
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._loop, name="otlp-exporter", daemon=True
+        )
+        self._thread.start()
+
+    def offer(self, service: str, span_dict: dict) -> None:
+        with self._lock:
+            if len(self._queue) == self._queue.maxlen:
+                self.dropped += 1
+            self._queue.append((service, span_dict))
+        if len(self._queue) >= self.max_batch:
+            self._wake.set()
+
+    @staticmethod
+    def _otlp_value(v):
+        if isinstance(v, bool):
+            return {"boolValue": v}
+        if isinstance(v, int):
+            return {"intValue": str(v)}
+        if isinstance(v, float):
+            return {"doubleValue": v}
+        return {"stringValue": str(v)}
+
+    @classmethod
+    def _otlp_span(cls, d: dict) -> dict:
+        return {
+            "traceId": d["trace_id"],
+            "spanId": d["span_id"],
+            **({"parentSpanId": d["parent_span_id"]} if d["parent_span_id"] else {}),
+            "name": d["name"],
+            "kind": 1,  # SPAN_KIND_INTERNAL
+            "startTimeUnixNano": str(d["start_ns"]),
+            "endTimeUnixNano": str(d["end_ns"] or d["start_ns"]),
+            "attributes": [
+                {"key": k, "value": cls._otlp_value(v)}
+                for k, v in d["attributes"].items()
+            ],
+            "events": [
+                {
+                    "timeUnixNano": str(e["ts_ns"]),
+                    "name": e["name"],
+                    "attributes": [
+                        {"key": k, "value": cls._otlp_value(v)}
+                        for k, v in e["attrs"].items()
+                    ],
+                }
+                for e in d["events"]
+            ],
+            "status": {"code": 2 if d["status"] == "error" else 1},
+        }
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            self._wake.wait(timeout=self.flush_interval_s)
+            self._wake.clear()
+            self.flush()
+
+    def flush(self) -> None:
+        with self._lock:
+            batch = list(self._queue)
+            self._queue.clear()
+        if not batch:
+            return
+        by_service: dict[str, list[dict]] = {}
+        for service, d in batch:
+            by_service.setdefault(service, []).append(self._otlp_span(d))
+        body = json.dumps({
+            "resourceSpans": [
+                {
+                    "resource": {"attributes": [
+                        {"key": "service.name",
+                         "value": {"stringValue": svc}},
+                    ]},
+                    "scopeSpans": [{
+                        "scope": {"name": "omnia_tpu"},
+                        "spans": spans,
+                    }],
+                }
+                for svc, spans in by_service.items()
+            ]
+        }).encode()
+        import urllib.request
+
+        req = urllib.request.Request(
+            self.endpoint + "/v1/traces", data=body,
+            headers={"Content-Type": "application/json"},
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout_s):
+                self.exported += len(batch)
+        except Exception:
+            self.dropped += len(batch)
+
+    def shutdown(self) -> None:
+        self._stop.set()
+        self._wake.set()
+        self._thread.join(timeout=5)
+        self.flush()
+
+
 class Tracer:
-    """Process tracer: sampling + ring buffer + optional jsonl export."""
+    """Process tracer: sampling + ring buffer + optional jsonl and/or
+    OTLP/HTTP export."""
 
     def __init__(self, service: str, sample_rate: float = 1.0,
                  export_path: Optional[str] = None, ring_size: int = 2048,
-                 seed: Optional[int] = None):
+                 seed: Optional[int] = None,
+                 otlp: Optional[OTLPExporter] = None):
         self.service = service
         self.sample_rate = sample_rate
         self.export_path = export_path
+        self.otlp = otlp
         self.finished: "deque[Span]" = deque(maxlen=ring_size)
         self._rng = random.Random(seed)
         self._lock = threading.Lock()
@@ -189,6 +311,8 @@ class Tracer:
     def _export(self, span: Span) -> None:
         with self._lock:
             self.finished.append(span)
+        if self.otlp is not None:
+            self.otlp.offer(self.service, span.to_dict())
         if self.export_path:
             line = json.dumps(span.to_dict()) + "\n"
             try:

@@ -1,0 +1,368 @@
+"""EE plane tests: license activation/gating, store-resident EE kinds
+reconciled by the operator (ArenaJob end-to-end with a worker, ToolPolicy
+→ shared evaluator, SessionPrivacyPolicy/RolloutAnalysis), operator REST
+(tool-test, content CRUD, authz, mgmt tokens, license endpoints), and the
+mgmt-plane token fetcher."""
+
+import http.server
+import json
+import threading
+import time
+import urllib.request
+
+import pytest
+from cryptography.hazmat.primitives.asymmetric import rsa
+from cryptography.hazmat.primitives import serialization
+
+from omnia_tpu.license import (
+    CommunityLicenseManager,
+    EE_FEATURES,
+    LicenseError,
+    LicenseManager,
+    sign_license,
+)
+from omnia_tpu.operator.api import ContentStore, OperatorAPI
+from omnia_tpu.operator.controller import ControllerManager
+from omnia_tpu.operator.resources import Resource
+from omnia_tpu.operator.store import MemoryResourceStore
+from omnia_tpu.operator.validation import ValidationError
+
+
+@pytest.fixture(scope="module")
+def vendor_key():
+    priv = rsa.generate_private_key(public_exponent=65537, key_size=2048)
+    pub_pem = priv.public_key().public_bytes(
+        serialization.Encoding.PEM, serialization.PublicFormat.SubjectPublicKeyInfo
+    )
+    return priv, pub_pem
+
+
+class TestLicense:
+    def test_activate_and_gate(self, vendor_key):
+        priv, pub = vendor_key
+        mgr = LicenseManager(pub)
+        assert not mgr.licensed("arena")
+        with pytest.raises(LicenseError):
+            mgr.require("arena")
+        key = sign_license(priv, customer="acme", features=["arena"])
+        lic = mgr.activate(key)
+        assert lic.customer == "acme"
+        assert mgr.licensed("arena")
+        assert not mgr.licensed("privacy-api")  # only licensed features
+        hb = mgr.heartbeat()
+        assert hb["active"] and hb["customer"] == "acme"
+
+    def test_forged_key_rejected(self, vendor_key):
+        _priv, pub = vendor_key
+        other = rsa.generate_private_key(public_exponent=65537, key_size=2048)
+        mgr = LicenseManager(pub)
+        with pytest.raises(LicenseError, match="signature"):
+            mgr.activate(sign_license(other))
+        with pytest.raises(LicenseError, match="malformed"):
+            mgr.activate("not-a-key")
+
+    def test_tampered_payload_rejected(self, vendor_key):
+        priv, pub = vendor_key
+        key = sign_license(priv, features=["arena"])
+        payload, sig = key.split(".")
+        import base64
+
+        doc = json.loads(base64.urlsafe_b64decode(payload + "=="))
+        doc["features"] = sorted(EE_FEATURES)  # self-upgrade attempt
+        forged = base64.urlsafe_b64encode(
+            json.dumps(doc, sort_keys=True).encode()
+        ).decode().rstrip("=") + "." + sig
+        mgr = LicenseManager(pub)
+        with pytest.raises(LicenseError, match="signature"):
+            mgr.activate(forged)
+
+    def test_expiry_and_grace(self, vendor_key):
+        priv, pub = vendor_key
+        mgr = LicenseManager(pub, grace_s=3600)
+        key = sign_license(priv, features=["arena"],
+                           expires_at=time.time() - 60)  # expired, in grace
+        mgr.activate(key)
+        assert mgr.licensed("arena")
+        hb = mgr.heartbeat()
+        assert hb["in_grace"] and hb["active"]
+        # Beyond grace: activation refuses outright.
+        dead = sign_license(priv, features=["arena"],
+                            expires_at=time.time() - 7200)
+        with pytest.raises(LicenseError, match="expired"):
+            LicenseManager(pub, grace_s=3600).activate(dead)
+
+
+# ---------------------------------------------------------------------------
+# EE kinds through the operator
+# ---------------------------------------------------------------------------
+
+SCENARIO = {
+    "name": "refund-check",
+    "turns": [{
+        "user": "how do refunds work?",
+        "checks": [{"kind": "contains", "value": "refund"}],
+    }],
+}
+
+
+class TestEEKindsReconcile:
+    def test_arena_job_end_to_end(self):
+        """ArenaJob resource → controller submits to the arena queue → a
+        worker drains it → status converges to a verdict."""
+        from omnia_tpu.evals.arena import ArenaJobController
+        from omnia_tpu.evals.queue import ArenaQueue
+        from omnia_tpu.evals.worker import ArenaWorker, DirectRunner
+        from omnia_tpu.runtime.packs import load_pack
+        from omnia_tpu.runtime.providers import ProviderRegistry, ProviderSpec
+
+        store = MemoryResourceStore()
+        arena = ArenaJobController(ArenaQueue())
+        mgr = ControllerManager(store, arena=arena)
+        store.apply(Resource(kind="ArenaJob", name="job-a", spec={
+            "scenarios": [SCENARIO],
+            "providers": ["good"],
+            "threshold": {"min_pass_rate": 1.0},
+        }))
+        mgr.drain_queue()
+        res = store.get("default", "ArenaJob", "job-a")
+        assert res.status["phase"] == "Running"
+        assert res.status["total"] == 1
+
+        reg = ProviderRegistry()
+        reg.register(ProviderSpec(name="good", type="mock", options={
+            "scenarios": [{"pattern": "refund",
+                           "reply": "a refund lands within 30 days"}]}))
+        pack = load_pack({"name": "p", "version": "1.0.0",
+                          "prompts": {"system": "s"},
+                          "sampling": {"temperature": 0.0, "max_tokens": 64}})
+        ArenaWorker(arena.queue, DirectRunner(pack, reg)).run_until_empty()
+        mgr.resync()
+        res = store.get("default", "ArenaJob", "job-a")
+        assert res.status["phase"] == "Succeeded", res.status
+        assert res.status["verdict"]["passed"] is True
+        mgr.shutdown()
+
+    def test_arena_job_blocked_without_license(self, vendor_key):
+        _priv, pub = vendor_key
+        store = MemoryResourceStore()
+        mgr = ControllerManager(store, license_manager=LicenseManager(pub))
+        store.apply(Resource(kind="ArenaJob", name="job-b", spec={
+            "scenarios": [SCENARIO], "providers": ["p"]}))
+        mgr.drain_queue()
+        res = store.get("default", "ArenaJob", "job-b")
+        assert res.status["phase"] == "Blocked"
+        assert "license" in res.status["message"]
+        mgr.shutdown()
+
+    def test_tool_policy_builds_shared_evaluator(self):
+        store = MemoryResourceStore()
+        mgr = ControllerManager(store)
+        store.apply(Resource(kind="ToolPolicy", name="deny-destructive", spec={
+            "tools": ["db_*"],
+            "rules": [{"action": "deny", "when": 'args.mode == "write"',
+                       "reason": "writes forbidden"}],
+            "default_action": "allow",
+        }))
+        mgr.drain_queue()
+        res = store.get("default", "ToolPolicy", "deny-destructive")
+        assert res.status["phase"] == "Ready"
+        assert res.status["policiesLoaded"] == 1
+        d = mgr.policy_evaluator.decide({
+            "tool": "db_query", "agent": "a", "args": {"mode": "write"}})
+        assert d.allow is False and "writes forbidden" in d.reason
+        d = mgr.policy_evaluator.decide({
+            "tool": "db_query", "agent": "a", "args": {"mode": "read"}})
+        assert d.allow is True
+        mgr.shutdown()
+
+    def test_admission_rejects_bad_ee_specs(self):
+        store = MemoryResourceStore()
+        with pytest.raises(ValidationError, match="scenarios"):
+            store.apply(Resource(kind="ArenaJob", name="x",
+                                 spec={"providers": ["p"]}))
+        with pytest.raises(ValidationError, match="action"):
+            store.apply(Resource(kind="ToolPolicy", name="x",
+                                 spec={"rules": [{"action": "maybe"}]}))
+        with pytest.raises(ValidationError, match="metrics"):
+            store.apply(Resource(kind="RolloutAnalysis", name="x", spec={}))
+
+    def test_passive_ee_kinds_ready(self):
+        store = MemoryResourceStore()
+        mgr = ControllerManager(store)
+        store.apply(Resource(kind="SessionPrivacyPolicy", name="spp", spec={
+            "recording": True, "redactFields": ["ssn"]}))
+        store.apply(Resource(kind="RolloutAnalysis", name="ra", spec={
+            "metrics": [{"name": "error-rate", "maxErrorRate": 0.05}]}))
+        mgr.drain_queue()
+        assert store.get("default", "SessionPrivacyPolicy", "spp").status["phase"] == "Ready"
+        assert store.get("default", "RolloutAnalysis", "ra").status["phase"] == "Ready"
+        mgr.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# operator REST
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture()
+def op_api():
+    store = MemoryResourceStore()
+    store.apply(Resource(kind="Workspace", name="team-a", spec={
+        "environment": "dev",
+        "roleBindings": [
+            {"role": "admin", "users": ["alice"]},
+            {"role": "viewer", "users": ["bob"]},
+        ],
+    }))
+    api = OperatorAPI(store, mgmt_secret=b"mgmt-secret",
+                      service_token="svc-tok")
+    port = api.serve(host="127.0.0.1", port=0)
+    yield api, port
+    api.shutdown()
+
+
+def _call(port, method, path, body=None, token="svc-tok"):
+    data = json.dumps(body).encode() if body is not None else None
+    headers = {"Content-Type": "application/json"}
+    if token and path != "/api/v1/mgmt-token":
+        headers["Authorization"] = f"Bearer {token}"
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}", data=data, method=method,
+        headers=headers,
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=10) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+class TestOperatorAPI:
+    def test_tooltest_executes_http_handler(self, op_api):
+        _api, port = op_api
+
+        class Echo(http.server.BaseHTTPRequestHandler):
+            def do_POST(self):
+                n = int(self.headers.get("Content-Length") or 0)
+                body = self.rfile.read(n)
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *a):
+                pass
+
+        httpd = http.server.ThreadingHTTPServer(("127.0.0.1", 0), Echo)
+        threading.Thread(target=httpd.serve_forever, daemon=True).start()
+        try:
+            status, doc = _call(port, "POST", "/api/v1/tooltest", {
+                "handler": {"name": "echo-tool", "type": "http",
+                            "url": f"http://127.0.0.1:{httpd.server_address[1]}/"},
+                "arguments": {"q": "refunds"},
+            })
+            assert status == 200 and doc["ok"], doc
+            assert "refunds" in doc["result"]
+            assert doc["latency_ms"] >= 0
+        finally:
+            httpd.shutdown()
+
+    def test_tooltest_reports_unreachable_backend(self, op_api):
+        _api, port = op_api
+        status, doc = _call(port, "POST", "/api/v1/tooltest", {
+            "handler": {"name": "dead", "type": "http",
+                        "url": "http://127.0.0.1:1/", "timeout_s": 0.3},
+        })
+        assert status == 200 and doc["ok"] is False
+
+    def test_content_crud_versions(self, op_api):
+        _api, port = op_api
+        s, v1 = _call(port, "PUT", "/api/v1/content/team-a/packs/main.json",
+                      {"content": '{"v": 1}', "author": "alice"})
+        assert s == 200 and v1["version"] == 1
+        _call(port, "PUT", "/api/v1/content/team-a/packs/main.json",
+              {"content": '{"v": 2}'})
+        s, latest = _call(port, "GET", "/api/v1/content/team-a/packs/main.json")
+        assert latest["version"] == 2 and latest["content"] == '{"v": 2}'
+        s, old = _call(port, "GET",
+                       "/api/v1/content/team-a/packs/main.json?version=1")
+        assert old["content"] == '{"v": 1}'
+        s, listing = _call(port, "GET", "/api/v1/content/team-a/")
+        assert listing["items"][0]["path"] == "packs/main.json"
+        s, d = _call(port, "DELETE", "/api/v1/content/team-a/packs/main.json")
+        assert d["deleted"]
+        s, _ = _call(port, "GET", "/api/v1/content/team-a/packs/main.json")
+        assert s == 404
+
+    def test_authz_roles(self, op_api):
+        _api, port = op_api
+        s, doc = _call(port, "POST", "/api/v1/authz/check",
+                       {"workspace": "team-a", "user": "alice", "verb": "delete"})
+        assert doc == {"allowed": True, "role": "admin"}
+        s, doc = _call(port, "POST", "/api/v1/authz/check",
+                       {"workspace": "team-a", "user": "bob", "verb": "delete"})
+        assert doc["allowed"] is False
+        s, doc = _call(port, "POST", "/api/v1/authz/check",
+                       {"workspace": "team-a", "user": "bob", "verb": "get"})
+        assert doc["allowed"] is True
+        s, doc = _call(port, "POST", "/api/v1/authz/check",
+                       {"workspace": "nope", "user": "alice", "verb": "get"})
+        assert doc["allowed"] is False
+
+    def test_mgmt_token_minting_and_fetcher(self, op_api):
+        from omnia_tpu.facade.auth import HmacValidator
+        from omnia_tpu.utils.mgmtplane import MgmtTokenFetcher
+
+        _api, port = op_api
+        fetcher = MgmtTokenFetcher(f"http://127.0.0.1:{port}", subject="doctor",
+                                   service_token="svc-tok")
+        tok = fetcher.token()
+        principal = HmacValidator(b"mgmt-secret", audience="mgmt").validate(tok)
+        assert principal is not None and principal.subject == "doctor"
+        # Cached until near expiry: same token returned.
+        assert fetcher.token() == tok
+        assert fetcher.auth_header()["Authorization"].startswith("Bearer ")
+        # Without the service token, minting is denied — an open minting
+        # endpoint would let any caller escalate to a mgmt principal.
+        s, doc = _call(port, "POST", "/api/v1/mgmt-token", {"subject": "evil"})
+        assert s == 401
+        # And with NO service token configured at all, minting is disabled.
+        api2 = OperatorAPI(MemoryResourceStore(), mgmt_secret=b"x")
+        port2 = api2.serve(host="127.0.0.1", port=0)
+        try:
+            s, doc = _call(port2, "POST", "/api/v1/mgmt-token", {"subject": "u"})
+            assert s == 401
+        finally:
+            api2.shutdown()
+
+    def test_license_endpoints(self, op_api, vendor_key):
+        priv, pub = vendor_key
+        store = MemoryResourceStore()
+        api = OperatorAPI(store, license_manager=LicenseManager(pub))
+        port = api.serve(host="127.0.0.1", port=0)
+        try:
+            s, hb = _call(port, "GET", "/api/v1/license")
+            assert hb["active"] is False
+            s, doc = _call(port, "POST", "/api/v1/license/activate",
+                           {"key": sign_license(priv, features=["arena"])})
+            assert s == 200 and doc["activated"]
+            s, hb = _call(port, "GET", "/api/v1/license")
+            assert hb["active"] and hb["features"] == ["arena"]
+            s, doc = _call(port, "POST", "/api/v1/license/activate",
+                           {"key": "garbage"})
+            assert s == 402
+        finally:
+            api.shutdown()
+
+    def test_deploy_intent_applies_resources(self, op_api):
+        api, port = op_api
+        s, doc = _call(port, "POST", "/api/v1/deploy", {
+            "version": "v1",
+            "name": "intent-bot",
+            "pack": {"name": "intent-pack", "version": "1.0.0",
+                     "prompts": {"system": "s"},
+                     "sampling": {"temperature": 0.0, "max_tokens": 32}},
+            "providers": [{"name": "m", "providerRef": {"name": "mock-llm"}}],
+        })
+        assert s == 200, doc
+        assert api.store.get("default", "AgentRuntime", "intent-bot") is not None

@@ -153,3 +153,87 @@ class TestSamplingPropagation:
             pass
         assert not t.spans("remote-child")
         root.end()
+
+
+class TestOTLPExport:
+    """OTLP/HTTP exporter (reference internal/tracing OTLP→Tempo): spans
+    arrive at a collector in ExportTraceServiceRequest shape; a dead
+    collector drops batches without stalling serving."""
+
+    def _collector(self):
+        import http.server
+        import threading
+
+        received = []
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            def do_POST(self):
+                n = int(self.headers.get("Content-Length") or 0)
+                received.append((self.path, json.loads(self.rfile.read(n))))
+                self.send_response(200)
+                self.send_header("Content-Length", "2")
+                self.end_headers()
+                self.wfile.write(b"{}")
+
+            def log_message(self, *a):
+                pass
+
+        httpd = http.server.ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        threading.Thread(target=httpd.serve_forever, daemon=True).start()
+        return httpd, received
+
+    def test_spans_reach_collector_in_otlp_shape(self):
+        from omnia_tpu.utils.tracing import OTLPExporter, Tracer
+
+        httpd, received = self._collector()
+        try:
+            exporter = OTLPExporter(
+                f"http://127.0.0.1:{httpd.server_address[1]}",
+                flush_interval_s=60,  # flush manually
+            )
+            tracer = Tracer("runtime", otlp=exporter)
+            with tracer.start_span("conversation", attrs={"turn": 3}) as parent:
+                parent.add_llm_metrics(10, 5, ttft_s=0.1, cost_usd=0.01)
+                with tracer.start_span("llm") as child:
+                    child.add_event("first_token")
+            exporter.flush()
+            assert received, "no OTLP request arrived"
+            path, doc = received[0]
+            assert path == "/v1/traces"
+            rs = doc["resourceSpans"][0]
+            svc = rs["resource"]["attributes"][0]
+            assert svc["key"] == "service.name"
+            assert svc["value"]["stringValue"] == "runtime"
+            spans = rs["scopeSpans"][0]["spans"]
+            by_name = {s["name"]: s for s in spans}
+            assert set(by_name) == {"conversation", "llm"}
+            conv, llm = by_name["conversation"], by_name["llm"]
+            assert llm["traceId"] == conv["traceId"]
+            assert llm["parentSpanId"] == conv["spanId"]
+            assert int(conv["endTimeUnixNano"]) >= int(conv["startTimeUnixNano"])
+            attrs = {a["key"]: a["value"] for a in conv["attributes"]}
+            assert attrs["llm.prompt_tokens"] == {"intValue": "10"}
+            assert attrs["llm.cost_usd"] == {"doubleValue": 0.01}
+            assert llm["events"][0]["name"] == "first_token"
+            assert exporter.exported == 2
+        finally:
+            exporter.shutdown()
+            httpd.shutdown()
+
+    def test_dead_collector_drops_not_blocks(self):
+        import time as _time
+
+        from omnia_tpu.utils.tracing import OTLPExporter, Tracer
+
+        exporter = OTLPExporter("http://127.0.0.1:1", flush_interval_s=60,
+                                timeout_s=0.3)
+        tracer = Tracer("runtime", otlp=exporter)
+        t0 = _time.monotonic()
+        for _ in range(20):
+            with tracer.start_span("s"):
+                pass
+        assert _time.monotonic() - t0 < 1.0  # span path never blocks
+        exporter.flush()
+        assert exporter.dropped == 20
+        assert exporter.exported == 0
+        exporter.shutdown()
