@@ -1,0 +1,7 @@
+#!/bin/bash
+# Retry the chip claim every 60s within this task's window.
+for i in $(seq 1 9); do
+  python -u /root/repo/_bench_when_free.py 2>&1 | grep -v WARNING && exit 0
+  sleep 50
+done
+exit 1

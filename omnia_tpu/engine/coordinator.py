@@ -1,0 +1,141 @@
+"""Engine coordinator: one submit() surface over many engine workers.
+
+SURVEY §7 hard parts: "multi-host serving for 70B — the engine spans
+pods; the facade's single-gRPC-backend assumption must be preserved by
+fronting the engine with one coordinator." This is that front. Workers
+are InferenceEngine-compatible objects: in-process engines (one per
+chip/slice in a single host), or thin stubs wrapping remote runtime pods.
+
+Topology for real multi-host (v5e multi-pod): each worker pod runs
+`jax.distributed.initialize(coordinator, num_processes, process_id)` and
+participates in ONE pjit program spanning hosts — from this module's
+view that whole slice is a single worker whose mesh happens to span
+pods. The coordinator handles the *fleet* dimension: many model
+replicas, routed; XLA handles the *model* dimension inside each.
+
+Routing:
+- Sessionful requests pin to the worker holding their resident KV
+  (cross-turn prefix reuse only pays off on the same worker). The
+  affinity map is coordinator-owned state.
+- Fresh requests go to the least-loaded healthy worker (queue depth +
+  active slots).
+- An unhealthy worker's sessions fail over: affinity drops, the next
+  turn lands elsewhere and re-prefills — the session-KV contract
+  (rebuild-on-miss) makes that a latency cost, never a correctness one.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Optional, Sequence
+
+from omnia_tpu.engine.types import FinishReason, RequestHandle, SamplingParams, StreamEvent
+
+logger = logging.getLogger(__name__)
+
+
+class EngineCoordinator:
+    def __init__(self, workers: Sequence) -> None:
+        if not workers:
+            raise ValueError("coordinator needs at least one worker")
+        self.workers = list(workers)
+        self._affinity: dict[str, int] = {}
+        self._lock = threading.Lock()
+        self.metrics = {"routed": 0, "failovers": 0}
+
+    # -- health / load -------------------------------------------------
+
+    def _healthy_indices(self) -> list[int]:
+        out = []
+        for i, w in enumerate(self.workers):
+            try:
+                if w.healthy():
+                    out.append(i)
+            except Exception:
+                continue
+        return out
+
+    def _load(self, i: int) -> float:
+        w = self.workers[i]
+        try:
+            return w.queue_depth() + w.active_slots()
+        except Exception:
+            return float("inf")
+
+    def healthy(self) -> bool:
+        return bool(self._healthy_indices())
+
+    def queue_depth(self) -> int:
+        return sum(
+            self.workers[i].queue_depth() for i in self._healthy_indices()
+        )
+
+    def active_slots(self) -> int:
+        return sum(
+            self.workers[i].active_slots() for i in self._healthy_indices()
+        )
+
+    # -- routing -------------------------------------------------------
+
+    def _pick(self, session_id: Optional[str]) -> Optional[int]:
+        healthy = set(self._healthy_indices())
+        if not healthy:
+            return None
+        with self._lock:
+            if session_id is not None:
+                pinned = self._affinity.get(session_id)
+                if pinned is not None:
+                    if pinned in healthy:
+                        return pinned
+                    # Worker died: fail the session over. Its resident KV
+                    # is gone; the new worker re-prefills from scratch.
+                    del self._affinity[session_id]
+                    self.metrics["failovers"] += 1
+            choice = min(healthy, key=self._load)
+            if session_id is not None:
+                self._affinity[session_id] = choice
+            return choice
+
+    def submit(
+        self,
+        prompt_tokens: list[int],
+        params: SamplingParams = SamplingParams(),
+        session_id: Optional[str] = None,
+    ) -> RequestHandle:
+        idx = self._pick(session_id)
+        if idx is None:
+            handle = RequestHandle("req-unrouted")
+            handle._push(StreamEvent(
+                "req-unrouted", finish_reason=FinishReason.ERROR,
+                error="no healthy engine workers",
+            ))
+            return handle
+        self.metrics["routed"] += 1
+        return self.workers[idx].submit(prompt_tokens, params, session_id=session_id)
+
+    def release_session(self, session_id: str) -> None:
+        with self._lock:
+            idx = self._affinity.pop(session_id, None)
+        if idx is not None:
+            try:
+                self.workers[idx].release_session(session_id)
+            except Exception:
+                logger.warning("release_session on worker %d failed", idx)
+
+    def worker_for(self, session_id: str) -> Optional[int]:
+        with self._lock:
+            return self._affinity.get(session_id)
+
+    # -- lifecycle -----------------------------------------------------
+
+    def start(self) -> None:
+        for w in self.workers:
+            w.start()
+
+    def stop(self) -> None:
+        for w in self.workers:
+            try:
+                w.stop()
+            except Exception:
+                logger.exception("worker stop failed")

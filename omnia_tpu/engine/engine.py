@@ -151,9 +151,11 @@ class InferenceEngine:
 
         self._dtype = resolve_dtype(engine_cfg.dtype)
         self._mesh = None
-        use_mesh = engine_cfg.dp * engine_cfg.tp > 1
+        use_mesh = engine_cfg.dp * engine_cfg.tp * engine_cfg.sp > 1
         if use_mesh:
-            self._mesh = make_mesh(engine_cfg.dp, engine_cfg.tp, devices=devices)
+            self._mesh = make_mesh(
+                engine_cfg.dp, engine_cfg.tp, sp=engine_cfg.sp, devices=devices
+            )
 
         if params is None:
             params = llama.init_params(model_cfg, jax.random.key(seed), dtype=self._dtype)
@@ -234,6 +236,17 @@ class InferenceEngine:
         # One compiled prefill per bucket length (lazily compiled; warmup()
         # forces all). Shapes: tokens [1, T].
         self._prefill_fn = jax.jit(prefill)
+
+        # Long-context prefill (sp > 1): ring attention splits the O(T²)
+        # attention of buckets ≥ long_prefill_threshold across the sp axis.
+        self._prefill_ring_fn = None
+        if self.cfg.sp > 1:
+            mesh = self._mesh
+
+            def prefill_ring(params, tokens, positions):
+                return llama.forward_prefill_ring(params, cfg, tokens, positions, mesh)
+
+            self._prefill_ring_fn = jax.jit(prefill_ring)
 
         def insert(ck, cv, k_chunk, v_chunk, slot, last_logits, key_data, temp, top_p, top_k):
             # Place the prefill chunk into the slot's rows [slot, 0:T].
@@ -381,6 +394,12 @@ class InferenceEngine:
                 self._ck, self._cv, _, self._key_data = self._run_insert(
                     k_chunk, v_chunk, 0, logits[:, -1]
                 )
+                if (
+                    self._prefill_ring_fn is not None
+                    and b >= self.cfg.long_prefill_threshold
+                    and b % self.cfg.sp == 0
+                ):
+                    self._prefill_ring_fn(self.params, toks, pos)
             self._ck, self._cv = self._extend_nosample_fn(
                 self.params, self._ck, self._cv, toks, pos, zero, zero
             )
@@ -778,7 +797,14 @@ class InferenceEngine:
         # excludes them — and decode overwrites each pad row before it first
         # becomes attendable.
         pos = np.arange(bucket, dtype=np.int32)[None, :]
-        logits, k_chunk, v_chunk = self._prefill_fn(
+        prefill = self._prefill_fn
+        if (
+            self._prefill_ring_fn is not None
+            and bucket >= self.cfg.long_prefill_threshold
+            and bucket % self.cfg.sp == 0
+        ):
+            prefill = self._prefill_ring_fn
+        logits, k_chunk, v_chunk = prefill(
             self.params, jnp.asarray(toks), jnp.asarray(pos)
         )
         self._ck, self._cv, first_tok, self._key_data = self._run_insert(

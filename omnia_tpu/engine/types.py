@@ -141,6 +141,14 @@ class EngineConfig:
     # Mesh shape; dp divides num_slots, tp divides num_kv_heads.
     dp: int = 1
     tp: int = 1
+    # Sequence/context parallelism for LONG-PROMPT prefill: buckets ≥
+    # long_prefill_threshold prefill via causal ring attention with the
+    # prompt sequence-sharded over the "sp" mesh axis, splitting the
+    # O(T²) attention FLOPs across the ring (SURVEY §5.7 / parallel/
+    # ring_attention.py). Decode and the cache layout are unchanged —
+    # the KV chunk gathers into the resident slot rows on insert.
+    sp: int = 1
+    long_prefill_threshold: int = 2048
     # Decode steps per device dispatch (lax.scan inside one compiled
     # program). Each dispatch costs a host↔device round trip — ruinous
     # through a tunnel/remote device — so K tokens per sync amortizes it.

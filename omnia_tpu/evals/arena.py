@@ -81,3 +81,6 @@ class ArenaJobController:
 
     def status(self, job: str) -> JobStatus:
         return self._jobs[job][1]
+
+    def has(self, job: str) -> bool:
+        return job in self._jobs

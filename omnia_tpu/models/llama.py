@@ -158,7 +158,8 @@ def _write_kv(cache, new, start):
     return jax.vmap(one)(cache, new.astype(cache.dtype), start)
 
 
-def _layer(x, p, cfg: ModelConfig, cos, sin, q_positions, ck, cv, write_start):
+def _layer(x, p, cfg: ModelConfig, cos, sin, q_positions, ck, cv, write_start,
+           attn_fn=None):
     B, T, D = x.shape
     h = rms_norm(x, p["ln1"], cfg.rms_norm_eps)
     q = jnp.dot(h, p["attn"]["wq"]).reshape(B, T, cfg.num_heads, cfg.head_dim)
@@ -179,7 +180,10 @@ def _layer(x, p, cfg: ModelConfig, cos, sin, q_positions, ck, cv, write_start):
         ck_eff, cv_eff = ck, cv
         out_pair = (ck, cv)
 
-    attn = gqa_attention(q, ck_eff, cv_eff, q_positions)
+    if attn_fn is not None:
+        attn = attn_fn(q, ck_eff, cv_eff, q_positions)
+    else:
+        attn = gqa_attention(q, ck_eff, cv_eff, q_positions)
     x = x + jnp.dot(attn.reshape(B, T, -1), p["attn"]["wo"])
 
     h2 = rms_norm(x, p["ln2"], cfg.rms_norm_eps)
@@ -190,23 +194,47 @@ def _layer(x, p, cfg: ModelConfig, cos, sin, q_positions, ck, cv, write_start):
     return x, out_pair[0], out_pair[1]
 
 
-def forward_prefill(params, cfg: ModelConfig, tokens, q_positions):
+def forward_prefill(params, cfg: ModelConfig, tokens, q_positions, attn_fn=None):
     """Fresh-sequence prefill: self-contained attention over the chunk,
     returning the per-layer KV chunk for the engine to place into a cache
     slot (so prefill never reads or writes other slots' cache).
 
     tokens, q_positions: int32 [B, T]
     Returns (logits [B, T, V] f32, k_chunk, v_chunk [L, B, T, Hkv, D]).
+    attn_fn overrides the attention op (the ring-prefill path).
     """
     x = params["embed"][tokens]
     cos, sin = rope_cos_sin(q_positions, cfg.head_dim, cfg.rope_theta, cfg.rope_scaling)
 
     def body(x, p):
-        x, k, v = _layer(x, p, cfg, cos, sin, q_positions, None, None, None)
+        x, k, v = _layer(
+            x, p, cfg, cos, sin, q_positions, None, None, None, attn_fn=attn_fn
+        )
         return x, (k, v)
 
     x, (k_chunk, v_chunk) = jax.lax.scan(body, x, params["layers"])
     return _logits(params, cfg, x), k_chunk, v_chunk
+
+
+def forward_prefill_ring(params, cfg: ModelConfig, tokens, q_positions, mesh):
+    """Long-context prefill: identical contract to `forward_prefill`, but
+    attention runs as causal ring attention with q/k/v sequence-sharded
+    over the mesh's "sp" axis (parallel/ring_attention.py), so the O(T²)
+    attention FLOPs of a long prompt split across the ring instead of
+    serializing on one device. The returned KV chunk is the full
+    [L, B, T, Hkv, D] (GSPMD gathers shards on insert), so the serving
+    cache layout is unchanged — sp accelerates prefill, decode still
+    reads the resident rows.
+
+    Requires T divisible by mesh.shape["sp"]; positions must be the
+    fresh-sequence arange (ring blocks derive causality from global row
+    index)."""
+    from omnia_tpu.parallel.ring_attention import ring_attention
+
+    def ring(q, k, v, _q_positions):
+        return ring_attention(q, k, v, mesh)
+
+    return forward_prefill(params, cfg, tokens, q_positions, attn_fn=ring)
 
 
 # ---------------------------------------------------------------------------

@@ -22,7 +22,6 @@ from typing import Optional
 from omnia_tpu.facade.auth import HmacValidator
 from omnia_tpu.license import CommunityLicenseManager, LicenseError
 from omnia_tpu.operator.deploy import DeployIntentError, deploy as apply_intent
-from omnia_tpu.operator.resources import Resource
 from omnia_tpu.operator.validation import ValidationError
 
 logger = logging.getLogger(__name__)
@@ -160,12 +159,18 @@ class OperatorAPI:
 
     # -- mgmt tokens ---------------------------------------------------
 
+    MAX_MGMT_TTL_S = 3600.0
+
     def mint_mgmt_token(self, subject: str, ttl_s: float = 300.0) -> tuple[int, dict]:
         """Short-lived HS256 mgmt-plane token (reference
         internal/mgmtplane/fetcher.go consumes the dashboard's equivalent;
-        here the operator mints for in-cluster callers like doctor)."""
+        here the operator mints for in-cluster callers like doctor). TTL
+        is capped: an uncapped client-supplied ttl would let a service-
+        token holder mint effectively permanent principals that survive
+        service-token rotation."""
         if not self.mgmt_secret:
             return 503, {"error": "management plane secret not configured"}
+        ttl_s = min(max(ttl_s, 1.0), self.MAX_MGMT_TTL_S)
         token = HmacValidator.mint(
             self.mgmt_secret, subject=subject, audience="mgmt", ttl_s=ttl_s
         )
@@ -180,7 +185,12 @@ class OperatorAPI:
             return False  # never open: minting escalates privileges
         if self.service_token is None:
             return True
-        auth = (headers or {}).get("Authorization", "")
+        # Header names are case-insensitive (RFC 7230; HTTP/2 lowercases).
+        auth = ""
+        for k, v in (headers or {}).items():
+            if str(k).lower() == "authorization":
+                auth = str(v)
+                break
         token = auth[7:] if auth.startswith("Bearer ") else ""
         import hashlib
         import hmac as hmac_mod

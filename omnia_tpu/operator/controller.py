@@ -131,10 +131,21 @@ class ControllerManager:
                 logger.exception("store sync failed")
         for ar in self.store.list(ResourceKind.AGENT_RUNTIME.value):
             self.reconcile_agent_runtime(ar)
-        # Running arena jobs fold queue results on the same tick.
+        # Running arena jobs fold queue results on the same tick; Blocked
+        # EE resources re-check the gate — a license activated at runtime
+        # (POST /api/v1/license/activate) fires no store event, so the
+        # level-trigger is what unblocks them.
         for aj in self.store.list(ResourceKind.ARENA_JOB.value):
-            if aj.status.get("phase") in ("", "Pending", "Running", None):
+            if aj.status.get("phase") in ("", "Pending", "Running", "Blocked", None):
                 self.reconcile_arena_job(aj)
+        for kind in (
+            ResourceKind.TOOL_POLICY.value,
+            ResourceKind.SESSION_PRIVACY_POLICY.value,
+            ResourceKind.ROLLOUT_ANALYSIS.value,
+        ):
+            for res in self.store.list(kind):
+                if res.status.get("phase") in ("Blocked", "", None):
+                    self.reconcile_key(res.namespace, res.kind, res.name)
 
     # -- reconcilers ----------------------------------------------------
 
@@ -213,7 +224,7 @@ class ControllerManager:
             self.arena = ArenaJobController()
         name = f"{res.namespace}/{res.name}"
         try:
-            if name not in self.arena._jobs:
+            if not self.arena.has(name):
                 spec_doc = dict(res.spec)
                 spec_doc["name"] = name
                 self.arena.submit(ArenaJobSpec.from_dict(spec_doc))

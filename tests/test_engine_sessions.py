@@ -262,3 +262,127 @@ class TestWarmupCoversSessionPrograms:
                 logger.removeHandler(handler)
             logged = stream.getvalue()
         assert "Compiling" not in logged, logged
+
+
+class TestLongContextServing:
+    """sp axis: long prompts prefill via ring attention (VERDICT weak #8 —
+    ring attention wired into the serving path, not a standalone demo)."""
+
+    def _eng(self, sp, thresh=16):
+        return InferenceEngine(
+            get_config("test-tiny"),
+            EngineConfig(
+                num_slots=2, max_seq=64, prefill_buckets=(8, 32),
+                dtype="float32", dp=1, tp=2, sp=sp,
+                long_prefill_threshold=thresh,
+            ),
+            seed=0,
+        )
+
+    def test_ring_prefill_matches_dense_engine(self):
+        long_prompt = [int(x) for x in
+                       np.random.default_rng(0).integers(1, 200, size=20)]
+        want, _ = self._eng(sp=1).generate(long_prompt, GREEDY)
+        eng = self._eng(sp=2)
+        got, fin = eng.generate(long_prompt, GREEDY)
+        assert fin.finish_reason == FinishReason.LENGTH
+        assert got == want
+
+    def test_short_prompts_skip_the_ring(self):
+        """Below the threshold the dense program serves (no ring latency
+        tax on short prompts)."""
+        eng = self._eng(sp=2, thresh=16)
+        short = [1, 2, 3]  # bucket 8 < threshold 16
+        want, _ = self._eng(sp=1).generate(short, GREEDY)
+        got, _ = eng.generate(short, GREEDY)
+        assert got == want
+
+    def test_sessionful_reuse_with_sp_mesh(self):
+        eng = self._eng(sp=2)
+        p1 = [int(x) for x in np.random.default_rng(1).integers(1, 200, size=18)]
+        a, _ = _turn(eng, p1, sid="lc-1")
+        p2 = p1 + a + [7]
+        want, _ = self._eng(sp=1).generate(p2, GREEDY)
+        got, _ = _turn(eng, p2, sid="lc-1")
+        assert got == want
+        assert eng.metrics["prefix_reuse_tokens"] > 0
+
+
+class TestEngineCoordinator:
+    """Multi-pod serving front (SURVEY §7): one submit() surface, session
+    affinity, load balance, failover."""
+
+    def _coord(self, n=2):
+        from omnia_tpu.engine.coordinator import EngineCoordinator
+
+        workers = [_engine(num_slots=2) for _ in range(n)]
+        return EngineCoordinator(workers), workers
+
+    def _drive(self, coord, workers, handle):
+        toks = []
+        while True:
+            for w in workers:
+                w.step()
+            try:
+                while True:
+                    ev = handle._queue.get_nowait()
+                    if ev.token_id is not None:
+                        toks.append(ev.token_id)
+                    if ev.is_final:
+                        return toks, ev
+            except Exception:
+                pass
+
+    def test_session_affinity_reuses_kv(self):
+        coord, workers = self._coord()
+        p1 = [1, 2, 3, 4, 5, 6]
+        h = coord.submit(p1, GREEDY, session_id="s-aff")
+        t1, _ = self._drive(coord, workers, h)
+        first = coord.worker_for("s-aff")
+        h2 = coord.submit(p1 + t1 + [9], GREEDY, session_id="s-aff")
+        self._drive(coord, workers, h2)
+        assert coord.worker_for("s-aff") == first
+        assert workers[first].metrics["prefix_reuse_tokens"] > 0
+
+    def test_fresh_sessions_balance(self):
+        coord, workers = self._coord()
+        # Submit without driving: queue depths grow, the picker spreads.
+        for i in range(4):
+            coord.submit([1, 2, 3], GREEDY, session_id=f"bal-{i}")
+        spread = {coord.worker_for(f"bal-{i}") for i in range(4)}
+        assert spread == {0, 1}
+        for w in workers:
+            while w.step():
+                pass
+
+    def test_failover_on_unhealthy_worker(self):
+        coord, workers = self._coord()
+        h = coord.submit([5, 5, 5], GREEDY, session_id="s-fo")
+        self._drive(coord, workers, h)
+        pinned = coord.worker_for("s-fo")
+        workers[pinned]._healthy = False  # worker dies
+        h2 = coord.submit([5, 5, 5], GREEDY, session_id="s-fo")
+        toks, fin = self._drive(coord, workers, h2)
+        assert fin.finish_reason == FinishReason.LENGTH
+        assert coord.worker_for("s-fo") != pinned
+        assert coord.metrics["failovers"] == 1
+        # Correctness preserved: same greedy tokens as a fresh engine.
+        want, _ = _engine().generate([5, 5, 5], GREEDY)
+        assert toks == want
+
+    def test_all_workers_down_is_honest_error(self):
+        coord, workers = self._coord()
+        for w in workers:
+            w._healthy = False
+        ev = coord.submit([1], GREEDY).get_event(timeout=5)
+        assert ev.finish_reason == FinishReason.ERROR
+        assert "no healthy" in ev.error
+
+    def test_aggregate_signals(self):
+        coord, workers = self._coord()
+        coord.submit([1, 2], GREEDY)
+        assert coord.queue_depth() >= 1
+        assert coord.healthy()
+        for w in workers:
+            while w.step():
+                pass
