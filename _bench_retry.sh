@@ -1,7 +1,9 @@
 #!/bin/bash
-# Retry the chip claim every 60s within this task's window.
+set -o pipefail
 for i in $(seq 1 9); do
-  python -u /root/repo/_bench_when_free.py 2>&1 | grep -v WARNING && exit 0
-  sleep 50
+  if python -u /root/repo/_bench_when_free.py 2>&1 | grep -v WARNING; then
+    [ -s /root/repo/_bench_result.json ] && exit 0
+  fi
+  sleep 45
 done
 exit 1

@@ -26,6 +26,7 @@ Routing:
 
 from __future__ import annotations
 
+import collections
 import logging
 import threading
 from typing import Optional, Sequence
@@ -36,13 +37,19 @@ logger = logging.getLogger(__name__)
 
 
 class EngineCoordinator:
-    def __init__(self, workers: Sequence) -> None:
+    def __init__(self, workers: Sequence, max_affinity: int = 100_000) -> None:
         if not workers:
             raise ValueError("coordinator needs at least one worker")
         self.workers = list(workers)
-        self._affinity: dict[str, int] = {}
+        # LRU-bounded: workers evict sessions on their own cap without
+        # telling the coordinator, so unbounded affinity would leak one
+        # entry per session forever. Evicting an affinity entry only
+        # costs a re-prefill if the worker still held the KV — the same
+        # rebuild-on-miss contract failover relies on.
+        self._affinity: "collections.OrderedDict[str, int]" = collections.OrderedDict()
+        self.max_affinity = max_affinity
         self._lock = threading.Lock()
-        self.metrics = {"routed": 0, "failovers": 0}
+        self.metrics = {"routed": 0, "failovers": 0, "affinity_evictions": 0}
 
     # -- health / load -------------------------------------------------
 
@@ -66,15 +73,22 @@ class EngineCoordinator:
     def healthy(self) -> bool:
         return bool(self._healthy_indices())
 
+    def _sum_signal(self, attr: str) -> int:
+        # A worker that answered healthy() can still fail its stats RPC a
+        # moment later — the coordinator's own surface must not raise.
+        total = 0
+        for i in self._healthy_indices():
+            try:
+                total += getattr(self.workers[i], attr)()
+            except Exception:
+                continue
+        return total
+
     def queue_depth(self) -> int:
-        return sum(
-            self.workers[i].queue_depth() for i in self._healthy_indices()
-        )
+        return self._sum_signal("queue_depth")
 
     def active_slots(self) -> int:
-        return sum(
-            self.workers[i].active_slots() for i in self._healthy_indices()
-        )
+        return self._sum_signal("active_slots")
 
     # -- routing -------------------------------------------------------
 
@@ -87,6 +101,7 @@ class EngineCoordinator:
                 pinned = self._affinity.get(session_id)
                 if pinned is not None:
                     if pinned in healthy:
+                        self._affinity.move_to_end(session_id)
                         return pinned
                     # Worker died: fail the session over. Its resident KV
                     # is gone; the new worker re-prefills from scratch.
@@ -95,6 +110,10 @@ class EngineCoordinator:
             choice = min(healthy, key=self._load)
             if session_id is not None:
                 self._affinity[session_id] = choice
+                self._affinity.move_to_end(session_id)
+                while len(self._affinity) > self.max_affinity:
+                    self._affinity.popitem(last=False)
+                    self.metrics["affinity_evictions"] += 1
             return choice
 
     def submit(

@@ -1,0 +1,18 @@
+"""PostgreSQL wire-protocol support: client, and an in-tree test server.
+
+The reference's warm/durable tier is Postgres
+(internal/session/providers/postgres — partitioned tables, usage
+aggregation in SQL). omnia_tpu ships the same capability as a real
+wire-protocol client (`omnia_tpu.pg.client.PGClient`, pure stdlib — no
+psycopg in the image) plus an in-tree protocol-v3 server backed by
+SQLite (`omnia_tpu.pg.server.PGServer`) that plays the role
+testcontainers-postgres plays in the reference's tests: the PG-dialect
+SQL and the wire protocol are exercised for real, with no postgres
+binary in the image. Against a production cluster the same client
+connects to real Postgres (trust/cleartext/md5 auth).
+"""
+
+from omnia_tpu.pg.client import PGClient, PGError
+from omnia_tpu.pg.server import PGServer
+
+__all__ = ["PGClient", "PGError", "PGServer"]

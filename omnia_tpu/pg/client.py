@@ -1,0 +1,236 @@
+"""PostgreSQL client over the simple-query protocol.
+
+Production-path client for the warm/durable tier (reference analog: pgx
+in internal/session/providers/postgres). Parameters are interpolated
+client-side with strict literal escaping and sent through the simple
+protocol — the same approach small pure drivers take; it works against
+any Postgres and against the in-tree test server identically.
+
+Auth: trust, cleartext password, and md5. Thread-safe: one socket, one
+lock, one query in flight.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import socket
+import struct
+import threading
+from typing import Iterable, Optional, Union
+
+from omnia_tpu.pg import protocol as p
+
+
+class PGError(RuntimeError):
+    """Server error reply (code in .code)."""
+
+    def __init__(self, message: str, code: str = ""):
+        super().__init__(message)
+        self.code = code
+
+
+class PGUnavailable(PGError):
+    """Transport-level failure."""
+
+
+Param = Union[None, bool, int, float, str, bytes, dict, list]
+
+
+def quote_literal(v: Param) -> str:
+    """Strict client-side literal quoting (the injection-safety boundary
+    for the simple-protocol path)."""
+    import json as _json
+
+    if v is None:
+        return "NULL"
+    if isinstance(v, bool):
+        return "TRUE" if v else "FALSE"
+    if isinstance(v, (int,)):
+        return str(v)
+    if isinstance(v, float):
+        if v != v or v in (float("inf"), float("-inf")):
+            return f"'{v}'"  # NaN/Infinity travel as quoted literals
+        return repr(v)
+    if isinstance(v, bytes):
+        return "'\\x" + v.hex() + "'"
+    if isinstance(v, (dict, list)):
+        v = _json.dumps(v)
+    if isinstance(v, str):
+        if "\x00" in v:
+            raise PGError("NUL byte not allowed in text literal")
+        # Standard-conforming strings: double single quotes. E'' form
+        # guards against backslash-permissive servers too.
+        escaped = v.replace("\\", "\\\\").replace("'", "''")
+        return "E'" + escaped + "'"
+    raise PGError(f"unsupported parameter type {type(v)!r}")
+
+
+def bind(sql: str, params: Iterable[Param]) -> str:
+    """Substitute $1..$n with quoted literals in ONE pass over the
+    original SQL — sequential replacement would re-scan substituted
+    literals, so a parameter VALUE containing '$1' would be expanded
+    inside another parameter's quotes (quoting breakage → injection)."""
+    import re
+
+    plist = list(params)
+
+    def sub(m: re.Match) -> str:
+        idx = int(m.group(1))
+        if not 1 <= idx <= len(plist):
+            raise PGError(f"no parameter for ${idx}")
+        return quote_literal(plist[idx - 1])
+
+    return re.sub(r"\$(\d+)", sub, sql)
+
+
+class PGClient:
+    def __init__(
+        self,
+        host: str = "127.0.0.1",
+        port: int = 5432,
+        user: str = "omnia",
+        database: str = "omnia",
+        password: Optional[str] = None,
+        timeout_s: float = 15.0,
+    ) -> None:
+        self.host, self.port = host, port
+        self.user, self.database = user, database
+        self._password = password
+        self._timeout = timeout_s
+        self._sock: Optional[socket.socket] = None
+        self._rfile = None
+        self._wfile = None
+        self._lock = threading.Lock()
+
+    # -- connection ----------------------------------------------------
+
+    def _connect_locked(self) -> None:
+        sock = socket.create_connection((self.host, self.port), timeout=self._timeout)
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self._sock = sock
+        self._rfile = sock.makefile("rb")
+        self._wfile = sock.makefile("wb")
+        self._wfile.write(p.startup_message(self.user, self.database))
+        self._wfile.flush()
+        while True:
+            typ, payload = p.read_message(self._rfile)
+            if typ == b"R":
+                (code,) = struct.unpack("!I", payload[:4])
+                if code == 0:
+                    continue  # AuthenticationOk
+                if code == 3:  # cleartext
+                    if self._password is None:
+                        raise PGError("server requires a password")
+                    p.write_message(
+                        self._wfile, b"p", self._password.encode() + b"\x00")
+                    self._wfile.flush()
+                elif code == 5:  # md5
+                    if self._password is None:
+                        raise PGError("server requires a password")
+                    salt = payload[4:8]
+                    inner = hashlib.md5(
+                        self._password.encode() + self.user.encode()
+                    ).hexdigest()
+                    digest = hashlib.md5(inner.encode() + salt).hexdigest()
+                    p.write_message(
+                        self._wfile, b"p", b"md5" + digest.encode() + b"\x00")
+                    self._wfile.flush()
+                else:
+                    raise PGError(f"unsupported auth method {code}")
+            elif typ == b"E":
+                err = p.parse_error(payload)
+                raise PGError(err.get("M", "auth error"), err.get("C", ""))
+            elif typ == b"Z":
+                return  # ReadyForQuery
+            # ParameterStatus ('S'), BackendKeyData ('K'), notices: skip
+
+    def _drop_locked(self) -> None:
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+        self._sock = None
+        self._rfile = None
+        self._wfile = None
+
+    def close(self) -> None:
+        with self._lock:
+            if self._sock is not None:
+                try:
+                    p.write_message(self._wfile, b"X", b"")
+                    self._wfile.flush()
+                except OSError:
+                    pass
+            self._drop_locked()
+
+    def clone(self) -> "PGClient":
+        return PGClient(self.host, self.port, self.user, self.database,
+                        self._password, self._timeout)
+
+    # -- queries -------------------------------------------------------
+
+    def query(self, sql: str, params: Iterable[Param] = ()) -> list[dict]:
+        """Run one statement; returns rows as dicts of text values (caller
+        converts types). Raises PGError on server error, PGUnavailable on
+        transport failure. Reconnects once if the cached connection died
+        BEFORE the query was written."""
+        stmt = bind(sql, params)
+        with self._lock:
+            for attempt in (0, 1):
+                try:
+                    if self._sock is None:
+                        self._connect_locked()
+                    break
+                except PGError:
+                    self._drop_locked()
+                    raise
+                except Exception as e:
+                    self._drop_locked()
+                    if attempt:
+                        raise PGUnavailable(
+                            f"postgres at {self.host}:{self.port}: {e}")
+            try:
+                p.write_message(self._wfile, b"Q", stmt.encode() + b"\x00")
+                self._wfile.flush()
+            except Exception as e:
+                self._drop_locked()
+                raise PGUnavailable(str(e)) from e
+            try:
+                return self._read_result_locked()
+            except PGError:
+                raise
+            except Exception as e:
+                self._drop_locked()
+                raise PGUnavailable(str(e)) from e
+
+    def _read_result_locked(self) -> list[dict]:
+        cols: list[str] = []
+        rows: list[dict] = []
+        error: Optional[PGError] = None
+        while True:
+            typ, payload = p.read_message(self._rfile)
+            if typ == b"T":
+                cols = p.parse_row_description(payload)
+            elif typ == b"D":
+                values = p.parse_data_row(payload)
+                rows.append(dict(zip(cols, values)))
+            elif typ == b"E":
+                err = p.parse_error(payload)
+                error = PGError(err.get("M", "query failed"), err.get("C", ""))
+            elif typ == b"C":
+                continue  # CommandComplete
+            elif typ == b"Z":
+                if error is not None:
+                    raise error
+                return rows
+            # NoticeResponse ('N'), EmptyQueryResponse ('I'): skip
+
+    def execute(self, sql: str, params: Iterable[Param] = ()) -> None:
+        self.query(sql, params)
+
+    def ping(self) -> bool:
+        try:
+            return self.query("SELECT 1 AS ok")[0]["ok"] == "1"
+        except PGError:
+            return False

@@ -1,0 +1,137 @@
+"""PostgreSQL frontend/backend protocol v3 framing.
+
+Message = 1-byte type + int32 length (incl. itself) + payload; the
+startup message has no type byte. Only the simple-query subset the
+platform uses is implemented: startup/auth, Query, RowDescription,
+DataRow, CommandComplete, ErrorResponse, ReadyForQuery, Terminate.
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import Optional
+
+
+class ProtocolError(Exception):
+    pass
+
+
+def read_exactly(stream, n: int) -> bytes:
+    buf = b""
+    while len(buf) < n:
+        chunk = stream.read(n - len(buf))
+        if not chunk:
+            raise ProtocolError("connection closed")
+        buf += chunk
+    return buf
+
+
+def read_message(stream) -> tuple[bytes, bytes]:
+    """→ (type_byte, payload)."""
+    head = read_exactly(stream, 5)
+    typ = head[:1]
+    (length,) = struct.unpack("!I", head[1:5])
+    if length < 4 or length > 64 * 1024 * 1024:
+        raise ProtocolError(f"bad message length {length}")
+    return typ, read_exactly(stream, length - 4)
+
+
+def write_message(stream, typ: bytes, payload: bytes) -> None:
+    stream.write(typ + struct.pack("!I", len(payload) + 4) + payload)
+
+
+def read_startup(stream) -> dict:
+    """Server side: startup message → params dict (or {'_ssl': True} for
+    an SSLRequest, which the caller answers with b'N')."""
+    (length,) = struct.unpack("!I", read_exactly(stream, 4))
+    payload = read_exactly(stream, length - 4)
+    (code,) = struct.unpack("!I", payload[:4])
+    if code == 80877103:  # SSLRequest
+        return {"_ssl": True}
+    if code != 196608:  # protocol 3.0
+        raise ProtocolError(f"unsupported protocol {code}")
+    params: dict = {}
+    parts = payload[4:].split(b"\x00")
+    for i in range(0, len(parts) - 1, 2):
+        if parts[i]:
+            params[parts[i].decode()] = parts[i + 1].decode()
+    return params
+
+
+def startup_message(user: str, database: str) -> bytes:
+    body = struct.pack("!I", 196608)
+    for k, v in (("user", user), ("database", database)):
+        body += k.encode() + b"\x00" + v.encode() + b"\x00"
+    body += b"\x00"
+    return struct.pack("!I", len(body) + 4) + body
+
+
+def cstr(b: bytes) -> str:
+    return b.split(b"\x00", 1)[0].decode()
+
+
+def error_response(message: str, code: str = "XX000",
+                   severity: str = "ERROR") -> bytes:
+    payload = b"S" + severity.encode() + b"\x00"
+    payload += b"C" + code.encode() + b"\x00"
+    payload += b"M" + message.encode() + b"\x00"
+    payload += b"\x00"
+    return payload
+
+
+def parse_error(payload: bytes) -> dict:
+    out: dict = {}
+    i = 0
+    while i < len(payload) and payload[i: i + 1] != b"\x00":
+        field = chr(payload[i])
+        end = payload.index(b"\x00", i + 1)
+        out[field] = payload[i + 1: end].decode(errors="replace")
+        i = end + 1
+    return out
+
+
+def row_description(names: list[str]) -> bytes:
+    # All columns described as text (oid 25) — values travel in text
+    # format and the caller converts; same posture as many thin drivers.
+    payload = struct.pack("!H", len(names))
+    for name in names:
+        payload += name.encode() + b"\x00"
+        payload += struct.pack("!IhIhih", 0, 0, 25, -1, -1, 0)
+    return payload
+
+
+def parse_row_description(payload: bytes) -> list[str]:
+    (n,) = struct.unpack("!H", payload[:2])
+    names = []
+    i = 2
+    for _ in range(n):
+        end = payload.index(b"\x00", i)
+        names.append(payload[i:end].decode())
+        i = end + 1 + 18
+    return names
+
+
+def data_row(values: list[Optional[str]]) -> bytes:
+    payload = struct.pack("!H", len(values))
+    for v in values:
+        if v is None:
+            payload += struct.pack("!i", -1)
+        else:
+            b = v.encode()
+            payload += struct.pack("!i", len(b)) + b
+    return payload
+
+
+def parse_data_row(payload: bytes) -> list[Optional[str]]:
+    (n,) = struct.unpack("!H", payload[:2])
+    out: list[Optional[str]] = []
+    i = 2
+    for _ in range(n):
+        (ln,) = struct.unpack("!i", payload[i: i + 4])
+        i += 4
+        if ln == -1:
+            out.append(None)
+        else:
+            out.append(payload[i: i + ln].decode())
+            i += ln
+    return out

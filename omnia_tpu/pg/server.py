@@ -1,0 +1,240 @@
+"""In-tree PostgreSQL-protocol server backed by SQLite.
+
+The test double for the PG tier (role model: the reference's
+testcontainers-postgres, go.mod:53-54 — here with no postgres binary in
+the image). Speaks protocol v3 (startup/auth, simple Query,
+RowDescription/DataRow/CommandComplete/ErrorResponse/ReadyForQuery) and
+executes a translated PG-dialect SQL subset on SQLite: enough that the
+REAL backend SQL (`session/pg_warm.py`) runs verbatim. The translation
+is deliberately narrow and explicit — anything it does not understand
+errors out rather than silently differing from Postgres.
+
+Translation rules (PG → SQLite):
+- types: DOUBLE PRECISION→REAL, BIGINT→INTEGER, BOOLEAN→INTEGER,
+  JSONB/TIMESTAMPTZ→TEXT
+- E'...' string literals → '...' (backslash-unescape)
+- `::type` casts stripped
+- TRUE/FALSE pass through (SQLite accepts them)
+- ON CONFLICT upserts pass through (SQLite shares the syntax)
+"""
+
+from __future__ import annotations
+
+import logging
+import re
+import socket
+import socketserver
+import sqlite3
+import struct
+import threading
+from typing import Optional
+
+from omnia_tpu.pg import protocol as p
+
+logger = logging.getLogger(__name__)
+
+_TYPE_MAP = [
+    (re.compile(r"\bDOUBLE PRECISION\b", re.I), "REAL"),
+    (re.compile(r"\bBIGINT\b", re.I), "INTEGER"),
+    (re.compile(r"\bBOOLEAN\b", re.I), "INTEGER"),
+    (re.compile(r"\bJSONB\b", re.I), "TEXT"),
+    (re.compile(r"\bTIMESTAMPTZ\b", re.I), "TEXT"),
+]
+_CAST = re.compile(r"::[a-zA-Z_ ]+")
+_ESTR = re.compile(r"E'((?:[^']|'')*)'")
+
+
+def translate(sql: str) -> str:
+    for pat, repl in _TYPE_MAP:
+        sql = pat.sub(repl, sql)
+    sql = _CAST.sub("", sql)
+
+    def unescape(m: re.Match) -> str:
+        body = m.group(1)
+        body = body.replace("\\\\", "\x00ESCBS\x00").replace("\\'", "''")
+        body = body.replace("\x00ESCBS\x00", "\\")
+        return "'" + body + "'"
+
+    return _ESTR.sub(unescape, sql)
+
+
+class PGServer:
+    """Threaded protocol-v3 server over one shared SQLite database."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 password: Optional[str] = None, db_path: str = ":memory:"):
+        self._host, self._port = host, port
+        self._password = password
+        self._db = sqlite3.connect(db_path, check_same_thread=False)
+        self._db_lock = threading.Lock()
+        self._server: Optional[socketserver.ThreadingTCPServer] = None
+        self._conns: set[socket.socket] = set()
+        self._conns_lock = threading.Lock()
+
+    def start(self) -> "PGServer":
+        outer = self
+
+        class Handler(socketserver.StreamRequestHandler):
+            def handle(self):
+                with outer._conns_lock:
+                    outer._conns.add(self.connection)
+                try:
+                    outer._serve(self.rfile, self.wfile, self.connection)
+                except Exception:
+                    pass
+                finally:
+                    with outer._conns_lock:
+                        outer._conns.discard(self.connection)
+
+        class Server(socketserver.ThreadingTCPServer):
+            allow_reuse_address = True
+            daemon_threads = True
+
+        self._server = Server((self._host, self._port), Handler)
+        self._port = self._server.server_address[1]
+        threading.Thread(
+            target=self._server.serve_forever, name="omnia-pgd", daemon=True
+        ).start()
+        return self
+
+    def stop(self) -> None:
+        if self._server is not None:
+            self._server.shutdown()
+            self._server.server_close()
+            self._server = None
+        with self._conns_lock:
+            conns, self._conns = list(self._conns), set()
+        for s in conns:
+            try:
+                s.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+
+    @property
+    def address(self) -> tuple[str, int]:
+        return self._host, self._port
+
+    # -- connection loop ----------------------------------------------
+
+    def _serve(self, rfile, wfile, conn) -> None:
+        params = p.read_startup(rfile)
+        if params.get("_ssl"):
+            wfile.write(b"N")  # no TLS in the double
+            wfile.flush()
+            params = p.read_startup(rfile)
+        if self._password is not None:
+            p.write_message(wfile, b"R", struct.pack("!I", 3))  # cleartext
+            wfile.flush()
+            typ, payload = p.read_message(rfile)
+            if typ != b"p" or p.cstr(payload) != self._password:
+                p.write_message(
+                    wfile, b"E",
+                    p.error_response("password authentication failed", "28P01"),
+                )
+                wfile.flush()
+                return
+        p.write_message(wfile, b"R", struct.pack("!I", 0))  # AuthOk
+        p.write_message(
+            wfile, b"S", b"server_version\x0016.0 (omnia-sqlite-double)\x00")
+        p.write_message(wfile, b"Z", b"I")
+        wfile.flush()
+        while True:
+            typ, payload = p.read_message(rfile)
+            if typ == b"X":
+                return
+            if typ != b"Q":
+                p.write_message(
+                    wfile, b"E",
+                    p.error_response(f"unsupported message {typ!r}", "0A000"),
+                )
+                p.write_message(wfile, b"Z", b"I")
+                wfile.flush()
+                continue
+            self._run_query(wfile, p.cstr(payload))
+
+    @staticmethod
+    def _split_statements(sql: str) -> list[str]:
+        """Split on top-level semicolons only — a ';' inside a quoted
+        literal (E'' with backslash escapes, '' doubling) or a line
+        comment is content, not a separator."""
+        out: list[str] = []
+        buf: list[str] = []
+        i = 0
+        n = len(sql)
+        while i < n:
+            ch = sql[i]
+            if ch == "'" or (
+                ch in "eE" and i + 1 < n and sql[i + 1] == "'"
+            ):
+                estring = ch != "'"
+                start = i
+                i += 2 if estring else 1
+                while i < n:
+                    if sql[i] == "\\" and estring:
+                        i += 2
+                        continue
+                    if sql[i] == "'":
+                        if i + 1 < n and sql[i + 1] == "'":
+                            i += 2
+                            continue
+                        i += 1
+                        break
+                    i += 1
+                buf.append(sql[start:i])
+                continue
+            if ch == "-" and i + 1 < n and sql[i + 1] == "-":
+                while i < n and sql[i] != "\n":
+                    i += 1
+                continue
+            if ch == ";":
+                out.append("".join(buf))
+                buf = []
+                i += 1
+                continue
+            buf.append(ch)
+            i += 1
+        out.append("".join(buf))
+        return [s for s in out if s.strip()]
+
+    def _run_query(self, wfile, sql: str) -> None:
+        statements = self._split_statements(sql)
+        try:
+            with self._db_lock:
+                rows = None
+                cols: list[str] = []
+                for stmt in statements:
+                    cur = self._db.execute(translate(stmt))
+                    if cur.description is not None:
+                        cols = [d[0] for d in cur.description]
+                        rows = cur.fetchall()
+                self._db.commit()
+        except sqlite3.Error as e:
+            with self._db_lock:
+                self._db.rollback()
+            p.write_message(wfile, b"E", p.error_response(str(e), "42601"))
+            p.write_message(wfile, b"Z", b"I")
+            wfile.flush()
+            return
+        if rows is not None:
+            p.write_message(wfile, b"T", p.row_description(cols))
+            for row in rows:
+                p.write_message(
+                    wfile, b"D",
+                    p.data_row([self._text(v) for v in row]),
+                )
+            p.write_message(
+                wfile, b"C", b"SELECT %d\x00" % len(rows))
+        else:
+            p.write_message(wfile, b"C", b"OK\x00")
+        p.write_message(wfile, b"Z", b"I")
+        wfile.flush()
+
+    @staticmethod
+    def _text(v) -> Optional[str]:
+        if v is None:
+            return None
+        if isinstance(v, float):
+            return repr(v)
+        if isinstance(v, bytes):
+            return "\\x" + v.hex()
+        return str(v)

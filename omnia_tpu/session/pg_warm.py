@@ -1,0 +1,274 @@
+"""Postgres-backed warm tier.
+
+The cluster deployment of the durable session archive (reference
+internal/session/providers/postgres — partitioned tables, usage
+aggregation in SQL, eval/provider-call stores). Same interface as the
+SQLite `WarmStore`; the warm-tier conformance suite in
+tests/test_postgres.py runs identical assertions against both, through
+the real wire protocol (in-tree PG server in CI, real Postgres when
+OMNIA_TEST_PG_DSN points at one).
+
+Schema notes: PG types (DOUBLE PRECISION, BIGINT, BOOLEAN, JSONB);
+time-partitioning is modelled with the same `day` column + index the
+SQLite tier uses (the reference partitions by range —
+provider_partition.go; a DBA can convert `records` to a partitioned
+table without touching this code, the queries are partition-pruned by
+`day`). All statements are $n-parameterized through PGClient.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from typing import Optional
+
+from omnia_tpu.pg.client import PGClient
+from omnia_tpu.session.records import (
+    EvalResultRecord,
+    MessageRecord,
+    ProviderCallRecord,
+    RuntimeEventRecord,
+    SessionRecord,
+    ToolCallRecord,
+)
+
+_SCHEMA = [
+    """CREATE TABLE IF NOT EXISTS sessions (
+      session_id TEXT PRIMARY KEY,
+      workspace TEXT NOT NULL DEFAULT 'default',
+      agent TEXT NOT NULL DEFAULT '',
+      user_id TEXT NOT NULL DEFAULT '',
+      created_at DOUBLE PRECISION NOT NULL,
+      updated_at DOUBLE PRECISION NOT NULL,
+      archived BOOLEAN NOT NULL DEFAULT FALSE,
+      tier TEXT NOT NULL DEFAULT 'warm',
+      attrs JSONB NOT NULL DEFAULT '{}'
+    )""",
+    "CREATE INDEX IF NOT EXISTS idx_sessions_ws ON sessions(workspace, updated_at)",
+    """CREATE TABLE IF NOT EXISTS records (
+      record_id TEXT PRIMARY KEY,
+      kind TEXT NOT NULL,
+      session_id TEXT NOT NULL,
+      day TEXT NOT NULL,
+      created_at DOUBLE PRECISION NOT NULL,
+      body JSONB NOT NULL
+    )""",
+    "CREATE INDEX IF NOT EXISTS idx_records_session ON records(session_id, kind, created_at)",
+    "CREATE INDEX IF NOT EXISTS idx_records_day ON records(day, kind)",
+    """CREATE TABLE IF NOT EXISTS provider_usage (
+      workspace TEXT NOT NULL,
+      day TEXT NOT NULL,
+      provider TEXT NOT NULL,
+      model TEXT NOT NULL,
+      input_tokens BIGINT NOT NULL DEFAULT 0,
+      output_tokens BIGINT NOT NULL DEFAULT 0,
+      cost_usd DOUBLE PRECISION NOT NULL DEFAULT 0,
+      calls BIGINT NOT NULL DEFAULT 0,
+      PRIMARY KEY (workspace, day, provider, model)
+    )""",
+]
+
+
+def _day(ts: float) -> str:
+    return time.strftime("%Y-%m-%d", time.gmtime(ts))
+
+
+class PgWarmStore:
+    def __init__(self, client: PGClient) -> None:
+        self.client = client
+        # Usage upserts are read-modify-write across two statements; the
+        # lock keeps a single writer's dup-check atomic (multi-writer
+        # deployments rely on record_id PK conflict = dup, same as the
+        # reference's idempotent insert).
+        self._lock = threading.Lock()
+        for stmt in _SCHEMA:
+            self.client.execute(stmt)
+
+    # -- sessions ------------------------------------------------------
+
+    def ensure_session(self, rec: SessionRecord) -> SessionRecord:
+        self.client.execute(
+            """INSERT INTO sessions
+               (session_id, workspace, agent, user_id, created_at,
+                updated_at, archived, tier, attrs)
+               VALUES ($1,$2,$3,$4,$5,$6,$7,'warm',$8)
+               ON CONFLICT(session_id) DO UPDATE SET updated_at=excluded.updated_at""",
+            [rec.session_id, rec.workspace, rec.agent, rec.user_id,
+             rec.created_at, rec.updated_at, rec.archived, rec.attrs],
+        )
+        rec.tier = "warm"
+        return rec
+
+    _SESSION_COLS = ("session_id, workspace, agent, user_id, created_at,"
+                     " updated_at, archived, tier, attrs")
+
+    def get_session(self, session_id: str) -> Optional[SessionRecord]:
+        rows = self.client.query(
+            f"SELECT {self._SESSION_COLS} FROM sessions WHERE session_id=$1",
+            [session_id],
+        )
+        return self._row_to_session(rows[0]) if rows else None
+
+    def list_sessions(
+        self, workspace: Optional[str] = None, limit: int = 100
+    ) -> list[SessionRecord]:
+        if workspace is not None:
+            rows = self.client.query(
+                f"SELECT {self._SESSION_COLS} FROM sessions WHERE workspace=$1"
+                " ORDER BY updated_at DESC LIMIT $2",
+                [workspace, limit],
+            )
+        else:
+            rows = self.client.query(
+                f"SELECT {self._SESSION_COLS} FROM sessions"
+                " ORDER BY updated_at DESC LIMIT $1",
+                [limit],
+            )
+        return [self._row_to_session(r) for r in rows]
+
+    def delete_session(self, session_id: str) -> bool:
+        existed = bool(self.client.query(
+            "SELECT 1 AS x FROM sessions WHERE session_id=$1", [session_id]))
+        self.client.execute(
+            "DELETE FROM sessions WHERE session_id=$1", [session_id])
+        self.client.execute(
+            "DELETE FROM records WHERE session_id=$1", [session_id])
+        return existed
+
+    @staticmethod
+    def _row_to_session(row: dict) -> SessionRecord:
+        truthy = ("1", "t", "true", "TRUE")
+        return SessionRecord(
+            session_id=row["session_id"],
+            workspace=row["workspace"],
+            agent=row["agent"],
+            user_id=row["user_id"],
+            created_at=float(row["created_at"]),
+            updated_at=float(row["updated_at"]),
+            archived=row["archived"] in truthy,
+            tier=row["tier"],
+            attrs=json.loads(row["attrs"]),
+        )
+
+    # -- appends -------------------------------------------------------
+
+    def _append(self, kind: str, session_id: str, created_at: float, body: dict):
+        self.client.execute(
+            """INSERT INTO records (record_id, kind, session_id, day, created_at, body)
+               VALUES ($1,$2,$3,$4,$5,$6)
+               ON CONFLICT(record_id) DO UPDATE SET body=excluded.body""",
+            [body.get("record_id"), kind, session_id, _day(created_at),
+             created_at, body],
+        )
+
+    def append_message(self, rec: MessageRecord) -> None:
+        self._append("message", rec.session_id, rec.created_at, rec.__dict__)
+
+    def append_tool_call(self, rec: ToolCallRecord) -> None:
+        self._append("tool_call", rec.session_id, rec.created_at, rec.__dict__)
+
+    def append_provider_call(self, rec: ProviderCallRecord) -> None:
+        with self._lock:
+            dup = bool(self.client.query(
+                "SELECT 1 AS x FROM records WHERE record_id=$1",
+                [rec.record_id],
+            ))
+            self._append(
+                "provider_call", rec.session_id, rec.created_at, rec.__dict__)
+            if dup:
+                return  # usage increments must not double-count
+            ws_rows = self.client.query(
+                "SELECT workspace FROM sessions WHERE session_id=$1",
+                [rec.session_id],
+            )
+            ws = ws_rows[0]["workspace"] if ws_rows else "default"
+            self.client.execute(
+                """INSERT INTO provider_usage
+                   (workspace, day, provider, model, input_tokens,
+                    output_tokens, cost_usd, calls)
+                   VALUES ($1,$2,$3,$4,$5,$6,$7,1)
+                   ON CONFLICT(workspace, day, provider, model) DO UPDATE SET
+                     input_tokens = provider_usage.input_tokens + excluded.input_tokens,
+                     output_tokens = provider_usage.output_tokens + excluded.output_tokens,
+                     cost_usd = provider_usage.cost_usd + excluded.cost_usd,
+                     calls = provider_usage.calls + 1""",
+                [ws, _day(rec.created_at), rec.provider, rec.model,
+                 rec.input_tokens, rec.output_tokens, rec.cost_usd],
+            )
+
+    def append_eval_result(self, rec: EvalResultRecord) -> None:
+        self._append("eval_result", rec.session_id, rec.created_at, rec.__dict__)
+
+    def append_event(self, rec: RuntimeEventRecord) -> None:
+        self._append("event", rec.session_id, rec.created_at, rec.__dict__)
+
+    # -- reads ---------------------------------------------------------
+
+    def _read(self, kind: str, session_id: str) -> list[dict]:
+        rows = self.client.query(
+            "SELECT body FROM records WHERE session_id=$1 AND kind=$2"
+            " ORDER BY created_at",
+            [session_id, kind],
+        )
+        return [json.loads(r["body"]) for r in rows]
+
+    def messages(self, session_id: str) -> list[MessageRecord]:
+        return [MessageRecord(**d) for d in self._read("message", session_id)]
+
+    def tool_calls(self, session_id: str) -> list[ToolCallRecord]:
+        return [ToolCallRecord(**d) for d in self._read("tool_call", session_id)]
+
+    def provider_calls(self, session_id: str) -> list[ProviderCallRecord]:
+        return [
+            ProviderCallRecord(**d) for d in self._read("provider_call", session_id)
+        ]
+
+    def eval_results(self, session_id: str) -> list[EvalResultRecord]:
+        return [EvalResultRecord(**d) for d in self._read("eval_result", session_id)]
+
+    def events(self, session_id: str) -> list[RuntimeEventRecord]:
+        return [RuntimeEventRecord(**d) for d in self._read("event", session_id)]
+
+    # -- usage ---------------------------------------------------------
+
+    def usage(self, workspace: Optional[str] = None) -> dict:
+        where = " WHERE workspace=$1" if workspace is not None else ""
+        params = [workspace] if workspace is not None else []
+        row = self.client.query(
+            "SELECT COALESCE(SUM(input_tokens),0) AS it,"
+            " COALESCE(SUM(output_tokens),0) AS ot,"
+            " COALESCE(SUM(cost_usd),0) AS c, COALESCE(SUM(calls),0) AS n"
+            f" FROM provider_usage{where}",
+            params,
+        )[0]
+        sessions = self.client.query(
+            f"SELECT COUNT(*) AS n FROM sessions{where}", params
+        )[0]["n"]
+        return {
+            "sessions": int(sessions),
+            "input_tokens": int(float(row["it"])),
+            "output_tokens": int(float(row["ot"])),
+            "cost_usd": round(float(row["c"]), 6),
+            "calls": int(float(row["n"])),
+        }
+
+    # -- compaction hooks ---------------------------------------------
+
+    def sessions_older_than(self, cutoff_ts: float, limit: int = 100) -> list[SessionRecord]:
+        rows = self.client.query(
+            f"SELECT {self._SESSION_COLS} FROM sessions"
+            " WHERE updated_at < $1 ORDER BY updated_at LIMIT $2",
+            [cutoff_ts, limit],
+        )
+        return [self._row_to_session(r) for r in rows]
+
+    def all_records(self, session_id: str) -> dict[str, list[dict]]:
+        return {
+            kind: self._read(kind, session_id)
+            for kind in ("message", "tool_call", "provider_call",
+                         "eval_result", "event")
+        }
+
+    def close(self) -> None:
+        self.client.close()

@@ -1,0 +1,232 @@
+"""Postgres tier tests: wire protocol, and warm-store conformance.
+
+The conformance class runs the SAME assertions against the SQLite
+WarmStore and the PG-backed PgWarmStore — the latter through the real
+wire protocol against the in-tree PG server (reference analog:
+testcontainers-postgres in provider_test.go). Set OMNIA_TEST_PG_DSN
+(host:port/user/db[/password]) to additionally run against a real
+Postgres."""
+
+import os
+import threading
+import time
+
+import pytest
+
+from omnia_tpu.pg import PGClient, PGError, PGServer
+from omnia_tpu.pg.client import PGUnavailable, bind, quote_literal
+from omnia_tpu.session.pg_warm import PgWarmStore
+from omnia_tpu.session.records import (
+    EvalResultRecord,
+    MessageRecord,
+    ProviderCallRecord,
+    SessionRecord,
+)
+from omnia_tpu.session.tiers import TieredStore, demote_bundle
+from omnia_tpu.session.warm import WarmStore
+
+
+@pytest.fixture(scope="module")
+def server():
+    srv = PGServer().start()
+    yield srv
+    srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# protocol / client
+# ---------------------------------------------------------------------------
+
+
+class TestProtocol:
+    def test_quote_literal_escaping(self):
+        assert quote_literal(None) == "NULL"
+        assert quote_literal(True) == "TRUE"
+        assert quote_literal(7) == "7"
+        assert quote_literal(1.5) == "1.5"
+        assert quote_literal("it's") == "E'it''s'"
+        assert quote_literal("a\\b") == "E'a\\\\b'"
+        assert quote_literal({"k": 1}) == "E'{\"k\": 1}'"
+        with pytest.raises(PGError):
+            quote_literal("bad\x00byte")
+
+    def test_bind_positional_no_shadowing(self):
+        sql = bind("SELECT $1, $2, $10", ["a"] * 10)
+        assert "$" not in sql
+
+    def test_injection_via_param_is_inert(self, server):
+        c = PGClient(*server.address)
+        c.execute("CREATE TABLE IF NOT EXISTS inj (id TEXT)")
+        evil = "x'; DROP TABLE inj; --"
+        c.execute("INSERT INTO inj VALUES ($1)", [evil])
+        rows = c.query("SELECT id FROM inj WHERE id=$1", [evil])
+        assert rows == [{"id": evil}]
+        assert c.query("SELECT COUNT(*) AS n FROM inj")[0]["n"] == "1"
+        c.close()
+
+    def test_error_then_connection_still_usable(self, server):
+        c = PGClient(*server.address)
+        with pytest.raises(PGError):
+            c.query("SELECT FROM FROM")
+        assert c.ping()
+        c.close()
+
+    def test_unreachable_maps_to_unavailable(self):
+        c = PGClient("127.0.0.1", 1, timeout_s=0.2)
+        with pytest.raises(PGUnavailable):
+            c.query("SELECT 1")
+
+    def test_concurrent_clients(self, server):
+        boot = PGClient(*server.address)
+        boot.execute("CREATE TABLE IF NOT EXISTS ctr (k TEXT PRIMARY KEY, n BIGINT)")
+        boot.execute("INSERT INTO ctr VALUES ('c', 0)"
+                     " ON CONFLICT(k) DO UPDATE SET n=0")
+        errs = []
+
+        def worker():
+            try:
+                c = PGClient(*server.address)
+                for _ in range(25):
+                    c.execute("UPDATE ctr SET n = n + 1 WHERE k='c'")
+                c.close()
+            except Exception as e:  # pragma: no cover
+                errs.append(e)
+
+        threads = [threading.Thread(target=worker) for _ in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errs
+        assert boot.query("SELECT n FROM ctr")[0]["n"] == "100"
+        boot.close()
+
+
+# ---------------------------------------------------------------------------
+# warm-store conformance: sqlite AND postgres run the same suite
+# ---------------------------------------------------------------------------
+
+
+def _pg_params():
+    out = [("pg-double", None)]
+    dsn = os.environ.get("OMNIA_TEST_PG_DSN")
+    if dsn:
+        out.append(("pg-real", dsn))
+    return out
+
+
+@pytest.fixture(params=["sqlite"] + [p[0] for p in _pg_params()])
+def make_warm(request, server):
+    if request.param == "sqlite":
+        yield lambda: WarmStore()
+        return
+    if request.param == "pg-double":
+        counter = [0]
+
+        def make():
+            # Fresh tables per store: separate schema via table prefix is
+            # overkill for the double — wipe instead.
+            c = PGClient(*server.address)
+            for t in ("sessions", "records", "provider_usage"):
+                c.execute(f"DROP TABLE IF EXISTS {t}")
+            return PgWarmStore(c)
+
+        yield make
+        return
+    # real postgres: host:port/user/db[/password]
+    dsn = os.environ["OMNIA_TEST_PG_DSN"]
+    hostport, user, db, *pw = dsn.split("/")
+    host, _, port = hostport.partition(":")
+
+    def make_real():
+        c = PGClient(host, int(port or 5432), user=user, database=db,
+                     password=pw[0] if pw else None)
+        for t in ("sessions", "records", "provider_usage"):
+            c.execute(f"DROP TABLE IF EXISTS {t}")
+        return PgWarmStore(c)
+
+    yield make_real
+
+
+class TestWarmConformance:
+    def test_session_round_trip(self, make_warm):
+        warm = make_warm()
+        rec = SessionRecord(session_id="w1", workspace="acme", agent="bot",
+                            attrs={"k": "v", "n": 3})
+        warm.ensure_session(rec)
+        got = warm.get_session("w1")
+        assert got.workspace == "acme" and got.attrs == {"k": "v", "n": 3}
+        assert got.tier == "warm"
+        assert warm.get_session("nope") is None
+        assert [s.session_id for s in warm.list_sessions(workspace="acme")] == ["w1"]
+        assert warm.delete_session("w1")
+        assert not warm.delete_session("w1")
+
+    def test_ensure_is_upsert(self, make_warm):
+        warm = make_warm()
+        warm.ensure_session(SessionRecord(session_id="u1", updated_at=100.0))
+        warm.ensure_session(SessionRecord(session_id="u1", updated_at=200.0))
+        assert warm.get_session("u1").updated_at == 200.0
+        assert len(warm.list_sessions()) == 1
+
+    def test_records_round_trip_ordered(self, make_warm):
+        warm = make_warm()
+        warm.ensure_session(SessionRecord(session_id="r1"))
+        for i in range(3):
+            warm.append_message(MessageRecord(
+                session_id="r1", role="user", content=f"m{i}",
+                created_at=1000.0 + i))
+        warm.append_eval_result(EvalResultRecord(
+            session_id="r1", eval_name="q", score=0.5, passed=True))
+        msgs = warm.messages("r1")
+        assert [m.content for m in msgs] == ["m0", "m1", "m2"]
+        assert warm.eval_results("r1")[0].eval_name == "q"
+        allr = warm.all_records("r1")
+        assert len(allr["message"]) == 3 and len(allr["eval_result"]) == 1
+
+    def test_usage_aggregates_and_dedupes(self, make_warm):
+        warm = make_warm()
+        warm.ensure_session(SessionRecord(session_id="s-u", workspace="w1"))
+        pc = ProviderCallRecord(
+            session_id="s-u", provider="tpu", model="llama",
+            input_tokens=100, output_tokens=50, cost_usd=0.25)
+        warm.append_provider_call(pc)
+        warm.append_provider_call(pc)  # at-least-once redelivery
+        u = warm.usage("w1")
+        assert u["input_tokens"] == 100 and u["output_tokens"] == 50
+        assert u["calls"] == 1 and abs(u["cost_usd"] - 0.25) < 1e-9
+        assert warm.usage("other")["calls"] == 0
+
+    def test_sessions_older_than(self, make_warm):
+        warm = make_warm()
+        warm.ensure_session(SessionRecord(session_id="old", updated_at=100.0))
+        warm.ensure_session(SessionRecord(session_id="new", updated_at=5e9))
+        olds = warm.sessions_older_than(1000.0)
+        assert [s.session_id for s in olds] == ["old"]
+
+    def test_tiered_demotion_and_readthrough(self, make_warm):
+        warm = make_warm()
+        ts = TieredStore(warm=warm)
+        ts.ensure_session(SessionRecord(session_id="tier-1"))
+        ts.append_message(MessageRecord(session_id="tier-1", role="user",
+                                        content="hot msg"))
+        bundles = ts.hot.pop_idle(idle_s=0, now=time.time() + 60)
+        demote_bundle(warm, bundles[0])
+        assert [m.content for m in ts.messages("tier-1")] == ["hot msg"]
+        assert ts.get_session("tier-1") is not None
+
+
+class TestBindRegression:
+    def test_param_value_containing_placeholder_stays_inert(self, server):
+        """A parameter VALUE containing '$1' must never be re-expanded
+        inside another parameter's quotes (injection regression)."""
+        c = PGClient(*server.address)
+        c.execute("DROP TABLE IF EXISTS bindreg")
+        c.execute("CREATE TABLE bindreg (a TEXT, b TEXT)")
+        sneaky = "user text mentioning $1 and $2 here"
+        c.execute("INSERT INTO bindreg VALUES ($1, $2)", ["rid-1", sneaky])
+        rows = c.query("SELECT a, b FROM bindreg")
+        assert rows == [{"a": "rid-1", "b": sneaky}]
+        with pytest.raises(PGError, match="no parameter"):
+            bind("SELECT $1, $2", ["only-one"])
+        c.close()
