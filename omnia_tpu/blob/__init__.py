@@ -1,0 +1,16 @@
+"""S3-protocol object storage: SigV4 client, and an in-tree server.
+
+The reference's cold tier and media storage ride object stores
+(internal/session/providers/cold/blobstore_{s3,gcs,azure}.go,
+internal/media). omnia_tpu ships the same capability as a real REST
+client (`S3BlobStore`: AWS Signature V4 over stdlib HTTP — works against
+AWS S3, GCS's S3-compatible XML API, and MinIO) plus an in-tree
+S3-protocol server (`S3Server`) playing the moto/minio role in tests.
+Both plug into the cold tier / media layer through the same
+put/get/list/delete surface as MemoryBlobStore/LocalBlobStore.
+"""
+
+from omnia_tpu.blob.client import S3BlobStore, S3Error
+from omnia_tpu.blob.server import S3Server
+
+__all__ = ["S3BlobStore", "S3Error", "S3Server"]

@@ -1,0 +1,186 @@
+"""S3 REST client with AWS Signature Version 4 (pure stdlib).
+
+Implements the object subset the platform uses — PutObject, GetObject,
+HeadObject, DeleteObject, ListObjectsV2 — against any S3-compatible
+endpoint (AWS, GCS interop, MinIO, the in-tree S3Server). Path-style
+addressing (endpoint/bucket/key), which every S3-compatible store
+accepts and avoids per-bucket DNS.
+"""
+
+from __future__ import annotations
+
+import datetime
+import hashlib
+import hmac
+import urllib.error
+import urllib.parse
+import urllib.request
+from typing import Optional
+
+
+class S3Error(RuntimeError):
+    def __init__(self, message: str, status: int = 0):
+        super().__init__(message)
+        self.status = status
+
+
+def _sha256(b: bytes) -> str:
+    return hashlib.sha256(b).hexdigest()
+
+
+def _hmac(key: bytes, msg: str) -> bytes:
+    return hmac.new(key, msg.encode(), hashlib.sha256).digest()
+
+
+def sign_v4(
+    method: str,
+    url: str,
+    headers: dict,
+    payload: bytes,
+    access_key: str,
+    secret_key: str,
+    region: str = "us-east-1",
+    service: str = "s3",
+    now: Optional[datetime.datetime] = None,
+) -> dict:
+    """→ headers dict including Authorization (AWS SigV4)."""
+    now = now or datetime.datetime.now(datetime.timezone.utc)
+    amz_date = now.strftime("%Y%m%dT%H%M%SZ")
+    datestamp = now.strftime("%Y%m%d")
+    split = urllib.parse.urlsplit(url)
+    host = split.netloc
+    payload_hash = _sha256(payload)
+
+    out = dict(headers)
+    out["host"] = host
+    out["x-amz-date"] = amz_date
+    out["x-amz-content-sha256"] = payload_hash
+
+    # S3 canonical URI = the path exactly as sent on the wire (already
+    # percent-encoded by the caller); re-encoding here would double-encode
+    # and real S3/MinIO would reject the signature.
+    canonical_uri = split.path or "/"
+    # Query params sorted, individually encoded.
+    q = urllib.parse.parse_qsl(split.query, keep_blank_values=True)
+    canonical_query = "&".join(
+        f"{urllib.parse.quote(k, safe='')}={urllib.parse.quote(v, safe='')}"
+        for k, v in sorted(q)
+    )
+    signed_names = sorted(k.lower() for k in out)
+    canonical_headers = "".join(
+        f"{name}:{str(out[next(k for k in out if k.lower() == name)]).strip()}\n"
+        for name in signed_names
+    )
+    signed_headers = ";".join(signed_names)
+    canonical_request = "\n".join([
+        method, canonical_uri, canonical_query, canonical_headers,
+        signed_headers, payload_hash,
+    ])
+    scope = f"{datestamp}/{region}/{service}/aws4_request"
+    string_to_sign = "\n".join([
+        "AWS4-HMAC-SHA256", amz_date, scope, _sha256(canonical_request.encode()),
+    ])
+    k = _hmac(("AWS4" + secret_key).encode(), datestamp)
+    k = _hmac(k, region)
+    k = _hmac(k, service)
+    k = _hmac(k, "aws4_request")
+    signature = hmac.new(k, string_to_sign.encode(), hashlib.sha256).hexdigest()
+    out["Authorization"] = (
+        f"AWS4-HMAC-SHA256 Credential={access_key}/{scope}, "
+        f"SignedHeaders={signed_headers}, Signature={signature}"
+    )
+    return out
+
+
+class S3BlobStore:
+    """put/get/list/delete blobstore surface over an S3 bucket."""
+
+    def __init__(
+        self,
+        endpoint: str,
+        bucket: str,
+        access_key: str,
+        secret_key: str,
+        region: str = "us-east-1",
+        prefix: str = "",
+        timeout_s: float = 30.0,
+    ) -> None:
+        self.endpoint = endpoint.rstrip("/")
+        self.bucket = bucket
+        self.access_key = access_key
+        self.secret_key = secret_key
+        self.region = region
+        self.prefix = prefix
+        self.timeout_s = timeout_s
+
+    def _url(self, key: str = "", query: str = "") -> str:
+        path = f"/{self.bucket}"
+        if key:
+            path += "/" + urllib.parse.quote(self.prefix + key, safe="/")
+        return self.endpoint + path + (f"?{query}" if query else "")
+
+    def _request(self, method: str, url: str, payload: bytes = b"",
+                 headers: Optional[dict] = None):
+        headers = sign_v4(method, url, headers or {}, payload,
+                          self.access_key, self.secret_key, self.region)
+        req = urllib.request.Request(url, data=payload or None, method=method,
+                                     headers=headers)
+        try:
+            return urllib.request.urlopen(req, timeout=self.timeout_s)
+        except urllib.error.HTTPError as e:
+            if e.code == 404:
+                return None
+            raise S3Error(f"{method} {url}: HTTP {e.code} {e.read()[:200]!r}",
+                          e.code) from e
+        except urllib.error.URLError as e:
+            raise S3Error(f"{method} {url}: {e.reason}") from e
+
+    # -- blobstore surface --------------------------------------------
+
+    def put(self, key: str, data: bytes) -> None:
+        resp = self._request(
+            "PUT", self._url(key), data,
+            {"content-type": "application/octet-stream"},
+        )
+        if resp is None:
+            raise S3Error(f"put {key}: bucket not found", 404)
+        resp.read()
+
+    def get(self, key: str) -> Optional[bytes]:
+        resp = self._request("GET", self._url(key))
+        return None if resp is None else resp.read()
+
+    def head(self, key: str) -> bool:
+        return self._request("HEAD", self._url(key)) is not None
+
+    def delete(self, key: str) -> bool:
+        existed = self.head(key)
+        resp = self._request("DELETE", self._url(key))
+        if resp is not None:
+            resp.read()
+        return existed
+
+    def list(self, prefix: str = "") -> list[str]:
+        import re as _re
+
+        keys: list[str] = []
+        token = ""
+        full_prefix = self.prefix + prefix
+        while True:
+            query = "list-type=2&prefix=" + urllib.parse.quote(full_prefix, safe="")
+            if token:
+                query += "&continuation-token=" + urllib.parse.quote(token, safe="")
+            resp = self._request("GET", self._url(query=query))
+            if resp is None:
+                return keys
+            from xml.sax.saxutils import unescape as _xml_unescape
+
+            body = resp.read().decode()
+            keys += [
+                _xml_unescape(k)[len(self.prefix):]
+                for k in _re.findall(r"<Key>([^<]*)</Key>", body)
+            ]
+            m = _re.search(r"<NextContinuationToken>([^<]*)</NextContinuationToken>", body)
+            if not m:
+                return sorted(keys)
+            token = m.group(1)

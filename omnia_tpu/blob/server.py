@@ -1,0 +1,170 @@
+"""In-tree S3-protocol server.
+
+The moto/minio role for object-storage tests: path-style PutObject /
+GetObject / HeadObject / DeleteObject / ListObjectsV2 with real SigV4
+verification (same canonicalization as the client — a signature
+mismatch is a 403, so the client's signing is actually exercised).
+Storage is in-memory per bucket.
+"""
+
+from __future__ import annotations
+
+import re
+import threading
+import urllib.parse
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from omnia_tpu.blob.client import sign_v4
+
+
+class S3Server:
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 access_key: str = "test-access", secret_key: str = "test-secret",
+                 region: str = "us-east-1") -> None:
+        self._host, self._port = host, port
+        self.access_key = access_key
+        self.secret_key = secret_key
+        self.region = region
+        self._buckets: dict[str, dict[str, bytes]] = {}
+        self._lock = threading.Lock()
+        self._httpd: Optional[ThreadingHTTPServer] = None
+
+    def create_bucket(self, name: str) -> None:
+        with self._lock:
+            self._buckets.setdefault(name, {})
+
+    @property
+    def address(self) -> tuple[str, int]:
+        return self._host, self._port
+
+    @property
+    def endpoint(self) -> str:
+        return f"http://{self._host}:{self._port}"
+
+    # -- auth ----------------------------------------------------------
+
+    def _verify(self, method: str, path: str, query: str, headers,
+                payload: bytes) -> bool:
+        auth = headers.get("Authorization", "")
+        m = re.match(
+            r"AWS4-HMAC-SHA256 Credential=([^/]+)/(\d+)/([^/]+)/s3/aws4_request,"
+            r" SignedHeaders=([^,]+), Signature=([0-9a-f]+)",
+            auth,
+        )
+        if not m or m.group(1) != self.access_key:
+            return False
+        signed_names = m.group(4).split(";")
+        # Re-sign with OUR secret using the request's own date and signed
+        # headers; equal signatures prove the client holds the secret.
+        import datetime
+
+        try:
+            when = datetime.datetime.strptime(
+                headers.get("x-amz-date", ""), "%Y%m%dT%H%M%SZ"
+            ).replace(tzinfo=datetime.timezone.utc)
+        except ValueError:
+            return False
+        base = {
+            name: headers.get(name, "")
+            for name in signed_names
+            if name not in ("host", "x-amz-date", "x-amz-content-sha256")
+        }
+        url = f"http://{headers.get('host', '')}{path}" + (f"?{query}" if query else "")
+        expect = sign_v4(
+            method, url, base, payload, self.access_key, self.secret_key,
+            self.region, now=when,
+        )["Authorization"]
+        got_sig = m.group(5)
+        want = re.search(r"Signature=([0-9a-f]+)", expect).group(1)
+        import hmac as hmac_mod
+
+        return hmac_mod.compare_digest(got_sig, want)
+
+    # -- lifecycle -----------------------------------------------------
+
+    def start(self) -> "S3Server":
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def _go(self, method: str):
+                split = urllib.parse.urlsplit(self.path)
+                length = int(self.headers.get("Content-Length") or 0)
+                payload = self.rfile.read(length) if length else b""
+                status, body, extra = outer.handle(
+                    method, split.path, split.query, self.headers, payload)
+                self.send_response(status)
+                for k, v in (extra or {}).items():
+                    self.send_header(k, v)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                if method != "HEAD":
+                    self.wfile.write(body)
+
+            def do_GET(self):
+                self._go("GET")
+
+            def do_PUT(self):
+                self._go("PUT")
+
+            def do_HEAD(self):
+                self._go("HEAD")
+
+            def do_DELETE(self):
+                self._go("DELETE")
+
+            def log_message(self, *a):  # pragma: no cover
+                pass
+
+        self._httpd = ThreadingHTTPServer((self._host, self._port), Handler)
+        self._port = self._httpd.server_address[1]
+        threading.Thread(
+            target=self._httpd.serve_forever, name="omnia-s3d", daemon=True
+        ).start()
+        return self
+
+    def stop(self) -> None:
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None
+
+    # -- request handling ---------------------------------------------
+
+    def handle(self, method, path, query, headers, payload):
+        if not self._verify(method, path, query, headers, payload):
+            return 403, b"<Error><Code>SignatureDoesNotMatch</Code></Error>", {}
+        parts = path.lstrip("/").split("/", 1)
+        bucket = urllib.parse.unquote(parts[0])
+        key = urllib.parse.unquote(parts[1]) if len(parts) > 1 else ""
+        with self._lock:
+            blobs = self._buckets.get(bucket)
+            if blobs is None:
+                return 404, b"<Error><Code>NoSuchBucket</Code></Error>", {}
+            if method == "GET" and not key:
+                q = urllib.parse.parse_qs(query)
+                prefix = (q.get("prefix") or [""])[0]
+                keys = sorted(k for k in blobs if k.startswith(prefix))
+                items = "".join(f"<Contents><Key>{k}</Key></Contents>" for k in keys)
+                body = (
+                    "<?xml version=\"1.0\"?><ListBucketResult>"
+                    f"<IsTruncated>false</IsTruncated>{items}</ListBucketResult>"
+                ).encode()
+                return 200, body, {"Content-Type": "application/xml"}
+            if method == "PUT" and key:
+                blobs[key] = payload
+                return 200, b"", {"ETag": '"etag"'}
+            if method in ("GET", "HEAD") and key:
+                data = blobs.get(key)
+                if data is None:
+                    return 404, b"<Error><Code>NoSuchKey</Code></Error>", {}
+                return 200, (b"" if method == "HEAD" else data), {
+                    "Content-Type": "application/octet-stream",
+                    **({"Content-Length": str(len(data))} if method == "HEAD" else {}),
+                }
+            if method == "DELETE" and key:
+                blobs.pop(key, None)
+                return 204, b"", {}
+        return 400, b"<Error><Code>BadRequest</Code></Error>", {}

@@ -82,7 +82,10 @@ def _pg_warm():
             password = parts[3] if len(parts) > 3 else None
             host, _, p = hostport.partition(":")
             host = host or "127.0.0.1"
-            port = int(p) if p else 5432
+            try:
+                port = int(p) if p else 5432
+            except ValueError:
+                host = None  # falls into the not-understood error below
     if not (host and user and db):
         raise SystemExit(
             f"OMNIA_PG_DSN {dsn!r} not understood; use "
@@ -91,6 +94,28 @@ def _pg_warm():
         )
     return PgWarmStore(PGClient(host, port, user=user, database=db,
                                 password=password))
+
+
+def _cold_store():
+    """Cold tier from env: OMNIA_S3_ENDPOINT/BUCKET/ACCESS_KEY/SECRET_KEY
+    (object storage), else OMNIA_COLD_DIR (local)."""
+    if _env("OMNIA_S3_ENDPOINT"):
+        from omnia_tpu.blob import S3BlobStore
+        from omnia_tpu.session.cold import ColdArchive
+
+        return ColdArchive(S3BlobStore(
+            _require("OMNIA_S3_ENDPOINT"),
+            _require("OMNIA_S3_BUCKET"),
+            _require("OMNIA_S3_ACCESS_KEY"),
+            _require("OMNIA_S3_SECRET_KEY"),
+            region=_env("OMNIA_S3_REGION", "us-east-1"),
+            prefix=_env("OMNIA_S3_PREFIX", ""),
+        ))
+    if _env("OMNIA_COLD_DIR"):
+        from omnia_tpu.session.cold import ColdArchive, LocalBlobStore
+
+        return ColdArchive(LocalBlobStore(_env("OMNIA_COLD_DIR")))
+    return None
 
 
 def _wait_forever() -> None:
@@ -260,10 +285,9 @@ def session_api_main() -> int:
         from omnia_tpu.session.warm import WarmStore
 
         kw["warm"] = WarmStore(_env("OMNIA_WARM_DB"))
-    if _env("OMNIA_COLD_DIR"):
-        from omnia_tpu.session.cold import ColdArchive, LocalBlobStore
-
-        kw["cold"] = ColdArchive(LocalBlobStore(_env("OMNIA_COLD_DIR")))
+    cold = _cold_store()
+    if cold is not None:
+        kw["cold"] = cold
     store = TieredStore(hot=hot, **kw) if (hot or kw) else TieredStore()
     api = SessionAPI(store=store, events=events or Stream())
     port = api.serve(host="0.0.0.0", port=int(_env("OMNIA_HTTP_PORT", "8300")))
@@ -380,10 +404,9 @@ def compaction_main() -> int:
         from omnia_tpu.session.warm import WarmStore
 
         kw["warm"] = WarmStore(_env("OMNIA_WARM_DB"))
-    if _env("OMNIA_COLD_DIR"):
-        from omnia_tpu.session.cold import ColdArchive, LocalBlobStore
-
-        kw["cold"] = ColdArchive(LocalBlobStore(_env("OMNIA_COLD_DIR")))
+    cold = _cold_store()
+    if cold is not None:
+        kw["cold"] = cold
     store = TieredStore(**kw)
     engine = CompactionEngine(store)
     report = engine.run_once()

@@ -44,18 +44,32 @@ _CAST = re.compile(r"::[a-zA-Z_ ]+")
 _ESTR = re.compile(r"E'((?:[^']|'')*)'")
 
 
+_ANY_STR = re.compile(r"E'((?:[^']|'')*)'|'((?:[^']|'')*)'")
+
+
 def translate(sql: str) -> str:
+    """Rewrites apply ONLY outside string literals — a stored value that
+    happens to contain '::text' or 'BIGINT' is data, not SQL, and must
+    round-trip byte-identical."""
+    literals: list[str] = []
+
+    def stash(m: re.Match) -> str:
+        if m.group(1) is not None:  # E'...': unescape backslashes
+            body = m.group(1)
+            body = body.replace("\\\\", "\x00ESCBS\x00").replace("\\'", "''")
+            body = body.replace("\x00ESCBS\x00", "\\")
+        else:
+            body = m.group(2)
+        literals.append("'" + body + "'")
+        return f"\x00LIT{len(literals) - 1}\x00"
+
+    sql = _ANY_STR.sub(stash, sql)
     for pat, repl in _TYPE_MAP:
         sql = pat.sub(repl, sql)
     sql = _CAST.sub("", sql)
-
-    def unescape(m: re.Match) -> str:
-        body = m.group(1)
-        body = body.replace("\\\\", "\x00ESCBS\x00").replace("\\'", "''")
-        body = body.replace("\x00ESCBS\x00", "\\")
-        return "'" + body + "'"
-
-    return _ESTR.sub(unescape, sql)
+    for i, lit in enumerate(literals):
+        sql = sql.replace(f"\x00LIT{i}\x00", lit)
+    return sql
 
 
 class PGServer:

@@ -170,14 +170,22 @@ class PgWarmStore:
 
     def append_provider_call(self, rec: ProviderCallRecord) -> None:
         with self._lock:
-            dup = bool(self.client.query(
-                "SELECT 1 AS x FROM records WHERE record_id=$1",
-                [rec.record_id],
-            ))
-            self._append(
-                "provider_call", rec.session_id, rec.created_at, rec.__dict__)
-            if dup:
-                return  # usage increments must not double-count
+            # DO NOTHING + RETURNING: a row comes back only when THIS call
+            # inserted the record — the database itself decides the dup,
+            # so concurrent redelivery across replicas cannot double-count
+            # usage (works identically on PG and the SQLite double).
+            body = rec.__dict__
+            inserted = self.client.query(
+                """INSERT INTO records
+                   (record_id, kind, session_id, day, created_at, body)
+                   VALUES ($1,'provider_call',$2,$3,$4,$5)
+                   ON CONFLICT(record_id) DO NOTHING
+                   RETURNING record_id""",
+                [rec.record_id, rec.session_id, _day(rec.created_at),
+                 rec.created_at, body],
+            )
+            if not inserted:
+                return  # duplicate: usage increments must not double-count
             ws_rows = self.client.query(
                 "SELECT workspace FROM sessions WHERE session_id=$1",
                 [rec.session_id],

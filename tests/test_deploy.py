@@ -270,3 +270,30 @@ class TestCLIWiring:
         finally:
             api.shutdown()
             srv.stop()
+
+
+class TestExamples:
+    """The shipped examples must actually load and reconcile (an example
+    that drifts from the schema is worse than none)."""
+
+    def test_example_devroots_reconcile(self):
+        from omnia_tpu.operator.controller import ControllerManager
+        from omnia_tpu.operator.resources import Resource
+        from omnia_tpu.operator.store import MemoryResourceStore
+
+        for example, agent_kinds in (
+            ("examples/custom-runtime/devroot/agent.yaml", "agent"),
+            ("examples/echo-function/function.yaml", "function"),
+        ):
+            store = MemoryResourceStore()
+            mgr = ControllerManager(store)  # before apply: watch fires
+            try:
+                with open(os.path.join(REPO, example)) as f:
+                    for doc in yaml.safe_load_all(f):
+                        store.apply(Resource.from_manifest(doc))  # admission
+                mgr.drain_queue()
+                ar = store.list(kind="AgentRuntime")[0]
+                assert ar.status.get("phase") == "Running", (example, ar.status)
+                assert ar.spec["mode"] == agent_kinds
+            finally:
+                mgr.shutdown()
