@@ -1,0 +1,261 @@
+"""Arena dev console: interactive scenario testing against live agents.
+
+Reference ee/cmd/arena-dev-console (the dashboard's "try this scenario"
+backend): a service that opens a real WS connection to an agent facade,
+plays scenario turns through it, evaluates the checks inline, and keeps
+the session open so a developer can continue hand-driving turns — the
+interactive complement to batch ArenaJobs.
+
+HTTP surface (JSON):
+  POST /api/v1/dev-sessions               {endpoint[, session]} → {id}
+  POST /api/v1/dev-sessions/<id>/turn     {content[, checks]}   → turn result
+  POST /api/v1/dev-sessions/<id>/scenario {scenario}            → per-turn results
+  GET  /api/v1/dev-sessions/<id>          transcript + results so far
+  DELETE /api/v1/dev-sessions/<id>        hang up
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+import urllib.parse
+import uuid
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from omnia_tpu.evals.defs import Check, EvalScenario
+
+
+class DevSession:
+    """One live WS conversation with an agent, driven turn by turn."""
+
+    def __init__(self, endpoint: str, session_id: str = "",
+                 connect_timeout_s: float = 15.0) -> None:
+        from websockets.sync.client import connect
+
+        url = endpoint
+        if session_id:
+            sep = "&" if "?" in url else "?"
+            url += f"{sep}session={urllib.parse.quote(session_id)}"
+        self.ws = connect(url, open_timeout=connect_timeout_s)
+        try:
+            hello = json.loads(self.ws.recv(timeout=connect_timeout_s))
+            if hello.get("type") != "connected":
+                raise RuntimeError(f"agent did not say connected: {hello}")
+        except BaseException:
+            self.ws.close()  # a failed handshake must not leak the socket
+            raise
+        self.agent = hello.get("agent", "")
+        self.session_id = hello.get("session_id", "")
+        self.transcript: list[dict] = []
+        self.results: list[dict] = []
+        self._lock = threading.Lock()
+
+    def turn(self, content: str, checks: Optional[list[Check]] = None,
+             timeout_s: float = 120.0) -> dict:
+        with self._lock:
+            t0 = time.monotonic()
+            self.ws.send(json.dumps({"type": "message", "content": content}))
+            text = ""
+            usage: dict = {}
+            error = None
+            deadline = time.monotonic() + timeout_s
+            while time.monotonic() < deadline:
+                try:
+                    msg = json.loads(
+                        self.ws.recv(timeout=max(0.0, deadline - time.monotonic())))
+                except TimeoutError:
+                    error = "turn timeout"
+                    break
+                if msg["type"] == "chunk":
+                    text += msg["text"]
+                elif msg["type"] == "tool_call":
+                    # Dev console auto-acks client tools with an empty
+                    # result so scenarios exercising them don't stall.
+                    self.ws.send(json.dumps({
+                        "type": "tool_result",
+                        "tool_call_id": msg["id"],
+                        "content": "{}",
+                    }))
+                elif msg["type"] == "done":
+                    usage = msg.get("usage", {})
+                    break
+                elif msg["type"] == "error":
+                    error = msg.get("message", "turn error")
+                    break
+            else:
+                error = "turn timeout"
+            latency = time.monotonic() - t0
+            check_results = [
+                {"kind": c.kind, "value": c.value,
+                 # judge checks need the batch judge; None = unevaluated
+                 "passed": c.evaluate_sync(text, latency)}
+                for c in (checks or [])
+            ]
+            result = {
+                "user": content,
+                "assistant": text,
+                "latency_s": round(latency, 3),
+                "usage": usage,
+                "error": error,
+                "checks": check_results,
+                # Unevaluated (None) does NOT pass — a green result must
+                # mean every check actually ran and held.
+                "passed": error is None and all(
+                    c["passed"] is True for c in check_results),
+            }
+            self.transcript.append(result)
+            return result
+
+    def run_scenario(self, scenario: EvalScenario) -> dict:
+        turns = [
+            self.turn(t.user, checks=t.checks) for t in scenario.turns
+        ]
+        passed = all(t["passed"] for t in turns)
+        summary = {"scenario": scenario.name, "passed": passed, "turns": turns}
+        self.results.append(summary)
+        return summary
+
+    def close(self) -> None:
+        try:
+            self.ws.send(json.dumps({"type": "hangup"}))
+        except Exception:
+            pass
+        try:
+            self.ws.close()
+        except Exception:
+            pass
+
+
+class DevConsole:
+    """The service: session registry + HTTP surface."""
+
+    def __init__(self, license_manager=None) -> None:
+        from omnia_tpu.license import CommunityLicenseManager
+
+        self.license = license_manager or CommunityLicenseManager()
+        self._sessions: dict[str, DevSession] = {}
+        self._lock = threading.Lock()
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self.port: Optional[int] = None
+
+    # -- operations ----------------------------------------------------
+
+    def create(self, endpoint: str, session_id: str = "") -> str:
+        self.license.require("arena")
+        ds = DevSession(endpoint, session_id)
+        sid = uuid.uuid4().hex[:12]
+        with self._lock:
+            self._sessions[sid] = ds
+        return sid
+
+    def get(self, sid: str) -> Optional[DevSession]:
+        with self._lock:
+            return self._sessions.get(sid)
+
+    def delete(self, sid: str) -> bool:
+        with self._lock:
+            ds = self._sessions.pop(sid, None)
+        if ds is None:
+            return False
+        ds.close()
+        return True
+
+    def shutdown(self) -> None:
+        with self._lock:
+            sessions, self._sessions = list(self._sessions.values()), {}
+        for ds in sessions:
+            ds.close()
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None
+
+    # -- http ----------------------------------------------------------
+
+    def handle(self, method: str, path: str, body: Optional[dict]):
+        from omnia_tpu.license import LicenseError
+
+        body = body or {}
+        try:
+            if path == "/api/v1/dev-sessions" and method == "POST":
+                if not body.get("endpoint"):
+                    return 400, {"error": "endpoint required"}
+                sid = self.create(body["endpoint"], body.get("session", ""))
+                ds = self.get(sid)
+                return 200, {"id": sid, "agent": ds.agent,
+                             "session_id": ds.session_id}
+            if path.startswith("/api/v1/dev-sessions/"):
+                rest = path[len("/api/v1/dev-sessions/"):]
+                sid, _, action = rest.partition("/")
+                ds = self.get(sid)
+                if ds is None:
+                    return 404, {"error": "no such dev session"}
+                if method == "GET" and not action:
+                    return 200, {"id": sid, "agent": ds.agent,
+                                 "transcript": ds.transcript,
+                                 "results": ds.results}
+                if method == "DELETE" and not action:
+                    self.delete(sid)
+                    return 200, {"deleted": True}
+                if method == "POST" and action == "turn":
+                    if not body.get("content"):
+                        return 400, {"error": "content required"}
+                    checks = [Check.from_dict(c) for c in body.get("checks", [])]
+                    return 200, ds.turn(body["content"], checks=checks)
+                if method == "POST" and action == "scenario":
+                    if not body.get("scenario"):
+                        return 400, {"error": "scenario required"}
+                    scenario = EvalScenario.from_dict(body["scenario"])
+                    return 200, ds.run_scenario(scenario)
+            return 404, {"error": f"no route {method} {path}"}
+        except LicenseError as e:
+            return 402, {"error": str(e)}
+        except Exception as e:
+            return 502, {"error": str(e)}
+
+    def serve(self, host: str = "localhost", port: int = 0) -> int:
+        console = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def _go(self, method):
+                split = urllib.parse.urlsplit(self.path)
+                length = int(self.headers.get("Content-Length") or 0)
+                body = None
+                if length:
+                    try:
+                        body = json.loads(self.rfile.read(length))
+                    except json.JSONDecodeError:
+                        self._reply(400, {"error": "bad json"})
+                        return
+                status, doc = console.handle(method, split.path, body)
+                self._reply(status, doc)
+
+            def _reply(self, status, doc):
+                payload = json.dumps(doc).encode()
+                self.send_response(status)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(payload)))
+                self.end_headers()
+                self.wfile.write(payload)
+
+            def do_GET(self):
+                self._go("GET")
+
+            def do_POST(self):
+                self._go("POST")
+
+            def do_DELETE(self):
+                self._go("DELETE")
+
+            def log_message(self, *a):  # pragma: no cover
+                pass
+
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self.port = self._httpd.server_address[1]
+        threading.Thread(
+            target=self._httpd.serve_forever, name="omnia-dev-console",
+            daemon=True,
+        ).start()
+        return self.port

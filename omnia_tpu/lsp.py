@@ -1,0 +1,252 @@
+"""PromptPack language server (LSP over stdio).
+
+Reference ee/cmd/promptkit-lsp (the dashboard editor's language server):
+live diagnostics, completion, and hover for compiled pack JSON. Speaks
+the Language Server Protocol's base JSON-RPC framing (Content-Length
+headers) so any LSP-capable editor — and the dashboard's pack editor —
+can attach.
+
+Capabilities:
+- diagnostics on open/change: JSON parse errors (positioned), the pack
+  schema validator's errors (`runtime/packs.validate_pack`, positioned at
+  the offending key when findable), undeclared `{{param}}` references.
+- completion: `{{` inside prompt strings completes declared params;
+  top-level key completion from the pack schema.
+- hover: param occurrences show their declared type/default/required.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import sys
+from typing import Optional
+
+from omnia_tpu.runtime.packs import PACK_SCHEMA, validate_pack
+from omnia_tpu.runtime.packs import _VAR_RE as _VAR  # one regex, one truth
+
+_VAR_OPEN = re.compile(r"\{\{\s*(\w+)?$")
+
+
+# ---------------------------------------------------------------------------
+# document analysis
+# ---------------------------------------------------------------------------
+
+
+def _pos(text: str, offset: int) -> dict:
+    line = text.count("\n", 0, offset)
+    col = offset - (text.rfind("\n", 0, offset) + 1)
+    return {"line": line, "character": col}
+
+
+def _find_key(text: str, key: str) -> Optional[tuple[int, int]]:
+    """Byte range of the LAST path segment's key token, best-effort."""
+    m = re.search(r'"%s"\s*:' % re.escape(key), text)
+    return (m.start(), m.start() + len(key) + 2) if m else None
+
+
+def diagnostics(text: str) -> list[dict]:
+    """LSP Diagnostic list for one pack document."""
+    try:
+        doc = json.loads(text)
+    except json.JSONDecodeError as e:
+        return [{
+            "range": {"start": {"line": e.lineno - 1, "character": e.colno - 1},
+                      "end": {"line": e.lineno - 1, "character": e.colno}},
+            "severity": 1,
+            "source": "omnia-pack",
+            "message": f"JSON: {e.msg}",
+        }]
+    if not isinstance(doc, dict):
+        return [{
+            "range": {"start": {"line": 0, "character": 0},
+                      "end": {"line": 0, "character": 1}},
+            "severity": 1, "source": "omnia-pack",
+            "message": "pack must be a JSON object",
+        }]
+    out = []
+    for err in validate_pack(doc):
+        path, _, message = err.partition(": ")
+        anchor = None
+        # Position at the deepest named path segment we can find.
+        for seg in reversed(path.split("/")):
+            if seg and not seg.isdigit() and seg != "<root>":
+                anchor = _find_key(text, seg)
+                if anchor:
+                    break
+        start = _pos(text, anchor[0]) if anchor else {"line": 0, "character": 0}
+        end = _pos(text, anchor[1]) if anchor else {"line": 0, "character": 1}
+        out.append({
+            "range": {"start": start, "end": end},
+            "severity": 1,
+            "source": "omnia-pack",
+            "message": err,
+        })
+    return out
+
+
+def _offset(text: str, line: int, character: int) -> int:
+    lines = text.split("\n")
+    return sum(len(ln) + 1 for ln in lines[:line]) + character
+
+
+def completions(text: str, line: int, character: int) -> list[dict]:
+    off = _offset(text, line, character)
+    before = text[:off]
+    try:
+        doc = json.loads(text)
+        params = doc.get("params", {}) if isinstance(doc, dict) else {}
+    except json.JSONDecodeError:
+        # Mid-edit invalid JSON: no param completion (crashing the server
+        # on a trailing comma would kill every editor feature).
+        params = {}
+    if _VAR_OPEN.search(before.split('"')[-1] if '"' in before else before):
+        return [
+            {"label": name, "kind": 6,  # Variable
+             "detail": f"pack param ({(spec or {}).get('type', 'string')})",
+             "insertText": name}
+            for name, spec in (params or {}).items()
+        ]
+    # top-level keys from the schema
+    props = PACK_SCHEMA.get("properties", {})
+    return [
+        {"label": k, "kind": 5,  # Field
+         "detail": (v.get("type") or "object") if isinstance(v, dict) else "",
+         "insertText": f'"{k}": '}
+        for k, v in props.items()
+    ]
+
+
+def hover(text: str, line: int, character: int) -> Optional[dict]:
+    off = _offset(text, line, character)
+    try:
+        doc = json.loads(text)
+    except json.JSONDecodeError:
+        return None
+    for m in _VAR.finditer(text):
+        if m.start() <= off <= m.end():
+            name = m.group(1)
+            spec = (doc.get("params") or {}).get(name)
+            if spec is None:
+                value = f"`{name}` — **undeclared** pack param"
+            else:
+                bits = [f"`{name}`: {spec.get('type', 'string')}"]
+                if "default" in spec:
+                    bits.append(f"default `{spec['default']!r}`")
+                if spec.get("required"):
+                    bits.append("required")
+                value = " · ".join(bits)
+            return {
+                "contents": {"kind": "markdown", "value": value},
+                "range": {"start": _pos(text, m.start()),
+                          "end": _pos(text, m.end())},
+            }
+    return None
+
+
+# ---------------------------------------------------------------------------
+# JSON-RPC / LSP plumbing
+# ---------------------------------------------------------------------------
+
+
+class PackLanguageServer:
+    """Transport-agnostic LSP endpoint: handle(message) → responses +
+    notifications to emit. The stdio main loop (and tests) feed it."""
+
+    def __init__(self) -> None:
+        self.docs: dict[str, str] = {}
+        self.shutdown_requested = False
+        self.exited = False
+
+    def handle(self, msg: dict) -> list[dict]:
+        method = msg.get("method", "")
+        mid = msg.get("id")
+        params = msg.get("params") or {}
+        if method == "initialize":
+            return [self._result(mid, {
+                "capabilities": {
+                    "textDocumentSync": 1,  # full
+                    "completionProvider": {"triggerCharacters": ["{", '"']},
+                    "hoverProvider": True,
+                },
+                "serverInfo": {"name": "omnia-pack-lsp", "version": "1.0"},
+            })]
+        if method == "shutdown":
+            self.shutdown_requested = True
+            return [self._result(mid, None)]
+        if method == "exit":
+            self.exited = True
+            return []
+        if method in ("textDocument/didOpen", "textDocument/didChange"):
+            td = params["textDocument"]
+            uri = td["uri"]
+            if method == "textDocument/didOpen":
+                text = td["text"]
+            else:
+                text = params["contentChanges"][-1]["text"]
+            self.docs[uri] = text
+            return [{
+                "jsonrpc": "2.0",
+                "method": "textDocument/publishDiagnostics",
+                "params": {"uri": uri, "diagnostics": diagnostics(text)},
+            }]
+        if method == "textDocument/didClose":
+            self.docs.pop(params["textDocument"]["uri"], None)
+            return []
+        if method == "textDocument/completion":
+            text = self.docs.get(params["textDocument"]["uri"], "")
+            pos = params["position"]
+            return [self._result(
+                mid, completions(text, pos["line"], pos["character"]))]
+        if method == "textDocument/hover":
+            text = self.docs.get(params["textDocument"]["uri"], "")
+            pos = params["position"]
+            return [self._result(
+                mid, hover(text, pos["line"], pos["character"]))]
+        if mid is not None:  # unknown request → MethodNotFound
+            return [{
+                "jsonrpc": "2.0", "id": mid,
+                "error": {"code": -32601, "message": f"unknown method {method}"},
+            }]
+        return []  # unknown notification: ignore
+
+    @staticmethod
+    def _result(mid, result) -> dict:
+        return {"jsonrpc": "2.0", "id": mid, "result": result}
+
+
+def read_lsp_message(stream) -> Optional[dict]:
+    """Content-Length framed JSON-RPC (the LSP base protocol)."""
+    length = None
+    while True:
+        line = stream.readline()
+        if not line:
+            return None
+        line = line.strip()
+        if not line:
+            break
+        if line.lower().startswith(b"content-length:"):
+            length = int(line.split(b":", 1)[1])
+    if length is None:
+        return None
+    return json.loads(stream.read(length))
+
+
+def write_lsp_message(stream, msg: dict) -> None:
+    payload = json.dumps(msg).encode()
+    stream.write(b"Content-Length: %d\r\n\r\n" % len(payload) + payload)
+    stream.flush()
+
+
+def lsp_main() -> int:
+    """`omnia-pack-lsp`: serve LSP over stdio."""
+    server = PackLanguageServer()
+    stdin = sys.stdin.buffer
+    stdout = sys.stdout.buffer
+    while not server.exited:
+        msg = read_lsp_message(stdin)
+        if msg is None:
+            break
+        for reply in server.handle(msg):
+            write_lsp_message(stdout, reply)
+    return 0

@@ -1,0 +1,237 @@
+"""Developer-tooling tests: the pack language server (reference
+ee/cmd/promptkit-lsp) driven through real LSP framing, and the arena dev
+console (reference ee/cmd/arena-dev-console) against a live agent."""
+
+import io
+import json
+
+import pytest
+
+from omnia_tpu.lsp import (
+    PackLanguageServer,
+    diagnostics,
+    read_lsp_message,
+    write_lsp_message,
+)
+
+GOOD_PACK = json.dumps({
+    "name": "p", "version": "1.0.0",
+    "prompts": {"system": "You are {{persona}}."},
+    "params": {"persona": {"type": "string", "default": "helpful"}},
+    "sampling": {"temperature": 0.0, "max_tokens": 64},
+}, indent=2)
+
+
+class TestDiagnostics:
+    def test_valid_pack_clean(self):
+        assert diagnostics(GOOD_PACK) == []
+
+    def test_json_error_positioned(self):
+        out = diagnostics('{\n  "name": "p",\n  broken\n}')
+        assert len(out) == 1
+        assert out[0]["range"]["start"]["line"] == 2
+        assert "JSON" in out[0]["message"]
+
+    def test_schema_error_positioned_at_key(self):
+        bad = json.dumps({
+            "name": "p", "version": "1.0.0",
+            "prompts": {"system": "hi"},
+            "sampling": {"temperature": "hot"},
+        }, indent=2)
+        out = diagnostics(bad)
+        assert out, "expected schema diagnostics"
+        assert any("temperature" in d["message"] for d in out)
+        d = next(d for d in out if "temperature" in d["message"])
+        line = bad.split("\n")[d["range"]["start"]["line"]]
+        assert "temperature" in line  # anchored at the offending key
+
+    def test_undeclared_param_flagged(self):
+        bad = json.dumps({
+            "name": "p", "version": "1.0.0",
+            "prompts": {"system": "You are {{nobody}}."},
+        })
+        out = diagnostics(bad)
+        assert any("undeclared param" in d["message"] for d in out)
+
+
+class TestServerProtocol:
+    def _rpc(self, server, method, mid=None, **params):
+        return server.handle({
+            "jsonrpc": "2.0", "method": method,
+            **({"id": mid} if mid is not None else {}),
+            "params": params,
+        })
+
+    def test_lifecycle_and_diagnostics_flow(self):
+        s = PackLanguageServer()
+        (init,) = self._rpc(s, "initialize", mid=1)
+        assert init["result"]["capabilities"]["hoverProvider"]
+        (diag,) = self._rpc(
+            s, "textDocument/didOpen",
+            textDocument={"uri": "file:///p.json", "text": GOOD_PACK})
+        assert diag["method"] == "textDocument/publishDiagnostics"
+        assert diag["params"]["diagnostics"] == []
+        # break it: diagnostics republish
+        (diag2,) = self._rpc(
+            s, "textDocument/didChange",
+            textDocument={"uri": "file:///p.json"},
+            contentChanges=[{"text": GOOD_PACK.replace("persona}", "ghost}")}])
+        assert diag2["params"]["diagnostics"]
+        (bye,) = self._rpc(s, "shutdown", mid=2)
+        assert bye["result"] is None
+        assert self._rpc(s, "exit") == []
+        assert s.exited
+
+    def test_completion_of_params_inside_braces(self):
+        s = PackLanguageServer()
+        text = GOOD_PACK.replace("{{persona}}", "{{")
+        self._rpc(s, "textDocument/didOpen",
+                  textDocument={"uri": "u", "text": text})
+        line_no = next(i for i, l in enumerate(text.split("\n")) if "{{" in l)
+        col = text.split("\n")[line_no].index("{{") + 2
+        (resp,) = self._rpc(s, "textDocument/completion", mid=3,
+                            textDocument={"uri": "u"},
+                            position={"line": line_no, "character": col})
+        labels = [c["label"] for c in resp["result"]]
+        assert "persona" in labels
+
+    def test_hover_shows_param_spec(self):
+        s = PackLanguageServer()
+        self._rpc(s, "textDocument/didOpen",
+                  textDocument={"uri": "u", "text": GOOD_PACK})
+        line_no = next(i for i, l in enumerate(GOOD_PACK.split("\n"))
+                       if "{{persona}}" in l)
+        col = GOOD_PACK.split("\n")[line_no].index("persona") + 2
+        (resp,) = self._rpc(s, "textDocument/hover", mid=4,
+                            textDocument={"uri": "u"},
+                            position={"line": line_no, "character": col})
+        assert "persona" in resp["result"]["contents"]["value"]
+        assert "default" in resp["result"]["contents"]["value"]
+
+    def test_unknown_request_is_method_not_found(self):
+        s = PackLanguageServer()
+        (resp,) = self._rpc(s, "workspace/executeCommand", mid=9)
+        assert resp["error"]["code"] == -32601
+
+    def test_framing_round_trip(self):
+        buf = io.BytesIO()
+        write_lsp_message(buf, {"jsonrpc": "2.0", "id": 1, "method": "initialize"})
+        buf.seek(0)
+        assert read_lsp_message(buf) == {
+            "jsonrpc": "2.0", "id": 1, "method": "initialize"}
+        assert read_lsp_message(io.BytesIO(b"")) is None
+
+
+# ---------------------------------------------------------------------------
+# dev console against a live agent
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def live_agent():
+    from omnia_tpu.facade.server import FacadeServer
+    from omnia_tpu.runtime.packs import load_pack
+    from omnia_tpu.runtime.providers import ProviderRegistry, ProviderSpec
+    from omnia_tpu.runtime.server import RuntimeServer
+
+    reg = ProviderRegistry()
+    reg.register(ProviderSpec(name="m", type="mock", options={"scenarios": [
+        {"pattern": "refund", "reply": "refunds land within 30 days"},
+        {"pattern": ".", "reply": "sure thing"}]}))
+    rt = RuntimeServer(
+        pack=load_pack({"name": "dc", "version": "1.0.0",
+                        "prompts": {"system": "s"},
+                        "sampling": {"temperature": 0.0, "max_tokens": 64}}),
+        providers=reg, provider_name="m")
+    rport = rt.serve("localhost:0")
+    facade = FacadeServer(runtime_target=f"localhost:{rport}", agent_name="dc-agent")
+    fport = facade.serve()
+    yield f"ws://localhost:{fport}/ws"
+    facade.shutdown()
+    rt.shutdown()
+
+
+class TestDevConsole:
+    def _call(self, port, method, path, body=None):
+        import urllib.error
+        import urllib.request
+
+        data = json.dumps(body).encode() if body is not None else None
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}{path}", data=data, method=method,
+            headers={"Content-Type": "application/json"})
+        try:
+            with urllib.request.urlopen(req, timeout=30) as r:
+                return r.status, json.loads(r.read())
+        except urllib.error.HTTPError as e:
+            return e.code, json.loads(e.read())
+
+    def test_interactive_turns_and_scenario(self, live_agent):
+        from omnia_tpu.evals.dev_console import DevConsole
+
+        console = DevConsole()
+        port = console.serve(host="127.0.0.1", port=0)
+        try:
+            s, doc = self._call(port, "POST", "/api/v1/dev-sessions",
+                                {"endpoint": live_agent})
+            assert s == 200 and doc["agent"] == "dc-agent"
+            sid = doc["id"]
+            # hand-driven turn with checks
+            s, turn = self._call(port, "POST", f"/api/v1/dev-sessions/{sid}/turn", {
+                "content": "how do refunds work?",
+                "checks": [{"kind": "contains", "value": "refunds"},
+                           {"kind": "not_contains", "value": "cannot"}],
+            })
+            assert s == 200 and turn["passed"], turn
+            assert "30 days" in turn["assistant"]
+            # scripted scenario
+            s, res = self._call(
+                port, "POST", f"/api/v1/dev-sessions/{sid}/scenario", {
+                    "scenario": {
+                        "name": "refund-flow",
+                        "turns": [{"user": "refund please", "checks": [
+                            {"kind": "contains", "value": "30 days"}]}],
+                    }})
+            assert s == 200 and res["passed"], res
+            # transcript accumulates across both
+            s, full = self._call(port, "GET", f"/api/v1/dev-sessions/{sid}")
+            assert len(full["transcript"]) == 2
+            assert len(full["results"]) == 1
+            s, _ = self._call(port, "DELETE", f"/api/v1/dev-sessions/{sid}")
+            assert s == 200
+            s, _ = self._call(port, "GET", f"/api/v1/dev-sessions/{sid}")
+            assert s == 404
+        finally:
+            console.shutdown()
+
+    def test_unreachable_agent_is_502(self):
+        from omnia_tpu.evals.dev_console import DevConsole
+
+        console = DevConsole()
+        port = console.serve(host="127.0.0.1", port=0)
+        try:
+            s, doc = self._call(port, "POST", "/api/v1/dev-sessions",
+                                {"endpoint": "ws://127.0.0.1:1/ws"})
+            assert s == 502
+        finally:
+            console.shutdown()
+
+    def test_license_gated(self, live_agent, ):
+        from cryptography.hazmat.primitives import serialization
+        from cryptography.hazmat.primitives.asymmetric import rsa
+
+        from omnia_tpu.evals.dev_console import DevConsole
+        from omnia_tpu.license import LicenseManager
+
+        priv = rsa.generate_private_key(public_exponent=65537, key_size=2048)
+        pub = priv.public_key().public_bytes(
+            serialization.Encoding.PEM,
+            serialization.PublicFormat.SubjectPublicKeyInfo)
+        console = DevConsole(license_manager=LicenseManager(pub))
+        port = console.serve(host="127.0.0.1", port=0)
+        try:
+            s, doc = self._call(port, "POST", "/api/v1/dev-sessions",
+                                {"endpoint": live_agent})
+            assert s == 402 and "license" in doc["error"]
+        finally:
+            console.shutdown()
