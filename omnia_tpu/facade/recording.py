@@ -27,9 +27,14 @@ class RecordingInterceptor:
         workers: int = 2,
         queue_limit: int = 1000,
         timeout_s: float = 5.0,
+        agent: str = "",
     ):
         self.url = session_api_url.rstrip("/") if session_api_url else None
         self.timeout_s = timeout_s
+        # Stamped onto session records so the archive (and rollout
+        # analysis) can scope sessions to the agent that served them.
+        self.agent = agent
+        self._ensured: set[str] = set()
         self._queue: "queue.Queue[dict]" = queue.Queue(maxsize=queue_limit)
         self._dropped = 0
         self._stop = threading.Event()
@@ -45,6 +50,17 @@ class RecordingInterceptor:
     # ------------------------------------------------------------------
 
     def record_user(self, session_id: str, user_id: str, content: str) -> None:
+        if session_id not in self._ensured:
+            if len(self._ensured) > 100_000:
+                self._ensured.clear()  # bounded memory; re-ensure is idempotent
+            self._ensured.add(session_id)
+            self._enqueue({
+                "kind": "session",
+                "session_id": session_id,
+                "user_id": user_id,
+                "agent": self.agent,
+                "ts": time.time(),
+            })
         self._enqueue(
             {
                 "kind": "message",
@@ -100,7 +116,10 @@ class RecordingInterceptor:
             except queue.Empty:
                 continue
             try:
-                path = "/api/v1/messages" if record["kind"] == "message" else "/api/v1/events"
+                path = {
+                    "message": "/api/v1/messages",
+                    "session": "/api/v1/sessions",
+                }.get(record["kind"], "/api/v1/events")
                 req = urllib.request.Request(
                     self.url + path,
                     data=json.dumps(record).encode(),

@@ -73,6 +73,8 @@ class FacadeServer:
         self.agent_name = agent_name
         self.auth = auth_chain
         self.recording = recording or RecordingInterceptor(None)
+        if not getattr(self.recording, "agent", ""):
+            self.recording.agent = agent_name
         self.realtime = realtime
         self.route_store = route_store
         self.advertise_address = advertise_address
@@ -82,6 +84,9 @@ class FacadeServer:
             "connections_active", "live websocket connections"
         )
         self._messages_total = self.metrics.counter("messages_total")
+        self._turn_errors_total = self.metrics.counter(
+            "turn_errors_total", "turns that ended in an error frame"
+        )
         self._turn_latency = self.metrics.histogram(
             "turn_seconds", buckets=(0.05, 0.1, 0.25, 0.5, 1, 2.5, 5, 10, 30, 60, 120)
         )
@@ -381,6 +386,7 @@ class FacadeServer:
                 })
                 return assistant_text
             elif rmsg.type == "error":
+                self._turn_errors_total.inc()
                 self._send(ws, {
                     "type": "error",
                     "code": rmsg.error_code,

@@ -1,0 +1,144 @@
+"""Rollout analysis: metric-gated promotion for canary candidates.
+
+Reference internal/controller/rollout_analysis.go + the EE
+RolloutAnalysis CRD: during a progressive rollout, the candidate track's
+metrics are evaluated against declared thresholds; a violation rolls the
+candidate back instead of promoting it. Metrics come straight from the
+candidate pods' own registries (in-process pods expose them directly;
+a cluster backend would scrape the same names over /metrics):
+
+- `error-rate`     : turn errors / messages       (max: maxErrorRate)
+- `p95-latency`    : facade turn_seconds p95      (max: maxP95LatencyS)
+- `eval-pass-rate` : realtime eval results for the agent from session-api
+                     (min: threshold)
+
+`minSamples` (default 1) guards against deciding on no traffic: until
+the candidate has served that many turns, analysis reports healthy
+(the time-boxed rollout step is the traffic-accumulation window).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import urllib.parse
+import urllib.request
+from typing import Optional
+
+from omnia_tpu.operator.resources import Resource, ResourceKind, resolve_ref
+
+logger = logging.getLogger(__name__)
+
+
+class AnalysisRunner:
+    def __init__(self, store, session_api_url: Optional[str] = None):
+        self.store = store
+        self.session_api_url = (session_api_url or "").rstrip("/")
+        # Exposed for observability/tests: last evaluation per agent key.
+        self.last_results: dict[str, list[dict]] = {}
+
+    # -- metric collection --------------------------------------------
+
+    @staticmethod
+    def _candidate_counts(dep) -> tuple[float, float, float]:
+        """(messages, errors, p95_latency_s) summed over candidate pods."""
+        messages = errors = 0.0
+        p95 = 0.0
+        for pod in dep.candidate_pods:
+            m = pod.facade.metrics
+            messages += m.counter("messages_total").value()
+            errors += m.counter("turn_errors_total").value()
+            hist = m.histogram("turn_seconds")
+            if hist.count:  # property, not method
+                p95 = max(p95, hist.quantile(0.95))
+        return messages, errors, p95
+
+    def _eval_pass_rate(self, agent: str) -> Optional[float]:
+        if not self.session_api_url:
+            return None
+        try:
+            # Bounded, recent-first sample (the listing is sorted by
+            # updated_at desc); scoped to EXACTLY this agent's sessions —
+            # unattributed sessions must not leak other agents' evals into
+            # this verdict.
+            with urllib.request.urlopen(
+                f"{self.session_api_url}/api/v1/sessions?limit=50", timeout=5
+            ) as r:
+                sessions = json.loads(r.read())["sessions"]
+            total = passed = 0
+            for s in sessions[:50]:
+                if s.get("agent") != agent:
+                    continue
+                with urllib.request.urlopen(
+                    f"{self.session_api_url}/api/v1/sessions/"
+                    f"{urllib.parse.quote(s['session_id'], safe='')}/eval-results",
+                    timeout=5,
+                ) as r:
+                    for res in json.loads(r.read())["eval_results"]:
+                        total += 1
+                        passed += bool(res.get("passed"))
+            return (passed / total) if total else None
+        except Exception:
+            logger.warning("eval pass-rate fetch failed", exc_info=True)
+            return None
+
+    # -- the analyzer hook --------------------------------------------
+
+    def analyze(self, dep) -> bool:
+        """Analyzer signature for RolloutEngine: True = candidate healthy.
+        Falls back to the health-probe analyzer when the spec references
+        no analysis."""
+        from omnia_tpu.operator.rollout import _default_analyzer
+
+        if not _default_analyzer(dep):
+            return False  # a dead candidate fails regardless of metrics
+        ref = (dep.resource.spec.get("rollout") or {}).get("analysis")
+        if not ref:
+            return True
+        res = resolve_ref(
+            self.store, dep.resource.namespace, ResourceKind.ROLLOUT_ANALYSIS, ref
+        )
+        if res is None:
+            logger.warning("rollout analysis ref %r not found; failing closed", ref)
+            return False  # declared analysis that can't run must not promote
+        if res.status.get("phase") == "Blocked":
+            # License-gated: a Blocked analysis must not silently grant the
+            # EE feature (nor promote an unanalyzed candidate).
+            logger.warning("rollout analysis %s is Blocked (unlicensed)", res.name)
+            return False
+        return self.evaluate(dep, res)
+
+    def evaluate(self, dep, analysis: Resource) -> bool:
+        spec = analysis.spec
+        min_samples = int(spec.get("minSamples", 1))
+        messages, errors, p95 = self._candidate_counts(dep)
+        results: list[dict] = []
+        healthy = True
+        for metric in spec.get("metrics", []):
+            name = metric.get("name", "")
+            verdict: Optional[bool] = None
+            observed: Optional[float] = None
+            if name == "error-rate":
+                if messages >= min_samples:
+                    observed = errors / messages if messages else 0.0
+                    verdict = observed <= float(metric.get("maxErrorRate", 1.0))
+            elif name == "p95-latency":
+                if messages >= min_samples:
+                    observed = p95
+                    verdict = observed <= float(metric.get("maxP95LatencyS", 1e9))
+            elif name == "eval-pass-rate":
+                observed = self._eval_pass_rate(dep.resource.name)
+                if observed is not None:
+                    verdict = observed >= float(metric.get("threshold", 0.0))
+            else:
+                # A misspelled metric must not promote ungated — same
+                # fail-closed stance as a missing analysis ref.
+                logger.warning("unknown analysis metric %r fails closed", name)
+                verdict = False
+            results.append({"name": name, "observed": observed,
+                            "passed": verdict})
+            if verdict is False:
+                healthy = False
+        self.last_results[dep.resource.key] = results
+        return healthy
+

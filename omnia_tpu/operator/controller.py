@@ -44,7 +44,12 @@ class ControllerManager:
         self.session_api_url = session_api_url
         self.capability_probe_timeout_s = capability_probe_timeout_s
         self.wait_ready = wait_ready
-        self.rollouts = RolloutEngine(self.backend)
+        # Metric-gated canary analysis (RolloutAnalysis resources) wraps
+        # the default health-probe analyzer.
+        from omnia_tpu.operator.analysis import AnalysisRunner
+
+        self.analysis = AnalysisRunner(store, session_api_url=session_api_url)
+        self.rollouts = RolloutEngine(self.backend, analyzer=self.analysis.analyze)
         self.deployments: dict[str, AgentDeployment] = {}
         self._autoscalers: dict[str, Autoscaler] = {}
         # EE plane: license gates reconciliation of enterprise kinds
@@ -54,6 +59,9 @@ class ControllerManager:
         self.license = license_manager or CommunityLicenseManager()
         self.arena = arena  # evals.arena.ArenaJobController (lazy default)
         self.policy_evaluator = None  # policy.broker.PolicyEvaluator
+        from omnia_tpu.operator.workspace import InProcessWorkspaceBackend
+
+        self.workspaces = InProcessWorkspaceBackend()
         self._queue: "queue.Queue[tuple[str, str, str]]" = queue.Queue()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -74,7 +82,7 @@ class ControllerManager:
             self._queue.put((res.namespace, res.kind, res.name))
             for ar in self.store.list(ResourceKind.AGENT_RUNTIME.value, res.namespace):
                 self._queue.put((ar.namespace, ar.kind, ar.name))
-        elif res.kind in EE_KINDS:
+        elif res.kind in EE_KINDS or res.kind == ResourceKind.WORKSPACE.value:
             self._queue.put((res.namespace, res.kind, res.name))
 
     # -- run loop -------------------------------------------------------
@@ -108,6 +116,7 @@ class ControllerManager:
                 except Exception:
                     pass
         self.deployments.clear()
+        self.workspaces.shutdown()
 
     def drain_queue(self) -> None:
         """Process every queued key (tests / single-step operation)."""
@@ -146,6 +155,11 @@ class ControllerManager:
             for res in self.store.list(kind):
                 if res.status.get("phase") in ("Blocked", "", None):
                     self.reconcile_key(res.namespace, res.kind, res.name)
+        # Workspaces recover from transient reconcile failures on the same
+        # level-trigger as everything else.
+        for ws in self.store.list(ResourceKind.WORKSPACE.value):
+            if ws.status.get("phase") in ("Error", "", None):
+                self.reconcile_workspace(ws)
 
     # -- reconcilers ----------------------------------------------------
 
@@ -159,6 +173,8 @@ class ControllerManager:
                 # a stale allow-override lingering in the evaluator is a
                 # security hole.
                 self._rebuild_policy_evaluator()
+            elif kind == ResourceKind.WORKSPACE.value:
+                self.workspaces.teardown(f"{namespace}/{kind}/{name}")
             return
         if kind == ResourceKind.AGENT_RUNTIME.value:
             self.reconcile_agent_runtime(res)
@@ -170,6 +186,8 @@ class ControllerManager:
             self.reconcile_arena_job(res)
         elif kind == ResourceKind.TOOL_POLICY.value:
             self.reconcile_tool_policies(res)
+        elif kind == ResourceKind.WORKSPACE.value:
+            self.reconcile_workspace(res)
         elif kind in (
             ResourceKind.SESSION_PRIVACY_POLICY.value,
             ResourceKind.ROLLOUT_ANALYSIS.value,
@@ -200,6 +218,21 @@ class ControllerManager:
                 "version": (res.spec.get("content") or {}).get("version", ""),
             },
         )
+
+    def reconcile_workspace(self, res: Resource) -> None:
+        """Per-service-group data planes (reference
+        workspace_services.go:72-365): real in-process session/memory-api
+        instances per group; endpoints land in status."""
+        try:
+            endpoints = self.workspaces.reconcile(res)
+        except Exception as e:
+            self.store.update_status(res, {"phase": "Error", "message": str(e)})
+            return
+        self.store.update_status(res, {
+            "phase": "Ready",
+            "environment": res.spec.get("environment", ""),
+            "serviceGroups": endpoints,
+        })
 
     # -- EE reconcilers -------------------------------------------------
 

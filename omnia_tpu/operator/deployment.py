@@ -287,4 +287,73 @@ class K8sManifestBackend:
                 ],
             },
         }
-        return {"deployment": deployment, "service": service}
+        out = {"deployment": deployment, "service": service}
+        scaler = self.render_autoscaling(dep)
+        if scaler is not None:
+            out["autoscaling"] = scaler
+        return out
+
+    @staticmethod
+    def render_autoscaling(dep: AgentDeployment):
+        """HPA or KEDA ScaledObject from spec.autoscaling (reference
+        autoscaling.go:74/:204). The north-star trigger is inference
+        QUEUE DEPTH (the engine's backlog signal), not active connections:
+        KEDA when scale-to-zero is requested (HPA cannot reach 0),
+        plain HPA otherwise."""
+        spec = dep.resource.spec.get("autoscaling")
+        if not spec:
+            return None
+        min_r = int(spec.get("minReplicas", 1))
+        max_r = int(spec.get("maxReplicas", max(min_r, 1)))
+        target_depth = int(spec.get("queueDepthTarget", 8))
+        if spec.get("scaleToZero"):
+            return {
+                "apiVersion": "keda.sh/v1alpha1",
+                "kind": "ScaledObject",
+                "metadata": {
+                    "name": f"agent-{dep.name}",
+                    "namespace": dep.namespace,
+                },
+                "spec": {
+                    "scaleTargetRef": {"name": f"agent-{dep.name}"},
+                    "minReplicaCount": 0,
+                    "maxReplicaCount": max_r,
+                    "triggers": [{
+                        "type": "prometheus",
+                        "metadata": {
+                            "serverAddress": spec.get(
+                                "prometheusAddress",
+                                "http://prometheus.omnia-system.svc:9090",
+                            ),
+                            "query": (
+                                "sum(omnia_runtime_queue_depth"
+                                f'{{agent="{dep.name}"}})'
+                            ),
+                            "threshold": str(target_depth),
+                        },
+                    }],
+                },
+            }
+        return {
+            "apiVersion": "autoscaling/v2",
+            "kind": "HorizontalPodAutoscaler",
+            "metadata": {
+                "name": f"agent-{dep.name}", "namespace": dep.namespace,
+            },
+            "spec": {
+                "scaleTargetRef": {
+                    "apiVersion": "apps/v1", "kind": "Deployment",
+                    "name": f"agent-{dep.name}",
+                },
+                "minReplicas": max(min_r, 1),
+                "maxReplicas": max_r,
+                "metrics": [{
+                    "type": "Pods",
+                    "pods": {
+                        "metric": {"name": "omnia_runtime_queue_depth"},
+                        "target": {"type": "AverageValue",
+                                   "averageValue": str(target_depth)},
+                    },
+                }],
+            },
+        }

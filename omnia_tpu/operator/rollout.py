@@ -39,7 +39,12 @@ class RolloutStep:
 
     @classmethod
     def from_spec(cls, d: dict) -> "RolloutStep":
-        return cls(weight=float(d["weight"]), hold_s=float(d.get("holdSeconds", 0.0)))
+        # `pause_s` is the published CRD key (operator/crds.py); the
+        # holdSeconds spelling is accepted for compatibility.
+        return cls(
+            weight=float(d["weight"]),
+            hold_s=float(d.get("pause_s", d.get("holdSeconds", 0.0))),
+        )
 
 
 @dataclass

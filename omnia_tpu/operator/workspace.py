@@ -1,0 +1,214 @@
+"""Workspace reconciliation: per-service-group data planes.
+
+Reference internal/controller/workspace_services.go:72-365 (+ the
+netpol/RBAC/storage builders): a Workspace's `services[]` groups each
+get their OWN session-api/memory-api deployments so tenants' data planes
+are isolated. Two backends, same shape as agent pods:
+
+- In-process (dev/tests): real SessionAPI/MemoryAPI instances per group,
+  endpoints written into Workspace status.
+- Manifests (clusters): Deployments + Services + a default-deny
+  NetworkPolicy scoped to the workspace + a namespaced Role/RoleBinding —
+  rendered pure and linted like every other deploy artifact.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Optional
+
+from omnia_tpu.operator.resources import Resource
+
+logger = logging.getLogger(__name__)
+
+
+class ServiceGroup:
+    __slots__ = ("name", "session_api", "memory_api", "session_port",
+                 "memory_port", "shape")
+
+    def __init__(self, name: str) -> None:
+        self.name = name
+        self.session_api = None
+        self.memory_api = None
+        self.session_port: Optional[int] = None
+        self.memory_port: Optional[int] = None
+        self.shape: tuple = (False, False)  # (sessionApi, memoryApi)
+
+    def endpoints(self) -> dict:
+        out: dict = {"group": self.name}
+        if self.session_port is not None:
+            out["sessionApi"] = f"http://localhost:{self.session_port}"
+        if self.memory_port is not None:
+            out["memoryApi"] = f"http://localhost:{self.memory_port}"
+        return out
+
+    def stop(self) -> None:
+        for svc in (self.session_api, self.memory_api):
+            if svc is not None:
+                try:
+                    svc.shutdown()
+                except Exception:
+                    logger.exception("service group %s shutdown failed", self.name)
+
+
+class InProcessWorkspaceBackend:
+    """Real per-group services in this process (the devroot analog of the
+    reference's per-group Deployments)."""
+
+    def __init__(self) -> None:
+        self._groups: dict[str, dict[str, ServiceGroup]] = {}
+        self._lock = threading.Lock()
+
+    def reconcile(self, res: Resource) -> list[dict]:
+        """Converge running groups to the spec; returns endpoint docs."""
+        from omnia_tpu.memory.api import MemoryAPI
+        from omnia_tpu.session.api import SessionAPI
+
+        want = {
+            g["name"]: g for g in res.spec.get("services", [])
+            if isinstance(g, dict) and g.get("name")
+        }
+        key = res.key
+        with self._lock:
+            groups = self._groups.setdefault(key, {})
+            for name in list(groups):
+                if name not in want:
+                    groups.pop(name).stop()
+            for name, spec in want.items():
+                shape = (bool(spec.get("sessionApi", True)),
+                         bool(spec.get("memoryApi", False)))
+                existing = groups.get(name)
+                if existing is not None:
+                    if existing.shape == shape:
+                        continue
+                    # Spec changed: converge by recreate (these are
+                    # stateless-by-default dev services).
+                    groups.pop(name).stop()
+                group = ServiceGroup(name)
+                group.shape = shape
+                try:
+                    if shape[0]:
+                        group.session_api = SessionAPI()
+                        group.session_port = group.session_api.serve(
+                            host="localhost", port=0)
+                    if shape[1]:
+                        group.memory_api = MemoryAPI()
+                        group.memory_port = group.memory_api.serve(
+                            host="localhost", port=0)
+                except BaseException:
+                    group.stop()  # never leak a half-started group
+                    raise
+                groups[name] = group
+            return [g.endpoints() for g in groups.values()]
+
+    def teardown(self, key: str) -> None:
+        with self._lock:
+            groups = self._groups.pop(key, {})
+        for g in groups.values():
+            g.stop()
+
+    def group(self, key: str, name: str) -> Optional[ServiceGroup]:
+        with self._lock:
+            return self._groups.get(key, {}).get(name)
+
+    def shutdown(self) -> None:
+        with self._lock:
+            all_groups, self._groups = list(self._groups.values()), {}
+        for groups in all_groups:
+            for g in groups.values():
+                g.stop()
+
+
+def render_workspace_manifests(res: Resource, images: Optional[dict] = None) -> list[dict]:
+    """Cluster manifests for a Workspace: per-group session/memory-api
+    Deployments+Services, default-deny-ingress NetworkPolicy (workspace
+    traffic only), and a namespaced admin Role/RoleBinding from
+    roleBindings (reference workspace_controller _networkpolicy/_rbac)."""
+    images = images or {
+        "sessionApi": "omnia-tpu/session-api:latest",
+        "memoryApi": "omnia-tpu/memory-api:latest",
+    }
+    ns = res.spec.get("namespace", res.name)
+    out: list[dict] = [
+        {"apiVersion": "v1", "kind": "Namespace", "metadata": {"name": ns}},
+        {
+            "apiVersion": "networking.k8s.io/v1",
+            "kind": "NetworkPolicy",
+            "metadata": {"name": "omnia-workspace-default", "namespace": ns},
+            "spec": {
+                "podSelector": {},
+                "policyTypes": ["Ingress"],
+                "ingress": [{
+                    "from": [
+                        {"podSelector": {}},  # same-namespace traffic
+                        {"namespaceSelector": {"matchLabels": {
+                            "kubernetes.io/metadata.name": "omnia-system"}}},
+                    ],
+                }],
+            },
+        },
+    ]
+    for i, binding in enumerate(res.spec.get("roleBindings", [])):
+        role = binding.get("role", "viewer")
+        users = binding.get("users", [])
+        if not users:
+            continue
+        out.append({
+            "apiVersion": "rbac.authorization.k8s.io/v1",
+            "kind": "RoleBinding",
+            # Indexed: two bindings with the same role must not collide.
+            "metadata": {"name": f"omnia-{role}-{i}", "namespace": ns},
+            "roleRef": {
+                "apiGroup": "rbac.authorization.k8s.io",
+                "kind": "ClusterRole",
+                # Map workspace roles onto the stock cluster roles.
+                "name": {"viewer": "view", "editor": "edit",
+                         "admin": "admin"}.get(role, "view"),
+            },
+            "subjects": [
+                {"kind": "User", "name": u,
+                 "apiGroup": "rbac.authorization.k8s.io"}
+                for u in users
+            ],
+        })
+    for group in res.spec.get("services", []):
+        name = group.get("name")
+        if not name:
+            continue
+        for svc_key, enabled_default, image_key, port in (
+            ("sessionApi", True, "sessionApi", 8300),
+            ("memoryApi", False, "memoryApi", 8400),
+        ):
+            if not group.get(svc_key, enabled_default):
+                continue
+            comp = f"{name}-{'session-api' if svc_key == 'sessionApi' else 'memory-api'}"
+            labels = {"app.kubernetes.io/name": "omnia",
+                      "app.kubernetes.io/component": comp}
+            out.append({
+                "apiVersion": "apps/v1",
+                "kind": "Deployment",
+                "metadata": {"name": comp, "namespace": ns, "labels": labels},
+                "spec": {
+                    "replicas": int(group.get("replicas", 1)),
+                    "selector": {"matchLabels": labels},
+                    "template": {
+                        "metadata": {"labels": labels},
+                        "spec": {"containers": [{
+                            "name": "api",
+                            "image": images[image_key],
+                            "ports": [{"name": "http", "containerPort": port}],
+                            "env": [{"name": "OMNIA_HTTP_PORT",
+                                     "value": str(port)}],
+                        }]},
+                    },
+                },
+            })
+            out.append({
+                "apiVersion": "v1",
+                "kind": "Service",
+                "metadata": {"name": comp, "namespace": ns, "labels": labels},
+                "spec": {"selector": labels,
+                         "ports": [{"name": "http", "port": port}]},
+            })
+    return out

@@ -1,0 +1,236 @@
+"""Operator upgrades: metric-gated rollout analysis, HPA/KEDA object
+rendering, and per-service-group workspace data planes (reference
+rollout_analysis.go, autoscaling.go:74/:204, workspace_services.go)."""
+
+import json
+import time
+import urllib.request
+
+import pytest
+
+from omnia_tpu.operator.analysis import AnalysisRunner
+from omnia_tpu.operator.controller import ControllerManager
+from omnia_tpu.operator.deployment import AgentDeployment, K8sManifestBackend
+from omnia_tpu.operator.manifest_lint import lint
+from omnia_tpu.operator.resources import Resource
+from omnia_tpu.operator.rollout import RolloutPhase
+from omnia_tpu.operator.store import MemoryResourceStore
+from omnia_tpu.operator.workspace import render_workspace_manifests
+
+PACK = {
+    "name": "up-agent", "version": "1.0.0",
+    "prompts": {"system": "s"},
+    "sampling": {"temperature": 0.0, "max_tokens": 32},
+}
+
+
+def _apply_agent(store, rollout=None, scenarios=None):
+    store.apply(Resource(kind="Provider", name="p", spec={
+        "type": "mock", "role": "llm",
+        "options": {"scenarios": scenarios or [{"pattern": ".", "reply": "ok"}]}}))
+    store.apply(Resource(kind="PromptPack", name="pk", spec={"content": PACK}))
+    spec = {
+        "mode": "agent",
+        "promptPackRef": {"name": "pk"},
+        "providers": [{"name": "main", "providerRef": {"name": "p"}}],
+        "replicas": 1,
+    }
+    if rollout:
+        spec["rollout"] = rollout
+    store.apply(Resource(kind="AgentRuntime", name="up-agent", spec=spec))
+
+
+class TestRolloutAnalysis:
+    def _chat(self, endpoint, text):
+        from websockets.sync.client import connect
+
+        with connect(endpoint) as ws:
+            json.loads(ws.recv(timeout=10))
+            ws.send(json.dumps({"type": "message", "content": text}))
+            while True:
+                m = json.loads(ws.recv(timeout=30))
+                if m["type"] in ("done", "error"):
+                    return m
+
+    def test_unhealthy_metrics_roll_back(self):
+        """Candidate whose turns error past maxErrorRate must roll back,
+        not promote — evaluated from the candidate pods' real metrics."""
+        store = MemoryResourceStore()
+        mgr = ControllerManager(store)
+        try:
+            store.apply(Resource(kind="RolloutAnalysis", name="ra", spec={
+                "minSamples": 1,
+                "metrics": [{"name": "error-rate", "maxErrorRate": 0.2}],
+            }))
+            _apply_agent(store, rollout={
+                "steps": [{"weight": 50, "pause_s": 0.05}],
+                "analysis": {"name": "ra"},
+            }, scenarios=[
+                {"pattern": "boom", "error": "simulated provider failure"},
+                {"pattern": ".", "reply": "ok"},
+            ])
+            mgr.drain_queue()
+            dep = next(iter(mgr.deployments.values()))
+
+            # Trigger a canary: config change spawns a candidate track.
+            res = store.get("default", "AgentRuntime", "up-agent")
+            res.spec["context"] = {"ttl_s": 123}
+            store.apply(res)
+            mgr.drain_queue()
+            st = mgr.rollouts.state(dep)
+            assert st.phase == RolloutPhase.PROGRESSING
+            # Drive ERROR turns through the candidate (the mock provider's
+            # error scenario streams an error final).
+            cand = dep.candidate_pods[0]
+            for _ in range(3):
+                out = self._chat(cand.endpoint, "boom")
+                assert out["type"] == "error", out
+            time.sleep(0.1)  # step pause elapses
+            mgr.resync()
+            st = mgr.rollouts.state(dep)
+            assert st.phase == RolloutPhase.ROLLED_BACK, st.to_status()
+            results = mgr.analysis.last_results[dep.resource.key]
+            er = next(r for r in results if r["name"] == "error-rate")
+            assert er["passed"] is False and er["observed"] == 1.0
+        finally:
+            mgr.shutdown()
+
+    def test_healthy_metrics_promote(self):
+        store = MemoryResourceStore()
+        mgr = ControllerManager(store)
+        try:
+            store.apply(Resource(kind="RolloutAnalysis", name="ra", spec={
+                "minSamples": 1,
+                "metrics": [{"name": "error-rate", "maxErrorRate": 0.2},
+                            {"name": "p95-latency", "maxP95LatencyS": 30.0}],
+            }))
+            _apply_agent(store, rollout={
+                "steps": [{"weight": 50, "pause_s": 0.05}],
+                "analysis": {"name": "ra"},
+            })
+            mgr.drain_queue()
+            dep = next(iter(mgr.deployments.values()))
+            res = store.get("default", "AgentRuntime", "up-agent")
+            res.spec["context"] = {"ttl_s": 456}
+            store.apply(res)
+            mgr.drain_queue()
+            cand = dep.candidate_pods[0]
+            assert self._chat(cand.endpoint, "hello")["type"] == "done"
+            time.sleep(0.1)
+            mgr.resync()
+            assert mgr.rollouts.state(dep).phase == RolloutPhase.PROMOTED
+        finally:
+            mgr.shutdown()
+
+    def test_missing_analysis_ref_fails_closed(self):
+        store = MemoryResourceStore()
+        mgr = ControllerManager(store)
+        try:
+            _apply_agent(store, rollout={
+                "steps": [{"weight": 50, "pause_s": 0.05}],
+                "analysis": {"name": "ghost"},
+            })
+            mgr.drain_queue()
+            dep = next(iter(mgr.deployments.values()))
+            res = store.get("default", "AgentRuntime", "up-agent")
+            res.spec["context"] = {"ttl_s": 9}
+            store.apply(res)
+            mgr.drain_queue()
+            time.sleep(0.1)
+            mgr.resync()
+            assert mgr.rollouts.state(dep).phase == RolloutPhase.ROLLED_BACK
+        finally:
+            mgr.shutdown()
+
+
+class TestAutoscalingManifests:
+    def _dep(self, autoscaling):
+        res = Resource(kind="AgentRuntime", name="scaler", spec={
+            "promptPackRef": {"name": "pk"},
+            "providers": [{"providerRef": {"name": "p"}}],
+            "autoscaling": autoscaling,
+        })
+        return AgentDeployment(
+            res, pack_doc=PACK, provider_specs=[{"name": "p", "type": "mock"}],
+            default_provider="p")
+
+    def test_scale_to_zero_renders_keda(self):
+        out = K8sManifestBackend().render(self._dep({
+            "minReplicas": 0, "maxReplicas": 8, "scaleToZero": True,
+            "queueDepthTarget": 4}))
+        so = out["autoscaling"]
+        assert so["kind"] == "ScaledObject"
+        assert so["spec"]["minReplicaCount"] == 0
+        trig = so["spec"]["triggers"][0]
+        assert trig["type"] == "prometheus"
+        assert "queue_depth" in trig["metadata"]["query"]
+        assert trig["metadata"]["threshold"] == "4"
+        assert lint([out["deployment"], out["service"], so]) == []
+
+    def test_plain_hpa_otherwise(self):
+        out = K8sManifestBackend().render(self._dep({
+            "minReplicas": 2, "maxReplicas": 6}))
+        hpa = out["autoscaling"]
+        assert hpa["kind"] == "HorizontalPodAutoscaler"
+        assert hpa["spec"]["minReplicas"] == 2
+        assert hpa["spec"]["metrics"][0]["pods"]["metric"]["name"] == \
+            "omnia_runtime_queue_depth"
+
+    def test_no_autoscaling_no_object(self):
+        out = K8sManifestBackend().render(self._dep(None))
+        assert "autoscaling" not in out
+
+
+class TestWorkspaceServiceGroups:
+    def test_in_process_groups_serve_real_apis(self):
+        store = MemoryResourceStore()
+        mgr = ControllerManager(store)
+        try:
+            store.apply(Resource(kind="Workspace", name="team-a", spec={
+                "environment": "dev",
+                "services": [
+                    {"name": "core", "sessionApi": True, "memoryApi": True},
+                    {"name": "batch", "sessionApi": True},
+                ],
+            }))
+            mgr.drain_queue()
+            res = store.get("default", "Workspace", "team-a")
+            assert res.status["phase"] == "Ready"
+            groups = {g["group"]: g for g in res.status["serviceGroups"]}
+            assert set(groups) == {"core", "batch"}
+            assert "memoryApi" in groups["core"] and "memoryApi" not in groups["batch"]
+            # The endpoints are LIVE services.
+            body = json.dumps({"session_id": "ws-grp"}).encode()
+            req = urllib.request.Request(
+                groups["core"]["sessionApi"] + "/api/v1/sessions", data=body,
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(req, timeout=10) as r:
+                assert r.status == 200
+            # Group isolation: the other group has no such session.
+            with urllib.request.urlopen(
+                groups["batch"]["sessionApi"] + "/api/v1/sessions", timeout=10
+            ) as r:
+                assert json.loads(r.read())["sessions"] == []
+            # Removing a group converges: its service stops.
+            res.spec["services"] = [{"name": "core", "sessionApi": True,
+                                     "memoryApi": True}]
+            store.apply(res)
+            mgr.drain_queue()
+            res = store.get("default", "Workspace", "team-a")
+            assert [g["group"] for g in res.status["serviceGroups"]] == ["core"]
+        finally:
+            mgr.shutdown()
+
+    def test_rendered_manifests_lint_clean(self):
+        res = Resource(kind="Workspace", name="team-b", spec={
+            "environment": "prod",
+            "roleBindings": [{"role": "admin", "users": ["alice"]}],
+            "services": [{"name": "core", "sessionApi": True, "memoryApi": True}],
+        })
+        manifests = render_workspace_manifests(res)
+        assert lint(manifests) == [], lint(manifests)
+        kinds = [m["kind"] for m in manifests]
+        assert kinds.count("Deployment") == 2 and kinds.count("Service") == 2
+        assert "NetworkPolicy" in kinds and "RoleBinding" in kinds
+        netpol = next(m for m in manifests if m["kind"] == "NetworkPolicy")
+        assert netpol["spec"]["policyTypes"] == ["Ingress"]
