@@ -59,6 +59,7 @@ from omnia_tpu.engine.types import (
 )
 from omnia_tpu.models import ModelConfig
 from omnia_tpu.models import llama
+from omnia_tpu.models import quant
 from omnia_tpu.ops.sampling import (
     make_slot_key_data,
     sample_tokens_per_slot,
@@ -157,10 +158,41 @@ class InferenceEngine:
                 engine_cfg.dp, engine_cfg.tp, sp=engine_cfg.sp, devices=devices
             )
 
+        qmode = quant.validate_mode(engine_cfg.quant)
+        if params is not None and quant.params_quantized(params):
+            # Pre-quantized tree (the loader's flagship path): its mode is
+            # authoritative — shard specs must match the actual leaf
+            # structure, and a silent w8/w8d mismatch would serve the
+            # wrong arithmetic. Adopt it; reject a contradictory config.
+            detected = quant.detect_mode(params)
+            if qmode is None:
+                qmode = detected
+            elif qmode != detected:
+                raise ValueError(
+                    f"EngineConfig.quant={qmode!r} but supplied params are "
+                    f"{detected!r}-quantized"
+                )
         if params is None:
-            params = llama.init_params(model_cfg, jax.random.key(seed), dtype=self._dtype)
+            if qmode:
+                # Born quantized: for flagship sizes the full-precision
+                # tree would not fit in HBM beside the int8 one.
+                params = quant.init_params_quantized(
+                    model_cfg, jax.random.key(seed), qmode, dtype=self._dtype
+                )
+            else:
+                params = llama.init_params(
+                    model_cfg, jax.random.key(seed), dtype=self._dtype
+                )
+        elif qmode and not quant.params_quantized(params):
+            # Caller-supplied full-precision params (small models / tests).
+            # Checkpoint-loaded flagships should quantize in the loader
+            # (load_params(quant=...)) so this on-device pass is skipped.
+            params = quant.quantize_params(params, model_cfg, qmode)
+        specs = llama.param_specs(model_cfg)
+        if qmode:
+            specs = quant.quantize_param_specs(specs, model_cfg, qmode)
         if self._mesh is not None:
-            params = shard_pytree(params, llama.param_specs(model_cfg), self._mesh)
+            params = shard_pytree(params, specs, self._mesh)
         self.params = params
 
         self._seed = seed

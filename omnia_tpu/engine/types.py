@@ -166,6 +166,11 @@ class EngineConfig:
     # host RAM (LRU) and swap back on demand, so this many *logical*
     # sessions share the fixed device cache. 0 disables sessionful serving.
     max_sessions: int = 64
+    # Weight quantization: None (full dtype), "int8" (W8A16 weight-only,
+    # near-lossless, halves weight HBM), or "int8-dynamic" (W8A8 dynamic
+    # activation quant, int8×int8 MXU path — fastest). Dense models only;
+    # see models/quant.py.
+    quant: Optional[str] = None
 
     def restore_buckets(self) -> tuple[int, ...]:
         """Row counts used when moving a session's KV rows device↔host:

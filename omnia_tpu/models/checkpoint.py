@@ -245,17 +245,29 @@ def load_params(
     cfg: Optional[ModelConfig] = None,
     dtype=jnp.bfloat16,
     mesh=None,
+    quant: Optional[str] = None,
 ):
     """Load an HF-layout llama/mixtral checkpoint into the stacked pytree.
 
     With ``mesh``, each leaf is placed with its ``param_specs`` sharding as
     it is assembled (per-device HBM holds only that device's shard);
     without, leaves are committed to the default device.
+
+    With ``quant`` ("int8" / "int8-dynamic", models/quant.py), matmul
+    weights are quantized **on host** as each stacked tensor is assembled
+    and only the int8 tensor + scales are device_put — the full-precision
+    tree never lands in HBM, which is what makes Llama-3-8B fit one 16 GB
+    chip.
     """
     cfg = cfg or read_config(path)
     np_dt = _np_dtype(dtype)
     reader = _ShardReader(path)
     specs = param_specs(cfg)
+    if quant is not None:
+        from omnia_tpu.models import quant as quant_mod
+
+        quant_mod.validate_mode(quant)
+        specs = quant_mod.quantize_param_specs(specs, cfg, quant)
     L, D, F, V = cfg.num_layers, cfg.hidden_size, cfg.ffn_hidden_size, cfg.vocab_size
 
     if mesh is not None:
@@ -266,6 +278,16 @@ def load_params(
     else:
         def put(arr, spec):
             return jnp.asarray(arr)
+
+    def put_leaf(arr: np.ndarray, spec):
+        # A dict spec marks a leaf the quant mode covers: quantize the
+        # assembled host tensor and place its members individually.
+        if isinstance(spec, dict):
+            from omnia_tpu.models import quant as quant_mod
+
+            d = quant_mod.quantize_np(arr, quant)
+            return {k: put(d[k], spec[k]) for k in spec}
+        return put(np.asarray(arr, dtype=np_dt), spec)
 
     def fetch(name: str, want_shape: tuple, transpose: bool) -> np.ndarray:
         t = reader.get(name)
@@ -279,13 +301,13 @@ def load_params(
         return t
 
     def single(name: str, shape: tuple, spec, transpose: bool = False):
-        return put(np.asarray(fetch(name, shape, transpose), dtype=np_dt), spec)
+        return put_leaf(np.asarray(fetch(name, shape, transpose), dtype=np_dt), spec)
 
     def stacked(tmpl: str, shape: tuple, spec, transpose: bool = True):
         out = np.empty((L, *shape), dtype=np_dt)
         for i in range(L):
             out[i] = fetch(tmpl.format(i=i), shape, transpose)
-        return put(out, spec)
+        return put_leaf(out, spec)
 
     def stacked_experts(tmpl: str, shape: tuple, spec):
         E = cfg.num_experts
@@ -339,7 +361,7 @@ def load_params(
             )
         else:
             # Some checkpoints omit lm_head and tie on load; honor that.
-            params["lm_head"] = put(
+            params["lm_head"] = put_leaf(
                 np.asarray(
                     fetch("model.embed_tokens.weight", (V, D), False).T, dtype=np_dt
                 ),
@@ -362,6 +384,15 @@ def save_params(
     """Write the stacked pytree as an HF-layout safetensors checkpoint
     (config.json + shard files + index when more than one shard)."""
     from safetensors.numpy import save_file
+
+    from omnia_tpu.models.quant import params_quantized
+
+    if params_quantized(params):
+        raise CheckpointError(
+            "save_params writes HF-layout full-precision checkpoints; "
+            "int8-quantized trees are a serving format — load with "
+            "load_params(quant=...) instead of persisting them"
+        )
 
     os.makedirs(path, exist_ok=True)
     with open(os.path.join(path, "config.json"), "w") as f:

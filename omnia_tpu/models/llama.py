@@ -28,6 +28,7 @@ import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
 from omnia_tpu.models.config import ModelConfig
+from omnia_tpu.models.quant import qdot
 from omnia_tpu.ops.attention import gqa_attention
 from omnia_tpu.ops.moe import moe_mlp
 from omnia_tpu.ops.norms import rms_norm
@@ -137,9 +138,9 @@ def init_kv_cache(cfg: ModelConfig, batch: int, seq: int, dtype=jnp.bfloat16):
 
 
 def _dense_mlp(h, p):
-    gate = jnp.dot(h, p["wg"])
-    up = jnp.dot(h, p["wu"])
-    return jnp.dot(jax.nn.silu(gate) * up, p["wd"])
+    gate = qdot(h, p["wg"])
+    up = qdot(h, p["wu"])
+    return qdot(jax.nn.silu(gate) * up, p["wd"])
 
 
 def _moe_mlp(h, p, cfg: ModelConfig):
@@ -162,9 +163,9 @@ def _layer(x, p, cfg: ModelConfig, cos, sin, q_positions, ck, cv, write_start,
            attn_fn=None):
     B, T, D = x.shape
     h = rms_norm(x, p["ln1"], cfg.rms_norm_eps)
-    q = jnp.dot(h, p["attn"]["wq"]).reshape(B, T, cfg.num_heads, cfg.head_dim)
-    k = jnp.dot(h, p["attn"]["wk"]).reshape(B, T, cfg.num_kv_heads, cfg.head_dim)
-    v = jnp.dot(h, p["attn"]["wv"]).reshape(B, T, cfg.num_kv_heads, cfg.head_dim)
+    q = qdot(h, p["attn"]["wq"]).reshape(B, T, cfg.num_heads, cfg.head_dim)
+    k = qdot(h, p["attn"]["wk"]).reshape(B, T, cfg.num_kv_heads, cfg.head_dim)
+    v = qdot(h, p["attn"]["wv"]).reshape(B, T, cfg.num_kv_heads, cfg.head_dim)
     q = apply_rope(q, cos, sin)
     k = apply_rope(k, cos, sin)
 
@@ -184,7 +185,7 @@ def _layer(x, p, cfg: ModelConfig, cos, sin, q_positions, ck, cv, write_start,
         attn = attn_fn(q, ck_eff, cv_eff, q_positions)
     else:
         attn = gqa_attention(q, ck_eff, cv_eff, q_positions)
-    x = x + jnp.dot(attn.reshape(B, T, -1), p["attn"]["wo"])
+    x = x + qdot(attn.reshape(B, T, -1), p["attn"]["wo"])
 
     h2 = rms_norm(x, p["ln2"], cfg.rms_norm_eps)
     if cfg.is_moe:
@@ -246,7 +247,7 @@ def _logits(params, cfg: ModelConfig, x):
     x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
     if cfg.tie_embeddings:
         return jnp.dot(x, params["embed"].T).astype(jnp.float32)
-    return jnp.dot(x, params["lm_head"]).astype(jnp.float32)
+    return qdot(x, params["lm_head"]).astype(jnp.float32)
 
 
 def forward(params, cfg: ModelConfig, tokens, q_positions, cache_k, cache_v, write_start):

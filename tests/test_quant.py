@@ -1,0 +1,251 @@
+"""int8 quantization: qdot accuracy, full-forward fidelity, engine + loader
+integration, sharded specs. (models/quant.py — the single-chip capacity
+path for the Llama-3-8B north star; see BASELINE.md.)"""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from omnia_tpu.engine import EngineConfig, InferenceEngine, SamplingParams
+from omnia_tpu.models import checkpoint as ckpt_io
+from omnia_tpu.models import get_config, llama, quant
+from omnia_tpu.parallel import make_mesh, shard_pytree
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    cfg = get_config("test-tiny")
+    params = llama.init_params(cfg, jax.random.key(0), dtype=jnp.float32)
+    return cfg, params
+
+
+# ---------------------------------------------------------------------------
+# qdot
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("mode", quant.QUANT_MODES)
+def test_qdot_matches_dense(mode):
+    k1, k2 = jax.random.split(jax.random.key(0))
+    h = jax.random.normal(k1, (4, 64), dtype=jnp.float32)
+    w = jax.random.normal(k2, (64, 32), dtype=jnp.float32) * 0.05
+    ref = jnp.dot(h, w)
+    out = quant.qdot(h, quant.quantize_weight(w, mode))
+    # int8 per-channel round-trip: ~0.5% weight error (w8a16), plus the
+    # same again on activations for w8a8.
+    tol = 0.02 if mode == "int8" else 0.05
+    err = jnp.linalg.norm(out - ref) / jnp.linalg.norm(ref)
+    assert err < tol, f"{mode}: relative error {err}"
+
+
+def test_qdot_passthrough_dense_weight():
+    h = jnp.ones((2, 8))
+    w = jnp.ones((8, 4))
+    np.testing.assert_allclose(quant.qdot(h, w), jnp.dot(h, w))
+
+
+def test_scale_commutes_with_contraction():
+    """The w8a16 identity the design rests on: per-output-channel scale
+    applied to the output equals dequantizing the weight first."""
+    k1, k2 = jax.random.split(jax.random.key(1))
+    h = jax.random.normal(k1, (3, 16), dtype=jnp.float32)
+    w = jax.random.normal(k2, (16, 8), dtype=jnp.float32)
+    d = quant.quantize_weight(w, "int8")
+    dequant = d["w8"].astype(jnp.float32) * d["s"][None, :]
+    np.testing.assert_allclose(
+        np.asarray(quant.qdot(h, d)),
+        np.asarray(jnp.dot(h, dequant)),
+        rtol=1e-5, atol=1e-5,
+    )
+
+
+# ---------------------------------------------------------------------------
+# Full-forward fidelity
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("mode", quant.QUANT_MODES)
+def test_forward_close_to_dense(tiny, mode):
+    cfg, params = tiny
+    qparams = quant.quantize_params(params, cfg, mode)
+    toks = jax.random.randint(jax.random.key(2), (2, 12), 0, cfg.vocab_size)
+    ref = llama.forward_train(params, cfg, toks)
+    out = llama.forward_train(qparams, cfg, toks)
+    # Logits drift but ranking must hold almost everywhere: top-1 token
+    # agreement is the serving-relevant fidelity metric.
+    agree = jnp.mean(
+        (jnp.argmax(ref, axis=-1) == jnp.argmax(out, axis=-1)).astype(jnp.float32)
+    )
+    assert agree > 0.9, f"{mode}: top-1 agreement {agree}"
+
+
+def test_quantized_structure(tiny):
+    cfg, params = tiny
+    qparams = quant.quantize_params(params, cfg, "int8")
+    assert quant.params_quantized(qparams)
+    assert not quant.params_quantized(params)
+    wq = qparams["layers"]["attn"]["wq"]
+    assert wq["w8"].dtype == jnp.int8
+    assert wq["s"].shape == (cfg.num_layers, cfg.q_dim)
+    # Norms/embed untouched.
+    assert qparams["layers"]["ln1"].dtype == params["layers"]["ln1"].dtype
+    assert qparams["embed"].dtype == params["embed"].dtype
+
+
+def test_moe_init_quantized_rejected():
+    cfg = get_config("test-tiny-moe")
+    with pytest.raises(ValueError, match="MoE"):
+        quant.init_params_quantized(cfg, jax.random.key(0))
+
+
+# ---------------------------------------------------------------------------
+# Sharding
+# ---------------------------------------------------------------------------
+
+
+def test_quantized_specs_shard_on_mesh(tiny):
+    cfg, params = tiny
+    qparams = quant.quantize_params(params, cfg, "int8")
+    specs = quant.quantize_param_specs(llama.param_specs(cfg), cfg, "int8")
+    mesh = make_mesh(dp=2, tp=4)
+    sharded = shard_pytree(qparams, specs, mesh)
+    toks = jax.random.randint(jax.random.key(3), (2, 8), 0, cfg.vocab_size)
+    ref = llama.forward_train(qparams, cfg, toks)
+    out = llama.forward_train(sharded, cfg, toks)
+    np.testing.assert_allclose(np.asarray(ref), np.asarray(out), rtol=2e-2, atol=2e-2)
+
+
+# ---------------------------------------------------------------------------
+# Engine integration
+# ---------------------------------------------------------------------------
+
+
+def _greedy_turn(engine, prompt, n=8):
+    h = engine.submit(prompt, SamplingParams(temperature=0.0, max_tokens=n))
+    toks, final = h.collect_tokens(timeout=120)
+    assert final.error is None
+    return toks
+
+
+@pytest.mark.parametrize("mode", quant.QUANT_MODES)
+def test_engine_serves_quantized(mode):
+    cfg = get_config("test-tiny")
+    eng = InferenceEngine(
+        cfg,
+        EngineConfig(
+            num_slots=2, max_seq=64, prefill_buckets=(16,), dtype="float32",
+            quant=mode, max_sessions=0,
+        ),
+        seed=0,
+    )
+    eng.start()
+    try:
+        a = _greedy_turn(eng, [1, 2, 3, 4])
+        b = _greedy_turn(eng, [1, 2, 3, 4])
+        assert a == b and len(a) == 8  # deterministic greedy decode
+    finally:
+        eng.stop()
+
+
+def test_engine_quantizes_supplied_params(tiny):
+    cfg, params = tiny
+    eng = InferenceEngine(
+        cfg,
+        EngineConfig(
+            num_slots=2, max_seq=64, prefill_buckets=(16,), dtype="float32",
+            quant="int8", max_sessions=0,
+        ),
+        params=params,
+    )
+    assert quant.params_quantized(eng.params)
+    eng.start()
+    try:
+        ref_eng = InferenceEngine(
+            cfg,
+            EngineConfig(
+                num_slots=2, max_seq=64, prefill_buckets=(16,), dtype="float32",
+                max_sessions=0,
+            ),
+            params=params,
+        )
+        ref_eng.start()
+        try:
+            a = _greedy_turn(eng, [5, 6, 7])
+            b = _greedy_turn(ref_eng, [5, 6, 7])
+            # Same weights, int8 vs dense: greedy paths usually agree on
+            # the first tokens; require a common prefix, not equality.
+            assert a[:2] == b[:2]
+        finally:
+            ref_eng.stop()
+    finally:
+        eng.stop()
+
+
+def test_engine_on_mesh_quantized():
+    cfg = get_config("test-tiny-gqa8")  # 8 kv heads: tp=4 divides them
+    params = llama.init_params(cfg, jax.random.key(0), dtype=jnp.float32)
+    eng = InferenceEngine(
+        cfg,
+        EngineConfig(
+            num_slots=2, max_seq=64, prefill_buckets=(16,), dtype="float32",
+            quant="int8", dp=2, tp=4, max_sessions=0,
+        ),
+        params=params,
+    )
+    eng.start()
+    try:
+        toks = _greedy_turn(eng, [1, 2, 3])
+        assert len(toks) == 8
+    finally:
+        eng.stop()
+
+
+# ---------------------------------------------------------------------------
+# Checkpoint loader
+# ---------------------------------------------------------------------------
+
+
+def test_load_params_quantized(tiny, tmp_path):
+    cfg, params = tiny
+    path = str(tmp_path / "ckpt")
+    ckpt_io.save_params(params, cfg, path)
+    qparams = ckpt_io.load_params(path, cfg, dtype=jnp.float32, quant="int8")
+    assert quant.params_quantized(qparams)
+    toks = jax.random.randint(jax.random.key(4), (1, 10), 0, cfg.vocab_size)
+    ref = llama.forward_train(params, cfg, toks)
+    out = llama.forward_train(qparams, cfg, toks)
+    agree = jnp.mean(
+        (jnp.argmax(ref, axis=-1) == jnp.argmax(out, axis=-1)).astype(jnp.float32)
+    )
+    assert agree > 0.9
+
+
+def test_engine_adopts_and_validates_prequantized_mode(tiny):
+    cfg, params = tiny
+    qparams = quant.quantize_params(params, cfg, "int8-dynamic")
+    # quant unset → adopted from the tree (specs must match leaf layout).
+    eng = InferenceEngine(
+        cfg,
+        EngineConfig(num_slots=2, max_seq=64, prefill_buckets=(16,),
+                     dtype="float32", max_sessions=0),
+        params=qparams,
+    )
+    assert quant.detect_mode(eng.params) == "int8-dynamic"
+    # Contradictory config → hard error, not silent wrong arithmetic.
+    with pytest.raises(ValueError, match="int8"):
+        InferenceEngine(
+            cfg,
+            EngineConfig(num_slots=2, max_seq=64, prefill_buckets=(16,),
+                         dtype="float32", quant="int8", max_sessions=0),
+            params=qparams,
+        )
+
+
+def test_save_params_rejects_quantized(tiny, tmp_path):
+    cfg, params = tiny
+    qparams = quant.quantize_params(params, cfg, "int8")
+    with pytest.raises(ckpt_io.CheckpointError, match="int8"):
+        ckpt_io.save_params(qparams, cfg, str(tmp_path / "q"))
