@@ -28,13 +28,22 @@ class RecordingInterceptor:
         queue_limit: int = 1000,
         timeout_s: float = 5.0,
         agent: str = "",
+        attrs: Optional[dict] = None,
     ):
         self.url = session_api_url.rstrip("/") if session_api_url else None
         self.timeout_s = timeout_s
         # Stamped onto session records so the archive (and rollout
-        # analysis) can scope sessions to the agent that served them.
+        # analysis) can scope sessions to the agent that served them;
+        # attrs additionally carries the serving track/version so canary
+        # analysis can scope to candidate-pod sessions only.
         self.agent = agent
+        self.attrs = dict(attrs or {})
+        # A session is "ensured" only once its session record was
+        # DELIVERED — a dropped or failed ensure must retry on the next
+        # message or the session never gets its agent/track attribution.
         self._ensured: set[str] = set()
+        self._ensure_inflight: set[str] = set()
+        self._ensure_lock = threading.Lock()
         self._queue: "queue.Queue[dict]" = queue.Queue(maxsize=queue_limit)
         self._dropped = 0
         self._stop = threading.Event()
@@ -50,17 +59,27 @@ class RecordingInterceptor:
     # ------------------------------------------------------------------
 
     def record_user(self, session_id: str, user_id: str, content: str) -> None:
-        if session_id not in self._ensured:
+        with self._ensure_lock:
             if len(self._ensured) > 100_000:
                 self._ensured.clear()  # bounded memory; re-ensure is idempotent
-            self._ensured.add(session_id)
-            self._enqueue({
+            need = (
+                session_id not in self._ensured
+                and session_id not in self._ensure_inflight
+            )
+            if need:
+                self._ensure_inflight.add(session_id)
+        if need:
+            ok = self._enqueue({
                 "kind": "session",
                 "session_id": session_id,
                 "user_id": user_id,
                 "agent": self.agent,
+                "attrs": self.attrs,
                 "ts": time.time(),
             })
+            if not ok:  # dropped: retry on the next message
+                with self._ensure_lock:
+                    self._ensure_inflight.discard(session_id)
         self._enqueue(
             {
                 "kind": "message",
@@ -100,14 +119,16 @@ class RecordingInterceptor:
 
     # ------------------------------------------------------------------
 
-    def _enqueue(self, record: dict) -> None:
+    def _enqueue(self, record: dict) -> bool:
         if self.url is None:
-            return
+            return False
         try:
             self._queue.put_nowait(record)
+            return True
         except queue.Full:
             # Fail open: drop and count, never block the message path.
             self._dropped += 1
+            return False
 
     def _worker(self) -> None:
         while not self._stop.is_set():
@@ -115,6 +136,7 @@ class RecordingInterceptor:
                 record = self._queue.get(timeout=0.25)
             except queue.Empty:
                 continue
+            delivered = False
             try:
                 path = {
                     "message": "/api/v1/messages",
@@ -127,8 +149,16 @@ class RecordingInterceptor:
                     method="POST",
                 )
                 urllib.request.urlopen(req, timeout=self.timeout_s).read()
+                delivered = True
             except Exception as e:  # fail open
                 logger.debug("recording failed (open): %s", e)
+            if record["kind"] == "session":
+                sid = record["session_id"]
+                with self._ensure_lock:
+                    self._ensure_inflight.discard(sid)
+                    if delivered:
+                        self._ensured.add(sid)
+                    # else: next record_user re-sends the ensure
 
     def close(self) -> None:
         self._stop.set()

@@ -19,6 +19,7 @@ the candidate has served that many turns, analysis reports healthy
 
 from __future__ import annotations
 
+import concurrent.futures
 import json
 import logging
 import urllib.parse
@@ -28,6 +29,11 @@ from typing import Optional
 from omnia_tpu.operator.resources import Resource, ResourceKind, resolve_ref
 
 logger = logging.getLogger(__name__)
+
+
+class AnalysisFetchError(Exception):
+    """session-api unreachable/errored — distinct from 'no eval data yet',
+    so a declared eval gate fails closed instead of silently passing."""
 
 
 class AnalysisRunner:
@@ -53,34 +59,76 @@ class AnalysisRunner:
                 p95 = max(p95, hist.quantile(0.95))
         return messages, errors, p95
 
-    def _eval_pass_rate(self, agent: str) -> Optional[float]:
+    # Bounded work per analysis tick: this runs on the controller's
+    # reconcile thread, so total wall time must stay small even against a
+    # slow session-api.
+    _SESSION_SAMPLE = 20
+    _FETCH_TIMEOUT_S = 3.0
+    _FETCH_WORKERS = 8
+
+    def _eval_pass_rate(
+        self, agent: str, version: Optional[str]
+    ) -> Optional[float]:
+        """Pass rate over the candidate track's recent sessions.
+
+        Scoped server-side to the agent and client-side to sessions the
+        candidate pods served (attrs.track == "candidate", and the hash
+        under analysis when known) — stable-track sessions must not
+        dilute the canary verdict. Returns None only for the legitimate
+        'no candidate eval data yet' case; infrastructure failures raise
+        AnalysisFetchError (fail closed)."""
         if not self.session_api_url:
             return None
         try:
-            # Bounded, recent-first sample (the listing is sorted by
-            # updated_at desc); scoped to EXACTLY this agent's sessions —
-            # unattributed sessions must not leak other agents' evals into
-            # this verdict.
             with urllib.request.urlopen(
-                f"{self.session_api_url}/api/v1/sessions?limit=50", timeout=5
+                f"{self.session_api_url}/api/v1/sessions?limit=50"
+                f"&agent={urllib.parse.quote(agent, safe='')}",
+                timeout=self._FETCH_TIMEOUT_S,
             ) as r:
                 sessions = json.loads(r.read())["sessions"]
-            total = passed = 0
-            for s in sessions[:50]:
-                if s.get("agent") != agent:
-                    continue
-                with urllib.request.urlopen(
-                    f"{self.session_api_url}/api/v1/sessions/"
-                    f"{urllib.parse.quote(s['session_id'], safe='')}/eval-results",
-                    timeout=5,
-                ) as r:
-                    for res in json.loads(r.read())["eval_results"]:
-                        total += 1
-                        passed += bool(res.get("passed"))
-            return (passed / total) if total else None
-        except Exception:
-            logger.warning("eval pass-rate fetch failed", exc_info=True)
+        except Exception as e:
+            raise AnalysisFetchError(f"session listing failed: {e}") from e
+
+        candidates = [
+            s for s in sessions
+            if (s.get("attrs") or {}).get("track") == "candidate"
+            and (
+                version is None
+                or (s.get("attrs") or {}).get("version") == version
+            )
+        ][: self._SESSION_SAMPLE]
+        if not candidates:
             return None
+
+        def fetch(sid: str) -> list[dict]:
+            with urllib.request.urlopen(
+                f"{self.session_api_url}/api/v1/sessions/"
+                f"{urllib.parse.quote(sid, safe='')}/eval-results",
+                timeout=self._FETCH_TIMEOUT_S,
+            ) as r:
+                return json.loads(r.read())["eval_results"]
+
+        total = passed = 0
+        with concurrent.futures.ThreadPoolExecutor(self._FETCH_WORKERS) as ex:
+            futs = [ex.submit(fetch, s["session_id"]) for s in candidates]
+            done, not_done = concurrent.futures.wait(
+                futs, timeout=self._FETCH_TIMEOUT_S * 3
+            )
+            for f in not_done:
+                f.cancel()
+            if not_done:
+                raise AnalysisFetchError(
+                    f"{len(not_done)} eval-result fetches timed out"
+                )
+            for f in done:
+                try:
+                    results = f.result()
+                except Exception as e:
+                    raise AnalysisFetchError(f"eval-result fetch failed: {e}") from e
+                for res in results:
+                    total += 1
+                    passed += bool(res.get("passed"))
+        return (passed / total) if total else None
 
     # -- the analyzer hook --------------------------------------------
 
@@ -127,9 +175,23 @@ class AnalysisRunner:
                     observed = p95
                     verdict = observed <= float(metric.get("maxP95LatencyS", 1e9))
             elif name == "eval-pass-rate":
-                observed = self._eval_pass_rate(dep.resource.name)
-                if observed is not None:
-                    verdict = observed >= float(metric.get("threshold", 0.0))
+                version = (
+                    dep.candidate_pods[0].version if dep.candidate_pods else None
+                )
+                try:
+                    observed = self._eval_pass_rate(dep.resource.name, version)
+                except AnalysisFetchError:
+                    # A declared eval gate with an unreachable metrics
+                    # source must not promote (same stance as a missing
+                    # analysis ref).
+                    logger.warning(
+                        "eval pass-rate unavailable; failing closed",
+                        exc_info=True,
+                    )
+                    verdict = False
+                else:
+                    if observed is not None:
+                        verdict = observed >= float(metric.get("threshold", 0.0))
             else:
                 # A misspelled metric must not promote ungated — same
                 # fail-closed stance as a missing analysis ref.

@@ -154,7 +154,12 @@ class InProcessPodBackend:
         self._lock = threading.Lock()
 
     def start_pod(
-        self, dep: AgentDeployment, *, version: str = "", wait_ready: bool = True
+        self,
+        dep: AgentDeployment,
+        *,
+        version: str = "",
+        wait_ready: bool = True,
+        track: str = "stable",
     ) -> PodHandle:
         from omnia_tpu.facade.recording import RecordingInterceptor
         from omnia_tpu.facade.server import FacadeServer
@@ -180,7 +185,15 @@ class InProcessPodBackend:
         facade = FacadeServer(
             runtime_target=f"localhost:{runtime_port}",
             agent_name=dep.name,
-            recording=RecordingInterceptor(dep.session_api_url),
+            recording=RecordingInterceptor(
+                dep.session_api_url,
+                agent=dep.name,
+                # Track/version attribution: rollout analysis scopes its
+                # eval verdict to candidate-track sessions of the hash
+                # under analysis (reference rollout_analysis.go gates on
+                # candidate metrics, not whole-agent metrics).
+                attrs={"track": track, "version": version or dep.config_hash()},
+            ),
         )
         facade_port = facade.serve()
         handle = PodHandle(

@@ -160,7 +160,7 @@ class RolloutEngine:
         n = max(1, len(dep.pods))
         for _ in range(n):
             dep.candidate_pods.append(
-                self.backend.start_pod(dep, version=new_hash)
+                self.backend.start_pod(dep, version=new_hash, track="candidate")
             )
         dep.candidate_weight = first_step.weight
         st.phase = RolloutPhase.PROGRESSING

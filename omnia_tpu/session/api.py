@@ -109,8 +109,12 @@ class SessionAPI:
         if path == "/api/v1/sessions" and method == "GET":
             ws = (body or {}).get("workspace")
             limit = int((body or {}).get("limit", 100))
+            ag = (body or {}).get("agent")
             return 200, {
-                "sessions": [to_dict(s) for s in self.store.list_sessions(ws, limit)]
+                "sessions": [
+                    to_dict(s)
+                    for s in self.store.list_sessions(ws, limit, agent=ag)
+                ]
             }
         m = _SESSION_PATH.match(path)
         if m:

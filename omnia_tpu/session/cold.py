@@ -197,12 +197,17 @@ class ColdArchive:
         )
 
     def list_sessions(
-        self, workspace: Optional[str] = None, limit: int = 100
+        self,
+        workspace: Optional[str] = None,
+        limit: int = 100,
+        agent: Optional[str] = None,
     ) -> list[SessionRecord]:
         m = self._load_manifest()
         out = []
         for sid, entry in m["sessions"].items():
             if workspace is not None and entry["workspace"] != workspace:
+                continue
+            if agent is not None and entry["agent"] != agent:
                 continue
             out.append(
                 SessionRecord(

@@ -97,7 +97,10 @@ class HotStore:
             return b.session
 
     def list_sessions(
-        self, workspace: Optional[str] = None, limit: int = 100
+        self,
+        workspace: Optional[str] = None,
+        limit: int = 100,
+        agent: Optional[str] = None,
     ) -> list[SessionRecord]:
         with self._lock:
             out = [
@@ -105,6 +108,7 @@ class HotStore:
                 for b in self._bundles.values()
                 if not self._expired(b)
                 and (workspace is None or b.session.workspace == workspace)
+                and (agent is None or b.session.agent == agent)
             ]
         out.sort(key=lambda s: -s.updated_at)
         return out[:limit]

@@ -111,20 +111,25 @@ class PgWarmStore:
         return self._row_to_session(rows[0]) if rows else None
 
     def list_sessions(
-        self, workspace: Optional[str] = None, limit: int = 100
+        self,
+        workspace: Optional[str] = None,
+        limit: int = 100,
+        agent: Optional[str] = None,
     ) -> list[SessionRecord]:
+        clauses, args = [], []
         if workspace is not None:
-            rows = self.client.query(
-                f"SELECT {self._SESSION_COLS} FROM sessions WHERE workspace=$1"
-                " ORDER BY updated_at DESC LIMIT $2",
-                [workspace, limit],
-            )
-        else:
-            rows = self.client.query(
-                f"SELECT {self._SESSION_COLS} FROM sessions"
-                " ORDER BY updated_at DESC LIMIT $1",
-                [limit],
-            )
+            args.append(workspace)
+            clauses.append(f"workspace=${len(args)}")
+        if agent is not None:
+            args.append(agent)
+            clauses.append(f"agent=${len(args)}")
+        where = (" WHERE " + " AND ".join(clauses)) if clauses else ""
+        args.append(limit)
+        rows = self.client.query(
+            f"SELECT {self._SESSION_COLS} FROM sessions{where}"
+            f" ORDER BY updated_at DESC LIMIT ${len(args)}",
+            args,
+        )
         return [self._row_to_session(r) for r in rows]
 
     def delete_session(self, session_id: str) -> bool:

@@ -149,7 +149,10 @@ class RedisHotStore:
         return rec
 
     def list_sessions(
-        self, workspace: Optional[str] = None, limit: int = 100
+        self,
+        workspace: Optional[str] = None,
+        limit: int = 100,
+        agent: Optional[str] = None,
     ) -> list[SessionRecord]:
         out = []
         for sid in reversed(self.client.zrange(self._idx(), 0, -1)):
@@ -157,6 +160,8 @@ class RedisHotStore:
             if rec is None or self._expired(rec):
                 continue
             if workspace is not None and rec.workspace != workspace:
+                continue
+            if agent is not None and rec.agent != agent:
                 continue
             out.append(rec)
             if len(out) >= limit:

@@ -25,7 +25,10 @@ class SessionStore(Protocol):
     def get_session(self, session_id: str) -> Optional[SessionRecord]: ...
 
     def list_sessions(
-        self, workspace: Optional[str] = None, limit: int = 100
+        self,
+        workspace: Optional[str] = None,
+        limit: int = 100,
+        agent: Optional[str] = None,
     ) -> list[SessionRecord]: ...
 
     def delete_session(self, session_id: str) -> bool: ...

@@ -74,11 +74,14 @@ class TieredStore:
         )
 
     def list_sessions(
-        self, workspace: Optional[str] = None, limit: int = 100
+        self,
+        workspace: Optional[str] = None,
+        limit: int = 100,
+        agent: Optional[str] = None,
     ) -> list[SessionRecord]:
         seen: dict[str, SessionRecord] = {}
         for tier in (self.hot, self.warm, self.cold):
-            for s in tier.list_sessions(workspace, limit):
+            for s in tier.list_sessions(workspace, limit, agent=agent):
                 seen.setdefault(s.session_id, s)
         out = sorted(seen.values(), key=lambda s: -s.updated_at)
         return out[:limit]

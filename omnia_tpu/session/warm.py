@@ -116,16 +116,25 @@ class WarmStore:
         return self._row_to_session(row) if row else None
 
     def list_sessions(
-        self, workspace: Optional[str] = None, limit: int = 100
+        self,
+        workspace: Optional[str] = None,
+        limit: int = 100,
+        agent: Optional[str] = None,
     ) -> list[SessionRecord]:
         q = (
             "SELECT session_id, workspace, agent, user_id, created_at,"
             " updated_at, archived, tier, attrs FROM sessions"
         )
-        params: tuple = ()
+        clauses, params_l = [], []
         if workspace is not None:
-            q += " WHERE workspace=?"
-            params = (workspace,)
+            clauses.append("workspace=?")
+            params_l.append(workspace)
+        if agent is not None:
+            clauses.append("agent=?")
+            params_l.append(agent)
+        if clauses:
+            q += " WHERE " + " AND ".join(clauses)
+        params: tuple = tuple(params_l)
         q += " ORDER BY updated_at DESC LIMIT ?"
         with self._lock:
             rows = self._db.execute(q, params + (limit,)).fetchall()

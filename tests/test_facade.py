@@ -232,8 +232,13 @@ class TestFacade:
             ws.recv(timeout=10)
             ws.send(json.dumps({"type": "message", "content": "hello recorder"}))
             _recv_until(ws, {"done", "error"})
-        deadline = time.monotonic() + 5
-        while time.monotonic() < deadline and len(records) < before + 2:
+        # Wait for both *message* records (session-ensure records also
+        # land in the sink, so a raw count races the assistant delivery).
+        deadline = time.monotonic() + 15
+        while time.monotonic() < deadline:
+            msgs = [r for _, r in records[before:] if r.get("kind") == "message"]
+            if len(msgs) >= 2:
+                break
             time.sleep(0.05)
         new = [r for _, r in records[before:]]
         roles = [r["role"] for r in new if r.get("kind") == "message"]
