@@ -70,6 +70,11 @@ from omnia_tpu.utils.compile_cache import enable_compilation_cache
 
 logger = logging.getLogger(__name__)
 
+# Per-slot stop-token ids tracked ON DEVICE (padded with -1). Requests with
+# more stop ids than this still finish correctly — the host checks the full
+# set — the device mask just can't early-freeze on the overflow ids.
+MAX_DEVICE_STOP_IDS = 8
+
 
 class _Slot:
     __slots__ = (
@@ -230,6 +235,14 @@ class InferenceEngine:
         }
 
         self._build_programs()
+        from omnia_tpu.ops.attention import pallas_decode_mode
+
+        logger.info(
+            "engine built: backend=%s pallas_decode=%s slots=%d max_seq=%d "
+            "chunks=%s quant=%s",
+            jax.default_backend(), pallas_decode_mode(), B, engine_cfg.max_seq,
+            self.cfg.chunk_variants(), qmode,
+        )
 
     def _init_device_state(self):
         """(Re)allocate KV caches and per-slot device state. Called at
@@ -251,6 +264,14 @@ class InferenceEngine:
         self._top_p = jnp.ones((B,), jnp.float32)
         self._top_k = jnp.zeros((B,), jnp.int32)
         self._active = jnp.zeros((B,), jnp.bool_)
+        # Device-side finish tracking: remaining emission budget after the
+        # first token, and the request's stop ids (-1 padded). The decode
+        # chunk deactivates a slot the step it hits a stop id or exhausts
+        # its budget, so positions freeze and no garbage rows are written
+        # for the rest of the chunk — the host stays authoritative for
+        # handles, the device mask just stops wasted work.
+        self._budget = jnp.zeros((B,), jnp.int32)
+        self._stop_ids = jnp.full((B, MAX_DEVICE_STOP_IDS), -1, jnp.int32)
         self._key_data = jnp.stack(
             [make_slot_key_data(self._seed + 1 + i) for i in range(B)]
         )
@@ -300,39 +321,57 @@ class InferenceEngine:
         max_seq = self.cfg.max_seq
 
         def make_decode(chunk: int):
-            def decode_chunk(params, ck, cv, tokens, positions, active, key_data, temp, top_p, top_k):
+            def decode_chunk(params, ck, cv, tokens, positions, active, budget,
+                             stop_ids, key_data, temp, top_p, top_k):
                 """`chunk` decode steps in ONE compiled program (lax.scan):
                 one host↔device round trip per K tokens instead of per
-                token. Inactive slots' positions stay frozen (they re-write
-                row 0, which the next prefill's insert overwrites)."""
+                token. Stop-token/length finishes are masked ON DEVICE:
+                the step that samples a stop id (or exhausts the slot's
+                budget) deactivates the slot inside the scan, freezing its
+                position — a mid-chunk finish costs zero further row
+                writes or position advances, so large chunks don't trade
+                correctness-adjacent garbage for RTT amortization.
+                Inactive slots' frozen row is re-written each step (row 0
+                for unpinned slots — the next prefill's insert overwrites
+                it — or the session's valid-row frontier for pinned ones:
+                garbage only ever lives at rows ≥ the session's length)."""
 
                 def body(carry, _):
-                    ck, cv, tokens, positions, key_data = carry
+                    ck, cv, tokens, positions, active, budget, key_data = carry
                     logits, ck, cv = llama.forward(
                         params, cfg, tokens[:, None], positions[:, None], ck, cv, positions
                     )
                     tok, key_data = sample_tokens_per_slot(
                         logits[:, 0], key_data, temp, top_p, top_k
                     )
+                    # Position advances for the row just written (gated on
+                    # active at step START); deactivation applies from the
+                    # NEXT step on, mirroring the host's finish bookkeeping.
                     positions = jnp.where(
                         active, jnp.minimum(positions + 1, max_seq - 1), positions
                     )
-                    return (ck, cv, tok, positions, key_data), tok
+                    budget = budget - active.astype(jnp.int32)
+                    hit_stop = (tok[:, None] == stop_ids).any(axis=1)
+                    active = active & ~hit_stop & (budget > 0)
+                    tokens = jnp.where(active | hit_stop, tok, tokens)
+                    return (ck, cv, tokens, positions, active, budget, key_data), tok
 
-                (ck, cv, tokens, positions, key_data), toks = jax.lax.scan(
-                    body, (ck, cv, tokens, positions, key_data), None, length=chunk
+                (ck, cv, tokens, positions, active, budget, key_data), toks = jax.lax.scan(
+                    body, (ck, cv, tokens, positions, active, budget, key_data),
+                    None, length=chunk,
                 )
-                return ck, cv, tokens, positions, key_data, toks  # toks [K, B]
+                # toks [K, B]
+                return ck, cv, tokens, positions, active, budget, key_data, toks
 
             return jax.jit(decode_chunk, donate_argnums=(1, 2))
 
-        # Two compiled variants: the big chunk for steady-state throughput,
-        # a single step while requests are queued so a waiting prefill never
-        # sits out a long chunk (TTFT discipline).
-        self._decode_fn = make_decode(max(1, self.cfg.decode_chunk))
-        self._decode_fn_single = (
-            make_decode(1) if self.cfg.decode_chunk > 1 else self._decode_fn
-        )
+        # Compiled chunk-size variants: the big chunk for steady-state
+        # throughput, smaller ones so the tail of a generation (or a step
+        # taken while requests queue — TTFT discipline) doesn't pay for a
+        # full chunk. _pick_chunk chooses per dispatch.
+        self._decode_fns = {k: make_decode(k) for k in self.cfg.chunk_variants()}
+        self._decode_fn = self._decode_fns[max(self._decode_fns)]
+        self._decode_fn_single = self._decode_fns[1]
 
         # --- sessionful-KV programs -----------------------------------
         # Incremental extend: run the suffix through `forward` against the
@@ -404,21 +443,27 @@ class InferenceEngine:
 
         self._restore_fn = jax.jit(restore, donate_argnums=(0, 1))
 
-    def warmup(self):
-        """AOT-compile decode + all usable prefill buckets + the sessionful
-        extend/offload/restore programs (called before ready — the request
-        path must never hit a compile). Behavior-neutral: all device state
-        and metrics it touched are restored afterwards."""
+    def warmup(self, sessions: bool = True):
+        """AOT-compile decode (all chunk variants) + all usable prefill
+        buckets + the sessionful extend/offload/restore programs (called
+        before ready — the request path must never hit a compile).
+        Behavior-neutral: all device state and metrics it touched are
+        restored afterwards.
+
+        sessions=False skips the extend/offload/restore family — only
+        valid for serving without session KV reuse AND with every prompt
+        fitting the largest prefill bucket (the chunked-prefill path uses
+        extend too). The bench uses it to keep warmup inside the driver
+        budget on a cold compile cache."""
         t0 = time.monotonic()
         metrics_before = dict(self.metrics)
-        self._run_decode_step()
-        if self._decode_fn_single is not self._decode_fn:
-            self._run_decode_step(single=True)
+        for k in self._decode_fns:
+            self._run_decode_step(chunk=k)
         kd = self._key_data[0]
         zero = jnp.int32(0)
         sargs = (kd, jnp.float32(0.0), jnp.float32(1.0), jnp.int32(0))
-        extend_shapes = set(self.cfg.usable_buckets()) | {1}
-        for b in sorted(extend_shapes):
+        extend_shapes = set(self.cfg.usable_buckets()) | {1} if sessions else set()
+        for b in sorted(set(self.cfg.usable_buckets()) | extend_shapes):
             toks = jnp.zeros((1, b), jnp.int32)
             pos = jnp.arange(b, dtype=jnp.int32)[None, :]
             if b in self.cfg.usable_buckets():
@@ -432,20 +477,25 @@ class InferenceEngine:
                     and b % self.cfg.sp == 0
                 ):
                     self._prefill_ring_fn(self.params, toks, pos)
-            self._ck, self._cv = self._extend_nosample_fn(
-                self.params, self._ck, self._cv, toks, pos, zero, zero
-            )
-            self._ck, self._cv, _, _ = self._extend_fn(
-                self.params, self._ck, self._cv, toks, pos, zero, zero, zero, *sargs
-            )
-        for r in self.cfg.restore_buckets():
-            k, v = self._offload_fn(self._ck, self._cv, zero, r)
-            self._ck, self._cv = self._restore_fn(self._ck, self._cv, k, v, zero)
+            if b in extend_shapes:
+                self._ck, self._cv = self._extend_nosample_fn(
+                    self.params, self._ck, self._cv, toks, pos, zero, zero
+                )
+                self._ck, self._cv, _, _ = self._extend_fn(
+                    self.params, self._ck, self._cv, toks, pos, zero, zero, zero, *sargs
+                )
+        if sessions:
+            for r in self.cfg.restore_buckets():
+                k, v = self._offload_fn(self._ck, self._cv, zero, r)
+                self._ck, self._cv = self._restore_fn(self._ck, self._cv, k, v, zero)
         # Restore everything warmup wrote (cache contents, PRNG streams,
         # positions, metrics) so warmup cannot perturb request sampling.
         self._init_device_state()
         self.metrics.update(metrics_before)
-        logger.info("engine warmup done in %.1fs", time.monotonic() - t0)
+        logger.info(
+            "engine warmup done in %.1fs (%d decode variants, sessions=%s)",
+            time.monotonic() - t0, len(self._decode_fns), sessions,
+        )
 
     # ------------------------------------------------------------------
     # Submission API
@@ -607,19 +657,7 @@ class InferenceEngine:
         are optimistic (max_tokens); the cost of optimism is one garbage
         chunk, the cost of pessimism would be no pipelining for any request
         that carries an EOS id (all real chat traffic)."""
-        inflight_steps: dict[int, int] = {}
-        for toks, active in self._inflight:
-            k = int(toks.shape[0])
-            for i, _rid in active:
-                inflight_steps[i] = inflight_steps.get(i, 0) + k
-        for i, s in enumerate(self._slots):
-            if not s.active:
-                continue
-            pending = inflight_steps.get(i, 0)
-            if s.generated + pending < s.max_total and \
-                    s.length + pending < self.cfg.max_seq - 2:
-                return True
-        return False
+        return self._remaining_work() > 0
 
     def _drain_releases(self) -> None:
         with self._lock:
@@ -817,6 +855,20 @@ class InferenceEngine:
         self._temp = self._temp.at[slot_idx].set(sp.temperature)
         self._top_p = self._top_p.at[slot_idx].set(sp.top_p)
         self._top_k = self._top_k.at[slot_idx].set(sp.top_k)
+        # Device-side finish state: decode emissions still allowed after
+        # the first token. MUST equal the host's finish schedule exactly
+        # (generated >= max_tokens OR length >= max_seq - 2, checked after
+        # each emission): a device mask firing EARLIER than the host's
+        # would freeze the slot while the host keeps consuming its chunk
+        # rows as real tokens. Stop-id row is -1 padded; ids past
+        # MAX_DEVICE_STOP_IDS are host-checked only (host-early is safe).
+        budget = min(sp.max_tokens - 1, self.cfg.max_seq - 2 - n)
+        self._budget = self._budget.at[slot_idx].set(max(budget, 0))
+        ids = list(sp.stop_token_ids)[:MAX_DEVICE_STOP_IDS]
+        ids += [-1] * (MAX_DEVICE_STOP_IDS - len(ids))
+        self._stop_ids = self._stop_ids.at[slot_idx].set(
+            jnp.asarray(ids, jnp.int32)
+        )
         self._emit_token(slot_idx, int(first_tok))
 
     def _fresh_prefill(self, slot_idx: int, prompt: list[int], sp: SamplingParams):
@@ -894,17 +946,25 @@ class InferenceEngine:
         self.metrics["extend_steps"] += len(pieces)
         return first_tok
 
-    def _run_decode_step(self, single: bool = False):
+    def _run_decode_step(self, single: bool = False, chunk: Optional[int] = None):
         """One chunked decode dispatch → host tokens [K, B]. Position
-        advancement happens on-device inside the scan (active slots only).
-        `single` picks the 1-step variant (used while work is queued so a
-        waiting prefill doesn't sit out a full chunk)."""
-        fn = self._decode_fn_single if single else self._decode_fn
+        advancement AND stop/length deactivation happen on-device inside
+        the scan. `single` picks the 1-step variant (used while work is
+        queued so a waiting prefill doesn't sit out a full chunk); `chunk`
+        picks an explicit compiled variant."""
+        if single:
+            fn = self._decode_fn_single
+        elif chunk is not None:
+            fn = self._decode_fns[chunk]
+        else:
+            fn = self._decode_fn
         (
             self._ck,
             self._cv,
             self._tokens,
             self._positions,
+            self._active,
+            self._budget,
             self._key_data,
             toks,
         ) = fn(
@@ -914,6 +974,8 @@ class InferenceEngine:
             self._tokens,
             self._positions,
             self._active,
+            self._budget,
+            self._stop_ids,
             self._key_data,
             self._temp,
             self._top_p,
@@ -922,18 +984,54 @@ class InferenceEngine:
         self.metrics["decode_steps"] += int(toks.shape[0])
         return toks
 
+    def _remaining_work(self) -> int:
+        """Max over active slots of tokens still to emit beyond steps
+        already in flight — how many more decode steps could do real work
+        for SOMEONE."""
+        inflight_steps: dict[int, int] = {}
+        for toks, active in self._inflight:
+            k = int(toks.shape[0])
+            for i, _rid in active:
+                inflight_steps[i] = inflight_steps.get(i, 0) + k
+        need = 0
+        for i, s in enumerate(self._slots):
+            if not s.active:
+                continue
+            rem = min(
+                s.max_total - s.generated,
+                self.cfg.max_seq - 2 - s.length,
+            ) - inflight_steps.get(i, 0)
+            need = max(need, rem)
+        return need
+
+    def _pick_chunk(self) -> int:
+        """Chunk size for the remaining useful work: the full chunk while
+        work exceeds it, else the SMALLEST variant covering the remainder.
+        Overshoot is preferred to undershoot — the on-device finish mask
+        makes overshot steps cheap garbage (~one model step each), while
+        an extra dispatch costs a full host round trip (the dominant cost
+        on a remote-device link)."""
+        need = max(self._remaining_work(), 1)
+        best = max(self._decode_fns)
+        for k in sorted(self._decode_fns):
+            if k >= need:
+                best = k
+                break
+        return best
+
     def _dispatch_decode(self, single: bool = False):
         """Dispatch one decode chunk asynchronously: device state advances
         to output futures immediately; the token read is deferred to
         _process_oldest_chunk. The active-slot list is snapshotted at
         dispatch time — a slot that finishes while this chunk is in flight
-        produced garbage rows past its valid frontier, which the sessionful
-        bookkeeping already tolerates (garbage only at rows ≥ session
-        length)."""
+        is deactivated on-device the same step, so it stops writing rows;
+        any rows it DID write past its valid frontier are tolerated by the
+        sessionful bookkeeping (garbage only at rows ≥ session length)."""
         active = [
             (i, s.request.request_id) for i, s in enumerate(self._slots) if s.active
         ]
-        toks = self._run_decode_step(single=single)
+        chunk = 1 if single else self._pick_chunk()
+        toks = self._run_decode_step(chunk=chunk)
         self._inflight.append((toks, active))
 
     def _process_oldest_chunk(self):

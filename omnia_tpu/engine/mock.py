@@ -89,7 +89,7 @@ class MockEngine:
             "tokens_generated": 0,
         }
 
-    def warmup(self):
+    def warmup(self, sessions: bool = True):
         pass
 
     def queue_depth(self) -> int:

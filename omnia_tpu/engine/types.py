@@ -154,8 +154,17 @@ class EngineConfig:
     # through a tunnel/remote device — so K tokens per sync amortizes it.
     # Trade-offs: streaming granularity becomes K tokens, a queued prefill
     # waits up to one chunk, and a slot finishing mid-chunk wastes ≤K-1
-    # slot-steps. 1 = per-token sync.
+    # slot-steps (bounded by on-device stop/length masking: a finished
+    # slot stops advancing/writing inside the chunk). 1 = per-token sync.
     decode_chunk: int = 8
+    # Additional compiled chunk sizes for adaptive dispatch. While more
+    # work remains than the full chunk, the engine dispatches decode_chunk;
+    # for the tail it picks the SMALLEST variant covering the remaining
+    # work (overshoot preferred: overshot steps are cheap on-device-masked
+    # garbage, an extra dispatch is a full host round trip — see
+    # _pick_chunk). () = {decode_chunk, 1}. Every variant costs one warmup
+    # compile.
+    decode_chunk_variants: tuple[int, ...] = ()
     # Decode chunks kept in flight (dispatched on the previous chunk's
     # output futures before its tokens are read). 2 hides the host's
     # read-RTT + bookkeeping gap behind device compute — the device runs
@@ -171,6 +180,17 @@ class EngineConfig:
     # activation quant, int8×int8 MXU path — fastest). Dense models only;
     # see models/quant.py.
     quant: Optional[str] = None
+
+    def chunk_variants(self) -> tuple[int, ...]:
+        """Compiled decode-chunk sizes, descending, always containing
+        decode_chunk and 1 (the queued-prefill TTFT escape hatch)."""
+        sizes = set(self.decode_chunk_variants) | {max(1, self.decode_chunk), 1}
+        bad = [k for k in sizes if k < 1 or k > max(1, self.decode_chunk)]
+        if bad:
+            raise ValueError(
+                f"decode_chunk_variants {bad} outside [1, decode_chunk]"
+            )
+        return tuple(sorted(sizes, reverse=True))
 
     def restore_buckets(self) -> tuple[int, ...]:
         """Row counts used when moving a session's KV rows device↔host:

@@ -129,26 +129,38 @@ class Consolidator:
     # -- pass -------------------------------------------------------------
 
     def run_once(self, workspace_id: str) -> dict:
-        """One consolidation pass (single-flight)."""
+        """One consolidation pass. Single-flight in-process, and — when
+        the store is the durable tier — cross-process via its advisory
+        lock (reference internal/memory/postgres/advisory_lock.go: one
+        consolidation worker per workspace across all memory-api pods)."""
         if not self._lock.acquire(blocking=False):
             return {"skipped": True}
+        lock_key = f"memory-consolidation:{workspace_id}"
+        locker = getattr(self.store, "try_advisory_lock", None)
         try:
-            merged = 0
-            for survivor, dup, _sim in self.find_duplicates(workspace_id):
-                # Both sides must still be live at merge time: an earlier
-                # pair may have superseded either one, and folding content
-                # into an already-superseded survivor would strand it
-                # (scan filters superseded entries).
-                s_now, d_now = self.store.get(survivor.id), self.store.get(dup.id)
-                if (
-                    s_now is not None
-                    and d_now is not None
-                    and s_now.superseded_by is None
-                    and d_now.superseded_by is None
-                ):
-                    self.merge(s_now, d_now)
-                    merged += 1
-            conflicts = self.detect_conflicts(workspace_id)
-            return {"skipped": False, "merged": merged, "conflicts": len(conflicts)}
+            if locker is not None and not locker(lock_key):
+                return {"skipped": True}
+            return self._pass(workspace_id)
         finally:
+            if locker is not None:
+                self.store.advisory_unlock(lock_key)
             self._lock.release()
+
+    def _pass(self, workspace_id: str) -> dict:
+        merged = 0
+        for survivor, dup, _sim in self.find_duplicates(workspace_id):
+            # Both sides must still be live at merge time: an earlier
+            # pair may have superseded either one, and folding content
+            # into an already-superseded survivor would strand it
+            # (scan filters superseded entries).
+            s_now, d_now = self.store.get(survivor.id), self.store.get(dup.id)
+            if (
+                s_now is not None
+                and d_now is not None
+                and s_now.superseded_by is None
+                and d_now.superseded_by is None
+            ):
+                self.merge(s_now, d_now)
+                merged += 1
+        conflicts = self.detect_conflicts(workspace_id)
+        return {"skipped": False, "merged": merged, "conflicts": len(conflicts)}

@@ -127,14 +127,33 @@ def quantize_params(params, cfg, mode: str = "int8"):
 def quantize_param_specs(specs, cfg, mode: str = "int8"):
     """Transform the ``llama.param_specs`` pytree to match quantized
     params: the int8 tensor keeps the weight's spec; the scale drops the
-    contraction axis (index ndim-2) from it."""
+    contraction axis (index ndim-2) from it.
+
+    Specs must be FULL-LENGTH (one entry per array dim). A shortened
+    PartitionSpec is legal in JAX (trailing dims implicitly replicated)
+    but would silently misalign the contraction/output slicing below, so
+    it is rejected here (ADVICE r2). Quantized leaves are stacked
+    [L, in, out] (ndim 3) everywhere except lm_head [in, out] (ndim 2)."""
     key = _key_for(mode)
 
-    def leaf(spec):
-        entries = tuple(spec)
-        return {key: spec, "s": P(*entries[: len(entries) - 2], entries[-1])}
+    def make_leaf(expect_ndim: int):
+        def leaf(spec):
+            entries = tuple(spec)
+            if len(entries) != expect_ndim:
+                raise ValueError(
+                    f"quantized weight spec {spec} has {len(entries)} entries, "
+                    f"expected {expect_ndim}; shortened PartitionSpecs would "
+                    "misalign the scale's contraction-axis slicing"
+                )
+            return {key: spec, "s": P(*entries[: len(entries) - 2], entries[-1])}
 
-    return _map_quant_leaves(specs, cfg.is_moe, leaf)
+        return leaf
+
+    stacked = {k: v for k, v in specs.items() if k != "lm_head"}
+    out = _map_quant_leaves(stacked, cfg.is_moe, make_leaf(3))
+    if "lm_head" in specs:
+        out["lm_head"] = make_leaf(2)(specs["lm_head"])
+    return out
 
 
 def init_params_quantized(cfg, key: jax.Array, mode: str = "int8", dtype=jnp.bfloat16):

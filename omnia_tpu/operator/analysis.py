@@ -79,24 +79,26 @@ class AnalysisRunner:
         AnalysisFetchError (fail closed)."""
         if not self.session_api_url:
             return None
+        # Track/version filtering happens SERVER-SIDE (attrs.* query
+        # params): heavy stable-track traffic can push every candidate
+        # session out of a recency-limited page, which would make this
+        # return None ('no data yet') and silently pass a gate that DOES
+        # have candidate data (ADVICE r2).
+        query = (
+            f"limit={self._SESSION_SAMPLE}"
+            f"&agent={urllib.parse.quote(agent, safe='')}"
+            "&attrs.track=candidate"
+        )
+        if version is not None:
+            query += f"&attrs.version={urllib.parse.quote(str(version), safe='')}"
         try:
             with urllib.request.urlopen(
-                f"{self.session_api_url}/api/v1/sessions?limit=50"
-                f"&agent={urllib.parse.quote(agent, safe='')}",
+                f"{self.session_api_url}/api/v1/sessions?{query}",
                 timeout=self._FETCH_TIMEOUT_S,
             ) as r:
-                sessions = json.loads(r.read())["sessions"]
+                candidates = json.loads(r.read())["sessions"]
         except Exception as e:
             raise AnalysisFetchError(f"session listing failed: {e}") from e
-
-        candidates = [
-            s for s in sessions
-            if (s.get("attrs") or {}).get("track") == "candidate"
-            and (
-                version is None
-                or (s.get("attrs") or {}).get("version") == version
-            )
-        ][: self._SESSION_SAMPLE]
         if not candidates:
             return None
 
@@ -111,8 +113,13 @@ class AnalysisRunner:
         total = passed = 0
         with concurrent.futures.ThreadPoolExecutor(self._FETCH_WORKERS) as ex:
             futs = [ex.submit(fetch, s["session_id"]) for s in candidates]
+            # Aggregate wait sized from the wave count with one wave of
+            # slack: a healthy-but-slow session-api near the per-request
+            # timeout must not trip fail-closed with zero headroom
+            # (ADVICE r2: 3s*3 exactly equaled the worst legitimate case).
+            waves = -(-len(futs) // self._FETCH_WORKERS)  # ceil
             done, not_done = concurrent.futures.wait(
-                futs, timeout=self._FETCH_TIMEOUT_S * 3
+                futs, timeout=self._FETCH_TIMEOUT_S * (waves + 1)
             )
             for f in not_done:
                 f.cancel()

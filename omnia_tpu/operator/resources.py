@@ -50,8 +50,13 @@ EE_KINDS = frozenset({
 # Enum vocabularies shared with validation (reference anchors cited).
 FACADE_TYPES = ("websocket", "a2a", "rest", "mcp")  # agentruntime_types.go:1408-1417
 AGENT_MODES = ("agent", "function")  # agentruntime_types.go:1356-1394
-PROVIDER_TYPES = ("tpu", "mock")  # reference enum :382-414 + the new tpu type
-PROVIDER_ROLES = ("llm", "embedding")  # provider_types.go:40-63 (serving subset)
+# Reference enum :382-414 + the new tpu type; "tone" is the in-tree
+# model-free pcm16 speech codec standing in for the reference's remote
+# cartesia/elevenlabs speech types (provider_types.go:407-409).
+PROVIDER_TYPES = ("tpu", "mock", "tone")
+# provider_types.go:40-63; image/inference validated for parity, served
+# when an on-device image/inference family lands.
+PROVIDER_ROLES = ("llm", "embedding", "tts", "stt", "image", "inference")
 TOOL_HANDLER_TYPES = ("http", "openapi", "grpc", "mcp", "client")  # toolregistry :26-51
 
 

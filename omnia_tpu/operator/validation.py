@@ -83,7 +83,23 @@ def _validate_provider(spec: dict, errs: list[str]) -> None:
     role = spec.get("role", "llm")
     if role not in PROVIDER_ROLES:
         errs.append(f"role must be one of {PROVIDER_ROLES}, got {role!r}")
-    if t == "tpu" and not spec.get("model"):
+    # Role↔type compatibility, mirroring the reference's per-type role
+    # restrictions (provider_types.go:399-409: mock is LLM-role only,
+    # speech types are TTS/STT-role only).
+    role_types = {
+        "llm": ("tpu", "mock"),
+        "embedding": ("tpu", "mock"),
+        "tts": ("tone", "mock"),
+        "stt": ("tone", "mock"),
+        "image": (),
+        "inference": ("tpu",),
+    }
+    if role in role_types and t in PROVIDER_TYPES and t not in role_types[role]:
+        errs.append(
+            f"type {t!r} does not serve role {role!r} "
+            f"(valid types: {role_types[role] or '(none yet)'})"
+        )
+    if t == "tpu" and role in ("llm", "inference") and not spec.get("model"):
         errs.append("tpu provider requires spec.model (a model preset name)")
     pricing = spec.get("pricing", {})
     for k in ("inputPerMTok", "outputPerMTok"):

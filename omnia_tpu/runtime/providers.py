@@ -8,9 +8,14 @@ provider.go:93-135). Here the first-class citizens are:
 - type "tpu": the in-tree JAX continuous-batching engine on the attached
   slice (the north-star addition — zero external LLM calls),
 - type "mock": scripted scenario playback (reference mock-provider analog),
+- type "tone": model-free pcm16 speech codec for tts/stt roles (the
+  zero-external-call stand-in for the reference's Cartesia/ElevenLabs
+  remote speech types, provider_types.go:407-409),
 
 with the same named-provider indirection so AgentRuntime specs bind by
-name. Roles (llm | embedding) mirror the reference's provider roles.
+name. Roles (llm | embedding | tts | stt) mirror the reference's provider
+roles (provider_types.go:40-63); duplex voice resolves its speech pair
+from declared tts/stt-role providers (build_speech_support).
 """
 
 from __future__ import annotations
@@ -31,8 +36,8 @@ class ProviderError(ValueError):
 @dataclasses.dataclass(frozen=True)
 class ProviderSpec:
     name: str
-    type: str = "tpu"              # tpu | mock
-    role: str = "llm"              # llm | embedding
+    type: str = "tpu"              # tpu | mock | tone (speech roles)
+    role: str = "llm"              # llm | embedding | tts | stt
     model: str = "llama3-8b"       # ModelConfig preset name
     # Engine placement/shape options (forwarded to EngineConfig).
     options: dict = dataclasses.field(default_factory=dict)
@@ -102,6 +107,46 @@ def build_engine(spec: ProviderSpec, *, warmup: bool = False):
             engine.warmup()
         return engine
     raise ProviderError(f"unknown provider type {spec.type!r}")
+
+
+def build_speech_provider(spec: ProviderSpec):
+    """Instantiate the STT/TTS backend for a speech-role provider
+    (reference provider_spec.go maps role→SDK option the same way)."""
+    from omnia_tpu.runtime import duplex
+
+    table = {
+        ("stt", "mock"): duplex.MockStt,
+        ("tts", "mock"): duplex.MockTts,
+        ("stt", "tone"): duplex.TonePcmStt,
+        ("tts", "tone"): duplex.TonePcmTts,
+    }
+    maker = table.get((spec.role, spec.type))
+    if maker is None:
+        raise ProviderError(
+            f"provider {spec.name!r}: no {spec.role} backend of type "
+            f"{spec.type!r} (have mock, tone)"
+        )
+    return maker()
+
+
+def build_speech_support(registry: "ProviderRegistry"):
+    """Resolve the duplex speech pair from declared speech-role providers
+    — the reference resolves duplex speech from Provider CRDs the same
+    way (VERDICT r2 #6; internal/runtime/duplex.go negotiation). Returns
+    duplex.SpeechSupport, or None when either role is undeclared (the
+    runtime then advertises no duplex_audio capability)."""
+    from omnia_tpu.runtime.duplex import SpeechSupport
+
+    stt = tts = None
+    for name in registry.names():
+        spec = registry.spec(name)
+        if spec.role == "stt" and stt is None:
+            stt = build_speech_provider(spec)
+        elif spec.role == "tts" and tts is None:
+            tts = build_speech_provider(spec)
+    if stt is None or tts is None:
+        return None
+    return SpeechSupport(stt=stt, tts=tts)
 
 
 def build_tokenizer(spec: ProviderSpec):

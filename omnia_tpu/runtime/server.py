@@ -80,6 +80,13 @@ class RuntimeServer:
             # Honest capability advertisement (reference runtime.proto
             # :350-354): only claim memory when a capability is wired.
             self.capabilities.append(c.Capability.MEMORY.value)
+        if speech is None:
+            # Resolve the speech pair from declared tts/stt-role providers
+            # (reference: duplex speech comes from Provider CRDs, not
+            # hardwired backends — provider_types.go:40-63).
+            from omnia_tpu.runtime.providers import build_speech_support
+
+            speech = build_speech_support(providers)
         self.speech = speech  # duplex.SpeechSupport (None = no voice)
         if speech is not None and c.Capability.DUPLEX_AUDIO.value not in self.capabilities:
             self.capabilities.append(c.Capability.DUPLEX_AUDIO.value)

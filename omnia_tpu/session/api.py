@@ -110,10 +110,19 @@ class SessionAPI:
             ws = (body or {}).get("workspace")
             limit = int((body or {}).get("limit", 100))
             ag = (body or {}).get("agent")
+            # ?attrs.<key>=<value> query params become a server-side
+            # subset filter (rollout analysis scopes by track/version).
+            attrs = {
+                k[len("attrs."):]: v
+                for k, v in (body or {}).items()
+                if k.startswith("attrs.")
+            } or None
             return 200, {
                 "sessions": [
                     to_dict(s)
-                    for s in self.store.list_sessions(ws, limit, agent=ag)
+                    for s in self.store.list_sessions(
+                        ws, limit, agent=ag, attrs=attrs
+                    )
                 ]
             }
         m = _SESSION_PATH.match(path)

@@ -175,6 +175,9 @@ class ColdArchive:
                 "created_at": session.created_at,
                 "updated_at": session.updated_at,
                 "records": table.num_rows,
+                # attrs survive demotion so attr-scoped listings (rollout
+                # analysis track/version) still see archived sessions.
+                "attrs": dict(session.attrs or {}),
             }
             self._save_manifest(m)
         return key
@@ -194,6 +197,7 @@ class ColdArchive:
             updated_at=entry["updated_at"],
             archived=True,
             tier="cold",
+            attrs=entry.get("attrs") or {},
         )
 
     def list_sessions(
@@ -201,13 +205,18 @@ class ColdArchive:
         workspace: Optional[str] = None,
         limit: int = 100,
         agent: Optional[str] = None,
+        attrs: Optional[dict] = None,
     ) -> list[SessionRecord]:
+        from omnia_tpu.session.store import attrs_match
+
         m = self._load_manifest()
         out = []
         for sid, entry in m["sessions"].items():
             if workspace is not None and entry["workspace"] != workspace:
                 continue
             if agent is not None and entry["agent"] != agent:
+                continue
+            if not attrs_match(entry.get("attrs"), attrs):
                 continue
             out.append(
                 SessionRecord(
@@ -219,6 +228,7 @@ class ColdArchive:
                     updated_at=entry["updated_at"],
                     archived=True,
                     tier="cold",
+                    attrs=entry.get("attrs") or {},
                 )
             )
         out.sort(key=lambda s: -s.updated_at)

@@ -101,7 +101,10 @@ class HotStore:
         workspace: Optional[str] = None,
         limit: int = 100,
         agent: Optional[str] = None,
+        attrs: Optional[dict] = None,
     ) -> list[SessionRecord]:
+        from omnia_tpu.session.store import attrs_match
+
         with self._lock:
             out = [
                 b.session
@@ -109,6 +112,7 @@ class HotStore:
                 if not self._expired(b)
                 and (workspace is None or b.session.workspace == workspace)
                 and (agent is None or b.session.agent == agent)
+                and attrs_match(b.session.attrs, attrs)
             ]
         out.sort(key=lambda s: -s.updated_at)
         return out[:limit]

@@ -115,6 +115,7 @@ class PgWarmStore:
         workspace: Optional[str] = None,
         limit: int = 100,
         agent: Optional[str] = None,
+        attrs: Optional[dict] = None,
     ) -> list[SessionRecord]:
         clauses, args = [], []
         if workspace is not None:
@@ -124,13 +125,34 @@ class PgWarmStore:
             args.append(agent)
             clauses.append(f"agent=${len(args)}")
         where = (" WHERE " + " AND ".join(clauses)) if clauses else ""
-        args.append(limit)
-        rows = self.client.query(
+        base = (
             f"SELECT {self._SESSION_COLS} FROM sessions{where}"
-            f" ORDER BY updated_at DESC LIMIT ${len(args)}",
-            args,
+            f" ORDER BY updated_at DESC LIMIT ${len(args) + 1}"
+            f" OFFSET ${len(args) + 2}"
         )
-        return [self._row_to_session(r) for r in rows]
+        if not attrs:
+            rows = self.client.query(base, args + [limit, 0])
+            return [self._row_to_session(r) for r in rows]
+        # attrs live in a JSON column: page through recency order,
+        # filtering client-side, until `limit` MATCHING rows are found or
+        # the table is exhausted — a fixed page multiplier would just move
+        # the silent-drop threshold (ADVICE r2).
+        from omnia_tpu.session.store import attrs_match
+
+        out: list[SessionRecord] = []
+        offset, page = 0, 500
+        while len(out) < limit:
+            rows = self.client.query(base, args + [page, offset])
+            for r in rows:
+                s = self._row_to_session(r)
+                if attrs_match(s.attrs, attrs):
+                    out.append(s)
+                    if len(out) >= limit:
+                        break
+            if len(rows) < page:
+                break
+            offset += page
+        return out
 
     def delete_session(self, session_id: str) -> bool:
         existed = bool(self.client.query(

@@ -153,7 +153,10 @@ class RedisHotStore:
         workspace: Optional[str] = None,
         limit: int = 100,
         agent: Optional[str] = None,
+        attrs: Optional[dict] = None,
     ) -> list[SessionRecord]:
+        from omnia_tpu.session.store import attrs_match
+
         out = []
         for sid in reversed(self.client.zrange(self._idx(), 0, -1)):
             rec = self._load(sid.decode())
@@ -162,6 +165,8 @@ class RedisHotStore:
             if workspace is not None and rec.workspace != workspace:
                 continue
             if agent is not None and rec.agent != agent:
+                continue
+            if not attrs_match(rec.attrs, attrs):
                 continue
             out.append(rec)
             if len(out) >= limit:

@@ -18,6 +18,17 @@ from omnia_tpu.session.records import (
 )
 
 
+def attrs_match(have: Optional[dict], want: Optional[dict]) -> bool:
+    """Subset match: every (k, v) in `want` must equal `have[k]`. Used by
+    the list_sessions attrs filter (server-side track/version scoping for
+    rollout analysis — reference rollout_analysis.go scopes its candidate
+    queries server-side too; ADVICE r2 flagged the client-side version)."""
+    if not want:
+        return True
+    have = have or {}
+    return all(have.get(k) == v for k, v in want.items())
+
+
 class SessionStore(Protocol):
     # -- sessions ------------------------------------------------------
     def ensure_session(self, rec: SessionRecord) -> SessionRecord: ...
@@ -29,6 +40,7 @@ class SessionStore(Protocol):
         workspace: Optional[str] = None,
         limit: int = 100,
         agent: Optional[str] = None,
+        attrs: Optional[dict] = None,
     ) -> list[SessionRecord]: ...
 
     def delete_session(self, session_id: str) -> bool: ...

@@ -78,10 +78,11 @@ class TieredStore:
         workspace: Optional[str] = None,
         limit: int = 100,
         agent: Optional[str] = None,
+        attrs: Optional[dict] = None,
     ) -> list[SessionRecord]:
         seen: dict[str, SessionRecord] = {}
         for tier in (self.hot, self.warm, self.cold):
-            for s in tier.list_sessions(workspace, limit, agent=agent):
+            for s in tier.list_sessions(workspace, limit, agent=agent, attrs=attrs):
                 seen.setdefault(s.session_id, s)
         out = sorted(seen.values(), key=lambda s: -s.updated_at)
         return out[:limit]

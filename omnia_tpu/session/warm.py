@@ -120,6 +120,7 @@ class WarmStore:
         workspace: Optional[str] = None,
         limit: int = 100,
         agent: Optional[str] = None,
+        attrs: Optional[dict] = None,
     ) -> list[SessionRecord]:
         q = (
             "SELECT session_id, workspace, agent, user_id, created_at,"
@@ -135,10 +136,32 @@ class WarmStore:
         if clauses:
             q += " WHERE " + " AND ".join(clauses)
         params: tuple = tuple(params_l)
-        q += " ORDER BY updated_at DESC LIMIT ?"
-        with self._lock:
-            rows = self._db.execute(q, params + (limit,)).fetchall()
-        return [self._row_to_session(r) for r in rows]
+        q += " ORDER BY updated_at DESC LIMIT ? OFFSET ?"
+        if not attrs:
+            with self._lock:
+                rows = self._db.execute(q, params + (limit, 0)).fetchall()
+            return [self._row_to_session(r) for r in rows]
+        # attrs live in a JSON column: page through recency order,
+        # filtering client-side, until `limit` MATCHING rows are found or
+        # the table is exhausted — a fixed page multiplier would just move
+        # the silent-drop threshold (ADVICE r2).
+        from omnia_tpu.session.store import attrs_match
+
+        out: list[SessionRecord] = []
+        offset, page = 0, 500
+        while len(out) < limit:
+            with self._lock:
+                rows = self._db.execute(q, params + (page, offset)).fetchall()
+            for r in rows:
+                s = self._row_to_session(r)
+                if attrs_match(s.attrs, attrs):
+                    out.append(s)
+                    if len(out) >= limit:
+                        break
+            if len(rows) < page:
+                break
+            offset += page
+        return out
 
     def delete_session(self, session_id: str) -> bool:
         with self._lock:

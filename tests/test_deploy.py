@@ -284,6 +284,7 @@ class TestExamples:
         for example, agent_kinds in (
             ("examples/custom-runtime/devroot/agent.yaml", "agent"),
             ("examples/echo-function/function.yaml", "function"),
+            ("examples/voice-agent/agent.yaml", "agent"),
         ):
             store = MemoryResourceStore()
             mgr = ControllerManager(store)  # before apply: watch fires
@@ -297,3 +298,52 @@ class TestExamples:
                 assert ar.spec["mode"] == agent_kinds
             finally:
                 mgr.shutdown()
+
+    def test_voice_agent_example_speaks_pcm16(self):
+        """The voice-agent example makes a REAL voice call against its
+        declared tone speech providers: pcm16 in, pcm16 out (VERDICT r2
+        #6 'voice-agent example runs against declared providers')."""
+        import json as _json
+        import time as _time
+
+        import numpy as np
+        from websockets.sync.client import connect
+
+        from omnia_tpu.operator.controller import ControllerManager
+        from omnia_tpu.operator.resources import Resource
+        from omnia_tpu.operator.store import MemoryResourceStore
+        from omnia_tpu.runtime.duplex import TonePcmStt, TonePcmTts
+
+        store = MemoryResourceStore()
+        mgr = ControllerManager(store)
+        fmt = {"encoding": "pcm16", "sample_rate_hz": 16000, "channels": 1}
+        try:
+            with open(os.path.join(REPO, "examples/voice-agent/agent.yaml")) as f:
+                for doc in yaml.safe_load_all(f):
+                    store.apply(Resource.from_manifest(doc))
+            mgr.drain_queue()
+            dep = next(iter(mgr.deployments.values()))
+            endpoint = dep.pods[0].endpoint
+            with connect(endpoint) as ws:
+                connected = _json.loads(ws.recv(timeout=10))
+                assert "duplex_audio" in connected["capabilities"]
+                ws.send(_json.dumps({"type": "duplex_start", "format": fmt}))
+                assert _json.loads(ws.recv(timeout=10))["type"] == "duplex_ready"
+                ws.send(b"".join(TonePcmTts().synthesize("about refunds", fmt)))
+                ws.send(b"")
+                audio = bytearray()
+                deadline = _time.monotonic() + 30
+                while _time.monotonic() < deadline:
+                    frame = ws.recv(timeout=deadline - _time.monotonic())
+                    if isinstance(frame, bytes):
+                        audio.extend(frame)
+                    elif _json.loads(frame)["type"] == "done":
+                        break
+                samples = np.frombuffer(bytes(audio), dtype="<i2")
+                assert int(np.abs(samples).max()) > 5000
+                assert (
+                    TonePcmStt().transcribe(bytes(audio), fmt)
+                    == "refunds take thirty days to process"
+                )
+        finally:
+            mgr.shutdown()

@@ -532,3 +532,115 @@ class TestAPI:
         mem.api.reembed.drain()
         out = mem.recall(WS, "in process fact", virtual_user_id="u9")
         assert out and out[0]["content"] == "in process fact"
+
+
+class TestDurableTier:
+    """PgMemoryStore: write-through persistence over the PG wire (reference
+    internal/memory/store.go — Postgres there; VERDICT r2 'memory loses
+    data on restart') and advisory-lock worker exclusion (reference
+    internal/memory/postgres/advisory_lock.go)."""
+
+    @pytest.fixture()
+    def pg(self):
+        from omnia_tpu.pg import PGClient, PGServer
+
+        srv = PGServer().start()
+        yield lambda: PGClient(*srv.address)
+        srv.stop()
+
+    def test_survives_restart(self, pg):
+        from omnia_tpu.memory.pg_store import PgMemoryStore
+
+        s1 = PgMemoryStore(pg(), embedding_dim=4)
+        e = s1.save(MemoryEntry(workspace_id=WS, content="durable fact",
+                                virtual_user_id="u1"))
+        s1.observe(e.id, Observation(content="seen twice"))
+        other = s1.save(MemoryEntry(workspace_id=WS, content="related"))
+        s1.relate(Relation(src_id=e.id, relation="knows", dst_id=other.id))
+        s1.set_embedding(e.id, np.array([1, 0, 0, 0], np.float32))
+
+        # A fresh store over the same database IS the same store.
+        s2 = PgMemoryStore(pg())
+        assert s2.embedding_dim == 4
+        got = s2.get(e.id)
+        assert got is not None and got.content == "durable fact"
+        assert [o.content for o in got.observations] == ["seen twice"]
+        assert got.embedding is not None
+        assert s2.relations_from(e.id)[0].dst_id == other.id
+        # FTS index rebuilt from rows at startup.
+        assert s2.fts_rank("durable", {e.id, other.id})[0][0] == e.id
+
+    def test_tombstone_purge_and_consent_survive_restart(self, pg):
+        from omnia_tpu.memory.pg_store import PgMemoryStore
+
+        s1 = PgMemoryStore(pg(), embedding_dim=4)
+        a = s1.save(MemoryEntry(workspace_id=WS, content="will tombstone"))
+        b = s1.save(MemoryEntry(workspace_id=WS, content="will purge"))
+        s1.set_embedding(a.id, np.array([0, 1, 0, 0], np.float32))
+        s1.tombstone(a.id)
+        s1.purge(b.id)
+
+        s2 = PgMemoryStore(pg())
+        assert s2.get(a.id).tombstoned
+        assert s2.get(b.id) is None
+        # Dimension change still gated by consent after reload...
+        with pytest.raises(DimensionChangeNeedsConsent):
+            s2.ensure_embedding_dim(8)
+        s2.record_dimension_change_consent(8)
+        # ...and recorded consent survives ANOTHER restart.
+        s3 = PgMemoryStore(pg())
+        s3.ensure_embedding_dim(8)
+        assert s3.embedding_dim == 8
+        # The reshape's embedding discard is durable too.
+        s4 = PgMemoryStore(pg())
+        assert s4.embedding_dim == 8
+        assert s4.get(a.id).embedding is None
+
+    def test_advisory_lock_excludes_second_holder(self, pg):
+        from omnia_tpu.memory.pg_store import PgMemoryStore
+
+        s1 = PgMemoryStore(pg())
+        s2 = PgMemoryStore(pg())
+        assert s1.try_advisory_lock("k") is True
+        assert s1.try_advisory_lock("k") is True  # re-entrant for owner
+        assert s2.try_advisory_lock("k") is False
+        s1.advisory_unlock("k")
+        assert s2.try_advisory_lock("k") is True
+        # Expired leases are stealable (crashed worker can't wedge).
+        assert s1.try_advisory_lock("stale", ttl_s=0.01) is True
+        time.sleep(0.05)
+        assert s2.try_advisory_lock("stale") is True
+
+    def test_consolidator_skips_when_lock_held(self, pg):
+        from omnia_tpu.memory.pg_store import PgMemoryStore
+
+        s1 = PgMemoryStore(pg(), embedding_dim=4)
+        s2 = PgMemoryStore(pg(), embedding_dim=4)
+        v = np.array([1, 0, 0, 0], np.float32)
+        for s in (s1,):
+            a = s.save(MemoryEntry(workspace_id=WS, content="dup fact one"))
+            b = s.save(MemoryEntry(workspace_id=WS, content="dup fact one"))
+            s.set_embedding(a.id, v)
+            s.set_embedding(b.id, v)
+        # Another pod holds the workspace lock: this pass must skip.
+        assert s2.try_advisory_lock(f"memory-consolidation:{WS}")
+        out = Consolidator(s1).run_once(WS)
+        assert out == {"skipped": True}
+        s2.advisory_unlock(f"memory-consolidation:{WS}")
+        out = Consolidator(s1).run_once(WS)
+        assert out["skipped"] is False and out["merged"] == 1
+
+    def test_memory_api_over_durable_store(self, pg):
+        from omnia_tpu.memory.pg_store import PgMemoryStore
+
+        api = MemoryAPI(store=PgMemoryStore(pg()), embedder=HashingEmbedder(dim=16))
+        code, resp = api.handle("POST", "/api/v1/memories", {
+            "workspace_id": WS, "content": "api durable fact"})
+        assert code == 200
+        api.reembed.drain()
+        api2 = MemoryAPI(store=PgMemoryStore(pg()), embedder=HashingEmbedder(dim=16))
+        code, resp = api2.handle(
+            "POST", "/api/v1/memories/retrieve",
+            {"workspace_id": WS, "query": "api durable fact"})
+        assert code == 200
+        assert any("api durable fact" in m["content"] for m in resp["memories"])

@@ -226,6 +226,41 @@ def test_api_usage_and_not_found():
     assert code == 404
 
 
+def test_api_list_sessions_attrs_filter_server_side():
+    """?attrs.<k>=<v> scopes the listing server-side: candidate-track
+    sessions must be findable even when stable traffic dominates recency
+    (ADVICE r2 — rollout analysis relies on this)."""
+    api = SessionAPI()
+    for i in range(30):
+        api.handle("POST", "/api/v1/sessions", {
+            "session_id": f"stable-{i}", "agent": "a",
+            "attrs": {"track": "stable"},
+        })
+    api.handle("POST", "/api/v1/sessions", {
+        "session_id": "cand-1", "agent": "a",
+        "attrs": {"track": "candidate", "version": "v2"},
+    })
+    for i in range(30, 60):
+        api.handle("POST", "/api/v1/sessions", {
+            "session_id": f"stable-{i}", "agent": "a",
+            "attrs": {"track": "stable"},
+        })
+    # A recency-limited unfiltered page misses the candidate...
+    code, resp = api.handle(
+        "GET", "/api/v1/sessions", {"limit": "20", "agent": "a"}
+    )
+    assert code == 200
+    assert all(s["session_id"] != "cand-1" for s in resp["sessions"])
+    # ...the server-side attrs filter finds it.
+    code, resp = api.handle(
+        "GET", "/api/v1/sessions",
+        {"limit": "20", "agent": "a", "attrs.track": "candidate",
+         "attrs.version": "v2"},
+    )
+    assert code == 200
+    assert [s["session_id"] for s in resp["sessions"]] == ["cand-1"]
+
+
 def test_api_bad_append_is_400():
     api = SessionAPI()
     code, resp = api.handle("POST", "/api/v1/messages", {"role": "user", "content": "x"})
