@@ -126,6 +126,31 @@ def _cold_store():
     return None
 
 
+def _media_store():
+    """Media backend from env: OMNIA_S3_ENDPOINT/... → S3MediaStore,
+    OMNIA_MEDIA_ROOT → LocalMediaStore, else None (uploads rejected).
+    OMNIA_MEDIA_SECRET makes grant tokens verifiable across the facade
+    and runtime processes (both must hold the same store secret)."""
+    secret = (_env("OMNIA_MEDIA_SECRET") or "").encode() or None
+    if _env("OMNIA_S3_ENDPOINT"):
+        from omnia_tpu.blob import S3BlobStore
+        from omnia_tpu.media import S3MediaStore
+
+        return S3MediaStore(S3BlobStore(
+            _require("OMNIA_S3_ENDPOINT"),
+            _require("OMNIA_S3_BUCKET"),
+            _require("OMNIA_S3_ACCESS_KEY"),
+            _require("OMNIA_S3_SECRET_KEY"),
+            region=_env("OMNIA_S3_REGION", "us-east-1"),
+            prefix=_env("OMNIA_S3_PREFIX", ""),
+        ), secret=secret)
+    if _env("OMNIA_MEDIA_ROOT"):
+        from omnia_tpu.media import LocalMediaStore
+
+        return LocalMediaStore(_env("OMNIA_MEDIA_ROOT"), secret=secret)
+    return None
+
+
 def _wait_forever() -> None:
     stop = threading.Event()
 
@@ -180,6 +205,7 @@ def runtime_main() -> int:
     server = RuntimeServer(
         pack=pack, providers=registry, provider_name=provider_name,
         context_store=store, tool_executor=executor,
+        media_store=_media_store(),
     )
     port = server.serve(f"0.0.0.0:{_env('OMNIA_GRPC_PORT', '9000')}")
     logger.info("runtime serving gRPC on :%d", port)
@@ -245,6 +271,8 @@ def facade_main() -> int:
             park_ttl_s=float(_env("OMNIA_PARK_TTL_S", "60"))),
         route_store=RedisRouteStore(rc) if rc is not None else None,
         advertise_address=_env("OMNIA_ADVERTISE", ""),
+        media_store=_media_store(),
+        workspace=_env("OMNIA_WORKSPACE", "default"),
     )
     port = server.serve(
         host="0.0.0.0",
@@ -332,7 +360,7 @@ def memory_api_main() -> int:
     port = api.serve(host="0.0.0.0", port=int(_env("OMNIA_HTTP_PORT", "8400")))
     logger.info("memory-api on :%d", port)
     _wait_forever()
-    api.shutdown()
+    api.close()
     return 0
 
 
@@ -380,7 +408,11 @@ def operator_main() -> int:
         from omnia_tpu.dashboard import DashboardServer
 
         dash = DashboardServer(
-            store, session_api_url=_env("OMNIA_SESSION_API_URL"))
+            store,
+            session_api_url=_env("OMNIA_SESSION_API_URL"),
+            memory_api_url=_env("OMNIA_MEMORY_API_URL"),
+            write_token=_env("OMNIA_DASHBOARD_TOKEN") or None,
+        )
         dash.serve(host="0.0.0.0", port=int(_env("OMNIA_HTTP_PORT", "8090")))
     from omnia_tpu.operator.api import OperatorAPI
 
@@ -455,7 +487,17 @@ def conformance_main() -> int:
 
 
 def redisd_main() -> int:
+    """In-tree Redis server: OMNIA_REDIS_HOST/PORT/PASSWORD (env-first,
+    like every other entry point; argv still works for manual runs)."""
+    import sys as _sys
+
     from omnia_tpu.redis.server import main as redis_main
 
-    redis_main()
+    argv = _sys.argv[1:]
+    if not argv:
+        argv = ["--host", _env("OMNIA_REDIS_HOST", "0.0.0.0"),
+                "--port", _env("OMNIA_REDIS_PORT", "6379")]
+        if _env("OMNIA_REDIS_PASSWORD"):
+            argv += ["--password", _env("OMNIA_REDIS_PASSWORD")]
+    redis_main(argv)
     return 0

@@ -1,21 +1,31 @@
 """Operator-hosted web console.
 
 Reference parity target: dashboard/ (~239k LoC Next.js) + its WS proxy
-(dashboard/server.js). V1 scope per the platform's actual operator
-surface: agent list with live status, a chat console speaking the real
-WS protocol straight to an agent facade, a session browser over
-session-api, and eval results — one static page served by the operator
+(dashboard/server.js). Served as a static SPA straight from the operator
 process (no node toolchain in a TPU serving image; the reference runs a
-separate Next server, here the console IS an operator endpoint).
+separate Next server, here the console IS an operator endpoint), with a
+JSON API per reference route family (dashboard/src/app/):
 
-APIs (JSON): /api/agents (resource store + reconciler status),
-/api/resources?kind= (topology), /api/sessions[?workspace=],
-/api/sessions/<id>/messages|tool-calls|eval-results (session-api
-proxy — the browser never needs CORS to session-api), /api/usage.
+  agents     /api/agents                    list + live status
+  console    (browser WS straight to the agent facade; CORS open)
+  providers  /api/providers                 Provider CRs + phase
+  promptpacks/api/packs                     PromptPack CRs + versions
+  tools      /api/tools                     ToolRegistry flattened
+  workspaces /api/workspaces                Workspace CRs + service groups
+  sessions   /api/sessions[...]             session-api proxy
+  costs      /api/costs                     usage + per-session rollup
+  quality    /api/quality                   eval pass-rates by agent
+  arena      /api/arena                     ArenaJob status + verdicts
+  memories   /api/memories[...]             memory-api proxy
+  topology   /api/topology                  resource graph (nodes+edges)
+  sources    /api/sources                   pack/arena source sync status
+  settings   /api/resources CRUD            CRD passthrough (the reference
+             dashboard writes CRDs directly — crd-operations.ts)
 """
 
 from __future__ import annotations
 
+import concurrent.futures
 import json
 import logging
 import os
@@ -35,9 +45,16 @@ class DashboardServer:
         self,
         store,
         session_api_url: Optional[str] = None,
+        memory_api_url: Optional[str] = None,
+        write_token: Optional[str] = None,
     ) -> None:
         self.store = store
         self.session_api_url = (session_api_url or "").rstrip("/")
+        self.memory_api_url = (memory_api_url or "").rstrip("/")
+        # CRD mutations require this bearer token (OMNIA_DASHBOARD_TOKEN;
+        # the reference console authenticates its CRD writes too). None =
+        # writes disabled entirely — never silently open.
+        self.write_token = write_token
         self._httpd: Optional[ThreadingHTTPServer] = None
         self.port: Optional[int] = None
 
@@ -57,24 +74,233 @@ class DashboardServer:
                     else str(p.get("providerRef", ""))
                     for p in spec.get("providers", [])
                 ],
+                "facades": [f.get("type") for f in spec.get("facades", [])],
                 "phase": res.status.get("phase", "Unknown"),
                 "replicas": res.status.get("replicas", 0),
                 "endpoints": res.status.get("endpoints", []),
                 "configHash": res.status.get("configHash", ""),
+                "rollout": res.status.get("rollout", {}),
             })
         return out
+
+    def providers(self) -> list[dict]:
+        return [{
+            "name": r.name, "namespace": r.namespace,
+            "type": r.spec.get("type", ""), "role": r.spec.get("role", "llm"),
+            "model": r.spec.get("model", ""),
+            "phase": r.status.get("phase", "Unknown"),
+            "message": r.status.get("message", ""),
+            "pricing": r.spec.get("pricing", {}),
+        } for r in self.store.list(kind="Provider")]
+
+    def packs(self) -> list[dict]:
+        return [{
+            "name": r.name, "namespace": r.namespace,
+            "version": (r.spec.get("content") or {}).get("version", ""),
+            "phase": r.status.get("phase", "Unknown"),
+            "functions": [
+                f.get("name")
+                for f in (r.spec.get("content") or {}).get("functions", [])
+            ],
+            "sourceRef": (r.spec.get("sourceRef") or {}).get("name", ""),
+        } for r in self.store.list(kind="PromptPack")]
+
+    def tools(self) -> list[dict]:
+        out = []
+        for r in self.store.list(kind="ToolRegistry"):
+            probes = {
+                p.get("name"): p for p in r.status.get("tools", [])
+            } if isinstance(r.status.get("tools"), list) else {}
+            for t in r.spec.get("tools", []):
+                h = t.get("handler", {})
+                out.append({
+                    "registry": r.name, "namespace": r.namespace,
+                    "name": t.get("name", ""),
+                    "type": h.get("type", t.get("type", "")),
+                    "endpoint": h.get("url", t.get("endpoint", "")),
+                    "probe": probes.get(t.get("name"), {}).get("phase", ""),
+                })
+        return out
+
+    def workspaces(self) -> list[dict]:
+        return [{
+            "name": r.name, "namespace": r.namespace,
+            "environment": r.spec.get("environment", ""),
+            "phase": r.status.get("phase", "Unknown"),
+            "serviceGroups": r.status.get("serviceGroups", {}),
+        } for r in self.store.list(kind="Workspace")]
+
+    def arena(self) -> list[dict]:
+        return [{
+            "name": r.name, "namespace": r.namespace,
+            "phase": r.status.get("phase", "Unknown"),
+            "total": r.status.get("total", 0),
+            "completed": r.status.get("completed", 0),
+            "verdict": r.status.get("verdict"),
+            "providers": r.spec.get("providers", []),
+            "mode": r.spec.get("mode", "direct"),
+        } for r in self.store.list(kind="ArenaJob")]
+
+    def sources(self) -> list[dict]:
+        out = []
+        for kind in ("PromptPackSource", "ArenaSource", "ArenaTemplateSource",
+                     "SkillSource"):
+            for r in self.store.list(kind=kind):
+                out.append({
+                    "kind": kind, "name": r.name, "namespace": r.namespace,
+                    "type": (r.spec.get("source") or {}).get("type", ""),
+                    "phase": r.status.get("phase", "Unknown"),
+                    "version": r.status.get("version", ""),
+                    "message": r.status.get("message", ""),
+                })
+        return out
+
+    def topology(self) -> dict:
+        """Resource graph (reference dashboard /topology route): nodes are
+        resources, edges are spec references."""
+        nodes, edges = [], []
+
+        def node(r):
+            nid = f"{r.kind}/{r.namespace}/{r.name}"
+            nodes.append({
+                "id": nid, "kind": r.kind, "name": r.name,
+                "namespace": r.namespace,
+                "phase": r.status.get("phase", ""),
+            })
+            return nid
+
+        ids = {}
+        for kind in ("Workspace", "Provider", "PromptPack", "ToolRegistry",
+                     "AgentRuntime", "PromptPackSource", "ArenaJob",
+                     "MemoryPolicy", "SessionRetentionPolicy"):
+            for r in self.store.list(kind=kind):
+                ids[(r.kind, r.namespace, r.name)] = node(r)
+
+        def edge(src_id, kind, ns, name, label):
+            dst = ids.get((kind, ns, name))
+            if dst:
+                edges.append({"from": src_id, "to": dst, "label": label})
+
+        for r in self.store.list(kind="AgentRuntime"):
+            src = ids[(r.kind, r.namespace, r.name)]
+            ref = (r.spec.get("promptPackRef") or {})
+            if isinstance(ref, dict) and ref.get("name"):
+                edge(src, "PromptPack", r.namespace, ref["name"], "pack")
+            tref = (r.spec.get("toolRegistryRef") or {})
+            if isinstance(tref, dict) and tref.get("name"):
+                edge(src, "ToolRegistry", r.namespace, tref["name"], "tools")
+            for p in r.spec.get("providers", []):
+                pref = p.get("providerRef")
+                pname = pref.get("name") if isinstance(pref, dict) else pref
+                if pname:
+                    edge(src, "Provider", r.namespace, pname, "provider")
+        for r in self.store.list(kind="PromptPack"):
+            sref = (r.spec.get("sourceRef") or {}).get("name")
+            if sref:
+                edge(ids[(r.kind, r.namespace, r.name)],
+                     "PromptPackSource", r.namespace, sref, "synced-from")
+        return {"nodes": nodes, "edges": edges}
+
+    # -- session-api-backed rollups -------------------------------------
+
+    _COST_SAMPLE = 25
+    _FETCH_WORKERS = 8
+
+    def costs(self, workspace: str = "") -> dict:
+        """Aggregate usage + per-session cost rollup (reference /costs
+        route; cost lands on every done frame and in provider-call
+        records)."""
+        status, usage = self._proxy_session_api(
+            "/api/v1/usage", f"workspace={workspace}" if workspace else "")
+        if status != 200:
+            return {"usage": {}, "sessions": [],
+                    "error": usage.get("error", "usage unavailable")}
+        q = f"limit={self._COST_SAMPLE}"
+        if workspace:
+            q += f"&workspace={urllib.parse.quote(workspace)}"
+        _s, listing = self._proxy_session_api("/api/v1/sessions", q)
+
+        def roll(s):
+            sid = s.get("session_id", "")
+            _st, calls = self._proxy_session_api(
+                f"/api/v1/sessions/{urllib.parse.quote(sid, safe='')}"
+                "/provider-calls", "")
+            pc = calls.get("provider_calls", []) if _st == 200 else []
+            return {
+                "session_id": sid,
+                "agent": s.get("agent", ""),
+                "calls": len(pc),
+                "input_tokens": sum(c.get("input_tokens", 0) for c in pc),
+                "output_tokens": sum(c.get("output_tokens", 0) for c in pc),
+                "cost_usd": round(sum(c.get("cost_usd", 0.0) for c in pc), 6),
+            }
+
+        with concurrent.futures.ThreadPoolExecutor(self._FETCH_WORKERS) as ex:
+            rows = list(ex.map(roll, listing.get("sessions", [])))
+        rows.sort(key=lambda r: -r["cost_usd"])
+        by_agent: dict[str, dict] = {}
+        for r in rows:
+            a = by_agent.setdefault(r["agent"] or "(none)", {
+                "agent": r["agent"] or "(none)", "sessions": 0,
+                "cost_usd": 0.0, "output_tokens": 0})
+            a["sessions"] += 1
+            a["cost_usd"] = round(a["cost_usd"] + r["cost_usd"], 6)
+            a["output_tokens"] += r["output_tokens"]
+        return {"usage": usage, "sessions": rows,
+                "byAgent": sorted(by_agent.values(),
+                                  key=lambda a: -a["cost_usd"])}
+
+    def quality(self) -> dict:
+        """Eval pass-rates by agent over recent sessions (reference
+        /quality route; results come from runtime-inline + eval workers)."""
+        _s, listing = self._proxy_session_api(
+            "/api/v1/sessions", f"limit={self._COST_SAMPLE}")
+
+        def fetch(s):
+            sid = s.get("session_id", "")
+            _st, doc = self._proxy_session_api(
+                f"/api/v1/sessions/{urllib.parse.quote(sid, safe='')}"
+                "/eval-results", "")
+            return s, (doc.get("eval_results", []) if _st == 200 else [])
+
+        with concurrent.futures.ThreadPoolExecutor(self._FETCH_WORKERS) as ex:
+            pairs = list(ex.map(fetch, listing.get("sessions", [])))
+        agg: dict[str, dict] = {}
+        for s, results in pairs:
+            agent = s.get("agent", "") or "(none)"
+            a = agg.setdefault(agent, {"agent": agent, "total": 0, "passed": 0,
+                                       "checks": {}})
+            for r in results:
+                a["total"] += 1
+                a["passed"] += bool(r.get("passed"))
+                c = a["checks"].setdefault(
+                    r.get("eval_name") or r.get("name", "?"),
+                    {"total": 0, "passed": 0})
+                c["total"] += 1
+                c["passed"] += bool(r.get("passed"))
+        for a in agg.values():
+            a["pass_rate"] = (
+                round(a["passed"] / a["total"], 4) if a["total"] else None
+            )
+        return {"agents": sorted(agg.values(), key=lambda a: a["agent"])}
 
     def resources(self, kind: Optional[str] = None) -> list[dict]:
         return [r.to_manifest() for r in self.store.list(kind=kind)]
 
-    def _proxy_session_api(self, path: str, query: str):
-        if not self.session_api_url:
-            return 503, {"error": "session-api not configured"}
-        url = f"{self.session_api_url}{path}"
+    # -- proxies ---------------------------------------------------------
+
+    def _proxy(self, base: str, path: str, query: str,
+               method: str = "GET", body: Optional[bytes] = None):
+        if not base:
+            return 503, {"error": "backing service not configured"}
+        url = f"{base}{path}"
         if query:
             url += f"?{query}"
         try:
-            with urllib.request.urlopen(url, timeout=10) as resp:
+            req = urllib.request.Request(url, method=method, data=body)
+            if body is not None:
+                req.add_header("Content-Type", "application/json")
+            with urllib.request.urlopen(req, timeout=10) as resp:
                 return resp.status, json.loads(resp.read())
         except urllib.error.HTTPError as e:
             try:
@@ -82,14 +308,17 @@ class DashboardServer:
             except Exception:
                 return e.code, {"error": str(e)}
         except (urllib.error.URLError, OSError) as e:
-            return 502, {"error": f"session-api unreachable: {e}"}
+            return 502, {"error": f"backing service unreachable: {e}"}
+
+    def _proxy_session_api(self, path: str, query: str):
+        return self._proxy(self.session_api_url, path, query)
 
     # -- request handling ---------------------------------------------
 
-    def handle(self, method: str, path: str, query: str = ""):
+    def handle(self, method: str, path: str, query: str = "",
+               body: Optional[bytes] = None,
+               headers: Optional[dict] = None):
         """Returns (status, content_type, body_bytes)."""
-        if method != "GET":
-            return 405, "application/json", b'{"error": "GET only"}'
         if path in ("/", "/index.html"):
             try:
                 with open(os.path.join(_STATIC_DIR, "index.html"), "rb") as f:
@@ -98,12 +327,27 @@ class DashboardServer:
                 return 500, "application/json", b'{"error": "asset missing"}'
         if path == "/healthz":
             return 200, "application/json", b'{"status": "ok"}'
-        if path == "/api/agents":
-            return self._json(200, {"agents": self.agents()})
         if path == "/api/resources":
-            q = urllib.parse.parse_qs(query)
-            kind = (q.get("kind") or [None])[0]
-            return self._json(200, {"resources": self.resources(kind)})
+            return self._handle_resources(method, query, body, headers or {})
+        if method != "GET":
+            return 405, "application/json", b'{"error": "method not allowed"}'
+        q = urllib.parse.parse_qs(query)
+        simple = {
+            "/api/agents": lambda: {"agents": self.agents()},
+            "/api/providers": lambda: {"providers": self.providers()},
+            "/api/packs": lambda: {"packs": self.packs()},
+            "/api/tools": lambda: {"tools": self.tools()},
+            "/api/workspaces": lambda: {"workspaces": self.workspaces()},
+            "/api/arena": lambda: {"jobs": self.arena()},
+            "/api/sources": lambda: {"sources": self.sources()},
+            "/api/topology": self.topology,
+            "/api/quality": self.quality,
+        }
+        if path in simple:
+            return self._json(200, simple[path]())
+        if path == "/api/costs":
+            ws = (q.get("workspace") or [""])[0]
+            return self._json(200, self.costs(ws))
         if path == "/api/usage":
             status, doc = self._proxy_session_api("/api/v1/usage", query)
             return self._json(status, doc)
@@ -119,7 +363,60 @@ class DashboardServer:
                 f"/api/v1/sessions/{sid}{sub}", query
             )
             return self._json(status, doc)
+        if path in ("/api/memories", "/api/memories/aggregate"):
+            # memory-api speaks workspace_id; the console speaks workspace.
+            if "workspace=" in query:
+                query = query.replace("workspace=", "workspace_id=")
+            status, doc = self._proxy(
+                self.memory_api_url,
+                path.replace("/api/", "/api/v1/", 1),
+                query,
+            )
+            return self._json(status, doc)
         return 404, "application/json", b'{"error": "not found"}'
+
+    def _handle_resources(self, method: str, query: str,
+                          body: Optional[bytes], headers: dict):
+        """CRD passthrough (reference dashboard writes CRDs directly to
+        the K8s API — dashboard/src/lib/k8s/crd-operations.ts): GET lists,
+        POST applies a manifest through admission, DELETE removes.
+        Mutations require the write token — an unauthenticated write
+        surface with open CORS would be drive-by cluster mutation."""
+        import hmac as _hmac
+
+        from omnia_tpu.operator.resources import Resource
+        from omnia_tpu.operator.validation import ValidationError
+
+        q = urllib.parse.parse_qs(query)
+        if method == "GET":
+            kind = (q.get("kind") or [None])[0]
+            return self._json(200, {"resources": self.resources(kind)})
+        if self.write_token is None:
+            return self._json(403, {
+                "error": "resource writes disabled; set OMNIA_DASHBOARD_TOKEN"
+            })
+        supplied = (headers.get("Authorization") or "").removeprefix("Bearer ")
+        if not _hmac.compare_digest(supplied, self.write_token):
+            return self._json(401, {"error": "missing/invalid write token"})
+        if method == "POST":
+            try:
+                manifest = json.loads(body or b"")
+                res = self.store.apply(Resource.from_manifest(manifest))
+            except ValidationError as e:
+                return self._json(400, {"error": str(e)})
+            except (ValueError, KeyError, TypeError) as e:
+                return self._json(400, {"error": f"bad manifest: {e}"})
+            return self._json(200, res.to_manifest())
+        if method == "DELETE":
+            kind = (q.get("kind") or [""])[0]
+            name = (q.get("name") or [""])[0]
+            ns = (q.get("namespace") or ["default"])[0]
+            if not kind or not name:
+                return self._json(400, {"error": "kind and name required"})
+            if self.store.delete(ns, kind, name):
+                return self._json(200, {"deleted": True})
+            return self._json(404, {"error": "not found"})
+        return self._json(405, {"error": "method not allowed"})
 
     @staticmethod
     def _json(status: int, doc: dict):
@@ -131,17 +428,35 @@ class DashboardServer:
         dash = self
 
         class Handler(BaseHTTPRequestHandler):
-            def do_GET(self):
+            def _go(self, method: str):
                 split = urllib.parse.urlsplit(self.path)
-                status, ctype, body = dash.handle("GET", split.path, split.query)
+                body = None
+                length = int(self.headers.get("Content-Length") or 0)
+                if length:
+                    body = self.rfile.read(length)
+                status, ctype, out = dash.handle(
+                    method, split.path, split.query, body,
+                    dict(self.headers),
+                )
                 self.send_response(status)
                 self.send_header("Content-Type", ctype)
-                self.send_header("Content-Length", str(len(body)))
-                # The chat console opens WS connections to agent facades
-                # on other ports.
-                self.send_header("Access-Control-Allow-Origin", "*")
+                self.send_header("Content-Length", str(len(out)))
+                if method == "GET":
+                    # The chat console opens WS connections to agent
+                    # facades on other ports. Mutations get NO CORS
+                    # grant (and require the write token besides).
+                    self.send_header("Access-Control-Allow-Origin", "*")
                 self.end_headers()
-                self.wfile.write(body)
+                self.wfile.write(out)
+
+            def do_GET(self):
+                self._go("GET")
+
+            def do_POST(self):
+                self._go("POST")
+
+            def do_DELETE(self):
+                self._go("DELETE")
 
             def log_message(self, *a):  # pragma: no cover - quiet
                 pass

@@ -68,6 +68,8 @@ class FacadeServer:
         realtime=None,          # realtime.RealtimeRegistry — park/resume
         route_store=None,       # realtime.RouteStore — sid → pod address
         advertise_address: str = "",
+        media_store=None,       # media.MediaStore — upload negotiation
+        workspace: str = "default",
     ):
         self.runtime = RuntimeClient(runtime_target)
         self.agent_name = agent_name
@@ -78,6 +80,8 @@ class FacadeServer:
         self.realtime = realtime
         self.route_store = route_store
         self.advertise_address = advertise_address
+        self.media = media_store
+        self.workspace = workspace
         self.drain_timeout_s = drain_timeout_s
         self.metrics = Registry(prefix="omnia_facade")
         self._connections_active = self.metrics.gauge(
@@ -334,6 +338,13 @@ class FacadeServer:
                     "message": "no tool call in flight",
                 })
                 continue
+            if mtype in ("upload_request", "upload_data"):
+                # Upload flow (reference asyncapi.yaml upload_request /
+                # upload_* + internal/media/builder.go): negotiate a
+                # grant, then ship bytes; messages then carry parts
+                # referencing the storage_ref.
+                self._handle_upload(ws, mtype, msg)
+                continue
             if mtype != "message":
                 self._try_send(ws, {
                     "type": "error", "code": "bad_message",
@@ -346,13 +357,54 @@ class FacadeServer:
 
             self._messages_total.inc()
             content = msg.get("content", "")
+            parts = msg.get("parts") or []
             self.recording.record_user(session_id, user_id, content)
             t0 = _time.monotonic()
-            stream.send_text(content)
+            if parts:
+                from omnia_tpu.runtime import contract as _c
+
+                stream.send(_c.ClientMessage(content=content, parts=parts))
+            else:
+                stream.send_text(content)
             assistant_text = self._pump_turn(ws, stream, session_id, user_id)
             self._turn_latency.observe(_time.monotonic() - t0)
             if assistant_text is None:
                 return False  # turn ended the connection
+
+    def _handle_upload(self, ws, mtype: str, msg: dict) -> None:
+        """upload_request → upload_grant; upload_data (b64) →
+        upload_complete. Grant tokens are store-signed and expiring (the
+        reference's presigned-URL analog, internal/media/builder.go)."""
+        import base64 as _b64
+
+        from omnia_tpu.media import MediaError
+
+        if self.media is None:
+            self._try_send(ws, {
+                "type": "error", "code": "media_unsupported",
+                "message": "no media store configured for this agent",
+            })
+            return
+        try:
+            if mtype == "upload_request":
+                grant = self.media.negotiate_upload(
+                    self.workspace, msg.get("content_type", "")
+                )
+                self._try_send(ws, {"type": "upload_grant", **grant.to_dict()})
+                return
+            ref = msg.get("storage_ref", "")
+            data = _b64.b64decode(msg.get("data_b64", "") or "")
+            self.media.put(ref, msg.get("token", ""), data)
+            self._try_send(ws, {
+                "type": "upload_complete", "storage_ref": ref, "bytes": len(data),
+            })
+        except (MediaError, ValueError) as e:
+            # binascii.Error (bad base64) is a ValueError subclass: a
+            # malformed upload frame must answer upload_failed, never tear
+            # down the live session.
+            self._try_send(ws, {
+                "type": "error", "code": "upload_failed", "message": str(e),
+            })
 
     def _pump_turn(self, ws, stream, session_id: str, user_id: str) -> Optional[str]:
         """Forward runtime messages for one turn; handles client-tool

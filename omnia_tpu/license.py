@@ -22,6 +22,7 @@ from typing import Optional
 
 EE_FEATURES = frozenset({
     "arena",          # batch eval jobs (ArenaJob)
+    "sources",        # pack/arena source sync (PromptPackSource, Arena*Source)
     "policy-broker",  # tool-policy decision sidecar
     "privacy-api",    # consent/DSAR/audit plane
     "encryption",     # envelope encryption + key rotation

@@ -4,9 +4,12 @@ Reference internal/media (builder.go, handler.go, s3/gcs/azure/local
 backends): clients negotiate an upload (get a storage_ref + a signed
 upload URL), PUT bytes, and the runtime resolves storage_refs to bytes
 at provider-call time (internal/runtime/media_storage_adapter.go).
-Backends here: LocalMediaStore (filesystem, the dev/test backend; the
-cloud backends drop in behind the same interface). Upload tokens are
-HMAC-signed and expire, which is the signed-URL analog."""
+Backends here: LocalMediaStore (filesystem, the dev/test backend) and
+S3MediaStore (any S3-compatible endpoint through the in-tree SigV4
+client — the in-tree S3 server in tests, real object storage in
+cluster); GCS/Azure ride the same S3BlobStore seam the way the
+platform's cold session tier does. Upload tokens are HMAC-signed and
+expire, which is the signed-URL analog."""
 
 from __future__ import annotations
 
@@ -39,14 +42,14 @@ class UploadGrant:
         return dataclasses.asdict(self)
 
 
-class LocalMediaStore:
-    def __init__(self, root: str, secret: Optional[bytes] = None,
-                 grant_ttl_s: float = 600.0):
-        self.root = root
+class MediaStore:
+    """Grant negotiation + ref parsing shared by all backends; concrete
+    stores implement the _write/_read/_delete byte hops."""
+
+    def __init__(self, secret: Optional[bytes] = None, grant_ttl_s: float = 600.0):
         self.secret = secret or os.urandom(32)
         self.grant_ttl_s = grant_ttl_s
         self._lock = threading.Lock()
-        os.makedirs(root, exist_ok=True)
 
     # -- negotiation -------------------------------------------------------
 
@@ -72,43 +75,137 @@ class LocalMediaStore:
         if not hmac.compare_digest(self._sign(ref, expires), token):
             raise MediaError("invalid upload token")
 
-    # -- data path ---------------------------------------------------------
-
-    def _path(self, ref: str) -> tuple[str, str]:
+    @staticmethod
+    def _parse_ref(ref: str) -> tuple[str, str]:
         m = _REF.match(ref)
         if not m:
             raise MediaError(f"bad storage ref {ref!r}")
-        d = os.path.join(self.root, m.group("workspace"))
-        return d, os.path.join(d, m.group("id"))
+        return m.group("workspace"), m.group("id")
+
+    # -- data path ---------------------------------------------------------
 
     def put(self, ref: str, token: str, data: bytes) -> None:
         self._verify(ref, token)
         if len(data) > MAX_UPLOAD_BYTES:
             raise MediaError(f"upload exceeds {MAX_UPLOAD_BYTES} bytes")
-        d, path = self._path(ref)
-        os.makedirs(d, exist_ok=True)
-        tmp = path + ".tmp"
-        with open(tmp, "wb") as f:
-            f.write(data)
-        os.replace(tmp, path)
+        self._write(*self._parse_ref(ref), data)
 
     def resolve(self, ref: str) -> bytes:
         """storage_ref → bytes (the runtime's provider-call-time hop)."""
-        _d, path = self._path(ref)
-        if not os.path.exists(path):
+        data = self._read(*self._parse_ref(ref))
+        if data is None:
             raise MediaError(f"no media at {ref!r}")
-        with open(path, "rb") as f:
-            return f.read()
+        return data
 
     def delete_workspace_user_media(self, workspace: str, refs: list[str]) -> int:
         """DSAR hook: delete the given refs (caller scopes them by user)."""
         n = 0
         for ref in refs:
             try:
-                _d, path = self._path(ref)
+                ws, mid = self._parse_ref(ref)
             except MediaError:
                 continue
-            if os.path.exists(path):
-                os.remove(path)
-                n += 1
+            n += bool(self._delete(ws, mid))
         return n
+
+    # -- backend hops ------------------------------------------------------
+
+    def _write(self, workspace: str, media_id: str, data: bytes) -> None:
+        raise NotImplementedError
+
+    def _read(self, workspace: str, media_id: str) -> Optional[bytes]:
+        raise NotImplementedError
+
+    def _delete(self, workspace: str, media_id: str) -> bool:
+        raise NotImplementedError
+
+
+class LocalMediaStore(MediaStore):
+    def __init__(self, root: str, secret: Optional[bytes] = None,
+                 grant_ttl_s: float = 600.0):
+        super().__init__(secret=secret, grant_ttl_s=grant_ttl_s)
+        self.root = root
+        os.makedirs(root, exist_ok=True)
+
+    def _path(self, workspace: str, media_id: str) -> str:
+        return os.path.join(self.root, workspace, media_id)
+
+    def _write(self, workspace: str, media_id: str, data: bytes) -> None:
+        path = self._path(workspace, media_id)
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(data)
+        os.replace(tmp, path)
+
+    def _read(self, workspace: str, media_id: str) -> Optional[bytes]:
+        path = self._path(workspace, media_id)
+        if not os.path.exists(path):
+            return None
+        with open(path, "rb") as f:
+            return f.read()
+
+    def _delete(self, workspace: str, media_id: str) -> bool:
+        path = self._path(workspace, media_id)
+        if not os.path.exists(path):
+            return False
+        os.remove(path)
+        return True
+
+
+class S3MediaStore(MediaStore):
+    """Object-storage backend over the in-tree SigV4 S3 client (reference
+    internal/media/blobstore_s3.go)."""
+
+    def __init__(self, blobs, secret: Optional[bytes] = None,
+                 grant_ttl_s: float = 600.0, prefix: str = "media"):
+        super().__init__(secret=secret, grant_ttl_s=grant_ttl_s)
+        self.blobs = blobs
+        self.prefix = prefix.strip("/")
+
+    def _key(self, workspace: str, media_id: str) -> str:
+        return f"{self.prefix}/{workspace}/{media_id}"
+
+    def _write(self, workspace: str, media_id: str, data: bytes) -> None:
+        self.blobs.put(self._key(workspace, media_id), data)
+
+    def _read(self, workspace: str, media_id: str) -> Optional[bytes]:
+        return self.blobs.get(self._key(workspace, media_id))
+
+    def _delete(self, workspace: str, media_id: str) -> bool:
+        return bool(self.blobs.delete(self._key(workspace, media_id)))
+
+
+_TEXT_CLIP = 16 * 1024
+
+
+def render_parts(parts: list[dict], store: Optional[MediaStore]) -> str:
+    """Resolve multimodal message parts to prompt text at provider-call
+    time (reference media_storage_adapter.go resolves storage_refs to
+    bytes for its multimodal providers; the on-device engine is
+    text-token-based, so text attachments inline and binary attachments
+    become an honest metadata marker rather than silently dropping).
+
+    Raises MediaError on an unresolvable ref — a message that names an
+    attachment the store can't produce must fail the turn, not serve a
+    silently attachment-blind answer."""
+    out = []
+    for p in parts or []:
+        ptype = p.get("type", "media")
+        if ptype == "text":
+            out.append(str(p.get("text", "")))
+            continue
+        ref = p.get("storage_ref", "")
+        if store is None:
+            raise MediaError("message has media parts but no media store is wired")
+        data = store.resolve(ref)
+        ctype = p.get("content_type", "application/octet-stream")
+        if ctype.startswith("text/"):
+            text = data[:_TEXT_CLIP].decode("utf-8", errors="replace")
+            out.append(f"[ATTACHMENT {ctype}]\n{text}\n[/ATTACHMENT]")
+        else:
+            digest = hashlib.sha256(data).hexdigest()[:16]
+            out.append(
+                f"[ATTACHMENT {ctype} bytes={len(data)} sha256={digest}]"
+            )
+    return "\n".join(x for x in out if x)

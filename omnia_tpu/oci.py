@@ -1,0 +1,264 @@
+"""Minimal OCI artifact registry + pull client (HTTP distribution v2).
+
+The reference syncs PromptPack/Arena content from OCI artifacts
+(reference internal/sourcesync/oci.go, using go-containerregistry to a
+remote registry). A zero-egress TPU cluster needs the same capability
+against an in-cluster registry, so — like the in-tree Redis/PG/S3
+servers — this module ships BOTH halves behind the wire protocol:
+
+- `OCIRegistry`: a distribution-v2 server subset (GET/HEAD/PUT blobs and
+  manifests, tag listing) storing content-addressed blobs on disk.
+- `push_artifact` / `pull_artifact`: artifact ↔ files helpers. Artifacts
+  are a single tar.gz layer (media type `.tar+gzip`), the layout
+  oras/flux use for config artifacts.
+
+Only plain HTTP endpoints are spoken (in-cluster registries; tests);
+auth rides an optional static bearer token.
+"""
+
+from __future__ import annotations
+
+import gzip
+import hashlib
+import io
+import json
+import os
+import re
+import tarfile
+import threading
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+MANIFEST_TYPE = "application/vnd.oci.image.manifest.v1+json"
+LAYER_TYPE = "application/vnd.oci.image.layer.v1.tar+gzip"
+CONFIG_TYPE = "application/vnd.oci.empty.v1+json"
+
+_NAME = re.compile(r"^[a-z0-9]+(?:[._/-][a-z0-9]+)*$")
+
+
+class OCIError(RuntimeError):
+    pass
+
+
+def _digest(data: bytes) -> str:
+    return "sha256:" + hashlib.sha256(data).hexdigest()
+
+
+class OCIRegistry:
+    """In-tree distribution-v2 registry subset."""
+
+    def __init__(self, root: Optional[str] = None, host: str = "127.0.0.1",
+                 port: int = 0, token: Optional[str] = None):
+        self._blobs: dict[str, bytes] = {}
+        # manifests[(repo, ref)] -> manifest bytes; ref = tag or digest
+        self._manifests: dict[tuple[str, str], bytes] = {}
+        self._tags: dict[str, list[str]] = {}
+        self._lock = threading.Lock()
+        self._host, self._port = host, port
+        self._token = token
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self.root = root
+        if root:
+            os.makedirs(root, exist_ok=True)
+            self._load(root)
+
+    # -- persistence (content-addressed files) --------------------------
+
+    def _load(self, root: str) -> None:
+        bdir = os.path.join(root, "blobs")
+        if os.path.isdir(bdir):
+            for fn in os.listdir(bdir):
+                with open(os.path.join(bdir, fn), "rb") as f:
+                    self._blobs["sha256:" + fn] = f.read()
+        mpath = os.path.join(root, "manifests.json")
+        if os.path.exists(mpath):
+            with open(mpath) as f:
+                doc = json.load(f)
+            for key, raw in doc.items():
+                repo, ref = key.split("@", 1)
+                self._manifests[(repo, ref)] = raw.encode()
+
+    def _persist(self) -> None:
+        if not self.root:
+            return
+        bdir = os.path.join(self.root, "blobs")
+        os.makedirs(bdir, exist_ok=True)
+        for digest, data in self._blobs.items():
+            path = os.path.join(bdir, digest.split(":", 1)[1])
+            if not os.path.exists(path):
+                with open(path, "wb") as f:
+                    f.write(data)
+        with open(os.path.join(self.root, "manifests.json"), "w") as f:
+            json.dump(
+                {f"{r}@{t}": raw.decode() for (r, t), raw in self._manifests.items()},
+                f,
+            )
+
+    # -- store API -------------------------------------------------------
+
+    def put_blob(self, data: bytes) -> str:
+        d = _digest(data)
+        with self._lock:
+            self._blobs[d] = data
+            self._persist()
+        return d
+
+    def put_manifest(self, repo: str, tag: str, manifest: dict) -> str:
+        if not _NAME.match(repo):
+            raise OCIError(f"bad repository name {repo!r}")
+        raw = json.dumps(manifest, sort_keys=True).encode()
+        d = _digest(raw)
+        with self._lock:
+            self._manifests[(repo, tag)] = raw
+            self._manifests[(repo, d)] = raw
+            self._tags.setdefault(repo, [])
+            if tag not in self._tags[repo]:
+                self._tags[repo].append(tag)
+            self._persist()
+        return d
+
+    # -- HTTP server -----------------------------------------------------
+
+    @property
+    def endpoint(self) -> str:
+        return f"{self._host}:{self._port}"
+
+    def start(self) -> "OCIRegistry":
+        reg = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):  # pragma: no cover
+                pass
+
+            def _deny(self, code: int, msg: str = ""):
+                self.send_response(code)
+                self.end_headers()
+                if msg:
+                    self.wfile.write(msg.encode())
+
+            def _go(self, head: bool):
+                if reg._token:
+                    if self.headers.get("Authorization") != f"Bearer {reg._token}":
+                        return self._deny(401, "unauthorized")
+                m = re.match(r"^/v2/(?P<repo>.+)/(?P<kind>manifests|blobs|tags)/(?P<ref>.+)$", self.path)
+                if self.path == "/v2/":
+                    self.send_response(200)
+                    self.end_headers()
+                    return
+                if not m:
+                    return self._deny(404)
+                repo, kind, ref = m.group("repo"), m.group("kind"), m.group("ref")
+                with reg._lock:
+                    if kind == "manifests":
+                        raw = reg._manifests.get((repo, ref))
+                        ctype = MANIFEST_TYPE
+                    elif kind == "blobs":
+                        raw = reg._blobs.get(ref)
+                        ctype = "application/octet-stream"
+                    else:  # tags/list
+                        raw = json.dumps(
+                            {"name": repo, "tags": reg._tags.get(repo, [])}
+                        ).encode()
+                        ctype = "application/json"
+                if raw is None:
+                    return self._deny(404)
+                self.send_response(200)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(raw)))
+                self.send_header("Docker-Content-Digest", _digest(raw))
+                self.end_headers()
+                if not head:
+                    self.wfile.write(raw)
+
+            def do_GET(self):
+                self._go(head=False)
+
+            def do_HEAD(self):
+                self._go(head=True)
+
+        self._httpd = ThreadingHTTPServer((self._host, self._port), Handler)
+        self._port = self._httpd.server_address[1]
+        threading.Thread(target=self._httpd.serve_forever, daemon=True).start()
+        return self
+
+    def stop(self) -> None:
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None
+
+
+# -- artifact helpers -------------------------------------------------------
+
+
+def push_artifact(registry: OCIRegistry, repo: str, tag: str,
+                  files: dict[str, bytes]) -> str:
+    """files → one tar.gz layer + manifest; returns the manifest digest."""
+    buf = io.BytesIO()
+    # mtime=0 via gzip.GzipFile keeps the digest deterministic per content.
+    with gzip.GzipFile(fileobj=buf, mode="wb", mtime=0) as gz:
+        with tarfile.open(fileobj=gz, mode="w") as tar:
+            for name in sorted(files):
+                data = files[name]
+                info = tarfile.TarInfo(name)
+                info.size = len(data)
+                tar.addfile(info, io.BytesIO(data))
+    layer = buf.getvalue()
+    layer_digest = registry.put_blob(layer)
+    config = b"{}"
+    config_digest = registry.put_blob(config)
+    manifest = {
+        "schemaVersion": 2,
+        "mediaType": MANIFEST_TYPE,
+        "config": {"mediaType": CONFIG_TYPE, "digest": config_digest,
+                   "size": len(config)},
+        "layers": [{"mediaType": LAYER_TYPE, "digest": layer_digest,
+                    "size": len(layer)}],
+    }
+    return registry.put_manifest(repo, tag, manifest)
+
+
+def _fetch(url: str, token: Optional[str] = None, timeout: float = 30.0) -> bytes:
+    req = urllib.request.Request(url)
+    req.add_header("Accept", MANIFEST_TYPE)
+    if token:
+        req.add_header("Authorization", f"Bearer {token}")
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return r.read()
+
+
+def pull_artifact(ref: str, token: Optional[str] = None) -> tuple[str, dict[str, bytes]]:
+    """'host:port/repo:tag' (or @sha256:...) → (manifest digest, files).
+
+    Layer tars are extracted memory-side with path traversal guards —
+    registry content is untrusted input."""
+    m = re.match(r"^(?P<host>[^/]+)/(?P<repo>[^:@]+)(?::(?P<tag>[^@]+))?(?:@(?P<dig>sha256:[0-9a-f]+))?$", ref)
+    if not m:
+        raise OCIError(f"bad OCI ref {ref!r}")
+    host, repo = m.group("host"), m.group("repo")
+    want = m.group("dig") or m.group("tag") or "latest"
+    raw = _fetch(f"http://{host}/v2/{repo}/manifests/{want}", token)
+    digest = _digest(raw)
+    if m.group("dig") and digest != m.group("dig"):
+        raise OCIError(f"manifest digest mismatch: got {digest}")
+    manifest = json.loads(raw)
+    files: dict[str, bytes] = {}
+    for layer in manifest.get("layers", []):
+        ldig = layer["digest"]
+        data = _fetch(f"http://{host}/v2/{repo}/blobs/{ldig}", token)
+        if _digest(data) != ldig:
+            raise OCIError(f"layer digest mismatch for {ldig}")
+        if layer.get("mediaType", LAYER_TYPE).endswith("+gzip"):
+            data = gzip.decompress(data)
+        with tarfile.open(fileobj=io.BytesIO(data), mode="r") as tar:
+            for info in tar.getmembers():
+                if not info.isfile():
+                    continue
+                name = os.path.normpath(info.name)
+                if name.startswith(("/", "..")) or os.path.isabs(name):
+                    raise OCIError(f"layer path escapes root: {info.name!r}")
+                fobj = tar.extractfile(info)
+                if fobj is not None:
+                    files[name] = fobj.read()
+    return digest, files

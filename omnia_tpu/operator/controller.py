@@ -103,7 +103,10 @@ class ControllerManager:
                 pass
             if time.monotonic() - last_resync >= resync_s:
                 last_resync = time.monotonic()
-                self.resync()
+                try:
+                    self.resync()
+                except Exception:  # the reconcile thread must never die
+                    logger.exception("resync failed; retrying next tick")
 
     def shutdown(self) -> None:
         self._stop.set()
@@ -160,6 +163,24 @@ class ControllerManager:
         for ws in self.store.list(ResourceKind.WORKSPACE.value):
             if ws.status.get("phase") in ("Error", "", None):
                 self.reconcile_workspace(ws)
+        # Source kinds re-sync on their declared interval (reference
+        # promptpacksource_controller.go requeue-after) and retry errors.
+        for kind, fn in (
+            (ResourceKind.PROMPT_PACK_SOURCE.value, self.reconcile_prompt_pack_source),
+            (ResourceKind.ARENA_SOURCE.value, self.reconcile_arena_source),
+            (ResourceKind.ARENA_TEMPLATE_SOURCE.value, self.reconcile_arena_source),
+        ):
+            for src in self.store.list(kind):
+                synced = float(src.status.get("syncedAt") or 0.0)
+                interval = float(src.spec.get("interval_s", 60.0))
+                if (
+                    src.status.get("phase") != "Ready"
+                    or time.time() - synced >= interval
+                ):
+                    fn(src)
+        for ads in self.store.list(ResourceKind.ARENA_DEV_SESSION.value):
+            if ads.status.get("phase") in ("Ready", "Blocked", "", None):
+                self.reconcile_arena_dev_session(ads)
 
     # -- reconcilers ----------------------------------------------------
 
@@ -188,6 +209,15 @@ class ControllerManager:
             self.reconcile_tool_policies(res)
         elif kind == ResourceKind.WORKSPACE.value:
             self.reconcile_workspace(res)
+        elif kind == ResourceKind.PROMPT_PACK_SOURCE.value:
+            self.reconcile_prompt_pack_source(res)
+        elif kind in (
+            ResourceKind.ARENA_SOURCE.value,
+            ResourceKind.ARENA_TEMPLATE_SOURCE.value,
+        ):
+            self.reconcile_arena_source(res)
+        elif kind == ResourceKind.ARENA_DEV_SESSION.value:
+            self.reconcile_arena_dev_session(res)
         elif kind in (
             ResourceKind.SESSION_PRIVACY_POLICY.value,
             ResourceKind.ROLLOUT_ANALYSIS.value,
@@ -260,12 +290,132 @@ class ControllerManager:
             if not self.arena.has(name):
                 spec_doc = dict(res.spec)
                 spec_doc["name"] = name
+                sf = spec_doc.pop("scenariosFrom", None)
+                if sf and not spec_doc.get("scenarios"):
+                    # Scenarios from a synced ArenaSource (reference arena
+                    # content sync → worker PVC; here the shared sync root).
+                    import json as _json
+
+                    key = (
+                        f"{ResourceKind.ARENA_SOURCE.value.lower()}-"
+                        f"{res.namespace}-{sf['name']}"
+                    )
+                    raw = self._syncer().read(key, sf.get("path", "scenarios.json"))
+                    spec_doc["scenarios"] = _json.loads(raw)
                 self.arena.submit(ArenaJobSpec.from_dict(spec_doc))
             status = self.arena.reconcile(name)
         except Exception as e:
             self.store.update_status(res, {"phase": "Error", "message": str(e)})
             return
         self.store.update_status(res, status.to_dict())
+
+    def _syncer(self):
+        """Lazy shared source syncer (OMNIA_SYNC_ROOT or a temp dir — the
+        reference syncs to a workspace PVC, sourcesync/syncer.go:92)."""
+        if getattr(self, "_syncer_inst", None) is None:
+            import os
+            import tempfile
+
+            from omnia_tpu.operator.sourcesync import Syncer
+
+            root = os.environ.get("OMNIA_SYNC_ROOT") or tempfile.mkdtemp(
+                prefix="omnia-sync-"
+            )
+            self._syncer_inst = Syncer(root)
+        return self._syncer_inst
+
+    def _source_key(self, res: Resource) -> str:
+        return f"{res.kind.lower()}-{res.namespace}-{res.name}"
+
+    def reconcile_prompt_pack_source(self, res: Resource) -> None:
+        """Sync the source and project its pack JSON into a PromptPack
+        resource (reference ee promptpacksource_controller.go): a version
+        change lands as a PromptPack update, which the existing
+        version-trigger rollout machinery picks up — pack-source push =
+        progressive rollout."""
+        if not self._license_gate(res, "sources"):
+            return
+        import json as _json
+
+        from omnia_tpu.operator.sourcesync import SyncError
+
+        syncer = self._syncer()
+        key = self._source_key(res)
+        pack_name = res.spec.get("packName") or res.name
+        try:
+            version = syncer.sync(key, res.spec.get("source") or {})
+            raw = syncer.read(key, res.spec.get("packFile", "pack.json"))
+            content = _json.loads(raw)
+            existing = self.store.get(
+                res.namespace, ResourceKind.PROMPT_PACK.value, pack_name
+            )
+            if existing is None or existing.spec.get("content") != content:
+                pack = existing or Resource(
+                    kind=ResourceKind.PROMPT_PACK.value,
+                    name=pack_name,
+                    namespace=res.namespace,
+                )
+                pack.spec = dict(pack.spec)
+                pack.spec["content"] = content
+                pack.spec["sourceRef"] = {"name": res.name}
+                # Admission (ValidationError) must land as source status,
+                # not escape resync() and kill the reconcile thread: a bad
+                # pack in a synced repo is routine operator input.
+                self.store.apply(pack)
+        except Exception as e:  # noqa: BLE001 - any failure = source Error
+            self.store.update_status(res, {"phase": "Error", "message": str(e)})
+            return
+        self.store.update_status(res, {
+            "phase": "Ready",
+            "version": version,
+            "packName": pack_name,
+            "packVersion": content.get("version", ""),
+            "syncedAt": time.time(),
+        })
+
+    def reconcile_arena_source(self, res: Resource) -> None:
+        """Arena scenario/template content sync (reference
+        arenasource_controller.go / arenatemplatesource_controller.go):
+        content lands in the shared sync root; ArenaJobs reference it via
+        scenariosFrom."""
+        if not self._license_gate(res, "sources"):
+            return
+        try:
+            version = self._syncer().sync(
+                self._source_key(res), res.spec.get("source") or {}
+            )
+        except Exception as e:  # noqa: BLE001 - any failure = source Error
+            self.store.update_status(res, {"phase": "Error", "message": str(e)})
+            return
+        self.store.update_status(res, {
+            "phase": "Ready", "version": version, "syncedAt": time.time(),
+        })
+
+    def reconcile_arena_dev_session(self, res: Resource) -> None:
+        """Interactive arena dev session record (reference
+        arenadevsession_controller.go): validates the agent ref, stamps an
+        expiry, and expires on the level-trigger."""
+        if not self._license_gate(res, "arena"):
+            return
+        exp = res.status.get("expiresAt")
+        if exp and time.time() > float(exp):
+            self.store.update_status(res, {"phase": "Expired"})
+            return
+        ref = (res.spec.get("agentRef") or {}).get("name", "")
+        agent = self.store.get(
+            res.namespace, ResourceKind.AGENT_RUNTIME.value, ref
+        )
+        if agent is None:
+            self.store.update_status(
+                res, {"phase": "Error", "message": f"agentRef {ref!r} not found"}
+            )
+            return
+        endpoint = (agent.status.get("serviceEndpoint") or "")
+        self.store.update_status(res, {
+            "phase": "Ready",
+            "agentEndpoint": endpoint,
+            "expiresAt": exp or time.time() + float(res.spec.get("ttl_s", 3600.0)),
+        })
 
     def _rebuild_policy_evaluator(self) -> list[str]:
         from omnia_tpu.policy.broker import PolicyEvaluator, ToolPolicy

@@ -265,6 +265,43 @@ def _skill_source_schema() -> dict:
     }, required=["source"])
 
 
+# Shared source shape for PromptPackSource / Arena*Source (reference
+# sourcesync_types.go:56-58: git | oci | configmap; local for devroots).
+def _sync_source() -> dict:
+    return _obj({
+        "type": _str(enum=("git", "oci", "configmap", "local")),
+        "repo": _str(desc="git clone url"),
+        "ref": _str(desc="git branch/tag, or OCI host/repo:tag[@digest]"),
+        "path": _str(),
+        "data": _obj(open_=True, desc="configmap payload {filename: text}"),
+        "token": _str(desc="OCI bearer token"),
+    }, required=["type"])
+
+
+def _prompt_pack_source_schema() -> dict:
+    return _obj({
+        "source": _sync_source(),
+        "packName": _str(desc="target PromptPack name (default: source name)"),
+        "packFile": _str(desc="pack JSON filename in the source (default pack.json)"),
+        "interval_s": _NUM,
+    }, required=["source"])
+
+
+def _arena_source_schema() -> dict:
+    return _obj({
+        "source": _sync_source(),
+        "interval_s": _NUM,
+    }, required=["source"])
+
+
+def _arena_dev_session_schema() -> dict:
+    return _obj({
+        "agentRef": _REF,
+        "ttl_s": _NUM,
+        "packOverride": _obj(open_=True),
+    }, required=["agentRef"])
+
+
 # kind → (plural, schema builder, short names)
 KINDS: dict[str, tuple[str, object, list[str]]] = {
     "AgentRuntime": ("agentruntimes", _agent_runtime_schema, ["ar"]),
@@ -285,6 +322,10 @@ KINDS: dict[str, tuple[str, object, list[str]]] = {
         "sessionprivacypolicies", _session_privacy_policy_schema, ["spp"],
     ),
     "RolloutAnalysis": ("rolloutanalyses", _rollout_analysis_schema, []),
+    "PromptPackSource": ("promptpacksources", _prompt_pack_source_schema, ["pps"]),
+    "ArenaSource": ("arenasources", _arena_source_schema, []),
+    "ArenaTemplateSource": ("arenatemplatesources", _arena_source_schema, []),
+    "ArenaDevSession": ("arenadevsessions", _arena_dev_session_schema, ["ads"]),
 }
 
 

@@ -152,6 +152,23 @@ class InProcessPodBackend:
     def __init__(self) -> None:
         self._counter = 0
         self._lock = threading.Lock()
+        self._media = None
+
+    def _media_store(self):
+        """One shared LocalMediaStore per backend: all in-process pods see
+        the same media (the cluster analog is a shared bucket + shared
+        OMNIA_MEDIA_SECRET); facade and runtime must share the instance so
+        grant tokens verify across the pair."""
+        with self._lock:
+            if self._media is None:
+                import tempfile
+
+                from omnia_tpu.media import LocalMediaStore
+
+                self._media = LocalMediaStore(
+                    tempfile.mkdtemp(prefix="omnia-media-")
+                )
+            return self._media
 
     def start_pod(
         self,
@@ -180,6 +197,7 @@ class InProcessPodBackend:
             providers=registry,
             provider_name=dep.default_provider,
             tool_executor=ToolExecutor(handlers=_build_tool_handlers(dep.tool_configs)),
+            media_store=self._media_store(),
         )
         runtime_port = runtime.serve(wait_ready=wait_ready)
         facade = FacadeServer(
@@ -194,6 +212,8 @@ class InProcessPodBackend:
                 # candidate metrics, not whole-agent metrics).
                 attrs={"track": track, "version": version or dep.config_hash()},
             ),
+            media_store=self._media_store(),
+            workspace=dep.namespace,
         )
         facade_port = facade.serve()
         handle = PodHandle(

@@ -33,6 +33,16 @@ DEFAULT_VALUES: dict = {
     "memoryApi": {"replicas": 1},
     "redis": {"enabled": True},
     "serviceAccount": "omnia-operator",
+    # Bundled observability (reference charts/omnia/templates/observability:
+    # Prometheus + Grafana dashboards + podmonitors; Loki/Tempo are left to
+    # a cluster's own logging/tracing stack — OTLP export is wired via
+    # OMNIA_OTLP_ENDPOINT on the services).
+    "observability": {
+        "enabled": False,
+        "prometheus": {"image": "prom/prometheus:v2.53.0", "retention": "24h"},
+        "grafana": {"image": "grafana/grafana:11.1.0"},
+        "podMonitors": True,
+    },
 }
 
 
@@ -182,7 +192,125 @@ def render_install(values: Optional[dict] = None) -> list[dict]:
         _service(ns, "omnia-memory-api", "memory-api",
                  [{"name": "http", "port": 8400}]),
     ]
+    if v["observability"]["enabled"]:
+        out += _render_observability(ns, v["observability"])
     return out
+
+
+# -- observability bundle ---------------------------------------------------
+# Reference charts/omnia/templates/observability: in-cluster Prometheus
+# scraping every omnia pod's `metrics` port, a Grafana instance provisioned
+# with the serving dashboard, and PodMonitor objects for clusters running
+# prometheus-operator (the reference's agent-podmonitor.yaml shape).
+
+GRAFANA_DASHBOARD = {
+    "title": "Omnia TPU Serving",
+    "uid": "omnia-serving",
+    "panels": [
+        {"title": "TTFT p50 (s)", "type": "timeseries", "targets": [
+            {"expr": "histogram_quantile(0.5, sum(rate("
+                     "omnia_facade_turn_seconds_bucket[5m])) by (le))"}]},
+        {"title": "Decode tokens/sec", "type": "timeseries", "targets": [
+            {"expr": "sum(rate(omnia_engine_tokens_generated_total[1m]))"}]},
+        {"title": "Inference queue depth", "type": "timeseries", "targets": [
+            {"expr": "sum(omnia_engine_queue_depth) by (pod)"}]},
+        {"title": "Active connections", "type": "timeseries", "targets": [
+            {"expr": "sum(omnia_facade_connections_active)"}]},
+        {"title": "Turn errors/min", "type": "timeseries", "targets": [
+            {"expr": "sum(rate(omnia_facade_turn_errors_total[1m])) * 60"}]},
+        {"title": "Session writes/min", "type": "timeseries", "targets": [
+            {"expr": "sum(rate(omnia_session_writes_total[1m])) * 60"}]},
+    ],
+}
+
+
+def _render_observability(ns: str, cfg: dict) -> list[dict]:
+    import json as _json
+
+    prom_cfg = {
+        "global": {"scrape_interval": "15s"},
+        "scrape_configs": [{
+            "job_name": "omnia",
+            "kubernetes_sd_configs": [{"role": "pod"}],
+            "relabel_configs": [
+                # Scrape any pod exposing a port NAMED `metrics` with the
+                # omnia app label — agents and core services alike (the
+                # reference discovers by port name too).
+                {"source_labels": ["__meta_kubernetes_pod_label_app_kubernetes_io_name"],
+                 "regex": "omnia", "action": "keep"},
+                {"source_labels": ["__meta_kubernetes_pod_container_port_name"],
+                 "regex": "metrics", "action": "keep"},
+                {"source_labels": ["__meta_kubernetes_pod_name"],
+                 "target_label": "pod"},
+            ],
+        }],
+    }
+    out: list[dict] = [
+        {
+            "apiVersion": "v1",
+            "kind": "ConfigMap",
+            "metadata": {"name": "omnia-prometheus-config", "namespace": ns,
+                         "labels": _labels("prometheus")},
+            "data": {"prometheus.yml": _to_inline_yaml(prom_cfg)},
+        },
+        _deployment(ns, "omnia-prometheus", "prometheus",
+                    cfg["prometheus"]["image"], 1,
+                    [{"name": "http", "containerPort": 9090}], []),
+        _service(ns, "omnia-prometheus", "prometheus",
+                 [{"name": "http", "port": 9090}]),
+        {
+            "apiVersion": "v1",
+            "kind": "ConfigMap",
+            "metadata": {"name": "omnia-grafana-dashboards", "namespace": ns,
+                         "labels": _labels("grafana")},
+            "data": {"omnia-serving.json": _json.dumps(GRAFANA_DASHBOARD)},
+        },
+        _deployment(ns, "omnia-grafana", "grafana", cfg["grafana"]["image"], 1,
+                    [{"name": "http", "containerPort": 3000}],
+                    [{"name": "GF_AUTH_ANONYMOUS_ENABLED", "value": "true"}]),
+        _service(ns, "omnia-grafana", "grafana",
+                 [{"name": "http", "port": 3000}]),
+    ]
+    # Mount prometheus config + grafana dashboards into their pods.
+    prom = out[1]["spec"]["template"]["spec"]
+    prom["volumes"] = [{"name": "config",
+                        "configMap": {"name": "omnia-prometheus-config"}}]
+    prom["containers"][0]["args"] = [
+        "--config.file=/etc/prometheus/prometheus.yml",
+        f"--storage.tsdb.retention.time={cfg['prometheus']['retention']}",
+    ]
+    prom["containers"][0]["volumeMounts"] = [
+        {"name": "config", "mountPath": "/etc/prometheus"}]
+    graf = out[4]["spec"]["template"]["spec"]
+    graf["volumes"] = [{"name": "dashboards",
+                        "configMap": {"name": "omnia-grafana-dashboards"}}]
+    graf["containers"][0]["volumeMounts"] = [
+        {"name": "dashboards",
+         "mountPath": "/var/lib/grafana/dashboards"}]
+    if cfg.get("podMonitors", True):
+        # prometheus-operator clusters (reference agent-podmonitor.yaml).
+        for comp, selector in (
+            ("agents", {"app.kubernetes.io/name": "omnia",
+                        "app.kubernetes.io/component": "agent"}),
+            ("services", {"app.kubernetes.io/name": "omnia"}),
+        ):
+            out.append({
+                "apiVersion": "monitoring.coreos.com/v1",
+                "kind": "PodMonitor",
+                "metadata": {"name": f"omnia-{comp}", "namespace": ns,
+                             "labels": _labels("monitoring")},
+                "spec": {
+                    "selector": {"matchLabels": selector},
+                    "podMetricsEndpoints": [{"port": "metrics"}],
+                },
+            })
+    return out
+
+
+def _to_inline_yaml(doc: dict) -> str:
+    import yaml
+
+    return yaml.safe_dump(doc, sort_keys=False)
 
 
 def to_yaml(manifests: list[dict]) -> str:

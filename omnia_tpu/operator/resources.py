@@ -37,6 +37,12 @@ class ResourceKind(str, enum.Enum):
     TOOL_POLICY = "ToolPolicy"
     SESSION_PRIVACY_POLICY = "SessionPrivacyPolicy"
     ROLLOUT_ANALYSIS = "RolloutAnalysis"
+    # Source-sync kinds (reference ee promptpacksource_controller.go,
+    # arenasource/arenatemplatesource/arenadevsession controllers).
+    PROMPT_PACK_SOURCE = "PromptPackSource"
+    ARENA_SOURCE = "ArenaSource"
+    ARENA_TEMPLATE_SOURCE = "ArenaTemplateSource"
+    ARENA_DEV_SESSION = "ArenaDevSession"
 
 
 EE_KINDS = frozenset({
@@ -44,7 +50,15 @@ EE_KINDS = frozenset({
     ResourceKind.TOOL_POLICY.value,
     ResourceKind.SESSION_PRIVACY_POLICY.value,
     ResourceKind.ROLLOUT_ANALYSIS.value,
+    ResourceKind.PROMPT_PACK_SOURCE.value,
+    ResourceKind.ARENA_SOURCE.value,
+    ResourceKind.ARENA_TEMPLATE_SOURCE.value,
+    ResourceKind.ARENA_DEV_SESSION.value,
 })
+
+# Source spec type vocabulary (SkillSource/PromptPackSource/Arena*Source;
+# reference sourcesync_types.go:56-58 git|oci|configmap + in-tree local).
+SOURCE_TYPES = ("git", "oci", "configmap", "local")
 
 
 # Enum vocabularies shared with validation (reference anchors cited).

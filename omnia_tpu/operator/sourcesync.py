@@ -49,6 +49,8 @@ class Syncer:
             return self._sync_payload(name, source.get("data") or {})
         if stype == "local":
             return self._sync_local(name, source["path"])
+        if stype == "oci":
+            return self._sync_oci(name, source)
         raise SyncError(f"unsupported source type {stype!r}")
 
     def head(self, name: str) -> Optional[str]:
@@ -130,6 +132,37 @@ class Syncer:
             text = content if isinstance(content, str) else json.dumps(content)
             with open(os.path.join(staging, fname), "w") as f:
                 f.write(text)
+        return self._install(name, version, staging, move=True)
+
+    def _sync_oci(self, name: str, source: dict) -> str:
+        """OCI artifact source (reference internal/sourcesync/oci.go):
+        pull 'host:port/repo:tag[@digest]' from a v2 registry (the
+        in-tree omnia_tpu.oci registry, or any plain-HTTP in-cluster
+        registry) and install the layer files as a version. Version id =
+        manifest digest, so re-syncing an unchanged tag is idempotent
+        and a moved tag lands as a NEW version (tag-move = pack update)."""
+        ref = source.get("ref") or source.get("url")
+        if not ref:
+            raise SyncError("oci source requires ref (host/repo:tag)")
+        from omnia_tpu.oci import OCIError, pull_artifact
+
+        try:
+            digest, files = pull_artifact(ref, token=source.get("token"))
+        except OCIError as e:
+            raise SyncError(f"oci sync failed: {e}") from e
+        except Exception as e:  # network/registry errors
+            raise SyncError(f"oci sync failed: {e}") from e
+        version = f"oci-{digest.split(':', 1)[1][:12]}"
+        if self.head(name) == version:
+            return version
+        staging = os.path.join(self.root, name, f".{version}.tmp")
+        shutil.rmtree(staging, ignore_errors=True)
+        os.makedirs(staging)
+        for rel, data in files.items():
+            dest = os.path.join(staging, rel)
+            os.makedirs(os.path.dirname(dest) or staging, exist_ok=True)
+            with open(dest, "wb") as f:
+                f.write(data)
         return self._install(name, version, staging, move=True)
 
     def _sync_local(self, name: str, path: str) -> str:

@@ -175,8 +175,11 @@ def _validate_skill_source(spec: dict, errs: list[str]) -> None:
 
 
 def _validate_arena_job(spec: dict, errs: list[str]) -> None:
-    if not spec.get("scenarios"):
-        errs.append("scenarios[] is required")
+    if not spec.get("scenarios") and not spec.get("scenariosFrom"):
+        errs.append("scenarios[] or scenariosFrom is required")
+    sf = spec.get("scenariosFrom")
+    if sf is not None and (not isinstance(sf, dict) or not sf.get("name")):
+        errs.append("scenariosFrom.name (an ArenaSource) is required")
     if not spec.get("providers"):
         errs.append("providers[] is required")
     mode = spec.get("mode", "direct")
@@ -230,7 +233,40 @@ def _validate_rollout_analysis(spec: dict, errs: list[str]) -> None:
             errs.append(f"metrics[{i}] needs a threshold field")
 
 
+def _validate_sync_source(spec: dict, errs: list[str]) -> None:
+    src = spec.get("source")
+    if not isinstance(src, dict):
+        errs.append("spec.source is required")
+        return
+    from omnia_tpu.operator.resources import SOURCE_TYPES
+
+    stype = src.get("type")
+    if stype not in SOURCE_TYPES:
+        errs.append(f"source.type must be one of {SOURCE_TYPES}, got {stype!r}")
+    if stype == "git" and not (src.get("repo") or src.get("url")):
+        errs.append("git source requires repo url")
+    if stype == "oci" and not (src.get("ref") or src.get("url")):
+        errs.append("oci source requires ref (host/repo:tag)")
+    if stype == "configmap" and not isinstance(src.get("data"), dict):
+        errs.append("configmap source requires data {filename: content}")
+    if stype == "local" and not src.get("path"):
+        errs.append("local source requires path")
+
+
+def _validate_arena_dev_session(spec: dict, errs: list[str]) -> None:
+    ref = spec.get("agentRef")
+    if not isinstance(ref, dict) or not ref.get("name"):
+        errs.append("agentRef.name is required")
+    ttl = spec.get("ttl_s")
+    if ttl is not None and (not isinstance(ttl, (int, float)) or ttl <= 0):
+        errs.append("ttl_s must be a positive number")
+
+
 _VALIDATORS: dict[str, Callable[[dict, list[str]], None]] = {
+    ResourceKind.PROMPT_PACK_SOURCE.value: _validate_sync_source,
+    ResourceKind.ARENA_SOURCE.value: _validate_sync_source,
+    ResourceKind.ARENA_TEMPLATE_SOURCE.value: _validate_sync_source,
+    ResourceKind.ARENA_DEV_SESSION.value: _validate_arena_dev_session,
     ResourceKind.ARENA_JOB.value: _validate_arena_job,
     ResourceKind.TOOL_POLICY.value: _validate_tool_policy,
     ResourceKind.SESSION_PRIVACY_POLICY.value: _validate_session_privacy_policy,

@@ -6,6 +6,7 @@ from omnia_tpu.privacy.audit import AuditHub, AuditOutbox
 from omnia_tpu.privacy.api import PrivacyAPI
 from omnia_tpu.privacy.deletion import DeletionRequest, FanoutEraser, TargetState
 from omnia_tpu.privacy.encryption import Envelope, EnvelopeCipher, Kms, KmsError, LocalKms
+from omnia_tpu.privacy.rotation import EnvelopeVault, KeyRotationController
 from omnia_tpu.privacy.redaction import Redactor
 
 __all__ = [
@@ -17,6 +18,8 @@ __all__ = [
     "TargetState",
     "Envelope",
     "EnvelopeCipher",
+    "EnvelopeVault",
+    "KeyRotationController",
     "Kms",
     "KmsError",
     "LocalKms",

@@ -1,0 +1,155 @@
+"""Key-rotation controller: scheduled KEK generations + envelope re-wrap.
+
+Reference ee/internal/controller/keyrotation_controller.go: a controller
+that (a) mints a new master-key generation when the current one exceeds
+its age budget and (b) sweeps every stored envelope, re-wrapping DEKs
+under the current KEK — payload bytes are never touched, so rotation cost
+is O(envelopes), not O(data). VERDICT r2 flagged this as the missing half
+of the encryption plane (privacy/encryption.py had rotate() with nothing
+driving it).
+
+EnvelopeVault is the durable envelope store the sweep runs over: the
+privacy plane keeps PII payloads in it (encrypted at rest, jsonl-backed),
+and anything else holding Envelope JSON can implement the same two-method
+surface (iter_envelopes / replace_envelope) to join the sweep.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Iterator, Optional
+
+from omnia_tpu.privacy.encryption import Envelope, EnvelopeCipher, LocalKms
+
+DEFAULT_KEY_MAX_AGE_S = 30 * 24 * 3600.0
+
+
+class EnvelopeVault:
+    """Encrypted-at-rest blob store keyed by id (privacy-plane payloads).
+
+    jsonl file layout, one {"id", "env"} per line, latest-wins — same
+    durability idiom as the memory store's snapshot."""
+
+    def __init__(self, cipher: EnvelopeCipher, path: Optional[str] = None):
+        self.cipher = cipher
+        self.path = path
+        self._envs: dict[str, Envelope] = {}
+        self._lock = threading.Lock()
+        if path and os.path.exists(path):
+            with open(path) as f:
+                for line in f:
+                    doc = json.loads(line)
+                    self._envs[doc["id"]] = Envelope.from_json(doc["env"])
+
+    def _append(self, blob_id: str, env: Envelope) -> None:
+        if not self.path:
+            return
+        with open(self.path, "a") as f:
+            f.write(json.dumps({"id": blob_id, "env": env.to_json()}) + "\n")
+
+    def put(self, blob_id: str, plaintext: bytes) -> None:
+        env = self.cipher.encrypt(plaintext, aad=blob_id.encode())
+        with self._lock:
+            self._envs[blob_id] = env
+            self._append(blob_id, env)
+
+    def get(self, blob_id: str) -> Optional[bytes]:
+        with self._lock:
+            env = self._envs.get(blob_id)
+        if env is None:
+            return None
+        return self.cipher.decrypt(env, aad=blob_id.encode())
+
+    def delete(self, blob_id: str) -> bool:
+        with self._lock:
+            hit = self._envs.pop(blob_id, None) is not None
+        if hit and self.path:
+            self.compact()
+        return hit
+
+    def compact(self) -> None:
+        if not self.path:
+            return
+        with self._lock, open(self.path + ".tmp", "w") as f:
+            for bid, env in self._envs.items():
+                f.write(json.dumps({"id": bid, "env": env.to_json()}) + "\n")
+        os.replace(self.path + ".tmp", self.path)
+
+    # -- rotation surface ----------------------------------------------
+
+    def iter_envelopes(self) -> Iterator[tuple[str, Envelope]]:
+        with self._lock:
+            items = list(self._envs.items())
+        yield from items
+
+    def replace_envelope(self, blob_id: str, env: Envelope) -> None:
+        with self._lock:
+            if blob_id in self._envs:
+                self._envs[blob_id] = env
+                self._append(blob_id, env)
+
+
+class KeyRotationController:
+    """Drives KEK generations and envelope sweeps (reference
+    keyrotation_controller.go Reconcile)."""
+
+    def __init__(
+        self,
+        kms: LocalKms,
+        stores: Optional[list] = None,
+        key_max_age_s: float = DEFAULT_KEY_MAX_AGE_S,
+    ):
+        self.kms = kms
+        self.cipher = EnvelopeCipher(kms)
+        self.stores = list(stores or [])
+        self.key_max_age_s = key_max_age_s
+        self._key_born: dict[str, float] = {kms.current_key_id(): time.time()}
+        self._gen = 0
+        self.status = {
+            "currentKey": kms.current_key_id(),
+            "rotations": 0,
+            "rewrapped": 0,
+            "lastRunAt": 0.0,
+        }
+
+    def register(self, store) -> None:
+        self.stores.append(store)
+
+    def _key_age(self) -> float:
+        return time.time() - self._key_born.get(self.kms.current_key_id(), 0.0)
+
+    def rotate_key(self) -> str:
+        """Mint a new KEK generation and make it current. Old generations
+        stay resident for unwrap until every envelope is re-wrapped."""
+        self._gen += 1
+        key_id = f"gen-{int(time.time())}-{self._gen}"
+        self.kms.add_key(key_id, make_current=True)
+        self._key_born[key_id] = time.time()
+        self.status["currentKey"] = key_id
+        self.status["rotations"] += 1
+        return key_id
+
+    def sweep(self) -> int:
+        """Re-wrap every envelope not under the current KEK. Returns the
+        count re-wrapped."""
+        current = self.kms.current_key_id()
+        n = 0
+        for store in self.stores:
+            for blob_id, env in store.iter_envelopes():
+                if env.key_id != current:
+                    store.replace_envelope(blob_id, self.cipher.rotate(env))
+                    n += 1
+        self.status["rewrapped"] += n
+        self.status["lastRunAt"] = time.time()
+        return n
+
+    def reconcile(self) -> dict:
+        """One controller pass: rotate when the current key is past its
+        age budget, then sweep stragglers either way."""
+        if self._key_age() >= self.key_max_age_s:
+            self.rotate_key()
+        self.sweep()
+        return dict(self.status)

@@ -829,21 +829,24 @@ class RedisServer:
         return Error("ERR unsupported XINFO subcommand")
 
 
-def main() -> None:  # pragma: no cover - manual entry point
+def main(argv=None) -> None:
     import argparse
+    import signal
 
     ap = argparse.ArgumentParser(description="omnia in-tree redis server")
     ap.add_argument("--host", default="127.0.0.1")
     ap.add_argument("--port", type=int, default=6379)
     ap.add_argument("--password", default=None)
-    args = ap.parse_args()
+    args = ap.parse_args(argv)
     srv = RedisServer(args.host, args.port, password=args.password).start()
-    print(f"omnia-redisd listening on {srv.address[0]}:{srv.address[1]}")
+    print(f"omnia-redisd listening on {srv.address[0]}:{srv.address[1]}", flush=True)
+    stop = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *_a: stop.set())
     try:
-        while True:
-            time.sleep(3600)
+        stop.wait()
     except KeyboardInterrupt:
-        srv.stop()
+        pass
+    srv.stop()
 
 
 if __name__ == "__main__":  # pragma: no cover

@@ -80,6 +80,11 @@ class ClientMessage:
     # message | tool_results | cancel | duplex_start | audio_input
     type: str = "message"
     content: str = ""
+    # Multimodal parts (reference runtime.proto ClientMessage :66-95):
+    # {"type": "text", "text": ...} or {"type": "media",
+    # "storage_ref": "media://...", "content_type": ...} — storage_refs
+    # resolve at provider-call time (media.render_parts).
+    parts: list[dict] = field(default_factory=list)
     tool_results: list[ToolResult] = field(default_factory=list)
     response_format: Optional[dict] = None   # {"type": "json"|"json_schema", "schema": {...}}
     metadata: dict = field(default_factory=dict)

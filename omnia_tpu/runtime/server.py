@@ -13,6 +13,7 @@ normal gRPC HTTP/2 stream.
 
 from __future__ import annotations
 
+import dataclasses
 import json
 import logging
 import queue
@@ -65,6 +66,7 @@ class RuntimeServer:
         memory=None,
         tracer=None,
         speech=None,
+        media_store=None,
     ):
         self.pack = pack
         self.providers = providers
@@ -73,6 +75,7 @@ class RuntimeServer:
         self.tools = tool_executor or ToolExecutor()
         self.memory = memory  # MemoryCapability shared by conversations
         self.tracer = tracer  # utils.tracing.Tracer (None = tracing off)
+        self.media = media_store  # media.MediaStore (storage_ref resolution)
         # Copy: appending 'memory' below must never mutate a caller list
         # shared with another server.
         self.capabilities = list(capabilities) if capabilities else list(DEFAULT_CAPABILITIES)
@@ -244,6 +247,25 @@ class RuntimeServer:
                         continue
                     yield from d.handle_audio(m)
                 else:
+                    if m.parts:
+                        # Resolve multimodal parts at provider-call time
+                        # (reference media_storage_adapter.go): text
+                        # attachments inline into the turn, binary ones
+                        # become metadata markers; a dangling ref fails
+                        # the turn rather than dropping the attachment.
+                        from omnia_tpu.media import MediaError, render_parts
+
+                        try:
+                            rendered = render_parts(m.parts, self.media)
+                        except MediaError as e:
+                            yield c.ServerMessage(
+                                type="error",
+                                error_code="media_unresolvable",
+                                error_message=str(e),
+                            )
+                            continue
+                        joined = "\n".join(x for x in (m.content, rendered) if x)
+                        m = dataclasses.replace(m, content=joined, parts=[])
                     yield from conv.stream(
                         m, traceparent=traceparent, input_closed=input_closed
                     )

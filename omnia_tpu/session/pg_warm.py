@@ -133,26 +133,12 @@ class PgWarmStore:
         if not attrs:
             rows = self.client.query(base, args + [limit, 0])
             return [self._row_to_session(r) for r in rows]
-        # attrs live in a JSON column: page through recency order,
-        # filtering client-side, until `limit` MATCHING rows are found or
-        # the table is exhausted — a fixed page multiplier would just move
-        # the silent-drop threshold (ADVICE r2).
-        from omnia_tpu.session.store import attrs_match
+        from omnia_tpu.session.store import paged_attrs_filter
 
-        out: list[SessionRecord] = []
-        offset, page = 0, 500
-        while len(out) < limit:
-            rows = self.client.query(base, args + [page, offset])
-            for r in rows:
-                s = self._row_to_session(r)
-                if attrs_match(s.attrs, attrs):
-                    out.append(s)
-                    if len(out) >= limit:
-                        break
-            if len(rows) < page:
-                break
-            offset += page
-        return out
+        return paged_attrs_filter(
+            lambda page, offset: self.client.query(base, args + [page, offset]),
+            self._row_to_session, attrs, limit,
+        )
 
     def delete_session(self, session_id: str) -> bool:
         existed = bool(self.client.query(

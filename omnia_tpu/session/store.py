@@ -29,6 +29,30 @@ def attrs_match(have: Optional[dict], want: Optional[dict]) -> bool:
     return all(have.get(k) == v for k, v in want.items())
 
 
+def paged_attrs_filter(fetch_page, to_session, attrs: dict, limit: int,
+                       page: int = 500) -> list:
+    """Shared SQL-tier attrs filtering: page through recency order,
+    filtering client-side (attrs live in a JSON column), until `limit`
+    MATCHING rows are found or the table is exhausted — a fixed page
+    multiplier would just move the silent-drop threshold (ADVICE r2).
+    fetch_page(limit, offset) -> raw rows; to_session(row) -> SessionRecord.
+    """
+    out: list = []
+    offset = 0
+    while len(out) < limit:
+        rows = fetch_page(page, offset)
+        for r in rows:
+            s = to_session(r)
+            if attrs_match(s.attrs, attrs):
+                out.append(s)
+                if len(out) >= limit:
+                    break
+        if len(rows) < page:
+            break
+        offset += page
+    return out
+
+
 class SessionStore(Protocol):
     # -- sessions ------------------------------------------------------
     def ensure_session(self, rec: SessionRecord) -> SessionRecord: ...

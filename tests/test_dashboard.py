@@ -157,3 +157,201 @@ class TestDashboard:
                 if m["type"] == "done":
                     assert "completion_tokens" in m["usage"]
                     break
+
+
+class TestRouteFamilies:
+    """Every reference dashboard route family (dashboard/src/app/) has a
+    working analog: providers, promptpacks, tools, workspaces, costs,
+    quality, arena(+sources), memories, topology graph, and settings
+    (CRD CRUD passthrough — crd-operations.ts)."""
+
+    def test_providers_packs_tools_workspaces(self, stack):
+        dash, dport, *_ = stack
+        dash.store.apply(Resource(kind="ToolRegistry", name="kb", spec={
+            "tools": [{"name": "kb_search", "handler": {
+                "type": "http", "url": "http://kb/search"}}]}))
+        dash.store.apply(Resource(kind="Workspace", name="team-a", spec={
+            "environment": "dev"}))
+        _s, doc = _get(dport, "/api/providers")
+        p = next(x for x in doc["providers"] if x["name"] == "mock-llm")
+        assert p["type"] == "mock" and p["role"] == "llm"
+        _s, doc = _get(dport, "/api/packs")
+        assert any(x["name"] == "dash-pack" and x["version"] == "1.0.0"
+                   for x in doc["packs"])
+        _s, doc = _get(dport, "/api/tools")
+        t = next(x for x in doc["tools"] if x["name"] == "kb_search")
+        assert t["registry"] == "kb" and t["type"] == "http"
+        _s, doc = _get(dport, "/api/workspaces")
+        assert any(w["name"] == "team-a" for w in doc["workspaces"])
+
+    def test_costs_rollup(self, stack):
+        _dash, dport, session_api, _sp = stack
+        from omnia_tpu.session.records import ProviderCallRecord
+
+        session_api.store.ensure_session(SessionRecord(
+            session_id="cost-sess", workspace="w1", agent="dash-agent"))
+        session_api.store.append_provider_call(ProviderCallRecord(
+            session_id="cost-sess", provider="tpu", model="llama3-1b",
+            input_tokens=100, output_tokens=50, cost_usd=0.0042))
+        _s, doc = _get(dport, "/api/costs")
+        row = next(s for s in doc["sessions"] if s["session_id"] == "cost-sess")
+        assert row["cost_usd"] == 0.0042 and row["output_tokens"] == 50
+        agent = next(a for a in doc["byAgent"] if a["agent"] == "dash-agent")
+        assert agent["cost_usd"] >= 0.0042
+        assert doc["usage"]["input_tokens"] >= 100
+
+    def test_quality_aggregates_pass_rate(self, stack):
+        _dash, dport, session_api, _sp = stack
+        session_api.store.ensure_session(SessionRecord(
+            session_id="q-sess", workspace="w1", agent="dash-agent"))
+        session_api.store.append_eval_result(EvalResultRecord(
+            session_id="q-sess", eval_name="tone", score=1.0, passed=True))
+        session_api.store.append_eval_result(EvalResultRecord(
+            session_id="q-sess", eval_name="tone", score=0.1, passed=False))
+        _s, doc = _get(dport, "/api/quality")
+        a = next(x for x in doc["agents"] if x["agent"] == "dash-agent")
+        assert a["total"] >= 2 and 0 < a["pass_rate"] < 1
+
+    def test_arena_and_sources_views(self, stack):
+        dash, dport, *_ = stack
+        dash.store.apply(Resource(kind="ArenaJob", name="dash-aj", spec={
+            "scenarios": [{"name": "s", "turns": [{"user": "hi"}]}],
+            "providers": ["mock-llm"]}))
+        dash.store.apply(Resource(kind="ArenaSource", name="dash-src", spec={
+            "source": {"type": "configmap", "data": {"f": "x"}}}))
+        _s, doc = _get(dport, "/api/arena")
+        assert any(j["name"] == "dash-aj" for j in doc["jobs"])
+        _s, doc = _get(dport, "/api/sources")
+        assert any(s["name"] == "dash-src" and s["kind"] == "ArenaSource"
+                   for s in doc["sources"])
+
+    def test_topology_graph_nodes_and_edges(self, stack):
+        _dash, dport, *_ = stack
+        _s, doc = _get(dport, "/api/topology")
+        ids = {n["id"] for n in doc["nodes"]}
+        assert "AgentRuntime/default/dash-agent" in ids
+        assert "Provider/default/mock-llm" in ids
+        # agent → provider and agent → pack reference edges exist
+        edges = {(e["from"], e["to"], e["label"]) for e in doc["edges"]}
+        assert ("AgentRuntime/default/dash-agent",
+                "Provider/default/mock-llm", "provider") in edges
+        assert ("AgentRuntime/default/dash-agent",
+                "PromptPack/default/dash-pack", "pack") in edges
+
+    def test_memories_proxy(self, stack):
+        from omnia_tpu.memory import HashingEmbedder, MemoryAPI
+
+        mem_api = MemoryAPI(embedder=HashingEmbedder(dim=8))
+        mport = mem_api.serve(host="127.0.0.1", port=0)
+        dash2 = DashboardServer(
+            stack[0].store, memory_api_url=f"http://127.0.0.1:{mport}")
+        dport2 = dash2.serve(host="127.0.0.1", port=0)
+        try:
+            mem_api.handle("POST", "/api/v1/memories", {
+                "workspace_id": "wm", "content": "console fact"})
+            _s, doc = _get(dport2, "/api/memories?workspace=wm")
+            assert any("console fact" in m["content"] for m in doc["memories"])
+        finally:
+            dash2.shutdown()
+            mem_api.close()
+
+    def test_crd_crud_passthrough(self, stack):
+        """Settings view semantics: mutations are token-gated (an open
+        write surface + open CORS would be drive-by cluster mutation);
+        with the token, POST applies through admission (bad manifests
+        400) and DELETE removes (reference crd-operations.ts)."""
+        dash2 = DashboardServer(stack[0].store, write_token="w-tok")
+        dport = dash2.serve(host="127.0.0.1", port=0)
+        auth = {"Authorization": "Bearer w-tok",
+                "Content-Type": "application/json"}
+        manifest = {
+            "apiVersion": "omnia.tpu/v1alpha1", "kind": "Provider",
+            "metadata": {"name": "ui-prov", "namespace": "default"},
+            "spec": {"type": "mock", "role": "llm", "options": {}},
+        }
+        try:
+            # No/wrong token → 401; never applied.
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{dport}/api/resources",
+                data=json.dumps(manifest).encode(), method="POST",
+                headers={"Content-Type": "application/json"})
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(req, timeout=10)
+            assert ei.value.code == 401
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{dport}/api/resources",
+                data=json.dumps(manifest).encode(), method="POST",
+                headers=auth)
+            with urllib.request.urlopen(req, timeout=10) as r:
+                assert r.status == 200
+            _s, doc = _get(dport, "/api/resources?kind=Provider")
+            assert any(r["metadata"]["name"] == "ui-prov"
+                       for r in doc["resources"])
+            # admission rejects invalid specs
+            bad = dict(manifest, spec={"type": "carrier-pigeon"})
+            bad["metadata"] = {"name": "bad-prov"}
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{dport}/api/resources",
+                data=json.dumps(bad).encode(), method="POST", headers=auth)
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(req, timeout=10)
+            assert ei.value.code == 400
+            # delete
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{dport}/api/resources?kind=Provider"
+                "&name=ui-prov&namespace=default", method="DELETE",
+                headers=auth)
+            with urllib.request.urlopen(req, timeout=10) as r:
+                assert r.status == 200
+            _s, doc = _get(dport, "/api/resources?kind=Provider")
+            assert not any(r["metadata"]["name"] == "ui-prov"
+                           for r in doc["resources"])
+        finally:
+            dash2.shutdown()
+
+    def test_writes_disabled_without_token_config(self, stack):
+        """No write token configured → mutations are 403 regardless of
+        headers (never silently open)."""
+        _dash, dport, *_ = stack
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{dport}/api/resources",
+            data=b"{}", method="POST",
+            headers={"Content-Type": "application/json",
+                     "Authorization": "Bearer anything"})
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(req, timeout=10)
+        assert ei.value.code == 403
+
+
+class TestSpaDom:
+    """DOM-level checks on the served page: every route family has a nav
+    entry + view section, and the JS actually drives the APIs."""
+
+    def test_views_and_api_bindings(self, stack):
+        _dash, dport, *_ = stack
+        with urllib.request.urlopen(f"http://127.0.0.1:{dport}/", timeout=10) as r:
+            html = r.read().decode()
+        from html.parser import HTMLParser
+
+        ids, navs = set(), set()
+
+        class P(HTMLParser):
+            def handle_starttag(self, tag, attrs):
+                d = dict(attrs)
+                if d.get("id"):
+                    ids.add(d["id"])
+                if tag == "button" and d.get("data-view"):
+                    navs.add(d["data-view"])
+
+        P().feed(html)
+        expected_views = {"agents", "console", "sessions", "costs", "quality",
+                          "arena", "providers", "packs", "tools", "workspaces",
+                          "memories", "topology", "settings"}
+        assert expected_views <= navs, expected_views - navs
+        for v in expected_views:
+            assert f"view-{v}" in ids, f"missing section view-{v}"
+        for endpoint in ("/api/agents", "/api/costs", "/api/quality",
+                         "/api/arena", "/api/providers", "/api/packs",
+                         "/api/tools", "/api/workspaces", "/api/memories",
+                         "/api/topology", "/api/resources", "/api/sources"):
+            assert endpoint in html, f"SPA never calls {endpoint}"
