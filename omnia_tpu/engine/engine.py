@@ -767,6 +767,10 @@ class InferenceEngine:
                     handle._push(
                         StreamEvent(req.request_id, finish_reason=FinishReason.CANCELLED)
                     )
+                    # A queue-cancelled request is as finished as a slot-
+                    # cancelled one: every submit reaches exactly one
+                    # terminal event AND one finished count.
+                    self.metrics["requests_finished"] += 1
                 else:
                     still.append((req, handle))
             self._waiting = still

@@ -121,11 +121,11 @@ class DevSession:
         try:
             self.ws.send(json.dumps({"type": "hangup"}))
         except Exception:
-            pass
+            pass  # best-effort hangup
         try:
             self.ws.close()
         except Exception:
-            pass
+            pass  # best-effort close
 
 
 class DevConsole:

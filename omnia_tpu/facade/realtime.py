@@ -216,7 +216,7 @@ class DuplexSession:
         try:
             self.stream.close()
         except Exception:
-            pass
+            pass  # best-effort stream teardown
         self.ended.set()
 
 

@@ -143,7 +143,7 @@ class FacadeServer:
                 try:
                     ws.close(1013, "draining")
                 except Exception:
-                    pass
+                    pass  # peer may already be gone during drain
 
     @property
     def draining(self) -> bool:
@@ -605,7 +605,7 @@ class FacadeServer:
         try:
             self._send(ws, doc)
         except Exception:
-            pass
+            pass  # dead socket: the read loop notices and cleans up
 
     # ------------------------------------------------------------------
     # health / metrics endpoint

@@ -117,7 +117,7 @@ class ControllerManager:
                 try:
                     p.stop()
                 except Exception:
-                    pass
+                    pass  # best-effort pod teardown
         self.deployments.clear()
         self.workspaces.shutdown()
 
@@ -456,7 +456,18 @@ class ControllerManager:
         )
         if not self._license_gate(res, feature):
             return
-        self.store.update_status(res, {"phase": "Ready", "message": ""})
+        status = {"phase": "Ready", "message": ""}
+        if res.kind == ResourceKind.SESSION_PRIVACY_POLICY.value:
+            # Compliance presets expand server-side (reference
+            # ee/pkg/compliance/presets.go): consumers read the effective
+            # policy from status, never re-derive regime rules.
+            from omnia_tpu.privacy.compliance import expand_preset
+
+            try:
+                status["effective"] = expand_preset(res.spec)
+            except ValueError as e:
+                status = {"phase": "Error", "message": str(e)}
+        self.store.update_status(res, status)
 
     def reconcile_agent_runtime(self, res: Resource) -> None:
         key = res.key
@@ -630,11 +641,11 @@ class ControllerManager:
                 finally:
                     client.close()
             except Exception:
-                pass
+                pass  # scrape is advisory; autoscaler tolerates gaps
             try:
                 conns += int(pod.facade.metrics.gauge("connections_active").value())
             except Exception:
-                pass
+                pass  # in-process pod without facade metrics
         return depth, conns
 
     def _write_blocked(self, res: Resource, dep, msg: str) -> None:

@@ -235,10 +235,14 @@ def _tool_policy_schema() -> dict:
 
 def _session_privacy_policy_schema() -> dict:
     return _obj({
+        # Compliance preset expanded server-side (ee/pkg/compliance).
+        "preset": _str(enum=("gdpr", "hipaa", "ccpa")),
         "recording": _BOOL,
         "redactFields": _arr(_str()),
         "consentCategories": _arr(_str()),
         "retention": _obj(open_=True),
+        "userOptOut": _obj(open_=True),
+        "encryption": _obj(open_=True),
     })
 
 

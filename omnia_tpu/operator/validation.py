@@ -210,6 +210,12 @@ def _validate_tool_policy(spec: dict, errs: list[str]) -> None:
 
 
 def _validate_session_privacy_policy(spec: dict, errs: list[str]) -> None:
+    preset = spec.get("preset")
+    if preset is not None:
+        from omnia_tpu.privacy.compliance import PRESETS
+
+        if preset not in PRESETS:
+            errs.append(f"preset must be one of {PRESETS}, got {preset!r}")
     if "recording" in spec and not isinstance(spec["recording"], bool):
         errs.append("recording must be a bool")
     for field in ("redactFields", "consentCategories"):

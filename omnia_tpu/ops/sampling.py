@@ -21,6 +21,38 @@ import jax.numpy as jnp
 _NEG_INF = -1e30
 
 
+# Decode sampling is on the per-token critical path: a full-vocab sort
+# (O(V log² V) bitonic passes on TPU, V = 128k) per step can rival the
+# model forward once dispatch overhead is amortized. The threshold only
+# needs the DESCENDING PREFIX of the distribution, so the fast path uses
+# lax.top_k over this many entries and falls back to the exact full-sort
+# path (one lax.cond) whenever any row's answer could lie past the
+# prefix — semantics are bit-identical either way.
+_FAST_PREFIX_K = 256
+
+
+def _thresholds_from_prefix(prefix: jnp.ndarray, denom: jnp.ndarray,
+                            m: jnp.ndarray, top_p: jnp.ndarray,
+                            k: jnp.ndarray):
+    """Shared threshold math over a descending prefix of the scaled
+    logits. prefix: [B, K] descending; denom: [B] total survivor mass in
+    exp(x - m) units; m: [B] row max; k: [B] effective top-k (0 = off).
+    Returns [B, 1] threshold."""
+    K = prefix.shape[-1]
+    kth = jnp.take_along_axis(
+        prefix, jnp.clip(k - 1, 0, K - 1)[:, None], axis=-1
+    )
+    k_thresh = jnp.where((k > 0)[:, None], kth, _NEG_INF)
+
+    in_topk = jnp.arange(K)[None, :] < jnp.where(k > 0, k, K)[:, None]
+    e = jnp.where(in_topk, jnp.exp(prefix - m[:, None]), 0.0)
+    cum = jnp.cumsum(e, axis=-1)
+    # mass strictly before each entry < top_p * survivor mass
+    keep = in_topk & ((cum - e) < top_p[:, None] * denom[:, None])
+    p_thresh = jnp.min(jnp.where(keep, prefix, jnp.inf), axis=-1, keepdims=True)
+    return jnp.maximum(k_thresh, p_thresh)
+
+
 def _filter_thresholds(scaled: jnp.ndarray, top_p: jnp.ndarray, top_k: jnp.ndarray):
     """Per-row admission threshold combining top-k and top-p (nucleus).
 
@@ -33,26 +65,83 @@ def _filter_thresholds(scaled: jnp.ndarray, top_p: jnp.ndarray, top_k: jnp.ndarr
     top_k: [B] int32 (<= 0 disables). Returns [B, 1] threshold.
     """
     V = scaled.shape[-1]
-    sorted_desc = jnp.sort(scaled, axis=-1)[..., ::-1]
-
-    # top-k: the k-th largest scaled logit.
     k = jnp.clip(top_k, 0, V)
-    kth = jnp.take_along_axis(
-        sorted_desc, jnp.clip(k - 1, 0, V - 1)[:, None], axis=-1
+    K = min(_FAST_PREFIX_K, V)
+
+    # Descending prefix + survivor-mass denominators (no sort needed).
+    prefix, _idx = jax.lax.top_k(scaled, K)
+    m = prefix[:, 0]
+    e_prefix = jnp.exp(prefix - m[:, None])
+    cum_prefix = jnp.cumsum(e_prefix, axis=-1)
+    z_all = jnp.sum(jnp.exp(scaled - m[:, None]), axis=-1)
+    k_in_prefix = (k > 0) & (k <= K)
+    denom = jnp.where(
+        k_in_prefix,
+        jnp.take_along_axis(
+            cum_prefix, jnp.clip(k - 1, 0, K - 1)[:, None], axis=-1
+        )[:, 0],
+        z_all,
     )
-    k_thresh = jnp.where((k > 0)[:, None], kth, _NEG_INF)
+    # Rows with BOTH knobs off (the SamplingParams defaults) admit the
+    # whole vocabulary: no threshold to find, trivially fast-feasible —
+    # without this exemption one default-params request in the batch
+    # would force every decode step onto the full sort.
+    no_filter = (top_p >= 1.0) & (k <= 0)
 
-    # top-p over the top-k survivors: mask the sorted tail beyond k, then
-    # softmax renormalizes over what's left (sorted order makes the
-    # survivor set a prefix).
-    in_topk = jnp.arange(V)[None, :] < jnp.where(k > 0, k, V)[:, None]
-    survivors = jnp.where(in_topk, sorted_desc, _NEG_INF)
-    probs = jax.nn.softmax(survivors, axis=-1)
-    cum = jnp.cumsum(probs, axis=-1)
-    keep = in_topk & ((cum - probs) < top_p[:, None])  # mass strictly before < top_p
-    p_thresh = jnp.min(jnp.where(keep, sorted_desc, jnp.inf), axis=-1, keepdims=True)
+    def fast(_):
+        th = _thresholds_from_prefix(prefix, denom, m, top_p, k)
+        # A prefix-only computation would wrongly cut unfiltered rows at
+        # the K-th value; force their threshold open.
+        return jnp.where(no_filter[:, None], _NEG_INF, th)
 
-    return jnp.maximum(k_thresh, p_thresh)
+    def slow(_):
+        sorted_desc = jnp.sort(scaled, axis=-1)[..., ::-1]
+        # Survivor mass from the SAME sorted cumsum the keep-comparison
+        # uses (not z_all): a different summation order can differ by an
+        # ulp, which at top_p=1.0 would wrongly exclude the final
+        # element (cum - e < top_p*denom must hold for every survivor).
+        cum_full = jnp.cumsum(jnp.exp(sorted_desc - m[:, None]), axis=-1)
+        denom_full = jnp.where(
+            k > 0,
+            jnp.take_along_axis(
+                cum_full, jnp.clip(k - 1, 0, V - 1)[:, None], axis=-1
+            )[:, 0],
+            cum_full[:, -1],
+        )
+        return _thresholds_from_prefix(sorted_desc, denom_full, m, top_p, k)
+
+    if K == V:
+        # top_k(V) already IS the full sort; no fallback needed.
+        return fast(None)
+    # Fast path is exact iff every row is one of: unfiltered (exempt),
+    # top-k cutoff inside the prefix, or nucleus threshold inside it
+    # (prefix mass under the survivor distribution reaches top_p).
+    feasible = jnp.all(
+        no_filter
+        | (k_in_prefix  # survivors ⊂ prefix ⇒ threshold in prefix
+           | ((k <= 0) & (cum_prefix[:, -1] >= top_p * z_all)))
+    )
+    return jax.lax.cond(feasible, fast, slow, None)
+
+
+def fast_path_feasible(scaled, top_p, top_k) -> bool:
+    """Test/diagnostic hook: would _filter_thresholds take the prefix
+    fast path for this batch? Mirrors the feasibility predicate above."""
+    V = scaled.shape[-1]
+    K = min(_FAST_PREFIX_K, V)
+    if K == V:
+        return True
+    k = jnp.clip(jnp.asarray(top_k, jnp.int32), 0, V)
+    top_p = jnp.asarray(top_p, jnp.float32)
+    prefix, _ = jax.lax.top_k(jnp.asarray(scaled, jnp.float32), K)
+    m = prefix[:, 0]
+    cum_last = jnp.sum(jnp.exp(prefix - m[:, None]), axis=-1)
+    z_all = jnp.sum(jnp.exp(jnp.asarray(scaled, jnp.float32) - m[:, None]), axis=-1)
+    no_filter = (top_p >= 1.0) & (k <= 0)
+    k_in_prefix = (k > 0) & (k <= K)
+    return bool(jnp.all(
+        no_filter | (k_in_prefix | ((k <= 0) & (cum_last >= top_p * z_all)))
+    ))
 
 
 def _prepare(logits, temperature, top_p, top_k):

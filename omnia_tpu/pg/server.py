@@ -95,7 +95,7 @@ class PGServer:
                 try:
                     outer._serve(self.rfile, self.wfile, self.connection)
                 except Exception:
-                    pass
+                    pass  # client disconnect mid-query; connection is done either way
                 finally:
                     with outer._conns_lock:
                         outer._conns.discard(self.connection)

@@ -1,0 +1,71 @@
+"""Compliance presets: one name expands to a full SessionPrivacyPolicy.
+
+Reference ee/pkg/compliance/presets.go: `preset: gdpr|hipaa|ccpa` on a
+SessionPrivacyPolicy expands into the regime's recording/redaction/
+retention/opt-out/audit posture, so operators don't hand-assemble
+regulatory policy from primitives. Shapes here match the in-tree
+SessionPrivacyPolicy spec (operator/crds.py) and the Redactor's pattern
+vocabulary (privacy/redaction.py)."""
+
+from __future__ import annotations
+
+PRESETS = ("gdpr", "hipaa", "ccpa")
+
+# Redactor categories per regime (reference gdprPIIPatterns et al).
+_PII = {
+    "gdpr": ["email", "phone", "ipv4", "credit_card"],
+    "hipaa": ["email", "phone", "ssn", "credit_card", "ipv4"],
+    "ccpa": ["email", "phone", "ssn", "credit_card"],
+}
+
+# Retention windows in days (reference presets.go: GDPR warm 30/cold 90,
+# HIPAA 30/2555 — 7y records rule, CCPA 30/365) and audit retention.
+_RETENTION = {
+    "gdpr": {"warm_days": 30, "cold_days": 90, "audit_days": 365},
+    "hipaa": {"warm_days": 30, "cold_days": 2555, "audit_days": 2555},
+    "ccpa": {"warm_days": 30, "cold_days": 365, "audit_days": 730},
+}
+
+
+def list_presets() -> tuple[str, ...]:
+    return PRESETS
+
+
+def get_preset(name: str) -> dict:
+    """→ SessionPrivacyPolicy spec dict for the named regime. Raises
+    ValueError on an unknown preset (fail closed, never a default)."""
+    key = (name or "").lower()
+    if key not in PRESETS:
+        raise ValueError(f"unknown compliance preset {name!r}; have {PRESETS}")
+    r = _RETENTION[key]
+    spec = {
+        "recording": True,
+        "redactFields": list(_PII[key]),
+        "consentCategories": ["memory", "analytics"],
+        "retention": {
+            "warm_ttl_s": r["warm_days"] * 86400.0,
+            "cold_ttl_s": r["cold_days"] * 86400.0,
+            "audit_ttl_s": r["audit_days"] * 86400.0,
+        },
+        "userOptOut": {"enabled": True, "deleteWithinDays": 30},
+        "encryption": {"enabled": key == "hipaa"},
+        "preset": key,
+    }
+    return spec
+
+
+def expand_preset(spec: dict) -> dict:
+    """SessionPrivacyPolicy spec with `preset:` → fully expanded spec.
+    Explicit fields in the spec OVERRIDE the preset's (operator intent
+    wins); specs without a preset pass through unchanged."""
+    preset = spec.get("preset")
+    if not preset:
+        # Copy: callers store the result (e.g. status.effective) and an
+        # alias of the live spec would let status mutations bypass
+        # admission.
+        return dict(spec)
+    out = get_preset(preset)
+    for k, v in spec.items():
+        if k != "preset":
+            out[k] = v
+    return out

@@ -316,6 +316,7 @@ class TestExamples:
             ("examples/custom-runtime/devroot/agent.yaml", "agent"),
             ("examples/echo-function/function.yaml", "function"),
             ("examples/voice-agent/agent.yaml", "agent"),
+            ("examples/tool-agent/agent.yaml", "agent"),
         ):
             store = MemoryResourceStore()
             mgr = ControllerManager(store)  # before apply: watch fires
@@ -572,3 +573,56 @@ class TestEntryPointWiring:
         monkeypatch.delenv("OMNIA_RUNTIME_TARGET", raising=False)
         monkeypatch.delenv("OMNIA_SESSION_API_URL", raising=False)
         assert cli.doctor_main() in (0, 1)  # no checks configured → report
+
+    def test_conformance_main_one_shot(self):
+        """omnia-conformance (conformance_main) runs the suite against a
+        live runtime target and exits by verdict."""
+        import sys
+        from unittest import mock
+
+        from omnia_tpu import cli
+        from omnia_tpu.runtime.packs import load_pack
+        from omnia_tpu.runtime.providers import ProviderRegistry, ProviderSpec
+        from omnia_tpu.runtime.server import RuntimeServer
+
+        reg = ProviderRegistry()
+        reg.register(ProviderSpec(name="m", type="mock", options={
+            "scenarios": [{"pattern": ".", "reply": "conformant"}]}))
+        rt = RuntimeServer(
+            pack=load_pack({"name": "p", "version": "1.0.0",
+                            "prompts": {"system": "s"},
+                            "sampling": {"max_tokens": 16}}),
+            providers=reg, provider_name="m")
+        port = rt.serve("localhost:0")
+        try:
+            with mock.patch.object(sys, "argv",
+                                   ["omnia-conformance", f"localhost:{port}"]):
+                assert cli.conformance_main() == 0
+        finally:
+            rt.shutdown()
+
+    def test_lsp_main_stdio_wiring(self, tmp_path, monkeypatch):
+        """omnia-pack-lsp (lsp_main) speaks LSP over stdio: initialize →
+        respond → exit cleanly."""
+        import io
+        import sys
+
+        from omnia_tpu import lsp as lsp_mod
+
+        body = b""
+        for doc in (
+            {"jsonrpc": "2.0", "id": 1, "method": "initialize", "params": {}},
+            {"jsonrpc": "2.0", "method": "exit"},
+        ):
+            payload = json.dumps(doc).encode()
+            body += b"Content-Length: %d\r\n\r\n%s" % (len(payload), payload)
+
+        stdin = io.BytesIO(body)
+        stdout = io.BytesIO()
+        monkeypatch.setattr(lsp_mod.sys, "stdin",
+                            type("S", (), {"buffer": stdin})())
+        monkeypatch.setattr(lsp_mod.sys, "stdout",
+                            type("S", (), {"buffer": stdout})())
+        assert lsp_mod.lsp_main() == 0
+        out = stdout.getvalue()
+        assert b"capabilities" in out

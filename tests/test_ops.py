@@ -170,3 +170,82 @@ def test_top_k_top_p_sequential_semantics():
         top_k=jnp.full(64, 2, dtype=jnp.int32),
     )
     assert set(np.asarray(toks).tolist()) == {0}
+
+
+def test_fast_prefix_threshold_matches_full_sort():
+    """The top_k-prefix fast path must be semantics-identical to the
+    full-sort path across regimes: peaked rows (fast path engages), flat
+    rows (nucleus past the prefix → fallback), and top_k beyond the
+    prefix (fallback)."""
+    import numpy as np
+
+    from omnia_tpu.ops import sampling as S
+
+    rng = np.random.default_rng(0)
+    V = 4096  # > _FAST_PREFIX_K so the prefix is a strict subset
+
+    def full_sort_reference(scaled, top_p, top_k):
+        # Full-sort formulation: smallest descending prefix of the top-k
+        # survivors whose mass reaches top_p * survivor mass.
+        scaled = jnp.asarray(scaled, jnp.float32)
+        sorted_desc = jnp.sort(scaled, axis=-1)[..., ::-1]
+        k = jnp.clip(jnp.asarray(top_k, jnp.int32), 0, V)
+        kth = jnp.take_along_axis(
+            sorted_desc, jnp.clip(k - 1, 0, V - 1)[:, None], axis=-1)
+        k_thresh = jnp.where((k > 0)[:, None], kth, -1e30)
+        in_topk = jnp.arange(V)[None, :] < jnp.where(k > 0, k, V)[:, None]
+        m = sorted_desc[:, :1]
+        e = jnp.where(in_topk, jnp.exp(sorted_desc - m), 0.0)
+        cum = jnp.cumsum(e, axis=-1)
+        denom = jnp.where(
+            k > 0,
+            jnp.take_along_axis(
+                cum, jnp.clip(k - 1, 0, V - 1)[:, None], axis=-1)[:, 0],
+            cum[:, -1],
+        )
+        keep = in_topk & (
+            (cum - e) < jnp.asarray(top_p)[:, None] * denom[:, None])
+        p_thresh = jnp.min(
+            jnp.where(keep, sorted_desc, jnp.inf), axis=-1, keepdims=True)
+        # Disabled knobs (top_p>=1, k=0) mean NO filtering: express that
+        # as an open threshold rather than the row minimum — at f32 the
+        # cumsum boundary is ulp-noisy there, and "admit everything" is
+        # the defined semantics.
+        no_filter = (jnp.asarray(top_p) >= 1.0) & (k <= 0)
+        p_thresh = jnp.where(no_filter[:, None], -1e30, p_thresh)
+        return jnp.maximum(k_thresh, p_thresh)
+
+    cases = [
+        # peaked logits, typical serving knobs (incl. a default-params
+        # row: top_p=1/k=0 is exempt, not a fallback trigger) → FAST
+        (rng.normal(0, 4, (4, V)), [0.9, 0.95, 0.5, 1.0], [0, 40, 8, 0], True),
+        # near-flat logits: top-256 mass << top_p → full-sort fallback
+        (rng.normal(0, 0.01, (3, V)), [0.99, 0.9, 0.999], [0, 0, 0], False),
+        # top_k beyond the prefix → fallback
+        (rng.normal(0, 2, (2, V)), [0.9, 1.0], [1000, 2000], False),
+        # mixed batch: one row would be fast, one forces fallback
+        (rng.normal(0, 2, (2, V)) * np.array([[4.0], [0.01]]),
+         [0.9, 0.99], [0, 0], False),
+        # all-defaults batch (the common serving case) must be FAST
+        (rng.normal(0, 2, (4, V)), [1.0] * 4, [0] * 4, True),
+    ]
+    fast_seen = slow_seen = False
+    for logits, top_p, top_k, want_fast in cases:
+        # Guard the guard: assert each case exercises the intended branch.
+        assert S.fast_path_feasible(logits, top_p, top_k) is want_fast, (
+            "case no longer hits its intended path", top_p, top_k)
+        fast_seen |= want_fast
+        slow_seen |= not want_fast
+        scaled = jnp.asarray(logits, jnp.float32)
+        got = S._filter_thresholds(
+            scaled,
+            jnp.asarray(top_p, jnp.float32),
+            jnp.asarray(top_k, jnp.int32),
+        )
+        want = full_sort_reference(logits, np.asarray(top_p, np.float32), top_k)
+        # Compare ADMITTED SETS, not raw thresholds: an unfiltered row's
+        # threshold may be -inf on one path and the row minimum on the
+        # other — same admitted vocabulary either way.
+        np.testing.assert_array_equal(
+            np.asarray(scaled >= got), np.asarray(scaled >= want))
+    assert fast_seen and slow_seen

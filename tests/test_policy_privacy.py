@@ -341,3 +341,53 @@ class TestKeyRotation:
         key = kms.current_key_id()
         ctrl.reconcile()
         assert kms.current_key_id() == key  # young key stays
+
+
+class TestCompliancePresets:
+    """Compliance presets (reference ee/pkg/compliance/presets.go): one
+    name expands server-side into the regime's full privacy posture."""
+
+    def test_presets_expand_with_regime_rules(self):
+        from omnia_tpu.privacy.compliance import get_preset, list_presets
+
+        assert set(list_presets()) == {"gdpr", "hipaa", "ccpa"}
+        hipaa = get_preset("hipaa")
+        assert "ssn" in hipaa["redactFields"]
+        assert hipaa["retention"]["cold_ttl_s"] == 2555 * 86400.0  # 7y rule
+        assert hipaa["encryption"]["enabled"] is True
+        gdpr = get_preset("gdpr")
+        assert gdpr["retention"]["cold_ttl_s"] == 90 * 86400.0
+        assert gdpr["userOptOut"]["deleteWithinDays"] == 30
+        with pytest.raises(ValueError):
+            get_preset("sox")
+
+    def test_explicit_fields_override_preset(self):
+        from omnia_tpu.privacy.compliance import expand_preset
+
+        spec = expand_preset({"preset": "gdpr", "recording": False})
+        assert spec["recording"] is False          # operator intent wins
+        assert spec["redactFields"]                # regime rules retained
+        assert expand_preset({"recording": True}) == {"recording": True}
+
+    def test_policy_reconcile_writes_effective_spec(self):
+        from omnia_tpu.operator.controller import ControllerManager
+        from omnia_tpu.operator.resources import Resource
+        from omnia_tpu.operator.store import MemoryResourceStore
+        from omnia_tpu.operator.validation import ValidationError
+
+        store = MemoryResourceStore()
+        mgr = ControllerManager(store)
+        try:
+            store.apply(Resource(kind="SessionPrivacyPolicy", name="p",
+                                 spec={"preset": "hipaa"}))
+            mgr.drain_queue()
+            res = store.get("default", "SessionPrivacyPolicy", "p")
+            assert res.status["phase"] == "Ready"
+            eff = res.status["effective"]
+            assert "ssn" in eff["redactFields"]
+            # unknown preset rejected at admission
+            with pytest.raises(ValidationError):
+                store.apply(Resource(kind="SessionPrivacyPolicy", name="bad",
+                                     spec={"preset": "sox"}))
+        finally:
+            mgr.shutdown()
