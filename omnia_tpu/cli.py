@@ -171,7 +171,13 @@ def runtime_main() -> int:
     """OMNIA_PACK_PATH (compiled pack JSON, mounted), OMNIA_PROVIDERS_PATH
     (provider spec list JSON), OMNIA_PROVIDER (default provider name),
     OMNIA_TOOLS_PATH (optional tool handlers), OMNIA_GRPC_PORT,
-    OMNIA_REDIS_ADDR (context store; in-memory without it)."""
+    OMNIA_REDIS_ADDR (context store; in-memory without it),
+    OMNIA_COORDINATOR_ADDR/_NUM_PROCESSES/_PROCESS_ID (multi-host engine:
+    join the jax.distributed runtime before any backend init so TP meshes
+    span pods)."""
+    from omnia_tpu.parallel.distributed import maybe_initialize_distributed
+
+    dist = maybe_initialize_distributed()
     from omnia_tpu.runtime.packs import load_pack
     from omnia_tpu.runtime.providers import ProviderRegistry, ProviderSpec
     from omnia_tpu.runtime.server import RuntimeServer
@@ -201,6 +207,24 @@ def runtime_main() -> int:
             executor = ToolExecutor(
                 [ToolHandler(**h) for h in json.load(f)]
             )
+
+    if dist is not None and dist["num_processes"] > 1:
+        # Multi-host engine: every process builds the same replica over
+        # the GLOBAL mesh and runs identical host control flow; only the
+        # leader serves gRPC (engine/multihost.py). The headless-service
+        # topology routes clients to the leader pod (deployment builder).
+        from omnia_tpu.engine.multihost import LockstepEngine
+
+        lock = LockstepEngine(registry.engine(provider_name))
+        registry._engines[provider_name] = lock
+        if not lock.is_leader:
+            lock.warmup()
+            logger.info(
+                "multi-host follower %d/%d replicating the leader's steps",
+                dist["process_id"], dist["num_processes"],
+            )
+            lock.run_follower()
+            return 0
 
     server = RuntimeServer(
         pack=pack, providers=registry, provider_name=provider_name,

@@ -124,13 +124,13 @@ class _SessionKV:
 
     __slots__ = ("session_id", "token_ids", "slot", "host_k", "host_v", "last_used")
 
-    def __init__(self, session_id: str):
+    def __init__(self, session_id: str, now: Optional[float] = None):
         self.session_id = session_id
         self.token_ids: list[int] = []
         self.slot: Optional[int] = None
         self.host_k: Optional[np.ndarray] = None  # [L, R, H, D] padded rows
         self.host_v: Optional[np.ndarray] = None
-        self.last_used = time.monotonic()
+        self.last_used = time.monotonic() if now is None else now
 
 
 class InferenceEngine:
@@ -220,6 +220,11 @@ class InferenceEngine:
         self._thread: Optional[threading.Thread] = None
         self._stop_event = threading.Event()
         self._healthy = True
+        # Session-LRU clock. Injectable so replicated engines (multi-host
+        # lockstep, engine/multihost.py) share a LOGICAL clock: eviction
+        # order must be identical on every process or their compiled-step
+        # streams diverge and the cross-host collectives deadlock.
+        self.clock = time.monotonic
 
         # Metrics (engine-level; exported via utils.metrics by the runtime).
         self.metrics = {
@@ -812,10 +817,10 @@ class InferenceEngine:
             sess = self._sessions.get(request.session_id)
             if sess is None:
                 sess = self._sessions[request.session_id] = _SessionKV(
-                    request.session_id
+                    request.session_id, now=self.clock()
                 )
                 self._enforce_session_cap()
-            sess.last_used = time.monotonic()
+            sess.last_used = self.clock()
             # Longest common prefix with the cached rows, capped at n-1 so
             # there is always ≥1 suffix token to produce the next logits.
             limit = min(len(sess.token_ids), n - 1)
@@ -1097,7 +1102,7 @@ class InferenceEngine:
         sess = self._sessions.get(sid) if sid else None
         if sess is not None and reason is not FinishReason.ERROR:
             sess.token_ids = list(slot.request.prompt_tokens) + slot.emitted[:-1]
-            sess.last_used = time.monotonic()
+            sess.last_used = self.clock()
             # Idle-pinned slots keep decoding garbage at this frozen row —
             # parking it at the valid-row frontier keeps the invariant that
             # garbage only ever lives at rows ≥ the session's length.

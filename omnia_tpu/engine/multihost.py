@@ -1,0 +1,261 @@
+"""Lockstep multi-host serving: one engine replicated across processes.
+
+SURVEY §7 hard part ("the engine spans pods; the facade's single-backend
+assumption must be preserved"): when the model's mesh covers devices on
+N processes (jax.distributed, parallel/distributed.py), every compiled
+step is a cross-host collective — ALL processes must dispatch the SAME
+program sequence or the DCN collectives deadlock. The design here is the
+standard JAX one: run IDENTICAL host control flow everywhere and make
+its inputs identical.
+
+- Every process builds the same InferenceEngine over the global mesh.
+- Process 0 (the leader) owns the public surface: gRPC serves there,
+  submits/cancels/releases land in an event queue.
+- Each tick, the leader broadcasts (logical_time, events) to all
+  processes; everyone applies the events to their local engine replica
+  and runs engine.step(). The engine's scheduling is deterministic given
+  the event stream — the injected logical clock removes the one
+  wall-time dependency (session LRU eviction).
+- Followers' handles stream into the void (their token queues die with
+  the slot); only the leader's handles have readers.
+
+The broadcast costs one small collective per tick — microseconds on
+ICI/DCN next to a decode chunk's model step, and it replaces any
+NCCL/MPI-style sideband the reference never had (SURVEY §2.13).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+import time
+from typing import Optional
+
+import numpy as np
+
+from omnia_tpu.engine.types import (
+    FinishReason,
+    RequestHandle,
+    SamplingParams,
+    StreamEvent,
+)
+
+logger = logging.getLogger(__name__)
+
+_BUF_BYTES = 64 * 1024  # fixed broadcast frame (collectives need one shape)
+_HDR = 4
+
+
+class LockstepEngine:
+    """Engine-shaped facade driving replicated engines in lockstep.
+
+    Leader: duck-types InferenceEngine for the runtime layer (submit /
+    queue_depth / active_slots / healthy / warmup / start / stop /
+    release_session / metrics). Followers: construct and call
+    run_follower() — it never returns until stop().
+    """
+
+    def __init__(self, engine, tick_idle_s: float = 0.002):
+        import jax
+
+        self.engine = engine
+        self.process_index = jax.process_index()
+        self.process_count = jax.process_count()
+        self.is_leader = self.process_index == 0
+        self.tick_idle_s = tick_idle_s
+        self._logical_time = 0.0
+        engine.clock = lambda: self._logical_time
+        self._pending: list[dict] = []
+        self._handles: dict[str, RequestHandle] = {}
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.metrics = engine.metrics  # shared view
+
+    # -- leader public surface (engine duck type) -----------------------
+
+    def submit(self, prompt_tokens, params: SamplingParams = SamplingParams(),
+               session_id: Optional[str] = None) -> RequestHandle:
+        assert self.is_leader, "submit() is leader-only; followers replicate"
+        handle = _LeaderHandle(self)
+        with self._lock:
+            self._pending.append({
+                "op": "submit",
+                "prompt": list(prompt_tokens),
+                "params": {
+                    "temperature": params.temperature,
+                    "top_p": params.top_p,
+                    "top_k": params.top_k,
+                    "max_tokens": params.max_tokens,
+                    "stop_token_ids": list(params.stop_token_ids),
+                    "seed": params.seed,
+                },
+                "session_id": session_id,
+                "tag": id(handle),
+            })
+            self._tagged = getattr(self, "_tagged", {})
+            self._tagged[id(handle)] = handle
+        return handle
+
+    def release_session(self, session_id: str) -> None:
+        with self._lock:
+            self._pending.append({"op": "release", "session_id": session_id})
+
+    def _enqueue_cancel(self, rid: str) -> None:
+        with self._lock:
+            self._pending.append({"op": "cancel", "rid": rid})
+
+    def queue_depth(self) -> int:
+        with self._lock:
+            pending = sum(1 for e in self._pending if e["op"] == "submit")
+        return self.engine.queue_depth() + pending
+
+    def active_slots(self) -> int:
+        return self.engine.active_slots()
+
+    def healthy(self) -> bool:
+        return self.engine.healthy()
+
+    def warmup(self, sessions: bool = True) -> None:
+        # Collective: every process calls warmup() with the same config
+        # before its loop starts, dispatching the same compile sequence.
+        self.engine.warmup(sessions=sessions)
+
+    def generate(self, prompt_tokens, params: SamplingParams = SamplingParams()):
+        """Synchronous helper (function-mode Invoke path): the lockstep
+        loop drives the steps, so blocking on the handle is safe."""
+        handle = self.submit(prompt_tokens, params)
+        return handle.collect_tokens(timeout=600)
+
+    # -- lifecycle ------------------------------------------------------
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._loop, name="omnia-lockstep", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=30)
+            self._thread = None
+
+    def run_follower(self) -> None:
+        """Follower processes block here, replicating the leader's step
+        stream until the leader broadcasts shutdown."""
+        assert not self.is_leader
+        self._loop()
+
+    # -- the lockstep loop ----------------------------------------------
+
+    def _broadcast(self, payload: bytes) -> bytes:
+        from jax.experimental import multihost_utils
+
+        if len(payload) > _BUF_BYTES - _HDR:
+            raise ValueError(
+                f"tick payload {len(payload)}B exceeds frame {_BUF_BYTES}"
+            )
+        buf = np.zeros(_BUF_BYTES, np.uint8)
+        if self.is_leader:
+            buf[:_HDR] = np.frombuffer(
+                len(payload).to_bytes(_HDR, "big"), np.uint8
+            )
+            buf[_HDR:_HDR + len(payload)] = np.frombuffer(payload, np.uint8)
+        out = np.asarray(multihost_utils.broadcast_one_to_all(buf))
+        n = int.from_bytes(out[:_HDR].tobytes(), "big")
+        return out[_HDR:_HDR + n].tobytes()
+
+    def _drain_pending(self, budget: int = 64) -> list[dict]:
+        with self._lock:
+            take, self._pending = self._pending[:budget], self._pending[budget:]
+        return take
+
+    def _loop(self) -> None:
+        while True:
+            if self.is_leader:
+                events = self._drain_pending()
+                doc = {
+                    "t": time.monotonic(),
+                    "stop": self._stop.is_set(),
+                    "events": events,
+                }
+                payload = json.dumps(doc).encode()
+            else:
+                payload = b""
+            doc = json.loads(self._broadcast(payload).decode())
+            self._logical_time = float(doc["t"])
+            for ev in doc["events"]:
+                self._apply(ev)
+            if doc["stop"]:
+                return
+            did = self.engine.step()
+            if not did and not doc["events"]:
+                time.sleep(self.tick_idle_s)
+
+    def _apply(self, ev: dict) -> None:
+        op = ev["op"]
+        if op == "submit":
+            p = ev["params"]
+            sp = SamplingParams(
+                temperature=p["temperature"], top_p=p["top_p"],
+                top_k=p["top_k"], max_tokens=p["max_tokens"],
+                stop_token_ids=tuple(p["stop_token_ids"]),
+                seed=p["seed"],
+            )
+            real = self.engine.submit(ev["prompt"], sp,
+                                      session_id=ev["session_id"])
+            self._handles[real.request_id] = real
+            if self.is_leader:
+                wrapper = self._tagged.pop(ev["tag"], None)
+                if wrapper is not None:
+                    wrapper._bind(real)
+        elif op == "cancel":
+            real = self._handles.get(ev["rid"])
+            if real is not None:
+                real.cancel()
+        elif op == "release":
+            self.engine.release_session(ev["session_id"])
+        # Finished handles are dropped lazily to bound the map.
+        if len(self._handles) > 4096:
+            self._handles = dict(list(self._handles.items())[-2048:])
+
+
+class _LeaderHandle(RequestHandle):
+    """Handle returned before the submit event has been broadcast: events
+    forward from the engine's real handle once the tick binds it; cancel
+    is an event so every process applies it at the same step."""
+
+    def __init__(self, owner: LockstepEngine):
+        super().__init__("pending")
+        self._owner = owner
+        self._real: Optional[RequestHandle] = None
+        self._bound = threading.Event()
+
+    def _bind(self, real: RequestHandle) -> None:
+        self.request_id = real.request_id
+        self._real = real
+        # Forward the real handle's stream into this one's queue.
+        def pump():
+            for ev in real.events(timeout=None):
+                self._push(ev)
+                if ev.is_final:
+                    return
+        threading.Thread(target=pump, daemon=True).start()
+        self._bound.set()
+
+    def cancel(self) -> None:
+        super().cancel()
+        if self._real is not None:
+            self._owner._enqueue_cancel(self._real.request_id)
+        else:
+            # Not broadcast yet: cancel-before-bind still needs to reach
+            # every process AFTER the submit does; poll-bind in a thread.
+            def late():
+                if self._bound.wait(timeout=30) and self._real is not None:
+                    self._owner._enqueue_cancel(self._real.request_id)
+            threading.Thread(target=late, daemon=True).start()

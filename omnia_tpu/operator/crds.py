@@ -103,6 +103,9 @@ def _agent_runtime_schema() -> dict:
             "facadeImage": _str(),
             "runtimeImage": _str(),
             "tpuChips": _INT,
+            # Multi-host engine: pods per model replica (StatefulSet +
+            # jax.distributed; parallel/distributed.py env contract).
+            "tpuHosts": _INT,
             "podOverrides": _obj(open_=True),
         },
         required=["promptPackRef", "providers"],

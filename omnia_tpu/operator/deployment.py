@@ -321,9 +321,48 @@ class K8sManifestBackend:
             },
         }
         out = {"deployment": deployment, "service": service}
+        hosts = int(spec.get("tpuHosts", 1))
+        if hosts > 1:
+            # Multi-host engine (one pjit program spanning pods): the
+            # runtime replicas become a StatefulSet so each pod gets a
+            # stable ordinal (= jax process_id, inferred from the
+            # hostname by parallel/distributed.py), a headless service
+            # names process 0 as the coordinator, and the engine's mesh
+            # covers hosts × chips global devices.
+            coord = (
+                f"agent-{dep.name}-0.agent-{dep.name}-hosts."
+                f"{dep.namespace}.svc:8476"
+            )
+            for c in pod_spec["containers"]:
+                if c["name"] == "runtime":
+                    c["env"] = env + [
+                        {"name": "OMNIA_COORDINATOR_ADDR", "value": coord},
+                        {"name": "OMNIA_NUM_PROCESSES", "value": str(hosts)},
+                    ]
+            deployment["kind"] = "StatefulSet"
+            deployment["spec"]["serviceName"] = f"agent-{dep.name}-hosts"
+            deployment["spec"]["replicas"] = hosts
+            # Only the LEADER (ordinal 0) serves clients — followers
+            # replicate its step stream (engine/multihost.py) and run no
+            # facade surface. Route the client Service to pod-0 alone via
+            # the per-pod StatefulSet label.
+            service["spec"]["selector"] = {
+                "statefulset.kubernetes.io/pod-name": f"agent-{dep.name}-0",
+            }
+            out["headless_service"] = {
+                "apiVersion": "v1",
+                "kind": "Service",
+                "metadata": {"name": f"agent-{dep.name}-hosts",
+                             "namespace": dep.namespace},
+                "spec": {
+                    "clusterIP": "None",
+                    "selector": {"omnia/agent": dep.name},
+                    "ports": [{"name": "coordinator", "port": 8476}],
+                },
+            }
         scaler = self.render_autoscaling(dep)
-        if scaler is not None:
-            out["autoscaling"] = scaler
+        if scaler is not None and hosts <= 1:
+            out["autoscaling"] = scaler  # HPA cannot scale a multi-host set
         return out
 
     @staticmethod

@@ -74,6 +74,20 @@ def _validate_agent_runtime(spec: dict, errs: list[str]) -> None:
             w = s.get("weight") if isinstance(s, dict) else None
             if not isinstance(w, (int, float)) or not (0 <= w <= 100):
                 errs.append("rollout step weight must be in [0, 100]")
+    hosts = spec.get("tpuHosts", 1)
+    if not isinstance(hosts, int) or isinstance(hosts, bool) or hosts < 1:
+        errs.append(f"tpuHosts must be an integer >= 1, got {hosts!r}")
+    elif hosts > 1:
+        # One multi-host set IS one model instance: a replica count or an
+        # autoscaler on top would silently be discarded by the renderer —
+        # reject instead (scale multi-host models with more AgentRuntimes
+        # or a fleet coordinator, not HPA).
+        if spec.get("replicas", 1) != 1:
+            errs.append("tpuHosts > 1 requires replicas == 1 "
+                        "(the StatefulSet's replicas are HOSTS of one model)")
+        if spec.get("autoscaling"):
+            errs.append("tpuHosts > 1 cannot be autoscaled (HPA would "
+                        "resize the host set, not add model replicas)")
 
 
 def _validate_provider(spec: dict, errs: list[str]) -> None:

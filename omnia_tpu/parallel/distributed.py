@@ -1,0 +1,75 @@
+"""Multi-host initialization: one engine spanning pods over DCN.
+
+SURVEY §5.8: intra-slice parallelism rides ICI implicitly inside pjit
+programs; CROSS-HOST (multi-pod v5e slices, 70B TP) requires every
+process to join one JAX distributed runtime before backend init —
+after which `jax.devices()` is the GLOBAL device set and the engine's
+mesh/`shard_map` programs span hosts with XLA managing DCN collectives.
+The reference has no analog (its NCCL/MPI row is empty — SURVEY §2.13);
+this is the TPU-native backend that replaces it.
+
+Env contract (stamped by the deployment builder for multi-host pods,
+mirroring how GKE JobSet/indexed Jobs expose rank):
+
+  OMNIA_COORDINATOR_ADDR  host:port of process 0
+  OMNIA_NUM_PROCESSES     world size
+  OMNIA_PROCESS_ID        this pod's rank (defaults to the trailing
+                          integer of the pod hostname, the StatefulSet/
+                          indexed-Job convention)
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import re
+import threading
+from typing import Optional
+
+logger = logging.getLogger(__name__)
+
+_lock = threading.Lock()
+_initialized: Optional[dict] = None
+
+
+def _infer_process_id(env) -> Optional[int]:
+    explicit = env.get("OMNIA_PROCESS_ID")
+    if explicit is not None:
+        return int(explicit)
+    # StatefulSet / indexed-Job pods end in their ordinal: agent-7b-3.
+    m = re.search(r"-(\d+)$", env.get("HOSTNAME", ""))
+    return int(m.group(1)) if m else None
+
+
+def maybe_initialize_distributed(env=None) -> Optional[dict]:
+    """Join the multi-host runtime iff OMNIA_COORDINATOR_ADDR is set.
+    Idempotent; must run BEFORE anything creates a JAX backend. Returns
+    {"num_processes", "process_id"} when distributed, None for the
+    single-host path (the common case — no env, no effect)."""
+    global _initialized
+    env = env if env is not None else os.environ
+    addr = env.get("OMNIA_COORDINATOR_ADDR")
+    if not addr:
+        return None
+    with _lock:
+        if _initialized is not None:
+            return _initialized
+        num = int(env.get("OMNIA_NUM_PROCESSES", "1"))
+        pid = _infer_process_id(env)
+        if pid is None:
+            raise RuntimeError(
+                "OMNIA_COORDINATOR_ADDR set but no OMNIA_PROCESS_ID and the "
+                "hostname carries no trailing ordinal"
+            )
+        import jax
+
+        jax.distributed.initialize(
+            coordinator_address=addr, num_processes=num, process_id=pid
+        )
+        _initialized = {"num_processes": num, "process_id": pid}
+        logger.info(
+            "joined distributed runtime: process %d/%d via %s "
+            "(%d global devices)",
+            pid, num, addr, jax.device_count(),
+        )
+        return _initialized

@@ -56,16 +56,27 @@ def get_preset(name: str) -> dict:
 
 def expand_preset(spec: dict) -> dict:
     """SessionPrivacyPolicy spec with `preset:` → fully expanded spec.
-    Explicit fields in the spec OVERRIDE the preset's (operator intent
-    wins); specs without a preset pass through unchanged."""
+    Explicit fields in the spec OVERRIDE the preset's, merged DEEP for
+    dict values — tuning `retention.warm_ttl_s` must not silently drop
+    the regime's cold/audit windows (the 7-year HIPAA rule riding along
+    unmentioned is the point of a preset). Specs without a preset pass
+    through (deep-)copied: callers store the result (status.effective),
+    and any aliasing of the live spec would let status mutations bypass
+    admission."""
+    import copy
+
     preset = spec.get("preset")
     if not preset:
-        # Copy: callers store the result (e.g. status.effective) and an
-        # alias of the live spec would let status mutations bypass
-        # admission.
-        return dict(spec)
-    out = get_preset(preset)
-    for k, v in spec.items():
-        if k != "preset":
-            out[k] = v
-    return out
+        return copy.deepcopy(spec)
+
+    def merge(base: dict, over: dict) -> dict:
+        out = dict(base)
+        for k, v in over.items():
+            if isinstance(v, dict) and isinstance(out.get(k), dict):
+                out[k] = merge(out[k], v)
+            else:
+                out[k] = copy.deepcopy(v)
+        return out
+
+    return merge(get_preset(preset),
+                 {k: v for k, v in spec.items() if k != "preset"})

@@ -167,6 +167,63 @@ class TestAgentPodManifests:
         assert runtime["resources"]["limits"]["google.com/tpu"] == 8
 
 
+class TestMultiHostManifests:
+    def test_tpu_hosts_renders_statefulset_with_coordinator(self):
+        """spec.tpuHosts > 1 → StatefulSet with stable ordinals (= jax
+        process ids), headless coordinator service, and the distributed
+        env contract on the runtime container (SURVEY §5.8 DCN path)."""
+        from omnia_tpu.operator.deployment import AgentDeployment, K8sManifestBackend
+        from omnia_tpu.operator.resources import Resource
+
+        res = Resource(
+            kind="AgentRuntime", name="llama70b", namespace="prod",
+            spec={
+                "promptPackRef": {"name": "pack"},
+                "providers": [{"providerRef": {"name": "tpu-llm"}}],
+                "tpuChips": 4, "tpuHosts": 4,
+            },
+        )
+        dep = AgentDeployment(
+            res, pack_doc={"name": "pack", "version": "1.0.0"},
+            provider_specs=[{"name": "tpu-llm", "type": "tpu"}],
+            default_provider="tpu-llm",
+        )
+        rendered = K8sManifestBackend().render(dep)
+        sts = rendered["deployment"]
+        assert sts["kind"] == "StatefulSet"
+        assert sts["spec"]["replicas"] == 4
+        assert sts["spec"]["serviceName"] == "agent-llama70b-hosts"
+        runtime = next(c for c in sts["spec"]["template"]["spec"]["containers"]
+                       if c["name"] == "runtime")
+        env = {e["name"]: e["value"] for e in runtime["env"]}
+        assert env["OMNIA_NUM_PROCESSES"] == "4"
+        assert env["OMNIA_COORDINATOR_ADDR"] == (
+            "agent-llama70b-0.agent-llama70b-hosts.prod.svc:8476")
+        headless = rendered["headless_service"]
+        assert headless["spec"]["clusterIP"] == "None"
+        # Clients route to the LEADER pod only; followers have no facade.
+        assert rendered["service"]["spec"]["selector"] == {
+            "statefulset.kubernetes.io/pod-name": "agent-llama70b-0"}
+        # autoscaling must not target a multi-host set
+        assert "autoscaling" not in rendered
+
+    def test_multi_host_rejects_replicas_and_autoscaling(self):
+        from omnia_tpu.operator.resources import Resource
+        from omnia_tpu.operator.validation import ValidationError, validate
+
+        base = {
+            "promptPackRef": {"name": "p"},
+            "providers": [{"providerRef": {"name": "m"}}],
+            "tpuHosts": 4,
+        }
+        with pytest.raises(ValidationError, match="replicas"):
+            validate(Resource(kind="AgentRuntime", name="a",
+                              spec={**base, "replicas": 3}))
+        with pytest.raises(ValidationError, match="autoscaled"):
+            validate(Resource(kind="AgentRuntime", name="a",
+                              spec={**base, "autoscaling": {"maxReplicas": 4}}))
+
+
 class TestDockerfiles:
     SERVICES = ("runtime", "facade", "session-api", "memory-api", "operator",
                 "redisd")

@@ -368,6 +368,17 @@ class TestCompliancePresets:
         assert spec["recording"] is False          # operator intent wins
         assert spec["redactFields"]                # regime rules retained
         assert expand_preset({"recording": True}) == {"recording": True}
+        # Deep merge: tuning one retention knob must not drop the
+        # regime's other windows (the 7y HIPAA cold rule rides along).
+        spec = expand_preset({"preset": "hipaa",
+                              "retention": {"warm_ttl_s": 86400.0}})
+        assert spec["retention"]["warm_ttl_s"] == 86400.0
+        assert spec["retention"]["cold_ttl_s"] == 2555 * 86400.0
+        # No aliasing: expanding a preset-less spec deep-copies it.
+        raw = {"recording": True, "retention": {"warm_ttl_s": 1.0}}
+        out = expand_preset(raw)
+        out["retention"]["warm_ttl_s"] = 99.0
+        assert raw["retention"]["warm_ttl_s"] == 1.0
 
     def test_policy_reconcile_writes_effective_spec(self):
         from omnia_tpu.operator.controller import ControllerManager
