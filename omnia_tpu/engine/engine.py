@@ -288,12 +288,34 @@ class InferenceEngine:
     def _build_programs(self):
         cfg = self.model_cfg
 
-        def prefill(params, tokens, positions):
-            return llama.forward_prefill(params, cfg, tokens, positions)
+        # Fused fresh-prefill: forward + cache insert + first-token sample
+        # in ONE dispatch. TTFT pays per-dispatch round trips (tens of ms
+        # each on a remote-device link), so folding the old
+        # prefill→insert pair into one program halves the prefill RTT
+        # bill; math is identical (same ops, same PRNG flow).
+        def prefill_insert(params, ck, cv, tokens, positions, slot, last_idx,
+                           key_data, temp, top_p, top_k):
+            logits, k_chunk, v_chunk = llama.forward_prefill(
+                params, cfg, tokens, positions
+            )
 
-        # One compiled prefill per bucket length (lazily compiled; warmup()
-        # forces all). Shapes: tokens [1, T].
-        self._prefill_fn = jax.jit(prefill)
+            def put(c, chunk):
+                # c: [L,B,S,H,D]; chunk: [L,1,T,H,D]
+                return jax.lax.dynamic_update_slice(
+                    c, chunk.astype(c.dtype), (0, slot, 0, 0, 0)
+                )
+
+            ck = put(ck, k_chunk)
+            cv = put(cv, v_chunk)
+            last = jax.lax.dynamic_slice(
+                logits, (0, last_idx, 0), (1, 1, logits.shape[-1])
+            )[:, 0]
+            tok, new_kd = sample_tokens_per_slot(
+                last, key_data[None], temp[None], top_p[None], top_k[None]
+            )
+            return ck, cv, tok[0], new_kd[0]
+
+        self._prefill_insert_fn = jax.jit(prefill_insert, donate_argnums=(1, 2))
 
         # Long-context prefill (sp > 1): ring attention splits the O(T²)
         # attention of buckets ≥ long_prefill_threshold across the sp axis.
@@ -472,16 +494,21 @@ class InferenceEngine:
             toks = jnp.zeros((1, b), jnp.int32)
             pos = jnp.arange(b, dtype=jnp.int32)[None, :]
             if b in self.cfg.usable_buckets():
-                logits, k_chunk, v_chunk = self._prefill_fn(self.params, toks, pos)
-                self._ck, self._cv, _, self._key_data = self._run_insert(
-                    k_chunk, v_chunk, 0, logits[:, -1]
+                self._ck, self._cv, _, _ = self._prefill_insert_fn(
+                    self.params, self._ck, self._cv, toks, pos, zero,
+                    jnp.int32(b - 1), *sargs
                 )
                 if (
                     self._prefill_ring_fn is not None
                     and b >= self.cfg.long_prefill_threshold
                     and b % self.cfg.sp == 0
                 ):
-                    self._prefill_ring_fn(self.params, toks, pos)
+                    logits, k_chunk, v_chunk = self._prefill_ring_fn(
+                        self.params, toks, pos
+                    )
+                    self._ck, self._cv, _, self._key_data = self._run_insert(
+                        k_chunk, v_chunk, 0, logits[:, -1]
+                    )
             if b in extend_shapes:
                 self._ck, self._cv = self._extend_nosample_fn(
                     self.params, self._ck, self._cv, toks, pos, zero, zero
@@ -493,6 +520,22 @@ class InferenceEngine:
             for r in self.cfg.restore_buckets():
                 k, v = self._offload_fn(self._ck, self._cv, zero, r)
                 self._ck, self._cv = self._restore_fn(self._ck, self._cv, k, v, zero)
+        # Placement bookkeeping runs a handful of tiny scatter programs
+        # (at[slot].set on tokens/positions/active/budget/stop_ids/keys);
+        # un-warmed, each costs a first-request compile round trip —
+        # directly inflating the FIRST measured TTFT. Touch them all.
+        self._tokens = self._tokens.at[0].set(jnp.int32(0))
+        self._positions = self._positions.at[0].set(jnp.int32(0))
+        self._active = self._active.at[0].set(True)
+        self._temp = self._temp.at[0].set(jnp.float32(0.0))
+        self._top_p = self._top_p.at[0].set(jnp.float32(1.0))
+        self._top_k = self._top_k.at[0].set(jnp.int32(0))
+        self._budget = self._budget.at[0].set(1)
+        self._stop_ids = self._stop_ids.at[0].set(
+            jnp.asarray([-1] * MAX_DEVICE_STOP_IDS, jnp.int32)
+        )
+        self._key_data = self._key_data.at[0].set(kd)
+        jax.block_until_ready(self._key_data)
         # Restore everything warmup wrote (cache contents, PRNG streams,
         # positions, metrics) so warmup cannot perturb request sampling.
         self._init_device_state()
@@ -568,6 +611,15 @@ class InferenceEngine:
 
     def active_slots(self) -> int:
         return sum(1 for s in self._slots if s.active)
+
+    def live_request_ids(self) -> set:
+        """Request ids still queued or decoding (multihost handle-map
+        hygiene: live handles must never be evicted)."""
+        with self._lock:
+            waiting = {req.request_id for req, _h in self._waiting}
+        return waiting | {
+            s.request.request_id for s in self._slots if s.active
+        }
 
     # ------------------------------------------------------------------
     # Engine loop
@@ -890,19 +942,29 @@ class InferenceEngine:
         # excludes them — and decode overwrites each pad row before it first
         # becomes attendable.
         pos = np.arange(bucket, dtype=np.int32)[None, :]
-        prefill = self._prefill_fn
         if (
             self._prefill_ring_fn is not None
             and bucket >= self.cfg.long_prefill_threshold
             and bucket % self.cfg.sp == 0
         ):
-            prefill = self._prefill_ring_fn
-        logits, k_chunk, v_chunk = prefill(
-            self.params, jnp.asarray(toks), jnp.asarray(pos)
+            # Ring path: the sp-sharded prefill stays its own program;
+            # its KV chunk gathers into the slot via the insert step.
+            logits, k_chunk, v_chunk = self._prefill_ring_fn(
+                self.params, jnp.asarray(toks), jnp.asarray(pos)
+            )
+            self._ck, self._cv, first_tok, self._key_data = self._run_insert(
+                k_chunk, v_chunk, slot_idx, logits[:, n - 1], sp
+            )
+            return first_tok
+        kd = self._sampling_key(slot_idx, sp)
+        self._ck, self._cv, first_tok, new_kd = self._prefill_insert_fn(
+            self.params, self._ck, self._cv,
+            jnp.asarray(toks), jnp.asarray(pos),
+            jnp.int32(slot_idx), jnp.int32(n - 1), kd,
+            jnp.float32(sp.temperature), jnp.float32(sp.top_p),
+            jnp.int32(sp.top_k),
         )
-        self._ck, self._cv, first_tok, self._key_data = self._run_insert(
-            k_chunk, v_chunk, slot_idx, logits[:, n - 1], sp
-        )
+        self._key_data = self._key_data.at[slot_idx].set(new_kd)
         return first_tok
 
     def _extend_pieces(self, start: int, count: int) -> list[tuple[int, int, int]]:

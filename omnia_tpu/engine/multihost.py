@@ -43,8 +43,13 @@ from omnia_tpu.engine.types import (
 
 logger = logging.getLogger(__name__)
 
-_BUF_BYTES = 64 * 1024  # fixed broadcast frame (collectives need one shape)
-_HDR = 4
+# Two-phase tick broadcast: a tiny fixed header every tick (idle ticks
+# cost 16 bytes, not a padded frame), then an exact-size payload
+# broadcast only when events exist (collectives need one shape per call;
+# the header tells every rank the payload's).
+_HDR_BYTES = 16
+_MAX_PAYLOAD = 1 << 20          # hard cap: one tick's event JSON
+_DRAIN_BUDGET = 48 * 1024       # soft per-tick size; remainder waits
 
 
 class LockstepEngine:
@@ -79,21 +84,30 @@ class LockstepEngine:
                session_id: Optional[str] = None) -> RequestHandle:
         assert self.is_leader, "submit() is leader-only; followers replicate"
         handle = _LeaderHandle(self)
+        event = {
+            "op": "submit",
+            "prompt": list(prompt_tokens),
+            "params": {
+                "temperature": params.temperature,
+                "top_p": params.top_p,
+                "top_k": params.top_k,
+                "max_tokens": params.max_tokens,
+                "stop_token_ids": list(params.stop_token_ids),
+                "seed": params.seed,
+            },
+            "session_id": session_id,
+            "tag": id(handle),
+        }
+        if len(json.dumps(event)) > _MAX_PAYLOAD - 256:
+            # An event that can never fit a tick must fail HONESTLY at
+            # submit — queuing it would stall the stream forever.
+            handle._push(StreamEvent(
+                "req-oversize", finish_reason=FinishReason.ERROR,
+                error=f"prompt too large to replicate (> {_MAX_PAYLOAD} B tick)",
+            ))
+            return handle
         with self._lock:
-            self._pending.append({
-                "op": "submit",
-                "prompt": list(prompt_tokens),
-                "params": {
-                    "temperature": params.temperature,
-                    "top_p": params.top_p,
-                    "top_k": params.top_k,
-                    "max_tokens": params.max_tokens,
-                    "stop_token_ids": list(params.stop_token_ids),
-                    "seed": params.seed,
-                },
-                "session_id": session_id,
-                "tag": id(handle),
-            })
+            self._pending.append(event)
             self._tagged = getattr(self, "_tagged", {})
             self._tagged[id(handle)] = handle
         return handle
@@ -153,49 +167,82 @@ class LockstepEngine:
 
     # -- the lockstep loop ----------------------------------------------
 
-    def _broadcast(self, payload: bytes) -> bytes:
+    def _broadcast_tick(self, payload: bytes, stop: bool, t: float) -> tuple:
+        """Header (16B: length, stop, clock) every tick; exact-size payload
+        broadcast only when events exist. Returns (payload, stop, t) as
+        seen by every rank."""
         from jax.experimental import multihost_utils
 
-        if len(payload) > _BUF_BYTES - _HDR:
-            raise ValueError(
-                f"tick payload {len(payload)}B exceeds frame {_BUF_BYTES}"
-            )
-        buf = np.zeros(_BUF_BYTES, np.uint8)
+        hdr = np.zeros(_HDR_BYTES, np.uint8)
         if self.is_leader:
-            buf[:_HDR] = np.frombuffer(
-                len(payload).to_bytes(_HDR, "big"), np.uint8
+            hdr[:4] = np.frombuffer(len(payload).to_bytes(4, "big"), np.uint8)
+            hdr[4] = 1 if stop else 0
+            hdr[5:13] = np.frombuffer(
+                np.float64(t).tobytes(), np.uint8
             )
-            buf[_HDR:_HDR + len(payload)] = np.frombuffer(payload, np.uint8)
-        out = np.asarray(multihost_utils.broadcast_one_to_all(buf))
-        n = int.from_bytes(out[:_HDR].tobytes(), "big")
-        return out[_HDR:_HDR + n].tobytes()
+        out = np.asarray(multihost_utils.broadcast_one_to_all(hdr))
+        n = int.from_bytes(out[:4].tobytes(), "big")
+        stop_f = bool(out[4])
+        t_f = float(np.frombuffer(out[5:13].tobytes(), np.float64)[0])
+        if n == 0:
+            return b"", stop_f, t_f
+        buf = np.zeros(n, np.uint8)
+        if self.is_leader:
+            buf[:] = np.frombuffer(payload, np.uint8)
+        data = np.asarray(multihost_utils.broadcast_one_to_all(buf))
+        return data.tobytes(), stop_f, t_f
 
-    def _drain_pending(self, budget: int = 64) -> list[dict]:
+    def _drain_pending(self) -> list[dict]:
+        """Take events up to the per-tick SIZE budget (a count budget
+        would let a few long prompts overflow the frame); the remainder
+        waits for the next tick, order preserved."""
+        take: list[dict] = []
+        size = 2
         with self._lock:
-            take, self._pending = self._pending[:budget], self._pending[budget:]
+            while self._pending:
+                ev_len = len(json.dumps(self._pending[0])) + 1
+                if take and size + ev_len > _DRAIN_BUDGET:
+                    break
+                size += ev_len
+                take.append(self._pending.pop(0))
         return take
 
     def _loop(self) -> None:
+        idle_ticks = 0
         while True:
             if self.is_leader:
                 events = self._drain_pending()
-                doc = {
-                    "t": time.monotonic(),
-                    "stop": self._stop.is_set(),
-                    "events": events,
-                }
-                payload = json.dumps(doc).encode()
+                payload = json.dumps(events).encode() if events else b""
+                stop, t = self._stop.is_set(), time.monotonic()
             else:
-                payload = b""
-            doc = json.loads(self._broadcast(payload).decode())
-            self._logical_time = float(doc["t"])
-            for ev in doc["events"]:
+                payload, stop, t = b"", False, 0.0
+            payload, stop, t = self._broadcast_tick(payload, stop, t)
+            self._logical_time = t
+            events = json.loads(payload.decode()) if payload else []
+            for ev in events:
                 self._apply(ev)
-            if doc["stop"]:
+            if stop:
                 return
-            did = self.engine.step()
-            if not did and not doc["events"]:
-                time.sleep(self.tick_idle_s)
+            try:
+                did = self.engine.step()
+            except Exception:
+                # step() re-raises placement failures by design (the
+                # request's ERROR is already pushed); recovery reallocates
+                # device state — deterministic, so every rank recovers
+                # identically and the stream stays aligned. The loop must
+                # survive: a dead lockstep thread deadlocks every rank.
+                logger.exception("lockstep step failed; recovering")
+                self.engine._recover("lockstep step failed")
+                did = True
+            if not did and not events:
+                # Deterministic shared backoff: every rank computes the
+                # same sleep from the same (did, events) history, so ticks
+                # stay aligned while an idle engine stops burning a
+                # broadcast every 2 ms.
+                idle_ticks = min(idle_ticks + 1, 5)
+                time.sleep(self.tick_idle_s * (2 ** idle_ticks))
+            else:
+                idle_ticks = 0
 
     def _apply(self, ev: dict) -> None:
         op = ev["op"]
@@ -220,9 +267,14 @@ class LockstepEngine:
                 real.cancel()
         elif op == "release":
             self.engine.release_session(ev["session_id"])
-        # Finished handles are dropped lazily to bound the map.
+        # Bound the map WITHOUT evicting live requests: a trimmed live
+        # handle would turn its future cancel event into a silent no-op
+        # on every rank. Liveness comes from the engine's own books.
         if len(self._handles) > 4096:
-            self._handles = dict(list(self._handles.items())[-2048:])
+            live = self.engine.live_request_ids()
+            keep_live = {r: h for r, h in self._handles.items() if r in live}
+            rest = [(r, h) for r, h in self._handles.items() if r not in live]
+            self._handles = dict(rest[-1024:]) | keep_live
 
 
 class _LeaderHandle(RequestHandle):

@@ -262,8 +262,9 @@ def test_prefill_failure_reaches_handle(engine):
     """A prefill exception must deliver an ERROR final to that request's
     handle (it has no slot yet, so recovery's fail_all can't see it)."""
     sp = SamplingParams(temperature=0.0, max_tokens=2)
-    orig = engine._prefill_fn
-    engine._prefill_fn = lambda *a, **k: (_ for _ in ()).throw(RuntimeError("boom"))
+    orig = engine._prefill_insert_fn
+    engine._prefill_insert_fn = (
+        lambda *a, **k: (_ for _ in ()).throw(RuntimeError("boom")))
     try:
         h = engine.submit([1, 2], sp)
         with pytest.raises(RuntimeError):
@@ -272,7 +273,7 @@ def test_prefill_failure_reaches_handle(engine):
         assert ev.finish_reason == FinishReason.ERROR
         assert "prefill" in ev.error
     finally:
-        engine._prefill_fn = orig
+        engine._prefill_insert_fn = orig
         engine._recover("test cleanup")
     toks, fin = engine.generate([1, 2], sp)
     assert len(toks) == 2
