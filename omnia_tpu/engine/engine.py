@@ -524,12 +524,17 @@ class InferenceEngine:
         # (at[slot].set on tokens/positions/active/budget/stop_ids/keys);
         # un-warmed, each costs a first-request compile round trip —
         # directly inflating the FIRST measured TTFT. Touch them all.
+        # Scalar types must MATCH the request path exactly (weak-typed
+        # Python scalars for positions/temp/top_p/top_k/budget, a strong
+        # device int32 for tokens) — jit caches key on weak_type, so a
+        # jnp.int32 here would warm a different program than the one
+        # placement dispatches.
         self._tokens = self._tokens.at[0].set(jnp.int32(0))
-        self._positions = self._positions.at[0].set(jnp.int32(0))
+        self._positions = self._positions.at[0].set(0)
         self._active = self._active.at[0].set(True)
-        self._temp = self._temp.at[0].set(jnp.float32(0.0))
-        self._top_p = self._top_p.at[0].set(jnp.float32(1.0))
-        self._top_k = self._top_k.at[0].set(jnp.int32(0))
+        self._temp = self._temp.at[0].set(0.0)
+        self._top_p = self._top_p.at[0].set(1.0)
+        self._top_k = self._top_k.at[0].set(0)
         self._budget = self._budget.at[0].set(1)
         self._stop_ids = self._stop_ids.at[0].set(
             jnp.asarray([-1] * MAX_DEVICE_STOP_IDS, jnp.int32)

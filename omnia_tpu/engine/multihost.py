@@ -71,7 +71,13 @@ class LockstepEngine:
         self.tick_idle_s = tick_idle_s
         self._logical_time = 0.0
         engine.clock = lambda: self._logical_time
-        self._pending: list[dict] = []
+        # Pre-serialized event frames (bytes) — one json.dumps per event
+        # at enqueue time; the tick joins them into the payload without
+        # re-serializing, and a deque keeps the drain O(1) per event.
+        import collections as _collections
+
+        self._pending: "_collections.deque[bytes]" = _collections.deque()
+        self._pending_submits = 0
         self._handles: dict[str, RequestHandle] = {}
         self._lock = threading.Lock()
         self._stop = threading.Event()
@@ -98,7 +104,8 @@ class LockstepEngine:
             "session_id": session_id,
             "tag": id(handle),
         }
-        if len(json.dumps(event)) > _MAX_PAYLOAD - 256:
+        raw = json.dumps(event).encode()
+        if len(raw) > _MAX_PAYLOAD - 256:
             # An event that can never fit a tick must fail HONESTLY at
             # submit — queuing it would stall the stream forever.
             handle._push(StreamEvent(
@@ -107,22 +114,27 @@ class LockstepEngine:
             ))
             return handle
         with self._lock:
-            self._pending.append(event)
+            self._pending.append(raw)
+            self._pending_submits += 1
             self._tagged = getattr(self, "_tagged", {})
             self._tagged[id(handle)] = handle
         return handle
 
     def release_session(self, session_id: str) -> None:
         with self._lock:
-            self._pending.append({"op": "release", "session_id": session_id})
+            self._pending.append(
+                json.dumps({"op": "release", "session_id": session_id}).encode()
+            )
 
     def _enqueue_cancel(self, rid: str) -> None:
         with self._lock:
-            self._pending.append({"op": "cancel", "rid": rid})
+            self._pending.append(
+                json.dumps({"op": "cancel", "rid": rid}).encode()
+            )
 
     def queue_depth(self) -> int:
         with self._lock:
-            pending = sum(1 for e in self._pending if e["op"] == "submit")
+            pending = self._pending_submits
         return self.engine.queue_depth() + pending
 
     def active_slots(self) -> int:
@@ -192,27 +204,30 @@ class LockstepEngine:
         data = np.asarray(multihost_utils.broadcast_one_to_all(buf))
         return data.tobytes(), stop_f, t_f
 
-    def _drain_pending(self) -> list[dict]:
-        """Take events up to the per-tick SIZE budget (a count budget
-        would let a few long prompts overflow the frame); the remainder
-        waits for the next tick, order preserved."""
-        take: list[dict] = []
+    def _drain_pending(self) -> list[bytes]:
+        """Take pre-serialized events up to the per-tick SIZE budget (a
+        count budget would let a few long prompts overflow the frame);
+        the remainder waits for the next tick, order preserved."""
+        take: list[bytes] = []
         size = 2
         with self._lock:
             while self._pending:
-                ev_len = len(json.dumps(self._pending[0])) + 1
+                ev_len = len(self._pending[0]) + 1
                 if take and size + ev_len > _DRAIN_BUDGET:
                     break
                 size += ev_len
-                take.append(self._pending.pop(0))
+                raw = self._pending.popleft()
+                if raw.startswith(b'{"op": "submit"'):
+                    self._pending_submits -= 1
+                take.append(raw)
         return take
 
     def _loop(self) -> None:
         idle_ticks = 0
         while True:
             if self.is_leader:
-                events = self._drain_pending()
-                payload = json.dumps(events).encode() if events else b""
+                raws = self._drain_pending()
+                payload = (b"[" + b",".join(raws) + b"]") if raws else b""
                 stop, t = self._stop.is_set(), time.monotonic()
             else:
                 payload, stop, t = b"", False, 0.0

@@ -76,6 +76,7 @@ class ControllerManager:
             ResourceKind.PROVIDER.value,
             ResourceKind.PROMPT_PACK.value,
             ResourceKind.TOOL_REGISTRY.value,
+            ResourceKind.SKILL_SOURCE.value,
         ):
             # Cross-resource fan-in: requeue every AgentRuntime that might
             # reference this (reference agentruntime_watches.go).
@@ -169,6 +170,7 @@ class ControllerManager:
             (ResourceKind.PROMPT_PACK_SOURCE.value, self.reconcile_prompt_pack_source),
             (ResourceKind.ARENA_SOURCE.value, self.reconcile_arena_source),
             (ResourceKind.ARENA_TEMPLATE_SOURCE.value, self.reconcile_arena_source),
+            (ResourceKind.SKILL_SOURCE.value, self.reconcile_skill_source),
         ):
             for src in self.store.list(kind):
                 synced = float(src.status.get("syncedAt") or 0.0)
@@ -209,6 +211,8 @@ class ControllerManager:
             self.reconcile_tool_policies(res)
         elif kind == ResourceKind.WORKSPACE.value:
             self.reconcile_workspace(res)
+        elif kind == ResourceKind.SKILL_SOURCE.value:
+            self.reconcile_skill_source(res)
         elif kind == ResourceKind.PROMPT_PACK_SOURCE.value:
             self.reconcile_prompt_pack_source(res)
         elif kind in (
@@ -372,6 +376,79 @@ class ControllerManager:
             "packVersion": content.get("version", ""),
             "syncedAt": time.time(),
         })
+
+    def reconcile_skill_source(self, res: Resource) -> None:
+        """Skill bundle sync (reference skillsource_controller.go): skill
+        content lands in the shared sync root; packs that declare
+        `skills: [name]` get it merged into their system prompt at
+        resolution (_merge_pack_skills — the promptpack_skills.go analog).
+        Core kind: no license gate."""
+        source = dict(res.spec.get("source") or {})
+        if source.get("type") == "dir":
+            source["type"] = "local"  # SkillSource vocabulary → syncer's
+        try:
+            version = self._syncer().sync(self._source_key(res), source)
+        except Exception as e:  # noqa: BLE001 - status, not crash
+            self.store.update_status(res, {"phase": "Error", "message": str(e)})
+            return
+        changed = res.status.get("version") != version
+        self.store.update_status(res, {
+            "phase": "Ready", "version": version, "syncedAt": time.time(),
+        })
+        if changed:
+            # Status writes fire no watch events: fan the new skill
+            # content out to the agents serving it ourselves (a skill
+            # push must restart/re-resolve its consumers the way a pack
+            # push does — the reference's version-trigger discipline).
+            for ar in self.store.list(
+                ResourceKind.AGENT_RUNTIME.value, res.namespace
+            ):
+                self._queue.put((ar.namespace, ar.kind, ar.name))
+
+    def _merge_pack_skills(self, ns: str, content: dict):
+        """Pack content with `skills: [names]` → content whose system
+        prompt carries each SkillSource's synced markdown (reference
+        promptpack_skills.go merge). Returns (content, error)."""
+        skills = content.get("skills") or []
+        if not skills:
+            return content, None
+        import os as _os
+
+        blocks = []
+        for sname in skills:
+            src = self.store.get(ns, ResourceKind.SKILL_SOURCE.value, sname)
+            if src is None:
+                return content, f"skill source {sname!r} not found"
+            if src.status.get("phase") != "Ready":
+                self.reconcile_skill_source(src)
+                src = self.store.get(ns, ResourceKind.SKILL_SOURCE.value, sname)
+                if src.status.get("phase") != "Ready":
+                    return content, (
+                        f"skill source {sname!r}: {src.status.get('message')}"
+                    )
+            head = self._syncer().head_dir(self._source_key(src))
+            if head is None:
+                # Ready status but no synced content on THIS sync root
+                # (pruned PVC / fresh temp dir): os.listdir(None) would
+                # read the process cwd into the prompt — fail instead.
+                return content, (
+                    f"skill source {sname!r} has no synced content here; "
+                    "re-sync pending"
+                )
+            texts = []
+            for fn in sorted(_os.listdir(head)):
+                if fn.endswith(".md"):
+                    with open(_os.path.join(head, fn)) as f:
+                        texts.append(f.read().strip())
+            if not texts:
+                return content, f"skill source {sname!r} has no .md content"
+            blocks.append(f"[SKILL {sname}]\n" + "\n".join(texts) + "\n[/SKILL]")
+        out = dict(content)
+        out["prompts"] = dict(content.get("prompts") or {})
+        out["prompts"]["system"] = (
+            out["prompts"].get("system", "") + "\n" + "\n".join(blocks)
+        ).strip()
+        return out, None
 
     def reconcile_arena_source(self, res: Resource) -> None:
         """Arena scenario/template content sync (reference
@@ -574,7 +651,11 @@ class ControllerManager:
             return None
         if treg is not None:
             tool_configs = treg.spec.get("tools", [])
-        return pack.spec["content"], provider_specs, default_provider, tool_configs
+        content, skill_err = self._merge_pack_skills(ns, pack.spec["content"])
+        if skill_err is not None:
+            self._write_ref_error(res, skill_err)
+            return None
+        return content, provider_specs, default_provider, tool_configs
 
     def _required_capabilities(self, res: Resource, tool_configs: list[dict]) -> list[str]:
         from omnia_tpu.runtime.contract import Capability as C

@@ -45,6 +45,8 @@ class ConformanceSuite:
             ("history_resume", self.check_history_resume),
             ("invoke_validation", self.check_invoke_validation),
             ("identity_pinning", self.check_identity_pinning),
+            ("duplex_gating", self.check_duplex_gating),
+            ("media_fail_closed", self.check_media_fail_closed),
         ]
         results = []
         for name, fn in all_checks:
@@ -175,6 +177,68 @@ class ConformanceSuite:
             if msgs and msgs[-1].type == "error":
                 return None  # rejected foreign identity — conformant
             return "session accepted a different identity (no pinning)"
+        finally:
+            client.close()
+
+
+    def check_duplex_gating(self) -> Optional[str]:
+        """Capability honesty both ways (reference runtime.proto:350-354):
+        a runtime WITHOUT duplex_audio must reject duplex_start with a
+        capability error; one WITH it must answer duplex_ready or a typed
+        format error — never silently accept or hang."""
+        from omnia_tpu.runtime import contract as c
+
+        client = self._client()
+        try:
+            caps = client.health().capabilities
+            stream = client.open_stream(f"conf-dx-{uuid.uuid4().hex[:8]}")
+            stream.send(c.ClientMessage(
+                type="duplex_start",
+                audio_format={"encoding": "pcm16", "sample_rate_hz": 16000},
+            ))
+            msg = next(iter(stream))
+            stream.close()
+            if "duplex_audio" in caps:
+                if msg.type == "duplex_ready":
+                    return None
+                if msg.type == "error" and msg.error_code == "unsupported_audio_format":
+                    return None
+                return f"advertised duplex answered {msg.type}/{msg.error_code}"
+            if msg.type == "error" and msg.error_code == "capability_unsupported":
+                return None
+            return (
+                f"no duplex_audio capability but duplex_start got "
+                f"{msg.type}/{msg.error_code} instead of capability_unsupported"
+            )
+        finally:
+            client.close()
+
+    def check_media_fail_closed(self) -> Optional[str]:
+        """A message naming an unresolvable storage_ref must fail the turn
+        with a typed media error — an attachment-blind answer would
+        silently drop user content."""
+        from omnia_tpu.runtime import contract as c
+
+        client = self._client()
+        try:
+            stream = client.open_stream(f"conf-md-{uuid.uuid4().hex[:8]}")
+            stream.send(c.ClientMessage(
+                content=self.probe_text,
+                parts=[{"type": "media",
+                        "storage_ref": "media://conf/" + "0" * 32,
+                        "content_type": "text/plain"}],
+            ))
+            final = None
+            for msg in stream:
+                final = msg
+                if msg.type in ("done", "error"):
+                    break
+            stream.close()
+            if final is not None and final.type == "error" \
+                    and final.error_code == "media_unresolvable":
+                return None
+            got = f"{final.type}/{final.error_code}" if final else "nothing"
+            return f"dangling storage_ref answered {got}, not media_unresolvable"
         finally:
             client.close()
 

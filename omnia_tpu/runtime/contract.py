@@ -50,6 +50,7 @@ class Capability(str, enum.Enum):
     MEMORY = "memory"              # memory retrieval/injection
     RESPONSE_FORMAT = "response_format"  # json / json_schema constrained output
     DUPLEX_AUDIO = "duplex_audio"  # bidirectional voice (not yet served)
+    MEDIA = "media"                # storage_ref multimodal parts resolve
 
 
 class ResumeState(str, enum.Enum):

@@ -29,6 +29,9 @@ PACK_SCHEMA: dict = {
             "pattern": r"^\d+\.\d+\.\d+$",
         },
         "description": {"type": "string"},
+        # SkillSource names whose synced markdown merges into the system
+        # prompt at pack resolution (reference promptpack_skills.go).
+        "skills": {"type": "array", "items": {"type": "string", "minLength": 1}},
         "prompts": {
             "type": "object",
             "required": ["system"],

@@ -93,6 +93,10 @@ class RuntimeServer:
         self.speech = speech  # duplex.SpeechSupport (None = no voice)
         if speech is not None and c.Capability.DUPLEX_AUDIO.value not in self.capabilities:
             self.capabilities.append(c.Capability.DUPLEX_AUDIO.value)
+        if media_store is not None and c.Capability.MEDIA.value not in self.capabilities:
+            # Honest advertisement: only claim media when storage_refs can
+            # actually resolve (reference runtime.proto:350-354 pattern).
+            self.capabilities.append(c.Capability.MEDIA.value)
         self.pack_params = pack_params or {}
         self.on_event = on_event
         # Pack is immutable for the server's lifetime: precompute the

@@ -585,3 +585,72 @@ class TestSourceCRDs:
         cm.drain_queue()
         assert store.get("default", "PromptPackSource", "dangling") \
             .status["phase"] == "Error"
+
+
+class TestSkillSources:
+    """SkillSource reconcile + pack skills merge (reference
+    skillsource_controller.go + promptpack_skills.go): synced skill
+    markdown lands in the deployed pack's system prompt, and a skill
+    update re-resolves the agents that use it."""
+
+    def test_skill_merges_into_served_pack(self, manager, monkeypatch, tmp_path):
+        monkeypatch.setenv("OMNIA_SYNC_ROOT", str(tmp_path))
+        store, cm = manager
+        store.apply(Resource(kind="SkillSource", name="refund-playbook", spec={
+            "source": {"type": "configmap", "data": {
+                "SKILL.md": "Always quote the thirty day refund window.",
+            }},
+        }))
+        provider = Resource(kind="Provider", name="mock-llm", spec={
+            "type": "mock", "role": "llm", "options": {"scenarios": [
+                # Mock matching runs over system + current turn: hitting
+                # this pattern PROVES the skill text reached the prompt.
+                {"pattern": "thirty day refund window",
+                 "reply": "skill applied"},
+                {"pattern": ".", "reply": "no skill"},
+            ]}})
+        store.apply(provider)
+        store.apply(Resource(kind="PromptPack", name="op-pack", spec={
+            "content": {**PACK_CONTENT, "skills": ["refund-playbook"]}}))
+        agent_spec = {
+            "mode": "agent",
+            "promptPackRef": {"name": "op-pack"},
+            "providers": [{"name": "main", "providerRef": {"name": "mock-llm"}}],
+            "facades": [{"type": "websocket"}],
+        }
+        store.apply(Resource(kind="AgentRuntime", name="op-agent",
+                             spec=agent_spec))
+        cm.drain_queue()
+        src = store.get("default", "SkillSource", "refund-playbook")
+        assert src.status["phase"] == "Ready"
+        dep = cm.deployments["default/AgentRuntime/op-agent"]
+
+        from websockets.sync.client import connect
+
+        with connect(dep.pods[0].endpoint) as ws:
+            json.loads(ws.recv(timeout=10))
+            ws.send(json.dumps({"type": "message", "content": "hello"}))
+            text = ""
+            while True:
+                m = json.loads(ws.recv(timeout=30))
+                if m["type"] == "chunk":
+                    text += m["text"]
+                elif m["type"] in ("done", "error"):
+                    break
+        assert text == "skill applied"
+
+    def test_missing_skill_fails_ref_resolution(self, manager, monkeypatch, tmp_path):
+        monkeypatch.setenv("OMNIA_SYNC_ROOT", str(tmp_path))
+        store, cm = manager
+        provider, _pack, agent = _resources()
+        store.apply(provider)
+        store.apply(Resource(kind="PromptPack", name="op-pack", spec={
+            "content": {**PACK_CONTENT, "skills": ["ghost-skill"]}}))
+        store.apply(agent)
+        cm.drain_queue()
+        res = store.get("default", "AgentRuntime", "op-agent")
+        # Unresolvable skills park the agent at Pending with the ref
+        # condition naming the skill (same stance as a missing pack).
+        assert res.status["phase"] == "Pending"
+        cond = res.status["conditions"][0]
+        assert cond["status"] == "False" and "ghost-skill" in cond["message"]

@@ -306,7 +306,7 @@ class TestConformance:
         results = ConformanceSuite(target, probe_text="ping").run()
         failed = [r.to_dict() for r in results if not r.passed]
         assert not failed, failed
-        assert len(results) == 7
+        assert len(results) == 9
 
     def test_cli_entrypoint(self, live_runtime, capsys):
         from omnia_tpu.runtime.conformance import main
@@ -315,7 +315,7 @@ class TestConformance:
         rc = main([target, "ping"])
         assert rc == 0
         out = capsys.readouterr().out.strip().splitlines()
-        assert len(out) == 7
+        assert len(out) == 9
         assert all(json.loads(l)["passed"] for l in out)
 
 
