@@ -1,0 +1,41 @@
+"""Seed a memory-api with demo content (reference demos/memory-seeder).
+Uses the in-repo MemoryClient so the demo can't drift from the API."""
+
+from __future__ import annotations
+
+import os
+
+from omnia_tpu.memory import MemoryClient
+
+BASE = os.environ.get("OMNIA_MEMORY_API_URL", "http://localhost:8400")
+WS = os.environ.get("OMNIA_WORKSPACE", "demo")
+
+INSTITUTIONAL = [
+    ("refund-policy", "Refunds are processed within thirty days of approval."),
+    ("escalation", "Escalate billing disputes over $500 to the finance desk."),
+    ("tone", "Support replies are concise, friendly, and cite policy."),
+]
+USERS = {
+    "ada": ["Prefers email follow-ups over calls.",
+            "Enterprise plan customer since 2024."],
+    "lin": ["Reported a duplicate charge in June.",
+            "Interested in the annual billing discount."],
+}
+
+
+def main() -> None:
+    client = MemoryClient(BASE)
+    for key, content in INSTITUTIONAL:
+        client.remember(WS, content, category="policy", about={"key": key})
+    for user, facts in USERS.items():
+        for fact in facts:
+            client.remember(WS, fact, virtual_user_id=user,
+                            category="profile")
+    recalled = client.recall(WS, "refund policy", limit=3)
+    n = len(INSTITUTIONAL) + sum(len(f) for f in USERS.values())
+    top = repr(recalled[0]["content"]) if recalled else "(nothing yet)"
+    print(f"seeded {n} memories into workspace {WS!r}; top recall: {top}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,53 @@
+"""Minimal custom facade: chat-command HTTP surface over the runtime
+contract (reference examples/custom-facade — any process speaking
+omnia.runtime.v1 is a facade)."""
+
+from __future__ import annotations
+
+import json
+import os
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from omnia_tpu.runtime.client import RuntimeClient
+
+
+def serve(runtime_target: str, port: int = 8088) -> ThreadingHTTPServer:
+    client = RuntimeClient(runtime_target)
+
+    class Handler(BaseHTTPRequestHandler):
+        def do_POST(self):
+            if self.path != "/command":
+                self.send_response(404)
+                self.end_headers()
+                return
+            body = json.loads(
+                self.rfile.read(int(self.headers.get("Content-Length", 0)))
+            )
+            user = body.get("user", "anon")
+            stream = client.open_stream(f"cmd-{user}", user_id=user)
+            text = ""
+            for msg in stream.turn(body.get("text", "")):
+                if msg.type == "chunk":
+                    text += msg.text
+                elif msg.type in ("done", "error"):
+                    break
+            stream.close()
+            out = json.dumps({"reply": text}).encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(out)))
+            self.end_headers()
+            self.wfile.write(out)
+
+        def log_message(self, *a):
+            pass
+
+    httpd = ThreadingHTTPServer(("0.0.0.0", port), Handler)
+    return httpd
+
+
+if __name__ == "__main__":
+    httpd = serve(os.environ.get("OMNIA_RUNTIME_TARGET", "localhost:9000"),
+                  int(os.environ.get("PORT", "8088")))
+    print(f"custom facade on :{httpd.server_address[1]}")
+    httpd.serve_forever()

@@ -497,8 +497,11 @@ def doctor_main() -> int:
     if _env("OMNIA_MEMORY_API_URL"):
         doc.add_http_check(
             "memory-api", _env("OMNIA_MEMORY_API_URL") + "/healthz")
+        doc.add_memory_check(_env("OMNIA_MEMORY_API_URL"))
     if _env("OMNIA_FACADE_WS_URL"):
         doc.add_facade_ws_check(_env("OMNIA_FACADE_WS_URL"))
+    if _env("OMNIA_OPERATOR_URL"):
+        doc.add_crd_presence_check(_env("OMNIA_OPERATOR_URL"))
     report = doc.run()
     print(json.dumps(report, indent=2))
     return 0 if report.get("status") == "pass" else 1

@@ -142,6 +142,97 @@ class Doctor:
                                    remedy="runtime may be stalled")
         self.register("facade-ws", check)
 
+    def add_crd_presence_check(self, operator_api_url: str,
+                               expect_kinds: Optional[tuple] = None) -> None:
+        """CRD inventory over the operator REST (reference
+        internal/doctor/checks/crds.go): the resource API must be
+        reachable and able to serve EVERY kind the generator ships —
+        derived from operator.crds.KINDS so a new kind can't silently
+        drop out of the probe. Detail reports per-kind resource counts
+        (presence of instances is workload-dependent, not a failure)."""
+        base = operator_api_url.rstrip("/")
+
+        def check() -> CheckResult:
+            from omnia_tpu.operator.crds import KINDS
+
+            kinds = expect_kinds or tuple(KINDS)
+            counts, errors = [], []
+            for kind in kinds:
+                try:
+                    with urllib.request.urlopen(
+                        f"{base}/api/resources?kind={kind}", timeout=5.0
+                    ) as resp:
+                        doc = json.loads(resp.read())
+                    n = len(doc.get("resources", []))
+                    if n:
+                        counts.append(f"{kind}={n}")
+                except urllib.error.HTTPError as e:
+                    errors.append(f"{kind}: HTTP {e.code}")
+                except (urllib.error.URLError, OSError, ValueError) as e:
+                    errors.append(f"{kind}: {e}")
+            if errors:
+                return CheckResult("crds", FAIL, detail="; ".join(errors[:4]),
+                                   remedy="is the operator API reachable?")
+            return CheckResult(
+                "crds", PASS,
+                detail=f"{len(kinds)} kinds servable"
+                + (f" ({', '.join(counts)})" if counts else " (store empty)"),
+            )
+
+        self.register("crds", check)
+
+    def add_memory_check(self, memory_api_url: str) -> None:
+        """Memory round-trip (reference checks/memory.go): save a probe
+        memory, recall it through the public API, and ALWAYS delete it —
+        doctor runs against production stores and must not litter them
+        even when the recall leg fails."""
+        base = memory_api_url.rstrip("/")
+
+        def check() -> CheckResult:
+            probe = f"doctor-probe-{int(time.time() * 1000)}"
+            saved_id = None
+
+            def post(path: str, doc: dict) -> dict:
+                req = urllib.request.Request(
+                    f"{base}{path}", data=json.dumps(doc).encode(),
+                    headers={"Content-Type": "application/json"},
+                )
+                with urllib.request.urlopen(req, timeout=5.0) as resp:
+                    return json.loads(resp.read())
+
+            try:
+                try:
+                    saved_id = post("/api/v1/memories", {
+                        "workspace_id": "doctor", "content": probe,
+                    }).get("id")
+                except urllib.error.HTTPError as e:
+                    return CheckResult("memory", FAIL,
+                                       detail=f"save HTTP {e.code}",
+                                       remedy="check memory-api logs")
+                try:
+                    found = post("/api/v1/memories/search", {
+                        "workspace_id": "doctor", "query": probe,
+                    }).get("memories", [])
+                except urllib.error.HTTPError as e:
+                    return CheckResult("memory", FAIL,
+                                       detail=f"search HTTP {e.code}",
+                                       remedy="check memory-api logs")
+                if not any(probe in m.get("content", "") for m in found):
+                    return CheckResult("memory", FAIL,
+                                       detail="saved probe not recalled",
+                                       remedy="check memory-api indexing")
+                return CheckResult("memory", PASS, detail="save+recall ok")
+            finally:
+                if saved_id:
+                    try:
+                        urllib.request.urlopen(urllib.request.Request(
+                            f"{base}/api/v1/memories/{saved_id}",
+                            method="DELETE"), timeout=5.0)
+                    except (urllib.error.URLError, OSError):
+                        pass  # best-effort probe cleanup
+
+        self.register("memory", check)
+
     def add_streams_check(self, stream) -> None:
         def check() -> CheckResult:
             probe_group = "doctor-probe"

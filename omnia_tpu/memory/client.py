@@ -48,19 +48,22 @@ class MemoryClient:
         category: str = "general",
         confidence: float = 0.8,
         purposes: Optional[list] = None,
+        about: Optional[dict] = None,
     ) -> dict:
-        return self._post(
-            "/api/v1/memories",
-            {
-                "workspace_id": workspace_id,
-                "content": content,
-                "virtual_user_id": virtual_user_id,
-                "agent_id": agent_id,
-                "category": category,
-                "confidence": confidence,
-                "purposes": purposes or [],
-            },
-        )
+        body = {
+            "workspace_id": workspace_id,
+            "content": content,
+            "virtual_user_id": virtual_user_id,
+            "agent_id": agent_id,
+            "category": category,
+            "confidence": confidence,
+            "purposes": purposes or [],
+        }
+        if about is not None:
+            # about.key makes the write an idempotent upsert (re-seeding
+            # the same key updates rather than duplicates).
+            body["about"] = about
+        return self._post("/api/v1/memories", body)
 
     def recall(
         self,

@@ -683,3 +683,67 @@ class TestEntryPointWiring:
         assert lsp_mod.lsp_main() == 0
         out = stdout.getvalue()
         assert b"capabilities" in out
+
+
+class TestExampleScripts:
+    """Shipped example/demo scripts must actually run (an example that
+    drifts from the API is worse than none)."""
+
+    def test_custom_facade_example(self):
+        import importlib.util
+        import urllib.request as _ur
+
+        from omnia_tpu.runtime.packs import load_pack
+        from omnia_tpu.runtime.providers import ProviderRegistry, ProviderSpec
+        from omnia_tpu.runtime.server import RuntimeServer
+
+        reg = ProviderRegistry()
+        reg.register(ProviderSpec(name="m", type="mock", options={
+            "scenarios": [{"pattern": ".", "reply": "from custom facade"}]}))
+        rt = RuntimeServer(
+            pack=load_pack({"name": "p", "version": "1.0.0",
+                            "prompts": {"system": "s"},
+                            "sampling": {"max_tokens": 64}}),
+            providers=reg, provider_name="m")
+        port = rt.serve("localhost:0")
+        spec = importlib.util.spec_from_file_location(
+            "slackish", os.path.join(REPO, "examples/custom-facade/slackish.py"))
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        httpd = mod.serve(f"localhost:{port}", port=0)
+        import threading as _th
+
+        _th.Thread(target=httpd.serve_forever, daemon=True).start()
+        try:
+            hport = httpd.server_address[1]
+            req = _ur.Request(
+                f"http://127.0.0.1:{hport}/command",
+                data=json.dumps({"user": "ada", "text": "hi"}).encode())
+            with _ur.urlopen(req, timeout=15) as resp:
+                assert json.loads(resp.read())["reply"] == "from custom facade"
+        finally:
+            httpd.shutdown()
+            rt.shutdown()
+
+    def test_memory_seeder_demo(self, monkeypatch):
+        import importlib.util
+
+        from omnia_tpu.memory import HashingEmbedder, MemoryAPI
+
+        api = MemoryAPI(embedder=HashingEmbedder(dim=16))
+        port = api.serve(host="127.0.0.1", port=0)
+        try:
+            monkeypatch.setenv("OMNIA_MEMORY_API_URL", f"http://127.0.0.1:{port}")
+            spec = importlib.util.spec_from_file_location(
+                "seed", os.path.join(REPO, "demos/memory-seeder/seed.py"))
+            mod = importlib.util.module_from_spec(spec)
+            spec.loader.exec_module(mod)
+            mod.main()
+            api.reembed.drain()
+            code, resp = api.handle(
+                "POST", "/api/v1/memories/retrieve",
+                {"workspace_id": "demo", "query": "refund", "limit": 3})
+            assert code == 200
+            assert any("thirty days" in m["content"] for m in resp["memories"])
+        finally:
+            api.close()

@@ -367,6 +367,36 @@ class TestDoctor:
         assert report["checks"][0]["status"] == "fail"
         assert "division" in report["checks"][0]["detail"]
 
+    def test_memory_and_crd_checks(self):
+        """Memory save+recall round-trip and operator CRD-presence checks
+        (reference internal/doctor/checks/{memory,crds}.go)."""
+        from omnia_tpu.dashboard import DashboardServer
+        from omnia_tpu.doctor import Doctor
+        from omnia_tpu.memory import HashingEmbedder, MemoryAPI
+        from omnia_tpu.operator.store import MemoryResourceStore
+
+        mem = MemoryAPI(embedder=HashingEmbedder(dim=8))
+        mport = mem.serve(host="127.0.0.1", port=0)
+        store = MemoryResourceStore()
+        dash = DashboardServer(store)
+        dport = dash.serve(host="127.0.0.1", port=0)
+        try:
+            doc = Doctor()
+            doc.add_memory_check(f"http://127.0.0.1:{mport}")
+            doc.add_crd_presence_check(f"http://127.0.0.1:{dport}")
+            report = doc.run()
+            by_name = {c["name"]: c for c in report["checks"]}
+            assert by_name["memory"]["status"] == "pass", by_name["memory"]
+            assert by_name["crds"]["status"] == "pass", by_name["crds"]
+            # Unreachable operator → crds FAIL with a remedy.
+            doc2 = Doctor()
+            doc2.add_crd_presence_check("http://127.0.0.1:1")
+            rep2 = doc2.run()
+            assert rep2["checks"][0]["status"] == "fail"
+        finally:
+            dash.shutdown()
+            mem.close()
+
 
 class TestOCI:
     """In-tree OCI registry + artifact pull (reference
