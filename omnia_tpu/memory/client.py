@@ -94,20 +94,20 @@ class InProcessMemory:
         self.api = api or MemoryAPI()
 
     def remember(self, workspace_id, content, virtual_user_id="", agent_id="",
-                 category="general", confidence=0.8, purposes=None) -> dict:
-        status, resp = self.api.handle(
-            "POST",
-            "/api/v1/memories",
-            {
-                "workspace_id": workspace_id,
-                "content": content,
-                "virtual_user_id": virtual_user_id,
-                "agent_id": agent_id,
-                "category": category,
-                "confidence": confidence,
-                "purposes": purposes or [],
-            },
-        )
+                 category="general", confidence=0.8, purposes=None,
+                 about=None) -> dict:
+        body = {
+            "workspace_id": workspace_id,
+            "content": content,
+            "virtual_user_id": virtual_user_id,
+            "agent_id": agent_id,
+            "category": category,
+            "confidence": confidence,
+            "purposes": purposes or [],
+        }
+        if about is not None:
+            body["about"] = about
+        status, resp = self.api.handle("POST", "/api/v1/memories", body)
         if status != 200:
             raise RuntimeError(resp.get("error", "remember failed"))
         return resp

@@ -388,6 +388,9 @@ class TestDoctor:
             by_name = {c["name"]: c for c in report["checks"]}
             assert by_name["memory"]["status"] == "pass", by_name["memory"]
             assert by_name["crds"]["status"] == "pass", by_name["crds"]
+            # The probe must not litter the store (doctor runs against
+            # production memory-apis).
+            assert not mem.store.scan("doctor"), mem.store.scan("doctor")
             # Unreachable operator → crds FAIL with a remedy.
             doc2 = Doctor()
             doc2.add_crd_presence_check("http://127.0.0.1:1")
