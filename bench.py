@@ -93,6 +93,10 @@ def _run_child(env_base: dict | None, deadline_s: float) -> dict | None:
     except subprocess.TimeoutExpired:
         proc.kill()
         proc.wait()
+        # Let the stderr pump drain the pipe buffer before the caller
+        # snapshots the trace — the final lines are the evidence.
+        for t in threads:
+            t.join(timeout=10)
         _log("child hit hard deadline; killed")
         return None
     for t in threads:
@@ -294,6 +298,10 @@ def child_main() -> None:
             "decode_tok_s_per_chip": round(main_res["tok_s_chip"], 1),
             "batch_tokens": main_res["batch_tokens"],
             "batch_wall_s": main_res["batch_wall_s"],
+            # Host-side split of the decode wall: dispatch-bound serving
+            # shows dispatch_s ≈ wall; device-bound shows sync_s ≈ wall.
+            "decode_dispatch_s": main_res["decode_dispatch_s"],
+            "decode_sync_s": main_res["decode_sync_s"],
             "warmup_s": main_res["warmup_s"],
             "ttft_p90_ms": main_res["ttft_p90_ms"],
             "platform": platform,
@@ -353,6 +361,7 @@ def _bench_engine(cfg, ecfg, params, ttft_iters, decode_tokens, remaining):
         sp_long = SamplingParams(
             temperature=0.7, top_p=0.9, max_tokens=decode_tokens, seed=1
         )
+        m0 = dict(engine.metrics)
         t_start = time.monotonic()
         handles = [engine.submit(prompt, sp_long) for _ in range(ecfg.num_slots)]
         total_tokens = 0
@@ -360,6 +369,10 @@ def _bench_engine(cfg, ecfg, params, ttft_iters, decode_tokens, remaining):
             toks, _ = h.collect_tokens(timeout=300)
             total_tokens += len(toks)
         wall = time.monotonic() - t_start
+        # Where did the wall go? dispatch = host submitting programs,
+        # sync = waiting on device outputs, rest = host bookkeeping/idle.
+        dispatch_s = engine.metrics["decode_dispatch_s"] - m0["decode_dispatch_s"]
+        sync_s = engine.metrics["decode_sync_s"] - m0["decode_sync_s"]
     finally:
         engine.stop()
         del engine
@@ -371,6 +384,8 @@ def _bench_engine(cfg, ecfg, params, ttft_iters, decode_tokens, remaining):
         "tok_s_chip": total_tokens / wall,
         "batch_tokens": total_tokens,
         "batch_wall_s": round(wall, 2),
+        "decode_dispatch_s": round(dispatch_s, 3),
+        "decode_sync_s": round(sync_s, 3),
         "warmup_s": round(warmup_s, 1),
         "weight_bytes": weight_bytes,
     }

@@ -32,7 +32,9 @@ def serve(runtime_target: str, port: int = 8088) -> ThreadingHTTPServer:
                     self.rfile.read(int(self.headers.get("Content-Length", 0)))
                 )
             except (ValueError, TypeError):
-                self._reply(400, {"error": "body must be JSON"})
+                body = None
+            if not isinstance(body, dict):
+                self._reply(400, {"error": "body must be a JSON object"})
                 return
             user = str(body.get("user", "anon"))
             stream = client.open_stream(f"cmd-{user}", user_id=user)

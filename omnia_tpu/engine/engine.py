@@ -227,6 +227,10 @@ class InferenceEngine:
         self.clock = time.monotonic
 
         # Metrics (engine-level; exported via utils.metrics by the runtime).
+        # The *_s accumulators split host wall time between program
+        # DISPATCH (async submit to the device stream) and SYNC (waiting
+        # on chunk outputs) — the roofline evidence for whether serving is
+        # device-bound or host/link-bound.
         self.metrics = {
             "requests_submitted": 0,
             "requests_finished": 0,
@@ -237,6 +241,9 @@ class InferenceEngine:
             "prefix_reuse_tokens": 0,
             "session_offloads": 0,
             "session_restores": 0,
+            "decode_dispatch_s": 0.0,
+            "decode_sync_s": 0.0,
+            "prefill_dispatch_s": 0.0,
         }
 
         self._build_programs()
@@ -897,10 +904,12 @@ class InferenceEngine:
 
         sp = request.params
         usable = self.cfg.usable_buckets()
+        t_prefill = time.monotonic()
         if reuse == 0 and n <= max(usable):
             first_tok = self._fresh_prefill(slot_idx, prompt, sp)
         else:
             first_tok = self._chunked_extend(slot_idx, prompt, reuse, sp)
+        self.metrics["prefill_dispatch_s"] += time.monotonic() - t_prefill
         self.metrics["prefix_reuse_tokens"] += reuse
         self.metrics["prefill_steps"] += 1
 
@@ -1034,6 +1043,7 @@ class InferenceEngine:
             fn = self._decode_fns[chunk]
         else:
             fn = self._decode_fn
+        t_dispatch = time.monotonic()
         (
             self._ck,
             self._cv,
@@ -1057,6 +1067,7 @@ class InferenceEngine:
             self._top_p,
             self._top_k,
         )
+        self.metrics["decode_dispatch_s"] += time.monotonic() - t_dispatch
         self.metrics["decode_steps"] += int(toks.shape[0])
         return toks
 
@@ -1112,7 +1123,9 @@ class InferenceEngine:
 
     def _process_oldest_chunk(self):
         toks, active = self._inflight.popleft()
+        t_sync = time.monotonic()
         host_tokens = np.asarray(toks)  # [K, B] — ONE sync per chunk
+        self.metrics["decode_sync_s"] += time.monotonic() - t_sync
         for k in range(host_tokens.shape[0]):
             for i, rid in active:
                 slot = self._slots[i]

@@ -363,6 +363,29 @@ class K8sManifestBackend:
         scaler = self.render_autoscaling(dep)
         if scaler is not None and hosts <= 1:
             out["autoscaling"] = scaler  # HPA cannot scale a multi-host set
+        max_replicas = dep.replicas
+        if scaler is not None:
+            # An autoscaled replicas:1 agent still runs multiple pods at
+            # peak — the disruption floor must cover that too.
+            max_replicas = max(
+                max_replicas,
+                int((spec.get("autoscaling") or {}).get("maxReplicas", 1)),
+            )
+        if max_replicas > 1 and hosts <= 1:
+            # Voluntary-disruption floor (reference internal/controller/
+            # pdb.go): node drains must leave at least one serving pod.
+            # Multi-host sets get none — evicting ANY host breaks the
+            # lockstep engine, so disruptions are all-or-nothing there.
+            out["pdb"] = {
+                "apiVersion": "policy/v1",
+                "kind": "PodDisruptionBudget",
+                "metadata": {"name": f"agent-{dep.name}",
+                             "namespace": dep.namespace},
+                "spec": {
+                    "minAvailable": 1,
+                    "selector": {"matchLabels": {"omnia/agent": dep.name}},
+                },
+            }
         return out
 
     @staticmethod

@@ -130,6 +130,9 @@ def render_install(values: Optional[dict] = None) -> list[dict]:
                 {"apiGroups": ["autoscaling"],
                  "resources": ["horizontalpodautoscalers"],
                  "verbs": ["get", "list", "watch", "create", "update", "patch", "delete"]},
+                {"apiGroups": ["policy"],
+                 "resources": ["poddisruptionbudgets"],
+                 "verbs": ["get", "list", "watch", "create", "update", "patch", "delete"]},
             ],
         },
         {

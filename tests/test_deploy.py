@@ -747,3 +747,31 @@ class TestExampleScripts:
             assert any("thirty days" in m["content"] for m in resp["memories"])
         finally:
             api.close()
+
+
+class TestPDB:
+    def test_multi_replica_agents_get_disruption_floor(self):
+        from omnia_tpu.operator.deployment import AgentDeployment, K8sManifestBackend
+        from omnia_tpu.operator.resources import Resource
+
+        def render(extra, replicas=1):
+            res = Resource(kind="AgentRuntime", name="a", spec={
+                "promptPackRef": {"name": "p"},
+                "providers": [{"providerRef": {"name": "m"}}], **extra})
+            return K8sManifestBackend().render(AgentDeployment(
+                res, pack_doc={"name": "p", "version": "1.0.0"},
+                provider_specs=[{"name": "m", "type": "mock"}],
+                default_provider="m", replicas=replicas))
+
+        out = render({}, replicas=3)
+        pdb = out["pdb"]
+        assert pdb["spec"]["minAvailable"] == 1
+        assert pdb["spec"]["selector"]["matchLabels"] == {"omnia/agent": "a"}
+        # Single replica: a PDB would block every drain — none rendered.
+        assert "pdb" not in render({}, replicas=1)
+        # ...unless autoscaling can fan it out past one pod.
+        scaled = render({"autoscaling": {"minReplicas": 1, "maxReplicas": 5}},
+                        replicas=1)
+        assert scaled["pdb"]["spec"]["minAvailable"] == 1
+        # Multi-host: evicting any host breaks lockstep — none rendered.
+        assert "pdb" not in render({"tpuHosts": 2})
