@@ -366,10 +366,16 @@ class K8sManifestBackend:
         max_replicas = dep.replicas
         if scaler is not None:
             # An autoscaled replicas:1 agent still runs multiple pods at
-            # peak — the disruption floor must cover that too.
+            # peak — read the ceiling from the RENDERED scaler (HPA or
+            # KEDA) so this never drifts from render_autoscaling's
+            # defaulting rules.
+            sspec = scaler.get("spec", {})
             max_replicas = max(
                 max_replicas,
-                int((spec.get("autoscaling") or {}).get("maxReplicas", 1)),
+                int(sspec.get("maxReplicas")
+                    or sspec.get("maxReplicaCount") or 1),
+                int(sspec.get("minReplicas")
+                    or sspec.get("minReplicaCount") or 1),
             )
         if max_replicas > 1 and hosts <= 1:
             # Voluntary-disruption floor (reference internal/controller/

@@ -3,7 +3,9 @@
 Endpoint families mirror the reference session-api (reference
 cmd/session-api/SERVICE.md:27-50, internal/session/api/handler*.go):
 session CRUD, record appends (messages / events / tool-calls /
-provider-calls / eval-results), per-session reads, usage aggregates.
+provider-calls / eval-results), per-session reads, usage aggregates,
+and OTLP/HTTP trace ingest (POST /v1/traces — spans with a session.id
+attribute land as runtime events, reference internal/session/otlp).
 Every write publishes a session event to the stream fabric so eval
 workers can consume them (reference internal/session/api/
 event_publisher.go → Redis Streams). Per-client rate limiting and
@@ -100,6 +102,8 @@ class SessionAPI:
     def _route(self, method: str, path: str, body: Optional[dict]):
         if method == "POST" and path in _APPEND_ROUTES:
             return self._append(path, body or {})
+        if method == "POST" and path == "/v1/traces":
+            return self._ingest_otlp(body or {})
         if method == "POST" and path == "/api/v1/sessions":
             return self._ensure_session(body or {})
         if path == "/api/v1/usage" and method == "GET":
@@ -143,6 +147,64 @@ class SessionAPI:
                 recs = getattr(self.store, _SUB_READS[sub])(sid)
                 return 200, {sub.replace("-", "_"): [to_dict(r) for r in recs]}
         return 404, {"error": f"no route {method} {path}"}
+
+    def _ingest_otlp(self, body: dict):
+        """OTLP/HTTP JSON trace ingest (reference internal/session/otlp):
+        spans carrying a `session.id` attribute land as runtime-event
+        records on their session, correlating traces with the session
+        archive; spans without one are accepted and dropped (OTLP
+        partial-success semantics, never a client error)."""
+        ingested = dropped = 0
+        for rs in body.get("resourceSpans", []):
+            service = ""
+            for attr in (rs.get("resource") or {}).get("attributes", []):
+                if attr.get("key") == "service.name":
+                    service = (attr.get("value") or {}).get("stringValue", "")
+            for ss in rs.get("scopeSpans", []):
+                for span in ss.get("spans", []):
+                    # Per-span isolation: one malformed span must not 400
+                    # the batch after earlier spans persisted (the OTLP
+                    # retry would duplicate them) — it just counts dropped.
+                    try:
+                        attrs = {
+                            a.get("key"): next(
+                                iter((a.get("value") or {}).values()), None)
+                            for a in span.get("attributes", [])
+                        }
+                        sid = attrs.get("session.id")
+                        if not sid:
+                            dropped += 1
+                            continue
+                        start = int(span.get("startTimeUnixNano") or 0)
+                        end = int(span.get("endTimeUnixNano") or start)
+                        rec = RuntimeEventRecord(
+                            session_id=str(sid),
+                            event_type="otlp_span",
+                            data={
+                                "name": span.get("name", ""),
+                                "service": service,
+                                "trace_id": span.get("traceId", ""),
+                                "span_id": span.get("spanId", ""),
+                                "duration_ms": round((end - start) / 1e6, 3),
+                                "status": (span.get("status") or {}).get("code", 0),
+                                "attrs": {
+                                    k: v for k, v in attrs.items()
+                                    if k != "session.id"
+                                },
+                            },
+                        )
+                    except (ValueError, TypeError, AttributeError):
+                        dropped += 1
+                        continue
+                    self.store.ensure_session(SessionRecord(session_id=rec.session_id))
+                    self.store.append_event(rec)
+                    # Same contract as _append: every written record
+                    # publishes to the stream fabric and counts once.
+                    self._writes.inc(kind="otlp_span")
+                    self._publish("event", rec.session_id, to_dict(rec))
+                    ingested += 1
+        return 200, {"partialSuccess": {}, "ingested": ingested,
+                     "dropped": dropped}
 
     def _ensure_session(self, body: dict):
         if "session_id" not in body:

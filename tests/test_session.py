@@ -399,3 +399,39 @@ def test_rearchive_merges_cold_history():
     # Superseded blob deleted (no orphan leak).
     keys = set(store.cold.blobs.list("archive/"))
     assert len(keys) == 1 and (keys == old_keys or not (old_keys & keys))
+
+
+def test_otlp_trace_ingest_end_to_end():
+    """Session-api ingests OTLP/HTTP traces (reference
+    internal/session/otlp): the platform's own Tracer exports a turn span
+    over real HTTP, and it lands as a runtime event on the session."""
+    from omnia_tpu.utils.tracing import OTLPExporter, Tracer
+
+    api = SessionAPI()
+    port = api.serve(host="127.0.0.1", port=0)
+    try:
+        otlp = OTLPExporter(f"http://127.0.0.1:{port}", flush_interval_s=60)
+        tracer = Tracer("runtime", otlp=otlp)
+        span = tracer.start_span("conversation.turn",
+                                 attrs={"session.id": "otlp-sess",
+                                        "turn.index": 1})
+        span.set_attr("llm.completion_tokens", 42)
+        span.end()
+        # A span with NO session attribute is accepted and dropped.
+        tracer.start_span("orphan").end()
+        otlp.shutdown()  # flush over the wire
+        assert otlp.exported == 2 and otlp.dropped == 0
+
+        code, resp = api.handle(
+            "GET", "/api/v1/sessions/otlp-sess/events", None)
+        assert code == 200
+        events = resp["events"]
+        assert len(events) == 1
+        ev = events[0]
+        assert ev["event_type"] == "otlp_span"
+        assert ev["data"]["name"] == "conversation.turn"
+        assert ev["data"]["service"] == "runtime"
+        assert ev["data"]["attrs"]["llm.completion_tokens"] in (42, "42", 42.0)
+        assert ev["data"]["duration_ms"] >= 0
+    finally:
+        api.shutdown()
