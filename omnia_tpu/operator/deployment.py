@@ -293,15 +293,27 @@ class K8sManifestBackend:
             "metadata": {
                 "name": f"agent-{dep.name}",
                 "namespace": dep.namespace,
-                "labels": {"omnia/agent": dep.name},
+                "labels": {"omnia/agent": dep.name, "omnia/track": "stable"},
                 "annotations": {"omnia/config-hash": cfg_hash},
             },
             "spec": {
                 "replicas": dep.replicas,
-                "selector": {"matchLabels": {"omnia/agent": dep.name}},
+                # Selector labels are IMMUTABLE after creation (the
+                # reference carves out exactly this subset,
+                # deployment_builder.go:134-145): agent identity + track,
+                # nothing that can evolve. track in the selector keeps the
+                # stable and canary Deployments' pod ownership DISJOINT.
+                "selector": {"matchLabels": {
+                    "omnia/agent": dep.name, "omnia/track": "stable"}},
                 "template": {
                     "metadata": {
-                        "labels": {"omnia/agent": dep.name},
+                        # app.kubernetes.io labels make the observability
+                        # bundle's PodMonitor (component: agent) and the
+                        # Prometheus pod-label keep rule match agent pods.
+                        "labels": {"omnia/agent": dep.name,
+                                   "omnia/track": "stable",
+                                   "app.kubernetes.io/name": "omnia",
+                                   "app.kubernetes.io/component": "agent"},
                         "annotations": {"omnia/config-hash": cfg_hash},
                     },
                     "spec": pod_spec,
@@ -389,10 +401,76 @@ class K8sManifestBackend:
                              "namespace": dep.namespace},
                 "spec": {
                     "minAvailable": 1,
-                    "selector": {"matchLabels": {"omnia/agent": dep.name}},
+                    # track-scoped: a lone canary pod must not satisfy the
+                    # floor while every stable pod is evicted.
+                    "selector": {"matchLabels": {
+                        "omnia/agent": dep.name, "omnia/track": "stable"}},
                 },
             }
         return out
+
+    def render_candidate(self, dep: AgentDeployment, candidate_hash: str,
+                         weight: float) -> dict:
+        """Cluster-side progressive delivery artifacts (reference
+        rollout_candidate.go + rollout_istio.go): a candidate Deployment
+        (track-labeled, 1 replica), a track-scoped Service, and an Istio
+        VirtualService splitting traffic stable/candidate by the current
+        step weight. The in-process backend does the same split with
+        weighted endpoints; this is its kubectl-visible equivalent."""
+        if int(dep.resource.spec.get("tpuHosts", 1)) > 1:
+            raise ValueError(
+                "progressive rollout is not supported for multi-host sets: "
+                "a 1-replica candidate cannot join (or must not poison) the "
+                "stable lockstep coordinator — roll multi-host models by "
+                "deploying a second AgentRuntime"
+            )
+        base = self.render(dep)
+        cand = copy.deepcopy(base["deployment"])
+        cand["metadata"]["name"] = f"agent-{dep.name}-canary"
+        cand["metadata"]["annotations"]["omnia/config-hash"] = candidate_hash
+        for meta in (cand["metadata"],
+                     cand["spec"]["template"]["metadata"]):
+            meta.setdefault("labels", {})["omnia/track"] = "candidate"
+        cand["spec"]["replicas"] = 1
+        cand["spec"]["selector"]["matchLabels"]["omnia/track"] = "candidate"
+        cand["spec"]["template"]["metadata"]["annotations"][
+            "omnia/config-hash"] = candidate_hash
+        stable_svc = copy.deepcopy(base["service"])
+        stable_svc["metadata"]["name"] = f"agent-{dep.name}-stable"
+        stable_svc["spec"]["selector"] = {
+            "omnia/agent": dep.name, "omnia/track": "stable",
+        }
+        cand_svc = copy.deepcopy(base["service"])
+        cand_svc["metadata"]["name"] = f"agent-{dep.name}-canary"
+        cand_svc["spec"]["selector"] = {
+            "omnia/agent": dep.name, "omnia/track": "candidate",
+        }
+        w = max(0, min(100, int(round(weight))))
+        vs = {
+            "apiVersion": "networking.istio.io/v1beta1",
+            "kind": "VirtualService",
+            "metadata": {"name": f"agent-{dep.name}",
+                         "namespace": dep.namespace},
+            "spec": {
+                "hosts": [f"agent-{dep.name}"],
+                "http": [{
+                    "route": [
+                        {"destination": {
+                            "host": f"agent-{dep.name}-stable"},
+                         "weight": 100 - w},
+                        {"destination": {
+                            "host": f"agent-{dep.name}-canary"},
+                         "weight": w},
+                    ],
+                }],
+            },
+        }
+        return {
+            "candidate_deployment": cand,
+            "stable_service": stable_svc,
+            "candidate_service": cand_svc,
+            "virtual_service": vs,
+        }
 
     @staticmethod
     def render_autoscaling(dep: AgentDeployment):

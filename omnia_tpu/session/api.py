@@ -193,17 +193,25 @@ class SessionAPI:
                                 },
                             },
                         )
-                    except (ValueError, TypeError, AttributeError):
+                        self.store.ensure_session(
+                            SessionRecord(session_id=rec.session_id))
+                        self.store.append_event(rec)
+                        # Same contract as _append: every written record
+                        # publishes to the stream fabric and counts once.
+                        self._writes.inc(kind="otlp_span")
+                        self._publish("event", rec.session_id, to_dict(rec))
+                        ingested += 1
+                    except (ValueError, TypeError, AttributeError, KeyError):
                         dropped += 1
                         continue
-                    self.store.ensure_session(SessionRecord(session_id=rec.session_id))
-                    self.store.append_event(rec)
-                    # Same contract as _append: every written record
-                    # publishes to the stream fabric and counts once.
-                    self._writes.inc(kind="otlp_span")
-                    self._publish("event", rec.session_id, to_dict(rec))
-                    ingested += 1
-        return 200, {"partialSuccess": {}, "ingested": ingested,
+        # OTLP partial-success semantics: standard SDKs only inspect
+        # partialSuccess, so drops must be signalled there.
+        partial = {}
+        if dropped:
+            partial = {"rejectedSpans": dropped,
+                       "errorMessage": "spans without session.id "
+                                       "(or malformed) dropped"}
+        return 200, {"partialSuccess": partial, "ingested": ingested,
                      "dropped": dropped}
 
     def _ensure_session(self, body: dict):

@@ -766,7 +766,9 @@ class TestPDB:
         out = render({}, replicas=3)
         pdb = out["pdb"]
         assert pdb["spec"]["minAvailable"] == 1
-        assert pdb["spec"]["selector"]["matchLabels"] == {"omnia/agent": "a"}
+        # track-scoped: a lone canary pod must not satisfy the floor.
+        assert pdb["spec"]["selector"]["matchLabels"] == {
+            "omnia/agent": "a", "omnia/track": "stable"}
         # Single replica: a PDB would block every drain — none rendered.
         assert "pdb" not in render({}, replicas=1)
         # ...unless autoscaling can fan it out past one pod.
@@ -775,3 +777,36 @@ class TestPDB:
         assert scaled["pdb"]["spec"]["minAvailable"] == 1
         # Multi-host: evicting any host breaks lockstep — none rendered.
         assert "pdb" not in render({"tpuHosts": 2})
+
+
+class TestCanaryManifests:
+    def test_render_candidate_with_traffic_split(self):
+        """Cluster-side rollout artifacts (reference rollout_candidate.go
+        + rollout_istio.go): candidate Deployment on its own track label,
+        track-scoped Services, Istio VirtualService splitting by step
+        weight — selectors must NOT leak candidate pods into stable."""
+        from omnia_tpu.operator.deployment import AgentDeployment, K8sManifestBackend
+        from omnia_tpu.operator.resources import Resource
+
+        res = Resource(kind="AgentRuntime", name="a", spec={
+            "promptPackRef": {"name": "p"},
+            "providers": [{"providerRef": {"name": "m"}}]})
+        dep = AgentDeployment(
+            res, pack_doc={"name": "p", "version": "1.0.0"},
+            provider_specs=[{"name": "m", "type": "mock"}],
+            default_provider="m")
+        out = K8sManifestBackend().render_candidate(dep, "hash-v2", 25)
+        cand = out["candidate_deployment"]
+        assert cand["metadata"]["name"] == "agent-a-canary"
+        assert cand["spec"]["selector"]["matchLabels"]["omnia/track"] == "candidate"
+        assert cand["spec"]["template"]["metadata"]["labels"]["omnia/track"] == "candidate"
+        assert cand["metadata"]["annotations"]["omnia/config-hash"] == "hash-v2"
+        assert cand["spec"]["replicas"] == 1
+        assert lint([cand, out["stable_service"], out["candidate_service"]]) == []
+        routes = out["virtual_service"]["spec"]["http"][0]["route"]
+        assert [(r["destination"]["host"], r["weight"]) for r in routes] == [
+            ("agent-a-stable", 75), ("agent-a-canary", 25)]
+        # Candidate service selects ONLY candidate pods; stable selects all
+        # agent pods minus... k8s can't negate, so stable keeps the agent
+        # selector and the VS weights do the split (reference approach).
+        assert out["candidate_service"]["spec"]["selector"]["omnia/track"] == "candidate"
