@@ -58,19 +58,27 @@ print(f"RANK-OK {jax.process_index()} out={np.asarray(out).tolist()}", flush=Tru
 """
 
 
+
+def _two_rank_env(coord_port: int, extra: dict | None = None) -> dict:
+    """Shared two-process env contract (the PALLAS/XLA scrubs must stay in
+    ONE place — drift here means ranks init different backends)."""
+    env = {
+        **os.environ,
+        "JAX_PLATFORMS": "cpu",
+        "PYTHONPATH": REPO,
+        "OMNIA_COORDINATOR_ADDR": f"127.0.0.1:{coord_port}",
+        "OMNIA_NUM_PROCESSES": "2",
+        **(extra or {}),
+    }
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env.pop("XLA_FLAGS", None)  # one device per process, not a forced 8
+    return env
+
 def test_two_process_engine_forward():
     with socket.socket() as s:
         s.bind(("127.0.0.1", 0))
         port = s.getsockname()[1]
-    env_base = {
-        **os.environ,
-        "JAX_PLATFORMS": "cpu",
-        "PYTHONPATH": REPO,
-        "OMNIA_COORDINATOR_ADDR": f"127.0.0.1:{port}",
-        "OMNIA_NUM_PROCESSES": "2",
-    }
-    env_base.pop("PALLAS_AXON_POOL_IPS", None)
-    env_base.pop("XLA_FLAGS", None)  # one device per process, not a forced 8
+    env_base = _two_rank_env(port)
     procs = [
         subprocess.Popen(
             [sys.executable, "-c", CHILD],
@@ -150,15 +158,7 @@ def test_lockstep_engine_two_processes():
     with socket.socket() as s:
         s.bind(("127.0.0.1", 0))
         port = s.getsockname()[1]
-    env_base = {
-        **os.environ,
-        "JAX_PLATFORMS": "cpu",
-        "PYTHONPATH": REPO,
-        "OMNIA_COORDINATOR_ADDR": f"127.0.0.1:{port}",
-        "OMNIA_NUM_PROCESSES": "2",
-    }
-    env_base.pop("PALLAS_AXON_POOL_IPS", None)
-    env_base.pop("XLA_FLAGS", None)
+    env_base = _two_rank_env(port)
     procs = [
         subprocess.Popen(
             [sys.executable, "-c", LOCKSTEP_CHILD],
@@ -183,3 +183,99 @@ def test_lockstep_engine_two_processes():
     assert gen_l == gen_f > 0, (leader, follower)
     assert int(_re.search(r"reuse=(\d+)", follower).group(1)) > 0
     assert int(_re.search(r"sessions=(\d+)", follower).group(1)) == 0
+
+
+def test_multihost_runtime_binaries_serve_grpc(tmp_path):
+    """THE multi-host serving e2e: two real `omnia-runtime` binaries with
+    a `type: tpu` provider whose tp=2 mesh spans both processes — the
+    follower replicates, the leader serves gRPC, and a Converse turn
+    streams real engine tokens through the public contract."""
+    import json as _json
+    import time as _time
+
+    (tmp_path / "pack.json").write_text(_json.dumps({
+        "name": "mh", "version": "1.0.0",
+        "prompts": {"system": "s"}, "sampling": {"temperature": 0.0,
+                                                 "max_tokens": 8}}))
+    (tmp_path / "providers.json").write_text(_json.dumps([{
+        "name": "t", "type": "tpu", "model": "test-tiny",
+        "options": {"tp": 2, "num_slots": 2, "max_seq": 64,
+                    "prefill_buckets": [8], "dtype": "float32"},
+    }]))
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        coord_port = s.getsockname()[1]
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        grpc_port = s.getsockname()[1]
+    env_base = _two_rank_env(coord_port, {
+        "OMNIA_PACK_PATH": str(tmp_path / "pack.json"),
+        "OMNIA_PROVIDERS_PATH": str(tmp_path / "providers.json"),
+        "OMNIA_GRPC_PORT": str(grpc_port),
+    })
+    # stderr → files: a PIPE nobody drains can block a chatty rank mid-
+    # collective and stall the whole lockstep run; files never backpressure.
+    logs = [open(tmp_path / f"rank{r}.log", "wb") for r in range(2)]
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-c",
+             "from omnia_tpu.cli import runtime_main; runtime_main()"],
+            env={**env_base, "OMNIA_PROCESS_ID": str(rank)},
+            cwd=REPO, stdout=subprocess.DEVNULL, stderr=logs[rank],
+        )
+        for rank in range(2)
+    ]
+
+    def rank_log(r):
+        logs[r].flush()
+        return (tmp_path / f"rank{r}.log").read_bytes().decode()[-2000:]
+
+    try:
+        from omnia_tpu.runtime.client import RuntimeClient
+
+        deadline = _time.monotonic() + 240
+        client = None
+        while _time.monotonic() < deadline:
+            for r, p in enumerate(procs):
+                if p.poll() is not None:
+                    raise AssertionError(f"rank {r} died: {rank_log(r)}")
+            try:
+                client = RuntimeClient(f"127.0.0.1:{grpc_port}")
+                if client.health().status == "ok":
+                    break
+                client.close()
+                client = None
+            except Exception:
+                if client is not None:
+                    client.close()
+                    client = None
+            _time.sleep(1.0)
+        assert client is not None, (
+            "leader gRPC never became healthy; "
+            f"rank0: {rank_log(0)} rank1: {rank_log(1)}")
+        stream = client.open_stream("mh-sess")
+        chunks = []
+        final = None
+        for msg in stream.turn("hello multihost"):
+            if msg.type == "chunk":
+                chunks.append(msg.text)
+            if msg.type in ("done", "error"):
+                final = msg
+                break
+        stream.close()
+        client.close()
+        assert final is not None and final.type == "done", final
+        assert chunks, "no tokens streamed from the multi-host engine"
+    finally:
+        import signal as _signal
+
+        for p in procs:
+            if p.poll() is None:
+                p.send_signal(_signal.SIGTERM)
+        for p in procs:
+            try:
+                p.wait(timeout=15)
+            except subprocess.TimeoutExpired:
+                p.kill()
+        for f in logs:
+            f.close()
