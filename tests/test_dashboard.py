@@ -353,5 +353,6 @@ class TestSpaDom:
         for endpoint in ("/api/agents", "/api/costs", "/api/quality",
                          "/api/arena", "/api/providers", "/api/packs",
                          "/api/tools", "/api/workspaces", "/api/memories",
+                         "/api/memories/aggregate",
                          "/api/topology", "/api/resources", "/api/sources"):
             assert endpoint in html, f"SPA never calls {endpoint}"
