@@ -1,0 +1,134 @@
+"""Round-long TPU chip hunter (VERDICT r3 "Next round" #1).
+
+The shared axon chip is contended: round 3 probed it twice in ~7.5 h and
+never caught a free window. This watcher turns chip access into a
+round-long cadence instead of an end-of-round event:
+
+- every OMNIA_HUNT_INTERVAL_S (default 540 s) it probes backend
+  reachability in a SIGKILL-able child with a hard deadline (backend init
+  through the tunnel hangs uninterruptibly when the chip is held — the
+  watchdog must live in a different process, same lesson as bench.py);
+- EVERY attempt is appended to bench_probe.log with a UTC timestamp and
+  outcome, success or not — the cadence itself is the evidence;
+- on the first successful probe it immediately runs the full bench
+  (which also pre-seeds the persistent XLA compile cache in .jax_cache —
+  engine/engine.py:152 — so the driver's end-of-round bench needs seconds
+  of warmup, not ~100 s), writes the JSON to BENCH_TPU_r04.json, and
+  exits so the builder can commit the evidence;
+- if the chip is lost between probe and bench (CPU fallback), it keeps
+  hunting.
+"""
+
+from __future__ import annotations
+
+import datetime
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.abspath(__file__))
+LOG = os.path.join(REPO, "bench_probe.log")
+OUT = os.path.join(REPO, "BENCH_TPU_r04.json")
+PROBE_DEADLINE_S = float(os.environ.get("OMNIA_HUNT_PROBE_DEADLINE_S", "120"))
+BENCH_BUDGET_S = float(os.environ.get("OMNIA_HUNT_BENCH_BUDGET_S", "780"))
+INTERVAL_S = float(os.environ.get("OMNIA_HUNT_INTERVAL_S", "540"))
+
+
+def log(msg: str) -> None:
+    stamp = datetime.datetime.now(datetime.timezone.utc).strftime(
+        "%Y-%m-%dT%H:%M:%SZ"
+    )
+    line = f"[hunt {stamp}] {msg}"
+    print(line, flush=True)
+    with open(LOG, "a") as f:
+        f.write(line + "\n")
+
+
+def probe() -> bool:
+    """One killable backend-init attempt; True iff a non-CPU device answered."""
+    env = dict(os.environ)
+    env.setdefault("OMNIA_JAX_CACHE_DIR", os.path.join(REPO, ".jax_cache"))
+    code = (
+        "import jax; d = jax.devices()[0]; "
+        "print(f'PROBE_OK {d.platform} {d.device_kind}')"
+    )
+    t0 = time.monotonic()
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", code],
+            env=env, capture_output=True, timeout=PROBE_DEADLINE_S,
+        )
+    except subprocess.TimeoutExpired:
+        log(f"probe TIMEOUT after {PROBE_DEADLINE_S:.0f}s (child killed; "
+            "chip presumed held by another claim)")
+        return False
+    dt = time.monotonic() - t0
+    out = proc.stdout.decode(errors="replace").strip()
+    ok_lines = [ln for ln in out.splitlines() if "PROBE_OK" in ln]
+    if proc.returncode == 0 and ok_lines:
+        if ok_lines[-1].split()[1] == "cpu":
+            log(f"probe CPU-ONLY in {dt:.1f}s (no accelerator answered; "
+                f"hunt continues): {ok_lines[-1]}")
+            return False
+        log(f"probe OK in {dt:.1f}s: {ok_lines[-1]}")
+        return True
+    tail = proc.stderr.decode(errors="replace").strip().splitlines()[-3:]
+    log(f"probe FAILED rc={proc.returncode} in {dt:.1f}s: {' | '.join(tail)}")
+    return False
+
+
+def run_bench() -> bool:
+    """Full bench.py run; True iff it produced an accelerator-platform JSON."""
+    env = dict(os.environ)
+    env["OMNIA_BENCH_BUDGET_S"] = str(BENCH_BUDGET_S)
+    env.setdefault("OMNIA_JAX_CACHE_DIR", os.path.join(REPO, ".jax_cache"))
+    log(f"chip answered -> running full bench (budget {BENCH_BUDGET_S:.0f}s)")
+    with open(os.path.join(REPO, "bench_hunt_stderr.log"), "ab") as errf:
+        try:
+            proc = subprocess.run(
+                [sys.executable, os.path.join(REPO, "bench.py")],
+                env=env, stdout=subprocess.PIPE, stderr=errf,
+                timeout=BENCH_BUDGET_S + 120,
+            )
+        except subprocess.TimeoutExpired:
+            log("bench timed out past its own watchdog; killed")
+            return False
+    for line in reversed(proc.stdout.decode(errors="replace").splitlines()):
+        line = line.strip()
+        if not line.startswith("{"):
+            continue
+        try:
+            res = json.loads(line)
+        except json.JSONDecodeError:
+            continue
+        plat = res.get("aux", {}).get("platform", "?")
+        log(f"bench done: platform={plat} value={res.get('value')} "
+            f"{res.get('unit')} aux_keys={sorted(res.get('aux', {}))}")
+        if plat not in ("cpu", "?"):
+            with open(OUT, "w") as f:
+                json.dump(res, f, indent=1)
+            log(f"TPU bench JSON written to {OUT}")
+            return True
+        log("bench fell back to CPU (chip lost after probe); hunt continues")
+        return False
+    log(f"bench produced no JSON line (rc={proc.returncode})")
+    return False
+
+
+def main() -> None:
+    log(f"=== chip hunt started: interval {INTERVAL_S:.0f}s, "
+        f"probe deadline {PROBE_DEADLINE_S:.0f}s ===")
+    attempt = 0
+    while True:
+        attempt += 1
+        log(f"attempt {attempt}")
+        if probe() and run_bench():
+            log("hunt SUCCESS; exiting so the result can be committed")
+            return
+        time.sleep(INTERVAL_S)
+
+
+if __name__ == "__main__":
+    main()
