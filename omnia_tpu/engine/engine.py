@@ -29,9 +29,10 @@ Architecture (TPU-first):
   operator scales a pod to zero until the runtime advertises capabilities;
   here readiness additionally implies "no compile on the request path").
 
-Scheduling policy: prefill-first (favors TTFT over decode throughput;
-BASELINE.json north star is p50 TTFT < 400 ms), one prefill per step,
-then a decode step for all active slots.
+Module layout (one seam per concern): compiled programs live in
+``programs.py``, the dispatch/pipeline policy in ``scheduler.py``, slot
+and session-KV residency in ``sessions.py``; this module owns
+construction, request placement, warmup, and the thread lifecycle.
 """
 
 from __future__ import annotations
@@ -48,6 +49,9 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from omnia_tpu.engine.programs import build_programs
+from omnia_tpu.engine.scheduler import _SchedulerMixin
+from omnia_tpu.engine.sessions import _SessionKV, _SessionMixin, _Slot
 from omnia_tpu.engine.types import (
     EngineConfig,
     FinishReason,
@@ -60,10 +64,7 @@ from omnia_tpu.engine.types import (
 from omnia_tpu.models import ModelConfig
 from omnia_tpu.models import llama
 from omnia_tpu.models import quant
-from omnia_tpu.ops.sampling import (
-    make_slot_key_data,
-    sample_tokens_per_slot,
-)
+from omnia_tpu.ops.sampling import make_slot_key_data
 from omnia_tpu.parallel import make_mesh, shard_pytree
 from omnia_tpu.parallel.sharding import named_sharding_tree
 from omnia_tpu.utils.compile_cache import enable_compilation_cache
@@ -76,64 +77,7 @@ logger = logging.getLogger(__name__)
 MAX_DEVICE_STOP_IDS = 8
 
 
-class _Slot:
-    __slots__ = (
-        "request",
-        "handle",
-        "length",
-        "generated",
-        "max_total",
-        "stop_ids",
-        "session_id",
-        "emitted",
-    )
-
-    def __init__(self):
-        self.request: Optional[Request] = None
-        self.handle: Optional[RequestHandle] = None
-        self.length = 0          # tokens currently in the slot's KV rows
-        self.generated = 0
-        self.max_total = 0       # generation cap (request max_tokens)
-        self.stop_ids: frozenset[int] = frozenset()
-        self.session_id: Optional[str] = None  # pinned session (may be idle)
-        self.emitted: list[int] = []           # tokens emitted this request
-
-    @property
-    def active(self) -> bool:
-        return self.request is not None
-
-    def clear(self):
-        self.request = None
-        self.handle = None
-        self.length = 0
-        self.generated = 0
-        self.emitted = []
-
-
-class _SessionKV:
-    """A logical session's KV residency record.
-
-    Exactly one of (slot is not None) / (host_k is not None) / neither
-    holds: resident in a device slot, paged out to host RAM, or empty.
-    token_ids are the tokens whose KV rows are KNOWN valid — on finish the
-    last emitted token is conservatively excluded (its row write is not
-    guaranteed when a slot finishes mid-decode-chunk), costing one
-    re-prefilled token per turn instead of a correctness proof over chunk
-    timing.
-    """
-
-    __slots__ = ("session_id", "token_ids", "slot", "host_k", "host_v", "last_used")
-
-    def __init__(self, session_id: str, now: Optional[float] = None):
-        self.session_id = session_id
-        self.token_ids: list[int] = []
-        self.slot: Optional[int] = None
-        self.host_k: Optional[np.ndarray] = None  # [L, R, H, D] padded rows
-        self.host_v: Optional[np.ndarray] = None
-        self.last_used = time.monotonic() if now is None else now
-
-
-class InferenceEngine:
+class InferenceEngine(_SchedulerMixin, _SessionMixin):
     """Slot-based continuous-batching engine over one model."""
 
     def __init__(
@@ -246,7 +190,20 @@ class InferenceEngine:
             "prefill_dispatch_s": 0.0,
         }
 
-        self._build_programs()
+        progs = build_programs(self.model_cfg, self.cfg, self._mesh)
+        # Program callables live as flat attributes (not the dataclass) so
+        # tests/recovery can swap one (e.g. fault injection on
+        # _prefill_insert_fn) without rebuilding the set.
+        self._prefill_insert_fn = progs.prefill_insert
+        self._prefill_ring_fn = progs.prefill_ring
+        self._insert_fn = progs.insert
+        self._decode_fns = progs.decode_fns
+        self._decode_fn = self._decode_fns[max(self._decode_fns)]
+        self._decode_fn_single = self._decode_fns[1]
+        self._extend_fn = progs.extend
+        self._extend_nosample_fn = progs.extend_nosample
+        self._offload_fn = progs.offload
+        self._restore_fn = progs.restore
         from omnia_tpu.ops.attention import pallas_decode_mode
 
         logger.info(
@@ -287,195 +244,6 @@ class InferenceEngine:
         self._key_data = jnp.stack(
             [make_slot_key_data(self._seed + 1 + i) for i in range(B)]
         )
-
-    # ------------------------------------------------------------------
-    # Compiled programs
-    # ------------------------------------------------------------------
-
-    def _build_programs(self):
-        cfg = self.model_cfg
-
-        # Fused fresh-prefill: forward + cache insert + first-token sample
-        # in ONE dispatch. TTFT pays per-dispatch round trips (tens of ms
-        # each on a remote-device link), so folding the old
-        # prefill→insert pair into one program halves the prefill RTT
-        # bill; math is identical (same ops, same PRNG flow).
-        def prefill_insert(params, ck, cv, tokens, positions, slot, last_idx,
-                           key_data, temp, top_p, top_k):
-            logits, k_chunk, v_chunk = llama.forward_prefill(
-                params, cfg, tokens, positions
-            )
-
-            def put(c, chunk):
-                # c: [L,B,S,H,D]; chunk: [L,1,T,H,D]
-                return jax.lax.dynamic_update_slice(
-                    c, chunk.astype(c.dtype), (0, slot, 0, 0, 0)
-                )
-
-            ck = put(ck, k_chunk)
-            cv = put(cv, v_chunk)
-            last = jax.lax.dynamic_slice(
-                logits, (0, last_idx, 0), (1, 1, logits.shape[-1])
-            )[:, 0]
-            tok, new_kd = sample_tokens_per_slot(
-                last, key_data[None], temp[None], top_p[None], top_k[None]
-            )
-            return ck, cv, tok[0], new_kd[0]
-
-        self._prefill_insert_fn = jax.jit(prefill_insert, donate_argnums=(1, 2))
-
-        # Long-context prefill (sp > 1): ring attention splits the O(T²)
-        # attention of buckets ≥ long_prefill_threshold across the sp axis.
-        self._prefill_ring_fn = None
-        if self.cfg.sp > 1:
-            mesh = self._mesh
-
-            def prefill_ring(params, tokens, positions):
-                return llama.forward_prefill_ring(params, cfg, tokens, positions, mesh)
-
-            self._prefill_ring_fn = jax.jit(prefill_ring)
-
-        def insert(ck, cv, k_chunk, v_chunk, slot, last_logits, key_data, temp, top_p, top_k):
-            # Place the prefill chunk into the slot's rows [slot, 0:T].
-            def put(c, chunk):
-                # c: [L,B,S,H,D]; chunk: [L,1,T,H,D]
-                return jax.lax.dynamic_update_slice(
-                    c, chunk.astype(c.dtype), (0, slot, 0, 0, 0)
-                )
-
-            ck = put(ck, k_chunk)
-            cv = put(cv, v_chunk)
-            tok, new_kd = sample_tokens_per_slot(
-                last_logits, key_data[None], temp[None], top_p[None], top_k[None]
-            )
-            return ck, cv, tok[0], new_kd[0]
-
-        self._insert_fn = jax.jit(insert, donate_argnums=(0, 1))
-
-        max_seq = self.cfg.max_seq
-
-        def make_decode(chunk: int):
-            def decode_chunk(params, ck, cv, tokens, positions, active, budget,
-                             stop_ids, key_data, temp, top_p, top_k):
-                """`chunk` decode steps in ONE compiled program (lax.scan):
-                one host↔device round trip per K tokens instead of per
-                token. Stop-token/length finishes are masked ON DEVICE:
-                the step that samples a stop id (or exhausts the slot's
-                budget) deactivates the slot inside the scan, freezing its
-                position — a mid-chunk finish costs zero further row
-                writes or position advances, so large chunks don't trade
-                correctness-adjacent garbage for RTT amortization.
-                Inactive slots' frozen row is re-written each step (row 0
-                for unpinned slots — the next prefill's insert overwrites
-                it — or the session's valid-row frontier for pinned ones:
-                garbage only ever lives at rows ≥ the session's length)."""
-
-                def body(carry, _):
-                    ck, cv, tokens, positions, active, budget, key_data = carry
-                    logits, ck, cv = llama.forward(
-                        params, cfg, tokens[:, None], positions[:, None], ck, cv, positions
-                    )
-                    tok, key_data = sample_tokens_per_slot(
-                        logits[:, 0], key_data, temp, top_p, top_k
-                    )
-                    # Position advances for the row just written (gated on
-                    # active at step START); deactivation applies from the
-                    # NEXT step on, mirroring the host's finish bookkeeping.
-                    positions = jnp.where(
-                        active, jnp.minimum(positions + 1, max_seq - 1), positions
-                    )
-                    budget = budget - active.astype(jnp.int32)
-                    hit_stop = (tok[:, None] == stop_ids).any(axis=1)
-                    active = active & ~hit_stop & (budget > 0)
-                    tokens = jnp.where(active | hit_stop, tok, tokens)
-                    return (ck, cv, tokens, positions, active, budget, key_data), tok
-
-                (ck, cv, tokens, positions, active, budget, key_data), toks = jax.lax.scan(
-                    body, (ck, cv, tokens, positions, active, budget, key_data),
-                    None, length=chunk,
-                )
-                # toks [K, B]
-                return ck, cv, tokens, positions, active, budget, key_data, toks
-
-            return jax.jit(decode_chunk, donate_argnums=(1, 2))
-
-        # Compiled chunk-size variants: the big chunk for steady-state
-        # throughput, smaller ones so the tail of a generation (or a step
-        # taken while requests queue — TTFT discipline) doesn't pay for a
-        # full chunk. _pick_chunk chooses per dispatch.
-        self._decode_fns = {k: make_decode(k) for k in self.cfg.chunk_variants()}
-        self._decode_fn = self._decode_fns[max(self._decode_fns)]
-        self._decode_fn_single = self._decode_fns[1]
-
-        # --- sessionful-KV programs -----------------------------------
-        # Incremental extend: run the suffix through `forward` against the
-        # slot's EXISTING rows (cross-attention to history) with
-        # write_start at the reuse frontier. Batch-1 on a sliced slot cache
-        # — one slot's cache moves, not B× suffix FLOPs. One program per
-        # suffix bucket; shapes all static.
-        def extend(params, ck, cv, tokens, positions, slot, write_start, last_idx,
-                   key_data, temp, top_p, top_k):
-            L, B, S, H, D = ck.shape
-            k_slot = jax.lax.dynamic_slice(ck, (0, slot, 0, 0, 0), (L, 1, S, H, D))
-            v_slot = jax.lax.dynamic_slice(cv, (0, slot, 0, 0, 0), (L, 1, S, H, D))
-            logits, k_slot, v_slot = llama.forward(
-                params, cfg, tokens, positions, k_slot, v_slot, write_start[None]
-            )
-            ck = jax.lax.dynamic_update_slice(
-                ck, k_slot.astype(ck.dtype), (0, slot, 0, 0, 0)
-            )
-            cv = jax.lax.dynamic_update_slice(
-                cv, v_slot.astype(cv.dtype), (0, slot, 0, 0, 0)
-            )
-            last = jax.lax.dynamic_slice(
-                logits, (0, last_idx, 0), (1, 1, logits.shape[-1])
-            )[:, 0]
-            tok, new_kd = sample_tokens_per_slot(
-                last, key_data[None], temp[None], top_p[None], top_k[None]
-            )
-            return ck, cv, tok[0], new_kd[0]
-
-        self._extend_fn = jax.jit(extend, donate_argnums=(1, 2))
-
-        # Mid-extend chunk: writes rows, no sampling (sampling happens only
-        # on the final chunk of a multi-chunk extend).
-        def extend_nosample(params, ck, cv, tokens, positions, slot, write_start):
-            L, B, S, H, D = ck.shape
-            k_slot = jax.lax.dynamic_slice(ck, (0, slot, 0, 0, 0), (L, 1, S, H, D))
-            v_slot = jax.lax.dynamic_slice(cv, (0, slot, 0, 0, 0), (L, 1, S, H, D))
-            _, k_slot, v_slot = llama.forward(
-                params, cfg, tokens, positions, k_slot, v_slot, write_start[None]
-            )
-            ck = jax.lax.dynamic_update_slice(
-                ck, k_slot.astype(ck.dtype), (0, slot, 0, 0, 0)
-            )
-            cv = jax.lax.dynamic_update_slice(
-                cv, v_slot.astype(cv.dtype), (0, slot, 0, 0, 0)
-            )
-            return ck, cv
-
-        self._extend_nosample_fn = jax.jit(extend_nosample, donate_argnums=(1, 2))
-
-        # Session paging: pull/push one slot's leading rows in fixed
-        # restore-bucket shapes (device↔host transfers stay compile-stable).
-        def offload(ck, cv, slot, rows: int):
-            L, B, S, H, D = ck.shape
-            k = jax.lax.dynamic_slice(ck, (0, slot, 0, 0, 0), (L, 1, rows, H, D))
-            v = jax.lax.dynamic_slice(cv, (0, slot, 0, 0, 0), (L, 1, rows, H, D))
-            return k[:, 0], v[:, 0]
-
-        self._offload_fn = jax.jit(offload, static_argnums=(3,))
-
-        def restore(ck, cv, k_rows, v_rows, slot):
-            ck = jax.lax.dynamic_update_slice(
-                ck, k_rows[:, None].astype(ck.dtype), (0, slot, 0, 0, 0)
-            )
-            cv = jax.lax.dynamic_update_slice(
-                cv, v_rows[:, None].astype(cv.dtype), (0, slot, 0, 0, 0)
-            )
-            return ck, cv
-
-        self._restore_fn = jax.jit(restore, donate_argnums=(0, 1))
 
     def warmup(self, sessions: bool = True):
         """AOT-compile decode (all chunk variants) + all usable prefill
@@ -634,215 +402,8 @@ class InferenceEngine:
         }
 
     # ------------------------------------------------------------------
-    # Engine loop
+    # Request placement (prefill / sessionful extend)
     # ------------------------------------------------------------------
-
-    def step(self) -> bool:
-        """One scheduling step. Returns True if any work was done."""
-        self._drain_releases()
-        self._reap_cancelled()
-        did = False
-        with self._lock:
-            queued = bool(self._waiting)
-        if queued and self._inflight:
-            # Requests are waiting: surface any in-flight finishes now so
-            # their slots free up this step (TTFT over pipeline depth).
-            self._flush_pipeline()
-            did = True
-        with self._lock:
-            waiting = list(self._waiting)
-        # First PLACEABLE request, not just the head: a request whose
-        # session is still mid-decode must not head-of-line-block other
-        # sessions' requests while slots sit free.
-        pending = None
-        slot_idx = None
-        for cand in waiting:
-            idx = self._slot_for(cand[0])
-            if idx is not None:
-                pending, slot_idx = cand, idx
-                break
-        if pending is not None:
-            with self._lock:
-                try:
-                    self._waiting.remove(pending)
-                except ValueError:
-                    pending = None  # reaped concurrently
-        if pending is not None:
-            # Prefill/extend programs consume self._ck/_cv, which may be
-            # futures from in-flight decode chunks — XLA sequences the
-            # dependency, but host slot state must be current before
-            # placement decisions stick, so the pipeline is already flushed
-            # (the queued branch above ran whenever _waiting was non-empty).
-            try:
-                self._place_request(slot_idx, *pending)
-            except Exception:
-                # The request may not be attached to a slot yet, so
-                # recovery's _fail_all would never reach its handle —
-                # fail it here, then let the loop's recovery rebuild
-                # device state.
-                request, handle = pending
-                handle._push(
-                    StreamEvent(
-                        request.request_id,
-                        finish_reason=FinishReason.ERROR,
-                        error="prefill failed",
-                    )
-                )
-                self._drop_session(request.session_id)
-                self._slots[slot_idx].session_id = None
-                self._slots[slot_idx].clear()
-                raise
-            did = True
-        if any(s.active for s in self._slots):
-            with self._lock:
-                queued = bool(self._waiting)
-            # Steady state keeps up to decode_pipeline chunks in flight:
-            # chunk N+1 is dispatched on chunk N's output *futures* before
-            # N's tokens are read, so the device never idles through the
-            # host's read-RTT + bookkeeping gap (the dominant per-chunk
-            # cost on a remote-dispatch link). While requests queue, the
-            # flush above degrades this to synchronous single steps. A
-            # dispatch-ahead that no slot can still need (everyone's token
-            # budget is covered by chunks already in flight) would be pure
-            # garbage whose sync delays the NEXT request's placement by a
-            # full chunk — drain instead.
-            if self._inflight and not self._dispatch_ahead_useful():
-                self._process_oldest_chunk()
-            else:
-                self._dispatch_decode(single=queued)
-                depth = 1 if queued else max(1, self.cfg.decode_pipeline)
-                while len(self._inflight) >= depth:
-                    self._process_oldest_chunk()
-            did = True
-        elif self._inflight:
-            self._process_oldest_chunk()
-            did = True
-        return did
-
-    def _dispatch_ahead_useful(self) -> bool:
-        """True if at least one active slot's generation budget extends past
-        the decode steps already in flight — i.e. one more chunk does real
-        work for someone. Stop-token finishes are unpredictable, so budgets
-        are optimistic (max_tokens); the cost of optimism is one garbage
-        chunk, the cost of pessimism would be no pipelining for any request
-        that carries an EOS id (all real chat traffic)."""
-        return self._remaining_work() > 0
-
-    def _drain_releases(self) -> None:
-        with self._lock:
-            released, self._pending_releases = self._pending_releases, []
-        for sid in released:
-            self._drop_session(sid)
-
-    # -- slot & session scheduling -------------------------------------
-
-    def _slot_for(self, request: Request) -> Optional[int]:
-        """Pick the slot for a request, or None if it must wait.
-
-        Priority: the session's own resident slot (but never while a
-        previous request on the same session is still decoding there) →
-        a free unpinned slot → evict the least-recently-used idle session
-        to host and take its slot."""
-        sid = request.session_id if self.cfg.max_sessions > 0 else None
-        if sid is not None:
-            sess = self._sessions.get(sid)
-            if sess is not None and sess.slot is not None:
-                if self._slots[sess.slot].active:
-                    return None  # same-session turn still in flight
-                return sess.slot
-        for i, s in enumerate(self._slots):
-            if not s.active and s.session_id is None:
-                return i
-        idle_pinned = [
-            (self._sessions[s.session_id].last_used, i)
-            for i, s in enumerate(self._slots)
-            if not s.active and s.session_id is not None
-            and s.session_id in self._sessions
-        ]
-        if idle_pinned:
-            _, i = min(idle_pinned)
-            self._offload_session(self._sessions[self._slots[i].session_id])
-            return i
-        return None  # every slot is decoding
-
-    def _offload_session(self, sess: _SessionKV) -> None:
-        """Page an idle session's valid KV rows to host RAM and unpin its
-        slot. Rows move in a fixed restore-bucket shape so the transfer
-        program is compile-stable."""
-        slot_idx = sess.slot
-        valid = len(sess.token_ids)
-        if valid > 0:
-            rows = self.cfg.restore_bucket_for(valid)
-            k, v = self._offload_fn(self._ck, self._cv, slot_idx, rows)
-            sess.host_k = np.asarray(k)
-            sess.host_v = np.asarray(v)
-            self.metrics["session_offloads"] += 1
-        sess.slot = None
-        self._slots[slot_idx].session_id = None
-
-    def _restore_session(self, sess: _SessionKV, slot_idx: int) -> None:
-        """Swap a host-paged session's KV rows back into a device slot."""
-        self._ck, self._cv = self._restore_fn(
-            self._ck, self._cv, jnp.asarray(sess.host_k), jnp.asarray(sess.host_v),
-            slot_idx,
-        )
-        sess.host_k = sess.host_v = None
-        sess.slot = slot_idx
-        self._slots[slot_idx].session_id = sess.session_id
-        self.metrics["session_restores"] += 1
-
-    def _drop_session(self, sid: Optional[str]) -> None:
-        if not sid:
-            return
-        sess = self._sessions.pop(sid, None)
-        if sess is not None and sess.slot is not None:
-            self._slots[sess.slot].session_id = None
-
-    def release_session(self, session_id: str) -> None:
-        """Forget a session's cached KV (conversation ended / TTL expired).
-        Thread-safe: the registry is engine-thread-owned, so the release is
-        queued and applied at the next step. An in-flight request on the
-        session finishes normally."""
-        with self._lock:
-            self._pending_releases.append(session_id)
-        if self._thread is None:
-            self._drain_releases()  # synchronous single-threaded use
-
-    def _enforce_session_cap(self, protect: Optional[str] = None) -> None:
-        """Drop least-recently-used sessions above max_sessions. Sessions
-        with a decoding request — and the one currently being placed
-        (`protect`) — are never dropped: evicting the in-placement session
-        would leave its slot pinned to a ghost id."""
-        while len(self._sessions) > self.cfg.max_sessions:
-            victims = [
-                (s.last_used, s.session_id)
-                for s in self._sessions.values()
-                if s.session_id != protect
-                and not (s.slot is not None and self._slots[s.slot].active)
-            ]
-            if not victims:
-                return
-            _, sid = min(victims)
-            self._drop_session(sid)
-
-    def _reap_cancelled(self):
-        for i, slot in enumerate(self._slots):
-            if slot.active and slot.handle.cancelled:
-                self._finish_slot(i, FinishReason.CANCELLED)
-        with self._lock:
-            still = []
-            for req, handle in self._waiting:
-                if handle.cancelled:
-                    handle._push(
-                        StreamEvent(req.request_id, finish_reason=FinishReason.CANCELLED)
-                    )
-                    # A queue-cancelled request is as finished as a slot-
-                    # cancelled one: every submit reaches exactly one
-                    # terminal event AND one finished count.
-                    self.metrics["requests_finished"] += 1
-                else:
-                    still.append((req, handle))
-            self._waiting = still
 
     def _sampling_key(self, slot_idx: int, sp: SamplingParams):
         return (
@@ -1030,174 +591,6 @@ class InferenceEngine:
         self._key_data = self._key_data.at[slot_idx].set(new_kd)
         self.metrics["extend_steps"] += len(pieces)
         return first_tok
-
-    def _run_decode_step(self, single: bool = False, chunk: Optional[int] = None):
-        """One chunked decode dispatch → host tokens [K, B]. Position
-        advancement AND stop/length deactivation happen on-device inside
-        the scan. `single` picks the 1-step variant (used while work is
-        queued so a waiting prefill doesn't sit out a full chunk); `chunk`
-        picks an explicit compiled variant."""
-        if single:
-            fn = self._decode_fn_single
-        elif chunk is not None:
-            fn = self._decode_fns[chunk]
-        else:
-            fn = self._decode_fn
-        t_dispatch = time.monotonic()
-        (
-            self._ck,
-            self._cv,
-            self._tokens,
-            self._positions,
-            self._active,
-            self._budget,
-            self._key_data,
-            toks,
-        ) = fn(
-            self.params,
-            self._ck,
-            self._cv,
-            self._tokens,
-            self._positions,
-            self._active,
-            self._budget,
-            self._stop_ids,
-            self._key_data,
-            self._temp,
-            self._top_p,
-            self._top_k,
-        )
-        self.metrics["decode_dispatch_s"] += time.monotonic() - t_dispatch
-        self.metrics["decode_steps"] += int(toks.shape[0])
-        return toks
-
-    def _remaining_work(self) -> int:
-        """Max over active slots of tokens still to emit beyond steps
-        already in flight — how many more decode steps could do real work
-        for SOMEONE."""
-        inflight_steps: dict[int, int] = {}
-        for toks, active in self._inflight:
-            k = int(toks.shape[0])
-            for i, _rid in active:
-                inflight_steps[i] = inflight_steps.get(i, 0) + k
-        need = 0
-        for i, s in enumerate(self._slots):
-            if not s.active:
-                continue
-            rem = min(
-                s.max_total - s.generated,
-                self.cfg.max_seq - 2 - s.length,
-            ) - inflight_steps.get(i, 0)
-            need = max(need, rem)
-        return need
-
-    def _pick_chunk(self) -> int:
-        """Chunk size for the remaining useful work: the full chunk while
-        work exceeds it, else the SMALLEST variant covering the remainder.
-        Overshoot is preferred to undershoot — the on-device finish mask
-        makes overshot steps cheap garbage (~one model step each), while
-        an extra dispatch costs a full host round trip (the dominant cost
-        on a remote-device link)."""
-        need = max(self._remaining_work(), 1)
-        best = max(self._decode_fns)
-        for k in sorted(self._decode_fns):
-            if k >= need:
-                best = k
-                break
-        return best
-
-    def _dispatch_decode(self, single: bool = False):
-        """Dispatch one decode chunk asynchronously: device state advances
-        to output futures immediately; the token read is deferred to
-        _process_oldest_chunk. The active-slot list is snapshotted at
-        dispatch time — a slot that finishes while this chunk is in flight
-        is deactivated on-device the same step, so it stops writing rows;
-        any rows it DID write past its valid frontier are tolerated by the
-        sessionful bookkeeping (garbage only at rows ≥ session length)."""
-        active = [
-            (i, s.request.request_id) for i, s in enumerate(self._slots) if s.active
-        ]
-        chunk = 1 if single else self._pick_chunk()
-        toks = self._run_decode_step(chunk=chunk)
-        self._inflight.append((toks, active))
-
-    def _process_oldest_chunk(self):
-        toks, active = self._inflight.popleft()
-        t_sync = time.monotonic()
-        host_tokens = np.asarray(toks)  # [K, B] — ONE sync per chunk
-        self.metrics["decode_sync_s"] += time.monotonic() - t_sync
-        for k in range(host_tokens.shape[0]):
-            for i, rid in active:
-                slot = self._slots[i]
-                if not slot.active or slot.request.request_id != rid:
-                    # Finished earlier in this chunk (rest is garbage) — or
-                    # cancelled and re-placed while the chunk was in
-                    # flight, in which case these tokens belong to the old
-                    # request, never the slot's new occupant.
-                    continue
-                slot.length += 1
-                self._emit_token(i, int(host_tokens[k, i]))
-
-    def _flush_pipeline(self):
-        while self._inflight:
-            self._process_oldest_chunk()
-
-    def _emit_token(self, slot_idx: int, token: int):
-        slot = self._slots[slot_idx]
-        if not slot.active:
-            return
-        rid = slot.request.request_id
-        if token in slot.stop_ids:
-            self._finish_slot(slot_idx, FinishReason.STOP)
-            return
-        slot.generated += 1
-        slot.emitted.append(token)
-        slot.handle._push(StreamEvent(rid, token_id=token))
-        self.metrics["tokens_generated"] += 1
-        # max_total caps generated tokens; the cache bound stops a step early
-        # so the next decode write can never clamp/corrupt (row max_seq-1 is
-        # the last legal write).
-        if slot.generated >= slot.max_total or slot.length >= self.cfg.max_seq - 2:
-            self._finish_slot(slot_idx, FinishReason.LENGTH)
-
-    def _finish_slot(self, slot_idx: int, reason: FinishReason):
-        slot = self._slots[slot_idx]
-        rid = slot.request.request_id
-        slot.handle._push(
-            StreamEvent(
-                rid,
-                finish_reason=reason,
-                num_prompt_tokens=len(slot.request.prompt_tokens),
-                num_generated_tokens=slot.generated,
-            )
-        )
-        self.metrics["requests_finished"] += 1
-        # Sessionful: record which rows are valid for the next turn's
-        # prefix reuse. The last emitted token's row write is not
-        # guaranteed (a slot can finish mid-decode-chunk), so it is
-        # conservatively excluded — re-prefilling one token next turn is
-        # cheaper than reasoning about chunk timing.
-        quiesce_row = 0
-        sid = slot.session_id
-        sess = self._sessions.get(sid) if sid else None
-        if sess is not None and reason is not FinishReason.ERROR:
-            sess.token_ids = list(slot.request.prompt_tokens) + slot.emitted[:-1]
-            sess.last_used = self.clock()
-            # Idle-pinned slots keep decoding garbage at this frozen row —
-            # parking it at the valid-row frontier keeps the invariant that
-            # garbage only ever lives at rows ≥ the session's length.
-            quiesce_row = len(sess.token_ids)
-        elif sess is not None:
-            self._drop_session(sid)
-        slot.clear()
-        # Quiesce the slot: decode keeps running over it (static shape), but
-        # with active=False its position is frozen, so it only ever rewrites
-        # one row — row 0 for unpinned slots (the next prefill's insert
-        # overwrites it) or the session's length frontier for pinned ones.
-        self._positions = self._positions.at[slot_idx].set(quiesce_row)
-        self._tokens = self._tokens.at[slot_idx].set(0)
-        self._temp = self._temp.at[slot_idx].set(0.0)
-        self._active = self._active.at[slot_idx].set(False)
 
     # ------------------------------------------------------------------
     # Thread loop / sync helpers

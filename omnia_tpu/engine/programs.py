@@ -1,0 +1,247 @@
+"""Compiled XLA programs for the serving engine.
+
+Every device computation the engine dispatches is built here, once, at
+engine construction — the request path never traces or compiles (the
+TTFT discipline; readiness implies every program below is AOT-warm).
+
+Program inventory (all static-shaped, KV caches donated where they flow
+through):
+
+- ``prefill_insert`` — fused fresh-prefill: forward + cache insert +
+  first-token sample in ONE dispatch. TTFT pays per-dispatch round trips
+  (tens of ms each on a remote-device link), so folding the old
+  prefill→insert pair into one program halves the prefill RTT bill.
+- ``prefill_ring`` — long-context prefill (sp > 1): ring attention
+  splits the O(T²) attention of buckets ≥ long_prefill_threshold across
+  the sp mesh axis (SURVEY §5.7).
+- ``insert`` — place a prefill KV chunk into a slot's rows + sample the
+  first token (the gather step after a ring prefill).
+- ``decode_fns`` — chunked decode: `k` decode steps in one compiled
+  ``lax.scan`` program per chunk-size variant, with stop-token/length
+  finishes masked ON DEVICE so mid-chunk finishes stop writing rows.
+- ``extend`` / ``extend_nosample`` — sessionful incremental prefill:
+  run a prompt suffix through ``forward`` against the slot's EXISTING
+  rows (cross-attention to history) from the reuse frontier; batch-1 on
+  a sliced slot cache so one slot's cache moves, not B× suffix FLOPs.
+- ``offload`` / ``restore`` — session paging: pull/push one slot's
+  leading KV rows in fixed restore-bucket shapes (device↔host transfers
+  stay compile-stable).
+
+Replaces the reference's provider-relay hot path (it has no on-device
+programs at all — internal/runtime/provider.go streams vendor SSE); the
+program set is the TPU-native substitute for that relay loop.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+from omnia_tpu.engine.types import EngineConfig
+from omnia_tpu.models import ModelConfig, llama
+from omnia_tpu.ops.sampling import sample_tokens_per_slot
+
+
+@dataclasses.dataclass(frozen=True)
+class EnginePrograms:
+    """The engine's compiled-program set (jitted callables)."""
+
+    prefill_insert: Callable
+    prefill_ring: Optional[Callable]
+    insert: Callable
+    decode_fns: dict[int, Callable]
+    extend: Callable
+    extend_nosample: Callable
+    offload: Callable
+    restore: Callable
+
+
+def build_programs(
+    cfg: ModelConfig, ecfg: EngineConfig, mesh=None
+) -> EnginePrograms:
+    """Trace and jit every serving program for one (model, engine) config.
+
+    Pure in the sense that matters: depends only on the configs and mesh,
+    owns no state, and is safe to call before any device state exists.
+    """
+
+    def prefill_insert(params, ck, cv, tokens, positions, slot, last_idx,
+                       key_data, temp, top_p, top_k):
+        logits, k_chunk, v_chunk = llama.forward_prefill(
+            params, cfg, tokens, positions
+        )
+
+        def put(c, chunk):
+            # c: [L,B,S,H,D]; chunk: [L,1,T,H,D]
+            return jax.lax.dynamic_update_slice(
+                c, chunk.astype(c.dtype), (0, slot, 0, 0, 0)
+            )
+
+        ck = put(ck, k_chunk)
+        cv = put(cv, v_chunk)
+        last = jax.lax.dynamic_slice(
+            logits, (0, last_idx, 0), (1, 1, logits.shape[-1])
+        )[:, 0]
+        tok, new_kd = sample_tokens_per_slot(
+            last, key_data[None], temp[None], top_p[None], top_k[None]
+        )
+        return ck, cv, tok[0], new_kd[0]
+
+    prefill_insert_fn = jax.jit(prefill_insert, donate_argnums=(1, 2))
+
+    prefill_ring_fn = None
+    if ecfg.sp > 1:
+        def prefill_ring(params, tokens, positions):
+            return llama.forward_prefill_ring(params, cfg, tokens, positions, mesh)
+
+        prefill_ring_fn = jax.jit(prefill_ring)
+
+    def insert(ck, cv, k_chunk, v_chunk, slot, last_logits, key_data, temp,
+               top_p, top_k):
+        # Place the prefill chunk into the slot's rows [slot, 0:T].
+        def put(c, chunk):
+            # c: [L,B,S,H,D]; chunk: [L,1,T,H,D]
+            return jax.lax.dynamic_update_slice(
+                c, chunk.astype(c.dtype), (0, slot, 0, 0, 0)
+            )
+
+        ck = put(ck, k_chunk)
+        cv = put(cv, v_chunk)
+        tok, new_kd = sample_tokens_per_slot(
+            last_logits, key_data[None], temp[None], top_p[None], top_k[None]
+        )
+        return ck, cv, tok[0], new_kd[0]
+
+    insert_fn = jax.jit(insert, donate_argnums=(0, 1))
+
+    max_seq = ecfg.max_seq
+
+    def make_decode(chunk: int):
+        def decode_chunk(params, ck, cv, tokens, positions, active, budget,
+                         stop_ids, key_data, temp, top_p, top_k):
+            """`chunk` decode steps in ONE compiled program (lax.scan):
+            one host↔device round trip per K tokens instead of per
+            token. Stop-token/length finishes are masked ON DEVICE:
+            the step that samples a stop id (or exhausts the slot's
+            budget) deactivates the slot inside the scan, freezing its
+            position — a mid-chunk finish costs zero further row
+            writes or position advances, so large chunks don't trade
+            correctness-adjacent garbage for RTT amortization.
+            Inactive slots' frozen row is re-written each step (row 0
+            for unpinned slots — the next prefill's insert overwrites
+            it — or the session's valid-row frontier for pinned ones:
+            garbage only ever lives at rows ≥ the session's length)."""
+
+            def body(carry, _):
+                ck, cv, tokens, positions, active, budget, key_data = carry
+                logits, ck, cv = llama.forward(
+                    params, cfg, tokens[:, None], positions[:, None], ck, cv,
+                    positions
+                )
+                tok, key_data = sample_tokens_per_slot(
+                    logits[:, 0], key_data, temp, top_p, top_k
+                )
+                # Position advances for the row just written (gated on
+                # active at step START); deactivation applies from the
+                # NEXT step on, mirroring the host's finish bookkeeping.
+                positions = jnp.where(
+                    active, jnp.minimum(positions + 1, max_seq - 1), positions
+                )
+                budget = budget - active.astype(jnp.int32)
+                hit_stop = (tok[:, None] == stop_ids).any(axis=1)
+                active = active & ~hit_stop & (budget > 0)
+                tokens = jnp.where(active | hit_stop, tok, tokens)
+                return (ck, cv, tokens, positions, active, budget, key_data), tok
+
+            (ck, cv, tokens, positions, active, budget, key_data), toks = (
+                jax.lax.scan(
+                    body, (ck, cv, tokens, positions, active, budget, key_data),
+                    None, length=chunk,
+                )
+            )
+            # toks [K, B]
+            return ck, cv, tokens, positions, active, budget, key_data, toks
+
+        return jax.jit(decode_chunk, donate_argnums=(1, 2))
+
+    # Compiled chunk-size variants: the big chunk for steady-state
+    # throughput, smaller ones so the tail of a generation (or a step
+    # taken while requests queue — TTFT discipline) doesn't pay for a
+    # full chunk. The scheduler's _pick_chunk chooses per dispatch.
+    decode_fns = {k: make_decode(k) for k in ecfg.chunk_variants()}
+
+    def extend(params, ck, cv, tokens, positions, slot, write_start, last_idx,
+               key_data, temp, top_p, top_k):
+        L, B, S, H, D = ck.shape
+        k_slot = jax.lax.dynamic_slice(ck, (0, slot, 0, 0, 0), (L, 1, S, H, D))
+        v_slot = jax.lax.dynamic_slice(cv, (0, slot, 0, 0, 0), (L, 1, S, H, D))
+        logits, k_slot, v_slot = llama.forward(
+            params, cfg, tokens, positions, k_slot, v_slot, write_start[None]
+        )
+        ck = jax.lax.dynamic_update_slice(
+            ck, k_slot.astype(ck.dtype), (0, slot, 0, 0, 0)
+        )
+        cv = jax.lax.dynamic_update_slice(
+            cv, v_slot.astype(cv.dtype), (0, slot, 0, 0, 0)
+        )
+        last = jax.lax.dynamic_slice(
+            logits, (0, last_idx, 0), (1, 1, logits.shape[-1])
+        )[:, 0]
+        tok, new_kd = sample_tokens_per_slot(
+            last, key_data[None], temp[None], top_p[None], top_k[None]
+        )
+        return ck, cv, tok[0], new_kd[0]
+
+    extend_fn = jax.jit(extend, donate_argnums=(1, 2))
+
+    # Mid-extend chunk: writes rows, no sampling (sampling happens only
+    # on the final chunk of a multi-chunk extend).
+    def extend_nosample(params, ck, cv, tokens, positions, slot, write_start):
+        L, B, S, H, D = ck.shape
+        k_slot = jax.lax.dynamic_slice(ck, (0, slot, 0, 0, 0), (L, 1, S, H, D))
+        v_slot = jax.lax.dynamic_slice(cv, (0, slot, 0, 0, 0), (L, 1, S, H, D))
+        _, k_slot, v_slot = llama.forward(
+            params, cfg, tokens, positions, k_slot, v_slot, write_start[None]
+        )
+        ck = jax.lax.dynamic_update_slice(
+            ck, k_slot.astype(ck.dtype), (0, slot, 0, 0, 0)
+        )
+        cv = jax.lax.dynamic_update_slice(
+            cv, v_slot.astype(cv.dtype), (0, slot, 0, 0, 0)
+        )
+        return ck, cv
+
+    extend_nosample_fn = jax.jit(extend_nosample, donate_argnums=(1, 2))
+
+    def offload(ck, cv, slot, rows: int):
+        L, B, S, H, D = ck.shape
+        k = jax.lax.dynamic_slice(ck, (0, slot, 0, 0, 0), (L, 1, rows, H, D))
+        v = jax.lax.dynamic_slice(cv, (0, slot, 0, 0, 0), (L, 1, rows, H, D))
+        return k[:, 0], v[:, 0]
+
+    offload_fn = jax.jit(offload, static_argnums=(3,))
+
+    def restore(ck, cv, k_rows, v_rows, slot):
+        ck = jax.lax.dynamic_update_slice(
+            ck, k_rows[:, None].astype(ck.dtype), (0, slot, 0, 0, 0)
+        )
+        cv = jax.lax.dynamic_update_slice(
+            cv, v_rows[:, None].astype(cv.dtype), (0, slot, 0, 0, 0)
+        )
+        return ck, cv
+
+    restore_fn = jax.jit(restore, donate_argnums=(0, 1))
+
+    return EnginePrograms(
+        prefill_insert=prefill_insert_fn,
+        prefill_ring=prefill_ring_fn,
+        insert=insert_fn,
+        decode_fns=decode_fns,
+        extend=extend_fn,
+        extend_nosample=extend_nosample_fn,
+        offload=offload_fn,
+        restore=restore_fn,
+    )

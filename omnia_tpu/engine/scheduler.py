@@ -1,0 +1,303 @@
+"""Decode scheduler for the serving engine.
+
+Scheduling policy: prefill-first (favors TTFT over decode throughput;
+BASELINE.json north star is p50 TTFT < 400 ms), one prefill per step,
+then a decode step for all active slots.
+
+Steady state keeps up to ``decode_pipeline`` chunks in flight: chunk
+N+1 is dispatched on chunk N's output *futures* before N's tokens are
+read, so the device never idles through the host's read-RTT +
+bookkeeping gap (the dominant per-chunk cost on a remote-dispatch
+link). While requests queue, the pipeline degrades to synchronous
+single steps so a waiting prefill never sits out a full chunk.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Optional
+
+import numpy as np
+
+from omnia_tpu.engine.types import FinishReason, StreamEvent
+
+
+class _SchedulerMixin:
+    """Step-loop and pipeline methods of :class:`InferenceEngine`.
+
+    Mixed into the engine class — operates on the engine's slots, device
+    state, and compiled programs. Split out so the dispatch/pipeline
+    policy reads as one unit apart from placement and session residency.
+    """
+
+    def step(self) -> bool:
+        """One scheduling step. Returns True if any work was done."""
+        self._drain_releases()
+        self._reap_cancelled()
+        did = False
+        with self._lock:
+            queued = bool(self._waiting)
+        if queued and self._inflight:
+            # Requests are waiting: surface any in-flight finishes now so
+            # their slots free up this step (TTFT over pipeline depth).
+            self._flush_pipeline()
+            did = True
+        with self._lock:
+            waiting = list(self._waiting)
+        # First PLACEABLE request, not just the head: a request whose
+        # session is still mid-decode must not head-of-line-block other
+        # sessions' requests while slots sit free.
+        pending = None
+        slot_idx = None
+        for cand in waiting:
+            idx = self._slot_for(cand[0])
+            if idx is not None:
+                pending, slot_idx = cand, idx
+                break
+        if pending is not None:
+            with self._lock:
+                try:
+                    self._waiting.remove(pending)
+                except ValueError:
+                    pending = None  # reaped concurrently
+        if pending is not None:
+            # Prefill/extend programs consume self._ck/_cv, which may be
+            # futures from in-flight decode chunks — XLA sequences the
+            # dependency, but host slot state must be current before
+            # placement decisions stick, so the pipeline is already flushed
+            # (the queued branch above ran whenever _waiting was non-empty).
+            try:
+                self._place_request(slot_idx, *pending)
+            except Exception:
+                # The request may not be attached to a slot yet, so
+                # recovery's _fail_all would never reach its handle —
+                # fail it here, then let the loop's recovery rebuild
+                # device state.
+                request, handle = pending
+                handle._push(
+                    StreamEvent(
+                        request.request_id,
+                        finish_reason=FinishReason.ERROR,
+                        error="prefill failed",
+                    )
+                )
+                self._drop_session(request.session_id)
+                self._slots[slot_idx].session_id = None
+                self._slots[slot_idx].clear()
+                raise
+            did = True
+        if any(s.active for s in self._slots):
+            with self._lock:
+                queued = bool(self._waiting)
+            # A dispatch-ahead that no slot can still need (everyone's
+            # token budget is covered by chunks already in flight) would
+            # be pure garbage whose sync delays the NEXT request's
+            # placement by a full chunk — drain instead.
+            if self._inflight and not self._dispatch_ahead_useful():
+                self._process_oldest_chunk()
+            else:
+                self._dispatch_decode(single=queued)
+                depth = 1 if queued else max(1, self.cfg.decode_pipeline)
+                while len(self._inflight) >= depth:
+                    self._process_oldest_chunk()
+            did = True
+        elif self._inflight:
+            self._process_oldest_chunk()
+            did = True
+        return did
+
+    def _dispatch_ahead_useful(self) -> bool:
+        """True if at least one active slot's generation budget extends past
+        the decode steps already in flight — i.e. one more chunk does real
+        work for someone. Stop-token finishes are unpredictable, so budgets
+        are optimistic (max_tokens); the cost of optimism is one garbage
+        chunk, the cost of pessimism would be no pipelining for any request
+        that carries an EOS id (all real chat traffic)."""
+        return self._remaining_work() > 0
+
+    def _reap_cancelled(self):
+        for i, slot in enumerate(self._slots):
+            if slot.active and slot.handle.cancelled:
+                self._finish_slot(i, FinishReason.CANCELLED)
+        with self._lock:
+            still = []
+            for req, handle in self._waiting:
+                if handle.cancelled:
+                    handle._push(
+                        StreamEvent(req.request_id, finish_reason=FinishReason.CANCELLED)
+                    )
+                    # A queue-cancelled request is as finished as a slot-
+                    # cancelled one: every submit reaches exactly one
+                    # terminal event AND one finished count.
+                    self.metrics["requests_finished"] += 1
+                else:
+                    still.append((req, handle))
+            self._waiting = still
+
+    def _run_decode_step(self, single: bool = False, chunk: Optional[int] = None):
+        """One chunked decode dispatch → host tokens [K, B]. Position
+        advancement AND stop/length deactivation happen on-device inside
+        the scan. `single` picks the 1-step variant (used while work is
+        queued so a waiting prefill doesn't sit out a full chunk); `chunk`
+        picks an explicit compiled variant."""
+        if single:
+            fn = self._decode_fn_single
+        elif chunk is not None:
+            fn = self._decode_fns[chunk]
+        else:
+            fn = self._decode_fn
+        t_dispatch = time.monotonic()
+        (
+            self._ck,
+            self._cv,
+            self._tokens,
+            self._positions,
+            self._active,
+            self._budget,
+            self._key_data,
+            toks,
+        ) = fn(
+            self.params,
+            self._ck,
+            self._cv,
+            self._tokens,
+            self._positions,
+            self._active,
+            self._budget,
+            self._stop_ids,
+            self._key_data,
+            self._temp,
+            self._top_p,
+            self._top_k,
+        )
+        self.metrics["decode_dispatch_s"] += time.monotonic() - t_dispatch
+        self.metrics["decode_steps"] += int(toks.shape[0])
+        return toks
+
+    def _remaining_work(self) -> int:
+        """Max over active slots of tokens still to emit beyond steps
+        already in flight — how many more decode steps could do real work
+        for SOMEONE."""
+        inflight_steps: dict[int, int] = {}
+        for toks, active in self._inflight:
+            k = int(toks.shape[0])
+            for i, _rid in active:
+                inflight_steps[i] = inflight_steps.get(i, 0) + k
+        need = 0
+        for i, s in enumerate(self._slots):
+            if not s.active:
+                continue
+            rem = min(
+                s.max_total - s.generated,
+                self.cfg.max_seq - 2 - s.length,
+            ) - inflight_steps.get(i, 0)
+            need = max(need, rem)
+        return need
+
+    def _pick_chunk(self) -> int:
+        """Chunk size for the remaining useful work: the full chunk while
+        work exceeds it, else the SMALLEST variant covering the remainder.
+        Overshoot is preferred to undershoot — the on-device finish mask
+        makes overshot steps cheap garbage (~one model step each), while
+        an extra dispatch costs a full host round trip (the dominant cost
+        on a remote-device link)."""
+        need = max(self._remaining_work(), 1)
+        best = max(self._decode_fns)
+        for k in sorted(self._decode_fns):
+            if k >= need:
+                best = k
+                break
+        return best
+
+    def _dispatch_decode(self, single: bool = False):
+        """Dispatch one decode chunk asynchronously: device state advances
+        to output futures immediately; the token read is deferred to
+        _process_oldest_chunk. The active-slot list is snapshotted at
+        dispatch time — a slot that finishes while this chunk is in flight
+        is deactivated on-device the same step, so it stops writing rows;
+        any rows it DID write past its valid frontier are tolerated by the
+        sessionful bookkeeping (garbage only at rows ≥ session length)."""
+        active = [
+            (i, s.request.request_id) for i, s in enumerate(self._slots) if s.active
+        ]
+        chunk = 1 if single else self._pick_chunk()
+        toks = self._run_decode_step(chunk=chunk)
+        self._inflight.append((toks, active))
+
+    def _process_oldest_chunk(self):
+        toks, active = self._inflight.popleft()
+        t_sync = time.monotonic()
+        host_tokens = np.asarray(toks)  # [K, B] — ONE sync per chunk
+        self.metrics["decode_sync_s"] += time.monotonic() - t_sync
+        for k in range(host_tokens.shape[0]):
+            for i, rid in active:
+                slot = self._slots[i]
+                if not slot.active or slot.request.request_id != rid:
+                    # Finished earlier in this chunk (rest is garbage) — or
+                    # cancelled and re-placed while the chunk was in
+                    # flight, in which case these tokens belong to the old
+                    # request, never the slot's new occupant.
+                    continue
+                slot.length += 1
+                self._emit_token(i, int(host_tokens[k, i]))
+
+    def _flush_pipeline(self):
+        while self._inflight:
+            self._process_oldest_chunk()
+
+    def _emit_token(self, slot_idx: int, token: int):
+        slot = self._slots[slot_idx]
+        if not slot.active:
+            return
+        rid = slot.request.request_id
+        if token in slot.stop_ids:
+            self._finish_slot(slot_idx, FinishReason.STOP)
+            return
+        slot.generated += 1
+        slot.emitted.append(token)
+        slot.handle._push(StreamEvent(rid, token_id=token))
+        self.metrics["tokens_generated"] += 1
+        # max_total caps generated tokens; the cache bound stops a step early
+        # so the next decode write can never clamp/corrupt (row max_seq-1 is
+        # the last legal write).
+        if slot.generated >= slot.max_total or slot.length >= self.cfg.max_seq - 2:
+            self._finish_slot(slot_idx, FinishReason.LENGTH)
+
+    def _finish_slot(self, slot_idx: int, reason: FinishReason):
+        slot = self._slots[slot_idx]
+        rid = slot.request.request_id
+        slot.handle._push(
+            StreamEvent(
+                rid,
+                finish_reason=reason,
+                num_prompt_tokens=len(slot.request.prompt_tokens),
+                num_generated_tokens=slot.generated,
+            )
+        )
+        self.metrics["requests_finished"] += 1
+        # Sessionful: record which rows are valid for the next turn's
+        # prefix reuse. The last emitted token's row write is not
+        # guaranteed (a slot can finish mid-decode-chunk), so it is
+        # conservatively excluded — re-prefilling one token next turn is
+        # cheaper than reasoning about chunk timing.
+        quiesce_row = 0
+        sid = slot.session_id
+        sess = self._sessions.get(sid) if sid else None
+        if sess is not None and reason is not FinishReason.ERROR:
+            sess.token_ids = list(slot.request.prompt_tokens) + slot.emitted[:-1]
+            sess.last_used = self.clock()
+            # Idle-pinned slots keep decoding garbage at this frozen row —
+            # parking it at the valid-row frontier keeps the invariant that
+            # garbage only ever lives at rows ≥ the session's length.
+            quiesce_row = len(sess.token_ids)
+        elif sess is not None:
+            self._drop_session(sid)
+        slot.clear()
+        # Quiesce the slot: decode keeps running over it (static shape), but
+        # with active=False its position is frozen, so it only ever rewrites
+        # one row — row 0 for unpinned slots (the next prefill's insert
+        # overwrites it) or the session's length frontier for pinned ones.
+        self._positions = self._positions.at[slot_idx].set(quiesce_row)
+        self._tokens = self._tokens.at[slot_idx].set(0)
+        self._temp = self._temp.at[slot_idx].set(0.0)
+        self._active = self._active.at[slot_idx].set(False)

@@ -1,0 +1,186 @@
+"""Slot and session-KV bookkeeping for the serving engine.
+
+A *slot* is one row of the fixed decode batch; a *session* is a logical
+conversation whose KV rows outlive individual requests so the next turn
+prefills only the tokens past its longest common prefix with what is
+already cached (multi-turn serving cost becomes O(new tokens), SURVEY
+§7 — the reference has no analog because its providers re-send full
+history upstream every turn, internal/runtime/message.go).
+
+Residency moves through three states: resident in a device slot, paged
+out to host RAM (``host_k``/``host_v``), or empty. The engine thread
+owns every structure here; cross-thread requests (``release_session``)
+are queued under the engine lock and applied at the next step.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from omnia_tpu.engine.types import Request, RequestHandle
+
+
+class _Slot:
+    __slots__ = (
+        "request",
+        "handle",
+        "length",
+        "generated",
+        "max_total",
+        "stop_ids",
+        "session_id",
+        "emitted",
+    )
+
+    def __init__(self):
+        self.request: Optional[Request] = None
+        self.handle: Optional[RequestHandle] = None
+        self.length = 0          # tokens currently in the slot's KV rows
+        self.generated = 0
+        self.max_total = 0       # generation cap (request max_tokens)
+        self.stop_ids: frozenset[int] = frozenset()
+        self.session_id: Optional[str] = None  # pinned session (may be idle)
+        self.emitted: list[int] = []           # tokens emitted this request
+
+    @property
+    def active(self) -> bool:
+        return self.request is not None
+
+    def clear(self):
+        self.request = None
+        self.handle = None
+        self.length = 0
+        self.generated = 0
+        self.emitted = []
+
+
+class _SessionKV:
+    """A logical session's KV residency record.
+
+    Exactly one of (slot is not None) / (host_k is not None) / neither
+    holds: resident in a device slot, paged out to host RAM, or empty.
+    token_ids are the tokens whose KV rows are KNOWN valid — on finish the
+    last emitted token is conservatively excluded (its row write is not
+    guaranteed when a slot finishes mid-decode-chunk), costing one
+    re-prefilled token per turn instead of a correctness proof over chunk
+    timing.
+    """
+
+    __slots__ = ("session_id", "token_ids", "slot", "host_k", "host_v", "last_used")
+
+    def __init__(self, session_id: str, now: Optional[float] = None):
+        self.session_id = session_id
+        self.token_ids: list[int] = []
+        self.slot: Optional[int] = None
+        self.host_k: Optional[np.ndarray] = None  # [L, R, H, D] padded rows
+        self.host_v: Optional[np.ndarray] = None
+        self.last_used = time.monotonic() if now is None else now
+
+
+class _SessionMixin:
+    """Session-KV scheduling methods of :class:`InferenceEngine`.
+
+    Mixed into the engine class — operates on the engine's slots, session
+    registry, and paging programs. Split out so the session-residency
+    policy (slot pick, LRU eviction, host paging, cap enforcement) reads
+    as one unit apart from the decode scheduler.
+    """
+
+    def _slot_for(self, request: Request) -> Optional[int]:
+        """Pick the slot for a request, or None if it must wait.
+
+        Priority: the session's own resident slot (but never while a
+        previous request on the same session is still decoding there) →
+        a free unpinned slot → evict the least-recently-used idle session
+        to host and take its slot."""
+        sid = request.session_id if self.cfg.max_sessions > 0 else None
+        if sid is not None:
+            sess = self._sessions.get(sid)
+            if sess is not None and sess.slot is not None:
+                if self._slots[sess.slot].active:
+                    return None  # same-session turn still in flight
+                return sess.slot
+        for i, s in enumerate(self._slots):
+            if not s.active and s.session_id is None:
+                return i
+        idle_pinned = [
+            (self._sessions[s.session_id].last_used, i)
+            for i, s in enumerate(self._slots)
+            if not s.active and s.session_id is not None
+            and s.session_id in self._sessions
+        ]
+        if idle_pinned:
+            _, i = min(idle_pinned)
+            self._offload_session(self._sessions[self._slots[i].session_id])
+            return i
+        return None  # every slot is decoding
+
+    def _offload_session(self, sess: _SessionKV) -> None:
+        """Page an idle session's valid KV rows to host RAM and unpin its
+        slot. Rows move in a fixed restore-bucket shape so the transfer
+        program is compile-stable."""
+        slot_idx = sess.slot
+        valid = len(sess.token_ids)
+        if valid > 0:
+            rows = self.cfg.restore_bucket_for(valid)
+            k, v = self._offload_fn(self._ck, self._cv, slot_idx, rows)
+            sess.host_k = np.asarray(k)
+            sess.host_v = np.asarray(v)
+            self.metrics["session_offloads"] += 1
+        sess.slot = None
+        self._slots[slot_idx].session_id = None
+
+    def _restore_session(self, sess: _SessionKV, slot_idx: int) -> None:
+        """Swap a host-paged session's KV rows back into a device slot."""
+        self._ck, self._cv = self._restore_fn(
+            self._ck, self._cv, jnp.asarray(sess.host_k), jnp.asarray(sess.host_v),
+            slot_idx,
+        )
+        sess.host_k = sess.host_v = None
+        sess.slot = slot_idx
+        self._slots[slot_idx].session_id = sess.session_id
+        self.metrics["session_restores"] += 1
+
+    def _drop_session(self, sid: Optional[str]) -> None:
+        if not sid:
+            return
+        sess = self._sessions.pop(sid, None)
+        if sess is not None and sess.slot is not None:
+            self._slots[sess.slot].session_id = None
+
+    def release_session(self, session_id: str) -> None:
+        """Forget a session's cached KV (conversation ended / TTL expired).
+        Thread-safe: the registry is engine-thread-owned, so the release is
+        queued and applied at the next step. An in-flight request on the
+        session finishes normally."""
+        with self._lock:
+            self._pending_releases.append(session_id)
+        if self._thread is None:
+            self._drain_releases()  # synchronous single-threaded use
+
+    def _drain_releases(self) -> None:
+        with self._lock:
+            released, self._pending_releases = self._pending_releases, []
+        for sid in released:
+            self._drop_session(sid)
+
+    def _enforce_session_cap(self, protect: Optional[str] = None) -> None:
+        """Drop least-recently-used sessions above max_sessions. Sessions
+        with a decoding request — and the one currently being placed
+        (`protect`) — are never dropped: evicting the in-placement session
+        would leave its slot pinned to a ghost id."""
+        while len(self._sessions) > self.cfg.max_sessions:
+            victims = [
+                (s.last_used, s.session_id)
+                for s in self._sessions.values()
+                if s.session_id != protect
+                and not (s.slot is not None and self._slots[s.slot].active)
+            ]
+            if not victims:
+                return
+            _, sid = min(victims)
+            self._drop_session(sid)

@@ -1,0 +1,331 @@
+"""Streams command family of the in-tree Redis server.
+
+XADD/XRANGE/XREADGROUP/XACK/XPENDING/XAUTOCLAIM/XINFO — the
+at-least-once work-queue semantics the platform's stream clients rely
+on (reference ee/pkg/arena/queue/redis.go): consumer groups with a
+per-group pending-entries list (PEL), blocking XREADGROUP waits on the
+server's condition variable notified by every XADD, and XAUTOCLAIM
+reclaims entries whose consumer died mid-work.
+
+Split from server.py so the stream/work-queue semantics read as one
+unit apart from the keyspace commands; mixed into
+:class:`~omnia_tpu.redis.server.RedisServer`.
+"""
+
+from __future__ import annotations
+
+import time
+
+from omnia_tpu.redis.resp import Error
+
+
+class _Stream:
+    __slots__ = ("entries", "last_ms", "last_seq", "groups")
+
+    def __init__(self) -> None:
+        self.entries: list[tuple[int, int, dict[bytes, bytes]]] = []
+        self.last_ms = 0
+        self.last_seq = 0
+        self.groups: dict[bytes, _Group] = {}
+
+    def next_id(self) -> tuple[int, int]:
+        ms = int(time.time() * 1000)
+        if ms <= self.last_ms:
+            return self.last_ms, self.last_seq + 1
+        return ms, 0
+
+    def add(self, ms: int, seq: int, fields: dict[bytes, bytes]) -> None:
+        self.entries.append((ms, seq, fields))
+        self.last_ms, self.last_seq = ms, seq
+
+
+class _Group:
+    __slots__ = ("last_ms", "last_seq", "pending")
+
+    def __init__(self, last_ms: int, last_seq: int) -> None:
+        self.last_ms = last_ms
+        self.last_seq = last_seq
+        # id -> [consumer, delivered_at_ms, delivery_count]
+        self.pending: dict[tuple[int, int], list] = {}
+
+
+def _fmt_id(ms: int, seq: int) -> bytes:
+    return b"%d-%d" % (ms, seq)
+
+
+def _parse_id(raw: bytes, default_seq: int = 0) -> tuple[int, int]:
+    if b"-" in raw:
+        ms, seq = raw.split(b"-", 1)
+        return int(ms), int(seq)
+    return int(raw), default_seq
+
+
+class _StreamCommandsMixin:
+    """Stream commands of :class:`RedisServer` (uses its _lock/_cond/_typed)."""
+
+    def _cmd_xadd(self, a):
+        key, idspec = a[0], a[1]
+        fields = {a[i]: a[i + 1] for i in range(2, len(a) - 1, 2)}
+        with self._cond:
+            st = self._typed(key, "stream", _Stream)
+            if idspec == b"*":
+                ms, seq = st.next_id()
+            else:
+                ms, seq = _parse_id(idspec)
+                if (ms, seq) <= (st.last_ms, st.last_seq) and st.entries:
+                    return Error(
+                        "ERR The ID specified in XADD is equal or smaller "
+                        "than the target stream top item"
+                    )
+            st.add(ms, seq, fields)
+            self._cond.notify_all()
+            return _fmt_id(ms, seq)
+
+    def _cmd_xlen(self, a):
+        with self._lock:
+            st = self._typed(a[0], "stream")
+            return len(st.entries) if st else 0
+
+    @staticmethod
+    def _entry_reply(e: tuple[int, int, dict[bytes, bytes]]):
+        ms, seq, fields = e
+        flat: list[bytes] = []
+        for k, v in fields.items():
+            flat += [k, v]
+        return [_fmt_id(ms, seq), flat]
+
+    def _cmd_xrange(self, a):
+        key, lo_raw, hi_raw = a[0], a[1], a[2]
+        count = None
+        if len(a) >= 5 and a[3].upper() == b"COUNT":
+            count = int(a[4])
+        lo = (0, 0) if lo_raw == b"-" else _parse_id(lo_raw, 0)
+        hi = (1 << 62, 1 << 62) if hi_raw == b"+" else _parse_id(hi_raw, 1 << 62)
+        with self._lock:
+            st = self._typed(key, "stream")
+            entries = list(st.entries) if st else []
+        out = [
+            self._entry_reply(e) for e in entries if lo <= (e[0], e[1]) <= hi
+        ]
+        return out[:count] if count is not None else out
+
+    def _cmd_xgroup(self, a):
+        sub = a[0].upper()
+        if sub != b"CREATE":
+            return Error("ERR unsupported XGROUP subcommand")
+        key, group, start = a[1], a[2], a[3]
+        mkstream = any(x.upper() == b"MKSTREAM" for x in a[4:])
+        with self._lock:
+            st = self._typed(key, "stream")
+            if st is None:
+                if not mkstream:
+                    return Error(
+                        "ERR The XGROUP subcommand requires the key to exist. "
+                        "Note that for CREATE you may want to use the MKSTREAM "
+                        "option to create an empty stream automatically."
+                    )
+                st = self._typed(key, "stream", _Stream)
+            if group in st.groups:
+                return Error("BUSYGROUP Consumer Group name already exists")
+            if start == b"$":
+                ms, seq = st.last_ms, st.last_seq
+            else:
+                ms, seq = _parse_id(start)
+            st.groups[group] = _Group(ms, seq)
+        return "OK"
+
+    def _cmd_xreadgroup(self, a):
+        group = consumer = None
+        count = 10**9
+        block_ms = None
+        i = 0
+        keys: list[bytes] = []
+        ids: list[bytes] = []
+        while i < len(a):
+            opt = a[i].upper()
+            if opt == b"GROUP":
+                group, consumer = a[i + 1], a[i + 2]
+                i += 3
+            elif opt == b"COUNT":
+                count = int(a[i + 1])
+                i += 2
+            elif opt == b"BLOCK":
+                block_ms = int(a[i + 1])
+                i += 2
+            elif opt == b"NOACK":
+                i += 1
+            elif opt == b"STREAMS":
+                rest = a[i + 1:]
+                half = len(rest) // 2
+                keys, ids = rest[:half], rest[half:]
+                break
+            else:
+                return Error("ERR syntax error")
+        if group is None or not keys:
+            return Error("ERR syntax error")
+        deadline = None if block_ms is None else time.monotonic() + block_ms / 1000.0
+        while True:
+            with self._cond:
+                result = []
+                for key, idspec in zip(keys, ids):
+                    st = self._typed(key, "stream")
+                    if st is None or group not in st.groups:
+                        return Error(
+                            "NOGROUP No such key '%s' or consumer group '%s'"
+                            % (key.decode(), group.decode())
+                        )
+                    g = st.groups[group]
+                    taken = []
+                    if idspec == b">":
+                        cur = (g.last_ms, g.last_seq)
+                        for e in st.entries:
+                            eid = (e[0], e[1])
+                            if eid > cur:
+                                taken.append(e)
+                                g.last_ms, g.last_seq = eid
+                                g.pending[eid] = [
+                                    consumer, int(time.time() * 1000), 1
+                                ]
+                                if len(taken) >= count:
+                                    break
+                    else:
+                        # Re-read this consumer's pending entries from id.
+                        lo = _parse_id(idspec, 0)
+                        for e in st.entries:
+                            eid = (e[0], e[1])
+                            p = g.pending.get(eid)
+                            if p and p[0] == consumer and eid >= lo:
+                                taken.append(e)
+                                if len(taken) >= count:
+                                    break
+                    if taken:
+                        result.append([key, [self._entry_reply(e) for e in taken]])
+                if result:
+                    return result
+                if deadline is None:
+                    return None
+                remaining = deadline - time.monotonic()
+                if block_ms != 0 and remaining <= 0:
+                    return None
+                self._cond.wait(
+                    timeout=0.25 if block_ms == 0 else min(remaining, 0.25)
+                )
+
+    def _cmd_xack(self, a):
+        key, group = a[0], a[1]
+        with self._lock:
+            st = self._typed(key, "stream")
+            if st is None or group not in st.groups:
+                return 0
+            g = st.groups[group]
+            return sum(
+                1 for raw in a[2:] if g.pending.pop(_parse_id(raw), None)
+            )
+
+    def _cmd_xpending(self, a):
+        key, group = a[0], a[1]
+        with self._lock:
+            st = self._typed(key, "stream")
+            if st is None or group not in st.groups:
+                return Error(
+                    "NOGROUP No such key '%s' or consumer group '%s'"
+                    % (key.decode(), group.decode())
+                )
+            g = st.groups[group]
+            pend = sorted(g.pending.items())
+            if len(a) == 2:  # summary form
+                if not pend:
+                    return [0, None, None, None]
+                consumers: dict[bytes, int] = {}
+                for _eid, (c, _t, _n) in pend:
+                    consumers[c] = consumers.get(c, 0) + 1
+                return [
+                    len(pend),
+                    _fmt_id(*pend[0][0]),
+                    _fmt_id(*pend[-1][0]),
+                    [[c, str(n).encode()] for c, n in sorted(consumers.items())],
+                ]
+            # extended: [IDLE ms] start end count [consumer]
+            i = 2
+            min_idle = 0
+            if a[i].upper() == b"IDLE":
+                min_idle = int(a[i + 1])
+                i += 2
+            lo = (0, 0) if a[i] == b"-" else _parse_id(a[i], 0)
+            hi = (1 << 62, 1 << 62) if a[i + 1] == b"+" else _parse_id(a[i + 1], 1 << 62)
+            count = int(a[i + 2])
+            want_consumer = a[i + 3] if len(a) > i + 3 else None
+            now = int(time.time() * 1000)
+            out = []
+            for eid, (c, delivered, n) in pend:
+                idle = now - delivered
+                if eid < lo or eid > hi or idle < min_idle:
+                    continue
+                if want_consumer is not None and c != want_consumer:
+                    continue
+                out.append([_fmt_id(*eid), c, idle, n])
+                if len(out) >= count:
+                    break
+            return out
+
+    def _cmd_xautoclaim(self, a):
+        key, group, consumer = a[0], a[1], a[2]
+        min_idle = int(a[3])
+        start = (0, 0) if a[4] in (b"0", b"0-0", b"-") else _parse_id(a[4], 0)
+        count = 100
+        for i in range(5, len(a) - 1):
+            if a[i].upper() == b"COUNT":
+                count = int(a[i + 1])
+        with self._lock:
+            st = self._typed(key, "stream")
+            if st is None or group not in st.groups:
+                return Error(
+                    "NOGROUP No such key '%s' or consumer group '%s'"
+                    % (key.decode(), group.decode())
+                )
+            g = st.groups[group]
+            now = int(time.time() * 1000)
+            by_id = {(e[0], e[1]): e for e in st.entries}
+            claimed = []
+            deleted = []
+            for eid in sorted(g.pending):
+                if eid < start:
+                    continue
+                p = g.pending[eid]
+                if now - p[1] < min_idle:
+                    continue
+                entry = by_id.get(eid)
+                if entry is None:  # trimmed entry: drop from PEL
+                    del g.pending[eid]
+                    deleted.append(_fmt_id(*eid))
+                    continue
+                p[0] = consumer
+                p[1] = now
+                p[2] += 1
+                claimed.append(self._entry_reply(entry))
+                if len(claimed) >= count:
+                    break
+            return [b"0-0", claimed, deleted]
+
+    def _cmd_xinfo(self, a):
+        sub = a[0].upper()
+        with self._lock:
+            st = self._typed(a[1], "stream")
+            if st is None:
+                return Error("ERR no such key")
+            if sub == b"STREAM":
+                return [
+                    b"length", len(st.entries),
+                    b"last-generated-id", _fmt_id(st.last_ms, st.last_seq),
+                    b"groups", len(st.groups),
+                ]
+            if sub == b"GROUPS":
+                return [
+                    [
+                        b"name", name,
+                        b"pending", len(g.pending),
+                        b"last-delivered-id", _fmt_id(g.last_ms, g.last_seq),
+                    ]
+                    for name, g in sorted(st.groups.items())
+                ]
+        return Error("ERR unsupported XINFO subcommand")
