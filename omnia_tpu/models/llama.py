@@ -120,6 +120,23 @@ def param_specs(cfg: ModelConfig):
     return specs
 
 
+def param_specs_pp(cfg: ModelConfig):
+    """``param_specs`` with the stacked layer axis sharded over "pp"
+    (parallel/pipeline.py): each pipeline stage holds its contiguous
+    L/pp layer shard; tp sharding within a layer is unchanged, so pp
+    composes with tensor parallelism. Non-layer params (embed, final
+    norm, lm_head) stay replicated across pp — at 70B the embedding is
+    ~2% of weights, a fair price for keeping the first/last stage
+    symmetric and the checkpoint layout identical to the dense specs."""
+    specs = param_specs(cfg)
+    specs["layers"] = jax.tree.map(
+        lambda s: P("pp", *s[1:]),
+        specs["layers"],
+        is_leaf=lambda x: isinstance(x, P),
+    )
+    return specs
+
+
 def kv_cache_specs() -> tuple:
     """(k, v) PartitionSpecs for [L, B, S, Hkv, D] caches: batch over "dp",
     KV heads over "tp"."""

@@ -1,6 +1,7 @@
 from omnia_tpu.parallel.mesh import make_mesh, single_device_mesh
 from omnia_tpu.parallel.sharding import shard_pytree, named_sharding_tree
 from omnia_tpu.parallel.ring_attention import ring_attention
+from omnia_tpu.parallel.pipeline import pipeline_forward
 from omnia_tpu.parallel.distributed import maybe_initialize_distributed
 
 __all__ = [
@@ -9,5 +10,6 @@ __all__ = [
     "shard_pytree",
     "named_sharding_tree",
     "ring_attention",
+    "pipeline_forward",
     "maybe_initialize_distributed",
 ]

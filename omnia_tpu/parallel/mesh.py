@@ -27,20 +27,35 @@ def make_mesh(
     dp: int = 1,
     tp: int = 1,
     sp: int = 1,
+    pp: int = 1,
     devices: Optional[Sequence] = None,
 ) -> Mesh:
-    """Build a ("dp", "tp") mesh, or ("dp", "sp", "tp") when sp > 1.
+    """Build a ("dp", "tp") mesh, with "pp" and/or "sp" axes inserted
+    (("dp", "pp", "sp", "tp") order) when those degrees exceed 1.
 
     "sp" (sequence/context parallel — ring attention) sits between dp and
     tp so that the ring ppermute hops between ICI neighbors: consecutive
     devices differ in the sp coordinate while sharing the dp coordinate.
+
+    "pp" (pipeline parallel — parallel/pipeline.py) sits OUTSIDE sp/tp:
+    a pp stage boundary is the cross-host/DCN cut (one activation hop per
+    microbatch), so all of a stage's tp/sp collectives stay inside the
+    stage's slice on ICI while consecutive pp coordinates map to
+    different hosts.
     """
     devices = list(devices if devices is not None else jax.devices())
-    n = dp * tp * sp
+    n = dp * tp * sp * pp
     if len(devices) < n:
-        raise ValueError(f"mesh {dp}x{sp}x{tp} needs {n} devices, have {len(devices)}")
-    shape = (dp, sp, tp) if sp > 1 else (dp, tp)
-    names = ("dp", "sp", "tp") if sp > 1 else ("dp", "tp")
+        raise ValueError(
+            f"mesh {dp}x{pp}x{sp}x{tp} needs {n} devices, have {len(devices)}"
+        )
+    dims = [("dp", dp), ("pp", pp), ("sp", sp), ("tp", tp)]
+    keep = [
+        (name, size) for name, size in dims
+        if size > 1 or name in ("dp", "tp")
+    ]
+    shape = tuple(size for _, size in keep)
+    names = tuple(name for name, _ in keep)
     try:
         from jax.experimental import mesh_utils
 

@@ -34,28 +34,58 @@ class TrainState:
 def loss_fn(params, cfg: ModelConfig, tokens: jnp.ndarray) -> jnp.ndarray:
     """Mean next-token cross-entropy. tokens: int32 [B, T]."""
     logits = llama.forward_train(params, cfg, tokens[:, :-1])
+    return _nll(logits, tokens)
+
+
+def _nll(logits, tokens):
     targets = tokens[:, 1:]
     logp = jax.nn.log_softmax(logits, axis=-1)
     nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
     return nll.mean()
 
 
+def pipeline_loss_fn(
+    params, cfg: ModelConfig, tokens: jnp.ndarray, mesh: Mesh,
+    num_microbatches: Optional[int] = None,
+) -> jnp.ndarray:
+    """loss_fn routed through the pp-microbatched pipeline forward
+    (parallel/pipeline.py) — same math, layers sharded over "pp"."""
+    from omnia_tpu.parallel.pipeline import pipeline_forward
+
+    B, T = tokens.shape
+    toks_in = tokens[:, :-1]
+    pos = jnp.broadcast_to(jnp.arange(T - 1, dtype=jnp.int32)[None], (B, T - 1))
+    logits, _, _ = pipeline_forward(
+        params, cfg, toks_in, pos, mesh, num_microbatches=num_microbatches
+    )
+    return _nll(logits, tokens)
+
+
 def make_train_step(
     cfg: ModelConfig,
     optimizer: Optional[optax.GradientTransformation] = None,
     mesh: Optional[Mesh] = None,
+    num_microbatches: Optional[int] = None,
 ) -> tuple[Callable, Callable]:
     """Returns (init_fn, train_step).
 
     init_fn(key, dtype) -> TrainState (params sharded onto `mesh` if given).
     train_step(state, tokens) -> (state, loss) — jitted, donates state.
+
+    A mesh with a "pp" axis switches the forward to the microbatched
+    pipeline schedule and shards the layer stack over pp
+    (llama.param_specs_pp); dp/tp sharding is unchanged either way.
     """
     optimizer = optimizer or optax.adamw(1e-4)
+    pipelined = mesh is not None and "pp" in mesh.axis_names
+
+    def _specs():
+        return llama.param_specs_pp(cfg) if pipelined else llama.param_specs(cfg)
 
     def init_fn(key, dtype=jnp.float32) -> TrainState:
         params = llama.init_params(cfg, key, dtype=dtype)
         if mesh is not None:
-            shardings = named_sharding_tree(llama.param_specs(cfg), mesh)
+            shardings = named_sharding_tree(_specs(), mesh)
             params = jax.device_put(params, shardings)
         opt_state = optimizer.init(params)
         return TrainState(params=params, opt_state=opt_state, step=jnp.zeros((), jnp.int32))
@@ -65,7 +95,12 @@ def make_train_step(
             tokens = jax.lax.with_sharding_constraint(
                 tokens, NamedSharding(mesh, P("dp", None))
             )
-        loss, grads = jax.value_and_grad(loss_fn)(state.params, cfg, tokens)
+        if pipelined:
+            loss, grads = jax.value_and_grad(pipeline_loss_fn)(
+                state.params, cfg, tokens, mesh, num_microbatches
+            )
+        else:
+            loss, grads = jax.value_and_grad(loss_fn)(state.params, cfg, tokens)
         updates, opt_state = optimizer.update(grads, state.opt_state, state.params)
         params = optax.apply_updates(state.params, updates)
         return TrainState(params=params, opt_state=opt_state, step=state.step + 1), loss
