@@ -61,7 +61,8 @@ class LockstepEngine:
     run_follower() — it never returns until stop().
     """
 
-    def __init__(self, engine, tick_idle_s: float = 0.002):
+    def __init__(self, engine, tick_idle_s: float = 0.002,
+                 tick_timeout_s: float = 60.0):
         import jax
 
         self.engine = engine
@@ -69,6 +70,18 @@ class LockstepEngine:
         self.process_count = jax.process_count()
         self.is_leader = self.process_index == 0
         self.tick_idle_s = tick_idle_s
+        # Failure detection (SURVEY §5.3): a peer process dying mid-
+        # collective wedges every survivor inside the broadcast/step by
+        # construction — the collective never completes and cannot be
+        # interrupted in-process. The watchdog can't unwedge the loop
+        # thread, but it bounds the DAMAGE: after tick_timeout_s without
+        # a completed tick it marks the engine unhealthy (readiness
+        # flips, the platform reschedules) and fails every live handle
+        # so no client blocks past the bound.
+        self.tick_timeout_s = tick_timeout_s
+        self._last_tick = None  # set when the loop starts ticking
+        self._wedged = False
+        self._monitor: Optional[threading.Thread] = None
         self._logical_time = 0.0
         engine.clock = lambda: self._logical_time
         # Pre-serialized event frames (bytes) — one json.dumps per event
@@ -90,6 +103,15 @@ class LockstepEngine:
                session_id: Optional[str] = None) -> RequestHandle:
         assert self.is_leader, "submit() is leader-only; followers replicate"
         handle = _LeaderHandle(self)
+        if self._wedged:
+            # A wedged tick loop would never broadcast this submit —
+            # fail fast instead of queueing into the void.
+            handle._push(StreamEvent(
+                "req-wedged", finish_reason=FinishReason.ERROR,
+                error="lockstep tick stalled (peer process lost); "
+                      "engine unhealthy",
+            ))
+            return handle
         event = {
             "op": "submit",
             "prompt": list(prompt_tokens),
@@ -141,7 +163,7 @@ class LockstepEngine:
         return self.engine.active_slots()
 
     def healthy(self) -> bool:
-        return self.engine.healthy()
+        return self.engine.healthy() and not self._wedged
 
     def warmup(self, sessions: bool = True) -> None:
         # Collective: every process calls warmup() with the same config
@@ -164,18 +186,78 @@ class LockstepEngine:
             target=self._loop, name="omnia-lockstep", daemon=True
         )
         self._thread.start()
+        self._start_monitor()
 
     def stop(self) -> None:
         self._stop.set()
         if self._thread is not None:
             self._thread.join(timeout=30)
             self._thread = None
+        if self._monitor is not None:
+            self._monitor.join(timeout=5)
+            self._monitor = None
 
     def run_follower(self) -> None:
         """Follower processes block here, replicating the leader's step
         stream until the leader broadcasts shutdown."""
         assert not self.is_leader
+        self._start_monitor()
         self._loop()
+
+    # -- tick watchdog --------------------------------------------------
+
+    def _start_monitor(self) -> None:
+        if self._monitor is not None and self._monitor.is_alive():
+            return
+        # Baseline at monitor start: a peer lost before the FIRST tick
+        # completes must still be detected within the bound.
+        self._last_tick = time.monotonic()
+        self._monitor = threading.Thread(
+            target=self._monitor_loop, name="omnia-lockstep-watchdog",
+            daemon=True,
+        )
+        self._monitor.start()
+
+    def _monitor_loop(self) -> None:
+        poll = min(1.0, self.tick_timeout_s / 4)
+        while not self._stop.is_set():
+            time.sleep(poll)
+            stalled = time.monotonic() - self._last_tick > self.tick_timeout_s
+            if stalled and not self._wedged:
+                self._declare_wedged()
+            elif self._wedged and not stalled:
+                # False positive (e.g. one step outlived the bound but the
+                # peers were alive all along): ticks resumed, so restore
+                # readiness. Handles failed meanwhile stay failed — their
+                # clients retry — but the engine is not a permanent outage.
+                self._wedged = False
+                logger.warning(
+                    "lockstep ticks resumed on rank %d after a stall — "
+                    "clearing wedged state", self.process_index,
+                )
+
+    def _declare_wedged(self) -> None:
+        """Bound the blast radius of a lost peer: flip readiness and fail
+        every live handle. The loop thread itself stays stuck in the
+        collective (daemon — it dies with the process when the platform
+        restarts the pod, which is the actual recovery path)."""
+        self._wedged = True
+        logger.error(
+            "lockstep tick stalled > %.0fs on rank %d/%d — peer process "
+            "presumed lost; marking engine unhealthy and failing live "
+            "handles",
+            self.tick_timeout_s, self.process_index, self.process_count,
+        )
+        err = ("lockstep tick stalled (peer process lost); "
+               "turn aborted, engine unhealthy")
+        with self._lock:
+            tagged = list(getattr(self, "_tagged", {}).values())
+            handles = list(self._handles.values())
+        for h in tagged + handles:
+            h._push(StreamEvent(
+                getattr(h, "request_id", "req-wedged"),
+                finish_reason=FinishReason.ERROR, error=err,
+            ))
 
     # -- the lockstep loop ----------------------------------------------
 
@@ -231,7 +313,16 @@ class LockstepEngine:
                 stop, t = self._stop.is_set(), time.monotonic()
             else:
                 payload, stop, t = b"", False, 0.0
-            payload, stop, t = self._broadcast_tick(payload, stop, t)
+            try:
+                payload, stop, t = self._broadcast_tick(payload, stop, t)
+            except Exception:
+                # A lost peer surfaces here either as a hang (watchdog's
+                # job) or as a collective error (gloo RST / coordination
+                # heartbeat) — same meaning, same bounded response.
+                logger.exception("lockstep tick broadcast failed")
+                self._declare_wedged()
+                return
+            self._last_tick = time.monotonic()
             self._logical_time = t
             events = json.loads(payload.decode()) if payload else []
             for ev in events:
