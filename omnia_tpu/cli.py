@@ -261,7 +261,10 @@ def _auth_chain_from_env():
         validators.append(SharedTokenValidator(shared))
     mgmt = _env("OMNIA_MGMT_SECRET")
     if mgmt:
-        validators.append(HmacValidator(mgmt.encode()))
+        # Audience-pinned: only aud="mgmt" tokens (operator mint, console
+        # mint) authenticate — a console session cookie or any other
+        # same-secret JWT with a different audience must NOT pass here.
+        validators.append(HmacValidator(mgmt.encode(), audience="mgmt"))
     issuer = _env("OMNIA_OIDC_ISSUER")
     if issuer:
         from omnia_tpu.facade.oidc import OIDCValidator
@@ -431,11 +434,13 @@ def operator_main() -> int:
     if _env("OMNIA_DASHBOARD", "1") == "1":
         from omnia_tpu.dashboard import DashboardServer
 
+        _dash_mgmt = _env("OMNIA_MGMT_SECRET")
         dash = DashboardServer(
             store,
             session_api_url=_env("OMNIA_SESSION_API_URL"),
             memory_api_url=_env("OMNIA_MEMORY_API_URL"),
             write_token=_env("OMNIA_DASHBOARD_TOKEN") or None,
+            mgmt_secret=_dash_mgmt.encode() if _dash_mgmt else None,
         )
         dash.serve(host="0.0.0.0", port=int(_env("OMNIA_HTTP_PORT", "8090")))
     from omnia_tpu.operator.api import OperatorAPI

@@ -19,8 +19,21 @@ JSON API per reference route family (dashboard/src/app/):
   memories   /api/memories[...]             memory-api proxy
   topology   /api/topology                  resource graph (nodes+edges)
   sources    /api/sources                   pack/arena source sync status
-  settings   /api/resources CRUD            CRD passthrough (the reference
-             dashboard writes CRDs directly — crd-operations.ts)
+  skills     /api/skills                    SkillSource sync + consumers
+  functions  /api/functions                 pack functions flattened
+  memory-analytics /api/memory-analytics    tier/category/agent/day rollup
+  settings   /api/settings + /api/resources CRUD   config snapshot + CRD
+             passthrough (the reference dashboard writes CRDs directly —
+             crd-operations.ts)
+
+Auth (reference dashboard/server.js:1-40 — the console authenticates the
+CHAT path too, not just writes): POST /api/login exchanges the dashboard
+token for an HttpOnly session cookie; GET /api/console-token (session-
+gated) mints a short-lived HS256 mgmt-plane JWT server-side, which the
+SPA passes to the agent facade's WS (`?token=`) — the facade validates
+it through its OMNIA_MGMT_SECRET HmacValidator. The browser never holds
+a long-lived credential and the WS path is never unauthenticated when a
+mgmt secret is configured.
 """
 
 from __future__ import annotations
@@ -41,22 +54,99 @@ _STATIC_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)), "static")
 
 
 class DashboardServer:
+    CONSOLE_SESSION_TTL_S = 12 * 3600.0
+    CONSOLE_TOKEN_TTL_S = 300.0
+
     def __init__(
         self,
         store,
         session_api_url: Optional[str] = None,
         memory_api_url: Optional[str] = None,
         write_token: Optional[str] = None,
+        mgmt_secret: Optional[bytes] = None,
     ) -> None:
         self.store = store
         self.session_api_url = (session_api_url or "").rstrip("/")
         self.memory_api_url = (memory_api_url or "").rstrip("/")
         # CRD mutations require this bearer token (OMNIA_DASHBOARD_TOKEN;
         # the reference console authenticates its CRD writes too). None =
-        # writes disabled entirely — never silently open.
+        # writes disabled entirely — never silently open. The same token
+        # is the console login credential (POST /api/login).
         self.write_token = write_token
+        # Shared secret with the facades' HmacValidator (OMNIA_MGMT_SECRET):
+        # lets the dashboard mint short-lived mgmt-plane JWTs server-side
+        # for console WS connections, reference dashboard/server.js style.
+        self.mgmt_secret = mgmt_secret
         self._httpd: Optional[ThreadingHTTPServer] = None
         self.port: Optional[int] = None
+
+    # -- console auth ---------------------------------------------------
+
+    @property
+    def _cookie_secret(self) -> Optional[bytes]:
+        """Session-cookie signing key, DERIVED from the configured secret:
+        a cookie signed with raw mgmt_secret would itself validate at any
+        facade whose HmacValidator skips the audience check — a 12 h
+        wide-scope credential minted by accident. Deriving breaks that
+        class entirely; the audience claim is then defense in depth."""
+        import hashlib as _hashlib
+
+        base = self.mgmt_secret or (
+            self.write_token.encode() if self.write_token else None
+        )
+        if base is None:
+            return None
+        return _hashlib.sha256(b"omnia-console-cookie:" + base).digest()
+
+    def auth_required(self) -> bool:
+        """Login is enforced whenever ANY credential is configured — a
+        mgmt secret without a dashboard token must not leave the token
+        mint open. Only a bare dev dashboard (no token, no secret) stays
+        open."""
+        return self.write_token is not None or self.mgmt_secret is not None
+
+    def _session_cookie(self) -> str:
+        from omnia_tpu.facade.auth import HmacValidator
+
+        return HmacValidator.mint(
+            self._cookie_secret, subject="console-user", audience="console",
+            ttl_s=self.CONSOLE_SESSION_TTL_S,
+        )
+
+    def _bearer_is_write_token(self, headers: dict) -> bool:
+        """Constant-time dashboard-token check (sha256 digests so that
+        non-ASCII or non-string input can never raise out of
+        hmac.compare_digest — the SharedTokenValidator discipline)."""
+        import hashlib as _hashlib
+        import hmac as _hmac
+
+        if not self.write_token:
+            return False
+        bearer = (headers.get("Authorization") or "").removeprefix("Bearer ")
+        if not bearer:
+            return False
+        return _hmac.compare_digest(
+            _hashlib.sha256(str(bearer).encode()).digest(),
+            _hashlib.sha256(self.write_token.encode()).digest(),
+        )
+
+    def _console_authenticated(self, headers: dict) -> bool:
+        """True when the request carries a valid console session cookie or
+        the dashboard token itself (API clients)."""
+        if not self.auth_required():
+            return True
+        if self._bearer_is_write_token(headers):
+            return True
+        cookies = headers.get("Cookie") or ""
+        for part in cookies.split(";"):
+            name, _, value = part.strip().partition("=")
+            if name == "omnia_console" and value:
+                from omnia_tpu.facade.auth import HmacValidator
+
+                v = HmacValidator(self._cookie_secret, audience="console")
+                if v.validate(value) is not None:
+                    return True
+        return False
 
     # -- data assembly -------------------------------------------------
 
@@ -154,6 +244,90 @@ class DashboardServer:
                     "message": r.status.get("message", ""),
                 })
         return out
+
+    def skills(self) -> list[dict]:
+        """SkillSource sync state + which packs consume each skill
+        (reference dashboard /skills route; skill merge happens at pack
+        resolution — operator/controller.py _merge_pack_skills)."""
+        consumers: dict[tuple[str, str], list[str]] = {}
+        for p in self.store.list(kind="PromptPack"):
+            for sname in (p.spec.get("content") or {}).get("skills", []) or []:
+                consumers.setdefault((p.namespace, sname), []).append(p.name)
+        return [{
+            "name": r.name, "namespace": r.namespace,
+            "type": (r.spec.get("source") or {}).get("type", ""),
+            "phase": r.status.get("phase", "Unknown"),
+            "version": r.status.get("version", ""),
+            "message": r.status.get("message", ""),
+            "syncedAt": r.status.get("syncedAt"),
+            "consumers": sorted(consumers.get((r.namespace, r.name), [])),
+        } for r in self.store.list(kind="SkillSource")]
+
+    def functions(self) -> list[dict]:
+        """Every pack function flattened (reference dashboard /functions
+        route): name, owning pack, schema summary."""
+        out = []
+        for p in self.store.list(kind="PromptPack"):
+            content = p.spec.get("content") or {}
+            for fn in content.get("functions", []) or []:
+                params = fn.get("parameters") or {}
+                out.append({
+                    "pack": p.name, "namespace": p.namespace,
+                    "packVersion": content.get("version", ""),
+                    "name": fn.get("name", ""),
+                    "description": fn.get("description", ""),
+                    "parameters": sorted((params.get("properties") or {})),
+                    "required": params.get("required", []),
+                    "packPhase": p.status.get("phase", "Unknown"),
+                })
+        return out
+
+    def memory_analytics(self, workspace: str) -> dict:
+        """Memory rollups by every aggregate axis the memory-api offers
+        (reference dashboard /memory-analytics route)."""
+        ws_q = f"workspace_id={urllib.parse.quote(workspace)}"
+        out: dict = {"workspace": workspace}
+        for axis in ("tier", "category", "agent", "day"):
+            status, doc = self._proxy(
+                self.memory_api_url, "/api/v1/memories/aggregate",
+                f"{ws_q}&groupBy={axis}",
+            )
+            out[f"by_{axis}"] = doc.get("groups", doc) if status == 200 else {
+                "error": doc.get("error", f"HTTP {status}")
+            }
+        status, doc = self._proxy(
+            self.memory_api_url, "/api/v1/memories", f"{ws_q}&limit=1")
+        out["available"] = status == 200
+        return out
+
+    def settings(self) -> dict:
+        """Deployment/config snapshot (reference dashboard /settings
+        route): auth posture, backing services, and the policy CRs that
+        govern behavior."""
+        policies = {}
+        for kind in ("AgentPolicy", "MemoryPolicy", "SessionRetentionPolicy",
+                     "ToolPolicy", "SessionPrivacyPolicy"):
+            policies[kind] = [{
+                "name": r.name, "namespace": r.namespace,
+                "phase": r.status.get("phase", ""),
+            } for r in self.store.list(kind=kind)]
+        return {
+            "auth": {
+                "loginRequired": self.auth_required(),
+                "writesEnabled": self.write_token is not None,
+                "consoleTokenMinting": self.mgmt_secret is not None,
+            },
+            "services": {
+                "sessionApi": bool(self.session_api_url),
+                "memoryApi": bool(self.memory_api_url),
+            },
+            "policies": policies,
+            "counts": {
+                kind: len(self.store.list(kind=kind))
+                for kind in ("AgentRuntime", "Provider", "PromptPack",
+                             "ToolRegistry", "Workspace")
+            },
+        }
 
     def topology(self) -> dict:
         """Resource graph (reference dashboard /topology route): nodes are
@@ -318,7 +492,8 @@ class DashboardServer:
     def handle(self, method: str, path: str, query: str = "",
                body: Optional[bytes] = None,
                headers: Optional[dict] = None):
-        """Returns (status, content_type, body_bytes)."""
+        """Returns (status, content_type, body_bytes[, extra_headers])."""
+        headers = headers or {}
         if path in ("/", "/index.html"):
             try:
                 with open(os.path.join(_STATIC_DIR, "index.html"), "rb") as f:
@@ -327,8 +502,29 @@ class DashboardServer:
                 return 500, "application/json", b'{"error": "asset missing"}'
         if path == "/healthz":
             return 200, "application/json", b'{"status": "ok"}'
+        if path == "/api/login":
+            if method != "POST":
+                return self._json(405, {"error": "POST only"})
+            return self._handle_login(body)
+        if path == "/api/logout":
+            if method != "POST":
+                return self._json(405, {"error": "POST only"})
+            return self._handle_logout()
+        if path == "/api/me":
+            return self._json(200, {
+                "authenticated": self._console_authenticated(headers),
+                "loginRequired": self.auth_required(),
+                "consoleTokenMinting": self.mgmt_secret is not None,
+            })
+        # Login (when configured) gates EVERY data route, not just the
+        # token mint — "login required" must mean the server enforces it,
+        # not that the SPA draws an overlay.
+        if self.auth_required() and not self._console_authenticated(headers):
+            return self._json(401, {"error": "login required"})
+        if path == "/api/console-token":
+            return self._handle_console_token(headers)
         if path == "/api/resources":
-            return self._handle_resources(method, query, body, headers or {})
+            return self._handle_resources(method, query, body, headers)
         if method != "GET":
             return 405, "application/json", b'{"error": "method not allowed"}'
         q = urllib.parse.parse_qs(query)
@@ -340,6 +536,9 @@ class DashboardServer:
             "/api/workspaces": lambda: {"workspaces": self.workspaces()},
             "/api/arena": lambda: {"jobs": self.arena()},
             "/api/sources": lambda: {"sources": self.sources()},
+            "/api/skills": lambda: {"skills": self.skills()},
+            "/api/functions": lambda: {"functions": self.functions()},
+            "/api/settings": self.settings,
             "/api/topology": self.topology,
             "/api/quality": self.quality,
         }
@@ -348,6 +547,9 @@ class DashboardServer:
         if path == "/api/costs":
             ws = (q.get("workspace") or [""])[0]
             return self._json(200, self.costs(ws))
+        if path == "/api/memory-analytics":
+            ws = (q.get("workspace") or ["default"])[0]
+            return self._json(200, self.memory_analytics(ws))
         if path == "/api/usage":
             status, doc = self._proxy_session_api("/api/v1/usage", query)
             return self._json(status, doc)
@@ -375,6 +577,70 @@ class DashboardServer:
             return self._json(status, doc)
         return 404, "application/json", b'{"error": "not found"}'
 
+    def _handle_login(self, body: Optional[bytes]):
+        """Exchange the dashboard token for an HttpOnly session cookie
+        (reference dashboard auth routes). Constant-time compare; no
+        cookie ever issued when auth is unconfigured (nothing to gate)."""
+        import hashlib as _hashlib
+        import hmac as _hmac
+
+        if not self.auth_required():
+            return self._json(200, {"authenticated": True,
+                                    "loginRequired": False})
+        if not self.write_token:
+            # mgmt secret configured but no login credential: everything
+            # stays locked rather than silently open.
+            return self._json(403, {
+                "error": "no login credential configured; "
+                         "set OMNIA_DASHBOARD_TOKEN"
+            })
+        try:
+            doc = json.loads(body or b"{}")
+            supplied = str(doc.get("token") or "") if isinstance(doc, dict) else ""
+        except json.JSONDecodeError:
+            return self._json(400, {"error": "bad login body"})
+        if not _hmac.compare_digest(
+            _hashlib.sha256(supplied.encode()).digest(),
+            _hashlib.sha256(self.write_token.encode()).digest(),
+        ):
+            return self._json(401, {"error": "invalid credentials"})
+        cookie = (
+            f"omnia_console={self._session_cookie()}; HttpOnly; "
+            f"SameSite=Strict; Path=/; Max-Age={int(self.CONSOLE_SESSION_TTL_S)}"
+        )
+        status, ctype, out = self._json(200, {"authenticated": True})
+        return status, ctype, out, {"Set-Cookie": cookie}
+
+    def _handle_logout(self):
+        """Server-side logout: the cookie is HttpOnly (JS cannot clear
+        it), so expiry must come from a Set-Cookie here."""
+        status, ctype, out = self._json(200, {"authenticated": False})
+        return status, ctype, out, {
+            "Set-Cookie": "omnia_console=; HttpOnly; SameSite=Strict; "
+                          "Path=/; Max-Age=0"
+        }
+
+    def _handle_console_token(self, headers: dict):
+        """Server-side mgmt-JWT mint for console WS connections (reference
+        dashboard/server.js:1-40): session-gated, short TTL, audience
+        "mgmt" so the facade's HmacValidator accepts it."""
+        if not self._console_authenticated(headers):
+            return self._json(401, {"error": "login required"})
+        if not self.mgmt_secret:
+            return self._json(503, {
+                "error": "console token minting disabled; set "
+                         "OMNIA_MGMT_SECRET on the operator and facades"
+            })
+        from omnia_tpu.facade.auth import HmacValidator
+
+        token = HmacValidator.mint(
+            self.mgmt_secret, subject="console-user", audience="mgmt",
+            ttl_s=self.CONSOLE_TOKEN_TTL_S,
+        )
+        return self._json(200, {
+            "token": token, "expires_in_s": self.CONSOLE_TOKEN_TTL_S,
+        })
+
     def _handle_resources(self, method: str, query: str,
                           body: Optional[bytes], headers: dict):
         """CRD passthrough (reference dashboard writes CRDs directly to
@@ -382,8 +648,6 @@ class DashboardServer:
         POST applies a manifest through admission, DELETE removes.
         Mutations require the write token — an unauthenticated write
         surface with open CORS would be drive-by cluster mutation."""
-        import hmac as _hmac
-
         from omnia_tpu.operator.resources import Resource
         from omnia_tpu.operator.validation import ValidationError
 
@@ -395,8 +659,7 @@ class DashboardServer:
             return self._json(403, {
                 "error": "resource writes disabled; set OMNIA_DASHBOARD_TOKEN"
             })
-        supplied = (headers.get("Authorization") or "").removeprefix("Bearer ")
-        if not _hmac.compare_digest(supplied, self.write_token):
+        if not self._bearer_is_write_token(headers):
             return self._json(401, {"error": "missing/invalid write token"})
         if method == "POST":
             try:
@@ -434,17 +697,22 @@ class DashboardServer:
                 length = int(self.headers.get("Content-Length") or 0)
                 if length:
                     body = self.rfile.read(length)
-                status, ctype, out = dash.handle(
+                result = dash.handle(
                     method, split.path, split.query, body,
                     dict(self.headers),
                 )
+                status, ctype, out = result[:3]
+                extra = result[3] if len(result) > 3 else {}
                 self.send_response(status)
                 self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(out)))
-                if method == "GET":
+                for k, v in extra.items():
+                    self.send_header(k, v)
+                if method == "GET" and split.path != "/api/console-token":
                     # The chat console opens WS connections to agent
-                    # facades on other ports. Mutations get NO CORS
-                    # grant (and require the write token besides).
+                    # facades on other ports. Mutations and the minted
+                    # WS credential get NO CORS grant (and the token
+                    # endpoint requires the session cookie besides).
                     self.send_header("Access-Control-Allow-Origin", "*")
                 self.end_headers()
                 self.wfile.write(out)

@@ -150,9 +150,26 @@ class InProcessPodBackend:
     backend."""
 
     def __init__(self) -> None:
+        import os
+
         self._counter = 0
         self._lock = threading.Lock()
         self._media = None
+        # Cluster analog: every facade pod gets OMNIA_MGMT_SECRET via
+        # secretKeyRef (K8sManifestBackend); in-process pods read it from
+        # the operator's own env so console-minted mgmt JWTs validate at
+        # the facade the same way in both topologies.
+        self._mgmt_secret = (os.environ.get("OMNIA_MGMT_SECRET") or "").encode() or None
+
+    def _auth_chain(self):
+        """Facade auth for in-process pods: audience-pinned HMAC when a
+        mgmt secret is configured (matching cli.py facade assembly), else
+        None (open dev pods, same as before)."""
+        if self._mgmt_secret is None:
+            return None
+        from omnia_tpu.facade.auth import AuthChain, HmacValidator
+
+        return AuthChain([HmacValidator(self._mgmt_secret, audience="mgmt")])
 
     def _media_store(self):
         """One shared LocalMediaStore per backend: all in-process pods see
@@ -214,6 +231,7 @@ class InProcessPodBackend:
             ),
             media_store=self._media_store(),
             workspace=dep.namespace,
+            auth_chain=self._auth_chain(),
         )
         facade_port = facade.serve()
         handle = PodHandle(
@@ -254,6 +272,13 @@ class K8sManifestBackend:
             {"name": "OMNIA_AGENT", "value": dep.name},
             {"name": "OMNIA_PROVIDER", "value": dep.default_provider},
             {"name": "OMNIA_SESSION_API_URL", "value": dep.session_api_url or ""},
+            # Facades validate mgmt-plane JWTs (console WS, in-cluster
+            # callers) with the shared secret; optional so clusters
+            # without the omnia-mgmt Secret still schedule (open facade,
+            # dev posture).
+            {"name": "OMNIA_MGMT_SECRET", "valueFrom": {"secretKeyRef": {
+                "name": "omnia-mgmt", "key": "secret", "optional": True,
+            }}},
         ]
         pod_spec = {
             "nodeSelector": overrides.get("nodeSelector", {}),

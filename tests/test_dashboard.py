@@ -284,7 +284,13 @@ class TestRouteFamilies:
                 headers=auth)
             with urllib.request.urlopen(req, timeout=10) as r:
                 assert r.status == 200
-            _s, doc = _get(dport, "/api/resources?kind=Provider")
+            # Reads are login-gated once a token is configured — the
+            # bearer token authenticates API clients.
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{dport}/api/resources?kind=Provider",
+                headers=auth)
+            with urllib.request.urlopen(req, timeout=10) as r:
+                doc = json.loads(r.read())
             assert any(r["metadata"]["name"] == "ui-prov"
                        for r in doc["resources"])
             # admission rejects invalid specs
@@ -303,7 +309,11 @@ class TestRouteFamilies:
                 headers=auth)
             with urllib.request.urlopen(req, timeout=10) as r:
                 assert r.status == 200
-            _s, doc = _get(dport, "/api/resources?kind=Provider")
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{dport}/api/resources?kind=Provider",
+                headers=auth)
+            with urllib.request.urlopen(req, timeout=10) as r:
+                doc = json.loads(r.read())
             assert not any(r["metadata"]["name"] == "ui-prov"
                            for r in doc["resources"])
         finally:
