@@ -65,9 +65,10 @@ SOURCE_TYPES = ("git", "oci", "configmap", "local")
 FACADE_TYPES = ("websocket", "a2a", "rest", "mcp")  # agentruntime_types.go:1408-1417
 AGENT_MODES = ("agent", "function")  # agentruntime_types.go:1356-1394
 # Reference enum :382-414 + the new tpu type; "tone" is the in-tree
-# model-free pcm16 speech codec standing in for the reference's remote
-# cartesia/elevenlabs speech types (provider_types.go:407-409).
-PROVIDER_TYPES = ("tpu", "mock", "tone")
+# model-free pcm16 speech test codec; cartesia/elevenlabs/openai are the
+# real HTTP speech vendors (provider_types.go:407-414,
+# runtime/speech_http.py) for tts/stt roles.
+PROVIDER_TYPES = ("tpu", "mock", "tone", "cartesia", "elevenlabs", "openai")
 # provider_types.go:40-63; image/inference validated for parity, served
 # when an on-device image/inference family lands.
 PROVIDER_ROLES = ("llm", "embedding", "tts", "stt", "image", "inference")

@@ -103,8 +103,8 @@ def _validate_provider(spec: dict, errs: list[str]) -> None:
     role_types = {
         "llm": ("tpu", "mock"),
         "embedding": ("tpu", "mock"),
-        "tts": ("tone", "mock"),
-        "stt": ("tone", "mock"),
+        "tts": ("tone", "mock", "cartesia", "elevenlabs", "openai"),
+        "stt": ("tone", "mock", "cartesia", "elevenlabs", "openai"),
         "image": (),
         "inference": ("tpu",),
     }

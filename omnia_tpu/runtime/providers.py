@@ -9,8 +9,12 @@ provider.go:93-135). Here the first-class citizens are:
   slice (the north-star addition — zero external LLM calls),
 - type "mock": scripted scenario playback (reference mock-provider analog),
 - type "tone": model-free pcm16 speech codec for tts/stt roles (the
-  zero-external-call stand-in for the reference's Cartesia/ElevenLabs
-  remote speech types, provider_types.go:407-409),
+  zero-external-call test codec),
+- types "cartesia" | "elevenlabs" | "openai": real HTTP speech vendors
+  for tts/stt roles, speaking each vendor's wire shape
+  (runtime/speech_http.py; reference provider_types.go:407-414 wires the
+  same vendors). `base_url` points them at a gateway or the in-tree
+  speechd for air-gapped clusters,
 
 with the same named-provider indirection so AgentRuntime specs bind by
 name. Roles (llm | embedding | tts | stt) mirror the reference's provider
@@ -36,7 +40,7 @@ class ProviderError(ValueError):
 @dataclasses.dataclass(frozen=True)
 class ProviderSpec:
     name: str
-    type: str = "tpu"              # tpu | mock | tone (speech roles)
+    type: str = "tpu"  # tpu | mock | tone | cartesia | elevenlabs | openai
     role: str = "llm"              # llm | embedding | tts | stt
     model: str = "llama3-8b"       # ModelConfig preset name
     # Engine placement/shape options (forwarded to EngineConfig).
@@ -109,11 +113,25 @@ def build_engine(spec: ProviderSpec, *, warmup: bool = False):
     raise ProviderError(f"unknown provider type {spec.type!r}")
 
 
+SPEECH_VENDOR_TYPES = ("cartesia", "elevenlabs", "openai")
+
+
 def build_speech_provider(spec: ProviderSpec):
     """Instantiate the STT/TTS backend for a speech-role provider
     (reference provider_spec.go maps role→SDK option the same way)."""
     from omnia_tpu.runtime import duplex
 
+    if spec.type in SPEECH_VENDOR_TYPES:
+        from omnia_tpu.runtime.speech_http import HttpStt, HttpTts
+
+        if spec.role == "stt":
+            return HttpStt(spec.type, spec.options)
+        if spec.role == "tts":
+            return HttpTts(spec.type, spec.options)
+        raise ProviderError(
+            f"provider {spec.name!r}: vendor type {spec.type!r} serves "
+            f"tts/stt roles only, not {spec.role!r}"
+        )
     table = {
         ("stt", "mock"): duplex.MockStt,
         ("tts", "mock"): duplex.MockTts,
@@ -124,7 +142,8 @@ def build_speech_provider(spec: ProviderSpec):
     if maker is None:
         raise ProviderError(
             f"provider {spec.name!r}: no {spec.role} backend of type "
-            f"{spec.type!r} (have mock, tone)"
+            f"{spec.type!r} (have mock, tone, "
+            f"{', '.join(SPEECH_VENDOR_TYPES)})"
         )
     return maker()
 

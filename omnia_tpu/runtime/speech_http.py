@@ -1,0 +1,220 @@
+"""HTTP speech-vendor clients (Provider types cartesia | elevenlabs |
+openai for tts/stt roles).
+
+Reference parity: the reference wires remote speech vendors as Provider
+types (api/v1alpha1/agentruntime_types.go:387-414 — cartesia,
+elevenlabs) and resolves them into the duplex session's speech pair.
+These clients speak each vendor's actual wire shape:
+
+- cartesia    TTS POST /tts/bytes (JSON, raw-pcm response)
+              STT POST /stt (multipart)             [X-API-Key]
+- elevenlabs  TTS POST /v1/text-to-speech/{voice}?output_format=pcm_16000
+              STT POST /v1/speech-to-text (multipart)   [xi-api-key]
+- openai      TTS POST /v1/audio/speech (JSON, pcm response)
+              STT POST /v1/audio/transcriptions (multipart)  [Bearer]
+
+Keys normally come from the environment (``api_key_env`` option, with
+the vendor's conventional variable as default), mirroring the
+reference's secretRef discipline. ``options.api_key`` exists for
+non-secret dev credentials only (the hermetic speechd example uses
+``api_key: dev``) — real vendor keys belong in env/Secrets, never in a
+CR the store persists in plaintext. ``base_url`` overrides
+the vendor endpoint (self-hosted gateways, the hermetic dev speechd,
+tests). TTS streams the HTTP response body in chunks so playback starts
+before synthesis finishes; both calls honor the duplex format dict
+(sample_rate_hz rides into each vendor's encoding parameter).
+
+The in-tree dev server (``runtime/speechd.py``) implements the cartesia
+shape over the tone codec, so the full vendor path runs with zero
+external calls.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import urllib.error
+import urllib.request
+import uuid
+from typing import Iterator, Optional
+
+from omnia_tpu.runtime.duplex import SttProvider, TtsProvider
+
+_CHUNK = 8192
+_TIMEOUT_S = 30.0
+
+VENDOR_DEFAULTS = {
+    "cartesia": {
+        "base_url": "https://api.cartesia.ai",
+        "api_key_env": "CARTESIA_API_KEY",
+        "tts_model": "sonic-2",
+        "stt_model": "ink-whisper",
+        "voice": "default",
+    },
+    "elevenlabs": {
+        "base_url": "https://api.elevenlabs.io",
+        "api_key_env": "ELEVENLABS_API_KEY",
+        "tts_model": "eleven_flash_v2_5",
+        "stt_model": "scribe_v1",
+        "voice": "21m00Tcm4TlvDq8ikWAM",
+    },
+    "openai": {
+        "base_url": "https://api.openai.com",
+        "api_key_env": "OPENAI_API_KEY",
+        "tts_model": "tts-1",
+        "stt_model": "whisper-1",
+        "voice": "alloy",
+    },
+}
+
+
+class SpeechVendorError(RuntimeError):
+    """A vendor call failed; the duplex session surfaces it as a turn
+    error rather than killing the stream."""
+
+
+def _opt(options: dict, vendor: str, key: str) -> str:
+    return str(options.get(key) or VENDOR_DEFAULTS[vendor][key])
+
+
+def _api_key(options: dict, vendor: str) -> str:
+    direct = options.get("api_key")
+    if direct:
+        return str(direct)
+    env = _opt(options, vendor, "api_key_env")
+    key = os.environ.get(env, "")
+    if not key:
+        raise SpeechVendorError(
+            f"{vendor}: no API key (set ${env} or options.api_key)"
+        )
+    return key
+
+
+def _multipart(fields: dict[str, str], file_name: str, file_bytes: bytes,
+               file_content_type: str) -> tuple[bytes, str]:
+    """Stdlib multipart/form-data encoder (no requests in the image)."""
+    boundary = uuid.uuid4().hex
+    out = bytearray()
+    for k, v in fields.items():
+        out += (f"--{boundary}\r\nContent-Disposition: form-data; "
+                f'name="{k}"\r\n\r\n{v}\r\n').encode()
+    out += (f"--{boundary}\r\nContent-Disposition: form-data; "
+            f'name="file"; filename="{file_name}"\r\n'
+            f"Content-Type: {file_content_type}\r\n\r\n").encode()
+    out += file_bytes
+    out += f"\r\n--{boundary}--\r\n".encode()
+    return bytes(out), f"multipart/form-data; boundary={boundary}"
+
+
+def _request(url: str, headers: dict, body: bytes,
+             content_type: str) -> "urllib.request.Request":
+    req = urllib.request.Request(url, data=body, method="POST")
+    req.add_header("Content-Type", content_type)
+    for k, v in headers.items():
+        req.add_header(k, v)
+    return req
+
+
+def _open(req, vendor: str):
+    try:
+        return urllib.request.urlopen(req, timeout=_TIMEOUT_S)
+    except urllib.error.HTTPError as e:
+        detail = e.read()[:200].decode(errors="replace")
+        raise SpeechVendorError(f"{vendor}: HTTP {e.code}: {detail}") from e
+    except (urllib.error.URLError, OSError) as e:
+        raise SpeechVendorError(f"{vendor}: unreachable: {e}") from e
+
+
+class HttpTts(TtsProvider):
+    """Vendor-shaped TTS: one POST per utterance, response streamed."""
+
+    def __init__(self, vendor: str, options: Optional[dict] = None):
+        if vendor not in VENDOR_DEFAULTS:
+            raise ValueError(f"unknown speech vendor {vendor!r}")
+        self.vendor = vendor
+        self.options = dict(options or {})
+
+    def _build(self, text: str, rate: int):
+        v, o = self.vendor, self.options
+        base = _opt(o, v, "base_url").rstrip("/")
+        model = _opt(o, v, "tts_model")
+        voice = _opt(o, v, "voice")
+        key = _api_key(o, v)
+        if v == "cartesia":
+            body = json.dumps({
+                "model_id": model,
+                "transcript": text,
+                "voice": {"mode": "id", "id": voice},
+                "output_format": {"container": "raw",
+                                  "encoding": "pcm_s16le",
+                                  "sample_rate": rate},
+            }).encode()
+            return _request(
+                f"{base}/tts/bytes",
+                {"X-API-Key": key, "Cartesia-Version": "2024-06-10"},
+                body, "application/json")
+        if v == "elevenlabs":
+            body = json.dumps({"text": text, "model_id": model}).encode()
+            return _request(
+                f"{base}/v1/text-to-speech/{voice}?output_format=pcm_{rate}",
+                {"xi-api-key": key}, body, "application/json")
+        body = json.dumps({  # openai
+            "model": model, "input": text, "voice": voice,
+            "response_format": "pcm",
+        }).encode()
+        return _request(f"{base}/v1/audio/speech",
+                        {"Authorization": f"Bearer {key}"},
+                        body, "application/json")
+
+    def synthesize(self, text: str, fmt: dict) -> Iterator[bytes]:
+        rate = int(fmt.get("sample_rate_hz", 16000))
+        req = self._build(text, rate)
+        with _open(req, self.vendor) as resp:
+            while True:
+                chunk = resp.read(_CHUNK)
+                if not chunk:
+                    return
+                yield chunk
+
+
+class HttpStt(SttProvider):
+    """Vendor-shaped STT: multipart upload → {"text": ...}."""
+
+    def __init__(self, vendor: str, options: Optional[dict] = None):
+        if vendor not in VENDOR_DEFAULTS:
+            raise ValueError(f"unknown speech vendor {vendor!r}")
+        self.vendor = vendor
+        self.options = dict(options or {})
+
+    def transcribe(self, audio: bytes, fmt: dict) -> str:
+        v, o = self.vendor, self.options
+        base = _opt(o, v, "base_url").rstrip("/")
+        model = _opt(o, v, "stt_model")
+        key = _api_key(o, v)
+        rate = int(fmt.get("sample_rate_hz", 16000))
+        if v == "cartesia":
+            body, ctype = _multipart(
+                {"model_id": model, "encoding": "pcm_s16le",
+                 "sample_rate": str(rate)},
+                "audio.raw", audio, "application/octet-stream")
+            req = _request(
+                f"{base}/stt",
+                {"X-API-Key": key, "Cartesia-Version": "2024-06-10"},
+                body, ctype)
+        elif v == "elevenlabs":
+            body, ctype = _multipart(
+                {"model_id": model}, "audio.raw", audio,
+                "application/octet-stream")
+            req = _request(f"{base}/v1/speech-to-text",
+                           {"xi-api-key": key}, body, ctype)
+        else:  # openai
+            body, ctype = _multipart(
+                {"model": model}, "audio.wav", audio, "audio/wav")
+            req = _request(f"{base}/v1/audio/transcriptions",
+                           {"Authorization": f"Bearer {key}"}, body, ctype)
+        with _open(req, self.vendor) as resp:
+            doc = json.loads(resp.read())
+        text = doc.get("text")
+        if text is None:
+            raise SpeechVendorError(f"{v}: no 'text' in STT response")
+        return str(text)

@@ -1,0 +1,162 @@
+"""Dev speech server: the cartesia wire shape served locally.
+
+Purpose: the HTTP speech-vendor path (runtime/speech_http.py) needs a
+server to talk to, and this environment (like any hermetic CI) has no
+egress. speechd implements the cartesia endpoints —
+
+  POST /tts/bytes   JSON {model_id, transcript, voice, output_format}
+                    → raw pcm16 body
+  POST /stt         multipart (model_id, encoding, sample_rate, file)
+                    → {"text": ...}
+  GET  /healthz
+
+— backed by the in-tree tone codec (runtime/duplex.py TonePcm*), so a
+Provider declared `type: cartesia` with `base_url` pointed here runs the
+FULL vendor client path (auth header, JSON/multipart encoding, streamed
+pcm response) with zero external calls. The reference ships no analog
+because its speech vendors are always remote; a TPU pod in an air-gapped
+cluster needs the local option.
+
+Auth: requests must carry X-API-Key matching --api-key (default "dev").
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+
+class SpeechDevServer:
+    def __init__(self, api_key: str = "dev") -> None:
+        import collections
+
+        self.api_key = api_key
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self.port: Optional[int] = None
+        # Observed calls for test introspection — bounded so the
+        # long-running omnia-speechd binary doesn't grow without limit.
+        self.requests: "collections.deque[dict]" = collections.deque(maxlen=256)
+
+    # -- handlers -------------------------------------------------------
+
+    def _tts(self, doc: dict) -> tuple[int, bytes, str]:
+        from omnia_tpu.runtime.duplex import TonePcmTts
+
+        text = doc.get("transcript") or ""
+        fmt = {"sample_rate_hz": (doc.get("output_format") or {}).get(
+            "sample_rate", 16000)}
+        audio = b"".join(TonePcmTts().synthesize(text, fmt))
+        return 200, audio, "application/octet-stream"
+
+    def _stt(self, body: bytes, content_type: str) -> tuple[int, bytes, str]:
+        from omnia_tpu.runtime.duplex import TonePcmStt
+
+        m = re.search(r"boundary=([^\s;]+)", content_type or "")
+        if not m:
+            return 400, b'{"error": "expected multipart"}', "application/json"
+        boundary = m.group(1).encode()
+        fields: dict[str, bytes] = {}
+        for part in body.split(b"--" + boundary)[1:-1]:
+            head, _, payload = part.partition(b"\r\n\r\n")
+            name = re.search(rb'name="([^"]+)"', head)
+            if name:
+                # Exactly ONE trailing CRLF is the part separator; a
+                # broader rstrip would eat legitimate 0x0a/0x0d audio
+                # bytes at the end of the payload.
+                if payload.endswith(b"\r\n"):
+                    payload = payload[:-2]
+                fields[name.group(1).decode()] = payload
+        audio = fields.get("file", b"")
+        rate = int(fields.get("sample_rate", b"16000") or b"16000")
+        text = TonePcmStt().transcribe(audio, {"sample_rate_hz": rate})
+        return 200, json.dumps({"text": text}).encode(), "application/json"
+
+    # -- lifecycle ------------------------------------------------------
+
+    def serve(self, host: str = "127.0.0.1", port: int = 0) -> int:
+        srv = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def _reply(self, status: int, body: bytes, ctype: str):
+                self.send_response(status)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                if self.path == "/healthz":
+                    self._reply(200, b'{"status": "ok"}', "application/json")
+                else:
+                    self._reply(404, b'{"error": "not found"}',
+                                "application/json")
+
+            def do_POST(self):
+                if self.headers.get("X-API-Key") != srv.api_key:
+                    self._reply(401, b'{"error": "bad api key"}',
+                                "application/json")
+                    return
+                length = int(self.headers.get("Content-Length") or 0)
+                body = self.rfile.read(length)
+                srv.requests.append({
+                    "path": self.path,
+                    # Headers minus credentials: the log is for test
+                    # introspection, not a place to retain key material.
+                    "headers": {k: v for k, v in self.headers.items()
+                                if k.lower() != "x-api-key"},
+                })
+                try:
+                    if self.path == "/tts/bytes":
+                        status, out, ctype = srv._tts(json.loads(body or b"{}"))
+                    elif self.path == "/stt":
+                        status, out, ctype = srv._stt(
+                            body, self.headers.get("Content-Type", ""))
+                    else:
+                        status, out, ctype = (404, b'{"error": "not found"}',
+                                              "application/json")
+                except Exception as e:  # noqa: BLE001 - bad input → 400
+                    status, ctype = 400, "application/json"
+                    out = json.dumps({"error": f"bad request: {e}"}).encode()
+                self._reply(status, out, ctype)
+
+            def log_message(self, *a):  # pragma: no cover - quiet
+                pass
+
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self.port = self._httpd.server_address[1]
+        threading.Thread(target=self._httpd.serve_forever,
+                         name="omnia-speechd", daemon=True).start()
+        return self.port
+
+    def shutdown(self) -> None:
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None
+
+
+def main(argv=None) -> int:
+    import argparse
+    import signal
+
+    ap = argparse.ArgumentParser(
+        description="omnia dev speech server (cartesia wire shape, "
+                    "tone-codec backend)")
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, default=8077)
+    ap.add_argument("--api-key", default="dev")
+    args = ap.parse_args(argv)
+    srv = SpeechDevServer(api_key=args.api_key)
+    port = srv.serve(args.host, args.port)
+    print(f"omnia-speechd on {args.host}:{port}", flush=True)
+    stop = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *_a: stop.set())
+    try:
+        stop.wait()
+    except KeyboardInterrupt:
+        pass
+    srv.shutdown()
+    return 0

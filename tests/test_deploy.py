@@ -195,7 +195,7 @@ class TestMultiHostManifests:
         assert sts["spec"]["serviceName"] == "agent-llama70b-hosts"
         runtime = next(c for c in sts["spec"]["template"]["spec"]["containers"]
                        if c["name"] == "runtime")
-        env = {e["name"]: e["value"] for e in runtime["env"]}
+        env = {e["name"]: e.get("value") for e in runtime["env"]}
         assert env["OMNIA_NUM_PROCESSES"] == "4"
         assert env["OMNIA_COORDINATOR_ADDR"] == (
             "agent-llama70b-0.agent-llama70b-hosts.prod.svc:8476")
@@ -403,12 +403,22 @@ class TestExamples:
         from omnia_tpu.operator.store import MemoryResourceStore
         from omnia_tpu.runtime.duplex import TonePcmStt, TonePcmTts
 
+        from omnia_tpu.runtime.speechd import SpeechDevServer
+
         store = MemoryResourceStore()
         mgr = ControllerManager(store)
         fmt = {"encoding": "pcm16", "sample_rate_hz": 16000, "channels": 1}
+        # The example declares REAL vendor-type (cartesia) speech
+        # providers pointed at the dev speech server; the test runs one
+        # on an ephemeral port and rewrites only base_url.
+        speechd = SpeechDevServer(api_key="dev")
+        sport = speechd.serve()
         try:
             with open(os.path.join(REPO, "examples/voice-agent/agent.yaml")) as f:
                 for doc in yaml.safe_load_all(f):
+                    opts = (doc.get("spec") or {}).get("options") or {}
+                    if "base_url" in opts:
+                        opts["base_url"] = f"http://127.0.0.1:{sport}"
                     store.apply(Resource.from_manifest(doc))
             mgr.drain_queue()
             dep = next(iter(mgr.deployments.values()))
@@ -434,8 +444,13 @@ class TestExamples:
                     TonePcmStt().transcribe(bytes(audio), fmt)
                     == "refunds take thirty days to process"
                 )
+            # The vendor path really was exercised: the dev server saw
+            # authenticated cartesia-shaped STT + TTS calls.
+            paths = {r["path"] for r in speechd.requests}
+            assert paths == {"/stt", "/tts/bytes"}, paths
         finally:
             mgr.shutdown()
+            speechd.shutdown()
 
 
 class TestEntryPointWiring:

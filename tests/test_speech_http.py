@@ -1,0 +1,218 @@
+"""HTTP speech-vendor clients (VERDICT r3 #3): each vendor's wire shape
+is pinned against a recording server, the key discipline is enforced,
+and the full duplex path runs through the cartesia client against the
+in-tree dev speech server (reference provider_types.go:407-414)."""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from omnia_tpu.runtime.speech_http import (
+    HttpStt,
+    HttpTts,
+    SpeechVendorError,
+    VENDOR_DEFAULTS,
+)
+
+FMT = {"encoding": "pcm16", "sample_rate_hz": 16000, "channels": 1}
+REPO_DIR = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture()
+def vendor_server():
+    """Recording HTTP server: returns canned bodies, keeps every request."""
+    seen = []
+
+    class Handler(BaseHTTPRequestHandler):
+        def do_POST(self):
+            length = int(self.headers.get("Content-Length") or 0)
+            body = self.rfile.read(length)
+            seen.append({"path": self.path,
+                         "headers": {k.lower(): v for k, v in
+                                     self.headers.items()},
+                         "body": body})
+            if "transcription" in self.path or "speech-to-text" in self.path \
+                    or self.path == "/stt":
+                out, ctype = json.dumps({"text": "hello there"}).encode(), \
+                    "application/json"
+            else:
+                out, ctype = b"\x01\x02" * 6000, "application/octet-stream"
+            self.send_response(200)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(out)))
+            self.end_headers()
+            self.wfile.write(out)
+
+        def log_message(self, *a):
+            pass
+
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    yield f"http://127.0.0.1:{httpd.server_address[1]}", seen
+    httpd.shutdown()
+    httpd.server_close()
+
+
+def test_cartesia_wire_shape(vendor_server):
+    base, seen = vendor_server
+    opts = {"base_url": base, "api_key": "k1", "voice": "v9"}
+    chunks = list(HttpTts("cartesia", opts).synthesize("hi", FMT))
+    assert b"".join(chunks) and len(chunks) > 1  # streamed, not one slab
+    tts = seen[-1]
+    assert tts["path"] == "/tts/bytes"
+    assert tts["headers"]["x-api-key"] == "k1"
+    assert tts["headers"]["cartesia-version"]
+    body = json.loads(tts["body"])
+    assert body["transcript"] == "hi" and body["voice"]["id"] == "v9"
+    assert body["output_format"] == {"container": "raw",
+                                     "encoding": "pcm_s16le",
+                                     "sample_rate": 16000}
+
+    text = HttpStt("cartesia", opts).transcribe(b"\x00\x01audio", FMT)
+    assert text == "hello there"
+    stt = seen[-1]
+    assert stt["path"] == "/stt"
+    assert b'name="file"' in stt["body"] and b"\x00\x01audio" in stt["body"]
+    assert b'name="model_id"' in stt["body"]
+
+
+def test_elevenlabs_wire_shape(vendor_server):
+    base, seen = vendor_server
+    opts = {"base_url": base, "api_key": "k2", "voice": "vox"}
+    b"".join(HttpTts("elevenlabs", opts).synthesize("yo", FMT))
+    tts = seen[-1]
+    assert tts["path"] == "/v1/text-to-speech/vox?output_format=pcm_16000"
+    assert tts["headers"]["xi-api-key"] == "k2"
+    assert json.loads(tts["body"])["text"] == "yo"
+
+    assert HttpStt("elevenlabs", opts).transcribe(b"aud", FMT) == "hello there"
+    assert seen[-1]["path"] == "/v1/speech-to-text"
+
+
+def test_openai_wire_shape(vendor_server):
+    base, seen = vendor_server
+    opts = {"base_url": base, "api_key": "k3"}
+    b"".join(HttpTts("openai", opts).synthesize("hey", FMT))
+    tts = seen[-1]
+    assert tts["path"] == "/v1/audio/speech"
+    assert tts["headers"]["authorization"] == "Bearer k3"
+    body = json.loads(tts["body"])
+    assert body["input"] == "hey" and body["response_format"] == "pcm"
+
+    assert HttpStt("openai", opts).transcribe(b"aud", FMT) == "hello there"
+    assert seen[-1]["path"] == "/v1/audio/transcriptions"
+
+
+def test_api_key_comes_from_env_never_defaults_open(monkeypatch):
+    """No key configured → an explicit error naming the env var; key in
+    the vendor's conventional env var is picked up (secretRef
+    discipline: the CR carries no secret)."""
+    monkeypatch.delenv("CARTESIA_API_KEY", raising=False)
+    with pytest.raises(SpeechVendorError, match="CARTESIA_API_KEY"):
+        list(HttpTts("cartesia", {"base_url": "http://127.0.0.1:1"})
+             .synthesize("x", FMT))
+    monkeypatch.setenv("CARTESIA_API_KEY", "env-key")
+    # Key resolves; the call then fails on the unreachable endpoint, not
+    # on the key.
+    with pytest.raises(SpeechVendorError, match="unreachable"):
+        list(HttpTts("cartesia", {"base_url": "http://127.0.0.1:1"})
+             .synthesize("x", FMT))
+
+
+def test_http_errors_map_to_vendor_error(vendor_server):
+    base, _seen = vendor_server
+    with pytest.raises(ValueError, match="unknown speech vendor"):
+        HttpTts("acme", {})
+    bad = HttpStt("cartesia", {"base_url": "http://127.0.0.1:9", "api_key": "k"})
+    with pytest.raises(SpeechVendorError, match="unreachable"):
+        bad.transcribe(b"x", FMT)
+
+
+def test_registry_resolves_vendor_speech_pair():
+    """build_speech_support wires vendor-typed tts/stt providers into the
+    duplex speech pair; vendor types refuse non-speech roles."""
+    from omnia_tpu.runtime.providers import (
+        ProviderError,
+        ProviderRegistry,
+        ProviderSpec,
+        build_speech_provider,
+        build_speech_support,
+    )
+
+    reg = ProviderRegistry()
+    reg.register(ProviderSpec(name="ears", type="elevenlabs", role="stt",
+                              options={"api_key": "k"}))
+    reg.register(ProviderSpec(name="voice", type="cartesia", role="tts",
+                              options={"api_key": "k"}))
+    support = build_speech_support(reg)
+    assert isinstance(support.stt, HttpStt) and support.stt.vendor == "elevenlabs"
+    assert isinstance(support.tts, HttpTts) and support.tts.vendor == "cartesia"
+    with pytest.raises(ProviderError, match="tts/stt roles only"):
+        build_speech_provider(ProviderSpec(name="x", type="openai", role="llm"))
+
+
+def test_speechd_round_trip_through_vendor_client():
+    """Hermetic full path: cartesia client → dev speech server (tone
+    backend) → audio → back to text. Auth is enforced on the wire."""
+    from omnia_tpu.runtime.speechd import SpeechDevServer
+
+    srv = SpeechDevServer(api_key="sesame")
+    port = srv.serve()
+    base = f"http://127.0.0.1:{port}"
+    try:
+        with pytest.raises(SpeechVendorError, match="HTTP 401"):
+            list(HttpTts("cartesia", {"base_url": base, "api_key": "wrong"})
+                 .synthesize("x", FMT))
+        opts = {"base_url": base, "api_key": "sesame"}
+        audio = b"".join(HttpTts("cartesia", opts)
+                         .synthesize("round trip works", FMT))
+        assert len(audio) > 1000  # real pcm16, not text passthrough
+        text = HttpStt("cartesia", opts).transcribe(audio, FMT)
+        assert text == "round trip works"
+    finally:
+        srv.shutdown()
+
+
+def test_speechd_main_wiring(tmp_path):
+    """omnia-speechd entry point boots from argv, serves /healthz, and
+    dies on SIGTERM (check-wiring-tests.sh discipline)."""
+    import signal
+    import socket
+    import subprocess
+    import sys
+    import time
+    import urllib.request
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    proc = subprocess.Popen(
+        [sys.executable, "-c",
+         "from omnia_tpu.runtime.speechd import main; "
+         f"raise SystemExit(main(['--port', '{port}']))"],
+        cwd=REPO_DIR, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+    )
+    try:
+        deadline = time.monotonic() + 30
+        ok = False
+        while time.monotonic() < deadline:
+            try:
+                with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/healthz", timeout=2
+                ) as r:
+                    ok = r.status == 200
+                    break
+            except OSError:
+                time.sleep(0.2)
+        assert ok, "speechd never answered /healthz"
+        proc.send_signal(signal.SIGTERM)
+        assert proc.wait(timeout=15) == 0
+    finally:
+        if proc.poll() is None:
+            proc.kill()
