@@ -230,6 +230,7 @@ def runtime_main() -> int:
         pack=pack, providers=registry, provider_name=provider_name,
         context_store=store, tool_executor=executor,
         media_store=_media_store(),
+        workspace=_env("OMNIA_WORKSPACE", "default"),
     )
     port = server.serve(f"0.0.0.0:{_env('OMNIA_GRPC_PORT', '9000')}")
     logger.info("runtime serving gRPC on :%d", port)

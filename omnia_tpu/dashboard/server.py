@@ -113,22 +113,24 @@ class DashboardServer:
             ttl_s=self.CONSOLE_SESSION_TTL_S,
         )
 
-    def _bearer_is_write_token(self, headers: dict) -> bool:
+    def _token_matches(self, supplied) -> bool:
         """Constant-time dashboard-token check (sha256 digests so that
         non-ASCII or non-string input can never raise out of
-        hmac.compare_digest — the SharedTokenValidator discipline)."""
+        hmac.compare_digest — the SharedTokenValidator discipline). THE
+        single compare for both login bodies and bearer headers."""
         import hashlib as _hashlib
         import hmac as _hmac
 
-        if not self.write_token:
-            return False
-        bearer = (headers.get("Authorization") or "").removeprefix("Bearer ")
-        if not bearer:
+        if not self.write_token or not supplied:
             return False
         return _hmac.compare_digest(
-            _hashlib.sha256(str(bearer).encode()).digest(),
+            _hashlib.sha256(str(supplied).encode()).digest(),
             _hashlib.sha256(self.write_token.encode()).digest(),
         )
+
+    def _bearer_is_write_token(self, headers: dict) -> bool:
+        bearer = (headers.get("Authorization") or "").removeprefix("Bearer ")
+        return self._token_matches(bearer)
 
     def _console_authenticated(self, headers: dict) -> bool:
         """True when the request carries a valid console session cookie or
@@ -287,17 +289,23 @@ class DashboardServer:
         (reference dashboard /memory-analytics route)."""
         ws_q = f"workspace_id={urllib.parse.quote(workspace)}"
         out: dict = {"workspace": workspace}
-        for axis in ("tier", "category", "agent", "day"):
-            status, doc = self._proxy(
+        axes = ("tier", "category", "agent", "day")
+
+        def one(axis):
+            return axis, self._proxy(
                 self.memory_api_url, "/api/v1/memories/aggregate",
                 f"{ws_q}&groupBy={axis}",
             )
-            out[f"by_{axis}"] = doc.get("groups", doc) if status == 200 else {
-                "error": doc.get("error", f"HTTP {status}")
-            }
-        status, doc = self._proxy(
-            self.memory_api_url, "/api/v1/memories", f"{ws_q}&limit=1")
-        out["available"] = status == 200
+
+        statuses = []
+        with concurrent.futures.ThreadPoolExecutor(len(axes)) as ex:
+            for axis, (status, doc) in ex.map(one, axes):
+                statuses.append(status)
+                out[f"by_{axis}"] = (
+                    doc.get("groups", doc) if status == 200
+                    else {"error": doc.get("error", f"HTTP {status}")}
+                )
+        out["available"] = any(s == 200 for s in statuses)
         return out
 
     def settings(self) -> dict:
@@ -581,9 +589,6 @@ class DashboardServer:
         """Exchange the dashboard token for an HttpOnly session cookie
         (reference dashboard auth routes). Constant-time compare; no
         cookie ever issued when auth is unconfigured (nothing to gate)."""
-        import hashlib as _hashlib
-        import hmac as _hmac
-
         if not self.auth_required():
             return self._json(200, {"authenticated": True,
                                     "loginRequired": False})
@@ -596,13 +601,10 @@ class DashboardServer:
             })
         try:
             doc = json.loads(body or b"{}")
-            supplied = str(doc.get("token") or "") if isinstance(doc, dict) else ""
+            supplied = doc.get("token") if isinstance(doc, dict) else None
         except json.JSONDecodeError:
             return self._json(400, {"error": "bad login body"})
-        if not _hmac.compare_digest(
-            _hashlib.sha256(supplied.encode()).digest(),
-            _hashlib.sha256(self.write_token.encode()).digest(),
-        ):
+        if not self._token_matches(supplied):
             return self._json(401, {"error": "invalid credentials"})
         cookie = (
             f"omnia_console={self._session_cookie()}; HttpOnly; "

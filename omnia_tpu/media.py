@@ -90,6 +90,17 @@ class MediaStore:
             raise MediaError(f"upload exceeds {MAX_UPLOAD_BYTES} bytes")
         self._write(*self._parse_ref(ref), data)
 
+    def store_generated(self, workspace: str, data: bytes) -> str:
+        """Server-side write for RUNTIME-generated media (image-role
+        providers, runtime/images.py): no upload grant — the producer is
+        the trusted process itself, not a client — but the same size cap
+        and ref vocabulary as uploads. Returns the storage_ref."""
+        if len(data) > MAX_UPLOAD_BYTES:
+            raise MediaError(f"generated media exceeds {MAX_UPLOAD_BYTES} bytes")
+        media_id = uuid.uuid4().hex
+        self._write(workspace, media_id, data)
+        return f"media://{workspace}/{media_id}"
+
     def resolve(self, ref: str) -> bytes:
         """storage_ref → bytes (the runtime's provider-call-time hop)."""
         data = self._read(*self._parse_ref(ref))

@@ -215,6 +215,7 @@ class InProcessPodBackend:
             provider_name=dep.default_provider,
             tool_executor=ToolExecutor(handlers=_build_tool_handlers(dep.tool_configs)),
             media_store=self._media_store(),
+            workspace=dep.namespace,
         )
         runtime_port = runtime.serve(wait_ready=wait_ready)
         facade = FacadeServer(

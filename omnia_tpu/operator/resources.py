@@ -68,7 +68,10 @@ AGENT_MODES = ("agent", "function")  # agentruntime_types.go:1356-1394
 # model-free pcm16 speech test codec; cartesia/elevenlabs/openai are the
 # real HTTP speech vendors (provider_types.go:407-414,
 # runtime/speech_http.py) for tts/stt roles.
-PROVIDER_TYPES = ("tpu", "mock", "tone", "cartesia", "elevenlabs", "openai")
+# "procedural" is the in-tree model-free image generator
+# (runtime/images.py — the image analog of the tone speech codec).
+PROVIDER_TYPES = ("tpu", "mock", "tone", "cartesia", "elevenlabs", "openai",
+                  "procedural")
 # provider_types.go:40-63; image/inference validated for parity, served
 # when an on-device image/inference family lands.
 PROVIDER_ROLES = ("llm", "embedding", "tts", "stt", "image", "inference")

@@ -105,7 +105,7 @@ def _validate_provider(spec: dict, errs: list[str]) -> None:
         "embedding": ("tpu", "mock"),
         "tts": ("tone", "mock", "cartesia", "elevenlabs", "openai"),
         "stt": ("tone", "mock", "cartesia", "elevenlabs", "openai"),
-        "image": (),
+        "image": ("procedural", "openai"),
         "inference": ("tpu",),
     }
     if role in role_types and t in PROVIDER_TYPES and t not in role_types[role]:

@@ -1,0 +1,142 @@
+"""Image-role providers: generation → media store → storage_ref.
+
+Reference parity: the reference wires image generation as a Provider
+role served by remote vendors (api/v1alpha1/agentruntime_types.go:
+387-414 imagen type) and lands outputs in the media pipeline
+(internal/media/builder.go). Here the role is served by:
+
+- type "procedural": an in-tree model-free generator (the image analog
+  of the tone speech codec) — deterministic smooth value-noise fields
+  seeded by the prompt, emitted as REAL PNG bytes via a minimal stdlib
+  encoder. Zero external calls; tests and air-gapped clusters get an
+  actual image pipeline, not a stub.
+- type "openai": the real images API (POST /v1/images/generations,
+  b64_json response), same key/base_url discipline as the speech
+  vendors (runtime/speech_http.py).
+
+The runtime exposes a declared image provider as the built-in
+`generate_image` tool (runtime/server.py): the model calls it, the
+provider renders, the bytes land in the media store, and the tool
+result carries the storage_ref — the reply references media exactly
+like uploaded media does.
+"""
+
+from __future__ import annotations
+
+import base64
+import hashlib
+import json
+import struct
+import zlib
+from typing import Optional
+
+from omnia_tpu.runtime.speech_http import (
+    SpeechVendorError,
+    _api_key,
+    _open,
+    _request,
+)
+
+_OPENAI_DEFAULTS = {
+    "base_url": "https://api.openai.com",
+    "api_key_env": "OPENAI_API_KEY",
+    "image_model": "gpt-image-1",
+}
+
+
+def encode_png(rgb) -> bytes:
+    """uint8 array [H, W, 3] → PNG bytes (RGB8, no filtering). Minimal
+    stdlib encoder — PIL is not in the serving image."""
+    import numpy as np
+
+    arr = np.asarray(rgb, dtype=np.uint8)
+    h, w, _ = arr.shape
+    raw = b"".join(b"\x00" + arr[y].tobytes() for y in range(h))
+
+    def chunk(tag: bytes, data: bytes) -> bytes:
+        body = tag + data
+        return struct.pack(">I", len(data)) + body + struct.pack(
+            ">I", zlib.crc32(body) & 0xFFFFFFFF)
+
+    ihdr = struct.pack(">IIBBBBB", w, h, 8, 2, 0, 0, 0)  # 8-bit RGB
+    return (b"\x89PNG\r\n\x1a\n" + chunk(b"IHDR", ihdr)
+            + chunk(b"IDAT", zlib.compress(raw, 6)) + chunk(b"IEND", b""))
+
+
+def decode_png_size(png: bytes) -> tuple[int, int]:
+    """(width, height) from a PNG header — test/verification helper."""
+    if png[:8] != b"\x89PNG\r\n\x1a\n":
+        raise ValueError("not a PNG")
+    w, h = struct.unpack(">II", png[16:24])
+    return w, h
+
+
+class ProceduralImageGen:
+    """Deterministic prompt-seeded value-noise renderer (real PNGs)."""
+
+    def __init__(self, options: Optional[dict] = None):
+        self.options = dict(options or {})
+
+    MAX_SIZE = 2048
+
+    def generate(self, prompt: str, size: int = 0) -> tuple[bytes, str]:
+        import numpy as np
+
+        # Clamp unconditionally: size can arrive from a model-emitted
+        # tool call, and size² ×3 float32 buffers scale quadratically.
+        size = min(max(int(size or self.options.get("size", 256)), 16),
+                   self.MAX_SIZE)
+        seed = int.from_bytes(
+            hashlib.sha256(prompt.encode()).digest()[:8], "big")
+        rng = np.random.default_rng(seed)
+        # Two octaves of bilinear value noise per channel + a palette
+        # rotation from the seed — smooth, colorful, and unique per
+        # prompt.
+        img = np.zeros((size, size, 3), np.float32)
+        for octave, cells in ((0.65, 4), (0.35, 16)):
+            grid = rng.random((cells + 1, cells + 1, 3), dtype=np.float32)
+            xs = np.linspace(0, cells, size, endpoint=False)
+            i = xs.astype(np.int32)
+            f = (xs - i)[:, None]
+            g00 = grid[np.ix_(i, i)]
+            g01 = grid[np.ix_(i, i + 1)]
+            g10 = grid[np.ix_(i + 1, i)]
+            g11 = grid[np.ix_(i + 1, i + 1)]
+            fy, fx = f[:, None, :], f[None, :, :]
+            img += octave * ((g00 * (1 - fx) + g01 * fx) * (1 - fy)
+                             + (g10 * (1 - fx) + g11 * fx) * fy)
+        phase = (seed % 360) / 360.0 * 2 * np.pi
+        rot = np.stack([np.sin(phase + c * 2.1) * 0.25 + 0.75
+                        for c in range(3)])
+        img = np.clip(img * rot[None, None, :], 0.0, 1.0)
+        return encode_png((img * 255).astype(np.uint8)), "image/png"
+
+
+class HttpImageGen:
+    """OpenAI-shaped images API client (b64_json response)."""
+
+    def __init__(self, options: Optional[dict] = None):
+        self.options = dict(options or {})
+
+    def generate(self, prompt: str, size: int = 0) -> tuple[bytes, str]:
+        o = self.options
+        base = str(o.get("base_url")
+                   or _OPENAI_DEFAULTS["base_url"]).rstrip("/")
+        model = str(o.get("image_model") or _OPENAI_DEFAULTS["image_model"])
+        key = _api_key(o, "openai")
+        px = int(size or o.get("size", 1024))
+        body = json.dumps({
+            "model": model, "prompt": prompt, "n": 1,
+            "size": f"{px}x{px}",
+        }).encode()
+        req = _request(f"{base}/v1/images/generations",
+                       {"Authorization": f"Bearer {key}"},
+                       body, "application/json")
+        with _open(req, "openai") as resp:
+            doc = json.loads(resp.read())
+        data = (doc.get("data") or [{}])[0]
+        b64 = data.get("b64_json")
+        if not b64:
+            raise SpeechVendorError("openai: no b64_json in image response")
+        return base64.b64decode(b64), str(data.get("content_type")
+                                          or "image/png")

@@ -148,6 +148,34 @@ def build_speech_provider(spec: ProviderSpec):
     return maker()
 
 
+def build_image_provider(spec: ProviderSpec):
+    """Instantiate the generator for an image-role provider
+    (runtime/images.py; reference imagen provider type +
+    internal/media/builder.go)."""
+    from omnia_tpu.runtime.images import HttpImageGen, ProceduralImageGen
+
+    if spec.role != "image":
+        raise ProviderError(f"provider {spec.name!r} is not image-role")
+    if spec.type == "procedural":
+        return ProceduralImageGen(spec.options)
+    if spec.type == "openai":
+        return HttpImageGen(spec.options)
+    raise ProviderError(
+        f"provider {spec.name!r}: no image backend of type {spec.type!r} "
+        "(have procedural, openai)"
+    )
+
+
+def find_role_spec(registry: "ProviderRegistry", role: str) -> Optional[ProviderSpec]:
+    """First declared provider of a role (the reference resolves roles
+    from the AgentRuntime's provider list the same way)."""
+    for name in registry.names():
+        spec = registry.spec(name)
+        if spec.role == role:
+            return spec
+    return None
+
+
 def build_speech_support(registry: "ProviderRegistry"):
     """Resolve the duplex speech pair from declared speech-role providers
     — the reference resolves duplex speech from Provider CRDs the same

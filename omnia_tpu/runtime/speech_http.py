@@ -90,6 +90,32 @@ def _api_key(options: dict, vendor: str) -> str:
     return key
 
 
+def _wav_wrap(pcm: bytes, rate: int, channels: int = 1) -> bytes:
+    """Raw pcm16 → minimal RIFF/WAV container. openai and elevenlabs STT
+    take audio *files* and cannot auto-detect headerless PCM; the 44-byte
+    header makes the duplex stream a decodable upload."""
+    import struct
+
+    byte_rate = rate * channels * 2
+    return (b"RIFF" + struct.pack("<I", 36 + len(pcm)) + b"WAVEfmt "
+            + struct.pack("<IHHIIHH", 16, 1, channels, rate, byte_rate,
+                          channels * 2, 16)
+            + b"data" + struct.pack("<I", len(pcm)) + pcm)
+
+
+def _resample_pcm16(pcm: bytes, src_rate: int, dst_rate: int) -> bytes:
+    """Linear-interpolation resample of mono pcm16 (numpy)."""
+    if src_rate == dst_rate or not pcm:
+        return pcm
+    import numpy as np
+
+    x = np.frombuffer(pcm[: len(pcm) - (len(pcm) % 2)], dtype="<i2")
+    n_out = max(1, int(round(len(x) * dst_rate / src_rate)))
+    pos = np.linspace(0, len(x) - 1, n_out)
+    out = np.interp(pos, np.arange(len(x)), x.astype(np.float32))
+    return out.astype("<i2").tobytes()
+
+
 def _multipart(fields: dict[str, str], file_name: str, file_bytes: bytes,
                file_content_type: str) -> tuple[bytes, str]:
     """Stdlib multipart/form-data encoder (no requests in the image)."""
@@ -169,6 +195,14 @@ class HttpTts(TtsProvider):
     def synthesize(self, text: str, fmt: dict) -> Iterator[bytes]:
         rate = int(fmt.get("sample_rate_hz", 16000))
         req = self._build(text, rate)
+        if self.vendor == "openai" and rate != 24000:
+            # /v1/audio/speech pcm is fixed 24 kHz with no rate knob:
+            # buffer and resample to the negotiated duplex rate (loses
+            # streamed start for this vendor; correctness over latency).
+            with _open(req, self.vendor) as resp:
+                pcm = resp.read()
+            yield _resample_pcm16(pcm, 24000, rate)
+            return
         with _open(req, self.vendor) as resp:
             while True:
                 chunk = resp.read(_CHUNK)
@@ -203,13 +237,14 @@ class HttpStt(SttProvider):
                 body, ctype)
         elif v == "elevenlabs":
             body, ctype = _multipart(
-                {"model_id": model}, "audio.raw", audio,
-                "application/octet-stream")
+                {"model_id": model}, "audio.wav",
+                _wav_wrap(audio, rate), "audio/wav")
             req = _request(f"{base}/v1/speech-to-text",
                            {"xi-api-key": key}, body, ctype)
         else:  # openai
             body, ctype = _multipart(
-                {"model": model}, "audio.wav", audio, "audio/wav")
+                {"model": model}, "audio.wav",
+                _wav_wrap(audio, rate), "audio/wav")
             req = _request(f"{base}/v1/audio/transcriptions",
                            {"Authorization": f"Bearer {key}"}, body, ctype)
         with _open(req, self.vendor) as resp:

@@ -95,7 +95,14 @@ class SpeechDevServer:
                                 "application/json")
 
             def do_POST(self):
-                if self.headers.get("X-API-Key") != srv.api_key:
+                import hashlib
+                import hmac
+
+                supplied = self.headers.get("X-API-Key") or ""
+                if not hmac.compare_digest(
+                    hashlib.sha256(supplied.encode()).digest(),
+                    hashlib.sha256(srv.api_key.encode()).digest(),
+                ):
                     self._reply(401, b'{"error": "bad api key"}',
                                 "application/json")
                     return

@@ -1,0 +1,205 @@
+"""Image and inference roles are honest (VERDICT r3 #4): a declared
+role now has a working path, enforced end-to-end — image generation
+lands real PNGs in the media store with the storage_ref in the tool
+reply, and inference.generate serves raw completions from the declared
+inference-role provider (reference agentruntime_types.go:387-414,
+internal/media/builder.go)."""
+
+from __future__ import annotations
+
+import base64
+import json
+import threading
+import zlib
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from omnia_tpu.runtime.images import (
+    HttpImageGen,
+    ProceduralImageGen,
+    decode_png_size,
+    encode_png,
+)
+
+PACK = {"name": "img-agent", "version": "1.0.0",
+        "prompts": {"system": "You are terse."},
+        "sampling": {"temperature": 0.0, "max_tokens": 256}}
+
+
+def _valid_png(png: bytes) -> tuple[int, int]:
+    """Structural validity: signature, header dims, decompressable IDAT."""
+    w, h = decode_png_size(png)
+    idat_start = png.index(b"IDAT") + 4
+    idat_len = int.from_bytes(png[idat_start - 8:idat_start - 4], "big")
+    raw = zlib.decompress(png[idat_start:idat_start + idat_len])
+    assert len(raw) == h * (1 + w * 3)  # filter byte + RGB rows
+    return w, h
+
+
+def test_procedural_generates_real_deterministic_pngs():
+    gen = ProceduralImageGen()
+    png1, ctype = gen.generate("a red fox", size=64)
+    assert ctype == "image/png"
+    assert _valid_png(png1) == (64, 64)
+    # Deterministic per prompt; distinct across prompts.
+    png1b, _ = ProceduralImageGen().generate("a red fox", size=64)
+    png2, _ = gen.generate("a blue whale", size=64)
+    assert png1 == png1b
+    assert png1 != png2
+
+
+def test_encode_png_roundtrip_shape():
+    import numpy as np
+
+    arr = np.arange(4 * 3 * 3, dtype=np.uint8).reshape(4, 3, 3)
+    png = encode_png(arr)
+    assert _valid_png(png) == (3, 4)
+
+
+def test_openai_images_wire_shape():
+    seen = []
+    canned = base64.b64encode(b"png-bytes-here").decode()
+
+    class Handler(BaseHTTPRequestHandler):
+        def do_POST(self):
+            body = self.rfile.read(int(self.headers.get("Content-Length") or 0))
+            seen.append({"path": self.path,
+                         "auth": self.headers.get("Authorization"),
+                         "body": json.loads(body)})
+            out = json.dumps({"data": [{"b64_json": canned}]}).encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(out)))
+            self.end_headers()
+            self.wfile.write(out)
+
+        def log_message(self, *a):
+            pass
+
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    try:
+        base = f"http://127.0.0.1:{httpd.server_address[1]}"
+        gen = HttpImageGen({"base_url": base, "api_key": "ik",
+                            "image_model": "gpt-image-1"})
+        data, ctype = gen.generate("sunset", size=512)
+        assert data == b"png-bytes-here" and ctype == "image/png"
+        req = seen[-1]
+        assert req["path"] == "/v1/images/generations"
+        assert req["auth"] == "Bearer ik"
+        assert req["body"] == {"model": "gpt-image-1", "prompt": "sunset",
+                               "n": 1, "size": "512x512"}
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+
+
+def test_image_role_serves_generate_image_tool(tmp_path):
+    """Declared image-role provider + media store ⇒ the model can call
+    generate_image and the reply's storage_ref resolves to a real PNG."""
+    from omnia_tpu.media import LocalMediaStore
+    from omnia_tpu.runtime.packs import load_pack
+    from omnia_tpu.runtime.providers import ProviderRegistry, ProviderSpec
+    from omnia_tpu.runtime.server import RuntimeServer
+
+    registry = ProviderRegistry()
+    registry.register(ProviderSpec(
+        name="main", type="mock",
+        options={"scenarios": [
+            # Once the tool result (carrying storage_ref) is in context,
+            # the mock answers normally instead of re-calling the tool.
+            {"pattern": "storage_ref", "reply": "done drawing"},
+            {"pattern": "draw",
+             "reply": '<tool_call>{"name": "generate_image", '
+                      '"arguments": {"prompt": "a fox", "size": 32}}'
+                      "</tool_call>"},
+            {"pattern": ".", "reply": "ok"},
+        ]}))
+    registry.register(ProviderSpec(name="artist", type="procedural",
+                                   role="image", options={"size": 32}))
+    media = LocalMediaStore(str(tmp_path))
+    runtime = RuntimeServer(pack=load_pack(PACK), providers=registry,
+                            provider_name="main", media_store=media)
+    port = runtime.serve("localhost:0")
+    try:
+        from omnia_tpu.runtime.client import RuntimeClient
+
+        client = RuntimeClient(f"127.0.0.1:{port}")
+        stream = client.open_stream("img-sess")
+        tool_payloads = []
+        final = None
+        for msg in stream.turn("draw me a fox"):
+            if msg.type == "tool_call":
+                tool_payloads.append(msg)
+            if msg.type in ("done", "error"):
+                final = msg
+                break
+        stream.close()
+        client.close()
+        assert final is not None and final.type == "done", final
+    finally:
+        runtime.shutdown()
+    # The generated ref resolves from the media store to a valid PNG.
+    refs = [f for f in (tmp_path.rglob("*")) if f.is_file()]
+    assert refs, "no media stored by generate_image"
+    png = refs[0].read_bytes()
+    assert _valid_png(png) == (32, 32)
+
+
+def test_inference_role_serves_raw_generate():
+    """inference.generate runs a raw completion on the inference-role
+    provider — no pack templating — and errors honestly without one."""
+    from omnia_tpu.runtime.packs import load_pack
+    from omnia_tpu.runtime.providers import ProviderRegistry, ProviderSpec
+    from omnia_tpu.runtime.server import RuntimeServer
+    from omnia_tpu.runtime import contract as c
+
+    registry = ProviderRegistry()
+    registry.register(ProviderSpec(
+        name="main", type="mock",
+        options={"scenarios": [{"pattern": ".", "reply": "chat"}]}))
+    registry.register(ProviderSpec(
+        name="raw", type="mock", role="inference",
+        options={"scenarios": [{"pattern": ".", "reply": "raw completion"}]}))
+    runtime = RuntimeServer(pack=load_pack(PACK), providers=registry,
+                            provider_name="main")
+    resp = runtime.invoke(
+        c.InvokeRequest(name="inference.generate",
+                        input={"prompt": "2+2=", "max_tokens": 64}),
+        None)
+    assert resp.error_code is None or resp.error_code == "", resp
+    assert resp.output["text"] == "raw completion"
+    assert resp.usage.completion_tokens > 0
+    # Input validation + honest absence.
+    bad = runtime.invoke(
+        c.InvokeRequest(name="inference.generate", input={}), None)
+    assert bad.error_code == "bad_input"
+    registry2 = ProviderRegistry()
+    registry2.register(ProviderSpec(
+        name="main", type="mock",
+        options={"scenarios": [{"pattern": ".", "reply": "x"}]}))
+    runtime2 = RuntimeServer(pack=load_pack(PACK), providers=registry2,
+                             provider_name="main")
+    none = runtime2.invoke(
+        c.InvokeRequest(name="inference.generate",
+                        input={"prompt": "p"}), None)
+    assert none.error_code == "not_found"
+
+
+def test_admission_accepts_working_image_inference_roles():
+    """Role ⇒ type table: declared roles validate only with types that
+    have a working backend; nonsense pairs are rejected."""
+    from omnia_tpu.operator.resources import Resource
+    from omnia_tpu.operator.validation import ValidationError, validate
+
+    ok = Resource(kind="Provider", name="img",
+                  spec={"type": "procedural", "role": "image"})
+    validate(ok)
+    ok2 = Resource(kind="Provider", name="inf",
+                   spec={"type": "tpu", "role": "inference",
+                         "model": "test-tiny"})
+    validate(ok2)
+    with pytest.raises(ValidationError, match="does not serve role"):
+        validate(Resource(kind="Provider", name="bad",
+                          spec={"type": "tone", "role": "image"}))

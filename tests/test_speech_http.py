@@ -216,3 +216,36 @@ def test_speechd_main_wiring(tmp_path):
     finally:
         if proc.poll() is None:
             proc.kill()
+
+
+def test_stt_uploads_are_decodable_wav(vendor_server):
+    """openai/elevenlabs take audio FILES: raw duplex pcm16 must be
+    RIFF/WAV-wrapped before upload (headerless PCM is rejected by the
+    real vendors)."""
+    base, seen = vendor_server
+    pcm = b"\x01\x02\x03\x04" * 10
+    for vendor in ("openai", "elevenlabs"):
+        HttpStt(vendor, {"base_url": base, "api_key": "k"}).transcribe(pcm, FMT)
+        body = seen[-1]["body"]
+        assert b"RIFF" in body and b"WAVEfmt" in body and pcm in body
+    # cartesia sends raw pcm with explicit encoding fields instead.
+    HttpStt("cartesia", {"base_url": base, "api_key": "k"}).transcribe(pcm, FMT)
+    assert b"RIFF" not in seen[-1]["body"]
+    assert b'name="encoding"' in seen[-1]["body"]
+
+
+def test_openai_tts_resamples_24k_to_duplex_rate(vendor_server):
+    """/v1/audio/speech pcm is fixed 24 kHz: at a 16 kHz duplex format
+    the client must resample (2:3 sample-count ratio), not mislabel."""
+    import numpy as np
+
+    base, _seen = vendor_server
+    out = b"".join(HttpTts("openai", {"base_url": base, "api_key": "k"})
+                   .synthesize("x", FMT))
+    # Server returned 6000 samples of 24 kHz pcm; 16 kHz keeps 2/3.
+    n_in, n_out = 6000, len(out) // 2
+    assert abs(n_out - n_in * 16000 / 24000) <= 2, n_out
+    # At 24 kHz the stream passes through untouched.
+    out24 = b"".join(HttpTts("openai", {"base_url": base, "api_key": "k"})
+                     .synthesize("x", dict(FMT, sample_rate_hz=24000)))
+    assert len(out24) // 2 == n_in
