@@ -231,6 +231,7 @@ def runtime_main() -> int:
         context_store=store, tool_executor=executor,
         media_store=_media_store(),
         workspace=_env("OMNIA_WORKSPACE", "default"),
+        tracer=_tracer("omnia-runtime"),
     )
     port = server.serve(f"0.0.0.0:{_env('OMNIA_GRPC_PORT', '9000')}")
     logger.info("runtime serving gRPC on :%d", port)
@@ -278,6 +279,22 @@ def _auth_chain_from_env():
 
         validators.append(EdgeTrustValidator(edge))
     return AuthChain(validators) if validators else None
+
+
+def _tracer(service: str):
+    """OMNIA_OTLP_ENDPOINT → Tracer with OTLP/HTTP export (the bundled
+    Tempo's address when the observability bundle is installed), else
+    None. OMNIA_TRACE_SAMPLE_RATE tunes sampling."""
+    endpoint = _env("OMNIA_OTLP_ENDPOINT")
+    if not endpoint:
+        return None
+    from omnia_tpu.utils.tracing import OTLPExporter, Tracer
+
+    return Tracer(
+        service,
+        sample_rate=float(_env("OMNIA_TRACE_SAMPLE_RATE", "1.0")),
+        otlp=OTLPExporter(endpoint),
+    )
 
 
 def facade_main() -> int:
@@ -508,6 +525,15 @@ def doctor_main() -> int:
         doc.add_facade_ws_check(_env("OMNIA_FACADE_WS_URL"))
     if _env("OMNIA_OPERATOR_URL"):
         doc.add_crd_presence_check(_env("OMNIA_OPERATOR_URL"))
+    # Observability bundle (install.py renders the trio; each component
+    # exposes its own readiness path).
+    for name, env, path in (
+        ("prometheus", "OMNIA_PROMETHEUS_URL", "/-/healthy"),
+        ("loki", "OMNIA_LOKI_URL", "/ready"),
+        ("tempo", "OMNIA_TEMPO_URL", "/ready"),
+    ):
+        if _env(env):
+            doc.add_http_check(name, _env(env) + path)
     report = doc.run()
     print(json.dumps(report, indent=2))
     return 0 if report.get("status") == "pass" else 1

@@ -161,6 +161,19 @@ class InProcessPodBackend:
         # the facade the same way in both topologies.
         self._mgmt_secret = (os.environ.get("OMNIA_MGMT_SECRET") or "").encode() or None
 
+    def _tracer(self):
+        """OTLP tracer for in-process pods when the operator env carries
+        OMNIA_OTLP_ENDPOINT (observability bundle); cluster pods get the
+        same env stamped by K8sManifestBackend."""
+        import os
+
+        endpoint = os.environ.get("OMNIA_OTLP_ENDPOINT")
+        if not endpoint:
+            return None
+        from omnia_tpu.utils.tracing import OTLPExporter, Tracer
+
+        return Tracer("omnia-runtime", otlp=OTLPExporter(endpoint))
+
     def _auth_chain(self):
         """Facade auth for in-process pods: audience-pinned HMAC when a
         mgmt secret is configured (matching cli.py facade assembly), else
@@ -216,6 +229,7 @@ class InProcessPodBackend:
             tool_executor=ToolExecutor(handlers=_build_tool_handlers(dep.tool_configs)),
             media_store=self._media_store(),
             workspace=dep.namespace,
+            tracer=self._tracer(),
         )
         runtime_port = runtime.serve(wait_ready=wait_ready)
         facade = FacadeServer(
@@ -266,6 +280,8 @@ class K8sManifestBackend:
     config-hash annotation, podOverrides merge for TPU placement)."""
 
     def render(self, dep: AgentDeployment) -> dict:
+        import os
+
         spec = dep.resource.spec
         overrides = spec.get("podOverrides", {})
         cfg_hash = dep.config_hash()
@@ -273,6 +289,12 @@ class K8sManifestBackend:
             {"name": "OMNIA_AGENT", "value": dep.name},
             {"name": "OMNIA_PROVIDER", "value": dep.default_provider},
             {"name": "OMNIA_SESSION_API_URL", "value": dep.session_api_url or ""},
+            # Trace export propagates operator → agent pods: agents are
+            # where turn spans originate (install.py points the operator
+            # at the bundled Tempo; cli._tracer reads this in the pod).
+            *([{"name": "OMNIA_OTLP_ENDPOINT",
+                "value": os.environ["OMNIA_OTLP_ENDPOINT"]}]
+              if os.environ.get("OMNIA_OTLP_ENDPOINT") else []),
             # Facades validate mgmt-plane JWTs (console WS, in-cluster
             # callers) with the shared secret; optional so clusters
             # without the omnia-mgmt Secret still schedule (open facade,

@@ -34,16 +34,88 @@ DEFAULT_VALUES: dict = {
     "redis": {"enabled": True},
     "serviceAccount": "omnia-operator",
     # Bundled observability (reference charts/omnia/templates/observability:
-    # Prometheus + Grafana dashboards + podmonitors; Loki/Tempo are left to
-    # a cluster's own logging/tracing stack — OTLP export is wired via
-    # OMNIA_OTLP_ENDPOINT on the services).
+    # Prometheus + Grafana + Loki + Tempo + an Alloy collector). Services
+    # get OMNIA_OTLP_ENDPOINT pointed at Tempo automatically; the Alloy
+    # DaemonSet tails pod logs into Loki and relays any pod OTLP to Tempo.
     "observability": {
         "enabled": False,
         "prometheus": {"image": "prom/prometheus:v2.53.0", "retention": "24h"},
         "grafana": {"image": "grafana/grafana:11.1.0"},
+        "loki": {"image": "grafana/loki:3.1.0", "retention": "168h"},
+        "tempo": {"image": "grafana/tempo:2.5.0"},
+        "collector": {"image": "grafana/alloy:v1.3.0"},
         "podMonitors": True,
     },
 }
+
+# Schema for install values (reference charts/omnia/values.schema.json):
+# typo'd keys and wrong types fail at render time, not at kubectl-apply
+# time. additionalProperties: false at every level is the point.
+_IMAGE = {"type": "string", "minLength": 1}
+_REPLICAS = {"type": "integer", "minimum": 0}
+VALUES_SCHEMA = {
+    "type": "object",
+    "additionalProperties": False,
+    "properties": {
+        "namespace": {"type": "string", "minLength": 1},
+        "serviceAccount": {"type": "string", "minLength": 1},
+        "images": {
+            "type": "object", "additionalProperties": False,
+            "properties": {k: _IMAGE for k in
+                           ("operator", "sessionApi", "memoryApi", "redis")},
+        },
+        "operator": {
+            "type": "object", "additionalProperties": False,
+            "properties": {"replicas": _REPLICAS,
+                           "dashboard": {"type": "boolean"}},
+        },
+        "sessionApi": {
+            "type": "object", "additionalProperties": False,
+            "properties": {"replicas": _REPLICAS},
+        },
+        "memoryApi": {
+            "type": "object", "additionalProperties": False,
+            "properties": {"replicas": _REPLICAS},
+        },
+        "redis": {
+            "type": "object", "additionalProperties": False,
+            "properties": {"enabled": {"type": "boolean"}},
+        },
+        "observability": {
+            "type": "object", "additionalProperties": False,
+            "properties": {
+                "enabled": {"type": "boolean"},
+                "podMonitors": {"type": "boolean"},
+                "prometheus": {
+                    "type": "object", "additionalProperties": False,
+                    "properties": {"image": _IMAGE,
+                                   "retention": {"type": "string"}},
+                },
+                "grafana": {
+                    "type": "object", "additionalProperties": False,
+                    "properties": {"image": _IMAGE},
+                },
+                "loki": {
+                    "type": "object", "additionalProperties": False,
+                    "properties": {"image": _IMAGE,
+                                   "retention": {"type": "string"}},
+                },
+                "tempo": {
+                    "type": "object", "additionalProperties": False,
+                    "properties": {"image": _IMAGE},
+                },
+                "collector": {
+                    "type": "object", "additionalProperties": False,
+                    "properties": {"image": _IMAGE},
+                },
+            },
+        },
+    },
+}
+
+
+class ValuesError(ValueError):
+    """values.yaml failed schema validation."""
 
 
 def _merge(base: dict, over: Optional[dict]) -> dict:
@@ -94,7 +166,21 @@ def _service(ns: str, name: str, comp: str, ports: list[dict]) -> dict:
     }
 
 
+def validate_values(values: Optional[dict]) -> None:
+    """Schema-gate user values (reference values.schema.json)."""
+    if values is None:
+        return
+    import jsonschema
+
+    try:
+        jsonschema.validate(values, VALUES_SCHEMA)
+    except jsonschema.ValidationError as e:
+        path = ".".join(str(p) for p in e.absolute_path) or "(root)"
+        raise ValuesError(f"values.{path}: {e.message}") from e
+
+
 def render_install(values: Optional[dict] = None) -> list[dict]:
+    validate_values(values)
     v = _merge(DEFAULT_VALUES, values)
     ns = v["namespace"]
     sa = v["serviceAccount"]
@@ -133,6 +219,14 @@ def render_install(values: Optional[dict] = None) -> list[dict]:
                 {"apiGroups": ["policy"],
                  "resources": ["poddisruptionbudgets"],
                  "verbs": ["get", "list", "watch", "create", "update", "patch", "delete"]},
+                # The observability collector (Alloy DaemonSet) discovers
+                # pods and tails their logs under this same ClusterRole.
+                {"apiGroups": [""],
+                 "resources": ["pods"],
+                 "verbs": ["get", "list", "watch"]},
+                {"apiGroups": [""],
+                 "resources": ["pods/log"],
+                 "verbs": ["get"]},
             ],
         },
         {
@@ -160,6 +254,15 @@ def render_install(values: Optional[dict] = None) -> list[dict]:
     common_env = redis_env + [
         {"name": "OMNIA_NAMESPACE", "value": ns},
     ]
+    if v["observability"]["enabled"]:
+        # Trace export address (cli._tracer). The OPERATOR's copy is the
+        # load-bearing one: it propagates to every agent pod it renders
+        # (deployment.K8sManifestBackend), and agent runtimes are where
+        # turn spans originate.
+        common_env.append({
+            "name": "OMNIA_OTLP_ENDPOINT",
+            "value": f"http://omnia-tempo.{ns}.svc:4318",
+        })
     out += [
         _deployment(
             ns, "omnia-operator", "operator", v["images"]["operator"],
@@ -196,7 +299,7 @@ def render_install(values: Optional[dict] = None) -> list[dict]:
                  [{"name": "http", "port": 8400}]),
     ]
     if v["observability"]["enabled"]:
-        out += _render_observability(ns, v["observability"])
+        out += _render_observability(ns, v["observability"], sa)
     return out
 
 
@@ -227,7 +330,7 @@ GRAFANA_DASHBOARD = {
 }
 
 
-def _render_observability(ns: str, cfg: dict) -> list[dict]:
+def _render_observability(ns: str, cfg: dict, sa: str = "omnia-operator") -> list[dict]:
     import json as _json
 
     prom_cfg = {
@@ -285,11 +388,38 @@ def _render_observability(ns: str, cfg: dict) -> list[dict]:
     prom["containers"][0]["volumeMounts"] = [
         {"name": "config", "mountPath": "/etc/prometheus"}]
     graf = out[4]["spec"]["template"]["spec"]
-    graf["volumes"] = [{"name": "dashboards",
-                        "configMap": {"name": "omnia-grafana-dashboards"}}]
+    graf["volumes"] = [
+        {"name": "dashboards",
+         "configMap": {"name": "omnia-grafana-dashboards"}},
+        {"name": "datasources",
+         "configMap": {"name": "omnia-grafana-datasources"}},
+    ]
     graf["containers"][0]["volumeMounts"] = [
         {"name": "dashboards",
-         "mountPath": "/var/lib/grafana/dashboards"}]
+         "mountPath": "/var/lib/grafana/dashboards"},
+        {"name": "datasources",
+         "mountPath": "/etc/grafana/provisioning/datasources"},
+    ]
+    # Metrics + logs + traces provisioned as one Grafana view.
+    out.append({
+        "apiVersion": "v1",
+        "kind": "ConfigMap",
+        "metadata": {"name": "omnia-grafana-datasources", "namespace": ns,
+                     "labels": _labels("grafana")},
+        "data": {"datasources.yaml": _to_inline_yaml({
+            "apiVersion": 1,
+            "datasources": [
+                {"name": "Prometheus", "type": "prometheus",
+                 "url": f"http://omnia-prometheus.{ns}.svc:9090",
+                 "isDefault": True},
+                {"name": "Loki", "type": "loki",
+                 "url": f"http://omnia-loki.{ns}.svc:3100"},
+                {"name": "Tempo", "type": "tempo",
+                 "url": f"http://omnia-tempo.{ns}.svc:3200"},
+            ],
+        })},
+    })
+    out += _render_logs_traces(ns, cfg, sa)
     if cfg.get("podMonitors", True):
         # prometheus-operator clusters (reference agent-podmonitor.yaml).
         for comp, selector in (
@@ -307,6 +437,185 @@ def _render_observability(ns: str, cfg: dict) -> list[dict]:
                     "podMetricsEndpoints": [{"port": "metrics"}],
                 },
             })
+    return out
+
+
+def _render_logs_traces(ns: str, cfg: dict, sa: str = "omnia-operator") -> list[dict]:
+    """Loki (logs) + Tempo (traces) + an Alloy collector DaemonSet
+    (reference charts/omnia/templates/observability bundles the same
+    trio). Single-binary filesystem-backed configs: the in-cluster dev/
+    eval posture; production clusters swap object-storage backends via
+    values images/config."""
+    loki_cfg = {
+        "auth_enabled": False,
+        "server": {"http_listen_port": 3100},
+        "common": {
+            "replication_factor": 1,
+            "ring": {"kvstore": {"store": "inmemory"}},
+            "path_prefix": "/loki",
+        },
+        "schema_config": {"configs": [{
+            "from": "2024-01-01", "store": "tsdb",
+            "object_store": "filesystem", "schema": "v13",
+            "index": {"prefix": "index_", "period": "24h"},
+        }]},
+        "limits_config": {
+            "retention_period": cfg["loki"]["retention"],
+        },
+        # retention_period is a no-op without the compactor actively
+        # enforcing it (Loki 3.x) — without this the emptyDir fills until
+        # the node evicts the pod.
+        "compactor": {
+            "working_directory": "/loki/compactor",
+            "retention_enabled": True,
+            "delete_request_store": "filesystem",
+        },
+    }
+    tempo_cfg = {
+        "server": {"http_listen_port": 3200},
+        "distributor": {"receivers": {"otlp": {"protocols": {
+            "grpc": {"endpoint": "0.0.0.0:4317"},
+            "http": {"endpoint": "0.0.0.0:4318"},
+        }}}},
+        "storage": {"trace": {"backend": "local",
+                              "local": {"path": "/var/tempo"}}},
+    }
+    # Alloy config: tail every omnia pod's logs into Loki, and relay any
+    # pod-local OTLP (agents that can't reach Tempo's Service directly)
+    # onward — the reference's Alloy role.
+    alloy_cfg = "\n".join([
+        # Node-scoped discovery: each DaemonSet pod tails ONLY its own
+        # node's pods (NODE_NAME via fieldRef below) — without the field
+        # selector every node would push every pod's logs, duplicating
+        # them by the node count.
+        'discovery.kubernetes "pods" {',
+        '  role = "pod"',
+        '  selectors {',
+        '    role  = "pod"',
+        '    field = "spec.nodeName=" + sys.env("NODE_NAME")',
+        '  }',
+        '}',
+        '',
+        'discovery.relabel "omnia_pods" {',
+        '  targets = discovery.kubernetes.pods.targets',
+        '  rule {',
+        '    source_labels = ["__meta_kubernetes_pod_label_app_kubernetes_io_name"]',
+        '    regex         = "omnia"',
+        '    action        = "keep"',
+        '  }',
+        '}',
+        '',
+        'loki.source.kubernetes "pod_logs" {',
+        '  targets    = discovery.relabel.omnia_pods.output',
+        '  forward_to = [loki.write.default.receiver]',
+        '}',
+        '',
+        'loki.write "default" {',
+        f'  endpoint {{ url = "http://omnia-loki.{ns}.svc:3100/loki/api/v1/push" }}',
+        '}',
+        '',
+        'otelcol.receiver.otlp "relay" {',
+        '  grpc { endpoint = "0.0.0.0:4317" }',
+        '  http { endpoint = "0.0.0.0:4318" }',
+        '  output { traces = [otelcol.exporter.otlphttp.tempo.input] }',
+        '}',
+        '',
+        'otelcol.exporter.otlphttp "tempo" {',
+        f'  client {{ endpoint = "http://omnia-tempo.{ns}.svc:4318" }}',
+        '}',
+    ])
+    out: list[dict] = [
+        {
+            "apiVersion": "v1",
+            "kind": "ConfigMap",
+            "metadata": {"name": "omnia-loki-config", "namespace": ns,
+                         "labels": _labels("loki")},
+            "data": {"loki.yaml": _to_inline_yaml(loki_cfg)},
+        },
+        _deployment(ns, "omnia-loki", "loki", cfg["loki"]["image"], 1,
+                    [{"name": "http", "containerPort": 3100}], []),
+        _service(ns, "omnia-loki", "loki", [{"name": "http", "port": 3100}]),
+        {
+            "apiVersion": "v1",
+            "kind": "ConfigMap",
+            "metadata": {"name": "omnia-tempo-config", "namespace": ns,
+                         "labels": _labels("tempo")},
+            "data": {"tempo.yaml": _to_inline_yaml(tempo_cfg)},
+        },
+        _deployment(ns, "omnia-tempo", "tempo", cfg["tempo"]["image"], 1,
+                    [{"name": "http", "containerPort": 3200},
+                     {"name": "otlp-grpc", "containerPort": 4317},
+                     {"name": "otlp-http", "containerPort": 4318}], []),
+        _service(ns, "omnia-tempo", "tempo",
+                 [{"name": "http", "port": 3200},
+                  {"name": "otlp-grpc", "port": 4317},
+                  {"name": "otlp-http", "port": 4318}]),
+        {
+            "apiVersion": "v1",
+            "kind": "ConfigMap",
+            "metadata": {"name": "omnia-collector-config", "namespace": ns,
+                         "labels": _labels("collector")},
+            "data": {"config.alloy": alloy_cfg},
+        },
+    ]
+    loki = out[1]["spec"]["template"]["spec"]
+    loki["volumes"] = [{"name": "config",
+                        "configMap": {"name": "omnia-loki-config"}},
+                       {"name": "data", "emptyDir": {}}]
+    loki["containers"][0]["args"] = ["-config.file=/etc/loki/loki.yaml"]
+    loki["containers"][0]["volumeMounts"] = [
+        {"name": "config", "mountPath": "/etc/loki"},
+        {"name": "data", "mountPath": "/loki"}]
+    tempo = out[4]["spec"]["template"]["spec"]
+    tempo["volumes"] = [{"name": "config",
+                         "configMap": {"name": "omnia-tempo-config"}},
+                        {"name": "data", "emptyDir": {}}]
+    tempo["containers"][0]["args"] = ["-config.file=/etc/tempo/tempo.yaml"]
+    tempo["containers"][0]["volumeMounts"] = [
+        {"name": "config", "mountPath": "/etc/tempo"},
+        {"name": "data", "mountPath": "/var/tempo"}]
+    labels = _labels("collector")
+    out.append({
+        "apiVersion": "apps/v1",
+        "kind": "DaemonSet",
+        "metadata": {"name": "omnia-collector", "namespace": ns,
+                     "labels": labels},
+        "spec": {
+            "selector": {"matchLabels": labels},
+            "template": {
+                "metadata": {"labels": labels},
+                "spec": {
+                    "serviceAccountName": sa,
+                    "containers": [{
+                        "name": "collector",
+                        "image": cfg["collector"]["image"],
+                        "args": ["run", "/etc/alloy/config.alloy"],
+                        "env": [{
+                            "name": "NODE_NAME",
+                            "valueFrom": {"fieldRef": {
+                                "fieldPath": "spec.nodeName"}},
+                        }],
+                        "ports": [
+                            {"name": "otlp-grpc", "containerPort": 4317},
+                            {"name": "otlp-http", "containerPort": 4318},
+                        ],
+                        "volumeMounts": [
+                            {"name": "config", "mountPath": "/etc/alloy"},
+                        ],
+                    }],
+                    "volumes": [{
+                        "name": "config",
+                        "configMap": {"name": "omnia-collector-config"},
+                    }],
+                },
+            },
+        },
+    })
+    # Stable in-cluster address for the OTLP relay (pods that prefer the
+    # collector hop over Tempo's Service directly).
+    out.append(_service(ns, "omnia-collector", "collector",
+                        [{"name": "otlp-grpc", "port": 4317},
+                         {"name": "otlp-http", "port": 4318}]))
     return out
 
 

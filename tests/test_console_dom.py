@@ -350,3 +350,53 @@ def test_skills_functions_settings_payloads(dash):
     _s, _h, doc = _req(port, "/api/memory-analytics?workspace=w1",
                        headers=auth)
     assert doc["workspace"] == "w1" and doc["available"] is False
+
+
+def test_pod_facades_validate_console_tokens(monkeypatch):
+    """The cluster side of 'no unauthenticated WS path': with a mgmt
+    secret configured, controller-started pods build an audience-pinned
+    HMAC chain (in-process backend) and the rendered K8s manifest stamps
+    OMNIA_MGMT_SECRET (secretKeyRef) and the OTLP endpoint onto both
+    containers."""
+    from omnia_tpu.operator.deployment import (
+        InProcessPodBackend,
+        K8sManifestBackend,
+    )
+
+    monkeypatch.setenv("OMNIA_MGMT_SECRET", "pod-secret")
+    monkeypatch.setenv("OMNIA_OTLP_ENDPOINT", "http://tempo:4318")
+    backend = InProcessPodBackend()
+    chain = backend._auth_chain()
+    assert chain is not None
+    from omnia_tpu.facade.auth import HmacValidator
+
+    good = HmacValidator.mint(b"pod-secret", "console-user", audience="mgmt")
+    bad_aud = HmacValidator.mint(b"pod-secret", "console-user",
+                                 audience="console")
+    assert chain.authenticate(good) is not None
+    assert chain.authenticate(bad_aud) is None  # cookie-shaped JWT refused
+    monkeypatch.delenv("OMNIA_MGMT_SECRET")
+    assert InProcessPodBackend()._auth_chain() is None  # dev: open as before
+    monkeypatch.setenv("OMNIA_MGMT_SECRET", "pod-secret")
+
+    class _Dep:
+        name = "a"
+        namespace = "default"
+        default_provider = "main"
+        session_api_url = ""
+        stable_hash = "h"
+        replicas = 1
+
+        class resource:
+            spec = {}
+
+        def config_hash(self):
+            return "h"
+
+    manifest = K8sManifestBackend().render(_Dep())["deployment"]
+    for c in manifest["spec"]["template"]["spec"]["containers"]:
+        refs = [e for e in c["env"] if e["name"] == "OMNIA_MGMT_SECRET"]
+        assert refs and refs[0]["valueFrom"]["secretKeyRef"]["name"] == "omnia-mgmt"
+        # Trace export propagates operator env -> agent pods.
+        otlp = [e for e in c["env"] if e["name"] == "OMNIA_OTLP_ENDPOINT"]
+        assert otlp and otlp[0]["value"] == "http://tempo:4318"

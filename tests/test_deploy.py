@@ -116,6 +116,96 @@ class TestInstallBundle:
         bare = [(m["kind"], m["metadata"]["name"]) for m in render_install()]
         assert ("Deployment", "omnia-prometheus") not in bare
 
+    def test_observability_logs_traces_bundle(self):
+        """Loki + Tempo + Alloy collector render with the bundle
+        (VERDICT r3 #8): OTLP wired to Tempo on every service, Grafana
+        provisioned with all three datasources, collector config tails
+        omnia pods into Loki."""
+        out = render_install({"observability": {"enabled": True}})
+        assert lint(out) == []
+        kinds = [(m["kind"], m["metadata"]["name"]) for m in out]
+        for expected in (
+            ("Deployment", "omnia-loki"),
+            ("Service", "omnia-loki"),
+            ("ConfigMap", "omnia-loki-config"),
+            ("Deployment", "omnia-tempo"),
+            ("Service", "omnia-tempo"),
+            ("ConfigMap", "omnia-tempo-config"),
+            ("ConfigMap", "omnia-collector-config"),
+            ("DaemonSet", "omnia-collector"),
+            ("ConfigMap", "omnia-grafana-datasources"),
+        ):
+            assert expected in kinds, expected
+        # Every core service exports OTLP at the bundled Tempo.
+        for name in ("omnia-operator", "omnia-session-api", "omnia-memory-api"):
+            dep = next(m for m in out if m["kind"] == "Deployment"
+                       and m["metadata"]["name"] == name)
+            env = {e["name"]: e.get("value")
+                   for e in dep["spec"]["template"]["spec"]["containers"][0]["env"]}
+            assert env["OMNIA_OTLP_ENDPOINT"].endswith(":4318"), (name, env)
+        # Tempo receives OTLP on both protocols; Loki honors retention.
+        tempo_cm = next(m for m in out
+                        if m["metadata"]["name"] == "omnia-tempo-config")
+        assert "4317" in tempo_cm["data"]["tempo.yaml"]
+        assert "4318" in tempo_cm["data"]["tempo.yaml"]
+        loki_cm = next(m for m in out
+                       if m["metadata"]["name"] == "omnia-loki-config")
+        assert "retention_period: 168h" in loki_cm["data"]["loki.yaml"]
+        # The collector tails omnia pods into Loki and relays to Tempo.
+        alloy = next(m for m in out
+                     if m["metadata"]["name"] == "omnia-collector-config")
+        cfg = alloy["data"]["config.alloy"]
+        assert "loki.source.kubernetes" in cfg and "omnia-loki" in cfg
+        assert "otelcol.exporter.otlphttp" in cfg and "omnia-tempo" in cfg
+        # Grafana sees metrics, logs, and traces.
+        ds = next(m for m in out
+                  if m["metadata"]["name"] == "omnia-grafana-datasources")
+        assert all(t in ds["data"]["datasources.yaml"]
+                   for t in ("prometheus", "loki", "tempo"))
+        # Collector correctness: custom SA threads through, node-scoped
+        # discovery (no N× log duplication), stable relay Service, and
+        # the ClusterRole really grants pod/log access.
+        out_sa = render_install({"serviceAccount": "my-sa",
+                                 "observability": {"enabled": True}})
+        ds = next(m for m in out_sa if m["kind"] == "DaemonSet")
+        pod = ds["spec"]["template"]["spec"]
+        assert pod["serviceAccountName"] == "my-sa"
+        env = pod["containers"][0]["env"][0]
+        assert env["name"] == "NODE_NAME"
+        assert env["valueFrom"]["fieldRef"]["fieldPath"] == "spec.nodeName"
+        assert 'field = "spec.nodeName=" + sys.env("NODE_NAME")' in cfg
+        assert ("Service", "omnia-collector") in kinds
+        role = next(m for m in out if m["kind"] == "ClusterRole")
+        flat = [(g, res, v) for r in role["rules"] for g in r["apiGroups"]
+                for res in r["resources"] for v in r["verbs"]]
+        assert ("", "pods", "list") in flat and ("", "pods/log", "get") in flat
+        # Loki actually ENFORCES retention (compactor, Loki 3.x).
+        assert "retention_enabled: true" in loki_cm["data"]["loki.yaml"]
+        # No observability env leaks into a bare render.
+        bare_dep = next(m for m in render_install() if m["kind"] == "Deployment"
+                        and m["metadata"]["name"] == "omnia-operator")
+        bare_env = [e["name"] for e
+                    in bare_dep["spec"]["template"]["spec"]["containers"][0]["env"]]
+        assert "OMNIA_OTLP_ENDPOINT" not in bare_env
+
+    def test_values_schema_rejects_typos(self):
+        """values.schema.json discipline (reference charts/omnia):
+        unknown keys and wrong types fail at render, not at apply."""
+        from omnia_tpu.operator.install import ValuesError, VALUES_SCHEMA
+
+        with pytest.raises(ValuesError, match="observabilty"):
+            render_install({"observabilty": {"enabled": True}})
+        with pytest.raises(ValuesError, match="replicas"):
+            render_install({"operator": {"replicas": "three"}})
+        with pytest.raises(ValuesError, match="loki"):
+            render_install({"observability": {"loki": {"imge": "x"}}})
+        # The committed schema file matches the in-code schema.
+        with open(os.path.join(REPO, "deploy", "values.schema.json")) as f:
+            assert json.load(f) == VALUES_SCHEMA
+        # The committed values pass their own schema.
+        with open(os.path.join(REPO, "deploy", "values.yaml")) as f:
+            render_install(yaml.safe_load(f))
+
     def test_yaml_round_trips(self):
         manifests = render_install()
         assert list(yaml.safe_load_all(to_yaml(manifests))) == manifests
