@@ -21,12 +21,13 @@ from omnia_tpu.operator.autoscaling import Autoscaler, AutoscalingPolicy
 from omnia_tpu.operator.deployment import AgentDeployment, InProcessPodBackend
 from omnia_tpu.operator.resources import EE_KINDS, Resource, ResourceKind, resolve_ref
 from omnia_tpu.operator.rollout import RolloutEngine
+from omnia_tpu.operator.sources_controller import _SourceReconcilersMixin
 from omnia_tpu.operator.store import ResourceStore
 
 logger = logging.getLogger(__name__)
 
 
-class ControllerManager:
+class ControllerManager(_SourceReconcilersMixin):
     def __init__(
         self,
         store: ResourceStore,
@@ -81,6 +82,13 @@ class ControllerManager:
             # Cross-resource fan-in: requeue every AgentRuntime that might
             # reference this (reference agentruntime_watches.go).
             self._queue.put((res.namespace, res.kind, res.name))
+            for ar in self.store.list(ResourceKind.AGENT_RUNTIME.value, res.namespace):
+                self._queue.put((ar.namespace, ar.kind, ar.name))
+        elif res.kind == "HTTPRoute":
+            # Route observation (reference facade_route.go watch): a
+            # route appearing/changing re-derives every agent's public
+            # endpoints in the namespace; the route itself has no
+            # reconcile of its own.
             for ar in self.store.list(ResourceKind.AGENT_RUNTIME.value, res.namespace):
                 self._queue.put((ar.namespace, ar.kind, ar.name))
         elif res.kind in EE_KINDS or res.kind == ResourceKind.WORKSPACE.value:
@@ -312,187 +320,6 @@ class ControllerManager:
             self.store.update_status(res, {"phase": "Error", "message": str(e)})
             return
         self.store.update_status(res, status.to_dict())
-
-    def _syncer(self):
-        """Lazy shared source syncer (OMNIA_SYNC_ROOT or a temp dir — the
-        reference syncs to a workspace PVC, sourcesync/syncer.go:92)."""
-        if getattr(self, "_syncer_inst", None) is None:
-            import os
-            import tempfile
-
-            from omnia_tpu.operator.sourcesync import Syncer
-
-            root = os.environ.get("OMNIA_SYNC_ROOT") or tempfile.mkdtemp(
-                prefix="omnia-sync-"
-            )
-            self._syncer_inst = Syncer(root)
-        return self._syncer_inst
-
-    def _source_key(self, res: Resource) -> str:
-        return f"{res.kind.lower()}-{res.namespace}-{res.name}"
-
-    def reconcile_prompt_pack_source(self, res: Resource) -> None:
-        """Sync the source and project its pack JSON into a PromptPack
-        resource (reference ee promptpacksource_controller.go): a version
-        change lands as a PromptPack update, which the existing
-        version-trigger rollout machinery picks up — pack-source push =
-        progressive rollout."""
-        if not self._license_gate(res, "sources"):
-            return
-        import json as _json
-
-        from omnia_tpu.operator.sourcesync import SyncError
-
-        syncer = self._syncer()
-        key = self._source_key(res)
-        pack_name = res.spec.get("packName") or res.name
-        try:
-            version = syncer.sync(key, res.spec.get("source") or {})
-            raw = syncer.read(key, res.spec.get("packFile", "pack.json"))
-            content = _json.loads(raw)
-            existing = self.store.get(
-                res.namespace, ResourceKind.PROMPT_PACK.value, pack_name
-            )
-            if existing is None or existing.spec.get("content") != content:
-                pack = existing or Resource(
-                    kind=ResourceKind.PROMPT_PACK.value,
-                    name=pack_name,
-                    namespace=res.namespace,
-                )
-                pack.spec = dict(pack.spec)
-                pack.spec["content"] = content
-                pack.spec["sourceRef"] = {"name": res.name}
-                # Admission (ValidationError) must land as source status,
-                # not escape resync() and kill the reconcile thread: a bad
-                # pack in a synced repo is routine operator input.
-                self.store.apply(pack)
-        except Exception as e:  # noqa: BLE001 - any failure = source Error
-            self.store.update_status(res, {"phase": "Error", "message": str(e)})
-            return
-        self.store.update_status(res, {
-            "phase": "Ready",
-            "version": version,
-            "packName": pack_name,
-            "packVersion": content.get("version", ""),
-            "syncedAt": time.time(),
-        })
-
-    def reconcile_skill_source(self, res: Resource) -> None:
-        """Skill bundle sync (reference skillsource_controller.go): skill
-        content lands in the shared sync root; packs that declare
-        `skills: [name]` get it merged into their system prompt at
-        resolution (_merge_pack_skills — the promptpack_skills.go analog).
-        Core kind: no license gate."""
-        source = dict(res.spec.get("source") or {})
-        if source.get("type") == "dir":
-            source["type"] = "local"  # SkillSource vocabulary → syncer's
-        try:
-            version = self._syncer().sync(self._source_key(res), source)
-        except Exception as e:  # noqa: BLE001 - status, not crash
-            self.store.update_status(res, {"phase": "Error", "message": str(e)})
-            return
-        changed = res.status.get("version") != version
-        self.store.update_status(res, {
-            "phase": "Ready", "version": version, "syncedAt": time.time(),
-        })
-        if changed:
-            # Status writes fire no watch events: fan the new skill
-            # content out to the agents serving it ourselves (a skill
-            # push must restart/re-resolve its consumers the way a pack
-            # push does — the reference's version-trigger discipline).
-            for ar in self.store.list(
-                ResourceKind.AGENT_RUNTIME.value, res.namespace
-            ):
-                self._queue.put((ar.namespace, ar.kind, ar.name))
-
-    def _merge_pack_skills(self, ns: str, content: dict):
-        """Pack content with `skills: [names]` → content whose system
-        prompt carries each SkillSource's synced markdown (reference
-        promptpack_skills.go merge). Returns (content, error)."""
-        skills = content.get("skills") or []
-        if not skills:
-            return content, None
-        import os as _os
-
-        blocks = []
-        for sname in skills:
-            src = self.store.get(ns, ResourceKind.SKILL_SOURCE.value, sname)
-            if src is None:
-                return content, f"skill source {sname!r} not found"
-            if src.status.get("phase") != "Ready":
-                self.reconcile_skill_source(src)
-                src = self.store.get(ns, ResourceKind.SKILL_SOURCE.value, sname)
-                if src.status.get("phase") != "Ready":
-                    return content, (
-                        f"skill source {sname!r}: {src.status.get('message')}"
-                    )
-            head = self._syncer().head_dir(self._source_key(src))
-            if head is None:
-                # Ready status but no synced content on THIS sync root
-                # (pruned PVC / fresh temp dir): os.listdir(None) would
-                # read the process cwd into the prompt — fail instead.
-                return content, (
-                    f"skill source {sname!r} has no synced content here; "
-                    "re-sync pending"
-                )
-            texts = []
-            for fn in sorted(_os.listdir(head)):
-                if fn.endswith(".md"):
-                    with open(_os.path.join(head, fn)) as f:
-                        texts.append(f.read().strip())
-            if not texts:
-                return content, f"skill source {sname!r} has no .md content"
-            blocks.append(f"[SKILL {sname}]\n" + "\n".join(texts) + "\n[/SKILL]")
-        out = dict(content)
-        out["prompts"] = dict(content.get("prompts") or {})
-        out["prompts"]["system"] = (
-            out["prompts"].get("system", "") + "\n" + "\n".join(blocks)
-        ).strip()
-        return out, None
-
-    def reconcile_arena_source(self, res: Resource) -> None:
-        """Arena scenario/template content sync (reference
-        arenasource_controller.go / arenatemplatesource_controller.go):
-        content lands in the shared sync root; ArenaJobs reference it via
-        scenariosFrom."""
-        if not self._license_gate(res, "sources"):
-            return
-        try:
-            version = self._syncer().sync(
-                self._source_key(res), res.spec.get("source") or {}
-            )
-        except Exception as e:  # noqa: BLE001 - any failure = source Error
-            self.store.update_status(res, {"phase": "Error", "message": str(e)})
-            return
-        self.store.update_status(res, {
-            "phase": "Ready", "version": version, "syncedAt": time.time(),
-        })
-
-    def reconcile_arena_dev_session(self, res: Resource) -> None:
-        """Interactive arena dev session record (reference
-        arenadevsession_controller.go): validates the agent ref, stamps an
-        expiry, and expires on the level-trigger."""
-        if not self._license_gate(res, "arena"):
-            return
-        exp = res.status.get("expiresAt")
-        if exp and time.time() > float(exp):
-            self.store.update_status(res, {"phase": "Expired"})
-            return
-        ref = (res.spec.get("agentRef") or {}).get("name", "")
-        agent = self.store.get(
-            res.namespace, ResourceKind.AGENT_RUNTIME.value, ref
-        )
-        if agent is None:
-            self.store.update_status(
-                res, {"phase": "Error", "message": f"agentRef {ref!r} not found"}
-            )
-            return
-        endpoint = (agent.status.get("serviceEndpoint") or "")
-        self.store.update_status(res, {
-            "phase": "Ready",
-            "agentEndpoint": endpoint,
-            "expiresAt": exp or time.time() + float(res.spec.get("ttl_s", 3600.0)),
-        })
 
     def _rebuild_policy_evaluator(self) -> list[str]:
         from omnia_tpu.policy.broker import PolicyEvaluator, ToolPolicy
@@ -754,14 +581,62 @@ class ControllerManager:
             },
         )
 
+    def _route_endpoints(self, res) -> list[dict]:
+        """Public endpoints observed from Gateway-API HTTPRoutes whose
+        backendRefs target this agent's Service (reference
+        internal/controller/facade_endpoints.go + facade_route.go): each
+        route hostname × matching rule path becomes a public URL in
+        status.facade.endpoints."""
+        svc = f"agent-{res.name}"
+        out: list[dict] = []
+        for route in self.store.list("HTTPRoute", res.namespace):
+            for rule in route.spec.get("rules", []) or []:
+                # Admission validates shape, but a reconcile crash here
+                # would kill the controller loop — stay defensive against
+                # resources that predate (or bypass) validation.
+                if not isinstance(rule, dict):
+                    continue
+                refs = [r for r in (rule.get("backendRefs") or [])
+                        if isinstance(r, dict)]
+                if not any(r.get("name") == svc for r in refs):
+                    continue
+                path = ""
+                matches = [m for m in (rule.get("matches") or [])
+                           if isinstance(m, dict)]
+                if matches:
+                    path = (matches[0].get("path") or {}).get("value", "") or ""
+                for host in route.spec.get("hostnames", []) or ["*"]:
+                    if host == "*":
+                        continue  # wildcard hosts carry no usable URL
+                    out.append({
+                        "url": f"https://{host}{path}",
+                        "source": "httproute",
+                        "route": route.name,
+                    })
+        # Deterministic + deduped (two rules can repeat a hostname).
+        seen: set[str] = set()
+        uniq = []
+        for e in sorted(out, key=lambda e: (e["url"], e["route"])):
+            if e["url"] not in seen:
+                seen.add(e["url"])
+                uniq.append(e)
+        return uniq
+
     def _write_status(self, res, dep, phase: str, conditions: list[dict]) -> None:
+        pod_endpoints = [
+            {"url": url, "weight": w} for url, w in dep.endpoints()
+        ]
         st = {
             "phase": phase,
             "replicas": len(dep.pods),
             "candidateReplicas": len(dep.candidate_pods),
-            "endpoints": [
-                {"url": url, "weight": w} for url, w in dep.endpoints()
-            ],
+            "endpoints": pod_endpoints,
+            # Reference status.facade.endpoints: the PUBLIC addresses —
+            # HTTPRoute-derived URLs first, direct pod endpoints as the
+            # fallback when no route fronts the agent.
+            "facade": {
+                "endpoints": (self._route_endpoints(res) or pod_endpoints),
+            },
             "configHash": dep.stable_hash,
             "conditions": conditions,
             "rollout": self.rollouts.state(dep).to_status(),

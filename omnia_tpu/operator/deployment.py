@@ -172,7 +172,11 @@ class InProcessPodBackend:
             return None
         from omnia_tpu.utils.tracing import OTLPExporter, Tracer
 
-        return Tracer("omnia-runtime", otlp=OTLPExporter(endpoint))
+        return Tracer(
+            "omnia-runtime",
+            sample_rate=float(os.environ.get("OMNIA_TRACE_SAMPLE_RATE", "1.0")),
+            otlp=OTLPExporter(endpoint),
+        )
 
     def _auth_chain(self):
         """Facade auth for in-process pods: audience-pinned HMAC when a

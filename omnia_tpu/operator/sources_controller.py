@@ -1,0 +1,203 @@
+"""Content-source reconcilers of the controller.
+
+PromptPackSource / SkillSource / ArenaSource / ArenaTemplateSource /
+ArenaDevSession sync flows (reference promptpacksource_controller.go,
+skillsource_controller.go, ee arena source controllers): fetch from
+git/oci/configmap/local through the shared syncer, version-stamp status,
+and fan content changes out to consuming packs/agents. Split from
+controller.py so the sync pipeline reads as one unit; mixed into
+:class:`ControllerManager`.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+
+from omnia_tpu.operator.resources import Resource, ResourceKind
+
+logger = logging.getLogger(__name__)
+
+
+class _SourceReconcilersMixin:
+    """Source-sync methods of :class:`ControllerManager` (uses its store,
+    queue, license manager, and deployments map)."""
+
+    def _syncer(self):
+        """Lazy shared source syncer (OMNIA_SYNC_ROOT or a temp dir — the
+        reference syncs to a workspace PVC, sourcesync/syncer.go:92)."""
+        if getattr(self, "_syncer_inst", None) is None:
+            import os
+            import tempfile
+
+            from omnia_tpu.operator.sourcesync import Syncer
+
+            root = os.environ.get("OMNIA_SYNC_ROOT") or tempfile.mkdtemp(
+                prefix="omnia-sync-"
+            )
+            self._syncer_inst = Syncer(root)
+        return self._syncer_inst
+
+    def _source_key(self, res: Resource) -> str:
+        return f"{res.kind.lower()}-{res.namespace}-{res.name}"
+
+    def reconcile_prompt_pack_source(self, res: Resource) -> None:
+        """Sync the source and project its pack JSON into a PromptPack
+        resource (reference ee promptpacksource_controller.go): a version
+        change lands as a PromptPack update, which the existing
+        version-trigger rollout machinery picks up — pack-source push =
+        progressive rollout."""
+        if not self._license_gate(res, "sources"):
+            return
+        import json as _json
+
+        syncer = self._syncer()
+        key = self._source_key(res)
+        pack_name = res.spec.get("packName") or res.name
+        try:
+            version = syncer.sync(key, res.spec.get("source") or {})
+            raw = syncer.read(key, res.spec.get("packFile", "pack.json"))
+            content = _json.loads(raw)
+            existing = self.store.get(
+                res.namespace, ResourceKind.PROMPT_PACK.value, pack_name
+            )
+            if existing is None or existing.spec.get("content") != content:
+                pack = existing or Resource(
+                    kind=ResourceKind.PROMPT_PACK.value,
+                    name=pack_name,
+                    namespace=res.namespace,
+                )
+                pack.spec = dict(pack.spec)
+                pack.spec["content"] = content
+                pack.spec["sourceRef"] = {"name": res.name}
+                # Admission (ValidationError) must land as source status,
+                # not escape resync() and kill the reconcile thread: a bad
+                # pack in a synced repo is routine operator input.
+                self.store.apply(pack)
+        except Exception as e:  # noqa: BLE001 - any failure = source Error
+            self.store.update_status(res, {"phase": "Error", "message": str(e)})
+            return
+        self.store.update_status(res, {
+            "phase": "Ready",
+            "version": version,
+            "packName": pack_name,
+            "packVersion": content.get("version", ""),
+            "syncedAt": time.time(),
+        })
+
+    def reconcile_skill_source(self, res: Resource) -> None:
+        """Skill bundle sync (reference skillsource_controller.go): skill
+        content lands in the shared sync root; packs that declare
+        `skills: [name]` get it merged into their system prompt at
+        resolution (_merge_pack_skills — the promptpack_skills.go analog).
+        Core kind: no license gate."""
+        source = dict(res.spec.get("source") or {})
+        if source.get("type") == "dir":
+            source["type"] = "local"  # SkillSource vocabulary → syncer's
+        try:
+            version = self._syncer().sync(self._source_key(res), source)
+        except Exception as e:  # noqa: BLE001 - status, not crash
+            self.store.update_status(res, {"phase": "Error", "message": str(e)})
+            return
+        changed = res.status.get("version") != version
+        self.store.update_status(res, {
+            "phase": "Ready", "version": version, "syncedAt": time.time(),
+        })
+        if changed:
+            # Status writes fire no watch events: fan the new skill
+            # content out to the agents serving it ourselves (a skill
+            # push must restart/re-resolve its consumers the way a pack
+            # push does — the reference's version-trigger discipline).
+            for ar in self.store.list(
+                ResourceKind.AGENT_RUNTIME.value, res.namespace
+            ):
+                self._queue.put((ar.namespace, ar.kind, ar.name))
+
+    def _merge_pack_skills(self, ns: str, content: dict):
+        """Pack content with `skills: [names]` → content whose system
+        prompt carries each SkillSource's synced markdown (reference
+        promptpack_skills.go merge). Returns (content, error)."""
+        skills = content.get("skills") or []
+        if not skills:
+            return content, None
+        import os as _os
+
+        blocks = []
+        for sname in skills:
+            src = self.store.get(ns, ResourceKind.SKILL_SOURCE.value, sname)
+            if src is None:
+                return content, f"skill source {sname!r} not found"
+            if src.status.get("phase") != "Ready":
+                self.reconcile_skill_source(src)
+                src = self.store.get(ns, ResourceKind.SKILL_SOURCE.value, sname)
+                if src.status.get("phase") != "Ready":
+                    return content, (
+                        f"skill source {sname!r}: {src.status.get('message')}"
+                    )
+            head = self._syncer().head_dir(self._source_key(src))
+            if head is None:
+                # Ready status but no synced content on THIS sync root
+                # (pruned PVC / fresh temp dir): os.listdir(None) would
+                # read the process cwd into the prompt — fail instead.
+                return content, (
+                    f"skill source {sname!r} has no synced content here; "
+                    "re-sync pending"
+                )
+            texts = []
+            for fn in sorted(_os.listdir(head)):
+                if fn.endswith(".md"):
+                    with open(_os.path.join(head, fn)) as f:
+                        texts.append(f.read().strip())
+            if not texts:
+                return content, f"skill source {sname!r} has no .md content"
+            blocks.append(f"[SKILL {sname}]\n" + "\n".join(texts) + "\n[/SKILL]")
+        out = dict(content)
+        out["prompts"] = dict(content.get("prompts") or {})
+        out["prompts"]["system"] = (
+            out["prompts"].get("system", "") + "\n" + "\n".join(blocks)
+        ).strip()
+        return out, None
+
+    def reconcile_arena_source(self, res: Resource) -> None:
+        """Arena scenario/template content sync (reference
+        arenasource_controller.go / arenatemplatesource_controller.go):
+        content lands in the shared sync root; ArenaJobs reference it via
+        scenariosFrom."""
+        if not self._license_gate(res, "sources"):
+            return
+        try:
+            version = self._syncer().sync(
+                self._source_key(res), res.spec.get("source") or {}
+            )
+        except Exception as e:  # noqa: BLE001 - any failure = source Error
+            self.store.update_status(res, {"phase": "Error", "message": str(e)})
+            return
+        self.store.update_status(res, {
+            "phase": "Ready", "version": version, "syncedAt": time.time(),
+        })
+
+    def reconcile_arena_dev_session(self, res: Resource) -> None:
+        """Interactive arena dev session record (reference
+        arenadevsession_controller.go): validates the agent ref, stamps an
+        expiry, and expires on the level-trigger."""
+        if not self._license_gate(res, "arena"):
+            return
+        exp = res.status.get("expiresAt")
+        if exp and time.time() > float(exp):
+            self.store.update_status(res, {"phase": "Expired"})
+            return
+        ref = (res.spec.get("agentRef") or {}).get("name", "")
+        agent = self.store.get(
+            res.namespace, ResourceKind.AGENT_RUNTIME.value, ref
+        )
+        if agent is None:
+            self.store.update_status(
+                res, {"phase": "Error", "message": f"agentRef {ref!r} not found"}
+            )
+            return
+        endpoint = (agent.status.get("serviceEndpoint") or "")
+        self.store.update_status(res, {
+            "phase": "Ready",
+            "agentEndpoint": endpoint,
+            "expiresAt": exp or time.time() + float(res.spec.get("ttl_s", 3600.0)),
+        })

@@ -282,7 +282,37 @@ def _validate_arena_dev_session(spec: dict, errs: list[str]) -> None:
         errs.append("ttl_s must be a positive number")
 
 
+def _validate_httproute(spec: dict, errs: list[str]) -> None:
+    """Minimal Gateway-API HTTPRoute shape (gateway.networking.k8s.io):
+    enough structure for the controller's endpoint observation
+    (reference internal/controller/facade_route.go). Not one of omnia's
+    own CRDs — accepted so a devroot/store can carry the routes the
+    reference watches from the cluster."""
+    hostnames = spec.get("hostnames", [])
+    if not isinstance(hostnames, list) or not all(
+        isinstance(h, str) and h for h in hostnames
+    ):
+        errs.append("hostnames must be a list of non-empty strings")
+    rules = spec.get("rules", [])
+    if not isinstance(rules, list):
+        errs.append("rules must be a list")
+        return
+    for i, rule in enumerate(rules):
+        if not isinstance(rule, dict):
+            errs.append(f"rules[{i}] must be an object")
+            continue
+        matches = rule.get("matches", []) or []
+        if not isinstance(matches, list) or not all(
+            isinstance(m, dict) for m in matches
+        ):
+            errs.append(f"rules[{i}].matches must be a list of objects")
+        for j, ref in enumerate(rule.get("backendRefs", []) or []):
+            if not isinstance(ref, dict) or not ref.get("name"):
+                errs.append(f"rules[{i}].backendRefs[{j}] needs a name")
+
+
 _VALIDATORS: dict[str, Callable[[dict, list[str]], None]] = {
+    "HTTPRoute": _validate_httproute,
     ResourceKind.PROMPT_PACK_SOURCE.value: _validate_sync_source,
     ResourceKind.ARENA_SOURCE.value: _validate_sync_source,
     ResourceKind.ARENA_TEMPLATE_SOURCE.value: _validate_sync_source,

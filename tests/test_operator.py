@@ -654,3 +654,92 @@ class TestSkillSources:
         assert res.status["phase"] == "Pending"
         cond = res.status["conditions"][0]
         assert cond["status"] == "False" and "ghost-skill" in cond["message"]
+
+
+class TestHTTPRouteObservation:
+    """Gateway-API HTTPRoute endpoint observation (VERDICT r3 #9;
+    reference internal/controller/facade_endpoints.go + facade_route.go):
+    routes targeting an agent's Service surface public URLs in
+    status.facade.endpoints, live-updating on route changes."""
+
+    def test_route_urls_surface_in_facade_status(self):
+        store = MemoryResourceStore()
+        mgr = ControllerManager(store)
+        try:
+            for r in _resources():
+                store.apply(r)
+            mgr.drain_queue()
+            res = store.get("default", "AgentRuntime", "op-agent")
+            # No route yet: facade endpoints fall back to pod endpoints.
+            assert res.status["facade"]["endpoints"] == res.status["endpoints"]
+            # A route appears → its hostnames become the public endpoints.
+            store.apply(Resource(kind="HTTPRoute", name="chat-route", spec={
+                "hostnames": ["chat.example.com", "www.chat.example.com"],
+                "rules": [{
+                    "matches": [{"path": {"type": "PathPrefix",
+                                          "value": "/ws"}}],
+                    "backendRefs": [{"name": "agent-op-agent", "port": 8080}],
+                }],
+            }))
+            mgr.drain_queue()  # route event requeued the agent
+            res = store.get("default", "AgentRuntime", "op-agent")
+            eps = res.status["facade"]["endpoints"]
+            assert [e["url"] for e in eps] == [
+                "https://chat.example.com/ws",
+                "https://www.chat.example.com/ws",
+            ], eps
+            assert all(e["source"] == "httproute" and e["route"] == "chat-route"
+                       for e in eps)
+            # Routes for OTHER services don't leak in.
+            store.apply(Resource(kind="HTTPRoute", name="other", spec={
+                "hostnames": ["other.example.com"],
+                "rules": [{"backendRefs": [{"name": "agent-someone-else"}]}],
+            }))
+            mgr.drain_queue()
+            res = store.get("default", "AgentRuntime", "op-agent")
+            assert all("other.example.com" not in e["url"]
+                       for e in res.status["facade"]["endpoints"])
+            # Route deletion falls back to pod endpoints on next resync.
+            store.delete("default", "HTTPRoute", "chat-route")
+            mgr.resync()
+            res = store.get("default", "AgentRuntime", "op-agent")
+            assert res.status["facade"]["endpoints"] == res.status["endpoints"]
+        finally:
+            mgr.shutdown()
+
+    def test_devroot_route_yaml_populates_status(self, tmp_path):
+        """The devroot path: an HTTPRoute YAML dropped into the config
+        tree (kubectl-apply equivalent) surfaces its hostname in the
+        agent's status.facade.endpoints on the next resync."""
+        import yaml as _yaml
+
+        root = str(tmp_path / "devroot")
+        store = FileResourceStore(root)
+        mgr = ControllerManager(store)
+        try:
+            for r in _resources():
+                store.apply(r)
+            mgr.drain_queue()
+            doc = Resource(kind="HTTPRoute", name="public", spec={
+                "hostnames": ["agents.corp.example"],
+                "rules": [{"backendRefs": [{"name": "agent-op-agent"}]}],
+            }).to_manifest()
+            (tmp_path / "devroot" / "route.yaml").write_text(
+                _yaml.safe_dump(doc))
+            mgr.resync()  # devroot sync + requeue
+            mgr.drain_queue()
+            res = store.get("default", "AgentRuntime", "op-agent")
+            assert [e["url"] for e in res.status["facade"]["endpoints"]] == [
+                "https://agents.corp.example"
+            ]
+        finally:
+            mgr.shutdown()
+
+    def test_httproute_admission(self):
+        store = MemoryResourceStore()
+        with pytest.raises(ValidationError, match="backendRefs"):
+            store.apply(Resource(kind="HTTPRoute", name="bad", spec={
+                "rules": [{"backendRefs": [{"port": 8080}]}]}))
+        with pytest.raises(ValidationError, match="hostnames"):
+            store.apply(Resource(kind="HTTPRoute", name="bad2", spec={
+                "hostnames": "chat.example.com"}))
