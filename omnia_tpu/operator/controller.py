@@ -596,23 +596,29 @@ class ControllerManager(_SourceReconcilersMixin):
                 # resources that predate (or bypass) validation.
                 if not isinstance(rule, dict):
                     continue
-                refs = [r for r in (rule.get("backendRefs") or [])
-                        if isinstance(r, dict)]
-                if not any(r.get("name") == svc for r in refs):
+                refs = rule.get("backendRefs") or []
+                if not isinstance(refs, list):
                     continue
-                path = ""
-                matches = [m for m in (rule.get("matches") or [])
-                           if isinstance(m, dict)]
-                if matches:
-                    path = (matches[0].get("path") or {}).get("value", "") or ""
-                for host in route.spec.get("hostnames", []) or ["*"]:
+                if not any(isinstance(r, dict) and r.get("name") == svc
+                           for r in refs):
+                    continue
+                # EVERY match path contributes an endpoint (hostname ×
+                # path); non-dict path shapes are skipped, not crashed on.
+                paths = []
+                for m in (rule.get("matches") or []):
+                    if isinstance(m, dict) and isinstance(m.get("path"), dict):
+                        paths.append(m["path"].get("value", "") or "")
+                if not paths:
+                    paths = [""]
+                for host in route.spec.get("hostnames") or []:
                     if host == "*":
                         continue  # wildcard hosts carry no usable URL
-                    out.append({
-                        "url": f"https://{host}{path}",
-                        "source": "httproute",
-                        "route": route.name,
-                    })
+                    for path in paths:
+                        out.append({
+                            "url": f"https://{host}{path}",
+                            "source": "httproute",
+                            "route": route.name,
+                        })
         # Deterministic + deduped (two rules can repeat a hostname).
         seen: set[str] = set()
         uniq = []

@@ -306,7 +306,11 @@ def _validate_httproute(spec: dict, errs: list[str]) -> None:
             isinstance(m, dict) for m in matches
         ):
             errs.append(f"rules[{i}].matches must be a list of objects")
-        for j, ref in enumerate(rule.get("backendRefs", []) or []):
+        refs = rule.get("backendRefs", []) or []
+        if not isinstance(refs, list):
+            errs.append(f"rules[{i}].backendRefs must be a list")
+            continue
+        for j, ref in enumerate(refs):
             if not isinstance(ref, dict) or not ref.get("name"):
                 errs.append(f"rules[{i}].backendRefs[{j}] needs a name")
 

@@ -1,0 +1,99 @@
+"""The quick-start doc is executable (VERDICT r3 #10): the YAML block is
+applied verbatim through admission + the controller, the WS snippet runs
+against the resulting live agent, and every relative doc link resolves.
+If docs/quickstart.md drifts from the code, this fails."""
+
+from __future__ import annotations
+
+import json
+import re
+import os
+import threading
+
+import pytest
+import yaml
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+DOC = os.path.join(REPO, "docs", "quickstart.md")
+
+
+def _blocks(lang: str) -> list[str]:
+    text = open(DOC).read()
+    return re.findall(rf"```{lang}\n(.*?)```", text, re.DOTALL)
+
+
+@pytest.fixture(scope="module")
+def echo_server():
+    """The doc's echo tool endpoint (http://127.0.0.1:18099/echo)."""
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    class Handler(BaseHTTPRequestHandler):
+        def do_POST(self):
+            body = self.rfile.read(int(self.headers.get("Content-Length") or 0))
+            out = json.dumps({"echoed": json.loads(body or b"{}")}).encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(out)))
+            self.end_headers()
+            self.wfile.write(out)
+
+        def log_message(self, *a):
+            pass
+
+    httpd = ThreadingHTTPServer(("127.0.0.1", 18099), Handler)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    yield
+    httpd.shutdown()
+    httpd.server_close()
+
+
+def test_quickstart_yaml_and_ws_flow_run_verbatim(echo_server):
+    """Apply the doc's YAML block through real admission, reconcile, and
+    run the doc's WS snippet against the live endpoint."""
+    from omnia_tpu.operator import ControllerManager, MemoryResourceStore, Resource
+
+    [agent_yaml] = _blocks("yaml")
+    store = MemoryResourceStore()
+    mgr = ControllerManager(store)
+    try:
+        docs = list(yaml.safe_load_all(agent_yaml))
+        assert [d["kind"] for d in docs] == [
+            "Provider", "PromptPack", "ToolRegistry", "AgentRuntime"]
+        for d in docs:
+            store.apply(Resource.from_manifest(d))
+        mgr.drain_queue()
+        res = store.get("default", "AgentRuntime", "quickstart")
+        assert res.status["phase"] == "Running", res.status
+        endpoint = res.status["endpoints"][0]["url"]
+
+        # Execute the doc's python block with ENDPOINT bound, verbatim.
+        [py] = _blocks("python")
+        scope = {"ENDPOINT": endpoint}
+        exec(compile(py, "quickstart.md#python", "exec"), scope)  # noqa: S102
+        assert scope["reply"], "doc snippet produced no reply"
+        assert scope["usage"]["completion_tokens"] > 0
+    finally:
+        mgr.shutdown()
+
+
+def test_quickstart_bash_commands_name_real_binaries():
+    """The doc's bash blocks reference entry points that exist."""
+    import tomllib
+
+    with open(os.path.join(REPO, "pyproject.toml"), "rb") as f:
+        scripts = tomllib.load(f)["project"]["scripts"]
+    blobs = "\n".join(_blocks("bash"))
+    assert "omnia-operator" in blobs and "omnia-operator" in scripts
+    assert "bench.py" in blobs and os.path.exists(os.path.join(REPO, "bench.py"))
+
+
+def test_docs_index_links_resolve():
+    """docs/index.md organizes every page; every relative link exists
+    and every docs/*.md page is reachable from the index."""
+    index = open(os.path.join(REPO, "docs", "index.md")).read()
+    linked = set(re.findall(r"\]\((\w[\w-]*\.md)\)", index))
+    for target in linked:
+        assert os.path.exists(os.path.join(REPO, "docs", target)), target
+    pages = {f for f in os.listdir(os.path.join(REPO, "docs"))
+             if f.endswith(".md") and f != "index.md"}
+    assert pages <= linked, f"pages missing from index: {sorted(pages - linked)}"

@@ -743,3 +743,31 @@ class TestHTTPRouteObservation:
         with pytest.raises(ValidationError, match="hostnames"):
             store.apply(Resource(kind="HTTPRoute", name="bad2", spec={
                 "hostnames": "chat.example.com"}))
+
+    def test_route_multi_path_and_hostile_shapes(self):
+        """Every match path yields an endpoint; admitted-but-odd shapes
+        (string path, non-list backendRefs) never crash reconcile."""
+        store = MemoryResourceStore()
+        mgr = ControllerManager(store)
+        try:
+            for r in _resources():
+                store.apply(r)
+            mgr.drain_queue()
+            store.apply(Resource(kind="HTTPRoute", name="multi", spec={
+                "hostnames": ["h.example"],
+                "rules": [{
+                    "matches": [{"path": {"value": "/api"}},
+                                {"path": {"value": "/ws"}},
+                                {"path": "bare-string"}],  # skipped, not fatal
+                    "backendRefs": [{"name": "agent-op-agent"}],
+                }],
+            }))
+            mgr.drain_queue()
+            res = store.get("default", "AgentRuntime", "op-agent")
+            urls = [e["url"] for e in res.status["facade"]["endpoints"]]
+            assert urls == ["https://h.example/api", "https://h.example/ws"]
+            with pytest.raises(ValidationError, match="must be a list"):
+                store.apply(Resource(kind="HTTPRoute", name="bad3", spec={
+                    "rules": [{"backendRefs": 5}]}))
+        finally:
+            mgr.shutdown()
