@@ -79,6 +79,8 @@ class DashboardServer:
         self.mgmt_secret = mgmt_secret
         self._httpd: Optional[ThreadingHTTPServer] = None
         self.port: Optional[int] = None
+        self._ws_proxy = None
+        self.ws_proxy_port: Optional[int] = None
 
     # -- console auth ---------------------------------------------------
 
@@ -523,6 +525,10 @@ class DashboardServer:
                 "authenticated": self._console_authenticated(headers),
                 "loginRequired": self.auth_required(),
                 "consoleTokenMinting": self.mgmt_secret is not None,
+                # Preferred chat path: server-side WS proxy (reference
+                # dashboard/server.js) — credentials never leave the
+                # server at all. 0 = proxy not running (token fallback).
+                "consoleProxyPort": self.ws_proxy_port or 0,
             })
         # Login (when configured) gates EVERY data route, not just the
         # token mint — "login required" must mean the server enforces it,
@@ -622,23 +628,31 @@ class DashboardServer:
                           "Path=/; Max-Age=0"
         }
 
+    def mint_console_token(self) -> Optional[str]:
+        """THE console mgmt-JWT mint (short TTL, aud="mgmt") — shared by
+        the /api/console-token handler and the WS proxy so their claims
+        can never diverge. None when no mgmt secret is configured."""
+        if not self.mgmt_secret:
+            return None
+        from omnia_tpu.facade.auth import HmacValidator
+
+        return HmacValidator.mint(
+            self.mgmt_secret, subject="console-user", audience="mgmt",
+            ttl_s=self.CONSOLE_TOKEN_TTL_S,
+        )
+
     def _handle_console_token(self, headers: dict):
         """Server-side mgmt-JWT mint for console WS connections (reference
         dashboard/server.js:1-40): session-gated, short TTL, audience
         "mgmt" so the facade's HmacValidator accepts it."""
         if not self._console_authenticated(headers):
             return self._json(401, {"error": "login required"})
-        if not self.mgmt_secret:
+        token = self.mint_console_token()
+        if token is None:
             return self._json(503, {
                 "error": "console token minting disabled; set "
                          "OMNIA_MGMT_SECRET on the operator and facades"
             })
-        from omnia_tpu.facade.auth import HmacValidator
-
-        token = HmacValidator.mint(
-            self.mgmt_secret, subject="console-user", audience="mgmt",
-            ttl_s=self.CONSOLE_TOKEN_TTL_S,
-        )
         return self._json(200, {
             "token": token, "expires_in_s": self.CONSOLE_TOKEN_TTL_S,
         })
@@ -737,6 +751,15 @@ class DashboardServer:
             target=self._httpd.serve_forever, name="omnia-dashboard", daemon=True
         ).start()
         logger.info("dashboard on %s:%d", host, self.port)
+        try:
+            from omnia_tpu.dashboard.ws_proxy import ConsoleWsProxy
+
+            self._ws_proxy = ConsoleWsProxy(self)
+            self.ws_proxy_port = self._ws_proxy.serve(host=host, port=0)
+        except Exception:  # noqa: BLE001 - console falls back to token flow
+            logger.exception("console WS proxy unavailable; token fallback")
+            self._ws_proxy = None
+            self.ws_proxy_port = None
         return self.port
 
     def shutdown(self) -> None:
@@ -744,3 +767,7 @@ class DashboardServer:
             self._httpd.shutdown()
             self._httpd.server_close()
             self._httpd = None
+        if self._ws_proxy is not None:
+            self._ws_proxy.shutdown()
+            self._ws_proxy = None
+            self.ws_proxy_port = None

@@ -1,0 +1,121 @@
+"""Console WS proxy: browser ⇄ dashboard ⇄ agent facade.
+
+Reference parity: dashboard/server.js:1-40 — the reference console's
+chat traffic flows through the dashboard server, which mints a
+mgmt-plane JWT per connection and proxies frames to the agent facade.
+The browser never talks to a facade directly and never holds a facade
+credential of any kind.
+
+This is the stronger sibling of /api/console-token (server.py): the
+token flow hands the browser a short-lived JWT; the proxy keeps even
+that on the server. The SPA prefers the proxy when the dashboard
+advertises it (/api/me consoleProxy) and falls back to the token flow.
+
+Auth: the browser's console session cookie (HttpOnly, set by
+/api/login) rides the WS upgrade request; the proxy validates it with
+the dashboard's checker, mints the aud="mgmt" JWT itself, dials the
+facade with it, then relays frames both ways (text AND binary — duplex
+voice rides the same proxy). Either side closing closes both.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import urllib.parse
+from typing import Optional
+
+logger = logging.getLogger(__name__)
+
+
+class ConsoleWsProxy:
+    """One WS listener; path /proxy?url=<ws-url-of-facade>."""
+
+    def __init__(self, dashboard) -> None:
+        self.dashboard = dashboard  # DashboardServer (auth + minting)
+        self._server = None
+        self.port: Optional[int] = None
+
+    # -- per-connection relay -------------------------------------------
+
+    def _facade_url(self, raw_target: str) -> str:
+        """Validate the browser-supplied target against the agents the
+        store actually publishes — the proxy must not be an open relay
+        to arbitrary hosts (SSRF)."""
+        allowed = set()
+        for agent in self.dashboard.agents():
+            for ep in agent.get("endpoints", []):
+                if ep.get("url"):
+                    allowed.add(ep["url"].split("?")[0])
+        base = raw_target.split("?")[0]
+        if base not in allowed:
+            raise PermissionError(f"target {base!r} is not a known agent facade")
+        return raw_target
+
+    def _handle(self, ws) -> None:
+        from websockets.sync.client import connect as ws_connect
+
+        req = ws.request
+        headers = {"Cookie": req.headers.get("Cookie", "")}
+        if not self.dashboard._console_authenticated(headers):
+            ws.close(4401, "login required")
+            return
+        q = urllib.parse.parse_qs(urllib.parse.urlsplit(req.path).query)
+        target = (q.get("url") or [""])[0]
+        session = (q.get("session") or [""])[0]
+        try:
+            url = self._facade_url(target)
+        except PermissionError as e:
+            ws.close(4403, str(e)[:100])
+            return
+        if session:
+            url += ("&" if "?" in url else "?") + "session=" + urllib.parse.quote(session)
+        # Mint server-side; the credential never reaches the browser.
+        token = self.dashboard.mint_console_token()
+        if token:
+            url += ("&" if "?" in url else "?") + "token=" + token
+        try:
+            upstream = ws_connect(url, open_timeout=15, max_size=16 * 1024 * 1024)
+        except Exception as e:  # noqa: BLE001 - surfaced as a close code
+            ws.close(4502, f"facade unreachable: {e}"[:100])
+            return
+
+        def pump(src, dst, label):
+            try:
+                for frame in src:
+                    dst.send(frame)
+            except Exception:  # noqa: BLE001 - one side closed
+                pass
+            finally:
+                try:
+                    dst.close()
+                except Exception:  # noqa: BLE001 - already closed
+                    pass
+                try:
+                    src.close()
+                except Exception:  # noqa: BLE001 - already closed
+                    pass
+
+        up = threading.Thread(
+            target=pump, args=(ws, upstream, "to-facade"), daemon=True)
+        up.start()
+        pump(upstream, ws, "to-browser")
+        up.join(timeout=10)
+
+    # -- lifecycle ------------------------------------------------------
+
+    def serve(self, host: str = "localhost", port: int = 0) -> int:
+        from websockets.sync.server import serve
+
+        self._server = serve(
+            self._handle, host, port, max_size=16 * 1024 * 1024)
+        self.port = self._server.socket.getsockname()[1]
+        threading.Thread(target=self._server.serve_forever,
+                         name="omnia-console-ws-proxy", daemon=True).start()
+        logger.info("console WS proxy on %s:%d", host, self.port)
+        return self.port
+
+    def shutdown(self) -> None:
+        if self._server is not None:
+            self._server.shutdown()
+            self._server = None

@@ -59,7 +59,9 @@ class InterleaveRun:
             except Exception as e:  # noqa: BLE001 - collected for asserts
                 errors.append(f"seed={self.seed} thread={index}: {e!r}")
 
-        return threading.Thread(target=runner, name=f"race-{index}")
+        # daemon: a genuinely-deadlocked schedule must FAIL the test, not
+        # hang interpreter shutdown joining the stuck thread.
+        return threading.Thread(target=runner, name=f"race-{index}", daemon=True)
 
     def run(self, bodies: Sequence[Callable[[], None]],
             timeout_s: float = 60.0) -> list[str]:

@@ -400,3 +400,85 @@ def test_pod_facades_validate_console_tokens(monkeypatch):
         # Trace export propagates operator env -> agent pods.
         otlp = [e for e in c["env"] if e["name"] == "OMNIA_OTLP_ENDPOINT"]
         assert otlp and otlp[0]["value"] == "http://tempo:4318"
+
+
+def test_console_ws_proxy_end_to_end(tmp_path):
+    """Reference dashboard/server.js parity: chat frames flow browser →
+    dashboard WS proxy → facade; the cookie rides the upgrade, the mgmt
+    JWT is minted server-side and NEVER reaches the client; unknown
+    targets and missing sessions are refused."""
+    import websockets.sync.client as wsc
+
+    from omnia_tpu.facade.auth import AuthChain, HmacValidator
+    from omnia_tpu.facade.server import FacadeServer
+    from omnia_tpu.operator.store import MemoryResourceStore
+    from omnia_tpu.runtime.packs import load_pack
+    from omnia_tpu.runtime.providers import ProviderRegistry, ProviderSpec
+    from omnia_tpu.runtime.server import RuntimeServer
+
+    registry = ProviderRegistry()
+    registry.register(ProviderSpec(
+        name="main", type="mock",
+        options={"scenarios": [{"pattern": ".", "reply": "proxied hi"}]},
+    ))
+    runtime = RuntimeServer(
+        pack=load_pack({"name": "a", "version": "1.0.0",
+                        "prompts": {"system": "s"},
+                        "sampling": {"max_tokens": 16}}),
+        providers=registry, provider_name="main",
+    )
+    rport = runtime.serve("localhost:0")
+    facade = FacadeServer(
+        runtime_target=f"localhost:{rport}", agent_name="proxy-e2e",
+        auth_chain=AuthChain([HmacValidator(MGMT_SECRET, audience="mgmt")]),
+    )
+    fport = facade.serve()
+    endpoint = f"ws://localhost:{fport}/ws"
+
+    store = MemoryResourceStore()
+    agent = store.apply(Resource(kind="AgentRuntime", name="proxy-agent", spec={
+        "mode": "agent", "promptPackRef": {"name": "p"},
+        "providers": [{"name": "m", "providerRef": {"name": "x"}}],
+    }))
+    store.update_status(agent, {"endpoints": [{"url": endpoint}]})
+    srv = DashboardServer(store, write_token=DASH_TOKEN,
+                          mgmt_secret=MGMT_SECRET)
+    port = srv.serve(host="127.0.0.1", port=0)
+    try:
+        assert srv.ws_proxy_port
+        proxy = (f"ws://127.0.0.1:{srv.ws_proxy_port}/proxy?url="
+                 + json.dumps(endpoint)[1:-1])
+        # 1. No cookie → 4401 at the proxy; the facade is never dialed.
+        with pytest.raises(Exception) as exc:
+            with wsc.connect(proxy, open_timeout=10) as ws:
+                ws.recv(timeout=5)
+        assert "4401" in str(exc.value)
+        # 2. Login, then chat THROUGH the proxy with only the cookie.
+        _s, headers, _d = _req(port, "/api/login", method="POST",
+                               body=json.dumps({"token": DASH_TOKEN}).encode())
+        cookie = headers["Set-Cookie"].split(";")[0]
+        with wsc.connect(proxy, open_timeout=15,
+                         additional_headers={"Cookie": cookie}) as ws:
+            first = json.loads(ws.recv(timeout=15))
+            assert first["type"] == "connected"
+            ws.send(json.dumps({"type": "message", "content": "hello"}))
+            text, done = "", None
+            while done is None:
+                m = json.loads(ws.recv(timeout=30))
+                if m["type"] == "chunk":
+                    text += m["text"]
+                if m["type"] in ("done", "error"):
+                    done = m
+            assert done["type"] == "done" and text == "proxied hi"
+        # 3. Unknown target → 4403 (the proxy is not an open relay).
+        bad = (f"ws://127.0.0.1:{srv.ws_proxy_port}/proxy?url="
+               "ws%3A%2F%2Fevil.example%2Fws")
+        with pytest.raises(Exception) as exc:
+            with wsc.connect(bad, open_timeout=10,
+                             additional_headers={"Cookie": cookie}) as ws:
+                ws.recv(timeout=5)
+        assert "4403" in str(exc.value)
+    finally:
+        srv.shutdown()
+        facade.shutdown()
+        runtime.shutdown()
