@@ -215,13 +215,14 @@ def child_main() -> None:
             decode_chunk_variants=(64, 16, 1),
             decode_pipeline=2,
             max_sessions=0,  # bench is sessionless; skip those compiles
+            spec_decode=4,   # greedy traffic verifies 4 proposals/stream
         )
         ttft_iters, decode_tokens = 20, 128
     else:
         model_name = "test-tiny"
         ecfg = EngineConfig(
             num_slots=4, max_seq=128, prefill_buckets=(64,), dtype="float32",
-            max_sessions=0,
+            max_sessions=0, spec_decode=4,
         )
         ttft_iters, decode_tokens = 5, 32
 
@@ -307,6 +308,10 @@ def child_main() -> None:
             "platform": platform,
             "device_kind": dev.device_kind,
             "pallas_decode": pallas_decode_mode(),
+            # Greedy-traffic speculative decoding (engine/spec_decode.py):
+            # tokens_per_stream > 1 is decode throughput ABOVE the
+            # weight-streaming roofline.
+            "greedy_spec": main_res["greedy_spec"],
             "chip_spec_used": kind,
             "mfu": round(mfu, 4),
             "hbm_bw_util": round(achieved_bw / peak_bw, 4),
@@ -373,6 +378,35 @@ def _bench_engine(cfg, ecfg, params, ttft_iters, decode_tokens, remaining):
         # sync = waiting on device outputs, rest = host bookkeeping/idle.
         dispatch_s = engine.metrics["decode_dispatch_s"] - m0["decode_dispatch_s"]
         sync_s = engine.metrics["decode_sync_s"] - m0["decode_sync_s"]
+
+        # --- greedy speculative phase: same engine, temperature 0 →
+        # the verify path engages; tokens-per-weight-stream is the
+        # roofline multiplier speculation buys on greedy traffic.
+        spec = None
+        if ecfg.spec_decode and remaining() > 60:
+            sp_greedy = SamplingParams(temperature=0.0,
+                                       max_tokens=decode_tokens)
+            ms = dict(engine.metrics)
+            t_g = time.monotonic()
+            handles = [engine.submit(prompt, sp_greedy)
+                       for _ in range(ecfg.num_slots)]
+            g_tokens = sum(len(h.collect_tokens(timeout=300)[0])
+                           for h in handles)
+            g_wall = time.monotonic() - t_g
+            streams = (engine.metrics["spec_steps"] - ms["spec_steps"]) + (
+                engine.metrics["decode_steps"] - ms["decode_steps"])
+            spec = {
+                "tok_s_chip": round(g_tokens / g_wall, 1),
+                # Per-SLOT tokens per weight stream: vanilla decode is
+                # exactly 1.0; anything above is speculation beating the
+                # HBM roofline.
+                "tokens_per_stream_per_slot": round(
+                    g_tokens / max(streams * ecfg.num_slots, 1), 2),
+                "accept_rate": round(
+                    (engine.metrics["spec_accepted"] - ms["spec_accepted"])
+                    / max(engine.metrics["spec_proposed"]
+                          - ms["spec_proposed"], 1), 3),
+            }
     finally:
         engine.stop()
         del engine
@@ -388,6 +422,7 @@ def _bench_engine(cfg, ecfg, params, ttft_iters, decode_tokens, remaining):
         "decode_sync_s": round(sync_s, 3),
         "warmup_s": round(warmup_s, 1),
         "weight_bytes": weight_bytes,
+        "greedy_spec": spec,
     }
 
 

@@ -52,6 +52,7 @@ import numpy as np
 from omnia_tpu.engine.programs import build_programs
 from omnia_tpu.engine.scheduler import _SchedulerMixin
 from omnia_tpu.engine.sessions import _SessionKV, _SessionMixin, _Slot
+from omnia_tpu.engine.spec_decode import _SpecDecodeMixin
 from omnia_tpu.engine.types import (
     EngineConfig,
     FinishReason,
@@ -77,7 +78,7 @@ logger = logging.getLogger(__name__)
 MAX_DEVICE_STOP_IDS = 8
 
 
-class InferenceEngine(_SchedulerMixin, _SessionMixin):
+class InferenceEngine(_SchedulerMixin, _SessionMixin, _SpecDecodeMixin):
     """Slot-based continuous-batching engine over one model."""
 
     def __init__(
@@ -98,6 +99,15 @@ class InferenceEngine(_SchedulerMixin, _SessionMixin):
             raise ValueError("engine max_seq exceeds model max_seq_len")
         if engine_cfg.num_slots % max(engine_cfg.dp, 1) != 0:
             raise ValueError("num_slots must be divisible by dp")
+        if engine_cfg.spec_decode:
+            usable = engine_cfg.usable_buckets()
+            if not usable or engine_cfg.spec_decode + 1 > min(usable):
+                # Rejected-proposal rows at an unpinned idle slot must be
+                # covered by the next occupant's smallest prefill write.
+                raise ValueError(
+                    f"spec_decode={engine_cfg.spec_decode} needs "
+                    f"spec_decode + 1 <= min(prefill_buckets)"
+                )
 
         self._dtype = resolve_dtype(engine_cfg.dtype)
         self._mesh = None
@@ -188,6 +198,12 @@ class InferenceEngine(_SchedulerMixin, _SessionMixin):
             "decode_dispatch_s": 0.0,
             "decode_sync_s": 0.0,
             "prefill_dispatch_s": 0.0,
+            # Speculative decoding (spec_decode.py): acceptance rate =
+            # spec_accepted / spec_proposed; tokens-per-weight-stream =
+            # (tokens_generated during spec) / spec_steps.
+            "spec_steps": 0,
+            "spec_proposed": 0,
+            "spec_accepted": 0,
         }
 
         progs = build_programs(self.model_cfg, self.cfg, self._mesh)
@@ -204,6 +220,7 @@ class InferenceEngine(_SchedulerMixin, _SessionMixin):
         self._extend_nosample_fn = progs.extend_nosample
         self._offload_fn = progs.offload
         self._restore_fn = progs.restore
+        self._verify_fn = progs.verify
         from omnia_tpu.ops.attention import pallas_decode_mode
 
         logger.info(
@@ -295,6 +312,14 @@ class InferenceEngine(_SchedulerMixin, _SessionMixin):
             for r in self.cfg.restore_buckets():
                 k, v = self._offload_fn(self._ck, self._cv, zero, r)
                 self._ck, self._cv = self._restore_fn(self._ck, self._cv, k, v, zero)
+        if self._verify_fn is not None:
+            B, K1 = self.cfg.num_slots, self.cfg.spec_decode + 1
+            self._ck, self._cv, _ = self._verify_fn(
+                self.params, self._ck, self._cv,
+                jnp.zeros((B, K1), jnp.int32),
+                jnp.broadcast_to(jnp.arange(K1, dtype=jnp.int32)[None], (B, K1)),
+                jnp.zeros((B,), jnp.int32),
+            )
         # Placement bookkeeping runs a handful of tiny scatter programs
         # (at[slot].set on tokens/positions/active/budget/stop_ids/keys);
         # un-warmed, each costs a first-request compile round trip —

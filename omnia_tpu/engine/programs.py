@@ -57,6 +57,7 @@ class EnginePrograms:
     extend_nosample: Callable
     offload: Callable
     restore: Callable
+    verify: Optional[Callable]  # speculative-decode verify (spec_decode > 0)
 
 
 def build_programs(
@@ -235,6 +236,22 @@ def build_programs(
 
     restore_fn = jax.jit(restore, donate_argnums=(0, 1))
 
+    # Speculative-decode verify: ONE forward over [B, K+1] tokens (last
+    # emitted + K proposals per slot) with per-slot write offsets; the
+    # greedy argmax over every position is the acceptance oracle. The
+    # cache rows for rejected proposals are garbage at rows ≥ the slot's
+    # new frontier — the same invariant the decode finish-mask relies on.
+    verify_fn = None
+    if ecfg.spec_decode > 0:
+        def verify(params, ck, cv, tokens, positions, write_start):
+            logits, ck, cv = llama.forward(
+                params, cfg, tokens, positions, ck, cv, write_start
+            )
+            greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            return ck, cv, greedy
+
+        verify_fn = jax.jit(verify, donate_argnums=(1, 2))
+
     return EnginePrograms(
         prefill_insert=prefill_insert_fn,
         prefill_ring=prefill_ring_fn,
@@ -244,4 +261,5 @@ def build_programs(
         extend_nosample=extend_nosample_fn,
         offload=offload_fn,
         restore=restore_fn,
+        verify=verify_fn,
     )

@@ -89,6 +89,13 @@ class _SchedulerMixin:
         if any(s.active for s in self._slots):
             with self._lock:
                 queued = bool(self._waiting)
+            # Greedy-only batches take the speculative verify path when
+            # configured: up to spec_decode+1 tokens per weight stream
+            # (spec_decode.py). Sampled/mixed traffic and in-flight
+            # chunks fall through to the exact chunked path.
+            if self._spec_applicable():
+                self._spec_verify_step()
+                return True
             # A dispatch-ahead that no slot can still need (everyone's
             # token budget is covered by chunks already in flight) would
             # be pure garbage whose sync delays the NEXT request's

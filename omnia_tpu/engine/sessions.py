@@ -34,6 +34,7 @@ class _Slot:
         "stop_ids",
         "session_id",
         "emitted",
+        "spec_index",
     )
 
     def __init__(self):
@@ -45,6 +46,7 @@ class _Slot:
         self.stop_ids: frozenset[int] = frozenset()
         self.session_id: Optional[str] = None  # pinned session (may be idle)
         self.emitted: list[int] = []           # tokens emitted this request
+        self.spec_index = None   # lazy per-request n-gram index (spec_decode)
 
     @property
     def active(self) -> bool:
@@ -56,6 +58,7 @@ class _Slot:
         self.length = 0
         self.generated = 0
         self.emitted = []
+        self.spec_index = None
 
 
 class _SessionKV:

@@ -1,0 +1,183 @@
+"""Prompt-lookup speculative decoding (EngineConfig.spec_decode).
+
+Decode on TPU is HBM-bound: every step streams the full weight set for
+one token per slot. The verify program streams the SAME weights over
+T=K+1 tokens, so each accepted proposal is a nearly-free extra token —
+the classic speculative-decoding win, with the draft model replaced by
+prompt lookup (the strongest zero-cost proposer for chat/RAG/code
+traffic, where continuations repeat spans of the prompt or history).
+
+How a verify step works:
+
+- Host proposes K tokens per active slot from an INCREMENTAL n-gram
+  index over prompt+emitted (O(1) lookup + O(new tokens) maintenance —
+  a backward rescan per step would make the host the bottleneck at
+  long context): the most recent earlier occurrence of the current
+  tail n-gram (3→2→1), continued for K tokens.
+- One compiled forward over [B, K+1] (last emitted token + proposals),
+  writing KV rows at each slot's frontier. Greedy argmax over all K+1
+  positions is the acceptance oracle: the prefix of proposals matching
+  the model's own choices is accepted, plus the model's next token
+  after the accepted prefix ("bonus") — 1..K+1 tokens per weight
+  stream, exactly what vanilla greedy decode would have produced.
+- Rejected proposals' KV rows are garbage at rows ≥ the slot's new
+  frontier — the invariant the whole cache design already tolerates.
+
+Everything the step needs is HOST state (slot lengths, emitted tokens,
+session frontiers), so the only device round trip per step is the
+verify dispatch + greedy read — no extra syncs on a remote-dispatch
+link.
+
+Engagement rules (``_spec_applicable``): only when every active slot is
+greedy (temperature 0 — sampled traffic keeps the exact chunked path
+with its per-slot PRNG reproducibility), no decode chunks are in
+flight, and every slot's write window fits the cache (a clamped
+``dynamic_update_slice`` would corrupt earlier rows). Mixed batches
+fall back automatically; nothing about the request API changes.
+"""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+
+_NGRAM_MAX = 3
+
+
+class _NgramIndex:
+    """Incremental most-recent-occurrence index over an append-only
+    token sequence: maps each n-gram (n = 1.._NGRAM_MAX) to the latest
+    start position strictly BEFORE the current tail."""
+
+    __slots__ = ("maps", "built")
+
+    def __init__(self):
+        self.maps = {n: {} for n in range(1, _NGRAM_MAX + 1)}
+        self.built = {n: 0 for n in range(1, _NGRAM_MAX + 1)}
+
+    def propose(self, ctx: list[int], k: int) -> tuple[list[int], int]:
+        """(k proposals zero-padded, number of REAL proposals)."""
+        L = len(ctx)
+        for n in range(min(_NGRAM_MAX, L - 1), 0, -1):
+            m = self.maps[n]
+            # Ingest every start whose gram lies fully before the tail
+            # start (L - n); ctx only appends, so this is incremental.
+            for i in range(self.built[n], L - n):
+                m[tuple(ctx[i:i + n])] = i
+            self.built[n] = max(self.built[n], L - n)
+            hit = m.get(tuple(ctx[L - n:]))
+            if hit is not None:
+                prop = ctx[hit + n:hit + n + k]
+                if prop:
+                    return prop + [0] * (k - len(prop)), len(prop)
+        return [0] * k, 0
+
+
+class _SpecDecodeMixin:
+    """Speculative-decode methods of :class:`InferenceEngine`."""
+
+    def _host_row(self, slot) -> int:
+        """The row an INACTIVE slot's verify window may write from —
+        mirrors the quiesce row _finish_slot chose, from host state
+        only: the pinned session's valid frontier, else 0 (both are ≥
+        any row the next occupant won't overwrite)."""
+        sid = slot.session_id
+        if sid:
+            sess = self._sessions.get(sid)
+            if sess is not None:
+                return len(sess.token_ids)
+        return 0
+
+    def _spec_applicable(self) -> bool:
+        k = self.cfg.spec_decode
+        if not k or self._verify_fn is None or self._inflight:
+            return False
+        any_active = False
+        for s in self._slots:
+            if s.active:
+                any_active = True
+                if s.request.params.temperature != 0.0:
+                    return False
+                if s.length + k + 2 > self.cfg.max_seq:
+                    return False  # window would clamp at the cache end
+                if not s.emitted:
+                    return False  # first token not through yet
+            elif self._host_row(s) + k + 1 > self.cfg.max_seq:
+                # Idle slots' frozen rows also receive the K+1-row write
+                # window; near the cache end it would clamp back over
+                # valid rows — skip spec entirely for this step.
+                return False
+        return any_active
+
+    def _propose(self, slot) -> tuple[list[int], int]:
+        if slot.spec_index is None:
+            slot.spec_index = _NgramIndex()
+        ctx = slot.request.prompt_tokens + slot.emitted
+        return slot.spec_index.propose(ctx, self.cfg.spec_decode)
+
+    def _spec_verify_step(self) -> None:
+        """One verify dispatch + host acceptance/emission (synchronous:
+        acceptance decides the NEXT step's inputs, so there is nothing
+        to pipeline)."""
+        import jax.numpy as jnp
+
+        B, k = self.cfg.num_slots, self.cfg.spec_decode
+        toks = np.zeros((B, k + 1), np.int32)
+        pos = np.zeros((B, k + 1), np.int32)
+        wstart = np.zeros((B,), np.int32)
+        proposals: dict[int, tuple[list[int], int]] = {}
+        for i, s in enumerate(self._slots):
+            if s.active:
+                prop, real = self._propose(s)
+                proposals[i] = (prop, real)
+                toks[i, 0] = s.emitted[-1]
+                toks[i, 1:] = prop
+                wstart[i] = s.length
+                pos[i] = s.length + np.arange(k + 1)
+            else:
+                # Frozen frontier row (the quiesce row _finish_slot set);
+                # _spec_applicable guaranteed the window fits the cache.
+                row = self._host_row(s)
+                wstart[i] = row
+                pos[i] = row + np.arange(k + 1)
+
+        t_dispatch = time.monotonic()
+        self._ck, self._cv, greedy = self._verify_fn(
+            self.params, self._ck, self._cv,
+            jnp.asarray(toks), jnp.asarray(pos), jnp.asarray(wstart),
+        )
+        self.metrics["decode_dispatch_s"] += time.monotonic() - t_dispatch
+        t_sync = time.monotonic()
+        g = np.asarray(greedy)  # [B, K+1]
+        self.metrics["decode_sync_s"] += time.monotonic() - t_sync
+        self.metrics["spec_steps"] += 1
+
+        for i, (prop, real) in proposals.items():
+            s = self._slots[i]
+            if not s.active:
+                continue  # cancelled between dispatch and emission
+            accepted = 0
+            while accepted < k and prop[accepted] == g[i, accepted]:
+                accepted += 1
+            # Metrics count only GENUINE proposals (padding that happens
+            # to match would inflate the acceptance rate operators tune
+            # against); emission still uses every matching token — a
+            # matched pad IS the model's own choice.
+            self.metrics["spec_proposed"] += real
+            self.metrics["spec_accepted"] += min(accepted, real)
+            # Emit accepted proposals then the bonus token, mirroring the
+            # chunk path's bookkeeping (length BEFORE emit; stop/max
+            # checks inside _emit_token can finish the slot mid-list).
+            for tok in [*prop[:accepted], int(g[i, accepted])]:
+                s.length += 1
+                self._emit_token(i, int(tok))
+                if not s.active:
+                    break
+            if s.active:
+                # Device state must match the host frontier exactly so a
+                # later fallback to the chunked path stays coherent (the
+                # device budget is not decremented here: it only ever
+                # over-allows, and the host finish check fires first).
+                self._tokens = self._tokens.at[i].set(int(s.emitted[-1]))
+                self._positions = self._positions.at[i].set(s.length)

@@ -175,6 +175,16 @@ class EngineConfig:
     # host RAM (LRU) and swap back on demand, so this many *logical*
     # sessions share the fixed device cache. 0 disables sessionful serving.
     max_sessions: int = 64
+    # Prompt-lookup speculative decoding (greedy traffic only): each
+    # verify step feeds the last token plus K host-proposed tokens
+    # (n-gram lookup over prompt+history) through ONE forward of T=K+1
+    # and accepts the matching prefix — up to K+1 tokens per weight
+    # stream instead of 1, a direct multiplier on the HBM-bound decode
+    # roofline. Engages only when every active slot samples greedily
+    # (temperature 0); sampled traffic keeps the exact chunked path.
+    # 0 = off. Must satisfy spec_decode + 1 <= min(prefill_buckets)
+    # (rejected-proposal rows land below the next occupant's prefill).
+    spec_decode: int = 0
     # Weight quantization: None (full dtype), "int8" (W8A16 weight-only,
     # near-lossless, halves weight HBM), or "int8-dynamic" (W8A8 dynamic
     # activation quant, int8×int8 MXU path — fastest). Dense models only;

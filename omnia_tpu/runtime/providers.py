@@ -70,7 +70,9 @@ def build_engine(spec: ProviderSpec, *, warmup: bool = False):
         eng_kwargs = {
             k: v
             for k, v in spec.options.items()
-            if k in {"num_slots", "max_seq", "prefill_buckets", "dtype", "dp", "tp"}
+            if k in {"num_slots", "max_seq", "prefill_buckets", "dtype",
+                     "dp", "tp", "decode_chunk", "decode_pipeline",
+                     "spec_decode", "quant", "max_sessions"}
         }
         if "prefill_buckets" in eng_kwargs:
             eng_kwargs["prefill_buckets"] = tuple(eng_kwargs["prefill_buckets"])
