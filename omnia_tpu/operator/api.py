@@ -80,8 +80,11 @@ class ContentStore:
 class OperatorAPI:
     # Routes that change state or mint credentials; read-only routes stay
     # open for the dashboard (which fronts its own auth).
+    # tooltest is protected because an mcp/python handler config is code
+    # execution on the operator host — never exposable unauthenticated.
     _PROTECTED = ("/api/v1/mgmt-token", "/api/v1/deploy",
-                  "/api/v1/license/activate", "/api/v1/content/")
+                  "/api/v1/license/activate", "/api/v1/content/",
+                  "/api/v1/tooltest")
 
     def __init__(
         self,
@@ -135,9 +138,20 @@ class OperatorAPI:
             return 400, {"error": "handler with name required"}
         if handler_doc.get("type") == "client":
             return 400, {"error": "client tools execute in the browser"}
+        # Defense in depth on top of route auth: a stdio MCP config names
+        # a command to spawn — probing it from the operator process would
+        # execute arbitrary binaries on the operator host.
+        mcp_cfg = handler_doc.get("mcp") or {}
+        if handler_doc.get("type") == "mcp" and (
+            mcp_cfg.get("command") or mcp_cfg.get("transport") == "stdio"
+        ):
+            return 400, {"error": "stdio MCP handlers cannot be tool-tested "
+                                  "from the operator; use streamable-http"}
         known = {
             "name", "type", "description", "input_schema", "url", "method",
-            "headers", "timeout_s",
+            "headers", "timeout_s", "endpoint", "tls", "auth_token",
+            "auth_header", "mcp", "spec", "spec_url", "base_url",
+            "operation", "remote_name",
         }
         try:
             handler = ToolHandler(
@@ -150,7 +164,10 @@ class OperatorAPI:
         # same name (and reset its circuit breaker) for live traffic.
         executor = ToolExecutor([handler])
         t0 = time.monotonic()
-        outcome = executor.execute(handler.name, body.get("arguments", {}))
+        try:
+            outcome = executor.execute(handler.name, body.get("arguments", {}))
+        finally:
+            executor.close()
         return 200, {
             "ok": not outcome.is_error,
             "result": outcome.content,

@@ -149,13 +149,52 @@ def _prompt_pack_schema() -> dict:
 
 
 def _tool_registry_schema() -> dict:
+    # handler carries per-type config blocks, mirroring the reference's
+    # HandlerEntry (reference internal/runtime/tools/config.go:131-169:
+    # grpcConfig/mcpConfig/openAPIConfig alongside the plain http fields).
+    handler = _obj({
+        "type": _str(enum=TOOL_HANDLER_TYPES),
+        "url": _str(),
+        "method": _str(),
+        "headers": _obj(open_=True),
+        "timeoutSeconds": _NUM,
+        "endpoint": _str(),
+        "remoteName": _str(),
+        "operation": _str(),
+        "spec": _obj(open_=True),
+        "specURL": _str(),
+        "baseURL": _str(),
+        "grpcConfig": _obj({
+            "endpoint": _str(),
+            "tls": _BOOL,
+            "authToken": _str(),
+        }, open_=True),
+        "mcpConfig": _obj({
+            "transport": _str(enum=("stdio", "http", "streamable-http")),
+            "command": _str(),
+            "args": _arr(_str()),
+            "env": _obj(open_=True),
+            "workDir": _str(),
+            "endpoint": _str(),
+            "headers": _obj(open_=True),
+            "toolFilter": _obj({
+                "allowlist": _arr(_str()),
+                "blocklist": _arr(_str()),
+            }),
+        }),
+        "openAPIConfig": _obj({
+            "specURL": _str(),
+            "baseURL": _str(),
+            "headers": _obj(open_=True),
+        }, open_=True),
+    }, required=["type"])
     return _obj({
         "tools": _arr(_obj({
             "name": _str(),
             "description": _str(),
-            "type": _str(enum=TOOL_HANDLER_TYPES),
-            "endpoint": _str(),
-            "input_schema": _obj(open_=True),
+            "handler": handler,
+            "inputSchema": _obj(open_=True),
+            "input_schema": _obj(open_=True),  # legacy spelling, examples/
             "auth": _obj(open_=True),
             "timeout_s": _NUM,
         }, required=["name"])),

@@ -121,23 +121,38 @@ class AgentDeployment:
 
 
 def _build_tool_handlers(tool_configs: list[dict]):
+    """CRD tools[] entries → executor handlers. All five handler types
+    route (reference internal/runtime/tools/config.go:131-169 HandlerEntry
+    carries per-type config blocks; same shape here in camelCase)."""
     from omnia_tpu.tools.executor import ToolHandler
 
     handlers = []
     for t in tool_configs:
         h = t.get("handler", {})
+        htype = h.get("type", "http")
+        if htype not in ("http", "openapi", "grpc", "mcp", "client"):
+            htype = "http"
+        grpc_cfg = h.get("grpcConfig", {})
+        openapi_cfg = h.get("openAPIConfig", {})
         handlers.append(
             ToolHandler(
                 name=t["name"],
-                type={"http": "http", "openapi": "openapi", "client": "client"}.get(
-                    h.get("type", "http"), "http"
-                ),
+                type=htype,
                 description=t.get("description", ""),
-                input_schema=t.get("inputSchema"),
+                input_schema=t.get("inputSchema", t.get("input_schema")),
                 url=h.get("url", ""),
                 method=h.get("method", "POST"),
-                headers=h.get("headers", {}),
-                timeout_s=h.get("timeoutSeconds", 30.0),
+                headers=h.get("headers", openapi_cfg.get("headers", {})),
+                timeout_s=h.get("timeoutSeconds", t.get("timeout_s", 30.0)),
+                endpoint=h.get("endpoint", grpc_cfg.get("endpoint", "")),
+                tls=bool(grpc_cfg.get("tls", h.get("tls", False))),
+                auth_token=grpc_cfg.get("authToken", h.get("authToken", "")),
+                mcp=h.get("mcpConfig") or h.get("mcp"),
+                spec=h.get("spec"),
+                spec_url=h.get("specURL", openapi_cfg.get("specURL", "")),
+                base_url=h.get("baseURL", openapi_cfg.get("baseURL", "")),
+                operation=h.get("operation", ""),
+                remote_name=h.get("remoteName", ""),
             )
         )
     return handlers

@@ -142,11 +142,33 @@ def _validate_tool_registry(spec: dict, errs: list[str]) -> None:
         if t["name"] in seen:
             errs.append(f"duplicate tool name {t['name']!r}")
         seen.add(t["name"])
-        ht = t.get("handler", {}).get("type")
+        h = t.get("handler", {})
+        ht = h.get("type")
         if ht not in TOOL_HANDLER_TYPES:
             errs.append(
                 f"tool {t['name']}: handler.type must be one of {TOOL_HANDLER_TYPES}"
             )
+            continue
+        # Per-type required config (reference HandlerEntry carries a
+        # matching config block per type, config.go:131-169).
+        if ht == "http" and not h.get("url"):
+            errs.append(f"tool {t['name']}: http handler needs url")
+        elif ht == "grpc" and not (h.get("endpoint") or h.get("grpcConfig", {}).get("endpoint")):
+            errs.append(f"tool {t['name']}: grpc handler needs endpoint")
+        elif ht == "mcp":
+            mcp = h.get("mcpConfig") or h.get("mcp") or {}
+            if not (mcp.get("command") or mcp.get("endpoint")):
+                errs.append(
+                    f"tool {t['name']}: mcp handler needs mcpConfig.command "
+                    "(stdio) or mcpConfig.endpoint (streamable-http)"
+                )
+        elif ht == "openapi":
+            oa = h.get("openAPIConfig", {})
+            if not (h.get("spec") or h.get("specURL") or oa.get("specURL")
+                    or h.get("url")):
+                errs.append(
+                    f"tool {t['name']}: openapi handler needs spec/specURL"
+                )
 
 
 def _validate_workspace(spec: dict, errs: list[str]) -> None:

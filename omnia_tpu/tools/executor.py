@@ -6,10 +6,13 @@ openapi backends with a circuit breaker + classified retries per handler;
 client-side tools are suspended up to the facade). Here:
 
 - handler types: python (in-process callable), http (JSON POST),
-  openapi (operation mapped to http), client (suspension marker);
-  mcp/grpc handlers arrive with the transport work.
+  grpc (omnia.tools.v1.ToolService client — grpc_transport.py),
+  mcp (stdio/streamable-http JSON-RPC client — mcp_client.py),
+  openapi (spec-parsed operation mapping — openapi.py),
+  client (suspension marker). All five CRD handler types execute.
 - resilience: per-handler circuit breaker + classified retries
-  (retry on transport/5xx, never on 4xx), wall-clock execution timeout.
+  (retry on transport/5xx/UNAVAILABLE, never on 4xx/INVALID_ARGUMENT),
+  wall-clock execution timeout.
 - policy hook: an optional decision callback runs before every dispatch
   (the EE policy-broker seam, fail-closed).
 """
@@ -45,20 +48,40 @@ class ToolOutcome:
 @dataclasses.dataclass
 class ToolHandler:
     name: str
-    type: str = "python"              # python | http | openapi | client
+    type: str = "python"      # python | http | grpc | mcp | openapi | client
     description: str = ""
     input_schema: Optional[dict] = None
     # python
     fn: Optional[Callable[[dict], Any]] = None
-    # http / openapi
+    # http
     url: str = ""
     method: str = "POST"
     headers: dict = dataclasses.field(default_factory=dict)
     timeout_s: float = DEFAULT_TIMEOUT_S
+    # grpc: ToolService endpoint (host:port) + auth
+    #   (reference internal/runtime/tools/config.go:196 GRPCCfg)
+    endpoint: str = ""
+    tls: bool = False
+    auth_token: str = ""
+    auth_header: str = "authorization"
+    # mcp: transport config {transport, command, args, env, workDir,
+    #   endpoint, headers, toolFilter} (config.go:213 MCPCfg)
+    mcp: Optional[dict] = None
+    # openapi: spec source + operation binding (config.go:246 OpenAPICfg)
+    spec: Optional[Any] = None        # inline dict or JSON/YAML text
+    spec_url: str = ""                # URL or file path
+    base_url: str = ""
+    operation: str = ""               # operationId; defaults to remote_name
+    # name of the tool on the remote grpc/mcp server (defaults to `name`)
+    remote_name: str = ""
 
     @property
     def client_side(self) -> bool:
         return self.type == "client"
+
+    @property
+    def remote_tool(self) -> str:
+        return self.remote_name or self.name
 
 
 class CircuitBreaker:
@@ -114,6 +137,11 @@ class ToolExecutor:
         self._breakers: dict[str, CircuitBreaker] = {}
         self._policy_check = policy_check
         self._max_retries = max_retries
+        # Lazily-built transport clients, shared across handlers that hit
+        # the same backend (one channel per grpc endpoint, one MCP session
+        # per server config, one parsed spec per openapi handler).
+        self._transports: dict[str, Any] = {}
+        self._transports_lock = threading.Lock()
         for h in handlers or []:
             self.register(h)
 
@@ -187,9 +215,195 @@ class ToolExecutor:
                 raise _FatalError(f"python tool {handler.name} has no fn")
             out = handler.fn(arguments)
             return ToolOutcome(out if isinstance(out, str) else json.dumps(out))
-        if handler.type in ("http", "openapi"):
+        if handler.type == "http":
             return self._dispatch_http(handler, arguments, context)
+        if handler.type == "grpc":
+            return self._dispatch_grpc(handler, arguments, context)
+        if handler.type == "mcp":
+            return self._dispatch_mcp(handler, arguments)
+        if handler.type == "openapi":
+            # Legacy shorthand kept from rounds 1-4: an openapi handler
+            # with a plain url and no spec degrades to the http path.
+            if handler.spec is None and not handler.spec_url:
+                return self._dispatch_http(handler, arguments, context)
+            return self._dispatch_openapi(handler, arguments)
         raise _FatalError(f"unsupported handler type {handler.type}")
+
+    # -- transport client cache ----------------------------------------
+
+    def _transport(self, key: str, build: Callable[[], Any]) -> Any:
+        # build() can spawn a process, dial a channel, or fetch a spec —
+        # it must run OUTSIDE the lock or one slow backend stalls every
+        # other tool dispatch. Double-checked insert; a raced duplicate
+        # is closed.
+        with self._transports_lock:
+            client = self._transports.get(key)
+        if client is not None:
+            return client
+        client = build()
+        with self._transports_lock:
+            existing = self._transports.get(key)
+            if existing is None:
+                self._transports[key] = client
+                return client
+        try:
+            client.close()
+        except Exception:  # closing the raced duplicate is best-effort
+            pass
+        return existing
+
+    def _evict_transport(self, key: str) -> None:
+        """Drop a (possibly dead) cached client so the retry re-dials —
+        an MCP stdio child that crashed stays dead otherwise."""
+        with self._transports_lock:
+            client = self._transports.pop(key, None)
+        if client is not None:
+            try:
+                client.close()
+            except Exception:  # closing a dead transport is best-effort
+                pass
+
+    def close(self) -> None:
+        with self._transports_lock:
+            clients, self._transports = list(self._transports.values()), {}
+        for c in clients:
+            try:
+                c.close()
+            except Exception:  # shutdown path: never raise past close()
+                pass
+
+    # -- grpc -----------------------------------------------------------
+
+    def _dispatch_grpc(self, handler: ToolHandler, arguments: dict, context: dict) -> ToolOutcome:
+        import grpc as _grpc
+
+        from omnia_tpu.tools.grpc_transport import GrpcToolClient, is_retryable
+
+        if not handler.endpoint:
+            raise _FatalError(f"grpc tool {handler.name} has no endpoint")
+        key = (f"grpc:{handler.endpoint}:{handler.tls}:"
+               f"{handler.auth_header}:{handler.auth_token}:{handler.timeout_s}")
+        client = self._transport(key, lambda: GrpcToolClient(
+            handler.endpoint, tls=handler.tls,
+            auth_token=handler.auth_token, auth_header=handler.auth_header,
+            timeout_s=handler.timeout_s,
+        ))
+        metadata = {
+            k: str(v) for k, v in context.items()
+            if k in ("session_id", "agent", "user_id") and v
+        }
+        try:
+            resp = client.execute(
+                handler.remote_tool, arguments, metadata,
+                timeout_s=handler.timeout_s,
+            )
+        except _grpc.RpcError as e:
+            if is_retryable(e):
+                raise _RetryableError(
+                    f"grpc {e.code().name} from {handler.endpoint}"
+                ) from e
+            raise _FatalError(
+                f"grpc {e.code().name} from {handler.endpoint}: {e.details()}"
+            ) from e
+        if resp.is_error:
+            # Application-level tool failure: surfaces to the model,
+            # never retried (reference omnia_executor_grpc.go:228).
+            return ToolOutcome(resp.error_message or "tool error", is_error=True)
+        return ToolOutcome(resp.result_json)
+
+    # -- mcp ------------------------------------------------------------
+
+    def _mcp_key(self, handler: ToolHandler) -> str:
+        return "mcp:" + json.dumps(handler.mcp or {}, sort_keys=True, default=str)
+
+    def _dispatch_mcp(self, handler: ToolHandler, arguments: dict) -> ToolOutcome:
+        from omnia_tpu.tools.mcp_client import (
+            MCPClient, MCPProtocolError, MCPTransportError,
+        )
+
+        if not handler.mcp:
+            raise _FatalError(f"mcp tool {handler.name} has no mcp config")
+        key = self._mcp_key(handler)
+        client = self._transport(
+            key, lambda: MCPClient.from_config(handler.mcp, handler.timeout_s)
+        )
+        try:
+            content, is_error = client.call_tool(handler.remote_tool, arguments)
+        except MCPTransportError as e:
+            self._evict_transport(key)
+            raise _RetryableError(str(e)) from e
+        except MCPProtocolError as e:
+            raise _FatalError(str(e)) from e
+        return ToolOutcome(content, is_error=is_error)
+
+    # -- openapi ---------------------------------------------------------
+
+    def _dispatch_openapi(self, handler: ToolHandler, arguments: dict) -> ToolOutcome:
+        from omnia_tpu.tools.openapi import OpenAPIAdapter
+
+        # Keyed by connection config (like grpc/mcp) so re-registering a
+        # same-name handler with a new spec/base_url doesn't serve the
+        # stale cached adapter.
+        key = "openapi:" + json.dumps({
+            "spec_url": handler.spec_url,
+            "base_url": handler.base_url,
+            "headers": handler.headers,
+            "timeout_s": handler.timeout_s,
+            "spec": handler.spec if isinstance(handler.spec, str) else None,
+            "spec_id": id(handler.spec) if isinstance(handler.spec, dict) else None,
+        }, sort_keys=True)
+
+        def build():
+            if handler.spec is not None:
+                spec = (handler.spec if isinstance(handler.spec, dict)
+                        else OpenAPIAdapter.parse_text(str(handler.spec)))
+                return OpenAPIAdapter(
+                    spec, base_url=handler.base_url,
+                    headers=handler.headers, timeout_s=handler.timeout_s,
+                )
+            return OpenAPIAdapter.load(
+                handler.spec_url, base_url=handler.base_url,
+                headers=handler.headers, timeout_s=handler.timeout_s,
+            )
+
+        try:
+            adapter = self._transport(key, build)
+        except urllib.error.HTTPError as e:
+            # 4xx on the spec URL is deterministic — retrying refetches a
+            # spec that will 404 again (HTTPError subclasses OSError, so
+            # it must be classified before the transport branch).
+            if e.code >= 500:
+                raise _RetryableError(
+                    f"openapi spec fetch for {handler.name}: HTTP {e.code}"
+                ) from e
+            raise _FatalError(
+                f"openapi spec fetch for {handler.name}: HTTP {e.code}"
+            ) from e
+        except (ValueError, KeyError) as e:  # malformed spec: never retry
+            raise _FatalError(
+                f"openapi spec parse for {handler.name}: {e}"
+            ) from e
+        except OSError as e:
+            raise _RetryableError(
+                f"openapi spec load for {handler.name}: {e}"
+            ) from e
+        op_id = handler.operation or handler.remote_tool
+        try:
+            return ToolOutcome(adapter.call(op_id, arguments))
+        except KeyError as e:
+            raise _FatalError(str(e)) from e
+        except urllib.error.HTTPError as e:
+            if e.code >= 500:
+                raise _RetryableError(f"HTTP {e.code} from {handler.name}") from e
+            raise _FatalError(
+                f"HTTP {e.code} from {handler.name}: {e.reason}"
+            ) from e
+        except urllib.error.URLError as e:
+            raise _RetryableError(
+                f"transport error calling {handler.name}: {e.reason}"
+            ) from e
+        except ValueError as e:  # missing required path param etc.
+            raise _FatalError(str(e)) from e
 
     def _dispatch_http(self, handler: ToolHandler, arguments: dict, context: dict) -> ToolOutcome:
         body = json.dumps(arguments).encode()

@@ -1,0 +1,221 @@
+"""gRPC tool transport: ToolService client + provider server.
+
+Mirrors the reference's gRPC executor path (reference internal/runtime/
+tools/omnia_executor_grpc.go:53/:138 dials the endpoint, attaches bearer
+auth metadata, calls omnia.tools.v1.ToolService/Execute, and maps
+ToolResponse.is_error back into the conversation) and its provider-side
+contract (api/proto/tools/v1/tools.proto). The wire messages come from
+`toolsproto` (programmatic descriptors, same bytes as generated code).
+
+`GrpcToolServer` is the provider half: it serves any python callables
+over the contract — used by tests as the fixture server and by users as
+the in-tree way to expose a tool service (the reference ships provider
+examples implementing the same proto).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from concurrent import futures
+from typing import Any, Callable, Optional
+
+import grpc
+
+from omnia_tpu.tools import toolsproto as tp
+
+
+class GrpcToolClient:
+    """One channel per endpoint; thread-safe, lazily dialed."""
+
+    def __init__(
+        self,
+        endpoint: str,
+        tls: bool = False,
+        auth_token: str = "",
+        auth_header: str = "authorization",
+        timeout_s: float = 30.0,
+    ):
+        self.endpoint = endpoint
+        self.timeout_s = timeout_s
+        self._metadata = []
+        if auth_token:
+            value = auth_token
+            if auth_header.lower() == "authorization" and not value.lower().startswith("bearer "):
+                value = f"Bearer {value}"
+            self._metadata.append((auth_header.lower(), value))
+        if tls:
+            self._channel = grpc.secure_channel(
+                endpoint, grpc.ssl_channel_credentials()
+            )
+        else:
+            self._channel = grpc.insecure_channel(endpoint)
+        self._execute = self._channel.unary_unary(
+            tp.EXECUTE_METHOD,
+            request_serializer=lambda m: m.SerializeToString(),
+            response_deserializer=tp.ToolResponse.FromString,
+        )
+        self._list = self._channel.unary_unary(
+            tp.LIST_TOOLS_METHOD,
+            request_serializer=lambda m: m.SerializeToString(),
+            response_deserializer=tp.ListToolsResponse.FromString,
+        )
+
+    def execute(
+        self,
+        tool_name: str,
+        arguments: dict,
+        metadata: Optional[dict] = None,
+        timeout_s: Optional[float] = None,
+    ):
+        """Returns the raw ToolResponse; grpc.RpcError propagates for the
+        caller to classify (UNAVAILABLE/DEADLINE retryable, rest fatal)."""
+        req = tp.ToolRequest(
+            tool_name=tool_name, arguments_json=json.dumps(arguments)
+        )
+        for k, v in (metadata or {}).items():
+            req.metadata[k] = str(v)
+        return self._execute(
+            req, timeout=timeout_s or self.timeout_s, metadata=self._metadata
+        )
+
+    def list_tools(self, timeout_s: Optional[float] = None) -> list[dict]:
+        resp = self._list(
+            tp.ListToolsRequest(),
+            timeout=timeout_s or self.timeout_s,
+            metadata=self._metadata,
+        )
+        out = []
+        for t in resp.tools:
+            schema = None
+            if t.input_schema:
+                try:
+                    schema = json.loads(t.input_schema)
+                except json.JSONDecodeError:
+                    schema = None
+            out.append({
+                "name": t.name,
+                "description": t.description,
+                "input_schema": schema,
+            })
+        return out
+
+    def close(self) -> None:
+        self._channel.close()
+
+
+RETRYABLE_CODES = frozenset((
+    grpc.StatusCode.UNAVAILABLE,
+    grpc.StatusCode.DEADLINE_EXCEEDED,
+    grpc.StatusCode.RESOURCE_EXHAUSTED,
+    grpc.StatusCode.ABORTED,
+))
+
+
+def is_retryable(err: grpc.RpcError) -> bool:
+    code = err.code() if callable(getattr(err, "code", None)) else None
+    return code in RETRYABLE_CODES
+
+
+# ---------------------------------------------------------------------------
+# Provider side
+
+
+class GrpcToolServer:
+    """Serve python tools over omnia.tools.v1.ToolService.
+
+    tools: {name: (callable(dict)->Any, description, input_schema|None)}
+    or {name: callable} shorthand.
+    """
+
+    def __init__(
+        self,
+        tools: dict,
+        port: int = 0,
+        require_token: str = "",
+        max_workers: int = 8,
+    ):
+        self._tools: dict[str, tuple[Callable[[dict], Any], str, Optional[dict]]] = {}
+        for name, spec in tools.items():
+            if callable(spec):
+                self._tools[name] = (spec, "", None)
+            else:
+                fn, desc, schema = (list(spec) + ["", None])[:3]
+                self._tools[name] = (fn, desc or "", schema)
+        self._require_token = require_token
+        self._server = grpc.server(
+            futures.ThreadPoolExecutor(max_workers=max_workers)
+        )
+        self._server.add_generic_rpc_handlers((self._handler(),))
+        self.port = self._server.add_insecure_port(f"127.0.0.1:{port}")
+        self._started = threading.Event()
+
+    @property
+    def endpoint(self) -> str:
+        return f"127.0.0.1:{self.port}"
+
+    def _auth_ok(self, context) -> bool:
+        if not self._require_token:
+            return True
+        md = dict(context.invocation_metadata())
+        tok = md.get("authorization", "")
+        return tok == f"Bearer {self._require_token}" or tok == self._require_token
+
+    def _do_execute(self, request, context):
+        if not self._auth_ok(context):
+            context.abort(grpc.StatusCode.UNAUTHENTICATED, "bad token")
+        entry = self._tools.get(request.tool_name)
+        if entry is None:
+            return tp.ToolResponse(
+                is_error=True,
+                error_message=f"unknown tool: {request.tool_name}",
+            )
+        fn, _, _ = entry
+        try:
+            args = json.loads(request.arguments_json or "{}")
+        except json.JSONDecodeError as e:
+            return tp.ToolResponse(
+                is_error=True, error_message=f"bad arguments_json: {e}"
+            )
+        try:
+            out = fn(args)
+        except Exception as e:  # tool errors flow back, not crash the RPC
+            return tp.ToolResponse(is_error=True, error_message=str(e))
+        return tp.ToolResponse(
+            result_json=out if isinstance(out, str) else json.dumps(out)
+        )
+
+    def _do_list(self, request, context):
+        if not self._auth_ok(context):
+            context.abort(grpc.StatusCode.UNAUTHENTICATED, "bad token")
+        resp = tp.ListToolsResponse()
+        for name, (_, desc, schema) in sorted(self._tools.items()):
+            resp.tools.append(tp.ToolInfo(
+                name=name,
+                description=desc,
+                input_schema=json.dumps(schema) if schema else "",
+            ))
+        return resp
+
+    def _handler(self):
+        handlers = {
+            "Execute": grpc.unary_unary_rpc_method_handler(
+                self._do_execute,
+                request_deserializer=tp.ToolRequest.FromString,
+                response_serializer=lambda m: m.SerializeToString(),
+            ),
+            "ListTools": grpc.unary_unary_rpc_method_handler(
+                self._do_list,
+                request_deserializer=tp.ListToolsRequest.FromString,
+                response_serializer=lambda m: m.SerializeToString(),
+            ),
+        }
+        return grpc.method_handlers_generic_handler(tp.SERVICE, handlers)
+
+    def start(self) -> "GrpcToolServer":
+        self._server.start()
+        self._started.set()
+        return self
+
+    def stop(self, grace: float = 0.5) -> None:
+        self._server.stop(grace)

@@ -1,0 +1,291 @@
+"""MCP (Model Context Protocol) client: stdio + streamable-http transports.
+
+Counterpart of the reference's MCP executor path (reference internal/
+runtime/tools/omnia_executor_mcp.go:44 builds a transport from MCPCfg
+{transport, endpoint|command+args+env}, initializes the session, and
+:219/:259 routes tool calls through tools/call with the breaker; an
+allow/blocklist filter gates which remote tools are exposed,
+config.go:213-238).
+
+MCP is JSON-RPC 2.0:
+- stdio: newline-delimited JSON-RPC over a child process's stdin/stdout
+  (messages must not contain embedded newlines).
+- streamable http: each JSON-RPC request is an HTTP POST to the MCP
+  endpoint; the response is either application/json (single message) or
+  text/event-stream (SSE frames, last data: line carries the response).
+  The server may mint an `Mcp-Session-Id` on initialize which the client
+  echoes on every subsequent request.
+
+Handshake: initialize -> notifications/initialized, then tools/list and
+tools/call {name, arguments} -> {content: [{type:text,...}], isError}.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import threading
+import urllib.error
+import urllib.request
+from typing import Any, Optional
+
+PROTOCOL_VERSION = "2025-03-26"
+CLIENT_INFO = {"name": "omnia-tpu", "version": "0.1"}
+
+
+class MCPTransportError(RuntimeError):
+    """Transport-level failure (process died, HTTP unreachable) — the
+    executor classifies these retryable."""
+
+
+class MCPProtocolError(RuntimeError):
+    """JSON-RPC error response — deterministic, never retried."""
+
+
+class StdioTransport:
+    def __init__(self, command: str, args: Optional[list] = None,
+                 env: Optional[dict] = None, workdir: str = "",
+                 timeout_s: float = 30.0):
+        self._timeout_s = timeout_s
+        full_env = dict(os.environ)
+        full_env.update(env or {})
+        try:
+            self._proc = subprocess.Popen(
+                [command, *(args or [])],
+                stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+                stderr=subprocess.DEVNULL,
+                cwd=workdir or None, env=full_env, text=True, bufsize=1,
+            )
+        except OSError as e:
+            raise MCPTransportError(f"spawn {command}: {e}") from e
+        self._lock = threading.Lock()
+
+    def request(self, payload: dict) -> Optional[dict]:
+        """Send one JSON-RPC message; if it carries an id, read frames
+        until the matching response (server-initiated notifications are
+        skipped). A watchdog timer kills a hung server so the blocking
+        readline cannot wedge the agent turn."""
+        want_id = payload.get("id")
+        line = json.dumps(payload, separators=(",", ":"))
+        with self._lock:
+            if self._proc.poll() is not None:
+                raise MCPTransportError("mcp server process exited")
+            try:
+                self._proc.stdin.write(line + "\n")
+                self._proc.stdin.flush()
+            except (BrokenPipeError, OSError) as e:
+                raise MCPTransportError(f"mcp stdin write: {e}") from e
+            if want_id is None:
+                return None
+            watchdog = threading.Timer(self._timeout_s, self._proc.kill)
+            watchdog.start()
+            try:
+                while True:
+                    raw = self._proc.stdout.readline()
+                    if not raw:
+                        raise MCPTransportError(
+                            "mcp server closed stdout (timeout or crash)"
+                        )
+                    raw = raw.strip()
+                    if not raw:
+                        continue
+                    try:
+                        msg = json.loads(raw)
+                    except json.JSONDecodeError:
+                        continue  # stray non-JSON output on stdout
+                    if msg.get("id") == want_id:
+                        return msg
+            finally:
+                watchdog.cancel()
+
+    def close(self) -> None:
+        try:
+            self._proc.terminate()
+            self._proc.wait(timeout=2)
+        except Exception:
+            self._proc.kill()
+
+
+class StreamableHttpTransport:
+    def __init__(self, endpoint: str, headers: Optional[dict] = None,
+                 timeout_s: float = 30.0):
+        self.endpoint = endpoint
+        self._headers = dict(headers or {})
+        self._timeout_s = timeout_s
+        self._session_id: Optional[str] = None
+
+    def request(self, payload: dict) -> Optional[dict]:
+        body = json.dumps(payload).encode()
+        headers = {
+            "Content-Type": "application/json",
+            "Accept": "application/json, text/event-stream",
+            **self._headers,
+        }
+        if self._session_id:
+            headers["Mcp-Session-Id"] = self._session_id
+        req = urllib.request.Request(
+            self.endpoint, data=body, method="POST", headers=headers
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=self._timeout_s) as resp:
+                sid = resp.headers.get("Mcp-Session-Id")
+                if sid:
+                    self._session_id = sid
+                if payload.get("id") is None:
+                    return None  # notification: 202, no body expected
+                ctype = resp.headers.get("Content-Type", "")
+                raw = resp.read().decode("utf-8", errors="replace")
+        except urllib.error.HTTPError as e:
+            if e.code >= 500:
+                raise MCPTransportError(
+                    f"mcp http {e.code} from {self.endpoint}"
+                ) from e
+            # 4xx (bad auth, wrong path) is deterministic — surfacing it
+            # as a protocol error keeps the executor from retry-dialing.
+            raise MCPProtocolError(
+                f"mcp http {e.code} from {self.endpoint}"
+            ) from e
+        except urllib.error.URLError as e:
+            raise MCPTransportError(
+                f"mcp transport to {self.endpoint}: {e.reason}"
+            ) from e
+        if "text/event-stream" in ctype:
+            return self._last_sse_message(raw, payload.get("id"))
+        try:
+            return json.loads(raw)
+        except json.JSONDecodeError as e:
+            raise MCPTransportError(f"mcp bad json response: {e}") from e
+
+    @staticmethod
+    def _last_sse_message(raw: str, want_id) -> dict:
+        """The response rides an SSE stream: concatenate each event's
+        data: lines, return the message whose id matches."""
+        match = None
+        for event in raw.split("\n\n"):
+            data = "\n".join(
+                ln[5:].lstrip() for ln in event.splitlines()
+                if ln.startswith("data:")
+            )
+            if not data:
+                continue
+            try:
+                msg = json.loads(data)
+            except json.JSONDecodeError:
+                continue
+            if msg.get("id") == want_id:
+                match = msg
+        if match is None:
+            raise MCPTransportError("mcp sse stream carried no response")
+        return match
+
+    def close(self) -> None:
+        pass
+
+
+class MCPClient:
+    """One MCP session (initialize handshake done lazily on first use)."""
+
+    def __init__(self, transport, tool_filter: Optional[dict] = None):
+        self._t = transport
+        self._next_id = 0
+        self._lock = threading.Lock()
+        self._initialized = False
+        self._filter = tool_filter or {}
+        self.server_info: dict = {}
+
+    @classmethod
+    def from_config(cls, cfg: dict, timeout_s: float = 30.0) -> "MCPClient":
+        """cfg mirrors the CRD's mcp handler block: {transport:
+        stdio|http|streamable-http, command, args, env, workDir,
+        endpoint, headers, toolFilter:{allowlist,blocklist}}."""
+        kind = (cfg.get("transport") or ("stdio" if cfg.get("command") else "http")).lower()
+        if kind == "stdio":
+            if not cfg.get("command"):
+                raise ValueError("mcp stdio transport requires command")
+            t = StdioTransport(
+                cfg["command"], cfg.get("args"), cfg.get("env"),
+                cfg.get("workDir", ""), timeout_s,
+            )
+        elif kind in ("http", "streamable-http", "streamablehttp"):
+            if not cfg.get("endpoint"):
+                raise ValueError("mcp http transport requires endpoint")
+            t = StreamableHttpTransport(
+                cfg["endpoint"], cfg.get("headers"), timeout_s
+            )
+        else:
+            raise ValueError(f"unknown mcp transport {kind!r}")
+        return cls(t, cfg.get("toolFilter"))
+
+    def _rpc(self, method: str, params: Optional[dict] = None) -> Any:
+        with self._lock:
+            self._next_id += 1
+            rid = self._next_id
+        resp = self._t.request({
+            "jsonrpc": "2.0", "id": rid, "method": method,
+            "params": params or {},
+        })
+        if resp is None:
+            raise MCPTransportError(f"no response to {method}")
+        if "error" in resp:
+            err = resp["error"]
+            raise MCPProtocolError(
+                f"{method}: {err.get('message')} (code {err.get('code')})"
+            )
+        return resp.get("result")
+
+    def _notify(self, method: str) -> None:
+        self._t.request({"jsonrpc": "2.0", "method": method})
+
+    def ensure_initialized(self) -> None:
+        if self._initialized:
+            return
+        result = self._rpc("initialize", {
+            "protocolVersion": PROTOCOL_VERSION,
+            "capabilities": {},
+            "clientInfo": CLIENT_INFO,
+        })
+        self.server_info = (result or {}).get("serverInfo", {})
+        self._notify("notifications/initialized")
+        self._initialized = True
+
+    def _included(self, name: str) -> bool:
+        allow = self._filter.get("allowlist") or []
+        block = self._filter.get("blocklist") or []
+        if name in block:
+            return False
+        return not allow or name in allow
+
+    def list_tools(self) -> list[dict]:
+        self.ensure_initialized()
+        result = self._rpc("tools/list") or {}
+        return [
+            {
+                "name": t["name"],
+                "description": t.get("description", ""),
+                "input_schema": t.get("inputSchema"),
+            }
+            for t in result.get("tools", [])
+            if self._included(t.get("name", ""))
+        ]
+
+    def call_tool(self, name: str, arguments: dict) -> tuple[str, bool]:
+        """Returns (text content, is_error)."""
+        self.ensure_initialized()
+        if not self._included(name):
+            return f"tool {name} blocked by MCP tool filter", True
+        result = self._rpc("tools/call", {"name": name, "arguments": arguments})
+        if result is None:
+            return "mcp tools/call returned no result", True
+        parts = []
+        for item in result.get("content", []):
+            if item.get("type") == "text":
+                parts.append(item.get("text", ""))
+            else:
+                parts.append(json.dumps(item))
+        if not parts and "structuredContent" in result:
+            parts.append(json.dumps(result["structuredContent"]))
+        return "\n".join(parts), bool(result.get("isError"))
+
+    def close(self) -> None:
+        self._t.close()

@@ -1,0 +1,227 @@
+"""OpenAPI tool adapter: parse a spec, map operations to callable tools.
+
+Counterpart of the reference's openapi path (reference internal/runtime/
+tools/openapi_adapter.go:135 fetches+parses the spec on Connect,
+:198 lists each operation as a tool whose input schema is synthesized
+from parameters + requestBody, :210 maps tool args back onto the HTTP
+request; openapi_parser.go / openapi_request.go do the spec walk and
+request build). Previously `type: openapi` was a plain-http synonym —
+this is the real mapping.
+
+Supports OpenAPI 3.x (and Swagger 2 basics) in JSON or YAML, local
+inline specs, file paths, or spec URLs. $ref resolution is local-file
+only (`#/components/...`).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import re
+import urllib.parse
+import urllib.request
+from typing import Any, Optional
+
+
+@dataclasses.dataclass
+class Operation:
+    op_id: str
+    method: str
+    path: str
+    description: str = ""
+    params: list = dataclasses.field(default_factory=list)  # (name, loc, required, schema)
+    body_schema: Optional[dict] = None
+    body_required: bool = False
+
+    def input_schema(self) -> dict:
+        """Synthesize one JSON schema: parameters + flattened requestBody
+        object properties become top-level properties (the reference
+        flattens the same way so model-facing tools stay one-level)."""
+        props: dict[str, Any] = {}
+        required: list[str] = []
+        for name, _loc, req, schema in self.params:
+            props[name] = schema or {"type": "string"}
+            if req:
+                required.append(name)
+        body = self.body_schema or {}
+        if body.get("type") == "object" or "properties" in body:
+            for k, v in (body.get("properties") or {}).items():
+                props.setdefault(k, v)
+            for k in body.get("required") or []:
+                if k not in required:
+                    required.append(k)
+        elif body:
+            props.setdefault("body", body)
+            if self.body_required:
+                required.append("body")
+        out: dict[str, Any] = {"type": "object", "properties": props}
+        if required:
+            out["required"] = required
+        return out
+
+
+class OpenAPIAdapter:
+    def __init__(self, spec: dict, base_url: str = "",
+                 headers: Optional[dict] = None,
+                 operation_filter: Optional[list] = None,
+                 timeout_s: float = 30.0):
+        self._spec = spec
+        self._headers = dict(headers or {})
+        self._timeout_s = timeout_s
+        self.base_url = (base_url or self._server_url()).rstrip("/")
+        self.ops: dict[str, Operation] = {}
+        self._parse(operation_filter or [])
+
+    # -- loading -------------------------------------------------------
+
+    @classmethod
+    def load(cls, source: str, **kw) -> "OpenAPIAdapter":
+        """source: URL, file path, or inline JSON/YAML text."""
+        text = source
+        if source.startswith(("http://", "https://")):
+            with urllib.request.urlopen(source, timeout=30) as r:
+                text = r.read().decode("utf-8", errors="replace")
+        elif not source.lstrip().startswith(("{", "openapi", "swagger", "info")):
+            with open(source, encoding="utf-8") as f:
+                text = f.read()
+        return cls(cls.parse_text(text), **kw)
+
+    @staticmethod
+    def parse_text(text: str) -> dict:
+        text = text.lstrip()
+        if text.startswith("{"):
+            return json.loads(text)
+        import yaml
+
+        try:
+            doc = yaml.safe_load(text)
+        except yaml.YAMLError as e:
+            raise ValueError(f"openapi spec is not valid YAML: {e}") from e
+        if not isinstance(doc, dict):
+            raise ValueError("openapi spec did not parse to a mapping")
+        return doc
+
+    # -- parsing -------------------------------------------------------
+
+    def _server_url(self) -> str:
+        servers = self._spec.get("servers") or []
+        if servers and servers[0].get("url"):
+            return servers[0]["url"]
+        host = self._spec.get("host")  # swagger 2
+        if host:
+            scheme = (self._spec.get("schemes") or ["https"])[0]
+            return f"{scheme}://{host}{self._spec.get('basePath', '')}"
+        return ""
+
+    def _resolve(self, node: Any, depth: int = 0) -> Any:
+        """Local $ref resolution, cycle-bounded."""
+        if depth > 16 or not isinstance(node, dict):
+            return node
+        ref = node.get("$ref")
+        if isinstance(ref, str) and ref.startswith("#/"):
+            target: Any = self._spec
+            for part in ref[2:].split("/"):
+                if not isinstance(target, dict) or part not in target:
+                    return {}
+                target = target[part]
+            return self._resolve(target, depth + 1)
+        return node
+
+    def _parse(self, op_filter: list) -> None:
+        for path, item in (self._spec.get("paths") or {}).items():
+            item = self._resolve(item)
+            shared = [self._resolve(p) for p in item.get("parameters", [])]
+            for method in ("get", "post", "put", "patch", "delete", "head"):
+                op = item.get(method)
+                if not isinstance(op, dict):
+                    continue
+                op_id = op.get("operationId") or (
+                    f"{method}_" + re.sub(r"[^a-zA-Z0-9]+", "_", path).strip("_")
+                )
+                if op_filter and op_id not in op_filter:
+                    continue
+                params = []
+                for p in shared + [self._resolve(q) for q in op.get("parameters", [])]:
+                    if not p.get("name"):
+                        continue
+                    schema = self._resolve(p.get("schema") or {})
+                    if not schema and p.get("type"):  # swagger 2 inline
+                        schema = {"type": p["type"]}
+                    params.append((
+                        p["name"], p.get("in", "query"),
+                        bool(p.get("required")), schema,
+                    ))
+                body_schema, body_required = None, False
+                rb = self._resolve(op.get("requestBody") or {})
+                if rb:
+                    body_required = bool(rb.get("required"))
+                    content = rb.get("content") or {}
+                    media = content.get("application/json") or next(
+                        iter(content.values()), {}
+                    )
+                    body_schema = self._resolve(media.get("schema") or {}) or None
+                self.ops[op_id] = Operation(
+                    op_id=op_id, method=method.upper(), path=path,
+                    description=op.get("summary") or op.get("description", ""),
+                    params=params, body_schema=body_schema,
+                    body_required=body_required,
+                )
+
+    # -- tool surface ---------------------------------------------------
+
+    def list_tools(self) -> list[dict]:
+        return [
+            {
+                "name": op.op_id,
+                "description": op.description,
+                "input_schema": op.input_schema(),
+            }
+            for op in self.ops.values()
+        ]
+
+    def build_request(self, op_id: str, args: dict) -> urllib.request.Request:
+        op = self.ops.get(op_id)
+        if op is None:
+            raise KeyError(f"unknown operation {op_id!r}")
+        path = op.path
+        query: list[tuple[str, str]] = []
+        headers = {**self._headers}
+        consumed = set()
+        for name, loc, required, _schema in op.params:
+            if name not in args:
+                if required and loc == "path":
+                    raise ValueError(f"{op_id}: missing path param {name!r}")
+                continue
+            val = args[name]
+            consumed.add(name)
+            if loc == "path":
+                path = path.replace(
+                    "{%s}" % name, urllib.parse.quote(str(val), safe="")
+                )
+            elif loc == "header":
+                headers[name] = str(val)
+            elif loc == "query":
+                if isinstance(val, (list, tuple)):
+                    query.extend((name, str(v)) for v in val)
+                else:
+                    query.append((name, str(val)))
+        url = self.base_url + path
+        if query:
+            url += "?" + urllib.parse.urlencode(query)
+        data = None
+        if op.method in ("POST", "PUT", "PATCH"):
+            if "body" in args and not any(n == "body" for n, *_ in op.params):
+                body_obj = args["body"]
+            else:
+                body_obj = {k: v for k, v in args.items() if k not in consumed}
+            if body_obj or op.body_required:
+                data = json.dumps(body_obj).encode()
+                headers.setdefault("Content-Type", "application/json")
+        return urllib.request.Request(
+            url, data=data, method=op.method, headers=headers
+        )
+
+    def call(self, op_id: str, args: dict) -> str:
+        req = self.build_request(op_id, args)
+        with urllib.request.urlopen(req, timeout=self._timeout_s) as resp:
+            return resp.read().decode("utf-8", errors="replace")

@@ -1,0 +1,103 @@
+"""omnia.tools.v1 protobuf contract, built programmatically.
+
+The reference defines the gRPC tool-provider contract in
+reference api/proto/tools/v1/tools.proto:12-17 (ToolService with
+Execute + ListTools over ToolRequest/ToolResponse/ToolInfo). This image
+ships the protobuf *runtime* but not the protoc python plugin, so instead
+of checked-in generated code the FileDescriptorProto is assembled here at
+import time and message classes are materialised from a private
+DescriptorPool — byte-for-byte the same wire format as the reference's
+generated `toolsv1` package, with no codegen step.
+"""
+
+from __future__ import annotations
+
+from google.protobuf import descriptor_pb2, descriptor_pool, message_factory
+
+SERVICE = "omnia.tools.v1.ToolService"
+EXECUTE_METHOD = f"/{SERVICE}/Execute"
+LIST_TOOLS_METHOD = f"/{SERVICE}/ListTools"
+
+_STR = descriptor_pb2.FieldDescriptorProto.TYPE_STRING
+_BOOL = descriptor_pb2.FieldDescriptorProto.TYPE_BOOL
+_MSG = descriptor_pb2.FieldDescriptorProto.TYPE_MESSAGE
+_OPT = descriptor_pb2.FieldDescriptorProto.LABEL_OPTIONAL
+_REP = descriptor_pb2.FieldDescriptorProto.LABEL_REPEATED
+
+
+def _field(name: str, number: int, ftype=_STR, label=_OPT, type_name: str = ""):
+    f = descriptor_pb2.FieldDescriptorProto(
+        name=name, number=number, type=ftype, label=label
+    )
+    if type_name:
+        f.type_name = type_name
+    return f
+
+
+def _build_file() -> descriptor_pb2.FileDescriptorProto:
+    fd = descriptor_pb2.FileDescriptorProto(
+        name="omnia/tools/v1/tools.proto",
+        package="omnia.tools.v1",
+        syntax="proto3",
+    )
+
+    req = fd.message_type.add(name="ToolRequest")
+    req.field.append(_field("tool_name", 1))
+    req.field.append(_field("arguments_json", 2))
+    # map<string,string> metadata = 3 — a map field is a repeated nested
+    # MetadataEntry message with the map_entry option set.
+    entry = req.nested_type.add(name="MetadataEntry")
+    entry.field.append(_field("key", 1))
+    entry.field.append(_field("value", 2))
+    entry.options.map_entry = True
+    req.field.append(_field(
+        "metadata", 3, _MSG, _REP,
+        ".omnia.tools.v1.ToolRequest.MetadataEntry",
+    ))
+
+    resp = fd.message_type.add(name="ToolResponse")
+    resp.field.append(_field("result_json", 1))
+    resp.field.append(_field("is_error", 2, _BOOL))
+    resp.field.append(_field("error_message", 3))
+
+    fd.message_type.add(name="ListToolsRequest")
+
+    info = fd.message_type.add(name="ToolInfo")
+    info.field.append(_field("name", 1))
+    info.field.append(_field("description", 2))
+    info.field.append(_field("input_schema", 3))
+
+    lresp = fd.message_type.add(name="ListToolsResponse")
+    lresp.field.append(_field("tools", 1, _MSG, _REP, ".omnia.tools.v1.ToolInfo"))
+
+    svc = fd.service.add(name="ToolService")
+    svc.method.add(
+        name="Execute",
+        input_type=".omnia.tools.v1.ToolRequest",
+        output_type=".omnia.tools.v1.ToolResponse",
+    )
+    svc.method.add(
+        name="ListTools",
+        input_type=".omnia.tools.v1.ListToolsRequest",
+        output_type=".omnia.tools.v1.ListToolsResponse",
+    )
+    return fd
+
+
+# Private pool: registering into the default pool would collide if a
+# generated module for the same file ever appears on the path.
+_pool = descriptor_pool.DescriptorPool()
+_pool.Add(_build_file())
+
+
+def _cls(name: str):
+    return message_factory.GetMessageClass(
+        _pool.FindMessageTypeByName(f"omnia.tools.v1.{name}")
+    )
+
+
+ToolRequest = _cls("ToolRequest")
+ToolResponse = _cls("ToolResponse")
+ListToolsRequest = _cls("ListToolsRequest")
+ListToolsResponse = _cls("ListToolsResponse")
+ToolInfo = _cls("ToolInfo")

@@ -1,0 +1,75 @@
+"""Minimal MCP server over stdio for transport tests.
+
+Speaks newline-delimited JSON-RPC 2.0: initialize handshake, tools/list,
+tools/call. Tools: echo (returns args as text), fail (isError result),
+crash (exits the process mid-call to exercise transport-error retry).
+"""
+
+import json
+import sys
+
+TOOLS = [
+    {
+        "name": "echo",
+        "description": "echo arguments back",
+        "inputSchema": {"type": "object", "properties": {"text": {"type": "string"}}},
+    },
+    {"name": "fail", "description": "always errors", "inputSchema": {"type": "object"}},
+    {"name": "crash", "description": "kills the server", "inputSchema": {"type": "object"}},
+    {"name": "hidden", "description": "filtered out by tests", "inputSchema": {"type": "object"}},
+]
+
+
+def reply(rid, result):
+    sys.stdout.write(json.dumps({"jsonrpc": "2.0", "id": rid, "result": result}) + "\n")
+    sys.stdout.flush()
+
+
+def main():
+    for line in sys.stdin:
+        line = line.strip()
+        if not line:
+            continue
+        msg = json.loads(line)
+        method, rid = msg.get("method"), msg.get("id")
+        if method == "initialize":
+            reply(rid, {
+                "protocolVersion": msg["params"].get("protocolVersion"),
+                "capabilities": {"tools": {}},
+                "serverInfo": {"name": "fixture-mcp", "version": "1.0"},
+            })
+        elif method == "notifications/initialized":
+            continue
+        elif method == "tools/list":
+            reply(rid, {"tools": TOOLS})
+        elif method == "tools/call":
+            name = msg["params"]["name"]
+            args = msg["params"].get("arguments", {})
+            if name == "crash":
+                sys.exit(1)
+            if name == "fail":
+                reply(rid, {
+                    "content": [{"type": "text", "text": "deliberate failure"}],
+                    "isError": True,
+                })
+            elif name in ("echo", "hidden"):
+                reply(rid, {
+                    "content": [{"type": "text", "text": json.dumps(args)}],
+                    "isError": False,
+                })
+            else:
+                sys.stdout.write(json.dumps({
+                    "jsonrpc": "2.0", "id": rid,
+                    "error": {"code": -32602, "message": f"unknown tool {name}"},
+                }) + "\n")
+                sys.stdout.flush()
+        elif rid is not None:
+            sys.stdout.write(json.dumps({
+                "jsonrpc": "2.0", "id": rid,
+                "error": {"code": -32601, "message": f"unknown method {method}"},
+            }) + "\n")
+            sys.stdout.flush()
+
+
+if __name__ == "__main__":
+    main()

@@ -267,6 +267,26 @@ class TestOperatorAPI:
         finally:
             httpd.shutdown()
 
+    def test_tooltest_requires_service_token(self, op_api):
+        """An mcp/python handler config is code execution on the operator
+        host — the route must not be callable unauthenticated."""
+        _api, port = op_api
+        status, _ = _call(port, "POST", "/api/v1/tooltest", {
+            "handler": {"name": "x", "type": "http", "url": "http://h/"},
+        }, token=None)
+        assert status in (401, 403)
+
+    def test_tooltest_rejects_stdio_mcp(self, op_api):
+        """Even authenticated, a stdio MCP config names a binary to spawn
+        on the operator host; tooltest refuses it (defense in depth)."""
+        _api, port = op_api
+        status, doc = _call(port, "POST", "/api/v1/tooltest", {
+            "handler": {"name": "evil", "type": "mcp",
+                        "mcp": {"transport": "stdio", "command": "bash",
+                                "args": ["-c", "true"]}},
+        })
+        assert status == 400 and "stdio" in doc["error"]
+
     def test_tooltest_reports_unreachable_backend(self, op_api):
         _api, port = op_api
         status, doc = _call(port, "POST", "/api/v1/tooltest", {

@@ -1,0 +1,433 @@
+"""gRPC / MCP / OpenAPI tool transports (VERDICT r4 #2).
+
+Covers the three handler types the executor previously rejected, each
+against an in-process fixture server, plus the executor integration so
+all five CRD handler types dispatch end-to-end.
+"""
+
+import http.server
+import json
+import os
+import sys
+import threading
+
+import pytest
+
+from omnia_tpu.tools.executor import ToolExecutor, ToolHandler
+from omnia_tpu.tools.grpc_transport import GrpcToolClient, GrpcToolServer
+from omnia_tpu.tools.mcp_client import (
+    MCPClient, MCPProtocolError, MCPTransportError, StdioTransport,
+    StreamableHttpTransport,
+)
+from omnia_tpu.tools.openapi import OpenAPIAdapter
+
+FIXTURE = os.path.join(os.path.dirname(__file__), "fixtures", "mcp_stdio_server.py")
+
+
+# ---------------------------------------------------------------------------
+# gRPC
+
+
+@pytest.fixture()
+def grpc_server():
+    srv = GrpcToolServer({
+        "add": (lambda a: {"sum": a["x"] + a["y"]}, "adds", {
+            "type": "object",
+            "properties": {"x": {"type": "number"}, "y": {"type": "number"}},
+        }),
+        "boom": lambda a: (_ for _ in ()).throw(RuntimeError("kaboom")),
+    }).start()
+    yield srv
+    srv.stop()
+
+
+def test_grpc_roundtrip(grpc_server):
+    client = GrpcToolClient(grpc_server.endpoint)
+    resp = client.execute("add", {"x": 2, "y": 3})
+    assert not resp.is_error
+    assert json.loads(resp.result_json) == {"sum": 5}
+    client.close()
+
+
+def test_grpc_tool_error_is_application_level(grpc_server):
+    client = GrpcToolClient(grpc_server.endpoint)
+    resp = client.execute("boom", {})
+    assert resp.is_error and "kaboom" in resp.error_message
+    resp = client.execute("nosuch", {})
+    assert resp.is_error and "unknown tool" in resp.error_message
+    client.close()
+
+
+def test_grpc_list_tools(grpc_server):
+    client = GrpcToolClient(grpc_server.endpoint)
+    tools = client.list_tools()
+    assert [t["name"] for t in tools] == ["add", "boom"]
+    assert tools[0]["input_schema"]["properties"]["x"]["type"] == "number"
+    client.close()
+
+
+def test_grpc_auth_enforced():
+    srv = GrpcToolServer({"echo": lambda a: a}, require_token="sekrit").start()
+    try:
+        import grpc
+
+        bad = GrpcToolClient(srv.endpoint)
+        with pytest.raises(grpc.RpcError) as ei:
+            bad.execute("echo", {})
+        assert ei.value.code() == grpc.StatusCode.UNAUTHENTICATED
+        bad.close()
+        good = GrpcToolClient(srv.endpoint, auth_token="sekrit")
+        assert not good.execute("echo", {"a": 1}).is_error
+        good.close()
+    finally:
+        srv.stop()
+
+
+def test_executor_grpc_dispatch(grpc_server):
+    ex = ToolExecutor([ToolHandler(
+        name="adder", type="grpc", endpoint=grpc_server.endpoint,
+        remote_name="add", timeout_s=5.0,
+    )])
+    out = ex.execute("adder", {"x": 10, "y": 5})
+    assert not out.is_error and json.loads(out.content) == {"sum": 15}
+    # application-level tool error: no retry, flows to the model
+    ex2 = ToolExecutor([ToolHandler(
+        name="boom", type="grpc", endpoint=grpc_server.endpoint, timeout_s=5.0,
+    )])
+    out = ex2.execute("boom", {})
+    assert out.is_error and "kaboom" in out.content
+    ex.close()
+    ex2.close()
+
+
+def test_executor_grpc_unreachable_retries_then_errors():
+    ex = ToolExecutor([ToolHandler(
+        name="dead", type="grpc", endpoint="127.0.0.1:1", timeout_s=0.5,
+    )], max_retries=1)
+    out = ex.execute("dead", {})
+    assert out.is_error and "after 2 attempts" in out.content
+    ex.close()
+
+
+# ---------------------------------------------------------------------------
+# MCP stdio
+
+
+def _stdio_cfg(**extra):
+    cfg = {"transport": "stdio", "command": sys.executable, "args": [FIXTURE]}
+    cfg.update(extra)
+    return cfg
+
+
+def test_mcp_stdio_handshake_and_call():
+    client = MCPClient.from_config(_stdio_cfg(), timeout_s=10.0)
+    try:
+        tools = client.list_tools()
+        assert {t["name"] for t in tools} >= {"echo", "fail"}
+        assert client.server_info["name"] == "fixture-mcp"
+        content, is_error = client.call_tool("echo", {"text": "hi"})
+        assert not is_error and json.loads(content) == {"text": "hi"}
+        content, is_error = client.call_tool("fail", {})
+        assert is_error and "deliberate failure" in content
+    finally:
+        client.close()
+
+
+def test_mcp_stdio_unknown_tool_is_protocol_error():
+    client = MCPClient.from_config(_stdio_cfg(), timeout_s=10.0)
+    try:
+        with pytest.raises(MCPProtocolError):
+            client.call_tool("nosuch", {})
+    finally:
+        client.close()
+
+
+def test_mcp_tool_filter():
+    client = MCPClient.from_config(
+        _stdio_cfg(toolFilter={"blocklist": ["hidden"]}), timeout_s=10.0
+    )
+    try:
+        assert "hidden" not in {t["name"] for t in client.list_tools()}
+        content, is_error = client.call_tool("hidden", {})
+        assert is_error and "blocked" in content
+    finally:
+        client.close()
+
+
+def test_mcp_crash_is_transport_error():
+    client = MCPClient.from_config(_stdio_cfg(), timeout_s=10.0)
+    try:
+        with pytest.raises(MCPTransportError):
+            client.call_tool("crash", {})
+    finally:
+        client.close()
+
+
+def test_executor_mcp_dispatch_and_redial_after_crash():
+    ex = ToolExecutor([
+        ToolHandler(name="echo", type="mcp", mcp=_stdio_cfg(), timeout_s=10.0),
+        ToolHandler(name="crash", type="mcp", mcp=_stdio_cfg(), timeout_s=10.0),
+    ])
+    try:
+        out = ex.execute("echo", {"text": "one"})
+        assert not out.is_error
+        # crash kills the shared stdio session; the executor must evict
+        # the dead client and re-dial, so a following echo still works.
+        out = ex.execute("crash", {})
+        assert out.is_error
+        out = ex.execute("echo", {"text": "two"})
+        assert not out.is_error and json.loads(out.content) == {"text": "two"}
+    finally:
+        ex.close()
+
+
+# ---------------------------------------------------------------------------
+# MCP streamable http
+
+
+@pytest.fixture()
+def mcp_http_server():
+    """POST JSON-RPC endpoint; answers initialize with an Mcp-Session-Id
+    and serves tools/call for `echo`. Asserts the session id comes back.
+    Responds in SSE framing when the request metadata asks for it."""
+    seen = {"session_ids": [], "sse": False}
+
+    class Handler(http.server.BaseHTTPRequestHandler):
+        def do_POST(self):
+            body = json.loads(self.rfile.read(int(self.headers["Content-Length"])))
+            sid = self.headers.get("Mcp-Session-Id")
+            if sid:
+                seen["session_ids"].append(sid)
+            rid = body.get("id")
+            if rid is None:
+                self.send_response(202)
+                self.end_headers()
+                return
+            method = body["method"]
+            if method == "initialize":
+                result = {
+                    "protocolVersion": body["params"]["protocolVersion"],
+                    "capabilities": {"tools": {}},
+                    "serverInfo": {"name": "fixture-http-mcp", "version": "1"},
+                }
+            elif method == "tools/list":
+                result = {"tools": [{"name": "echo", "inputSchema": {"type": "object"}}]}
+            elif method == "tools/call":
+                result = {
+                    "content": [{
+                        "type": "text",
+                        "text": json.dumps(body["params"].get("arguments", {})),
+                    }],
+                    "isError": False,
+                }
+            else:
+                result = {}
+            payload = {"jsonrpc": "2.0", "id": rid, "result": result}
+            if method == "tools/call":
+                seen["sse"] = True
+                raw = ("event: message\ndata: " + json.dumps(payload) + "\n\n").encode()
+                ctype = "text/event-stream"
+            else:
+                raw = json.dumps(payload).encode()
+                ctype = "application/json"
+            self.send_response(200)
+            self.send_header("Content-Type", ctype)
+            if method == "initialize":
+                self.send_header("Mcp-Session-Id", "sess-42")
+            self.send_header("Content-Length", str(len(raw)))
+            self.end_headers()
+            self.wfile.write(raw)
+
+        def log_message(self, *args):
+            pass
+
+    srv = http.server.ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    yield f"http://127.0.0.1:{srv.server_port}/mcp", seen
+    srv.shutdown()
+
+
+def test_mcp_streamable_http_with_session_and_sse(mcp_http_server):
+    endpoint, seen = mcp_http_server
+    client = MCPClient(StreamableHttpTransport(endpoint, timeout_s=5.0))
+    tools = client.list_tools()
+    assert tools[0]["name"] == "echo"
+    content, is_error = client.call_tool("echo", {"q": "sse"})
+    assert not is_error and json.loads(content) == {"q": "sse"}
+    # session id minted on initialize must ride every later request
+    assert "sess-42" in seen["session_ids"] and seen["sse"]
+
+
+def test_executor_mcp_http_dispatch(mcp_http_server):
+    endpoint, _ = mcp_http_server
+    ex = ToolExecutor([ToolHandler(
+        name="echo", type="mcp",
+        mcp={"transport": "streamable-http", "endpoint": endpoint},
+        timeout_s=5.0,
+    )])
+    out = ex.execute("echo", {"n": 7})
+    assert not out.is_error and json.loads(out.content) == {"n": 7}
+    ex.close()
+
+
+# ---------------------------------------------------------------------------
+# OpenAPI
+
+
+PETSTORE = {
+    "openapi": "3.0.0",
+    "info": {"title": "petstore", "version": "1"},
+    "servers": [{"url": "https://unused.example"}],
+    "paths": {
+        "/pets/{petId}": {
+            "get": {
+                "operationId": "getPet",
+                "summary": "fetch one pet",
+                "parameters": [
+                    {"name": "petId", "in": "path", "required": True,
+                     "schema": {"type": "integer"}},
+                    {"name": "verbose", "in": "query",
+                     "schema": {"type": "boolean"}},
+                    {"name": "X-Trace", "in": "header",
+                     "schema": {"type": "string"}},
+                ],
+            },
+        },
+        "/pets": {
+            "post": {
+                "operationId": "createPet",
+                "requestBody": {
+                    "required": True,
+                    "content": {"application/json": {"schema": {
+                        "$ref": "#/components/schemas/NewPet"
+                    }}},
+                },
+            },
+        },
+    },
+    "components": {"schemas": {"NewPet": {
+        "type": "object",
+        "properties": {"name": {"type": "string"}, "tag": {"type": "string"}},
+        "required": ["name"],
+    }}},
+}
+
+
+@pytest.fixture()
+def api_backend():
+    """Records the request the adapter builds and answers JSON."""
+    seen = {}
+
+    class Handler(http.server.BaseHTTPRequestHandler):
+        def _handle(self):
+            seen["method"] = self.command
+            seen["path"] = self.path
+            seen["headers"] = dict(self.headers)
+            length = int(self.headers.get("Content-Length") or 0)
+            seen["body"] = self.rfile.read(length).decode() if length else ""
+            raw = json.dumps({"ok": True}).encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(raw)))
+            self.end_headers()
+            self.wfile.write(raw)
+
+        do_GET = do_POST = do_PUT = do_DELETE = _handle
+
+        def log_message(self, *args):
+            pass
+
+    srv = http.server.ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    yield f"http://127.0.0.1:{srv.server_port}", seen
+    srv.shutdown()
+
+
+def test_openapi_parse_and_schemas():
+    adapter = OpenAPIAdapter(PETSTORE)
+    assert set(adapter.ops) == {"getPet", "createPet"}
+    get_schema = adapter.ops["getPet"].input_schema()
+    assert get_schema["properties"]["petId"]["type"] == "integer"
+    assert get_schema["required"] == ["petId"]
+    # requestBody object properties are flattened through the $ref
+    post_schema = adapter.ops["createPet"].input_schema()
+    assert post_schema["properties"]["name"]["type"] == "string"
+    assert "name" in post_schema["required"]
+    tools = adapter.list_tools()
+    assert {t["name"] for t in tools} == {"getPet", "createPet"}
+
+
+def test_openapi_get_request_mapping(api_backend):
+    base, seen = api_backend
+    adapter = OpenAPIAdapter(PETSTORE, base_url=base)
+    out = adapter.call("getPet", {"petId": 7, "verbose": True, "X-Trace": "t1"})
+    assert json.loads(out) == {"ok": True}
+    assert seen["method"] == "GET"
+    assert seen["path"] == "/pets/7?verbose=True"
+    assert seen["headers"]["X-Trace"] == "t1"
+
+
+def test_openapi_post_body_mapping(api_backend):
+    base, seen = api_backend
+    adapter = OpenAPIAdapter(PETSTORE, base_url=base)
+    adapter.call("createPet", {"name": "rex", "tag": "dog"})
+    assert seen["method"] == "POST" and seen["path"] == "/pets"
+    assert json.loads(seen["body"]) == {"name": "rex", "tag": "dog"}
+
+
+def test_openapi_missing_path_param_is_error():
+    adapter = OpenAPIAdapter(PETSTORE, base_url="http://x")
+    with pytest.raises(ValueError):
+        adapter.build_request("getPet", {})
+
+
+def test_openapi_yaml_and_operation_filter():
+    import yaml
+
+    text = yaml.safe_dump(PETSTORE)
+    adapter = OpenAPIAdapter(
+        OpenAPIAdapter.parse_text(text), operation_filter=["getPet"]
+    )
+    assert set(adapter.ops) == {"getPet"}
+
+
+def test_executor_openapi_dispatch(api_backend):
+    base, seen = api_backend
+    ex = ToolExecutor([ToolHandler(
+        name="getPet", type="openapi", spec=PETSTORE, base_url=base,
+        timeout_s=5.0,
+    )])
+    out = ex.execute("getPet", {"petId": 3})
+    assert not out.is_error and seen["path"] == "/pets/3"
+    # missing required path param: fatal, not retried
+    out = ex.execute("getPet", {})
+    assert out.is_error and "petId" in out.content
+    ex.close()
+
+
+# ---------------------------------------------------------------------------
+# all five types through one executor
+
+
+def test_executor_dispatches_all_five_types(grpc_server, api_backend):
+    base, _ = api_backend
+    ex = ToolExecutor([
+        ToolHandler(name="py", type="python", fn=lambda a: {"py": True}),
+        ToolHandler(name="web", type="http", url=base + "/hook"),
+        ToolHandler(name="grpc_add", type="grpc", endpoint=grpc_server.endpoint,
+                    remote_name="add", timeout_s=5.0),
+        ToolHandler(name="mcp_echo", type="mcp", mcp=_stdio_cfg(),
+                    remote_name="echo", timeout_s=10.0),
+        ToolHandler(name="getPet", type="openapi", spec=PETSTORE,
+                    base_url=base, timeout_s=5.0),
+        ToolHandler(name="browser", type="client"),
+    ])
+    try:
+        assert json.loads(ex.execute("py", {}).content) == {"py": True}
+        assert not ex.execute("web", {"k": 1}).is_error
+        assert json.loads(ex.execute("grpc_add", {"x": 1, "y": 1}).content) == {"sum": 2}
+        assert not ex.execute("mcp_echo", {"text": "all5"}).is_error
+        assert not ex.execute("getPet", {"petId": 9}).is_error
+        assert ex.is_client_side("browser")
+    finally:
+        ex.close()
