@@ -64,6 +64,7 @@ class DashboardServer:
         memory_api_url: Optional[str] = None,
         write_token: Optional[str] = None,
         mgmt_secret: Optional[bytes] = None,
+        cookie_secure: Optional[bool] = None,
     ) -> None:
         self.store = store
         self.session_api_url = (session_api_url or "").rstrip("/")
@@ -77,6 +78,15 @@ class DashboardServer:
         # lets the dashboard mint short-lived mgmt-plane JWTs server-side
         # for console WS connections, reference dashboard/server.js style.
         self.mgmt_secret = mgmt_secret
+        # Behind a TLS-terminating ingress the session cookie must carry
+        # Secure or it also rides any plaintext HTTP path to the same
+        # host (OMNIA_COOKIE_SECURE=1 in the deployment env; default off
+        # for the in-cluster plain-HTTP dev posture).
+        if cookie_secure is None:
+            cookie_secure = os.environ.get(
+                "OMNIA_COOKIE_SECURE", ""
+            ).lower() in ("1", "true", "yes")
+        self.cookie_secure = cookie_secure
         self._httpd: Optional[ThreadingHTTPServer] = None
         self.port: Optional[int] = None
         self._ws_proxy = None
@@ -616,6 +626,8 @@ class DashboardServer:
             f"omnia_console={self._session_cookie()}; HttpOnly; "
             f"SameSite=Strict; Path=/; Max-Age={int(self.CONSOLE_SESSION_TTL_S)}"
         )
+        if self.cookie_secure:
+            cookie += "; Secure"
         status, ctype, out = self._json(200, {"authenticated": True})
         return status, ctype, out, {"Set-Cookie": cookie}
 

@@ -50,7 +50,10 @@ class ConsoleWsProxy:
         base = raw_target.split("?")[0]
         if base not in allowed:
             raise PermissionError(f"target {base!r} is not a known agent facade")
-        return raw_target
+        # Only the validated base leaves here: passing the client's query
+        # string through would let a console user smuggle params (their
+        # own token=, replayed session=) ahead of the server-minted ones.
+        return base
 
     def _handle(self, ws) -> None:
         from websockets.sync.client import connect as ws_connect

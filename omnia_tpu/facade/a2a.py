@@ -83,6 +83,75 @@ class TaskStore:
             del self._tasks[oldest]
 
 
+class RedisTaskStore:
+    """Durable task store: `a2a:task:<id>` → task JSON with a server-side
+    TTL, same shape as the realtime `rt:route:` store (reference
+    redis_task_store.go) — tasks survive a facade pod restart, so a
+    client can poll tasks/get against any replica after a crash.
+
+    Same interface as TaskStore. `transition` takes a short per-task
+    Redis lock (SET NX PX) around its read-modify-write so the
+    unless_state compare-and-set holds across replicas too — a
+    tasks/cancel landing on replica B between replica A's get and put
+    must not be overwritten by A's completion."""
+
+    LOCK_TTL_MS = 5000
+    LOCK_WAIT_S = 2.0
+
+    def __init__(self, client, prefix: str = "a2a:task:",
+                 ttl_s: float = 3600.0):
+        import json as _json
+
+        self._json = _json
+        self.client = client
+        self.prefix = prefix
+        self.ttl_s = ttl_s
+        self._lock = threading.Lock()  # cheap in-process fast path
+
+    def put(self, task: dict) -> None:
+        task["_touched"] = time.time()
+        self.client.set(
+            self.prefix + task["id"],
+            self._json.dumps(task),
+            px_ms=int(self.ttl_s * 1000),
+        )
+
+    def get(self, task_id: str) -> Optional[dict]:
+        raw = self.client.get(self.prefix + task_id)
+        if raw is None:
+            return None
+        return self._json.loads(raw.decode())
+
+    def transition(self, task_id: str, status: dict,
+                   artifacts: Optional[list] = None,
+                   unless_state: tuple = ()) -> Optional[dict]:
+        lock_key = self.prefix + "lock:" + task_id
+        deadline = time.time() + self.LOCK_WAIT_S
+        locked = False
+        while time.time() < deadline:
+            if self.client.set(lock_key, "1", px_ms=self.LOCK_TTL_MS, nx=True):
+                locked = True
+                break
+            time.sleep(0.01)
+        # On lock-wait timeout proceed anyway (the PX TTL bounds how stale
+        # a dead holder can be; losing liveness is worse than the race).
+        try:
+            with self._lock:
+                t = self.get(task_id)
+                if t is None:
+                    return None
+                if t["status"]["state"] in unless_state:
+                    return t
+                t["status"] = status
+                if artifacts is not None:
+                    t["artifacts"] = artifacts
+                self.put(t)
+                return t
+        finally:
+            if locked:
+                self.client.delete(lock_key)
+
+
 class A2aFacade(JsonHttpFacade):
     def __init__(self, *args, description: str = "", skills: Optional[list] = None,
                  task_store: Optional[TaskStore] = None, **kwargs):

@@ -102,7 +102,8 @@ VALUES_SCHEMA = {
                 },
                 "tempo": {
                     "type": "object", "additionalProperties": False,
-                    "properties": {"image": _IMAGE},
+                    "properties": {"image": _IMAGE,
+                                   "retention": {"type": "string"}},
                 },
                 "collector": {
                     "type": "object", "additionalProperties": False,
@@ -219,14 +220,6 @@ def render_install(values: Optional[dict] = None) -> list[dict]:
                 {"apiGroups": ["policy"],
                  "resources": ["poddisruptionbudgets"],
                  "verbs": ["get", "list", "watch", "create", "update", "patch", "delete"]},
-                # The observability collector (Alloy DaemonSet) discovers
-                # pods and tails their logs under this same ClusterRole.
-                {"apiGroups": [""],
-                 "resources": ["pods"],
-                 "verbs": ["get", "list", "watch"]},
-                {"apiGroups": [""],
-                 "resources": ["pods/log"],
-                 "verbs": ["get"]},
             ],
         },
         {
@@ -419,7 +412,7 @@ def _render_observability(ns: str, cfg: dict, sa: str = "omnia-operator") -> lis
             ],
         })},
     })
-    out += _render_logs_traces(ns, cfg, sa)
+    out += _render_logs_traces(ns, cfg)
     if cfg.get("podMonitors", True):
         # prometheus-operator clusters (reference agent-podmonitor.yaml).
         for comp, selector in (
@@ -440,7 +433,7 @@ def _render_observability(ns: str, cfg: dict, sa: str = "omnia-operator") -> lis
     return out
 
 
-def _render_logs_traces(ns: str, cfg: dict, sa: str = "omnia-operator") -> list[dict]:
+def _render_logs_traces(ns: str, cfg: dict) -> list[dict]:
     """Loki (logs) + Tempo (traces) + an Alloy collector DaemonSet
     (reference charts/omnia/templates/observability bundles the same
     trio). Single-binary filesystem-backed configs: the in-cluster dev/
@@ -479,6 +472,15 @@ def _render_logs_traces(ns: str, cfg: dict, sa: str = "omnia-operator") -> list[
         }}}},
         "storage": {"trace": {"backend": "local",
                               "local": {"path": "/var/tempo"}}},
+        # Same fill-until-eviction failure mode as Loki: traces land on
+        # an emptyDir, so the compactor must actively expire blocks
+        # (mirrors the loki retention value rather than Tempo's 14d
+        # default).
+        "compactor": {"compaction": {
+            "block_retention": cfg["tempo"].get(
+                "retention", cfg["loki"]["retention"]
+            ),
+        }},
     }
     # Alloy config: tail every omnia pod's logs into Loki, and relay any
     # pod-local OTLP (agents that can't reach Tempo's Service directly)
@@ -575,6 +577,40 @@ def _render_logs_traces(ns: str, cfg: dict, sa: str = "omnia-operator") -> list[
         {"name": "config", "mountPath": "/etc/tempo"},
         {"name": "data", "mountPath": "/var/tempo"}]
     labels = _labels("collector")
+    # The collector gets its OWN ServiceAccount with the minimal log-
+    # tailing grant: attaching the cluster-wide pods/log read to the
+    # operator's ClusterRole would hand the operator broader privilege
+    # than either component needs.
+    out.append({
+        "apiVersion": "v1",
+        "kind": "ServiceAccount",
+        "metadata": {"name": "omnia-collector", "namespace": ns},
+    })
+    out.append({
+        "apiVersion": "rbac.authorization.k8s.io/v1",
+        "kind": "ClusterRole",
+        "metadata": {"name": "omnia-collector"},
+        "rules": [
+            {"apiGroups": [""],
+             "resources": ["pods"],
+             "verbs": ["get", "list", "watch"]},
+            {"apiGroups": [""],
+             "resources": ["pods/log"],
+             "verbs": ["get"]},
+        ],
+    })
+    out.append({
+        "apiVersion": "rbac.authorization.k8s.io/v1",
+        "kind": "ClusterRoleBinding",
+        "metadata": {"name": "omnia-collector"},
+        "roleRef": {
+            "apiGroup": "rbac.authorization.k8s.io",
+            "kind": "ClusterRole",
+            "name": "omnia-collector",
+        },
+        "subjects": [{"kind": "ServiceAccount", "name": "omnia-collector",
+                      "namespace": ns}],
+    })
     out.append({
         "apiVersion": "apps/v1",
         "kind": "DaemonSet",
@@ -585,7 +621,7 @@ def _render_logs_traces(ns: str, cfg: dict, sa: str = "omnia-operator") -> list[
             "template": {
                 "metadata": {"labels": labels},
                 "spec": {
-                    "serviceAccountName": sa,
+                    "serviceAccountName": "omnia-collector",
                     "containers": [{
                         "name": "collector",
                         "image": cfg["collector"]["image"],

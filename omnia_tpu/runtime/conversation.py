@@ -49,7 +49,11 @@ from omnia_tpu.tools import ToolExecutor
 
 TOOL_OPEN = "<tool_call>"
 TOOL_CLOSE = "</tool_call>"
-MAX_TOOL_ROUNDS = 4
+# The turn is budgeted by TIME, like the reference (reference internal/
+# runtime/conversation.go:36 toolCallExecutionTimeout = 120s): a
+# legitimate 6-step tool chain completes as long as it fits the budget.
+# MAX_TOOL_ROUNDS is only a runaway backstop far above real chains.
+MAX_TOOL_ROUNDS = 64
 TURN_TIMEOUT_S = 120.0          # reference tool-loop envelope
 CLIENT_TOOL_TIMEOUT_S = 60.0    # reference client-tool wait
 

@@ -11,6 +11,7 @@ from __future__ import annotations
 import json
 import os
 import re
+import urllib.parse
 import urllib.request
 from html.parser import HTMLParser
 
@@ -178,6 +179,24 @@ def test_login_flow_and_console_token(dash):
     # Wrong-secret facade rejects it; expiry is short.
     assert HmacValidator(b"other").validate(doc["token"]) is None
     assert doc["expires_in_s"] <= 600
+
+
+def test_cookie_secure_flag_opt_in():
+    """OMNIA_COOKIE_SECURE=1 (TLS-terminating ingress) marks the session
+    cookie Secure so it never rides a plaintext path; default posture
+    (in-cluster plain HTTP) leaves it off."""
+    store = MemoryResourceStore()
+    srv = DashboardServer(store, write_token=DASH_TOKEN,
+                          cookie_secure=True)
+    port = srv.serve(host="127.0.0.1", port=0)
+    try:
+        _status, headers, _doc = _req(
+            port, "/api/login", method="POST",
+            body=json.dumps({"token": DASH_TOKEN}).encode())
+        assert "Secure" in headers.get("Set-Cookie", "")
+    finally:
+        srv.shutdown()
+    assert DashboardServer(store, write_token=DASH_TOKEN).cookie_secure is False
 
 
 def test_data_routes_gated_when_login_required(dash):
@@ -470,7 +489,16 @@ def test_console_ws_proxy_end_to_end(tmp_path):
                 if m["type"] in ("done", "error"):
                     done = m
             assert done["type"] == "done" and text == "proxied hi"
-        # 3. Unknown target → 4403 (the proxy is not an open relay).
+        # 3. A client-smuggled query string on the target is STRIPPED:
+        # `?token=garbage` must not ride ahead of the server-minted
+        # token (pre-fix it did, and the facade read the garbage one).
+        smuggle = (f"ws://127.0.0.1:{srv.ws_proxy_port}/proxy?url="
+                   + urllib.parse.quote(endpoint + "?token=garbage", safe=""))
+        with wsc.connect(smuggle, open_timeout=15,
+                         additional_headers={"Cookie": cookie}) as ws:
+            first = json.loads(ws.recv(timeout=15))
+            assert first["type"] == "connected"
+        # 4. Unknown target → 4403 (the proxy is not an open relay).
         bad = (f"ws://127.0.0.1:{srv.ws_proxy_port}/proxy?url="
                "ws%3A%2F%2Fevil.example%2Fws")
         with pytest.raises(Exception) as exc:

@@ -162,23 +162,39 @@ class TestInstallBundle:
                   if m["metadata"]["name"] == "omnia-grafana-datasources")
         assert all(t in ds["data"]["datasources.yaml"]
                    for t in ("prometheus", "loki", "tempo"))
-        # Collector correctness: custom SA threads through, node-scoped
-        # discovery (no N× log duplication), stable relay Service, and
-        # the ClusterRole really grants pod/log access.
+        # Collector correctness: the DaemonSet runs under its OWN minimal
+        # ServiceAccount (NOT the operator's — the cluster-wide pods/log
+        # grant must not attach to the operator), node-scoped discovery
+        # (no N× log duplication), stable relay Service, and the
+        # collector ClusterRole really grants pod/log access.
         out_sa = render_install({"serviceAccount": "my-sa",
                                  "observability": {"enabled": True}})
         ds = next(m for m in out_sa if m["kind"] == "DaemonSet")
         pod = ds["spec"]["template"]["spec"]
-        assert pod["serviceAccountName"] == "my-sa"
+        assert pod["serviceAccountName"] == "omnia-collector"
+        collector_sas = [m for m in out_sa if m["kind"] == "ServiceAccount"
+                         and m["metadata"]["name"] == "omnia-collector"]
+        assert len(collector_sas) == 1
+        crb = next(m for m in out_sa if m["kind"] == "ClusterRoleBinding"
+                   and m["metadata"]["name"] == "omnia-collector")
+        assert crb["subjects"][0]["name"] == "omnia-collector"
         env = pod["containers"][0]["env"][0]
         assert env["name"] == "NODE_NAME"
         assert env["valueFrom"]["fieldRef"]["fieldPath"] == "spec.nodeName"
         assert 'field = "spec.nodeName=" + sys.env("NODE_NAME")' in cfg
         assert ("Service", "omnia-collector") in kinds
-        role = next(m for m in out if m["kind"] == "ClusterRole")
+        role = next(m for m in out if m["kind"] == "ClusterRole"
+                    and m["metadata"]["name"] == "omnia-collector")
         flat = [(g, res, v) for r in role["rules"] for g in r["apiGroups"]
                 for res in r["resources"] for v in r["verbs"]]
         assert ("", "pods", "list") in flat and ("", "pods/log", "get") in flat
+        # ...and the operator's role does NOT carry the log grant.
+        op_role = next(m for m in out if m["kind"] == "ClusterRole"
+                       and m["metadata"]["name"] == "omnia-operator")
+        op_flat = [res for r in op_role["rules"] for res in r["resources"]]
+        assert "pods/log" not in op_flat
+        # Tempo expires blocks instead of filling the emptyDir (ADVICE r4).
+        assert "block_retention: 168h" in tempo_cm["data"]["tempo.yaml"]
         # Loki actually ENFORCES retention (compactor, Loki 3.x).
         assert "retention_enabled: true" in loki_cm["data"]["loki.yaml"]
         # No observability env leaks into a bare render.

@@ -311,6 +311,33 @@ class TestConversation:
         assert text == "summarized"
         assert out[-1].type == "done" and out[-1].finish_reason == "stop"
 
+    def test_six_round_tool_chain_completes(self):
+        """The loop is time-budgeted (reference conversation.go:36 uses a
+        120 s execution budget, not a small round cap): a legitimate
+        6-step chain inside the budget must complete."""
+        calls = {"n": 0}
+
+        def step(_args):
+            calls["n"] += 1
+            return f"STEP{calls['n']}"
+
+        scenarios = [
+            Scenario(pattern="STEP6", reply="chain finished"),
+            Scenario(
+                pattern=".",
+                reply='<tool_call>{"name": "step", "arguments": {}}</tool_call>',
+            ),
+        ]
+        conv = _make_conversation(scenarios, handlers=[ToolHandler(
+            name="step", type="python", fn=step,
+        )])
+        msgs = list(conv.stream(c.ClientMessage(content="run the chain")))
+        assert calls["n"] == 6
+        assert msgs[-1].type == "done" and msgs[-1].finish_reason == "stop"
+        assert "chain finished" in "".join(
+            m.text for m in msgs if m.type == "chunk"
+        )
+
     def test_tool_loop_limit(self):
         scenarios = [
             Scenario(
