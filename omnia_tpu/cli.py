@@ -21,6 +21,7 @@ import os
 import signal
 import sys
 import threading
+import time
 from typing import Optional
 
 logging.basicConfig(
@@ -94,17 +95,17 @@ def _pg_client():
     return PGClient(host, port, user=user, database=db, password=password)
 
 
-def _pg_warm():
+def _pg_warm(cipher=None):
     """OMNIA_PG_DSN → PgWarmStore, or None."""
     client = _pg_client()
     if client is None:
         return None
     from omnia_tpu.session.pg_warm import PgWarmStore
 
-    return PgWarmStore(client)
+    return PgWarmStore(client, cipher=cipher)
 
 
-def _cold_store():
+def _cold_store(cipher=None):
     """Cold tier from env: OMNIA_S3_ENDPOINT/BUCKET/ACCESS_KEY/SECRET_KEY
     (object storage), else OMNIA_COLD_DIR (local)."""
     if _env("OMNIA_S3_ENDPOINT"):
@@ -118,11 +119,12 @@ def _cold_store():
             _require("OMNIA_S3_SECRET_KEY"),
             region=_env("OMNIA_S3_REGION", "us-east-1"),
             prefix=_env("OMNIA_S3_PREFIX", ""),
-        ))
+        ), cipher=cipher)
     if _env("OMNIA_COLD_DIR"):
         from omnia_tpu.session.cold import ColdArchive, LocalBlobStore
 
-        return ColdArchive(LocalBlobStore(_env("OMNIA_COLD_DIR")))
+        return ColdArchive(LocalBlobStore(_env("OMNIA_COLD_DIR")),
+                           cipher=cipher)
     return None
 
 
@@ -149,6 +151,33 @@ def _media_store():
 
         return LocalMediaStore(_env("OMNIA_MEDIA_ROOT"), secret=secret)
     return None
+
+
+def _start_rotation(cipher, stores) -> None:
+    """Background KEK rotation + DEK re-wrap sweep when encryption is on
+    and OMNIA_KEY_MAX_AGE_S is set (reference keyrotation_controller.go
+    runs the same reconcile in the operator)."""
+    max_age = _env("OMNIA_KEY_MAX_AGE_S")
+    if cipher is None or not max_age:
+        return
+    from omnia_tpu.privacy.rotation import KeyRotationController
+
+    ctl = KeyRotationController(
+        cipher.kms, stores=[s for s in stores if s is not None],
+        key_max_age_s=float(max_age),
+    )
+    interval = float(_env("OMNIA_KEY_ROTATION_INTERVAL_S", "3600"))
+
+    def loop():
+        while True:
+            time.sleep(interval)
+            try:
+                ctl.reconcile()
+            except Exception:
+                logger.exception("key-rotation reconcile failed")
+
+    threading.Thread(target=loop, name="omnia-key-rotation",
+                     daemon=True).start()
 
 
 def _wait_forever() -> None:
@@ -344,11 +373,16 @@ def facade_main() -> int:
 
 def session_api_main() -> int:
     """OMNIA_HTTP_PORT, OMNIA_REDIS_ADDR (hot tier + event stream),
-    OMNIA_WARM_DB (sqlite path), OMNIA_COLD_DIR (parquet archive)."""
+    OMNIA_WARM_DB (sqlite path), OMNIA_COLD_DIR (parquet archive),
+    OMNIA_ENCRYPTION=local + OMNIA_KEK_B64/OMNIA_KEK_FILE (at-rest
+    envelope encryption of warm/cold record bodies, resolved at assembly
+    like the reference's cmd/session-api/main.go:210)."""
+    from omnia_tpu.privacy.atrest import resolve_cipher
     from omnia_tpu.session.api import SessionAPI
     from omnia_tpu.session.tiers import TieredStore
     from omnia_tpu.streams import Stream
 
+    cipher = resolve_cipher()
     rc = _redis_client()
     hot = None
     events = None
@@ -359,20 +393,22 @@ def session_api_main() -> int:
         hot = RedisHotStore(rc, ttl_s=float(_env("OMNIA_HOT_TTL_S", "3600")))
         events = RedisStream(rc.clone(), "session-events")
     kw = {}
-    pg = _pg_warm()
+    pg = _pg_warm(cipher)
     if pg is not None:
         kw["warm"] = pg
     elif _env("OMNIA_WARM_DB"):
         from omnia_tpu.session.warm import WarmStore
 
-        kw["warm"] = WarmStore(_env("OMNIA_WARM_DB"))
-    cold = _cold_store()
+        kw["warm"] = WarmStore(_env("OMNIA_WARM_DB"), cipher=cipher)
+    cold = _cold_store(cipher)
     if cold is not None:
         kw["cold"] = cold
     store = TieredStore(hot=hot, **kw) if (hot or kw) else TieredStore()
     api = SessionAPI(store=store, events=events or Stream())
+    _start_rotation(cipher, [kw.get("warm"), kw.get("cold")])
     port = api.serve(host="0.0.0.0", port=int(_env("OMNIA_HTTP_PORT", "8300")))
-    logger.info("session-api on :%d", port)
+    logger.info("session-api on :%d (encryption=%s)", port,
+                "local" if cipher else "off")
     _wait_forever()
     api.shutdown()
     return 0
@@ -387,15 +423,19 @@ def memory_api_main() -> int:
     from omnia_tpu.memory.api import MemoryAPI
     from omnia_tpu.memory.store import MemoryStore
 
+    from omnia_tpu.privacy.atrest import resolve_cipher
+
+    cipher = resolve_cipher()
     pg = _pg_client()
     if pg is not None:
         from omnia_tpu.memory.pg_store import PgMemoryStore
 
-        store = PgMemoryStore(pg)
+        store = PgMemoryStore(pg, cipher=cipher)
     elif _env("OMNIA_MEMORY_DB"):
-        store = MemoryStore(_env("OMNIA_MEMORY_DB"))
+        store = MemoryStore(_env("OMNIA_MEMORY_DB"), cipher=cipher)
     else:
-        store = MemoryStore()
+        store = MemoryStore(cipher=cipher)
+    _start_rotation(cipher, [store])
     embedder = None
     if _env("OMNIA_EMBED_DIM"):
         from omnia_tpu.memory.embedding import HashingEmbedder
@@ -482,23 +522,25 @@ def operator_main() -> int:
 def compaction_main() -> int:
     """One compaction pass (CronJob binary): OMNIA_REDIS_ADDR +
     OMNIA_WARM_DB + OMNIA_COLD_DIR select the tiers."""
+    from omnia_tpu.privacy.atrest import resolve_cipher
     from omnia_tpu.session.compaction import CompactionEngine
     from omnia_tpu.session.tiers import TieredStore
 
+    cipher = resolve_cipher()
     rc = _redis_client()
     kw = {}
     if rc is not None:
         from omnia_tpu.session.redis_hot import RedisHotStore
 
         kw["hot"] = RedisHotStore(rc)
-    pg = _pg_warm()
+    pg = _pg_warm(cipher)
     if pg is not None:
         kw["warm"] = pg
     elif _env("OMNIA_WARM_DB"):
         from omnia_tpu.session.warm import WarmStore
 
-        kw["warm"] = WarmStore(_env("OMNIA_WARM_DB"))
-    cold = _cold_store()
+        kw["warm"] = WarmStore(_env("OMNIA_WARM_DB"), cipher=cipher)
+    cold = _cold_store(cipher)
     if cold is not None:
         kw["cold"] = cold
     store = TieredStore(**kw)

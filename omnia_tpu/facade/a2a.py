@@ -126,10 +126,11 @@ class RedisTaskStore:
                    artifacts: Optional[list] = None,
                    unless_state: tuple = ()) -> Optional[dict]:
         lock_key = self.prefix + "lock:" + task_id
+        token = uuid.uuid4().hex
         deadline = time.time() + self.LOCK_WAIT_S
         locked = False
         while time.time() < deadline:
-            if self.client.set(lock_key, "1", px_ms=self.LOCK_TTL_MS, nx=True):
+            if self.client.set(lock_key, token, px_ms=self.LOCK_TTL_MS, nx=True):
                 locked = True
                 break
             time.sleep(0.01)
@@ -149,7 +150,14 @@ class RedisTaskStore:
                 return t
         finally:
             if locked:
-                self.client.delete(lock_key)
+                # Token-checked release: if our TTL lapsed and another
+                # replica holds the lock now, deleting unconditionally
+                # would free THEIR lock (a narrow get/delete race remains;
+                # the unique token shrinks it from "always on slow holder"
+                # to microseconds).
+                held = self.client.get(lock_key)
+                if held is not None and held.decode() == token:
+                    self.client.delete(lock_key)
 
 
 class A2aFacade(JsonHttpFacade):

@@ -66,7 +66,8 @@ _SCHEMA = (
 class PgMemoryStore(MemoryStore):
     """MemoryStore with write-through PG persistence (see module doc)."""
 
-    def __init__(self, client: PGClient, embedding_dim: Optional[int] = None):
+    def __init__(self, client: PGClient, embedding_dim: Optional[int] = None,
+                 cipher=None):
         self.client = client
         self._owner = uuid.uuid4().hex
         self._db_lock = threading.Lock()
@@ -76,7 +77,7 @@ class PgMemoryStore(MemoryStore):
         if embedding_dim is None and stored_dim:
             embedding_dim = int(stored_dim)
         self._loading = True
-        super().__init__(path=None, embedding_dim=embedding_dim)
+        super().__init__(path=None, embedding_dim=embedding_dim, cipher=cipher)
         try:
             self._load_from_db()
         finally:
@@ -103,11 +104,11 @@ class PgMemoryStore(MemoryStore):
         for row in self.client.query(
             "SELECT doc FROM memory_entries ORDER BY updated_at"
         ):
-            e = MemoryEntry.from_dict(json.loads(row["doc"]))
+            e = MemoryEntry.from_dict(self._codec.open(row["doc"]))
             self._entries[e.id] = e
             self._index(e)
         for row in self.client.query("SELECT doc FROM memory_relations"):
-            self._relations.append(Relation(**json.loads(row["doc"])))
+            self._relations.append(Relation(**self._codec.open(row["doc"])))
         consent = self._meta_get("dim_change_consent")
         if consent:
             self._dim_change_consent = int(consent)
@@ -115,7 +116,7 @@ class PgMemoryStore(MemoryStore):
     def _persist(self, e: MemoryEntry) -> None:
         if self._loading:
             return
-        doc = json.dumps(e.to_dict(include_embedding=True))
+        doc = self._codec.seal(e.to_dict(include_embedding=True))
         with self._db_lock:
             self.client.execute(
                 """INSERT INTO memory_entries (id, workspace, updated_at, doc)
@@ -147,7 +148,7 @@ class PgMemoryStore(MemoryStore):
                 """INSERT INTO memory_relations (rel_id, src_id, dst_id, doc)
                    VALUES ($1,$2,$3,$4) ON CONFLICT(rel_id) DO NOTHING""",
                 [uuid.uuid4().hex, rel.src_id, rel.dst_id,
-                 json.dumps(rel.__dict__)],
+                 self._codec.seal(rel.__dict__)],
             )
 
     def set_embedding(self, entry_id: str, vec: np.ndarray) -> None:
@@ -205,6 +206,32 @@ class PgMemoryStore(MemoryStore):
                 entries = list(self._entries.values())
             for e in entries:
                 self._persist(e)
+
+    # -- rotation (privacy-plane KeyRotationController contract) --------
+
+    def iter_envelopes(self):
+        from omnia_tpu.privacy.atrest import RecordCodec
+
+        for row in self.client.query("SELECT id, doc FROM memory_entries"):
+            env = RecordCodec.envelope_of(row["doc"])
+            if env is not None:
+                yield "entry:" + row["id"], env
+        for row in self.client.query("SELECT rel_id, doc FROM memory_relations"):
+            env = RecordCodec.envelope_of(row["doc"])
+            if env is not None:
+                yield "rel:" + row["rel_id"], env
+
+    def replace_envelope(self, blob_id: str, env) -> None:
+        from omnia_tpu.privacy.atrest import RecordCodec
+
+        kind, _, key = blob_id.partition(":")
+        table, col = (("memory_entries", "id") if kind == "entry"
+                      else ("memory_relations", "rel_id"))
+        with self._db_lock:
+            self.client.execute(
+                f"UPDATE {table} SET doc=$1 WHERE {col}=$2",
+                [RecordCodec.reseal(env), key],
+            )
 
     # -- advisory locks (worker exclusion) ------------------------------
 

@@ -90,7 +90,14 @@ class Bm25Index:
 
 
 class MemoryStore:
-    def __init__(self, path: Optional[str] = None, embedding_dim: Optional[int] = None):
+    def __init__(self, path: Optional[str] = None, embedding_dim: Optional[int] = None,
+                 cipher=None):
+        from omnia_tpu.privacy.atrest import RecordCodec
+
+        # At-rest encryption of persisted entry/relation payloads
+        # (reference memory-api resolves its cipher at assembly like
+        # session-api; the in-memory working set stays plaintext).
+        self._codec = RecordCodec(cipher)
         self._entries: dict[str, MemoryEntry] = {}
         self._relations: list[Relation] = []
         # Idempotency index scoped by (workspace, agent, user, about.key):
@@ -111,7 +118,7 @@ class MemoryStore:
     def _load(self, path: str) -> None:
         with open(path) as f:
             for line in f:
-                rec = json.loads(line)
+                rec = self._codec.open(line)
                 if rec.get("_kind") == "relation":
                     rec.pop("_kind")
                     self._relations.append(Relation(**rec))
@@ -127,10 +134,38 @@ class MemoryStore:
             return
         with self._lock, open(path + ".tmp", "w") as f:
             for e in self._entries.values():
-                f.write(json.dumps({"_kind": "entry", **e.to_dict(include_embedding=True)}) + "\n")
+                f.write(self._codec.seal(
+                    {"_kind": "entry", **e.to_dict(include_embedding=True)}
+                ) + "\n")
             for r in self._relations:
-                f.write(json.dumps({"_kind": "relation", **r.__dict__}) + "\n")
+                f.write(self._codec.seal(
+                    {"_kind": "relation", **r.__dict__}
+                ) + "\n")
         os.replace(path + ".tmp", path)
+
+    def rotate_all(self, cipher) -> int:
+        """Privacy-plane rotation hook: the working set is plaintext in
+        memory, so re-snapshotting under the (already-rotated) cipher
+        re-seals every persisted payload with the current KEK. A no-op
+        sweep (no envelope older than current) skips the file rewrite —
+        the hourly reconcile must not rewrite a 100k-entry snapshot and
+        inflate the rewrapped metric when nothing rotated."""
+        if not self._path or not self._codec.active:
+            return 0
+        from omnia_tpu.privacy.atrest import RecordCodec, key_order
+
+        cur_order = key_order(cipher.kms.current_key_id())
+        stale = 0
+        if os.path.exists(self._path):
+            with open(self._path) as f:
+                for line in f:
+                    env = RecordCodec.envelope_of(line)
+                    if env is None or key_order(env.key_id) < cur_order:
+                        stale += 1
+        if stale == 0:
+            return 0
+        self.snapshot()
+        return stale
 
     # -- writes -----------------------------------------------------------
 

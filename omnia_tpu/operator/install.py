@@ -32,6 +32,13 @@ DEFAULT_VALUES: dict = {
     "sessionApi": {"replicas": 1},
     "memoryApi": {"replicas": 1},
     "redis": {"enabled": True},
+    # At-rest envelope encryption for session/memory storage (reference
+    # cmd/session-api/main.go:210 resolver). enabled=True stamps
+    # OMNIA_ENCRYPTION=local on session-api/memory-api with the KEK
+    # pulled from `secretName[secretKey]` via secretKeyRef — the key
+    # itself never appears in the rendered manifests.
+    "encryption": {"enabled": False, "secretName": "omnia-kek",
+                   "secretKey": "kek"},
     "serviceAccount": "omnia-operator",
     # Bundled observability (reference charts/omnia/templates/observability:
     # Prometheus + Grafana + Loki + Tempo + an Alloy collector). Services
@@ -80,6 +87,14 @@ VALUES_SCHEMA = {
         "redis": {
             "type": "object", "additionalProperties": False,
             "properties": {"enabled": {"type": "boolean"}},
+        },
+        "encryption": {
+            "type": "object", "additionalProperties": False,
+            "properties": {
+                "enabled": {"type": "boolean"},
+                "secretName": {"type": "string", "minLength": 1},
+                "secretKey": {"type": "string", "minLength": 1},
+            },
         },
         "observability": {
             "type": "object", "additionalProperties": False,
@@ -247,6 +262,15 @@ def render_install(values: Optional[dict] = None) -> list[dict]:
     common_env = redis_env + [
         {"name": "OMNIA_NAMESPACE", "value": ns},
     ]
+    enc_env = []
+    if v["encryption"]["enabled"]:
+        enc_env = [
+            {"name": "OMNIA_ENCRYPTION", "value": "local"},
+            {"name": "OMNIA_KEK_B64",
+             "valueFrom": {"secretKeyRef": {
+                 "name": v["encryption"]["secretName"],
+                 "key": v["encryption"]["secretKey"]}}},
+        ]
     if v["observability"]["enabled"]:
         # Trace export address (cli._tracer). The OPERATOR's copy is the
         # load-bearing one: it propagates to every agent pod it renders
@@ -274,7 +298,7 @@ def render_install(values: Optional[dict] = None) -> list[dict]:
             v["sessionApi"]["replicas"],
             [{"name": "http", "containerPort": 8300},
              {"name": "metrics", "containerPort": 8301}],
-            common_env,
+            common_env + enc_env,
         ),
         _service(ns, "omnia-session-api", "session-api",
                  [{"name": "http", "port": 8300}]),
@@ -283,7 +307,7 @@ def render_install(values: Optional[dict] = None) -> list[dict]:
             v["memoryApi"]["replicas"],
             [{"name": "http", "containerPort": 8400},
              {"name": "metrics", "containerPort": 8401}],
-            common_env + [
+            common_env + enc_env + [
                 {"name": "OMNIA_SESSION_API_URL",
                  "value": f"http://omnia-session-api.{ns}.svc:8300"},
             ],
@@ -292,7 +316,7 @@ def render_install(values: Optional[dict] = None) -> list[dict]:
                  [{"name": "http", "port": 8400}]),
     ]
     if v["observability"]["enabled"]:
-        out += _render_observability(ns, v["observability"], sa)
+        out += _render_observability(ns, v["observability"])
     return out
 
 
@@ -323,7 +347,7 @@ GRAFANA_DASHBOARD = {
 }
 
 
-def _render_observability(ns: str, cfg: dict, sa: str = "omnia-operator") -> list[dict]:
+def _render_observability(ns: str, cfg: dict) -> list[dict]:
     import json as _json
 
     prom_cfg = {

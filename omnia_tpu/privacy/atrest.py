@@ -1,0 +1,207 @@
+"""At-rest encryption for session + memory storage.
+
+Counterpart of the reference's startup-time encryption resolution
+(reference cmd/session-api/main.go:210 resolves a cipher + KMS before
+the store is built; internal/session/encryption_resolver.go picks the
+mode, kms_factory.go builds the key service; the postgres provider
+re-encrypts rows on rotation). Here:
+
+- `resolve_cipher()` reads the deployment env (stamped from CRD config
+  by the operator) and returns an EnvelopeCipher or None:
+    OMNIA_ENCRYPTION       off (default) | local
+    OMNIA_KEK_B64          base64 32-byte KEK (local mode)
+    OMNIA_KEK_FILE         file holding the raw/base64 KEK (local mode)
+- `RecordCodec` seals/opens record payloads at the storage boundary.
+  Sealed payloads are JSON objects tagged `_enc` carrying the envelope
+  (wrapped DEK + nonce + ciphertext), so any store that round-trips a
+  JSON string can hold ciphertext without schema changes, and legacy
+  plaintext rows keep reading (passthrough on open).
+
+Rotation: stores expose envelopes via iter_envelopes/replace_envelope
+(row stores) or rotate_all (blob stores) and register with the
+privacy plane's KeyRotationController, which re-wraps DEKs under the
+new KEK without touching payload bytes.
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import os
+from typing import Any, Optional
+
+from omnia_tpu.privacy.encryption import Envelope, EnvelopeCipher, LocalKms
+
+ENC_TAG = "_enc"
+
+
+class EncryptionConfigError(RuntimeError):
+    pass
+
+
+def key_order(key_id: str) -> float:
+    """KEK generation ordering: kek-0 < gen-<ts>-<n> by timestamp.
+    Rotation must never DOWNGRADE an envelope to an older generation
+    (after a restart the resolver comes up on kek-0; without ordering
+    the first sweep would rewrap the whole store backwards)."""
+    if key_id.startswith("gen-"):
+        parts = key_id.split("-")
+        try:
+            return float(parts[1]) + float(parts[2]) * 1e-6
+        except (IndexError, ValueError):
+            return 0.0
+    return 0.0
+
+
+def _load_kek(e) -> bytes:
+    raw_b64 = e.get("OMNIA_KEK_B64", "")
+    if raw_b64:
+        key = base64.b64decode(raw_b64)
+    else:
+        path = e.get("OMNIA_KEK_FILE", "")
+        if not path:
+            raise EncryptionConfigError(
+                "OMNIA_ENCRYPTION=local needs OMNIA_KEK_B64 or OMNIA_KEK_FILE"
+            )
+        with open(path, "rb") as f:
+            data = f.read().strip()
+        try:
+            key = base64.b64decode(data, validate=True)
+        except Exception:
+            key = data
+    if len(key) != 32:
+        raise EncryptionConfigError(
+            f"KEK must be 32 bytes (got {len(key)}); generate with "
+            "`head -c32 /dev/urandom | base64`"
+        )
+    return key
+
+
+class DerivedLocalKms(LocalKms):
+    """LocalKms whose generation KEKs are HKDF-derived from the root
+    secret by key_id — so after a pod restart (only OMNIA_KEK_* survives)
+    envelopes wrapped under ANY past generation still unwrap: the KEK for
+    `gen-…` is recomputed on demand from root + key_id. A cloud-KMS
+    backend would persist generations server-side instead; this is the
+    local-mode equivalent of that durability."""
+
+    def __init__(self, root: bytes):
+        self._root = root
+        super().__init__({"kek-0": self._derive("kek-0")}, current="kek-0")
+
+    def _derive(self, key_id: str) -> bytes:
+        import hashlib
+        import hmac as _hmac
+
+        return _hmac.new(
+            self._root, b"omnia-kek:" + key_id.encode(), hashlib.sha256
+        ).digest()
+
+    def add_key(self, key_id: str, key=None, make_current: bool = True) -> None:
+        super().add_key(key_id, key or self._derive(key_id), make_current)
+
+    def _ensure(self, key_id: str) -> None:
+        with self._lock:
+            if key_id not in self._keys:
+                self._keys[key_id] = self._derive(key_id)
+
+    def wrap(self, key_id: str, dek: bytes) -> bytes:
+        self._ensure(key_id)
+        return super().wrap(key_id, dek)
+
+    def unwrap(self, key_id: str, wrapped: bytes) -> bytes:
+        self._ensure(key_id)
+        return super().unwrap(key_id, wrapped)
+
+    def make_current(self, key_id: str) -> None:
+        self._ensure(key_id)
+        super().make_current(key_id)
+
+
+def resolve_cipher(env: Optional[dict] = None) -> Optional[EnvelopeCipher]:
+    """Startup-time resolution. Fail-closed: a configured-but-broken
+    encryption setup raises rather than silently storing plaintext."""
+    e = env if env is not None else os.environ
+    mode = (e.get("OMNIA_ENCRYPTION") or "off").lower()
+    if mode in ("", "off", "none", "disabled"):
+        return None
+    if mode != "local":
+        raise EncryptionConfigError(
+            f"unknown OMNIA_ENCRYPTION mode {mode!r} (off|local)"
+        )
+    return EnvelopeCipher(DerivedLocalKms(_load_kek(e)))
+
+
+class RecordCodec:
+    """Seal/open JSON payloads at a store's write/read boundary.
+    cipher=None → passthrough (the off posture costs nothing)."""
+
+    def __init__(self, cipher: Optional[EnvelopeCipher] = None):
+        self.cipher = cipher
+
+    @property
+    def active(self) -> bool:
+        return self.cipher is not None
+
+    # -- dict payloads --------------------------------------------------
+
+    def seal_doc(self, doc: dict) -> dict:
+        """Sealed payload as a dict — for stores whose driver handles the
+        JSON encoding itself (jsonb columns)."""
+        if self.cipher is None:
+            return doc
+        env = self.cipher.encrypt(json.dumps(doc).encode())
+        return {ENC_TAG: env.to_json()}
+
+    def seal(self, doc: dict) -> str:
+        return json.dumps(self.seal_doc(doc))
+
+    def open(self, raw: Any) -> dict:
+        doc = json.loads(raw) if isinstance(raw, (str, bytes)) else raw
+        if isinstance(doc, dict) and ENC_TAG in doc:
+            if self.cipher is None:
+                raise EncryptionConfigError(
+                    "sealed record found but no cipher configured "
+                    "(set OMNIA_ENCRYPTION=local + the KEK)"
+                )
+            return json.loads(
+                self.cipher.decrypt(Envelope.from_json(doc[ENC_TAG]))
+            )
+        return doc
+
+    # -- raw byte payloads ----------------------------------------------
+
+    def seal_bytes(self, data: bytes) -> bytes:
+        if self.cipher is None:
+            return data
+        env = self.cipher.encrypt(data)
+        return (ENC_TAG + ":").encode() + env.to_json().encode()
+
+    def open_bytes(self, data: bytes) -> bytes:
+        prefix = (ENC_TAG + ":").encode()
+        if not data.startswith(prefix):
+            return data
+        if self.cipher is None:
+            raise EncryptionConfigError(
+                "sealed blob found but no cipher configured"
+            )
+        return self.cipher.decrypt(
+            Envelope.from_json(data[len(prefix):].decode())
+        )
+
+    # -- rotation helpers ------------------------------------------------
+
+    @staticmethod
+    def envelope_of(raw: Any) -> Optional[Envelope]:
+        """The envelope inside a sealed JSON payload, else None."""
+        try:
+            doc = json.loads(raw) if isinstance(raw, (str, bytes)) else raw
+        except (json.JSONDecodeError, TypeError):
+            return None
+        if isinstance(doc, dict) and ENC_TAG in doc:
+            return Envelope.from_json(doc[ENC_TAG])
+        return None
+
+    @staticmethod
+    def reseal(env: Envelope) -> str:
+        return json.dumps({ENC_TAG: env.to_json()})

@@ -60,6 +60,15 @@ class LocalKms(Kms):
         with self._lock:
             return self._current
 
+    def make_current(self, key_id: str) -> None:
+        """Adopt an existing generation as current (restart recovery:
+        the rotation controller re-adopts the newest generation seen in
+        storage so progress is monotonic across restarts)."""
+        with self._lock:
+            if key_id not in self._keys:
+                raise KmsError(f"unknown key {key_id!r}")
+            self._current = key_id
+
     def wrap(self, key_id: str, dek: bytes) -> bytes:
         with self._lock:
             kek = self._keys.get(key_id)

@@ -132,16 +132,50 @@ class KeyRotationController:
         self.status["rotations"] += 1
         return key_id
 
-    def sweep(self) -> int:
-        """Re-wrap every envelope not under the current KEK. Returns the
-        count re-wrapped."""
+    @staticmethod
+    def _key_order(key_id: str) -> float:
+        from omnia_tpu.privacy.atrest import key_order
+
+        return key_order(key_id)
+
+    def _adopt_newest(self) -> str:
+        """Restart recovery: if storage holds envelopes under a NEWER
+        generation than the KMS's current (a previous process rotated,
+        then restarted), adopt that generation as current instead of
+        rolling the store back."""
         current = self.kms.current_key_id()
+        newest, newest_order = current, self._key_order(current)
+        for store in self.stores:
+            if not hasattr(store, "iter_envelopes"):
+                continue
+            for _bid, env in store.iter_envelopes():
+                o = self._key_order(env.key_id)
+                if o > newest_order:
+                    newest, newest_order = env.key_id, o
+        if newest != current and hasattr(self.kms, "make_current"):
+            self.kms.make_current(newest)
+            self._key_born.setdefault(newest, time.time())
+            self.status["currentKey"] = newest
+        return self.kms.current_key_id()
+
+    def sweep(self) -> int:
+        """Re-wrap every envelope under an OLDER KEK than current.
+        Returns the count re-wrapped."""
+        current = self._adopt_newest()
+        cur_order = self._key_order(current)
         n = 0
         for store in self.stores:
-            for blob_id, env in store.iter_envelopes():
-                if env.key_id != current:
-                    store.replace_envelope(blob_id, self.cipher.rotate(env))
-                    n += 1
+            # Row stores expose envelopes individually; blob stores (cold
+            # Parquet, jsonl snapshots) only offer a bulk rotate_all —
+            # per-envelope replace would rewrite the blob N times.
+            if hasattr(store, "iter_envelopes"):
+                for blob_id, env in store.iter_envelopes():
+                    if (env.key_id != current
+                            and self._key_order(env.key_id) < cur_order):
+                        store.replace_envelope(blob_id, self.cipher.rotate(env))
+                        n += 1
+            elif hasattr(store, "rotate_all"):
+                n += store.rotate_all(self.cipher)
         self.status["rewrapped"] += n
         self.status["lastRunAt"] = time.time()
         return n

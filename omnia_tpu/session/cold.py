@@ -107,9 +107,14 @@ _MANIFEST_KEY = "manifest.json"
 
 
 class ColdArchive:
-    def __init__(self, blobstore=None) -> None:
+    def __init__(self, blobstore=None, cipher=None) -> None:
+        from omnia_tpu.privacy.atrest import RecordCodec
+
         self.blobs = blobstore or MemoryBlobStore()
         self._lock = threading.Lock()
+        # At-rest encryption of the Parquet `body` column: kind/ids stay
+        # plaintext for manifest/index reads, payloads are ciphertext.
+        self._codec = RecordCodec(cipher)
 
     # -- manifest ------------------------------------------------------
 
@@ -145,20 +150,30 @@ class ColdArchive:
                         old_table.column("record_id").to_pylist(),
                         old_table.column("body").to_pylist(),
                     ):
-                        merged[rid or body] = {"kind": kind, "body": body}
+                        # open() so a sealed prior archive merges with new
+                        # plaintext records symmetrically; resealed below.
+                        # Dedup keys for rid-less records use the OPENED
+                        # doc (sorted) on both sides — the sealed body is
+                        # nondeterministic ciphertext and would duplicate
+                        # on every re-archive.
+                        doc = self._codec.open(body)
+                        merged[rid or json.dumps(doc, sort_keys=True)] = {
+                            "kind": kind, "doc": doc,
+                        }
             for kind, recs in records.items():
                 for r in recs:
                     rid = str(r.get("record_id", ""))
-                    body = json.dumps(r)
-                    merged[rid or body] = {"kind": kind, "body": body}
+                    merged[rid or json.dumps(r, sort_keys=True)] = {
+                        "kind": kind, "doc": r,
+                    }
             rows = {"kind": [], "record_id": [], "session_id": [], "created_at": [], "body": []}
             for rid, item in merged.items():
-                d = json.loads(item["body"])
+                d = item["doc"]
                 rows["kind"].append(item["kind"])
                 rows["record_id"].append(str(d.get("record_id", "")))
                 rows["session_id"].append(session.session_id)
                 rows["created_at"].append(float(d.get("created_at", 0.0)))
-                rows["body"].append(item["body"])
+                rows["body"].append(self._codec.seal(d))
             table = pa.Table.from_pydict(rows, schema=_SCHEMA)
             buf = io.BytesIO()
             pq.write_table(table, buf, compression="zstd")
@@ -258,9 +273,49 @@ class ColdArchive:
             for k, body in zip(kinds, bodies):
                 if kind is not None and k != kind:
                     continue
-                out.append(from_dict(k, json.loads(body)))
+                out.append(from_dict(k, self._codec.open(body)))
         out.sort(key=lambda r: r.created_at)
         return out
+
+    def rotate_all(self, cipher) -> int:
+        """Bulk DEK re-wrap (privacy-plane KeyRotationController): rewrite
+        each Parquet object once with every sealed body's DEK re-wrapped
+        under the current KEK — per-record replace_envelope would rewrite
+        the blob N times. Returns envelopes re-wrapped."""
+        from omnia_tpu.privacy.atrest import RecordCodec, key_order
+
+        current = cipher.kms.current_key_id()
+        cur_order = key_order(current)
+        n = 0
+        with self._lock:
+            m = self._load_manifest()
+            for sid, entry in m["sessions"].items():
+                raw = self.blobs.get(entry["key"])
+                if raw is None:
+                    continue
+                table = pq.read_table(io.BytesIO(raw))
+                bodies = table.column("body").to_pylist()
+                changed = False
+                new_bodies = []
+                for body in bodies:
+                    env = RecordCodec.envelope_of(body)
+                    if (env is not None and env.key_id != current
+                            and key_order(env.key_id) < cur_order):
+                        new_bodies.append(RecordCodec.reseal(cipher.rotate(env)))
+                        changed = True
+                        n += 1
+                    else:
+                        new_bodies.append(body)
+                if not changed:
+                    continue
+                cols = {name: table.column(name).to_pylist()
+                        for name in ("kind", "record_id", "session_id", "created_at")}
+                cols["body"] = new_bodies
+                out = pa.Table.from_pydict(cols, schema=_SCHEMA)
+                buf = io.BytesIO()
+                pq.write_table(out, buf, compression="zstd")
+                self.blobs.put(entry["key"], buf.getvalue())
+        return n
 
     def delete_session(self, session_id: str) -> bool:
         with self._lock:

@@ -75,8 +75,14 @@ def _day(ts: float) -> str:
 
 
 class PgWarmStore:
-    def __init__(self, client: PGClient) -> None:
+    def __init__(self, client: PGClient, cipher=None) -> None:
+        from omnia_tpu.privacy.atrest import RecordCodec
+
         self.client = client
+        # At-rest envelope encryption of record bodies (reference
+        # internal/session/providers/postgres encrypts + re-encrypts on
+        # rotation); indexing columns stay plaintext.
+        self._codec = RecordCodec(cipher)
         # Usage upserts are read-modify-write across two statements; the
         # lock keeps a single writer's dup-check atomic (multi-writer
         # deployments rely on record_id PK conflict = dup, same as the
@@ -172,7 +178,7 @@ class PgWarmStore:
                VALUES ($1,$2,$3,$4,$5,$6)
                ON CONFLICT(record_id) DO UPDATE SET body=excluded.body""",
             [body.get("record_id"), kind, session_id, _day(created_at),
-             created_at, body],
+             created_at, self._codec.seal_doc(body)],
         )
 
     def append_message(self, rec: MessageRecord) -> None:
@@ -195,7 +201,7 @@ class PgWarmStore:
                    ON CONFLICT(record_id) DO NOTHING
                    RETURNING record_id""",
                 [rec.record_id, rec.session_id, _day(rec.created_at),
-                 rec.created_at, body],
+                 rec.created_at, self._codec.seal_doc(body)],
             )
             if not inserted:
                 return  # duplicate: usage increments must not double-count
@@ -232,7 +238,7 @@ class PgWarmStore:
             " ORDER BY created_at",
             [session_id, kind],
         )
-        return [json.loads(r["body"]) for r in rows]
+        return [self._codec.open(r["body"]) for r in rows]
 
     def messages(self, session_id: str) -> list[MessageRecord]:
         return [MessageRecord(**d) for d in self._read("message", session_id)]
@@ -290,6 +296,25 @@ class PgWarmStore:
             for kind in ("message", "tool_call", "provider_call",
                          "eval_result", "event")
         }
+
+    # -- rotation (privacy-plane KeyRotationController contract) -------
+
+    def iter_envelopes(self):
+        from omnia_tpu.privacy.atrest import RecordCodec
+
+        rows = self.client.query("SELECT record_id, body FROM records", [])
+        for r in rows:
+            env = RecordCodec.envelope_of(r["body"])
+            if env is not None:
+                yield r["record_id"], env
+
+    def replace_envelope(self, record_id: str, env) -> None:
+        from omnia_tpu.privacy.atrest import ENC_TAG
+
+        self.client.execute(
+            "UPDATE records SET body=$1 WHERE record_id=$2",
+            [{ENC_TAG: env.to_json()}, record_id],
+        )
 
     def close(self) -> None:
         self.client.close()

@@ -70,12 +70,18 @@ def _day(ts: float) -> str:
 
 
 class WarmStore:
-    def __init__(self, path: str = ":memory:") -> None:
+    def __init__(self, path: str = ":memory:", cipher=None) -> None:
+        from omnia_tpu.privacy.atrest import RecordCodec
+
         # One shared connection guarded by a lock: SQLite serializes writes
         # anyway and this keeps :memory: stores coherent across threads.
         self._db = sqlite3.connect(path, check_same_thread=False)
         self._db.execute("PRAGMA journal_mode=WAL")
         self._lock = threading.Lock()
+        # At-rest envelope encryption of record bodies (reference
+        # cmd/session-api/main.go:210 resolves the cipher before the
+        # store); indexing columns stay plaintext, body is ciphertext.
+        self._codec = RecordCodec(cipher)
         with self._lock:
             self._db.executescript(_SCHEMA)
             self._db.commit()
@@ -186,7 +192,7 @@ class WarmStore:
                     session_id,
                     _day(created_at),
                     created_at,
-                    json.dumps(body),
+                    self._codec.seal(body),
                 ),
             )
             self._db.commit()
@@ -263,7 +269,7 @@ class WarmStore:
                 " ORDER BY created_at",
                 (session_id, kind),
             ).fetchall()
-        return [json.loads(r[0]) for r in rows]
+        return [self._codec.open(r[0]) for r in rows]
 
     def messages(self, session_id: str) -> list[MessageRecord]:
         return [MessageRecord(**d) for d in self._read("message", session_id)]
@@ -325,6 +331,30 @@ class WarmStore:
         for kind in ("message", "tool_call", "provider_call", "eval_result", "event"):
             out[kind] = self._read(kind, session_id)
         return out
+
+    # -- rotation (privacy-plane KeyRotationController contract) -------
+
+    def iter_envelopes(self):
+        from omnia_tpu.privacy.atrest import RecordCodec
+
+        with self._lock:
+            rows = self._db.execute(
+                "SELECT record_id, body FROM records"
+            ).fetchall()
+        for rid, body in rows:
+            env = RecordCodec.envelope_of(body)
+            if env is not None:
+                yield rid, env
+
+    def replace_envelope(self, record_id: str, env) -> None:
+        from omnia_tpu.privacy.atrest import RecordCodec
+
+        with self._lock:
+            self._db.execute(
+                "UPDATE records SET body=? WHERE record_id=?",
+                (RecordCodec.reseal(env), record_id),
+            )
+            self._db.commit()
 
     def close(self) -> None:
         with self._lock:

@@ -65,6 +65,28 @@ class TestInstallBundle:
             "python -m omnia_tpu.operator.install deploy/values.yaml"
         )
 
+    def test_encryption_values_stamp_env_via_secret(self):
+        """values.encryption stamps OMNIA_ENCRYPTION + a secretKeyRef KEK
+        on session-api and memory-api; the key never appears inline."""
+        out = render_install({"encryption": {"enabled": True,
+                                             "secretName": "my-kek"}})
+        assert lint(out) == []
+        for name in ("omnia-session-api", "omnia-memory-api"):
+            dep = next(m for m in out if m["kind"] == "Deployment"
+                       and m["metadata"]["name"] == name)
+            env = {e["name"]: e for e
+                   in dep["spec"]["template"]["spec"]["containers"][0]["env"]}
+            assert env["OMNIA_ENCRYPTION"]["value"] == "local"
+            ref = env["OMNIA_KEK_B64"]["valueFrom"]["secretKeyRef"]
+            assert ref == {"name": "my-kek", "key": "kek"}
+            assert "value" not in env["OMNIA_KEK_B64"]
+        # default render stays off
+        bare = next(m for m in render_install() if m["kind"] == "Deployment"
+                    and m["metadata"]["name"] == "omnia-session-api")
+        names = [e["name"] for e
+                 in bare["spec"]["template"]["spec"]["containers"][0]["env"]]
+        assert "OMNIA_ENCRYPTION" not in names
+
     def test_values_override_merge(self):
         out = render_install({
             "namespace": "custom-ns",
