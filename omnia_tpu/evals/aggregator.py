@@ -28,6 +28,7 @@ class CellStats:
     passed: int = 0
     errors: int = 0
     latencies: list = dataclasses.field(default_factory=list)
+    turn_latencies_ms: list = dataclasses.field(default_factory=list)
     cost_usd: float = 0.0
     tokens: int = 0
 
@@ -50,6 +51,10 @@ class CellStats:
             "error_rate": self.error_rate,
             "p50_latency_s": _percentile(self.latencies, 50),
             "p95_latency_s": _percentile(self.latencies, 95),
+            # Per-turn percentiles (fleet SLO view — scenario latency
+            # hides slow turns inside multi-turn scenarios).
+            "p50_turn_ms": _percentile(self.turn_latencies_ms, 50),
+            "p95_turn_ms": _percentile(self.turn_latencies_ms, 95),
             "cost_usd": self.cost_usd,
             "tokens": self.tokens,
         }
@@ -78,6 +83,7 @@ class Aggregator:
         elif r.passed:
             cell.passed += 1
         cell.latencies.append(r.latency_s)
+        cell.turn_latencies_ms.extend(r.turn_latency_ms)
         cell.cost_usd += r.cost_usd
         cell.tokens += r.tokens
         return True

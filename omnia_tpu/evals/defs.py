@@ -155,6 +155,11 @@ class WorkResult:
     tokens: int = 0
     cost_usd: float = 0.0
     worker: str = ""
+    # Per-turn latencies (reference vu_pool.go WorkResult carries turn
+    # timings for the fleet SLO story): raw ms samples + a fixed-bucket
+    # histogram dict (vu_pool.LatencyHistogram.to_dict()).
+    turn_latency_ms: list = dataclasses.field(default_factory=list)
+    latency_hist: dict = dataclasses.field(default_factory=dict)
 
     @property
     def passed(self) -> bool:
@@ -169,4 +174,5 @@ class WorkResult:
         d["checks"] = [CheckResult(**c) for c in d.get("checks", [])]
         return cls(**{k: d[k] for k in (
             "work_id", "job", "scenario", "provider", "repeat", "checks",
-            "error", "latency_s", "tokens", "cost_usd", "worker") if k in d})
+            "error", "latency_s", "tokens", "cost_usd", "worker",
+            "turn_latency_ms", "latency_hist") if k in d})

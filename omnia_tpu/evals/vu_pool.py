@@ -1,0 +1,247 @@
+"""Fleet-mode load generation: VU pool + load profiles + latency
+histograms.
+
+Counterpart of the reference's arena fleet worker internals (reference
+ee/cmd/arena-worker/vu_pool.go — a pool of virtual users popping the
+queue under a concurrency gate; load_profile.go — linear ramp-up and
+pending-aware ramp-down of allowed concurrency). This is what makes
+BASELINE config 3's "64 concurrent sessions at SLO" a demonstrable
+claim: the pool holds N live WebSocket users against a facade while
+per-turn latencies land in histograms on each WorkResult.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Optional
+
+# Log-spaced bucket upper bounds in milliseconds (last bucket = +inf).
+DEFAULT_BUCKETS_MS = (
+    5, 10, 25, 50, 100, 250, 500, 1000, 2500, 5000, 10000, 30000,
+)
+
+
+class LatencyHistogram:
+    """Fixed-bucket latency histogram. All units are MILLISECONDS, in and
+    out. Percentiles report the UPPER BOUND of the bucket the rank lands
+    in (a conservative estimate); samples past the last bucket report the
+    maximum observed value, never a fabricated bound."""
+
+    def __init__(self, buckets_ms=DEFAULT_BUCKETS_MS):
+        self.buckets_ms = tuple(buckets_ms)
+        self.counts = [0] * (len(self.buckets_ms) + 1)
+        self.total = 0
+        self.sum_ms = 0.0
+        self.max_ms = 0.0
+        self._lock = threading.Lock()
+
+    def record(self, ms: float) -> None:
+        with self._lock:
+            self.total += 1
+            self.sum_ms += ms
+            self.max_ms = max(self.max_ms, ms)
+            for i, ub in enumerate(self.buckets_ms):
+                if ms <= ub:
+                    self.counts[i] += 1
+                    return
+            self.counts[-1] += 1
+
+    def merge(self, other: "LatencyHistogram") -> None:
+        with self._lock:
+            for i, c in enumerate(other.counts):
+                self.counts[i] += c
+            self.total += other.total
+            self.sum_ms += other.sum_ms
+            self.max_ms = max(self.max_ms, other.max_ms)
+
+    def percentile(self, p: float) -> float:
+        """Estimated percentile in ms (bucket upper bound; overflow
+        bucket reports max_ms — the real observed ceiling)."""
+        with self._lock:
+            if self.total == 0:
+                return 0.0
+            rank = p / 100.0 * self.total
+            seen = 0
+            for i, c in enumerate(self.counts):
+                seen += c
+                if seen >= rank and c:
+                    if i < len(self.buckets_ms):
+                        return float(self.buckets_ms[i])
+                    return self.max_ms
+            return self.max_ms
+
+    def to_dict(self) -> dict:
+        with self._lock:
+            return {
+                "buckets_ms": list(self.buckets_ms),
+                "counts": list(self.counts),
+                "count": self.total,
+                "sum_ms": round(self.sum_ms, 3),
+                "max_ms": round(self.max_ms, 3),
+            }
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "LatencyHistogram":
+        h = cls(d.get("buckets_ms", DEFAULT_BUCKETS_MS))
+        h.counts = list(d.get("counts", h.counts))
+        h.total = int(d.get("count", 0))
+        h.sum_ms = float(d.get("sum_ms", 0.0))
+        h.max_ms = float(d.get("max_ms", 0.0))
+        return h
+
+
+class LoadProfile:
+    """Allowed-concurrency schedule (reference load_profile.go): linear
+    ramp-up to the target over ramp_up_s, and pending-aware ramp-down —
+    when fewer items remain than VUs, idle VUs stand down instead of
+    hammering an empty queue."""
+
+    def __init__(self, concurrency: int, ramp_up_s: float = 0.0):
+        self.concurrency = max(1, concurrency)
+        self.ramp_up_s = max(0.0, ramp_up_s)
+        self._started_at: Optional[float] = None
+
+    def start(self) -> None:
+        self._started_at = time.monotonic()
+
+    def elapsed(self) -> float:
+        return 0.0 if self._started_at is None else time.monotonic() - self._started_at
+
+    def allowed(self, pending: Optional[int] = None) -> int:
+        n = self.concurrency
+        if self.ramp_up_s > 0:
+            frac = min(1.0, self.elapsed() / self.ramp_up_s)
+            # At least one VU from t=0 so the ramp isn't a dead start.
+            n = max(1, int(frac * self.concurrency))
+        if pending is not None and pending > 0:
+            # Ramp-down: no more VUs than items remain. When pending is 0
+            # the full allowance stays open so every VU can pop, observe
+            # the drain, and exit (capping at 1 would serialize shutdown).
+            n = min(n, pending)
+        return n
+
+
+class PoolStopped(Exception):
+    """Raised by execute() to stop the whole pool immediately (budget
+    exhaustion): the in-flight item is NOT reported/acked, so a later
+    reclaim can re-run it once budget returns."""
+
+
+class VUPool:
+    """Pool of virtual users executing work under a LoadProfile.
+
+    - `source(vu_id)` → item or None (queue pop; None = drained). Each VU
+      passes its own id so queue consumers can be per-VU (shared consumer
+      names let reclaim steal a sibling's in-flight item).
+    - `execute(vu_id, item)` → result (exceptions become error results;
+      PoolStopped stops the whole pool)
+    - `report(item, result)` → publish/ack
+    Each VU loops pop→execute→report while the profile allows its slot.
+    """
+
+    def __init__(
+        self,
+        concurrency: int,
+        source: Callable[[int], Optional[object]],
+        execute: Callable[[int, object], object],
+        report: Callable[[object, object], None],
+        profile: Optional[LoadProfile] = None,
+        pending: Optional[Callable[[], int]] = None,
+        poll_interval_s: float = 0.02,
+    ):
+        self.profile = profile or LoadProfile(concurrency)
+        self.profile.concurrency = concurrency
+        self._source = source
+        self._execute = execute
+        self._report = report
+        self._pending = pending
+        self._poll = poll_interval_s
+        self._active = 0
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self.stats = {"executed": 0, "errors": 0, "max_active": 0}
+
+    def _try_acquire(self, vu_id: int) -> bool:
+        pend = self._pending() if self._pending else None
+        with self._lock:
+            if self._active >= self.profile.allowed(pend):
+                return False
+            self._active += 1
+            self.stats["max_active"] = max(self.stats["max_active"], self._active)
+            return True
+
+    def _release(self) -> None:
+        with self._lock:
+            self._active -= 1
+
+    def _vu_loop(self, vu_id: int) -> None:
+        import logging
+
+        log = logging.getLogger(__name__)
+        idle_polls = 0
+        while not self._stop.is_set():
+            if not self._try_acquire(vu_id):
+                time.sleep(self._poll)
+                continue
+            try:
+                try:
+                    item = self._source(vu_id)
+                except Exception:  # noqa: BLE001 — transient queue error
+                    log.exception("vu-%d: source failed; retrying", vu_id)
+                    time.sleep(self._poll)
+                    continue
+                if item is None:
+                    idle_polls += 1
+                    if idle_polls >= 3:
+                        return  # drained
+                    time.sleep(self._poll)
+                    continue
+                idle_polls = 0
+                try:
+                    result = self._execute(vu_id, item)
+                except PoolStopped:
+                    self._stop.set()
+                    return  # item left unacked for reclaim
+                except Exception as e:  # noqa: BLE001 — becomes a failed result
+                    with self._lock:
+                        self.stats["errors"] += 1
+                    result = e
+                try:
+                    self._report(item, result)
+                except Exception:  # noqa: BLE001 — item stays unacked
+                    log.exception("vu-%d: report failed; item unacked "
+                                  "(reclaimable)", vu_id)
+                    continue
+                with self._lock:
+                    self.stats["executed"] += 1
+            finally:
+                self._release()
+
+    def run(self, timeout_s: float = 300.0) -> dict:
+        """Blocks until all VUs drain the source (or timeout — on timeout
+        the pool is STOPPED before returning so no VU keeps executing or
+        acking behind the caller's back). Returns stats
+        {executed, errors, max_active}."""
+        self.profile.start()
+        threads = [
+            threading.Thread(target=self._vu_loop, args=(i,),
+                             name=f"vu-{i}", daemon=True)
+            for i in range(self.profile.concurrency)
+        ]
+        for t in threads:
+            t.start()
+        deadline = time.monotonic() + timeout_s
+        for t in threads:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                break
+            t.join(timeout=remaining)
+        if any(t.is_alive() for t in threads):
+            self._stop.set()  # deadline passed: stop VUs mid-queue
+            for t in threads:
+                t.join(timeout=1.0)
+        return dict(self.stats)
+
+    def stop(self) -> None:
+        self._stop.set()

@@ -89,40 +89,72 @@ class DirectRunner:
 
 
 class FleetRunner:
-    """Virtual-user WebSocket runner against a live facade."""
+    """Virtual-user WebSocket runner against a live facade.
 
-    def __init__(self, url_for: Callable[[str], str], recv_timeout_s: float = 60.0):
+    One live connection PER SESSION, held across the scenario's turns
+    (reference vu_pool.go VUs are stateful users, not per-turn dialers)
+    — so 64 concurrent scenarios really are 64 concurrent sockets on the
+    facade, and turn latency measures the turn, not the handshake."""
+
+    def __init__(self, url_for: Callable[[str], str], recv_timeout_s: float = 60.0,
+                 token_for: Optional[Callable[[str], str]] = None):
         self.url_for = url_for  # provider/agent name → ws url
         self.recv_timeout_s = recv_timeout_s
+        # Per-VU credential mint (reference fleet VUs authenticate as
+        # distinct virtual users — which also gives each VU its own
+        # rate-limit bucket at the facade instead of one shared
+        # per-address bucket tripping 4429 under load).
+        self.token_for = token_for
+        self._conns: dict[str, object] = {}
+        self._lock = threading.Lock()
 
-    def run_turn(self, provider: str, session_id: str, content: str) -> tuple[str, float, int]:
+    def _connect(self, provider: str, session_id: str):
         from websockets.sync.client import connect
 
         url = self.url_for(provider)
         sep = "&" if "?" in url else "?"
+        url = f"{url}{sep}session={session_id}"
+        if self.token_for is not None:
+            url += "&token=" + self.token_for(session_id)
+        ws = connect(url)
+        hello = json.loads(ws.recv(timeout=self.recv_timeout_s))
+        if hello.get("type") != "connected":
+            ws.close()
+            raise RuntimeError(f"no connected frame: {hello}")
+        return ws
+
+    def run_turn(self, provider: str, session_id: str, content: str) -> tuple[str, float, int, float]:
+        with self._lock:
+            ws = self._conns.get(session_id)
+        if ws is None:
+            ws = self._connect(provider, session_id)
+            with self._lock:
+                self._conns[session_id] = ws
         t0 = time.monotonic()
-        with connect(f"{url}{sep}session={session_id}") as ws:
-            hello = json.loads(ws.recv(timeout=self.recv_timeout_s))
-            if hello.get("type") != "connected":
-                raise RuntimeError(f"no connected frame: {hello}")
-            ws.send(json.dumps({"type": "message", "content": content}))
-            reply, tokens, cost = [], 0, 0.0
-            deadline = time.monotonic() + self.recv_timeout_s
-            while True:
-                msg = json.loads(ws.recv(timeout=max(0.1, deadline - time.monotonic())))
-                if msg["type"] == "chunk":
-                    reply.append(msg["text"])
-                elif msg["type"] == "error":
-                    raise RuntimeError(f"{msg.get('code')}: {msg.get('message')}")
-                elif msg["type"] == "done":
-                    usage = msg.get("usage") or {}
-                    tokens = usage.get("completion_tokens", 0)
-                    cost = usage.get("cost_usd", 0.0)
-                    break
-            return "".join(reply), time.monotonic() - t0, tokens, cost
+        ws.send(json.dumps({"type": "message", "content": content}))
+        reply, tokens, cost = [], 0, 0.0
+        deadline = time.monotonic() + self.recv_timeout_s
+        while True:
+            msg = json.loads(ws.recv(timeout=max(0.1, deadline - time.monotonic())))
+            if msg["type"] == "chunk":
+                reply.append(msg["text"])
+            elif msg["type"] == "error":
+                raise RuntimeError(f"{msg.get('code')}: {msg.get('message')}")
+            elif msg["type"] == "done":
+                usage = msg.get("usage") or {}
+                tokens = usage.get("completion_tokens", 0)
+                cost = usage.get("cost_usd", 0.0)
+                break
+        return "".join(reply), time.monotonic() - t0, tokens, cost
 
     def end_session(self, session_id: str) -> None:
-        pass  # fleet sessions live server-side; nothing to evict here
+        with self._lock:
+            ws = self._conns.pop(session_id, None)
+        if ws is not None:
+            try:
+                ws.close()
+            except Exception:  # noqa: BLE001 — already closed is fine
+                pass
 
 
 class ArenaWorker:
@@ -174,6 +206,7 @@ class ArenaWorker:
                 reply, latency, tokens, turn_cost = self.runner.run_turn(
                     item.provider, session_id, turn.user
                 )
+                result.turn_latency_ms.append(round(latency * 1000.0, 3))
                 result.tokens += tokens
                 if turn_cost <= 0.0 and self.cost_calculator is not None:
                     # Fallback pricing when the runner reports no cost
@@ -213,6 +246,13 @@ class ArenaWorker:
             if ender is not None:
                 ender(session_id)
         result.latency_s = time.monotonic() - t0
+        if result.turn_latency_ms:
+            from omnia_tpu.evals.vu_pool import LatencyHistogram
+
+            hist = LatencyHistogram()
+            for ms in result.turn_latency_ms:
+                hist.record(ms)
+            result.latency_hist = hist.to_dict()
         return result
 
     # -- loop -------------------------------------------------------------
@@ -270,6 +310,72 @@ class ArenaWorker:
                     return
                 self.queue.publish_result(result)
                 self.queue.ack(entry_id)
+
+    # -- fleet mode -------------------------------------------------------
+
+    def run_fleet(
+        self,
+        concurrency: int = 16,
+        ramp_up_s: float = 0.0,
+        timeout_s: float = 300.0,
+    ) -> dict:
+        """Drain the queue as a VU pool (reference worker_fleet.go over
+        vu_pool.go): up to `concurrency` virtual users execute scenarios
+        simultaneously under a ramp-up load profile. Returns pool stats
+        plus an aggregate turn-latency histogram
+        {executed, errors, max_active, latency: {p50_ms, p95_ms, count}}."""
+        from omnia_tpu.evals.vu_pool import (
+            LatencyHistogram, LoadProfile, PoolStopped, VUPool,
+        )
+
+        agg = LatencyHistogram()
+
+        def source(vu_id):
+            # Per-VU consumer (same invariant as _loop: a shared name
+            # would let reclaim steal a sibling's in-flight item).
+            return self.queue.next(f"{self.name}-fleet-{vu_id}")
+
+        def execute(vu_id, got):
+            _eid, item = got
+            try:
+                return self.process(item)
+            except BudgetExceeded as e:
+                # Stop the whole pool, leave the item unacked for a
+                # post-budget reclaim — same contract as the direct loop.
+                logger.warning("%s: budget exhausted, stopping fleet", self.name)
+                raise PoolStopped() from e
+
+        def report(got, result):
+            eid, item = got
+            if isinstance(result, Exception):
+                result = WorkResult(
+                    work_id=item.id, job=item.job,
+                    scenario=item.scenario.get("name", "?"),
+                    provider=item.provider, repeat=item.repeat,
+                    worker=self.name, error=str(result),
+                )
+            for ms in result.turn_latency_ms:
+                agg.record(ms)
+            self.queue.publish_result(result)
+            self.queue.ack(eid)
+
+        pool = VUPool(
+            concurrency=concurrency,
+            source=source,
+            execute=execute,
+            report=report,
+            profile=LoadProfile(concurrency, ramp_up_s=ramp_up_s),
+            pending=self.queue.depth,
+        )
+        stats = pool.run(timeout_s=timeout_s)
+        stats["latency"] = {
+            "p50_ms": agg.percentile(50),
+            "p95_ms": agg.percentile(95),
+            "p99_ms": agg.percentile(99),
+            "count": agg.total,
+            "hist": agg.to_dict(),
+        }
+        return stats
 
     def stop(self) -> None:
         self._stop.set()

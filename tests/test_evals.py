@@ -356,6 +356,106 @@ class TestFleetMode:
             runtime.shutdown()
 
 
+class TestFleetLoad:
+    def test_64_concurrent_vus_within_slo(self):
+        """BASELINE config 3 as a TEST (VERDICT r4 #4): 64 virtual users
+        drive a live facade (mock engine) concurrently through the VU
+        pool; every scenario completes, per-turn latency histograms land
+        in WorkResults, and p50/p95 sit inside an SLO."""
+        from omnia_tpu.facade.auth import AuthChain, HmacValidator
+        from omnia_tpu.facade.server import FacadeServer
+        from omnia_tpu.runtime.server import RuntimeServer
+
+        secret = b"fleet-load-secret"
+        reg = _registry()
+        runtime = RuntimeServer(pack=load_pack(PACK), providers=reg,
+                                provider_name="good")
+        rport = runtime.serve("localhost:0")
+        # Authenticated facade: each VU is a DISTINCT virtual user with
+        # its own rate-limit bucket — unauthenticated, all 64 share one
+        # per-address bucket and the facade correctly 4429s the flood.
+        facade = FacadeServer(runtime_target=f"localhost:{rport}",
+                              agent_name="eval-agent",
+                              auth_chain=AuthChain([HmacValidator(secret)]))
+        fport = facade.serve()
+        try:
+            spec = _spec(providers=("eval-agent",), repeats=64)
+            spec.mode = "fleet"
+            q = ArenaQueue()
+            n_items = q.enqueue(partition(spec))
+            assert n_items == 64
+            runner = FleetRunner(
+                lambda agent: f"ws://localhost:{fport}/ws",
+                token_for=lambda sid: HmacValidator.mint(
+                    secret, subject=f"vu-{sid}"),
+            )
+            worker = ArenaWorker(q, runner)
+            stats = worker.run_fleet(concurrency=64, ramp_up_s=0.2,
+                                     timeout_s=120.0)
+            assert stats["executed"] == 64, stats
+            assert stats["errors"] == 0, stats
+            # the pool genuinely ran many users at once (not serialized)
+            assert stats["max_active"] >= 8, stats
+            lat = stats["latency"]
+            assert lat["count"] == 64
+            # SLO: mock-engine turns over localhost — generous bounds,
+            # the point is the MEASUREMENT machinery, not the number
+            assert lat["p50_ms"] <= 2500, lat
+            assert lat["p95_ms"] <= 10000, lat
+            results = q.consume_results(count=200)
+            assert len(results) == 64
+            assert all(r.passed for r in results)
+            assert all(r.turn_latency_ms and r.latency_hist["count"] >= 1
+                       for r in results)
+        finally:
+            facade.shutdown()
+            runtime.shutdown()
+
+    def test_fleet_budget_stops_pool_and_leaves_items_reclaimable(self):
+        """Budget exhaustion mid-fleet stops the WHOLE pool (same
+        contract as the direct loop): no bogus error results, remaining
+        items stay claimable by a post-budget worker."""
+        q = ArenaQueue()
+        q.enqueue(partition(_spec(providers=("good",), repeats=40)))
+        runner = DirectRunner(load_pack(PACK), _registry())
+        worker = ArenaWorker(q, runner, budget=BudgetTracker(max_tokens=25))
+        stats = worker.run_fleet(concurrency=8, timeout_s=60.0)
+        assert stats["executed"] < 40
+        results = q.consume_results(count=100)
+        assert all(not r.error for r in results)  # no budget-as-error
+        assert q.depth() > 0  # unfinished work remains claimable
+
+    def test_load_profile_ramp(self):
+        from omnia_tpu.evals.vu_pool import LoadProfile
+
+        lp = LoadProfile(10, ramp_up_s=10.0)
+        lp.start()
+        lp._started_at -= 5.0  # halfway through the ramp
+        assert lp.allowed() == 5
+        lp._started_at -= 10.0  # past the ramp
+        assert lp.allowed() == 10
+        # pending-aware ramp-down, but full allowance at drain (pending=0)
+        assert lp.allowed(pending=3) == 3
+        assert lp.allowed(pending=0) == 10
+
+    def test_latency_histogram_percentiles(self):
+        from omnia_tpu.evals.vu_pool import LatencyHistogram
+
+        h = LatencyHistogram()
+        for ms in (4, 8, 20, 40, 90, 200, 400, 900, 2000, 4000):
+            h.record(ms)
+        assert h.total == 10
+        assert h.percentile(50) in (50.0, 100.0)
+        assert h.percentile(95) >= 2500.0
+        # round-trip through the WorkResult dict form
+        h2 = LatencyHistogram.from_dict(h.to_dict())
+        assert h2.to_dict() == h.to_dict()
+        merged = LatencyHistogram()
+        merged.merge(h2)
+        merged.merge(h2)
+        assert merged.total == 20
+
+
 class TestAtLeastOnceDedup:
     def test_duplicate_results_do_not_skew_job(self):
         ctrl = ArenaJobController()
