@@ -567,6 +567,12 @@ def doctor_main() -> int:
         doc.add_facade_ws_check(_env("OMNIA_FACADE_WS_URL"))
     if _env("OMNIA_OPERATOR_URL"):
         doc.add_crd_presence_check(_env("OMNIA_OPERATOR_URL"))
+    if _env("OMNIA_CONFIG_DIR"):
+        # Devroot posture: the doctor reads CRD status straight from the
+        # file-backed store (incl. ToolRegistry probe phases).
+        from omnia_tpu.operator.store import FileResourceStore
+
+        doc.add_tool_registry_check(FileResourceStore(_env("OMNIA_CONFIG_DIR")))
     # Observability bundle (install.py renders the trio; each component
     # exposes its own readiness path).
     for name, env, path in (

@@ -1,0 +1,41 @@
+"""Console bridge into the in-tree pack language server.
+
+The reference dashboard's editor attaches to promptkit-lsp (reference
+ee/cmd/promptkit-lsp); here the console's Editor view POSTs
+{op, text, line, character} to /api/lsp and gets the same payload
+shapes the stdio LSP serves (lsp.py diagnostics/completions/hover).
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Optional
+
+
+def handle_lsp(method: str, body: Optional[bytes], respond):
+    if method != "POST":
+        return respond(405, {"error": "POST only"})
+    from omnia_tpu import lsp
+
+    try:
+        doc = json.loads(body or b"{}")
+    except json.JSONDecodeError:
+        return respond(400, {"error": "bad json body"})
+    if not isinstance(doc, dict):
+        return respond(400, {"error": "body must be a JSON object"})
+    op = doc.get("op", "diagnostics")
+    text = doc.get("text", "")
+    if not isinstance(text, str):
+        return respond(400, {"error": "text must be a string"})
+    try:
+        line = int(doc.get("line") or 0)
+        character = int(doc.get("character") or 0)
+    except (TypeError, ValueError):
+        return respond(400, {"error": "line/character must be integers"})
+    if op == "diagnostics":
+        return respond(200, {"diagnostics": lsp.diagnostics(text)})
+    if op == "completion":
+        return respond(200, {"items": lsp.completions(text, line, character)})
+    if op == "hover":
+        return respond(200, {"hover": lsp.hover(text, line, character)})
+    return respond(400, {"error": f"unknown op {op!r}"})

@@ -549,6 +549,8 @@ class DashboardServer:
             return self._handle_console_token(headers)
         if path == "/api/resources":
             return self._handle_resources(method, query, body, headers)
+        if path == "/api/lsp":
+            return self._handle_lsp(method, body)
         if method != "GET":
             return 405, "application/json", b'{"error": "method not allowed"}'
         q = urllib.parse.parse_qs(query)
@@ -668,6 +670,11 @@ class DashboardServer:
         return self._json(200, {
             "token": token, "expires_in_s": self.CONSOLE_TOKEN_TTL_S,
         })
+
+    def _handle_lsp(self, method: str, body):
+        from omnia_tpu.dashboard.lsp_bridge import handle_lsp
+
+        return handle_lsp(method, body, self._json)
 
     def _handle_resources(self, method: str, query: str,
                           body: Optional[bytes], headers: dict):

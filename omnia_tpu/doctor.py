@@ -238,6 +238,51 @@ class Doctor:
 
         self.register("memory", check)
 
+    def add_tool_registry_check(self, store) -> None:
+        """Surface ToolRegistry probe results (reference doctor reads the
+        CRD status the probe controller writes): Degraded/Failed
+        registries or Unavailable tools become WARN/FAIL here."""
+        def check() -> CheckResult:
+            regs = store.list(kind="ToolRegistry")
+            if not regs:
+                return CheckResult("tool-registries", PASS, detail="none declared")
+            bad: list[str] = []
+            unprobed: list[str] = []
+            failed = False
+            for reg in regs:
+                status = reg.status or {}
+                phase = status.get("phase")
+                if not status.get("lastProbeAt"):
+                    # Never probed (operator not yet reconciled, or
+                    # user-authored YAML): reachability is UNKNOWN —
+                    # claiming "reachable" here would mask a down
+                    # backend during exactly the triage doctor is for.
+                    unprobed.append(reg.name)
+                    continue
+                down = [t["name"] for t in status.get("tools", [])
+                        if t.get("status") == "Unavailable"]
+                if down:
+                    bad.append(f"{reg.name}: {phase} "
+                               f"(unreachable: {', '.join(down)})")
+                if phase == "Failed":
+                    failed = True
+            if bad:
+                return CheckResult(
+                    "tool-registries", FAIL if failed else WARN,
+                    detail="; ".join(bad),
+                    remedy="check tool backend Services/endpoints",
+                )
+            if unprobed:
+                return CheckResult(
+                    "tool-registries", WARN,
+                    detail=f"not yet probed: {', '.join(unprobed)}",
+                    remedy="wait for the operator's probe pass (or check "
+                           "the operator is running)",
+                )
+            return CheckResult("tool-registries", PASS,
+                               detail=f"{len(regs)} registries reachable")
+        self.register("tool-registries", check)
+
     def add_streams_check(self, stream) -> None:
         def check() -> CheckResult:
             probe_group = "doctor-probe"

@@ -66,6 +66,9 @@ class ControllerManager(_SourceReconcilersMixin):
         self._queue: "queue.Queue[tuple[str, str, str]]" = queue.Queue()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        # In-flight ToolRegistry probes (network dials run off-thread).
+        self._probe_threads: dict[str, threading.Thread] = {}
+        self._probe_lock = threading.Lock()
         store.watch(self._on_event)
 
     # -- watch fan-in ---------------------------------------------------
@@ -136,6 +139,7 @@ class ControllerManager(_SourceReconcilersMixin):
             try:
                 key = self._queue.get_nowait()
             except queue.Empty:
+                self.join_probes()
                 return
             self.reconcile_key(*key)
 
@@ -191,6 +195,17 @@ class ControllerManager(_SourceReconcilersMixin):
         for ads in self.store.list(ResourceKind.ARENA_DEV_SESSION.value):
             if ads.status.get("phase") in ("Ready", "Blocked", "", None):
                 self.reconcile_arena_dev_session(ads)
+        # ToolRegistry reachability is a LIVE property: re-probe on the
+        # declared interval (reference toolregistry_probe.go requeue-
+        # after), so a backend that dies after apply flips the phase.
+        for tr in self.store.list(ResourceKind.TOOL_REGISTRY.value):
+            probe_cfg = tr.spec.get("probe", {}) or {}
+            if not probe_cfg.get("enabled", True):
+                continue
+            interval = float(probe_cfg.get("intervalSeconds", 60.0))
+            last = float(tr.status.get("lastProbeAt") or 0.0)
+            if time.time() - last >= interval:
+                self.reconcile_tool_registry(tr)
 
     # -- reconcilers ----------------------------------------------------
 
@@ -219,6 +234,8 @@ class ControllerManager(_SourceReconcilersMixin):
             self.reconcile_tool_policies(res)
         elif kind == ResourceKind.WORKSPACE.value:
             self.reconcile_workspace(res)
+        elif kind == ResourceKind.TOOL_REGISTRY.value:
+            self.reconcile_tool_registry(res)
         elif kind == ResourceKind.SKILL_SOURCE.value:
             self.reconcile_skill_source(res)
         elif kind == ResourceKind.PROMPT_PACK_SOURCE.value:
@@ -334,6 +351,62 @@ class ControllerManager(_SourceReconcilersMixin):
                 errs.append(f"{tp.name}: {e}")
         self.policy_evaluator = PolicyEvaluator(policies)
         return errs
+
+    def reconcile_tool_registry(self, res: Resource) -> None:
+        """Probe each tool handler's endpoint and surface per-tool status
+        + a registry phase (reference toolregistry_probe.go:53 +
+        toolregistry_types.go:661-673). The probe dials real sockets, so
+        it runs OFF the reconcile thread — network timeouts must not
+        stall every other kind's reconcile behind a ToolRegistry event.
+        drain_queue() joins in-flight probes so tests stay synchronous.
+        spec.probe.enabled=False skips probing (tools report Unknown,
+        phase Ready — declared-only)."""
+        key = f"{res.namespace}/{res.name}"
+        with self._probe_lock:
+            existing = self._probe_threads.get(key)
+            if existing is not None and existing.is_alive():
+                return  # a probe for this registry is already in flight
+            t = threading.Thread(
+                target=self._probe_tool_registry, args=(res,),
+                name=f"toolprobe-{key}", daemon=True,
+            )
+            self._probe_threads[key] = t
+        t.start()
+
+    def _probe_tool_registry(self, res: Resource) -> None:
+        from omnia_tpu.operator import toolprobe
+
+        tools = res.spec.get("tools", [])
+        probe_cfg = res.spec.get("probe", {}) or {}
+        if probe_cfg.get("enabled", True):
+            statuses = toolprobe.probe_tools(
+                tools, timeout_s=float(probe_cfg.get("timeoutSeconds", 2.0))
+            )
+            phase = toolprobe.phase_of(statuses)
+        else:
+            statuses = [{
+                "name": t.get("name", ""),
+                "handlerType": (t.get("handler") or {}).get("type", "http"),
+                "status": toolprobe.STATUS_UNKNOWN,
+            } for t in tools]
+            phase = toolprobe.PHASE_READY if tools else toolprobe.PHASE_PENDING
+        down = [t["name"] for t in statuses
+                if t["status"] == toolprobe.STATUS_UNAVAILABLE]
+        self.store.update_status(res, {
+            "phase": phase,
+            "discoveredToolsCount": len(tools),
+            "tools": statuses,
+            "lastProbeAt": time.time(),
+            "message": f"unreachable: {', '.join(down)}" if down else "",
+        })
+
+    def join_probes(self, timeout_s: float = 30.0) -> None:
+        """Wait for in-flight ToolRegistry probes (tests/drain)."""
+        with self._probe_lock:
+            threads = list(self._probe_threads.values())
+        deadline = time.monotonic() + timeout_s
+        for t in threads:
+            t.join(timeout=max(0.1, deadline - time.monotonic()))
 
     def reconcile_tool_policies(self, res: Resource) -> None:
         """Rebuild the shared evaluator from ALL ToolPolicy resources (the

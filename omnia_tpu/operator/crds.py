@@ -189,6 +189,14 @@ def _tool_registry_schema() -> dict:
         }, open_=True),
     }, required=["type"])
     return _obj({
+        # Reachability probing (reference toolregistry_types.go
+        # ProbeConfig): the controller TCP-dials each network handler and
+        # surfaces per-tool Available/Unavailable + a registry phase.
+        "probe": _obj({
+            "enabled": _BOOL,
+            "timeoutSeconds": _NUM,
+            "intervalSeconds": _NUM,
+        }),
         "tools": _arr(_obj({
             "name": _str(),
             "description": _str(),

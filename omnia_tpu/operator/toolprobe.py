@@ -1,0 +1,154 @@
+"""ToolRegistry reachability probes → per-tool status + registry phase.
+
+Counterpart of the reference's ToolRegistry probe pass (reference
+internal/controller/toolregistry_probe.go:53 fans probes out under a
+small semaphore, :79 TCP-dials each network endpoint within a timeout
+and marks Available/Unavailable, :113 leaves client://, stdio:// and
+empty endpoints unprobed; phases in api/v1alpha1/toolregistry_types.go:
+661-673 — Pending/Ready/Degraded/Failed, tools Available/Unavailable/
+Unknown).
+"""
+
+from __future__ import annotations
+
+import socket
+import threading
+import time
+import urllib.parse
+from typing import Optional
+
+PHASE_PENDING = "Pending"
+PHASE_READY = "Ready"
+PHASE_DEGRADED = "Degraded"
+PHASE_FAILED = "Failed"
+
+STATUS_AVAILABLE = "Available"
+STATUS_UNAVAILABLE = "Unavailable"
+STATUS_UNKNOWN = "Unknown"
+
+DEFAULT_TIMEOUT_S = 2.0
+MAX_CONCURRENT_PROBES = 8
+
+
+def endpoint_of(tool: dict) -> str:
+    """The probeable endpoint a tools[] CRD entry resolves to.
+    client tools → client:// (unprobed), stdio MCP → stdio:// (a
+    subprocess has no address), everything else → its network target."""
+    h = tool.get("handler", {}) or {}
+    htype = h.get("type", "http")
+    if htype == "client":
+        return "client://"
+    if htype == "http":
+        return h.get("url", "")
+    if htype == "grpc":
+        return h.get("endpoint") or h.get("grpcConfig", {}).get("endpoint", "")
+    if htype == "mcp":
+        mcp = h.get("mcpConfig") or h.get("mcp") or {}
+        if mcp.get("command") or (mcp.get("transport") or "").lower() == "stdio":
+            return "stdio://"
+        return mcp.get("endpoint", "")
+    if htype == "openapi":
+        oa = h.get("openAPIConfig", {})
+        return (h.get("baseURL") or oa.get("baseURL")
+                or h.get("specURL") or oa.get("specURL") or h.get("url", ""))
+    return ""
+
+
+def probe_address(endpoint: str) -> Optional[tuple[str, int]]:
+    """(host, port) to dial, or None when the endpoint can't be parsed
+    (a network endpoint we can't parse is a misconfiguration — the
+    caller surfaces it rather than leaving the tool unprobed)."""
+    u = urllib.parse.urlsplit(endpoint)
+    if u.scheme and u.hostname:
+        port = u.port or (443 if u.scheme in ("https", "wss") else 80)
+        return u.hostname, port
+    # bare host:port (gRPC endpoints)
+    host, _, port = endpoint.rpartition(":")
+    if host and port.isdigit():
+        return host, int(port)
+    return None
+
+
+def is_network_endpoint(endpoint: str) -> bool:
+    return bool(endpoint) and not endpoint.startswith(("client://", "stdio://"))
+
+
+def probe_one(endpoint: str, timeout_s: float = DEFAULT_TIMEOUT_S) -> tuple[str, str]:
+    """→ (status, error). TCP reachability, not protocol health: the
+    reference deliberately dials rather than speaking each protocol."""
+    if not is_network_endpoint(endpoint):
+        return STATUS_UNKNOWN, ""
+    addr = probe_address(endpoint)
+    if addr is None:
+        return STATUS_UNAVAILABLE, f"unrecognized endpoint address {endpoint!r}"
+    try:
+        with socket.create_connection(addr, timeout=timeout_s):
+            return STATUS_AVAILABLE, ""
+    except OSError as e:
+        return STATUS_UNAVAILABLE, f"probe failed: {e}"
+
+
+def probe_tools(
+    tools: list[dict],
+    timeout_s: float = DEFAULT_TIMEOUT_S,
+    max_concurrent: int = MAX_CONCURRENT_PROBES,
+) -> list[dict]:
+    """Probe every tool concurrently (bounded). Returns per-tool status
+    entries in input order."""
+    sem = threading.Semaphore(max_concurrent)
+    out: list[Optional[dict]] = [None] * len(tools)
+
+    def worker(i: int, tool: dict) -> None:
+        with sem:
+            endpoint = endpoint_of(tool)
+            status, err = probe_one(endpoint, timeout_s)
+            entry = {
+                "name": tool.get("name", ""),
+                "handlerType": (tool.get("handler") or {}).get("type", "http"),
+                "endpoint": endpoint,
+                "status": status,
+                "lastChecked": time.time(),
+            }
+            if err:
+                entry["error"] = err
+            out[i] = entry
+
+    threads = [
+        threading.Thread(target=worker, args=(i, t), daemon=True)
+        for i, t in enumerate(tools)
+    ]
+    for t in threads:
+        t.start()
+    # The connect timeout does not bound DNS resolution (getaddrinfo has
+    # no per-call deadline), so the join is the hard backstop: a probe
+    # hung on a blackholed name reports Unknown with its IDENTITY kept —
+    # the tool must not vanish from status while it is unprobeable.
+    deadline = time.time() + timeout_s * 4 + 5
+    for t in threads:
+        t.join(timeout=max(0.1, deadline - time.time()))
+    return [
+        e if e is not None else {
+            "name": tools[i].get("name", ""),
+            "handlerType": (tools[i].get("handler") or {}).get("type", "http"),
+            "endpoint": endpoint_of(tools[i]),
+            "status": STATUS_UNKNOWN,
+            "error": "probe timed out (DNS or dial hang)",
+            "lastChecked": time.time(),
+        }
+        for i, e in enumerate(out)
+    ]
+
+
+def phase_of(tool_statuses: list[dict]) -> str:
+    """Registry phase from per-tool statuses (toolregistry_types.go:
+    661-667): Ready when nothing is Unavailable, Degraded when some are,
+    Failed when ALL network tools are down, Pending when empty."""
+    if not tool_statuses:
+        return PHASE_PENDING
+    down = [t for t in tool_statuses if t["status"] == STATUS_UNAVAILABLE]
+    if not down:
+        return PHASE_READY
+    probed = [t for t in tool_statuses if t["status"] != STATUS_UNKNOWN]
+    if len(down) == len(probed):
+        return PHASE_FAILED
+    return PHASE_DEGRADED

@@ -1,0 +1,10 @@
+"""Test harness that EXECUTES the console SPA's JavaScript.
+
+The image ships no JS engine, so this package provides a minimal
+interpreter for the ES subset the SPA uses (jsmini + jslex/jsparse/
+jsvalues/jsbuiltins) plus a headless DOM/browser shim (domshim).
+tests/test_console_js.py runs the real static/index.html script
+verbatim against fixture (or live-HTTP) backends — a broken view
+loader fails CI. Test infrastructure only: nothing here ships in the
+omnia_tpu package.
+"""

@@ -1,0 +1,395 @@
+"""Headless DOM/browser shim for executing the console SPA under jsmini.
+
+Just enough browser for the loaders: a lazy element registry keyed by
+selector, createElement(+NS), appendChild/innerHTML/textContent, a
+fetch backed by fixture JSON (or a live dashboard server), localStorage,
+location, and a recording WebSocket stand-in. Tests assert on the
+rendered innerHTML/children of the elements the loaders write.
+"""
+
+from __future__ import annotations
+
+import json as _json
+from typing import Any, Callable, Optional
+
+from consoleharness.jsmini import (
+    UNDEF, JSError, JSThrow, Thenable, js_str,
+)
+
+
+class ClassList:
+    def __init__(self, el):
+        self.el = el
+
+    def js_get(self, name):
+        if name == "toggle":
+            def _toggle(cls, force=UNDEF):
+                classes = set(self.el.className.split())
+                on = (cls not in classes) if force is UNDEF else bool(force)
+                (classes.add if on else classes.discard)(cls)
+                self.el.className = " ".join(sorted(classes))
+                return on
+            return _toggle
+        if name == "add":
+            def _add(cls):
+                classes = set(self.el.className.split())
+                classes.add(cls)
+                self.el.className = " ".join(sorted(classes))
+            return _add
+        if name == "remove":
+            def _rm(cls):
+                classes = set(self.el.className.split())
+                classes.discard(cls)
+                self.el.className = " ".join(sorted(classes))
+            return _rm
+        if name == "contains":
+            return lambda cls: cls in self.el.className.split()
+        return UNDEF
+
+
+class Element:
+    def __init__(self, tag: str = "div", selector: str = ""):
+        self.tag = tag
+        self.selector = selector
+        self.children: list[Element] = []
+        self.attrs: dict[str, Any] = {}
+        self.dataset: dict[str, Any] = {}
+        self.style: dict[str, Any] = {}
+        self._props: dict[str, Any] = {
+            "innerHTML": "", "textContent": "", "value": "", "hidden": False,
+            "className": "", "scrollTop": 0, "id": selector.lstrip("#"),
+        }
+        self._listeners: dict[str, list] = {}
+
+    # -- jsmini property protocol ---------------------------------------
+
+    def js_get(self, name):
+        if name in self._props:
+            return self._props[name]
+        if name == "classList":
+            return ClassList(self)
+        if name == "dataset":
+            return self.dataset
+        if name == "style":
+            return self.style
+        if name == "children":
+            return list(self.children)
+        if name == "appendChild":
+            def _append(child):
+                self.children.append(child)
+                # select semantics: the first appended option becomes the
+                # select's value (loaders rely on `sel.value` after fill)
+                if child._props.get("value") and not self._props.get("value"):
+                    self._props["value"] = child._props["value"]
+                return child
+            return _append
+        if name == "setAttribute":
+            def _set(k, v):
+                self.attrs[js_str(k)] = v
+                return UNDEF
+            return _set
+        if name == "getAttribute":
+            return lambda k: self.attrs.get(js_str(k), None)
+        if name == "querySelector":
+            return lambda sel: self._find(sel)
+        if name == "querySelectorAll":
+            return lambda sel: self._find_all(sel)
+        if name == "addEventListener":
+            def _listen(event, fn, *a):
+                self._listeners.setdefault(js_str(event), []).append(fn)
+                return UNDEF
+            return _listen
+        if name == "removeEventListener":
+            return lambda *a: UNDEF
+        if name == "focus" or name == "blur" or name == "click" \
+                or name == "remove" or name == "preventDefault" \
+                or name == "scrollIntoView" or name == "select":
+            return lambda *a: UNDEF
+        if name.startswith("on"):
+            return self._props.get(name, None)
+        return self._props.get(name, UNDEF)
+
+    def js_set(self, name, value):
+        if name == "innerHTML":
+            self.children = []  # innerHTML assignment clears children
+            if value == "":
+                # select semantics: emptying the options resets value
+                # (the next appended option re-populates it)
+                self._props["value"] = ""
+        self._props[name] = value
+
+    # convenience for python-side assertions/drives
+    @property
+    def className(self):
+        return self._props.get("className", "")
+
+    @className.setter
+    def className(self, v):
+        self._props["className"] = v
+
+    @property
+    def innerHTML(self):
+        return self._props.get("innerHTML", "")
+
+    @property
+    def value(self):
+        return self._props.get("value", "")
+
+    def set_value(self, v):
+        self._props["value"] = v
+
+    def _find(self, sel):
+        hits = self._find_all(sel)
+        if hits:
+            return hits[0]
+        # Loaders assign handlers to elements they just wrote via
+        # innerHTML (`tr.querySelector("button").onclick = ...`). The
+        # shim stores innerHTML as a string, so materialize a synthetic
+        # child when the markup plainly contains the tag.
+        tag = sel.strip().split(".")[0].split("[")[0]
+        if tag and f"<{tag}" in js_str(self._props.get("innerHTML", "")):
+            child = Element(tag)
+            self.children.append(child)
+            return child
+        return None
+
+    def _find_all(self, sel):
+        out = []
+        for c in self.children:
+            if _matches(c, sel):
+                out.append(c)
+            out.extend(c._find_all(sel))
+        return out
+
+    def fire(self, event, payload=None):
+        """Python-side event dispatch (tests drive onmessage etc.)."""
+        handler = self._props.get(f"on{event}")
+        handlers = list(self._listeners.get(event, []))
+        if handler:
+            handlers.insert(0, handler)
+        for h in handlers:
+            from consoleharness.jsmini import _call_js
+
+            _call_js(h, [payload if payload is not None else Event(event)])
+
+    def rendered_text(self) -> str:
+        """All content under this element: innerHTML + child text."""
+        parts = [js_str(self._props.get("innerHTML", "")),
+                 js_str(self._props.get("textContent", ""))]
+        parts.extend(c.rendered_text() for c in self.children)
+        return "\n".join(p for p in parts if p)
+
+    def __repr__(self):
+        return f"<Element {self.tag} {self.selector!r}>"
+
+
+def _matches(el: Element, sel: str) -> bool:
+    sel = sel.strip()
+    if sel.startswith("#"):
+        return el._props.get("id") == sel[1:]
+    if sel.startswith("."):
+        return sel[1:] in el.className.split()
+    return el.tag == sel.split("[")[0].split(".")[0]
+
+
+class Event:
+    def __init__(self, kind="event", **kw):
+        self.type = kind
+        for k, v in kw.items():
+            setattr(self, k, v)
+
+    def js_get(self, name):
+        if name == "preventDefault" or name == "stopPropagation":
+            return lambda *a: UNDEF
+        return getattr(self, name, UNDEF)
+
+
+class Document:
+    """Lazy element registry: querySelector(sel) returns a singleton per
+    selector — the page's static skeleton is implied, not parsed."""
+
+    def __init__(self):
+        self.by_selector: dict[str, Element] = {}
+        self.created: list[Element] = []
+
+    def element(self, sel: str) -> Element:
+        el = self.by_selector.get(sel)
+        if el is None:
+            tag = "table" if "table" in sel else "div"
+            el = Element(tag, sel)
+            self.by_selector[sel] = el
+        return el
+
+    def js_get(self, name):
+        if name == "querySelector":
+            return lambda sel: self.element(js_str(sel))
+        if name == "querySelectorAll":
+            return lambda sel: []
+        if name == "createElement":
+            def _create(tag):
+                el = Element(js_str(tag))
+                self.created.append(el)
+                return el
+            return _create
+        if name == "createElementNS":
+            def _create_ns(ns, tag):
+                el = Element(js_str(tag))
+                self.created.append(el)
+                return el
+            return _create_ns
+        if name == "addEventListener":
+            return lambda *a: UNDEF
+        if name == "body":
+            return self.element("body")
+        return UNDEF
+
+
+class Storage:
+    def __init__(self):
+        self.data: dict[str, str] = {}
+
+    def js_get(self, name):
+        if name == "getItem":
+            return lambda k: self.data.get(js_str(k), None)
+        if name == "setItem":
+            def _set(k, v):
+                self.data[js_str(k)] = js_str(v)
+                return UNDEF
+            return _set
+        if name == "removeItem":
+            return lambda k: self.data.pop(js_str(k), None) and UNDEF
+        return UNDEF
+
+
+class Response:
+    def __init__(self, status: int, body: Any):
+        self.status = status
+        self.ok = 200 <= status < 300
+        self._body = body
+
+    def js_get(self, name):
+        if name == "ok":
+            return self.ok
+        if name == "status":
+            return self.status
+        if name == "json":
+            def _json_m():
+                if isinstance(self._body, (dict, list)):
+                    return Thenable(self._body)
+                try:
+                    return Thenable(_json.loads(self._body))
+                except Exception as e:
+                    return Thenable(error=JSError(f"bad json: {e}"))
+            return _json_m
+        if name == "text":
+            return lambda: Thenable(js_str(self._body))
+        return UNDEF
+
+
+class FixtureFetch:
+    """fetch() over a {path: response} table. Values may be dicts
+    (200 JSON), (status, dict) tuples, or callables(path, opts)."""
+
+    def __init__(self, fixtures: dict):
+        self.fixtures = fixtures
+        self.calls: list[tuple[str, Any]] = []
+
+    def __call__(self, path, opts=UNDEF):
+        path = js_str(path)
+        self.calls.append((path, opts))
+        hit = self.fixtures.get(path)
+        if hit is None:
+            base = path.split("?")[0]
+            hit = self.fixtures.get(base)
+        if hit is None:
+            for key, v in self.fixtures.items():
+                if key.endswith("*") and path.startswith(key[:-1]):
+                    hit = v
+                    break
+        if hit is None:
+            return Thenable(Response(404, {"error": f"no fixture for {path}"}))
+        if callable(hit) and not isinstance(hit, (dict, list)):
+            hit = hit(path, opts)
+        if isinstance(hit, tuple):
+            return Thenable(Response(hit[0], hit[1]))
+        return Thenable(Response(200, hit))
+
+
+class FakeWebSocket:
+    """Recording WebSocket: captures the URL + sent frames; tests fire
+    open/message/close via the element-style handlers."""
+
+    instances: list["FakeWebSocket"] = []
+
+    def __init__(self, url):
+        self.url = js_str(url)
+        self.sent: list[str] = []
+        self.readyState = 1
+        self._props: dict[str, Any] = {}
+        self._listeners: dict[str, list] = {}
+        FakeWebSocket.instances.append(self)
+
+    def js_get(self, name):
+        if name == "send":
+            def _send(data):
+                self.sent.append(js_str(data))
+                return UNDEF
+            return _send
+        if name == "close":
+            def _close(*a):
+                self.readyState = 3
+                return UNDEF
+            return _close
+        if name == "addEventListener":
+            def _listen(ev, fn, *a):
+                self._listeners.setdefault(js_str(ev), []).append(fn)
+                return UNDEF
+            return _listen
+        if name == "readyState":
+            return self.readyState
+        if name == "url":
+            return self.url
+        return self._props.get(name, UNDEF)
+
+    def js_set(self, name, value):
+        self._props[name] = value
+
+    def fire(self, event, payload=None):
+        from consoleharness.jsmini import _call_js
+
+        handlers = list(self._listeners.get(event, []))
+        h = self._props.get(f"on{event}")
+        if h:
+            handlers.append(h)
+        for fn in handlers:
+            _call_js(fn, [payload if payload is not None else Event(event)])
+
+
+class Location:
+    hostname = "127.0.0.1"
+    host = "127.0.0.1"
+    protocol = "http:"
+
+    def js_get(self, name):
+        if name == "reload":
+            return lambda *a: UNDEF
+        return getattr(self, name, UNDEF)
+
+
+def make_browser_globals(fetch: Optional[Callable] = None,
+                         fixtures: Optional[dict] = None) -> dict:
+    """Globals for running the SPA script: document/fetch/localStorage/
+    location/WebSocket. Returns the dict; the Document rides under
+    '__document__' for python-side assertions too."""
+    doc = Document()
+    fetch_impl = fetch or FixtureFetch(fixtures or {})
+    return {
+        "document": doc,
+        "fetch": fetch_impl,
+        "localStorage": Storage(),
+        "location": Location(),
+        "WebSocket": FakeWebSocket,
+        "window": doc,
+        "__document__": doc,
+        "__fetch__": fetch_impl,
+    }

@@ -1,0 +1,397 @@
+"""Builtin methods, host types, and standard globals for jsmini."""
+
+from __future__ import annotations
+
+import json as _json
+import re
+from typing import Any, Optional
+
+from consoleharness.jsvalues import (
+    UNDEF, JSError, JSThrow, Thenable, _call_js, js_num, js_str, js_truthy,
+    js_eq_strict, unwrap,
+)
+
+# ---------------------------------------------------------------------------
+# builtin method tables
+
+
+def _dict_method(obj: dict, name: str):
+    if name == "hasOwnProperty":
+        return lambda k: js_str(k) in obj
+    if name == "toString":
+        return lambda: "[object Object]"
+    return UNDEF
+
+
+def _list_method(obj: list, name: str):
+    if name == "map":
+        return lambda fn: [_call_js(fn, [v, i, obj]) for i, v in enumerate(obj)]
+    if name == "filter":
+        return lambda fn: [v for i, v in enumerate(obj)
+                           if js_truthy(_call_js(fn, [v, i, obj]))]
+    if name == "forEach":
+        def _each(fn):
+            for i, v in enumerate(obj):
+                _call_js(fn, [v, i, obj])
+            return UNDEF
+        return _each
+    if name == "join":
+        return lambda sep=",": js_str(sep).join(
+            "" if v is UNDEF or v is None else js_str(v) for v in obj)
+    if name == "push":
+        def _push(*vals):
+            obj.extend(vals)
+            return len(obj)
+        return _push
+    if name == "pop":
+        return lambda: obj.pop() if obj else UNDEF
+    if name == "indexOf":
+        def _idx(v):
+            for i, x in enumerate(obj):
+                if js_eq_strict(x, v):
+                    return i
+            return -1
+        return _idx
+    if name == "includes":
+        return lambda v: any(js_eq_strict(x, v) for x in obj)
+    if name == "find":
+        def _find(fn):
+            for i, v in enumerate(obj):
+                if js_truthy(_call_js(fn, [v, i, obj])):
+                    return v
+            return UNDEF
+        return _find
+    if name == "some":
+        return lambda fn: any(js_truthy(_call_js(fn, [v, i, obj]))
+                              for i, v in enumerate(obj))
+    if name == "every":
+        return lambda fn: all(js_truthy(_call_js(fn, [v, i, obj]))
+                              for i, v in enumerate(obj))
+    if name == "slice":
+        def _slice(start=0, end=None):
+            s = int(js_num(start))
+            e = len(obj) if end is None else int(js_num(end))
+            return obj[s:e]
+        return _slice
+    if name == "concat":
+        return lambda *others: obj + [x for o in others
+                                      for x in (o if isinstance(o, list) else [o])]
+    if name == "flat":
+        return lambda depth=1: [x for v in obj
+                                for x in (v if isinstance(v, list) else [v])]
+    if name == "sort":
+        def _sort(cmp=None):
+            import functools
+
+            if cmp is None:
+                obj.sort(key=js_str)
+            else:
+                obj.sort(key=functools.cmp_to_key(
+                    lambda a, b: (lambda r: -1 if r < 0 else (1 if r > 0 else 0))(
+                        js_num(_call_js(cmp, [a, b])))))
+            return obj
+        return _sort
+    if name == "reduce":
+        def _reduce(fn, *init):
+            acc_set = bool(init)
+            acc = init[0] if init else None
+            for i, v in enumerate(obj):
+                if not acc_set:
+                    acc, acc_set = v, True
+                else:
+                    acc = _call_js(fn, [acc, v, i, obj])
+            return acc
+        return _reduce
+    if name == "reverse":
+        def _rev():
+            obj.reverse()
+            return obj
+        return _rev
+    if name == "keys":
+        return lambda: list(range(len(obj)))
+    if name == "entries":
+        return lambda: [[i, v] for i, v in enumerate(obj)]
+    if name == "flatMap":
+        return lambda fn: [x for i, v in enumerate(obj)
+                           for x in _as_list(_call_js(fn, [v, i, obj]))]
+    return UNDEF
+
+
+def _as_list(v):
+    return v if isinstance(v, list) else [v]
+
+
+def _str_method(s: str, name: str):
+    if name == "replace":
+        def _replace(pat, repl):
+            def do(m_text):
+                if isinstance(repl, str):
+                    return repl
+                return js_str(_call_js(repl, [m_text]))
+            if isinstance(pat, JSRegExp):
+                return pat.py.sub(lambda m: do(m.group(0)), s,
+                                  count=0 if "g" in pat.flags else 1)
+            return s.replace(js_str(pat), js_str(repl) if isinstance(repl, str)
+                             else do(js_str(pat)), 1)
+        return _replace
+    if name == "replaceAll":
+        return lambda pat, repl: s.replace(js_str(pat), js_str(repl))
+    if name == "trim":
+        return s.strip
+    if name == "slice":
+        def _slice(start=0, end=None):
+            st = int(js_num(start))
+            en = len(s) if end is None else int(js_num(end))
+            return s[st:en]
+        return _slice
+    if name == "split":
+        def _split(sep=None, limit=None):
+            parts = list(s) if sep == "" else s.split(js_str(sep))
+            return parts[:int(js_num(limit))] if limit is not None else parts
+        return _split
+    if name == "includes":
+        return lambda sub: js_str(sub) in s
+    if name == "startsWith":
+        return lambda sub: s.startswith(js_str(sub))
+    if name == "endsWith":
+        return lambda sub: s.endswith(js_str(sub))
+    if name == "indexOf":
+        return lambda sub: s.find(js_str(sub))
+    if name == "toUpperCase":
+        return s.upper
+    if name == "toLowerCase":
+        return s.lower
+    if name == "charAt":
+        return lambda i=0: s[int(js_num(i))] if 0 <= int(js_num(i)) < len(s) else ""
+    if name == "padStart":
+        return lambda width, fill=" ": s.rjust(int(js_num(width)), js_str(fill)[0])
+    if name == "padEnd":
+        return lambda width, fill=" ": s.ljust(int(js_num(width)), js_str(fill)[0])
+    if name == "repeat":
+        return lambda k: s * int(js_num(k))
+    if name == "toString":
+        return lambda: s
+    if name == "match":
+        def _match(pat):
+            m = pat.py.search(s) if isinstance(pat, JSRegExp) else re.search(js_str(pat), s)
+            return list(m.groups()) and [m.group(0), *m.groups()] or [m.group(0)] if m else None
+        return _match
+    if name == "localeCompare":
+        return lambda other: -1 if s < js_str(other) else (1 if s > js_str(other) else 0)
+    return UNDEF
+
+
+def _num_method(x, name: str):
+    if name == "toFixed":
+        return lambda digits=0: f"{float(x):.{int(js_num(digits))}f}"
+    if name == "toLocaleString":
+        return lambda *a: f"{x:,}" if isinstance(x, int) or x == int(x) else str(x)
+    if name == "toString":
+        return lambda *a: js_str(x)
+    return UNDEF
+
+
+# ---------------------------------------------------------------------------
+# host types
+
+
+class JSRegExp:
+    def __init__(self, pattern, flags=""):
+        self.source = pattern
+        self.flags = flags
+        pyflags = re.IGNORECASE if "i" in flags else 0
+        self.py = re.compile(pattern, pyflags)
+
+    def test(self, s):
+        return self.py.search(js_str(s)) is not None
+
+
+class JSMap:
+    def __init__(self, entries=None):
+        self.data = {}
+        for k, v in entries or []:
+            self.data[_mkey(k)] = v
+
+    def js_get(self, name):
+        if name == "get":
+            return lambda k: self.data.get(_mkey(k), UNDEF)
+        if name == "set":
+            def _set(k, v):
+                self.data[_mkey(k)] = v
+                return self
+            return _set
+        if name == "has":
+            return lambda k: _mkey(k) in self.data
+        if name == "delete":
+            return lambda k: self.data.pop(_mkey(k), UNDEF) is not UNDEF
+        if name == "keys":
+            return lambda: list(self.data.keys())
+        if name == "values":
+            return lambda: list(self.data.values())
+        if name == "entries":
+            return lambda: [[k, v] for k, v in self.data.items()]
+        if name == "forEach":
+            def _each(fn):
+                for k, v in self.data.items():
+                    _call_js(fn, [v, k, self])
+            return _each
+        if name == "size":
+            return len(self.data)
+        return UNDEF
+
+    def __iter__(self):
+        return iter([[k, v] for k, v in self.data.items()])
+
+
+def _mkey(k):
+    return k  # numbers/strings hash natively; good enough for the subset
+
+
+class JSSet:
+    def __init__(self, items=None):
+        self.data = list(dict.fromkeys(items or []))
+
+    def js_get(self, name):
+        if name == "add":
+            def _add(v):
+                if v not in self.data:
+                    self.data.append(v)
+                return self
+            return _add
+        if name == "has":
+            return lambda v: v in self.data
+        if name == "delete":
+            def _del(v):
+                if v in self.data:
+                    self.data.remove(v)
+                    return True
+                return False
+            return _del
+        if name == "size":
+            return len(self.data)
+        return UNDEF
+
+    def __iter__(self):
+        return iter(self.data)
+
+
+class JSDate:
+    def __init__(self, ms=None):
+        import datetime
+
+        if ms is None:
+            self.dt = datetime.datetime.now()
+        else:
+            self.dt = datetime.datetime.fromtimestamp(js_num(ms) / 1000.0)
+
+    def js_get(self, name):
+        if name == "toLocaleString":
+            return lambda *a: self.dt.strftime("%Y-%m-%d %H:%M:%S")
+        if name == "toISOString":
+            return lambda: self.dt.strftime("%Y-%m-%dT%H:%M:%S.000Z")
+        if name == "getTime":
+            return lambda: self.dt.timestamp() * 1000.0
+        if name == "toLocaleDateString":
+            return lambda *a: self.dt.strftime("%Y-%m-%d")
+        if name == "toLocaleTimeString":
+            return lambda *a: self.dt.strftime("%H:%M:%S")
+        return UNDEF
+
+
+def JSErrorCtor(message=""):
+    return JSError(js_str(message))
+
+
+# ---------------------------------------------------------------------------
+# standard globals
+
+
+def _json_default(v):
+    if v is UNDEF:
+        return None
+    if isinstance(v, JSError):
+        return f"Error: {v.message}"
+    raise TypeError(str(type(v)))
+
+
+def make_std_globals() -> dict:
+    """The JS standard-library surface the SPA uses."""
+
+    def _parse_json(text, *a):
+        try:
+            return _json.loads(js_str(text))
+        except Exception as e:
+            raise JSThrow(JSError(f"JSON.parse: {e}")) from e
+
+    def _stringify(v, *a):
+        def clean(x):
+            if x is UNDEF:
+                return None
+            if isinstance(x, dict):
+                return {k: clean(v2) for k, v2 in x.items() if v2 is not UNDEF}
+            if isinstance(x, list):
+                return [clean(v2) for v2 in x]
+            if isinstance(x, float) and x == int(x):
+                return int(x)
+            return x
+        return _json.dumps(clean(v))
+
+    import urllib.parse
+
+    return {
+        "JSON": {"parse": _parse_json, "stringify": _stringify},
+        "Object": {
+            "entries": lambda o: [[k, v] for k, v in o.items()]
+            if isinstance(o, dict) else [],
+            "keys": lambda o: list(o.keys()) if isinstance(o, dict) else [],
+            "values": lambda o: list(o.values()) if isinstance(o, dict) else [],
+            "assign": lambda t, *srcs: (
+                [t.update(s) for s in srcs if isinstance(s, dict)] and t or t),
+            "fromEntries": lambda pairs: {js_str(k): v for k, v in pairs},
+        },
+        "Array": {
+            "isArray": lambda v: isinstance(v, list),
+            "from": lambda v, fn=None: [
+                _call_js(fn, [x, i]) if fn else x
+                for i, x in enumerate(v if isinstance(v, list) else list(v))
+            ],
+        },
+        "Math": {
+            "max": lambda *a: max(js_num(x) for x in a),
+            "min": lambda *a: min(js_num(x) for x in a),
+            "round": lambda x: float(round(js_num(x))),
+            "floor": lambda x: float(int(js_num(x) // 1)),
+            "ceil": lambda x: float(-(-js_num(x) // 1)),
+            "abs": lambda x: abs(js_num(x)),
+            "random": lambda: 0.42,
+        },
+        "Promise": {
+            "all": lambda lst: Thenable([unwrap(v) for v in lst]),
+            "resolve": lambda v=UNDEF: Thenable(unwrap(v) if isinstance(v, Thenable) else v),
+            "reject": lambda err: Thenable(error=err),
+        },
+        "String": lambda v=UNDEF: js_str(v) if v is not UNDEF else "",
+        "Number": js_num,
+        "Boolean": js_truthy,
+        "parseInt": lambda s, base=10: int(js_str(s), int(js_num(base))),
+        "parseFloat": lambda s: js_num(s),
+        "isNaN": lambda v: js_num(v) != js_num(v),
+        "encodeURIComponent": lambda s: urllib.parse.quote(js_str(s), safe=""),
+        "decodeURIComponent": lambda s: urllib.parse.unquote(js_str(s)),
+        "Error": JSErrorCtor,
+        "Map": JSMap,
+        "Set": JSSet,
+        "Date": JSDate,
+        "RegExp": JSRegExp,
+        "NaN": float("nan"),
+        "Infinity": float("inf"),
+        "console": {"log": lambda *a: UNDEF, "error": lambda *a: UNDEF,
+                    "warn": lambda *a: UNDEF},
+        # setTimeout runs the callback IMMEDIATELY: loaders debounce
+        # through it, and under the harness a deferred timer would simply
+        # never fire. setInterval stays inert (it would loop forever).
+        "setTimeout": lambda fn, ms=0, *a: (_call_js(fn, list(a)), 0)[1],
+        "clearTimeout": lambda h=0: UNDEF,
+        "setInterval": lambda fn, ms=0, *a: 0,
+        "clearInterval": lambda h=0: UNDEF,
+    }

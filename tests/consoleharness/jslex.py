@@ -1,0 +1,175 @@
+"""Lexer for the console's mini-JS interpreter (see jsmini.py)."""
+
+from __future__ import annotations
+
+import re
+
+# ---------------------------------------------------------------------------
+# lexer
+
+_PUNCT = [
+    "...", "===", "!==", "**=", "?.(", "=>", "==", "!=", "<=", ">=", "&&",
+    "||", "??", "?.", "+=", "-=", "*=", "/=", "%=", "++", "--", "{", "}",
+    "(", ")", "[", "]", ";", ",", "<", ">", "+", "-", "*", "/", "%", "=",
+    "!", "?", ":", ".", "&", "|", "^", "~",
+]
+_KEYWORDS = {
+    "const", "let", "var", "function", "return", "if", "else", "for", "of",
+    "in", "while", "do", "new", "typeof", "instanceof", "try", "catch",
+    "finally", "throw", "true", "false", "null", "undefined", "async",
+    "await", "break", "continue", "delete", "void", "switch", "case",
+    "default",
+}
+_ID_RE = re.compile(r"[A-Za-z_$][A-Za-z0-9_$]*")
+_NUM_RE = re.compile(r"(?:0[xX][0-9a-fA-F]+|\d+\.?\d*(?:[eE][+-]?\d+)?|\.\d+(?:[eE][+-]?\d+)?)")
+
+
+class Tok:
+    __slots__ = ("kind", "val", "pos")
+
+    def __init__(self, kind, val, pos):
+        self.kind = kind      # id, kw, num, str, tpl, regex, punct, eof
+        self.val = val
+        self.pos = pos
+
+    def __repr__(self):
+        return f"{self.kind}:{self.val!r}"
+
+
+def tokenize(src: str) -> list[Tok]:
+    toks: list[Tok] = []
+    i, n = 0, len(src)
+
+    def prev_allows_regex():
+        for t in reversed(toks):
+            if t.kind == "punct":
+                return t.val not in (")", "]")
+            return t.kind in ("kw",) and t.val not in ("true", "false", "null", "undefined")
+        return True
+
+    while i < n:
+        c = src[i]
+        if c in " \t\r\n":
+            i += 1
+            continue
+        if src.startswith("//", i):
+            j = src.find("\n", i)
+            i = n if j < 0 else j
+            continue
+        if src.startswith("/*", i):
+            j = src.find("*/", i + 2)
+            i = n if j < 0 else j + 2
+            continue
+        if c in "'\"":
+            j = i + 1
+            buf = []
+            while j < n and src[j] != c:
+                if src[j] == "\\":
+                    buf.append(_unescape(src[j + 1]))
+                    j += 2
+                else:
+                    buf.append(src[j])
+                    j += 1
+            toks.append(Tok("str", "".join(buf), i))
+            i = j + 1
+            continue
+        if c == "`":
+            parts, j = _lex_template(src, i)
+            toks.append(Tok("tpl", parts, i))
+            i = j
+            continue
+        if c == "/" and prev_allows_regex():
+            j = i + 1
+            in_class = False
+            while j < n:
+                if src[j] == "\\":
+                    j += 2
+                    continue
+                if src[j] == "[":
+                    in_class = True
+                elif src[j] == "]":
+                    in_class = False
+                elif src[j] == "/" and not in_class:
+                    break
+                j += 1
+            pattern = src[i + 1:j]
+            k = j + 1
+            while k < n and src[k].isalpha():
+                k += 1
+            toks.append(Tok("regex", (pattern, src[j + 1:k]), i))
+            i = k
+            continue
+        m = _NUM_RE.match(src, i)
+        if m and (c.isdigit() or (c == "." and i + 1 < n and src[i + 1].isdigit())):
+            text = m.group(0)
+            val = int(text, 16) if text[:2] in ("0x", "0X") else (
+                int(text) if re.fullmatch(r"\d+", text) else float(text))
+            toks.append(Tok("num", val, i))
+            i = m.end()
+            continue
+        m = _ID_RE.match(src, i)
+        if m:
+            word = m.group(0)
+            toks.append(Tok("kw" if word in _KEYWORDS else "id", word, i))
+            i = m.end()
+            continue
+        for p in _PUNCT:
+            if src.startswith(p, i):
+                toks.append(Tok("punct", p, i))
+                i += len(p)
+                break
+        else:
+            raise SyntaxError(f"jsmini: unexpected char {c!r} at {i}: "
+                              f"{src[max(0, i-40):i+40]!r}")
+    toks.append(Tok("eof", None, n))
+    return toks
+
+
+def _unescape(c: str) -> str:
+    return {"n": "\n", "t": "\t", "r": "\r", "0": "\0", "b": "\b"}.get(c, c)
+
+
+def _lex_template(src: str, start: int):
+    """Returns ([("str", s) | ("expr", source)], end_index). start at `"""
+    parts = []
+    buf = []
+    i = start + 1
+    n = len(src)
+    while i < n:
+        c = src[i]
+        if c == "\\":
+            buf.append(_unescape(src[i + 1]))
+            i += 2
+            continue
+        if c == "`":
+            if buf:
+                parts.append(("str", "".join(buf)))
+            return parts, i + 1
+        if c == "$" and i + 1 < n and src[i + 1] == "{":
+            if buf:
+                parts.append(("str", "".join(buf)))
+                buf = []
+            depth = 1
+            j = i + 2
+            while j < n and depth:
+                if src[j] == "{":
+                    depth += 1
+                elif src[j] == "}":
+                    depth -= 1
+                elif src[j] == "`":
+                    _, j2 = _lex_template(src, j)
+                    j = j2 - 1
+                elif src[j] in "'\"":
+                    q = src[j]
+                    j += 1
+                    while j < n and src[j] != q:
+                        j += 2 if src[j] == "\\" else 1
+                j += 1
+            parts.append(("expr", src[i + 2:j - 1]))
+            i = j
+            continue
+        buf.append(c)
+        i += 1
+    raise SyntaxError("jsmini: unterminated template literal")
+
+

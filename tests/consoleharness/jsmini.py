@@ -1,0 +1,496 @@
+"""Minimal JavaScript interpreter for the console's data-binding subset.
+
+The image ships no JS engine, but the console's view loaders must
+EXECUTE in CI (a render bug in a loader must fail a test, not ship
+green). This interpreter covers the ES subset the SPA uses — arrow
+functions, destructuring, template literals, for-of, optional chaining,
+spread, Map/Set, regex replace, async/await (synchronous thenables) —
+and nothing more. It is intentionally small and strict: an unsupported
+construct raises at parse time, which keeps the SPA inside an
+executable subset by construction.
+
+Reference analog: the reference dashboard's components are exercised by
+its jest/react test suite; here the loaders run under this interpreter
+against fixture JSON (tests/test_console_js.py).
+"""
+
+from __future__ import annotations
+
+import json as _json
+import re
+from typing import Optional
+
+from consoleharness.jsbuiltins import (   # noqa: F401 — public surface
+    JSDate, JSErrorCtor, JSMap, JSRegExp, JSSet, _dict_method, _list_method,
+    _num_method, _str_method, make_std_globals,
+)
+from consoleharness.jsparse import Parser
+from consoleharness.jslex import tokenize
+from consoleharness.jsvalues import (      # noqa: F401 — public surface
+    NULL, UNDEF, Env, JSError, JSFunction, JSThrow, Thenable, _Break,
+    _Continue, _Return, _call_js, js_eq_loose, js_eq_strict, js_num, js_str,
+    js_truthy, unwrap,
+)
+
+# ---------------------------------------------------------------------------
+# interpreter
+
+
+class Interp:
+    def __init__(self, global_vars: Optional[dict] = None):
+        self.globals = Env()
+        for k, v in (global_vars or {}).items():
+            self.globals.declare(k, v)
+
+    # -- public ----------------------------------------------------------
+
+    def run(self, src: str, env: Optional[Env] = None):
+        ast = Parser(tokenize(src), src).parse_program()
+        env = env or self.globals
+        self.exec_block(ast[1], env, new_scope=False)
+
+    # -- binding ----------------------------------------------------------
+
+    def bind_pattern(self, env: Env, pat, value):
+        kind = pat[0]
+        if kind == "pat_id":
+            env.declare(pat[1], value)
+        elif kind == "pat_obj":
+            for key, alias, default in pat[1]:
+                v = self.get_prop(value, key)
+                if v is UNDEF and default is not None:
+                    v = self.eval(default, env)
+                env.declare(alias, v)
+        elif kind == "pat_arr":
+            seq = list(self.iterate(value))
+            for i, sub in enumerate(pat[1]):
+                if sub is None:
+                    continue
+                self.bind_pattern(env, sub, seq[i] if i < len(seq) else UNDEF)
+        else:
+            raise JSThrow(JSError(f"bad pattern {kind}"))
+
+    def iterate(self, value):
+        if isinstance(value, list):
+            return list(value)
+        if isinstance(value, str):
+            return list(value)
+        if isinstance(value, dict):
+            raise JSThrow(JSError("object is not iterable"))
+        if isinstance(value, JSMap):
+            return [[k, v] for k, v in value.data.items()]
+        if isinstance(value, JSSet):
+            return list(value.data)
+        if hasattr(value, "__iter__"):
+            return list(value)
+        raise JSThrow(JSError(f"{js_str(value)} is not iterable"))
+
+    # -- statements --------------------------------------------------------
+
+    def exec_block(self, stmts, env: Env, new_scope=True):
+        scope = Env(env) if new_scope else env
+        if isinstance(stmts, tuple):  # single stmt or ('block', [...])
+            stmts = stmts[1] if stmts[0] == "block" else [stmts]
+        # hoist function declarations
+        for s in stmts:
+            if s[0] == "funcdecl":
+                _, name, params, body, is_async = s
+                scope.declare(name, JSFunction(params, body, scope, self,
+                                               is_async=is_async, name=name))
+        for s in stmts:
+            self.exec_stmt(s, scope)
+
+    def exec_stmt(self, s, env: Env):
+        kind = s[0]
+        if kind == "expr":
+            self.eval(s[1], env)
+        elif kind == "var":
+            for pat, init in s[2]:
+                value = self.eval(init, env) if init is not None else UNDEF
+                self.bind_pattern(env, pat, value)
+        elif kind == "block":
+            self.exec_block(s[1], env)
+        elif kind == "if":
+            if js_truthy(self.eval(s[1], env)):
+                self.exec_block(s[2], env)
+            elif s[3] is not None:
+                self.exec_block(s[3], env)
+        elif kind == "forof":
+            for item in self.iterate(self.eval(s[3], env)):
+                scope = Env(env)
+                self.bind_pattern(scope, s[2], item)
+                try:
+                    self.exec_block(s[4], scope)
+                except _Break:
+                    break
+                except _Continue:
+                    continue
+        elif kind == "forin":
+            obj = self.eval(s[3], env)
+            keys = list(obj.keys()) if isinstance(obj, dict) else \
+                [str(i) for i in range(len(obj))] if isinstance(obj, list) else []
+            for k in keys:
+                scope = Env(env)
+                self.bind_pattern(scope, s[2], k)
+                try:
+                    self.exec_block(s[4], scope)
+                except _Break:
+                    break
+                except _Continue:
+                    continue
+        elif kind == "for":
+            scope = Env(env)
+            if s[1] is not None:
+                self.exec_stmt(s[1], scope)
+            while s[2] is None or js_truthy(self.eval(s[2], scope)):
+                try:
+                    self.exec_block(s[4], scope)
+                except _Break:
+                    break
+                except _Continue:
+                    pass
+                if s[3] is not None:
+                    self.eval(s[3], scope)
+        elif kind == "while":
+            while js_truthy(self.eval(s[1], env)):
+                try:
+                    self.exec_block(s[2], env)
+                except _Break:
+                    break
+                except _Continue:
+                    continue
+        elif kind == "return":
+            raise _Return(self.eval(s[1], env))
+        elif kind == "throw":
+            raise JSThrow(self.eval(s[1], env))
+        elif kind == "try":
+            try:
+                self.exec_block(s[1], env)
+            except JSThrow as t:
+                if s[3] is not None:
+                    scope = Env(env)
+                    if s[2] is not None:
+                        self.bind_pattern(scope, s[2], t.value)
+                    self.exec_block(s[3], scope)
+                elif s[4] is None:
+                    raise
+            finally:
+                if s[4] is not None:
+                    self.exec_block(s[4], env)
+        elif kind == "funcdecl":
+            pass  # hoisted
+        elif kind == "break":
+            raise _Break()
+        elif kind == "continue":
+            raise _Continue()
+        elif kind == "switch":
+            disc = self.eval(s[1], env)
+            matched = False
+            try:
+                for test, body in s[2]:
+                    if matched or js_eq_strict(disc, self.eval(test, env)):
+                        matched = True
+                        for st in body:
+                            self.exec_stmt(st, env)
+                if not matched and s[3] is not None:
+                    for st in s[3]:
+                        self.exec_stmt(st, env)
+            except _Break:
+                pass
+        elif kind == "empty":
+            pass
+        else:
+            raise JSThrow(JSError(f"unknown stmt {kind}"))
+
+    # -- expressions -------------------------------------------------------
+
+    def eval(self, e, env: Env):
+        kind = e[0]
+        if kind == "num" or kind == "str" or kind == "bool":
+            return e[1]
+        if kind == "null":
+            return None
+        if kind == "undef":
+            return UNDEF
+        if kind == "ident":
+            return env.get(e[1])
+        if kind == "tpl":
+            out = []
+            for pk, pv in e[1]:
+                out.append(pv if pk == "str" else js_str(self.eval(pv, env)))
+            return "".join(out)
+        if kind == "regex":
+            return JSRegExp(e[1], e[2])
+        if kind == "array":
+            out = []
+            for el in e[1]:
+                if el[0] == "spread":
+                    out.extend(self.iterate(self.eval(el[1], env)))
+                else:
+                    out.append(self.eval(el, env))
+            return out
+        if kind == "object":
+            out = {}
+            for prop in e[1]:
+                if prop[0] == "spread":
+                    v = self.eval(prop[1], env)
+                    if isinstance(v, dict):
+                        out.update(v)
+                elif prop[0] == "computed":
+                    out[js_str(self.eval(prop[1], env))] = self.eval(prop[2], env)
+                else:
+                    out[prop[1]] = self.eval(prop[2], env)
+            return out
+        if kind == "get":
+            obj = self.eval(e[1], env)
+            if e[3] and (obj is UNDEF or obj is None):
+                return UNDEF
+            return self.get_prop(obj, e[2])
+        if kind == "getidx":
+            obj = self.eval(e[1], env)
+            idx = self.eval(e[2], env)
+            if isinstance(obj, list) and isinstance(idx, (int, float)) \
+                    and not isinstance(idx, bool):
+                i = int(idx)
+                return obj[i] if 0 <= i < len(obj) else UNDEF
+            return self.get_prop(obj, js_str(idx))
+        if kind == "call":
+            return self.eval_call(e, env)
+        if kind == "new":
+            callee = self.eval(e[1], env)
+            args = [self.eval(a, env) for a in e[2]]
+            return self.construct(callee, args)
+        if kind == "assign":
+            return self.eval_assign(e, env)
+        if kind == "update":
+            _, op, target, prefix = e
+            old = js_num(self.eval(target, env))
+            new = old + (1 if op == "++" else -1)
+            self.assign_to(target, new, env)
+            return new if prefix else old
+        if kind == "bin":
+            return self.eval_bin(e[1], self.eval(e[2], env), self.eval(e[3], env))
+        if kind == "logic":
+            left = self.eval(e[2], env)
+            if e[1] == "&&":
+                return self.eval(e[3], env) if js_truthy(left) else left
+            if e[1] == "||":
+                return left if js_truthy(left) else self.eval(e[3], env)
+            # ??
+            return self.eval(e[3], env) if left is UNDEF or left is None else left
+        if kind == "un":
+            if e[1] == "typeof":
+                try:
+                    v = self.eval(e[2], env)
+                except JSThrow:
+                    return "undefined"
+                return self.typeof(v)
+            v = self.eval(e[2], env)
+            if e[1] == "!":
+                return not js_truthy(v)
+            if e[1] == "-":
+                return -js_num(v)
+            if e[1] == "+":
+                return js_num(v)
+            if e[1] == "~":
+                return ~int(js_num(v))
+            if e[1] == "void":
+                return UNDEF
+            if e[1] == "delete":
+                return True
+        if kind == "cond":
+            return self.eval(e[2] if js_truthy(self.eval(e[1], env)) else e[3], env)
+        if kind == "arrow":
+            return JSFunction(e[1], e[2], env, self, is_async=e[4],
+                              is_expr_body=e[3])
+        if kind == "funcexpr":
+            return JSFunction(e[2], e[3], env, self, is_async=e[4], name=e[1])
+        if kind == "await":
+            return unwrap(self.eval(e[1], env))
+        if kind == "seq":
+            self.eval(e[1], env)
+            return self.eval(e[2], env)
+        if kind == "spread":
+            raise JSThrow(JSError("unexpected spread"))
+        raise JSThrow(JSError(f"unknown expr {kind}"))
+
+    def typeof(self, v):
+        if v is UNDEF:
+            return "undefined"
+        if isinstance(v, bool):
+            return "boolean"
+        if isinstance(v, (int, float)):
+            return "number"
+        if isinstance(v, str):
+            return "string"
+        if isinstance(v, JSFunction) or callable(v):
+            return "function"
+        return "object"
+
+    def eval_call(self, e, env: Env):
+        _, callee, argexprs, optional = e
+        this = None
+        if callee[0] in ("get", "getidx"):
+            this = self.eval(callee[1], env)
+            if callee[3] and (this is UNDEF or this is None):
+                return UNDEF
+            name = callee[2] if callee[0] == "get" else js_str(self.eval(callee[2], env))
+            fn = self.get_prop(this, name)
+        else:
+            fn = self.eval(callee, env)
+        if optional and (fn is UNDEF or fn is None):
+            return UNDEF
+        args = []
+        for a in argexprs:
+            if a[0] == "spread":
+                args.extend(self.iterate(self.eval(a[1], env)))
+            else:
+                args.append(self.eval(a, env))
+        if fn is UNDEF or fn is None:
+            raise JSThrow(JSError(f"{js_str(fn)} is not a function "
+                                  f"(calling {callee!r:.80})"))
+        return _call_js(fn, args)
+
+    def construct(self, callee, args):
+        if callee in (JSMap, JSSet, JSRegExp, JSDate):
+            return callee(*args)
+        if callee is JSErrorCtor:
+            return JSError(js_str(args[0]) if args else "")
+        if isinstance(callee, type):
+            return callee(*args)
+        if callable(callee):
+            return callee(*args)
+        raise JSThrow(JSError("not a constructor"))
+
+    def eval_assign(self, e, env: Env):
+        _, target, op, rhs = e
+        value = self.eval(rhs, env)
+        if op != "=":
+            old = self.eval(target, env)
+            pyop = op[0]
+            if pyop == "+":
+                if isinstance(old, str) or isinstance(value, str):
+                    value = js_str(old) + js_str(value)
+                else:
+                    value = js_num(old) + js_num(value)
+            elif pyop == "-":
+                value = js_num(old) - js_num(value)
+            elif pyop == "*":
+                value = js_num(old) * js_num(value)
+            elif pyop == "/":
+                value = js_num(old) / js_num(value)
+            elif pyop == "%":
+                value = js_num(old) % js_num(value)
+        self.assign_to(target, value, env)
+        return value
+
+    def assign_to(self, target, value, env: Env):
+        kind = target[0]
+        if kind == "ident":
+            env.set(target[1], value)
+        elif kind == "get":
+            obj = self.eval(target[1], env)
+            self.set_prop(obj, target[2], value)
+        elif kind == "getidx":
+            obj = self.eval(target[1], env)
+            idx = self.eval(target[2], env)
+            if isinstance(obj, list):
+                i = int(js_num(idx))
+                while len(obj) <= i:
+                    obj.append(UNDEF)
+                obj[i] = value
+            else:
+                self.set_prop(obj, js_str(idx), value)
+        else:
+            raise JSThrow(JSError(f"invalid assignment target {kind}"))
+
+    def eval_bin(self, op, left, right):
+        if op == "+":
+            if isinstance(left, str) or isinstance(right, str):
+                return js_str(left) + js_str(right)
+            return js_num(left) + js_num(right)
+        if op == "-":
+            return js_num(left) - js_num(right)
+        if op == "*":
+            return js_num(left) * js_num(right)
+        if op == "/":
+            r = js_num(right)
+            if r == 0:
+                return float("inf") if js_num(left) > 0 else (
+                    float("-inf") if js_num(left) < 0 else float("nan"))
+            return js_num(left) / r
+        if op == "%":
+            return js_num(left) % js_num(right)
+        if op == "===":
+            return js_eq_strict(left, right)
+        if op == "!==":
+            return not js_eq_strict(left, right)
+        if op == "==":
+            return js_eq_loose(left, right)
+        if op == "!=":
+            return not js_eq_loose(left, right)
+        if op in ("<", ">", "<=", ">="):
+            if isinstance(left, str) and isinstance(right, str):
+                a, b = left, right
+            else:
+                a, b = js_num(left), js_num(right)
+            return {"<": a < b, ">": a > b, "<=": a <= b, ">=": a >= b}[op]
+        if op == "instanceof":
+            return isinstance(left, right) if isinstance(right, type) else False
+        if op == "in":
+            return js_str(left) in right if isinstance(right, dict) else False
+        raise JSThrow(JSError(f"unknown op {op}"))
+
+    # -- property model ----------------------------------------------------
+
+    def get_prop(self, obj, name: str):
+        if obj is UNDEF or obj is None:
+            raise JSThrow(JSError(
+                f"cannot read properties of {js_str(obj)} (reading '{name}')"))
+        # dict
+        if isinstance(obj, dict):
+            if name in obj:
+                return obj[name]
+            return _dict_method(obj, name)
+        if isinstance(obj, list):
+            if name == "length":
+                return len(obj)
+            return _list_method(obj, name)
+        if isinstance(obj, str):
+            if name == "length":
+                return len(obj)
+            return _str_method(obj, name)
+        if isinstance(obj, bool):
+            return UNDEF
+        if isinstance(obj, (int, float)):
+            return _num_method(obj, name)
+        if isinstance(obj, Thenable):
+            if name == "then":
+                return obj.then
+            if name == "catch":
+                return obj.catch
+            if name == "finally":
+                return obj.finally_
+            return UNDEF
+        if isinstance(obj, JSError):
+            if name == "message":
+                return obj.message
+            return UNDEF
+        # host object (Element, shims, JSMap...)
+        getter = getattr(obj, "js_get", None)
+        if getter is not None:
+            return getter(name)
+        v = getattr(obj, name, UNDEF)
+        return v
+
+    def set_prop(self, obj, name: str, value):
+        if isinstance(obj, dict):
+            obj[name] = value
+            return
+        setter = getattr(obj, "js_set", None)
+        if setter is not None:
+            setter(name, value)
+            return
+        setattr(obj, name, value)
+
+

@@ -1,0 +1,534 @@
+"""Parser for the console's mini-JS interpreter (see jsmini.py)."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from consoleharness.jslex import Tok, tokenize
+
+# ---------------------------------------------------------------------------
+# parser
+
+
+class Parser:
+    def __init__(self, toks: list[Tok], src: str = ""):
+        self.toks = toks
+        self.i = 0
+        self.src = src
+
+    # -- helpers --------------------------------------------------------
+
+    def peek(self, k=0) -> Tok:
+        return self.toks[min(self.i + k, len(self.toks) - 1)]
+
+    def next(self) -> Tok:
+        t = self.toks[self.i]
+        self.i += 1
+        return t
+
+    def at(self, kind, val=None) -> bool:
+        t = self.peek()
+        return t.kind == kind and (val is None or t.val == val)
+
+    def eat(self, kind, val=None) -> Optional[Tok]:
+        if self.at(kind, val):
+            return self.next()
+        return None
+
+    def expect(self, kind, val=None) -> Tok:
+        if not self.at(kind, val):
+            t = self.peek()
+            ctx = self.src[max(0, t.pos - 60):t.pos + 60]
+            raise SyntaxError(
+                f"jsmini: expected {val or kind}, got {t} near {ctx!r}")
+        return self.next()
+
+    # -- entry ----------------------------------------------------------
+
+    def parse_program(self):
+        stmts = []
+        while not self.at("eof"):
+            stmts.append(self.parse_stmt())
+        return ("block", stmts)
+
+    # -- statements ------------------------------------------------------
+
+    def parse_stmt(self):
+        t = self.peek()
+        if t.kind == "punct" and t.val == "{":
+            self.next()
+            stmts = []
+            while not self.eat("punct", "}"):
+                stmts.append(self.parse_stmt())
+            return ("block", stmts)
+        if t.kind == "punct" and t.val == ";":
+            self.next()
+            return ("empty",)
+        if t.kind == "kw":
+            if t.val in ("const", "let", "var"):
+                return self.parse_var()
+            if t.val == "if":
+                return self.parse_if()
+            if t.val == "for":
+                return self.parse_for()
+            if t.val == "while":
+                return self.parse_while()
+            if t.val == "return":
+                self.next()
+                if self.at("punct", ";") or self.at("punct", "}"):
+                    self.eat("punct", ";")
+                    return ("return", ("undef",))
+                e = self.parse_expr()
+                self.eat("punct", ";")
+                return ("return", e)
+            if t.val == "throw":
+                self.next()
+                e = self.parse_expr()
+                self.eat("punct", ";")
+                return ("throw", e)
+            if t.val == "try":
+                return self.parse_try()
+            if t.val == "break":
+                self.next()
+                self.eat("punct", ";")
+                return ("break",)
+            if t.val == "continue":
+                self.next()
+                self.eat("punct", ";")
+                return ("continue",)
+            if t.val == "function" or (
+                t.val == "async" and self.peek(1).kind == "kw"
+                and self.peek(1).val == "function"
+            ):
+                return self.parse_funcdecl()
+            if t.val == "switch":
+                return self.parse_switch()
+        e = self.parse_expr()
+        self.eat("punct", ";")
+        return ("expr", e)
+
+    def parse_var(self):
+        kind = self.next().val
+        decls = []
+        while True:
+            pat = self.parse_pattern()
+            init = None
+            if self.eat("punct", "="):
+                init = self.parse_assign()
+            decls.append((pat, init))
+            if not self.eat("punct", ","):
+                break
+        self.eat("punct", ";")
+        return ("var", kind, decls)
+
+    def parse_pattern(self):
+        if self.at("punct", "{"):
+            self.next()
+            props = []
+            while not self.eat("punct", "}"):
+                key = self.next().val  # id or str
+                alias = key
+                default = None
+                if self.eat("punct", ":"):
+                    alias = self.next().val
+                if self.eat("punct", "="):
+                    default = self.parse_assign()
+                props.append((key, alias, default))
+                self.eat("punct", ",")
+            return ("pat_obj", props)
+        if self.at("punct", "["):
+            self.next()
+            elems = []
+            while not self.eat("punct", "]"):
+                if self.at("punct", ","):
+                    elems.append(None)
+                else:
+                    elems.append(self.parse_pattern())
+                self.eat("punct", ",")
+            return ("pat_arr", elems)
+        return ("pat_id", self.expect_any_name())
+
+    def expect_any_name(self):
+        t = self.next()
+        if t.kind not in ("id", "kw"):
+            raise SyntaxError(f"jsmini: expected name, got {t}")
+        return t.val
+
+    def parse_if(self):
+        self.next()
+        self.expect("punct", "(")
+        cond = self.parse_expr()
+        self.expect("punct", ")")
+        then = self.parse_stmt()
+        other = None
+        if self.eat("kw", "else"):
+            other = self.parse_stmt()
+        return ("if", cond, then, other)
+
+    def parse_while(self):
+        self.next()
+        self.expect("punct", "(")
+        cond = self.parse_expr()
+        self.expect("punct", ")")
+        return ("while", cond, self.parse_stmt())
+
+    def parse_for(self):
+        self.next()
+        self.expect("punct", "(")
+        # for (const PAT of EXPR) | classic for(;;)
+        save = self.i
+        if self.peek().kind == "kw" and self.peek().val in ("const", "let", "var"):
+            kind = self.next().val
+            pat = self.parse_pattern()
+            if self.eat("kw", "of"):
+                it = self.parse_expr()
+                self.expect("punct", ")")
+                return ("forof", kind, pat, it, self.parse_stmt())
+            if self.eat("kw", "in"):
+                it = self.parse_expr()
+                self.expect("punct", ")")
+                return ("forin", kind, pat, it, self.parse_stmt())
+            self.i = save
+        init = None
+        if not self.at("punct", ";"):
+            if self.peek().kind == "kw" and self.peek().val in ("const", "let", "var"):
+                init = self.parse_var()
+            else:
+                init = ("expr", self.parse_expr())
+                self.eat("punct", ";")
+        else:
+            self.next()
+        if init is not None and init[0] == "var":
+            pass  # parse_var already ate the ';'
+        cond = None if self.at("punct", ";") else self.parse_expr()
+        self.expect("punct", ";")
+        update = None if self.at("punct", ")") else self.parse_expr()
+        self.expect("punct", ")")
+        return ("for", init, cond, update, self.parse_stmt())
+
+    def parse_try(self):
+        self.next()
+        block = self.parse_stmt()
+        param, catch, fin = None, None, None
+        if self.eat("kw", "catch"):
+            if self.eat("punct", "("):
+                param = self.parse_pattern()
+                self.expect("punct", ")")
+            catch = self.parse_stmt()
+        if self.eat("kw", "finally"):
+            fin = self.parse_stmt()
+        return ("try", block, param, catch, fin)
+
+    def parse_switch(self):
+        self.next()
+        self.expect("punct", "(")
+        disc = self.parse_expr()
+        self.expect("punct", ")")
+        self.expect("punct", "{")
+        cases = []
+        default = None
+        while not self.eat("punct", "}"):
+            if self.eat("kw", "case"):
+                test = self.parse_expr()
+                self.expect("punct", ":")
+                body = []
+                while not (self.at("kw", "case") or self.at("kw", "default")
+                           or self.at("punct", "}")):
+                    body.append(self.parse_stmt())
+                cases.append((test, body))
+            elif self.eat("kw", "default"):
+                self.expect("punct", ":")
+                body = []
+                while not (self.at("kw", "case") or self.at("punct", "}")):
+                    body.append(self.parse_stmt())
+                default = body
+        return ("switch", disc, cases, default)
+
+    def parse_funcdecl(self):
+        is_async = bool(self.eat("kw", "async"))
+        self.expect("kw", "function")
+        name = self.expect_any_name()
+        params = self.parse_params()
+        body = self.parse_stmt()
+        return ("funcdecl", name, params, body, is_async)
+
+    def parse_params(self):
+        self.expect("punct", "(")
+        params = []
+        while not self.eat("punct", ")"):
+            params.append(self.parse_pattern())
+            self.eat("punct", ",")
+        return params
+
+    # -- expressions ------------------------------------------------------
+
+    def parse_expr(self):
+        e = self.parse_assign()
+        while self.at("punct", ","):
+            self.next()
+            e = ("seq", e, self.parse_assign())
+        return e
+
+    def parse_assign(self):
+        # arrow detection: ident => | ( params ) =>  | async (...) =>
+        if self.at("kw", "async"):
+            save = self.i
+            self.next()
+            arrow = self.try_arrow(is_async=True)
+            if arrow is not None:
+                return arrow
+            self.i = save
+        arrow = self.try_arrow(is_async=False)
+        if arrow is not None:
+            return arrow
+        left = self.parse_cond()
+        t = self.peek()
+        if t.kind == "punct" and t.val in ("=", "+=", "-=", "*=", "/=", "%="):
+            self.next()
+            right = self.parse_assign()
+            return ("assign", left, t.val, right)
+        return left
+
+    def try_arrow(self, is_async):
+        save = self.i
+        params = None
+        if self.peek().kind == "id" and self.peek(1).kind == "punct" \
+                and self.peek(1).val == "=>":
+            params = [("pat_id", self.next().val)]
+        elif self.at("punct", "("):
+            depth = 0
+            j = self.i
+            while j < len(self.toks):
+                t = self.toks[j]
+                if t.kind == "punct" and t.val == "(":
+                    depth += 1
+                elif t.kind == "punct" and t.val == ")":
+                    depth -= 1
+                    if depth == 0:
+                        break
+                j += 1
+            nxt = self.toks[j + 1] if j + 1 < len(self.toks) else None
+            if nxt is not None and nxt.kind == "punct" and nxt.val == "=>":
+                params = self.parse_params()
+        if params is None:
+            self.i = save
+            return None
+        self.expect("punct", "=>")
+        if self.at("punct", "{"):
+            body = self.parse_stmt()
+            return ("arrow", params, body, False, is_async)
+        body = self.parse_assign()
+        return ("arrow", params, body, True, is_async)
+
+    def parse_cond(self):
+        c = self.parse_nullish()
+        if self.at("punct", "?") and not self.at("punct", "?."):
+            self.next()
+            t = self.parse_assign()
+            self.expect("punct", ":")
+            f = self.parse_assign()
+            return ("cond", c, t, f)
+        return c
+
+    def parse_nullish(self):
+        left = self.parse_or()
+        while self.at("punct", "??"):
+            self.next()
+            left = ("logic", "??", left, self.parse_or())
+        return left
+
+    def parse_or(self):
+        left = self.parse_and()
+        while self.at("punct", "||"):
+            self.next()
+            left = ("logic", "||", left, self.parse_and())
+        return left
+
+    def parse_and(self):
+        left = self.parse_eq()
+        while self.at("punct", "&&"):
+            self.next()
+            left = ("logic", "&&", left, self.parse_eq())
+        return left
+
+    def parse_eq(self):
+        left = self.parse_rel()
+        while self.peek().kind == "punct" and self.peek().val in (
+                "===", "!==", "==", "!="):
+            op = self.next().val
+            left = ("bin", op, left, self.parse_rel())
+        return left
+
+    def parse_rel(self):
+        left = self.parse_add()
+        while True:
+            t = self.peek()
+            if t.kind == "punct" and t.val in ("<", ">", "<=", ">="):
+                op = self.next().val
+                left = ("bin", op, left, self.parse_add())
+            elif t.kind == "kw" and t.val in ("instanceof", "in"):
+                op = self.next().val
+                left = ("bin", op, left, self.parse_add())
+            else:
+                return left
+
+    def parse_add(self):
+        left = self.parse_mul()
+        while self.peek().kind == "punct" and self.peek().val in ("+", "-"):
+            op = self.next().val
+            left = ("bin", op, left, self.parse_mul())
+        return left
+
+    def parse_mul(self):
+        left = self.parse_unary()
+        while self.peek().kind == "punct" and self.peek().val in ("*", "/", "%"):
+            op = self.next().val
+            left = ("bin", op, left, self.parse_unary())
+        return left
+
+    def parse_unary(self):
+        t = self.peek()
+        if t.kind == "punct" and t.val in ("!", "-", "+", "~"):
+            self.next()
+            return ("un", t.val, self.parse_unary())
+        if t.kind == "punct" and t.val in ("++", "--"):
+            self.next()
+            return ("update", t.val, self.parse_unary(), True)
+        if t.kind == "kw" and t.val in ("typeof", "void", "delete"):
+            self.next()
+            return ("un", t.val, self.parse_unary())
+        if t.kind == "kw" and t.val == "await":
+            self.next()
+            return ("await", self.parse_unary())
+        if t.kind == "kw" and t.val == "new":
+            self.next()
+            callee = self.parse_postfix(no_call=True)
+            args = []
+            if self.at("punct", "("):
+                args = self.parse_args()
+            return ("new", callee, args)
+        return self.parse_postfix()
+
+    def parse_args(self):
+        self.expect("punct", "(")
+        args = []
+        while not self.eat("punct", ")"):
+            if self.eat("punct", "..."):
+                args.append(("spread", self.parse_assign()))
+            else:
+                args.append(self.parse_assign())
+            self.eat("punct", ",")
+        return args
+
+    def parse_postfix(self, no_call=False):
+        e = self.parse_primary()
+        while True:
+            t = self.peek()
+            if t.kind == "punct" and t.val == ".":
+                self.next()
+                e = ("get", e, self.expect_any_name(), False)
+            elif t.kind == "punct" and t.val == "?.":
+                self.next()
+                e = ("get", e, self.expect_any_name(), True)
+            elif t.kind == "punct" and t.val == "?.(":
+                self.i -= 0  # token is '?.(' composite
+                self.next()
+                args = []
+                while not self.eat("punct", ")"):
+                    if self.eat("punct", "..."):
+                        args.append(("spread", self.parse_assign()))
+                    else:
+                        args.append(self.parse_assign())
+                    self.eat("punct", ",")
+                e = ("call", e, args, True)
+            elif t.kind == "punct" and t.val == "[":
+                self.next()
+                idx = self.parse_expr()
+                self.expect("punct", "]")
+                e = ("getidx", e, idx, False)
+            elif t.kind == "punct" and t.val == "(" and not no_call:
+                e = ("call", e, self.parse_args(), False)
+            elif t.kind == "punct" and t.val in ("++", "--"):
+                self.next()
+                e = ("update", t.val, e, False)
+            else:
+                return e
+
+    def parse_primary(self):
+        t = self.next()
+        if t.kind == "num":
+            return ("num", t.val)
+        if t.kind == "str":
+            return ("str", t.val)
+        if t.kind == "tpl":
+            parts = []
+            for kind, val in t.val:
+                if kind == "str":
+                    parts.append(("str", val))
+                else:
+                    sub = Parser(tokenize(val), val)
+                    parts.append(("expr", sub.parse_expr()))
+            return ("tpl", parts)
+        if t.kind == "regex":
+            return ("regex", t.val[0], t.val[1])
+        if t.kind == "id":
+            return ("ident", t.val)
+        if t.kind == "kw":
+            if t.val == "true":
+                return ("bool", True)
+            if t.val == "false":
+                return ("bool", False)
+            if t.val == "null":
+                return ("null",)
+            if t.val == "undefined":
+                return ("undef",)
+            if t.val == "function" or (
+                t.val == "async" and self.at("kw", "function")
+            ):
+                is_async = t.val == "async"
+                if is_async:
+                    self.expect("kw", "function")
+                name = self.expect_any_name() if self.peek().kind == "id" else ""
+                params = self.parse_params()
+                body = self.parse_stmt()
+                return ("funcexpr", name, params, body, is_async)
+            if t.val in ("of", "in", "async"):  # contextual as identifier
+                return ("ident", t.val)
+        if t.kind == "punct":
+            if t.val == "(":
+                e = self.parse_expr()
+                self.expect("punct", ")")
+                return e
+            if t.val == "[":
+                elems = []
+                while not self.eat("punct", "]"):
+                    if self.eat("punct", "..."):
+                        elems.append(("spread", self.parse_assign()))
+                    else:
+                        elems.append(self.parse_assign())
+                    self.eat("punct", ",")
+                return ("array", elems)
+            if t.val == "{":
+                props = []
+                while not self.eat("punct", "}"):
+                    if self.eat("punct", "..."):
+                        props.append(("spread", self.parse_assign()))
+                    elif self.at("punct", "["):
+                        self.next()
+                        key = self.parse_assign()
+                        self.expect("punct", "]")
+                        self.expect("punct", ":")
+                        props.append(("computed", key, self.parse_assign()))
+                    else:
+                        kt = self.next()
+                        key = kt.val if kt.kind in ("id", "kw", "str") else str(kt.val)
+                        if self.eat("punct", ":"):
+                            props.append(("kv", key, self.parse_assign()))
+                        else:  # shorthand {a}
+                            props.append(("kv", key, ("ident", key)))
+                    self.eat("punct", ",")
+                return ("object", props)
+        raise SyntaxError(f"jsmini: unexpected token {t} near "
+                          f"{self.src[max(0, t.pos-60):t.pos+60]!r}")
+
+

@@ -1,0 +1,246 @@
+"""Value model for the console's mini-JS interpreter (see jsmini.py)."""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+
+# ---------------------------------------------------------------------------
+# values
+
+
+class _Undefined:
+    _inst = None
+
+    def __new__(cls):
+        if cls._inst is None:
+            cls._inst = super().__new__(cls)
+        return cls._inst
+
+    def __repr__(self):
+        return "undefined"
+
+    def __bool__(self):
+        return False
+
+
+UNDEF = _Undefined()
+NULL = None
+
+
+class JSThrow(Exception):
+    def __init__(self, value):
+        self.value = value
+        super().__init__(str(value))
+
+
+class _Return(Exception):
+    def __init__(self, value):
+        self.value = value
+
+
+class _Break(Exception):
+    pass
+
+
+class _Continue(Exception):
+    pass
+
+
+class JSError:
+    def __init__(self, message=""):
+        self.message = message
+
+    def __repr__(self):
+        return f"Error: {self.message}"
+
+
+class Thenable:
+    """Synchronous promise stand-in: resolved or rejected, already."""
+
+    def __init__(self, value=UNDEF, error=None):
+        self.value = value
+        self.error = error
+
+    def then(self, fn=None, _rej=None):
+        if self.error is not None:
+            if _rej is not None:
+                return Thenable(_call_js(_rej, [self.error]))
+            return self
+        if fn is None:
+            return self
+        return Thenable(_call_js(fn, [self.value]))
+
+    def catch(self, fn):
+        if self.error is not None:
+            return Thenable(_call_js(fn, [self.error]))
+        return self
+
+    # `finally` is a Python keyword; dispatched via _MISC_METHODS.
+    def finally_(self, fn):
+        _call_js(fn, [])
+        return self
+
+
+def unwrap(v):
+    """`await v` semantics."""
+    if isinstance(v, Thenable):
+        if v.error is not None:
+            raise JSThrow(v.error)
+        return unwrap(v.value)
+    return v
+
+
+class JSFunction:
+    def __init__(self, params, body, env, interp, is_async=False,
+                 is_expr_body=False, name=""):
+        self.params = params
+        self.body = body
+        self.env = env
+        self.interp = interp
+        self.is_async = is_async
+        self.is_expr_body = is_expr_body
+        self.name = name
+
+    def __call__(self, *args):
+        return self.invoke(list(args))
+
+    def invoke(self, args):
+        env = Env(self.env)
+        for i, pat in enumerate(self.params):
+            self.interp.bind_pattern(env, pat, args[i] if i < len(args) else UNDEF)
+        try:
+            if self.is_expr_body:
+                result = self.interp.eval(self.body, env)
+            else:
+                self.interp.exec_block(self.body, env)
+                result = UNDEF
+        except _Return as r:
+            result = r.value
+        except JSThrow as t:
+            if self.is_async:
+                return Thenable(error=t.value)
+            raise
+        if self.is_async:
+            return Thenable(unwrap(result) if isinstance(result, Thenable) else result)
+        return result
+
+
+def _call_js(fn, args):
+    if isinstance(fn, JSFunction):
+        return fn.invoke(args)
+    if callable(fn):
+        return fn(*args)
+    raise JSThrow(JSError(f"{fn!r} is not a function"))
+
+
+class Env:
+    def __init__(self, parent: Optional["Env"] = None):
+        self.vars: dict[str, Any] = {}
+        self.parent = parent
+
+    def get(self, name):
+        e = self
+        while e is not None:
+            if name in e.vars:
+                return e.vars[name]
+            e = e.parent
+        raise JSThrow(JSError(f"{name} is not defined"))
+
+    def has(self, name):
+        e = self
+        while e is not None:
+            if name in e.vars:
+                return True
+            e = e.parent
+        return False
+
+    def declare(self, name, value):
+        self.vars[name] = value
+
+    def set(self, name, value):
+        e = self
+        while e is not None:
+            if name in e.vars:
+                e.vars[name] = value
+                return
+            e = e.parent
+        # implicit global (sloppy) — declare at root
+        e = self
+        while e.parent is not None:
+            e = e.parent
+        e.vars[name] = value
+
+
+
+
+def js_truthy(v) -> bool:
+    if v is UNDEF or v is None:
+        return False
+    if isinstance(v, bool):
+        return v
+    if isinstance(v, (int, float)):
+        return v != 0 and v == v  # NaN false
+    if isinstance(v, str):
+        return v != ""
+    return True
+
+
+def js_str(v) -> str:
+    if v is UNDEF:
+        return "undefined"
+    if v is None:
+        return "null"
+    if isinstance(v, bool):
+        return "true" if v else "false"
+    if isinstance(v, float):
+        if v != v:
+            return "NaN"
+        if v == int(v) and abs(v) < 1e15:
+            return str(int(v))
+        return repr(v)
+    if isinstance(v, (dict,)):
+        return "[object Object]"
+    if isinstance(v, list):
+        return ",".join(js_str(x) for x in v)
+    if isinstance(v, JSError):
+        return f"Error: {v.message}"
+    return str(v)
+
+
+def js_num(v) -> float:
+    if isinstance(v, bool):
+        return 1.0 if v else 0.0
+    if isinstance(v, (int, float)):
+        return v
+    if v is None:
+        return 0.0
+    if isinstance(v, str):
+        try:
+            return float(v) if v.strip() else 0.0
+        except ValueError:
+            return float("nan")
+    return float("nan")
+
+
+def js_eq_loose(a, b) -> bool:
+    if (a is UNDEF or a is None) and (b is UNDEF or b is None):
+        return True
+    if isinstance(a, str) and isinstance(b, (int, float)) or \
+       isinstance(b, str) and isinstance(a, (int, float)):
+        return js_num(a) == js_num(b)
+    return js_eq_strict(a, b)
+
+
+def js_eq_strict(a, b) -> bool:
+    if a is UNDEF or b is UNDEF:
+        return a is b
+    if a is None or b is None:
+        return a is b
+    if isinstance(a, bool) or isinstance(b, bool):
+        return a is b
+    if isinstance(a, (int, float)) and isinstance(b, (int, float)):
+        return a == b
+    if isinstance(a, str) and isinstance(b, str):
+        return a == b
+    return a is b

@@ -1,0 +1,185 @@
+"""ToolRegistry reachability probes (VERDICT r4 #7): endpoint
+derivation, TCP probing, phase computation, controller status
+projection, and the doctor check — an unreachable tool shows up in CRD
+status AND doctor output.
+"""
+
+import socket
+import threading
+
+import pytest
+
+from omnia_tpu.operator import toolprobe
+from omnia_tpu.operator.controller import ControllerManager
+from omnia_tpu.operator.resources import Resource
+from omnia_tpu.operator.store import MemoryResourceStore
+
+
+@pytest.fixture
+def live_port():
+    """A listening TCP socket (reachable endpoint)."""
+    srv = socket.socket()
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(8)
+    t = threading.Thread(target=lambda: [c[0].close() for c in
+                                         iter(lambda: _accept(srv), None)],
+                         daemon=True)
+    t.start()
+    yield srv.getsockname()[1]
+    srv.close()
+
+
+def _accept(srv):
+    try:
+        return srv.accept()
+    except OSError:
+        return None
+
+
+class TestEndpointDerivation:
+    def test_per_handler_type(self):
+        assert toolprobe.endpoint_of(
+            {"handler": {"type": "http", "url": "http://kb:8080/s"}}
+        ) == "http://kb:8080/s"
+        assert toolprobe.endpoint_of(
+            {"handler": {"type": "grpc",
+                         "grpcConfig": {"endpoint": "billing:50051"}}}
+        ) == "billing:50051"
+        assert toolprobe.endpoint_of(
+            {"handler": {"type": "mcp",
+                         "mcpConfig": {"transport": "stdio", "command": "x"}}}
+        ) == "stdio://"
+        assert toolprobe.endpoint_of(
+            {"handler": {"type": "mcp",
+                         "mcpConfig": {"endpoint": "http://mcp:9000/mcp"}}}
+        ) == "http://mcp:9000/mcp"
+        assert toolprobe.endpoint_of({"handler": {"type": "client"}}) == "client://"
+        assert toolprobe.endpoint_of(
+            {"handler": {"type": "openapi",
+                         "openAPIConfig": {"specURL": "https://api.x/spec"}}}
+        ) == "https://api.x/spec"
+
+    def test_probe_address_forms(self):
+        assert toolprobe.probe_address("http://h:81/x") == ("h", 81)
+        assert toolprobe.probe_address("https://h/x") == ("h", 443)
+        assert toolprobe.probe_address("grpc-host:50051") == ("grpc-host", 50051)
+        assert toolprobe.probe_address("not an endpoint") is None
+
+
+class TestProbe:
+    def test_reachable_and_unreachable(self, live_port):
+        status, err = toolprobe.probe_one(f"http://127.0.0.1:{live_port}/x",
+                                          timeout_s=2.0)
+        assert status == "Available" and not err
+        status, err = toolprobe.probe_one("http://127.0.0.1:1/x", timeout_s=0.5)
+        assert status == "Unavailable" and "probe failed" in err
+
+    def test_unprobeable_endpoints_stay_unknown(self):
+        assert toolprobe.probe_one("stdio://")[0] == "Unknown"
+        assert toolprobe.probe_one("client://")[0] == "Unknown"
+        assert toolprobe.probe_one("")[0] == "Unknown"
+
+    def test_bad_address_is_misconfiguration(self):
+        status, err = toolprobe.probe_one("no-port-here")
+        assert status == "Unavailable" and "unrecognized" in err
+
+    def test_phases(self):
+        A, U, K = "Available", "Unavailable", "Unknown"
+
+        def mk(*sts):
+            return [{"status": s} for s in sts]
+
+        assert toolprobe.phase_of([]) == "Pending"
+        assert toolprobe.phase_of(mk(A, A, K)) == "Ready"
+        assert toolprobe.phase_of(mk(A, U)) == "Degraded"
+        assert toolprobe.phase_of(mk(U, U, K)) == "Failed"
+
+
+class TestControllerIntegration:
+    def test_unreachable_tool_surfaces_in_status_and_doctor(self, live_port):
+        store = MemoryResourceStore()
+        cm = ControllerManager(store)
+        try:
+            store.apply(Resource(kind="ToolRegistry", name="tr", spec={
+                "probe": {"timeoutSeconds": 0.5},
+                "tools": [
+                    {"name": "up", "handler": {
+                        "type": "http",
+                        "url": f"http://127.0.0.1:{live_port}/hook"}},
+                    {"name": "down", "handler": {
+                        "type": "grpc", "endpoint": "127.0.0.1:1"}},
+                    {"name": "browser", "handler": {"type": "client"}},
+                ],
+            }))
+            cm.drain_queue()
+            res = store.get("default", "ToolRegistry", "tr")
+            status = res.status
+            assert status["phase"] == "Degraded"
+            assert status["discoveredToolsCount"] == 3
+            by_name = {t["name"]: t for t in status["tools"]}
+            assert by_name["up"]["status"] == "Available"
+            assert by_name["down"]["status"] == "Unavailable"
+            assert "probe failed" in by_name["down"]["error"]
+            assert by_name["browser"]["status"] == "Unknown"
+            assert "down" in status["message"]
+
+            # doctor reads the same status
+            from omnia_tpu.doctor import Doctor
+
+            doc = Doctor()
+            doc.add_tool_registry_check(store)
+            report = doc.run()
+            assert report["status"] == "warn"
+            tr_check = next(c for c in report["checks"]
+                            if c["name"] == "tool-registries")
+            assert "down" in tr_check["detail"]
+        finally:
+            cm.shutdown()
+
+    def test_backend_death_flips_phase_on_resync(self):
+        """Reachability is a LIVE property: a backend that dies after
+        apply must flip Ready→Degraded on the next interval re-probe —
+        not stay green forever."""
+        srv = socket.socket()
+        srv.bind(("127.0.0.1", 0))
+        srv.listen(4)
+        port = srv.getsockname()[1]
+        t = threading.Thread(target=lambda: [c[0].close() for c in
+                                             iter(lambda: _accept(srv), None)],
+                             daemon=True)
+        t.start()
+        store = MemoryResourceStore()
+        cm = ControllerManager(store)
+        try:
+            store.apply(Resource(kind="ToolRegistry", name="tr", spec={
+                "probe": {"timeoutSeconds": 0.5, "intervalSeconds": 0.0},
+                "tools": [{"name": "t", "handler": {
+                    "type": "grpc", "endpoint": f"127.0.0.1:{port}"}}],
+            }))
+            cm.drain_queue()
+            assert store.get("default", "ToolRegistry", "tr").status["phase"] == "Ready"
+            srv.close()  # backend dies
+            cm.resync()  # intervalSeconds=0 → due immediately
+            cm.join_probes()
+            status = store.get("default", "ToolRegistry", "tr").status
+            assert status["phase"] == "Failed"
+            assert status["tools"][0]["status"] == "Unavailable"
+        finally:
+            cm.shutdown()
+            srv.close()
+
+    def test_probe_disabled_reports_declared_only(self):
+        store = MemoryResourceStore()
+        cm = ControllerManager(store)
+        try:
+            store.apply(Resource(kind="ToolRegistry", name="tr", spec={
+                "probe": {"enabled": False},
+                "tools": [{"name": "t", "handler": {
+                    "type": "grpc", "endpoint": "127.0.0.1:1"}}],
+            }))
+            cm.drain_queue()
+            status = store.get("default", "ToolRegistry", "tr").status
+            assert status["phase"] == "Ready"
+            assert status["tools"][0]["status"] == "Unknown"
+        finally:
+            cm.shutdown()
