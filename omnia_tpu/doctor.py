@@ -279,8 +279,18 @@ class Doctor:
                     remedy="wait for the operator's probe pass (or check "
                            "the operator is running)",
                 )
-            return CheckResult("tool-registries", PASS,
-                               detail=f"{len(regs)} registries reachable")
+            # "reachable" only for registries where something was DIALED;
+            # probe-disabled / client-or-stdio-only ones are declared.
+            probed = sum(
+                1 for reg in regs
+                if any(t.get("status") == "Available"
+                       for t in (reg.status or {}).get("tools", []))
+            )
+            declared = len(regs) - probed
+            detail = f"{probed} reachable"
+            if declared:
+                detail += f", {declared} declared-only (not dialed)"
+            return CheckResult("tool-registries", PASS, detail=detail)
         self.register("tool-registries", check)
 
     def add_streams_check(self, stream) -> None:

@@ -201,6 +201,11 @@ class ControllerManager(_SourceReconcilersMixin):
         for tr in self.store.list(ResourceKind.TOOL_REGISTRY.value):
             probe_cfg = tr.spec.get("probe", {}) or {}
             if not probe_cfg.get("enabled", True):
+                # Probe-disabled registries still need their declared-only
+                # status ONCE — a devroot manifest synced before the
+                # watcher subscribed otherwise never gets a phase at all.
+                if not tr.status.get("phase"):
+                    self.reconcile_tool_registry(tr)
                 continue
             interval = float(probe_cfg.get("intervalSeconds", 60.0))
             last = float(tr.status.get("lastProbeAt") or 0.0)
@@ -392,13 +397,23 @@ class ControllerManager(_SourceReconcilersMixin):
             phase = toolprobe.PHASE_READY if tools else toolprobe.PHASE_PENDING
         down = [t["name"] for t in statuses
                 if t["status"] == toolprobe.STATUS_UNAVAILABLE]
-        self.store.update_status(res, {
-            "phase": phase,
-            "discoveredToolsCount": len(tools),
-            "tools": statuses,
-            "lastProbeAt": time.time(),
-            "message": f"unreachable: {', '.join(down)}" if down else "",
-        })
+        try:
+            self.store.update_status(res, {
+                "phase": phase,
+                "discoveredToolsCount": len(tools),
+                "tools": statuses,
+                "lastProbeAt": time.time(),
+                "message": f"unreachable: {', '.join(down)}" if down else "",
+            })
+        except KeyError:
+            # The registry was deleted while its probe was in flight —
+            # nothing to report against.
+            pass
+        finally:
+            with self._probe_lock:
+                key = f"{res.namespace}/{res.name}"
+                if self._probe_threads.get(key) is threading.current_thread():
+                    self._probe_threads.pop(key, None)
 
     def join_probes(self, timeout_s: float = 30.0) -> None:
         """Wait for in-flight ToolRegistry probes (tests/drain)."""

@@ -62,9 +62,11 @@ def probe_address(endpoint: str) -> Optional[tuple[str, int]]:
     if u.scheme and u.hostname:
         port = u.port or (443 if u.scheme in ("https", "wss") else 80)
         return u.hostname, port
-    # bare host:port (gRPC endpoints)
+    # bare host:port (gRPC endpoints), incl. bracketed IPv6 [::1]:50051
     host, _, port = endpoint.rpartition(":")
     if host and port.isdigit():
+        if host.startswith("[") and host.endswith("]"):
+            host = host[1:-1]  # getaddrinfo wants the bare address
         return host, int(port)
     return None
 

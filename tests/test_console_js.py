@@ -350,6 +350,33 @@ def test_editor_view_lints_live_through_lsp(page):
     assert "applied" in doc.element("#editor-state")._props["textContent"]
 
 
+def test_editor_keeps_unsaved_edits_across_view_switch(page):
+    """Switching away and back must not clobber an in-progress edit."""
+    interp, doc = page
+    fetch = interp.globals.get("__fetch__")
+    fetch.fixtures["/api/resources?kind=PromptPack"] = {"resources": [{
+        "kind": "PromptPack",
+        "metadata": {"name": "support-pack", "namespace": "default"},
+        "spec": {"content": {"name": "support-pack", "version": "1.0.0",
+                             "prompts": {"system": "be helpful"}}},
+    }]}
+    fetch.fixtures["/api/lsp"] = _lsp_fixture
+    from consoleharness.jsmini import _call_js, unwrap
+
+    _load(interp, "editor")
+    ta = doc.element("#editor-text")
+    ta.set_value('{"name": "WIP edit"}')
+    unwrap(_call_js(ta._props["oninput"], []))  # marks dirty
+    _load(interp, "tools")      # user checks another view
+    _load(interp, "editor")     # and comes back
+    assert ta._props["value"] == '{"name": "WIP edit"}'
+    assert "unsaved" in doc.element("#editor-state")._props["textContent"]
+    # opening a pack explicitly resets the buffer (clean open)
+    sel = doc.element("#editor-pack")
+    unwrap(_call_js(sel._props["onchange"], []))
+    assert "1.0.0" in ta._props["value"]
+
+
 def test_login_flow_via_form(page):
     """Login submit posts the token and flips the overlay on success."""
     interp, doc = page
