@@ -273,6 +273,21 @@ def child_main() -> None:
     elif on_accel:
         w8 = {"skipped": f"only {remaining():.0f}s left in child budget"}
 
+    # --- pallas-vs-XLA decode attention A/B (VERDICT r4 #1) -----------
+    # The claim "the Pallas decode kernel beats the XLA path" must be a
+    # measurement, not an assertion: same op, same shapes, both routes,
+    # at full context and at 1/8 context (the kernel's length-aware HBM
+    # traffic is the whole point — its win grows as context shrinks
+    # relative to cache capacity).
+    pallas_ab = None
+    if on_accel and remaining() > 60:
+        try:
+            pallas_ab = _bench_pallas_ab(cfg, ecfg, remaining)
+            _log(f"pallas A/B done: {pallas_ab}")
+        except Exception as exc:  # noqa: BLE001 - A/B is evidence, not a gate
+            _log(f"pallas A/B failed: {exc!r}")
+            pallas_ab = {"error": repr(exc)}
+
     # --- roofline accounting ------------------------------------------
     kind, peak_flops, peak_bw = _chip_spec(dev.device_kind)
     n_params = cfg.num_params()
@@ -324,12 +339,92 @@ def child_main() -> None:
             ),
         },
     }
+    if pallas_ab is not None:
+        result["aux"]["pallas_ab"] = pallas_ab
     if w8 is not None:
         w8.pop("weight_bytes", None)
         result["aux"]["int8_dynamic"] = {
             k: (round(v, 2) if isinstance(v, float) else v) for k, v in w8.items()
         }
     print(json.dumps(result))
+
+
+def _bench_pallas_ab(cfg, ecfg, remaining, iters: int = 50):
+    """Time gqa_attention's decode step with the Pallas kernel forced ON
+    vs OFF, on the serving shapes (num_slots batch, max_seq cache, bf16).
+    Returns per-context medians (µs) + speedups + a numeric agreement
+    check between the two routes."""
+    import jax
+    import jax.numpy as jnp
+
+    from omnia_tpu.ops import attention as attn
+
+    B, S = ecfg.num_slots, ecfg.max_seq
+    H, Hkv, D = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim
+    key = jax.random.PRNGKey(7)
+    kq, kk, kv = jax.random.split(key, 3)
+    q = jax.random.normal(kq, (B, 1, H, D), dtype=jnp.bfloat16)
+    k = jax.random.normal(kk, (B, S, Hkv, D), dtype=jnp.bfloat16)
+    v = jax.random.normal(kv, (B, S, Hkv, D), dtype=jnp.bfloat16)
+
+    prev = os.environ.get("OMNIA_PALLAS_DECODE")
+    # Real Mosaic kernels only exist on TPU backends; the CPU smoke path
+    # (tests) runs the Pallas arm under the interpreter.
+    pallas_mode = "1" if jax.default_backend() in ("tpu", "axon") else "interpret"
+    out: dict = {"shape": f"B{B} S{S} H{H} Hkv{Hkv} D{D} bf16"}
+    try:
+        results: dict = {}
+        for label, pos_val in (("full_ctx", S - 1), ("ctx_div8", S // 8)):
+            if remaining() < 30:
+                # NEVER risk the already-measured main result: the child
+                # prints its JSON only at the end, so blowing the
+                # watchdog here would discard everything (the r2 lesson).
+                out["truncated"] = f"stopped before {label}: budget"
+                break
+            pos = jnp.full((B, 1), pos_val, dtype=jnp.int32)
+            per_mode: dict = {}
+            outputs: dict = {}
+            for mode in (pallas_mode, "0"):
+                if remaining() < 15:
+                    out["truncated"] = f"stopped in {label}: budget"
+                    break
+                os.environ["OMNIA_PALLAS_DECODE"] = mode
+                attn._pallas_decode_mode.cache_clear()
+                # fresh jit per mode: routing is resolved at trace time
+                fn = jax.jit(lambda q_, k_, v_, p_: attn.gqa_attention(
+                    q_, k_, v_, p_))
+                y = fn(q, k, v, pos)
+                y.block_until_ready()  # compile outside the timing loop
+                times = []
+                n = iters if remaining() > 30 else max(10, iters // 5)
+                for _ in range(n):
+                    t0 = time.perf_counter()
+                    fn(q, k, v, pos).block_until_ready()
+                    times.append(time.perf_counter() - t0)
+                per_mode[mode] = statistics.median(times) * 1e6
+                outputs[mode] = y
+            if len(per_mode) < 2:
+                break
+            agree = bool(jnp.allclose(
+                outputs[pallas_mode].astype(jnp.float32),
+                outputs["0"].astype(jnp.float32), atol=2e-2, rtol=2e-2,
+            ))
+            results[label] = {
+                "pallas_us": round(per_mode[pallas_mode], 1),
+                "xla_us": round(per_mode["0"], 1),
+                "speedup": round(
+                    per_mode["0"] / max(per_mode[pallas_mode], 1e-9), 3),
+                "outputs_agree": agree,
+            }
+        out.update(results)
+        out["pallas_decode"] = pallas_mode
+    finally:
+        if prev is None:
+            os.environ.pop("OMNIA_PALLAS_DECODE", None)
+        else:
+            os.environ["OMNIA_PALLAS_DECODE"] = prev
+        attn._pallas_decode_mode.cache_clear()
+    return out
 
 
 def _bench_engine(cfg, ecfg, params, ttft_iters, decode_tokens, remaining):

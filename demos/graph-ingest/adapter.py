@@ -85,7 +85,12 @@ class VcrTransport:
             for i in self.interactions:
                 if (i["request"]["method"] == method
                         and self._path_of(i["request"]["url"]) == want):
-                    return i["response"]["status"], i["response"]["body"].encode()
+                    resp = i["response"]
+                    if "body_b64" in resp:  # binary content (docx/pdf)
+                        import base64
+
+                        return resp["status"], base64.b64decode(resp["body_b64"])
+                    return resp["status"], resp["body"].encode()
             raise CassetteMiss(
                 f"{method} {want} is not in cassette {self.path} "
                 "(re-record with RECORD=1)")
@@ -96,6 +101,19 @@ class VcrTransport:
                 status, body = resp.status, resp.read()
         except urllib.error.HTTPError as e:
             status, body = e.code, e.read()
+        # Text bodies stay readable in the cassette; anything that does
+        # not round-trip UTF-8 losslessly (docx/pdf item content) is
+        # stored base64 so replay is byte-accurate.
+        try:
+            text = body.decode("utf-8")
+            response = {"status": status, "body": text}
+            if text.encode() != body:
+                raise UnicodeError("lossy")
+        except (UnicodeDecodeError, UnicodeError):
+            import base64
+
+            response = {"status": status,
+                        "body_b64": base64.b64encode(body).decode()}
         self.interactions.append({
             "request": {
                 "method": method,
@@ -105,8 +123,7 @@ class VcrTransport:
                 "headers": {k: v for k, v in (headers or {}).items()
                             if k.lower() not in self.SENSITIVE_HEADERS},
             },
-            "response": {"status": status,
-                         "body": body.decode("utf-8", errors="replace")},
+            "response": response,
         })
         return status, body
 

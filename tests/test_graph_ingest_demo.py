@@ -117,6 +117,38 @@ class TestRecorder:
         assert [d.id for d in docs2] == [d.id for d in docs]
         assert [client2.fetch(d).text for d in docs2] == live
 
+    def test_binary_bodies_roundtrip_byte_accurate(self, tmp_path):
+        """Non-UTF-8 content (docx/pdf items on a real tenant) must
+        replay byte-for-byte, not as mojibake."""
+        a = _adapter()
+        blob = bytes(range(256)) * 3  # definitely not UTF-8
+
+        class H(http.server.BaseHTTPRequestHandler):
+            def do_GET(self):
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(blob)))
+                self.end_headers()
+                self.wfile.write(blob)
+
+            def log_message(self, *args):
+                pass
+
+        srv = http.server.ThreadingHTTPServer(("127.0.0.1", 0), H)
+        threading.Thread(target=srv.serve_forever, daemon=True).start()
+        try:
+            cassette = str(tmp_path / "bin.json")
+            rec = a.VcrTransport(cassette, record=True)
+            status, live = rec.request(
+                "GET", f"http://127.0.0.1:{srv.server_port}/doc.docx")
+            assert live == blob
+            rec.save()
+            replay = a.VcrTransport(cassette, record=False)
+            status2, replayed = replay.request(
+                "GET", "http://elsewhere.example/doc.docx")
+            assert (status2, replayed) == (status, blob)
+        finally:
+            srv.shutdown()
+
     def test_cassette_miss_raises(self, tmp_path):
         a = _adapter()
         cassette = str(tmp_path / "c.json")

@@ -4,6 +4,7 @@ projection, and the doctor check — an unreachable tool shows up in CRD
 status AND doctor output.
 """
 
+import time
 import socket
 import threading
 
@@ -159,10 +160,19 @@ class TestControllerIntegration:
             cm.drain_queue()
             assert store.get("default", "ToolRegistry", "tr").status["phase"] == "Ready"
             srv.close()  # backend dies
-            cm.resync()  # intervalSeconds=0 → due immediately
-            cm.join_probes()
-            status = store.get("default", "ToolRegistry", "tr").status
-            assert status["phase"] == "Failed"
+            # intervalSeconds=0 → due immediately; the controller's own
+            # background resync may have a pre-death probe in flight, so
+            # re-probe until the dead backend is observed (bounded).
+            deadline = time.time() + 10.0
+            status = {}
+            while time.time() < deadline:
+                cm.resync()
+                cm.join_probes()
+                status = store.get("default", "ToolRegistry", "tr").status
+                if status.get("phase") == "Failed":
+                    break
+                time.sleep(0.05)
+            assert status["phase"] == "Failed", status
             assert status["tools"][0]["status"] == "Unavailable"
         finally:
             cm.shutdown()
