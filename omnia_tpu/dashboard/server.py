@@ -215,14 +215,27 @@ class DashboardServer:
             probes = {
                 p.get("name"): p for p in r.status.get("tools", [])
             } if isinstance(r.status.get("tools"), list) else {}
+            from omnia_tpu.operator.toolprobe import endpoint_of
+
             for t in r.spec.get("tools", []):
                 h = t.get("handler", {})
+                htype = h.get("type", t.get("type", ""))
+                mcp_cfg = h.get("mcpConfig") or h.get("mcp") or {}
                 out.append({
                     "registry": r.name, "namespace": r.namespace,
                     "name": t.get("name", ""),
-                    "type": h.get("type", t.get("type", "")),
-                    "endpoint": h.get("url", t.get("endpoint", "")),
-                    "probe": probes.get(t.get("name"), {}).get("phase", ""),
+                    "type": htype,
+                    "endpoint": endpoint_of(t) or t.get("endpoint", ""),
+                    # per-tool probe result (controller toolprobe status)
+                    "probe": probes.get(t.get("name"), {}).get("status", ""),
+                    # The handler CONFIG never leaves the server (it can
+                    # carry auth tokens, and GET routes ride the open
+                    # CORS grant) — the Test button posts identifiers and
+                    # the server resolves the handler from the store.
+                    "testable": htype not in ("client",) and not (
+                        htype == "mcp" and (
+                            mcp_cfg.get("command")
+                            or mcp_cfg.get("transport") == "stdio")),
                 })
         return out
 
@@ -551,6 +564,41 @@ class DashboardServer:
             return self._handle_resources(method, query, body, headers)
         if path == "/api/lsp":
             return self._handle_lsp(method, body)
+        if path == "/api/tooltest":
+            # Same write-token gate as CRD mutations: a handler config is
+            # an outbound request from the operator host (and the shared
+            # helper refuses stdio MCP / code-exec shapes outright).
+            if method != "POST":
+                return self._json(405, {"error": "POST only"})
+            if self.write_token is None:
+                return self._json(403, {"error": "tool tests disabled; "
+                                                 "set OMNIA_DASHBOARD_TOKEN"})
+            if not self._bearer_is_write_token(headers):
+                return self._json(401, {"error": "missing/invalid write token"})
+            from omnia_tpu.tools.tooltest import run_tool_test
+
+            try:
+                doc = json.loads(body or b"{}")
+            except json.JSONDecodeError:
+                return self._json(400, {"error": "bad json body"})
+            if not isinstance(doc, dict):
+                return self._json(400, {"error": "body must be an object"})
+            # The console names the tool; the handler config (which can
+            # carry credentials) is resolved server-side from the CRD.
+            reg = self.store.get(doc.get("namespace") or "default",
+                                 "ToolRegistry", doc.get("registry") or "")
+            if reg is None:
+                return self._json(404, {"error": "registry not found"})
+            tool = next((t for t in reg.spec.get("tools", [])
+                         if t.get("name") == doc.get("name")), None)
+            if tool is None:
+                return self._json(404, {"error": "tool not found in registry"})
+            status, out = run_tool_test({
+                "handler": {**(tool.get("handler") or {}),
+                            "name": tool["name"]},
+                "arguments": doc.get("arguments") or {},
+            })
+            return self._json(status, out)
         if method != "GET":
             return 405, "application/json", b'{"error": "method not allowed"}'
         q = urllib.parse.parse_qs(query)

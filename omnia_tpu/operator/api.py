@@ -130,49 +130,12 @@ class OperatorAPI:
 
     def tool_test(self, body: dict) -> tuple[int, dict]:
         """Execute one tool handler config against its backend and report
-        the outcome (reference internal/tooltest/server.go:33)."""
-        from omnia_tpu.tools.executor import ToolExecutor, ToolHandler
+        the outcome (reference internal/tooltest/server.go:33). The
+        execution + hardening live in tools/tooltest.py, shared with the
+        console's /api/tooltest route."""
+        from omnia_tpu.tools.tooltest import run_tool_test
 
-        handler_doc = body.get("handler")
-        if not handler_doc or "name" not in handler_doc:
-            return 400, {"error": "handler with name required"}
-        if handler_doc.get("type") == "client":
-            return 400, {"error": "client tools execute in the browser"}
-        # Defense in depth on top of route auth: a stdio MCP config names
-        # a command to spawn — probing it from the operator process would
-        # execute arbitrary binaries on the operator host.
-        mcp_cfg = handler_doc.get("mcp") or {}
-        if handler_doc.get("type") == "mcp" and (
-            mcp_cfg.get("command") or mcp_cfg.get("transport") == "stdio"
-        ):
-            return 400, {"error": "stdio MCP handlers cannot be tool-tested "
-                                  "from the operator; use streamable-http"}
-        known = {
-            "name", "type", "description", "input_schema", "url", "method",
-            "headers", "timeout_s", "endpoint", "tls", "auth_token",
-            "auth_header", "mcp", "spec", "spec_url", "base_url",
-            "operation", "remote_name",
-        }
-        try:
-            handler = ToolHandler(
-                **{k: v for k, v in handler_doc.items() if k in known}
-            )
-        except TypeError as e:
-            return 400, {"error": str(e)}
-        # ALWAYS an ephemeral executor: registering the probe handler into
-        # the production executor would overwrite the real tool of the
-        # same name (and reset its circuit breaker) for live traffic.
-        executor = ToolExecutor([handler])
-        t0 = time.monotonic()
-        try:
-            outcome = executor.execute(handler.name, body.get("arguments", {}))
-        finally:
-            executor.close()
-        return 200, {
-            "ok": not outcome.is_error,
-            "result": outcome.content,
-            "latency_ms": round((time.monotonic() - t0) * 1000, 2),
-        }
+        return run_tool_test(body or {})
 
     # -- mgmt tokens ---------------------------------------------------
 

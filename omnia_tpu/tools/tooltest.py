@@ -1,0 +1,70 @@
+"""One-shot tool-handler test execution (reference internal/tooltest/
+server.go:33): build an EPHEMERAL executor for the posted handler
+config, run it once, report outcome + latency.
+
+Shared by the operator API (/api/v1/tooltest) and the console
+(/api/tooltest) so the hardening lives in exactly one place:
+- always an ephemeral executor — registering a probe handler into the
+  production executor would overwrite the real tool of the same name
+  (and reset its breaker) for live traffic;
+- stdio MCP configs are refused — they name a binary to spawn on the
+  serving host (remote code execution if the route were ever exposed);
+- python handlers cannot arrive via JSON (fn is not serializable) and
+  fail with a clear error instead of a crash.
+"""
+
+from __future__ import annotations
+
+import time
+
+KNOWN_FIELDS = {
+    "name", "type", "description", "input_schema", "url", "method",
+    "headers", "timeout_s", "endpoint", "tls", "auth_token",
+    "auth_header", "mcp", "spec", "spec_url", "base_url",
+    "operation", "remote_name",
+}
+
+
+def run_tool_test(body: dict) -> tuple[int, dict]:
+    from omnia_tpu.tools.executor import ToolExecutor, ToolHandler
+
+    handler_doc = body.get("handler")
+    if not isinstance(handler_doc, dict) or "name" not in handler_doc:
+        return 400, {"error": "handler object with name required"}
+    if handler_doc.get("type") == "client":
+        return 400, {"error": "client tools execute in the browser"}
+    mcp_cfg = (handler_doc.get("mcp") or handler_doc.get("mcpConfig") or {})
+    if handler_doc.get("type") == "mcp" and (
+        mcp_cfg.get("command") or (mcp_cfg.get("transport") or "") == "stdio"
+    ):
+        return 400, {"error": "stdio MCP handlers cannot be tool-tested "
+                              "from the server; use streamable-http"}
+    # Two accepted shapes: executor-field names (operator API callers)
+    # or the CRD's camelCase handler block (the console posts a tools[]
+    # entry's handler verbatim) — the deployment mapper translates.
+    crd_keys = {"grpcConfig", "mcpConfig", "openAPIConfig",
+                "timeoutSeconds", "remoteName", "specURL", "baseURL"}
+    try:
+        if crd_keys & set(handler_doc):
+            from omnia_tpu.operator.deployment import _build_tool_handlers
+
+            handler = _build_tool_handlers([
+                {"name": handler_doc["name"], "handler": handler_doc}
+            ])[0]
+        else:
+            handler = ToolHandler(
+                **{k: v for k, v in handler_doc.items() if k in KNOWN_FIELDS}
+            )
+    except TypeError as e:
+        return 400, {"error": str(e)}
+    executor = ToolExecutor([handler])
+    t0 = time.monotonic()
+    try:
+        outcome = executor.execute(handler.name, body.get("arguments", {}))
+    finally:
+        executor.close()
+    return 200, {
+        "ok": not outcome.is_error,
+        "result": outcome.content,
+        "latency_ms": round((time.monotonic() - t0) * 1000, 2),
+    }

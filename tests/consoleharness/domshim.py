@@ -146,9 +146,15 @@ class Element:
         # innerHTML (`tr.querySelector("button").onclick = ...`). The
         # shim stores innerHTML as a string, so materialize a synthetic
         # child when the markup plainly contains the tag.
+        html = js_str(self._props.get("innerHTML", ""))
         tag = sel.strip().split(".")[0].split("[")[0]
-        if tag and f"<{tag}" in js_str(self._props.get("innerHTML", "")):
+        if tag and f"<{tag}" in html:
             child = Element(tag)
+            self.children.append(child)
+            return child
+        if sel.strip().startswith(".") and sel.strip()[1:] in html:
+            child = Element("td")
+            child.className = sel.strip()[1:]
             self.children.append(child)
             return child
         return None

@@ -1,0 +1,127 @@
+"""BASELINE config 4 shape, end to end: an operator-deployed
+tool-calling agent whose ToolRegistry mixes gRPC (omnia.tools.v1
+ToolService) and MCP (stdio) handlers — the conversation loop executes
+BOTH remote transports mid-turn, driven over the live WebSocket facade.
+
+This is the staged benchmark config VERDICT r4 said the missing
+grpc/mcp dispatch blocked; with the transports landed, the whole chain
+is a test: CRDs → controller → in-process pod → WS turn → tool_call
+events → gRPC/MCP backends → final answer.
+"""
+
+import json
+import os
+import sys
+import time
+
+import pytest
+from websockets.sync.client import connect
+
+from omnia_tpu.operator.controller import ControllerManager
+from omnia_tpu.operator.resources import Resource
+from omnia_tpu.operator.store import MemoryResourceStore
+from omnia_tpu.tools.grpc_transport import GrpcToolServer
+
+MCP_FIXTURE = os.path.join(os.path.dirname(__file__), "fixtures",
+                           "mcp_stdio_server.py")
+
+PACK = {
+    "name": "support-pack",
+    "version": "1.0.0",
+    "prompts": {"system": "You are a billing support agent."},
+    "sampling": {"temperature": 0.0, "max_tokens": 128},
+}
+
+
+@pytest.fixture()
+def grpc_billing():
+    srv = GrpcToolServer({
+        "quote": (lambda a: {"refund_usd": round(a["amount"] * 0.9, 2)},
+                  "quotes a refund", None),
+    }).start()
+    yield srv
+    srv.stop()
+
+
+def _scenarios():
+    """Mock LLM that chains BOTH tools: gRPC quote, then MCP lookup,
+    then answers from their results. The mock is first-match-wins over
+    the ACCUMULATED turn view (earlier tool results stay visible), so
+    the terminal pattern comes first and each pattern keys on the
+    NEWEST marker the previous round introduced."""
+    return [
+        {"pattern": r"T-7",                # after the MCP result: answer
+         "reply": "your 90.0 refund is attached to ticket T-7"},
+        {"pattern": r"refund_usd.*90\.0",  # after the gRPC result
+         "reply": '<tool_call>{"name": "ticket_lookup", '
+                  '"arguments": {"id": "T-7"}}</tool_call>'},
+        {"pattern": ".",                   # first round: call the gRPC tool
+         "reply": '<tool_call>{"name": "refund_quote", '
+                  '"arguments": {"amount": 100}}</tool_call>'},
+    ]
+
+
+def test_config4_grpc_and_mcp_tools_through_operator(grpc_billing):
+    store = MemoryResourceStore()
+    cm = ControllerManager(store)
+    try:
+        store.apply(Resource(kind="Provider", name="mock-llm", spec={
+            "type": "mock", "role": "llm",
+            "options": {"scenarios": _scenarios()},
+        }))
+        store.apply(Resource(kind="PromptPack", name="support-pack",
+                             spec={"content": PACK}))
+        store.apply(Resource(kind="ToolRegistry", name="support-tools", spec={
+            "probe": {"enabled": False},
+            "tools": [
+                {"name": "refund_quote",
+                 "description": "quote a refund via the billing ToolService",
+                 "handler": {"type": "grpc", "remoteName": "quote",
+                             "grpcConfig": {"endpoint": grpc_billing.endpoint},
+                             "timeoutSeconds": 10}},
+                {"name": "ticket_lookup",
+                 "description": "fetch a ticket from the MCP server",
+                 "handler": {"type": "mcp", "remoteName": "echo",
+                             "mcpConfig": {"transport": "stdio",
+                                           "command": sys.executable,
+                                           "args": [MCP_FIXTURE]},
+                             "timeoutSeconds": 15}},
+            ],
+        }))
+        store.apply(Resource(kind="AgentRuntime", name="support-agent", spec={
+            "mode": "agent",
+            "promptPackRef": {"name": "support-pack"},
+            "toolRegistryRef": {"name": "support-tools"},
+            "providers": [{"name": "main",
+                           "providerRef": {"name": "mock-llm"}}],
+            "facades": [{"type": "websocket"}],
+            "replicas": 1,
+        }))
+        cm.drain_queue()
+        res = store.get("default", "AgentRuntime", "support-agent")
+        assert res.status["phase"] == "Running", res.status
+        url = res.status["endpoints"][0]["url"]
+
+        tool_calls, chunks, done = [], [], None
+        with connect(url, open_timeout=15) as ws:
+            json.loads(ws.recv(timeout=15))  # connected
+            ws.send(json.dumps({"type": "message",
+                                "content": "I want a refund on my $100 order"}))
+            deadline = time.time() + 60
+            while time.time() < deadline:
+                msg = json.loads(ws.recv(timeout=60))
+                if msg["type"] == "tool_call":
+                    tool_calls.append(msg["tool_call"]["name"])
+                elif msg["type"] == "chunk":
+                    chunks.append(msg["text"])
+                elif msg["type"] in ("done", "error"):
+                    done = msg
+                    break
+        assert done is not None and done["type"] == "done", done
+        text = "".join(chunks)
+        assert "90.0" in text and "T-7" in text, text
+        # server-side tools execute server-side: they surface as events,
+        # never as client-suspension tool_calls
+        assert tool_calls == []
+    finally:
+        cm.shutdown()

@@ -60,8 +60,14 @@ FIXTURES = {
         "functions": ["classify"], "sourceRef": "git:packs",
     }]},
     "/api/tools": {"tools": [{
-        "name": "kb_search", "registry": "support-tools", "type": "http",
+        "name": "kb_search", "registry": "support-tools",
+        "namespace": "default", "type": "http",
         "endpoint": "http://kb:8080/search", "probe": "Available",
+        "testable": True,
+    }, {
+        "name": "local_mcp", "registry": "support-tools",
+        "namespace": "default", "type": "mcp",
+        "endpoint": "stdio://", "probe": "", "testable": False,
     }]},
     "/api/workspaces": {"workspaces": [{
         "name": "team-a", "environment": "prod", "phase": "Ready",
@@ -348,6 +354,36 @@ def test_editor_view_lints_live_through_lsp(page):
     manifest = json.loads(posts[-1][1]["body"])
     assert manifest["spec"]["content"]["version"] == "1.1.0"
     assert "applied" in doc.element("#editor-state")._props["textContent"]
+
+
+def test_tools_view_test_button_posts_handler(page):
+    """The Tools view's Test button posts tool IDENTIFIERS to
+    /api/tooltest and renders the outcome; stdio MCP rows get no
+    button."""
+    interp, doc = page
+    fetch = interp.globals.get("__fetch__")
+    fetch.fixtures["/api/tooltest"] = {"ok": True, "result": "pong",
+                                       "latency_ms": 12.5}
+    from consoleharness.jsmini import _call_js, unwrap
+
+    _load(interp, "tools")
+    tbody = doc.element("#tools-table tbody")
+    http_row, mcp_row = tbody.children[0], tbody.children[1]
+    assert "<button" in http_row._props["innerHTML"]
+    btn = http_row._find("button")
+    fetch.calls.clear()
+    unwrap(_call_js(btn._props["onclick"], []))
+    posts = [c for c in fetch.calls if c[0] == "/api/tooltest"]
+    assert posts, "Test never posted"
+    body = json.loads(posts[-1][1]["body"])
+    # identifiers only — the handler config (which can carry
+    # credentials) never round-trips through the browser
+    assert body == {"registry": "support-tools", "namespace": "default",
+                    "name": "kb_search", "arguments": {}}
+    result_cell = http_row._find(".tool-test-result")
+    assert "ok · 12.5ms" in result_cell._props["textContent"]
+    # stdio MCP row renders no Test button (server refuses it anyway)
+    assert "<button" not in mcp_row._props["innerHTML"]
 
 
 def test_editor_keeps_unsaved_edits_across_view_switch(page):

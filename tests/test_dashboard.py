@@ -398,6 +398,77 @@ class TestLspBridge:
             dash.shutdown()
 
 
+class TestConsoleToolTest:
+    def test_tooltest_route_executes_and_gates(self):
+        """Console 'Test this tool' backend: write-token gated, resolves
+        the handler SERVER-SIDE from the named registry (configs can
+        carry credentials and never round-trip through the browser),
+        refuses stdio MCP (code-exec shape)."""
+        import http.server as hs
+        import threading as thr
+
+        class Echo(hs.BaseHTTPRequestHandler):
+            def do_POST(self):
+                n = int(self.headers.get("Content-Length") or 0)
+                body = self.rfile.read(n)
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *a):
+                pass
+
+        httpd = hs.ThreadingHTTPServer(("127.0.0.1", 0), Echo)
+        thr.Thread(target=httpd.serve_forever, daemon=True).start()
+        store = MemoryResourceStore()
+        store.apply(Resource(kind="ToolRegistry", name="reg", spec={
+            "probe": {"enabled": False},
+            "tools": [
+                {"name": "echo", "handler": {
+                    "type": "http",
+                    "url": f"http://127.0.0.1:{httpd.server_address[1]}/",
+                    "timeoutSeconds": 5}},
+                {"name": "local", "handler": {
+                    "type": "mcp",
+                    "mcpConfig": {"transport": "stdio", "command": "bash"}}},
+            ],
+        }))
+        dash = DashboardServer(store, write_token="wtok")
+        port = dash.serve(host="127.0.0.1", port=0)
+        try:
+            # the tools listing never exposes the handler config
+            _s, doc = _post(port, "/api/tooltest", b"{}", token="wtok")
+            status, listing = _get_auth(port, "/api/tools", "wtok")
+            assert all("handler" not in t for t in listing["tools"])
+            assert [t["testable"] for t in listing["tools"]] == [True, False]
+            payload = json.dumps({"registry": "reg", "name": "echo",
+                                  "arguments": {"q": "ping"}}).encode()
+            status, _ = _post(port, "/api/tooltest", payload, token="bad")
+            assert status == 401
+            status, doc = _post(port, "/api/tooltest", payload, token="wtok")
+            assert status == 200 and doc["ok"] and "ping" in doc["result"]
+            # stdio MCP refused even though it is in the registry
+            status, doc = _post(port, "/api/tooltest", json.dumps(
+                {"registry": "reg", "name": "local"}).encode(), token="wtok")
+            assert status == 400 and "stdio" in doc["error"]
+            # unknown tool → 404
+            status, _ = _post(port, "/api/tooltest", json.dumps(
+                {"registry": "reg", "name": "ghost"}).encode(), token="wtok")
+            assert status == 404
+        finally:
+            dash.shutdown()
+            httpd.shutdown()
+
+
+def _get_auth(port, path, token):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}",
+        headers={"Authorization": f"Bearer {token}"})
+    with urllib.request.urlopen(req, timeout=10) as r:
+        return r.status, json.loads(r.read())
+
+
 class TestSpaDom:
     """DOM-level checks on the served page: every route family has a nav
     entry + view section, and the JS actually drives the APIs."""
