@@ -48,6 +48,8 @@ import urllib.request
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
+from omnia_tpu.operator.toolprobe import endpoint_of
+
 logger = logging.getLogger(__name__)
 
 _STATIC_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)), "static")
@@ -215,27 +217,24 @@ class DashboardServer:
             probes = {
                 p.get("name"): p for p in r.status.get("tools", [])
             } if isinstance(r.status.get("tools"), list) else {}
-            from omnia_tpu.operator.toolprobe import endpoint_of
-
             for t in r.spec.get("tools", []):
                 h = t.get("handler", {})
                 htype = h.get("type", t.get("type", ""))
-                mcp_cfg = h.get("mcpConfig") or h.get("mcp") or {}
+                endpoint = endpoint_of(t) or t.get("endpoint", "")
                 out.append({
                     "registry": r.name, "namespace": r.namespace,
                     "name": t.get("name", ""),
                     "type": htype,
-                    "endpoint": endpoint_of(t) or t.get("endpoint", ""),
+                    "endpoint": endpoint,
                     # per-tool probe result (controller toolprobe status)
                     "probe": probes.get(t.get("name"), {}).get("status", ""),
                     # The handler CONFIG never leaves the server (it can
                     # carry auth tokens, and GET routes ride the open
                     # CORS grant) — the Test button posts identifiers and
                     # the server resolves the handler from the store.
-                    "testable": htype not in ("client",) and not (
-                        htype == "mcp" and (
-                            mcp_cfg.get("command")
-                            or mcp_cfg.get("transport") == "stdio")),
+                    # endpoint_of is THE stdio/client classifier; no
+                    # second copy of that predicate here.
+                    "testable": endpoint not in ("client://", "stdio://", ""),
                 })
         return out
 

@@ -1,0 +1,106 @@
+"""Self-play capture: record live fleet conversations as replayable
+scenarios.
+
+Counterpart of the reference's fleet-mode self-play collector
+(reference ee/cmd/arena-worker/selfplay_capture.go — a collector rides
+the VU's event stream, appends each agent turn, and the capture becomes
+arena source material). Here `SelfPlayCapture` wraps any runner
+(FleetRunner/DirectRunner): every turn's (user, reply, latency) lands in
+a per-session transcript, and `to_scenarios()` turns transcripts into
+EvalScenario docs — with the observed replies as `contains`-prefix
+checks — ready to feed an ArenaSource or a regression job, so today's
+live behavior becomes tomorrow's pinned eval.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from typing import Optional
+
+from omnia_tpu.evals.defs import EvalScenario
+
+
+class SelfPlayCapture:
+    """Wraps a runner's run_turn/end_session, recording transcripts."""
+
+    def __init__(self, runner, check_prefix_chars: int = 48):
+        self.runner = runner
+        self.check_prefix_chars = check_prefix_chars
+        self._transcripts: dict[str, list[dict]] = {}
+        self._lock = threading.Lock()
+
+    # -- runner interface (pass-through + record) -----------------------
+
+    def run_turn(self, provider: str, session_id: str, content: str):
+        reply, latency, tokens, cost = self.runner.run_turn(
+            provider, session_id, content
+        )
+        with self._lock:
+            self._transcripts.setdefault(session_id, []).append({
+                "provider": provider,
+                "user": content,
+                "reply": reply,
+                "latency_ms": round(latency * 1000.0, 3),
+                "tokens": tokens,
+                "at": time.time(),
+            })
+        return reply, latency, tokens, cost
+
+    def end_session(self, session_id: str) -> None:
+        ender = getattr(self.runner, "end_session", None)
+        if ender is not None:
+            ender(session_id)
+
+    # -- capture surface -------------------------------------------------
+
+    def transcripts(self) -> dict[str, list[dict]]:
+        with self._lock:
+            return {k: list(v) for k, v in self._transcripts.items()}
+
+    def to_scenarios(self, name_prefix: str = "selfplay") -> list[EvalScenario]:
+        """One scenario per captured session: the user turns replay
+        verbatim; each observed reply pins a `contains` check on its
+        leading span (the stable part — sampling may vary tails)."""
+        out = []
+        with self._lock:
+            items = sorted(self._transcripts.items())
+        for i, (sid, turns) in enumerate(items):
+            if not turns:
+                continue
+            out.append(EvalScenario.from_dict({
+                "name": f"{name_prefix}-{i}-{sid[:8]}",
+                "turns": [
+                    {
+                        "user": t["user"],
+                        "checks": [{
+                            "kind": "contains",
+                            "value": t["reply"][:self.check_prefix_chars],
+                            "name": "replay-matches-capture",
+                        }] if t["reply"] else [],
+                    }
+                    for t in turns
+                ],
+            }))
+        return out
+
+    def save(self, path: str, name_prefix: str = "selfplay") -> int:
+        """Write an ArenaSource-compatible scenario document. Returns the
+        scenario count."""
+        scenarios = self.to_scenarios(name_prefix)
+        doc = {"scenarios": [
+            {
+                "name": s.name,
+                "turns": [
+                    {"user": t.user,
+                     "checks": [{"kind": c.kind, "value": c.value,
+                                 "name": c.name} for c in t.checks]}
+                    for t in s.turns
+                ],
+            }
+            for s in scenarios
+        ]}
+        with open(path, "w", encoding="utf-8") as f:
+            json.dump(doc, f, indent=1)
+        return len(scenarios)

@@ -55,8 +55,10 @@ def run_tool_test(body: dict) -> tuple[int, dict]:
             handler = ToolHandler(
                 **{k: v for k, v in handler_doc.items() if k in KNOWN_FIELDS}
             )
-    except TypeError as e:
-        return 400, {"error": str(e)}
+    except (TypeError, AttributeError, KeyError, ValueError) as e:
+        # Malformed config blocks (null grpcConfig etc.) are caller
+        # errors, never 500s/dropped connections.
+        return 400, {"error": f"bad handler config: {e}"}
     executor = ToolExecutor([handler])
     t0 = time.monotonic()
     try:

@@ -437,8 +437,10 @@ class TestConsoleToolTest:
         dash = DashboardServer(store, write_token="wtok")
         port = dash.serve(host="127.0.0.1", port=0)
         try:
+            # empty body → registry lookup fails cleanly, not a crash
+            status, _doc = _post(port, "/api/tooltest", b"{}", token="wtok")
+            assert status == 404
             # the tools listing never exposes the handler config
-            _s, doc = _post(port, "/api/tooltest", b"{}", token="wtok")
             status, listing = _get_auth(port, "/api/tools", "wtok")
             assert all("handler" not in t for t in listing["tools"])
             assert [t["testable"] for t in listing["tools"]] == [True, False]

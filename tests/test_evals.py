@@ -456,6 +456,46 @@ class TestFleetLoad:
         assert merged.total == 20
 
 
+class TestSelfPlayCapture:
+    def test_capture_replays_as_pinned_scenarios(self, tmp_path):
+        """Fleet self-play (reference selfplay_capture.go): live turns
+        become scenarios whose checks pin the observed replies — and the
+        captured scenarios PASS when replayed against the same agent."""
+        from omnia_tpu.evals.selfplay import SelfPlayCapture
+
+        runner = DirectRunner(load_pack(PACK), _registry())
+        capture = SelfPlayCapture(runner)
+        q = ArenaQueue()
+        q.enqueue(partition(_spec(providers=("good",), repeats=2)))
+        worker = ArenaWorker(q, capture)
+        assert worker.run_until_empty() == 2
+        # transcripts recorded per session
+        ts = capture.transcripts()
+        assert len(ts) == 2
+        assert all(t[0]["reply"] for t in ts.values())
+        # captured → scenario docs with contains checks
+        path = str(tmp_path / "selfplay.json")
+        n = capture.save(path)
+        assert n == 2
+        doc = json.loads(open(path).read())
+        chk = doc["scenarios"][0]["turns"][0]["checks"][0]
+        assert chk["kind"] == "contains" and "refund" in chk["value"]
+        # replay the captured scenarios against the same agent: all pass
+        spec2 = ArenaJobSpec(
+            name="replay", providers=["good"],
+            scenarios=[EvalScenario.from_dict(s) for s in doc["scenarios"]],
+            threshold=Threshold(min_pass_rate=1.0),
+        )
+        q2 = ArenaQueue()
+        q2.enqueue(partition(spec2))
+        ArenaWorker(q2, DirectRunner(load_pack(PACK), _registry())).run_until_empty()
+        agg = Aggregator()
+        for r in q2.consume_results():
+            agg.add(r)
+        verdict = agg.evaluate(Threshold(min_pass_rate=1.0))
+        assert verdict["passed"], verdict
+
+
 class TestAtLeastOnceDedup:
     def test_duplicate_results_do_not_skew_job(self):
         ctrl = ArenaJobController()
