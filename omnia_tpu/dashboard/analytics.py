@@ -1,0 +1,88 @@
+"""Costs + quality roll-ups for the console (reference /costs and
+/quality route assemblies): proxy session-api listings, fan per-session
+detail fetches out over a small thread pool, and aggregate per agent.
+Split out of server.py purely for module-size discipline."""
+
+from __future__ import annotations
+
+import concurrent.futures
+import urllib.parse
+
+def costs(dash, workspace: str = "") -> dict:
+    """Aggregate usage + per-session cost rollup (reference /costs
+    route; cost lands on every done frame and in provider-call
+    records)."""
+    status, usage = dash._proxy_session_api(
+        "/api/v1/usage", f"workspace={workspace}" if workspace else "")
+    if status != 200:
+        return {"usage": {}, "sessions": [],
+                "error": usage.get("error", "usage unavailable")}
+    q = f"limit={dash._COST_SAMPLE}"
+    if workspace:
+        q += f"&workspace={urllib.parse.quote(workspace)}"
+    _s, listing = dash._proxy_session_api("/api/v1/sessions", q)
+
+    def roll(s):
+        sid = s.get("session_id", "")
+        _st, calls = dash._proxy_session_api(
+            f"/api/v1/sessions/{urllib.parse.quote(sid, safe='')}"
+            "/provider-calls", "")
+        pc = calls.get("provider_calls", []) if _st == 200 else []
+        return {
+            "session_id": sid,
+            "agent": s.get("agent", ""),
+            "calls": len(pc),
+            "input_tokens": sum(c.get("input_tokens", 0) for c in pc),
+            "output_tokens": sum(c.get("output_tokens", 0) for c in pc),
+            "cost_usd": round(sum(c.get("cost_usd", 0.0) for c in pc), 6),
+        }
+
+    with concurrent.futures.ThreadPoolExecutor(dash._FETCH_WORKERS) as ex:
+        rows = list(ex.map(roll, listing.get("sessions", [])))
+    rows.sort(key=lambda r: -r["cost_usd"])
+    by_agent: dict[str, dict] = {}
+    for r in rows:
+        a = by_agent.setdefault(r["agent"] or "(none)", {
+            "agent": r["agent"] or "(none)", "sessions": 0,
+            "cost_usd": 0.0, "output_tokens": 0})
+        a["sessions"] += 1
+        a["cost_usd"] = round(a["cost_usd"] + r["cost_usd"], 6)
+        a["output_tokens"] += r["output_tokens"]
+    return {"usage": usage, "sessions": rows,
+            "byAgent": sorted(by_agent.values(),
+                              key=lambda a: -a["cost_usd"])}
+
+def quality(dash) -> dict:
+    """Eval pass-rates by agent over recent sessions (reference
+    /quality route; results come from runtime-inline + eval workers)."""
+    _s, listing = dash._proxy_session_api(
+        "/api/v1/sessions", f"limit={dash._COST_SAMPLE}")
+
+    def fetch(s):
+        sid = s.get("session_id", "")
+        _st, doc = dash._proxy_session_api(
+            f"/api/v1/sessions/{urllib.parse.quote(sid, safe='')}"
+            "/eval-results", "")
+        return s, (doc.get("eval_results", []) if _st == 200 else [])
+
+    with concurrent.futures.ThreadPoolExecutor(dash._FETCH_WORKERS) as ex:
+        pairs = list(ex.map(fetch, listing.get("sessions", [])))
+    agg: dict[str, dict] = {}
+    for s, results in pairs:
+        agent = s.get("agent", "") or "(none)"
+        a = agg.setdefault(agent, {"agent": agent, "total": 0, "passed": 0,
+                                   "checks": {}})
+        for r in results:
+            a["total"] += 1
+            a["passed"] += bool(r.get("passed"))
+            c = a["checks"].setdefault(
+                r.get("eval_name") or r.get("name", "?"),
+                {"total": 0, "passed": 0})
+            c["total"] += 1
+            c["passed"] += bool(r.get("passed"))
+    for a in agg.values():
+        a["pass_rate"] = (
+            round(a["passed"] / a["total"], 4) if a["total"] else None
+        )
+    return {"agents": sorted(agg.values(), key=lambda a: a["agent"])}
+

@@ -413,82 +413,14 @@ class DashboardServer:
     _FETCH_WORKERS = 8
 
     def costs(self, workspace: str = "") -> dict:
-        """Aggregate usage + per-session cost rollup (reference /costs
-        route; cost lands on every done frame and in provider-call
-        records)."""
-        status, usage = self._proxy_session_api(
-            "/api/v1/usage", f"workspace={workspace}" if workspace else "")
-        if status != 200:
-            return {"usage": {}, "sessions": [],
-                    "error": usage.get("error", "usage unavailable")}
-        q = f"limit={self._COST_SAMPLE}"
-        if workspace:
-            q += f"&workspace={urllib.parse.quote(workspace)}"
-        _s, listing = self._proxy_session_api("/api/v1/sessions", q)
+        from omnia_tpu.dashboard.analytics import costs
 
-        def roll(s):
-            sid = s.get("session_id", "")
-            _st, calls = self._proxy_session_api(
-                f"/api/v1/sessions/{urllib.parse.quote(sid, safe='')}"
-                "/provider-calls", "")
-            pc = calls.get("provider_calls", []) if _st == 200 else []
-            return {
-                "session_id": sid,
-                "agent": s.get("agent", ""),
-                "calls": len(pc),
-                "input_tokens": sum(c.get("input_tokens", 0) for c in pc),
-                "output_tokens": sum(c.get("output_tokens", 0) for c in pc),
-                "cost_usd": round(sum(c.get("cost_usd", 0.0) for c in pc), 6),
-            }
-
-        with concurrent.futures.ThreadPoolExecutor(self._FETCH_WORKERS) as ex:
-            rows = list(ex.map(roll, listing.get("sessions", [])))
-        rows.sort(key=lambda r: -r["cost_usd"])
-        by_agent: dict[str, dict] = {}
-        for r in rows:
-            a = by_agent.setdefault(r["agent"] or "(none)", {
-                "agent": r["agent"] or "(none)", "sessions": 0,
-                "cost_usd": 0.0, "output_tokens": 0})
-            a["sessions"] += 1
-            a["cost_usd"] = round(a["cost_usd"] + r["cost_usd"], 6)
-            a["output_tokens"] += r["output_tokens"]
-        return {"usage": usage, "sessions": rows,
-                "byAgent": sorted(by_agent.values(),
-                                  key=lambda a: -a["cost_usd"])}
+        return costs(self, workspace)
 
     def quality(self) -> dict:
-        """Eval pass-rates by agent over recent sessions (reference
-        /quality route; results come from runtime-inline + eval workers)."""
-        _s, listing = self._proxy_session_api(
-            "/api/v1/sessions", f"limit={self._COST_SAMPLE}")
+        from omnia_tpu.dashboard.analytics import quality
 
-        def fetch(s):
-            sid = s.get("session_id", "")
-            _st, doc = self._proxy_session_api(
-                f"/api/v1/sessions/{urllib.parse.quote(sid, safe='')}"
-                "/eval-results", "")
-            return s, (doc.get("eval_results", []) if _st == 200 else [])
-
-        with concurrent.futures.ThreadPoolExecutor(self._FETCH_WORKERS) as ex:
-            pairs = list(ex.map(fetch, listing.get("sessions", [])))
-        agg: dict[str, dict] = {}
-        for s, results in pairs:
-            agent = s.get("agent", "") or "(none)"
-            a = agg.setdefault(agent, {"agent": agent, "total": 0, "passed": 0,
-                                       "checks": {}})
-            for r in results:
-                a["total"] += 1
-                a["passed"] += bool(r.get("passed"))
-                c = a["checks"].setdefault(
-                    r.get("eval_name") or r.get("name", "?"),
-                    {"total": 0, "passed": 0})
-                c["total"] += 1
-                c["passed"] += bool(r.get("passed"))
-        for a in agg.values():
-            a["pass_rate"] = (
-                round(a["passed"] / a["total"], 4) if a["total"] else None
-            )
-        return {"agents": sorted(agg.values(), key=lambda a: a["agent"])}
+        return quality(self)
 
     def resources(self, kind: Optional[str] = None) -> list[dict]:
         return [r.to_manifest() for r in self.store.list(kind=kind)]
@@ -562,42 +494,13 @@ class DashboardServer:
         if path == "/api/resources":
             return self._handle_resources(method, query, body, headers)
         if path == "/api/lsp":
-            return self._handle_lsp(method, body)
-        if path == "/api/tooltest":
-            # Same write-token gate as CRD mutations: a handler config is
-            # an outbound request from the operator host (and the shared
-            # helper refuses stdio MCP / code-exec shapes outright).
-            if method != "POST":
-                return self._json(405, {"error": "POST only"})
-            if self.write_token is None:
-                return self._json(403, {"error": "tool tests disabled; "
-                                                 "set OMNIA_DASHBOARD_TOKEN"})
-            if not self._bearer_is_write_token(headers):
-                return self._json(401, {"error": "missing/invalid write token"})
-            from omnia_tpu.tools.tooltest import run_tool_test
+            from omnia_tpu.dashboard.lsp_bridge import handle_lsp
 
-            try:
-                doc = json.loads(body or b"{}")
-            except json.JSONDecodeError:
-                return self._json(400, {"error": "bad json body"})
-            if not isinstance(doc, dict):
-                return self._json(400, {"error": "body must be an object"})
-            # The console names the tool; the handler config (which can
-            # carry credentials) is resolved server-side from the CRD.
-            reg = self.store.get(doc.get("namespace") or "default",
-                                 "ToolRegistry", doc.get("registry") or "")
-            if reg is None:
-                return self._json(404, {"error": "registry not found"})
-            tool = next((t for t in reg.spec.get("tools", [])
-                         if t.get("name") == doc.get("name")), None)
-            if tool is None:
-                return self._json(404, {"error": "tool not found in registry"})
-            status, out = run_tool_test({
-                "handler": {**(tool.get("handler") or {}),
-                            "name": tool["name"]},
-                "arguments": doc.get("arguments") or {},
-            })
-            return self._json(status, out)
+            return handle_lsp(method, body, self._json)
+        if path == "/api/tooltest":
+            from omnia_tpu.dashboard.tooltest_bridge import handle_tooltest
+
+            return handle_tooltest(self, method, body, headers)
         if method != "GET":
             return 405, "application/json", b'{"error": "method not allowed"}'
         q = urllib.parse.parse_qs(query)
@@ -717,11 +620,6 @@ class DashboardServer:
         return self._json(200, {
             "token": token, "expires_in_s": self.CONSOLE_TOKEN_TTL_S,
         })
-
-    def _handle_lsp(self, method: str, body):
-        from omnia_tpu.dashboard.lsp_bridge import handle_lsp
-
-        return handle_lsp(method, body, self._json)
 
     def _handle_resources(self, method: str, query: str,
                           body: Optional[bytes], headers: dict):

@@ -1,0 +1,45 @@
+"""Console "test this tool" bridge (reference internal/tooltest).
+
+The browser posts only {registry, namespace, name, arguments}; the
+handler config — which can carry credentials — is resolved server-side
+from the ToolRegistry CRD and never round-trips through the client.
+Write-token gated like CRD mutations: a tool test is an outbound request
+from the operator host (and tools/tooltest.py refuses stdio MCP shapes
+outright).
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Optional
+
+
+def handle_tooltest(dash, method: str, body: Optional[bytes], headers: dict):
+    if method != "POST":
+        return dash._json(405, {"error": "POST only"})
+    if dash.write_token is None:
+        return dash._json(403, {"error": "tool tests disabled; "
+                                         "set OMNIA_DASHBOARD_TOKEN"})
+    if not dash._bearer_is_write_token(headers):
+        return dash._json(401, {"error": "missing/invalid write token"})
+    from omnia_tpu.tools.tooltest import run_tool_test
+
+    try:
+        doc = json.loads(body or b"{}")
+    except json.JSONDecodeError:
+        return dash._json(400, {"error": "bad json body"})
+    if not isinstance(doc, dict):
+        return dash._json(400, {"error": "body must be an object"})
+    reg = dash.store.get(doc.get("namespace") or "default",
+                         "ToolRegistry", doc.get("registry") or "")
+    if reg is None:
+        return dash._json(404, {"error": "registry not found"})
+    tool = next((t for t in reg.spec.get("tools", [])
+                 if t.get("name") == doc.get("name")), None)
+    if tool is None:
+        return dash._json(404, {"error": "tool not found in registry"})
+    status, out = run_tool_test({
+        "handler": {**(tool.get("handler") or {}), "name": tool["name"]},
+        "arguments": doc.get("arguments") or {},
+    })
+    return dash._json(status, out)
