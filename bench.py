@@ -288,6 +288,52 @@ def child_main() -> None:
             _log(f"pallas A/B failed: {exc!r}")
             pallas_ab = {"error": repr(exc)}
 
+    # --- honest CPU fallback (VERDICT r5 #10) -------------------------
+    # No accelerator: a test-tiny float32 TTFT against the 400 ms TPU
+    # target is meaningless, so the fallback drops vs_baseline entirely
+    # and self-describes as overhead-only — the ENGINE's host-side costs
+    # (dispatch/sync per step, scheduler latency under load) are the
+    # only transferable numbers a CPU run produces.
+    if not on_accel:
+        sched = None
+        if remaining() > 60:
+            try:
+                sched = _bench_sched_latency(cfg, ecfg, remaining)
+                _log(f"scheduler latency done: {sched}")
+            except Exception as exc:  # noqa: BLE001 - aux evidence only
+                _log(f"scheduler latency phase failed: {exc!r}")
+                sched = {"error": repr(exc)}
+        steps = max(main_res["decode_steps"], 1)
+        dispatch_us = main_res["decode_dispatch_s"] / steps * 1e6
+        sync_us = main_res["decode_sync_s"] / steps * 1e6
+        result = {
+            "metric": (
+                f"engine dispatch overhead per decode step, {model_name} "
+                f"{ecfg.dtype}, cpu x1 (overhead-only fallback — no TPU "
+                "attached, model-perf baseline not applicable)"
+            ),
+            "value": round(dispatch_us, 1),
+            "unit": "us/step",
+            "mode": "overhead-only",
+            "aux": {
+                "platform": platform,
+                "device_kind": dev.device_kind,
+                "decode_dispatch_us_per_step": round(dispatch_us, 1),
+                "decode_sync_us_per_step": round(sync_us, 1),
+                "decode_steps": main_res["decode_steps"],
+                "decode_tok_s": round(main_res["tok_s_chip"], 1),
+                "ttft_p50_ms": round(main_res["ttft_p50_ms"], 2),
+                "warmup_s": main_res["warmup_s"],
+                "scheduler_latency_ms_p50": sched,
+                "note": (
+                    "vs_baseline intentionally omitted: CPU fallback "
+                    "certifies engine overhead, not serving performance"
+                ),
+            },
+        }
+        print(json.dumps(result))
+        return
+
     # --- roofline accounting ------------------------------------------
     kind, peak_flops, peak_bw = _chip_spec(dev.device_kind)
     n_params = cfg.num_params()
@@ -427,6 +473,43 @@ def _bench_pallas_ab(cfg, ecfg, remaining, iters: int = 50):
     return out
 
 
+def _bench_sched_latency(cfg, ecfg, remaining, depths=(4, 16, 64)):
+    """Scheduler latency under load: p50 submit→first-token per request
+    with N requests queued at once (N beyond num_slots exercises the
+    waiting queue — the scheduler's admission latency, not the model)."""
+    import gc
+
+    from omnia_tpu.engine import InferenceEngine, SamplingParams
+
+    engine = InferenceEngine(cfg, ecfg, seed=0)
+    engine.warmup(sessions=False)
+    engine.start()
+    out: dict = {}
+    try:
+        prompt = list(range(1, 9))
+        sp = SamplingParams(temperature=0.0, max_tokens=4)
+        for depth in depths:
+            if remaining() < 30:
+                out["truncated"] = f"stopped before depth {depth}: budget"
+                break
+            submits = []
+            handles = []
+            for _ in range(depth):
+                submits.append(time.monotonic())
+                handles.append(engine.submit(prompt, sp))
+            lat = []
+            for t0, h in zip(submits, handles):
+                h.collect_tokens(timeout=300)
+                if h.first_token_at is not None:
+                    lat.append((h.first_token_at - t0) * 1000.0)
+            out[f"q{depth}"] = round(statistics.median(lat), 2) if lat else None
+    finally:
+        engine.stop()
+        del engine
+        gc.collect()
+    return out
+
+
 def _bench_engine(cfg, ecfg, params, ttft_iters, decode_tokens, remaining):
     """Warm up one engine and measure TTFT + saturated decode throughput."""
     import gc
@@ -473,6 +556,7 @@ def _bench_engine(cfg, ecfg, params, ttft_iters, decode_tokens, remaining):
         # sync = waiting on device outputs, rest = host bookkeeping/idle.
         dispatch_s = engine.metrics["decode_dispatch_s"] - m0["decode_dispatch_s"]
         sync_s = engine.metrics["decode_sync_s"] - m0["decode_sync_s"]
+        decode_steps = engine.metrics["decode_steps"] - m0["decode_steps"]
 
         # --- greedy speculative phase: same engine, temperature 0 →
         # the verify path engages; tokens-per-weight-stream is the
@@ -515,6 +599,7 @@ def _bench_engine(cfg, ecfg, params, ttft_iters, decode_tokens, remaining):
         "batch_wall_s": round(wall, 2),
         "decode_dispatch_s": round(dispatch_s, 3),
         "decode_sync_s": round(sync_s, 3),
+        "decode_steps": decode_steps,
         "warmup_s": round(warmup_s, 1),
         "weight_bytes": weight_bytes,
         "greedy_spec": spec,

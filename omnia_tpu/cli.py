@@ -180,7 +180,9 @@ def _start_rotation(cipher, stores) -> None:
                      daemon=True).start()
 
 
-def _wait_forever() -> None:
+def _wait_forever_or(abort: threading.Event) -> int:
+    """Block until SIGTERM/SIGINT (→ 0) or `abort` fires (→ 1, e.g. lost
+    leader lease: the process must die rather than keep writing)."""
     stop = threading.Event()
 
     def _sig(*_a):
@@ -188,7 +190,14 @@ def _wait_forever() -> None:
 
     signal.signal(signal.SIGTERM, _sig)
     signal.signal(signal.SIGINT, _sig)
-    stop.wait()
+    while not stop.is_set():
+        if abort.wait(timeout=0.5):
+            return 1
+    return 0
+
+
+def _wait_forever() -> None:
+    _wait_forever_or(threading.Event())
 
 
 # ---------------------------------------------------------------------------
@@ -454,18 +463,93 @@ def memory_api_main() -> int:
 # ---------------------------------------------------------------------------
 
 
+def _cluster_store(args):
+    """Cluster mode: a live apiserver is the resource store (reference
+    pkg/k8s/client.go + cmd/main.go controller-manager wiring). Returns
+    (store, client, config)."""
+    from omnia_tpu.kube import KubeClient, KubeConfig, KubeResourceStore
+
+    if args.in_cluster:
+        cfg = KubeConfig.in_cluster()
+    elif args.kubeconfig:
+        cfg = KubeConfig.from_kubeconfig(args.kubeconfig)
+    else:
+        cfg = KubeConfig.from_env()
+    if args.namespace:
+        cfg.namespace = args.namespace
+    client = KubeClient(cfg)
+    return KubeResourceStore(client=client), client, cfg
+
+
+def _operator_args(argv=None):
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        prog="omnia-operator",
+        description="omnia control plane: memory | devroot | cluster mode",
+    )
+    ap.add_argument("--kubeconfig", default=_env("OMNIA_KUBECONFIG"),
+                    help="run against a live apiserver via this kubeconfig")
+    ap.add_argument("--in-cluster", action="store_true",
+                    default=_env("OMNIA_IN_CLUSTER") == "1",
+                    help="use the pod ServiceAccount (in-cluster mode)")
+    ap.add_argument("--namespace", default=_env("OMNIA_NAMESPACE"),
+                    help="leader-election/lease namespace override")
+    ap.add_argument("--leader-elect", dest="leader_elect",
+                    default=_env("OMNIA_LEADER_ELECT", "1"),
+                    help="1 (default in cluster mode) = Lease single-writer "
+                         "guard; 0 = reconcile unconditionally")
+    # Unknown args tolerated: mains may run under a test harness argv.
+    return ap.parse_known_args(argv)[0]
+
+
 def operator_main() -> int:
     """OMNIA_CONFIG_DIR (manifest devroot, watched — the reference's
-    file-backed clusterless mode), OMNIA_HTTP_PORT (operator REST +
-    dashboard), OMNIA_SESSION_API_URL."""
+    file-backed clusterless mode), --kubeconfig/--in-cluster (cluster
+    mode: live apiserver store + Lease leader election), OMNIA_HTTP_PORT
+    (operator REST + dashboard), OMNIA_SESSION_API_URL."""
     from omnia_tpu.operator.controller import ControllerManager as Controller
     from omnia_tpu.operator.store import FileResourceStore, MemoryResourceStore
 
+    args = _operator_args()
     config_dir = _env("OMNIA_CONFIG_DIR")
-    # Devroot mode (reference pkg/k8s/filebacked.go): a manifest tree IS
-    # the cluster; the controller's resync loop re-syncs it so external
-    # edits are the kubectl-apply equivalent.
-    store = FileResourceStore(config_dir) if config_dir else MemoryResourceStore()
+    kube_client = None
+    leadership_lost = threading.Event()
+    elector = None
+    if args.in_cluster or args.kubeconfig:
+        store, kube_client, kube_cfg = _cluster_store(args)
+        # Accept the usual boolean spellings — a deployment setting
+        # OMNIA_LEADER_ELECT=true must NOT silently skip the single-
+        # writer guard (that's the split-brain the lease prevents).
+        if str(args.leader_elect).strip().lower() not in (
+                "0", "false", "no", "off", ""):
+            # Single-writer guard: block reconciliation until this
+            # replica holds the Lease; losing it exits non-zero so the
+            # pod restarts as a standby (client-go leaderelection
+            # posture — never keep writing without the lease).
+            from omnia_tpu.kube.leader import LeaderElector
+
+            elector = LeaderElector(
+                kube_client, namespace=args.namespace or kube_cfg.namespace,
+                on_stopped=leadership_lost.set,
+            ).run()
+            logger.info("waiting for leader election (%s)", elector.identity)
+            while not elector.wait_for_leadership(timeout_s=60):
+                # Blocking is correct (a standby just waits its turn),
+                # but a MISCONFIGURED install waits forever — keep
+                # naming the likely cause in the logs.
+                logger.warning(
+                    "still waiting for Lease %s/omnia-operator — if this "
+                    "never resolves, check the operator's RBAC grants "
+                    "coordination.k8s.io/leases", args.namespace or
+                    kube_cfg.namespace)
+    elif config_dir:
+        # Devroot mode (reference pkg/k8s/filebacked.go): a manifest tree
+        # IS the cluster; the controller's resync loop re-syncs it so
+        # external edits are the kubectl-apply equivalent.
+        store = FileResourceStore(config_dir)
+    else:
+        store = MemoryResourceStore()
     license_manager = None
     pubkey_path = _env("OMNIA_LICENSE_PUBKEY_PATH")
     if pubkey_path:
@@ -512,11 +596,19 @@ def operator_main() -> int:
     )
     api.serve(host="0.0.0.0", port=int(_env("OMNIA_API_PORT", "8092")))
     logger.info("operator reconciling (%d resources)", len(store.list()))
-    _wait_forever()
+    rc = _wait_forever_or(leadership_lost)
+    if rc != 0:
+        logger.error("leadership lost: exiting for pod restart (standby "
+                     "takes the Lease)")
     api.shutdown()
     if dash is not None:
         dash.shutdown()
-    return 0
+    if elector is not None:
+        elector.stop()
+    close = getattr(store, "close", None)
+    if callable(close):
+        close()
+    return rc
 
 
 def compaction_main() -> int:
@@ -582,6 +674,22 @@ def doctor_main() -> int:
     ):
         if _env(env):
             doc.add_http_check(name, _env(env) + path)
+    # Observability check family (reference checks/observability.go):
+    # OTLP ingest + metric scrape targets, name=url comma-separated.
+    if _env("OMNIA_OTLP_ENDPOINT"):
+        doc.add_otlp_check(_env("OMNIA_OTLP_ENDPOINT"))
+    for entry in (_env("OMNIA_METRICS_URLS") or "").split(","):
+        name, _, url = entry.strip().partition("=")
+        if name and url:
+            doc.add_metrics_check(f"metrics-{name}", url)
+    # Cluster mode: CRD servability straight off the live apiserver,
+    # exercising the same kube client the operator runs on. The factory
+    # defers config resolution into the check itself, so a broken
+    # kubeconfig shows up as a FAIL row, not a pre-report crash.
+    if _env("OMNIA_KUBECONFIG") or _env("OMNIA_IN_CLUSTER") == "1":
+        from omnia_tpu.kube import KubeClient, KubeConfig
+
+        doc.add_apiserver_check(lambda: KubeClient(KubeConfig.from_env()))
     report = doc.run()
     print(json.dumps(report, indent=2))
     return 0 if report.get("status") == "pass" else 1

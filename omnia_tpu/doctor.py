@@ -293,6 +293,107 @@ class Doctor:
             return CheckResult("tool-registries", PASS, detail=detail)
         self.register("tool-registries", check)
 
+    # -- observability family (reference checks/observability.go) ---------
+
+    def add_otlp_check(self, endpoint: str) -> None:
+        """OTLP/HTTP ingest reachability: POST an empty resourceSpans
+        batch at /v1/traces. 2xx = the collector accepts traces; a
+        4xx from a live listener is WARN (reachable, payload quibble);
+        nothing listening = FAIL — spans are being dropped silently."""
+        base = endpoint.rstrip("/")
+
+        def check() -> CheckResult:
+            req = urllib.request.Request(
+                f"{base}/v1/traces",
+                data=json.dumps({"resourceSpans": []}).encode(),
+                headers={"Content-Type": "application/json"},
+            )
+            try:
+                with urllib.request.urlopen(req, timeout=5.0) as resp:
+                    return CheckResult("otlp", PASS,
+                                       detail=f"ingest HTTP {resp.status}")
+            except urllib.error.HTTPError as e:
+                if e.code >= 500:
+                    return CheckResult("otlp", FAIL,
+                                       detail=f"HTTP {e.code}",
+                                       remedy="check collector/Tempo logs")
+                return CheckResult(
+                    "otlp", WARN, detail=f"listener up, HTTP {e.code}",
+                    remedy="endpoint live but rejected the probe batch",
+                )
+            except (urllib.error.URLError, OSError) as e:
+                return CheckResult(
+                    "otlp", FAIL, detail=str(e),
+                    remedy=f"no OTLP listener at {base} — spans are "
+                           "being dropped",
+                )
+        self.register("otlp", check)
+
+    def add_metrics_check(self, name: str, url: str) -> None:
+        """Prometheus-format scrape reachability: the endpoint must
+        answer AND expose at least one metric line — an empty body means
+        the scrape target is up but exporting nothing."""
+        def check() -> CheckResult:
+            try:
+                with urllib.request.urlopen(url, timeout=5.0) as resp:
+                    body = resp.read(65536).decode(errors="replace")
+            except (urllib.error.URLError, OSError) as e:
+                return CheckResult(name, FAIL, detail=str(e),
+                                   remedy=f"is the exporter at {url} up?")
+            lines = [ln for ln in body.splitlines()
+                     if ln and not ln.startswith("#")]
+            if not lines:
+                return CheckResult(name, WARN, detail="scrape empty",
+                                   remedy="exporter up but no series yet")
+            return CheckResult(name, PASS, detail=f"{len(lines)} series")
+        self.register(name, check)
+
+    def add_apiserver_check(self, client, expect_kinds: Optional[tuple] = None) -> None:
+        """Cluster-mode CRD inventory: every omnia kind must be servable
+        by the live apiserver through the kube client (the cluster twin
+        of add_crd_presence_check, which probes the operator REST).
+        `client` may be a KubeClient or a zero-arg factory — a factory
+        defers config resolution into the check, so a broken kubeconfig
+        becomes a FAIL row in the report instead of a pre-report crash."""
+        def check() -> CheckResult:
+            from omnia_tpu.kube.client import ApiError, KubeClient, NotFound
+            from omnia_tpu.operator.crds import KINDS
+
+            try:
+                c = client() if not isinstance(client, KubeClient) else client
+                ver = c.server_version().get("gitVersion", "?")
+            except Exception as e:  # noqa: BLE001 — unreachable/bad
+                # config = FAIL row, never a crash
+                return CheckResult("apiserver", FAIL, detail=str(e),
+                                   remedy="check kubeconfig / cluster DNS")
+            kinds = expect_kinds or tuple(KINDS)
+            counts, missing, errors = [], [], []
+            for kind in kinds:
+                try:
+                    n = len(c.list(kind).get("items") or [])
+                    if n:
+                        counts.append(f"{kind}={n}")
+                except NotFound:
+                    missing.append(kind)
+                except ApiError as e:
+                    errors.append(f"{kind}: {e}")
+            if errors:
+                return CheckResult("apiserver", FAIL,
+                                   detail="; ".join(errors[:4]),
+                                   remedy="check apiserver/RBAC")
+            if missing:
+                return CheckResult(
+                    "apiserver", FAIL,
+                    detail=f"CRDs not installed: {', '.join(missing)}",
+                    remedy="kubectl apply the deploy/crds bundle",
+                )
+            return CheckResult(
+                "apiserver", PASS,
+                detail=f"{ver}: {len(kinds)} kinds servable"
+                + (f" ({', '.join(counts)})" if counts else ""),
+            )
+        self.register("apiserver", check)
+
     def add_streams_check(self, stream) -> None:
         def check() -> CheckResult:
             probe_group = "doctor-probe"

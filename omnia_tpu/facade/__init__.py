@@ -1,18 +1,30 @@
 """Facade plane: the agent's client-facing surfaces (reference
 cmd/agent + internal/facade) — WebSocket chat, REST/function-mode,
 MCP tools, and the A2A agent-to-agent protocol, sharing one auth chain
-and one runtime gRPC backend."""
+and one runtime gRPC backend.
 
-from omnia_tpu.facade.a2a import A2aFacade, TaskStore
-from omnia_tpu.facade.mcp import McpFacade
-from omnia_tpu.facade.rest import JsonHttpFacade, RestFacade
-from omnia_tpu.facade.server import FacadeServer
+Submodules load lazily (PEP 562): `facade.auth`/`facade.oidc` are pure
+stdlib+crypto and are imported by the operator/API planes, while
+`facade.server` needs the `websockets` package — an eager import here
+would make the whole control plane unbootable on hosts without it.
+"""
 
-__all__ = [
-    "A2aFacade",
-    "TaskStore",
-    "McpFacade",
-    "JsonHttpFacade",
-    "RestFacade",
-    "FacadeServer",
-]
+_EXPORTS = {
+    "A2aFacade": "omnia_tpu.facade.a2a",
+    "TaskStore": "omnia_tpu.facade.a2a",
+    "McpFacade": "omnia_tpu.facade.mcp",
+    "JsonHttpFacade": "omnia_tpu.facade.rest",
+    "RestFacade": "omnia_tpu.facade.rest",
+    "FacadeServer": "omnia_tpu.facade.server",
+}
+
+__all__ = list(_EXPORTS)
+
+
+def __getattr__(name: str):
+    mod = _EXPORTS.get(name)
+    if mod is None:
+        raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+    import importlib
+
+    return getattr(importlib.import_module(mod), name)

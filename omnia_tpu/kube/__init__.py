@@ -1,0 +1,41 @@
+"""Kubernetes control-plane integration: the cluster-mode operator.
+
+Reference parity: pkg/k8s/client.go (real apiserver client) +
+cmd/main.go:400-535 (controller-manager wiring). Four layers, each
+independently testable:
+
+- `config`  — kubeconfig / in-cluster ServiceAccount auth resolution.
+- `client`  — stdlib-HTTP JSON client for any group/version/kind,
+  including chunked watch streams.
+- `watch`   — Reflector: list+watch with resourceVersion resume,
+  bookmark handling, relist-on-410, exponential backoff with jitter.
+- `store`   — KubeResourceStore: the ResourceStore drop-in that makes a
+  live apiserver the operator's backing store (third backend beside
+  Memory/File).
+- `apiserver` — the in-tree shim (the redis/server.py pattern): a real
+  HTTP apiserver with resourceVersion bookkeeping, 409/410 semantics and
+  CRD OpenAPI validation, so the SAME controller suite runs clusterless.
+- `leader`  — Lease-based leader election (single-writer guard).
+"""
+
+from omnia_tpu.kube.client import (
+    ApiError,
+    Conflict,
+    Gone,
+    KubeClient,
+    NotFound,
+    Unprocessable,
+)
+from omnia_tpu.kube.config import KubeConfig
+from omnia_tpu.kube.store import KubeResourceStore
+
+__all__ = [
+    "ApiError",
+    "Conflict",
+    "Gone",
+    "KubeClient",
+    "KubeConfig",
+    "KubeResourceStore",
+    "NotFound",
+    "Unprocessable",
+]

@@ -1,0 +1,623 @@
+"""In-tree Kubernetes apiserver shim (the redis/server.py pattern).
+
+A real HTTP server speaking the apiserver's JSON wire protocol over the
+subset the operator uses, so the SAME controller/store test suite runs
+against Memory, File, and Kube backends with zero external infra — the
+envtest stand-in this environment can't run:
+
+- collection + named-object CRUD for builtin kinds (everything
+  install.py renders, plus Lease/HTTPRoute) and for any kind whose
+  CustomResourceDefinition is POSTed first (CRD registration is live,
+  like a real apiserver).
+- resourceVersion bookkeeping: one monotonic counter, rv stamped on
+  every write, list metadata.resourceVersion, PUT requires the current
+  rv (409 Conflict on stale), watch resume from any retained rv and
+  **410 Gone** once the bounded event history has evicted it.
+- status subresource (PUT .../status): status-only write, generation
+  NOT bumped; main-resource PUT bumps generation on spec change and
+  preserves status (subresource discipline).
+- watch streams: line-delimited JSON events (ADDED/MODIFIED/DELETED),
+  replay-from-rv, periodic BOOKMARK frames, ERROR frame carrying the 410.
+- validation chain, fail-closed: structural lint for builtin kinds
+  (manifest_lint — the dry-run gate), strict OpenAPI schema validation
+  for CRD-registered kinds (unknown fields rejected unless the schema
+  preserves them), and the operator's admission validators for the
+  omnia group + HTTPRoute (the webhook-chain parity) → HTTP 422.
+
+Fault-injection hooks for tests: `drop_watches()` severs live watch
+streams; `stop()`/`start()` flaps the server while keeping state, so
+reflector backoff-resume is testable.
+"""
+
+from __future__ import annotations
+
+import copy
+import json
+import logging
+import threading
+import time
+import urllib.parse
+import uuid
+from collections import deque
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from omnia_tpu.kube.client import KIND_ROUTES
+from omnia_tpu.kube.config import KubeConfig
+
+logger = logging.getLogger(__name__)
+
+
+class _Rejected(Exception):
+    def __init__(self, status: int, message: str):
+        self.status = status
+        self.message = message
+        super().__init__(message)
+
+
+def _status_doc(code: int, message: str, reason: str = "") -> dict:
+    return {
+        "kind": "Status", "apiVersion": "v1", "status": "Failure",
+        "code": code, "message": message, "reason": reason,
+    }
+
+
+# -- schema translation ------------------------------------------------------
+
+
+def openapi_to_jsonschema(schema: dict) -> dict:
+    """CRD openAPIV3Schema → strict jsonschema: objects that declare
+    properties reject unknown fields unless x-kubernetes-preserve-
+    unknown-fields marks them open; bare `type: object` (metadata) stays
+    permissive. This is the envtest-grade strictness the repo's lint
+    can't provide: a typo'd spec key fails the apply, not the rollout."""
+    if not isinstance(schema, dict):
+        return {}
+    out: dict = {}
+    t = schema.get("type")
+    if t:
+        out["type"] = t
+    for key in ("enum", "required", "minimum", "maximum", "minLength",
+                "maxLength", "pattern"):
+        if key in schema:
+            out[key] = schema[key]
+    if t == "object":
+        props = schema.get("properties")
+        preserve = schema.get("x-kubernetes-preserve-unknown-fields", False)
+        if props:
+            out["properties"] = {
+                k: openapi_to_jsonschema(v) for k, v in props.items()
+            }
+            if not preserve:
+                out["additionalProperties"] = False
+    elif t == "array" and "items" in schema:
+        out["items"] = openapi_to_jsonschema(schema["items"])
+    return out
+
+
+# -- storage -----------------------------------------------------------------
+
+
+class _State:
+    """Keyspace + event history; survives server flaps (the HTTP server
+    holds a reference, never owns it)."""
+
+    def __init__(self, max_history: int = 512):
+        self.lock = threading.Lock()
+        self.cond = threading.Condition(self.lock)
+        self.rv = 0
+        # (prefix, plural) -> {(ns, name): object}
+        self.objects: dict[tuple[str, str], dict[tuple[str, str], dict]] = {}
+        # registry: (prefix, plural) -> {kind, namespaced, schema, group,
+        #                                has_status}
+        self.registry: dict[tuple[str, str], dict] = {}
+        self.events: deque = deque()
+        self.max_history = max_history
+        self.evicted_through = 0
+        for kind, (prefix, plural, namespaced) in KIND_ROUTES.items():
+            group = prefix.split("/")[1] if prefix.startswith("apis/") else ""
+            if group == "omnia.tpu":
+                continue  # omnia kinds register via their CRDs, like a real cluster
+            self.registry[(prefix, plural)] = {
+                "kind": kind, "namespaced": namespaced, "schema": None,
+                "group": group, "has_status": False,
+            }
+
+    # call with lock held ----------------------------------------------
+
+    def bump(self) -> int:
+        self.rv += 1
+        return self.rv
+
+    def record_event(self, etype: str, prefix: str, plural: str,
+                     ns: str, obj: dict) -> None:
+        self.events.append({
+            "rv": int(obj["metadata"]["resourceVersion"]),
+            "type": etype, "prefix": prefix, "plural": plural, "ns": ns,
+            "object": copy.deepcopy(obj),
+        })
+        while len(self.events) > self.max_history:
+            self.evicted_through = self.events.popleft()["rv"]
+        self.cond.notify_all()
+
+    def register_crd(self, crd: dict) -> None:
+        spec = crd.get("spec") or {}
+        group = spec.get("group", "")
+        names = spec.get("names") or {}
+        for v in spec.get("versions") or []:
+            if not v.get("served", True):
+                continue
+            prefix = f"apis/{group}/{v['name']}"
+            schema = ((v.get("schema") or {}).get("openAPIV3Schema")) or None
+            self.registry[(prefix, names.get("plural", ""))] = {
+                "kind": names.get("kind", ""),
+                "namespaced": spec.get("scope", "Namespaced") == "Namespaced",
+                "schema": schema,
+                "group": group,
+                "has_status": "status" in (v.get("subresources") or {}),
+            }
+
+
+class ApiServerShim:
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 max_history: int = 512, bookmark_interval_s: float = 0.5,
+                 register_omnia_crds: bool = False):
+        self._host, self._port = host, port
+        self.state = _State(max_history=max_history)
+        self.bookmark_interval_s = bookmark_interval_s
+        self._register_omnia = register_omnia_crds
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+        self._watch_conns: set = set()
+        self._conns_lock = threading.Lock()
+        # Fault injection: while True, watch requests are load-shed with
+        # 503 (the apiserver-under-pressure failure mode) — combined with
+        # drop_watches() this holds clients off long enough for history
+        # eviction, making the 410 path deterministic in tests.
+        self.reject_watches = False
+        self.stats = {"lists": 0, "watches": 0, "gone": 0, "writes": 0}
+
+    # -- lifecycle -----------------------------------------------------
+
+    def start(self) -> "ApiServerShim":
+        shim = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.0"
+
+            def log_message(self, *a):  # pragma: no cover
+                pass
+
+            def do_GET(self):
+                shim._dispatch(self, "GET")
+
+            def do_POST(self):
+                shim._dispatch(self, "POST")
+
+            def do_PUT(self):
+                shim._dispatch(self, "PUT")
+
+            def do_DELETE(self):
+                shim._dispatch(self, "DELETE")
+
+        class Server(ThreadingHTTPServer):
+            allow_reuse_address = True
+            daemon_threads = True
+            # A store opens one watch per kind CONCURRENTLY; the stdlib
+            # default backlog of 5 makes the 18th connect eat a 1s SYN
+            # retransmit.
+            request_queue_size = 128
+
+        self._httpd = Server((self._host, self._port), Handler)
+        self._port = self._httpd.server_address[1]
+        self._thread = threading.Thread(
+            # Small poll_interval: shutdown() blocks one poll tick.
+            target=lambda: self._httpd.serve_forever(poll_interval=0.05),
+            name="omnia-apiserver-shim",
+            daemon=True,
+        )
+        self._thread.start()
+        if self._register_omnia:
+            self._register_omnia = False  # once, even across flaps
+            from omnia_tpu.operator.crds import render_crds
+
+            for crd in render_crds():
+                self.handle("POST", _path_of(crd), crd)
+        return self
+
+    def stop(self) -> None:
+        """Stop serving (state is retained — start() again to flap)."""
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None
+        self.drop_watches()
+
+    def drop_watches(self) -> None:
+        """Sever every live watch stream (fault injection: clients must
+        resume from their last resourceVersion)."""
+        import socket as _socket
+
+        with self._conns_lock:
+            conns, self._watch_conns = list(self._watch_conns), set()
+        for c in conns:
+            try:
+                c.shutdown(_socket.SHUT_RDWR)
+            except OSError:
+                pass  # already closed
+        with self.state.lock:
+            self.state.cond.notify_all()
+
+    @property
+    def url(self) -> str:
+        return f"http://{self._host}:{self._port}"
+
+    def local_config(self, namespace: str = "default") -> KubeConfig:
+        return KubeConfig(host=self.url, namespace=namespace)
+
+    # -- request plumbing ----------------------------------------------
+
+    def _dispatch(self, handler: BaseHTTPRequestHandler, method: str) -> None:
+        split = urllib.parse.urlsplit(handler.path)
+        query = {k: v[0] for k, v in urllib.parse.parse_qs(split.query).items()}
+        body = None
+        length = int(handler.headers.get("Content-Length") or 0)
+        if length:
+            try:
+                body = json.loads(handler.rfile.read(length))
+            except json.JSONDecodeError:
+                _reply(handler, 400, _status_doc(400, "bad json"))
+                return
+        if method == "GET" and query.get("watch") == "true":
+            self._serve_watch(handler, split.path, query)
+            return
+        status, doc = self.handle(method, split.path, body, query)
+        _reply(handler, status, doc)
+
+    def handle(self, method: str, path: str, body: Optional[dict] = None,
+               query: Optional[dict] = None) -> tuple[int, dict]:
+        """Route one non-watch request (also the in-process entry the
+        guard tests use)."""
+        if path == "/version":
+            return 200, {"major": "1", "minor": "30",
+                         "gitVersion": "v1.30.0-omnia-shim"}
+        if path in ("/healthz", "/readyz", "/livez"):
+            return 200, {"status": "ok"}
+        if body is not None and not isinstance(body, dict):
+            return 400, _status_doc(400, "body must be a JSON object")
+        try:
+            route = self._parse_path(path)
+        except _Rejected as e:
+            return e.status, _status_doc(e.status, e.message)
+        try:
+            if method == "GET" and route["name"]:
+                return self._get(route)
+            if method == "GET":
+                return self._list(route)
+            if method == "POST" and not route["name"]:
+                return self._create(route, body)
+            if method == "PUT" and route["name"]:
+                return self._replace(route, body)
+            if method == "DELETE" and route["name"]:
+                return self._delete(route)
+        except _Rejected as e:
+            return e.status, _status_doc(e.status, e.message)
+        return 405, _status_doc(405, f"method {method} not supported on {path}")
+
+    def _parse_path(self, path: str) -> dict:
+        segs = [s for s in path.strip("/").split("/") if s]
+        if segs[:1] == ["api"] and len(segs) >= 2:
+            prefix, rest = "api/v1", segs[2:]
+        elif segs[:1] == ["apis"] and len(segs) >= 3:
+            prefix, rest = "/".join(segs[:3]), segs[3:]
+        else:
+            raise _Rejected(404, f"unrecognized path {path!r}")
+        ns = None
+        # /api/v1/namespaces and /api/v1/namespaces/{name} address the
+        # Namespace resource itself; three+ segments address a namespaced
+        # collection within.
+        if rest[:1] == ["namespaces"] and len(rest) >= 3:
+            ns, rest = rest[1], rest[2:]
+        if not rest:
+            raise _Rejected(404, f"no resource in path {path!r}")
+        plural, name, sub = rest[0], None, ""
+        if len(rest) >= 2:
+            name = rest[1]
+        if len(rest) >= 3:
+            sub = rest[2]
+            if sub != "status":
+                raise _Rejected(404, f"unknown subresource {sub!r}")
+        reg = self.state.registry.get((prefix, plural))
+        if reg is None:
+            raise _Rejected(
+                404, f"the server could not find the requested resource "
+                     f"({prefix}/{plural})"
+            )
+        if reg["namespaced"] and ns is None and name is not None:
+            raise _Rejected(404, f"{reg['kind']} is namespaced; name lookups "
+                                 "need a namespace path")
+        return {"prefix": prefix, "plural": plural, "ns": ns, "name": name,
+                "sub": sub, "reg": reg}
+
+    # -- handlers ------------------------------------------------------
+
+    def _bucket(self, route) -> dict:
+        return self.state.objects.setdefault(
+            (route["prefix"], route["plural"]), {}
+        )
+
+    def _get(self, route) -> tuple[int, dict]:
+        with self.state.lock:
+            obj = self._bucket(route).get((route["ns"] or "", route["name"]))
+            if obj is None:
+                raise _Rejected(404, f"{route['plural']} "
+                                     f"{route['name']!r} not found")
+            return 200, copy.deepcopy(obj)
+
+    def _list(self, route) -> tuple[int, dict]:
+        self.stats["lists"] += 1
+        with self.state.lock:
+            items = [
+                copy.deepcopy(o)
+                for (ns, _n), o in sorted(self._bucket(route).items())
+                if route["ns"] is None or ns == route["ns"]
+            ]
+            rv = self.state.rv
+        return 200, {
+            "apiVersion": "v1", "kind": f"{route['reg']['kind']}List",
+            "metadata": {"resourceVersion": str(rv)}, "items": items,
+        }
+
+    def _validate(self, route, obj: dict) -> None:
+        reg = route["reg"]
+        if reg["schema"] is not None:
+            import jsonschema
+
+            # Compile once per registered schema — jsonschema.validate()
+            # rebuilds the validator on every call, a per-request tax on
+            # the write path.
+            validator = reg.get("_validator")
+            if validator is None:
+                validator = jsonschema.Draft202012Validator(
+                    openapi_to_jsonschema(reg["schema"]))
+                reg["_validator"] = validator
+            err = jsonschema.exceptions.best_match(validator.iter_errors(obj))
+            if err is not None:
+                path = ".".join(str(p) for p in err.absolute_path) or "(root)"
+                raise _Rejected(422, f"schema: {path}: {err.message}")
+        else:
+            from omnia_tpu.operator.manifest_lint import lint
+
+            errs = lint([obj])
+            if errs:
+                raise _Rejected(422, "; ".join(errs))
+        # Admission chain (webhook parity): omnia kinds + HTTPRoute run
+        # the same fail-closed validators the in-process stores use.
+        if reg["group"] == "omnia.tpu" or reg["kind"] == "HTTPRoute":
+            from omnia_tpu.operator.resources import Resource
+            from omnia_tpu.operator.validation import ValidationError, validate
+
+            try:
+                validate(Resource.from_manifest(obj))
+            except ValidationError as e:
+                raise _Rejected(422, f"admission: {e}") from None
+            except ValueError as e:
+                raise _Rejected(422, f"admission: {e}") from None
+
+    def _create(self, route, body: Optional[dict]) -> tuple[int, dict]:
+        if not body:
+            raise _Rejected(400, "empty body")
+        md = body.setdefault("metadata", {})
+        name = md.get("name")
+        if not name:
+            raise _Rejected(422, "metadata.name required")
+        if route["reg"]["namespaced"]:
+            ns = route["ns"] or md.get("namespace") or "default"
+            md["namespace"] = ns
+        else:
+            ns = ""
+            md.pop("namespace", None)
+        self._validate(route, body)
+        key = (ns, name)
+        with self.state.lock:
+            bucket = self._bucket(route)
+            if key in bucket:
+                raise _Rejected(409, f"{route['plural']} {name!r} already exists")
+            obj = copy.deepcopy(body)
+            omd = obj["metadata"]
+            omd["uid"] = str(uuid.uuid4())
+            omd["generation"] = 1
+            omd["creationTimestamp"] = omd.get("creationTimestamp") or time.time()
+            omd["resourceVersion"] = str(self.state.bump())
+            bucket[key] = obj
+            self.stats["writes"] += 1
+            self.state.record_event(
+                "ADDED", route["prefix"], route["plural"], ns, obj)
+            if route["reg"]["kind"] == "CustomResourceDefinition":
+                self.state.register_crd(obj)
+            return 201, copy.deepcopy(obj)
+
+    def _replace(self, route, body: Optional[dict]) -> tuple[int, dict]:
+        if not body:
+            raise _Rejected(400, "empty body")
+        ns = route["ns"] or ""
+        key = (ns, route["name"])
+        is_status = route["sub"] == "status"
+        if not is_status:
+            self._validate(route, body)
+        with self.state.lock:
+            bucket = self._bucket(route)
+            cur = bucket.get(key)
+            if cur is None:
+                raise _Rejected(404, f"{route['plural']} "
+                                     f"{route['name']!r} not found")
+            sent_rv = (body.get("metadata") or {}).get("resourceVersion")
+            if not sent_rv:
+                raise _Rejected(
+                    409, "metadata.resourceVersion must be specified "
+                         "for an update")
+            if str(sent_rv) != cur["metadata"]["resourceVersion"]:
+                raise _Rejected(
+                    409, f"operation cannot be fulfilled: object modified "
+                         f"(have {cur['metadata']['resourceVersion']}, "
+                         f"got {sent_rv})")
+            obj = copy.deepcopy(cur)
+            if is_status:
+                # Status subresource: status only, generation untouched.
+                obj["status"] = copy.deepcopy(body.get("status") or {})
+            else:
+                new = copy.deepcopy(body)
+                # apiserver-owned metadata wins over whatever was sent.
+                new["metadata"] = {
+                    **new.get("metadata", {}),
+                    "uid": cur["metadata"]["uid"],
+                    "creationTimestamp": cur["metadata"]["creationTimestamp"],
+                    "generation": cur["metadata"]["generation"],
+                }
+                # subresource discipline: the main resource PUT cannot
+                # write status — only PUT .../status can.
+                new["status"] = copy.deepcopy(cur.get("status") or {})
+                if new.get("spec") != cur.get("spec"):
+                    new["metadata"]["generation"] = (
+                        cur["metadata"]["generation"] + 1)
+                obj = new
+            obj["metadata"]["resourceVersion"] = str(self.state.bump())
+            bucket[key] = obj
+            self.stats["writes"] += 1
+            self.state.record_event(
+                "MODIFIED", route["prefix"], route["plural"], ns, obj)
+            if route["reg"]["kind"] == "CustomResourceDefinition":
+                self.state.register_crd(obj)
+            return 200, copy.deepcopy(obj)
+
+    def _delete(self, route) -> tuple[int, dict]:
+        ns = route["ns"] or ""
+        with self.state.lock:
+            bucket = self._bucket(route)
+            obj = bucket.pop((ns, route["name"]), None)
+            if obj is None:
+                raise _Rejected(404, f"{route['plural']} "
+                                     f"{route['name']!r} not found")
+            # Deletion is itself a versioned write: the DELETED event (and
+            # the returned final object) carry the deletion rv so watchers
+            # resuming later can dedupe it.
+            obj = copy.deepcopy(obj)
+            obj["metadata"]["resourceVersion"] = str(self.state.bump())
+            self.stats["writes"] += 1
+            self.state.record_event(
+                "DELETED", route["prefix"], route["plural"], ns, obj)
+            return 200, obj
+
+    # -- watch ---------------------------------------------------------
+
+    def _serve_watch(self, handler, path: str, query: dict) -> None:
+        try:
+            route = self._parse_path(path)
+        except _Rejected as e:
+            _reply(handler, e.status, _status_doc(e.status, e.message))
+            return
+        if self.reject_watches:
+            _reply(handler, 503, _status_doc(503, "watch load-shed"))
+            return
+        self.stats["watches"] += 1
+        bookmarks = query.get("allowWatchBookmarks") == "true"
+        try:
+            since = int(query.get("resourceVersion") or 0)
+        except ValueError:
+            since = 0
+        try:
+            # Honor the client's requested watch lifetime (clean close at
+            # timeoutSeconds, the apiserver contract clients resume from).
+            lifetime = float(query.get("timeoutSeconds") or 0) or None
+        except ValueError:
+            lifetime = None
+        with self._conns_lock:
+            self._watch_conns.add(handler.connection)
+        handler.send_response(200)
+        handler.send_header("Content-Type", "application/json")
+        handler.send_header("Transfer-Encoding", "identity")
+        handler.end_headers()
+
+        def send(frame: dict) -> bool:
+            try:
+                handler.wfile.write(json.dumps(frame).encode() + b"\n")
+                handler.wfile.flush()
+                return True
+            except OSError:
+                return False
+
+        try:
+            self._stream_events(route, since, bookmarks, send, lifetime)
+        finally:
+            with self._conns_lock:
+                self._watch_conns.discard(handler.connection)
+
+    def _stream_events(self, route, since: int, bookmarks: bool, send,
+                       lifetime_s: Optional[float] = None) -> None:
+        st = self.state
+        deadline = (time.monotonic() + lifetime_s) if lifetime_s else None
+        with st.lock:
+            if since and since < st.evicted_through:
+                self.stats["gone"] += 1
+                send({"type": "ERROR", "object": _status_doc(
+                    410, "too old resource version: history evicted",
+                    reason="Expired")})
+                return
+            cursor = since or st.rv
+        last_sent = cursor
+        while self._httpd is not None:
+            if deadline is not None and time.monotonic() >= deadline:
+                return  # clean close; the client resumes from its rv
+            batch: list[dict] = []
+            with st.lock:
+                for ev in st.events:
+                    if ev["rv"] <= last_sent:
+                        continue
+                    if (ev["prefix"], ev["plural"]) != (
+                            route["prefix"], route["plural"]):
+                        continue
+                    if route["ns"] is not None and ev["ns"] != route["ns"]:
+                        continue
+                    batch.append(ev)
+                if not batch:
+                    # Safe resume point: every event <= this rv was just
+                    # scanned and none matched. It must be captured
+                    # BEFORE the wait — the write that wakes us appends
+                    # an event *newer* than it, and bookmarking past
+                    # that event would silently swallow it.
+                    safe_rv = st.rv
+                    st.cond.wait(timeout=self.bookmark_interval_s)
+            if batch:
+                for ev in batch:
+                    if not send({"type": ev["type"],
+                                 "object": copy.deepcopy(ev["object"])}):
+                        return
+                    last_sent = ev["rv"]
+            else:
+                # Idle: bookmark advances the client's resume point past
+                # history eviction without delivering anything.
+                last_sent = max(last_sent, safe_rv)
+                if bookmarks and not send({"type": "BOOKMARK", "object": {
+                    "kind": route["reg"]["kind"],
+                    "metadata": {"resourceVersion": str(last_sent)},
+                }}):
+                    return
+
+
+def _reply(handler, status: int, doc: dict) -> None:
+    payload = json.dumps(doc).encode()
+    try:
+        handler.send_response(status)
+        handler.send_header("Content-Type", "application/json")
+        handler.send_header("Content-Length", str(len(payload)))
+        handler.end_headers()
+        handler.wfile.write(payload)
+    except OSError:
+        pass  # client went away mid-reply
+
+
+def _path_of(obj: dict) -> str:
+    from omnia_tpu.kube.client import collection_path
+
+    ns = (obj.get("metadata") or {}).get("namespace")
+    return collection_path(obj["kind"], ns)

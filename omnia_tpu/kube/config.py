@@ -1,0 +1,179 @@
+"""Cluster connection + auth resolution.
+
+Two auth modes, mirroring the reference's client bootstrap
+(pkg/k8s/client.go: rest.InClusterConfig falling back to kubeconfig):
+
+- kubeconfig: $KUBECONFIG / ~/.kube/config, current-context → cluster
+  server + CA, user bearer token or client cert/key. Inline *-data
+  fields are materialized to temp files so the ssl module can load them.
+- in-cluster: the pod ServiceAccount mount
+  (/var/run/secrets/kubernetes.io/serviceaccount) + KUBERNETES_SERVICE_
+  HOST/PORT. The token file is re-read on every request upstream of here
+  (projected SA tokens rotate), so KubeConfig keeps the *path*.
+"""
+
+from __future__ import annotations
+
+import base64
+import os
+import tempfile
+from dataclasses import dataclass, field
+from typing import Optional
+
+SA_DIR = "/var/run/secrets/kubernetes.io/serviceaccount"
+
+
+class KubeConfigError(ValueError):
+    """Connection config missing or unusable."""
+
+
+@dataclass
+class KubeConfig:
+    host: str                                # e.g. https://10.0.0.1:6443
+    token: Optional[str] = None              # static bearer token
+    token_file: Optional[str] = None         # re-read per request (SA rotation)
+    ca_file: Optional[str] = None
+    client_cert_file: Optional[str] = None
+    client_key_file: Optional[str] = None
+    verify_tls: bool = True
+    namespace: str = "default"
+    # Files this config materialized (inline cert data); owned for cleanup.
+    _owned_files: list = field(default_factory=list, repr=False)
+
+    def bearer_token(self) -> Optional[str]:
+        if self.token_file:
+            try:
+                with open(self.token_file, encoding="utf-8") as f:
+                    return f.read().strip()
+            except OSError as e:
+                raise KubeConfigError(f"token file unreadable: {e}") from e
+        return self.token
+
+    # -- loaders -------------------------------------------------------
+
+    @classmethod
+    def in_cluster(cls, sa_dir: str = SA_DIR) -> "KubeConfig":
+        host = os.environ.get("KUBERNETES_SERVICE_HOST")
+        port = os.environ.get("KUBERNETES_SERVICE_PORT", "443")
+        if not host:
+            raise KubeConfigError(
+                "KUBERNETES_SERVICE_HOST unset: not running in a cluster"
+            )
+        token_file = os.path.join(sa_dir, "token")
+        if not os.path.exists(token_file):
+            raise KubeConfigError(f"serviceaccount token missing at {token_file}")
+        ns = "default"
+        ns_file = os.path.join(sa_dir, "namespace")
+        if os.path.exists(ns_file):
+            with open(ns_file, encoding="utf-8") as f:
+                ns = f.read().strip() or "default"
+        ca = os.path.join(sa_dir, "ca.crt")
+        return cls(
+            host=f"https://{host}:{port}",
+            token_file=token_file,
+            ca_file=ca if os.path.exists(ca) else None,
+            namespace=ns,
+        )
+
+    @classmethod
+    def from_kubeconfig(
+        cls, path: Optional[str] = None, context: Optional[str] = None
+    ) -> "KubeConfig":
+        import yaml
+
+        path = path or os.environ.get(
+            "KUBECONFIG", os.path.expanduser("~/.kube/config")
+        )
+        try:
+            with open(path, encoding="utf-8") as f:
+                doc = yaml.safe_load(f) or {}
+        except OSError as e:
+            raise KubeConfigError(f"kubeconfig unreadable: {e}") from e
+        ctx_name = context or doc.get("current-context")
+        if not ctx_name:
+            raise KubeConfigError(f"{path}: no current-context")
+        ctx = _named(doc.get("contexts"), ctx_name, "context")
+        cluster = _named(doc.get("clusters"), ctx.get("cluster"), "cluster")
+        user = _named(doc.get("users"), ctx.get("user"), "user") if ctx.get("user") else {}
+        server = cluster.get("server")
+        if not server:
+            raise KubeConfigError(f"cluster {ctx.get('cluster')!r} has no server")
+        # Only static credentials are supported. An exec plugin or
+        # auth-provider (the managed-cloud default) silently ignored here
+        # would send every request ANONYMOUS — reflectors would back off
+        # on 401s forever with no hint why. Fail fast and name it.
+        for unsupported in ("exec", "auth-provider"):
+            if user.get(unsupported):
+                raise KubeConfigError(
+                    f"kubeconfig user {ctx.get('user')!r} uses "
+                    f"{unsupported!r} credentials, which this client does "
+                    "not support — mint a static token (e.g. a "
+                    "ServiceAccount token) or client cert for the operator"
+                )
+        owned: list[str] = []
+        cfg = cls(
+            host=server.rstrip("/"),
+            namespace=ctx.get("namespace", "default"),
+            verify_tls=not cluster.get("insecure-skip-tls-verify", False),
+            ca_file=_file_or_data(
+                cluster, "certificate-authority", owned
+            ),
+            token=user.get("token"),
+            client_cert_file=_file_or_data(user, "client-certificate", owned),
+            client_key_file=_file_or_data(user, "client-key", owned),
+        )
+        cfg._owned_files = owned
+        return cfg
+
+    @classmethod
+    def from_env(cls) -> "KubeConfig":
+        """Resolution order (operator_main / doctor cluster mode):
+        OMNIA_IN_CLUSTER=1 → SA mount; OMNIA_KUBECONFIG / KUBECONFIG /
+        ~/.kube/config → kubeconfig; else in-cluster if the SA mount
+        exists. Raises KubeConfigError with the modes tried."""
+        if os.environ.get("OMNIA_IN_CLUSTER") == "1":
+            return cls.in_cluster()
+        explicit = os.environ.get("OMNIA_KUBECONFIG") or os.environ.get("KUBECONFIG")
+        if explicit:
+            return cls.from_kubeconfig(explicit)
+        default = os.path.expanduser("~/.kube/config")
+        if os.path.exists(default):
+            return cls.from_kubeconfig(default)
+        if os.path.exists(os.path.join(SA_DIR, "token")):
+            return cls.in_cluster()
+        raise KubeConfigError(
+            "no cluster config: set OMNIA_KUBECONFIG/KUBECONFIG, run "
+            "in-cluster, or create ~/.kube/config"
+        )
+
+    def close(self) -> None:
+        for p in self._owned_files:
+            try:
+                os.unlink(p)
+            except OSError:
+                pass  # temp cert file already gone
+        self._owned_files = []
+
+
+def _named(entries, name, what) -> dict:
+    """kubeconfig lists entries as {name: ..., <what>: {...}}."""
+    for e in entries or []:
+        if e.get("name") == name:
+            return e.get(what) or {}
+    raise KubeConfigError(f"{what} {name!r} not found in kubeconfig")
+
+
+def _file_or_data(section: dict, key: str, owned: list) -> Optional[str]:
+    """kubeconfig fields come as either a path (`client-certificate`) or
+    inline base64 (`client-certificate-data`); inline data lands in a
+    temp file the config owns."""
+    if section.get(key):
+        return os.path.expanduser(section[key])
+    data = section.get(key + "-data")
+    if not data:
+        return None
+    fd, path = tempfile.mkstemp(prefix="omnia-kube-", suffix=".pem")
+    with os.fdopen(fd, "wb") as f:
+        f.write(base64.b64decode(data))
+    owned.append(path)
+    return path

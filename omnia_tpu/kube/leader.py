@@ -1,0 +1,218 @@
+"""Lease-based leader election: the cluster-mode single-writer guard.
+
+Reference parity: the controller-manager's coordination.k8s.io Lease
+election (cmd/main.go LeaderElection: true). Running two operator
+replicas without it means two reconcile loops fighting over the same
+status subresources and double-starting rollouts — the lease makes one
+replica the writer and parks the rest.
+
+Protocol (client-go leaderelection semantics over plain CRUD):
+- acquire: create the Lease, or replace it when the holder's renewTime
+  is older than leaseDurationSeconds (expired) or the holder is us.
+- renew: replace with a fresh renewTime at renew_interval; a failed
+  renew (409 — someone stole it after our lease expired) drops
+  leadership immediately.
+- release: null out holderIdentity so a standby takes over without
+  waiting a full lease duration.
+All writes go through resourceVersion optimistic concurrency, so two
+candidates racing the same transition: exactly one wins, the other sees
+409 and backs off.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+import uuid
+from typing import Callable, Optional
+
+from omnia_tpu.kube.client import ApiError, Conflict, KubeClient, NotFound
+
+logger = logging.getLogger(__name__)
+
+
+def _rfc3339(ts: float) -> str:
+    """Lease times go on the wire as MicroTime (RFC3339 with µs) — a real
+    apiserver rejects bare floats."""
+    import datetime
+
+    return datetime.datetime.fromtimestamp(
+        ts, tz=datetime.timezone.utc
+    ).strftime("%Y-%m-%dT%H:%M:%S.%fZ")
+
+
+class LeaderElector:
+    def __init__(
+        self,
+        client: KubeClient,
+        name: str = "omnia-operator",
+        namespace: str = "default",
+        identity: Optional[str] = None,
+        lease_duration_s: float = 15.0,
+        renew_interval_s: float = 5.0,
+        renew_deadline_s: Optional[float] = None,
+        on_started: Optional[Callable[[], None]] = None,
+        on_stopped: Optional[Callable[[], None]] = None,
+    ) -> None:
+        self.client = client
+        self.name = name
+        self.namespace = namespace
+        self.identity = identity or f"omnia-operator-{uuid.uuid4().hex[:8]}"
+        self.lease_duration_s = lease_duration_s
+        self.renew_interval_s = renew_interval_s
+        # How long a LEADER rides out failed renew requests before
+        # conceding (client-go RenewDeadline, default 2/3 of the lease):
+        # dropping leadership on the first lost packet would turn every
+        # apiserver blip into a control-plane restart, while the lease
+        # itself is still safely ours server-side.
+        self.renew_deadline_s = (
+            renew_deadline_s if renew_deadline_s is not None
+            else lease_duration_s * 2.0 / 3.0
+        )
+        self.on_started = on_started
+        self.on_stopped = on_stopped
+        self._leading = threading.Event()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        # (holder, renewTime-string, local-monotonic-first-seen): foreign
+        # lease expiry is judged by OUR clock observing the same renewTime
+        # for a full duration — trusting the holder's self-stamped wall
+        # time would let clock skew > lease_duration steal a live lease.
+        self._observed: Optional[tuple[str, str, float]] = None
+        self._last_renew_ok = 0.0
+
+    @property
+    def is_leader(self) -> bool:
+        return self._leading.is_set()
+
+    # -- one protocol step ---------------------------------------------
+
+    def try_acquire_or_renew(self, now: Optional[float] = None) -> bool:
+        now = time.time() if now is None else now
+        spec = {
+            "holderIdentity": self.identity,
+            # Integer per the Lease API; floor of 1 — int() truncation of
+            # a sub-second duration would declare a 0s lease, which every
+            # reader treats as unset and backfills with their own default.
+            "leaseDurationSeconds": max(1, int(self.lease_duration_s)),
+            "renewTime": _rfc3339(now),
+        }
+        try:
+            cur = self.client.get("Lease", self.name, self.namespace)
+        except NotFound:
+            lease = {
+                "apiVersion": "coordination.k8s.io/v1",
+                "kind": "Lease",
+                "metadata": {"name": self.name, "namespace": self.namespace},
+                "spec": {**spec, "acquireTime": _rfc3339(now)},
+            }
+            try:
+                self.client.create(lease)
+                return True
+            except Conflict:
+                return False  # another candidate won the create race
+        cur_spec = cur.get("spec") or {}
+        holder = cur_spec.get("holderIdentity")
+        duration = float(cur_spec.get("leaseDurationSeconds")
+                         or self.lease_duration_s)
+        if holder and holder != self.identity:
+            token = (holder, str(cur_spec.get("renewTime")))
+            obs = self._observed
+            if obs is None or (obs[0], obs[1]) != token:
+                # Holder or renewTime moved: the lease is live. Start
+                # (or restart) OUR expiry clock from this observation.
+                self._observed = (token[0], token[1], time.monotonic())
+                return False
+            if time.monotonic() - obs[2] < duration:
+                return False  # held; not yet unrenewed for a full duration
+            # Same renewTime observed for > duration on our clock: the
+            # holder is gone (or wedged) — steal below.
+        # Expired, released, or ours: take/renew it at the live rv.
+        cur["spec"] = {
+            **spec,
+            "acquireTime": (
+                cur_spec.get("acquireTime", _rfc3339(now))
+                if holder == self.identity else _rfc3339(now)
+            ),
+        }
+        try:
+            self.client.replace(cur)
+            return True
+        except (Conflict, NotFound):
+            return False  # lost the transition race
+
+    def release(self) -> None:
+        """Give the lease up so a standby acquires without the timeout."""
+        try:
+            cur = self.client.get("Lease", self.name, self.namespace)
+        except ApiError:
+            return
+        if (cur.get("spec") or {}).get("holderIdentity") != self.identity:
+            return
+        cur["spec"] = {**cur["spec"], "holderIdentity": "",
+                       "renewTime": _rfc3339(0.0)}
+        try:
+            self.client.replace(cur)
+        except ApiError:
+            logger.warning("lease release failed; standby waits for expiry")
+
+    # -- run loop ------------------------------------------------------
+
+    def run(self) -> "LeaderElector":
+        self._thread = threading.Thread(
+            target=self._loop, name=f"leader-elect-{self.name}", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                got = self.try_acquire_or_renew()
+                if got:
+                    self._last_renew_ok = time.monotonic()
+            except Exception as e:  # noqa: BLE001 — the elector thread
+                # must NEVER die silently: a dead renew loop with
+                # _leading still set is an unbounded split-brain. Any
+                # failure (ApiError, config/token-read errors, bugs)
+                # degrades to follower logic instead.
+                logger.warning("leader election request failed: %s", e)
+                if (self._leading.is_set()
+                        and time.monotonic() - self._last_renew_ok
+                        < self.renew_deadline_s):
+                    # Transient: the lease is still ours server-side;
+                    # ride it out until the renew deadline (client-go
+                    # RenewDeadline posture).
+                    got = True
+                else:
+                    got = False
+            if got and not self._leading.is_set():
+                logger.info("leader election: %s acquired %s/%s",
+                            self.identity, self.namespace, self.name)
+                self._leading.set()
+                if self.on_started:
+                    self.on_started()
+            elif not got and self._leading.is_set():
+                logger.warning("leader election: %s LOST %s/%s",
+                               self.identity, self.namespace, self.name)
+                self._leading.clear()
+                if self.on_stopped:
+                    self.on_stopped()
+            self._stop.wait(
+                self.renew_interval_s if self._leading.is_set()
+                else min(self.renew_interval_s, 1.0)
+            )
+
+    def wait_for_leadership(self, timeout_s: Optional[float] = None) -> bool:
+        return self._leading.wait(timeout=timeout_s)
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+        if self._leading.is_set():
+            self._leading.clear()
+            self.release()
+            if self.on_stopped:
+                self.on_stopped()

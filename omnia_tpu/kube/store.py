@@ -1,0 +1,310 @@
+"""KubeResourceStore: a live apiserver as the operator's resource store.
+
+The third ResourceStore backend beside Memory/File (reference
+pkg/k8s/client.go vs filebacked.go — same split). Semantics map 1:1:
+
+- apply()        → POST, or PUT at the live resourceVersion (409s are
+  retried with a fresh GET — optimistic concurrency, not lost updates);
+  the apiserver owns the generation bump.
+- update_status()→ PUT the status subresource (no generation bump).
+- delete()       → DELETE; watchers get DELETED.
+- watch()        → one Reflector per kind feeds the same (event,
+  Resource) callbacks the in-process stores fire. Local writes notify
+  synchronously (controller tests stay deterministic); the watch stream
+  is deduplicated against them by resourceVersion, so an event is
+  delivered exactly once whether it originated here or from kubectl on
+  the other side of the cluster. Relists after a 410 diff against the
+  local cache: only objects that actually changed (or vanished) notify,
+  so a relist storm cannot cause duplicate side effects downstream.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Optional
+
+from omnia_tpu.kube.client import ApiError, Conflict, KubeClient, NotFound
+from omnia_tpu.kube.config import KubeConfig
+from omnia_tpu.kube.watch import Reflector
+from omnia_tpu.operator.resources import API_VERSION, Resource
+from omnia_tpu.operator.store import ResourceStore
+from omnia_tpu.operator.validation import validate
+
+logger = logging.getLogger(__name__)
+
+# Kinds whose manifests leave the omnia group on the wire.
+_API_VERSION_OVERRIDES = {"HTTPRoute": "gateway.networking.k8s.io/v1"}
+
+
+def _default_kinds() -> list[str]:
+    from omnia_tpu.operator.crds import KINDS
+
+    return list(KINDS) + ["HTTPRoute"]
+
+
+def _to_wire(res: Resource) -> dict:
+    obj = res.to_manifest()
+    obj["apiVersion"] = _API_VERSION_OVERRIDES.get(res.kind, API_VERSION)
+    return obj
+
+
+def _created_at(md: dict) -> Optional[float]:
+    ts = md.get("creationTimestamp")
+    if ts is None:
+        return None
+    if isinstance(ts, (int, float)):
+        return float(ts)
+    try:  # RFC3339 from a real apiserver
+        import datetime
+
+        return datetime.datetime.fromisoformat(
+            str(ts).replace("Z", "+00:00")
+        ).timestamp()
+    except ValueError:
+        return None
+
+
+def _from_wire(obj: dict) -> Resource:
+    res = Resource.from_manifest(obj)
+    created = _created_at(obj.get("metadata") or {})
+    if created is not None:
+        res.created_at = created
+    return res
+
+
+def _rv_of(obj: dict) -> int:
+    try:
+        return int((obj.get("metadata") or {}).get("resourceVersion") or 0)
+    except (TypeError, ValueError):
+        return 0
+
+
+class KubeResourceStore(ResourceStore):
+    def __init__(
+        self,
+        client: Optional[KubeClient] = None,
+        config: Optional[KubeConfig] = None,
+        kinds: Optional[list[str]] = None,
+        start_watches: bool = True,
+        sync_timeout_s: float = 10.0,
+        backoff_base_s: float = 0.05,
+        backoff_cap_s: float = 5.0,
+    ) -> None:
+        super().__init__()
+        if client is None:
+            client = KubeClient(config or KubeConfig.from_env())
+        self.client = client
+        self.kinds = kinds or _default_kinds()
+        # key -> (rv, Resource): watch dedup + relist diffing.
+        self._cache: dict[str, tuple[int, Resource]] = {}
+        # key -> deletion rv for locally-issued deletes (watch dedup).
+        self._seen_deletes: dict[str, int] = {}
+        self._state_lock = threading.Lock()
+        # Serializes claim+notify as one unit: without it, a thread that
+        # claimed rv N could be preempted before notifying while another
+        # delivers rv N+1 — watchers would see events out of order.
+        # RLock: a watcher may reentrantly write through the store.
+        self._deliver_lock = threading.RLock()
+        self._reflectors: list[Reflector] = []
+        if start_watches:
+            for kind in self.kinds:
+                r = Reflector(
+                    client, kind,
+                    on_event=self._on_watch_event,
+                    on_sync=lambda objs, k=kind: self._on_relist(k, objs),
+                    backoff_base_s=backoff_base_s,
+                    backoff_cap_s=backoff_cap_s,
+                ).start()
+                self._reflectors.append(r)
+            for r in self._reflectors:
+                if not r.wait_synced(timeout_s=sync_timeout_s):
+                    logger.warning("reflector %s not synced yet", r.kind)
+
+    # -- CRUD ----------------------------------------------------------
+
+    def apply(self, res: Resource) -> Resource:
+        validate(res)  # fail fast with the admission error type tests expect
+        last_err: Optional[ApiError] = None
+        for _attempt in range(5):
+            try:
+                cur = self.client.get(res.kind, res.name, res.namespace)
+            except NotFound:
+                cur = None
+            obj = _to_wire(res)
+            try:
+                if cur is None:
+                    out = self.client.create(obj)
+                    event = "ADDED"
+                else:
+                    obj["metadata"]["resourceVersion"] = (
+                        cur["metadata"]["resourceVersion"])
+                    out = self.client.replace(obj)
+                    event = "MODIFIED"
+            except (Conflict, NotFound) as e:
+                last_err = e  # raced another writer; re-GET and retry
+                continue
+            applied = _from_wire(out)
+            res.generation = applied.generation
+            res.created_at = applied.created_at
+            # Claim-based dedup: the watch stream races this return path
+            # (the apiserver can deliver our own event before we get
+            # here) — whoever claims the rv first is the one that
+            # notifies, so the event fires exactly once either way.
+            self._deliver(event, applied, _rv_of(out))
+            return applied
+        raise last_err or ApiError(409, "apply retries exhausted")
+
+    def update_status(self, res: Resource, status: dict) -> Resource:
+        last_err: Optional[ApiError] = None
+        for _attempt in range(5):
+            try:
+                cur = self.client.get(res.kind, res.name, res.namespace)
+            except NotFound:
+                raise KeyError(res.key) from None
+            cur["status"] = dict(status)
+            try:
+                out = self.client.replace(cur, subresource="status")
+            except Conflict as e:
+                last_err = e
+                continue
+            except NotFound:
+                raise KeyError(res.key) from None
+            updated = _from_wire(out)
+            # Status writes are cache-marked but NOT notified — parity
+            # with the in-process stores (no event storm from status).
+            self._mark_seen(updated, _rv_of(out))
+            return updated
+        raise last_err or ApiError(409, "status update retries exhausted")
+
+    def delete(self, namespace: str, kind: str, name: str) -> bool:
+        try:
+            out = self.client.delete(kind, name, namespace)
+        except NotFound:
+            return False
+        res = _from_wire(out)
+        self._deliver("DELETED", res, _rv_of(out))
+        return True
+
+    def get(self, namespace: str, kind: str, name: str) -> Optional[Resource]:
+        try:
+            return _from_wire(self.client.get(kind, name, namespace))
+        except NotFound:
+            return None
+
+    def list(
+        self, kind: Optional[str] = None, namespace: Optional[str] = None
+    ) -> list[Resource]:
+        out: list[Resource] = []
+        for k in [kind] if kind else self.kinds:
+            try:
+                doc = self.client.list(k, namespace)
+            except (NotFound, KeyError):
+                continue  # CRD not registered (yet); same as empty
+            out += [_from_wire(o) for o in doc.get("items") or []]
+        return sorted(out, key=lambda r: r.key)
+
+    # -- watch plumbing ------------------------------------------------
+
+    def _mark_seen(self, res: Resource, rv: int) -> None:
+        with self._state_lock:
+            have, _ = self._cache.get(res.key, (0, None))
+            if rv >= have:
+                self._cache[res.key] = (rv, res)
+
+    def _record_tombstone(self, key: str, rv: int) -> None:
+        """Record a deletion rv for watch dedup, bounded: on churny kinds
+        the map would otherwise grow one entry per ever-deleted key for
+        the process lifetime. Oldest-first eviction is safe — dedup only
+        matters for rvs still in flight. Call with _state_lock held."""
+        self._seen_deletes[key] = rv
+        if len(self._seen_deletes) > 4096:
+            for k in list(self._seen_deletes)[:1024]:
+                del self._seen_deletes[k]
+
+    def _deliver(self, etype: str, res: Resource, rv: int,
+                 from_watch: bool = False) -> None:
+        """Atomically claim an event rv and notify: exactly one of the
+        local write path and the watch thread wins each rv. Watch-side
+        MODIFIED events whose spec+labels match the cache are claimed
+        QUIETLY — they are status/metadata-only writes, which the
+        in-process stores never notify for. Without this, a controller's
+        own update_status echoes back through the watch and re-triggers
+        the reconcile that wrote it: a self-sustaining hot loop."""
+        with self._deliver_lock:
+            with self._state_lock:
+                if etype == "DELETED":
+                    if rv <= self._seen_deletes.get(res.key, 0):
+                        return
+                    self._record_tombstone(res.key, rv)
+                    self._cache.pop(res.key, None)
+                    quiet = False
+                else:
+                    have, cached = self._cache.get(res.key, (0, None))
+                    if rv <= max(have, self._seen_deletes.get(res.key, 0)):
+                        return
+                    quiet = (
+                        from_watch and etype == "MODIFIED"
+                        and cached is not None
+                        and cached.spec == res.spec
+                        and cached.labels == res.labels
+                    )
+                    self._cache[res.key] = (rv, res)
+            if not quiet:
+                self._notify(etype, res)
+
+    def _on_watch_event(self, etype: str, obj: dict) -> None:
+        try:
+            res = _from_wire(obj)
+        except ValueError:
+            logger.warning("unparseable watch object: %s", obj.get("kind"))
+            return
+        if etype in ("ADDED", "MODIFIED", "DELETED"):
+            self._deliver(etype, res, _rv_of(obj), from_watch=True)
+
+    def _on_relist(self, kind: str, objects: list[dict]) -> None:
+        """Post-410 (or initial) list: diff against the cache; notify
+        only real deltas so a relist never replays history downstream."""
+        with self._deliver_lock:
+            incoming: set[str] = set()
+            for obj in objects:
+                try:
+                    res = _from_wire(obj)
+                except ValueError:
+                    continue
+                incoming.add(res.key)
+                with self._state_lock:
+                    have, _ = self._cache.get(res.key, (0, None))
+                    known = have > 0
+                self._deliver("MODIFIED" if known else "ADDED",
+                              res, _rv_of(obj), from_watch=True)
+            # Objects that vanished during the outage: their DELETED
+            # events are unrecoverable (evicted), so the diff IS the
+            # delete signal. The cached rv seeds _seen_deletes — any
+            # recreation will carry a strictly newer rv.
+            with self._state_lock:
+                gone = [
+                    (k, rv, cached)
+                    for k, (rv, cached) in self._cache.items()
+                    if cached is not None and cached.kind == kind
+                    and k not in incoming
+                ]
+                for k, rv, _cached in gone:
+                    self._cache.pop(k, None)
+                    self._record_tombstone(
+                        k, max(rv, self._seen_deletes.get(k, 0)))
+            for _k, _rv, cached in gone:
+                self._notify("DELETED", cached)
+
+    # -- lifecycle -----------------------------------------------------
+
+    def close(self) -> None:
+        # Two-phase: signal everything first so the reflector threads
+        # wind down CONCURRENTLY (a serial signal+join pays one bookmark
+        # interval per kind — seconds per store teardown).
+        for r in self._reflectors:
+            r.signal_stop()
+        for r in self._reflectors:
+            r.stop(timeout_s=0.5)
+        self._reflectors = []
+        self.client.config.close()

@@ -1,0 +1,148 @@
+"""Reflector: the list+watch loop (client-go reflector semantics).
+
+One reflector per (kind, namespace): an initial LIST establishes state
+and the resume resourceVersion, then a WATCH streams deltas. On
+disconnect it resumes from the last seen resourceVersion after an
+exponential backoff with full jitter; on 410 Gone (the apiserver's event
+history no longer covers the resume point) it RELISTS and hands the full
+set to `on_sync`, whose consumer diffs against its own cache — relist
+must converge without replaying per-object history. BOOKMARK events
+advance the resume point without a callback, so a quiet kind never
+triggers a spurious relist after history eviction.
+"""
+
+from __future__ import annotations
+
+import logging
+import random
+import threading
+import time
+from typing import Callable, Optional
+
+from omnia_tpu.kube.client import ApiError, Gone, KubeClient
+
+logger = logging.getLogger(__name__)
+
+# on_event(event_type, object) for ADDED/MODIFIED/DELETED.
+EventFn = Callable[[str, dict], None]
+# on_sync(objects) after every (re)list: the authoritative full set.
+SyncFn = Callable[[list[dict]], None]
+
+
+def backoff_s(attempt: int, base: float, cap: float) -> float:
+    """Exponential backoff with full jitter (AWS-style): uniform in
+    [0, min(cap, base * 2^attempt)] — a herd of reflectors reconnecting
+    after an apiserver flap must not re-stampede it in lockstep."""
+    return random.uniform(0, min(cap, base * (2.0 ** attempt)))
+
+
+class Reflector:
+    def __init__(
+        self,
+        client: KubeClient,
+        kind: str,
+        on_event: EventFn,
+        on_sync: Optional[SyncFn] = None,
+        namespace: Optional[str] = None,
+        backoff_base_s: float = 0.05,
+        backoff_cap_s: float = 5.0,
+    ) -> None:
+        self.client = client
+        self.kind = kind
+        self.namespace = namespace
+        self.on_event = on_event
+        self.on_sync = on_sync
+        self.backoff_base_s = backoff_base_s
+        self.backoff_cap_s = backoff_cap_s
+        self.resource_version: Optional[str] = None
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._synced = threading.Event()
+        # Telemetry the fault-injection tests assert on.
+        self.lists = 0
+        self.relists_on_gone = 0
+        self.disconnects = 0
+
+    # -- lifecycle -----------------------------------------------------
+
+    def start(self) -> "Reflector":
+        self._thread = threading.Thread(
+            target=self.run, name=f"kube-reflector-{self.kind}", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def signal_stop(self) -> None:
+        """Flag shutdown without waiting (callers batch-signal a fleet of
+        reflectors, then join — teardown overlaps instead of serializing
+        on each one's next watch wakeup)."""
+        self._stop.set()
+
+    def stop(self, timeout_s: float = 2.0) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=timeout_s)
+
+    def wait_synced(self, timeout_s: float = 10.0) -> bool:
+        """Block until the initial list completed (informer HasSynced)."""
+        return self._synced.wait(timeout=timeout_s)
+
+    # -- loop ----------------------------------------------------------
+
+    def run(self) -> None:
+        attempt = 0
+        while not self._stop.is_set():
+            try:
+                if self.resource_version is None:
+                    self._list()
+                self._watch_once()
+                attempt = 0  # a healthy watch resets the backoff ladder
+            except Gone:
+                # Resume point fell out of the server's event window:
+                # relist from scratch (resourceVersion reset) and let the
+                # consumer diff — never replay, never crash.
+                self.relists_on_gone += 1
+                logger.info("watch %s: 410 gone, relisting", self.kind)
+                self.resource_version = None
+            except ApiError as e:
+                self.disconnects += 1
+                delay = backoff_s(attempt, self.backoff_base_s, self.backoff_cap_s)
+                logger.debug(
+                    "watch %s disconnected (%s); resuming rv=%s in %.2fs",
+                    self.kind, e, self.resource_version, delay,
+                )
+                attempt += 1
+                self._stop.wait(delay)
+            except Exception:
+                # A reflector thread must never die silently; treat like
+                # a disconnect and keep serving the controller.
+                logger.exception("reflector %s crashed; backing off", self.kind)
+                attempt += 1
+                self._stop.wait(
+                    backoff_s(attempt, self.backoff_base_s, self.backoff_cap_s)
+                )
+
+    def _list(self) -> None:
+        doc = self.client.list(self.kind, self.namespace)
+        self.lists += 1
+        self.resource_version = (doc.get("metadata") or {}).get(
+            "resourceVersion"
+        ) or "0"
+        items = doc.get("items") or []
+        if self.on_sync is not None:
+            self.on_sync(items)
+        self._synced.set()
+
+    def _watch_once(self) -> None:
+        for etype, obj in self.client.watch(
+            self.kind, self.namespace, resource_version=self.resource_version
+        ):
+            rv = (obj.get("metadata") or {}).get("resourceVersion")
+            if rv:
+                self.resource_version = rv
+            if self._stop.is_set():
+                return
+            if etype == "BOOKMARK":
+                continue  # resume point advanced above; nothing to deliver
+            self.on_event(etype, obj)
+        # Server closed the stream cleanly (watch timeout): just resume.

@@ -119,9 +119,13 @@ def _provider_schema() -> dict:
             "role": _str(enum=PROVIDER_ROLES),
             "model": _str(),
             "options": _obj(open_=True),
+            # Key names match the admission/controller vocabulary
+            # (validation.py pricing checks, controller._resolve_refs) —
+            # the apiserver-shim schema gate caught the earlier *MTokUSD
+            # drift.
             "pricing": _obj({
-                "inputPerMTokUSD": _NUM,
-                "outputPerMTokUSD": _NUM,
+                "inputPerMTok": _NUM,
+                "outputPerMTok": _NUM,
             }),
             "engine": _obj({
                 "numSlots": _INT,
@@ -252,12 +256,18 @@ def _session_retention_schema() -> dict:
 
 
 def _arena_job_schema() -> dict:
+    # scenarios/scenariosFrom are an either-or (admission enforces it);
+    # requiring scenarios here would reject every source-fed job.
     return _obj({
         "scenarios": _arr(_obj({
             "name": _str(),
             "turns": _arr(_obj(open_=True)),
             "checks": _arr(_obj(open_=True)),
         }, required=["name"], open_=True)),
+        "scenariosFrom": _obj({
+            "name": _str(),
+            "path": _str(),
+        }, required=["name"]),
         "providers": _arr(_str()),
         "repeats": _INT,
         "mode": _str(enum=("direct", "fleet")),
@@ -266,7 +276,7 @@ def _arena_job_schema() -> dict:
             "max_error_rate": _NUM,
             "max_p95_latency_s": _NUM,
         }),
-    }, required=["scenarios", "providers"])
+    }, required=["providers"])
 
 
 def _tool_policy_schema() -> dict:

@@ -235,6 +235,12 @@ def render_install(values: Optional[dict] = None) -> list[dict]:
                 {"apiGroups": ["policy"],
                  "resources": ["poddisruptionbudgets"],
                  "verbs": ["get", "list", "watch", "create", "update", "patch", "delete"]},
+                # Leader election: cluster mode holds a Lease by default —
+                # without this grant the elector 403s forever and the
+                # operator blocks waiting for a lease it can never take.
+                {"apiGroups": ["coordination.k8s.io"],
+                 "resources": ["leases"],
+                 "verbs": ["get", "list", "watch", "create", "update", "patch", "delete"]},
             ],
         },
         {

@@ -165,13 +165,14 @@ def pipeline_forward(
     x_mb = x.reshape(M, mb, T, x.shape[-1])
     pos_mb = q_positions.reshape(M, mb, T)
 
-    fn = jax.shard_map(
+    from omnia_tpu.parallel.compat import shard_map
+
+    fn = shard_map(
         functools.partial(_pp_local, cfg=cfg, S=S, M=M),
-        mesh=mesh,
+        mesh,
         in_specs=(P("pp"), P(), P()),
         out_specs=(P(), P("pp"), P("pp")),
-        axis_names={"pp"},
-        check_vma=False,
+        manual_axes={"pp"},
     )
     out, k_chunk, v_chunk = fn(params["layers"], x_mb, pos_mb)
     out = out.reshape(B, T, -1)

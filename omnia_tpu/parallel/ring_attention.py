@@ -119,11 +119,12 @@ def ring_attention(
     if q.shape[1] % n:
         raise ValueError(f"seq len {q.shape[1]} not divisible by {seq_axis}={n}")
 
-    fn = jax.shard_map(
+    from omnia_tpu.parallel.compat import shard_map
+
+    fn = shard_map(
         functools.partial(_ring_attn_local, axis_name=seq_axis),
-        mesh=mesh,
+        mesh,
         in_specs=(qspec, kvspec, kvspec),
         out_specs=qspec,
-        check_vma=False,
     )
     return fn(q, k, v)

@@ -1,0 +1,616 @@
+"""Cluster-mode tests: kube config/client, the apiserver shim, the
+KubeResourceStore as a drop-in third backend, fault injection (dropped
+watch, 410 storm, apiserver flap), and Lease leader election.
+
+The store-conformance suite runs the SAME assertions over Memory, File,
+and Kube backends — the contract every controller depends on. Fault
+tests drive a real ControllerManager over the shim and assert it
+relists and reconverges without duplicate side effects.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import pytest
+
+from omnia_tpu.kube.apiserver import ApiServerShim
+from omnia_tpu.kube.client import (
+    Conflict,
+    KubeClient,
+    NotFound,
+    Unprocessable,
+)
+from omnia_tpu.kube.config import KubeConfig, KubeConfigError
+from omnia_tpu.kube.store import KubeResourceStore
+from omnia_tpu.operator.resources import Resource
+from omnia_tpu.operator.store import FileResourceStore, MemoryResourceStore
+from omnia_tpu.operator.validation import ValidationError
+
+
+def _wait_for(fn, timeout_s=10.0, interval_s=0.05):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        v = fn()
+        if v:
+            return v
+        time.sleep(interval_s)
+    return fn()
+
+
+@pytest.fixture
+def shim():
+    s = ApiServerShim(register_omnia_crds=True).start()
+    yield s
+    s.stop()
+
+
+@pytest.fixture
+def kube_store(shim):
+    store = KubeResourceStore(
+        client=KubeClient(shim.local_config()),
+        backoff_base_s=0.02, backoff_cap_s=0.2,
+    )
+    yield store
+    store.close()
+
+
+# -- kube config -------------------------------------------------------
+
+
+class TestKubeConfig:
+    def test_kubeconfig_parse(self, tmp_path):
+        import base64
+
+        import yaml
+
+        ca = tmp_path / "ca.pem"
+        ca.write_text("CERT")
+        path = tmp_path / "kubeconfig"
+        path.write_text(yaml.safe_dump({
+            "current-context": "prod",
+            "contexts": [
+                {"name": "other", "context": {"cluster": "x", "user": "x"}},
+                {"name": "prod", "context": {
+                    "cluster": "c1", "user": "u1", "namespace": "omnia-system",
+                }},
+            ],
+            "clusters": [{"name": "c1", "cluster": {
+                "server": "https://1.2.3.4:6443/",
+                "certificate-authority": str(ca),
+            }}],
+            "users": [{"name": "u1", "user": {
+                "token": "tok-123",
+                "client-certificate-data":
+                    base64.b64encode(b"CLIENTCERT").decode(),
+                "client-key-data": base64.b64encode(b"CLIENTKEY").decode(),
+            }}],
+        }))
+        cfg = KubeConfig.from_kubeconfig(str(path))
+        assert cfg.host == "https://1.2.3.4:6443"
+        assert cfg.namespace == "omnia-system"
+        assert cfg.bearer_token() == "tok-123"
+        assert cfg.ca_file == str(ca)
+        # Inline cert data materialized to files, cleaned by close().
+        with open(cfg.client_cert_file, "rb") as f:
+            assert f.read() == b"CLIENTCERT"
+        cfg.close()
+        import os
+
+        assert not os.path.exists(cfg.client_cert_file)
+
+    def test_in_cluster_sa_mount(self, tmp_path, monkeypatch):
+        (tmp_path / "token").write_text("sa-token\n")
+        (tmp_path / "namespace").write_text("agents")
+        (tmp_path / "ca.crt").write_text("CA")
+        monkeypatch.setenv("KUBERNETES_SERVICE_HOST", "10.0.0.1")
+        monkeypatch.setenv("KUBERNETES_SERVICE_PORT", "443")
+        cfg = KubeConfig.in_cluster(sa_dir=str(tmp_path))
+        assert cfg.host == "https://10.0.0.1:443"
+        assert cfg.namespace == "agents"
+        # Token is re-read per request: projected SA tokens rotate.
+        assert cfg.bearer_token() == "sa-token"
+        (tmp_path / "token").write_text("rotated")
+        assert cfg.bearer_token() == "rotated"
+
+    def test_missing_config_fails_with_modes_named(self, monkeypatch):
+        monkeypatch.delenv("KUBERNETES_SERVICE_HOST", raising=False)
+        with pytest.raises(KubeConfigError):
+            KubeConfig.in_cluster(sa_dir="/nonexistent")
+
+
+# -- client + shim wire semantics -------------------------------------
+
+
+class TestClientShim:
+    def test_conflict_on_stale_rv_and_registration(self, shim):
+        c = KubeClient(shim.local_config())
+        obj = {"apiVersion": "omnia.tpu/v1alpha1", "kind": "Provider",
+               "metadata": {"name": "p", "namespace": "default"},
+               "spec": {"type": "mock"}}
+        created = c.create(obj)
+        stale = dict(created, spec={"type": "mock", "role": "llm"})
+        stale["metadata"] = dict(created["metadata"], resourceVersion="1")
+        with pytest.raises(Conflict):
+            c.replace(stale)
+        # PUT without rv is an error too (apiserver update contract).
+        no_rv = dict(created)
+        no_rv["metadata"] = {k: v for k, v in created["metadata"].items()
+                             if k != "resourceVersion"}
+        with pytest.raises(Conflict):
+            c.replace(no_rv)
+        with pytest.raises(Conflict):  # duplicate create = AlreadyExists
+            c.create(obj)
+        with pytest.raises(NotFound):
+            c.get("Provider", "ghost", "default")
+        with pytest.raises(NotFound):  # unregistered plural = 404
+            c.request("GET", "/apis/foo.example/v1/widgets")
+        with pytest.raises(KeyError):  # unroutable kind is a client error
+            c.list("Widget")
+
+    def test_schema_and_admission_rejection(self, shim):
+        c = KubeClient(shim.local_config())
+        with pytest.raises(Unprocessable, match="not one of"):
+            c.create({"apiVersion": "omnia.tpu/v1alpha1", "kind": "Provider",
+                      "metadata": {"name": "b", "namespace": "default"},
+                      "spec": {"type": "carrier-pigeon"}})
+        # Typo'd spec key: strict OpenAPI validation (the envtest gate).
+        with pytest.raises(Unprocessable, match="[Aa]dditional properties"):
+            c.create({"apiVersion": "omnia.tpu/v1alpha1", "kind": "Provider",
+                      "metadata": {"name": "b", "namespace": "default"},
+                      "spec": {"type": "mock", "replcias": 1}})
+        # Admission chain (webhook parity): schema-valid but semantically
+        # wrong — tpu provider without a model preset.
+        with pytest.raises(Unprocessable, match="admission"):
+            c.create({"apiVersion": "omnia.tpu/v1alpha1", "kind": "Provider",
+                      "metadata": {"name": "b", "namespace": "default"},
+                      "spec": {"type": "tpu"}})
+
+    def test_status_subresource_discipline(self, shim):
+        c = KubeClient(shim.local_config())
+        created = c.create({
+            "apiVersion": "omnia.tpu/v1alpha1", "kind": "Workspace",
+            "metadata": {"name": "w", "namespace": "default"},
+            "spec": {"environment": "dev"}})
+        # Main PUT cannot smuggle status in.
+        smuggle = dict(created, status={"phase": "Hacked"})
+        out = c.replace(smuggle)
+        assert out.get("status") in (None, {})
+        # Status PUT writes status and does NOT bump generation.
+        live = c.get("Workspace", "w", "default")
+        live["status"] = {"phase": "Ready"}
+        out = c.replace(live, subresource="status")
+        assert out["status"] == {"phase": "Ready"}
+        assert out["metadata"]["generation"] == 1
+        # Spec PUT bumps generation.
+        live = c.get("Workspace", "w", "default")
+        live["spec"] = {"environment": "prod"}
+        out = c.replace(live)
+        assert out["metadata"]["generation"] == 2
+        assert out["status"] == {"phase": "Ready"}, "status survives spec PUT"
+
+
+# -- store conformance over all three backends -------------------------
+
+
+@pytest.fixture(params=["memory", "file", "kube"])
+def any_store(request, tmp_path):
+    if request.param == "memory":
+        yield MemoryResourceStore()
+    elif request.param == "file":
+        yield FileResourceStore(str(tmp_path / "devroot"))
+    else:
+        shim = ApiServerShim(register_omnia_crds=True).start()
+        store = KubeResourceStore(
+            client=KubeClient(shim.local_config()),
+            kinds=["Provider", "Workspace", "PromptPack"],
+            backoff_base_s=0.02, backoff_cap_s=0.2,
+        )
+        yield store
+        store.close()
+        shim.stop()
+
+
+class TestStoreConformance:
+    """One behavioral contract, three backends (reference: the real and
+    file-backed k8s clients are interchangeable behind pkg/k8s)."""
+
+    def test_apply_get_list_delete(self, any_store):
+        s = any_store
+        s.apply(Resource(kind="Provider", name="p1",
+                         spec={"type": "mock", "role": "llm"}))
+        s.apply(Resource(kind="Workspace", name="w1",
+                         spec={"environment": "dev"}))
+        got = s.get("default", "Provider", "p1")
+        assert got is not None and got.spec["type"] == "mock"
+        assert [r.kind for r in s.list(namespace="default")] == \
+            ["Provider", "Workspace"]
+        assert [r.name for r in s.list(kind="Provider")] == ["p1"]
+        assert s.delete("default", "Provider", "p1") is True
+        assert s.delete("default", "Provider", "p1") is False
+        assert s.get("default", "Provider", "p1") is None
+
+    def test_generation_bumps_on_spec_change_only(self, any_store):
+        s = any_store
+        r1 = s.apply(Resource(kind="Provider", name="p",
+                              spec={"type": "mock"}))
+        assert r1.generation == 1
+        r2 = s.apply(Resource(kind="Provider", name="p",
+                              spec={"type": "mock", "role": "llm"}))
+        assert r2.generation == 2
+
+    def test_status_subresource_does_not_bump_generation(self, any_store):
+        s = any_store
+        r = s.apply(Resource(kind="Provider", name="p", spec={"type": "mock"}))
+        s.update_status(r, {"phase": "Ready"})
+        got = s.get("default", "Provider", "p")
+        assert got.status == {"phase": "Ready"} and got.generation == 1
+
+    def test_update_status_on_missing_raises_keyerror(self, any_store):
+        with pytest.raises(KeyError):
+            any_store.update_status(
+                Resource(kind="Provider", name="ghost", spec={"type": "mock"}),
+                {"phase": "Ready"},
+            )
+
+    def test_watch_ordering(self, any_store):
+        s = any_store
+        events = []
+        s.watch(lambda ev, r: events.append((ev, r.name, r.generation)))
+        s.apply(Resource(kind="Provider", name="p", spec={"type": "mock"}))
+        s.apply(Resource(kind="Provider", name="p",
+                         spec={"type": "mock", "role": "llm"}))
+        s.delete("default", "Provider", "p")
+        assert [(e[0], e[1]) for e in events] == \
+            [("ADDED", "p"), ("MODIFIED", "p"), ("DELETED", "p")]
+        assert events[1][2] == 2  # MODIFIED carries the bumped generation
+
+    def test_watcher_isolation(self, any_store):
+        """One watcher crashing must not starve the others."""
+        s = any_store
+        seen = []
+
+        def bad(ev, r):
+            raise RuntimeError("watcher bug")
+
+        s.watch(bad)
+        s.watch(lambda ev, r: seen.append(ev))
+        s.apply(Resource(kind="Provider", name="p", spec={"type": "mock"}))
+        s.delete("default", "Provider", "p")
+        assert seen == ["ADDED", "DELETED"]
+
+    def test_admission_fails_closed(self, any_store):
+        with pytest.raises(ValidationError):
+            any_store.apply(Resource(kind="Provider", name="bad",
+                                     spec={"type": "carrier-pigeon"}))
+        with pytest.raises(ValidationError):
+            any_store.apply(Resource(kind="Gadget", name="x"))
+
+
+# -- kube-only: watch stream, faults, convergence ----------------------
+
+
+class TestKubeWatch:
+    def test_external_apply_reaches_watchers(self, shim, kube_store):
+        events = []
+        kube_store.watch(lambda ev, r: events.append((ev, r.key)))
+        ext = KubeClient(shim.local_config())
+        ext.create({"apiVersion": "omnia.tpu/v1alpha1", "kind": "Provider",
+                    "metadata": {"name": "ext", "namespace": "default"},
+                    "spec": {"type": "mock"}})
+        assert _wait_for(lambda: events)
+        assert events[0] == ("ADDED", "default/Provider/ext")
+        # and the store reads it back without having written it
+        assert kube_store.get("default", "Provider", "ext") is not None
+
+    def test_local_write_not_duplicated_by_watch_stream(self, shim, kube_store):
+        events = []
+        kube_store.watch(lambda ev, r: events.append(ev))
+        kube_store.apply(Resource(kind="Provider", name="p",
+                                  spec={"type": "mock"}))
+        time.sleep(1.2)  # watch stream delivers; dedup must swallow it
+        assert events == ["ADDED"]
+
+    def test_dropped_watch_resumes_from_rv(self, shim, kube_store):
+        events = []
+        kube_store.watch(lambda ev, r: events.append((ev, r.name)))
+        shim.drop_watches()  # sever mid-stream, no history eviction
+        ext = KubeClient(shim.local_config())
+        ext.create({"apiVersion": "omnia.tpu/v1alpha1", "kind": "Provider",
+                    "metadata": {"name": "after-drop", "namespace": "default"},
+                    "spec": {"type": "mock"}})
+        assert _wait_for(lambda: ("ADDED", "after-drop") in events)
+        # Resume, not relist: no Gone was involved.
+        refl = [r for r in kube_store._reflectors if r.kind == "Provider"][0]
+        assert refl.relists_on_gone == 0
+
+
+class TestFaultInjection:
+    """The acceptance-criteria scenarios: dropped watch mid-reconcile,
+    410 storm → relist, apiserver flap — the operator reconverges with
+    no duplicate side effects."""
+
+    def _controller(self, shim, kinds=None):
+        from omnia_tpu.operator.controller import ControllerManager
+
+        store = KubeResourceStore(
+            client=KubeClient(shim.local_config()), kinds=kinds,
+            backoff_base_s=0.02, backoff_cap_s=0.2,
+        )
+        return store, ControllerManager(store)
+
+    def test_410_storm_relists_and_reconverges(self):
+        shim = ApiServerShim(register_omnia_crds=True, max_history=8).start()
+        store, cm = self._controller(shim, kinds=["Provider", "Workspace"])
+        try:
+            ext = KubeClient(shim.local_config())
+            ext.create({"apiVersion": "omnia.tpu/v1alpha1", "kind": "Provider",
+                        "metadata": {"name": "p", "namespace": "default"},
+                        "spec": {"type": "mock"}})
+            assert _wait_for(lambda: (
+                cm.drain_queue(),
+                (store.get("default", "Provider", "p") or Resource(
+                    kind="Provider", name="p")).status.get("phase") == "Ready",
+            )[1])
+            writes_before = shim.stats["writes"]
+
+            # Outage: shed+sever watches, then evict history (410 storm).
+            shim.reject_watches = True
+            shim.drop_watches()
+            for i in range(12):
+                ext.apply({"apiVersion": "v1", "kind": "ConfigMap",
+                           "metadata": {"name": f"n-{i}",
+                                        "namespace": "default"},
+                           "data": {"i": str(i)}})
+            # External spec change AND a delete during the outage.
+            live = ext.get("Provider", "p", "default")
+            live["spec"] = {"type": "mock", "role": "llm"}
+            ext.replace(live)
+            ext.create({"apiVersion": "omnia.tpu/v1alpha1",
+                        "kind": "Workspace",
+                        "metadata": {"name": "w-gone",
+                                     "namespace": "default"},
+                        "spec": {"environment": "dev"}})
+            ext.delete("Workspace", "w-gone", "default")
+            time.sleep(0.3)
+            shim.reject_watches = False
+
+            # Relist converges: the spec change reconciles exactly once.
+            assert _wait_for(lambda: (
+                cm.drain_queue(),
+                (store.get("default", "Provider", "p") or Resource(
+                    kind="Provider", name="p",
+                )).spec.get("role") == "llm",
+            )[1], timeout_s=15)
+            # The reflector went through Gone → relist (get() above is a
+            # direct read; this is the WATCH path recovering).
+            refl = [r for r in store._reflectors if r.kind == "Provider"][0]
+            assert _wait_for(lambda: refl.relists_on_gone >= 1,
+                             timeout_s=15), "410 must force a relist"
+            assert shim.stats["gone"] >= 1
+            # No duplicate side effects: reconcile wrote status for the
+            # one real change, not once per relist/backoff round.
+            cm.drain_queue()
+            status_writes = shim.stats["writes"] - writes_before
+            assert status_writes <= 20, (
+                f"{status_writes} writes after relist — duplicate "
+                "reconcile side effects")
+            # The deleted-during-outage object never resurfaces.
+            assert store.get("default", "Workspace", "w-gone") is None
+        finally:
+            cm.shutdown()
+            store.close()
+            shim.stop()
+
+    def test_apiserver_flap_resumes_watch(self, shim):
+        store = KubeResourceStore(
+            client=KubeClient(shim.local_config()), kinds=["Provider"],
+            backoff_base_s=0.02, backoff_cap_s=0.2,
+        )
+        events = []
+        store.watch(lambda ev, r: events.append((ev, r.name)))
+        try:
+            shim.stop()       # full outage: reads AND watches fail
+            time.sleep(0.3)   # reflectors cycle through backoff
+            shim.start()      # same state, same port
+            ext = KubeClient(shim.local_config())
+            ext.create({"apiVersion": "omnia.tpu/v1alpha1",
+                        "kind": "Provider",
+                        "metadata": {"name": "post-flap",
+                                     "namespace": "default"},
+                        "spec": {"type": "mock"}})
+            assert _wait_for(
+                lambda: ("ADDED", "post-flap") in events, timeout_s=15)
+        finally:
+            store.close()
+
+
+# -- controller through the kube store (non-pod kinds) -----------------
+
+
+class TestControllerOnKube:
+    def test_reconciles_crs_outside_default_namespace(self, shim, kube_store):
+        """The operator is cluster-wide (ClusterRole RBAC): reflectors
+        and list() use the all-namespaces endpoints, so a CR applied in
+        ANY namespace reconciles — pinning to 'default' would leave the
+        documented `--namespace omnia-system` deployment silently inert."""
+        from omnia_tpu.operator.controller import ControllerManager
+
+        cm = ControllerManager(kube_store)
+        try:
+            ext = KubeClient(shim.local_config())
+            ext.create({"apiVersion": "omnia.tpu/v1alpha1", "kind": "Provider",
+                        "metadata": {"name": "p-ns",
+                                     "namespace": "omnia-system"},
+                        "spec": {"type": "mock", "role": "llm"}})
+            assert _wait_for(lambda: (
+                cm.drain_queue(),
+                (ext.get("Provider", "p-ns", "omnia-system").get("status")
+                 or {}).get("phase") == "Ready",
+            )[1])
+            # list() without a namespace spans namespaces too.
+            keys = [r.key for r in kube_store.list(kind="Provider")]
+            assert "omnia-system/Provider/p-ns" in keys
+        finally:
+            cm.shutdown()
+
+    def test_watch_reconcile_status_round_trip(self, shim, kube_store):
+        """kubectl-side create → watch → reconcile → status readable from
+        the kubectl side: the full cluster-mode control loop."""
+        from omnia_tpu.operator.controller import ControllerManager
+
+        cm = ControllerManager(kube_store)
+        try:
+            ext = KubeClient(shim.local_config())
+            ext.create({"apiVersion": "omnia.tpu/v1alpha1", "kind": "Provider",
+                        "metadata": {"name": "p-ext", "namespace": "default"},
+                        "spec": {"type": "mock", "role": "llm"}})
+            assert _wait_for(lambda: (
+                cm.drain_queue(),
+                (ext.get("Provider", "p-ext", "default").get("status") or {})
+                .get("phase") == "Ready",
+            )[1])
+            # Status write did NOT bump generation (subresource path).
+            raw = ext.get("Provider", "p-ext", "default")
+            assert raw["metadata"]["generation"] == 1
+        finally:
+            cm.shutdown()
+
+
+# -- leader election ---------------------------------------------------
+
+
+class TestLeaderElection:
+    def test_single_writer_and_failover(self, shim):
+        from omnia_tpu.kube.leader import LeaderElector
+
+        c1, c2 = KubeClient(shim.local_config()), KubeClient(shim.local_config())
+        a = LeaderElector(c1, identity="a", lease_duration_s=1.0,
+                          renew_interval_s=0.1).run()
+        b = LeaderElector(c2, identity="b", lease_duration_s=1.0,
+                          renew_interval_s=0.1).run()
+        try:
+            assert _wait_for(lambda: a.is_leader or b.is_leader)
+            time.sleep(0.3)
+            assert a.is_leader != b.is_leader, "exactly one writer"
+            leader, standby = (a, b) if a.is_leader else (b, a)
+            leader.stop()  # releases the lease
+            assert standby.wait_for_leadership(timeout_s=5)
+        finally:
+            a.stop()
+            b.stop()
+
+    def test_create_race_has_one_winner(self, shim):
+        from omnia_tpu.kube.leader import LeaderElector
+
+        c = KubeClient(shim.local_config())
+        x = LeaderElector(c, identity="x")
+        y = LeaderElector(c, identity="y")
+        assert [x.try_acquire_or_renew(), y.try_acquire_or_renew()] == \
+            [True, False]
+
+    def test_expired_lease_is_taken_over(self, shim):
+        """Expiry is judged by the CHALLENGER's clock observing the same
+        renewTime for a full lease duration — never by trusting the
+        holder's self-stamped wall time (clock skew > lease_duration
+        would otherwise let a standby steal a live lease)."""
+        from omnia_tpu.kube.leader import LeaderElector
+
+        c = KubeClient(shim.local_config())
+        x = LeaderElector(c, identity="x", lease_duration_s=1.0)
+        assert x.try_acquire_or_renew()
+        y = LeaderElector(c, identity="y")
+        assert not y.try_acquire_or_renew(), "first observation only"
+        time.sleep(0.3)
+        assert not y.try_acquire_or_renew(), "locally not yet expired"
+        time.sleep(0.8)  # x never renewed: >1.0s on y's clock
+        assert y.try_acquire_or_renew(), "unrenewed lease must be stealable"
+
+    def test_leader_rides_out_transient_renew_failures(self, shim):
+        """A failed renew request within the renew deadline must NOT drop
+        leadership (the lease is still ours server-side) — but sustained
+        failure past the deadline must (fail-safe before a standby could
+        legitimately steal)."""
+        from omnia_tpu.kube.leader import LeaderElector
+
+        c = KubeClient(shim.local_config())
+        led = LeaderElector(c, identity="ld", lease_duration_s=2.0,
+                            renew_interval_s=0.1, renew_deadline_s=0.8).run()
+        try:
+            assert led.wait_for_leadership(timeout_s=5)
+            shim.stop()  # apiserver outage: renew requests now fail
+            time.sleep(0.4)
+            assert led.is_leader, "blip within renew deadline kept the lease"
+            assert _wait_for(lambda: not led.is_leader, timeout_s=5), \
+                "sustained outage past the deadline must drop leadership"
+        finally:
+            led.stop()
+
+
+# -- doctor: cluster + observability families --------------------------
+
+
+class TestDoctorChecks:
+    def test_apiserver_check(self, shim):
+        from omnia_tpu.doctor import Doctor
+
+        doc = Doctor()
+        doc.add_apiserver_check(KubeClient(shim.local_config()))
+        report = doc.run()
+        chk = report["checks"][0]
+        assert chk["name"] == "apiserver" and chk["status"] == "pass"
+        assert "17 kinds servable" in chk["detail"]
+
+    def test_apiserver_check_fails_without_crds(self):
+        from omnia_tpu.doctor import Doctor
+
+        bare = ApiServerShim().start()  # no CRDs registered
+        try:
+            doc = Doctor()
+            doc.add_apiserver_check(KubeClient(bare.local_config()))
+            chk = doc.run()["checks"][0]
+            assert chk["status"] == "fail"
+            assert "CRDs not installed" in chk["detail"]
+        finally:
+            bare.stop()
+
+    def test_otlp_and_metrics_checks(self):
+        import json as _json
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+        from omnia_tpu.doctor import Doctor
+
+        class H(BaseHTTPRequestHandler):
+            def do_POST(self):
+                self.rfile.read(int(self.headers.get("Content-Length") or 0))
+                body = b"{}"
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                body = b"# HELP omnia_up up\nomnia_up 1\n"
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *a):
+                pass
+
+        srv = ThreadingHTTPServer(("127.0.0.1", 0), H)
+        threading.Thread(target=srv.serve_forever, daemon=True).start()
+        port = srv.server_address[1]
+        try:
+            doc = Doctor()
+            doc.add_otlp_check(f"http://127.0.0.1:{port}")
+            doc.add_metrics_check("metrics-engine",
+                                  f"http://127.0.0.1:{port}/metrics")
+            doc.add_otlp_check("http://127.0.0.1:1")  # nothing listening
+            checks = doc.run()["checks"]
+            assert [c["status"] for c in checks] == ["pass", "pass", "fail"]
+            assert "dropped" in checks[2]["remedy"]
+        finally:
+            srv.shutdown()
