@@ -50,19 +50,39 @@ def _log(msg: str) -> None:
 # accelerator attempt got to before the watchdog fired).
 _last_child_trace: list[str] = []
 
+# The child logs this marker once jax.devices() returns — backend init is
+# the step that hangs silently through a dead accelerator tunnel (the
+# BENCH_r05 lesson: the child ate its FULL deadline producing nothing).
+_BACKEND_UP_MARKER = "backend up:"
+DEFAULT_INIT_DEADLINE_S = 90.0
+
+
+def _init_stalled(backend_up_seen: bool, elapsed_s: float,
+                  init_deadline_s: float) -> bool:
+    """Sub-deadline heartbeat: True when backend init has produced no
+    progress marker within its own (much shorter) deadline — the child
+    should be aborted NOW so the CPU fallback starts in minutes, not
+    after the whole budget burns."""
+    return (not backend_up_seen) and elapsed_s >= init_deadline_s
+
 
 def _run_child(env_base: dict | None, deadline_s: float) -> dict | None:
     """Run this script as a bench child with a hard deadline; return its
     parsed JSON result or None. The child is SIGKILLed on deadline —
     backend init through the remote-accelerator tunnel can hang
     uninterruptibly, so the watchdog must live in a different process.
-    Child stderr is teed: forwarded live to the driver log AND kept for
-    the fallback JSON's evidence trail."""
+    A sub-deadline heartbeat aborts much earlier when backend init shows
+    no progress at all (see _init_stalled). Child stderr is teed:
+    forwarded live to the driver log AND kept for the fallback JSON's
+    evidence trail."""
     env = dict(os.environ) if env_base is None else dict(env_base)
     env["OMNIA_BENCH_CHILD"] = "1"
     env["OMNIA_BENCH_CHILD_DEADLINE_S"] = str(deadline_s)
-    _log(f"child starting (deadline {deadline_s:.0f}s, "
-         f"platforms={env.get('JAX_PLATFORMS', 'default')})")
+    init_deadline = float(
+        os.environ.get("OMNIA_BENCH_INIT_DEADLINE_S", DEFAULT_INIT_DEADLINE_S)
+    )
+    _log(f"child starting (deadline {deadline_s:.0f}s, init sub-deadline "
+         f"{init_deadline:.0f}s, platforms={env.get('JAX_PLATFORMS', 'default')})")
     _last_child_trace.clear()
     proc = subprocess.Popen(
         [sys.executable, os.path.abspath(__file__)],
@@ -73,11 +93,14 @@ def _run_child(env_base: dict | None, deadline_s: float) -> dict | None:
     # One dedicated reader per pipe (communicate() would race the stderr
     # pump for the same fd and garble the evidence lines).
     out_buf: list[bytes] = []
+    backend_up = threading.Event()
 
     def pump_err():
         for raw in iter(proc.stderr.readline, b""):
             line = raw.decode(errors="replace").rstrip()
             print(line, file=sys.stderr, flush=True)
+            if _BACKEND_UP_MARKER in line:
+                backend_up.set()
             _last_child_trace.append(line)
             del _last_child_trace[:-8]
 
@@ -88,17 +111,33 @@ def _run_child(env_base: dict | None, deadline_s: float) -> dict | None:
                threading.Thread(target=pump_out, daemon=True)]
     for t in threads:
         t.start()
-    try:
-        proc.wait(timeout=deadline_s)
-    except subprocess.TimeoutExpired:
+
+    def _kill(reason: str) -> None:
         proc.kill()
         proc.wait()
         # Let the stderr pump drain the pipe buffer before the caller
         # snapshots the trace — the final lines are the evidence.
         for t in threads:
             t.join(timeout=10)
-        _log("child hit hard deadline; killed")
-        return None
+        _last_child_trace.append(f"[bench-watchdog] {reason}")
+        _log(f"child killed: {reason}")
+
+    start = time.monotonic()
+    while True:
+        try:
+            proc.wait(timeout=1.0)
+            break
+        except subprocess.TimeoutExpired:
+            elapsed = time.monotonic() - start
+            if elapsed >= deadline_s:
+                _kill(f"hard deadline {deadline_s:.0f}s")
+                return None
+            if _init_stalled(backend_up.is_set(), elapsed, init_deadline):
+                _kill(
+                    f"backend init produced no '{_BACKEND_UP_MARKER}' progress "
+                    f"within {init_deadline:.0f}s — aborting early for fallback"
+                )
+                return None
     for t in threads:
         t.join(timeout=10)
     out = b"".join(out_buf)
@@ -288,6 +327,19 @@ def child_main() -> None:
             _log(f"pallas A/B failed: {exc!r}")
             pallas_ab = {"error": repr(exc)}
 
+    # --- cross-session shared-prefix pool (engine/prefix_cache.py) ----
+    # N fresh sessions × one shared system prefix: the pack-serving
+    # shape the pool exists for. Runs on accel and CPU (the pool's win
+    # is a device copy vs a prefill — it shows on any backend).
+    prefix_cache = None
+    if remaining() > (90 if on_accel else 45):
+        try:
+            prefix_cache = _bench_prefix_cache(cfg, remaining, on_accel)
+            _log(f"prefix cache bench done: {prefix_cache}")
+        except Exception as exc:  # noqa: BLE001 - aux evidence only
+            _log(f"prefix cache bench failed: {exc!r}")
+            prefix_cache = {"error": repr(exc)}
+
     # --- honest CPU fallback (VERDICT r5 #10) -------------------------
     # No accelerator: a test-tiny float32 TTFT against the 400 ms TPU
     # target is meaningless, so the fallback drops vs_baseline entirely
@@ -325,6 +377,7 @@ def child_main() -> None:
                 "ttft_p50_ms": round(main_res["ttft_p50_ms"], 2),
                 "warmup_s": main_res["warmup_s"],
                 "scheduler_latency_ms_p50": sched,
+                "prefix_cache": prefix_cache,
                 "note": (
                     "vs_baseline intentionally omitted: CPU fallback "
                     "certifies engine overhead, not serving performance"
@@ -387,6 +440,8 @@ def child_main() -> None:
     }
     if pallas_ab is not None:
         result["aux"]["pallas_ab"] = pallas_ab
+    if prefix_cache is not None:
+        result["aux"]["prefix_cache"] = prefix_cache
     if w8 is not None:
         w8.pop("weight_bytes", None)
         result["aux"]["int8_dynamic"] = {
@@ -470,6 +525,82 @@ def _bench_pallas_ab(cfg, ecfg, remaining, iters: int = 50):
         else:
             os.environ["OMNIA_PALLAS_DECODE"] = prev
         attn._pallas_decode_mode.cache_clear()
+    return out
+
+
+def _bench_prefix_cache(cfg, remaining, on_accel, prefix_len=None,
+                        n_sessions=None):
+    """Shared-prefix scenario: N fresh sessions of one "pack" — every
+    prompt = one shared system prefix + a short unique user suffix —
+    measured with the cross-session prefix pool ON and (budget allowing)
+    OFF. The pool turns session 2+'s prefill into a device seed-copy +
+    suffix, so TTFT p50 over the warm sessions is the headline."""
+    import gc
+
+    from omnia_tpu.engine import EngineConfig, InferenceEngine, SamplingParams
+
+    if on_accel:
+        prefix_len = prefix_len or 512
+        n_sessions = n_sessions or 8
+        base = dict(
+            num_slots=8, max_seq=1024, prefill_buckets=(64, 256, 512),
+            dtype="bfloat16", decode_chunk=16, decode_chunk_variants=(16, 1),
+            max_sessions=0,
+        )
+    else:
+        prefix_len = prefix_len or 48
+        n_sessions = n_sessions or 4
+        base = dict(
+            num_slots=4, max_seq=128, prefill_buckets=(64,), dtype="float32",
+            max_sessions=0,
+        )
+    shared_prefix = [((7 * i) % 251) + 1 for i in range(prefix_len)]
+    sp = SamplingParams(temperature=0.0, max_tokens=4)
+
+    def run(pool_slots: int) -> dict:
+        ecfg = EngineConfig(prefix_cache_slots=pool_slots, **base)
+        engine = InferenceEngine(cfg, ecfg, seed=0)
+        engine.warmup(sessions=False)
+        engine.start()
+        try:
+            if pool_slots:
+                engine.register_prefix(shared_prefix)
+            ttfts = []
+            for i in range(n_sessions):
+                prompt = shared_prefix + [200 + i, 201 + i, 202 + i]
+                t0 = time.monotonic()
+                h = engine.submit(prompt, sp)
+                h.collect_tokens(timeout=300)
+                ttfts.append((h.first_token_at - t0) * 1000.0)
+            m = engine.metrics
+            return {
+                # Session 1 publishes (cold); the warm tail is the win.
+                "ttft_first_session_ms": round(ttfts[0], 2),
+                "ttft_p50_warm_ms": round(statistics.median(ttfts[1:]), 2),
+                "hit_tokens": m["prefix_cache_hit_tokens"],
+                "insertions": m["prefix_cache_insertions"],
+                "evictions": m["prefix_cache_evictions"],
+            }
+        finally:
+            engine.stop()
+            del engine
+            gc.collect()
+
+    out = {"prefix_len": prefix_len, "sessions": n_sessions}
+    with_pool = run(pool_slots=4)
+    out["with_pool"] = with_pool
+    out["ttft_p50_ms"] = with_pool["ttft_p50_warm_ms"]
+    out["hit_tokens"] = with_pool["hit_tokens"]
+    if remaining() > (120 if on_accel else 30):
+        without = run(pool_slots=0)
+        out["without_pool"] = without
+        if without["ttft_p50_warm_ms"] > 0:
+            out["ttft_speedup"] = round(
+                without["ttft_p50_warm_ms"] / max(with_pool["ttft_p50_warm_ms"], 1e-6),
+                3,
+            )
+    else:
+        out["without_pool"] = {"skipped": "budget"}
     return out
 
 

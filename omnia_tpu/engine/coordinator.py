@@ -17,11 +17,16 @@ Routing:
 - Sessionful requests pin to the worker holding their resident KV
   (cross-turn prefix reuse only pays off on the same worker). The
   affinity map is coordinator-owned state.
-- Fresh requests go to the least-loaded healthy worker (queue depth +
-  active slots).
-- An unhealthy worker's sessions fail over: affinity drops, the next
-  turn lands elsewhere and re-prefills — the session-KV contract
-  (rebuild-on-miss) makes that a latency cost, never a correctness one.
+- FRESH sessions route by prompt-prefix affinity: requests sharing a
+  prompt head (the pack's rendered system block) land on the same
+  worker, so that worker's shared-prefix pool (engine/prefix_cache.py)
+  serves them all instead of every worker re-prefilling its own copy.
+  Least-loaded spill guards against hot-pack pile-up; short prompts
+  (nothing worth pooling) go straight to least-loaded.
+- An unhealthy worker's sessions AND prefix pins fail over: affinity
+  drops, the next request lands elsewhere and re-prefills — the
+  rebuild-on-miss contract makes that a latency cost, never a
+  correctness one.
 """
 
 from __future__ import annotations
@@ -37,7 +42,13 @@ logger = logging.getLogger(__name__)
 
 
 class EngineCoordinator:
-    def __init__(self, workers: Sequence, max_affinity: int = 100_000) -> None:
+    def __init__(
+        self,
+        workers: Sequence,
+        max_affinity: int = 100_000,
+        prefix_route_min_tokens: int = 32,
+        prefix_spill_load: int = 8,
+    ) -> None:
         if not workers:
             raise ValueError("coordinator needs at least one worker")
         self.workers = list(workers)
@@ -47,9 +58,29 @@ class EngineCoordinator:
         # costs a re-prefill if the worker still held the KV — the same
         # rebuild-on-miss contract failover relies on.
         self._affinity: "collections.OrderedDict[str, int]" = collections.OrderedDict()
+        # Prefix-affinity for FRESH sessions: prompt-head key → worker.
+        # Same LRU bound and rebuild-on-miss contract as sessions.
+        self._prefix_affinity: "collections.OrderedDict[str, int]" = (
+            collections.OrderedDict()
+        )
         self.max_affinity = max_affinity
+        # Prompts shorter than this derive no prefix key (a head that
+        # small is not worth pooling — least-loaded wins outright).
+        self.prefix_route_min_tokens = prefix_route_min_tokens
+        # Spill threshold: when the pinned worker's load exceeds the
+        # least-loaded worker's by more than this, route the request to
+        # the least-loaded worker (the pin survives — one re-prefill on
+        # the spill target beats piling a hot pack onto one worker).
+        self.prefix_spill_load = prefix_spill_load
         self._lock = threading.Lock()
-        self.metrics = {"routed": 0, "failovers": 0, "affinity_evictions": 0}
+        self.metrics = {
+            "routed": 0,
+            "failovers": 0,
+            "affinity_evictions": 0,
+            "prefix_routed": 0,
+            "prefix_failovers": 0,
+            "prefix_spills": 0,
+        }
 
     # -- health / load -------------------------------------------------
 
@@ -92,7 +123,25 @@ class EngineCoordinator:
 
     # -- routing -------------------------------------------------------
 
-    def _pick(self, session_id: Optional[str]) -> Optional[int]:
+    def _prefix_key(
+        self, prompt_tokens: list[int], prefix_key: Optional[str]
+    ) -> Optional[str]:
+        """Routing key for a fresh session's shared prefix: an explicit
+        caller key (e.g. pack name@version) wins; otherwise the prompt
+        head hashes into one — sessions of the same pack share their
+        rendered system block, so their heads collide by construction."""
+        if prefix_key is not None:
+            return prefix_key
+        if len(prompt_tokens) < self.prefix_route_min_tokens:
+            return None
+        return f"h{hash(tuple(prompt_tokens[: self.prefix_route_min_tokens]))}"
+
+    def _pick(
+        self,
+        session_id: Optional[str],
+        prompt_tokens: list[int] = (),
+        prefix_key: Optional[str] = None,
+    ) -> Optional[int]:
         healthy = set(self._healthy_indices())
         if not healthy:
             return None
@@ -107,7 +156,33 @@ class EngineCoordinator:
                     # is gone; the new worker re-prefills from scratch.
                     del self._affinity[session_id]
                     self.metrics["failovers"] += 1
-            choice = min(healthy, key=self._load)
+            # Fresh session (or sessionless): prefix-affinity routing.
+            choice = None
+            key = self._prefix_key(list(prompt_tokens), prefix_key)
+            if key is not None:
+                pinned = self._prefix_affinity.get(key)
+                if pinned is not None and pinned not in healthy:
+                    # Worker died: the pin fails over — the next healthy
+                    # worker re-prefills (and republishes) from scratch.
+                    del self._prefix_affinity[key]
+                    self.metrics["prefix_failovers"] += 1
+                    pinned = None
+                if pinned is not None:
+                    least = min(healthy, key=self._load)
+                    if self._load(pinned) - self._load(least) > self.prefix_spill_load:
+                        self.metrics["prefix_spills"] += 1
+                        choice = least  # spill; the pin survives
+                    else:
+                        self._prefix_affinity.move_to_end(key)
+                        self.metrics["prefix_routed"] += 1
+                        choice = pinned
+            if choice is None:
+                choice = min(healthy, key=self._load)
+            if key is not None and key not in self._prefix_affinity:
+                self._prefix_affinity[key] = choice
+                while len(self._prefix_affinity) > self.max_affinity:
+                    self._prefix_affinity.popitem(last=False)
+                    self.metrics["affinity_evictions"] += 1
             if session_id is not None:
                 self._affinity[session_id] = choice
                 self._affinity.move_to_end(session_id)
@@ -116,13 +191,25 @@ class EngineCoordinator:
                     self.metrics["affinity_evictions"] += 1
             return choice
 
+    def register_prefix(self, tokens) -> None:
+        """Register a pack prefix with every worker's shared-prefix pool
+        (workers without a pool ignore it)."""
+        for w in self.workers:
+            reg = getattr(w, "register_prefix", None)
+            if reg is not None:
+                try:
+                    reg(tokens)
+                except Exception:
+                    logger.warning("register_prefix failed on a worker")
+
     def submit(
         self,
         prompt_tokens: list[int],
         params: SamplingParams = SamplingParams(),
         session_id: Optional[str] = None,
+        prefix_key: Optional[str] = None,
     ) -> RequestHandle:
-        idx = self._pick(session_id)
+        idx = self._pick(session_id, prompt_tokens, prefix_key)
         if idx is None:
             handle = RequestHandle("req-unrouted")
             handle._push(StreamEvent(

@@ -49,6 +49,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from omnia_tpu.engine.prefix_cache import PrefixPool, _PrefixCacheMixin
 from omnia_tpu.engine.programs import build_programs
 from omnia_tpu.engine.scheduler import _SchedulerMixin
 from omnia_tpu.engine.sessions import _SessionKV, _SessionMixin, _Slot
@@ -78,7 +79,9 @@ logger = logging.getLogger(__name__)
 MAX_DEVICE_STOP_IDS = 8
 
 
-class InferenceEngine(_SchedulerMixin, _SessionMixin, _SpecDecodeMixin):
+class InferenceEngine(
+    _SchedulerMixin, _SessionMixin, _SpecDecodeMixin, _PrefixCacheMixin
+):
     """Slot-based continuous-batching engine over one model."""
 
     def __init__(
@@ -155,6 +158,23 @@ class InferenceEngine(_SchedulerMixin, _SessionMixin, _SpecDecodeMixin):
         self.params = params
 
         self._seed = seed
+        # Cross-session shared-prefix pool (engine/prefix_cache.py).
+        # Host-side books live here; the device arrays (_pk/_pv) are
+        # (re)allocated with the caches in _init_device_state. The pool
+        # LRU shares the engine's logical clock (lambda defers the
+        # lookup — self.clock is injectable for multi-host lockstep).
+        self._prefix_pool: Optional[PrefixPool] = None
+        self._pending_prefix_regs: list[list[int]] = []
+        if engine_cfg.prefix_cache_slots > 0:
+            if self._mesh is not None and (
+                engine_cfg.prefix_cache_slots % max(engine_cfg.dp, 1) != 0
+            ):
+                raise ValueError("prefix_cache_slots must be divisible by dp")
+            self._prefix_pool = PrefixPool(
+                engine_cfg.prefix_cache_slots,
+                engine_cfg.prefix_cache_host_entries,
+                clock=lambda: self.clock(),
+            )
         self._init_device_state()
 
         B = engine_cfg.num_slots
@@ -192,9 +212,16 @@ class InferenceEngine(_SchedulerMixin, _SessionMixin, _SpecDecodeMixin):
             "prefill_steps": 0,
             "decode_steps": 0,
             "extend_steps": 0,
+            "prefill_tokens": 0,
             "prefix_reuse_tokens": 0,
             "session_offloads": 0,
             "session_restores": 0,
+            # Cross-session shared-prefix pool (engine/prefix_cache.py).
+            "prefix_cache_hit_tokens": 0,
+            "prefix_cache_insertions": 0,
+            "prefix_cache_evictions": 0,
+            "prefix_cache_host_hits": 0,
+            "prefix_cache_offload_elisions": 0,
             "decode_dispatch_s": 0.0,
             "decode_sync_s": 0.0,
             "prefill_dispatch_s": 0.0,
@@ -221,6 +248,9 @@ class InferenceEngine(_SchedulerMixin, _SessionMixin, _SpecDecodeMixin):
         self._offload_fn = progs.offload
         self._restore_fn = progs.restore
         self._verify_fn = progs.verify
+        self._prefix_store_fn = progs.prefix_store
+        self._prefix_seed_fn = progs.prefix_seed
+        self._prefix_offload_fn = progs.prefix_offload
         from omnia_tpu.ops.attention import pallas_decode_mode
 
         logger.info(
@@ -243,6 +273,24 @@ class InferenceEngine(_SchedulerMixin, _SessionMixin, _SpecDecodeMixin):
             ck = jax.device_put(ck, tree[0])
             cv = jax.device_put(cv, tree[1])
         self._ck, self._cv = ck, cv
+
+        # Shared-prefix pool arrays: [L, P, R, H, D] beside the slot
+        # cache, same layout/sharding (P over dp, heads over tp). A
+        # reallocation means any device-resident pool entries died with
+        # the caches; host-paged entries survive in the pool's books.
+        self._pk = self._pv = None
+        if self._prefix_pool is not None:
+            R = self.cfg.prefix_buckets()[-1]
+            pk, pv = llama.init_kv_cache(
+                self.model_cfg, self.cfg.prefix_cache_slots, R, dtype=self._dtype
+            )
+            if self._mesh is not None:
+                pk = jax.device_put(pk, tree[0])
+                pv = jax.device_put(pv, tree[1])
+            self._pk, self._pv = pk, pv
+            self._prefix_pool.on_device_reset()
+            if hasattr(self, "metrics"):  # absent on first (construction) call
+                self.metrics["prefix_cache_evictions"] = self._prefix_pool.evictions
 
         self._tokens = jnp.zeros((B,), jnp.int32)       # last sampled token
         self._positions = jnp.zeros((B,), jnp.int32)    # next write row
@@ -281,7 +329,14 @@ class InferenceEngine(_SchedulerMixin, _SessionMixin, _SpecDecodeMixin):
         kd = self._key_data[0]
         zero = jnp.int32(0)
         sargs = (kd, jnp.float32(0.0), jnp.float32(1.0), jnp.int32(0))
-        extend_shapes = set(self.cfg.usable_buckets()) | {1} if sessions else set()
+        # Suffix prefill after a shared-prefix seed rides the extend
+        # family, so an enabled pool warms it even for sessionless
+        # serving (the bench's shared-prefix scenario).
+        extend_shapes = (
+            set(self.cfg.usable_buckets()) | {1}
+            if sessions or self._prefix_enabled()
+            else set()
+        )
         for b in sorted(set(self.cfg.usable_buckets()) | extend_shapes):
             toks = jnp.zeros((1, b), jnp.int32)
             pos = jnp.arange(b, dtype=jnp.int32)[None, :]
@@ -312,6 +367,23 @@ class InferenceEngine(_SchedulerMixin, _SessionMixin, _SpecDecodeMixin):
             for r in self.cfg.restore_buckets():
                 k, v = self._offload_fn(self._ck, self._cv, zero, r)
                 self._ck, self._cv = self._restore_fn(self._ck, self._cv, k, v, zero)
+        if self._prefix_enabled():
+            # Pool transfers per prefix bucket: store (slot→pool), seed
+            # (pool→slot), demote (pool→host), and the host-hit restore
+            # path with the SAME scalar types placement dispatches
+            # (python-int slot/pool indices, static row bucket).
+            for b in self.cfg.prefix_buckets():
+                self._pk, self._pv = self._prefix_store_fn(
+                    self._pk, self._pv, self._ck, self._cv, 0, 0, b
+                )
+                self._ck, self._cv = self._prefix_seed_fn(
+                    self._ck, self._cv, self._pk, self._pv, 0, 0, b
+                )
+                k, v = self._prefix_offload_fn(self._pk, self._pv, 0, b)
+                self._ck, self._cv = self._restore_fn(
+                    self._ck, self._cv,
+                    jnp.asarray(np.asarray(k)), jnp.asarray(np.asarray(v)), 0,
+                )
         if self._verify_fn is not None:
             B, K1 = self.cfg.num_slots, self.cfg.spec_decode + 1
             self._ck, self._cv, _ = self._verify_fn(
@@ -491,12 +563,21 @@ class InferenceEngine(_SchedulerMixin, _SessionMixin, _SpecDecodeMixin):
         sp = request.params
         usable = self.cfg.usable_buckets()
         t_prefill = time.monotonic()
-        if reuse == 0 and n <= max(usable):
+        # No same-session rows to extend from: longest-prefix-match the
+        # cross-session pool and seed-copy the shared rows, so a FRESH
+        # session of a known pack prefills only its suffix.
+        seeded = 0
+        if reuse == 0:
+            seeded = self._try_seed_from_pool(slot_idx, prompt, sess)
+        frontier = reuse or seeded
+        if frontier == 0 and n <= max(usable):
             first_tok = self._fresh_prefill(slot_idx, prompt, sp)
         else:
-            first_tok = self._chunked_extend(slot_idx, prompt, reuse, sp)
+            first_tok = self._chunked_extend(slot_idx, prompt, frontier, sp)
+        self._maybe_publish_prefix(slot_idx, prompt)
         self.metrics["prefill_dispatch_s"] += time.monotonic() - t_prefill
         self.metrics["prefix_reuse_tokens"] += reuse
+        self.metrics["prefill_tokens"] += n - frontier
         self.metrics["prefill_steps"] += 1
 
         slot = self._slots[slot_idx]
@@ -688,6 +769,7 @@ class InferenceEngine(_SchedulerMixin, _SessionMixin, _SpecDecodeMixin):
                         error=msg,
                     )
                 )
+                self._release_slot_seed(slot)
                 slot.clear()
 
     def generate(

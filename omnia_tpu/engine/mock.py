@@ -92,6 +92,9 @@ class MockEngine:
     def warmup(self, sessions: bool = True):
         pass
 
+    def register_prefix(self, tokens) -> None:
+        """Interface parity with InferenceEngine; the mock has no KV."""
+
     def queue_depth(self) -> int:
         return 0
 

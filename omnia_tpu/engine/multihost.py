@@ -148,6 +148,15 @@ class LockstepEngine:
                 json.dumps({"op": "release", "session_id": session_id}).encode()
             )
 
+    def register_prefix(self, tokens) -> None:
+        """Pack-prefix registration is an event: the shared-prefix pool's
+        publish/evict decisions must replay identically on every process
+        (a diverging pool would diverge the compiled-step streams)."""
+        with self._lock:
+            self._pending.append(
+                json.dumps({"op": "register", "tokens": list(tokens)}).encode()
+            )
+
     def _enqueue_cancel(self, rid: str) -> None:
         with self._lock:
             self._pending.append(
@@ -373,6 +382,8 @@ class LockstepEngine:
                 real.cancel()
         elif op == "release":
             self.engine.release_session(ev["session_id"])
+        elif op == "register":
+            self.engine.register_prefix(ev["tokens"])
         # Bound the map WITHOUT evicting live requests: a trimmed live
         # handle would turn its future cancel event into a silent no-op
         # on every rank. Liveness comes from the engine's own books.

@@ -26,6 +26,11 @@ through):
 - ``offload`` / ``restore`` — session paging: pull/push one slot's
   leading KV rows in fixed restore-bucket shapes (device↔host transfers
   stay compile-stable).
+- ``prefix_store`` / ``prefix_seed`` / ``prefix_offload`` — shared-prefix
+  pool transfers (engine/prefix_cache.py): copy a slot's leading rows
+  into a pool entry, seed-copy a pool entry into a fresh slot, and pull
+  a pool entry to host RAM for the paged tier. All device↔device (store
+  and seed never cross the host link) in fixed prefix-bucket shapes.
 
 Replaces the reference's provider-relay hot path (it has no on-device
 programs at all — internal/runtime/provider.go streams vendor SSE); the
@@ -58,6 +63,10 @@ class EnginePrograms:
     offload: Callable
     restore: Callable
     verify: Optional[Callable]  # speculative-decode verify (spec_decode > 0)
+    # Shared-prefix pool transfers (prefix_cache_slots > 0, else None).
+    prefix_store: Optional[Callable]
+    prefix_seed: Optional[Callable]
+    prefix_offload: Optional[Callable]
 
 
 def build_programs(
@@ -241,6 +250,61 @@ def build_programs(
     # greedy argmax over every position is the acceptance oracle. The
     # cache rows for rejected proposals are garbage at rows ≥ the slot's
     # new frontier — the same invariant the decode finish-mask relies on.
+    # Shared-prefix pool transfers. store: slot rows → pool entry (pool
+    # donated); seed: pool entry → slot rows (cache donated) — the
+    # device-to-device copy that replaces a fresh session's shared-prefix
+    # prefill; prefix_offload: pool entry → host (paged tier; promotion
+    # back rides the slot restore program). All take a static row bucket.
+    prefix_store_fn = prefix_seed_fn = prefix_offload_fn = None
+    if ecfg.prefix_cache_slots > 0:
+        def prefix_store(pool_k, pool_v, ck, cv, slot, pool_idx, rows: int):
+            L, B, S, H, D = ck.shape
+            k = jax.lax.dynamic_slice(ck, (0, slot, 0, 0, 0), (L, 1, rows, H, D))
+            v = jax.lax.dynamic_slice(cv, (0, slot, 0, 0, 0), (L, 1, rows, H, D))
+            pool_k = jax.lax.dynamic_update_slice(
+                pool_k, k.astype(pool_k.dtype), (0, pool_idx, 0, 0, 0)
+            )
+            pool_v = jax.lax.dynamic_update_slice(
+                pool_v, v.astype(pool_v.dtype), (0, pool_idx, 0, 0, 0)
+            )
+            return pool_k, pool_v
+
+        prefix_store_fn = jax.jit(
+            prefix_store, donate_argnums=(0, 1), static_argnums=(6,)
+        )
+
+        def prefix_seed(ck, cv, pool_k, pool_v, pool_idx, slot, rows: int):
+            L, P, R, H, D = pool_k.shape
+            k = jax.lax.dynamic_slice(
+                pool_k, (0, pool_idx, 0, 0, 0), (L, 1, rows, H, D)
+            )
+            v = jax.lax.dynamic_slice(
+                pool_v, (0, pool_idx, 0, 0, 0), (L, 1, rows, H, D)
+            )
+            ck = jax.lax.dynamic_update_slice(
+                ck, k.astype(ck.dtype), (0, slot, 0, 0, 0)
+            )
+            cv = jax.lax.dynamic_update_slice(
+                cv, v.astype(cv.dtype), (0, slot, 0, 0, 0)
+            )
+            return ck, cv
+
+        prefix_seed_fn = jax.jit(
+            prefix_seed, donate_argnums=(0, 1), static_argnums=(6,)
+        )
+
+        def prefix_offload(pool_k, pool_v, pool_idx, rows: int):
+            L, P, R, H, D = pool_k.shape
+            k = jax.lax.dynamic_slice(
+                pool_k, (0, pool_idx, 0, 0, 0), (L, 1, rows, H, D)
+            )
+            v = jax.lax.dynamic_slice(
+                pool_v, (0, pool_idx, 0, 0, 0), (L, 1, rows, H, D)
+            )
+            return k[:, 0], v[:, 0]
+
+        prefix_offload_fn = jax.jit(prefix_offload, static_argnums=(3,))
+
     verify_fn = None
     if ecfg.spec_decode > 0:
         def verify(params, ck, cv, tokens, positions, write_start):
@@ -262,4 +326,7 @@ def build_programs(
         offload=offload_fn,
         restore=restore_fn,
         verify=verify_fn,
+        prefix_store=prefix_store_fn,
+        prefix_seed=prefix_seed_fn,
+        prefix_offload=prefix_offload_fn,
     )

@@ -33,6 +33,7 @@ class _SchedulerMixin:
     def step(self) -> bool:
         """One scheduling step. Returns True if any work was done."""
         self._drain_releases()
+        self._drain_prefix_regs()
         self._reap_cancelled()
         did = False
         with self._lock:
@@ -49,7 +50,7 @@ class _SchedulerMixin:
         # sessions' requests while slots sit free.
         pending = None
         slot_idx = None
-        for cand in waiting:
+        for cand in self._admission_order(waiting):
             idx = self._slot_for(cand[0])
             if idx is not None:
                 pending, slot_idx = cand, idx
@@ -83,6 +84,7 @@ class _SchedulerMixin:
                 )
                 self._drop_session(request.session_id)
                 self._slots[slot_idx].session_id = None
+                self._release_slot_seed(self._slots[slot_idx])
                 self._slots[slot_idx].clear()
                 raise
             did = True
@@ -112,6 +114,58 @@ class _SchedulerMixin:
             self._process_oldest_chunk()
             did = True
         return did
+
+    # Admission fairness window: requests older than this keep strict
+    # FIFO priority regardless of estimated prefill cost.
+    _ADMIT_FAIRNESS_S = 0.5
+    # Cost estimation is O(prompt-length radix walk); bound it to the
+    # queue head so a deep backlog doesn't tax every step.
+    _ADMIT_WINDOW = 8
+
+    def _admission_order(self, waiting):
+        """Seeded-length-aware admission: within the young head of the
+        queue, place the request with the cheapest estimated prefill
+        first — a fresh session whose prompt is mostly covered by the
+        shared-prefix pool (or its own session rows) costs a seed-copy
+        plus a short suffix, so admitting it ahead of a long cold
+        prefill lowers TTFT p50 without starving anyone (requests past
+        the fairness window keep strict FIFO)."""
+        if len(waiting) < 2 or not self._prefix_enabled():
+            return waiting
+        if self.clock is not time.monotonic:
+            # Replicated engines (multi-host lockstep) must keep the
+            # leader's submit order: the fairness age below is measured
+            # against each rank's LOCAL submitted_at, so a reorder could
+            # differ per rank and diverge the compiled-step streams.
+            return waiting
+        # Same clock domain as Request.submitted_at (time.monotonic) —
+        # NOT self.clock, which may be an injected logical clock.
+        now = time.monotonic()
+        head = waiting[: self._ADMIT_WINDOW]
+
+        def key(item):
+            idx, (req, _h) = item
+            if now - req.submitted_at >= self._ADMIT_FAIRNESS_S:
+                return (0, idx, 0)
+            return (1, self._estimated_prefill_cost(req), idx)
+
+        ordered = [it for _, it in sorted(enumerate(head), key=key)]
+        return ordered + waiting[self._ADMIT_WINDOW:]
+
+    def _estimated_prefill_cost(self, req) -> int:
+        """Tokens this request would actually prefill: prompt length
+        minus the better of its session's resident-row LCP and the
+        shared-prefix pool match."""
+        prompt = req.prompt_tokens
+        covered = self._prefix_match_len(prompt)
+        if req.session_id and self.cfg.max_sessions > 0:
+            sess = self._sessions.get(req.session_id)
+            if sess is not None:
+                lcp, limit = 0, min(len(sess.token_ids), len(prompt) - 1)
+                while lcp < limit and sess.token_ids[lcp] == prompt[lcp]:
+                    lcp += 1
+                covered = max(covered, lcp)
+        return len(prompt) - min(covered, len(prompt) - 1)
 
     def _dispatch_ahead_useful(self) -> bool:
         """True if at least one active slot's generation budget extends past
@@ -299,6 +353,7 @@ class _SchedulerMixin:
             quiesce_row = len(sess.token_ids)
         elif sess is not None:
             self._drop_session(sid)
+        self._release_slot_seed(slot)
         slot.clear()
         # Quiesce the slot: decode keeps running over it (static shape), but
         # with active=False its position is frozen, so it only ever rewrites

@@ -35,6 +35,7 @@ class _Slot:
         "session_id",
         "emitted",
         "spec_index",
+        "seeded_from",
     )
 
     def __init__(self):
@@ -47,6 +48,10 @@ class _Slot:
         self.session_id: Optional[str] = None  # pinned session (may be idle)
         self.emitted: list[int] = []           # tokens emitted this request
         self.spec_index = None   # lazy per-request n-gram index (spec_decode)
+        # Shared-prefix pool entry a SESSIONLESS request seeded from —
+        # pins the entry until finish (sessionful seeds pin via
+        # _SessionKV.seeded_from instead). Engine releases before clear().
+        self.seeded_from: Optional[int] = None
 
     @property
     def active(self) -> bool:
@@ -59,6 +64,7 @@ class _Slot:
         self.generated = 0
         self.emitted = []
         self.spec_index = None
+        self.seeded_from = None
 
 
 class _SessionKV:
@@ -73,7 +79,10 @@ class _SessionKV:
     timing.
     """
 
-    __slots__ = ("session_id", "token_ids", "slot", "host_k", "host_v", "last_used")
+    __slots__ = (
+        "session_id", "token_ids", "slot", "host_k", "host_v", "last_used",
+        "seeded_from",
+    )
 
     def __init__(self, session_id: str, now: Optional[float] = None):
         self.session_id = session_id
@@ -82,6 +91,10 @@ class _SessionKV:
         self.host_k: Optional[np.ndarray] = None  # [L, R, H, D] padded rows
         self.host_v: Optional[np.ndarray] = None
         self.last_used = time.monotonic() if now is None else now
+        # Shared-prefix pool entry this session seeded from: pins the
+        # entry's rows for the session's lifetime (dropping the session
+        # decrefs — the pool may then evict them).
+        self.seeded_from: Optional[int] = None
 
 
 class _SessionMixin:
@@ -125,10 +138,19 @@ class _SessionMixin:
     def _offload_session(self, sess: _SessionKV) -> None:
         """Page an idle session's valid KV rows to host RAM and unpin its
         slot. Rows move in a fixed restore-bucket shape so the transfer
-        program is compile-stable."""
+        program is compile-stable.
+
+        Seeded-length accounting: when the shared-prefix pool fully
+        covers the session's valid rows, the host copy is elided — the
+        rows are reconstructible by a device-side pool seed (cheaper
+        than a host restore), so the session just forgets them and the
+        next turn rebuilds through the pool-match path."""
         slot_idx = sess.slot
         valid = len(sess.token_ids)
-        if valid > 0:
+        if valid > 0 and self._prefix_covered(sess.token_ids):
+            sess.token_ids = []
+            self.metrics["prefix_cache_offload_elisions"] += 1
+        elif valid > 0:
             rows = self.cfg.restore_bucket_for(valid)
             k, v = self._offload_fn(self._ck, self._cv, slot_idx, rows)
             sess.host_k = np.asarray(k)
@@ -154,6 +176,9 @@ class _SessionMixin:
         sess = self._sessions.pop(sid, None)
         if sess is not None and sess.slot is not None:
             self._slots[sess.slot].session_id = None
+        if sess is not None:
+            # Unpin the shared-prefix entry this session seeded from.
+            self._prefix_decref(sess.seeded_from)
 
     def release_session(self, session_id: str) -> None:
         """Forget a session's cached KV (conversation ended / TTL expired).

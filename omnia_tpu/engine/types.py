@@ -190,6 +190,27 @@ class EngineConfig:
     # activation quant, int8×int8 MXU path — fastest). Dense models only;
     # see models/quant.py.
     quant: Optional[str] = None
+    # Cross-SESSION shared-prefix KV pool (engine/prefix_cache.py): a
+    # device-resident, radix-matched cache of refcounted prompt prefixes
+    # (pack system blocks, tool schemas) so a FRESH session seed-copies
+    # the shared rows and prefills only its suffix. This many pool
+    # entries are allocated beside the slot cache; 0 disables the pool
+    # entirely (no allocation, no programs — a true no-op path).
+    prefix_cache_slots: int = 0
+    # Max KV rows cached per pool entry; 0 = max_seq. Longer prefixes
+    # cache their leading rows only (the tail re-prefills).
+    prefix_cache_rows: int = 0
+    # A prefix publishes into the pool once seen this many times across
+    # placements (radix LCP of fresh prompts). Prefixes registered via
+    # register_prefix() (pack system blocks) publish on first sight.
+    prefix_cache_publish_threshold: int = 2
+    # Prefixes shorter than this never publish or seed — a row copy that
+    # saves fewer tokens than this is not worth the dispatch.
+    prefix_cache_min_tokens: int = 8
+    # Host-paged tier: entries LRU-demoted off the device pool keep their
+    # rows in host RAM up to this count (restore machinery pages them
+    # back through a slot on the next hit). 0 = evicted entries drop.
+    prefix_cache_host_entries: int = 32
 
     def chunk_variants(self) -> tuple[int, ...]:
         """Compiled decode-chunk sizes, descending, always containing
@@ -220,6 +241,24 @@ class EngineConfig:
             if n <= b:
                 return b
         raise ValueError(f"{n} rows exceed max_seq {self.max_seq}")
+
+    def prefix_rows(self) -> int:
+        """Row capacity of one shared-prefix pool entry."""
+        rows = self.prefix_cache_rows or self.max_seq
+        return min(rows, self.max_seq)
+
+    def prefix_buckets(self) -> tuple[int, ...]:
+        """Row counts for shared-prefix pool transfers (store / seed-copy /
+        demote): the restore buckets that fit a pool entry — the same
+        fixed-shape discipline that keeps session paging compile-stable."""
+        buckets = tuple(b for b in self.restore_buckets() if b <= self.prefix_rows())
+        return buckets or self.restore_buckets()[:1]
+
+    def prefix_bucket_for(self, n: int) -> int:
+        for b in self.prefix_buckets():
+            if n <= b:
+                return b
+        return self.prefix_buckets()[-1]
 
     def usable_buckets(self) -> tuple[int, ...]:
         """Prefill buckets that fit the KV cache (a bucket's chunk is

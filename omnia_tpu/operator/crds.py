@@ -135,6 +135,9 @@ def _provider_schema() -> dict:
                 "tp": _INT,
                 "decodeChunk": _INT,
                 "maxSessions": _INT,
+                # Cross-session shared-prefix KV pool (docs/serving.md).
+                "prefixCacheSlots": _INT,
+                "prefixCacheRows": _INT,
             }),
         },
         required=["type"],

@@ -20,6 +20,7 @@ never streamed as text.
 from __future__ import annotations
 
 import json
+import logging
 import queue
 import threading
 import time
@@ -46,6 +47,8 @@ from omnia_tpu.runtime.contract import (
 from omnia_tpu.runtime.packs import PromptPack
 from omnia_tpu.runtime.providers import ProviderSpec
 from omnia_tpu.tools import ToolExecutor
+
+logger = logging.getLogger(__name__)
 
 TOOL_OPEN = "<tool_call>"
 TOOL_CLOSE = "</tool_call>"
@@ -113,16 +116,15 @@ class ToolCallStreamParser:
         return rest
 
 
-def render_prompt(
+def render_system_block(
     pack: PromptPack,
-    state: ConversationState,
     params: Optional[dict] = None,
     memory_block: str = "",
     extra_tools: Optional[list] = None,
 ) -> str:
-    """Chat-format the conversation for the model. Tool declarations ride in
-    the system block so the model knows the call convention; ambient
-    memories (when a memory capability is wired) land there too."""
+    """The ``[SYS]...[/SYS]`` head of every prompt of this pack. Rendered
+    WITHOUT per-user memory it is identical across sessions — which is
+    what the engine's cross-session shared-prefix pool keys on."""
     parts = [f"[SYS]{pack.render_system(params)}"]
     if memory_block:
         parts.append(f"\n{memory_block}")
@@ -136,6 +138,20 @@ def render_prompt(
         )
         parts.append(f"\n[TOOLS]{tool_desc}[/TOOLS]")
     parts.append("[/SYS]\n")
+    return "".join(parts)
+
+
+def render_prompt(
+    pack: PromptPack,
+    state: ConversationState,
+    params: Optional[dict] = None,
+    memory_block: str = "",
+    extra_tools: Optional[list] = None,
+) -> str:
+    """Chat-format the conversation for the model. Tool declarations ride in
+    the system block so the model knows the call convention; ambient
+    memories (when a memory capability is wired) land there too."""
+    parts = [render_system_block(pack, params, memory_block, extra_tools)]
     for turn in state.turns:
         if turn.role == "user":
             parts.append(f"[USER]{turn.content}[/USER]\n")
@@ -183,6 +199,18 @@ class Conversation:
         self._turn_lock = threading.Lock()
         self._active_handle = None
         self._cancel_requested = threading.Event()
+        # Hand the pack's rendered system block to the engine's
+        # cross-session shared-prefix pool: every session of this pack
+        # prefills the same head, so registering it means session 2
+        # onward seed-copies those KV rows instead of re-prefilling.
+        # Best-effort — a pack whose params fail to render here fails
+        # the same way at turn time, with the real error surface.
+        if hasattr(engine, "register_prefix"):
+            try:
+                sys_block = render_system_block(pack, self.pack_params)
+                engine.register_prefix(tokenizer.encode(sys_block))
+            except Exception:
+                logger.debug("pack prefix registration skipped", exc_info=True)
 
     # ------------------------------------------------------------------
 

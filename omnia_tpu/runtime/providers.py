@@ -72,7 +72,10 @@ def build_engine(spec: ProviderSpec, *, warmup: bool = False):
             for k, v in spec.options.items()
             if k in {"num_slots", "max_seq", "prefill_buckets", "dtype",
                      "dp", "tp", "decode_chunk", "decode_pipeline",
-                     "spec_decode", "quant", "max_sessions"}
+                     "spec_decode", "quant", "max_sessions",
+                     "prefix_cache_slots", "prefix_cache_rows",
+                     "prefix_cache_publish_threshold",
+                     "prefix_cache_min_tokens", "prefix_cache_host_entries"}
         }
         if "prefill_buckets" in eng_kwargs:
             eng_kwargs["prefill_buckets"] = tuple(eng_kwargs["prefill_buckets"])
