@@ -1,3 +1,14 @@
+"""Serving engine package.
+
+Engine classes load lazily (PEP 562): the request/config types are
+jax-free, so importing this package — or a jax-free subpackage like
+``omnia_tpu.engine.grammar`` — initializes no device backend. The guards
+suite pins that property (the grammar=off no-op contract); the same
+lazy-__init__ pattern the facade package uses.
+"""
+
+import importlib
+
 from omnia_tpu.engine.types import (
     EngineConfig,
     FinishReason,
@@ -6,8 +17,11 @@ from omnia_tpu.engine.types import (
     SamplingParams,
     StreamEvent,
 )
-from omnia_tpu.engine.engine import InferenceEngine
-from omnia_tpu.engine.mock import MockEngine
+
+_LAZY = {
+    "InferenceEngine": "omnia_tpu.engine.engine",
+    "MockEngine": "omnia_tpu.engine.mock",
+}
 
 __all__ = [
     "EngineConfig",
@@ -19,3 +33,14 @@ __all__ = [
     "SamplingParams",
     "StreamEvent",
 ]
+
+
+def __getattr__(name: str):
+    target = _LAZY.get(name)
+    if target is None:
+        raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+    return getattr(importlib.import_module(target), name)
+
+
+def __dir__():
+    return sorted(set(globals()) | set(_LAZY))

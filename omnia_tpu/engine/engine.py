@@ -31,8 +31,9 @@ Architecture (TPU-first):
 
 Module layout (one seam per concern): compiled programs live in
 ``programs.py``, the dispatch/pipeline policy in ``scheduler.py``, slot
-and session-KV residency in ``sessions.py``; this module owns
-construction, request placement, warmup, and the thread lifecycle.
+and session-KV residency in ``sessions.py``, request placement (prefill/
+extend/grammar attach) in ``placement.py``; this module owns
+construction, submission, warmup, and the thread lifecycle.
 """
 
 from __future__ import annotations
@@ -49,12 +50,14 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from omnia_tpu.engine.placement import _PlacementMixin
 from omnia_tpu.engine.prefix_cache import PrefixPool, _PrefixCacheMixin
 from omnia_tpu.engine.programs import build_programs
 from omnia_tpu.engine.scheduler import _SchedulerMixin
 from omnia_tpu.engine.sessions import _SessionKV, _SessionMixin, _Slot
 from omnia_tpu.engine.spec_decode import _SpecDecodeMixin
 from omnia_tpu.engine.types import (
+    MAX_DEVICE_STOP_IDS,
     EngineConfig,
     FinishReason,
     Request,
@@ -73,14 +76,10 @@ from omnia_tpu.utils.compile_cache import enable_compilation_cache
 
 logger = logging.getLogger(__name__)
 
-# Per-slot stop-token ids tracked ON DEVICE (padded with -1). Requests with
-# more stop ids than this still finish correctly — the host checks the full
-# set — the device mask just can't early-freeze on the overflow ids.
-MAX_DEVICE_STOP_IDS = 8
-
 
 class InferenceEngine(
-    _SchedulerMixin, _SessionMixin, _SpecDecodeMixin, _PrefixCacheMixin
+    _SchedulerMixin, _SessionMixin, _SpecDecodeMixin, _PrefixCacheMixin,
+    _PlacementMixin,
 ):
     """Slot-based continuous-batching engine over one model."""
 
@@ -111,6 +110,14 @@ class InferenceEngine(
                     f"spec_decode={engine_cfg.spec_decode} needs "
                     f"spec_decode + 1 <= min(prefill_buckets)"
                 )
+
+        # Grammar-constrained decoding (engine/grammar/): gated ONCE here;
+        # every grammar code path below checks this flag, so grammar=False
+        # builds no tables, allocates no device state, and traces the
+        # exact pre-grammar programs (the guarded-no-op contract).
+        self._gr_on = bool(engine_cfg.grammar)
+        if self._gr_on and engine_cfg.grammar_max_states < 2:
+            raise ValueError("grammar_max_states must be >= 2 with grammar on")
 
         self._dtype = resolve_dtype(engine_cfg.dtype)
         self._mesh = None
@@ -231,7 +238,21 @@ class InferenceEngine(
             "spec_steps": 0,
             "spec_proposed": 0,
             "spec_accepted": 0,
+            # Grammar-constrained decoding (engine/grammar/).
+            # compile_hits/misses mirror the process-global grammar
+            # compile cache (content-addressed, key-stable across
+            # processes); masked_logit_fraction is the running mean
+            # fraction of the vocabulary masked per constrained step;
+            # rejections_avoided counts constrained generations brought
+            # to a valid finish — each one a would-have-been
+            # bad_response_format retry loop.
+            "grammar_compile_hits": 0,
+            "grammar_compile_misses": 0,
+            "masked_logit_fraction": 0.0,
+            "grammar_rejections_avoided": 0,
         }
+        self._gr_mask_sum = 0.0
+        self._gr_mask_steps = 0
 
         progs = build_programs(self.model_cfg, self.cfg, self._mesh)
         # Program callables live as flat attributes (not the dataclass) so
@@ -292,6 +313,33 @@ class InferenceEngine(
             if hasattr(self, "metrics"):  # absent on first (construction) call
                 self.metrics["prefix_cache_evictions"] = self._prefix_pool.evictions
 
+        # Grammar-constrained decoding state: per-slot FSM state beside
+        # the sampler key data, per-slot transition tables, and the
+        # active-mask gate. grammar=off allocates NONE of it.
+        self._gstate = self._gtable = self._gactive = None
+        self._gbias_zero = None
+        self._gslot_key = None
+        if self._gr_on:
+            V = self.model_cfg.vocab_size
+            Sg = self.cfg.grammar_max_states
+            table_bytes = B * Sg * V * 4
+            if table_bytes > 1 << 30:
+                logger.warning(
+                    "grammar transition tables need %.1f GiB of device "
+                    "memory (num_slots=%d x grammar_max_states=%d x "
+                    "vocab=%d x 4B) — size grammar_max_states down to "
+                    "the largest schema you actually serve",
+                    table_bytes / (1 << 30), B, Sg, V,
+                )
+            self._gstate = jnp.zeros((B,), jnp.int32)
+            self._gactive = jnp.zeros((B,), jnp.bool_)
+            self._gtable = jnp.zeros((B, Sg, V), jnp.int32)
+            self._gbias_zero = jnp.zeros((V,), jnp.float32)
+            # Host mirror of what each slot's device table rows hold, so
+            # re-placing the same grammar (the common case: one schema,
+            # many requests) skips the [Sg, V] re-upload.
+            self._gslot_key = [None] * B
+
         self._tokens = jnp.zeros((B,), jnp.int32)       # last sampled token
         self._positions = jnp.zeros((B,), jnp.int32)    # next write row
         self._temp = jnp.zeros((B,), jnp.float32)
@@ -329,6 +377,11 @@ class InferenceEngine(
         kd = self._key_data[0]
         zero = jnp.int32(0)
         sargs = (kd, jnp.float32(0.0), jnp.float32(1.0), jnp.int32(0))
+        if self._gr_on:
+            # The request path ALWAYS passes the grammar bias operand
+            # when support is on (zeros for ungrammared requests), so
+            # warmup must trace the same signatures.
+            sargs = sargs + (self._gbias_zero,)
         # Suffix prefill after a shared-prefix seed rides the extend
         # family, so an enabled pool warms it even for sessionless
         # serving (the bench's shared-prefix scenario).
@@ -412,6 +465,16 @@ class InferenceEngine(
             jnp.asarray([-1] * MAX_DEVICE_STOP_IDS, jnp.int32)
         )
         self._key_data = self._key_data.at[0].set(kd)
+        if self._gr_on:
+            # Grammar placement scatters: FSM state + gate (the exact
+            # scalar-set programs placement dispatches). The table
+            # upload is NOT warmable here: placement writes [S, V] rows
+            # where S is each grammar's own state count — a different
+            # scatter shape per grammar — so a [max_states, V] set would
+            # trace a program placement never runs while transiently
+            # building a multi-GB host array at large vocabularies.
+            self._gstate = self._gstate.at[0].set(0)
+            self._gactive = self._gactive.at[0].set(True)
         jax.block_until_ready(self._key_data)
         # Restore everything warmup wrote (cache contents, PRNG streams,
         # positions, metrics) so warmup cannot perturb request sampling.
@@ -431,14 +494,29 @@ class InferenceEngine(
         prompt_tokens: list[int],
         params: SamplingParams = SamplingParams(),
         session_id: Optional[str] = None,
+        grammar=None,
     ) -> RequestHandle:
         """Queue a generation request. With a session_id, the session's KV
         rows persist across requests: the next request prefills only the
         tokens past its longest common prefix with what is already cached
-        (multi-turn serving cost becomes O(new tokens), SURVEY §7)."""
+        (multi-turn serving cost becomes O(new tokens), SURVEY §7).
+        With a `grammar` (engine/grammar.TokenGrammar), every sampled
+        token is FSM-masked on device and EOS is admissible only in
+        accepting states — requires EngineConfig.grammar=True."""
         rid = f"req-{next(self._req_counter)}"
         handle = RequestHandle(rid)
-        request = Request(rid, list(prompt_tokens), params, session_id=session_id)
+        request = Request(
+            rid, list(prompt_tokens), params, session_id=session_id,
+            grammar=grammar,
+        )
+        if grammar is not None:
+            err = self._validate_grammar(grammar, params)
+            if err:
+                handle._push(
+                    StreamEvent(rid, finish_reason=FinishReason.ERROR, error=err)
+                )
+                return handle
+            self._sync_grammar_cache_metrics()
         if not prompt_tokens:
             handle._push(
                 StreamEvent(rid, finish_reason=FinishReason.ERROR, error="empty prompt")
@@ -480,6 +558,11 @@ class InferenceEngine(
             self.metrics["requests_submitted"] += 1
         return handle
 
+    def supports_grammar(self) -> bool:
+        """True when this engine enforces request grammars (the runtime
+        only attaches one when this answers True)."""
+        return self._gr_on
+
     def queue_depth(self) -> int:
         """Waiting requests — the autoscaling signal (north star replaces the
         reference's active-connections KEDA trigger with queue depth)."""
@@ -497,206 +580,6 @@ class InferenceEngine(
         return waiting | {
             s.request.request_id for s in self._slots if s.active
         }
-
-    # ------------------------------------------------------------------
-    # Request placement (prefill / sessionful extend)
-    # ------------------------------------------------------------------
-
-    def _sampling_key(self, slot_idx: int, sp: SamplingParams):
-        return (
-            jnp.asarray(make_slot_key_data(sp.seed))
-            if sp.seed is not None
-            else self._key_data[slot_idx]
-        )
-
-    def _run_insert(self, k_chunk, v_chunk, slot_idx, last_logits, sp=None):
-        sp = sp or SamplingParams()
-        kd = self._sampling_key(slot_idx, sp)
-        ck, cv, tok, new_kd = self._insert_fn(
-            self._ck,
-            self._cv,
-            k_chunk,
-            v_chunk,
-            slot_idx,
-            last_logits,
-            kd,
-            jnp.float32(sp.temperature),
-            jnp.float32(sp.top_p),
-            jnp.int32(sp.top_k),
-        )
-        key_data = self._key_data.at[slot_idx].set(new_kd)
-        return ck, cv, tok, key_data
-
-    def _place_request(self, slot_idx: int, request: Request, handle: RequestHandle):
-        """Prefill a request into a slot: fresh single-bucket prefill when
-        there is no reusable prefix and the prompt fits one bucket,
-        otherwise chunked incremental extend from the reuse frontier."""
-        prompt = request.prompt_tokens
-        n = len(prompt)
-        sess = None
-        reuse = 0
-        if self.cfg.max_sessions > 0 and request.session_id:
-            sess = self._sessions.get(request.session_id)
-            if sess is None:
-                sess = self._sessions[request.session_id] = _SessionKV(
-                    request.session_id, now=self.clock()
-                )
-                self._enforce_session_cap()
-            sess.last_used = self.clock()
-            # Longest common prefix with the cached rows, capped at n-1 so
-            # there is always ≥1 suffix token to produce the next logits.
-            limit = min(len(sess.token_ids), n - 1)
-            while reuse < limit and sess.token_ids[reuse] == prompt[reuse]:
-                reuse += 1
-            if sess.slot is None and sess.host_k is not None:
-                if reuse > 0:
-                    self._restore_session(sess, slot_idx)
-                else:
-                    sess.host_k = sess.host_v = None  # diverged: page is useless
-            if sess.slot is None:
-                sess.slot = slot_idx
-                self._slots[slot_idx].session_id = sess.session_id
-            slot_idx = sess.slot
-            if reuse == 0:
-                sess.token_ids = []
-
-        sp = request.params
-        usable = self.cfg.usable_buckets()
-        t_prefill = time.monotonic()
-        # No same-session rows to extend from: longest-prefix-match the
-        # cross-session pool and seed-copy the shared rows, so a FRESH
-        # session of a known pack prefills only its suffix.
-        seeded = 0
-        if reuse == 0:
-            seeded = self._try_seed_from_pool(slot_idx, prompt, sess)
-        frontier = reuse or seeded
-        if frontier == 0 and n <= max(usable):
-            first_tok = self._fresh_prefill(slot_idx, prompt, sp)
-        else:
-            first_tok = self._chunked_extend(slot_idx, prompt, frontier, sp)
-        self._maybe_publish_prefix(slot_idx, prompt)
-        self.metrics["prefill_dispatch_s"] += time.monotonic() - t_prefill
-        self.metrics["prefix_reuse_tokens"] += reuse
-        self.metrics["prefill_tokens"] += n - frontier
-        self.metrics["prefill_steps"] += 1
-
-        slot = self._slots[slot_idx]
-        slot.request = request
-        slot.handle = handle
-        slot.length = n
-        slot.generated = 0
-        slot.emitted = []
-        slot.max_total = sp.max_tokens
-        slot.stop_ids = frozenset(sp.stop_token_ids)
-        if sess is not None:
-            sess.token_ids = list(prompt)
-
-        self._tokens = self._tokens.at[slot_idx].set(first_tok)
-        self._positions = self._positions.at[slot_idx].set(n)
-        self._active = self._active.at[slot_idx].set(True)
-        self._temp = self._temp.at[slot_idx].set(sp.temperature)
-        self._top_p = self._top_p.at[slot_idx].set(sp.top_p)
-        self._top_k = self._top_k.at[slot_idx].set(sp.top_k)
-        # Device-side finish state: decode emissions still allowed after
-        # the first token. MUST equal the host's finish schedule exactly
-        # (generated >= max_tokens OR length >= max_seq - 2, checked after
-        # each emission): a device mask firing EARLIER than the host's
-        # would freeze the slot while the host keeps consuming its chunk
-        # rows as real tokens. Stop-id row is -1 padded; ids past
-        # MAX_DEVICE_STOP_IDS are host-checked only (host-early is safe).
-        budget = min(sp.max_tokens - 1, self.cfg.max_seq - 2 - n)
-        self._budget = self._budget.at[slot_idx].set(max(budget, 0))
-        ids = list(sp.stop_token_ids)[:MAX_DEVICE_STOP_IDS]
-        ids += [-1] * (MAX_DEVICE_STOP_IDS - len(ids))
-        self._stop_ids = self._stop_ids.at[slot_idx].set(
-            jnp.asarray(ids, jnp.int32)
-        )
-        self._emit_token(slot_idx, int(first_tok))
-
-    def _fresh_prefill(self, slot_idx: int, prompt: list[int], sp: SamplingParams):
-        n = len(prompt)
-        bucket = self.cfg.bucket_for(n)
-        toks = np.zeros((1, bucket), np.int32)
-        toks[0, :n] = prompt
-        # Pad rows sit at positions n..bucket-1, i.e. strictly after every
-        # real query position, so the causal mask (key_idx <= q_pos) already
-        # excludes them — and decode overwrites each pad row before it first
-        # becomes attendable.
-        pos = np.arange(bucket, dtype=np.int32)[None, :]
-        if (
-            self._prefill_ring_fn is not None
-            and bucket >= self.cfg.long_prefill_threshold
-            and bucket % self.cfg.sp == 0
-        ):
-            # Ring path: the sp-sharded prefill stays its own program;
-            # its KV chunk gathers into the slot via the insert step.
-            logits, k_chunk, v_chunk = self._prefill_ring_fn(
-                self.params, jnp.asarray(toks), jnp.asarray(pos)
-            )
-            self._ck, self._cv, first_tok, self._key_data = self._run_insert(
-                k_chunk, v_chunk, slot_idx, logits[:, n - 1], sp
-            )
-            return first_tok
-        kd = self._sampling_key(slot_idx, sp)
-        self._ck, self._cv, first_tok, new_kd = self._prefill_insert_fn(
-            self.params, self._ck, self._cv,
-            jnp.asarray(toks), jnp.asarray(pos),
-            jnp.int32(slot_idx), jnp.int32(n - 1), kd,
-            jnp.float32(sp.temperature), jnp.float32(sp.top_p),
-            jnp.int32(sp.top_k),
-        )
-        self._key_data = self._key_data.at[slot_idx].set(new_kd)
-        return first_tok
-
-    def _extend_pieces(self, start: int, count: int) -> list[tuple[int, int, int]]:
-        """Plan (offset, real_len, bucket) chunks covering prompt[start:
-        start+count]. Bucket-padded writes must never cross max_seq (a
-        clamped dynamic_update_slice would corrupt earlier rows), so near
-        the cache end chunks degrade to single-token steps."""
-        buckets = sorted(self.cfg.usable_buckets())
-        S = self.cfg.max_seq
-        pieces = []
-        pos, left = start, count
-        while left > 0:
-            b = buckets[-1] if left >= buckets[-1] else self.cfg.bucket_for(left)
-            if pos + b > S:
-                b = 1
-            take = min(left, b)
-            pieces.append((pos, take, b))
-            pos += take
-            left -= take
-        return pieces
-
-    def _chunked_extend(
-        self, slot_idx: int, prompt: list[int], reuse: int, sp: SamplingParams
-    ):
-        """Incremental prefill of prompt[reuse:] against the slot's resident
-        rows; only the final chunk samples."""
-        pieces = self._extend_pieces(reuse, len(prompt) - reuse)
-        slot_arr = jnp.int32(slot_idx)
-
-        def chunk_arrays(off, take, b):
-            toks = np.zeros((1, b), np.int32)
-            toks[0, :take] = prompt[off:off + take]
-            pos = (off + np.arange(b, dtype=np.int32))[None, :]
-            return jnp.asarray(toks), jnp.asarray(pos)
-
-        for off, take, b in pieces[:-1]:
-            toks, pos = chunk_arrays(off, take, b)
-            self._ck, self._cv = self._extend_nosample_fn(
-                self.params, self._ck, self._cv, toks, pos, slot_arr, jnp.int32(off)
-            )
-        off, take, b = pieces[-1]
-        toks, pos = chunk_arrays(off, take, b)
-        kd = self._sampling_key(slot_idx, sp)
-        self._ck, self._cv, first_tok, new_kd = self._extend_fn(
-            self.params, self._ck, self._cv, toks, pos, slot_arr, jnp.int32(off),
-            jnp.int32(take - 1), kd,
-            jnp.float32(sp.temperature), jnp.float32(sp.top_p), jnp.int32(sp.top_k),
-        )
-        self._key_data = self._key_data.at[slot_idx].set(new_kd)
-        self.metrics["extend_steps"] += len(pieces)
-        return first_tok
 
     # ------------------------------------------------------------------
     # Thread loop / sync helpers

@@ -1,0 +1,47 @@
+"""Grammar-constrained decoding: compiled FSM token masking.
+
+Turns JSON-Schema ``response_format`` specs, tool-call argument schemas,
+and regexes into token-level transition tables the sampler masks with —
+validity becomes a property of decoding instead of a post-hoc retry
+(Willard & Louf 2023 / Dong et al. 2024, TPU-serving edition).
+
+This package is host-side and jax-free by contract: importing it (or
+constructing grammars) allocates no device arrays and traces no
+programs — the guards suite enforces that ``grammar=off`` engines stay
+byte-identical to pre-grammar behavior. See docs/serving.md
+("Structured output") for the FSM lifecycle through the serving path.
+"""
+
+from omnia_tpu.engine.grammar.cache import (
+    clear_cache,
+    compile_json_schema,
+    compile_regex,
+    compile_turn_grammar,
+    grammar_cache_key,
+    stats,
+)
+from omnia_tpu.engine.grammar.fsm import (
+    GrammarError,
+    GrammarTooLarge,
+    GrammarUnsupported,
+    SamplerView,
+    TokenGrammar,
+    force_complete,
+    walk_text,
+)
+
+__all__ = [
+    "GrammarError",
+    "GrammarTooLarge",
+    "GrammarUnsupported",
+    "SamplerView",
+    "TokenGrammar",
+    "clear_cache",
+    "compile_json_schema",
+    "compile_regex",
+    "compile_turn_grammar",
+    "force_complete",
+    "grammar_cache_key",
+    "stats",
+    "walk_text",
+]

@@ -87,13 +87,25 @@ class MockEngine:
             "requests_submitted": 0,
             "requests_finished": 0,
             "tokens_generated": 0,
+            # Grammar parity with InferenceEngine (host-side masks).
+            "grammar_compile_hits": 0,
+            "grammar_compile_misses": 0,
+            "masked_logit_fraction": 0.0,
+            "grammar_rejections_avoided": 0,
         }
+        self._gr_mask_sum = 0.0
+        self._gr_mask_steps = 0
 
     def warmup(self, sessions: bool = True):
         pass
 
     def register_prefix(self, tokens) -> None:
         """Interface parity with InferenceEngine; the mock has no KV."""
+
+    def supports_grammar(self) -> bool:
+        """The mock enforces grammars host-side (same masks, no device),
+        so tier-1 tests exercise the full constrained path hermetically."""
+        return True
 
     def queue_depth(self) -> int:
         return 0
@@ -106,6 +118,7 @@ class MockEngine:
         prompt_tokens: list[int],
         params: SamplingParams = SamplingParams(),
         session_id: Optional[str] = None,
+        grammar=None,
     ) -> RequestHandle:
         # session_id accepted for interface parity with InferenceEngine;
         # the mock replays scenarios statelessly, so it is ignored.
@@ -113,10 +126,24 @@ class MockEngine:
         handle = RequestHandle(rid)
         # Mirror InferenceEngine.submit's validation (and its metric
         # ordering: rejected requests are NOT counted as submitted).
+        # Grammar liveness is checked first like the real engine does —
+        # a starved grammar (stop id that is also a required token) must
+        # refuse here too, not play back truncated "completed" output.
         error = None
-        if not prompt_tokens:
+        if grammar is not None:
+            from omnia_tpu.engine.grammar.fsm import GrammarError
+
+            try:
+                grammar.validate(
+                    1 << 30,  # host-side playback has no state budget
+                    self.tokenizer.vocab_size,
+                    params.stop_token_ids,
+                )
+            except GrammarError as e:
+                error = f"grammar rejected: {e}"
+        if error is None and not prompt_tokens:
             error = "empty prompt"
-        elif params.max_tokens < 1:
+        if error is None and params.max_tokens < 1:
             error = f"max_tokens must be >= 1, got {params.max_tokens}"
         if error is not None:
             handle._push(
@@ -125,8 +152,16 @@ class MockEngine:
             return handle
         with self._lock:
             self.metrics["requests_submitted"] += 1
+        if grammar is not None:
+            from omnia_tpu.engine.grammar.cache import stats
+
+            with self._lock:
+                self.metrics["grammar_compile_hits"] = stats["hits"]
+                self.metrics["grammar_compile_misses"] = stats["misses"]
         thread = threading.Thread(
-            target=self._play, args=(rid, list(prompt_tokens), params, handle), daemon=True
+            target=self._play,
+            args=(rid, list(prompt_tokens), params, handle, grammar),
+            daemon=True,
         )
         thread.start()
         return handle
@@ -147,7 +182,44 @@ class MockEngine:
                 return s
         return Scenario(pattern=".*", reply=DEFAULT_REPLY)
 
-    def _play(self, rid, prompt_tokens, params, handle: RequestHandle):
+    def _constrained_reply(self, reply_ids, params, grammar) -> list[int]:
+        """Apply the SAME token masks the compiled engine path enforces:
+        the scripted reply is the proposal stream (the mock's stand-in
+        for argmax logits); a proposed token that the current FSM state
+        masks is replaced by the grammar's completion move, and once the
+        script is exhausted the walk is force-completed to an accepting
+        state — so scripted garbage becomes schema-valid output, exactly
+        what masked sampling does to a misbehaving model."""
+        from omnia_tpu.engine.grammar.fsm import force_complete
+
+        # Same view the compiled engine would mask with: the request's
+        # stop ids are unmasked in accepting states (parity — a custom
+        # stop id in a scripted reply must survive, not be rewritten).
+        view = grammar.view(self.tokenizer.vocab_size, params.stop_token_ids)
+        it = iter(reply_ids)
+
+        def propose(_state, _allowed):
+            return next(it, None)
+
+        toks, _done = force_complete(view, propose, params.max_tokens)
+        # Host-side masked-fraction mirror (parity with the engine's
+        # metrics; one walk re-derives the per-step states).
+        s = view.start
+        with self._lock:
+            for t in toks:
+                self._gr_mask_sum += view.masked_fraction(s)
+                self._gr_mask_steps += 1
+                s = view.advance(s, t)
+            if self._gr_mask_steps:
+                self.metrics["masked_logit_fraction"] = round(
+                    self._gr_mask_sum / self._gr_mask_steps, 6
+                )
+            if view.is_accepting(s):
+                self.metrics["grammar_rejections_avoided"] += 1
+        return toks
+
+    def _play(self, rid, prompt_tokens, params, handle: RequestHandle,
+              grammar=None):
         prompt = self.tokenizer.decode(prompt_tokens)
         scenario = self._scenario_for(prompt)
         if scenario.ttft_s:
@@ -158,6 +230,8 @@ class MockEngine:
             )
             return
         reply_ids = self.tokenizer.encode(scenario.reply, add_bos=False)
+        if grammar is not None:
+            reply_ids = self._constrained_reply(reply_ids, params, grammar)
         reply_ids = reply_ids[: params.max_tokens]
         generated = 0
         for tok in reply_ids:

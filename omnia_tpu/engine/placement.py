@@ -1,0 +1,341 @@
+"""Request placement for the serving engine.
+
+Placement takes a queued request to its first sampled token: fresh
+single-bucket prefill when nothing is reusable, chunked incremental
+extend from the session/pool reuse frontier otherwise — plus the
+grammar-constrained-decoding attach path (per-slot FSM table upload,
+start-state bias for the first token, host state mirror).
+
+Mixed into :class:`InferenceEngine` (same seam-per-concern layout as the
+scheduler/session/prefix-cache mixins): everything here operates on the
+engine's slots, device state, and compiled programs.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from omnia_tpu.engine.sessions import _SessionKV
+from omnia_tpu.engine.types import (
+    MAX_DEVICE_STOP_IDS,
+    Request,
+    RequestHandle,
+    SamplingParams,
+)
+from omnia_tpu.ops.sampling import _NEG_INF, make_slot_key_data
+
+
+class _PlacementMixin:
+    """Placement methods of :class:`InferenceEngine`."""
+
+    def _sampling_key(self, slot_idx: int, sp: SamplingParams):
+        return (
+            jnp.asarray(make_slot_key_data(sp.seed))
+            if sp.seed is not None
+            else self._key_data[slot_idx]
+        )
+
+    # -- grammar-constrained decoding helpers ---------------------------
+
+    def _validate_grammar(self, grammar, sp: SamplingParams) -> Optional[str]:
+        """Submit-time rejection with a real error surface (placement
+        failures only say 'prefill failed')."""
+        if not self._gr_on:
+            return "grammar-constrained request on an engine built with grammar=off"
+        from omnia_tpu.engine.grammar.fsm import GrammarError
+
+        try:
+            # Budget + liveness on the exact [S, V] view placement will
+            # upload (memoized), so placement cannot hit a grammar error
+            # later — without materializing the padded [max_states, V]
+            # table the check never reads.
+            grammar.validate(
+                self.cfg.grammar_max_states, self.model_cfg.vocab_size,
+                sp.stop_token_ids,
+            )
+        except GrammarError as e:
+            return f"grammar rejected: {e}"
+        return None
+
+    def _sync_grammar_cache_metrics(self) -> None:
+        from omnia_tpu.engine.grammar.cache import stats
+
+        self.metrics["grammar_compile_hits"] = stats["hits"]
+        self.metrics["grammar_compile_misses"] = stats["misses"]
+
+    def _grammar_args(self, request: Optional[Request], sp: SamplingParams):
+        """Extra first-token sampler operand: the start-state mask bias.
+        () when grammar support is off (the programs were traced without
+        the operand); a zero bias for ungrammared requests."""
+        if not self._gr_on:
+            return ()
+        g = request.grammar if request is not None else None
+        if g is None:
+            return (self._gbias_zero,)
+        view = g.view(self.model_cfg.vocab_size, sp.stop_token_ids)
+        row = view.table[view.start]
+        bias = np.where(row < 0, _NEG_INF, 0.0).astype(np.float32)
+        return (jnp.asarray(bias),)
+
+    def _attach_grammar(self, slot_idx: int, request: Request,
+                        first_tok: int) -> None:
+        """Upload the request's transition table + post-first-token FSM
+        state into the slot's device rows; mirror the state on the host
+        slot (metrics + mock parity)."""
+        slot = self._slots[slot_idx]
+        g = request.grammar
+        if not self._gr_on:
+            return
+        if g is None:
+            self._gactive = self._gactive.at[slot_idx].set(False)
+            return
+        sp = request.params
+        view = g.view(self.model_cfg.vocab_size, sp.stop_token_ids)
+        state0 = view.advance(view.start, first_tok)
+        if state0 < 0:  # first token finished the request (stop id)
+            state0 = view.start
+        # Upload the grammar's rows only when the slot doesn't already
+        # hold them (same grammar + same stop-id set — the common case of
+        # one schema served across many requests). Keying on the grammar
+        # OBJECT when it has no content key pins it alive, so a recycled
+        # id() can never alias a stale mirror entry. The upload writes
+        # the unpadded [S, V] view: states ≥ S are unreachable (every
+        # transition targets a state < S), so stale rows above S from a
+        # previous occupant are dead weight, not a hazard — and the
+        # padded [max_states, V] host array never gets built.
+        gkey = (
+            g.key or g,
+            tuple(sorted({g.eos_id, *sp.stop_token_ids})),
+        )
+        if self._gslot_key[slot_idx] != gkey:
+            if view.num_states > self.cfg.grammar_max_states:
+                from omnia_tpu.engine.grammar.fsm import GrammarTooLarge
+
+                raise GrammarTooLarge(  # submit validates; belt-and-braces
+                    f"grammar needs {view.num_states} states, engine "
+                    f"grammar_max_states is {self.cfg.grammar_max_states}"
+                )
+            self._gtable = self._gtable.at[slot_idx, : view.num_states].set(
+                jnp.asarray(view.table)
+            )
+            self._gslot_key[slot_idx] = gkey
+        self._gstate = self._gstate.at[slot_idx].set(state0)
+        self._gactive = self._gactive.at[slot_idx].set(True)
+        slot.grammar = g
+        slot.gr_view = view
+        slot.gr_state = view.start  # _emit_token advances for first_tok
+
+    def _run_insert(self, k_chunk, v_chunk, slot_idx, last_logits, sp=None,
+                    request=None):
+        sp = sp or SamplingParams()
+        kd = self._sampling_key(slot_idx, sp)
+        ck, cv, tok, new_kd = self._insert_fn(
+            self._ck,
+            self._cv,
+            k_chunk,
+            v_chunk,
+            slot_idx,
+            last_logits,
+            kd,
+            jnp.float32(sp.temperature),
+            jnp.float32(sp.top_p),
+            jnp.int32(sp.top_k),
+            *self._grammar_args(request, sp),
+        )
+        key_data = self._key_data.at[slot_idx].set(new_kd)
+        return ck, cv, tok, key_data
+
+    def _place_request(self, slot_idx: int, request: Request, handle: RequestHandle):
+        """Prefill a request into a slot: fresh single-bucket prefill when
+        there is no reusable prefix and the prompt fits one bucket,
+        otherwise chunked incremental extend from the reuse frontier."""
+        prompt = request.prompt_tokens
+        n = len(prompt)
+        sess = None
+        reuse = 0
+        if self.cfg.max_sessions > 0 and request.session_id:
+            sess = self._sessions.get(request.session_id)
+            if sess is None:
+                sess = self._sessions[request.session_id] = _SessionKV(
+                    request.session_id, now=self.clock()
+                )
+                self._enforce_session_cap()
+            sess.last_used = self.clock()
+            # Longest common prefix with the cached rows, capped at n-1 so
+            # there is always ≥1 suffix token to produce the next logits.
+            limit = min(len(sess.token_ids), n - 1)
+            while reuse < limit and sess.token_ids[reuse] == prompt[reuse]:
+                reuse += 1
+            if sess.slot is None and sess.host_k is not None:
+                if reuse > 0:
+                    self._restore_session(sess, slot_idx)
+                else:
+                    sess.host_k = sess.host_v = None  # diverged: page is useless
+            if sess.slot is None:
+                sess.slot = slot_idx
+                self._slots[slot_idx].session_id = sess.session_id
+            slot_idx = sess.slot
+            if reuse == 0:
+                sess.token_ids = []
+
+        sp = request.params
+        usable = self.cfg.usable_buckets()
+        t_prefill = time.monotonic()
+        # No same-session rows to extend from: longest-prefix-match the
+        # cross-session pool and seed-copy the shared rows, so a FRESH
+        # session of a known pack prefills only its suffix.
+        seeded = 0
+        if reuse == 0:
+            seeded = self._try_seed_from_pool(slot_idx, prompt, sess)
+        frontier = reuse or seeded
+        if frontier == 0 and n <= max(usable):
+            first_tok = self._fresh_prefill(slot_idx, prompt, sp, request)
+        else:
+            first_tok = self._chunked_extend(
+                slot_idx, prompt, frontier, sp, request
+            )
+        self._maybe_publish_prefix(slot_idx, prompt)
+        self.metrics["prefill_dispatch_s"] += time.monotonic() - t_prefill
+        self.metrics["prefix_reuse_tokens"] += reuse
+        self.metrics["prefill_tokens"] += n - frontier
+        self.metrics["prefill_steps"] += 1
+
+        slot = self._slots[slot_idx]
+        slot.request = request
+        slot.handle = handle
+        slot.length = n
+        slot.generated = 0
+        slot.emitted = []
+        slot.max_total = sp.max_tokens
+        stop_ids = frozenset(sp.stop_token_ids)
+        if request.grammar is not None:
+            # In terminal accepting states the grammar view unmasks ONLY
+            # its eos id — the engine must finish on it even when the
+            # caller's stop set omits it, or the slot streams raw EOS
+            # tokens until the budget runs out (valid JSON + EOS spam,
+            # finish_reason LENGTH, and mock/compiled parity broken).
+            stop_ids |= {request.grammar.eos_id}
+        slot.stop_ids = stop_ids
+        if sess is not None:
+            sess.token_ids = list(prompt)
+
+        self._tokens = self._tokens.at[slot_idx].set(first_tok)
+        self._positions = self._positions.at[slot_idx].set(n)
+        self._active = self._active.at[slot_idx].set(True)
+        self._temp = self._temp.at[slot_idx].set(sp.temperature)
+        self._top_p = self._top_p.at[slot_idx].set(sp.top_p)
+        self._top_k = self._top_k.at[slot_idx].set(sp.top_k)
+        # Device-side finish state: decode emissions still allowed after
+        # the first token. MUST equal the host's finish schedule exactly
+        # (generated >= max_tokens OR length >= max_seq - 2, checked after
+        # each emission): a device mask firing EARLIER than the host's
+        # would freeze the slot while the host keeps consuming its chunk
+        # rows as real tokens. Stop-id row is -1 padded; ids past
+        # MAX_DEVICE_STOP_IDS are host-checked only (host-early is safe).
+        budget = min(sp.max_tokens - 1, self.cfg.max_seq - 2 - n)
+        self._budget = self._budget.at[slot_idx].set(max(budget, 0))
+        ids = list(sp.stop_token_ids)
+        if request.grammar is not None and request.grammar.eos_id not in ids:
+            ids.append(request.grammar.eos_id)  # device mirror of slot.stop_ids
+        ids = ids[:MAX_DEVICE_STOP_IDS]
+        ids += [-1] * (MAX_DEVICE_STOP_IDS - len(ids))
+        self._stop_ids = self._stop_ids.at[slot_idx].set(
+            jnp.asarray(ids, jnp.int32)
+        )
+        first = int(first_tok)
+        self._attach_grammar(slot_idx, request, first)
+        self._emit_token(slot_idx, first)
+
+    def _fresh_prefill(self, slot_idx: int, prompt: list[int],
+                       sp: SamplingParams, request: Optional[Request] = None):
+        n = len(prompt)
+        bucket = self.cfg.bucket_for(n)
+        toks = np.zeros((1, bucket), np.int32)
+        toks[0, :n] = prompt
+        # Pad rows sit at positions n..bucket-1, i.e. strictly after every
+        # real query position, so the causal mask (key_idx <= q_pos) already
+        # excludes them — and decode overwrites each pad row before it first
+        # becomes attendable.
+        pos = np.arange(bucket, dtype=np.int32)[None, :]
+        if (
+            self._prefill_ring_fn is not None
+            and bucket >= self.cfg.long_prefill_threshold
+            and bucket % self.cfg.sp == 0
+        ):
+            # Ring path: the sp-sharded prefill stays its own program;
+            # its KV chunk gathers into the slot via the insert step.
+            logits, k_chunk, v_chunk = self._prefill_ring_fn(
+                self.params, jnp.asarray(toks), jnp.asarray(pos)
+            )
+            self._ck, self._cv, first_tok, self._key_data = self._run_insert(
+                k_chunk, v_chunk, slot_idx, logits[:, n - 1], sp,
+                request=request,
+            )
+            return first_tok
+        kd = self._sampling_key(slot_idx, sp)
+        self._ck, self._cv, first_tok, new_kd = self._prefill_insert_fn(
+            self.params, self._ck, self._cv,
+            jnp.asarray(toks), jnp.asarray(pos),
+            jnp.int32(slot_idx), jnp.int32(n - 1), kd,
+            jnp.float32(sp.temperature), jnp.float32(sp.top_p),
+            jnp.int32(sp.top_k),
+            *self._grammar_args(request, sp),
+        )
+        self._key_data = self._key_data.at[slot_idx].set(new_kd)
+        return first_tok
+
+    def _extend_pieces(self, start: int, count: int) -> list[tuple[int, int, int]]:
+        """Plan (offset, real_len, bucket) chunks covering prompt[start:
+        start+count]. Bucket-padded writes must never cross max_seq (a
+        clamped dynamic_update_slice would corrupt earlier rows), so near
+        the cache end chunks degrade to single-token steps."""
+        buckets = sorted(self.cfg.usable_buckets())
+        S = self.cfg.max_seq
+        pieces = []
+        pos, left = start, count
+        while left > 0:
+            b = buckets[-1] if left >= buckets[-1] else self.cfg.bucket_for(left)
+            if pos + b > S:
+                b = 1
+            take = min(left, b)
+            pieces.append((pos, take, b))
+            pos += take
+            left -= take
+        return pieces
+
+    def _chunked_extend(
+        self, slot_idx: int, prompt: list[int], reuse: int,
+        sp: SamplingParams, request: Optional[Request] = None,
+    ):
+        """Incremental prefill of prompt[reuse:] against the slot's resident
+        rows; only the final chunk samples."""
+        pieces = self._extend_pieces(reuse, len(prompt) - reuse)
+        slot_arr = jnp.int32(slot_idx)
+
+        def chunk_arrays(off, take, b):
+            toks = np.zeros((1, b), np.int32)
+            toks[0, :take] = prompt[off:off + take]
+            pos = (off + np.arange(b, dtype=np.int32))[None, :]
+            return jnp.asarray(toks), jnp.asarray(pos)
+
+        for off, take, b in pieces[:-1]:
+            toks, pos = chunk_arrays(off, take, b)
+            self._ck, self._cv = self._extend_nosample_fn(
+                self.params, self._ck, self._cv, toks, pos, slot_arr, jnp.int32(off)
+            )
+        off, take, b = pieces[-1]
+        toks, pos = chunk_arrays(off, take, b)
+        kd = self._sampling_key(slot_idx, sp)
+        self._ck, self._cv, first_tok, new_kd = self._extend_fn(
+            self.params, self._ck, self._cv, toks, pos, slot_arr, jnp.int32(off),
+            jnp.int32(take - 1), kd,
+            jnp.float32(sp.temperature), jnp.float32(sp.top_p), jnp.int32(sp.top_k),
+            *self._grammar_args(request, sp),
+        )
+        self._key_data = self._key_data.at[slot_idx].set(new_kd)
+        self.metrics["extend_steps"] += len(pieces)
+        return first_tok

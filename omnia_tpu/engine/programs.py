@@ -47,7 +47,7 @@ import jax.numpy as jnp
 
 from omnia_tpu.engine.types import EngineConfig
 from omnia_tpu.models import ModelConfig, llama
-from omnia_tpu.ops.sampling import sample_tokens_per_slot
+from omnia_tpu.ops.sampling import _NEG_INF, sample_tokens_per_slot
 
 
 @dataclasses.dataclass(frozen=True)
@@ -78,8 +78,19 @@ def build_programs(
     owns no state, and is safe to call before any device state exists.
     """
 
+    # Grammar-constrained decoding: when the engine is built with
+    # ecfg.grammar, every first-token sampler (prefill_insert / insert /
+    # extend) takes ONE extra ``*g`` operand — the start-state mask bias
+    # [V] — and the decode scan threads per-slot FSM state through a
+    # device-side gather (no host round-trip per step). When grammar is
+    # off the engine never passes the operand, so the traced programs are
+    # byte-identical to a pre-grammar engine (the guarded-no-op
+    # contract).
+    def _first_bias(g):
+        return g[0][None] if g else None
+
     def prefill_insert(params, ck, cv, tokens, positions, slot, last_idx,
-                       key_data, temp, top_p, top_k):
+                       key_data, temp, top_p, top_k, *g):
         logits, k_chunk, v_chunk = llama.forward_prefill(
             params, cfg, tokens, positions
         )
@@ -96,7 +107,8 @@ def build_programs(
             logits, (0, last_idx, 0), (1, 1, logits.shape[-1])
         )[:, 0]
         tok, new_kd = sample_tokens_per_slot(
-            last, key_data[None], temp[None], top_p[None], top_k[None]
+            last, key_data[None], temp[None], top_p[None], top_k[None],
+            mask_bias=_first_bias(g),
         )
         return ck, cv, tok[0], new_kd[0]
 
@@ -110,7 +122,7 @@ def build_programs(
         prefill_ring_fn = jax.jit(prefill_ring)
 
     def insert(ck, cv, k_chunk, v_chunk, slot, last_logits, key_data, temp,
-               top_p, top_k):
+               top_p, top_k, *g):
         # Place the prefill chunk into the slot's rows [slot, 0:T].
         def put(c, chunk):
             # c: [L,B,S,H,D]; chunk: [L,1,T,H,D]
@@ -121,7 +133,8 @@ def build_programs(
         ck = put(ck, k_chunk)
         cv = put(cv, v_chunk)
         tok, new_kd = sample_tokens_per_slot(
-            last_logits, key_data[None], temp[None], top_p[None], top_k[None]
+            last_logits, key_data[None], temp[None], top_p[None], top_k[None],
+            mask_bias=_first_bias(g),
         )
         return ck, cv, tok[0], new_kd[0]
 
@@ -130,8 +143,9 @@ def build_programs(
     max_seq = ecfg.max_seq
 
     def make_decode(chunk: int):
-        def decode_chunk(params, ck, cv, tokens, positions, active, budget,
-                         stop_ids, key_data, temp, top_p, top_k):
+        def decode_impl(params, ck, cv, tokens, positions, active, budget,
+                        stop_ids, key_data, temp, top_p, top_k,
+                        gstate=None, gtable=None, gactive=None):
             """`chunk` decode steps in ONE compiled program (lax.scan):
             one host↔device round trip per K tokens instead of per
             token. Stop-token/length finishes are masked ON DEVICE:
@@ -143,17 +157,63 @@ def build_programs(
             Inactive slots' frozen row is re-written each step (row 0
             for unpinned slots — the next prefill's insert overwrites
             it — or the session's valid-row frontier for pinned ones:
-            garbage only ever lives at rows ≥ the session's length)."""
+            garbage only ever lives at rows ≥ the session's length).
+
+            With grammar operands (one trace-time Python branch — the
+            plain program stays byte-identical), per-slot FSM state
+            rides the scan carry: each step gathers the current state's
+            transition row from the per-slot table, applies it as an
+            additive -inf mask inside the sampler, and advances the
+            state on the sampled token. Slots with ``gactive=False``
+            see a zero bias and a frozen state — an ungrammared request
+            in the same batch samples exactly as the plain program
+            would."""
+            grammar_on = gstate is not None
 
             def body(carry, _):
-                ck, cv, tokens, positions, active, budget, key_data = carry
+                if grammar_on:
+                    (ck, cv, tokens, positions, active, budget, key_data,
+                     gstate) = carry
+                else:
+                    ck, cv, tokens, positions, active, budget, key_data = carry
                 logits, ck, cv = llama.forward(
                     params, cfg, tokens[:, None], positions[:, None], ck, cv,
                     positions
                 )
-                tok, key_data = sample_tokens_per_slot(
-                    logits[:, 0], key_data, temp, top_p, top_k
-                )
+                if grammar_on:
+                    # One table row per slot, unrolled over the static
+                    # batch dim: XLA CPU lowers gather (vmapped
+                    # dynamic_index, take_along_axis) to an O(table)
+                    # walk — cost grew with grammar_max_states — while a
+                    # dynamic_slice per slot is an O(V) copy regardless
+                    # of table size.
+                    nvocab = gtable.shape[-1]
+                    row = jnp.stack([
+                        jax.lax.dynamic_slice(
+                            gtable, (b, gstate[b], 0), (1, 1, nvocab)
+                        )[0, 0]
+                        for b in range(gtable.shape[0])
+                    ])  # [B, V]
+                    bias = jnp.where(
+                        gactive[:, None] & (row < 0), _NEG_INF, 0.0
+                    )
+                    tok, key_data = sample_tokens_per_slot(
+                        logits[:, 0], key_data, temp, top_p, top_k,
+                        mask_bias=bias,
+                    )
+                    # State advances on the sampled token, gated like
+                    # the position advance (active at step START); a
+                    # masked token cannot be sampled, so row[tok] >= 0
+                    # for any gactive slot — the max(·, 0) only covers
+                    # inactive slots' garbage samples.
+                    nxt = jnp.take_along_axis(row, tok[:, None], axis=1)[:, 0]
+                    gstate = jnp.where(
+                        gactive & active, jnp.maximum(nxt, 0), gstate
+                    )
+                else:
+                    tok, key_data = sample_tokens_per_slot(
+                        logits[:, 0], key_data, temp, top_p, top_k
+                    )
                 # Position advances for the row just written (gated on
                 # active at step START); deactivation applies from the
                 # NEXT step on, mirroring the host's finish bookkeeping.
@@ -164,18 +224,37 @@ def build_programs(
                 hit_stop = (tok[:, None] == stop_ids).any(axis=1)
                 active = active & ~hit_stop & (budget > 0)
                 tokens = jnp.where(active | hit_stop, tok, tokens)
-                return (ck, cv, tokens, positions, active, budget, key_data), tok
+                out = (ck, cv, tokens, positions, active, budget, key_data)
+                if grammar_on:
+                    out += (gstate,)
+                return out, tok
 
-            (ck, cv, tokens, positions, active, budget, key_data), toks = (
-                jax.lax.scan(
-                    body, (ck, cv, tokens, positions, active, budget, key_data),
-                    None, length=chunk,
-                )
-            )
+            init = (ck, cv, tokens, positions, active, budget, key_data)
+            if grammar_on:
+                init += (gstate,)
+            carry, toks = jax.lax.scan(body, init, None, length=chunk)
             # toks [K, B]
-            return ck, cv, tokens, positions, active, budget, key_data, toks
+            return carry + (toks,)
 
-        return jax.jit(decode_chunk, donate_argnums=(1, 2))
+        if ecfg.grammar:
+            def decode_chunk_grammar(params, ck, cv, tokens, positions,
+                                     active, budget, stop_ids, key_data,
+                                     temp, top_p, top_k, gstate, gtable,
+                                     gactive):
+                return decode_impl(params, ck, cv, tokens, positions, active,
+                                   budget, stop_ids, key_data, temp, top_p,
+                                   top_k, gstate, gtable, gactive)
+
+            fn = decode_chunk_grammar
+        else:
+            def decode_chunk(params, ck, cv, tokens, positions, active,
+                             budget, stop_ids, key_data, temp, top_p, top_k):
+                return decode_impl(params, ck, cv, tokens, positions, active,
+                                   budget, stop_ids, key_data, temp, top_p,
+                                   top_k)
+
+            fn = decode_chunk
+        return jax.jit(fn, donate_argnums=(1, 2))
 
     # Compiled chunk-size variants: the big chunk for steady-state
     # throughput, smaller ones so the tail of a generation (or a step
@@ -184,7 +263,7 @@ def build_programs(
     decode_fns = {k: make_decode(k) for k in ecfg.chunk_variants()}
 
     def extend(params, ck, cv, tokens, positions, slot, write_start, last_idx,
-               key_data, temp, top_p, top_k):
+               key_data, temp, top_p, top_k, *g):
         L, B, S, H, D = ck.shape
         k_slot = jax.lax.dynamic_slice(ck, (0, slot, 0, 0, 0), (L, 1, S, H, D))
         v_slot = jax.lax.dynamic_slice(cv, (0, slot, 0, 0, 0), (L, 1, S, H, D))
@@ -201,7 +280,8 @@ def build_programs(
             logits, (0, last_idx, 0), (1, 1, logits.shape[-1])
         )[:, 0]
         tok, new_kd = sample_tokens_per_slot(
-            last, key_data[None], temp[None], top_p[None], top_k[None]
+            last, key_data[None], temp[None], top_p[None], top_k[None],
+            mask_bias=_first_bias(g),
         )
         return ck, cv, tok[0], new_kd[0]
 

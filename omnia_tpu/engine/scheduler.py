@@ -208,16 +208,7 @@ class _SchedulerMixin:
         else:
             fn = self._decode_fn
         t_dispatch = time.monotonic()
-        (
-            self._ck,
-            self._cv,
-            self._tokens,
-            self._positions,
-            self._active,
-            self._budget,
-            self._key_data,
-            toks,
-        ) = fn(
+        args = (
             self.params,
             self._ck,
             self._cv,
@@ -231,6 +222,31 @@ class _SchedulerMixin:
             self._top_p,
             self._top_k,
         )
+        if self._gr_on:
+            # Grammar edition: per-slot FSM state rides the dispatch and
+            # advances on device (programs.decode_chunk_grammar).
+            (
+                self._ck,
+                self._cv,
+                self._tokens,
+                self._positions,
+                self._active,
+                self._budget,
+                self._key_data,
+                self._gstate,
+                toks,
+            ) = fn(*args, self._gstate, self._gtable, self._gactive)
+        else:
+            (
+                self._ck,
+                self._cv,
+                self._tokens,
+                self._positions,
+                self._active,
+                self._budget,
+                self._key_data,
+                toks,
+            ) = fn(*args)
         self.metrics["decode_dispatch_s"] += time.monotonic() - t_dispatch
         self.metrics["decode_steps"] += int(toks.shape[0])
         return toks
@@ -311,6 +327,18 @@ class _SchedulerMixin:
         if not slot.active:
             return
         rid = slot.request.request_id
+        if slot.gr_view is not None:
+            # Host mirror of the device FSM walk: the state BEFORE this
+            # token is what the sampler masked with — its masked row
+            # fraction feeds the masked_logit_fraction running mean.
+            self._gr_mask_sum += slot.gr_view.masked_fraction(slot.gr_state)
+            self._gr_mask_steps += 1
+            self.metrics["masked_logit_fraction"] = round(
+                self._gr_mask_sum / self._gr_mask_steps, 6
+            )
+            nxt = slot.gr_view.advance(slot.gr_state, token)
+            if nxt >= 0:
+                slot.gr_state = nxt
         if token in slot.stop_ids:
             self._finish_slot(slot_idx, FinishReason.STOP)
             return
@@ -336,6 +364,15 @@ class _SchedulerMixin:
             )
         )
         self.metrics["requests_finished"] += 1
+        if slot.gr_view is not None:
+            # A constrained generation brought to a valid stop: without
+            # the grammar this request could have burned a whole decode
+            # on unparseable output and retried (bad_response_format).
+            if reason is FinishReason.STOP and slot.gr_view.is_accepting(
+                slot.gr_state
+            ):
+                self.metrics["grammar_rejections_avoided"] += 1
+            self._gactive = self._gactive.at[slot_idx].set(False)
         # Sessionful: record which rows are valid for the next turn's
         # prefix reuse. The last emitted token's row write is not
         # guaranteed (a slot can finish mid-decode-chunk), so it is

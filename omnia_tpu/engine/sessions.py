@@ -36,6 +36,9 @@ class _Slot:
         "emitted",
         "spec_index",
         "seeded_from",
+        "grammar",
+        "gr_view",
+        "gr_state",
     )
 
     def __init__(self):
@@ -52,10 +55,12 @@ class _Slot:
         # pins the entry until finish (sessionful seeds pin via
         # _SessionKV.seeded_from instead). Engine releases before clear().
         self.seeded_from: Optional[int] = None
-
-    @property
-    def active(self) -> bool:
-        return self.request is not None
+        # Grammar-constrained decoding: the request's TokenGrammar, its
+        # sampler view for this engine's vocab/stop ids, and the host
+        # mirror of the device FSM state (metrics + finish accounting).
+        self.grammar = None
+        self.gr_view = None
+        self.gr_state = 0
 
     def clear(self):
         self.request = None
@@ -65,6 +70,13 @@ class _Slot:
         self.emitted = []
         self.spec_index = None
         self.seeded_from = None
+        self.grammar = None
+        self.gr_view = None
+        self.gr_state = 0
+
+    @property
+    def active(self) -> bool:
+        return self.request is not None
 
 
 class _SessionKV:

@@ -77,6 +77,12 @@ class _NgramIndex:
 class _SpecDecodeMixin:
     """Speculative-decode methods of :class:`InferenceEngine`."""
 
+    # Set when a grammar-constrained slot emitted nothing from a verify
+    # step (its unmasked greedy left the grammar): the next step runs the
+    # masked chunk path instead of another verify, so that slot cannot
+    # starve behind a run of spec steps while unconstrained slots advance.
+    _spec_hold = False
+
     def _host_row(self, slot) -> int:
         """The row an INACTIVE slot's verify window may write from —
         mirrors the quiesce row _finish_slot chose, from host state
@@ -92,6 +98,9 @@ class _SpecDecodeMixin:
     def _spec_applicable(self) -> bool:
         k = self.cfg.spec_decode
         if not k or self._verify_fn is None or self._inflight:
+            return False
+        if self._spec_hold:
+            self._spec_hold = False
             return False
         any_active = False
         for s in self._slots:
@@ -160,6 +169,25 @@ class _SpecDecodeMixin:
             accepted = 0
             while accepted < k and prop[accepted] == g[i, accepted]:
                 accepted += 1
+            emit = [*prop[:accepted], int(g[i, accepted])]
+            if s.gr_view is not None:
+                # The verify program's greedy argmax is UNMASKED. A token
+                # is sound to emit only while the grammar admits it (the
+                # masked and unmasked argmax coincide exactly when the
+                # global argmax is admissible); past the first token the
+                # host FSM mirror rejects, the masked argmax is unknowable
+                # without logits, so the slot stops here and its next
+                # token comes from the masked chunk path.
+                gstate, ok = s.gr_state, 0
+                for tok in emit:
+                    nxt = s.gr_view.advance(gstate, int(tok))
+                    if nxt < 0:
+                        break
+                    gstate, ok = nxt, ok + 1
+                emit = emit[:ok]
+                accepted = min(accepted, ok)
+                if not ok:
+                    self._spec_hold = True
             # Metrics count only GENUINE proposals (padding that happens
             # to match would inflate the acceptance rate operators tune
             # against); emission still uses every matching token — a
@@ -169,7 +197,7 @@ class _SpecDecodeMixin:
             # Emit accepted proposals then the bonus token, mirroring the
             # chunk path's bookkeeping (length BEFORE emit; stop/max
             # checks inside _emit_token can finish the slot mid-list).
-            for tok in [*prop[:accepted], int(g[i, accepted])]:
+            for tok in emit:
                 s.length += 1
                 self._emit_token(i, int(tok))
                 if not s.active:
@@ -181,3 +209,8 @@ class _SpecDecodeMixin:
                 # over-allows, and the host finish check fires first).
                 self._tokens = self._tokens.at[i].set(int(s.emitted[-1]))
                 self._positions = self._positions.at[i].set(s.length)
+                if s.gr_view is not None and emit:
+                    # _emit_token advanced the host FSM mirror; the device
+                    # copy only advances inside the decode scan, so resync
+                    # it or the next masked step gathers a stale row.
+                    self._gstate = self._gstate.at[i].set(s.gr_state)

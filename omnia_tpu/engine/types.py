@@ -17,6 +17,12 @@ import time
 from typing import Iterator, Optional, Sequence
 
 
+# Per-slot stop-token ids tracked ON DEVICE (padded with -1). Requests with
+# more stop ids than this still finish correctly — the host checks the full
+# set — the device mask just can't early-freeze on the overflow ids.
+MAX_DEVICE_STOP_IDS = 8
+
+
 @dataclasses.dataclass(frozen=True)
 class SamplingParams:
     temperature: float = 0.7
@@ -43,6 +49,12 @@ class Request:
     # session's resident KV rows across turns (prefix-matched), so turn
     # N+1 prefills only its new tokens.
     session_id: Optional[str] = None
+    # Grammar-constrained decoding (engine/grammar.TokenGrammar): when
+    # set, the sampler masks every step to the grammar's admissible
+    # tokens and EOS is unmasked only in accepting states. Requires
+    # EngineConfig.grammar=True on the real engine (the mock honors it
+    # host-side unconditionally).
+    grammar: Optional[object] = None
     submitted_at: float = dataclasses.field(default_factory=time.monotonic)
 
 
@@ -211,6 +223,23 @@ class EngineConfig:
     # rows in host RAM up to this count (restore machinery pages them
     # back through a slot on the next hit). 0 = evicted entries drop.
     prefix_cache_host_entries: int = 32
+    # Grammar-constrained decoding (engine/grammar/): False is a guarded
+    # true no-op — no per-slot FSM state or mask tables are allocated and
+    # the compiled programs carry zero mask operands (byte-identical
+    # traces to a pre-grammar engine). True threads a per-slot grammar
+    # state + [num_slots, grammar_max_states, vocab] transition table
+    # through the decode step: the mask row is gathered ON DEVICE and
+    # applied inside sample_tokens_per_slot (no host round-trip), and
+    # the FSM state advances on the sampled token.
+    grammar: bool = False
+    # State capacity of one slot's device transition table. Grammars
+    # needing more states are rejected at submit. Device memory cost is
+    # num_slots × grammar_max_states × vocab_size × 4 bytes — size it
+    # down for large vocabularies (the engine warns at >1 GiB). The
+    # default keeps generic JSON mode servable (its automaton needs
+    # 2237 states over the byte tokenizer); schema grammars typically
+    # need well under 200.
+    grammar_max_states: int = 2560
 
     def chunk_variants(self) -> tuple[int, ...]:
         """Compiled decode-chunk sizes, descending, always containing

@@ -13,7 +13,7 @@ Python branching, to keep the step traceable.
 
 from __future__ import annotations
 
-from typing import Union
+from typing import Optional, Union
 
 import jax
 import jax.numpy as jnp
@@ -144,9 +144,15 @@ def fast_path_feasible(scaled, top_p, top_k) -> bool:
     ))
 
 
-def _prepare(logits, temperature, top_p, top_k):
+def _prepare(logits, temperature, top_p, top_k, mask_bias=None):
     B, V = logits.shape
     logits = logits.astype(jnp.float32)
+    if mask_bias is not None:
+        # Grammar-constrained decoding (engine/grammar): additive mask,
+        # 0 for admissible tokens / -inf for masked. Applied BEFORE the
+        # greedy argmax and the filter thresholds so every path —
+        # greedy, top-k, top-p — samples inside the grammar.
+        logits = logits + mask_bias
     if isinstance(top_k, int):
         top_k = jnp.full((B,), top_k, dtype=jnp.int32)
     greedy_tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
@@ -162,13 +168,15 @@ def sample_tokens(
     temperature: jnp.ndarray,
     top_p: jnp.ndarray,
     top_k: Union[int, jnp.ndarray] = 0,
+    mask_bias: Optional[jnp.ndarray] = None,
 ) -> jnp.ndarray:
     """Sample one token per row with a single PRNG key for the whole batch.
 
     logits: [B, V]; temperature: [B] (<= 0 → greedy); top_p: [B];
-    top_k: int or [B] int32. Returns int32 [B].
+    top_k: int or [B] int32; mask_bias: optional additive [B, V] grammar
+    mask (0 / -inf). Returns int32 [B].
     """
-    filtered, greedy_tok = _prepare(logits, temperature, top_p, top_k)
+    filtered, greedy_tok = _prepare(logits, temperature, top_p, top_k, mask_bias)
     gumbel = jax.random.gumbel(key, filtered.shape, dtype=jnp.float32)
     sampled_tok = jnp.argmax(filtered + gumbel, axis=-1).astype(jnp.int32)
     return jnp.where(temperature <= 0.0, greedy_tok, sampled_tok)
@@ -180,15 +188,17 @@ def sample_tokens_per_slot(
     temperature: jnp.ndarray,
     top_p: jnp.ndarray,
     top_k: Union[int, jnp.ndarray] = 0,
+    mask_bias: Optional[jnp.ndarray] = None,
 ):
     """Per-slot PRNG streams: each continuous-batching slot owns a key so a
     request's sample sequence is reproducible regardless of which other
     requests share the batch.
 
     key_data: uint32 [B, 2] raw key data (jax.random.key_data of threefry
-    keys). Returns (tokens int32 [B], new_key_data [B, 2]).
+    keys); mask_bias: optional additive [B, V] grammar mask (0 / -inf).
+    Returns (tokens int32 [B], new_key_data [B, 2]).
     """
-    filtered, greedy_tok = _prepare(logits, temperature, top_p, top_k)
+    filtered, greedy_tok = _prepare(logits, temperature, top_p, top_k, mask_bias)
 
     def one(row, kd):
         k = jax.random.wrap_key_data(kd)

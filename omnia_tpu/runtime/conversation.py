@@ -107,6 +107,12 @@ class ToolCallStreamParser:
         the buffer holds internal JSON, not user-visible text."""
         return self._in_tool
 
+    @property
+    def partial(self) -> str:
+        """The buffered partial tool-call payload while `in_tool` — what
+        an unterminated stream would otherwise silently drop."""
+        return self._buf if self._in_tool else ""
+
     def flush(self) -> str:
         """Remaining held-back text (end of stream). Check `in_tool` first:
         a mid-tool buffer must not be streamed as text."""
@@ -236,6 +242,91 @@ class Conversation:
             stop_token_ids=(self.tokenizer.eos_id,),
         )
 
+    def _grammar_tools(self, extra_tools: Optional[list]) -> Optional[list]:
+        """Declared tools with their argument schemas, for the turn
+        grammar. Returns None — tools stay UNconstrained — unless every
+        declared tool resolves a schema (pack `input_schema` first, then
+        the executor handler's): constraining a subset would let the
+        model call the schema-less tools only through masked-off bytes,
+        i.e. never."""
+        tools = list(self.pack.tools) + list(extra_tools or [])
+        if not tools:
+            return None
+        out = []
+        for t in tools:
+            name = t.get("name", "")
+            schema = t.get("input_schema")
+            if schema is None:
+                handler = self.tools.handler(name)
+                schema = getattr(handler, "input_schema", None)
+            if not name or schema is None:
+                return None
+            out.append({"name": name, "input_schema": schema})
+        return out
+
+    def _turn_grammar(self, msg: ClientMessage, extra_tools: Optional[list]):
+        """Compile (or cache-hit) the FSM grammar constraining this turn.
+
+        Attached when the engine supports grammar decoding AND there is
+        something enforceable: a `json_schema` response_format, and/or a
+        fully-schema'd tool set. The compiled automaton's tool branch is
+        keyed by the name bytes — once generation commits to a tool name,
+        only that tool's argument schema remains admissible (the
+        stream-parser-level view of this is: entering `<tool_call>`
+        hot-swaps the constraint to the invoked tool's schema). Anything
+        non-enforceable (GrammarUnsupported) falls back to post-hoc
+        validation alone — never a partially-enforced mask."""
+        supports = getattr(self.engine, "supports_grammar", None)
+        if not callable(supports) or not supports():
+            return None
+        rf = msg.response_format
+        rf_kind = rf.get("type") if rf else None
+        rf_ok = bool(rf_kind == "json_schema" and rf.get("schema"))
+        tools = self._grammar_tools(extra_tools)
+        if tools is None and (self.pack.tools or extra_tools):
+            # Tools are declared but not all schema'd: an rf-only
+            # grammar would mask off the `<tool_call>` marker bytes and
+            # make EVERY declared tool uninvocable for the turn. The
+            # no-partial-enforcement rule applies turn-wide — attach
+            # nothing.
+            return None
+        if rf_kind in ("json", "json_schema") and not rf_ok:
+            # Plain {"type": "json"} (and schema-less json_schema) stays
+            # post-hoc-only BY POLICY: the generic-JSON automaton bounds
+            # nesting depth, which could mask a legitimate deep answer.
+            # A tools-only grammar would then admit free text the format
+            # forbids — so attach nothing: the no-partial-enforcement
+            # rule applies across the whole turn, not per branch.
+            return None
+        if not rf_ok and not tools:
+            return None
+        from omnia_tpu.engine import grammar as gr
+
+        try:
+            g = gr.compile_turn_grammar(
+                rf if rf_ok else None, tools or (), self.tokenizer
+            )
+        except gr.GrammarError:
+            logger.debug(
+                "turn grammar not FSM-enforceable; post-hoc validation only",
+                exc_info=True,
+            )
+            return None
+        # The compile cache is shared across engines, so the compiled
+        # automaton may exceed THIS engine's device-table budget even
+        # though compilation succeeded. Attaching it would turn every
+        # submit into a hard engine_error — too-big is just another
+        # "not enforceable here": fall back to post-hoc.
+        budget = getattr(
+            getattr(self.engine, "cfg", None), "grammar_max_states", None)
+        if g is not None and budget and g.num_states > int(budget):
+            logger.debug(
+                "turn grammar needs %d states, engine budget is %d; "
+                "post-hoc validation only", g.num_states, budget,
+            )
+            return None
+        return g
+
     def _load_state(self) -> ConversationState:
         state = self.store.get(self.session_id)
         return state or ConversationState(session_id=self.session_id)
@@ -318,6 +409,11 @@ class Conversation:
             memory_block = self.memory.ambient_block(msg.content, self.user_id)
             extra_tools = self.memory.tool_defs()
 
+        # Grammar-constrained decoding: compiled once per turn (content-
+        # addressed cache makes repeat turns a hit), attached to every
+        # round's engine submit.
+        grammar = self._turn_grammar(msg, extra_tools)
+
         for _ in range(MAX_TOOL_ROUNDS + 1):
             # A cancel that landed between rounds (no engine request in
             # flight) must stop the turn, not be silently ignored.
@@ -350,8 +446,19 @@ class Conversation:
             try:
                 # session_id keys the engine's cross-turn KV reuse: the
                 # engine prefix-matches this prompt against the session's
-                # resident rows and prefills only the new tokens.
-                handle = self.engine.submit(prompt_ids, sp, session_id=self.session_id)
+                # resident rows and prefills only the new tokens. The
+                # grammar kwarg is only passed when attached, so engines
+                # without grammar support in their submit signature
+                # (coordinator/multihost fronts) keep working unchanged.
+                if grammar is not None:
+                    handle = self.engine.submit(
+                        prompt_ids, sp, session_id=self.session_id,
+                        grammar=grammar,
+                    )
+                else:
+                    handle = self.engine.submit(
+                        prompt_ids, sp, session_id=self.session_id
+                    )
             except Exception:
                 if llm_span is not None:
                     llm_span.status = "error"
@@ -431,14 +538,22 @@ class Conversation:
 
             if cancelled:
                 # Client asked to stop: persist what was produced, finish
-                # honestly with finish_reason=cancelled.
+                # honestly with finish_reason=cancelled. A cancel that
+                # landed INSIDE a tool call is surfaced distinctly — the
+                # parser buffer holds a partial call payload that was
+                # never dispatched, and silently reporting a plain
+                # cancel would hide that an action was cut off mid-
+                # intent (the caller may want to re-ask, not resume).
                 state.turns.append(Turn(role="assistant", content=assistant_text))
                 try:
                     self.store.put(state)
                 except StoreUnavailable:
                     pass
                 usage.cost_usd = self._cost(usage)
-                yield ServerMessage(type="done", usage=usage, finish_reason="cancelled")
+                reason = (
+                    "cancelled_in_tool_call" if parser.in_tool else "cancelled"
+                )
+                yield ServerMessage(type="done", usage=usage, finish_reason=reason)
                 return
 
             tail = detok.flush()
@@ -450,12 +565,19 @@ class Conversation:
                     elif tool_payload is None:
                         tool_payload = payload
             if parser.in_tool:
-                # Generation truncated mid-tool-call: the held-back fragment
-                # is internal JSON, never user text.
+                # Generation truncated mid-tool-call: the held-back
+                # fragment is internal JSON, never user text — but it is
+                # also evidence, so the error names the dropped payload
+                # instead of silently discarding it.
+                partial = parser.partial
                 yield ServerMessage(
                     type="error",
                     error_code="truncated_tool_call",
-                    error_message="generation ended inside a tool call",
+                    error_message=(
+                        "generation ended inside a tool call "
+                        f"({len(partial)} buffered payload chars dropped: "
+                        f"{partial[:80]!r})"
+                    ),
                 )
                 return
             tail2 = parser.flush()

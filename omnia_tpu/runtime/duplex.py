@@ -218,7 +218,11 @@ class DuplexSession:
                             seq=self._seq,
                         )
                 elif m.type == "done":
-                    if m.finish_reason == "cancelled" and self._interrupted.is_set():
+                    # cancelled_in_tool_call: barge-in landed while the
+                    # model was inside a <tool_call> — still a user
+                    # interruption, not a normal completion.
+                    if (m.finish_reason in ("cancelled", "cancelled_in_tool_call")
+                            and self._interrupted.is_set()):
                         yield ServerMessage(type="interruption", text="barge-in")
                         return
                     yield ServerMessage(

@@ -75,7 +75,8 @@ def build_engine(spec: ProviderSpec, *, warmup: bool = False):
                      "spec_decode", "quant", "max_sessions",
                      "prefix_cache_slots", "prefix_cache_rows",
                      "prefix_cache_publish_threshold",
-                     "prefix_cache_min_tokens", "prefix_cache_host_entries"}
+                     "prefix_cache_min_tokens", "prefix_cache_host_entries",
+                     "grammar", "grammar_max_states"}
         }
         if "prefill_buckets" in eng_kwargs:
             eng_kwargs["prefill_buckets"] = tuple(eng_kwargs["prefill_buckets"])

@@ -1,0 +1,1206 @@
+"""Grammar-constrained decoding: compiler, engine masking, runtime path.
+
+Covers the PR's acceptance contract:
+- every sampled sequence under a grammar decodes to output that parses
+  under the source schema/regex (property tests, worst-case sampling),
+- the post-hoc response_format validator can never fire with a grammar
+  attached (cross-check over random schemas),
+- the mock engine enforces identical masks to the compiled path,
+- grammar=off is a guarded true no-op,
+- the compile cache key is content-addressed and process-stable.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import random
+import re
+import subprocess
+import sys
+import threading
+
+import jsonschema
+import numpy as np
+import pytest
+
+from omnia_tpu.engine.grammar import (
+    GrammarTooLarge,
+    GrammarUnsupported,
+    TokenGrammar,
+    clear_cache,
+    compile_json_schema,
+    compile_regex,
+    compile_turn_grammar,
+    force_complete,
+    grammar_cache_key,
+    stats,
+    walk_text,
+)
+from omnia_tpu.engine.grammar.fsm import NfaBuilder, determinize
+from omnia_tpu.engine.tokenizer import ByteTokenizer, IncrementalDetokenizer
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+TOK = ByteTokenizer()
+
+
+def _complete(view, toks, s):
+    """Deterministic completion: each completion token strictly reduces
+    distance-to-accept, so this terminates in <= num_states steps."""
+    while not view.is_accepting(s):
+        t = view.completion_token(s)
+        assert t >= 0, f"state {s} cannot complete"
+        toks.append(t)
+        s = view.advance(s, t)
+    return toks, s
+
+
+def _rand_walk(view, rng, max_tokens=400):
+    """Random phase over admissible BYTE tokens, then forced completion —
+    worst-case in the sense that the random phase explores arbitrary
+    grammar corners before finishing."""
+    toks, s = [], view.start
+    for _ in range(rng.randint(3, max_tokens)):
+        allowed = np.flatnonzero(view.allowed(s)[:256])
+        if allowed.size == 0:
+            break
+        t = int(rng.choice(allowed))
+        toks.append(t)
+        s = view.advance(s, t)
+    toks, _s = _complete(view, toks, s)
+    return TOK.decode(toks)
+
+
+def _garbage_walk(view, rng, n=48):
+    """Worst-case proposal stream (the mock's semantics): mostly-masked
+    garbage bytes, each masked proposal replaced by the completion move —
+    what a maximally misbehaving model would force the sampler into."""
+    toks, s = [], view.start
+    for _ in range(n):
+        t = rng.randrange(256)
+        if not view.allowed(s)[t]:
+            t = view.completion_token(s)
+            if t < 0:
+                break
+        toks.append(t)
+        s = view.advance(s, t)
+    toks, _s = _complete(view, toks, s)
+    return TOK.decode(toks)
+
+
+# ---------------------------------------------------------------------------
+# Regex compiler
+# ---------------------------------------------------------------------------
+
+
+class TestRegexCompile:
+    PATTERNS = [
+        r"[a-c]{2,4}(x|yz)?\d+",
+        r"(foo|bar)+",
+        r"v\d+\.\d+\.\d+(-rc\d)?",
+        r"[A-F0-9]{8}",
+        r"yes|no|maybe",
+        r"a*b+c?",
+        r"\w{1,6}@\w{1,6}\.(com|org)",
+        r"^anchored$",
+        r"[^x]{1,3}",
+        r"wild.{0,3}card",
+    ]
+
+    def test_walks_fullmatch_python_re(self):
+        rng = random.Random(7)
+        for pat in self.PATTERNS:
+            g = compile_regex(pat, TOK)
+            v = g.view()
+            for _ in range(8):
+                text = _rand_walk(v, rng)
+                assert re.fullmatch(pat, text, re.ASCII), (pat, text)
+
+    def test_rejects_matching_strings_only(self):
+        g = compile_regex(r"ab+c", TOK)
+        v = g.view()
+        ok = TOK.encode("abbc", add_bos=False)
+        bad = TOK.encode("abd", add_bos=False)
+        assert walk_text(v, ok)
+        assert not walk_text(v, bad)
+
+    def test_eos_only_when_complete(self):
+        g = compile_regex(r"ab", TOK)
+        v = g.view()
+        s = v.start
+        assert not v.allowed(s)[TOK.eos_id]
+        s = v.advance(s, ord("a"))
+        assert not v.allowed(s)[TOK.eos_id]
+        s = v.advance(s, ord("b"))
+        assert v.is_accepting(s)
+        assert v.allowed(s)[TOK.eos_id]
+
+    def test_unsupported_constructs_refuse(self):
+        for pat in [r"(?=look)x", r"a\1", r"mid^anchor", r"\bword"]:
+            with pytest.raises(GrammarUnsupported):
+                compile_regex(pat, TOK)
+
+    def test_runaway_repeat_bounds(self):
+        with pytest.raises(GrammarTooLarge):
+            compile_regex(r"a{1,99999}", TOK)
+
+
+# ---------------------------------------------------------------------------
+# JSON-Schema compiler (property tests, worst-case sampling)
+# ---------------------------------------------------------------------------
+
+
+def _rand_schema(rng: random.Random, depth: int) -> dict:
+    kinds = ["string", "integer", "number", "boolean", "null", "enum"]
+    if depth > 0:
+        kinds += ["object", "array", "anyOf"]
+    kind = rng.choice(kinds)
+    if kind == "string":
+        s: dict = {"type": "string"}
+        if rng.random() < 0.5:
+            lo = rng.randint(0, 3)
+            s["minLength"] = lo
+            s["maxLength"] = lo + rng.randint(0, 6)
+        return s
+    if kind == "integer":
+        s = {"type": "integer"}
+        if rng.random() < 0.4:
+            s["minimum"] = 0
+        return s
+    if kind == "number":
+        return {"type": "number"}
+    if kind == "boolean":
+        return {"type": "boolean"}
+    if kind == "null":
+        return {"type": "null"}
+    if kind == "enum":
+        pool = ["red", "green", 1, 2.5, True, None, "héllo"]
+        return {"enum": rng.sample(pool, rng.randint(1, 3))}
+    if kind == "anyOf":
+        return {"anyOf": [_rand_schema(rng, depth - 1)
+                          for _ in range(rng.randint(1, 2))]}
+    if kind == "array":
+        lo = rng.randint(0, 2)
+        return {
+            "type": "array",
+            "items": _rand_schema(rng, depth - 1),
+            "minItems": lo,
+            "maxItems": lo + rng.randint(0, 2),
+        }
+    props = {
+        f"k{i}": _rand_schema(rng, depth - 1)
+        for i in range(rng.randint(1, 3))
+    }
+    names = list(props)
+    return {
+        "type": "object",
+        "properties": props,
+        "required": rng.sample(names, rng.randint(0, len(names))),
+    }
+
+
+class TestJsonSchemaProperty:
+    def test_fifty_random_schemas_worst_case_sampling(self):
+        """Acceptance property: with a grammar attached, every admitted
+        output parses AND validates — so the post-hoc validator
+        (`_check_response_format`) can never fire. ~50 random schemas,
+        worst-case (garbage-proposal) and random-walk sampling."""
+        from omnia_tpu.runtime.conversation import Conversation
+
+        check = Conversation._check_response_format
+        rng = random.Random(11)
+        for i in range(50):
+            schema = _rand_schema(rng, depth=2)
+            g = compile_json_schema(schema, TOK)
+            v = g.view()
+            for walker in (_rand_walk, _garbage_walk):
+                text = walker(v, rng)
+                doc = json.loads(text)
+                jsonschema.validate(doc, schema)
+                rf = {"type": "json_schema", "schema": schema}
+                err = check(None, text, rf)
+                assert err is None, (schema, text, err)
+
+    def test_generic_json_mode(self):
+        g = compile_json_schema(None, TOK)
+        rng = random.Random(3)
+        v = g.view()
+        for _ in range(5):
+            json.loads(_rand_walk(v, rng, max_tokens=2000))
+
+    def test_unenforceable_keywords_refuse(self):
+        bad = [
+            {"type": "integer", "minimum": 5},
+            {"type": "number", "maximum": 10},
+            {"oneOf": [{"type": "integer"}, {"type": "number"}]},
+            {"type": "array", "items": {"type": "integer"},
+             "uniqueItems": True},
+            {"type": "object", "properties": {"a": {"type": "string"}},
+             "required": ["a", "missing"]},
+            {"type": "string", "pattern": 'quo"te'},
+        ]
+        for schema in bad:
+            with pytest.raises(GrammarUnsupported):
+                compile_json_schema(schema, TOK)
+
+    def test_string_pattern_enforced(self):
+        schema = {"type": "string", "pattern": "^[a-z]{2,5}$"}
+        g = compile_json_schema(schema, TOK)
+        rng = random.Random(5)
+        for _ in range(5):
+            text = _rand_walk(g.view(), rng)
+            doc = json.loads(text)
+            jsonschema.validate(doc, schema)
+
+    def test_pattern_json_unsafe_bytes_restricted_or_refused(self):
+        """`.` and negated classes can MATCH a raw quote/backslash/
+        control byte even when the pattern source never spells one.
+        The compiler intersects every class with the JSON-string-safe
+        alphabet (emitted ⊂ pattern language — still re.search-valid),
+        and refuses outright when a LITERAL requires a forbidden byte."""
+        rng = random.Random(6)
+        for pat in ["a.c", "[^x]+", "^[a-z]{1,4}(-[0-9]{1,3})?$"]:
+            schema = {"type": "string", "pattern": pat}
+            g = compile_json_schema(schema, TOK)
+            for _ in range(6):
+                text = _garbage_walk(g.view(), rng)
+                doc = json.loads(text)  # raw quote/control would break this
+                jsonschema.validate(doc, schema)
+        with pytest.raises(GrammarUnsupported):
+            compile_json_schema(
+                {"type": "string", "pattern": "a\\tb"}, TOK)
+
+    def test_enum_filtered_by_sibling_type(self):
+        schema = {"type": "integer", "enum": [1, "x", 2, True]}
+        g = compile_json_schema(schema, TOK)
+        rng = random.Random(8)
+        for _ in range(6):
+            doc = json.loads(_rand_walk(g.view(), rng))
+            jsonschema.validate(doc, schema)  # only 1 / 2 are emittable
+        with pytest.raises(GrammarUnsupported):
+            compile_json_schema({"type": "integer", "enum": ["x"]}, TOK)
+        with pytest.raises(GrammarUnsupported):
+            compile_json_schema({"type": "integer", "const": True}, TOK)
+
+    def test_non_serializable_spec_refuses_not_crashes(self):
+        with pytest.raises(GrammarUnsupported):
+            compile_turn_grammar(None, [{
+                "name": "bad",
+                "input_schema": {"type": "object",
+                                 "properties": {"s": {"enum": {1, 2}}}},
+            }], TOK)
+
+
+# ---------------------------------------------------------------------------
+# Tool-call turn grammar
+# ---------------------------------------------------------------------------
+
+TOOLS = [
+    {"name": "add", "input_schema": {
+        "type": "object",
+        "properties": {"a": {"type": "integer"}, "b": {"type": "integer"}},
+        "required": ["a", "b"]}},
+    {"name": "get_weather", "input_schema": {
+        "type": "object",
+        "properties": {"city": {"type": "string", "maxLength": 12}},
+        "required": ["city"]}},
+]
+
+
+class TestToolCallGrammar:
+    def test_marker_forces_valid_tool_json(self):
+        g = compile_turn_grammar(None, TOOLS, TOK)
+        v = g.view()
+        rng = random.Random(2)
+        script = iter(TOK.encode("so, <tool_call>garbage", add_bos=False))
+
+        def propose(_s, allowed):
+            t = next(script, None)
+            if t is None:
+                return rng.choice(np.flatnonzero(allowed).tolist())
+            return t
+
+        toks, done = force_complete(v, propose, 600)
+        assert done
+        text = TOK.decode(toks)
+        m = re.search(r"<tool_call>(.*?)</tool_call>", text, re.S)
+        assert m, text
+        call = json.loads(m.group(1))
+        schema = {t["name"]: t["input_schema"] for t in TOOLS}[call["name"]]
+        jsonschema.validate(call["arguments"], schema)
+
+    def test_name_commit_hot_swaps_argument_schema(self):
+        """Once the emitted name commits to one tool, only that tool's
+        argument schema remains admissible — `add` args cannot carry
+        get_weather's `city`."""
+        g = compile_turn_grammar(None, TOOLS, TOK)
+        v = g.view()
+        good = TOK.encode('<tool_call>{"name":"add","arguments":{"a":1', add_bos=False)
+        assert walk_text(v, good)
+        crossed = TOK.encode(
+            '<tool_call>{"name":"add","arguments":{"city"', add_bos=False)
+        assert not walk_text(v, crossed)
+
+    def test_free_text_allows_partial_marker(self):
+        g = compile_turn_grammar(None, TOOLS, TOK)
+        v = g.view()
+        assert walk_text(v, TOK.encode("a < b and <tool", add_bos=False))
+        # ... and text states accept (the model may stop mid-almost-marker)
+        s = v.start
+        for t in TOK.encode("half <tool", add_bos=False):
+            s = v.advance(s, t)
+        assert v.is_accepting(s)
+
+    def test_response_format_plus_tools_union(self):
+        rf = {"type": "json_schema",
+              "schema": {"type": "object",
+                         "properties": {"x": {"type": "integer"}},
+                         "required": ["x"]}}
+        g = compile_turn_grammar(rf, TOOLS, TOK)
+        v = g.view()
+        rng = random.Random(9)
+        for _ in range(6):
+            text = _rand_walk(v, rng)
+            if text.startswith("<tool_call>"):
+                call = json.loads(
+                    text[len("<tool_call>"):text.index("</tool_call>")])
+                assert call["name"] in {"add", "get_weather"}
+            else:
+                jsonschema.validate(json.loads(text), rf["schema"])
+
+
+# ---------------------------------------------------------------------------
+# Token-level compilation for multi-byte-token vocabularies
+# ---------------------------------------------------------------------------
+
+
+class _FakeBpeTokenizer:
+    """Tiny stand-in for an HF vocabulary: multi-byte tokens exercise the
+    longest-match transition path (a token is admitted only when its
+    WHOLE byte string stays on live DFA paths)."""
+
+    def __init__(self):
+        self.pieces = [None, "a", "b", "ab", "abc", "xyz", '"', "{", "}"]
+        self.vocab_size = len(self.pieces) + 1  # + eos
+        self.bos_id = 0
+        self.eos_id = len(self.pieces)
+
+    def token_bytes(self):
+        return [p.encode() if p else None for p in self.pieces] + [None]
+
+    def encode(self, text, add_bos=True):  # pragma: no cover - unused
+        raise NotImplementedError
+
+    def decode(self, ids):
+        return "".join(self.pieces[i] for i in ids
+                       if i < len(self.pieces) and self.pieces[i])
+
+
+class TestMultiByteTokens:
+    def test_longest_match_token_transitions(self):
+        tok = _FakeBpeTokenizer()
+        b = NfaBuilder()
+        from omnia_tpu.engine.grammar.regex import regex_fragment
+
+        frag = regex_fragment(b, "abab|abc")
+        dfa = determinize(b, frag.start, {frag.end})
+        g = TokenGrammar(dfa, tok)
+        v = g.view()
+        s = v.start
+        allowed = v.allowed(s)
+        # "ab" and "abc" walk whole-token; "b"/"xyz" die on byte 1.
+        assert allowed[tok.pieces.index("a")]
+        assert allowed[tok.pieces.index("ab")]
+        assert allowed[tok.pieces.index("abc")]
+        assert not allowed[tok.pieces.index("b")]
+        assert not allowed[tok.pieces.index("xyz")]
+        s2 = v.advance(s, tok.pieces.index("ab"))
+        assert v.allowed(s2)[tok.pieces.index("ab")]
+        s3 = v.advance(s2, tok.pieces.index("ab"))
+        assert v.is_accepting(s3)
+        assert v.allowed(s3)[tok.eos_id]
+        assert not v.allowed(s2)[tok.eos_id]
+
+    def test_gpt2_byte_level_and_byte_fallback_pieces(self):
+        """Byte-level BPE pieces decode through the GPT-2 byte alphabet
+        (NOT utf-8 re-encoding — 'Ã©' is the two bytes C3 A9, one é) and
+        sentencepiece `<0xNN>` byte-fallback pieces are single bytes."""
+        from omnia_tpu.engine.grammar.fsm import tokenizer_token_bytes
+
+        class Inner:
+            def convert_ids_to_tokens(self, i):
+                return ["Ġhi", "Ã©", "<0x0A>", "▁sp", None][i]
+
+        class Wrapper:
+            vocab_size = 5
+            bos_id = 3
+            eos_id = 4
+            _tok = Inner()
+
+            def decode(self, ids):  # pragma: no cover - unused
+                return ""
+
+        tb = tokenizer_token_bytes(Wrapper())
+        assert tb[0] == b" hi"
+        assert tb[1] == "é".encode("utf-8")  # C3 A9, not C3 83 C2 A9
+        assert tb[2] == b"\n"
+        # byte-level alphabet detected ⇒ '▁' is outside the byte
+        # decoder's domain: masked (None), never wrong bytes
+        assert tb[3] is None
+        assert tb[4] is None
+
+
+# ---------------------------------------------------------------------------
+# Compile cache
+# ---------------------------------------------------------------------------
+
+
+class TestCompileCache:
+    def test_key_is_content_addressed_and_order_stable(self):
+        s1 = {"type": "object", "properties": {"a": {"type": "integer"},
+                                               "b": {"type": "boolean"}}}
+        s2 = json.loads(json.dumps(s1))  # fresh dicts
+        s2["properties"] = dict(reversed(list(s2["properties"].items())))
+        assert grammar_cache_key("turn", s1, TOK) == \
+            grammar_cache_key("turn", s2, TOK)
+
+    def test_key_stable_across_processes(self):
+        """The key must be a pure function of (spec, tokenizer
+        fingerprint) — re-derive the canonical payload independently and
+        match the sha256. A process-local id() or dict-order dependence
+        would break this."""
+        spec = {"schema": {"type": "integer"}}
+        payload = {
+            "v": 1, "kind": "regex", "spec": spec,
+            "tokenizer": {"class": "ByteTokenizer", "vocab_size": 259,
+                          "bos_id": 256, "eos_id": 257},
+        }
+        blob = json.dumps(payload, sort_keys=True, separators=(",", ":"),
+                          ensure_ascii=True)
+        expected = hashlib.sha256(blob.encode()).hexdigest()
+        assert grammar_cache_key("regex", spec, TOK) == expected
+
+    def test_hit_miss_counters(self):
+        clear_cache()
+        compile_regex(r"x\d+", TOK)
+        assert stats == {"hits": 0, "misses": 1}
+        compile_regex(r"x\d+", TOK)
+        assert stats == {"hits": 1, "misses": 1}
+        compile_regex(r"y\d+", TOK)
+        assert stats == {"hits": 1, "misses": 2}
+
+
+# ---------------------------------------------------------------------------
+# Windowed incremental detokenizer (satellite)
+# ---------------------------------------------------------------------------
+
+
+class TestDetokenizerWindow:
+    def test_multibyte_utf8_split_across_tokens_equivalence(self):
+        # CJK + emoji + combining chars, byte tokens split mid-rune, and
+        # long enough to force many window folds.
+        text = ("héllo wörld 漢字テスト🙂🦙" * 20) + " tail"
+        ids = TOK.encode(text, add_bos=False)
+        detok = IncrementalDetokenizer(TOK)
+        streamed = "".join(detok.push(i) for i in ids) + detok.flush()
+        assert streamed == TOK.decode(ids)
+
+    def test_window_actually_bounds_state(self):
+        detok = IncrementalDetokenizer(TOK)
+        for i in TOK.encode("abcdefgh" * 50, add_bos=False):
+            detok.push(i)
+        assert len(detok._ids) <= IncrementalDetokenizer.WINDOW
+
+    def test_fold_defers_on_split_sensitive_tokenizer(self):
+        """Sentencepiece-style decode (leading-space marker stripped at
+        SEQUENCE start only) makes decode(left)+decode(right) differ
+        from decode(whole) at every cut — the fold must defer (window
+        bound yields) and the stream must still equal the full-sequence
+        decode exactly."""
+
+        class SpStyleTok:
+            vocab_size = 300
+            bos_id = 256
+            eos_id = 257
+
+            def decode(self, ids):
+                # every piece carries a leading-space marker; the very
+                # first marker of a sequence is stripped.
+                return " ".join(str(i) for i in ids)
+
+        tok = SpStyleTok()
+        detok = IncrementalDetokenizer(tok)
+        ids = list(range(2, 102))
+        streamed = "".join(detok.push(i) for i in ids) + detok.flush()
+        assert streamed == tok.decode(ids)
+        # correctness won over the window bound: no fold point was legal
+        detok2 = IncrementalDetokenizer(tok)
+        for i in ids:
+            detok2.push(i)
+        assert len(detok2._ids) == len(ids)
+
+    def test_trailing_partial_rune_held_back(self):
+        detok = IncrementalDetokenizer(TOK)
+        ids = "🙂".encode("utf-8")
+        assert detok.push(ids[0]) == ""
+        assert detok.push(ids[1]) == ""
+        assert detok.push(ids[2]) == ""
+        assert detok.push(ids[3]) == "🙂"
+
+
+# ---------------------------------------------------------------------------
+# Engine integration (compiled path) + no-op guard
+# ---------------------------------------------------------------------------
+
+
+def _drain(engine, handle):
+    toks = []
+    fin = None
+    while fin is None:
+        engine.step()
+        try:
+            while True:
+                ev = handle._queue.get_nowait()
+                if ev.token_id is not None:
+                    toks.append(ev.token_id)
+                if ev.is_final:
+                    fin = ev
+                    break
+        except Exception:  # noqa: BLE001 - queue.Empty
+            pass
+    return toks, fin
+
+
+@pytest.fixture(scope="module")
+def grammar_engine():
+    from omnia_tpu.engine import EngineConfig, InferenceEngine
+    from omnia_tpu.models import get_config
+
+    ecfg = EngineConfig(num_slots=4, max_seq=128, prefill_buckets=(64,),
+                        dtype="float32", max_sessions=4, grammar=True,
+                        grammar_max_states=512)
+    return InferenceEngine(get_config("test-tiny"), ecfg, seed=0)
+
+
+SCHEMA = {"type": "object",
+          "properties": {"a": {"type": "integer"},
+                         "ok": {"type": "boolean"}},
+          "required": ["a", "ok"]}
+
+
+class TestEngineGrammar:
+    def test_constrained_sampled_generation_validates(self, grammar_engine):
+        from omnia_tpu.engine import FinishReason, SamplingParams
+
+        eng = grammar_engine
+        g = compile_json_schema(SCHEMA, TOK)
+        # Stop id 0: byte 0 is never admissible inside the grammar, so
+        # it plays EOS for the 256-vocab test model.
+        sp = SamplingParams(temperature=1.0, max_tokens=120,
+                            stop_token_ids=(0,))
+        prompt = TOK.encode("make json")
+        handles = [eng.submit(prompt, sp, grammar=g) for _ in range(3)]
+        for h in handles:
+            toks, fin = _drain(eng, h)
+            assert fin.finish_reason == FinishReason.STOP
+            text = TOK.decode([t for t in toks if t != 0])
+            jsonschema.validate(json.loads(text), SCHEMA)
+        assert eng.metrics["grammar_rejections_avoided"] >= 3
+        assert 0.0 < eng.metrics["masked_logit_fraction"] <= 1.0
+
+    def test_mixed_batch_unconstrained_slot_unaffected(self, grammar_engine):
+        from omnia_tpu.engine import SamplingParams
+
+        eng = grammar_engine
+        g = compile_json_schema(SCHEMA, TOK)
+        sp_g = SamplingParams(temperature=1.0, max_tokens=100,
+                              stop_token_ids=(0,))
+        sp_free = SamplingParams(temperature=1.0, max_tokens=12, seed=5)
+        prompt = TOK.encode("mix")
+        hg = eng.submit(prompt, sp_g, grammar=g)
+        hf = eng.submit(prompt, sp_free)
+        toks_f, fin_f = _drain(eng, hf)
+        toks_g, fin_g = _drain(eng, hg)
+        assert len(toks_f) == 12  # free slot ran to its budget unmasked
+        jsonschema.validate(
+            json.loads(TOK.decode([t for t in toks_g if t != 0])), SCHEMA)
+
+    def test_sampled_tokens_follow_host_mirror(self, grammar_engine):
+        """Device-advanced FSM state and the host mirror agree: every
+        emitted token is admissible from the mirror's running state —
+        the compiled path enforces exactly the TokenGrammar tables."""
+        from omnia_tpu.engine import SamplingParams
+
+        eng = grammar_engine
+        g = compile_json_schema(SCHEMA, TOK)
+        sp = SamplingParams(temperature=1.0, max_tokens=100,
+                            stop_token_ids=(0,))
+        h = eng.submit(TOK.encode("mirror"), sp, grammar=g)
+        toks, _fin = _drain(eng, h)
+        v = g.view(eng.model_cfg.vocab_size, (0,))
+        s = v.start
+        for t in toks:
+            assert v.allowed(s)[t], (s, t)
+            s = v.advance(s, t)
+
+    def test_session_turns_with_grammar(self, grammar_engine):
+        from omnia_tpu.engine import SamplingParams
+
+        eng = grammar_engine
+        g = compile_json_schema(SCHEMA, TOK)
+        sp = SamplingParams(temperature=1.0, max_tokens=100,
+                            stop_token_ids=(0,))
+        prompt = TOK.encode("turn one")
+        h = eng.submit(prompt, sp, session_id="gs", grammar=g)
+        toks, _ = _drain(eng, h)
+        prompt2 = prompt + toks[:-1] + TOK.encode(" turn two", add_bos=False)
+        h2 = eng.submit(prompt2, sp, session_id="gs", grammar=g)
+        toks2, _ = _drain(eng, h2)
+        jsonschema.validate(
+            json.loads(TOK.decode([t for t in toks2 if t != 0])), SCHEMA)
+
+    def test_too_large_grammar_rejected_at_submit(self, grammar_engine):
+        from omnia_tpu.engine import FinishReason, SamplingParams
+
+        eng = grammar_engine
+        big = compile_json_schema(None, TOK)  # generic JSON > 512 states
+        h = eng.submit(TOK.encode("x"), SamplingParams(), grammar=big)
+        ev = h.get_event(timeout=5)
+        assert ev.finish_reason == FinishReason.ERROR
+        assert "grammar" in ev.error
+
+    def test_compile_cache_metrics_mirrored(self, grammar_engine):
+        from omnia_tpu.engine import SamplingParams
+
+        clear_cache()
+        g = compile_json_schema(SCHEMA, TOK)
+        compile_json_schema(SCHEMA, TOK)
+        eng = grammar_engine
+        h = eng.submit(TOK.encode("m"), SamplingParams(
+            temperature=0.0, max_tokens=4, stop_token_ids=(0,)), grammar=g)
+        _drain(eng, h)
+        assert eng.metrics["grammar_compile_misses"] == 1
+        assert eng.metrics["grammar_compile_hits"] == 1
+
+
+class TestGrammarOffNoop:
+    """CI/tooling satellite: grammar=off allocates nothing and traces no
+    grammar operands; the grammar package itself is jax-free."""
+
+    def test_engine_grammar_import_is_jax_free(self):
+        """Importing omnia_tpu.engine.grammar must not initialize jax —
+        no device arrays can exist if jax is never imported."""
+        code = (
+            "import sys; import omnia_tpu.engine.grammar; "
+            "assert 'jax' not in sys.modules, 'jax imported'; "
+            "import omnia_tpu.engine.grammar.fsm, "
+            "omnia_tpu.engine.grammar.jsonfsm, "
+            "omnia_tpu.engine.grammar.regex, omnia_tpu.engine.grammar.cache; "
+            "assert 'jax' not in sys.modules, 'jax imported by submodule'"
+        )
+        subprocess.run([sys.executable, "-c", code], check=True, cwd=REPO)
+
+    def test_grammar_off_engine_allocates_no_grammar_state(self):
+        from omnia_tpu.engine import (
+            EngineConfig, FinishReason, InferenceEngine, SamplingParams,
+        )
+        from omnia_tpu.models import get_config
+
+        ecfg = EngineConfig(num_slots=2, max_seq=64, prefill_buckets=(32,),
+                            dtype="float32", max_sessions=0)
+        eng = InferenceEngine(get_config("test-tiny"), ecfg, seed=0)
+        assert not eng.supports_grammar()
+        assert eng._gstate is None
+        assert eng._gtable is None
+        assert eng._gactive is None
+        assert eng._gbias_zero is None
+        # A grammar request is refused with a real error, not silently
+        # served unconstrained.
+        g = compile_regex(r"\d+", TOK)
+        h = eng.submit(TOK.encode("x"), SamplingParams(), grammar=g)
+        ev = h.get_event(timeout=5)
+        assert ev.finish_reason == FinishReason.ERROR
+        assert "grammar=off" in ev.error
+        # ... and ungrammared serving works with untouched grammar metrics.
+        h2 = eng.submit(TOK.encode("y"), SamplingParams(max_tokens=4))
+        _drain(eng, h2)
+        assert eng.metrics["grammar_compile_misses"] == 0
+        assert eng.metrics["masked_logit_fraction"] == 0.0
+        assert eng.metrics["grammar_rejections_avoided"] == 0
+
+    def test_grammar_package_sources_never_import_jax(self):
+        gdir = os.path.join(REPO, "omnia_tpu", "engine", "grammar")
+        for fn in os.listdir(gdir):
+            if not fn.endswith(".py"):
+                continue
+            with open(os.path.join(gdir, fn)) as f:
+                src = f.read()
+            assert not re.search(r"^\s*(import jax|from jax)", src, re.M), (
+                f"omnia_tpu/engine/grammar/{fn} imports jax — the package "
+                "must stay host-side"
+            )
+
+
+# ---------------------------------------------------------------------------
+# Mock-engine parity
+# ---------------------------------------------------------------------------
+
+
+class TestMockGrammarParity:
+    def test_mock_enforces_identical_masks(self):
+        """The mock walks the SAME table the compiled path uploads:
+        every emitted token is admissible step-by-step, and the device
+        table rows equal the mock view's rows."""
+        from omnia_tpu.engine import MockEngine, SamplingParams
+
+        g = compile_json_schema(SCHEMA, TOK)
+        eng = MockEngine([], tokenizer=TOK)
+        h = eng.submit(TOK.encode("anything"),
+                       SamplingParams(max_tokens=200), grammar=g)
+        toks, fin = h.collect_tokens(timeout=30)
+        v = g.view(TOK.vocab_size)
+        s = v.start
+        for t in toks:
+            assert v.allowed(s)[t]
+            s = v.advance(s, t)
+        assert v.is_accepting(s)
+        jsonschema.validate(json.loads(TOK.decode(toks)), SCHEMA)
+        # Device-table prefix == mock view table (same arrays, padded).
+        dev = g.device_table(512, 259, (0,))
+        np.testing.assert_array_equal(
+            dev[:g.num_states, :TOK.vocab_size],
+            g.view(259, (0,)).table[:, :TOK.vocab_size],
+        )
+        assert eng.metrics["grammar_rejections_avoided"] == 1
+        assert eng.metrics["masked_logit_fraction"] > 0
+
+    def test_scripted_garbage_becomes_valid(self):
+        from omnia_tpu.engine import MockEngine, SamplingParams
+        from omnia_tpu.engine.mock import Scenario
+
+        g = compile_json_schema(SCHEMA, TOK)
+        eng = MockEngine([Scenario(pattern=".", reply="not json at all")],
+                         tokenizer=TOK)
+        toks, _fin = eng.submit(
+            TOK.encode("x"), SamplingParams(max_tokens=200), grammar=g
+        ).collect_tokens(timeout=30)
+        jsonschema.validate(json.loads(TOK.decode(toks)), SCHEMA)
+
+    def test_conforming_script_plays_back_verbatim(self):
+        """Stop-id parity with the compiled path: a scripted reply that
+        already satisfies the grammar must stream byte-identical —
+        including when the request carries custom stop ids (the mock's
+        view must unmask them in accepting states, like placement
+        does)."""
+        from omnia_tpu.engine import MockEngine, SamplingParams
+        from omnia_tpu.engine.mock import Scenario
+
+        g = compile_json_schema(SCHEMA, TOK)
+        reply = '{"a":7,"ok":true}'
+        eng = MockEngine([Scenario(pattern=".", reply=reply)], tokenizer=TOK)
+        toks, _fin = eng.submit(
+            TOK.encode("x"),
+            SamplingParams(max_tokens=200, stop_token_ids=(0,)), grammar=g,
+        ).collect_tokens(timeout=30)
+        assert TOK.decode(toks) == reply
+
+
+# ---------------------------------------------------------------------------
+# Runtime path: conversation + response_format / tool args (cross-check)
+# ---------------------------------------------------------------------------
+
+
+def _conv(scenarios, pack_extra=None, handlers=None, session="g1"):
+    from omnia_tpu.engine import MockEngine
+    from omnia_tpu.engine.mock import Scenario
+    from omnia_tpu.runtime.context_store import InMemoryContextStore
+    from omnia_tpu.runtime.conversation import Conversation
+    from omnia_tpu.runtime.packs import load_pack
+    from omnia_tpu.tools import ToolExecutor
+
+    doc = {
+        "name": "g", "version": "1.0.0",
+        "prompts": {"system": "You are terse."},
+        "sampling": {"temperature": 0.0, "max_tokens": 400},
+    }
+    doc.update(pack_extra or {})
+    tok = ByteTokenizer()
+    eng = MockEngine([Scenario(**s) for s in scenarios], tokenizer=tok)
+    return Conversation(
+        session_id=session, pack=load_pack(doc), engine=eng, tokenizer=tok,
+        store=InMemoryContextStore(),
+        tool_executor=ToolExecutor(handlers or []),
+    )
+
+
+class TestRuntimeGrammar:
+    def test_posthoc_validator_never_fires_with_grammar(self):
+        """Cross-check satellite: random schemas, scripted-garbage
+        replies (the mock's worst-case proposal stream) — the turn must
+        finish `done`, never `bad_response_format`."""
+        import omnia_tpu.runtime.contract as c
+
+        rng = random.Random(23)
+        for i in range(8):
+            schema = _rand_schema(rng, depth=1)
+            conv = _conv([{"pattern": ".", "reply": "complete garbage !!"}],
+                         session=f"pg{i}")
+            msgs = list(conv.stream(c.ClientMessage(
+                content=f"go {i}",
+                response_format={"type": "json_schema", "schema": schema},
+            )))
+            assert msgs[-1].type == "done", (schema, vars(msgs[-1]))
+            text = "".join(m.text for m in msgs if m.type == "chunk")
+            jsonschema.validate(json.loads(text), schema)
+
+    def test_plain_json_mode_stays_posthoc(self):
+        """`{"type": "json"}` (no schema) keeps the pre-grammar
+        behavior: invalid output surfaces bad_response_format."""
+        import omnia_tpu.runtime.contract as c
+
+        conv = _conv([{"pattern": ".", "reply": "not json at all"}])
+        msgs = list(conv.stream(c.ClientMessage(
+            content="x", response_format={"type": "json"})))
+        assert msgs[-1].type == "error"
+        assert msgs[-1].error_code == "bad_response_format"
+
+    def test_tool_arguments_valid_by_construction(self):
+        import omnia_tpu.runtime.contract as c
+        from omnia_tpu.tools import ToolHandler
+
+        calls = []
+        handlers = [ToolHandler(name="add", type="python",
+                                fn=lambda a: calls.append(a) or "5")]
+        conv = _conv(
+            [
+                {"pattern": r"\[TOOL\]", "reply": "the sum is 5"},
+                # Scripted args are WRONG (strings): the grammar coerces
+                # them into schema-valid integers before dispatch.
+                {"pattern": "calc", "reply":
+                    '<tool_call>{"name": "add", "arguments": '
+                    '{"a": "two", "b": "three"}}</tool_call>'},
+            ],
+            pack_extra={"tools": [dict(TOOLS[0], description="adds")]},
+            handlers=handlers,
+        )
+        msgs = list(conv.stream(c.ClientMessage(content="calc")))
+        assert msgs[-1].type == "done"
+        assert len(calls) == 1
+        jsonschema.validate(calls[0], TOOLS[0]["input_schema"])
+
+    def test_schema_less_tool_disables_constraint(self):
+        conv = _conv([], pack_extra={"tools": [
+            {"name": "a", "input_schema": {"type": "object"}},
+            {"name": "b"},  # no schema anywhere
+        ]})
+        assert conv._grammar_tools(None) is None
+
+    def test_plain_json_with_tools_attaches_nothing(self):
+        """A tools-only grammar under {"type": "json"} would admit free
+        text the format forbids — no partial enforcement: attach
+        nothing, keep both post-hoc paths."""
+        import omnia_tpu.runtime.contract as c
+
+        conv = _conv([], pack_extra={"tools": [dict(TOOLS[0])]})
+        msg = c.ClientMessage(content="x", response_format={"type": "json"})
+        assert conv._turn_grammar(msg, None) is None
+        # ... while without a response_format the tool grammar attaches.
+        assert conv._turn_grammar(c.ClientMessage(content="x"), None) \
+            is not None
+
+    def test_unsupported_schema_falls_back_posthoc(self):
+        import omnia_tpu.runtime.contract as c
+
+        schema = {"type": "integer", "minimum": 5}  # not FSM-enforceable
+        conv = _conv([{"pattern": ".", "reply": "7"}])
+        msgs = list(conv.stream(c.ClientMessage(
+            content="x",
+            response_format={"type": "json_schema", "schema": schema})))
+        # grammar refused → post-hoc validated the scripted reply (7 ≥ 5)
+        assert msgs[-1].type == "done"
+
+
+class TestUnterminatedToolCall:
+    def test_truncated_stream_surfaces_partial(self):
+        import omnia_tpu.runtime.contract as c
+
+        conv = _conv([{"pattern": ".", "reply":
+                       '<tool_call>{"name": "echo", "argu'}])
+        msgs = list(conv.stream(c.ClientMessage(content="x")))
+        assert msgs[-1].type == "error"
+        assert msgs[-1].error_code == "truncated_tool_call"
+        # The buffered partial call is named, not silently dropped.
+        assert '{"name": "echo"' in msgs[-1].error_message
+
+    def test_cancel_inside_tool_call_distinct_finish(self):
+        import omnia_tpu.runtime.contract as c
+
+        conv = _conv([{"pattern": ".", "reply":
+                       '<tool_call>{"name": "echo", "arguments": {"text": '
+                       '"' + "x" * 200 + '"}}</tool_call>',
+                       "delay_per_token_s": 0.01}])
+        timer = threading.Timer(0.4, conv.cancel_turn)
+        timer.start()
+        try:
+            msgs = list(conv.stream(c.ClientMessage(content="x")))
+        finally:
+            timer.cancel()
+        assert msgs[-1].type == "done"
+        assert msgs[-1].finish_reason == "cancelled_in_tool_call"
+
+    def test_parser_partial_property(self):
+        from omnia_tpu.runtime.conversation import ToolCallStreamParser
+
+        p = ToolCallStreamParser()
+        p.feed('before <tool_call>{"na')
+        assert p.in_tool
+        assert p.partial == '{"na'
+        p2 = ToolCallStreamParser()
+        p2.feed("plain text")
+        assert p2.partial == ""
+
+
+class TestBenchGrammarScenario:
+    def test_bench_has_grammar_scenario(self):
+        import bench
+
+        assert callable(bench._bench_grammar)
+
+    def test_bench_wires_grammar_aux(self):
+        with open(os.path.join(REPO, "bench.py")) as f:
+            src = f.read()
+        assert '"grammar": grammar_bench' in src
+        assert 'result["aux"]["grammar"] = grammar_bench' in src
+
+
+class TestReviewHardening:
+    """Contracts pinned by the second review pass."""
+
+    def test_bare_object_schema_admits_arbitrary_members(self):
+        """{"type": "object"} means ANY object (additionalProperties
+        defaults true) — constraining it to the literal "{}" would make
+        a permissively-schema'd tool strictly less usable than one with
+        no schema at all."""
+        g = compile_json_schema({"type": "object"}, TOK)
+        v = g.view(TOK.vocab_size, (0,))
+        good = '{"anything": [1, "x"], "more": true}'
+        assert walk_text(v, TOK.encode(good, add_bos=False))
+        assert walk_text(v, TOK.encode("{}", add_bos=False))
+        assert not walk_text(v, TOK.encode("[1]", add_bos=False))
+
+    def test_stop_id_masked_outside_accepting_states(self):
+        """A stop id that is also a grammar token must not be sampleable
+        mid-grammar (the engine would terminate on it and emit truncated,
+        schema-invalid output)."""
+        from omnia_tpu.engine.grammar.fsm import GrammarError
+
+        schema = {"type": "object",
+                  "properties": {"s": {"type": "string", "maxLength": 4}},
+                  "required": ["s"]}
+        v = compile_json_schema(schema, TOK).view(TOK.vocab_size, (120,))
+        v.check_live()  # strings can route around 'x'
+        s = v.start
+        for t in TOK.encode('{"s": "a', add_bos=False):
+            s = v.advance(s, t)
+        assert v.advance(s, 120) < 0  # 'x' masked inside the string
+        # When masking starves a state (the '}' byte as a stop id), the
+        # request refuses up front instead of silently truncating.
+        g2 = compile_json_schema(
+            {"type": "object", "properties": {"a": {"type": "integer"}},
+             "required": ["a"]}, TOK)
+        with pytest.raises(GrammarError):
+            g2.view(TOK.vocab_size, (125,)).check_live()
+
+    def test_view_and_table_memos_bounded(self):
+        schema = {"type": "object",
+                  "properties": {"x": {"type": "integer"}},
+                  "required": ["x"]}
+        g = compile_json_schema(schema, TOK)
+        for i in range(3 * TokenGrammar._MEMO_CAP):
+            g.view(TOK.vocab_size, (i,))
+        assert len(g._views) <= TokenGrammar._MEMO_CAP
+        assert g.nbytes() > 0
+
+    def test_turn_grammar_respects_engine_state_budget(self):
+        """A compiled grammar larger than THIS engine's device budget
+        falls back to post-hoc validation (the compile cache is shared
+        across engines), never a hard submit error."""
+        from types import SimpleNamespace
+
+        from omnia_tpu.runtime.context_store import InMemoryContextStore
+        from omnia_tpu.runtime.conversation import Conversation
+        from omnia_tpu.runtime.packs import load_pack
+        from omnia_tpu.tools import ToolExecutor
+        import omnia_tpu.runtime.contract as c
+
+        class StubEngine:
+            cfg = SimpleNamespace(grammar_max_states=4)
+
+            def supports_grammar(self):
+                return True
+
+        conv = Conversation(
+            session_id="budget", engine=StubEngine(), tokenizer=TOK,
+            pack=load_pack({"name": "v", "version": "1.0.0",
+                            "prompts": {"system": "t"},
+                            "sampling": {"temperature": 0.0,
+                                         "max_tokens": 10}}),
+            store=InMemoryContextStore(), tool_executor=ToolExecutor([]))
+        schema = {"type": "object",
+                  "properties": {"x": {"type": "integer"}},
+                  "required": ["x"]}
+        msg = c.ClientMessage(
+            content="q",
+            response_format={"type": "json_schema", "schema": schema})
+        assert conv._turn_grammar(msg, None) is None
+        StubEngine.cfg = SimpleNamespace(grammar_max_states=4096)
+        assert conv._turn_grammar(msg, None) is not None
+
+    def test_rf_with_partially_schemad_tools_attaches_nothing(self):
+        """rf-only enforcement with tools declared would mask off every
+        tool's `<tool_call>` marker bytes — the no-partial-enforcement
+        rule applies turn-wide."""
+        from types import SimpleNamespace
+
+        from omnia_tpu.runtime.context_store import InMemoryContextStore
+        from omnia_tpu.runtime.conversation import Conversation
+        from omnia_tpu.runtime.packs import load_pack
+        from omnia_tpu.tools import ToolExecutor
+        import omnia_tpu.runtime.contract as c
+
+        class StubEngine:
+            cfg = SimpleNamespace(grammar_max_states=4096)
+
+            def supports_grammar(self):
+                return True
+
+        conv = Conversation(
+            session_id="partial", engine=StubEngine(), tokenizer=TOK,
+            pack=load_pack({"name": "v", "version": "1.0.0",
+                            "prompts": {"system": "t"},
+                            "sampling": {"temperature": 0.0,
+                                         "max_tokens": 10},
+                            "tools": [{"name": "a",
+                                       "input_schema": {"type": "object"}},
+                                      {"name": "b"}]}),
+            store=InMemoryContextStore(), tool_executor=ToolExecutor([]))
+        msg = c.ClientMessage(
+            content="q",
+            response_format={"type": "json_schema",
+                             "schema": {"type": "object",
+                                        "properties": {},
+                                        "maxProperties": 0}})
+        assert conv._turn_grammar(msg, None) is None
+
+
+    def test_lone_surrogate_escapes_unrepresentable(self):
+        """String grammars must refuse surrogate escapes outright: a
+        lone \\uD800-\\uDFFF passes json.loads AND jsonschema, but the
+        decoded value crashes any downstream .encode('utf-8') — so the
+        automaton may not admit them (pairs included; astral chars stay
+        expressible as raw UTF-8)."""
+        schema = {"type": "object",
+                  "properties": {"s": {"type": "string"}},
+                  "required": ["s"]}
+        v = compile_json_schema(schema, TOK).view(TOK.vocab_size, (0,))
+
+        def admits(text):
+            return walk_text(v, TOK.encode(text, add_bos=False))
+
+        assert admits('{"s":"\\u0041"}')          # ordinary escape fine
+        assert admits('{"s":"\\uD7FF"}')          # just below the range
+        assert admits('{"s":"🚀"}')               # astral as raw UTF-8
+        for esc in ("\\uD800", "\\uDBFF", "\\uDC00", "\\uDFFF"):
+            assert not admits('{"s":"%s"}' % esc), esc
+        # Pairs are refused too (their high half is already inadmissible).
+        assert not admits('{"s":"\\uD83D\\uDE00"}')
+        # minLength path uses the same character class.
+        v2 = compile_json_schema(
+            {"type": "object",
+             "properties": {"s": {"type": "string", "minLength": 2}},
+             "required": ["s"]}, TOK).view(TOK.vocab_size, (0,))
+        assert not walk_text(v2, TOK.encode('{"s":"\\uDC00\\uDC00"}',
+                                            add_bos=False))
+
+    def test_mock_refuses_starved_grammar_like_engine(self):
+        """Submit-time parity: a grammar starved by its stop id refuses
+        on the mock exactly as on the real engine — instead of playing
+        back a truncated walk that force_complete mislabels 'completed'."""
+        from omnia_tpu.engine import FinishReason, MockEngine, SamplingParams
+        from omnia_tpu.engine.mock import Scenario
+
+        g = compile_json_schema(
+            {"type": "object", "properties": {"a": {"type": "integer"}},
+             "required": ["a"]}, TOK)
+        eng = MockEngine([Scenario(pattern=".", reply="x")], tokenizer=TOK)
+        # '}' (125) as stop id starves the post-'}' states.
+        h = eng.submit(TOK.encode("x"),
+                       SamplingParams(max_tokens=50, stop_token_ids=(125,)),
+                       grammar=g)
+        ev = h.get_event(timeout=5)
+        assert ev.finish_reason == FinishReason.ERROR
+        assert "grammar rejected" in ev.error
+
+    def test_force_complete_reports_starved_state_honestly(self):
+        """force_complete must not conflate 'accepting' with 'dead end':
+        a walk stuck in a non-accepting state with no completion move
+        returns completed=False."""
+        from omnia_tpu.engine.grammar.fsm import SamplerView
+
+        # Two states: start --(1)--> trap; trap is non-accepting and has
+        # no outgoing admissible token at all.
+        table = np.full((2, 3), -1, np.int32)
+        table[0, 1] = 1
+        view = SamplerView(table, np.array([False, False]), 0)
+        toks, done = force_complete(view, lambda s, allowed: 1, 10)
+        assert toks == [1]
+        assert done is False
+
+
+    def test_grammar_eos_finishes_without_explicit_stop_id(self):
+        """A grammar request whose SamplingParams omit the tokenizer's
+        eos from stop_token_ids must still finish STOP at the terminal
+        accepting state: placement folds grammar.eos_id into the slot's
+        stop set, so the view's only-unmasked-token there actually
+        terminates instead of streaming raw EOS until the budget."""
+        import dataclasses
+
+        from omnia_tpu.engine import (EngineConfig, FinishReason,
+                                      InferenceEngine, SamplingParams)
+        from omnia_tpu.models import get_config
+
+        # test-tiny's vocab (256) excludes ByteTokenizer's eos (257);
+        # widen it so the accepting-state EOS unmask is in-vocab.
+        mcfg = dataclasses.replace(get_config("test-tiny"),
+                                   name="test-tiny-eos", vocab_size=260)
+        ecfg = EngineConfig(num_slots=2, max_seq=128, prefill_buckets=(64,),
+                            dtype="float32", max_sessions=2, grammar=True,
+                            grammar_max_states=256)
+        eng = InferenceEngine(mcfg, ecfg, seed=1)
+        g = compile_regex("(ab|cd)", TOK)
+        h = eng.submit(TOK.encode("x"),
+                       SamplingParams(temperature=1.0, max_tokens=40), grammar=g)
+        toks, fin = _drain(eng, h)
+        assert fin.finish_reason == FinishReason.STOP
+        assert TOK.decode([t for t in toks if t < 256]) in ("ab", "cd")
+
+    def test_truncated_hex_escapes_refused(self):
+        """Pattern-final '\\x4' / '\\u12' must refuse like Python re
+        does (incomplete escape), not compile a mask admitting chr(0x4)
+        that the post-hoc validator then crashes on."""
+        for pat in (r"id-\x4", r"id-\u12", r"[\x4]"):
+            with pytest.raises(GrammarUnsupported):
+                compile_regex(pat, TOK)
+
+    def test_possessive_quantifiers_refused(self):
+        """Possessive quantifiers change the language (a*+a matches
+        nothing) — dropping one would admit strings re.fullmatch
+        rejects, so they refuse; lazy modifiers (preference-only) still
+        compile."""
+        for pat in (r"a*+a", r"a++", r"ab?+", r"a{1,3}+b"):
+            with pytest.raises(GrammarUnsupported):
+                compile_regex(pat, TOK)
+        g = compile_regex(r"a+?b", TOK)  # lazy: same language as a+b
+        v = g.view(TOK.vocab_size, (0,))
+        assert walk_text(v, TOK.encode("aab", add_bos=False))

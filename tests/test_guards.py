@@ -96,6 +96,25 @@ def test_rbac_sync_guard():
         ), f"missing committed CRD yaml for {kind}"
 
 
+def test_guard_walk_covers_grammar_subsystem():
+    """The guard sweep must see omnia_tpu/engine/grammar/ — and the
+    package must stay jax-free at the source level: importing it with
+    grammar=off must allocate no device arrays, which is only provable
+    if nothing in it can ever touch jax (tests/test_grammar.py asserts
+    the import-time half in a subprocess)."""
+    rels = {os.path.relpath(p, PKG) for p in _py_files()}
+    gdir = os.path.join("engine", "grammar")
+    expected = {"__init__.py", "fsm.py", "regex.py", "jsonfsm.py", "cache.py"}
+    present = {os.path.basename(r) for r in rels if r.startswith(gdir + os.sep)}
+    assert expected <= present, f"guard walk misses {expected - present}"
+    for fn in sorted(present):
+        with open(os.path.join(PKG, gdir, fn)) as f:
+            src = f.read()
+        assert not re.search(r"^\s*(import jax|from jax)", src, re.M), (
+            f"omnia_tpu/engine/grammar/{fn} imports jax"
+        )
+
+
 def test_guard_walk_covers_kube_subsystem():
     """The guard sweep (file-length, PII-log, no-silent-except) must see
     omnia_tpu/kube/ — a package added outside the walk would dodge every

@@ -405,6 +405,8 @@ class TestMetricsKeyStability:
         "prefix_cache_hit_tokens", "prefix_cache_insertions",
         "prefix_cache_evictions", "prefix_cache_host_hits",
         "prefix_cache_offload_elisions",
+        "grammar_compile_hits", "grammar_compile_misses",
+        "masked_logit_fraction", "grammar_rejections_avoided",
     }
 
     def test_engine_metric_keys_are_stable(self):
@@ -487,3 +489,6 @@ class TestBenchHeartbeat:
         line = [ln for ln in out.stdout.decode().splitlines() if ln.startswith("{")][-1]
         aux = json.loads(line)["aux"]
         assert aux["prefix_cache"]["hit_tokens"] > 0
+        # Grammar scenario rides the same child run (aux.grammar).
+        assert aux["grammar"]["compile_cache_hit_rate"] > 0
+        assert "mask_apply_us_per_step" in aux["grammar"]

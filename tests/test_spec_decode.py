@@ -117,6 +117,58 @@ def eng_drive(eng, handle, max_steps=3000):
     raise AssertionError("request did not finish")
 
 
+def test_spec_coexists_with_grammar_slot():
+    """A grammar-constrained greedy slot no longer disables spec for the
+    whole batch: verify steps still run, the constrained output is
+    token-identical to the non-spec masked path (spec only ever emits
+    tokens whose unmasked argmax the grammar admits — where masked and
+    unmasked greedy coincide), and every emitted token is admissible
+    under the host FSM walk."""
+    import json
+
+    import jsonschema
+
+    from omnia_tpu.engine.grammar import compile_json_schema
+    from omnia_tpu.engine.tokenizer import ByteTokenizer
+
+    tok = ByteTokenizer()
+    schema = {"type": "object",
+              "properties": {"a": {"type": "integer"},
+                             "ok": {"type": "boolean"}},
+              "required": ["a", "ok"]}
+    g = compile_json_schema(schema, tok)
+    over = dict(num_slots=2, grammar=True, grammar_max_states=512)
+    sp_g = SamplingParams(temperature=0.0, max_tokens=100,
+                          stop_token_ids=(0,))
+
+    ref = _engine(0, **over)
+    hg = ref.submit(tok.encode("make json"), sp_g, grammar=g)
+    eng_drive(ref, hg)
+    toks_ref, _ = hg.collect_tokens(timeout=1)
+
+    eng = _engine(4, **over)
+    hg = eng.submit(tok.encode("make json"), sp_g, grammar=g)
+    hf = eng.submit(REPETITIVE, SamplingParams(temperature=0.0,
+                                               max_tokens=60))
+    eng_drive(eng, hf)
+    eng_drive(eng, hg)
+    toks_f, _ = hf.collect_tokens(timeout=1)
+    toks_g, fin_g = hg.collect_tokens(timeout=1)
+
+    assert eng.metrics["spec_steps"] > 0, "grammar slot suspended spec"
+    assert toks_g == toks_ref, "spec changed constrained greedy output"
+    payload = [t for t in toks_g if t != 0]
+    jsonschema.validate(json.loads(tok.decode(payload)), schema)
+    view = g.view(eng.model_cfg.vocab_size, (0,))
+    s = view.start
+    for t in toks_g:
+        assert view.allowed(s)[t], (s, t)
+        s = view.advance(s, t)
+    toks_f_ref, _ = _engine(0).generate(
+        REPETITIVE, SamplingParams(temperature=0.0, max_tokens=60))
+    assert toks_f == toks_f_ref, "unconstrained slot diverged"
+
+
 def test_spec_config_validation():
     with pytest.raises(ValueError, match="spec_decode"):
         InferenceEngine(
