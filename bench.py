@@ -205,21 +205,62 @@ _CHIP_SPECS = [
     ("v5", 459e12, 2765e9),
     ("v4", 275e12, 1228e9),
 ]
-_DEFAULT_SPEC = ("assumed v5e", 197e12, 819e9)
+
+# Model-dtype bytes for the fp-KV comparison column of aux.kv.
+_DTYPE_BYTES = {"bfloat16": 2, "float16": 2, "float32": 4}
 
 
 def _chip_spec(device_kind: str):
+    """(kind, peak_flops, peak_bw, known). An UNRECOGNIZED device kind
+    returns known=False — callers must then report utilization ratios as
+    null instead of quoting ratios against a guessed chip (the old
+    "assumed v5e" label dressed a guess up as a measurement)."""
     kind = device_kind.lower()
     for sub, flops, bw in _CHIP_SPECS:
         if sub in kind:
-            return (device_kind, flops, bw)
-    return _DEFAULT_SPEC
+            return (device_kind, flops, bw, True)
+    return (f"unknown ({device_kind})", 197e12, 819e9, False)
 
 
 def _tree_bytes(tree) -> int:
     import jax
 
     return sum(x.size * x.dtype.itemsize for x in jax.tree.leaves(tree))
+
+
+def _kv_aux(cfg, ecfg, main_res, weight_bytes, mean_ctx, peak_bw=None):
+    """aux.kv: the decode roofline's KV term at the CONFIGURED KV dtype
+    against a full-model-dtype cache — so the kv_quant "2× KV bandwidth
+    and capacity" claim is arithmetic over the engine's MEASURED
+    bytes/token and allocation, not an assertion. ceiling_delta (the
+    tok/s headroom int8 KV buys at this context) is a bytes ratio, so
+    it is reported even when the chip's peak bandwidth is unknown
+    (peak_bw=None drops only the absolute ceilings)."""
+    fp_bpt = (
+        cfg.num_layers * cfg.num_kv_heads * cfg.head_dim
+        * _DTYPE_BYTES[ecfg.dtype] * 2
+    )
+    bpt = main_res["kv_bytes_per_token"]
+
+    def step_bytes(b):
+        return weight_bytes + b * mean_ctx * ecfg.num_slots
+
+    out = {
+        "kv_dtype": ecfg.kv_quant or ecfg.dtype,
+        "bytes_per_token": bpt,
+        "fp_bytes_per_token": fp_bpt,
+        "kv_device_bytes": main_res["kv_device_bytes"],
+        "kv_read_bytes_per_step": int(bpt * mean_ctx * ecfg.num_slots),
+        "ceiling_delta": round(step_bytes(fp_bpt) / step_bytes(bpt), 4),
+    }
+    if peak_bw is not None:
+        out["ceiling_tok_s"] = round(
+            peak_bw / step_bytes(bpt) * ecfg.num_slots, 1
+        )
+        out["ceiling_tok_s_fp_kv"] = round(
+            peak_bw / step_bytes(fp_bpt) * ecfg.num_slots, 1
+        )
+    return out
 
 
 def child_main() -> None:
@@ -340,6 +381,20 @@ def child_main() -> None:
             _log(f"prefix cache bench failed: {exc!r}")
             prefix_cache = {"error": repr(exc)}
 
+    # --- int8 KV cache A/B (models/kv_quant.py) -----------------------
+    # Same tiny serving config with kv_quant on/off: greedy agreement,
+    # TTFT/decode deltas, and the measured device-bytes ratio (scales
+    # included). Runs on accel and CPU — the capacity/equivalence story
+    # shows on any backend; the bandwidth win needs the TPU numbers.
+    kv_ab = None
+    if remaining() > (90 if on_accel else 45):
+        try:
+            kv_ab = _bench_kv_quant(cfg, remaining, on_accel)
+            _log(f"kv quant A/B done: {kv_ab}")
+        except Exception as exc:  # noqa: BLE001 - aux evidence only
+            _log(f"kv quant A/B failed: {exc!r}")
+            kv_ab = {"error": repr(exc)}
+
     # --- grammar-constrained decoding (engine/grammar/) ---------------
     # Constrained vs unconstrained on one grammar=on engine: mask-apply
     # µs/step, compile-cache hit rate, TTFT delta. Runs on accel and CPU
@@ -371,6 +426,13 @@ def child_main() -> None:
         steps = max(main_res["decode_steps"], 1)
         dispatch_us = main_res["decode_dispatch_s"] / steps * 1e6
         sync_us = main_res["decode_sync_s"] / steps * 1e6
+        kv_cpu = _kv_aux(
+            cfg, ecfg, main_res,
+            weight_bytes=main_res.pop("weight_bytes"),
+            mean_ctx=48 + decode_tokens / 2,
+        )
+        if kv_ab is not None:
+            kv_cpu["ab"] = kv_ab
         result = {
             "metric": (
                 f"engine dispatch overhead per decode step, {model_name} "
@@ -392,6 +454,19 @@ def child_main() -> None:
                 "scheduler_latency_ms_p50": sched,
                 "prefix_cache": prefix_cache,
                 "grammar": grammar_bench,
+                # Chip-roofline ratios are meaningless against CPU
+                # timings — explicitly null, never quoted against an
+                # assumed TPU spec (the old "assumed v5e" label).
+                "mfu": None,
+                "hbm_bw_util": None,
+                "roofline_note": (
+                    "no accelerator attached: MFU and HBM-bandwidth "
+                    "utilization are chip-roofline ratios and are not "
+                    "computed from CPU timings; aux.kv carries the "
+                    "dtype-level KV arithmetic, which is "
+                    "backend-independent"
+                ),
+                "kv": kv_cpu,
                 "note": (
                     "vs_baseline intentionally omitted: CPU fallback "
                     "certifies engine overhead, not serving performance"
@@ -402,19 +477,33 @@ def child_main() -> None:
         return
 
     # --- roofline accounting ------------------------------------------
-    kind, peak_flops, peak_bw = _chip_spec(dev.device_kind)
+    kind, peak_flops, peak_bw, spec_known = _chip_spec(dev.device_kind)
     n_params = cfg.num_params()
     weight_bytes = main_res.pop("weight_bytes")
     steps_per_s = main_res["tok_s_chip"] / max(ecfg.num_slots, 1)
     # Per decode step the chip streams the full weight set once (batch
-    # shares it) plus each slot's live KV rows.
-    kv_row_bytes = (
-        cfg.num_layers * cfg.num_kv_heads * cfg.head_dim * 2 * 2
-    )  # k+v, bf16
+    # shares it) plus each slot's live KV rows — at the CONFIGURED KV
+    # precision: the engine reports its real bytes/token (int8 rows +
+    # f32 scales under kv_quant), not an assumed bf16.
+    kv_row_bytes = main_res["kv_bytes_per_token"]
     mean_ctx = 48 + decode_tokens / 2
     kv_bytes_step = kv_row_bytes * mean_ctx * ecfg.num_slots
     achieved_bw = (weight_bytes + kv_bytes_step) * steps_per_s
     mfu = 2.0 * n_params * main_res["tok_s_chip"] / peak_flops
+    step_bytes = weight_bytes + kv_bytes_step
+    if spec_known:
+        roofline_note = (
+            "decode is HBM-bound: ceiling ≈ peak_bw/(weight_bytes + "
+            f"kv_read_bytes) = {peak_bw / step_bytes:.0f} steps/s → "
+            f"{peak_bw / step_bytes * ecfg.num_slots:.0f} tok/s/chip "
+            f"at {ecfg.num_slots} slots, mean ctx {mean_ctx:.0f}"
+        )
+    else:
+        roofline_note = (
+            f"device kind {dev.device_kind!r} has no known peak spec: "
+            "mfu/hbm_bw_util reported as null rather than ratios "
+            "against a guessed chip"
+        )
 
     p50 = main_res["ttft_p50_ms"]
     result = {
@@ -441,17 +530,20 @@ def child_main() -> None:
             # weight-streaming roofline.
             "greedy_spec": main_res["greedy_spec"],
             "chip_spec_used": kind,
-            "mfu": round(mfu, 4),
-            "hbm_bw_util": round(achieved_bw / peak_bw, 4),
+            "mfu": round(mfu, 4) if spec_known else None,
+            "hbm_bw_util": (
+                round(achieved_bw / peak_bw, 4) if spec_known else None
+            ),
             "hbm_gbps_achieved": round(achieved_bw / 1e9, 1),
-            "roofline_note": (
-                "decode is HBM-bound: ceiling ≈ peak_bw/weight_bytes = "
-                f"{peak_bw / weight_bytes:.0f} steps/s → "
-                f"{peak_bw / weight_bytes * ecfg.num_slots:.0f} tok/s/chip "
-                f"at {ecfg.num_slots} slots"
+            "roofline_note": roofline_note,
+            "kv": _kv_aux(
+                cfg, ecfg, main_res, weight_bytes, mean_ctx,
+                peak_bw if spec_known else None,
             ),
         },
     }
+    if kv_ab is not None:
+        result["aux"]["kv"]["ab"] = kv_ab
     if pallas_ab is not None:
         result["aux"]["pallas_ab"] = pallas_ab
     if prefix_cache is not None:
@@ -780,6 +872,74 @@ def _bench_grammar(cfg, remaining, on_accel):
         gc.collect()
 
 
+def _bench_kv_quant(cfg, remaining, on_accel):
+    """int8-KV A/B (EngineConfig.kv_quant): the same serving config with
+    the cache at int8+scales and at full model dtype. Reports greedy
+    token agreement (the near-lossless claim), TTFT p50 and decode tok/s
+    for both arms (the no-regression claim), and the measured
+    device-bytes ratio, scales included (the capacity claim)."""
+    import gc
+
+    from omnia_tpu.engine import EngineConfig, InferenceEngine, SamplingParams
+
+    if on_accel:
+        base = dict(
+            num_slots=4, max_seq=512, prefill_buckets=(64,),
+            dtype="bfloat16", decode_chunk=16, decode_chunk_variants=(16, 1),
+            max_sessions=0,
+        )
+        n_requests, max_tokens = 4, 48
+    else:
+        base = dict(
+            num_slots=4, max_seq=128, prefill_buckets=(64,), dtype="float32",
+            max_sessions=0,
+        )
+        n_requests, max_tokens = 4, 24
+    prompt = list(range(1, 49))
+
+    def run(kvq):
+        engine = InferenceEngine(cfg, EngineConfig(kv_quant=kvq, **base), seed=0)
+        engine.warmup(sessions=False)
+        engine.start()
+        try:
+            sp = SamplingParams(temperature=0.0, max_tokens=max_tokens)
+            ttfts, token_lists = [], []
+            t0 = time.monotonic()
+            for _ in range(n_requests):
+                t_sub = time.monotonic()
+                h = engine.submit(prompt, sp)
+                toks, _fin = h.collect_tokens(timeout=300)
+                token_lists.append(toks)
+                ttfts.append((h.first_token_at - t_sub) * 1000.0)
+            wall = time.monotonic() - t0
+            return {
+                "ttft_p50_ms": round(statistics.median(ttfts), 2),
+                "tok_s": round(sum(len(t) for t in token_lists) / wall, 1),
+                "kv_device_bytes": engine.metrics["kv_quant_device_bytes"],
+                "bytes_per_token": engine.metrics["kv_quant_bytes_per_token"],
+            }, token_lists
+        finally:
+            engine.stop()
+            del engine
+            gc.collect()
+
+    q8, q8_toks = run("int8")
+    fp, fp_toks = run(None)
+    agree = total = 0
+    for a, b in zip(q8_toks, fp_toks):
+        total += max(len(a), len(b))
+        agree += sum(x == y for x, y in zip(a, b))
+    return {
+        "int8": q8,
+        "fp": fp,
+        "bytes_ratio": round(
+            q8["kv_device_bytes"] / max(fp["kv_device_bytes"], 1), 4
+        ),
+        "greedy_token_agreement": round(agree / max(total, 1), 4),
+        "ttft_delta_ms": round(q8["ttft_p50_ms"] - fp["ttft_p50_ms"], 2),
+    }
+
+
 def _bench_sched_latency(cfg, ecfg, remaining, depths=(4, 16, 64)):
     """Scheduler latency under load: p50 submit→first-token per request
     with N requests queued at once (N beyond num_slots exercises the
@@ -825,6 +985,11 @@ def _bench_engine(cfg, ecfg, params, ttft_iters, decode_tokens, remaining):
 
     engine = InferenceEngine(cfg, ecfg, params=params, seed=0)
     weight_bytes = _tree_bytes(engine.params)
+    # KV footprint at the engine's configured precision (scales
+    # included) — the roofline's KV term reads these, never an assumed
+    # dtype.
+    kv_bytes_per_token = engine.metrics["kv_quant_bytes_per_token"]
+    kv_device_bytes = engine.metrics["kv_quant_device_bytes"]
     t0 = time.monotonic()
     engine.warmup(sessions=False)
     warmup_s = time.monotonic() - t0
@@ -909,6 +1074,8 @@ def _bench_engine(cfg, ecfg, params, ttft_iters, decode_tokens, remaining):
         "decode_steps": decode_steps,
         "warmup_s": round(warmup_s, 1),
         "weight_bytes": weight_bytes,
+        "kv_bytes_per_token": kv_bytes_per_token,
+        "kv_device_bytes": kv_device_bytes,
         "greedy_spec": spec,
     }
 

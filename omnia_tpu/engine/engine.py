@@ -48,7 +48,6 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 
 from omnia_tpu.engine.placement import _PlacementMixin
 from omnia_tpu.engine.prefix_cache import PrefixPool, _PrefixCacheMixin
@@ -69,6 +68,12 @@ from omnia_tpu.engine.types import (
 from omnia_tpu.models import ModelConfig
 from omnia_tpu.models import llama
 from omnia_tpu.models import quant
+from omnia_tpu.models.kv_quant import (
+    cache_bytes,
+    kv_device,
+    kv_host,
+    validate_kv_quant,
+)
 from omnia_tpu.ops.sampling import make_slot_key_data
 from omnia_tpu.parallel import make_mesh, shard_pytree
 from omnia_tpu.parallel.sharding import named_sharding_tree
@@ -120,6 +125,11 @@ class InferenceEngine(
             raise ValueError("grammar_max_states must be >= 2 with grammar on")
 
         self._dtype = resolve_dtype(engine_cfg.dtype)
+        # int8 KV cache (models/kv_quant.py): validated ONCE here; the
+        # cache allocations below decide representation, and every
+        # program/op dispatches on the array type — None means plain
+        # arrays flow exactly as before (the guarded-no-op contract).
+        self._kv_quant = validate_kv_quant(engine_cfg.kv_quant)
         self._mesh = None
         use_mesh = engine_cfg.dp * engine_cfg.tp * engine_cfg.sp > 1
         if use_mesh:
@@ -250,6 +260,18 @@ class InferenceEngine(
             "grammar_compile_misses": 0,
             "masked_logit_fraction": 0.0,
             "grammar_rejections_avoided": 0,
+            # int8 KV cache (models/kv_quant.py) — capacity gauges, set
+            # at every (re)allocation: bytes_per_token is the per-token
+            # KV read/write footprint (k+v across layers, scales
+            # included) at the configured precision; device_bytes is the
+            # real allocation of slot cache + prefix pool. The bench
+            # roofline and the 2× capacity claim read THESE, not an
+            # assumed dtype.
+            "kv_quant_enabled": 1 if self._kv_quant else 0,
+            "kv_quant_bytes_per_token": self.kv_bytes_per_token(),
+            "kv_quant_device_bytes": cache_bytes(
+                self._ck, self._cv, self._pk, self._pv
+            ),
         }
         self._gr_mask_sum = 0.0
         self._gr_mask_steps = 0
@@ -276,9 +298,9 @@ class InferenceEngine(
 
         logger.info(
             "engine built: backend=%s pallas_decode=%s slots=%d max_seq=%d "
-            "chunks=%s quant=%s",
+            "chunks=%s quant=%s kv_quant=%s",
             jax.default_backend(), pallas_decode_mode(), B, engine_cfg.max_seq,
-            self.cfg.chunk_variants(), qmode,
+            self.cfg.chunk_variants(), qmode, self._kv_quant,
         )
 
     def _init_device_state(self):
@@ -287,23 +309,28 @@ class InferenceEngine(
         donated-buffer step, self._ck/_cv may point at deleted arrays, so
         the only way back to a healthy engine is a fresh allocation."""
         B, S = self.cfg.num_slots, self.cfg.max_seq
-        ck, cv = llama.init_kv_cache(self.model_cfg, B, S, dtype=self._dtype)
+        ck, cv = llama.init_kv_cache(
+            self.model_cfg, B, S, dtype=self._dtype, kv_quant=self._kv_quant
+        )
         if self._mesh is not None:
-            kspec, vspec = llama.kv_cache_specs()
+            kspec, vspec = llama.kv_cache_specs(self._kv_quant)
             tree = named_sharding_tree((kspec, vspec), self._mesh)
             ck = jax.device_put(ck, tree[0])
             cv = jax.device_put(cv, tree[1])
         self._ck, self._cv = ck, cv
 
         # Shared-prefix pool arrays: [L, P, R, H, D] beside the slot
-        # cache, same layout/sharding (P over dp, heads over tp). A
+        # cache, same layout/sharding (P over dp, heads over tp) AND the
+        # same KV representation — under kv_quant the pool holds int8
+        # rows + scales, so the same pool bytes cache 2× the prefixes. A
         # reallocation means any device-resident pool entries died with
         # the caches; host-paged entries survive in the pool's books.
         self._pk = self._pv = None
         if self._prefix_pool is not None:
             R = self.cfg.prefix_buckets()[-1]
             pk, pv = llama.init_kv_cache(
-                self.model_cfg, self.cfg.prefix_cache_slots, R, dtype=self._dtype
+                self.model_cfg, self.cfg.prefix_cache_slots, R,
+                dtype=self._dtype, kv_quant=self._kv_quant,
             )
             if self._mesh is not None:
                 pk = jax.device_put(pk, tree[0])
@@ -312,6 +339,10 @@ class InferenceEngine(
             self._prefix_pool.on_device_reset()
             if hasattr(self, "metrics"):  # absent on first (construction) call
                 self.metrics["prefix_cache_evictions"] = self._prefix_pool.evictions
+        if hasattr(self, "metrics"):
+            self.metrics["kv_quant_device_bytes"] = cache_bytes(
+                self._ck, self._cv, self._pk, self._pv
+            )
 
         # Grammar-constrained decoding state: per-slot FSM state beside
         # the sampler key data, per-slot transition tables, and the
@@ -356,6 +387,18 @@ class InferenceEngine(
         self._stop_ids = jnp.full((B, MAX_DEVICE_STOP_IDS), -1, jnp.int32)
         self._key_data = jnp.stack(
             [make_slot_key_data(self._seed + 1 + i) for i in range(B)]
+        )
+
+    def kv_bytes_per_token(self) -> int:
+        """HBM bytes one cached token costs (k+v over all layers, f32
+        row scales included under kv_quant) — the KV term of the decode
+        roofline at THIS engine's configured precision."""
+        mc = self.model_cfg
+        itemsize = 1 if self._kv_quant else jnp.dtype(self._dtype).itemsize
+        scale_bytes = 4 if self._kv_quant else 0
+        return (
+            mc.num_layers * mc.num_kv_heads
+            * (mc.head_dim * itemsize + scale_bytes) * 2
         )
 
     def warmup(self, sessions: bool = True):
@@ -435,7 +478,7 @@ class InferenceEngine(
                 k, v = self._prefix_offload_fn(self._pk, self._pv, 0, b)
                 self._ck, self._cv = self._restore_fn(
                     self._ck, self._cv,
-                    jnp.asarray(np.asarray(k)), jnp.asarray(np.asarray(v)), 0,
+                    kv_device(kv_host(k)), kv_device(kv_host(v)), 0,
                 )
         if self._verify_fn is not None:
             B, K1 = self.cfg.num_slots, self.cfg.spec_decode + 1

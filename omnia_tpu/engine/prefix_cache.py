@@ -39,8 +39,7 @@ import logging
 import time
 from typing import Optional
 
-import jax.numpy as jnp
-import numpy as np
+from omnia_tpu.models.kv_quant import kv_device, kv_host
 
 logger = logging.getLogger(__name__)
 
@@ -76,8 +75,11 @@ class PrefixEntry:
         self.tokens = tokens                  # the rows KNOWN valid
         self.bucket = bucket                  # fixed transfer shape
         self.pool_idx: Optional[int] = None   # device pool slot
-        self.host_k: Optional[np.ndarray] = None  # paged tier
-        self.host_v: Optional[np.ndarray] = None
+        # Paged tier: numpy rows, or a QuantKV of numpy leaves when the
+        # engine runs kv_quant (the host tier inherits the KV dtype, so
+        # its entry budget buys 2× the rows under int8).
+        self.host_k = None
+        self.host_v = None
         self.refs = 0                         # resident seeders
         self.hits = 0
         self.last_used = now
@@ -412,7 +414,7 @@ class _PrefixCacheMixin:
             # another host transfer).
             self._ck, self._cv = self._restore_fn(
                 self._ck, self._cv,
-                jnp.asarray(entry.host_k), jnp.asarray(entry.host_v),
+                kv_device(entry.host_k), kv_device(entry.host_v),
                 slot_idx,
             )
             self.metrics["prefix_cache_host_hits"] += 1
@@ -522,5 +524,5 @@ class _PrefixCacheMixin:
             self._pk, self._pv, entry.pool_idx, entry.bucket
         )
         entry.pool_idx = None
-        self._prefix_pool.demoted_to_host(entry, np.asarray(k), np.asarray(v))
+        self._prefix_pool.demoted_to_host(entry, kv_host(k), kv_host(v))
         self.metrics["prefix_cache_evictions"] = self._prefix_pool.evictions

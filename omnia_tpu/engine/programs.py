@@ -32,6 +32,15 @@ through):
   a pool entry to host RAM for the paged tier. All device↔device (store
   and seed never cross the host link) in fixed prefix-bucket shapes.
 
+KV representation: every program moves cache rows through the
+cache-agnostic helpers in ``models/kv_quant.py``, so one program source
+serves both KV precisions — with ``EngineConfig.kv_quant`` the caches
+(and the pool / paged tiers downstream) are QuantKV pytrees (int8 rows
++ per-row-per-head f32 scales), quantized at the write sites here and
+dequantized fused inside the attention ops. With ``kv_quant=None`` the
+helpers reduce to the exact plain-array slicing they replaced, so the
+traced programs carry the same operands as a pre-quant engine.
+
 Replaces the reference's provider-relay hot path (it has no on-device
 programs at all — internal/runtime/provider.go streams vendor SSE); the
 program set is the TPU-native substitute for that relay loop.
@@ -47,6 +56,7 @@ import jax.numpy as jnp
 
 from omnia_tpu.engine.types import EngineConfig
 from omnia_tpu.models import ModelConfig, llama
+from omnia_tpu.models.kv_quant import cache_put, cache_take, kv_map
 from omnia_tpu.ops.sampling import _NEG_INF, sample_tokens_per_slot
 
 
@@ -95,14 +105,10 @@ def build_programs(
             params, cfg, tokens, positions
         )
 
-        def put(c, chunk):
-            # c: [L,B,S,H,D]; chunk: [L,1,T,H,D]
-            return jax.lax.dynamic_update_slice(
-                c, chunk.astype(c.dtype), (0, slot, 0, 0, 0)
-            )
-
-        ck = put(ck, k_chunk)
-        cv = put(cv, v_chunk)
+        # c: [L,B,S,H,D]; chunk: [L,1,T,H,D] — a quantized cache
+        # quantizes the fresh rows inside cache_put (kv_quant mode).
+        ck = cache_put(ck, k_chunk, (0, slot, 0))
+        cv = cache_put(cv, v_chunk, (0, slot, 0))
         last = jax.lax.dynamic_slice(
             logits, (0, last_idx, 0), (1, 1, logits.shape[-1])
         )[:, 0]
@@ -123,15 +129,10 @@ def build_programs(
 
     def insert(ck, cv, k_chunk, v_chunk, slot, last_logits, key_data, temp,
                top_p, top_k, *g):
-        # Place the prefill chunk into the slot's rows [slot, 0:T].
-        def put(c, chunk):
-            # c: [L,B,S,H,D]; chunk: [L,1,T,H,D]
-            return jax.lax.dynamic_update_slice(
-                c, chunk.astype(c.dtype), (0, slot, 0, 0, 0)
-            )
-
-        ck = put(ck, k_chunk)
-        cv = put(cv, v_chunk)
+        # Place the prefill chunk into the slot's rows [slot, 0:T]
+        # (chunk [L,1,T,H,D] floats — quantized on write in kv mode).
+        ck = cache_put(ck, k_chunk, (0, slot, 0))
+        cv = cache_put(cv, v_chunk, (0, slot, 0))
         tok, new_kd = sample_tokens_per_slot(
             last_logits, key_data[None], temp[None], top_p[None], top_k[None],
             mask_bias=_first_bias(g),
@@ -265,17 +266,16 @@ def build_programs(
     def extend(params, ck, cv, tokens, positions, slot, write_start, last_idx,
                key_data, temp, top_p, top_k, *g):
         L, B, S, H, D = ck.shape
-        k_slot = jax.lax.dynamic_slice(ck, (0, slot, 0, 0, 0), (L, 1, S, H, D))
-        v_slot = jax.lax.dynamic_slice(cv, (0, slot, 0, 0, 0), (L, 1, S, H, D))
+        k_slot = cache_take(ck, (0, slot, 0), (L, 1, S))
+        v_slot = cache_take(cv, (0, slot, 0), (L, 1, S))
         logits, k_slot, v_slot = llama.forward(
             params, cfg, tokens, positions, k_slot, v_slot, write_start[None]
         )
-        ck = jax.lax.dynamic_update_slice(
-            ck, k_slot.astype(ck.dtype), (0, slot, 0, 0, 0)
-        )
-        cv = jax.lax.dynamic_update_slice(
-            cv, v_slot.astype(cv.dtype), (0, slot, 0, 0, 0)
-        )
+        # forward kept the slice in cache representation (suffix rows
+        # quantized inside _write_kv when kv_quant is on) — write back
+        # verbatim, no requantization of resident rows.
+        ck = cache_put(ck, k_slot, (0, slot, 0))
+        cv = cache_put(cv, v_slot, (0, slot, 0))
         last = jax.lax.dynamic_slice(
             logits, (0, last_idx, 0), (1, 1, logits.shape[-1])
         )[:, 0]
@@ -291,36 +291,30 @@ def build_programs(
     # on the final chunk of a multi-chunk extend).
     def extend_nosample(params, ck, cv, tokens, positions, slot, write_start):
         L, B, S, H, D = ck.shape
-        k_slot = jax.lax.dynamic_slice(ck, (0, slot, 0, 0, 0), (L, 1, S, H, D))
-        v_slot = jax.lax.dynamic_slice(cv, (0, slot, 0, 0, 0), (L, 1, S, H, D))
+        k_slot = cache_take(ck, (0, slot, 0), (L, 1, S))
+        v_slot = cache_take(cv, (0, slot, 0), (L, 1, S))
         _, k_slot, v_slot = llama.forward(
             params, cfg, tokens, positions, k_slot, v_slot, write_start[None]
         )
-        ck = jax.lax.dynamic_update_slice(
-            ck, k_slot.astype(ck.dtype), (0, slot, 0, 0, 0)
-        )
-        cv = jax.lax.dynamic_update_slice(
-            cv, v_slot.astype(cv.dtype), (0, slot, 0, 0, 0)
-        )
+        ck = cache_put(ck, k_slot, (0, slot, 0))
+        cv = cache_put(cv, v_slot, (0, slot, 0))
         return ck, cv
 
     extend_nosample_fn = jax.jit(extend_nosample, donate_argnums=(1, 2))
 
     def offload(ck, cv, slot, rows: int):
         L, B, S, H, D = ck.shape
-        k = jax.lax.dynamic_slice(ck, (0, slot, 0, 0, 0), (L, 1, rows, H, D))
-        v = jax.lax.dynamic_slice(cv, (0, slot, 0, 0, 0), (L, 1, rows, H, D))
-        return k[:, 0], v[:, 0]
+        k = cache_take(ck, (0, slot, 0), (L, 1, rows))
+        v = cache_take(cv, (0, slot, 0), (L, 1, rows))
+        # Paged rows keep the cache representation (int8 + scales under
+        # kv_quant — host pages shrink with the device bytes).
+        return kv_map(lambda a: a[:, 0], k), kv_map(lambda a: a[:, 0], v)
 
     offload_fn = jax.jit(offload, static_argnums=(3,))
 
     def restore(ck, cv, k_rows, v_rows, slot):
-        ck = jax.lax.dynamic_update_slice(
-            ck, k_rows[:, None].astype(ck.dtype), (0, slot, 0, 0, 0)
-        )
-        cv = jax.lax.dynamic_update_slice(
-            cv, v_rows[:, None].astype(cv.dtype), (0, slot, 0, 0, 0)
-        )
+        ck = cache_put(ck, kv_map(lambda a: a[:, None], k_rows), (0, slot, 0))
+        cv = cache_put(cv, kv_map(lambda a: a[:, None], v_rows), (0, slot, 0))
         return ck, cv
 
     restore_fn = jax.jit(restore, donate_argnums=(0, 1))
@@ -339,14 +333,13 @@ def build_programs(
     if ecfg.prefix_cache_slots > 0:
         def prefix_store(pool_k, pool_v, ck, cv, slot, pool_idx, rows: int):
             L, B, S, H, D = ck.shape
-            k = jax.lax.dynamic_slice(ck, (0, slot, 0, 0, 0), (L, 1, rows, H, D))
-            v = jax.lax.dynamic_slice(cv, (0, slot, 0, 0, 0), (L, 1, rows, H, D))
-            pool_k = jax.lax.dynamic_update_slice(
-                pool_k, k.astype(pool_k.dtype), (0, pool_idx, 0, 0, 0)
-            )
-            pool_v = jax.lax.dynamic_update_slice(
-                pool_v, v.astype(pool_v.dtype), (0, pool_idx, 0, 0, 0)
-            )
+            # Pool entries inherit the cache representation: under
+            # kv_quant the int8 rows + scales copy verbatim (2× entries
+            # per pool byte, zero requantization drift on seed).
+            k = cache_take(ck, (0, slot, 0), (L, 1, rows))
+            v = cache_take(cv, (0, slot, 0), (L, 1, rows))
+            pool_k = cache_put(pool_k, k, (0, pool_idx, 0))
+            pool_v = cache_put(pool_v, v, (0, pool_idx, 0))
             return pool_k, pool_v
 
         prefix_store_fn = jax.jit(
@@ -355,18 +348,10 @@ def build_programs(
 
         def prefix_seed(ck, cv, pool_k, pool_v, pool_idx, slot, rows: int):
             L, P, R, H, D = pool_k.shape
-            k = jax.lax.dynamic_slice(
-                pool_k, (0, pool_idx, 0, 0, 0), (L, 1, rows, H, D)
-            )
-            v = jax.lax.dynamic_slice(
-                pool_v, (0, pool_idx, 0, 0, 0), (L, 1, rows, H, D)
-            )
-            ck = jax.lax.dynamic_update_slice(
-                ck, k.astype(ck.dtype), (0, slot, 0, 0, 0)
-            )
-            cv = jax.lax.dynamic_update_slice(
-                cv, v.astype(cv.dtype), (0, slot, 0, 0, 0)
-            )
+            k = cache_take(pool_k, (0, pool_idx, 0), (L, 1, rows))
+            v = cache_take(pool_v, (0, pool_idx, 0), (L, 1, rows))
+            ck = cache_put(ck, k, (0, slot, 0))
+            cv = cache_put(cv, v, (0, slot, 0))
             return ck, cv
 
         prefix_seed_fn = jax.jit(
@@ -375,13 +360,9 @@ def build_programs(
 
         def prefix_offload(pool_k, pool_v, pool_idx, rows: int):
             L, P, R, H, D = pool_k.shape
-            k = jax.lax.dynamic_slice(
-                pool_k, (0, pool_idx, 0, 0, 0), (L, 1, rows, H, D)
-            )
-            v = jax.lax.dynamic_slice(
-                pool_v, (0, pool_idx, 0, 0, 0), (L, 1, rows, H, D)
-            )
-            return k[:, 0], v[:, 0]
+            k = cache_take(pool_k, (0, pool_idx, 0), (L, 1, rows))
+            v = cache_take(pool_v, (0, pool_idx, 0), (L, 1, rows))
+            return kv_map(lambda a: a[:, 0], k), kv_map(lambda a: a[:, 0], v)
 
         prefix_offload_fn = jax.jit(prefix_offload, static_argnums=(3,))
 

@@ -18,10 +18,10 @@ from __future__ import annotations
 import time
 from typing import Optional
 
-import jax.numpy as jnp
 import numpy as np
 
 from omnia_tpu.engine.types import Request, RequestHandle
+from omnia_tpu.models.kv_quant import kv_device, kv_host
 
 
 class _Slot:
@@ -100,7 +100,9 @@ class _SessionKV:
         self.session_id = session_id
         self.token_ids: list[int] = []
         self.slot: Optional[int] = None
-        self.host_k: Optional[np.ndarray] = None  # [L, R, H, D] padded rows
+        # [L, R, H, D] padded rows; a QuantKV of numpy leaves when the
+        # engine runs kv_quant (pages inherit the cache representation).
+        self.host_k: Optional[np.ndarray] = None
         self.host_v: Optional[np.ndarray] = None
         self.last_used = time.monotonic() if now is None else now
         # Shared-prefix pool entry this session seeded from: pins the
@@ -165,8 +167,11 @@ class _SessionMixin:
         elif valid > 0:
             rows = self.cfg.restore_bucket_for(valid)
             k, v = self._offload_fn(self._ck, self._cv, slot_idx, rows)
-            sess.host_k = np.asarray(k)
-            sess.host_v = np.asarray(v)
+            # Host pages keep the cache representation (int8 rows +
+            # scales under kv_quant — half the bf16 page bytes and
+            # transfer time, restored verbatim with zero extra drift).
+            sess.host_k = kv_host(k)
+            sess.host_v = kv_host(v)
             self.metrics["session_offloads"] += 1
         sess.slot = None
         self._slots[slot_idx].session_id = None
@@ -174,7 +179,7 @@ class _SessionMixin:
     def _restore_session(self, sess: _SessionKV, slot_idx: int) -> None:
         """Swap a host-paged session's KV rows back into a device slot."""
         self._ck, self._cv = self._restore_fn(
-            self._ck, self._cv, jnp.asarray(sess.host_k), jnp.asarray(sess.host_v),
+            self._ck, self._cv, kv_device(sess.host_k), kv_device(sess.host_v),
             slot_idx,
         )
         sess.host_k = sess.host_v = None

@@ -202,6 +202,16 @@ class EngineConfig:
     # activation quant, int8×int8 MXU path — fastest). Dense models only;
     # see models/quant.py.
     quant: Optional[str] = None
+    # KV-cache quantization: None (cache stored at `dtype`) or "int8"
+    # (rows stored int8 with a f32 scale per (layer, slot, row, kv_head)
+    # — models/kv_quant.py). Halves KV HBM read traffic per decode step
+    # and doubles the effective capacity of the slot cache, the shared-
+    # prefix pool, and both host-paged tiers, at ~0.5-1% per-row
+    # round-trip error (near-lossless greedy decoding; see
+    # docs/serving.md "KV cache precision"). None is a guarded true
+    # no-op: no scale tensors exist and the compiled programs take the
+    # exact pre-quant operands.
+    kv_quant: Optional[str] = None
     # Cross-SESSION shared-prefix KV pool (engine/prefix_cache.py): a
     # device-resident, radix-matched cache of refcounted prompt prefixes
     # (pack system blocks, tool schemas) so a FRESH session seed-copies

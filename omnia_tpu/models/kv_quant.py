@@ -1,0 +1,224 @@
+"""int8 KV-cache quantization (EngineConfig.kv_quant).
+
+Decode is HBM-bound, and at long contexts the KV read stream rivals the
+weight stream (bench roofline: ceiling ≈ peak_bw / bytes-per-step). The
+weights already have an int8 path (models/quant.py); this module gives
+the KV cache the same treatment: rows stored int8 with a float32 scale
+per (…, kv_head) row — the KVQuant/KIVI per-token granularity — so KV
+HBM traffic halves against bf16 and the shared-prefix pool / host-paged
+tiers hold 2× the rows in the same bytes.
+
+Representation: a :class:`QuantKV` pytree with two leaves,
+
+- ``q``  int8  ``[..., H, D]`` — the quantized rows
+- ``s``  f32   ``[..., H]``   — per-row-per-head absmax/127 scales
+
+registered as a JAX pytree node, so it flows through ``jit`` /
+``lax.scan`` / donation / ``device_put`` exactly like the plain array it
+replaces. Every cache operation the serving programs perform (slot
+writes, slot/pool slices, device↔host paging) goes through the
+cache-agnostic helpers below, which accept EITHER a plain array (the
+``kv_quant=None`` path — byte-identical behavior to a pre-quant engine)
+OR a ``QuantKV`` — dispatch is trace-time ``isinstance``, no flags
+threaded through the forward pass.
+
+Dequantization happens fused on READ inside the attention ops
+(ops/attention.py, ops/decode_attention.py): the score matmul runs
+against the int8 rows and the scale multiplies the score/prob matrices
+— never a full-cache upcast in HBM.
+
+Numpy twins (``quantize_rows_np`` / ``dequantize_rows_np``) mirror the
+scheme bit-for-bit on host so the mock engine and hermetic tests
+exercise identical numerics with no device.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+KV_QUANT_MODES = ("int8",)
+
+# Symmetric int8: scale = absmax/127, clamped so all-zero rows (the
+# freshly-allocated cache) quantize to exact zeros instead of NaN.
+_QMAX = 127.0
+_EPS = 1e-8
+
+
+def validate_kv_quant(mode: Optional[str]) -> Optional[str]:
+    """None passthrough + mode-string validation (EngineConfig surface)."""
+    if mode is None:
+        return None
+    if mode not in KV_QUANT_MODES:
+        raise ValueError(
+            f"unknown kv_quant mode {mode!r}; have {sorted(KV_QUANT_MODES)}"
+        )
+    return mode
+
+
+@jax.tree_util.register_pytree_node_class
+class QuantKV:
+    """One quantized KV tensor: int8 rows + per-(…, head) f32 scales."""
+
+    __slots__ = ("q", "s")
+
+    def __init__(self, q, s):
+        self.q = q
+        self.s = s
+
+    def tree_flatten(self):
+        return (self.q, self.s), None
+
+    @classmethod
+    def tree_unflatten(cls, _aux, children):
+        return cls(*children)
+
+    # Shape/byte introspection mirrors the plain array it replaces (the
+    # engine and bench size caches by these).
+    @property
+    def shape(self):
+        return self.q.shape
+
+    @property
+    def ndim(self):
+        return self.q.ndim
+
+    @property
+    def nbytes(self) -> int:
+        return (
+            self.q.size * self.q.dtype.itemsize
+            + self.s.size * self.s.dtype.itemsize
+        )
+
+    def __repr__(self):  # pragma: no cover - debugging aid
+        return f"QuantKV(q={self.q.shape}{self.q.dtype}, s={self.s.shape})"
+
+
+def is_quant_kv(x) -> bool:
+    return isinstance(x, QuantKV)
+
+
+# ---------------------------------------------------------------------------
+# Quantize / dequantize
+# ---------------------------------------------------------------------------
+
+
+def quantize_rows(x) -> QuantKV:
+    """x float [..., H, D] → QuantKV. Scale is absmax over the head dim
+    (one f32 per row per head); symmetric int8 in [-127, 127]."""
+    xf = jnp.asarray(x, jnp.float32)
+    s = jnp.maximum(jnp.max(jnp.abs(xf), axis=-1), _EPS) / _QMAX
+    q = jnp.clip(jnp.round(xf / s[..., None]), -_QMAX, _QMAX).astype(jnp.int8)
+    return QuantKV(q, s)
+
+
+def dequantize_rows(kv: QuantKV, dtype=jnp.float32):
+    """QuantKV → float rows (tests/host use; the serving read path fuses
+    the scale into attention instead of materializing this)."""
+    return (kv.q.astype(jnp.float32) * kv.s[..., None]).astype(dtype)
+
+
+def quantize_rows_np(x: np.ndarray) -> QuantKV:
+    """Host (numpy) twin of :func:`quantize_rows` — same rounding, same
+    clamp, bit-identical int8 output (np.rint and jnp.round both round
+    half to even). The mock engine round-trips through this."""
+    xf = np.asarray(x, np.float32)
+    s = (np.maximum(np.max(np.abs(xf), axis=-1), _EPS) / _QMAX).astype(np.float32)
+    q = np.clip(np.rint(xf / s[..., None]), -_QMAX, _QMAX).astype(np.int8)
+    return QuantKV(q, s)
+
+
+def dequantize_rows_np(kv: QuantKV) -> np.ndarray:
+    return np.asarray(kv.q, np.float32) * np.asarray(kv.s, np.float32)[..., None]
+
+
+# ---------------------------------------------------------------------------
+# Cache-agnostic structure helpers (plain array OR QuantKV)
+# ---------------------------------------------------------------------------
+
+
+def kv_map(fn, *caches):
+    """Apply an array op to every leaf of a cache (both leaves of a
+    QuantKV, or the array itself). The op must only touch LEADING axes
+    (everything before the head axis) — those are shared by q and s."""
+    if is_quant_kv(caches[0]):
+        return QuantKV(
+            fn(*(c.q for c in caches)), fn(*(c.s for c in caches))
+        )
+    return fn(*caches)
+
+
+def _pad_idx(arr, starts):
+    return tuple(starts) + (0,) * (arr.ndim - len(starts))
+
+
+def cache_put(cache, chunk, starts):
+    """``dynamic_update_slice`` a chunk of rows into a cache at index
+    ``starts`` over the leading axes (head/feature axes start at 0).
+
+    chunk may be: a float array (fresh KV from the forward pass —
+    quantized here iff the cache is quantized), or a QuantKV (rows
+    already in cache representation — pool↔slot and restore copies move
+    the int8 rows + scales verbatim, no requantization drift)."""
+    if is_quant_kv(cache):
+        if not is_quant_kv(chunk):
+            chunk = quantize_rows(chunk)
+        return QuantKV(
+            lax.dynamic_update_slice(
+                cache.q, chunk.q.astype(cache.q.dtype), _pad_idx(cache.q, starts)
+            ),
+            lax.dynamic_update_slice(
+                cache.s, chunk.s.astype(cache.s.dtype), _pad_idx(cache.s, starts)
+            ),
+        )
+    if is_quant_kv(chunk):
+        raise TypeError("quantized chunk written into an unquantized cache")
+    return lax.dynamic_update_slice(
+        cache, chunk.astype(cache.dtype), _pad_idx(cache, starts)
+    )
+
+
+def cache_take(cache, starts, lead_sizes):
+    """``dynamic_slice`` rows out of a cache: ``starts``/``lead_sizes``
+    cover the leading axes; the head/feature axes are taken whole."""
+
+    def take(arr):
+        sizes = tuple(lead_sizes) + arr.shape[len(lead_sizes):]
+        return lax.dynamic_slice(arr, _pad_idx(arr, starts), sizes)
+
+    return kv_map(take, cache)
+
+
+# ---------------------------------------------------------------------------
+# Host paging
+# ---------------------------------------------------------------------------
+
+
+def kv_host(cache):
+    """Device cache/rows → host (numpy leaves). Session offload, the
+    prefix pool's host-paged tier, and crash-surviving pages go through
+    here — int8 rows page at half the bf16 byte count."""
+    return kv_map(np.asarray, cache)
+
+
+def kv_device(cache):
+    """Host rows → device arrays (the restore/seed promotion path)."""
+    return kv_map(jnp.asarray, cache)
+
+
+def cache_bytes(*caches) -> int:
+    """Total bytes of the given caches (0 for None entries) — scales
+    included, so capacity claims are measured against the real
+    allocation."""
+    total = 0
+    for c in caches:
+        if c is None:
+            continue
+        total += sum(
+            x.size * x.dtype.itemsize for x in jax.tree_util.tree_leaves(c)
+        )
+    return total

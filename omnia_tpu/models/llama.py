@@ -28,6 +28,12 @@ import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
 from omnia_tpu.models.config import ModelConfig
+from omnia_tpu.models.kv_quant import (
+    QuantKV,
+    is_quant_kv,
+    quantize_rows,
+    validate_kv_quant,
+)
 from omnia_tpu.models.quant import qdot
 from omnia_tpu.ops.attention import gqa_attention
 from omnia_tpu.ops.moe import moe_mlp
@@ -137,15 +143,32 @@ def param_specs_pp(cfg: ModelConfig):
     return specs
 
 
-def kv_cache_specs() -> tuple:
+def kv_cache_specs(kv_quant=None) -> tuple:
     """(k, v) PartitionSpecs for [L, B, S, Hkv, D] caches: batch over "dp",
-    KV heads over "tp"."""
+    KV heads over "tp". With kv_quant the spec tree mirrors the QuantKV
+    pytree (the scale drops the trailing head-dim axis but keeps the
+    "tp"-sharded head axis)."""
     spec = P(None, "dp", None, "tp", None)
+    if validate_kv_quant(kv_quant):
+        qspec = QuantKV(spec, P(None, "dp", None, "tp"))
+        return qspec, qspec
     return spec, spec
 
 
-def init_kv_cache(cfg: ModelConfig, batch: int, seq: int, dtype=jnp.bfloat16):
+def init_kv_cache(cfg: ModelConfig, batch: int, seq: int, dtype=jnp.bfloat16,
+                  kv_quant=None):
+    """Zeroed (k, v) caches: plain [L, B, S, Hkv, D] arrays, or QuantKV
+    pairs (int8 rows + per-row-per-head f32 scales) when kv_quant is
+    set. kv_quant=None allocates no scale tensors at all."""
     shape = (cfg.num_layers, batch, seq, cfg.num_kv_heads, cfg.head_dim)
+    if validate_kv_quant(kv_quant):
+        def one():
+            return QuantKV(
+                jnp.zeros(shape, dtype=jnp.int8),
+                jnp.zeros(shape[:-1], dtype=jnp.float32),
+            )
+
+        return one(), one()
     return jnp.zeros(shape, dtype=dtype), jnp.zeros(shape, dtype=dtype)
 
 
@@ -168,11 +191,25 @@ def _moe_mlp(h, p, cfg: ModelConfig):
 
 
 def _write_kv(cache, new, start):
-    """cache [B,S,Hkv,D] ← new [B,T,Hkv,D] at per-batch row offsets start [B]."""
+    """cache [B,S,Hkv,D] ← new [B,T,Hkv,D] at per-batch row offsets start [B].
+
+    A quantized cache quantizes the NEW rows here — the single producer
+    seam for every serving write path (prefill chunk placement goes
+    through kv_quant.cache_put with the same quantizer, so both paths
+    store bit-identical int8 rows for the same values)."""
 
     def one(c, n, s):
         return jax.lax.dynamic_update_slice(c, n, (s, 0, 0))
 
+    def one_s(c, n, s):
+        return jax.lax.dynamic_update_slice(c, n, (s, 0))
+
+    if is_quant_kv(cache):
+        qn = quantize_rows(new)
+        return QuantKV(
+            jax.vmap(one)(cache.q, qn.q, start),
+            jax.vmap(one_s)(cache.s, qn.s, start),
+        )
     return jax.vmap(one)(cache, new.astype(cache.dtype), start)
 
 

@@ -24,6 +24,8 @@ import os
 import jax
 import jax.numpy as jnp
 
+from omnia_tpu.models.kv_quant import is_quant_kv
+
 _NEG_INF = -1e30
 
 # Decode (T==1) steps can route to the length-aware Pallas kernel
@@ -62,11 +64,19 @@ def _decode_path(q, k_cache, v_cache, q_positions):
         return None
     from omnia_tpu.ops.decode_attention import decode_gqa_attention
 
+    k_scale = v_scale = None
+    if is_quant_kv(k_cache):
+        # int8 KV: the kernel streams the int8 rows + scale rows and
+        # applies the scales in VMEM (half the HBM KV traffic).
+        k_cache, k_scale = k_cache.q, k_cache.s
+        v_cache, v_scale = v_cache.q, v_cache.s
     out = decode_gqa_attention(
         q[:, 0],
         k_cache,
         v_cache,
         q_positions[:, 0],
+        k_scale=k_scale,
+        v_scale=v_scale,
         block_s=block,
         interpret=mode == "interpret",
     )
@@ -82,7 +92,12 @@ def gqa_attention(
     """Attention of queries against a slot-contiguous KV cache.
 
     q: [B, T, H, D] (already rotary-embedded)
-    k_cache, v_cache: [B, S, Hkv, D] (position s stored at row s)
+    k_cache, v_cache: [B, S, Hkv, D] (position s stored at row s), either
+        plain arrays or QuantKV (int8 rows + [B, S, Hkv] f32 scales —
+        EngineConfig.kv_quant). Dequantization is FUSED: the score
+        matmul runs against the int8 rows and the per-row scale
+        multiplies the score/prob matrices — the cache is never
+        upcast wholesale.
     q_positions: int [B, T] absolute position of each query token.
     Returns [B, T, H, D].
     """
@@ -98,9 +113,24 @@ def gqa_attention(
 
     qg = q.reshape(B, T, Hkv, G, D)
     # scores [B, Hkv, G, T, S]
-    scores = jnp.einsum(
-        "bthgd,bshd->bhgts", qg, k_cache, preferred_element_type=jnp.float32
-    )
+    if is_quant_kv(k_cache):
+        # q·k as a MIXED float × int8 dot (the quant.qdot idiom): the
+        # int8 rows are a DIRECT dot operand, so no dequantized copy of
+        # the cache is ever expressed in the HLO, and the per-(row,
+        # head) scale factors out of the head-dim contraction onto the
+        # score matrix.
+        scores = jax.lax.dot_general(
+            jnp.moveaxis(qg, 2, 1),            # [B, Hkv, T, G, D]
+            jnp.swapaxes(k_cache.q, 1, 2),     # [B, Hkv, S, D] int8
+            (((4,), (3,)), ((0, 1), (0, 1))),
+            preferred_element_type=jnp.float32,
+        )                                      # [B, Hkv, T, G, S]
+        scores = jnp.swapaxes(scores, 2, 3)
+        scores = scores * jnp.transpose(k_cache.s, (0, 2, 1))[:, :, None, None, :]
+    else:
+        scores = jnp.einsum(
+            "bthgd,bshd->bhgts", qg, k_cache, preferred_element_type=jnp.float32
+        )
     scores = scores * (D**-0.5)
 
     key_idx = jnp.arange(S, dtype=jnp.int32)
@@ -111,7 +141,21 @@ def gqa_attention(
 
     probs = jnp.exp(scores - scores.max(axis=-1, keepdims=True))
     probs = probs / probs.sum(axis=-1, keepdims=True)
-    probs = probs.astype(v_cache.dtype)
 
-    out = jnp.einsum("bhgts,bshd->bthgd", probs, v_cache)
+    if is_quant_kv(v_cache):
+        # The v scale varies along the contracted S axis, so it folds
+        # into probs (same size as the score matrix, already resident)
+        # before the mixed f32 × int8 pv dot — again no dequantized
+        # cache copy expressed.
+        v_s = jnp.transpose(v_cache.s, (0, 2, 1))[:, :, None, None, :]
+        pv = jax.lax.dot_general(
+            probs * v_s,                       # [B, Hkv, G, T, S] f32
+            jnp.swapaxes(v_cache.q, 1, 2),     # [B, Hkv, S, D] int8
+            (((4,), (2,)), ((0, 1), (0, 1))),
+            preferred_element_type=jnp.float32,
+        )                                      # [B, Hkv, G, T, D]
+        out = jnp.transpose(pv, (0, 3, 1, 2, 4)).astype(q.dtype)
+    else:
+        probs = probs.astype(v_cache.dtype)
+        out = jnp.einsum("bhgts,bshd->bthgd", probs, v_cache)
     return out.reshape(B, T, H, D)

@@ -35,16 +35,21 @@ _NEG_INF = -1e30
 def _decode_kernel(
     positions_ref,  # SMEM [B] (scalar prefetch)
     q_ref,          # VMEM [1, Hkv, G, D]
-    k_ref,          # VMEM [1, BLOCK_S, Hkv, D]
+    k_ref,          # VMEM [1, BLOCK_S, Hkv, D] (bf16, or int8 when quantized)
     v_ref,          # VMEM [1, BLOCK_S, Hkv, D]
-    out_ref,        # VMEM [1, Hkv, G, D]
-    m_ref,          # VMEM [Hkv, G] f32 scratch
-    l_ref,          # VMEM [Hkv, G] f32 scratch
-    acc_ref,        # VMEM [Hkv, G, D] f32 scratch
-    *,
+    *rest,          # [ks_ref, vs_ref,] out_ref, m_ref, l_ref, acc_ref
     block_s: int,
     scale: float,
+    quantized: bool = False,
 ):
+    # int8-KV edition (EngineConfig.kv_quant): two extra VMEM blocks
+    # carry the [1, BLOCK_S, Hkv] f32 row scales. The HBM read streams
+    # int8 rows (half the bf16 bytes — the whole point of the mode);
+    # scales apply to the score/prob matrices, never as a cache upcast.
+    if quantized:
+        ks_ref, vs_ref, out_ref, m_ref, l_ref, acc_ref = rest
+    else:
+        out_ref, m_ref, l_ref, acc_ref = rest
     b = pl.program_id(0)
     s = pl.program_id(1)
     num_s = pl.num_programs(1)
@@ -69,6 +74,9 @@ def _decode_kernel(
             dimension_numbers=(((2,), (2,)), ((0,), (0,))),
             preferred_element_type=jnp.float32,
         ) * scale
+        if quantized:
+            # Per-(row, head) k scale factors out of the D contraction.
+            scores = scores * jnp.swapaxes(ks_ref[0], 0, 1)[:, None, :]
 
         key_idx = s * block_s + jax.lax.broadcasted_iota(
             jnp.int32, scores.shape, dimension=2
@@ -79,9 +87,17 @@ def _decode_kernel(
         m_new = jnp.maximum(m_prev, scores.max(axis=-1))
         alpha = jnp.exp(m_prev - m_new)             # [Hkv, G]
         p = jnp.exp(scores - m_new[:, :, None])     # [Hkv, G, BLOCK_S]
+        if quantized:
+            # The v scale varies along the contracted S axis → fold it
+            # into p before the pv matmul (p is already f32 in VMEM; the
+            # softmax statistics l/m stay scale-free because p here is
+            # only the pv operand — l sums the UNscaled p below).
+            pv_p = p * jnp.swapaxes(vs_ref[0], 0, 1)[:, None, :]
+        else:
+            pv_p = p
         # pv [Hkv, G, D]
         pv = jax.lax.dot_general(
-            p,
+            pv_p,
             jnp.swapaxes(v, 0, 1).astype(jnp.float32),  # [Hkv, BLOCK_S, D]
             dimension_numbers=(((2,), (1,)), ((0,), (0,))),
             preferred_element_type=jnp.float32,
@@ -100,18 +116,25 @@ def _decode_kernel(
 @functools.partial(jax.jit, static_argnames=("block_s", "interpret"))
 def decode_gqa_attention(
     q: jnp.ndarray,          # [B, H, D] (rotary already applied)
-    k_cache: jnp.ndarray,    # [B, S, Hkv, D]
+    k_cache: jnp.ndarray,    # [B, S, Hkv, D] (int8 when scales given)
     v_cache: jnp.ndarray,    # [B, S, Hkv, D]
     positions: jnp.ndarray,  # int32 [B] — current decode position per slot
+    k_scale: jnp.ndarray = None,  # f32 [B, S, Hkv] (int8-KV mode)
+    v_scale: jnp.ndarray = None,
     block_s: int = DEFAULT_BLOCK_S,
     interpret: bool = False,
 ) -> jnp.ndarray:
-    """→ [B, H, D]. Requires S % block_s == 0 (engine sizes caches so)."""
+    """→ [B, H, D]. Requires S % block_s == 0 (engine sizes caches so).
+
+    With k_scale/v_scale the caches are rowwise-int8 (models/kv_quant):
+    the kernel streams half the KV bytes from HBM and applies the scales
+    in VMEM on the score/prob matrices."""
     B, H, D = q.shape
     S, Hkv = k_cache.shape[1], k_cache.shape[2]
     G = H // Hkv
     if S % block_s != 0:
         raise ValueError(f"cache length {S} not divisible by block {block_s}")
+    quantized = k_scale is not None
     num_s = S // block_s
     positions = positions.astype(jnp.int32)
 
@@ -121,25 +144,33 @@ def decode_gqa_attention(
         # and skips the HBM→VMEM DMA.
         return (b, jnp.minimum(s, pos_ref[b] // block_s), 0, 0)
 
+    kv_spec = pl.BlockSpec(
+        (1, block_s, Hkv, D),
+        lambda b, s, pos_ref: kv_index(b, s, pos_ref),
+        memory_space=pltpu.VMEM,
+    )
+    in_specs = [
+        pl.BlockSpec(
+            (1, Hkv, G, D), lambda b, s, pos_ref: (b, 0, 0, 0),
+            memory_space=pltpu.VMEM,
+        ),
+        kv_spec,
+        kv_spec,
+    ]
+    operands = [positions, q.reshape(B, Hkv, G, D), k_cache, v_cache]
+    if quantized:
+        scale_spec = pl.BlockSpec(
+            (1, block_s, Hkv),
+            lambda b, s, pos_ref: kv_index(b, s, pos_ref)[:3],
+            memory_space=pltpu.VMEM,
+        )
+        in_specs += [scale_spec, scale_spec]
+        operands += [k_scale, v_scale]
+
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
         grid=(B, num_s),
-        in_specs=[
-            pl.BlockSpec(
-                (1, Hkv, G, D), lambda b, s, pos_ref: (b, 0, 0, 0),
-                memory_space=pltpu.VMEM,
-            ),
-            pl.BlockSpec(
-                (1, block_s, Hkv, D),
-                lambda b, s, pos_ref: kv_index(b, s, pos_ref),
-                memory_space=pltpu.VMEM,
-            ),
-            pl.BlockSpec(
-                (1, block_s, Hkv, D),
-                lambda b, s, pos_ref: kv_index(b, s, pos_ref),
-                memory_space=pltpu.VMEM,
-            ),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec(
             (1, Hkv, G, D), lambda b, s, pos_ref: (b, 0, 0, 0),
             memory_space=pltpu.VMEM,
@@ -152,9 +183,12 @@ def decode_gqa_attention(
     )
 
     out = pl.pallas_call(
-        functools.partial(_decode_kernel, block_s=block_s, scale=D**-0.5),
+        functools.partial(
+            _decode_kernel, block_s=block_s, scale=D**-0.5,
+            quantized=quantized,
+        ),
         out_shape=jax.ShapeDtypeStruct((B, Hkv, G, D), q.dtype),
         grid_spec=grid_spec,
         interpret=interpret,
-    )(positions, q.reshape(B, Hkv, G, D), k_cache, v_cache)
+    )(*operands)
     return out.reshape(B, H, D)

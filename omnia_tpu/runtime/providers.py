@@ -63,7 +63,9 @@ def build_engine(spec: ProviderSpec, *, warmup: bool = False):
     """Instantiate the engine for a provider spec."""
     if spec.type == "mock":
         scenarios = [Scenario(**s) for s in spec.options.get("scenarios", [])]
-        return MockEngine(scenarios)
+        # kv_quant forwards for parity: the mock mirrors the int8 KV
+        # round-trip host-side (engine/mock.py) with unchanged output.
+        return MockEngine(scenarios, kv_quant=spec.options.get("kv_quant"))
     if spec.type == "tpu":
         from omnia_tpu.models import PRESETS, get_config
 
@@ -72,7 +74,7 @@ def build_engine(spec: ProviderSpec, *, warmup: bool = False):
             for k, v in spec.options.items()
             if k in {"num_slots", "max_seq", "prefill_buckets", "dtype",
                      "dp", "tp", "decode_chunk", "decode_pipeline",
-                     "spec_decode", "quant", "max_sessions",
+                     "spec_decode", "quant", "kv_quant", "max_sessions",
                      "prefix_cache_slots", "prefix_cache_rows",
                      "prefix_cache_publish_threshold",
                      "prefix_cache_min_tokens", "prefix_cache_host_entries",

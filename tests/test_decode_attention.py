@@ -61,6 +61,75 @@ class TestDecodeAttention:
             decode_gqa_attention(q[:, 0], k, v, jnp.zeros((1,), jnp.int32),
                                  block_s=64, interpret=True)
 
+    @pytest.mark.parametrize("positions", [[0, 5, 255, 511], [37, 499, 256, 128]])
+    def test_quantized_matches_dequantized_reference(self, positions):
+        """int8-KV edition (models/kv_quant.py): the kernel streaming
+        int8 rows + scale blocks must equal the XLA reference over the
+        DEQUANTIZED cache to float epsilon — the scale application in
+        VMEM is exact algebra, not an approximation."""
+        from omnia_tpu.models import kv_quant as kvq
+
+        q, k, v = _setup()
+        pos = jnp.asarray(positions, dtype=jnp.int32)
+        qk, qv = kvq.quantize_rows(k), kvq.quantize_rows(v)
+        ref = gqa_attention(
+            q, kvq.dequantize_rows(qk), kvq.dequantize_rows(qv), pos[:, None]
+        )[:, 0]
+        out = decode_gqa_attention(
+            q[:, 0], qk.q, qv.q, pos, k_scale=qk.s, v_scale=qv.s,
+            block_s=128, interpret=True,
+        )
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5)
+
+    def test_quantized_rows_past_position_do_not_influence(self):
+        """Scale blocks ride the same clamped index map as the KV
+        blocks: poisoned rows AND poisoned scales beyond each position
+        must not change the output."""
+        from omnia_tpu.models import kv_quant as kvq
+
+        q, k, v = _setup(B=2, S=256, H=4, Hkv=2, D=128)
+        pos = jnp.asarray([63, 190], dtype=jnp.int32)
+        qk, qv = kvq.quantize_rows(k), kvq.quantize_rows(v)
+        clean = decode_gqa_attention(
+            q[:, 0], qk.q, qv.q, pos, k_scale=qk.s, v_scale=qv.s,
+            block_s=64, interpret=True,
+        )
+        ks_p, vs_p = np.asarray(qk.s).copy(), np.asarray(qv.s).copy()
+        kq_p, vq_p = np.asarray(qk.q).copy(), np.asarray(qv.q).copy()
+        for b, p in enumerate([63, 190]):
+            kq_p[b, p + 1:] = 127
+            vq_p[b, p + 1:] = -127
+            ks_p[b, p + 1:] = 1e9
+            vs_p[b, p + 1:] = 1e9
+        poisoned = decode_gqa_attention(
+            q[:, 0], jnp.asarray(kq_p), jnp.asarray(vq_p), pos,
+            k_scale=jnp.asarray(ks_p), v_scale=jnp.asarray(vs_p),
+            block_s=64, interpret=True,
+        )
+        np.testing.assert_allclose(np.asarray(clean), np.asarray(poisoned))
+
+    def test_quantized_dispatch_from_gqa_attention(self, monkeypatch):
+        """gqa_attention unpacks a QuantKV cache into the kernel's
+        int8+scale operands (the engine's serving route on TPU)."""
+        import omnia_tpu.ops.attention as attn
+        from omnia_tpu.models import kv_quant as kvq
+
+        q, k, v = _setup(B=2, S=256, H=4, Hkv=2, D=128)
+        pos = jnp.asarray([10, 200], dtype=jnp.int32)
+        qk, qv = kvq.quantize_rows(k), kvq.quantize_rows(v)
+        monkeypatch.setenv("OMNIA_PALLAS_DECODE", "interpret")
+        attn._pallas_decode_mode.cache_clear()
+        try:
+            out = attn.gqa_attention(q, qk, qv, pos[:, None])
+            monkeypatch.setenv("OMNIA_PALLAS_DECODE", "0")
+            attn._pallas_decode_mode.cache_clear()
+            ref = attn.gqa_attention(q, qk, qv, pos[:, None])
+            np.testing.assert_allclose(
+                np.asarray(out[:, 0]), np.asarray(ref[:, 0]), atol=2e-5, rtol=2e-5
+            )
+        finally:
+            attn._pallas_decode_mode.cache_clear()
+
     def test_dispatch_from_gqa_attention(self, monkeypatch):
         """gqa_attention routes T==1 to the kernel when enabled."""
         import omnia_tpu.ops.attention as attn

@@ -174,6 +174,49 @@ def test_install_objects_round_trip_apiserver_shim():
         shim.stop()
 
 
+def test_kv_quant_none_is_true_noop():
+    """EngineConfig.kv_quant=None must be a guarded no-op: caches stay
+    plain arrays of the configured dtype (zero scale tensors allocated,
+    pool included), and the compiled decode program's operand signature
+    is byte-identical to a pre-kv_quant engine — one flat tensor per
+    cache and no int8 anywhere in the lowered module."""
+    import jax
+    import jax.numpy as jnp
+
+    from omnia_tpu.engine import EngineConfig, InferenceEngine
+    from omnia_tpu.models import get_config
+    from omnia_tpu.models.kv_quant import QuantKV
+
+    eng = InferenceEngine(
+        get_config("test-tiny"),
+        EngineConfig(num_slots=2, max_seq=64, prefill_buckets=(16,),
+                     dtype="float32", max_sessions=0, prefix_cache_slots=2),
+    )
+    for c in (eng._ck, eng._cv, eng._pk, eng._pv):
+        assert not isinstance(c, QuantKV)
+        assert c.dtype == jnp.float32
+    leaves = jax.tree.leaves((eng._ck, eng._cv, eng._pk, eng._pv))
+    assert len(leaves) == 4  # one tensor per cache — no scales beside them
+    assert all(leaf.dtype != jnp.int8 for leaf in leaves)
+    assert eng.metrics["kv_quant_enabled"] == 0
+    lowered = eng._decode_fn_single.lower(
+        eng.params, eng._ck, eng._cv, eng._tokens, eng._positions,
+        eng._active, eng._budget, eng._stop_ids, eng._key_data, eng._temp,
+        eng._top_p, eng._top_k,
+    )
+    text = lowered.as_text()
+    assert "xi8>" not in text and "i8[" not in text, (
+        "kv_quant=None traced int8 into the decode program"
+    )
+    # And the inverse sanity: int8 engines DO carry QuantKV caches.
+    q8 = InferenceEngine(
+        get_config("test-tiny"),
+        EngineConfig(num_slots=2, max_seq=64, prefill_buckets=(16,),
+                     dtype="float32", max_sessions=0, kv_quant="int8"),
+    )
+    assert isinstance(q8._ck, QuantKV) and q8._ck.q.dtype == jnp.int8
+
+
 def test_no_silent_broad_except():
     """Broad handlers (`except Exception:`/bare `except:`) followed by a
     bare `pass` with no comment swallow faults silently — they must log

@@ -249,6 +249,66 @@ class TestSharedPrefixServing:
         assert got == want
 
 
+class TestKVQuantPool:
+    """int8 KV edition (EngineConfig.kv_quant): the pool, its host tier,
+    and the seed→suffix-prefill path move int8 rows + scales VERBATIM —
+    the copy itself adds zero requantization drift. Token equality with
+    a fresh engine is bounded rather than structural here, unlike the
+    fp32 pool tests above: the pooled arm's suffix extend attends the
+    int8 prefix rows while the fresh arm's single-bucket prefill attends
+    the original float rows, so suffix logits carry ~0.4% quantization
+    noise between the arms and a near-tie argmax flip is legal (though
+    these 4-token turns sit deep inside the measured exact regime —
+    free-running divergence starts ~token 75, tests/test_quant.py)."""
+
+    @staticmethod
+    def _assert_tokens_close(got, want):
+        assert len(got) == len(want), (got, want)
+        assert got[:2] == want[:2], (got, want)      # near-term greedy head
+        agree = sum(int(x == y) for x, y in zip(got, want))
+        assert agree >= len(got) - 1, (got, want)    # ≤1 near-tie tail flip
+
+    def test_seed_suffix_prefill_round_trip(self):
+        eng = _engine(prefix_cache_slots=2, kv_quant="int8")
+        eng.register_prefix(SYS)
+        _turn(eng, SYS + [50, 51], sid="u1")     # publish from slot rows
+        assert eng.metrics["prefix_cache_insertions"] == 1
+        p2 = SYS + [60, 61, 62]
+        before = dict(eng.metrics)
+        t2, fin = _turn(eng, p2, sid="u2")       # device seed + suffix
+        assert fin.finish_reason == FinishReason.LENGTH
+        assert (
+            eng.metrics["prefix_cache_hit_tokens"]
+            - before["prefix_cache_hit_tokens"] == len(SYS)
+        )
+        fresh = _engine(kv_quant="int8")
+        t2_fresh, _ = _turn(fresh, p2)
+        self._assert_tokens_close(t2, t2_fresh)
+
+    def test_host_tier_round_trip(self):
+        pa, pb = SYS, list(range(200, 212))
+        eng = _engine(prefix_cache_slots=1, prefix_cache_host_entries=4,
+                      kv_quant="int8")
+        eng.register_prefix(pa)
+        eng.register_prefix(pb)
+        _turn(eng, pa + [1])                     # publish A (device)
+        _turn(eng, pb + [2])                     # publish B → A to host
+        got, _ = _turn(eng, pa + [3, 4])
+        assert eng.metrics["prefix_cache_host_hits"] == 1
+        fresh = _engine(kv_quant="int8")
+        want, _ = _turn(fresh, pa + [3, 4])
+        self._assert_tokens_close(got, want)
+
+    def test_pool_bytes_halved(self):
+        fp = _engine(prefix_cache_slots=2)
+        q8 = _engine(prefix_cache_slots=2, kv_quant="int8")
+        ratio = (
+            q8.metrics["kv_quant_device_bytes"]
+            / fp.metrics["kv_quant_device_bytes"]
+        )
+        assert ratio <= 0.55, f"slot+pool bytes ratio {ratio}"
+
+
 class TestAdmissionOrder:
     def test_seedable_request_admits_first_within_window(self):
         from omnia_tpu.engine.types import Request, RequestHandle
@@ -407,6 +467,8 @@ class TestMetricsKeyStability:
         "prefix_cache_offload_elisions",
         "grammar_compile_hits", "grammar_compile_misses",
         "masked_logit_fraction", "grammar_rejections_avoided",
+        "kv_quant_enabled", "kv_quant_bytes_per_token",
+        "kv_quant_device_bytes",
     }
 
     def test_engine_metric_keys_are_stable(self):
