@@ -27,18 +27,58 @@ Routing:
   drops, the next request lands elsewhere and re-prefills — the
   rebuild-on-miss contract makes that a latency cost, never a
   correctness one.
+
+Resilience (the request-lifecycle hardening layer):
+- Health is a CACHED prober, not a per-request RPC: probes refresh at
+  most every ``probe_interval_s``, a worker goes down only after
+  ``health_fail_threshold`` consecutive failures (hysteresis against
+  flapping transports), and a down worker reinstates only after staying
+  healthy for ``health_cooldown_s`` (no thundering re-pin onto a pod
+  that is still crash-looping). A submit() exception is hard evidence
+  and marks the worker down immediately.
+- submit() catches worker exceptions and fails over to the next
+  healthy worker with jittered backoff, inside the request's deadline
+  budget (``deadline_s``); exhausting budget or workers is an honest
+  terminal, never a raise to the caller.
+- A mid-stream worker death with ZERO tokens emitted is transparently
+  resubmitted to another worker (the caller cannot observe duplication
+  when nothing was delivered); a death after ≥1 token surfaces ERROR
+  with the partial count — resubmitting would silently duplicate the
+  delivered prefix.
+- When every healthy worker's queue is at ``max_worker_queue``, submit
+  sheds with FinishReason.OVERLOADED *before* routing — fleet overload
+  degrades to a fast observable signal, not queue pile-up.
 """
 
 from __future__ import annotations
 
 import collections
+import dataclasses
 import logging
+import random
 import threading
+import time
 from typing import Optional, Sequence
 
 from omnia_tpu.engine.types import FinishReason, RequestHandle, SamplingParams, StreamEvent
 
 logger = logging.getLogger(__name__)
+
+
+class _WorkerHealth:
+    """Cached probe state for one worker (prober-owned)."""
+
+    __slots__ = ("up", "fails", "last_probe", "healthy_since", "probing")
+
+    def __init__(self):
+        self.up = True
+        self.fails = 0
+        self.last_probe = float("-inf")
+        self.healthy_since: Optional[float] = None  # while down: first ok probe
+        # One outstanding probe RPC at a time: a permanently hung
+        # healthy() leaks exactly ONE abandoned thread, not one per
+        # probe interval forever.
+        self.probing = False
 
 
 class EngineCoordinator:
@@ -48,6 +88,15 @@ class EngineCoordinator:
         max_affinity: int = 100_000,
         prefix_route_min_tokens: int = 32,
         prefix_spill_load: int = 8,
+        probe_interval_s: float = 0.05,
+        probe_timeout_s: Optional[float] = 1.0,
+        health_fail_threshold: int = 1,
+        health_cooldown_s: float = 0.0,
+        max_worker_queue: int = 0,
+        submit_retries: int = 3,
+        resubmit_retries: int = 1,
+        backoff_base_s: float = 0.005,
+        backoff_seed: int = 0,
     ) -> None:
         if not workers:
             raise ValueError("coordinator needs at least one worker")
@@ -72,7 +121,38 @@ class EngineCoordinator:
         # the least-loaded worker (the pin survives — one re-prefill on
         # the spill target beats piling a hot pack onto one worker).
         self.prefix_spill_load = prefix_spill_load
+        # Prober knobs. The defaults reproduce the pre-prober semantics
+        # (every routing decision sees at-most-50ms-old health, one bad
+        # probe downs a worker, reinstatement is immediate); raise
+        # threshold/cooldown for flappy transports.
+        self.probe_interval_s = probe_interval_s
+        # Probe RPCs run under this bound (None = inline, for transports
+        # that cannot hang): a hung healthy() must cost the claiming
+        # submit at most probe_timeout_s, never a wedged client thread.
+        self.probe_timeout_s = probe_timeout_s
+        self.health_fail_threshold = max(1, health_fail_threshold)
+        self.health_cooldown_s = health_cooldown_s
+        # Failover/shed knobs. max_worker_queue=0 never sheds (the
+        # guarded default); submit_retries bounds cross-worker submit
+        # failover, resubmit_retries bounds zero-token mid-stream
+        # resubmission.
+        self.max_worker_queue = max_worker_queue
+        self.submit_retries = submit_retries
+        self.resubmit_retries = resubmit_retries
+        self.backoff_base_s = backoff_base_s
+        # Seeded jitter: backoff spreads retry pressure without making
+        # the chaos suite's timing nondeterministic.
+        self._rng = random.Random(backoff_seed)
         self._lock = threading.Lock()
+        # Health state has its own lock: probe bookkeeping must never
+        # wait on routing bookkeeping (and worker RPCs happen under
+        # NEITHER lock — see _pick).
+        self._health_lock = threading.Lock()
+        self._health = [_WorkerHealth() for _ in self.workers]
+        # Metric increments take _metrics_lock so counts reconcile
+        # EXACTLY with terminal events under concurrent submits
+        # (unlocked += drops updates under contention).
+        self._metrics_lock = threading.Lock()
         self.metrics = {
             "routed": 0,
             "failovers": 0,
@@ -80,19 +160,117 @@ class EngineCoordinator:
             "prefix_routed": 0,
             "prefix_failovers": 0,
             "prefix_spills": 0,
+            # Lifecycle hardening: shed = OVERLOADED fast-fails before
+            # routing (fleet saturated); resubmits = zero-token worker
+            # deaths transparently re-placed on another worker.
+            "shed": 0,
+            "resubmits": 0,
         }
+
+    def _count(self, key: str, n: int = 1) -> None:
+        with self._metrics_lock:
+            self.metrics[key] += n
 
     # -- health / load -------------------------------------------------
 
-    def _healthy_indices(self) -> list[int]:
-        out = []
-        for i, w in enumerate(self.workers):
+    def _probe_worker(self, i: int) -> None:
+        """One health RPC (outside every lock) + cached-state update.
+        With probe_timeout_s, the RPC runs in a short-lived thread: a
+        hang counts as a failed probe at the bound, and the eventual
+        late answer still lands in the cache when the RPC returns."""
+        def rpc():
             try:
-                if w.healthy():
-                    out.append(i)
+                ok = bool(self.workers[i].healthy())
             except Exception:
-                continue
-        return out
+                ok = False
+            finally:
+                with self._health_lock:
+                    self._health[i].probing = False
+            box.append(ok)
+            self._note_probe(i, ok)
+
+        if self.probe_timeout_s is None:
+            box: list = []
+            rpc()
+            return
+        box = []
+        t = threading.Thread(target=rpc, name="omnia-coord-probe", daemon=True)
+        t.start()
+        t.join(timeout=self.probe_timeout_s)
+        if not box:
+            self._note_probe(i, False)  # hung probe = failed probe
+
+    def _note_probe(self, i: int, ok: bool, hard: bool = False) -> None:
+        """Fold one observation into the cached state. hard=True is
+        direct evidence (a submit() exception): the worker goes down
+        immediately regardless of the hysteresis threshold."""
+        now = time.monotonic()
+        st = self._health[i]
+        with self._health_lock:
+            st.last_probe = now
+            if ok:
+                st.fails = 0
+                if not st.up:
+                    if st.healthy_since is None:
+                        st.healthy_since = now
+                    if now - st.healthy_since >= self.health_cooldown_s:
+                        st.up = True
+                        st.healthy_since = None
+                        logger.info("worker %d reinstated after cooldown", i)
+            else:
+                st.fails += 1
+                st.healthy_since = None
+                if st.up and (hard or st.fails >= self.health_fail_threshold):
+                    st.up = False
+                    logger.warning(
+                        "worker %d marked down (%s)", i,
+                        "submit failure" if hard else f"{st.fails} failed probes",
+                    )
+
+    def _healthy_indices(self) -> list[int]:
+        """Workers currently considered up, refreshing stale probes.
+        Probe RPCs run outside every coordinator lock, and each stale
+        entry is CLAIMED (last_probe stamped) before its RPC is issued —
+        a hung healthy() then blocks only the one caller that claimed
+        it, while every concurrent submit keeps routing on the cached
+        state instead of piling onto the same hung RPC."""
+        now = time.monotonic()
+        # A claim older than this is an abandoned (blackholed) probe:
+        # re-claim it so a worker that RECOVERS after a hung RPC can
+        # still be probed again — at most one extra leaked thread per
+        # abandon window, never permanent probe silence.
+        abandon_s = (
+            None if self.probe_timeout_s is None else 10 * self.probe_timeout_s
+        )
+        stale = []
+        with self._health_lock:
+            for i, st in enumerate(self._health):
+                if st.probing and (
+                    abandon_s is None or now - st.last_probe < abandon_s
+                ):
+                    continue  # prior probe still in flight (maybe hung)
+                if now - st.last_probe >= self.probe_interval_s:
+                    st.last_probe = now  # claim: one prober per interval
+                    st.probing = True
+                    stale.append(i)
+        if len(stale) > 1 and self.probe_timeout_s is not None:
+            # Parallel probes: the claiming caller pays ~one
+            # probe_timeout_s total, not one per hung worker.
+            ts = [
+                threading.Thread(
+                    target=self._probe_worker, args=(i,), daemon=True
+                )
+                for i in stale
+            ]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join()  # each self-bounds at probe_timeout_s
+        else:
+            for i in stale:
+                self._probe_worker(i)
+        with self._health_lock:
+            return [i for i, st in enumerate(self._health) if st.up]
 
     def _load(self, i: int) -> float:
         w = self.workers[i]
@@ -121,6 +299,27 @@ class EngineCoordinator:
     def active_slots(self) -> int:
         return self._sum_signal("active_slots")
 
+    def _saturated(self) -> bool:
+        """True when every healthy worker's queue is at the per-worker
+        bound — the shed-before-routing signal. A worker whose stats RPC
+        fails cannot prove spare capacity, so it counts as saturated.
+        Note: with the bound enabled, submit pays this sweep on top of
+        _pick's load snapshot (two stats passes); folding them into one
+        shared snapshot is the known follow-up if opted-in fleets see
+        routing-RPC pressure. max_worker_queue=0 (default) skips it."""
+        if self.max_worker_queue <= 0:
+            return False
+        healthy = self._healthy_indices()
+        if not healthy:
+            return False  # routed path owns the no-workers terminal
+        for i in healthy:
+            try:
+                if self.workers[i].queue_depth() < self.max_worker_queue:
+                    return False
+            except Exception:
+                continue
+        return True
+
     # -- routing -------------------------------------------------------
 
     def _prefix_key(
@@ -141,21 +340,41 @@ class EngineCoordinator:
         session_id: Optional[str],
         prompt_tokens: list[int] = (),
         prefix_key: Optional[str] = None,
+        exclude: frozenset = frozenset(),
     ) -> Optional[int]:
-        healthy = set(self._healthy_indices())
+        healthy = set(self._healthy_indices()) - set(exclude)
         if not healthy:
             return None
+        if session_id is not None:
+            # Pinned-session fast path: the steady-state hot path needs
+            # ZERO load RPCs — only the failover/fresh branches below
+            # pay for a fleet load snapshot.
+            with self._lock:
+                pinned = self._affinity.get(session_id)
+                if pinned is not None and pinned in healthy:
+                    self._affinity.move_to_end(session_id)
+                    return pinned
+        # Load snapshot OUTSIDE self._lock: these are worker RPCs, and a
+        # slow/hung stats call while holding the routing lock would
+        # serialize ALL routing behind one bad worker (satellite fix).
+        loads = {i: self._load(i) for i in healthy}
         with self._lock:
             if session_id is not None:
                 pinned = self._affinity.get(session_id)
                 if pinned is not None:
                     if pinned in healthy:
+                        # Re-pinned by a concurrent submit while we
+                        # snapshotted loads — honor it.
                         self._affinity.move_to_end(session_id)
                         return pinned
-                    # Worker died: fail the session over. Its resident KV
-                    # is gone; the new worker re-prefills from scratch.
+                    # Worker died (or is excluded after a failure): the
+                    # session fails over. Its resident KV is gone; the
+                    # new worker re-prefills from scratch. An EXCLUDED
+                    # pin was already counted by the submit-exception
+                    # failover — one fault, one ledger entry.
                     del self._affinity[session_id]
-                    self.metrics["failovers"] += 1
+                    if pinned not in exclude:
+                        self._count("failovers")
             # Fresh session (or sessionless): prefix-affinity routing.
             choice = None
             key = self._prefix_key(list(prompt_tokens), prefix_key)
@@ -165,30 +384,30 @@ class EngineCoordinator:
                     # Worker died: the pin fails over — the next healthy
                     # worker re-prefills (and republishes) from scratch.
                     del self._prefix_affinity[key]
-                    self.metrics["prefix_failovers"] += 1
+                    self._count("prefix_failovers")
                     pinned = None
                 if pinned is not None:
-                    least = min(healthy, key=self._load)
-                    if self._load(pinned) - self._load(least) > self.prefix_spill_load:
-                        self.metrics["prefix_spills"] += 1
+                    least = min(healthy, key=lambda i: (loads[i], i))
+                    if loads[pinned] - loads[least] > self.prefix_spill_load:
+                        self._count("prefix_spills")
                         choice = least  # spill; the pin survives
                     else:
                         self._prefix_affinity.move_to_end(key)
-                        self.metrics["prefix_routed"] += 1
+                        self._count("prefix_routed")
                         choice = pinned
             if choice is None:
-                choice = min(healthy, key=self._load)
+                choice = min(healthy, key=lambda i: (loads[i], i))
             if key is not None and key not in self._prefix_affinity:
                 self._prefix_affinity[key] = choice
                 while len(self._prefix_affinity) > self.max_affinity:
                     self._prefix_affinity.popitem(last=False)
-                    self.metrics["affinity_evictions"] += 1
+                    self._count("affinity_evictions")
             if session_id is not None:
                 self._affinity[session_id] = choice
                 self._affinity.move_to_end(session_id)
                 while len(self._affinity) > self.max_affinity:
                     self._affinity.popitem(last=False)
-                    self.metrics["affinity_evictions"] += 1
+                    self._count("affinity_evictions")
             return choice
 
     def register_prefix(self, tokens) -> None:
@@ -202,32 +421,137 @@ class EngineCoordinator:
                 except Exception:
                     logger.warning("register_prefix failed on a worker")
 
+    # -- submission ----------------------------------------------------
+
+    def _routed_submit(
+        self,
+        prompt_tokens: list[int],
+        params: SamplingParams,
+        session_id: Optional[str],
+        prefix_key: Optional[str],
+        deadline_at: Optional[float],
+        exclude: frozenset = frozenset(),
+    ):
+        """Pick a healthy worker and submit, failing over on submit
+        exceptions with jittered backoff inside the deadline budget.
+        Returns ``(idx, inner_handle)`` on success or ``(None, event)``
+        with the honest terminal StreamEvent on exhaustion."""
+        exclude = frozenset(exclude)
+        for attempt in range(self.submit_retries + 1):
+            idx = self._pick(session_id, prompt_tokens, prefix_key, exclude=exclude)
+            if idx is None:
+                return None, StreamEvent(
+                    "req-unrouted", finish_reason=FinishReason.ERROR,
+                    error="no healthy engine workers",
+                )
+            rem = None if deadline_at is None else deadline_at - time.monotonic()
+            if rem is not None and rem <= 0:
+                return None, StreamEvent(
+                    "req-deadline", finish_reason=FinishReason.DEADLINE,
+                    error="deadline exhausted before a worker accepted the request",
+                )
+            try:
+                try:
+                    inner = self.workers[idx].submit(
+                        prompt_tokens, params, session_id=session_id,
+                        deadline_s=rem,
+                    )
+                except TypeError:
+                    # Worker predates the deadline_s kwarg (same compat
+                    # contract as stop(drain=)): a legacy signature is a
+                    # supported duck type, not a worker fault — the TTL
+                    # then only binds coordinator-side (queue reaping on
+                    # that worker is unavailable).
+                    inner = self.workers[idx].submit(
+                        prompt_tokens, params, session_id=session_id
+                    )
+                return idx, inner
+            except Exception:
+                logger.warning("submit to worker %d failed; failing over", idx)
+                self._note_probe(idx, False, hard=True)
+                self._count("failovers")
+                exclude = exclude | {idx}
+                # Jittered exponential backoff, clipped to the deadline
+                # budget — a flaky transport gets breathing room, a
+                # tight deadline is never slept past.
+                pause = self.backoff_base_s * (2 ** attempt) * (
+                    0.5 + self._rng.random()
+                )
+                if rem is not None:
+                    pause = min(pause, max(rem - 0.001, 0.0))
+                if pause > 0 and attempt < self.submit_retries:
+                    # No sleep after the FINAL attempt — backoff buys a
+                    # retry, never a delayed failure terminal.
+                    time.sleep(pause)
+        return None, StreamEvent(
+            "req-failed", finish_reason=FinishReason.ERROR,
+            error=f"submit failed on {self.submit_retries + 1} workers",
+        )
+
     def submit(
         self,
         prompt_tokens: list[int],
         params: SamplingParams = SamplingParams(),
         session_id: Optional[str] = None,
         prefix_key: Optional[str] = None,
+        deadline_s: Optional[float] = None,
     ) -> RequestHandle:
-        idx = self._pick(session_id, prompt_tokens, prefix_key)
-        if idx is None:
-            handle = RequestHandle("req-unrouted")
+        deadline_at = (
+            time.monotonic() + deadline_s if deadline_s is not None else None
+        )
+        if self._saturated():
+            self._count("shed")
+            handle = RequestHandle("req-shed")
             handle._push(StreamEvent(
-                "req-unrouted", finish_reason=FinishReason.ERROR,
-                error="no healthy engine workers",
+                "req-shed", finish_reason=FinishReason.OVERLOADED,
+                error=(
+                    f"every healthy worker is saturated "
+                    f"(max_worker_queue={self.max_worker_queue})"
+                ),
             ))
             return handle
-        self.metrics["routed"] += 1
-        return self.workers[idx].submit(prompt_tokens, params, session_id=session_id)
+        idx, result = self._routed_submit(
+            prompt_tokens, params, session_id, prefix_key, deadline_at
+        )
+        if idx is None:
+            handle = RequestHandle(result.request_id)
+            handle._push(result)
+            return handle
+        self._count("routed")
+        if self.resubmit_retries <= 0:
+            # The relay exists for the zero-token resubmit rule; with it
+            # disabled the worker handle streams to the caller directly —
+            # no pump thread, no per-event copy.
+            return result
+        relay = _RelayHandle(
+            self, prompt_tokens, params, session_id, prefix_key, deadline_at
+        )
+        relay._begin(idx, result)
+        return relay
 
     def release_session(self, session_id: str) -> None:
+        """Forget a session's coordinator pin AND its worker-resident KV.
+        On a worker-RPC failure the entry is RE-PINNED: dropping it on a
+        transient error would orphan the session's device KV on that
+        worker (nothing would ever release it there) while the next
+        request re-prefills elsewhere. setdefault on the re-pin keeps
+        any pin a concurrent submit created meanwhile — that newer pin
+        must survive either way (a same-index compare could not tell a
+        concurrent re-pin apart from our own stale read)."""
         with self._lock:
             idx = self._affinity.pop(session_id, None)
-        if idx is not None:
-            try:
-                self.workers[idx].release_session(session_id)
-            except Exception:
-                logger.warning("release_session on worker %d failed", idx)
+        if idx is None:
+            return
+        try:
+            self.workers[idx].release_session(session_id)
+        except Exception:
+            logger.warning(
+                "release_session on worker %d failed; re-pinning the "
+                "affinity entry so the session's device KV is not orphaned",
+                idx,
+            )
+            with self._lock:
+                self._affinity.setdefault(session_id, idx)
 
     def worker_for(self, session_id: str) -> Optional[int]:
         with self._lock:
@@ -239,9 +563,130 @@ class EngineCoordinator:
         for w in self.workers:
             w.start()
 
-    def stop(self) -> None:
-        for w in self.workers:
+    def stop(self, drain: bool = False) -> None:
+        def _stop_one(w):
             try:
-                w.stop()
+                try:
+                    w.stop(drain=drain)
+                except TypeError:
+                    w.stop()  # worker predates the drain kwarg
             except Exception:
                 logger.exception("worker stop failed")
+
+        if drain and len(self.workers) > 1:
+            # Drain in parallel: admission closes fleet-wide at once and
+            # the drains overlap, bounding shutdown at ONE drain window
+            # instead of workers × drain_timeout_s.
+            threads = [
+                threading.Thread(target=_stop_one, args=(w,), daemon=True)
+                for w in self.workers
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            return
+        for w in self.workers:
+            _stop_one(w)
+
+
+class _RelayHandle(RequestHandle):
+    """Coordinator-owned handle: pumps the worker handle's events into
+    its own queue, and owns the resubmit decision on worker death.
+
+    The rule is duplication-safe by construction: a terminal ERROR with
+    ZERO tokens forwarded means the caller observed nothing, so the
+    request transparently resubmits to another worker (bounded by
+    ``resubmit_retries`` and the deadline budget); once ≥1 token has
+    been forwarded the ERROR surfaces with the partial count — the
+    coordinator never replays a stream the caller already saw part of.
+    Exactly ONE terminal event ever reaches the consumer."""
+
+    def __init__(self, owner, prompt_tokens, params, session_id, prefix_key,
+                 deadline_at):
+        super().__init__("coord-pending")
+        self._owner = owner
+        self._args = (list(prompt_tokens), params, session_id, prefix_key)
+        self._deadline_at = deadline_at
+        self._inner: Optional[RequestHandle] = None
+        self._inner_idx: Optional[int] = None
+        self._resubmits_left = owner.resubmit_retries
+        self._forwarded = 0
+
+    def _begin(self, idx: int, inner: RequestHandle) -> None:
+        self.request_id = inner.request_id
+        self._inner, self._inner_idx = inner, idx
+        threading.Thread(
+            target=self._pump, name="omnia-coord-relay", daemon=True
+        ).start()
+
+    def cancel(self) -> None:
+        super().cancel()
+        inner = self._inner
+        if inner is not None:
+            inner.cancel()
+
+    def _try_resubmit(self) -> bool:
+        """Zero-token worker death: place the request on another worker.
+        Returns True when a new inner stream is live."""
+        failed = self._inner_idx
+        self._owner._note_probe(failed, False, hard=True)
+        idx, result = self._owner._routed_submit(
+            *self._args, self._deadline_at, exclude=frozenset({failed})
+        )
+        if idx is None:
+            self._push(dataclasses.replace(result, request_id=self.request_id))
+            return False
+        self._owner._count("resubmits")
+        self._inner, self._inner_idx = result, idx
+        if self.cancelled:
+            result.cancel()  # a cancel raced the resubmit: propagate
+        return True
+
+    def _pump(self) -> None:
+        while True:
+            for ev in self._inner.events(timeout=None):
+                if not ev.is_final:
+                    if ev.token_id is not None:
+                        self._forwarded += 1
+                    # Hot path: before any resubmit the inner rid IS the
+                    # relay rid — forward without an allocation; only a
+                    # replacement stream (different rid) pays the copy.
+                    self._push(
+                        ev if ev.request_id == self.request_id
+                        else dataclasses.replace(ev, request_id=self.request_id)
+                    )
+                    continue
+                if (
+                    ev.finish_reason is FinishReason.ERROR
+                    # Worker-fault discriminator: engines stamp
+                    # num_prompt_tokens only on ERRORs for requests they
+                    # had ACCEPTED (death/recovery/prefill-crash);
+                    # validation rejections (empty prompt, bad
+                    # max_tokens, grammar) leave it 0 and would recur
+                    # identically on every worker — resubmitting one
+                    # would burn a retry and smear a healthy worker's
+                    # reputation (a malformed-request stream must never
+                    # down the fleet).
+                    and ev.num_prompt_tokens > 0
+                    and self._forwarded == 0
+                    and self._resubmits_left > 0
+                    and not self.cancelled
+                    and (
+                        self._deadline_at is None
+                        or time.monotonic() < self._deadline_at
+                    )
+                ):
+                    self._resubmits_left -= 1
+                    if self._try_resubmit():
+                        break  # pump the replacement stream
+                    return
+                if ev.finish_reason is FinishReason.ERROR:
+                    # Honest partial count: the consumer saw exactly
+                    # self._forwarded tokens from this coordinator,
+                    # whatever the dying worker thought it emitted.
+                    ev = dataclasses.replace(
+                        ev, num_generated_tokens=self._forwarded
+                    )
+                self._push(dataclasses.replace(ev, request_id=self.request_id))
+                return

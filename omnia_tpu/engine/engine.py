@@ -49,6 +49,8 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
+from omnia_tpu.engine.faults import FaultPlan
+from omnia_tpu.engine.lifecycle import _LifecycleMixin
 from omnia_tpu.engine.placement import _PlacementMixin
 from omnia_tpu.engine.prefix_cache import PrefixPool, _PrefixCacheMixin
 from omnia_tpu.engine.programs import build_programs
@@ -84,7 +86,7 @@ logger = logging.getLogger(__name__)
 
 class InferenceEngine(
     _SchedulerMixin, _SessionMixin, _SpecDecodeMixin, _PrefixCacheMixin,
-    _PlacementMixin,
+    _PlacementMixin, _LifecycleMixin,
 ):
     """Slot-based continuous-batching engine over one model."""
 
@@ -197,6 +199,10 @@ class InferenceEngine(
         B = engine_cfg.num_slots
         self._slots = [_Slot() for _ in range(B)]
         self._waiting: list[tuple[Request, RequestHandle]] = []
+        # Requests between queue removal and slot activation (mid-
+        # placement): invisible to queue_depth AND active_slots, so the
+        # graceful-drain wait must count them explicitly.
+        self._placing = 0
         self._lock = threading.Lock()
         self._req_counter = itertools.count()
         # Sessionful KV registry — engine-thread-owned: only step() and the
@@ -211,6 +217,13 @@ class InferenceEngine(
         self._thread: Optional[threading.Thread] = None
         self._stop_event = threading.Event()
         self._healthy = True
+        # Graceful drain (stop(drain=True)): True stops admission —
+        # submit() sheds OVERLOADED — while queued/active work finishes.
+        self._draining = False
+        # Chaos-harness injection seam (engine/faults.py): tests set this
+        # to inject hung/slow chunk syncs and flaky submits. None in
+        # production — every consult is a cheap attribute check.
+        self._fault_plan: Optional[FaultPlan] = None
         # Session-LRU clock. Injectable so replicated engines (multi-host
         # lockstep, engine/multihost.py) share a LOGICAL clock: eviction
         # order must be identical on every process or their compiled-step
@@ -248,6 +261,15 @@ class InferenceEngine(
             "spec_steps": 0,
             "spec_proposed": 0,
             "spec_accepted": 0,
+            # Request-lifecycle robustness (always present, zero until a
+            # knob/fault engages): shed = OVERLOADED fast-fails at
+            # submit (full queue or draining; NOT counted as submitted),
+            # deadline_exceeded = DEADLINE terminals (queued sheds +
+            # early mid-decode finishes), watchdog_trips = hung-dispatch
+            # watchdog firings (each one also counts a recovery).
+            "requests_shed": 0,
+            "deadline_exceeded": 0,
+            "watchdog_trips": 0,
             # Grammar-constrained decoding (engine/grammar/).
             # compile_hits/misses mirror the process-global grammar
             # compile cache (content-addressed, key-stable across
@@ -538,6 +560,7 @@ class InferenceEngine(
         params: SamplingParams = SamplingParams(),
         session_id: Optional[str] = None,
         grammar=None,
+        deadline_s: Optional[float] = None,
     ) -> RequestHandle:
         """Queue a generation request. With a session_id, the session's KV
         rows persist across requests: the next request prefills only the
@@ -545,13 +568,23 @@ class InferenceEngine(
         (multi-turn serving cost becomes O(new tokens), SURVEY §7).
         With a `grammar` (engine/grammar.TokenGrammar), every sampled
         token is FSM-masked on device and EOS is admissible only in
-        accepting states — requires EngineConfig.grammar=True."""
+        accepting states — requires EngineConfig.grammar=True.
+        With a `deadline_s` TTL, a request still queued at the deadline
+        is shed with FinishReason.DEADLINE and an active request
+        finishes early at the deadline boundary (chunk granularity)."""
+        if self._fault_plan is not None and self._fault_plan.take_submit_fault():
+            raise RuntimeError("injected flaky submit (FaultPlan)")
         rid = f"req-{next(self._req_counter)}"
         handle = RequestHandle(rid)
         request = Request(
             rid, list(prompt_tokens), params, session_id=session_id,
             grammar=grammar,
         )
+        if deadline_s is not None:
+            # Engine clock domain (not time.monotonic): lockstep ranks
+            # share the leader's logical clock, so the deadline reaps
+            # identically everywhere.
+            request.deadline_at = self.clock() + deadline_s
         if grammar is not None:
             err = self._validate_grammar(grammar, params)
             if err:
@@ -597,8 +630,22 @@ class InferenceEngine(
             )
             return handle
         with self._lock:
-            self._waiting.append((request, handle))
-            self.metrics["requests_submitted"] += 1
+            # Bounded admission: overload (or a draining engine) is an
+            # immediate OVERLOADED terminal, never unbounded queue wait.
+            # Shed requests are NOT counted as submitted (the rejected-
+            # request convention) — requests_shed is their own ledger.
+            if self._draining:
+                shed_why = "engine draining (stop(drain=True))"
+            elif 0 < self.cfg.max_queue <= len(self._waiting):
+                shed_why = f"queue full (max_queue={self.cfg.max_queue})"
+            else:
+                self._waiting.append((request, handle))
+                self.metrics["requests_submitted"] += 1
+                return handle
+            self.metrics["requests_shed"] += 1
+        handle._push(
+            StreamEvent(rid, finish_reason=FinishReason.OVERLOADED, error=shed_why)
+        )
         return handle
 
     def supports_grammar(self) -> bool:
@@ -625,78 +672,9 @@ class InferenceEngine(
         }
 
     # ------------------------------------------------------------------
-    # Thread loop / sync helpers
+    # Thread loop / lifecycle: start/stop/drain/recovery live in
+    # engine/lifecycle.py (_LifecycleMixin) — the robustness seam.
     # ------------------------------------------------------------------
-
-    def start(self):
-        if self._thread is not None:
-            return
-        self._stop_event.clear()
-        self._thread = threading.Thread(target=self._loop, name="omnia-engine", daemon=True)
-        self._thread.start()
-
-    def stop(self):
-        if self._thread is None:
-            return
-        self._stop_event.set()
-        self._thread.join(timeout=30)
-        if self._thread.is_alive():
-            # A wedged device step: keep the handle so a retried start()
-            # cannot spawn a second loop over the same donated buffers.
-            logger.error("engine loop did not stop within 30s; still alive")
-            self._healthy = False
-            return
-        self._thread = None
-
-    def _loop(self):
-        while not self._stop_event.is_set():
-            try:
-                if not self.step():
-                    time.sleep(0.001)
-            except Exception:  # pragma: no cover - engine must not die silently
-                logger.exception("engine step failed")
-                self._recover("engine step failed")
-                time.sleep(0.1)
-
-    def _recover(self, msg: str):
-        """Fail in-flight requests and rebuild device state. A raise after
-        cache donation leaves self._ck/_cv pointing at deleted arrays, so
-        without reallocation every subsequent step would also fail and the
-        engine would be permanently dead while looking alive."""
-        self._fail_all(msg)
-        # In-flight chunk futures share lineage with the dead caches.
-        self._inflight.clear()
-        # Device-resident session rows died with the caches; host-paged
-        # sessions survive (their rows live in host RAM).
-        for sess in list(self._sessions.values()):
-            if sess.slot is not None:
-                self._slots[sess.slot].session_id = None
-                sess.slot = None
-                sess.token_ids = []
-        try:
-            self._init_device_state()
-            self.metrics["recoveries"] = self.metrics.get("recoveries", 0) + 1
-        except Exception:
-            logger.exception("engine recovery failed; marking unhealthy")
-            self._healthy = False
-
-    def healthy(self) -> bool:
-        """False once recovery itself failed — the readiness signal
-        (platform analog of the reference runtime's Health capabilities)."""
-        return self._healthy
-
-    def _fail_all(self, msg: str):
-        for i, slot in enumerate(self._slots):
-            if slot.active:
-                slot.handle._push(
-                    StreamEvent(
-                        slot.request.request_id,
-                        finish_reason=FinishReason.ERROR,
-                        error=msg,
-                    )
-                )
-                self._release_slot_seed(slot)
-                slot.clear()
 
     def generate(
         self, prompt_tokens: list[int], params: SamplingParams = SamplingParams()

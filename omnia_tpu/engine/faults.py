@@ -1,0 +1,98 @@
+"""Deterministic fault injection for the request-lifecycle chaos harness.
+
+The reference platform leans on Kubernetes-grade resilience (probes,
+failover, KEDA backpressure); the serving plane's equivalent claims —
+shed, deadline, failover, resubmit, watchdog — are only honest if a
+test can INJECT the faults they guard against and count the terminal
+events. A :class:`FaultPlan` is that injection point: a small, counted,
+thread-safe script of faults that `MockEngine(fault_plan=...)` and
+`InferenceEngine._fault_plan` consult at well-defined seams.
+
+Every fault is bounded by an explicit count, so a plan fires a known
+number of times and the chaos suite (tests/test_chaos.py) can reconcile
+coordinator/engine metrics against ``plan.fired`` exactly — no
+randomness, no wall-clock races in the assertions.
+
+Seams (who consults what):
+
+- ``take_submit_fault()``: ``submit()`` on both engines — the first
+  ``flaky_submit`` submits raise ``RuntimeError`` (a flaky worker
+  transport; the coordinator's failover/backoff path).
+- ``take_death()``: ``MockEngine._play`` — the request emits
+  ``die_after_tokens`` tokens and then the worker "dies" (ERROR final,
+  mid-stream). ``die_after_tokens=0`` is death before the first token —
+  the transparently-resubmittable case.
+- ``take_hang_s()`` / ``slow_sync_s``: the host-sync seam —
+  ``InferenceEngine._sync_chunk_host`` (a decode chunk's device→host
+  read) and ``MockEngine._play``'s pre-first-token dispatch. A hang
+  longer than the engine's ``watchdog_s`` trips the hung-dispatch
+  watchdog; ``slow_sync_s`` is an un-counted per-sync tax.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+from typing import Optional
+
+
+class WatchdogTimeout(RuntimeError):
+    """A decode chunk's host sync exceeded EngineConfig.watchdog_s.
+
+    Raised out of the scheduler's chunk sync; the engine loop's recovery
+    path catches it, fails in-flight handles, and reallocates device
+    state — the same path a donated-buffer crash takes."""
+
+
+@dataclasses.dataclass
+class FaultPlan:
+    """A counted, deterministic script of injectable faults.
+
+    Counters make every fault finite: after ``die_count`` deaths /
+    ``hang_count`` hangs / ``flaky_submit`` submit failures the plan is
+    spent and the worker behaves normally — so a chaos scenario has a
+    deterministic shape (fault, degrade, recover) instead of a flap
+    loop. ``fired`` records how many times each fault actually fired;
+    the chaos suite reconciles metrics against it exactly.
+    """
+
+    # Each affected request emits this many tokens, then the worker
+    # dies mid-request (ERROR final). 0 = death before the first token.
+    die_after_tokens: Optional[int] = None
+    die_count: int = 1
+    # Host-sync hang per affected dispatch (seconds); trips the
+    # hung-dispatch watchdog when it exceeds the engine's watchdog_s.
+    hang_dispatch_s: float = 0.0
+    hang_count: int = 1
+    # The first N submit() calls raise RuntimeError (flaky transport).
+    flaky_submit: int = 0
+    # Added to EVERY sync/token step — un-counted latency tax (slow
+    # link), never a terminal fault by itself.
+    slow_sync_s: float = 0.0
+
+    def __post_init__(self):
+        self._lock = threading.Lock()
+        self.fired = {"deaths": 0, "submit_faults": 0, "hangs": 0}
+
+    # -- consumption seams (each decides-and-counts atomically) --------
+
+    def take_submit_fault(self) -> bool:
+        with self._lock:
+            if self.fired["submit_faults"] < self.flaky_submit:
+                self.fired["submit_faults"] += 1
+                return True
+        return False
+
+    def take_death(self) -> bool:
+        with self._lock:
+            if self.die_after_tokens is not None and self.fired["deaths"] < self.die_count:
+                self.fired["deaths"] += 1
+                return True
+        return False
+
+    def take_hang_s(self) -> float:
+        with self._lock:
+            if self.hang_dispatch_s > 0.0 and self.fired["hangs"] < self.hang_count:
+                self.fired["hangs"] += 1
+                return self.hang_dispatch_s
+        return 0.0

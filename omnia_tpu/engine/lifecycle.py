@@ -1,0 +1,166 @@
+"""Engine thread lifecycle: loop, graceful drain, crash recovery, health.
+
+The request-lifecycle robustness seam of :class:`InferenceEngine` (same
+seam-per-concern layout as the scheduler/session/placement mixins):
+starting/stopping the step loop, the graceful drain that stops admission
+and pages sessions out before shutdown, and the recovery path that turns
+a failed (or watchdog-tripped) device step into failed handles plus a
+fresh device-state allocation instead of a silently dead engine.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+
+from omnia_tpu.engine.types import FinishReason, StreamEvent
+
+logger = logging.getLogger(__name__)
+
+
+class _LifecycleMixin:
+    """Thread-loop / drain / recovery methods of :class:`InferenceEngine`."""
+
+    def start(self):
+        if self._thread is not None:
+            return
+        self._draining = False
+        self._stop_event.clear()
+        self._thread = threading.Thread(
+            target=self._loop, name="omnia-engine", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self, drain: bool = False, drain_timeout_s: float = 30.0):
+        """Stop the engine loop. drain=True first performs a graceful
+        drain: admission stops (submit sheds OVERLOADED), queued and
+        active requests finish — bounded by drain_timeout_s — and the
+        idle sessions' KV rows are offloaded to host RAM so a restarted
+        engine restores them instead of re-prefilling."""
+        if drain:
+            self._draining = True
+            deadline = time.monotonic() + drain_timeout_s
+            while time.monotonic() < deadline and (
+                self.queue_depth() > 0 or self.active_slots() > 0
+                or self._placing > 0
+            ):
+                if self._thread is None:
+                    if not self.step():
+                        time.sleep(0.001)
+                else:
+                    time.sleep(0.002)
+        wedged = False
+        if self._thread is not None:
+            self._stop_event.set()
+            self._thread.join(timeout=30)
+            if self._thread.is_alive():
+                # A wedged device step: keep the handle so a retried
+                # start() cannot spawn a second loop over the same
+                # donated buffers.
+                logger.error("engine loop did not stop within 30s; still alive")
+                self._healthy = False
+                wedged = True
+            else:
+                self._thread = None
+        if drain:
+            # Drain-timeout leftovers still get their terminal — even
+            # past a wedged join, terminal delivery is pure host-side
+            # work and must happen: a client blocked on a handle must
+            # never hang past the drain window (the exactly-one-terminal
+            # invariant). Queued requests were accepted, so their shed
+            # counts as finished; active slots fail with partial counts.
+            with self._lock:
+                leftover, self._waiting = self._waiting, []
+            for req, handle in leftover:
+                handle._push(StreamEvent(
+                    req.request_id,
+                    finish_reason=FinishReason.OVERLOADED,
+                    error="engine draining: drain window elapsed while queued",
+                    num_prompt_tokens=len(req.prompt_tokens),
+                ))
+                self.metrics["requests_finished"] += 1
+            if any(s.active for s in self._slots):
+                if wedged:
+                    # The engine thread is still alive inside a stuck
+                    # step and OWNS the active slots: failing them from
+                    # this thread could double-push a terminal if the
+                    # step unwedges mid-_fail_all. Queued sheds above
+                    # are lock-safe; active handles stay with the loop
+                    # thread (it delivers terminals if it ever resumes).
+                    logger.error(
+                        "drain: engine loop wedged with %d active slot(s); "
+                        "their handles remain with the stuck loop",
+                        sum(1 for s in self._slots if s.active),
+                    )
+                else:
+                    self._fail_all(
+                        "engine stopped: drain window elapsed mid-request"
+                    )
+        if drain and not wedged and self._healthy:
+            # The loop has joined (or never ran), so the engine thread's
+            # device-state ownership has passed back to this caller.
+            self._offload_idle_sessions()
+
+    def _loop(self):
+        while not self._stop_event.is_set():
+            try:
+                if not self.step():
+                    time.sleep(0.001)
+            except Exception:  # pragma: no cover - engine must not die silently
+                logger.exception("engine step failed")
+                self._recover("engine step failed")
+                time.sleep(0.1)
+
+    def _recover(self, msg: str):
+        """Fail in-flight requests and rebuild device state. A raise after
+        cache donation leaves self._ck/_cv pointing at deleted arrays, so
+        without reallocation every subsequent step would also fail and the
+        engine would be permanently dead while looking alive."""
+        self._fail_all(msg)
+        # In-flight chunk futures share lineage with the dead caches.
+        self._inflight.clear()
+        # Device-resident session rows died with the caches; host-paged
+        # sessions survive (their rows live in host RAM).
+        for sess in list(self._sessions.values()):
+            if sess.slot is not None:
+                self._slots[sess.slot].session_id = None
+                sess.slot = None
+                sess.token_ids = []
+        try:
+            self._init_device_state()
+            self.metrics["recoveries"] = self.metrics.get("recoveries", 0) + 1
+            # A watchdog trip marks the engine unhealthy before raising;
+            # a recovery that actually reallocated device state restores
+            # readiness (the platform analog: probe fails during the
+            # incident, passes once the pod is serving again).
+            self._healthy = True
+        except Exception:
+            logger.exception("engine recovery failed; marking unhealthy")
+            self._healthy = False
+
+    def healthy(self) -> bool:
+        """False once recovery itself failed — the readiness signal
+        (platform analog of the reference runtime's Health capabilities)."""
+        return self._healthy
+
+    def _fail_all(self, msg: str):
+        for i, slot in enumerate(self._slots):
+            if slot.active:
+                # Carry the partial progress: a consumer (and the
+                # coordinator's resubmit rule) must be able to tell a
+                # zero-token death from a mid-stream one.
+                slot.handle._push(
+                    StreamEvent(
+                        slot.request.request_id,
+                        finish_reason=FinishReason.ERROR,
+                        error=msg,
+                        num_prompt_tokens=len(slot.request.prompt_tokens),
+                        num_generated_tokens=slot.generated,
+                    )
+                )
+                # An ERROR terminal is as finished as any other — the
+                # books must balance for every accepted submit.
+                self.metrics["requests_finished"] += 1
+                self._release_slot_seed(slot)
+                slot.clear()

@@ -20,6 +20,7 @@ import time
 from dataclasses import dataclass, field
 from typing import Optional, Sequence
 
+from omnia_tpu.engine.faults import FaultPlan
 from omnia_tpu.engine.tokenizer import ByteTokenizer
 from omnia_tpu.engine.types import (
     FinishReason,
@@ -79,11 +80,24 @@ class MockEngine:
     """Drop-in scripted engine (no device, no model)."""
 
     def __init__(self, scenarios: Sequence[Scenario] = (), tokenizer=None,
-                 kv_quant=None):
+                 kv_quant=None, fault_plan: Optional[FaultPlan] = None,
+                 max_queue: int = 0, watchdog_s: Optional[float] = None):
         self.scenarios = list(scenarios)
         self.tokenizer = tokenizer or ByteTokenizer()
         self._req_counter = itertools.count()
         self._lock = threading.Lock()
+        # Request-lifecycle parity with InferenceEngine (chaos harness):
+        # a counted FaultPlan (engine/faults.py) injects deaths/hangs/
+        # flaky submits; max_queue bounds concurrent playbacks the same
+        # way the engine bounds its waiting queue; watchdog_s converts a
+        # hung dispatch (an injected hang past the bound) into the same
+        # ERROR terminal + watchdog_trips count the engine produces.
+        self.fault_plan = fault_plan
+        self.max_queue = max_queue
+        self.watchdog_s = watchdog_s
+        self._healthy = True
+        self._draining = False
+        self._live_plays = 0
         # int8-KV parity (models/kv_quant.py): the mock has no cache,
         # but with kv_quant set it round-trips a deterministic pseudo-KV
         # block per request through the SAME rowwise quantize/dequant
@@ -111,6 +125,11 @@ class MockEngine:
             "kv_quant_enabled": 1 if kv_quant else 0,
             "kv_quant_rows_written": 0,
             "kv_quant_roundtrip_rel_err": 0.0,
+            # Request-lifecycle parity (same semantics as the engine's
+            # counters — the chaos suite reconciles against these).
+            "requests_shed": 0,
+            "deadline_exceeded": 0,
+            "watchdog_trips": 0,
         }
         self._gr_mask_sum = 0.0
         self._gr_mask_steps = 0
@@ -156,8 +175,19 @@ class MockEngine:
         so tier-1 tests exercise the full constrained path hermetically."""
         return True
 
+    def healthy(self) -> bool:
+        """Interface parity with InferenceEngine; chaos tests flip the
+        backing flag to simulate worker death/flap."""
+        return self._healthy
+
     def queue_depth(self) -> int:
-        return 0
+        # Only meaningful under bounded admission: live playbacks stand
+        # in for the engine's waiting queue (with max_queue=0 the mock
+        # keeps its historical always-idle signal).
+        if self.max_queue <= 0:
+            return 0
+        with self._lock:
+            return self._live_plays
 
     def active_slots(self) -> int:
         return 0
@@ -168,9 +198,12 @@ class MockEngine:
         params: SamplingParams = SamplingParams(),
         session_id: Optional[str] = None,
         grammar=None,
+        deadline_s: Optional[float] = None,
     ) -> RequestHandle:
         # session_id accepted for interface parity with InferenceEngine;
         # the mock replays scenarios statelessly, so it is ignored.
+        if self.fault_plan is not None and self.fault_plan.take_submit_fault():
+            raise RuntimeError("injected flaky submit (FaultPlan)")
         rid = f"mock-{next(self._req_counter)}"
         handle = RequestHandle(rid)
         # Mirror InferenceEngine.submit's validation (and its metric
@@ -199,17 +232,38 @@ class MockEngine:
                 StreamEvent(rid, finish_reason=FinishReason.ERROR, error=error)
             )
             return handle
+        # Bounded admission / drain parity AFTER validation (the
+        # engine's ordering: a bad request is ERROR even at a full
+        # queue). Check-and-reserve in ONE critical section so
+        # concurrent submits can never overshoot max_queue.
         with self._lock:
-            self.metrics["requests_submitted"] += 1
+            if self._draining or (0 < self.max_queue <= self._live_plays):
+                self.metrics["requests_shed"] += 1
+                why = (
+                    "engine draining (stop(drain=True))" if self._draining
+                    else f"queue full (max_queue={self.max_queue})"
+                )
+            else:
+                why = None
+                self.metrics["requests_submitted"] += 1
+                self._live_plays += 1
+        if why is not None:
+            handle._push(
+                StreamEvent(rid, finish_reason=FinishReason.OVERLOADED, error=why)
+            )
+            return handle
         if grammar is not None:
             from omnia_tpu.engine.grammar.cache import stats
 
             with self._lock:
                 self.metrics["grammar_compile_hits"] = stats["hits"]
                 self.metrics["grammar_compile_misses"] = stats["misses"]
+        deadline_at = (
+            time.monotonic() + deadline_s if deadline_s is not None else None
+        )
         thread = threading.Thread(
-            target=self._play,
-            args=(rid, list(prompt_tokens), params, handle, grammar),
+            target=self._play_guarded,
+            args=(rid, list(prompt_tokens), params, handle, grammar, deadline_at),
             daemon=True,
         )
         thread.start()
@@ -219,10 +273,20 @@ class MockEngine:
         return self.submit(prompt_tokens, params).collect_tokens(timeout=30)
 
     def start(self):
-        pass
+        self._draining = False
 
-    def stop(self):
-        pass
+    def stop(self, drain: bool = False, drain_timeout_s: float = 30.0):
+        """Interface parity: drain stops admission (submit sheds
+        OVERLOADED) and waits out live playbacks, bounded."""
+        if not drain:
+            return
+        self._draining = True
+        deadline = time.monotonic() + drain_timeout_s
+        while time.monotonic() < deadline:
+            with self._lock:
+                if self._live_plays == 0:
+                    return
+            time.sleep(0.002)
 
     def _scenario_for(self, prompt: str) -> Scenario:
         turn_view = _current_turn_view(prompt)
@@ -267,54 +331,112 @@ class MockEngine:
                 self.metrics["grammar_rejections_avoided"] += 1
         return toks
 
+    def _play_guarded(self, rid, prompt_tokens, params, handle, grammar,
+                      deadline_at):
+        try:
+            self._play(rid, prompt_tokens, params, handle, grammar, deadline_at)
+        finally:
+            with self._lock:
+                self._live_plays -= 1
+
+    def _finish(self, handle, rid, reason, n_prompt, generated, error=None):
+        """Push the terminal event and keep the books balanced: every
+        accepted submit reaches exactly one finish count, whatever the
+        reason (the documented requests_finished semantics)."""
+        handle._push(
+            StreamEvent(
+                rid, finish_reason=reason, error=error,
+                num_prompt_tokens=n_prompt, num_generated_tokens=generated,
+            )
+        )
+        with self._lock:
+            self.metrics["requests_finished"] += 1
+
     def _play(self, rid, prompt_tokens, params, handle: RequestHandle,
-              grammar=None):
+              grammar=None, deadline_at=None):
         prompt = self.tokenizer.decode(prompt_tokens)
         scenario = self._scenario_for(prompt)
-        if scenario.ttft_s:
-            time.sleep(scenario.ttft_s)
+        fault = self.fault_plan
+        n_prompt = len(prompt_tokens)
+        # Hung-dispatch parity: an injected hang past watchdog_s fails
+        # the request at the watchdog bound (the engine's trip path),
+        # never after the full hang — bounded client latency.
+        hang = fault.take_hang_s() if fault is not None else 0.0
+        if hang > 0.0 and self.watchdog_s is not None and hang > self.watchdog_s:
+            time.sleep(self.watchdog_s)
+            with self._lock:
+                self.metrics["watchdog_trips"] += 1
+            self._finish(
+                handle, rid, FinishReason.ERROR, n_prompt, 0,
+                error=f"dispatch hung > watchdog_s={self.watchdog_s}",
+            )
+            return
+        time.sleep(hang + scenario.ttft_s)
         if scenario.error is not None:
-            handle._push(
-                StreamEvent(rid, finish_reason=FinishReason.ERROR, error=scenario.error)
+            # Scripted errors model DETERMINISTIC provider failures
+            # (they would recur identically on any worker), so they keep
+            # num_prompt_tokens=0 — the coordinator's resubmit
+            # discriminator must not reclassify them as worker deaths
+            # and replay the scenario on another worker. Only FaultPlan
+            # deaths and watchdog trips carry the accepted-prompt marker.
+            self._finish(
+                handle, rid, FinishReason.ERROR, 0, 0, error=scenario.error,
             )
             return
         reply_ids = self.tokenizer.encode(scenario.reply, add_bos=False)
         if grammar is not None:
             reply_ids = self._constrained_reply(reply_ids, params, grammar)
         reply_ids = reply_ids[: params.max_tokens]
+        # Worker-death injection: decided ONCE per playback so the
+        # chaos suite's counts are exact; the request emits its first
+        # die_after_tokens tokens and then the "worker" dies mid-stream
+        # (0 = death before any token — the resubmittable case).
+        die_after = (
+            fault.die_after_tokens
+            if fault is not None and fault.take_death()
+            else None
+        )
         # Every row the real engine would write (prompt prefill + each
         # decoded token) round-trips through the int8 scheme host-side.
         self._kv_roundtrip(prompt_tokens + reply_ids)
         generated = 0
+        if die_after == 0:
+            self._finish(
+                handle, rid, FinishReason.ERROR, n_prompt, 0,
+                error="injected worker death (FaultPlan)",
+            )
+            return
         for tok in reply_ids:
             if handle.cancelled:
-                handle._push(
-                    StreamEvent(
-                        rid,
-                        finish_reason=FinishReason.CANCELLED,
-                        num_prompt_tokens=len(prompt_tokens),
-                        num_generated_tokens=generated,
-                    )
+                self._finish(
+                    handle, rid, FinishReason.CANCELLED, n_prompt, generated
                 )
                 return
-            if scenario.delay_per_token_s:
-                time.sleep(scenario.delay_per_token_s)
+            if deadline_at is not None and time.monotonic() >= deadline_at:
+                with self._lock:
+                    self.metrics["deadline_exceeded"] += 1
+                self._finish(
+                    handle, rid, FinishReason.DEADLINE, n_prompt, generated
+                )
+                return
+            delay = scenario.delay_per_token_s
+            if fault is not None:
+                delay += fault.slow_sync_s
+            if delay:
+                time.sleep(delay)
             handle._push(StreamEvent(rid, token_id=tok))
             generated += 1
             with self._lock:
                 self.metrics["tokens_generated"] += 1
+            if die_after is not None and generated >= die_after:
+                self._finish(
+                    handle, rid, FinishReason.ERROR, n_prompt, generated,
+                    error="injected worker death (FaultPlan)",
+                )
+                return
         reason = (
             FinishReason.LENGTH
             if len(reply_ids) >= params.max_tokens
             else FinishReason.STOP
         )
-        handle._push(
-            StreamEvent(
-                rid,
-                finish_reason=reason,
-                num_prompt_tokens=len(prompt_tokens),
-                num_generated_tokens=generated,
-            )
-        )
-        with self._lock:
-            self.metrics["requests_finished"] += 1
+        self._finish(handle, rid, reason, n_prompt, generated)

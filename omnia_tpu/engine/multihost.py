@@ -79,6 +79,15 @@ class LockstepEngine:
         # flips, the platform reschedules) and fails every live handle
         # so no client blocks past the bound.
         self.tick_timeout_s = tick_timeout_s
+        if getattr(getattr(engine, "cfg", None), "watchdog_s", None) is not None:
+            # A wall-clock watchdog trip on ONE rank would recover that
+            # rank alone and diverge the replicated step streams — the
+            # tick watchdog below owns hang detection in lockstep.
+            logger.warning(
+                "EngineConfig.watchdog_s is set under lockstep replication; "
+                "per-rank watchdog trips can diverge ranks — prefer "
+                "tick_timeout_s and leave watchdog_s=None"
+            )
         self._last_tick = None  # set when the loop starts ticking
         self._wedged = False
         self._monitor: Optional[threading.Thread] = None
@@ -100,7 +109,8 @@ class LockstepEngine:
     # -- leader public surface (engine duck type) -----------------------
 
     def submit(self, prompt_tokens, params: SamplingParams = SamplingParams(),
-               session_id: Optional[str] = None) -> RequestHandle:
+               session_id: Optional[str] = None,
+               deadline_s: Optional[float] = None) -> RequestHandle:
         assert self.is_leader, "submit() is leader-only; followers replicate"
         handle = _LeaderHandle(self)
         if self._wedged:
@@ -124,6 +134,14 @@ class LockstepEngine:
                 "seed": params.seed,
             },
             "session_id": session_id,
+            # Deadline/shed decisions replicate BY CONSTRUCTION, like
+            # register_prefix: the TTL rides the submit event, every
+            # rank applies it at the same tick, and the engine anchors
+            # deadline_at to the leader-broadcast logical clock — so
+            # queue sheds (max_queue) and deadline reaps happen at the
+            # same step on every rank, keeping the compiled-step
+            # streams aligned.
+            "deadline_s": deadline_s,
             "tag": id(handle),
         }
         raw = json.dumps(event).encode()
@@ -370,7 +388,8 @@ class LockstepEngine:
                 seed=p["seed"],
             )
             real = self.engine.submit(ev["prompt"], sp,
-                                      session_id=ev["session_id"])
+                                      session_id=ev["session_id"],
+                                      deadline_s=ev.get("deadline_s"))
             self._handles[real.request_id] = real
             if self.is_leader:
                 wrapper = self._tagged.pop(ev["tag"], None)

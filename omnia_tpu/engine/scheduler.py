@@ -14,11 +14,13 @@ single steps so a waiting prefill never sits out a full chunk.
 
 from __future__ import annotations
 
+import threading
 import time
 from typing import Optional
 
 import numpy as np
 
+from omnia_tpu.engine.faults import WatchdogTimeout
 from omnia_tpu.engine.types import FinishReason, StreamEvent
 
 
@@ -35,6 +37,7 @@ class _SchedulerMixin:
         self._drain_releases()
         self._drain_prefix_regs()
         self._reap_cancelled()
+        self._reap_deadlines()
         did = False
         with self._lock:
             queued = bool(self._waiting)
@@ -59,6 +62,7 @@ class _SchedulerMixin:
             with self._lock:
                 try:
                     self._waiting.remove(pending)
+                    self._placing += 1
                 except ValueError:
                     pending = None  # reaped concurrently
         if pending is not None:
@@ -80,13 +84,22 @@ class _SchedulerMixin:
                         request.request_id,
                         finish_reason=FinishReason.ERROR,
                         error="prefill failed",
+                        # Accepted-and-placed marker: a nonzero prompt
+                        # count tells the coordinator this is a worker
+                        # fault (resubmittable), not a validation
+                        # rejection that would recur anywhere.
+                        num_prompt_tokens=len(request.prompt_tokens),
                     )
                 )
+                self.metrics["requests_finished"] += 1
                 self._drop_session(request.session_id)
                 self._slots[slot_idx].session_id = None
                 self._release_slot_seed(self._slots[slot_idx])
                 self._slots[slot_idx].clear()
                 raise
+            finally:
+                with self._lock:
+                    self._placing -= 1
             did = True
         if any(s.active for s in self._slots):
             with self._lock:
@@ -194,6 +207,83 @@ class _SchedulerMixin:
                 else:
                     still.append((req, handle))
             self._waiting = still
+
+    def _reap_deadlines(self):
+        """Deadline enforcement at the step boundary: queued requests
+        past their TTL shed with DEADLINE before placement (they would
+        only add latency), and an active slot past its TTL finishes
+        early with its partial output (chunk granularity — the boundary
+        is checked between dispatches, not inside a compiled chunk).
+        Requests without a deadline cost one attribute check here —
+        deadline_s=None traffic takes the pre-existing path exactly."""
+        now = None
+        for i, slot in enumerate(self._slots):
+            if slot.active and slot.request.deadline_at is not None:
+                now = self.clock() if now is None else now
+                if now >= slot.request.deadline_at:
+                    self.metrics["deadline_exceeded"] += 1
+                    self._finish_slot(i, FinishReason.DEADLINE)
+        with self._lock:
+            if not any(r.deadline_at is not None for r, _h in self._waiting):
+                return
+            now = self.clock() if now is None else now
+            still = []
+            for req, handle in self._waiting:
+                if req.deadline_at is not None and now >= req.deadline_at:
+                    handle._push(
+                        StreamEvent(
+                            req.request_id,
+                            finish_reason=FinishReason.DEADLINE,
+                            num_prompt_tokens=len(req.prompt_tokens),
+                        )
+                    )
+                    # Shed-from-queue is still a terminal: every submit
+                    # reaches exactly one final event and one finish.
+                    self.metrics["deadline_exceeded"] += 1
+                    self.metrics["requests_finished"] += 1
+                else:
+                    still.append((req, handle))
+            self._waiting = still
+
+    def _sync_chunk_host(self, toks) -> np.ndarray:
+        """Device→host read of a decode chunk's tokens, optionally under
+        the hung-dispatch watchdog. watchdog_s=None is the direct
+        pre-existing sync (no thread); with a watchdog the sync runs in
+        a short-lived thread and a read that outlives watchdog_s raises
+        WatchdogTimeout — the loop's recovery path fails in-flight
+        handles and reallocates device state, so a hung device bounds
+        client latency instead of freezing the engine silently."""
+        fault = self._fault_plan
+        wd = self.cfg.watchdog_s
+        if wd is None:
+            if fault is not None:
+                time.sleep(fault.take_hang_s() + fault.slow_sync_s)
+            return np.asarray(toks)
+        box: list = []
+
+        def sync():
+            if fault is not None:
+                # Inside the timed thread: an injected hang must look
+                # exactly like a hung device sync to the watchdog.
+                time.sleep(fault.take_hang_s() + fault.slow_sync_s)
+            try:
+                box.append(np.asarray(toks))
+            except Exception as e:  # noqa: BLE001 - re-raised on the engine thread
+                box.append(e)
+
+        t = threading.Thread(target=sync, name="omnia-chunk-sync", daemon=True)
+        t.start()
+        t.join(timeout=wd)
+        if not box:
+            self.metrics["watchdog_trips"] += 1
+            self._healthy = False  # readiness flips for the incident;
+            # _recover restores it once device state reallocates.
+            raise WatchdogTimeout(
+                f"decode chunk host sync exceeded watchdog_s={wd}"
+            )
+        if isinstance(box[0], Exception):
+            raise box[0]
+        return box[0]
 
     def _run_decode_step(self, single: bool = False, chunk: Optional[int] = None):
         """One chunked decode dispatch → host tokens [K, B]. Position
@@ -304,7 +394,7 @@ class _SchedulerMixin:
     def _process_oldest_chunk(self):
         toks, active = self._inflight.popleft()
         t_sync = time.monotonic()
-        host_tokens = np.asarray(toks)  # [K, B] — ONE sync per chunk
+        host_tokens = self._sync_chunk_host(toks)  # [K, B] — ONE sync per chunk
         self.metrics["decode_sync_s"] += time.monotonic() - t_sync
         for k in range(host_tokens.shape[0]):
             for i, rid in active:

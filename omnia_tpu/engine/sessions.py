@@ -213,6 +213,19 @@ class _SessionMixin:
         for sid in released:
             self._drop_session(sid)
 
+    def _offload_idle_sessions(self) -> int:
+        """Page every idle resident session's KV rows to host RAM — the
+        graceful-drain tail (stop(drain=True)): device state is about to
+        go away with the process, host pages survive a restart handoff.
+        Only callable once the engine loop is not stepping (the caller
+        owns device state)."""
+        n = 0
+        for sess in list(self._sessions.values()):
+            if sess.slot is not None and not self._slots[sess.slot].active:
+                self._offload_session(sess)
+                n += 1
+        return n
+
     def _enforce_session_cap(self, protect: Optional[str] = None) -> None:
         """Drop least-recently-used sessions above max_sessions. Sessions
         with a decoding request — and the one currently being placed

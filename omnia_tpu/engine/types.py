@@ -38,6 +38,13 @@ class FinishReason(enum.Enum):
     LENGTH = "length"      # hit max_tokens or context limit
     CANCELLED = "cancelled"
     ERROR = "error"
+    # Request-lifecycle robustness terminals: a request past its TTL is
+    # shed from the queue (or finished early mid-decode), and a request
+    # hitting a full queue / saturated fleet / draining engine is shed
+    # at admission. Both are FAST, OBSERVABLE degradation — the caller
+    # gets a terminal event immediately instead of unbounded latency.
+    DEADLINE = "deadline"
+    OVERLOADED = "overloaded"
 
 
 @dataclasses.dataclass
@@ -56,6 +63,12 @@ class Request:
     # host-side unconditionally).
     grammar: Optional[object] = None
     submitted_at: float = dataclasses.field(default_factory=time.monotonic)
+    # Absolute deadline in the ENGINE's clock domain (engine.clock() at
+    # submit + deadline_s) — self.clock, not time.monotonic, so
+    # replicated engines (multihost lockstep) reap deadlines from the
+    # leader-broadcast logical clock and every rank decides identically.
+    # None = no deadline (the guarded default).
+    deadline_at: Optional[float] = None
 
 
 @dataclasses.dataclass(frozen=True)
@@ -242,6 +255,22 @@ class EngineConfig:
     # applied inside sample_tokens_per_slot (no host round-trip), and
     # the FSM state advances on the sampled token.
     grammar: bool = False
+    # Bounded admission: submit() fast-fails with FinishReason.OVERLOADED
+    # once this many requests are already waiting — overload degrades to
+    # an immediate, observable shed instead of unbounded queue latency
+    # (the KEDA-style backpressure signal turned into a hard bound).
+    # 0 = unbounded (the guarded pre-existing behavior).
+    max_queue: int = 0
+    # Hung-dispatch watchdog: a decode chunk whose device→host sync
+    # exceeds this many seconds trips WatchdogTimeout — the engine marks
+    # itself unhealthy, fails in-flight handles, and takes the existing
+    # crash-recovery path (device state reallocation; health restores on
+    # success). Costs one short-lived sync thread per chunk while
+    # enabled. None = no watchdog threads, direct sync (the guarded
+    # default). Leave None under multihost lockstep: a wall-clock trip
+    # on one rank would diverge the replicated step streams (the tick
+    # watchdog in multihost.py owns that failure class).
+    watchdog_s: Optional[float] = None
     # State capacity of one slot's device transition table. Grammars
     # needing more states are rejected at submit. Device memory cost is
     # num_slots × grammar_max_states × vocab_size × 4 bytes — size it

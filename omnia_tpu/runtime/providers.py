@@ -65,7 +65,11 @@ def build_engine(spec: ProviderSpec, *, warmup: bool = False):
         scenarios = [Scenario(**s) for s in spec.options.get("scenarios", [])]
         # kv_quant forwards for parity: the mock mirrors the int8 KV
         # round-trip host-side (engine/mock.py) with unchanged output.
-        return MockEngine(scenarios, kv_quant=spec.options.get("kv_quant"))
+        return MockEngine(
+            scenarios, kv_quant=spec.options.get("kv_quant"),
+            max_queue=spec.options.get("max_queue", 0),
+            watchdog_s=spec.options.get("watchdog_s"),
+        )
     if spec.type == "tpu":
         from omnia_tpu.models import PRESETS, get_config
 
@@ -78,7 +82,11 @@ def build_engine(spec: ProviderSpec, *, warmup: bool = False):
                      "prefix_cache_slots", "prefix_cache_rows",
                      "prefix_cache_publish_threshold",
                      "prefix_cache_min_tokens", "prefix_cache_host_entries",
-                     "grammar", "grammar_max_states"}
+                     "grammar", "grammar_max_states",
+                     # Request-lifecycle hardening knobs (both default
+                     # to the guarded no-op): bounded admission and the
+                     # hung-dispatch watchdog.
+                     "max_queue", "watchdog_s"}
         }
         if "prefill_buckets" in eng_kwargs:
             eng_kwargs["prefill_buckets"] = tuple(eng_kwargs["prefill_buckets"])

@@ -217,6 +217,61 @@ def test_kv_quant_none_is_true_noop():
     assert isinstance(q8._ck, QuantKV) and q8._ck.q.dtype == jnp.int8
 
 
+def test_lifecycle_knobs_off_are_true_noop():
+    """ISSUE 7 guard: deadline_s=None / max_queue=0 / watchdog_s=None
+    must trace ZERO new operands and change ZERO behavior. The whole
+    hardening layer is host-side by design, so even knobs-ON engines
+    lower byte-identical decode programs; knobs-off engines must also
+    take the exact pre-existing host paths (no watchdog threads, no
+    deadline state, zero-valued counters) and emit identical tokens."""
+    from omnia_tpu.engine import EngineConfig, InferenceEngine, SamplingParams
+    from omnia_tpu.models import get_config
+
+    base = dict(num_slots=2, max_seq=64, prefill_buckets=(8,),
+                dtype="float32", max_sessions=0)
+    off = InferenceEngine(get_config("test-tiny"), EngineConfig(**base), seed=3)
+    on = InferenceEngine(
+        get_config("test-tiny"),
+        EngineConfig(**base, max_queue=4, watchdog_s=30.0), seed=3,
+    )
+
+    def lowered(eng):
+        return eng._decode_fn_single.lower(
+            eng.params, eng._ck, eng._cv, eng._tokens, eng._positions,
+            eng._active, eng._budget, eng._stop_ids, eng._key_data,
+            eng._temp, eng._top_p, eng._top_k,
+        ).as_text()
+
+    # Zero new operands: the compiled decode program is byte-identical
+    # whether the lifecycle knobs are on or off.
+    assert lowered(off) == lowered(on)
+
+    # Zero behavior change: a deadline-less request on the knobs-off
+    # engine carries no deadline state and produces the same greedy
+    # tokens as the knobs-on engine (the knobs only ever bite when a
+    # deadline/TTL/overload actually occurs).
+    sp = SamplingParams(temperature=0.0, max_tokens=8)
+    h = off.submit([1, 2, 3], sp)
+    with off._lock:
+        assert off._waiting[0][0].deadline_at is None
+    import threading as _threading
+
+    t_off, _ = off.generate([4, 5, 6], sp)
+    t_on, _ = on.generate([4, 5, 6], sp)
+    assert t_off == t_on
+    while off.step():
+        pass
+    h.collect_tokens(timeout=5)
+    # watchdog_s=None syncs inline: no omnia-chunk-sync thread ever ran.
+    assert not [
+        t for t in _threading.enumerate() if t.name == "omnia-chunk-sync"
+    ]
+    # The always-present counters exist and stayed zero on both engines.
+    for eng in (off, on):
+        for key in ("requests_shed", "deadline_exceeded", "watchdog_trips"):
+            assert eng.metrics[key] == 0, (key, eng.metrics[key])
+
+
 def test_no_silent_broad_except():
     """Broad handlers (`except Exception:`/bare `except:`) followed by a
     bare `pass` with no comment swallow faults silently — they must log

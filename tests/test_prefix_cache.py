@@ -469,6 +469,7 @@ class TestMetricsKeyStability:
         "masked_logit_fraction", "grammar_rejections_avoided",
         "kv_quant_enabled", "kv_quant_bytes_per_token",
         "kv_quant_device_bytes",
+        "requests_shed", "deadline_exceeded", "watchdog_trips",
     }
 
     def test_engine_metric_keys_are_stable(self):

@@ -164,7 +164,10 @@ def test_coordinator_concurrent_routing_and_failover():
         w._healthy = True
         w.healthy = (lambda w=w: w._healthy)  # type: ignore[assignment]
         w.start()
-    coord = EngineCoordinator(workers)
+    # probe_interval_s=0 restores this test's original per-request
+    # health reads: a 2 ms flap must be OBSERVED by routing, which the
+    # production-default probe cache would legitimately smooth over.
+    coord = EngineCoordinator(workers, probe_interval_s=0.0)
     stop = threading.Event()
 
     def flapper():
@@ -206,6 +209,102 @@ def test_coordinator_concurrent_routing_and_failover():
     with coord._lock:
         assert all(0 <= idx < 3 for idx in coord._affinity.values())
         assert set(coord._affinity.values()) - {0}, coord._affinity
+
+
+def test_coordinator_submit_failover_metrics_reconcile_exactly():
+    """16-thread submit against a fleet where one worker flaps health
+    AND kills a bounded number of requests pre-token: every submit
+    reaches exactly ONE clean terminal, and the coordinator's ledger
+    (routed / resubmits / shed) reconciles EXACTLY with the terminal
+    events and the fault plan's fired counts (ISSUE 7 satellite)."""
+    from omnia_tpu.engine.coordinator import EngineCoordinator
+    from omnia_tpu.engine.faults import FaultPlan
+    from omnia_tpu.engine.mock import MockEngine, Scenario
+
+    THREADS, PER = 16, 8
+    # Worker 0 kills its first 20 requests before the first token —
+    # every one is coordinator-resubmittable, and the counted plan lets
+    # the reconciliation below be exact instead of statistical.
+    plan = FaultPlan(die_after_tokens=0, die_count=20)
+    workers = [
+        MockEngine([Scenario(".", "w")],
+                   fault_plan=plan if i == 0 else None)
+        for i in range(3)
+    ]
+    for w in workers:
+        w.start()
+    # probe_interval_s=0: every routing decision sees live health, so
+    # the flapping worker actually takes traffic whenever it is up
+    # (cached probes could otherwise park it down for the whole storm).
+    coord = EngineCoordinator(workers, resubmit_retries=2,
+                              probe_interval_s=0.0)
+    # Deterministic teeth BEFORE the flap starts: worker 0 is healthy
+    # and least-loaded ties route to the lowest index, so these all hit
+    # the fault, die pre-token, and resubmit — the ledger below can
+    # never trivially pass on a fault that no request ever reached.
+    for k in range(4):
+        toks, fin = coord.submit([9, k], SamplingParams(max_tokens=2)
+                                 ).collect_tokens(timeout=30)
+        assert fin.finish_reason.value in ("length", "stop"), fin
+    assert plan.fired["deaths"] == 4
+    assert coord.metrics["resubmits"] == 4
+    stop = threading.Event()
+
+    def flapper():
+        import time as _t
+
+        while not stop.is_set():
+            workers[0]._healthy = not workers[0]._healthy
+            _t.sleep(0.002)
+
+    flap = threading.Thread(target=flapper)
+    flap.start()
+    errors: list[str] = []
+    finals: list = []
+    finals_lock = threading.Lock()
+
+    def submitter(i: int):
+        try:
+            for j in range(PER):
+                h = coord.submit([1 + i, 2 + j], SamplingParams(max_tokens=2),
+                                 session_id=f"rx-{(i + j) % 6}")
+                toks, fin = h.collect_tokens(timeout=30)
+                with finals_lock:
+                    finals.append(fin)
+                # Two workers never fault: with resubmit, every request
+                # must end CLEAN — an ERROR/None means a death leaked
+                # through or a terminal was lost.
+                if fin.finish_reason is None or fin.finish_reason.value not in (
+                    "length", "stop",
+                ):
+                    errors.append(f"bad finish: {fin.finish_reason}")
+        except Exception as e:  # noqa: BLE001 - collected for the assert
+            errors.append(repr(e))
+
+    with concurrent.futures.ThreadPoolExecutor(THREADS) as ex:
+        list(ex.map(submitter, range(THREADS)))
+    stop.set()
+    flap.join(timeout=5)
+    for w in workers:
+        w.stop()
+    assert not errors, errors[:5]
+    total = THREADS * PER + 4  # storm + the deterministic warmup
+    # Exactly one terminal per submit, all clean.
+    assert len(finals) == THREADS * PER
+    # Exact ledger reconciliation: every submit routed once (nothing
+    # shed — no queue bounds configured), and every injected pre-token
+    # death was resubmitted exactly once.
+    assert coord.metrics["routed"] == total
+    assert coord.metrics["shed"] == 0
+    assert coord.metrics["resubmits"] == plan.fired["deaths"] >= 4
+    # Worker-side books balance too: accepted == finished on every
+    # worker (the deaths are ERROR terminals, counted as finished).
+    for w in workers:
+        assert w.metrics["requests_finished"] == w.metrics["requests_submitted"]
+    # And the per-request token streams stayed clean: total clean
+    # finishes == routed submits (deaths were absorbed, not surfaced).
+    clean = sum(f.finish_reason.value in ("length", "stop") for f in finals)
+    assert clean == THREADS * PER
 
 
 # ---------------------------------------------------------------------------
