@@ -272,10 +272,27 @@ class EngineCoordinator:
         with self._health_lock:
             return [i for i, st in enumerate(self._health) if st.up]
 
+    # Prompt tokens per request-equivalent of load: the token-backlog
+    # term is queued+in-flight PREFILL WORK, so four 8k-prompt requests
+    # (64 units) no longer route like four 10-token ones (~0). Sized so
+    # a typical short-chat prompt (hundreds of tokens) stays well under
+    # one queue-slot equivalent.
+    _PREFILL_BACKLOG_NORM = 512.0
+
     def _load(self, i: int) -> float:
+        """Worker load: queued + active requests, plus the prompt-token
+        backlog (queued prompts and the unconsumed tail of an in-flight
+        chunked prefill) in request-equivalents. Both the least-loaded
+        pick and the prefix-affinity spill threshold compare this
+        signal. Workers predating ``pending_prefill_tokens`` keep the
+        count-only load (a supported duck type, like stop(drain=))."""
         w = self.workers[i]
         try:
-            return w.queue_depth() + w.active_slots()
+            load = float(w.queue_depth() + w.active_slots())
+            pending = getattr(w, "pending_prefill_tokens", None)
+            if pending is not None:
+                load += pending() / self._PREFILL_BACKLOG_NORM
+            return load
         except Exception:
             return float("inf")
 

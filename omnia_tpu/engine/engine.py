@@ -50,6 +50,7 @@ import jax
 import jax.numpy as jnp
 
 from omnia_tpu.engine.faults import FaultPlan
+from omnia_tpu.engine.interleave import _InflightPrefill, _InterleaveMixin
 from omnia_tpu.engine.lifecycle import _LifecycleMixin
 from omnia_tpu.engine.placement import _PlacementMixin
 from omnia_tpu.engine.prefix_cache import PrefixPool, _PrefixCacheMixin
@@ -86,7 +87,7 @@ logger = logging.getLogger(__name__)
 
 class InferenceEngine(
     _SchedulerMixin, _SessionMixin, _SpecDecodeMixin, _PrefixCacheMixin,
-    _PlacementMixin, _LifecycleMixin,
+    _PlacementMixin, _InterleaveMixin, _LifecycleMixin,
 ):
     """Slot-based continuous-batching engine over one model."""
 
@@ -213,6 +214,10 @@ class InferenceEngine(
         # Dispatched-but-unread decode chunks: (token futures, active
         # snapshot). Engine-thread-owned.
         self._inflight: collections.deque = collections.deque()
+        # Token-budget interleaving (engine/interleave.py): the at-most-
+        # one placement currently mid-interleave. Always None with
+        # prefill_chunk_tokens=0 — every interleave path is then dead.
+        self._prefilling: Optional[_InflightPrefill] = None
 
         self._thread: Optional[threading.Thread] = None
         self._stop_event = threading.Event()
@@ -270,6 +275,15 @@ class InferenceEngine(
             "requests_shed": 0,
             "deadline_exceeded": 0,
             "watchdog_trips": 0,
+            # Stall-free batching (engine/interleave.py): mixed_steps =
+            # fused prefill+decode dispatches, interleaved_prefill_tokens
+            # = prompt tokens consumed by them (metered per piece — exact
+            # under mid-prefill aborts), decode_stall_steps = prefill
+            # dispatches that idled a live decode batch (the prefill-
+            # first cost the token-budget policy drives to zero).
+            "mixed_steps": 0,
+            "interleaved_prefill_tokens": 0,
+            "decode_stall_steps": 0,
             # Grammar-constrained decoding (engine/grammar/).
             # compile_hits/misses mirror the process-global grammar
             # compile cache (content-addressed, key-stable across
@@ -316,6 +330,8 @@ class InferenceEngine(
         self._prefix_store_fn = progs.prefix_store
         self._prefix_seed_fn = progs.prefix_seed
         self._prefix_offload_fn = progs.prefix_offload
+        self._mixed_fns = progs.mixed
+        self._mixed_sample_fns = progs.mixed_sample
         from omnia_tpu.ops.attention import pallas_decode_mode
 
         logger.info(
@@ -481,6 +497,30 @@ class InferenceEngine(
                 self._ck, self._cv, _, _ = self._extend_fn(
                     self.params, self._ck, self._cv, toks, pos, zero, zero, zero, *sargs
                 )
+        gargs = (
+            (self._gstate, self._gtable, self._gactive) if self._gr_on else ()
+        )
+        for b in self.cfg.mixed_prefill_buckets():
+            # Fused mixed prefill+decode steps (token-budget
+            # interleaving): warm both variants per piece bucket with
+            # the request path's exact operand types (strong int32
+            # piece arrays/scalars, the `sargs` sampling family).
+            toks = jnp.zeros((1, b), jnp.int32)
+            pos = jnp.arange(b, dtype=jnp.int32)[None, :]
+            out = self._mixed_fns[b](
+                self.params, self._ck, self._cv, self._tokens,
+                self._positions, self._active, self._budget, self._stop_ids,
+                self._key_data, self._temp, self._top_p, self._top_k,
+                toks, pos, zero, zero, *gargs,
+            )
+            self._ck, self._cv = out[0], out[1]
+            out = self._mixed_sample_fns[b](
+                self.params, self._ck, self._cv, self._tokens,
+                self._positions, self._active, self._budget, self._stop_ids,
+                self._key_data, self._temp, self._top_p, self._top_k,
+                toks, pos, zero, zero, jnp.int32(b - 1), *sargs, *gargs,
+            )
+            self._ck, self._cv = out[0], out[1]
         if sessions:
             for r in self.cfg.restore_buckets():
                 k, v = self._offload_fn(self._ck, self._cv, zero, r)
@@ -667,6 +707,9 @@ class InferenceEngine(
         hygiene: live handles must never be evicted)."""
         with self._lock:
             waiting = {req.request_id for req, _h in self._waiting}
+        pf = self._prefilling
+        if pf is not None:
+            waiting.add(pf.request.request_id)  # mid-interleave placement
         return waiting | {
             s.request.request_id for s in self._slots if s.active
         }

@@ -1,0 +1,371 @@
+"""Stall-free batching: token-budget interleaving of prefill and decode.
+
+With ``EngineConfig.prefill_chunk_tokens > 0`` the scheduler drops the
+prefill-first policy for placements that would stall live decode:
+the prompt splits into pieces of at most the per-step token budget, and
+every piece rides a FUSED device dispatch (the ``mixed`` program family
+in programs.py) that also advances all active decode slots by one
+token. Decode inter-token latency under arriving traffic is then
+bounded by ONE mixed step — never a whole prefill — and the decode
+pipeline stays at full depth while requests queue: the old
+degrade-to-synchronous-single-steps path is gone entirely. A request
+waiting on a SLOT (every slot busy) gets the pipeline flushed each
+step so finishes surface promptly, but chunks stay full-size — slot
+turnover detection may lag by up to one chunk, the deliberate price
+for not cratering decode throughput exactly when the engine is
+saturated.
+
+Invariants this module maintains:
+
+- **Bit-exactness.** A piece runs the same extend-seam op graph as the
+  monolithic chunked extend, and the fused decode step is the same scan
+  body as the chunked decode programs, so interleaved serving emits
+  bit-identical tokens and KV rows to prefill-first serving
+  (tests/test_interleave.py pins it, including under kv_quant="int8"
+  and with grammar slots in the batch).
+- **Garbage rows.** The in-placement slot is inactive during every
+  mixed step's decode half; its frozen position is parked at the
+  piece's END, so the decode garbage write lands at the new frontier —
+  overwritten by the next piece or by the first real decode write after
+  activation. Garbage only ever lives at rows ≥ the consumed frontier.
+- **Exact partial books.** ``prefill_tokens`` /
+  ``interleaved_prefill_tokens`` count per consumed piece and a
+  session's ``token_ids`` advance with the frontier, so a deadline or
+  cancel landing mid-prefill leaves exact counts and genuinely-valid
+  reusable rows behind.
+
+At most ONE prefill is in flight at a time (``self._prefilling``); the
+knob off means the attribute stays None and every path in this module
+is dead — the guarded no-op contract (tests/test_guards.py).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from omnia_tpu.engine.types import (
+    MAX_DEVICE_STOP_IDS,
+    FinishReason,
+    Request,
+    RequestHandle,
+    StreamEvent,
+)
+
+
+@dataclasses.dataclass
+class _InflightPrefill:
+    """A placement mid-interleave: claimed slot + remaining piece plan."""
+
+    slot_idx: int
+    request: Request
+    handle: RequestHandle
+    sess: Optional[object]          # _SessionKV or None
+    pieces: list                    # [(offset, real_len, bucket)]
+    next_piece: int = 0
+    frontier: int = 0               # rows known valid (reuse/seed + consumed)
+
+    @property
+    def prompt(self) -> list[int]:
+        return self.request.prompt_tokens
+
+
+class _InterleaveMixin:
+    """Mixed-step scheduling methods of :class:`InferenceEngine`."""
+
+    def _mixed_enabled(self) -> bool:
+        return self.cfg.prefill_chunk_tokens > 0
+
+    def pending_prefill_tokens(self) -> int:
+        """Prompt-token backlog: queued prompts plus the unconsumed tail
+        of the in-flight interleaved prefill. The coordinator folds this
+        into its load signal so four 8k-prompt requests no longer route
+        like four 10-token ones."""
+        with self._lock:
+            backlog = sum(len(r.prompt_tokens) for r, _h in self._waiting)
+        pf = self._prefilling
+        if pf is not None:
+            backlog += max(len(pf.prompt) - pf.frontier, 0)
+        return backlog
+
+    # -- step loop ------------------------------------------------------
+
+    def _step_mixed(self) -> bool:
+        """One scheduling step under the token-budget policy."""
+        did = False
+        if self._prefilling is None:
+            pending, slot_idx = self._claim_pending()
+            if pending is not None:
+                did = True
+                request, handle = pending
+                if any(s.active for s in self._slots):
+                    self._begin_interleaved_prefill(slot_idx, request, handle)
+                else:
+                    # Nothing to stall: monolithic placement is strictly
+                    # better (no per-piece dispatch overhead, no garbage
+                    # decode forward over an all-idle batch).
+                    self._place_pending(slot_idx, request, handle)
+        pf = self._prefilling
+        if pf is not None:
+            # One mixed dispatch: the next prompt piece rides the same
+            # program as this step's decode token. Pipelined exactly
+            # like decode chunks — the token read is deferred.
+            try:
+                self._dispatch_mixed(pf)
+            except Exception:
+                self._fail_prefilling("prefill failed")
+                raise
+            while len(self._inflight) >= max(1, self.cfg.decode_pipeline):
+                self._process_oldest_chunk()
+            return True
+        if any(s.active for s in self._slots):
+            if self._spec_applicable():
+                self._spec_verify_step()
+                return True
+            with self._lock:
+                queued = bool(self._waiting)
+            if queued and self._inflight:
+                # The queue is waiting on a SLOT here (a placeable
+                # request would have begun interleaving above), so
+                # surface in-flight finishes promptly — but keep
+                # dispatching FULL chunks: prefill waits never degrade
+                # the chunk pipeline under the token-budget policy.
+                self._flush_pipeline()
+            if self._inflight and not self._dispatch_ahead_useful():
+                self._process_oldest_chunk()
+            else:
+                self._dispatch_decode()
+                while len(self._inflight) >= max(1, self.cfg.decode_pipeline):
+                    self._process_oldest_chunk()
+            return True
+        if self._inflight:
+            self._process_oldest_chunk()
+            return True
+        return did
+
+    # -- placement ------------------------------------------------------
+
+    def _budget_pieces(self, start: int, count: int) -> list[tuple[int, int, int]]:
+        """Plan (offset, real_len, bucket) pieces covering prompt[start:
+        start+count], each consuming at most ``prefill_chunk_tokens``
+        prompt tokens — the per-step budget. Same no-write-past-max_seq
+        degrade as ``_extend_pieces``: a bucket-padded write must never
+        cross the cache end, so the tail degrades to 1-token pieces."""
+        buckets = sorted(self.cfg.usable_buckets())
+        budget = self.cfg.prefill_chunk_tokens
+        S = self.cfg.max_seq
+        pieces = []
+        pos, left = start, count
+        while left > 0:
+            take = min(left, budget, buckets[-1])
+            b = self.cfg.bucket_for(take)
+            if pos + b > S:
+                b = 1
+                take = 1
+            pieces.append((pos, take, b))
+            pos += take
+            left -= take
+        return pieces
+
+    def _begin_interleaved_prefill(
+        self, slot_idx: int, request: Request, handle: RequestHandle
+    ) -> None:
+        """Claim the slot and plan the piece schedule; the per-piece
+        dispatches happen one per step in ``_dispatch_mixed``. The
+        ``_placing`` claim taken by ``_claim_pending`` is held for the
+        WHOLE interleave (queue-invisible, slot-invisible work — drain
+        and recovery must see it)."""
+        try:
+            prompt = request.prompt_tokens
+            slot_idx, sess, reuse = self._prepare_session_slot(
+                slot_idx, request
+            )
+            t0 = time.monotonic()
+            seeded = 0
+            if reuse == 0:
+                seeded = self._try_seed_from_pool(slot_idx, prompt, sess)
+            self.metrics["prefill_dispatch_s"] += time.monotonic() - t0
+            self.metrics["prefix_reuse_tokens"] += reuse
+            frontier = reuse or seeded
+            if sess is not None:
+                # Truncate to the reuse frontier NOW: the pieces below
+                # overwrite rows from `frontier` on, so any longer stale
+                # claim (a diverged previous turn) must drop before the
+                # first piece lands.
+                sess.token_ids = list(prompt[:frontier])
+            self._prefilling = _InflightPrefill(
+                slot_idx=slot_idx, request=request, handle=handle, sess=sess,
+                pieces=self._budget_pieces(frontier, len(prompt) - frontier),
+                frontier=frontier,
+            )
+        except Exception:
+            self._fail_placement(slot_idx, request, handle, "prefill failed")
+            with self._lock:
+                self._placing -= 1
+            raise
+
+    def _dispatch_mixed(self, pf: _InflightPrefill) -> None:
+        """One fused dispatch: the next prompt piece + one decode step
+        for every active slot. The decode token read is deferred to
+        ``_process_oldest_chunk`` like any decode chunk."""
+        off, take, bucket = pf.pieces[pf.next_piece]
+        final = pf.next_piece == len(pf.pieces) - 1
+        active = [
+            (i, s.request.request_id)
+            for i, s in enumerate(self._slots) if s.active
+        ]
+        # Park the in-placement slot's frozen decode-write row at the
+        # piece's END: the fused program runs the extend half first, so
+        # the decode half's garbage write lands at the NEW frontier —
+        # the row the next piece (or the first real decode write after
+        # activation) overwrites.
+        self._positions = self._positions.at[pf.slot_idx].set(off + take)
+        toks = np.zeros((1, bucket), np.int32)
+        toks[0, :take] = pf.prompt[off:off + take]
+        ppos = (off + np.arange(bucket, dtype=np.int32))[None, :]
+        args = (
+            self.params, self._ck, self._cv, self._tokens, self._positions,
+            self._active, self._budget, self._stop_ids, self._key_data,
+            self._temp, self._top_p, self._top_k,
+            jnp.asarray(toks), jnp.asarray(ppos),
+            jnp.int32(pf.slot_idx), jnp.int32(off),
+        )
+        gargs = (
+            (self._gstate, self._gtable, self._gactive) if self._gr_on else ()
+        )
+        t_dispatch = time.monotonic()
+        first_tok = new_pkd = None
+        if final:
+            sp = pf.request.params
+            kd = self._sampling_key(pf.slot_idx, sp)
+            out = self._mixed_sample_fns[bucket](
+                *args, jnp.int32(take - 1), kd, jnp.float32(sp.temperature),
+                jnp.float32(sp.top_p), jnp.int32(sp.top_k),
+                *self._grammar_args(pf.request, sp), *gargs,
+            )
+            first_tok, new_pkd = out[-2], out[-1]
+            out = out[:-2]
+        else:
+            out = self._mixed_fns[bucket](*args, *gargs)
+        if self._gr_on:
+            (self._ck, self._cv, self._tokens, self._positions, self._active,
+             self._budget, self._key_data, self._gstate, dtoks) = out
+        else:
+            (self._ck, self._cv, self._tokens, self._positions, self._active,
+             self._budget, self._key_data, dtoks) = out
+        self.metrics["decode_dispatch_s"] += time.monotonic() - t_dispatch
+        self.metrics["decode_steps"] += 1
+        self.metrics["mixed_steps"] += 1
+        self.metrics["interleaved_prefill_tokens"] += take
+        self.metrics["prefill_tokens"] += take
+        self._inflight.append((dtoks, active))
+        pf.next_piece += 1
+        pf.frontier = off + take
+        if pf.sess is not None:
+            # Each consumed piece's rows are genuinely valid prompt KV:
+            # recording them incrementally keeps a mid-prefill abort
+            # (deadline/cancel) exact — the next turn reuses [0,
+            # frontier) instead of re-prefilling the whole prompt.
+            pf.sess.token_ids = list(pf.prompt[:pf.frontier])
+            pf.sess.last_used = self.clock()
+        if final:
+            self._complete_interleaved(pf, first_tok, new_pkd)
+
+    def _complete_interleaved(self, pf, first_tok, new_pkd) -> None:
+        """The final piece sampled the first token: activate the slot —
+        the back half of ``_place_request``, against the mixed program's
+        already-advanced decode state."""
+        slot_idx, request, handle = pf.slot_idx, pf.request, pf.handle
+        sp = request.params
+        prompt = pf.prompt
+        n = len(prompt)
+        slot = self._slots[slot_idx]
+        slot.request = request
+        slot.handle = handle
+        slot.length = n
+        slot.generated = 0
+        slot.emitted = []
+        slot.max_total = sp.max_tokens
+        stop_ids = frozenset(sp.stop_token_ids)
+        if request.grammar is not None:
+            # Same rule as monolithic placement: the grammar's eos id
+            # must finish the slot even when the caller's stop set
+            # omits it (see _place_request).
+            stop_ids |= {request.grammar.eos_id}
+        slot.stop_ids = stop_ids
+        if pf.sess is not None:
+            pf.sess.token_ids = list(prompt)
+        self._maybe_publish_prefix(slot_idx, prompt)
+        self.metrics["prefill_steps"] += 1
+
+        self._tokens = self._tokens.at[slot_idx].set(first_tok)
+        self._key_data = self._key_data.at[slot_idx].set(new_pkd)
+        # positions[slot_idx] already sits at n — the final piece's
+        # frontier, where the first real decode write lands.
+        self._active = self._active.at[slot_idx].set(True)
+        self._temp = self._temp.at[slot_idx].set(sp.temperature)
+        self._top_p = self._top_p.at[slot_idx].set(sp.top_p)
+        self._top_k = self._top_k.at[slot_idx].set(sp.top_k)
+        budget = min(sp.max_tokens - 1, self.cfg.max_seq - 2 - n)
+        self._budget = self._budget.at[slot_idx].set(max(budget, 0))
+        ids = list(sp.stop_token_ids)
+        if request.grammar is not None and request.grammar.eos_id not in ids:
+            ids.append(request.grammar.eos_id)
+        ids = ids[:MAX_DEVICE_STOP_IDS]
+        ids += [-1] * (MAX_DEVICE_STOP_IDS - len(ids))
+        self._stop_ids = self._stop_ids.at[slot_idx].set(
+            jnp.asarray(ids, jnp.int32)
+        )
+        self._prefilling = None
+        with self._lock:
+            self._placing -= 1
+        first = int(first_tok)
+        self._attach_grammar(slot_idx, request, first)
+        self._emit_token(slot_idx, first)
+
+    # -- abort / failure ------------------------------------------------
+
+    def _abort_prefilling(self, reason: FinishReason) -> None:
+        """Terminal for a half-prefilled request (deadline reap or
+        cancel): the consumed rows stay valid for the session — books
+        were advanced per piece, so partial counts are already exact —
+        and the slot quiesces at the consumed frontier."""
+        pf = self._prefilling
+        self._prefilling = None
+        slot = self._slots[pf.slot_idx]
+        pf.handle._push(
+            StreamEvent(
+                pf.request.request_id,
+                finish_reason=reason,
+                num_prompt_tokens=len(pf.prompt),
+            )
+        )
+        self.metrics["requests_finished"] += 1
+        quiesce_row = 0
+        if pf.sess is not None:
+            # token_ids already reads prompt[:frontier]; the rows below
+            # it are genuine prompt KV the next turn can reuse.
+            quiesce_row = len(pf.sess.token_ids)
+        else:
+            self._release_slot_seed(slot)
+        slot.clear()
+        self._positions = self._positions.at[pf.slot_idx].set(quiesce_row)
+        with self._lock:
+            self._placing -= 1
+
+    def _fail_prefilling(self, msg: str) -> None:
+        """Hard-failure terminal for the in-flight prefill (a raised
+        dispatch or recovery/_fail_all): the shared monolithic
+        prefill-failure surface, with the accepted-and-placed prompt
+        marker so the coordinator resubmits."""
+        pf = self._prefilling
+        if pf is None:
+            return
+        self._prefilling = None
+        self._fail_placement(pf.slot_idx, pf.request, pf.handle, msg)
+        with self._lock:
+            self._placing -= 1

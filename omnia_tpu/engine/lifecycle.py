@@ -145,6 +145,10 @@ class _LifecycleMixin:
         return self._healthy
 
     def _fail_all(self, msg: str):
+        # A half-prefilled placement (token-budget interleaving) is
+        # neither queued nor active — fail it explicitly or its handle
+        # would hang past recovery/drain.
+        self._fail_prefilling(msg)
         for i, slot in enumerate(self._slots):
             if slot.active:
                 # Carry the partial progress: a consumer (and the

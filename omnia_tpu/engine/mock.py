@@ -81,11 +81,22 @@ class MockEngine:
 
     def __init__(self, scenarios: Sequence[Scenario] = (), tokenizer=None,
                  kv_quant=None, fault_plan: Optional[FaultPlan] = None,
-                 max_queue: int = 0, watchdog_s: Optional[float] = None):
+                 max_queue: int = 0, watchdog_s: Optional[float] = None,
+                 prefill_chunk_tokens: int = 0):
         self.scenarios = list(scenarios)
         self.tokenizer = tokenizer or ByteTokenizer()
         self._req_counter = itertools.count()
         self._lock = threading.Lock()
+        # Stall-free batching parity (engine/interleave.py): with a
+        # token budget, each playback's "prefill" books the same
+        # mixed-step/interleaved-token counts the real engine meters per
+        # consumed piece; budget 0 mirrors prefill-first — a playback
+        # whose prefill lands while other playbacks are live counts a
+        # decode stall, exactly the signal the budget exists to zero.
+        self.prefill_chunk_tokens = prefill_chunk_tokens
+        # Prompt-token backlog mirror for the coordinator's token-aware
+        # load signal (live playbacks' prompt tokens).
+        self._live_prompt_tokens = 0
         # Request-lifecycle parity with InferenceEngine (chaos harness):
         # a counted FaultPlan (engine/faults.py) injects deaths/hangs/
         # flaky submits; max_queue bounds concurrent playbacks the same
@@ -130,6 +141,10 @@ class MockEngine:
             "requests_shed": 0,
             "deadline_exceeded": 0,
             "watchdog_trips": 0,
+            # Stall-free batching parity (engine/interleave.py).
+            "mixed_steps": 0,
+            "interleaved_prefill_tokens": 0,
+            "decode_stall_steps": 0,
         }
         self._gr_mask_sum = 0.0
         self._gr_mask_steps = 0
@@ -192,6 +207,13 @@ class MockEngine:
     def active_slots(self) -> int:
         return 0
 
+    def pending_prefill_tokens(self) -> int:
+        """Prompt-token backlog of live playbacks — the mock's mirror of
+        the engine's queued+in-flight prefill work, so the coordinator's
+        token-aware load signal is exercisable hermetically."""
+        with self._lock:
+            return self._live_prompt_tokens
+
     def submit(
         self,
         prompt_tokens: list[int],
@@ -247,6 +269,7 @@ class MockEngine:
                 why = None
                 self.metrics["requests_submitted"] += 1
                 self._live_plays += 1
+                self._live_prompt_tokens += len(prompt_tokens)
         if why is not None:
             handle._push(
                 StreamEvent(rid, finish_reason=FinishReason.OVERLOADED, error=why)
@@ -338,6 +361,7 @@ class MockEngine:
         finally:
             with self._lock:
                 self._live_plays -= 1
+                self._live_prompt_tokens -= len(prompt_tokens)
 
     def _finish(self, handle, rid, reason, n_prompt, generated, error=None):
         """Push the terminal event and keep the books balanced: every
@@ -372,6 +396,19 @@ class MockEngine:
             )
             return
         time.sleep(hang + scenario.ttft_s)
+        # Stall-free batching mirror: this is the playback's "prefill"
+        # moment. With a token budget the prompt books ceil(n/budget)
+        # mixed steps and its full token count (identical to the real
+        # engine's per-piece metering); prefill-first instead counts a
+        # decode stall whenever other playbacks are live to be stalled.
+        with self._lock:
+            if self.prefill_chunk_tokens > 0:
+                self.metrics["mixed_steps"] += -(
+                    -n_prompt // self.prefill_chunk_tokens
+                )
+                self.metrics["interleaved_prefill_tokens"] += n_prompt
+            elif self._live_plays > 1:
+                self.metrics["decode_stall_steps"] += 1
         if scenario.error is not None:
             # Scripted errors model DETERMINISTIC provider failures
             # (they would recur identically on any worker), so they keep

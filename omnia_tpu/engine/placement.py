@@ -149,10 +149,14 @@ class _PlacementMixin:
         key_data = self._key_data.at[slot_idx].set(new_kd)
         return ck, cv, tok, key_data
 
-    def _place_request(self, slot_idx: int, request: Request, handle: RequestHandle):
-        """Prefill a request into a slot: fresh single-bucket prefill when
-        there is no reusable prefix and the prompt fits one bucket,
-        otherwise chunked incremental extend from the reuse frontier."""
+    def _prepare_session_slot(
+        self, slot_idx: int, request: Request
+    ):
+        """Session front-half of placement, shared by the monolithic and
+        the interleaved (engine/interleave.py) paths: look up / create
+        the session record, compute the resident-row LCP reuse, restore
+        a host-paged session, and pin the slot. Returns the (possibly
+        re-targeted) ``(slot_idx, sess, reuse)``."""
         prompt = request.prompt_tokens
         n = len(prompt)
         sess = None
@@ -181,6 +185,15 @@ class _PlacementMixin:
             slot_idx = sess.slot
             if reuse == 0:
                 sess.token_ids = []
+        return slot_idx, sess, reuse
+
+    def _place_request(self, slot_idx: int, request: Request, handle: RequestHandle):
+        """Prefill a request into a slot: fresh single-bucket prefill when
+        there is no reusable prefix and the prompt fits one bucket,
+        otherwise chunked incremental extend from the reuse frontier."""
+        prompt = request.prompt_tokens
+        n = len(prompt)
+        slot_idx, sess, reuse = self._prepare_session_slot(slot_idx, request)
 
         sp = request.params
         usable = self.cfg.usable_buckets()
@@ -192,11 +205,21 @@ class _PlacementMixin:
         if reuse == 0:
             seeded = self._try_seed_from_pool(slot_idx, prompt, sess)
         frontier = reuse or seeded
+        # Prefill-first bookkeeping: every prefill forward dispatched
+        # while a decode slot sits live is a stall step — the decode
+        # batch idles for the whole dispatch. The token-budget policy
+        # (engine/interleave.py) exists to drive this to zero.
+        stalled = any(s.active for s in self._slots)
+        ext0 = self.metrics["extend_steps"]
         if frontier == 0 and n <= max(usable):
             first_tok = self._fresh_prefill(slot_idx, prompt, sp, request)
         else:
             first_tok = self._chunked_extend(
                 slot_idx, prompt, frontier, sp, request
+            )
+        if stalled:
+            self.metrics["decode_stall_steps"] += max(
+                self.metrics["extend_steps"] - ext0, 1
             )
         self._maybe_publish_prefix(slot_idx, prompt)
         self.metrics["prefill_dispatch_s"] += time.monotonic() - t_prefill

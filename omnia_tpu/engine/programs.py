@@ -19,6 +19,15 @@ through):
 - ``decode_fns`` — chunked decode: `k` decode steps in one compiled
   ``lax.scan`` program per chunk-size variant, with stop-token/length
   finishes masked ON DEVICE so mid-chunk finishes stop writing rows.
+- ``mixed`` / ``mixed_sample`` — stall-free batching
+  (``prefill_chunk_tokens > 0``): per prefill-piece bucket, ONE fused
+  dispatch that runs a bounded prompt piece through the extend seam
+  into the in-placement slot's rows AND advances every active decode
+  slot by one token (the same scan body as ``decode_fns``, length 1).
+  ``mixed_sample`` is the final-piece variant — it additionally samples
+  the placed request's first token (with the grammar start-state bias,
+  like ``extend``). An arriving prefill then costs decode at most one
+  mixed step of latency instead of a full prefill stall.
 - ``extend`` / ``extend_nosample`` — sessionful incremental prefill:
   run a prompt suffix through ``forward`` against the slot's EXISTING
   rows (cross-attention to history) from the reuse frontier; batch-1 on
@@ -77,6 +86,10 @@ class EnginePrograms:
     prefix_store: Optional[Callable]
     prefix_seed: Optional[Callable]
     prefix_offload: Optional[Callable]
+    # Fused mixed prefill+decode steps, one per prefill-piece bucket
+    # (prefill_chunk_tokens > 0, else both dicts are empty).
+    mixed: dict[int, Callable]
+    mixed_sample: dict[int, Callable]
 
 
 def build_programs(
@@ -143,6 +156,75 @@ def build_programs(
 
     max_seq = ecfg.max_seq
 
+    def _mk_step_body(params, stop_ids, temp, top_p, top_k,
+                      gtable=None, gactive=None, grammar_on=False):
+        """One decode step as a ``lax.scan`` body — the SINGLE source of
+        the decode-step math, shared by the chunked decode programs and
+        the fused mixed prefill+decode programs (interleaved and
+        monolithic serving must stay bit-identical, so there is exactly
+        one place the step semantics live)."""
+
+        def body(carry, _):
+            if grammar_on:
+                (ck, cv, tokens, positions, active, budget, key_data,
+                 gstate) = carry
+            else:
+                ck, cv, tokens, positions, active, budget, key_data = carry
+            logits, ck, cv = llama.forward(
+                params, cfg, tokens[:, None], positions[:, None], ck, cv,
+                positions
+            )
+            if grammar_on:
+                # One table row per slot, unrolled over the static
+                # batch dim: XLA CPU lowers gather (vmapped
+                # dynamic_index, take_along_axis) to an O(table)
+                # walk — cost grew with grammar_max_states — while a
+                # dynamic_slice per slot is an O(V) copy regardless
+                # of table size.
+                nvocab = gtable.shape[-1]
+                row = jnp.stack([
+                    jax.lax.dynamic_slice(
+                        gtable, (b, gstate[b], 0), (1, 1, nvocab)
+                    )[0, 0]
+                    for b in range(gtable.shape[0])
+                ])  # [B, V]
+                bias = jnp.where(
+                    gactive[:, None] & (row < 0), _NEG_INF, 0.0
+                )
+                tok, key_data = sample_tokens_per_slot(
+                    logits[:, 0], key_data, temp, top_p, top_k,
+                    mask_bias=bias,
+                )
+                # State advances on the sampled token, gated like
+                # the position advance (active at step START); a
+                # masked token cannot be sampled, so row[tok] >= 0
+                # for any gactive slot — the max(·, 0) only covers
+                # inactive slots' garbage samples.
+                nxt = jnp.take_along_axis(row, tok[:, None], axis=1)[:, 0]
+                gstate = jnp.where(
+                    gactive & active, jnp.maximum(nxt, 0), gstate
+                )
+            else:
+                tok, key_data = sample_tokens_per_slot(
+                    logits[:, 0], key_data, temp, top_p, top_k
+                )
+            # Position advances for the row just written (gated on
+            # active at step START); deactivation applies from the
+            # NEXT step on, mirroring the host's finish bookkeeping.
+            positions = jnp.where(
+                active, jnp.minimum(positions + 1, max_seq - 1), positions
+            )
+            budget = budget - active.astype(jnp.int32)
+            hit_stop = (tok[:, None] == stop_ids).any(axis=1)
+            active = active & ~hit_stop & (budget > 0)
+            tokens = jnp.where(active | hit_stop, tok, tokens)
+            out = (ck, cv, tokens, positions, active, budget, key_data)
+            if grammar_on:
+                out += (gstate,)
+            return out, tok
+
+        return body
+
     def make_decode(chunk: int):
         def decode_impl(params, ck, cv, tokens, positions, active, budget,
                         stop_ids, key_data, temp, top_p, top_k,
@@ -170,66 +252,10 @@ def build_programs(
             in the same batch samples exactly as the plain program
             would."""
             grammar_on = gstate is not None
-
-            def body(carry, _):
-                if grammar_on:
-                    (ck, cv, tokens, positions, active, budget, key_data,
-                     gstate) = carry
-                else:
-                    ck, cv, tokens, positions, active, budget, key_data = carry
-                logits, ck, cv = llama.forward(
-                    params, cfg, tokens[:, None], positions[:, None], ck, cv,
-                    positions
-                )
-                if grammar_on:
-                    # One table row per slot, unrolled over the static
-                    # batch dim: XLA CPU lowers gather (vmapped
-                    # dynamic_index, take_along_axis) to an O(table)
-                    # walk — cost grew with grammar_max_states — while a
-                    # dynamic_slice per slot is an O(V) copy regardless
-                    # of table size.
-                    nvocab = gtable.shape[-1]
-                    row = jnp.stack([
-                        jax.lax.dynamic_slice(
-                            gtable, (b, gstate[b], 0), (1, 1, nvocab)
-                        )[0, 0]
-                        for b in range(gtable.shape[0])
-                    ])  # [B, V]
-                    bias = jnp.where(
-                        gactive[:, None] & (row < 0), _NEG_INF, 0.0
-                    )
-                    tok, key_data = sample_tokens_per_slot(
-                        logits[:, 0], key_data, temp, top_p, top_k,
-                        mask_bias=bias,
-                    )
-                    # State advances on the sampled token, gated like
-                    # the position advance (active at step START); a
-                    # masked token cannot be sampled, so row[tok] >= 0
-                    # for any gactive slot — the max(·, 0) only covers
-                    # inactive slots' garbage samples.
-                    nxt = jnp.take_along_axis(row, tok[:, None], axis=1)[:, 0]
-                    gstate = jnp.where(
-                        gactive & active, jnp.maximum(nxt, 0), gstate
-                    )
-                else:
-                    tok, key_data = sample_tokens_per_slot(
-                        logits[:, 0], key_data, temp, top_p, top_k
-                    )
-                # Position advances for the row just written (gated on
-                # active at step START); deactivation applies from the
-                # NEXT step on, mirroring the host's finish bookkeeping.
-                positions = jnp.where(
-                    active, jnp.minimum(positions + 1, max_seq - 1), positions
-                )
-                budget = budget - active.astype(jnp.int32)
-                hit_stop = (tok[:, None] == stop_ids).any(axis=1)
-                active = active & ~hit_stop & (budget > 0)
-                tokens = jnp.where(active | hit_stop, tok, tokens)
-                out = (ck, cv, tokens, positions, active, budget, key_data)
-                if grammar_on:
-                    out += (gstate,)
-                return out, tok
-
+            body = _mk_step_body(
+                params, stop_ids, temp, top_p, top_k, gtable, gactive,
+                grammar_on,
+            )
             init = (ck, cv, tokens, positions, active, budget, key_data)
             if grammar_on:
                 init += (gstate,)
@@ -301,6 +327,80 @@ def build_programs(
         return ck, cv
 
     extend_nosample_fn = jax.jit(extend_nosample, donate_argnums=(1, 2))
+
+    # Stall-free batching: fused mixed prefill+decode steps. One program
+    # per prefill-piece bucket (and a *_sample twin for the final piece)
+    # so the ENTIRE per-step work — a bounded prompt piece for the
+    # in-placement slot AND one decode token for every active slot —
+    # costs a single dispatch round trip. The piece runs the extend seam
+    # FIRST (cache_take slot slice → forward with per-batch write offsets
+    # → cache_put), then the decode step runs over the updated cache: the
+    # in-placement slot is inactive during the decode part, so its frozen
+    # position (parked by the scheduler at the piece's END) receives one
+    # garbage row write at the NEW frontier — exactly the row the next
+    # piece, or the first real decode write after activation, overwrites.
+    # Both halves reuse their monolithic counterparts' exact op graphs
+    # (forward + _mk_step_body), which is what makes interleaved prefill
+    # bit-identical to monolithic prefill.
+    mixed_fns: dict[int, Callable] = {}
+    mixed_sample_fns: dict[int, Callable] = {}
+    if ecfg.prefill_chunk_tokens > 0:
+        def make_mixed(bucket: int, sample: bool):
+            grammar_on = bool(ecfg.grammar)
+
+            def mixed_step(params, ck, cv, tokens, positions, active,
+                           budget, stop_ids, key_data, temp, top_p, top_k,
+                           ptoks, ppos, pslot, pwrite, *rest):
+                rest = list(rest)
+                if grammar_on:
+                    gstate, gtable, gactive = rest[-3:]
+                    del rest[-3:]
+                else:
+                    gstate = gtable = gactive = None
+                # -- prefill piece via the extend seam ------------------
+                L, B, S, H, D = ck.shape
+                k_slot = cache_take(ck, (0, pslot, 0), (L, 1, S))
+                v_slot = cache_take(cv, (0, pslot, 0), (L, 1, S))
+                plogits, k_slot, v_slot = llama.forward(
+                    params, cfg, ptoks, ppos, k_slot, v_slot, pwrite[None]
+                )
+                ck = cache_put(ck, k_slot, (0, pslot, 0))
+                cv = cache_put(cv, v_slot, (0, pslot, 0))
+                extra = ()
+                if sample:
+                    # Final piece: sample the placed request's first
+                    # token (grammar start-state bias rides *pg, the
+                    # extend signature exactly).
+                    plast, pkd, ptemp, ptop_p, ptop_k = rest[:5]
+                    pg = tuple(rest[5:])
+                    last = jax.lax.dynamic_slice(
+                        plogits, (0, plast, 0), (1, 1, plogits.shape[-1])
+                    )[:, 0]
+                    ptok, new_pkd = sample_tokens_per_slot(
+                        last, pkd[None], ptemp[None], ptop_p[None],
+                        ptop_k[None], mask_bias=_first_bias(pg),
+                    )
+                    extra = (ptok[0], new_pkd[0])
+                # -- one decode step over the fixed batch ---------------
+                body = _mk_step_body(
+                    params, stop_ids, temp, top_p, top_k, gtable, gactive,
+                    grammar_on,
+                )
+                init = (ck, cv, tokens, positions, active, budget, key_data)
+                if grammar_on:
+                    init += (gstate,)
+                carry, toks = jax.lax.scan(body, init, None, length=1)
+                # toks [1, B] (+ first_tok, new_key_data on final pieces)
+                return carry + (toks,) + extra
+
+            mixed_step.__name__ = (
+                f"mixed_{'sample_' if sample else ''}{bucket}"
+            )
+            return jax.jit(mixed_step, donate_argnums=(1, 2))
+
+        for b in ecfg.mixed_prefill_buckets():
+            mixed_fns[b] = make_mixed(b, sample=False)
+            mixed_sample_fns[b] = make_mixed(b, sample=True)
 
     def offload(ck, cv, slot, rows: int):
         L, B, S, H, D = ck.shape
@@ -390,4 +490,6 @@ def build_programs(
         prefix_store=prefix_store_fn,
         prefix_seed=prefix_seed_fn,
         prefix_offload=prefix_offload_fn,
+        mixed=mixed_fns,
+        mixed_sample=mixed_sample_fns,
     )

@@ -1,15 +1,29 @@
 """Decode scheduler for the serving engine.
 
-Scheduling policy: prefill-first (favors TTFT over decode throughput;
-BASELINE.json north star is p50 TTFT < 400 ms), one prefill per step,
-then a decode step for all active slots.
+Scheduling policy, two editions selected by
+``EngineConfig.prefill_chunk_tokens``:
+
+- **0 (default): prefill-first** — one monolithic prefill per step,
+  then a decode step for all active slots. Favors TTFT, but every
+  arriving prompt stalls ALL active decode slots for its full prefill,
+  and while requests queue the pipeline degrades to synchronous single
+  steps.
+- **> 0: token-budget mixed steps** (engine/interleave.py) — prefills
+  split into budget-sized pieces and every piece FUSES into the same
+  dispatch as a one-token decode step for all active slots, so decode
+  never stalls for more than one mixed step and the chunk pipeline
+  stays at full depth while requests queue. Bit-identical output to
+  prefill-first (tests/test_interleave.py).
 
 Steady state keeps up to ``decode_pipeline`` chunks in flight: chunk
 N+1 is dispatched on chunk N's output *futures* before N's tokens are
 read, so the device never idles through the host's read-RTT +
 bookkeeping gap (the dominant per-chunk cost on a remote-dispatch
-link). While requests queue, the pipeline degrades to synchronous
-single steps so a waiting prefill never sits out a full chunk.
+link). While requests queue under prefill-first, the pipeline degrades
+to synchronous single steps so a waiting prefill never sits out a full
+chunk; under the token-budget policy a waiting prefill piggybacks on
+the next mixed step instead — requests waiting on a SLOT get a
+pipeline flush per step (finish surfacing) but chunks stay full-size.
 """
 
 from __future__ import annotations
@@ -38,6 +52,10 @@ class _SchedulerMixin:
         self._drain_prefix_regs()
         self._reap_cancelled()
         self._reap_deadlines()
+        if self._mixed_enabled():
+            # Token-budget policy (engine/interleave.py): prefills split
+            # into pieces fused with decode steps.
+            return self._step_mixed()
         did = False
         with self._lock:
             queued = bool(self._waiting)
@@ -46,60 +64,14 @@ class _SchedulerMixin:
             # their slots free up this step (TTFT over pipeline depth).
             self._flush_pipeline()
             did = True
-        with self._lock:
-            waiting = list(self._waiting)
-        # First PLACEABLE request, not just the head: a request whose
-        # session is still mid-decode must not head-of-line-block other
-        # sessions' requests while slots sit free.
-        pending = None
-        slot_idx = None
-        for cand in self._admission_order(waiting):
-            idx = self._slot_for(cand[0])
-            if idx is not None:
-                pending, slot_idx = cand, idx
-                break
-        if pending is not None:
-            with self._lock:
-                try:
-                    self._waiting.remove(pending)
-                    self._placing += 1
-                except ValueError:
-                    pending = None  # reaped concurrently
+        pending, slot_idx = self._claim_pending()
         if pending is not None:
             # Prefill/extend programs consume self._ck/_cv, which may be
             # futures from in-flight decode chunks — XLA sequences the
             # dependency, but host slot state must be current before
             # placement decisions stick, so the pipeline is already flushed
             # (the queued branch above ran whenever _waiting was non-empty).
-            try:
-                self._place_request(slot_idx, *pending)
-            except Exception:
-                # The request may not be attached to a slot yet, so
-                # recovery's _fail_all would never reach its handle —
-                # fail it here, then let the loop's recovery rebuild
-                # device state.
-                request, handle = pending
-                handle._push(
-                    StreamEvent(
-                        request.request_id,
-                        finish_reason=FinishReason.ERROR,
-                        error="prefill failed",
-                        # Accepted-and-placed marker: a nonzero prompt
-                        # count tells the coordinator this is a worker
-                        # fault (resubmittable), not a validation
-                        # rejection that would recur anywhere.
-                        num_prompt_tokens=len(request.prompt_tokens),
-                    )
-                )
-                self.metrics["requests_finished"] += 1
-                self._drop_session(request.session_id)
-                self._slots[slot_idx].session_id = None
-                self._release_slot_seed(self._slots[slot_idx])
-                self._slots[slot_idx].clear()
-                raise
-            finally:
-                with self._lock:
-                    self._placing -= 1
+            self._place_pending(slot_idx, *pending)
             did = True
         if any(s.active for s in self._slots):
             with self._lock:
@@ -127,6 +99,69 @@ class _SchedulerMixin:
             self._process_oldest_chunk()
             did = True
         return did
+
+    def _claim_pending(self):
+        """First PLACEABLE waiting request — not just the head: a
+        request whose session is still mid-decode must not
+        head-of-line-block other sessions' requests while slots sit
+        free. The winner is CLAIMED (removed from the queue, ``_placing``
+        incremented); returns ``(pending, slot_idx)`` or ``(None, None)``."""
+        with self._lock:
+            waiting = list(self._waiting)
+        pending = None
+        slot_idx = None
+        for cand in self._admission_order(waiting):
+            idx = self._slot_for(cand[0])
+            if idx is not None:
+                pending, slot_idx = cand, idx
+                break
+        if pending is not None:
+            with self._lock:
+                try:
+                    self._waiting.remove(pending)
+                    self._placing += 1
+                except ValueError:
+                    pending = None  # reaped concurrently
+        return pending, slot_idx
+
+    def _place_pending(self, slot_idx, request, handle):
+        """Monolithic placement with the prefill-failure error surface;
+        balances the ``_placing`` claim taken by ``_claim_pending``."""
+        try:
+            self._place_request(slot_idx, request, handle)
+        except Exception:
+            # The request may not be attached to a slot yet, so
+            # recovery's _fail_all would never reach its handle —
+            # fail it here, then let the loop's recovery rebuild
+            # device state.
+            self._fail_placement(slot_idx, request, handle, "prefill failed")
+            raise
+        finally:
+            with self._lock:
+                self._placing -= 1
+
+    def _fail_placement(self, slot_idx, request, handle, msg: str):
+        """Shared placement-failure surface (monolithic except, interleave
+        begin/dispatch failures, recovery's half-prefill path): terminal
+        ERROR, books balanced, session/seed/slot released. Callers own
+        the ``_placing`` release."""
+        handle._push(
+            StreamEvent(
+                request.request_id,
+                finish_reason=FinishReason.ERROR,
+                error=msg,
+                # Accepted-and-placed marker: a nonzero prompt
+                # count tells the coordinator this is a worker
+                # fault (resubmittable), not a validation
+                # rejection that would recur anywhere.
+                num_prompt_tokens=len(request.prompt_tokens),
+            )
+        )
+        self.metrics["requests_finished"] += 1
+        self._drop_session(request.session_id)
+        self._slots[slot_idx].session_id = None
+        self._release_slot_seed(self._slots[slot_idx])
+        self._slots[slot_idx].clear()
 
     # Admission fairness window: requests older than this keep strict
     # FIFO priority regardless of estimated prefill cost.
@@ -193,6 +228,11 @@ class _SchedulerMixin:
         for i, slot in enumerate(self._slots):
             if slot.active and slot.handle.cancelled:
                 self._finish_slot(i, FinishReason.CANCELLED)
+        pf = self._prefilling
+        if pf is not None and pf.handle.cancelled:
+            # Half-prefilled slot (token-budget interleaving): consumed
+            # rows stay valid for the session, books are already exact.
+            self._abort_prefilling(FinishReason.CANCELLED)
         with self._lock:
             still = []
             for req, handle in self._waiting:
@@ -223,6 +263,16 @@ class _SchedulerMixin:
                 if now >= slot.request.deadline_at:
                     self.metrics["deadline_exceeded"] += 1
                     self._finish_slot(i, FinishReason.DEADLINE)
+        pf = self._prefilling
+        if pf is not None and pf.request.deadline_at is not None:
+            now = self.clock() if now is None else now
+            if now >= pf.request.deadline_at:
+                # Deadline landed mid-prefill (token-budget
+                # interleaving): shed with exact partial counts — the
+                # pieces consumed so far were metered per dispatch and
+                # their rows stay valid for the session.
+                self.metrics["deadline_exceeded"] += 1
+                self._abort_prefilling(FinishReason.DEADLINE)
         with self._lock:
             if not any(r.deadline_at is not None for r, _h in self._waiting):
                 return

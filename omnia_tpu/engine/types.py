@@ -279,6 +279,19 @@ class EngineConfig:
     # 2237 states over the byte tokenizer); schema grammars typically
     # need well under 200.
     grammar_max_states: int = 2560
+    # Stall-free batching (engine/interleave.py): per-step prompt-token
+    # budget for MIXED prefill+decode dispatches. With a positive
+    # budget, an arriving prompt no longer stalls the decode batch for
+    # its full prefill: placement splits the prompt into pieces of at
+    # most this many tokens and every piece rides a fused program that
+    # also advances all active decode slots by one token — decode
+    # inter-token latency is bounded by ONE mixed step instead of a
+    # whole prefill, at the cost of one extra batch-decode forward per
+    # piece. Interleaved prefill is bit-identical to monolithic prefill
+    # (tests/test_interleave.py pins greedy tokens AND resident KV).
+    # 0 (default) is a guarded true no-op: no mixed programs are built
+    # and the scheduler keeps the exact prefill-first paths.
+    prefill_chunk_tokens: int = 0
 
     def chunk_variants(self) -> tuple[int, ...]:
         """Compiled decode-chunk sizes, descending, always containing
@@ -327,6 +340,18 @@ class EngineConfig:
             if n <= b:
                 return b
         return self.prefix_buckets()[-1]
+
+    def mixed_prefill_buckets(self) -> tuple[int, ...]:
+        """Prefill-piece buckets the fused mixed prefill+decode programs
+        compile for: every usable bucket a budget-sized piece can land
+        in, plus the 1-token degrade bucket used at the cache end (the
+        same no-write-past-max_seq discipline as ``_extend_pieces``).
+        () when interleaving is off — no mixed programs exist at all."""
+        usable = self.usable_buckets()
+        if self.prefill_chunk_tokens <= 0 or not usable:
+            return ()
+        cap = self.bucket_for(min(self.prefill_chunk_tokens, max(usable)))
+        return tuple(sorted({b for b in usable if b <= cap} | {1}))
 
     def usable_buckets(self) -> tuple[int, ...]:
         """Prefill buckets that fit the KV cache (a bucket's chunk is

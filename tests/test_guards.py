@@ -272,6 +272,56 @@ def test_lifecycle_knobs_off_are_true_noop():
             assert eng.metrics[key] == 0, (key, eng.metrics[key])
 
 
+def test_interleave_off_is_true_noop():
+    """ISSUE 8 guard: prefill_chunk_tokens=0 must build ZERO mixed
+    programs, never hold an in-flight interleaved prefill, and keep the
+    compiled decode family byte-identical to a knobs-on engine (the
+    feature only ADDS programs — the decode step body is shared, so the
+    lowered decode programs cannot differ either way) while emitting
+    identical greedy tokens through the monolithic paths."""
+    from omnia_tpu.engine import EngineConfig, InferenceEngine, SamplingParams
+    from omnia_tpu.models import get_config
+
+    base = dict(num_slots=2, max_seq=64, prefill_buckets=(8,),
+                dtype="float32", max_sessions=0)
+    off = InferenceEngine(get_config("test-tiny"), EngineConfig(**base), seed=3)
+    on = InferenceEngine(
+        get_config("test-tiny"),
+        EngineConfig(**base, prefill_chunk_tokens=4), seed=3,
+    )
+    # Knob off: no mixed programs exist, no interleave state ever forms.
+    assert off._mixed_fns == {} and off._mixed_sample_fns == {}
+    assert off.cfg.mixed_prefill_buckets() == ()
+    assert off._prefilling is None
+    # Knob on: the family exists per piece bucket (incl. the 1-token
+    # cache-end degrade bucket).
+    assert set(on._mixed_fns) == set(on.cfg.mixed_prefill_buckets()) != set()
+    assert set(on._mixed_sample_fns) == set(on._mixed_fns)
+
+    def lowered(eng):
+        return eng._decode_fn_single.lower(
+            eng.params, eng._ck, eng._cv, eng._tokens, eng._positions,
+            eng._active, eng._budget, eng._stop_ids, eng._key_data,
+            eng._temp, eng._top_p, eng._top_k,
+        ).as_text()
+
+    # The decode programs are byte-identical knob-on vs knob-off: the
+    # shared step body refactor changed nothing about their lowering.
+    assert lowered(off) == lowered(on)
+
+    # Identical greedy tokens (a solo request takes the monolithic path
+    # on both engines — interleaving only engages with live decode).
+    sp = SamplingParams(temperature=0.0, max_tokens=8)
+    t_off, _ = off.generate([4, 5, 6], sp)
+    t_on, _ = on.generate([4, 5, 6], sp)
+    assert t_off == t_on
+    # The always-present counters exist and stayed zero on the off
+    # engine (no stall possible: nothing was decoding).
+    for key in ("mixed_steps", "interleaved_prefill_tokens",
+                "decode_stall_steps"):
+        assert off.metrics[key] == 0, (key, off.metrics[key])
+
+
 def test_no_silent_broad_except():
     """Broad handlers (`except Exception:`/bare `except:`) followed by a
     bare `pass` with no comment swallow faults silently — they must log

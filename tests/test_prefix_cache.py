@@ -470,6 +470,7 @@ class TestMetricsKeyStability:
         "kv_quant_enabled", "kv_quant_bytes_per_token",
         "kv_quant_device_bytes",
         "requests_shed", "deadline_exceeded", "watchdog_trips",
+        "mixed_steps", "interleaved_prefill_tokens", "decode_stall_steps",
     }
 
     def test_engine_metric_keys_are_stable(self):
