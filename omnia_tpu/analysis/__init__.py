@@ -1,0 +1,40 @@
+"""omnia-analyze: repo-invariant static analysis.
+
+The Go reference gets `go vet` + `-race` + CGO_ENABLED=0 builds for
+free; this package is the Python/JAX rebuild's equivalent — an AST/CFG
+checker suite that turns the engine's by-convention invariants (each
+one a past bug class) into machine-checked rules:
+
+- **locks** — fields annotated ``# guarded-by: <lock>`` may only be
+  read/written inside the matching ``with self.<lock>:`` scope, and no
+  blocking call (worker RPC, device sync, ``time.sleep``) may run while
+  an engine/coordinator lock is held (the ``_pick`` bug class, PR 5).
+- **purity** — bodies traced by ``jax.jit`` / ``lax.scan`` /
+  ``shard_map`` / ``pallas_call`` must be host-side-effect free: no
+  ``time.*`` / ``random.*`` / ``print`` / ``.item()`` / ``np.asarray``
+  implicit syncs / Python-state mutation inside a traced body.
+- **guards** — every ``EngineConfig`` / ``MockEngine`` knob must map to
+  a registered knobs-off guard test (``tests/test_guards.py``
+  ``KNOB_GUARDS``), so "off = guarded true no-op" is a checked
+  contract, not a manually-remembered PR rule.
+- **metrics** — every metrics key written anywhere in ``engine/`` must
+  appear in the stable key registries (``TestMetricsKeyStability``) and
+  the ``docs/serving.md`` metrics table.
+- **jaxfree** — packages that are jax-free by contract
+  (``engine/grammar``) must never import jax (absorbed from
+  ``tests/test_guards.py``).
+
+Every checker honors explicit ``# analysis: allow(<rule>) — <reason>``
+waivers; the suite runs with ZERO unwaived findings (tier-1
+``tests/test_analysis.py`` + CI enforce it). Run locally with::
+
+    python -m omnia_tpu.analysis           # custom checkers
+    python -m omnia_tpu.analysis --all     # + ruff + mypy when installed
+
+This package must stay importable without jax (the CLI runs in CI
+containers with no accelerator stack): pure stdlib ``ast`` only.
+"""
+
+from omnia_tpu.analysis.core import Finding, Waiver, analyze_file_set, repo_root
+
+__all__ = ["Finding", "Waiver", "analyze_file_set", "repo_root"]

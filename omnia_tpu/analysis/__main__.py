@@ -1,0 +1,7 @@
+"""``python -m omnia_tpu.analysis`` entry point."""
+
+import sys
+
+from omnia_tpu.analysis.cli import main
+
+sys.exit(main())

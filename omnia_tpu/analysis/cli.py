@@ -1,0 +1,137 @@
+"""omnia-analyze CLI: run the repo-invariant checkers (+ ruff + mypy).
+
+Usage::
+
+    python -m omnia_tpu.analysis                 # custom checkers only
+    python -m omnia_tpu.analysis --all           # + ruff + mypy (gated)
+    python -m omnia_tpu.analysis --rule locks    # one checker
+    python -m omnia_tpu.analysis --root /path    # explicit checkout root
+
+Exit status 0 iff every checker ran with zero unwaived findings (and,
+under ``--all``, ruff/mypy passed when installed). ruff and mypy are
+GATED on availability: containers without them (the hermetic test image
+bakes neither) report "skipped (not installed)" and do not fail — CI
+installs both, so the full gate runs on every push/PR.
+"""
+
+from __future__ import annotations
+
+import argparse
+import shutil
+import subprocess
+import sys
+
+from omnia_tpu.analysis import guardcheck, jaxfree, locks, metricscheck, purity
+from omnia_tpu.analysis.core import (
+    Finding,
+    analyze_file_set,
+    apply_waivers,
+    parse_errors,
+    repo_root,
+    walk_py,
+)
+
+CHECKERS = ("locks", "purity", "guards", "metrics", "jaxfree")
+
+
+def run_checkers(
+    root: str, rules: tuple[str, ...] = CHECKERS
+) -> list[Finding]:
+    """Run the selected checkers over the checkout at ``root`` and
+    return findings with waivers applied (unused-waiver detection only
+    engages when every rule runs — a partial run can't tell stale from
+    out-of-scope)."""
+    pkg_files = walk_py(root, "omnia_tpu")
+    wanted: set[str] = set()
+    if "locks" in rules:
+        for _name, files in locks.LOCK_GROUPS:
+            wanted.update(files)
+    if "purity" in rules:
+        wanted.update(purity.purity_files(pkg_files))
+    if "guards" in rules:
+        wanted.update({
+            guardcheck.REGISTRY_FILE, guardcheck.ENGINE_CONFIG_FILE,
+            guardcheck.MOCK_FILE,
+        })
+    if "metrics" in rules:
+        wanted.update(metricscheck.ENGINE_FAMILY)
+        wanted.update({
+            metricscheck.MOCK_FILE, metricscheck.COORDINATOR_FILE,
+            metricscheck.REGISTRY_FILE,
+        })
+    if "jaxfree" in rules:
+        wanted.update(jaxfree.jaxfree_files(pkg_files))
+    sources = analyze_file_set(root, sorted(wanted))
+    findings = parse_errors(sources)
+    if "locks" in rules:
+        findings += locks.check_locks(sources)
+    if "purity" in rules:
+        findings += purity.check_purity(sources)
+    if "guards" in rules:
+        findings += guardcheck.check_guards(root, sources)
+    if "metrics" in rules:
+        findings += metricscheck.check_metrics(root, sources)
+    if "jaxfree" in rules:
+        findings += jaxfree.check_jaxfree(sources)
+    complete = set(rules) >= set(CHECKERS)
+    return apply_waivers(findings, sources, check_unused=complete)
+
+
+def _run_external(name: str, argv: list[str], root: str) -> int:
+    """Run an optional external tool; 0 = pass or not installed."""
+    if shutil.which(argv[0]) is None:
+        print(f"{name}: skipped (not installed — CI installs it)")
+        return 0
+    print(f"{name}: {' '.join(argv)}")
+    proc = subprocess.run(argv, cwd=root)
+    return proc.returncode
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m omnia_tpu.analysis",
+        description="Repo-invariant static analysis "
+        "(locks / purity / guards / metrics / jaxfree).",
+    )
+    parser.add_argument(
+        "--rule", action="append", choices=CHECKERS,
+        help="run only this checker (repeatable; default: all)",
+    )
+    parser.add_argument(
+        "--all", action="store_true",
+        help="also run ruff + mypy when installed",
+    )
+    parser.add_argument(
+        "--root", default=None,
+        help="checkout root (default: auto-detected from this package)",
+    )
+    parser.add_argument(
+        "--show-waived", action="store_true",
+        help="also print findings covered by allow() waivers",
+    )
+    args = parser.parse_args(argv)
+    root = args.root or repo_root()
+    rules = tuple(args.rule) if args.rule else CHECKERS
+
+    findings = run_checkers(root, rules)
+    unwaived = [f for f in findings if not f.waived]
+    waived = [f for f in findings if f.waived]
+    for f in sorted(unwaived, key=lambda f: (f.path, f.line, f.rule)):
+        print(f.render())
+    if args.show_waived:
+        for f in sorted(waived, key=lambda f: (f.path, f.line)):
+            print(f.render())
+    print(
+        f"omnia-analyze: {len(unwaived)} finding(s), "
+        f"{len(waived)} waived, rules: {', '.join(rules)}"
+    )
+    rc = 1 if unwaived else 0
+
+    if args.all:
+        rc |= _run_external("ruff", ["ruff", "check", "."], root)
+        rc |= _run_external("mypy", ["mypy"], root)
+    return rc
+
+
+if __name__ == "__main__":  # pragma: no cover - module CLI
+    sys.exit(main())

@@ -1,0 +1,204 @@
+"""Shared analyzer plumbing: findings, waivers, and source loading.
+
+Stdlib-only (``ast`` + ``re``): the analysis CLI must run in containers
+with no jax/numpy installed, and must stay fast enough to run on every
+commit (the whole suite parses the repo once and shares the trees).
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import os
+import re
+from typing import Iterable, Optional
+
+#: Rules a waiver may name. Kept explicit so a typo'd allow(<rule>)
+#: surfaces as a malformed waiver instead of silently never matching.
+KNOWN_RULES = (
+    "lock-guard",
+    "lock-blocking",
+    "purity",
+    "guards",
+    "metrics",
+    "jaxfree",
+)
+
+_WAIVER_RE = re.compile(
+    r"#\s*analysis:\s*allow\(([a-z0-9_-]+)\)\s*(?:(?:—|:|--)\s*(\S.*))?"
+)
+
+_GUARDED_BY_RE = re.compile(
+    r"self\.([A-Za-z_][A-Za-z0-9_]*)[^#]*#\s*guarded-by:\s*"
+    r"([A-Za-z_][A-Za-z0-9_]*)"
+)
+
+
+@dataclasses.dataclass
+class Finding:
+    """One analyzer result, anchored to a source line."""
+
+    rule: str
+    path: str  # repo-relative, forward slashes
+    line: int
+    message: str
+    waived: bool = False
+    waive_reason: str = ""
+
+    def render(self) -> str:
+        tag = f"[{self.rule}]"
+        suffix = f"  (waived: {self.waive_reason})" if self.waived else ""
+        return f"{self.path}:{self.line}: {tag} {self.message}{suffix}"
+
+
+@dataclasses.dataclass(frozen=True)
+class Waiver:
+    """One parsed ``# analysis: allow(<rule>) — <reason>`` comment.
+
+    ``line`` is the line the waiver applies to: the waiver's own line
+    when it trails code, the NEXT line when the waiver stands alone on a
+    comment-only line (the two supported placements)."""
+
+    rule: str
+    line: int
+    reason: str
+    declared_line: int
+
+
+class SourceFile:
+    """One parsed source file: text, lines, AST, waivers."""
+
+    def __init__(self, root: str, rel: str):
+        self.rel = rel.replace(os.sep, "/")
+        self.abspath = os.path.join(root, rel)
+        with open(self.abspath, encoding="utf-8") as f:
+            self.text = f.read()
+        self.lines = self.text.splitlines()
+        self.tree: Optional[ast.AST] = None
+        self.parse_error: Optional[str] = None
+        try:
+            self.tree = ast.parse(self.text, filename=self.rel)
+        except SyntaxError as e:
+            self.parse_error = f"syntax error: {e.msg} (line {e.lineno})"
+        self.waivers: list[Waiver] = []
+        self.malformed_waivers: list[Finding] = []
+        self._parse_waivers()
+
+    def _parse_waivers(self) -> None:
+        for i, line in enumerate(self.lines, start=1):
+            m = _WAIVER_RE.search(line)
+            if m is None:
+                continue
+            rule, reason = m.group(1), (m.group(2) or "").strip()
+            code_before = line[: m.start()].strip()
+            target = i if code_before else i + 1
+            if rule not in KNOWN_RULES:
+                self.malformed_waivers.append(Finding(
+                    "waiver", self.rel, i,
+                    f"waiver names unknown rule {rule!r} "
+                    f"(known: {', '.join(KNOWN_RULES)})",
+                ))
+                continue
+            if not reason:
+                self.malformed_waivers.append(Finding(
+                    "waiver", self.rel, i,
+                    f"waiver for {rule!r} has no reason — write "
+                    f"`# analysis: allow({rule}) — <why>`",
+                ))
+                continue
+            self.waivers.append(Waiver(rule, target, reason, i))
+
+    def guarded_fields(self) -> dict[str, str]:
+        """Inline ``# guarded-by:`` declarations: field name → lock name."""
+        out: dict[str, str] = {}
+        for line in self.lines:
+            m = _GUARDED_BY_RE.search(line)
+            if m is not None:
+                out[m.group(1)] = m.group(2)
+        return out
+
+
+def repo_root(start: Optional[str] = None) -> str:
+    """The repo checkout root: the nearest ancestor of ``start`` (or of
+    this package) containing both ``omnia_tpu/`` and ``tests/``."""
+    probe = os.path.abspath(start or os.path.dirname(os.path.dirname(
+        os.path.dirname(__file__)
+    )))
+    cur = probe
+    while True:
+        if os.path.isdir(os.path.join(cur, "omnia_tpu")) and os.path.isdir(
+            os.path.join(cur, "tests")
+        ):
+            return cur
+        parent = os.path.dirname(cur)
+        if parent == cur:
+            return probe
+        cur = parent
+
+
+def load_sources(root: str, rel_paths: Iterable[str]) -> list[SourceFile]:
+    out = []
+    for rel in rel_paths:
+        if os.path.isfile(os.path.join(root, rel)):
+            out.append(SourceFile(root, rel))
+    return out
+
+
+def walk_py(root: str, rel_dir: str) -> list[str]:
+    """Repo-relative paths of every .py file under ``rel_dir``, sorted."""
+    base = os.path.join(root, rel_dir)
+    found = []
+    for dirpath, _dirs, files in os.walk(base):
+        for fn in sorted(files):
+            if fn.endswith(".py"):
+                rel = os.path.relpath(os.path.join(dirpath, fn), root)
+                found.append(rel.replace(os.sep, "/"))
+    return sorted(found)
+
+
+def apply_waivers(
+    findings: list[Finding], sources: dict[str, SourceFile],
+    check_unused: bool = False,
+) -> list[Finding]:
+    """Mark findings covered by a same-line (or preceding comment-line)
+    waiver of the same rule. With ``check_unused``, waivers that covered
+    nothing become findings themselves — a stale allow() is exactly the
+    kind of rot this suite exists to stop."""
+    used: set[tuple[str, int, str]] = set()
+    for f in findings:
+        src = sources.get(f.path)
+        if src is None:
+            continue
+        for w in src.waivers:
+            if w.rule == f.rule and w.line == f.line:
+                f.waived = True
+                f.waive_reason = w.reason
+                used.add((f.path, w.declared_line, w.rule))
+    out = list(findings)
+    for src in sources.values():
+        out.extend(src.malformed_waivers)
+        if check_unused:
+            for w in src.waivers:
+                if (src.rel, w.declared_line, w.rule) not in used:
+                    out.append(Finding(
+                        "waiver", src.rel, w.declared_line,
+                        f"unused waiver for {w.rule!r} — the finding it "
+                        f"covered is gone; remove the allow()",
+                    ))
+    return out
+
+
+def analyze_file_set(
+    root: str, rel_paths: Iterable[str]
+) -> dict[str, SourceFile]:
+    """Parse a file set once, keyed by repo-relative path (shared by all
+    checkers in one run so the repo is read exactly once)."""
+    return {s.rel: s for s in load_sources(root, rel_paths)}
+
+
+def parse_errors(sources: dict[str, SourceFile]) -> list[Finding]:
+    return [
+        Finding("syntax", s.rel, 1, s.parse_error)
+        for s in sources.values()
+        if s.parse_error is not None
+    ]

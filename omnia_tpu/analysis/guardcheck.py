@@ -1,0 +1,181 @@
+"""Guard-conformance checker (rule ``guards``).
+
+Every serving knob — an ``EngineConfig`` dataclass field or a
+``MockEngine.__init__`` keyword — must be REGISTERED in the knob-guard
+registry (``tests/test_guards.py`` ``KNOB_GUARDS``) as either:
+
+- ``"<test_file.py>::<test_name>"`` — the knobs-off guard test proving
+  the knob's off value is a guarded true no-op (the PR 2–6 contract:
+  off builds zero state, traces zero new operands, changes zero
+  behavior), or
+- ``"structural: <why>"`` — a shape/placement knob with no off state
+  (``num_slots``, ``dtype``, mesh axes, ...), with the one-line reason.
+
+The checker cross-checks three ways, all by AST (no test imports, so it
+runs without jax):
+
+- every knob has a registry entry;
+- every referenced guard test exists in the named test file;
+- every registry entry still names a real knob (no stale rows).
+
+This is how "off = guarded true no-op" stops being a manually-
+remembered PR rule: adding a knob without a guard fails tier-1.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Optional
+
+from omnia_tpu.analysis.core import Finding, SourceFile
+
+REGISTRY_FILE = "tests/test_guards.py"
+ENGINE_CONFIG_FILE = "omnia_tpu/engine/types.py"
+MOCK_FILE = "omnia_tpu/engine/mock.py"
+
+#: MockEngine ctor args that are inputs, not feature knobs.
+_MOCK_NON_KNOBS = frozenset({"self", "scenarios", "tokenizer"})
+
+
+def engine_config_knobs(src: SourceFile) -> list[tuple[str, int]]:
+    """(field name, line) of every EngineConfig dataclass field."""
+    out = []
+    if src.tree is None:
+        return out
+    for node in ast.walk(src.tree):
+        if isinstance(node, ast.ClassDef) and node.name == "EngineConfig":
+            for stmt in node.body:
+                if isinstance(stmt, ast.AnnAssign) and isinstance(
+                    stmt.target, ast.Name
+                ):
+                    out.append((stmt.target.id, stmt.lineno))
+    return out
+
+
+def mock_knobs(src: SourceFile) -> list[tuple[str, int]]:
+    """(kwarg name, line) of every MockEngine.__init__ feature knob."""
+    out = []
+    if src.tree is None:
+        return out
+    for node in ast.walk(src.tree):
+        if isinstance(node, ast.ClassDef) and node.name == "MockEngine":
+            for stmt in node.body:
+                if isinstance(stmt, ast.FunctionDef) and stmt.name == "__init__":
+                    args = stmt.args
+                    for a in list(args.posonlyargs) + list(args.args) + list(
+                        args.kwonlyargs
+                    ):
+                        if a.arg not in _MOCK_NON_KNOBS:
+                            out.append((a.arg, a.lineno))
+    return out
+
+
+def load_registry(src: SourceFile) -> tuple[dict[str, tuple[str, int]], int]:
+    """Parse the ``KNOB_GUARDS`` dict literal: knob → (value, line).
+    Returns (registry, registry_line); registry_line is 0 when the
+    registry is missing entirely."""
+    if src.tree is None:
+        return {}, 0
+    for node in ast.walk(src.tree):
+        if isinstance(node, ast.Assign):
+            names = [
+                t.id for t in node.targets if isinstance(t, ast.Name)
+            ]
+            if "KNOB_GUARDS" in names and isinstance(node.value, ast.Dict):
+                reg: dict[str, tuple[str, int]] = {}
+                for k, v in zip(node.value.keys, node.value.values):
+                    if isinstance(k, ast.Constant) and isinstance(
+                        v, ast.Constant
+                    ):
+                        reg[str(k.value)] = (str(v.value), k.lineno)
+                return reg, node.lineno
+    return {}, 0
+
+
+def _test_functions(src: Optional[SourceFile]) -> set[str]:
+    """Every test function name in a test module, including methods."""
+    out: set[str] = set()
+    if src is None or src.tree is None:
+        return out
+    for node in ast.walk(src.tree):
+        if isinstance(node, ast.FunctionDef) and node.name.startswith("test"):
+            out.add(node.name)
+    return out
+
+
+def check_guards(root: str, sources: dict[str, SourceFile]) -> list[Finding]:
+    findings: list[Finding] = []
+    reg_src = sources.get(REGISTRY_FILE)
+    cfg_src = sources.get(ENGINE_CONFIG_FILE)
+    mock_src = sources.get(MOCK_FILE)
+    if reg_src is None or cfg_src is None or mock_src is None:
+        missing = [
+            f for f, s in (
+                (REGISTRY_FILE, reg_src), (ENGINE_CONFIG_FILE, cfg_src),
+                (MOCK_FILE, mock_src),
+            ) if s is None
+        ]
+        return [Finding(
+            "guards", missing[0], 1,
+            f"guard conformance needs {', '.join(missing)} in the file set",
+        )]
+    registry, reg_line = load_registry(reg_src)
+    if reg_line == 0:
+        return [Finding(
+            "guards", REGISTRY_FILE, 1,
+            "KNOB_GUARDS registry not found — every EngineConfig/"
+            "MockEngine knob must map to a knobs-off guard test or a "
+            "'structural: <why>' classification",
+        )]
+
+    knobs: list[tuple[str, str, int]] = []  # (registry key, src file, line)
+    for name, line in engine_config_knobs(cfg_src):
+        knobs.append((f"EngineConfig.{name}", ENGINE_CONFIG_FILE, line))
+    for name, line in mock_knobs(mock_src):
+        knobs.append((f"MockEngine.{name}", MOCK_FILE, line))
+
+    test_cache: dict[str, set[str]] = {}
+    for key, src_file, line in knobs:
+        entry = registry.get(key)
+        if entry is None:
+            findings.append(Finding(
+                "guards", src_file, line,
+                f"knob {key} has no KNOB_GUARDS entry in "
+                f"{REGISTRY_FILE} — register its knobs-off guard test "
+                f"or classify it 'structural: <why>'",
+            ))
+            continue
+        value, vline = entry
+        if value.startswith("structural:") and value.split(":", 1)[1].strip():
+            continue
+        if "::" not in value:
+            findings.append(Finding(
+                "guards", REGISTRY_FILE, vline,
+                f"KNOB_GUARDS[{key!r}] = {value!r} is neither "
+                f"'<file>::<test>' nor 'structural: <why>'",
+            ))
+            continue
+        test_file, test_name = value.split("::", 1)
+        rel = f"tests/{test_file}" if not test_file.startswith("tests/") else test_file
+        if rel not in test_cache:
+            src = sources.get(rel)
+            if src is None and os.path.isfile(os.path.join(root, rel)):
+                src = SourceFile(root, rel)
+            test_cache[rel] = _test_functions(src)
+        if test_name not in test_cache[rel]:
+            findings.append(Finding(
+                "guards", REGISTRY_FILE, vline,
+                f"KNOB_GUARDS[{key!r}] names {test_file}::{test_name}, "
+                f"but no such test exists — the knobs-off guard is gone",
+            ))
+
+    known = {k for k, _f, _l in knobs}
+    for key, (_value, vline) in registry.items():
+        if key not in known:
+            findings.append(Finding(
+                "guards", REGISTRY_FILE, vline,
+                f"stale KNOB_GUARDS entry {key!r} — no such knob exists "
+                f"on EngineConfig/MockEngine anymore",
+            ))
+    return findings

@@ -1,0 +1,58 @@
+"""Jax-free-by-contract package checker (rule ``jaxfree``).
+
+Some packages are jax-free BY CONTRACT: ``engine/grammar`` must be
+importable with grammar=off allocating zero device arrays, which is
+only provable if nothing in the package can ever touch jax (PR 3;
+``tests/test_grammar.py`` asserts the import-time half in a
+subprocess). This rule is the source-level half, absorbed from
+``tests/test_guards.py``: no ``import jax`` / ``from jax ...`` at ANY
+position (module top, function body, conditional) in a contracted
+package. AST-based, so an import hidden inside a function no longer
+slips past the old line-regex.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from omnia_tpu.analysis.core import Finding, SourceFile
+
+#: Packages (repo-relative directory prefixes) that must never import jax.
+JAX_FREE_PACKAGES: tuple[str, ...] = (
+    "omnia_tpu/engine/grammar/",
+    "omnia_tpu/analysis/",
+)
+
+
+def jaxfree_files(all_files: list[str]) -> list[str]:
+    return [
+        f for f in all_files
+        if any(f.startswith(p) for p in JAX_FREE_PACKAGES)
+    ]
+
+
+def check_jaxfree(sources: dict[str, SourceFile]) -> list[Finding]:
+    findings: list[Finding] = []
+    for src in sources.values():
+        if not any(src.rel.startswith(p) for p in JAX_FREE_PACKAGES):
+            continue
+        if src.tree is None:
+            continue
+        for node in ast.walk(src.tree):
+            bad = None
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    if alias.name == "jax" or alias.name.startswith("jax."):
+                        bad = alias.name
+            elif isinstance(node, ast.ImportFrom):
+                mod = node.module or ""
+                if node.level == 0 and (mod == "jax" or mod.startswith("jax.")):
+                    bad = mod
+            if bad is not None:
+                findings.append(Finding(
+                    "jaxfree", src.rel, node.lineno,
+                    f"imports {bad!r} inside a jax-free-by-contract "
+                    f"package — the package must stay importable with "
+                    f"zero device-array allocation",
+                ))
+    return findings

@@ -1,0 +1,205 @@
+"""Lock-discipline checker (rules ``lock-guard`` and ``lock-blocking``).
+
+Two invariants over the engine/coordinator concurrency seams, both past
+bug classes:
+
+- **lock-guard**: a field annotated ``# guarded-by: <lock>`` on its
+  initializing assignment may only be read or written inside a lexical
+  ``with self.<lock>:`` block. ``__init__`` is exempt (construction
+  precedes concurrency). Annotations are collected per *lock group* —
+  the engine is one logical class spread over mixin files, so a field
+  declared in ``engine.py`` is enforced across every engine-family
+  file.
+
+- **lock-blocking**: while any ``with self.<lock>:`` is held, no
+  blocking call may run — worker RPCs (``healthy`` / ``queue_depth`` /
+  ``submit`` / ...), device syncs (``np.asarray``,
+  ``block_until_ready``), ``time.sleep``, thread ``join``. This is the
+  ``_pick`` bug class (PR 5): a slow stats RPC under the routing lock
+  serialized ALL routing behind one bad worker.
+
+The check is lexical by design: the codebase's discipline is
+lock-at-access-site (no "caller holds the lock" contracts for guarded
+fields), which is exactly what makes the invariant machine-checkable.
+A deliberate exception gets an ``analysis: allow(lock-guard)`` waiver
+comment instead of an unwritten convention.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Optional
+
+from omnia_tpu.analysis.core import Finding, SourceFile
+
+#: Lock groups: each entry is one logical concurrent class whose
+#: ``# guarded-by:`` annotations are merged across its (mixin) files.
+LOCK_GROUPS: tuple[tuple[str, tuple[str, ...]], ...] = (
+    ("engine", (
+        "omnia_tpu/engine/engine.py",
+        "omnia_tpu/engine/scheduler.py",
+        "omnia_tpu/engine/lifecycle.py",
+        "omnia_tpu/engine/interleave.py",
+        "omnia_tpu/engine/placement.py",
+        "omnia_tpu/engine/sessions.py",
+        "omnia_tpu/engine/prefix_cache.py",
+        "omnia_tpu/engine/spec_decode.py",
+        "omnia_tpu/engine/multihost.py",
+    )),
+    ("mock", ("omnia_tpu/engine/mock.py",)),
+    ("coordinator", ("omnia_tpu/engine/coordinator.py",)),
+)
+
+#: Attribute names whose CALL under a held lock is (potentially)
+#: blocking: worker RPC surface + sleeps + thread joins + host syncs.
+#: dict.get / queue.put are deliberately absent — the list is the RPC
+#: and sync vocabulary of this codebase, not a generic heuristic.
+BLOCKING_ATTRS = frozenset({
+    "sleep", "join", "healthy", "queue_depth", "active_slots",
+    "pending_prefill_tokens", "submit", "release_session",
+    "collect_tokens", "get_event", "block_until_ready", "wait",
+})
+
+#: Module aliases whose ``.asarray`` forces a device→host sync.
+_HOST_SYNC_MODULES = frozenset({"np", "numpy"})
+
+
+def _with_locks(node: ast.With) -> list[str]:
+    """Lock names taken by ``with self.<name>: ...`` items."""
+    out = []
+    for item in node.items:
+        ctx = item.context_expr
+        if (
+            isinstance(ctx, ast.Attribute)
+            and isinstance(ctx.value, ast.Name)
+            and ctx.value.id == "self"
+        ):
+            out.append(ctx.attr)
+    return out
+
+
+class _FunctionLockWalker:
+    """Walk one function body tracking the lexically-held lock set.
+
+    Nested function definitions start with an EMPTY held set (a closure
+    defined under a lock does not run under it) and are walked
+    independently."""
+
+    def __init__(self, src: SourceFile, guarded: dict[str, str],
+                 in_init: bool, findings: list[Finding]):
+        self.src = src
+        self.guarded = guarded
+        self.in_init = in_init
+        self.findings = findings
+
+    def walk(self, body: list[ast.stmt], held: frozenset[str]) -> None:
+        for stmt in body:
+            self._visit(stmt, held)
+
+    def _visit(self, node: ast.AST, held: frozenset[str]) -> None:
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            taken = _with_locks(node)
+            for item in node.items:
+                self._visit(item.context_expr, held)
+                if item.optional_vars is not None:
+                    self._visit(item.optional_vars, held)
+            inner = held | frozenset(taken)
+            for stmt in node.body:
+                self._visit(stmt, inner)
+            return
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            # Fresh scope: the nested def's body runs whenever it is
+            # CALLED, not where it is defined.
+            sub = _FunctionLockWalker(
+                self.src, self.guarded, node.name == "__init__", self.findings
+            )
+            sub.walk(node.body, frozenset())
+            return
+        if isinstance(node, ast.Lambda):
+            sub = _FunctionLockWalker(
+                self.src, self.guarded, False, self.findings
+            )
+            sub._visit(node.body, frozenset())
+            return
+        if isinstance(node, ast.Attribute):
+            self._check_guarded(node, held)
+        if isinstance(node, ast.Call):
+            self._check_blocking(node, held)
+        for child in ast.iter_child_nodes(node):
+            self._visit(child, held)
+
+    def _check_guarded(self, node: ast.Attribute, held: frozenset[str]) -> None:
+        if not (isinstance(node.value, ast.Name) and node.value.id == "self"):
+            return
+        lock = self.guarded.get(node.attr)
+        if lock is None or self.in_init or lock in held:
+            return
+        verb = "written" if isinstance(
+            node.ctx, (ast.Store, ast.Del)
+        ) else "read"
+        self.findings.append(Finding(
+            "lock-guard", self.src.rel, node.lineno,
+            f"self.{node.attr} (guarded-by {lock}) {verb} outside "
+            f"`with self.{lock}`",
+        ))
+
+    def _check_blocking(self, node: ast.Call, held: frozenset[str]) -> None:
+        if not held:
+            return
+        func = node.func
+        label: Optional[str] = None
+        if isinstance(func, ast.Attribute):
+            if func.attr == "asarray":
+                if (
+                    isinstance(func.value, ast.Name)
+                    and func.value.id in _HOST_SYNC_MODULES
+                ):
+                    label = f"{func.value.id}.asarray (device→host sync)"
+            elif func.attr in BLOCKING_ATTRS:
+                recv = ast.unparse(func.value)
+                # self.metrics.get(...)-style dict ops are not RPCs; the
+                # blocking vocabulary targets worker objects, time,
+                # threads, events — anything else with these names IS
+                # the pattern this rule exists for.
+                label = f"{recv}.{func.attr}()"
+        if label is not None:
+            locks = ", ".join(sorted(held))
+            self.findings.append(Finding(
+                "lock-blocking", self.src.rel, node.lineno,
+                f"blocking call {label} while holding self.{locks} — "
+                f"move the call outside the lock (the _pick bug class)",
+            ))
+
+
+def check_locks(sources: dict[str, SourceFile]) -> list[Finding]:
+    """Run both lock rules over every lock group present in ``sources``."""
+    findings: list[Finding] = []
+    for _name, files in LOCK_GROUPS:
+        group = [sources[f] for f in files if f in sources]
+        if not group:
+            continue
+        guarded: dict[str, str] = {}
+        for src in group:
+            guarded.update(src.guarded_fields())
+        for src in group:
+            findings.extend(_walk_module(src, guarded))
+    return findings
+
+
+def _walk_module(src: SourceFile, guarded: dict[str, str]) -> list[Finding]:
+    findings: list[Finding] = []
+    if src.tree is None:
+        return findings
+
+    def visit_scope(body: list[ast.stmt]) -> None:
+        for stmt in body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                walker = _FunctionLockWalker(
+                    src, guarded, stmt.name == "__init__", findings
+                )
+                walker.walk(stmt.body, frozenset())
+            elif isinstance(stmt, ast.ClassDef):
+                visit_scope(stmt.body)
+
+    visit_scope(src.tree.body)
+    return findings

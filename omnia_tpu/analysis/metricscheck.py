@@ -1,0 +1,210 @@
+"""Metrics-conformance checker (rule ``metrics``).
+
+The metrics key names are a stable interface — the dashboard, doctor,
+and operators' alerts read them — so every key WRITTEN anywhere in the
+engine package must be registered in the stability registries
+(``tests/test_prefix_cache.py`` ``TestMetricsKeyStability``) and
+documented in the ``docs/serving.md`` metrics tables:
+
+- engine-family files (``engine.py`` + mixins)  → ``EXPECTED``
+- ``mock.py``            → ``EXPECTED`` ∪ ``MOCK_ONLY`` (the mock
+  mirrors engine keys; its private keys get their own registry)
+- ``coordinator.py``     → ``COORDINATOR``
+
+Write sites recognized (all by AST): ``self.metrics["k"] op ...``,
+``self.metrics.get("k", ...)``, and the coordinator's
+``self._count("k")``/``self._count("k", n)`` helper — plus the keys of
+the ``self.metrics = {...}`` dict literal itself.
+
+A key written but unregistered, a key written but undocumented, or a
+registry row no code writes anymore each produce a finding. This is the
+machine check behind the PR rule "every new metric rides with its
+EXPECTED row and its docs row".
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Optional
+
+from omnia_tpu.analysis.core import Finding, SourceFile
+
+REGISTRY_FILE = "tests/test_prefix_cache.py"
+DOCS_FILE = "docs/serving.md"
+
+#: File → registry set(s) its metric keys must belong to.
+ENGINE_FAMILY = (
+    "omnia_tpu/engine/engine.py",
+    "omnia_tpu/engine/scheduler.py",
+    "omnia_tpu/engine/lifecycle.py",
+    "omnia_tpu/engine/interleave.py",
+    "omnia_tpu/engine/placement.py",
+    "omnia_tpu/engine/sessions.py",
+    "omnia_tpu/engine/prefix_cache.py",
+    "omnia_tpu/engine/spec_decode.py",
+    "omnia_tpu/engine/multihost.py",
+)
+MOCK_FILE = "omnia_tpu/engine/mock.py"
+COORDINATOR_FILE = "omnia_tpu/engine/coordinator.py"
+
+
+def metric_keys_in(src: SourceFile) -> list[tuple[str, int]]:
+    """(key, line) for every metrics-key write site in a module."""
+    out: list[tuple[str, int]] = []
+    if src.tree is None:
+        return out
+
+    def is_self_metrics(node: ast.expr) -> bool:
+        return (
+            isinstance(node, ast.Attribute)
+            and node.attr == "metrics"
+            and isinstance(node.value, ast.Name)
+            and node.value.id == "self"
+        )
+
+    for node in ast.walk(src.tree):
+        if isinstance(node, ast.Subscript) and is_self_metrics(node.value):
+            if isinstance(node.slice, ast.Constant) and isinstance(
+                node.slice.value, str
+            ):
+                out.append((node.slice.value, node.lineno))
+        elif isinstance(node, ast.Call):
+            func = node.func
+            if (
+                isinstance(func, ast.Attribute)
+                and func.attr in ("get", "setdefault")
+                and is_self_metrics(func.value)
+                and node.args
+                and isinstance(node.args[0], ast.Constant)
+                and isinstance(node.args[0].value, str)
+            ):
+                out.append((node.args[0].value, node.lineno))
+            elif (
+                isinstance(func, ast.Attribute)
+                and func.attr == "_count"
+                and isinstance(func.value, ast.Name)
+                and func.value.id == "self"
+                and node.args
+                and isinstance(node.args[0], ast.Constant)
+                and isinstance(node.args[0].value, str)
+            ):
+                out.append((node.args[0].value, node.lineno))
+        elif isinstance(node, ast.Assign) and isinstance(node.value, ast.Dict):
+            if any(
+                is_self_metrics(t) for t in node.targets
+            ):
+                for k in node.value.keys:
+                    if isinstance(k, ast.Constant) and isinstance(k.value, str):
+                        out.append((k.value, k.lineno))
+    return out
+
+
+def load_registry_sets(src: Optional[SourceFile]) -> dict[str, set[str]]:
+    """``TestMetricsKeyStability``'s class-level set literals by name."""
+    out: dict[str, set[str]] = {}
+    if src is None or src.tree is None:
+        return out
+    for node in ast.walk(src.tree):
+        if isinstance(node, ast.ClassDef) and node.name == "TestMetricsKeyStability":
+            for stmt in node.body:
+                if isinstance(stmt, ast.Assign) and isinstance(
+                    stmt.value, ast.Set
+                ):
+                    for t in stmt.targets:
+                        if isinstance(t, ast.Name):
+                            out[t.id] = {
+                                e.value for e in stmt.value.elts
+                                if isinstance(e, ast.Constant)
+                                and isinstance(e.value, str)
+                            }
+    return out
+
+
+def check_metrics(root: str, sources: dict[str, SourceFile]) -> list[Finding]:
+    findings: list[Finding] = []
+    reg_src = sources.get(REGISTRY_FILE)
+    if reg_src is None:
+        reg_path = os.path.join(root, REGISTRY_FILE)
+        if os.path.isfile(reg_path):
+            reg_src = SourceFile(root, REGISTRY_FILE)
+    registries = load_registry_sets(reg_src)
+    expected = registries.get("EXPECTED")
+    mock_only = registries.get("MOCK_ONLY", set())
+    coordinator = registries.get("COORDINATOR", set())
+    if expected is None:
+        return [Finding(
+            "metrics", REGISTRY_FILE, 1,
+            "TestMetricsKeyStability.EXPECTED set not found — the "
+            "stable engine metric key registry is the conformance anchor",
+        )]
+    docs_path = os.path.join(root, DOCS_FILE)
+    docs_text = ""
+    if os.path.isfile(docs_path):
+        with open(docs_path, encoding="utf-8") as f:
+            docs_text = f.read()
+    else:
+        findings.append(Finding(
+            "metrics", DOCS_FILE, 1, "docs/serving.md missing",
+        ))
+
+    plans: list[tuple[str, set[str], str]] = []
+    for f in ENGINE_FAMILY:
+        plans.append((f, expected, "TestMetricsKeyStability.EXPECTED"))
+    plans.append((
+        MOCK_FILE, expected | mock_only,
+        "TestMetricsKeyStability.EXPECTED ∪ MOCK_ONLY",
+    ))
+    plans.append((
+        COORDINATOR_FILE, coordinator, "TestMetricsKeyStability.COORDINATOR",
+    ))
+
+    written: dict[str, set[str]] = {"engine": set(), "mock": set(), "coord": set()}
+    seen: set[tuple[str, int, str, str]] = set()
+    for rel, allowed, registry_name in plans:
+        src = sources.get(rel)
+        if src is None:
+            continue
+        for key, line in metric_keys_in(src):
+            if (rel, line, key, registry_name) in seen:
+                continue  # .get + subscript on one line report once
+            seen.add((rel, line, key, registry_name))
+            if rel == COORDINATOR_FILE:
+                written["coord"].add(key)
+            elif rel == MOCK_FILE:
+                written["mock"].add(key)
+            else:
+                written["engine"].add(key)
+            if key not in allowed:
+                findings.append(Finding(
+                    "metrics", rel, line,
+                    f"metrics key {key!r} is not registered in "
+                    f"{registry_name} — metric names are a stable "
+                    f"interface; add the registry row (and the docs row)",
+                ))
+            if docs_text and f"`{key}`" not in docs_text:
+                findings.append(Finding(
+                    "metrics", rel, line,
+                    f"metrics key {key!r} is not documented in "
+                    f"{DOCS_FILE} — add a row to the metrics table",
+                ))
+
+    # Stale registry rows: a registered key nothing writes anymore.
+    reg_line = 1
+    if reg_src is not None and reg_src.tree is not None:
+        for node in ast.walk(reg_src.tree):
+            if isinstance(node, ast.ClassDef) and (
+                node.name == "TestMetricsKeyStability"
+            ):
+                reg_line = node.lineno
+    all_written = written["engine"] | written["mock"] | written["coord"]
+    for name, keys in (("EXPECTED", expected), ("MOCK_ONLY", mock_only),
+                       ("COORDINATOR", coordinator)):
+        for key in sorted(keys - all_written):
+            findings.append(Finding(
+                "metrics", REGISTRY_FILE, reg_line,
+                f"stale registry row: TestMetricsKeyStability.{name} "
+                f"contains {key!r} but no engine/mock/coordinator code "
+                f"writes it",
+            ))
+    return findings

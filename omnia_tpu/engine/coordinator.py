@@ -106,10 +106,12 @@ class EngineCoordinator:
         # entry per session forever. Evicting an affinity entry only
         # costs a re-prefill if the worker still held the KV — the same
         # rebuild-on-miss contract failover relies on.
-        self._affinity: "collections.OrderedDict[str, int]" = collections.OrderedDict()
+        self._affinity: "collections.OrderedDict[str, int]" = (  # guarded-by: _lock
+            collections.OrderedDict()
+        )
         # Prefix-affinity for FRESH sessions: prompt-head key → worker.
         # Same LRU bound and rebuild-on-miss contract as sessions.
-        self._prefix_affinity: "collections.OrderedDict[str, int]" = (
+        self._prefix_affinity: "collections.OrderedDict[str, int]" = (  # guarded-by: _lock
             collections.OrderedDict()
         )
         self.max_affinity = max_affinity
@@ -148,12 +150,12 @@ class EngineCoordinator:
         # wait on routing bookkeeping (and worker RPCs happen under
         # NEITHER lock — see _pick).
         self._health_lock = threading.Lock()
-        self._health = [_WorkerHealth() for _ in self.workers]
+        self._health = [_WorkerHealth() for _ in self.workers]  # guarded-by: _health_lock
         # Metric increments take _metrics_lock so counts reconcile
         # EXACTLY with terminal events under concurrent submits
         # (unlocked += drops updates under contention).
         self._metrics_lock = threading.Lock()
-        self.metrics = {
+        self.metrics = {  # guarded-by: _metrics_lock
             "routed": 0,
             "failovers": 0,
             "affinity_evictions": 0,
@@ -205,8 +207,8 @@ class EngineCoordinator:
         direct evidence (a submit() exception): the worker goes down
         immediately regardless of the hysteresis threshold."""
         now = time.monotonic()
-        st = self._health[i]
         with self._health_lock:
+            st = self._health[i]
             st.last_probe = now
             if ok:
                 st.fails = 0

@@ -184,7 +184,7 @@ class InferenceEngine(
         # LRU shares the engine's logical clock (lambda defers the
         # lookup — self.clock is injectable for multi-host lockstep).
         self._prefix_pool: Optional[PrefixPool] = None
-        self._pending_prefix_regs: list[list[int]] = []
+        self._pending_prefix_regs: list[list[int]] = []  # guarded-by: _lock
         if engine_cfg.prefix_cache_slots > 0:
             if self._mesh is not None and (
                 engine_cfg.prefix_cache_slots % max(engine_cfg.dp, 1) != 0
@@ -199,18 +199,18 @@ class InferenceEngine(
 
         B = engine_cfg.num_slots
         self._slots = [_Slot() for _ in range(B)]
-        self._waiting: list[tuple[Request, RequestHandle]] = []
+        self._waiting: list[tuple[Request, RequestHandle]] = []  # guarded-by: _lock
         # Requests between queue removal and slot activation (mid-
         # placement): invisible to queue_depth AND active_slots, so the
         # graceful-drain wait must count them explicitly.
-        self._placing = 0
+        self._placing = 0  # guarded-by: _lock
         self._lock = threading.Lock()
         self._req_counter = itertools.count()
         # Sessionful KV registry — engine-thread-owned: only step() and the
         # helpers it calls touch it. Cross-thread requests (release_session)
         # arrive via _pending_releases under _lock. LRU uses last_used.
         self._sessions: dict[str, _SessionKV] = {}
-        self._pending_releases: list[str] = []
+        self._pending_releases: list[str] = []  # guarded-by: _lock
         # Dispatched-but-unread decode chunks: (token futures, active
         # snapshot). Engine-thread-owned.
         self._inflight: collections.deque = collections.deque()
@@ -224,7 +224,7 @@ class InferenceEngine(
         self._healthy = True
         # Graceful drain (stop(drain=True)): True stops admission —
         # submit() sheds OVERLOADED — while queued/active work finishes.
-        self._draining = False
+        self._draining = False  # guarded-by: _lock
         # Chaos-harness injection seam (engine/faults.py): tests set this
         # to inject hung/slow chunk syncs and flaky submits. None in
         # production — every consult is a cheap attribute check.
@@ -275,6 +275,12 @@ class InferenceEngine(
             "requests_shed": 0,
             "deadline_exceeded": 0,
             "watchdog_trips": 0,
+            # Crash recoveries (lifecycle._recover): device-state
+            # reallocations after a failed/watchdog-tripped step.
+            # Initialized here (not lazily on first recovery) so the
+            # stable key set is the same on a healthy engine — a
+            # dashboard querying it pre-incident reads 0, not KeyError.
+            "recoveries": 0,
             # Stall-free batching (engine/interleave.py): mixed_steps =
             # fused prefill+decode dispatches, interleaved_prefill_tokens
             # = prompt tokens consumed by them (metered per piece — exact

@@ -70,9 +70,11 @@ class FaultPlan:
     # link), never a terminal fault by itself.
     slow_sync_s: float = 0.0
 
-    def __post_init__(self):
+    def __post_init__(self) -> None:
         self._lock = threading.Lock()
-        self.fired = {"deaths": 0, "submit_faults": 0, "hangs": 0}
+        self.fired: dict[str, int] = {
+            "deaths": 0, "submit_faults": 0, "hangs": 0,
+        }
 
     # -- consumption seams (each decides-and-counts atomically) --------
 

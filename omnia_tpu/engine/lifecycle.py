@@ -25,7 +25,8 @@ class _LifecycleMixin:
     def start(self):
         if self._thread is not None:
             return
-        self._draining = False
+        with self._lock:
+            self._draining = False
         self._stop_event.clear()
         self._thread = threading.Thread(
             target=self._loop, name="omnia-engine", daemon=True
@@ -39,12 +40,10 @@ class _LifecycleMixin:
         idle sessions' KV rows are offloaded to host RAM so a restarted
         engine restores them instead of re-prefilling."""
         if drain:
-            self._draining = True
+            with self._lock:
+                self._draining = True
             deadline = time.monotonic() + drain_timeout_s
-            while time.monotonic() < deadline and (
-                self.queue_depth() > 0 or self.active_slots() > 0
-                or self._placing > 0
-            ):
+            while time.monotonic() < deadline and self._drain_work_left():
                 if self._thread is None:
                     if not self.step():
                         time.sleep(0.001)
@@ -102,6 +101,18 @@ class _LifecycleMixin:
             # device-state ownership has passed back to this caller.
             self._offload_idle_sessions()
 
+    def _drain_work_left(self) -> bool:
+        """The drain-wait predicate: queued, mid-placement, or active
+        work remains. The queue and the ``_placing`` counter are read in
+        ONE critical section — the pre-fix unlocked ``_placing`` read
+        could observe a torn claim (queue already popped, counter not
+        yet visible) and end the drain with a request in neither
+        ledger."""
+        with self._lock:
+            if self._waiting or self._placing > 0:
+                return True
+        return self.active_slots() > 0
+
     def _loop(self):
         while not self._stop_event.is_set():
             try:
@@ -129,7 +140,7 @@ class _LifecycleMixin:
                 sess.token_ids = []
         try:
             self._init_device_state()
-            self.metrics["recoveries"] = self.metrics.get("recoveries", 0) + 1
+            self.metrics["recoveries"] += 1
             # A watchdog trip marks the engine unhealthy before raising;
             # a recovery that actually reallocated device state restores
             # readiness (the platform analog: probe fails during the

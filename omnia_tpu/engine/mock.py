@@ -96,7 +96,7 @@ class MockEngine:
         self.prefill_chunk_tokens = prefill_chunk_tokens
         # Prompt-token backlog mirror for the coordinator's token-aware
         # load signal (live playbacks' prompt tokens).
-        self._live_prompt_tokens = 0
+        self._live_prompt_tokens = 0  # guarded-by: _lock
         # Request-lifecycle parity with InferenceEngine (chaos harness):
         # a counted FaultPlan (engine/faults.py) injects deaths/hangs/
         # flaky submits; max_queue bounds concurrent playbacks the same
@@ -107,8 +107,8 @@ class MockEngine:
         self.max_queue = max_queue
         self.watchdog_s = watchdog_s
         self._healthy = True
-        self._draining = False
-        self._live_plays = 0
+        self._draining = False  # guarded-by: _lock
+        self._live_plays = 0  # guarded-by: _lock
         # int8-KV parity (models/kv_quant.py): the mock has no cache,
         # but with kv_quant set it round-trips a deterministic pseudo-KV
         # block per request through the SAME rowwise quantize/dequant
@@ -121,7 +121,7 @@ class MockEngine:
 
             kv_quant = validate_kv_quant(kv_quant)
         self.kv_quant = kv_quant
-        self.metrics = {
+        self.metrics = {  # guarded-by: _lock
             "requests_submitted": 0,
             "requests_finished": 0,
             "tokens_generated": 0,
@@ -184,6 +184,12 @@ class MockEngine:
 
     def register_prefix(self, tokens) -> None:
         """Interface parity with InferenceEngine; the mock has no KV."""
+
+    def release_session(self, session_id: str) -> None:
+        """Interface parity with InferenceEngine; the mock keeps no
+        session KV, so a release is a no-op — but accepting the call
+        lets the coordinator's release path run against mock fleets
+        without taking its worker-RPC-failure re-pin branch."""
 
     def supports_grammar(self) -> bool:
         """The mock enforces grammars host-side (same masks, no device),
@@ -296,14 +302,20 @@ class MockEngine:
         return self.submit(prompt_tokens, params).collect_tokens(timeout=30)
 
     def start(self):
-        self._draining = False
+        with self._lock:
+            self._draining = False
 
     def stop(self, drain: bool = False, drain_timeout_s: float = 30.0):
         """Interface parity: drain stops admission (submit sheds
-        OVERLOADED) and waits out live playbacks, bounded."""
+        OVERLOADED) and waits out live playbacks, bounded. The
+        ``_draining`` flip happens under the lock: submit's
+        check-and-reserve reads it in its critical section, so an
+        unlocked write could admit a playback AFTER the drain decided
+        the engine was idle (the books then disagree with the wait)."""
         if not drain:
             return
-        self._draining = True
+        with self._lock:
+            self._draining = True
         deadline = time.monotonic() + drain_timeout_s
         while time.monotonic() < deadline:
             with self._lock:

@@ -14,7 +14,7 @@ import enum
 import queue
 import threading
 import time
-from typing import Iterator, Optional, Sequence
+from typing import Any, Iterator, Optional
 
 
 # Per-slot stop-token ids tracked ON DEVICE (padded with -1). Requests with
@@ -91,7 +91,7 @@ class StreamEvent:
 class RequestHandle:
     """Consumer side of a submitted request: iterate StreamEvents."""
 
-    def __init__(self, request_id: str):
+    def __init__(self, request_id: str) -> None:
         self.request_id = request_id
         self._queue: "queue.Queue[StreamEvent]" = queue.Queue()
         self._cancelled = threading.Event()
@@ -133,13 +133,13 @@ class RequestHandle:
         raise AssertionError("stream ended without final event")
 
 
-def resolve_dtype(name: str):
+def resolve_dtype(name: str) -> Any:
     """EngineConfig.dtype string → jnp dtype. The single mapping shared by
     the engine, the provider layer, and bench — adding a dtype means
     touching exactly this table."""
     import jax.numpy as jnp
 
-    table = {
+    table: dict[str, Any] = {
         "bfloat16": jnp.bfloat16,
         "float32": jnp.float32,
         "float16": jnp.float16,

@@ -34,7 +34,7 @@ exercise identical numerics with no device.
 
 from __future__ import annotations
 
-from typing import Optional
+from typing import Any, Callable, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
@@ -66,26 +66,26 @@ class QuantKV:
 
     __slots__ = ("q", "s")
 
-    def __init__(self, q, s):
+    def __init__(self, q: Any, s: Any) -> None:
         self.q = q
         self.s = s
 
-    def tree_flatten(self):
+    def tree_flatten(self) -> tuple[tuple[Any, Any], None]:
         return (self.q, self.s), None
 
     @classmethod
-    def tree_unflatten(cls, _aux, children):
+    def tree_unflatten(cls, _aux: None, children: Sequence[Any]) -> "QuantKV":
         return cls(*children)
 
     # Shape/byte introspection mirrors the plain array it replaces (the
     # engine and bench size caches by these).
     @property
-    def shape(self):
-        return self.q.shape
+    def shape(self) -> tuple[int, ...]:
+        return tuple(self.q.shape)
 
     @property
-    def ndim(self):
-        return self.q.ndim
+    def ndim(self) -> int:
+        return int(self.q.ndim)
 
     @property
     def nbytes(self) -> int:
@@ -94,11 +94,11 @@ class QuantKV:
             + self.s.size * self.s.dtype.itemsize
         )
 
-    def __repr__(self):  # pragma: no cover - debugging aid
+    def __repr__(self) -> str:  # pragma: no cover - debugging aid
         return f"QuantKV(q={self.q.shape}{self.q.dtype}, s={self.s.shape})"
 
 
-def is_quant_kv(x) -> bool:
+def is_quant_kv(x: Any) -> bool:
     return isinstance(x, QuantKV)
 
 
@@ -107,7 +107,7 @@ def is_quant_kv(x) -> bool:
 # ---------------------------------------------------------------------------
 
 
-def quantize_rows(x) -> QuantKV:
+def quantize_rows(x: Any) -> QuantKV:
     """x float [..., H, D] → QuantKV. Scale is absmax over the head dim
     (one f32 per row per head); symmetric int8 in [-127, 127]."""
     xf = jnp.asarray(x, jnp.float32)
@@ -116,7 +116,7 @@ def quantize_rows(x) -> QuantKV:
     return QuantKV(q, s)
 
 
-def dequantize_rows(kv: QuantKV, dtype=jnp.float32):
+def dequantize_rows(kv: QuantKV, dtype: Any = jnp.float32) -> Any:
     """QuantKV → float rows (tests/host use; the serving read path fuses
     the scale into attention instead of materializing this)."""
     return (kv.q.astype(jnp.float32) * kv.s[..., None]).astype(dtype)
@@ -141,7 +141,7 @@ def dequantize_rows_np(kv: QuantKV) -> np.ndarray:
 # ---------------------------------------------------------------------------
 
 
-def kv_map(fn, *caches):
+def kv_map(fn: Callable[..., Any], *caches: Any) -> Any:
     """Apply an array op to every leaf of a cache (both leaves of a
     QuantKV, or the array itself). The op must only touch LEADING axes
     (everything before the head axis) — those are shared by q and s."""
@@ -152,11 +152,11 @@ def kv_map(fn, *caches):
     return fn(*caches)
 
 
-def _pad_idx(arr, starts):
+def _pad_idx(arr: Any, starts: Sequence[Any]) -> tuple[Any, ...]:
     return tuple(starts) + (0,) * (arr.ndim - len(starts))
 
 
-def cache_put(cache, chunk, starts):
+def cache_put(cache: Any, chunk: Any, starts: Sequence[Any]) -> Any:
     """``dynamic_update_slice`` a chunk of rows into a cache at index
     ``starts`` over the leading axes (head/feature axes start at 0).
 
@@ -182,11 +182,11 @@ def cache_put(cache, chunk, starts):
     )
 
 
-def cache_take(cache, starts, lead_sizes):
+def cache_take(cache: Any, starts: Sequence[Any], lead_sizes: Sequence[int]) -> Any:
     """``dynamic_slice`` rows out of a cache: ``starts``/``lead_sizes``
     cover the leading axes; the head/feature axes are taken whole."""
 
-    def take(arr):
+    def take(arr: Any) -> Any:
         sizes = tuple(lead_sizes) + arr.shape[len(lead_sizes):]
         return lax.dynamic_slice(arr, _pad_idx(arr, starts), sizes)
 
@@ -198,19 +198,19 @@ def cache_take(cache, starts, lead_sizes):
 # ---------------------------------------------------------------------------
 
 
-def kv_host(cache):
+def kv_host(cache: Any) -> Any:
     """Device cache/rows → host (numpy leaves). Session offload, the
     prefix pool's host-paged tier, and crash-surviving pages go through
     here — int8 rows page at half the bf16 byte count."""
     return kv_map(np.asarray, cache)
 
 
-def kv_device(cache):
+def kv_device(cache: Any) -> Any:
     """Host rows → device arrays (the restore/seed promotion path)."""
     return kv_map(jnp.asarray, cache)
 
 
-def cache_bytes(*caches) -> int:
+def cache_bytes(*caches: Any) -> int:
     """Total bytes of the given caches (0 for None entries) — scales
     included, so capacity claims are measured against the real
     allocation."""

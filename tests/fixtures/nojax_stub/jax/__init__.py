@@ -1,0 +1,8 @@
+"""Poisoned jax stub (tests/test_analysis.py): the analysis CLI must run
+in containers with no accelerator stack, so importing jax from anywhere
+under ``python -m omnia_tpu.analysis`` is a hard failure."""
+
+raise ImportError(
+    "omnia_tpu.analysis must not import jax (poisoned stub — see "
+    "tests/test_analysis.py::test_cli_module_runs_clean_without_jax)"
+)

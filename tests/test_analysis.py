@@ -1,0 +1,592 @@
+"""Static-analysis suite gate (ISSUE 9): the repo-invariant checkers in
+omnia_tpu/analysis/ run over the real tree with ZERO unwaived findings,
+plus per-checker unit tests on synthetic good/bad snippets (waiver
+parsing included). Everything here is pure-AST — no jax import, so the
+module runs in the CI analysis job's minimal container too."""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from omnia_tpu.analysis.cli import CHECKERS, run_checkers
+from omnia_tpu.analysis.core import (
+    SourceFile,
+    analyze_file_set,
+    apply_waivers,
+    repo_root,
+)
+from omnia_tpu.analysis.guardcheck import check_guards
+from omnia_tpu.analysis.jaxfree import check_jaxfree
+from omnia_tpu.analysis.locks import check_locks
+from omnia_tpu.analysis.metricscheck import check_metrics
+from omnia_tpu.analysis.purity import check_purity
+
+pytestmark = pytest.mark.analysis
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _write(root, rel, text):
+    path = os.path.join(root, rel)
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, "w") as f:
+        f.write(textwrap.dedent(text))
+    return rel
+
+
+# ---------------------------------------------------------------------------
+# The real gate: the whole tree is clean.
+# ---------------------------------------------------------------------------
+
+
+class TestRepoIsClean:
+    def test_all_checkers_zero_unwaived_findings(self):
+        findings = run_checkers(REPO, CHECKERS)
+        unwaived = [f for f in findings if not f.waived]
+        assert not unwaived, "\n" + "\n".join(f.render() for f in unwaived)
+
+    def test_repo_root_autodetects_this_checkout(self):
+        assert repo_root() == REPO
+        assert repo_root(os.path.join(REPO, "omnia_tpu", "engine")) == REPO
+
+    def test_cli_module_runs_clean_without_jax(self):
+        """`python -m omnia_tpu.analysis` is the CI entry point: it must
+        exit 0 on this tree AND never import jax (the analysis container
+        has no accelerator stack). A poisoned jax stub proves it."""
+        env = dict(os.environ)
+        stub = os.path.join(REPO, "tests", "fixtures", "nojax_stub")
+        env["PYTHONPATH"] = stub + os.pathsep + env.get("PYTHONPATH", "")
+        out = subprocess.run(
+            [sys.executable, "-m", "omnia_tpu.analysis"],
+            cwd=REPO, env=env, capture_output=True, text=True, timeout=120,
+        )
+        assert out.returncode == 0, out.stdout + out.stderr
+        assert "0 finding(s)" in out.stdout
+
+
+# ---------------------------------------------------------------------------
+# Waiver parsing.
+# ---------------------------------------------------------------------------
+
+
+class TestWaivers:
+    def _src(self, tmp_path, text):
+        rel = _write(str(tmp_path), "omnia_tpu/engine/mock.py", text)
+        return SourceFile(str(tmp_path), rel)
+
+    def test_trailing_and_standalone_waivers_parse(self, tmp_path):
+        src = self._src(tmp_path, """\
+            x = 1  # analysis: allow(lock-guard) — engine-thread-owned here
+            # analysis: allow(purity): trace-time constant by design
+            y = 2
+        """)
+        assert not src.malformed_waivers
+        assert {(w.rule, w.line) for w in src.waivers} == {
+            ("lock-guard", 1), ("purity", 3),
+        }
+        assert all(w.reason for w in src.waivers)
+
+    def test_reasonless_and_unknown_rule_waivers_are_malformed(self, tmp_path):
+        src = self._src(tmp_path, """\
+            a = 1  # analysis: allow(lock-guard)
+            b = 2  # analysis: allow(made-up-rule) — whatever
+        """)
+        assert len(src.malformed_waivers) == 2
+        assert not src.waivers
+
+    def test_waiver_suppresses_matching_finding_only(self, tmp_path):
+        text = """\
+            import threading
+
+            class MockEngine:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._draining = False  # guarded-by: _lock
+
+                def poke(self):
+                    self._draining = True  # analysis: allow(lock-guard) — test fixture
+
+                def peek(self):
+                    return self._draining
+        """
+        rel = _write(str(tmp_path), "omnia_tpu/engine/mock.py", text)
+        sources = analyze_file_set(str(tmp_path), [rel])
+        findings = apply_waivers(check_locks(sources), sources)
+        waived = [f for f in findings if f.waived]
+        live = [f for f in findings if not f.waived]
+        assert len(waived) == 1 and waived[0].line == 9
+        assert len(live) == 1 and live[0].line == 12  # read not covered
+
+    def test_unused_waiver_is_flagged_on_full_runs(self, tmp_path):
+        text = """\
+            class MockEngine:
+                def __init__(self):
+                    self.x = 1  # analysis: allow(lock-guard) — nothing here needs this
+        """
+        rel = _write(str(tmp_path), "omnia_tpu/engine/mock.py", text)
+        sources = analyze_file_set(str(tmp_path), [rel])
+        findings = apply_waivers(check_locks(sources), sources,
+                                 check_unused=True)
+        assert [f for f in findings if f.rule == "waiver"]
+
+
+# ---------------------------------------------------------------------------
+# Lock discipline.
+# ---------------------------------------------------------------------------
+
+
+class TestLockChecker:
+    def _run(self, tmp_path, body):
+        rel = _write(str(tmp_path), "omnia_tpu/engine/mock.py", body)
+        return check_locks(analyze_file_set(str(tmp_path), [rel]))
+
+    def test_guarded_access_outside_lock_flagged(self, tmp_path):
+        findings = self._run(tmp_path, """\
+            import threading
+
+            class MockEngine:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._live = 0  # guarded-by: _lock
+
+                def good(self):
+                    with self._lock:
+                        self._live += 1
+
+                def bad_write(self):
+                    self._live = 0
+
+                def bad_read(self):
+                    return self._live
+        """)
+        assert sorted((f.rule, f.line) for f in findings) == [
+            ("lock-guard", 13), ("lock-guard", 16),
+        ]
+
+    def test_init_and_other_fields_exempt(self, tmp_path):
+        findings = self._run(tmp_path, """\
+            class MockEngine:
+                def __init__(self):
+                    self._live = 0  # guarded-by: _lock
+                    self._live = self._live + 1
+                    self.other = 2
+
+                def touch_other(self):
+                    self.other += 1
+        """)
+        assert findings == []
+
+    def test_lock_scope_survives_try_except_and_nested_with(self, tmp_path):
+        findings = self._run(tmp_path, """\
+            import threading
+
+            class MockEngine:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._live = 0  # guarded-by: _lock
+
+                def ok(self):
+                    try:
+                        pass
+                    except Exception:
+                        with self._lock:
+                            self._live -= 1
+                        raise
+        """)
+        assert findings == []
+
+    def test_closure_under_lock_does_not_inherit_scope(self, tmp_path):
+        findings = self._run(tmp_path, """\
+            import threading
+
+            class MockEngine:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._live = 0  # guarded-by: _lock
+
+                def leak(self):
+                    with self._lock:
+                        def later():
+                            self._live += 1
+                        return later
+        """)
+        assert [(f.rule, f.line) for f in findings] == [("lock-guard", 11)]
+
+    def test_blocking_call_under_lock_flagged(self, tmp_path):
+        findings = self._run(tmp_path, """\
+            import threading
+            import time
+            import numpy as np
+
+            class MockEngine:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.workers = []
+
+                def bad(self, toks):
+                    with self._lock:
+                        time.sleep(0.1)
+                        load = self.workers[0].queue_depth()
+                        host = np.asarray(toks)
+                    return load, host
+
+                def good(self, toks):
+                    with self._lock:
+                        depth = len(self.workers)
+                    time.sleep(0.0)
+                    return depth, np.asarray(toks)
+        """)
+        assert sorted((f.rule, f.line) for f in findings) == [
+            ("lock-blocking", 12), ("lock-blocking", 13),
+            ("lock-blocking", 14),
+        ]
+
+    def test_mixin_annotations_apply_across_engine_family(self, tmp_path):
+        root = str(tmp_path)
+        a = _write(root, "omnia_tpu/engine/engine.py", """\
+            class InferenceEngine:
+                def __init__(self):
+                    self._waiting = []  # guarded-by: _lock
+        """)
+        b = _write(root, "omnia_tpu/engine/scheduler.py", """\
+            class _SchedulerMixin:
+                def peek(self):
+                    return len(self._waiting)
+        """)
+        findings = check_locks(analyze_file_set(root, [a, b]))
+        assert [(f.path, f.rule) for f in findings] == [
+            ("omnia_tpu/engine/scheduler.py", "lock-guard"),
+        ]
+
+
+# ---------------------------------------------------------------------------
+# Trace purity.
+# ---------------------------------------------------------------------------
+
+
+class TestPurityChecker:
+    def _run(self, tmp_path, body):
+        rel = _write(str(tmp_path), "omnia_tpu/engine/programs.py", body)
+        return check_purity(analyze_file_set(str(tmp_path), [rel]))
+
+    def test_host_effects_in_jit_body_flagged(self, tmp_path):
+        findings = self._run(tmp_path, """\
+            import time
+            import random
+            import numpy as np
+            import jax
+
+            def decode(tokens):
+                t0 = time.monotonic()
+                jitter = random.random()
+                host = np.asarray(tokens)
+                print(tokens)
+                return tokens.item() + t0 + jitter + host
+
+            decode_fn = jax.jit(decode)
+        """)
+        rules = {(f.line, f.rule) for f in findings}
+        assert {(7, "purity"), (8, "purity"), (9, "purity"),
+                (10, "purity"), (11, "purity")} <= rules
+
+    def test_scan_body_and_transitive_callee_covered(self, tmp_path):
+        findings = self._run(tmp_path, """\
+            import time
+            import jax
+
+            def helper(x):
+                return x + time.time()
+
+            def make():
+                def body(carry, _):
+                    return helper(carry), carry
+                return body
+
+            def outer(init):
+                body = make()
+                return jax.lax.scan(make(), init, None, length=4)
+
+            outer_fn = jax.jit(outer)
+        """)
+        assert any(f.line == 5 for f in findings), findings
+
+    def test_pure_jit_body_and_untraced_host_code_clean(self, tmp_path):
+        findings = self._run(tmp_path, """\
+            import time
+            import jax
+            import jax.numpy as jnp
+
+            def decode(tokens, key_data):
+                key = jax.random.wrap_key_data(key_data)
+                noise = jax.random.gumbel(key, tokens.shape)
+                return jnp.asarray(tokens) + noise
+
+            decode_fn = jax.jit(decode)
+
+            def host_dispatch(fn, tokens):
+                t0 = time.monotonic()
+                out = fn(tokens)
+                print("dispatched in", time.monotonic() - t0)
+                return out
+        """)
+        assert findings == []
+
+    def test_lambda_passed_to_tracer_is_checked(self, tmp_path):
+        findings = self._run(tmp_path, """\
+            import time
+            import jax
+
+            def outer(init, xs):
+                return jax.lax.scan(
+                    lambda c, x: (c + time.time(), x), init, xs
+                )
+        """)
+        assert [(f.line, f.rule) for f in findings] == [(6, "purity")]
+        assert "<lambda>" in findings[0].message
+
+    def test_rule_is_self_scoped_to_the_purity_file_set(self, tmp_path):
+        """Files loaded for OTHER rules (lock groups, registries) must
+        not widen the purity scope on full runs — mock.py is outside
+        PURITY_FILES_PREFIXES, so a traced host effect there is (by
+        scope policy) not this rule's to flag."""
+        rel = _write(str(tmp_path), "omnia_tpu/engine/mock.py", """\
+            import time
+            import jax
+
+            def bad(x):
+                return x + time.time()
+
+            bad_fn = jax.jit(bad)
+        """)
+        assert check_purity(analyze_file_set(str(tmp_path), [rel])) == []
+
+    def test_method_sharing_a_traced_name_is_not_traced(self, tmp_path):
+        """A bare Name can never reference a class method, so a method
+        that happens to share its name with a jitted function must NOT
+        be pulled into the traced set (false-positive guard)."""
+        findings = self._run(tmp_path, """\
+            import time
+            import jax
+
+            def step(x):
+                return x + 1
+
+            step_fn = jax.jit(step)
+
+            class Helper:
+                def step(self, x):
+                    return x + time.time()
+        """)
+        assert findings == []
+
+    def test_nested_traced_def_violation_reported_once(self, tmp_path):
+        findings = self._run(tmp_path, """\
+            import time
+            import jax
+
+            def outer(x):
+                def body(c):
+                    return c + time.time()
+                return body(x)
+
+            outer_fn = jax.jit(outer)
+        """)
+        assert len(findings) == 1, findings
+        assert findings[0].line == 6 and "'body'" in findings[0].message
+
+    def test_partial_wrapped_tracers_are_covered(self, tmp_path):
+        """The two functools.partial idioms the kernels use:
+        ``@partial(jax.jit, ...)`` decorators and
+        ``pallas_call(partial(kernel, ...))`` call sites — both must
+        mark their function traced (the decode-attention gap)."""
+        findings = self._run(tmp_path, """\
+            import functools
+            import time
+            import jax
+            import jax.experimental.pallas as pl
+
+            @functools.partial(jax.jit, static_argnames=("block",))
+            def decode_attn(q, block=8):
+                t0 = time.time()
+                return q + t0
+
+            def _kernel(ref, block):
+                print(ref)
+
+            def launch(x):
+                return pl.pallas_call(
+                    functools.partial(_kernel, block=4),
+                    out_shape=None,
+                )(x)
+        """)
+        assert {(f.line, f.rule) for f in findings} == {
+            (8, "purity"), (12, "purity"),
+        }, findings
+
+    def test_self_mutation_in_traced_body_flagged(self, tmp_path):
+        findings = self._run(tmp_path, """\
+            import jax
+
+            class Holder:
+                def step(self, x):
+                    def body(y):
+                        self.cache = y
+                        return y
+                    return jax.jit(body)(x)
+        """)
+        assert [(f.line, f.rule) for f in findings] == [(6, "purity")]
+
+
+# ---------------------------------------------------------------------------
+# Guard conformance.
+# ---------------------------------------------------------------------------
+
+
+class TestGuardChecker:
+    def _repo(self, tmp_path, registry):
+        root = str(tmp_path)
+        files = [
+            _write(root, "omnia_tpu/engine/types.py", """\
+                import dataclasses
+
+                @dataclasses.dataclass(frozen=True)
+                class EngineConfig:
+                    num_slots: int = 8
+                    kv_quant: str | None = None
+            """),
+            _write(root, "omnia_tpu/engine/mock.py", """\
+                class MockEngine:
+                    def __init__(self, scenarios=(), tokenizer=None,
+                                 fault_plan=None):
+                        pass
+            """),
+            _write(root, "tests/test_guards.py", registry),
+        ]
+        return root, analyze_file_set(root, files)
+
+    def test_clean_registry_passes(self, tmp_path):
+        root, sources = self._repo(tmp_path, """\
+            KNOB_GUARDS = {
+                "EngineConfig.num_slots": "structural: batch shape",
+                "EngineConfig.kv_quant": "test_guards.py::test_kv_off",
+                "MockEngine.fault_plan": "structural: injection input",
+            }
+
+            def test_kv_off():
+                pass
+        """)
+        assert check_guards(root, sources) == []
+
+    def test_unregistered_missing_and_stale_flagged(self, tmp_path):
+        root, sources = self._repo(tmp_path, """\
+            KNOB_GUARDS = {
+                "EngineConfig.num_slots": "structural: batch shape",
+                "EngineConfig.kv_quant": "test_guards.py::test_gone",
+                "EngineConfig.removed_knob": "structural: old",
+            }
+        """)
+        messages = [f.message for f in check_guards(root, sources)]
+        assert any("MockEngine.fault_plan" in m for m in messages)
+        assert any("test_gone" in m for m in messages)
+        assert any("removed_knob" in m for m in messages)
+
+    def test_missing_registry_is_one_finding(self, tmp_path):
+        root, sources = self._repo(tmp_path, "X = 1\n")
+        findings = check_guards(root, sources)
+        assert len(findings) == 1 and "KNOB_GUARDS" in findings[0].message
+
+
+# ---------------------------------------------------------------------------
+# Metrics conformance.
+# ---------------------------------------------------------------------------
+
+
+class TestMetricsChecker:
+    def _repo(self, tmp_path, engine_body, expected, docs_keys):
+        root = str(tmp_path)
+        files = [
+            _write(root, "omnia_tpu/engine/engine.py", engine_body),
+            _write(root, "tests/test_prefix_cache.py", f"""\
+                class TestMetricsKeyStability:
+                    EXPECTED = {expected!r}
+                    MOCK_ONLY = set()
+                    COORDINATOR = set()
+            """),
+        ]
+        _write(root, "docs/serving.md",
+               "\n".join(f"| `{k}` | row |" for k in docs_keys) + "\n")
+        return root, analyze_file_set(root, files)
+
+    ENGINE = """\
+        class InferenceEngine:
+            def __init__(self):
+                self.metrics = {"tokens_generated": 0}
+
+            def step(self):
+                self.metrics["tokens_generated"] += 1
+                self.metrics["mystery_counter"] += 1
+    """
+
+    def test_unregistered_and_undocumented_key_flagged(self, tmp_path):
+        root, sources = self._repo(
+            tmp_path, self.ENGINE, {"tokens_generated"}, ["tokens_generated"]
+        )
+        msgs = [f.message for f in check_metrics(root, sources)]
+        assert any(
+            "mystery_counter" in m and "not registered" in m for m in msgs
+        )
+        assert any(
+            "mystery_counter" in m and "not documented" in m for m in msgs
+        )
+
+    def test_stale_registry_row_flagged(self, tmp_path):
+        root, sources = self._repo(
+            tmp_path, self.ENGINE,
+            {"tokens_generated", "mystery_counter", "ghost_metric"},
+            ["tokens_generated", "mystery_counter", "ghost_metric"],
+        )
+        msgs = [f.message for f in check_metrics(root, sources)]
+        assert msgs == [
+            "stale registry row: TestMetricsKeyStability.EXPECTED contains "
+            "'ghost_metric' but no engine/mock/coordinator code writes it"
+        ]
+
+    def test_empty_set_literals_parse(self, tmp_path):
+        """``MOCK_ONLY = set()`` must not crash registry loading (an
+        ast.Set literal cannot be empty)."""
+        root, sources = self._repo(
+            tmp_path, self.ENGINE, {"tokens_generated", "mystery_counter"},
+            ["tokens_generated", "mystery_counter"],
+        )
+        assert check_metrics(root, sources) == []
+
+
+# ---------------------------------------------------------------------------
+# Jax-free packages.
+# ---------------------------------------------------------------------------
+
+
+class TestJaxfreeChecker:
+    def test_any_position_jax_import_flagged(self, tmp_path):
+        root = str(tmp_path)
+        rel = _write(root, "omnia_tpu/engine/grammar/fsm.py", """\
+            def compile(pattern):
+                import jax.numpy as jnp
+                return jnp.zeros(3)
+        """)
+        findings = check_jaxfree(analyze_file_set(root, [rel]))
+        assert [(f.rule, f.line) for f in findings] == [("jaxfree", 2)]
+
+    def test_from_jax_and_clean_file(self, tmp_path):
+        root = str(tmp_path)
+        bad = _write(root, "omnia_tpu/engine/grammar/regex.py",
+                     "from jax import numpy\n")
+        ok = _write(root, "omnia_tpu/engine/grammar/cache.py",
+                    "import hashlib\nfrom jaxtyping_like import x\n")
+        findings = check_jaxfree(analyze_file_set(root, [bad, ok]))
+        assert [f.path for f in findings] == [bad]

@@ -376,6 +376,35 @@ class TestGracefulDrain:
         assert (eng.metrics["requests_finished"]
                 == eng.metrics["requests_submitted"] == 2)
 
+    def test_drain_wait_covers_mid_placement_under_lock(self):
+        """ISSUE 9 lock-discipline regression: the drain wait reads the
+        ``_placing`` claim in the SAME critical section as the queue
+        (lifecycle._drain_work_left) — the pre-fix unlocked read could
+        end the drain while a request sat mid-placement in neither
+        ledger. Simulate a stuck placement claim and assert the drain
+        genuinely waits for it, then closes admission."""
+        import threading
+
+        eng = _tiny_engine()
+        with eng._lock:
+            eng._placing += 1
+        released_at = []
+
+        def releaser():
+            time.sleep(0.15)
+            with eng._lock:
+                eng._placing -= 1
+            released_at.append(time.monotonic())
+
+        threading.Thread(target=releaser, daemon=True).start()
+        t0 = time.monotonic()
+        eng.stop(drain=True, drain_timeout_s=5.0)
+        assert released_at, "drain returned before the claim released"
+        assert time.monotonic() - t0 >= 0.14
+        # Draining flag was flipped under the lock; admission is closed.
+        _, fin = _drain_events(eng.submit([1, 2], GREEDY))
+        assert fin.finish_reason == FinishReason.OVERLOADED
+
     def test_restart_after_drain_reopens_admission(self):
         eng = _tiny_engine()
         eng.start()

@@ -454,7 +454,11 @@ class TestPoolDisabledNoop:
 
 class TestMetricsKeyStability:
     """Dashboard/doctor read these names — renaming one is a breaking
-    change and must show up here, not in a broken panel."""
+    change and must show up here, not in a broken panel. The three set
+    literals below are ALSO the machine-readable registries the static
+    metrics-conformance checker (omnia_tpu/analysis/metricscheck.py)
+    cross-checks against every metrics-write site and the
+    docs/serving.md tables — keep them as plain string-set literals."""
 
     EXPECTED = {
         "requests_submitted", "requests_finished", "tokens_generated",
@@ -470,17 +474,48 @@ class TestMetricsKeyStability:
         "kv_quant_enabled", "kv_quant_bytes_per_token",
         "kv_quant_device_bytes",
         "requests_shed", "deadline_exceeded", "watchdog_trips",
+        "recoveries",
         "mixed_steps", "interleaved_prefill_tokens", "decode_stall_steps",
+    }
+
+    # MockEngine-private keys (beyond its EXPECTED mirror): the host-side
+    # int8-KV round-trip evidence the real cache cannot report.
+    MOCK_ONLY = {
+        "kv_quant_rows_written", "kv_quant_roundtrip_rel_err",
+    }
+
+    # EngineCoordinator's fleet-routing ledger.
+    COORDINATOR = {
+        "routed", "failovers", "affinity_evictions",
+        "prefix_routed", "prefix_failovers", "prefix_spills",
+        "shed", "resubmits",
     }
 
     def test_engine_metric_keys_are_stable(self):
         eng = _engine()
         assert set(eng.metrics) == self.EXPECTED
 
+    def test_mock_metric_keys_are_stable(self):
+        from omnia_tpu.engine.mock import MockEngine
+
+        keys = set(MockEngine().metrics)
+        assert self.MOCK_ONLY <= keys
+        assert keys - self.MOCK_ONLY <= self.EXPECTED, (
+            keys - self.MOCK_ONLY - self.EXPECTED
+        )
+
+    def test_coordinator_metric_keys_are_stable(self):
+        from omnia_tpu.engine.coordinator import EngineCoordinator
+        from omnia_tpu.engine.mock import MockEngine
+
+        coord = EngineCoordinator([MockEngine()])
+        assert set(coord.metrics) == self.COORDINATOR
+
     def test_docs_cover_every_metric_key(self):
         with open(os.path.join(REPO, "docs", "serving.md")) as f:
             doc = f.read()
-        missing = [k for k in self.EXPECTED | {"recoveries"} if f"`{k}`" not in doc]
+        everything = self.EXPECTED | self.MOCK_ONLY | self.COORDINATOR
+        missing = [k for k in everything if f"`{k}`" not in doc]
         assert not missing, f"docs/serving.md missing metric keys: {missing}"
 
 

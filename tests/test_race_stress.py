@@ -442,6 +442,197 @@ def test_interleaved_lockstep_drain_counter():
     assert not run_interleaved(scenario, seeds=range(5))
 
 
+def test_interleaved_mock_drain_vs_submit_ledger():
+    """ISSUE 9 lock-discipline regression (seeded schedules): MockEngine
+    ``stop(drain=True)`` racing ``submit``. The pre-fix unlocked
+    ``_draining`` write could interleave with submit's check-and-reserve
+    so a playback was admitted after the drain decided the engine was
+    idle. Under every forced schedule: each submit reaches exactly one
+    terminal, and the ledger reconciles exactly —
+    attempts == submitted + shed and submitted == finished."""
+    from omnia_tpu.engine.mock import MockEngine, Scenario
+
+    def scenario():
+        eng = MockEngine([Scenario(".", "abcdef")])
+        eng.start()
+        results: list = []
+
+        def submitter(k: int):
+            def body():
+                for j in range(5):
+                    h = eng.submit([k, j, 1], SamplingParams(max_tokens=3))
+                    _toks, fin = h.collect_tokens(timeout=20)
+                    results.append(fin)
+            return body
+
+        def drainer():
+            h = eng.submit([9, 9], SamplingParams(max_tokens=3))
+            _toks, fin = h.collect_tokens(timeout=20)
+            results.append(fin)
+            eng.stop(drain=True, drain_timeout_s=20)
+
+        def check():
+            import time as _t
+
+            attempts = 3 * 5 + 1
+            finals = list(results)
+            assert len(finals) == attempts, len(finals)
+            assert all(f.finish_reason is not None for f in finals)
+            # requests_finished increments AFTER the terminal push; give
+            # the playback threads a bounded moment to balance the books.
+            deadline = _t.monotonic() + 5
+            while _t.monotonic() < deadline:
+                m = eng.metrics
+                with eng._lock:
+                    submitted, finished, shed = (
+                        m["requests_submitted"], m["requests_finished"],
+                        m["requests_shed"],
+                    )
+                if submitted == finished and submitted + shed == attempts:
+                    return
+                _t.sleep(0.005)
+            raise AssertionError(
+                f"ledger never reconciled: submitted={submitted} "
+                f"finished={finished} shed={shed} attempts={attempts}"
+            )
+
+        return [submitter(0), submitter(1), submitter(2), drainer], check
+
+    assert not run_interleaved(scenario, seeds=range(5), timeout_s=90)
+
+
+def test_interleaved_coordinator_drain_failover_ledger():
+    """ISSUE 9 satellite: coordinator failover + drain under forced
+    interleavings — ``stop(drain=True)`` racing ``submit`` and
+    ``release_session``, with worker 0 killing a counted number of
+    requests pre-token. The PR 5 ledger must reconcile EXACTLY under
+    every schedule: one terminal per submit, routed == accepted submits,
+    resubmits == injected zero-token deaths, and worker books
+    (submitted + shed vs routed + resubmits) balance fleet-wide."""
+    from omnia_tpu.engine.coordinator import EngineCoordinator
+    from omnia_tpu.engine.faults import FaultPlan
+    from omnia_tpu.engine.mock import MockEngine, Scenario
+
+    def scenario():
+        plan = FaultPlan(die_after_tokens=0, die_count=3)
+        workers = [
+            MockEngine([Scenario(".", "w")],
+                       fault_plan=plan if i == 0 else None)
+            for i in range(3)
+        ]
+        for w in workers:
+            w.start()
+        coord = EngineCoordinator(workers, resubmit_retries=2,
+                                  probe_interval_s=0.0)
+        finals: list = []
+
+        def submitter(k: int):
+            def body():
+                for j in range(4):
+                    h = coord.submit([1 + k, 2 + j],
+                                     SamplingParams(max_tokens=2),
+                                     session_id=f"dr-{(k + j) % 3}")
+                    _toks, fin = h.collect_tokens(timeout=30)
+                    finals.append(fin)
+            return body
+
+        def releaser():
+            for sid in ("dr-0", "dr-1", "dr-2", "dr-0"):
+                coord.release_session(sid)
+
+        def drainer():
+            coord.stop(drain=True)
+
+        def check():
+            import time as _t
+
+            total = 2 * 4
+            assert len(finals) == total
+            assert all(f.finish_reason is not None for f in finals)
+            # Worker books balance once playback threads finish their
+            # post-terminal increments (bounded wait).
+            deadline = _t.monotonic() + 5
+            while _t.monotonic() < deadline:
+                snap = []
+                for w in workers:
+                    with w._lock:
+                        snap.append((
+                            w.metrics["requests_submitted"],
+                            w.metrics["requests_finished"],
+                            w.metrics["requests_shed"],
+                        ))
+                if all(s == f for s, f, _ in snap):
+                    break
+                _t.sleep(0.005)
+            assert all(s == f for s, f, _ in snap), snap
+            with coord._metrics_lock:
+                routed = coord.metrics["routed"]
+                resubmits = coord.metrics["resubmits"]
+                shed = coord.metrics["shed"]
+            # Every submit found a worker (all stay healthy; drain sheds
+            # AT the worker, not before routing) and every injected
+            # zero-token death was transparently resubmitted.
+            assert routed == total and shed == 0
+            assert resubmits == plan.fired["deaths"]
+            # Fleet-wide attempt conservation: each routed submit +
+            # each resubmit landed on exactly one worker, where it was
+            # either accepted or shed by the drain.
+            accepted = sum(s for s, _f, _sh in snap)
+            worker_shed = sum(sh for _s, _f, sh in snap)
+            assert accepted + worker_shed == routed + resubmits, (
+                snap, routed, resubmits
+            )
+            # Affinity hygiene under release/drain races: surviving pins
+            # only name real workers.
+            with coord._lock:
+                assert all(0 <= i < 3 for i in coord._affinity.values())
+
+        return [submitter(0), submitter(1), releaser, drainer], check
+
+    assert not run_interleaved(scenario, seeds=range(5), timeout_s=120)
+
+
+def test_interleaved_prober_hard_and_soft_evidence():
+    """ISSUE 9 lock-discipline regression: ``_note_probe`` now reads the
+    per-worker health record inside ``_health_lock`` (the read raced
+    probe writers before). Hammer mixed hard/soft evidence under forced
+    schedules and assert the cached state can never wedge: counters stay
+    non-negative and the worker still transitions down on consecutive
+    failures and back up on recovery."""
+    from omnia_tpu.engine.coordinator import EngineCoordinator
+    from omnia_tpu.engine.mock import MockEngine
+
+    def scenario():
+        coord = EngineCoordinator(
+            [MockEngine()], health_fail_threshold=3, health_cooldown_s=0.0,
+        )
+
+        def noter(hard: bool):
+            def body():
+                for i in range(40):
+                    coord._note_probe(0, i % 3 != 0, hard=hard and i % 7 == 0)
+            return body
+
+        def check():
+            with coord._health_lock:
+                st = coord._health[0]
+                assert st.fails >= 0
+            # Post-contention the state machine must still move: three
+            # consecutive failures down the worker, one success (zero
+            # cooldown) reinstates it.
+            for _ in range(3):
+                coord._note_probe(0, False)
+            with coord._health_lock:
+                assert not coord._health[0].up
+            coord._note_probe(0, True)
+            with coord._health_lock:
+                assert coord._health[0].up
+
+        return [noter(True), noter(False), noter(False)], check
+
+    assert not run_interleaved(scenario, seeds=range(5))
+
+
 def test_interleaved_media_grant_lifecycle():
     """MediaStore negotiate/put/resolve across threads: every granted
     upload resolves to exactly the bytes its thread wrote (cross-ref
