@@ -435,6 +435,19 @@ def child_main() -> None:
             _log(f"interleave bench failed: {exc!r}")
             interleave = {"error": repr(exc)}
 
+    # --- flight-recorder latency decomposition (engine/flight.py) -----
+    # p50/p99 TTFT decomposition from per-request LatencyBreakdowns +
+    # the recorder-on-vs-off overhead A/B (< 2% decode tok/s pin).
+    # Runs on accel and CPU — the recorder is host-side bookkeeping.
+    latency = None
+    if remaining() > (90 if on_accel else 40):
+        try:
+            latency = _bench_latency(cfg, remaining, on_accel)
+            _log(f"latency bench done: {latency}")
+        except Exception as exc:  # noqa: BLE001 - aux evidence only
+            _log(f"latency bench failed: {exc!r}")
+            latency = {"error": repr(exc)}
+
     # --- honest CPU fallback (VERDICT r5 #10) -------------------------
     # No accelerator: a test-tiny float32 TTFT against the 400 ms TPU
     # target is meaningless, so the fallback drops vs_baseline entirely
@@ -483,6 +496,7 @@ def child_main() -> None:
                 "grammar": grammar_bench,
                 "overload": overload,
                 "interleave": interleave,
+                "latency": latency,
                 # Chip-roofline ratios are meaningless against CPU
                 # timings — explicitly null, never quoted against an
                 # assumed TPU spec (the old "assumed v5e" label).
@@ -583,6 +597,8 @@ def child_main() -> None:
         result["aux"]["overload"] = overload
     if interleave is not None:
         result["aux"]["interleave"] = interleave
+    if latency is not None:
+        result["aux"]["latency"] = latency
     if w8 is not None:
         w8.pop("weight_bytes", None)
         result["aux"]["int8_dynamic"] = {
@@ -970,6 +986,192 @@ def _bench_kv_quant(cfg, remaining, on_accel):
         ),
         "greedy_token_agreement": round(agree / max(total, 1), 4),
         "ttft_delta_ms": round(q8["ttft_p50_ms"] - fp["ttft_p50_ms"], 2),
+    }
+
+
+def _bench_latency(cfg, remaining, on_accel):
+    """aux.latency: the flight recorder's own evidence — (a) p50/p99
+    TTFT decomposition (queue / placement / prefill / per-token decode)
+    from per-request LatencyBreakdowns over a small concurrent serve,
+    and (b) the recorder-overhead pin (< 2% decode tok/s on the CPU
+    run), measured TWO ways: a wall-clock on-vs-off A/B (median of
+    paired alternating rounds, spread reported — on a noisy shared host
+    this estimator's spread can exceed the pin itself) and a DIRECT
+    instrumentation of the "on" arm (every recorder call timed and
+    summed against the measured decode wall — deterministic, immune to
+    host drift, and exactly the added work the pin is about). The
+    boolean pin keys on the direct share; the A/B corroborates where
+    the host is quiet enough to resolve it."""
+    import functools
+    import gc
+
+    from omnia_tpu.engine import EngineConfig, InferenceEngine, SamplingParams
+
+    base = dict(
+        num_slots=4, max_seq=128, prefill_buckets=(16,),
+        dtype="bfloat16" if on_accel else "float32", max_sessions=0,
+        # The engine DEFAULT chunk size — the representative shape for
+        # a per-chunk-recording overhead claim (the overload bench's
+        # chunk=4 would double the recorder's per-chunk events vs what
+        # production configs dispatch).
+        decode_chunk=8,
+    )
+    prompt = list(range(1, 13))
+    # Short batches, MANY pairs: on a noisy shared host the per-pair
+    # delta distribution is what matters — its median is the estimator,
+    # and more short samples beat fewer long ones (XLA's intra-op pool
+    # makes long windows drift-prone, measured, not assumed).
+    sp = SamplingParams(temperature=0.0, max_tokens=24)
+
+    def serve_batch(engine):
+        """One full concurrent batch; returns (tok/s, wall_s)."""
+        t0 = time.monotonic()
+        handles = [engine.submit(prompt, sp) for _ in range(12)]
+        tokens = 0
+        for h in handles:
+            toks, _fin = h.collect_tokens(timeout=300)
+            tokens += len(toks)
+        wall = max(time.monotonic() - t0, 1e-6)
+        return tokens / wall, wall
+
+    def instrument_recorder(rec, acc):
+        """Shadow every note_* on THIS recorder instance with a timing
+        wrapper accumulating into acc['t'] — the direct measurement of
+        the work the recorder adds to the serve path."""
+        for name in dir(rec):
+            if not name.startswith("note_"):
+                continue
+            orig = getattr(rec, name)
+
+            def wrapped(*a, _orig=orig, **k):
+                t0 = time.perf_counter()
+                r = _orig(*a, **k)
+                acc["t"] += time.perf_counter() - t0
+                acc["n"] += 1
+                return r
+
+            setattr(rec, name, functools.wraps(orig)(wrapped))
+
+    def build(flight_events):
+        engine = InferenceEngine(
+            cfg, EngineConfig(**base, flight_events=flight_events), seed=0
+        )
+        engine.warmup(sessions=False)
+        engine.start()
+        return engine
+
+    def pct(values, q):
+        if not values:
+            return None
+        vals = sorted(values)
+        return round(vals[min(len(vals) - 1, int(len(vals) * q))] * 1000, 3)
+
+    on = build(flight_events=4096)
+    off = None
+    try:
+        # Second build INSIDE the try: if it raises (compile/OOM), the
+        # first engine's loop thread must still be stopped — a leaked
+        # spinning loop would tax every later bench arm.
+        off = build(flight_events=0)
+        # One throwaway batch per arm (first-request costs), then
+        # ALTERNATING measured batches — order swapped per round, since
+        # within-pair position is itself a bias on a busy host — with a
+        # best-of estimator per arm: one-sided load noise can only slow
+        # a run down, so max tok/s is the honest per-arm capability
+        # (same reasoning as the grammar bench's min-over-short-batches).
+        serve_batch(on)
+        serve_batch(off)
+        # Terminals recorded so far are warmup (first-request/compile
+        # costs) — the decomposition below must exclude them, same rule
+        # as keeping warmup out of on_runs/off_runs. Marked by seq, so
+        # a ring overwrite can't shift the cut.
+        warm_terms = on._flight.events("terminal")
+        warm_last_seq = warm_terms[-1].seq if warm_terms else -1
+        rec_acc = {"t": 0.0, "n": 0}
+        instrument_recorder(on._flight, rec_acc)
+        on_runs, off_runs, pair_deltas = [], [], []
+        on_wall = 0.0
+        for i in range(12):
+            if remaining() < 15:
+                break
+            first, second = (on, off) if i % 2 == 0 else (off, on)
+            a, a_wall = serve_batch(first)
+            b, b_wall = serve_batch(second)
+            on_i, off_i = (a, b) if i % 2 == 0 else (b, a)
+            on_wall += a_wall if i % 2 == 0 else b_wall
+            on_runs.append(on_i)
+            off_runs.append(off_i)
+            # Adjacent-in-time pair: the delta cancels common-mode host
+            # drift that per-arm aggregates cannot.
+            pair_deltas.append((off_i - on_i) / max(off_i, 1e-9) * 100.0)
+        breakdowns = [
+            e.attrs["breakdown"]
+            for e in on._flight.events("terminal")
+            if e.seq > warm_last_seq
+        ]
+    finally:
+        on.stop()
+        if off is not None:
+            off.stop()
+        del on, off
+        gc.collect()
+    measured = bool(pair_deltas)
+    tok_s_on = max(on_runs) if on_runs else None
+    tok_s_off = max(off_runs) if off_runs else None
+    # An unmeasured pin must never present as evidence: with zero
+    # measured rounds (budget ran out during warmup) the A/B fields are
+    # null, not a vacuous "0% overhead, within bound". The A/B estimator
+    # is the MEDIAN of per-round paired deltas — order alternates and
+    # each pair is adjacent in time, so one-sided load drift cancels
+    # instead of landing entirely on one arm.
+    ab_overhead_pct = statistics.median(pair_deltas) if measured else None
+    # The pin itself keys on the DIRECT measurement: total time spent
+    # inside recorder calls during the measured "on" rounds over their
+    # decode wall — deterministic where wall-clock A/B drowns in host
+    # noise (observed pair spreads of 15-25% against a 2% pin).
+    direct_pct = (
+        rec_acc["t"] / on_wall * 100.0 if measured and on_wall > 0 else None
+    )
+
+    def col(key):
+        vals = [b[key] for b in breakdowns]
+        return {"p50": pct(vals, 0.5), "p99": pct(vals, 0.99)}
+
+    return {
+        "requests": len(breakdowns),
+        # Where TTFT went, stage by stage (ms, p50/p99 over requests).
+        "ttft_ms": col("ttft_s"),
+        "queue_ms": col("queue_s"),
+        "placement_ms": col("placement_s"),
+        "prefill_ms": col("prefill_s"),
+        "decode_ms_per_token": col("decode_s_per_token"),
+        # Recorder-overhead pin (< 2% decode tok/s, CPU run). All null
+        # when the budget ran out before a measured round completed.
+        # The boolean keys on the DIRECT instrumentation (recorder-call
+        # time / decode wall); the A/B median + spread ride alongside —
+        # where the spread dwarfs 2%, the host could not resolve the
+        # pin by wall clock and the direct number is the evidence.
+        "recorder_time_share_pct": (
+            round(direct_pct, 3) if direct_pct is not None else None
+        ),
+        "recorder_calls_timed": rec_acc["n"],
+        "overhead_within_2pct": (
+            direct_pct < 2.0 if direct_pct is not None else None
+        ),
+        "decode_tok_s_recorder_on": (
+            round(tok_s_on, 1) if measured else None
+        ),
+        "decode_tok_s_recorder_off": (
+            round(tok_s_off, 1) if measured else None
+        ),
+        "ab_overhead_pct": (
+            round(ab_overhead_pct, 2) if measured else None
+        ),
+        "ab_pairs": len(pair_deltas),
+        "ab_pair_spread_pct": (
+            round(max(pair_deltas) - min(pair_deltas), 2)
+            if measured else None
+        ),
     }
 
 

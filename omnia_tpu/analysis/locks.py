@@ -48,6 +48,10 @@ LOCK_GROUPS: tuple[tuple[str, tuple[str, ...]], ...] = (
     )),
     ("mock", ("omnia_tpu/engine/mock.py",)),
     ("coordinator", ("omnia_tpu/engine/coordinator.py",)),
+    # The flight recorder is its own concurrent class (submits arrive on
+    # caller threads, step events on the engine thread, terminals on
+    # either) — same machine-checked lock-at-access-site discipline.
+    ("flight", ("omnia_tpu/engine/flight.py",)),
 )
 
 #: Attribute names whose CALL under a held lock is (potentially)

@@ -28,11 +28,18 @@ import ast
 
 from omnia_tpu.analysis.core import Finding, SourceFile
 
-#: Files whose traced bodies are checked (the compiled-program surface).
+#: Files whose traced bodies are checked (the compiled-program surface —
+#: plus the flight-recorder layer and the scheduler/placement seams it
+#: instruments: all flight timing must be captured strictly host-side,
+#: so a host clock slipping into a traced body there is exactly this
+#: rule's bug class).
 PURITY_FILES_PREFIXES: tuple[str, ...] = (
     "omnia_tpu/engine/programs.py",
     "omnia_tpu/engine/interleave.py",
     "omnia_tpu/engine/spec_decode.py",
+    "omnia_tpu/engine/flight.py",
+    "omnia_tpu/engine/scheduler.py",
+    "omnia_tpu/engine/placement.py",
     "omnia_tpu/ops/",
     "omnia_tpu/models/",
     "omnia_tpu/parallel/",

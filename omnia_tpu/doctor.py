@@ -348,6 +348,79 @@ class Doctor:
             return CheckResult(name, PASS, detail=f"{len(lines)} series")
         self.register(name, check)
 
+    def add_engine_metrics_check(self, source) -> None:
+        """Engine-metrics family presence + freshness: the serving
+        engine's health must be visible on the scraped exposition
+        (`omnia_engine_*`, bridged by utils/metrics.bind_engine_metrics)
+        and computed LIVE — a cached snapshot would hide an engine that
+        stopped stepping. `source` is a /metrics URL or a zero-arg
+        callable returning exposition text (e.g. `registry.expose` for
+        in-process probing). Freshness is proven by the collector's
+        per-scrape `omnia_engine_scrape_unixtime` stamp advancing
+        between two scrapes."""
+        def scrape() -> str:
+            if callable(source):
+                return source()
+            with urllib.request.urlopen(source, timeout=5.0) as resp:
+                return resp.read(1 << 20).decode(errors="replace")
+
+        def stamp(body: str) -> Optional[float]:
+            for ln in body.splitlines():
+                if ln.startswith("omnia_engine_scrape_unixtime "):
+                    try:
+                        return float(ln.split()[1])
+                    except (IndexError, ValueError):
+                        return None
+            return None
+
+        def check() -> CheckResult:
+            try:
+                body = scrape()
+            except (urllib.error.URLError, OSError) as e:
+                return CheckResult("engine-metrics", FAIL, detail=str(e),
+                                   remedy=f"is the exporter at {source} up?")
+            family = [
+                ln for ln in body.splitlines()
+                if ln.startswith("omnia_engine_") and not ln.startswith("#")
+                # The collector's own freshness stamp is plumbing, not
+                # an engine series — it must not satisfy presence.
+                and not ln.startswith("omnia_engine_scrape_unixtime")
+            ]
+            if not family:
+                return CheckResult(
+                    "engine-metrics", FAIL,
+                    detail="no omnia_engine_* series in the exposition",
+                    remedy="bind the engine into the registry "
+                           "(utils/metrics.bind_engine_metrics)",
+                )
+            t1 = stamp(body)
+            time.sleep(0.05)
+            try:
+                t2 = stamp(scrape())
+            except (urllib.error.URLError, OSError) as e:
+                return CheckResult("engine-metrics", FAIL,
+                                   detail=f"second scrape failed: {e}",
+                                   remedy="exporter flapped mid-probe")
+            if t1 is None or t2 is None:
+                return CheckResult(
+                    "engine-metrics", WARN,
+                    detail="freshness stamp missing — staleness unprovable",
+                    remedy="collector predates scrape_unixtime; upgrade",
+                )
+            if t2 <= t1:
+                return CheckResult(
+                    "engine-metrics", FAIL,
+                    detail=f"scrape stamp did not advance ({t1} → {t2})",
+                    remedy="exposition is a cached snapshot, not a live "
+                           "collector — engine health is stale",
+                )
+            return CheckResult(
+                "engine-metrics", PASS,
+                detail=f"{len(family)} live engine series",
+            )
+
+        self.register("engine-metrics", check)
+
     def add_apiserver_check(self, client, expect_kinds: Optional[tuple] = None) -> None:
         """Cluster-mode CRD inventory: every omnia kind must be servable
         by the live apiserver through the kube client (the cluster twin

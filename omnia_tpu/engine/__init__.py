@@ -21,11 +21,15 @@ from omnia_tpu.engine.types import (
 _LAZY = {
     "InferenceEngine": "omnia_tpu.engine.engine",
     "MockEngine": "omnia_tpu.engine.mock",
+    # jax-free (engine/flight.py is pure stdlib — the dump CLI and
+    # hermetic recorder tests import it with no device stack).
+    "FlightRecorder": "omnia_tpu.engine.flight",
 }
 
 __all__ = [
     "EngineConfig",
     "FinishReason",
+    "FlightRecorder",
     "InferenceEngine",
     "MockEngine",
     "Request",

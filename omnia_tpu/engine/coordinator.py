@@ -60,6 +60,7 @@ import threading
 import time
 from typing import Optional, Sequence
 
+from omnia_tpu.engine.flight import FlightRecorder
 from omnia_tpu.engine.types import FinishReason, RequestHandle, SamplingParams, StreamEvent
 
 logger = logging.getLogger(__name__)
@@ -97,6 +98,7 @@ class EngineCoordinator:
         resubmit_retries: int = 1,
         backoff_base_s: float = 0.005,
         backoff_seed: int = 0,
+        flight_events: int = 0,
     ) -> None:
         if not workers:
             raise ValueError("coordinator needs at least one worker")
@@ -168,6 +170,15 @@ class EngineCoordinator:
             "shed": 0,
             "resubmits": 0,
         }
+        # Fleet-dimension flight recorder (engine/flight.py): records
+        # failover / resubmit / shed events with the affected worker, so
+        # a request's flight trail covers worker deaths too. The same
+        # trace_ctx the caller supplied is re-sent on every failover and
+        # resubmit — one trace id spans the replacement workers.
+        # flight_events=0 (default) allocates nothing.
+        self._flight: Optional[FlightRecorder] = (
+            FlightRecorder(flight_events) if flight_events > 0 else None
+        )
 
     def _count(self, key: str, n: int = 1) -> None:
         with self._metrics_lock:
@@ -394,6 +405,10 @@ class EngineCoordinator:
                     del self._affinity[session_id]
                     if pinned not in exclude:
                         self._count("failovers")
+                        if self._flight is not None:
+                            self._flight.note_failover(
+                                session_id or "", worker=pinned
+                            )
             # Fresh session (or sessionless): prefix-affinity routing.
             choice = None
             key = self._prefix_key(list(prompt_tokens), prefix_key)
@@ -450,11 +465,14 @@ class EngineCoordinator:
         prefix_key: Optional[str],
         deadline_at: Optional[float],
         exclude: frozenset = frozenset(),
+        trace_ctx: Optional[str] = None,
     ):
         """Pick a healthy worker and submit, failing over on submit
         exceptions with jittered backoff inside the deadline budget.
         Returns ``(idx, inner_handle)`` on success or ``(None, event)``
-        with the honest terminal StreamEvent on exhaustion."""
+        with the honest terminal StreamEvent on exhaustion. The SAME
+        ``trace_ctx`` goes to every attempted worker — a failover
+        extends the caller's trace instead of starting a new one."""
         exclude = frozenset(exclude)
         for attempt in range(self.submit_retries + 1):
             idx = self._pick(session_id, prompt_tokens, prefix_key, exclude=exclude)
@@ -470,25 +488,36 @@ class EngineCoordinator:
                     error="deadline exhausted before a worker accepted the request",
                 )
             try:
-                try:
-                    inner = self.workers[idx].submit(
-                        prompt_tokens, params, session_id=session_id,
-                        deadline_s=rem,
+                # Kwarg-compat ladder (same contract as stop(drain=)):
+                # a worker predating trace_ctx — or deadline_s — is a
+                # supported duck type, not a worker fault; each level
+                # drops exactly one not-yet-tried kwarg, and no level is
+                # ever retried verbatim (trace_ctx arrived after
+                # deadline_s in-tree, so no worker accepts only it).
+                kw_ladder: list[dict] = []
+                if trace_ctx is not None:
+                    kw_ladder.append(
+                        {"deadline_s": rem, "trace_ctx": trace_ctx}
                     )
-                except TypeError:
-                    # Worker predates the deadline_s kwarg (same compat
-                    # contract as stop(drain=)): a legacy signature is a
-                    # supported duck type, not a worker fault — the TTL
-                    # then only binds coordinator-side (queue reaping on
-                    # that worker is unavailable).
-                    inner = self.workers[idx].submit(
-                        prompt_tokens, params, session_id=session_id
-                    )
+                kw_ladder.append({"deadline_s": rem})
+                kw_ladder.append({})
+                for level, kw in enumerate(kw_ladder):
+                    try:
+                        inner = self.workers[idx].submit(
+                            prompt_tokens, params, session_id=session_id,
+                            **kw,
+                        )
+                        break
+                    except TypeError:
+                        if level == len(kw_ladder) - 1:
+                            raise  # a real TypeError, not a legacy kwarg
                 return idx, inner
             except Exception:
                 logger.warning("submit to worker %d failed; failing over", idx)
                 self._note_probe(idx, False, hard=True)
                 self._count("failovers")
+                if self._flight is not None:
+                    self._flight.note_failover(session_id or "", worker=idx)
                 exclude = exclude | {idx}
                 # Jittered exponential backoff, clipped to the deadline
                 # budget — a flaky transport gets breathing room, a
@@ -514,12 +543,17 @@ class EngineCoordinator:
         session_id: Optional[str] = None,
         prefix_key: Optional[str] = None,
         deadline_s: Optional[float] = None,
+        trace_ctx: Optional[str] = None,
     ) -> RequestHandle:
         deadline_at = (
             time.monotonic() + deadline_s if deadline_s is not None else None
         )
         if self._saturated():
             self._count("shed")
+            if self._flight is not None:
+                self._flight.note_shed(
+                    f"max_worker_queue={self.max_worker_queue}"
+                )
             handle = RequestHandle("req-shed")
             handle._push(StreamEvent(
                 "req-shed", finish_reason=FinishReason.OVERLOADED,
@@ -530,7 +564,8 @@ class EngineCoordinator:
             ))
             return handle
         idx, result = self._routed_submit(
-            prompt_tokens, params, session_id, prefix_key, deadline_at
+            prompt_tokens, params, session_id, prefix_key, deadline_at,
+            trace_ctx=trace_ctx,
         )
         if idx is None:
             handle = RequestHandle(result.request_id)
@@ -543,7 +578,8 @@ class EngineCoordinator:
             # no pump thread, no per-event copy.
             return result
         relay = _RelayHandle(
-            self, prompt_tokens, params, session_id, prefix_key, deadline_at
+            self, prompt_tokens, params, session_id, prefix_key, deadline_at,
+            trace_ctx=trace_ctx,
         )
         relay._begin(idx, result)
         return relay
@@ -622,11 +658,15 @@ class _RelayHandle(RequestHandle):
     Exactly ONE terminal event ever reaches the consumer."""
 
     def __init__(self, owner, prompt_tokens, params, session_id, prefix_key,
-                 deadline_at):
+                 deadline_at, trace_ctx=None):
         super().__init__("coord-pending")
         self._owner = owner
         self._args = (list(prompt_tokens), params, session_id, prefix_key)
         self._deadline_at = deadline_at
+        # Re-sent verbatim on resubmit: the replacement worker's engine
+        # span joins the SAME trace (worker deaths extend the trace,
+        # never fork it).
+        self._trace_ctx = trace_ctx
         self._inner: Optional[RequestHandle] = None
         self._inner_idx: Optional[int] = None
         self._resubmits_left = owner.resubmit_retries
@@ -651,12 +691,15 @@ class _RelayHandle(RequestHandle):
         failed = self._inner_idx
         self._owner._note_probe(failed, False, hard=True)
         idx, result = self._owner._routed_submit(
-            *self._args, self._deadline_at, exclude=frozenset({failed})
+            *self._args, self._deadline_at, exclude=frozenset({failed}),
+            trace_ctx=self._trace_ctx,
         )
         if idx is None:
             self._push(dataclasses.replace(result, request_id=self.request_id))
             return False
         self._owner._count("resubmits")
+        if self._owner._flight is not None:
+            self._owner._flight.note_resubmit(self.request_id, worker=idx)
         self._inner, self._inner_idx = result, idx
         if self.cancelled:
             result.cancel()  # a cancel raced the resubmit: propagate

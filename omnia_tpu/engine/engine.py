@@ -50,6 +50,7 @@ import jax
 import jax.numpy as jnp
 
 from omnia_tpu.engine.faults import FaultPlan
+from omnia_tpu.engine.flight import FlightRecorder
 from omnia_tpu.engine.interleave import _InflightPrefill, _InterleaveMixin
 from omnia_tpu.engine.lifecycle import _LifecycleMixin
 from omnia_tpu.engine.placement import _PlacementMixin
@@ -235,6 +236,24 @@ class InferenceEngine(
         # streams diverge and the cross-host collectives deadlock.
         self.clock = time.monotonic
 
+        # Flight recorder (engine/flight.py): the step-level event ring
+        # + per-request latency breakdowns. flight_events=0 allocates NO
+        # recorder state — every seam below is a single None check (the
+        # guarded no-op contract, tests/test_flight.py). The recorder
+        # keeps its OWN monotonic clock, never self.clock: breakdowns
+        # are host wall time, and an injected logical clock (lockstep)
+        # must not distort them.
+        self._flight: Optional[FlightRecorder] = (
+            FlightRecorder(engine_cfg.flight_events)
+            if engine_cfg.flight_events > 0 else None
+        )
+        # Tracer for the `omnia.engine.request` child span (trace
+        # continuity from the runtime's llm span): set by the embedding
+        # server (utils.tracing.Tracer), None = no engine spans. Spans
+        # only open for submits that carry a trace_ctx AND with the
+        # flight recorder on — the recorder owns the span lifecycle.
+        self.tracer = None
+
         # Metrics (engine-level; exported via utils.metrics by the runtime).
         # The *_s accumulators split host wall time between program
         # DISPATCH (async submit to the device stream) and SYNC (waiting
@@ -314,6 +333,11 @@ class InferenceEngine(
             "kv_quant_device_bytes": cache_bytes(
                 self._ck, self._cv, self._pk, self._pv
             ),
+            # Engine flight recorder (engine/flight.py): set once at
+            # construction, like kv_quant_enabled — dashboards can tell
+            # whether per-request latency breakdowns exist before asking
+            # for a dump.
+            "flight_enabled": 1 if self._flight is not None else 0,
         }
         self._gr_mask_sum = 0.0
         self._gr_mask_steps = 0
@@ -607,6 +631,7 @@ class InferenceEngine(
         session_id: Optional[str] = None,
         grammar=None,
         deadline_s: Optional[float] = None,
+        trace_ctx: Optional[str] = None,
     ) -> RequestHandle:
         """Queue a generation request. With a session_id, the session's KV
         rows persist across requests: the next request prefills only the
@@ -617,14 +642,18 @@ class InferenceEngine(
         accepting states — requires EngineConfig.grammar=True.
         With a `deadline_s` TTL, a request still queued at the deadline
         is shed with FinishReason.DEADLINE and an active request
-        finishes early at the deadline boundary (chunk granularity)."""
+        finishes early at the deadline boundary (chunk granularity).
+        With a `trace_ctx` W3C traceparent (the runtime llm span) and
+        flight recording on, the request's lifecycle is recorded and an
+        `omnia.engine.request` child span is emitted into self.tracer —
+        trace continuity from the facade down to TPU dispatch."""
         if self._fault_plan is not None and self._fault_plan.take_submit_fault():
             raise RuntimeError("injected flaky submit (FaultPlan)")
         rid = f"req-{next(self._req_counter)}"
         handle = RequestHandle(rid)
         request = Request(
             rid, list(prompt_tokens), params, session_id=session_id,
-            grammar=grammar,
+            grammar=grammar, trace_ctx=trace_ctx,
         )
         if deadline_s is not None:
             # Engine clock domain (not time.monotonic): lockstep ranks
@@ -687,6 +716,14 @@ class InferenceEngine(
             else:
                 self._waiting.append((request, handle))
                 self.metrics["requests_submitted"] += 1
+                if self._flight is not None:
+                    # Inside the admission critical section: the engine
+                    # thread cannot claim this request (it needs _lock to
+                    # see the queue) before its submit event is recorded,
+                    # so submit-seq < claim-seq always holds in the ring.
+                    self._flight.note_submit(
+                        rid, len(prompt_tokens), trace_ctx, self.tracer
+                    )
                 return handle
             self.metrics["requests_shed"] += 1
         handle._push(

@@ -68,6 +68,8 @@ class _InflightPrefill:
     pieces: list                    # [(offset, real_len, bucket)]
     next_piece: int = 0
     frontier: int = 0               # rows known valid (reuse/seed + consumed)
+    reuse: int = 0                  # session-LCP rows (flight-recorder attrs)
+    seeded: int = 0                 # prefix-pool seeded rows
 
     @property
     def prompt(self) -> list[int]:
@@ -200,7 +202,7 @@ class _InterleaveMixin:
             self._prefilling = _InflightPrefill(
                 slot_idx=slot_idx, request=request, handle=handle, sess=sess,
                 pieces=self._budget_pieces(frontier, len(prompt) - frontier),
-                frontier=frontier,
+                frontier=frontier, reuse=reuse, seeded=seeded,
             )
         except Exception:
             self._fail_placement(slot_idx, request, handle, "prefill failed")
@@ -257,12 +259,17 @@ class _InterleaveMixin:
         else:
             (self._ck, self._cv, self._tokens, self._positions, self._active,
              self._budget, self._key_data, dtoks) = out
-        self.metrics["decode_dispatch_s"] += time.monotonic() - t_dispatch
+        dispatch_s = time.monotonic() - t_dispatch
+        self.metrics["decode_dispatch_s"] += dispatch_s
         self.metrics["decode_steps"] += 1
         self.metrics["mixed_steps"] += 1
         self.metrics["interleaved_prefill_tokens"] += take
         self.metrics["prefill_tokens"] += take
-        self._inflight.append((dtoks, active))
+        if self._flight is not None:
+            self._flight.note_mixed_step(
+                pf.request.request_id, take, bucket, dispatch_s
+            )
+        self._inflight.append((dtoks, active, dispatch_s))
         pf.next_piece += 1
         pf.frontier = off + take
         if pf.sess is not None:
@@ -325,6 +332,15 @@ class _InterleaveMixin:
             self._placing -= 1
         first = int(first_tok)
         self._attach_grammar(slot_idx, request, first)
+        if self._flight is not None:
+            # Same stage-tiling rule as monolithic placement: recorded
+            # just before the first token emits. prefill_s=0 here — the
+            # per-piece mixed-step dispatches already accumulated it.
+            self._flight.note_placement(
+                request.request_id, slot_idx, n,
+                reuse=pf.reuse, seeded=pf.seeded,
+                prefill_s=0.0, stalled=False,
+            )
         self._emit_token(slot_idx, first)
 
     # -- abort / failure ------------------------------------------------
@@ -345,6 +361,8 @@ class _InterleaveMixin:
             )
         )
         self.metrics["requests_finished"] += 1
+        if self._flight is not None:
+            self._flight.note_terminal(pf.request.request_id, reason.value)
         quiesce_row = 0
         if pf.sess is not None:
             # token_ids already reads prompt[:frontier]; the rows below

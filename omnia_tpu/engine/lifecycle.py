@@ -79,6 +79,11 @@ class _LifecycleMixin:
                     num_prompt_tokens=len(req.prompt_tokens),
                 ))
                 self.metrics["requests_finished"] += 1
+                if self._flight is not None:
+                    self._flight.note_terminal(
+                        req.request_id, FinishReason.OVERLOADED.value,
+                        error="drain window elapsed while queued",
+                    )
             if any(s.active for s in self._slots):
                 if wedged:
                     # The engine thread is still alive inside a stuck
@@ -177,5 +182,11 @@ class _LifecycleMixin:
                 # An ERROR terminal is as finished as any other — the
                 # books must balance for every accepted submit.
                 self.metrics["requests_finished"] += 1
+                if self._flight is not None:
+                    self._flight.note_terminal(
+                        slot.request.request_id, FinishReason.ERROR.value,
+                        tokens=slot.generated, error=msg,
+                        first_token_at=slot.handle.first_token_at,
+                    )
                 self._release_slot_seed(slot)
                 slot.clear()

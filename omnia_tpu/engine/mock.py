@@ -21,6 +21,7 @@ from dataclasses import dataclass, field
 from typing import Optional, Sequence
 
 from omnia_tpu.engine.faults import FaultPlan
+from omnia_tpu.engine.flight import FlightRecorder
 from omnia_tpu.engine.tokenizer import ByteTokenizer
 from omnia_tpu.engine.types import (
     FinishReason,
@@ -82,11 +83,20 @@ class MockEngine:
     def __init__(self, scenarios: Sequence[Scenario] = (), tokenizer=None,
                  kv_quant=None, fault_plan: Optional[FaultPlan] = None,
                  max_queue: int = 0, watchdog_s: Optional[float] = None,
-                 prefill_chunk_tokens: int = 0):
+                 prefill_chunk_tokens: int = 0, flight_events: int = 0):
         self.scenarios = list(scenarios)
         self.tokenizer = tokenizer or ByteTokenizer()
         self._req_counter = itertools.count()
         self._lock = threading.Lock()
+        # Flight-recorder parity (engine/flight.py): the mock records
+        # the IDENTICAL event vocabulary (submit/claim/placement/token
+        # books/terminal) so hermetic tests exercise the full breakdown
+        # + trace-continuity path with no device. flight_events=0 is the
+        # same guarded no-op as the real engine's.
+        self._flight: Optional[FlightRecorder] = (
+            FlightRecorder(flight_events) if flight_events > 0 else None
+        )
+        self.tracer = None  # utils.tracing.Tracer for engine-request spans
         # Stall-free batching parity (engine/interleave.py): with a
         # token budget, each playback's "prefill" books the same
         # mixed-step/interleaved-token counts the real engine meters per
@@ -145,6 +155,8 @@ class MockEngine:
             "mixed_steps": 0,
             "interleaved_prefill_tokens": 0,
             "decode_stall_steps": 0,
+            # Flight-recorder parity (engine/flight.py).
+            "flight_enabled": 1 if flight_events > 0 else 0,
         }
         self._gr_mask_sum = 0.0
         self._gr_mask_steps = 0
@@ -227,6 +239,7 @@ class MockEngine:
         session_id: Optional[str] = None,
         grammar=None,
         deadline_s: Optional[float] = None,
+        trace_ctx: Optional[str] = None,
     ) -> RequestHandle:
         # session_id accepted for interface parity with InferenceEngine;
         # the mock replays scenarios statelessly, so it is ignored.
@@ -281,6 +294,12 @@ class MockEngine:
                 StreamEvent(rid, finish_reason=FinishReason.OVERLOADED, error=why)
             )
             return handle
+        if self._flight is not None:
+            # Before the playback thread starts, so submit-seq < claim-seq
+            # in the ring (same ordering contract as the real engine).
+            self._flight.note_submit(
+                rid, len(prompt_tokens), trace_ctx, self.tracer
+            )
         if grammar is not None:
             from omnia_tpu.engine.grammar.cache import stats
 
@@ -387,6 +406,11 @@ class MockEngine:
         )
         with self._lock:
             self.metrics["requests_finished"] += 1
+        if self._flight is not None:
+            self._flight.note_terminal(
+                rid, reason.value, tokens=generated, error=error,
+                first_token_at=handle.first_token_at,
+            )
 
     def _play(self, rid, prompt_tokens, params, handle: RequestHandle,
               grammar=None, deadline_at=None):
@@ -394,6 +418,9 @@ class MockEngine:
         scenario = self._scenario_for(prompt)
         fault = self.fault_plan
         n_prompt = len(prompt_tokens)
+        if self._flight is not None:
+            # Playback-thread start is the mock's "claim" seam.
+            self._flight.note_claim(rid)
         # Hung-dispatch parity: an injected hang past watchdog_s fails
         # the request at the watchdog bound (the engine's trip path),
         # never after the full hang — bounded client latency.
@@ -408,6 +435,12 @@ class MockEngine:
             )
             return
         time.sleep(hang + scenario.ttft_s)
+        if self._flight is not None:
+            # The post-ttft-sleep moment is the mock's "placement": the
+            # simulated prefill is done, tokens stream next.
+            self._flight.note_placement(
+                rid, 0, n_prompt, prefill_s=scenario.ttft_s
+            )
         # Stall-free batching mirror: this is the playback's "prefill"
         # moment. With a token budget the prompt books ceil(n/budget)
         # mixed steps and its full token count (identical to the real

@@ -128,6 +128,10 @@ class _PlacementMixin:
         slot.grammar = g
         slot.gr_view = view
         slot.gr_state = view.start  # _emit_token advances for first_tok
+        if self._flight is not None:
+            self._flight.note_grammar_attach(
+                request.request_id, view.num_states
+            )
 
     def _run_insert(self, k_chunk, v_chunk, slot_idx, last_logits, sp=None,
                     request=None):
@@ -218,11 +222,13 @@ class _PlacementMixin:
                 slot_idx, prompt, frontier, sp, request
             )
         if stalled:
-            self.metrics["decode_stall_steps"] += max(
-                self.metrics["extend_steps"] - ext0, 1
-            )
+            stall_steps = max(self.metrics["extend_steps"] - ext0, 1)
+            self.metrics["decode_stall_steps"] += stall_steps
+            if self._flight is not None:
+                self._flight.note_stall(stall_steps)
         self._maybe_publish_prefix(slot_idx, prompt)
-        self.metrics["prefill_dispatch_s"] += time.monotonic() - t_prefill
+        prefill_s = time.monotonic() - t_prefill
+        self.metrics["prefill_dispatch_s"] += prefill_s
         self.metrics["prefix_reuse_tokens"] += reuse
         self.metrics["prefill_tokens"] += n - frontier
         self.metrics["prefill_steps"] += 1
@@ -271,6 +277,15 @@ class _PlacementMixin:
         )
         first = int(first_tok)
         self._attach_grammar(slot_idx, request, first)
+        if self._flight is not None:
+            # Recorded just BEFORE the first token emits so the
+            # breakdown's stages tile the wall: queue (submit→claim) +
+            # placement (claim→here, prefill included) + decode (first
+            # token→terminal).
+            self._flight.note_placement(
+                request.request_id, slot_idx, n, reuse=reuse, seeded=seeded,
+                prefill_s=prefill_s, stalled=stalled,
+            )
         self._emit_token(slot_idx, first)
 
     def _fresh_prefill(self, slot_idx: int, prompt: list[int],
@@ -300,6 +315,7 @@ class _PlacementMixin:
             )
             return first_tok
         kd = self._sampling_key(slot_idx, sp)
+        t0 = time.monotonic()
         self._ck, self._cv, first_tok, new_kd = self._prefill_insert_fn(
             self.params, self._ck, self._cv,
             jnp.asarray(toks), jnp.asarray(pos),
@@ -308,6 +324,10 @@ class _PlacementMixin:
             jnp.int32(sp.top_k),
             *self._grammar_args(request, sp),
         )
+        if self._flight is not None and request is not None:
+            self._flight.note_prefill_piece(
+                request.request_id, n, bucket, time.monotonic() - t0
+            )
         self._key_data = self._key_data.at[slot_idx].set(new_kd)
         return first_tok
 
@@ -345,20 +365,29 @@ class _PlacementMixin:
             pos = (off + np.arange(b, dtype=np.int32))[None, :]
             return jnp.asarray(toks), jnp.asarray(pos)
 
+        rid = request.request_id if request is not None else ""
         for off, take, b in pieces[:-1]:
             toks, pos = chunk_arrays(off, take, b)
+            t0 = time.monotonic()
             self._ck, self._cv = self._extend_nosample_fn(
                 self.params, self._ck, self._cv, toks, pos, slot_arr, jnp.int32(off)
             )
+            if self._flight is not None and rid:
+                self._flight.note_prefill_piece(
+                    rid, take, b, time.monotonic() - t0
+                )
         off, take, b = pieces[-1]
         toks, pos = chunk_arrays(off, take, b)
         kd = self._sampling_key(slot_idx, sp)
+        t0 = time.monotonic()
         self._ck, self._cv, first_tok, new_kd = self._extend_fn(
             self.params, self._ck, self._cv, toks, pos, slot_arr, jnp.int32(off),
             jnp.int32(take - 1), kd,
             jnp.float32(sp.temperature), jnp.float32(sp.top_p), jnp.int32(sp.top_k),
             *self._grammar_args(request, sp),
         )
+        if self._flight is not None and rid:
+            self._flight.note_prefill_piece(rid, take, b, time.monotonic() - t0)
         self._key_data = self._key_data.at[slot_idx].set(new_kd)
         self.metrics["extend_steps"] += len(pieces)
         return first_tok

@@ -122,6 +122,8 @@ class _SchedulerMixin:
                     self._placing += 1
                 except ValueError:
                     pending = None  # reaped concurrently
+        if pending is not None and self._flight is not None:
+            self._flight.note_claim(pending[0].request_id)
         return pending, slot_idx
 
     def _place_pending(self, slot_idx, request, handle):
@@ -158,6 +160,10 @@ class _SchedulerMixin:
             )
         )
         self.metrics["requests_finished"] += 1
+        if self._flight is not None:
+            self._flight.note_terminal(
+                request.request_id, FinishReason.ERROR.value, error=msg
+            )
         self._drop_session(request.session_id)
         self._slots[slot_idx].session_id = None
         self._release_slot_seed(self._slots[slot_idx])
@@ -233,6 +239,7 @@ class _SchedulerMixin:
             # Half-prefilled slot (token-budget interleaving): consumed
             # rows stay valid for the session, books are already exact.
             self._abort_prefilling(FinishReason.CANCELLED)
+        reaped = []
         with self._lock:
             still = []
             for req, handle in self._waiting:
@@ -244,9 +251,15 @@ class _SchedulerMixin:
                     # cancelled one: every submit reaches exactly one
                     # terminal event AND one finished count.
                     self.metrics["requests_finished"] += 1
+                    reaped.append(req.request_id)
                 else:
                     still.append((req, handle))
             self._waiting = still
+        if self._flight is not None:
+            # Terminal recording ends the request span (tracer export
+            # I/O) — never under the engine lock.
+            for rid in reaped:
+                self._flight.note_terminal(rid, FinishReason.CANCELLED.value)
 
     def _reap_deadlines(self):
         """Deadline enforcement at the step boundary: queued requests
@@ -273,6 +286,7 @@ class _SchedulerMixin:
                 # their rows stay valid for the session.
                 self.metrics["deadline_exceeded"] += 1
                 self._abort_prefilling(FinishReason.DEADLINE)
+        reaped = []
         with self._lock:
             if not any(r.deadline_at is not None for r, _h in self._waiting):
                 return
@@ -291,9 +305,13 @@ class _SchedulerMixin:
                     # reaches exactly one final event and one finish.
                     self.metrics["deadline_exceeded"] += 1
                     self.metrics["requests_finished"] += 1
+                    reaped.append(req.request_id)
                 else:
                     still.append((req, handle))
             self._waiting = still
+        if self._flight is not None:
+            for rid in reaped:  # span end = I/O, never under the lock
+                self._flight.note_terminal(rid, FinishReason.DEADLINE.value)
 
     def _sync_chunk_host(self, toks) -> np.ndarray:
         """Device→host read of a decode chunk's tokens, optionally under
@@ -396,7 +414,7 @@ class _SchedulerMixin:
         already in flight — how many more decode steps could do real work
         for SOMEONE."""
         inflight_steps: dict[int, int] = {}
-        for toks, active in self._inflight:
+        for toks, active, _dispatch_s in self._inflight:
             k = int(toks.shape[0])
             for i, _rid in active:
                 inflight_steps[i] = inflight_steps.get(i, 0) + k
@@ -438,14 +456,23 @@ class _SchedulerMixin:
             (i, s.request.request_id) for i, s in enumerate(self._slots) if s.active
         ]
         chunk = 1 if single else self._pick_chunk()
+        t_dispatch = time.monotonic()
         toks = self._run_decode_step(chunk=chunk)
-        self._inflight.append((toks, active))
+        # The dispatch wall rides the in-flight entry so the flight
+        # recorder can pair it with the (deferred) sync wall into one
+        # per-chunk dispatch-vs-sync event.
+        self._inflight.append((toks, active, time.monotonic() - t_dispatch))
 
     def _process_oldest_chunk(self):
-        toks, active = self._inflight.popleft()
+        toks, active, dispatch_s = self._inflight.popleft()
         t_sync = time.monotonic()
         host_tokens = self._sync_chunk_host(toks)  # [K, B] — ONE sync per chunk
-        self.metrics["decode_sync_s"] += time.monotonic() - t_sync
+        sync_s = time.monotonic() - t_sync
+        self.metrics["decode_sync_s"] += sync_s
+        if self._flight is not None:
+            self._flight.note_decode_chunk(
+                int(host_tokens.shape[0]), dispatch_s, sync_s, len(active)
+            )
         for k in range(host_tokens.shape[0]):
             for i, rid in active:
                 slot = self._slots[i]
@@ -484,6 +511,9 @@ class _SchedulerMixin:
             return
         slot.generated += 1
         slot.emitted.append(token)
+        # Deliberately NO flight-recorder call here: the emit loop is
+        # the decode hot path, and handle._push already stamps
+        # first_token_at — the terminal carries it to the recorder.
         slot.handle._push(StreamEvent(rid, token_id=token))
         self.metrics["tokens_generated"] += 1
         # max_total caps generated tokens; the cache bound stops a step early
@@ -504,6 +534,11 @@ class _SchedulerMixin:
             )
         )
         self.metrics["requests_finished"] += 1
+        if self._flight is not None:
+            self._flight.note_terminal(
+                rid, reason.value, tokens=slot.generated,
+                first_token_at=slot.handle.first_token_at,
+            )
         if slot.gr_view is not None:
             # A constrained generation brought to a valid stop: without
             # the grammar this request could have burned a whole decode

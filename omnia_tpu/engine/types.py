@@ -69,6 +69,13 @@ class Request:
     # leader-broadcast logical clock and every rank decides identically.
     # None = no deadline (the guarded default).
     deadline_at: Optional[float] = None
+    # W3C traceparent of the caller's span (the runtime's llm span):
+    # with flight recording on, the engine opens a child
+    # `omnia.engine.request` span under it, so one trace id covers
+    # facade → runtime → engine — and the coordinator re-sends the SAME
+    # context on failover/resubmit, so a worker death extends the trace
+    # instead of starting a new one. None = no trace continuity.
+    trace_ctx: Optional[str] = None
 
 
 @dataclasses.dataclass(frozen=True)
@@ -292,6 +299,17 @@ class EngineConfig:
     # 0 (default) is a guarded true no-op: no mixed programs are built
     # and the scheduler keeps the exact prefill-first paths.
     prefill_chunk_tokens: int = 0
+    # Engine flight recorder (engine/flight.py): capacity of the
+    # fixed-size ring buffer of lifecycle events (submit/claim/placement/
+    # prefill piece/mixed step/decode chunk/offload/restore/terminal)
+    # with per-request latency breakdowns, step-timing histograms, and
+    # the `omnia.engine.request` child span when submit() carries a
+    # trace_ctx. Everything it records is strictly host-side wall time
+    # between dispatches — compiled programs and sampled tokens are
+    # untouched. 0 (default) is a guarded true no-op: no recorder object
+    # exists, no span is ever opened, every seam is one `is not None`
+    # check (tests/test_flight.py).
+    flight_events: int = 0
 
     def chunk_variants(self) -> tuple[int, ...]:
         """Compiled decode-chunk sizes, descending, always containing

@@ -70,6 +70,7 @@ class FacadeServer:
         advertise_address: str = "",
         media_store=None,       # media.MediaStore — upload negotiation
         workspace: str = "default",
+        engine=None,            # co-located engine OBJECT → /metrics bridge
     ):
         self.runtime = RuntimeClient(runtime_target)
         self.agent_name = agent_name
@@ -94,6 +95,15 @@ class FacadeServer:
         self._turn_latency = self.metrics.histogram(
             "turn_seconds", buckets=(0.05, 0.1, 0.25, 0.5, 1, 2.5, 5, 10, 30, 60, 120)
         )
+        if engine is not None:
+            # Single-process deployments (runtime + engine in-proc): the
+            # engine's metrics dict — and, with flight recording on, its
+            # step-timing histograms — ride this facade's /metrics as the
+            # live omnia_engine_* family (one collector, no copied
+            # bookkeeping; utils/metrics.bind_engine_metrics).
+            from omnia_tpu.utils.metrics import bind_engine_metrics
+
+            bind_engine_metrics(self.metrics, engine)
         self._limiter = KeyedLimiter(rate=messages_per_minute / 60.0, burst=10)
         self._draining = threading.Event()
         self._live = set()

@@ -450,15 +450,26 @@ class Conversation:
                 # grammar kwarg is only passed when attached, so engines
                 # without grammar support in their submit signature
                 # (coordinator/multihost fronts) keep working unchanged.
+                # The llm span's traceparent rides as trace_ctx so the
+                # engine's flight recorder emits a child
+                # `omnia.engine.request` span — one trace id from the
+                # facade down to TPU dispatch. Engines predating the
+                # kwarg are supported duck types (TypeError retry, the
+                # coordinator's own compat ladder); an unsampled llm
+                # span propagates flags 00, so the engine stays silent.
+                kwargs = {"session_id": self.session_id}
                 if grammar is not None:
-                    handle = self.engine.submit(
-                        prompt_ids, sp, session_id=self.session_id,
-                        grammar=grammar,
-                    )
+                    kwargs["grammar"] = grammar
+                if llm_span is not None:
+                    try:
+                        handle = self.engine.submit(
+                            prompt_ids, sp,
+                            trace_ctx=llm_span.traceparent(), **kwargs,
+                        )
+                    except TypeError:
+                        handle = self.engine.submit(prompt_ids, sp, **kwargs)
                 else:
-                    handle = self.engine.submit(
-                        prompt_ids, sp, session_id=self.session_id
-                    )
+                    handle = self.engine.submit(prompt_ids, sp, **kwargs)
             except Exception:
                 if llm_span is not None:
                     llm_span.status = "error"

@@ -69,6 +69,9 @@ def build_engine(spec: ProviderSpec, *, warmup: bool = False):
             scenarios, kv_quant=spec.options.get("kv_quant"),
             max_queue=spec.options.get("max_queue", 0),
             watchdog_s=spec.options.get("watchdog_s"),
+            # Flight-recorder parity: mock Provider CRs can turn on the
+            # same per-request latency breakdowns as tpu ones.
+            flight_events=spec.options.get("flight_events", 0),
         )
     if spec.type == "tpu":
         from omnia_tpu.models import PRESETS, get_config
@@ -86,7 +89,11 @@ def build_engine(spec: ProviderSpec, *, warmup: bool = False):
                      # Request-lifecycle hardening knobs (both default
                      # to the guarded no-op): bounded admission and the
                      # hung-dispatch watchdog.
-                     "max_queue", "watchdog_s"}
+                     "max_queue", "watchdog_s",
+                     # Engine flight recorder (engine/flight.py): ring
+                     # capacity for step-level tracing + latency
+                     # breakdowns (0 = the guarded no-op).
+                     "flight_events"}
         }
         if "prefill_buckets" in eng_kwargs:
             eng_kwargs["prefill_buckets"] = tuple(eng_kwargs["prefill_buckets"])

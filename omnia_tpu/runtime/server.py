@@ -168,7 +168,18 @@ class RuntimeServer:
 
     @property
     def engine(self):
-        return self.providers.engine(self.provider_name)
+        engine = self.providers.engine(self.provider_name)
+        # Trace continuity (engine/flight.py): the engine emits its
+        # `omnia.engine.request` child spans into the SAME tracer the
+        # conversation's llm spans use, so one trace id covers facade →
+        # runtime → engine dispatch. Engines without the attribute
+        # (remote fronts) are supported duck types.
+        if self.tracer is not None and getattr(engine, "tracer", None) is None:
+            try:
+                engine.tracer = self.tracer
+            except AttributeError:
+                pass  # read-only engine surface: tracing stays runtime-side
+        return engine
 
     @property
     def spec(self):

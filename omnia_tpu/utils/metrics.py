@@ -10,6 +10,7 @@ health endpoint.
 from __future__ import annotations
 
 import threading
+import time
 from typing import Callable, Optional, Sequence
 
 _DEFAULT_BUCKETS = (0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0)
@@ -115,6 +116,38 @@ class Histogram:
         return lines
 
 
+class DictCollector:
+    """Live exposition of a plain metrics dict (e.g. ``engine.metrics``).
+
+    ONE collector, no copied bookkeeping: the dict is read at scrape
+    time through ``fn``, so the exposition can never go stale behind the
+    source counters. Values are read without the source's lock — ints
+    and floats read atomically in CPython; at worst a scrape sees two
+    keys from adjacent instants, which is the normal Prometheus
+    contract. Non-numeric values are skipped. A ``<prefix>_scrape_unixtime``
+    line stamps each scrape so a monitor (and the doctor's engine-metrics
+    check) can prove the family is computed live, not cached."""
+
+    def __init__(self, prefix: str, fn: Callable[[], dict], help_: str = ""):
+        self.name = prefix
+        self.prefix = prefix
+        self.help = help_
+        self._fn = fn
+
+    def expose(self) -> list[str]:
+        lines: list[str] = []
+        d = self._fn() or {}
+        for k in sorted(d):
+            v = d[k]
+            if isinstance(v, bool) or not isinstance(v, (int, float)):
+                continue
+            lines.append(f"# TYPE {self.prefix}_{k} gauge")
+            lines.append(f"{self.prefix}_{k} {float(v)}")
+        lines.append(f"# TYPE {self.prefix}_scrape_unixtime gauge")
+        lines.append(f"{self.prefix}_scrape_unixtime {time.time()}")
+        return lines
+
+
 class Registry:
     def __init__(self, prefix: str = "omnia"):
         self.prefix = prefix
@@ -130,6 +163,30 @@ class Registry:
     def histogram(self, name: str, help_: str = "", buckets=_DEFAULT_BUCKETS) -> Histogram:
         return self._get_or_make(name, lambda n: Histogram(n, help_, buckets))
 
+    def register(self, metric, replace: bool = False) -> object:
+        """Adopt an externally-created metric (its ``name`` is used
+        verbatim — no registry prefix). By default first registration
+        wins (re-registering the same series is idempotent);
+        ``replace=True`` swaps the series in — the rebind path for a
+        replaced backing object (a reloaded engine must not leave the
+        exposition pointing at its dead predecessor)."""
+        with self._lock:
+            if replace:
+                self._metrics[metric.name] = metric
+                return metric
+            return self._metrics.setdefault(metric.name, metric)
+
+    def unregister_prefix(self, prefix: str) -> int:
+        """Drop every registered metric whose full name starts with
+        ``prefix``; returns how many were removed. The rebind broom:
+        series owned by a replaced backing object must not survive it
+        frozen (see :func:`bind_engine_metrics`)."""
+        with self._lock:
+            doomed = [n for n in self._metrics if n.startswith(prefix)]
+            for n in doomed:
+                del self._metrics[n]
+            return len(doomed)
+
     def _get_or_make(self, name: str, make):
         full = f"{self.prefix}_{name}"
         with self._lock:
@@ -140,6 +197,46 @@ class Registry:
 
     def expose(self) -> str:
         lines: list[str] = []
-        for m in self._metrics.values():
+        with self._lock:
+            metrics = list(self._metrics.values())
+        for m in metrics:
             lines.extend(m.expose())
         return "\n".join(lines) + "\n"
+
+
+def bind_engine_metrics(registry: Registry, engine) -> DictCollector:
+    """Bridge an engine-like object (InferenceEngine / MockEngine /
+    EngineCoordinator) into a Prometheus registry: its ``metrics`` dict
+    is exposed live as the ``omnia_engine_*`` gauge family (one
+    collector, no double bookkeeping), and — when the engine carries a
+    flight recorder (``EngineConfig.flight_events > 0``) — the
+    recorder's step-timing histograms (ttft, inter-token, queue wait,
+    per-chunk dispatch/sync µs) register alongside it. The facade/doctor
+    ``/metrics`` endpoint then answers engine-health queries directly.
+
+    One registry exposes ONE engine family: rebinding (a provider
+    reload replacing the engine) first sweeps every ``omnia_engine_*``
+    series, then registers the new collector and histograms — so the
+    exposition can never keep reading a dead engine's frozen counters
+    (not even its old flight histograms when the replacement has no
+    recorder), which would pass the doctor's freshness stamp while
+    serving stale data."""
+    if not hasattr(engine, "metrics") or isinstance(engine, dict):
+        # Loud rejection beats a silently-empty family: passing the
+        # metrics DICT instead of the engine object would expose zero
+        # engine series while the freshness stamp keeps ticking.
+        raise TypeError(
+            "bind_engine_metrics wants the engine OBJECT (anything with "
+            f"a .metrics dict), got {type(engine).__name__}"
+        )
+    registry.unregister_prefix("omnia_engine_")
+    coll = DictCollector(
+        "omnia_engine", lambda: getattr(engine, "metrics", {}) or {},
+        help_="live view of engine.metrics",
+    )
+    registry.register(coll, replace=True)
+    rec = getattr(engine, "_flight", None)
+    if rec is not None:
+        for h in rec.hist.values():
+            registry.register(h, replace=True)
+    return coll

@@ -23,11 +23,14 @@ from collections import deque
 from typing import Optional
 
 # Span kinds (the reference's vocabulary, internal/tracing/tracing.go
-# :214/:244/:270/:296).
+# :214/:244/:270/:296) — plus the engine-request span the serving layer
+# adds: the in-tree TPU engine's child of the runtime's llm span, so one
+# trace id covers facade → runtime → engine dispatch (engine/flight.py).
 SPAN_CONVERSATION = "omnia.conversation"
 SPAN_INVOCATION = "omnia.invocation"
 SPAN_LLM = "omnia.llm"
 SPAN_TOOL = "omnia.tool"
+SPAN_ENGINE = "omnia.engine.request"
 
 MD_TRACEPARENT = "traceparent"
 
@@ -51,7 +54,12 @@ class Span:
         self.attrs = dict(attrs or {})
         self.events: list[dict] = []
         self.status = "ok"
+        # Wall clock for the exported timestamps (cross-process trace
+        # correlation needs unix time), but the DURATION is computed
+        # from the monotonic clock: an NTP step between start and end
+        # would otherwise yield negative/garbage span durations.
         self.start_ns = time.time_ns()
+        self._start_monotonic_ns = time.monotonic_ns()
         self.end_ns: Optional[int] = None
         self._token = None
 
@@ -69,11 +77,20 @@ class Span:
     def end(self) -> None:
         if self.end_ns is not None:
             return
-        self.end_ns = time.time_ns()
+        # end = wall start + monotonic elapsed: the exported duration is
+        # immune to wall-clock steps (keeps end_ns >= start_ns always).
+        self.end_ns = self.start_ns + self.duration_ns()
         if self._token is not None:
             _current_span.reset(self._token)
             self._token = None
         self.tracer._export(self)
+
+    def duration_ns(self) -> int:
+        """Monotonic elapsed time since the span started (or the final
+        duration once ended). Never negative, whatever NTP did."""
+        if self.end_ns is not None:
+            return self.end_ns - self.start_ns
+        return max(time.monotonic_ns() - self._start_monotonic_ns, 0)
 
     # -- data --------------------------------------------------------------
 
@@ -282,24 +299,31 @@ class Tracer:
                    attrs: Optional[dict] = None) -> Span:
         """Parent precedence: explicit parent > traceparent header >
         current-context span > new root. Sampling decides at the root;
-        children always follow their root's decision (parent-based)."""
-        parent = parent or _current_span.get()
+        children always follow their root's decision (parent-based).
+
+        A parseable ``traceparent`` really does beat the ambient
+        context-var span: a caller handing over a remote context (the
+        engine's request span parenting under the runtime's llm span)
+        must get THAT parent even when some enclosing span is active on
+        the thread — the old code silently parented under the ambient
+        span and orphaned the handed-over context."""
+        trace_id = parent_id = None
+        parsed = parse_traceparent(traceparent) if traceparent else None
+        if parent is None and parsed is None:
+            parent = _current_span.get()
         if isinstance(parent, _NoopSpan):
             # Parent-based sampling: children of an unsampled root must be
             # dropped too, not exported as orphans under the zero trace id.
             return _NoopSpan(self)
-        trace_id = parent_id = None
         if parent is not None:
             trace_id, parent_id = parent.trace_id, parent.span_id
-        elif traceparent:
-            parsed = parse_traceparent(traceparent)
-            if parsed:
-                trace_id, parent_id, sampled = parsed
-                if not sampled:
-                    # Parent-based sampling: honor the remote decision —
-                    # an explicitly-unsampled parent (flags 00) must not
-                    # be resurrected here.
-                    return _NoopSpan(self)
+        elif parsed:
+            trace_id, parent_id, sampled = parsed
+            if not sampled:
+                # Parent-based sampling: honor the remote decision —
+                # an explicitly-unsampled parent (flags 00) must not
+                # be resurrected here.
+                return _NoopSpan(self)
         if trace_id is None:
             if self._rng.random() >= self.sample_rate:
                 return _NoopSpan(self)
@@ -335,11 +359,17 @@ class _NoopSpan(Span):
     def __init__(self, tracer: Tracer):
         super().__init__(tracer, "noop", "0" * 32, "0" * 16)
 
+    def traceparent(self) -> str:
+        # flags 00: a downstream layer (the engine's request span)
+        # honoring parent-based sampling must not resurrect children
+        # under the zero trace id.
+        return f"00-{self.trace_id}-{self.span_id}-00"
+
     def end(self) -> None:
         if self._token is not None:
             _current_span.reset(self._token)
             self._token = None
-        self.end_ns = time.time_ns()  # no export
+        self.end_ns = self.start_ns + self.duration_ns()  # no export
 
 
 def current_span() -> Optional[Span]:

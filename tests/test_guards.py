@@ -66,6 +66,8 @@ KNOB_GUARDS = {
         "structural: device table capacity; dead while grammar=False",
     "EngineConfig.prefill_chunk_tokens":
         "test_guards.py::test_interleave_off_is_true_noop",
+    "EngineConfig.flight_events":
+        "test_flight.py::test_flight_off_is_true_noop",
     "MockEngine.kv_quant":
         "test_guards.py::test_mock_knobs_off_are_true_noop",
     "MockEngine.fault_plan":
@@ -75,6 +77,8 @@ KNOB_GUARDS = {
     "MockEngine.watchdog_s":
         "test_guards.py::test_mock_knobs_off_are_true_noop",
     "MockEngine.prefill_chunk_tokens":
+        "test_guards.py::test_mock_knobs_off_are_true_noop",
+    "MockEngine.flight_events":
         "test_guards.py::test_mock_knobs_off_are_true_noop",
 }
 
@@ -447,6 +451,8 @@ def test_mock_knobs_off_are_true_noop():
 
     m = MockEngine([Scenario("hi", "hello-world")])
     assert m.queue_depth() == 0  # max_queue=0 keeps the idle signal
+    # flight_events=0: zero recorder state, no span plumbing engaged.
+    assert m._flight is None and m.tracer is None
     toks, fin = m.generate(
         m.tokenizer.encode("hi"), SamplingParams(max_tokens=32)
     )
@@ -454,7 +460,8 @@ def test_mock_knobs_off_are_true_noop():
     assert fin.finish_reason.value == "stop"
     for key in ("requests_shed", "deadline_exceeded", "watchdog_trips",
                 "mixed_steps", "interleaved_prefill_tokens",
-                "kv_quant_enabled", "kv_quant_rows_written"):
+                "kv_quant_enabled", "kv_quant_rows_written",
+                "flight_enabled"):
         assert m.metrics[key] == 0, (key, m.metrics[key])
     assert m.metrics["kv_quant_roundtrip_rel_err"] == 0.0
 

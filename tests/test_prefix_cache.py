@@ -476,6 +476,7 @@ class TestMetricsKeyStability:
         "requests_shed", "deadline_exceeded", "watchdog_trips",
         "recoveries",
         "mixed_steps", "interleaved_prefill_tokens", "decode_stall_steps",
+        "flight_enabled",
     }
 
     # MockEngine-private keys (beyond its EXPECTED mirror): the host-side

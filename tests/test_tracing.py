@@ -57,6 +57,41 @@ class TestTracer:
         assert s.status == "error"
         assert s.attrs["error.message"] == "bad"
 
+    def test_ntp_step_cannot_corrupt_duration(self, monkeypatch):
+        """start_ns/end_ns come from the wall clock for cross-process
+        timestamp correlation, but the DURATION must come from the
+        monotonic clock: a backwards NTP step between start and end used
+        to yield a negative span duration (end_ns < start_ns)."""
+        import time as _time
+
+        t = tr.Tracer("svc")
+        span = t.start_span("stepped")
+        # Simulate an NTP step: wall clock jumps 10 s into the past
+        # while ~2 ms of real (monotonic) time elapses.
+        real_time_ns = _time.time_ns
+        monkeypatch.setattr(
+            _time, "time_ns", lambda: real_time_ns() - 10_000_000_000
+        )
+        _time.sleep(0.002)
+        span.end()
+        assert span.end_ns >= span.start_ns
+        dur = span.end_ns - span.start_ns
+        assert 1_000_000 <= dur < 5_000_000_000  # ~2ms real, never -10s
+        assert span.duration_ns() == dur
+
+    def test_forward_wall_jump_does_not_inflate_duration(self, monkeypatch):
+        import time as _time
+
+        t = tr.Tracer("svc")
+        span = t.start_span("jumped")
+        real_time_ns = _time.time_ns
+        monkeypatch.setattr(
+            _time, "time_ns", lambda: real_time_ns() + 3_600_000_000_000
+        )
+        span.end()
+        # A +1h wall jump must not become a 1h span.
+        assert span.end_ns - span.start_ns < 1_000_000_000
+
     def test_jsonl_export(self, tmp_path):
         path = str(tmp_path / "spans.jsonl")
         t = tr.Tracer("svc", export_path=path)
