@@ -44,6 +44,7 @@ LOCK_GROUPS: tuple[tuple[str, tuple[str, ...]], ...] = (
         "omnia_tpu/engine/sessions.py",
         "omnia_tpu/engine/prefix_cache.py",
         "omnia_tpu/engine/spec_decode.py",
+        "omnia_tpu/engine/paged.py",
         "omnia_tpu/engine/multihost.py",
     )),
     ("mock", ("omnia_tpu/engine/mock.py",)),

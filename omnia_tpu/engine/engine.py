@@ -41,7 +41,6 @@ from __future__ import annotations
 import collections
 import itertools
 import logging
-import queue
 import threading
 import time
 from typing import Optional
@@ -53,6 +52,11 @@ from omnia_tpu.engine.faults import FaultPlan
 from omnia_tpu.engine.flight import FlightRecorder
 from omnia_tpu.engine.interleave import _InflightPrefill, _InterleaveMixin
 from omnia_tpu.engine.lifecycle import _LifecycleMixin
+from omnia_tpu.engine.paged import (
+    _PagedKVMixin,
+    dp_divisibility_error,
+    validate_paged_config,
+)
 from omnia_tpu.engine.placement import _PlacementMixin
 from omnia_tpu.engine.prefix_cache import PrefixPool, _PrefixCacheMixin
 from omnia_tpu.engine.programs import build_programs
@@ -88,7 +92,7 @@ logger = logging.getLogger(__name__)
 
 class InferenceEngine(
     _SchedulerMixin, _SessionMixin, _SpecDecodeMixin, _PrefixCacheMixin,
-    _PlacementMixin, _InterleaveMixin, _LifecycleMixin,
+    _PlacementMixin, _InterleaveMixin, _LifecycleMixin, _PagedKVMixin,
 ):
     """Slot-based continuous-batching engine over one model."""
 
@@ -136,6 +140,7 @@ class InferenceEngine(
         self._kv_quant = validate_kv_quant(engine_cfg.kv_quant)
         self._mesh = None
         use_mesh = engine_cfg.dp * engine_cfg.tp * engine_cfg.sp > 1
+        validate_paged_config(engine_cfg, use_mesh)
         if use_mesh:
             self._mesh = make_mesh(
                 engine_cfg.dp, engine_cfg.tp, sp=engine_cfg.sp, devices=devices
@@ -190,7 +195,10 @@ class InferenceEngine(
             if self._mesh is not None and (
                 engine_cfg.prefix_cache_slots % max(engine_cfg.dp, 1) != 0
             ):
-                raise ValueError("prefix_cache_slots must be divisible by dp")
+                raise ValueError(dp_divisibility_error(
+                    "prefix_cache_slots", engine_cfg.prefix_cache_slots,
+                    engine_cfg.dp,
+                ))
             self._prefix_pool = PrefixPool(
                 engine_cfg.prefix_cache_slots,
                 engine_cfg.prefix_cache_host_entries,
@@ -333,6 +341,16 @@ class InferenceEngine(
             "kv_quant_device_bytes": cache_bytes(
                 self._ck, self._cv, self._pk, self._pv
             ),
+            # Paged KV cache (engine/kv_pages.py) — pool gauges, live
+            # while kv_pages > 0 and zero otherwise: usable pages total/
+            # free, internal fragmentation of slot-referenced pages
+            # (allocated-but-unused token slack), and copy-on-write page
+            # copies (a shared prefix page duplicated because a slot
+            # diverged into it).
+            "kv_pages_total": self._pages.total if self._pages else 0,
+            "kv_pages_free": self._pages.free_count if self._pages else 0,
+            "kv_page_fragmentation": 0.0,
+            "kv_page_cow_copies": 0,
             # Engine flight recorder (engine/flight.py): set once at
             # construction, like kv_quant_enabled — dashboards can tell
             # whether per-request latency breakdowns exist before asking
@@ -362,6 +380,9 @@ class InferenceEngine(
         self._prefix_offload_fn = progs.prefix_offload
         self._mixed_fns = progs.mixed
         self._mixed_sample_fns = progs.mixed_sample
+        self._page_copy_fn = progs.page_copy
+        self._gather_pages_fn = progs.gather_pages
+        self._scatter_pages_fn = progs.scatter_pages
         from omnia_tpu.ops.attention import pallas_decode_mode
 
         logger.info(
@@ -377,36 +398,46 @@ class InferenceEngine(
         donated-buffer step, self._ck/_cv may point at deleted arrays, so
         the only way back to a healthy engine is a fresh allocation."""
         B, S = self.cfg.num_slots, self.cfg.max_seq
-        ck, cv = llama.init_kv_cache(
-            self.model_cfg, B, S, dtype=self._dtype, kv_quant=self._kv_quant
-        )
-        if self._mesh is not None:
-            kspec, vspec = llama.kv_cache_specs(self._kv_quant)
-            tree = named_sharding_tree((kspec, vspec), self._mesh)
-            ck = jax.device_put(ck, tree[0])
-            cv = jax.device_put(cv, tree[1])
-        self._ck, self._cv = ck, cv
-
-        # Shared-prefix pool arrays: [L, P, R, H, D] beside the slot
-        # cache, same layout/sharding (P over dp, heads over tp) AND the
-        # same KV representation — under kv_quant the pool holds int8
-        # rows + scales, so the same pool bytes cache 2× the prefixes. A
-        # reallocation means any device-resident pool entries died with
-        # the caches; host-paged entries survive in the pool's books.
-        self._pk = self._pv = None
-        if self._prefix_pool is not None:
-            R = self.cfg.prefix_buckets()[-1]
-            pk, pv = llama.init_kv_cache(
-                self.model_cfg, self.cfg.prefix_cache_slots, R,
-                dtype=self._dtype, kv_quant=self._kv_quant,
+        if self.cfg.kv_pages > 0:
+            # Paged layout (engine/paged.py): ONE page pool + per-slot
+            # page tables serve the slots, the prefix cache (page runs
+            # in the same pool), and session paging from a single free
+            # list — the dedicated _pk/_pv prefix arrays do not exist.
+            self._init_paged_state()
+        else:
+            ck, cv = llama.init_kv_cache(
+                self.model_cfg, B, S, dtype=self._dtype, kv_quant=self._kv_quant
             )
             if self._mesh is not None:
-                pk = jax.device_put(pk, tree[0])
-                pv = jax.device_put(pv, tree[1])
-            self._pk, self._pv = pk, pv
-            self._prefix_pool.on_device_reset()
-            if hasattr(self, "metrics"):  # absent on first (construction) call
-                self.metrics["prefix_cache_evictions"] = self._prefix_pool.evictions
+                kspec, vspec = llama.kv_cache_specs(self._kv_quant)
+                tree = named_sharding_tree((kspec, vspec), self._mesh)
+                ck = jax.device_put(ck, tree[0])
+                cv = jax.device_put(cv, tree[1])
+            self._ck, self._cv = ck, cv
+
+            # Shared-prefix pool arrays: [L, P, R, H, D] beside the slot
+            # cache, same layout/sharding (P over dp, heads over tp) AND
+            # the same KV representation — under kv_quant the pool holds
+            # int8 rows + scales, so the same pool bytes cache 2× the
+            # prefixes. A reallocation means any device-resident pool
+            # entries died with the caches; host-paged entries survive
+            # in the pool's books.
+            self._pk = self._pv = None
+            if self._prefix_pool is not None:
+                R = self.cfg.prefix_buckets()[-1]
+                pk, pv = llama.init_kv_cache(
+                    self.model_cfg, self.cfg.prefix_cache_slots, R,
+                    dtype=self._dtype, kv_quant=self._kv_quant,
+                )
+                if self._mesh is not None:
+                    pk = jax.device_put(pk, tree[0])
+                    pv = jax.device_put(pv, tree[1])
+                self._pk, self._pv = pk, pv
+                self._prefix_pool.on_device_reset()
+                if hasattr(self, "metrics"):  # absent at construction
+                    self.metrics["prefix_cache_evictions"] = (
+                        self._prefix_pool.evictions
+                    )
         if hasattr(self, "metrics"):
             self.metrics["kv_quant_device_bytes"] = cache_bytes(
                 self._ck, self._cv, self._pk, self._pv
@@ -555,11 +586,17 @@ class InferenceEngine(
             for r in self.cfg.restore_buckets():
                 k, v = self._offload_fn(self._ck, self._cv, zero, r)
                 self._ck, self._cv = self._restore_fn(self._ck, self._cv, k, v, zero)
-        if self._prefix_enabled():
+        if self._paged_on():
+            # Paged-only programs: page copy (CoW), table-row sync, and
+            # the prefix host-tier page-run transfer buckets.
+            self._warmup_paged()
+        if self._prefix_enabled() and self._prefix_store_fn is not None:
             # Pool transfers per prefix bucket: store (slot→pool), seed
             # (pool→slot), demote (pool→host), and the host-hit restore
             # path with the SAME scalar types placement dispatches
-            # (python-int slot/pool indices, static row bucket).
+            # (python-int slot/pool indices, static row bucket). Absent
+            # under kv_pages — the paged prefix cache is table rewrites
+            # plus the page-run programs warmed above.
             for b in self.cfg.prefix_buckets():
                 self._pk, self._pv = self._prefix_store_fn(
                     self._pk, self._pv, self._ck, self._cv, 0, 0, b
@@ -745,40 +782,9 @@ class InferenceEngine(
     def active_slots(self) -> int:
         return sum(1 for s in self._slots if s.active)
 
-    def live_request_ids(self) -> set:
-        """Request ids still queued or decoding (multihost handle-map
-        hygiene: live handles must never be evicted)."""
-        with self._lock:
-            waiting = {req.request_id for req, _h in self._waiting}
-        pf = self._prefilling
-        if pf is not None:
-            waiting.add(pf.request.request_id)  # mid-interleave placement
-        return waiting | {
-            s.request.request_id for s in self._slots if s.active
-        }
-
     # ------------------------------------------------------------------
     # Thread loop / lifecycle: start/stop/drain/recovery live in
-    # engine/lifecycle.py (_LifecycleMixin) — the robustness seam.
+    # engine/lifecycle.py (_LifecycleMixin); the synchronous generate()
+    # helper and live_request_ids() in engine/scheduler.py
+    # (_SchedulerMixin) — the step-driving seam.
     # ------------------------------------------------------------------
-
-    def generate(
-        self, prompt_tokens: list[int], params: SamplingParams = SamplingParams()
-    ) -> tuple[list[int], StreamEvent]:
-        """Synchronous helper: submit and drive steps inline (single-threaded
-        use in tests/bench; with the engine thread running, just blocks)."""
-        handle = self.submit(prompt_tokens, params)
-        if self._thread is None:
-            toks: list[int] = []
-            while True:
-                self.step()
-                try:
-                    while True:
-                        ev = handle._queue.get_nowait()
-                        if ev.token_id is not None:
-                            toks.append(ev.token_id)
-                        if ev.is_final:
-                            return toks, ev
-                except queue.Empty:
-                    pass
-        return handle.collect_tokens(timeout=120)

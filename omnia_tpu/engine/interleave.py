@@ -193,6 +193,10 @@ class _InterleaveMixin:
             self.metrics["prefill_dispatch_s"] += time.monotonic() - t0
             self.metrics["prefix_reuse_tokens"] += reuse
             frontier = reuse or seeded
+            if frontier == 0:
+                # Paged pool: cold start — stale pages back to the free
+                # list before the first piece allocates fresh ones.
+                self._free_slot_pages(slot_idx)
             if sess is not None:
                 # Truncate to the reuse frontier NOW: the pieces below
                 # overwrite rows from `frontier` on, so any longer stale
@@ -226,6 +230,11 @@ class _InterleaveMixin:
         # the row the next piece (or the first real decode write after
         # activation) overwrites.
         self._positions = self._positions.at[pf.slot_idx].set(off + take)
+        # Paged pool: exclusive pages through the piece's bucket end for
+        # the placing slot (the parked garbage row lands inside them),
+        # plus one decode row for every active slot.
+        self._prepare_slot_write(pf.slot_idx, off, min(off + bucket, self.cfg.max_seq))
+        self._prealloc_decode_pages(1)
         toks = np.zeros((1, bucket), np.int32)
         toks[0, :take] = pf.prompt[off:off + take]
         ppos = (off + np.arange(bucket, dtype=np.int32))[None, :]
@@ -307,6 +316,9 @@ class _InterleaveMixin:
         if pf.sess is not None:
             pf.sess.token_ids = list(prompt)
         self._maybe_publish_prefix(slot_idx, prompt)
+        # Paged pool: drop the final piece's bucket-padding slack (after
+        # publish shared the prefix pages).
+        self._trim_slot_pages(slot_idx, n)
         self.metrics["prefill_steps"] += 1
 
         self._tokens = self._tokens.at[slot_idx].set(first_tok)
@@ -371,6 +383,9 @@ class _InterleaveMixin:
         else:
             self._release_slot_seed(slot)
         slot.clear()
+        # Paged pool: keep only the pages below the consumed frontier
+        # (the session's reusable rows); everything else frees.
+        self._trim_slot_pages(pf.slot_idx, quiesce_row)
         self._positions = self._positions.at[pf.slot_idx].set(quiesce_row)
         with self._lock:
             self._placing -= 1

@@ -83,7 +83,8 @@ class MockEngine:
     def __init__(self, scenarios: Sequence[Scenario] = (), tokenizer=None,
                  kv_quant=None, fault_plan: Optional[FaultPlan] = None,
                  max_queue: int = 0, watchdog_s: Optional[float] = None,
-                 prefill_chunk_tokens: int = 0, flight_events: int = 0):
+                 prefill_chunk_tokens: int = 0, flight_events: int = 0,
+                 kv_pages: int = 0, kv_page_tokens: int = 64):
         self.scenarios = list(scenarios)
         self.tokenizer = tokenizer or ByteTokenizer()
         self._req_counter = itertools.count()
@@ -131,6 +132,23 @@ class MockEngine:
 
             kv_quant = validate_kv_quant(kv_quant)
         self.kv_quant = kv_quant
+        # Paged-KV parity (engine/kv_pages.py): the mock has no device
+        # pool, but with kv_pages set each live playback reserves real
+        # pages from the SAME host-side allocator the engine books with,
+        # so the occupancy/fragmentation gauges (and their exhaustion
+        # behavior) are exercisable hermetically. kv_pages=0 allocates
+        # nothing — the guarded no-op, zero-valued gauges.
+        self.kv_pages = kv_pages
+        self.kv_page_tokens = kv_page_tokens
+        # The allocator REFERENCE is immutable after construction; its
+        # internal books (and _page_slots) mutate only under _lock.
+        self._page_alloc = None
+        self._page_slots: list[int] = []  # guarded-by: _lock
+        if kv_pages > 0:
+            from omnia_tpu.engine.kv_pages import PageAllocator
+
+            self._page_alloc = PageAllocator(kv_pages, kv_page_tokens, kv_pages)
+            self._page_slots = list(range(kv_pages))
         self.metrics = {  # guarded-by: _lock
             "requests_submitted": 0,
             "requests_finished": 0,
@@ -157,6 +175,15 @@ class MockEngine:
             "decode_stall_steps": 0,
             # Flight-recorder parity (engine/flight.py).
             "flight_enabled": 1 if flight_events > 0 else 0,
+            # Paged-KV parity (engine/kv_pages.py): live playbacks hold
+            # pages in a real allocator, so these mirror the engine's
+            # pool gauges; all zero with kv_pages=0.
+            "kv_pages_total": self._page_alloc.total if self._page_alloc else 0,
+            "kv_pages_free": (
+                self._page_alloc.free_count if self._page_alloc else 0
+            ),
+            "kv_page_fragmentation": 0.0,
+            "kv_page_cow_copies": 0,
         }
         self._gr_mask_sum = 0.0
         self._gr_mask_steps = 0
@@ -385,11 +412,43 @@ class MockEngine:
                 self.metrics["grammar_rejections_avoided"] += 1
         return toks
 
+    def _page_mirror_begin(self, n_prompt: int) -> Optional[int]:
+        """Reserve pages for a live playback's prompt rows (paged-KV
+        parity). None when the mirror is off or saturated — playback
+        proceeds either way; the mirror only drives the gauges."""
+        if self._page_alloc is None:
+            return None
+        with self._lock:
+            if not self._page_slots:
+                return None
+            a = self._page_alloc
+            slot = self._page_slots.pop()
+            rows = min(n_prompt, a.page_tokens * a.total)
+            if a.writes_needed(slot, 0, rows) <= a.free_count:
+                a.prepare_write(slot, 0, rows)
+            self.metrics["kv_pages_free"] = a.free_count
+            self.metrics["kv_page_fragmentation"] = a.fragmentation()
+            self.metrics["kv_page_cow_copies"] = a.cow_copies
+            return slot
+
+    def _page_mirror_end(self, slot: Optional[int]) -> None:
+        if slot is None:
+            return
+        with self._lock:
+            a = self._page_alloc
+            a.release_from(slot, 0)
+            self._page_slots.append(slot)
+            self.metrics["kv_pages_free"] = a.free_count
+            self.metrics["kv_page_fragmentation"] = a.fragmentation()
+            self.metrics["kv_page_cow_copies"] = a.cow_copies
+
     def _play_guarded(self, rid, prompt_tokens, params, handle, grammar,
                       deadline_at):
+        page_slot = self._page_mirror_begin(len(prompt_tokens))
         try:
             self._play(rid, prompt_tokens, params, handle, grammar, deadline_at)
         finally:
+            self._page_mirror_end(page_slot)
             with self._lock:
                 self._live_plays -= 1
                 self._live_prompt_tokens -= len(prompt_tokens)

@@ -209,6 +209,12 @@ class _PlacementMixin:
         if reuse == 0:
             seeded = self._try_seed_from_pool(slot_idx, prompt, sess)
         frontier = reuse or seeded
+        if frontier == 0:
+            # Paged pool: a cold start owns no history — return any
+            # stale pages (a diverged session's, a dropped pin's) to
+            # the free list before the bucket write allocates fresh
+            # ones. No-op on the contiguous layout.
+            self._free_slot_pages(slot_idx)
         # Prefill-first bookkeeping: every prefill forward dispatched
         # while a decode slot sits live is a stall step — the decode
         # batch idles for the whole dispatch. The token-budget policy
@@ -227,6 +233,11 @@ class _PlacementMixin:
             if self._flight is not None:
                 self._flight.note_stall(stall_steps)
         self._maybe_publish_prefix(slot_idx, prompt)
+        # Paged pool: the bucket-padded prefill covered rows past the
+        # prompt — return that slack now (publish above already shares
+        # the prefix pages, so only pad pages free). The next decode
+        # write re-allocates its page in the pre-dispatch prealloc.
+        self._trim_slot_pages(slot_idx, n)
         prefill_s = time.monotonic() - t_prefill
         self.metrics["prefill_dispatch_s"] += prefill_s
         self.metrics["prefix_reuse_tokens"] += reuse
@@ -299,6 +310,9 @@ class _PlacementMixin:
         # excludes them — and decode overwrites each pad row before it first
         # becomes attendable.
         pos = np.arange(bucket, dtype=np.int32)[None, :]
+        # Paged pool: the fused prefill writes the whole bucket —
+        # exclusive pages must cover it before dispatch.
+        self._prepare_slot_write(slot_idx, 0, bucket)
         if (
             self._prefill_ring_fn is not None
             and bucket >= self.cfg.long_prefill_threshold
@@ -368,6 +382,10 @@ class _PlacementMixin:
         rid = request.request_id if request is not None else ""
         for off, take, b in pieces[:-1]:
             toks, pos = chunk_arrays(off, take, b)
+            # Paged pool: each bucket-padded piece write needs exclusive
+            # pages through its end — the first piece after a seed also
+            # copy-on-writes the shared boundary page here.
+            self._prepare_slot_write(slot_idx, off, off + b)
             t0 = time.monotonic()
             self._ck, self._cv = self._extend_nosample_fn(
                 self.params, self._ck, self._cv, toks, pos, slot_arr, jnp.int32(off)
@@ -378,6 +396,7 @@ class _PlacementMixin:
                 )
         off, take, b = pieces[-1]
         toks, pos = chunk_arrays(off, take, b)
+        self._prepare_slot_write(slot_idx, off, off + b)
         kd = self._sampling_key(slot_idx, sp)
         t0 = time.monotonic()
         self._ck, self._cv, first_tok, new_kd = self._extend_fn(

@@ -65,7 +65,7 @@ class PrefixEntry:
     """One cached prefix: token ids + where its KV rows live."""
 
     __slots__ = (
-        "key", "tokens", "bucket", "pool_idx", "host_k", "host_v",
+        "key", "tokens", "bucket", "pool_idx", "pages", "host_k", "host_v",
         "refs", "hits", "last_used", "registered",
     )
 
@@ -75,9 +75,18 @@ class PrefixEntry:
         self.tokens = tokens                  # the rows KNOWN valid
         self.bucket = bucket                  # fixed transfer shape
         self.pool_idx: Optional[int] = None   # device pool slot
+        # Paged engine (EngineConfig.kv_pages): the refcounted page run
+        # holding this prefix's rows in the ONE shared device pool —
+        # publish shares the prefill slot's pages (zero copies), seed
+        # points a fresh slot's table at them, and divergent writes
+        # copy-on-write. pool_idx stays None in that mode; bucket holds
+        # the page-run transfer bucket for the host tier.
+        self.pages: Optional[list[int]] = None
         # Paged tier: numpy rows, or a QuantKV of numpy leaves when the
         # engine runs kv_quant (the host tier inherits the KV dtype, so
-        # its entry budget buys 2× the rows under int8).
+        # its entry budget buys 2× the rows under int8). Under kv_pages
+        # the host arrays hold whole pages ([L, bucket, PAGE_S, H, D]),
+        # verbatim.
         self.host_k = None
         self.host_v = None
         self.refs = 0                         # resident seeders
@@ -87,7 +96,7 @@ class PrefixEntry:
 
     @property
     def on_device(self) -> bool:
-        return self.pool_idx is not None
+        return self.pool_idx is not None or self.pages is not None
 
 
 class PrefixPool:
@@ -109,6 +118,9 @@ class PrefixPool:
         self._registered: list[tuple] = []
         self._keys = itertools.count()
         self.evictions = 0  # device-slot losses (demote or drop)
+        # Paged engine: set to the page allocator's release so dropping
+        # an entry that still holds a page run returns the references.
+        self.page_release = None
 
     # -- radix index ---------------------------------------------------
 
@@ -310,9 +322,17 @@ class PrefixPool:
         if node is not None and node.entry is entry:
             node.entry = None
         entry.host_k = entry.host_v = None
+        if entry.pages is not None:
+            if self.page_release is not None:
+                self.page_release(entry.pages)
+            entry.pages = None
         if entry.pool_idx is not None:
             self._free.append(entry.pool_idx)
             entry.pool_idx = None
+
+    # Public alias: the paged engine drops stale entries (rebuild on
+    # miss) and crash-reset zombies without reaching into privates.
+    drop_entry = _drop
 
     def _find_node(self, tokens: list[int]) -> Optional[_RadixNode]:
         node, d = self._root, 0
@@ -402,7 +422,14 @@ class _PrefixCacheMixin:
         matched = min(matched, len(prompt) - 1)
         if entry is None or matched < self.cfg.prefix_cache_min_tokens:
             return 0
-        if entry.on_device:
+        if self._paged_on():
+            # Paged pool: the seed is a page-table rewrite onto the
+            # entry's refcounted page run — zero device copies; the
+            # suffix prefill's first write copy-on-writes the boundary
+            # page (engine/paged.py).
+            if not self._paged_adopt_entry(entry, slot_idx, matched):
+                return 0
+        elif entry.on_device:
             self._ck, self._cv = self._prefix_seed_fn(
                 self._ck, self._cv, self._pk, self._pv,
                 entry.pool_idx, slot_idx, entry.bucket,
@@ -504,6 +531,13 @@ class _PrefixCacheMixin:
         _e, already = pool.match(tokens)
         if candidate - already < min_tokens:
             return  # the pool already covers (nearly) all of it
+        if self._paged_on():
+            # Paged pool: publishing SHARES the slot's freshly-written
+            # pages with the new entry (refcount only — no store copy,
+            # no dedicated pool slot to acquire; pool pressure is
+            # handled by demand-time reclaim instead).
+            self._paged_publish(slot_idx, tokens, registered)
+            return
         idx, demoted = pool.acquire_slot()
         if idx is None:
             return  # every entry is pinned by a resident seeder

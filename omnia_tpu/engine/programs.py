@@ -66,6 +66,7 @@ import jax.numpy as jnp
 from omnia_tpu.engine.types import EngineConfig
 from omnia_tpu.models import ModelConfig, llama
 from omnia_tpu.models.kv_quant import cache_put, cache_take, kv_map
+from omnia_tpu.models import paged_kv as pkv
 from omnia_tpu.ops.sampling import _NEG_INF, sample_tokens_per_slot
 
 
@@ -90,6 +91,11 @@ class EnginePrograms:
     # (prefill_chunk_tokens > 0, else both dicts are empty).
     mixed: dict[int, Callable]
     mixed_sample: dict[int, Callable]
+    # Paged-pool programs (kv_pages > 0, else all None): copy-on-write
+    # page duplication and the prefix host-tier page-run transfers.
+    page_copy: Optional[Callable] = None
+    gather_pages: Optional[Callable] = None
+    scatter_pages: Optional[Callable] = None
 
 
 def build_programs(
@@ -112,6 +118,38 @@ def build_programs(
     def _first_bias(g):
         return g[0][None] if g else None
 
+    # Paged KV cache (kv_pages > 0): ck/cv operands are PagedKV pytrees
+    # (pool + page table) instead of [L, B, S, H, D] arrays, and the
+    # three access seams below reroute through the table. kv_pages=0
+    # takes the exact pre-paging branches at trace time, so the lowered
+    # programs carry the unchanged contiguous operands (the guarded
+    # no-op contract).
+    paged = ecfg.kv_pages > 0
+
+    def _put(c, chunk, slot, start):
+        """Write a slot-row chunk [L, 1, T, H, D] at rows [start, …)."""
+        if paged:
+            return pkv.put_chunk(c, chunk, slot, start)
+        return cache_put(c, chunk, (0, slot, start))
+
+    def _take_slot(c, slot):
+        """One slot's contiguous [L, 1, S, H, D] view, either layout."""
+        if paged:
+            return pkv.gather_slot(c, slot)
+        L, B, S, H, D = c.shape
+        return cache_take(c, (0, slot, 0), (L, 1, S))
+
+    def _put_back(c, view, slot, write_start, t):
+        """Write a slot view back after forward wrote rows
+        [write_start, write_start + t): contiguous puts the whole view
+        (one dynamic_update_slice, its storage); paged scatters ONLY
+        the written rows through the page table — the rest of the view
+        is a gather copy, not the storage."""
+        if paged:
+            new = cache_take(view, (0, 0, write_start), (view.shape[0], 1, t))
+            return pkv.put_chunk(c, new, slot, write_start)
+        return cache_put(c, view, (0, slot, 0))
+
     def prefill_insert(params, ck, cv, tokens, positions, slot, last_idx,
                        key_data, temp, top_p, top_k, *g):
         logits, k_chunk, v_chunk = llama.forward_prefill(
@@ -120,8 +158,8 @@ def build_programs(
 
         # c: [L,B,S,H,D]; chunk: [L,1,T,H,D] — a quantized cache
         # quantizes the fresh rows inside cache_put (kv_quant mode).
-        ck = cache_put(ck, k_chunk, (0, slot, 0))
-        cv = cache_put(cv, v_chunk, (0, slot, 0))
+        ck = _put(ck, k_chunk, slot, 0)
+        cv = _put(cv, v_chunk, slot, 0)
         last = jax.lax.dynamic_slice(
             logits, (0, last_idx, 0), (1, 1, logits.shape[-1])
         )[:, 0]
@@ -144,8 +182,8 @@ def build_programs(
                top_p, top_k, *g):
         # Place the prefill chunk into the slot's rows [slot, 0:T]
         # (chunk [L,1,T,H,D] floats — quantized on write in kv mode).
-        ck = cache_put(ck, k_chunk, (0, slot, 0))
-        cv = cache_put(cv, v_chunk, (0, slot, 0))
+        ck = _put(ck, k_chunk, slot, 0)
+        cv = _put(cv, v_chunk, slot, 0)
         tok, new_kd = sample_tokens_per_slot(
             last_logits, key_data[None], temp[None], top_p[None], top_k[None],
             mask_bias=_first_bias(g),
@@ -291,17 +329,17 @@ def build_programs(
 
     def extend(params, ck, cv, tokens, positions, slot, write_start, last_idx,
                key_data, temp, top_p, top_k, *g):
-        L, B, S, H, D = ck.shape
-        k_slot = cache_take(ck, (0, slot, 0), (L, 1, S))
-        v_slot = cache_take(cv, (0, slot, 0), (L, 1, S))
+        k_slot = _take_slot(ck, slot)
+        v_slot = _take_slot(cv, slot)
         logits, k_slot, v_slot = llama.forward(
             params, cfg, tokens, positions, k_slot, v_slot, write_start[None]
         )
         # forward kept the slice in cache representation (suffix rows
         # quantized inside _write_kv when kv_quant is on) — write back
         # verbatim, no requantization of resident rows.
-        ck = cache_put(ck, k_slot, (0, slot, 0))
-        cv = cache_put(cv, v_slot, (0, slot, 0))
+        t = tokens.shape[1]
+        ck = _put_back(ck, k_slot, slot, write_start, t)
+        cv = _put_back(cv, v_slot, slot, write_start, t)
         last = jax.lax.dynamic_slice(
             logits, (0, last_idx, 0), (1, 1, logits.shape[-1])
         )[:, 0]
@@ -316,14 +354,14 @@ def build_programs(
     # Mid-extend chunk: writes rows, no sampling (sampling happens only
     # on the final chunk of a multi-chunk extend).
     def extend_nosample(params, ck, cv, tokens, positions, slot, write_start):
-        L, B, S, H, D = ck.shape
-        k_slot = cache_take(ck, (0, slot, 0), (L, 1, S))
-        v_slot = cache_take(cv, (0, slot, 0), (L, 1, S))
+        k_slot = _take_slot(ck, slot)
+        v_slot = _take_slot(cv, slot)
         _, k_slot, v_slot = llama.forward(
             params, cfg, tokens, positions, k_slot, v_slot, write_start[None]
         )
-        ck = cache_put(ck, k_slot, (0, slot, 0))
-        cv = cache_put(cv, v_slot, (0, slot, 0))
+        t = tokens.shape[1]
+        ck = _put_back(ck, k_slot, slot, write_start, t)
+        cv = _put_back(cv, v_slot, slot, write_start, t)
         return ck, cv
 
     extend_nosample_fn = jax.jit(extend_nosample, donate_argnums=(1, 2))
@@ -358,14 +396,14 @@ def build_programs(
                 else:
                     gstate = gtable = gactive = None
                 # -- prefill piece via the extend seam ------------------
-                L, B, S, H, D = ck.shape
-                k_slot = cache_take(ck, (0, pslot, 0), (L, 1, S))
-                v_slot = cache_take(cv, (0, pslot, 0), (L, 1, S))
+                k_slot = _take_slot(ck, pslot)
+                v_slot = _take_slot(cv, pslot)
                 plogits, k_slot, v_slot = llama.forward(
                     params, cfg, ptoks, ppos, k_slot, v_slot, pwrite[None]
                 )
-                ck = cache_put(ck, k_slot, (0, pslot, 0))
-                cv = cache_put(cv, v_slot, (0, pslot, 0))
+                pt = ptoks.shape[1]
+                ck = _put_back(ck, k_slot, pslot, pwrite, pt)
+                cv = _put_back(cv, v_slot, pslot, pwrite, pt)
                 extra = ()
                 if sample:
                     # Final piece: sample the placed request's first
@@ -403,18 +441,23 @@ def build_programs(
             mixed_sample_fns[b] = make_mixed(b, sample=True)
 
     def offload(ck, cv, slot, rows: int):
+        # Paged rows keep the cache representation (int8 + scales under
+        # kv_quant — host pages shrink with the device bytes). Under
+        # kv_pages only the pages covering the bucket are gathered, and
+        # the HOST format is identical to the contiguous engine's, so
+        # session pages survive a layout change.
+        if paged:
+            return pkv.gather_rows(ck, slot, rows), pkv.gather_rows(cv, slot, rows)
         L, B, S, H, D = ck.shape
         k = cache_take(ck, (0, slot, 0), (L, 1, rows))
         v = cache_take(cv, (0, slot, 0), (L, 1, rows))
-        # Paged rows keep the cache representation (int8 + scales under
-        # kv_quant — host pages shrink with the device bytes).
         return kv_map(lambda a: a[:, 0], k), kv_map(lambda a: a[:, 0], v)
 
     offload_fn = jax.jit(offload, static_argnums=(3,))
 
     def restore(ck, cv, k_rows, v_rows, slot):
-        ck = cache_put(ck, kv_map(lambda a: a[:, None], k_rows), (0, slot, 0))
-        cv = cache_put(cv, kv_map(lambda a: a[:, None], v_rows), (0, slot, 0))
+        ck = _put(ck, kv_map(lambda a: a[:, None], k_rows), slot, 0)
+        cv = _put(cv, kv_map(lambda a: a[:, None], v_rows), slot, 0)
         return ck, cv
 
     restore_fn = jax.jit(restore, donate_argnums=(0, 1))
@@ -429,8 +472,11 @@ def build_programs(
     # device-to-device copy that replaces a fresh session's shared-prefix
     # prefill; prefix_offload: pool entry → host (paged tier; promotion
     # back rides the slot restore program). All take a static row bucket.
+    # Under kv_pages the prefix cache needs NO transfer programs at all:
+    # publish and seed are pure page-table rewrites (engine/paged.py),
+    # and the host tier rides the page-run gather/scatter below.
     prefix_store_fn = prefix_seed_fn = prefix_offload_fn = None
-    if ecfg.prefix_cache_slots > 0:
+    if ecfg.prefix_cache_slots > 0 and not paged:
         def prefix_store(pool_k, pool_v, ck, cv, slot, pool_idx, rows: int):
             L, B, S, H, D = ck.shape
             # Pool entries inherit the cache representation: under
@@ -466,6 +512,33 @@ def build_programs(
 
         prefix_offload_fn = jax.jit(prefix_offload, static_argnums=(3,))
 
+    # Paged-pool programs: the copy-on-write page duplicator and the
+    # prefix host-tier page-run transfers (TRASH-padded fixed-length
+    # runs keep them compile-stable; pad gathers are garbage the host
+    # slices off, pad scatters land in the trash page).
+    page_copy_fn = gather_pages_fn = scatter_pages_fn = None
+    if paged:
+        def page_copy(ck, cv, src, dst):
+            return (
+                pkv.PagedKV(pkv.copy_page(ck.pool, src, dst), ck.table),
+                pkv.PagedKV(pkv.copy_page(cv.pool, src, dst), cv.table),
+            )
+
+        page_copy_fn = jax.jit(page_copy, donate_argnums=(0, 1))
+
+        def gather_pages(ck, cv, idx):
+            return pkv.gather_pages(ck.pool, idx), pkv.gather_pages(cv.pool, idx)
+
+        gather_pages_fn = jax.jit(gather_pages)
+
+        def scatter_pages(ck, cv, idx, k_pages, v_pages):
+            return (
+                pkv.PagedKV(pkv.scatter_pages(ck.pool, idx, k_pages), ck.table),
+                pkv.PagedKV(pkv.scatter_pages(cv.pool, idx, v_pages), cv.table),
+            )
+
+        scatter_pages_fn = jax.jit(scatter_pages, donate_argnums=(0, 1))
+
     verify_fn = None
     if ecfg.spec_decode > 0:
         def verify(params, ck, cv, tokens, positions, write_start):
@@ -492,4 +565,7 @@ def build_programs(
         prefix_offload=prefix_offload_fn,
         mixed=mixed_fns,
         mixed_sample=mixed_sample_fns,
+        page_copy=page_copy_fn,
+        gather_pages=gather_pages_fn,
+        scatter_pages=scatter_pages_fn,
     )

@@ -28,6 +28,7 @@ pipeline flush per step (finish surfacing) but chunks stay full-size.
 
 from __future__ import annotations
 
+import queue
 import threading
 import time
 from typing import Optional
@@ -35,7 +36,7 @@ from typing import Optional
 import numpy as np
 
 from omnia_tpu.engine.faults import WatchdogTimeout
-from omnia_tpu.engine.types import FinishReason, StreamEvent
+from omnia_tpu.engine.types import FinishReason, SamplingParams, StreamEvent
 
 
 class _SchedulerMixin:
@@ -45,6 +46,39 @@ class _SchedulerMixin:
     state, and compiled programs. Split out so the dispatch/pipeline
     policy reads as one unit apart from placement and session residency.
     """
+
+    def generate(
+        self, prompt_tokens: list[int], params: SamplingParams = SamplingParams()
+    ) -> tuple[list[int], StreamEvent]:
+        """Synchronous helper: submit and drive steps inline (single-threaded
+        use in tests/bench; with the engine thread running, just blocks)."""
+        handle = self.submit(prompt_tokens, params)
+        if self._thread is None:
+            toks: list[int] = []
+            while True:
+                self.step()
+                try:
+                    while True:
+                        ev = handle._queue.get_nowait()
+                        if ev.token_id is not None:
+                            toks.append(ev.token_id)
+                        if ev.is_final:
+                            return toks, ev
+                except queue.Empty:
+                    pass
+        return handle.collect_tokens(timeout=120)
+
+    def live_request_ids(self) -> set:
+        """Request ids still queued or decoding (multihost handle-map
+        hygiene: live handles must never be evicted)."""
+        with self._lock:
+            waiting = {req.request_id for req, _h in self._waiting}
+        pf = self._prefilling
+        if pf is not None:
+            waiting.add(pf.request.request_id)  # mid-interleave placement
+        return waiting | {
+            s.request.request_id for s in self._slots if s.active
+        }
 
     def step(self) -> bool:
         """One scheduling step. Returns True if any work was done."""
@@ -456,6 +490,10 @@ class _SchedulerMixin:
             (i, s.request.request_id) for i, s in enumerate(self._slots) if s.active
         ]
         chunk = 1 if single else self._pick_chunk()
+        # Paged pool: extend every active slot's pages past its write
+        # frontier BEFORE the chunk dispatches (engine/paged.py) — a
+        # decode write must never land through a trash table entry.
+        self._prealloc_decode_pages(chunk)
         t_dispatch = time.monotonic()
         toks = self._run_decode_step(chunk=chunk)
         # The dispatch wall rides the in-flight entry so the flight
@@ -567,6 +605,11 @@ class _SchedulerMixin:
             self._drop_session(sid)
         self._release_slot_seed(slot)
         slot.clear()
+        # Paged pool: pages past the quiesce frontier (all of them for
+        # an unpinned slot) go back to the one free list; the frozen
+        # row's garbage writes land in the kept partial page or the
+        # trash page, never in a freed one.
+        self._trim_slot_pages(slot_idx, quiesce_row)
         # Quiesce the slot: decode keeps running over it (static shape), but
         # with active=False its position is frozen, so it only ever rewrites
         # one row — row 0 for unpinned slots (the next prefill's insert

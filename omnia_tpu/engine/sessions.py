@@ -175,11 +175,20 @@ class _SessionMixin:
             self.metrics["session_offloads"] += 1
             if self._flight is not None:
                 self._flight.note_offload(sess.session_id, rows)
+        # Paged pool: the slot's pages go back to the one free list the
+        # moment the rows are on host (or elided) — an offloaded session
+        # holds ZERO device pages, which is the whole sessions-per-chip
+        # win. No-op on the contiguous layout.
+        self._free_slot_pages(slot_idx)
         sess.slot = None
         self._slots[slot_idx].session_id = None
 
     def _restore_session(self, sess: _SessionKV, slot_idx: int) -> None:
         """Swap a host-paged session's KV rows back into a device slot."""
+        # Paged pool: allocate pages covering the host rows and sync the
+        # slot's table row FIRST — the restore program scatters through
+        # it. No-op on the contiguous layout.
+        self._prepare_slot_restore(slot_idx, sess.host_k)
         self._ck, self._cv = self._restore_fn(
             self._ck, self._cv, kv_device(sess.host_k), kv_device(sess.host_v),
             slot_idx,

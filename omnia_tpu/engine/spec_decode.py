@@ -151,6 +151,15 @@ class _SpecDecodeMixin:
                 wstart[i] = row
                 pos[i] = row + np.arange(k + 1)
 
+        # Paged pool: active slots' K+1-row verify windows need
+        # exclusive pages before dispatch. Idle slots' frozen-row
+        # windows write garbage only — through owned partial pages or
+        # the trash page, never a freed one — so they need none.
+        for i, s in enumerate(self._slots):
+            if s.active:
+                self._prepare_slot_write(
+                    i, s.length, min(s.length + k + 1, self.cfg.max_seq)
+                )
         t_dispatch = time.monotonic()
         self._ck, self._cv, greedy = self._verify_fn(
             self.params, self._ck, self._cv,

@@ -232,6 +232,27 @@ class EngineConfig:
     # no-op: no scale tensors exist and the compiled programs take the
     # exact pre-quant operands.
     kv_quant: Optional[str] = None
+    # Paged KV cache (engine/kv_pages.py + models/paged_kv.py): > 0
+    # replaces the slot-contiguous cache AND the dedicated prefix-pool
+    # arrays with ONE device page pool of this many fixed-size pages
+    # ([L, kv_pages, kv_page_tokens, Hkv, D]; page 0 is a reserved
+    # trash page for quiesced-slot garbage writes) served by a single
+    # free list: active slots map rows through per-slot page tables
+    # [num_slots, max_seq / kv_page_tokens], the prefix cache shares
+    # refcounted page runs copy-on-write (publish and seed become pure
+    # table rewrites — zero device copies), and session offload pages
+    # out only the rows a session actually holds. Decode gathers pages
+    # inside the Pallas kernel (ops/decode_attention.py); prefill/
+    # extend/verify and off-TPU decode take an XLA `take` fallback that
+    # is bit-identical to the contiguous layout. 0 (default) is a
+    # guarded true no-op: no pool, no tables, no allocator — the
+    # compiled programs carry the exact contiguous operands
+    # (tests/test_guards.py::test_kv_pages_zero_is_true_noop).
+    kv_pages: int = 0
+    # Tokens per KV page. Must divide max_seq; it is also the paged
+    # decode kernel's block size, so on real TPUs keep it a multiple of
+    # the sublane tile (≥ 16 recommended). Dead while kv_pages == 0.
+    kv_page_tokens: int = 64
     # Cross-SESSION shared-prefix KV pool (engine/prefix_cache.py): a
     # device-resident, radix-matched cache of refcounted prompt prefixes
     # (pack system blocks, tool schemas) so a FRESH session seed-copies
@@ -358,6 +379,28 @@ class EngineConfig:
             if n <= b:
                 return b
         return self.prefix_buckets()[-1]
+
+    def num_page_positions(self) -> int:
+        """Page-table width: table positions per slot (max_seq / page)."""
+        return self.max_seq // max(self.kv_page_tokens, 1)
+
+    def page_run_buckets(self) -> tuple[int, ...]:
+        """Page-count buckets for prefix host-tier page transfers
+        (gather/scatter a TRASH-padded fixed-length page run — the same
+        fixed-shape discipline as the restore buckets)."""
+        cap = max(-(-self.prefix_rows() // max(self.kv_page_tokens, 1)), 1)
+        out, b = [], 1
+        while b < cap:
+            out.append(b)
+            b *= 2
+        out.append(cap)
+        return tuple(out)
+
+    def page_bucket_for(self, n: int) -> int:
+        for b in self.page_run_buckets():
+            if n <= b:
+                return b
+        return self.page_run_buckets()[-1]
 
     def mixed_prefill_buckets(self) -> tuple[int, ...]:
         """Prefill-piece buckets the fused mixed prefill+decode programs

@@ -34,6 +34,7 @@ from omnia_tpu.models.kv_quant import (
     quantize_rows,
     validate_kv_quant,
 )
+from omnia_tpu.models.paged_kv import PagedKV, is_paged, write_rows
 from omnia_tpu.models.quant import qdot
 from omnia_tpu.ops.attention import gqa_attention
 from omnia_tpu.ops.moe import moe_mlp
@@ -155,6 +156,15 @@ def kv_cache_specs(kv_quant=None) -> tuple:
     return spec, spec
 
 
+def paged_kv_specs(kv_quant=None) -> tuple:
+    """(k, v) PartitionSpecs for PagedKV caches: the pool's page axis
+    shards over "dp" (the axis the slot-batch left), KV heads over
+    "tp"; the page table is tiny and replicated."""
+    kspec, vspec = kv_cache_specs(kv_quant)
+    tspec = P(None, None)
+    return PagedKV(kspec, tspec), PagedKV(vspec, tspec)
+
+
 def init_kv_cache(cfg: ModelConfig, batch: int, seq: int, dtype=jnp.bfloat16,
                   kv_quant=None):
     """Zeroed (k, v) caches: plain [L, B, S, Hkv, D] arrays, or QuantKV
@@ -204,6 +214,11 @@ def _write_kv(cache, new, start):
     def one_s(c, n, s):
         return jax.lax.dynamic_update_slice(c, n, (s, 0))
 
+    if is_paged(cache):
+        # Paged pool (EngineConfig.kv_pages): rows scatter through the
+        # page table; quantization runs through the same quantize_rows
+        # seam, so stored values are bit-identical across layouts.
+        return write_rows(cache, new, start)
     if is_quant_kv(cache):
         qn = quantize_rows(new)
         return QuantKV(
@@ -313,6 +328,26 @@ def forward(params, cfg: ModelConfig, tokens, q_positions, cache_k, cache_v, wri
     """
     x = params["embed"][tokens]  # [B,T,D]
     cos, sin = rope_cos_sin(q_positions, cfg.head_dim, cfg.rope_theta, cfg.rope_scaling)
+
+    if is_paged(cache_k):
+        # Paged caches: the pool's [L] axis scans with the layers; the
+        # page table is layer-invariant (one page holds a row for every
+        # layer), so it closes over the scan instead of riding it.
+        tk, tv = cache_k.table, cache_v.table
+
+        def pbody(carry, scanned):
+            x = carry
+            p, pk, pv = scanned
+            x, ck, cv = _layer(
+                x, p, cfg, cos, sin, q_positions,
+                PagedKV(pk, tk), PagedKV(pv, tv), write_start,
+            )
+            return x, (ck.pool, cv.pool)
+
+        x, (new_k, new_v) = jax.lax.scan(
+            pbody, x, (params["layers"], cache_k.pool, cache_v.pool)
+        )
+        return _logits(params, cfg, x), PagedKV(new_k, tk), PagedKV(new_v, tv)
 
     def body(carry, scanned):
         x = carry

@@ -25,6 +25,7 @@ import jax
 import jax.numpy as jnp
 
 from omnia_tpu.models.kv_quant import is_quant_kv
+from omnia_tpu.models.paged_kv import gather_view, is_paged
 
 _NEG_INF = -1e30
 
@@ -58,6 +59,22 @@ def _decode_path(q, k_cache, v_cache, q_positions):
     mode = _pallas_decode_mode()
     if mode not in ("1", "interpret"):
         return None
+    if is_paged(k_cache):
+        # Paged pool (EngineConfig.kv_pages): the kernel gathers K/V
+        # blocks through the scalar-prefetched page table — one block
+        # per page, the online-softmax body unchanged.
+        from omnia_tpu.ops.decode_attention import decode_gqa_attention_paged
+
+        pool_k, pool_v, table = k_cache.pool, v_cache.pool, k_cache.table
+        k_scale = v_scale = None
+        if is_quant_kv(pool_k):
+            pool_k, k_scale = pool_k.q, pool_k.s
+            pool_v, v_scale = pool_v.q, pool_v.s
+        out = decode_gqa_attention_paged(
+            q[:, 0], pool_k, pool_v, table, q_positions[:, 0],
+            k_scale=k_scale, v_scale=v_scale, interpret=mode == "interpret",
+        )
+        return out[:, None]
     S = k_cache.shape[1]
     block = min(_DECODE_BLOCK_S, S)
     if S % block != 0:
@@ -102,14 +119,26 @@ def gqa_attention(
     Returns [B, T, H, D].
     """
     B, T, H, D = q.shape
-    S = k_cache.shape[1]
-    Hkv = k_cache.shape[2]
-    G = H // Hkv
 
     if T == 1:
         fused = _decode_path(q, k_cache, v_cache, q_positions)
         if fused is not None:
             return fused
+
+    if is_paged(k_cache):
+        # XLA `take` fallback (prefill/extend/verify, and decode off
+        # TPU): materialize the per-slot view once and run the EXACT
+        # contiguous math below — same shapes, same contraction order,
+        # so paged serving is bit-identical to contiguous on this path.
+        # Rows reached through trash-page table entries are garbage, but
+        # they sit at positions past every slot's written prefix, where
+        # the causal mask already zeroes them exactly.
+        k_cache = gather_view(k_cache)
+        v_cache = gather_view(v_cache)
+
+    S = k_cache.shape[1]
+    Hkv = k_cache.shape[2]
+    G = H // Hkv
 
     qg = q.reshape(B, T, Hkv, G, D)
     # scores [B, Hkv, G, T, S]

@@ -113,6 +113,101 @@ def _decode_kernel(
         ).astype(out_ref.dtype)
 
 
+def _decode_kernel_paged(positions_ref, table_ref, *rest, block_s, scale,
+                         quantized=False):
+    """Paged edition (EngineConfig.kv_pages): identical online-softmax
+    body — the page table acts entirely through the BlockSpec index
+    maps, which resolve logical block ``s`` of slot ``b`` to pool page
+    ``table[b, s]`` before the DMA. The kernel itself never sees page
+    ids, so the math is the contiguous kernel's, block for block."""
+    del table_ref  # consumed by the index maps only
+    return _decode_kernel(
+        positions_ref, *rest, block_s=block_s, scale=scale,
+        quantized=quantized,
+    )
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def decode_gqa_attention_paged(
+    q: jnp.ndarray,          # [B, H, D] (rotary already applied)
+    pool_k: jnp.ndarray,     # [P, PAGE_S, Hkv, D] (int8 when scales given)
+    pool_v: jnp.ndarray,     # [P, PAGE_S, Hkv, D]
+    table: jnp.ndarray,      # int32 [B, NP] — per-slot page table
+    positions: jnp.ndarray,  # int32 [B] — current decode position per slot
+    k_scale: jnp.ndarray = None,  # f32 [P, PAGE_S, Hkv] (int8-KV mode)
+    v_scale: jnp.ndarray = None,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """→ [B, H, D]. Paged-attention decode: one kernel block per KV
+    page (``block_s == PAGE_S``), gathered from the pool through the
+    scalar-prefetched page table. Blocks past a slot's position re-map
+    to its last needed page (DMA dedup) and skip compute, so HBM
+    traffic stays proportional to actual context length — and free/dead
+    pages are simply never addressed (tests poison them to prove it)."""
+    B, H, D = q.shape
+    P, page_s, Hkv = pool_k.shape[0], pool_k.shape[1], pool_k.shape[2]
+    G = H // Hkv
+    num_s = table.shape[1]
+    quantized = k_scale is not None
+    positions = positions.astype(jnp.int32)
+    table = table.astype(jnp.int32)
+
+    def kv_index(b, s, pos_ref, tbl_ref):
+        # Clamp to the last needed LOGICAL block, then translate through
+        # the page table: repeated steps re-map to the same pool page,
+        # which Pallas recognizes as resident and skips the DMA.
+        return (tbl_ref[b, jnp.minimum(s, pos_ref[b] // page_s)], 0, 0)
+
+    kv_spec = pl.BlockSpec(
+        (1, page_s, Hkv, D),
+        lambda b, s, pos_ref, tbl_ref: kv_index(b, s, pos_ref, tbl_ref) + (0,),
+        memory_space=pltpu.VMEM,
+    )
+    in_specs = [
+        pl.BlockSpec(
+            (1, Hkv, G, D), lambda b, s, pos_ref, tbl_ref: (b, 0, 0, 0),
+            memory_space=pltpu.VMEM,
+        ),
+        kv_spec,
+        kv_spec,
+    ]
+    operands = [positions, table, q.reshape(B, Hkv, G, D), pool_k, pool_v]
+    if quantized:
+        scale_spec = pl.BlockSpec(
+            (1, page_s, Hkv),
+            lambda b, s, pos_ref, tbl_ref: kv_index(b, s, pos_ref, tbl_ref),
+            memory_space=pltpu.VMEM,
+        )
+        in_specs += [scale_spec, scale_spec]
+        operands += [k_scale, v_scale]
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(B, num_s),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec(
+            (1, Hkv, G, D), lambda b, s, pos_ref, tbl_ref: (b, 0, 0, 0),
+            memory_space=pltpu.VMEM,
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((Hkv, G), jnp.float32),
+            pltpu.VMEM((Hkv, G), jnp.float32),
+            pltpu.VMEM((Hkv, G, D), jnp.float32),
+        ],
+    )
+
+    out = pl.pallas_call(
+        functools.partial(
+            _decode_kernel_paged, block_s=page_s, scale=D**-0.5,
+            quantized=quantized,
+        ),
+        out_shape=jax.ShapeDtypeStruct((B, Hkv, G, D), q.dtype),
+        grid_spec=grid_spec,
+        interpret=interpret,
+    )(*operands)
+    return out.reshape(B, H, D)
+
+
 @functools.partial(jax.jit, static_argnames=("block_s", "interpret"))
 def decode_gqa_attention(
     q: jnp.ndarray,          # [B, H, D] (rotary already applied)

@@ -72,6 +72,10 @@ def build_engine(spec: ProviderSpec, *, warmup: bool = False):
             # Flight-recorder parity: mock Provider CRs can turn on the
             # same per-request latency breakdowns as tpu ones.
             flight_events=spec.options.get("flight_events", 0),
+            # Paged-KV parity: the mock mirrors the page-pool gauges
+            # against a real allocator (engine/kv_pages.py).
+            kv_pages=spec.options.get("kv_pages", 0),
+            kv_page_tokens=spec.options.get("kv_page_tokens", 64),
         )
     if spec.type == "tpu":
         from omnia_tpu.models import PRESETS, get_config
@@ -93,7 +97,12 @@ def build_engine(spec: ProviderSpec, *, warmup: bool = False):
                      # Engine flight recorder (engine/flight.py): ring
                      # capacity for step-level tracing + latency
                      # breakdowns (0 = the guarded no-op).
-                     "flight_events"}
+                     "flight_events",
+                     # Paged KV cache (engine/kv_pages.py): one page-
+                     # table device pool behind the slots, prefix
+                     # cache, and session paging (0 = the guarded
+                     # no-op contiguous layout).
+                     "kv_pages", "kv_page_tokens"}
         }
         if "prefill_buckets" in eng_kwargs:
             eng_kwargs["prefill_buckets"] = tuple(eng_kwargs["prefill_buckets"])

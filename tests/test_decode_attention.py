@@ -9,7 +9,10 @@ import numpy as np
 import pytest
 
 from omnia_tpu.ops.attention import gqa_attention
-from omnia_tpu.ops.decode_attention import decode_gqa_attention
+from omnia_tpu.ops.decode_attention import (
+    decode_gqa_attention,
+    decode_gqa_attention_paged,
+)
 
 
 def _setup(B=4, S=512, H=8, Hkv=2, D=128, seed=0, dtype=jnp.float32):
@@ -18,6 +21,24 @@ def _setup(B=4, S=512, H=8, Hkv=2, D=128, seed=0, dtype=jnp.float32):
     k = jax.random.normal(ks[1], (B, S, Hkv, D), dtype=dtype)
     v = jax.random.normal(ks[2], (B, S, Hkv, D), dtype=dtype)
     return q, k, v
+
+
+def _paginate(k, v, page_s, free_pages=3, seed=7):
+    """Scatter contiguous caches into a scrambled page pool + table
+    (the first `free_pages` pool pages stay unreferenced — 'free')."""
+    B, S, Hkv, D = k.shape
+    npg = S // page_s
+    perm = np.random.RandomState(seed).permutation(B * npg)
+    pool_k = np.zeros((B * npg + free_pages, page_s, Hkv, D), np.asarray(k).dtype)
+    pool_v = np.zeros_like(pool_k)
+    table = np.zeros((B, npg), np.int32)
+    for b in range(B):
+        for j in range(npg):
+            pid = int(perm[b * npg + j]) + free_pages
+            pool_k[pid] = np.asarray(k[b, j * page_s:(j + 1) * page_s])
+            pool_v[pid] = np.asarray(v[b, j * page_s:(j + 1) * page_s])
+            table[b, j] = pid
+    return jnp.asarray(pool_k), jnp.asarray(pool_v), jnp.asarray(table)
 
 
 class TestDecodeAttention:
@@ -126,6 +147,116 @@ class TestDecodeAttention:
             ref = attn.gqa_attention(q, qk, qv, pos[:, None])
             np.testing.assert_allclose(
                 np.asarray(out[:, 0]), np.asarray(ref[:, 0]), atol=2e-5, rtol=2e-5
+            )
+        finally:
+            attn._pallas_decode_mode.cache_clear()
+
+    @pytest.mark.parametrize(
+        "positions",
+        [
+            [0, 5, 255, 511],     # incl. single-page sequences (pos < 64)
+            [37, 499, 256, 128],  # partial last pages + exact boundaries
+            [63, 64, 127, 510],   # last row of a page / first of the next
+        ],
+    )
+    def test_paged_matches_contiguous_kernel(self, positions):
+        """Paged edition vs the contiguous kernel at the SAME block size
+        over a scrambled page pool: the table only reorders DMAs, so the
+        outputs must be bit-identical — including partial last pages and
+        single-page sequences (within-block iota masking)."""
+        q, k, v = _setup()
+        pos = jnp.asarray(positions, dtype=jnp.int32)
+        ref = decode_gqa_attention(q[:, 0], k, v, pos, block_s=64, interpret=True)
+        pool_k, pool_v, table = _paginate(k, v, page_s=64)
+        out = decode_gqa_attention_paged(
+            q[:, 0], pool_k, pool_v, table, pos, interpret=True
+        )
+        np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+
+    def test_paged_free_and_dead_pages_never_contribute(self):
+        """Poison every pool page the tables do not reference (the free
+        list) AND the referenced rows past each position — output must
+        not move: dead pages are simply never addressed, and rows past
+        the position are masked/skipped like the contiguous kernel."""
+        q, k, v = _setup(B=2, S=256, H=4, Hkv=2, D=128)
+        pos = jnp.asarray([63, 190], dtype=jnp.int32)
+        pool_k, pool_v, table = _paginate(k, v, page_s=64)
+        clean = decode_gqa_attention_paged(
+            q[:, 0], pool_k, pool_v, table, pos, interpret=True
+        )
+        kp, vp = np.asarray(pool_k).copy(), np.asarray(pool_v).copy()
+        referenced = set(np.asarray(table).ravel().tolist())
+        for pid in range(kp.shape[0]):
+            if pid not in referenced:
+                kp[pid] = 1e9
+                vp[pid] = -1e9
+        for b, p in enumerate([63, 190]):
+            for j in range(table.shape[1]):
+                pid = int(table[b, j])
+                lo = j * 64
+                if lo > p:
+                    kp[pid] = 1e9      # whole page past the position
+                    vp[pid] = -1e9
+                elif lo <= p < lo + 64:
+                    kp[pid, p - lo + 1:] = 1e9  # partial-page tail
+                    vp[pid, p - lo + 1:] = -1e9
+        poisoned = decode_gqa_attention_paged(
+            q[:, 0], jnp.asarray(kp), jnp.asarray(vp), table, pos, interpret=True
+        )
+        np.testing.assert_array_equal(np.asarray(clean), np.asarray(poisoned))
+
+    def test_paged_quantized_matches_dequantized_reference(self):
+        """int8 scale-block path: the paged kernel streaming int8 pool
+        pages + scale pages through the table must equal the XLA
+        reference over the dequantized contiguous cache."""
+        from omnia_tpu.models import kv_quant as kvq
+
+        q, k, v = _setup()
+        pos = jnp.asarray([37, 499, 256, 128], dtype=jnp.int32)
+        qk, qv = kvq.quantize_rows(k), kvq.quantize_rows(v)
+        ref = gqa_attention(
+            q, kvq.dequantize_rows(qk), kvq.dequantize_rows(qv), pos[:, None]
+        )[:, 0]
+        pool_kq, pool_vq, table = _paginate(qk.q, qv.q, page_s=64)
+        pool_ks, pool_vs, _t = _paginate(
+            qk.s[..., None], qv.s[..., None], page_s=64
+        )
+        out = decode_gqa_attention_paged(
+            q[:, 0], pool_kq, pool_vq, table, pos,
+            k_scale=pool_ks[..., 0], v_scale=pool_vs[..., 0],
+            interpret=True,
+        )
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5
+        )
+
+    def test_paged_dispatch_from_gqa_attention(self, monkeypatch):
+        """gqa_attention routes a PagedKV cache to the paged kernel when
+        Pallas is on, and to the XLA take-fallback otherwise — equal
+        numerics either way (the engine's serving routes)."""
+        import omnia_tpu.ops.attention as attn
+        from omnia_tpu.models.paged_kv import PagedKV
+
+        q, k, v = _setup(B=2, S=256, H=4, Hkv=2, D=128)
+        pos = jnp.asarray([10, 200], dtype=jnp.int32)
+        pool_k, pool_v, table = _paginate(k, v, page_s=64)
+        pk, pv = PagedKV(pool_k, table), PagedKV(pool_v, table)
+        monkeypatch.setenv("OMNIA_PALLAS_DECODE", "interpret")
+        attn._pallas_decode_mode.cache_clear()
+        try:
+            out = attn.gqa_attention(q, pk, pv, pos[:, None])
+            monkeypatch.setenv("OMNIA_PALLAS_DECODE", "0")
+            attn._pallas_decode_mode.cache_clear()
+            fallback = attn.gqa_attention(q, pk, pv, pos[:, None])
+            ref = attn.gqa_attention(q, k, v, pos[:, None])
+            # The take-fallback materializes the same values the
+            # contiguous cache holds — bit-identical.
+            np.testing.assert_array_equal(
+                np.asarray(fallback), np.asarray(ref)
+            )
+            np.testing.assert_allclose(
+                np.asarray(out[:, 0]), np.asarray(ref[:, 0]),
+                atol=2e-5, rtol=2e-5,
             )
         finally:
             attn._pallas_decode_mode.cache_clear()

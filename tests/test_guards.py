@@ -46,6 +46,10 @@ KNOB_GUARDS = {
     "EngineConfig.quant":
         "test_guards.py::test_default_knobs_off_are_true_noop",
     "EngineConfig.kv_quant": "test_guards.py::test_kv_quant_none_is_true_noop",
+    "EngineConfig.kv_pages":
+        "test_guards.py::test_kv_pages_zero_is_true_noop",
+    "EngineConfig.kv_page_tokens":
+        "structural: page size / paged-kernel block; dead while kv_pages=0",
     "EngineConfig.prefix_cache_slots":
         "test_prefix_cache.py::test_disabled_pool_is_true_noop",
     "EngineConfig.prefix_cache_rows":
@@ -80,6 +84,10 @@ KNOB_GUARDS = {
         "test_guards.py::test_mock_knobs_off_are_true_noop",
     "MockEngine.flight_events":
         "test_guards.py::test_mock_knobs_off_are_true_noop",
+    "MockEngine.kv_pages":
+        "test_guards.py::test_mock_knobs_off_are_true_noop",
+    "MockEngine.kv_page_tokens":
+        "structural: mirror page size; dead while kv_pages=0",
 }
 
 
@@ -292,6 +300,59 @@ def test_kv_quant_none_is_true_noop():
     assert isinstance(q8._ck, QuantKV) and q8._ck.q.dtype == jnp.int8
 
 
+def test_kv_pages_zero_is_true_noop():
+    """ISSUE 11 guard: kv_pages=0 must allocate ZERO page state — plain
+    [L, B, S, H, D] caches (no PagedKV wrapper, no page table, no
+    allocator, no paged programs), zero-valued pool gauges — and the
+    compiled decode program must be byte-identical regardless of the
+    (dead) kv_page_tokens knob. The paged engine, by contrast, carries
+    the PagedKV operands and a live allocator."""
+    from omnia_tpu.engine import EngineConfig, InferenceEngine, SamplingParams
+    from omnia_tpu.models import get_config
+    from omnia_tpu.models.paged_kv import PagedKV
+
+    base = dict(num_slots=2, max_seq=64, prefill_buckets=(16,),
+                dtype="float32", max_sessions=0)
+    off = InferenceEngine(get_config("test-tiny"), EngineConfig(**base), seed=3)
+    # kv_page_tokens is dead while kv_pages=0: ANY value (even one that
+    # does not divide max_seq) must change nothing.
+    off2 = InferenceEngine(
+        get_config("test-tiny"), EngineConfig(**base, kv_page_tokens=7), seed=3
+    )
+    for eng in (off, off2):
+        assert not isinstance(eng._ck, PagedKV)
+        assert not isinstance(eng._cv, PagedKV)
+        assert eng._pages is None and not eng._paged_on()
+        assert eng._page_copy_fn is None
+        assert eng._gather_pages_fn is None and eng._scatter_pages_fn is None
+        for key in ("kv_pages_total", "kv_pages_free", "kv_page_cow_copies"):
+            assert eng.metrics[key] == 0, (key, eng.metrics[key])
+        assert eng.metrics["kv_page_fragmentation"] == 0.0
+
+    def lowered(eng):
+        return eng._decode_fn_single.lower(
+            eng.params, eng._ck, eng._cv, eng._tokens, eng._positions,
+            eng._active, eng._budget, eng._stop_ids, eng._key_data,
+            eng._temp, eng._top_p, eng._top_k,
+        ).as_text()
+
+    assert lowered(off) == lowered(off2)
+
+    # Identical greedy tokens off-vs-on (the equivalence battery in
+    # tests/test_kv_pages.py covers the full matrix; this is the guard's
+    # smoke half) and the paged engine's state is really paged.
+    on = InferenceEngine(
+        get_config("test-tiny"),
+        EngineConfig(**base, kv_pages=10, kv_page_tokens=16), seed=3,
+    )
+    assert isinstance(on._ck, PagedKV) and on._pages is not None
+    assert on.metrics["kv_pages_total"] == 9  # page 0 reserved for trash
+    sp = SamplingParams(temperature=0.0, max_tokens=8)
+    t_off, _ = off.generate([4, 5, 6], sp)
+    t_on, _ = on.generate([4, 5, 6], sp)
+    assert t_off == t_on
+
+
 def test_lifecycle_knobs_off_are_true_noop():
     """ISSUE 7 guard: deadline_s=None / max_queue=0 / watchdog_s=None
     must trace ZERO new operands and change ZERO behavior. The whole
@@ -461,9 +522,13 @@ def test_mock_knobs_off_are_true_noop():
     for key in ("requests_shed", "deadline_exceeded", "watchdog_trips",
                 "mixed_steps", "interleaved_prefill_tokens",
                 "kv_quant_enabled", "kv_quant_rows_written",
-                "flight_enabled"):
+                "flight_enabled", "kv_pages_total", "kv_pages_free",
+                "kv_page_cow_copies"):
         assert m.metrics[key] == 0, (key, m.metrics[key])
     assert m.metrics["kv_quant_roundtrip_rel_err"] == 0.0
+    assert m.metrics["kv_page_fragmentation"] == 0.0
+    # kv_pages=0: no mirror allocator exists at all.
+    assert m._page_alloc is None and m._page_slots == []
 
 
 def test_knob_guard_registry_is_conformant():

@@ -1,0 +1,456 @@
+"""Paged KV cache suite (EngineConfig.kv_pages).
+
+Two halves, one marker (``paged``, tier-1):
+
+- **Bookkeeping** (jax-free): the ``PageAllocator`` free list, refcount
+  and copy-on-write decisions, and the mock-engine mirror — this subset
+  runs in the CI analysis job with no jax installed (module-level
+  imports stay jax-free; engine-backed cases importorskip jax).
+- **Equivalence battery**: paged greedy output must be BIT-IDENTICAL to
+  the contiguous layout across prefill, chunked extend, session
+  offload/restore, prefix-seeded placement, mixed interleave, int8 KV,
+  and spec-decode — the acceptance contract of the one-pool design (the
+  XLA take-fallback materializes the exact rows the contiguous cache
+  holds, so the math is the same floats in the same order).
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from omnia_tpu.engine.kv_pages import TRASH, PageAllocator, PoolExhausted
+
+pytestmark = pytest.mark.paged
+
+
+# ---------------------------------------------------------------------------
+# PageAllocator bookkeeping (jax-free)
+# ---------------------------------------------------------------------------
+
+
+class TestPageAllocator:
+    def test_trash_page_reserved_and_deterministic_alloc(self):
+        a = PageAllocator(6, 16, 2)
+        assert a.total == 5 and a.free_count == 5
+        got = a.alloc_pages(3)
+        assert got == [1, 2, 3]          # page 0 (TRASH) never hands out
+        assert TRASH not in got and a.free_count == 2
+        a.release_pages(got)
+        assert a.free_count == 5
+
+    def test_prepare_write_allocates_and_covers(self):
+        a = PageAllocator(8, 16, 2)
+        acts = a.prepare_write(0, 0, 40)  # 3 pages: rows [0, 40)
+        assert [pos for pos, _p, _c in acts] == [0, 1, 2]
+        assert all(c is None for _pos, _p, c in acts)  # fresh, no copies
+        assert a.covered[0] == 40
+        # Extending within owned pages allocates nothing new.
+        assert a.prepare_write(0, 40, 48) == []
+        # Crossing into a new page allocates exactly it.
+        acts = a.prepare_write(0, 48, 49)
+        assert len(acts) == 1 and acts[0][0] == 3
+
+    def test_release_from_keeps_boundary_page(self):
+        a = PageAllocator(8, 16, 2)
+        a.prepare_write(0, 0, 64)        # 4 pages
+        freed = a.release_from(0, 20)    # keep rows [0, 20) → 2 pages
+        assert freed == [2, 3] and len(a.slot_pages[0]) == 2
+        assert a.covered[0] == 20 and a.free_count == 5
+        # Full release returns everything and trashes the row.
+        a.release_from(0, 0)
+        assert a.slot_pages[0] == [] and a.free_count == 7
+        assert a.table_row(0, 4) == [TRASH] * 4
+
+    def test_share_adopt_and_cow(self):
+        a = PageAllocator(10, 16, 2)
+        a.prepare_write(0, 0, 40)            # slot 0: pages for rows [0,40)
+        shared = a.share(0, 3)               # a prefix entry over 40 rows
+        assert all(a.refs[p] == 2 for p in shared)
+        # Seed slot 1 from the run (rows [0, 36) matched — partial page).
+        a.adopt(1, shared[:3], 36)
+        assert all(a.refs[p] == 3 for p in shared)
+        # Slot 1 writes its suffix from row 36 → boundary page (pos 2,
+        # rows 32..47) is shared AND holds surviving rows → CoW copy;
+        # later pages are fresh, no copy.
+        acts = a.prepare_write(1, 36, 70)
+        by_pos = {pos: (new, copy) for pos, new, copy in acts}
+        assert by_pos[2][1] == shared[2]     # copy-on-write of the boundary
+        assert by_pos[3][1] is None and by_pos[4][1] is None
+        assert a.cow_copies == 1
+        assert a.refs[shared[2]] == 2        # entry + slot 0 keep the original
+        # Slot 0 itself diverging at row 10 swaps ALL shared pages; only
+        # the boundary (holding rows < 10) copies.
+        acts = a.prepare_write(0, 10, 40)
+        copies = [c for _pos, _new, c in acts if c is not None]
+        assert copies == [shared[0]] and a.cow_copies == 2
+
+    def test_writes_needed_matches_prepare(self):
+        a = PageAllocator(8, 16, 2)
+        assert a.writes_needed(0, 0, 40) == 3
+        a.prepare_write(0, 0, 40)
+        assert a.writes_needed(0, 0, 40) == 0
+        a.incref_pages([a.slot_pages[0][1]])  # share page 1
+        assert a.writes_needed(0, 16, 40) == 1  # the shared one
+
+    def test_exhaustion_raises(self):
+        a = PageAllocator(3, 16, 1)  # 2 usable pages
+        a.prepare_write(0, 0, 32)
+        with pytest.raises(PoolExhausted):
+            a.prepare_write(0, 32, 64)
+
+    def test_fragmentation_gauge(self):
+        a = PageAllocator(8, 16, 2)
+        assert a.fragmentation() == 0.0
+        a.prepare_write(0, 0, 8)     # 1 page, 8/16 rows used
+        assert a.fragmentation() == 0.5
+        a.prepare_write(1, 0, 16)    # full page joins
+        assert a.fragmentation() == 0.25
+        a.release_from(0, 0)
+        assert a.fragmentation() == 0.0
+
+
+class TestMockMirror:
+    def test_mock_pages_mirror_live_playbacks(self):
+        from omnia_tpu.engine.mock import MockEngine, Scenario
+        from omnia_tpu.engine.types import SamplingParams
+
+        m = MockEngine(
+            [Scenario("hi", "hello-world", delay_per_token_s=0.01)],
+            kv_pages=8, kv_page_tokens=4,
+        )
+        assert m.metrics["kv_pages_total"] == 7
+        assert m.metrics["kv_pages_free"] == 7
+        h = m.submit(m.tokenizer.encode("hi"), SamplingParams(max_tokens=32))
+        import time
+
+        deadline = time.monotonic() + 5
+        while m.metrics["kv_pages_free"] == 7 and time.monotonic() < deadline:
+            time.sleep(0.002)
+        assert m.metrics["kv_pages_free"] < 7  # the playback holds pages
+        h.collect_tokens(timeout=10)
+        deadline = time.monotonic() + 5
+        while m.metrics["kv_pages_free"] != 7 and time.monotonic() < deadline:
+            time.sleep(0.002)
+        assert m.metrics["kv_pages_free"] == 7  # released at finish
+
+
+# ---------------------------------------------------------------------------
+# Engine equivalence battery (needs jax; skips in the CI analysis job)
+# ---------------------------------------------------------------------------
+
+
+BASE = dict(num_slots=2, max_seq=64, prefill_buckets=(8, 16, 32),
+            dtype="float32", max_sessions=6)
+
+
+def _engines(seed=3, pages=20, page_tokens=16, **kw):
+    pytest.importorskip("jax")
+    from omnia_tpu.engine import EngineConfig, InferenceEngine
+    from omnia_tpu.models import get_config
+
+    cfg = dict(BASE, **kw)
+    cont = InferenceEngine(get_config("test-tiny"), EngineConfig(**cfg), seed=seed)
+    paged = InferenceEngine(
+        get_config("test-tiny"),
+        EngineConfig(**cfg, kv_pages=pages, kv_page_tokens=page_tokens),
+        seed=seed,
+    )
+    return cont, paged
+
+
+def _turn(eng, prompt, sid=None, max_tokens=6):
+    from omnia_tpu.engine import SamplingParams
+
+    h = eng.submit(
+        prompt, SamplingParams(temperature=0.0, max_tokens=max_tokens),
+        session_id=sid,
+    )
+    while eng.step():
+        pass
+    return h.collect_tokens(timeout=60)
+
+
+SYS = list(range(40, 60))  # 20-token shared prefix (crosses a 16-row page)
+
+
+class TestPagedEquivalence:
+    def test_prefill_and_chunked_extend_bit_identical(self):
+        cont, paged = _engines()
+        for prompt in ([1, 2, 3], list(range(10, 30)), list(range(1, 45))):
+            tc, fc = _turn(cont, prompt, max_tokens=10)
+            tp, fp = _turn(paged, prompt, max_tokens=10)
+            assert tc == tp and fc.finish_reason == fp.finish_reason
+
+    def test_batched_decode_bit_identical(self):
+        cont, paged = _engines()
+        from omnia_tpu.engine import SamplingParams
+
+        sp = SamplingParams(temperature=0.0, max_tokens=12)
+        outs = {}
+        for tag, eng in (("c", cont), ("p", paged)):
+            h1 = eng.submit([1, 2, 3], sp)
+            h2 = eng.submit([9, 8, 7, 6], sp)
+            while eng.step():
+                pass
+            outs[tag] = (
+                h1.collect_tokens(timeout=60)[0],
+                h2.collect_tokens(timeout=60)[0],
+            )
+        assert outs["c"] == outs["p"]
+
+    def test_session_offload_restore_bit_identical(self):
+        cont, paged = _engines()
+        hist = {}
+        for tag, eng in (("c", cont), ("p", paged)):
+            for s in range(4):  # 4 sessions over 2 slots → offloads
+                hist[(tag, s)] = _turn(eng, [s + 1, s + 2, s + 3], sid=f"s{s}")[0]
+            for s in range(4):  # second turns → restores
+                hist[(tag, s, 2)] = _turn(
+                    eng, [s + 1, s + 2, s + 3] + hist[(tag, s)] + [7],
+                    sid=f"s{s}",
+                )[0]
+        for s in range(4):
+            assert hist[("c", s)] == hist[("p", s)]
+            assert hist[("c", s, 2)] == hist[("p", s, 2)]
+        assert paged.metrics["session_offloads"] > 0
+        assert paged.metrics["session_restores"] > 0
+        assert (
+            cont.metrics["session_offloads"] == paged.metrics["session_offloads"]
+        )
+
+    def test_prefix_seeded_placement_bit_identical_and_zero_copy(self):
+        cont, paged = _engines(prefix_cache_slots=2)
+        for eng in (cont, paged):
+            eng.register_prefix(SYS)
+        for i in (1, 2, 3):
+            tc, _ = _turn(cont, SYS + [i])
+            tp, _ = _turn(paged, SYS + [i])
+            assert tc == tp
+        assert paged.metrics["prefix_cache_insertions"] >= 1
+        assert paged.metrics["prefix_cache_hit_tokens"] > 0
+        # Page-granular sharing: the entry holds a run in the ONE pool
+        # (no dedicated _pk/_pv arrays), and seeded sessions diverging
+        # into the partial boundary page copy-on-wrote it.
+        assert paged._pk is None and paged._pv is None
+        [entry] = [
+            e for e in paged._prefix_pool.entries() if e.pages is not None
+        ]
+        assert len(entry.pages) == 2  # 20 tokens over 16-row pages
+        assert paged.metrics["kv_page_cow_copies"] > 0
+
+    def test_mixed_interleave_bit_identical(self):
+        cont, paged = _engines(prefill_chunk_tokens=8)
+        from omnia_tpu.engine import SamplingParams
+
+        outs = {}
+        for tag, eng in (("c", cont), ("p", paged)):
+            h1 = eng.submit(
+                [1, 2, 3], SamplingParams(temperature=0.0, max_tokens=20)
+            )
+            eng.step(); eng.step()
+            h2 = eng.submit(  # long prompt arrives while decode is live
+                list(range(70, 90)),
+                SamplingParams(temperature=0.0, max_tokens=6),
+            )
+            while eng.step():
+                pass
+            outs[tag] = (
+                h1.collect_tokens(timeout=60)[0],
+                h2.collect_tokens(timeout=60)[0],
+            )
+        assert outs["c"] == outs["p"]
+        assert paged.metrics["mixed_steps"] > 0
+
+    def test_int8_kv_bit_identical(self):
+        cont, paged = _engines(kv_quant="int8")
+        tc, _ = _turn(cont, [9, 8, 7, 6, 5], max_tokens=10)
+        tp, _ = _turn(paged, [9, 8, 7, 6, 5], max_tokens=10)
+        assert tc == tp
+        from omnia_tpu.models.kv_quant import QuantKV
+
+        assert isinstance(paged._ck.pool, QuantKV)
+
+    def test_spec_decode_bit_identical(self):
+        cont, paged = _engines(spec_decode=3)
+        tc, _ = _turn(cont, [3, 1, 4, 1, 5, 9, 2, 6], max_tokens=12)
+        tp, _ = _turn(paged, [3, 1, 4, 1, 5, 9, 2, 6], max_tokens=12)
+        assert tc == tp
+        assert paged.metrics["spec_steps"] > 0
+
+
+class TestPagedPoolBehavior:
+    def test_finished_slots_release_pages(self):
+        _, paged = _engines()
+        total = paged.metrics["kv_pages_total"]
+        _turn(paged, [1, 2, 3])  # sessionless: everything frees at finish
+        assert paged.metrics["kv_pages_free"] == total
+
+    def test_offloaded_sessions_hold_zero_pages(self):
+        _, paged = _engines()
+        for s in range(4):
+            _turn(paged, [s + 1, s + 2, s + 3], sid=f"s{s}")
+        # 2 resident idle sessions hold pages; 2 offloaded hold none.
+        resident = sum(
+            len(paged._pages.slot_pages[i]) for i in range(BASE["num_slots"])
+        )
+        used = paged.metrics["kv_pages_total"] - paged.metrics["kv_pages_free"]
+        assert used == resident > 0
+
+    def test_pool_pressure_reclaims_idle_sessions(self):
+        _, paged = _engines(pages=6)  # 5 usable pages, 16 tokens each
+        for s in range(3):
+            _turn(paged, [s + 1, s + 2, s + 3], sid=f"t{s}")
+        assert paged.metrics["session_offloads"] > 0  # reclaim kicked in
+        assert paged.metrics["kv_pages_free"] >= 0
+
+    def test_hard_exhaustion_fails_placement_not_engine(self):
+        pytest.importorskip("jax")
+        from omnia_tpu.engine import EngineConfig, InferenceEngine
+        from omnia_tpu.engine.types import FinishReason
+        from omnia_tpu.models import get_config
+
+        from omnia_tpu.engine import SamplingParams
+        from omnia_tpu.engine.kv_pages import PoolExhausted
+
+        # 1 usable page of 16 rows; a 24-token prompt (two 16-bucket
+        # extend pieces) cannot ever fit, a short one can.
+        eng = InferenceEngine(
+            get_config("test-tiny"),
+            EngineConfig(num_slots=2, max_seq=64, prefill_buckets=(16,),
+                         dtype="float32", max_sessions=0,
+                         kv_pages=2, kv_page_tokens=16),
+            seed=3,
+        )
+        h = eng.submit(
+            list(range(1, 25)), SamplingParams(temperature=0.0, max_tokens=4)
+        )
+        # Drive the step loop the way lifecycle._loop does: the raise
+        # reaches recovery, never a silent wedge — and the handle got
+        # its ERROR terminal from the placement-failure surface first.
+        with pytest.raises(PoolExhausted, match="exhausted"):
+            while eng.step():
+                pass
+        _toks, fin = h.collect_tokens(timeout=10)
+        assert fin.finish_reason == FinishReason.ERROR
+        eng._recover("kv page pool exhausted")  # what _loop would do
+        # The recovered engine still serves a fitting request.
+        toks, fin = eng.generate(
+            [1, 2], SamplingParams(temperature=0.0, max_tokens=2)
+        )
+        assert fin.finish_reason is not None and toks
+
+    def test_decode_exhaustion_degrades_one_stream_not_the_batch(self):
+        """Oversubscribed pool + concurrent decodes outgrowing it: the
+        starved slot finishes early with LENGTH, the other stream keeps
+        decoding to completion, nothing ERRORs, and the engine stays
+        healthy (the review-found fail-all path is gone)."""
+        pytest.importorskip("jax")
+        from omnia_tpu.engine import EngineConfig, InferenceEngine, SamplingParams
+        from omnia_tpu.engine.types import FinishReason
+        from omnia_tpu.models import get_config
+
+        # 7 usable pages × 16 rows = 112 rows vs 2 slots × 96 max_seq.
+        eng = InferenceEngine(
+            get_config("test-tiny"),
+            EngineConfig(num_slots=2, max_seq=96, prefill_buckets=(16, 32),
+                         dtype="float32", max_sessions=0,
+                         kv_pages=8, kv_page_tokens=16),
+            seed=3,
+        )
+        sp = SamplingParams(temperature=0.0, max_tokens=80)
+        h1 = eng.submit(list(range(1, 30)), sp)
+        h2 = eng.submit(list(range(31, 60)), sp)
+        while eng.step():
+            pass
+        fins = [h.collect_tokens(timeout=120)[1] for h in (h1, h2)]
+        reasons = {f.finish_reason for f in fins}
+        assert FinishReason.ERROR not in reasons, reasons
+        assert FinishReason.LENGTH in reasons
+        assert eng.healthy()
+        # Both streams emitted real tokens before any early finish.
+        assert all(f.num_generated_tokens > 0 for f in fins)
+
+    def test_reclaim_falls_through_shared_entry_to_idle_session(self):
+        """A demotable prefix entry whose pages are ALL still shared
+        with a live slot frees nothing — reclaim must fall through to
+        offloading an idle session instead of giving up (review
+        finding: the old no-progress check returned False early)."""
+        pytest.importorskip("jax")
+        from omnia_tpu.engine import EngineConfig, InferenceEngine
+        from omnia_tpu.models import get_config
+
+        eng = InferenceEngine(
+            get_config("test-tiny"),
+            EngineConfig(num_slots=2, max_seq=64, prefill_buckets=(16, 32),
+                         dtype="float32", max_sessions=4,
+                         prefix_cache_slots=2, kv_pages=6, kv_page_tokens=16),
+            seed=3,
+        )
+        # Pinned session publishes a page-aligned prefix: the entry's
+        # pages stay shared with the idle resident slot (refs 2 each).
+        eng.register_prefix(list(range(100, 132)))  # 32 tokens, 2 pages
+        _turn(eng, list(range(100, 132)) + [1], sid="pinned", max_tokens=4)
+        [entry] = [
+            e for e in eng._prefix_pool.entries() if e.pages is not None
+        ]
+        assert all(eng._pages.refs[p] == 2 for p in entry.pages)
+        # A cold placement needing more pages than are free (48 tokens
+        # = 3 pages vs 2 free): demoting the entry frees nothing NOW,
+        # so reclaim must offload the idle pinned session — and the
+        # request must succeed.
+        toks, fin = _turn(eng, list(range(200, 248)), max_tokens=4)
+        assert fin.finish_reason is not None and toks
+        assert eng.metrics["session_offloads"] >= 1
+
+    def test_warmup_then_serve_no_compiles(self):
+        pytest.importorskip("jax")
+        import io
+        import logging as _logging
+
+        import jax as _jax
+
+        from omnia_tpu.engine import EngineConfig, InferenceEngine
+        from omnia_tpu.models import get_config
+
+        eng = InferenceEngine(
+            get_config("test-tiny"),
+            EngineConfig(**BASE, prefix_cache_slots=2,
+                         kv_pages=20, kv_page_tokens=16),
+            seed=3,
+        )
+        eng.register_prefix(SYS)
+        eng.warmup()
+        # Pre-drive one non-slot-0 placement: per-slot table-row sync
+        # and scatter programs key on the concrete slot index (the
+        # pre-existing at[slot].set discipline — warmup touches slot 0).
+        _turn(eng, [7, 7, 7], sid="w0")
+        _turn(eng, [8, 8, 8], sid="w1")
+        with _jax.log_compiles():
+            stream = io.StringIO()
+            handler = _logging.StreamHandler(stream)
+            logger = _logging.getLogger("jax._src.dispatch")
+            logger.addHandler(handler)
+            try:
+                _turn(eng, SYS + [1, 2])   # publish (share, no program)
+                _turn(eng, SYS + [3, 4])   # paged seed + extend
+            finally:
+                logger.removeHandler(handler)
+            logged = stream.getvalue()
+        assert "Compiling" not in logged, logged
+
+    def test_validation_messages_are_actionable(self):
+        pytest.importorskip("jax")
+        from omnia_tpu.engine import EngineConfig, InferenceEngine
+        from omnia_tpu.models import get_config
+
+        with pytest.raises(ValueError, match="must divide max_seq"):
+            InferenceEngine(
+                get_config("test-tiny"),
+                EngineConfig(num_slots=2, max_seq=64, prefill_buckets=(16,),
+                             dtype="float32", kv_pages=8, kv_page_tokens=48),
+            )
+        from omnia_tpu.engine.paged import dp_divisibility_error
+
+        msg = dp_divisibility_error("prefix_cache_slots", 7, 4)
+        assert "prefix_cache_slots=7" in msg and "dp=4" in msg
+        assert "4 or 8" in msg  # nearest valid sizes named

@@ -473,6 +473,8 @@ class TestMetricsKeyStability:
         "masked_logit_fraction", "grammar_rejections_avoided",
         "kv_quant_enabled", "kv_quant_bytes_per_token",
         "kv_quant_device_bytes",
+        "kv_pages_total", "kv_pages_free", "kv_page_fragmentation",
+        "kv_page_cow_copies",
         "requests_shed", "deadline_exceeded", "watchdog_trips",
         "recoveries",
         "mixed_steps", "interleaved_prefill_tokens", "decode_stall_steps",
