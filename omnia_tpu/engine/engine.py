@@ -62,7 +62,7 @@ from omnia_tpu.engine.prefix_cache import PrefixPool, _PrefixCacheMixin
 from omnia_tpu.engine.programs import build_programs
 from omnia_tpu.engine.scheduler import _SchedulerMixin
 from omnia_tpu.engine.sessions import _SessionKV, _SessionMixin, _Slot
-from omnia_tpu.engine.spec_decode import _SpecDecodeMixin
+from omnia_tpu.engine.spec_decode import _SpecDecodeMixin, validate_spec_config
 from omnia_tpu.engine.types import (
     MAX_DEVICE_STOP_IDS,
     EngineConfig,
@@ -114,15 +114,7 @@ class InferenceEngine(
             raise ValueError("engine max_seq exceeds model max_seq_len")
         if engine_cfg.num_slots % max(engine_cfg.dp, 1) != 0:
             raise ValueError("num_slots must be divisible by dp")
-        if engine_cfg.spec_decode:
-            usable = engine_cfg.usable_buckets()
-            if not usable or engine_cfg.spec_decode + 1 > min(usable):
-                # Rejected-proposal rows at an unpinned idle slot must be
-                # covered by the next occupant's smallest prefill write.
-                raise ValueError(
-                    f"spec_decode={engine_cfg.spec_decode} needs "
-                    f"spec_decode + 1 <= min(prefill_buckets)"
-                )
+        validate_spec_config(engine_cfg)
 
         # Grammar-constrained decoding (engine/grammar/): gated ONCE here;
         # every grammar code path below checks this flag, so grammar=False
@@ -289,10 +281,17 @@ class InferenceEngine(
             "prefill_dispatch_s": 0.0,
             # Speculative decoding (spec_decode.py): acceptance rate =
             # spec_accepted / spec_proposed; tokens-per-weight-stream =
-            # (tokens_generated during spec) / spec_steps.
+            # (tokens_generated during spec) / spec_steps. gate_state is
+            # the self-gate's decision (0 probing / 1 on / 2 off),
+            # accept_ema the engine-wide accept-rate EMA driving the
+            # per-slot depths, index_bytes the bounded n-gram index's
+            # estimated host footprint.
             "spec_steps": 0,
             "spec_proposed": 0,
             "spec_accepted": 0,
+            "spec_gate_state": 0,
+            "spec_accept_ema": 0.0,
+            "spec_index_bytes": 0,
             # Request-lifecycle robustness (always present, zero until a
             # knob/fault engages): shed = OVERLOADED fast-fails at
             # submit (full queue or draining; NOT counted as submitted),
@@ -375,6 +374,9 @@ class InferenceEngine(
         self._offload_fn = progs.offload
         self._restore_fn = progs.restore
         self._verify_fn = progs.verify
+        self._verify_decode_fn = progs.verify_decode
+        self._mixed_spec_fns = progs.mixed_spec
+        self._mixed_spec_sample_fns = progs.mixed_spec_sample
         self._prefix_store_fn = progs.prefix_store
         self._prefix_seed_fn = progs.prefix_seed
         self._prefix_offload_fn = progs.prefix_offload
@@ -610,13 +612,10 @@ class InferenceEngine(
                     kv_device(kv_host(k)), kv_device(kv_host(v)), 0,
                 )
         if self._verify_fn is not None:
-            B, K1 = self.cfg.num_slots, self.cfg.spec_decode + 1
-            self._ck, self._cv, _ = self._verify_fn(
-                self.params, self._ck, self._cv,
-                jnp.zeros((B, K1), jnp.int32),
-                jnp.broadcast_to(jnp.arange(K1, dtype=jnp.int32)[None], (B, K1)),
-                jnp.zeros((B,), jnp.int32),
-            )
+            # Speculative family (spec_decode.py owns the operand set):
+            # pure verify, verify+decode fusion, and the mixed-spec
+            # twins under token-budget interleaving.
+            self._warmup_spec(gargs, sargs, zero)
         # Placement bookkeeping runs a handful of tiny scatter programs
         # (at[slot].set on tokens/positions/active/budget/stop_ids/keys);
         # un-warmed, each costs a first-request compile round trip —

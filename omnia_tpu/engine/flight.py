@@ -5,7 +5,8 @@ The serving engine's black-box answer to "where did this request's
 ring buffer of structured :class:`FlightEvent` rows recorded at every
 request-lifecycle seam — submit, claim, placement (incl. prefix-pool
 seeding), each prefill piece / mixed step / decode chunk with the
-host-side dispatch-vs-sync wall split, grammar attach, session
+host-side dispatch-vs-sync wall split, each speculative verify step
+with its proposed/accepted counts, grammar attach, session
 offload/restore, coordinator failover/resubmit/shed, terminal — plus a
 per-request :class:`LatencyBreakdown` (queue_s, placement_s, prefill_s,
 ttft_s, per-token decode_s, stall_steps) attached to terminal events.
@@ -61,6 +62,7 @@ EVENTS = frozenset({
     "prefill_piece",   # one monolithic prefill/extend piece dispatched
     "mixed_step",      # fused prefill+decode dispatch (interleaving)
     "decode_chunk",    # one decode chunk: dispatch_s + sync_s wall split
+    "spec_verify",     # one speculative verify dispatch: proposed/accepted
     "grammar_attach",  # grammar table attached to a slot
     "offload",         # session KV rows paged device→host
     "restore",         # session KV rows paged host→device
@@ -296,6 +298,21 @@ class FlightRecorder:
         self.hist["dispatch_us"].observe(dispatch_s * 1e6)
         self.hist["sync_us"].observe(sync_s * 1e6)
 
+    def note_spec_verify(self, proposed: int, accepted: int,
+                         dispatch_s: float, sync_s: float,
+                         slots: int) -> None:
+        """One speculative verify dispatch fully processed (standalone,
+        decode-fused, or riding a mixed step): per-step proposal and
+        acceptance counts plus the dispatch-vs-sync wall split — verify
+        steps are synchronous, so their sync share is the latency-triage
+        signal for whether speculation is paying on this link."""
+        self._record("spec_verify", "", {
+            "proposed": proposed, "accepted": accepted,
+            "dispatch_s": dispatch_s, "sync_s": sync_s, "slots": slots,
+        })
+        self.hist["dispatch_us"].observe(dispatch_s * 1e6)
+        self.hist["sync_us"].observe(sync_s * 1e6)
+
     def note_grammar_attach(self, request_id: str, num_states: int) -> None:
         self._record("grammar_attach", request_id, {"num_states": num_states})
 
@@ -458,7 +475,8 @@ def to_chrome_trace(events: list) -> dict:
 
     for e in evs:
         kind, rid, attrs = e["kind"], e["request_id"], e.get("attrs", {})
-        if kind in ("decode_chunk", "mixed_step", "prefill_piece"):
+        if kind in ("decode_chunk", "mixed_step", "prefill_piece",
+                    "spec_verify"):
             dur = attrs.get("dispatch_s", 0.0) + attrs.get("sync_s", 0.0)
             out.append({
                 "ph": "X", "pid": 1, "tid": 0, "name": kind,

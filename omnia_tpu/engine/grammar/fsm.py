@@ -16,8 +16,12 @@ dense ``[states, vocab]`` int32 table per grammar where entry ``(s, t)``
 is the successor state after emitting token ``t`` from state ``s``, or
 ``-1`` when ``t`` is disallowed. The decode step gathers row ``s`` and
 adds ``-inf`` where the row is negative — validity becomes a property of
-the sampler, and the same table drives the mock engine's host-side
-playback so hermetic tests exercise identical masks.
+the sampler. The SAME device-resident rows serve the speculative-decode
+acceptance oracle (programs.py ``_verify_window``: masked argmax per
+window position, FSM state advanced along the proposed stream), so
+constrained slots speculate without any extra table state; and the same
+table drives the mock engine's host-side playback so hermetic tests
+exercise identical masks.
 """
 
 from __future__ import annotations

@@ -125,8 +125,7 @@ class _InterleaveMixin:
                 self._process_oldest_chunk()
             return True
         if any(s.active for s in self._slots):
-            if self._spec_applicable():
-                self._spec_verify_step()
+            if self._spec_step():
                 return True
             with self._lock:
                 queued = bool(self._waiting)
@@ -217,12 +216,32 @@ class _InterleaveMixin:
     def _dispatch_mixed(self, pf: _InflightPrefill) -> None:
         """One fused dispatch: the next prompt piece + one decode step
         for every active slot. The decode token read is deferred to
-        ``_process_oldest_chunk`` like any decode chunk."""
+        ``_process_oldest_chunk`` like any decode chunk.
+
+        With speculation engaged (spec_decode.py), a verify window
+        rides the SAME dispatch via the ``mixed_spec`` program family:
+        greedy slots verify their proposals while sampled slots take
+        the exact decode step and the prefill piece streams — per-slot
+        lanes in one program. Acceptance needs the window's greedy
+        tokens on host immediately, so spec-fused mixed steps are
+        synchronous (the in-flight pipeline is flushed first); the
+        self-gate prices that in."""
         off, take, bucket = pf.pieces[pf.next_piece]
         final = pf.next_piece == len(pf.pieces) - 1
+        plan = None
+        if self._spec_engaged():
+            park = {pf.slot_idx: off + take}
+            depths: dict = {}  # one cooldown advance per step (memoized)
+            if self._spec_plan(park=park, depths=depths) is not None:
+                if self._inflight:
+                    # Settled host books before proposing (the same
+                    # rule as the standalone verify step).
+                    self._flush_pipeline()
+                plan = self._spec_plan(park=park, depths=depths)
         active = [
             (i, s.request.request_id)
-            for i, s in enumerate(self._slots) if s.active
+            for i, s in enumerate(self._slots)
+            if s.active and (plan is None or not plan.vmask[i])
         ]
         # Park the in-placement slot's frozen decode-write row at the
         # piece's END: the fused program runs the extend half first, so
@@ -235,6 +254,24 @@ class _InterleaveMixin:
         # plus one decode row for every active slot.
         self._prepare_slot_write(pf.slot_idx, off, min(off + bucket, self.cfg.max_seq))
         self._prealloc_decode_pages(1)
+        spec_args = ()
+        mixed_fns, mixed_sample_fns = self._mixed_fns, self._mixed_sample_fns
+        if plan is not None:
+            # Paged pool: exclusive pages for every active slot's verify
+            # window (the scan-lane slots' windows are garbage, but
+            # garbage must still land in owned pages, never freed ones).
+            W = self.cfg.spec_window()
+            for i, s in enumerate(self._slots):
+                if s.active:
+                    self._prepare_slot_write(
+                        i, s.length, min(s.length + W + 1, self.cfg.max_seq)
+                    )
+            spec_args = (
+                jnp.asarray(plan.toks), jnp.asarray(plan.pos),
+                jnp.asarray(plan.wstart), jnp.asarray(plan.vmask),
+            )
+            mixed_fns = self._mixed_spec_fns
+            mixed_sample_fns = self._mixed_spec_sample_fns
         toks = np.zeros((1, bucket), np.int32)
         toks[0, :take] = pf.prompt[off:off + take]
         ppos = (off + np.arange(bucket, dtype=np.int32))[None, :]
@@ -249,19 +286,24 @@ class _InterleaveMixin:
             (self._gstate, self._gtable, self._gactive) if self._gr_on else ()
         )
         t_dispatch = time.monotonic()
-        first_tok = new_pkd = None
+        first_tok = new_pkd = greedy = None
         if final:
             sp = pf.request.params
             kd = self._sampling_key(pf.slot_idx, sp)
-            out = self._mixed_sample_fns[bucket](
-                *args, jnp.int32(take - 1), kd, jnp.float32(sp.temperature),
+            out = mixed_sample_fns[bucket](
+                *args, *spec_args,
+                jnp.int32(take - 1), kd, jnp.float32(sp.temperature),
                 jnp.float32(sp.top_p), jnp.int32(sp.top_k),
                 *self._grammar_args(pf.request, sp), *gargs,
             )
+            if plan is not None:
+                greedy, out = out[-1], out[:-1]
             first_tok, new_pkd = out[-2], out[-1]
             out = out[:-2]
         else:
-            out = self._mixed_fns[bucket](*args, *gargs)
+            out = mixed_fns[bucket](*args, *spec_args, *gargs)
+            if plan is not None:
+                greedy, out = out[-1], out[:-1]
         if self._gr_on:
             (self._ck, self._cv, self._tokens, self._positions, self._active,
              self._budget, self._key_data, self._gstate, dtoks) = out
@@ -279,6 +321,17 @@ class _InterleaveMixin:
                 pf.request.request_id, take, bucket, dispatch_s
             )
         self._inflight.append((dtoks, active, dispatch_s))
+        if plan is not None:
+            # Acceptance decides the verify slots' next inputs — sync
+            # the window's greedy tokens now (the piece/decode halves
+            # of this dispatch materialize with them; the deferred
+            # dtoks read above becomes a cheap ready-array copy).
+            t_sync = time.monotonic()
+            g = np.asarray(greedy)
+            sync_s = time.monotonic() - t_sync
+            self.metrics["decode_sync_s"] += sync_s
+            self.metrics["spec_steps"] += 1
+            self._spec_accept(plan, g, dispatch_s, sync_s)
         pf.next_piece += 1
         pf.frontier = off + take
         if pf.sess is not None:
@@ -306,6 +359,8 @@ class _InterleaveMixin:
         slot.generated = 0
         slot.emitted = []
         slot.max_total = sp.max_tokens
+        if self.cfg.spec_decode:
+            slot.spec_reset(self.cfg.spec_decode, self.cfg.spec_decode_max)
         stop_ids = frozenset(sp.stop_token_ids)
         if request.grammar is not None:
             # Same rule as monolithic placement: the grammar's eos id

@@ -84,7 +84,9 @@ class MockEngine:
                  kv_quant=None, fault_plan: Optional[FaultPlan] = None,
                  max_queue: int = 0, watchdog_s: Optional[float] = None,
                  prefill_chunk_tokens: int = 0, flight_events: int = 0,
-                 kv_pages: int = 0, kv_page_tokens: int = 64):
+                 kv_pages: int = 0, kv_page_tokens: int = 64,
+                 spec_decode: int = 0, spec_decode_max: int = 0,
+                 spec_gate_window: int = 0):
         self.scenarios = list(scenarios)
         self.tokenizer = tokenizer or ByteTokenizer()
         self._req_counter = itertools.count()
@@ -140,6 +142,30 @@ class MockEngine:
         # nothing — the guarded no-op, zero-valued gauges.
         self.kv_pages = kv_pages
         self.kv_page_tokens = kv_page_tokens
+        # Speculative-decoding parity (engine/spec_decode.py): the mock
+        # has no verify program, but with spec_decode set each GREEDY
+        # playback walks its scripted reply through the REAL bounded
+        # _NgramIndex, the real per-slot depth policy
+        # (spec_depth_update), and a real _SpecGate — the scripted
+        # reply stands in for the model's own greedy choices, so
+        # acceptance is what prompt lookup would genuinely achieve on
+        # that stream. Scripted token output is EXACTLY unchanged; the
+        # mirror only drives the spec metrics. All three knobs at 0 =
+        # the guarded no-op (no index, no gate, zero-valued keys).
+        self.spec_decode = spec_decode
+        self.spec_decode_max = spec_decode_max
+        self.spec_gate_window = spec_gate_window
+        self._spec_gate = None
+        self._spec_ema = 0.0  # guarded-by: _lock
+        # Cumulative tokens walked by the mirror across ALL playbacks —
+        # _SpecGate.tick assumes a monotone engine-wide counter (the
+        # real engine passes tokens_generated); a per-playback position
+        # would run the gate's rate math backwards between playbacks.
+        self._spec_walked = 0  # guarded-by: _lock
+        if spec_decode > 0 and spec_gate_window > 0:
+            from omnia_tpu.engine.spec_decode import _SpecGate
+
+            self._spec_gate = _SpecGate(spec_gate_window)
         # The allocator REFERENCE is immutable after construction; its
         # internal books (and _page_slots) mutate only under _lock.
         self._page_alloc = None
@@ -175,6 +201,14 @@ class MockEngine:
             "decode_stall_steps": 0,
             # Flight-recorder parity (engine/flight.py).
             "flight_enabled": 1 if flight_events > 0 else 0,
+            # Speculative-decoding parity (engine/spec_decode.py): the
+            # greedy-playback prompt-lookup mirror books these.
+            "spec_steps": 0,
+            "spec_proposed": 0,
+            "spec_accepted": 0,
+            "spec_gate_state": 0,
+            "spec_accept_ema": 0.0,
+            "spec_index_bytes": 0,
             # Paged-KV parity (engine/kv_pages.py): live playbacks hold
             # pages in a real allocator, so these mirror the engine's
             # pool gauges; all zero with kv_pages=0.
@@ -412,6 +446,78 @@ class MockEngine:
                 self.metrics["grammar_rejections_avoided"] += 1
         return toks
 
+    def _spec_mirror(self, prompt_tokens, reply_ids, params) -> None:
+        """Walk a greedy playback's reply in verify-window strides
+        through the real prompt-lookup machinery: propose from the
+        bounded n-gram index over prompt+emitted, accept the prefix
+        matching the scripted reply (the mock's stand-in for the
+        model's greedy choices), update the real per-slot depth policy,
+        and tick the real gate — so the spec ledger and controllers are
+        exercisable hermetically. Playback output is untouched."""
+        if self.spec_decode <= 0 or params.temperature != 0.0:
+            return
+        import time as _time
+
+        from omnia_tpu.engine.spec_decode import (
+            _EMA_ALPHA,
+            _ENTRY_BYTES,
+            _NgramIndex,
+            spec_depth_update,
+        )
+
+        idx = _NgramIndex()
+        kmax = self.spec_decode_max
+        k = min(self.spec_decode, kmax) if kmax else self.spec_decode
+        ema = (k / kmax) if kmax else 1.0
+        ctx = list(prompt_tokens)
+        pos, steps, proposed, accepted = 0, 0, 0, 0
+        while pos < len(reply_ids):
+            if self._spec_gate is not None:
+                # The gate is shared across concurrent playbacks —
+                # tick under the lock (the engine's gate is engine-
+                # thread-only and needs none), against the cumulative
+                # walked-token counter, never this playback's position.
+                with self._lock:
+                    allowed = self._spec_gate.tick(
+                        _time.monotonic(), self._spec_walked
+                    )
+                if not allowed:
+                    ctx.append(reply_ids[pos])
+                    pos += 1
+                    with self._lock:
+                        self._spec_walked += 1
+                    continue
+            prop, real = idx.propose(ctx, max(k, 1))
+            acc = 0
+            while (acc < real and pos + acc < len(reply_ids)
+                   and prop[acc] == reply_ids[pos + acc]):
+                acc += 1
+            emit = min(acc + 1, len(reply_ids) - pos)  # accepted + bonus
+            ctx.extend(reply_ids[pos:pos + emit])
+            pos += emit
+            if self._spec_gate is not None:
+                with self._lock:
+                    self._spec_walked += emit
+            if real > 0:
+                steps += 1
+                proposed += real
+                accepted += acc
+                ema, new_k = spec_depth_update(ema, real, acc, kmax)
+                if kmax:
+                    k = max(new_k, 1)  # mirror skips the re-probe wait
+        with self._lock:
+            self.metrics["spec_steps"] += steps
+            self.metrics["spec_proposed"] += proposed
+            self.metrics["spec_accepted"] += accepted
+            if proposed:
+                self._spec_ema += _EMA_ALPHA * (
+                    accepted / proposed - self._spec_ema
+                )
+                self.metrics["spec_accept_ema"] = round(self._spec_ema, 4)
+            self.metrics["spec_index_bytes"] = _ENTRY_BYTES * idx.entries()
+            if self._spec_gate is not None:
+                self.metrics["spec_gate_state"] = self._spec_gate.state_code()
+
     def _page_mirror_begin(self, n_prompt: int) -> Optional[int]:
         """Reserve pages for a live playback's prompt rows (paged-KV
         parity). None when the mirror is off or saturated — playback
@@ -540,6 +646,7 @@ class MockEngine:
         # Every row the real engine would write (prompt prefill + each
         # decoded token) round-trips through the int8 scheme host-side.
         self._kv_roundtrip(prompt_tokens + reply_ids)
+        self._spec_mirror(prompt_tokens, reply_ids, params)
         generated = 0
         if die_after == 0:
             self._finish(

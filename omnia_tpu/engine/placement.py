@@ -251,6 +251,8 @@ class _PlacementMixin:
         slot.generated = 0
         slot.emitted = []
         slot.max_total = sp.max_tokens
+        if self.cfg.spec_decode:
+            slot.spec_reset(self.cfg.spec_decode, self.cfg.spec_decode_max)
         stop_ids = frozenset(sp.stop_token_ids)
         if request.grammar is not None:
             # In terminal accepting states the grammar view unmasks ONLY

@@ -28,6 +28,11 @@ through):
   the placed request's first token (with the grammar start-state bias,
   like ``extend``). An arriving prefill then costs decode at most one
   mixed step of latency instead of a full prefill stall.
+- ``verify`` / ``verify_decode`` / ``mixed_spec`` /
+  ``mixed_spec_sample`` — speculative decoding (``spec_decode > 0``):
+  the grammar-mask-aware verify window, the window fused with one exact
+  decode step for non-verify slots, and the window riding the mixed
+  prefill-piece dispatches (engine/spec_decode.py drives all four).
 - ``extend`` / ``extend_nosample`` — sessionful incremental prefill:
   run a prompt suffix through ``forward`` against the slot's EXISTING
   rows (cross-attention to history) from the reuse frontier; batch-1 on
@@ -96,6 +101,15 @@ class EnginePrograms:
     page_copy: Optional[Callable] = None
     gather_pages: Optional[Callable] = None
     scatter_pages: Optional[Callable] = None
+    # Speculative-decode fusion (spec_decode > 0): verify window + one
+    # exact decode step for the non-verify slots in ONE dispatch, and
+    # the mixed-step twins that additionally stream a prefill piece
+    # (both dicts empty unless prefill_chunk_tokens > 0 too).
+    verify_decode: Optional[Callable] = None
+    mixed_spec: dict[int, Callable] = dataclasses.field(default_factory=dict)
+    mixed_spec_sample: dict[int, Callable] = dataclasses.field(
+        default_factory=dict
+    )
 
 
 def build_programs(
@@ -194,6 +208,23 @@ def build_programs(
 
     max_seq = ecfg.max_seq
 
+    def _grammar_rows(gtable, state):
+        """Each slot's current [V] transition row, gathered with one
+        dynamic_slice per slot unrolled over the static batch dim: XLA
+        CPU lowers gather (vmapped dynamic_index, take_along_axis) to
+        an O(table) walk — cost grew with grammar_max_states — while a
+        dynamic_slice per slot is an O(V) copy regardless of table
+        size. The SINGLE gather idiom shared by the decode step body
+        and the spec verify oracle, so the sampler's mask and the
+        acceptance oracle's mask can never diverge."""
+        nvocab = gtable.shape[-1]
+        return jnp.stack([
+            jax.lax.dynamic_slice(
+                gtable, (b, state[b], 0), (1, 1, nvocab)
+            )[0, 0]
+            for b in range(gtable.shape[0])
+        ])  # [B, V]
+
     def _mk_step_body(params, stop_ids, temp, top_p, top_k,
                       gtable=None, gactive=None, grammar_on=False):
         """One decode step as a ``lax.scan`` body — the SINGLE source of
@@ -213,19 +244,7 @@ def build_programs(
                 positions
             )
             if grammar_on:
-                # One table row per slot, unrolled over the static
-                # batch dim: XLA CPU lowers gather (vmapped
-                # dynamic_index, take_along_axis) to an O(table)
-                # walk — cost grew with grammar_max_states — while a
-                # dynamic_slice per slot is an O(V) copy regardless
-                # of table size.
-                nvocab = gtable.shape[-1]
-                row = jnp.stack([
-                    jax.lax.dynamic_slice(
-                        gtable, (b, gstate[b], 0), (1, 1, nvocab)
-                    )[0, 0]
-                    for b in range(gtable.shape[0])
-                ])  # [B, V]
+                row = _grammar_rows(gtable, gstate)
                 bias = jnp.where(
                     gactive[:, None] & (row < 0), _NEG_INF, 0.0
                 )
@@ -262,6 +281,83 @@ def build_programs(
             return out, tok
 
         return body
+
+    def _verify_window(params, ck, cv, vtoks, vpos, vwstart,
+                       gstate=None, gtable=None, gactive=None):
+        """Speculative verify half: ONE forward over [B, W+1] tokens
+        (last emitted + proposals per slot) with per-slot write offsets;
+        the greedy argmax over every position is the acceptance oracle.
+        The cache rows for rejected proposals are garbage at rows ≥ the
+        slot's new frontier — the invariant the decode finish-mask
+        already relies on.
+
+        Grammar edition (gstate is not None): the oracle is the MASKED
+        argmax — each slot's current [S, V] transition row applies as
+        the same additive -inf bias the sampler uses (ops/sampling
+        seam), and the per-slot FSM state advances across window
+        positions along the PROPOSED stream (position t+1's input), so
+        the oracle's choice at every position within the accepted
+        prefix is admissible by construction. A masked proposal yields
+        garbage states downstream of it, but it also mismatches the
+        (admissible) masked argmax at its own position, so host
+        acceptance never trusts anything past it. The row gather is the
+        decode body's shared ``_grammar_rows`` helper — one idiom, one
+        mask source for sampler and oracle alike."""
+        logits, ck, cv = llama.forward(
+            params, cfg, vtoks, vpos, ck, cv, vwstart
+        )
+        if gstate is None:
+            return ck, cv, jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        T = vtoks.shape[1]
+        state = gstate
+        cols = []
+        for t in range(T):
+            row = _grammar_rows(gtable, state)
+            bias = jnp.where(gactive[:, None] & (row < 0), _NEG_INF, 0.0)
+            cols.append(
+                jnp.argmax(logits[:, t] + bias, axis=-1).astype(jnp.int32)
+            )
+            if t + 1 < T:
+                nxt = jnp.take_along_axis(
+                    row, vtoks[:, t + 1][:, None], axis=1
+                )[:, 0]
+                state = jnp.where(gactive, jnp.maximum(nxt, 0), state)
+        return ck, cv, jnp.stack(cols, axis=1)
+
+    def _vmasked_decode_step(params, ck, cv, tokens, positions, active,
+                             budget, stop_ids, key_data, temp, top_p,
+                             top_k, vmask, vshift, gstate, gtable,
+                             gactive, grammar_on):
+        """One _mk_step_body scan step with the verify-lane slots masked
+        OUT: they run inactive for the scan (frozen sampling state — the
+        host re-syncs their tokens/positions after acceptance) and their
+        unavoidable garbage row write is parked ``vshift`` rows past
+        their frontier, one row beyond the verify window they just
+        received — ≥ any frontier acceptance can reach, so it never
+        lands on real data. Scan-lane slots take the EXACT chunked step:
+        same body, same per-slot PRNG consumption."""
+        body = _mk_step_body(
+            params, stop_ids, temp, top_p, top_k, gtable, gactive,
+            grammar_on,
+        )
+        init = (ck, cv, tokens,
+                jnp.where(vmask, positions + vshift, positions),
+                active & ~vmask, budget, key_data)
+        if grammar_on:
+            init += (gstate,)
+        carry, toks = jax.lax.scan(body, init, None, length=1)
+        ck, cv, o_tok, o_pos, o_act, o_bud, o_kd = carry[:7]
+        out = (ck, cv,
+               jnp.where(vmask, tokens, o_tok),
+               jnp.where(vmask, positions, o_pos),
+               jnp.where(vmask, active, o_act),
+               jnp.where(vmask, budget, o_bud),
+               jnp.where(vmask[:, None], key_data, o_kd))
+        if grammar_on:
+            # The body already froze vmask slots' FSM state (they ran
+            # inactive), so the carry value passes through unmerged.
+            out += (carry[7],)
+        return out, toks
 
     def make_decode(chunk: int):
         def decode_impl(params, ck, cv, tokens, positions, active, budget,
@@ -382,8 +478,10 @@ def build_programs(
     # bit-identical to monolithic prefill.
     mixed_fns: dict[int, Callable] = {}
     mixed_sample_fns: dict[int, Callable] = {}
+    mixed_spec_fns: dict[int, Callable] = {}
+    mixed_spec_sample_fns: dict[int, Callable] = {}
     if ecfg.prefill_chunk_tokens > 0:
-        def make_mixed(bucket: int, sample: bool):
+        def make_mixed(bucket: int, sample: bool, spec: bool = False):
             grammar_on = bool(ecfg.grammar)
 
             def mixed_step(params, ck, cv, tokens, positions, active,
@@ -395,6 +493,13 @@ def build_programs(
                     del rest[-3:]
                 else:
                     gstate = gtable = gactive = None
+                if spec:
+                    # Speculative edition: the verify window rides the
+                    # SAME dispatch as the piece and the decode step —
+                    # its operands sit between the piece's and the
+                    # final-piece sampling family's.
+                    vtoks, vpos, vwstart, vmask = rest[:4]
+                    del rest[:4]
                 # -- prefill piece via the extend seam ------------------
                 k_slot = _take_slot(ck, pslot)
                 v_slot = _take_slot(cv, pslot)
@@ -419,6 +524,22 @@ def build_programs(
                         ptop_k[None], mask_bias=_first_bias(pg),
                     )
                     extra = (ptok[0], new_pkd[0])
+                if spec:
+                    # Verify window AFTER the piece (its garbage rows
+                    # for the placing slot park at the piece frontier,
+                    # where the next piece overwrites them), then the
+                    # decode step with the verify slots masked out.
+                    ck, cv, greedy = _verify_window(
+                        params, ck, cv, vtoks, vpos, vwstart,
+                        gstate, gtable, gactive,
+                    )
+                    carry, toks = _vmasked_decode_step(
+                        params, ck, cv, tokens, positions, active, budget,
+                        stop_ids, key_data, temp, top_p, top_k,
+                        vmask, vtoks.shape[1], gstate, gtable, gactive,
+                        grammar_on,
+                    )
+                    return carry + (toks,) + extra + (greedy,)
                 # -- one decode step over the fixed batch ---------------
                 body = _mk_step_body(
                     params, stop_ids, temp, top_p, top_k, gtable, gactive,
@@ -432,13 +553,19 @@ def build_programs(
                 return carry + (toks,) + extra
 
             mixed_step.__name__ = (
-                f"mixed_{'sample_' if sample else ''}{bucket}"
+                f"mixed_{'spec_' if spec else ''}"
+                f"{'sample_' if sample else ''}{bucket}"
             )
             return jax.jit(mixed_step, donate_argnums=(1, 2))
 
         for b in ecfg.mixed_prefill_buckets():
             mixed_fns[b] = make_mixed(b, sample=False)
             mixed_sample_fns[b] = make_mixed(b, sample=True)
+            if ecfg.spec_decode > 0:
+                mixed_spec_fns[b] = make_mixed(b, sample=False, spec=True)
+                mixed_spec_sample_fns[b] = make_mixed(
+                    b, sample=True, spec=True
+                )
 
     def offload(ck, cv, slot, rows: int):
         # Paged rows keep the cache representation (int8 + scales under
@@ -462,11 +589,6 @@ def build_programs(
 
     restore_fn = jax.jit(restore, donate_argnums=(0, 1))
 
-    # Speculative-decode verify: ONE forward over [B, K+1] tokens (last
-    # emitted + K proposals per slot) with per-slot write offsets; the
-    # greedy argmax over every position is the acceptance oracle. The
-    # cache rows for rejected proposals are garbage at rows ≥ the slot's
-    # new frontier — the same invariant the decode finish-mask relies on.
     # Shared-prefix pool transfers. store: slot rows → pool entry (pool
     # donated); seed: pool entry → slot rows (cache donated) — the
     # device-to-device copy that replaces a fresh session's shared-prefix
@@ -539,16 +661,39 @@ def build_programs(
 
         scatter_pages_fn = jax.jit(scatter_pages, donate_argnums=(0, 1))
 
-    verify_fn = None
+    # Speculative-decode programs (engine/spec_decode.py). `verify` is
+    # the pure window for all-verify-lane batches; `verify_decode`
+    # additionally runs ONE exact _mk_step_body step for the scan-lane
+    # slots (sampled traffic) with the verify slots masked out — per-
+    # slot participation in a single dispatch. Grammar engines pass the
+    # (gstate, gtable, gactive) triple so the acceptance oracle is the
+    # MASKED argmax (one trace-time branch; grammar-off programs carry
+    # zero extra operands — the guarded no-op contract).
+    verify_fn = verify_decode_fn = None
     if ecfg.spec_decode > 0:
-        def verify(params, ck, cv, tokens, positions, write_start):
-            logits, ck, cv = llama.forward(
-                params, cfg, tokens, positions, ck, cv, write_start
+        def verify(params, ck, cv, tokens, positions, write_start, *g):
+            gs, gt, ga = g if g else (None, None, None)
+            return _verify_window(
+                params, ck, cv, tokens, positions, write_start, gs, gt, ga
             )
-            greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-            return ck, cv, greedy
 
         verify_fn = jax.jit(verify, donate_argnums=(1, 2))
+
+        def verify_decode(params, ck, cv, tokens, positions, active,
+                          budget, stop_ids, key_data, temp, top_p, top_k,
+                          vtoks, vpos, vwstart, vmask, *g):
+            gs, gt, ga = g if g else (None, None, None)
+            ck, cv, greedy = _verify_window(
+                params, ck, cv, vtoks, vpos, vwstart, gs, gt, ga
+            )
+            carry, toks = _vmasked_decode_step(
+                params, ck, cv, tokens, positions, active, budget,
+                stop_ids, key_data, temp, top_p, top_k,
+                vmask, vtoks.shape[1], gs, gt, ga, bool(g),
+            )
+            return carry + (toks, greedy)
+
+        verify_decode_fn = jax.jit(verify_decode, donate_argnums=(1, 2))
 
     return EnginePrograms(
         prefill_insert=prefill_insert_fn,
@@ -568,4 +713,7 @@ def build_programs(
         page_copy=page_copy_fn,
         gather_pages=gather_pages_fn,
         scatter_pages=scatter_pages_fn,
+        verify_decode=verify_decode_fn,
+        mixed_spec=mixed_spec_fns,
+        mixed_spec_sample=mixed_spec_sample_fns,
     )

@@ -110,12 +110,14 @@ class _SchedulerMixin:
         if any(s.active for s in self._slots):
             with self._lock:
                 queued = bool(self._waiting)
-            # Greedy-only batches take the speculative verify path when
-            # configured: up to spec_decode+1 tokens per weight stream
-            # (spec_decode.py). Sampled/mixed traffic and in-flight
-            # chunks fall through to the exact chunked path.
-            if self._spec_applicable():
-                self._spec_verify_step()
+            # Per-slot speculation (spec_decode.py): greedy slots —
+            # grammar-constrained ones included — verify up to W
+            # proposals per weight stream while sampled slots ride the
+            # exact chunked step fused into the same dispatch; the
+            # self-gate and proposal plan decide per step, falling
+            # through to the plain lane whenever speculation would not
+            # pay (no proposals, gate off, window at the cache end).
+            if self._spec_step():
                 return True
             # A dispatch-ahead that no slot can still need (everyone's
             # token budget is covered by chunks already in flight) would

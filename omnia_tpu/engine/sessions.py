@@ -35,6 +35,9 @@ class _Slot:
         "session_id",
         "emitted",
         "spec_index",
+        "spec_ema",
+        "spec_k",
+        "spec_cool",
         "seeded_from",
         "grammar",
         "gr_view",
@@ -51,6 +54,13 @@ class _Slot:
         self.session_id: Optional[str] = None  # pinned session (may be idle)
         self.emitted: list[int] = []           # tokens emitted this request
         self.spec_index = None   # lazy per-request n-gram index (spec_decode)
+        # Per-slot adaptive speculation depth (spec_decode.py): the
+        # accept-rate EMA, the current proposal depth it drives, and
+        # the re-probe cooldown once the depth has collapsed to 0.
+        # Reset by placement via spec_reset; dead while spec is off.
+        self.spec_ema = 0.0
+        self.spec_k = 0
+        self.spec_cool = 0
         # Shared-prefix pool entry a SESSIONLESS request seeded from —
         # pins the entry until finish (sessionful seeds pin via
         # _SessionKV.seeded_from instead). Engine releases before clear().
@@ -69,10 +79,26 @@ class _Slot:
         self.generated = 0
         self.emitted = []
         self.spec_index = None
+        self.spec_ema = 0.0
+        self.spec_k = 0
+        self.spec_cool = 0
         self.seeded_from = None
         self.grammar = None
         self.gr_view = None
         self.gr_state = 0
+
+    def spec_reset(self, spec_decode: int, spec_decode_max: int) -> None:
+        """Arm the adaptive-depth controller for a newly placed request:
+        depth starts at the configured base and the EMA starts where
+        that depth sits on the curve, so the first observations move it
+        rather than fight an optimistic prior."""
+        if spec_decode_max > 0:
+            self.spec_k = min(spec_decode, spec_decode_max)
+            self.spec_ema = self.spec_k / spec_decode_max
+        else:
+            self.spec_k = spec_decode
+            self.spec_ema = 1.0
+        self.spec_cool = 0
 
     @property
     def active(self) -> bool:

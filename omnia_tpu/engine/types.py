@@ -207,16 +207,37 @@ class EngineConfig:
     # host RAM (LRU) and swap back on demand, so this many *logical*
     # sessions share the fixed device cache. 0 disables sessionful serving.
     max_sessions: int = 64
-    # Prompt-lookup speculative decoding (greedy traffic only): each
-    # verify step feeds the last token plus K host-proposed tokens
-    # (n-gram lookup over prompt+history) through ONE forward of T=K+1
-    # and accepts the matching prefix — up to K+1 tokens per weight
-    # stream instead of 1, a direct multiplier on the HBM-bound decode
-    # roofline. Engages only when every active slot samples greedily
-    # (temperature 0); sampled traffic keeps the exact chunked path.
-    # 0 = off. Must satisfy spec_decode + 1 <= min(prefill_buckets)
-    # (rejected-proposal rows land below the next occupant's prefill).
+    # Prompt-lookup speculative decoding (engine/spec_decode.py): each
+    # verify step feeds the last token plus host-proposed tokens
+    # (n-gram lookup over prompt+history) through ONE forward of
+    # T=W+1 and accepts the matching prefix — up to W+1 tokens per
+    # weight stream instead of 1, a direct multiplier on the HBM-bound
+    # decode roofline. Participation is PER SLOT: greedy slots verify
+    # (grammar-constrained ones included — the acceptance oracle is
+    # masked on device), while sampled slots ride the exact chunked
+    # sampling path fused into the same dispatch. 0 = off (the guarded
+    # no-op: no verify programs, no spec state). Must satisfy
+    # spec_window() + 1 <= min(prefill_buckets) (rejected-proposal rows
+    # land below the next occupant's smallest prefill write).
     spec_decode: int = 0
+    # Per-slot adaptive speculation depth cap: > 0 lets each slot's
+    # proposal depth follow its accept-rate EMA between 0 (lookup keeps
+    # missing — the slot rides verify steps as a plain passenger, with
+    # a periodic 1-token re-probe) and this cap, starting from
+    # spec_decode. Must be 0 (fixed depth = spec_decode) or >=
+    # spec_decode. Dead while spec_decode = 0.
+    spec_decode_max: int = 0
+    # Online self-gate (spec_decode.py _SpecGate): > 0 duty-cycles
+    # speculation in probe windows of this many scheduler steps,
+    # compares realized tokens/second with speculation permitted vs
+    # suppressed, and disables it (state reported in the
+    # `spec_gate_state` metric and bench aux.greedy_spec.gate) when it
+    # is not paying; holds each decision for 8 windows, then re-probes.
+    # 0 = no gate (speculation always permitted). Ignored under an
+    # injected logical clock (multihost lockstep) — a wall-clock
+    # decision could diverge the replicated step streams. Dead while
+    # spec_decode = 0.
+    spec_gate_window: int = 0
     # Weight quantization: None (full dtype), "int8" (W8A16 weight-only,
     # near-lossless, halves weight HBM), or "int8-dynamic" (W8A8 dynamic
     # activation quant, int8×int8 MXU path — fastest). Dense models only;
@@ -331,6 +352,14 @@ class EngineConfig:
     # exists, no span is ever opened, every seam is one `is not None`
     # check (tests/test_flight.py).
     flight_events: int = 0
+
+    def spec_window(self) -> int:
+        """Speculative verify window W — the most proposals any slot
+        may submit per verify step; the compiled verify shape is
+        [num_slots, W + 1]. 0 while speculation is off."""
+        if not self.spec_decode:
+            return 0
+        return max(self.spec_decode, self.spec_decode_max)
 
     def chunk_variants(self) -> tuple[int, ...]:
         """Compiled decode-chunk sizes, descending, always containing

@@ -76,6 +76,11 @@ def build_engine(spec: ProviderSpec, *, warmup: bool = False):
             # against a real allocator (engine/kv_pages.py).
             kv_pages=spec.options.get("kv_pages", 0),
             kv_page_tokens=spec.options.get("kv_page_tokens", 64),
+            # Speculative-decoding parity: greedy playbacks mirror the
+            # prompt-lookup/depth/gate controllers (engine/mock.py).
+            spec_decode=spec.options.get("spec_decode", 0),
+            spec_decode_max=spec.options.get("spec_decode_max", 0),
+            spec_gate_window=spec.options.get("spec_gate_window", 0),
         )
     if spec.type == "tpu":
         from omnia_tpu.models import PRESETS, get_config
@@ -85,7 +90,8 @@ def build_engine(spec: ProviderSpec, *, warmup: bool = False):
             for k, v in spec.options.items()
             if k in {"num_slots", "max_seq", "prefill_buckets", "dtype",
                      "dp", "tp", "decode_chunk", "decode_pipeline",
-                     "spec_decode", "quant", "kv_quant", "max_sessions",
+                     "spec_decode", "spec_decode_max", "spec_gate_window",
+                     "quant", "kv_quant", "max_sessions",
                      "prefix_cache_slots", "prefix_cache_rows",
                      "prefix_cache_publish_threshold",
                      "prefix_cache_min_tokens", "prefix_cache_host_entries",

@@ -43,6 +43,10 @@ KNOB_GUARDS = {
         "test_guards.py::test_default_knobs_off_are_true_noop",
     "EngineConfig.spec_decode":
         "test_guards.py::test_default_knobs_off_are_true_noop",
+    "EngineConfig.spec_decode_max":
+        "test_spec_decode.py::test_spec_knobs_off_are_true_noop",
+    "EngineConfig.spec_gate_window":
+        "test_spec_decode.py::test_spec_knobs_off_are_true_noop",
     "EngineConfig.quant":
         "test_guards.py::test_default_knobs_off_are_true_noop",
     "EngineConfig.kv_quant": "test_guards.py::test_kv_quant_none_is_true_noop",
@@ -88,6 +92,12 @@ KNOB_GUARDS = {
         "test_guards.py::test_mock_knobs_off_are_true_noop",
     "MockEngine.kv_page_tokens":
         "structural: mirror page size; dead while kv_pages=0",
+    "MockEngine.spec_decode":
+        "test_guards.py::test_mock_knobs_off_are_true_noop",
+    "MockEngine.spec_decode_max":
+        "structural: mirror depth cap; dead while spec_decode=0",
+    "MockEngine.spec_gate_window":
+        "structural: mirror gate window; dead while spec_decode=0",
 }
 
 
@@ -483,9 +493,11 @@ def test_default_knobs_off_are_true_noop():
     assert all(
         leaf.dtype != jnp.int8 for leaf in jax.tree.leaves(eng.params)
     )
-    # spec_decode=0: no verify program, the spec path never engages.
-    assert eng._verify_fn is None
-    assert not eng._spec_applicable()
+    # spec_decode=0: no verify program, the spec path never engages —
+    # _spec_step is a config check that dispatches nothing.
+    assert eng._verify_fn is None and eng._verify_decode_fn is None
+    assert not eng._spec_step()
+    assert eng._spec_gate is None
     # sp=1: no ring-prefill program.
     assert eng._prefill_ring_fn is None
     # max_sessions=0: a session_id is accepted but creates NO session
@@ -498,8 +510,10 @@ def test_default_knobs_off_are_true_noop():
     assert fin.finish_reason is not None and toks
     assert eng._sessions == {}
     for key in ("spec_steps", "spec_proposed", "spec_accepted",
+                "spec_gate_state", "spec_index_bytes",
                 "session_offloads", "session_restores"):
         assert eng.metrics[key] == 0, (key, eng.metrics[key])
+    assert eng.metrics["spec_accept_ema"] == 0.0
 
 
 def test_mock_knobs_off_are_true_noop():
@@ -523,12 +537,16 @@ def test_mock_knobs_off_are_true_noop():
                 "mixed_steps", "interleaved_prefill_tokens",
                 "kv_quant_enabled", "kv_quant_rows_written",
                 "flight_enabled", "kv_pages_total", "kv_pages_free",
-                "kv_page_cow_copies"):
+                "kv_page_cow_copies", "spec_steps", "spec_proposed",
+                "spec_accepted", "spec_gate_state", "spec_index_bytes"):
         assert m.metrics[key] == 0, (key, m.metrics[key])
     assert m.metrics["kv_quant_roundtrip_rel_err"] == 0.0
+    assert m.metrics["spec_accept_ema"] == 0.0
     assert m.metrics["kv_page_fragmentation"] == 0.0
     # kv_pages=0: no mirror allocator exists at all.
     assert m._page_alloc is None and m._page_slots == []
+    # spec_decode=0: no gate controller, no index ever built.
+    assert m._spec_gate is None
 
 
 def test_knob_guard_registry_is_conformant():

@@ -466,6 +466,7 @@ class TestMetricsKeyStability:
         "prefix_reuse_tokens", "session_offloads", "session_restores",
         "decode_dispatch_s", "decode_sync_s", "prefill_dispatch_s",
         "spec_steps", "spec_proposed", "spec_accepted",
+        "spec_gate_state", "spec_accept_ema", "spec_index_bytes",
         "prefix_cache_hit_tokens", "prefix_cache_insertions",
         "prefix_cache_evictions", "prefix_cache_host_hits",
         "prefix_cache_offload_elisions",
