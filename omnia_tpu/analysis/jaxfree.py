@@ -17,10 +17,14 @@ import ast
 
 from omnia_tpu.analysis.core import Finding, SourceFile
 
-#: Packages (repo-relative directory prefixes) that must never import jax.
+#: Repo-relative path prefixes (packages or single modules) that must
+#: never import jax.
 JAX_FREE_PACKAGES: tuple[str, ...] = (
     "omnia_tpu/engine/grammar/",
     "omnia_tpu/analysis/",
+    # Cold-start tracker + warmup manifest: jax-free by contract so the
+    # mock parity layer and the CI poisoned-jax subset can run it.
+    "omnia_tpu/engine/coldstart.py",
 )
 
 

@@ -45,6 +45,7 @@ LOCK_GROUPS: tuple[tuple[str, tuple[str, ...]], ...] = (
         "omnia_tpu/engine/prefix_cache.py",
         "omnia_tpu/engine/spec_decode.py",
         "omnia_tpu/engine/paged.py",
+        "omnia_tpu/engine/warmup.py",
         "omnia_tpu/engine/multihost.py",
     )),
     ("mock", ("omnia_tpu/engine/mock.py",)),
@@ -53,6 +54,9 @@ LOCK_GROUPS: tuple[tuple[str, tuple[str, ...]], ...] = (
     # caller threads, step events on the engine thread, terminals on
     # either) — same machine-checked lock-at-access-site discipline.
     ("flight", ("omnia_tpu/engine/flight.py",)),
+    # The cold-start tracker is written from the loader/warmup threads
+    # and read by Health probes — its own lock class.
+    ("coldstart", ("omnia_tpu/engine/coldstart.py",)),
 )
 
 #: Attribute names whose CALL under a held lock is (potentially)

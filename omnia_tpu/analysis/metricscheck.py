@@ -44,6 +44,7 @@ ENGINE_FAMILY = (
     "omnia_tpu/engine/prefix_cache.py",
     "omnia_tpu/engine/spec_decode.py",
     "omnia_tpu/engine/paged.py",
+    "omnia_tpu/engine/warmup.py",
     "omnia_tpu/engine/multihost.py",
 )
 MOCK_FILE = "omnia_tpu/engine/mock.py"

@@ -41,6 +41,7 @@ PURITY_FILES_PREFIXES: tuple[str, ...] = (
     "omnia_tpu/engine/scheduler.py",
     "omnia_tpu/engine/placement.py",
     "omnia_tpu/engine/paged.py",
+    "omnia_tpu/engine/warmup.py",
     "omnia_tpu/ops/",
     "omnia_tpu/models/",
     "omnia_tpu/parallel/",

@@ -48,6 +48,7 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
+from omnia_tpu.engine.coldstart import PHASE_CODES, ColdStartTracker
 from omnia_tpu.engine.faults import FaultPlan
 from omnia_tpu.engine.flight import FlightRecorder
 from omnia_tpu.engine.interleave import _InflightPrefill, _InterleaveMixin
@@ -63,6 +64,7 @@ from omnia_tpu.engine.programs import build_programs
 from omnia_tpu.engine.scheduler import _SchedulerMixin
 from omnia_tpu.engine.sessions import _SessionKV, _SessionMixin, _Slot
 from omnia_tpu.engine.spec_decode import _SpecDecodeMixin, validate_spec_config
+from omnia_tpu.engine.warmup import _WarmupMixin
 from omnia_tpu.engine.types import (
     MAX_DEVICE_STOP_IDS,
     EngineConfig,
@@ -76,16 +78,11 @@ from omnia_tpu.engine.types import (
 from omnia_tpu.models import ModelConfig
 from omnia_tpu.models import llama
 from omnia_tpu.models import quant
-from omnia_tpu.models.kv_quant import (
-    cache_bytes,
-    kv_device,
-    kv_host,
-    validate_kv_quant,
-)
+from omnia_tpu.models.kv_quant import cache_bytes, validate_kv_quant
 from omnia_tpu.ops.sampling import make_slot_key_data
 from omnia_tpu.parallel import make_mesh, shard_pytree
 from omnia_tpu.parallel.sharding import named_sharding_tree
-from omnia_tpu.utils.compile_cache import enable_compilation_cache
+from omnia_tpu.utils.compile_cache import enable_compilation_cache, enabled_dir
 
 logger = logging.getLogger(__name__)
 
@@ -93,6 +90,7 @@ logger = logging.getLogger(__name__)
 class InferenceEngine(
     _SchedulerMixin, _SessionMixin, _SpecDecodeMixin, _PrefixCacheMixin,
     _PlacementMixin, _InterleaveMixin, _LifecycleMixin, _PagedKVMixin,
+    _WarmupMixin,
 ):
     """Slot-based continuous-batching engine over one model."""
 
@@ -103,9 +101,16 @@ class InferenceEngine(
         params=None,
         seed: int = 0,
         devices=None,
+        coldstart: Optional[ColdStartTracker] = None,
     ):
         self.model_cfg = model_cfg
         self.cfg = engine_cfg
+        # Cold-start tracker (engine/coldstart.py): phase spans + weight
+        # streaming + warmup progress, mirrored into the stable metrics.
+        # Callers that measure backend bring-up (bench, the runtime
+        # server) pass their own tracker with the backend_init phase
+        # already begun; construction here closes it.
+        self._coldstart = coldstart or ColdStartTracker()
         # Every serving path compiles through the persistent cache: restart
         # after the first start deserializes instead of recompiling (cold
         # warmup ~100 s → seconds; the scale-to-zero enabler).
@@ -114,6 +119,8 @@ class InferenceEngine(
             raise ValueError("engine max_seq exceeds model max_seq_len")
         if engine_cfg.num_slots % max(engine_cfg.dp, 1) != 0:
             raise ValueError("num_slots must be divisible by dp")
+        if engine_cfg.warmup_threads < 0:
+            raise ValueError("warmup_threads must be >= 0")
         validate_spec_config(engine_cfg)
 
         # Grammar-constrained decoding (engine/grammar/): gated ONCE here;
@@ -138,7 +145,97 @@ class InferenceEngine(
                 engine_cfg.dp, engine_cfg.tp, sp=engine_cfg.sp, devices=devices
             )
 
+        self._seed = seed
+        # Session-LRU clock. Injectable so replicated engines (multi-host
+        # lockstep, engine/multihost.py) share a LOGICAL clock: eviction
+        # order must be identical on every process or their compiled-step
+        # streams diverge and the cross-host collectives deadlock.
+        self.clock = time.monotonic
+        # Cross-session shared-prefix pool (engine/prefix_cache.py).
+        # Host-side books live here; the device arrays (_pk/_pv) are
+        # (re)allocated with the caches in _init_device_state. The pool
+        # LRU shares the engine's logical clock (lambda defers the
+        # lookup — self.clock is injectable for multi-host lockstep).
+        self._prefix_pool: Optional[PrefixPool] = None
+        self._pending_prefix_regs: list[list[int]] = []  # guarded-by: _lock
+        if engine_cfg.prefix_cache_slots > 0:
+            if self._mesh is not None and (
+                engine_cfg.prefix_cache_slots % max(engine_cfg.dp, 1) != 0
+            ):
+                raise ValueError(dp_divisibility_error(
+                    "prefix_cache_slots", engine_cfg.prefix_cache_slots,
+                    engine_cfg.dp,
+                ))
+            self._prefix_pool = PrefixPool(
+                engine_cfg.prefix_cache_slots,
+                engine_cfg.prefix_cache_host_entries,
+                clock=lambda: self.clock(),
+            )
+
+        # Flight recorder (engine/flight.py): the step-level event ring
+        # + per-request latency breakdowns. flight_events=0 allocates NO
+        # recorder state — every seam below is a single None check (the
+        # guarded no-op contract, tests/test_flight.py). The recorder
+        # keeps its OWN monotonic clock, never self.clock: breakdowns
+        # are host wall time, and an injected logical clock (lockstep)
+        # must not distort them. Created before weight loading so the
+        # cold-start init-phase events have somewhere to land.
+        self._flight: Optional[FlightRecorder] = (
+            FlightRecorder(engine_cfg.flight_events)
+            if engine_cfg.flight_events > 0 else None
+        )
+        # Tracer for the `omnia.engine.request` child span (trace
+        # continuity from the runtime's llm span): set by the embedding
+        # server (utils.tracing.Tracer), None = no engine spans. Spans
+        # only open for submits that carry a trace_ctx AND with the
+        # flight recorder on — the recorder owns the span lifecycle.
+        self.tracer = None
+
+        # Programs are pure config functions — built BEFORE params so a
+        # callable `params` (the streaming checkpoint loader) can overlap
+        # weight streaming with the param-free program compiles
+        # (engine/warmup.py _load_params_overlapped).
+        progs = build_programs(self.model_cfg, self.cfg, self._mesh)
+        # Program callables live as flat attributes (not the dataclass) so
+        # tests/recovery can swap one (e.g. fault injection on
+        # _prefill_insert_fn) without rebuilding the set.
+        self._prefill_insert_fn = progs.prefill_insert
+        self._prefill_ring_fn = progs.prefill_ring
+        self._insert_fn = progs.insert
+        self._decode_fns = progs.decode_fns
+        self._decode_fn = self._decode_fns[max(self._decode_fns)]
+        self._decode_fn_single = self._decode_fns[1]
+        self._extend_fn = progs.extend
+        self._extend_nosample_fn = progs.extend_nosample
+        self._offload_fn = progs.offload
+        self._restore_fn = progs.restore
+        self._verify_fn = progs.verify
+        self._verify_decode_fn = progs.verify_decode
+        self._mixed_spec_fns = progs.mixed_spec
+        self._mixed_spec_sample_fns = progs.mixed_spec_sample
+        self._prefix_store_fn = progs.prefix_store
+        self._prefix_seed_fn = progs.prefix_seed
+        self._prefix_offload_fn = progs.prefix_offload
+        self._mixed_fns = progs.mixed
+        self._mixed_sample_fns = progs.mixed_sample
+        self._page_copy_fn = progs.page_copy
+        self._gather_pages_fn = progs.gather_pages
+        self._scatter_pages_fn = progs.scatter_pages
+
+        backend_init_s = self._coldstart.end_phase("backend_init")
+        if self._flight is not None:
+            self._flight.note_init_phase("backend_init", {
+                "backend": jax.default_backend(),
+                "seconds": backend_init_s,
+            })
+
         qmode = quant.validate_mode(engine_cfg.quant)
+        if callable(params):
+            # Streaming checkpoint loader: runs under the weights_load
+            # phase while the param-free program families compile on a
+            # side thread (engine/warmup.py) — cold start pays
+            # max(weights, KV-transfer compiles), not their sum.
+            params = self._load_params_overlapped(params)
         if params is not None and quant.params_quantized(params):
             # Pre-quantized tree (the loader's flagship path): its mode is
             # authoritative — shard specs must match the actual leaf
@@ -174,28 +271,6 @@ class InferenceEngine(
         if self._mesh is not None:
             params = shard_pytree(params, specs, self._mesh)
         self.params = params
-
-        self._seed = seed
-        # Cross-session shared-prefix pool (engine/prefix_cache.py).
-        # Host-side books live here; the device arrays (_pk/_pv) are
-        # (re)allocated with the caches in _init_device_state. The pool
-        # LRU shares the engine's logical clock (lambda defers the
-        # lookup — self.clock is injectable for multi-host lockstep).
-        self._prefix_pool: Optional[PrefixPool] = None
-        self._pending_prefix_regs: list[list[int]] = []  # guarded-by: _lock
-        if engine_cfg.prefix_cache_slots > 0:
-            if self._mesh is not None and (
-                engine_cfg.prefix_cache_slots % max(engine_cfg.dp, 1) != 0
-            ):
-                raise ValueError(dp_divisibility_error(
-                    "prefix_cache_slots", engine_cfg.prefix_cache_slots,
-                    engine_cfg.dp,
-                ))
-            self._prefix_pool = PrefixPool(
-                engine_cfg.prefix_cache_slots,
-                engine_cfg.prefix_cache_host_entries,
-                clock=lambda: self.clock(),
-            )
         self._init_device_state()
 
         B = engine_cfg.num_slots
@@ -230,29 +305,6 @@ class InferenceEngine(
         # to inject hung/slow chunk syncs and flaky submits. None in
         # production — every consult is a cheap attribute check.
         self._fault_plan: Optional[FaultPlan] = None
-        # Session-LRU clock. Injectable so replicated engines (multi-host
-        # lockstep, engine/multihost.py) share a LOGICAL clock: eviction
-        # order must be identical on every process or their compiled-step
-        # streams diverge and the cross-host collectives deadlock.
-        self.clock = time.monotonic
-
-        # Flight recorder (engine/flight.py): the step-level event ring
-        # + per-request latency breakdowns. flight_events=0 allocates NO
-        # recorder state — every seam below is a single None check (the
-        # guarded no-op contract, tests/test_flight.py). The recorder
-        # keeps its OWN monotonic clock, never self.clock: breakdowns
-        # are host wall time, and an injected logical clock (lockstep)
-        # must not distort them.
-        self._flight: Optional[FlightRecorder] = (
-            FlightRecorder(engine_cfg.flight_events)
-            if engine_cfg.flight_events > 0 else None
-        )
-        # Tracer for the `omnia.engine.request` child span (trace
-        # continuity from the runtime's llm span): set by the embedding
-        # server (utils.tracing.Tracer), None = no engine spans. Spans
-        # only open for submits that carry a trace_ctx AND with the
-        # flight recorder on — the recorder owns the span lifecycle.
-        self.tracer = None
 
         # Metrics (engine-level; exported via utils.metrics by the runtime).
         # The *_s accumulators split host wall time between program
@@ -355,36 +407,28 @@ class InferenceEngine(
             # whether per-request latency breakdowns exist before asking
             # for a dump.
             "flight_enabled": 1 if self._flight is not None else 0,
+            # Cold-start observability (engine/coldstart.py): the
+            # persistent-compile-cache switch and the submit-to-ready
+            # progress surface. warmup_phase is the PHASE_CODES index
+            # (0 idle → 5 ready); programs/bytes counters fill in DURING
+            # bring-up, so a probe mid-warmup reads real progress
+            # instead of an opaque "initializing". manifest hits/misses
+            # say whether this start found a prior start's program list
+            # (warm restore) or is discovering the set cold.
+            "compile_cache_enabled": 1 if enabled_dir() else 0,
+            "warmup_phase": PHASE_CODES[self._coldstart.current_phase()],
+            "warmup_programs_total": 0,
+            "warmup_programs_done": 0,
+            "warmup_manifest_hits": 0,
+            "warmup_manifest_misses": 0,
+            "weights_bytes_total": 0,
+            "weights_bytes_loaded": 0,
         }
         self._gr_mask_sum = 0.0
         self._gr_mask_steps = 0
-
-        progs = build_programs(self.model_cfg, self.cfg, self._mesh)
-        # Program callables live as flat attributes (not the dataclass) so
-        # tests/recovery can swap one (e.g. fault injection on
-        # _prefill_insert_fn) without rebuilding the set.
-        self._prefill_insert_fn = progs.prefill_insert
-        self._prefill_ring_fn = progs.prefill_ring
-        self._insert_fn = progs.insert
-        self._decode_fns = progs.decode_fns
-        self._decode_fn = self._decode_fns[max(self._decode_fns)]
-        self._decode_fn_single = self._decode_fns[1]
-        self._extend_fn = progs.extend
-        self._extend_nosample_fn = progs.extend_nosample
-        self._offload_fn = progs.offload
-        self._restore_fn = progs.restore
-        self._verify_fn = progs.verify
-        self._verify_decode_fn = progs.verify_decode
-        self._mixed_spec_fns = progs.mixed_spec
-        self._mixed_spec_sample_fns = progs.mixed_spec_sample
-        self._prefix_store_fn = progs.prefix_store
-        self._prefix_seed_fn = progs.prefix_seed
-        self._prefix_offload_fn = progs.prefix_offload
-        self._mixed_fns = progs.mixed
-        self._mixed_sample_fns = progs.mixed_sample
-        self._page_copy_fn = progs.page_copy
-        self._gather_pages_fn = progs.gather_pages
-        self._scatter_pages_fn = progs.scatter_pages
+        # A callable-params construction streamed weights before the
+        # metrics dict existed — fold the tracker's view in now.
+        self._sync_coldstart_metrics()
         from omnia_tpu.ops.attention import pallas_decode_mode
 
         logger.info(
@@ -394,12 +438,51 @@ class InferenceEngine(
             self.cfg.chunk_variants(), qmode, self._kv_quant,
         )
 
+    def _alloc_kv_state(self):
+        """Fresh KV arrays at the engine's exact layout, representation,
+        and sharding: (ck, cv, pk, pv) — the allocation half of
+        ``_init_device_state``, also what each ADDITIONAL parallel
+        warmup worker chains its donated operands through
+        (engine/warmup.py). Pure allocation: no allocator or pool books
+        are touched.
+
+        Non-paged: the slot cache plus (pool on) the shared-prefix
+        arrays [L, P, R, H, D] beside it, same layout/sharding (P over
+        dp, heads over tp) AND the same KV representation — under
+        kv_quant both hold int8 rows + scales, so the same pool bytes
+        cache 2× the prefixes. Paged: ONE page pool + per-slot tables
+        (engine/paged.py), pk/pv None."""
+        B, S = self.cfg.num_slots, self.cfg.max_seq
+        if self.cfg.kv_pages > 0:
+            ck, cv = self._alloc_paged_kv()
+            return ck, cv, None, None
+        ck, cv = llama.init_kv_cache(
+            self.model_cfg, B, S, dtype=self._dtype, kv_quant=self._kv_quant
+        )
+        tree = None
+        if self._mesh is not None:
+            kspec, vspec = llama.kv_cache_specs(self._kv_quant)
+            tree = named_sharding_tree((kspec, vspec), self._mesh)
+            ck = jax.device_put(ck, tree[0])
+            cv = jax.device_put(cv, tree[1])
+        pk = pv = None
+        if self._prefix_pool is not None:
+            R = self.cfg.prefix_buckets()[-1]
+            pk, pv = llama.init_kv_cache(
+                self.model_cfg, self.cfg.prefix_cache_slots, R,
+                dtype=self._dtype, kv_quant=self._kv_quant,
+            )
+            if self._mesh is not None:
+                pk = jax.device_put(pk, tree[0])
+                pv = jax.device_put(pv, tree[1])
+        return ck, cv, pk, pv
+
     def _init_device_state(self):
         """(Re)allocate KV caches and per-slot device state. Called at
         construction and from crash recovery — after an exception inside a
         donated-buffer step, self._ck/_cv may point at deleted arrays, so
         the only way back to a healthy engine is a fresh allocation."""
-        B, S = self.cfg.num_slots, self.cfg.max_seq
+        B = self.cfg.num_slots
         if self.cfg.kv_pages > 0:
             # Paged layout (engine/paged.py): ONE page pool + per-slot
             # page tables serve the slots, the prefix cache (page runs
@@ -407,34 +490,11 @@ class InferenceEngine(
             # list — the dedicated _pk/_pv prefix arrays do not exist.
             self._init_paged_state()
         else:
-            ck, cv = llama.init_kv_cache(
-                self.model_cfg, B, S, dtype=self._dtype, kv_quant=self._kv_quant
-            )
-            if self._mesh is not None:
-                kspec, vspec = llama.kv_cache_specs(self._kv_quant)
-                tree = named_sharding_tree((kspec, vspec), self._mesh)
-                ck = jax.device_put(ck, tree[0])
-                cv = jax.device_put(cv, tree[1])
-            self._ck, self._cv = ck, cv
-
-            # Shared-prefix pool arrays: [L, P, R, H, D] beside the slot
-            # cache, same layout/sharding (P over dp, heads over tp) AND
-            # the same KV representation — under kv_quant the pool holds
-            # int8 rows + scales, so the same pool bytes cache 2× the
-            # prefixes. A reallocation means any device-resident pool
-            # entries died with the caches; host-paged entries survive
-            # in the pool's books.
-            self._pk = self._pv = None
+            self._ck, self._cv, self._pk, self._pv = self._alloc_kv_state()
             if self._prefix_pool is not None:
-                R = self.cfg.prefix_buckets()[-1]
-                pk, pv = llama.init_kv_cache(
-                    self.model_cfg, self.cfg.prefix_cache_slots, R,
-                    dtype=self._dtype, kv_quant=self._kv_quant,
-                )
-                if self._mesh is not None:
-                    pk = jax.device_put(pk, tree[0])
-                    pv = jax.device_put(pv, tree[1])
-                self._pk, self._pv = pk, pv
+                # A reallocation means any device-resident pool entries
+                # died with the caches; host-paged entries survive in
+                # the pool's books.
                 self._prefix_pool.on_device_reset()
                 if hasattr(self, "metrics"):  # absent at construction
                     self.metrics["prefix_cache_evictions"] = (
@@ -502,159 +562,6 @@ class InferenceEngine(
             * (mc.head_dim * itemsize + scale_bytes) * 2
         )
 
-    def warmup(self, sessions: bool = True):
-        """AOT-compile decode (all chunk variants) + all usable prefill
-        buckets + the sessionful extend/offload/restore programs (called
-        before ready — the request path must never hit a compile).
-        Behavior-neutral: all device state and metrics it touched are
-        restored afterwards.
-
-        sessions=False skips the extend/offload/restore family — only
-        valid for serving without session KV reuse AND with every prompt
-        fitting the largest prefill bucket (the chunked-prefill path uses
-        extend too). The bench uses it to keep warmup inside the driver
-        budget on a cold compile cache."""
-        t0 = time.monotonic()
-        metrics_before = dict(self.metrics)
-        for k in self._decode_fns:
-            self._run_decode_step(chunk=k)
-        kd = self._key_data[0]
-        zero = jnp.int32(0)
-        sargs = (kd, jnp.float32(0.0), jnp.float32(1.0), jnp.int32(0))
-        if self._gr_on:
-            # The request path ALWAYS passes the grammar bias operand
-            # when support is on (zeros for ungrammared requests), so
-            # warmup must trace the same signatures.
-            sargs = sargs + (self._gbias_zero,)
-        # Suffix prefill after a shared-prefix seed rides the extend
-        # family, so an enabled pool warms it even for sessionless
-        # serving (the bench's shared-prefix scenario).
-        extend_shapes = (
-            set(self.cfg.usable_buckets()) | {1}
-            if sessions or self._prefix_enabled()
-            else set()
-        )
-        for b in sorted(set(self.cfg.usable_buckets()) | extend_shapes):
-            toks = jnp.zeros((1, b), jnp.int32)
-            pos = jnp.arange(b, dtype=jnp.int32)[None, :]
-            if b in self.cfg.usable_buckets():
-                self._ck, self._cv, _, _ = self._prefill_insert_fn(
-                    self.params, self._ck, self._cv, toks, pos, zero,
-                    jnp.int32(b - 1), *sargs
-                )
-                if (
-                    self._prefill_ring_fn is not None
-                    and b >= self.cfg.long_prefill_threshold
-                    and b % self.cfg.sp == 0
-                ):
-                    logits, k_chunk, v_chunk = self._prefill_ring_fn(
-                        self.params, toks, pos
-                    )
-                    self._ck, self._cv, _, self._key_data = self._run_insert(
-                        k_chunk, v_chunk, 0, logits[:, -1]
-                    )
-            if b in extend_shapes:
-                self._ck, self._cv = self._extend_nosample_fn(
-                    self.params, self._ck, self._cv, toks, pos, zero, zero
-                )
-                self._ck, self._cv, _, _ = self._extend_fn(
-                    self.params, self._ck, self._cv, toks, pos, zero, zero, zero, *sargs
-                )
-        gargs = (
-            (self._gstate, self._gtable, self._gactive) if self._gr_on else ()
-        )
-        for b in self.cfg.mixed_prefill_buckets():
-            # Fused mixed prefill+decode steps (token-budget
-            # interleaving): warm both variants per piece bucket with
-            # the request path's exact operand types (strong int32
-            # piece arrays/scalars, the `sargs` sampling family).
-            toks = jnp.zeros((1, b), jnp.int32)
-            pos = jnp.arange(b, dtype=jnp.int32)[None, :]
-            out = self._mixed_fns[b](
-                self.params, self._ck, self._cv, self._tokens,
-                self._positions, self._active, self._budget, self._stop_ids,
-                self._key_data, self._temp, self._top_p, self._top_k,
-                toks, pos, zero, zero, *gargs,
-            )
-            self._ck, self._cv = out[0], out[1]
-            out = self._mixed_sample_fns[b](
-                self.params, self._ck, self._cv, self._tokens,
-                self._positions, self._active, self._budget, self._stop_ids,
-                self._key_data, self._temp, self._top_p, self._top_k,
-                toks, pos, zero, zero, jnp.int32(b - 1), *sargs, *gargs,
-            )
-            self._ck, self._cv = out[0], out[1]
-        if sessions:
-            for r in self.cfg.restore_buckets():
-                k, v = self._offload_fn(self._ck, self._cv, zero, r)
-                self._ck, self._cv = self._restore_fn(self._ck, self._cv, k, v, zero)
-        if self._paged_on():
-            # Paged-only programs: page copy (CoW), table-row sync, and
-            # the prefix host-tier page-run transfer buckets.
-            self._warmup_paged()
-        if self._prefix_enabled() and self._prefix_store_fn is not None:
-            # Pool transfers per prefix bucket: store (slot→pool), seed
-            # (pool→slot), demote (pool→host), and the host-hit restore
-            # path with the SAME scalar types placement dispatches
-            # (python-int slot/pool indices, static row bucket). Absent
-            # under kv_pages — the paged prefix cache is table rewrites
-            # plus the page-run programs warmed above.
-            for b in self.cfg.prefix_buckets():
-                self._pk, self._pv = self._prefix_store_fn(
-                    self._pk, self._pv, self._ck, self._cv, 0, 0, b
-                )
-                self._ck, self._cv = self._prefix_seed_fn(
-                    self._ck, self._cv, self._pk, self._pv, 0, 0, b
-                )
-                k, v = self._prefix_offload_fn(self._pk, self._pv, 0, b)
-                self._ck, self._cv = self._restore_fn(
-                    self._ck, self._cv,
-                    kv_device(kv_host(k)), kv_device(kv_host(v)), 0,
-                )
-        if self._verify_fn is not None:
-            # Speculative family (spec_decode.py owns the operand set):
-            # pure verify, verify+decode fusion, and the mixed-spec
-            # twins under token-budget interleaving.
-            self._warmup_spec(gargs, sargs, zero)
-        # Placement bookkeeping runs a handful of tiny scatter programs
-        # (at[slot].set on tokens/positions/active/budget/stop_ids/keys);
-        # un-warmed, each costs a first-request compile round trip —
-        # directly inflating the FIRST measured TTFT. Touch them all.
-        # Scalar types must MATCH the request path exactly (weak-typed
-        # Python scalars for positions/temp/top_p/top_k/budget, a strong
-        # device int32 for tokens) — jit caches key on weak_type, so a
-        # jnp.int32 here would warm a different program than the one
-        # placement dispatches.
-        self._tokens = self._tokens.at[0].set(jnp.int32(0))
-        self._positions = self._positions.at[0].set(0)
-        self._active = self._active.at[0].set(True)
-        self._temp = self._temp.at[0].set(0.0)
-        self._top_p = self._top_p.at[0].set(1.0)
-        self._top_k = self._top_k.at[0].set(0)
-        self._budget = self._budget.at[0].set(1)
-        self._stop_ids = self._stop_ids.at[0].set(
-            jnp.asarray([-1] * MAX_DEVICE_STOP_IDS, jnp.int32)
-        )
-        self._key_data = self._key_data.at[0].set(kd)
-        if self._gr_on:
-            # Grammar placement scatters: FSM state + gate (the exact
-            # scalar-set programs placement dispatches). The table
-            # upload is NOT warmable here: placement writes [S, V] rows
-            # where S is each grammar's own state count — a different
-            # scatter shape per grammar — so a [max_states, V] set would
-            # trace a program placement never runs while transiently
-            # building a multi-GB host array at large vocabularies.
-            self._gstate = self._gstate.at[0].set(0)
-            self._gactive = self._gactive.at[0].set(True)
-        jax.block_until_ready(self._key_data)
-        # Restore everything warmup wrote (cache contents, PRNG streams,
-        # positions, metrics) so warmup cannot perturb request sampling.
-        self._init_device_state()
-        self.metrics.update(metrics_before)
-        logger.info(
-            "engine warmup done in %.1fs (%d decode variants, sessions=%s)",
-            time.monotonic() - t0, len(self._decode_fns), sessions,
-        )
 
     # ------------------------------------------------------------------
     # Submission API

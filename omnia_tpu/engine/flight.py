@@ -70,6 +70,20 @@ EVENTS = frozenset({
     "resubmit",        # coordinator re-placed a zero-token death
     "shed",            # coordinator shed before routing (fleet saturated)
     "terminal",        # request finished (attrs carry the breakdown)
+    # Cold-start phases (engine/coldstart.py): the submit-to-ready
+    # bring-up seams, so an accelerator hang is attributed to a PHASE
+    # (backend init vs weight streaming vs compile) instead of one
+    # opaque timeout. Recorded once per engine bring-up, request_id "".
+    "backend_init",    # accelerator backend observed up (engine built)
+    "weights_load",    # checkpoint streaming finished (attrs: bytes, seconds)
+    "warmup_compile",  # AOT program set compiled (attrs: programs, threads)
+    "warmup_restore",  # post-warmup pristine-state restore finished
+})
+
+#: The init-phase subset of EVENTS (``note_init_phase`` accepts only
+#: these; the Chrome export renders their ``seconds`` attr as duration).
+INIT_EVENTS = frozenset({
+    "backend_init", "weights_load", "warmup_compile", "warmup_restore",
 })
 
 # Microsecond-scale buckets for the per-dispatch histograms (host
@@ -313,6 +327,14 @@ class FlightRecorder:
         self.hist["dispatch_us"].observe(dispatch_s * 1e6)
         self.hist["sync_us"].observe(sync_s * 1e6)
 
+    def note_init_phase(self, kind: str, attrs: Optional[dict] = None) -> None:
+        """One cold-start phase completed (engine/coldstart.py seams):
+        ``seconds`` in attrs becomes the phase's duration row in the
+        Chrome export, so bring-up reads as a timeline next to the
+        request lifecycle instead of a silent gap before event 0."""
+        assert kind in INIT_EVENTS, f"not an init-phase event kind {kind!r}"
+        self._record(kind, "", dict(attrs or {}))
+
     def note_grammar_attach(self, request_id: str, num_states: int) -> None:
         self._record("grammar_attach", request_id, {"num_states": num_states})
 
@@ -450,6 +472,11 @@ def to_chrome_trace(events: list) -> dict:
     # land at a negative ts. Base on the earliest computed start.
     def start_of(e: dict) -> float:
         attrs = e.get("attrs", {})
+        if e["kind"] in INIT_EVENTS:
+            # Init-phase events are recorded at phase END with the
+            # phase's wall in `seconds` — the longest durations in any
+            # cold-start dump, so the base must account for them.
+            return e["mono"] - attrs.get("seconds", 0.0)
         return e["mono"] - attrs.get("dispatch_s", 0.0) - attrs.get("sync_s", 0.0)
 
     base = min(start_of(e) for e in evs)
@@ -478,6 +505,13 @@ def to_chrome_trace(events: list) -> dict:
         if kind in ("decode_chunk", "mixed_step", "prefill_piece",
                     "spec_verify"):
             dur = attrs.get("dispatch_s", 0.0) + attrs.get("sync_s", 0.0)
+            out.append({
+                "ph": "X", "pid": 1, "tid": 0, "name": kind,
+                "ts": us(e["mono"] - dur), "dur": round(dur * 1e6, 1),
+                "args": attrs,
+            })
+        elif kind in INIT_EVENTS:
+            dur = attrs.get("seconds", 0.0)
             out.append({
                 "ph": "X", "pid": 1, "tid": 0, "name": kind,
                 "ts": us(e["mono"] - dur), "dur": round(dur * 1e6, 1),

@@ -86,9 +86,25 @@ class MockEngine:
                  prefill_chunk_tokens: int = 0, flight_events: int = 0,
                  kv_pages: int = 0, kv_page_tokens: int = 64,
                  spec_decode: int = 0, spec_decode_max: int = 0,
-                 spec_gate_window: int = 0):
+                 spec_gate_window: int = 0, warmup_threads: int = 0,
+                 coldstart=None):
+        from omnia_tpu.engine.coldstart import ColdStartTracker
+
         self.scenarios = list(scenarios)
         self.tokenizer = tokenizer or ByteTokenizer()
+        # Cold-start parity (engine/coldstart.py): the mock has no
+        # programs to compile, but warmup() books the same phase spans,
+        # progress counters, and manifest hits/misses through the REAL
+        # tracker and manifest code — scripted output is untouched.
+        # warmup_threads is accepted (providers forward it to mock AND
+        # tpu engines) and mirrored into the ledger; with no compiles
+        # there is nothing to parallelize — the knob only affects which
+        # thread count the ledger reports.
+        if warmup_threads < 0:
+            raise ValueError("warmup_threads must be >= 0")
+        self.warmup_threads = warmup_threads
+        self._coldstart = coldstart or ColdStartTracker()
+        self._coldstart.end_phase("backend_init")
         self._req_counter = itertools.count()
         self._lock = threading.Lock()
         # Flight-recorder parity (engine/flight.py): the mock records
@@ -218,6 +234,18 @@ class MockEngine:
             ),
             "kv_page_fragmentation": 0.0,
             "kv_page_cow_copies": 0,
+            # Cold-start parity (engine/coldstart.py): warmup() books
+            # these through the real tracker/manifest machinery.
+            # compile_cache_enabled reads the same module state the
+            # engine reads (normally 0 in a jax-free mock process).
+            "compile_cache_enabled": 0,
+            "warmup_phase": 0,
+            "warmup_programs_total": 0,
+            "warmup_programs_done": 0,
+            "warmup_manifest_hits": 0,
+            "warmup_manifest_misses": 0,
+            "weights_bytes_total": 0,
+            "weights_bytes_loaded": 0,
         }
         self._gr_mask_sum = 0.0
         self._gr_mask_steps = 0
@@ -253,7 +281,57 @@ class MockEngine:
             )
 
     def warmup(self, sessions: bool = True):
-        pass
+        """Cold-start ledger parity with InferenceEngine.warmup(): the
+        same phase spans, progress counters, and manifest transaction
+        through the REAL coldstart machinery — with a one-entry pseudo
+        program inventory standing in for the compiled set (the mock
+        compiles nothing; a second mock with the same knobs reads the
+        manifest back as a hit). Playback behavior is untouched."""
+        from omnia_tpu.engine.coldstart import (
+            PHASE_CODES,
+            WarmupManifest,
+            manifest_bookkeeping,
+            manifest_dir,
+        )
+        from omnia_tpu.utils.compile_cache import enabled_dir
+
+        cs = self._coldstart
+        inventory = [f"playback:vocab{self.tokenizer.vocab_size}"]
+        cs.set_programs_total(len(inventory))
+        cs.begin_phase("warmup_compile")
+        key = WarmupManifest.manifest_key({
+            "backend": "mock",
+            "vocab": self.tokenizer.vocab_size,
+            "kv_quant": self.kv_quant,
+            "kv_pages": self.kv_pages,
+            "kv_page_tokens": self.kv_page_tokens,
+            "spec_decode": self.spec_decode,
+            "prefill_chunk_tokens": self.prefill_chunk_tokens,
+        })
+        hits, misses = manifest_bookkeeping(
+            manifest_dir(), key, inventory, cs, meta={"backend": "mock"},
+        )
+        done = cs.note_program(len(inventory))
+        seconds = cs.end_phase("warmup_compile")
+        cs.mark_ready()
+        if self._flight is not None:
+            # Same init-phase timeline shape as the real engine (the
+            # closed-vocabulary parity tests read both).
+            self._flight.note_init_phase("warmup_compile", {
+                "seconds": seconds, "programs": len(inventory),
+                "threads": self.warmup_threads, "manifest_hits": hits,
+                "manifest_misses": misses,
+            })
+        snap = cs.snapshot()
+        with self._lock:
+            self.metrics["compile_cache_enabled"] = 1 if enabled_dir() else 0
+            self.metrics["warmup_phase"] = PHASE_CODES["ready"]
+            self.metrics["warmup_programs_total"] = len(inventory)
+            self.metrics["warmup_programs_done"] = done
+            self.metrics["warmup_manifest_hits"] = hits
+            self.metrics["warmup_manifest_misses"] = misses
+            self.metrics["weights_bytes_total"] = snap["weights_bytes_total"]
+            self.metrics["weights_bytes_loaded"] = snap["weights_bytes_loaded"]
 
     def register_prefix(self, tokens) -> None:
         """Interface parity with InferenceEngine; the mock has no KV."""

@@ -90,15 +90,15 @@ class _PagedKVMixin:
 
     # -- device state ----------------------------------------------------
 
-    def _init_paged_state(self) -> None:
-        """(Re)allocate the page pool, tables, and allocator books —
-        the paged half of ``_init_device_state`` (crash recovery calls
-        it too: device pages died, host-paged sessions/prefixes keep
-        their rows)."""
+    def _alloc_paged_kv(self):
+        """Fresh (ck, cv) PagedKV pair — pool + all-trash tables — at
+        the engine's layout/sharding. Pure allocation (no allocator
+        books): shared by ``_init_paged_state`` and the parallel-warmup
+        worker states (engine/warmup.py), which chain donated paged
+        operands through their own pool copy."""
         cfg = self.cfg
-        ps = cfg.kv_page_tokens
         pool_k, pool_v = llama.init_kv_cache(
-            self.model_cfg, cfg.kv_pages, ps,
+            self.model_cfg, cfg.kv_pages, cfg.kv_page_tokens,
             dtype=self._dtype, kv_quant=self._kv_quant,
         )
         np_pos = cfg.num_page_positions()
@@ -112,9 +112,17 @@ class _PagedKVMixin:
             tree = named_sharding_tree((kspec, vspec), self._mesh)
             ck = jax.device_put(ck, tree[0])
             cv = jax.device_put(cv, tree[1])
-        self._ck, self._cv = ck, cv
+        return ck, cv
+
+    def _init_paged_state(self) -> None:
+        """(Re)allocate the page pool, tables, and allocator books —
+        the paged half of ``_init_device_state`` (crash recovery calls
+        it too: device pages died, host-paged sessions/prefixes keep
+        their rows)."""
+        cfg = self.cfg
+        self._ck, self._cv = self._alloc_paged_kv()
         self._pk = self._pv = None  # the prefix cache shares THIS pool
-        self._pages = PageAllocator(cfg.kv_pages, ps, cfg.num_slots)
+        self._pages = PageAllocator(cfg.kv_pages, cfg.kv_page_tokens, cfg.num_slots)
         if self._prefix_pool is not None:
             # Device page runs died with the pool; host-paged entries
             # survive — the paged edition of on_device_reset.
@@ -355,19 +363,3 @@ class _PagedKVMixin:
 
     # -- warmup ----------------------------------------------------------
 
-    def _warmup_paged(self) -> None:
-        """AOT-warm the paged-only programs (page copy, table-row sync,
-        and — with the prefix cache on — every page-run transfer
-        bucket). Runs against the all-trash warmup table; warmup's
-        closing ``_init_device_state`` rebuilds clean state."""
-        self._ck, self._cv = self._page_copy_fn(self._ck, self._cv, 0, 0)
-        self._sync_table_row(0)
-        if self._prefix_enabled():
-            for b in self.cfg.page_run_buckets():
-                idx = jnp.zeros((b,), jnp.int32)
-                k, v = self._gather_pages_fn(self._ck, self._cv, idx)
-                self._ck, self._cv = self._scatter_pages_fn(
-                    self._ck, self._cv, idx,
-                    kv_device(kv_host(k)), kv_device(kv_host(v)),
-                )
-        jax.block_until_ready(self._ck.table)

@@ -426,46 +426,6 @@ class _SpecDecodeMixin:
             return None
         return _SpecPlan(toks, pos, wstart, vmask, proposals, scan)
 
-    def _warmup_spec(self, gargs, sargs, zero) -> None:
-        """AOT-warm the speculative program family at the static
-        [B, W+1] window with the request path's exact operand types
-        (called from engine.warmup; device state is restored after)."""
-        import jax.numpy as jnp
-
-        B, K1 = self.cfg.num_slots, self.cfg.spec_window() + 1
-        vtoks = jnp.zeros((B, K1), jnp.int32)
-        vpos = jnp.broadcast_to(jnp.arange(K1, dtype=jnp.int32)[None], (B, K1))
-        vstart = jnp.zeros((B,), jnp.int32)
-        vmask = jnp.zeros((B,), jnp.bool_)
-        self._ck, self._cv, _ = self._verify_fn(
-            self.params, self._ck, self._cv, vtoks, vpos, vstart, *gargs
-        )
-        out = self._verify_decode_fn(
-            self.params, self._ck, self._cv, self._tokens, self._positions,
-            self._active, self._budget, self._stop_ids, self._key_data,
-            self._temp, self._top_p, self._top_k,
-            vtoks, vpos, vstart, vmask, *gargs,
-        )
-        self._ck, self._cv = out[0], out[1]
-        for b in sorted(self._mixed_spec_fns):
-            toks = jnp.zeros((1, b), jnp.int32)
-            pos = jnp.arange(b, dtype=jnp.int32)[None, :]
-            out = self._mixed_spec_fns[b](
-                self.params, self._ck, self._cv, self._tokens,
-                self._positions, self._active, self._budget, self._stop_ids,
-                self._key_data, self._temp, self._top_p, self._top_k,
-                toks, pos, zero, zero, vtoks, vpos, vstart, vmask, *gargs,
-            )
-            self._ck, self._cv = out[0], out[1]
-            out = self._mixed_spec_sample_fns[b](
-                self.params, self._ck, self._cv, self._tokens,
-                self._positions, self._active, self._budget, self._stop_ids,
-                self._key_data, self._temp, self._top_p, self._top_k,
-                toks, pos, zero, zero, vtoks, vpos, vstart, vmask,
-                jnp.int32(b - 1), *sargs, *gargs,
-            )
-            self._ck, self._cv = out[0], out[1]
-
     def _spec_step(self) -> bool:
         """Try one speculative step from the scheduler (no prefill piece
         in flight). Returns True when this method did the step's work;

@@ -341,6 +341,22 @@ class EngineConfig:
     # 0 (default) is a guarded true no-op: no mixed programs are built
     # and the scheduler keeps the exact prefill-first paths.
     prefill_chunk_tokens: int = 0
+    # Parallel AOT warmup (engine/warmup.py): > 0 dispatches warmup's
+    # independent compile tasks (decode variants, prefill/extend
+    # buckets, mixed pieces, session/prefix/page transfers, the spec
+    # family) across a bounded pool of this many threads — XLA
+    # compilation releases the GIL, so a cold start compiles N program
+    # families concurrently instead of one at a time. Each concurrent
+    # worker chains donated KV operands through its OWN scratch cache
+    # copy, so peak warmup device memory grows by up to
+    # (warmup_threads - 1) x the KV allocation; size it to spare HBM.
+    # The compiled program set, the traced signatures, and the
+    # post-warmup state restore are IDENTICAL to serial warmup
+    # (tests/test_coldstart.py pins both). 0 (default) is a guarded
+    # true no-op: no executor, no scratch caches, the exact serial
+    # warmup order (the knob is never read at trace time, so lowered
+    # programs are byte-identical across values).
+    warmup_threads: int = 0
     # Engine flight recorder (engine/flight.py): capacity of the
     # fixed-size ring buffer of lifecycle events (submit/claim/placement/
     # prefill piece/mixed step/decode chunk/offload/restore/terminal)

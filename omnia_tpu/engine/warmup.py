@@ -1,0 +1,606 @@
+"""AOT warmup: the serving program set compiled before readiness.
+
+The engine's TTFT discipline — no compile on the request path — makes
+cold start pay the full compile bill up front, and until this module the
+bill was strictly serial: every (program family, bucket shape) compiled
+one at a time inside ``InferenceEngine.warmup()``. This mixin owns the
+warmup pipeline:
+
+- **One task list, two executions.** ``_warmup_tasks`` enumerates every
+  (family, shape) as a self-contained closure over a :class:`_WarmupState`
+  (the donated KV operands a warmup call chains through). With
+  ``EngineConfig.warmup_threads == 0`` the tasks run in order on the
+  caller thread against the engine's own cache arrays — the serial path,
+  a guarded true no-op. With ``warmup_threads = N`` they run across a
+  bounded thread pool: XLA compilation releases the GIL, so N program
+  families compile concurrently. Each concurrent worker chains donated
+  operands through its OWN scratch cache copy (``_alloc_kv_state``), so
+  donation never sees a buffer twice; all non-donated operands (params,
+  the per-slot vectors, grammar tables) are shared read-only. The traced
+  signatures are identical either way — jit keys on avals, not on which
+  thread dispatched — so serial and parallel warmup produce the same
+  compiled program set and the same post-warmup state
+  (tests/test_coldstart.py pins both).
+
+- **Manifest + progress.** Every warmup runs the manifest transaction
+  (:func:`~omnia_tpu.engine.coldstart.manifest_bookkeeping`): the
+  persisted program list for this config key says whether this start is
+  a warm restore (persistent compile cache should serve every listed
+  shape) or a cold compile, and the ``warmup_*`` metrics mirror the
+  tracker so readiness progress is observable mid-warmup.
+
+- **Param-free overlap.** ``_warmup_paramfree`` warms the families that
+  take no model params (session offload/restore, prefix-pool transfers,
+  page-run programs) — the engine runs it on a side thread while the
+  checkpoint loader streams weights (``_load_params_overlapped``), so a
+  checkpoint-backed cold start pays max(weights, KV-program compiles)
+  for those families instead of their sum.
+
+Behavior-neutral like the serial warmup always was: all device state and
+metrics warmup touched are restored afterwards (``warmup_restore``
+phase), so warmup cannot perturb request sampling.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import threading
+import time
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+from omnia_tpu.engine.coldstart import (
+    PHASE_CODES,
+    WarmupManifest,
+    manifest_bookkeeping,
+    manifest_dir,
+)
+from omnia_tpu.engine.types import MAX_DEVICE_STOP_IDS, SamplingParams
+from omnia_tpu.models.kv_quant import kv_device, kv_host
+
+logger = logging.getLogger(__name__)
+
+#: Families whose programs take no model params — compilable while the
+#: checkpoint is still streaming (the weight/compile overlap set).
+PARAMFREE_FAMILIES = frozenset({"session", "prefix", "pages"})
+
+
+class _WarmupState:
+    """The donated operands one warmup worker chains its calls through:
+    the slot KV pair and (when the pool exists) the prefix-pool pair.
+    Everything else a warmup call takes is shared read-only self state."""
+
+    __slots__ = ("ck", "cv", "pk", "pv")
+
+    def __init__(self, ck, cv, pk=None, pv=None):
+        self.ck, self.cv, self.pk, self.pv = ck, cv, pk, pv
+
+
+class _WarmupMixin:
+    """Warmup pipeline methods of :class:`InferenceEngine`."""
+
+    # -- task inventory --------------------------------------------------
+
+    def _warmup_tasks(
+        self, sessions: bool, families: Optional[frozenset] = None
+    ) -> list[tuple[str, str, Callable]]:
+        """The (family, shape-key, closure) inventory for one warmup.
+        Closures defer every self-state read to call time, so the
+        param-free subset is buildable before device state exists.
+        Each closure mirrors the corresponding serial warmup call
+        EXACTLY (operand sources and scalar types included — jit caches
+        key on weak_type, so a drifted scalar would warm a program the
+        request path never dispatches)."""
+        cfg = self.cfg
+        tasks: list[tuple[str, str, Callable]] = []
+
+        def add(family: str, key: str, fn: Callable) -> None:
+            if families is None or family in families:
+                tasks.append((family, key, fn))
+
+        def sargs():
+            # First-token sampling operands (the prefill/extend/mixed
+            # `*sargs` tail): per-slot key data + greedy scalars, plus
+            # the zero grammar bias when support is on (the request
+            # path ALWAYS passes the bias operand then).
+            out = (
+                self._key_data[0], jnp.float32(0.0), jnp.float32(1.0),
+                jnp.int32(0),
+            )
+            if self._gr_on:
+                out = out + (self._gbias_zero,)
+            return out
+
+        def gargs():
+            return (
+                (self._gstate, self._gtable, self._gactive)
+                if self._gr_on else ()
+            )
+
+        def decode_task(k):
+            def run(st):
+                fn = self._decode_fns[k]
+                args = (
+                    self.params, st.ck, st.cv, self._tokens,
+                    self._positions, self._active, self._budget,
+                    self._stop_ids, self._key_data, self._temp,
+                    self._top_p, self._top_k,
+                )
+                out = fn(*args, *gargs())
+                st.ck, st.cv = out[0], out[1]
+            return run
+
+        for k in sorted(self._decode_fns, reverse=True):
+            add("decode", f"chunk{k}", decode_task(k))
+
+        usable = set(cfg.usable_buckets())
+        # Suffix prefill after a shared-prefix seed rides the extend
+        # family, so an enabled pool warms it even for sessionless
+        # serving (the bench's shared-prefix scenario).
+        extend_shapes = (
+            usable | {1}
+            if sessions or cfg.prefix_cache_slots > 0
+            else set()
+        )
+
+        def bucket_task(b):
+            def run(st):
+                zero = jnp.int32(0)
+                toks = jnp.zeros((1, b), jnp.int32)
+                pos = jnp.arange(b, dtype=jnp.int32)[None, :]
+                if b in usable:
+                    st.ck, st.cv, _, _ = self._prefill_insert_fn(
+                        self.params, st.ck, st.cv, toks, pos, zero,
+                        jnp.int32(b - 1), *sargs()
+                    )
+                    if (
+                        self._prefill_ring_fn is not None
+                        and b >= cfg.long_prefill_threshold
+                        and b % cfg.sp == 0
+                    ):
+                        logits, k_chunk, v_chunk = self._prefill_ring_fn(
+                            self.params, toks, pos
+                        )
+                        sp = SamplingParams()
+                        out = self._insert_fn(
+                            st.ck, st.cv, k_chunk, v_chunk, 0,
+                            logits[:, -1], self._sampling_key(0, sp),
+                            jnp.float32(sp.temperature),
+                            jnp.float32(sp.top_p), jnp.int32(sp.top_k),
+                            *self._grammar_args(None, sp),
+                        )
+                        st.ck, st.cv = out[0], out[1]
+                if b in extend_shapes:
+                    st.ck, st.cv = self._extend_nosample_fn(
+                        self.params, st.ck, st.cv, toks, pos, zero, zero
+                    )
+                    st.ck, st.cv, _, _ = self._extend_fn(
+                        self.params, st.ck, st.cv, toks, pos, zero, zero,
+                        zero, *sargs()
+                    )
+            return run
+
+        for b in sorted(usable | extend_shapes):
+            add("prefill", f"bucket{b}", bucket_task(b))
+
+        def mixed_task(b):
+            def run(st):
+                # Fused mixed prefill+decode steps (token-budget
+                # interleaving): both variants per piece bucket with
+                # the request path's exact operand types.
+                zero = jnp.int32(0)
+                toks = jnp.zeros((1, b), jnp.int32)
+                pos = jnp.arange(b, dtype=jnp.int32)[None, :]
+
+                def common(st):
+                    # Re-read st per call: the caches are DONATED, so
+                    # the first dispatch consumes the pair the closure
+                    # would otherwise have captured.
+                    return (
+                        self.params, st.ck, st.cv, self._tokens,
+                        self._positions, self._active, self._budget,
+                        self._stop_ids, self._key_data, self._temp,
+                        self._top_p, self._top_k, toks, pos, zero, zero,
+                    )
+
+                out = self._mixed_fns[b](*common(st), *gargs())
+                st.ck, st.cv = out[0], out[1]
+                out = self._mixed_sample_fns[b](
+                    *common(st), jnp.int32(b - 1), *sargs(), *gargs()
+                )
+                st.ck, st.cv = out[0], out[1]
+            return run
+
+        for b in cfg.mixed_prefill_buckets():
+            add("mixed", f"bucket{b}", mixed_task(b))
+
+        if sessions:
+            def session_task(r):
+                def run(st):
+                    zero = jnp.int32(0)
+                    k, v = self._offload_fn(st.ck, st.cv, zero, r)
+                    st.ck, st.cv = self._restore_fn(st.ck, st.cv, k, v, zero)
+                return run
+
+            for r in cfg.restore_buckets():
+                add("session", f"rows{r}", session_task(r))
+
+        if cfg.kv_pages > 0:
+            # Paged-only programs: page copy (CoW), the fixed-shape
+            # table-row sync, and the prefix host-tier page-run
+            # transfer buckets. All run against all-trash state; the
+            # closing restore rebuilds clean books.
+            def page_copy_task(st):
+                from omnia_tpu.models.paged_kv import PagedKV
+
+                st.ck, st.cv = self._page_copy_fn(st.ck, st.cv, 0, 0)
+                row = jnp.zeros((cfg.num_page_positions(),), jnp.int32)
+                st.ck = PagedKV(st.ck.pool, st.ck.table.at[0].set(row))
+                st.cv = PagedKV(st.cv.pool, st.cv.table.at[0].set(row))
+
+            add("pages", "copy", page_copy_task)
+            if cfg.prefix_cache_slots > 0:
+                def page_run_task(b):
+                    def run(st):
+                        idx = jnp.zeros((b,), jnp.int32)
+                        k, v = self._gather_pages_fn(st.ck, st.cv, idx)
+                        st.ck, st.cv = self._scatter_pages_fn(
+                            st.ck, st.cv, idx,
+                            kv_device(kv_host(k)), kv_device(kv_host(v)),
+                        )
+                    return run
+
+                for b in cfg.page_run_buckets():
+                    add("pages", f"run{b}", page_run_task(b))
+
+        if cfg.prefix_cache_slots > 0 and self._prefix_store_fn is not None:
+            # Pool transfers per prefix bucket: store (slot→pool), seed
+            # (pool→slot), demote (pool→host), and the host-hit restore
+            # path with the SAME scalar types placement dispatches
+            # (python-int slot/pool indices, static row bucket). Absent
+            # under kv_pages — the paged prefix cache is table rewrites
+            # plus the page-run programs above.
+            def prefix_task(b):
+                def run(st):
+                    st.pk, st.pv = self._prefix_store_fn(
+                        st.pk, st.pv, st.ck, st.cv, 0, 0, b
+                    )
+                    st.ck, st.cv = self._prefix_seed_fn(
+                        st.ck, st.cv, st.pk, st.pv, 0, 0, b
+                    )
+                    k, v = self._prefix_offload_fn(st.pk, st.pv, 0, b)
+                    st.ck, st.cv = self._restore_fn(
+                        st.ck, st.cv,
+                        kv_device(kv_host(k)), kv_device(kv_host(v)), 0,
+                    )
+                return run
+
+            for b in cfg.prefix_buckets():
+                add("prefix", f"bucket{b}", prefix_task(b))
+
+        if self._verify_fn is not None:
+            # Speculative family (spec_decode.py owns the operand set):
+            # pure verify + verify+decode fusion in one task, the
+            # mixed-spec twins per piece bucket.
+            def spec_window_operands():
+                B, K1 = cfg.num_slots, cfg.spec_window() + 1
+                vtoks = jnp.zeros((B, K1), jnp.int32)
+                vpos = jnp.broadcast_to(
+                    jnp.arange(K1, dtype=jnp.int32)[None], (B, K1)
+                )
+                vstart = jnp.zeros((B,), jnp.int32)
+                vmask = jnp.zeros((B,), jnp.bool_)
+                return vtoks, vpos, vstart, vmask
+
+            def verify_task(st):
+                vtoks, vpos, vstart, vmask = spec_window_operands()
+                st.ck, st.cv, _ = self._verify_fn(
+                    self.params, st.ck, st.cv, vtoks, vpos, vstart, *gargs()
+                )
+                out = self._verify_decode_fn(
+                    self.params, st.ck, st.cv, self._tokens,
+                    self._positions, self._active, self._budget,
+                    self._stop_ids, self._key_data, self._temp,
+                    self._top_p, self._top_k, vtoks, vpos, vstart, vmask,
+                    *gargs(),
+                )
+                st.ck, st.cv = out[0], out[1]
+
+            add("spec", "verify", verify_task)
+
+            def mixed_spec_task(b):
+                def run(st):
+                    zero = jnp.int32(0)
+                    vtoks, vpos, vstart, vmask = spec_window_operands()
+                    toks = jnp.zeros((1, b), jnp.int32)
+                    pos = jnp.arange(b, dtype=jnp.int32)[None, :]
+
+                    def common(st):
+                        # Donated caches: re-read st per call (see
+                        # mixed_task above).
+                        return (
+                            self.params, st.ck, st.cv, self._tokens,
+                            self._positions, self._active, self._budget,
+                            self._stop_ids, self._key_data, self._temp,
+                            self._top_p, self._top_k, toks, pos, zero,
+                            zero, vtoks, vpos, vstart, vmask,
+                        )
+
+                    out = self._mixed_spec_fns[b](*common(st), *gargs())
+                    st.ck, st.cv = out[0], out[1]
+                    out = self._mixed_spec_sample_fns[b](
+                        *common(st), jnp.int32(b - 1), *sargs(), *gargs(),
+                    )
+                    st.ck, st.cv = out[0], out[1]
+                return run
+
+            for b in sorted(self._mixed_spec_fns):
+                add("spec", f"mixed{b}", mixed_spec_task(b))
+
+        return tasks
+
+    # -- worker states ---------------------------------------------------
+
+    def _alloc_warmup_state(self) -> _WarmupState:
+        """A fresh scratch state at the engine's exact layout/sharding —
+        what each ADDITIONAL parallel warmup worker chains its donated
+        operands through (worker 0 steals the engine's own arrays; the
+        closing restore reallocates them regardless)."""
+        ck, cv, pk, pv = self._alloc_kv_state()
+        return _WarmupState(ck, cv, pk, pv)
+
+    def _run_warmup_serial(self, tasks) -> list[_WarmupState]:
+        st = _WarmupState(self._ck, self._cv, self._pk, self._pv)
+        for _family, _key, fn in tasks:
+            fn(st)
+            self.metrics["warmup_programs_done"] = self._coldstart.note_program()
+        return [st]
+
+    def _run_warmup_parallel(self, tasks, threads: int) -> list[_WarmupState]:
+        """Dispatch the task list over a bounded pool. States are pooled
+        through a queue: at most `threads` workers run at once, so at
+        most `threads` states (one of them the engine's own arrays) are
+        ever allocated — the documented peak-memory bound."""
+        import queue as queue_mod
+        from concurrent.futures import ThreadPoolExecutor
+
+        states: list[_WarmupState] = [
+            _WarmupState(self._ck, self._cv, self._pk, self._pv)
+        ]
+        idle: "queue_mod.SimpleQueue[_WarmupState]" = queue_mod.SimpleQueue()
+        idle.put(states[0])
+        states_lock = threading.Lock()
+
+        def run(task):
+            _family, _key, fn = task
+            try:
+                st = idle.get_nowait()
+            except queue_mod.Empty:
+                st = self._alloc_warmup_state()
+                with states_lock:
+                    states.append(st)
+            try:
+                fn(st)
+            finally:
+                idle.put(st)
+            self.metrics["warmup_programs_done"] = self._coldstart.note_program()
+
+        with ThreadPoolExecutor(
+            max_workers=threads, thread_name_prefix="omnia-warmup"
+        ) as pool:
+            futures = [pool.submit(run, t) for t in tasks]
+            for f in futures:
+                f.result()  # propagate the first failure
+        return states
+
+    # -- manifest --------------------------------------------------------
+
+    def _warmup_manifest_key(self) -> str:
+        """Content key of everything that determines the compiled
+        program set and its lowerings: the model config, the mesh
+        shape, the bucket sets, and the KV knobs. Host-side-only knobs
+        (thread counts, ring capacities, admission bounds) are excluded
+        — they change no traced program, so a restart that only tunes
+        them still reads the same manifest."""
+        ecfg = dataclasses.asdict(self.cfg)
+        for host_only in (
+            "warmup_threads", "flight_events", "max_queue", "watchdog_s",
+            "decode_pipeline", "spec_gate_window",
+        ):
+            ecfg.pop(host_only, None)
+        return WarmupManifest.manifest_key({
+            "model": dataclasses.asdict(self.model_cfg),
+            "engine": ecfg,
+            "backend": jax.default_backend(),
+        })
+
+    # -- overlap with weight streaming ----------------------------------
+
+    def _warmup_paramfree(self) -> None:
+        """Compile the param-free families (session/prefix/page KV
+        transfers) on a scratch state — safe before model params exist,
+        which is exactly when it runs: on a side thread while the
+        checkpoint loader streams weights. The later full warmup()
+        re-dispatches these families and finds their jit caches warm."""
+        tasks = self._warmup_tasks(
+            sessions=self.cfg.max_sessions > 0, families=PARAMFREE_FAMILIES
+        )
+        if not tasks:
+            return
+        st = self._alloc_warmup_state()
+        for _family, _key, fn in tasks:
+            fn(st)
+        jax.block_until_ready((st.ck, st.cv))
+
+    def _load_params_overlapped(self, loader: Callable):
+        """Run the params loader with weight-streaming progress tracked,
+        overlapping the param-free program compiles on a side thread —
+        a checkpoint-backed cold start pays max(weights, KV-transfer
+        compiles) for those families instead of their sum. Loaders that
+        accept ``progress_cb`` get per-tensor byte progress
+        (models/checkpoint.load_params does)."""
+        import inspect
+
+        cs = self._coldstart
+        cs.begin_phase("weights_load")
+        t = threading.Thread(
+            target=self._overlap_guarded, name="omnia-warmup-overlap",
+            daemon=True,
+        )
+        t.start()
+        try:
+            kwargs = {}
+            try:
+                if "progress_cb" in inspect.signature(loader).parameters:
+                    kwargs["progress_cb"] = cs.note_weights
+            except (TypeError, ValueError):
+                pass  # builtins/partials without a signature: no progress
+            params = loader(**kwargs)
+        finally:
+            t.join()
+        seconds = cs.end_phase("weights_load")
+        if self._flight is not None:
+            snap = cs.snapshot()
+            self._flight.note_init_phase("weights_load", {
+                "seconds": seconds,
+                "bytes": snap["weights_bytes_loaded"],
+            })
+        return params
+
+    def _overlap_guarded(self) -> None:
+        try:
+            self._warmup_paramfree()
+        except Exception:
+            # The overlap is an optimization: a failure here only means
+            # the full warmup pays these compiles serially later.
+            logger.warning(
+                "param-free warmup overlap failed; warmup() will compile "
+                "those families serially", exc_info=True,
+            )
+
+    # -- orchestrator ----------------------------------------------------
+
+    def warmup(self, sessions: bool = True):
+        """AOT-compile decode (all chunk variants) + all usable prefill
+        buckets + the sessionful extend/offload/restore programs (called
+        before ready — the request path must never hit a compile).
+        Behavior-neutral: all device state and metrics it touched are
+        restored afterwards.
+
+        sessions=False skips the extend/offload/restore family — only
+        valid for serving without session KV reuse AND with every prompt
+        fitting the largest prefill bucket (the chunked-prefill path uses
+        extend too). The bench uses it to keep warmup inside the driver
+        budget on a cold compile cache.
+
+        With ``EngineConfig.warmup_threads > 0`` the compile tasks run
+        across a bounded thread pool (same program set, same traced
+        signatures, same restored state — just concurrent compiles);
+        progress is observable mid-warmup through the ``warmup_*``
+        metrics and the cold-start tracker."""
+        t0 = time.monotonic()
+        cs = self._coldstart
+        metrics_before = dict(self.metrics)
+        tasks = self._warmup_tasks(sessions)
+        cs.set_programs_total(len(tasks))
+        cs.begin_phase("warmup_compile")
+        self.metrics["warmup_phase"] = PHASE_CODES["warmup_compile"]
+        self.metrics["warmup_programs_total"] = len(tasks)
+        self.metrics["warmup_programs_done"] = 0
+
+        program_keys = [f"{family}:{key}" for family, key, _fn in tasks]
+        hits, misses = manifest_bookkeeping(
+            manifest_dir(), self._warmup_manifest_key(), program_keys, cs,
+            meta={"model": self.model_cfg.name,
+                  "backend": jax.default_backend()},
+        )
+        self.metrics["warmup_manifest_hits"] = hits
+        self.metrics["warmup_manifest_misses"] = misses
+
+        threads = max(int(self.cfg.warmup_threads), 0)
+        if threads <= 0:
+            states = self._run_warmup_serial(tasks)
+        else:
+            states = self._run_warmup_parallel(tasks, threads)
+        for st in states:
+            # Donated chains may still be executing asynchronously;
+            # the compile phase ends when the device is quiesced.
+            jax.block_until_ready((st.ck, st.cv))
+        compile_s = cs.end_phase("warmup_compile")
+        if self._flight is not None:
+            self._flight.note_init_phase("warmup_compile", {
+                "seconds": compile_s, "programs": len(tasks),
+                "threads": threads, "manifest_hits": hits,
+                "manifest_misses": misses,
+            })
+
+        self._warmup_scatters()
+
+        cs.begin_phase("warmup_restore")
+        self.metrics["warmup_phase"] = PHASE_CODES["warmup_restore"]
+        # Restore everything warmup wrote (cache contents, PRNG streams,
+        # positions, metrics) so warmup cannot perturb request sampling.
+        self._init_device_state()
+        self.metrics.update(metrics_before)
+        restore_s = cs.end_phase("warmup_restore")
+        cs.mark_ready()
+        self._sync_coldstart_metrics()
+        if self._flight is not None:
+            self._flight.note_init_phase(
+                "warmup_restore", {"seconds": restore_s}
+            )
+        logger.info(
+            "engine warmup done in %.1fs (%d programs, %d decode variants, "
+            "threads=%d, manifest %d hit / %d miss, sessions=%s)",
+            time.monotonic() - t0, len(tasks), len(self._decode_fns),
+            threads, hits, misses, sessions,
+        )
+
+    def _warmup_scatters(self) -> None:
+        """Placement bookkeeping runs a handful of tiny scatter programs
+        (at[slot].set on tokens/positions/active/budget/stop_ids/keys);
+        un-warmed, each costs a first-request compile round trip —
+        directly inflating the FIRST measured TTFT. Touch them all.
+        Scalar types must MATCH the request path exactly (weak-typed
+        Python scalars for positions/temp/top_p/top_k/budget, a strong
+        device int32 for tokens) — jit caches key on weak_type, so a
+        jnp.int32 here would warm a different program than the one
+        placement dispatches."""
+        kd = self._key_data[0]
+        self._tokens = self._tokens.at[0].set(jnp.int32(0))
+        self._positions = self._positions.at[0].set(0)
+        self._active = self._active.at[0].set(True)
+        self._temp = self._temp.at[0].set(0.0)
+        self._top_p = self._top_p.at[0].set(1.0)
+        self._top_k = self._top_k.at[0].set(0)
+        self._budget = self._budget.at[0].set(1)
+        self._stop_ids = self._stop_ids.at[0].set(
+            jnp.asarray([-1] * MAX_DEVICE_STOP_IDS, jnp.int32)
+        )
+        self._key_data = self._key_data.at[0].set(kd)
+        if self._gr_on:
+            # Grammar placement scatters: FSM state + gate (the exact
+            # scalar-set programs placement dispatches). The table
+            # upload is NOT warmable here: placement writes [S, V] rows
+            # where S is each grammar's own state count — a different
+            # scatter shape per grammar — so a [max_states, V] set would
+            # trace a program placement never runs while transiently
+            # building a multi-GB host array at large vocabularies.
+            self._gstate = self._gstate.at[0].set(0)
+            self._gactive = self._gactive.at[0].set(True)
+        jax.block_until_ready(self._key_data)
+
+    def _sync_coldstart_metrics(self) -> None:
+        """Mirror the tracker into the stable metrics keys (the warmup
+        progress surface dashboards and the Health wire read)."""
+        snap = self._coldstart.snapshot()
+        self.metrics["warmup_phase"] = snap["phase_code"]
+        self.metrics["warmup_programs_total"] = snap["programs_total"]
+        self.metrics["warmup_programs_done"] = snap["programs_done"]
+        self.metrics["warmup_manifest_hits"] = snap["manifest_hits"]
+        self.metrics["warmup_manifest_misses"] = snap["manifest_misses"]
+        self.metrics["weights_bytes_total"] = snap["weights_bytes_total"]
+        self.metrics["weights_bytes_loaded"] = snap["weights_bytes_loaded"]

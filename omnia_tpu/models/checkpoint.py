@@ -240,12 +240,29 @@ _MOE = {
 # ---------------------------------------------------------------------------
 
 
+def expected_param_bytes(cfg: ModelConfig, dtype=jnp.bfloat16) -> int:
+    """Host bytes ``load_params`` will stream for this config at `dtype`
+    (pre-quantization — what actually crosses from the checkpoint).
+    The denominator of the loader's byte-level progress callback."""
+    D, F, V, L = cfg.hidden_size, cfg.ffn_hidden_size, cfg.vocab_size, cfg.num_layers
+    per_layer = 2 * D + D * cfg.q_dim + 2 * (D * cfg.kv_dim) + cfg.q_dim * D
+    if cfg.is_moe:
+        per_layer += D * cfg.num_experts + cfg.num_experts * (2 * D * F + F * D)
+    else:
+        per_layer += 2 * D * F + F * D
+    elements = V * D + L * per_layer + D
+    if not cfg.tie_embeddings:
+        elements += D * V
+    return elements * np.dtype(_np_dtype(dtype)).itemsize
+
+
 def load_params(
     path: str,
     cfg: Optional[ModelConfig] = None,
     dtype=jnp.bfloat16,
     mesh=None,
     quant: Optional[str] = None,
+    progress_cb=None,
 ):
     """Load an HF-layout llama/mixtral checkpoint into the stacked pytree.
 
@@ -258,9 +275,16 @@ def load_params(
     and only the int8 tensor + scales are device_put — the full-precision
     tree never lands in HBM, which is what makes Llama-3-8B fit one 16 GB
     chip.
+
+    With ``progress_cb``, ``progress_cb(loaded_bytes, total_bytes)`` is
+    invoked after every streamed tensor — the cold-start tracker's
+    weight-streaming progress feed (engine/coldstart.py), so readiness
+    probes can report "1.2 of 16 GB loaded" instead of a silent gap.
     """
     cfg = cfg or read_config(path)
     np_dt = _np_dtype(dtype)
+    total_bytes = expected_param_bytes(cfg, dtype)
+    loaded_bytes = 0
     reader = _ShardReader(path)
     specs = param_specs(cfg)
     if quant is not None:
@@ -290,6 +314,7 @@ def load_params(
         return put(np.asarray(arr, dtype=np_dt), spec)
 
     def fetch(name: str, want_shape: tuple, transpose: bool) -> np.ndarray:
+        nonlocal loaded_bytes
         t = reader.get(name)
         if transpose:
             t = t.T  # torch Linear [out,in] → right-multiply [in,out]
@@ -298,6 +323,12 @@ def load_params(
                 f"{name}: shape {tuple(t.shape)} != expected {want_shape}"
                 f"{' (after transpose)' if transpose else ''}"
             )
+        if progress_cb is not None:
+            # Meter at the TARGET dtype (what expected_param_bytes
+            # counted), not the checkpoint's on-disk dtype — the two can
+            # differ, and the progress bar must reach exactly 100%.
+            loaded_bytes += t.size * np.dtype(np_dt).itemsize
+            progress_cb(loaded_bytes, total_bytes)
         return t
 
     def single(name: str, shape: tuple, spec, transpose: bool = False):

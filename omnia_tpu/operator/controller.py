@@ -27,6 +27,24 @@ from omnia_tpu.operator.store import ResourceStore
 logger = logging.getLogger(__name__)
 
 
+def warmup_progress_message(warmup: dict) -> str:
+    """Render a Health.warmup snapshot (engine/coldstart.py) into the
+    one-line staged-readiness condition message the operator writes —
+    e.g. ``phase=warmup_compile, programs 12/40, weights 1.2/16.1 GB``.
+    Tolerates partial/empty dicts (legacy runtimes send no warmup)."""
+    if not warmup:
+        return "phase=unknown (runtime reports no warmup progress)"
+    parts = [f"phase={warmup.get('phase', 'unknown')}"]
+    total = int(warmup.get("programs_total") or 0)
+    if total:
+        parts.append(f"programs {int(warmup.get('programs_done') or 0)}/{total}")
+    wtotal = int(warmup.get("weights_bytes_total") or 0)
+    if wtotal:
+        loaded = int(warmup.get("weights_bytes_loaded") or 0)
+        parts.append(f"weights {loaded / 1e9:.1f}/{wtotal / 1e9:.1f} GB")
+    return ", ".join(parts)
+
+
 class ControllerManager(_SourceReconcilersMixin):
     def __init__(
         self,
@@ -504,7 +522,21 @@ class ControllerManager(_SourceReconcilersMixin):
             dep.gate_blocked_hash = ""  # config changed: re-admit and re-probe
             if not dep.pods and not dep.candidate_pods:
                 self.backend.scale(dep, max(1, dep.replicas), wait_ready=self.wait_ready)
-        gated, missing = self._capability_gate(dep)
+        gated, missing, warming = self._capability_gate(dep)
+        if warming is not None:
+            # Staged readiness (engine/coldstart.py → Health.warmup): the
+            # runtime is still warming — surface WHICH phase and how far
+            # instead of silently re-probing until a 600 s timeout, and
+            # don't gate on capabilities it cannot advertise yet. The
+            # next resync re-probes; progress updates in place.
+            self._write_status(
+                res, dep, phase="Starting",
+                conditions=[{
+                    "type": "CapabilitiesSatisfied", "status": "Unknown",
+                    "message": f"runtime warming up: {warming}",
+                }],
+            )
+            return
         if gated:
             dep.gate_blocked_hash = gate_key
             self.backend.scale(dep, 0)
@@ -585,12 +617,16 @@ class ControllerManager(_SourceReconcilersMixin):
         return req
 
     def _capability_gate(self, dep: AgentDeployment):
-        """Probe the first live runtime's Health; gate if its advertised
-        capabilities miss anything required. No pods yet → not gated
+        """Probe the first live runtime's Health; returns
+        ``(gated, missing, warming)``. Gate if advertised capabilities
+        miss anything required; ``warming`` (a progress string) is
+        non-None while the runtime reports "initializing" — the staged
+        cold-start signal, during which capability absence means
+        "not ready yet", never "missing". No pods yet → not gated
         (nothing to probe; scale-up proceeds and the next resync probes)."""
         pods = dep.pods + dep.candidate_pods
         if not pods:
-            return False, []
+            return False, [], None
         from omnia_tpu.runtime.client import RuntimeClient
 
         try:
@@ -601,9 +637,13 @@ class ControllerManager(_SourceReconcilersMixin):
                 client.close()
         except Exception as e:
             logger.warning("capability probe failed for %s: %s", dep.name, e)
-            return False, []  # unreachable ≠ missing; retry next resync
+            return False, [], None  # unreachable ≠ missing; retry next resync
+        if h.status == "initializing":
+            return False, [], warmup_progress_message(
+                getattr(h, "warmup", None) or {}
+            )
         missing = sorted(set(dep.required_capabilities) - set(h.capabilities))
-        return (True, missing) if missing else (False, [])
+        return bool(missing), missing, None
 
     def _autoscale(self, key: str, dep: AgentDeployment) -> None:
         policy = AutoscalingPolicy.from_spec(

@@ -203,6 +203,12 @@ class HealthResponse:
     # so HTTP facades (REST, MCP tools/list) can enumerate callable
     # functions without a pack copy of their own.
     functions: list[dict] = field(default_factory=list)
+    # Staged readiness (engine/coldstart.py snapshot): while status is
+    # "initializing" this carries phase / weights_bytes_loaded|total /
+    # programs_done|total, so the operator's capability gate reports
+    # warmup PROGRESS instead of waiting out one opaque timeout. Empty
+    # dict on runtimes without a tracker (wire-compatible both ways).
+    warmup: dict = field(default_factory=dict)
 
     def to_bytes(self) -> bytes:
         return json.dumps(asdict(self)).encode()

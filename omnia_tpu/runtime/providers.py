@@ -59,8 +59,12 @@ class ProviderSpec:
         return cls(**d)
 
 
-def build_engine(spec: ProviderSpec, *, warmup: bool = False):
-    """Instantiate the engine for a provider spec."""
+def build_engine(spec: ProviderSpec, *, warmup: bool = False, coldstart=None):
+    """Instantiate the engine for a provider spec. ``coldstart`` is an
+    optional :class:`~omnia_tpu.engine.coldstart.ColdStartTracker` the
+    caller is already publishing (the runtime server's staged-readiness
+    Health surface) — the engine adopts it so bring-up progress lands
+    where the probes look."""
     if spec.type == "mock":
         scenarios = [Scenario(**s) for s in spec.options.get("scenarios", [])]
         # kv_quant forwards for parity: the mock mirrors the int8 KV
@@ -69,6 +73,10 @@ def build_engine(spec: ProviderSpec, *, warmup: bool = False):
             scenarios, kv_quant=spec.options.get("kv_quant"),
             max_queue=spec.options.get("max_queue", 0),
             watchdog_s=spec.options.get("watchdog_s"),
+            # Cold-start parity: the mock books the same warmup
+            # progress/manifest ledger (engine/coldstart.py).
+            warmup_threads=spec.options.get("warmup_threads", 0),
+            coldstart=coldstart,
             # Flight-recorder parity: mock Provider CRs can turn on the
             # same per-request latency breakdowns as tpu ones.
             flight_events=spec.options.get("flight_events", 0),
@@ -108,7 +116,10 @@ def build_engine(spec: ProviderSpec, *, warmup: bool = False):
                      # table device pool behind the slots, prefix
                      # cache, and session paging (0 = the guarded
                      # no-op contiguous layout).
-                     "kv_pages", "kv_page_tokens"}
+                     "kv_pages", "kv_page_tokens",
+                     # Parallel AOT warmup (engine/warmup.py): bounded
+                     # compile pool for cold start (0 = serial).
+                     "warmup_threads"}
         }
         if "prefill_buckets" in eng_kwargs:
             eng_kwargs["prefill_buckets"] = tuple(eng_kwargs["prefill_buckets"])
@@ -135,7 +146,17 @@ def build_engine(spec: ProviderSpec, *, warmup: bool = False):
                 # instead of bouncing the weights through one device.
                 mesh = make_mesh(ecfg.dp, ecfg.tp)
             dtype = resolve_dtype(ecfg.dtype)
-            params = ckpt_io.load_params(ckpt, cfg, dtype=dtype, mesh=mesh)
+
+            # Hand the engine a LOADER, not loaded params: it streams
+            # the checkpoint under the weights_load phase (per-tensor
+            # byte progress) while the param-free program families
+            # compile on a side thread (engine/warmup.py) — cold start
+            # overlaps weight streaming with compilation.
+            def params(progress_cb=None):
+                return ckpt_io.load_params(
+                    ckpt, cfg, dtype=dtype, mesh=mesh,
+                    progress_cb=progress_cb,
+                )
         else:
             if spec.model not in PRESETS:
                 raise ProviderError(
@@ -143,7 +164,8 @@ def build_engine(spec: ProviderSpec, *, warmup: bool = False):
                 )
             cfg = get_config(spec.model)
         engine = InferenceEngine(
-            cfg, ecfg, params=params, seed=spec.options.get("seed", 0)
+            cfg, ecfg, params=params, seed=spec.options.get("seed", 0),
+            coldstart=coldstart,
         )
         if warmup:
             engine.warmup()
@@ -272,7 +294,7 @@ class ProviderRegistry:
             raise ProviderError(f"no provider named {name!r}")
         return self._specs[name]
 
-    def engine(self, name: str):
+    def engine(self, name: str, coldstart=None):
         """Lazily build (and cache) the engine for a named provider.
 
         Builds are serialized PER NAME: a model build takes minutes, and two
@@ -281,6 +303,10 @@ class ProviderRegistry:
         never-started one. Already-built engines return without locking, and
         one provider's build never stalls another provider (llm vs
         embedding) or post-ready health probes.
+
+        ``coldstart`` (a ColdStartTracker) only matters to whichever call
+        actually builds — the server's bring-up passes its published
+        tracker so staged-readiness probes see the build's progress.
         """
         eng = self._engines.get(name)
         if eng is not None:
@@ -290,7 +316,9 @@ class ProviderRegistry:
         with lock:
             eng = self._engines.get(name)
             if eng is None:
-                eng = self._engines[name] = build_engine(self.spec(name))
+                eng = self._engines[name] = build_engine(
+                    self.spec(name), coldstart=coldstart
+                )
             return eng
 
     def names(self) -> list[str]:

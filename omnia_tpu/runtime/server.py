@@ -125,6 +125,10 @@ class RuntimeServer:
         self._grpc_server: Optional[grpc.Server] = None
         self.port: Optional[int] = None
         self._ready = threading.Event()
+        # Cold-start tracker (engine/coldstart.py): published by serve()'s
+        # bring-up and read by Health while "initializing" — the staged-
+        # readiness surface the operator's capability gate consumes.
+        self._coldstart = None
 
     # ------------------------------------------------------------------
 
@@ -435,6 +439,11 @@ class RuntimeServer:
         # the request path). Before ready, do NOT touch self.engine — the
         # probe must never trigger (or block on) the minutes-long build.
         if not self._ready.is_set():
+            # Staged readiness: the tracker is engine-independent state
+            # (bring_up publishes it before touching the registry), so
+            # reporting phase/bytes/programs here never blocks on — or
+            # triggers — the build.
+            cs = self._coldstart
             return c.HealthResponse(
                 status="initializing",
                 contract_version=c.CONTRACT_VERSION,
@@ -443,6 +452,7 @@ class RuntimeServer:
                 queue_depth=0,
                 active_slots=0,
                 functions=self._function_meta(),
+                warmup=cs.snapshot() if cs is not None else {},
             )
         engine = self.engine
         status = "ok" if getattr(engine, "healthy", lambda: True)() else "unhealthy"
@@ -513,7 +523,14 @@ class RuntimeServer:
         self._grpc_server = server
 
         def bring_up():
-            engine = self.engine  # builds (and shards) the model
+            from omnia_tpu.engine.coldstart import ColdStartTracker
+
+            # Publish the tracker BEFORE the build: weight streaming and
+            # warmup progress land where initializing Health probes look.
+            tracker = self._coldstart = ColdStartTracker()
+            tracker.begin_phase("backend_init")
+            self.providers.engine(self.provider_name, coldstart=tracker)
+            engine = self.engine  # cached above; wires the tracer
             try:
                 engine.warmup()
             finally:

@@ -19,21 +19,60 @@ from __future__ import annotations
 
 import logging
 import os
+import tempfile
 
 logger = logging.getLogger(__name__)
 
 _enabled = False
+_enabled_dir: str | None = None
+
+
+def _writable_dir(path: str) -> bool:
+    """True when `path` exists (or can be created) and accepts writes —
+    the probe actually creates and removes a file, because os.access
+    lies under containers' overlayfs/read-only mounts."""
+    try:
+        os.makedirs(path, exist_ok=True)
+        probe = os.path.join(path, f".write_probe_{os.getpid()}")
+        with open(probe, "w") as f:
+            f.write("")
+        os.remove(probe)
+        return True
+    except OSError:
+        return False
 
 
 def default_cache_dir() -> str:
     """OMNIA_JAX_CACHE_DIR wins; otherwise a dot-dir next to the package
-    (the repo root in dev, the install prefix in a pod image — both are
-    writable in their respective environments)."""
+    (the repo root in dev, the install prefix in a pod image) — and when
+    THAT is unwritable (read-only container images mount the install
+    prefix ro), a per-user tmpdir with a logged warning. A tmpdir cache
+    only survives the pod, not the node — but a silent failure used to
+    disable caching entirely, which is strictly worse."""
     env = os.environ.get("OMNIA_JAX_CACHE_DIR")
     if env:
         return env
     pkg_root = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
-    return os.path.join(pkg_root, ".jax_cache")
+    preferred = os.path.join(pkg_root, ".jax_cache")
+    if _writable_dir(preferred):
+        return preferred
+    fallback = os.path.join(
+        tempfile.gettempdir(), f"omnia_jax_cache_{os.getuid()}"
+    )
+    logger.warning(
+        "compile cache dir %s is unwritable (read-only image?); falling "
+        "back to %s — set OMNIA_JAX_CACHE_DIR to a persistent volume so "
+        "restarts keep their compile cache",
+        preferred, fallback,
+    )
+    return fallback
+
+
+def enabled_dir() -> str | None:
+    """The directory the persistent compile cache was enabled with, or
+    None while disabled. Jax-free to call (module state only) — the
+    warmup manifest and the metrics mirror read it."""
+    return _enabled_dir
 
 
 def enable_compilation_cache(cache_dir: str | None = None) -> str | None:
@@ -43,11 +82,10 @@ def enable_compilation_cache(cache_dir: str | None = None) -> str | None:
     even a 1 s compile is worth skipping). Returns the dir, or None if the
     cache could not be enabled (old jax) — serving still works, cold starts
     just stay slow."""
-    global _enabled
+    global _enabled, _enabled_dir
     if _enabled:
-        return default_cache_dir() if cache_dir is None else cache_dir
+        return _enabled_dir
     explicit = cache_dir is not None or "OMNIA_JAX_CACHE_DIR" in os.environ
-    cache_dir = cache_dir or default_cache_dir()
     try:
         import jax
 
@@ -55,13 +93,18 @@ def enable_compilation_cache(cache_dir: str | None = None) -> str | None:
             # CPU runs (tests, dev) don't pay a meaningful compile bill,
             # and XLA:CPU AOT cache entries are machine-feature-pinned —
             # reloading them across feature-detection differences risks
-            # SIGILL. Opt in explicitly to cache on CPU.
+            # SIGILL. Opt in explicitly to cache on CPU. Decided BEFORE
+            # resolving the default dir: the resolution write-probes the
+            # filesystem and may log the read-only-image fallback
+            # warning, which would be noise for a cache never enabled.
             return None
+        cache_dir = cache_dir or default_cache_dir()
         os.makedirs(cache_dir, exist_ok=True)
         jax.config.update("jax_compilation_cache_dir", cache_dir)
         jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
         _enabled = True
+        _enabled_dir = cache_dir
         return cache_dir
     except Exception:  # pragma: no cover - depends on jax version
         logger.exception("persistent compilation cache unavailable")

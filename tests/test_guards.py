@@ -76,6 +76,8 @@ KNOB_GUARDS = {
         "test_guards.py::test_interleave_off_is_true_noop",
     "EngineConfig.flight_events":
         "test_flight.py::test_flight_off_is_true_noop",
+    "EngineConfig.warmup_threads":
+        "test_coldstart.py::test_warmup_threads_zero_is_true_noop",
     "MockEngine.kv_quant":
         "test_guards.py::test_mock_knobs_off_are_true_noop",
     "MockEngine.fault_plan":
@@ -98,6 +100,11 @@ KNOB_GUARDS = {
         "structural: mirror depth cap; dead while spec_decode=0",
     "MockEngine.spec_gate_window":
         "structural: mirror gate window; dead while spec_decode=0",
+    "MockEngine.warmup_threads":
+        "test_coldstart.py::test_mock_warmup_threads_zero_is_true_noop",
+    "MockEngine.coldstart":
+        "structural: injected progress tracker (ColdStartTracker); "
+        "default-constructed when absent, never a behavior switch",
 }
 
 

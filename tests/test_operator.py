@@ -300,8 +300,8 @@ def test_capability_gate_blocks_and_scales_to_zero(manager, monkeypatch):
 
     # Spec now requires a capability the runtime does not advertise.
     dep.required_capabilities = dep.required_capabilities + ["duplex_audio"]
-    gated, missing = cm._capability_gate(dep)
-    assert gated and missing == ["duplex_audio"]
+    gated, missing, warming = cm._capability_gate(dep)
+    assert gated and missing == ["duplex_audio"] and warming is None
     monkeypatch.setattr(
         cm, "_required_capabilities", lambda res, tools: ["duplex_audio"]
     )

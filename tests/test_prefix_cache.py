@@ -480,6 +480,10 @@ class TestMetricsKeyStability:
         "recoveries",
         "mixed_steps", "interleaved_prefill_tokens", "decode_stall_steps",
         "flight_enabled",
+        "compile_cache_enabled", "warmup_phase",
+        "warmup_programs_total", "warmup_programs_done",
+        "warmup_manifest_hits", "warmup_manifest_misses",
+        "weights_bytes_total", "weights_bytes_loaded",
     }
 
     # MockEngine-private keys (beyond its EXPECTED mirror): the host-side
