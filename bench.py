@@ -506,6 +506,22 @@ def child_main() -> None:
             _log(f"latency bench failed: {exc!r}")
             latency = {"error": repr(exc)}
 
+    # --- production traffic simulator (evals/trafficsim) --------------
+    # Seeded mixed-class VU fleet against a mock fleet behind the real
+    # coordinator: clean arm vs counted-chaos arm, per-class attainment,
+    # exact resubmit/shed reconciliation. Pure host-side scheduling —
+    # identical on accel and CPU, and deliberately mock-backed so the
+    # chaos deaths are injectable and the arms cost seconds.
+    trafficsim = None
+    if remaining() > (60 if on_accel else 30):
+        try:
+            trafficsim = _bench_trafficsim(cfg, remaining, on_accel)
+            _log(f"trafficsim bench done: reconciled="
+                 f"{trafficsim.get('reconciled')}")
+        except Exception as exc:  # noqa: BLE001 - aux evidence only
+            _log(f"trafficsim bench failed: {exc!r}")
+            trafficsim = {"error": repr(exc)}
+
     # --- cold start decomposition + cache A/B (engine/coldstart.py) ---
     # Submit-to-ready per phase, cold-vs-warm persistent-cache restart,
     # and parallel-vs-serial warmup. Runs on accel and CPU (compile
@@ -572,6 +588,7 @@ def child_main() -> None:
                 "interleave": interleave,
                 "kv_paged": kv_paged,
                 "latency": latency,
+                "trafficsim": trafficsim,
                 "coldstart": coldstart,
                 # Chip-roofline ratios are meaningless against CPU
                 # timings — explicitly null, never quoted against an
@@ -678,6 +695,10 @@ def child_main() -> None:
         result["aux"]["kv_paged"] = kv_paged
     if latency is not None:
         result["aux"]["latency"] = latency
+    if trafficsim is not None:
+        # Traffic simulator (ROADMAP item 5): per-class SLO attainment
+        # clean-vs-chaos with exact ledger reconciliation.
+        result["aux"]["trafficsim"] = trafficsim
     if coldstart is not None:
         # Cold start (ROADMAP item 3): submit-to-ready decomposition +
         # cold-vs-warm cache A/B + parallel-vs-serial warmup.
@@ -1701,6 +1722,78 @@ def _bench_greedy_spec(cfg, remaining, on_accel):
         # The acceptance bar: speculation pays, or the gate disabled it
         # and says so — never a silent regression.
         "paying": ratio >= 1.0 or gate_disabled,
+    }
+
+
+def _bench_trafficsim(cfg, remaining, on_accel):
+    """Production traffic simulator (evals/trafficsim → aux.trafficsim):
+    one seeded mixed-class virtual-user run against a hermetic mock
+    fleet behind the REAL coordinator, twice — a clean arm and a chaos
+    arm with a counted FaultPlan (worker deaths + a flaky submit + a
+    slow-sync tax) armed mid-run. Reports per-class SLO attainment and
+    flight-sourced TTFT p95s for both arms, and the honest contract:
+    the chaos arm's resubmit/shed/death books must reconcile EXACTLY
+    (ledger.ok) or the phase reports the broken identity. Host-side
+    scheduling behavior — runs identically on accel and CPU."""
+    from omnia_tpu.engine.faults import FaultPlan
+    from omnia_tpu.evals.trafficsim import TrafficPlan, TrafficSimulator, default_classes
+    from omnia_tpu.evals.trafficsim.__main__ import build_mock_fleet
+
+    plan = TrafficPlan(
+        seed=0, duration_s=1.5,
+        classes=default_classes(include_duplex=False),
+    )
+
+    def run_arm(chaos):
+        target, _fleet = build_mock_fleet(
+            2, flight_events=4096, max_worker_queue=8,
+        )
+        sim = TrafficSimulator(
+            target, plan, concurrency=16, chaos=chaos, chaos_at_s=0.2,
+        )
+        # Bounded by the child's remaining budget (minus a reporting
+        # margin): a wedged arm must degrade to a short arm, never blow
+        # the whole bench child's deadline and lose every section.
+        arm_budget = max(5.0, min(60.0, remaining() - 15.0))
+        rep = sim.run(timeout_s=arm_budget).report()
+        led = rep["ledger"]
+        cells = {
+            name: {
+                "offered": cell["offered"],
+                "attainment": cell["slo"]["attainment"],
+                "ttft_p95_ms": cell["ttft_engine_ms"]["p95"],
+                "goodput_tok_s": cell["slo"]["goodput_tok_s"],
+            }
+            for name, cell in rep["classes"].items()
+            if "slo" in cell
+        }
+        return {
+            "offered": led["offered_requests"],
+            "submits": led["engine_submits"],
+            "slo_passed": rep["slo"]["passed"],
+            "classes": cells,
+            "ledger_ok": led["ok"],
+            "coordinator": led["coordinator"],
+            "chaos_fired": led["chaos_fired"],
+            "death_errors": led["death_errors_observed"],
+            "broken_identities": [
+                i["name"] for i in led["identities"] if i["ok"] is False
+            ],
+        }
+
+    clean = run_arm(None)
+    chaos = run_arm(FaultPlan(
+        die_after_tokens=0, die_count=2, flaky_submit=1,
+        slow_sync_s=0.001,
+    ))
+    return {
+        "seed": plan.seed,
+        "duration_s": plan.duration_s,
+        "clean": clean,
+        "chaos": chaos,
+        # The acceptance bar: both arms' books close exactly, and the
+        # chaos arm's counted faults are fully attributed.
+        "reconciled": clean["ledger_ok"] and chaos["ledger_ok"],
     }
 
 

@@ -55,6 +55,7 @@ def run_checkers(
         })
     if "metrics" in rules:
         wanted.update(metricscheck.ENGINE_FAMILY)
+        wanted.update(metricscheck.TRAFFICSIM_FILES)
         wanted.update({
             metricscheck.MOCK_FILE, metricscheck.COORDINATOR_FILE,
             metricscheck.REGISTRY_FILE,

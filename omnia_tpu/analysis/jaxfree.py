@@ -25,6 +25,10 @@ JAX_FREE_PACKAGES: tuple[str, ...] = (
     # Cold-start tracker + warmup manifest: jax-free by contract so the
     # mock parity layer and the CI poisoned-jax subset can run it.
     "omnia_tpu/engine/coldstart.py",
+    # Traffic simulator: the generator/report path and the mock-fleet
+    # CLI must run in jax-less containers (the duplex driver's runtime
+    # import is lazy and degrades to a recorded skip).
+    "omnia_tpu/evals/trafficsim/",
 )
 
 

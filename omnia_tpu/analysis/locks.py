@@ -57,6 +57,16 @@ LOCK_GROUPS: tuple[tuple[str, tuple[str, ...]], ...] = (
     # The cold-start tracker is written from the loader/warmup threads
     # and read by Health probes — its own lock class.
     ("coldstart", ("omnia_tpu/engine/coldstart.py",)),
+    # The traffic simulator's fleet driver: VU threads write the
+    # outcome/submit books concurrently — same machine-checked
+    # lock-at-access-site discipline as the engine family.
+    ("trafficsim", (
+        "omnia_tpu/evals/trafficsim/simulator.py",
+        "omnia_tpu/evals/trafficsim/arrivals.py",
+        "omnia_tpu/evals/trafficsim/generator.py",
+        "omnia_tpu/evals/trafficsim/report.py",
+        "omnia_tpu/evals/trafficsim/scenarios.py",
+    )),
 )
 
 #: Attribute names whose CALL under a held lock is (potentially)

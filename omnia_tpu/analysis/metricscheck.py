@@ -49,6 +49,17 @@ ENGINE_FAMILY = (
 )
 MOCK_FILE = "omnia_tpu/engine/mock.py"
 COORDINATOR_FILE = "omnia_tpu/engine/coordinator.py"
+#: Traffic-simulator files: the simulator reports through its own JSON
+#: report schema, not `self.metrics` — any `self.metrics` write that
+#: ever appears here must name a registered engine key (it would be
+#: mirroring the engine ledger) or it is a finding.
+TRAFFICSIM_FILES = (
+    "omnia_tpu/evals/trafficsim/simulator.py",
+    "omnia_tpu/evals/trafficsim/report.py",
+    "omnia_tpu/evals/trafficsim/generator.py",
+    "omnia_tpu/evals/trafficsim/arrivals.py",
+    "omnia_tpu/evals/trafficsim/scenarios.py",
+)
 
 
 def metric_keys_in(src: SourceFile) -> list[tuple[str, int]]:
@@ -152,6 +163,8 @@ def check_metrics(root: str, sources: dict[str, SourceFile]) -> list[Finding]:
 
     plans: list[tuple[str, set[str], str]] = []
     for f in ENGINE_FAMILY:
+        plans.append((f, expected, "TestMetricsKeyStability.EXPECTED"))
+    for f in TRAFFICSIM_FILES:
         plans.append((f, expected, "TestMetricsKeyStability.EXPECTED"))
     plans.append((
         MOCK_FILE, expected | mock_only,

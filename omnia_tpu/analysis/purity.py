@@ -45,6 +45,9 @@ PURITY_FILES_PREFIXES: tuple[str, ...] = (
     "omnia_tpu/ops/",
     "omnia_tpu/models/",
     "omnia_tpu/parallel/",
+    # The traffic simulator is host-side by contract; listing it makes
+    # any future traced body inside it subject to the same rule.
+    "omnia_tpu/evals/trafficsim/",
 )
 
 #: Call heads that trace their function argument(s).

@@ -329,6 +329,16 @@ class EngineCoordinator:
     def active_slots(self) -> int:
         return self._sum_signal("active_slots")
 
+    def pending_prefill_tokens(self) -> int:
+        """Fleet-wide prompt-token backlog (queued prompts + unconsumed
+        in-flight prefill tails, summed over healthy workers) — the
+        SURVEY §5.8 queue-depth signal, exposed with the same name the
+        workers use so load generators (evals/trafficsim) and autoscaler
+        triggers read one surface whether they front a single engine or
+        the whole fleet. Workers predating the method contribute 0 (the
+        same duck-type contract as _load)."""
+        return self._sum_signal("pending_prefill_tokens")
+
     def _saturated(self) -> bool:
         """True when every healthy worker's queue is at the per-worker
         bound — the shed-before-routing signal. A worker whose stats RPC
@@ -466,6 +476,7 @@ class EngineCoordinator:
         deadline_at: Optional[float],
         exclude: frozenset = frozenset(),
         trace_ctx: Optional[str] = None,
+        grammar=None,
     ):
         """Pick a healthy worker and submit, failing over on submit
         exceptions with jittered backoff inside the deadline budget.
@@ -494,13 +505,20 @@ class EngineCoordinator:
                 # drops exactly one not-yet-tried kwarg, and no level is
                 # ever retried verbatim (trace_ctx arrived after
                 # deadline_s in-tree, so no worker accepts only it).
+                # grammar is NOT laddered: a constrained request served
+                # unconstrained would stream schema-invalid output, so a
+                # worker that cannot take the kwarg is a real fault for
+                # this request (failover finds one that can).
+                base_kw: dict = {}
+                if grammar is not None:
+                    base_kw["grammar"] = grammar
                 kw_ladder: list[dict] = []
                 if trace_ctx is not None:
                     kw_ladder.append(
-                        {"deadline_s": rem, "trace_ctx": trace_ctx}
+                        dict(base_kw, deadline_s=rem, trace_ctx=trace_ctx)
                     )
-                kw_ladder.append({"deadline_s": rem})
-                kw_ladder.append({})
+                kw_ladder.append(dict(base_kw, deadline_s=rem))
+                kw_ladder.append(dict(base_kw))
                 for level, kw in enumerate(kw_ladder):
                     try:
                         inner = self.workers[idx].submit(
@@ -544,6 +562,7 @@ class EngineCoordinator:
         prefix_key: Optional[str] = None,
         deadline_s: Optional[float] = None,
         trace_ctx: Optional[str] = None,
+        grammar=None,
     ) -> RequestHandle:
         deadline_at = (
             time.monotonic() + deadline_s if deadline_s is not None else None
@@ -565,7 +584,7 @@ class EngineCoordinator:
             return handle
         idx, result = self._routed_submit(
             prompt_tokens, params, session_id, prefix_key, deadline_at,
-            trace_ctx=trace_ctx,
+            trace_ctx=trace_ctx, grammar=grammar,
         )
         if idx is None:
             handle = RequestHandle(result.request_id)
@@ -579,7 +598,7 @@ class EngineCoordinator:
             return result
         relay = _RelayHandle(
             self, prompt_tokens, params, session_id, prefix_key, deadline_at,
-            trace_ctx=trace_ctx,
+            trace_ctx=trace_ctx, grammar=grammar,
         )
         relay._begin(idx, result)
         return relay
@@ -658,7 +677,7 @@ class _RelayHandle(RequestHandle):
     Exactly ONE terminal event ever reaches the consumer."""
 
     def __init__(self, owner, prompt_tokens, params, session_id, prefix_key,
-                 deadline_at, trace_ctx=None):
+                 deadline_at, trace_ctx=None, grammar=None):
         super().__init__("coord-pending")
         self._owner = owner
         self._args = (list(prompt_tokens), params, session_id, prefix_key)
@@ -667,6 +686,9 @@ class _RelayHandle(RequestHandle):
         # span joins the SAME trace (worker deaths extend the trace,
         # never fork it).
         self._trace_ctx = trace_ctx
+        # Likewise re-sent: a resubmitted constrained request stays
+        # constrained on the replacement worker.
+        self._grammar = grammar
         self._inner: Optional[RequestHandle] = None
         self._inner_idx: Optional[int] = None
         self._resubmits_left = owner.resubmit_retries
@@ -692,7 +714,7 @@ class _RelayHandle(RequestHandle):
         self._owner._note_probe(failed, False, hard=True)
         idx, result = self._owner._routed_submit(
             *self._args, self._deadline_at, exclude=frozenset({failed}),
-            trace_ctx=self._trace_ctx,
+            trace_ctx=self._trace_ctx, grammar=self._grammar,
         )
         if idx is None:
             self._push(dataclasses.replace(result, request_id=self.request_id))

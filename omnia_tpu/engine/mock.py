@@ -87,11 +87,18 @@ class MockEngine:
                  kv_pages: int = 0, kv_page_tokens: int = 64,
                  spec_decode: int = 0, spec_decode_max: int = 0,
                  spec_gate_window: int = 0, warmup_threads: int = 0,
-                 coldstart=None):
+                 coldstart=None, name: str = "mock"):
         from omnia_tpu.engine.coldstart import ColdStartTracker
 
         self.scenarios = list(scenarios)
         self.tokenizer = tokenizer or ByteTokenizer()
+        # Request-id prefix. Default preserves the historical "mock-N"
+        # ids; a FLEET of mocks behind one coordinator gives each worker
+        # its own name so request ids stay unique across workers — the
+        # traffic simulator joins flight-recorder terminals back to its
+        # submits by id, and two workers both emitting "mock-0" would
+        # cross-wire the per-class latency books.
+        self.name = name
         # Cold-start parity (engine/coldstart.py): the mock has no
         # programs to compile, but warmup() books the same phase spans,
         # progress counters, and manifest hits/misses through the REAL
@@ -384,7 +391,7 @@ class MockEngine:
         # the mock replays scenarios statelessly, so it is ignored.
         if self.fault_plan is not None and self.fault_plan.take_submit_fault():
             raise RuntimeError("injected flaky submit (FaultPlan)")
-        rid = f"mock-{next(self._req_counter)}"
+        rid = f"{self.name}-{next(self._req_counter)}"
         handle = RequestHandle(rid)
         # Mirror InferenceEngine.submit's validation (and its metric
         # ordering: rejected requests are NOT counted as submitted).

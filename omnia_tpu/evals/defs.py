@@ -82,11 +82,19 @@ class EvalScenario:
 @dataclasses.dataclass
 class Threshold:
     """Pass/fail gate over aggregated results (reference
-    ee/pkg/arena/threshold)."""
+    ee/pkg/arena/threshold). The three SLO bounds only engage on cells
+    a traffic-simulator report was folded into
+    (Aggregator.add_slo_cells) — classic check-based jobs never see
+    them fire."""
 
     min_pass_rate: float = 1.0
     max_error_rate: float = 0.0
     max_p95_latency_s: Optional[float] = None
+    # Simulator SLO gates (evals/trafficsim): per-class attainment and
+    # flight-recorder-sourced engine percentile bounds.
+    min_slo_attainment: Optional[float] = None
+    max_p95_ttft_ms: Optional[float] = None
+    max_p95_itl_ms: Optional[float] = None
 
 
 @dataclasses.dataclass
@@ -111,6 +119,9 @@ class ArenaJobSpec:
                 min_pass_rate=float(th.get("min_pass_rate", 1.0)),
                 max_error_rate=float(th.get("max_error_rate", 0.0)),
                 max_p95_latency_s=th.get("max_p95_latency_s"),
+                min_slo_attainment=th.get("min_slo_attainment"),
+                max_p95_ttft_ms=th.get("max_p95_ttft_ms"),
+                max_p95_itl_ms=th.get("max_p95_itl_ms"),
             ),
         )
 

@@ -1,0 +1,62 @@
+"""Production traffic simulator: a seeded virtual-user fleet.
+
+The in-tree equivalent of the reference's arena fleet worker (SURVEY
+§2.10/§3.4 — ``vu_pool.go`` / ``load_profile.go`` fleet mode): scenario
+classes (bursty chat, long-prompt RAG, grammar turns, mid-stream
+cancels, deadline turns, multi-turn sessions, duplex/barge-in voice)
+offered under open-loop arrival processes (Poisson / MMPP / ramp /
+diurnal) against the real facade→coordinator→engine stack, with chaos
+from ``engine/faults.py`` injectable mid-run, and a per-class SLO
+attainment report whose ledger reconciles exactly against the engine
+and coordinator books.
+
+Jax-free by contract (like ``engine/grammar`` and ``analysis``): the
+generator/report path and the CLI against mock fleets run in
+containers with no accelerator stack — the duplex scenario's runtime
+import is lazy and degrades to a recorded skip.
+
+Entry points::
+
+    python -m omnia_tpu.evals.trafficsim --seed 0 --duration 2 --chaos
+    from omnia_tpu.evals.trafficsim import TrafficPlan, TrafficSimulator
+"""
+
+from omnia_tpu.evals.trafficsim.arrivals import ArrivalSpec, arrival_times
+from omnia_tpu.evals.trafficsim.generator import (
+    OfferedRequest,
+    OfferedTurn,
+    TrafficPlan,
+    generate_offered,
+    offered_digest,
+)
+from omnia_tpu.evals.trafficsim.report import build_report, summary_lines
+from omnia_tpu.evals.trafficsim.scenarios import (
+    ScenarioClass,
+    SLOTarget,
+    default_classes,
+    mock_scenarios,
+)
+from omnia_tpu.evals.trafficsim.simulator import (
+    SimRun,
+    TrafficSimulator,
+    TurnOutcome,
+)
+
+__all__ = [
+    "ArrivalSpec",
+    "arrival_times",
+    "OfferedRequest",
+    "OfferedTurn",
+    "TrafficPlan",
+    "generate_offered",
+    "offered_digest",
+    "build_report",
+    "summary_lines",
+    "ScenarioClass",
+    "SLOTarget",
+    "default_classes",
+    "mock_scenarios",
+    "SimRun",
+    "TrafficSimulator",
+    "TurnOutcome",
+]

@@ -1,0 +1,161 @@
+"""Open-loop arrival processes for the traffic simulator.
+
+Every process here is a PURE function of (seed, rate, duration): it
+returns the complete list of intended start offsets up front, before a
+single request is served. That is the coordinated-omission guard — the
+offered schedule can never stretch, shrink, or resample because the
+server got slow (the classic closed-loop benchmark flaw where a stalled
+client politely stops offering load and the tail percentiles flatter
+the server). A slow run serves the SAME offered trace late, and the
+driver records the lateness (`sched_delay`) instead of hiding it.
+
+Processes (reference load_profile.go shapes, open-loop edition):
+
+- ``poisson``: exponential inter-arrival gaps at a constant rate.
+- ``mmpp``: a 2-state Markov-modulated Poisson process — the classic
+  bursty-traffic model; dwell in a quiet state at ``rate``, flip into a
+  burst state at ``burst_factor`` × rate. Same mean load as poisson at
+  equal average rate, much heavier short-window peaks.
+- ``ramp``: Poisson gaps under a rate that climbs linearly from
+  ``ramp_from_frac`` × rate to rate over the run (a launch ramp).
+- ``diurnal``: Poisson gaps under one sinusoidal day compressed into
+  the run (peak = rate, trough = ``trough_frac`` × rate).
+
+All times are SECONDS from run start, strictly inside [0, duration).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+import random
+from typing import Optional
+
+PROFILES = ("poisson", "mmpp", "ramp", "diurnal")
+
+
+@dataclasses.dataclass(frozen=True)
+class ArrivalSpec:
+    """One class's arrival process: ``profile`` drawn at ``rate_rps``
+    mean requests/second. The knobs beyond (profile, rate) only apply
+    to their profile and are ignored elsewhere."""
+
+    profile: str = "poisson"
+    rate_rps: float = 2.0
+    # mmpp: burst-state rate multiplier + mean dwell seconds per state.
+    burst_factor: float = 6.0
+    dwell_s: float = 0.5
+    burst_dwell_s: float = 0.15
+    # ramp: starting rate as a fraction of rate_rps.
+    ramp_from_frac: float = 0.1
+    # diurnal: trough rate as a fraction of rate_rps.
+    trough_frac: float = 0.2
+
+    def __post_init__(self) -> None:
+        if self.profile not in PROFILES:
+            raise ValueError(
+                f"unknown arrival profile {self.profile!r}; have {PROFILES}"
+            )
+        if self.rate_rps <= 0:
+            raise ValueError(f"rate_rps must be > 0, got {self.rate_rps}")
+        # Degenerate shape knobs fail HERE, not deep inside generation.
+        if self.dwell_s <= 0 or self.burst_dwell_s <= 0:
+            raise ValueError(
+                f"mmpp dwell times must be > 0, got dwell_s={self.dwell_s} "
+                f"burst_dwell_s={self.burst_dwell_s}"
+            )
+        if self.burst_factor < 1.0:
+            raise ValueError(
+                f"burst_factor must be >= 1, got {self.burst_factor}"
+            )
+        if not (0.0 <= self.ramp_from_frac <= 1.0):
+            raise ValueError(
+                f"ramp_from_frac must be in [0, 1], got {self.ramp_from_frac}"
+            )
+        if not (0.0 <= self.trough_frac <= 1.0):
+            raise ValueError(
+                f"trough_frac must be in [0, 1], got {self.trough_frac}"
+            )
+
+
+def _poisson(rng: random.Random, rate: float, duration_s: float) -> list:
+    out, t = [], 0.0
+    while True:
+        t += rng.expovariate(rate)
+        if t >= duration_s:
+            return out
+        out.append(t)
+
+
+def _mmpp(rng: random.Random, spec: ArrivalSpec, duration_s: float) -> list:
+    # Normalize so rate_rps is the MEAN rate: with burst-time fraction
+    # f = burst_dwell / (dwell + burst_dwell), the quiet-state rate is
+    # rate / ((1 - f) + burst_factor * f) — equal average load to a
+    # poisson trace at the same rate_rps, much heavier peaks.
+    f = spec.burst_dwell_s / (spec.dwell_s + spec.burst_dwell_s)
+    quiet = spec.rate_rps / ((1.0 - f) + spec.burst_factor * f)
+    out, t = [], 0.0
+    burst = False
+    state_end = rng.expovariate(1.0 / spec.dwell_s)
+    while t < duration_s:
+        rate = quiet * (spec.burst_factor if burst else 1.0)
+        t += rng.expovariate(rate)
+        while t >= state_end:
+            burst = not burst
+            dwell = spec.burst_dwell_s if burst else spec.dwell_s
+            state_end += rng.expovariate(1.0 / dwell)
+        if t < duration_s:
+            out.append(t)
+    return out
+
+
+def _thinned(rng: random.Random, peak_rate: float, duration_s: float,
+             rate_at) -> list:
+    """Inhomogeneous Poisson via thinning: draw at the peak rate, keep
+    each arrival with probability rate(t)/peak — exact for any bounded
+    rate function, and still a pure function of the seed."""
+    out, t = [], 0.0
+    while True:
+        t += rng.expovariate(peak_rate)
+        if t >= duration_s:
+            return out
+        if rng.random() < rate_at(t) / peak_rate:
+            out.append(t)
+
+
+def arrival_times(spec: ArrivalSpec, duration_s: float, seed: int) -> list:
+    """Intended start offsets (seconds, sorted ascending) for one class.
+    Deterministic: the same (spec, duration, seed) always yields the
+    identical list."""
+    rng = random.Random(seed)
+    if spec.profile == "poisson":
+        return _poisson(rng, spec.rate_rps, duration_s)
+    if spec.profile == "mmpp":
+        return _mmpp(rng, spec, duration_s)
+    if spec.profile == "ramp":
+        lo = spec.rate_rps * spec.ramp_from_frac
+
+        def rate_at(t: float) -> float:
+            return lo + (spec.rate_rps - lo) * (t / duration_s)
+
+        return _thinned(rng, spec.rate_rps, duration_s, rate_at)
+    # diurnal: one compressed day, peak at mid-run.
+    trough = spec.rate_rps * spec.trough_frac
+
+    def rate_at(t: float) -> float:
+        phase = math.sin(math.pi * t / duration_s)  # 0 → 1 → 0
+        return trough + (spec.rate_rps - trough) * phase
+
+    return _thinned(rng, spec.rate_rps, duration_s, rate_at)
+
+
+def interval_counts(times: list, duration_s: float,
+                    window_s: float = 0.25) -> list:
+    """Arrivals per fixed window — the burstiness evidence the report
+    carries (an MMPP trace shows a max-window count far above its
+    mean; a Poisson trace at equal rate does not)."""
+    n = max(1, int(math.ceil(duration_s / window_s)))
+    counts = [0] * n
+    for t in times:
+        counts[min(int(t / window_s), n - 1)] += 1
+    return counts

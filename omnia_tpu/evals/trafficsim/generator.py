@@ -1,0 +1,146 @@
+"""Offered-trace generation: plan + seed → the exact load to offer.
+
+``generate_offered`` is a pure function: the same :class:`TrafficPlan`
+(same seed) always produces the byte-identical offered trace —
+``offered_digest`` pins that, and rerunning a simulator run with the
+seed from its report replays the exact same traffic. The trace is
+materialized in full BEFORE the run starts; nothing the server does can
+change what was offered (the open-loop / coordinated-omission
+contract — see arrivals.py).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import random
+from typing import Optional
+
+from omnia_tpu.evals.trafficsim.arrivals import arrival_times
+from omnia_tpu.evals.trafficsim.scenarios import ScenarioClass, default_classes
+
+
+@dataclasses.dataclass(frozen=True)
+class TrafficPlan:
+    """One run's worth of offered traffic: seed + duration + class mix."""
+
+    seed: int = 0
+    duration_s: float = 2.0
+    classes: "tuple[ScenarioClass, ...]" = dataclasses.field(
+        default_factory=default_classes
+    )
+
+    def to_dict(self) -> dict:
+        return {
+            "seed": self.seed,
+            "duration_s": self.duration_s,
+            "classes": [dataclasses.asdict(c) for c in self.classes],
+        }
+
+
+@dataclasses.dataclass(frozen=True)
+class OfferedTurn:
+    """One turn of one offered request (text is the user content; the
+    driver renders/encodes it for the target surface)."""
+
+    text: str
+    max_tokens: int
+    cancel_after_tokens: Optional[int] = None
+
+
+@dataclasses.dataclass(frozen=True)
+class OfferedRequest:
+    """One offered unit of traffic, fully determined at generation time.
+    ``intended_at_s`` is the open-loop intended start offset from run
+    start — lateness against it is the server's to own."""
+
+    index: int
+    klass: str
+    intended_at_s: float
+    turns: "tuple[OfferedTurn, ...]"
+    session_id: Optional[str] = None
+    deadline_s: Optional[float] = None
+    grammar_schema_json: Optional[str] = None
+    stop_token_ids: "tuple[int, ...]" = ()
+    duplex: bool = False
+    barge_in_after_chunks: Optional[int] = None
+
+
+def _turn_text(cls: ScenarioClass, index: int, turn: int,
+               rng: random.Random) -> str:
+    """Deterministic prompt text: a class marker (the mock's scenario
+    scripts key on it) plus filler padding to the drawn token size.
+    ByteTokenizer yields ~1 token per ASCII char + BOS, so a text of
+    n-1 chars encodes to n tokens. The drawn size is a CEILING too:
+    the head's tail (req/turn counters) truncates before the text may
+    exceed the band — a clamped band (``max_prompt_tokens``, sized to
+    real prefill buckets) really bounds the prompt. The one floor is
+    the class marker itself (``sim <name> ``), which never truncates."""
+    lo, hi = cls.prompt_tokens
+    want = rng.randint(lo, hi)
+    head = f"sim {cls.name} req {index} turn {turn} :: "
+    marker = f"sim {cls.name} "
+    n = max(want - 1, len(marker))
+    if len(head) >= n:
+        return head[:n]
+    return head + "x" * (n - len(head))
+
+
+def _class_seed(plan_seed: int, name: str, salt: str) -> int:
+    """Stable per-(class, purpose) sub-seed: classes draw independently,
+    so adding a class never perturbs another class's trace."""
+    h = hashlib.sha256(f"{plan_seed}:{name}:{salt}".encode()).digest()
+    return int.from_bytes(h[:8], "big")
+
+
+def generate_offered(plan: TrafficPlan) -> "list[OfferedRequest]":
+    """Expand the plan into the full offered trace, sorted by intended
+    start (ties broken by class name then per-class order — total order,
+    so the trace is reproducible to the byte)."""
+    raw = []
+    for cls in plan.classes:
+        times = arrival_times(
+            cls.arrival, plan.duration_s,
+            _class_seed(plan.seed, cls.name, "arrivals"),
+        )
+        body_rng = random.Random(_class_seed(plan.seed, cls.name, "bodies"))
+        for k, t in enumerate(times):
+            turns = tuple(
+                OfferedTurn(
+                    text=_turn_text(cls, k, turn, body_rng),
+                    max_tokens=cls.max_tokens,
+                    cancel_after_tokens=cls.cancel_after_tokens,
+                )
+                for turn in range(cls.turns)
+            )
+            raw.append(OfferedRequest(
+                index=0,  # assigned after the global sort
+                klass=cls.name,
+                intended_at_s=t,
+                turns=turns,
+                session_id=(
+                    f"sim-{cls.name}-{k}"
+                    if (cls.turns > 1 or cls.duplex) else None
+                ),
+                deadline_s=cls.deadline_s,
+                grammar_schema_json=cls.grammar_schema_json,
+                stop_token_ids=cls.stop_token_ids,
+                duplex=cls.duplex,
+                barge_in_after_chunks=cls.barge_in_after_chunks,
+            ))
+    raw.sort(key=lambda r: (r.intended_at_s, r.klass, r.session_id or ""))
+    return [dataclasses.replace(r, index=i) for i, r in enumerate(raw)]
+
+
+def offered_to_dicts(trace) -> "list[dict]":
+    return [dataclasses.asdict(r) for r in trace]
+
+
+def offered_digest(trace) -> str:
+    """sha256 over the canonical JSON of the trace — the report carries
+    it, and the determinism tests (and a rerun with the same seed) pin
+    byte-identical offered traffic on it."""
+    blob = json.dumps(offered_to_dicts(trace), sort_keys=True,
+                      separators=(",", ":")).encode()
+    return hashlib.sha256(blob).hexdigest()

@@ -1,0 +1,441 @@
+"""Per-class SLO attainment report + exact ledger reconciliation.
+
+``build_report(run)`` turns a :class:`~.simulator.SimRun` into one
+JSON-able dict with three jobs:
+
+1. **Per-class cells** — for every scenario class: offered/terminal
+   counts by finish reason, engine-side TTFT / inter-token / queue
+   percentiles from the flight recorder's per-request
+   ``LatencyBreakdown`` terminals (never wall-clock guesses), the
+   client-side wall timings beside them (labeled), the open-loop
+   scheduling delay, and SLO attainment against the class's
+   :class:`~.scenarios.SLOTarget` — attainment judged on the
+   INTENDED-start clock, so scheduling lag counts against the server
+   (the coordinated-omission-honest reading).
+
+2. **Ledger** — the exact reconciliation the chaos suite demands:
+   offered submits == terminals observed == engine books ± the
+   coordinator's shed/resubmit entries, with every identity listed
+   (lhs, rhs, ok) so a failure names the broken seam instead of one
+   opaque boolean. ``FaultPlan.fired`` reconciles against the observed
+   resubmits + surfaced worker-death errors.
+
+3. **Verdict** — per-class pass/fail plus the run-level ``slo.passed``
+   and ``ledger.ok`` gates ArenaJob thresholds and the bench consume.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from omnia_tpu.evals.aggregator import percentile as _agg_percentile
+from omnia_tpu.evals.trafficsim.arrivals import interval_counts
+from omnia_tpu.evals.trafficsim.scenarios import classes_by_name
+
+#: Report schema version — bump when cells/ledger keys change shape.
+SCHEMA_VERSION = 1
+
+#: Finish buckets every class cell carries (stable keys; absent
+#: outcomes are 0, so mock and real engine reports share one schema).
+FINISH_KEYS = (
+    "stop", "length", "cancelled", "deadline", "overloaded", "error",
+    "interrupted", "lost",
+)
+
+_UNROUTED_MARKERS = (
+    "no healthy engine workers",
+    "submit failed on",
+    "deadline exhausted before a worker accepted",
+)
+_COORD_SHED_MARKER = "every healthy worker is saturated"
+_DEATH_MARKER = "injected worker death"
+#: The coordinator's sentinel request ids for terminals it minted
+#: WITHOUT reaching a worker. Matched exactly — real InferenceEngine
+#: request ids are "req-<n>", so a prefix match would misclassify a
+#: failed RESUBMIT (surfaced under the original worker rid) on a
+#: real-engine fleet.
+_COORD_SENTINEL_IDS = frozenset({
+    "req-shed", "req-unrouted", "req-deadline", "req-failed",
+})
+
+
+def _percentile(values: list, p: float) -> Optional[float]:
+    # The shared evals-plane definition (aggregator cells merge these
+    # blocks — two "p95" columns on one surface must rank identically);
+    # empty=None so absence is visible in the report schema.
+    return _agg_percentile(values, p, empty=None)
+
+
+def _pct_block(values: list) -> dict:
+    return {
+        "p50": _round(_percentile(values, 50)),
+        "p95": _round(_percentile(values, 95)),
+        "p99": _round(_percentile(values, 99)),
+        "count": len(values),
+    }
+
+
+def _round(v: Optional[float]) -> Optional[float]:
+    return None if v is None else round(v, 3)
+
+
+def _is_unrouted(out) -> bool:
+    err = out.error or ""
+    return any(err.startswith(m) for m in _UNROUTED_MARKERS)
+
+
+def _is_coord_shed(out) -> bool:
+    return (out.finish == "overloaded"
+            and (out.error or "").startswith(_COORD_SHED_MARKER))
+
+
+def _partial_mismatch(out) -> bool:
+    """A non-duplex terminal whose streamed count disagrees with the
+    engine's num_generated book — the one predicate both the per-class
+    cells and the ledger gate on (one definition, so the column and the
+    ``partial_count_mismatches`` gate can never drift apart)."""
+    return (not out.duplex and out.finish != "lost"
+            and out.tokens_streamed != out.num_generated)
+
+
+def _class_cell(cls, offered: list, outcomes: list, run) -> dict:
+    """One scenario class's report cell."""
+    finish = {k: 0 for k in FINISH_KEYS}
+    ttft_engine, itl_engine, queue_engine = [], [], []
+    ttft_client, co_ttft, sched_delay = [], [], []
+    tokens_streamed = 0
+    partial_mismatches = 0
+    breakdowns_missing = 0
+    by_index: dict = {}
+    for out in outcomes:
+        by_index.setdefault(out.index, []).append(out)
+        finish[out.finish] = finish.get(out.finish, 0) + 1
+        tokens_streamed += out.tokens_streamed
+        if out.turn_index == 0:
+            # Intended-start comparisons only make sense for a request's
+            # FIRST turn: later turns of a session are serialized behind
+            # the previous turn's stream by design, and folding that
+            # service time into "scheduling delay" would misread a
+            # healthy multi-turn class as a saturated client.
+            sched_delay.append(
+                (out.submit_at_s - out.intended_at_s) * 1000.0
+            )
+            if out.first_token_at_s is not None:
+                co_ttft.append(
+                    (out.first_token_at_s - out.intended_at_s) * 1000.0
+                )
+        if out.first_token_at_s is not None:
+            ttft_client.append(
+                (out.first_token_at_s - out.submit_at_s) * 1000.0
+            )
+        if _partial_mismatch(out):
+            partial_mismatches += 1
+        bd = run.breakdowns.get(out.request_id)
+        if bd is None:
+            if not out.duplex:
+                breakdowns_missing += 1
+            continue
+        b = bd.get("breakdown", {})
+        if out.tokens_streamed > 0 and b.get("ttft_s", 0.0) > 0.0:
+            ttft_engine.append(b["ttft_s"] * 1000.0)
+        if b.get("decode_s_per_token", 0.0) > 0.0:
+            itl_engine.append(b["decode_s_per_token"] * 1000.0)
+        if "queue_s" in b:
+            queue_engine.append(b["queue_s"] * 1000.0)
+
+    # SLO attainment, judged per OFFERED request on the intended-start
+    # clock: met = first token (of the request's first turn) within
+    # slo.ttft_ms of the intended start AND no turn terminated in
+    # error/overloaded/deadline. Cancels/barge-ins count when on time.
+    met = 0
+    met_tokens = 0
+    errors = 0
+    unsubmitted = 0
+    for req in offered:
+        outs = by_index.get(req.index, [])
+        if not outs:
+            # Offered but never submitted (the run aborted on the pool
+            # timeout / driver stop before this request's intended
+            # start): NOT met — the user got nothing — but not a server
+            # error either. max_error_rate judges the engine, and the
+            # engine never saw this request; blaming it would fail the
+            # class on the client's own truncation.
+            unsubmitted += 1
+            continue
+        if any(o.finish in ("error", "lost") for o in outs):
+            errors += 1
+            continue
+        if any(o.finish in ("overloaded", "deadline") for o in outs):
+            continue
+        first = min(outs, key=lambda o: o.turn_index)
+        if first.first_token_at_s is None:
+            continue
+        lat_ms = (first.first_token_at_s - req.intended_at_s) * 1000.0
+        if lat_ms <= cls.slo.ttft_ms:
+            met += 1
+            met_tokens += sum(o.tokens_streamed for o in outs)
+    # A class with zero offered requests has no evidence either way:
+    # attainment is None (not 0.0) and no failure is emitted — a short
+    # run where a low-rate class produced no arrivals must not report
+    # an SLO violation it never observed.
+    attainment = met / len(offered) if offered else None
+    error_rate = errors / len(offered) if offered else 0.0
+    itl_p95 = _percentile(itl_engine, 95)
+    slo_failures = []
+    if attainment is not None and attainment < cls.slo.min_attainment:
+        slo_failures.append(
+            f"{cls.name}: SLO attainment {attainment:.3f} < "
+            f"{cls.slo.min_attainment:.3f} (target: first token within "
+            f"{cls.slo.ttft_ms}ms of intended start)"
+        )
+    if error_rate > cls.slo.max_error_rate:
+        slo_failures.append(
+            f"{cls.name}: error_rate {error_rate:.3f} > "
+            f"{cls.slo.max_error_rate:.3f}"
+        )
+    if cls.slo.itl_p95_ms is not None and itl_p95 is not None \
+            and itl_p95 > cls.slo.itl_p95_ms:
+        slo_failures.append(
+            f"{cls.name}: engine ITL p95 {itl_p95:.1f}ms > "
+            f"{cls.slo.itl_p95_ms}ms"
+        )
+
+    turns_offered = sum(len(r.turns) for r in offered)
+    times = [r.intended_at_s for r in offered]
+    counts = interval_counts(times, run.plan.duration_s)
+    return {
+        "offered": len(offered),
+        "turns_offered": turns_offered,
+        "turns_submitted": len(outcomes),
+        "turns_skipped": turns_offered - len(outcomes),
+        "finish": finish,
+        "tokens_streamed": tokens_streamed,
+        "partial_mismatches": partial_mismatches,
+        "breakdowns_missing": breakdowns_missing,
+        # Engine-side stages from flight-recorder LatencyBreakdowns.
+        "ttft_engine_ms": _pct_block(ttft_engine),
+        "itl_engine_ms": _pct_block(itl_engine),
+        "queue_engine_ms": _pct_block(queue_engine),
+        # Client-side wall clocks, labeled as such.
+        "ttft_client_ms": _pct_block(ttft_client),
+        "ttft_from_intended_ms": _pct_block(co_ttft),
+        "sched_delay_ms": _pct_block(sched_delay),
+        "arrivals": {
+            "profile": cls.arrival.profile,
+            "rate_rps": cls.arrival.rate_rps,
+            "window_s": 0.25,
+            "max_window": max(counts) if counts else 0,
+            "mean_window": round(sum(counts) / len(counts), 3)
+            if counts else 0.0,
+        },
+        "slo": {
+            "ttft_ms": cls.slo.ttft_ms,
+            "itl_p95_ms": cls.slo.itl_p95_ms,
+            "min_attainment": cls.slo.min_attainment,
+            "max_error_rate": cls.slo.max_error_rate,
+            "met_requests": met,
+            "attainment": round(attainment, 4)
+            if attainment is not None else None,
+            "unsubmitted": unsubmitted,
+            "errors": errors,
+            "error_rate": round(error_rate, 4),
+            "goodput_tok_s": round(met_tokens / run.wall_s, 2)
+            if run.wall_s > 0 else 0.0,
+            "passed": not slo_failures,
+            "failures": slo_failures,
+        },
+    }
+
+
+def _ledger(run, outcomes: list) -> dict:
+    """The exact reconciliation: every identity listed with its sides."""
+    terminals = len(outcomes)
+    lost = sum(1 for o in outcomes if o.finish == "lost")
+    # Unrouted terminals split by WHERE routing failed: an initial
+    # submit that never reached a worker carries one of the
+    # coordinator's sentinel request ids ("req-unrouted"/"req-deadline"/
+    # "req-failed"); a relay whose RESUBMIT (after a zero-token worker
+    # death) found no worker surfaces the same error under the original
+    # worker rid. The two sit on different sides of the routed/finished
+    # books, so the identities must not conflate them.
+    unrouted_initial = sum(
+        1 for o in outcomes
+        if _is_unrouted(o) and o.request_id in _COORD_SENTINEL_IDS
+    )
+    unrouted_resubmit = sum(
+        1 for o in outcomes
+        if _is_unrouted(o) and o.request_id not in _COORD_SENTINEL_IDS
+    )
+    coord_shed_obs = sum(1 for o in outcomes if _is_coord_shed(o))
+    death_errors = sum(
+        1 for o in outcomes
+        if o.finish == "error" and _DEATH_MARKER in (o.error or "")
+    )
+    w_sub = sum(b["requests_submitted"] for b in run.worker_books)
+    w_fin = sum(b["requests_finished"] for b in run.worker_books)
+    w_shed = sum(b["requests_shed"] for b in run.worker_books)
+    coord = run.coord_books or {}
+    routed = coord.get("routed", 0)
+    resubmits = coord.get("resubmits", 0)
+    coord_shed = coord.get("shed", 0)
+
+    identities = []
+
+    def ident(name: str, lhs, rhs) -> None:
+        identities.append({"name": name, "lhs": lhs, "rhs": rhs,
+                           "ok": lhs == rhs})
+
+    ident("terminals == submits", terminals, run.submits)
+    # Every submit lands exactly one terminal, and every terminal is
+    # accounted to exactly one book. A successful transparent resubmit
+    # gives its submit TWO worker finishes (the hidden zero-token death
+    # plus the replacement stream) — subtract them; a death whose
+    # resubmit FAILED still has exactly one worker finish (the hidden
+    # death) behind its unrouted terminal, so it needs no term here.
+    ident(
+        "submits == worker_finished - resubmits + worker_shed + "
+        "coord_shed + unrouted_initial",
+        run.submits,
+        w_fin - resubmits + w_shed + coord_shed + unrouted_initial,
+    )
+    ident("worker_submitted == worker_finished (quiescence)", w_sub, w_fin)
+    if run.coord_books is not None:
+        ident("submits == routed + coord_shed + unrouted_initial",
+              run.submits, routed + coord_shed + unrouted_initial)
+        ident("worker_submitted == routed + resubmits - worker_shed",
+              w_sub, routed + resubmits - w_shed)
+        ident("coord_shed observed == coord shed book",
+              coord_shed_obs, coord_shed)
+    if run.chaos_fired is not None:
+        # Exact chaos attribution: every counted death either became a
+        # transparent resubmit, surfaced as a worker-death ERROR (second
+        # death / mid-stream death / retries spent), or failed its
+        # resubmit routing (unrouted under the original rid).
+        deaths = run.chaos_fired.get("deaths", 0)
+        ident(
+            "FaultPlan deaths == resubmits + surfaced death errors + "
+            "resubmit_failures",
+            deaths, resubmits + death_errors + unrouted_resubmit,
+        )
+    flight_terms = sum(s.get("recorded", 0) for s in run.flight_stats)
+    dropped = sum(s.get("dropped", 0) for s in run.flight_stats)
+    open_reqs = sum(s.get("open_requests", 0) for s in run.flight_stats)
+    if run.flight_stats:
+        ident("flight open_requests == 0 (all books closed)", open_reqs, 0)
+    ok = all(i["ok"] for i in identities)
+    ok = ok and lost == 0 and run.driver_errors == 0
+    partial_mm = sum(1 for o in outcomes if _partial_mismatch(o))
+    ok = ok and partial_mm == 0
+    return {
+        "ok": ok,
+        "offered_requests": len(run.trace),
+        "engine_submits": run.submits,
+        "terminals_observed": terminals,
+        "lost_streams": lost,
+        "driver_errors": run.driver_errors,
+        "partial_count_mismatches": partial_mm,
+        "worker_submitted": w_sub,
+        "worker_finished": w_fin,
+        "worker_shed": w_shed,
+        "coordinator": run.coord_books,
+        "unrouted_initial": unrouted_initial,
+        "unrouted_resubmit": unrouted_resubmit,
+        "death_errors_observed": death_errors,
+        "chaos_fired": run.chaos_fired,
+        "flight": {
+            "recorders": len(run.flight_stats),
+            "events_recorded": flight_terms,
+            "dropped": dropped,
+            "open_requests": open_reqs,
+            # Request ids ambiguous across workers' recorders (real
+            # engines share the "req-N" namespace): dropped from the
+            # breakdown join instead of cross-wiring class latencies.
+            "id_collisions": getattr(run, "breakdown_collisions", 0),
+        },
+        "identities": identities,
+    }
+
+
+def build_report(run) -> dict:
+    classes = classes_by_name(run.plan.classes)
+    offered_by_class: dict = {name: [] for name in classes}
+    for req in run.trace:
+        offered_by_class[req.klass].append(req)
+    outcomes_by_class: dict = {name: [] for name in classes}
+    for out in run.outcomes:
+        outcomes_by_class.setdefault(out.klass, []).append(out)
+    cells = {}
+    for name, cls in classes.items():
+        if cls.duplex and run.duplex_skipped and not outcomes_by_class[name]:
+            cells[name] = {
+                "offered": len(offered_by_class[name]),
+                "skipped": run.duplex_skip_reason or "duplex unavailable",
+            }
+            continue
+        cells[name] = _class_cell(
+            cls, offered_by_class[name], outcomes_by_class[name], run
+        )
+    scored = [c for c in cells.values() if "slo" in c]
+    failing = [f for c in scored for f in c["slo"]["failures"]]
+    ledger = _ledger(run, run.outcomes)
+    return {
+        "schema_version": SCHEMA_VERSION,
+        "seed": run.plan.seed,
+        "duration_s": run.plan.duration_s,
+        "wall_s": round(run.wall_s, 3),
+        "offered_sha256": run.offered_sha256,
+        "concurrency": {
+            "pool": run.pool_stats,
+        },
+        "classes": cells,
+        "slo": {
+            "passed": not failing,
+            "failures": failing,
+            "classes_scored": len(scored),
+        },
+        "ledger": ledger,
+        "duplex_skipped": run.duplex_skipped,
+        "ttft_source": "flight-recorder LatencyBreakdown terminals "
+                       "(engine stages); client wall clocks labeled "
+                       "*_client/_from_intended",
+    }
+
+
+def summary_lines(report: dict) -> list:
+    """Human-oriented per-class table for the CLI."""
+    lines = [
+        f"trafficsim seed={report['seed']} offered="
+        f"{report['ledger']['offered_requests']} submits="
+        f"{report['ledger']['engine_submits']} "
+        f"ledger={'OK' if report['ledger']['ok'] else 'BROKEN'} "
+        f"slo={'PASS' if report['slo']['passed'] else 'FAIL'}",
+        f"{'class':<20}{'offered':>8}{'ttft_p95':>10}{'itl_p95':>9}"
+        f"{'attain':>8}{'goodput':>9}  finish",
+    ]
+    for name, cell in sorted(report["classes"].items()):
+        if "slo" not in cell:
+            lines.append(f"{name:<20}{cell.get('offered', 0):>8}  "
+                         f"skipped: {cell.get('skipped')}")
+            continue
+        slo = cell["slo"]
+        fin = ",".join(
+            f"{k}:{v}" for k, v in cell["finish"].items() if v
+        )
+        t95 = cell["ttft_engine_ms"]["p95"]
+        i95 = cell["itl_engine_ms"]["p95"]
+        att = slo["attainment"]
+        lines.append(
+            f"{name:<20}{cell['offered']:>8}"
+            f"{(f'{t95:.0f}ms' if t95 is not None else '-'):>10}"
+            f"{(f'{i95:.1f}' if i95 is not None else '-'):>9}"
+            f"{(f'{att:.2f}' if att is not None else '-'):>8}"
+            f"{slo['goodput_tok_s']:>9.1f}  {fin}"
+        )
+    for f in report["slo"]["failures"]:
+        lines.append(f"  SLO FAIL: {f}")
+    for i in report["ledger"]["identities"]:
+        if i["ok"] is False:
+            lines.append(
+                f"  LEDGER BROKEN: {i['name']}: {i['lhs']} != {i['rhs']}"
+            )
+    return lines

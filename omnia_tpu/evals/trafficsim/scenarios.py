@@ -1,0 +1,208 @@
+"""Scenario classes: the traffic mix the simulator plays.
+
+A :class:`ScenarioClass` is a declarative description of one kind of
+production traffic — how it arrives (open-loop process), what its
+requests look like (prompt size, output budget, turns, deadline,
+mid-stream cancel, grammar constraint, duplex voice), and what SLO it
+is held to. The generator expands each class into a concrete offered
+trace; the simulator plays the trace; the report scores each class
+against its own :class:`SLOTarget` — a fleet that nails bursty chat
+while starving RAG tails shows up as exactly that.
+
+The defaults cover the reference arena worker's scenario diversity
+(SURVEY §2.10/§3.4) plus this engine's own hard cases: bursty
+short-turn chat, long-prompt RAG, grammar/tool-calling turns,
+mid-stream cancels, deadline-sensitive short turns, multi-turn
+session reuse, and duplex/barge-in voice sessions.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+from omnia_tpu.evals.trafficsim.arrivals import ArrivalSpec
+
+
+@dataclasses.dataclass(frozen=True)
+class SLOTarget:
+    """Per-class service-level objective.
+
+    A request MEETS its SLO when it produced a first token within
+    ``ttft_ms`` of its INTENDED start (open-loop clock — scheduling
+    lag counts against the server, the coordinated-omission-honest
+    reading) and did not terminate in error/overloaded/deadline.
+    ``min_attainment`` is the fraction of the class's offered requests
+    that must meet it for the class to pass. Client-initiated cancels
+    and duplex barge-ins count as met when the first token was on time
+    — the user got what they asked for and then changed their mind."""
+
+    ttft_ms: float = 500.0
+    itl_p95_ms: Optional[float] = None  # engine inter-token gap bound
+    min_attainment: float = 0.9
+    max_error_rate: float = 0.0
+
+
+@dataclasses.dataclass(frozen=True)
+class ScenarioClass:
+    """One traffic class: arrivals + request shape + SLO."""
+
+    name: str
+    arrival: ArrivalSpec
+    # Prompt size band in TOKENS (byte tokenizer: ~1 token per ASCII
+    # char + BOS); each offered request draws uniformly inside it.
+    prompt_tokens: "tuple[int, int]" = (24, 48)
+    max_tokens: int = 64
+    # Sequential turns per offered request, same session_id (cross-turn
+    # KV reuse); turn N+1 only submits after turn N's terminal.
+    turns: int = 1
+    # Per-request TTL (engine FinishReason.DEADLINE); None = no TTL.
+    deadline_s: Optional[float] = None
+    # Client cancels mid-stream after receiving this many tokens.
+    cancel_after_tokens: Optional[int] = None
+    # JSON-schema grammar constraint (engine/grammar), serialized so the
+    # dataclass stays frozen/hashable; None = unconstrained.
+    grammar_schema_json: Optional[str] = None
+    # Engine stop ids for grammar turns (byte 0 plays EOS for grammars
+    # over the byte tokenizer — never admissible inside JSON).
+    stop_token_ids: "tuple[int, ...]" = ()
+    # Duplex voice session via the runtime's duplex surface; barge in
+    # (interrupt playback + cancel the turn) after this many media
+    # chunks, None = listen to the full reply.
+    duplex: bool = False
+    barge_in_after_chunks: Optional[int] = None
+    slo: SLOTarget = dataclasses.field(default_factory=SLOTarget)
+
+    def __post_init__(self) -> None:
+        lo, hi = self.prompt_tokens
+        if not (0 < lo <= hi):
+            raise ValueError(f"bad prompt_tokens band {self.prompt_tokens}")
+        if self.turns < 1:
+            raise ValueError("turns must be >= 1")
+        if self.duplex and self.turns != 1:
+            raise ValueError("duplex classes are single-turn sessions")
+
+
+# The grammar the tool-calling class constrains to — small enough for
+# any grammar_max_states budget, real enough to prove masked decoding
+# end-to-end (the mock force-completes garbage scripts into it; the
+# real engine masks the sampler with it).
+TOOL_SCHEMA_JSON = (
+    '{"type": "object", "properties": {'
+    '"tool": {"type": "string", "enum": ["search", "lookup"]}, '
+    '"k": {"type": "integer"}}, '
+    '"required": ["tool", "k"]}'
+)
+
+
+def default_classes(rate_scale: float = 1.0,
+                    include_duplex: bool = True,
+                    max_prompt_tokens: int = 0) -> "tuple[ScenarioClass, ...]":
+    """The standard mixed-traffic plan. ``rate_scale`` multiplies every
+    class's arrival rate (sizing knob); ``max_prompt_tokens`` > 0 clamps
+    every prompt band (real-engine runs must fit the prefill buckets);
+    ``include_duplex=False`` drops the voice class (its driver needs the
+    runtime package, which imports jax via the provider layer)."""
+
+    def band(lo: int, hi: int) -> "tuple[int, int]":
+        if max_prompt_tokens > 0:
+            lo = min(lo, max_prompt_tokens)
+            hi = min(hi, max_prompt_tokens)
+        return (lo, hi)
+
+    classes = [
+        # Bursty short-turn chat: the MMPP peaks are the point.
+        ScenarioClass(
+            name="chat_bursty",
+            arrival=ArrivalSpec(profile="mmpp", rate_rps=6.0 * rate_scale),
+            prompt_tokens=band(16, 40), max_tokens=48,
+            slo=SLOTarget(ttft_ms=400.0, itl_p95_ms=80.0,
+                          min_attainment=0.9),
+        ),
+        # Long-prompt RAG: prefill-heavy, ramping up over the run.
+        ScenarioClass(
+            name="rag_long",
+            arrival=ArrivalSpec(profile="ramp", rate_rps=2.0 * rate_scale),
+            prompt_tokens=band(192, 320), max_tokens=96,
+            slo=SLOTarget(ttft_ms=1200.0, min_attainment=0.85),
+        ),
+        # Grammar/tool-calling turns: masked decoding under load.
+        ScenarioClass(
+            name="grammar_tool",
+            arrival=ArrivalSpec(profile="poisson", rate_rps=2.0 * rate_scale),
+            prompt_tokens=band(24, 48), max_tokens=64,
+            grammar_schema_json=TOOL_SCHEMA_JSON,
+            stop_token_ids=(0,),
+            slo=SLOTarget(ttft_ms=600.0, min_attainment=0.9),
+        ),
+        # Mid-stream cancels: users navigating away; partial books must
+        # reconcile exactly.
+        ScenarioClass(
+            name="cancel_midstream",
+            arrival=ArrivalSpec(profile="poisson", rate_rps=2.0 * rate_scale),
+            prompt_tokens=band(16, 32), max_tokens=128,
+            cancel_after_tokens=8,
+            slo=SLOTarget(ttft_ms=500.0, min_attainment=0.9),
+        ),
+        # Deadline-sensitive short turns: tight TTLs — sized so a
+        # lightly-loaded serve finishes inside the TTL and queue
+        # pressure / chaos pushes the tail over it (shed-don't-queue).
+        ScenarioClass(
+            name="deadline_short",
+            arrival=ArrivalSpec(profile="poisson", rate_rps=3.0 * rate_scale),
+            prompt_tokens=band(12, 24), max_tokens=32,
+            deadline_s=0.35,
+            slo=SLOTarget(ttft_ms=300.0, min_attainment=0.8),
+        ),
+        # Multi-turn session reuse: cross-turn KV residency + affinity.
+        ScenarioClass(
+            name="session_multiturn",
+            arrival=ArrivalSpec(profile="diurnal", rate_rps=1.5 * rate_scale),
+            prompt_tokens=band(16, 28), max_tokens=40, turns=2,
+            slo=SLOTarget(ttft_ms=700.0, min_attainment=0.85),
+        ),
+    ]
+    if include_duplex:
+        classes.append(ScenarioClass(
+            name="duplex_voice",
+            arrival=ArrivalSpec(profile="poisson", rate_rps=1.0 * rate_scale),
+            prompt_tokens=band(12, 24), max_tokens=64,
+            duplex=True, barge_in_after_chunks=2,
+            slo=SLOTarget(ttft_ms=800.0, min_attainment=0.8),
+        ))
+    return tuple(classes)
+
+
+def classes_by_name(classes) -> dict:
+    return {c.name: c for c in classes}
+
+
+def mock_scenarios():
+    """Scripted MockEngine behaviors keyed on the class marker every
+    generated prompt carries (``sim <class> ``) — class-appropriate
+    reply lengths and latency shapes so a mock fleet produces realistic
+    per-class contrast with zero model. Import is local so this module
+    stays importable without the engine package loaded."""
+    from omnia_tpu.engine.mock import Scenario
+
+    return [
+        Scenario(pattern=r"sim chat_bursty ", reply="b" * 40,
+                 ttft_s=0.004, delay_per_token_s=0.0008),
+        Scenario(pattern=r"sim rag_long ", reply="r" * 88,
+                 ttft_s=0.02, delay_per_token_s=0.0008),
+        # Garbage script: the mock's constrained path force-completes it
+        # into schema-valid output — exactly what masked sampling does
+        # to a misbehaving model.
+        Scenario(pattern=r"sim grammar_tool ", reply="g" * 48,
+                 ttft_s=0.006, delay_per_token_s=0.0008),
+        Scenario(pattern=r"sim cancel_midstream ", reply="c" * 120,
+                 ttft_s=0.004, delay_per_token_s=0.002),
+        Scenario(pattern=r"sim deadline_short ", reply="d" * 60,
+                 ttft_s=0.01, delay_per_token_s=0.002),
+        Scenario(pattern=r"sim session_multiturn ", reply="s" * 36,
+                 ttft_s=0.005, delay_per_token_s=0.0008),
+        Scenario(pattern=r"sim duplex_voice ", reply="v" * 64,
+                 ttft_s=0.004, delay_per_token_s=0.002),
+        Scenario(pattern=r".", reply="fallback-reply",
+                 ttft_s=0.002, delay_per_token_s=0.0008),
+    ]

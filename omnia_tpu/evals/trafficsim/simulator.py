@@ -1,0 +1,577 @@
+"""The virtual-user fleet driver: play an offered trace at a target.
+
+A :class:`TrafficSimulator` takes any engine-compatible TARGET — a
+``MockEngine``, a real ``InferenceEngine``, or an ``EngineCoordinator``
+fronting a fleet of either — and plays a :class:`TrafficPlan`'s offered
+trace against it through the arena VU pool
+(:mod:`omnia_tpu.evals.vu_pool`): virtual users pop offered requests in
+intended-start order, wait out each request's open-loop intended start,
+submit, and drain the stream, recording client-side timings per turn.
+The concurrency gate is the pool's :class:`LoadProfile`, optionally
+ramped down by the target's ``pending_prefill_tokens()`` backlog (the
+SURVEY §5.8 queue-depth signal, end to end).
+
+What the simulator deliberately does NOT do:
+
+- It never reshapes the offered trace: a slow target serves the same
+  trace late, and the lateness is recorded (``submit_at - intended_at``)
+  instead of flattering the percentiles (coordinated-omission guard).
+- It never invents latency numbers: engine-side TTFT/ITL/queue
+  percentiles come from the flight recorder's per-request
+  ``LatencyBreakdown`` terminals, joined back to the sim's submits by
+  request id — wall-clock client timings ride beside them, labeled.
+- It never hides a terminal: every submit is drained to its final
+  event, and the report reconciles offered == terminals == the engine
+  and coordinator books exactly (:mod:`.report`).
+
+Chaos (`engine/faults.py`) is injectable mid-run: ``chaos`` +
+``chaos_at_s`` arm a counted :class:`FaultPlan` on every worker at the
+given elapsed time; the plan's ``fired`` counters feed the ledger.
+
+Jax-free by contract like the rest of the package: the duplex scenario
+class needs the runtime's duplex surface, whose provider layer imports
+jax — that import is lazy and failure degrades to skipping duplex
+requests with the reason recorded in the run, so the generator/report
+path (and the CLI against mock fleets) runs in jax-less containers.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from typing import Optional
+
+from omnia_tpu.engine.faults import FaultPlan
+from omnia_tpu.engine.types import SamplingParams
+from omnia_tpu.evals.trafficsim.generator import (
+    OfferedRequest,
+    TrafficPlan,
+    generate_offered,
+    offered_digest,
+)
+from omnia_tpu.evals.vu_pool import LoadProfile, VUPool
+
+#: Worker/coordinator metric keys the ledger snapshots (diffed around
+#: the run so pre-warmed or reused targets reconcile too).
+WORKER_KEYS = (
+    "requests_submitted", "requests_finished", "requests_shed",
+    "deadline_exceeded", "tokens_generated", "watchdog_trips",
+)
+COORD_KEYS = (
+    "routed", "shed", "resubmits", "failovers", "prefix_routed",
+    "affinity_evictions",
+)
+
+
+@dataclasses.dataclass
+class TurnOutcome:
+    """Client-observed record of one submitted engine turn (or one
+    duplex session). All *_s offsets are seconds from run start."""
+
+    index: int
+    klass: str
+    turn_index: int
+    request_id: str = ""
+    intended_at_s: float = 0.0
+    submit_at_s: float = 0.0
+    first_token_at_s: Optional[float] = None
+    end_at_s: float = 0.0
+    finish: str = ""              # FinishReason.value | "interrupted" | "lost"
+    error: Optional[str] = None
+    tokens_streamed: int = 0
+    num_generated: int = 0
+    num_prompt_tokens: int = 0
+    cancelled_by_client: bool = False
+    duplex: bool = False
+    media_chunks: int = 0
+
+
+@dataclasses.dataclass
+class SimRun:
+    """Everything one run produced — the report builds from this."""
+
+    plan: TrafficPlan
+    trace: list
+    offered_sha256: str
+    outcomes: list
+    submits: int
+    worker_books: list          # per-worker {key: delta}
+    coord_books: Optional[dict]
+    breakdowns: dict            # request_id -> terminal attrs (flight)
+    breakdown_collisions: int   # rids ambiguous across workers (dropped)
+    flight_stats: list          # per-recorder stats() snapshots
+    chaos_fired: Optional[dict]
+    pool_stats: dict
+    wall_s: float
+    duplex_skipped: int = 0
+    duplex_skip_reason: Optional[str] = None
+    driver_errors: int = 0
+
+    def report(self) -> dict:
+        from omnia_tpu.evals.trafficsim.report import build_report
+
+        return build_report(self)
+
+
+class _DuplexRuntime:
+    """Lazily-built shared state for duplex sessions (pack, store,
+    speech pair). Import failure is remembered and reported, never
+    raised into the run."""
+
+    def __init__(self) -> None:
+        self.ready = False
+        self.error: Optional[str] = None
+        self.pack = None
+        self.store = None
+        self.speech = None
+        self.conversation_cls = None
+        self.session_cls = None
+        self.message_cls = None
+
+    def build(self) -> bool:
+        if self.ready or self.error is not None:
+            return self.ready
+        try:
+            from omnia_tpu.runtime.context_store import InMemoryContextStore
+            from omnia_tpu.runtime.conversation import Conversation
+            from omnia_tpu.runtime.duplex import (
+                DuplexSession,
+                MockStt,
+                MockTts,
+                SpeechSupport,
+            )
+            from omnia_tpu.runtime.packs import load_pack
+        except Exception as e:  # noqa: BLE001 — degrade, don't crash the run
+            self.error = f"runtime duplex surface unavailable: {e!r}"
+            return False
+        self.pack = load_pack({
+            "name": "trafficsim-voice", "version": "1.0.0",
+            "prompts": {"system": "You are a voice agent."},
+            "sampling": {"max_tokens": 64, "temperature": 0.0},
+        })
+        self.store = InMemoryContextStore()
+        self.speech = SpeechSupport(MockStt(), MockTts())
+        self.conversation_cls = Conversation
+        self.session_cls = DuplexSession
+        self.ready = True
+        return True
+
+
+class _CountingEngine:
+    """Thin submit proxy handed to duplex Conversations so their engine
+    requests land in the same submit ledger (and request-id map) as the
+    direct turns."""
+
+    def __init__(self, inner, on_submit) -> None:
+        self._inner = inner
+        self._on_submit = on_submit
+
+    def submit(self, *args, **kwargs):
+        handle = self._inner.submit(*args, **kwargs)
+        self._on_submit(handle)
+        return handle
+
+    def register_prefix(self, tokens) -> None:
+        reg = getattr(self._inner, "register_prefix", None)
+        if reg is not None:
+            reg(tokens)
+
+
+class TrafficSimulator:
+    """Drive one :class:`TrafficPlan` at one target; collect a
+    :class:`SimRun`. One-shot: build a fresh simulator per run."""
+
+    def __init__(
+        self,
+        target,
+        plan: TrafficPlan,
+        concurrency: int = 16,
+        ramp_up_s: float = 0.0,
+        backlog_limit_tokens: int = 0,
+        chaos: Optional[FaultPlan] = None,
+        chaos_at_s: float = 0.0,
+        tokenizer=None,
+        turn_timeout_s: float = 30.0,
+        temperature: float = 0.0,
+    ) -> None:
+        from omnia_tpu.engine.tokenizer import ByteTokenizer
+
+        self.target = target
+        self.plan = plan
+        self.concurrency = max(1, concurrency)
+        self.ramp_up_s = ramp_up_s
+        self.backlog_limit_tokens = backlog_limit_tokens
+        self.chaos = chaos
+        self.chaos_at_s = max(0.0, chaos_at_s)
+        self.tokenizer = tokenizer or ByteTokenizer()
+        self.turn_timeout_s = turn_timeout_s
+        self.temperature = temperature
+        # The fleet behind the target: coordinator exposes .workers; a
+        # bare engine IS its own single-worker fleet.
+        self.workers = list(getattr(target, "workers", None) or [target])
+        self._is_coordinator = hasattr(target, "workers")
+        self._lock = threading.Lock()
+        self._outcomes: list = []           # guarded-by: _lock
+        self._submits = 0                   # guarded-by: _lock
+        self._next = 0                      # guarded-by: _lock
+        self._duplex_skipped = 0            # guarded-by: _lock
+        self._driver_errors = 0             # guarded-by: _lock
+        self._grammars: dict = {}           # guarded-by: _lock
+        self._rid_map: dict = {}            # guarded-by: _lock
+        self._t0 = 0.0
+        self._stop = threading.Event()
+        self._duplex_rt = _DuplexRuntime()
+
+    # -- bookkeeping helpers --------------------------------------------
+
+    def _now_s(self) -> float:
+        return time.monotonic() - self._t0
+
+    def _note_submit(self, handle, index: int, klass: str) -> None:
+        with self._lock:
+            self._submits += 1
+            self._rid_map[handle.request_id] = (index, klass)
+
+    def _grammar_for(self, req: OfferedRequest):
+        if req.grammar_schema_json is None:
+            return None
+        with self._lock:
+            g = self._grammars.get(req.grammar_schema_json)
+        if g is not None:
+            return g
+        import json as _json
+
+        from omnia_tpu.engine.grammar.cache import compile_json_schema
+
+        g = compile_json_schema(
+            _json.loads(req.grammar_schema_json), self.tokenizer
+        )
+        with self._lock:
+            self._grammars[req.grammar_schema_json] = g
+        return g
+
+    def _books(self) -> "tuple[list, Optional[dict]]":
+        workers = [
+            {k: w.metrics.get(k, 0) for k in WORKER_KEYS}
+            for w in self.workers
+        ]
+        coord = None
+        if self._is_coordinator:
+            coord = {k: self.target.metrics.get(k, 0) for k in COORD_KEYS}
+        return workers, coord
+
+    def _arm_chaos(self) -> None:
+        if self.chaos is None:
+            return
+        for w in self.workers:
+            # MockEngine exposes `fault_plan`; InferenceEngine's seam is
+            # `_fault_plan` — same counted plan object either way, so
+            # `fired` reconciles across the whole fleet.
+            if hasattr(w, "fault_plan"):
+                w.fault_plan = self.chaos
+            else:
+                w._fault_plan = self.chaos
+
+    # -- VU callbacks ----------------------------------------------------
+
+    def _source(self, vu_id: int) -> Optional[OfferedRequest]:
+        with self._lock:
+            if self._next >= len(self._trace):
+                return None
+            req = self._trace[self._next]
+            self._next += 1
+        # Open-loop pacing: wait out the intended start. The schedule is
+        # immutable — a busy fleet just submits LATE, and the lateness is
+        # recorded per turn instead of stretching the offered trace.
+        while not self._stop.is_set():
+            lag = (self._t0 + req.intended_at_s) - time.monotonic()
+            if lag <= 0:
+                break
+            time.sleep(min(lag, 0.02))
+        if self._stop.is_set():
+            # Run aborted (pool timeout) while this VU waited: do NOT
+            # submit — a post-stop submit would race the ledger snapshot
+            # and start before its intended time. The request stays
+            # unsubmitted; the ledger reconciles on submits, and
+            # offered_requests > engine_submits tells the story.
+            return None
+        return req
+
+    def _execute(self, vu_id: int, req: OfferedRequest) -> list:
+        if req.duplex:
+            return self._run_duplex(req)
+        return self._run_direct(req)
+
+    def _report_cb(self, req: OfferedRequest, result) -> None:
+        with self._lock:
+            if isinstance(result, Exception):
+                # A driver bug, not a server outcome — surfaced as its
+                # own counter so the ledger fails loudly instead of
+                # silently losing offered requests.
+                self._driver_errors += 1
+            else:
+                self._outcomes.extend(result)
+
+    # -- direct (engine-stream) scenario classes -------------------------
+
+    def _run_direct(self, req: OfferedRequest) -> list:
+        outcomes = []
+        history = ""
+        grammar = self._grammar_for(req)
+        for ti, turn in enumerate(req.turns):
+            prompt_text = history + turn.text
+            ids = self.tokenizer.encode(prompt_text)
+            sp = SamplingParams(
+                temperature=self.temperature, max_tokens=turn.max_tokens,
+                stop_token_ids=req.stop_token_ids,
+            )
+            kwargs: dict = {}
+            if req.session_id is not None:
+                kwargs["session_id"] = req.session_id
+            if grammar is not None:
+                kwargs["grammar"] = grammar
+            if req.deadline_s is not None:
+                kwargs["deadline_s"] = req.deadline_s
+            out = TurnOutcome(
+                index=req.index, klass=req.klass, turn_index=ti,
+                intended_at_s=req.intended_at_s,
+            )
+            out.submit_at_s = self._now_s()
+            handle = self.target.submit(ids, sp, **kwargs)
+            self._note_submit(handle, req.index, req.klass)
+            out.request_id = handle.request_id
+            reply_ids: list = []
+            cancelled = False
+            try:
+                for ev in handle.events(timeout=self.turn_timeout_s):
+                    if ev.token_id is not None:
+                        if out.first_token_at_s is None:
+                            out.first_token_at_s = self._now_s()
+                        out.tokens_streamed += 1
+                        reply_ids.append(ev.token_id)
+                        if (turn.cancel_after_tokens is not None
+                                and not cancelled
+                                and out.tokens_streamed
+                                >= turn.cancel_after_tokens):
+                            handle.cancel()
+                            cancelled = True
+                            out.cancelled_by_client = True
+                    if ev.is_final:
+                        out.finish = ev.finish_reason.value
+                        out.error = ev.error
+                        out.num_generated = ev.num_generated_tokens
+                        out.num_prompt_tokens = ev.num_prompt_tokens
+            except Exception:  # noqa: BLE001 — queue.Empty: stream lost
+                out.finish = "lost"
+            out.end_at_s = self._now_s()
+            outcomes.append(out)
+            if out.finish not in ("stop", "length", "cancelled"):
+                # Deadline/shed/error ends the session script: the
+                # remaining turns were offered but are NOT submitted
+                # (the report books them as skipped turns).
+                break
+            history = prompt_text + self.tokenizer.decode(reply_ids) + "\n"
+        return outcomes
+
+    # -- duplex/barge-in scenario class ----------------------------------
+
+    def _run_duplex(self, req: OfferedRequest) -> list:
+        import base64
+
+        if not self._duplex_rt.build():
+            with self._lock:
+                self._duplex_skipped += 1
+            return []
+        rt = self._duplex_rt
+        out = TurnOutcome(
+            index=req.index, klass=req.klass, turn_index=0,
+            intended_at_s=req.intended_at_s, duplex=True,
+        )
+
+        def on_submit(handle) -> None:
+            self._note_submit(handle, req.index, req.klass)
+            out.request_id = handle.request_id
+
+        conv = rt.conversation_cls(
+            session_id=req.session_id or f"sim-duplex-{req.index}",
+            pack=rt.pack,
+            engine=_CountingEngine(self.target, on_submit),
+            tokenizer=self.tokenizer,
+            store=rt.store,
+        )
+        sess = rt.session_cls(conv, rt.speech)
+        from omnia_tpu.runtime.contract import ClientMessage
+
+        out.submit_at_s = self._now_s()
+        for _m in sess.handle_start(ClientMessage(type="duplex_start")):
+            pass
+        audio = base64.b64encode(req.turns[0].text.encode()).decode()
+        interrupted = False
+        for m in sess.handle_audio(ClientMessage(
+            type="audio_input", audio_b64=audio, final=True,
+        )):
+            if m.type == "media_chunk":
+                if out.first_token_at_s is None:
+                    out.first_token_at_s = self._now_s()
+                out.media_chunks += 1
+                if (req.barge_in_after_chunks is not None
+                        and not interrupted
+                        and out.media_chunks >= req.barge_in_after_chunks):
+                    sess.barge_in()
+                    interrupted = True
+                    out.cancelled_by_client = True
+            elif m.type == "interruption":
+                out.finish = "interrupted"
+            elif m.type == "done":
+                out.finish = m.finish_reason or "stop"
+                if m.usage is not None:
+                    out.num_generated = m.usage.completion_tokens
+            elif m.type == "error":
+                out.finish = "error"
+                out.error = m.error_message
+        if not out.finish:
+            out.finish = "lost"
+        out.tokens_streamed = out.media_chunks
+        out.end_at_s = self._now_s()
+        return [out]
+
+    # -- run --------------------------------------------------------------
+
+    def _quiesce(self, timeout_s: float = 5.0) -> None:
+        """Wait for the engine books to stop moving: terminals are
+        consumed synchronously, but the counters behind them are
+        incremented on playback threads a beat later — reconciliation
+        reads a settled fleet, never a racing one."""
+        deadline = time.monotonic() + timeout_s
+        prev = None
+        while time.monotonic() < deadline:
+            snap = tuple(
+                tuple(w.metrics.get(k, 0) for k in WORKER_KEYS)
+                for w in self.workers
+            )
+            if snap == prev:
+                return
+            prev = snap
+            time.sleep(0.05)
+
+    def run(self, timeout_s: Optional[float] = None) -> SimRun:
+        self._trace = generate_offered(self.plan)
+        digest = offered_digest(self._trace)
+        if any(r.duplex for r in self._trace):
+            # Build the duplex runtime BEFORE the clock starts: its
+            # import chain (runtime → providers → engine) pulls jax in
+            # jax-capable environments, a multi-second one-time cost
+            # that would otherwise land inside the measured window and
+            # stall the pool mid-run.
+            self._duplex_rt.build()
+        for req in self._trace:
+            if req.grammar_schema_json is not None:
+                # Likewise pre-compile grammars: the content-addressed
+                # cache makes every in-run lookup a hit.
+                self._grammar_for(req)
+        books0, coord0 = self._books()
+        profile = LoadProfile(
+            self.concurrency, ramp_up_s=self.ramp_up_s,
+            backlog_limit=self.backlog_limit_tokens,
+        )
+        backlog_cb = None
+        if self.backlog_limit_tokens > 0:
+            pending_fn = getattr(self.target, "pending_prefill_tokens", None)
+            if pending_fn is not None:
+                backlog_cb = pending_fn
+
+        def pending() -> int:
+            with self._lock:
+                return len(self._trace) - self._next
+
+        pool = VUPool(
+            concurrency=self.concurrency,
+            source=self._source,
+            execute=self._execute,
+            report=self._report_cb,
+            profile=profile,
+            pending=pending,
+            backlog=backlog_cb,
+        )
+        timer = None
+        if self.chaos is not None:
+            if self.chaos_at_s <= 0:
+                self._arm_chaos()
+            else:
+                timer = threading.Timer(self.chaos_at_s, self._arm_chaos)
+                timer.daemon = True
+        wall0 = time.monotonic()
+        self._t0 = wall0
+        if timer is not None:
+            timer.start()
+        budget = timeout_s if timeout_s is not None else (
+            self.plan.duration_s + 60.0
+        )
+        try:
+            pool_stats = pool.run(timeout_s=budget)
+        finally:
+            self._stop.set()
+            if timer is not None:
+                timer.cancel()
+        self._quiesce()
+        wall_s = time.monotonic() - wall0
+        books1, coord1 = self._books()
+        worker_books = [
+            {k: b1[k] - b0[k] for k in WORKER_KEYS}
+            for b0, b1 in zip(books0, books1)
+        ]
+        coord_books = None
+        if coord1 is not None:
+            coord_books = {k: coord1[k] - coord0[k] for k in COORD_KEYS}
+        breakdowns: dict = {}
+        flight_stats = []
+        with self._lock:
+            rid_map = dict(self._rid_map)
+        # Join guard: workers whose request-id namespaces overlap (two
+        # real InferenceEngines both emit "req-N"; MockEngine(name=)
+        # exists to avoid this for mock fleets) would cross-wire one
+        # class's LatencyBreakdown onto another's percentile books. A
+        # rid seen in MORE than one worker's terminals is ambiguous —
+        # dropped from the join and counted, never attributed wrong.
+        bd_owner: dict = {}
+        collided: set = set()
+        for wi, w in enumerate(self.workers):
+            rec = getattr(w, "_flight", None)
+            if rec is None:
+                continue
+            flight_stats.append(rec.stats())
+            for ev in rec.events("terminal"):
+                rid = ev.request_id
+                if rid not in rid_map or rid in collided:
+                    continue
+                if rid in bd_owner and bd_owner[rid] != wi:
+                    collided.add(rid)
+                    breakdowns.pop(rid, None)
+                    continue
+                bd_owner[rid] = wi
+                breakdowns[rid] = dict(ev.attrs)
+        with self._lock:
+            outcomes = list(self._outcomes)
+            submits = self._submits
+            duplex_skipped = self._duplex_skipped
+            driver_errors = self._driver_errors
+        return SimRun(
+            plan=self.plan,
+            trace=self._trace,
+            offered_sha256=digest,
+            outcomes=outcomes,
+            submits=submits,
+            worker_books=worker_books,
+            coord_books=coord_books,
+            breakdowns=breakdowns,
+            breakdown_collisions=len(collided),
+            flight_stats=flight_stats,
+            chaos_fired=(dict(self.chaos.fired)
+                         if self.chaos is not None else None),
+            pool_stats=pool_stats,
+            wall_s=wall_s,
+            duplex_skipped=duplex_skipped,
+            duplex_skip_reason=self._duplex_rt.error,
+            driver_errors=driver_errors,
+        )

@@ -95,11 +95,23 @@ class LoadProfile:
     """Allowed-concurrency schedule (reference load_profile.go): linear
     ramp-up to the target over ramp_up_s, and pending-aware ramp-down —
     when fewer items remain than VUs, idle VUs stand down instead of
-    hammering an empty queue."""
+    hammering an empty queue.
 
-    def __init__(self, concurrency: int, ramp_up_s: float = 0.0):
+    ``backlog_limit`` > 0 adds a second ramp-down input: the SERVER's
+    queue depth (the SURVEY §5.8 signal — the engine's
+    ``pending_prefill_tokens()`` backlog, in tokens), so the gate reacts
+    to how much prefill work the engine is already sitting on rather
+    than only to how many work items remain in the client's queue. The
+    allowance scales linearly from full (backlog 0) down to a floor of 1
+    at ``backlog_limit`` tokens — one VU always stays live so the pool
+    keeps observing the drain instead of deadlocking against a backlog
+    that only it can stop feeding."""
+
+    def __init__(self, concurrency: int, ramp_up_s: float = 0.0,
+                 backlog_limit: int = 0):
         self.concurrency = max(1, concurrency)
         self.ramp_up_s = max(0.0, ramp_up_s)
+        self.backlog_limit = max(0, backlog_limit)
         self._started_at: Optional[float] = None
 
     def start(self) -> None:
@@ -108,12 +120,18 @@ class LoadProfile:
     def elapsed(self) -> float:
         return 0.0 if self._started_at is None else time.monotonic() - self._started_at
 
-    def allowed(self, pending: Optional[int] = None) -> int:
+    def allowed(self, pending: Optional[int] = None,
+                backlog: Optional[int] = None) -> int:
         n = self.concurrency
         if self.ramp_up_s > 0:
             frac = min(1.0, self.elapsed() / self.ramp_up_s)
             # At least one VU from t=0 so the ramp isn't a dead start.
             n = max(1, int(frac * self.concurrency))
+        if self.backlog_limit > 0 and backlog is not None and backlog > 0:
+            # Queue-depth ramp-down: linear from full allowance at zero
+            # backlog to the 1-VU floor at/above backlog_limit.
+            frac = max(0.0, 1.0 - backlog / self.backlog_limit)
+            n = max(1, int(n * frac))
         if pending is not None and pending > 0:
             # Ramp-down: no more VUs than items remain. When pending is 0
             # the full allowance stays open so every VU can pop, observe
@@ -137,6 +155,9 @@ class VUPool:
     - `execute(vu_id, item)` → result (exceptions become error results;
       PoolStopped stops the whole pool)
     - `report(item, result)` → publish/ack
+    - `backlog()` → the server-side queue-depth signal (the engine's
+      ``pending_prefill_tokens()``) fed to the profile's backlog
+      ramp-down; None = client-side pending only.
     Each VU loops pop→execute→report while the profile allows its slot.
     """
 
@@ -148,6 +169,7 @@ class VUPool:
         report: Callable[[object, object], None],
         profile: Optional[LoadProfile] = None,
         pending: Optional[Callable[[], int]] = None,
+        backlog: Optional[Callable[[], int]] = None,
         poll_interval_s: float = 0.02,
     ):
         self.profile = profile or LoadProfile(concurrency)
@@ -156,16 +178,50 @@ class VUPool:
         self._execute = execute
         self._report = report
         self._pending = pending
+        self._backlog = backlog
         self._poll = poll_interval_s
         self._active = 0
         self._lock = threading.Lock()
         self._stop = threading.Event()
-        self.stats = {"executed": 0, "errors": 0, "max_active": 0}
+        # Backlog sample cache: the callback is a server stats sweep
+        # (worker RPCs under the target's locks) — N refused VUs each
+        # polling it every poll interval would hammer the very signal
+        # being measured, so at most ONE VU refreshes it per interval.
+        self._backlog_val: Optional[int] = None  # guarded-by: _lock
+        self._backlog_at = float("-inf")         # guarded-by: _lock
+        self.stats = {"executed": 0, "errors": 0, "max_active": 0,
+                      "backlog_gated": 0}
+
+    def _backlog_cached(self) -> Optional[int]:
+        if self._backlog is None:
+            return None
+        now = time.monotonic()
+        refresh = False
+        with self._lock:
+            if now - self._backlog_at >= self._poll:
+                self._backlog_at = now  # claim: one refresher per interval
+                refresh = True
+        if refresh:
+            val = self._backlog()  # outside the lock: may be an RPC sweep
+            with self._lock:
+                self._backlog_val = val
+        with self._lock:
+            return self._backlog_val
 
     def _try_acquire(self, vu_id: int) -> bool:
+        # Both signals are read OUTSIDE the lock: pending()/backlog() may
+        # be worker RPCs, and a slow stats call under the pool lock would
+        # serialize every VU behind one bad server (the _pick bug class).
         pend = self._pending() if self._pending else None
+        back = self._backlog_cached()
         with self._lock:
-            if self._active >= self.profile.allowed(pend):
+            if self._active >= self.profile.allowed(pend, back):
+                if (back is not None
+                        and self._active < self.profile.allowed(pend)):
+                    # The refusal came from the BACKLOG ramp-down, not
+                    # from items-remaining or the ramp — the observable
+                    # evidence the queue-depth gate actually engaged.
+                    self.stats["backlog_gated"] += 1
                 return False
             self._active += 1
             self.stats["max_active"] = max(self.stats["max_active"], self._active)

@@ -278,6 +278,11 @@ def _arena_job_schema() -> dict:
             "min_pass_rate": _NUM,
             "max_error_rate": _NUM,
             "max_p95_latency_s": _NUM,
+            # Simulator SLO gates (evals/trafficsim → Aggregator
+            # add_slo_cells): attainment + flight-recorder percentiles.
+            "min_slo_attainment": _NUM,
+            "max_p95_ttft_ms": _NUM,
+            "max_p95_itl_ms": _NUM,
         }),
     }, required=["providers"])
 

@@ -105,6 +105,10 @@ KNOB_GUARDS = {
     "MockEngine.coldstart":
         "structural: injected progress tracker (ColdStartTracker); "
         "default-constructed when absent, never a behavior switch",
+    "MockEngine.name":
+        "structural: request-id prefix only (fleet-unique ids for the "
+        "traffic simulator's flight-terminal join); never a behavior "
+        "switch — default keeps the historical 'mock-N' ids",
 }
 
 

@@ -1,0 +1,859 @@
+"""Traffic-simulator suite (ISSUE 14): seeded offered-trace determinism,
+open-loop coordinated-omission guard, exact ledger reconciliation under
+counted chaos, cancel/deadline partial-count exactness, flight-sourced
+latency percentiles, the VU-pool backlog gate, SLO threshold gating, and
+mock-vs-real report schema parity.
+
+Module top is jax-free by design: everything except the real-engine
+parity battery and the duplex driver runs under the CI analysis job's
+poisoned jax stub (``pytest -m sim --noconftest``).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from omnia_tpu.engine.coordinator import EngineCoordinator
+from omnia_tpu.engine.faults import FaultPlan
+from omnia_tpu.engine.mock import MockEngine, Scenario
+from omnia_tpu.engine.types import FinishReason, SamplingParams
+from omnia_tpu.evals.aggregator import Aggregator
+from omnia_tpu.evals.defs import Threshold
+from omnia_tpu.evals.trafficsim import (
+    ArrivalSpec,
+    ScenarioClass,
+    SLOTarget,
+    TrafficPlan,
+    TrafficSimulator,
+    arrival_times,
+    default_classes,
+    generate_offered,
+    mock_scenarios,
+    offered_digest,
+)
+from omnia_tpu.evals.trafficsim.arrivals import interval_counts
+from omnia_tpu.evals.vu_pool import LoadProfile, VUPool
+
+pytestmark = pytest.mark.sim
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+TOOL_SCHEMA = {
+    "type": "object",
+    "properties": {"tool": {"type": "string", "enum": ["search", "lookup"]},
+                   "k": {"type": "integer"}},
+    "required": ["tool", "k"],
+}
+
+
+def _test_classes(deadline: bool = True, cancel: bool = True,
+                  grammar: bool = True, multiturn: bool = True):
+    """A fast, controlled mix for hermetic runs: every special scenario
+    shape (grammar turns, mid-stream cancels, deadline turns, session
+    reuse) in a sub-second plan."""
+    out = [ScenarioClass(
+        name="chat_bursty",
+        arrival=ArrivalSpec(profile="mmpp", rate_rps=18.0,
+                            dwell_s=0.25, burst_dwell_s=0.1),
+        prompt_tokens=(16, 32), max_tokens=24,
+        slo=SLOTarget(ttft_ms=400.0),
+    )]
+    if grammar:
+        out.append(ScenarioClass(
+            name="grammar_tool",
+            arrival=ArrivalSpec(profile="poisson", rate_rps=4.0),
+            prompt_tokens=(20, 32), max_tokens=48,
+            grammar_schema_json=json.dumps(TOOL_SCHEMA),
+            stop_token_ids=(0,),
+            slo=SLOTarget(ttft_ms=600.0),
+        ))
+    if cancel:
+        out.append(ScenarioClass(
+            name="cancel_midstream",
+            arrival=ArrivalSpec(profile="poisson", rate_rps=4.0),
+            prompt_tokens=(16, 24), max_tokens=96,
+            cancel_after_tokens=4,
+            slo=SLOTarget(ttft_ms=500.0),
+        ))
+    if deadline:
+        # ttft sleep (80 ms) > TTL (40 ms): deterministic DEADLINE with
+        # zero tokens at the worker, never a pre-route reap.
+        out.append(ScenarioClass(
+            name="deadline_short",
+            arrival=ArrivalSpec(profile="poisson", rate_rps=4.0),
+            prompt_tokens=(12, 20), max_tokens=16,
+            deadline_s=0.04,
+            slo=SLOTarget(ttft_ms=300.0, min_attainment=0.0),
+        ))
+    if multiturn:
+        out.append(ScenarioClass(
+            name="session_multiturn",
+            arrival=ArrivalSpec(profile="poisson", rate_rps=6.0),
+            prompt_tokens=(12, 20), max_tokens=16, turns=2,
+            slo=SLOTarget(ttft_ms=700.0),
+        ))
+    return tuple(out)
+
+
+def _test_mock_scenarios():
+    return [
+        Scenario(pattern=r"sim chat_bursty ", reply="b" * 24,
+                 ttft_s=0.002, delay_per_token_s=0.0005),
+        Scenario(pattern=r"sim grammar_tool ", reply="g" * 40,
+                 ttft_s=0.002, delay_per_token_s=0.0005),
+        Scenario(pattern=r"sim cancel_midstream ", reply="c" * 96,
+                 ttft_s=0.002, delay_per_token_s=0.002),
+        Scenario(pattern=r"sim deadline_short ", reply="d" * 16,
+                 ttft_s=0.08, delay_per_token_s=0.0005),
+        Scenario(pattern=r"sim session_multiturn ", reply="s" * 16,
+                 ttft_s=0.002, delay_per_token_s=0.0005),
+        Scenario(pattern=r".", reply="fallback", ttft_s=0.002),
+    ]
+
+
+def _fleet(n=2, fault_plan=None, flight_events=2048, max_queue=0,
+           max_worker_queue=0):
+    workers = [
+        MockEngine(_test_mock_scenarios(), name=f"w{i}",
+                   flight_events=flight_events, fault_plan=fault_plan,
+                   max_queue=max_queue, prefill_chunk_tokens=16)
+        for i in range(n)
+    ]
+    coord = EngineCoordinator(workers, max_worker_queue=max_worker_queue,
+                              flight_events=512)
+    return coord, workers
+
+
+def _ident(report, name):
+    for i in report["ledger"]["identities"]:
+        if i["name"].startswith(name):
+            return i
+    raise AssertionError(
+        f"identity {name!r} not in "
+        f"{[i['name'] for i in report['ledger']['identities']]}"
+    )
+
+
+# ---------------------------------------------------------------------------
+# Arrival processes.
+# ---------------------------------------------------------------------------
+
+
+class TestArrivals:
+    def test_deterministic_per_seed(self):
+        for profile in ("poisson", "mmpp", "ramp", "diurnal"):
+            spec = ArrivalSpec(profile=profile, rate_rps=20.0)
+            a = arrival_times(spec, 5.0, seed=42)
+            b = arrival_times(spec, 5.0, seed=42)
+            assert a == b
+            assert a != arrival_times(spec, 5.0, seed=43)
+            assert all(0 <= t < 5.0 for t in a)
+            assert a == sorted(a)
+            # Mean rate lands in the right ballpark over 5 s.
+            assert 0.3 * 100 <= len(a) <= 2.0 * 100
+
+    def test_mmpp_burstier_than_poisson(self):
+        po = arrival_times(ArrivalSpec("poisson", rate_rps=20.0), 10.0, 7)
+        mm = arrival_times(
+            ArrivalSpec("mmpp", rate_rps=20.0, burst_factor=8.0), 10.0, 7
+        )
+        po_peak = max(interval_counts(po, 10.0))
+        mm_peak = max(interval_counts(mm, 10.0))
+        assert mm_peak > po_peak, (mm_peak, po_peak)
+
+    def test_unknown_profile_rejected(self):
+        with pytest.raises(ValueError, match="unknown arrival profile"):
+            ArrivalSpec(profile="sawtooth")
+
+
+# ---------------------------------------------------------------------------
+# Offered trace: seeded determinism (the acceptance-criteria pin).
+# ---------------------------------------------------------------------------
+
+
+class TestOfferedTrace:
+    def test_same_seed_identical_trace(self):
+        plan = TrafficPlan(seed=5, duration_s=2.0)
+        a, b = generate_offered(plan), generate_offered(plan)
+        assert a == b
+        assert offered_digest(a) == offered_digest(b)
+
+    def test_seed_changes_trace(self):
+        a = generate_offered(TrafficPlan(seed=5, duration_s=2.0))
+        b = generate_offered(TrafficPlan(seed=6, duration_s=2.0))
+        assert offered_digest(a) != offered_digest(b)
+
+    def test_default_mix_covers_required_shapes(self):
+        classes = default_classes()
+        assert len(classes) >= 6
+        by_name = {c.name: c for c in classes}
+        assert by_name["grammar_tool"].grammar_schema_json is not None
+        assert by_name["cancel_midstream"].cancel_after_tokens
+        assert by_name["deadline_short"].deadline_s
+        assert by_name["session_multiturn"].turns > 1
+        assert by_name["duplex_voice"].duplex
+
+    def test_max_prompt_tokens_really_bounds_prompts(self):
+        # The clamp exists so real-engine runs fit the prefill buckets:
+        # the drawn band must be a CEILING, not a suggestion — the head
+        # truncates before the text may exceed it. The one floor is the
+        # class marker the mock scripts key on, which never truncates.
+        classes = default_classes(max_prompt_tokens=24,
+                                  include_duplex=False)
+        trace = generate_offered(
+            TrafficPlan(seed=2, duration_s=2.0, classes=classes)
+        )
+        assert trace
+        for req in trace:
+            marker = f"sim {req.klass} "
+            bound = max(24, len(marker) + 1)  # tokens = chars + BOS
+            for turn in req.turns:
+                assert len(turn.text) + 1 <= bound, \
+                    (req.klass, len(turn.text) + 1, bound)
+                assert turn.text.startswith(marker)
+
+    def test_adding_a_class_never_perturbs_others(self):
+        base = _test_classes(multiturn=False)
+        more = base + (_test_classes()[-1],)
+        a = generate_offered(TrafficPlan(seed=1, classes=base))
+        b = generate_offered(TrafficPlan(seed=1, classes=more))
+        keep = [r for r in b if r.klass != "session_multiturn"]
+        assert [(r.klass, r.intended_at_s, r.turns) for r in a] == \
+               [(r.klass, r.intended_at_s, r.turns) for r in keep]
+
+
+# ---------------------------------------------------------------------------
+# VU-pool backlog gate (satellite: queue-depth signal end to end).
+# ---------------------------------------------------------------------------
+
+
+class TestBacklogGate:
+    def test_load_profile_backlog_rampdown(self):
+        p = LoadProfile(8, backlog_limit=100)
+        assert p.allowed(None, 0) == 8
+        assert p.allowed(None, 50) == 4
+        assert p.allowed(None, 100) == 1     # floor, never 0
+        assert p.allowed(None, 10_000) == 1
+        # Gate off: backlog ignored entirely.
+        assert LoadProfile(8).allowed(None, 10_000) == 8
+        # Pending ramp-down still composes on top.
+        assert p.allowed(2, 50) == 2
+
+    def test_pool_gates_on_backlog_signal(self):
+        items = list(range(8))
+
+        def run(backlog_fn):
+            idx = [0]
+            lock = threading.Lock()
+
+            def source(_vu):
+                with lock:
+                    if idx[0] >= len(items):
+                        return None
+                    idx[0] += 1
+                    return idx[0]
+
+            def execute(_vu, _item):
+                time.sleep(0.03)
+                return "ok"
+
+            pool = VUPool(
+                concurrency=4, source=source, execute=execute,
+                report=lambda i, r: None,
+                profile=LoadProfile(4, backlog_limit=100),
+                backlog=backlog_fn,
+            )
+            return pool.run(timeout_s=10.0)
+
+        gated = run(lambda: 10_000)
+        open_ = run(None)
+        assert gated["max_active"] == 1
+        assert gated["backlog_gated"] > 0
+        assert gated["executed"] == 8
+        assert open_["max_active"] > 1
+        assert open_["backlog_gated"] == 0
+
+    def test_simulator_wires_engine_backlog(self):
+        # One deliberately slow worker + a token backlog limit below one
+        # prompt: the pool's gate must visibly engage, and the ledger
+        # still reconciles (gating delays offered load; it never drops
+        # it).
+        coord, _workers = _fleet(1)
+        plan = TrafficPlan(
+            seed=2, duration_s=0.4,
+            classes=(ScenarioClass(
+                name="cancel_midstream",
+                arrival=ArrivalSpec(profile="poisson", rate_rps=20.0),
+                prompt_tokens=(48, 64), max_tokens=96,
+                cancel_after_tokens=12,
+                slo=SLOTarget(ttft_ms=5000.0, min_attainment=0.0),
+            ),),
+        )
+        sim = TrafficSimulator(coord, plan, concurrency=8,
+                               backlog_limit_tokens=16)
+        run = sim.run(timeout_s=30.0)
+        report = run.report()
+        assert report["ledger"]["ok"], report["ledger"]
+        assert report["concurrency"]["pool"]["backlog_gated"] > 0
+        assert report["ledger"]["offered_requests"] == len(run.trace)
+
+    def test_coordinator_sums_worker_backlog(self):
+        coord, workers = _fleet(2)
+        assert coord.pending_prefill_tokens() == 0
+        h1 = workers[0].submit(list(range(1, 40)),
+                               SamplingParams(temperature=0.0, max_tokens=4))
+        h2 = workers[1].submit(list(range(1, 30)),
+                               SamplingParams(temperature=0.0, max_tokens=4))
+        # Live playbacks mirror their prompt tokens; the coordinator
+        # surface must sum them fleet-wide under the same method name.
+        assert coord.pending_prefill_tokens() == \
+            workers[0].pending_prefill_tokens() + \
+            workers[1].pending_prefill_tokens()
+        h1.collect_tokens(timeout=10)
+        h2.collect_tokens(timeout=10)
+
+
+# ---------------------------------------------------------------------------
+# Direct mock-engine run: ledger + partial counts + flight sourcing.
+# ---------------------------------------------------------------------------
+
+
+class TestSimDirectMock:
+    @pytest.fixture(scope="class")
+    def run_and_report(self):
+        eng = MockEngine(_test_mock_scenarios(), flight_events=4096,
+                         prefill_chunk_tokens=16)
+        plan = TrafficPlan(seed=11, duration_s=0.8,
+                           classes=_test_classes())
+        sim = TrafficSimulator(eng, plan, concurrency=16)
+        run = sim.run(timeout_s=60.0)
+        return run, run.report()
+
+    def test_ledger_reconciles_exactly(self, run_and_report):
+        run, report = run_and_report
+        led = report["ledger"]
+        assert led["ok"], led
+        assert led["terminals_observed"] == led["engine_submits"]
+        assert led["worker_submitted"] == led["worker_finished"]
+        assert led["lost_streams"] == 0
+        assert led["driver_errors"] == 0
+        # Direct target: submits == finished + shed, no coordinator terms.
+        assert led["engine_submits"] == \
+            led["worker_finished"] + led["worker_shed"]
+        assert led["flight"]["open_requests"] == 0
+        assert led["flight"]["dropped"] == 0
+
+    def test_every_class_played(self, run_and_report):
+        _run, report = run_and_report
+        for name in ("chat_bursty", "grammar_tool", "cancel_midstream",
+                     "deadline_short", "session_multiturn"):
+            assert report["classes"][name]["offered"] > 0, name
+
+    def test_cancel_partial_counts_reconcile(self, run_and_report):
+        run, report = run_and_report
+        cell = report["classes"]["cancel_midstream"]
+        assert cell["finish"]["cancelled"] == cell["turns_submitted"]
+        assert cell["partial_mismatches"] == 0
+        for out in run.outcomes:
+            if out.klass == "cancel_midstream":
+                assert out.cancelled_by_client
+                assert out.tokens_streamed == out.num_generated
+                assert out.tokens_streamed >= 4
+
+    def test_deadline_partial_counts_reconcile(self, run_and_report):
+        run, report = run_and_report
+        cell = report["classes"]["deadline_short"]
+        assert cell["finish"]["deadline"] == cell["turns_submitted"]
+        assert cell["partial_mismatches"] == 0
+        deadline_total = sum(b["deadline_exceeded"]
+                             for b in run.worker_books)
+        assert deadline_total == cell["finish"]["deadline"]
+
+    def test_multiturn_sessions_submit_both_turns(self, run_and_report):
+        _run, report = run_and_report
+        cell = report["classes"]["session_multiturn"]
+        assert cell["turns_offered"] == 2 * cell["offered"]
+        assert cell["turns_submitted"] == cell["turns_offered"]
+        assert cell["turns_skipped"] == 0
+
+    def test_ttft_itl_sourced_from_flight_breakdowns(self, run_and_report):
+        run, report = run_and_report
+        chat = report["classes"]["chat_bursty"]
+        assert chat["ttft_engine_ms"]["count"] > 0
+        assert chat["itl_engine_ms"]["count"] > 0
+        assert chat["queue_engine_ms"]["count"] > 0
+        assert chat["breakdowns_missing"] == 0
+        # The values really come from recorder terminals: every mapped
+        # breakdown's ttft must match a recorder event, and the report's
+        # p95 must be one of the observed samples.
+        assert run.breakdowns
+        samples = sorted(
+            run.breakdowns[o.request_id]["breakdown"]["ttft_s"] * 1000.0
+            for o in run.outcomes
+            if o.klass == "chat_bursty" and o.request_id in run.breakdowns
+            and o.tokens_streamed > 0
+        )
+        assert chat["ttft_engine_ms"]["p95"] in [
+            pytest.approx(s, abs=1e-3) for s in samples
+        ]
+
+    def test_grammar_turns_complete_constrained(self, run_and_report):
+        _run, report = run_and_report
+        cell = report["classes"]["grammar_tool"]
+        assert cell["finish"]["stop"] + cell["finish"]["length"] == \
+            cell["turns_submitted"]
+        assert cell["finish"]["error"] == 0
+
+    def test_zero_offered_class_is_not_an_slo_failure(self):
+        # A short run where a low-rate class produced no arrivals has no
+        # evidence either way: attainment must be None (not 0.0) and the
+        # cell must not report an SLO violation it never observed — and
+        # the CLI table must render the empty cell without crashing.
+        from omnia_tpu.evals.trafficsim.report import (
+            _class_cell, summary_lines,
+        )
+
+        class _Plan:
+            duration_s = 1.0
+
+        class _Run:
+            plan = _Plan()
+            wall_s = 1.0
+            breakdowns: dict = {}
+
+        cell = _class_cell(_test_classes()[0], [], [], _Run())
+        assert cell["offered"] == 0
+        assert cell["slo"]["attainment"] is None
+        assert cell["slo"]["passed"] is True
+        assert cell["slo"]["failures"] == []
+        report = {
+            "seed": 0,
+            "ledger": {"offered_requests": 0, "engine_submits": 0,
+                       "ok": True, "identities": []},
+            "slo": {"passed": True, "failures": []},
+            "classes": {"empty": cell},
+        }
+        table = "\n".join(summary_lines(report))
+        assert "empty" in table and "SLO FAIL" not in table
+
+    def test_unsubmitted_offered_is_not_a_server_error(self):
+        # A request the run never submitted (pool timeout truncated the
+        # trace) is NOT met — the user got nothing — but it must not be
+        # booked as a server error: max_error_rate judges the engine,
+        # and the engine never saw the request.
+        from omnia_tpu.evals.trafficsim.generator import (
+            OfferedRequest, OfferedTurn,
+        )
+        from omnia_tpu.evals.trafficsim.report import _class_cell
+
+        cls = _test_classes()[0]
+
+        class _Plan:
+            duration_s = 1.0
+
+        class _Run:
+            plan = _Plan()
+            wall_s = 1.0
+            breakdowns: dict = {}
+
+        req = OfferedRequest(
+            index=0, klass=cls.name, intended_at_s=0.0,
+            turns=(OfferedTurn(text="sim chat_bursty never-sent",
+                               max_tokens=8),),
+        )
+        cell = _class_cell(cls, [req], [], _Run())
+        slo = cell["slo"]
+        assert slo["unsubmitted"] == 1
+        assert slo["errors"] == 0
+        assert slo["error_rate"] == 0.0
+        # Still counts against attainment: truncation must not flatter.
+        assert slo["attainment"] == 0.0
+        assert not any("error_rate" in f for f in slo["failures"])
+
+
+# ---------------------------------------------------------------------------
+# Coordinated-omission guard: a slow server must not shrink the offer.
+# ---------------------------------------------------------------------------
+
+
+class TestCoordinatedOmission:
+    def test_slow_server_keeps_full_offered_trace(self):
+        slow = [Scenario(pattern=r".", reply="z" * 30,
+                         delay_per_token_s=0.01)]
+        eng = MockEngine(slow, flight_events=1024)
+        plan = TrafficPlan(
+            seed=3, duration_s=0.4,
+            classes=(ScenarioClass(
+                name="chat_bursty",
+                arrival=ArrivalSpec(profile="poisson", rate_rps=25.0),
+                prompt_tokens=(12, 16), max_tokens=30,
+                slo=SLOTarget(ttft_ms=100.0, min_attainment=0.0),
+            ),),
+        )
+        expected = generate_offered(plan)
+        sim = TrafficSimulator(eng, plan, concurrency=2)
+        run = sim.run(timeout_s=60.0)
+        report = run.report()
+        # The offer never shrank: every generated request was submitted
+        # and terminated, and the trace digest matches a fresh expansion.
+        assert report["ledger"]["offered_requests"] == len(expected)
+        assert report["ledger"]["engine_submits"] == len(expected)
+        assert report["ledger"]["ok"], report["ledger"]
+        assert run.offered_sha256 == offered_digest(expected)
+        # The lateness is RECORDED, not hidden: with 2 VUs against
+        # ~10 req over 0.4 s at ~0.3 s each, the tail submits late.
+        cell = report["classes"]["chat_bursty"]
+        assert cell["sched_delay_ms"]["p95"] > 50.0
+        # And the intended-start TTFT view is correspondingly worse than
+        # the submit-relative client view — the CO adjustment is visible.
+        assert cell["ttft_from_intended_ms"]["p95"] > \
+            cell["ttft_client_ms"]["p95"]
+
+
+# ---------------------------------------------------------------------------
+# Coordinator fleet + counted chaos: exact reconciliation.
+# ---------------------------------------------------------------------------
+
+
+class TestFleetJoin:
+    def test_colliding_request_ids_never_cross_wire(self):
+        # Two workers sharing one request-id namespace (real engines all
+        # emit "req-N"; here two mocks with the SAME name) make the
+        # flight-terminal join ambiguous: the overlap must be DROPPED
+        # and counted, never attributed to the wrong class's books.
+        workers = [
+            MockEngine(_test_mock_scenarios(), name="mock",
+                       flight_events=4096, prefill_chunk_tokens=16)
+            for _ in range(2)
+        ]
+        coord = EngineCoordinator(workers, flight_events=512)
+        plan = TrafficPlan(seed=9, duration_s=0.6,
+                           classes=_test_classes(multiturn=False))
+        sim = TrafficSimulator(coord, plan, concurrency=16)
+        run = sim.run(timeout_s=60.0)
+        report = run.report()
+        assert report["ledger"]["ok"], report["ledger"]
+        sim_rids = {o.request_id for o in run.outcomes}
+        term_sets = [
+            {ev.request_id for ev in w._flight.events("terminal")}
+            for w in workers
+        ]
+        overlap = term_sets[0] & term_sets[1] & sim_rids
+        # Both workers served traffic, so the hazard is real here.
+        assert overlap, (len(term_sets[0]), len(term_sets[1]))
+        assert run.breakdown_collisions == len(overlap)
+        assert report["ledger"]["flight"]["id_collisions"] == len(overlap)
+        assert not overlap & set(run.breakdowns)
+
+
+class TestChaosLedger:
+    def test_counted_faults_reconcile_exactly(self):
+        plan_faults = FaultPlan(die_after_tokens=0, die_count=2,
+                                flaky_submit=1)
+        coord, workers = _fleet(2, flight_events=4096)
+        plan = TrafficPlan(seed=13, duration_s=0.8,
+                           classes=_test_classes(multiturn=False))
+        sim = TrafficSimulator(coord, plan, concurrency=16,
+                               chaos=plan_faults, chaos_at_s=0.1)
+        run = sim.run(timeout_s=60.0)
+        report = run.report()
+        led = report["ledger"]
+        assert led["ok"], led
+        # The chaos plan actually fired, mid-run.
+        assert run.chaos_fired["deaths"] == 2
+        assert run.chaos_fired["submit_faults"] == 1
+        # Exact attribution: every counted death is a transparent
+        # resubmit, a surfaced worker-death ERROR, or a failed resubmit.
+        ident = _ident(report, "FaultPlan deaths")
+        assert ident["ok"] is True, ident
+        assert led["coordinator"]["resubmits"] + \
+            led["death_errors_observed"] + led["unrouted_resubmit"] == 2
+        # Coordinator books close: every submit routed, shed, or failed
+        # routing — and worker accepted == routed + resubmits.
+        assert _ident(report, "submits == routed")["ok"] is True
+        assert _ident(report, "worker_submitted == routed")["ok"] is True
+        # Flaky submit surfaced as at least one failover.
+        assert led["coordinator"]["failovers"] >= 1
+
+    def test_clean_arm_has_no_chaos_artifacts(self):
+        coord, _workers = _fleet(2, flight_events=4096)
+        plan = TrafficPlan(seed=13, duration_s=0.6,
+                           classes=_test_classes(multiturn=False))
+        sim = TrafficSimulator(coord, plan, concurrency=16)
+        report = sim.run(timeout_s=60.0).report()
+        led = report["ledger"]
+        assert led["ok"], led
+        assert led["chaos_fired"] is None
+        assert led["coordinator"]["resubmits"] == 0
+        assert led["death_errors_observed"] == 0
+
+
+# ---------------------------------------------------------------------------
+# Coordinator grammar threading (satellite of the grammar seam).
+# ---------------------------------------------------------------------------
+
+
+class TestCoordinatorGrammar:
+    def _grammar(self, eng):
+        from omnia_tpu.engine.grammar.cache import compile_json_schema
+
+        return compile_json_schema(TOOL_SCHEMA, eng.workers[0].tokenizer)
+
+    def test_constrained_submit_through_coordinator(self):
+        coord, workers = _fleet(2)
+        g = self._grammar(coord)
+        sp = SamplingParams(temperature=0.0, max_tokens=64,
+                            stop_token_ids=(0,))
+        tok = workers[0].tokenizer
+        h = coord.submit(tok.encode("sim grammar_tool via coord"), sp,
+                         grammar=g)
+        toks, fin = h.collect_tokens(timeout=10)
+        assert fin.finish_reason in (FinishReason.STOP, FinishReason.LENGTH)
+        doc = json.loads(tok.decode([t for t in toks if t != 0]))
+        assert doc["tool"] in ("search", "lookup")
+        assert isinstance(doc["k"], int)
+
+    def test_resubmit_keeps_grammar(self):
+        fault = FaultPlan(die_after_tokens=0, die_count=1)
+        coord, workers = _fleet(2, fault_plan=fault)
+        g = self._grammar(coord)
+        sp = SamplingParams(temperature=0.0, max_tokens=64,
+                            stop_token_ids=(0,))
+        tok = workers[0].tokenizer
+        h = coord.submit(tok.encode("sim grammar_tool resubmit"), sp,
+                         grammar=g)
+        toks, fin = h.collect_tokens(timeout=10)
+        assert fault.fired["deaths"] == 1
+        assert coord.metrics["resubmits"] == 1
+        # The replacement stream is still constrained — valid JSON out.
+        assert fin.finish_reason in (FinishReason.STOP, FinishReason.LENGTH)
+        doc = json.loads(tok.decode([t for t in toks if t != 0]))
+        assert doc["tool"] in ("search", "lookup")
+
+    def test_mock_name_prefixes_request_ids(self):
+        default = MockEngine()
+        named = MockEngine(name="w7")
+        assert default.submit([1, 2], SamplingParams(max_tokens=1)) \
+            .request_id.startswith("mock-")
+        assert named.submit([1, 2], SamplingParams(max_tokens=1)) \
+            .request_id.startswith("w7-")
+
+
+# ---------------------------------------------------------------------------
+# Aggregator fold + threshold gating (satellite).
+# ---------------------------------------------------------------------------
+
+
+class TestAggregatorSLO:
+    def _report(self):
+        coord, _ = _fleet(2, flight_events=4096)
+        plan = TrafficPlan(seed=21, duration_s=0.5,
+                           classes=_test_classes(deadline=False))
+        return TrafficSimulator(coord, plan, concurrency=16) \
+            .run(timeout_s=60.0).report()
+
+    def test_fold_and_gate(self):
+        report = self._report()
+        agg = Aggregator()
+        folded = agg.add_slo_cells(report, provider="mock-fleet")
+        assert folded == len(report["classes"])
+        cells = {c.scenario: c for c in agg.cells()}
+        chat = cells["chat_bursty"]
+        assert chat.slo_offered == report["classes"]["chat_bursty"]["offered"]
+        assert chat.ttft_ms["p95"] == \
+            report["classes"]["chat_bursty"]["ttft_engine_ms"]["p95"]
+        d = chat.to_dict()
+        assert d["slo_attainment"] is not None
+        assert d["ttft_p95_ms"] == chat.ttft_ms["p95"]
+        # Pure simulator cells are NOT judged by the classic check
+        # gates: a DEFAULT threshold (min_pass_rate=1.0) must pass even
+        # though these cells have zero check runs — the SLO gates below
+        # are their verdict surface.
+        verdict = agg.evaluate(Threshold(
+            min_slo_attainment=0.0, max_p95_ttft_ms=60_000.0,
+        ))
+        assert verdict["passed"], verdict["failures"]
+        # A failing gate names the class AND the percentile.
+        verdict = agg.evaluate(Threshold(max_p95_ttft_ms=0.0001))
+        assert not verdict["passed"]
+        assert any("chat_bursty/mock-fleet: TTFT p95" in f
+                   for f in verdict["failures"]), verdict["failures"]
+        # Attainment gate likewise.
+        verdict = agg.evaluate(Threshold(min_slo_attainment=1.01))
+        assert any("SLO attainment" in f for f in verdict["failures"])
+
+    def test_classic_jobs_unaffected(self):
+        # Cells without folded SLO data never trip the new gates.
+        from omnia_tpu.evals.defs import WorkResult
+
+        agg = Aggregator()
+        agg.add(WorkResult(work_id="w1", job="j", scenario="s",
+                           provider="p", repeat=0))
+        verdict = agg.evaluate(Threshold(
+            min_slo_attainment=0.99, max_p95_ttft_ms=0.001,
+            max_p95_itl_ms=0.001,
+        ))
+        assert verdict["passed"], verdict["failures"]
+        assert verdict["cells"][0]["slo_attainment"] is None
+
+
+# ---------------------------------------------------------------------------
+# CLI: artifact round trip, seed reproduction, jax-free proof.
+# ---------------------------------------------------------------------------
+
+
+class TestCLI:
+    def _run(self, *args, env=None):
+        cmd = [sys.executable, "-m", "omnia_tpu.evals.trafficsim",
+               "--duration", "0.5", "--no-duplex", *args]
+        full_env = dict(os.environ)
+        if env:
+            full_env.update(env)
+        return subprocess.run(cmd, cwd=REPO, env=full_env,
+                              capture_output=True, text=True, timeout=120)
+
+    def test_report_artifact_and_seed_reproduction(self, tmp_path):
+        out_a = str(tmp_path / "a.json")
+        out_b = str(tmp_path / "b.json")
+        ra = self._run("--seed", "3", "--out", out_a)
+        assert ra.returncode == 0, ra.stdout + ra.stderr
+        rb = self._run("--seed", "3", "--out", out_b)
+        assert rb.returncode == 0, rb.stdout + rb.stderr
+        a = json.load(open(out_a))
+        b = json.load(open(out_b))
+        assert a["ledger"]["ok"] and b["ledger"]["ok"]
+        assert a["offered_sha256"] == b["offered_sha256"]
+        assert a["schema_version"] == 1
+        rc = self._run("--seed", "4", "--out", str(tmp_path / "c.json"))
+        assert rc.returncode == 0
+        c = json.load(open(str(tmp_path / "c.json")))
+        assert c["offered_sha256"] != a["offered_sha256"]
+
+    def test_chaos_arm_reconciles(self, tmp_path):
+        out = str(tmp_path / "chaos.json")
+        r = self._run("--seed", "9", "--chaos", "--chaos-at", "0.05",
+                      "--out", out)
+        assert r.returncode == 0, r.stdout + r.stderr
+        rep = json.load(open(out))
+        assert rep["ledger"]["ok"], rep["ledger"]
+        assert rep["ledger"]["chaos_fired"]["deaths"] >= 1
+
+    def test_cli_is_jax_free(self, tmp_path):
+        stub = os.path.join(REPO, "tests", "fixtures", "nojax_stub")
+        r = self._run(
+            "--seed", "1", "--out", str(tmp_path / "nj.json"),
+            env={"PYTHONPATH": stub + os.pathsep
+                 + os.environ.get("PYTHONPATH", "")},
+        )
+        assert r.returncode == 0, r.stdout + r.stderr
+        assert json.load(open(str(tmp_path / "nj.json")))["ledger"]["ok"]
+
+
+# ---------------------------------------------------------------------------
+# Duplex/barge-in class (needs the runtime package → skips without jax).
+# ---------------------------------------------------------------------------
+
+
+class TestDuplex:
+    def test_barge_in_sessions_reconcile(self):
+        # exc_type: the CI poisoned-jax stub raises ImportError through
+        # the runtime's provider-layer import — that's the skip signal.
+        pytest.importorskip("omnia_tpu.runtime.conversation",
+                            exc_type=ImportError)
+        coord, _workers = _fleet(1, flight_events=2048)
+        plan = TrafficPlan(
+            seed=17, duration_s=0.5,
+            classes=(ScenarioClass(
+                name="duplex_voice",
+                arrival=ArrivalSpec(profile="poisson", rate_rps=6.0),
+                prompt_tokens=(12, 20), max_tokens=64,
+                duplex=True, barge_in_after_chunks=2,
+                slo=SLOTarget(ttft_ms=2000.0, min_attainment=0.0),
+            ),),
+        )
+        sim = TrafficSimulator(coord, plan, concurrency=8)
+        run = sim.run(timeout_s=60.0)
+        report = run.report()
+        led = report["ledger"]
+        assert led["ok"], led
+        cell = report["classes"]["duplex_voice"]
+        assert cell["offered"] > 0
+        # Every session was interrupted by the scripted barge-in, and
+        # each one submitted exactly one engine request that terminated.
+        assert cell["finish"]["interrupted"] == cell["turns_submitted"]
+        assert led["engine_submits"] == cell["turns_submitted"]
+        assert led["worker_finished"] == led["engine_submits"]
+        assert run.duplex_skipped == 0
+
+
+# ---------------------------------------------------------------------------
+# Mock-vs-real-engine report schema parity (skips without jax).
+# ---------------------------------------------------------------------------
+
+
+def _key_paths(obj, prefix=""):
+    """All dict key paths, recursing through dicts and list elements —
+    the report-schema fingerprint both backends must share."""
+    paths = set()
+    if isinstance(obj, dict):
+        for k, v in obj.items():
+            p = f"{prefix}.{k}" if prefix else str(k)
+            paths.add(p)
+            paths |= _key_paths(v, p)
+    elif isinstance(obj, list):
+        for v in obj:
+            paths |= _key_paths(v, prefix + "[]")
+    return paths
+
+
+class TestSchemaParityRealEngine:
+    def test_mock_and_real_reports_share_schema(self):
+        pytest.importorskip("jax", exc_type=ImportError)
+        from omnia_tpu.engine import EngineConfig, InferenceEngine
+        from omnia_tpu.models import get_config
+
+        classes = _test_classes(multiturn=False)
+        # Scale the offer down: a CPU test-tiny engine serves a few
+        # requests, not a fleet's worth.
+        import dataclasses as dc
+        classes = tuple(
+            dc.replace(
+                c,
+                arrival=dc.replace(c.arrival, rate_rps=3.0),
+                prompt_tokens=(12, 24), max_tokens=8,
+            )
+            for c in classes
+        )
+        plan = TrafficPlan(seed=29, duration_s=0.6, classes=classes)
+
+        mock = MockEngine(_test_mock_scenarios(), flight_events=2048)
+        mock_report = TrafficSimulator(mock, plan, concurrency=8) \
+            .run(timeout_s=60.0).report()
+
+        ecfg = EngineConfig(
+            num_slots=4, max_seq=128, prefill_buckets=(64,),
+            dtype="float32", max_sessions=0, grammar=True,
+            grammar_max_states=512, flight_events=2048, decode_chunk=2,
+        )
+        eng = InferenceEngine(get_config("test-tiny"), ecfg, seed=0)
+        eng.warmup(sessions=False)
+        eng.start()
+        try:
+            real_report = TrafficSimulator(eng, plan, concurrency=8,
+                                           turn_timeout_s=120.0) \
+                .run(timeout_s=300.0).report()
+        finally:
+            eng.stop()
+        assert real_report["ledger"]["ok"], real_report["ledger"]
+        assert mock_report["ledger"]["ok"], mock_report["ledger"]
+        assert _key_paths(mock_report) == _key_paths(real_report)
+        # Same flight-recorder sourcing on both backends.
+        for rep in (mock_report, real_report):
+            assert rep["classes"]["chat_bursty"]["ttft_engine_ms"]["count"] > 0
