@@ -522,6 +522,24 @@ def child_main() -> None:
             _log(f"trafficsim bench failed: {exc!r}")
             trafficsim = {"error": repr(exc)}
 
+    # --- elastic fleet scale-out (engine/fleet.py) --------------------
+    # Trafficsim ramp against a mock fleet with the FleetScaler live:
+    # autoscaled vs static arms, 1→N→1 scale trace, zero dropped
+    # sessions on the shrink, exact ledgers. Pure host-side control —
+    # identical on accel and CPU.
+    fleet = None
+    if remaining() > (60 if on_accel else 30):
+        try:
+            fleet = _bench_fleet(cfg, remaining, on_accel)
+            _log(
+                f"fleet bench done: scaled={fleet.get('scaled_out_and_back')}"
+                f" dropped={fleet.get('sessions_dropped')}"
+                f" reconciled={fleet.get('reconciled')}"
+            )
+        except Exception as exc:  # noqa: BLE001 - aux evidence only
+            _log(f"fleet bench failed: {exc!r}")
+            fleet = {"error": repr(exc)}
+
     # --- cold start decomposition + cache A/B (engine/coldstart.py) ---
     # Submit-to-ready per phase, cold-vs-warm persistent-cache restart,
     # and parallel-vs-serial warmup. Runs on accel and CPU (compile
@@ -589,6 +607,7 @@ def child_main() -> None:
                 "kv_paged": kv_paged,
                 "latency": latency,
                 "trafficsim": trafficsim,
+                "fleet": fleet,
                 "coldstart": coldstart,
                 # Chip-roofline ratios are meaningless against CPU
                 # timings — explicitly null, never quoted against an
@@ -699,6 +718,10 @@ def child_main() -> None:
         # Traffic simulator (ROADMAP item 5): per-class SLO attainment
         # clean-vs-chaos with exact ledger reconciliation.
         result["aux"]["trafficsim"] = trafficsim
+    if fleet is not None:
+        # Elastic fleet (ROADMAP item 2): queue-depth autoscaling +
+        # live migration — 1→N→1 with zero dropped sessions.
+        result["aux"]["fleet"] = fleet
     if coldstart is not None:
         # Cold start (ROADMAP item 3): submit-to-ready decomposition +
         # cold-vs-warm cache A/B + parallel-vs-serial warmup.
@@ -1794,6 +1817,141 @@ def _bench_trafficsim(cfg, remaining, on_accel):
         # The acceptance bar: both arms' books close exactly, and the
         # chaos arm's counted faults are fully attributed.
         "reconciled": clean["ledger_ok"] and chaos["ledger_ok"],
+    }
+
+
+def _bench_fleet(cfg, remaining, on_accel):
+    """Elastic fleet scale-out (engine/fleet.py → aux.fleet): one seeded
+    trafficsim RAMP run against a mock fleet with the FleetScaler LIVE
+    (the autoscaled arm: workers join as the prompt-token backlog
+    climbs, and the post-ramp idle window shrinks the fleet back with
+    every resident session migrated) vs the SAME plan against a static
+    single-worker fleet. Reports the 1→N→1 scale event trace, per-class
+    SLO attainment for both arms, the migration ledger, and the honest
+    contracts: ``sessions_dropped == 0`` on scale-down and both arms'
+    exact ledgers reconciled. Host-side scheduling behavior — runs
+    identically on accel and CPU."""
+    from omnia_tpu.engine.coordinator import EngineCoordinator
+    from omnia_tpu.engine.fleet import FleetScaler, MockFleetProvisioner
+    from omnia_tpu.engine.mock import MockEngine, Scenario
+    from omnia_tpu.evals.trafficsim import (
+        ArrivalSpec, ScenarioClass, SLOTarget, TrafficPlan, TrafficSimulator,
+    )
+    from omnia_tpu.operator.autoscaling import AutoscalingPolicy
+
+    # A launch-ramp plan sized to saturate ONE bounded worker at peak:
+    # chat climbs 5% → 40 rps; the sessionful class keeps conversations
+    # resident so the ramp-down has KV to migrate.
+    plan = TrafficPlan(seed=0, duration_s=2.0, classes=(
+        ScenarioClass(
+            name="chat_ramp",
+            arrival=ArrivalSpec(
+                profile="ramp", rate_rps=40.0, ramp_from_frac=0.05,
+            ),
+            prompt_tokens=(48, 96), max_tokens=32,
+            slo=SLOTarget(ttft_ms=500.0, min_attainment=0.5),
+        ),
+        ScenarioClass(
+            name="session_ramp",
+            arrival=ArrivalSpec(
+                profile="ramp", rate_rps=5.0, ramp_from_frac=0.2,
+            ),
+            prompt_tokens=(24, 48), max_tokens=24, turns=2,
+            slo=SLOTarget(ttft_ms=800.0, min_attainment=0.5),
+        ),
+    ))
+
+    def worker(i):
+        # Bounded admission (max_queue) is what makes capacity REAL for
+        # a scripted engine: a saturated worker sheds OVERLOADED, so
+        # attainment genuinely depends on fleet size.
+        return MockEngine(
+            [Scenario(".", reply="f" * 48, ttft_s=0.004,
+                      delay_per_token_s=0.004)],
+            name=f"w{i}", flight_events=4096, max_queue=4,
+        )
+
+    arm_budget = max(5.0, min(45.0, remaining() - 20.0))
+
+    def run_arm(autoscale):
+        coord = EngineCoordinator([worker(0)], flight_events=256)
+        prov = scaler = None
+        if autoscale:
+            prov = MockFleetProvisioner(coord, worker, max_workers=3)
+            scaler = FleetScaler(
+                AutoscalingPolicy(
+                    min_replicas=0, max_replicas=3, target_queue_depth=2.0,
+                    scale_to_zero_after_idle_s=0.4, stabilization_s=0.6,
+                ),
+                prov, coordinator=coord, interval_s=0.05, pending_norm=64.0,
+            )
+            scaler.start()
+        sim = TrafficSimulator(coord, plan, concurrency=24)
+        rep = sim.run(timeout_s=arm_budget).report()
+        arm = {
+            "workers_final": coord.live_workers(),
+            "slo_passed": rep["slo"]["passed"],
+            "ledger_ok": rep["ledger"]["ok"],
+            "classes": {
+                name: {
+                    "offered": cell["offered"],
+                    "attainment": cell["slo"]["attainment"],
+                    "ttft_p95_ms": cell["ttft_engine_ms"]["p95"],
+                }
+                for name, cell in rep["classes"].items() if "slo" in cell
+            },
+        }
+        if autoscale:
+            # Ramp-down: the idle window shrinks the fleet to the floor,
+            # migrating every session still pinned to a retiring worker.
+            deadline = time.monotonic() + 6.0
+            while coord.live_workers() > 1 and time.monotonic() < deadline:
+                time.sleep(0.05)
+            scaler.stop()
+            snap = coord.metrics_snapshot()
+            arm.update(
+                workers_final=coord.live_workers(),
+                scale_events=[e.to_dict() for e in scaler.events()],
+                scaler=scaler.stats(),
+                sessions_migrated=snap["sessions_migrated"],
+                migration_fallbacks=snap["migration_fallbacks"],
+                sessions_dropped=sum(
+                    s.get("dropped_pins", 0) for s in prov.disposed
+                ),
+            )
+        coord.stop()
+        return arm
+
+    autoscaled = run_arm(True)
+    static = run_arm(False)
+
+    def mean_attainment(arm):
+        cells = arm["classes"].values()
+        return round(
+            sum(c["attainment"] for c in cells) / max(len(cells), 1), 4,
+        )
+
+    auto_att, static_att = mean_attainment(autoscaled), mean_attainment(static)
+    peak = max(
+        [e["to_workers"] for e in autoscaled.get("scale_events", [])
+         if e["kind"] == "up"], default=1,
+    )
+    return {
+        "seed": plan.seed,
+        "duration_s": plan.duration_s,
+        "autoscaled": autoscaled,
+        "static": static,
+        "attainment_autoscaled": auto_att,
+        "attainment_static": static_att,
+        # The ISSUE 15 acceptance bars: the scaler actually scaled out
+        # and back (1→N→1), no conversation was dropped on the shrink,
+        # the autoscaled arm attains at least the static arm, and both
+        # arms' exact ledgers close.
+        "scaled_out_and_back": peak > 1
+        and autoscaled["workers_final"] == 1,
+        "sessions_dropped": autoscaled.get("sessions_dropped", 0),
+        "autoscaled_not_worse": auto_att >= static_att,
+        "reconciled": autoscaled["ledger_ok"] and static["ledger_ok"],
     }
 
 

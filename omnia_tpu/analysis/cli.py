@@ -56,10 +56,10 @@ def run_checkers(
     if "metrics" in rules:
         wanted.update(metricscheck.ENGINE_FAMILY)
         wanted.update(metricscheck.TRAFFICSIM_FILES)
-        wanted.update({
-            metricscheck.MOCK_FILE, metricscheck.COORDINATOR_FILE,
-            metricscheck.REGISTRY_FILE,
-        })
+        wanted.update(metricscheck.MOCK_FILES)
+        wanted.update(metricscheck.COORDINATOR_FILES)
+        wanted.add(metricscheck.FLEET_FILE)
+        wanted.add(metricscheck.REGISTRY_FILE)
     if "jaxfree" in rules:
         wanted.update(jaxfree.jaxfree_files(pkg_files))
     sources = analyze_file_set(root, sorted(wanted))

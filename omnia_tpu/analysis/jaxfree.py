@@ -29,6 +29,11 @@ JAX_FREE_PACKAGES: tuple[str, ...] = (
     # CLI must run in jax-less containers (the duplex driver's runtime
     # import is lazy and degrades to a recorded skip).
     "omnia_tpu/evals/trafficsim/",
+    # Fleet scaler: queue-depth → replica-count decisions are host-side
+    # arithmetic by contract — the operator's pod path runs it in
+    # jax-less controller processes, and the CI poisoned-jax subset
+    # proves the whole control loop without a device stack.
+    "omnia_tpu/engine/fleet.py",
 )
 
 

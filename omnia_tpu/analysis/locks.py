@@ -48,8 +48,20 @@ LOCK_GROUPS: tuple[tuple[str, tuple[str, ...]], ...] = (
         "omnia_tpu/engine/warmup.py",
         "omnia_tpu/engine/multihost.py",
     )),
-    ("mock", ("omnia_tpu/engine/mock.py",)),
-    ("coordinator", ("omnia_tpu/engine/coordinator.py",)),
+    ("mock", (
+        "omnia_tpu/engine/mock.py",
+        "omnia_tpu/engine/mock_sessions.py",
+    )),
+    ("coordinator", (
+        "omnia_tpu/engine/coordinator.py",
+        "omnia_tpu/engine/membership.py",
+        "omnia_tpu/engine/relay.py",
+    )),
+    # The fleet scaler's control loop: the tick thread and callers of
+    # events()/stats() share the event/tick books — worker-RPC samples
+    # and provisioner calls must stay OUTSIDE its lock (lock-blocking),
+    # same discipline as coordinator routing.
+    ("fleet", ("omnia_tpu/engine/fleet.py",)),
     # The flight recorder is its own concurrent class (submits arrive on
     # caller threads, step events on the engine thread, terminals on
     # either) — same machine-checked lock-at-access-site discipline.

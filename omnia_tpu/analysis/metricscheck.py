@@ -47,8 +47,23 @@ ENGINE_FAMILY = (
     "omnia_tpu/engine/warmup.py",
     "omnia_tpu/engine/multihost.py",
 )
-MOCK_FILE = "omnia_tpu/engine/mock.py"
-COORDINATOR_FILE = "omnia_tpu/engine/coordinator.py"
+#: Mock-engine family: mock.py plus its session-migration mixin — a
+#: mixin method's ``self`` IS the MockEngine, so its metric writes are
+#: mock writes and must name registered mock keys.
+MOCK_FILES = (
+    "omnia_tpu/engine/mock.py",
+    "omnia_tpu/engine/mock_sessions.py",
+)
+#: Coordinator family: coordinator.py plus the membership/relay splits.
+#: membership.py holds the actual increment sites for the fleet ledger
+#: (`fleet_workers`/`scale_events`/`sessions_migrated`/
+#: `migration_fallbacks`); relay.py books through its owner today but
+#: any direct ``self.metrics`` write it ever grows must be registered.
+COORDINATOR_FILES = (
+    "omnia_tpu/engine/coordinator.py",
+    "omnia_tpu/engine/membership.py",
+    "omnia_tpu/engine/relay.py",
+)
 #: Traffic-simulator files: the simulator reports through its own JSON
 #: report schema, not `self.metrics` — any `self.metrics` write that
 #: ever appears here must name a registered engine key (it would be
@@ -60,6 +75,11 @@ TRAFFICSIM_FILES = (
     "omnia_tpu/evals/trafficsim/arrivals.py",
     "omnia_tpu/evals/trafficsim/scenarios.py",
 )
+#: Fleet scaler: reports through ScaleEvent/stats() dicts, not
+#: `self.metrics` — any `self.metrics` write that ever appears here
+#: must name a registered coordinator key (it would be mirroring the
+#: fleet ledger) or it is a finding.
+FLEET_FILE = "omnia_tpu/engine/fleet.py"
 
 
 def metric_keys_in(src: SourceFile) -> list[tuple[str, int]]:
@@ -166,12 +186,17 @@ def check_metrics(root: str, sources: dict[str, SourceFile]) -> list[Finding]:
         plans.append((f, expected, "TestMetricsKeyStability.EXPECTED"))
     for f in TRAFFICSIM_FILES:
         plans.append((f, expected, "TestMetricsKeyStability.EXPECTED"))
+    for f in MOCK_FILES:
+        plans.append((
+            f, expected | mock_only,
+            "TestMetricsKeyStability.EXPECTED ∪ MOCK_ONLY",
+        ))
+    for f in COORDINATOR_FILES:
+        plans.append((
+            f, coordinator, "TestMetricsKeyStability.COORDINATOR",
+        ))
     plans.append((
-        MOCK_FILE, expected | mock_only,
-        "TestMetricsKeyStability.EXPECTED ∪ MOCK_ONLY",
-    ))
-    plans.append((
-        COORDINATOR_FILE, coordinator, "TestMetricsKeyStability.COORDINATOR",
+        FLEET_FILE, coordinator, "TestMetricsKeyStability.COORDINATOR",
     ))
 
     written: dict[str, set[str]] = {"engine": set(), "mock": set(), "coord": set()}
@@ -184,9 +209,9 @@ def check_metrics(root: str, sources: dict[str, SourceFile]) -> list[Finding]:
             if (rel, line, key, registry_name) in seen:
                 continue  # .get + subscript on one line report once
             seen.add((rel, line, key, registry_name))
-            if rel == COORDINATOR_FILE:
+            if rel in COORDINATOR_FILES:
                 written["coord"].add(key)
-            elif rel == MOCK_FILE:
+            elif rel in MOCK_FILES:
                 written["mock"].add(key)
             else:
                 written["engine"].add(key)

@@ -48,6 +48,9 @@ PURITY_FILES_PREFIXES: tuple[str, ...] = (
     # The traffic simulator is host-side by contract; listing it makes
     # any future traced body inside it subject to the same rule.
     "omnia_tpu/evals/trafficsim/",
+    # The fleet scaler is host-side by contract (scale decisions are
+    # stats arithmetic); a traced body here would be the same bug class.
+    "omnia_tpu/engine/fleet.py",
 )
 
 #: Call heads that trace their function argument(s).

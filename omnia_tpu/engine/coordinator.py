@@ -48,6 +48,26 @@ Resilience (the request-lifecycle hardening layer):
 - When every healthy worker's queue is at ``max_worker_queue``, submit
   sheds with FinishReason.OVERLOADED *before* routing — fleet overload
   degrades to a fast observable signal, not queue pile-up.
+
+Elastic membership (the fleet-scaling layer, driven by
+``engine/fleet.py``):
+- ``add_worker()`` joins a worker at runtime: health/metrics state
+  initialize under the existing locks, and the next routing decision
+  can pick it — no restart, no rebuild.
+- ``remove_worker(migrate=True)`` retires one: the worker leaves the
+  routing set immediately (``retired`` is permanent — it never
+  reinstates through the prober), drains its admission and in-flight
+  requests, then **migrates** every resident conversation — each
+  pinned session's KV exports in the host-row offload format
+  (``engine/sessions.py``) and imports at the affinity-best survivor,
+  re-pinning the coordinator's affinity so the next turn reuses the
+  moved rows. A failed export/import falls back to fresh prefill (the
+  conversation's next turn re-prefills from its own history — the
+  rebuild-on-miss contract), counted in ``migration_fallbacks``;
+  scale-down never DROPS a conversation. Requests racing the
+  retirement relay-resubmit: a zero-token OVERLOADED terminal from a
+  retiring worker re-places on a survivor through the same
+  ``_RelayHandle`` path worker deaths use.
 """
 
 from __future__ import annotations
@@ -61,7 +81,15 @@ import time
 from typing import Optional, Sequence
 
 from omnia_tpu.engine.flight import FlightRecorder
-from omnia_tpu.engine.types import FinishReason, RequestHandle, SamplingParams, StreamEvent
+from omnia_tpu.engine.membership import _MembershipMixin
+from omnia_tpu.engine.relay import _RelayHandle
+from omnia_tpu.engine.types import (
+    PENDING_TOKENS_NORM,
+    FinishReason,
+    RequestHandle,
+    SamplingParams,
+    StreamEvent,
+)
 
 logger = logging.getLogger(__name__)
 
@@ -69,7 +97,8 @@ logger = logging.getLogger(__name__)
 class _WorkerHealth:
     """Cached probe state for one worker (prober-owned)."""
 
-    __slots__ = ("up", "fails", "last_probe", "healthy_since", "probing")
+    __slots__ = ("up", "fails", "last_probe", "healthy_since", "probing",
+                 "retired")
 
     def __init__(self):
         self.up = True
@@ -80,9 +109,13 @@ class _WorkerHealth:
         # healthy() leaks exactly ONE abandoned thread, not one per
         # probe interval forever.
         self.probing = False
+        # Fleet retirement (remove_worker): permanent — a retired
+        # worker is never probed and never reinstates, so its index
+        # stays a stable tombstone while routing forgets it.
+        self.retired = False
 
 
-class EngineCoordinator:
+class EngineCoordinator(_MembershipMixin):
     def __init__(
         self,
         workers: Sequence,
@@ -169,7 +202,28 @@ class EngineCoordinator:
             # deaths transparently re-placed on another worker.
             "shed": 0,
             "resubmits": 0,
+            # A submit that reached a worker just as remove_worker
+            # closed its admission sheds OVERLOADED there and re-places
+            # on a survivor — its own book, NOT resubmits, so the chaos
+            # ledger's deaths == resubmits identity stays exact.
+            "retirement_relays": 0,
+            # Elastic fleet (engine/fleet.py drives these): the live
+            # (non-retired) worker gauge — the scrape-able replica
+            # signal for the deployment path — plus the migration
+            # ledger scale-down reconciles against: every session
+            # pinned to a retiring worker lands in exactly one of
+            # migrated (KV carried to a survivor) or fallbacks (fresh
+            # prefill recovers it). scale_events counts applied
+            # add/remove membership changes.
+            "fleet_workers": len(self.workers),
+            "sessions_migrated": 0,
+            "migration_fallbacks": 0,
+            "scale_events": 0,
         }
+        # Serializes membership changes (add/remove): concurrent scale
+        # operations would race the migrate/retire bookkeeping. Routing
+        # never takes it.
+        self._scale_lock = threading.Lock()
         # Fleet-dimension flight recorder (engine/flight.py): records
         # failover / resubmit / shed events with the affected worker, so
         # a request's flight trail covers worker deaths too. The same
@@ -183,6 +237,12 @@ class EngineCoordinator:
     def _count(self, key: str, n: int = 1) -> None:
         with self._metrics_lock:
             self.metrics[key] += n
+
+    def metrics_snapshot(self) -> dict:
+        """A consistent copy of the fleet ledger (readers outside this
+        module must not iterate the live dict while _count mutates it)."""
+        with self._metrics_lock:
+            return dict(self.metrics)
 
     # -- health / load -------------------------------------------------
 
@@ -221,6 +281,8 @@ class EngineCoordinator:
         with self._health_lock:
             st = self._health[i]
             st.last_probe = now
+            if st.retired:
+                return  # retirement is permanent: no probe reinstates it
             if ok:
                 st.fails = 0
                 if not st.up:
@@ -258,6 +320,8 @@ class EngineCoordinator:
         stale = []
         with self._health_lock:
             for i, st in enumerate(self._health):
+                if st.retired:
+                    continue  # tombstone: never probed, never healthy
                 if st.probing and (
                     abandon_s is None or now - st.last_probe < abandon_s
                 ):
@@ -289,8 +353,10 @@ class EngineCoordinator:
     # term is queued+in-flight PREFILL WORK, so four 8k-prompt requests
     # (64 units) no longer route like four 10-token ones (~0). Sized so
     # a typical short-chat prompt (hundreds of tokens) stays well under
-    # one queue-slot equivalent.
-    _PREFILL_BACKLOG_NORM = 512.0
+    # one queue-slot equivalent. ONE constant (engine/types.py) shared
+    # with the fleet scaler's depth signal: routing and autoscaling must
+    # agree on what "one request of prefill work" means.
+    _PREFILL_BACKLOG_NORM = PENDING_TOKENS_NORM
 
     def _load(self, i: int) -> float:
         """Worker load: queued + active requests, plus the prompt-token
@@ -311,6 +377,16 @@ class EngineCoordinator:
 
     def healthy(self) -> bool:
         return bool(self._healthy_indices())
+
+    def live_workers(self) -> int:
+        """Fleet members not retired (up or temporarily down) — the
+        replica count the fleet scaler steers."""
+        with self._health_lock:
+            return sum(1 for st in self._health if not st.retired)
+
+    def _worker_retired(self, i: int) -> bool:
+        with self._health_lock:
+            return 0 <= i < len(self._health) and self._health[i].retired
 
     def _sum_signal(self, attr: str) -> int:
         # A worker that answered healthy() can still fail its stats RPC a
@@ -631,14 +707,24 @@ class EngineCoordinator:
         with self._lock:
             return self._affinity.get(session_id)
 
+    # Fleet membership (add_worker / remove_worker / migration) lives in
+    # engine/membership.py — one lock group with this file, split the
+    # way the engine splits its own mixins.
+
     # -- lifecycle -----------------------------------------------------
 
     def start(self) -> None:
-        for w in self.workers:
-            w.start()
+        for i, w in enumerate(self.workers):
+            if not self._worker_retired(i):
+                w.start()
 
     def stop(self, drain: bool = False) -> None:
-        def _stop_one(w):
+        def _stop_one(i, w):
+            # Per-worker drain duration lands in the flight trail: in
+            # the overlapped-drain path one slow-drain worker is
+            # otherwise indistinguishable from a wedged fleet — the
+            # `drain` events name WHICH worker ate the window.
+            t0 = time.monotonic()
             try:
                 try:
                     w.stop(drain=drain)
@@ -646,131 +732,25 @@ class EngineCoordinator:
                     w.stop()  # worker predates the drain kwarg
             except Exception:
                 logger.exception("worker stop failed")
+            if drain and self._flight is not None:
+                self._flight.note_drain(i, time.monotonic() - t0)
 
-        if drain and len(self.workers) > 1:
+        live = [
+            (i, w) for i, w in enumerate(self.workers)
+            if not self._worker_retired(i)  # retired: already stopped
+        ]
+        if drain and len(live) > 1:
             # Drain in parallel: admission closes fleet-wide at once and
             # the drains overlap, bounding shutdown at ONE drain window
             # instead of workers × drain_timeout_s.
             threads = [
-                threading.Thread(target=_stop_one, args=(w,), daemon=True)
-                for w in self.workers
+                threading.Thread(target=_stop_one, args=(i, w), daemon=True)
+                for i, w in live
             ]
             for t in threads:
                 t.start()
             for t in threads:
                 t.join()
             return
-        for w in self.workers:
-            _stop_one(w)
-
-
-class _RelayHandle(RequestHandle):
-    """Coordinator-owned handle: pumps the worker handle's events into
-    its own queue, and owns the resubmit decision on worker death.
-
-    The rule is duplication-safe by construction: a terminal ERROR with
-    ZERO tokens forwarded means the caller observed nothing, so the
-    request transparently resubmits to another worker (bounded by
-    ``resubmit_retries`` and the deadline budget); once ≥1 token has
-    been forwarded the ERROR surfaces with the partial count — the
-    coordinator never replays a stream the caller already saw part of.
-    Exactly ONE terminal event ever reaches the consumer."""
-
-    def __init__(self, owner, prompt_tokens, params, session_id, prefix_key,
-                 deadline_at, trace_ctx=None, grammar=None):
-        super().__init__("coord-pending")
-        self._owner = owner
-        self._args = (list(prompt_tokens), params, session_id, prefix_key)
-        self._deadline_at = deadline_at
-        # Re-sent verbatim on resubmit: the replacement worker's engine
-        # span joins the SAME trace (worker deaths extend the trace,
-        # never fork it).
-        self._trace_ctx = trace_ctx
-        # Likewise re-sent: a resubmitted constrained request stays
-        # constrained on the replacement worker.
-        self._grammar = grammar
-        self._inner: Optional[RequestHandle] = None
-        self._inner_idx: Optional[int] = None
-        self._resubmits_left = owner.resubmit_retries
-        self._forwarded = 0
-
-    def _begin(self, idx: int, inner: RequestHandle) -> None:
-        self.request_id = inner.request_id
-        self._inner, self._inner_idx = inner, idx
-        threading.Thread(
-            target=self._pump, name="omnia-coord-relay", daemon=True
-        ).start()
-
-    def cancel(self) -> None:
-        super().cancel()
-        inner = self._inner
-        if inner is not None:
-            inner.cancel()
-
-    def _try_resubmit(self) -> bool:
-        """Zero-token worker death: place the request on another worker.
-        Returns True when a new inner stream is live."""
-        failed = self._inner_idx
-        self._owner._note_probe(failed, False, hard=True)
-        idx, result = self._owner._routed_submit(
-            *self._args, self._deadline_at, exclude=frozenset({failed}),
-            trace_ctx=self._trace_ctx, grammar=self._grammar,
-        )
-        if idx is None:
-            self._push(dataclasses.replace(result, request_id=self.request_id))
-            return False
-        self._owner._count("resubmits")
-        if self._owner._flight is not None:
-            self._owner._flight.note_resubmit(self.request_id, worker=idx)
-        self._inner, self._inner_idx = result, idx
-        if self.cancelled:
-            result.cancel()  # a cancel raced the resubmit: propagate
-        return True
-
-    def _pump(self) -> None:
-        while True:
-            for ev in self._inner.events(timeout=None):
-                if not ev.is_final:
-                    if ev.token_id is not None:
-                        self._forwarded += 1
-                    # Hot path: before any resubmit the inner rid IS the
-                    # relay rid — forward without an allocation; only a
-                    # replacement stream (different rid) pays the copy.
-                    self._push(
-                        ev if ev.request_id == self.request_id
-                        else dataclasses.replace(ev, request_id=self.request_id)
-                    )
-                    continue
-                if (
-                    ev.finish_reason is FinishReason.ERROR
-                    # Worker-fault discriminator: engines stamp
-                    # num_prompt_tokens only on ERRORs for requests they
-                    # had ACCEPTED (death/recovery/prefill-crash);
-                    # validation rejections (empty prompt, bad
-                    # max_tokens, grammar) leave it 0 and would recur
-                    # identically on every worker — resubmitting one
-                    # would burn a retry and smear a healthy worker's
-                    # reputation (a malformed-request stream must never
-                    # down the fleet).
-                    and ev.num_prompt_tokens > 0
-                    and self._forwarded == 0
-                    and self._resubmits_left > 0
-                    and not self.cancelled
-                    and (
-                        self._deadline_at is None
-                        or time.monotonic() < self._deadline_at
-                    )
-                ):
-                    self._resubmits_left -= 1
-                    if self._try_resubmit():
-                        break  # pump the replacement stream
-                    return
-                if ev.finish_reason is FinishReason.ERROR:
-                    # Honest partial count: the consumer saw exactly
-                    # self._forwarded tokens from this coordinator,
-                    # whatever the dying worker thought it emitted.
-                    ev = dataclasses.replace(
-                        ev, num_generated_tokens=self._forwarded
-                    )
-                self._push(dataclasses.replace(ev, request_id=self.request_id))
-                return
+        for i, w in live:
+            _stop_one(i, w)

@@ -287,6 +287,10 @@ class InferenceEngine(
         # arrive via _pending_releases under _lock. LRU uses last_used.
         self._sessions: dict[str, _SessionKV] = {}
         self._pending_releases: list[str] = []  # guarded-by: _lock
+        # Cross-worker session migration (sessions.py import_session):
+        # validated payloads queued for the engine thread to adopt —
+        # the same queued cross-thread contract as releases.
+        self._pending_imports: list = []  # guarded-by: _lock
         # Dispatched-but-unread decode chunks: (token futures, active
         # snapshot). Engine-thread-owned.
         self._inflight: collections.deque = collections.deque()
@@ -322,6 +326,12 @@ class InferenceEngine(
             "prefix_reuse_tokens": 0,
             "session_offloads": 0,
             "session_restores": 0,
+            # Live cross-worker session migration (sessions.py): exports
+            # hand a retiring worker's idle sessions to the coordinator
+            # in the host offload row format; imports adopt them here so
+            # the next turn restores instead of re-prefilling.
+            "session_exports": 0,
+            "session_imports": 0,
             # Cross-session shared-prefix pool (engine/prefix_cache.py).
             "prefix_cache_hit_tokens": 0,
             "prefix_cache_insertions": 0,

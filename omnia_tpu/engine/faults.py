@@ -22,6 +22,10 @@ Seams (who consults what):
   ``die_after_tokens`` tokens and then the worker "dies" (ERROR final,
   mid-stream). ``die_after_tokens=0`` is death before the first token —
   the transparently-resubmittable case.
+- ``take_export_fault()``: ``MockEngine.export_session`` — the first
+  ``export_faults`` exports raise (a worker dying mid-migration on
+  scale-down; the coordinator books the session as a counted
+  fresh-prefill fallback, never a dropped conversation).
 - ``take_hang_s()`` / ``slow_sync_s``: the host-sync seam —
   ``InferenceEngine._sync_chunk_host`` (a decode chunk's device→host
   read) and ``MockEngine._play``'s pre-first-token dispatch. A hang
@@ -66,6 +70,10 @@ class FaultPlan:
     hang_count: int = 1
     # The first N submit() calls raise RuntimeError (flaky transport).
     flaky_submit: int = 0
+    # The first N export_session() calls raise RuntimeError — the
+    # worker "dies mid-export" during a scale-down migration; the
+    # coordinator must book the session as a fresh-prefill fallback.
+    export_faults: int = 0
     # Added to EVERY sync/token step — un-counted latency tax (slow
     # link), never a terminal fault by itself.
     slow_sync_s: float = 0.0
@@ -73,7 +81,7 @@ class FaultPlan:
     def __post_init__(self) -> None:
         self._lock = threading.Lock()
         self.fired: dict[str, int] = {
-            "deaths": 0, "submit_faults": 0, "hangs": 0,
+            "deaths": 0, "submit_faults": 0, "hangs": 0, "export_faults": 0,
         }
 
     # -- consumption seams (each decides-and-counts atomically) --------
@@ -82,6 +90,13 @@ class FaultPlan:
         with self._lock:
             if self.fired["submit_faults"] < self.flaky_submit:
                 self.fired["submit_faults"] += 1
+                return True
+        return False
+
+    def take_export_fault(self) -> bool:
+        with self._lock:
+            if self.fired["export_faults"] < self.export_faults:
+                self.fired["export_faults"] += 1
                 return True
         return False
 

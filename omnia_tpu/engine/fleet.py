@@ -1,0 +1,311 @@
+"""Elastic fleet scale-out: the queue-depth → replica-count control loop.
+
+ROADMAP item 2's missing end-to-end path: the engine exports
+``pending_prefill_tokens()`` (the SURVEY §5.8 backlog signal), the
+coordinator sums it fleet-wide, and ``operator/autoscaling.py`` holds a
+KEDA-style :class:`Autoscaler` policy — but until now nothing DROVE it.
+A :class:`FleetScaler` closes the loop: it samples the fleet-wide
+prompt-token backlog plus active sessions, feeds the existing
+``AutoscalingPolicy``/``Autoscaler`` (queue depth, not connection
+count), and applies the decision through a **provisioner callback** —
+the one seam both deployment shapes implement:
+
+- :class:`MockFleetProvisioner` (in-tree, tests/bench): launches mock
+  workers into a live :class:`~omnia_tpu.engine.coordinator.
+  EngineCoordinator` via ``add_worker`` and retires them via
+  ``remove_worker(migrate=True)`` — scale-down migrates every resident
+  conversation to a survivor instead of dropping it.
+- the operator's pod backend (``operator/controller.py``): the same
+  ``current()``/``scale_to(want)`` callback over ``backend.scale``, so
+  AgentDeployment replicas follow inference queue depth.
+
+Jax-free by contract (the CI analysis job runs the whole control loop
+under a poisoned jax stub): decisions are host-side arithmetic over
+stats RPCs; nothing here touches device state. Worker RPCs
+(``queue_depth``/``pending_prefill_tokens``/``active_slots``) and
+provisioner calls all run OUTSIDE the scaler's lock — the same
+no-blocking-under-lock discipline the lock checker enforces on the
+coordinator.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import threading
+import time
+from collections import deque
+from typing import Callable, Optional
+
+from omnia_tpu.engine.types import PENDING_TOKENS_NORM
+from omnia_tpu.operator.autoscaling import Autoscaler, AutoscalingPolicy
+
+logger = logging.getLogger(__name__)
+
+__all__ = ["FleetScaler", "MockFleetProvisioner", "ScaleEvent",
+           "PENDING_TOKENS_NORM"]
+
+
+@dataclasses.dataclass
+class ScaleEvent:
+    """One applied fleet-size change (the bench's 1→N→1 event trace)."""
+
+    at_s: float              # scaler-clock timestamp of the decision
+    kind: str                # "up" | "down"
+    from_workers: int
+    to_workers: int
+    queue_signal: float      # the depth fed to the policy at decision time
+    active: int              # active connections/slots at decision time
+    migrated: int = 0        # sessions carried to survivors (down only)
+    fallbacks: int = 0       # sessions falling back to fresh prefill
+
+    def to_dict(self) -> dict:
+        d = dataclasses.asdict(self)
+        d["at_s"] = round(d["at_s"], 6)
+        d["queue_signal"] = round(d["queue_signal"], 4)
+        return d
+
+
+class MockFleetProvisioner:
+    """In-tree provisioner: elastic mock workers on a live coordinator.
+
+    ``factory(index)`` builds one started-ready worker (tests/bench pass
+    a ``MockEngine(name=f"w{index}", ...)`` builder so request-id
+    namespaces stay unique across the elastic fleet — the traffic
+    simulator's flight joins depend on it). Scale-down retires through
+    ``remove_worker(migrate=True)``: resident conversations move to the
+    affinity-best survivor, so shrinking the fleet never drops one.
+
+    The floor is ONE live worker: an in-process coordinator cannot
+    serve from zero (true scale-to-zero belongs to the operator's pod
+    backend, where a cold start brings the replica back). A policy that
+    asks for 0 is clamped, and the clamp is visible in ``current()``.
+    """
+
+    def __init__(self, coordinator, factory: Callable[[int], object],
+                 max_workers: int = 8) -> None:
+        self.coordinator = coordinator
+        self.factory = factory
+        self.max_workers = max_workers
+        self._launched = len(coordinator.workers)
+        self.disposed: list = []   # remove_worker() summary dicts, in order
+
+    def current(self) -> int:
+        return self.coordinator.live_workers()
+
+    def scale_to(self, want: int) -> int:
+        want = max(1, min(want, self.max_workers))
+        while self.coordinator.live_workers() < want:
+            worker = self.factory(self._launched)
+            self._launched += 1
+            self.coordinator.add_worker(worker)
+        while self.coordinator.live_workers() > want:
+            summary = self.coordinator.remove_worker(migrate=True)
+            self.disposed.append(summary)
+        return self.coordinator.live_workers()
+
+
+class FleetScaler:
+    """Samples the fleet's backlog, decides through the Autoscaler,
+    applies through the provisioner. Drive it either way:
+
+    - ``start()``/``stop()``: a daemon thread ticks every
+      ``interval_s`` (the serving deployment shape).
+    - ``tick(now=..., current=..., depth=..., conns=...)``: one
+      synchronous decision with any sample overridden — deterministic
+      tests and the operator's resync loop (which samples its pods
+      itself and supplies ``current`` from the deployment record).
+
+    The provisioner is duck-typed: an object with ``current()`` +
+    ``scale_to(want) -> achieved``, or a bare callable
+    ``f(want) -> achieved`` (then ``current`` must come from the
+    coordinator or the tick kwarg).
+    """
+
+    def __init__(
+        self,
+        policy: AutoscalingPolicy,
+        provisioner,
+        *,
+        coordinator=None,
+        signals: Optional[Callable[[], "tuple[float, int]"]] = None,
+        interval_s: float = 0.25,
+        clock: Callable[[], float] = time.monotonic,
+        pending_norm: float = PENDING_TOKENS_NORM,
+        max_events: int = 256,
+    ) -> None:
+        self.policy = policy
+        self.provisioner = provisioner
+        self.coordinator = coordinator
+        self._signals = signals
+        self.interval_s = interval_s
+        self._clock = clock
+        self.pending_norm = pending_norm
+        self._scaler = Autoscaler(policy, clock=clock)
+        self._lock = threading.Lock()
+        self._events: "deque[ScaleEvent]" = deque(maxlen=max_events)  # guarded-by: _lock
+        self._ticks = 0          # guarded-by: _lock
+        self._scale_errors = 0   # guarded-by: _lock
+        # Lifetime totals, monotonic beside the BOUNDED event trace: a
+        # long-lived fleet scales past maxlen and the runbook's flap
+        # diagnostic must still read true lifetime counts, not the
+        # retained window dressed up as totals.
+        self._totals = {         # guarded-by: _lock
+            "scale_events": 0, "ups": 0, "downs": 0,
+            "migrated": 0, "fallbacks": 0,
+        }
+        self._thread: Optional[threading.Thread] = None
+        self._stop_event = threading.Event()
+
+    # -- sampling -------------------------------------------------------
+
+    def sample(self) -> "tuple[float, int]":
+        """(queue-depth signal, active connections): queued requests
+        plus the prompt-token backlog in request-equivalents — the
+        SURVEY §5.8 trigger, NOT the connection count (which only
+        breaks ties at zero backlog via the policy's busy-hold)."""
+        if self._signals is not None:
+            return self._signals()
+        c = self.coordinator
+        if c is None:
+            return 0.0, 0
+        depth = float(c.queue_depth())
+        depth += c.pending_prefill_tokens() / self.pending_norm
+        return depth, c.active_slots()
+
+    # -- one decision ---------------------------------------------------
+
+    def tick(
+        self,
+        now: Optional[float] = None,
+        *,
+        current: Optional[int] = None,
+        depth: Optional[float] = None,
+        conns: Optional[int] = None,
+    ) -> Optional[ScaleEvent]:
+        """Sample → decide → apply. Returns the applied ScaleEvent, or
+        None when the policy held the fleet size."""
+        if depth is None or conns is None:
+            s_depth, s_conns = self.sample()
+            depth = s_depth if depth is None else depth
+            conns = s_conns if conns is None else conns
+        if current is None:
+            current = (
+                self.provisioner.current()
+                if hasattr(self.provisioner, "current")
+                else self.coordinator.live_workers()
+            )
+        with self._lock:
+            self._ticks += 1
+        want = self._scaler.desired_replicas(current, depth, conns, now=now)
+        if want == current:
+            return None
+        apply = (
+            self.provisioner.scale_to
+            if hasattr(self.provisioner, "scale_to")
+            else self.provisioner
+        )
+        before_mig, before_fb = self._migration_books()
+        try:
+            achieved = apply(want)
+        except Exception:
+            logger.exception("fleet scale %d -> %d failed", current, want)
+            with self._lock:
+                self._scale_errors += 1
+            # Nothing changed: un-stamp the decision so stabilization
+            # does not gate the retry as if the fleet had just scaled.
+            self._scaler.note_unapplied()
+            return None
+        applied = achieved if achieved is not None else want
+        if applied == current:
+            # The provisioner's floor/ceiling clamp made this a no-op
+            # (e.g. the mock fleet's 1-worker floor under a
+            # scale-to-zero policy): no event — an idle fleet must not
+            # flood the trace with phantom downs every stabilization
+            # window, evicting the genuine 1→N→1 history — and no
+            # stabilization stamp either.
+            self._scaler.note_unapplied()
+            return None
+        after_mig, after_fb = self._migration_books()
+        ev = ScaleEvent(
+            at_s=self._clock() if now is None else now,
+            kind="up" if want > current else "down",
+            from_workers=current,
+            to_workers=applied,
+            queue_signal=depth,
+            active=conns,
+            migrated=after_mig - before_mig,
+            fallbacks=after_fb - before_fb,
+        )
+        with self._lock:
+            self._events.append(ev)
+            self._totals["scale_events"] += 1
+            self._totals["ups" if ev.kind == "up" else "downs"] += 1
+            self._totals["migrated"] += ev.migrated
+            self._totals["fallbacks"] += ev.fallbacks
+        logger.info(
+            "fleet scaled %s: %d -> %d (queue=%.2f conns=%d migrated=%d "
+            "fallbacks=%d)", ev.kind, ev.from_workers, ev.to_workers,
+            depth, conns, ev.migrated, ev.fallbacks,
+        )
+        return ev
+
+    def _migration_books(self) -> "tuple[int, int]":
+        c = self.coordinator
+        if c is None or not hasattr(c, "metrics"):
+            return 0, 0
+        snap = c.metrics_snapshot() if hasattr(c, "metrics_snapshot") else c.metrics
+        return (
+            snap.get("sessions_migrated", 0),
+            snap.get("migration_fallbacks", 0),
+        )
+
+    # -- observability --------------------------------------------------
+
+    def events(self) -> "list[ScaleEvent]":
+        with self._lock:
+            return list(self._events)
+
+    def stats(self) -> dict:
+        with self._lock:
+            out = dict(self._totals)
+            out["ticks"] = self._ticks
+            out["scale_errors"] = self._scale_errors
+        return out
+
+    # -- thread loop ----------------------------------------------------
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._stop_event.clear()
+        self._thread = threading.Thread(
+            target=self._loop, name="omnia-fleet-scaler", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        if self._thread is None:
+            return
+        self._stop_event.set()
+        self._thread.join(timeout=30)
+        if self._thread.is_alive():
+            # Mid scale apply (a multi-worker drain can hold a tick for
+            # minutes): keep the handle so a later start() cannot clear
+            # _stop_event under the still-running loop and leave TWO
+            # loops racing scale_to() on one provisioner. A retried
+            # stop() finishes the cleanup once the tick returns.
+            logger.warning(
+                "fleet scaler thread still stopping (tick mid scale "
+                "apply); retry stop() to reap it"
+            )
+            return
+        self._thread = None
+
+    def _loop(self) -> None:
+        while not self._stop_event.is_set():
+            try:
+                self.tick()
+            except Exception:  # pragma: no cover - loop must not die silently
+                logger.exception("fleet scaler tick failed")
+            self._stop_event.wait(self.interval_s)

@@ -69,6 +69,10 @@ EVENTS = frozenset({
     "failover",        # coordinator moved work off a failing worker
     "resubmit",        # coordinator re-placed a zero-token death
     "shed",            # coordinator shed before routing (fleet saturated)
+    "migrate",         # scale-down moved a session to a survivor (or
+                       # booked its fresh-prefill fallback)
+    "drain",           # one worker's graceful drain finished (attrs:
+                       # worker, seconds — slow-drain attribution)
     "terminal",        # request finished (attrs carry the breakdown)
     # Cold-start phases (engine/coldstart.py): the submit-to-ready
     # bring-up seams, so an accelerator hang is attributed to a PHASE
@@ -353,11 +357,35 @@ class FlightRecorder:
     def note_failover(self, request_id: str = "", worker: int = -1) -> None:
         self._record("failover", request_id, {"worker": worker})
 
-    def note_resubmit(self, request_id: str = "", worker: int = -1) -> None:
-        self._record("resubmit", request_id, {"worker": worker})
+    def note_resubmit(self, request_id: str = "", worker: int = -1,
+                      reason: str = "death") -> None:
+        """Transparent zero-token re-placement. ``reason`` keeps the
+        trail reconcilable against the SPLIT metric books: "death" rows
+        count under `resubmits`, "retirement" rows (a submit that raced
+        remove_worker) under `retirement_relays`."""
+        self._record("resubmit", request_id, {
+            "worker": worker, "reason": reason,
+        })
 
     def note_shed(self, reason: str = "") -> None:
         self._record("shed", "", {"reason": reason})
+
+    def note_migrate(self, session_id: str, src: int, dest: int,
+                     fallback: bool = False) -> None:
+        """Scale-down moved one session off a retiring worker: carried
+        to ``dest`` (imported KV), or — with ``fallback`` — dropped to
+        a counted fresh-prefill recovery (``dest`` is -1)."""
+        self._record("migrate", "", {
+            "session_id": session_id, "src": src, "dest": dest,
+            "fallback": fallback,
+        })
+
+    def note_drain(self, worker: int, seconds: float) -> None:
+        """One worker's graceful drain completed, ``seconds`` after it
+        began — recorded per worker so a slow-drain worker in the
+        overlapped fleet drain is attributable instead of reading as a
+        wedged fleet."""
+        self._record("drain", "", {"worker": worker, "seconds": seconds})
 
     def note_terminal(self, request_id: str, reason: str,
                       tokens: int = 0, error: Optional[str] = None,
@@ -472,10 +500,11 @@ def to_chrome_trace(events: list) -> dict:
     # land at a negative ts. Base on the earliest computed start.
     def start_of(e: dict) -> float:
         attrs = e.get("attrs", {})
-        if e["kind"] in INIT_EVENTS:
-            # Init-phase events are recorded at phase END with the
-            # phase's wall in `seconds` — the longest durations in any
-            # cold-start dump, so the base must account for them.
+        if e["kind"] in INIT_EVENTS or e["kind"] == "drain":
+            # Init-phase and drain events are recorded at their END
+            # with the wall in `seconds` — the longest durations in any
+            # cold-start or scale-down dump, so the base must account
+            # for them.
             return e["mono"] - attrs.get("seconds", 0.0)
         return e["mono"] - attrs.get("dispatch_s", 0.0) - attrs.get("sync_s", 0.0)
 
@@ -510,14 +539,15 @@ def to_chrome_trace(events: list) -> dict:
                 "ts": us(e["mono"] - dur), "dur": round(dur * 1e6, 1),
                 "args": attrs,
             })
-        elif kind in INIT_EVENTS:
+        elif kind in INIT_EVENTS or kind == "drain":
             dur = attrs.get("seconds", 0.0)
             out.append({
                 "ph": "X", "pid": 1, "tid": 0, "name": kind,
                 "ts": us(e["mono"] - dur), "dur": round(dur * 1e6, 1),
                 "args": attrs,
             })
-        elif kind in ("offload", "restore", "failover", "resubmit", "shed"):
+        elif kind in ("offload", "restore", "failover", "resubmit", "shed",
+                      "migrate"):
             out.append({"ph": "i", "pid": 1, "tid": 0, "name": kind,
                         "ts": us(e["mono"]), "s": "p", "args": attrs})
         elif rid:

@@ -1,0 +1,243 @@
+"""Elastic fleet membership: the coordinator's add/remove/migrate layer.
+
+Mixin methods of :class:`~omnia_tpu.engine.coordinator.EngineCoordinator`
+(split out the way the engine splits its scheduler/sessions mixins; the
+lock checker enforces coordinator.py's ``guarded-by`` annotations
+across this file too — both are one lock group):
+
+- ``add_worker()`` joins a worker at runtime: health/metrics state
+  initialize under the existing locks and the next routing decision can
+  pick it.
+- ``remove_worker(migrate=True)`` retires one: permanent tombstone
+  (never probed, never reinstated, index stable), bounded drain with
+  the duration in the flight trail, then **live migration** — each
+  pinned session's KV exports in the host-row offload format
+  (``engine/types.SessionExport``) and imports at the affinity-best
+  survivor; a failed export/import falls back to a counted fresh
+  prefill. Scale-down never drops a conversation.
+
+``engine/fleet.py`` drives both ends through its provisioner seam.
+"""
+
+from __future__ import annotations
+
+import collections
+import logging
+import time
+from typing import Optional
+
+logger = logging.getLogger(__name__)
+
+
+class _MembershipMixin:
+    """Fleet-membership methods of ``EngineCoordinator``. All worker
+    RPCs (start/stop/export/import) run OUTSIDE every coordinator lock;
+    ``_scale_lock`` serializes whole membership operations only."""
+
+    def add_worker(self, worker, start: bool = True) -> int:
+        """Join a worker to the live fleet. Health/metrics state
+        initialize under the existing locks; the next ``_pick`` load
+        snapshot can route to it. Returns the worker's fleet index
+        (stable for its lifetime — retirement tombstones, never
+        compacts). ``start=False`` for workers the caller already
+        started (remote stubs)."""
+        from omnia_tpu.engine.coordinator import _WorkerHealth
+
+        with self._scale_lock:
+            if start:
+                worker.start()
+            with self._lock:
+                self.workers.append(worker)
+                idx = len(self.workers) - 1
+            # Health entry appended AFTER the worker: _healthy_indices
+            # enumerates _health, so no index it yields can ever be
+            # missing from self.workers.
+            with self._health_lock:
+                self._health.append(_WorkerHealth())
+            self._count("scale_events")
+            live = self.live_workers()
+            with self._metrics_lock:
+                self.metrics["fleet_workers"] = live
+            logger.info("worker %d joined the fleet (live=%d)", idx, live)
+            return idx
+
+    def _retire_candidate(self) -> int:
+        """The cheapest live worker to drain: fewest pinned sessions,
+        newest index breaking ties (LIFO matches how elastic fleets
+        grew)."""
+        with self._health_lock:
+            live = [i for i, st in enumerate(self._health) if not st.retired]
+        with self._lock:
+            pins = collections.Counter(self._affinity.values())
+        return min(live, key=lambda i: (pins.get(i, 0), -i))
+
+    def remove_worker(
+        self,
+        idx: Optional[int] = None,
+        migrate: bool = True,
+        drain_timeout_s: float = 30.0,
+    ) -> dict:
+        """Retire one worker: leave the routing set, drain admission and
+        in-flight requests (bounded), then move its resident
+        conversations. ``idx=None`` picks the candidate with the fewest
+        pinned sessions. Returns the retirement summary —
+        ``{"worker", "drain_s", "migrated", "fallbacks", "repinned",
+        "dropped_pins"}`` — and the fleet ledger
+        (``sessions_migrated``/``migration_fallbacks``) books the same
+        outcomes, so ``pinned == migrated + fallbacks + repinned``
+        reconciles exactly."""
+        with self._scale_lock:
+            if idx is None:
+                idx = self._retire_candidate()
+            with self._health_lock:
+                if not (0 <= idx < len(self._health)) or self._health[idx].retired:
+                    raise ValueError(f"worker {idx} is not a live fleet member")
+                if sum(1 for st in self._health if not st.retired) <= 1:
+                    raise ValueError("cannot remove the last live worker")
+                st = self._health[idx]
+                st.retired = True
+                st.up = False
+                st.healthy_since = None
+            # Fresh-session prefix pins must stop steering traffic here
+            # NOW — dropping them proactively keeps the lazy _pick path
+            # from misbooking retirement as prefix_failovers.
+            with self._lock:
+                for key in [
+                    k for k, wi in self._prefix_affinity.items() if wi == idx
+                ]:
+                    del self._prefix_affinity[key]
+            worker = self.workers[idx]
+            summary = {
+                "worker": idx, "drain_s": 0.0, "migrated": 0,
+                "fallbacks": 0, "repinned": 0, "dropped_pins": 0,
+            }
+            # Drain: admission closes on the worker (racing submits shed
+            # OVERLOADED there and relay-resubmit to a survivor), queued
+            # and active requests finish inside the window. Timed so a
+            # slow-drain worker is attributable in the flight trail.
+            t0 = time.monotonic()
+            try:
+                try:
+                    worker.stop(drain=True, drain_timeout_s=drain_timeout_s)
+                except TypeError:
+                    try:
+                        worker.stop(drain=True)
+                    except TypeError:
+                        worker.stop()  # worker predates the drain kwarg
+            except Exception:
+                logger.exception("retiring worker %d failed to stop", idx)
+            summary["drain_s"] = time.monotonic() - t0
+            if self._flight is not None:
+                self._flight.note_drain(idx, summary["drain_s"])
+            if migrate:
+                m, f, r = self._migrate_sessions(idx, worker)
+                summary.update(migrated=m, fallbacks=f, repinned=r)
+            else:
+                with self._lock:
+                    stale = [
+                        sid for sid, wi in self._affinity.items() if wi == idx
+                    ]
+                    for sid in stale:
+                        del self._affinity[sid]
+                summary["dropped_pins"] = len(stale)
+            self._count("scale_events")
+            live = self.live_workers()
+            with self._metrics_lock:
+                self.metrics["fleet_workers"] = live
+            logger.info(
+                "worker %d retired (live=%d migrated=%d fallbacks=%d "
+                "drain=%.3fs)", idx, live, summary["migrated"],
+                summary["fallbacks"], summary["drain_s"],
+            )
+            return summary
+
+    def _pick_survivor(self, token_ids: list) -> "Optional[int]":
+        """The prefix-aware half of ``_pick``, read-only: honors an
+        existing prompt-head pin (with the same spill-to-least-loaded
+        rule) but books nothing and mutates no affinity state — the
+        routing ledger must read served traffic, not migrations."""
+        healthy = set(self._healthy_indices())
+        if not healthy:
+            return None
+        # Load snapshot OUTSIDE self._lock (worker RPCs — same
+        # no-blocking-under-lock rule as _pick).
+        loads = {i: self._load(i) for i in healthy}
+        least = min(healthy, key=lambda i: (loads[i], i))
+        key = self._prefix_key(list(token_ids), None)
+        with self._lock:
+            pinned = (
+                self._prefix_affinity.get(key) if key is not None else None
+            )
+        if pinned is None or pinned not in healthy:
+            return least
+        if loads[pinned] - loads[least] > self.prefix_spill_load:
+            return least
+        return pinned
+
+    def _migrate_sessions(self, idx: int, worker) -> "tuple[int, int, int]":
+        """Move every session pinned to the retiring worker. Each lands
+        in exactly one bucket: migrated (export → affinity-best survivor
+        import → re-pin), fallback (export/import failed or unsupported:
+        the pin drops and the next turn fresh-prefills from the
+        conversation's own history), or repinned (a racing submit
+        already failed the session over — it lives elsewhere, leave it).
+        All worker RPCs run outside every coordinator lock."""
+        with self._lock:
+            sids = [sid for sid, wi in self._affinity.items() if wi == idx]
+        export = getattr(worker, "export_session", None)
+        migrated = fallbacks = repinned = 0
+        for sid in sids:
+            with self._lock:
+                if self._affinity.get(sid) != idx:
+                    repinned += 1
+                    continue
+            payload = None
+            if export is not None:
+                try:
+                    payload = export(sid)
+                except Exception:
+                    logger.warning(
+                        "export_session(%s) failed on retiring worker %d; "
+                        "falling back to fresh prefill", sid, idx,
+                    )
+            dest = None
+            if payload is not None:
+                # Affinity-best survivor: the same prefix-aware decision
+                # fresh sessions route through, so migrated sessions
+                # sharing a prompt head land beside their pool entry —
+                # but READ-ONLY: a migration is not a routed submit, and
+                # must not bump prefix_routed/spill books or mutate the
+                # prefix-pin map.
+                dest = self._pick_survivor(list(payload.token_ids))
+            ok = False
+            if dest is not None:
+                imp = getattr(self.workers[dest], "import_session", None)
+                if imp is not None:
+                    try:
+                        imp(payload)
+                        ok = True
+                    except Exception:
+                        logger.warning(
+                            "import_session(%s) on worker %d failed; "
+                            "falling back to fresh prefill", sid, dest,
+                        )
+            with self._lock:
+                if self._affinity.get(sid) == idx:
+                    if ok:
+                        self._affinity[sid] = dest
+                        self._affinity.move_to_end(sid)
+                    else:
+                        del self._affinity[sid]
+            if ok:
+                migrated += 1
+                self._count("sessions_migrated")
+                if self._flight is not None:
+                    self._flight.note_migrate(sid, src=idx, dest=dest)
+            else:
+                fallbacks += 1
+                self._count("migration_fallbacks")
+                if self._flight is not None:
+                    self._flight.note_migrate(
+                        sid, src=idx, dest=-1, fallback=True
+                    )
+        return migrated, fallbacks, repinned

@@ -22,6 +22,7 @@ from typing import Optional, Sequence
 
 from omnia_tpu.engine.faults import FaultPlan
 from omnia_tpu.engine.flight import FlightRecorder
+from omnia_tpu.engine.mock_sessions import _MockSessionsMixin
 from omnia_tpu.engine.tokenizer import ByteTokenizer
 from omnia_tpu.engine.types import (
     FinishReason,
@@ -77,7 +78,7 @@ def _current_turn_view(prompt: str) -> str:
 DEFAULT_REPLY = "mock-reply"
 
 
-class MockEngine:
+class MockEngine(_MockSessionsMixin):
     """Drop-in scripted engine (no device, no model)."""
 
     def __init__(self, scenarios: Sequence[Scenario] = (), tokenizer=None,
@@ -189,6 +190,14 @@ class MockEngine:
             from omnia_tpu.engine.spec_decode import _SpecGate
 
             self._spec_gate = _SpecGate(spec_gate_window)
+        # Session-migration parity (engine/sessions.py export/import):
+        # the mock keeps no KV, but it DOES remember which sessions are
+        # resident — token streams keyed by session_id — so the
+        # coordinator's scale-down migration (export at the retiring
+        # worker, import at the survivor, re-pin) is exercisable
+        # hermetically, including the PoolExhausted rejection when the
+        # survivor's page mirror cannot hold the imported rows.
+        self._sessions: dict = {}  # guarded-by: _lock
         # The allocator REFERENCE is immutable after construction; its
         # internal books (and _page_slots) mutate only under _lock.
         self._page_alloc = None
@@ -224,6 +233,10 @@ class MockEngine:
             "decode_stall_steps": 0,
             # Flight-recorder parity (engine/flight.py).
             "flight_enabled": 1 if flight_events > 0 else 0,
+            # Session-migration parity (engine/sessions.py): scale-down
+            # exports at the retiring worker, imports at the survivor.
+            "session_exports": 0,
+            "session_imports": 0,
             # Speculative-decoding parity (engine/spec_decode.py): the
             # greedy-playback prompt-lookup mirror books these.
             "spec_steps": 0,
@@ -343,12 +356,6 @@ class MockEngine:
     def register_prefix(self, tokens) -> None:
         """Interface parity with InferenceEngine; the mock has no KV."""
 
-    def release_session(self, session_id: str) -> None:
-        """Interface parity with InferenceEngine; the mock keeps no
-        session KV, so a release is a no-op — but accepting the call
-        lets the coordinator's release path run against mock fleets
-        without taking its worker-RPC-failure re-pin branch."""
-
     def supports_grammar(self) -> bool:
         """The mock enforces grammars host-side (same masks, no device),
         so tier-1 tests exercise the full constrained path hermetically."""
@@ -387,8 +394,9 @@ class MockEngine:
         deadline_s: Optional[float] = None,
         trace_ctx: Optional[str] = None,
     ) -> RequestHandle:
-        # session_id accepted for interface parity with InferenceEngine;
-        # the mock replays scenarios statelessly, so it is ignored.
+        # Playback stays stateless (scenarios key on the prompt), but a
+        # session_id registers the completed token stream in the
+        # migration registry so scale-down can export/import it.
         if self.fault_plan is not None and self.fault_plan.take_submit_fault():
             raise RuntimeError("injected flaky submit (FaultPlan)")
         rid = f"{self.name}-{next(self._req_counter)}"
@@ -457,7 +465,8 @@ class MockEngine:
         )
         thread = threading.Thread(
             target=self._play_guarded,
-            args=(rid, list(prompt_tokens), params, handle, grammar, deadline_at),
+            args=(rid, list(prompt_tokens), params, handle, grammar,
+                  deadline_at, session_id),
             daemon=True,
         )
         thread.start()
@@ -634,10 +643,11 @@ class MockEngine:
             self.metrics["kv_page_cow_copies"] = a.cow_copies
 
     def _play_guarded(self, rid, prompt_tokens, params, handle, grammar,
-                      deadline_at):
+                      deadline_at, session_id=None):
         page_slot = self._page_mirror_begin(len(prompt_tokens))
         try:
-            self._play(rid, prompt_tokens, params, handle, grammar, deadline_at)
+            self._play(rid, prompt_tokens, params, handle, grammar,
+                       deadline_at, session_id)
         finally:
             self._page_mirror_end(page_slot)
             with self._lock:
@@ -663,7 +673,7 @@ class MockEngine:
             )
 
     def _play(self, rid, prompt_tokens, params, handle: RequestHandle,
-              grammar=None, deadline_at=None):
+              grammar=None, deadline_at=None, session_id=None):
         prompt = self.tokenizer.decode(prompt_tokens)
         scenario = self._scenario_for(prompt)
         fault = self.fault_plan
@@ -772,4 +782,8 @@ class MockEngine:
             if len(reply_ids) >= params.max_tokens
             else FinishReason.STOP
         )
+        if session_id is not None:
+            # Completed sessionful turn: the prompt+reply stream is the
+            # session's resident record (the migration payload).
+            self._session_note(session_id, prompt_tokens + reply_ids)
         self._finish(handle, rid, reason, n_prompt, generated)

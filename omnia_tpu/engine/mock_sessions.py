@@ -1,0 +1,122 @@
+"""Mock-engine session-migration parity (engine/types.SessionExport).
+
+Mixin methods of :class:`~omnia_tpu.engine.mock.MockEngine` (split out
+on the file-length discipline; one lock group with mock.py). The mock
+keeps no KV, but it DOES remember which sessions are resident — token
+streams keyed by session_id — so the coordinator's scale-down migration
+(export at the retiring worker, import at the survivor, re-pin) is
+exercisable hermetically, including the ``PoolExhausted`` rejection
+when the survivor's page mirror cannot hold the imported rows. All of
+it is jax-free: the CI analysis job runs the whole migration battery
+under a poisoned jax stub.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+
+class _MockSessionsMixin:
+    def release_session(self, session_id: str) -> None:
+        """Forget a session's resident record (parity with the engine's
+        release contract; the coordinator's release path runs against
+        mock fleets without taking its worker-RPC-failure re-pin
+        branch). Frees the page-mirror rows an imported session held."""
+        self._forget_session(session_id)
+
+    def _forget_session(self, session_id: str) -> Optional[dict]:
+        """Pop a session's resident record, returning its page-mirror
+        hold to the free list (lock taken HERE — the allocator's books
+        mutate only under it). Returns the popped record, already
+        detached from the registry and the pool."""
+        with self._lock:
+            rec = self._sessions.pop(session_id, None)
+            if rec is None:
+                return None
+            slot = rec.get("page_slot")
+            if slot is not None and self._page_alloc is not None:
+                a = self._page_alloc
+                a.release_from(slot, 0)
+                self._page_slots.append(slot)
+                self.metrics["kv_pages_free"] = a.free_count
+                self.metrics["kv_page_fragmentation"] = a.fragmentation()
+            return rec
+
+    def _session_note(self, session_id: str, token_ids: list) -> None:
+        """A sessionful playback completed: remember its token stream
+        (the migration payload's recovery seed). Replaces any imported
+        record — the playback 'rewrote' the session's rows, so the
+        import's page hold is returned."""
+        self._forget_session(session_id)
+        with self._lock:
+            self._sessions[session_id] = {
+                "token_ids": list(token_ids), "page_slot": None,
+            }
+
+    def export_session(self, session_id: str):
+        """Package one resident session for migration (the retiring-
+        worker half of ``remove_worker(migrate=True)``): the SAME
+        ``SessionExport`` payload the engine produces, with the token
+        stream carried and no host rows (the mock has no KV). A counted
+        ``FaultPlan.export_faults`` makes this the die-mid-export chaos
+        seam. Ownership transfers with the payload."""
+        from omnia_tpu.engine.types import SessionExport
+
+        if self.fault_plan is not None and self.fault_plan.take_export_fault():
+            raise RuntimeError("injected export death (FaultPlan)")
+        rec = self._forget_session(session_id)
+        if rec is None or not rec["token_ids"]:
+            return None
+        with self._lock:
+            self.metrics["session_exports"] += 1
+        return SessionExport(
+            session_id=session_id,
+            token_ids=list(rec["token_ids"]),
+            host_k=None, host_v=None,
+            kv_quant=self.kv_quant,
+        )
+
+    def import_session(self, export) -> None:
+        """Adopt a migrated session (the survivor half). Validates the
+        KV representation like the engine does, and — with the paged
+        mirror on — books real pages for the imported rows so a full
+        pool rejects the import with ``PoolExhausted`` (the coordinator
+        then counts a fresh-prefill fallback), exactly the exhaustion
+        behavior the real pool has."""
+        if export.kv_quant != self.kv_quant:
+            raise ValueError(
+                f"kv_quant mismatch: payload {export.kv_quant!r} vs "
+                f"mock {self.kv_quant!r}"
+            )
+        n = len(export.token_ids)
+        if n <= 0:
+            raise ValueError("empty session payload")
+        # Replacing a resident record frees its pages FIRST, so the
+        # re-import books against the pool the replacement leaves.
+        self._forget_session(export.session_id)
+        with self._lock:
+            page_slot = None
+            if self._page_alloc is not None:
+                from omnia_tpu.engine.kv_pages import PoolExhausted
+
+                a = self._page_alloc
+                if not self._page_slots:
+                    raise PoolExhausted(
+                        "no free page-table slot for imported session"
+                    )
+                slot = self._page_slots.pop()
+                if a.writes_needed(slot, 0, n) > a.free_count:
+                    self._page_slots.append(slot)
+                    raise PoolExhausted(
+                        f"imported session needs {a.writes_needed(slot, 0, n)}"
+                        f" pages; {a.free_count} free"
+                    )
+                a.prepare_write(slot, 0, n)
+                page_slot = slot
+                self.metrics["kv_pages_free"] = a.free_count
+                self.metrics["kv_page_fragmentation"] = a.fragmentation()
+            self._sessions[export.session_id] = {
+                "token_ids": list(export.token_ids), "page_slot": page_slot,
+            }
+            self.metrics["session_imports"] += 1
+

@@ -1,0 +1,161 @@
+"""Coordinator relay handle: the resubmit-on-worker-death pump.
+
+Split from ``engine/coordinator.py`` (file-length discipline): one
+class, owned by the coordinator's ``submit()`` — see its docstring for
+the duplication-safety rule. No coordinator lock is ever taken here;
+the single pump thread owns all relay state.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from typing import Optional
+
+from omnia_tpu.engine.types import FinishReason, RequestHandle
+
+
+class _RelayHandle(RequestHandle):
+    """Coordinator-owned handle: pumps the worker handle's events into
+    its own queue, and owns the resubmit decision on worker death.
+
+    The rule is duplication-safe by construction: a terminal ERROR with
+    ZERO tokens forwarded means the caller observed nothing, so the
+    request transparently resubmits to another worker (bounded by
+    ``resubmit_retries`` and the deadline budget); once ≥1 token has
+    been forwarded the ERROR surfaces with the partial count — the
+    coordinator never replays a stream the caller already saw part of.
+    Exactly ONE terminal event ever reaches the consumer."""
+
+    def __init__(self, owner, prompt_tokens, params, session_id, prefix_key,
+                 deadline_at, trace_ctx=None, grammar=None):
+        super().__init__("coord-pending")
+        self._owner = owner
+        self._args = (list(prompt_tokens), params, session_id, prefix_key)
+        self._deadline_at = deadline_at
+        # Re-sent verbatim on resubmit: the replacement worker's engine
+        # span joins the SAME trace (worker deaths extend the trace,
+        # never fork it).
+        self._trace_ctx = trace_ctx
+        # Likewise re-sent: a resubmitted constrained request stays
+        # constrained on the replacement worker.
+        self._grammar = grammar
+        self._inner: Optional[RequestHandle] = None
+        self._inner_idx: Optional[int] = None
+        self._resubmits_left = owner.resubmit_retries
+        self._forwarded = 0
+
+    def _begin(self, idx: int, inner: RequestHandle) -> None:
+        self.request_id = inner.request_id
+        self._inner, self._inner_idx = inner, idx
+        threading.Thread(
+            target=self._pump, name="omnia-coord-relay", daemon=True
+        ).start()
+
+    def cancel(self) -> None:
+        super().cancel()
+        inner = self._inner
+        if inner is not None:
+            inner.cancel()
+
+    def _try_resubmit(self, count_key: str = "resubmits") -> bool:
+        """Zero-token worker death (or retirement shed): place the
+        request on another worker. Returns True when a new inner stream
+        is live. ``count_key`` keeps the two causes in separate books —
+        the chaos ledger's ``deaths == resubmits + …`` identity must
+        never see a retirement relay. (The down-probe is a no-op for a
+        retired worker: retirement is a permanent tombstone.)"""
+        failed = self._inner_idx
+        self._owner._note_probe(failed, False, hard=True)
+        idx, result = self._owner._routed_submit(
+            *self._args, self._deadline_at, exclude=frozenset({failed}),
+            trace_ctx=self._trace_ctx, grammar=self._grammar,
+        )
+        if idx is None:
+            self._push(dataclasses.replace(result, request_id=self.request_id))
+            return False
+        self._owner._count(count_key)
+        if self._owner._flight is not None:
+            self._owner._flight.note_resubmit(
+                self.request_id, worker=idx,
+                reason=(
+                    "retirement" if count_key == "retirement_relays"
+                    else "death"
+                ),
+            )
+        self._inner, self._inner_idx = result, idx
+        if self.cancelled:
+            result.cancel()  # a cancel raced the resubmit: propagate
+        return True
+
+    def _pump(self) -> None:
+        while True:
+            for ev in self._inner.events(timeout=None):
+                if not ev.is_final:
+                    if ev.token_id is not None:
+                        self._forwarded += 1
+                    # Hot path: before any resubmit the inner rid IS the
+                    # relay rid — forward without an allocation; only a
+                    # replacement stream (different rid) pays the copy.
+                    self._push(
+                        ev if ev.request_id == self.request_id
+                        else dataclasses.replace(ev, request_id=self.request_id)
+                    )
+                    continue
+                if (
+                    ev.finish_reason is FinishReason.ERROR
+                    # Worker-fault discriminator: engines stamp
+                    # num_prompt_tokens only on ERRORs for requests they
+                    # had ACCEPTED (death/recovery/prefill-crash);
+                    # validation rejections (empty prompt, bad
+                    # max_tokens, grammar) leave it 0 and would recur
+                    # identically on every worker — resubmitting one
+                    # would burn a retry and smear a healthy worker's
+                    # reputation (a malformed-request stream must never
+                    # down the fleet).
+                    and ev.num_prompt_tokens > 0
+                    and self._forwarded == 0
+                    and self._resubmits_left > 0
+                    and not self.cancelled
+                    and (
+                        self._deadline_at is None
+                        or time.monotonic() < self._deadline_at
+                    )
+                ):
+                    self._resubmits_left -= 1
+                    if self._try_resubmit():
+                        break  # pump the replacement stream
+                    return
+                if (
+                    # Scale-down race: a submit that reached a worker
+                    # just as remove_worker closed its admission sheds
+                    # OVERLOADED there. Zero tokens forwarded means the
+                    # caller observed nothing — re-place on a survivor
+                    # (same duplication-safety rule as worker deaths).
+                    # An OVERLOADED from a NON-retiring worker is real
+                    # backpressure and must surface, never be retried
+                    # into an already-saturated fleet.
+                    ev.finish_reason is FinishReason.OVERLOADED
+                    and self._owner._worker_retired(self._inner_idx)
+                    and self._forwarded == 0
+                    and self._resubmits_left > 0
+                    and not self.cancelled
+                    and (
+                        self._deadline_at is None
+                        or time.monotonic() < self._deadline_at
+                    )
+                ):
+                    self._resubmits_left -= 1
+                    if self._try_resubmit(count_key="retirement_relays"):
+                        break  # pump the replacement stream
+                    return
+                if ev.finish_reason is FinishReason.ERROR:
+                    # Honest partial count: the consumer saw exactly
+                    # self._forwarded tokens from this coordinator,
+                    # whatever the dying worker thought it emitted.
+                    ev = dataclasses.replace(
+                        ev, num_generated_tokens=self._forwarded
+                    )
+                self._push(dataclasses.replace(ev, request_id=self.request_id))
+                return

@@ -83,6 +83,7 @@ class _SchedulerMixin:
     def step(self) -> bool:
         """One scheduling step. Returns True if any work was done."""
         self._drain_releases()
+        self._drain_imports()
         self._drain_prefix_regs()
         self._reap_cancelled()
         self._reap_deadlines()

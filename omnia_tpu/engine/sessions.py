@@ -20,7 +20,10 @@ from typing import Optional
 
 import numpy as np
 
-from omnia_tpu.engine.types import Request, RequestHandle
+# SessionExport lives in types.py (jax-free home — the mock fleet
+# builds payloads without the device stack) and is re-exported here,
+# its documented location beside the offload/restore code it rides.
+from omnia_tpu.engine.types import Request, RequestHandle, SessionExport
 from omnia_tpu.models.kv_quant import kv_device, kv_host
 
 
@@ -251,6 +254,97 @@ class _SessionMixin:
             released, self._pending_releases = self._pending_releases, []
         for sid in released:
             self._drop_session(sid)
+
+    def export_session(self, session_id: str) -> Optional[SessionExport]:
+        """Package one idle session for cross-worker migration
+        (scale-down: ``EngineCoordinator.remove_worker(migrate=True)``).
+
+        Callable once the engine loop is stopped (the post-drain moment
+        remove_worker calls from) — the registry and device state are
+        engine-thread-owned, so a LIVE engine answers None instead of
+        racing its own step loop. None also covers: unknown session, a
+        request still decoding on it, and rows the shared-prefix pool
+        elided (the survivor rebuilds those through its own pool seed —
+        nothing portable to carry). Ownership transfers with the
+        payload: a successful export forgets the session here."""
+        if self._thread is not None:
+            return None  # loop owns the registry/device state; drain first
+        self._drain_releases()
+        sess = self._sessions.get(session_id)
+        if sess is None:
+            return None
+        if sess.slot is not None:
+            if self._slots[sess.slot].active:
+                return None  # in-flight request still owns the slot
+            # Device-resident: page to host first — export rides the
+            # exact offload format (int8 + paged pools included).
+            self._offload_session(sess)
+        if not sess.token_ids or sess.host_k is None:
+            return None  # empty or elided: fresh prefill is the recovery
+        payload = SessionExport(
+            session_id=session_id,
+            token_ids=list(sess.token_ids),
+            host_k=sess.host_k,
+            host_v=sess.host_v,
+            kv_quant=self._kv_quant,
+            restore_rows=self.cfg.restore_bucket_for(len(sess.token_ids)),
+        )
+        self._drop_session(session_id)
+        self.metrics["session_exports"] += 1
+        return payload
+
+    def import_session(self, export: SessionExport) -> None:
+        """Adopt a migrated session: validate compatibility NOW (the
+        coordinator needs the accept/reject decision synchronously to
+        count fresh-prefill fallbacks exactly), then apply the registry
+        insert on the engine thread at the next step — the same queued
+        cross-thread contract as ``release_session`` — or immediately
+        when the loop is down. The imported record is host-paged; the
+        session's next turn restores it into a slot and prefills only
+        past the LCP, exactly as if it had been offloaded here."""
+        if self.cfg.max_sessions <= 0:
+            raise ValueError("engine has sessions disabled (max_sessions=0)")
+        if export.kv_quant != self._kv_quant:
+            raise ValueError(
+                f"kv_quant mismatch: payload {export.kv_quant!r} vs "
+                f"engine {self._kv_quant!r}"
+            )
+        n = len(export.token_ids)
+        if n <= 0 or export.host_k is None:
+            raise ValueError("empty session payload")
+        if n > self.cfg.max_seq - 2:
+            raise ValueError(
+                f"session of {n} tokens exceeds KV capacity "
+                f"(max_seq {self.cfg.max_seq} - 2)"
+            )
+        rows = self.cfg.restore_bucket_for(n)
+        shape = tuple(getattr(export.host_k, "shape", ()) or ())
+        expect = (
+            self.model_cfg.num_layers, rows,
+            self.model_cfg.num_kv_heads, self.model_cfg.head_dim,
+        )
+        if shape != expect:
+            raise ValueError(
+                f"session KV rows {shape} incompatible with this "
+                f"engine's restore shape {expect}"
+            )
+        with self._lock:
+            self._pending_imports.append(export)
+        if self._thread is None:
+            self._drain_imports()  # synchronous single-threaded use
+
+    def _drain_imports(self) -> None:
+        with self._lock:
+            imported, self._pending_imports = self._pending_imports, []
+        for exp in imported:
+            self._drop_session(exp.session_id)  # replace a stale record
+            sess = _SessionKV(exp.session_id, now=self.clock())
+            sess.token_ids = list(exp.token_ids)
+            sess.host_k = exp.host_k
+            sess.host_v = exp.host_v
+            self._sessions[exp.session_id] = sess
+            self.metrics["session_imports"] += 1
+            self._enforce_session_cap(protect=exp.session_id)
 
     def _offload_idle_sessions(self) -> int:
         """Page every idle resident session's KV rows to host RAM — the

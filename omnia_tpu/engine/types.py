@@ -22,6 +22,13 @@ from typing import Any, Iterator, Optional
 # set — the device mask just can't early-freeze on the overflow ids.
 MAX_DEVICE_STOP_IDS = 8
 
+# Prompt tokens per queue-slot request-equivalent of prefill backlog —
+# the ONE normalization shared by the coordinator's routing load signal,
+# the fleet scaler's autoscaling depth signal, and the operator's pod
+# scrape, so "one request of prefill work" means the same thing at every
+# decision point (retuning it in one place retunes them all).
+PENDING_TOKENS_NORM = 512.0
+
 
 @dataclasses.dataclass(frozen=True)
 class SamplingParams:
@@ -93,6 +100,35 @@ class StreamEvent:
     @property
     def is_final(self) -> bool:
         return self.finish_reason is not None
+
+
+@dataclasses.dataclass
+class SessionExport:
+    """One session's portable residency record — the live-migration
+    payload ``EngineCoordinator.remove_worker(migrate=True)`` carries
+    from a retiring worker to its survivor.
+
+    ``host_k``/``host_v`` ride the EXISTING host-row offload format
+    (``_offload_session``'s ``[L, R, H, D]`` restore-bucket rows; a
+    ``QuantKV`` of numpy leaves under ``kv_quant``; under ``kv_pages``
+    the retiring pool's pages gather to the SAME host layout) — so an
+    import is exactly a deferred ``_restore_session``, and the int8 and
+    paged pools migrate with zero extra formats. ``kv_quant`` and
+    ``restore_rows`` are the import-side compatibility stamp: a
+    survivor with a different KV representation or bucket set rejects
+    the payload loudly and the coordinator books a fresh-prefill
+    fallback instead of restoring garbage rows.
+
+    Lives HERE (not ``engine/sessions.py``, which re-exports it) so the
+    jax-free mock fleet can build payloads without pulling the engine's
+    device stack."""
+
+    session_id: str
+    token_ids: list
+    host_k: object
+    host_v: object
+    kv_quant: Optional[str] = None
+    restore_rows: int = 0
 
 
 class RequestHandle:

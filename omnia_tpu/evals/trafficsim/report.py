@@ -277,6 +277,11 @@ def _ledger(run, outcomes: list) -> dict:
     coord = run.coord_books or {}
     routed = coord.get("routed", 0)
     resubmits = coord.get("resubmits", 0)
+    # Retirement relays (scale-down racing a submit: OVERLOADED at the
+    # retiring worker, re-placed on a survivor) flow like resubmits in
+    # the worker books but are NOT deaths — they fold into the flow
+    # identities below and stay out of the chaos deaths identity.
+    relays = coord.get("retirement_relays", 0)
     coord_shed = coord.get("shed", 0)
 
     identities = []
@@ -293,17 +298,18 @@ def _ledger(run, outcomes: list) -> dict:
     # resubmit FAILED still has exactly one worker finish (the hidden
     # death) behind its unrouted terminal, so it needs no term here.
     ident(
-        "submits == worker_finished - resubmits + worker_shed + "
-        "coord_shed + unrouted_initial",
+        "submits == worker_finished - resubmits - retirement_relays + "
+        "worker_shed + coord_shed + unrouted_initial",
         run.submits,
-        w_fin - resubmits + w_shed + coord_shed + unrouted_initial,
+        w_fin - resubmits - relays + w_shed + coord_shed + unrouted_initial,
     )
     ident("worker_submitted == worker_finished (quiescence)", w_sub, w_fin)
     if run.coord_books is not None:
         ident("submits == routed + coord_shed + unrouted_initial",
               run.submits, routed + coord_shed + unrouted_initial)
-        ident("worker_submitted == routed + resubmits - worker_shed",
-              w_sub, routed + resubmits - w_shed)
+        ident("worker_submitted == routed + resubmits + retirement_relays"
+              " - worker_shed",
+              w_sub, routed + resubmits + relays - w_shed)
         ident("coord_shed observed == coord shed book",
               coord_shed_obs, coord_shed)
     if run.chaos_fired is not None:

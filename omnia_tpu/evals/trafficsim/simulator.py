@@ -59,8 +59,8 @@ WORKER_KEYS = (
     "deadline_exceeded", "tokens_generated", "watchdog_trips",
 )
 COORD_KEYS = (
-    "routed", "shed", "resubmits", "failovers", "prefix_routed",
-    "affinity_evictions",
+    "routed", "shed", "resubmits", "retirement_relays", "failovers",
+    "prefix_routed", "affinity_evictions",
 )
 
 
@@ -208,9 +208,13 @@ class TrafficSimulator:
         self.turn_timeout_s = turn_timeout_s
         self.temperature = temperature
         # The fleet behind the target: coordinator exposes .workers; a
-        # bare engine IS its own single-worker fleet.
-        self.workers = list(getattr(target, "workers", None) or [target])
+        # bare engine IS its own single-worker fleet. `self.workers` is
+        # the construction-time snapshot; every internal consumer reads
+        # _fleet() instead, because an elastic coordinator's membership
+        # changes mid-run (fleet scaler adds workers, scale-down retires
+        # them in place — retired workers stay readable tombstones).
         self._is_coordinator = hasattr(target, "workers")
+        self.workers = self._fleet()
         self._lock = threading.Lock()
         self._outcomes: list = []           # guarded-by: _lock
         self._submits = 0                   # guarded-by: _lock
@@ -251,20 +255,35 @@ class TrafficSimulator:
             self._grammars[req.grammar_schema_json] = g
         return g
 
-    def _books(self) -> "tuple[list, Optional[dict]]":
-        workers = [
-            {k: w.metrics.get(k, 0) for k in WORKER_KEYS}
-            for w in self.workers
-        ]
+    def _fleet(self) -> list:
+        """Current fleet membership behind the target, re-read live: a
+        worker that joined mid-run baselines at zero; a retired worker
+        keeps its books readable (the coordinator tombstones in place,
+        never compacts)."""
+        raw = getattr(self.target, "workers", None)
+        if raw is None:
+            return [self.target]
+        return [w for w in raw if w is not None]
+
+    def _books(self) -> "tuple[dict, Optional[dict]]":
+        workers = {
+            id(w): {k: w.metrics.get(k, 0) for k in WORKER_KEYS}
+            for w in self._fleet()
+        }
         coord = None
         if self._is_coordinator:
-            coord = {k: self.target.metrics.get(k, 0) for k in COORD_KEYS}
+            snap = (
+                self.target.metrics_snapshot()
+                if hasattr(self.target, "metrics_snapshot")
+                else self.target.metrics
+            )
+            coord = {k: snap.get(k, 0) for k in COORD_KEYS}
         return workers, coord
 
     def _arm_chaos(self) -> None:
         if self.chaos is None:
             return
-        for w in self.workers:
+        for w in self._fleet():
             # MockEngine exposes `fault_plan`; InferenceEngine's seam is
             # `_fault_plan` — same counted plan object either way, so
             # `fired` reconciles across the whole fleet.
@@ -448,7 +467,7 @@ class TrafficSimulator:
         while time.monotonic() < deadline:
             snap = tuple(
                 tuple(w.metrics.get(k, 0) for k in WORKER_KEYS)
-                for w in self.workers
+                for w in self._fleet()
             )
             if snap == prev:
                 return
@@ -516,10 +535,17 @@ class TrafficSimulator:
                 timer.cancel()
         self._quiesce()
         wall_s = time.monotonic() - wall0
+        fleet = self._fleet()
         books1, coord1 = self._books()
+        # Delta per worker IDENTITY (not list position): a mid-run
+        # joiner has no baseline and deltas from zero; workers present
+        # at both ends diff their own books.
         worker_books = [
-            {k: b1[k] - b0[k] for k in WORKER_KEYS}
-            for b0, b1 in zip(books0, books1)
+            {
+                k: books1[id(w)][k] - books0.get(id(w), {}).get(k, 0)
+                for k in WORKER_KEYS
+            }
+            for w in fleet
         ]
         coord_books = None
         if coord1 is not None:
@@ -536,7 +562,7 @@ class TrafficSimulator:
         # dropped from the join and counted, never attributed wrong.
         bd_owner: dict = {}
         collided: set = set()
-        for wi, w in enumerate(self.workers):
+        for wi, w in enumerate(fleet):
             rec = getattr(w, "_flight", None)
             if rec is None:
                 continue

@@ -15,7 +15,7 @@ from __future__ import annotations
 import math
 import time
 from dataclasses import dataclass
-from typing import Optional
+from typing import Callable, Optional
 
 
 @dataclass(frozen=True)
@@ -43,10 +43,17 @@ class AutoscalingPolicy:
 
 
 class Autoscaler:
-    def __init__(self, policy: AutoscalingPolicy):
+    def __init__(self, policy: AutoscalingPolicy,
+                 clock: Callable[[], float] = time.monotonic):
+        # Injectable clock (same idiom as the engine's deadline/LRU
+        # clock): scale-to-zero idle windows and scale-down
+        # stabilization become deterministic under test — a scripted
+        # clock walks the boundary exactly instead of sleeping at it.
         self.policy = policy
-        self._last_active_at = time.monotonic()
+        self._clock = clock
+        self._last_active_at = clock()
         self._last_change = 0.0
+        self._prev_change = 0.0
 
     def desired_replicas(
         self,
@@ -59,7 +66,7 @@ class Autoscaler:
         scale-to-zero only after a sustained idle window and scale-down
         stabilization to avoid flapping."""
         p = self.policy
-        now = time.monotonic() if now is None else now
+        now = self._clock() if now is None else now
         busy = total_queue_depth > 0 or active_connections > 0
         if busy:
             self._last_active_at = now
@@ -80,8 +87,17 @@ class Autoscaler:
         if want < current and now - self._last_change < p.stabilization_s:
             return current
         if want != current:
+            self._prev_change = self._last_change
             self._last_change = now
         return want
+
+    def note_unapplied(self) -> None:
+        """The caller could not apply the last non-hold decision (the
+        provisioner raised, or its floor/ceiling clamp made the apply a
+        no-op): restore the pre-decision stabilization stamp, so a
+        phantom "change" does not suppress the next real scale-down for
+        a full stabilization window."""
+        self._last_change = self._prev_change
 
     def _idle_long_enough(self, now: float) -> bool:
         return (

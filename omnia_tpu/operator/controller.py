@@ -17,10 +17,10 @@ import threading
 import time
 from typing import Optional
 
-from omnia_tpu.operator.autoscaling import Autoscaler, AutoscalingPolicy
 from omnia_tpu.operator.deployment import AgentDeployment, InProcessPodBackend
 from omnia_tpu.operator.resources import EE_KINDS, Resource, ResourceKind, resolve_ref
 from omnia_tpu.operator.rollout import RolloutEngine
+from omnia_tpu.operator.scaling_controller import _AutoscaleMixin
 from omnia_tpu.operator.sources_controller import _SourceReconcilersMixin
 from omnia_tpu.operator.store import ResourceStore
 
@@ -45,7 +45,7 @@ def warmup_progress_message(warmup: dict) -> str:
     return ", ".join(parts)
 
 
-class ControllerManager(_SourceReconcilersMixin):
+class ControllerManager(_AutoscaleMixin, _SourceReconcilersMixin):
     def __init__(
         self,
         store: ResourceStore,
@@ -70,7 +70,12 @@ class ControllerManager(_SourceReconcilersMixin):
         self.analysis = AnalysisRunner(store, session_api_url=session_api_url)
         self.rollouts = RolloutEngine(self.backend, analyzer=self.analysis.analyze)
         self.deployments: dict[str, AgentDeployment] = {}
-        self._autoscalers: dict[str, Autoscaler] = {}
+        # Per-deployment FleetScaler (engine/fleet.py, imported lazily —
+        # the fleet module imports this package's autoscaling policy):
+        # the SAME queue-depth control loop the in-process coordinator
+        # fleets run, applied here through the pod backend's
+        # current()/scale_to() provisioner callback.
+        self._autoscalers: dict[str, object] = {}
         # EE plane: license gates reconciliation of enterprise kinds
         # (reference ee/pkg/setup registration behind --enterprise +
         # license activation); the shared policy evaluator is rebuilt from
@@ -644,45 +649,6 @@ class ControllerManager(_SourceReconcilersMixin):
             )
         missing = sorted(set(dep.required_capabilities) - set(h.capabilities))
         return bool(missing), missing, None
-
-    def _autoscale(self, key: str, dep: AgentDeployment) -> None:
-        policy = AutoscalingPolicy.from_spec(
-            dep.resource.spec.get("autoscaling"),
-            fallback_replicas=dep.resource.spec.get("replicas", 1),
-        )
-        scaler = self._autoscalers.get(key)
-        if scaler is None or scaler.policy != policy:
-            scaler = Autoscaler(policy)
-            self._autoscalers[key] = scaler
-        depth, conns = self._load_signals(dep)
-        want = scaler.desired_replicas(len(dep.pods), depth, conns)
-        if want != len(dep.pods):
-            logger.info(
-                "autoscale %s: %d -> %d (queue=%s conns=%s)",
-                dep.name, len(dep.pods), want, depth, conns,
-            )
-            self.backend.scale(dep, want, wait_ready=self.wait_ready)
-
-    def _load_signals(self, dep: AgentDeployment) -> tuple[float, int]:
-        from omnia_tpu.runtime.client import RuntimeClient
-
-        depth = 0.0
-        conns = 0
-        for pod in dep.pods + dep.candidate_pods:
-            try:
-                client = RuntimeClient(f"localhost:{pod.runtime_port}")
-                try:
-                    h = client.health()
-                    depth += h.queue_depth
-                finally:
-                    client.close()
-            except Exception:
-                pass  # scrape is advisory; autoscaler tolerates gaps
-            try:
-                conns += int(pod.facade.metrics.gauge("connections_active").value())
-            except Exception:
-                pass  # in-process pod without facade metrics
-        return depth, conns
 
     def _write_blocked(self, res: Resource, dep, msg: str) -> None:
         self._write_status(

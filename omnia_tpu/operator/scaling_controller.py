@@ -1,0 +1,87 @@
+"""Queue-depth autoscaling seam of the controller.
+
+The pod-backend half of the elastic-fleet story: the resync loop samples
+each deployment's runtime /healthz (queue depth PLUS the prompt-token
+prefill backlog) and drives the SAME `FleetScaler` control loop the
+in-process coordinator fleets run (engine/fleet.py), applying scale
+decisions through `backend.scale`. Split from controller.py so the
+scaling seam reads as one unit; mixed into :class:`ControllerManager`.
+"""
+
+from __future__ import annotations
+
+import logging
+
+from omnia_tpu.operator.autoscaling import AutoscalingPolicy
+from omnia_tpu.operator.deployment import AgentDeployment
+
+logger = logging.getLogger(__name__)
+
+
+class _AutoscaleMixin:
+    """Autoscaling methods of :class:`ControllerManager` (uses its pod
+    backend, deployments map, and per-deployment scaler registry)."""
+
+    def _apply_scale(self, dep: AgentDeployment, want: int) -> int:
+        """The pod-backend half of the FleetScaler provisioner seam."""
+        self.backend.scale(dep, want, wait_ready=self.wait_ready)
+        return len(dep.pods)
+
+    def _autoscale(self, key: str, dep: AgentDeployment) -> None:
+        # Lazy import: engine/fleet.py imports this package's
+        # autoscaling policy, so a module-top import here would be
+        # circular through omnia_tpu.operator.__init__.
+        from omnia_tpu.engine.fleet import FleetScaler
+
+        policy = AutoscalingPolicy.from_spec(
+            dep.resource.spec.get("autoscaling"),
+            fallback_replicas=dep.resource.spec.get("replicas", 1),
+        )
+        scaler = self._autoscalers.get(key)
+        if scaler is None or scaler.policy != policy:
+            scaler = FleetScaler(
+                policy, provisioner=lambda want: self._apply_scale(dep, want),
+            )
+            self._autoscalers[key] = scaler
+        # The resync loop samples its own pods (the deployment record is
+        # resync-local state) and supplies current + the sample to the
+        # shared control loop; the bare-callable provisioner applies
+        # through backend.scale.
+        scaler.provisioner = lambda want: self._apply_scale(dep, want)
+        depth, conns = self._load_signals(dep)
+        ev = scaler.tick(current=len(dep.pods), depth=depth, conns=conns)
+        if ev is not None:
+            logger.info(
+                "autoscale %s: %d -> %d (queue=%.2f conns=%s)",
+                dep.name, ev.from_workers, ev.to_workers, depth, conns,
+            )
+
+    def _load_signals(self, dep: AgentDeployment) -> tuple[float, int]:
+        from omnia_tpu.engine.fleet import PENDING_TOKENS_NORM
+        from omnia_tpu.runtime.client import RuntimeClient
+
+        depth = 0.0
+        conns = 0
+        for pod in dep.pods + dep.candidate_pods:
+            try:
+                client = RuntimeClient(f"localhost:{pod.runtime_port}")
+                try:
+                    h = client.health()
+                    # Queue depth PLUS the prompt-token prefill backlog
+                    # in request-equivalents — the SURVEY §5.8 trigger:
+                    # four queued 8k-token prompts scale like real work,
+                    # not like four idle connections.
+                    depth += h.queue_depth
+                    depth += (
+                        getattr(h, "pending_prefill_tokens", 0)
+                        / PENDING_TOKENS_NORM
+                    )
+                finally:
+                    client.close()
+            except Exception:
+                pass  # scrape is advisory; autoscaler tolerates gaps
+            try:
+                conns += int(pod.facade.metrics.gauge("connections_active").value())
+            except Exception:
+                pass  # in-process pod without facade metrics
+        return depth, conns

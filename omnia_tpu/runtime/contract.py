@@ -199,6 +199,12 @@ class HealthResponse:
     model: str = ""
     queue_depth: int = 0
     active_slots: int = 0
+    # Prompt-token prefill backlog (queued prompts + unconsumed
+    # in-flight prefill tails) — the SURVEY §5.8 autoscaling trigger,
+    # carried beside queue_depth so the operator scales on inference
+    # backlog, not connection count. 0 on engines predating the signal
+    # (wire-compatible both ways via _known_fields).
+    pending_prefill_tokens: int = 0
     # Function-mode metadata ({name, description, input_schema} per entry)
     # so HTTP facades (REST, MCP tools/list) can enumerate callable
     # functions without a pack copy of their own.

@@ -456,6 +456,7 @@ class RuntimeServer:
             )
         engine = self.engine
         status = "ok" if getattr(engine, "healthy", lambda: True)() else "unhealthy"
+        pending_fn = getattr(engine, "pending_prefill_tokens", None)
         return c.HealthResponse(
             status=status,
             contract_version=c.CONTRACT_VERSION,
@@ -463,6 +464,11 @@ class RuntimeServer:
             model=self.spec.model,
             queue_depth=engine.queue_depth(),
             active_slots=engine.active_slots(),
+            # Engines predating the backlog signal report 0 (the same
+            # duck-type contract the coordinator's load signal uses).
+            pending_prefill_tokens=(
+                pending_fn() if pending_fn is not None else 0
+            ),
             functions=self._function_meta(),
         )
 

@@ -1,0 +1,862 @@
+"""Elastic-fleet suite (ISSUE 15): runtime membership, the queue-depth
+FleetScaler control loop, live cross-worker session migration, and the
+migration chaos battery.
+
+Module top is jax-free by design: the scaler, the mock fleet, and the
+whole migration battery run under the CI analysis job's poisoned jax
+stub (``pytest -m fleet --noconftest``); the engine-backed
+export/import equivalence cases importorskip jax.
+"""
+
+from __future__ import annotations
+
+import queue as queue_mod
+import threading
+import time
+
+import pytest
+
+from omnia_tpu.engine.coordinator import EngineCoordinator, _RelayHandle
+from omnia_tpu.engine.faults import FaultPlan
+from omnia_tpu.engine.fleet import FleetScaler, MockFleetProvisioner
+from omnia_tpu.engine.mock import MockEngine, Scenario
+from omnia_tpu.engine.tokenizer import ByteTokenizer
+from omnia_tpu.engine.types import FinishReason, SamplingParams
+from omnia_tpu.operator.autoscaling import Autoscaler, AutoscalingPolicy
+
+pytestmark = pytest.mark.fleet
+
+TOK = ByteTokenizer()
+SP = SamplingParams(max_tokens=64)
+REPLY = "fleet reply"
+
+
+def _mock(name="w0", **kw):
+    return MockEngine([Scenario(".", REPLY)], name=name, **kw)
+
+
+def _coord(*workers, **kw):
+    return EngineCoordinator(list(workers), **kw)
+
+
+def _collect(handle, timeout=10.0):
+    """Tokens + the exactly-one terminal event of a handle."""
+    tokens, final = [], None
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        try:
+            ev = handle._queue.get(timeout=0.1)
+        except queue_mod.Empty:
+            if final is not None:
+                break
+            continue
+        if ev.token_id is not None:
+            tokens.append(ev.token_id)
+        if ev.is_final:
+            final = ev
+            deadline = min(deadline, time.monotonic() + 0.2)
+    assert final is not None, "no terminal event"
+    return tokens, final
+
+
+def _turn(coord, sid, text="hi"):
+    """One completed sessionful turn through the coordinator: the
+    playback registers the session in the worker's migration registry
+    and the routing pins the coordinator affinity."""
+    tokens, fin = _collect(coord.submit(TOK.encode(text), SP, session_id=sid))
+    assert fin.finish_reason == FinishReason.STOP
+    assert TOK.decode(tokens) == REPLY
+    return tokens
+
+
+# ---------------------------------------------------------------------------
+# Satellite: deterministic Autoscaler clock (flap suppression, idle window)
+# ---------------------------------------------------------------------------
+
+
+class TestAutoscalerClock:
+    """The injectable clock makes every boundary exact — no sleeps."""
+
+    POLICY = AutoscalingPolicy(
+        min_replicas=0, max_replicas=4, target_queue_depth=8.0,
+        scale_to_zero_after_idle_s=10.0, stabilization_s=30.0,
+    )
+
+    def _scaler(self, t0=100.0):
+        t = [t0]
+        return Autoscaler(self.POLICY, clock=lambda: t[0]), t
+
+    def test_scale_down_held_inside_stabilization_window(self):
+        a, t = self._scaler()
+        # Load spike: 32 queued / target 8 => 4 replicas (a change at
+        # t=100 arms the stabilization window).
+        assert a.desired_replicas(1, 32.0, 4) == 4
+        # Load gone (but connections keep it busy): a scale-down to 1
+        # is wanted, and must be suppressed until t=130 exactly.
+        t[0] = 129.999
+        assert a.desired_replicas(4, 1.0, 1) == 4
+        t[0] = 130.0
+        assert a.desired_replicas(4, 1.0, 1) == 1
+
+    def test_flap_suppression_rearms_after_each_change(self):
+        a, t = self._scaler()
+        assert a.desired_replicas(1, 32.0, 4) == 4          # change @100
+        t[0] = 130.0
+        assert a.desired_replicas(4, 8.0, 1) == 1           # change @130
+        # An immediate dip below the new level is suppressed again.
+        t[0] = 131.0
+        assert a.desired_replicas(2, 1.0, 1) == 2
+        t[0] = 160.0
+        assert a.desired_replicas(2, 1.0, 1) == 1
+
+    def test_scale_to_zero_only_after_sustained_idle(self):
+        a, t = self._scaler()
+        # Idle since construction at t=100: the window ends at t=110.
+        t[0] = 109.999
+        assert a.desired_replicas(1, 0.0, 0) == 1
+        t[0] = 110.0
+        assert a.desired_replicas(1, 0.0, 0) == 0
+
+    def test_busy_sample_resets_the_idle_window(self):
+        a, t = self._scaler()
+        t[0] = 105.0
+        assert a.desired_replicas(1, 0.0, 1) == 1   # busy: window re-arms
+        t[0] = 114.999
+        assert a.desired_replicas(1, 0.0, 0) == 1
+        t[0] = 115.0
+        assert a.desired_replicas(1, 0.0, 0) == 0
+
+    def test_scale_up_is_never_suppressed(self):
+        a, t = self._scaler()
+        assert a.desired_replicas(1, 32.0, 4) == 4
+        t[0] = 100.5  # deep inside the stabilization window
+        assert a.desired_replicas(2, 32.0, 4) == 4
+
+
+# ---------------------------------------------------------------------------
+# Runtime fleet membership
+# ---------------------------------------------------------------------------
+
+
+class TestFleetMembership:
+    def test_add_worker_joins_routing_and_books(self):
+        w0 = _mock("w0")
+        coord = _coord(w0)
+        assert coord.live_workers() == 1
+        idx = coord.add_worker(_mock("w1"))
+        assert idx == 1
+        assert coord.live_workers() == 2
+        assert coord._healthy_indices() == [0, 1]
+        snap = coord.metrics_snapshot()
+        assert snap["fleet_workers"] == 2
+        assert snap["scale_events"] == 1
+        # The joined worker serves traffic.
+        _turn(coord, None)
+
+    def test_remove_worker_books_and_tombstones(self):
+        coord = _coord(_mock("w0"), _mock("w1"))
+        summary = coord.remove_worker(1, migrate=True)
+        assert summary["worker"] == 1
+        assert summary["drain_s"] >= 0.0
+        assert coord.live_workers() == 1
+        assert coord._healthy_indices() == [0]
+        snap = coord.metrics_snapshot()
+        assert snap["fleet_workers"] == 1
+        assert snap["scale_events"] == 1
+        # Tombstone, not compaction: the worker list keeps its index.
+        assert len(coord.workers) == 2
+
+    def test_retired_worker_never_reinstates(self):
+        coord = _coord(_mock("w0"), _mock("w1"))
+        coord.remove_worker(1)
+        # Even a direct healthy probe result cannot reinstate it.
+        coord._note_probe(1, True)
+        assert coord._healthy_indices() == [0]
+        assert coord.live_workers() == 1
+
+    def test_cannot_remove_the_last_live_worker(self):
+        coord = _coord(_mock("w0"), _mock("w1"))
+        coord.remove_worker(0)
+        with pytest.raises(ValueError, match="last live worker"):
+            coord.remove_worker(1)
+
+    def test_remove_unknown_or_retired_index_raises(self):
+        coord = _coord(_mock("w0"), _mock("w1"))
+        with pytest.raises(ValueError):
+            coord.remove_worker(7)
+        coord.remove_worker(1)
+        with pytest.raises(ValueError):
+            coord.remove_worker(1)
+
+    def test_retire_candidate_prefers_fewest_pins(self):
+        w0, w1, w2 = _mock("w0"), _mock("w1"), _mock("w2")
+        coord = _coord(w0, w1, w2)
+        # Two sessions pinned on one worker, none on the others.
+        with coord._lock:
+            coord._affinity["a"] = 0
+            coord._affinity["b"] = 0
+        # Fewest pins, newest index tie-break: w2.
+        assert coord._retire_candidate() == 2
+
+    def test_remove_without_migrate_drops_pins_counted(self):
+        coord = _coord(_mock("w0"), _mock("w1"))
+        sid = "drop-me"
+        _turn(coord, sid)
+        idx = coord.worker_for(sid)
+        summary = coord.remove_worker(idx, migrate=False)
+        assert summary["dropped_pins"] == 1
+        assert coord.worker_for(sid) is None
+        snap = coord.metrics_snapshot()
+        assert snap["sessions_migrated"] == 0
+        assert snap["migration_fallbacks"] == 0
+
+
+# ---------------------------------------------------------------------------
+# Live session migration (mock fleet)
+# ---------------------------------------------------------------------------
+
+
+class TestLiveMigration:
+    def test_scale_down_migrates_pinned_session(self):
+        w0, w1 = _mock("w0"), _mock("w1")
+        coord = _coord(w0, w1)
+        sid = "conv-1"
+        _turn(coord, sid)
+        src = coord.worker_for(sid)
+        assert src is not None
+        summary = coord.remove_worker(src, migrate=True)
+        assert summary["migrated"] == 1
+        assert summary["fallbacks"] == 0
+        dest = coord.worker_for(sid)
+        assert dest is not None and dest != src
+        survivor = coord.workers[dest]
+        assert survivor.metrics["session_imports"] == 1
+        assert coord.workers[src].metrics["session_exports"] == 1
+        assert coord.metrics_snapshot()["sessions_migrated"] == 1
+        # The conversation continues at the survivor.
+        _turn(coord, sid, text="again")
+        assert coord.worker_for(sid) == dest
+
+    def test_migration_flight_events_recorded(self):
+        coord = _coord(_mock("w0"), _mock("w1"), flight_events=64)
+        sid = "conv-f"
+        _turn(coord, sid)
+        coord.remove_worker(coord.worker_for(sid), migrate=True)
+        migrates = coord._flight.events("migrate")
+        assert len(migrates) == 1
+        ev = migrates[0]
+        assert ev.attrs["session_id"] == sid
+        assert ev.attrs["fallback"] is False
+        assert ev.attrs["dest"] == coord.worker_for(sid)
+        drains = coord._flight.events("drain")
+        assert len(drains) == 1
+        assert drains[0].attrs["seconds"] >= 0.0
+
+    def test_sessionless_worker_retires_clean(self):
+        coord = _coord(_mock("w0"), _mock("w1"))
+        _turn(coord, None)  # no session — nothing pinned
+        summary = coord.remove_worker(1, migrate=True)
+        assert summary["migrated"] == 0 == summary["fallbacks"]
+
+    def test_imported_paged_session_books_real_pages(self):
+        """The survivor's page mirror holds real pages for the import,
+        and releasing the session returns them."""
+        w0 = _mock("w0")
+        w1 = _mock("w1", kv_pages=32, kv_page_tokens=8)
+        coord = _coord(w0, w1)
+        sid = "paged-conv"
+        _turn(coord, sid)
+        src = coord.worker_for(sid)
+        if src != 0:  # force the migration direction onto the paged w1
+            pytest.skip("session landed on the paged worker")
+        free_before = w1.metrics["kv_pages_free"]
+        coord.remove_worker(0, migrate=True)
+        assert coord.worker_for(sid) == 1
+        assert w1.metrics["kv_pages_free"] < free_before
+        w1.release_session(sid)
+        assert w1.metrics["kv_pages_free"] == free_before
+
+
+# ---------------------------------------------------------------------------
+# Satellite: migration chaos battery
+# ---------------------------------------------------------------------------
+
+
+class TestMigrationChaos:
+    def test_worker_dies_mid_export_falls_back_counted(self):
+        plan = FaultPlan(export_faults=1)
+        w0 = _mock("w0", fault_plan=plan)
+        w1 = _mock("w1")
+        coord = _coord(w0, w1)
+        sid = "doomed-export"
+        _turn(coord, sid)
+        src = coord.worker_for(sid)
+        summary = coord.remove_worker(src, migrate=True)
+        assert plan.fired["export_faults"] == 1
+        assert summary["migrated"] == 0
+        assert summary["fallbacks"] == 1
+        snap = coord.metrics_snapshot()
+        assert snap["migration_fallbacks"] == 1
+        assert snap["sessions_migrated"] == 0
+        # The conversation is NOT dropped: the pin is gone, and the next
+        # turn fresh-prefills on a survivor and re-pins there.
+        assert coord.worker_for(sid) is None
+        _turn(coord, sid, text="recover")
+        assert coord.worker_for(sid) is not None
+        assert coord.worker_for(sid) != src
+
+    def test_import_rejected_by_full_pool_falls_back(self):
+        """PoolExhausted at the survivor books a counted fresh-prefill
+        fallback: a tiny page mirror cannot hold the migrated rows."""
+        w0 = _mock("w0")
+        # 2 pages × 4 tokens: any real session exceeds the pool.
+        w1 = _mock("w1", kv_pages=2, kv_page_tokens=4)
+        coord = _coord(w0, w1)
+        sid = "too-big"
+        _turn(coord, sid, text="x" * 40)
+        src = coord.worker_for(sid)
+        if src != 0:
+            pytest.skip("session landed on the paged worker")
+        summary = coord.remove_worker(0, migrate=True)
+        assert summary["fallbacks"] == 1
+        assert summary["migrated"] == 0
+        assert coord.metrics_snapshot()["migration_fallbacks"] == 1
+        assert w1.metrics["session_imports"] == 0
+        # Recovery seed intact: the next turn rebuilds at the survivor.
+        _turn(coord, sid, text="fresh")
+        assert coord.worker_for(sid) == 1
+
+    def test_submit_racing_retirement_relays_to_survivor(self):
+        """The scale-down race: a submit bound to a worker the instant
+        retirement lands sheds OVERLOADED there — the relay re-places
+        it on a survivor, exactly like a zero-token worker death."""
+        w0, w1 = _mock("w0"), _mock("w1")
+        coord = _coord(w0, w1)
+        # The retirement moment, hit mid-submit: admission closed and
+        # the health entry tombstoned AFTER the router picked w0.
+        with coord._health_lock:
+            coord._health[0].retired = True
+            coord._health[0].up = False
+        w0.stop(drain=True)
+        toks = TOK.encode("raced")
+        inner = w0.submit(toks, SP)  # the racing submit: sheds OVERLOADED
+        relay = _RelayHandle(coord, toks, SP, None, None, None)
+        coord._count("routed")
+        relay._begin(0, inner)
+        tokens, fin = _collect(relay)
+        assert fin.finish_reason == FinishReason.STOP
+        assert TOK.decode(tokens) == REPLY
+        # Its own book: a retirement relay is not a worker death, so
+        # the chaos ledger's deaths == resubmits identity stays exact.
+        snap = coord.metrics_snapshot()
+        assert snap["retirement_relays"] == 1
+        assert snap["resubmits"] == 0
+        assert w1.metrics["requests_finished"] == 1
+
+    def test_overloaded_from_live_worker_is_real_backpressure(self):
+        """An OVERLOADED from a NON-retiring worker must surface — a
+        retry would slam an already-saturated fleet."""
+        w0, w1 = _mock("w0"), _mock("w1")
+        coord = _coord(w0, w1)
+        w0.stop(drain=True)  # draining but NOT retired
+        toks = TOK.encode("backpressure")
+        inner = w0.submit(toks, SP)
+        relay = _RelayHandle(coord, toks, SP, None, None, None)
+        relay._begin(0, inner)
+        tokens, fin = _collect(relay)
+        assert fin.finish_reason == FinishReason.OVERLOADED
+        assert tokens == []
+        snap = coord.metrics_snapshot()
+        assert snap["resubmits"] == 0 and snap["retirement_relays"] == 0
+
+    def test_exact_ledger_across_mixed_outcomes(self):
+        """Chaos battery reconciliation: sessions pinned to the retiring
+        worker land in exactly one bucket — migrated + fallbacks ==
+        pinned — and the fleet ledger agrees with the summary."""
+        plan = FaultPlan(export_faults=1)
+        w0 = _mock("w0", fault_plan=plan)
+        w1 = _mock("w1")
+        coord = _coord(w0, w1)
+        sids = [f"conv-{i}" for i in range(4)]
+        for sid in sids:
+            _turn(coord, sid)
+        pinned0 = [s for s in sids if coord.worker_for(s) == 0]
+        if not pinned0:
+            pytest.skip("no sessions pinned to the faulted worker")
+        summary = coord.remove_worker(0, migrate=True)
+        assert (
+            summary["migrated"] + summary["fallbacks"] + summary["repinned"]
+            == len(pinned0)
+        )
+        assert summary["fallbacks"] == plan.fired["export_faults"] == 1
+        snap = coord.metrics_snapshot()
+        assert snap["sessions_migrated"] == summary["migrated"]
+        assert snap["migration_fallbacks"] == summary["fallbacks"]
+        # Every conversation survives: each sid either kept a live pin
+        # or recovers through a fresh-prefill next turn.
+        for sid in sids:
+            _turn(coord, sid, text="post-chaos")
+            assert coord.worker_for(sid) in (1,)
+
+
+# ---------------------------------------------------------------------------
+# Satellite: per-worker drain attribution in the overlapped-drain path
+# ---------------------------------------------------------------------------
+
+
+class TestDrainAttribution:
+    def test_overlapped_stop_records_per_worker_drain(self):
+        slow = MockEngine(
+            [Scenario(".", REPLY, delay_per_token_s=0.01)], name="slow",
+        )
+        fast = _mock("fast")
+        coord = _coord(slow, fast, flight_events=64)
+        h = coord.submit(TOK.encode("hold the drain"), SP)
+        coord.stop(drain=True)
+        _collect(h)
+        drains = coord._flight.events("drain")
+        assert sorted(e.attrs["worker"] for e in drains) == [0, 1]
+        by_worker = {e.attrs["worker"]: e.attrs["seconds"] for e in drains}
+        # The slow-drain worker is attributable: it ate the window.
+        assert by_worker[0] >= by_worker[1]
+
+    def test_stop_skips_retired_workers(self):
+        coord = _coord(_mock("w0"), _mock("w1"), flight_events=64)
+        coord.remove_worker(1)
+        coord.stop(drain=True)
+        # remove_worker drained w1 already; stop(drain) drains only w0 —
+        # one drain event from retirement, one from the fleet stop.
+        workers = [e.attrs["worker"] for e in coord._flight.events("drain")]
+        assert workers == [1, 0]
+
+
+# ---------------------------------------------------------------------------
+# The FleetScaler control loop
+# ---------------------------------------------------------------------------
+
+
+class _FakeProvisioner:
+    def __init__(self, n=1, fail=False):
+        self.n = n
+        self.fail = fail
+        self.calls = []
+
+    def current(self):
+        return self.n
+
+    def scale_to(self, want):
+        if self.fail:
+            raise RuntimeError("provisioner down")
+        self.calls.append(want)
+        self.n = want
+        return self.n
+
+
+class TestFleetScaler:
+    POLICY = AutoscalingPolicy(
+        min_replicas=1, max_replicas=4, target_queue_depth=2.0,
+        stabilization_s=0.0,
+    )
+
+    def _scaler(self, prov, **kw):
+        t = [100.0]
+        kw.setdefault("clock", lambda: t[0])
+        return FleetScaler(self.POLICY, prov, **kw), t
+
+    def test_tick_holds_when_policy_holds(self):
+        prov = _FakeProvisioner(n=1)
+        scaler, _ = self._scaler(prov)
+        assert scaler.tick(now=100.0, depth=1.0, conns=1) is None
+        assert prov.calls == []
+        assert scaler.stats()["ticks"] == 1
+
+    def test_tick_applies_scale_up_and_books_event(self):
+        prov = _FakeProvisioner(n=1)
+        scaler, _ = self._scaler(prov)
+        ev = scaler.tick(now=101.0, depth=8.0, conns=3)
+        assert ev is not None and ev.kind == "up"
+        assert (ev.from_workers, ev.to_workers) == (1, 4)
+        assert ev.queue_signal == 8.0
+        assert prov.calls == [4]
+        stats = scaler.stats()
+        assert stats["ups"] == 1 and stats["downs"] == 0
+        d = ev.to_dict()
+        assert d["kind"] == "up" and d["at_s"] == 101.0
+
+    def test_scale_error_is_counted_not_raised(self):
+        prov = _FakeProvisioner(n=1, fail=True)
+        scaler, _ = self._scaler(prov)
+        assert scaler.tick(now=101.0, depth=8.0, conns=3) is None
+        assert scaler.stats()["scale_errors"] == 1
+        assert scaler.events() == []
+
+    def test_failed_apply_does_not_arm_stabilization(self):
+        """A provisioner error is not a replica change: the very next
+        tick may retry the scale-down instead of sitting out a full
+        stabilization window behind a phantom change stamp."""
+        policy = AutoscalingPolicy(
+            min_replicas=1, max_replicas=4, target_queue_depth=2.0,
+            stabilization_s=30.0,
+        )
+        calls = []
+
+        def flaky(want):
+            calls.append(want)
+            if len(calls) == 1:
+                raise RuntimeError("backend down")
+            return want
+
+        scaler = FleetScaler(policy, flaky, clock=lambda: 100.0)
+        assert scaler.tick(now=100.0, current=3, depth=2.0, conns=1) is None
+        assert scaler.stats()["scale_errors"] == 1
+        # Retry one tick later, well inside the 30 s window: it applies.
+        ev = scaler.tick(now=101.0, current=3, depth=2.0, conns=1)
+        assert ev is not None and ev.kind == "down"
+        assert calls == [1, 1]
+
+    def test_clamped_noop_books_no_event_and_no_stamp(self):
+        """The provisioner floor turning a decision into a no-op books
+        neither a phantom ScaleEvent nor a stabilization stamp — and a
+        later REAL scale-down is not gated by the phantom."""
+        policy = AutoscalingPolicy(
+            min_replicas=0, max_replicas=4, target_queue_depth=2.0,
+            stabilization_s=30.0, scale_to_zero_after_idle_s=0.0,
+        )
+        scaler = FleetScaler(policy, lambda want: max(1, want),
+                             clock=lambda: 100.0)
+        # Idle at the 1-worker floor: want=0, the clamp makes it a no-op.
+        assert scaler.tick(now=100.0, current=1, depth=0.0, conns=0) is None
+        assert scaler.events() == [] and scaler.stats()["downs"] == 0
+        # A real 2→1 decision one tick later, well inside the 30 s
+        # window, still applies: the no-op left no phantom stamp.
+        ev = scaler.tick(now=101.0, current=2, depth=0.0, conns=0)
+        assert ev is not None and ev.kind == "down"
+        assert (ev.from_workers, ev.to_workers) == (2, 1)
+
+    def test_stats_totals_survive_event_ring_eviction(self):
+        """stats() reports lifetime totals, not the bounded events()
+        window: a long-lived fleet that scales past max_events must not
+        read the retained tail as its history."""
+        flip = []
+
+        def apply(want):
+            flip.append(want)
+            return want
+
+        scaler = FleetScaler(self.POLICY, apply, clock=lambda: 100.0,
+                             max_events=4)
+        current, t = 1, 100.0
+        for i in range(10):  # 10 alternating real changes, ring holds 4
+            t += 1.0
+            # depth 8 → ceil(8/2)=4 workers; depth 0.5 → ceil=1 worker.
+            depth = 8.0 if current == 1 else 0.5
+            ev = scaler.tick(now=t, current=current, depth=depth, conns=0)
+            assert ev is not None
+            current = ev.to_workers
+        stats = scaler.stats()
+        assert len(scaler.events()) == 4
+        assert stats["scale_events"] == 10
+        assert stats["ups"] + stats["downs"] == 10
+
+    def test_bare_callable_provisioner(self):
+        applied = []
+
+        def apply(want):
+            applied.append(want)
+            return want
+
+        scaler, _ = self._scaler(apply)
+        ev = scaler.tick(now=101.0, current=1, depth=8.0, conns=3)
+        assert ev is not None and applied == [4]
+
+    def test_sample_folds_prefill_backlog_into_depth(self):
+        # A generous TTFT keeps the playback's prompt tokens booked as
+        # backlog while sample() runs — without it a loaded CI box can
+        # let the playback finish (and the books drain) first.
+        w0 = MockEngine([Scenario(".", REPLY, ttft_s=2.0)], name="w0")
+        coord = _coord(w0)
+        scaler = FleetScaler(
+            self.POLICY, _FakeProvisioner(), coordinator=coord,
+            pending_norm=64.0,
+        )
+        depth, conns = scaler.sample()
+        assert depth == 0.0 and conns == 0
+        # A live playback's prompt tokens are backlog in
+        # request-equivalents (the SURVEY §5.8 signal).
+        prompt = TOK.encode("x" * 127)
+        h = w0.submit(prompt, SamplingParams(max_tokens=1))
+        try:
+            depth, _ = scaler.sample()
+            assert depth == pytest.approx(len(prompt) / 64.0)
+        finally:
+            _collect(h)
+
+    def test_signals_override_wins(self):
+        scaler, _ = self._scaler(
+            _FakeProvisioner(), signals=lambda: (6.0, 2),
+        )
+        assert scaler.sample() == (6.0, 2)
+
+
+class TestMockFleetProvisioner:
+    def _factory(self):
+        def factory(i):
+            return _mock(f"w{i}")
+        return factory
+
+    def test_scale_up_then_down_with_migration(self):
+        coord = _coord(_mock("w0"))
+        prov = MockFleetProvisioner(coord, self._factory(), max_workers=3)
+        assert prov.current() == 1
+        assert prov.scale_to(3) == 3
+        assert coord.live_workers() == 3
+        # One resident session on EVERY worker (retirement prefers
+        # unpinned workers, so only this shape forces migration): the
+        # shrink to 1 must carry two conversations, dropping none.
+        for i, w in enumerate(coord.workers):
+            _collect(w.submit(TOK.encode("hi"), SP, session_id=f"c{i}"))
+            with coord._lock:
+                coord._affinity[f"c{i}"] = i
+        assert prov.scale_to(1) == 1
+        assert coord.live_workers() == 1
+        snap = coord.metrics_snapshot()
+        assert snap["sessions_migrated"] + snap["migration_fallbacks"] == 2
+        assert sum(s["dropped_pins"] for s in prov.disposed) == 0
+        # Every conversation continues on the last live worker.
+        for i in range(3):
+            _turn(coord, f"c{i}", text="still here")
+
+    def test_floor_is_one_live_worker(self):
+        coord = _coord(_mock("w0"))
+        prov = MockFleetProvisioner(coord, self._factory())
+        assert prov.scale_to(0) == 1
+        assert coord.live_workers() == 1
+
+    def test_max_workers_clamped(self):
+        coord = _coord(_mock("w0"))
+        prov = MockFleetProvisioner(coord, self._factory(), max_workers=2)
+        assert prov.scale_to(9) == 2
+
+
+class TestScalerEndToEnd:
+    def test_backlog_scales_up_idle_scales_down_no_drops(self):
+        """The whole loop, deterministically clocked: ramp backlog in →
+        workers join; idle past the window → fleet shrinks to the floor
+        with every session migrated; the event trace reads 1→N→1."""
+        t = [0.0]
+        policy = AutoscalingPolicy(
+            min_replicas=0, max_replicas=3, target_queue_depth=2.0,
+            scale_to_zero_after_idle_s=5.0, stabilization_s=1.0,
+        )
+        coord = _coord(_mock("w0"))
+        prov = MockFleetProvisioner(
+            coord, lambda i: _mock(f"w{i}"), max_workers=3,
+        )
+        scaler = FleetScaler(
+            policy, prov, coordinator=coord, clock=lambda: t[0],
+        )
+        # Ramp up: backlog of 6 request-equivalents → 3 workers.
+        t[0] = 10.0
+        ev = scaler.tick(now=10.0, depth=6.0, conns=2)
+        assert ev.kind == "up" and ev.to_workers == 3
+        assert coord.live_workers() == 3
+        # Sessions land across the (now larger) fleet.
+        sids = [f"vc-{i}" for i in range(5)]
+        for sid in sids:
+            _turn(coord, sid)
+        pinned = {sid: coord.worker_for(sid) for sid in sids}
+        assert all(w is not None for w in pinned.values())
+        # Ramp down: idle long enough → policy asks 0, floor clamps to 1.
+        t[0] = 20.0
+        ev = scaler.tick(now=20.0, depth=0.0, conns=0)
+        assert ev is not None and ev.kind == "down"
+        assert ev.to_workers == 1
+        assert coord.live_workers() == 1
+        # Zero dropped conversations, exact ledger.
+        snap = coord.metrics_snapshot()
+        moved = sum(1 for w in pinned.values() if coord._worker_retired(w))
+        assert snap["sessions_migrated"] + snap["migration_fallbacks"] == moved
+        assert ev.migrated + ev.fallbacks == moved
+        assert sum(s["dropped_pins"] for s in prov.disposed) == 0
+        for sid in sids:
+            _turn(coord, sid, text="after the shrink")
+        trace = [e.kind for e in scaler.events()]
+        assert trace == ["up", "down"]
+
+    def test_thread_loop_scales_on_live_backlog(self):
+        """The daemon loop (real clock): saturating playbacks push the
+        prefill backlog up; the loop adds workers without being told."""
+        slow = MockEngine(
+            [Scenario(".", REPLY, ttft_s=0.2)], name="w0",
+        )
+        coord = _coord(slow)
+        prov = MockFleetProvisioner(
+            coord, lambda i: _mock(f"w{i}"), max_workers=2,
+        )
+        policy = AutoscalingPolicy(
+            min_replicas=1, max_replicas=2, target_queue_depth=1.0,
+            stabilization_s=0.0,
+        )
+        scaler = FleetScaler(
+            policy, prov, coordinator=coord, interval_s=0.02,
+            pending_norm=8.0,
+        )
+        handles = [
+            slow.submit(TOK.encode("y" * 31), SamplingParams(max_tokens=1))
+            for _ in range(4)
+        ]
+        scaler.start()
+        try:
+            deadline = time.monotonic() + 5.0
+            while coord.live_workers() < 2 and time.monotonic() < deadline:
+                time.sleep(0.01)
+        finally:
+            scaler.stop()
+            for h in handles:
+                _collect(h)
+        assert coord.live_workers() == 2
+        assert scaler.stats()["ups"] >= 1
+
+
+# ---------------------------------------------------------------------------
+# The operator's pod-backend seam drives the SAME control loop
+# ---------------------------------------------------------------------------
+
+
+class TestOperatorPodPath:
+    def test_controller_autoscale_scales_pods_on_queue_depth(self):
+        """`ControllerManager._autoscale` runs a FleetScaler over the
+        pod backend's scale callback: queue depth (not connection
+        count) finally drives AgentDeployment replicas."""
+        from omnia_tpu.operator import (
+            AgentDeployment, ControllerManager, MemoryResourceStore, Resource,
+        )
+
+        class FakeBackend:
+            def __init__(self):
+                self.calls = []
+
+            def scale(self, dep, replicas, wait_ready=True):
+                self.calls.append(replicas)
+                while len(dep.pods) > replicas:
+                    dep.pods.pop()
+                while len(dep.pods) < replicas:
+                    dep.pods.append(object())
+
+        backend = FakeBackend()
+        cm = ControllerManager(MemoryResourceStore(), backend=backend)
+        res = Resource(kind="AgentRuntime", name="a", spec={
+            "autoscaling": {
+                "minReplicas": 1, "maxReplicas": 4,
+                "targetQueueDepth": 2.0, "stabilizationSeconds": 0,
+            },
+        })
+        dep = AgentDeployment(
+            resource=res, pack_doc={}, provider_specs=[],
+            default_provider="mock",
+        )
+        dep.pods.append(object())
+        # Backlog of 8 request-equivalents against a per-replica target
+        # of 2: the loop scales the pod set to 4.
+        cm._load_signals = lambda d: (8.0, 2)
+        cm._autoscale("a", dep)
+        assert backend.calls == [4]
+        assert len(dep.pods) == 4
+        # Backlog collapses: the same loop shrinks the pod set.
+        cm._load_signals = lambda d: (2.0, 1)
+        cm._autoscale("a", dep)
+        assert backend.calls == [4, 1]
+        assert len(dep.pods) == 1
+        # The scaler's event trace is readable for the deployment too.
+        assert [e.kind for e in cm._autoscalers["a"].events()] == [
+            "up", "down",
+        ]
+
+
+# ---------------------------------------------------------------------------
+# Engine-backed export/import (the real host-row payload; needs jax)
+# ---------------------------------------------------------------------------
+
+
+def _tiny_engine():
+    from omnia_tpu.engine import EngineConfig, InferenceEngine
+    from omnia_tpu.models import get_config
+
+    return InferenceEngine(
+        get_config("test-tiny"),
+        EngineConfig(
+            num_slots=2, max_seq=64, prefill_buckets=(8, 16),
+            dtype="float32", max_sessions=8,
+        ),
+        seed=0,
+    )
+
+
+def _engine_turn(eng, prompt, sid=None):
+    sp = SamplingParams(temperature=0.0, max_tokens=6)
+    handle = eng.submit(prompt, sp, session_id=sid)
+    toks = []
+    while True:
+        eng.step()
+        try:
+            while True:
+                ev = handle._queue.get_nowait()
+                if ev.token_id is not None:
+                    toks.append(ev.token_id)
+                if ev.is_final:
+                    return toks, ev
+        except queue_mod.Empty:
+            pass
+
+
+class TestEngineExportImport:
+    def test_round_trip_matches_fresh_engine(self):
+        """Gold equivalence: a migrated session's next turn produces
+        exactly the tokens a fresh engine produces for the full prompt —
+        and it RESTORES the imported rows instead of re-prefilling."""
+        pytest.importorskip("jax", exc_type=ImportError)
+        e1, e2 = _tiny_engine(), _tiny_engine()
+        p1 = [1, 2, 3, 4, 5, 6, 7, 8]
+        t1, _ = _engine_turn(e1, p1, sid="m")
+        payload = e1.export_session("m")
+        assert payload is not None
+        assert payload.token_ids[: len(p1)] == p1
+        assert payload.restore_rows > 0
+        assert e1.metrics["session_exports"] == 1
+        # Ownership transferred: the exporter forgot the session.
+        assert "m" not in e1._sessions
+        e2.import_session(payload)
+        assert e2.metrics["session_imports"] == 1
+        p2 = p1 + t1 + [20, 21, 22]
+        restores_before = e2.metrics["session_restores"]
+        t2, _ = _engine_turn(e2, p2, sid="m")
+        assert e2.metrics["session_restores"] > restores_before
+        fresh = _tiny_engine()
+        t2_fresh, _ = _engine_turn(fresh, p2)
+        assert t2 == t2_fresh
+
+    def test_incompatible_payload_rejected_loudly(self):
+        pytest.importorskip("jax", exc_type=ImportError)
+        e1, e2 = _tiny_engine(), _tiny_engine()
+        _engine_turn(e1, [1, 2, 3, 4, 5, 6, 7, 8], sid="m")
+        payload = e1.export_session("m")
+        bad = type(payload)(
+            session_id=payload.session_id, token_ids=payload.token_ids,
+            host_k=payload.host_k, host_v=payload.host_v,
+            kv_quant="int8", restore_rows=payload.restore_rows,
+        )
+        with pytest.raises(ValueError, match="kv_quant mismatch"):
+            e2.import_session(bad)
+
+    def test_live_engine_refuses_export(self):
+        """The registry is engine-thread-owned: a running loop answers
+        None (drain first) instead of racing its own step loop."""
+        pytest.importorskip("jax", exc_type=ImportError)
+        eng = _tiny_engine()
+        _engine_turn(eng, [1, 2, 3, 4, 5, 6, 7, 8], sid="m")
+        eng._thread = threading.Thread(target=lambda: None)  # simulate live loop
+        try:
+            assert eng.export_session("m") is None
+        finally:
+            eng._thread = None
+        assert eng.export_session("m") is not None

@@ -464,6 +464,7 @@ class TestMetricsKeyStability:
         "requests_submitted", "requests_finished", "tokens_generated",
         "prefill_steps", "decode_steps", "extend_steps", "prefill_tokens",
         "prefix_reuse_tokens", "session_offloads", "session_restores",
+        "session_exports", "session_imports",
         "decode_dispatch_s", "decode_sync_s", "prefill_dispatch_s",
         "spec_steps", "spec_proposed", "spec_accepted",
         "spec_gate_state", "spec_accept_ema", "spec_index_bytes",
@@ -492,11 +493,14 @@ class TestMetricsKeyStability:
         "kv_quant_rows_written", "kv_quant_roundtrip_rel_err",
     }
 
-    # EngineCoordinator's fleet-routing ledger.
+    # EngineCoordinator's fleet-routing ledger (+ the elastic-fleet
+    # membership/migration books engine/fleet.py drives).
     COORDINATOR = {
         "routed", "failovers", "affinity_evictions",
         "prefix_routed", "prefix_failovers", "prefix_spills",
-        "shed", "resubmits",
+        "shed", "resubmits", "retirement_relays",
+        "fleet_workers", "sessions_migrated", "migration_fallbacks",
+        "scale_events",
     }
 
     def test_engine_metric_keys_are_stable(self):
