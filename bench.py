@@ -540,6 +540,23 @@ def child_main() -> None:
             _log(f"fleet bench failed: {exc!r}")
             fleet = {"error": repr(exc)}
 
+    # --- disaggregated prefill/decode serving (engine/disagg.py) ------
+    # Equal-size pooled vs prefill/decode-tier mock fleets under the
+    # same two-class plan (long-prompt RAG + deadline short turns):
+    # per-class SLO attainment both arms, handoff ledger exact.
+    disagg = None
+    if remaining() > (60 if on_accel else 30):
+        try:
+            disagg = _bench_disagg(cfg, remaining, on_accel)
+            _log(
+                f"disagg bench done: handed_off={disagg.get('handed_off')}"
+                f" reconciled={disagg.get('reconciled')}"
+                f" ledger_exact={disagg.get('handoff_ledger_exact')}"
+            )
+        except Exception as exc:  # noqa: BLE001 - aux evidence only
+            _log(f"disagg bench failed: {exc!r}")
+            disagg = {"error": repr(exc)}
+
     # --- cold start decomposition + cache A/B (engine/coldstart.py) ---
     # Submit-to-ready per phase, cold-vs-warm persistent-cache restart,
     # and parallel-vs-serial warmup. Runs on accel and CPU (compile
@@ -608,6 +625,7 @@ def child_main() -> None:
                 "latency": latency,
                 "trafficsim": trafficsim,
                 "fleet": fleet,
+                "disagg": disagg,
                 "coldstart": coldstart,
                 # Chip-roofline ratios are meaningless against CPU
                 # timings — explicitly null, never quoted against an
@@ -722,6 +740,10 @@ def child_main() -> None:
         # Elastic fleet (ROADMAP item 2): queue-depth autoscaling +
         # live migration — 1→N→1 with zero dropped sessions.
         result["aux"]["fleet"] = fleet
+    if disagg is not None:
+        # Disaggregated serving (engine/disagg.py): pooled vs
+        # prefill/decode tiers at equal fleet size, handoff ledger exact.
+        result["aux"]["disagg"] = disagg
     if coldstart is not None:
         # Cold start (ROADMAP item 3): submit-to-ready decomposition +
         # cold-vs-warm cache A/B + parallel-vs-serial warmup.
@@ -1952,6 +1974,110 @@ def _bench_fleet(cfg, remaining, on_accel):
         "sessions_dropped": autoscaled.get("sessions_dropped", 0),
         "autoscaled_not_worse": auto_att >= static_att,
         "reconciled": autoscaled["ledger_ok"] and static["ledger_ok"],
+    }
+
+
+def _bench_disagg(cfg, remaining, on_accel):
+    """Disaggregated prefill/decode serving (engine/disagg.py →
+    aux.disagg): the SAME seeded two-class plan — a prefill-heavy
+    long-prompt RAG class (sessionful, decode-heavy later turns) and a
+    deadline-tight short interactive class — against two EQUAL-SIZE
+    mock fleets: four pooled workers vs two prefill + two decode
+    workers with the first-turn handoff live. Reports both classes'
+    SLO attainment per arm plus the exact ledgers: offered ==
+    terminals, and handoffs == handoff_fallbacks + sessions imported
+    with the flight handoff events reconciled against the coordinator
+    books. Host-side scheduling behavior — identical on accel and
+    CPU."""
+    from omnia_tpu.engine.coordinator import EngineCoordinator
+    from omnia_tpu.engine.mock import MockEngine, Scenario
+    from omnia_tpu.evals.trafficsim import (
+        ArrivalSpec, ScenarioClass, SLOTarget, TrafficPlan, TrafficSimulator,
+    )
+
+    plan = TrafficPlan(seed=0, duration_s=2.0, classes=(
+        ScenarioClass(
+            name="rag_long",
+            arrival=ArrivalSpec(profile="poisson", rate_rps=6.0),
+            prompt_tokens=(320, 480), max_tokens=32, turns=3,
+            slo=SLOTarget(ttft_ms=1500.0, min_attainment=0.5),
+        ),
+        ScenarioClass(
+            name="short_turn",
+            arrival=ArrivalSpec(profile="poisson", rate_rps=20.0),
+            prompt_tokens=(16, 32), max_tokens=16, deadline_s=2.0,
+            slo=SLOTarget(ttft_ms=400.0, min_attainment=0.5),
+        ),
+    ))
+
+    def scenarios():
+        # RAG pays a real prefill (large ttft_s) and decodes long; the
+        # interactive class is cheap on both sides. Bounded admission
+        # (max_queue) makes the contention real: in the pooled arm RAG
+        # prefills and short turns fight for the same four workers.
+        return [
+            Scenario("sim rag_long", reply="r" * 48, ttft_s=0.03,
+                     delay_per_token_s=0.004),
+            Scenario("sim short_turn", reply="s" * 16, ttft_s=0.003,
+                     delay_per_token_s=0.002),
+        ]
+
+    def worker(i, role):
+        return MockEngine(scenarios(), name=f"{role[0]}{i}",
+                          flight_events=4096, max_queue=4, role=role)
+
+    arm_budget = max(5.0, min(45.0, remaining() - 20.0))
+
+    def run_arm(disagg):
+        if disagg:
+            workers = [worker(0, "prefill"), worker(1, "prefill"),
+                       worker(2, "decode"), worker(3, "decode")]
+        else:
+            workers = [worker(i, "pooled") for i in range(4)]
+        coord = EngineCoordinator(workers, flight_events=4096)
+        sim = TrafficSimulator(coord, plan, concurrency=24)
+        rep = sim.run(timeout_s=arm_budget).report()
+        snap = coord.metrics_snapshot()
+        idents = {i["name"]: i["ok"] for i in rep["ledger"]["identities"]}
+        arm = {
+            "roles": [w.role for w in workers],
+            "slo_passed": rep["slo"]["passed"],
+            "ledger_ok": rep["ledger"]["ok"],
+            "handoffs": snap["handoffs"],
+            "handoff_fallbacks": snap["handoff_fallbacks"],
+            "handoff_ledger_exact": idents.get(
+                "handoffs == handoff_fallbacks + sessions imported", True,
+            ) and idents.get(
+                "handoff flight events == handoffs book", True,
+            ),
+            "classes": {
+                name: {
+                    "offered": cell["offered"],
+                    "attainment": cell["slo"]["attainment"],
+                    "ttft_p95_ms": cell["ttft_engine_ms"]["p95"],
+                    "handoffs": cell["handoffs"],
+                    "handoff_p95_s": cell["handoff_s"]["p95"],
+                }
+                for name, cell in rep["classes"].items() if "slo" in cell
+            },
+        }
+        coord.stop()
+        return arm
+
+    disagg = run_arm(True)
+    pooled = run_arm(False)
+    return {
+        "seed": plan.seed,
+        "duration_s": plan.duration_s,
+        "fleet_size": 4,
+        "disaggregated": disagg,
+        "pooled": pooled,
+        # The acceptance bars: the disaggregated arm actually handed
+        # first-turn sessions to the decode tier, both arms' exact
+        # ledgers close, and the handoff identity is exact.
+        "handed_off": disagg["handoffs"] > 0,
+        "reconciled": disagg["ledger_ok"] and pooled["ledger_ok"],
+        "handoff_ledger_exact": disagg["handoff_ledger_exact"],
     }
 
 

@@ -34,6 +34,11 @@ JAX_FREE_PACKAGES: tuple[str, ...] = (
     # jax-less controller processes, and the CI poisoned-jax subset
     # proves the whole control loop without a device stack.
     "omnia_tpu/engine/fleet.py",
+    # Role policy + handoff orchestration are host-side by contract:
+    # the DisaggRouter must run in jax-less controller processes and
+    # the CI poisoned-jax subset proves the routing/handoff plane
+    # without a device stack.
+    "omnia_tpu/engine/disagg.py",
 )
 
 

@@ -56,6 +56,7 @@ LOCK_GROUPS: tuple[tuple[str, tuple[str, ...]], ...] = (
         "omnia_tpu/engine/coordinator.py",
         "omnia_tpu/engine/membership.py",
         "omnia_tpu/engine/relay.py",
+        "omnia_tpu/engine/disagg.py",
     )),
     # The fleet scaler's control loop: the tick thread and callers of
     # events()/stats() share the event/tick books — worker-RPC samples
@@ -87,8 +88,9 @@ LOCK_GROUPS: tuple[tuple[str, tuple[str, ...]], ...] = (
 #: and sync vocabulary of this codebase, not a generic heuristic.
 BLOCKING_ATTRS = frozenset({
     "sleep", "join", "healthy", "queue_depth", "active_slots",
-    "pending_prefill_tokens", "submit", "release_session",
-    "collect_tokens", "get_event", "block_until_ready", "wait",
+    "pending_prefill_tokens", "decode_slots_active", "submit",
+    "release_session", "collect_tokens", "get_event",
+    "block_until_ready", "wait",
 })
 
 #: Module aliases whose ``.asarray`` forces a device→host sync.

@@ -63,6 +63,10 @@ COORDINATOR_FILES = (
     "omnia_tpu/engine/coordinator.py",
     "omnia_tpu/engine/membership.py",
     "omnia_tpu/engine/relay.py",
+    # Disaggregated-serving split: handoff books through its coord
+    # argument today, but any direct ``self.metrics`` write it ever
+    # grows must be registered.
+    "omnia_tpu/engine/disagg.py",
 )
 #: Traffic-simulator files: the simulator reports through its own JSON
 #: report schema, not `self.metrics` — any `self.metrics` write that

@@ -51,6 +51,9 @@ PURITY_FILES_PREFIXES: tuple[str, ...] = (
     # The fleet scaler is host-side by contract (scale decisions are
     # stats arithmetic); a traced body here would be the same bug class.
     "omnia_tpu/engine/fleet.py",
+    # Role routing and the handoff plane are stats arithmetic + worker
+    # RPCs; a traced body here would be the same bug class.
+    "omnia_tpu/engine/disagg.py",
 )
 
 #: Call heads that trace their function argument(s).

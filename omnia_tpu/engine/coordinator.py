@@ -80,6 +80,7 @@ import threading
 import time
 from typing import Optional, Sequence
 
+from omnia_tpu.engine.disagg import detect_roles, fresh_pool
 from omnia_tpu.engine.flight import FlightRecorder
 from omnia_tpu.engine.membership import _MembershipMixin
 from omnia_tpu.engine.relay import _RelayHandle
@@ -136,6 +137,12 @@ class EngineCoordinator(_MembershipMixin):
         if not workers:
             raise ValueError("coordinator needs at least one worker")
         self.workers = list(workers)
+        # Disaggregated serving (engine/disagg.py): per-worker role list,
+        # or None when every worker is pooled — None IS the no-op guard
+        # (zero role state, the exact pre-disagg routing path). The list
+        # reference is replaced atomically under _scale_lock on
+        # membership changes; readers take a local snapshot.
+        self._roles = detect_roles(self.workers)
         # LRU-bounded: workers evict sessions on their own cap without
         # telling the coordinator, so unbounded affinity would leak one
         # entry per session forever. Evicting an affinity entry only
@@ -219,6 +226,20 @@ class EngineCoordinator(_MembershipMixin):
             "sessions_migrated": 0,
             "migration_fallbacks": 0,
             "scale_events": 0,
+            # Disaggregated serving (engine/disagg.py): explicit tier
+            # sizes (0/0 in a pooled fleet), the sampled decode-slot
+            # occupancy gauge, and the handoff ledger — every handoff
+            # attempt lands in exactly one of imported or fallback, so
+            # handoffs == handoff_fallbacks + sessions imported.
+            "prefill_tier_workers": sum(
+                1 for r in (self._roles or ()) if r == "prefill"
+            ),
+            "decode_tier_workers": sum(
+                1 for r in (self._roles or ()) if r == "decode"
+            ),
+            "decode_slots_active": 0,
+            "handoffs": 0,
+            "handoff_fallbacks": 0,
         }
         # Serializes membership changes (add/remove): concurrent scale
         # operations would race the migrate/retire bookkeeping. Routing
@@ -415,6 +436,18 @@ class EngineCoordinator(_MembershipMixin):
         same duck-type contract as _load)."""
         return self._sum_signal("pending_prefill_tokens")
 
+    def decode_slots_active(self) -> int:
+        """Fleet-wide active decode-slot occupancy (summed over healthy
+        workers) — the disaggregated decode tier's autoscaling signal
+        (engine/disagg.py). Workers predating the method contribute 0
+        (same duck-type contract as pending_prefill_tokens); the sample
+        mirrors into the metrics gauge so dashboards scrape it beside
+        the tier sizes."""
+        n = self._sum_signal("decode_slots_active")
+        with self._metrics_lock:
+            self.metrics["decode_slots_active"] = n
+        return n
+
     def _saturated(self) -> bool:
         """True when every healthy worker's queue is at the per-worker
         bound — the shed-before-routing signal. A worker whose stats RPC
@@ -470,10 +503,17 @@ class EngineCoordinator(_MembershipMixin):
                 if pinned is not None and pinned in healthy:
                     self._affinity.move_to_end(session_id)
                     return pinned
+        # Disaggregated fleets (engine/disagg.py): FRESH work routes
+        # within the prefill tier — decode workers only serve sessions
+        # handed to them. Pinned sessions bypass this (fast path above /
+        # re-pin check below), and a pooled fleet (_roles is None) takes
+        # the exact pre-disagg path.
+        roles = self._roles
+        route = healthy if roles is None else fresh_pool(roles, healthy)
         # Load snapshot OUTSIDE self._lock: these are worker RPCs, and a
         # slow/hung stats call while holding the routing lock would
         # serialize ALL routing behind one bad worker (satellite fix).
-        loads = {i: self._load(i) for i in healthy}
+        loads = {i: self._load(i) for i in route}
         with self._lock:
             if session_id is not None:
                 pinned = self._affinity.get(session_id)
@@ -500,14 +540,14 @@ class EngineCoordinator(_MembershipMixin):
             key = self._prefix_key(list(prompt_tokens), prefix_key)
             if key is not None:
                 pinned = self._prefix_affinity.get(key)
-                if pinned is not None and pinned not in healthy:
+                if pinned is not None and pinned not in route:
                     # Worker died: the pin fails over — the next healthy
                     # worker re-prefills (and republishes) from scratch.
                     del self._prefix_affinity[key]
                     self._count("prefix_failovers")
                     pinned = None
                 if pinned is not None:
-                    least = min(healthy, key=lambda i: (loads[i], i))
+                    least = min(route, key=lambda i: (loads[i], i))
                     if loads[pinned] - loads[least] > self.prefix_spill_load:
                         self._count("prefix_spills")
                         choice = least  # spill; the pin survives
@@ -516,7 +556,7 @@ class EngineCoordinator(_MembershipMixin):
                         self._count("prefix_routed")
                         choice = pinned
             if choice is None:
-                choice = min(healthy, key=lambda i: (loads[i], i))
+                choice = min(route, key=lambda i: (loads[i], i))
             if key is not None and key not in self._prefix_affinity:
                 self._prefix_affinity[key] = choice
                 while len(self._prefix_affinity) > self.max_affinity:

@@ -1,0 +1,335 @@
+"""Disaggregated prefill/decode serving: roles, KV handoff, tier signals.
+
+The DistServe/Splitwise serving shape (SURVEY §7 step 2, ROADMAP item
+1): long RAG prompts must stop competing with interactive decode for
+the same chips. Workers declare a **role** — ``prefill``, ``decode``,
+or ``pooled`` (the default; a pooled fleet keeps zero role state and
+the exact pre-disagg routing path):
+
+- The coordinator routes FRESH prompts to prefill-tier workers
+  (``_pick`` consults :func:`fresh_pool`); pinned sessions follow
+  their pin wherever it lives.
+- At first-token — concretely, when the relay pump sees the first
+  turn's terminal on a prefill-tier worker, the earliest moment the
+  session's KV is exportable through the existing
+  ``export_session``/``import_session`` seam (host-row offload format,
+  int8 + paged included) — :func:`maybe_handoff` moves the
+  freshly-prefilled session to the least-loaded decode-tier worker and
+  re-pins it, so every later decode-heavy turn runs on decode chips.
+- ANY handoff failure (export fault, import rejection, no survivor)
+  books a counted fresh-prefill fallback: the pin drops and the next
+  turn re-prefills from the conversation's own history — the same
+  rebuild-on-miss contract migration uses; no conversation is ever
+  dropped. The ledger identity is exact:
+  ``handoffs == handoff_fallbacks + sessions imported``.
+
+The :class:`DisaggRouter` policy object (jax-free by contract, beside
+``fleet.py``) splits the FleetScaler's single backlog signal in two:
+the prefill tier scales on ``pending_prefill_tokens()`` (prompt-token
+backlog), the decode tier on ``decode_slots_active()`` (active decode
+occupancy — the new default-0 wire-compat ``/healthz`` signal). Each
+tier gets its own ``FleetScaler`` (its own ``Autoscaler`` instance)
+through a :class:`TierProvisioner` with a per-tier floor.
+
+All worker RPCs here (export/import/stats) run OUTSIDE every
+coordinator lock — the same no-blocking-under-lock discipline the lock
+checker enforces on the rest of the coordinator group.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import Callable, Optional, Sequence
+
+logger = logging.getLogger(__name__)
+
+__all__ = ["ROLES", "DisaggRouter", "TierProvisioner", "worker_role",
+           "detect_roles", "fresh_pool", "survivor_pool", "maybe_handoff",
+           "validate_role"]
+
+#: The closed role vocabulary. ``pooled`` is the guarded default: a
+#: worker without a ``role`` attribute is pooled, and a fleet that is
+#: pooled everywhere carries zero role state.
+ROLES = ("prefill", "decode", "pooled")
+
+
+def validate_role(role: str) -> str:
+    """Reject an unknown role at construction (engine ctors call this —
+    a typo'd role silently becoming pooled would un-tier a worker)."""
+    if role not in ROLES:
+        raise ValueError(f"role must be one of {ROLES}, got {role!r}")
+    return role
+
+
+def worker_role(worker) -> str:
+    """A worker's declared role; anything absent or unknown is pooled
+    (an old worker predating roles is a supported duck type, exactly
+    like ``pending_prefill_tokens`` on the health wire)."""
+    role = getattr(worker, "role", "pooled")
+    return role if role in ROLES else "pooled"
+
+
+def detect_roles(workers: Sequence) -> Optional[list[str]]:
+    """Role list for a fleet, or None when every worker is pooled —
+    None IS the no-op guard: the coordinator stores no role state and
+    routing takes the exact pre-disagg path."""
+    roles = [worker_role(w) for w in workers]
+    if all(r == "pooled" for r in roles):
+        return None
+    return roles
+
+
+def fresh_pool(roles: list[str], healthy: set) -> set:
+    """Workers eligible for a FRESH prompt: the prefill tier (prefill +
+    pooled). Decode workers only serve sessions handed to them — unless
+    no prefill-capable worker is healthy, in which case availability
+    beats tiering (a request must never fail because a tier is empty)."""
+    pool = {i for i in healthy if roles[i] != "decode"}
+    return pool or healthy
+
+
+def survivor_pool(roles: Optional[list[str]], healthy: set,
+                  role: Optional[str]) -> set:
+    """Migration survivors for a retiring worker, roles honored BEFORE
+    prefix affinity: exact-role survivors first, then pooled, then any
+    healthy worker (a conversation always finds a home)."""
+    if roles is None or role is None or role == "pooled":
+        return healthy
+    exact = {i for i in healthy if roles[i] == role}
+    if exact:
+        return exact
+    pooled = {i for i in healthy if roles[i] == "pooled"}
+    return pooled or healthy
+
+
+def live_tier_counts(coord) -> "dict[str, int]":
+    """Live (non-retired) workers per explicit tier — the
+    ``prefill_tier_workers`` / ``decode_tier_workers`` gauges. A pooled
+    fleet reports 0/0 (no tiers configured)."""
+    roles = coord._roles
+    with coord._health_lock:
+        live = [i for i, st in enumerate(coord._health) if not st.retired]
+    out = {"prefill": 0, "decode": 0, "pooled": 0}
+    for i in live:
+        out[roles[i] if roles is not None else "pooled"] += 1
+    return out
+
+
+def maybe_handoff(coord, session_id: Optional[str], src_idx: int) -> Optional[bool]:
+    """First-token handoff: move a freshly-prefilled session off a
+    prefill-tier worker onto the least-loaded decode-tier worker via
+    the host-row export/import seam, re-pinning the coordinator's
+    affinity so the session's next turn lands on decode chips.
+
+    Returns True (handed off), False (counted fresh-prefill fallback —
+    the pin drops and the next turn re-prefills), or None (not
+    applicable: pooled fleet, sessionless request, non-prefill source,
+    a racing failover already moved the pin, or no decode-capable
+    survivor exists — the session simply stays where it is).
+
+    Every attempt books exactly one of ``handoffs``-with-import or
+    ``handoff_fallbacks``, so ``handoffs == handoff_fallbacks +
+    sessions imported`` reconciles exactly. All worker RPCs run outside
+    every coordinator lock."""
+    roles = coord._roles
+    if roles is None or session_id is None:
+        return None
+    if src_idx >= len(roles) or roles[src_idx] != "prefill":
+        return None
+    with coord._lock:
+        if coord._affinity.get(session_id) != src_idx:
+            return None  # racing failover/migration owns the pin now
+    healthy = set(coord._healthy_indices()) - {src_idx}
+    targets = [i for i in healthy if roles[i] == "decode"]
+    if not targets:
+        targets = [i for i in healthy if roles[i] == "pooled"]
+    if not targets:
+        return None  # no decode tier yet: the session stays put
+    # Load snapshot OUTSIDE coord._lock (worker RPCs — the _pick rule).
+    loads = {i: coord._load(i) for i in targets}
+    dest = min(targets, key=lambda i: (loads[i], i))
+    coord._count("handoffs")
+    t0 = time.monotonic()
+    payload = None
+    export = getattr(coord.workers[src_idx], "export_session", None)
+    if export is not None:
+        try:
+            payload = export(session_id)
+        except Exception:
+            logger.warning(
+                "export_session(%s) failed on prefill worker %d during "
+                "handoff; falling back to fresh prefill", session_id, src_idx,
+            )
+    t1 = time.monotonic()
+    ok = False
+    if payload is not None:
+        imp = getattr(coord.workers[dest], "import_session", None)
+        if imp is not None:
+            try:
+                imp(payload)
+                ok = True
+            except Exception:
+                logger.warning(
+                    "import_session(%s) on decode worker %d failed during "
+                    "handoff; falling back to fresh prefill", session_id, dest,
+                )
+    import_s = (time.monotonic() - t1) if payload is not None else 0.0
+    with coord._lock:
+        if coord._affinity.get(session_id) == src_idx:
+            if ok:
+                coord._affinity[session_id] = dest
+                coord._affinity.move_to_end(session_id)
+            else:
+                # Fresh-prefill fallback: the pin drops; the next turn
+                # re-prefills from the conversation's own history (the
+                # rebuild-on-miss contract) — on the prefill tier, which
+                # retries the handoff at ITS terminal.
+                del coord._affinity[session_id]
+    if not ok:
+        coord._count("handoff_fallbacks")
+    if coord._flight is not None:
+        coord._flight.note_handoff(
+            session_id, src=src_idx, dest=dest if ok else -1,
+            export_s=t1 - t0, import_s=import_s, reprefill=not ok,
+        )
+    return ok
+
+
+class DisaggRouter:
+    """Two-tier signal policy over a role-configured coordinator.
+
+    Splits the FleetScaler's single backlog sample into per-tier
+    signals — prefill scales on the prompt-token backlog, decode on
+    active decode-slot occupancy — each pluggable straight into a
+    ``FleetScaler(signals=...)``. Jax-free by contract; every sample is
+    stats-RPC arithmetic taken outside all locks (the router itself
+    holds none)."""
+
+    def __init__(self, coordinator, pending_norm: Optional[float] = None):
+        from omnia_tpu.engine.fleet import PENDING_TOKENS_NORM
+
+        self.coordinator = coordinator
+        self.pending_norm = (
+            PENDING_TOKENS_NORM if pending_norm is None else pending_norm
+        )
+
+    def tier_indices(self, role: str) -> list[int]:
+        """Healthy workers in one explicit tier (pooled workers belong
+        to both — a mixed fleet's pooled workers carry either kind)."""
+        coord = self.coordinator
+        roles = coord._roles
+        healthy = coord._healthy_indices()
+        if roles is None:
+            return list(healthy)
+        return [i for i in healthy if roles[i] in (role, "pooled")]
+
+    def _tier_sum(self, indices: list[int], attr: str) -> int:
+        total = 0
+        for i in indices:
+            fn = getattr(self.coordinator.workers[i], attr, None)
+            if fn is None:
+                continue
+            try:
+                total += int(fn())
+            except Exception:
+                continue
+        return total
+
+    def prefill_signals(self) -> "tuple[float, int]":
+        """(depth, active) for the prefill tier's FleetScaler: queued
+        requests plus the prompt-token prefill backlog in
+        request-equivalents — the SURVEY §5.8 trigger, scoped to the
+        tier that pays the prefill cost."""
+        idx = self.tier_indices("prefill")
+        depth = float(self._tier_sum(idx, "queue_depth"))
+        depth += self._tier_sum(idx, "pending_prefill_tokens") / self.pending_norm
+        return depth, self._tier_sum(idx, "active_slots")
+
+    def decode_signals(self) -> "tuple[float, int]":
+        """(depth, active) for the decode tier's FleetScaler: active
+        decode-slot occupancy plus queued turns — sessions decode for
+        many turns after one handoff, so occupancy (not prompt backlog)
+        is what saturates this tier."""
+        idx = self.tier_indices("decode")
+        slots = self._tier_sum(idx, "decode_slots_active")
+        depth = float(self._tier_sum(idx, "queue_depth") + slots)
+        return depth, slots
+
+    def build_scalers(self, prefill_policy, decode_policy,
+                      prefill_provisioner, decode_provisioner,
+                      **kw) -> "tuple":
+        """Two FleetScalers (each its own Autoscaler instance) wired to
+        the per-tier signals and provisioners — the two-tier control
+        loop in one call. ``kw`` forwards to both (interval_s, clock)."""
+        from omnia_tpu.engine.fleet import FleetScaler
+
+        prefill = FleetScaler(
+            prefill_policy, prefill_provisioner,
+            coordinator=self.coordinator, signals=self.prefill_signals, **kw,
+        )
+        decode = FleetScaler(
+            decode_policy, decode_provisioner,
+            coordinator=self.coordinator, signals=self.decode_signals, **kw,
+        )
+        return prefill, decode
+
+    def stats(self) -> dict:
+        """One observability snapshot: tier sizes + both tier signals."""
+        tiers = live_tier_counts(self.coordinator)
+        p_depth, p_active = self.prefill_signals()
+        d_depth, d_slots = self.decode_signals()
+        return {
+            "prefill_tier_workers": tiers["prefill"],
+            "decode_tier_workers": tiers["decode"],
+            "pooled_workers": tiers["pooled"],
+            "prefill_depth": round(p_depth, 4),
+            "prefill_active": p_active,
+            "decode_depth": round(d_depth, 4),
+            "decode_slots_active": d_slots,
+        }
+
+
+class TierProvisioner:
+    """Per-tier provisioner over a live coordinator — the disaggregated
+    analog of ``MockFleetProvisioner``. ``factory(index)`` builds one
+    started-ready worker; the tier's role is stamped on it before it
+    joins, and scale-down retires only tier members (through
+    ``remove_worker(role=..., migrate=True)``, so a retiring decode
+    worker's sessions move to decode-tier survivors). The floor is one
+    live worker per tier: a tier at zero would strand its half of the
+    pipeline (fresh prompts for prefill, handed-off sessions for
+    decode)."""
+
+    def __init__(self, coordinator, factory: Callable[[int], object],
+                 role: str, max_workers: int = 8, floor: int = 1) -> None:
+        if role not in ("prefill", "decode"):
+            raise ValueError(
+                f"TierProvisioner role must be 'prefill' or 'decode', "
+                f"got {role!r} (pooled fleets use MockFleetProvisioner)"
+            )
+        self.coordinator = coordinator
+        self.factory = factory
+        self.role = role
+        self.max_workers = max_workers
+        self.floor = max(1, floor)
+        self._launched = len(coordinator.workers)
+        self.disposed: list = []   # remove_worker() summary dicts, in order
+
+    def current(self) -> int:
+        return live_tier_counts(self.coordinator)[self.role]
+
+    def scale_to(self, want: int) -> int:
+        want = max(self.floor, min(want, self.max_workers))
+        while self.current() < want:
+            worker = self.factory(self._launched)
+            self._launched += 1
+            if worker_role(worker) != self.role:
+                worker.role = self.role
+            self.coordinator.add_worker(worker)
+        while self.current() > want:
+            summary = self.coordinator.remove_worker(
+                role=self.role, migrate=True
+            )
+            self.disposed.append(summary)
+        return self.current()

@@ -698,6 +698,15 @@ class InferenceEngine(
     def active_slots(self) -> int:
         return sum(1 for s in self._slots if s.active)
 
+    def decode_slots_active(self) -> int:
+        """Occupied decode slots — the disaggregated decode tier's
+        autoscaling signal (engine/disagg.py). An active slot IS a
+        decode-resident stream (placement completes the prefill), so
+        today this equals active_slots(); the alias keeps the wire
+        name stable for when the device-resident decode loop splits
+        the two."""
+        return self.active_slots()
+
     # ------------------------------------------------------------------
     # Thread loop / lifecycle: start/stop/drain/recovery live in
     # engine/lifecycle.py (_LifecycleMixin); the synchronous generate()

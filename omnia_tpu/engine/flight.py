@@ -71,6 +71,10 @@ EVENTS = frozenset({
     "shed",            # coordinator shed before routing (fleet saturated)
     "migrate",         # scale-down moved a session to a survivor (or
                        # booked its fresh-prefill fallback)
+    "handoff",         # disaggregated first-turn handoff: session left
+                       # its prefill worker for the decode tier (attrs:
+                       # src/dest ids, export_s/import_s split, reprefill
+                       # on the counted fresh-prefill fallback)
     "drain",           # one worker's graceful drain finished (attrs:
                        # worker, seconds — slow-drain attribution)
     "terminal",        # request finished (attrs carry the breakdown)
@@ -380,6 +384,21 @@ class FlightRecorder:
             "fallback": fallback,
         })
 
+    def note_handoff(self, session_id: str, src: int, dest: int,
+                     export_s: float = 0.0, import_s: float = 0.0,
+                     reprefill: bool = False) -> None:
+        """Disaggregated serving (engine/disagg.py) moved one freshly
+        prefilled session from its prefill-tier worker to the decode
+        tier at first-turn completion. The export-vs-import wall split
+        is kept separate so a slow handoff is attributable to the
+        source's export or the destination's import; ``reprefill``
+        books the counted fresh-prefill fallback (``dest`` is -1)."""
+        self._record("handoff", "", {
+            "session_id": session_id, "src": src, "dest": dest,
+            "export_s": export_s, "import_s": import_s,
+            "seconds": export_s + import_s, "reprefill": reprefill,
+        })
+
     def note_drain(self, worker: int, seconds: float) -> None:
         """One worker's graceful drain completed, ``seconds`` after it
         began — recorded per worker so a slow-drain worker in the
@@ -500,11 +519,11 @@ def to_chrome_trace(events: list) -> dict:
     # land at a negative ts. Base on the earliest computed start.
     def start_of(e: dict) -> float:
         attrs = e.get("attrs", {})
-        if e["kind"] in INIT_EVENTS or e["kind"] == "drain":
-            # Init-phase and drain events are recorded at their END
-            # with the wall in `seconds` — the longest durations in any
-            # cold-start or scale-down dump, so the base must account
-            # for them.
+        if e["kind"] in INIT_EVENTS or e["kind"] in ("drain", "handoff"):
+            # Init-phase, drain, and handoff events are recorded at
+            # their END with the wall in `seconds` — the longest
+            # durations in any cold-start or scale-down dump, so the
+            # base must account for them.
             return e["mono"] - attrs.get("seconds", 0.0)
         return e["mono"] - attrs.get("dispatch_s", 0.0) - attrs.get("sync_s", 0.0)
 
@@ -539,7 +558,7 @@ def to_chrome_trace(events: list) -> dict:
                 "ts": us(e["mono"] - dur), "dur": round(dur * 1e6, 1),
                 "args": attrs,
             })
-        elif kind in INIT_EVENTS or kind == "drain":
+        elif kind in INIT_EVENTS or kind in ("drain", "handoff"):
             dur = attrs.get("seconds", 0.0)
             out.append({
                 "ph": "X", "pid": 1, "tid": 0, "name": kind,

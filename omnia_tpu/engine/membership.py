@@ -26,6 +26,13 @@ import logging
 import time
 from typing import Optional
 
+from omnia_tpu.engine.disagg import (
+    detect_roles,
+    live_tier_counts,
+    survivor_pool,
+    worker_role,
+)
+
 logger = logging.getLogger(__name__)
 
 
@@ -54,19 +61,38 @@ class _MembershipMixin:
             # missing from self.workers.
             with self._health_lock:
                 self._health.append(_WorkerHealth())
+            # Role list tracks membership (engine/disagg.py): recomputed
+            # wholesale (scale ops are rare, routing reads a snapshot) —
+            # a pooled-everywhere fleet collapses back to None, keeping
+            # the no-op guard exact across membership churn.
+            self._roles = detect_roles(self.workers)
             self._count("scale_events")
             live = self.live_workers()
             with self._metrics_lock:
                 self.metrics["fleet_workers"] = live
+            self._refresh_tier_gauges()
             logger.info("worker %d joined the fleet (live=%d)", idx, live)
             return idx
 
-    def _retire_candidate(self) -> int:
+    def _refresh_tier_gauges(self) -> None:
+        """Mirror the live per-tier worker counts into the metric gauges
+        (0/0 in a pooled fleet — no tiers configured)."""
+        tiers = live_tier_counts(self)
+        with self._metrics_lock:
+            self.metrics["prefill_tier_workers"] = tiers["prefill"]
+            self.metrics["decode_tier_workers"] = tiers["decode"]
+
+    def _retire_candidate(self, role: "Optional[str]" = None) -> int:
         """The cheapest live worker to drain: fewest pinned sessions,
         newest index breaking ties (LIFO matches how elastic fleets
-        grew)."""
+        grew). ``role`` restricts the choice to one tier (the
+        TierProvisioner's scale-down seam)."""
         with self._health_lock:
             live = [i for i, st in enumerate(self._health) if not st.retired]
+        if role is not None:
+            live = [i for i in live if worker_role(self.workers[i]) == role]
+            if not live:
+                raise ValueError(f"no live {role}-tier worker to retire")
         with self._lock:
             pins = collections.Counter(self._affinity.values())
         return min(live, key=lambda i: (pins.get(i, 0), -i))
@@ -76,11 +102,13 @@ class _MembershipMixin:
         idx: Optional[int] = None,
         migrate: bool = True,
         drain_timeout_s: float = 30.0,
+        role: Optional[str] = None,
     ) -> dict:
         """Retire one worker: leave the routing set, drain admission and
         in-flight requests (bounded), then move its resident
         conversations. ``idx=None`` picks the candidate with the fewest
-        pinned sessions. Returns the retirement summary —
+        pinned sessions (``role`` restricts that pick to one tier — the
+        disaggregated provisioner's seam). Returns the retirement summary —
         ``{"worker", "drain_s", "migrated", "fallbacks", "repinned",
         "dropped_pins"}`` — and the fleet ledger
         (``sessions_migrated``/``migration_fallbacks``) books the same
@@ -88,7 +116,7 @@ class _MembershipMixin:
         reconciles exactly."""
         with self._scale_lock:
             if idx is None:
-                idx = self._retire_candidate()
+                idx = self._retire_candidate(role)
             with self._health_lock:
                 if not (0 <= idx < len(self._health)) or self._health[idx].retired:
                     raise ValueError(f"worker {idx} is not a live fleet member")
@@ -144,6 +172,7 @@ class _MembershipMixin:
             live = self.live_workers()
             with self._metrics_lock:
                 self.metrics["fleet_workers"] = live
+            self._refresh_tier_gauges()
             logger.info(
                 "worker %d retired (live=%d migrated=%d fallbacks=%d "
                 "drain=%.3fs)", idx, live, summary["migrated"],
@@ -151,14 +180,21 @@ class _MembershipMixin:
             )
             return summary
 
-    def _pick_survivor(self, token_ids: list) -> "Optional[int]":
+    def _pick_survivor(
+        self, token_ids: list, role: "Optional[str]" = None
+    ) -> "Optional[int]":
         """The prefix-aware half of ``_pick``, read-only: honors an
         existing prompt-head pin (with the same spill-to-least-loaded
         rule) but books nothing and mutates no affinity state — the
-        routing ledger must read served traffic, not migrations."""
+        routing ledger must read served traffic, not migrations.
+        ``role`` narrows the candidate set to the retiring worker's tier
+        BEFORE prefix affinity applies (a decode session must land on a
+        decode-capable survivor even when its prompt head pins
+        elsewhere — engine/disagg.py)."""
         healthy = set(self._healthy_indices())
         if not healthy:
             return None
+        healthy = survivor_pool(getattr(self, "_roles", None), healthy, role)
         # Load snapshot OUTSIDE self._lock (worker RPCs — same
         # no-blocking-under-lock rule as _pick).
         loads = {i: self._load(i) for i in healthy}
@@ -207,8 +243,16 @@ class _MembershipMixin:
                 # sharing a prompt head land beside their pool entry —
                 # but READ-ONLY: a migration is not a routed submit, and
                 # must not bump prefix_routed/spill books or mutate the
-                # prefix-pin map.
-                dest = self._pick_survivor(list(payload.token_ids))
+                # prefix-pin map. Role-aware: sessions leave a retiring
+                # worker for its own tier first (engine/disagg.py).
+                dest = self._pick_survivor(
+                    list(payload.token_ids),
+                    role=(
+                        worker_role(worker)
+                        if getattr(self, "_roles", None) is not None
+                        else None
+                    ),
+                )
             ok = False
             if dest is not None:
                 imp = getattr(self.workers[dest], "import_session", None)

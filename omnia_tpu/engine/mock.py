@@ -20,6 +20,7 @@ import time
 from dataclasses import dataclass, field
 from typing import Optional, Sequence
 
+from omnia_tpu.engine.disagg import validate_role
 from omnia_tpu.engine.faults import FaultPlan
 from omnia_tpu.engine.flight import FlightRecorder
 from omnia_tpu.engine.mock_sessions import _MockSessionsMixin
@@ -88,26 +89,28 @@ class MockEngine(_MockSessionsMixin):
                  kv_pages: int = 0, kv_page_tokens: int = 64,
                  spec_decode: int = 0, spec_decode_max: int = 0,
                  spec_gate_window: int = 0, warmup_threads: int = 0,
-                 coldstart=None, name: str = "mock"):
+                 coldstart=None, name: str = "mock", role: str = "pooled"):
         from omnia_tpu.engine.coldstart import ColdStartTracker
 
         self.scenarios = list(scenarios)
+        # Disaggregated role (engine/disagg.py): duck-typed off any
+        # worker; "pooled" (the default) is the guarded true no-op —
+        # an all-pooled fleet keeps the coordinator's role list None.
+        self.role = validate_role(role)
+        # Decode-slot occupancy gauge: playbacks past placement.
+        self._decode_rids: set = set()  # guarded-by: _lock
         self.tokenizer = tokenizer or ByteTokenizer()
         # Request-id prefix. Default preserves the historical "mock-N"
-        # ids; a FLEET of mocks behind one coordinator gives each worker
-        # its own name so request ids stay unique across workers — the
-        # traffic simulator joins flight-recorder terminals back to its
-        # submits by id, and two workers both emitting "mock-0" would
-        # cross-wire the per-class latency books.
+        # ids; a FLEET of mocks behind one coordinator names each worker
+        # so request ids stay unique across workers — the traffic
+        # simulator joins flight terminals back to submits by id.
         self.name = name
-        # Cold-start parity (engine/coldstart.py): the mock has no
-        # programs to compile, but warmup() books the same phase spans,
-        # progress counters, and manifest hits/misses through the REAL
-        # tracker and manifest code — scripted output is untouched.
-        # warmup_threads is accepted (providers forward it to mock AND
-        # tpu engines) and mirrored into the ledger; with no compiles
-        # there is nothing to parallelize — the knob only affects which
-        # thread count the ledger reports.
+        # Cold-start parity (engine/coldstart.py): no programs to
+        # compile, but warmup() books the same phase spans, progress
+        # counters, and manifest hits/misses through the REAL tracker —
+        # scripted output untouched. warmup_threads is accepted
+        # (providers forward it to both engines) and only affects the
+        # thread count the ledger reports (nothing to parallelize).
         if warmup_threads < 0:
             raise ValueError("warmup_threads must be >= 0")
         self.warmup_threads = warmup_threads
@@ -116,10 +119,9 @@ class MockEngine(_MockSessionsMixin):
         self._req_counter = itertools.count()
         self._lock = threading.Lock()
         # Flight-recorder parity (engine/flight.py): the mock records
-        # the IDENTICAL event vocabulary (submit/claim/placement/token
-        # books/terminal) so hermetic tests exercise the full breakdown
-        # + trace-continuity path with no device. flight_events=0 is the
-        # same guarded no-op as the real engine's.
+        # the IDENTICAL event vocabulary so hermetic tests exercise the
+        # full breakdown + trace-continuity path with no device;
+        # flight_events=0 is the same guarded no-op as the engine's.
         self._flight: Optional[FlightRecorder] = (
             FlightRecorder(flight_events) if flight_events > 0 else None
         )
@@ -384,6 +386,12 @@ class MockEngine(_MockSessionsMixin):
         token-aware load signal is exercisable hermetically."""
         with self._lock:
             return self._live_prompt_tokens
+
+    def decode_slots_active(self) -> int:
+        """Playbacks past placement — the decode tier's autoscaling
+        signal (engine/disagg.py, prefill done and tokens streaming)."""
+        with self._lock:
+            return len(self._decode_rids)
 
     def submit(
         self,
@@ -653,6 +661,7 @@ class MockEngine(_MockSessionsMixin):
             with self._lock:
                 self._live_plays -= 1
                 self._live_prompt_tokens -= len(prompt_tokens)
+                self._decode_rids.discard(rid)
 
     def _finish(self, handle, rid, reason, n_prompt, generated, error=None):
         """Push the terminal event and keep the books balanced: every
@@ -706,7 +715,9 @@ class MockEngine(_MockSessionsMixin):
         # mixed steps and its full token count (identical to the real
         # engine's per-piece metering); prefill-first instead counts a
         # decode stall whenever other playbacks are live to be stalled.
+        # Placement also claims the decode-slot gauge (disagg).
         with self._lock:
+            self._decode_rids.add(rid)
             if self.prefill_chunk_tokens > 0:
                 self.metrics["mixed_steps"] += -(
                     -n_prompt // self.prefill_chunk_tokens

@@ -189,6 +189,9 @@ class LockstepEngine:
     def active_slots(self) -> int:
         return self.engine.active_slots()
 
+    def decode_slots_active(self) -> int:
+        return self.engine.decode_slots_active()
+
     def healthy(self) -> bool:
         return self.engine.healthy() and not self._wedged
 

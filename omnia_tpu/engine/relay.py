@@ -13,6 +13,7 @@ import threading
 import time
 from typing import Optional
 
+from omnia_tpu.engine.disagg import maybe_handoff
 from omnia_tpu.engine.types import FinishReason, RequestHandle
 
 
@@ -157,5 +158,21 @@ class _RelayHandle(RequestHandle):
                     ev = dataclasses.replace(
                         ev, num_generated_tokens=self._forwarded
                     )
+                elif (
+                    # Disaggregated handoff (engine/disagg.py): a
+                    # sessionful stream that completed its first turn on
+                    # a prefill-tier worker moves to the decode tier
+                    # BEFORE the terminal surfaces, so the client's next
+                    # turn already routes to the new pin. Completion-only
+                    # (STOP/LENGTH): the session KV is exportable exactly
+                    # then, and ≥1 forwarded token proves the prefill
+                    # actually produced output worth carrying over.
+                    self._owner._roles is not None
+                    and self._args[2] is not None
+                    and self._forwarded > 0
+                    and ev.finish_reason in
+                        (FinishReason.STOP, FinishReason.LENGTH)
+                ):
+                    maybe_handoff(self._owner, self._args[2], self._inner_idx)
                 self._push(dataclasses.replace(ev, request_id=self.request_id))
                 return

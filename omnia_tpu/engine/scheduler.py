@@ -566,20 +566,9 @@ class _SchedulerMixin:
     def _finish_slot(self, slot_idx: int, reason: FinishReason):
         slot = self._slots[slot_idx]
         rid = slot.request.request_id
-        slot.handle._push(
-            StreamEvent(
-                rid,
-                finish_reason=reason,
-                num_prompt_tokens=len(slot.request.prompt_tokens),
-                num_generated_tokens=slot.generated,
-            )
-        )
-        self.metrics["requests_finished"] += 1
-        if self._flight is not None:
-            self._flight.note_terminal(
-                rid, reason.value, tokens=slot.generated,
-                first_token_at=slot.handle.first_token_at,
-            )
+        handle = slot.handle
+        n_prompt = len(slot.request.prompt_tokens)
+        generated = slot.generated
         if slot.gr_view is not None:
             # A constrained generation brought to a valid stop: without
             # the grammar this request could have burned a whole decode
@@ -593,7 +582,12 @@ class _SchedulerMixin:
         # prefix reuse. The last emitted token's row write is not
         # guaranteed (a slot can finish mid-decode-chunk), so it is
         # conservatively excluded — re-prefilling one token next turn is
-        # cheaper than reasoning about chunk timing.
+        # cheaper than reasoning about chunk timing. The record commits
+        # BEFORE the terminal event is pushed: the coordinator relay
+        # hands a freshly-prefilled session off at the terminal
+        # (engine/disagg.py), so the terminal must never be observable
+        # while the registry still holds the previous turn or the slot
+        # still reads active.
         quiesce_row = 0
         sid = slot.session_id
         sess = self._sessions.get(sid) if sid else None
@@ -621,3 +615,17 @@ class _SchedulerMixin:
         self._tokens = self._tokens.at[slot_idx].set(0)
         self._temp = self._temp.at[slot_idx].set(0.0)
         self._active = self._active.at[slot_idx].set(False)
+        handle._push(
+            StreamEvent(
+                rid,
+                finish_reason=reason,
+                num_prompt_tokens=n_prompt,
+                num_generated_tokens=generated,
+            )
+        )
+        self.metrics["requests_finished"] += 1
+        if self._flight is not None:
+            self._flight.note_terminal(
+                rid, reason.value, tokens=generated,
+                first_token_at=handle.first_token_at,
+            )

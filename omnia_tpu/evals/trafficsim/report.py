@@ -203,6 +203,19 @@ def _class_cell(cls, offered: list, outcomes: list, run) -> dict:
     turns_offered = sum(len(r.turns) for r in offered)
     times = [r.intended_at_s for r in offered]
     counts = interval_counts(times, run.plan.duration_s)
+    # Disaggregated handoffs folded per class by session id: the
+    # coordinator's flight `handoff` events carry the export+import
+    # wall in `seconds` (reprefill=True marks the counted
+    # fresh-prefill fallback — zero carry cost, so excluded from the
+    # duration percentiles but counted beside them).
+    sids = {r.session_id for r in offered if r.session_id is not None}
+    handoffs = [
+        h for h in (getattr(run, "coord_handoffs", None) or ())
+        if h.get("session_id") in sids
+    ]
+    handoff_s = [
+        h.get("seconds", 0.0) for h in handoffs if not h.get("reprefill")
+    ]
     return {
         "offered": len(offered),
         "turns_offered": turns_offered,
@@ -220,6 +233,13 @@ def _class_cell(cls, offered: list, outcomes: list, run) -> dict:
         "ttft_client_ms": _pct_block(ttft_client),
         "ttft_from_intended_ms": _pct_block(co_ttft),
         "sched_delay_ms": _pct_block(sched_delay),
+        # Disaggregated serving: per-class first-turn handoff wall
+        # (seconds) + the attempt/fallback split.
+        "handoff_s": _pct_block(handoff_s),
+        "handoffs": len(handoffs),
+        "handoff_reprefills": sum(
+            1 for h in handoffs if h.get("reprefill")
+        ),
         "arrivals": {
             "profile": cls.arrival.profile,
             "rate_rps": cls.arrival.rate_rps,
@@ -312,6 +332,21 @@ def _ledger(run, outcomes: list) -> dict:
               w_sub, routed + resubmits + relays - w_shed)
         ident("coord_shed observed == coord shed book",
               coord_shed_obs, coord_shed)
+        # Disaggregated handoff ledger (engine/disagg.py): every
+        # attempt books exactly one import-or-fallback, and the
+        # coordinator's flight trail records each attempt once. Only
+        # assertable when the coordinator HAS a recorder (imports are
+        # visible only through its handoff events).
+        h_events = getattr(run, "coord_handoffs", None)
+        if h_events is not None:
+            h_imported = sum(
+                1 for h in h_events if not h.get("reprefill")
+            )
+            ident("handoffs == handoff_fallbacks + sessions imported",
+                  coord.get("handoffs", 0),
+                  coord.get("handoff_fallbacks", 0) + h_imported)
+            ident("handoff flight events == handoffs book",
+                  len(h_events), coord.get("handoffs", 0))
     if run.chaos_fired is not None:
         # Exact chaos attribution: every counted death either became a
         # transparent resubmit, surfaced as a worker-death ERROR (second

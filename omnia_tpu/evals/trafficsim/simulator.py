@@ -61,6 +61,9 @@ WORKER_KEYS = (
 COORD_KEYS = (
     "routed", "shed", "resubmits", "retirement_relays", "failovers",
     "prefix_routed", "affinity_evictions",
+    # Disaggregated serving (engine/disagg.py): first-turn handoff
+    # attempts and their counted fresh-prefill fallbacks.
+    "handoffs", "handoff_fallbacks",
 )
 
 
@@ -107,6 +110,13 @@ class SimRun:
     duplex_skipped: int = 0
     duplex_skip_reason: Optional[str] = None
     driver_errors: int = 0
+    # Disaggregated handoff events from the COORDINATOR's flight
+    # recorder (attr dicts; handoffs are routing-plane actions no
+    # worker recorder sees) — the report folds them per class by
+    # session id and reconciles them against the handoff books.
+    # None when the target has no coordinator recorder (the ledger
+    # skips the flight-side handoff identities, it can't see them).
+    coord_handoffs: Optional[list] = None
 
     def report(self) -> dict:
         from omnia_tpu.evals.trafficsim.report import build_report
@@ -577,6 +587,18 @@ class TrafficSimulator:
                     continue
                 bd_owner[rid] = wi
                 breakdowns[rid] = dict(ev.attrs)
+        # Handoff events live on the COORDINATOR's own recorder (the
+        # handoff is a routing-plane action, not any worker's); scoped
+        # to THIS run's session ids so a reused target's history never
+        # leaks into the per-class fold or the ledger identities.
+        coord_handoffs: Optional[list] = None
+        crec = getattr(self.target, "_flight", None)
+        if crec is not None:
+            sids = {r.session_id for r in self._trace if r.session_id}
+            coord_handoffs = [
+                dict(ev.attrs) for ev in crec.events("handoff")
+                if ev.attrs.get("session_id") in sids
+            ]
         with self._lock:
             outcomes = list(self._outcomes)
             submits = self._submits
@@ -600,4 +622,5 @@ class TrafficSimulator:
             duplex_skipped=duplex_skipped,
             duplex_skip_reason=self._duplex_rt.error,
             driver_errors=driver_errors,
+            coord_handoffs=coord_handoffs,
         )

@@ -60,6 +60,11 @@ class _AutoscaleMixin:
         from omnia_tpu.engine.fleet import PENDING_TOKENS_NORM
         from omnia_tpu.runtime.client import RuntimeClient
 
+        # Disaggregated tier (engine/disagg.py): a deployment declaring
+        # `disagg: {role: decode}` scales on decode-slot occupancy —
+        # the tier's own backlog — instead of the prefill-side signal;
+        # prefill/pooled deployments keep the queue+token trigger.
+        role = (dep.resource.spec.get("disagg") or {}).get("role", "pooled")
         depth = 0.0
         conns = 0
         for pod in dep.pods + dep.candidate_pods:
@@ -67,15 +72,23 @@ class _AutoscaleMixin:
                 client = RuntimeClient(f"localhost:{pod.runtime_port}")
                 try:
                     h = client.health()
-                    # Queue depth PLUS the prompt-token prefill backlog
-                    # in request-equivalents — the SURVEY §5.8 trigger:
-                    # four queued 8k-token prompts scale like real work,
-                    # not like four idle connections.
-                    depth += h.queue_depth
-                    depth += (
-                        getattr(h, "pending_prefill_tokens", 0)
-                        / PENDING_TOKENS_NORM
-                    )
+                    if role == "decode":
+                        # Occupied decode slots are the decode tier's
+                        # work units; queue depth still counts so a
+                        # backed-up decode worker registers too.
+                        depth += h.queue_depth
+                        depth += getattr(h, "decode_slots_active", 0)
+                    else:
+                        # Queue depth PLUS the prompt-token prefill
+                        # backlog in request-equivalents — the SURVEY
+                        # §5.8 trigger: four queued 8k-token prompts
+                        # scale like real work, not like four idle
+                        # connections.
+                        depth += h.queue_depth
+                        depth += (
+                            getattr(h, "pending_prefill_tokens", 0)
+                            / PENDING_TOKENS_NORM
+                        )
                 finally:
                     client.close()
             except Exception:

@@ -205,6 +205,12 @@ class HealthResponse:
     # backlog, not connection count. 0 on engines predating the signal
     # (wire-compatible both ways via _known_fields).
     pending_prefill_tokens: int = 0
+    # Active decode-slot occupancy — the disaggregated decode tier's
+    # autoscaling signal (engine/disagg.py), carried beside the prefill
+    # backlog so the operator can size the two tiers independently.
+    # 0 on engines predating the signal (wire-compatible both ways via
+    # _known_fields).
+    decode_slots_active: int = 0
     # Function-mode metadata ({name, description, input_schema} per entry)
     # so HTTP facades (REST, MCP tools/list) can enumerate callable
     # functions without a pack copy of their own.

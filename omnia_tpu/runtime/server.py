@@ -457,6 +457,7 @@ class RuntimeServer:
         engine = self.engine
         status = "ok" if getattr(engine, "healthy", lambda: True)() else "unhealthy"
         pending_fn = getattr(engine, "pending_prefill_tokens", None)
+        decode_fn = getattr(engine, "decode_slots_active", None)
         return c.HealthResponse(
             status=status,
             contract_version=c.CONTRACT_VERSION,
@@ -468,6 +469,9 @@ class RuntimeServer:
             # duck-type contract the coordinator's load signal uses).
             pending_prefill_tokens=(
                 pending_fn() if pending_fn is not None else 0
+            ),
+            decode_slots_active=(
+                decode_fn() if decode_fn is not None else 0
             ),
             functions=self._function_meta(),
         )

@@ -109,6 +109,8 @@ KNOB_GUARDS = {
         "structural: request-id prefix only (fleet-unique ids for the "
         "traffic simulator's flight-terminal join); never a behavior "
         "switch — default keeps the historical 'mock-N' ids",
+    "MockEngine.role":
+        "test_disagg.py::test_pooled_fleet_is_true_noop",
 }
 
 

@@ -501,6 +501,11 @@ class TestMetricsKeyStability:
         "shed", "resubmits", "retirement_relays",
         "fleet_workers", "sessions_migrated", "migration_fallbacks",
         "scale_events",
+        # Disaggregated serving (engine/disagg.py): tier-size gauges,
+        # the sampled decode-slot occupancy, and the handoff ledger
+        # (handoffs == handoff_fallbacks + sessions imported).
+        "prefill_tier_workers", "decode_tier_workers",
+        "decode_slots_active", "handoffs", "handoff_fallbacks",
     }
 
     def test_engine_metric_keys_are_stable(self):
