@@ -51,6 +51,7 @@ LOCK_GROUPS: tuple[tuple[str, tuple[str, ...]], ...] = (
     ("mock", (
         "omnia_tpu/engine/mock.py",
         "omnia_tpu/engine/mock_sessions.py",
+        "omnia_tpu/engine/mock_mirrors.py",
     )),
     ("coordinator", (
         "omnia_tpu/engine/coordinator.py",
@@ -63,6 +64,11 @@ LOCK_GROUPS: tuple[tuple[str, tuple[str, ...]], ...] = (
     # and provisioner calls must stay OUTSIDE its lock (lock-blocking),
     # same discipline as coordinator routing.
     ("fleet", ("omnia_tpu/engine/fleet.py",)),
+    # The chunk drainer: the engine thread submits entries and reads
+    # stats() while the drainer thread books drains — its counter lock
+    # must never wrap the np.asarray readback (that wall is the thing
+    # the drainer exists to keep off the dispatch path).
+    ("devloop", ("omnia_tpu/engine/devloop.py",)),
     # The flight recorder is its own concurrent class (submits arrive on
     # caller threads, step events on the engine thread, terminals on
     # either) — same machine-checked lock-at-access-site discipline.

@@ -53,6 +53,7 @@ ENGINE_FAMILY = (
 MOCK_FILES = (
     "omnia_tpu/engine/mock.py",
     "omnia_tpu/engine/mock_sessions.py",
+    "omnia_tpu/engine/mock_mirrors.py",
 )
 #: Coordinator family: coordinator.py plus the membership/relay splits.
 #: membership.py holds the actual increment sites for the fleet ledger

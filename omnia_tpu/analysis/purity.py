@@ -54,6 +54,10 @@ PURITY_FILES_PREFIXES: tuple[str, ...] = (
     # Role routing and the handoff plane are stats arithmetic + worker
     # RPCs; a traced body here would be the same bug class.
     "omnia_tpu/engine/disagg.py",
+    # The decode-ring host half is host-side by contract (drainer
+    # threads + gate arithmetic); a traced body here would be the same
+    # bug class.
+    "omnia_tpu/engine/devloop.py",
 )
 
 #: Call heads that trace their function argument(s).

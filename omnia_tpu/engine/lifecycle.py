@@ -105,6 +105,12 @@ class _LifecycleMixin:
             # The loop has joined (or never ran), so the engine thread's
             # device-state ownership has passed back to this caller.
             self._offload_idle_sessions()
+        if self._devloop is not None:
+            # Join the long-lived chunk drainer (engine/devloop.py) —
+            # stop() skips a poisoned drainer's thread (it is wedged in
+            # the hung readback that tripped the watchdog). A later
+            # start() lazily builds a fresh one on first use.
+            self._devloop.stop()
 
     def _drain_work_left(self) -> bool:
         """The drain-wait predicate: queued, mid-placement, or active
@@ -135,6 +141,9 @@ class _LifecycleMixin:
         engine would be permanently dead while looking alive."""
         self._fail_all(msg)
         # In-flight chunk futures share lineage with the dead caches.
+        # Entries the drainer is still reading park their exception in
+        # the drain box (devloop.ChunkDrainer catches) — dropping them
+        # here means nobody ever waits on those boxes again.
         self._inflight.clear()
         # Device-resident session rows died with the caches; host-paged
         # sessions survive (their rows live in host RAM).

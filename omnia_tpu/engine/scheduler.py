@@ -29,12 +29,12 @@ pipeline flush per step (finish surfacing) but chunks stay full-size.
 from __future__ import annotations
 
 import queue
-import threading
 import time
 from typing import Optional
 
 import numpy as np
 
+from omnia_tpu.engine.devloop import _InflightChunk
 from omnia_tpu.engine.faults import WatchdogTimeout
 from omnia_tpu.engine.types import FinishReason, SamplingParams, StreamEvent
 
@@ -350,47 +350,51 @@ class _SchedulerMixin:
             for rid in reaped:  # span end = I/O, never under the lock
                 self._flight.note_terminal(rid, FinishReason.DEADLINE.value)
 
-    def _sync_chunk_host(self, toks) -> np.ndarray:
-        """Device→host read of a decode chunk's tokens, optionally under
-        the hung-dispatch watchdog. watchdog_s=None is the direct
-        pre-existing sync (no thread); with a watchdog the sync runs in
-        a short-lived thread and a read that outlives watchdog_s raises
-        WatchdogTimeout — the loop's recovery path fails in-flight
-        handles and reallocates device state, so a hung device bounds
-        client latency instead of freezing the engine silently."""
+    def _fault_sleep_s(self) -> float:
+        """Injected hang/slow-sync seconds for the next chunk readback
+        (engine/faults.py): consumed at the point the readback STARTS —
+        inline, or on the drainer thread, where an injected hang must
+        look exactly like a hung device sync to the watchdog."""
         fault = self._fault_plan
+        if fault is None:
+            return 0.0
+        return fault.take_hang_s() + fault.slow_sync_s
+
+    def _sync_chunk_host(self, toks, entry=None) -> np.ndarray:
+        """Device→host read of a decode chunk's tokens, optionally under
+        the hung-dispatch watchdog. watchdog_s=None without a drain
+        entry is the direct pre-existing sync (no thread); everything
+        else rides the engine's ONE long-lived drainer thread
+        (engine/devloop.py ChunkDrainer — it replaced the short-lived
+        per-chunk omnia-chunk-sync threads the watchdog used to spawn):
+        a readback already started at dispatch (``entry``) is awaited,
+        a watchdog-only readback is handed over now. A read that
+        outlives watchdog_s raises WatchdogTimeout — the loop's
+        recovery path fails in-flight handles and reallocates device
+        state, so a hung device bounds client latency instead of
+        freezing the engine silently."""
         wd = self.cfg.watchdog_s
-        if wd is None:
-            if fault is not None:
-                time.sleep(fault.take_hang_s() + fault.slow_sync_s)
-            return np.asarray(toks)
-        box: list = []
-
-        def sync():
-            if fault is not None:
-                # Inside the timed thread: an injected hang must look
-                # exactly like a hung device sync to the watchdog.
-                time.sleep(fault.take_hang_s() + fault.slow_sync_s)
-            try:
-                box.append(np.asarray(toks))
-            except Exception as e:  # noqa: BLE001 - re-raised on the engine thread
-                box.append(e)
-
-        t = threading.Thread(target=sync, name="omnia-chunk-sync", daemon=True)
-        t.start()
-        t.join(timeout=wd)
-        if not box:
+        if entry is None:
+            if wd is None:
+                sleep_s = self._fault_sleep_s()
+                if sleep_s > 0.0:
+                    time.sleep(sleep_s)
+                return np.asarray(toks)
+            entry = self._devloop.get_drainer().submit(
+                toks, pre_sleep_s=self._fault_sleep_s()
+            )
+        host = self._devloop.get_drainer().wait(entry, timeout=wd)
+        if host is None:
             self.metrics["watchdog_trips"] += 1
             self._healthy = False  # readiness flips for the incident;
             # _recover restores it once device state reallocates.
             raise WatchdogTimeout(
                 f"decode chunk host sync exceeded watchdog_s={wd}"
             )
-        if isinstance(box[0], Exception):
-            raise box[0]
-        return box[0]
+        return host
 
-    def _run_decode_step(self, single: bool = False, chunk: Optional[int] = None):
+    def _run_decode_step(self, single: bool = False, chunk: Optional[int] = None,
+                         dl_steps=None):
         """One chunked decode dispatch → host tokens [K, B]. Position
         advancement AND stop/length deactivation happen on-device inside
         the scan. `single` picks the 1-step variant (used while work is
@@ -417,7 +421,27 @@ class _SchedulerMixin:
             self._top_p,
             self._top_k,
         )
-        if self._gr_on:
+        ring = self.cfg.decode_ring > 0
+        if ring and dl_steps is None:
+            dl_steps = self._deadline_steps()
+        if self._gr_on and ring:
+            # Ring grammar edition: the per-slot EOS ids and the
+            # deadline-step budget ride the dispatch; the returned
+            # deadline carry is discarded (recomputed per dispatch).
+            (
+                self._ck,
+                self._cv,
+                self._tokens,
+                self._positions,
+                self._active,
+                self._budget,
+                self._key_data,
+                self._gstate,
+                _dl,
+                toks,
+            ) = fn(*args, self._gstate, self._gtable, self._gactive,
+                   self._geos, dl_steps)
+        elif self._gr_on:
             # Grammar edition: per-slot FSM state rides the dispatch and
             # advances on device (programs.decode_chunk_grammar).
             (
@@ -431,6 +455,18 @@ class _SchedulerMixin:
                 self._gstate,
                 toks,
             ) = fn(*args, self._gstate, self._gtable, self._gactive)
+        elif ring:
+            (
+                self._ck,
+                self._cv,
+                self._tokens,
+                self._positions,
+                self._active,
+                self._budget,
+                self._key_data,
+                _dl,
+                toks,
+            ) = fn(*args, dl_steps)
         else:
             (
                 self._ck,
@@ -451,9 +487,9 @@ class _SchedulerMixin:
         already in flight — how many more decode steps could do real work
         for SOMEONE."""
         inflight_steps: dict[int, int] = {}
-        for toks, active, _dispatch_s in self._inflight:
-            k = int(toks.shape[0])
-            for i, _rid in active:
+        for ch in self._inflight:
+            k = int(ch.toks.shape[0])
+            for i, _rid in ch.active:
                 inflight_steps[i] = inflight_steps.get(i, 0) + k
         need = 0
         for i, s in enumerate(self._slots):
@@ -497,25 +533,91 @@ class _SchedulerMixin:
         # frontier BEFORE the chunk dispatches (engine/paged.py) — a
         # decode write must never land through a trash table entry.
         self._prealloc_decode_pages(chunk)
+        dl_steps = (
+            self._deadline_steps() if self.cfg.decode_ring > 0 else None
+        )
         t_dispatch = time.monotonic()
-        toks = self._run_decode_step(chunk=chunk)
+        toks = self._run_decode_step(chunk=chunk, dl_steps=dl_steps)
         # The dispatch wall rides the in-flight entry so the flight
         # recorder can pair it with the (deferred) sync wall into one
         # per-chunk dispatch-vs-sync event.
-        self._inflight.append((toks, active, time.monotonic() - t_dispatch))
+        self._push_inflight(toks, active, time.monotonic() - t_dispatch, dl_steps)
+
+    def _deadline_steps(self) -> np.ndarray:
+        """Per-slot deadline budget in decode STEPS for the next ring
+        dispatch: remaining wall time to each slot's deadline divided by
+        the realized per-step EMA (engine/devloop.py), clamped to ≥ 1 —
+        a deadline already past belongs to the step-boundary reap, not
+        the scan. Slots without a deadline (and every slot under an
+        injected logical clock, where a wall-based conversion would
+        diverge lockstep ranks) get an effectively-infinite budget, so
+        the in-scan mask can only ever fire for real wall deadlines."""
+        dl = np.full((self.cfg.num_slots,), 1 << 30, np.int32)
+        if self.clock is not time.monotonic:
+            return dl
+        ema = max(self._devloop.step_ema_s, 1e-6)
+        now = time.monotonic()
+        for i, s in enumerate(self._slots):
+            if s.active and s.request.deadline_at is not None:
+                steps = int((s.request.deadline_at - now) / ema)
+                dl[i] = max(1, min(1 << 30, steps))
+        return dl
+
+    def _push_inflight(self, toks, active, dispatch_s, dl_steps=None):
+        """Append one dispatched chunk to the pipeline — the shared seam
+        for plain decode chunks and mixed interleave steps (both ride
+        the same ring). With async drain engaged, the device→host
+        readback starts NOW on the drainer thread (the dispatch path
+        never blocks on it); a ring already holding ``capacity``
+        undrained chunks processes its oldest first (ring_full_stalls —
+        the drain fell behind dispatch)."""
+        ch = _InflightChunk(toks, active, dispatch_s, dl_steps)
+        dv = self._devloop
+        if dv is not None and dv.async_engaged(self.clock is time.monotonic):
+            if len(self._inflight) >= dv.capacity:
+                self.metrics["ring_full_stalls"] += 1
+                self._process_oldest_chunk()
+            ch.entry = dv.get_drainer().submit(
+                toks, pre_sleep_s=self._fault_sleep_s(),
+                on_drained=self._note_ring_drain,
+            )
+        self._inflight.append(ch)
+
+    def _note_ring_drain(self, host_tokens, drain_s: float) -> None:
+        """Drainer-thread callback: record the drain as ITS OWN flight
+        event so sync time is attributed to the thread that actually
+        blocked on the link, keeping the dispatch/sync split honest
+        under async drain. Runs on the drainer thread — the recorder is
+        lock-protected, and None (a failed readback) records nothing
+        (the engine thread re-raises and recovers)."""
+        if self._flight is not None and host_tokens is not None:
+            self._flight.note_ring_drain(
+                1, int(host_tokens.size), drain_s
+            )
 
     def _process_oldest_chunk(self):
-        toks, active, dispatch_s = self._inflight.popleft()
+        ch = self._inflight.popleft()
         t_sync = time.monotonic()
-        host_tokens = self._sync_chunk_host(toks)  # [K, B] — ONE sync per chunk
+        # [K, B] — ONE sync per chunk; with a drain entry this only
+        # blocks for whatever the drainer hasn't finished yet.
+        host_tokens = self._sync_chunk_host(ch.toks, ch.entry)
         sync_s = time.monotonic() - t_sync
         self.metrics["decode_sync_s"] += sync_s
+        drained = ch.entry is not None
+        if drained:
+            self.metrics["ring_drains"] += 1
+        dv = self._devloop
+        K = int(host_tokens.shape[0])
+        if dv is not None and K > 0:
+            # Realized per-step wall time feeds the deadline→steps EMA.
+            dv.observe_step_time((ch.dispatch_s + sync_s) / K)
         if self._flight is not None:
             self._flight.note_decode_chunk(
-                int(host_tokens.shape[0]), dispatch_s, sync_s, len(active)
+                K, ch.dispatch_s, sync_s, len(ch.active), drained=drained
             )
-        for k in range(host_tokens.shape[0]):
-            for i, rid in active:
+        for k in range(K):
+            stepped = False
+            for i, rid in ch.active:
                 slot = self._slots[i]
                 if not slot.active or slot.request.request_id != rid:
                     # Finished earlier in this chunk (rest is garbage) — or
@@ -523,8 +625,38 @@ class _SchedulerMixin:
                     # flight, in which case these tokens belong to the old
                     # request, never the slot's new occupant.
                     continue
+                if ch.dl_steps is not None and k >= int(ch.dl_steps[i]):
+                    # The scan masked this slot at exactly this step
+                    # (deadline-step budget): finish with the partial
+                    # output — streamed tokens == num_generated, and
+                    # the frozen device rows past here are garbage.
+                    self.metrics["deadline_exceeded"] += 1
+                    self._finish_slot(i, FinishReason.DEADLINE)
+                    continue
+                stepped = True
                 slot.length += 1
                 self._emit_token(i, int(host_tokens[k, i]))
+            if not stepped:
+                # Every snapshot slot is finished: the remaining steps'
+                # tokens are frozen garbage for all of them — and with
+                # the ring scan (dl_steps rides exactly the ring decode
+                # chunks, never mixed steps), the device skipped those
+                # forwards too (the lax.cond early-out).
+                if ch.dl_steps is not None:
+                    self.metrics["early_exit_steps"] += K - k
+                break
+        if (
+            dv is not None and dv.gate is not None
+            and self.clock is time.monotonic
+        ):
+            # One gate tick per processed chunk (the spec-gate idiom):
+            # realized tok/s with async drain permitted vs suppressed
+            # decides whether the NEXT dispatch hands its readback to
+            # the drainer. Skipped under an injected logical clock
+            # (lockstep), where a wall-clock decision could diverge
+            # the replicated step streams.
+            dv.gate.tick(time.monotonic(), self.metrics["tokens_generated"])
+            self.metrics["decode_ring_gate_state"] = dv.gate.state_code()
 
     def _flush_pipeline(self):
         while self._inflight:

@@ -1,0 +1,610 @@
+"""Device-resident decode loop suite (ISSUE 17): the chunk drainer,
+the ring self-gate, the deadline-step conversion, the mock's ring
+mirror, and the ring-on-vs-off equivalence battery.
+
+Module top is jax-free by design: the validate/drainer/gate/state
+units and the MockEngine ring-mirror battery all run under the CI
+analysis job's poisoned jax stub (``pytest -m devloop --noconftest``);
+the engine-backed equivalence battery importorskips jax.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from types import SimpleNamespace
+
+import pytest
+
+try:  # the CI analysis job runs the jax-free subset on a bare venv
+    import numpy as np
+except ImportError:  # pragma: no cover - CI analysis job only
+    np = None
+
+from omnia_tpu.engine.devloop import (
+    ChunkDrainer,
+    DevLoopState,
+    RingGate,
+    _InflightChunk,
+    validate_decode_ring,
+)
+from omnia_tpu.engine.mock import MockEngine, Scenario
+from omnia_tpu.engine.types import FinishReason, SamplingParams
+
+pytestmark = pytest.mark.devloop
+
+
+# ---------------------------------------------------------------------------
+# validate_decode_ring (jax-free)
+# ---------------------------------------------------------------------------
+
+
+class TestValidate:
+    @pytest.mark.parametrize("ring", [0, 2, 3, 8])
+    def test_servable_values_pass(self, ring):
+        validate_decode_ring(SimpleNamespace(decode_ring=ring))
+
+    def test_negative_rejected(self):
+        with pytest.raises(ValueError, match="must be >= 0"):
+            validate_decode_ring(SimpleNamespace(decode_ring=-1))
+
+    def test_one_deep_ring_rejected(self):
+        """ring=1 can never overlap a drain with the next dispatch —
+        a misconfiguration, not a degraded mode."""
+        with pytest.raises(ValueError, match="one-deep ring"):
+            validate_decode_ring(SimpleNamespace(decode_ring=1))
+
+    def test_knobless_config_is_off(self):
+        validate_decode_ring(SimpleNamespace())  # duck-typed: absent = 0
+
+
+# ---------------------------------------------------------------------------
+# ChunkDrainer (jax-free)
+# ---------------------------------------------------------------------------
+
+
+class _Boom:
+    """An array-like whose readback dies (a donated buffer freed by
+    recovery while the drainer was still reading)."""
+
+    def __array__(self, dtype=None, copy=None):
+        raise RuntimeError("buffer deleted")
+
+
+class TestChunkDrainer:
+    @pytest.fixture(autouse=True)
+    def _needs_numpy(self):
+        # The drain IS the numpy readback; on the bare CI venv these
+        # skip while the gate/state/mock units still run.
+        pytest.importorskip("numpy")
+
+    def test_drain_returns_host_array_fifo(self):
+        d = ChunkDrainer()
+        try:
+            entries = [d.submit([i, i + 1]) for i in range(3)]
+            outs = [d.wait(e, timeout=5) for e in entries]
+            for i, out in enumerate(outs):
+                assert isinstance(out, np.ndarray)
+                assert out.tolist() == [i, i + 1]
+            drains, drain_s = d.stats()
+            assert drains == 3 and drain_s >= 0.0
+            assert not d.poisoned
+        finally:
+            d.stop()
+        assert not d._thread.is_alive()
+
+    def test_readback_exception_parked_and_reraised(self):
+        d = ChunkDrainer()
+        try:
+            bad = d.submit(_Boom())
+            with pytest.raises(RuntimeError, match="buffer deleted"):
+                d.wait(bad, timeout=5)
+            # The drainer itself survives a dead buffer: next entry drains.
+            good = d.wait(d.submit([7]), timeout=5)
+            assert good.tolist() == [7]
+        finally:
+            d.stop()
+
+    def test_timeout_poisons(self):
+        d = ChunkDrainer()
+        entry = d.submit([1], pre_sleep_s=0.5)
+        assert d.wait(entry, timeout=0.01) is None
+        assert d.poisoned
+        # stop() must not block on the wedged thread.
+        t0 = time.monotonic()
+        d.stop()
+        assert time.monotonic() - t0 < 0.4
+
+    def test_on_drained_runs_on_drainer_thread(self):
+        d = ChunkDrainer()
+        seen = {}
+        fired = threading.Event()
+
+        def cb(arr, took):
+            seen["arr"] = arr
+            seen["took"] = took
+            seen["thread"] = threading.current_thread().name
+            fired.set()
+
+        try:
+            d.wait(d.submit([1, 2], on_drained=cb), timeout=5)
+            assert fired.wait(5)
+            assert seen["arr"].tolist() == [1, 2]
+            assert seen["took"] >= 0.0
+            assert seen["thread"] == "omnia-chunk-drainer"
+        finally:
+            d.stop()
+
+    def test_callback_exception_does_not_kill_drainer(self):
+        d = ChunkDrainer()
+        try:
+            d.wait(d.submit([1], on_drained=lambda a, t: 1 / 0), timeout=5)
+            assert d.wait(d.submit([2]), timeout=5).tolist() == [2]
+        finally:
+            d.stop()
+
+    def test_fault_pre_sleep_is_timed(self):
+        """Injected hang rides the drain wall (watchdog/chaos parity)."""
+        d = ChunkDrainer()
+        try:
+            d.wait(d.submit([1], pre_sleep_s=0.05), timeout=5)
+            _, drain_s = d.stats()
+            assert drain_s >= 0.05
+        finally:
+            d.stop()
+
+
+# ---------------------------------------------------------------------------
+# RingGate (jax-free) — the spec-decode _SpecGate state machine
+# ---------------------------------------------------------------------------
+
+
+class TestRingGate:
+    def test_probe_cycle_keeps_faster_async(self):
+        g = RingGate(window=2, hold_factor=2)
+        assert g.state == RingGate.PROBE_ASYNC and g.allows_async()
+        # Async probe: 100 tok/s realized.
+        g.tick(0.0, 0)
+        g.tick(1.0, 100)
+        assert g.state == RingGate.PROBE_SYNC and not g.allows_async()
+        # Sync probe: 10 tok/s — async wins, hold on.
+        g.tick(2.0, 110)
+        g.tick(3.0, 120)
+        assert g.state == RingGate.HOLD_ON and g.allows_async()
+        assert g.state_code() == 1
+        assert g.decisions == 1 and g.disables == 0
+        rep = g.report()
+        assert rep["state"] == "on"
+        assert rep["rate_async_tok_s"] == 100.0
+        assert rep["rate_sync_tok_s"] == 10.0
+
+    def test_slower_async_is_disabled(self):
+        g = RingGate(window=2, hold_factor=2)
+        g.tick(0.0, 0)
+        g.tick(1.0, 10)     # async: 10 tok/s
+        g.tick(2.0, 60)
+        g.tick(3.0, 160)    # sync: 100 tok/s — ring does not pay
+        assert g.state == RingGate.HOLD_OFF and not g.allows_async()
+        assert g.state_code() == 2
+        assert g.disables == 1
+        assert g.report()["state"] == "off"
+
+    def test_hold_expiry_reprobes(self):
+        g = RingGate(window=1, hold_factor=2)
+        g.tick(0.0, 0)      # async probe ends (rate 0 over zero time)
+        g.tick(1.0, 0)      # sync probe: rate 0 — tie keeps async on
+        assert g.state == RingGate.HOLD_ON
+        g.tick(2.0, 50)
+        g.tick(3.0, 100)    # hold (window*factor=2 ticks) expires
+        assert g.state == RingGate.PROBE_ASYNC
+        assert g.rate_async == 50.0  # hold refreshed the async rate
+
+    def test_window_zero_always_allows(self):
+        g = RingGate(window=0)
+        for i in range(10):
+            assert g.tick(float(i), i * 5)
+        assert g.state_code() == 0
+
+
+# ---------------------------------------------------------------------------
+# DevLoopState + _InflightChunk (jax-free)
+# ---------------------------------------------------------------------------
+
+
+class TestDevLoopState:
+    def test_ring_off_builds_nothing(self):
+        st = DevLoopState(0)
+        assert st.capacity == 0 and st.gate is None
+        assert not st.async_engaged(wall_clock=True)
+        assert not st.async_engaged(wall_clock=False)
+        assert st.drainer_if_live() is None
+        st.stop()  # no drainer ever built — a no-op
+
+    def test_ring_on_capacity_and_gate(self):
+        st = DevLoopState(3)
+        assert st.capacity == 3 and isinstance(st.gate, RingGate)
+        assert st.async_engaged(wall_clock=True)
+        # Lockstep engines (injected logical clock) keep async drain
+        # unconditionally — the gate's wall-clock decision never binds.
+        st.gate.state = RingGate.HOLD_OFF
+        assert not st.async_engaged(wall_clock=True)
+        assert st.async_engaged(wall_clock=False)
+        st.stop()
+
+    def test_gateless_ring(self):
+        st = DevLoopState(2, gate=False)
+        assert st.gate is None and st.async_engaged(wall_clock=True)
+        st.stop()
+
+    def test_drainer_lazy_and_poison_replacement(self):
+        st = DevLoopState(2)
+        assert st.drainer_if_live() is None  # lazy: nothing until first use
+        d1 = st.get_drainer()
+        assert st.get_drainer() is d1
+        d1.poisoned = True
+        assert st.drainer_if_live() is None
+        d2 = st.get_drainer()  # recovery lane: fresh thread
+        assert d2 is not d1 and not d2.poisoned
+        st.stop()
+        assert st._drainer is None
+
+    def test_step_ema(self):
+        st = DevLoopState(2)
+        before = st.step_ema_s
+        for _ in range(50):
+            st.observe_step_time(1.0)
+        assert abs(st.step_ema_s - 1.0) < 1e-3 and st.step_ema_s != before
+        st.stop()
+
+    def test_inflight_chunk_fields(self):
+        ch = _InflightChunk("toks", [(0, "r0")], 0.25)
+        assert ch.dl_steps is None and ch.entry is None
+        assert ch.toks == "toks" and ch.dispatch_s == 0.25
+        assert not hasattr(ch, "__dict__")  # __slots__: pipeline entry
+
+
+# ---------------------------------------------------------------------------
+# MockEngine ring mirror (jax-free)
+# ---------------------------------------------------------------------------
+
+
+REPLY = "devloop-reply!"  # 14 tokens under the byte tokenizer
+
+
+class TestMockRingMirror:
+    def test_mock_rejects_one_deep_ring(self):
+        with pytest.raises(ValueError, match="one-deep ring"):
+            MockEngine(decode_ring=1)
+
+    def test_mock_ring_ledger(self):
+        m = MockEngine([Scenario(".", REPLY)], decode_ring=4)
+        toks, fin = m.generate(m.tokenizer.encode("hi"))
+        assert m.tokenizer.decode(toks) == REPLY
+        assert fin.finish_reason is FinishReason.STOP
+        assert m.metrics["decode_ring_enabled"] == 1
+        # ceil(14 / 4) chunk-strides drained, gate engaged, no stalls.
+        assert m.metrics["ring_drains"] == 4
+        assert m.metrics["decode_ring_gate_state"] == 1
+        assert m.metrics["ring_full_stalls"] == 0
+        assert m.metrics["early_exit_steps"] == 0
+
+    def test_mock_decode_ring_off_is_true_noop(self):
+        """KNOB_GUARDS target (MockEngine.decode_ring): the default books
+        zero ring state and playback is byte-identical to a ring mock."""
+        off = MockEngine([Scenario(".", REPLY)])
+        on = MockEngine([Scenario(".", REPLY)], decode_ring=2)
+        prompt = off.tokenizer.encode("hi")
+        t_off, _ = off.generate(prompt)
+        t_on, _ = on.generate(prompt)
+        assert t_off == t_on
+        assert off.decode_ring == 0
+        for key in ("decode_ring_enabled", "ring_drains",
+                    "ring_full_stalls", "early_exit_steps",
+                    "decode_ring_gate_state"):
+            assert off.metrics[key] == 0, (key, off.metrics[key])
+
+
+# ---------------------------------------------------------------------------
+# Aggregator devloop gate (jax-free) — bench aux.devloop → ArenaJob verdict
+# ---------------------------------------------------------------------------
+
+
+class TestAggregatorDevloopGate:
+    def _agg(self):
+        from omnia_tpu.evals.aggregator import Aggregator
+
+        return Aggregator()
+
+    def test_silent_regression_fails_the_bound(self):
+        from omnia_tpu.evals.defs import Threshold
+
+        agg = self._agg()
+        assert not agg.add_devloop({"error": "boom"})  # errored phase folds nothing
+        assert agg.add_devloop({
+            "ratio_on_vs_off": 0.9, "gate": {"state": "on"},
+            "paying": False, "regression": True,
+        })
+        verdict = agg.evaluate(Threshold(min_devloop_ratio=0.95))
+        assert not verdict["passed"]
+        assert "devloop/bench" in verdict["failures"][0]
+        assert "0.900" in verdict["failures"][0]
+        assert verdict["devloop"][0]["regression"] is True
+
+    def test_reported_gate_disable_clears_the_bound(self):
+        from omnia_tpu.evals.defs import Threshold
+
+        agg = self._agg()
+        assert agg.add_devloop({
+            "ratio_on_vs_off": 0.7, "gate": {"state": "off"},
+            "paying": True, "regression": False,
+        })
+        verdict = agg.evaluate(Threshold(min_devloop_ratio=0.95))
+        assert verdict["passed"] and verdict["devloop"][0]["gate_disabled"]
+
+    def test_unset_bound_and_unfolded_jobs_never_engage(self):
+        from omnia_tpu.evals.defs import Threshold
+
+        agg = self._agg()
+        agg.add_devloop({"ratio_on_vs_off": 0.5, "gate": None})
+        assert agg.evaluate(Threshold())["passed"]  # no bound set
+        clean = self._agg().evaluate(Threshold(min_devloop_ratio=0.95))
+        assert clean["passed"] and "devloop" not in clean  # nothing folded
+
+    def test_threshold_schema_row(self):
+        from omnia_tpu.evals.defs import ArenaJobSpec
+
+        spec = ArenaJobSpec.from_dict({
+            "name": "perf", "providers": ["p"],
+            "threshold": {"min_devloop_ratio": 0.97},
+        })
+        assert spec.threshold.min_devloop_ratio == 0.97
+
+
+# ---------------------------------------------------------------------------
+# Engine-backed equivalence battery (skips without jax)
+# ---------------------------------------------------------------------------
+
+
+def _engine(**kw):
+    pytest.importorskip("jax")
+    from omnia_tpu.engine.engine import InferenceEngine
+    from omnia_tpu.engine.types import EngineConfig
+    from omnia_tpu.models import get_config
+
+    seed = kw.pop("seed", 0)
+    base = dict(num_slots=2, max_seq=64, prefill_buckets=(8,),
+                dtype="float32", max_sessions=0)
+    base.update(kw)
+    return InferenceEngine(get_config("test-tiny"), EngineConfig(**base),
+                           seed=seed)
+
+
+GREEDY = SamplingParams(temperature=0.0, max_tokens=12)
+
+
+def _drive(eng, *handles, timeout=60):
+    deadline = time.monotonic() + timeout
+    out = []
+    while eng.step():
+        assert time.monotonic() < deadline
+    for h in handles:
+        out.append(h.collect_tokens(timeout=timeout))
+    return out
+
+
+def test_decode_ring_off_is_true_noop():
+    """KNOB_GUARDS target (EngineConfig.decode_ring): decode_ring=0
+    allocates ZERO ring state — no devloop container, no drainer
+    thread, no per-slot grammar-EOS array — and the compiled decode
+    program carries the exact pre-ring operands (the 12-argument
+    signature lowers; byte-identical whether or not the host-side
+    watchdog, which shares the drainer implementation, is on)."""
+    off = _engine()
+    wd = _engine(watchdog_s=30.0)
+    assert off._devloop is None and off._geos is None
+    assert off.cfg.decode_ring == 0
+
+    def lowered(eng):
+        return eng._decode_fn_single.lower(
+            eng.params, eng._ck, eng._cv, eng._tokens, eng._positions,
+            eng._active, eng._budget, eng._stop_ids, eng._key_data,
+            eng._temp, eng._top_p, eng._top_k,
+        ).as_text()
+
+    # The watchdog engine owns devloop state (its drainer) but traces
+    # the identical ring-free program.
+    assert wd._devloop is not None and wd._devloop.ring == 0
+    assert lowered(off) == lowered(wd)
+
+    toks, fin = off.generate([1, 2, 3], GREEDY)
+    assert toks and fin.finish_reason is not None
+    for key in ("ring_drains", "ring_full_stalls", "early_exit_steps",
+                "decode_ring_gate_state", "decode_ring_enabled"):
+        assert off.metrics[key] == 0, (key, off.metrics[key])
+    wd.stop()
+
+
+def test_ring_one_rejected_at_construction():
+    with pytest.raises(ValueError, match="one-deep ring"):
+        _engine(decode_ring=1)
+
+
+def test_ring_greedy_equivalence_and_resident_kv():
+    """Ring on vs off: bit-identical greedy streams AND bit-identical
+    valid resident KV rows for a sessionful turn (the ring early-out
+    may skip frozen-slot garbage writes, so only rows below the
+    session's valid frontier are comparable — exactly the rows any
+    later turn can read)."""
+    prompt = [1, 2, 3, 4]
+    results = []
+    for ring in (0, 2):
+        eng = _engine(decode_ring=ring, max_sessions=4)
+        h = eng.submit(prompt, GREEDY, session_id="s")
+        (res,) = _drive(eng, h)
+        rows = len(eng._sessions["s"].token_ids)
+        assert rows > 0
+        ck = np.asarray(eng._ck)[:, 0, :rows]
+        cv = np.asarray(eng._cv)[:, 0, :rows]
+        results.append((res, rows, ck, cv))
+        if ring:
+            assert eng.metrics["decode_ring_enabled"] == 1
+            assert eng.metrics["ring_drains"] > 0
+            eng.stop()
+    (t0, r0, ck0, cv0), (t1, r1, ck1, cv1) = results
+    assert t0 == t1 and r0 == r1
+    np.testing.assert_array_equal(ck0, ck1)
+    np.testing.assert_array_equal(cv0, cv1)
+
+
+@pytest.mark.parametrize("extra", [
+    pytest.param({"kv_quant": "int8"}, id="int8-kv"),
+    pytest.param({"kv_pages": 9, "kv_page_tokens": 8}, id="paged"),
+    pytest.param({"spec_decode": 2}, id="spec"),
+    pytest.param({"prefill_chunk_tokens": 4}, id="interleave"),
+])
+def test_ring_equivalence_with_cotenant(extra):
+    """Ring on vs off under each major engine feature, with TWO live
+    requests so chunks carry multi-slot snapshots (spec-decode and
+    mixed interleave steps must ride the same ring unchanged)."""
+    pa, pb = [1, 2, 3], [9, 8, 7, 6]
+    streams = []
+    for ring in (0, 2):
+        eng = _engine(decode_ring=ring, **extra)
+        ha = eng.submit(pa, GREEDY)
+        hb = eng.submit(pb, GREEDY)
+        streams.append([t for t, _ in _drive(eng, ha, hb)])
+        eng.stop()
+    assert streams[0] == streams[1]
+
+
+def test_ring_grammar_equivalence_and_inscan_eos():
+    """Grammar-constrained ring decode: identical constrained streams,
+    and the ring engine carries the per-slot grammar-EOS ids so the
+    scan can freeze a completed grammar slot in-scan."""
+    pytest.importorskip("jax")
+    from omnia_tpu.engine.grammar import compile_json_schema
+    from omnia_tpu.engine.tokenizer import ByteTokenizer
+    from omnia_tpu.models import get_config
+
+    schema = {"type": "object",
+              "properties": {"a": {"type": "integer"}},
+              "required": ["a"]}
+    g = compile_json_schema(schema, ByteTokenizer())
+    sp = SamplingParams(temperature=0.0, max_tokens=40, stop_token_ids=(0,))
+    streams = []
+    for ring in (0, 2):
+        eng = _engine(decode_ring=ring, num_slots=4, max_seq=128,
+                      prefill_buckets=(8, 16, 32), grammar=True,
+                      grammar_max_states=512)
+        if ring:
+            assert eng._geos is not None
+        else:
+            assert eng._geos is None
+        h = eng.submit(list(b"make json"), sp, grammar=g)
+        streams.append(_drive(eng, h)[0][0])
+        eng.stop()
+    assert streams[0] == streams[1]
+    v = g.view(get_config("test-tiny").vocab_size, (0,))
+    s = v.start
+    for t in streams[0]:
+        assert v.allowed(s)[t]
+        s = v.advance(s, t)
+
+
+def test_mid_scan_deadline_exact_partial_counts():
+    """The in-scan deadline-step budget: a slot whose wall budget
+    converts to 1 step emits exactly one in-chunk token and finishes
+    DEADLINE at the same step the device masked it — streamed tokens
+    == num_generated, and the chunk's remaining steps are booked as
+    early-exit savings."""
+    eng = _engine(decode_ring=2)
+    # Force the deadline→steps conversion to 1 step without the
+    # boundary reap ever firing: a far-future wall deadline against a
+    # huge per-step EMA.
+    eng._devloop.step_ema_s = 1e4
+    h = eng.submit([1, 2, 3], SamplingParams(temperature=0.0, max_tokens=32),
+                   deadline_s=60.0)
+    ((toks, fin),) = _drive(eng, h)
+    assert fin.finish_reason is FinishReason.DEADLINE
+    assert len(toks) == fin.num_generated_tokens
+    # Prefill's first token + exactly one in-scan step before the mask.
+    assert fin.num_generated_tokens == 2
+    assert eng.metrics["deadline_exceeded"] == 1
+    assert eng.metrics["early_exit_steps"] > 0
+
+
+def test_cancel_mid_ring_exact_partial_counts():
+    """A cancel landing while ring chunks are in flight: the terminal
+    carries exactly the streamed token count (no token from a stale
+    drained chunk leaks past the terminal)."""
+    eng = _engine(decode_ring=2)
+    h = eng.submit([1, 2, 3], SamplingParams(temperature=0.0, max_tokens=48))
+    for _ in range(3):
+        eng.step()
+    h.cancel()
+    while eng.step():
+        pass
+    toks, fin = h.collect_tokens(timeout=30)
+    assert fin.finish_reason is FinishReason.CANCELLED
+    assert len(toks) == fin.num_generated_tokens
+
+
+def test_ring_watchdog_trip_poisons_drainer_and_recovers():
+    """An injected hang on the drainer thread trips the watchdog at
+    the bound, poisons the drainer, and recovery rebuilds device state
+    plus a FRESH drainer lane — the engine serves again."""
+    from omnia_tpu.engine.faults import FaultPlan
+
+    plan = FaultPlan(hang_dispatch_s=30.0, hang_count=1)
+    eng = _engine(decode_ring=2, watchdog_s=0.2)
+    eng._fault_plan = plan
+    h = eng.submit([1, 2, 3], GREEDY)
+    from omnia_tpu.engine.faults import WatchdogTimeout
+
+    with pytest.raises(WatchdogTimeout):
+        while eng.step():
+            pass
+    assert eng.metrics["watchdog_trips"] == 1
+    poisoned = eng._devloop._drainer
+    assert poisoned is not None and poisoned.poisoned
+    eng._recover("watchdog tripped")
+    assert eng.healthy() and eng.metrics["recoveries"] == 1
+    _toks, fin = h.collect_tokens(timeout=30)
+    assert fin.finish_reason is FinishReason.ERROR
+    # Post-recovery service on a fresh drainer lane.
+    toks2, fin2 = eng.generate([4, 5, 6], GREEDY)
+    assert toks2 and fin2.finish_reason is not None
+    assert eng._devloop._drainer is not poisoned
+    eng.stop()
+
+
+def test_ring_drain_stop_with_inflight_chunks():
+    """stop(drain=True) with a half-drained ring: every in-flight
+    chunk's tokens are surfaced (the stream terminal arrives), and the
+    drainer thread is joined."""
+    eng = _engine(decode_ring=2)
+    h = eng.submit([1, 2, 3], SamplingParams(temperature=0.0, max_tokens=48))
+    for _ in range(4):
+        eng.step()
+    assert eng._inflight  # chunks genuinely in flight mid-drain
+    eng.stop(drain=True)
+    d = eng._devloop._drainer
+    assert d is None  # stop() joined and cleared the drainer
+    toks, fin = h.collect_tokens(timeout=5)
+    assert fin.finish_reason is not None
+    assert len(toks) == fin.num_generated_tokens
+
+
+def test_ring_full_stall_books_and_preserves_stream():
+    """A pipeline held past the ring's undrained-chunk capacity books
+    ring_full_stalls and processes the oldest chunk first — tokens
+    still arrive exactly once, in order."""
+    eng = _engine(decode_ring=2, decode_pipeline=4)
+    off = _engine(decode_pipeline=4)
+    sp = SamplingParams(temperature=0.0, max_tokens=24)
+    (t_on,) = _drive(eng, eng.submit([5, 6, 7], sp))
+    (t_off,) = _drive(off, off.submit([5, 6, 7], sp))
+    assert t_on[0] == t_off[0]
+    # decode_pipeline=4 wants 4 undrained chunks; capacity 2 stalls it.
+    assert eng.metrics["ring_full_stalls"] > 0
+    eng.stop()

@@ -78,6 +78,8 @@ KNOB_GUARDS = {
         "test_flight.py::test_flight_off_is_true_noop",
     "EngineConfig.warmup_threads":
         "test_coldstart.py::test_warmup_threads_zero_is_true_noop",
+    "EngineConfig.decode_ring":
+        "test_devloop.py::test_decode_ring_off_is_true_noop",
     "MockEngine.kv_quant":
         "test_guards.py::test_mock_knobs_off_are_true_noop",
     "MockEngine.fault_plan":
@@ -100,6 +102,8 @@ KNOB_GUARDS = {
         "structural: mirror depth cap; dead while spec_decode=0",
     "MockEngine.spec_gate_window":
         "structural: mirror gate window; dead while spec_decode=0",
+    "MockEngine.decode_ring":
+        "test_devloop.py::test_mock_decode_ring_off_is_true_noop",
     "MockEngine.warmup_threads":
         "test_coldstart.py::test_mock_warmup_threads_zero_is_true_noop",
     "MockEngine.coldstart":
@@ -421,10 +425,20 @@ def test_lifecycle_knobs_off_are_true_noop():
     while off.step():
         pass
     h.collect_tokens(timeout=5)
-    # watchdog_s=None syncs inline: no omnia-chunk-sync thread ever ran.
+    # watchdog_s=None syncs inline: no omnia-chunk-sync thread ever ran
+    # (and none CAN anymore — the watchdog path now shares the ONE
+    # long-lived omnia-chunk-drainer per engine, engine/devloop.py).
     assert not [
         t for t in _threading.enumerate() if t.name == "omnia-chunk-sync"
     ]
+    # The knobs-off engine builds no devloop state at all; the knobs-on
+    # engine's watchdog runs through its single long-lived drainer, not
+    # per-chunk thread churn (one ChunkDrainer, reused across chunks).
+    assert off._devloop is None
+    d = on._devloop.drainer_if_live()
+    assert d is not None and d.drains > 0
+    on.stop()
+    assert not d._thread.is_alive()
     # The always-present counters exist and stayed zero on both engines.
     for eng in (off, on):
         for key in ("requests_shed", "deadline_exceeded", "watchdog_trips"):
